@@ -1,0 +1,30 @@
+"""Test configuration.
+
+Forces an 8-device virtual CPU platform before jax is imported anywhere, the
+analog of the reference's single-host multi-rank loopback testing via
+btl/self + btl/sm (SURVEY.md §4): any N-rank collective/pt2pt test runs on one
+host with no TPU.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture()
+def fresh_vars():
+    """Snapshot/restore the MCA var registry around a test."""
+    from zhpe_ompi_tpu.mca import var as mca_var
+
+    saved = {v.name: (v._value, v._source) for v in mca_var.registry.all_vars()}
+    yield mca_var.registry
+    for v in mca_var.registry.all_vars():
+        if v.name in saved:
+            v._value, v._source = saved[v.name]

@@ -1,0 +1,215 @@
+"""Datatype engine tests.
+
+Pure-host pack/unpack without any network, modeled on the reference's
+test/datatype suite (ddt_test.c, ddt_pack.c, position.c, unpack_ooo.c) —
+SURVEY.md §4.
+"""
+
+import numpy as np
+import pytest
+
+import zhpe_ompi_tpu.datatype as dt
+from zhpe_ompi_tpu.core import errors
+
+
+class TestPredefined:
+    def test_basic_sizes(self):
+        assert dt.FLOAT.size == 4 and dt.FLOAT.extent == 4
+        assert dt.DOUBLE.size == 8
+        assert dt.BYTE.size == 1
+        assert dt.BFLOAT16.size == 2  # TPU-first: bfloat16 is predefined
+
+    def test_pair_type(self):
+        assert dt.FLOAT_INT.size == 8
+        tm = dt.FLOAT_INT.typemap()
+        assert tm[0][1] == 0 and tm[1][1] == 4
+
+    def test_from_np(self):
+        assert dt.from_np_dtype(np.float32) is dt.FLOAT
+        assert dt.from_np_dtype("bfloat16") is dt.BFLOAT16
+
+
+class TestConstructors:
+    def test_contiguous(self):
+        t = dt.create_contiguous(4, dt.FLOAT).commit()
+        assert t.size == 16 and t.extent == 16
+        assert t.is_contiguous
+
+    def test_vector_gaps(self):
+        # 3 blocks of 2 floats, stride 4 floats: |XX..XX..XX|
+        t = dt.create_vector(3, 2, 4, dt.FLOAT)
+        assert t.size == 24
+        assert not t.is_contiguous
+        assert t.segments() == [(0, 8), (16, 8), (32, 8)]
+
+    def test_vector_contig_when_stride_equals_blocklen(self):
+        t = dt.create_vector(3, 2, 2, dt.FLOAT)
+        assert t.is_contiguous
+
+    def test_indexed(self):
+        t = dt.create_indexed([2, 1], [0, 3], dt.INT)
+        assert t.size == 12
+        assert t.segments() == [(0, 8), (12, 4)]
+
+    def test_struct(self):
+        t = dt.create_struct([1, 1], [0, 8], [dt.INT, dt.DOUBLE])
+        assert t.size == 12
+        assert t.extent == 16
+        assert t.homogeneous_dtype is None
+
+    def test_subarray(self):
+        # 4x4 array, take the middle 2x2 at (1,1)
+        t = dt.create_subarray([4, 4], [2, 2], [1, 1], dt.FLOAT)
+        assert t.size == 16
+        assert t.extent == 64  # full array, per the standard
+        assert t.segments() == [(20, 8), (36, 8)]
+
+    def test_resized(self):
+        t = dt.create_resized(dt.FLOAT, 0, 16)
+        assert t.size == 4 and t.extent == 16
+
+    def test_bounds_check(self):
+        with pytest.raises(errors.ArgError):
+            dt.create_subarray([4], [3], [2], dt.FLOAT)
+
+    def test_zero_blocklength_vector(self):
+        t = dt.create_vector(2, 0, 1, dt.INT)
+        assert t.size == 0 and t.extent == 0
+        assert dt.convertor.pack(np.zeros(4, np.int32), t, 2).nbytes == 0
+
+    def test_positive_lb_indexed(self):
+        # MPI: indexed([1],[1],INT) has lb=4, extent=4; element k's payload
+        # sits at byte 4k+4
+        t = dt.create_indexed([1], [1], dt.INT).commit()
+        assert t.lb == 4 and t.extent == 4
+        idx = dt.convertor.byte_index_map(t, 3)
+        np.testing.assert_array_equal(idx, np.arange(4, 16))
+        src = np.arange(8, dtype=np.int32)
+        packed = dt.convertor.pack(src, t, 3)
+        np.testing.assert_array_equal(packed.view(np.int32), [1, 2, 3])
+
+    def test_negative_displacement_rejected(self):
+        t = dt.create_hvector(2, 1, -4, dt.INT)
+        with pytest.raises(errors.ArgError):
+            dt.convertor.pack(np.zeros(4, np.int32), t, 1)
+
+
+class TestPackUnpack:
+    def test_contiguous_roundtrip(self):
+        src = np.arange(16, dtype=np.float32)
+        t = dt.create_contiguous(4, dt.FLOAT).commit()
+        packed = dt.convertor.pack(src, t, 4)
+        assert packed.nbytes == 64
+        out = dt.convertor.unpack(packed, t, 4)
+        np.testing.assert_array_equal(out.view(np.float32), src)
+
+    def test_vector_pack(self):
+        # matrix column extraction: 4x4 f32, column 1
+        m = np.arange(16, dtype=np.float32).reshape(4, 4)
+        col = dt.create_vector(4, 1, 4, dt.FLOAT).commit()
+        packed = dt.convertor.pack(np.ascontiguousarray(m.ravel()[1:]), col, 1)
+        np.testing.assert_array_equal(
+            packed.view(np.float32), np.array([1, 5, 9, 13], dtype=np.float32)
+        )
+
+    def test_vector_unpack_roundtrip(self):
+        src = np.arange(24, dtype=np.float32)
+        t = dt.create_vector(3, 2, 4, dt.FLOAT).commit()
+        count = 2
+        packed = dt.convertor.pack(src, t, count)
+        assert packed.nbytes == t.size * count
+        dest = np.zeros_like(src)
+        dt.convertor.unpack(packed, t, count, out=dest)
+        idx = dt.convertor.byte_index_map(t, count)
+        src_b = src.view(np.uint8)
+        dest_b = dest.view(np.uint8)
+        np.testing.assert_array_equal(dest_b[idx], src_b[idx])
+
+    def test_struct_roundtrip(self):
+        t = dt.create_struct([1, 2], [0, 8], [dt.INT, dt.DOUBLE]).commit()
+        n = dt.convertor.span_bytes(t, 3)
+        src = np.random.default_rng(0).integers(0, 255, n, dtype=np.uint8)
+        packed = dt.convertor.pack(src, t, 3)
+        assert packed.nbytes == t.size * 3
+        dest = np.zeros(n, dtype=np.uint8)
+        dt.convertor.unpack(packed, t, 3, out=dest)
+        idx = dt.convertor.byte_index_map(t, 3)
+        np.testing.assert_array_equal(dest[idx], src[idx])
+
+    def test_truncation_raises(self):
+        t = dt.create_contiguous(4, dt.FLOAT)
+        with pytest.raises(errors.TruncateError):
+            dt.convertor.pack(np.zeros(2, np.float32), t, 4)
+
+    def test_position_partial_pack(self):
+        """Resumable packing at arbitrary byte positions (position.c model)."""
+        src = np.arange(40, dtype=np.float32)
+        t = dt.create_vector(5, 1, 2, dt.FLOAT).commit()
+        full = dt.convertor.pack(src, t, 2)
+        chunks, pos = [], 0
+        while pos < full.nbytes:
+            chunk, pos = dt.convertor.pack_partial(src, t, 2, pos, 7)  # odd size
+            chunks.append(chunk)
+        np.testing.assert_array_equal(np.concatenate(chunks), full)
+
+    def test_unpack_out_of_order(self):
+        """Chunks landing out of order (unpack_ooo.c model)."""
+        src = np.arange(40, dtype=np.float32)
+        t = dt.create_vector(5, 1, 2, dt.FLOAT).commit()
+        full = dt.convertor.pack(src, t, 2)
+        dest = np.zeros_like(src)
+        # split packed stream into 3 chunks, apply in reverse order
+        bounds = [0, 13, 27, full.nbytes]
+        for i in (2, 1, 0):
+            chunk = full[bounds[i] : bounds[i + 1]]
+            dt.convertor.unpack_partial(chunk, dest, t, 2, bounds[i])
+        idx = dt.convertor.byte_index_map(t, 2)
+        np.testing.assert_array_equal(
+            dest.view(np.uint8)[idx], src.view(np.uint8)[idx]
+        )
+
+
+class TestDevicePath:
+    def test_device_pack_gather(self):
+        import jax.numpy as jnp
+
+        x = jnp.arange(24, dtype=jnp.float32)
+        t = dt.create_vector(3, 2, 4, dt.FLOAT).commit()
+        packed = dt.convertor.device_pack(x, t, 2)
+        host = dt.convertor.pack(np.asarray(x), t, 2).view(np.float32)
+        np.testing.assert_array_equal(np.asarray(packed), host)
+
+    def test_device_unpack_scatter(self):
+        import jax.numpy as jnp
+
+        t = dt.create_vector(3, 2, 4, dt.FLOAT).commit()
+        packed = jnp.arange(12, dtype=jnp.float32)
+        out = jnp.zeros(24, dtype=jnp.float32)
+        res = dt.convertor.device_unpack(packed, t, 2, out)
+        host = dt.convertor.unpack(np.asarray(packed), t, 2).view(np.float32)
+        np.testing.assert_array_equal(np.asarray(res)[: host.shape[0]], host)
+
+    def test_device_pack_jittable(self):
+        import jax
+        import jax.numpy as jnp
+
+        t = dt.create_vector(3, 2, 4, dt.FLOAT).commit()
+        f = jax.jit(lambda x: dt.convertor.device_pack(x, t, 2))
+        x = jnp.arange(24, dtype=jnp.float32)
+        np.testing.assert_array_equal(
+            np.asarray(f(x)), np.asarray(dt.convertor.device_pack(x, t, 2))
+        )
+
+    def test_bf16_device_pack(self):
+        import jax.numpy as jnp
+
+        x = jnp.arange(16, dtype=jnp.bfloat16)
+        t = dt.create_vector(2, 2, 4, dt.BFLOAT16).commit()
+        packed = dt.convertor.device_pack(x, t, 2)
+        assert packed.dtype == jnp.bfloat16
+        # vector(2,2,4) extent = ((2-1)*4+2) elements = 6, so the second
+        # element of the type starts at element 6 (MPI extent semantics)
+        np.testing.assert_array_equal(
+            np.asarray(packed, dtype=np.float32),
+            np.array([0, 1, 4, 5, 6, 7, 10, 11], dtype=np.float32),
+        )

@@ -1,0 +1,167 @@
+"""Tests for the MCA substrate: var precedence, component selection.
+
+Mirrors the reference's var-system semantics (opal/mca/base/mca_base_var.c):
+default < file < env < API precedence with per-var source tracking, and the
+include/exclude component-list parsing of mca_base_component_find.c.
+"""
+
+import os
+
+import pytest
+
+from zhpe_ompi_tpu.core import errors
+from zhpe_ompi_tpu.mca import component as mca_comp
+from zhpe_ompi_tpu.mca import var as mca_var
+
+
+class TestVarSystem:
+    def test_default(self):
+        v = mca_var.register("t_default_param", 42, "test", type=int)
+        assert v.value == 42
+        assert v.source == mca_var.VarSource.DEFAULT
+
+    def test_env_overrides_default(self, monkeypatch):
+        monkeypatch.setenv("ZMPI_MCA_t_env_param", "7")
+        v = mca_var.register("t_env_param", 1, "test", type=int)
+        assert v.value == 7
+        assert v.source == mca_var.VarSource.ENV
+
+    def test_api_overrides_env(self, monkeypatch):
+        monkeypatch.setenv("ZMPI_MCA_t_api_param", "7")
+        mca_var.register("t_api_param", 1, "test", type=int)
+        mca_var.set_var("t_api_param", 9)
+        v = mca_var.lookup("t_api_param")
+        assert v.value == 9
+        assert v.source == mca_var.VarSource.API
+        mca_var.unset("t_api_param")
+        assert v.value == 7
+        assert v.source == mca_var.VarSource.ENV
+
+    def test_pending_api_set_before_register(self):
+        mca_var.set_var("t_pending_param", "xyz")
+        v = mca_var.register("t_pending_param", "abc", "test")
+        assert v.value == "xyz"
+        assert v.source == mca_var.VarSource.API
+
+    def test_bool_parsing(self, monkeypatch):
+        monkeypatch.setenv("ZMPI_MCA_t_bool_param", "yes")
+        v = mca_var.register("t_bool_param", False, "test", type=bool)
+        assert v.value is True
+
+    def test_enum_rejects(self):
+        mca_var.register("t_enum_param", "a", "test", enum=("a", "b"))
+        with pytest.raises(ValueError):
+            mca_var.set_var("t_enum_param", "c")
+
+    def test_int_parses_hex(self, monkeypatch):
+        monkeypatch.setenv("ZMPI_MCA_t_hex_param", "0x10")
+        v = mca_var.register("t_hex_param", 0, "test", type=int)
+        assert v.value == 16
+
+    def test_not_settable(self):
+        mca_var.register("t_ro_param", 5, "test", type=int, settable=False)
+        with pytest.raises(PermissionError):
+            mca_var.set_var("t_ro_param", 6)
+
+    def test_file_layer(self, tmp_path, monkeypatch):
+        conf = tmp_path / "mca-params.conf"
+        conf.write_text("# comment\nt_file_param = hello\n")
+        monkeypatch.setattr(mca_var, "PARAM_FILE", str(conf))
+        reg = mca_var.VarRegistry()
+        v = reg.register("t_file_param", "default", "test")
+        assert v.value == "hello"
+        assert v.source == mca_var.VarSource.FILE
+
+    def test_override_file_beats_api(self, tmp_path, monkeypatch):
+        ovr = tmp_path / "override.conf"
+        ovr.write_text("t_ovr_param = pinned\n")
+        monkeypatch.setattr(mca_var, "OVERRIDE_FILE", str(ovr))
+        reg = mca_var.VarRegistry()
+        v = reg.register("t_ovr_param", "default", "test")
+        assert v.value == "pinned"
+        assert v.source == mca_var.VarSource.OVERRIDE
+        reg.set("t_ovr_param", "nope")
+        assert v.value == "pinned"
+
+
+class _FakeComp(mca_comp.Component):
+    framework_name = "t_fw"
+
+    def __init__(self, name, prio, avail=True):
+        self.name = name
+        self.default_priority = prio
+        self._avail = avail
+        super().__init__()
+
+    def available(self):
+        return self._avail
+
+
+class TestComponentSelection:
+    def _fw(self, name="t_fw"):
+        fw = mca_comp.Framework(name)
+        fw.register(_FakeComp("alpha", 50))
+        fw.register(_FakeComp("beta", 80))
+        fw.register(_FakeComp("gamma", 10))
+        fw.register(_FakeComp("broken", 99, avail=False))
+        return fw
+
+    def test_priority_order(self):
+        fw = self._fw()
+        names = [c.name for c in fw.admitted()]
+        assert names == ["beta", "alpha", "gamma"]
+
+    def test_include_list(self, monkeypatch):
+        fw = self._fw()
+        mca_var.set_var("t_fw", "alpha,gamma")
+        try:
+            names = [c.name for c in fw.admitted()]
+            assert names == ["alpha", "gamma"]
+        finally:
+            mca_var.unset("t_fw")
+
+    def test_exclude_list(self):
+        fw = self._fw()
+        mca_var.set_var("t_fw", "^beta")
+        try:
+            names = [c.name for c in fw.admitted()]
+            assert names == ["alpha", "gamma"]
+        finally:
+            mca_var.unset("t_fw")
+
+    def test_mixed_raises(self):
+        with pytest.raises(errors.ArgError):
+            mca_comp.parse_include_exclude("a,^b")
+
+    def test_exclude_caret_on_every_item(self):
+        inc, exc = mca_comp.parse_include_exclude("^a,^b")
+        assert inc is None and exc == {"a", "b"}
+
+    def test_unset_preserves_override(self, tmp_path, monkeypatch):
+        ovr = tmp_path / "override.conf"
+        ovr.write_text("t_ovr2_param = pinned\n")
+        monkeypatch.setattr(mca_var, "OVERRIDE_FILE", str(ovr))
+        reg = mca_var.VarRegistry()
+        v = reg.register("t_ovr2_param", "default", "test")
+        reg.unset("t_ovr2_param")
+        assert v.value == "pinned"
+        assert v.source == mca_var.VarSource.OVERRIDE
+
+    def test_select_one(self):
+        fw = self._fw()
+        assert fw.select_one().name == "beta"
+
+    def test_priority_var_override(self):
+        fw = self._fw()
+        mca_var.set_var("t_fw_gamma_priority", 1000)
+        try:
+            assert fw.select_one().name == "gamma"
+        finally:
+            mca_var.unset("t_fw_gamma_priority")
+
+    def test_info_dump(self):
+        fw = mca_comp.framework("t_fw_info", "test framework")
+        fw.register(_FakeComp("only", 1))
+        dump = mca_comp.info()
+        entry = [d for d in dump if d["framework"] == "t_fw_info"][0]
+        assert entry["components"][0]["name"] == "only"

@@ -1,0 +1,5 @@
+"""Core substrate: errors and small host-side data structures (OPAL-core analog)."""
+
+from . import errors
+
+__all__ = ["errors"]

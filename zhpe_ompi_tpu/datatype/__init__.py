@@ -1,0 +1,62 @@
+"""Datatype engine: predefined types, derived constructors, convertor.
+
+TPU-native analog of ``ompi/datatype`` + ``opal/datatype`` (SURVEY.md §2.1).
+"""
+
+from . import convertor
+from .derived import (
+    DerivedDatatype,
+    create_contiguous,
+    create_hindexed,
+    create_hvector,
+    create_indexed,
+    create_indexed_block,
+    create_resized,
+    create_struct,
+    create_subarray,
+    create_vector,
+    dup,
+)
+from .predefined import (
+    AINT,
+    BFLOAT16,
+    BYTE,
+    BasicDatatype,
+    C_BOOL,
+    C_DOUBLE_COMPLEX,
+    C_FLOAT_COMPLEX,
+    CHAR,
+    COUNT,
+    DOUBLE,
+    DOUBLE_INT,
+    Datatype,
+    FLOAT,
+    FLOAT16,
+    FLOAT_INT,
+    INT,
+    INT8_T,
+    INT16_T,
+    INT32_T,
+    INT64_T,
+    LONG,
+    LONG_INT,
+    LONG_LONG,
+    OFFSET,
+    PairDatatype,
+    SHORT,
+    SHORT_INT,
+    TWOINT,
+    UINT8_T,
+    UINT16_T,
+    UINT32_T,
+    UINT64_T,
+    UNSIGNED,
+    UNSIGNED_CHAR,
+    UNSIGNED_LONG,
+    UNSIGNED_SHORT,
+    WCHAR,
+    from_np_dtype,
+    lookup,
+)
+
+__all__ = [n for n in dir() if not n.startswith("_")]

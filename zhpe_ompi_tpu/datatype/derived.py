@@ -1,0 +1,272 @@
+"""Derived datatype constructors.
+
+Re-design of the reference's datatype construction
+(``ompi/datatype/ompi_datatype_create_*.c`` over ``opal/datatype``): a derived
+type is a typemap — a list of (basic dtype, byte displacement) pairs — plus an
+extent.  The reference stores an optimized description alongside the raw one
+(``opal/datatype/opal_datatype_optimize.c``); here :meth:`DerivedDatatype.segments`
+plays that role, merging adjacent entries into maximal contiguous byte runs so
+pack/unpack does few large copies instead of per-primitive copies.
+
+Supported constructors (MPI names): contiguous, vector, hvector, indexed,
+hindexed, indexed_block, struct, subarray, resized, dup.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..core import errors
+from .predefined import BasicDatatype, Datatype
+
+
+def merge_typemap_segments(
+    typemap: list[tuple[np.dtype, int]],
+) -> list[tuple[int, int]]:
+    """Merge a displacement-sorted typemap into maximal contiguous
+    (displacement, nbytes) byte runs — the optimized-description pass
+    (cf. opal_datatype_optimize.c)."""
+    segs: list[tuple[int, int]] = []
+    for dt, disp in sorted(typemap, key=lambda e: e[1]):
+        nbytes = int(np.dtype(dt).itemsize)
+        if segs and segs[-1][0] + segs[-1][1] == disp:
+            segs[-1] = (segs[-1][0], segs[-1][1] + nbytes)
+        else:
+            segs.append((disp, nbytes))
+    return segs
+
+
+def _extent_of(typemap: list[tuple[np.dtype, int]]) -> tuple[int, int]:
+    """(lb, extent) of a typemap per MPI semantics: lb = min displacement,
+    ub = max displacement+size, extent = ub - lb."""
+    if not typemap:
+        return 0, 0
+    lb = min(d for _, d in typemap)
+    ub = max(d + int(np.dtype(t).itemsize) for t, d in typemap)
+    return lb, ub - lb
+
+
+class DerivedDatatype(Datatype):
+    def __init__(
+        self,
+        name: str,
+        typemap: list[tuple[np.dtype, int]],
+        extent: int,
+        lb: int = 0,
+    ):
+        super().__init__(name)
+        self.committed = False
+        self._typemap = sorted(typemap, key=lambda e: e[1])
+        self._lb = lb
+        self._extent = extent
+        self._size = sum(int(np.dtype(d).itemsize) for d, _ in self._typemap)
+        self._segments: list[tuple[int, int]] | None = None
+
+    def commit(self) -> "DerivedDatatype":
+        """MPI_Type_commit: precompute the optimized description."""
+        self.segments()
+        self.committed = True
+        return self
+
+    @property
+    def size(self) -> int:
+        return self._size
+
+    @property
+    def extent(self) -> int:
+        return self._extent
+
+    @property
+    def lb(self) -> int:
+        return self._lb
+
+    def typemap(self):
+        return list(self._typemap)
+
+    def segments(self) -> list[tuple[int, int]]:
+        """Optimized description: maximal contiguous (displacement, nbytes)
+        runs of one element's typemap, in displacement order."""
+        if self._segments is None:
+            self._segments = merge_typemap_segments(self._typemap)
+        return self._segments
+
+    @property
+    def is_contiguous(self) -> bool:
+        segs = self.segments()
+        return (
+            len(segs) == 1
+            and segs[0][0] == self._lb
+            and segs[0][1] == self._size
+            and self._size == self._extent
+        )
+
+    @property
+    def homogeneous_dtype(self) -> np.dtype | None:
+        """The single basic dtype if every typemap entry shares it and all
+        displacements are element-aligned (enables the on-device gather path)."""
+        if not self._typemap:
+            return None
+        dt0 = np.dtype(self._typemap[0][0])
+        for dt, disp in self._typemap:
+            if np.dtype(dt) != dt0 or disp % dt0.itemsize != 0:
+                return None
+        if self._extent % dt0.itemsize != 0:
+            return None
+        return dt0
+
+    def element_indices(self) -> np.ndarray:
+        """For homogeneous types: element-granularity displacements of one
+        element of this datatype (used to build device gather indices)."""
+        dt = self.homogeneous_dtype
+        if dt is None:
+            raise errors.TypeError_(
+                f"datatype {self.name} is not homogeneous; no element view"
+            )
+        return np.asarray([disp // dt.itemsize for _, disp in self._typemap])
+
+
+def _expand(datatype: Datatype, disp: int) -> list[tuple[np.dtype, int]]:
+    return [(dt, d + disp) for dt, d in datatype.typemap()]
+
+
+def create_contiguous(count: int, oldtype: Datatype) -> DerivedDatatype:
+    """MPI_Type_contiguous (cf. ompi_datatype_create_contiguous.c)."""
+    if count < 0:
+        raise errors.CountError(f"negative count {count}")
+    tm = []
+    for i in range(count):
+        tm += _expand(oldtype, i * oldtype.extent)
+    return DerivedDatatype(
+        f"contig({count},{oldtype.name})", tm, count * oldtype.extent
+    )
+
+
+def create_vector(
+    count: int, blocklength: int, stride: int, oldtype: Datatype
+) -> DerivedDatatype:
+    """MPI_Type_vector: stride counted in oldtype extents
+    (cf. ompi_datatype_create_vector.c)."""
+    return create_hvector(count, blocklength, stride * oldtype.extent, oldtype)
+
+
+def create_hvector(
+    count: int, blocklength: int, stride_bytes: int, oldtype: Datatype
+) -> DerivedDatatype:
+    """MPI_Type_create_hvector: stride counted in bytes."""
+    if count < 0 or blocklength < 0:
+        raise errors.CountError("negative count/blocklength")
+    tm = []
+    for i in range(count):
+        base = i * stride_bytes
+        for j in range(blocklength):
+            tm += _expand(oldtype, base + j * oldtype.extent)
+    lb, extent = _extent_of(tm)
+    return DerivedDatatype(
+        f"hvector({count},{blocklength},{stride_bytes},{oldtype.name})",
+        tm,
+        extent,
+        lb,
+    )
+
+
+def create_indexed(
+    blocklengths: list[int], displacements: list[int], oldtype: Datatype
+) -> DerivedDatatype:
+    """MPI_Type_indexed: displacements in oldtype extents."""
+    return create_hindexed(
+        blocklengths, [d * oldtype.extent for d in displacements], oldtype
+    )
+
+
+def create_hindexed(
+    blocklengths: list[int], byte_displacements: list[int], oldtype: Datatype
+) -> DerivedDatatype:
+    """MPI_Type_create_hindexed: displacements in bytes."""
+    if len(blocklengths) != len(byte_displacements):
+        raise errors.ArgError("blocklengths and displacements length mismatch")
+    tm = []
+    for bl, disp in zip(blocklengths, byte_displacements):
+        for j in range(bl):
+            tm += _expand(oldtype, disp + j * oldtype.extent)
+    lb, extent = _extent_of(tm)
+    return DerivedDatatype(
+        f"hindexed({len(blocklengths)},{oldtype.name})", tm, extent, lb
+    )
+
+
+def create_indexed_block(
+    blocklength: int, displacements: list[int], oldtype: Datatype
+) -> DerivedDatatype:
+    """MPI_Type_create_indexed_block."""
+    return create_indexed([blocklength] * len(displacements), displacements, oldtype)
+
+
+def create_struct(
+    blocklengths: list[int],
+    byte_displacements: list[int],
+    types: list[Datatype],
+) -> DerivedDatatype:
+    """MPI_Type_create_struct (cf. ompi_datatype_create_struct.c)."""
+    if not (len(blocklengths) == len(byte_displacements) == len(types)):
+        raise errors.ArgError("struct argument length mismatch")
+    tm = []
+    for bl, disp, t in zip(blocklengths, byte_displacements, types):
+        for j in range(bl):
+            tm += _expand(t, disp + j * t.extent)
+    lb, extent = _extent_of(tm)
+    return DerivedDatatype(f"struct({len(types)})", tm, extent, lb)
+
+
+def create_subarray(
+    sizes: list[int],
+    subsizes: list[int],
+    starts: list[int],
+    oldtype: Datatype,
+    order: str = "C",
+) -> DerivedDatatype:
+    """MPI_Type_create_subarray (cf. ompi_datatype_create_subarray.c).
+
+    The extent covers the FULL array, as the standard requires, so counting
+    over the type walks whole-array strides.
+    """
+    ndims = len(sizes)
+    if not (len(subsizes) == len(starts) == ndims):
+        raise errors.ArgError("subarray argument length mismatch")
+    for d in range(ndims):
+        if starts[d] + subsizes[d] > sizes[d]:
+            raise errors.ArgError("subarray exceeds array bounds")
+    if order not in ("C", "F"):
+        raise errors.ArgError(f"bad order {order!r}")
+    # byte strides per dim over the full array
+    strides = [0] * ndims
+    acc = oldtype.extent
+    dims = range(ndims - 1, -1, -1) if order == "C" else range(ndims)
+    for d in dims:
+        strides[d] = acc
+        acc *= sizes[d]
+    total_bytes = acc
+    tm: list[tuple[np.dtype, int]] = []
+
+    def rec(dim: int, base: int):
+        if dim == ndims:
+            tm.extend(_expand(oldtype, base))
+            return
+        for i in range(subsizes[dim]):
+            rec(dim + 1, base + (starts[dim] + i) * strides[dim])
+
+    rec(0, 0)
+    return DerivedDatatype(f"subarray({sizes},{subsizes},{starts})", tm, total_bytes)
+
+
+def create_resized(oldtype: Datatype, lb: int, extent: int) -> DerivedDatatype:
+    """MPI_Type_create_resized."""
+    return DerivedDatatype(f"resized({oldtype.name})", oldtype.typemap(), extent, lb)
+
+
+def dup(oldtype: Datatype) -> DerivedDatatype:
+    """MPI_Type_dup."""
+    d = DerivedDatatype(
+        f"dup({oldtype.name})", oldtype.typemap(), oldtype.extent, oldtype.lb
+    )
+    d.committed = oldtype.committed
+    return d

@@ -1,0 +1,202 @@
+"""Predefined MPI datatypes.
+
+Re-design of the reference's predefined type table
+(``ompi/datatype/ompi_datatype_internal.h``, ``ompi/datatype/ompi_datatype_module.c``)
+for TPU: every basic type carries its numpy dtype (host representation) and its
+JAX dtype (device representation).  ``BFLOAT16`` is first-class — on TPU it is
+the native MXU element type — which the reference, being a CPU-era MPI, lacks.
+
+Pair types (``FLOAT_INT`` etc.) exist for MINLOC/MAXLOC reductions
+(``ompi/op/op.h``); on host they are numpy structured dtypes, on device they
+are (value, index) array pairs.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+try:  # jax.numpy bfloat16 is ml_dtypes.bfloat16
+    import ml_dtypes
+
+    _bfloat16 = np.dtype(ml_dtypes.bfloat16)
+except ImportError:  # pragma: no cover
+    _bfloat16 = None
+
+
+class Datatype:
+    """Base class of all datatypes.
+
+    Attributes mirror the reference's ``ompi_datatype_t``: ``size`` (bytes of
+    payload), ``extent`` (stride between consecutive elements), ``lb``/``ub``.
+    """
+
+    def __init__(self, name: str):
+        self.name = name
+        self.committed = True
+
+    # -- interface implemented by subclasses --
+
+    @property
+    def size(self) -> int:
+        raise NotImplementedError
+
+    @property
+    def extent(self) -> int:
+        raise NotImplementedError
+
+    @property
+    def lb(self) -> int:
+        return 0
+
+    @property
+    def ub(self) -> int:
+        return self.lb + self.extent
+
+    def typemap(self) -> list[tuple[np.dtype, int]]:
+        """Flattened (basic numpy dtype, byte displacement) list for ONE element."""
+        raise NotImplementedError
+
+    @property
+    def is_contiguous(self) -> bool:
+        """True when one element's payload is a single gap-free run and
+        extent == size (so count elements are also gap-free)."""
+        raise NotImplementedError
+
+    def __repr__(self) -> str:  # pragma: no cover
+        return f"Datatype({self.name}, size={self.size}, extent={self.extent})"
+
+
+class BasicDatatype(Datatype):
+    """A predefined basic type backed by one numpy scalar dtype."""
+
+    def __init__(self, name: str, np_dtype, jax_name: str | None = None):
+        super().__init__(name)
+        self.np_dtype = np.dtype(np_dtype)
+        self.jax_name = jax_name or self.np_dtype.name
+
+    @property
+    def size(self) -> int:
+        return self.np_dtype.itemsize
+
+    @property
+    def extent(self) -> int:
+        return self.np_dtype.itemsize
+
+    def typemap(self):
+        return [(self.np_dtype, 0)]
+
+    @property
+    def is_contiguous(self) -> bool:
+        return True
+
+
+class PairDatatype(Datatype):
+    """(value, index) pair type for MINLOC/MAXLOC (cf. ompi MPI_FLOAT_INT)."""
+
+    def __init__(self, name: str, value_dtype, index_dtype):
+        super().__init__(name)
+        self.value_dtype = np.dtype(value_dtype)
+        self.index_dtype = np.dtype(index_dtype)
+        self.np_dtype = np.dtype(
+            [("value", self.value_dtype), ("index", self.index_dtype)]
+        )
+
+    @property
+    def size(self) -> int:
+        return self.value_dtype.itemsize + self.index_dtype.itemsize
+
+    @property
+    def extent(self) -> int:
+        return self.np_dtype.itemsize  # includes any alignment padding
+
+    def typemap(self):
+        off_v = self.np_dtype.fields["value"][1]
+        off_i = self.np_dtype.fields["index"][1]
+        return [(self.value_dtype, off_v), (self.index_dtype, off_i)]
+
+    @property
+    def is_contiguous(self) -> bool:
+        return self.size == self.extent
+
+
+# ---------------------------------------------------------------------------
+# The predefined table (MPI name → datatype object)
+# ---------------------------------------------------------------------------
+
+BYTE = BasicDatatype("MPI_BYTE", np.uint8)
+CHAR = BasicDatatype("MPI_CHAR", np.int8)
+SIGNED_CHAR = BasicDatatype("MPI_SIGNED_CHAR", np.int8)
+UNSIGNED_CHAR = BasicDatatype("MPI_UNSIGNED_CHAR", np.uint8)
+SHORT = BasicDatatype("MPI_SHORT", np.int16)
+UNSIGNED_SHORT = BasicDatatype("MPI_UNSIGNED_SHORT", np.uint16)
+INT = BasicDatatype("MPI_INT", np.int32)
+UNSIGNED = BasicDatatype("MPI_UNSIGNED", np.uint32)
+LONG = BasicDatatype("MPI_LONG", np.int64)
+UNSIGNED_LONG = BasicDatatype("MPI_UNSIGNED_LONG", np.uint64)
+LONG_LONG = BasicDatatype("MPI_LONG_LONG", np.int64)
+INT8_T = BasicDatatype("MPI_INT8_T", np.int8)
+INT16_T = BasicDatatype("MPI_INT16_T", np.int16)
+INT32_T = BasicDatatype("MPI_INT32_T", np.int32)
+INT64_T = BasicDatatype("MPI_INT64_T", np.int64)
+UINT8_T = BasicDatatype("MPI_UINT8_T", np.uint8)
+UINT16_T = BasicDatatype("MPI_UINT16_T", np.uint16)
+UINT32_T = BasicDatatype("MPI_UINT32_T", np.uint32)
+UINT64_T = BasicDatatype("MPI_UINT64_T", np.uint64)
+FLOAT = BasicDatatype("MPI_FLOAT", np.float32)
+DOUBLE = BasicDatatype("MPI_DOUBLE", np.float64)
+FLOAT16 = BasicDatatype("MPI_FLOAT16", np.float16)
+C_BOOL = BasicDatatype("MPI_C_BOOL", np.bool_)
+C_FLOAT_COMPLEX = BasicDatatype("MPI_C_FLOAT_COMPLEX", np.complex64)
+C_DOUBLE_COMPLEX = BasicDatatype("MPI_C_DOUBLE_COMPLEX", np.complex128)
+AINT = BasicDatatype("MPI_AINT", np.int64)
+OFFSET = BasicDatatype("MPI_OFFSET", np.int64)
+COUNT = BasicDatatype("MPI_COUNT", np.int64)
+WCHAR = BasicDatatype("MPI_WCHAR", np.uint32)
+
+if _bfloat16 is not None:
+    BFLOAT16 = BasicDatatype("MPI_BFLOAT16", _bfloat16, jax_name="bfloat16")
+else:  # pragma: no cover
+    BFLOAT16 = None
+
+# MINLOC/MAXLOC pair types
+FLOAT_INT = PairDatatype("MPI_FLOAT_INT", np.float32, np.int32)
+DOUBLE_INT = PairDatatype("MPI_DOUBLE_INT", np.float64, np.int32)
+LONG_INT = PairDatatype("MPI_LONG_INT", np.int64, np.int32)
+TWOINT = PairDatatype("MPI_2INT", np.int32, np.int32)
+SHORT_INT = PairDatatype("MPI_SHORT_INT", np.int16, np.int32)
+
+_ALL = {
+    d.name: d
+    for d in list(globals().values())
+    if isinstance(d, Datatype)
+}
+
+
+def lookup(name: str) -> Datatype:
+    return _ALL[name]
+
+
+def from_np_dtype(dt) -> BasicDatatype:
+    """Map a numpy/jax dtype to the canonical predefined basic type."""
+    dt = np.dtype(dt)
+    if _bfloat16 is not None and dt == _bfloat16:
+        return BFLOAT16
+    table = {
+        np.dtype(np.uint8): UINT8_T,
+        np.dtype(np.int8): INT8_T,
+        np.dtype(np.int16): INT16_T,
+        np.dtype(np.uint16): UINT16_T,
+        np.dtype(np.int32): INT32_T,
+        np.dtype(np.uint32): UINT32_T,
+        np.dtype(np.int64): INT64_T,
+        np.dtype(np.uint64): UINT64_T,
+        np.dtype(np.float16): FLOAT16,
+        np.dtype(np.float32): FLOAT,
+        np.dtype(np.float64): DOUBLE,
+        np.dtype(np.bool_): C_BOOL,
+        np.dtype(np.complex64): C_FLOAT_COMPLEX,
+        np.dtype(np.complex128): C_DOUBLE_COMPLEX,
+    }
+    if dt not in table:
+        raise KeyError(f"no predefined datatype for numpy dtype {dt}")
+    return table[dt]

@@ -1,0 +1,5 @@
+"""MCA-equivalent substrate: variables, output streams, components."""
+
+from . import component, output, var
+
+__all__ = ["var", "output", "component"]
