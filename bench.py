@@ -1,0 +1,144 @@
+"""Headline benchmark: flagship train-step throughput through the framework
+vs the identical step written in plain JAX (no framework layer).
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+vs_baseline semantics: the reference publishes no numbers (BASELINE.md), so
+the baseline is the strongest available stand-in — the same training step
+with every framework collective replaced by a raw lax.psum.  A value >= 1.0
+means the MPI-model layer (communicators, comm_select dispatch, tuned
+decisions, f/g AD wrappers) costs nothing over hand-written JAX; that is the
+claim being benchmarked.  On multi-device hosts the collectives are real; on
+one chip they lower to no-ops but the full dispatch path still runs.
+"""
+
+import json
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    import zhpe_ompi_tpu as zmpi
+    from zhpe_ompi_tpu.models import transformer as tfm
+
+    devs = jax.devices()
+    n = len(devs)
+    tp = 2 if n % 2 == 0 else 1
+    dp = n // tp
+    mesh = Mesh(np.asarray(devs[: dp * tp]).reshape(dp, tp), ("dp", "tp"))
+    dp_comm = zmpi.Communicator(mesh, "dp", name="bench_dp")
+    tp_comm = zmpi.Communicator(mesh, "tp", name="bench_tp") if tp > 1 else None
+
+    on_tpu = devs[0].platform not in ("cpu",)
+    if on_tpu:
+        cfg = tfm.Config(
+            vocab=8192, d_model=1024, n_heads=16, d_ff=4096, n_layers=4,
+            seq=512, dtype=jnp.bfloat16,
+        )
+        batch = 8 * dp
+        iters = 20
+    else:
+        cfg = tfm.Config(
+            vocab=256, d_model=128, n_heads=8, d_ff=512, n_layers=2,
+            seq=128, dtype=jnp.float32,
+        )
+        batch = 2 * dp
+        iters = 5
+
+    r = np.random.default_rng(0)
+    params = tfm.init_params(cfg, jax.random.PRNGKey(0))
+    tokens = jnp.asarray(r.integers(0, cfg.vocab, (batch, cfg.seq)))
+    targets = jnp.asarray(r.integers(0, cfg.vocab, (batch, cfg.seq)))
+
+    def bench_step(step, specs):
+        sharded = {
+            k: jax.device_put(v, NamedSharding(mesh, specs[k]))
+            for k, v in params.items()
+        }
+        dspec = NamedSharding(mesh, P("dp"))
+        tok = jax.device_put(tokens, dspec)
+        tgt = jax.device_put(targets, dspec)
+        ps, loss = step(sharded, tok, tgt)  # compile
+        for _ in range(3):  # warm caches/threads
+            ps, loss = step(ps, tok, tgt)
+        jax.block_until_ready(loss)
+        best = float("inf")
+        for _ in range(3):  # best-of-3 timing windows
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                ps, loss = step(ps, tok, tgt)
+            jax.block_until_ready(loss)
+            best = min(best, (time.perf_counter() - t0) / iters)
+        return batch * cfg.seq / best  # tokens/sec
+
+    # framework path
+    step_fw, specs = tfm.make_train_step(cfg, mesh, dp_comm, tp_comm)
+    fw_tps = bench_step(step_fw, specs)
+
+    # plain-JAX baseline: identical math, raw lax.psum collectives
+    from jax import lax
+
+    def make_plain_step():
+        from zhpe_ompi_tpu.parallel import grad as gradmod
+
+        class RawComm:
+            def __init__(self, axis):
+                self.axis = axis
+
+            def allreduce(self, x, op):
+                return lax.psum(x, self.axis)
+
+        raw_tp = RawComm("tp") if tp > 1 else None
+        raw_dp = RawComm("dp")
+
+        dp_sz = dp
+        tp_sz = tp
+        param_specs = specs
+
+        def spmd_step(p, tok, tgt):
+            def local_loss(pp):
+                return tfm.loss_fn(pp, tok, tgt, cfg, raw_tp)
+
+            loss, grads = jax.value_and_grad(local_loss)(p)
+            synced = {}
+            replicated = {"embed", "lnf", "ln1", "ln2"}
+            for name, g in grads.items():
+                g = lax.psum(g, "dp") / dp_sz
+                if name in replicated and raw_tp is not None:
+                    g = lax.psum(g, "tp") / tp_sz
+                synced[name] = g
+            loss = lax.psum(loss, "dp") / dp_sz
+            if raw_tp is not None:
+                loss = lax.psum(loss, "tp") / tp_sz
+            new_p = jax.tree.map(
+                lambda a, g: (a - 1e-2 * g).astype(a.dtype), p, synced
+            )
+            return new_p, loss
+
+        return jax.jit(
+            jax.shard_map(
+                spmd_step, mesh=mesh,
+                in_specs=(param_specs, P("dp"), P("dp")),
+                out_specs=(param_specs, P()),
+                check_vma=False,
+            )
+        )
+
+    plain_tps = bench_step(make_plain_step(), specs)
+
+    print(json.dumps({
+        "metric": "train_step_throughput",
+        "value": round(fw_tps, 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(fw_tps / plain_tps, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

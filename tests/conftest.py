@@ -15,6 +15,14 @@ if "xla_force_host_platform_device_count" not in flags:
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
+# Pin the platform at the jax-config level too: the environment may have a
+# TPU plugin (axon) force-registered via sitecustomize, and letting backends()
+# initialize it would reach for real hardware (and hang if the tunnel is
+# down).  Tests are CPU-loopback by design.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
 import pytest  # noqa: E402
 
 

@@ -1,0 +1,306 @@
+"""Collective correctness tests on the 8-device CPU loopback mesh.
+
+Every algorithm is compared against a numpy reference — the analog of the
+reference's external MPI correctness suites run over btl/self+sm
+(SURVEY.md §4).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import zhpe_ompi_tpu as zmpi
+from zhpe_ompi_tpu.coll import algorithms as alg
+from zhpe_ompi_tpu.coll import tpu as xla_mod
+
+N = 8
+
+
+@pytest.fixture(scope="module")
+def world():
+    return zmpi.init()
+
+
+def run_spmd(comm, fn, x_global, out_specs=None):
+    """Shard x_global along dim0 over the comm axis and run fn per-device."""
+    from jax.sharding import PartitionSpec as P
+
+    xs = comm.device_put_sharded(jnp.asarray(x_global))
+    return np.asarray(comm.run(fn, xs, out_specs=out_specs))
+
+
+def rng(seed=0):
+    return np.random.default_rng(seed)
+
+
+ALLREDUCE_ALGS = [
+    alg.allreduce_recursive_doubling,
+    alg.allreduce_ring,
+    alg.allreduce_rabenseifner,
+    alg.allreduce_linear,
+    xla_mod.allreduce,
+]
+
+
+class TestAllreduce:
+    @pytest.mark.parametrize("algo", ALLREDUCE_ALGS,
+                             ids=lambda f: f.__name__)
+    def test_sum(self, world, algo):
+        x = rng(1).normal(size=(N, 5)).astype(np.float32)
+        out = run_spmd(world, lambda s: algo(world, s, zmpi.SUM), x)
+        expect = np.tile(x.sum(axis=0), (N, 1)).reshape(out.shape)
+        np.testing.assert_allclose(out, expect, rtol=1e-5)
+
+    @pytest.mark.parametrize("algo", ALLREDUCE_ALGS,
+                             ids=lambda f: f.__name__)
+    def test_max(self, world, algo):
+        x = rng(2).normal(size=(N, 7)).astype(np.float32)
+        out = run_spmd(world, lambda s: algo(world, s, zmpi.MAX), x)
+        expect = np.tile(x.max(axis=0), (N, 1)).reshape(out.shape)
+        np.testing.assert_allclose(out, expect)
+
+    def test_prod_xla_fallback(self, world):
+        x = (rng(3).normal(size=(N, 4)) * 0.5 + 1).astype(np.float32)
+        out = run_spmd(world, lambda s: xla_mod.allreduce(world, s, zmpi.PROD), x)
+        expect = np.tile(np.prod(x, axis=0), (N, 1)).reshape(out.shape)
+        np.testing.assert_allclose(out, expect, rtol=1e-5)
+
+    def test_band(self, world):
+        x = rng(4).integers(0, 255, size=(N, 6)).astype(np.int32)
+        out = run_spmd(
+            world, lambda s: alg.allreduce_recursive_doubling(world, s, zmpi.BAND), x
+        )
+        expect = np.tile(np.bitwise_and.reduce(x, axis=0), (N, 1))
+        np.testing.assert_array_equal(out, expect.reshape(out.shape))
+
+    def test_nonuniform_split_xla(self, world):
+        """Non-uniform (5+3) splits ride XLA index groups; the algorithmic
+        path refuses them with a clear error."""
+        sub = world.split([0] * 5 + [1] * 3)
+        x = rng(5).normal(size=(N, 3)).astype(np.float32)
+        out = run_spmd(sub, lambda s: xla_mod.allreduce(sub, s, zmpi.SUM), x)
+        expect = np.empty_like(x)
+        expect[:5] = x[:5].sum(axis=0)
+        expect[5:] = x[5:].sum(axis=0)
+        np.testing.assert_allclose(out.reshape(N, 3), expect, rtol=1e-5)
+        with pytest.raises(zmpi.errors.CommError):
+            run_spmd(
+                sub,
+                lambda s: alg.allreduce_recursive_doubling(sub, s, zmpi.SUM),
+                x,
+            )
+
+    def test_odd_size_recursive_doubling(self, world):
+        """Non-power-of-two UNIFORM size (the pow2-adjust path): 2 groups of
+        4 would be pow2, so use a world split into one group via incl of 8 -
+        instead exercise n=8 vs a 2x(n=4)... the true odd case needs a
+        non-pow2 uniform group: split 8 ranks into [0..5] is non-uniform, so
+        build a 6-device sub-mesh world instead."""
+        import zhpe_ompi_tpu.parallel.mesh as mesh_mod
+        import jax
+
+        devs = jax.devices()[:6]
+        m = mesh_mod.world_mesh(axis_name="w6", devices=devs)
+        comm = zmpi.Communicator(m, "w6", name="w6comm")
+        x = rng(5).normal(size=(6, 3)).astype(np.float32)
+        out = np.asarray(
+            comm.run(
+                lambda s: alg.allreduce_recursive_doubling(comm, s, zmpi.SUM),
+                comm.device_put_sharded(jnp.asarray(x)),
+            )
+        )
+        np.testing.assert_allclose(
+            out.reshape(6, 3), np.tile(x.sum(axis=0), (6, 1)), rtol=1e-5
+        )
+
+    def test_bf16(self, world):
+        x = rng(6).normal(size=(N, 8)).astype("bfloat16")
+        out = run_spmd(world, lambda s: xla_mod.allreduce(world, s, zmpi.SUM), x)
+        expect = np.tile(
+            x.astype(np.float32).sum(axis=0), (N, 1)
+        ).reshape(out.shape)
+        np.testing.assert_allclose(out.astype(np.float32), expect, rtol=0.05)
+
+    def test_maxloc_pairs(self, world):
+        vals = rng(7).normal(size=(N, 4)).astype(np.float32)
+        idxs = np.tile(np.arange(N, dtype=np.int32)[:, None], (1, 4))
+
+        def body(v, i):
+            r, loc = alg.allreduce_recursive_doubling(
+                world, (v, i), zmpi.MAXLOC
+            )
+            return r, loc
+
+        from jax.sharding import PartitionSpec as P
+
+        v = world.device_put_sharded(jnp.asarray(vals))
+        i = world.device_put_sharded(jnp.asarray(idxs))
+        rv, ri = world.run(body, v, i, in_specs=(P("world"), P("world")),
+                           out_specs=(P("world"), P("world")))
+        expect_v = vals.max(axis=0)
+        expect_i = vals.argmax(axis=0)
+        np.testing.assert_allclose(np.asarray(rv).reshape(N, 4)[0], expect_v)
+        np.testing.assert_array_equal(np.asarray(ri).reshape(N, 4)[0], expect_i)
+
+
+class TestBcast:
+    @pytest.mark.parametrize("algo,root", [
+        (alg.bcast_binomial, 0),
+        (alg.bcast_binomial, 3),
+        (alg.bcast_chain, 0),
+        (alg.bcast_chain, 5),
+        (alg.bcast_scatter_allgather, 0),
+        (alg.bcast_scatter_allgather, 2),
+        (xla_mod.bcast, 0),
+        (xla_mod.bcast, 6),
+    ], ids=lambda p: getattr(p, "__name__", str(p)))
+    def test_bcast(self, world, algo, root):
+        x = rng(8).normal(size=(N, 9)).astype(np.float32)
+        out = run_spmd(world, lambda s: algo(world, s, root), x)
+        expect = np.tile(x[root], (N, 1)).reshape(out.shape)
+        np.testing.assert_allclose(out, expect)
+
+
+class TestReduce:
+    @pytest.mark.parametrize("root", [0, 4])
+    def test_binomial(self, world, root):
+        x = rng(9).normal(size=(N, 5)).astype(np.float32)
+        out = run_spmd(
+            world, lambda s: alg.reduce_binomial(world, s, zmpi.SUM, root), x
+        ).reshape(N, 5)
+        np.testing.assert_allclose(out[root], x.sum(axis=0), rtol=1e-5)
+
+
+class TestAllgather:
+    @pytest.mark.parametrize("algo", [
+        alg.allgather_ring, alg.allgather_bruck,
+        alg.allgather_recursive_doubling, xla_mod.allgather,
+    ], ids=lambda f: f.__name__)
+    def test_allgather(self, world, algo):
+        x = rng(10).normal(size=(N, 2)).astype(np.float32)
+        from jax.sharding import PartitionSpec as P
+
+        out = run_spmd(world, lambda s: algo(world, s), x,
+                       out_specs=P("world"))
+        # each device outputs the full (N*2,) concatenation; sharded output
+        # over N devices gives (N * N * 2 / N,)... collect one device's view
+        out = out.reshape(N, -1)[0] if out.size == N * N * 2 else out
+        np.testing.assert_allclose(out.reshape(-1), x.reshape(-1))
+
+
+class TestAlltoall:
+    @pytest.mark.parametrize("algo", [
+        alg.alltoall_pairwise, alg.alltoall_bruck, xla_mod.alltoall,
+    ], ids=lambda f: f.__name__)
+    def test_alltoall(self, world, algo):
+        # global matrix: row i holds blocks destined to each rank
+        m = 3
+        x = np.arange(N * N * m, dtype=np.float32).reshape(N, N * m)
+        out = run_spmd(world, lambda s: algo(world, s.reshape(N * m)), x)
+        out = out.reshape(N, N, m)
+        blocks = x.reshape(N, N, m)
+        expect = np.swapaxes(blocks, 0, 1)  # transpose of blocks
+        np.testing.assert_allclose(out, expect)
+
+
+class TestReduceScatter:
+    @pytest.mark.parametrize("algo", [
+        alg.reduce_scatter_ring, alg.reduce_scatter_recursive_halving,
+        xla_mod.reduce_scatter,
+    ], ids=lambda f: f.__name__)
+    def test_sum(self, world, algo):
+        m = 2
+        x = rng(11).normal(size=(N, N * m)).astype(np.float32)
+        out = run_spmd(
+            world, lambda s: algo(world, s.reshape(N * m), zmpi.SUM), x
+        )
+        total = x.sum(axis=0).reshape(N, m)
+        np.testing.assert_allclose(out.reshape(N, m), total, rtol=1e-5)
+
+
+class TestScanBarrier:
+    def test_scan(self, world):
+        x = rng(12).normal(size=(N, 4)).astype(np.float32)
+        out = run_spmd(
+            world, lambda s: alg.scan_recursive_doubling(world, s, zmpi.SUM), x
+        ).reshape(N, 4)
+        np.testing.assert_allclose(out, np.cumsum(x, axis=0), rtol=1e-4)
+
+    def test_exscan(self, world):
+        x = rng(13).normal(size=(N, 4)).astype(np.float32)
+        out = run_spmd(
+            world, lambda s: alg.exscan_recursive_doubling(world, s, zmpi.SUM), x
+        ).reshape(N, 4)
+        expect = np.vstack([np.zeros((1, 4), np.float32),
+                            np.cumsum(x, axis=0)[:-1]])
+        np.testing.assert_allclose(out, expect, rtol=1e-4)
+
+    def test_exscan_prod(self, world):
+        """Regression: exscan must be correct for non-SUM ops (the zero-fill
+        of a shifted *input* is only an identity for SUM)."""
+        x = np.arange(1, N + 1, dtype=np.float32).reshape(N, 1)
+        out = run_spmd(
+            world,
+            lambda s: alg.exscan_recursive_doubling(world, s, zmpi.PROD), x,
+        ).reshape(N)
+        expect = np.concatenate([[0], np.cumprod(x.reshape(N))[:-1]])
+        np.testing.assert_allclose(out[1:], expect[1:])  # rank 0 undefined
+
+    def test_exscan_max_negative(self, world):
+        x = (-np.arange(1, N + 1, dtype=np.float32)).reshape(N, 1)
+        out = run_spmd(
+            world,
+            lambda s: alg.exscan_recursive_doubling(world, s, zmpi.MAX), x,
+        ).reshape(N)
+        expect = np.maximum.accumulate(x.reshape(N))[:-1]
+        np.testing.assert_allclose(out[1:], expect)
+
+    def test_barrier(self, world):
+        out = run_spmd(world, lambda s: alg.barrier_dissemination(world) + 0 * s[0],
+                       np.zeros((N, 1), np.float32))
+        assert np.all(out == 0)
+
+
+class TestScatter:
+    @pytest.mark.parametrize("root", [0, 3])
+    def test_scatter_linear(self, world, root):
+        x = np.arange(N * 2, dtype=np.float32)
+        xs = np.tile(x, (N, 1))  # every rank holds the (root's) buffer
+        out = run_spmd(world, lambda s: alg.scatter_linear(world, s, root), xs)
+        np.testing.assert_allclose(out.reshape(N, 2), x.reshape(N, 2))
+
+
+class TestAllgatherv:
+    def test_allgatherv(self, world):
+        counts = [1, 2, 1, 3, 1, 2, 1, 1]
+        mx = max(counts)
+        data = rng(14).normal(size=(N, mx)).astype(np.float32)
+        out = run_spmd(
+            world,
+            lambda s: alg.allgatherv_concat(world, s.reshape(mx), counts),
+            data,
+        )
+        expect = np.concatenate([data[i, : counts[i]] for i in range(N)])
+        np.testing.assert_allclose(out.reshape(N, -1)[0], expect)
+
+
+class TestSplitComms:
+    def test_split_allreduce_xla(self, world):
+        sub = world.split([i % 2 for i in range(N)])  # even/odd groups
+        x = rng(15).normal(size=(N, 3)).astype(np.float32)
+        out = run_spmd(sub, lambda s: xla_mod.allreduce(sub, s, zmpi.SUM), x)
+        expect = np.empty_like(x)
+        expect[::2] = x[::2].sum(axis=0)
+        expect[1::2] = x[1::2].sum(axis=0)
+        np.testing.assert_allclose(out.reshape(N, 3), expect, rtol=1e-5)
+
+    def test_split_ring(self, world):
+        sub = world.split([0, 0, 0, 0, 1, 1, 1, 1])
+        x = rng(16).normal(size=(N, 8)).astype(np.float32)
+        out = run_spmd(sub, lambda s: alg.allreduce_ring(sub, s, zmpi.SUM), x)
+        expect = np.empty_like(x)
+        expect[:4] = x[:4].sum(axis=0)
+        expect[4:] = x[4:].sum(axis=0)
+        np.testing.assert_allclose(out.reshape(N, 8), expect, rtol=1e-5)

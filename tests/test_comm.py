@@ -1,0 +1,201 @@
+"""Group calculus and communicator tests (ompi/group + ompi/communicator)."""
+
+import numpy as np
+import pytest
+
+import zhpe_ompi_tpu as zmpi
+from zhpe_ompi_tpu.comm import group as G
+from zhpe_ompi_tpu.core import errors
+
+
+class TestGroup:
+    def test_basic(self):
+        g = zmpi.Group([3, 1, 5])
+        assert g.size == 3
+        assert g.global_of_rank(0) == 3
+        assert g.rank_of_global(5) == 2
+        assert g.rank_of_global(9) == G.UNDEFINED
+
+    def test_incl_excl(self):
+        g = zmpi.Group(range(8))
+        assert g.incl([1, 3]).ranks == (1, 3)
+        assert g.excl([0, 7]).ranks == tuple(range(1, 7))
+
+    def test_range_incl(self):
+        g = zmpi.Group(range(10))
+        assert g.range_incl([(0, 6, 2)]).ranks == (0, 2, 4, 6)
+        assert g.range_incl([(8, 4, -2)]).ranks == (8, 6, 4)
+
+    def test_set_ops(self):
+        a = zmpi.Group([0, 1, 2, 3])
+        b = zmpi.Group([2, 3, 4, 5])
+        assert a.union(b).ranks == (0, 1, 2, 3, 4, 5)
+        assert a.intersection(b).ranks == (2, 3)
+        assert a.difference(b).ranks == (0, 1)
+
+    def test_translate(self):
+        a = zmpi.Group([0, 1, 2, 3])
+        b = zmpi.Group([3, 2, 1, 0])
+        assert a.translate_ranks([0, 3], b) == [3, 0]
+
+    def test_compare(self):
+        a = zmpi.Group([0, 1])
+        assert a.compare(zmpi.Group([0, 1])) == G.IDENT
+        assert a.compare(zmpi.Group([1, 0])) == G.SIMILAR
+        assert a.compare(zmpi.Group([1, 2])) == G.UNEQUAL
+
+    def test_duplicate_rejected(self):
+        with pytest.raises(errors.GroupError):
+            zmpi.Group([1, 1])
+
+
+class TestCommunicator:
+    @pytest.fixture(scope="class")
+    def world(self):
+        return zmpi.init()
+
+    def test_world_shape(self, world):
+        assert world.size == 8
+        assert not world.is_partitioned
+        assert world.index_groups is None
+
+    def test_dup_gets_new_cid(self, world):
+        d = world.dup()
+        assert d.cid != world.cid
+        assert d.partition[0] == world.partition[0]
+
+    def test_split_groups(self, world):
+        sub = world.split([0, 0, 1, 1, 0, 0, 1, 1])
+        assert sub.is_partitioned
+        assert [g.ranks for g in sub.partition] == [
+            (0, 1, 4, 5), (2, 3, 6, 7)
+        ]
+        assert sub.uniform_size == 4
+
+    def test_split_with_keys_reorders(self, world):
+        sub = world.split([0] * 8, keys=[7, 6, 5, 4, 3, 2, 1, 0])
+        assert sub.partition[0].ranks == (7, 6, 5, 4, 3, 2, 1, 0)
+
+    def test_partition_must_cover(self, world):
+        with pytest.raises(errors.CommError):
+            zmpi.Communicator(
+                world.mesh, world.axis,
+                partition=[zmpi.Group([0, 1])],
+            )
+
+    def test_comm_self(self, world):
+        cs = zmpi.comm_self()
+        assert len(cs.partition) == 8
+        assert cs.uniform_size == 1
+
+    def test_rank_traced(self, world):
+        import jax.numpy as jnp
+
+        sub = world.split([0, 1, 0, 1, 0, 1, 0, 1])
+        out = np.asarray(
+            sub.run(
+                lambda x: x * 0 + sub.rank(),
+                sub.device_put_sharded(jnp.zeros((8, 1), jnp.int32)),
+            )
+        ).reshape(-1)
+        # axis idx 0,2,4,6 -> group 0 ranks 0..3; idx 1,3,5,7 -> group 1
+        np.testing.assert_array_equal(out, [0, 0, 1, 1, 2, 2, 3, 3])
+
+    def test_create_from_group(self, world):
+        sub = world.create_from_group(zmpi.Group([6, 7]))
+        assert sub.partition[0].ranks == (6, 7)
+        assert sub.partition[1].ranks == tuple(range(6))
+
+
+class TestCommCollDispatch:
+    """Per-communicator composed table + component selection semantics."""
+
+    @pytest.fixture(scope="class")
+    def world(self):
+        return zmpi.init()
+
+    def test_default_composition_is_tuned(self, world, fresh_vars):
+        table = world.dup().coll
+        assert table["allreduce"][1] == "tuned"
+        assert table["barrier"][1] == "tuned"
+
+    def test_exclude_tuned_falls_to_tpu(self, world):
+        zmpi.mca_var.set_var("coll", "^tuned")
+        try:
+            table = world.dup().coll
+            assert table["allreduce"][1] == "tpu"
+        finally:
+            zmpi.mca_var.unset("coll")
+
+    def test_only_basic(self, world):
+        zmpi.mca_var.set_var("coll", "basic")
+        try:
+            table = world.dup().coll
+            assert all(v[1] == "basic" for v in table.values())
+        finally:
+            zmpi.mca_var.unset("coll")
+
+    def test_nonuniform_comm_partial_table(self, world):
+        sub = world.split([0] * 5 + [1] * 3)
+        table = sub.coll
+        # tuned/basic decline; tpu provides the index-group ops only
+        assert table["allreduce"][1] == "tpu"
+        assert "scatter" not in table
+
+    def test_api_dispatch_end_to_end(self, world):
+        import jax.numpy as jnp
+
+        x = np.arange(16, dtype=np.float32).reshape(8, 2)
+        out = np.asarray(
+            world.run(
+                lambda s: world.allreduce(s, zmpi.SUM),
+                world.device_put_sharded(jnp.asarray(x)),
+            )
+        )
+        np.testing.assert_allclose(
+            out.reshape(8, 2), np.tile(x.sum(0), (8, 1))
+        )
+
+    def test_forced_algorithm_var(self, world):
+        import jax.numpy as jnp
+
+        zmpi.mca_var.set_var("coll_tuned_allreduce_algorithm", "ring")
+        try:
+            comm = world.dup()
+            x = np.arange(24, dtype=np.float32).reshape(8, 3)
+            out = np.asarray(
+                comm.run(
+                    lambda s: comm.allreduce(s, zmpi.SUM),
+                    comm.device_put_sharded(jnp.asarray(x)),
+                )
+            )
+            np.testing.assert_allclose(
+                out.reshape(8, 3), np.tile(x.sum(0), (8, 1)), rtol=1e-5
+            )
+        finally:
+            zmpi.mca_var.unset("coll_tuned_allreduce_algorithm")
+
+    def test_shift_and_permute(self, world):
+        import jax.numpy as jnp
+
+        x = np.arange(8, dtype=np.float32).reshape(8, 1)
+        xs = world.device_put_sharded(jnp.asarray(x))
+        out = np.asarray(
+            world.run(lambda s: world.shift(s, 1), xs)
+        ).reshape(8)
+        np.testing.assert_array_equal(out, np.roll(np.arange(8), 1))
+        # general permute: everyone sends to rank 0's... reversal pattern
+        out2 = np.asarray(
+            world.run(lambda s: world.permute(s, [7, 6, 5, 4, 3, 2, 1, 0]), xs)
+        ).reshape(8)
+        np.testing.assert_array_equal(out2, np.arange(8)[::-1])
+
+    def test_noncommutative_routes_to_linear(self, world):
+        from zhpe_ompi_tpu.coll import tuned
+
+        user = zmpi.create_op(lambda a, b: a - b, commute=False)
+        import jax.numpy as jnp
+
+        assert tuned.decide(
+            "allreduce", world, jnp.zeros((4,)), op=user
+        ) == "linear"

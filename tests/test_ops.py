@@ -1,0 +1,105 @@
+"""Reduction operator engine tests (ompi/op analog)."""
+
+import numpy as np
+import pytest
+
+import zhpe_ompi_tpu.datatype as dt
+import zhpe_ompi_tpu.ops as ops
+from zhpe_ompi_tpu.core import errors
+
+
+class TestPredefined:
+    def test_sum_host(self):
+        a = np.array([1, 2, 3], np.float32)
+        b = np.array([10, 20, 30], np.float32)
+        np.testing.assert_array_equal(ops.SUM(a, b), [11, 22, 33])
+
+    def test_all_numeric_ops_host(self):
+        a = np.array([5, 3], np.int32)
+        b = np.array([2, 8], np.int32)
+        assert list(ops.MAX(a, b)) == [5, 8]
+        assert list(ops.MIN(a, b)) == [2, 3]
+        assert list(ops.PROD(a, b)) == [10, 24]
+        assert list(ops.BAND(a, b)) == [0, 0]
+        assert list(ops.BOR(a, b)) == [7, 11]
+        assert list(ops.BXOR(a, b)) == [7, 11]
+
+    def test_logical_ops_host(self):
+        a = np.array([0, 2, 5], np.int32)
+        b = np.array([3, 0, 7], np.int32)
+        assert list(ops.LAND(a, b)) == [0, 0, 1]
+        assert list(ops.LOR(a, b)) == [1, 1, 1]
+        assert list(ops.LXOR(a, b)) == [1, 1, 0]
+
+    def test_device_combine(self):
+        import jax.numpy as jnp
+
+        a = jnp.array([1.0, 2.0])
+        b = jnp.array([3.0, 1.0])
+        np.testing.assert_array_equal(np.asarray(ops.MAX(a, b)), [3.0, 2.0])
+        np.testing.assert_array_equal(np.asarray(ops.SUM(a, b)), [4.0, 3.0])
+        r = ops.LAND(jnp.array([0, 2]), jnp.array([1, 1]))
+        np.testing.assert_array_equal(np.asarray(r), [0, 1])
+
+    def test_xla_hints(self):
+        assert ops.SUM.xla_collective == "psum"
+        assert ops.MAX.xla_collective == "pmax"
+        assert ops.PROD.xla_collective is None
+
+    def test_identity(self):
+        assert ops.SUM.identity_for(np.float32) == 0
+        assert ops.MAX.identity_for(np.float32) == -np.inf
+        assert ops.MAX.identity_for(np.int32) == np.iinfo(np.int32).min
+        assert ops.MIN.identity_for(np.int16) == np.iinfo(np.int16).max
+        assert ops.BAND.identity_for(np.uint8) == 255
+
+
+class TestMaxloc:
+    def test_host_maxloc(self):
+        a = np.array([(3.0, 5), (1.0, 2)], dtype=dt.FLOAT_INT.np_dtype)
+        b = np.array([(3.0, 1), (9.0, 7)], dtype=dt.FLOAT_INT.np_dtype)
+        r = ops.MAXLOC(a, b)
+        assert r["value"].tolist() == [3.0, 9.0]
+        assert r["index"].tolist() == [1, 7]  # tie at 3.0 -> lower index
+
+    def test_device_minloc(self):
+        import jax.numpy as jnp
+
+        a = (jnp.array([3.0, 1.0]), jnp.array([5, 2]))
+        b = (jnp.array([3.0, 9.0]), jnp.array([1, 7]))
+        v, i = ops.MINLOC(a, b)
+        assert np.asarray(v).tolist() == [3.0, 1.0]
+        assert np.asarray(i).tolist() == [1, 2]
+
+    def test_pair_type_required(self):
+        with pytest.raises(errors.OpError):
+            ops.MAXLOC.check_datatype(dt.FLOAT)
+        ops.MAXLOC.check_datatype(dt.FLOAT_INT)
+        with pytest.raises(errors.OpError):
+            ops.SUM.check_datatype(dt.FLOAT_INT)
+
+
+class TestTypeChecking:
+    def test_bitwise_rejects_float(self):
+        with pytest.raises(errors.OpError):
+            ops.BAND.check_datatype(dt.FLOAT)
+
+    def test_sum_accepts_bf16(self):
+        ops.SUM.check_datatype(dt.BFLOAT16)
+
+
+class TestUserOp:
+    def test_create_and_combine(self):
+        op = ops.create_op(lambda a, b: a * 2 + b, commute=False)
+        assert not op.commute
+        assert op.is_user_defined
+        r = ops.op_reduce(op, np.array([1, 2]), np.array([10, 20]))
+        assert list(r) == [12, 24]
+
+    def test_user_op_traceable(self):
+        import jax
+        import jax.numpy as jnp
+
+        op = ops.create_op(lambda a, b: jnp.maximum(a, b) + 1)
+        f = jax.jit(lambda a, b: op(a, b))
+        assert np.asarray(f(jnp.array([1.0]), jnp.array([5.0])))[0] == 6.0

@@ -1,0 +1,74 @@
+"""zhpe_ompi_tpu — a TPU-native framework with Open MPI's capabilities.
+
+Brand-new design (not a port) with the capabilities of the reference Open MPI
+5.0.0a1 fork: the MPI programming model (communicators, groups, datatypes,
+reduction ops, collectives, point-to-point, one-sided), an MCA-style
+component architecture with priority selection and a layered config system,
+a tuned-style collective decision layer, and the observability stack — built
+on jax/XLA/pjit: collectives are XLA collectives or static ppermute schedules
+over the ICI mesh, datatype pack/unpack happens in HBM, wire-up comes from
+jax.distributed.  See SURVEY.md for the reference blueprint.
+
+Quick start (8-virtual-device CPU loopback)::
+
+    import zhpe_ompi_tpu as zmpi
+    comm = zmpi.init()                       # MPI_COMM_WORLD
+    y = comm.run(lambda x: comm.allreduce(x, zmpi.SUM), x)
+"""
+
+from . import datatype, ops
+from .comm.communicator import Communicator
+from .comm.group import Group
+from .coll import algorithms as coll_algorithms
+from .core import errors
+from .datatype import (
+    BFLOAT16,
+    BYTE,
+    DOUBLE,
+    FLOAT,
+    FLOAT16,
+    FLOAT_INT,
+    INT32_T,
+    INT64_T,
+)
+from .mca import component as mca_component
+from .mca import output as mca_output
+from .mca import var as mca_var
+from .ops import (
+    BAND,
+    BOR,
+    BXOR,
+    LAND,
+    LOR,
+    LXOR,
+    MAX,
+    MAXLOC,
+    MIN,
+    MINLOC,
+    PROD,
+    SUM,
+    create_op,
+)
+from .parallel import mesh
+from .runtime import spc
+from .runtime.init import (
+    comm_self,
+    finalize,
+    init,
+    initialized,
+    is_finalized,
+    world,
+    world_mesh,
+)
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "init", "finalize", "initialized", "is_finalized", "world", "comm_self",
+    "world_mesh", "Communicator", "Group", "mesh", "datatype", "ops", "spc",
+    "errors", "mca_var", "mca_component", "mca_output", "coll_algorithms",
+    "SUM", "MAX", "MIN", "PROD", "LAND", "LOR", "LXOR", "BAND", "BOR",
+    "BXOR", "MAXLOC", "MINLOC", "create_op",
+    "FLOAT", "DOUBLE", "BFLOAT16", "FLOAT16", "BYTE", "INT32_T", "INT64_T",
+    "FLOAT_INT",
+]

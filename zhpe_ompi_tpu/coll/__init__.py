@@ -1,0 +1,4 @@
+"""Collective framework: components (tpu/tuned/basic) + algorithm library."""
+from . import algorithms, framework
+
+__all__ = ["algorithms", "framework"]

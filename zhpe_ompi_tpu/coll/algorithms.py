@@ -1,0 +1,643 @@
+"""Collective algorithm library — the heart of the framework.
+
+TPU-native re-design of ``ompi/mca/coll/base`` (SURVEY.md §2.4).  Where the
+reference implements each algorithm as a loop of blocking send/recv pairs
+driven by the progress engine (e.g. recursive doubling at
+``coll_base_allreduce.c:130``, ring at ``:341``, Rabenseifner at ``:970``;
+binomial bcast at ``coll_base_bcast.c:329``; pairwise alltoall at
+``coll_base_alltoall.c:132``; Bruck allgather at ``coll_base_allgather.c:85``),
+here every algorithm is a *static communication schedule* traced once under
+``jit``: rounds become ``lax.ppermute`` ops over the ICI mesh, per-rank
+divergence becomes ``jnp.where`` masks on the traced rank, and XLA overlaps /
+pipelines the rounds.  There is no matching, no fragmentation, no progress
+loop — the compiler owns scheduling.
+
+Conventions:
+
+- all functions take ``(comm, x, ...)`` and must be called inside
+  ``shard_map`` over the comm's mesh axis;
+- ``x`` may be a pytree for the mask-based algorithms (MINLOC/MAXLOC pairs are
+  (value, index) tuples); chunked algorithms (ring, Bruck, pairwise) require a
+  single dense array;
+- patterns are comm-relative and instantiated per sub-group by
+  :func:`zhpe_ompi_tpu.pt2pt.spmd.global_pairs` — one XLA op carries every
+  sub-communicator of a split;
+- mask-based algorithms require a uniform partition (same size per group);
+  the components route non-uniform comms to the XLA-native paths.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core import errors
+from ..pt2pt import spmd
+
+
+def _where(mask, a, b):
+    """Pytree-aware jnp.where with a scalar traced mask."""
+    return jax.tree.map(lambda u, v: jnp.where(mask, u, v), a, b)
+
+
+def _require_uniform(comm) -> int:
+    n = comm.uniform_size
+    if n is None:
+        raise errors.CommError(
+            "algorithmic collectives require a uniform partition; "
+            "use the xla component for non-uniform splits"
+        )
+    return n
+
+
+def _pow2_floor(n: int) -> int:
+    return 1 << (n.bit_length() - 1)
+
+
+# ---------------------------------------------------------------------------
+# Allreduce (cf. coll_base_allreduce.c)
+# ---------------------------------------------------------------------------
+
+
+def allreduce_recursive_doubling(comm, x, op):
+    """Recursive doubling (reference: coll_base_allreduce.c:130): log2(p)
+    exchange rounds; non-power-of-two handled by folding the tail into the
+    leading block first (the reference's pow2 adjust at :175-185)."""
+    n = _require_uniform(comm)
+    if n == 1:
+        return x
+    rank = comm.rank()
+    p2 = _pow2_floor(n)
+    extra = n - p2
+    if extra:
+        recv = spmd.ppermute(comm, x, [(p2 + i, i) for i in range(extra)])
+        x = _where(rank < extra, op(recv, x), x)
+    k = 1
+    while k < p2:
+        recv = spmd.ppermute(
+            comm, x, [(i, i ^ k) for i in range(p2)]
+        )
+        x = _where(rank < p2, op(recv, x), x)
+        k <<= 1
+    if extra:
+        recv = spmd.ppermute(comm, x, [(i, p2 + i) for i in range(extra)])
+        x = _where(rank >= p2, recv, x)
+    return x
+
+
+def _chunked(x, n):
+    """Pad-and-view a dense array as (n, chunk) plus restore info."""
+    flat = x.reshape(-1)
+    length = flat.shape[0]
+    chunk = -(-length // n)  # ceil
+    pad = n * chunk - length
+    if pad:
+        flat = jnp.pad(flat, (0, pad))
+    return flat.reshape(n, chunk), length
+
+
+def allreduce_ring(comm, x, op):
+    """Ring allreduce: reduce-scatter ring + allgather ring (reference:
+    coll_base_allreduce.c:341).  Bandwidth-optimal — 2(p-1)/p of the data
+    crosses each link; the shape XLA itself uses for large psums on ICI."""
+    n = _require_uniform(comm)
+    if n == 1:
+        return x
+    if not isinstance(x, jax.Array) and not hasattr(x, "shape"):
+        raise errors.ArgError("ring allreduce requires a dense array")
+    rank = comm.rank()
+    buf, length = _chunked(x, n)
+
+    def rs_round(k, b):
+        send_idx = (rank - k) % n
+        recv_idx = (rank - k - 1) % n
+        sent = spmd.ppermute(
+            comm, jnp.take(b, send_idx, axis=0),
+            lambda m: [(i, (i + 1) % m) for i in range(m)],
+        )
+        return b.at[recv_idx].set(op(sent, jnp.take(b, recv_idx, axis=0)))
+
+    buf = lax.fori_loop(0, n - 1, rs_round, buf)
+
+    def ag_round(k, b):
+        send_idx = (rank + 1 - k) % n
+        recv_idx = (rank - k) % n
+        sent = spmd.ppermute(
+            comm, jnp.take(b, send_idx, axis=0),
+            lambda m: [(i, (i + 1) % m) for i in range(m)],
+        )
+        return b.at[recv_idx].set(sent)
+
+    buf = lax.fori_loop(0, n - 1, ag_round, buf)
+    return buf.reshape(-1)[:length].reshape(x.shape)
+
+
+def allreduce_rabenseifner(comm, x, op):
+    """Rabenseifner: recursive-halving reduce-scatter + recursive-doubling
+    allgather (reference: coll_base_allreduce.c:970).  Power-of-two ranks;
+    falls back to ring otherwise — the same guard the reference's decision
+    logic applies."""
+    n = _require_uniform(comm)
+    if n & (n - 1):
+        return allreduce_ring(comm, x, op)
+    if n == 1:
+        return x
+    rank = comm.rank()
+    buf, length = _chunked(x, n)
+    chunk = buf.shape[1]
+
+    # reduce-scatter by recursive halving; rank ends owning chunk `rank`
+    lo = jnp.zeros((), jnp.int32)
+    bit = n >> 1
+    while bit:
+        pairs = [(i, i ^ bit) for i in range(n)]
+        on_upper = (rank & bit) != 0
+        send_lo = jnp.where(on_upper, lo, lo + bit)  # give away other half
+        keep_lo = jnp.where(on_upper, lo + bit, lo)
+        sent = spmd.ppermute(
+            comm, lax.dynamic_slice(buf, (send_lo, 0), (bit, chunk)), pairs
+        )
+        kept = lax.dynamic_slice(buf, (keep_lo, 0), (bit, chunk))
+        buf = lax.dynamic_update_slice(buf, op(sent, kept), (keep_lo, 0))
+        lo = keep_lo
+        bit >>= 1
+
+    # allgather by recursive doubling
+    w = 1
+    while w < n:
+        pairs = [(i, i ^ w) for i in range(n)]
+        my_lo = rank & ~(w - 1)
+        partner_lo = (rank ^ w) & ~(w - 1)
+        sent = spmd.ppermute(
+            comm, lax.dynamic_slice(buf, (my_lo, 0), (w, chunk)), pairs
+        )
+        buf = lax.dynamic_update_slice(buf, sent, (partner_lo, 0))
+        w <<= 1
+    return buf.reshape(-1)[:length].reshape(x.shape)
+
+
+def allreduce_linear(comm, x, op):
+    """Basic linear (reference: coll_base_allreduce.c:881): gather everything
+    everywhere, reduce locally in strict rank order — the only algorithm
+    whose reduction order matches MPI's canonical order for non-commutative
+    ops (rank 0's value ⊕ rank 1's ⊕ ...)."""
+    n = _require_uniform(comm)
+    if n == 1:
+        return x
+    # stack every rank's contribution: leaf shape (n, *leaf.shape)
+    gathered = jax.tree.map(
+        lambda a: allgather_ring(comm, jnp.asarray(a)[None]), x
+    )
+
+    def block(i):
+        return jax.tree.map(lambda g: jnp.take(g, i, axis=0), gathered)
+
+    acc = block(0)
+    for i in range(1, n):
+        acc = op(acc, block(i))
+    return jax.tree.map(
+        lambda o, xx: o.reshape(jnp.shape(xx)), acc, x
+    )
+
+
+# ---------------------------------------------------------------------------
+# Bcast (cf. coll_base_bcast.c)
+# ---------------------------------------------------------------------------
+
+
+def bcast_binomial(comm, x, root=0):
+    """Binomial tree (reference: coll_base_bcast.c:329): round k, virtual
+    ranks < 2^k forward to vrank+2^k."""
+    n = _require_uniform(comm)
+    if n == 1:
+        return x
+    rank = comm.rank()
+    vrank = (rank - root) % n
+    k = 1
+    while k < n:
+        pairs = []
+        for v in range(min(k, n - k)):
+            pairs.append((( v + root) % n, (v + k + root) % n))
+        recv = spmd.ppermute(comm, x, pairs)
+        x = _where((vrank >= k) & (vrank < 2 * k), recv, x)
+        k <<= 1
+    return x
+
+
+def bcast_chain(comm, x, root=0, segments: int = 4):
+    """Chain/pipeline bcast (reference: coll_base_bcast.c:273,301): the
+    message is cut into segments flowing down a rank chain; XLA overlaps the
+    segment ppermutes.  `segments` plays the role of the reference's segsize
+    MCA param."""
+    n = _require_uniform(comm)
+    if n == 1:
+        return x
+    rank = comm.rank()
+    vrank = (rank - root) % n
+    flat = x.reshape(-1)
+    length = flat.shape[0]
+    segments = max(1, min(segments, length))
+    seg = -(-length // segments)
+    pad = segments * seg - length
+    if pad:
+        flat = jnp.pad(flat, (0, pad))
+    segs = flat.reshape(segments, seg)
+
+    # chain pattern in vrank space: v -> v+1; segment s reaches chain
+    # position v at step v-1+s, so at step t position v adopts segment
+    # s = t - v + 1.  All rounds are static; XLA pipelines the hops.
+    pairs = [((v + root) % n, (v + 1 + root) % n) for v in range(n - 1)]
+    total_steps = (n - 1) + (segments - 1)
+
+    def step(t, sg):
+        sent = spmd.ppermute(comm, sg, pairs)
+        s_idx = t - vrank + 1
+        adopt = (vrank > 0) & (s_idx >= 0) & (s_idx < segments)
+        mask = (jnp.arange(segments) == s_idx) & adopt
+        return jnp.where(mask[:, None], sent, sg)
+
+    segs = lax.fori_loop(0, total_steps, step, segs)
+    return segs.reshape(-1)[:length].reshape(x.shape)
+
+
+def bcast_scatter_allgather(comm, x, root=0):
+    """Scatter + allgather bcast (reference: coll_base_bcast.c knomial/
+    scatter_allgather): binomial scatter of chunks then ring allgather —
+    bandwidth-optimal for large messages."""
+    n = _require_uniform(comm)
+    if n == 1:
+        return x
+    length = x.size
+    # scatter: keep only own chunk (root's data is authoritative)
+    own = scatter_linear(comm, x, root)
+    gathered = allgather_ring(comm, own)
+    return gathered.reshape(-1)[:length].reshape(x.shape)
+
+
+# ---------------------------------------------------------------------------
+# Reduce (cf. coll_base_reduce.c)
+# ---------------------------------------------------------------------------
+
+
+def reduce_binomial(comm, x, op, root=0):
+    """Binomial-tree reduce (reference: coll_base_reduce.c:471).  Result is
+    significant at root (SPMD: other ranks hold partials)."""
+    n = _require_uniform(comm)
+    if n == 1:
+        return x
+    rank = comm.rank()
+    vrank = (rank - root) % n
+    k = 1
+    while k < n:
+        pairs = []
+        for v in range(0, n - k, 2 * k):
+            pairs.append(((v + k + root) % n, (v + root) % n))
+        recv = spmd.ppermute(comm, x, pairs)
+        is_recv = (vrank % (2 * k) == 0) & (vrank + k < n)
+        x = _where(is_recv, op(recv, x), x)
+        k <<= 1
+    return x
+
+
+def reduce_linear(comm, x, op, root=0):
+    """Linear reduce preserving strict rank order for non-commutative ops."""
+    full = allreduce_linear(comm, x, op)
+    return full  # every rank computes the rank-ordered result
+
+
+# ---------------------------------------------------------------------------
+# Allgather (cf. coll_base_allgather.c)
+# ---------------------------------------------------------------------------
+
+
+def _stack_shape(x):
+    return x[None] if x.ndim == 0 else x
+
+
+def allgather_ring(comm, x):
+    """Ring allgather (reference: coll_base_allgather.c:358)."""
+    n = _require_uniform(comm)
+    x = _stack_shape(x)
+    if n == 1:
+        return x
+    rank = comm.rank()
+    buf = jnp.zeros((n,) + x.shape, x.dtype)
+    buf = lax.dynamic_update_slice(buf, x[None], (rank,) + (0,) * x.ndim)
+
+    def ag_round(k, b):
+        send_idx = (rank - k) % n
+        recv_idx = (rank - k - 1) % n
+        sent = spmd.ppermute(
+            comm, jnp.take(b, send_idx, axis=0),
+            lambda m: [(i, (i + 1) % m) for i in range(m)],
+        )
+        return b.at[recv_idx].set(sent)
+
+    buf = lax.fori_loop(0, n - 1, ag_round, buf)
+    return buf.reshape((n * x.shape[0],) + x.shape[1:])
+
+
+def allgather_bruck(comm, x):
+    """Bruck allgather (reference: coll_base_allgather.c:85): ceil(log2 p)
+    rounds of doubling block counts, then a rotation."""
+    n = _require_uniform(comm)
+    x = _stack_shape(x)
+    if n == 1:
+        return x
+    rank = comm.rank()
+    buf = jnp.zeros((n,) + x.shape, x.dtype)
+    buf = buf.at[0].set(x)
+    k = 1
+    while k < n:
+        cnt = min(k, n - k)
+        send = buf[:cnt]  # static slice
+        recv = spmd.ppermute(
+            comm, send, lambda m, k=k: [(i, (i - k) % m) for i in range(m)]
+        )
+        buf = lax.dynamic_update_slice(
+            buf, recv, (k,) + (0,) * (buf.ndim - 1)
+        )
+        k <<= 1
+    # buf[j] holds the block of comm rank (rank + j) % n; rotate to rank order
+    buf = jnp.roll(buf, shift=rank, axis=0)
+    return buf.reshape((n * x.shape[0],) + x.shape[1:])
+
+
+def allgather_recursive_doubling(comm, x):
+    """Recursive-doubling allgather (pow2; reference pattern of
+    coll_base_allgather.c). Falls back to Bruck otherwise."""
+    n = _require_uniform(comm)
+    if n & (n - 1):
+        return allgather_bruck(comm, x)
+    x = _stack_shape(x)
+    if n == 1:
+        return x
+    rank = comm.rank()
+    buf = jnp.zeros((n,) + x.shape, x.dtype)
+    buf = lax.dynamic_update_slice(buf, x[None], (rank,) + (0,) * x.ndim)
+    w = 1
+    while w < n:
+        pairs = [(i, i ^ w) for i in range(n)]
+        my_lo = rank & ~(w - 1)
+        partner_lo = (rank ^ w) & ~(w - 1)
+        sent = spmd.ppermute(
+            comm,
+            lax.dynamic_slice(
+                buf, (my_lo,) + (0,) * x.ndim, (w,) + x.shape
+            ),
+            pairs,
+        )
+        buf = lax.dynamic_update_slice(
+            buf, sent, (partner_lo,) + (0,) * x.ndim
+        )
+        w <<= 1
+    return buf.reshape((n * x.shape[0],) + x.shape[1:])
+
+
+# ---------------------------------------------------------------------------
+# Alltoall (cf. coll_base_alltoall.c)
+# ---------------------------------------------------------------------------
+
+
+def _atoall_blocks(comm, x):
+    n = _require_uniform(comm)
+    if x.shape[0] % n:
+        raise errors.CountError(
+            f"alltoall needs dim0 divisible by comm size {n}, got {x.shape[0]}"
+        )
+    m = x.shape[0] // n
+    return n, x.reshape((n, m) + x.shape[1:])
+
+
+def alltoall_pairwise(comm, x):
+    """Pairwise exchange (reference: coll_base_alltoall.c:132): p-1 rounds,
+    round r exchanges with rank±r."""
+    n, blocks = _atoall_blocks(comm, x)
+    if n == 1:
+        return x
+    rank = comm.rank()
+    out = jnp.zeros_like(blocks)
+    out = out.at[rank].set(jnp.take(blocks, rank, axis=0))
+
+    def round_r(r, o):
+        sendto = (rank + r) % n
+        recvfrom = (rank - r) % n
+        sent = spmd.ppermute(
+            comm, jnp.take(blocks, sendto, axis=0),
+            lambda m, r=r: [(i, (i + r) % m) for i in range(m)],
+        )
+        return o.at[recvfrom].set(sent)
+
+    # r is traced inside fori_loop but the ppermute pattern depends on it,
+    # so unroll the (static-count) rounds instead.
+    for r in range(1, n):
+        out = round_r(r, out)
+    return out.reshape(x.shape)
+
+
+def alltoall_bruck(comm, x):
+    """Bruck alltoall (reference: coll_base_alltoall.c:191): log2(p) rounds
+    moving blocks whose index has bit k set; saves latency for small
+    messages at the cost of local rotations."""
+    n, blocks = _atoall_blocks(comm, x)
+    if n == 1:
+        return x
+    rank = comm.rank()
+    # phase 1: local rotation so block j targets rank (rank + j) % n
+    blocks = jnp.roll(blocks, shift=-rank, axis=0)
+    # phase 2: for each bit k, send blocks with bit k set to rank + 2^k
+    k = 1
+    while k < n:
+        mask = (jnp.arange(n) & k) != 0
+        sent = spmd.ppermute(
+            comm, blocks, lambda m, k=k: [(i, (i + k) % m) for i in range(m)]
+        )
+        blocks = jnp.where(
+            mask.reshape((n,) + (1,) * (blocks.ndim - 1)), sent, blocks
+        )
+        k <<= 1
+    # phase 3: after phase 2, slot j at rank d holds data from source
+    # (d - j) mod n; restoring source order is a flip then rotate by rank+1
+    blocks = jnp.roll(jnp.flip(blocks, axis=0), shift=rank + 1, axis=0)
+    return blocks.reshape(x.shape)
+
+
+# ---------------------------------------------------------------------------
+# Reduce_scatter (cf. coll_base_reduce_scatter.c)
+# ---------------------------------------------------------------------------
+
+
+def reduce_scatter_ring(comm, x, op):
+    """Ring reduce-scatter (reference: coll_base_reduce_scatter.c:456)."""
+    n = _require_uniform(comm)
+    if n == 1:
+        return x
+    rank = comm.rank()
+    n_, blocks = _atoall_blocks(comm, x)
+
+    def rs_round(k, b):
+        send_idx = (rank - k) % n
+        recv_idx = (rank - k - 1) % n
+        sent = spmd.ppermute(
+            comm, jnp.take(b, send_idx, axis=0),
+            lambda m: [(i, (i + 1) % m) for i in range(m)],
+        )
+        return b.at[recv_idx].set(op(sent, jnp.take(b, recv_idx, axis=0)))
+
+    blocks = lax.fori_loop(0, n - 1, rs_round, blocks)
+    # rank owns chunk (rank+1)%n; shift it home so rank r holds chunk r
+    owned = jnp.take(blocks, (rank + 1) % n, axis=0)
+    return spmd.shift(comm, owned, 1, wrap=True)
+
+
+def reduce_scatter_recursive_halving(comm, x, op):
+    """Recursive halving (reference: coll_base_reduce_scatter.c:132); pow2
+    ranks, falls back to ring otherwise."""
+    n = _require_uniform(comm)
+    if n & (n - 1):
+        return reduce_scatter_ring(comm, x, op)
+    if n == 1:
+        return x
+    rank = comm.rank()
+    _, blocks = _atoall_blocks(comm, x)
+    shape_rest = blocks.shape[1:]
+    lo = jnp.zeros((), jnp.int32)
+    bit = n >> 1
+    while bit:
+        pairs = [(i, i ^ bit) for i in range(n)]
+        on_upper = (rank & bit) != 0
+        send_lo = jnp.where(on_upper, lo, lo + bit)
+        keep_lo = jnp.where(on_upper, lo + bit, lo)
+        sent = spmd.ppermute(
+            comm,
+            lax.dynamic_slice(
+                blocks, (send_lo,) + (0,) * len(shape_rest), (bit,) + shape_rest
+            ),
+            pairs,
+        )
+        kept = lax.dynamic_slice(
+            blocks, (keep_lo,) + (0,) * len(shape_rest), (bit,) + shape_rest
+        )
+        blocks = lax.dynamic_update_slice(
+            blocks, op(sent, kept), (keep_lo,) + (0,) * len(shape_rest)
+        )
+        lo = keep_lo
+        bit >>= 1
+    return jnp.take(blocks, rank, axis=0)
+
+
+# ---------------------------------------------------------------------------
+# Scan / Exscan (cf. coll_base_scan.c, coll_base_exscan.c)
+# ---------------------------------------------------------------------------
+
+
+def scan_recursive_doubling(comm, x, op):
+    """Inclusive prefix reduction, Hillis-Steele over ranks (reference:
+    coll_base_scan.c:157).  Order-preserving: safe for non-commutative
+    (associative) ops."""
+    n = _require_uniform(comm)
+    if n == 1:
+        return x
+    rank = comm.rank()
+    k = 1
+    while k < n:
+        recv = spmd.ppermute(
+            comm, x, [(i, i + k) for i in range(n - k)]
+        )
+        x = _where(rank >= k, op(recv, x), x)
+        k <<= 1
+    return x
+
+
+def exscan_recursive_doubling(comm, x, op):
+    """Exclusive scan (reference: coll_base_exscan.c:142): inclusive scan,
+    then shift the RESULTS up one rank — correct for every associative op
+    (shifting inputs instead would inject ppermute's zero-fill at rank 0
+    into every prefix, which is only an identity for SUM).  Rank 0's result
+    is undefined per MPI; here it holds zeros."""
+    _require_uniform(comm)
+    inclusive = scan_recursive_doubling(comm, x, op)
+    return spmd.shift(comm, inclusive, 1, wrap=False)
+
+
+# ---------------------------------------------------------------------------
+# Barrier (cf. coll_base_barrier.c)
+# ---------------------------------------------------------------------------
+
+
+def barrier_dissemination(comm, token=None):
+    """Bruck/dissemination barrier (reference: coll_base_barrier.c:253):
+    ceil(log2 p) rounds of shifted notifications.  Returns a data-dependent
+    zero scalar usable as a sequencing token."""
+    n = _require_uniform(comm)
+    t = jnp.zeros((), jnp.int32) if token is None else jnp.sum(token).astype(
+        jnp.int32
+    ) * 0
+    k = 1
+    while k < n:
+        t = t + spmd.ppermute(
+            comm, t, lambda m, k=k: [(i, (i + k) % m) for i in range(m)]
+        )
+        k <<= 1
+    return t
+
+
+# ---------------------------------------------------------------------------
+# Gather / Scatter (cf. coll_base_gather.c / coll_base_scatter.c)
+# ---------------------------------------------------------------------------
+
+
+def gather_ring(comm, x, root=0):
+    """Gather via allgather.  SPMD note (documented semantic): on a
+    single-program machine every device executes the same collective, so the
+    "only root receives" optimization of the reference's binomial gather
+    (coll_base_gather.c:41) buys nothing — the result is simply significant
+    at root."""
+    return allgather_ring(comm, x)
+
+
+def scatter_linear(comm, x, root=0):
+    """Linear scatter (reference: coll_base_scatter.c:63): root sends chunk i
+    to rank i, one static ppermute per destination; XLA overlaps them."""
+    n = _require_uniform(comm)
+    buf, length = _chunked(x, n)
+    chunk = buf.shape[1]
+    rank = comm.rank()
+    out = jnp.take(buf, rank, axis=0)  # root's own chunk (and garbage elsewhere)
+    for i in range(n):
+        if i == root:
+            continue
+        sent = spmd.ppermute(comm, buf[i], [(root, i)])
+        out = _where(rank == i, sent, out)
+    # non-root ranks' x may be garbage; out at rank i is root's chunk i
+    return out
+
+
+def bcast_via_scatter(comm, x, root=0):
+    return bcast_scatter_allgather(comm, x, root)
+
+
+# ---------------------------------------------------------------------------
+# Vector (v) variants
+# ---------------------------------------------------------------------------
+
+
+def allgatherv_concat(comm, x, counts: list[int]):
+    """Allgatherv with static per-rank counts (cf. coll_base_allgatherv.c):
+    pad to the max count, exchange, then statically re-concatenate.  `x` is
+    this device's contribution, whose dim0 may be any value up to
+    max(counts); entries beyond the device's count are ignored."""
+    n = _require_uniform(comm)
+    if len(counts) != n:
+        raise errors.ArgError(f"need {n} counts, got {len(counts)}")
+    mx = max(counts)
+    pad = mx - x.shape[0]
+    if pad:
+        x = jnp.pad(x, ((0, pad),) + ((0, 0),) * (x.ndim - 1))
+    gathered = allgather_ring(comm, x).reshape((n, mx) + x.shape[1:])
+    parts = [gathered[i, : counts[i]] for i in range(n)]
+    return jnp.concatenate(parts, axis=0)

@@ -1,0 +1,35 @@
+"""coll/basic — reference-semantics fallback component.
+
+Analog of ``ompi/mca/coll/basic`` (SURVEY.md §2.3): the simplest correct
+implementation of every operation, used when higher-priority components
+decline and as the semantic baseline tests compare against.  Linear/naive
+algorithms only; rank-order reductions (correct for non-commutative ops).
+"""
+
+from __future__ import annotations
+
+from . import algorithms as alg
+from .framework import CollComponent, CollModule
+
+
+class BasicCollComponent(CollComponent):
+    name = "basic"
+    default_priority = 10
+
+    def comm_query(self, comm) -> CollModule | None:
+        if comm.uniform_size is None:
+            return None
+        return CollModule(
+            allreduce=lambda comm, x, op: alg.allreduce_linear(comm, x, op),
+            reduce=alg.reduce_linear,
+            bcast=alg.bcast_binomial,
+            barrier=alg.barrier_dissemination,
+            allgather=alg.allgather_ring,
+            allgatherv=alg.allgatherv_concat,
+            alltoall=alg.alltoall_pairwise,
+            reduce_scatter=alg.reduce_scatter_ring,
+            scan=alg.scan_recursive_doubling,
+            exscan=alg.exscan_recursive_doubling,
+            gather=alg.gather_ring,
+            scatter=alg.scatter_linear,
+        )

@@ -1,0 +1,92 @@
+"""Coll framework: per-communicator, per-operation module composition.
+
+Re-design of ``ompi/mca/coll``'s selection machinery
+(``coll_base_comm_select.c:108-152``): every admitted component is queried for
+a per-communicator module; the communicator's collective table then takes each
+*operation* from the highest-priority module that provides it — so
+``--mca coll tpu,tuned`` composes per-op exactly as the reference does
+(module struct: ``ompi/mca/coll/coll.h:629-712``).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+from ..core import errors
+from ..mca import component as mca_component
+from ..mca import output as mca_output
+
+COLL_OPS = (
+    "allreduce",
+    "reduce",
+    "bcast",
+    "barrier",
+    "allgather",
+    "allgatherv",
+    "alltoall",
+    "reduce_scatter",
+    "scan",
+    "exscan",
+    "gather",
+    "scatter",
+)
+
+_stream = mca_output.open_stream("coll")
+
+
+class CollModule:
+    """Per-communicator module: attributes named after COLL_OPS entries hold
+    callables ``fn(comm, ...)`` or None (op not provided)."""
+
+    def __init__(self, **ops: Callable):
+        for name in COLL_OPS:
+            setattr(self, name, ops.get(name))
+
+
+class CollComponent(mca_component.Component):
+    framework_name = "coll"
+
+    def comm_query(self, comm) -> CollModule | None:
+        """Return a module for this communicator, or None to decline
+        (cf. component comm_query in coll_base_comm_select.c)."""
+        raise NotImplementedError
+
+
+def coll_framework() -> mca_component.Framework:
+    fw = mca_component.framework("coll", "collective operations")
+    # late import to avoid cycles; registration is idempotent
+    from .basic import BasicCollComponent
+    from .tpu import TpuCollComponent
+    from .tuned import TunedCollComponent
+
+    fw.register(TpuCollComponent())
+    fw.register(TunedCollComponent())
+    fw.register(BasicCollComponent())
+    fw.open()
+    return fw
+
+
+def comm_select(comm) -> dict[str, tuple[Callable, str]]:
+    """Compose the per-op table for a communicator."""
+    fw = coll_framework()
+    queried = []
+    for comp in fw.admitted():  # descending priority
+        mod = comp.comm_query(comm)
+        if mod is not None:
+            queried.append((comp, mod))
+            mca_output.verbose(
+                5, _stream, "comm %s: component %s available", comm.name,
+                comp.name,
+            )
+    if not queried:
+        raise errors.InternalError(
+            f"no coll component available for {comm.name}"
+        )
+    table: dict[str, tuple[Callable, str]] = {}
+    for opname in COLL_OPS:
+        for comp, mod in queried:
+            fn = getattr(mod, opname, None)
+            if fn is not None:
+                table[opname] = (fn, comp.name)
+                break
+    return table

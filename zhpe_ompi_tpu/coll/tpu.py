@@ -1,0 +1,183 @@
+"""coll/tpu — XLA-native collectives over the ICI mesh.
+
+The inversion of the reference's ``coll/cuda`` (SURVEY.md §2.4): where
+``coll_cuda_allreduce.c:30-69`` stages device buffers to the host and
+delegates to a CPU component, this component keeps data in HBM and lowers
+every operation to the XLA collective the TPU executes natively on ICI —
+``psum``/``pmax``/``pmin``, ``all_gather``, ``all_to_all``, ``psum_scatter``,
+with ``axis_index_groups`` carrying split sub-communicators in one op.
+
+Ops without a native XLA reduction (PROD, bitwise, MINLOC/MAXLOC, user ops)
+fall back to the algorithmic layer's recursive doubling — the same shape the
+reference uses when hardware collectives don't cover an op.  Logical ops are
+re-expressed arithmetically (LAND = pmin(x≠0), LOR = pmax(x≠0),
+LXOR = psum(x≠0) mod 2) so they still ride a single native collective.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .. import ops as _ops
+from . import algorithms as alg
+from .framework import CollComponent, CollModule
+
+
+def _groups(comm):
+    return comm.index_groups
+
+
+def _psum(comm, x):
+    return lax.psum(x, comm.axis, axis_index_groups=_groups(comm))
+
+
+def _pmax(comm, x):
+    return lax.pmax(x, comm.axis, axis_index_groups=_groups(comm))
+
+
+def _pmin(comm, x):
+    return lax.pmin(x, comm.axis, axis_index_groups=_groups(comm))
+
+
+def allreduce(comm, x, op):
+    name = op.name
+    if name == "MPI_SUM":
+        return _psum(comm, x)
+    if name == "MPI_MAX":
+        return _pmax(comm, x)
+    if name == "MPI_MIN":
+        return _pmin(comm, x)
+    if name == "MPI_LAND":
+        return _pmin(comm, (x != 0).astype(jnp.int32)).astype(x.dtype)
+    if name == "MPI_LOR":
+        return _pmax(comm, (x != 0).astype(jnp.int32)).astype(x.dtype)
+    if name == "MPI_LXOR":
+        return (_psum(comm, (x != 0).astype(jnp.int32)) % 2).astype(x.dtype)
+    # PROD / bitwise / MINLOC / MAXLOC / user ops: algorithmic path
+    return alg.allreduce_recursive_doubling(comm, x, op)
+
+
+def reduce(comm, x, op, root=0):
+    # SPMD: computing the allreduce everywhere IS the fastest reduce on an
+    # ICI mesh (result significant at root, per MPI semantics)
+    return allreduce(comm, x, op)
+
+
+def bcast(comm, x, root=0):
+    # one native collective: zero every contribution but root's and all-reduce
+    rank = comm.rank()
+    contrib = jax.tree.map(
+        lambda a: jnp.where(rank == root, a, jnp.zeros_like(a)), x
+    )
+    return jax.tree.map(lambda a: _psum(comm, a), contrib)
+
+
+def barrier(comm, token=None):
+    t = jnp.zeros((), jnp.int32) if token is None else (
+        jnp.sum(token).astype(jnp.int32) * 0
+    )
+    return _psum(comm, t)
+
+
+def allgather(comm, x):
+    x = alg._stack_shape(x)
+    return lax.all_gather(
+        x, comm.axis, axis_index_groups=_groups(comm), tiled=True
+    )
+
+
+def allgatherv(comm, x, counts):
+    n = comm.size
+    mx = max(counts)
+    pad = mx - x.shape[0]
+    if pad:
+        x = jnp.pad(x, ((0, pad),) + ((0, 0),) * (x.ndim - 1))
+    g = lax.all_gather(x, comm.axis, axis_index_groups=_groups(comm))
+    parts = [g[i, : counts[i]] for i in range(n)]
+    return jnp.concatenate(parts, axis=0)
+
+
+def alltoall(comm, x):
+    n = comm.size
+    if x.shape[0] % n:
+        from ..core import errors
+
+        raise errors.CountError(
+            f"alltoall needs dim0 divisible by comm size {n}"
+        )
+    return lax.all_to_all(
+        x, comm.axis, split_axis=0, concat_axis=0,
+        axis_index_groups=_groups(comm), tiled=True,
+    )
+
+
+def reduce_scatter(comm, x, op):
+    if op.name == "MPI_SUM":
+        return lax.psum_scatter(
+            x, comm.axis, scatter_dimension=0,
+            axis_index_groups=_groups(comm), tiled=True,
+        )
+    return alg.reduce_scatter_recursive_halving(comm, x, op)
+
+
+def scan(comm, x, op):
+    return alg.scan_recursive_doubling(comm, x, op)
+
+
+def exscan(comm, x, op):
+    return alg.exscan_recursive_doubling(comm, x, op)
+
+
+def gather(comm, x, root=0):
+    return allgather(comm, x)
+
+
+def scatter(comm, x, root=0):
+    # take own block of root's buffer after a single-collective bcast
+    n = comm.size
+    full = bcast(comm, x, root)
+    buf, _ = alg._chunked(full, n)
+    return jnp.take(buf, comm.rank(), axis=0)
+
+
+class TpuCollComponent(CollComponent):
+    # Priority 40 < tuned's 50: the decision layer is the default entry point
+    # (mirroring the reference, where tuned outranks basic/others) and its
+    # "xla" algorithm delegates here for the cases where hardware collectives
+    # win — which is most of them.  `--mca coll tpu` selects this component
+    # directly, bypassing decisions.
+    name = "tpu"
+    default_priority = 40
+
+    def available(self) -> bool:
+        return True  # XLA collectives exist on every backend
+
+    def comm_query(self, comm) -> CollModule:
+        mod = CollModule(
+            allreduce=allreduce,
+            reduce=reduce,
+            bcast=bcast,
+            barrier=barrier,
+            allgather=allgather,
+            allgatherv=allgatherv,
+            alltoall=alltoall,
+            reduce_scatter=reduce_scatter,
+            scan=scan,
+            exscan=exscan,
+            gather=gather,
+            scatter=scatter,
+        )
+        if comm.uniform_size is None:
+            # non-uniform partitions: only ops whose XLA form takes
+            # axis_index_groups with unequal group sizes remain
+            mod.scan = None
+            mod.exscan = None
+            mod.scatter = None
+            mod.gather = None
+            mod.allgather = None
+            mod.allgatherv = None
+            mod.alltoall = None
+            mod.reduce_scatter = None
+        return mod

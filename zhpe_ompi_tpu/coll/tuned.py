@@ -1,0 +1,251 @@
+"""coll/tuned — the decision layer.
+
+Re-design of ``ompi/mca/coll/tuned`` (SURVEY.md §2.4): picks an algorithm per
+(operation, message size, comm size).  Three differences from the reference,
+all TPU-native:
+
+- decisions happen at **trace time** (shapes are static under jit), so the
+  decision tree costs zero at execution — the reference pays it per call
+  (``coll_tuned_decision_fixed.c:45-85``);
+- algorithm 0 ("xla") hands the op to the XLA-native component path — the
+  normally-best choice, analogous to tuned delegating to hardware
+  collectives;
+- forced algorithms are MCA vars holding *names*, not magic integers:
+  ``ZMPI_MCA_coll_tuned_allreduce_algorithm=ring`` (the reference's
+  ``coll_tuned_allreduce_decision.c:37-46`` enum, readable).
+
+Dynamic-rules files (``coll_tuned_dynamic_file.c``) are supported in a
+simplified form: ``coll_tuned_dynamic_rules`` names a file of
+``<op> <comm_size_min> <msg_bytes_min> <algorithm>`` lines; the most specific
+matching line wins.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from ..mca import output as mca_output
+from ..mca import var as mca_var
+from . import algorithms as alg
+from . import tpu as xla_mod
+from .framework import CollComponent, CollModule
+
+_stream = mca_output.open_stream("coll_tuned")
+
+ALLREDUCE_ALGS = {
+    "xla": None,  # delegate to the XLA-native path
+    "linear": alg.allreduce_linear,
+    "recursive_doubling": alg.allreduce_recursive_doubling,
+    "ring": alg.allreduce_ring,
+    "rabenseifner": alg.allreduce_rabenseifner,
+}
+BCAST_ALGS = {
+    "xla": None,
+    "binomial": alg.bcast_binomial,
+    "chain": alg.bcast_chain,
+    "scatter_allgather": alg.bcast_scatter_allgather,
+}
+REDUCE_ALGS = {
+    "xla": None,
+    "binomial": alg.reduce_binomial,
+    "linear": alg.reduce_linear,
+}
+ALLGATHER_ALGS = {
+    "xla": None,
+    "ring": alg.allgather_ring,
+    "bruck": alg.allgather_bruck,
+    "recursive_doubling": alg.allgather_recursive_doubling,
+}
+ALLTOALL_ALGS = {
+    "xla": None,
+    "pairwise": alg.alltoall_pairwise,
+    "bruck": alg.alltoall_bruck,
+}
+REDUCE_SCATTER_ALGS = {
+    "xla": None,
+    "ring": alg.reduce_scatter_ring,
+    "recursive_halving": alg.reduce_scatter_recursive_halving,
+}
+
+_ALG_TABLES = {
+    "allreduce": ALLREDUCE_ALGS,
+    "bcast": BCAST_ALGS,
+    "reduce": REDUCE_ALGS,
+    "allgather": ALLGATHER_ALGS,
+    "alltoall": ALLTOALL_ALGS,
+    "reduce_scatter": REDUCE_SCATTER_ALGS,
+}
+
+# decision thresholds (bytes); MCA-tunable, defaults in the spirit of the
+# reference's 10KB/1MB switch points (coll_tuned_decision_fixed.c:53,73)
+_DEFAULT_SMALL = 16 * 1024
+_DEFAULT_LARGE = 1 * 1024 * 1024
+
+
+def _register_params():
+    for opname, table in _ALG_TABLES.items():
+        mca_var.register(
+            f"coll_tuned_{opname}_algorithm",
+            "auto",
+            f"Forced algorithm for {opname}: one of "
+            + ", ".join(["auto"] + list(table)),
+            enum=tuple(["auto"] + list(table)),
+        )
+    mca_var.register(
+        "coll_tuned_small_msg", _DEFAULT_SMALL,
+        "Message size (bytes) below which latency-optimal algorithms win",
+        type=int,
+    )
+    mca_var.register(
+        "coll_tuned_large_msg", _DEFAULT_LARGE,
+        "Message size (bytes) above which bandwidth-optimal algorithms win",
+        type=int,
+    )
+    mca_var.register(
+        "coll_tuned_dynamic_rules", "",
+        "Path to a dynamic decision-rules file "
+        "(<op> <comm_size_min> <msg_bytes_min> <algorithm> per line)",
+    )
+
+
+def _nbytes(x) -> int:
+    import jax
+
+    leaves = jax.tree.leaves(x)
+    return sum(
+        int(np.prod(l.shape)) * np.dtype(l.dtype).itemsize for l in leaves
+    )
+
+
+_rules_cache: dict[str, list[tuple[str, int, int, str]]] = {}
+
+
+def _dynamic_rule(opname: str, comm_size: int, nbytes: int) -> str | None:
+    path = mca_var.get("coll_tuned_dynamic_rules", "")
+    if not path:
+        return None
+    rules = _rules_cache.get(path)
+    if rules is None:
+        rules = []
+        try:
+            with open(path, "r", encoding="utf-8") as fh:
+                for line in fh:
+                    parts = line.split("#")[0].split()
+                    if len(parts) == 4:
+                        rules.append(
+                            (parts[0], int(parts[1]), int(parts[2]), parts[3])
+                        )
+        except OSError as e:
+            mca_output.emit(
+                _stream,
+                "coll_tuned_dynamic_rules file %r unreadable (%s); "
+                "falling back to fixed decisions", path, e,
+            )
+        _rules_cache[path] = rules
+    best = None
+    best_key = (-1, -1)
+    for op, cmin, bmin, algname in rules:
+        if op == opname and comm_size >= cmin and nbytes >= bmin:
+            if (cmin, bmin) > best_key:
+                best, best_key = algname, (cmin, bmin)
+    return best
+
+
+def decide(opname: str, comm, x, op=None) -> str:
+    """Pick an algorithm name for this call — all inputs static at trace
+    time, mirroring coll_tuned_decision_fixed.c but at zero runtime cost."""
+    table = _ALG_TABLES[opname]
+    forced = mca_var.get(f"coll_tuned_{opname}_algorithm", "auto")
+    if forced != "auto" and forced in table:
+        return forced
+    n = comm.uniform_size or 0
+    nbytes = _nbytes(x)
+    dyn = _dynamic_rule(opname, n, nbytes)
+    if dyn in table:
+        return dyn
+    # Non-commutative ops must reduce in rank order: only linear preserves it.
+    if op is not None and not op.commute and opname in (
+        "allreduce", "reduce"
+    ):
+        return "linear"
+    small = mca_var.get("coll_tuned_small_msg", _DEFAULT_SMALL)
+    large = mca_var.get("coll_tuned_large_msg", _DEFAULT_LARGE)
+    if opname == "allreduce":
+        if op is not None and op.xla_collective:
+            return "xla"
+        if nbytes < small:
+            return "recursive_doubling"
+        if n and n & (n - 1) == 0 and nbytes >= large:
+            return "rabenseifner"
+        return "ring"
+    if opname == "bcast":
+        if nbytes < small:
+            return "xla"
+        return "scatter_allgather" if nbytes >= large else "binomial"
+    if opname == "reduce":
+        if op is not None and op.xla_collective:
+            return "xla"
+        return "binomial"
+    if opname == "allgather":
+        # XLA's native all_gather is optimal on ICI at every size; the
+        # algorithmic variants (ring/bruck/recursive_doubling) exist for
+        # forced selection and benchmarking, not the auto path.
+        return "xla"
+    if opname == "alltoall":
+        return "xla"
+    if opname == "reduce_scatter":
+        if op is not None and op.xla_collective == "psum":
+            return "xla"
+        if n and n & (n - 1) == 0:
+            return "recursive_halving"
+        return "ring"
+    return next(iter(table))
+
+
+def _dispatch(opname):
+    def fn(comm, x, *args, **kwargs):
+        algname = decide(
+            opname, comm, x,
+            op=(args[0] if opname in ("allreduce", "reduce", "reduce_scatter")
+                and args else None),
+        )
+        mca_output.verbose(
+            9, _stream, "%s size=%s -> %s", opname,
+            comm.uniform_size, algname,
+        )
+        impl = _ALG_TABLES[opname][algname]
+        if impl is None:
+            impl = getattr(xla_mod, opname)
+        return impl(comm, x, *args, **kwargs)
+
+    return fn
+
+
+class TunedCollComponent(CollComponent):
+    name = "tuned"
+    default_priority = 50
+
+    def register_params(self) -> None:
+        _register_params()
+
+    def comm_query(self, comm) -> CollModule | None:
+        if comm.uniform_size is None:
+            return None  # algorithmic layer needs uniform groups
+        _register_params()
+        return CollModule(
+            allreduce=_dispatch("allreduce"),
+            reduce=_dispatch("reduce"),
+            bcast=_dispatch("bcast"),
+            allgather=_dispatch("allgather"),
+            alltoall=_dispatch("alltoall"),
+            reduce_scatter=_dispatch("reduce_scatter"),
+            # ops with a single algorithmic implementation delegate directly
+            barrier=alg.barrier_dissemination,
+            scan=alg.scan_recursive_doubling,
+            exscan=alg.exscan_recursive_doubling,
+            gather=alg.gather_ring,
+            scatter=alg.scatter_linear,
+            allgatherv=alg.allgatherv_concat,
+        )

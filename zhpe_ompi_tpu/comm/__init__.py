@@ -1,0 +1,5 @@
+"""Communicators and groups (ompi/communicator + ompi/group analog)."""
+from .communicator import Communicator
+from .group import Group
+
+__all__ = ["Communicator", "Group"]

@@ -1,0 +1,300 @@
+"""Communicators — the SPMD re-design.
+
+Re-design of ``ompi/communicator`` (``ompi_communicator_t``,
+``ompi/communicator/communicator.h:134-191``) for a single-controller SPMD
+machine.  Key semantic shift, documented here once:
+
+- In the reference, every process holds its *own* communicator object and
+  ``MPI_Comm_split`` is a collective over processes.  Under JAX's
+  single-controller model one Python object describes the communicator for
+  ALL devices; ``split(colors)`` takes the full color assignment (what the
+  reference reconstructs via an allgather inside ``ompi_comm_split``) and
+  returns ONE object representing every sub-communicator of the partition.
+  Inside traced SPMD code each device then acts within its own group.
+- A communicator is bound to one mesh axis.  Per-axis communicators of an
+  N-D mesh are the cartesian sub-communicators of ``MPI_Cart_sub``.
+- "rank" is a traced value (``lax.axis_index``) inside ``shard_map``; the
+  host never has a rank — it is the controller of all of them.
+
+The collective function table (``comm.coll``) is composed per-communicator,
+per-operation from the coll framework's components by priority, exactly
+mirroring ``mca_coll_base_comm_select.c:108-152``.
+"""
+
+from __future__ import annotations
+
+import threading
+from functools import partial
+from typing import Any, Sequence
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..core import errors
+from ..mca import output as mca_output
+from .group import Group
+
+_stream = mca_output.open_stream("comm")
+
+_cid_lock = threading.Lock()
+_next_cid = [0]
+
+
+def _alloc_cid() -> int:
+    """CID allocation (cf. ompi_comm_nextcid) — trivial under one controller."""
+    with _cid_lock:
+        cid = _next_cid[0]
+        _next_cid[0] += 1
+        return cid
+
+
+class Communicator:
+    """A communicator over one mesh axis, optionally partitioned into
+    same-axis sub-groups (the result of ``split``)."""
+
+    def __init__(
+        self,
+        mesh: Mesh,
+        axis: str,
+        partition: list[Group] | None = None,
+        name: str | None = None,
+    ) -> None:
+        if axis not in mesh.axis_names:
+            raise errors.CommError(f"axis {axis!r} not in mesh {mesh.axis_names}")
+        self.mesh = mesh
+        self.axis = axis
+        self.axis_size = mesh.shape[axis]
+        if partition is None:
+            partition = [Group(range(self.axis_size))]
+        covered = sorted(r for g in partition for r in g.ranks)
+        if covered != list(range(self.axis_size)):
+            raise errors.CommError(
+                "partition must cover every axis index exactly once"
+            )
+        self.partition = partition
+        self.cid = _alloc_cid()
+        self.name = name or f"comm{self.cid}"
+        self.attributes: dict[Any, Any] = {}  # MPI attribute caching
+        # Static lookup tables (device-constant arrays built lazily):
+        #   axis index -> comm-relative rank, and -> its group's size
+        self._rank_table = np.empty(self.axis_size, dtype=np.int32)
+        self._size_table = np.empty(self.axis_size, dtype=np.int32)
+        for g in partition:
+            for i, glob in enumerate(g.ranks):
+                self._rank_table[glob] = i
+                self._size_table[glob] = g.size
+        self._coll: dict[str, tuple] | None = None
+        mca_output.verbose(
+            5, _stream, "created %s over axis %s (%d groups)",
+            self.name, axis, len(partition),
+        )
+
+    # -- shape/introspection --------------------------------------------
+
+    @property
+    def is_partitioned(self) -> bool:
+        return len(self.partition) > 1
+
+    @property
+    def uniform_size(self) -> int | None:
+        sizes = {g.size for g in self.partition}
+        return sizes.pop() if len(sizes) == 1 else None
+
+    @property
+    def size(self) -> int:
+        """Group size when every sub-group has the same size (the common
+        case); raises otherwise — use ``size_traced()`` inside the program."""
+        s = self.uniform_size
+        if s is None:
+            raise errors.CommError(
+                f"{self.name} has non-uniform sub-group sizes; use size_traced()"
+            )
+        return s
+
+    @property
+    def group(self) -> Group:
+        if self.is_partitioned:
+            raise errors.CommError(
+                f"{self.name} is partitioned; access .partition instead"
+            )
+        return self.partition[0]
+
+    @property
+    def index_groups(self) -> list[list[int]] | None:
+        """axis_index_groups for XLA collectives (None for the whole axis)."""
+        if not self.is_partitioned and self.partition[0].ranks == tuple(
+            range(self.axis_size)
+        ):
+            return None
+        return [list(g.ranks) for g in self.partition]
+
+    # -- traced views (valid inside shard_map over self.mesh) ------------
+
+    def axis_index(self):
+        """Global index along the comm's mesh axis (traced)."""
+        return jax.lax.axis_index(self.axis)
+
+    def rank(self):
+        """Comm-relative rank of the executing device (traced)."""
+        if not self.is_partitioned:
+            return self.axis_index()
+        import jax.numpy as jnp
+
+        return jnp.asarray(self._rank_table)[self.axis_index()]
+
+    def size_traced(self):
+        import jax.numpy as jnp
+
+        return jnp.asarray(self._size_table)[self.axis_index()]
+
+    # -- construction of new communicators ------------------------------
+
+    def dup(self, name: str | None = None) -> "Communicator":
+        """MPI_Comm_dup: same partition, fresh CID and attribute space."""
+        return Communicator(self.mesh, self.axis, list(self.partition), name)
+
+    def split(self, colors: Sequence[int], keys: Sequence[int] | None = None,
+              name: str | None = None) -> "Communicator":
+        """MPI_Comm_split, single-controller form: `colors[i]` is the color of
+        axis index i (UNDEFINED/-1 for "not in any group" is not supported on
+        an SPMD machine — every device executes the program; use a color).
+        `keys` orders ranks within each new group (ties by old rank)."""
+        if len(colors) != self.axis_size:
+            raise errors.ArgError(
+                f"need {self.axis_size} colors, got {len(colors)}"
+            )
+        keys = list(keys) if keys is not None else [0] * self.axis_size
+        buckets: dict[int, list[int]] = {}
+        for idx in range(self.axis_size):
+            buckets.setdefault(int(colors[idx]), []).append(idx)
+        groups = []
+        for color in sorted(buckets):
+            members = sorted(buckets[color], key=lambda i: (keys[i], i))
+            groups.append(Group(members))
+        return Communicator(self.mesh, self.axis, groups, name)
+
+    def create_from_group(self, group: Group, name: str | None = None
+                          ) -> "Communicator":
+        """MPI_Comm_create_from_group-style: the given group plus the
+        complement as a second group (every device must belong somewhere on
+        an SPMD machine)."""
+        rest = [r for r in range(self.axis_size) if group.rank_of_global(r) < 0]
+        parts = [group] + ([Group(rest)] if rest else [])
+        return Communicator(self.mesh, self.axis, parts, name)
+
+    # -- collective dispatch --------------------------------------------
+
+    @property
+    def coll(self) -> dict:
+        """Per-communicator collective table, composed on first use
+        (mca_coll_base_comm_select semantics)."""
+        if self._coll is None:
+            from ..coll.framework import comm_select
+
+            self._coll = comm_select(self)
+        return self._coll
+
+    def _coll_call(self, opname: str, *args, **kwargs):
+        entry = self.coll.get(opname)
+        if entry is None:
+            raise errors.UnsupportedError(
+                f"no coll component provides {opname} for {self.name}"
+            )
+        fn, comp_name = entry
+        return fn(self, *args, **kwargs)
+
+    def allreduce(self, x, op=None, **kw):
+        from .. import ops as _ops
+
+        return self._coll_call("allreduce", x, op or _ops.SUM, **kw)
+
+    def reduce(self, x, op=None, root: int = 0, **kw):
+        from .. import ops as _ops
+
+        return self._coll_call("reduce", x, op or _ops.SUM, root, **kw)
+
+    def bcast(self, x, root: int = 0, **kw):
+        return self._coll_call("bcast", x, root, **kw)
+
+    def barrier(self, token=None):
+        return self._coll_call("barrier", token)
+
+    def allgather(self, x, **kw):
+        return self._coll_call("allgather", x, **kw)
+
+    def alltoall(self, x, **kw):
+        return self._coll_call("alltoall", x, **kw)
+
+    def reduce_scatter(self, x, op=None, **kw):
+        from .. import ops as _ops
+
+        return self._coll_call("reduce_scatter", x, op or _ops.SUM, **kw)
+
+    def scan(self, x, op=None, **kw):
+        from .. import ops as _ops
+
+        return self._coll_call("scan", x, op or _ops.SUM, **kw)
+
+    def exscan(self, x, op=None, **kw):
+        from .. import ops as _ops
+
+        return self._coll_call("exscan", x, op or _ops.SUM, **kw)
+
+    def gather(self, x, root: int = 0, **kw):
+        return self._coll_call("gather", x, root, **kw)
+
+    def scatter(self, x, root: int = 0, **kw):
+        return self._coll_call("scatter", x, root, **kw)
+
+    def allgatherv(self, x, counts, **kw):
+        return self._coll_call("allgatherv", x, counts, **kw)
+
+    # -- point-to-point (SPMD plane) -------------------------------------
+
+    def shift(self, x, offset: int, wrap: bool = True):
+        """Uniform-shift sendrecv (MPI_Sendrecv in a ring / MPI_Cart_shift):
+        every rank sends its buffer to (rank+offset) and receives from
+        (rank-offset).  With wrap=False the ends get zeros (MPI_PROC_NULL)."""
+        from ..pt2pt import spmd as _spmd
+
+        return _spmd.shift(self, x, offset, wrap=wrap)
+
+    def permute(self, x, dest_of: list[int]):
+        """General static sendrecv: dest_of[i] is where comm rank i's buffer
+        goes (-1 = sends nowhere); ranks nobody targets receive zeros."""
+        from ..pt2pt import spmd as _spmd
+
+        return _spmd.sendrecv(self, x, dest_of)
+
+    def ppermute(self, x, pairs: list[tuple[int, int]]):
+        """Comm-relative collective permute (the BTL of the SPMD plane)."""
+        from ..pt2pt import spmd as _spmd
+
+        return _spmd.ppermute(self, x, pairs)
+
+    # -- host-side execution helper --------------------------------------
+
+    def run(self, fn, *args, in_specs=None, out_specs=None):
+        """Run `fn(*args)` under shard_map over this comm's mesh with data
+        sharded along the comm axis (dim 0 by default).  Convenience for
+        tests/examples; real applications compose shard_map themselves."""
+        if in_specs is None:
+            in_specs = P(self.axis)
+        if out_specs is None:
+            out_specs = P(self.axis)
+        mapped = jax.shard_map(
+            fn, mesh=self.mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=False,
+        )
+        return mapped(*args)
+
+    def device_put_sharded(self, x, spec=None):
+        """Place a host array onto the mesh, sharded along the comm axis."""
+        sharding = NamedSharding(self.mesh, spec or P(self.axis))
+        return jax.device_put(x, sharding)
+
+    def __repr__(self):  # pragma: no cover
+        part = f", groups={len(self.partition)}" if self.is_partitioned else ""
+        return f"Communicator({self.name}, axis={self.axis}{part})"

@@ -1,0 +1,105 @@
+"""Process groups.
+
+Re-design of ``ompi/group`` for the SPMD world: a group is an ordered list of
+*global ranks* (positions in the world device order).  All MPI group calculus
+is supported (union/intersection/difference/incl/excl/range_incl/
+translate_ranks/compare), and groups are immutable value objects — there is no
+refcounting because the host is a single controller.
+"""
+
+from __future__ import annotations
+
+from ..core import errors
+
+# MPI_Group_compare results
+IDENT = 0
+SIMILAR = 1
+UNEQUAL = 2
+
+UNDEFINED = -1
+
+
+class Group:
+    __slots__ = ("_ranks", "_pos")
+
+    def __init__(self, ranks):
+        ranks = [int(r) for r in ranks]
+        if len(set(ranks)) != len(ranks):
+            raise errors.GroupError(f"duplicate ranks in group: {ranks}")
+        self._ranks = tuple(ranks)
+        self._pos = {r: i for i, r in enumerate(self._ranks)}
+
+    @property
+    def size(self) -> int:
+        return len(self._ranks)
+
+    @property
+    def ranks(self) -> tuple[int, ...]:
+        """Global ranks, in group order."""
+        return self._ranks
+
+    def rank_of_global(self, global_rank: int) -> int:
+        """Group-relative rank of a global rank (UNDEFINED if absent)."""
+        return self._pos.get(global_rank, UNDEFINED)
+
+    def global_of_rank(self, rank: int) -> int:
+        if not 0 <= rank < self.size:
+            raise errors.RankError(f"rank {rank} out of range [0,{self.size})")
+        return self._ranks[rank]
+
+    # -- calculus --------------------------------------------------------
+
+    def incl(self, ranks) -> "Group":
+        return Group([self.global_of_rank(r) for r in ranks])
+
+    def excl(self, ranks) -> "Group":
+        drop = set(ranks)
+        for r in drop:
+            if not 0 <= r < self.size:
+                raise errors.RankError(f"rank {r} out of range")
+        return Group([g for i, g in enumerate(self._ranks) if i not in drop])
+
+    def range_incl(self, triplets) -> "Group":
+        """MPI_Group_range_incl: [(first, last, stride), ...]."""
+        sel = []
+        for first, last, stride in triplets:
+            if stride == 0:
+                raise errors.ArgError("zero stride")
+            r = first
+            while (stride > 0 and r <= last) or (stride < 0 and r >= last):
+                sel.append(r)
+                r += stride
+        return self.incl(sel)
+
+    def union(self, other: "Group") -> "Group":
+        out = list(self._ranks)
+        for g in other._ranks:
+            if g not in self._pos:
+                out.append(g)
+        return Group(out)
+
+    def intersection(self, other: "Group") -> "Group":
+        return Group([g for g in self._ranks if g in other._pos])
+
+    def difference(self, other: "Group") -> "Group":
+        return Group([g for g in self._ranks if g not in other._pos])
+
+    def translate_ranks(self, ranks, other: "Group") -> list[int]:
+        """MPI_Group_translate_ranks."""
+        return [other.rank_of_global(self.global_of_rank(r)) for r in ranks]
+
+    def compare(self, other: "Group") -> int:
+        if self._ranks == other._ranks:
+            return IDENT
+        if set(self._ranks) == set(other._ranks):
+            return SIMILAR
+        return UNEQUAL
+
+    def __eq__(self, other):
+        return isinstance(other, Group) and self._ranks == other._ranks
+
+    def __hash__(self):
+        return hash(self._ranks)
+
+    def __repr__(self):  # pragma: no cover
+        return f"Group({list(self._ranks)})"

@@ -1,0 +1,218 @@
+"""Flagship model: a transformer LM parallelized *through the framework*.
+
+This plays the role the reference's example programs play
+(``examples/ring_c.c`` etc.): a real application whose every communication
+goes through the framework's communicators — the way a Megatron-style trainer
+drives MPI/NCCL:
+
+- **tp** (tensor parallel): attention heads and MLP hidden are sharded over
+  the 'tp' mesh axis; partial sums after the output/down projections are
+  combined with ``tp_comm.allreduce`` (the MPI_Allreduce hot path of
+  BASELINE.md, executed as XLA psum on ICI).
+- **dp** (data parallel): gradients are averaged with ``dp_comm.allreduce``.
+- **sp** (sequence parallel / long context): ring attention over the 'sp'
+  axis using ``comm.ppermute`` ring steps (see ring_attention.py).
+
+Everything is bfloat16 on the MXU path with float32 master params/reductions,
+static shapes, and scan-over-layers for compile-time O(1) in depth.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Any
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .. import ops as zops
+
+
+@dataclass(frozen=True)
+class Config:
+    vocab: int = 256
+    d_model: int = 128
+    n_heads: int = 8
+    d_ff: int = 512
+    n_layers: int = 2
+    seq: int = 64
+    dtype: Any = jnp.bfloat16
+
+
+def init_params(cfg: Config, key, tp: int = 1) -> dict:
+    """Initialize host-side full parameters (unsharded)."""
+    k = jax.random.split(key, 8)
+    D, H, F, V = cfg.d_model, cfg.d_model, cfg.d_ff, cfg.vocab
+    s = lambda *shape: (cfg.n_layers,) + shape
+
+    def nrm(kk, shape, scale):
+        return (jax.random.normal(kk, shape, jnp.float32) * scale)
+
+    return {
+        "embed": nrm(k[0], (V, D), 0.02),
+        # (L, D, 3, H): the q/k/v axis is explicit so tp-sharding the head
+        # dim (last axis) keeps each rank's slice = q,k,v of its own heads
+        "wqkv": nrm(k[1], s(D, 3, H), D**-0.5),
+        "wo": nrm(k[2], s(H, D), H**-0.5),
+        "w1": nrm(k[3], s(D, F), D**-0.5),
+        "w2": nrm(k[4], s(F, D), F**-0.5),
+        "ln1": jnp.ones(s(D)),
+        "ln2": jnp.ones(s(D)),
+        "lnf": jnp.ones((D,)),
+    }
+
+
+def shard_params_tp(params: dict, tp_rank, tp: int) -> dict:
+    """Slice the tp-sharded tensors for one tp rank (done by in_specs in
+    practice; this documents the layout)."""
+    return params
+
+
+def _ln(x, g):
+    dt = x.dtype
+    x = x.astype(jnp.float32)
+    m = x.mean(-1, keepdims=True)
+    v = x.var(-1, keepdims=True)
+    return ((x - m) * lax.rsqrt(v + 1e-5) * g).astype(dt)
+
+
+def _attn(q, k, v, causal=True):
+    # q,k,v: (B, S, h, hd)
+    B, S, h, hd = q.shape
+    q = q * (hd**-0.5)
+    scores = jnp.einsum("bshd,bthd->bhst", q, k).astype(jnp.float32)
+    if causal:
+        mask = jnp.tril(jnp.ones((S, S), bool))
+        scores = jnp.where(mask, scores, -1e30)
+    w = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
+    return jnp.einsum("bhst,bthd->bshd", w, v)
+
+
+def forward(params: dict, tokens, cfg: Config, tp_comm=None):
+    """Forward pass on one device's shard.
+
+    `tp_comm` is a framework communicator over the 'tp' axis (or None for no
+    tensor parallelism).  Heads and ffn-hidden arrive pre-sharded: wqkv is
+    (L, D, 3H/tp), wo is (L, H/tp, D), w1 (L, D, F/tp), w2 (L, F/tp, D).
+    After wo and w2 the partial products are summed with tp_comm.allreduce —
+    the framework's MPI_Allreduce on the hot path.
+    """
+    dtype = cfg.dtype
+    x = params["embed"].astype(dtype)[tokens]  # (B, S, D)
+    B, S, D = x.shape
+    hd = D // cfg.n_heads
+    n_heads_local = params["wqkv"].shape[-1] // hd
+
+    from ..parallel.grad import f_identity, g_allreduce
+
+    def block(x, layer):
+        wqkv, wo, w1, w2, g1, g2 = layer
+        h = _ln(x, g1)
+        if tp_comm is not None:
+            h = f_identity(tp_comm, h)
+        qkv = jnp.einsum("bsd,dce->bsce", h, wqkv.astype(dtype))
+        q = qkv[:, :, 0].reshape(B, S, n_heads_local, hd)
+        k = qkv[:, :, 1].reshape(B, S, n_heads_local, hd)
+        v = qkv[:, :, 2].reshape(B, S, n_heads_local, hd)
+        o = _attn(q, k, v).reshape(B, S, -1)
+        o = jnp.einsum("bse,ed->bsd", o, wo.astype(dtype))
+        if tp_comm is not None:
+            o = g_allreduce(tp_comm, o)
+        x = x + o
+        h = _ln(x, g2)
+        if tp_comm is not None:
+            h = f_identity(tp_comm, h)
+        u = jnp.einsum("bsd,df->bsf", h, w1.astype(dtype))
+        u = jax.nn.gelu(u)
+        d = jnp.einsum("bsf,fd->bsd", u, w2.astype(dtype))
+        if tp_comm is not None:
+            d = g_allreduce(tp_comm, d)
+        return x + d, None
+
+    layers = (
+        params["wqkv"], params["wo"], params["w1"], params["w2"],
+        params["ln1"], params["ln2"],
+    )
+    x, _ = lax.scan(
+        lambda carry, layer: block(carry, layer), x,
+        layers,
+    )
+    x = _ln(x, params["lnf"])
+    logits = jnp.einsum(
+        "bsd,vd->bsv", x.astype(jnp.float32), params["embed"]
+    )
+    return logits
+
+
+def loss_fn(params, tokens, targets, cfg: Config, tp_comm=None):
+    logits = forward(params, tokens, cfg, tp_comm)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)
+    return -jnp.mean(ll)
+
+
+def make_train_step(cfg: Config, mesh, dp_comm, tp_comm, lr: float = 1e-2):
+    """Build the jitted SPMD training step.
+
+    Gradient synchronization semantics (verified in tests against a
+    single-device run):
+      - tp-sharded params (wqkv/wo/w1/w2): their grads are tp-local already;
+        average over dp only.
+      - replicated params (embed/ln): the backward of the forward tp
+        allreduce (psum) makes each tp rank hold the FULL gradient already
+        summed over tp contributions; averaging over (dp, tp) with a divide
+        by dp restores the correct value when combined with a tp-mean.
+    All syncs go through the framework's allreduce.
+    """
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    dp = mesh.shape[dp_comm.axis]
+    tp = mesh.shape[tp_comm.axis] if tp_comm is not None else 1
+
+    param_specs = {
+        "embed": P(), "lnf": P(),
+        "wqkv": P(None, None, None, tp_comm.axis if tp_comm else None),
+        "wo": P(None, tp_comm.axis if tp_comm else None, None),
+        "w1": P(None, None, tp_comm.axis if tp_comm else None),
+        "w2": P(None, tp_comm.axis if tp_comm else None, None),
+        "ln1": P(), "ln2": P(),
+    }
+
+    replicated = {"embed", "lnf", "ln1", "ln2"}
+
+    def spmd_step(params, tokens, targets):
+        def local_loss(p):
+            return loss_fn(p, tokens, targets, cfg, tp_comm)
+
+        loss, grads = jax.value_and_grad(local_loss)(params)
+        synced = {}
+        for name, g in grads.items():
+            g = dp_comm.allreduce(g, zops.SUM) / dp
+            if name in replicated and tp_comm is not None:
+                # each tp rank already holds the tp-summed grad; make the
+                # replicated update bitwise-identical across tp ranks
+                g = tp_comm.allreduce(g, zops.SUM) / tp
+            synced[name] = g
+        loss = dp_comm.allreduce(loss, zops.SUM) / dp
+        if tp_comm is not None:
+            loss = tp_comm.allreduce(loss, zops.SUM) / tp
+        new_params = jax.tree.map(
+            lambda p, g: (p - lr * g).astype(p.dtype), params, synced
+        )
+        return new_params, loss
+
+    data_spec = P(dp_comm.axis)
+    step = jax.jit(
+        jax.shard_map(
+            spmd_step,
+            mesh=mesh,
+            in_specs=(param_specs, data_spec, data_spec),
+            out_specs=(param_specs, P()),
+            check_vma=False,
+        )
+    )
+    return step, param_specs

@@ -1,0 +1,264 @@
+"""Reduction operator engine.
+
+Re-design of ``ompi/op`` + ``ompi/mca/op`` (SURVEY.md §2.3): the reference
+keeps a table of C kernels per (op, datatype) (``ompi_op_base_functions``,
+``ompi/mca/op/base/functions.h:37-39``) and dispatches through
+``ompi_op_reduce`` (``ompi/op/op.h:547-605``).  The TPU-native redesign:
+
+- every predefined op lowers to a **jax.numpy elementwise combine** on device
+  (fusable by XLA into the surrounding collective) and a numpy combine on host;
+- ops that XLA's ICI collectives implement natively carry an
+  ``xla_collective`` hint (SUM→psum, MAX→pmax, MIN→pmin) so the coll layer can
+  skip the algorithmic path entirely;
+- the reference's COMMUTE / FLOAT_ASSOCIATIVE flags (``ompi/op/op.h:425-460``)
+  are kept: the tuned decision layer must not pick reordering algorithms
+  (recursive doubling, Rabenseifner) for non-commutative user ops, exactly as
+  the reference's algorithms check ``ompi_op_is_commute``;
+- MINLOC/MAXLOC operate on (value, index) pairs — host: structured arrays,
+  device: a (values, indices) tuple of arrays.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import numpy as np
+
+from ..core import errors
+from ..datatype.predefined import Datatype, PairDatatype
+
+_FLOAT_KINDS = ("f", "c")
+
+
+class Op:
+    """A reduction operator (``ompi_op_t`` analog)."""
+
+    def __init__(
+        self,
+        name: str,
+        np_fn: Callable | None,
+        jnp_fn: Callable | None = None,
+        *,
+        commute: bool = True,
+        float_assoc: bool = True,
+        xla_collective: str | None = None,
+        allowed_kinds: str | None = None,
+        pair_op: bool = False,
+        identity: Any = None,
+    ) -> None:
+        self.name = name
+        self._np_fn = np_fn
+        self._jnp_fn = jnp_fn
+        self.commute = commute
+        #: False when floating-point reassociation must be avoided (the
+        #: reference's FLOAT_ASSOCIATIVE flag); decision layers use it to pin
+        #: deterministic orderings for float reductions when asked.
+        self.float_assoc = float_assoc
+        #: XLA collective this op lowers to directly ("psum"/"pmax"/"pmin").
+        self.xla_collective = xla_collective
+        #: numpy dtype kinds this op accepts (None = any numeric).
+        self.allowed_kinds = allowed_kinds
+        self.pair_op = pair_op
+        #: identity element (for padding non-power-of-two algorithms).
+        self._identity = identity
+        self.is_user_defined = False
+
+    # -- validation ------------------------------------------------------
+
+    def check_datatype(self, datatype: Datatype) -> None:
+        if self.pair_op:
+            if not isinstance(datatype, PairDatatype):
+                raise errors.OpError(
+                    f"{self.name} requires a pair datatype (e.g. MPI_FLOAT_INT), "
+                    f"got {datatype.name}"
+                )
+            return
+        if isinstance(datatype, PairDatatype):
+            raise errors.OpError(
+                f"{self.name} does not accept pair datatype {datatype.name}"
+            )
+        kind = np.dtype(getattr(datatype, "np_dtype", np.uint8)).kind
+        if self.allowed_kinds is not None and kind not in self.allowed_kinds:
+            raise errors.OpError(
+                f"{self.name} not defined for datatype {datatype.name}"
+            )
+
+    # -- combine ---------------------------------------------------------
+
+    def __call__(self, a, b):
+        """Elementwise combine a ⊕ b. MPI_Reduce semantics: `a` is the
+        incoming (remote) operand, `b` the accumulator — order matters for
+        non-commutative user ops (cf. ompi_op_reduce(op, source, target))."""
+        if self._np_fn is None:
+            raise errors.OpError(f"{self.name} has no combine function")
+        if isinstance(a, np.ndarray) or np.isscalar(a):
+            return self._np_fn(a, b)
+        fn = self._jnp_fn or self._np_fn
+        return fn(a, b)
+
+    def identity_for(self, dtype) -> Any:
+        """Identity element for padding (raises for ops without one)."""
+        if self._identity is None:
+            raise errors.OpError(f"{self.name} has no identity element")
+        dt = np.dtype(dtype)
+        if self._identity == "min":
+            if dt.kind == "f":
+                return dt.type(-np.inf)
+            return np.iinfo(dt).min if dt.kind in "iu" else False
+        if self._identity == "max":
+            if dt.kind == "f":
+                return dt.type(np.inf)
+            return np.iinfo(dt).max if dt.kind in "iu" else True
+        return dt.type(self._identity)
+
+    def __repr__(self) -> str:  # pragma: no cover
+        return f"Op({self.name})"
+
+
+def _pair_combine(better):
+    """Build a MINLOC/MAXLOC combine over (value, index) pairs.
+
+    Host: numpy structured arrays with fields value/index.
+    Device: tuples (values, indices).
+    Ties go to the lower index, per the MPI standard.
+    """
+
+    def np_fn(a, b):
+        if isinstance(a, tuple):  # device representation
+            import jax.numpy as jnp
+
+            av, ai = a
+            bv, bi = b
+            take_a = better(av, bv) | ((av == bv) & (ai < bi))
+            return (jnp.where(take_a, av, bv), jnp.where(take_a, ai, bi))
+        a = np.asarray(a)
+        b = np.asarray(b)
+        take_a = better(a["value"], b["value"]) | (
+            (a["value"] == b["value"]) & (a["index"] < b["index"])
+        )
+        return np.where(take_a, a, b)
+
+    return np_fn
+
+
+def _land(a, b):
+    return ((np.asarray(a) != 0) & (np.asarray(b) != 0)).astype(np.asarray(a).dtype)
+
+
+def _lor(a, b):
+    return ((np.asarray(a) != 0) | (np.asarray(b) != 0)).astype(np.asarray(a).dtype)
+
+
+def _lxor(a, b):
+    return ((np.asarray(a) != 0) ^ (np.asarray(b) != 0)).astype(np.asarray(a).dtype)
+
+
+def _jnp(name):
+    import jax.numpy as jnp
+
+    return getattr(jnp, name)
+
+
+def _jnp_logical(kind):
+    import jax.numpy as jnp
+
+    def fn(a, b):
+        r = {
+            "and": jnp.logical_and,
+            "or": jnp.logical_or,
+            "xor": jnp.logical_xor,
+        }[kind]((a != 0), (b != 0))
+        return r.astype(a.dtype)
+
+    return fn
+
+
+MAX = Op("MPI_MAX", np.maximum, None, xla_collective="pmax", identity="min")
+MIN = Op("MPI_MIN", np.minimum, None, xla_collective="pmin", identity="max")
+SUM = Op("MPI_SUM", np.add, None, xla_collective="psum", identity=0)
+PROD = Op("MPI_PROD", np.multiply, None, identity=1)
+LAND = Op("MPI_LAND", _land, None, allowed_kinds="iub", identity=1)
+BAND = Op("MPI_BAND", np.bitwise_and, None, allowed_kinds="iub", identity="max")
+LOR = Op("MPI_LOR", _lor, None, allowed_kinds="iub", identity=0)
+BOR = Op("MPI_BOR", np.bitwise_or, None, allowed_kinds="iub", identity=0)
+LXOR = Op("MPI_LXOR", _lxor, None, allowed_kinds="iub", identity=0)
+BXOR = Op("MPI_BXOR", np.bitwise_xor, None, allowed_kinds="iub", identity=0)
+MAXLOC = Op(
+    "MPI_MAXLOC", _pair_combine(lambda x, y: x > y), None, pair_op=True
+)
+MINLOC = Op(
+    "MPI_MINLOC", _pair_combine(lambda x, y: x < y), None, pair_op=True
+)
+REPLACE = Op("MPI_REPLACE", lambda a, b: a, None, commute=False)
+NO_OP = Op("MPI_NO_OP", lambda a, b: b, None, commute=False)
+
+# Device combines: defer jax import until first use by installing lazily.
+for _op, _lazy in [
+    (MAX, lambda: _jnp("maximum")),
+    (MIN, lambda: _jnp("minimum")),
+    (SUM, lambda: _jnp("add")),
+    (PROD, lambda: _jnp("multiply")),
+    (BAND, lambda: _jnp("bitwise_and")),
+    (BOR, lambda: _jnp("bitwise_or")),
+    (BXOR, lambda: _jnp("bitwise_xor")),
+    (LAND, lambda: _jnp_logical("and")),
+    (LOR, lambda: _jnp_logical("or")),
+    (LXOR, lambda: _jnp_logical("xor")),
+]:
+
+    def _make(lazy):
+        holder = {}
+
+        def fn(a, b):
+            if "f" not in holder:
+                holder["f"] = lazy()
+            return holder["f"](a, b)
+
+        return fn
+
+    _op._jnp_fn = _make(_lazy)
+
+
+PREDEFINED = {
+    op.name: op
+    for op in (
+        MAX,
+        MIN,
+        SUM,
+        PROD,
+        LAND,
+        BAND,
+        LOR,
+        BOR,
+        LXOR,
+        BXOR,
+        MAXLOC,
+        MINLOC,
+        REPLACE,
+        NO_OP,
+    )
+}
+
+
+def lookup(name: str) -> Op:
+    return PREDEFINED[name]
+
+
+def create_op(fn: Callable, *, commute: bool = True, name: str = "user_op") -> Op:
+    """MPI_Op_create: register a user combine fn(a, b) -> a ⊕ b.
+
+    The function must be traceable by JAX for the device path (it receives
+    jax arrays inside shard_map) and accept numpy arrays on the host path.
+    Non-commutative ops restrict the algorithm space exactly as the
+    reference's 0 == ompi_op_is_commute checks do.
+    """
+    op = Op(name, fn, fn, commute=commute, float_assoc=False)
+    op.is_user_defined = True
+    return op
+
+
+def op_reduce(op: Op, source, target, datatype: Datatype | None = None):
+    """ompi_op_reduce equivalent: target = source ⊕ target (elementwise)."""
+    if datatype is not None:
+        op.check_datatype(datatype)
+    return op(source, target)

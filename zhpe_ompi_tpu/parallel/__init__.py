@@ -1,0 +1,4 @@
+"""Mesh construction and sharding helpers (the wire-up plane)."""
+from . import mesh
+
+__all__ = ["mesh"]

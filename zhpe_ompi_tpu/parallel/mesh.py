@@ -1,0 +1,74 @@
+"""Device mesh construction — the wire-up plane.
+
+TPU-native replacement for the reference's runtime wire-up
+(``ompi_rte_init`` → PMIx modex, ``ompi/runtime/ompi_mpi_init.c:508,667-700``):
+on TPU there is no endpoint-address exchange to do — process identity and the
+device topology come from ``jax.distributed`` + the platform, and the "modex"
+is mesh construction.  ``jax.sharding.Mesh`` over ICI is the analog of the
+btl/ofi endpoint set; host-loopback CPU devices are the btl/self+sm analog
+(SURVEY.md §5 "Distributed communication backend").
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh
+
+from ..mca import output as mca_output
+from ..mca import var as mca_var
+
+_stream = mca_output.open_stream("rte")
+
+mca_var.register(
+    "rte_distributed_init",
+    False,
+    "Call jax.distributed.initialize() at init (multi-host/multi-process "
+    "deployments; the PMIx-client analog)",
+    type=bool,
+)
+
+
+def distributed_initialize(**kwargs) -> None:
+    """Multi-controller wire-up (PMIx_Init analog): join the JAX coordination
+    service.  No-op if already initialized."""
+    try:
+        jax.distributed.initialize(**kwargs)
+        mca_output.verbose(1, _stream, "jax.distributed initialized")
+    except RuntimeError as e:
+        if "already initialized" in str(e).lower():
+            mca_output.verbose(1, _stream, "jax.distributed: %s", e)
+        else:
+            # real wire-up failure (bad coordinator, unreachable service):
+            # failing loudly beats silently running at the wrong world size
+            raise
+
+
+def world_devices() -> list:
+    """All addressable devices in process order — the proc table analog."""
+    return list(jax.devices())
+
+
+def world_mesh(axis_name: str = "world", devices=None) -> Mesh:
+    """1-D mesh over every device: MPI_COMM_WORLD's footprint."""
+    devs = np.asarray(devices if devices is not None else world_devices())
+    return Mesh(devs, axis_names=(axis_name,))
+
+
+def make_mesh(axis_sizes: dict[str, int], devices=None) -> Mesh:
+    """N-D mesh, e.g. {'dp': 2, 'tp': 4}: the topo-framework analog
+    (cartesian topologies, ``ompi/mca/topo``) expressed the TPU way.
+
+    Uses jax's device-assignment heuristics so that, on real hardware, the
+    trailing axes land on the fastest ICI dimensions.
+    """
+    names = tuple(axis_sizes.keys())
+    shape = tuple(axis_sizes.values())
+    if devices is None:
+        try:
+            return jax.make_mesh(shape, names)
+        except (ValueError, RuntimeError):
+            devices = world_devices()
+    arr = np.asarray(devices).reshape(shape)
+    return Mesh(arr, axis_names=names)

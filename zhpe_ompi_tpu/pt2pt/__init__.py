@@ -1,0 +1,4 @@
+"""Point-to-point: SPMD-plane static patterns + host-plane matching."""
+from . import spmd
+
+__all__ = ["spmd"]

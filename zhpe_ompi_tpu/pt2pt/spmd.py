@@ -1,0 +1,92 @@
+"""SPMD-plane point-to-point primitives.
+
+The TPU-native answer to the reference's BTL send/recv
+(``opal/mca/btl/btl.h:901``): on an SPMD machine a *static communication
+pattern* is one XLA ``collective_permute`` riding ICI — there is no
+per-message matching, no eager/rendezvous split, no progress engine.  The
+dynamic-tag-matching MPI semantics live in the host plane
+(:mod:`zhpe_ompi_tpu.pt2pt.matching`); every collective algorithm in
+:mod:`zhpe_ompi_tpu.coll` bottoms out here, the way the reference's
+collectives bottom out in ``MCA_PML_CALL(send/recv)``
+(``coll_base_util.h:70-98``).
+
+All rank arguments are comm-relative; translation to mesh axis indices goes
+through the communicator's partition.  Patterns are instantiated per
+sub-group (a callable receives each group's size), so one XLA op carries the
+pattern for every sub-communicator of a split simultaneously.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Sequence
+
+import jax
+
+from ..core import errors
+
+PatternFn = Callable[[int], Sequence[tuple[int, int]]]
+
+
+def global_pairs(comm, pattern: Sequence[tuple[int, int]] | PatternFn
+                 ) -> list[tuple[int, int]]:
+    """Translate a comm-relative pattern to mesh-axis-index pairs across every
+    sub-group.  `pattern` is either an explicit pair list (applied to each
+    group; pairs exceeding a group's size are dropped) or a callable
+    ``group_size -> pairs`` for size-dependent patterns (ring wrap etc.)."""
+    out: list[tuple[int, int]] = []
+    seen_dst: set[int] = set()
+    for g in comm.partition:
+        pairs = pattern(g.size) if callable(pattern) else pattern
+        for s, d in pairs:
+            if s >= g.size or d >= g.size:
+                continue
+            gs, gd = g.ranks[s], g.ranks[d]
+            if gd in seen_dst:
+                raise errors.ArgError(
+                    f"duplicate destination {gd} in permute pattern"
+                )
+            seen_dst.add(gd)
+            out.append((gs, gd))
+    return out
+
+
+def ppermute(comm, x, pattern: Sequence[tuple[int, int]] | PatternFn):
+    """Collective permute with comm-relative static pattern.
+
+    Ranks that are not a destination receive zeros (XLA collective_permute
+    semantics — algorithms mask with ``jnp.where``).
+    """
+    return jax.lax.ppermute(x, comm.axis, perm=global_pairs(comm, pattern))
+
+
+def shift(comm, x, offset: int, wrap: bool = True):
+    """Send to (rank+offset) mod group_size — the ring primitive.
+
+    With ``wrap=False`` the ends don't exchange (MPI_PROC_NULL semantics of
+    MPI_Cart_shift with a non-periodic topology): falling-off ranks receive
+    zeros.
+    """
+
+    def pattern(n: int):
+        ps = []
+        for i in range(n):
+            j = i + offset
+            if wrap:
+                ps.append((i, j % n))
+            elif 0 <= j < n:
+                ps.append((i, j))
+        return ps
+
+    return ppermute(comm, x, pattern)
+
+
+def sendrecv_shift(comm, x, offset: int):
+    """ompi_coll_base_sendrecv analog for the uniform-shift pattern."""
+    return shift(comm, x, offset, wrap=True)
+
+
+def sendrecv(comm, x, dest_of: list[int]):
+    """Fully general static sendrecv: `dest_of[i]` is where comm rank i's
+    buffer goes (use -1 for "sends nowhere")."""
+    pairs = [(i, d) for i, d in enumerate(dest_of) if d >= 0]
+    return ppermute(comm, x, pairs)

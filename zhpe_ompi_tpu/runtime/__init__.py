@@ -1,0 +1,4 @@
+"""Runtime: init/finalize, performance counters."""
+from . import init, spc
+
+__all__ = ["init", "spc"]

@@ -1,0 +1,105 @@
+"""Runtime init/finalize — ``ompi_mpi_init`` re-designed for SPMD.
+
+The reference's init sequence (``ompi/runtime/ompi_mpi_init.c:384``, SURVEY.md
+§3.1) is: OPAL init → RTE/PMIx wire-up → open frameworks → select PML → modex
+→ build COMM_WORLD → add_procs → coll select.  The TPU-native sequence
+collapses the wire-up (the platform knows the topology) to:
+
+    init() → [jax.distributed.initialize if multi-process] → build world mesh
+           → open frameworks → construct COMM_WORLD / COMM_SELF
+
+There is no modex (no endpoint addresses to exchange), no add_procs (the mesh
+IS the proc table), and per-communicator coll selection is lazy.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from ..comm.communicator import Communicator
+from ..core import errors
+from ..mca import output as mca_output
+from ..mca import var as mca_var
+from ..parallel import mesh as mesh_mod
+from . import spc
+
+_stream = mca_output.open_stream("runtime")
+
+_global = {
+    "initialized": False,
+    "finalized": False,
+    "world": None,
+    "self": None,
+    "mesh": None,
+    "init_time": None,
+}
+_lock = threading.Lock()
+
+
+def initialized() -> bool:
+    return _global["initialized"]
+
+
+def init(devices=None, axis_name: str = "world",
+         distributed: bool | None = None) -> Communicator:
+    """MPI_Init analog; returns COMM_WORLD.  Idempotent."""
+    with _lock:
+        if _global["initialized"]:
+            return _global["world"]
+        t0 = time.perf_counter()
+        if distributed is None:
+            distributed = bool(mca_var.get("rte_distributed_init", False))
+        if distributed:
+            mesh_mod.distributed_initialize()
+        m = mesh_mod.world_mesh(axis_name=axis_name, devices=devices)
+        world = Communicator(m, axis_name, name="MPI_COMM_WORLD")
+        # COMM_SELF: every device its own group — the btl/self analog
+        from ..comm.group import Group
+
+        self_comm = Communicator(
+            m, axis_name,
+            partition=[Group([i]) for i in range(m.shape[axis_name])],
+            name="MPI_COMM_SELF",
+        )
+        _global.update(
+            initialized=True, finalized=False, world=world, self=self_comm,
+            mesh=m, init_time=time.perf_counter() - t0,
+        )
+        spc.record("init_count", 1)
+        mca_output.verbose(
+            1, _stream, "initialized: %d devices, %.1fms",
+            m.devices.size, _global["init_time"] * 1e3,
+        )
+        return world
+
+
+def world() -> Communicator:
+    if not _global["initialized"]:
+        raise errors.NotInitializedError()
+    return _global["world"]
+
+
+def comm_self() -> Communicator:
+    if not _global["initialized"]:
+        raise errors.NotInitializedError()
+    return _global["self"]
+
+
+def world_mesh():
+    if not _global["initialized"]:
+        raise errors.NotInitializedError()
+    return _global["mesh"]
+
+
+def finalize() -> None:
+    """MPI_Finalize analog."""
+    with _lock:
+        _global.update(
+            initialized=False, finalized=True, world=None, self=None,
+            mesh=None,
+        )
+
+
+def is_finalized() -> bool:
+    return _global["finalized"]

@@ -1,0 +1,44 @@
+"""Software performance counters (SPC).
+
+Re-design of ``ompi/runtime/ompi_spc.c`` (SURVEY.md §5): named monotonic
+counters recorded at API call sites, surfaced through the MPI_T-style
+introspection (zmpi-info) and resettable for tests/benchmarks.
+
+Semantics note for a traced runtime: counters record **host-side events** —
+under ``jit`` a collective is counted when traced (compiled), not per device
+execution.  Eager calls count per call.  This is the honest analog on a
+compile-once machine and is documented at the CLI.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import defaultdict
+
+_counters: dict[str, int] = defaultdict(int)
+_lock = threading.Lock()
+
+WATERMARK = {"max_bytes_in_collective"}
+
+
+def record(name: str, value: int = 1) -> None:
+    with _lock:
+        if name in WATERMARK:
+            _counters[name] = max(_counters[name], value)
+        else:
+            _counters[name] += value
+
+
+def read(name: str) -> int:
+    with _lock:
+        return _counters.get(name, 0)
+
+
+def snapshot() -> dict[str, int]:
+    with _lock:
+        return dict(_counters)
+
+
+def reset() -> None:
+    with _lock:
+        _counters.clear()
