@@ -1,0 +1,77 @@
+"""Observability: SPC counters, monitoring interposition, zmpi-info."""
+
+import numpy as np
+
+import zhpe_ompi_tpu as zmpi
+from zhpe_ompi_tpu.runtime import spc
+from zhpe_ompi_tpu.tools import info as zinfo
+
+
+class TestSPC:
+    def test_record_read_reset(self):
+        spc.reset()
+        spc.record("x", 3)
+        spc.record("x", 4)
+        assert spc.read("x") == 7
+        assert spc.snapshot()["x"] == 7
+        spc.reset()
+        assert spc.read("x") == 0
+
+    def test_watermark(self):
+        spc.reset()
+        spc.record("max_bytes_in_collective", 10)
+        spc.record("max_bytes_in_collective", 5)
+        assert spc.read("max_bytes_in_collective") == 10
+
+
+class TestMonitoring:
+    def test_interposition_counts(self):
+        import jax.numpy as jnp
+
+        world = zmpi.init()
+        spc.reset()
+        zmpi.mca_var.set_var("coll_monitoring_enable", True)
+        try:
+            comm = world.dup(name="moncomm")
+            x = np.ones((8, 4), np.float32)
+            comm.run(
+                lambda s: comm.allreduce(s, zmpi.SUM),
+                comm.device_put_sharded(jnp.asarray(x)),
+            )
+            snap = spc.snapshot()
+            assert snap["coll_allreduce_calls"] >= 1
+            assert snap["coll_allreduce_bytes"] >= 16
+            assert snap["comm_moncomm_coll_calls"] >= 1
+        finally:
+            zmpi.mca_var.unset("coll_monitoring_enable")
+
+    def test_disabled_by_default(self):
+        world = zmpi.init()
+        table = world.dup().coll
+        fn, _ = table["allreduce"]
+        assert not fn.__name__.startswith("monitored")
+
+
+class TestInfoCLI:
+    def test_gather(self):
+        data = zinfo.gather()
+        names = [f["framework"] for f in data["frameworks"]]
+        assert "coll" in names
+        pnames = [p["name"] for p in data["params"]]
+        assert "coll_tuned_allreduce_algorithm" in pnames
+
+    def test_prefix_filter(self):
+        data = zinfo.gather("pt2pt")
+        assert all(p["name"].startswith("pt2pt") for p in data["params"])
+        assert len(data["params"]) >= 1
+
+    def test_main_runs(self, capsys):
+        assert zinfo.main(["--components"]) == 0
+        out = capsys.readouterr().out
+        assert "tuned" in out and "priority" in out
+
+    def test_main_json(self, capsys):
+        import json
+
+        assert zinfo.main(["--json", "--pvars"]) == 0
+        json.loads(capsys.readouterr().out)

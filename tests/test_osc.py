@@ -1,0 +1,255 @@
+"""One-sided communication tests: host windows + SPMD device windows."""
+
+import numpy as np
+import pytest
+
+import zhpe_ompi_tpu as zmpi
+from zhpe_ompi_tpu.core import errors
+from zhpe_ompi_tpu.osc import DeviceWindow, HostWindow
+from zhpe_ompi_tpu.pt2pt.universe import LocalUniverse
+
+
+class TestHostWindow:
+    def test_put_get_fence(self):
+        uni = LocalUniverse(4)
+
+        def main(ctx):
+            buf = np.zeros(8, np.float32)
+            win = HostWindow.create(ctx, buf)
+            win.fence()
+            # everyone puts its rank into slot `rank` of rank 0's window
+            win.put(np.float32(ctx.rank + 1), target=0, offset=ctx.rank)
+            win.fence()
+            if ctx.rank == 0:
+                return buf[:4].tolist()
+            return None
+
+        assert uni.run(main)[0] == [1.0, 2.0, 3.0, 4.0]
+
+    def test_get(self):
+        uni = LocalUniverse(2)
+
+        def main(ctx):
+            buf = np.full(4, float(ctx.rank * 10), np.float32)
+            win = HostWindow.create(ctx, buf)
+            win.fence()
+            other = 1 - ctx.rank
+            got = win.get(other, offset=0, count=4)
+            win.fence()
+            return got.tolist()
+
+        res = uni.run(main)
+        assert res[0] == [10.0] * 4 and res[1] == [0.0] * 4
+
+    def test_accumulate_atomic(self):
+        """Concurrent accumulates from all ranks must not lose updates."""
+        uni = LocalUniverse(8)
+        iters = 50
+
+        def main(ctx):
+            buf = np.zeros(1, np.int64)
+            win = HostWindow.create(ctx, buf)
+            win.fence()
+            for _ in range(iters):
+                win.accumulate(np.int64(1), target=0, offset=0)
+            win.fence()
+            return int(buf[0])
+
+        res = uni.run(main)
+        assert res[0] == 8 * iters
+
+    def test_get_accumulate(self):
+        uni = LocalUniverse(4)
+
+        def main(ctx):
+            buf = np.zeros(1, np.int64)
+            win = HostWindow.create(ctx, buf)
+            win.fence()
+            old = win.get_accumulate(np.int64(1), target=0, offset=0)
+            win.fence()
+            return int(old[0])
+
+        res = uni.run(main)
+        assert sorted(res) == [0, 1, 2, 3]  # each saw a distinct pre-value
+
+    def test_compare_and_swap(self):
+        uni = LocalUniverse(4)
+
+        def main(ctx):
+            buf = np.zeros(1, np.int64)
+            win = HostWindow.create(ctx, buf)
+            win.fence()
+            old = win.compare_and_swap(ctx.rank + 1, compare=0, target=0)
+            win.fence()
+            winner = int(buf[0]) if ctx.rank == 0 else None
+            return (int(old), winner)
+
+        res = uni.run(main)
+        olds = [o for o, _ in res]
+        assert olds.count(0) == 1  # exactly one rank won the CAS
+        assert res[0][1] in (1, 2, 3, 4)
+
+    def test_lock_unlock(self):
+        uni = LocalUniverse(4)
+
+        def main(ctx):
+            buf = np.zeros(1, np.float64)
+            win = HostWindow.create(ctx, buf)
+            win.fence()
+            for _ in range(20):
+                win.lock(0)
+                v = win.get(0, 0, 1)[0]
+                win.put(np.float64(v + 1), 0, 0)
+                win.unlock(0)
+            win.fence()
+            return float(buf[0])
+
+        assert uni.run(main)[0] == 80.0
+
+    def test_pscw(self):
+        """Real PSCW semantics: wait_sync alone must block until every
+        origin's complete() — no auxiliary barrier."""
+        uni = LocalUniverse(3)
+
+        def main(ctx):
+            buf = np.zeros(4, np.float32)
+            win = HostWindow.create(ctx, buf)
+            if ctx.rank == 0:
+                win.post(origins=[1, 2])
+                win.wait_sync()
+                return buf[:2].tolist()
+            win.start([0])
+            win.put(np.float32(ctx.rank), target=0, offset=ctx.rank - 1)
+            win.complete()
+            return None
+
+        assert uni.run(main)[0] == [1.0, 2.0]
+
+    def test_pscw_two_epochs(self):
+        """Back-to-back epochs must not race (epoch counters, not events)."""
+        uni = LocalUniverse(2)
+
+        def main(ctx):
+            buf = np.zeros(1, np.float32)
+            win = HostWindow.create(ctx, buf)
+            out = []
+            for epoch in range(3):
+                if ctx.rank == 0:
+                    win.post(origins=[1])
+                    win.wait_sync()
+                    out.append(float(buf[0]))
+                else:
+                    win.start([0])
+                    win.put(np.float32(epoch + 1), target=0, offset=0)
+                    win.complete()
+            return out
+
+        assert uni.run(main)[0] == [1.0, 2.0, 3.0]
+
+    def test_noncontiguous_buffer_rejected(self):
+        """A strided view would make reshape(-1) a copy and RMA writes
+        vanish; create() must refuse it (before any communication, so both
+        ranks fail symmetrically with no deadlock)."""
+        uni = LocalUniverse(2)
+
+        def main(ctx):
+            big = np.zeros(8, np.float32)
+            with pytest.raises(errors.WinError):
+                HostWindow.create(ctx, big[::2])
+            return True
+
+        assert uni.run(main) == [True, True]
+
+    def test_free_releases_registry(self):
+        uni = LocalUniverse(2)
+
+        def main(ctx):
+            buf = np.zeros(2, np.float32)
+            win = HostWindow.create(ctx, buf)
+            win.fence()
+            key = (id(ctx.universe), win.win_id)
+            win.free()
+            return key in HostWindow._registries
+
+        assert uni.run(main) == [False, False]
+
+    def test_bounds_checked(self):
+        uni = LocalUniverse(2)
+
+        def main(ctx):
+            buf = np.zeros(2, np.float32)
+            win = HostWindow.create(ctx, buf)
+            win.fence()
+            err = None
+            if ctx.rank == 1:
+                try:
+                    win.put(np.zeros(8, np.float32), target=0, offset=0)
+                except errors.WinError as e:
+                    err = str(e)
+            win.fence()
+            return err
+
+        assert "overruns" in uni.run(main)[1]
+
+
+class TestDeviceWindow:
+    @pytest.fixture(scope="class")
+    def world(self):
+        return zmpi.init()
+
+    def test_put_ring(self, world):
+        """Halo-pattern: every rank puts its value into right neighbor."""
+        import jax.numpy as jnp
+
+        n = 8
+        x = np.arange(n, dtype=np.float32).reshape(n, 1)
+        target_of = [(i + 1) % n for i in range(n)]
+        offset_of = [0] * n
+
+        def body(s):
+            win = DeviceWindow(world, jnp.zeros(2, jnp.float32))
+            win = win.put(s.reshape(1), target_of, offset_of)
+            return win.shard.reshape(1, 2)
+
+        out = np.asarray(
+            world.run(body, world.device_put_sharded(jnp.asarray(x)))
+        ).reshape(n, 2)
+        np.testing.assert_allclose(out[:, 0], np.roll(np.arange(n), 1))
+
+    def test_get(self, world):
+        import jax.numpy as jnp
+
+        n = 8
+        x = (np.arange(n, dtype=np.float32) * 10).reshape(n, 1)
+        source_of = [(i + 1) % n for i in range(n)]  # read right neighbor
+        offset_of = [0] * n
+
+        def body(s):
+            win = DeviceWindow(world, s.reshape(1))
+            got = win.get(source_of, offset_of, count=1)
+            return got.reshape(1, 1)
+
+        out = np.asarray(
+            world.run(body, world.device_put_sharded(jnp.asarray(x)))
+        ).reshape(n)
+        np.testing.assert_allclose(out, np.roll(np.arange(n) * 10, -1))
+
+    def test_accumulate(self, world):
+        import jax.numpy as jnp
+
+        n = 8
+        x = np.ones((n, 1), np.float32)
+        target_of = [0, -1, -1, -1, -1, -1, -1, -1]  # only rank 0 self-put
+        # every rank accumulates into ITS OWN window from the put of its
+        # LEFT neighbor: use ring pattern
+        ring = [(i + 1) % n for i in range(n)]
+
+        def body(s):
+            win = DeviceWindow(world, jnp.full((1,), 100.0, jnp.float32))
+            win = win.accumulate(s.reshape(1), ring, [0] * n)
+            return win.shard.reshape(1, 1)
+
+        out = np.asarray(
+            world.run(body, world.device_put_sharded(jnp.asarray(x)))
+        ).reshape(n)
+        np.testing.assert_allclose(out, np.full(n, 101.0))

@@ -1,0 +1,265 @@
+"""Host-plane pt2pt: matching engine + thread-rank universe.
+
+Models the reference's test strategy: pure-host matching tests (the
+datatype-engine style), then runtime smoke tests shaped like test/simple's
+ring/hello programs (SURVEY.md §4).
+"""
+
+import numpy as np
+import pytest
+
+from zhpe_ompi_tpu.core import errors
+from zhpe_ompi_tpu.mca import var as mca_var
+from zhpe_ompi_tpu.pt2pt import matching, requests
+from zhpe_ompi_tpu.pt2pt.matching import ANY_SOURCE, ANY_TAG, Envelope
+from zhpe_ompi_tpu.pt2pt.universe import LocalUniverse
+
+
+class TestMatchingEngine:
+    def _collect(self):
+        got = []
+        return got, lambda env, p: got.append((env, p))
+
+    def test_posted_then_incoming(self):
+        eng = matching.MatchingEngine()
+        got, cb = self._collect()
+        eng.post_recv(0, 5, 0, cb)
+        eng.incoming(Envelope(0, 5, 0, 0), "hello")
+        assert got == [(Envelope(0, 5, 0, 0), "hello")]
+
+    def test_unexpected_then_posted(self):
+        eng = matching.MatchingEngine()
+        eng.incoming(Envelope(2, 9, 0, 0), "early")
+        got, cb = self._collect()
+        eng.post_recv(2, 9, 0, cb)
+        assert got[0][1] == "early"
+
+    def test_wildcards(self):
+        eng = matching.MatchingEngine()
+        got, cb = self._collect()
+        eng.post_recv(ANY_SOURCE, ANY_TAG, 0, cb)
+        eng.incoming(Envelope(3, 42, 0, 0), "x")
+        assert got[0][0].src == 3 and got[0][0].tag == 42
+
+    def test_tag_mismatch_parks(self):
+        eng = matching.MatchingEngine()
+        got, cb = self._collect()
+        eng.post_recv(0, 1, 0, cb)
+        eng.incoming(Envelope(0, 2, 0, 0), "wrong tag")
+        assert not got
+        assert eng.stats()["unexpected"] == 1
+
+    def test_comm_isolation(self):
+        eng = matching.MatchingEngine()
+        got, cb = self._collect()
+        eng.post_recv(ANY_SOURCE, ANY_TAG, cid=7, on_match=cb)
+        eng.incoming(Envelope(0, 0, 3, 0), "other comm")
+        assert not got
+
+    def test_ordering_same_source(self):
+        eng = matching.MatchingEngine()
+        eng.incoming(Envelope(0, 5, 0, 0), "first")
+        eng.incoming(Envelope(0, 5, 0, 1), "second")
+        got, cb = self._collect()
+        eng.post_recv(0, 5, 0, cb)
+        eng.post_recv(0, 5, 0, cb)
+        assert [p for _, p in got] == ["first", "second"]
+
+    def test_probe(self):
+        eng = matching.MatchingEngine()
+        assert eng.probe(ANY_SOURCE, ANY_TAG, 0) is None
+        eng.incoming(Envelope(1, 8, 0, 0), "peek me")
+        env = eng.probe(ANY_SOURCE, 8, 0)
+        assert env.src == 1
+        assert eng.stats()["unexpected"] == 1  # probe does not consume
+
+
+class TestUniverse:
+    def test_ring(self):
+        """examples/ring_c.c analog: token passes around 4 ranks."""
+        uni = LocalUniverse(4)
+
+        def main(ctx):
+            token = 10 if ctx.rank == 0 else None
+            if ctx.rank == 0:
+                ctx.send(token, dest=1, tag=0)
+                token = ctx.recv(source=3, tag=0)
+            else:
+                token = ctx.recv(source=ctx.rank - 1, tag=0)
+                ctx.send(token + 1, dest=(ctx.rank + 1) % 4, tag=0)
+            return token
+
+        results = uni.run(main)
+        assert results[0] == 13  # incremented by ranks 1..3
+
+    def test_any_source(self):
+        uni = LocalUniverse(3)
+
+        def main(ctx):
+            if ctx.rank == 0:
+                vals = sorted(
+                    ctx.recv(source=ANY_SOURCE, tag=1) for _ in range(2)
+                )
+                return vals
+            ctx.send(ctx.rank * 100, dest=0, tag=1)
+
+        assert uni.run(main)[0] == [100, 200]
+
+    def test_status_reports_source(self):
+        uni = LocalUniverse(2)
+
+        def main(ctx):
+            if ctx.rank == 0:
+                val, st = ctx.recv(source=ANY_SOURCE, tag=ANY_TAG,
+                                   return_status=True)
+                return (val, st.source, st.tag)
+            ctx.send("payload", dest=0, tag=9)
+
+        assert uni.run(main)[0] == ("payload", 1, 9)
+
+    def test_rendezvous_large_message(self, fresh_vars):
+        mca_var.set_var("pt2pt_eager_limit", 1024)
+        try:
+            uni = LocalUniverse(2)
+            big = np.arange(100_000, dtype=np.float32)
+
+            def main(ctx):
+                if ctx.rank == 0:
+                    req = ctx.isend(big, dest=1, tag=3)
+                    assert not req.done  # rendezvous: not yet matched
+                    req.wait()
+                    return "sent"
+                got = ctx.recv(source=0, tag=3)
+                return float(got.sum())
+
+            res = uni.run(main)
+            assert res[1] == float(big.sum())
+        finally:
+            mca_var.unset("pt2pt_eager_limit")
+
+    def test_eager_send_buffer_reuse(self):
+        """MPI contract: after a completed (eager) send, mutating the send
+        buffer must not corrupt the message."""
+        uni = LocalUniverse(2)
+
+        def main(ctx):
+            if ctx.rank == 0:
+                buf = np.ones(8, np.float32)
+                ctx.send(buf, dest=1, tag=0)
+                buf[:] = -1  # reuse immediately
+                return None
+            got = ctx.recv(source=0, tag=0)
+            return got.tolist()
+
+        assert uni.run(main)[1] == [1.0] * 8
+
+    def test_isend_irecv_waitall(self):
+        uni = LocalUniverse(2)
+
+        def main(ctx):
+            if ctx.rank == 0:
+                reqs = [ctx.isend(i, dest=1, tag=i) for i in range(5)]
+                requests.wait_all(reqs)
+                return None
+            reqs = [ctx.irecv(source=0, tag=i) for i in range(5)]
+            return requests.wait_all(reqs)
+
+        assert uni.run(main)[1] == list(range(5))
+
+    def test_probe_then_recv(self):
+        uni = LocalUniverse(2)
+
+        def main(ctx):
+            if ctx.rank == 0:
+                ctx.send("x", dest=1, tag=77)
+                return None
+            env = None
+            while env is None:
+                env = ctx.probe()
+            assert env.tag == 77
+            return ctx.recv(source=env.src, tag=env.tag)
+
+        assert uni.run(main)[1] == "x"
+
+    def test_sendrecv(self):
+        uni = LocalUniverse(2)
+
+        def main(ctx):
+            other = 1 - ctx.rank
+            return ctx.sendrecv(f"from{ctx.rank}", dest=other, source=other)
+
+        assert uni.run(main) == ["from1", "from0"]
+
+    def test_barrier(self):
+        uni = LocalUniverse(5)
+        order = []
+
+        def main(ctx):
+            ctx.barrier()
+            order.append(ctx.rank)
+            ctx.barrier()
+            return len(order)
+
+        res = uni.run(main)
+        assert all(r == 5 for r in res)  # all ranks passed barrier 1 first
+
+    def test_deadlock_detection(self):
+        uni = LocalUniverse(2)
+
+        def main(ctx):
+            return ctx.recv(source=1 - ctx.rank, tag=0)  # both block
+
+        with pytest.raises(errors.InternalError):
+            uni.run(main, timeout=0.5)
+
+    def test_rendezvous_buffer_reuse(self, fresh_vars):
+        """Regression: after a rendezvous send completes, mutating the send
+        buffer must not corrupt the in-flight message."""
+        mca_var.set_var("pt2pt_eager_limit", 64)
+        try:
+            uni = LocalUniverse(2)
+            import threading
+
+            gate = threading.Event()
+
+            def main(ctx):
+                if ctx.rank == 0:
+                    buf = np.ones(1000, np.float64)
+                    ctx.send(buf, dest=1, tag=0)
+                    buf[:] = -1  # reuse right after completion
+                    gate.set()
+                    return None
+                got = ctx.recv(source=0, tag=0)
+                gate.wait(5)  # sender has clobbered its buffer by now
+                # if the handoff aliased the sender's buffer, got is -1s
+                return float(got.sum())
+
+            assert uni.run(main)[1] == 1000.0
+        finally:
+            mca_var.unset("pt2pt_eager_limit")
+
+    def test_rndv_lookalike_payload_is_not_special(self):
+        """Regression: a user payload shaped like the old in-band sentinel
+        must be delivered verbatim, not trigger rendezvous handling."""
+        uni = LocalUniverse(2)
+
+        def main(ctx):
+            if ctx.rank == 0:
+                ctx.send(("__rndv__", 0, 0), dest=1, tag=1)
+                return None
+            return ctx.recv(source=0, tag=1)
+
+        assert uni.run(main)[1] == ("__rndv__", 0, 0)
+
+    def test_jax_array_payload(self):
+        import jax.numpy as jnp
+
+        uni = LocalUniverse(2)
+
+        def main(ctx):
+            if ctx.rank == 0:
+                ctx.send(jnp.arange(4.0), dest=1)
+                return None
+            return np.asarray(ctx.recv(source=0)).tolist()
+
+        assert uni.run(main)[1] == [0.0, 1.0, 2.0, 3.0]

@@ -89,4 +89,13 @@ def comm_select(comm) -> dict[str, tuple[Callable, str]]:
             if fn is not None:
                 table[opname] = (fn, comp.name)
                 break
+    # monitoring interposition (coll/monitoring analog): wrap the composed
+    # table so counters see every call regardless of which component won
+    from . import monitoring
+
+    if monitoring.enabled():
+        table = {
+            opname: (monitoring.wrap(opname, fn, comm.name), comp_name)
+            for opname, (fn, comp_name) in table.items()
+        }
     return table

@@ -1,0 +1,43 @@
+"""coll/monitoring — transparent collective interposition.
+
+Re-design of the reference's monitoring components (``ompi/mca/coll/
+monitoring``, ``ompi/mca/common/monitoring`` — SURVEY.md §5): when enabled,
+every collective call is counted (calls + payload bytes, per operation and
+per communicator) before delegating to the real implementation.  Counters
+land in the SPC store and are readable via zmpi-info or
+``spc.snapshot()`` (the MPI_T pvar surface).
+
+Counting semantics on a traced runtime: counts record *call sites executed
+by host code* — under jit a collective is counted once per trace, eagerly
+per call (documented in runtime/spc.py).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+from ..mca import var as mca_var
+from ..runtime import spc
+from ..utils.payload import payload_nbytes as _nbytes
+
+mca_var.register(
+    "coll_monitoring_enable", False,
+    "Interpose monitoring counters on every collective call",
+    type=bool,
+)
+
+
+def enabled() -> bool:
+    return bool(mca_var.get("coll_monitoring_enable", False))
+
+
+def wrap(opname: str, fn: Callable, comm_name: str) -> Callable:
+    def monitored(comm, x, *args, **kwargs):
+        nbytes = _nbytes(x)
+        spc.record(f"coll_{opname}_calls", 1)
+        spc.record(f"coll_{opname}_bytes", nbytes)
+        spc.record(f"comm_{comm_name}_coll_calls", 1)
+        return fn(comm, x, *args, **kwargs)
+
+    monitored.__name__ = f"monitored_{opname}"
+    return monitored

@@ -24,7 +24,6 @@ from __future__ import annotations
 
 import os
 
-import numpy as np
 
 from ..mca import output as mca_output
 from ..mca import var as mca_var
@@ -110,13 +109,7 @@ def _register_params():
     )
 
 
-def _nbytes(x) -> int:
-    import jax
-
-    leaves = jax.tree.leaves(x)
-    return sum(
-        int(np.prod(l.shape)) * np.dtype(l.dtype).itemsize for l in leaves
-    )
+from ..utils.payload import payload_nbytes as _nbytes  # noqa: E402
 
 
 _rules_cache: dict[str, list[tuple[str, int, int, str]]] = {}

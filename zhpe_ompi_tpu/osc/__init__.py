@@ -1,0 +1,5 @@
+"""One-sided communication: host-plane windows + SPMD device windows."""
+from .spmd_window import DeviceWindow
+from .window import LOCK_EXCLUSIVE, LOCK_SHARED, HostWindow
+
+__all__ = ["HostWindow", "DeviceWindow", "LOCK_SHARED", "LOCK_EXCLUSIVE"]

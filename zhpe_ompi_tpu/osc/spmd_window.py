@@ -1,0 +1,126 @@
+"""One-sided communication — SPMD device plane.
+
+The TPU-native RMA re-design: a window is each device's HBM-resident shard;
+an *epoch* of puts/gets is a static communication schedule that compiles to
+``ppermute`` + dynamic-update ops and executes as one fused XLA program.
+This is the schedule-compilation shape SURVEY.md §7 calls for (libnbc's
+round-schedule model applied to RMA): instead of the reference's per-op BTL
+descriptors retired by the progress engine (osc_rdma), the whole epoch is
+handed to the compiler.
+
+Functional-update semantics: device code is pure, so operations RETURN the
+updated window shard — ``fence`` closes the epoch by returning the new
+window state.  Targets/offsets are static per-rank schedules (lists indexed
+by comm rank), matching MPI's common statically-known RMA patterns (halo
+exchange, all-to-one counters).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from .. import ops as zops
+from ..core import errors
+from ..pt2pt import spmd
+
+
+class DeviceWindow:
+    """Window over one device's shard, used inside shard_map."""
+
+    def __init__(self, comm, shard):
+        self.comm = comm
+        self.shard = shard
+
+    def put(self, values, target_of: list[int], offset_of: list[int]
+            ) -> "DeviceWindow":
+        """Every rank r puts `values` (its local array) into window of
+        ``target_of[r]`` at element offset ``offset_of[r]`` (use -1 in
+        target_of for "no put from this rank").  Returns the updated window.
+        """
+        n = self.comm.size
+        if len(target_of) != n or len(offset_of) != n:
+            raise errors.ArgError(f"need {n} targets/offsets")
+        moved = spmd.sendrecv(self.comm, values, target_of)
+        rank = self.comm.rank()
+        # offset where THIS rank must deposit (as the target): find who
+        # targets me; if nobody, mask out
+        src_of = [-1] * n
+        for s, t in enumerate(target_of):
+            if t >= 0:
+                if src_of[t] >= 0:
+                    raise errors.ArgError(
+                        f"two ranks put to target {t} in one schedule"
+                    )
+                src_of[t] = s
+        is_target = jnp.asarray([1 if s >= 0 else 0 for s in src_of])[rank]
+        my_off = jnp.asarray(
+            [offset_of[s] if s >= 0 else 0 for s in src_of]
+        )[rank]
+        updated = lax.dynamic_update_slice(
+            self.shard.reshape(-1), moved.reshape(-1), (my_off,)
+        ).reshape(self.shard.shape)
+        new_shard = jnp.where(is_target == 1, updated, self.shard)
+        return DeviceWindow(self.comm, new_shard)
+
+    def get(self, source_of: list[int], offset_of: list[int], count: int):
+        """Every rank r reads `count` elements at ``offset_of[r]`` from the
+        window of ``source_of[r]``.  Two-sided under the hood (request is
+        static, so only the data ppermute remains): the source slices and
+        sends."""
+        n = self.comm.size
+        rank = self.comm.rank()
+        # as a source, which offset do I serve? (static schedule inversion)
+        serve_off = [0] * n
+        dest_of = [-1] * n
+        for r, s in enumerate(source_of):
+            if s >= 0:
+                if dest_of[s] >= 0:
+                    raise errors.ArgError(
+                        f"two ranks get from source {s} in one schedule"
+                    )
+                dest_of[s] = r
+                serve_off[s] = offset_of[r]
+        my_serve = jnp.asarray(serve_off)[rank]
+        sliced = lax.dynamic_slice(
+            self.shard.reshape(-1), (my_serve,), (count,)
+        )
+        return spmd.sendrecv(self.comm, sliced, dest_of)
+
+    def accumulate(self, values, target_of: list[int],
+                   offset_of: list[int], op: zops.Op = zops.SUM
+                   ) -> "DeviceWindow":
+        """MPI_Accumulate with a static schedule."""
+        n = self.comm.size
+        if len(target_of) != n or len(offset_of) != n:
+            raise errors.ArgError(f"need {n} targets/offsets")
+        moved = spmd.sendrecv(self.comm, values, target_of)
+        rank = self.comm.rank()
+        src_of = [-1] * n
+        for s, t in enumerate(target_of):
+            if t >= 0:
+                if src_of[t] >= 0:
+                    raise errors.ArgError(
+                        f"two ranks accumulate to target {t} in one schedule;"
+                        " split into multiple epochs"
+                    )
+                src_of[t] = s
+        is_target = jnp.asarray([1 if s >= 0 else 0 for s in src_of])[rank]
+        my_off = jnp.asarray(
+            [offset_of[s] if s >= 0 else 0 for s in src_of]
+        )[rank]
+        flat = self.shard.reshape(-1)
+        cur = lax.dynamic_slice(flat, (my_off,), (moved.reshape(-1).shape[0],))
+        updated = lax.dynamic_update_slice(
+            flat, op(moved.reshape(-1), cur), (my_off,)
+        ).reshape(self.shard.shape)
+        new_shard = jnp.where(is_target == 1, updated, self.shard)
+        return DeviceWindow(self.comm, new_shard)
+
+    def fence(self) -> "DeviceWindow":
+        """Epoch boundary: a barrier token sequences the schedule (XLA
+        already orders data dependencies; this is for MPI-shaped programs)."""
+        from ..coll import algorithms as alg
+
+        alg.barrier_dissemination(self.comm)
+        return self

@@ -1,0 +1,240 @@
+"""One-sided communication (RMA windows) — host plane.
+
+Re-design of ``ompi/mca/osc/rdma`` (SURVEY.md §2.3, §3.5): the reference
+drives BTL put/get/atomics directly against registered remote memory
+(``osc_rdma_comm.c:98,455,616``).  In the thread-rank universe, every rank's
+window buffer IS directly addressable — put/get are memory copies with no
+target-side involvement (the literal meaning of RDMA), and accumulate takes
+a per-target lock for atomicity (the btl_atomic_op analog).
+
+Synchronization epochs:
+- ``fence``   — active target, collective (MPI_Win_fence)
+- ``lock/unlock`` — passive target (MPI_Win_lock SHARED/EXCLUSIVE)
+- ``post/start/complete/wait_sync`` — PSCW generalized active target
+
+In-process visibility is immediate (stronger than MPI requires); the epoch
+calls still enforce the ordering contract so programs written against them
+stay correct on the multi-host transport.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any
+
+import numpy as np
+
+from .. import ops as zops
+from ..core import errors
+from ..runtime import spc
+
+LOCK_SHARED = 1
+LOCK_EXCLUSIVE = 2
+
+
+class _WinRegistry:
+    """Universe-level shared state for one window id."""
+
+    def __init__(self, size: int):
+        self.buffers: list[np.ndarray | None] = [None] * size
+        self.locks = [threading.RLock() for _ in range(size)]
+        # PSCW state: per-rank exposure epoch counter (incremented by post)
+        # and per-rank count of origins that called complete() this epoch
+        self.cond = threading.Condition()
+        self.post_epochs = [0] * size
+        self.completes = [0] * size
+
+
+class HostWindow:
+    """Per-rank handle to a collectively-created window."""
+
+    _registries: dict[tuple[int, int], _WinRegistry] = {}
+    _reg_lock = threading.Lock()
+    _next_id = [0]
+
+    @classmethod
+    def create(cls, ctx, local_buffer: np.ndarray) -> "HostWindow":
+        """MPI_Win_create: collective over the universe."""
+        if not isinstance(local_buffer, np.ndarray):
+            raise errors.WinError("window buffer must be a numpy array")
+        if not local_buffer.flags["C_CONTIGUOUS"]:
+            # reshape(-1) on a non-contiguous array returns a COPY; RMA
+            # writes would silently vanish
+            raise errors.WinError(
+                "window buffer must be C-contiguous (RMA writes go through "
+                "a flat view)"
+            )
+        # collective id agreement: rank 0 allocates, broadcasts over pt2pt
+        if ctx.rank == 0:
+            with cls._reg_lock:
+                win_id = cls._next_id[0]
+                cls._next_id[0] += 1
+                cls._registries[(id(ctx.universe), win_id)] = _WinRegistry(
+                    ctx.size
+                )
+            for r in range(1, ctx.size):
+                ctx.send(win_id, dest=r, tag=0x7FFE, cid=0x7FFE)
+        else:
+            win_id = ctx.recv(source=0, tag=0x7FFE, cid=0x7FFE)
+        reg = cls._registries[(id(ctx.universe), win_id)]
+        reg.buffers[ctx.rank] = local_buffer
+        ctx.barrier()
+        return cls(ctx, win_id, reg)
+
+    def __init__(self, ctx, win_id: int, reg: _WinRegistry):
+        self.ctx = ctx
+        self.win_id = win_id
+        self._reg = reg
+        self._held: dict[int, int] = {}
+        self._started: list[int] = []  # PSCW access-epoch targets
+        self._seen_post = [0] * ctx.size  # last observed exposure epoch
+        self._exposure_origins = 0  # origins expected this exposure epoch
+
+    # -- communication ---------------------------------------------------
+
+    def _target_buf(self, target: int) -> np.ndarray:
+        buf = self._reg.buffers[target]
+        if buf is None:
+            raise errors.WinError(f"rank {target} has no window buffer")
+        return buf
+
+    def put(self, data, target: int, offset: int = 0) -> None:
+        """MPI_Put: direct write into the target's window."""
+        data = np.asarray(data)
+        buf = self._target_buf(target)
+        flat = buf.reshape(-1)
+        n = data.size
+        if offset + n > flat.size:
+            raise errors.WinError(
+                f"put of {n} at {offset} overruns window of {flat.size}"
+            )
+        spc.record("osc_puts", 1)
+        spc.record("osc_bytes_put", int(data.nbytes))
+        flat[offset : offset + n] = data.reshape(-1).astype(flat.dtype)
+
+    def get(self, target: int, offset: int = 0, count: int | None = None
+            ) -> np.ndarray:
+        """MPI_Get: direct read of the target's window."""
+        buf = self._target_buf(target).reshape(-1)
+        count = buf.size - offset if count is None else count
+        if offset + count > buf.size:
+            raise errors.WinError("get overruns window")
+        spc.record("osc_gets", 1)
+        return buf[offset : offset + count].copy()
+
+    def accumulate(self, data, target: int, offset: int = 0,
+                   op: zops.Op = zops.SUM) -> None:
+        """MPI_Accumulate: atomic read-modify-write (btl_atomic_op analog:
+        per-target lock serializes concurrent accumulates)."""
+        data = np.asarray(data)
+        flat = self._target_buf(target).reshape(-1)
+        n = data.size
+        if offset + n > flat.size:
+            raise errors.WinError("accumulate overruns window")
+        with self._reg.locks[target]:
+            cur = flat[offset : offset + n]
+            flat[offset : offset + n] = op(
+                data.reshape(-1).astype(flat.dtype), cur
+            )
+
+    def get_accumulate(self, data, target: int, offset: int = 0,
+                       op: zops.Op = zops.SUM) -> np.ndarray:
+        """MPI_Get_accumulate: fetch-and-op."""
+        data = np.asarray(data)
+        flat = self._target_buf(target).reshape(-1)
+        n = data.size
+        with self._reg.locks[target]:
+            old = flat[offset : offset + n].copy()
+            flat[offset : offset + n] = op(
+                data.reshape(-1).astype(flat.dtype), old
+            )
+        return old
+
+    def compare_and_swap(self, value, compare, target: int, offset: int = 0):
+        """MPI_Compare_and_swap (single element)."""
+        flat = self._target_buf(target).reshape(-1)
+        with self._reg.locks[target]:
+            old = flat[offset].copy()
+            if old == compare:
+                flat[offset] = value
+        return old
+
+    # -- synchronization -------------------------------------------------
+
+    def fence(self) -> None:
+        """MPI_Win_fence: collective epoch boundary."""
+        self.ctx.barrier()
+
+    def lock(self, target: int, lock_type: int = LOCK_EXCLUSIVE) -> None:
+        """MPI_Win_lock (passive target).  Shared locks are modeled with the
+        same RLock (conservative: shared behaves exclusive)."""
+        self._reg.locks[target].acquire()
+        self._held[target] = self._held.get(target, 0) + 1
+
+    def unlock(self, target: int) -> None:
+        if not self._held.get(target):
+            raise errors.WinError(f"unlock of {target} without lock")
+        self._held[target] -= 1
+        self._reg.locks[target].release()
+
+    def flush(self, target: int | None = None) -> None:
+        """MPI_Win_flush: in-process operations are already visible."""
+
+    # PSCW generalized active target (MPI_Win_post/start/complete/wait)
+    def post(self, origins: list[int] | None = None) -> None:
+        """Open an exposure epoch for `origins` (default: all other ranks)."""
+        n_origins = (self.ctx.size - 1) if origins is None else len(origins)
+        reg = self._reg
+        with reg.cond:
+            reg.completes[self.ctx.rank] = 0
+            self._exposure_origins = n_origins
+            reg.post_epochs[self.ctx.rank] += 1
+            reg.cond.notify_all()
+
+    def start(self, targets: list[int], timeout: float = 10.0) -> None:
+        """Open an access epoch: wait for each target to post a NEW epoch
+        (epoch counters, so back-to-back epochs can't race)."""
+        reg = self._reg
+        with reg.cond:
+            for t in targets:
+                if not reg.cond.wait_for(
+                    lambda t=t: reg.post_epochs[t] > self._seen_post[t],
+                    timeout=timeout,
+                ):
+                    raise errors.WinError("start: target never posted")
+                self._seen_post[t] = reg.post_epochs[t]
+        self._started = list(targets)
+
+    def complete(self) -> None:
+        """Close the access epoch: notify every started target that this
+        origin's RMA operations are done."""
+        reg = self._reg
+        with reg.cond:
+            for t in self._started:
+                reg.completes[t] += 1
+            reg.cond.notify_all()
+        self._started = []
+
+    def wait_sync(self, timeout: float = 10.0) -> None:
+        """Close the exposure epoch: block until every expected origin has
+        called complete()."""
+        reg = self._reg
+        me = self.ctx.rank
+        with reg.cond:
+            if not reg.cond.wait_for(
+                lambda: reg.completes[me] >= self._exposure_origins,
+                timeout=timeout,
+            ):
+                raise errors.WinError("wait_sync: origins never completed")
+            reg.completes[me] = 0
+
+    def free(self) -> None:
+        """MPI_Win_free: collective; the registry entry is dropped so
+        buffers/locks don't leak for the process lifetime."""
+        self.ctx.barrier()
+        self._reg.buffers[self.ctx.rank] = None
+        self.ctx.barrier()
+        with HostWindow._reg_lock:
+            HostWindow._registries.pop(
+                (id(self.ctx.universe), self.win_id), None
+            )

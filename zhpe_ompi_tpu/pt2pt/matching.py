@@ -1,0 +1,102 @@
+"""Tag-matching engine — the receive-side heart of the PML.
+
+Re-design of ob1's matching logic (``pml_ob1_recvfrag.c:295-513``): posted
+receives are matched against incoming envelopes on (source, tag,
+communicator id), with MPI wildcards ANY_SOURCE / ANY_TAG and the standard
+ordering guarantee — messages from the same source match posted receives in
+arrival order (per-source FIFO via sequence numbers).
+
+Pure host logic with no transport dependency, unit-testable in isolation
+exactly like the reference's datatype engine tests (SURVEY.md §4) — the
+transport layer feeds :meth:`MatchingEngine.incoming`, the API layer calls
+:meth:`MatchingEngine.post_recv`.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+ANY_SOURCE = -1
+ANY_TAG = -1
+
+
+@dataclass
+class Envelope:
+    src: int
+    tag: int
+    cid: int
+    seq: int  # per-(src, cid) sequence number, assigned by the sender
+
+
+@dataclass
+class PostedRecv:
+    src: int  # may be ANY_SOURCE
+    tag: int  # may be ANY_TAG
+    cid: int
+    on_match: Callable[[Envelope, Any], None]
+
+    def matches(self, env: Envelope) -> bool:
+        if self.cid != env.cid:
+            return False
+        if self.src != ANY_SOURCE and self.src != env.src:
+            return False
+        if self.tag != ANY_TAG and self.tag != env.tag:
+            return False
+        return True
+
+
+class MatchingEngine:
+    """Per-rank matching state: posted-receive list + unexpected-message
+    queue (the two queues of pml_ob1_recvfrag.c:325,426)."""
+
+    def __init__(self) -> None:
+        self._posted: deque[PostedRecv] = deque()
+        self._unexpected: deque[tuple[Envelope, Any]] = deque()
+        self._lock = threading.Lock()
+
+    def post_recv(self, src: int, tag: int, cid: int,
+                  on_match: Callable[[Envelope, Any], None]) -> None:
+        """Post a receive; matches an unexpected message immediately if one
+        is waiting (ordered: earliest matching unexpected wins)."""
+        with self._lock:
+            posted = PostedRecv(src, tag, cid, on_match)
+            for i, (env, payload) in enumerate(self._unexpected):
+                if posted.matches(env):
+                    del self._unexpected[i]
+                    break
+            else:
+                self._posted.append(posted)
+                return
+        on_match(env, payload)
+
+    def incoming(self, env: Envelope, payload: Any) -> None:
+        """Deliver an arriving message: match the earliest posted receive or
+        park it on the unexpected queue."""
+        with self._lock:
+            for i, posted in enumerate(self._posted):
+                if posted.matches(env):
+                    del self._posted[i]
+                    break
+            else:
+                self._unexpected.append((env, payload))
+                return
+        posted.on_match(env, payload)
+
+    def probe(self, src: int, tag: int, cid: int) -> Envelope | None:
+        """MPI_Iprobe: peek the earliest matching unexpected envelope."""
+        probe_req = PostedRecv(src, tag, cid, lambda e, p: None)
+        with self._lock:
+            for env, _ in self._unexpected:
+                if probe_req.matches(env):
+                    return env
+        return None
+
+    def stats(self) -> dict[str, int]:
+        with self._lock:
+            return {
+                "posted": len(self._posted),
+                "unexpected": len(self._unexpected),
+            }

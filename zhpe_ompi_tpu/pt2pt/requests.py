@@ -1,0 +1,110 @@
+"""Request objects — ``ompi_request_t`` re-designed.
+
+The reference couples requests to the progress engine through wait_sync
+(``ompi/request/request.h:399-414``); here a request is a small state machine
+completed by transport callbacks, and ``wait`` drives the caller's progress
+loop (MPI weak-progress semantics: progress happens inside MPI calls).
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+from ..core import errors
+
+
+@dataclass
+class Status:
+    """MPI_Status analog."""
+
+    source: int = -1
+    tag: int = -1
+    error: int = 0
+    cancelled: bool = False
+
+
+class Request:
+    __slots__ = ("_done", "_value", "status", "_lock", "_progress", "_cancel_fn")
+
+    def __init__(self, progress: Callable[[], None] | None = None,
+                 cancel_fn: Callable[["Request"], bool] | None = None):
+        self._done = threading.Event()
+        self._value: Any = None
+        self.status = Status()
+        self._progress = progress
+        self._cancel_fn = cancel_fn
+
+    # -- completion (called by transports) -------------------------------
+
+    def complete(self, value: Any = None, source: int = -1, tag: int = -1
+                 ) -> None:
+        self._value = value
+        self.status.source = source
+        self.status.tag = tag
+        self._done.set()
+
+    # -- user side --------------------------------------------------------
+
+    @property
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    def test(self):
+        """MPI_Test: (flag, value-or-None); non-blocking, drives progress."""
+        if not self._done.is_set() and self._progress is not None:
+            self._progress()
+        if self._done.is_set():
+            return True, self._value
+        return False, None
+
+    def wait(self, timeout: float | None = None):
+        """MPI_Wait: drive progress until complete; returns the payload."""
+        import time
+
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while not self._done.is_set():
+            if self._progress is not None:
+                self._progress()
+            if self._done.is_set():
+                break
+            if deadline is not None and time.monotonic() > deadline:
+                raise errors.RequestError("wait timed out")
+            self._done.wait(0.0005)
+        return self._value
+
+    def cancel(self) -> bool:
+        """MPI_Cancel: succeeds only if the request hasn't matched yet."""
+        if self._done.is_set():
+            return False
+        if self._cancel_fn is not None and self._cancel_fn(self):
+            self.status.cancelled = True
+            self._done.set()
+            return True
+        return False
+
+
+def wait_all(requests, timeout: float | None = None):
+    """MPI_Waitall."""
+    return [r.wait(timeout) for r in requests]
+
+
+def wait_any(requests):
+    """MPI_Waitany: (index, value) of the first completed request."""
+    import time
+
+    while True:
+        for i, r in enumerate(requests):
+            flag, val = r.test()
+            if flag:
+                return i, val
+        time.sleep(0.0002)
+
+
+def test_all(requests):
+    """MPI_Testall."""
+    results = [r.test() for r in requests]
+    if all(f for f, _ in results):
+        return True, [v for _, v in results]
+    return False, None

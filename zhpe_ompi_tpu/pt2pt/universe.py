@@ -1,0 +1,246 @@
+"""Local universe: thread-ranks with full MPI pt2pt semantics.
+
+The host-plane counterpart of the SPMD device plane — the analog of running
+N ranks over btl/self + btl/sm on one node (SURVEY.md §4's
+"multi-node-without-a-cluster" mechanism).  Each rank is a thread with its
+own matching engine and mailbox; payloads stay by-reference inside the
+process (jax arrays are immutable and zero-copy; numpy eager payloads are
+copied to honor MPI's buffer-reuse contract).
+
+Protocol design mirrors ob1's eager/rendezvous split
+(``pml_ob1_sendreq.h:385-414``): messages up to ``pt2pt_eager_limit`` travel
+with their envelope and the send completes immediately (buffered); larger
+messages send an RTS, the payload is handed over only after the receiver
+matches and returns a CTS — so an un-matched large send correctly blocks and
+the sender's buffer stays live until delivery.  Within one process this is a
+protocol-shape choice (refs are free), but it keeps the semantics and the
+machinery honest for the multi-host TCP/DCN transport that reuses it.
+"""
+
+from __future__ import annotations
+
+import itertools
+import queue
+import threading
+from dataclasses import dataclass
+from typing import Any, Callable
+
+import numpy as np
+
+from ..core import errors
+from ..mca import var as mca_var
+from ..runtime import spc
+from . import matching
+from .matching import ANY_SOURCE, ANY_TAG, Envelope
+from .requests import Request, Status
+
+mca_var.register(
+    "pt2pt_eager_limit", 64 * 1024,
+    "Message size (bytes) up to which sends complete eagerly "
+    "(btl_eager_limit analog)",
+    type=int,
+)
+
+_EAGER = "eager"
+_RTS = "rts"
+_CTS = "cts"
+_DATA = "data"
+
+
+class _RndvToken:
+    """Out-of-band marker for a rendezvous announce sitting in the matching
+    engine — a private type so no user payload can be mistaken for it."""
+
+    __slots__ = ("sender_rank", "rndv_id")
+
+    def __init__(self, sender_rank: int, rndv_id: int):
+        self.sender_rank = sender_rank
+        self.rndv_id = rndv_id
+
+
+def _payload_nbytes(obj: Any) -> int:
+    if hasattr(obj, "nbytes"):
+        return int(obj.nbytes)
+    try:
+        return len(obj)
+    except TypeError:
+        return 64
+
+
+def _eager_copy(obj: Any) -> Any:
+    """Copy mutable buffers so the sender may reuse them immediately."""
+    if isinstance(obj, np.ndarray):
+        return obj.copy()
+    return obj  # jax arrays / immutables
+
+
+class RankContext:
+    """One rank's endpoint: the MPI API surface of the host plane."""
+
+    def __init__(self, universe: "LocalUniverse", rank: int):
+        self.universe = universe
+        self.rank = rank
+        self.size = universe.size
+        self.engine = matching.MatchingEngine()
+        self.mailbox: queue.Queue = queue.Queue()
+        self._seq = itertools.count()
+        self._pending_rndv: dict[int, tuple[Any, Request]] = {}
+        self._rndv_ids = itertools.count()
+        self._lock = threading.Lock()
+
+    # -- internals -------------------------------------------------------
+
+    def _mbox(self, dest: int) -> queue.Queue:
+        if not 0 <= dest < self.size:
+            raise errors.RankError(f"rank {dest} out of range")
+        return self.universe.contexts[dest].mailbox
+
+    def progress(self) -> None:
+        """Drain the mailbox (opal_progress analog, weak progress)."""
+        while True:
+            try:
+                kind, *rest = self.mailbox.get_nowait()
+            except queue.Empty:
+                return
+            if kind == _EAGER:
+                env, payload = rest
+                self.engine.incoming(env, payload)
+            elif kind == _RTS:
+                # rendezvous announce: enters matching with a token the
+                # receive-side callback turns into a CTS (irecv.on_match)
+                env, sender_rank, rndv_id = rest
+                self.engine.incoming(env, _RndvToken(sender_rank, rndv_id))
+            elif kind == _CTS:
+                rndv_id, dest_rank, req_token = rest
+                with self._lock:
+                    payload, sreq = self._pending_rndv.pop(rndv_id)
+                # copy at handoff: the send completes now, so the sender may
+                # reuse its buffer before the receiver drains the message
+                self._mbox(dest_rank).put((_DATA, req_token, _eager_copy(payload)))
+                sreq.complete()
+            elif kind == _DATA:
+                req_token, payload = rest
+                req_token(payload)
+
+    # -- sends -----------------------------------------------------------
+
+    def isend(self, obj: Any, dest: int, tag: int = 0, cid: int = 0
+              ) -> Request:
+        """MPI_Isend (cf. mca_pml_ob1_send's protocol switch,
+        pml_ob1_sendreq.h:385-414)."""
+        if tag < 0:
+            raise errors.TagError(f"negative tag {tag}")
+        env = Envelope(self.rank, tag, cid, next(self._seq))
+        nbytes = _payload_nbytes(obj)
+        spc.record("pt2pt_sends", 1)
+        spc.record("pt2pt_bytes_sent", nbytes)
+        eager_limit = int(mca_var.get("pt2pt_eager_limit", 64 * 1024))
+        req = Request(progress=self.progress)
+        if nbytes <= eager_limit:
+            self._mbox(dest).put((_EAGER, env, _eager_copy(obj)))
+            req.complete()
+        else:
+            rndv_id = next(self._rndv_ids)
+            with self._lock:
+                self._pending_rndv[rndv_id] = (obj, req)
+            self._mbox(dest).put((_RTS, env, self.rank, rndv_id))
+        return req
+
+    def send(self, obj: Any, dest: int, tag: int = 0, cid: int = 0) -> None:
+        """MPI_Send: blocking (completes when the buffer is reusable)."""
+        self.isend(obj, dest, tag, cid).wait()
+
+    # -- receives --------------------------------------------------------
+
+    def irecv(self, source: int = ANY_SOURCE, tag: int = ANY_TAG,
+              cid: int = 0) -> Request:
+        """MPI_Irecv."""
+        req = Request(progress=self.progress)
+
+        def on_match(env: Envelope, payload: Any) -> None:
+            if isinstance(payload, _RndvToken):
+                def deliver(data, env=env):
+                    req.complete(data, source=env.src, tag=env.tag)
+
+                self.universe.contexts[payload.sender_rank].mailbox.put(
+                    (_CTS, payload.rndv_id, self.rank, deliver)
+                )
+            else:
+                req.complete(payload, source=env.src, tag=env.tag)
+
+        self.engine.post_recv(source, tag, cid, on_match)
+        return req
+
+    def recv(self, source: int = ANY_SOURCE, tag: int = ANY_TAG,
+             cid: int = 0, return_status: bool = False):
+        """MPI_Recv."""
+        req = self.irecv(source, tag, cid)
+        value = req.wait()
+        if return_status:
+            return value, req.status
+        return value
+
+    def probe(self, source: int = ANY_SOURCE, tag: int = ANY_TAG,
+              cid: int = 0):
+        """MPI_Iprobe: non-blocking; returns an Envelope or None."""
+        self.progress()
+        return self.engine.probe(source, tag, cid)
+
+    def sendrecv(self, obj: Any, dest: int, source: int = ANY_SOURCE,
+                 sendtag: int = 0, recvtag: int = ANY_TAG, cid: int = 0):
+        """MPI_Sendrecv."""
+        rreq = self.irecv(source, recvtag, cid)
+        self.isend(obj, dest, sendtag, cid)
+        return rreq.wait()
+
+    def barrier(self) -> None:
+        """Host-plane dissemination barrier over send/recv."""
+        n = self.size
+        k = 1
+        while k < n:
+            dest = (self.rank + k) % n
+            src = (self.rank - k) % n
+            rreq = self.irecv(src, tag=0x7FFF - 1, cid=0x7FFF)
+            self.isend(b"", dest, tag=0x7FFF - 1, cid=0x7FFF)
+            rreq.wait()
+            k <<= 1
+
+
+class LocalUniverse:
+    """N thread-ranks on one host (btl/self+sm analog)."""
+
+    def __init__(self, size: int):
+        if size < 1:
+            raise errors.ArgError("size must be >= 1")
+        self.size = size
+        self.contexts = [RankContext(self, r) for r in range(size)]
+
+    def run(self, fn: Callable[[RankContext], Any], timeout: float = 60.0
+            ) -> list[Any]:
+        """SPMD-launch fn(ctx) on every rank thread; returns per-rank
+        results; re-raises the first rank exception."""
+        results: list[Any] = [None] * self.size
+        excs: list[BaseException | None] = [None] * self.size
+
+        def runner(r):
+            try:
+                results[r] = fn(self.contexts[r])
+            except BaseException as e:  # noqa: BLE001 - propagated below
+                excs[r] = e
+
+        threads = [
+            threading.Thread(target=runner, args=(r,), daemon=True)
+            for r in range(self.size)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout)
+            if t.is_alive():
+                raise errors.InternalError(
+                    "universe.run timed out (deadlock between ranks?)"
+                )
+        for e in excs:
+            if e is not None:
+                raise e
+        return results

@@ -1,0 +1,1 @@
+"""Introspection tooling (ompi_info analog)."""

@@ -1,0 +1,29 @@
+"""Shared payload helpers."""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def payload_nbytes(x) -> int:
+    """Total bytes of a pytree of arrays (defensive: shapeless or exotic
+    leaves count conservatively instead of raising — used by trace-time
+    decision and monitoring paths that must never fail a trace)."""
+    import jax
+
+    try:
+        leaves = jax.tree.leaves(x)
+    except Exception:
+        return 0
+    total = 0
+    for leaf in leaves:
+        try:
+            shape = getattr(leaf, "shape", None)
+            dtype = getattr(leaf, "dtype", None)
+            if shape is None or dtype is None:
+                total += 8
+            else:
+                total += int(np.prod(shape or (1,))) * np.dtype(dtype).itemsize
+        except Exception:
+            total += 8
+    return total
