@@ -239,9 +239,7 @@ class TestDeviceWindow:
 
         n = 8
         x = np.ones((n, 1), np.float32)
-        target_of = [0, -1, -1, -1, -1, -1, -1, -1]  # only rank 0 self-put
-        # every rank accumulates into ITS OWN window from the put of its
-        # LEFT neighbor: use ring pattern
+        # ring schedule: every rank accumulates into its right neighbor
         ring = [(i + 1) % n for i in range(n)]
 
         def body(s):

@@ -1,0 +1,153 @@
+"""sp/ep/pp parallelism built on framework primitives: exactness tests.
+
+Each strategy's multi-device output is compared against a single-device
+dense reference — the framework's answer to "long-context and distributed
+are first-class".
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+import zhpe_ompi_tpu as zmpi
+from zhpe_ompi_tpu.models import moe, pipeline, ring_attention
+
+N = 8
+
+
+@pytest.fixture(scope="module")
+def world():
+    return zmpi.init()
+
+
+class TestRingAttention:
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_matches_dense(self, world, causal):
+        B, S, H, D = 2, 32, 4, 16  # S sharded into 8 blocks of 4
+        r = np.random.default_rng(0)
+        q = jnp.asarray(r.normal(size=(B, S, H, D)), jnp.float32)
+        k = jnp.asarray(r.normal(size=(B, S, H, D)), jnp.float32)
+        v = jnp.asarray(r.normal(size=(B, S, H, D)), jnp.float32)
+
+        dense = ring_attention._block_attention_single(q, k, v, causal)
+
+        spec = P(None, "world")
+        sharding = NamedSharding(world.mesh, spec)
+        qs, ks, vs = (jax.device_put(t, sharding) for t in (q, k, v))
+        out = world.run(
+            lambda a, b, c: ring_attention.ring_attention(
+                world, a, b, c, causal=causal
+            ),
+            qs, ks, vs,
+            in_specs=(spec, spec, spec), out_specs=spec,
+        )
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(dense), rtol=2e-4, atol=2e-5
+        )
+
+    def test_long_sequence_jit(self, world):
+        """Longer-than-memory-naive sequence: 8 x 64 = 512 under jit."""
+        B, S, H, D = 1, 512, 2, 8
+        r = np.random.default_rng(1)
+        mk = lambda: jnp.asarray(r.normal(size=(B, S, H, D)), jnp.float32)
+        q, k, v = mk(), mk(), mk()
+        spec = P(None, "world")
+        sharding = NamedSharding(world.mesh, spec)
+        out = world.run(
+            lambda a, b, c: ring_attention.ring_attention(world, a, b, c),
+            *(jax.device_put(t, sharding) for t in (q, k, v)),
+            in_specs=(spec, spec, spec), out_specs=spec,
+        )
+        dense = ring_attention._block_attention_single(q, k, v, True)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(dense), rtol=2e-4, atol=2e-5
+        )
+
+
+class TestMoE:
+    def test_matches_dense_reference(self, world):
+        D, F, T_local = 16, 32, 8
+        params = moe.init_moe_params(jax.random.PRNGKey(0), D, F, N)
+        r = np.random.default_rng(2)
+        x_all = jnp.asarray(r.normal(size=(N * T_local, D)), jnp.float32)
+
+        # big capacity so nothing drops -> exact equivalence
+        spec_x = P("world")
+        px = jax.device_put(x_all, NamedSharding(world.mesh, spec_x))
+        param_specs = {
+            "router": P(),
+            "w_in": P("world"),
+            "w_out": P("world"),
+        }
+        pp = {
+            k: jax.device_put(v, NamedSharding(world.mesh, param_specs[k]))
+            for k, v in params.items()
+        }
+
+        def body(prm, xs):
+            y, keep = moe.moe_ffn(world, prm, xs, capacity_factor=float(N))
+            return y
+
+        out = world.run(
+            body, pp, px,
+            in_specs=(param_specs, spec_x), out_specs=spec_x,
+        )
+        ref = moe.moe_reference_dense(params, x_all, N, capacity=10**9)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-5
+        )
+
+    def test_capacity_drops_dont_crash(self, world):
+        D, F, T_local = 8, 16, 4
+        params = moe.init_moe_params(jax.random.PRNGKey(1), D, F, N)
+        r = np.random.default_rng(3)
+        x_all = jnp.asarray(r.normal(size=(N * T_local, D)), jnp.float32)
+        spec_x = P("world")
+        param_specs = {"router": P(), "w_in": P("world"), "w_out": P("world")}
+        pp = {
+            k: jax.device_put(v, NamedSharding(world.mesh, param_specs[k]))
+            for k, v in params.items()
+        }
+
+        def body(prm, xs):
+            y, keep = moe.moe_ffn(world, prm, xs, capacity_factor=0.5)
+            return y
+
+        out = world.run(
+            body, pp,
+            jax.device_put(x_all, NamedSharding(world.mesh, spec_x)),
+            in_specs=(param_specs, spec_x), out_specs=spec_x,
+        )
+        assert np.isfinite(np.asarray(out)).all()
+
+
+class TestPipeline:
+    def test_matches_sequential(self, world):
+        """8-stage pipeline of affine layers == sequential application."""
+        M, mb, D = 6, 3, 8
+        r = np.random.default_rng(4)
+        # stage s applies x -> x @ W_s + 1  (W per stage, sharded over pp)
+        Ws = jnp.asarray(r.normal(size=(N, D, D)) * 0.3, jnp.float32)
+        xs = jnp.asarray(r.normal(size=(M, mb, D)), jnp.float32)
+
+        def stage_fn(W, x):
+            return x @ W[0] + 1.0
+
+        spec_w = P("world")
+        out = world.run(
+            lambda W, x: pipeline.pipeline_apply(world, stage_fn, W, x),
+            jax.device_put(Ws, NamedSharding(world.mesh, spec_w)),
+            xs,
+            in_specs=(spec_w, P()), out_specs=P("world"),
+        )
+        # sequential reference
+        ref = xs
+        for s in range(N):
+            ref = ref @ Ws[s] + 1.0
+        # per-stage outputs are stacked along dim 0; results live on the
+        # LAST stage's block (other stages hold zeros)
+        out = np.asarray(out).reshape(N, M, mb, D)[N - 1]
+        np.testing.assert_allclose(out, np.asarray(ref), rtol=1e-4, atol=1e-5)

@@ -1,0 +1,100 @@
+"""Mixture-of-Experts layer — expert parallelism over framework alltoall.
+
+Expert parallelism (ep) is the MPI_Alltoall workload par excellence: tokens
+are routed to experts living on other devices, processed, and routed back.
+Both transposes go through the framework's ``comm.alltoall`` (XLA
+``all_to_all`` on ICI via the coll table, so `--mca coll` selection and
+monitoring interposition apply to the model's hot path).
+
+Design: top-1 switch routing with static capacity (compiler-friendly: no
+dynamic shapes).  Each device hosts one expert; tokens overflowing a
+device's capacity are dropped (standard switch-transformer semantics) and
+their outputs fall back to zero (residual carries them).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def init_moe_params(key, d_model: int, d_ff: int, n_experts: int):
+    k1, k2, k3 = jax.random.split(key, 3)
+    return {
+        "router": jax.random.normal(k1, (d_model, n_experts), jnp.float32)
+        * d_model**-0.5,
+        # per-device expert slice (shard over 'ep' axis at dim 0)
+        "w_in": jax.random.normal(k2, (n_experts, d_model, d_ff), jnp.float32)
+        * d_model**-0.5,
+        "w_out": jax.random.normal(k3, (n_experts, d_ff, d_model), jnp.float32)
+        * d_ff**-0.5,
+    }
+
+
+def moe_ffn(comm, params, x, capacity_factor: float = 1.25):
+    """Expert-parallel FFN: x is (T_local, D) tokens on this device; the
+    device holds expert weights w_in/w_out of shape (1, D, F)/(1, F, D)
+    (its shard of the expert dim).  Returns (T_local, D).
+    """
+    n = comm.size  # == number of experts
+    T, D = x.shape
+    cap = max(1, int(capacity_factor * T / n))
+
+    logits = x.astype(jnp.float32) @ params["router"]  # (T, E)
+    probs = jax.nn.softmax(logits, axis=-1)
+    expert = jnp.argmax(probs, axis=-1)  # (T,)
+    gate = jnp.take_along_axis(probs, expert[:, None], axis=1)[:, 0]
+
+    # position of each token within its expert's capacity buffer
+    onehot = jax.nn.one_hot(expert, n, dtype=jnp.int32)  # (T, E)
+    pos_in_expert = (jnp.cumsum(onehot, axis=0) - 1) * onehot  # (T, E)
+    pos = jnp.sum(pos_in_expert, axis=-1)  # (T,)
+    keep = pos < cap
+
+    # scatter tokens into (E, cap, D) dispatch buffer
+    buf = jnp.zeros((n, cap, D), x.dtype)
+    tok_idx = jnp.where(keep, expert * cap + pos, n * cap)  # overflow -> oob
+    buf = buf.reshape(n * cap, D).at[tok_idx].set(
+        jnp.where(keep[:, None], x, 0), mode="drop"
+    ).reshape(n, cap, D)
+
+    # ep transpose #1: every device sends expert-e's buffer to device e
+    dispatched = comm.alltoall(buf.reshape(n * cap, D))  # (n*cap, D)
+    dispatched = dispatched.reshape(n, cap, D)  # n source-device blocks
+
+    # local expert applies to all received tokens
+    w_in = params["w_in"][0]
+    w_out = params["w_out"][0]
+    h = jax.nn.gelu(dispatched.astype(jnp.float32) @ w_in)
+    out = (h @ w_out).astype(x.dtype)  # (n, cap, D)
+
+    # ep transpose #2: route results back to their source devices
+    returned = comm.alltoall(out.reshape(n * cap, D)).reshape(n, cap, D)
+
+    # gather back into token order; dropped tokens get zeros
+    flat = returned.reshape(n * cap, D)
+    y = jnp.where(
+        keep[:, None],
+        jnp.take(flat, jnp.clip(tok_idx, 0, n * cap - 1), axis=0),
+        0.0,
+    )
+    return (y * gate[:, None].astype(y.dtype)), keep
+
+
+def moe_reference_dense(params, x_all, n_experts: int, capacity: int):
+    """Single-device reference for tests: same routing/capacity semantics,
+    no communication."""
+    T, D = x_all.shape
+    logits = x_all.astype(jnp.float32) @ params["router"]
+    probs = jax.nn.softmax(logits, axis=-1)
+    expert = jnp.argmax(probs, axis=-1)
+    gate = jnp.take_along_axis(probs, expert[:, None], axis=1)[:, 0]
+    out = jnp.zeros((T, D), jnp.float32)
+    for e in range(n_experts):
+        w_in = params["w_in"][e]
+        w_out = params["w_out"][e]
+        h = jax.nn.gelu(x_all.astype(jnp.float32) @ w_in)
+        y = h @ w_out
+        out = jnp.where((expert == e)[:, None], y, out)
+    return out * gate[:, None]

@@ -1,0 +1,95 @@
+"""Ring attention — sequence/context parallelism over the framework's ring.
+
+Long-context support (first-class per the design brief): Q/K/V are sharded
+over the sequence on the 'sp' mesh axis; each step computes one block of the
+attention matrix with the MXU while the K/V blocks rotate one hop around the
+ICI ring via the framework's ``comm.shift`` (a single ``collective_permute``
+per step, overlappable with the block matmul by XLA's scheduler).
+
+Numerics are the flash-attention online-softmax recurrence (running max,
+running denominator, rescaled accumulator) in float32, so arbitrarily long
+sequences never materialize an (S, S) matrix — memory is O(S_local^2) per
+step and exact (not approximate).
+
+The structural analog in the reference is large-message segmentation &
+pipelining — segmented ring allreduce (``coll_base_allreduce.c:618``),
+pipelined trees (``coll_base_bcast.c:273``) — SURVEY.md §5 "long-context";
+ring attention is the same ring-segment idea applied to the attention
+operator itself.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def ring_attention(comm, q, k, v, causal: bool = True):
+    """Exact attention over a sequence sharded on `comm`'s axis.
+
+    q, k, v: (B, S_local, H, D) — this device's sequence block.
+    Returns (B, S_local, H, D).  Must run inside shard_map over comm's mesh.
+    """
+    n = comm.size
+    if n == 1:
+        return _block_attention_single(q, k, v, causal)
+    rank = comm.rank()
+    B, S, H, D = q.shape
+    scale = D ** -0.5
+    qf = q.astype(jnp.float32) * scale
+
+    m0 = jnp.full((B, H, S), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((B, H, S), jnp.float32)
+    acc0 = jnp.zeros((B, S, H, D), jnp.float32)
+    q_pos = rank * S + jnp.arange(S)
+
+    def step(carry, i):
+        m, l, acc, kb, vb = carry
+        src = (rank - i) % n  # whose K/V block we hold this step
+        scores = jnp.einsum(
+            "bshd,bthd->bhst", qf, kb.astype(jnp.float32)
+        )  # (B,H,Sq,Sk)
+        if causal:
+            k_pos = src * S + jnp.arange(S)
+            mask = q_pos[:, None] >= k_pos[None, :]
+            scores = jnp.where(mask[None, None], scores, -jnp.inf)
+        blk_max = jnp.max(scores, axis=-1)  # (B,H,Sq)
+        new_m = jnp.maximum(m, blk_max)
+        # guard fully-masked rows: exp(-inf - -inf) -> use where
+        safe_m = jnp.where(jnp.isfinite(new_m), new_m, 0.0)
+        corr = jnp.where(
+            jnp.isfinite(m), jnp.exp(m - safe_m), 0.0
+        )
+        p = jnp.exp(scores - safe_m[..., None])
+        p = jnp.where(jnp.isfinite(scores), p, 0.0)
+        l = l * corr + jnp.sum(p, axis=-1)
+        pv = jnp.einsum("bhst,bthd->bshd", p, vb.astype(jnp.float32))
+        acc = acc * corr.transpose(0, 2, 1)[..., None] + pv
+        # rotate K/V one hop around the ring (framework ppermute)
+        kb = comm.shift(kb, 1)
+        vb = comm.shift(vb, 1)
+        return (new_m, l, acc, kb, vb), None
+
+    # lax.scan (not fori_loop): reverse-mode AD needs a scan so training
+    # can differentiate through the ring
+    (m, l, acc, _, _), _ = lax.scan(
+        step, (m0, l0, acc0, k, v), jnp.arange(n)
+    )
+    denom = jnp.maximum(l, 1e-30).transpose(0, 2, 1)[..., None]
+    return (acc / denom).astype(q.dtype)
+
+
+def _block_attention_single(q, k, v, causal):
+    B, S, H, D = q.shape
+    scores = jnp.einsum(
+        "bshd,bthd->bhst", q.astype(jnp.float32) * D**-0.5,
+        k.astype(jnp.float32),
+    )
+    if causal:
+        mask = jnp.tril(jnp.ones((S, S), bool))
+        scores = jnp.where(mask[None, None], scores, -1e30)
+    w = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum(
+        "bhst,bthd->bshd", w, v.astype(jnp.float32)
+    ).astype(q.dtype)

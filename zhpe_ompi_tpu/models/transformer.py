@@ -92,22 +92,27 @@ def _attn(q, k, v, causal=True):
     return jnp.einsum("bhst,bthd->bshd", w, v)
 
 
-def forward(params: dict, tokens, cfg: Config, tp_comm=None):
+def forward(params: dict, tokens, cfg: Config, tp_comm=None, sp_comm=None):
     """Forward pass on one device's shard.
 
     `tp_comm` is a framework communicator over the 'tp' axis (or None for no
     tensor parallelism).  Heads and ffn-hidden arrive pre-sharded: wqkv is
-    (L, D, 3H/tp), wo is (L, H/tp, D), w1 (L, D, F/tp), w2 (L, F/tp, D).
+    (L, D, 3, H/tp), wo is (L, H/tp, D), w1 (L, D, F/tp), w2 (L, F/tp, D).
     After wo and w2 the partial products are summed with tp_comm.allreduce —
     the framework's MPI_Allreduce on the hot path.
+
+    `sp_comm` (sequence parallel / long context): tokens arrive sequence-
+    sharded over the 'sp' axis and attention runs as ring attention over
+    the framework's ppermute ring (models/ring_attention.py).
     """
     dtype = cfg.dtype
-    x = params["embed"].astype(dtype)[tokens]  # (B, S, D)
+    x = params["embed"].astype(dtype)[tokens]  # (B, S_local, D)
     B, S, D = x.shape
     hd = D // cfg.n_heads
     n_heads_local = params["wqkv"].shape[-1] // hd
 
     from ..parallel.grad import f_identity, g_allreduce
+    from .ring_attention import ring_attention
 
     def block(x, layer):
         wqkv, wo, w1, w2, g1, g2 = layer
@@ -118,7 +123,11 @@ def forward(params: dict, tokens, cfg: Config, tp_comm=None):
         q = qkv[:, :, 0].reshape(B, S, n_heads_local, hd)
         k = qkv[:, :, 1].reshape(B, S, n_heads_local, hd)
         v = qkv[:, :, 2].reshape(B, S, n_heads_local, hd)
-        o = _attn(q, k, v).reshape(B, S, -1)
+        if sp_comm is not None:
+            o = ring_attention(sp_comm, q, k, v, causal=True)
+            o = o.reshape(B, S, -1)
+        else:
+            o = _attn(q, k, v).reshape(B, S, -1)
         o = jnp.einsum("bse,ed->bsd", o, wo.astype(dtype))
         if tp_comm is not None:
             o = g_allreduce(tp_comm, o)
@@ -148,37 +157,42 @@ def forward(params: dict, tokens, cfg: Config, tp_comm=None):
     return logits
 
 
-def loss_fn(params, tokens, targets, cfg: Config, tp_comm=None):
-    logits = forward(params, tokens, cfg, tp_comm)
+def loss_fn(params, tokens, targets, cfg: Config, tp_comm=None, sp_comm=None):
+    logits = forward(params, tokens, cfg, tp_comm, sp_comm)
     logp = jax.nn.log_softmax(logits, axis=-1)
     ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)
     return -jnp.mean(ll)
 
 
-def make_train_step(cfg: Config, mesh, dp_comm, tp_comm, lr: float = 1e-2):
-    """Build the jitted SPMD training step.
+def make_train_step(cfg: Config, mesh, dp_comm, tp_comm, sp_comm=None,
+                    lr: float = 1e-2):
+    """Build the jitted SPMD training step over dp x tp (x sp).
 
     Gradient synchronization semantics (verified in tests against a
     single-device run):
       - tp-sharded params (wqkv/wo/w1/w2): their grads are tp-local already;
         average over dp only.
-      - replicated params (embed/ln): the backward of the forward tp
-        allreduce (psum) makes each tp rank hold the FULL gradient already
-        summed over tp contributions; averaging over (dp, tp) with a divide
-        by dp restores the correct value when combined with a tp-mean.
+      - replicated-over-tp params (embed/ln): with the f/g wrappers each tp
+        rank holds the full tp-summed gradient; a tp-mean makes the update
+        bitwise-identical across tp ranks.
+      - sp: every rank sees only its sequence block, so EVERY param's grad
+        is partial over sp — sp-mean them all (the global loss is a mean
+        over tokens, and dp-mean x sp-mean composes to the global mean).
     All syncs go through the framework's allreduce.
     """
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     dp = mesh.shape[dp_comm.axis]
     tp = mesh.shape[tp_comm.axis] if tp_comm is not None else 1
+    sp = mesh.shape[sp_comm.axis] if sp_comm is not None else 1
 
+    tp_ax = tp_comm.axis if tp_comm is not None else None
     param_specs = {
         "embed": P(), "lnf": P(),
-        "wqkv": P(None, None, None, tp_comm.axis if tp_comm else None),
-        "wo": P(None, tp_comm.axis if tp_comm else None, None),
-        "w1": P(None, None, tp_comm.axis if tp_comm else None),
-        "w2": P(None, tp_comm.axis if tp_comm else None, None),
+        "wqkv": P(None, None, None, tp_ax),
+        "wo": P(None, tp_ax, None),
+        "w1": P(None, None, tp_ax),
+        "w2": P(None, tp_ax, None),
         "ln1": P(), "ln2": P(),
     }
 
@@ -186,18 +200,20 @@ def make_train_step(cfg: Config, mesh, dp_comm, tp_comm, lr: float = 1e-2):
 
     def spmd_step(params, tokens, targets):
         def local_loss(p):
-            return loss_fn(p, tokens, targets, cfg, tp_comm)
+            return loss_fn(p, tokens, targets, cfg, tp_comm, sp_comm)
 
         loss, grads = jax.value_and_grad(local_loss)(params)
         synced = {}
         for name, g in grads.items():
             g = dp_comm.allreduce(g, zops.SUM) / dp
+            if sp_comm is not None:
+                g = sp_comm.allreduce(g, zops.SUM) / sp
             if name in replicated and tp_comm is not None:
-                # each tp rank already holds the tp-summed grad; make the
-                # replicated update bitwise-identical across tp ranks
                 g = tp_comm.allreduce(g, zops.SUM) / tp
             synced[name] = g
         loss = dp_comm.allreduce(loss, zops.SUM) / dp
+        if sp_comm is not None:
+            loss = sp_comm.allreduce(loss, zops.SUM) / sp
         if tp_comm is not None:
             loss = tp_comm.allreduce(loss, zops.SUM) / tp
         new_params = jax.tree.map(
@@ -205,7 +221,8 @@ def make_train_step(cfg: Config, mesh, dp_comm, tp_comm, lr: float = 1e-2):
         )
         return new_params, loss
 
-    data_spec = P(dp_comm.axis)
+    sp_ax = sp_comm.axis if sp_comm is not None else None
+    data_spec = P(dp_comm.axis, sp_ax)
     step = jax.jit(
         jax.shard_map(
             spmd_step,

@@ -41,6 +41,14 @@ class DeviceWindow:
         n = self.comm.size
         if len(target_of) != n or len(offset_of) != n:
             raise errors.ArgError(f"need {n} targets/offsets")
+        win_elems = int(self.shard.size)
+        val_elems = int(values.size)
+        for r, (t, off) in enumerate(zip(target_of, offset_of)):
+            if t >= 0 and off + val_elems > win_elems:
+                raise errors.WinError(
+                    f"put from rank {r}: {val_elems} elems at offset {off} "
+                    f"overruns window of {win_elems}"
+                )
         moved = spmd.sendrecv(self.comm, values, target_of)
         rank = self.comm.rank()
         # offset where THIS rank must deposit (as the target): find who
@@ -69,6 +77,15 @@ class DeviceWindow:
         static, so only the data ppermute remains): the source slices and
         sends."""
         n = self.comm.size
+        if len(source_of) != n or len(offset_of) != n:
+            raise errors.ArgError(f"need {n} sources/offsets")
+        win_elems = int(self.shard.size)
+        for r, (s, off) in enumerate(zip(source_of, offset_of)):
+            if s >= 0 and off + count > win_elems:
+                raise errors.WinError(
+                    f"get by rank {r}: {count} elems at offset {off} "
+                    f"overruns window of {win_elems}"
+                )
         rank = self.comm.rank()
         # as a source, which offset do I serve? (static schedule inversion)
         serve_off = [0] * n
@@ -94,6 +111,14 @@ class DeviceWindow:
         n = self.comm.size
         if len(target_of) != n or len(offset_of) != n:
             raise errors.ArgError(f"need {n} targets/offsets")
+        win_elems = int(self.shard.size)
+        val_elems = int(values.size)
+        for r, (t, off) in enumerate(zip(target_of, offset_of)):
+            if t >= 0 and off + val_elems > win_elems:
+                raise errors.WinError(
+                    f"accumulate from rank {r}: {val_elems} elems at offset "
+                    f"{off} overruns window of {win_elems}"
+                )
         moved = spmd.sendrecv(self.comm, values, target_of)
         rank = self.comm.rank()
         src_of = [-1] * n
@@ -118,9 +143,12 @@ class DeviceWindow:
         return DeviceWindow(self.comm, new_shard)
 
     def fence(self) -> "DeviceWindow":
-        """Epoch boundary: a barrier token sequences the schedule (XLA
-        already orders data dependencies; this is for MPI-shaped programs)."""
+        """Epoch boundary: the barrier token is folded into the window state
+        (added as zero) so XLA cannot dead-code-eliminate the collective —
+        the returned window's shard carries a data dependency on every
+        rank's arrival."""
         from ..coll import algorithms as alg
 
-        alg.barrier_dissemination(self.comm)
-        return self
+        token = alg.barrier_dissemination(self.comm)
+        fenced = self.shard + token.astype(self.shard.dtype)
+        return DeviceWindow(self.comm, fenced)

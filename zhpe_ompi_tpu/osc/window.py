@@ -104,7 +104,7 @@ class HostWindow:
         buf = self._target_buf(target)
         flat = buf.reshape(-1)
         n = data.size
-        if offset + n > flat.size:
+        if offset < 0 or offset + n > flat.size:
             raise errors.WinError(
                 f"put of {n} at {offset} overruns window of {flat.size}"
             )
@@ -117,7 +117,7 @@ class HostWindow:
         """MPI_Get: direct read of the target's window."""
         buf = self._target_buf(target).reshape(-1)
         count = buf.size - offset if count is None else count
-        if offset + count > buf.size:
+        if offset < 0 or offset + count > buf.size:
             raise errors.WinError("get overruns window")
         spc.record("osc_gets", 1)
         return buf[offset : offset + count].copy()
@@ -129,7 +129,7 @@ class HostWindow:
         data = np.asarray(data)
         flat = self._target_buf(target).reshape(-1)
         n = data.size
-        if offset + n > flat.size:
+        if offset < 0 or offset + n > flat.size:
             raise errors.WinError("accumulate overruns window")
         with self._reg.locks[target]:
             cur = flat[offset : offset + n]
@@ -143,6 +143,11 @@ class HostWindow:
         data = np.asarray(data)
         flat = self._target_buf(target).reshape(-1)
         n = data.size
+        if offset < 0 or offset + n > flat.size:
+            raise errors.WinError(
+                f"get_accumulate of {n} at {offset} overruns window of "
+                f"{flat.size}"
+            )
         with self._reg.locks[target]:
             old = flat[offset : offset + n].copy()
             flat[offset : offset + n] = op(
@@ -153,6 +158,11 @@ class HostWindow:
     def compare_and_swap(self, value, compare, target: int, offset: int = 0):
         """MPI_Compare_and_swap (single element)."""
         flat = self._target_buf(target).reshape(-1)
+        if not 0 <= offset < flat.size:
+            raise errors.WinError(
+                f"compare_and_swap offset {offset} outside window of "
+                f"{flat.size}"
+            )
         with self._reg.locks[target]:
             old = flat[offset].copy()
             if old == compare:
