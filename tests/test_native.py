@@ -1,0 +1,188 @@
+"""Native (C++) kernel layer: build, and parity with the pure-Python paths.
+
+Mirrors the reference's approach of testing the datatype engine without any
+network (test/datatype/ddt_pack.c, position.c, unpack_ooo.c) — here
+additionally cross-checking the C++ kernels against the numpy reference
+implementations.
+"""
+
+import numpy as np
+import pytest
+
+from zhpe_ompi_tpu import native, ops
+from zhpe_ompi_tpu.datatype import convertor, derived, predefined
+from zhpe_ompi_tpu.pt2pt import matching
+
+
+def test_native_builds():
+    assert native.available(), f"native build failed: {native.build_error}"
+    assert native.load().zompi_abi_version() == 1
+
+
+@pytest.fixture
+def vector_type():
+    # 5 blocks of 3 float64s strided 7 elements apart
+    return derived.create_vector(5, 3, 7, predefined.DOUBLE)
+
+
+def _numpy_pack(buffer, datatype, count):
+    view = buffer.reshape(-1).view(np.uint8)
+    return view[convertor.byte_index_map(datatype, count)]
+
+
+def test_pack_matches_numpy(vector_type):
+    src = np.arange(7 * 5 * 4, dtype=np.float64)
+    packed = convertor.pack(src, vector_type, 4)
+    assert bytes(packed) == bytes(_numpy_pack(src, vector_type, 4))
+
+
+def test_pack_unpack_roundtrip_struct():
+    t = derived.create_struct(
+        [2, 3], [0, 32], [predefined.INT32_T, predefined.DOUBLE]
+    )
+    count = 9
+    src = np.random.default_rng(0).integers(
+        0, 255, convertor.span_bytes(t, count), dtype=np.uint8
+    ).astype(np.uint8)
+    packed = convertor.pack(src, t, count)
+    assert packed.nbytes == t.size * count
+    out = convertor.unpack(packed, t, count)
+    repacked = convertor.pack(out, t, count)
+    assert bytes(repacked) == bytes(packed)
+
+
+def test_pack_partial_native_matches_full(vector_type):
+    src = np.arange(7 * 5 * 6, dtype=np.float64)
+    full = convertor.pack(src, vector_type, 6)
+    pos, chunks = 0, []
+    # odd chunk size to split segment boundaries
+    while pos < full.nbytes:
+        chunk, pos = convertor.pack_partial(src, vector_type, 6, pos, 37)
+        chunks.append(chunk)
+    assert bytes(np.concatenate(chunks)) == bytes(full)
+
+
+def test_unpack_partial_out_of_order(vector_type):
+    count = 6
+    src = np.arange(7 * 5 * count, dtype=np.float64)
+    full = convertor.pack(src, vector_type, count)
+    dest = np.zeros(convertor.span_bytes(vector_type, count), np.uint8)
+    # deliver chunks in reverse order
+    bounds = list(range(0, full.nbytes, 41)) + [full.nbytes]
+    spans = list(zip(bounds[:-1], bounds[1:]))
+    for lo, hi in reversed(spans):
+        convertor.unpack_partial(full[lo:hi], dest, vector_type, count, lo)
+    assert bytes(convertor.pack(dest, vector_type, count)) == bytes(full)
+
+
+@pytest.mark.parametrize("opname", list(native.OP_CODES))
+@pytest.mark.parametrize("dtype", ["int32", "uint64", "float64"])
+def test_native_reduce_matches_numpy(opname, dtype):
+    op = getattr(ops, opname.replace("MPI_", ""))
+    if np.dtype(dtype).kind == "f" and op.allowed_kinds == "iub":
+        return
+    rng = np.random.default_rng(3)
+    if np.dtype(dtype).kind == "f":
+        a = rng.normal(size=5000).astype(dtype)
+        b = rng.normal(size=5000).astype(dtype)
+    else:
+        a = rng.integers(0, 100, 5000).astype(dtype)
+        b = rng.integers(0, 100, 5000).astype(dtype)
+    got = op(a, b)  # size >= 4096 → native path
+    want = op(a[:1], b[:1])  # scalar-size → numpy path
+    np.testing.assert_array_equal(got[:1], want)
+    # full parity against the raw numpy fn
+    np.testing.assert_array_equal(got, op._np_fn(a, b))
+
+
+def test_native_max_propagates_nan():
+    # np.maximum propagates NaN; the native kernel must agree on both sides
+    # of the size threshold (regression: size-dependent NaN semantics).
+    a = np.full(5000, np.nan, np.float32)
+    b = np.zeros(5000, np.float32)
+    assert np.isnan(ops.MAX(a, b)).all()
+    assert np.isnan(ops.MAX(b, a)).all()
+    assert np.isnan(ops.MIN(a, b)).all()
+
+
+def test_pack_partial_rejects_short_buffer(vector_type):
+    from zhpe_ompi_tpu.core import errors
+
+    with pytest.raises(errors.TruncateError):
+        convertor.pack_partial(np.zeros(8, np.uint8), vector_type, 4, 0, 10**6)
+    with pytest.raises(errors.ArgError):
+        convertor.pack_partial(
+            np.zeros(convertor.span_bytes(vector_type, 4), np.uint8),
+            vector_type, 4, -1, 16)
+
+
+def test_unpack_partial_rejects_short_destination(vector_type):
+    from zhpe_ompi_tpu.core import errors
+
+    chunk = np.zeros(64, np.uint8)
+    with pytest.raises(errors.TruncateError):
+        convertor.unpack_partial(chunk, np.zeros(4, np.uint8), vector_type, 4, 0)
+    dest = np.zeros(convertor.span_bytes(vector_type, 4), np.uint8)
+    with pytest.raises(errors.ArgError):
+        convertor.unpack_partial(chunk, dest, vector_type, 4, -1)
+
+
+def test_native_reduce_preserves_operands():
+    a = np.ones(5000, dtype=np.int32)
+    b = np.full(5000, 7, dtype=np.int32)
+    out = ops.SUM(a, b)
+    assert b[0] == 7 and a[0] == 1 and out[0] == 8
+
+
+class TestNativeMatching:
+    def make(self):
+        if not native.available():
+            pytest.skip("no native lib")
+        return matching.NativeMatchingEngine()
+
+    def test_post_then_incoming(self):
+        eng = self.make()
+        hits = []
+        eng.post_recv(1, 5, 0, lambda e, p: hits.append((e, p)))
+        eng.incoming(matching.Envelope(1, 5, 0, 0), "payload")
+        assert hits and hits[0][1] == "payload"
+        assert eng.stats() == {"posted": 0, "unexpected": 0}
+
+    def test_unexpected_then_post_wildcards(self):
+        eng = self.make()
+        eng.incoming(matching.Envelope(2, 9, 1, 0), "a")
+        eng.incoming(matching.Envelope(3, 9, 1, 1), "b")
+        assert eng.stats()["unexpected"] == 2
+        got = []
+        eng.post_recv(matching.ANY_SOURCE, 9, 1, lambda e, p: got.append(p))
+        assert got == ["a"]  # earliest unexpected wins
+        probe = eng.probe(matching.ANY_SOURCE, matching.ANY_TAG, 1)
+        assert probe is not None and probe.src == 3
+
+    def test_no_cross_cid_match(self):
+        eng = self.make()
+        got = []
+        eng.post_recv(matching.ANY_SOURCE, matching.ANY_TAG, 7, got.append)
+        eng.incoming(matching.Envelope(0, 0, 8, 0), "x")
+        assert eng.stats() == {"posted": 1, "unexpected": 1}
+
+    def test_parity_with_python_engine(self):
+        rng = np.random.default_rng(0)
+        neng, peng = self.make(), matching.MatchingEngine()
+        nlog, plog = [], []
+        events = []
+        for i in range(200):
+            kind = rng.integers(0, 2)
+            src = int(rng.integers(-1, 3))
+            tag = int(rng.integers(-1, 3))
+            events.append((kind, src, tag, i))
+        for kind, src, tag, i in events:
+            if kind == 0:
+                neng.post_recv(src, tag, 0, lambda e, p, i=i: nlog.append((i, e.seq, p)))
+                peng.post_recv(src, tag, 0, lambda e, p, i=i: plog.append((i, e.seq, p)))
+            else:
+                env = matching.Envelope(max(src, 0), max(tag, 0), 0, i)
+                neng.incoming(env, f"m{i}")
+                peng.incoming(env, f"m{i}")
+        assert nlog == plog
+        assert neng.stats() == peng.stats()

@@ -122,6 +122,14 @@ class TestMoE:
             in_specs=(param_specs, spec_x), out_specs=spec_x,
         )
         assert np.isfinite(np.asarray(out)).all()
+        # exact parity with the dense reference at the same binding capacity
+        cap = max(1, int(0.5 * T_local / N))
+        ref = moe.moe_reference_dense(
+            params, x_all, N, capacity=cap, block_tokens=T_local
+        )
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-5
+        )
 
 
 class TestPipeline:

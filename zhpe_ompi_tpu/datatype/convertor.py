@@ -20,11 +20,38 @@ datatype description; here the same roles are:
 
 from __future__ import annotations
 
+import ctypes
+
 import numpy as np
 
+from .. import native
 from ..core import errors
 from .derived import DerivedDatatype, merge_typemap_segments
 from .predefined import Datatype
+
+_seg_cache: dict[tuple, np.ndarray] = {}
+
+
+def _segs_array(datatype: Datatype) -> np.ndarray:
+    """(nsegs, 2) int64 array of one element's optimized description, for the
+    native pack/unpack kernels."""
+    segs = _one_element_segments(datatype)
+    key = (tuple(segs),)
+    arr = _seg_cache.get(key)
+    if arr is None:
+        arr = np.asarray(segs, dtype=np.int64).reshape(-1, 2)
+        if len(_seg_cache) > 256:
+            _seg_cache.clear()
+        _seg_cache[key] = arr
+    return arr
+
+
+def _vp(arr: np.ndarray):
+    return arr.ctypes.data_as(ctypes.c_void_p)
+
+
+def _i64p(arr: np.ndarray):
+    return arr.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
 
 
 def _one_element_segments(datatype: Datatype) -> list[tuple[int, int]]:
@@ -117,6 +144,14 @@ def pack(buffer, datatype: Datatype, count: int) -> np.ndarray:
         )
     if datatype.is_contiguous:
         return view[lb:need].copy()
+    lib = native.load()
+    if lib is not None:
+        segs = _segs_array(datatype)
+        out = np.empty(packed_size(datatype, count), dtype=np.uint8)
+        src = np.ascontiguousarray(view)
+        lib.zompi_pack(_vp(src), _vp(out), _i64p(segs), segs.shape[0],
+                       datatype.extent, count)
+        return out
     return view[byte_index_map(datatype, count)]
 
 
@@ -140,6 +175,13 @@ def unpack(packed, datatype: Datatype, count: int, out=None) -> np.ndarray:
             raise errors.TruncateError("destination buffer too small")
     if datatype.is_contiguous:
         dest[lb : lb + need] = src[:need]
+        return dest
+    lib = native.load()
+    if lib is not None and dest.flags["WRITEABLE"]:
+        segs = _segs_array(datatype)
+        srcc = np.ascontiguousarray(src[:need])
+        lib.zompi_unpack(_vp(srcc), _vp(dest), _i64p(segs), segs.shape[0],
+                         datatype.extent, count)
     else:
         dest[byte_index_map(datatype, count)] = src[:need]
     return dest
@@ -152,10 +194,27 @@ def pack_partial(
     byte `position`; returns (chunk, new_position).  Byte-granular, so segment
     boundaries may be split exactly as the reference's convertor allows."""
     view = _as_byte_view(buffer)
-    idx = byte_index_map(datatype, count)
-    end = min(position + max_bytes, idx.shape[0])
-    if position > idx.shape[0]:
+    total = packed_size(datatype, count)
+    if position < 0 or position > total:
         raise errors.ArgError(f"position {position} beyond packed size")
+    need = span_bytes(datatype, count)
+    if view.nbytes < need:
+        raise errors.TruncateError(
+            f"buffer of {view.nbytes}B too small for {count} x {datatype.name} "
+            f"({need}B)"
+        )
+    end = min(position + max_bytes, total)
+    lib = native.load()
+    if lib is not None:
+        _check_lb(datatype)
+        segs = _segs_array(datatype)
+        out = np.empty(end - position, dtype=np.uint8)
+        newpos = lib.zompi_pack_partial(
+            _vp(view), _vp(out), _i64p(segs), segs.shape[0],
+            datatype.extent, count, position, end - position,
+        )
+        return out[: newpos - position], newpos
+    idx = byte_index_map(datatype, count)
     return view[idx[position:end]], end
 
 
@@ -167,10 +226,27 @@ def unpack_partial(
     (cf. test/datatype/unpack_ooo.c) — each lands at its own offsets."""
     src = _as_byte_view(chunk)
     dest = _as_byte_view(buffer)
-    idx = byte_index_map(datatype, count)
+    if position < 0:
+        raise errors.ArgError(f"negative position {position}")
     end = position + src.nbytes
-    if end > idx.shape[0]:
+    if end > packed_size(datatype, count):
         raise errors.TruncateError("chunk overruns packed size")
+    span = span_bytes(datatype, count)
+    if dest.nbytes < span:
+        raise errors.TruncateError(
+            f"destination buffer of {dest.nbytes}B smaller than datatype "
+            f"span ({span}B)"
+        )
+    lib = native.load()
+    if lib is not None and dest.flags["WRITEABLE"]:
+        _check_lb(datatype)
+        segs = _segs_array(datatype)
+        srcc = np.ascontiguousarray(src)
+        return lib.zompi_unpack_partial(
+            _vp(srcc), srcc.nbytes, _vp(dest), _i64p(segs), segs.shape[0],
+            datatype.extent, count, position,
+        )
+    idx = byte_index_map(datatype, count)
     dest[idx[position:end]] = src
     return end
 

@@ -82,14 +82,28 @@ def moe_ffn(comm, params, x, capacity_factor: float = 1.25):
     return (y * gate[:, None].astype(y.dtype)), keep
 
 
-def moe_reference_dense(params, x_all, n_experts: int, capacity: int):
-    """Single-device reference for tests: same routing/capacity semantics,
-    no communication."""
+def moe_reference_dense(
+    params, x_all, n_experts: int, capacity: int, block_tokens: int | None = None
+):
+    """Single-device reference for tests: same routing/capacity semantics as
+    :func:`moe_ffn`, no communication.
+
+    `capacity` is per (source block, expert), matching moe_ffn where each
+    device owns `cap` dispatch slots per expert; `block_tokens` is the
+    per-device token count T_local (default: all of x_all is one block).
+    Dropped tokens produce zero output, as in moe_ffn.
+    """
     T, D = x_all.shape
+    bt = block_tokens or T
     logits = x_all.astype(jnp.float32) @ params["router"]
     probs = jax.nn.softmax(logits, axis=-1)
     expert = jnp.argmax(probs, axis=-1)
     gate = jnp.take_along_axis(probs, expert[:, None], axis=1)[:, 0]
+    # first-come-first-served capacity per (block, expert), as in moe_ffn
+    onehot = jax.nn.one_hot(expert, n_experts, dtype=jnp.int32)
+    blocks = onehot.reshape(T // bt, bt, n_experts)
+    pos = jnp.sum((jnp.cumsum(blocks, axis=1) - 1) * blocks, axis=-1)
+    keep = (pos < capacity).reshape(T)
     out = jnp.zeros((T, D), jnp.float32)
     for e in range(n_experts):
         w_in = params["w_in"][e]
@@ -97,4 +111,4 @@ def moe_reference_dense(params, x_all, n_experts: int, capacity: int):
         h = jax.nn.gelu(x_all.astype(jnp.float32) @ w_in)
         y = h @ w_out
         out = jnp.where((expert == e)[:, None], y, out)
-    return out * gate[:, None]
+    return out * gate[:, None] * keep[:, None]

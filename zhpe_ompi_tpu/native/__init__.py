@@ -1,0 +1,142 @@
+"""Native (C++) runtime kernels: build-on-demand loader.
+
+The reference's performance-critical host paths are native C (SURVEY.md §2 —
+datatype convertor, op kernel table, ob1 matching).  This package holds their
+C++ re-implementations (``zompi_native.cpp``), compiled once per source hash
+with the in-image g++ and loaded through ctypes (no pybind11 in the image;
+a flat C ABI keeps the boundary trivial).
+
+Import never fails: if no compiler is available or compilation breaks, ``lib``
+is ``None`` and every consumer falls back to its pure numpy/Python path.
+Disable via the MCA var ``native_kernels`` (``ZMPI_MCA_native_kernels=0``) or
+the direct env override ``ZOMPI_NATIVE=0``.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import threading
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "zompi_native.cpp")
+
+# op / type codes — must match the enums in zompi_native.cpp
+OP_CODES = {
+    "MPI_SUM": 0,
+    "MPI_PROD": 1,
+    "MPI_MAX": 2,
+    "MPI_MIN": 3,
+    "MPI_BAND": 4,
+    "MPI_BOR": 5,
+    "MPI_BXOR": 6,
+    "MPI_LAND": 7,
+    "MPI_LOR": 8,
+    "MPI_LXOR": 9,
+}
+TYPE_CODES = {
+    "int8": 0,
+    "uint8": 1,
+    "int16": 2,
+    "uint16": 3,
+    "int32": 4,
+    "uint32": 5,
+    "int64": 6,
+    "uint64": 7,
+    "float32": 8,
+    "float64": 9,
+}
+
+_lock = threading.Lock()
+_loaded = False
+lib: ctypes.CDLL | None = None
+build_error: str | None = None
+
+
+def _so_path() -> str:
+    with open(_SRC, "rb") as f:
+        h = hashlib.sha256(f.read()).hexdigest()[:16]
+    return os.path.join(_HERE, f"_libzompi_{h}.so")
+
+
+def _declare(dll: ctypes.CDLL) -> None:
+    i64, u64, vp = ctypes.c_int64, ctypes.c_uint64, ctypes.c_void_p
+    i64p, u64p = ctypes.POINTER(i64), ctypes.POINTER(u64)
+    dll.zompi_pack.argtypes = [vp, vp, i64p, i64, i64, i64]
+    dll.zompi_pack.restype = None
+    dll.zompi_unpack.argtypes = [vp, vp, i64p, i64, i64, i64]
+    dll.zompi_unpack.restype = None
+    dll.zompi_pack_partial.argtypes = [vp, vp, i64p, i64, i64, i64, i64, i64]
+    dll.zompi_pack_partial.restype = i64
+    dll.zompi_unpack_partial.argtypes = [vp, i64, vp, i64p, i64, i64, i64, i64]
+    dll.zompi_unpack_partial.restype = i64
+    dll.zompi_reduce.argtypes = [ctypes.c_int, ctypes.c_int, vp, vp, i64]
+    dll.zompi_reduce.restype = ctypes.c_int
+    dll.zompi_match_create.argtypes = []
+    dll.zompi_match_create.restype = vp
+    dll.zompi_match_destroy.argtypes = [vp]
+    dll.zompi_match_destroy.restype = None
+    dll.zompi_match_post.argtypes = [vp, i64, i64, i64, u64, i64p, u64p]
+    dll.zompi_match_post.restype = ctypes.c_int
+    dll.zompi_match_incoming.argtypes = [vp, i64, i64, i64, i64, u64, u64p]
+    dll.zompi_match_incoming.restype = ctypes.c_int
+    dll.zompi_match_probe.argtypes = [vp, i64, i64, i64, i64p]
+    dll.zompi_match_probe.restype = ctypes.c_int
+    dll.zompi_match_stats.argtypes = [vp, i64p, i64p]
+    dll.zompi_match_stats.restype = None
+    dll.zompi_abi_version.argtypes = []
+    dll.zompi_abi_version.restype = ctypes.c_int
+
+
+def load() -> ctypes.CDLL | None:
+    """Build (if needed) and load the native library; None on any failure."""
+    global _loaded, lib, build_error
+    if _loaded:
+        return lib
+    with _lock:
+        if _loaded:
+            return lib
+        if os.environ.get("ZOMPI_NATIVE", "1") in ("0", "false", "no"):
+            build_error = "disabled by ZOMPI_NATIVE=0"
+            _loaded = True
+            return None
+        from ..mca import var as mca_var
+
+        enabled = mca_var.register(
+            "native_kernels",
+            True,
+            "Use the native (C++) host-plane kernels for datatype "
+            "pack/unpack, reductions, and tag matching",
+        )
+        if not enabled.value:
+            build_error = "disabled by MCA var native_kernels"
+            _loaded = True
+            return None
+        so = _so_path()
+        try:
+            if not os.path.exists(so):
+                tmp = so + f".tmp.{os.getpid()}"
+                subprocess.run(
+                    ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
+                     "-o", tmp, _SRC],
+                    check=True, capture_output=True, text=True, timeout=120,
+                )
+                os.replace(tmp, so)
+            dll = ctypes.CDLL(so)
+            _declare(dll)
+            if dll.zompi_abi_version() != 1:
+                raise RuntimeError("ABI version mismatch")
+            lib = dll
+        except Exception as exc:  # noqa: BLE001 - any failure → fallback
+            build_error = (
+                getattr(exc, "stderr", None) or str(exc)
+            )
+            lib = None
+        _loaded = True
+        return lib
+
+
+def available() -> bool:
+    return load() is not None

@@ -24,6 +24,7 @@ from typing import Any, Callable
 
 import numpy as np
 
+from .. import native as _native_mod
 from ..core import errors
 from ..datatype.predefined import Datatype, PairDatatype
 
@@ -92,9 +93,39 @@ class Op:
         if self._np_fn is None:
             raise errors.OpError(f"{self.name} has no combine function")
         if isinstance(a, np.ndarray) or np.isscalar(a):
-            return self._np_fn(a, b)
+            out = self._native_combine(a, b)
+            return out if out is not None else self._np_fn(a, b)
         fn = self._jnp_fn or self._np_fn
         return fn(a, b)
+
+    def _native_combine(self, a, b):
+        """C++ kernel path (the ompi_op_base_functions table analog) for
+        large contiguous same-dtype host arrays; None → numpy fallback."""
+        if not (
+            isinstance(a, np.ndarray)
+            and isinstance(b, np.ndarray)
+            and a.dtype == b.dtype
+            and a.shape == b.shape
+            and a.size >= 4096
+            and a.flags["C_CONTIGUOUS"]
+            and self.name in _native_mod.OP_CODES
+            and str(a.dtype) in _native_mod.TYPE_CODES
+        ):
+            return None
+        lib = _native_mod.load()
+        if lib is None:
+            return None
+        import ctypes
+
+        out = np.ascontiguousarray(b).copy()
+        rc = lib.zompi_reduce(
+            _native_mod.OP_CODES[self.name],
+            _native_mod.TYPE_CODES[str(a.dtype)],
+            a.ctypes.data_as(ctypes.c_void_p),
+            out.ctypes.data_as(ctypes.c_void_p),
+            a.size,
+        )
+        return out if rc == 0 else None
 
     def identity_for(self, dtype) -> Any:
         """Identity element for padding (raises for ops without one)."""

@@ -100,3 +100,91 @@ class MatchingEngine:
                 "posted": len(self._posted),
                 "unexpected": len(self._unexpected),
             }
+
+
+class NativeMatchingEngine:
+    """Same contract as :class:`MatchingEngine`, with the queue walk in C++
+    (the native analog of ob1's match loops).  Payloads and callbacks stay in
+    Python, referenced by opaque keys handed through the C ABI."""
+
+    def __init__(self) -> None:
+        import ctypes
+
+        from .. import native
+
+        self._native = native
+        self._ctypes = ctypes
+        lib = native.load()
+        if lib is None:  # pragma: no cover - builder machine always has g++
+            raise RuntimeError(f"native library unavailable: {native.build_error}")
+        self._lib = lib
+        self._h = lib.zompi_match_create()
+        self._lock = threading.Lock()
+        self._next_key = 1
+        self._payloads: dict[int, Any] = {}
+        self._callbacks: dict[int, Callable[[Envelope, Any], None]] = {}
+
+    def __del__(self):  # pragma: no cover - interpreter teardown timing
+        lib, h = getattr(self, "_lib", None), getattr(self, "_h", None)
+        if lib is not None and h:
+            lib.zompi_match_destroy(h)
+            self._h = None
+
+    def post_recv(self, src: int, tag: int, cid: int,
+                  on_match: Callable[[Envelope, Any], None]) -> None:
+        ct = self._ctypes
+        env = (ct.c_int64 * 4)()
+        pkey = ct.c_uint64()
+        with self._lock:
+            key = self._next_key
+            self._next_key += 1
+            self._callbacks[key] = on_match
+            hit = self._lib.zompi_match_post(
+                self._h, src, tag, cid, key, env, ct.byref(pkey))
+            if hit:
+                del self._callbacks[key]
+                payload = self._payloads.pop(pkey.value)
+        if hit:
+            on_match(Envelope(env[0], env[1], env[2], env[3]), payload)
+
+    def incoming(self, env: Envelope, payload: Any) -> None:
+        ct = self._ctypes
+        rkey = ct.c_uint64()
+        with self._lock:
+            key = self._next_key
+            self._next_key += 1
+            self._payloads[key] = payload
+            hit = self._lib.zompi_match_incoming(
+                self._h, env.src, env.tag, env.cid, env.seq, key,
+                ct.byref(rkey))
+            if hit:
+                del self._payloads[key]
+                cb = self._callbacks.pop(rkey.value)
+        if hit:
+            cb(env, payload)
+
+    def probe(self, src: int, tag: int, cid: int) -> Envelope | None:
+        ct = self._ctypes
+        env = (ct.c_int64 * 4)()
+        with self._lock:
+            hit = self._lib.zompi_match_probe(self._h, src, tag, cid, env)
+        if hit:
+            return Envelope(env[0], env[1], env[2], env[3])
+        return None
+
+    def stats(self) -> dict[str, int]:
+        ct = self._ctypes
+        p, u = ct.c_int64(), ct.c_int64()
+        with self._lock:
+            self._lib.zompi_match_stats(self._h, ct.byref(p), ct.byref(u))
+        return {"posted": p.value, "unexpected": u.value}
+
+
+def make_matching_engine():
+    """Factory: native C++ engine when the library is available, pure-Python
+    otherwise (selection mirrors MCA component fallback)."""
+    from .. import native
+
+    if native.available():
+        return NativeMatchingEngine()
+    return MatchingEngine()

@@ -81,7 +81,7 @@ class RankContext:
         self.universe = universe
         self.rank = rank
         self.size = universe.size
-        self.engine = matching.MatchingEngine()
+        self.engine = matching.make_matching_engine()
         self.mailbox: queue.Queue = queue.Queue()
         self._seq = itertools.count()
         self._pending_rndv: dict[int, tuple[Any, Request]] = {}
