@@ -1,0 +1,237 @@
+"""Topo framework tests: dims_create, cartesian maps/shift/sub, graph and
+dist-graph adjacency, treematch-style reorder, neighbor collectives — all
+against numpy references on the 8-device CPU loopback mesh (SURVEY.md §4)."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import zhpe_ompi_tpu as zmpi
+from zhpe_ompi_tpu import topo
+from zhpe_ompi_tpu.core import errors
+
+N = 8
+
+
+@pytest.fixture(scope="module")
+def world():
+    return zmpi.init()
+
+
+def run_spmd(comm, fn, x_global, out_specs=None):
+    xs = comm.device_put_sharded(jnp.asarray(x_global))
+    return np.asarray(comm.run(fn, xs, out_specs=out_specs))
+
+
+class TestDimsCreate:
+    def test_balanced(self):
+        assert topo.dims_create(8, 3) == [2, 2, 2]
+        assert topo.dims_create(12, 2) == [4, 3]
+        assert topo.dims_create(7, 2) == [7, 1]
+
+    def test_constrained(self):
+        assert topo.dims_create(8, 2, [4, 0]) == [4, 2]
+        assert topo.dims_create(6, 3, [0, 3, 0]) == [2, 3, 1]
+
+    def test_errors(self):
+        with pytest.raises(errors.ArgError):
+            topo.dims_create(8, 2, [3, 0])  # 8 % 3 != 0
+        with pytest.raises(errors.ArgError):
+            topo.dims_create(8, 2, [2, 2])  # fully fixed, wrong product
+
+
+class TestCart:
+    def test_coords_rank_roundtrip(self, world):
+        cart = topo.CartTopology(world, (4, 2), periods=(True, False))
+        for r in range(N):
+            assert cart.rank_of(cart.coords(r)) == r
+        assert cart.coords(0) == (0, 0)
+        assert cart.coords(5) == (2, 1)  # row-major
+        # periodic wrap on dim 0, error on non-periodic dim 1
+        assert cart.rank_of((-1, 0)) == cart.rank_of((3, 0))
+        with pytest.raises(errors.RankError):
+            cart.rank_of((0, 2))
+
+    def test_shift_tables(self, world):
+        cart = topo.CartTopology(world, (4, 2), periods=(True, False))
+        src, dst = cart.shift(0, 1)
+        # periodic ring of 4 along dim 0 at fixed col
+        assert dst[cart.rank_of((3, 0))] == cart.rank_of((0, 0))
+        assert src[cart.rank_of((0, 0))] == cart.rank_of((3, 0))
+        src, dst = cart.shift(1, 1)
+        # non-periodic: col 1 has PROC_NULL dest, col 0 PROC_NULL source
+        assert dst[cart.rank_of((0, 1))] == -1
+        assert src[cart.rank_of((0, 0))] == -1
+
+    def test_shift_exchange_traced(self, world):
+        cart = topo.CartTopology(world, (8,), periods=(True,))
+        x = np.arange(N, dtype=np.float32).reshape(N, 1)
+        out = run_spmd(world, lambda s: cart.shift_exchange(s, 0, 1), x)
+        # rank r receives from r-1 (periodic)
+        expect = np.roll(x, 1, axis=0)
+        np.testing.assert_array_equal(out, expect)
+
+    def test_shift_exchange_nonperiodic_boundary(self, world):
+        cart = topo.CartTopology(world, (8,), periods=(False,))
+        x = np.arange(1, N + 1, dtype=np.float32).reshape(N, 1)
+        out = run_spmd(world, lambda s: cart.shift_exchange(s, 0, 1), x)
+        expect = np.roll(x, 1, axis=0)
+        expect[0] = 0.0  # MPI_PROC_NULL edge → zeros
+        np.testing.assert_array_equal(out, expect)
+
+    def test_cart_sub(self, world):
+        cart = topo.CartTopology(world, (4, 2), periods=(True, False))
+        rows, rtopo = cart.sub([True, False])  # keep dim 0: two col-groups
+        assert rows.is_partitioned and len(rows.partition) == 2
+        assert rtopo.dims == (4,) and rtopo.periods == (True,)
+        # each group contains the 4 ranks of one column, row-major order
+        cols = sorted(tuple(g.ranks) for g in rows.partition)
+        assert cols == [
+            tuple(cart.rank_of((i, 0)) for i in range(4)),
+            tuple(cart.rank_of((i, 1)) for i in range(4)),
+        ]
+
+    def test_bad_dims(self, world):
+        with pytest.raises(errors.CommError):
+            topo.CartTopology(world, (3, 2))  # 6 != 8
+
+
+class TestGraph:
+    def test_index_edges(self, world):
+        # ring as an MPI graph: each rank lists its two ring neighbors
+        index, edges = [], []
+        for r in range(N):
+            edges += [(r - 1) % N, (r + 1) % N]
+            index.append(len(edges))
+        g = topo.GraphTopology(world, index, edges)
+        assert g.neighbors_count(0) == 2
+        assert g.neighbors(0) == [N - 1, 1]
+        assert sorted(g.in_neighbors(0)) == [1, N - 1]
+
+    def test_malformed(self, world):
+        with pytest.raises(errors.ArgError):
+            topo.GraphTopology(world, [2] + [1] * (N - 1), [0, 1])  # not monotone
+        with pytest.raises(errors.ArgError):
+            topo.GraphTopology(world, [1] * N, [0, 1])  # index[-1] != len(edges)
+
+    def test_dist_graph_adjacent(self, world):
+        edge_list = [(r, (r + 1) % N) for r in range(N)]
+        dg = topo.DistGraphTopology.from_edges(world, edge_list)
+        indeg, outdeg, weighted = dg.neighbors_count(3)
+        assert (indeg, outdeg) == (1, 1)
+        srcs, _, dsts, _ = dg.neighbors(3)
+        assert srcs == [2] and dsts == [4]
+
+    def test_dist_graph_inconsistent(self, world):
+        with pytest.raises(errors.ArgError):
+            topo.DistGraphTopology(
+                world, [[1]] + [[]] * (N - 1), [[]] * N
+            )
+
+
+class TestReorder:
+    def test_chain_placement(self):
+        # traffic: 0-3 heavy, 3-1 medium, rest light — expect a chain
+        t = np.zeros((4, 4))
+        t[0, 3] = 10.0
+        t[3, 1] = 5.0
+        t[1, 2] = 1.0
+        perm = topo.reorder_greedy(t)
+        assert sorted(perm) == [0, 1, 2, 3]
+        pos = {r: i for i, r in enumerate(perm)}
+        assert abs(pos[0] - pos[3]) == 1  # heaviest pair adjacent
+        assert abs(pos[3] - pos[1]) == 1
+
+
+class TestNeighborColl:
+    def test_cart_ring_allgather(self, world):
+        cart = topo.CartTopology(world, (8,), periods=(True,))
+        x = np.arange(N, dtype=np.float32).reshape(N, 1)
+        from jax.sharding import PartitionSpec as P
+
+        out = run_spmd(
+            world, lambda s: topo.neighbor_allgather(cart, s), x,
+            out_specs=P("world"),
+        ).reshape(N, 2, 1)
+        for r in range(N):
+            # slot order per dim: [minus-neighbor, plus-neighbor]
+            np.testing.assert_array_equal(out[r, 0], x[(r - 1) % N])
+            np.testing.assert_array_equal(out[r, 1], x[(r + 1) % N])
+
+    def test_cart_nonperiodic_boundary_zeros(self, world):
+        cart = topo.CartTopology(world, (8,), periods=(False,))
+        x = np.arange(1, N + 1, dtype=np.float32).reshape(N, 1)
+        from jax.sharding import PartitionSpec as P
+
+        out = run_spmd(
+            world, lambda s: topo.neighbor_allgather(cart, s), x,
+            out_specs=P("world"),
+        ).reshape(N, 2, 1)
+        assert out[0, 0, 0] == 0.0  # no minus-neighbor at the edge
+        assert out[N - 1, 1, 0] == 0.0
+        np.testing.assert_array_equal(out[1, 0], x[0])
+
+    def test_cart_2d_alltoall(self, world):
+        cart = topo.CartTopology(world, (4, 2), periods=(True, True))
+        # payload: block j of rank r is r*10 + j; deg = 4 (2 dims)
+        x = np.zeros((N, 4, 1), dtype=np.float32)
+        for r in range(N):
+            for j in range(4):
+                x[r, j, 0] = r * 10 + j
+        from jax.sharding import PartitionSpec as P
+
+        out = run_spmd(
+            world, lambda s: topo.neighbor_alltoall(cart, s[0]), x,
+            out_specs=P("world"),
+        ).reshape(N, 4, 1)
+        # independent model of MPI pairing: recv slot k of rank r matches
+        # the occurrence-th send of src=nbrs[k] addressed to r (MPI
+        # non-overtaking order; duplicates pair in order)
+        for r in range(N):
+            nbrs = cart.neighbor_ranks(r)
+            for k, src in enumerate(nbrs):
+                occurrence = nbrs[:k].count(src)
+                src_out = cart.neighbor_ranks(src)
+                sslot = [j for j, d in enumerate(src_out) if d == r][occurrence]
+                assert out[r, k, 0] == src * 10 + sslot
+
+    def test_graph_neighbor_allgather(self, world):
+        # directed star: every rank sends to rank 0
+        edge_list = [(r, 0) for r in range(1, N)]
+        dg = topo.DistGraphTopology.from_edges(world, edge_list)
+        x = np.arange(N, dtype=np.float32).reshape(N, 1)
+        from jax.sharding import PartitionSpec as P
+
+        out = run_spmd(
+            world, lambda s: topo.neighbor_allgather(dg, s), x,
+            out_specs=P("world"),
+        ).reshape(N, N - 1, 1)
+        # rank 0's slots hold ranks 1..7 in source order; others all zero
+        np.testing.assert_array_equal(
+            out[0, :, 0], np.arange(1, N, dtype=np.float32)
+        )
+        assert (out[1:] == 0).all()
+
+    def test_size2_periodic_duplicate_edges(self, world):
+        """dims=(2,) periodic: each rank's minus and plus neighbor are the
+        same rank — duplicate edges must pair by occurrence order."""
+        sub = world.split([0, 0, 1, 1, 2, 2, 3, 3])
+        cart = topo.CartTopology(sub, (2,), periods=(True,))
+        x = np.zeros((N, 2, 1), dtype=np.float32)
+        for r in range(N):
+            x[r, 0, 0] = r * 10
+            x[r, 1, 0] = r * 10 + 1
+        from jax.sharding import PartitionSpec as P
+
+        out = run_spmd(
+            sub, lambda s: topo.neighbor_alltoall(cart, s[0]), x,
+            out_specs=P("world"),
+        ).reshape(N, 2, 1)
+        # within each pair (a=2k, b=2k+1): a's slot 0 gets b's block 0
+        for k in range(4):
+            a, b = 2 * k, 2 * k + 1
+            assert out[a, 0, 0] == b * 10
+            assert out[a, 1, 0] == b * 10 + 1
+            assert out[b, 0, 0] == a * 10
+            assert out[b, 1, 0] == a * 10 + 1

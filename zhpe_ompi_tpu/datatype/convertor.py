@@ -120,7 +120,14 @@ def _as_byte_view(buffer) -> np.ndarray:
 
 
 def _check_lb(datatype: Datatype) -> int:
-    """Reject negative lower bounds (our buffers are 0-based); return lb."""
+    """Reject negative lower bounds (our buffers are 0-based) and
+    non-positive extents (elements live at i * extent, so extent <= 0 would
+    address before the buffer); return lb."""
+    if datatype.extent < 0 or (datatype.extent == 0 and datatype.size > 0):
+        raise errors.ArgError(
+            f"datatype {datatype.name} has non-positive extent "
+            f"({datatype.extent}); the pack engine requires extent > 0"
+        )
     segs = _one_element_segments(datatype)
     lb = segs[0][0] if segs else 0
     if lb < 0:

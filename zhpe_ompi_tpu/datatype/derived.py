@@ -259,7 +259,15 @@ def create_subarray(
 
 
 def create_resized(oldtype: Datatype, lb: int, extent: int) -> DerivedDatatype:
-    """MPI_Type_create_resized."""
+    """MPI_Type_create_resized.  MPI permits non-positive extents, but the
+    pack/unpack engine addresses elements at `i * extent` from a 0-based
+    buffer, so they are rejected here rather than corrupting memory later."""
+    from ..core import errors
+
+    if extent <= 0:
+        raise errors.ArgError(
+            f"create_resized: extent must be positive, got {extent}"
+        )
     return DerivedDatatype(f"resized({oldtype.name})", oldtype.typemap(), extent, lb)
 
 

@@ -246,6 +246,7 @@ extern "C" {
 // Returns 0 on success, -1 for an undefined (op, type) pair — the caller
 // falls back to the Python path (mirrors the reference's NULL table slots).
 int zompi_reduce(int op, int type, const void* in, void* inout, int64_t n) {
+  if (op < ZOMPI_OP_SUM || op > ZOMPI_OP_LXOR) return -1;  // unknown op code
   switch (type) {
     case ZOMPI_T_I8:
       return reduce_dispatch_int<int8_t>(op, in, inout, n);

@@ -117,7 +117,7 @@ class Op:
             return None
         import ctypes
 
-        out = np.ascontiguousarray(b).copy()
+        out = b.copy()  # np copy is C-contiguous regardless of b's layout
         rc = lib.zompi_reduce(
             _native_mod.OP_CODES[self.name],
             _native_mod.TYPE_CODES[str(a.dtype)],

@@ -1,0 +1,40 @@
+"""Topo framework — process topologies, TPU-native.
+
+Re-design of ``ompi/mca/topo`` (interface ``ompi/mca/topo/topo.h:296-343``,
+base implementations ``ompi/mca/topo/base/topo_base_cart_create.c`` et al.)
+for the SPMD single-controller machine:
+
+- A topology is a *static host-side description* attached to a communicator.
+  Rank↔coordinate maps are numpy tables baked into the compiled program, not
+  per-process state — XLA sees only static permutation patterns.
+- ``MPI_Cart_shift`` + sendrecv collapses into one ``ppermute`` with a
+  uniform shift pattern; neighbor collectives compile to a short sequence of
+  ``ppermute`` rounds (one per cart direction, or per color class of a greedy
+  edge coloring for general graphs) instead of per-edge send/recv.
+- On TPU the cartesian grid of devices IS the physical ICI torus, so
+  ``reorder=True`` for cartesian topologies is the identity (the reference's
+  ``cart_map``/``treematch`` exist because MPI ranks land on arbitrary
+  cluster nodes; JAX device order already encodes ICI adjacency).  For
+  distributed graphs we still provide a treematch-style greedy traffic
+  reorder (``graph.reorder_greedy``,
+  cf. ``ompi/mca/topo/treematch/topo_treematch_dist_graph_create.c``).
+"""
+
+from __future__ import annotations
+
+from .cart import CartTopology, dims_create
+from .graph import DistGraphTopology, GraphTopology, reorder_greedy
+from .neighbor import (
+    neighbor_allgather,
+    neighbor_alltoall,
+)
+
+__all__ = [
+    "CartTopology",
+    "GraphTopology",
+    "DistGraphTopology",
+    "dims_create",
+    "reorder_greedy",
+    "neighbor_allgather",
+    "neighbor_alltoall",
+]
