@@ -264,7 +264,7 @@ def create_resized(oldtype: Datatype, lb: int, extent: int) -> DerivedDatatype:
     buffer, so they are rejected here rather than corrupting memory later."""
     from ..core import errors
 
-    if extent <= 0:
+    if extent < 0 or (extent == 0 and oldtype.size > 0):
         raise errors.ArgError(
             f"create_resized: extent must be positive, got {extent}"
         )

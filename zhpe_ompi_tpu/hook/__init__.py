@@ -1,0 +1,96 @@
+"""Hook framework — init/finalize interposition.
+
+Re-design of ``ompi/mca/hook`` (SURVEY.md §2.3): components get called at
+fixed points in the runtime lifecycle.  The shipped component mirrors
+``hook/comm_method`` (``ompi/mca/hook/comm_method/hook_comm_method.h:21-26``),
+which prints the transport selected for each peer at init — here the
+analogous question is "which coll component won each operation, over what
+mesh", so that is what gets printed.
+
+Enable with ``ZMPI_MCA_hook_comm_method_enable=1`` (the reference's
+``--mca hook_comm_method_enable_mpi_init`` analog).
+"""
+
+from __future__ import annotations
+
+from ..mca import component as mca_component
+from ..mca import output as mca_output
+from ..mca import var as mca_var
+
+_stream = mca_output.open_stream("hook")
+
+
+class HookComponent(mca_component.Component):
+    framework_name = "hook"
+
+    def at_init_bottom(self, world) -> None:
+        """Called at the end of init(), world communicator constructed."""
+
+    def at_finalize_top(self) -> None:
+        """Called at the start of finalize()."""
+
+
+class CommMethodHook(HookComponent):
+    """Prints the per-communicator coll selection and mesh layout — the
+    comm_method transport matrix re-imagined for a mesh machine."""
+
+    name = "comm_method"
+    default_priority = 10
+
+    def register_params(self) -> None:
+        mca_var.registry.register(
+            "hook_comm_method_enable", False, type=bool,
+            description="print mesh layout and per-op coll component "
+                        "selection at init",
+        )
+        mca_var.registry.register(
+            "hook_comm_method_max", 12, type=int,
+            description="max coll table rows to print",
+        )
+
+    def at_init_bottom(self, world) -> None:
+        if not mca_var.get("hook_comm_method_enable", False):
+            return
+        mesh = world.mesh
+        devs = mesh.devices.ravel()
+        plat = devs[0].platform if len(devs) else "?"
+        lines = [
+            f"comm_method: mesh axes {dict(mesh.shape)} on {len(devs)} "
+            f"{plat} device(s)",
+            f"comm_method: {world.name} coll selection:",
+        ]
+        limit = int(mca_var.get("hook_comm_method_max", 12))
+        for opname, (fn, comp) in list(world.coll.items())[:limit]:
+            lines.append(f"comm_method:   {opname:<16} -> {comp}")
+        for line in lines:
+            mca_output.emit(_stream, line)
+
+    def at_finalize_top(self) -> None:
+        if not mca_var.get("hook_comm_method_enable", False):
+            return
+        from ..runtime import spc
+
+        snap = spc.snapshot()
+        if snap:
+            mca_output.emit(
+                _stream,
+                "comm_method: SPC at finalize: "
+                + ", ".join(f"{k}={v}" for k, v in sorted(snap.items())),
+            )
+
+
+def hook_framework() -> mca_component.Framework:
+    fw = mca_component.framework("hook", "init/finalize interposition")
+    fw.register(CommMethodHook())
+    fw.open()
+    return fw
+
+
+def run_init_hooks(world) -> None:
+    for comp in hook_framework().admitted():
+        comp.at_init_bottom(world)
+
+
+def run_finalize_hooks() -> None:
+    for comp in hook_framework().admitted():
+        comp.at_finalize_top()

@@ -19,6 +19,8 @@ from collections import deque
 from dataclasses import dataclass, field
 from typing import Any, Callable
 
+from ..runtime import peruse
+
 ANY_SOURCE = -1
 ANY_TAG = -1
 
@@ -61,6 +63,8 @@ class MatchingEngine:
                   on_match: Callable[[Envelope, Any], None]) -> None:
         """Post a receive; matches an unexpected message immediately if one
         is waiting (ordered: earliest matching unexpected wins)."""
+        if peruse.active:
+            peruse.fire(peruse.REQ_ACTIVATE, src=src, tag=tag, cid=cid)
         with self._lock:
             posted = PostedRecv(src, tag, cid, on_match)
             for i, (env, payload) in enumerate(self._unexpected):
@@ -69,12 +73,26 @@ class MatchingEngine:
                     break
             else:
                 self._posted.append(posted)
-                return
+                env = None
+        # events fire outside the lock (subscribers may re-enter the engine)
+        if env is None:
+            if peruse.active:
+                peruse.fire(peruse.REQ_INSERT_IN_POSTED_Q,
+                            src=src, tag=tag, cid=cid)
+            return
+        if peruse.active:
+            peruse.fire(peruse.MSG_REMOVE_FROM_UNEX_Q,
+                        src=env.src, tag=env.tag, cid=env.cid, seq=env.seq)
+            peruse.fire(peruse.REQ_MATCH_UNEX,
+                        src=env.src, tag=env.tag, cid=env.cid, seq=env.seq)
         on_match(env, payload)
 
     def incoming(self, env: Envelope, payload: Any) -> None:
         """Deliver an arriving message: match the earliest posted receive or
         park it on the unexpected queue."""
+        if peruse.active:
+            peruse.fire(peruse.MSG_ARRIVED,
+                        src=env.src, tag=env.tag, cid=env.cid, seq=env.seq)
         with self._lock:
             for i, posted in enumerate(self._posted):
                 if posted.matches(env):
@@ -82,7 +100,17 @@ class MatchingEngine:
                     break
             else:
                 self._unexpected.append((env, payload))
-                return
+                posted = None
+        if posted is None:
+            if peruse.active:
+                peruse.fire(peruse.MSG_INSERT_IN_UNEX_Q, src=env.src,
+                            tag=env.tag, cid=env.cid, seq=env.seq)
+            return
+        if peruse.active:
+            peruse.fire(peruse.REQ_REMOVE_FROM_POSTED_Q, src=env.src,
+                        tag=env.tag, cid=env.cid, seq=env.seq)
+            peruse.fire(peruse.MSG_MATCH_POSTED_REQ, src=env.src,
+                        tag=env.tag, cid=env.cid, seq=env.seq)
         posted.on_match(env, payload)
 
     def probe(self, src: int, tag: int, cid: int) -> Envelope | None:
@@ -135,6 +163,8 @@ class NativeMatchingEngine:
         ct = self._ctypes
         env = (ct.c_int64 * 4)()
         pkey = ct.c_uint64()
+        if peruse.active:
+            peruse.fire(peruse.REQ_ACTIVATE, src=src, tag=tag, cid=cid)
         with self._lock:
             key = self._next_key
             self._next_key += 1
@@ -145,11 +175,23 @@ class NativeMatchingEngine:
                 del self._callbacks[key]
                 payload = self._payloads.pop(pkey.value)
         if hit:
-            on_match(Envelope(env[0], env[1], env[2], env[3]), payload)
+            matched = Envelope(env[0], env[1], env[2], env[3])
+            if peruse.active:
+                peruse.fire(peruse.MSG_REMOVE_FROM_UNEX_Q, src=matched.src,
+                            tag=matched.tag, cid=matched.cid, seq=matched.seq)
+                peruse.fire(peruse.REQ_MATCH_UNEX, src=matched.src,
+                            tag=matched.tag, cid=matched.cid, seq=matched.seq)
+            on_match(matched, payload)
+        elif peruse.active:
+            peruse.fire(peruse.REQ_INSERT_IN_POSTED_Q,
+                        src=src, tag=tag, cid=cid)
 
     def incoming(self, env: Envelope, payload: Any) -> None:
         ct = self._ctypes
         rkey = ct.c_uint64()
+        if peruse.active:
+            peruse.fire(peruse.MSG_ARRIVED,
+                        src=env.src, tag=env.tag, cid=env.cid, seq=env.seq)
         with self._lock:
             key = self._next_key
             self._next_key += 1
@@ -161,7 +203,15 @@ class NativeMatchingEngine:
                 del self._payloads[key]
                 cb = self._callbacks.pop(rkey.value)
         if hit:
+            if peruse.active:
+                peruse.fire(peruse.REQ_REMOVE_FROM_POSTED_Q, src=env.src,
+                            tag=env.tag, cid=env.cid, seq=env.seq)
+                peruse.fire(peruse.MSG_MATCH_POSTED_REQ, src=env.src,
+                            tag=env.tag, cid=env.cid, seq=env.seq)
             cb(env, payload)
+        elif peruse.active:
+            peruse.fire(peruse.MSG_INSERT_IN_UNEX_Q, src=env.src,
+                        tag=env.tag, cid=env.cid, seq=env.seq)
 
     def probe(self, src: int, tag: int, cid: int) -> Envelope | None:
         ct = self._ctypes

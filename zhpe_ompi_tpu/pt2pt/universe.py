@@ -22,6 +22,7 @@ from __future__ import annotations
 import itertools
 import queue
 import threading
+import weakref
 from dataclasses import dataclass
 from typing import Any, Callable
 
@@ -206,6 +207,42 @@ class RankContext:
             k <<= 1
 
 
+# Live-universe tracking for the MPI_T pvar surface (the plane
+# test_pvar_access.c exercises in the reference).  Weak references: pvars
+# must observe universes, not keep them alive.
+_live_universes: weakref.WeakSet = weakref.WeakSet()
+
+
+def _queue_depth(key: str) -> int:
+    return sum(
+        c.engine.stats()[key]
+        for uni in list(_live_universes)
+        for c in uni.contexts
+    )
+
+
+_pvars_registered = False
+
+
+def _register_queue_pvars() -> None:
+    global _pvars_registered
+    if _pvars_registered:
+        return
+    from ..tools import mpit
+
+    mpit.register_pvar(
+        "pt2pt_posted_recvs", lambda: _queue_depth("posted"),
+        klass=mpit.PVAR_STATE,
+        description="posted receives across all live universes",
+    )
+    mpit.register_pvar(
+        "pt2pt_unexpected_msgs", lambda: _queue_depth("unexpected"),
+        klass=mpit.PVAR_STATE,
+        description="unexpected-queue depth across all live universes",
+    )
+    _pvars_registered = True
+
+
 class LocalUniverse:
     """N thread-ranks on one host (btl/self+sm analog)."""
 
@@ -214,6 +251,8 @@ class LocalUniverse:
             raise errors.ArgError("size must be >= 1")
         self.size = size
         self.contexts = [RankContext(self, r) for r in range(size)]
+        _live_universes.add(self)
+        _register_queue_pvars()
 
     def run(self, fn: Callable[[RankContext], Any], timeout: float = 60.0
             ) -> list[Any]:

@@ -71,7 +71,12 @@ def init(devices=None, axis_name: str = "world",
             1, _stream, "initialized: %d devices, %.1fms",
             m.devices.size, _global["init_time"] * 1e3,
         )
-        return world
+    # hook interposition, bottom of init (ompi/mca/hook semantics) — outside
+    # the lock so a hook may call back into the (idempotent) runtime API
+    from ..hook import run_init_hooks
+
+    run_init_hooks(world)
+    return world
 
 
 def world() -> Communicator:
@@ -94,6 +99,9 @@ def world_mesh():
 
 def finalize() -> None:
     """MPI_Finalize analog."""
+    from ..hook import run_finalize_hooks
+
+    run_finalize_hooks()
     with _lock:
         _global.update(
             initialized=False, finalized=True, world=None, self=None,

@@ -1,0 +1,69 @@
+"""PERUSE-style message-queue instrumentation.
+
+Re-design of ``ompi/peruse/peruse.h:22-35`` (SURVEY.md §5): tools subscribe
+callbacks to the lifecycle events of the receive path — request activation,
+posted-queue insertion, unexpected-queue traffic, matching — and the
+matching engine fires them inline.
+
+Cost discipline: the hot path pays ONE module-attribute boolean check when
+no subscriber exists (the reference compiles PERUSE out entirely; a traced
+runtime can't, so the gate is the cheapest possible).
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import defaultdict
+from typing import Any, Callable
+
+# Event names mirror the PERUSE_COMM_* enum (peruse.h).
+REQ_ACTIVATE = "req_activate"
+REQ_INSERT_IN_POSTED_Q = "req_insert_in_posted_q"
+REQ_REMOVE_FROM_POSTED_Q = "req_remove_from_posted_q"
+REQ_MATCH_UNEX = "req_match_unex"
+REQ_COMPLETE = "req_complete"
+MSG_ARRIVED = "msg_arrived"
+MSG_INSERT_IN_UNEX_Q = "msg_insert_in_unex_q"
+MSG_REMOVE_FROM_UNEX_Q = "msg_remove_from_unex_q"
+MSG_MATCH_POSTED_REQ = "msg_match_posted_req"
+
+ALL_EVENTS = (
+    REQ_ACTIVATE, REQ_INSERT_IN_POSTED_Q, REQ_REMOVE_FROM_POSTED_Q,
+    REQ_MATCH_UNEX, REQ_COMPLETE, MSG_ARRIVED, MSG_INSERT_IN_UNEX_Q,
+    MSG_REMOVE_FROM_UNEX_Q, MSG_MATCH_POSTED_REQ,
+)
+
+_subscribers: dict[str, list[Callable[..., None]]] = defaultdict(list)
+_lock = threading.Lock()
+
+# Hot-path gate: matching engines check this bare module attribute.
+active = False
+
+
+def subscribe(event: str, fn: Callable[..., None]) -> Callable[..., None]:
+    """PERUSE_Event_comm_register analog; returns `fn` as the handle."""
+    if event not in ALL_EVENTS:
+        raise ValueError(f"unknown PERUSE event {event!r}")
+    global active
+    with _lock:
+        _subscribers[event].append(fn)
+        active = True
+    return fn
+
+
+def unsubscribe(event: str, fn: Callable[..., None]) -> None:
+    global active
+    with _lock:
+        try:
+            _subscribers[event].remove(fn)
+        except ValueError:
+            pass
+        active = any(v for v in _subscribers.values())
+
+
+def fire(event: str, **info: Any) -> None:
+    """Called by the matching engine under its `active` gate."""
+    with _lock:
+        subs = list(_subscribers.get(event, ()))
+    for fn in subs:
+        fn(event=event, **info)

@@ -1,0 +1,229 @@
+"""MPI_T tool interface analog — cvar/pvar/category introspection.
+
+Re-design of ``ompi/mpi/tool`` (SURVEY.md §5): the MPI_T surface is a typed
+window onto (a) the MCA var system (control variables) and (b) the runtime
+counter plane (performance variables).  The reference's handle/session
+machinery is kept because it carries real semantics:
+
+- **cvar handles** read and (scope permitting) write an MCA var through the
+  same precedence machinery as env/file/CLI — a write is an API-source set.
+- **pvar sessions** isolate measurement intervals: a counter handle records
+  its baseline at ``start`` and reads deltas, so two tools can watch the
+  same global counter without trampling each other (the reason MPI_T has
+  sessions at all).
+- **categories** group variables for tool discovery, derived from the var
+  registry's framework prefixes rather than a hand-maintained tree.
+
+Counter pvars come from SPC (``runtime/spc.py``); state pvars are provided
+by live subsystems via :func:`register_pvar` (e.g. matching-queue depths,
+the PERUSE-adjacent surface of ``test/monitoring/test_pvar_access.c``).
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import Any, Callable
+
+from ..core import errors
+from ..mca import var as mca_var
+from ..runtime import spc
+
+# -- scopes (MPI_T_SCOPE_*) -------------------------------------------------
+
+SCOPE_CONSTANT = "constant"  # read-only forever
+SCOPE_READONLY = "readonly"  # read-only in this build
+SCOPE_LOCAL = "local"        # writable, affects this controller only
+SCOPE_ALL = "all"            # writable, affects every device (SPMD: same)
+
+# -- pvar classes (MPI_T_PVAR_CLASS_*) --------------------------------------
+
+PVAR_COUNTER = "counter"
+PVAR_STATE = "state"
+PVAR_WATERMARK = "highwatermark"
+
+
+# =========================== control variables =============================
+
+
+def cvar_get_num() -> int:
+    return len(mca_var.registry.all_vars())
+
+
+def cvar_names() -> list[str]:
+    return [v.name for v in mca_var.registry.all_vars()]
+
+
+def cvar_get_info(name: str) -> dict[str, Any]:
+    """MPI_T_cvar_get_info: metadata without allocating a handle."""
+    v = mca_var.registry.lookup(name)
+    if v is None:
+        raise errors.ArgError(f"no such cvar {name!r}")
+    return {
+        "name": v.name,
+        "description": v.description,
+        "type": v.type.__name__,
+        "scope": SCOPE_ALL if v.settable else SCOPE_READONLY,
+        "value": v.value,
+        "source": v.source.name,
+    }
+
+
+class CvarHandle:
+    """MPI_T_cvar_handle_alloc product: read/write one control variable."""
+
+    def __init__(self, name: str) -> None:
+        self._var = mca_var.registry.lookup(name)
+        if self._var is None:
+            raise errors.ArgError(f"no such cvar {name!r}")
+        self.name = name
+
+    def read(self) -> Any:
+        return self._var.value
+
+    def write(self, value: Any) -> None:
+        if not self._var.settable:
+            raise errors.ArgError(f"cvar {self.name} is read-only")
+        mca_var.registry.set(self.name, value)
+
+
+# ========================= performance variables ===========================
+
+
+@dataclass
+class _PvarDef:
+    name: str
+    klass: str
+    description: str
+    reader: Callable[[], int | float]
+    writable_reset: bool = False
+    resetter: Callable[[], None] | None = None
+
+
+_pvars: dict[str, _PvarDef] = {}
+_pvar_lock = threading.Lock()
+
+
+def register_pvar(name: str, reader: Callable[[], int | float],
+                  klass: str = PVAR_STATE, description: str = "",
+                  resetter: Callable[[], None] | None = None) -> None:
+    """Publish a performance variable backed by a live reader callable.
+    Idempotent by name (last registration wins — subsystems re-register on
+    re-init)."""
+    with _pvar_lock:
+        _pvars[name] = _PvarDef(
+            name, klass, description, reader,
+            resetter is not None, resetter,
+        )
+
+
+def _spc_defs() -> dict[str, _PvarDef]:
+    """Every SPC counter is a counter-class pvar named spc_<counter>
+    (the reference surfaces SPCs as MPI_T pvars, ompi_spc.c)."""
+    out = {}
+    for cname in spc.snapshot():
+        klass = PVAR_WATERMARK if cname in spc.WATERMARK else PVAR_COUNTER
+        out[f"spc_{cname}"] = _PvarDef(
+            f"spc_{cname}", klass, f"SPC counter {cname}",
+            (lambda c=cname: spc.read(c)),
+        )
+    return out
+
+
+def pvar_defs() -> dict[str, _PvarDef]:
+    with _pvar_lock:
+        defs = dict(_pvars)
+    defs.update(_spc_defs())
+    return defs
+
+
+def pvar_get_num() -> int:
+    return len(pvar_defs())
+
+
+def pvar_names() -> list[str]:
+    return sorted(pvar_defs())
+
+
+class PvarSession:
+    """MPI_T_pvar_session_create: an isolation scope for handles."""
+
+    def __init__(self) -> None:
+        self._handles: list[PvarHandle] = []
+
+    def handle_alloc(self, name: str) -> "PvarHandle":
+        defs = pvar_defs()
+        if name not in defs:
+            raise errors.ArgError(f"no such pvar {name!r}")
+        h = PvarHandle(defs[name])
+        self._handles.append(h)
+        return h
+
+    def free(self) -> None:
+        self._handles.clear()
+
+
+class PvarHandle:
+    """Counter handles measure deltas from their ``start`` baseline so
+    concurrent sessions don't interfere; state/watermark handles read the
+    live value."""
+
+    def __init__(self, d: _PvarDef) -> None:
+        self._def = d
+        self._running = False
+        self._baseline: int | float = 0
+
+    @property
+    def name(self) -> str:
+        return self._def.name
+
+    @property
+    def klass(self) -> str:
+        return self._def.klass
+
+    def start(self) -> None:
+        if self._def.klass == PVAR_COUNTER:
+            self._baseline = self._def.reader()
+        self._running = True
+
+    def stop(self) -> None:
+        self._running = False
+
+    def read(self) -> int | float:
+        v = self._def.reader()
+        if self._def.klass == PVAR_COUNTER:
+            return v - self._baseline
+        return v
+
+    def reset(self) -> None:
+        """Counter handles rebase; others delegate to their resetter."""
+        if self._def.klass == PVAR_COUNTER:
+            self._baseline = self._def.reader()
+        elif self._def.resetter is not None:
+            self._def.resetter()
+        else:
+            raise errors.UnsupportedError(
+                f"pvar {self._def.name} is not resettable"
+            )
+
+
+# =============================== categories ================================
+
+
+def category_names() -> list[str]:
+    """Categories from var-name framework prefixes plus the pvar plane
+    (MPI_T_category_get_num analog)."""
+    cats = {v.name.split("_", 1)[0] for v in mca_var.registry.all_vars()}
+    cats.add("spc")
+    return sorted(cats)
+
+
+def category_info(cat: str) -> dict[str, list[str]]:
+    cvars = [
+        v.name for v in mca_var.registry.all_vars()
+        if v.name.split("_", 1)[0] == cat
+    ]
+    pvars = [n for n in pvar_names() if n.split("_", 1)[0] == cat]
+    if not cvars and not pvars:
+        raise errors.ArgError(f"no such category {cat!r}")
+    return {"cvars": cvars, "pvars": pvars}

@@ -37,6 +37,8 @@ def dims_create(nnodes: int, ndims: int,
     """MPI_Dims_create: fill zero entries of `dims` so the product is
     `nnodes`, as balanced as possible (``ompi/mpi/c/dims_create.c``).
     Nonzero entries are constraints and are preserved."""
+    if nnodes <= 0:
+        raise errors.ArgError(f"nnodes must be positive, got {nnodes}")
     dims = list(dims) if dims is not None else [0] * ndims
     if len(dims) != ndims:
         raise errors.ArgError(f"dims has {len(dims)} entries, ndims={ndims}")
@@ -102,6 +104,9 @@ class CartTopology:
         self._coords = np.stack(
             np.unravel_index(np.arange(n), self.dims), axis=1
         ).astype(np.int32)
+        # memoized static tables (built on demand, reused across traces)
+        self._shift_cache: dict[tuple[int, int], tuple[list, list]] = {}
+        self._neighbor_table: list[list[int]] | None = None
 
     # -- introspection (MPI_Cartdim_get / MPI_Cart_get) -------------------
 
@@ -133,24 +138,30 @@ class CartTopology:
     def shift(self, dim: int, disp: int = 1
               ) -> tuple[list[int], list[int]]:
         """Per-rank (rank_source, rank_dest) lists; -1 is MPI_PROC_NULL
-        (``topo_base_cart_shift.c``)."""
+        (``topo_base_cart_shift.c``).  Vectorized and memoized: tables are
+        static per topology, so traces pay a dict lookup, not O(size)."""
         if not 0 <= dim < self.ndims:
             raise errors.ArgError(f"dim {dim} out of range")
-        src, dst = [], []
-        for rank in range(len(self._coords)):
-            c = list(self._coords[rank])
-            up, down = c.copy(), c.copy()
-            up[dim] += disp
-            down[dim] -= disp
-            try:
-                dst.append(self.rank_of(up))
-            except errors.RankError:
-                dst.append(-1)
-            try:
-                src.append(self.rank_of(down))
-            except errors.RankError:
-                src.append(-1)
-        return src, dst
+        cached = self._shift_cache.get((dim, disp))
+        if cached is not None:
+            return cached
+
+        def moved(delta: int) -> list[int]:
+            c = self._coords.astype(np.int64).copy()
+            c[:, dim] += delta
+            d = self.dims[dim]
+            if self.periods[dim]:
+                c[:, dim] %= d
+                valid = np.ones(len(c), dtype=bool)
+            else:
+                valid = (c[:, dim] >= 0) & (c[:, dim] < d)
+                c[:, dim] = np.clip(c[:, dim], 0, d - 1)
+            ranks = np.ravel_multi_index(c.T, self.dims)
+            return list(np.where(valid, ranks, -1).astype(int))
+
+        result = (moved(-disp), moved(disp))  # (sources, dests)
+        self._shift_cache[(dim, disp)] = result
+        return result
 
     def shift_exchange(self, x, dim: int, disp: int = 1):
         """Traced: every rank sends `x` to its +disp neighbor along `dim`
@@ -197,6 +208,8 @@ class CartTopology:
         topo._coords = np.stack(
             np.unravel_index(np.arange(nsub), topo.dims), axis=1
         ).astype(np.int32)
+        topo._shift_cache = {}
+        topo._neighbor_table = None
         return sub, topo
 
     # -- neighbor lists for neighbor collectives --------------------------
@@ -205,11 +218,13 @@ class CartTopology:
         """Ordered neighbors of `rank` for MPI_Neighbor_* on a cartesian
         communicator: for each dim, the -1 then +1 neighbor (the order
         MPI-3.1 §7.6 fixes); -1 = MPI_PROC_NULL."""
-        out = []
-        for d in range(self.ndims):
-            src, dst = self.shift(d, 1)
-            out.extend([src[rank], dst[rank]])
-        return out
+        if self._neighbor_table is None:
+            shifts = [self.shift(d, 1) for d in range(self.ndims)]
+            self._neighbor_table = [
+                [t[r] for src_dst in shifts for t in src_dst]
+                for r in range(len(self._coords))
+            ]
+        return list(self._neighbor_table[rank])
 
     # cartesian neighbor lists are symmetric: slot k both sends to and
     # receives from the k-th neighbor (MPI-3.1 §7.6 fixed order)

@@ -48,6 +48,11 @@ class GraphTopology:
         for hi in self.index:
             self._adj.append(list(self.edges[lo:hi]))
             lo = hi
+        # in-neighbor lists, precomputed O(V+E) (queried per edge at trace)
+        self._in_adj: list[list[int]] = [[] for _ in range(size)]
+        for r, outs in enumerate(self._adj):
+            for d in outs:
+                self._in_adj[d].append(r)
 
     def neighbors_count(self, rank: int) -> int:
         """MPI_Graph_neighbors_count."""
@@ -66,10 +71,7 @@ class GraphTopology:
 
     def in_neighbors(self, rank: int) -> list[int]:
         self._check(rank)
-        return [
-            r for r in range(self.comm.size) if rank in self._adj[r]
-            for _ in range(self._adj[r].count(rank))
-        ]
+        return list(self._in_adj[rank])
 
     def _check(self, rank: int) -> None:
         if not 0 <= rank < self.comm.size:

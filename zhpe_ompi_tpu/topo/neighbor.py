@@ -29,18 +29,19 @@ def _edge_rounds(topo):
     recv_slot_table) over comm-relative ranks; tables hold -1 for ranks
     idle in that round."""
     size = topo.comm.size
+    # snapshot neighbor lists once (queries can be O(size) per call)
+    out_lists = [topo.out_neighbors(r) for r in range(size)]
+    in_lists = [topo.in_neighbors(r) for r in range(size)]
     edges = []  # (src, dst, send_slot, recv_slot)
     for src in range(size):
-        outs = topo.out_neighbors(src)
         seen: dict[int, int] = {}
-        for j, dst in enumerate(outs):
+        for j, dst in enumerate(out_lists[src]):
             if dst < 0:  # MPI_PROC_NULL
                 continue
             occurrence = seen.get(dst, 0)
             seen[dst] = occurrence + 1
             # match the occurrence-th appearance of src in dst's in-list
-            ins = topo.in_neighbors(dst)
-            hits = [k for k, r in enumerate(ins) if r == src]
+            hits = [k for k, r in enumerate(in_lists[dst]) if r == src]
             recv_slot = hits[occurrence]
             edges.append((src, dst, j, recv_slot))
     # greedy edge coloring: first color where src isn't sending and dst
