@@ -1,0 +1,151 @@
+"""Checkpoint/restart tests (reference surface: opal/mca/crs, crcp/bkmrk,
+opal-checkpoint/opal-restart — SURVEY.md §5)."""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import zhpe_ompi_tpu as zmpi
+from zhpe_ompi_tpu.core import errors
+from zhpe_ompi_tpu.runtime.checkpoint import Checkpointer, quiesce_check
+
+
+@pytest.fixture(scope="module")
+def world():
+    return zmpi.init()
+
+
+def make_state(seed=0):
+    r = np.random.default_rng(seed)
+    return {
+        "w": jnp.asarray(r.normal(size=(8, 4)).astype(np.float32)),
+        "b": jnp.asarray(r.normal(size=(4,)).astype(np.float32)),
+        "step_count": jnp.asarray(7, jnp.int32),
+    }
+
+
+class TestSaveRestore:
+    def test_roundtrip_blocking(self, tmp_path):
+        ck = Checkpointer(str(tmp_path), check_quiescent=False)
+        state = make_state()
+        ck.save(3, state, blocking=True)
+        got, step = ck.restore()
+        assert step == 3
+        assert set(got) == set(state)
+        for k in state:
+            np.testing.assert_array_equal(np.asarray(got[k]),
+                                          np.asarray(state[k]))
+
+    def test_roundtrip_async(self, tmp_path):
+        ck = Checkpointer(str(tmp_path), check_quiescent=False)
+        ck.save(1, make_state(1))
+        ck.wait()
+        got, step = ck.restore()
+        assert step == 1
+
+    def test_restore_specific_and_latest(self, tmp_path):
+        ck = Checkpointer(str(tmp_path), keep=10, check_quiescent=False)
+        for s in (2, 5, 9):
+            ck.save(s, make_state(s), blocking=True)
+        assert ck.all_steps() == [2, 5, 9]
+        _, step = ck.restore()
+        assert step == 9
+        _, step = ck.restore(5)
+        assert step == 5
+        with pytest.raises(errors.ArgError):
+            ck.restore(4)
+
+    def test_retention(self, tmp_path):
+        ck = Checkpointer(str(tmp_path), keep=2, check_quiescent=False)
+        for s in range(5):
+            ck.save(s, make_state(s), blocking=True)
+        assert ck.all_steps() == [3, 4]
+
+    def test_empty_dir(self, tmp_path):
+        ck = Checkpointer(str(tmp_path), check_quiescent=False)
+        with pytest.raises(errors.ArgError):
+            ck.restore()
+
+    def test_partial_tmp_ignored(self, tmp_path):
+        ck = Checkpointer(str(tmp_path), check_quiescent=False)
+        ck.save(1, make_state(), blocking=True)
+        # simulate a crashed writer
+        os.makedirs(str(tmp_path / "step_2.tmp"))
+        assert ck.all_steps() == [1]
+        _, step = ck.restore()
+        assert step == 1
+
+    def test_overwrite_same_step(self, tmp_path):
+        """Crash-restart reruns a step: re-checkpointing it must replace
+        the old version, not fail on the existing directory."""
+        ck = Checkpointer(str(tmp_path), check_quiescent=False)
+        ck.save(4, {"x": np.zeros(2)}, blocking=True)
+        ck.save(4, {"x": np.ones(2)}, blocking=True)
+        got, step = ck.restore()
+        assert step == 4
+        np.testing.assert_array_equal(np.asarray(got["x"]), [1, 1])
+        assert ck.all_steps() == [4]
+
+    def test_sharded_restore(self, tmp_path, world):
+        ck = Checkpointer(str(tmp_path), check_quiescent=False)
+        sharding = NamedSharding(world.mesh, P("world"))
+        state = {
+            "x": jax.device_put(
+                jnp.arange(32, dtype=jnp.float32).reshape(8, 4), sharding
+            )
+        }
+        ck.save(0, state, blocking=True)
+        got, _ = ck.restore(shardings={"x": sharding})
+        assert got["x"].sharding == sharding
+        np.testing.assert_array_equal(
+            np.asarray(got["x"]), np.asarray(state["x"])
+        )
+
+    def test_save_snapshots_before_return(self, tmp_path):
+        """Device→host copy happens inside save(): mutating the donated
+        buffer afterwards must not corrupt the checkpoint."""
+        ck = Checkpointer(str(tmp_path), check_quiescent=False)
+        x = np.arange(4, dtype=np.float32)
+        state = {"x": x}
+        ck.save(0, state)
+        x[:] = -1  # simulate buffer reuse while IO is in flight
+        ck.wait()
+        got, _ = ck.restore()
+        np.testing.assert_array_equal(
+            np.asarray(got["x"]), [0, 1, 2, 3]
+        )
+
+
+class TestQuiesce:
+    def test_quiescent_passes(self):
+        quiesce_check()
+
+    def test_inflight_message_detected(self):
+        from zhpe_ompi_tpu.pt2pt.universe import LocalUniverse
+
+        uni = LocalUniverse(2)
+        uni.contexts[0].send(np.zeros(2), dest=1, tag=1)
+        uni.contexts[1].progress()  # parks on unexpected queue
+        with pytest.raises(errors.InternalError):
+            quiesce_check()
+        # draining restores quiescence
+        uni.contexts[1].recv(source=0, tag=1)
+        quiesce_check()
+
+    def test_checkpointer_enforces(self, tmp_path):
+        from zhpe_ompi_tpu.pt2pt.universe import LocalUniverse
+
+        uni = LocalUniverse(2)
+        uni.contexts[0].send(np.zeros(2), dest=1, tag=2)
+        uni.contexts[1].progress()
+        ck = Checkpointer(str(tmp_path))  # check_quiescent defaults True
+        with pytest.raises(errors.InternalError):
+            ck.save(0, {"x": np.zeros(2)}, blocking=True)
+        uni.contexts[1].recv(source=0, tag=2)
+        ck.save(0, {"x": np.zeros(2)}, blocking=True)

@@ -1,0 +1,242 @@
+"""IO framework tests: file views over the datatype engine, explicit-offset
+and individual-pointer IO, shared pointers, collective two-phase
+aggregation, and sharded-array save/load (reference surface:
+ompi/mca/io/ompio + fcoll/fs/fbtl/sharedfp — SURVEY.md §2.3)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import zhpe_ompi_tpu as zmpi
+from zhpe_ompi_tpu import datatype as dt
+from zhpe_ompi_tpu import io as zio
+from zhpe_ompi_tpu.core import errors
+
+N = 8
+
+
+@pytest.fixture(scope="module")
+def world():
+    return zmpi.init()
+
+
+class TestOpenClose:
+    def test_create_write_read(self, tmp_path, world):
+        p = str(tmp_path / "f.bin")
+        with zio.File(world, p, zio.MODE_CREATE | zio.MODE_WRONLY) as f:
+            f.write_at(0, np.arange(10, dtype=np.uint8))
+        with zio.File(world, p, zio.MODE_RDONLY) as f:
+            got = f.read_at(0, 10)
+        np.testing.assert_array_equal(got, np.arange(10, dtype=np.uint8))
+
+    def test_excl_fails_on_existing(self, tmp_path, world):
+        p = str(tmp_path / "f.bin")
+        open(p, "w").close()
+        with pytest.raises(errors.ArgError):
+            zio.File(world, p,
+                     zio.MODE_CREATE | zio.MODE_EXCL | zio.MODE_WRONLY)
+
+    def test_missing_file(self, tmp_path, world):
+        with pytest.raises(errors.ArgError):
+            zio.File(world, str(tmp_path / "nope.bin"), zio.MODE_RDONLY)
+
+    def test_delete(self, tmp_path, world):
+        p = str(tmp_path / "f.bin")
+        open(p, "w").close()
+        zio.delete(p)
+        with pytest.raises(errors.ArgError):
+            zio.delete(p)
+
+    def test_mode_validation(self, tmp_path, world):
+        with pytest.raises(errors.ArgError):
+            zio.File(world, str(tmp_path / "f"), zio.MODE_CREATE)  # no rw bit
+
+    def test_append_starts_at_eof_but_respects_offsets(self, tmp_path, world):
+        """MPI_MODE_APPEND = pointers start at EOF; positioned writes must
+        still honor their offsets (regression: O_APPEND would hijack
+        pwrite offsets on Linux)."""
+        p = str(tmp_path / "f.bin")
+        with zio.File(world, p, zio.MODE_CREATE | zio.MODE_WRONLY) as f:
+            f.write_at(0, np.arange(4, dtype=np.uint8))
+        with zio.File(world, p, zio.MODE_WRONLY | zio.MODE_APPEND) as f:
+            assert f.tell(rank=0) == 4  # pointer at EOF
+            f.write(np.array([9, 9], np.uint8))  # appends via pointer
+            f.write_at(0, np.array([7], np.uint8))  # explicit offset wins
+        with zio.File(world, p, zio.MODE_RDONLY) as f:
+            got = f.read_at(0, 6)
+        np.testing.assert_array_equal(got, [7, 1, 2, 3, 9, 9])
+
+    def test_delete_on_close(self, tmp_path, world):
+        p = str(tmp_path / "f.bin")
+        with zio.File(world, p, zio.MODE_CREATE | zio.MODE_WRONLY
+                      | zio.MODE_DELETE_ON_CLOSE) as f:
+            f.write_at(0, np.zeros(4, np.uint8))
+        assert not (tmp_path / "f.bin").exists()
+
+    def test_partial_etype_rejected_everywhere(self, tmp_path, world):
+        p = str(tmp_path / "f.bin")
+        with zio.File(world, p, zio.MODE_CREATE | zio.MODE_RDWR) as f:
+            f.set_view(0, dt.INT)
+            bad = np.zeros(10, np.uint8)  # 2.5 int32s
+            with pytest.raises(errors.TypeError_):
+                f.write(bad)
+            with pytest.raises(errors.TypeError_):
+                f.write_shared(bad)
+            with pytest.raises(errors.TypeError_):
+                f.write_all([bad] * world.size)
+
+
+class TestViews:
+    def test_etype_typed_read(self, tmp_path, world):
+        p = str(tmp_path / "f.bin")
+        data = np.arange(16, dtype=np.float64)
+        with zio.File(world, p, zio.MODE_CREATE | zio.MODE_RDWR) as f:
+            f.set_view(0, dt.DOUBLE)
+            f.write_at(0, data)
+            got = f.read_at(4, 8)
+        np.testing.assert_array_equal(got, data[4:12])
+
+    def test_strided_filetype_view(self, tmp_path, world):
+        """filetype = vector(2 doubles every 4): rank sees elements 0,1 of
+        each 4-double tile — the classic interleaved-block file layout."""
+        p = str(tmp_path / "f.bin")
+        full = np.arange(32, dtype=np.float64)
+        with zio.File(world, p, zio.MODE_CREATE | zio.MODE_RDWR) as f:
+            f.write_at(0, full)  # default byte view
+            ftype = dt.create_vector(2, 2, 4, dt.DOUBLE)
+            f.set_view(0, dt.DOUBLE, ftype)
+            got = f.read_at(0, 8)
+        # vector extent = (count-1)*stride + blocklen = 6 doubles/tile:
+        # tile 0 exposes doubles {0,1,4,5}, tile 1 (at 6) exposes {6,7,10,11}
+        np.testing.assert_array_equal(got, [0, 1, 4, 5, 6, 7, 10, 11])
+
+    def test_displaced_view(self, tmp_path, world):
+        p = str(tmp_path / "f.bin")
+        full = np.arange(16, dtype=np.float64)
+        with zio.File(world, p, zio.MODE_CREATE | zio.MODE_RDWR) as f:
+            f.write_at(0, full)
+            f.set_view(8 * 4, dt.DOUBLE)  # skip 4 doubles
+            got = f.read_at(0, 4)
+        np.testing.assert_array_equal(got, full[4:8])
+
+    def test_bad_filetype_etype_mismatch(self, tmp_path, world):
+        p = str(tmp_path / "f.bin")
+        with zio.File(world, p, zio.MODE_CREATE | zio.MODE_RDWR) as f:
+            with pytest.raises(errors.TypeError_):
+                f.set_view(0, dt.DOUBLE, dt.create_contiguous(3, dt.INT))
+
+
+class TestPointers:
+    def test_individual_pointers_per_rank(self, tmp_path, world):
+        p = str(tmp_path / "f.bin")
+        with zio.File(world, p, zio.MODE_CREATE | zio.MODE_RDWR) as f:
+            f.set_view(0, dt.INT)
+            f.write(np.arange(4, dtype=np.int32), rank=0)
+            assert f.tell(rank=0) == 4
+            assert f.tell(rank=1) == 0  # independent pointers
+            f.seek(2, rank=1)
+            got = f.read(2, rank=1)
+        np.testing.assert_array_equal(got, [2, 3])
+
+    def test_shared_pointer_order(self, tmp_path, world):
+        p = str(tmp_path / "f.bin")
+        with zio.File(world, p, zio.MODE_CREATE | zio.MODE_RDWR) as f:
+            f.set_view(0, dt.INT)
+            f.write_shared(np.array([1, 2], np.int32))
+            f.write_shared(np.array([3], np.int32))
+            f.write_shared(np.array([4, 5], np.int32))
+            got = f.read_at(0, 5)
+        np.testing.assert_array_equal(got, [1, 2, 3, 4, 5])
+
+
+class TestCollective:
+    def test_write_all_interleaved_views(self, tmp_path, world):
+        """Each rank's view is a strided slot of a record: write_all must
+        coalesce all ranks' extents into the right file image."""
+        p = str(tmp_path / "f.bin")
+        n = world.size
+        with zio.File(world, p, zio.MODE_CREATE | zio.MODE_RDWR) as f:
+            # rank r owns double-slot r of every n-double record:
+            # filetype = one double resized to an n-double extent
+            for r in range(n):
+                ftype = dt.create_resized(dt.DOUBLE, 0, n * 8)
+                f.set_view(r * 8, dt.DOUBLE, ftype, rank=r)
+            bufs = [
+                np.full(3, float(r), dtype=np.float64) for r in range(n)
+            ]
+            f.write_all(bufs)
+            f.set_view(0, dt.DOUBLE)  # flat view to inspect
+            image = f.read_at(0, 3 * n)
+        expect = np.tile(np.arange(n, dtype=np.float64), 3)
+        np.testing.assert_array_equal(image, expect)
+
+    def test_read_all_roundtrip(self, tmp_path, world):
+        p = str(tmp_path / "f.bin")
+        n = world.size
+        with zio.File(world, p, zio.MODE_CREATE | zio.MODE_RDWR) as f:
+            full = np.arange(4 * n, dtype=np.float64)
+            f.write_at(0, full)
+            for r in range(n):
+                f.set_view(r * 4 * 8, dt.DOUBLE, rank=r)  # block-partition
+            parts = f.read_all([4] * n)
+        for r in range(n):
+            np.testing.assert_array_equal(parts[r], full[4 * r:4 * r + 4])
+
+    def test_write_all_wrong_arity(self, tmp_path, world):
+        p = str(tmp_path / "f.bin")
+        with zio.File(world, p, zio.MODE_CREATE | zio.MODE_WRONLY) as f:
+            with pytest.raises(errors.ArgError):
+                f.write_all([np.zeros(1)])
+
+
+class TestSizes:
+    def test_size_ops(self, tmp_path, world):
+        p = str(tmp_path / "f.bin")
+        with zio.File(world, p, zio.MODE_CREATE | zio.MODE_RDWR) as f:
+            f.write_at(0, np.zeros(100, np.uint8))
+            assert f.get_size() == 100
+            f.set_size(40)
+            assert f.get_size() == 40
+            f.preallocate(200)
+            assert f.get_size() == 200
+            f.preallocate(50)  # never shrinks
+            assert f.get_size() == 200
+            f.sync()
+
+    def test_short_read_past_eof_zeros(self, tmp_path, world):
+        p = str(tmp_path / "f.bin")
+        with zio.File(world, p, zio.MODE_CREATE | zio.MODE_RDWR) as f:
+            f.write_at(0, np.arange(4, dtype=np.uint8))
+            got = f.read_at(0, 8)
+        np.testing.assert_array_equal(got[:4], np.arange(4, dtype=np.uint8))
+        np.testing.assert_array_equal(got[4:], 0)
+
+
+class TestSharded:
+    def test_roundtrip_host(self, tmp_path):
+        p = str(tmp_path / "a.zmpi")
+        a = np.random.default_rng(0).normal(size=(16, 8)).astype(np.float32)
+        zio.save_sharded(p, jnp.asarray(a))
+        got = zio.load_sharded(p)
+        np.testing.assert_array_equal(got, a)
+
+    def test_roundtrip_sharded(self, tmp_path, world):
+        p = str(tmp_path / "a.zmpi")
+        a = np.arange(64, dtype=np.float32).reshape(16, 4)
+        sharding = NamedSharding(world.mesh, P("world"))
+        arr = jax.device_put(jnp.asarray(a), sharding)
+        zio.save_sharded(p, arr)
+        # load back with a DIFFERENT layout (resharding through the file)
+        sharding2 = NamedSharding(world.mesh, P(None, None))
+        back = zio.load_sharded(p, sharding2)
+        np.testing.assert_array_equal(np.asarray(back), a)
+
+    def test_header_validation(self, tmp_path):
+        p = str(tmp_path / "bad.bin")
+        with open(p, "wb") as f:
+            f.write(b"garbage" * 100)
+        with pytest.raises(errors.ArgError):
+            zio.load_sharded(p)

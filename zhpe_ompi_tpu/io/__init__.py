@@ -1,0 +1,54 @@
+"""IO framework — MPI-IO re-designed for a single-controller array machine.
+
+Reference shape (SURVEY.md §2.3): ``ompi/mca/io`` with the ``ompio``
+component (``ompi/mca/io/ompio``), whose sub-frameworks split collective
+strategy (``fcoll``), filesystem ops (``fs``), file byte transfer
+(``fbtl``) and shared file pointers (``sharedfp``).
+
+TPU-native re-design:
+
+- A *file view* (``MPI_File_set_view``'s (disp, etype, filetype) triple) is
+  interpreted by the same datatype engine that drives pack/unpack — the
+  filetype's byte-index map tiles across the file exactly as
+  ``ompi/mca/common/ompio/common_ompio_file_view.c`` decodes it.
+- *Collective* IO on a single-controller machine: the controller holds
+  every rank's buffer, so the two-phase aggregation of
+  ``fcoll/two_phase`` collapses to "order the per-rank views, coalesce
+  adjacent extents, issue large contiguous operations" — done in
+  :meth:`File.write_all`/:meth:`File.read_all`.
+- The idiomatic fast path is :mod:`zhpe_ompi_tpu.io.sharded`: a JAX
+  ``NamedSharding`` IS a file view (each shard owns a disjoint file
+  extent), so sharded-array save/load is MPI_File_write_all where the
+  "ranks" are devices.
+- ``fs`` components (posix today) are selected through the MCA framework
+  machinery like every other component.
+"""
+
+from __future__ import annotations
+
+from .file import (
+    MODE_APPEND,
+    MODE_CREATE,
+    MODE_DELETE_ON_CLOSE,
+    MODE_EXCL,
+    MODE_RDONLY,
+    MODE_RDWR,
+    MODE_WRONLY,
+    File,
+    delete,
+)
+from .sharded import load_sharded, save_sharded
+
+__all__ = [
+    "File",
+    "delete",
+    "MODE_RDONLY",
+    "MODE_RDWR",
+    "MODE_WRONLY",
+    "MODE_CREATE",
+    "MODE_EXCL",
+    "MODE_APPEND",
+    "MODE_DELETE_ON_CLOSE",
+    "save_sharded",
+    "load_sharded",
+]

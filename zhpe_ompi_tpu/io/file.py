@@ -1,0 +1,359 @@
+"""MPI_File over the datatype engine.
+
+Reference anatomy: ``ompi/mca/io/ompio/io_ompio_file_open.c`` (open/modes),
+``common_ompio_file_view.c`` (the (disp, etype, filetype) view decode),
+``common_ompio_file_read/write.c`` (individual IO through the convertor),
+``fcoll/two_phase`` (collective aggregation), ``sharedfp/lockedfile``
+(shared pointer).  This module re-designs all four for a single-controller
+machine:
+
+- The view's filetype tiles across the file; element byte offsets come from
+  the SAME ``byte_index_map`` the message convertor uses — one engine for
+  wire and disk, as OMPIO reuses ``opal_convertor``.
+- Per-rank individual file pointers and per-rank views live in one File
+  object (the controller holds all ranks).
+- Collective write_all/read_all computes every rank's (offset, length)
+  runs, sorts and coalesces adjacent extents, then issues few large
+  pread/pwrite calls — the two-phase optimization without the exchange
+  phase (no inter-process data movement exists to optimize away).
+- The shared file pointer is an integer under a lock (sharedfp/sm analog).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+import numpy as np
+
+from ..core import errors
+from ..datatype import convertor
+from ..datatype.predefined import BYTE, Datatype
+from . import fs as fs_mod
+
+MODE_RDONLY = 0x01
+MODE_RDWR = 0x02
+MODE_WRONLY = 0x04
+MODE_CREATE = 0x08
+MODE_EXCL = 0x10
+MODE_APPEND = 0x20
+MODE_DELETE_ON_CLOSE = 0x40
+
+
+def _os_flags(mode: int) -> int:
+    rw = mode & (MODE_RDONLY | MODE_RDWR | MODE_WRONLY)
+    if rw == MODE_RDONLY:
+        flags = os.O_RDONLY
+    elif rw == MODE_WRONLY:
+        flags = os.O_WRONLY
+    elif rw == MODE_RDWR:
+        flags = os.O_RDWR
+    else:
+        raise errors.ArgError("exactly one of RDONLY/RDWR/WRONLY required")
+    if mode & MODE_CREATE:
+        flags |= os.O_CREAT
+    if mode & MODE_EXCL:
+        flags |= os.O_EXCL
+    # MODE_APPEND deliberately does NOT map to O_APPEND: Linux pwrite on an
+    # O_APPEND fd ignores its offset, which would corrupt every view-computed
+    # write.  MPI_MODE_APPEND means "file pointers start at EOF" — handled
+    # in File.__init__.
+    return flags
+
+
+class _View:
+    """One rank's (disp, etype, filetype) triple, pre-decoded into the
+    byte positions of one filetype tile (common_ompio_file_view.c)."""
+
+    def __init__(self, disp: int, etype: Datatype, filetype: Datatype):
+        if filetype.size % max(etype.size, 1) != 0:
+            raise errors.TypeError_(
+                f"filetype size {filetype.size} is not a multiple of etype "
+                f"size {etype.size}"
+            )
+        self.disp = disp
+        self.etype = etype
+        self.filetype = filetype
+        self.etypes_per_tile = filetype.size // etype.size if etype.size else 0
+        # byte positions of one tile's accessible bytes, in pack order
+        self.tile_positions = convertor.byte_index_map(filetype, 1)
+        self.tile_extent = filetype.extent
+
+    def byte_offsets(self, start_etype: int, count: int) -> np.ndarray:
+        """Absolute file byte offsets for `count` etypes starting at etype
+        index `start_etype` (int64 array of count*etype.size entries)."""
+        esz = self.etype.size
+        if count == 0 or esz == 0:
+            return np.empty(0, dtype=np.int64)
+        e = np.arange(start_etype, start_etype + count, dtype=np.int64)
+        tiles = e // self.etypes_per_tile
+        within = e % self.etypes_per_tile
+        segs = self.tile_positions.reshape(self.etypes_per_tile, esz)
+        return (
+            self.disp + tiles[:, None] * self.tile_extent + segs[within]
+        ).ravel()
+
+
+def _runs(offsets: np.ndarray) -> list[tuple[int, int]]:
+    """Coalesce sorted byte offsets into (start, length) contiguous runs."""
+    if offsets.size == 0:
+        return []
+    breaks = np.nonzero(np.diff(offsets) != 1)[0]
+    starts = np.concatenate(([0], breaks + 1))
+    ends = np.concatenate((breaks, [offsets.size - 1]))
+    return [
+        (int(offsets[s]), int(offsets[e] - offsets[s] + 1))
+        for s, e in zip(starts, ends)
+    ]
+
+
+class File:
+    """MPI_File analog; one object serves every rank of `comm`."""
+
+    def __init__(self, comm, path: str, mode: int = MODE_RDONLY):
+        self.comm = comm
+        self.path = path
+        self.mode = mode
+        self._fs = fs_mod.select_fs()
+        self._fd = self._fs.open(path, _os_flags(mode))
+        n = comm.size if comm is not None else 1
+        self._views = [_View(0, BYTE, BYTE) for _ in range(n)]
+        # MPI_MODE_APPEND: all pointers start at EOF (etype = BYTE at open)
+        start = self._fs.size(self._fd) if mode & MODE_APPEND else 0
+        self._pointers = [start] * n  # individual, in etype units
+        self._shared = start  # shared pointer, etype units of rank-0's view
+        self._lock = threading.Lock()
+        self._closed = False
+
+    # -- lifecycle -------------------------------------------------------
+
+    def close(self) -> None:
+        if not self._closed:
+            self._fs.close(self._fd)
+            self._closed = True
+            if self.mode & MODE_DELETE_ON_CLOSE:
+                self._fs.delete(self.path)
+
+    def __enter__(self) -> "File":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def _check_open(self) -> None:
+        if self._closed:
+            raise errors.ArgError("file is closed")
+
+    # -- view (MPI_File_set_view / get_view) ------------------------------
+
+    def set_view(self, disp: int, etype: Datatype,
+                 filetype: Datatype | None = None,
+                 rank: int | None = None) -> None:
+        """Set the view for one rank, or every rank when rank is None (the
+        common collective case where all ranks pass the same triple)."""
+        self._check_open()
+        view = _View(disp, etype, filetype or etype)
+        with self._lock:
+            if rank is None:
+                self._views = [view] * len(self._views)
+                self._pointers = [0] * len(self._pointers)
+                self._shared = 0
+            else:
+                self._views[rank] = view
+                self._pointers[rank] = 0
+
+    def get_view(self, rank: int = 0) -> tuple[int, Datatype, Datatype]:
+        v = self._views[rank]
+        return v.disp, v.etype, v.filetype
+
+    # -- byte-level engine ------------------------------------------------
+
+    def _read_offsets(self, offsets: np.ndarray) -> np.ndarray:
+        out = np.empty(offsets.size, dtype=np.uint8)
+        pos = 0
+        for start, length in _runs(offsets):
+            chunk = self._fs.pread(self._fd, length, start)
+            got = np.frombuffer(chunk, dtype=np.uint8)
+            out[pos:pos + got.size] = got
+            if got.size < length:  # short read past EOF → zeros (MPI: count)
+                out[pos + got.size:pos + length] = 0
+            pos += length
+        return out
+
+    def _write_offsets(self, offsets: np.ndarray, data: np.ndarray) -> None:
+        pos = 0
+        for start, length in _runs(offsets):
+            self._fs.pwrite(
+                self._fd, data[pos:pos + length].tobytes(), start
+            )
+            pos += length
+
+    def _as_bytes(self, buf, view: _View, count: int) -> np.ndarray:
+        arr = np.ascontiguousarray(buf)
+        data = arr.reshape(-1).view(np.uint8)
+        need = count * view.etype.size
+        if data.size < need:
+            raise errors.TruncateError(
+                f"buffer {data.size}B < {need}B ({count} etypes)"
+            )
+        return data[:need]
+
+    # -- explicit-offset IO (MPI_File_read_at / write_at) -----------------
+
+    def read_at(self, offset: int, count: int, rank: int = 0) -> np.ndarray:
+        """Read `count` etypes at etype-offset `offset` through the rank's
+        view; returns an array of the etype's numpy dtype (or raw bytes)."""
+        self._check_open()
+        v = self._views[rank]
+        raw = self._read_offsets(v.byte_offsets(offset, count))
+        dt = getattr(v.etype, "np_dtype", None)
+        return raw.view(dt) if dt is not None else raw
+
+    def _full_count(self, buf, v: _View) -> int:
+        """Etype count of a whole buffer; rejects trailing partial etypes
+        (same contract for every write entry point)."""
+        nbytes = np.ascontiguousarray(buf).nbytes
+        if v.etype.size and nbytes % v.etype.size:
+            raise errors.TypeError_(
+                f"buffer ({nbytes}B) is not a whole number of etypes "
+                f"({v.etype.size}B)"
+            )
+        return nbytes // v.etype.size if v.etype.size else 0
+
+    def write_at(self, offset: int, buf, count: int | None = None,
+                 rank: int = 0) -> int:
+        """Write `count` etypes (default: full buffer) at etype-offset
+        `offset`; returns etypes written."""
+        self._check_open()
+        v = self._views[rank]
+        if count is None:
+            count = self._full_count(buf, v)
+        data = self._as_bytes(buf, v, count)
+        self._write_offsets(v.byte_offsets(offset, count), data)
+        return count
+
+    # -- individual-pointer IO (MPI_File_read / write) --------------------
+
+    def read(self, count: int, rank: int = 0) -> np.ndarray:
+        with self._lock:
+            off = self._pointers[rank]
+            self._pointers[rank] += count
+        return self.read_at(off, count, rank)
+
+    def write(self, buf, count: int | None = None, rank: int = 0) -> int:
+        v = self._views[rank]
+        if count is None:
+            count = self._full_count(buf, v)
+        with self._lock:
+            off = self._pointers[rank]
+            self._pointers[rank] += count
+        return self.write_at(off, buf, count, rank)
+
+    def seek(self, offset: int, rank: int = 0) -> None:
+        with self._lock:
+            self._pointers[rank] = offset
+
+    def tell(self, rank: int = 0) -> int:
+        with self._lock:
+            return self._pointers[rank]
+
+    # -- shared-pointer IO (MPI_File_read/write_shared) -------------------
+
+    def write_shared(self, buf, count: int | None = None) -> int:
+        """Atomic fetch-and-add on the shared pointer then write through
+        rank 0's view (sharedfp semantics: ordering is first-come)."""
+        v = self._views[0]
+        if count is None:
+            count = self._full_count(buf, v)
+        with self._lock:
+            off = self._shared
+            self._shared += count
+        return self.write_at(off, buf, count, rank=0)
+
+    def read_shared(self, count: int) -> np.ndarray:
+        with self._lock:
+            off = self._shared
+            self._shared += count
+        return self.read_at(off, count, rank=0)
+
+    # -- collective IO (MPI_File_write_all / read_all) --------------------
+
+    def write_all(self, bufs: list) -> int:
+        """Every rank writes its buffer at its individual pointer through
+        its view; extents from all ranks are sorted and coalesced into few
+        large writes (the fcoll/two_phase aggregation, minus the exchange
+        phase a single controller doesn't need).  Returns total etypes."""
+        self._check_open()
+        if len(bufs) != len(self._views):
+            raise errors.ArgError(
+                f"need one buffer per rank ({len(self._views)})"
+            )
+        all_offsets, all_bytes, total = [], [], 0
+        with self._lock:
+            for r, buf in enumerate(bufs):
+                v = self._views[r]
+                count = self._full_count(buf, v)
+                data = self._as_bytes(buf, v, count)
+                offs = v.byte_offsets(self._pointers[r], count)
+                self._pointers[r] += count
+                all_offsets.append(offs)
+                all_bytes.append(data)
+                total += count
+        offsets = np.concatenate(all_offsets) if all_offsets else (
+            np.empty(0, np.int64))
+        data = np.concatenate(all_bytes) if all_bytes else (
+            np.empty(0, np.uint8))
+        order = np.argsort(offsets, kind="stable")
+        self._write_offsets(offsets[order], data[order])
+        return total
+
+    def read_all(self, counts: list[int]) -> list[np.ndarray]:
+        """Collective read: rank r reads counts[r] etypes at its pointer.
+        One aggregated pass over the file, then scatter to per-rank
+        buffers."""
+        self._check_open()
+        if len(counts) != len(self._views):
+            raise errors.ArgError("need one count per rank")
+        per_rank_offs = []
+        with self._lock:
+            for r, count in enumerate(counts):
+                v = self._views[r]
+                per_rank_offs.append(v.byte_offsets(self._pointers[r], count))
+                self._pointers[r] += count
+        offsets = np.concatenate(per_rank_offs) if per_rank_offs else (
+            np.empty(0, np.int64))
+        order = np.argsort(offsets, kind="stable")
+        gathered = np.empty(offsets.size, dtype=np.uint8)
+        gathered[order] = self._read_offsets(offsets[order])
+        out, pos = [], 0
+        for r, offs in enumerate(per_rank_offs):
+            raw = gathered[pos:pos + offs.size]
+            pos += offs.size
+            dt = getattr(self._views[r].etype, "np_dtype", None)
+            out.append(raw.view(dt) if dt is not None else raw)
+        return out
+
+    # -- size management --------------------------------------------------
+
+    def get_size(self) -> int:
+        self._check_open()
+        return self._fs.size(self._fd)
+
+    def set_size(self, size: int) -> None:
+        self._check_open()
+        self._fs.resize(self._fd, size)
+
+    def preallocate(self, size: int) -> None:
+        """MPI_File_preallocate: ensure `size` bytes exist."""
+        self._check_open()
+        if self._fs.size(self._fd) < size:
+            self._fs.resize(self._fd, size)
+
+    def sync(self) -> None:
+        self._check_open()
+        self._fs.sync(self._fd)
+
+
+def delete(path: str) -> None:
+    """MPI_File_delete."""
+    fs_mod.select_fs().delete(path)

@@ -1,0 +1,91 @@
+"""Sharded-array IO — the TPU-native MPI_File_write_all.
+
+The insight this module encodes: a JAX ``NamedSharding`` is exactly an
+MPI-IO *file view* — each device owns a disjoint index-set of the global
+array, as each MPI rank's (disp, etype, filetype) view tiles a disjoint
+byte-set of the file (``common_ompio_file_view.c``).  So collective array
+IO needs no new machinery: every addressable shard reads/writes its own
+extent of one flat file, which is what ``fcoll``'s aggregation strategies
+(two_phase/vulcan, SURVEY.md §2.3) reconstruct laboriously from per-rank
+requests.
+
+Format: a fixed 512-byte JSON header (magic, dtype, shape) followed by the
+array in C order.  Multi-host note: each controller writes only its
+addressable shards, so the format works under ``jax.distributed`` when all
+hosts see a shared filesystem — the same contract MPI-IO itself assumes.
+"""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+
+import jax
+
+from ..core import errors
+
+_MAGIC = "ZMPIARR1"
+_HEADER = 512
+
+
+def _header_bytes(arr) -> bytes:
+    h = json.dumps({
+        "magic": _MAGIC,
+        "dtype": str(np.dtype(arr.dtype)),
+        "shape": list(arr.shape),
+    }).encode()
+    if len(h) > _HEADER - 1:
+        raise errors.ArgError("header overflow (shape rank too large?)")
+    return h + b" " * (_HEADER - len(h))
+
+
+def _read_header(path: str) -> tuple[np.dtype, tuple[int, ...]]:
+    with open(path, "rb") as f:
+        raw = f.read(_HEADER)
+    try:
+        meta = json.loads(raw.decode().strip())
+        if meta.get("magic") != _MAGIC:
+            raise ValueError
+    except (ValueError, UnicodeDecodeError):
+        raise errors.ArgError(f"{path} is not a zmpi sharded-array file")
+    return np.dtype(meta["dtype"]), tuple(meta["shape"])
+
+
+def save_sharded(path: str, arr) -> None:
+    """Write a (possibly sharded) jax array: every addressable shard stores
+    its slice at the file offsets its sharding index dictates."""
+    header = _header_bytes(arr)
+    with open(path, "wb") as f:
+        f.write(header)
+        f.truncate(_HEADER + int(np.prod(arr.shape or (1,)))
+                   * np.dtype(arr.dtype).itemsize)
+    mm = np.memmap(path, dtype=np.dtype(arr.dtype), mode="r+",
+                   offset=_HEADER, shape=tuple(arr.shape))
+    if hasattr(arr, "addressable_shards"):
+        seen = set()
+        for shard in arr.addressable_shards:
+            key = tuple(
+                (s.start, s.stop, s.step) for s in shard.index
+            ) if shard.index else ("scalar",)
+            if key in seen:  # replicated shards: write once
+                continue
+            seen.add(key)
+            mm[shard.index] = np.asarray(shard.data)
+    else:
+        mm[...] = np.asarray(arr)
+    mm.flush()
+    del mm
+
+
+def load_sharded(path: str, sharding=None):
+    """Read an array saved by :func:`save_sharded`.  With a `sharding`,
+    each device materializes only its own extent (the collective-read
+    path); without one, returns a host numpy array."""
+    dtype, shape = _read_header(path)
+    mm = np.memmap(path, dtype=dtype, mode="r", offset=_HEADER, shape=shape)
+    if sharding is None:
+        return np.array(mm)
+    return jax.make_array_from_callback(
+        shape, sharding, lambda idx: np.array(mm[idx])
+    )

@@ -1,0 +1,207 @@
+"""Checkpoint/restart — the crs/crcp lineage re-imagined as async array
+snapshots.
+
+Reference shape (SURVEY.md §5): ``opal/mca/crs/{none,self}`` single-process
+checkpoint, ``ompi/mca/crcp/bkmrk`` message bookmarking,
+``vprotocol/pessimist`` message logging, CLIs ``opal-checkpoint`` /
+``opal-restart``.  That machinery exists because MPI processes carry
+in-flight wire state that must be quiesced or logged.  On a
+single-controller SPMD machine the program state IS a pytree of arrays
+between steps, so the idiomatic equivalent (noted in SURVEY.md §5) is an
+orbax-style async snapshot:
+
+- ``Checkpointer.save(step, state)`` snapshots device arrays to host, then
+  writes in a background thread (computation overlaps IO — the reason the
+  reference interleaves checkpoint with the progress engine).
+- Atomicity via the write-to-tmp-then-rename protocol; a crashed writer
+  leaves only a ``.tmp`` directory that restore ignores (crs/self's
+  handshake analog).
+- ``restore()`` returns the newest complete checkpoint; retention keeps
+  the last k (``keep``).
+- The host-plane contract replacing crcp/bkmrk: checkpoint at a quiescent
+  point (no outstanding host-plane requests); :func:`quiesce_check` makes
+  the contract checkable instead of implicit.
+
+Arrays are stored via :mod:`zhpe_ompi_tpu.io.sharded`, so a sharded state
+restores with each device reading only its extent.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import threading
+
+import numpy as np
+
+import jax
+
+from ..core import errors
+from ..io import sharded
+from ..mca import output as mca_output
+
+_stream = mca_output.open_stream("checkpoint")
+
+_STEP_PREFIX = "step_"
+
+
+def quiesce_check() -> None:
+    """Raise if host-plane pt2pt queues are non-empty (the checkable form
+    of crcp/bkmrk's 'drain in-flight messages first' protocol)."""
+    from ..pt2pt import universe as uni_mod
+
+    posted = uni_mod._queue_depth("posted")
+    unexpected = uni_mod._queue_depth("unexpected")
+    if posted or unexpected:
+        raise errors.InternalError(
+            f"checkpoint at non-quiescent point: {posted} posted recvs, "
+            f"{unexpected} unexpected messages in flight"
+        )
+
+
+class Checkpointer:
+    """Async checkpoint manager over a directory."""
+
+    def __init__(self, directory: str, keep: int = 3,
+                 check_quiescent: bool = True):
+        self.directory = directory
+        self.keep = keep
+        self.check_quiescent = check_quiescent
+        os.makedirs(directory, exist_ok=True)
+        self._worker: threading.Thread | None = None
+        self._error: BaseException | None = None
+
+    # -- save ------------------------------------------------------------
+
+    def save(self, step: int, state, blocking: bool = False) -> None:
+        """Snapshot `state` (a pytree of arrays) at `step`.  Device→host
+        transfer happens NOW (so the caller may donate/overwrite buffers);
+        disk writes happen in the background unless `blocking`."""
+        if self.check_quiescent:
+            quiesce_check()
+        self.wait()  # one outstanding checkpoint at a time (orbax contract)
+        leaves, treedef = jax.tree_util.tree_flatten(state)
+        # snapshot to host before returning control (np.array COPIES even
+        # for host leaves — the caller may overwrite its buffers right away).
+        # Single-controller semantics: the controller materializes each full
+        # array; sharded RESTORE still places per-device extents directly.
+        host_leaves = [np.array(leaf) for leaf in leaves]
+
+        def write():
+            try:
+                self._write(step, host_leaves, treedef)
+            except BaseException as e:  # noqa: BLE001 - surfaced in wait()
+                self._error = e
+
+        if blocking:
+            write()
+            self._raise_pending()
+        else:
+            self._worker = threading.Thread(target=write, daemon=True)
+            self._worker.start()
+
+    def _write(self, step, host_leaves, treedef) -> None:
+        final = os.path.join(self.directory, f"{_STEP_PREFIX}{step}")
+        tmp = final + ".tmp"
+        if os.path.exists(tmp):
+            shutil.rmtree(tmp)
+        os.makedirs(tmp)
+        for i, leaf in enumerate(host_leaves):
+            sharded.save_sharded(os.path.join(tmp, f"leaf_{i}.zmpi"), leaf)
+        meta = {
+            "step": step,
+            "n_leaves": len(host_leaves),
+            "treedef": str(treedef),
+        }
+        with open(os.path.join(tmp, "meta.json"), "w") as f:
+            json.dump(meta, f)
+        # pytree structure, restorable without the original code layout
+        with open(os.path.join(tmp, "treedef.pkl"), "wb") as f:
+            import pickle
+
+            pickle.dump(treedef, f)
+        if os.path.isdir(final):
+            # re-checkpointing a step (crash-restart reruns it): retire the
+            # old version first; rename below republishes atomically
+            old = final + ".old"
+            if os.path.exists(old):
+                shutil.rmtree(old)
+            os.replace(final, old)
+            os.replace(tmp, final)
+            shutil.rmtree(old, ignore_errors=True)
+        else:
+            os.replace(tmp, final)  # atomic publish
+        mca_output.verbose(1, _stream, "checkpoint step %d written", step)
+        self._retain()
+
+    def _retain(self) -> None:
+        steps = self.all_steps()
+        for s in steps[:-self.keep] if self.keep > 0 else []:
+            shutil.rmtree(
+                os.path.join(self.directory, f"{_STEP_PREFIX}{s}"),
+                ignore_errors=True,
+            )
+
+    # -- wait/err --------------------------------------------------------
+
+    def wait(self) -> None:
+        """Block until the outstanding async save completes; re-raise its
+        error if it failed."""
+        if self._worker is not None:
+            self._worker.join()
+            self._worker = None
+        self._raise_pending()
+
+    def _raise_pending(self) -> None:
+        if self._error is not None:
+            e, self._error = self._error, None
+            raise errors.InternalError(f"checkpoint write failed: {e!r}")
+
+    # -- restore ---------------------------------------------------------
+
+    def all_steps(self) -> list[int]:
+        """Complete checkpoints, ascending (ignores .tmp partials)."""
+        out = []
+        for name in os.listdir(self.directory):
+            if name.startswith(_STEP_PREFIX) and not name.endswith(".tmp"):
+                try:
+                    out.append(int(name[len(_STEP_PREFIX):]))
+                except ValueError:
+                    continue
+        return sorted(out)
+
+    def latest_step(self) -> int | None:
+        steps = self.all_steps()
+        return steps[-1] if steps else None
+
+    def restore(self, step: int | None = None, shardings=None):
+        """Load a checkpoint (default: newest).  `shardings`: optional
+        pytree-of-shardings matching the state — each leaf then
+        materializes directly onto its devices."""
+        if step is None:
+            step = self.latest_step()
+            if step is None:
+                raise errors.ArgError(
+                    f"no checkpoint found in {self.directory}"
+                )
+        d = os.path.join(self.directory, f"{_STEP_PREFIX}{step}")
+        if not os.path.isdir(d):
+            raise errors.ArgError(f"no checkpoint for step {step}")
+        with open(os.path.join(d, "meta.json")) as f:
+            meta = json.load(f)
+        with open(os.path.join(d, "treedef.pkl"), "rb") as f:
+            import pickle
+
+            treedef = pickle.load(f)
+        shard_leaves = (
+            jax.tree_util.tree_flatten(shardings)[0]
+            if shardings is not None else [None] * meta["n_leaves"]
+        )
+        leaves = [
+            sharded.load_sharded(
+                os.path.join(d, f"leaf_{i}.zmpi"), shard_leaves[i]
+            )
+            for i in range(meta["n_leaves"])
+        ]
+        return jax.tree_util.tree_unflatten(treedef, leaves), step

@@ -144,7 +144,7 @@ class CartTopology:
             raise errors.ArgError(f"dim {dim} out of range")
         cached = self._shift_cache.get((dim, disp))
         if cached is not None:
-            return cached
+            return list(cached[0]), list(cached[1])  # copies: cache is live
 
         def moved(delta: int) -> list[int]:
             c = self._coords.astype(np.int64).copy()
@@ -161,7 +161,7 @@ class CartTopology:
 
         result = (moved(-disp), moved(disp))  # (sources, dests)
         self._shift_cache[(dim, disp)] = result
-        return result
+        return list(result[0]), list(result[1])
 
     def shift_exchange(self, x, dim: int, disp: int = 1):
         """Traced: every rank sends `x` to its +disp neighbor along `dim`
