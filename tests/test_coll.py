@@ -40,6 +40,8 @@ ALLREDUCE_ALGS = [
     alg.allreduce_ring,
     alg.allreduce_rabenseifner,
     alg.allreduce_linear,
+    alg.allreduce_nonoverlapping,
+    alg.allreduce_segmented_ring,
     xla_mod.allreduce,
 ]
 
@@ -153,6 +155,16 @@ class TestBcast:
         (alg.bcast_chain, 5),
         (alg.bcast_scatter_allgather, 0),
         (alg.bcast_scatter_allgather, 2),
+        (alg.bcast_linear, 0),
+        (alg.bcast_linear, 4),
+        (alg.bcast_binary, 0),
+        (alg.bcast_binary, 3),
+        (alg.bcast_pipeline, 0),
+        (alg.bcast_pipeline, 2),
+        (alg.bcast_split_binary, 0),
+        (alg.bcast_split_binary, 5),
+        (alg.bcast_knomial, 0),
+        (alg.bcast_knomial, 1),
         (xla_mod.bcast, 0),
         (xla_mod.bcast, 6),
     ], ids=lambda p: getattr(p, "__name__", str(p)))
@@ -164,11 +176,16 @@ class TestBcast:
 
 
 class TestReduce:
+    @pytest.mark.parametrize("algo", [
+        alg.reduce_binomial, alg.reduce_chain, alg.reduce_pipeline,
+        alg.reduce_binary, alg.reduce_rabenseifner, alg.reduce_linear,
+        alg.reduce_in_order_binary,
+    ], ids=lambda f: f.__name__)
     @pytest.mark.parametrize("root", [0, 4])
-    def test_binomial(self, world, root):
+    def test_sum(self, world, algo, root):
         x = rng(9).normal(size=(N, 5)).astype(np.float32)
         out = run_spmd(
-            world, lambda s: alg.reduce_binomial(world, s, zmpi.SUM, root), x
+            world, lambda s: algo(world, s, zmpi.SUM, root), x
         ).reshape(N, 5)
         np.testing.assert_allclose(out[root], x.sum(axis=0), rtol=1e-5)
 
@@ -176,7 +193,8 @@ class TestReduce:
 class TestAllgather:
     @pytest.mark.parametrize("algo", [
         alg.allgather_ring, alg.allgather_bruck,
-        alg.allgather_recursive_doubling, xla_mod.allgather,
+        alg.allgather_recursive_doubling, alg.allgather_neighbor_exchange,
+        alg.allgather_linear, xla_mod.allgather,
     ], ids=lambda f: f.__name__)
     def test_allgather(self, world, algo):
         x = rng(10).normal(size=(N, 2)).astype(np.float32)
@@ -192,7 +210,8 @@ class TestAllgather:
 
 class TestAlltoall:
     @pytest.mark.parametrize("algo", [
-        alg.alltoall_pairwise, alg.alltoall_bruck, xla_mod.alltoall,
+        alg.alltoall_pairwise, alg.alltoall_bruck, alg.alltoall_linear,
+        alg.alltoall_linear_sync, xla_mod.alltoall,
     ], ids=lambda f: f.__name__)
     def test_alltoall(self, world, algo):
         # global matrix: row i holds blocks destined to each rank
@@ -208,7 +227,12 @@ class TestAlltoall:
 class TestReduceScatter:
     @pytest.mark.parametrize("algo", [
         alg.reduce_scatter_ring, alg.reduce_scatter_recursive_halving,
-        xla_mod.reduce_scatter,
+        alg.reduce_scatter_nonoverlapping, alg.reduce_scatter_butterfly,
+        alg.reduce_scatter_block_linear,
+        alg.reduce_scatter_block_recursive_doubling,
+        alg.reduce_scatter_block_recursive_halving,
+        alg.reduce_scatter_block_butterfly,
+        xla_mod.reduce_scatter, xla_mod.reduce_scatter_block,
     ], ids=lambda f: f.__name__)
     def test_sum(self, world, algo):
         m = 2
@@ -257,19 +281,79 @@ class TestScanBarrier:
         expect = np.maximum.accumulate(x.reshape(N))[:-1]
         np.testing.assert_allclose(out[1:], expect)
 
-    def test_barrier(self, world):
-        out = run_spmd(world, lambda s: alg.barrier_dissemination(world) + 0 * s[0],
+    def test_scan_linear(self, world):
+        x = rng(12).normal(size=(N, 4)).astype(np.float32)
+        out = run_spmd(
+            world, lambda s: alg.scan_linear(world, s, zmpi.SUM), x
+        ).reshape(N, 4)
+        np.testing.assert_allclose(out, np.cumsum(x, axis=0), rtol=1e-4)
+
+    def test_exscan_linear_prod(self, world):
+        x = np.arange(1, N + 1, dtype=np.float32).reshape(N, 1)
+        out = run_spmd(
+            world, lambda s: alg.exscan_linear(world, s, zmpi.PROD), x
+        ).reshape(N)
+        expect = np.concatenate([[0], np.cumprod(x.reshape(N))[:-1]])
+        np.testing.assert_allclose(out[1:], expect[1:])  # rank 0 undefined
+
+    @pytest.mark.parametrize("algo", [
+        alg.barrier_dissemination, alg.barrier_double_ring,
+        alg.barrier_recursive_doubling, alg.barrier_tree,
+        alg.barrier_linear, xla_mod.barrier,
+    ], ids=lambda f: f.__name__)
+    def test_barrier(self, world, algo):
+        out = run_spmd(world, lambda s: algo(world) + 0 * s[0],
                        np.zeros((N, 1), np.float32))
         assert np.all(out == 0)
 
 
 class TestScatter:
+    @pytest.mark.parametrize("algo", [alg.scatter_linear,
+                                      alg.scatter_binomial],
+                             ids=lambda f: f.__name__)
     @pytest.mark.parametrize("root", [0, 3])
-    def test_scatter_linear(self, world, root):
+    def test_scatter(self, world, algo, root):
         x = np.arange(N * 2, dtype=np.float32)
         xs = np.tile(x, (N, 1))  # every rank holds the (root's) buffer
-        out = run_spmd(world, lambda s: alg.scatter_linear(world, s, root), xs)
+        out = run_spmd(world, lambda s: algo(world, s, root), xs)
         np.testing.assert_allclose(out.reshape(N, 2), x.reshape(N, 2))
+
+
+class TestGatherBinomial:
+    @pytest.mark.parametrize("root", [0, 3])
+    def test_gather(self, world, root):
+        x = rng(21).normal(size=(N, 2)).astype(np.float32)
+        out = run_spmd(
+            world, lambda s: alg.gather_binomial(world, s, root), x,
+        )
+        # result significant at root: check root's slice of the output
+        out = out.reshape(N, N * 2)
+        np.testing.assert_allclose(out[root], x.reshape(-1))
+
+
+class TestAlltoallv:
+    def _counts(self):
+        # counts[i][j]: rows i sends to j — deliberately ragged
+        return [[(i + j) % 3 for j in range(N)] for i in range(N)]
+
+    @pytest.mark.parametrize("impl", ["alg", "xla"])
+    def test_alltoallv(self, world, impl):
+        counts = self._counts()
+        mx = max(max(r) for r in counts)
+        data = rng(22).normal(size=(N, N, mx, 2)).astype(np.float32)
+        # zero out rows beyond the count so the reference is unambiguous
+        for i in range(N):
+            for j in range(N):
+                data[i, j, counts[i][j]:] = 0.0
+        fn = (alg.alltoallv_padded if impl == "alg" else xla_mod.alltoallv)
+        out = run_spmd(
+            world,
+            lambda s: fn(world, s.reshape(N, mx, 2), counts),
+            data.reshape(N, N * mx * 2),
+        )
+        out = out.reshape(N, N, mx, 2)
+        expect = np.swapaxes(data, 0, 1)
+        np.testing.assert_allclose(out, expect)
 
 
 class TestAllgatherv:
@@ -284,6 +368,74 @@ class TestAllgatherv:
         )
         expect = np.concatenate([data[i, : counts[i]] for i in range(N)])
         np.testing.assert_allclose(out.reshape(N, -1)[0], expect)
+
+
+class TestTwoProc:
+    """Exercise the real n==2 branches of the two_proc algorithms on 2-rank
+    split communicators (cf. coll_base_allgather.c:598, alltoall.c:490,
+    barrier.c:291)."""
+
+    @pytest.fixture(scope="class")
+    def pairs_comm(self, world):
+        return world.split([i // 2 for i in range(N)])  # 4 groups of 2
+
+    def test_allgather_two_proc(self, world, pairs_comm):
+        x = rng(30).normal(size=(N, 3)).astype(np.float32)
+        out = run_spmd(
+            pairs_comm, lambda s: alg.allgather_two_proc(pairs_comm, s), x
+        ).reshape(N, 2, 3)
+        for g in range(N // 2):
+            expect = x[2 * g : 2 * g + 2]
+            np.testing.assert_allclose(out[2 * g], expect)
+            np.testing.assert_allclose(out[2 * g + 1], expect)
+
+    def test_alltoall_two_proc(self, world, pairs_comm):
+        x = np.arange(N * 4, dtype=np.float32).reshape(N, 4)
+        out = run_spmd(
+            pairs_comm,
+            lambda s: alg.alltoall_two_proc(pairs_comm, s.reshape(4)), x,
+        ).reshape(N, 2, 2)
+        blocks = x.reshape(N, 2, 2)
+        for g in range(N // 2):
+            a, b = 2 * g, 2 * g + 1
+            np.testing.assert_allclose(out[a], [blocks[a, 0], blocks[b, 0]])
+            np.testing.assert_allclose(out[b], [blocks[a, 1], blocks[b, 1]])
+
+    def test_barrier_two_proc(self, world, pairs_comm):
+        out = run_spmd(
+            pairs_comm,
+            lambda s: alg.barrier_two_proc(pairs_comm) + 0 * s[0],
+            np.zeros((N, 1), np.float32),
+        )
+        assert np.all(out == 0)
+
+
+class TestBarrierNotFolded:
+    """Regression: `token * 0` on int32 lets XLA constant-fold the token and
+    dead-code-eliminate the barrier's collectives.  The compiled HLO must
+    retain its collective ops."""
+
+    @pytest.mark.parametrize("algo", [
+        alg.barrier_dissemination, alg.barrier_double_ring,
+        alg.barrier_recursive_doubling, alg.barrier_tree,
+        alg.barrier_linear, xla_mod.barrier,
+    ], ids=lambda f: f.__name__)
+    def test_collectives_survive_compilation(self, world, algo):
+        from jax.sharding import PartitionSpec as P
+
+        def step(s):
+            tok = algo(world, token=s)
+            return s + tok.astype(s.dtype)
+
+        fn = jax.shard_map(
+            step, mesh=world.mesh, in_specs=P("world"), out_specs=P("world")
+        )
+        txt = jax.jit(fn).lower(
+            jnp.zeros((N, 2), jnp.float32)
+        ).compile().as_text()
+        assert ("collective-permute" in txt) or ("all-reduce" in txt), (
+            f"{algo.__name__}: barrier collectives were optimized away"
+        )
 
 
 class TestSplitComms:

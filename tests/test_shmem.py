@@ -1,0 +1,292 @@
+"""OSHMEM-analog tests (reference: oshmem/, SURVEY.md §2.5) — the shape of
+the reference's OpenSHMEM examples (examples/hello_oshmem_c.c,
+oshmem_circular_shift.c, oshmem_symmetric_data.c, oshmem_strided_puts.c,
+oshmem_max_reduction.c) as in-process acceptance tests."""
+
+import numpy as np
+import pytest
+
+from zhpe_ompi_tpu import shmem
+from zhpe_ompi_tpu.core import errors
+from zhpe_ompi_tpu.shmem.memheap import ALIGN, SymmetricHeapAllocator
+
+N = 4
+
+
+@pytest.fixture()
+def universe():
+    return shmem.shmem_universe(N, heap_bytes=1 << 16)
+
+
+class TestMemheap:
+    def test_alloc_deterministic_and_aligned(self):
+        a = SymmetricHeapAllocator(4096)
+        b = SymmetricHeapAllocator(4096)
+        offs_a = [a.alloc(10), a.alloc(100), a.alloc(64)]
+        offs_b = [b.alloc(10), b.alloc(100), b.alloc(64)]
+        assert offs_a == offs_b  # symmetric contract
+        assert all(o % ALIGN == 0 for o in offs_a)
+
+    def test_free_coalesce_reuse(self):
+        a = SymmetricHeapAllocator(4096)
+        o1 = a.alloc(64)
+        o2 = a.alloc(64)
+        a.free(o1)
+        a.free(o2)
+        assert a.alloc(128) == o1  # coalesced extent reused first-fit
+        assert a.live_bytes == 128
+
+    def test_exhaustion(self):
+        a = SymmetricHeapAllocator(128)
+        a.alloc(128)
+        with pytest.raises(errors.ResourceError):
+            a.alloc(1)
+
+
+class TestPutGet:
+    def test_circular_shift(self, universe):
+        """oshmem_circular_shift_c analog: each PE puts its rank into its
+        right neighbor's symmetric variable."""
+        uni, pes = universe
+
+        def pe_main(ctx):
+            pe = pes[ctx.rank]
+            sym = pe.shmalloc(1, np.int64)
+            pe.local(sym)[...] = -1
+            pe.barrier_all()
+            pe.put(sym, pe.my_pe(), (pe.my_pe() + 1) % pe.n_pes())
+            pe.barrier_all()
+            return int(pe.local(sym)[0])
+
+        results = uni.run(pe_main)
+        assert results == [(r - 1) % N for r in range(N)]
+
+    def test_p_g_single_element(self, universe):
+        uni, pes = universe
+
+        def pe_main(ctx):
+            pe = pes[ctx.rank]
+            sym = pe.shmalloc(8, np.float64)
+            pe.local(sym)[...] = 0
+            pe.barrier_all()
+            # every PE writes its rank into slot rank of PE 0
+            pe.p(sym, float(pe.my_pe() + 1), 0, index=pe.my_pe())
+            pe.barrier_all()
+            return pe.g(sym, 0, index=(pe.my_pe() + 1) % pe.n_pes())
+
+        results = uni.run(pe_main)
+        assert results == [float(((r + 1) % N) + 1) for r in range(N)]
+
+    def test_strided_iput(self, universe):
+        """oshmem_strided_puts_c analog."""
+        uni, pes = universe
+
+        def pe_main(ctx):
+            pe = pes[ctx.rank]
+            sym = pe.shmalloc(10, np.int64)
+            pe.local(sym)[...] = 0
+            pe.barrier_all()
+            if pe.my_pe() == 0:
+                pe.iput(sym, np.arange(5), 1, tst=2, sst=1)
+            pe.barrier_all()
+            return pe.local(sym).copy()
+
+        results = uni.run(pe_main)
+        expect = np.zeros(10, np.int64)
+        expect[0:10:2] = np.arange(5)
+        np.testing.assert_array_equal(results[1], expect)
+
+    def test_symmetric_free_and_realloc(self, universe):
+        uni, pes = universe
+
+        def pe_main(ctx):
+            pe = pes[ctx.rank]
+            s1 = pe.shmalloc(16, np.float32)
+            off1 = s1.offset
+            pe.shfree(s1)
+            s2 = pe.shmalloc(16, np.float32)
+            return (off1, s2.offset)
+
+        results = uni.run(pe_main)
+        assert all(r == results[0] for r in results)
+        assert results[0][0] == results[0][1]  # freed space reused
+
+
+class TestAtomics:
+    def test_fetch_add_all_pes(self, universe):
+        uni, pes = universe
+
+        def pe_main(ctx):
+            pe = pes[ctx.rank]
+            sym = pe.shmalloc(1, np.int64)
+            pe.local(sym)[...] = 0
+            pe.barrier_all()
+            olds = [pe.atomic_fetch_add(sym, 1, 0) for _ in range(100)]
+            pe.barrier_all()
+            return int(pe.local(sym)[0]), olds
+
+        results = uni.run(pe_main)
+        assert results[0][0] == N * 100  # no lost updates
+        all_olds = sorted(o for _, olds in results for o in olds)
+        assert all_olds == list(range(N * 100))  # each ticket unique
+
+    def test_compare_swap(self, universe):
+        uni, pes = universe
+
+        def pe_main(ctx):
+            pe = pes[ctx.rank]
+            sym = pe.shmalloc(1, np.int64)
+            pe.local(sym)[...] = -1
+            pe.barrier_all()
+            # every PE races to claim PE 0's slot; exactly one wins
+            old = pe.atomic_compare_swap(sym, -1, pe.my_pe(), 0)
+            pe.barrier_all()
+            return int(old), int(pe.local(sym)[0]) if pe.my_pe() == 0 else None
+
+        results = uni.run(pe_main)
+        winners = [r for r, (old, _) in enumerate(results) if old == -1]
+        assert len(winners) == 1
+        assert results[0][1] == winners[0]
+
+    def test_swap_and_set(self, universe):
+        uni, pes = universe
+
+        def pe_main(ctx):
+            pe = pes[ctx.rank]
+            sym = pe.shmalloc(1, np.float64)
+            pe.local(sym)[...] = float(pe.my_pe())
+            pe.barrier_all()
+            if pe.my_pe() == 1:
+                old = pe.atomic_swap(sym, 99.0, 0)
+                assert old == 0.0
+            pe.barrier_all()
+            return float(pe.atomic_fetch(sym, 0))
+
+        assert all(v == 99.0 for v in uni.run(pe_main))
+
+
+class TestSync:
+    def test_wait_until(self, universe):
+        uni, pes = universe
+
+        def pe_main(ctx):
+            pe = pes[ctx.rank]
+            flag = pe.shmalloc(1, np.int64)
+            pe.local(flag)[...] = 0
+            pe.barrier_all()
+            if pe.my_pe() == 0:
+                for r in range(1, pe.n_pes()):
+                    pe.atomic_set(flag, 7, r)
+                return 7
+            pe.wait_until(flag, "eq", 7)
+            return int(pe.local(flag)[0])
+
+        assert uni.run(pe_main) == [7] * N
+
+    def test_lock_mutual_exclusion(self, universe):
+        uni, pes = universe
+        counter = {"v": 0}
+
+        def pe_main(ctx):
+            pe = pes[ctx.rank]
+            lock = pe.shmalloc(1, np.int64)
+            for _ in range(50):
+                pe.set_lock(lock)
+                v = counter["v"]
+                counter["v"] = v + 1
+                pe.clear_lock(lock)
+            pe.barrier_all()
+            return counter["v"]
+
+        results = uni.run(pe_main)
+        assert results[0] == N * 50
+
+
+class TestCollectives:
+    def test_broadcast(self, universe):
+        uni, pes = universe
+
+        def pe_main(ctx):
+            pe = pes[ctx.rank]
+            sym = pe.shmalloc(4, np.float64)
+            pe.local(sym)[...] = pe.my_pe()
+            pe.barrier_all()
+            pe.broadcast(sym, root=2)
+            return pe.local(sym).copy()
+
+        for r in uni.run(pe_main):
+            np.testing.assert_array_equal(r, np.full(4, 2.0))
+
+    def test_fcollect(self, universe):
+        uni, pes = universe
+
+        def pe_main(ctx):
+            pe = pes[ctx.rank]
+            src = pe.shmalloc(2, np.int64)
+            dest = pe.shmalloc(2 * pe.n_pes(), np.int64)
+            pe.local(src)[...] = [pe.my_pe() * 10, pe.my_pe() * 10 + 1]
+            pe.barrier_all()
+            pe.fcollect(dest, src)
+            return pe.local(dest).copy()
+
+        expect = np.array([v for r in range(N) for v in (r * 10, r * 10 + 1)])
+        for r in uni.run(pe_main):
+            np.testing.assert_array_equal(r, expect)
+
+    def test_collect_ragged(self, universe):
+        uni, pes = universe
+        counts = [1, 3, 2, 1]
+
+        def pe_main(ctx):
+            pe = pes[ctx.rank]
+            src = pe.shmalloc(3, np.int64)
+            dest = pe.shmalloc(sum(counts), np.int64)
+            pe.local(src)[...] = pe.my_pe() + 1
+            pe.barrier_all()
+            pe.collect(dest, src, counts)
+            return pe.local(dest).copy()
+
+        expect = np.concatenate(
+            [np.full(counts[r], r + 1) for r in range(N)]
+        )
+        for r in uni.run(pe_main):
+            np.testing.assert_array_equal(r, expect)
+
+    def test_reductions(self, universe):
+        """oshmem_max_reduction_c analog plus sum/prod."""
+        uni, pes = universe
+
+        def pe_main(ctx):
+            pe = pes[ctx.rank]
+            src = pe.shmalloc(3, np.int64)
+            dmax = pe.shmalloc(3, np.int64)
+            dsum = pe.shmalloc(3, np.int64)
+            pe.local(src)[...] = [pe.my_pe(), -pe.my_pe(), 1]
+            pe.barrier_all()
+            pe.max_to_all(dmax, src)
+            pe.sum_to_all(dsum, src)
+            return pe.local(dmax).copy(), pe.local(dsum).copy()
+
+        for mx, sm in uni.run(pe_main):
+            np.testing.assert_array_equal(mx, [N - 1, 0, 1])
+            np.testing.assert_array_equal(
+                sm, [N * (N - 1) // 2, -N * (N - 1) // 2, N]
+            )
+
+    def test_alltoall(self, universe):
+        uni, pes = universe
+
+        def pe_main(ctx):
+            pe = pes[ctx.rank]
+            src = pe.shmalloc((N, 2), np.int64)
+            dest = pe.shmalloc((N, 2), np.int64)
+            for j in range(N):
+                pe.local(src)[j] = [pe.my_pe(), j]
+            pe.barrier_all()
+            pe.alltoall(dest, src)
+            return pe.local(dest).copy()
+
+        results = uni.run(pe_main)
+        for me, d in enumerate(results):
+            for j in range(N):
+                np.testing.assert_array_equal(d[j], [j, me])

@@ -180,6 +180,42 @@ def allreduce_rabenseifner(comm, x, op):
     return buf.reshape(-1)[:length].reshape(x.shape)
 
 
+def allreduce_nonoverlapping(comm, x, op):
+    """Reduce-then-bcast (reference: coll_base_allreduce.c:54): compose the
+    two tree phases; on TPU the value is that the reduce tree and the bcast
+    tree use disjoint link directions, so XLA overlaps the tail of one with
+    the head of the other."""
+    n = _require_uniform(comm)
+    if n == 1:
+        return x
+    reduced = reduce_binomial(comm, x, op, root=0)
+    return bcast_binomial(comm, reduced, root=0)
+
+
+def allreduce_segmented_ring(comm, x, op, segments: int = 4):
+    """Segmented ring (reference: coll_base_allreduce.c:618 with its
+    ``segment_size`` knob): the message is cut into independent segments,
+    each running its own ring.  The reference pipelines segments by hand to
+    overlap wire and reduction; here the segment rings share no data
+    dependencies, so XLA's scheduler interleaves their ppermutes across ICI
+    for the same effect."""
+    n = _require_uniform(comm)
+    if n == 1:
+        return x
+    flat = x.reshape(-1)
+    length = flat.shape[0]
+    segments = max(1, min(segments, max(1, length // n)))
+    seg = -(-length // segments)
+    pad = segments * seg - length
+    if pad:
+        flat = jnp.pad(flat, (0, pad))
+    parts = [
+        allreduce_ring(comm, flat[i * seg : (i + 1) * seg], op)
+        for i in range(segments)
+    ]
+    return jnp.concatenate(parts)[:length].reshape(x.shape)
+
+
 def allreduce_linear(comm, x, op):
     """Basic linear (reference: coll_base_allreduce.c:881): gather everything
     everywhere, reduce locally in strict rank order — the only algorithm
@@ -264,6 +300,127 @@ def bcast_chain(comm, x, root=0, segments: int = 4):
     return segs.reshape(-1)[:length].reshape(x.shape)
 
 
+def bcast_linear(comm, x, root=0):
+    """Basic linear bcast (reference: coll_base_bcast.c:624): root sends the
+    whole message to each rank individually.  collective_permute patterns
+    need unique sources, so the p-1 sends are p-1 independent permutes —
+    sharing no data dependencies, XLA schedules them concurrently, which is
+    the latency shape of the reference's p-1 non-blocking isends."""
+    n = _require_uniform(comm)
+    if n == 1:
+        return x
+    rank = comm.rank()
+    out = x
+    for i in range(n):
+        if i == root:
+            continue
+        got = spmd.ppermute(comm, x, [(root, i)])
+        out = _where(rank == i, got, out)
+    return out
+
+
+def bcast_binary(comm, x, root=0):
+    """Binary-tree bcast (reference: coll_base_bcast.c:245): complete binary
+    tree in virtual-rank space (vrank v forwards to 2v+1 and 2v+2), depth
+    ceil(log2 p) rounds, two sends per interior node per round."""
+    n = _require_uniform(comm)
+    if n == 1:
+        return x
+    rank = comm.rank()
+    vrank = (rank - root) % n
+
+    # level boundaries: nodes [2^d - 1, 2^(d+1) - 1) are at depth d.
+    # ppermute needs unique sources, so each level is two permutes — the
+    # left-child arm and the right-child arm (independent; XLA overlaps).
+    depth = 0
+    x_have = x
+    while (1 << depth) - 1 < n:
+        lo, hi = (1 << depth) - 1, min((1 << (depth + 1)) - 1, n)
+        any_pairs = False
+        for side in (1, 2):
+            pairs = [
+                ((v + root) % n, (2 * v + side + root) % n)
+                for v in range(lo, hi)
+                if 2 * v + side < n
+            ]
+            if not pairs:
+                continue
+            any_pairs = True
+            recv = spmd.ppermute(comm, x_have, pairs)
+            is_child = ((vrank - side) % 2 == 0) & (
+                (vrank - side) // 2 >= lo
+            ) & ((vrank - side) // 2 < hi) & (vrank >= side)
+            x_have = _where(is_child, recv, x_have)
+        if not any_pairs:
+            break
+        depth += 1
+    return x_have
+
+
+def bcast_pipeline(comm, x, root=0, segments: int = 8):
+    """Pipelined single-chain bcast (reference: coll_base_bcast.c:273 — the
+    chain algorithm with fanout 1): segments stream down one chain, the
+    classic latency-hiding shape for large messages.  Delegates to the
+    segment-stepping machinery of :func:`bcast_chain`."""
+    return bcast_chain(comm, x, root=root, segments=segments)
+
+
+def bcast_split_binary(comm, x, root=0):
+    """Split-binary bcast (reference: coll_base_bcast.c:357): the message is
+    split in two halves broadcast down independent trees, followed by a
+    pairing exchange.  TPU-native form: the two half-trees are two
+    independent static schedules with mirrored round orderings (so they use
+    opposing link directions), and XLA overlaps them; the final exchange is
+    implicit because both trees span all ranks."""
+    n = _require_uniform(comm)
+    if n == 1:
+        return x
+    flat = x.reshape(-1)
+    length = flat.shape[0]
+    if length < 2:
+        return bcast_binomial(comm, x, root)
+    half = length // 2
+    a = bcast_binomial(comm, flat[:half], root)
+    b = bcast_binary(comm, flat[half:], root)
+    return jnp.concatenate([a, b]).reshape(x.shape)
+
+
+def bcast_knomial(comm, x, root=0, radix: int = 4):
+    """K-nomial tree bcast (reference: coll_base_bcast.c:714): radix-k
+    generalization of binomial — round d, every vrank that is a multiple of
+    radix^(d+1) sends to vrank + j*radix^d for j in 1..radix-1.  Fewer
+    rounds than binomial (log_k p) at k-1 sends per round; on ICI the k-1
+    sends of a round ride one collective_permute."""
+    n = _require_uniform(comm)
+    if n == 1:
+        return x
+    if radix < 2:
+        raise errors.ArgError(f"knomial radix must be >= 2, got {radix}")
+    rank = comm.rank()
+    vrank = (rank - root) % n
+    # rounds from the top of the tree down: highest stride first
+    strides = []
+    s = 1
+    while s < n:
+        strides.append(s)
+        s *= radix
+    # one permute per child arm j (unique sources per permute); the k-1
+    # arms of a round are independent and XLA overlaps them
+    for stride in reversed(strides):
+        for j in range(1, radix):
+            pairs = [
+                ((v + root) % n, (v + j * stride + root) % n)
+                for v in range(0, n, stride * radix)
+                if v + j * stride < n
+            ]
+            if not pairs:
+                continue
+            recv = spmd.ppermute(comm, x, pairs)
+            is_child = vrank % (stride * radix) == j * stride
+            x = _where(is_child, recv, x)
+    return x
+
+
 def bcast_scatter_allgather(comm, x, root=0):
     """Scatter + allgather bcast (reference: coll_base_bcast.c knomial/
     scatter_allgather): binomial scatter of chunks then ring allgather —
@@ -307,6 +464,101 @@ def reduce_linear(comm, x, op, root=0):
     """Linear reduce preserving strict rank order for non-commutative ops."""
     full = allreduce_linear(comm, x, op)
     return full  # every rank computes the rank-ordered result
+
+
+def reduce_chain(comm, x, op, root=0, segments: int = 4):
+    """Chain/pipelined reduce (reference: coll_base_reduce.c:379 chain, :409
+    pipeline): partial sums flow down a single chain toward root, segmented
+    so the hops of different segments overlap.  Segment chains share no data
+    dependencies — XLA interleaves them, which is the pipelining the
+    reference hand-schedules."""
+    n = _require_uniform(comm)
+    if n == 1:
+        return x
+    rank = comm.rank()
+    vrank = (rank - root) % n
+    flat = x.reshape(-1)
+    length = flat.shape[0]
+    segments = max(1, min(segments, length))
+    seg = -(-length // segments)
+    pad = segments * seg - length
+    if pad:
+        flat = jnp.pad(flat, (0, pad))
+    # chain toward root in vrank space: v -> v-1, accumulated at each hop
+    pairs = [((v + root) % n, (v - 1 + root) % n) for v in range(1, n)]
+
+    def one_segment(sg):
+        def hop(t, acc):
+            recv = spmd.ppermute(comm, acc, pairs)
+            # at hop t, vrank n-2-t absorbs the partial from vrank n-1-t
+            absorbing = vrank == (n - 2 - t)
+            return _where(absorbing, op(recv, acc), acc)
+
+        return lax.fori_loop(0, n - 1, hop, sg)
+
+    parts = [
+        one_segment(flat[i * seg : (i + 1) * seg]) for i in range(segments)
+    ]
+    return jnp.concatenate(parts)[:length].reshape(x.shape)
+
+
+def reduce_pipeline(comm, x, op, root=0, segments: int = 8):
+    """Pipelined reduce (reference: coll_base_reduce.c:409): the chain
+    algorithm at higher segment count."""
+    return reduce_chain(comm, x, op, root=root, segments=segments)
+
+
+def reduce_binary(comm, x, op, root=0):
+    """Binary-tree reduce (reference: coll_base_reduce.c:440): leaves send
+    up a complete binary tree, interior nodes absorb both children per
+    round (one collective_permute per child side)."""
+    n = _require_uniform(comm)
+    if n == 1:
+        return x
+    rank = comm.rank()
+    vrank = (rank - root) % n
+    # deepest level d has nodes [2^d - 1, 2^(d+1) - 1) ∩ [0, n)
+    max_depth = 0
+    while (1 << (max_depth + 1)) - 1 < n:
+        max_depth += 1
+    for d in range(max_depth, 0, -1):
+        lo, hi = (1 << d) - 1, min((1 << (d + 1)) - 1, n)
+        for side in (1, 2):  # vrank 2p+1 is p's left child, 2p+2 its right
+            pairs = [
+                ((v + root) % n, ((v - side) // 2 + root) % n)
+                for v in range(lo, hi)
+                if (v - side) % 2 == 0
+            ]
+            if not pairs:
+                continue
+            recv = spmd.ppermute(comm, x, pairs)
+            is_parent = (2 * vrank + side >= lo) & (2 * vrank + side < hi)
+            x = _where(is_parent, op(x, recv), x)
+    return x
+
+
+def reduce_in_order_binary(comm, x, op, root=0):
+    """In-order binary reduce (reference: coll_base_reduce.c:509): exists to
+    give non-commutative ops a deterministic reduction order.  On SPMD the
+    rank-ordered guarantee is provided by the linear algorithm (the only
+    order MPI defines), so this delegates — the reference's in-order tree is
+    an optimization of the same contract."""
+    return reduce_linear(comm, x, op, root)
+
+
+def reduce_rabenseifner(comm, x, op, root=0):
+    """Rabenseifner reduce (reference: coll_base_reduce.c:797): recursive
+    -halving reduce-scatter + binomial gather to root.  SPMD form: after the
+    reduce-scatter each rank owns one reduced chunk; the gather is an
+    allgather (result significant at root), which on ICI is the faster
+    primitive anyway."""
+    n = _require_uniform(comm)
+    if n & (n - 1) or n == 1:
+        return reduce_binomial(comm, x, op, root)
+    buf, length = _chunked(x, n)
+    own = reduce_scatter_recursive_halving(comm, buf.reshape(-1), op)
+    gathered = allgather_ring(comm, own)
+    return gathered.reshape(-1)[:length].reshape(x.shape)
 
 
 # ---------------------------------------------------------------------------
@@ -398,6 +650,90 @@ def allgather_recursive_doubling(comm, x):
     return buf.reshape((n * x.shape[0],) + x.shape[1:])
 
 
+def _neighbor_exchange_plan(n: int):
+    """Static per-step (pairs, sent_lo[rank], recv_lo[rank]) tables for the
+    neighbor-exchange allgather — computed once in Python since n is static
+    under jit."""
+    sent = [r - (r % 2) for r in range(n)]  # pair window owned after step 0
+    steps = []
+    for s in range(1, n // 2):
+        partner = []
+        for r in range(n):
+            if r % 2 == 0:
+                p = (r - 1) % n if s % 2 == 1 else (r + 1) % n
+            else:
+                p = (r + 1) % n if s % 2 == 1 else (r - 1) % n
+            partner.append(p)
+        pairs = [(r, partner[r]) for r in range(n)]
+        recv = [sent[partner[r]] for r in range(n)]
+        steps.append((pairs, list(sent), list(recv)))
+        sent = recv
+    return steps
+
+
+def allgather_neighbor_exchange(comm, x):
+    """Neighbor-exchange allgather (reference: coll_base_allgather.c:484,
+    the Chen et al. algorithm): even n only — n/2 rounds alternating
+    exchanges with left/right neighbors, each carrying the pair-window
+    received in the previous round.  Falls back to ring for odd n, as the
+    reference's selection logic does."""
+    n = _require_uniform(comm)
+    x = _stack_shape(x)
+    if n == 1:
+        return x
+    if n % 2:
+        return allgather_ring(comm, x)
+    rank = comm.rank()
+    zero_idx = (0,) * x.ndim
+    buf = jnp.zeros((n,) + x.shape, x.dtype)
+    buf = lax.dynamic_update_slice(buf, x[None], (rank,) + zero_idx)
+    # step 0: exchange own block within (even, odd) pairs
+    recv0 = spmd.ppermute(comm, x, [(i, i ^ 1) for i in range(n)])
+    buf = lax.dynamic_update_slice(buf, recv0[None], (rank ^ 1,) + zero_idx)
+    for pairs, sent_lo, recv_lo in _neighbor_exchange_plan(n):
+        s_lo = jnp.take(jnp.asarray(sent_lo), rank)
+        r_lo = jnp.take(jnp.asarray(recv_lo), rank)
+        win = lax.dynamic_slice(buf, (s_lo,) + zero_idx, (2,) + x.shape)
+        got = spmd.ppermute(comm, win, pairs)
+        buf = lax.dynamic_update_slice(buf, got, (r_lo,) + zero_idx)
+    return buf.reshape((n * x.shape[0],) + x.shape[1:])
+
+
+def allgather_two_proc(comm, x):
+    """Two-process allgather (reference: coll_base_allgather.c:598): one
+    exchange.  Requires comm size 2; falls back to ring otherwise."""
+    n = _require_uniform(comm)
+    if n != 2:
+        return allgather_ring(comm, x)
+    x = _stack_shape(x)
+    rank = comm.rank()
+    other = spmd.ppermute(comm, x, [(0, 1), (1, 0)])
+    lo = _where(rank == 0, x, other)
+    hi = _where(rank == 0, other, x)
+    return jnp.concatenate([lo, hi], axis=0)
+
+
+def allgather_linear(comm, x):
+    """Basic linear allgather (reference: coll_base_allgather.c:681): every
+    rank sends to every other.  The reference posts p(p-1) point-to-points;
+    here it is p-1 independent shift permutes that XLA schedules
+    concurrently — latency-optimal for tiny payloads on ICI."""
+    n = _require_uniform(comm)
+    x = _stack_shape(x)
+    if n == 1:
+        return x
+    rank = comm.rank()
+    buf = jnp.zeros((n,) + x.shape, x.dtype)
+    buf = lax.dynamic_update_slice(buf, x[None], (rank,) + (0,) * x.ndim)
+    for r in range(1, n):
+        got = spmd.shift(comm, x, r, wrap=True)
+        src = (rank - r) % n
+        buf = lax.dynamic_update_slice(
+            buf, got[None], (src,) + (0,) * x.ndim
+        )
+    return buf.reshape((n * x.shape[0],) + x.shape[1:])
+
+
 # ---------------------------------------------------------------------------
 # Alltoall (cf. coll_base_alltoall.c)
 # ---------------------------------------------------------------------------
@@ -466,6 +802,117 @@ def alltoall_bruck(comm, x):
     return blocks.reshape(x.shape)
 
 
+def alltoall_linear(comm, x):
+    """Basic linear alltoall (reference: coll_base_alltoall.c:569): post
+    everything at once.  On SPMD the posting-order distinction between
+    linear and pairwise vanishes — both lower to the same p-1 static shift
+    permutes, which XLA is free to schedule concurrently — so this shares
+    pairwise's schedule."""
+    return alltoall_pairwise(comm, x)
+
+
+def alltoall_linear_sync(comm, x, window: int = 4):
+    """Linear-sync alltoall (reference: coll_base_alltoall.c:333): like
+    linear but with at most `window` transfers in flight.  The TPU analog of
+    the in-flight cap is a data-dependency barrier between batches of
+    `window` rounds, bounding concurrent ICI traffic (useful when the
+    alltoall shares the mesh with other collectives)."""
+    n, blocks = _atoall_blocks(comm, x)
+    if n == 1:
+        return x
+    rank = comm.rank()
+    out = jnp.zeros_like(blocks)
+    out = out.at[rank].set(jnp.take(blocks, rank, axis=0))
+    token = jnp.zeros((), blocks.dtype)
+    for r in range(1, n):
+        sendto = (rank + r) % n
+        recvfrom = (rank - r) % n
+        payload = jnp.take(blocks, sendto, axis=0) + token
+        sent = spmd.ppermute(
+            comm, payload,
+            lambda m, r=r: [(i, (i + r) % m) for i in range(m)],
+        )
+        out = out.at[recvfrom].set(sent)
+        if r % window == 0:
+            # serialize the next batch behind this one; the zero tie-in is a
+            # *float* mul-by-zero — integer x*0 would be constant-folded and
+            # the window cap silently lost (see _barrier_token)
+            token = (
+                jnp.sum(sent).astype(jnp.float32) * 0.0
+            ).astype(blocks.dtype)
+    return out.reshape(x.shape)
+
+
+def alltoall_two_proc(comm, x):
+    """Two-process alltoall (reference: coll_base_alltoall.c:490): one
+    exchange of the off-diagonal blocks."""
+    n, blocks = _atoall_blocks(comm, x)
+    if n != 2:
+        return alltoall_pairwise(comm, x)
+    rank = comm.rank()
+    mine = jnp.take(blocks, rank, axis=0)
+    theirs = spmd.ppermute(
+        comm, jnp.take(blocks, 1 - rank, axis=0), [(0, 1), (1, 0)]
+    )
+    lo = _where(rank == 0, mine, theirs)
+    hi = _where(rank == 0, theirs, mine)
+    return jnp.stack([lo, hi]).reshape(x.shape)
+
+
+# ---------------------------------------------------------------------------
+# Alltoallv (cf. coll_base_alltoallv.c)
+# ---------------------------------------------------------------------------
+
+
+def alltoallv_padded(comm, x, counts):
+    """Pairwise alltoallv (reference: coll_base_alltoallv.c:125) with a
+    static count matrix.  ``counts[i][j]`` is how many dim0 rows rank i
+    sends to rank j (known to all ranks — the SPMD analog of every rank's
+    sendcounts array).  ``x`` is this rank's send buffer laid out as
+    ``(n, max_send, ...)`` padded blocks.  Returns ``(n, max_recv, ...)``
+    padded receive blocks — entries beyond ``counts[src][rank]`` are zero.
+    Static padding is the price of static shapes; the communicator layer
+    offers the ragged reassembly."""
+    n = _require_uniform(comm)
+    if len(counts) != n or any(len(row) != n for row in counts):
+        raise errors.ArgError(f"counts must be {n}x{n}")
+    if x.shape[0] != n:
+        raise errors.CountError(
+            f"alltoallv send buffer needs {n} blocks, got {x.shape[0]}"
+        )
+    rank = comm.rank()
+    max_recv = max(counts[i][j] for i in range(n) for j in range(n))
+    blk = x.shape[1]
+    if blk < max_recv:
+        x = jnp.pad(
+            x, ((0, 0), (0, max_recv - blk)) + ((0, 0),) * (x.ndim - 2)
+        )
+    counts_arr = jnp.asarray(counts)
+    row_ids = jnp.arange(max_recv)
+
+    def valid_block(dest):
+        cnt = counts_arr[rank, dest]
+        block = jnp.take(x, dest, axis=0)[:max_recv]
+        mask = (row_ids < cnt).reshape((max_recv,) + (1,) * (block.ndim - 1))
+        return jnp.where(mask, block, jnp.zeros_like(block))
+
+    out = jnp.zeros((n, max_recv) + x.shape[2:], x.dtype)
+    out = lax.dynamic_update_slice(
+        out, valid_block(rank)[None], (rank,) + (0,) * (out.ndim - 1)
+    )
+    for r in range(1, n):
+        sendto = (rank + r) % n
+        recvfrom = (rank - r) % n
+        sent = spmd.ppermute(
+            comm, valid_block(sendto),
+            lambda m, r=r: [(i, (i + r) % m) for i in range(m)],
+        )
+        out = lax.dynamic_update_slice(
+            out, sent[None], (recvfrom,) + (0,) * (out.ndim - 1)
+        )
+    return out
+
+
 # ---------------------------------------------------------------------------
 # Reduce_scatter (cf. coll_base_reduce_scatter.c)
 # ---------------------------------------------------------------------------
@@ -530,6 +977,78 @@ def reduce_scatter_recursive_halving(comm, x, op):
     return jnp.take(blocks, rank, axis=0)
 
 
+def reduce_scatter_nonoverlapping(comm, x, op):
+    """Reduce + scatter composition (reference:
+    coll_base_reduce_scatter.c:47): binomial reduce to rank 0, then linear
+    scatter of the chunks."""
+    n = _require_uniform(comm)
+    if n == 1:
+        return x
+    _atoall_blocks(comm, x)  # validate divisibility
+    reduced = reduce_binomial(comm, x, op, root=0)
+    chunk = x.shape[0] // n
+    scattered = scatter_linear(comm, reduced.reshape(-1), 0)
+    return scattered[: chunk * math.prod(x.shape[1:])].reshape(
+        (chunk,) + x.shape[1:]
+    )
+
+
+def reduce_scatter_butterfly(comm, x, op):
+    """Butterfly reduce-scatter (reference: coll_base_reduce_scatter.c:691).
+    For power-of-two comms the butterfly's pairwise distance-halving
+    exchange coincides with recursive halving; the reference's extra
+    machinery exists to handle non-power-of-two ranks, which here (as in
+    Rabenseifner) falls back to the ring."""
+    n = _require_uniform(comm)
+    if n & (n - 1):
+        return reduce_scatter_ring(comm, x, op)
+    return reduce_scatter_recursive_halving(comm, x, op)
+
+
+# ---------------------------------------------------------------------------
+# Reduce_scatter_block (cf. coll_base_reduce_scatter_block.c)
+# ---------------------------------------------------------------------------
+# MPI_Reduce_scatter_block: equal recvcounts — exactly the contract the
+# chunked algorithms above already implement, so the block entry points are
+# the canonical ones and MPI_Reduce_scatter with uniform counts delegates
+# here.
+
+
+def reduce_scatter_block_linear(comm, x, op):
+    """Reduce-to-all then take own block (reference:
+    coll_base_reduce_scatter_block.c:55 reduce+scatter via rank order)."""
+    n = _require_uniform(comm)
+    _, blocks = _atoall_blocks(comm, x)
+    full = allreduce_linear(comm, x, op)
+    return jnp.take(
+        full.reshape((n,) + blocks.shape[1:]), comm.rank(), axis=0
+    )
+
+
+def reduce_scatter_block_recursive_doubling(comm, x, op):
+    """Recursive-doubling variant (reference:
+    coll_base_reduce_scatter_block.c:128): allreduce by recursive doubling,
+    keep own block — latency-optimal for small payloads."""
+    n = _require_uniform(comm)
+    _, blocks = _atoall_blocks(comm, x)
+    full = allreduce_recursive_doubling(comm, x, op)
+    return jnp.take(
+        full.reshape((n,) + blocks.shape[1:]), comm.rank(), axis=0
+    )
+
+
+def reduce_scatter_block_recursive_halving(comm, x, op):
+    """Recursive-halving variant (reference:
+    coll_base_reduce_scatter_block.c:326)."""
+    return reduce_scatter_recursive_halving(comm, x, op)
+
+
+def reduce_scatter_block_butterfly(comm, x, op):
+    """Butterfly variant (reference: coll_base_reduce_scatter_block.c:567
+    and the pow2 specialization at :810)."""
+    return reduce_scatter_butterfly(comm, x, op)
+
+
 # ---------------------------------------------------------------------------
 # Scan / Exscan (cf. coll_base_scan.c, coll_base_exscan.c)
 # ---------------------------------------------------------------------------
@@ -553,6 +1072,38 @@ def scan_recursive_doubling(comm, x, op):
     return x
 
 
+def scan_linear(comm, x, op):
+    """Linear scan (reference: coll_base_scan.c:35): the running prefix
+    crawls up the rank chain one hop per round — n-1 rounds, each a single
+    point-to-point.  Exists for forced selection and as the semantic
+    baseline; recursive doubling is the performant choice."""
+    n = _require_uniform(comm)
+    if n == 1:
+        return x
+    rank = comm.rank()
+    acc = x
+    for r in range(1, n):
+        recv = spmd.ppermute(comm, acc, [(r - 1, r)])
+        acc = _where(rank == r, op(recv, acc), acc)
+    return acc
+
+
+def exscan_linear(comm, x, op):
+    """Linear exscan (reference: coll_base_exscan.c:35): the inclusive
+    prefix of rank r-1 arrives as rank r's exclusive result."""
+    n = _require_uniform(comm)
+    if n == 1:
+        return jax.tree.map(jnp.zeros_like, x)
+    rank = comm.rank()
+    acc = x                     # inclusive prefix being built
+    out = jax.tree.map(jnp.zeros_like, x)
+    for r in range(1, n):
+        recv = spmd.ppermute(comm, acc, [(r - 1, r)])
+        out = _where(rank == r, recv, out)
+        acc = _where(rank == r, op(recv, acc), acc)
+    return out
+
+
 def exscan_recursive_doubling(comm, x, op):
     """Exclusive scan (reference: coll_base_exscan.c:142): inclusive scan,
     then shift the RESULTS up one rank — correct for every associative op
@@ -569,21 +1120,107 @@ def exscan_recursive_doubling(comm, x, op):
 # ---------------------------------------------------------------------------
 
 
+def _barrier_token(comm, token):
+    """The scalar each barrier round actually permutes.
+
+    Three elimination traps, all verified against the XLA CPU pipeline:
+    integer ``sum(token) * 0`` is algebraically folded to a literal; a
+    collective-permute whose operand is a provably-constant splat is folded
+    (zeros in, zeros out), taking the whole barrier with it; and
+    ``optimization_barrier`` does not help because JAX's jaxpr-level DCE
+    prunes its unused outputs together with their operands.  So the wire
+    payload is *float32* and runtime-variant — axis_index (partition id)
+    plus the caller's token data — and :func:`_seal_token` turns the final
+    value into zero with a float mul-by-zero, which XLA must keep (0*x is
+    NaN for x=NaN/Inf, so floats never fold)."""
+    t = comm.axis_index().astype(jnp.float32)
+    if token is not None:
+        t = t + jnp.sum(token).astype(jnp.float32)
+    return t
+
+
+def _seal_token(t):
+    """An int32 zero whose value genuinely flows from the barrier rounds
+    (see :func:`_barrier_token` for why this is a float multiply).  NaN in
+    the caller's token would poison the zero — garbage in, garbage out, as
+    with any data-dependent sequencing."""
+    return (t.astype(jnp.float32) * 0.0).astype(jnp.int32)
+
+
 def barrier_dissemination(comm, token=None):
     """Bruck/dissemination barrier (reference: coll_base_barrier.c:253):
     ceil(log2 p) rounds of shifted notifications.  Returns a data-dependent
     zero scalar usable as a sequencing token."""
     n = _require_uniform(comm)
-    t = jnp.zeros((), jnp.int32) if token is None else jnp.sum(token).astype(
-        jnp.int32
-    ) * 0
+    t = _barrier_token(comm, token)
     k = 1
     while k < n:
         t = t + spmd.ppermute(
             comm, t, lambda m, k=k: [(i, (i + k) % m) for i in range(m)]
         )
         k <<= 1
-    return t
+    return _seal_token(t)
+
+
+def barrier_double_ring(comm, token=None):
+    """Double-ring barrier (reference: coll_base_barrier.c:100): two full
+    laps of a unit token around the ring — 2(p-1) hops, the simplest
+    schedule that transitively orders every rank."""
+    n = _require_uniform(comm)
+    t = _barrier_token(comm, token)
+
+    def hop(_, tok):
+        return tok + spmd.shift(comm, tok, 1, wrap=True)
+
+    return _seal_token(lax.fori_loop(0, 2 * (n - 1), hop, t))
+
+
+def barrier_recursive_doubling(comm, token=None):
+    """Recursive-doubling barrier (reference: coll_base_barrier.c:172):
+    log2(p) pairwise xor-distance exchanges (pow2 comms; dissemination
+    handles the rest and is what non-pow2 falls back to)."""
+    n = _require_uniform(comm)
+    if n & (n - 1):
+        return barrier_dissemination(comm, token)
+    t = _barrier_token(comm, token)
+    k = 1
+    while k < n:
+        t = t + spmd.ppermute(comm, t, [(i, i ^ k) for i in range(n)])
+        k <<= 1
+    return _seal_token(t)
+
+
+def barrier_two_proc(comm, token=None):
+    """Two-process barrier (reference: coll_base_barrier.c:291): one
+    exchange."""
+    n = _require_uniform(comm)
+    if n != 2:
+        return barrier_dissemination(comm, token)
+    t = _barrier_token(comm, token)
+    return _seal_token(t + spmd.ppermute(comm, t, [(0, 1), (1, 0)]))
+
+
+def barrier_tree(comm, token=None):
+    """Tree barrier (reference: coll_base_barrier.c:404): binomial fan-in to
+    rank 0 then binomial fan-out — the reduce/bcast trees applied to a unit
+    token."""
+    _require_uniform(comm)
+    t = _barrier_token(comm, token)
+    t = reduce_binomial(comm, t, lambda a, b: a + b, root=0)
+    return _seal_token(bcast_binomial(comm, t, root=0))
+
+
+def barrier_linear(comm, token=None):
+    """Linear barrier (reference: coll_base_barrier.c:330): everyone
+    reports to everyone.  The reference funnels through rank 0; the SPMD
+    equivalent of "rank 0 heard from all, then told all" with static
+    patterns is the all-to-all notification, p-1 concurrent permutes."""
+    n = _require_uniform(comm)
+    t = _barrier_token(comm, token)
+    acc = t
+    for r in range(1, n):
+        acc = acc + spmd.shift(comm, t, r, wrap=True)
+    return _seal_token(acc)
 
 
 # ---------------------------------------------------------------------------
@@ -615,6 +1252,88 @@ def scatter_linear(comm, x, root=0):
         out = _where(rank == i, sent, out)
     # non-root ranks' x may be garbage; out at rank i is root's chunk i
     return out
+
+
+def gather_binomial(comm, x, root=0):
+    """Binomial-tree gather (reference: coll_base_gather.c:41): round k,
+    vranks with bit k set ship their accumulated window of k blocks to
+    vrank−k; root ends holding all p blocks.  The windows are dynamic
+    slices at traced offsets with static sizes — jit-compatible.  Result is
+    the full (p·m, ...) buffer, significant at root."""
+    n = _require_uniform(comm)
+    x = _stack_shape(x)
+    if n == 1:
+        return x
+    rank = comm.rank()
+    vrank = (rank - root) % n
+    zero_idx = (0,) * x.ndim
+    # 2n rows so window reads/writes past n land in the zero pad instead of
+    # being clamped by dynamic_slice (non-pow2 tails)
+    buf = jnp.zeros((2 * n,) + x.shape, x.dtype)
+    # each rank's accumulated window starts at its own vrank
+    buf = lax.dynamic_update_slice(buf, x[None], (vrank,) + zero_idx)
+    k = 1
+    while k < n:
+        pairs = [
+            ((v + k + root) % n, (v + root) % n)
+            for v in range(0, n - k, 2 * k)
+        ]
+        sent = spmd.ppermute(
+            comm,
+            lax.dynamic_slice(buf, (vrank,) + zero_idx, (k,) + x.shape),
+            pairs,
+        )
+        is_recv = (vrank % (2 * k) == 0) & (vrank + k < n)
+        merged = lax.dynamic_update_slice(
+            buf, sent, (vrank + k,) + zero_idx
+        )
+        buf = _where(is_recv, merged, buf)
+        k <<= 1
+    # root's window is [0, n) in vrank order; rotate to rank order
+    buf = jnp.roll(buf[:n], shift=root, axis=0)
+    return buf.reshape((n * x.shape[0],) + x.shape[1:])
+
+
+def gather_linear_sync(comm, x, root=0):
+    """Linear-sync gather (reference: coll_base_gather.c:208): the
+    reference rate-limits senders with an ack handshake; on a statically
+    scheduled machine the collective_permutes already execute in schedule
+    order, so this shares the ring-gather schedule."""
+    return gather_ring(comm, x, root)
+
+
+def scatter_binomial(comm, x, root=0):
+    """Binomial-tree scatter (reference: coll_base_scatter.c:63, the
+    binomial entry): the mirror of binomial gather — root starts with all p
+    chunks, round k (descending) hands the upper half of each holder's
+    window to vrank+k.  Dynamic windows at traced offsets, static sizes."""
+    n = _require_uniform(comm)
+    buf, length = _chunked(x, n)
+    chunk = buf.shape[1]
+    if n == 1:
+        return buf.reshape(-1)[:length]
+    rank = comm.rank()
+    vrank = (rank - root) % n
+    # rotate root's buffer into vrank order, then pad to 2n rows so window
+    # reads past n hit the zero pad instead of dynamic_slice clamping
+    buf = jnp.roll(buf, shift=-root, axis=0)
+    buf = jnp.concatenate([buf, jnp.zeros_like(buf)], axis=0)
+    k = _pow2_floor(n - 1) if n > 1 else 0
+    while k >= 1:
+        pairs = [
+            ((v + root) % n, (v + k + root) % n)
+            for v in range(0, n - k, 2 * k)
+        ]
+        sent = spmd.ppermute(
+            comm,
+            lax.dynamic_slice(buf, (vrank + k, 0), (k, chunk)),
+            pairs,
+        )
+        is_recv = vrank % (2 * k) == k
+        merged = lax.dynamic_update_slice(buf, sent, (vrank, 0))
+        buf = _where(is_recv, merged, buf)
+        k >>= 1
+    return jnp.take(buf, vrank, axis=0)
 
 
 def bcast_via_scatter(comm, x, root=0):

@@ -27,9 +27,11 @@ class BasicCollComponent(CollComponent):
             allgather=alg.allgather_ring,
             allgatherv=alg.allgatherv_concat,
             alltoall=alg.alltoall_pairwise,
-            reduce_scatter=alg.reduce_scatter_ring,
-            scan=alg.scan_recursive_doubling,
-            exscan=alg.exscan_recursive_doubling,
+            alltoallv=alg.alltoallv_padded,
+            reduce_scatter=alg.reduce_scatter_block_linear,
+            reduce_scatter_block=alg.reduce_scatter_block_linear,
+            scan=alg.scan_linear,
+            exscan=alg.exscan_linear,
             gather=alg.gather_ring,
             scatter=alg.scatter_linear,
         )

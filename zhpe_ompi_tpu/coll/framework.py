@@ -24,7 +24,9 @@ COLL_OPS = (
     "allgather",
     "allgatherv",
     "alltoall",
+    "alltoallv",
     "reduce_scatter",
+    "reduce_scatter_block",
     "scan",
     "exscan",
     "gather",

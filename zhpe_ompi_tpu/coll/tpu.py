@@ -75,10 +75,9 @@ def bcast(comm, x, root=0):
 
 
 def barrier(comm, token=None):
-    t = jnp.zeros((), jnp.int32) if token is None else (
-        jnp.sum(token).astype(jnp.int32) * 0
-    )
-    return _psum(comm, t)
+    # alg._barrier_token ties the wire payload to the caller's token without
+    # a foldable *0; _seal_token zeroes the psum result the same way
+    return alg._seal_token(_psum(comm, alg._barrier_token(comm, token)))
 
 
 def allgather(comm, x):
@@ -122,6 +121,48 @@ def reduce_scatter(comm, x, op):
     return alg.reduce_scatter_recursive_halving(comm, x, op)
 
 
+def reduce_scatter_block(comm, x, op):
+    # MPI_Reduce_scatter_block (equal counts) — the contract psum_scatter
+    # implements natively
+    return reduce_scatter(comm, x, op)
+
+
+def alltoallv(comm, x, counts):
+    """Padded alltoallv on the native all_to_all: x is (n, max_send, ...)
+    blocks, counts the n x n static matrix; rows beyond the count are
+    zero-masked so padding never leaks (cf. coll_base_alltoallv.c:125)."""
+    from ..core import errors
+
+    n = comm.size
+    if x.shape[0] != n:
+        raise errors.CountError(
+            f"alltoallv send buffer needs {n} blocks, got {x.shape[0]}"
+        )
+    if len(counts) != n or any(
+        not hasattr(row, "__len__") or len(row) != n for row in counts
+    ):
+        raise errors.ArgError(f"counts must be {n}x{n}")
+    rank = comm.rank()
+    max_recv = max(max(row) for row in counts)
+    if x.shape[1] < max_recv:
+        x = jnp.pad(
+            x, ((0, 0), (0, max_recv - x.shape[1])) + ((0, 0),) * (x.ndim - 2)
+        )
+    else:
+        x = x[:, :max_recv]
+    counts_arr = jnp.asarray(counts)
+    sent_cnt = counts_arr[rank]  # (n,) rows this rank sends to each dest
+    mask = jnp.arange(max_recv)[None, :] < sent_cnt[:, None]
+    x = jnp.where(
+        mask.reshape((n, max_recv) + (1,) * (x.ndim - 2)), x,
+        jnp.zeros_like(x),
+    )
+    return lax.all_to_all(
+        x, comm.axis, split_axis=0, concat_axis=0,
+        axis_index_groups=_groups(comm), tiled=False,
+    )
+
+
 def scan(comm, x, op):
     return alg.scan_recursive_doubling(comm, x, op)
 
@@ -163,7 +204,9 @@ class TpuCollComponent(CollComponent):
             allgather=allgather,
             allgatherv=allgatherv,
             alltoall=alltoall,
+            alltoallv=alltoallv,
             reduce_scatter=reduce_scatter,
+            reduce_scatter_block=reduce_scatter_block,
             scan=scan,
             exscan=exscan,
             gather=gather,
@@ -179,5 +222,7 @@ class TpuCollComponent(CollComponent):
             mod.allgather = None
             mod.allgatherv = None
             mod.alltoall = None
+            mod.alltoallv = None
             mod.reduce_scatter = None
+            mod.reduce_scatter_block = None
         return mod

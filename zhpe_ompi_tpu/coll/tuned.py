@@ -34,38 +34,111 @@ from .framework import CollComponent, CollModule
 _stream = mca_output.open_stream("coll_tuned")
 
 ALLREDUCE_ALGS = {
+    # forced-alg name surface mirrors coll_tuned_allreduce_decision.c:37-46
     "xla": None,  # delegate to the XLA-native path
     "linear": alg.allreduce_linear,
+    "nonoverlapping": alg.allreduce_nonoverlapping,
     "recursive_doubling": alg.allreduce_recursive_doubling,
     "ring": alg.allreduce_ring,
+    "segmented_ring": alg.allreduce_segmented_ring,
     "rabenseifner": alg.allreduce_rabenseifner,
 }
 BCAST_ALGS = {
+    # cf. coll_tuned_bcast_decision.c:37-49
     "xla": None,
-    "binomial": alg.bcast_binomial,
+    "linear": alg.bcast_linear,
     "chain": alg.bcast_chain,
+    "pipeline": alg.bcast_pipeline,
+    "split_binary": alg.bcast_split_binary,
+    "binary": alg.bcast_binary,
+    "binomial": alg.bcast_binomial,
+    "knomial": alg.bcast_knomial,
     "scatter_allgather": alg.bcast_scatter_allgather,
 }
 REDUCE_ALGS = {
+    # cf. coll_tuned_reduce_decision.c
     "xla": None,
-    "binomial": alg.reduce_binomial,
     "linear": alg.reduce_linear,
+    "chain": alg.reduce_chain,
+    "pipeline": alg.reduce_pipeline,
+    "binary": alg.reduce_binary,
+    "binomial": alg.reduce_binomial,
+    "in_order_binary": alg.reduce_in_order_binary,
+    "rabenseifner": alg.reduce_rabenseifner,
 }
 ALLGATHER_ALGS = {
+    # cf. coll_tuned_allgather_decision.c
     "xla": None,
-    "ring": alg.allgather_ring,
+    "linear": alg.allgather_linear,
     "bruck": alg.allgather_bruck,
     "recursive_doubling": alg.allgather_recursive_doubling,
+    "ring": alg.allgather_ring,
+    "neighbor_exchange": alg.allgather_neighbor_exchange,
+    "two_proc": alg.allgather_two_proc,
 }
 ALLTOALL_ALGS = {
+    # cf. coll_tuned_alltoall_decision.c:35-43
     "xla": None,
+    "linear": alg.alltoall_linear,
     "pairwise": alg.alltoall_pairwise,
     "bruck": alg.alltoall_bruck,
+    "linear_sync": alg.alltoall_linear_sync,
+    "two_proc": alg.alltoall_two_proc,
 }
 REDUCE_SCATTER_ALGS = {
     "xla": None,
-    "ring": alg.reduce_scatter_ring,
+    "nonoverlapping": alg.reduce_scatter_nonoverlapping,
     "recursive_halving": alg.reduce_scatter_recursive_halving,
+    "ring": alg.reduce_scatter_ring,
+    "butterfly": alg.reduce_scatter_butterfly,
+    "linear": alg.reduce_scatter_block_linear,
+}
+REDUCE_SCATTER_BLOCK_ALGS = {
+    # cf. coll_base_reduce_scatter_block.c:55,128,326,567
+    "xla": None,
+    "linear": alg.reduce_scatter_block_linear,
+    "recursive_doubling": alg.reduce_scatter_block_recursive_doubling,
+    "recursive_halving": alg.reduce_scatter_block_recursive_halving,
+    "butterfly": alg.reduce_scatter_block_butterfly,
+}
+BARRIER_ALGS = {
+    # cf. coll_base_barrier.c:100,172,253,291,330,404
+    "xla": None,
+    "linear": alg.barrier_linear,
+    "double_ring": alg.barrier_double_ring,
+    "recursive_doubling": alg.barrier_recursive_doubling,
+    "bruck": alg.barrier_dissemination,
+    "two_proc": alg.barrier_two_proc,
+    "tree": alg.barrier_tree,
+}
+SCAN_ALGS = {
+    "linear": alg.scan_linear,
+    "recursive_doubling": alg.scan_recursive_doubling,
+}
+EXSCAN_ALGS = {
+    "linear": alg.exscan_linear,
+    "recursive_doubling": alg.exscan_recursive_doubling,
+}
+GATHER_ALGS = {
+    # cf. coll_base_gather.c:41,208
+    "xla": None,
+    "binomial": alg.gather_binomial,
+    "linear_sync": alg.gather_linear_sync,
+    "ring": alg.gather_ring,
+}
+SCATTER_ALGS = {
+    # cf. coll_base_scatter.c:63,285
+    "xla": None,
+    "binomial": alg.scatter_binomial,
+    "linear": alg.scatter_linear,
+}
+ALLGATHERV_ALGS = {
+    "xla": None,
+    "concat": alg.allgatherv_concat,
+}
+ALLTOALLV_ALGS = {
+    "xla": None,
+    "pairwise": alg.alltoallv_padded,
 }
 
 _ALG_TABLES = {
@@ -75,7 +148,21 @@ _ALG_TABLES = {
     "allgather": ALLGATHER_ALGS,
     "alltoall": ALLTOALL_ALGS,
     "reduce_scatter": REDUCE_SCATTER_ALGS,
+    "reduce_scatter_block": REDUCE_SCATTER_BLOCK_ALGS,
+    "barrier": BARRIER_ALGS,
+    "scan": SCAN_ALGS,
+    "exscan": EXSCAN_ALGS,
+    "gather": GATHER_ALGS,
+    "scatter": SCATTER_ALGS,
+    "allgatherv": ALLGATHERV_ALGS,
+    "alltoallv": ALLTOALLV_ALGS,
 }
+
+# ops whose first positional arg is the reduction op
+_OPS_WITH_REDUCTION = (
+    "allreduce", "reduce", "reduce_scatter", "reduce_scatter_block",
+    "scan", "exscan",
+)
 
 # decision thresholds (bytes); MCA-tunable, defaults in the spirit of the
 # reference's 10KB/1MB switch points (coll_tuned_decision_fixed.c:53,73)
@@ -160,7 +247,7 @@ def decide(opname: str, comm, x, op=None) -> str:
         return dyn
     # Non-commutative ops must reduce in rank order: only linear preserves it.
     if op is not None and not op.commute and opname in (
-        "allreduce", "reduce"
+        "allreduce", "reduce", "reduce_scatter", "reduce_scatter_block",
     ):
         return "linear"
     small = mca_var.get("coll_tuned_small_msg", _DEFAULT_SMALL)
@@ -181,28 +268,30 @@ def decide(opname: str, comm, x, op=None) -> str:
         if op is not None and op.xla_collective:
             return "xla"
         return "binomial"
-    if opname == "allgather":
-        # XLA's native all_gather is optimal on ICI at every size; the
-        # algorithmic variants (ring/bruck/recursive_doubling) exist for
-        # forced selection and benchmarking, not the auto path.
+    if opname in ("allgather", "alltoall", "barrier", "gather", "scatter",
+                  "allgatherv", "alltoallv"):
+        # XLA's native collectives are optimal on ICI at every size; the
+        # algorithmic variants exist for forced selection and benchmarking,
+        # not the auto path.
         return "xla"
-    if opname == "alltoall":
-        return "xla"
-    if opname == "reduce_scatter":
+    if opname in ("reduce_scatter", "reduce_scatter_block"):
         if op is not None and op.xla_collective == "psum":
             return "xla"
         if n and n & (n - 1) == 0:
             return "recursive_halving"
-        return "ring"
+        return "ring" if opname == "reduce_scatter" else "recursive_doubling"
+    if opname in ("scan", "exscan"):
+        return "recursive_doubling"
     return next(iter(table))
 
 
 def _dispatch(opname):
-    def fn(comm, x, *args, **kwargs):
+    def fn(comm, *args, **kwargs):
+        x = args[0] if args else kwargs.get("token")
         algname = decide(
             opname, comm, x,
-            op=(args[0] if opname in ("allreduce", "reduce", "reduce_scatter")
-                and args else None),
+            op=(args[1] if opname in _OPS_WITH_REDUCTION and len(args) > 1
+                else None),
         )
         mca_output.verbose(
             9, _stream, "%s size=%s -> %s", opname,
@@ -211,7 +300,7 @@ def _dispatch(opname):
         impl = _ALG_TABLES[opname][algname]
         if impl is None:
             impl = getattr(xla_mod, opname)
-        return impl(comm, x, *args, **kwargs)
+        return impl(comm, *args, **kwargs)
 
     return fn
 
@@ -228,17 +317,5 @@ class TunedCollComponent(CollComponent):
             return None  # algorithmic layer needs uniform groups
         _register_params()
         return CollModule(
-            allreduce=_dispatch("allreduce"),
-            reduce=_dispatch("reduce"),
-            bcast=_dispatch("bcast"),
-            allgather=_dispatch("allgather"),
-            alltoall=_dispatch("alltoall"),
-            reduce_scatter=_dispatch("reduce_scatter"),
-            # ops with a single algorithmic implementation delegate directly
-            barrier=alg.barrier_dissemination,
-            scan=alg.scan_recursive_doubling,
-            exscan=alg.exscan_recursive_doubling,
-            gather=alg.gather_ring,
-            scatter=alg.scatter_linear,
-            allgatherv=alg.allgatherv_concat,
+            **{opname: _dispatch(opname) for opname in _ALG_TABLES},
         )

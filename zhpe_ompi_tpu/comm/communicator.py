@@ -232,6 +232,19 @@ class Communicator:
 
         return self._coll_call("reduce_scatter", x, op or _ops.SUM, **kw)
 
+    def reduce_scatter_block(self, x, op=None, **kw):
+        from .. import ops as _ops
+
+        return self._coll_call(
+            "reduce_scatter_block", x, op or _ops.SUM, **kw
+        )
+
+    def alltoallv(self, x, counts, **kw):
+        """MPI_Alltoallv with a static count matrix: ``counts[i][j]`` rows
+        go from rank i to rank j; ``x`` is (size, max_send, ...) padded
+        blocks, result is (size, max_recv, ...) padded blocks."""
+        return self._coll_call("alltoallv", x, counts, **kw)
+
     def scan(self, x, op=None, **kw):
         from .. import ops as _ops
 

@@ -32,6 +32,7 @@ ERR_OTHER = 16
 ERR_INTERN = 17
 ERR_IN_STATUS = 18
 ERR_PENDING = 19
+ERR_NO_MEM = 34
 ERR_WIN = 45
 ERR_KEYVAL = 48
 ERR_NOT_INITIALIZED = 60
@@ -127,6 +128,10 @@ class RequestError(MpiError):
 
 class WinError(MpiError):
     errclass = ERR_WIN
+
+
+class ResourceError(MpiError):
+    errclass = ERR_NO_MEM
 
 
 class InternalError(MpiError):

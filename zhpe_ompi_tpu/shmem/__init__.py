@@ -1,0 +1,26 @@
+"""OpenSHMEM-analog PGAS layer (reference: ``oshmem/``, SURVEY.md §2.5).
+
+The reference implements OpenSHMEM 1.4 over five frameworks: ``memheap``
+(symmetric heap, buddy/ptmalloc allocators), ``sshmem`` (segment creation,
+mmap/sysv), ``spml`` (put/get transport over UCX), ``atomic`` (AMOs) and
+``scoll`` (collectives, including ``scoll/mpi`` which reuses the MPI
+collective layer).  The TPU-native redesign keeps the same layering on the
+host plane:
+
+- :mod:`.memheap` — deterministic first-fit symmetric allocator: the same
+  allocation sequence on every PE yields the same offsets, which is the
+  entire symmetric-heap contract (``oshmem/mca/memheap``).
+- :mod:`.api` — the PE-facing API (put/get/p/g, AMOs, wait_until, locks,
+  broadcast/collect/reductions, barrier), one object per PE over the
+  thread-rank universe — the analog of ``oshmem/shmem/c``'s 56 files over
+  spml/scoll.
+
+On the device plane, symmetric objects are simply replicated/sharded jax
+arrays and put/get lower to the same ``ppermute``/collective machinery as
+:mod:`zhpe_ompi_tpu.coll` — PGAS and MPI converge on SPMD hardware, so no
+separate device transport exists (documented design decision, not an
+omission).
+"""
+
+from .api import ShmemPE, shmem_universe  # noqa: F401
+from .memheap import SymmetricHeapAllocator  # noqa: F401

@@ -1,0 +1,369 @@
+"""OpenSHMEM-analog PE API (reference: ``oshmem/shmem/c``, 56 files).
+
+Each PE is a thread-rank of a :class:`~zhpe_ompi_tpu.pt2pt.universe.
+LocalUniverse` holding a handle to the universe-shared symmetric heap —
+the in-process form of the reference's sshmem segment, which every PE maps
+so spml put/get are true one-sided operations (no target involvement).
+Remote access here is a direct numpy view write/read guarded by per-PE
+locks for the atomic ops, exactly the shape of ``spml/ucx`` put/get +
+``atomic/basic`` over a mapped segment.
+
+Collectives follow ``scoll/basic`` (linear/binomial over pt2pt); the
+reference's ``scoll/mpi`` — reusing the MPI collective layer — appears
+here as the device-plane advice in the package docstring: on TPU both
+models lower to the same XLA collectives.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Sequence
+
+import numpy as np
+
+from ..core import errors
+from ..pt2pt.universe import LocalUniverse, RankContext
+from ..runtime import spc
+from .memheap import SymmetricHeapAllocator
+
+_DEFAULT_HEAP = 1 << 20  # 1 MiB per PE; SHMEM_SYMMETRIC_SIZE analog
+
+
+class SymArray:
+    """Handle to a symmetric allocation: same offset/shape/dtype on every
+    PE.  Valid on any PE of the universe that allocated it."""
+
+    __slots__ = ("offset", "shape", "dtype", "nbytes", "_uni")
+
+    def __init__(self, offset: int, shape: tuple, dtype, nbytes: int, uni):
+        self.offset = offset
+        self.shape = shape
+        self.dtype = np.dtype(dtype)
+        self.nbytes = nbytes
+        self._uni = uni
+
+
+class _ShmemUniverseState:
+    """Universe-shared: the per-PE heap arenas and their atomic locks."""
+
+    def __init__(self, n_pes: int, heap_bytes: int):
+        self.arenas = [
+            np.zeros(heap_bytes, dtype=np.uint8) for _ in range(n_pes)
+        ]
+        self.locks = [threading.RLock() for _ in range(n_pes)]
+        # symmetric allocators advance in lockstep (same call sequence on
+        # every PE); one shared instance keeps them trivially identical
+        self.allocator = SymmetricHeapAllocator(heap_bytes)
+        self.alloc_lock = threading.Lock()
+        # distributed locks (shmem_set_lock): keyed by symmetric offset
+        self.dist_locks: dict[int, threading.RLock] = {}
+        self.dist_lock_guard = threading.Lock()
+
+
+class ShmemPE:
+    """One PE's API handle — the surface of ``shmem.h``."""
+
+    def __init__(self, ctx: RankContext, state: _ShmemUniverseState):
+        self._ctx = ctx
+        self._state = state
+
+    # -- identity --------------------------------------------------------
+
+    def my_pe(self) -> int:
+        return self._ctx.rank
+
+    def n_pes(self) -> int:
+        return self._ctx.size
+
+    # -- symmetric memory ------------------------------------------------
+
+    def shmalloc(self, shape, dtype=np.float64) -> SymArray:
+        """Collective symmetric allocation (shmem_malloc: synchronizes all
+        PEs; identical offsets fall out of the shared allocator)."""
+        shape = (shape,) if isinstance(shape, int) else tuple(shape)
+        dt = np.dtype(dtype)
+        nbytes = int(np.prod(shape or (1,))) * dt.itemsize
+        self.barrier_all()
+        if self._ctx.rank == 0:
+            with self._state.alloc_lock:
+                off = self._state.allocator.alloc(nbytes)
+            for r in range(1, self._ctx.size):
+                self._ctx.send(off, dest=r, tag=0x7FF0, cid=0x7FF0)
+        else:
+            off = self._ctx.recv(source=0, tag=0x7FF0, cid=0x7FF0)
+        self.barrier_all()
+        return SymArray(off, shape, dt, nbytes, self._state)
+
+    def shfree(self, sym: SymArray) -> None:
+        """Collective free."""
+        self.barrier_all()
+        if self._ctx.rank == 0:
+            with self._state.alloc_lock:
+                self._state.allocator.free(sym.offset)
+        self.barrier_all()
+
+    def _view(self, sym: SymArray, pe: int) -> np.ndarray:
+        if not 0 <= pe < self._ctx.size:
+            raise errors.RankError(f"PE {pe} out of range")
+        raw = self._state.arenas[pe][sym.offset : sym.offset + sym.nbytes]
+        return raw.view(sym.dtype).reshape(sym.shape)
+
+    def local(self, sym: SymArray) -> np.ndarray:
+        """This PE's instance of the symmetric object (writable view)."""
+        return self._view(sym, self._ctx.rank)
+
+    # -- RMA (spml analog) -----------------------------------------------
+
+    def put(self, sym: SymArray, value, pe: int) -> None:
+        """shmem_put: one-sided write of the full object (or a broadcastable
+        slice) into the target PE's instance."""
+        spc.record("shmem_puts", 1)
+        self._view(sym, pe)[...] = value
+
+    def get(self, sym: SymArray, pe: int) -> np.ndarray:
+        """shmem_get: one-sided read of the target PE's instance."""
+        spc.record("shmem_gets", 1)
+        return self._view(sym, pe).copy()
+
+    def p(self, sym: SymArray, value, pe: int, index: int = 0) -> None:
+        """shmem_p: single-element put."""
+        self._view(sym, pe).reshape(-1)[index] = value
+
+    def g(self, sym: SymArray, pe: int, index: int = 0):
+        """shmem_g: single-element get."""
+        return self._view(sym, pe).reshape(-1)[index].copy()
+
+    def iput(self, sym: SymArray, values, pe: int, tst: int = 1,
+             sst: int = 1) -> None:
+        """shmem_iput: strided put (target stride tst, source stride sst)."""
+        values = np.asarray(values).reshape(-1)
+        n = (values.size + sst - 1) // sst
+        self._view(sym, pe).reshape(-1)[: n * tst : tst] = values[::sst]
+
+    def iget(self, sym: SymArray, pe: int, n: int, tst: int = 1,
+             sst: int = 1) -> np.ndarray:
+        """shmem_iget."""
+        return self._view(sym, pe).reshape(-1)[: n * sst : sst].copy()
+
+    def fence(self) -> None:
+        """shmem_fence: ordering of puts to each PE — in-process writes are
+        already ordered; kept for program portability."""
+
+    def quiet(self) -> None:
+        """shmem_quiet: completion of all outstanding puts — immediate
+        in-process."""
+
+    # -- atomics (atomic framework analog) -------------------------------
+
+    def atomic_add(self, sym: SymArray, value, pe: int, index: int = 0
+                   ) -> None:
+        with self._state.locks[pe]:
+            v = self._view(sym, pe).reshape(-1)
+            v[index] = v[index] + value
+
+    def atomic_fetch_add(self, sym: SymArray, value, pe: int,
+                         index: int = 0):
+        with self._state.locks[pe]:
+            v = self._view(sym, pe).reshape(-1)
+            old = v[index].copy()
+            v[index] = old + value
+        return old
+
+    def atomic_inc(self, sym: SymArray, pe: int, index: int = 0) -> None:
+        self.atomic_add(sym, 1, pe, index)
+
+    def atomic_fetch_inc(self, sym: SymArray, pe: int, index: int = 0):
+        return self.atomic_fetch_add(sym, 1, pe, index)
+
+    def atomic_swap(self, sym: SymArray, value, pe: int, index: int = 0):
+        with self._state.locks[pe]:
+            v = self._view(sym, pe).reshape(-1)
+            old = v[index].copy()
+            v[index] = value
+        return old
+
+    def atomic_compare_swap(self, sym: SymArray, cond, value, pe: int,
+                            index: int = 0):
+        with self._state.locks[pe]:
+            v = self._view(sym, pe).reshape(-1)
+            old = v[index].copy()
+            if old == cond:
+                v[index] = value
+        return old
+
+    def atomic_fetch(self, sym: SymArray, pe: int, index: int = 0):
+        with self._state.locks[pe]:
+            return self._view(sym, pe).reshape(-1)[index].copy()
+
+    def atomic_set(self, sym: SymArray, value, pe: int, index: int = 0
+                   ) -> None:
+        with self._state.locks[pe]:
+            self._view(sym, pe).reshape(-1)[index] = value
+
+    # -- point synchronization -------------------------------------------
+
+    def wait_until(self, sym: SymArray, op: str, value, index: int = 0,
+                   timeout: float = 10.0) -> None:
+        """shmem_wait_until: poll local memory until `local[index] op value`.
+        ops: eq, ne, gt, ge, lt, le."""
+        import operator
+
+        cmp = {"eq": operator.eq, "ne": operator.ne, "gt": operator.gt,
+               "ge": operator.ge, "lt": operator.lt, "le": operator.le}[op]
+        deadline = time.monotonic() + timeout
+        v = self.local(sym).reshape(-1)
+        while not cmp(v[index], value):
+            if time.monotonic() > deadline:
+                raise errors.InternalError(
+                    f"wait_until timed out: {v[index]} {op} {value}"
+                )
+            time.sleep(0)  # yield to writer threads
+
+    # -- distributed locks -----------------------------------------------
+
+    def _dist_lock(self, sym: SymArray) -> threading.RLock:
+        with self._state.dist_lock_guard:
+            return self._state.dist_locks.setdefault(
+                sym.offset, threading.RLock()
+            )
+
+    def set_lock(self, sym: SymArray) -> None:
+        """shmem_set_lock on a symmetric lock variable."""
+        self._dist_lock(sym).acquire()
+
+    def clear_lock(self, sym: SymArray) -> None:
+        self._dist_lock(sym).release()
+
+    def test_lock(self, sym: SymArray) -> bool:
+        """shmem_test_lock: True if acquired."""
+        return self._dist_lock(sym).acquire(blocking=False)
+
+    # -- collectives (scoll/basic analog) --------------------------------
+
+    def barrier_all(self) -> None:
+        self._ctx.barrier()
+
+    def broadcast(self, sym: SymArray, root: int = 0) -> None:
+        """shmem_broadcast: root's instance overwrites every PE's."""
+        me = self._ctx.rank
+        if me == root:
+            data = self.local(sym).copy()
+            for r in range(self._ctx.size):
+                if r != root:
+                    self._ctx.send(data, dest=r, tag=0x7FF1, cid=0x7FF0)
+        else:
+            data = self._ctx.recv(source=root, tag=0x7FF1, cid=0x7FF0)
+            self.local(sym)[...] = data
+        self.barrier_all()
+
+    def fcollect(self, dest: SymArray, src: SymArray) -> None:
+        """shmem_fcollect: concatenate every PE's src (equal sizes) into
+        every PE's dest, PE order."""
+        n = self._ctx.size
+        me = self._ctx.rank
+        mine = self.local(src).reshape(-1)
+        if dest.nbytes != src.nbytes * n:
+            raise errors.CountError("fcollect dest must hold n_pes * src")
+        out = self.local(dest).reshape(-1)
+        chunk = mine.size
+        # ring allgather over pt2pt
+        block = mine.copy()
+        out[me * chunk : (me + 1) * chunk] = block
+        for step in range(n - 1):
+            src_pe = (me - 1 - step) % n
+            block = self._ctx.sendrecv(
+                block, dest=(me + 1) % n, source=(me - 1) % n,
+                sendtag=0x7F2, recvtag=0x7F2, cid=0x7FF0,
+            )
+            out[src_pe * chunk : (src_pe + 1) * chunk] = block
+        self.barrier_all()
+
+    def collect(self, dest: SymArray, src: SymArray,
+                counts: Sequence[int]) -> None:
+        """shmem_collect: variable contribution sizes (counts[pe] elements
+        of src used)."""
+        n = self._ctx.size
+        me = self._ctx.rank
+        mine = self.local(src).reshape(-1)[: counts[me]].copy()
+        gathered: list[Any] = [None] * n
+        gathered[me] = mine
+        for step in range(1, n):
+            dest_pe = (me + step) % n
+            src_pe = (me - step) % n
+            got = self._ctx.sendrecv(
+                mine, dest=dest_pe, source=src_pe,
+                sendtag=0x7F3, recvtag=0x7F3, cid=0x7FF0,
+            )
+            gathered[src_pe] = got
+        flat = np.concatenate(gathered)
+        self.local(dest).reshape(-1)[: flat.size] = flat
+        self.barrier_all()
+
+    def _reduce_to_all(self, dest: SymArray, src: SymArray, fn) -> None:
+        """Linear reduce at PE 0 + broadcast — the scoll/basic shape; PE
+        order is preserved so non-commutative user extensions stay
+        deterministic."""
+        n = self._ctx.size
+        me = self._ctx.rank
+        acc = self.local(src).copy()
+        if me == 0:
+            for r in range(1, n):
+                other = self._ctx.recv(source=r, tag=0x7F4, cid=0x7FF0)
+                acc = fn(acc, other)
+            for r in range(1, n):
+                self._ctx.send(acc, dest=r, tag=0x7F6, cid=0x7FF0)
+        else:
+            self._ctx.send(acc, dest=0, tag=0x7F4, cid=0x7FF0)
+            acc = self._ctx.recv(source=0, tag=0x7F6, cid=0x7FF0)
+        self.local(dest)[...] = acc
+        self.barrier_all()
+
+    def sum_to_all(self, dest: SymArray, src: SymArray) -> None:
+        self._reduce_to_all(dest, src, np.add)
+
+    def max_to_all(self, dest: SymArray, src: SymArray) -> None:
+        self._reduce_to_all(dest, src, np.maximum)
+
+    def min_to_all(self, dest: SymArray, src: SymArray) -> None:
+        self._reduce_to_all(dest, src, np.minimum)
+
+    def prod_to_all(self, dest: SymArray, src: SymArray) -> None:
+        self._reduce_to_all(dest, src, np.multiply)
+
+    def and_to_all(self, dest: SymArray, src: SymArray) -> None:
+        self._reduce_to_all(dest, src, np.bitwise_and)
+
+    def or_to_all(self, dest: SymArray, src: SymArray) -> None:
+        self._reduce_to_all(dest, src, np.bitwise_or)
+
+    def xor_to_all(self, dest: SymArray, src: SymArray) -> None:
+        self._reduce_to_all(dest, src, np.bitwise_xor)
+
+    def alltoall(self, dest: SymArray, src: SymArray) -> None:
+        """shmem_alltoall: block i of src goes to PE i's dest block me."""
+        n = self._ctx.size
+        me = self._ctx.rank
+        s = self.local(src).reshape(n, -1)
+        d = self.local(dest).reshape(n, -1)
+        d[me] = s[me]
+        for step in range(1, n):
+            dest_pe = (me + step) % n
+            src_pe = (me - step) % n
+            got = self._ctx.sendrecv(
+                s[dest_pe].copy(), dest=dest_pe, source=src_pe,
+                sendtag=0x7F5, recvtag=0x7F5, cid=0x7FF0,
+            )
+            d[src_pe] = got
+        self.barrier_all()
+
+
+def shmem_universe(n_pes: int, heap_bytes: int = _DEFAULT_HEAP
+                   ) -> tuple[LocalUniverse, list[ShmemPE]]:
+    """Create a PE universe: the shmem analog of
+    :func:`zhpe_ompi_tpu.pt2pt.universe.LocalUniverse` construction +
+    symmetric-heap attach (shmem_init)."""
+    uni = LocalUniverse(n_pes)
+    state = _ShmemUniverseState(n_pes, heap_bytes)
+    pes = [ShmemPE(ctx, state) for ctx in uni.contexts]
+    return uni, pes

@@ -1,0 +1,66 @@
+"""Symmetric heap allocator (reference: ``oshmem/mca/memheap``).
+
+The reference offers buddy and ptmalloc components carving a pre-created
+shared segment (``sshmem/{mmap,sysv}``).  What makes a heap *symmetric* is
+not the allocator policy but determinism: every PE performs the same
+allocation sequence, so identical offsets come out — remote addresses are
+computed, never exchanged.  This first-fit free-list allocator is fully
+deterministic, coalesces on free, and aligns to 64 bytes (the reference
+aligns to cache lines; TPU HBM tiles like wider alignment too).
+"""
+
+from __future__ import annotations
+
+from ..core import errors
+
+ALIGN = 64
+
+
+class SymmetricHeapAllocator:
+    """First-fit free-list over a fixed-size arena of bytes."""
+
+    def __init__(self, size: int):
+        if size <= 0:
+            raise errors.ArgError("heap size must be positive")
+        self.size = size
+        # sorted list of (offset, length) free extents
+        self._free: list[tuple[int, int]] = [(0, size)]
+        self._live: dict[int, int] = {}  # offset -> allocated length
+
+    def alloc(self, nbytes: int) -> int:
+        """Return the offset of a new block; raises when the arena is
+        exhausted (the reference's memheap grows via mmap; a fixed arena
+        keeps offsets stable, which symmetric addressing needs)."""
+        if nbytes <= 0:
+            raise errors.ArgError("alloc size must be positive")
+        want = -(-nbytes // ALIGN) * ALIGN
+        for i, (off, length) in enumerate(self._free):
+            if length >= want:
+                if length == want:
+                    del self._free[i]
+                else:
+                    self._free[i] = (off + want, length - want)
+                self._live[off] = want
+                return off
+        raise errors.ResourceError(
+            f"symmetric heap exhausted: want {want} bytes"
+        )
+
+    def free(self, offset: int) -> None:
+        length = self._live.pop(offset, None)
+        if length is None:
+            raise errors.ArgError(f"free of unallocated offset {offset}")
+        self._free.append((offset, length))
+        self._free.sort()
+        # coalesce adjacent extents
+        merged: list[tuple[int, int]] = []
+        for off, ln in self._free:
+            if merged and merged[-1][0] + merged[-1][1] == off:
+                merged[-1] = (merged[-1][0], merged[-1][1] + ln)
+            else:
+                merged.append((off, ln))
+        self._free = merged
+
+    @property
+    def live_bytes(self) -> int:
+        return sum(self._live.values())
