@@ -1,0 +1,188 @@
+"""OSU-microbenchmark-style harness (SURVEY.md §6).
+
+The reference ships no benchmarks in-tree — Open MPI is measured with the
+external OSU/IMB suites (osu_allreduce, osu_bcast, osu_latency).  This is
+the in-tree equivalent for the TPU-native framework: per-algorithm
+collective latency/bandwidth sweeps over OSU's size ladder, and a
+host-plane ping-pong latency test, all emitting the familiar two-column
+table.
+
+Usage::
+
+    python -m benchmarks.osu_zmpi --op allreduce --algorithm ring
+    python -m benchmarks.osu_zmpi --op bcast --max-size 1048576
+    python -m benchmarks.osu_zmpi --op pt2pt
+    python -m benchmarks.osu_zmpi --op all --json
+
+On a CPU host this exercises the 8-virtual-device loopback mesh (the
+btl/self+sm analog); on TPU hardware the same sweep rides ICI.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+from typing import Callable
+
+import numpy as np
+
+
+def _sizes(max_bytes: int, min_bytes: int = 4) -> list[int]:
+    out = []
+    s = min_bytes
+    while s <= max_bytes:
+        out.append(s)
+        s *= 4
+    return out
+
+
+def _time_op(fn: Callable[[], None], warmup: int = 2, iters: int = 10
+             ) -> float:
+    """Median wall-clock seconds of fn() (fn must block to completion)."""
+    for _ in range(warmup):
+        fn()
+    samples = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        fn()
+        samples.append(time.perf_counter() - t0)
+    return float(np.median(samples))
+
+
+def bench_collective(opname: str, algorithm: str = "auto",
+                     max_size: int = 4 << 20, iters: int = 10,
+                     dtype=None) -> list[dict]:
+    """Latency sweep of one collective, optionally pinning the tuned
+    algorithm (the MCA forced-algorithm knob)."""
+    import jax
+    import jax.numpy as jnp
+
+    import zhpe_ompi_tpu as zmpi
+    from zhpe_ompi_tpu.mca import var as mca_var
+
+    world = zmpi.init()
+    n = world.size
+    dtype = dtype or jnp.float32
+    itemsize = jnp.dtype(dtype).itemsize
+
+    rows = []
+    for nbytes in _sizes(max_size):
+        count = max(n, nbytes // itemsize)
+        count = -(-count // n) * n  # divisible by n for scatter-type ops
+        x = jnp.arange(n * count, dtype=dtype).reshape(n, count)
+        xs = world.device_put_sharded(x)
+
+        if algorithm != "auto":
+            mca_var.set_var(f"coll_tuned_{opname}_algorithm", algorithm)
+        try:
+            if opname in ("allreduce", "reduce", "reduce_scatter",
+                          "reduce_scatter_block", "scan", "exscan"):
+                per_dev = lambda s: getattr(world, opname)(s.reshape(count))
+            elif opname in ("bcast", "gather", "scatter"):
+                per_dev = lambda s: getattr(world, opname)(
+                    s.reshape(count), 0
+                )
+            else:  # allgather, alltoall, barrier
+                per_dev = lambda s: getattr(world, opname)(s.reshape(count))
+            jitted = jax.jit(
+                lambda a: world.run(per_dev, a)
+            )
+            out = jitted(xs)  # compile
+            jax.block_until_ready(out)
+            sec = _time_op(
+                lambda: jax.block_until_ready(jitted(xs)), iters=iters
+            )
+        finally:
+            if algorithm != "auto":
+                mca_var.set_var(f"coll_tuned_{opname}_algorithm", "auto")
+
+        rows.append({
+            "op": opname, "algorithm": algorithm, "bytes": count * itemsize,
+            "latency_us": sec * 1e6,
+            "bandwidth_MBps": (count * itemsize / sec) / 1e6,
+        })
+    return rows
+
+
+def bench_pt2pt(max_size: int = 4 << 20, iters: int = 50) -> list[dict]:
+    """Host-plane ping-pong latency (osu_latency shape) over the
+    thread-rank universe — the btl/self+sm loopback analog."""
+    from zhpe_ompi_tpu.pt2pt.universe import LocalUniverse
+
+    rows = []
+    for nbytes in _sizes(max_size):
+        payload = np.zeros(max(1, nbytes // 8), dtype=np.float64)
+        uni = LocalUniverse(2)
+
+        def main(ctx, payload=payload):
+            if ctx.rank == 0:
+                # warmup
+                ctx.send(payload, dest=1, tag=1)
+                ctx.recv(source=1, tag=2)
+                t0 = time.perf_counter()
+                for _ in range(iters):
+                    ctx.send(payload, dest=1, tag=1)
+                    ctx.recv(source=1, tag=2)
+                return (time.perf_counter() - t0) / iters
+            ctx.recv(source=0, tag=1)
+            ctx.send(payload, dest=0, tag=2)
+            for _ in range(iters):
+                ctx.recv(source=0, tag=1)
+                ctx.send(payload, dest=0, tag=2)
+            return None
+
+        rtt = uni.run(main)[0]
+        rows.append({
+            "op": "pt2pt_pingpong", "bytes": payload.nbytes,
+            "latency_us": rtt / 2 * 1e6,  # one-way, OSU convention
+            "bandwidth_MBps": (payload.nbytes / (rtt / 2)) / 1e6,
+        })
+    return rows
+
+
+def _print_table(rows: list[dict]) -> None:
+    if not rows:
+        return
+    print(f"# {rows[0]['op']}"
+          + (f" [{rows[0]['algorithm']}]" if "algorithm" in rows[0] else ""))
+    print(f"{'Size (B)':>12} {'Latency (us)':>16} {'BW (MB/s)':>14}")
+    for r in rows:
+        print(f"{r['bytes']:>12} {r['latency_us']:>16.2f} "
+              f"{r['bandwidth_MBps']:>14.1f}")
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--op", default="allreduce",
+                   help="allreduce|bcast|allgather|alltoall|reduce|"
+                        "reduce_scatter|pt2pt|all")
+    p.add_argument("--algorithm", default="auto",
+                   help="tuned forced algorithm name, or 'auto'")
+    p.add_argument("--max-size", type=int, default=1 << 20)
+    p.add_argument("--iters", type=int, default=10)
+    p.add_argument("--json", action="store_true")
+    args = p.parse_args(argv)
+
+    if args.op == "pt2pt":
+        rows = bench_pt2pt(args.max_size, max(args.iters, 10))
+    elif args.op == "all":
+        rows = []
+        for op in ("allreduce", "bcast", "allgather", "alltoall"):
+            rows += bench_collective(op, "auto", args.max_size, args.iters)
+        rows += bench_pt2pt(args.max_size, max(args.iters, 10))
+    else:
+        rows = bench_collective(
+            args.op, args.algorithm, args.max_size, args.iters
+        )
+
+    if args.json:
+        for r in rows:
+            print(json.dumps(r))
+    else:
+        _print_table(rows)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

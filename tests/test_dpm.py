@@ -1,0 +1,135 @@
+"""Dynamic process management tests (reference: ompi/dpm, exercised by
+test/simple/{concurrent_spawn,intercomm_create}.c and
+MPI_Comm_connect/accept examples)."""
+
+import numpy as np
+import pytest
+
+from zhpe_ompi_tpu.comm import dpm
+from zhpe_ompi_tpu.core import errors
+from zhpe_ompi_tpu.pt2pt.universe import LocalUniverse
+
+
+class TestSpawn:
+    def test_spawn_and_pingpong(self):
+        """Parent universe spawns children; each parent rank sends to its
+        mirror child over the intercomm, children reply via get_parent."""
+        parent = LocalUniverse(2)
+
+        def child_main(cctx):
+            up = dpm.get_parent(cctx)
+            assert up is not None
+            assert up.remote_size == 2
+            val = up.recv(source=cctx.rank, tag=5)
+            up.send(val * 10, dest=cctx.rank, tag=6)
+            return val
+
+        def parent_main(ctx):
+            inter, handle = dpm.spawn(parent, ctx, child_main, 2)
+            assert inter.remote_size == 2
+            inter.send(ctx.rank + 1, dest=ctx.rank, tag=5)
+            echoed = inter.recv(source=ctx.rank, tag=6)
+            if ctx.rank == 0:
+                kids = handle.join()
+                assert kids == [1, 2]
+            return echoed
+
+        results = parent.run(parent_main)
+        assert results == [10, 20]
+
+    def test_get_parent_none_for_root(self):
+        uni = LocalUniverse(1)
+        assert dpm.get_parent(uni.contexts[0]) is None
+
+    def test_spawn_child_failure_surfaces_in_join(self):
+        parent = LocalUniverse(1)
+
+        def child_main(cctx):
+            raise RuntimeError("child exploded")
+
+        def parent_main(ctx):
+            _, handle = dpm.spawn(parent, ctx, child_main, 2)
+            with pytest.raises(RuntimeError, match="child exploded"):
+                handle.join()
+            return True
+
+        assert parent.run(parent_main) == [True]
+
+
+class TestConnectAccept:
+    def test_connect_accept_bridge(self):
+        """Two independent universes rendezvous on a port (the
+        MPI_Open_port / MPI_Comm_accept / MPI_Comm_connect triple)."""
+        server = LocalUniverse(2)
+        client = LocalUniverse(3)
+        port = dpm.open_port()
+        out = {}
+
+        import threading
+
+        def server_side():
+            def main(ctx):
+                inter = dpm.accept(port, server, ctx)
+                assert inter.remote_size == 3
+                if ctx.rank == 0:
+                    # gather one value from every client rank
+                    vals = sorted(
+                        inter.recv(tag=9) for _ in range(inter.remote_size)
+                    )
+                    return vals
+                return None
+
+            out["server"] = server.run(main)
+
+        def client_side():
+            def main(ctx):
+                inter = dpm.connect(port, client, ctx)
+                assert inter.remote_size == 2
+                inter.send(100 + ctx.rank, dest=0, tag=9)
+                return True
+
+            out["client"] = client.run(main)
+
+        ts = threading.Thread(target=server_side)
+        tc = threading.Thread(target=client_side)
+        ts.start()
+        tc.start()
+        ts.join(30)
+        tc.join(30)
+        dpm.close_port(port)
+        assert out["server"][0] == [100, 101, 102]
+        assert out["client"] == [True, True, True]
+
+    def test_unknown_port(self):
+        uni = LocalUniverse(1)
+
+        def main(ctx):
+            with pytest.raises(errors.ArgError):
+                dpm.connect("no-such-port", uni, ctx)
+            return True
+
+        assert uni.run(main) == [True]
+
+    def test_intercomm_barrier(self):
+        a = LocalUniverse(2)
+        b = LocalUniverse(2)
+        port = dpm.open_port()
+        import threading
+
+        res = {}
+
+        def side(uni, fn_name, key):
+            def main(ctx):
+                inter = getattr(dpm, fn_name)(port, uni, ctx)
+                inter.barrier()
+                inter.disconnect()
+                return True
+
+            res[key] = uni.run(main)
+
+        t1 = threading.Thread(target=side, args=(a, "accept", "a"))
+        t2 = threading.Thread(target=side, args=(b, "connect", "b"))
+        t1.start(); t2.start()
+        t1.join(30); t2.join(30)
+        dpm.close_port(port)
+        assert res["a"] == [True, True] and res["b"] == [True, True]

@@ -1,0 +1,65 @@
+"""DSS serialization tests (reference: opal/dss, test/dss/*)."""
+
+import numpy as np
+import pytest
+
+from zhpe_ompi_tpu.core import errors
+from zhpe_ompi_tpu.utils import dss
+
+
+class TestRoundtrip:
+    @pytest.mark.parametrize("value", [
+        None, True, False, 0, 1, -1, 127, 128, -300, 2**40, -(2**40),
+        0.0, -1.5, 3.14159, "", "hello", "unicode: émojis 🎉",
+        b"", b"\x00\xff raw",
+    ])
+    def test_scalars(self, value):
+        [out] = dss.unpack(dss.pack(value))
+        assert out == value and type(out) is type(value)
+
+    def test_multiple_values(self):
+        vals = [1, "two", b"three", 4.0, None]
+        assert dss.unpack(dss.pack(*vals)) == vals
+
+    @pytest.mark.parametrize("dtype", [
+        np.int8, np.int32, np.int64, np.uint16, np.float32, np.float64,
+        np.bool_,
+    ])
+    def test_ndarray(self, dtype):
+        arr = np.arange(24).reshape(2, 3, 4).astype(dtype)
+        [out] = dss.unpack(dss.pack(arr))
+        assert out.dtype == arr.dtype and out.shape == arr.shape
+        np.testing.assert_array_equal(out, arr)
+
+    def test_ndarray_zero_size(self):
+        arr = np.zeros((0, 5), np.float32)
+        [out] = dss.unpack(dss.pack(arr))
+        assert out.shape == (0, 5)
+
+    def test_numpy_scalar(self):
+        [out] = dss.unpack(dss.pack(np.float32(2.5)))
+        assert out.dtype == np.float32 and float(out) == 2.5
+
+    def test_nested_containers(self):
+        obj = {
+            "config": {"ranks": [0, 1, 2], "mesh": (2, 4)},
+            "weights": np.linspace(0, 1, 7).astype(np.float32),
+            ("tuple", "key"): [b"payload", None, {"deep": True}],
+        }
+        [out] = dss.unpack(dss.pack(obj))
+        assert out["config"] == obj["config"]
+        assert isinstance(out["config"]["mesh"], tuple)
+        np.testing.assert_array_equal(out["weights"], obj["weights"])
+        assert out[("tuple", "key")][2] == {"deep": True}
+
+    def test_unpackable_type_raises(self):
+        with pytest.raises(errors.TypeError_):
+            dss.pack(object())
+
+    def test_trailing_garbage_raises(self):
+        with pytest.raises(errors.TruncateError):
+            dss.unpack(dss.pack(1) + b"\x00")
+
+    def test_wire_is_compact(self):
+        # a small int should be a handful of bytes, not a pickle blob
+        assert len(dss.pack(7)) <= 4

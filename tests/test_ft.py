@@ -1,0 +1,224 @@
+"""Fault-tolerance tests: pessimistic message logging + replay
+(vprotocol/pessimist analog) and bookmark quiescence (crcp/bkmrk analog)."""
+
+import numpy as np
+import pytest
+
+from zhpe_ompi_tpu.core import errors
+from zhpe_ompi_tpu.ft import BookmarkCoordinator, UniverseLogger
+from zhpe_ompi_tpu.pt2pt.matching import ANY_SOURCE
+from zhpe_ompi_tpu.pt2pt.universe import LocalUniverse
+
+N = 4
+
+
+def ring_program(ctx):
+    """Each rank passes an accumulating token around the ring twice, plus
+    an any-source gather at rank 0 — enough nondeterminism to make replay
+    meaningful."""
+    acc = ctx.rank
+    for lap in range(2):
+        if ctx.rank == 0:
+            ctx.send(acc, dest=1, tag=lap)
+            acc = ctx.recv(source=N - 1, tag=lap)
+        else:
+            got = ctx.recv(source=ctx.rank - 1, tag=lap)
+            acc = acc + got
+            ctx.send(acc, dest=(ctx.rank + 1) % N, tag=lap)
+    # any-source phase: rank 0 collects one message from everyone
+    if ctx.rank == 0:
+        for _ in range(N - 1):
+            acc += ctx.recv(source=ANY_SOURCE, tag=99)
+    else:
+        ctx.send(ctx.rank * 100, dest=0, tag=99)
+    return acc
+
+
+class TestVprotocol:
+    def test_logged_run_matches_plain(self):
+        plain = LocalUniverse(N).run(ring_program)
+        logger = UniverseLogger(LocalUniverse(N))
+        logged = logger.run_logged(ring_program)
+        assert logged == plain
+
+    def test_replay_reproduces_rank(self):
+        """Restart each rank against the logs: identical result, no other
+        rank involved — the pessimist guarantee."""
+        logger = UniverseLogger(LocalUniverse(N))
+        live = logger.run_logged(ring_program)
+        for rank in range(N):
+            replay_ctx = logger.replay_context(rank)
+            assert ring_program(replay_ctx) == live[rank]
+            assert replay_ctx.fully_replayed
+
+    def test_replay_detects_divergence(self):
+        logger = UniverseLogger(LocalUniverse(2))
+
+        def prog(ctx):
+            if ctx.rank == 0:
+                ctx.send(b"x", dest=1, tag=3)
+                return 0
+            return ctx.recv(source=0, tag=3)
+
+        logger.run_logged(prog)
+        bad = logger.replay_context(0)
+        with pytest.raises(errors.InternalError, match="divergence"):
+            bad.send(b"x", dest=1, tag=4)  # logged tag was 3
+
+    def test_event_counts(self):
+        logger = UniverseLogger(LocalUniverse(N))
+        logger.run_logged(ring_program)
+        sends, recvs = logger.event_counts(0)
+        # rank 0: 2 ring sends; 2 ring recvs + 3 any-source recvs
+        assert sends == 2 and recvs == 5
+
+
+class TestCrcp:
+    def test_quiescent_after_balanced_traffic(self):
+        coord = BookmarkCoordinator(LocalUniverse(N))
+
+        def prog(ctx):
+            b = coord.wrap(ctx)
+            b.send(ctx.rank, dest=(ctx.rank + 1) % N, tag=0)
+            b.recv(source=(ctx.rank - 1) % N, tag=0)
+            return True
+
+        coord._uni.run(prog)
+        assert coord.quiescent()
+        coord.require_quiescent()  # no raise
+        sent, recvd = coord.bookmarks()
+        assert sent.sum() == N and recvd.sum() == N
+
+    def test_in_flight_detected(self):
+        uni = LocalUniverse(2)
+        coord = BookmarkCoordinator(uni)
+
+        def prog(ctx):
+            b = coord.wrap(ctx)
+            if ctx.rank == 0:
+                b.send(b"dangling", dest=1, tag=7)  # never received
+            return True
+
+        uni.run(prog)
+        assert not coord.quiescent()
+        assert coord.in_flight()[0, 1] == 1
+        with pytest.raises(errors.InternalError, match="0->1"):
+            coord.require_quiescent()
+
+
+class TestMpisync:
+    def test_zero_offset_shared_clock(self):
+        from zhpe_ompi_tpu.tools.mpisync import sync_clocks
+
+        offsets = sync_clocks(LocalUniverse(3))
+        assert offsets[0] == 0.0
+        assert all(abs(o) < 0.05 for o in offsets)
+
+    def test_recovers_injected_skew(self):
+        import time
+
+        from zhpe_ompi_tpu.tools.mpisync import sync_clocks
+
+        skew = [0.0, 0.25, -0.5, 1.0]
+        offsets = sync_clocks(
+            LocalUniverse(4),
+            clock=lambda r: time.monotonic() + skew[r],
+        )
+        for r in range(1, 4):
+            assert abs(offsets[r] - skew[r]) < 0.05, (r, offsets)
+
+
+class TestMemchecker:
+    def test_nan_send_rejected_when_enabled(self):
+        from zhpe_ompi_tpu.mca import var as mca_var
+        from zhpe_ompi_tpu.utils import memchecker
+
+        mca_var.set_var("memchecker_enable", True)
+        try:
+            uni = LocalUniverse(2)
+
+            def prog(ctx):
+                if ctx.rank == 0:
+                    bad = np.array([1.0, np.nan], np.float32)
+                    with pytest.raises(errors.MpiError, match="NaN"):
+                        ctx.send(bad, dest=1)
+                    ctx.send(np.ones(2, np.float32), dest=1)
+                    return True
+                return ctx.recv(source=0) is not None
+
+            assert uni.run(prog) == [True, True]
+        finally:
+            mca_var.set_var("memchecker_enable", False)
+
+    def test_disabled_by_default(self):
+        from zhpe_ompi_tpu.utils import memchecker
+
+        assert not memchecker.enabled()
+        uni = LocalUniverse(2)
+
+        def prog(ctx):
+            if ctx.rank == 0:
+                ctx.send(np.array([np.nan], np.float32), dest=1)
+                return True
+            return bool(np.isnan(ctx.recv(source=0))[0])
+
+        assert uni.run(prog) == [True, True]
+
+
+class TestPmpi:
+    def test_interposition_sees_collectives(self):
+        import zhpe_ompi_tpu as zmpi
+        from zhpe_ompi_tpu.tools import pmpi
+
+        world = zmpi.init()
+        calls = []
+
+        def tracer(opname, comm, args, kwargs, call_next):
+            calls.append((opname, comm.name))
+            return call_next()
+
+        pmpi.attach(tracer)
+        try:
+            import jax.numpy as jnp
+
+            x = np.ones((world.size, 2), np.float32)
+            xs = world.device_put_sharded(jnp.asarray(x))
+            out = np.asarray(world.run(lambda s: world.allreduce(s), xs))
+            np.testing.assert_allclose(
+                out.reshape(world.size, 2), world.size
+            )
+        finally:
+            pmpi.detach(tracer)
+        assert ("allreduce", "MPI_COMM_WORLD") in calls
+
+    def test_chain_order_outermost_last(self):
+        import zhpe_ompi_tpu as zmpi
+        from zhpe_ompi_tpu.tools import pmpi
+
+        world = zmpi.init()
+        order = []
+
+        def layer(name):
+            def f(opname, comm, args, kwargs, call_next):
+                order.append(f"{name}-in")
+                out = call_next()
+                order.append(f"{name}-out")
+                return out
+
+            return f
+
+        l1, l2 = layer("first"), layer("second")
+        pmpi.attach(l1)
+        pmpi.attach(l2)
+        try:
+            import jax.numpy as jnp
+
+            xs = world.device_put_sharded(
+                jnp.ones((world.size, 1), jnp.float32)
+            )
+            world.run(lambda s: world.allreduce(s), xs)
+        finally:
+            pmpi.detach(l1)
+            pmpi.detach(l2)
+        assert order[:2] == ["second-in", "first-in"]
+        assert order[-2:] == ["first-out", "second-out"]

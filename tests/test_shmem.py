@@ -96,6 +96,40 @@ class TestPutGet:
         expect[0:10:2] = np.arange(5)
         np.testing.assert_array_equal(results[1], expect)
 
+    def test_exhaustion_raises_on_every_pe(self, universe):
+        """Allocator failure must surface collectively — not deadlock the
+        non-root PEs waiting on rank 0's offset broadcast."""
+        uni, pes = universe
+
+        def pe_main(ctx):
+            pe = pes[ctx.rank]
+            with pytest.raises(errors.ResourceError):
+                pe.shmalloc(1 << 22, np.uint8)  # bigger than the heap
+            ok = pe.shmalloc(8, np.int64)  # universe still usable after
+            return ok.offset
+
+        results = uni.run(pe_main)
+        assert len(set(results)) == 1
+
+    def test_iget_target_stride(self, universe):
+        uni, pes = universe
+
+        def pe_main(ctx):
+            pe = pes[ctx.rank]
+            sym = pe.shmalloc(8, np.int64)
+            pe.local(sym)[...] = np.arange(8) + 10 * pe.my_pe()
+            pe.barrier_all()
+            target = np.zeros(8, np.int64)
+            # fetch 3 elements of PE 1 at source stride 2, place at
+            # target stride 3
+            pe.iget(sym, pe=1, n=3, target=target, tst=3, sst=2)
+            return target
+
+        for t in uni.run(pe_main):
+            np.testing.assert_array_equal(
+                t, [10, 0, 0, 12, 0, 0, 14, 0]
+            )
+
     def test_symmetric_free_and_realloc(self, universe):
         uni, pes = universe
 

@@ -49,6 +49,7 @@ from .ops import (
     SUM,
     create_op,
 )
+from .comm import dpm
 from .parallel import mesh
 from .runtime import spc
 from .runtime.init import (
@@ -66,6 +67,7 @@ __version__ = "0.1.0"
 __all__ = [
     "init", "finalize", "initialized", "is_finalized", "world", "comm_self",
     "world_mesh", "Communicator", "Group", "mesh", "datatype", "ops", "spc",
+    "dpm",
     "errors", "mca_var", "mca_component", "mca_output", "coll_algorithms",
     "SUM", "MAX", "MIN", "PROD", "LAND", "LOR", "LXOR", "BAND", "BOR",
     "BXOR", "MAXLOC", "MINLOC", "create_op",

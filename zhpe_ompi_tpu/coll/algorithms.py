@@ -455,7 +455,9 @@ def reduce_binomial(comm, x, op, root=0):
             pairs.append(((v + k + root) % n, (v + root) % n))
         recv = spmd.ppermute(comm, x, pairs)
         is_recv = (vrank % (2 * k) == 0) & (vrank + k < n)
-        x = _where(is_recv, op(recv, x), x)
+        # op(x, recv): x holds [v, v+k), recv holds [v+k, v+2k) — keeps
+        # the tree's reduction in ascending vrank order
+        x = _where(is_recv, op(x, recv), x)
         k <<= 1
     return x
 
@@ -490,9 +492,10 @@ def reduce_chain(comm, x, op, root=0, segments: int = 4):
     def one_segment(sg):
         def hop(t, acc):
             recv = spmd.ppermute(comm, acc, pairs)
-            # at hop t, vrank n-2-t absorbs the partial from vrank n-1-t
+            # at hop t, vrank n-2-t absorbs the partial from vrank n-1-t;
+            # op(acc, recv) keeps MPI's rank order x_v ⊕ (x_{v+1} ⊕ ...)
             absorbing = vrank == (n - 2 - t)
-            return _where(absorbing, op(recv, acc), acc)
+            return _where(absorbing, op(acc, recv), acc)
 
         return lax.fori_loop(0, n - 1, hop, sg)
 
@@ -864,6 +867,39 @@ def alltoall_two_proc(comm, x):
 # ---------------------------------------------------------------------------
 
 
+def alltoallv_prepare(comm, x, counts):
+    """Shared front half of every alltoallv transport: validate the static
+    ``counts[i][j]`` matrix, pad the send blocks to the global max count,
+    and zero-mask rows beyond this rank's per-destination counts so
+    padding can never leak into receive buffers.  Returns
+    ``(blocks, max_recv)`` with blocks shaped ``(n, max_recv, ...)``."""
+    n = _require_uniform(comm)
+    if len(counts) != n or any(
+        not hasattr(row, "__len__") or len(row) != n for row in counts
+    ):
+        raise errors.ArgError(f"counts must be {n}x{n}")
+    if x.shape[0] != n:
+        raise errors.CountError(
+            f"alltoallv send buffer needs {n} blocks, got {x.shape[0]}"
+        )
+    rank = comm.rank()
+    max_recv = max(max(row) for row in counts)
+    blk = x.shape[1]
+    if blk < max_recv:
+        x = jnp.pad(
+            x, ((0, 0), (0, max_recv - blk)) + ((0, 0),) * (x.ndim - 2)
+        )
+    else:
+        x = x[:, :max_recv]
+    sent_cnt = jnp.asarray(counts)[rank]  # (n,) rows sent to each dest
+    mask = jnp.arange(max_recv)[None, :] < sent_cnt[:, None]
+    x = jnp.where(
+        mask.reshape((n, max_recv) + (1,) * (x.ndim - 2)), x,
+        jnp.zeros_like(x),
+    )
+    return x, max_recv
+
+
 def alltoallv_padded(comm, x, counts):
     """Pairwise alltoallv (reference: coll_base_alltoallv.c:125) with a
     static count matrix.  ``counts[i][j]`` is how many dim0 rows rank i
@@ -873,38 +909,19 @@ def alltoallv_padded(comm, x, counts):
     padded receive blocks — entries beyond ``counts[src][rank]`` are zero.
     Static padding is the price of static shapes; the communicator layer
     offers the ragged reassembly."""
+    blocks, max_recv = alltoallv_prepare(comm, x, counts)
     n = _require_uniform(comm)
-    if len(counts) != n or any(len(row) != n for row in counts):
-        raise errors.ArgError(f"counts must be {n}x{n}")
-    if x.shape[0] != n:
-        raise errors.CountError(
-            f"alltoallv send buffer needs {n} blocks, got {x.shape[0]}"
-        )
     rank = comm.rank()
-    max_recv = max(counts[i][j] for i in range(n) for j in range(n))
-    blk = x.shape[1]
-    if blk < max_recv:
-        x = jnp.pad(
-            x, ((0, 0), (0, max_recv - blk)) + ((0, 0),) * (x.ndim - 2)
-        )
-    counts_arr = jnp.asarray(counts)
-    row_ids = jnp.arange(max_recv)
-
-    def valid_block(dest):
-        cnt = counts_arr[rank, dest]
-        block = jnp.take(x, dest, axis=0)[:max_recv]
-        mask = (row_ids < cnt).reshape((max_recv,) + (1,) * (block.ndim - 1))
-        return jnp.where(mask, block, jnp.zeros_like(block))
-
-    out = jnp.zeros((n, max_recv) + x.shape[2:], x.dtype)
+    out = jnp.zeros_like(blocks)
     out = lax.dynamic_update_slice(
-        out, valid_block(rank)[None], (rank,) + (0,) * (out.ndim - 1)
+        out, jnp.take(blocks, rank, axis=0)[None],
+        (rank,) + (0,) * (out.ndim - 1),
     )
     for r in range(1, n):
         sendto = (rank + r) % n
         recvfrom = (rank - r) % n
         sent = spmd.ppermute(
-            comm, valid_block(sendto),
+            comm, jnp.take(blocks, sendto, axis=0),
             lambda m, r=r: [(i, (i + r) % m) for i in range(m)],
         )
         out = lax.dynamic_update_slice(
@@ -1170,7 +1187,11 @@ def barrier_double_ring(comm, token=None):
     t = _barrier_token(comm, token)
 
     def hop(_, tok):
-        return tok + spmd.shift(comm, tok, 1, wrap=True)
+        # pass the token along (no accumulation: tok + shift(tok) doubles
+        # per hop and overflows f32 to inf around 60 ranks, NaN-poisoning
+        # the seal); each hop depends on the left neighbor's previous hop,
+        # so n-1 laps transitively order every rank
+        return spmd.shift(comm, tok, 1, wrap=True)
 
     return _seal_token(lax.fori_loop(0, 2 * (n - 1), hop, t))
 

@@ -129,36 +129,12 @@ def reduce_scatter_block(comm, x, op):
 
 def alltoallv(comm, x, counts):
     """Padded alltoallv on the native all_to_all: x is (n, max_send, ...)
-    blocks, counts the n x n static matrix; rows beyond the count are
-    zero-masked so padding never leaks (cf. coll_base_alltoallv.c:125)."""
-    from ..core import errors
-
-    n = comm.size
-    if x.shape[0] != n:
-        raise errors.CountError(
-            f"alltoallv send buffer needs {n} blocks, got {x.shape[0]}"
-        )
-    if len(counts) != n or any(
-        not hasattr(row, "__len__") or len(row) != n for row in counts
-    ):
-        raise errors.ArgError(f"counts must be {n}x{n}")
-    rank = comm.rank()
-    max_recv = max(max(row) for row in counts)
-    if x.shape[1] < max_recv:
-        x = jnp.pad(
-            x, ((0, 0), (0, max_recv - x.shape[1])) + ((0, 0),) * (x.ndim - 2)
-        )
-    else:
-        x = x[:, :max_recv]
-    counts_arr = jnp.asarray(counts)
-    sent_cnt = counts_arr[rank]  # (n,) rows this rank sends to each dest
-    mask = jnp.arange(max_recv)[None, :] < sent_cnt[:, None]
-    x = jnp.where(
-        mask.reshape((n, max_recv) + (1,) * (x.ndim - 2)), x,
-        jnp.zeros_like(x),
-    )
+    blocks, counts the n x n static matrix; validation, padding and
+    count-masking are shared with the algorithmic transport
+    (alg.alltoallv_prepare — cf. coll_base_alltoallv.c:125)."""
+    blocks, _ = alg.alltoallv_prepare(comm, x, counts)
     return lax.all_to_all(
-        x, comm.axis, split_axis=0, concat_axis=0,
+        blocks, comm.axis, split_axis=0, concat_axis=0,
         axis_index_groups=_groups(comm), tiled=False,
     )
 

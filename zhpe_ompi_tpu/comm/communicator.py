@@ -203,6 +203,12 @@ class Communicator:
                 f"no coll component provides {opname} for {self.name}"
             )
         fn, comp_name = entry
+        # PMPI interposition point (the weak-symbol MPI_X = PMPI_X analog,
+        # ompi/mpi/c/send.c:37-39): tools see the call before the MCA path
+        from ..tools import pmpi
+
+        if pmpi.active():
+            return pmpi.dispatch(opname, self, fn, args, kwargs)
         return fn(self, *args, **kwargs)
 
     def allreduce(self, x, op=None, **kw):

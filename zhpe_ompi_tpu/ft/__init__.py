@@ -1,0 +1,19 @@
+"""Fault tolerance — the checkpoint/restart lineage (SURVEY.md §5).
+
+The reference (Open MPI 5.0.0a1 vintage) carries three cooperating FT
+mechanisms, all re-designed here for the host plane:
+
+- ``ompi/mca/vprotocol/pessimist`` + ``pml/v`` — pessimistic message
+  logging wrapped around the PML: :mod:`.vprotocol` interposes on the
+  rank context the same way (sender-based payload logging + receiver event
+  logging) and can deterministically replay a single restarted rank.
+- ``ompi/mca/crcp/bkmrk`` — bookmark message counting so a checkpoint can
+  prove the channels are quiescent: :mod:`.crcp`.
+- ``opal/mca/crs`` single-process snapshots — the device-plane equivalent
+  is :mod:`zhpe_ompi_tpu.runtime.checkpoint`'s async array snapshots
+  (message logging does not transfer to the SPMD plane, where a step is a
+  deterministic pure function and "replay" is just re-running it).
+"""
+
+from .crcp import BookmarkCoordinator  # noqa: F401
+from .vprotocol import UniverseLogger  # noqa: F401

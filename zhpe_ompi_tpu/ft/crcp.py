@@ -1,0 +1,105 @@
+"""Bookmark checkpoint coordination — crcp/bkmrk analog.
+
+The reference's ``ompi/mca/crcp/bkmrk`` counts messages per peer pair and
+exchanges the counts ("bookmarks") when a checkpoint is requested: if
+rank i has sent more to rank j than j has received, the channel holds
+in-flight data that must be drained before the snapshot is consistent.
+
+Host-plane redesign: per-pair send/receive counters fed by the same
+interposition hook the vprotocol logger uses, and a
+:meth:`BookmarkCoordinator.quiescent` check that a checkpoint call can
+gate on — making :mod:`zhpe_ompi_tpu.runtime.checkpoint`'s "checkpoint at
+a quiescent point" contract verifiable per channel instead of assumed.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any
+
+import numpy as np
+
+from ..core import errors
+from ..pt2pt.matching import ANY_SOURCE, ANY_TAG
+from ..pt2pt.universe import LocalUniverse, RankContext
+
+
+class BookmarkedContext:
+    """RankContext proxy counting per-peer traffic."""
+
+    def __init__(self, ctx: RankContext, coord: "BookmarkCoordinator"):
+        self._ctx = ctx
+        self._coord = coord
+        self.rank = ctx.rank
+        self.size = ctx.size
+
+    def send(self, obj: Any, dest: int, tag: int = 0, cid: int = 0) -> None:
+        self._ctx.send(obj, dest, tag, cid)
+        self._coord._count_send(self.rank, dest)
+
+    def recv(self, source: int = ANY_SOURCE, tag: int = ANY_TAG,
+             cid: int = 0) -> Any:
+        value, status = self._ctx.recv(source, tag, cid, return_status=True)
+        self._coord._count_recv(status.source, self.rank)
+        return value
+
+    def sendrecv(self, obj: Any, dest: int, source: int = ANY_SOURCE,
+                 sendtag: int = 0, recvtag: int = ANY_TAG, cid: int = 0):
+        rreq = self._ctx.irecv(source, recvtag, cid)
+        self._ctx.isend(obj, dest, sendtag, cid)
+        self._coord._count_send(self.rank, dest)
+        value = rreq.wait()
+        self._coord._count_recv(rreq.status.source, self.rank)
+        return value
+
+    def barrier(self) -> None:
+        self._ctx.barrier()
+
+
+class BookmarkCoordinator:
+    """Per-pair traffic bookmarks for a universe."""
+
+    def __init__(self, uni: LocalUniverse):
+        self._uni = uni
+        n = uni.size
+        self._sent = np.zeros((n, n), dtype=np.int64)
+        self._recvd = np.zeros((n, n), dtype=np.int64)
+        self._lock = threading.Lock()
+
+    def wrap(self, ctx: RankContext) -> BookmarkedContext:
+        return BookmarkedContext(ctx, self)
+
+    def _count_send(self, src: int, dst: int) -> None:
+        with self._lock:
+            self._sent[src, dst] += 1
+
+    def _count_recv(self, src: int, dst: int) -> None:
+        with self._lock:
+            self._recvd[src, dst] += 1
+
+    def bookmarks(self) -> tuple[np.ndarray, np.ndarray]:
+        """(sent, received) matrices — entry [i, j] counts i→j messages."""
+        with self._lock:
+            return self._sent.copy(), self._recvd.copy()
+
+    def in_flight(self) -> np.ndarray:
+        """Per-channel outstanding message counts (sent − received)."""
+        sent, recvd = self.bookmarks()
+        return sent - recvd
+
+    def quiescent(self) -> bool:
+        """True when every channel is drained — the bkmrk go/no-go
+        decision for a consistent checkpoint."""
+        return bool(np.all(self.in_flight() == 0))
+
+    def require_quiescent(self) -> None:
+        fl = self.in_flight()
+        if np.any(fl != 0):
+            pairs = [
+                f"{i}->{j}:{int(fl[i, j])}"
+                for i, j in zip(*np.nonzero(fl))
+            ]
+            raise errors.InternalError(
+                "checkpoint requested on non-quiescent channels: "
+                + ", ".join(pairs)
+            )

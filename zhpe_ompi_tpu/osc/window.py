@@ -100,6 +100,9 @@ class HostWindow:
 
     def put(self, data, target: int, offset: int = 0) -> None:
         """MPI_Put: direct write into the target's window."""
+        from ..utils import memchecker
+
+        memchecker.check_send_buffer(data, "MPI_Put")
         data = np.asarray(data)
         buf = self._target_buf(target)
         flat = buf.reshape(-1)

@@ -131,6 +131,10 @@ class RankContext:
         pml_ob1_sendreq.h:385-414)."""
         if tag < 0:
             raise errors.TagError(f"negative tag {tag}")
+        # memchecker annotation point (ompi/mpi/c/send.c:53-55 analog)
+        from ..utils import memchecker
+
+        memchecker.check_send_buffer(obj, "isend")
         env = Envelope(self.rank, tag, cid, next(self._seq))
         nbytes = _payload_nbytes(obj)
         spc.record("pt2pt_sends", 1)

@@ -78,30 +78,48 @@ class ShmemPE:
 
     # -- symmetric memory ------------------------------------------------
 
+    def _rank0_collective(self, action):
+        """Rank 0 runs `action`; the outcome — value or error — is
+        broadcast so an allocator failure raises on EVERY PE instead of
+        deadlocking the others in recv (collective error agreement)."""
+        self.barrier_all()
+        if self._ctx.rank == 0:
+            try:
+                outcome = ("ok", action())
+            except errors.MpiError as e:
+                outcome = ("err", type(e).__name__, str(e))
+            for r in range(1, self._ctx.size):
+                self._ctx.send(outcome, dest=r, tag=0x7FF0, cid=0x7FF0)
+        else:
+            outcome = self._ctx.recv(source=0, tag=0x7FF0, cid=0x7FF0)
+        self.barrier_all()
+        if outcome[0] == "err":
+            cls = getattr(errors, outcome[1], errors.MpiError)
+            raise cls(outcome[2])
+        return outcome[1]
+
     def shmalloc(self, shape, dtype=np.float64) -> SymArray:
         """Collective symmetric allocation (shmem_malloc: synchronizes all
         PEs; identical offsets fall out of the shared allocator)."""
         shape = (shape,) if isinstance(shape, int) else tuple(shape)
         dt = np.dtype(dtype)
         nbytes = int(np.prod(shape or (1,))) * dt.itemsize
-        self.barrier_all()
-        if self._ctx.rank == 0:
+
+        def action():
             with self._state.alloc_lock:
-                off = self._state.allocator.alloc(nbytes)
-            for r in range(1, self._ctx.size):
-                self._ctx.send(off, dest=r, tag=0x7FF0, cid=0x7FF0)
-        else:
-            off = self._ctx.recv(source=0, tag=0x7FF0, cid=0x7FF0)
-        self.barrier_all()
+                return self._state.allocator.alloc(nbytes)
+
+        off = self._rank0_collective(action)
         return SymArray(off, shape, dt, nbytes, self._state)
 
     def shfree(self, sym: SymArray) -> None:
         """Collective free."""
-        self.barrier_all()
-        if self._ctx.rank == 0:
+
+        def action():
             with self._state.alloc_lock:
                 self._state.allocator.free(sym.offset)
-        self.barrier_all()
+
+        self._rank0_collective(action)
 
     def _view(self, sym: SymArray, pe: int) -> np.ndarray:
         if not 0 <= pe < self._ctx.size:
@@ -141,10 +159,18 @@ class ShmemPE:
         n = (values.size + sst - 1) // sst
         self._view(sym, pe).reshape(-1)[: n * tst : tst] = values[::sst]
 
-    def iget(self, sym: SymArray, pe: int, n: int, tst: int = 1,
+    def iget(self, sym: SymArray, pe: int, n: int,
+             target: np.ndarray | None = None, tst: int = 1,
              sst: int = 1) -> np.ndarray:
-        """shmem_iget."""
-        return self._view(sym, pe).reshape(-1)[: n * sst : sst].copy()
+        """shmem_iget: fetch n elements from the remote instance at source
+        stride `sst`; when `target` is given, scatter them at target
+        stride `tst` (the OpenSHMEM target-stride contract); otherwise
+        return them densely."""
+        got = self._view(sym, pe).reshape(-1)[: n * sst : sst].copy()
+        if target is None:
+            return got
+        target.reshape(-1)[: n * tst : tst] = got
+        return target
 
     def fence(self) -> None:
         """shmem_fence: ordering of puts to each PE — in-process writes are

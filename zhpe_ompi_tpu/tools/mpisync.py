@@ -1,0 +1,98 @@
+"""mpisync — cross-rank clock offset estimation for trace alignment.
+
+Re-design of ``ompi/tools/mpisync`` (SURVEY.md §2.6): the reference
+measures per-node clock offsets against rank 0 so that tool timestamps
+(PERUSE events, monitoring dumps) from different nodes can be merged on
+one timeline.  Same algorithm here: for each rank, rank 0 runs a burst of
+ping-pong exchanges, the offset estimate is ``theta = t_peer − (t0_send +
+rtt/2)`` from the minimum-RTT sample (the classic Cristian/NTP estimator
+the reference uses — its README cites the same approach).
+
+Thread-ranks share one clock, so the *measured* offset is ~0; tests
+inject synthetic skew through the ``clock`` hook — which is also how a
+multi-host transport would plug real per-host clocks in.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable
+
+import numpy as np
+
+from ..pt2pt.universe import LocalUniverse
+
+_SYNC_TAG = 0x51C
+_SYNC_CID = 0x51C
+
+
+def sync_clocks(uni: LocalUniverse, rounds: int = 16,
+                clock: Callable[[int], float] | None = None
+                ) -> list[float]:
+    """Estimated clock offset of every rank relative to rank 0 (seconds).
+
+    `clock(rank)` returns that rank's notion of "now" (defaults to the
+    shared monotonic clock)."""
+    if clock is None:
+        clock = lambda rank: time.monotonic()  # noqa: E731
+
+    def main(ctx):
+        if ctx.rank == 0:
+            offsets = [0.0]
+            for peer in range(1, ctx.size):
+                best_rtt = np.inf
+                best_theta = 0.0
+                for _ in range(rounds):
+                    t0 = clock(0)
+                    ctx.send(t0, dest=peer, tag=_SYNC_TAG, cid=_SYNC_CID)
+                    t_peer = ctx.recv(
+                        source=peer, tag=_SYNC_TAG, cid=_SYNC_CID
+                    )
+                    t1 = clock(0)
+                    rtt = t1 - t0
+                    if rtt < best_rtt:
+                        best_rtt = rtt
+                        best_theta = t_peer - (t0 + rtt / 2.0)
+                offsets.append(best_theta)
+            # done: release the peers
+            for peer in range(1, ctx.size):
+                ctx.send(None, dest=peer, tag=_SYNC_TAG + 1, cid=_SYNC_CID)
+            return offsets
+        while True:
+            # serve ping-pongs until released
+            probe_done = ctx.probe(source=0, tag=_SYNC_TAG + 1, cid=_SYNC_CID)
+            if probe_done is not None:
+                ctx.recv(source=0, tag=_SYNC_TAG + 1, cid=_SYNC_CID)
+                return None
+            probe = ctx.probe(source=0, tag=_SYNC_TAG, cid=_SYNC_CID)
+            if probe is not None:
+                ctx.recv(source=0, tag=_SYNC_TAG, cid=_SYNC_CID)
+                ctx.send(
+                    clock(ctx.rank), dest=0, tag=_SYNC_TAG, cid=_SYNC_CID
+                )
+            time.sleep(0)
+
+    results = uni.run(main)
+    return results[0]
+
+
+def main(argv: list[str] | None = None) -> int:  # pragma: no cover - CLI
+    import argparse
+
+    p = argparse.ArgumentParser(description="clock-sync demo (mpisync analog)")
+    p.add_argument("-n", "--ranks", type=int, default=4)
+    p.add_argument("--skew", type=float, nargs="*", default=None,
+                   help="per-rank synthetic skew seconds")
+    args = p.parse_args(argv)
+    uni = LocalUniverse(args.ranks)
+    skew = args.skew or [0.0] * args.ranks
+    offsets = sync_clocks(
+        uni, clock=lambda r: time.monotonic() + skew[r]
+    )
+    for r, off in enumerate(offsets):
+        print(f"rank {r}: offset {off * 1e6:+.1f} us")
+    return 0
+
+
+if __name__ == "__main__":  # pragma: no cover
+    raise SystemExit(main())

@@ -1,0 +1,188 @@
+"""DSS — typed data serialization for the out-of-band plane.
+
+Re-design of ``opal/dss`` (SURVEY.md §2.1, 6.2k LoC): the reference packs
+typed values (ints of every width, strings, byte objects, nested
+containers) into self-describing buffers for PMIx modex payloads and tool
+messages.  Same role here: the host plane's wire format for the multi-host
+DCN transport and for checkpoint metadata — numpy arrays carry their dtype
+and shape, containers nest, and every value round-trips exactly.
+
+Format: one type byte, then a varint length where needed, then the
+payload; containers recurse.  Little-endian fixed-width scalars (the
+reference's heterogeneous-arch conversion lives in the datatype engine's
+external32 path, not here).
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Any
+
+import numpy as np
+
+from ..core import errors
+
+_T_NONE = 0
+_T_BOOL = 1
+_T_INT = 2       # arbitrary-precision python int (zigzag varint)
+_T_FLOAT = 3     # python float, f64
+_T_STR = 4
+_T_BYTES = 5
+_T_LIST = 6
+_T_TUPLE = 7
+_T_DICT = 8
+_T_NDARRAY = 9
+
+
+def _pack_varint(n: int, out: bytearray) -> None:
+    if n < 0:
+        raise errors.ArgError("varint must be non-negative")
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return
+
+
+def _unpack_varint(buf: memoryview, pos: int) -> tuple[int, int]:
+    n = 0
+    shift = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        n |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return n, pos
+        shift += 7
+
+
+def _pack_one(obj: Any, out: bytearray) -> None:
+    if obj is None:
+        out.append(_T_NONE)
+    elif isinstance(obj, bool):
+        out.append(_T_BOOL)
+        out.append(1 if obj else 0)
+    elif isinstance(obj, int):
+        out.append(_T_INT)
+        # zigzag so negatives stay compact
+        z = (obj << 1) if obj >= 0 else ((-obj << 1) | 1)
+        _pack_varint(z, out)
+    elif isinstance(obj, float):
+        out.append(_T_FLOAT)
+        out.extend(struct.pack("<d", obj))
+    elif isinstance(obj, str):
+        raw = obj.encode("utf-8")
+        out.append(_T_STR)
+        _pack_varint(len(raw), out)
+        out.extend(raw)
+    elif isinstance(obj, (bytes, bytearray)):
+        out.append(_T_BYTES)
+        _pack_varint(len(obj), out)
+        out.extend(obj)
+    elif isinstance(obj, np.ndarray):
+        out.append(_T_NDARRAY)
+        dt = obj.dtype.str.encode("ascii")  # e.g. b'<f4'
+        _pack_varint(len(dt), out)
+        out.extend(dt)
+        _pack_varint(obj.ndim, out)
+        for d in obj.shape:
+            _pack_varint(d, out)
+        raw = np.ascontiguousarray(obj).tobytes()
+        _pack_varint(len(raw), out)
+        out.extend(raw)
+    elif isinstance(obj, (list, tuple)):
+        out.append(_T_LIST if isinstance(obj, list) else _T_TUPLE)
+        _pack_varint(len(obj), out)
+        for item in obj:
+            _pack_one(item, out)
+    elif isinstance(obj, dict):
+        out.append(_T_DICT)
+        _pack_varint(len(obj), out)
+        for k, v in obj.items():
+            _pack_one(k, out)
+            _pack_one(v, out)
+    elif isinstance(obj, np.generic):
+        # numpy scalar: pack as a 0-d array so the dtype survives
+        _pack_one(np.asarray(obj), out)
+    else:
+        raise errors.TypeError_(
+            f"dss cannot pack {type(obj).__name__}"
+        )
+
+
+def _unpack_one(buf: memoryview, pos: int) -> tuple[Any, int]:
+    t = buf[pos]
+    pos += 1
+    if t == _T_NONE:
+        return None, pos
+    if t == _T_BOOL:
+        return bool(buf[pos]), pos + 1
+    if t == _T_INT:
+        z, pos = _unpack_varint(buf, pos)
+        return ((z >> 1) if not z & 1 else -(z >> 1)), pos
+    if t == _T_FLOAT:
+        (v,) = struct.unpack_from("<d", buf, pos)
+        return v, pos + 8
+    if t == _T_STR:
+        n, pos = _unpack_varint(buf, pos)
+        return bytes(buf[pos : pos + n]).decode("utf-8"), pos + n
+    if t == _T_BYTES:
+        n, pos = _unpack_varint(buf, pos)
+        return bytes(buf[pos : pos + n]), pos + n
+    if t == _T_NDARRAY:
+        n, pos = _unpack_varint(buf, pos)
+        dt = np.dtype(bytes(buf[pos : pos + n]).decode("ascii"))
+        pos += n
+        ndim, pos = _unpack_varint(buf, pos)
+        shape = []
+        for _ in range(ndim):
+            d, pos = _unpack_varint(buf, pos)
+            shape.append(d)
+        nbytes, pos = _unpack_varint(buf, pos)
+        arr = np.frombuffer(
+            bytes(buf[pos : pos + nbytes]), dtype=dt
+        ).reshape(shape)
+        return arr, pos + nbytes
+    if t in (_T_LIST, _T_TUPLE):
+        n, pos = _unpack_varint(buf, pos)
+        items = []
+        for _ in range(n):
+            item, pos = _unpack_one(buf, pos)
+            items.append(item)
+        return (items if t == _T_LIST else tuple(items)), pos
+    if t == _T_DICT:
+        n, pos = _unpack_varint(buf, pos)
+        d = {}
+        for _ in range(n):
+            k, pos = _unpack_one(buf, pos)
+            v, pos = _unpack_one(buf, pos)
+            d[k] = v
+        return d, pos
+    raise errors.TypeError_(f"dss: unknown type tag {t}")
+
+
+def pack(*objs: Any) -> bytes:
+    """Pack values into one self-describing buffer (opal_dss.pack)."""
+    out = bytearray()
+    _pack_varint(len(objs), out)
+    for obj in objs:
+        _pack_one(obj, out)
+    return bytes(out)
+
+
+def unpack(data: bytes) -> list[Any]:
+    """Unpack every value from a buffer (opal_dss.unpack)."""
+    buf = memoryview(data)
+    n, pos = _unpack_varint(buf, 0)
+    out = []
+    for _ in range(n):
+        obj, pos = _unpack_one(buf, pos)
+        out.append(obj)
+    if pos != len(buf):
+        raise errors.TruncateError(
+            f"dss: {len(buf) - pos} trailing bytes after unpack"
+        )
+    return out

@@ -1,0 +1,78 @@
+"""memchecker — buffer-definedness checking at API entry.
+
+Re-design of ``opal/mca/memchecker/valgrind`` (SURVEY.md §5): the
+reference annotates every MPI entry point with valgrind client requests
+so reads of undefined send buffers are reported at the API boundary
+(``ompi/mpi/c/send.c:53-55``).  Without valgrind's shadow memory, the
+host-plane equivalents of "undefined" are checkable directly:
+
+- NaN payloads in float buffers (the overwhelmingly common "used
+  uninitialized/poisoned memory" symptom in numeric code — jax fills
+  donated/deleted buffers with NaN in debug modes);
+- non-contiguous numpy views where the transport would silently copy;
+- zero-size buffers passed where MPI requires data.
+
+Off by default (valgrind component is, too); enable with the
+``memchecker_enable`` MCA var or ``ZMPI_MCA_memchecker_enable=1``.  The
+hooks live at the same boundaries the reference instruments: host-plane
+isend and window put/accumulate.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as np
+
+from ..core import errors
+from ..mca import var as mca_var
+
+mca_var.register(
+    "memchecker_enable", False,
+    "Check buffer definedness (NaN poison, layout) at API entry "
+    "(memchecker/valgrind analog)",
+    type=bool,
+)
+
+
+def enabled() -> bool:
+    return bool(mca_var.get("memchecker_enable", False))
+
+
+def check_send_buffer(obj: Any, where: str) -> None:
+    """Raise if `obj` looks undefined.  Called at send-side API entry when
+    enabled (cf. memchecker annotations in ompi/mpi/c/send.c:53-55)."""
+    if not enabled():
+        return
+    arr = None
+    if isinstance(obj, np.ndarray):
+        arr = obj
+    else:
+        # jax arrays expose the buffer protocol via np.asarray; anything
+        # non-arraylike (pickled control messages) is exempt
+        try:
+            if hasattr(obj, "dtype") and hasattr(obj, "shape"):
+                arr = np.asarray(obj)
+        except Exception:
+            return
+    if arr is None:
+        return
+    if arr.dtype.kind == "f" and arr.size and bool(np.isnan(arr).any()):
+        raise errors.MpiError(
+            f"{where}: send buffer contains NaN (undefined data?)",
+            errclass=errors.ERR_BUFFER,
+        )
+
+
+def check_recv_buffer(arr: Any, where: str) -> None:
+    """Raise if a receive-side target buffer is unusable (the reference
+    marks recv buffers addressable-but-undefined; here the checkable
+    hazard is a non-contiguous view whose writes would vanish)."""
+    if not enabled():
+        return
+    if isinstance(arr, np.ndarray) and not arr.flags["C_CONTIGUOUS"]:
+        raise errors.MpiError(
+            f"{where}: receive buffer is a non-contiguous view; writes "
+            "through a flat view would be lost",
+            errclass=errors.ERR_BUFFER,
+        )
