@@ -1,0 +1,32 @@
+"""hello_c.c analog (reference: examples/hello_c.c): init, identify every
+rank, finalize.
+
+Run: JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+     python examples/hello_zmpi.py
+"""
+
+import jax.numpy as jnp
+import numpy as np
+
+import zhpe_ompi_tpu as zmpi
+
+
+def main():
+    comm = zmpi.init()
+    n = comm.size
+
+    def body(_):
+        # comm.rank() is the traced SPMD rank; allgather publishes it
+        return comm.allgather(jnp.asarray(comm.rank(), jnp.int32)[None])
+
+    out = np.asarray(comm.run(body, jnp.zeros((n, 1))))
+    ranks = out.reshape(n, n)[0]
+    for r in ranks:
+        print(f"Hello, world, I am {r} of {n} "
+              f"(zhpe_ompi_tpu {zmpi.__version__})")
+    assert list(ranks) == list(range(n))
+    zmpi.finalize()
+
+
+if __name__ == "__main__":
+    main()

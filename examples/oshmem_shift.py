@@ -1,0 +1,33 @@
+"""oshmem_circular_shift.c analog (reference: examples/
+oshmem_circular_shift.c): every PE puts its rank into its right
+neighbor's symmetric variable.
+
+Run: python examples/oshmem_shift.py
+"""
+
+import numpy as np
+
+from zhpe_ompi_tpu import shmem
+
+
+def main():
+    uni, pes = shmem.shmem_universe(4)
+
+    def pe_main(ctx):
+        pe = pes[ctx.rank]
+        sym = pe.shmalloc(1, np.int64)
+        pe.local(sym)[...] = -1
+        pe.barrier_all()
+        pe.put(sym, pe.my_pe(), (pe.my_pe() + 1) % pe.n_pes())
+        pe.barrier_all()
+        return int(pe.local(sym)[0])
+
+    results = uni.run(pe_main)
+    for r, v in enumerate(results):
+        print(f"PE {r} received {v}")
+    assert results == [(r - 1) % 4 for r in range(4)]
+    print("oshmem circular shift PASSED")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,29 @@
+"""Examples as acceptance tests (reference: examples/ring_c.c et al. built
+by examples/Makefile, SURVEY.md §4.4)."""
+
+import importlib.util
+import os
+import sys
+
+import pytest
+
+_EXAMPLES = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "examples"
+)
+
+
+def _run_example(name: str) -> None:
+    path = os.path.join(_EXAMPLES, f"{name}.py")
+    spec = importlib.util.spec_from_file_location(f"examples.{name}", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    mod.main()
+
+
+@pytest.mark.parametrize("name", [
+    "hello_zmpi", "ring_zmpi", "connectivity_zmpi", "oshmem_shift",
+])
+def test_example(name, capsys):
+    _run_example(name)
+    out = capsys.readouterr().out
+    assert "PASSED" in out or "Hello" in out or "laps" in out

@@ -1,0 +1,195 @@
+"""TCP transport tests (btl/tcp analog) — N procs over localhost sockets,
+the wire-level counterpart of the thread-rank loopback tests."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from zhpe_ompi_tpu.core import errors
+from zhpe_ompi_tpu.pt2pt.matching import ANY_SOURCE
+from zhpe_ompi_tpu.pt2pt.tcp import TcpProc
+
+N = 4
+
+
+def run_tcp(n, fn, timeout=60.0):
+    """Launch n TcpProcs in threads sharing a localhost coordinator."""
+    coord_ready = threading.Event()
+    coord_addr = [None]
+    results = [None] * n
+    excs = [None] * n
+
+    def publish(addr):
+        # ephemeral coordinator port -> other threads (on real deployments
+        # this is the launcher's job, like prte forwarding the PMIx URI)
+        coord_addr[0] = addr
+        coord_ready.set()
+
+    def main(rank):
+        try:
+            if rank == 0:
+                proc = TcpProc(0, n, coordinator=("127.0.0.1", 0),
+                               on_coordinator_bound=publish)
+            else:
+                coord_ready.wait(10)
+                proc = TcpProc(rank, n, coordinator=coord_addr[0])
+            try:
+                results[rank] = fn(proc)
+            finally:
+                proc.close()
+        except BaseException as e:  # noqa: BLE001
+            excs[rank] = e
+            coord_ready.set()
+
+    threads = [threading.Thread(target=main, args=(r,)) for r in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout)
+        assert not t.is_alive(), "tcp rank hung"
+    for e in excs:
+        if e is not None:
+            raise e
+    return results
+
+
+class TestWire:
+    def test_ring_token(self):
+        def prog(p):
+            token = p.rank
+            p.send(token, dest=(p.rank + 1) % N, tag=1)
+            return p.recv(source=(p.rank - 1) % N, tag=1)
+
+        assert run_tcp(N, prog) == [(r - 1) % N for r in range(N)]
+
+    def test_ndarray_payload(self):
+        def prog(p):
+            arr = np.arange(1000, dtype=np.float64) * p.rank
+            p.send(arr, dest=(p.rank + 1) % N, tag=2)
+            got = p.recv(source=(p.rank - 1) % N, tag=2)
+            return float(got.sum())
+
+        expect = [float(np.arange(1000).sum() * ((r - 1) % N))
+                  for r in range(N)]
+        assert run_tcp(N, prog) == expect
+
+    def test_any_source_gather(self):
+        def prog(p):
+            if p.rank == 0:
+                vals = sorted(p.recv(source=ANY_SOURCE, tag=3)
+                              for _ in range(N - 1))
+                return vals
+            p.send(p.rank * 10, dest=0, tag=3)
+            return None
+
+        assert run_tcp(N, prog)[0] == [10, 20, 30]
+
+    def test_tag_and_cid_isolation(self):
+        def prog(p):
+            if p.rank == 0:
+                p.send("cid7", dest=1, tag=5, cid=7)
+                p.send("cid9", dest=1, tag=5, cid=9)
+                return True
+            if p.rank == 1:
+                # receive in the opposite cid order
+                later = p.recv(source=0, tag=5, cid=9)
+                first = p.recv(source=0, tag=5, cid=7)
+                return (first, later)
+            return None
+
+        out = run_tcp(N, prog)
+        assert out[1] == ("cid7", "cid9")
+
+    def test_barrier_and_sendrecv(self):
+        def prog(p):
+            p.barrier()
+            out = p.sendrecv(
+                {"from": p.rank}, dest=(p.rank + 1) % N,
+                source=(p.rank - 1) % N, sendtag=6, recvtag=6,
+            )
+            p.barrier()
+            return out["from"]
+
+        assert run_tcp(N, prog) == [(r - 1) % N for r in range(N)]
+
+    def test_self_send_loopback(self):
+        def prog(p):
+            p.send(b"self", dest=p.rank, tag=8)
+            return p.recv(source=p.rank, tag=8)
+
+        assert run_tcp(2, prog) == [b"self", b"self"]
+
+    def test_large_message(self):
+        big = np.random.default_rng(0).normal(size=(512, 256))
+
+        def prog(p):
+            if p.rank == 0:
+                p.send(big, dest=1, tag=9)
+                return True
+            if p.rank == 1:
+                got = p.recv(source=0, tag=9)
+                return bool(np.array_equal(got, big))
+            return None
+
+        assert run_tcp(2, prog) == [True, True]
+
+    def test_recv_timeout(self):
+        def prog(p):
+            if p.rank == 0:
+                with pytest.raises(errors.InternalError, match="timeout"):
+                    p.recv(source=1, tag=99, timeout=0.3)
+            p.barrier()
+            return True
+
+        assert run_tcp(2, prog) == [True, True]
+
+    def test_message_survives_abandoned_recv(self):
+        """A message stolen by a timed-out receive must be re-injected so a
+        retry still finds it."""
+
+        def prog(p):
+            if p.rank == 0:
+                with pytest.raises(errors.InternalError, match="timeout"):
+                    p.recv(source=1, tag=42, timeout=0.3)
+                p.barrier()  # now rank 1 sends
+                return p.recv(source=1, tag=42, timeout=5.0)
+            p.barrier()
+            p.send("late", dest=0, tag=42)
+            return None
+
+        assert run_tcp(2, prog)[0] == "late"
+
+    def test_writable_ndarray_delivery(self):
+        """Wire-delivered arrays must be writable, matching the thread
+        universe's eager-copy semantics."""
+
+        def prog(p):
+            if p.rank == 0:
+                p.send(np.arange(4, dtype=np.int64), dest=1, tag=11)
+                return True
+            got = p.recv(source=0, tag=11)
+            got += 1  # raises on a read-only frombuffer view
+            return got.tolist()
+
+        assert run_tcp(2, prog)[1] == [1, 2, 3, 4]
+
+    def test_ft_logging_over_sockets(self):
+        """LoggedContext/BookmarkedContext-style wrapping works over the
+        socket transport (return_status + irecv/isend compatibility)."""
+        from zhpe_ompi_tpu.ft.vprotocol import LoggedContext, _RankLog
+        import threading as _t
+
+        def prog(p):
+            log = _RankLog()
+            wrapped = LoggedContext(p, log, _t.Lock())
+            if p.rank == 0:
+                wrapped.send(7, dest=1, tag=1)
+                got = wrapped.recv(source=1, tag=2)
+            else:
+                got = wrapped.recv(source=0, tag=1)
+                wrapped.send(got * 2, dest=0, tag=2)
+            return (got, len(log.sends), len(log.recvs))
+
+        out = run_tcp(2, prog)
+        assert out[0] == (14, 1, 1) and out[1] == (7, 1, 1)

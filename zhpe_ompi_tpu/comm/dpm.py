@@ -44,12 +44,12 @@ _registry_lock = threading.Lock()
 _ports: dict[str, dict[str, Any]] = {}
 _port_names = itertools.count()
 
-# per-child-universe parent bridge source (MPI_Comm_get_parent)
-_parents: dict[int, tuple[LocalUniverse, int]] = {}
-
-# rank-0-builds / everyone-fetches slots for collective dpm calls
-_pending: dict[tuple[int, int], Any] = {}
-_pending_seq: dict[int, Any] = {}
+# The parent bridge and the collective-slot state hang off the universe
+# OBJECT (attributes), not an id()-keyed global dict: id() values are
+# reused after garbage collection, which would hand a fresh universe a
+# stale parent, and a global registry would pin universes forever.
+_PARENT_ATTR = "_zmpi_dpm_parent"
+_SLOT_ATTR = "_zmpi_dpm_slots"
 
 
 class Intercomm:
@@ -112,22 +112,26 @@ def _collective_slot(uni: LocalUniverse, ctx: RankContext,
     the reference resolving dpm state over a PMIx fence.  If `build`
     raises on rank 0, the other ranks will block until the universe's run
     timeout (the same hang an un-matched MPI_Comm_accept produces)."""
+    with _registry_lock:
+        slots = getattr(uni, _SLOT_ATTR, None)
+        if slots is None:
+            slots = {"seq": itertools.count(), "values": {}}
+            setattr(uni, _SLOT_ATTR, slots)
     if ctx.rank == 0:
         value = build()
         with _registry_lock:
-            counter = _pending_seq.setdefault(id(uni), itertools.count())
-            key = next(counter)
-            _pending[(id(uni), key)] = value
+            key = next(slots["seq"])
+            slots["values"][key] = value
         for r in range(1, ctx.size):
             ctx.send(key, dest=r, tag=0x3FE, cid=0x3FE)
     else:
         key = ctx.recv(source=0, tag=0x3FE, cid=0x3FE)
         with _registry_lock:
-            value = _pending[(id(uni), key)]
+            value = slots["values"][key]
     ctx.barrier()
     if ctx.rank == 0:
         with _registry_lock:
-            _pending.pop((id(uni), key), None)
+            slots["values"].pop(key, None)
     return value
 
 
@@ -145,8 +149,7 @@ def spawn(uni: LocalUniverse, ctx: RankContext, child_main: Callable,
     def build():
         child = LocalUniverse(n_children)
         cid = next(_bridge_cids)
-        with _registry_lock:
-            _parents[id(child)] = (uni, cid)
+        setattr(child, _PARENT_ATTR, (uni, cid))
 
         results: list[Any] = [None] * n_children
         excs: list[BaseException | None] = [None] * n_children
@@ -184,8 +187,7 @@ def spawn(uni: LocalUniverse, ctx: RankContext, child_main: Callable,
 def get_parent(child_ctx: RankContext) -> Intercomm | None:
     """MPI_Comm_get_parent: the bridge to the universe that spawned this
     one, or None for a root universe."""
-    with _registry_lock:
-        entry = _parents.get(id(child_ctx.universe))
+    entry = getattr(child_ctx.universe, _PARENT_ATTR, None)
     if entry is None:
         return None
     parent_uni, cid = entry

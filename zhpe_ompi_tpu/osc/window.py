@@ -129,6 +129,9 @@ class HostWindow:
                    op: zops.Op = zops.SUM) -> None:
         """MPI_Accumulate: atomic read-modify-write (btl_atomic_op analog:
         per-target lock serializes concurrent accumulates)."""
+        from ..utils import memchecker
+
+        memchecker.check_send_buffer(data, "MPI_Accumulate")
         data = np.asarray(data)
         flat = self._target_buf(target).reshape(-1)
         n = data.size
@@ -143,6 +146,9 @@ class HostWindow:
     def get_accumulate(self, data, target: int, offset: int = 0,
                        op: zops.Op = zops.SUM) -> np.ndarray:
         """MPI_Get_accumulate: fetch-and-op."""
+        from ..utils import memchecker
+
+        memchecker.check_send_buffer(data, "MPI_Get_accumulate")
         data = np.asarray(data)
         flat = self._target_buf(target).reshape(-1)
         n = data.size

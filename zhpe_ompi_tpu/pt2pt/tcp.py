@@ -1,0 +1,351 @@
+"""TCP transport — the btl/tcp / DCN analog of the host plane.
+
+The reference reaches remote nodes through ``opal/mca/btl/tcp`` (5.3k LoC:
+endpoint address exchange via the modex, a listening socket per proc, lazy
+connection establishment, length-framed sends drained by the progress
+engine).  On TPU pods the *device* plane crosses hosts through ICI/DCN
+inside XLA; what still needs a wire is the host plane — control messages,
+dpm, shmem bookkeeping, file coordination.  This module is that wire:
+
+- **modex**: rank 0 is the rendezvous point (the PMIx server analog);
+  every rank connects, publishes its listen address, and receives the
+  address book (cf. the business-card exchange in ompi_mpi_init.c:667).
+- **endpoints**: one listening socket per proc, full-mesh connections
+  established lazily on first send and cached (btl_tcp_endpoint.c shape).
+- **framing**: 4-byte length + DSS-packed (src, tag, cid, seq, payload) —
+  the DSS buffer is the wire format, so anything the out-of-band plane
+  can represent travels as-is.
+- **matching**: incoming frames feed the same matching engine the local
+  universe uses — transport and semantics stay decoupled exactly as
+  BTL/PML are.
+
+``TcpProc`` mirrors :class:`~zhpe_ompi_tpu.pt2pt.universe.RankContext``'s
+API (send/recv/probe/sendrecv/barrier), so everything built on rank
+contexts — ft logging, crcp bookmarks, shmem collectives — runs over real
+sockets unchanged.  Tests drive N procs over localhost; multi-host runs
+pass the coordinator's address, the role `jax.distributed.initialize`'s
+coordinator plays for the device plane.
+"""
+
+from __future__ import annotations
+
+import itertools
+import socket
+import struct
+import threading
+from typing import Any
+
+from ..core import errors
+from ..mca import output as mca_output
+from ..runtime import spc
+from ..utils import dss
+from . import matching
+from .matching import ANY_SOURCE, ANY_TAG, Envelope
+
+_stream = mca_output.open_stream("btl_tcp")
+
+_LEN = struct.Struct("<I")
+
+
+def _send_frame(sock: socket.socket, payload: bytes) -> None:
+    sock.sendall(_LEN.pack(len(payload)) + payload)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes | None:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            return None
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def _recv_frame(sock: socket.socket) -> bytes | None:
+    header = _recv_exact(sock, _LEN.size)
+    if header is None:
+        return None
+    (length,) = _LEN.unpack(header)
+    return _recv_exact(sock, length)
+
+
+class TcpProc:
+    """One process's endpoint in a TCP universe of `size` ranks.
+
+    Construction is collective: every rank calls with the same coordinator
+    address; rank 0 must also pass ``is_coordinator=True`` (it binds the
+    rendezvous socket).  `host` is this rank's reachable address."""
+
+    def __init__(self, rank: int, size: int,
+                 coordinator: tuple[str, int] = ("127.0.0.1", 0),
+                 host: str = "127.0.0.1", timeout: float = 30.0,
+                 on_coordinator_bound=None):
+        if size < 1:
+            raise errors.ArgError("size must be >= 1")
+        self.rank = rank
+        self.size = size
+        self.engine = matching.make_matching_engine()
+        self._seq = itertools.count()
+        self._timeout = timeout
+        self._conns: dict[int, socket.socket] = {}
+        self._conn_lock = threading.Lock()
+        self._send_lock = threading.Lock()  # one frame on the wire at a time
+        self._closed = threading.Event()
+        self._incoming_cv = threading.Condition()
+
+        # listening socket (btl_tcp's per-proc endpoint)
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind((host, 0))
+        self._listener.listen(size + 4)
+        self.address = self._listener.getsockname()
+
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, daemon=True
+        )
+        self._accept_thread.start()
+
+        # modex: address-book exchange through the coordinator.
+        # `on_coordinator_bound(addr)` fires on rank 0 after the rendezvous
+        # socket is bound but BEFORE the blocking gather — the hook a
+        # launcher uses to forward an ephemeral coordinator address to the
+        # other ranks (prte forwarding the PMIx URI).  With a fixed,
+        # pre-agreed port it is unnecessary.
+        self._on_coordinator_bound = on_coordinator_bound
+        self.address_book = self._modex(coordinator, timeout)
+        mca_output.verbose(
+            5, _stream, "rank %d up at %s; book=%s", rank, self.address,
+            self.address_book,
+        )
+
+    # -- wire-up ---------------------------------------------------------
+
+    def _modex(self, coordinator: tuple[str, int], timeout: float
+               ) -> list[tuple[str, int]]:
+        if self.rank == 0:
+            srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            srv.bind(coordinator)
+            srv.listen(self.size + 4)
+            self.coordinator_address = srv.getsockname()
+            if self._on_coordinator_bound is not None:
+                self._on_coordinator_bound(self.coordinator_address)
+            book: list[Any] = [None] * self.size
+            book[0] = list(self.address)
+            peers = []
+            srv.settimeout(timeout)
+            for _ in range(self.size - 1):
+                conn, _addr = srv.accept()
+                [peer_rank, addr] = dss.unpack(_recv_frame(conn))
+                book[peer_rank] = addr
+                peers.append(conn)
+            payload = dss.pack(book)
+            for conn in peers:
+                _send_frame(conn, payload)
+                conn.close()
+            srv.close()
+            return [tuple(a) for a in book]
+        cli = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        cli.settimeout(timeout)
+        deadline_err = None
+        import time
+
+        for _ in range(200):  # coordinator may not be up yet
+            try:
+                cli.connect(coordinator)
+                break
+            except OSError as e:
+                deadline_err = e
+                time.sleep(0.05)
+                cli.close()
+                cli = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+                cli.settimeout(timeout)
+        else:
+            raise errors.InternalError(
+                f"modex: cannot reach coordinator {coordinator}: "
+                f"{deadline_err}"
+            )
+        _send_frame(cli, dss.pack(self.rank, list(self.address)))
+        [book] = dss.unpack(_recv_frame(cli))
+        cli.close()
+        return [tuple(a) for a in book]
+
+    def _accept_loop(self) -> None:
+        while not self._closed.is_set():
+            try:
+                conn, _ = self._listener.accept()
+            except OSError:
+                return
+            # first frame on a new connection announces the peer rank
+            frame = _recv_frame(conn)
+            if frame is None:
+                conn.close()
+                continue
+            [peer_rank] = dss.unpack(frame)
+            with self._conn_lock:
+                self._conns.setdefault(peer_rank, conn)
+            threading.Thread(
+                target=self._drain_loop, args=(conn,), daemon=True
+            ).start()
+
+    def _drain_loop(self, conn: socket.socket) -> None:
+        """Receiver thread per connection — the progress engine's read
+        side (btl_tcp drives this from libevent; threads are the Python
+        idiom)."""
+        while not self._closed.is_set():
+            try:
+                frame = _recv_frame(conn)
+            except OSError:
+                return
+            if frame is None:
+                return
+            [src, tag, cid, seq, payload] = dss.unpack(frame)
+            env = Envelope(src, tag, cid, seq)
+            spc.record("tcp_bytes_recvd", len(frame))
+            with self._incoming_cv:
+                self.engine.incoming(env, payload)
+                self._incoming_cv.notify_all()
+
+    def _endpoint(self, dest: int) -> socket.socket:
+        with self._conn_lock:
+            sock = self._conns.get(dest)
+        if sock is not None:
+            return sock
+        # lazy connection establishment (btl_tcp_endpoint shape)
+        addr = self.address_book[dest]
+        sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        sock.settimeout(self._timeout)
+        sock.connect(addr)
+        _send_frame(sock, dss.pack(self.rank))
+        with self._conn_lock:
+            existing = self._conns.get(dest)
+            if existing is not None:
+                sock.close()
+                return existing
+            self._conns[dest] = sock
+        threading.Thread(
+            target=self._drain_loop, args=(sock,), daemon=True
+        ).start()
+        return sock
+
+    # -- MPI surface (RankContext-compatible) ----------------------------
+
+    def send(self, obj: Any, dest: int, tag: int = 0, cid: int = 0) -> None:
+        """Eager length-framed send (the DCN plane is a control/metadata
+        path; ob1's rendezvous exists to bound eager buffering, which TCP's
+        own flow control provides here)."""
+        if not 0 <= dest < self.size:
+            raise errors.RankError(f"rank {dest} out of range")
+        if tag < 0:
+            raise errors.TagError(f"negative tag {tag}")
+        seq = next(self._seq)
+        frame = dss.pack(self.rank, tag, cid, seq, obj)
+        spc.record("tcp_bytes_sent", len(frame))
+        if dest == self.rank:
+            # loopback: the DSS round-trip is the eager buffer copy
+            env = Envelope(self.rank, tag, cid, seq)
+            with self._incoming_cv:
+                self.engine.incoming(env, dss.unpack(frame)[4])
+                self._incoming_cv.notify_all()
+            return
+        sock = self._endpoint(dest)
+        with self._send_lock:  # frames must not interleave on a socket
+            _send_frame(sock, frame)
+
+    def isend(self, obj: Any, dest: int, tag: int = 0, cid: int = 0):
+        """Nonblocking send: the eager frame is on the wire before return,
+        so the request is born complete (TCP flow control is the eager
+        buffer bound)."""
+        from .requests import Request
+
+        self.send(obj, dest, tag, cid)
+        req = Request()
+        req.complete()
+        return req
+
+    def irecv(self, source: int = ANY_SOURCE, tag: int = ANY_TAG,
+              cid: int = 0):
+        """Nonblocking matched receive returning a Request."""
+        from .requests import Request
+
+        req = Request()
+
+        def on_match(env: Envelope, payload: Any) -> None:
+            req.complete(payload, source=env.src, tag=env.tag)
+
+        with self._incoming_cv:
+            self.engine.post_recv(source, tag, cid, on_match)
+        return req
+
+    def recv(self, source: int = ANY_SOURCE, tag: int = ANY_TAG,
+             cid: int = 0, timeout: float | None = None,
+             return_status: bool = False) -> Any:
+        """Blocking matched receive.  On timeout the posted receive is
+        abandoned and any message it steals afterwards is re-injected into
+        the matching engine, so a retry can still find it (the matching
+        engines have no cancel in their C ABI; re-injection gives the same
+        liveness)."""
+        timeout = self._timeout if timeout is None else timeout
+        result: list[Any] = []
+        envs: list[Envelope] = []
+        done = threading.Event()
+        abandoned = [False]
+
+        def on_match(env: Envelope, payload: Any) -> None:
+            # always invoked while _incoming_cv is held (all engine entry
+            # points in this class take it), so `abandoned` is consistent
+            if abandoned[0]:
+                self.engine.incoming(env, payload)
+                return
+            result.append(payload)
+            envs.append(env)
+            done.set()
+
+        with self._incoming_cv:
+            self.engine.post_recv(source, tag, cid, on_match)
+        if not done.wait(timeout):
+            with self._incoming_cv:
+                if not done.is_set():
+                    abandoned[0] = True
+            if not done.is_set():
+                raise errors.InternalError(
+                    f"tcp recv timeout (src={source}, tag={tag})"
+                )
+        if return_status:
+            from .requests import Status
+
+            env = envs[0]
+            return result[0], Status(source=env.src, tag=env.tag)
+        return result[0]
+
+    def probe(self, source: int = ANY_SOURCE, tag: int = ANY_TAG,
+              cid: int = 0):
+        return self.engine.probe(source, tag, cid)
+
+    def sendrecv(self, obj: Any, dest: int, source: int = ANY_SOURCE,
+                 sendtag: int = 0, recvtag: int = ANY_TAG, cid: int = 0):
+        self.send(obj, dest, sendtag, cid)
+        return self.recv(source, recvtag, cid)
+
+    def barrier(self) -> None:
+        """Dissemination barrier over the wire."""
+        n = self.size
+        k = 1
+        while k < n:
+            self.send(b"", (self.rank + k) % n, tag=0x7FFD, cid=0x7FFD)
+            self.recv(source=(self.rank - k) % n, tag=0x7FFD, cid=0x7FFD)
+            k <<= 1
+
+    def close(self) -> None:
+        self._closed.set()
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        with self._conn_lock:
+            for sock in self._conns.values():
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+            self._conns.clear()

@@ -169,6 +169,13 @@ class ShmemPE:
         got = self._view(sym, pe).reshape(-1)[: n * sst : sst].copy()
         if target is None:
             return got
+        if not target.flags["C_CONTIGUOUS"]:
+            # reshape(-1) on a non-contiguous target returns a COPY and
+            # the scattered writes would silently vanish
+            raise errors.ArgError(
+                "iget target must be C-contiguous (strided writes go "
+                "through a flat view)"
+            )
         target.reshape(-1)[: n * tst : tst] = got
         return target
 

@@ -142,9 +142,11 @@ def _unpack_one(buf: memoryview, pos: int) -> tuple[Any, int]:
             d, pos = _unpack_varint(buf, pos)
             shape.append(d)
         nbytes, pos = _unpack_varint(buf, pos)
+        # copy: frombuffer over bytes yields a read-only array, which would
+        # diverge from the writable copies the thread universe delivers
         arr = np.frombuffer(
             bytes(buf[pos : pos + nbytes]), dtype=dt
-        ).reshape(shape)
+        ).reshape(shape).copy()
         return arr, pos + nbytes
     if t in (_T_LIST, _T_TUPLE):
         n, pos = _unpack_varint(buf, pos)

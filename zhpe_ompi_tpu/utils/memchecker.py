@@ -13,9 +13,11 @@ host-plane equivalents of "undefined" are checkable directly:
 - zero-size buffers passed where MPI requires data.
 
 Off by default (valgrind component is, too); enable with the
-``memchecker_enable`` MCA var or ``ZMPI_MCA_memchecker_enable=1``.  The
-hooks live at the same boundaries the reference instruments: host-plane
-isend and window put/accumulate.
+``memchecker_enable`` MCA var or ``ZMPI_MCA_memchecker_enable=1``.
+Wired-in hooks: host-plane ``isend``, ``HostWindow.put``,
+``HostWindow.accumulate``, ``HostWindow.get_accumulate``, and
+``ShmemPE.iget``'s target check; :func:`check_recv_buffer` is the
+receive-side primitive for transports that take user target buffers.
 """
 
 from __future__ import annotations
