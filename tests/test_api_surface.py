@@ -1,0 +1,192 @@
+"""API-surface parity tests: matched probe (MPI_Mprobe/Mrecv), persistent
+requests (MPI_Send_init/Start), and window variants (lock_all, allocate,
+allocate_shared/shared_query, dynamic attach/detach)."""
+
+import numpy as np
+import pytest
+
+from zhpe_ompi_tpu.core import errors
+from zhpe_ompi_tpu.osc.window import HostWindow
+from zhpe_ompi_tpu.pt2pt.matching import ANY_SOURCE, ANY_TAG
+from zhpe_ompi_tpu.pt2pt.universe import LocalUniverse
+
+
+class TestMatchedProbe:
+    def test_improbe_claims_message(self):
+        uni = LocalUniverse(2)
+
+        def prog(ctx):
+            if ctx.rank == 0:
+                ctx.send("claimed", dest=1, tag=5)
+                ctx.send("second", dest=1, tag=5)
+                return True
+            # wait for the first message to arrive unexpectedly
+            while ctx.probe(source=0, tag=5) is None:
+                pass
+            msg = ctx.improbe(source=0, tag=5)
+            assert msg is not None
+            # the claimed message is no longer matchable by a plain recv:
+            # the next recv gets the SECOND message
+            second = ctx.recv(source=0, tag=5)
+            first = ctx.mrecv(msg)
+            return (first, second)
+
+        out = uni.run(prog)
+        assert out[1] == ("claimed", "second")
+
+    def test_improbe_none_when_empty(self):
+        uni = LocalUniverse(1)
+        assert uni.contexts[0].improbe() is None
+
+    def test_mrecv_twice_raises(self):
+        uni = LocalUniverse(2)
+
+        def prog(ctx):
+            if ctx.rank == 0:
+                ctx.send(b"x", dest=1, tag=1)
+                return True
+            while ctx.probe(source=0, tag=1) is None:
+                pass
+            msg = ctx.improbe(source=0, tag=1)
+            ctx.mrecv(msg)
+            with pytest.raises(errors.RequestError):
+                ctx.mrecv(msg)
+            return True
+
+        assert uni.run(prog) == [True, True]
+
+
+class TestPersistentRequests:
+    def test_send_recv_init_restart(self):
+        uni = LocalUniverse(2)
+        ROUNDS = 5
+
+        def prog(ctx):
+            if ctx.rank == 0:
+                req = ctx.send_init(np.arange(4), dest=1, tag=3)
+                for _ in range(ROUNDS):
+                    req.start().wait()
+                return True
+            req = ctx.recv_init(source=0, tag=3)
+            total = 0
+            for _ in range(ROUNDS):
+                got = req.start().wait()
+                total += int(got.sum())
+            return total
+
+        assert uni.run(prog)[1] == 6 * ROUNDS
+
+    def test_start_while_active_raises(self):
+        uni = LocalUniverse(2)
+
+        def prog(ctx):
+            if ctx.rank == 1:
+                req = ctx.recv_init(source=0, tag=9)
+                req.start()
+                with pytest.raises(errors.RequestError):
+                    req.start()
+                ctx.universe.contexts  # keep linters quiet
+            ctx.barrier()
+            if ctx.rank == 0:
+                ctx.send(b"z", dest=1, tag=9)
+            else:
+                req.wait()
+            return True
+
+        assert uni.run(prog) == [True, True]
+
+    def test_wait_inactive_raises(self):
+        uni = LocalUniverse(1)
+        req = uni.contexts[0].send_init(b"x", dest=0)
+        with pytest.raises(errors.RequestError):
+            req.wait()
+
+
+class TestWindowVariants:
+    def test_lock_all_and_flush_all(self):
+        uni = LocalUniverse(3)
+
+        def prog(ctx):
+            buf = np.zeros(4, np.float64)
+            win = HostWindow.create(ctx, buf)
+            win.lock_all()
+            win.put(np.full(4, ctx.rank + 1.0), (ctx.rank + 1) % 3)
+            win.flush_all()
+            win.unlock_all()
+            win.fence()
+            out = buf.copy()
+            win.free()
+            return out
+
+        results = uni.run(prog)
+        for r, out in enumerate(results):
+            np.testing.assert_array_equal(out, np.full(4, ((r - 1) % 3) + 1))
+
+    def test_allocate_shared_direct_store(self):
+        uni = LocalUniverse(2)
+
+        def prog(ctx):
+            win = HostWindow.allocate_shared(ctx, 8 * 8, np.float64)
+            win.fence()
+            if ctx.rank == 0:
+                # direct load/store into rank 1's memory (shared_query)
+                peer = win.shared_query(1)
+                peer[...] = 7.5
+            win.fence()
+            out = float(win.shared_query(ctx.rank)[0])
+            win.free()
+            return out
+
+        assert uni.run(prog)[1] == 7.5
+
+    def test_shared_query_requires_shared(self):
+        uni = LocalUniverse(1)
+
+        def prog(ctx):
+            win = HostWindow.create(ctx, np.zeros(4))
+            with pytest.raises(errors.WinError):
+                win.shared_query(0)
+            win.free()
+            return True
+
+        assert uni.run(prog) == [True]
+
+    def test_dynamic_attach_put_get(self):
+        uni = LocalUniverse(2)
+
+        def prog(ctx):
+            win = HostWindow.create_dynamic(ctx)
+            region = np.zeros(6, np.int32)
+            disp = win.attach(region)
+            # share the displacement out of band (MPI does the same)
+            ctx.send(disp, dest=1 - ctx.rank, tag=1)
+            peer_disp = ctx.recv(source=1 - ctx.rank, tag=1)
+            win.fence()
+            win.dyn_put(np.arange(6, dtype=np.int32), 1 - ctx.rank,
+                        peer_disp)
+            win.fence()
+            # write-through: the user's array sees the remote put
+            got = region.copy()
+            raw = win.dyn_get(1 - ctx.rank, peer_disp, 24)
+            win.fence()  # peers must finish their gets before detach
+            win.detach(disp)
+            with pytest.raises(errors.WinError):
+                win.dyn_get(1 - ctx.rank, 10**6, 4)
+            win.free()
+            return got.tolist(), np.frombuffer(raw, np.int32).tolist()
+
+        for got, raw in uni.run(prog):
+            assert got == [0, 1, 2, 3, 4, 5]
+            assert raw == [0, 1, 2, 3, 4, 5]
+
+    def test_detach_unknown_raises(self):
+        uni = LocalUniverse(1)
+
+        def prog(ctx):
+            win = HostWindow.create_dynamic(ctx)
+            with pytest.raises(errors.WinError):
+                win.detach(123)
+            win.free()
+            return True
+
+        assert uni.run(prog) == [True]

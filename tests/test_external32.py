@@ -1,0 +1,76 @@
+"""external32 canonical-encoding tests (reference:
+test/datatype/external32.c)."""
+
+import numpy as np
+import pytest
+
+import zhpe_ompi_tpu as zmpi
+from zhpe_ompi_tpu.core import errors
+from zhpe_ompi_tpu.datatype import convertor, derived
+from zhpe_ompi_tpu.datatype.external32 import pack_external, unpack_external
+
+
+def _fill_struct(count, extent):
+    buf = np.zeros(count * extent, np.uint8)
+    for c in range(count):
+        buf[c * extent : c * extent + 4] = np.frombuffer(
+            np.int32(c + 1).tobytes(), np.uint8
+        )
+        buf[c * extent + 8 : c * extent + 16] = np.frombuffer(
+            np.float64(c * 1.5).tobytes(), np.uint8
+        )
+    return buf
+
+
+class TestExternal32:
+    def test_wire_is_big_endian(self):
+        t = derived.create_contiguous(4, zmpi.INT32_T).commit()
+        buf = np.arange(4, dtype=np.int32)
+        packed = pack_external(buf, t, 1)
+        wire = np.frombuffer(packed.tobytes(), dtype=">i4")
+        np.testing.assert_array_equal(wire, [0, 1, 2, 3])
+
+    def test_struct_roundtrip(self):
+        t = derived.create_struct(
+            [1, 1], [0, 8], [zmpi.INT32_T, zmpi.DOUBLE]
+        ).commit()
+        buf = _fill_struct(3, t.extent)
+        packed = pack_external(buf, t, 3)
+        assert packed.size == convertor.packed_size(t, 3)
+        out = unpack_external(packed, t, 3)
+        np.testing.assert_array_equal(out, buf)
+
+    def test_vector_roundtrip(self):
+        t = derived.create_vector(3, 2, 4, zmpi.DOUBLE).commit()
+        src = np.arange(12, dtype=np.float64)
+        packed = pack_external(src, t, 1)
+        # canonical stream holds the 3 blocks of 2 doubles
+        wire = np.frombuffer(packed.tobytes(), dtype=">f8")
+        np.testing.assert_array_equal(wire, [0, 1, 4, 5, 8, 9])
+        out = unpack_external(packed, t, 1)
+        got = np.frombuffer(out.tobytes(), np.float64)
+        np.testing.assert_array_equal(got[[0, 1, 4, 5, 8, 9]],
+                                      [0, 1, 4, 5, 8, 9])
+
+    def test_cross_endian_interop(self):
+        """A big-endian producer's stream unpacks to native values — the
+        heterogeneous-peers contract external32 exists for."""
+        t = derived.create_contiguous(3, zmpi.FLOAT).commit()
+        wire = np.array([1.5, -2.25, 8.0], dtype=">f4")
+        out = unpack_external(
+            np.frombuffer(wire.tobytes(), np.uint8), t, 1
+        )
+        np.testing.assert_array_equal(
+            np.frombuffer(out.tobytes(), np.float32), [1.5, -2.25, 8.0]
+        )
+
+    def test_truncated_stream_raises(self):
+        t = derived.create_contiguous(4, zmpi.INT32_T).commit()
+        packed = pack_external(np.arange(4, dtype=np.int32), t, 1)
+        with pytest.raises(errors.TruncateError):
+            unpack_external(packed[:-1], t, 1)
+
+    def test_short_buffer_raises(self):
+        t = derived.create_contiguous(4, zmpi.INT32_T).commit()
+        with pytest.raises(errors.TruncateError):
+            pack_external(np.arange(2, dtype=np.int32), t, 1)

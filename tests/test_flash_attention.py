@@ -1,0 +1,115 @@
+"""Flash-attention kernel tests: Pallas interpret mode (CPU) against the
+naive reference — the kernel analog of testing the datatype engine
+without a network (SURVEY.md §4)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from zhpe_ompi_tpu.ops.flash_attention import (
+    attn_reference,
+    _flash_fwd,
+    flash_attention,
+)
+
+
+def _qkv(B=2, S=128, h=2, hd=64, seed=0, dtype=jnp.float32):
+    k1, k2, k3 = jax.random.split(jax.random.PRNGKey(seed), 3)
+    shape = (B, S, h, hd)
+    return (jax.random.normal(k1, shape, dtype),
+            jax.random.normal(k2, shape, dtype),
+            jax.random.normal(k3, shape, dtype))
+
+
+class TestForward:
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_matches_reference(self, causal):
+        q, k, v = _qkv()
+        ref = attn_reference(q, k, v, causal)
+        out = _flash_fwd(q, k, v, causal, 32, 32, interpret=True)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5
+        )
+
+    def test_uneven_block_sizes(self):
+        q, k, v = _qkv(S=96)
+        ref = attn_reference(q, k, v, True)
+        out = _flash_fwd(q, k, v, True, 32, 48, interpret=True)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5
+        )
+
+    def test_indivisible_seq_falls_back(self):
+        q, k, v = _qkv(S=100)
+        out = _flash_fwd(q, k, v, True, 32, 32, interpret=True)
+        ref = attn_reference(q, k, v, True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5)
+
+    def test_single_kv_block(self):
+        q, k, v = _qkv(S=32)
+        out = _flash_fwd(q, k, v, True, 32, 32, interpret=True)
+        ref = attn_reference(q, k, v, True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5)
+
+
+class TestBackward:
+    def test_grads_match_reference(self):
+        q, k, v = _qkv(S=64)
+
+        def loss_flash(q, k, v):
+            return jnp.sum(
+                flash_attention(q, k, v, causal=True, block_q=32,
+                                block_k=32, interpret=True) ** 2
+            )
+
+        def loss_ref(q, k, v):
+            return jnp.sum(attn_reference(q, k, v, True) ** 2)
+
+        gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gf, gr):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=5e-4, rtol=5e-4
+            )
+
+
+class TestDispatch:
+    def test_cpu_defaults_to_reference(self):
+        q, k, v = _qkv(S=32)
+        out = flash_attention(q, k, v)  # no interpret, cpu platform
+        ref = attn_reference(q, k, v, True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=1e-6)
+
+    def test_force_runs_kernel_off_tpu(self):
+        """force=True must genuinely exercise the kernel (interpreted on
+        CPU), not silently fall back."""
+        q, k, v = _qkv(S=64)
+        out = flash_attention(q, k, v, block_q=32, block_k=32, force=True)
+        ref = attn_reference(q, k, v, True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5)
+
+    def test_model_config_forces_kernel(self):
+        """Config(flash=True) routes the transformer through the kernel."""
+        import jax
+
+        from zhpe_ompi_tpu.models import transformer as tfm
+
+        cfg = tfm.Config(vocab=64, d_model=64, n_heads=2, d_ff=128,
+                         n_layers=1, seq=32, dtype=jnp.float32, flash=True)
+        cfg_naive = tfm.Config(vocab=64, d_model=64, n_heads=2, d_ff=128,
+                               n_layers=1, seq=32, dtype=jnp.float32,
+                               flash=False)
+        params = tfm.init_params(cfg, jax.random.PRNGKey(0))
+        tokens = jnp.arange(2 * 32, dtype=jnp.int32).reshape(2, 32) % 64
+        out_flash = tfm.forward(params, tokens, cfg, tp_comm=None)
+        out_naive = tfm.forward(params, tokens, cfg_naive, tp_comm=None)
+        np.testing.assert_allclose(
+            np.asarray(out_flash), np.asarray(out_naive),
+            atol=1e-4, rtol=1e-4,
+        )

@@ -16,7 +16,7 @@ from zhpe_ompi_tpu.pt2pt import matching
 
 def test_native_builds():
     assert native.available(), f"native build failed: {native.build_error}"
-    assert native.load().zompi_abi_version() == 1
+    assert native.load().zompi_abi_version() == 2
 
 
 @pytest.fixture

@@ -41,6 +41,9 @@ class Config:
     n_layers: int = 2
     seq: int = 64
     dtype: Any = jnp.bfloat16
+    # attention impl: None = auto (Pallas flash kernel on TPU, naive jnp
+    # elsewhere); True/False forces
+    flash: bool | None = None
 
 
 def init_params(cfg: Config, key, tp: int = 1) -> dict:
@@ -80,16 +83,9 @@ def _ln(x, g):
     return ((x - m) * lax.rsqrt(v + 1e-5) * g).astype(dt)
 
 
-def _attn(q, k, v, causal=True):
-    # q,k,v: (B, S, h, hd)
-    B, S, h, hd = q.shape
-    q = q * (hd**-0.5)
-    scores = jnp.einsum("bshd,bthd->bhst", q, k).astype(jnp.float32)
-    if causal:
-        mask = jnp.tril(jnp.ones((S, S), bool))
-        scores = jnp.where(mask, scores, -1e30)
-    w = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
-    return jnp.einsum("bhst,bthd->bshd", w, v)
+from ..ops.flash_attention import attn_reference as _attn  # noqa: E402
+# single source of attention numerics: the naive reference lives with the
+# flash kernel (ops/flash_attention.py) so fallback/backward can't diverge
 
 
 def forward(params: dict, tokens, cfg: Config, tp_comm=None, sp_comm=None):
@@ -114,6 +110,10 @@ def forward(params: dict, tokens, cfg: Config, tp_comm=None, sp_comm=None):
     from ..parallel.grad import f_identity, g_allreduce
     from .ring_attention import ring_attention
 
+    # flash dispatch: auto picks per-platform inside flash_attention;
+    # flash=True forces the kernel (interpreted off-TPU), False forces naive
+    use_flash = cfg.flash is not False
+
     def block(x, layer):
         wqkv, wo, w1, w2, g1, g2 = layer
         h = _ln(x, g1)
@@ -126,6 +126,12 @@ def forward(params: dict, tokens, cfg: Config, tp_comm=None, sp_comm=None):
         if sp_comm is not None:
             o = ring_attention(sp_comm, q, k, v, causal=True)
             o = o.reshape(B, S, -1)
+        elif use_flash:
+            from ..ops.flash_attention import flash_attention
+
+            o = flash_attention(
+                q, k, v, causal=True, force=cfg.flash is True
+            ).reshape(B, S, -1)
         else:
             o = _attn(q, k, v).reshape(B, S, -1)
         o = jnp.einsum("bse,ed->bsd", o, wo.astype(dtype))

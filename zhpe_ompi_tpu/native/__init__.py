@@ -84,6 +84,8 @@ def _declare(dll: ctypes.CDLL) -> None:
     dll.zompi_match_incoming.restype = ctypes.c_int
     dll.zompi_match_probe.argtypes = [vp, i64, i64, i64, i64p]
     dll.zompi_match_probe.restype = ctypes.c_int
+    dll.zompi_match_extract.argtypes = [vp, i64, i64, i64, i64p, u64p]
+    dll.zompi_match_extract.restype = ctypes.c_int
     dll.zompi_match_stats.argtypes = [vp, i64p, i64p]
     dll.zompi_match_stats.restype = None
     dll.zompi_abi_version.argtypes = []
@@ -126,7 +128,7 @@ def load() -> ctypes.CDLL | None:
                 os.replace(tmp, so)
             dll = ctypes.CDLL(so)
             _declare(dll)
-            if dll.zompi_abi_version() != 1:
+            if dll.zompi_abi_version() != 2:
                 raise RuntimeError("ABI version mismatch")
             lib = dll
         except Exception as exc:  # noqa: BLE001 - any failure → fallback

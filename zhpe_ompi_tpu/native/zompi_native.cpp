@@ -366,6 +366,27 @@ int zompi_match_probe(void* h, int64_t src, int64_t tag, int64_t cid,
   return 0;
 }
 
+// MPI_Mprobe: dequeue the earliest matching unexpected envelope — the
+// returned message is matched and can no longer satisfy other receives.
+int zompi_match_extract(void* h, int64_t src, int64_t tag, int64_t cid,
+                        int64_t* out_env, uint64_t* out_payload_key) {
+  ZompiMatch* m = static_cast<ZompiMatch*>(h);
+  std::lock_guard<std::mutex> g(m->mu);
+  ZompiPosted p{src, tag, cid, 0};
+  for (auto it = m->unexpected.begin(); it != m->unexpected.end(); ++it) {
+    if (zompi_matches(p, *it)) {
+      out_env[0] = it->src;
+      out_env[1] = it->tag;
+      out_env[2] = it->cid;
+      out_env[3] = it->seq;
+      *out_payload_key = it->payload_key;
+      m->unexpected.erase(it);
+      return 1;
+    }
+  }
+  return 0;
+}
+
 void zompi_match_stats(void* h, int64_t* n_posted, int64_t* n_unexpected) {
   ZompiMatch* m = static_cast<ZompiMatch*>(h);
   std::lock_guard<std::mutex> g(m->mu);
@@ -373,6 +394,6 @@ void zompi_match_stats(void* h, int64_t* n_posted, int64_t* n_unexpected) {
   *n_unexpected = static_cast<int64_t>(m->unexpected.size());
 }
 
-int zompi_abi_version() { return 1; }
+int zompi_abi_version() { return 2; }
 
 }  // extern "C"

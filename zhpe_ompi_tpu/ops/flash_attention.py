@@ -1,0 +1,223 @@
+"""Fused attention (flash-attention) Pallas kernel for TPU.
+
+The reference has no accelerator kernels at all — its hot loops are C
+(SURVEY.md §2) — so this is pure TPU-native ground: the transformer
+models' attention is the FLOPs-dominant op after the matmuls, and the
+naive form materializes the (S, S) score matrix in HBM.  This kernel
+computes softmax(QKᵀ)V blockwise with the online-softmax recurrence over
+a (batch·heads, q-blocks, k-blocks) grid: only (block, d) tiles ever sit
+in VMEM (K/V stream one block per grid step — whole-sequence staging
+would blow the ~16 MB VMEM budget at exactly the long-context sizes the
+kernel targets), partial statistics live in VMEM scratch across the
+k-grid, and fully-masked causal blocks skip their compute.
+
+Backward pass: blockwise recomputation — one q-block of scores at a time
+(O(S·block) live memory, matching the forward's), accumulated dk/dv via
+lax.scan.  The naive O(S²) rebuild would OOM precisely the long-context
+training runs this kernel exists for.
+
+Falls back to the reference jnp implementation off-TPU on the auto path;
+`interpret=True` runs the kernel on CPU for tests (the in-tree analog of
+testing the datatype engine without a network, SURVEY.md §4), and
+forcing the kernel off-TPU routes through the interpreter so "forced"
+really does exercise the kernel.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+_NEG_INF = -1e30
+
+
+def attn_reference(q, k, v, causal=True):
+    """Naive attention — the single semantic baseline (the models import
+    this; keep numerics changes here only)."""
+    B, S, h, hd = q.shape
+    qs = q * (hd ** -0.5)
+    scores = jnp.einsum("bshd,bthd->bhst", qs, k).astype(jnp.float32)
+    if causal:
+        mask = jnp.tril(jnp.ones((S, S), bool))
+        scores = jnp.where(mask, scores, _NEG_INF)
+    w = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
+    return jnp.einsum("bhst,bthd->bshd", w, v)
+
+
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, acc_sc, m_sc, l_sc, *,
+                      block_q: int, block_k: int, n_kb: int, causal: bool):
+    import jax.experimental.pallas as pl
+
+    qi = pl.program_id(1)
+    kj = pl.program_id(2)
+    hd = q_ref.shape[-1]
+
+    @pl.when(kj == 0)
+    def _init():
+        acc_sc[...] = jnp.zeros_like(acc_sc)
+        m_sc[...] = jnp.full_like(m_sc, _NEG_INF)
+        l_sc[...] = jnp.zeros_like(l_sc)
+
+    def _compute():
+        scale = hd ** -0.5
+        qb = q_ref[0].astype(jnp.float32) * scale      # (block_q, hd)
+        kb = k_ref[0].astype(jnp.float32)              # (block_k, hd)
+        vb = v_ref[0].astype(jnp.float32)
+        s = lax.dot_general(
+            qb, kb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        if causal:
+            row = qi * block_q + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            col = kj * block_k + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            s = jnp.where(col <= row, s, _NEG_INF)
+        m_prev = m_sc[...]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        corr = jnp.exp(m_prev - m_new)
+        l_sc[...] = l_sc[...] * corr + jnp.sum(p, axis=-1, keepdims=True)
+        acc_sc[...] = acc_sc[...] * corr + lax.dot_general(
+            p, vb, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_sc[...] = m_new
+
+    if causal:
+        # skip blocks entirely above the diagonal
+        pl.when(kj * block_k <= (qi + 1) * block_q - 1)(_compute)
+    else:
+        _compute()
+
+    @pl.when(kj == n_kb - 1)
+    def _finalize():
+        o_ref[0] = (
+            acc_sc[...] / jnp.maximum(l_sc[...], 1e-30)
+        ).astype(o_ref.dtype)
+
+
+def _flash_fwd(q, k, v, causal: bool, block_q: int, block_k: int,
+               interpret: bool):
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, S, h, hd = q.shape
+    block_q = min(block_q, S)
+    block_k = min(block_k, S)
+    if S % block_q or S % block_k:
+        return attn_reference(q, k, v, causal)
+
+    def fold(x):  # (B, S, h, hd) -> (B*h, S, hd)
+        return x.transpose(0, 2, 1, 3).reshape(B * h, S, hd)
+
+    qf, kf, vf = fold(q), fold(k), fold(v)
+    n_kb = S // block_k
+    grid = (B * h, S // block_q, n_kb)
+    out = pl.pallas_call(
+        functools.partial(
+            _flash_fwd_kernel, block_q=block_q, block_k=block_k,
+            n_kb=n_kb, causal=causal,
+        ),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, hd), lambda bh, qi, kj: (bh, qi, 0)),
+            pl.BlockSpec((1, block_k, hd), lambda bh, qi, kj: (bh, kj, 0)),
+            pl.BlockSpec((1, block_k, hd), lambda bh, qi, kj: (bh, kj, 0)),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, block_q, hd), lambda bh, qi, kj: (bh, qi, 0)
+        ),
+        out_shape=jax.ShapeDtypeStruct((B * h, S, hd), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, hd), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qf, kf, vf)
+    return out.reshape(B, h, S, hd).transpose(0, 2, 1, 3)
+
+
+def _attn_qblock(q_blk, k, v, causal: bool, row_offset):
+    """Attention for one q block against the full K/V — O(S·block_q)
+    memory; the unit of the blockwise backward."""
+    B, bq, h, hd = q_blk.shape
+    S = k.shape[1]
+    qs = q_blk * (hd ** -0.5)
+    scores = jnp.einsum("bshd,bthd->bhst", qs, k).astype(jnp.float32)
+    if causal:
+        row = row_offset + jnp.arange(bq)
+        mask = row[:, None] >= jnp.arange(S)[None, :]
+        scores = jnp.where(mask[None, None], scores, _NEG_INF)
+    w = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
+    return jnp.einsum("bhst,bthd->bshd", w, v)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash(q, k, v, causal, block_q, block_k, interpret):
+    return _flash_fwd(q, k, v, causal, block_q, block_k, interpret)
+
+
+def _flash_vjp_fwd(q, k, v, causal, block_q, block_k, interpret):
+    out = _flash_fwd(q, k, v, causal, block_q, block_k, interpret)
+    return out, (q, k, v)
+
+
+def _flash_vjp_bwd(causal, block_q, block_k, interpret, res, g):
+    """Blockwise recompute: scan q-blocks, each rebuilding only its
+    (block_q, S) score slab — dq per block, dk/dv accumulated."""
+    q, k, v = res
+    B, S, h, hd = q.shape
+    bq = min(block_q, S)
+    if S % bq:
+        bq = S  # degenerate: single block
+    nb = S // bq
+
+    q_blocks = q.reshape(B, nb, bq, h, hd).transpose(1, 0, 2, 3, 4)
+    g_blocks = g.reshape(B, nb, bq, h, hd).transpose(1, 0, 2, 3, 4)
+
+    def step(carry, inputs):
+        dk, dv, i = carry
+        q_i, g_i = inputs
+        row0 = i * bq
+
+        def fwd_i(q_i, k, v):
+            return _attn_qblock(q_i, k, v, causal, row0)
+
+        _, vjp = jax.vjp(fwd_i, q_i, k, v)
+        dq_i, dk_i, dv_i = vjp(g_i)
+        return (dk + dk_i, dv + dv_i, i + 1), dq_i
+
+    (dk, dv, _), dq_blocks = lax.scan(
+        step, (jnp.zeros_like(k), jnp.zeros_like(v), jnp.asarray(0)),
+        (q_blocks, g_blocks),
+    )
+    dq = dq_blocks.transpose(1, 0, 2, 3, 4).reshape(B, S, h, hd)
+    return dq, dk, dv
+
+
+_flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
+def flash_attention(q, k, v, causal: bool = True, block_q: int = 128,
+                    block_k: int = 128, interpret: bool = False,
+                    force: bool = False):
+    """Fused attention over (B, S, heads, head_dim) tensors.
+
+    Auto path: the Pallas kernel on TPU, the jnp reference elsewhere.
+    ``force=True`` always runs the kernel — off-TPU it routes through the
+    Pallas interpreter so forcing genuinely exercises the kernel path
+    (slow; for tests and numerics comparison)."""
+    on_tpu = jax.devices()[0].platform == "tpu"
+    if force:
+        return _flash(q, k, v, causal, block_q, block_k,
+                      interpret or not on_tpu)
+    if not (on_tpu or interpret):
+        return attn_reference(q, k, v, causal)
+    return _flash(q, k, v, causal, block_q, block_k, interpret)

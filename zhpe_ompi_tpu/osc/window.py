@@ -38,6 +38,13 @@ class _WinRegistry:
     def __init__(self, size: int):
         self.buffers: list[np.ndarray | None] = [None] * size
         self.locks = [threading.RLock() for _ in range(size)]
+        # dynamic-window state (create_dynamic/attach): per-rank attached
+        # regions keyed by displacement (built here, not lazily — lazy init
+        # from racing rank threads would clobber attachments)
+        self.dynamic: list[dict[int, np.ndarray]] = [
+            dict() for _ in range(size)
+        ]
+        self.dynamic_next = [0] * size
         # PSCW state: per-rank exposure epoch counter (incremented by post)
         # and per-rank count of origins that called complete() this epoch
         self.cond = threading.Condition()
@@ -196,8 +203,113 @@ class HostWindow:
         self._held[target] -= 1
         self._reg.locks[target].release()
 
+    def lock_all(self) -> None:
+        """MPI_Win_lock_all: shared access epoch at every target; locks are
+        taken in rank order so concurrent lock_all calls cannot deadlock."""
+        for t in range(self.ctx.size):
+            self.lock(t, LOCK_SHARED)
+
+    def unlock_all(self) -> None:
+        for t in range(self.ctx.size):
+            self.unlock(t)
+
     def flush(self, target: int | None = None) -> None:
         """MPI_Win_flush: in-process operations are already visible."""
+
+    def flush_all(self) -> None:
+        """MPI_Win_flush_all."""
+
+    def flush_local(self, target: int | None = None) -> None:
+        """MPI_Win_flush_local."""
+
+    # -- allocation variants ---------------------------------------------
+
+    @classmethod
+    def allocate(cls, ctx, nbytes: int, dtype=np.uint8) -> "HostWindow":
+        """MPI_Win_allocate: the window owns its buffer."""
+        buf = np.zeros(nbytes // np.dtype(dtype).itemsize, dtype)
+        win = cls.create(ctx, buf)
+        win.base = buf
+        return win
+
+    @classmethod
+    def allocate_shared(cls, ctx, nbytes: int, dtype=np.uint8
+                        ) -> "HostWindow":
+        """MPI_Win_allocate_shared: all ranks' buffers are directly
+        loadable/storable by every rank (shared_query).  In-process every
+        window is already shared; this variant exposes the direct view."""
+        win = cls.allocate(ctx, nbytes, dtype)
+        win._shared = True
+        return win
+
+    def shared_query(self, target: int) -> np.ndarray:
+        """MPI_Win_shared_query: the target's buffer for direct load/store
+        (only windows from allocate_shared)."""
+        if not getattr(self, "_shared", False):
+            raise errors.WinError(
+                "shared_query requires a window from allocate_shared"
+            )
+        return self._target_buf(target)
+
+    # -- dynamic windows --------------------------------------------------
+    # MPI_Win_create_dynamic + attach/detach (reference: osc/rdma's dynamic
+    # region tree, ompi_osc_rdma_attach).  Dynamic windows are
+    # BYTE-addressed, as MPI's are (displacements against MPI_BOTTOM):
+    # dyn_put writes raw bytes into the target's attached region, dyn_get
+    # returns bytes — the window resolves (displacement -> region) and
+    # writes through to the user's array, never a copy.
+
+    @classmethod
+    def create_dynamic(cls, ctx) -> "HostWindow":
+        """MPI_Win_create_dynamic: starts with no memory."""
+        win = cls.create(ctx, np.zeros(0, np.uint8))
+        win._is_dynamic = True
+        return win
+
+    def attach(self, region: np.ndarray) -> int:
+        """Attach local memory; returns the displacement other ranks use
+        to address it (MPI hands out the raw address; a handle is the safe
+        equivalent)."""
+        if not getattr(self, "_is_dynamic", False):
+            raise errors.WinError("attach requires a dynamic window")
+        if not region.flags["C_CONTIGUOUS"]:
+            raise errors.WinError("attached region must be C-contiguous")
+        me = self.ctx.rank
+        disp = self._reg.dynamic_next[me]
+        self._reg.dynamic_next[me] += max(1, region.nbytes)
+        self._reg.dynamic[me][disp] = region
+        return disp
+
+    def detach(self, disp: int) -> None:
+        regions = self._reg.dynamic[self.ctx.rank]
+        if disp not in regions:
+            raise errors.WinError(f"no region attached at {disp}")
+        del regions[disp]
+
+    def _resolve_dynamic(self, target: int, disp: int, nbytes: int
+                         ) -> tuple[np.ndarray, int]:
+        for base, region in self._reg.dynamic[target].items():
+            if base <= disp and disp + nbytes <= base + region.nbytes:
+                return region.reshape(-1).view(np.uint8), disp - base
+        raise errors.WinError(
+            f"RMA [{disp}, {disp + nbytes}) outside attached regions of "
+            f"rank {target}"
+        )
+
+    def dyn_put(self, data, target: int, disp: int) -> None:
+        """Put into a dynamic window: raw bytes of `data` land at byte
+        displacement `disp` of the target's attached memory (write-through
+        to the attached array)."""
+        raw = np.frombuffer(np.ascontiguousarray(data).tobytes(), np.uint8)
+        with self._reg.locks[target]:
+            view, off = self._resolve_dynamic(target, disp, raw.size)
+            view[off : off + raw.size] = raw
+
+    def dyn_get(self, target: int, disp: int, nbytes: int) -> np.ndarray:
+        """Get raw bytes from the target's attached memory."""
+        with self._reg.locks[target]:
+            view, off = self._resolve_dynamic(target, disp, nbytes)
+            return view[off : off + nbytes].copy()
 
     # PSCW generalized active target (MPI_Win_post/start/complete/wait)
     def post(self, origins: list[int] | None = None) -> None:

@@ -122,6 +122,19 @@ class MatchingEngine:
                     return env
         return None
 
+    def extract(self, src: int, tag: int, cid: int
+                ) -> tuple[Envelope, Any] | None:
+        """MPI_Improbe's dequeue: remove and return the earliest matching
+        unexpected message — once extracted it can only be received
+        through the returned handle (MPI_Mrecv semantics)."""
+        probe_req = PostedRecv(src, tag, cid, lambda e, p: None)
+        with self._lock:
+            for i, (env, payload) in enumerate(self._unexpected):
+                if probe_req.matches(env):
+                    del self._unexpected[i]
+                    return env, payload
+        return None
+
     def stats(self) -> dict[str, int]:
         with self._lock:
             return {
@@ -220,6 +233,20 @@ class NativeMatchingEngine:
             hit = self._lib.zompi_match_probe(self._h, src, tag, cid, env)
         if hit:
             return Envelope(env[0], env[1], env[2], env[3])
+        return None
+
+    def extract(self, src: int, tag: int, cid: int
+                ) -> tuple[Envelope, Any] | None:
+        ct = self._ctypes
+        env = (ct.c_int64 * 4)()
+        pkey = ct.c_uint64()
+        with self._lock:
+            hit = self._lib.zompi_match_extract(
+                self._h, src, tag, cid, env, ct.byref(pkey)
+            )
+            payload = self._payloads.pop(pkey.value) if hit else None
+        if hit:
+            return Envelope(env[0], env[1], env[2], env[3]), payload
         return None
 
     def stats(self) -> dict[str, int]:

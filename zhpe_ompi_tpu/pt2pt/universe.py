@@ -75,6 +75,51 @@ def _eager_copy(obj: Any) -> Any:
     return obj  # jax arrays / immutables
 
 
+class _Message:
+    """MPI_Message analog: a matched-but-unreceived message handle."""
+
+    __slots__ = ("envelope", "payload", "consumed")
+
+    def __init__(self, envelope: Envelope, payload: Any):
+        self.envelope = envelope
+        self.payload = payload
+        self.consumed = False
+
+
+class PersistentRequest:
+    """MPI persistent request: created inactive, re-armed by start(),
+    completed by wait/test like any request (cf. MCA_PML_CALL(start))."""
+
+    def __init__(self, start_fn: Callable[[], "Request"]):
+        self._start_fn = start_fn
+        self._active: Request | None = None
+
+    def start(self) -> "PersistentRequest":
+        if self._active is not None and not self._active.done:
+            raise errors.RequestError(
+                "persistent request started while still active"
+            )
+        self._active = self._start_fn()
+        return self
+
+    def wait(self, timeout: float | None = None):
+        if self._active is None:
+            raise errors.RequestError("wait on an inactive persistent request")
+        value = self._active.wait(timeout)
+        self.status = self._active.status
+        self._active = None  # back to inactive, re-armable
+        return value
+
+    def test(self):
+        if self._active is None:
+            raise errors.RequestError("test on an inactive persistent request")
+        flag, value = self._active.test()
+        if flag:
+            self.status = self._active.status
+            self._active = None
+        return flag, value
+
+
 class RankContext:
     """One rank's endpoint: the MPI API surface of the host plane."""
 
@@ -190,6 +235,52 @@ class RankContext:
         """MPI_Iprobe: non-blocking; returns an Envelope or None."""
         self.progress()
         return self.engine.probe(source, tag, cid)
+
+    def improbe(self, source: int = ANY_SOURCE, tag: int = ANY_TAG,
+                cid: int = 0):
+        """MPI_Improbe: like probe but MATCHES the message — it is removed
+        from the unexpected queue and only retrievable via
+        :func:`mrecv` on the returned handle (thread-safe hand-off, the
+        reason mprobe exists)."""
+        self.progress()
+        hit = self.engine.extract(source, tag, cid)
+        if hit is None:
+            return None
+        env, payload = hit
+        if isinstance(payload, _RndvToken):
+            # rendezvous announce: pull the payload over before handing out
+            done: list[Any] = []
+
+            def deliver(data):
+                done.append(data)
+
+            self.universe.contexts[payload.sender_rank].mailbox.put(
+                (_CTS, payload.rndv_id, self.rank, deliver)
+            )
+            while not done:
+                self.progress()
+                self.universe.contexts[payload.sender_rank].progress()
+            payload = done[0]
+        return _Message(env, payload)
+
+    def mrecv(self, message: "_Message"):
+        """MPI_Mrecv: complete a matched-probe message."""
+        if message.consumed:
+            raise errors.RequestError("message already received")
+        message.consumed = True
+        return message.payload
+
+    # -- persistent requests (MPI_Send_init / MPI_Recv_init / MPI_Start) --
+
+    def send_init(self, obj: Any, dest: int, tag: int = 0, cid: int = 0):
+        """MPI_Send_init: persistent send (reference: pml start interface,
+        ompi/mca/pml/pml.h:491-528's pml_start)."""
+        return PersistentRequest(lambda: self.isend(obj, dest, tag, cid))
+
+    def recv_init(self, source: int = ANY_SOURCE, tag: int = ANY_TAG,
+                  cid: int = 0):
+        """MPI_Recv_init: persistent receive."""
+        return PersistentRequest(lambda: self.irecv(source, tag, cid))
 
     def sendrecv(self, obj: Any, dest: int, source: int = ANY_SOURCE,
                  sendtag: int = 0, recvtag: int = ANY_TAG, cid: int = 0):

@@ -30,6 +30,10 @@ def _load_everything():
     from ..pt2pt import universe  # registers pt2pt vars  # noqa: F401
     from ..parallel import mesh  # registers rte vars  # noqa: F401
     from ..coll import monitoring  # registers monitoring vars  # noqa: F401
+    from ..utils import memchecker  # registers memchecker vars  # noqa: F401
+    from .. import native
+
+    native.load()  # registration happens inside load(), not at import
 
 
 def gather(prefix: str | None = None) -> dict:
