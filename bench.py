@@ -2,7 +2,7 @@
 vs the identical step written in plain JAX (no framework layer).
 
 Prints ONE JSON line:
-  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...}
 
 vs_baseline semantics: the reference publishes no numbers (BASELINE.md), so
 the baseline is the strongest available stand-in — the same training step
@@ -11,12 +11,51 @@ means the MPI-model layer (communicators, comm_select dispatch, tuned
 decisions, f/g AD wrappers) costs nothing over hand-written JAX; that is the
 claim being benchmarked.  On multi-device hosts the collectives are real; on
 one chip they lower to no-ops but the full dispatch path still runs.
+
+Timing discipline: ``jax.block_until_ready`` is a no-op on some PJRT
+plugins (proven on this TPU backend: it returns while 1.5 s of queued work
+is still in flight), so every timing window ends with a FORCED HOST FETCH
+of the final loss — the step chain is sequentially dependent, so fetching
+the last loss bounds the whole window.  A physics assert rejects any
+throughput implying more FLOP/s than the chip's peak, so a broken sync can
+never ship a bogus number.
 """
 
 import json
 import time
 
 import numpy as np
+
+# Peak dense bf16 matmul FLOP/s per chip, by device_kind substring.
+_PEAK_BF16 = (
+    ("v5 lite", 197e12), ("v5e", 197e12), ("v5lite", 197e12),
+    ("v5p", 459e12), ("v5", 459e12),
+    ("v6e", 918e12), ("trillium", 918e12),
+    ("v4", 275e12),
+)
+# Unknown accelerator: a generous-but-finite ceiling so the broken-sync guard
+# still trips on dispatch-rate nonsense (BENCH_r01 implied 47 PFLOP/s) while
+# never aborting a legitimate run on a future chip.
+_UNKNOWN_PEAK = 2000e12
+
+
+def _chip_peak(dev):
+    """(per-chip bf16 peak, known: bool) for the physics assert / MFU."""
+    kind = getattr(dev, "device_kind", "").lower()
+    for key, peak in _PEAK_BF16:
+        if key in kind:
+            return peak, True
+    return _UNKNOWN_PEAK, False
+
+
+def _train_flops_per_step(cfg, batch):
+    """Approximate training FLOPs per step: 6 * n_matmul_params * tokens
+    (fwd 2x + bwd 4x) plus the attention quadratic term
+    12 * L * B * S^2 * D (QK^T and PV matmuls, fwd+bwd)."""
+    d, f, v, L, s = cfg.d_model, cfg.d_ff, cfg.vocab, cfg.n_layers, cfg.seq
+    matmul_params = L * (4 * d * d + 2 * d * f) + v * d  # qkv+o, ffn, unembed
+    tokens = batch * s
+    return 6 * matmul_params * tokens + 12 * L * batch * s * s * d
 
 
 def main():
@@ -56,6 +95,17 @@ def main():
     tokens = jnp.asarray(r.integers(0, cfg.vocab, (batch, cfg.seq)))
     targets = jnp.asarray(r.integers(0, cfg.vocab, (batch, cfg.seq)))
 
+    flops_step = _train_flops_per_step(cfg, batch)
+    chip_peak, kind_known = _chip_peak(devs[0]) if on_tpu else (None, False)
+    if on_tpu and not kind_known:
+        import sys
+
+        print(f"warning: unknown device_kind "
+              f"{getattr(devs[0], 'device_kind', '?')!r}; MFU disabled, "
+              f"physics ceiling {chip_peak/1e12:.0f} TFLOP/s/chip",
+              file=sys.stderr)
+    peak = chip_peak * (dp * tp) if on_tpu else float("inf")
+
     def bench_step(step, specs):
         sharded = {
             k: jax.device_put(v, NamedSharding(mesh, specs[k]))
@@ -67,26 +117,37 @@ def main():
         ps, loss = step(sharded, tok, tgt)  # compile
         for _ in range(3):  # warm caches/threads
             ps, loss = step(ps, tok, tgt)
-        jax.block_until_ready(loss)
+        float(loss)  # forced host fetch: drains the queue for real
         best = float("inf")
         for _ in range(3):  # best-of-3 timing windows
             t0 = time.perf_counter()
             for _ in range(iters):
                 ps, loss = step(ps, tok, tgt)
-            jax.block_until_ready(loss)
+            # The steps form a dependency chain (params thread through), so
+            # fetching the final loss to the host bounds the whole window.
+            lval = float(loss)
             best = min(best, (time.perf_counter() - t0) / iters)
-        return batch * cfg.seq / best  # tokens/sec
+            # raise (not assert): must survive python -O — this is the guard
+            # that a broken sync / NaN window can never ship a bogus number;
+            # checked per window so a discarded window can't hide a NaN
+            if not np.isfinite(lval):
+                raise RuntimeError(f"non-finite loss {lval}")
+        implied = flops_step / best
+        if implied >= peak:
+            raise RuntimeError(
+                f"implied {implied/1e12:.1f} TFLOP/s exceeds chip peak "
+                f"{peak/1e12:.1f} — timing sync is broken"
+            )
+        return best  # seconds/step
 
     # framework path
     step_fw, specs = tfm.make_train_step(cfg, mesh, dp_comm, tp_comm)
-    fw_tps = bench_step(step_fw, specs)
+    fw_s = bench_step(step_fw, specs)
 
     # plain-JAX baseline: identical math, raw lax.psum collectives
     from jax import lax
 
     def make_plain_step():
-        from zhpe_ompi_tpu.parallel import grad as gradmod
-
         class RawComm:
             def __init__(self, axis):
                 self.axis = axis
@@ -95,7 +156,6 @@ def main():
                 return lax.psum(x, self.axis)
 
         raw_tp = RawComm("tp") if tp > 1 else None
-        raw_dp = RawComm("dp")
 
         dp_sz = dp
         tp_sz = tp
@@ -130,13 +190,18 @@ def main():
             )
         )
 
-    plain_tps = bench_step(make_plain_step(), specs)
+    plain_s = bench_step(make_plain_step(), specs)
 
+    fw_tps = batch * cfg.seq / fw_s
+    mfu = (flops_step / fw_s) / peak if kind_known else 0.0
     print(json.dumps({
         "metric": "train_step_throughput",
         "value": round(fw_tps, 1),
         "unit": "tokens/s",
-        "vs_baseline": round(fw_tps / plain_tps, 4),
+        "vs_baseline": round(plain_s / fw_s, 4),
+        "step_ms": round(fw_s * 1e3, 2),
+        "mfu": round(mfu, 4),
+        "flops_per_step": flops_step,
     }))
 
 
