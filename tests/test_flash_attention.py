@@ -1,6 +1,7 @@
 """Flash-attention kernel tests: Pallas interpret mode (CPU) against the
 naive reference — the kernel analog of testing the datatype engine
-without a network (SURVEY.md §4)."""
+without a network (SURVEY.md §4).  Both directions are kernels now, so
+both are compared to the jnp reference's values/grads."""
 
 import numpy as np
 import pytest
@@ -23,51 +24,110 @@ def _qkv(B=2, S=128, h=2, hd=64, seed=0, dtype=jnp.float32):
             jax.random.normal(k3, shape, dtype))
 
 
+def _ref_lse(q, k, causal):
+    """Reference per-row logsumexp of the scaled (masked) scores."""
+    B, S, h, hd = q.shape
+    s = jnp.einsum("bshd,bthd->bhst", q * (hd ** -0.5), k)
+    s = s.astype(jnp.float32)
+    if causal:
+        mask = jnp.tril(jnp.ones((S, S), bool))
+        s = jnp.where(mask, s, -1e30)
+    return jax.nn.logsumexp(s, axis=-1).reshape(B * h, S)
+
+
 class TestForward:
     @pytest.mark.parametrize("causal", [True, False])
     def test_matches_reference(self, causal):
         q, k, v = _qkv()
         ref = attn_reference(q, k, v, causal)
-        out = _flash_fwd(q, k, v, causal, 32, 32, interpret=True)
+        out, lse = _flash_fwd(q, k, v, causal, 32, 32, interpret=True)
         np.testing.assert_allclose(
             np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5
+        )
+        np.testing.assert_allclose(
+            np.asarray(lse[..., 0]), np.asarray(_ref_lse(q, k, causal)),
+            atol=2e-5, rtol=2e-5,
         )
 
     def test_uneven_block_sizes(self):
         q, k, v = _qkv(S=96)
         ref = attn_reference(q, k, v, True)
-        out = _flash_fwd(q, k, v, True, 32, 48, interpret=True)
+        out, _ = _flash_fwd(q, k, v, True, 32, 48, interpret=True)
         np.testing.assert_allclose(
             np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5
         )
 
     def test_indivisible_seq_falls_back(self):
         q, k, v = _qkv(S=100)
-        out = _flash_fwd(q, k, v, True, 32, 32, interpret=True)
+        out = flash_attention(q, k, v, block_q=32, block_k=32, force=True)
         ref = attn_reference(q, k, v, True)
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    atol=2e-5, rtol=2e-5)
 
     def test_single_kv_block(self):
         q, k, v = _qkv(S=32)
-        out = _flash_fwd(q, k, v, True, 32, 32, interpret=True)
+        out, _ = _flash_fwd(q, k, v, True, 32, 32, interpret=True)
         ref = attn_reference(q, k, v, True)
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    atol=2e-5, rtol=2e-5)
 
 
 class TestBackward:
-    def test_grads_match_reference(self):
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_grads_match_reference(self, causal):
         q, k, v = _qkv(S=64)
 
         def loss_flash(q, k, v):
             return jnp.sum(
-                flash_attention(q, k, v, causal=True, block_q=32,
+                flash_attention(q, k, v, causal=causal, block_q=32,
                                 block_k=32, interpret=True) ** 2
             )
 
         def loss_ref(q, k, v):
+            return jnp.sum(attn_reference(q, k, v, causal) ** 2)
+
+        gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gf, gr):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=5e-4, rtol=5e-4
+            )
+
+    def test_grads_uneven_blocks(self):
+        """block_q != block_k exercises the asymmetric tile masks in both
+        backward kernels."""
+        q, k, v = _qkv(S=96)
+
+        def loss_flash(q, k, v):
+            return jnp.sum(
+                flash_attention(q, k, v, causal=True, block_q=32,
+                                block_k=48, interpret=True) ** 2
+            )
+
+        def loss_ref(q, k, v):
             return jnp.sum(attn_reference(q, k, v, True) ** 2)
+
+        gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gf, gr):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=5e-4, rtol=5e-4
+            )
+
+    def test_grads_nontrivial_cotangent(self):
+        """A non-symmetric loss (weighted sum) catches transposition bugs
+        that x**2 losses can miss."""
+        q, k, v = _qkv(S=64, seed=3)
+        w = jax.random.normal(jax.random.PRNGKey(9), q.shape, jnp.float32)
+
+        def loss_flash(q, k, v):
+            return jnp.sum(
+                w * flash_attention(q, k, v, causal=True, block_q=32,
+                                    block_k=32, interpret=True)
+            )
+
+        def loss_ref(q, k, v):
+            return jnp.sum(w * attn_reference(q, k, v, True))
 
         gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
         gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
