@@ -1,0 +1,371 @@
+"""Host-plane collectives: the full collective set over endpoint
+send/recv, for universe (thread) and TCP (socket/DCN) ranks.
+
+The reference's collective algorithms run over the PML regardless of which
+BTL carries the bytes — ``coll_base_allreduce.c:130-225`` is written in
+``MCA_PML_CALL(send/recv)`` and therefore works over tcp for free.  This
+module restores that layering property for the host plane: every function
+takes any endpoint exposing ``rank``/``size``/``send``/``recv``/
+``sendrecv`` (universe ``RankContext``, ``TcpProc``) and speaks only that
+surface — so a DCN-connected job can allreduce over sockets exactly like a
+thread universe.  (The device plane keeps its own XLA-native algorithms in
+``coll/tpu.py``/``coll/algorithms.py``; this is the control/host plane the
+reference runs EVERYTHING on.)
+
+Algorithm choices mirror coll_base (re-derived, not transliterated):
+binomial bcast/reduce (``coll_base_bcast.c``, in-order linear reduce for
+non-commutative ops), recursive-doubling allreduce with the non-power-of-2
+pre/post fold (``coll_base_allreduce.c:130-225``), ring allgather
+(``coll_base_allgather.c``), pairwise-exchange alltoall
+(``coll_base_alltoall.c``), linear scan/exscan.
+
+Payloads are arbitrary Python/numpy objects; reductions use the framework
+``Op`` combine (``a ⊕ b``), applied elementwise through lists/tuples so a
+list-of-blocks reduces blockwise (what reduce_scatter needs).  Operand
+order is preserved for non-commutative ops: every combine keeps the
+lower-rank contribution on the left.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from ..core import errors
+
+# Reserved context id for host-plane collective traffic (the
+# MCA_COLL_BASE_TAG_* space; barrier already uses cid 0x7FFF).
+COLL_CID = 0x7FFD
+
+# Per-operation base tags (the MCA_COLL_BASE_TAG_* table).
+TAG_BCAST = 0x7E01
+TAG_REDUCE = 0x7E02
+TAG_ALLREDUCE = 0x7E03
+TAG_ALLGATHER = 0x7E04
+TAG_GATHER = 0x7E05
+TAG_SCATTER = 0x7E06
+TAG_ALLTOALL = 0x7E07
+TAG_SCAN = 0x7E08
+TAG_RSCAT = 0x7E09
+
+
+def _next_tag(ctx, base: int) -> int:
+    """Instance tag = base kind tag + a per-endpoint collective sequence
+    number.
+
+    MPI requires every rank to issue collectives on a communicator in the
+    same program order, so the k-th collective gets the same tag on every
+    rank — and two overlapping collectives (a nonblocking one outstanding
+    across a blocking one, two outstanding nonblocking ones progressed in
+    different orders) can never cross-match, even though their rounds
+    interleave arbitrarily on the wire.  Base tags alone are NOT enough:
+    round numbering differs per rank (a fold rank's round 1 is a
+    non-fold rank's round 0), so posted-recv order need not match send
+    order across instances.  The reference solves this the same way via
+    libnbc's schedule tags (nbc.c `schedule->tag`)."""
+    seq = getattr(ctx, "_coll_seq", 0)
+    ctx._coll_seq = seq + 1
+    return ((seq % 0x8000) << 16) | base
+
+
+def _combine(op, a: Any, b: Any) -> Any:
+    """a ⊕ b, mapped elementwise through lists/tuples (blockwise reduce)."""
+    if isinstance(a, (list, tuple)):
+        if not isinstance(b, (list, tuple)) or len(a) != len(b):
+            raise errors.ArgError("blockwise reduce of mismatched sequences")
+        return type(a)(_combine(op, x, y) for x, y in zip(a, b))
+    return op(a, b)
+
+
+def _ordered(op, lo_val, hi_val):
+    """Combine preserving rank order: lo ⊕ hi."""
+    return _combine(op, lo_val, hi_val)
+
+
+# -------------------------------------------------------------- broadcast
+
+
+def bcast(ctx, obj: Any = None, root: int = 0) -> Any:
+    """Binomial-tree broadcast (coll_base_bcast.c:207-259 shape).
+
+    ``obj`` is significant at root only; every rank returns the payload.
+    """
+    size, rank = ctx.size, ctx.rank
+    if size == 1:
+        return obj
+    tag = _next_tag(ctx, TAG_BCAST)
+    vrank = (rank - root) % size
+    # receive from parent (clear lowest set bit of vrank)
+    if vrank != 0:
+        parent = ((vrank & (vrank - 1)) + root) % size
+        obj = ctx.recv(parent, tag=tag, cid=COLL_CID)
+    # forward to children: set bits above the lowest set bit
+    mask = 1
+    while mask < size:
+        if vrank & (mask - 1) == 0 and vrank | mask != vrank:
+            child = vrank | mask
+            if child < size:
+                ctx.send(obj, (child + root) % size, tag=tag,
+                         cid=COLL_CID)
+        mask <<= 1
+    return obj
+
+
+# ----------------------------------------------------------------- reduce
+
+
+def _reduce_linear(ctx, value, op, root, tag):
+    """In-order linear reduce: rank order is preserved exactly, so this is
+    the non-commutative path (the reference's in-order variants)."""
+    size, rank = ctx.size, ctx.rank
+    if rank != root:
+        ctx.send(value, root, tag=tag, cid=COLL_CID)
+        return None
+    acc = None
+    for r in range(size):
+        contrib = value if r == root else ctx.recv(r, tag=tag, cid=COLL_CID)
+        acc = contrib if acc is None else _ordered(op, acc, contrib)
+    return acc
+
+
+def reduce(ctx, value: Any, op, root: int = 0) -> Any:
+    """Reduce to root; binomial tree for commutative ops, in-order linear
+    otherwise.  Result significant at root (others return None)."""
+    size, rank = ctx.size, ctx.rank
+    if size == 1:
+        return value
+    tag = _next_tag(ctx, TAG_REDUCE)
+    if not getattr(op, "commute", True):
+        return _reduce_linear(ctx, value, op, root, tag)
+    vrank = (rank - root) % size
+    acc = value
+    mask = 1
+    while mask < size:
+        if vrank & mask:
+            parent = ((vrank & ~mask) + root) % size
+            ctx.send((vrank, acc), parent, tag=tag, cid=COLL_CID)
+            return None
+        child = vrank | mask
+        if child < size:
+            cvrank, contrib = ctx.recv(
+                (child + root) % size, tag=tag, cid=COLL_CID
+            )
+            # child subtree covers higher vranks: acc ⊕ contrib
+            acc = _ordered(op, acc, contrib)
+        mask <<= 1
+    return acc
+
+
+# -------------------------------------------------------------- allreduce
+
+
+def allreduce(ctx, value: Any, op) -> Any:
+    """Recursive-doubling allreduce with the non-power-of-two pre/post fold
+    (coll_base_allreduce.c:130-225 shape); in-order combines keep
+    non-commutative ops correct."""
+    size, rank = ctx.size, ctx.rank
+    if size == 1:
+        return value
+    tag = _next_tag(ctx, TAG_ALLREDUCE)
+    pof2 = 1
+    while pof2 * 2 <= size:
+        pof2 *= 2
+    rem = size - pof2
+    acc = value
+    # fold phase: the first 2*rem ranks pair up; odd member carries on
+    if rank < 2 * rem:
+        if rank % 2 == 0:
+            ctx.send(acc, rank + 1, tag=tag, cid=COLL_CID)
+            newrank = -1
+        else:
+            other = ctx.recv(rank - 1, tag=tag, cid=COLL_CID)
+            acc = _ordered(op, other, acc)  # lower rank's operand left
+            newrank = rank // 2
+    else:
+        newrank = rank - rem
+    if newrank >= 0:
+        mask = 1
+        while mask < pof2:
+            pnew = newrank ^ mask
+            partner = pnew * 2 + 1 if pnew < rem else pnew + rem
+            other = ctx.sendrecv(
+                acc, partner, source=partner,
+                sendtag=tag, recvtag=tag, cid=COLL_CID,
+            )
+            if partner < rank:
+                acc = _ordered(op, other, acc)
+            else:
+                acc = _ordered(op, acc, other)
+            mask <<= 1
+    # unfold: odd members hand the result back to their even partner
+    if rank < 2 * rem:
+        if rank % 2 == 0:
+            acc = ctx.recv(rank + 1, tag=tag, cid=COLL_CID)
+        else:
+            ctx.send(acc, rank - 1, tag=tag, cid=COLL_CID)
+    return acc
+
+
+# -------------------------------------------------------------- allgather
+
+
+def allgather(ctx, value: Any) -> list:
+    """Ring allgather (coll_base_allgather.c ring): p-1 steps, each rank
+    forwards the block it just received.  Returns the rank-indexed list."""
+    size, rank = ctx.size, ctx.rank
+    out: list = [None] * size
+    out[rank] = value
+    if size == 1:
+        return out
+    tag = _next_tag(ctx, TAG_ALLGATHER)
+    right = (rank + 1) % size
+    left = (rank - 1) % size
+    blk_idx, blk = rank, value
+    for _ in range(size - 1):
+        recv_idx, recv_blk = ctx.sendrecv(
+            (blk_idx, blk), right, source=left,
+            sendtag=tag, recvtag=tag, cid=COLL_CID,
+        )
+        out[recv_idx] = recv_blk
+        blk_idx, blk = recv_idx, recv_blk
+    return out
+
+
+# --------------------------------------------------------- gather/scatter
+
+
+def gather(ctx, value: Any, root: int = 0) -> list | None:
+    """Linear gather (coll_base_gather.c basic_linear): rank-indexed list
+    at root, None elsewhere."""
+    size, rank = ctx.size, ctx.rank
+    tag = _next_tag(ctx, TAG_GATHER)
+    if rank != root:
+        ctx.send(value, root, tag=tag, cid=COLL_CID)
+        return None
+    out = [None] * size
+    out[root] = value
+    for r in range(size):
+        if r != root:
+            out[r] = ctx.recv(r, tag=tag, cid=COLL_CID)
+    return out
+
+
+def scatter(ctx, values: list | None = None, root: int = 0) -> Any:
+    """Linear scatter from root; ``values`` (rank-indexed, significant at
+    root) must have one entry per rank.  Returns this rank's block."""
+    size, rank = ctx.size, ctx.rank
+    tag = _next_tag(ctx, TAG_SCATTER)
+    if rank == root:
+        if values is None or len(values) != size:
+            raise errors.ArgError(
+                f"scatter root needs {size} blocks, got "
+                f"{'None' if values is None else len(values)}"
+            )
+        for r in range(size):
+            if r != root:
+                ctx.send(values[r], r, tag=tag, cid=COLL_CID)
+        return values[root]
+    return ctx.recv(root, tag=tag, cid=COLL_CID)
+
+
+# --------------------------------------------------------------- alltoall
+
+
+def alltoall(ctx, values: list) -> list:
+    """Pairwise-exchange alltoall (coll_base_alltoall.c:383-444 shape):
+    p-1 rounds, round i exchanges with rank±i.  ``values`` is the
+    rank-indexed send list; returns the rank-indexed receive list."""
+    size, rank = ctx.size, ctx.rank
+    if len(values) != size:
+        raise errors.ArgError(f"alltoall needs {size} blocks")
+    tag = _next_tag(ctx, TAG_ALLTOALL)
+    out: list = [None] * size
+    out[rank] = values[rank]
+    for i in range(1, size):
+        sendto = (rank + i) % size
+        recvfrom = (rank - i) % size
+        out[recvfrom] = ctx.sendrecv(
+            values[sendto], sendto, source=recvfrom,
+            sendtag=tag, recvtag=tag, cid=COLL_CID,
+        )
+    return out
+
+
+# ------------------------------------------------------------ scan/exscan
+
+
+def scan(ctx, value: Any, op) -> Any:
+    """Inclusive prefix reduction, linear chain (coll_base_scan shape):
+    rank r returns buf_0 ⊕ ... ⊕ buf_r."""
+    rank = ctx.rank
+    tag = _next_tag(ctx, TAG_SCAN)
+    acc = value
+    if rank > 0:
+        prev = ctx.recv(rank - 1, tag=tag, cid=COLL_CID)
+        acc = _ordered(op, prev, acc)
+    if rank + 1 < ctx.size:
+        ctx.send(acc, rank + 1, tag=tag, cid=COLL_CID)
+    return acc
+
+
+def exscan(ctx, value: Any, op) -> Any:
+    """Exclusive prefix reduction: rank r returns buf_0 ⊕ ... ⊕ buf_{r-1};
+    rank 0's result is undefined (None)."""
+    rank = ctx.rank
+    tag = _next_tag(ctx, TAG_SCAN)
+    prev = None
+    if rank > 0:
+        prev = ctx.recv(rank - 1, tag=tag, cid=COLL_CID)
+    if rank + 1 < ctx.size:
+        mine = value if prev is None else _ordered(op, prev, value)
+        ctx.send(mine, rank + 1, tag=tag, cid=COLL_CID)
+    return prev
+
+
+# ---------------------------------------------------------- reduce_scatter
+
+
+def reduce_scatter(ctx, values: list, op) -> Any:
+    """Blockwise reduce + scatter (coll_base_reduce_scatter.c
+    non-overlapping shape): ``values`` is the rank-indexed list of blocks;
+    rank r returns the fully-reduced block r."""
+    size = ctx.size
+    if len(values) != size:
+        raise errors.ArgError(f"reduce_scatter needs {size} blocks")
+    reduced = reduce(ctx, values, op, root=0)
+    return scatter(ctx, reduced, root=0)
+
+
+class HostCollectives:
+    """Mixin giving any send/recv endpoint the collective API (the
+    mca_coll_base_comm_select analog for host endpoints: one composed
+    table, methods delegate to the module algorithms)."""
+
+    def bcast(self, obj: Any = None, root: int = 0) -> Any:
+        return bcast(self, obj, root)
+
+    def reduce(self, value: Any, op, root: int = 0) -> Any:
+        return reduce(self, value, op, root)
+
+    def allreduce(self, value: Any, op) -> Any:
+        return allreduce(self, value, op)
+
+    def allgather(self, value: Any) -> list:
+        return allgather(self, value)
+
+    def gather(self, value: Any, root: int = 0):
+        return gather(self, value, root)
+
+    def scatter(self, values: list | None = None, root: int = 0) -> Any:
+        return scatter(self, values, root)
+
+    def alltoall(self, values: list) -> list:
+        return alltoall(self, values)
+
+    def scan(self, value: Any, op) -> Any:
+        return scan(self, value, op)
+
+    def exscan(self, value: Any, op) -> Any:
+        return exscan(self, value, op)
+
+    def reduce_scatter(self, values: list, op) -> Any:
+        return reduce_scatter(self, values, op)

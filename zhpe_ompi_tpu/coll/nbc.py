@@ -1,0 +1,378 @@
+"""Nonblocking collectives: libnbc-style round schedules on the host plane.
+
+The reference ships a full nonblocking collective engine that compiles each
+collective into a *schedule* of rounds — every round a set of send/recv/op
+actions, progressed round-at-a-time by the request machinery
+(``ompi/mca/coll/libnbc/nbc.c:1-80``, ``nbc_internal.h``).  This module is
+that engine re-designed for Python: a schedule is a **generator** that
+yields one round's sub-requests at a time; :class:`SchedRequest` advances
+the generator whenever every yielded request has completed (the
+NBC_PROGRESS analog), and the generator's return value completes the
+collective's request.  The generator form subsumes libnbc's
+NBC_Sched_send/recv/op/copy/barrier primitives: sequential yields ARE the
+round barriers, and arbitrary Python between yields is the op/copy rounds.
+
+Device-plane nonblocking collectives are a platform non-problem by design:
+inside a jit trace every XLA collective is already asynchronous (the
+scheduler overlaps it with unrelated compute), and ``jax.Array`` IS the
+request handle — ``block_until_ready`` is Wait.  Documented in PARITY.md.
+
+All schedules run over the same endpoint surface as
+:mod:`zhpe_ompi_tpu.coll.host` (universe RankContext, TcpProc) and use its
+per-instance collective tags (:func:`~zhpe_ompi_tpu.coll.host._next_tag`):
+every collective — blocking or not — stamps its wire traffic with a
+sequence number that is identical on every rank (same program order) and
+unique per instance, so arbitrarily-overlapping schedules can never
+cross-match (libnbc's ``schedule->tag`` mechanism).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Generator
+
+from ..core import errors
+from ..pt2pt.requests import Request
+from . import host as H
+
+# Nonblocking barrier's base kind tag (blocking barrier has its own
+# reserved cid; the nonblocking one lives in the collective tag space).
+TAG_IBARRIER = 0x7E0A
+
+
+class SchedRequest(Request):
+    """A collective request driven by a round-schedule generator.
+
+    The generator yields lists of sub-requests (one list per round); it is
+    resumed with the list of their payloads once all complete.  Its return
+    value becomes this request's value.  Progress is weak (driven from
+    wait/test), like every request in this framework.
+    """
+
+    __slots__ = ("_gen", "_round", "_endpoint_progress")
+
+    def __init__(self, gen: Generator, endpoint_progress=None):
+        super().__init__(progress=self._advance)
+        self._gen = gen
+        self._round: list[Request] = []
+        self._endpoint_progress = endpoint_progress
+        self._kick()
+
+    def _kick(self) -> None:
+        """Start the schedule: run until the first yield (round 0 posted)."""
+        try:
+            self._round = list(next(self._gen))
+        except StopIteration as stop:
+            self.complete(stop.value)
+
+    def _advance(self) -> None:
+        """NBC_PROGRESS: if the current round is fully complete, feed the
+        results back and post the next round(s)."""
+        if self.done:
+            return
+        if self._endpoint_progress is not None:
+            self._endpoint_progress()
+        while not self.done and all(r.done for r in self._round):
+            values = [r._value for r in self._round]
+            try:
+                self._round = list(self._gen.send(values))
+            except StopIteration as stop:
+                self.complete(stop.value)
+
+
+def _start(ctx, gen) -> SchedRequest:
+    return SchedRequest(gen, endpoint_progress=getattr(ctx, "progress", None))
+
+
+# ---------------------------------------------------------------- ibarrier
+
+
+def ibarrier(ctx) -> SchedRequest:
+    """Nonblocking dissemination barrier (the shape of
+    coll_base_barrier.c's doubling, one yield per round)."""
+    def sched():
+        n, rank = ctx.size, ctx.rank
+        tag = H._next_tag(ctx, TAG_IBARRIER)
+        k = 1
+        while k < n:
+            rreq = ctx.irecv((rank - k) % n, tag=tag, cid=H.COLL_CID)
+            sreq = ctx.isend(b"", (rank + k) % n, tag=tag, cid=H.COLL_CID)
+            yield [rreq, sreq]
+            k <<= 1
+        return None
+
+    return _start(ctx, sched())
+
+
+# ------------------------------------------------------------------ ibcast
+
+
+def ibcast(ctx, obj: Any = None, root: int = 0) -> SchedRequest:
+    """Nonblocking binomial broadcast; request value is the payload."""
+    def sched():
+        size, rank = ctx.size, ctx.rank
+        payload = obj
+        if size > 1:
+            tag = H._next_tag(ctx, H.TAG_BCAST)
+            vrank = (rank - root) % size
+            if vrank != 0:
+                parent = ((vrank & (vrank - 1)) + root) % size
+                (payload,) = (yield [
+                    ctx.irecv(parent, tag=tag, cid=H.COLL_CID)
+                ])
+            sends = []
+            mask = 1
+            while mask < size:
+                if vrank & (mask - 1) == 0 and vrank | mask != vrank:
+                    child = vrank | mask
+                    if child < size:
+                        sends.append(ctx.isend(
+                            payload, (child + root) % size,
+                            tag=tag, cid=H.COLL_CID,
+                        ))
+                mask <<= 1
+            if sends:
+                yield sends
+        return payload
+
+    return _start(ctx, sched())
+
+
+# -------------------------------------------------------------- iallreduce
+
+
+def iallreduce(ctx, value: Any, op) -> SchedRequest:
+    """Nonblocking recursive-doubling allreduce with the non-power-of-two
+    fold — the same schedule as the blocking variant, one yield per
+    communication round."""
+    def sched():
+        size, rank = ctx.size, ctx.rank
+        acc = value
+        if size == 1:
+            return acc
+        tag = H._next_tag(ctx, H.TAG_ALLREDUCE)
+        pof2 = 1
+        while pof2 * 2 <= size:
+            pof2 *= 2
+        rem = size - pof2
+        if rank < 2 * rem:
+            if rank % 2 == 0:
+                yield [ctx.isend(acc, rank + 1, tag=tag,
+                                 cid=H.COLL_CID)]
+                newrank = -1
+            else:
+                (other,) = (yield [
+                    ctx.irecv(rank - 1, tag=tag, cid=H.COLL_CID)
+                ])
+                acc = H._ordered(op, other, acc)
+                newrank = rank // 2
+        else:
+            newrank = rank - rem
+        if newrank >= 0:
+            mask = 1
+            while mask < pof2:
+                pnew = newrank ^ mask
+                partner = pnew * 2 + 1 if pnew < rem else pnew + rem
+                rreq = ctx.irecv(partner, tag=tag,
+                                 cid=H.COLL_CID)
+                sreq = ctx.isend(acc, partner, tag=tag,
+                                 cid=H.COLL_CID)
+                other, _ = (yield [rreq, sreq])
+                if partner < rank:
+                    acc = H._ordered(op, other, acc)
+                else:
+                    acc = H._ordered(op, acc, other)
+                mask <<= 1
+        if rank < 2 * rem:
+            if rank % 2 == 0:
+                (acc,) = (yield [
+                    ctx.irecv(rank + 1, tag=tag, cid=H.COLL_CID)
+                ])
+            else:
+                yield [ctx.isend(acc, rank - 1, tag=tag,
+                                 cid=H.COLL_CID)]
+        return acc
+
+    return _start(ctx, sched())
+
+
+# -------------------------------------------------------------- iallgather
+
+
+def iallgather(ctx, value: Any) -> SchedRequest:
+    """Nonblocking ring allgather; request value is the rank-indexed list."""
+    def sched():
+        size, rank = ctx.size, ctx.rank
+        out: list = [None] * size
+        out[rank] = value
+        tag = H._next_tag(ctx, H.TAG_ALLGATHER)
+        right, left = (rank + 1) % size, (rank - 1) % size
+        blk = (rank, value)
+        for _ in range(size - 1):
+            rreq = ctx.irecv(left, tag=tag, cid=H.COLL_CID)
+            sreq = ctx.isend(blk, right, tag=tag, cid=H.COLL_CID)
+            got, _ = (yield [rreq, sreq])
+            out[got[0]] = got[1]
+            blk = got
+        return out
+
+    return _start(ctx, sched())
+
+
+# --------------------------------------------------------------- ialltoall
+
+
+def ialltoall(ctx, values: list) -> SchedRequest:
+    """Nonblocking pairwise-exchange alltoall; request value is the
+    rank-indexed receive list."""
+    if len(values) != ctx.size:
+        raise errors.ArgError(f"ialltoall needs {ctx.size} blocks")
+
+    def sched():
+        size, rank = ctx.size, ctx.rank
+        out: list = [None] * size
+        out[rank] = values[rank]
+        tag = H._next_tag(ctx, H.TAG_ALLTOALL)
+        for i in range(1, size):
+            sendto = (rank + i) % size
+            recvfrom = (rank - i) % size
+            rreq = ctx.irecv(recvfrom, tag=tag, cid=H.COLL_CID)
+            sreq = ctx.isend(values[sendto], sendto, tag=tag,
+                             cid=H.COLL_CID)
+            got, _ = (yield [rreq, sreq])
+            out[recvfrom] = got
+        return out
+
+    return _start(ctx, sched())
+
+
+# ----------------------------------------------------------------- ireduce
+
+
+def ireduce(ctx, value: Any, op, root: int = 0) -> SchedRequest:
+    """Nonblocking reduce (binomial for commutative ops, in-order linear
+    otherwise); request value significant at root."""
+    def sched_linear():
+        size, rank = ctx.size, ctx.rank
+        tag = H._next_tag(ctx, H.TAG_REDUCE)
+        if rank != root:
+            yield [ctx.isend(value, root, tag=tag, cid=H.COLL_CID)]
+            return None
+        acc = None
+        for r in range(size):
+            if r == root:
+                contrib = value
+            else:
+                (contrib,) = (yield [
+                    ctx.irecv(r, tag=tag, cid=H.COLL_CID)
+                ])
+            acc = contrib if acc is None else H._ordered(op, acc, contrib)
+        return acc
+
+    def sched_binomial():
+        size, rank = ctx.size, ctx.rank
+        tag = H._next_tag(ctx, H.TAG_REDUCE)
+        vrank = (rank - root) % size
+        acc = value
+        mask = 1
+        while mask < size:
+            if vrank & mask:
+                parent = ((vrank & ~mask) + root) % size
+                yield [ctx.isend((vrank, acc), parent, tag=tag,
+                                 cid=H.COLL_CID)]
+                return None
+            child = vrank | mask
+            if child < size:
+                (got,) = (yield [
+                    ctx.irecv((child + root) % size, tag=tag,
+                              cid=H.COLL_CID)
+                ])
+                acc = H._ordered(op, acc, got[1])
+            mask <<= 1
+        return acc
+
+    if ctx.size == 1:
+        def sched_one():
+            return value
+            yield  # pragma: no cover - makes this a generator
+
+        return _start(ctx, sched_one())
+    gen = (sched_linear() if not getattr(op, "commute", True)
+           else sched_binomial())
+    return _start(ctx, gen)
+
+
+# --------------------------------------------------------- igather/iscatter
+
+
+def igather(ctx, value: Any, root: int = 0) -> SchedRequest:
+    """Nonblocking linear gather; request value is the list at root."""
+    def sched():
+        size, rank = ctx.size, ctx.rank
+        tag = H._next_tag(ctx, H.TAG_GATHER)
+        if rank != root:
+            yield [ctx.isend(value, root, tag=tag, cid=H.COLL_CID)]
+            return None
+        out = [None] * size
+        out[root] = value
+        others = [r for r in range(size) if r != root]
+        reqs = [ctx.irecv(r, tag=tag, cid=H.COLL_CID)
+                for r in others]
+        vals = yield reqs
+        for r, v in zip(others, vals):
+            out[r] = v
+        return out
+
+    return _start(ctx, sched())
+
+
+def iscatter(ctx, values: list | None = None, root: int = 0) -> SchedRequest:
+    """Nonblocking linear scatter; request value is this rank's block."""
+    if ctx.rank == root and (values is None or len(values) != ctx.size):
+        raise errors.ArgError(
+            f"iscatter root needs {ctx.size} blocks, got "
+            f"{'None' if values is None else len(values)}"
+        )
+
+    def sched():
+        size, rank = ctx.size, ctx.rank
+        tag = H._next_tag(ctx, H.TAG_SCATTER)
+        if rank == root:
+            reqs = [ctx.isend(values[r], r, tag=tag, cid=H.COLL_CID)
+                    for r in range(size) if r != root]
+            if reqs:
+                yield reqs
+            return values[root]
+        (blk,) = (yield [ctx.irecv(root, tag=tag, cid=H.COLL_CID)])
+        return blk
+
+    return _start(ctx, sched())
+
+
+class NonblockingCollectives:
+    """Mixin: the MPI_Ix surface for host endpoints (pairs with
+    :class:`zhpe_ompi_tpu.coll.host.HostCollectives`)."""
+
+    def ibarrier(self) -> SchedRequest:
+        return ibarrier(self)
+
+    def ibcast(self, obj: Any = None, root: int = 0) -> SchedRequest:
+        return ibcast(self, obj, root)
+
+    def iallreduce(self, value: Any, op) -> SchedRequest:
+        return iallreduce(self, value, op)
+
+    def iallgather(self, value: Any) -> SchedRequest:
+        return iallgather(self, value)
+
+    def ialltoall(self, values: list) -> SchedRequest:
+        return ialltoall(self, values)
+
+    def ireduce(self, value: Any, op, root: int = 0) -> SchedRequest:
+        return ireduce(self, value, op, root)
+
+    def igather(self, value: Any, root: int = 0) -> SchedRequest:
+        return igather(self, value, root)
+
+    def iscatter(self, values: list | None = None, root: int = 0
+                 ) -> SchedRequest:
+        return iscatter(self, values, root)

@@ -35,6 +35,8 @@ import struct
 import threading
 from typing import Any
 
+from ..coll.host import HostCollectives
+from ..coll.nbc import NonblockingCollectives
 from ..core import errors
 from ..mca import output as mca_output
 from ..runtime import spc
@@ -69,8 +71,12 @@ def _recv_frame(sock: socket.socket) -> bytes | None:
     return _recv_exact(sock, length)
 
 
-class TcpProc:
+class TcpProc(HostCollectives, NonblockingCollectives):
     """One process's endpoint in a TCP universe of `size` ranks.
+    Collectives come from :class:`~zhpe_ompi_tpu.coll.host.HostCollectives`
+    and :class:`~zhpe_ompi_tpu.coll.nbc.NonblockingCollectives`, so
+    socket-connected (DCN) ranks bcast/allreduce/iallreduce exactly like
+    thread ranks — the coll-rides-the-PML layering of the reference.
 
     Construction is collective: every rank calls with the same coordinator
     address; rank 0 must also pass ``is_coordinator=True`` (it binds the

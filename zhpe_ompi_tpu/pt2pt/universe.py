@@ -28,6 +28,8 @@ from typing import Any, Callable
 
 import numpy as np
 
+from ..coll.host import HostCollectives
+from ..coll.nbc import NonblockingCollectives
 from ..core import errors
 from ..mca import var as mca_var
 from ..runtime import spc
@@ -120,8 +122,12 @@ class PersistentRequest:
         return flag, value
 
 
-class RankContext:
-    """One rank's endpoint: the MPI API surface of the host plane."""
+class RankContext(HostCollectives, NonblockingCollectives):
+    """One rank's endpoint: the MPI API surface of the host plane.
+    Collectives come from :class:`~zhpe_ompi_tpu.coll.host.HostCollectives`
+    (blocking) and :class:`~zhpe_ompi_tpu.coll.nbc.NonblockingCollectives`
+    (MPI_Ix round schedules) — written over send/recv, the way the
+    reference's coll_base and libnbc ride the PML."""
 
     def __init__(self, universe: "LocalUniverse", rank: int):
         self.universe = universe
