@@ -1,0 +1,224 @@
+"""Wire-plane dynamic process management: connect/accept between
+independent TcpProc groups, REAL multi-process spawn, and intercomm
+collectives across the bridge (rounds out VERDICT items 1, 2, 7)."""
+
+import threading
+
+import numpy as np
+
+from test_tcp import run_tcp
+from zhpe_ompi_tpu import ops as zops
+from zhpe_ompi_tpu.coll.inter import PROC_NULL, ROOT
+from zhpe_ompi_tpu.comm import dpm_wire
+from zhpe_ompi_tpu.pt2pt.tcp import TcpProc
+
+
+def run_two_groups(na, nb, fa, fb, timeout=60.0):
+    """Launch two independent TcpProc groups in threads; group A rank 0
+    opens a port whose name group B uses to connect."""
+    port = dpm_wire.open_port()
+    results = {"a": [None] * na, "b": [None] * nb}
+    excs = []
+
+    def make_group(n, fn, tagname, store):
+        coord_ready = threading.Event()
+        coord_addr = [None]
+
+        def publish(addr):
+            coord_addr[0] = addr
+            coord_ready.set()
+
+        def main(rank):
+            try:
+                if rank == 0:
+                    proc = TcpProc(0, n, coordinator=("127.0.0.1", 0),
+                                   on_coordinator_bound=publish)
+                else:
+                    coord_ready.wait(10)
+                    proc = TcpProc(rank, n, coordinator=coord_addr[0])
+                try:
+                    store[rank] = fn(proc)
+                finally:
+                    proc.close()
+            except BaseException as e:  # noqa: BLE001
+                excs.append(e)
+                coord_ready.set()
+
+        return [threading.Thread(target=main, args=(r,)) for r in range(n)]
+
+    threads = (make_group(na, lambda p: fa(p, port), "a", results["a"])
+               + make_group(nb, lambda p: fb(p, port.name), "b",
+                            results["b"]))
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout)
+        assert not t.is_alive(), "dpm group rank hung"
+    port.close()
+    if excs:
+        raise excs[0]
+    return results
+
+
+class TestConnectAccept:
+    def test_bridge_pt2pt(self):
+        """Ranks of two independent groups exchange across the bridge."""
+
+        def side_a(p, port):
+            ic = dpm_wire.accept(port if p.rank == 0 else None, p)
+            ic.send(("from-a", p.rank), dest=p.rank, tag=3)
+            got = ic.recv(source=p.rank, tag=4)
+            ic.barrier()
+            return got
+
+        def side_b(p, name):
+            ic = dpm_wire.connect(name, p)
+            got = ic.recv(source=p.rank, tag=3)
+            ic.send(("from-b", p.rank), dest=p.rank, tag=4)
+            ic.barrier()
+            return got
+
+        res = run_two_groups(2, 2, side_a, side_b)
+        assert res["a"] == [("from-b", 0), ("from-b", 1)]
+        assert res["b"] == [("from-a", 0), ("from-a", 1)]
+
+    def test_asymmetric_group_sizes(self):
+        def side_a(p, port):
+            ic = dpm_wire.accept(port if p.rank == 0 else None, p)
+            assert ic.remote_size == 3
+            # gather one value from every remote rank
+            vals = sorted(ic.recv(source=r, tag=9) for r in range(3))
+            ic.barrier()
+            return vals
+
+        def side_b(p, name):
+            ic = dpm_wire.connect(name, p)
+            assert ic.remote_size == 1
+            ic.send(p.rank * 5, dest=0, tag=9)
+            ic.barrier()
+            return True
+
+        res = run_two_groups(1, 3, side_a, side_b)
+        assert res["a"][0] == [0, 5, 10]
+
+
+class TestIntercommCollectives:
+    def test_bcast_allreduce_allgather_barrier(self):
+        """The VERDICT item-2 acceptance set, over a wire bridge."""
+
+        def side_a(p, port):
+            ic = dpm_wire.accept(port if p.rank == 0 else None, p)
+            # bcast rooted in group A rank 1
+            root = ROOT if p.rank == 1 else PROC_NULL
+            ic.bcast({"cfg": 42} if p.rank == 1 else None, root=root)
+            # allreduce: we receive the REMOTE group's sum
+            their_sum = ic.allreduce(p.rank + 1, zops.SUM)
+            # allgather: remote group's values
+            theirs = ic.allgather(f"a{p.rank}")
+            ic.barrier()
+            return (their_sum, theirs)
+
+        def side_b(p, name):
+            ic = dpm_wire.connect(name, p)
+            got = ic.bcast(None, root=1)  # root is rank 1 of remote group
+            their_sum = ic.allreduce(10 * (p.rank + 1), zops.SUM)
+            theirs = ic.allgather(f"b{p.rank}")
+            ic.barrier()
+            return (got, their_sum, theirs)
+
+        res = run_two_groups(2, 3, side_a, side_b)
+        # A received B's sum: 10+20+30
+        for r in range(2):
+            assert res["a"][r] == (60, ["b0", "b1", "b2"])
+        for r in range(3):
+            assert res["b"][r] == ({"cfg": 42}, 1 + 2, ["a0", "a1"])
+
+    def test_rooted_reduce_gather_scatter(self):
+        def side_a(p, port):
+            ic = dpm_wire.accept(port if p.rank == 0 else None, p)
+            root = ROOT if p.rank == 0 else PROC_NULL
+            red = ic.reduce(None, zops.MAX, root=root)
+            gat = ic.gather(root=root)
+            ic.scatter([100, 200] if p.rank == 0 else None,
+                       root=ROOT if p.rank == 0 else PROC_NULL)
+            ic.barrier()
+            return (red, gat)
+
+        def side_b(p, name):
+            ic = dpm_wire.connect(name, p)
+            ic.reduce((p.rank + 1) * 7, zops.MAX, root=0)
+            ic.gather(f"v{p.rank}", root=0)
+            block = ic.scatter(root=0)
+            ic.barrier()
+            return block
+
+        res = run_two_groups(1, 2, side_a, side_b)
+        assert res["a"][0] == (14, ["v0", "v1"])
+        assert res["b"] == [100, 200]
+
+
+class TestThreadIntercommCollectives:
+    def test_spawn_collectives(self):
+        """Thread-plane dpm spawn: the same collective set crosses the
+        parent/child bridge (VERDICT item-2 done criterion)."""
+        from zhpe_ompi_tpu.comm import dpm
+        from zhpe_ompi_tpu.pt2pt.universe import LocalUniverse
+
+        uni = LocalUniverse(2)
+
+        def child_main(ctx):
+            parent = dpm.get_parent(ctx)
+            got = parent.bcast(None, root=0)
+            s = parent.allreduce((ctx.rank + 1) * 10, zops.SUM)
+            vals = parent.allgather(f"c{ctx.rank}")
+            parent.barrier()
+            return (got, s, vals)
+
+        def main(ctx):
+            ic, handle = dpm.spawn(uni, ctx, child_main, n_children=3)
+            root = ROOT if ctx.rank == 0 else PROC_NULL
+            ic.bcast("hello" if ctx.rank == 0 else None, root=root)
+            s = ic.allreduce(ctx.rank + 1, zops.SUM)
+            vals = ic.allgather(f"p{ctx.rank}")
+            ic.barrier()
+            child_results = handle.join() if ctx.rank == 0 else None
+            return (s, vals, child_results)
+
+        res = uni.run(main)
+        for r in range(2):
+            assert res[r][0] == 10 + 20 + 30  # children's sum
+            assert res[r][1] == ["c0", "c1", "c2"]
+        for got, s, vals in res[0][2]:
+            assert got == "hello"
+            assert s == 1 + 2
+            assert vals == ["p0", "p1"]
+
+
+class TestProcessSpawn:
+    def test_real_process_spawn(self):
+        """MPI_Comm_spawn over genuine OS processes: children live in
+        their own interpreters, wire into their own universe, and speak
+        to the parent over the intercomm (VERDICT Missing #7)."""
+
+        def child(proc, parent):
+            # child group works internally, then reports to the parent
+            total = proc.allreduce(proc.rank + 1, zops.SUM)
+            got = parent.bcast(None, root=0)
+            parent.send((proc.rank, total, got), dest=0, tag=11)
+            parent.barrier()
+
+        def main(p):
+            ic, handle = dpm_wire.spawn(p, child, n_children=2)
+            root = ROOT if p.rank == 0 else PROC_NULL
+            ic.bcast("cfg" if p.rank == 0 else None, root=root)
+            reports = None
+            if p.rank == 0:
+                reports = sorted(ic.recv(source=r, tag=11)
+                                 for r in range(2))
+            ic.barrier()
+            if p.rank == 0:
+                handle.join()
+            return reports
+
+        res = run_tcp(2, main, timeout=90.0)
+        assert res[0] == [(0, 3, "cfg"), (1, 3, "cfg")]

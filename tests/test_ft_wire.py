@@ -1,0 +1,68 @@
+"""Fault tolerance over the wire plane: bookmark quiescence and
+pessimistic logging/replay with per-process state over real sockets
+(round-3 unweld — no shared matrix or log registry)."""
+
+from test_tcp import run_tcp
+from zhpe_ompi_tpu.ft.crcp import DistributedBookmarks
+from zhpe_ompi_tpu.ft.vprotocol import ProcessLogger
+
+N = 4
+
+
+class TestWireBookmarks:
+    def test_quiescent_after_drain(self):
+        def prog(p):
+            bk = DistributedBookmarks(p)
+            ctx = bk.wrap()
+            right, left = (p.rank + 1) % N, (p.rank - 1) % N
+            ctx.send({"hop": p.rank}, dest=right, tag=1)
+            got = ctx.recv(source=left, tag=1)
+            assert got["hop"] == left
+            return bk.quiescent()
+
+        assert run_tcp(N, prog) == [True] * N
+
+    def test_in_flight_detected(self):
+        """An unreceived message must show as in flight on every rank's
+        collective view, then clear once drained."""
+
+        def prog(p):
+            bk = DistributedBookmarks(p)
+            ctx = bk.wrap()
+            if p.rank == 0:
+                ctx.send(b"pending", dest=1, tag=2)
+            before = bk.in_flight()          # collective: 0->1 is 1
+            pending = int(before[0, 1])
+            if p.rank == 1:
+                ctx.recv(source=0, tag=2)
+            after_quiescent = bk.quiescent()  # collective: drained
+            return (pending, after_quiescent)
+
+        res = run_tcp(2, prog)
+        assert res == [(1, True), (1, True)]
+
+
+class TestWireLogging:
+    def test_log_and_replay(self):
+        """Each process logs its own rank's traffic; a replay context
+        reproduces the received values deterministically."""
+
+        def prog(p):
+            logger = ProcessLogger(p)
+            ctx = logger.wrap()
+            right, left = (p.rank + 1) % N, (p.rank - 1) % N
+            ctx.send(p.rank * 100, dest=right, tag=5)
+            got = ctx.recv(source=left, tag=5)
+            ctx.barrier()
+            # simulate restart: replay this rank against its own log
+            rp = logger.replay_context()
+            rp.send(p.rank * 100, dest=right, tag=5)
+            replayed = rp.recv(source=left, tag=5)
+            return (got, replayed, rp.fully_replayed,
+                    logger.event_counts())
+
+        res = run_tcp(N, prog)
+        for r in range(N):
+            got, replayed, done, counts = res[r]
+            assert got == replayed == ((r - 1) % N) * 100
+            assert done and counts == (1, 1)

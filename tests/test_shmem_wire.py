@@ -1,0 +1,279 @@
+"""OpenSHMEM over the wire plane: the PGAS surface re-run against the AM
+backend over N real socket procs (round-3 unweld proof — a DCN job gets
+symmetric-heap put/get/AMOs/locks/collectives without a shared address
+space)."""
+
+import numpy as np
+import pytest
+
+from test_tcp import run_tcp
+from zhpe_ompi_tpu.core import errors
+from zhpe_ompi_tpu.shmem.api import shmem_wire_pe
+
+N = 4
+
+
+def run_shmem(n, fn, heap_bytes=1 << 16, timeout=60.0):
+    """Launch n wire PEs and run fn(pe) on each."""
+
+    def main(p):
+        pe = shmem_wire_pe(p, heap_bytes)
+        return fn(pe)
+
+    return run_tcp(n, main, timeout=timeout)
+
+
+class TestWireShmem:
+    def test_circular_shift(self):
+        def prog(pe):
+            me, n = pe.my_pe(), pe.n_pes()
+            sym = pe.shmalloc(4, np.float64)
+            pe.local(sym)[...] = me
+            pe.barrier_all()
+            pe.put(sym, np.full(4, float(me)), (me + 1) % n)
+            pe.barrier_all()
+            got = pe.local(sym).copy()
+            pe.barrier_all()
+            pe.shfree(sym)
+            return got.tolist()
+
+        res = run_shmem(N, prog)
+        for r in range(N):
+            assert res[r] == [float((r - 1) % N)] * 4
+
+    def test_symmetric_offsets_agree(self):
+        """Lockstep allocators must produce identical offsets on every PE."""
+
+        def prog(pe):
+            a = pe.shmalloc(8, np.float32)
+            b = pe.shmalloc(3, np.int64)
+            offs = (a.offset, b.offset)
+            pe.barrier_all()
+            pe.shfree(b)
+            pe.shfree(a)
+            return offs
+
+        res = run_shmem(N, prog)
+        assert all(r == res[0] for r in res)
+
+    def test_p_g_single_element(self):
+        def prog(pe):
+            me, n = pe.my_pe(), pe.n_pes()
+            sym = pe.shmalloc(8, np.int64)
+            pe.local(sym)[...] = 0
+            pe.barrier_all()
+            pe.p(sym, me + 100, (me + 1) % n, index=me)
+            pe.barrier_all()
+            # read back what our left neighbor wrote into our slot
+            left = (me - 1) % n
+            val = int(pe.g(sym, me, index=left))
+            pe.barrier_all()
+            pe.shfree(sym)
+            return val
+
+        res = run_shmem(N, prog)
+        assert res == [((r - 1) % N) + 100 for r in range(N)]
+
+    def test_strided_iput_iget(self):
+        def prog(pe):
+            me, n = pe.my_pe(), pe.n_pes()
+            sym = pe.shmalloc(16, np.float64)
+            pe.local(sym)[...] = -1.0
+            pe.barrier_all()
+            # every PE writes [me, me, me, me] at stride 4 into neighbor
+            pe.iput(sym, np.full(4, float(me)), (me + 1) % n, tst=4, sst=1)
+            pe.quiet()
+            pe.barrier_all()
+            local = pe.local(sym).copy()
+            # strided fetch of our own neighbor's instance
+            got = pe.iget(sym, (me + 1) % n, n=4, sst=4)
+            pe.barrier_all()
+            pe.shfree(sym)
+            return (local[::4].tolist(), got.tolist())
+
+        res = run_shmem(N, prog)
+        for r in range(N):
+            left = float((r - 1) % N)
+            assert res[r][0] == [left] * 4
+            assert res[r][1] == [float(r)] * 4
+
+    def test_fetch_add_all_pes(self):
+        def prog(pe):
+            sym = pe.shmalloc(1, np.int64)
+            pe.local(sym)[...] = 0
+            pe.barrier_all()
+            old = int(pe.atomic_fetch_add(sym, 1, 0))
+            pe.barrier_all()
+            total = int(pe.local(sym)[0]) if pe.my_pe() == 0 else None
+            pe.barrier_all()
+            pe.shfree(sym)
+            return (old, total)
+
+        res = run_shmem(N, prog)
+        assert sorted(o for o, _ in res) == list(range(N))
+        assert res[0][1] == N
+
+    def test_compare_swap(self):
+        def prog(pe):
+            sym = pe.shmalloc(1, np.int64)
+            pe.local(sym)[...] = 0
+            pe.barrier_all()
+            old = int(pe.atomic_compare_swap(
+                sym, cond=0, value=pe.my_pe() + 1, pe=0
+            ))
+            pe.barrier_all()
+            winner = int(pe.local(sym)[0]) if pe.my_pe() == 0 else None
+            pe.barrier_all()
+            pe.shfree(sym)
+            return (old, winner)
+
+        res = run_shmem(N, prog)
+        assert [o for o, _ in res].count(0) == 1
+        assert res[0][1] in range(1, N + 1)
+
+    def test_swap_set_fetch(self):
+        def prog(pe):
+            me = pe.my_pe()
+            sym = pe.shmalloc(N, np.int64)
+            pe.local(sym)[...] = 0
+            pe.barrier_all()
+            pe.atomic_set(sym, me * 10, 0, index=me)
+            pe.barrier_all()
+            seen = int(pe.atomic_fetch(sym, 0, index=me))
+            old = int(pe.atomic_swap(sym, -1, 0, index=me))
+            pe.barrier_all()
+            pe.shfree(sym)
+            return (seen, old)
+
+        res = run_shmem(N, prog)
+        assert res == [(r * 10, r * 10) for r in range(N)]
+
+    def test_wait_until(self):
+        def prog(pe):
+            me, n = pe.my_pe(), pe.n_pes()
+            sym = pe.shmalloc(1, np.int64)
+            pe.local(sym)[...] = 0
+            pe.barrier_all()
+            if me == 0:
+                for r in range(1, n):
+                    pe.p(sym, 7, r, index=0)
+                pe.quiet()
+                pe.barrier_all()
+                return 7
+            pe.wait_until(sym, "eq", 7, timeout=15.0)
+            got = int(pe.local(sym)[0])
+            pe.barrier_all()
+            return got
+
+        assert run_shmem(N, prog) == [7] * N
+
+    def test_lock_mutual_exclusion(self):
+        """shmem_set_lock over the wire: unlocked read-modify-write would
+        lose updates; the home-PE lock manager must serialize them."""
+
+        def prog(pe):
+            lock = pe.shmalloc(1, np.int64)
+            ctr = pe.shmalloc(1, np.int64)
+            pe.local(ctr)[...] = 0
+            pe.barrier_all()
+            for _ in range(10):
+                pe.set_lock(lock)
+                v = int(pe.g(ctr, 0, index=0))
+                pe.p(ctr, v + 1, 0, index=0)
+                pe.quiet()
+                pe.clear_lock(lock)
+            pe.barrier_all()
+            out = int(pe.local(ctr)[0]) if pe.my_pe() == 0 else None
+            pe.barrier_all()
+            pe.shfree(ctr)
+            pe.shfree(lock)
+            return out
+
+        assert run_shmem(N, prog)[0] == 10 * N
+
+    def test_test_lock(self):
+        def prog(pe):
+            lock = pe.shmalloc(1, np.int64)
+            pe.barrier_all()
+            if pe.my_pe() == 0:
+                assert pe.test_lock(lock) is True
+                pe.barrier_all()  # rank 1 tries while we hold it
+                pe.barrier_all()
+                pe.clear_lock(lock)
+                pe.barrier_all()
+                pe.shfree(lock)
+                return True
+            if pe.my_pe() == 1:
+                pe.barrier_all()
+                got = pe.test_lock(lock)
+                pe.barrier_all()
+                pe.barrier_all()
+                pe.shfree(lock)
+                return got
+            pe.barrier_all()
+            pe.barrier_all()
+            pe.barrier_all()
+            pe.shfree(lock)
+            return None
+
+        assert run_shmem(3, prog)[1] is False
+
+    def test_broadcast_and_reductions(self):
+        def prog(pe):
+            me, n = pe.my_pe(), pe.n_pes()
+            src = pe.shmalloc(4, np.float64)
+            dst = pe.shmalloc(4, np.float64)
+            pe.local(src)[...] = float(me + 1)
+            pe.barrier_all()
+            pe.sum_to_all(dst, src)
+            total = pe.local(dst).copy()
+            pe.local(src)[...] = float(me)
+            pe.broadcast(src, root=2)
+            bcast = pe.local(src).copy()
+            pe.barrier_all()
+            pe.shfree(dst)
+            pe.shfree(src)
+            return (total.tolist(), bcast.tolist())
+
+        res = run_shmem(N, prog)
+        expect_sum = [float(sum(range(1, N + 1)))] * 4
+        for r in range(N):
+            assert res[r][0] == expect_sum
+            assert res[r][1] == [2.0] * 4
+
+    def test_fcollect_alltoall(self):
+        def prog(pe):
+            me, n = pe.my_pe(), pe.n_pes()
+            src = pe.shmalloc(2, np.int64)
+            dst = pe.shmalloc(2 * n, np.int64)
+            pe.local(src)[...] = [me * 2, me * 2 + 1]
+            pe.barrier_all()
+            pe.fcollect(dst, src)
+            coll = pe.local(dst).copy()
+            a2a_src = pe.shmalloc(n, np.int64)
+            a2a_dst = pe.shmalloc(n, np.int64)
+            pe.local(a2a_src)[...] = [me * 10 + i for i in range(n)]
+            pe.barrier_all()
+            pe.alltoall(a2a_dst, a2a_src)
+            a2a = pe.local(a2a_dst).copy()
+            pe.barrier_all()
+            for s in (a2a_dst, a2a_src, dst, src):
+                pe.shfree(s)
+            return (coll.tolist(), a2a.tolist())
+
+        res = run_shmem(N, prog)
+        for r in range(N):
+            assert res[r][0] == list(range(2 * N))
+            assert res[r][1] == [i * 10 + r for i in range(N)]
+
+    def test_exhaustion_raises_on_every_pe(self):
+        def prog(pe):
+            got_err = False
+            try:
+                pe.shmalloc(1 << 22, np.uint8)  # bigger than the heap
+            except errors.MpiError:
+                got_err = True
+            pe.barrier_all()
+            return got_err
+
+        assert run_shmem(2, prog, heap_bytes=1 << 12) == [True, True]

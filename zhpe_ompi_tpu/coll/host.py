@@ -46,6 +46,11 @@ TAG_SCATTER = 0x7E06
 TAG_ALLTOALL = 0x7E07
 TAG_SCAN = 0x7E08
 TAG_RSCAT = 0x7E09
+TAG_GATHERV = 0x7E0B
+TAG_SCATTERV = 0x7E0C
+TAG_ALLGATHERV = 0x7E0D
+TAG_ALLTOALLV = 0x7E0E
+TAG_NEIGHBOR = 0x7E0F
 
 
 def _next_tag(ctx, base: int) -> int:
@@ -290,6 +295,112 @@ def alltoall(ctx, values: list) -> list:
     return out
 
 
+# ------------------------------------------------------- v-variants
+# Variable-count collectives (coll_base_allgatherv.c:93,
+# coll_base_alltoallv.c:125 shapes).  The host plane carries arbitrary
+# objects, so blocks may differ per rank freely; the *v surface exists so
+# MPI-shaped programs (flat buffer + counts/displacements) port directly.
+
+
+def _displs_from(counts):
+    out, acc = [], 0
+    for c in counts:
+        out.append(acc)
+        acc += c
+    return out
+
+
+def _blocks_from(sendbuf, counts, displs, size):
+    """Slice a flat buffer into per-rank blocks by (counts, displs) — the
+    shared *v decomposition (displacements default to the running sum)."""
+    if len(counts) != size:
+        raise errors.ArgError(f"v-collective needs {size} counts")
+    displs = _displs_from(counts) if displs is None else displs
+    if len(displs) != size:
+        raise errors.ArgError(f"v-collective needs {size} displacements")
+    return [sendbuf[displs[r] : displs[r] + counts[r]] for r in range(size)]
+
+
+def gatherv(ctx, value: Any, root: int = 0) -> list | None:
+    """Linear gatherv: per-rank variable-size blocks, rank-indexed list at
+    root (object payloads carry their own size — MPI's recvcounts are
+    implicit)."""
+    size, rank = ctx.size, ctx.rank
+    tag = _next_tag(ctx, TAG_GATHERV)
+    if rank != root:
+        ctx.send(value, root, tag=tag, cid=COLL_CID)
+        return None
+    out = [None] * size
+    out[root] = value
+    for r in range(size):
+        if r != root:
+            out[r] = ctx.recv(r, tag=tag, cid=COLL_CID)
+    return out
+
+
+def scatterv(ctx, sendbuf=None, counts: list | None = None,
+             displs: list | None = None, root: int = 0):
+    """Linear scatterv: root slices a flat buffer by (counts, displs) —
+    the MPI signature — and ships each rank its block."""
+    size, rank = ctx.size, ctx.rank
+    tag = _next_tag(ctx, TAG_SCATTERV)
+    if rank == root:
+        if sendbuf is None or counts is None:
+            raise errors.ArgError(
+                f"scatterv root needs a buffer and {size} counts"
+            )
+        blocks = _blocks_from(sendbuf, counts, displs, size)
+        for r in range(size):
+            if r != root:
+                ctx.send(blocks[r], r, tag=tag, cid=COLL_CID)
+        return blocks[root]
+    return ctx.recv(root, tag=tag, cid=COLL_CID)
+
+
+def allgatherv(ctx, value: Any) -> list:
+    """Ring allgatherv (coll_base_allgatherv.c ring): identical schedule
+    to allgather — blocks ride with their sizes, so no recvcounts
+    negotiation round is needed."""
+    size, rank = ctx.size, ctx.rank
+    out: list = [None] * size
+    out[rank] = value
+    if size == 1:
+        return out
+    tag = _next_tag(ctx, TAG_ALLGATHERV)
+    right = (rank + 1) % size
+    left = (rank - 1) % size
+    blk_idx, blk = rank, value
+    for _ in range(size - 1):
+        recv_idx, recv_blk = ctx.sendrecv(
+            (blk_idx, blk), right, source=left,
+            sendtag=tag, recvtag=tag, cid=COLL_CID,
+        )
+        out[recv_idx] = recv_blk
+        blk_idx, blk = recv_idx, recv_blk
+    return out
+
+
+def alltoallv(ctx, sendbuf, counts: list, displs: list | None = None
+              ) -> list:
+    """Pairwise-exchange alltoallv (coll_base_alltoallv.c:125 shape):
+    `sendbuf` is flat, `counts[r]` elements go to rank r (displacements
+    default to the running sum).  Returns the rank-indexed list of
+    received blocks."""
+    size, rank = ctx.size, ctx.rank
+    blocks = _blocks_from(sendbuf, counts, displs, size)
+    tag = _next_tag(ctx, TAG_ALLTOALLV)
+    out: list = [None] * size
+    out[rank] = blocks[rank]
+    for i in range(1, size):
+        sendto = (rank + i) % size
+        recvfrom = (rank - i) % size
+        out[recvfrom] = ctx.sendrecv(
+            blocks[sendto], sendto, source=recvfrom,
+            sendtag=tag, recvtag=tag, cid=COLL_CID,
+        )
+    return out
+
+
 # ------------------------------------------------------------ scan/exscan
 
 
@@ -369,3 +480,17 @@ class HostCollectives:
 
     def reduce_scatter(self, values: list, op) -> Any:
         return reduce_scatter(self, values, op)
+
+    def gatherv(self, value: Any, root: int = 0):
+        return gatherv(self, value, root)
+
+    def scatterv(self, sendbuf=None, counts: list | None = None,
+                 displs: list | None = None, root: int = 0):
+        return scatterv(self, sendbuf, counts, displs, root)
+
+    def allgatherv(self, value: Any) -> list:
+        return allgatherv(self, value)
+
+    def alltoallv(self, sendbuf, counts: list,
+                  displs: list | None = None) -> list:
+        return alltoallv(self, sendbuf, counts, displs)

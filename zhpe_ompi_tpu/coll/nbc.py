@@ -348,6 +348,236 @@ def iscatter(ctx, values: list | None = None, root: int = 0) -> SchedRequest:
     return _start(ctx, sched())
 
 
+# ------------------------------------------------- v-variant schedules
+# (libnbc's nbc_iallgatherv.c / nbc_ialltoallv.c set — round-3 fill-in)
+
+
+def iallgatherv(ctx, value: Any) -> SchedRequest:
+    """Nonblocking ring allgatherv: blocks carry their sizes, so the
+    schedule is the allgather ring verbatim (nbc_iallgatherv.c shape)."""
+    def sched():
+        size, rank = ctx.size, ctx.rank
+        out: list = [None] * size
+        out[rank] = value
+        if size == 1:
+            return out
+        tag = H._next_tag(ctx, H.TAG_ALLGATHERV)
+        right, left = (rank + 1) % size, (rank - 1) % size
+        blk = (rank, value)
+        for _ in range(size - 1):
+            rreq = ctx.irecv(left, tag=tag, cid=H.COLL_CID)
+            sreq = ctx.isend(blk, right, tag=tag, cid=H.COLL_CID)
+            got, _ = (yield [rreq, sreq])
+            out[got[0]] = got[1]
+            blk = got
+        return out
+
+    return _start(ctx, sched())
+
+
+def ialltoallv(ctx, sendbuf, counts: list, displs: list | None = None
+               ) -> SchedRequest:
+    """Nonblocking pairwise alltoallv over a flat buffer + counts
+    (nbc_ialltoallv.c shape); request value is the rank-indexed recv
+    list."""
+    blocks = H._blocks_from(sendbuf, counts, displs, ctx.size)
+
+    def sched():
+        size, rank = ctx.size, ctx.rank
+        out: list = [None] * size
+        out[rank] = blocks[rank]
+        tag = H._next_tag(ctx, H.TAG_ALLTOALLV)
+        for i in range(1, size):
+            sendto = (rank + i) % size
+            recvfrom = (rank - i) % size
+            rreq = ctx.irecv(recvfrom, tag=tag, cid=H.COLL_CID)
+            sreq = ctx.isend(blocks[sendto], sendto, tag=tag,
+                             cid=H.COLL_CID)
+            got, _ = (yield [rreq, sreq])
+            out[recvfrom] = got
+        return out
+
+    return _start(ctx, sched())
+
+
+def igatherv(ctx, value: Any, root: int = 0) -> SchedRequest:
+    """Nonblocking linear gatherv (variable-size blocks)."""
+    def sched():
+        size, rank = ctx.size, ctx.rank
+        tag = H._next_tag(ctx, H.TAG_GATHERV)
+        if rank != root:
+            yield [ctx.isend(value, root, tag=tag, cid=H.COLL_CID)]
+            return None
+        out = [None] * size
+        out[root] = value
+        others = [r for r in range(size) if r != root]
+        vals = yield [ctx.irecv(r, tag=tag, cid=H.COLL_CID)
+                      for r in others]
+        for r, v in zip(others, vals):
+            out[r] = v
+        return out
+
+    return _start(ctx, sched())
+
+
+def iscatterv(ctx, sendbuf=None, counts: list | None = None,
+              displs: list | None = None, root: int = 0) -> SchedRequest:
+    """Nonblocking linear scatterv (flat buffer + counts at root)."""
+    if ctx.rank == root:
+        if sendbuf is None or counts is None:
+            raise errors.ArgError(
+                f"iscatterv root needs a buffer and {ctx.size} counts"
+            )
+        blocks = H._blocks_from(sendbuf, counts, displs, ctx.size)
+
+    def sched():
+        size, rank = ctx.size, ctx.rank
+        tag = H._next_tag(ctx, H.TAG_SCATTERV)
+        if rank == root:
+            reqs = [ctx.isend(blocks[r], r, tag=tag, cid=H.COLL_CID)
+                    for r in range(size) if r != root]
+            if reqs:
+                yield reqs
+            return blocks[root]
+        (blk,) = (yield [ctx.irecv(root, tag=tag, cid=H.COLL_CID)])
+        return blk
+
+    return _start(ctx, sched())
+
+
+# ------------------------------------------------ scan/exscan schedules
+# (nbc_iscan.c / nbc_iexscan.c: linear chain, one neighbor hop per rank)
+
+
+def iscan(ctx, value: Any, op) -> SchedRequest:
+    """Nonblocking inclusive prefix reduction (chain schedule)."""
+    def sched():
+        rank = ctx.rank
+        tag = H._next_tag(ctx, H.TAG_SCAN)
+        acc = value
+        if rank > 0:
+            (prev,) = (yield [
+                ctx.irecv(rank - 1, tag=tag, cid=H.COLL_CID)
+            ])
+            acc = H._ordered(op, prev, acc)
+        if rank + 1 < ctx.size:
+            yield [ctx.isend(acc, rank + 1, tag=tag, cid=H.COLL_CID)]
+        return acc
+
+    return _start(ctx, sched())
+
+
+def iexscan(ctx, value: Any, op) -> SchedRequest:
+    """Nonblocking exclusive prefix reduction; rank 0's value is None."""
+    def sched():
+        rank = ctx.rank
+        tag = H._next_tag(ctx, H.TAG_SCAN)
+        prev = None
+        if rank > 0:
+            (prev,) = (yield [
+                ctx.irecv(rank - 1, tag=tag, cid=H.COLL_CID)
+            ])
+        if rank + 1 < ctx.size:
+            mine = value if prev is None else H._ordered(op, prev, value)
+            yield [ctx.isend(mine, rank + 1, tag=tag, cid=H.COLL_CID)]
+        return prev
+
+    return _start(ctx, sched())
+
+
+# --------------------------------------------- reduce_scatter schedules
+# (nbc_ireduce_scatter.c: reduce + scatterv pipeline)
+
+
+def ireduce_scatter(ctx, values: list, op) -> SchedRequest:
+    """Nonblocking blockwise reduce + scatter: `values` is the
+    rank-indexed block list; request value is this rank's fully-reduced
+    block."""
+    if len(values) != ctx.size:
+        raise errors.ArgError(f"ireduce_scatter needs {ctx.size} blocks")
+
+    def sched():
+        size, rank = ctx.size, ctx.rank
+        if size == 1:
+            return values[0]
+        # binomial reduce of the block list to rank 0 (in-order combines)
+        tag = H._next_tag(ctx, H.TAG_RSCAT)
+        acc = list(values)
+        vrank = rank
+        mask = 1
+        while mask < size:
+            if vrank & mask:
+                yield [ctx.isend((vrank, acc), vrank & ~mask, tag=tag,
+                                 cid=H.COLL_CID)]
+                break
+            child = vrank | mask
+            if child < size:
+                (got,) = (yield [
+                    ctx.irecv(child, tag=tag, cid=H.COLL_CID)
+                ])
+                acc = H._combine(op, acc, got[1])
+            mask <<= 1
+        # scatter the reduced blocks from rank 0
+        stag = H._next_tag(ctx, H.TAG_SCATTER)
+        if rank == 0:
+            reqs = [ctx.isend(acc[r], r, tag=stag, cid=H.COLL_CID)
+                    for r in range(1, size)]
+            if reqs:
+                yield reqs
+            return acc[0]
+        (blk,) = (yield [ctx.irecv(0, tag=stag, cid=H.COLL_CID)])
+        return blk
+
+    return _start(ctx, sched())
+
+
+def ireduce_scatter_block(ctx, values: list, op) -> SchedRequest:
+    """Nonblocking reduce_scatter_block: equal block counts — the MPI
+    surface distinction; the schedule is shared."""
+    return ireduce_scatter(ctx, values, op)
+
+
+# ----------------------------------------------- neighbor collectives
+# (nbc_ineighbor_allgather.c / nbc_ineighbor_alltoall.c: one round of
+# irecv from every in-neighbor + isend to every out-neighbor)
+
+
+def ineighbor_allgather(ctx, value: Any, sources: list[int],
+                        destinations: list[int]) -> SchedRequest:
+    """Nonblocking neighbor allgather over explicit neighbor lists (the
+    dist_graph adjacency): sends `value` to every destination, returns
+    the in-neighbor-ordered list of received values."""
+    def sched():
+        tag = H._next_tag(ctx, H.TAG_NEIGHBOR)
+        rreqs = [ctx.irecv(s, tag=tag, cid=H.COLL_CID) for s in sources]
+        sreqs = [ctx.isend(value, d, tag=tag, cid=H.COLL_CID)
+                 for d in destinations]
+        vals = yield rreqs + sreqs
+        return list(vals[: len(rreqs)])
+
+    return _start(ctx, sched())
+
+
+def ineighbor_alltoall(ctx, values: list, sources: list[int],
+                       destinations: list[int]) -> SchedRequest:
+    """Nonblocking neighbor alltoall: values[i] goes to destinations[i];
+    returns the in-neighbor-ordered received list."""
+    if len(values) != len(destinations):
+        raise errors.ArgError(
+            "ineighbor_alltoall needs one value per destination"
+        )
+
+    def sched():
+        tag = H._next_tag(ctx, H.TAG_NEIGHBOR)
+        rreqs = [ctx.irecv(s, tag=tag, cid=H.COLL_CID) for s in sources]
+        sreqs = [ctx.isend(v, d, tag=tag, cid=H.COLL_CID)
+                 for v, d in zip(values, destinations)]
+        vals = yield rreqs + sreqs
+        return list(vals[: len(rreqs)])
+
+    return _start(ctx, sched())
+
+
 class NonblockingCollectives:
     """Mixin: the MPI_Ix surface for host endpoints (pairs with
     :class:`zhpe_ompi_tpu.coll.host.HostCollectives`)."""
@@ -376,3 +606,38 @@ class NonblockingCollectives:
     def iscatter(self, values: list | None = None, root: int = 0
                  ) -> SchedRequest:
         return iscatter(self, values, root)
+
+    def iallgatherv(self, value: Any) -> SchedRequest:
+        return iallgatherv(self, value)
+
+    def ialltoallv(self, sendbuf, counts: list,
+                   displs: list | None = None) -> SchedRequest:
+        return ialltoallv(self, sendbuf, counts, displs)
+
+    def igatherv(self, value: Any, root: int = 0) -> SchedRequest:
+        return igatherv(self, value, root)
+
+    def iscatterv(self, sendbuf=None, counts: list | None = None,
+                  displs: list | None = None, root: int = 0
+                  ) -> SchedRequest:
+        return iscatterv(self, sendbuf, counts, displs, root)
+
+    def iscan(self, value: Any, op) -> SchedRequest:
+        return iscan(self, value, op)
+
+    def iexscan(self, value: Any, op) -> SchedRequest:
+        return iexscan(self, value, op)
+
+    def ireduce_scatter(self, values: list, op) -> SchedRequest:
+        return ireduce_scatter(self, values, op)
+
+    def ireduce_scatter_block(self, values: list, op) -> SchedRequest:
+        return ireduce_scatter_block(self, values, op)
+
+    def ineighbor_allgather(self, value: Any, sources: list[int],
+                            destinations: list[int]) -> SchedRequest:
+        return ineighbor_allgather(self, value, sources, destinations)
+
+    def ineighbor_alltoall(self, values: list, sources: list[int],
+                           destinations: list[int]) -> SchedRequest:
+        return ineighbor_alltoall(self, values, sources, destinations)

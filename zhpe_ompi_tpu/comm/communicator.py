@@ -32,7 +32,9 @@ import numpy as np
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..core import errhandler as errh
 from ..core import errors
+from ..core import info as info_mod
 from ..mca import output as mca_output
 from .group import Group
 
@@ -50,9 +52,16 @@ def _alloc_cid() -> int:
         return cid
 
 
-class Communicator:
+class Communicator(errh.HasErrhandler):
     """A communicator over one mesh axis, optionally partitioned into
-    same-axis sub-groups (the result of ``split``)."""
+    same-axis sub-groups (the result of ``split``).
+
+    Carries an :class:`~zhpe_ompi_tpu.core.info.Info` of hints and an
+    attachable :class:`~zhpe_ompi_tpu.core.errhandler.Errhandler`
+    (default MPI_ERRORS_ARE_FATAL, the reference's communicator default);
+    collective dispatch failures route through it."""
+
+    _default_errhandler = errh.ERRORS_ARE_FATAL
 
     def __init__(
         self,
@@ -60,6 +69,7 @@ class Communicator:
         axis: str,
         partition: list[Group] | None = None,
         name: str | None = None,
+        info=None,
     ) -> None:
         if axis not in mesh.axis_names:
             raise errors.CommError(f"axis {axis!r} not in mesh {mesh.axis_names}")
@@ -77,6 +87,7 @@ class Communicator:
         self.cid = _alloc_cid()
         self.name = name or f"comm{self.cid}"
         self.attributes: dict[Any, Any] = {}  # MPI attribute caching
+        self.info = info_mod.coerce(info)  # MPI_Comm_set_info hints
         # Static lookup tables (device-constant arrays built lazily):
         #   axis index -> comm-relative rank, and -> its group's size
         self._rank_table = np.empty(self.axis_size, dtype=np.int32)
@@ -197,6 +208,13 @@ class Communicator:
         return self._coll
 
     def _coll_call(self, opname: str, *args, **kwargs):
+        # errors at the dispatch boundary route through the attached
+        # errhandler (OMPI_ERRHANDLER_INVOKE at the binding layer)
+        return self._errhandler_guard(
+            self._coll_call_inner, opname, *args, **kwargs
+        )
+
+    def _coll_call_inner(self, opname: str, *args, **kwargs):
         entry = self.coll.get(opname)
         if entry is None:
             raise errors.UnsupportedError(
@@ -210,6 +228,10 @@ class Communicator:
         if pmpi.active():
             return pmpi.dispatch(opname, self, fn, args, kwargs)
         return fn(self, *args, **kwargs)
+
+    def set_info(self, info) -> None:
+        """MPI_Comm_set_info: replace the hint set."""
+        self.info = info_mod.coerce(info)
 
     def allreduce(self, x, op=None, **kw):
         from .. import ops as _ops

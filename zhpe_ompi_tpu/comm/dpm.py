@@ -29,6 +29,7 @@ import itertools
 import threading
 from typing import Any, Callable
 
+from ..coll.inter import InterCollectives
 from ..core import errors
 from ..pt2pt.matching import ANY_SOURCE, ANY_TAG, Envelope
 from ..pt2pt.universe import _EAGER, LocalUniverse, RankContext, _eager_copy
@@ -52,15 +53,22 @@ _PARENT_ATTR = "_zmpi_dpm_parent"
 _SLOT_ATTR = "_zmpi_dpm_slots"
 
 
-class Intercomm:
+class Intercomm(InterCollectives):
     """Per-rank handle to an inter-communicator: a local group and a remote
-    group bridged by a dedicated CID (cf. ompi_intercomm_create)."""
+    group bridged by a dedicated CID (cf. ompi_intercomm_create).
+    Collectives across the bridge come from
+    :class:`~zhpe_ompi_tpu.coll.inter.InterCollectives` (the coll/inter
+    composition)."""
 
-    def __init__(self, ctx: RankContext, remote: LocalUniverse, cid: int):
+    def __init__(self, ctx: RankContext, remote: LocalUniverse, cid: int,
+                 info=None):
+        from ..core import info as info_mod
+
         self._ctx = ctx
         self._remote = remote
         self.cid = cid
         self._seq = itertools.count()
+        self.info = info_mod.coerce(info)
 
     @property
     def rank(self) -> int:
@@ -90,15 +98,6 @@ class Intercomm:
     def recv(self, source: int = ANY_SOURCE, tag: int = ANY_TAG) -> Any:
         """Receive from the remote group on the bridge CID."""
         return self._ctx.recv(source=source, tag=tag, cid=self.cid)
-
-    def barrier(self) -> None:
-        """Inter-group barrier: local barriers bracketing a rank-0 to
-        rank-0 exchange (the reference's intercomm barrier shape)."""
-        self._ctx.barrier()
-        if self._ctx.rank == 0:
-            self.send(b"", 0, tag=0x3FF)
-            self.recv(source=0, tag=0x3FF)
-        self._ctx.barrier()
 
     def disconnect(self) -> None:
         """MPI_Comm_disconnect: quiesce the bridge (collective over the
@@ -136,8 +135,10 @@ def _collective_slot(uni: LocalUniverse, ctx: RankContext,
 
 
 def spawn(uni: LocalUniverse, ctx: RankContext, child_main: Callable,
-          n_children: int, timeout: float = 60.0):
+          n_children: int, timeout: float = 60.0, info=None):
     """MPI_Comm_spawn analog — collective over the parent universe.
+    Accepts an MPI_Info of launch hints (stored on the intercomm; the
+    reference forwards these to PMIx_Spawn).
 
     Creates a fresh `n_children`-rank universe, starts
     ``child_main(child_ctx)`` on each rank thread, and returns
@@ -181,7 +182,7 @@ def spawn(uni: LocalUniverse, ctx: RankContext, child_main: Callable,
         return (child, cid, Handle())
 
     child, cid, handle = _collective_slot(uni, ctx, build)
-    return Intercomm(ctx, child, cid), handle
+    return Intercomm(ctx, child, cid, info=info), handle
 
 
 def get_parent(child_ctx: RankContext) -> Intercomm | None:

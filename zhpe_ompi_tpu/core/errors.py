@@ -130,6 +130,10 @@ class WinError(MpiError):
     errclass = ERR_WIN
 
 
+class KeyvalError(MpiError):
+    errclass = ERR_KEYVAL
+
+
 class ResourceError(MpiError):
     errclass = ERR_NO_MEM
 

@@ -103,3 +103,65 @@ class BookmarkCoordinator:
                 "checkpoint requested on non-quiescent channels: "
                 + ", ".join(pairs)
             )
+
+
+class DistributedBookmarks:
+    """Per-process bookmark counters with a collective quiescence check —
+    the wire-plane form of the protocol (round-3 unweld): each rank keeps
+    only its OWN row (`sent[j]`, `recvd[j]`), and :meth:`exchange` allgathers
+    the rows at checkpoint time — exactly the reference's bkmrk handshake
+    (``crcp_bkmrk_pml.c`` exchanges bookmarks between peers when a
+    checkpoint is requested, because no shared matrix can exist across
+    processes)."""
+
+    def __init__(self, ctx):
+        self._ctx = ctx
+        n = ctx.size
+        self.sent = np.zeros(n, dtype=np.int64)    # my sends, by dest
+        self.recvd = np.zeros(n, dtype=np.int64)   # my receives, by source
+        self._lock = threading.Lock()
+
+    def wrap(self, ctx=None) -> "BookmarkedContext":
+        """Proxy whose counters feed this rank's local rows."""
+        return BookmarkedContext(ctx or self._ctx, self)
+
+    # BookmarkedContext hooks (same interface as BookmarkCoordinator)
+    def _count_send(self, src: int, dst: int) -> None:
+        with self._lock:
+            self.sent[dst] += 1
+
+    def _count_recv(self, src: int, dst: int) -> None:
+        with self._lock:
+            self.recvd[src] += 1
+
+    def exchange(self) -> tuple[np.ndarray, np.ndarray]:
+        """Collective: gather every rank's rows into the full (sent,
+        received) matrices — entry [i, j] counts i→j messages."""
+        with self._lock:
+            mine = (self.sent.tolist(), self.recvd.tolist())
+        rows = self._ctx.allgather(mine)
+        sent = np.array([r[0] for r in rows], dtype=np.int64)
+        recvd = np.array([r[1] for r in rows], dtype=np.int64)
+        return sent, recvd
+
+    def in_flight(self) -> np.ndarray:
+        """Collective: per-channel outstanding counts (sent[i,j] −
+        recvd[j,i])."""
+        sent, recvd = self.exchange()
+        return sent - recvd.T
+
+    def quiescent(self) -> bool:
+        """Collective go/no-go: every channel drained on every rank."""
+        return bool(np.all(self.in_flight() == 0))
+
+    def require_quiescent(self) -> None:
+        fl = self.in_flight()
+        if np.any(fl != 0):
+            pairs = [
+                f"{i}->{j}:{int(fl[i, j])}"
+                for i, j in zip(*np.nonzero(fl))
+            ]
+            raise errors.InternalError(
+                "checkpoint requested on non-quiescent channels: "
+                + ", ".join(pairs)
+            )

@@ -150,6 +150,29 @@ class ReplayContext:
                 and self._send_pos == len(self._log.sends))
 
 
+class ProcessLogger:
+    """Pessimistic logging for ONE rank of a wire (multi-process) job —
+    the round-3 unweld: each process owns exactly its own log, as the
+    reference's sender-based logging does (no cross-process log registry
+    can exist).  Restart-side replay uses the same :class:`ReplayContext`;
+    fetching surviving peers' payload logs is the restart runtime's job,
+    exactly as in the reference."""
+
+    def __init__(self, ep):
+        self._ep = ep
+        self.log = _RankLog()
+        self._lock = threading.Lock()
+
+    def wrap(self) -> LoggedContext:
+        return LoggedContext(self._ep, self.log, self._lock)
+
+    def replay_context(self) -> ReplayContext:
+        return ReplayContext(self._ep.rank, self._ep.size, self.log)
+
+    def event_counts(self) -> tuple[int, int]:
+        return len(self.log.sends), len(self.log.recvs)
+
+
 class UniverseLogger:
     """Attach pessimistic logging to a universe."""
 

@@ -26,6 +26,7 @@ import threading
 
 import numpy as np
 
+from ..core import errhandler
 from ..core import errors
 from ..datatype import convertor
 from ..datatype.predefined import BYTE, Datatype
@@ -107,13 +108,23 @@ def _runs(offsets: np.ndarray) -> list[tuple[int, int]]:
     ]
 
 
-class File:
-    """MPI_File analog; one object serves every rank of `comm`."""
+class File(errhandler.HasErrhandler):
+    """MPI_File analog; one object serves every rank of `comm`.
 
-    def __init__(self, comm, path: str, mode: int = MODE_RDONLY):
+    Accepts an MPI_Info of hints (MPI_File_open's info argument); files
+    default to MPI_ERRORS_RETURN (the reference's file default)."""
+
+    _default_errhandler = errhandler.ERRORS_RETURN
+
+    def __init__(self, comm, path: str, mode: int = MODE_RDONLY,
+                 info=None):
+        from ..core import info as info_mod
+
         self.comm = comm
         self.path = path
         self.mode = mode
+        self.info = info_mod.coerce(info)
+        self.name = f"file:{path}"
         self._fs = fs_mod.select_fs()
         self._fd = self._fs.open(path, _os_flags(mode))
         n = comm.size if comm is not None else 1

@@ -25,7 +25,9 @@ from typing import Any
 import numpy as np
 
 from .. import ops as zops
+from ..core import errhandler as errh
 from ..core import errors
+from ..core import info as info_mod
 from ..runtime import spc
 
 LOCK_SHARED = 1
@@ -52,15 +54,22 @@ class _WinRegistry:
         self.completes = [0] * size
 
 
-class HostWindow:
-    """Per-rank handle to a collectively-created window."""
+class HostWindow(errh.HasErrhandler):
+    """Per-rank handle to a collectively-created window.
+
+    Windows default to MPI_ERRORS_RETURN (the reference's win default)
+    and accept an Info of hints; "no_locks" (an MPI-reserved window key)
+    disables the passive-target path."""
+
+    _default_errhandler = errh.ERRORS_RETURN
 
     _registries: dict[tuple[int, int], _WinRegistry] = {}
     _reg_lock = threading.Lock()
     _next_id = [0]
 
     @classmethod
-    def create(cls, ctx, local_buffer: np.ndarray) -> "HostWindow":
+    def create(cls, ctx, local_buffer: np.ndarray,
+               info=None) -> "HostWindow":
         """MPI_Win_create: collective over the universe."""
         if not isinstance(local_buffer, np.ndarray):
             raise errors.WinError("window buffer must be a numpy array")
@@ -86,12 +95,14 @@ class HostWindow:
         reg = cls._registries[(id(ctx.universe), win_id)]
         reg.buffers[ctx.rank] = local_buffer
         ctx.barrier()
-        return cls(ctx, win_id, reg)
+        return cls(ctx, win_id, reg, info=info)
 
-    def __init__(self, ctx, win_id: int, reg: _WinRegistry):
+    def __init__(self, ctx, win_id: int, reg: _WinRegistry, info=None):
         self.ctx = ctx
         self.win_id = win_id
         self._reg = reg
+        self.info = info_mod.coerce(info)
+        self.name = f"win{win_id}"
         self._held: dict[int, int] = {}
         self._started: list[int] = []  # PSCW access-epoch targets
         self._seen_post = [0] * ctx.size  # last observed exposure epoch
@@ -194,6 +205,10 @@ class HostWindow:
     def lock(self, target: int, lock_type: int = LOCK_EXCLUSIVE) -> None:
         """MPI_Win_lock (passive target).  Shared locks are modeled with the
         same RLock (conservative: shared behaves exclusive)."""
+        if self.info.get_bool("no_locks"):
+            raise errors.WinError(
+                "window created with no_locks=true (MPI info assertion)"
+            )
         self._reg.locks[target].acquire()
         self._held[target] = self._held.get(target, 0) + 1
 

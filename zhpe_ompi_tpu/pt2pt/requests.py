@@ -85,6 +85,73 @@ class Request:
         return False
 
 
+class GeneralizedRequest(Request):
+    """MPI generalized requests (``ompi/request/grequest.h:29-61``): a
+    user-defined operation that completes through the standard request
+    machinery.  ``start`` registers the user's query/free/cancel
+    callbacks; the operation's driver calls :meth:`complete` (the
+    MPI_Grequest_complete analog); wait/test then behave like any request.
+
+    - ``query_fn(extra_state, status)`` runs when the completed request
+      is inspected (wait/test), letting the user fill the status — called
+      exactly once per completion, per the spec.
+    - ``free_fn(extra_state)`` runs when the request is freed (after a
+      successful wait).
+    - ``cancel_fn(extra_state, completed)`` implements MPI_Cancel.
+    """
+
+    __slots__ = ("_query_fn", "_free_fn", "_gcancel_fn", "_extra",
+                 "_queried", "_freed")
+
+    @classmethod
+    def start(cls, query_fn: Callable | None = None,
+              free_fn: Callable | None = None,
+              cancel_fn: Callable | None = None,
+              extra_state: Any = None) -> "GeneralizedRequest":
+        """MPI_Grequest_start."""
+        return cls(query_fn, free_fn, cancel_fn, extra_state)
+
+    def __init__(self, query_fn=None, free_fn=None, cancel_fn=None,
+                 extra_state=None):
+        super().__init__(cancel_fn=self._do_cancel)
+        self._query_fn = query_fn
+        self._free_fn = free_fn
+        self._gcancel_fn = cancel_fn
+        self._extra = extra_state
+        self._queried = False
+        self._freed = False
+
+    def _do_cancel(self, _req) -> bool:
+        if self._gcancel_fn is not None:
+            return bool(self._gcancel_fn(self._extra, self.done))
+        return False
+
+    def _run_query(self) -> None:
+        if self._queried or self._query_fn is None:
+            return
+        self._queried = True
+        self._query_fn(self._extra, self.status)
+
+    def test(self):
+        flag, value = super().test()
+        if flag:
+            self._run_query()
+            self.free()  # a successful MPI_Test frees, like MPI_Wait
+        return flag, value
+
+    def wait(self, timeout: float | None = None):
+        value = super().wait(timeout)
+        self._run_query()
+        self.free()
+        return value
+
+    def free(self) -> None:
+        """MPI_Request_free on a completed generalized request."""
+        if not self._freed and self._free_fn is not None:
+            self._freed = True
+            self._free_fn(self._extra)
+
+
 def wait_all(requests, timeout: float | None = None):
     """MPI_Waitall."""
     return [r.wait(timeout) for r in requests]

@@ -182,14 +182,22 @@ class TcpProc(HostCollectives, NonblockingCollectives):
                 conn, _ = self._listener.accept()
             except OSError:
                 return
-            # first frame on a new connection announces the peer rank
+            # first frame on a new connection announces the peer: a bare
+            # rank for in-group peers, or ["b", bridge_cid, rank] for a
+            # rank of a REMOTE group connecting across an intercomm
+            # bridge (dpm) — namespaced so remote rank numbers cannot
+            # collide with local ones in the connection cache
             frame = _recv_frame(conn)
             if frame is None:
                 conn.close()
                 continue
-            [peer_rank] = dss.unpack(frame)
+            [hello] = dss.unpack(frame)
+            if isinstance(hello, (list, tuple)):
+                key = ("b", hello[1], hello[2])
+            else:
+                key = hello
             with self._conn_lock:
-                self._conns.setdefault(peer_rank, conn)
+                self._conns.setdefault(key, conn)
             threading.Thread(
                 target=self._drain_loop, args=(conn,), daemon=True
             ).start()
@@ -233,6 +241,42 @@ class TcpProc(HostCollectives, NonblockingCollectives):
             target=self._drain_loop, args=(sock,), daemon=True
         ).start()
         return sock
+
+    def bridge_endpoint(self, cid: int, dest: int,
+                        addr: tuple[str, int]) -> socket.socket:
+        """Lazy connection to rank `dest` of a REMOTE group across an
+        intercomm bridge (dpm) — cached under the bridge cid so remote
+        rank numbering stays disjoint from the in-group book."""
+        key = ("b", cid, dest)
+        with self._conn_lock:
+            sock = self._conns.get(key)
+        if sock is not None:
+            return sock
+        sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        sock.settimeout(self._timeout)
+        sock.connect(tuple(addr))
+        _send_frame(sock, dss.pack(["b", cid, self.rank]))
+        with self._conn_lock:
+            existing = self._conns.get(key)
+            if existing is not None:
+                sock.close()
+                return existing
+            self._conns[key] = sock
+        threading.Thread(
+            target=self._drain_loop, args=(sock,), daemon=True
+        ).start()
+        return sock
+
+    def bridge_send(self, obj: Any, cid: int, dest: int,
+                    addr: tuple[str, int], tag: int = 0) -> None:
+        """Send to a remote-group rank across a bridge; frames carry the
+        bridge cid so matching stays isolated from in-group traffic."""
+        seq = next(self._seq)
+        frame = dss.pack(self.rank, tag, cid, seq, obj)
+        spc.record("tcp_bytes_sent", len(frame))
+        sock = self.bridge_endpoint(cid, dest, addr)
+        with self._send_lock:
+            _send_frame(sock, frame)
 
     # -- MPI surface (RankContext-compatible) ----------------------------
 

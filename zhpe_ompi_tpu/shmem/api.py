@@ -1,17 +1,22 @@
 """OpenSHMEM-analog PE API (reference: ``oshmem/shmem/c``, 56 files).
 
-Each PE is a thread-rank of a :class:`~zhpe_ompi_tpu.pt2pt.universe.
-LocalUniverse` holding a handle to the universe-shared symmetric heap —
-the in-process form of the reference's sshmem segment, which every PE maps
-so spml put/get are true one-sided operations (no target involvement).
-Remote access here is a direct numpy view write/read guarded by per-PE
-locks for the atomic ops, exactly the shape of ``spml/ucx`` put/get +
-``atomic/basic`` over a mapped segment.
+A PE is a rank of either plane, selected the way the reference's spml
+framework selects its transport (``oshmem/mca/spml``):
 
-Collectives follow ``scoll/basic`` (linear/binomial over pt2pt); the
-reference's ``scoll/mpi`` — reusing the MPI collective layer — appears
-here as the device-plane advice in the package docstring: on TPU both
-models lower to the same XLA collectives.
+- **direct** (thread universe — the sshmem/mmap analog): every PE maps
+  the symmetric heap, so put/get are numpy view writes with per-PE locks
+  for the atomics, exactly the shape of ``spml/ucx`` put/get +
+  ``atomic/basic`` over a mapped segment.
+- **AM over the wire** (TcpProc/DCN — the spml-over-network path): the
+  symmetric heap is a local arena attached to an
+  :class:`~zhpe_ompi_tpu.osc.am.AmWindow` dynamic window; put/get/AMOs
+  are active messages applied by the target's service loop.  This is the
+  round-3 unweld: PGAS no longer requires sharing an address space.
+
+Collectives follow ``scoll/basic`` (linear/binomial over pt2pt) and are
+written against the endpoint surface only, so they run over either plane
+unchanged — the layering ``scoll/mpi`` gets by riding the MPI collective
+stack.
 """
 
 from __future__ import annotations
@@ -61,12 +66,202 @@ class _ShmemUniverseState:
         self.dist_lock_guard = threading.Lock()
 
 
-class ShmemPE:
-    """One PE's API handle — the surface of ``shmem.h``."""
+class _DirectBackend:
+    """Shared-address-space substrate (sshmem/mmap analog): remote heaps
+    are directly addressable numpy views."""
 
     def __init__(self, ctx: RankContext, state: _ShmemUniverseState):
         self._ctx = ctx
         self._state = state
+
+    def _view(self, sym: SymArray, pe: int) -> np.ndarray:
+        if not 0 <= pe < self._ctx.size:
+            raise errors.RankError(f"PE {pe} out of range")
+        raw = self._state.arenas[pe][sym.offset : sym.offset + sym.nbytes]
+        return raw.view(sym.dtype).reshape(sym.shape)
+
+    def local_view(self, sym: SymArray) -> np.ndarray:
+        return self._view(sym, self._ctx.rank)
+
+    def put(self, sym: SymArray, value, pe: int) -> None:
+        self._view(sym, pe)[...] = value
+
+    def get(self, sym: SymArray, pe: int) -> np.ndarray:
+        return self._view(sym, pe).copy()
+
+    def p(self, sym: SymArray, value, pe: int, index: int) -> None:
+        self._view(sym, pe).reshape(-1)[index] = value
+
+    def g(self, sym: SymArray, pe: int, index: int):
+        return self._view(sym, pe).reshape(-1)[index].copy()
+
+    def iput(self, sym: SymArray, values: np.ndarray, pe: int,
+             tst: int, sst: int) -> None:
+        n = (values.size + sst - 1) // sst
+        self._view(sym, pe).reshape(-1)[: n * tst : tst] = values[::sst]
+
+    def iget(self, sym: SymArray, pe: int, n: int, sst: int) -> np.ndarray:
+        return self._view(sym, pe).reshape(-1)[: n * sst : sst].copy()
+
+    def amo(self, sym: SymArray, kind: str, pe: int, index: int,
+            value=None, compare=None):
+        """Atomic read-modify-write; returns the pre-op value."""
+        with self._state.locks[pe]:
+            v = self._view(sym, pe).reshape(-1)
+            old = v[index].copy()
+            if kind == "add":
+                v[index] = old + value
+            elif kind == "swap":
+                v[index] = value
+            elif kind == "cas":
+                if old == compare:
+                    v[index] = value
+            elif kind == "set":
+                v[index] = value
+            elif kind == "fetch":
+                pass
+            else:
+                raise errors.InternalError(f"unknown AMO {kind!r}")
+            return old
+
+    # -- distributed locks ------------------------------------------------
+
+    def _dist_lock(self, sym: SymArray) -> threading.RLock:
+        with self._state.dist_lock_guard:
+            return self._state.dist_locks.setdefault(
+                sym.offset, threading.RLock()
+            )
+
+    def set_lock(self, sym: SymArray) -> None:
+        self._dist_lock(sym).acquire()
+
+    def clear_lock(self, sym: SymArray) -> None:
+        self._dist_lock(sym).release()
+
+    def test_lock(self, sym: SymArray) -> bool:
+        return self._dist_lock(sym).acquire(blocking=False)
+
+    # -- symmetric allocation ---------------------------------------------
+
+    def alloc_collective(self, pe_api: "ShmemPE", nbytes: int) -> int:
+        def action():
+            with self._state.alloc_lock:
+                return self._state.allocator.alloc(nbytes)
+
+        return pe_api._rank0_collective(action)
+
+    def free_collective(self, pe_api: "ShmemPE", offset: int) -> None:
+        def action():
+            with self._state.alloc_lock:
+                self._state.allocator.free(offset)
+
+        pe_api._rank0_collective(action)
+
+    def quiet(self) -> None:
+        """In-process writes complete immediately."""
+
+
+class _AmBackend:
+    """Wire substrate: the symmetric heap is a local arena attached to a
+    dynamic AM window; remote access is active messages (spml over the
+    network, re-designed on the osc/rdma-analog plane)."""
+
+    def __init__(self, ep, heap_bytes: int):
+        from ..osc.am import AmWindow
+
+        self._ep = ep
+        self.arena = np.zeros(heap_bytes, dtype=np.uint8)
+        self._win = AmWindow.create_dynamic(ep)
+        base = self._win.attach(self.arena)
+        if base != 0:
+            raise errors.InternalError(
+                "symmetric arena must be the first attachment"
+            )
+        # every PE runs an identical allocator in lockstep (collective,
+        # deterministic call sequence) — the symmetric-address contract
+        self._allocator = SymmetricHeapAllocator(heap_bytes)
+        ep.barrier()
+
+    def _disp(self, sym: SymArray, index: int = 0) -> int:
+        return sym.offset + index * sym.dtype.itemsize
+
+    def local_view(self, sym: SymArray) -> np.ndarray:
+        raw = self.arena[sym.offset : sym.offset + sym.nbytes]
+        return raw.view(sym.dtype).reshape(sym.shape)
+
+    def put(self, sym: SymArray, value, pe: int) -> None:
+        buf = np.empty(sym.shape, sym.dtype)
+        buf[...] = value
+        self._win.dyn_put(buf, pe, self._disp(sym))
+
+    def get(self, sym: SymArray, pe: int) -> np.ndarray:
+        raw = self._win.dyn_get(pe, self._disp(sym), sym.nbytes)
+        return raw.view(sym.dtype).reshape(sym.shape).copy()
+
+    def p(self, sym: SymArray, value, pe: int, index: int) -> None:
+        buf = np.empty((), sym.dtype)
+        buf[...] = value
+        self._win.dyn_put(buf, pe, self._disp(sym, index))
+
+    def g(self, sym: SymArray, pe: int, index: int):
+        raw = self._win.dyn_get(pe, self._disp(sym, index),
+                                sym.dtype.itemsize)
+        return raw.view(sym.dtype)[0]
+
+    def iput(self, sym: SymArray, values: np.ndarray, pe: int,
+             tst: int, sst: int) -> None:
+        self._win.dyn_iput(
+            values[::sst].astype(sym.dtype), pe, self._disp(sym), tst
+        )
+
+    def iget(self, sym: SymArray, pe: int, n: int, sst: int) -> np.ndarray:
+        return self._win.dyn_iget(pe, self._disp(sym), n, sym.dtype, sst)
+
+    def amo(self, sym: SymArray, kind: str, pe: int, index: int,
+            value=None, compare=None):
+        return self._win.dyn_amo(
+            pe, self._disp(sym, index), kind, sym.dtype,
+            value=value, compare=compare,
+        )
+
+    # -- distributed locks: home PE 0 arbitrates per-offset ---------------
+
+    def set_lock(self, sym: SymArray) -> None:
+        self._win.dist_lock(0, sym.offset)
+
+    def clear_lock(self, sym: SymArray) -> None:
+        self._win.dist_unlock(0, sym.offset)
+
+    def test_lock(self, sym: SymArray) -> bool:
+        return self._win.dist_trylock(0, sym.offset)
+
+    # -- symmetric allocation ---------------------------------------------
+
+    def alloc_collective(self, pe_api: "ShmemPE", nbytes: int) -> int:
+        """Every PE advances its own allocator — identical deterministic
+        call sequences keep offsets symmetric; the bracketing barriers are
+        the shmem_malloc synchronization."""
+        self._ep.barrier()
+        off = self._allocator.alloc(nbytes)
+        self._ep.barrier()
+        return off
+
+    def free_collective(self, pe_api: "ShmemPE", offset: int) -> None:
+        self._ep.barrier()
+        self._allocator.free(offset)
+        self._ep.barrier()
+
+    def quiet(self) -> None:
+        """shmem_quiet: flush outstanding AM puts (ack round-trip)."""
+        self._win.flush_all()
+
+
+class ShmemPE:
+    """One PE's API handle — the surface of ``shmem.h``."""
+
+    def __init__(self, ctx, backend):
+        self._ctx = ctx
+        self._backend = backend
 
     # -- identity --------------------------------------------------------
 
@@ -100,36 +295,20 @@ class ShmemPE:
 
     def shmalloc(self, shape, dtype=np.float64) -> SymArray:
         """Collective symmetric allocation (shmem_malloc: synchronizes all
-        PEs; identical offsets fall out of the shared allocator)."""
+        PEs; identical offsets fall out of lockstep allocators)."""
         shape = (shape,) if isinstance(shape, int) else tuple(shape)
         dt = np.dtype(dtype)
         nbytes = int(np.prod(shape or (1,))) * dt.itemsize
-
-        def action():
-            with self._state.alloc_lock:
-                return self._state.allocator.alloc(nbytes)
-
-        off = self._rank0_collective(action)
-        return SymArray(off, shape, dt, nbytes, self._state)
+        off = self._backend.alloc_collective(self, nbytes)
+        return SymArray(off, shape, dt, nbytes, self._backend)
 
     def shfree(self, sym: SymArray) -> None:
         """Collective free."""
-
-        def action():
-            with self._state.alloc_lock:
-                self._state.allocator.free(sym.offset)
-
-        self._rank0_collective(action)
-
-    def _view(self, sym: SymArray, pe: int) -> np.ndarray:
-        if not 0 <= pe < self._ctx.size:
-            raise errors.RankError(f"PE {pe} out of range")
-        raw = self._state.arenas[pe][sym.offset : sym.offset + sym.nbytes]
-        return raw.view(sym.dtype).reshape(sym.shape)
+        self._backend.free_collective(self, sym.offset)
 
     def local(self, sym: SymArray) -> np.ndarray:
         """This PE's instance of the symmetric object (writable view)."""
-        return self._view(sym, self._ctx.rank)
+        return self._backend.local_view(sym)
 
     # -- RMA (spml analog) -----------------------------------------------
 
@@ -137,27 +316,26 @@ class ShmemPE:
         """shmem_put: one-sided write of the full object (or a broadcastable
         slice) into the target PE's instance."""
         spc.record("shmem_puts", 1)
-        self._view(sym, pe)[...] = value
+        self._backend.put(sym, value, pe)
 
     def get(self, sym: SymArray, pe: int) -> np.ndarray:
         """shmem_get: one-sided read of the target PE's instance."""
         spc.record("shmem_gets", 1)
-        return self._view(sym, pe).copy()
+        return self._backend.get(sym, pe)
 
     def p(self, sym: SymArray, value, pe: int, index: int = 0) -> None:
         """shmem_p: single-element put."""
-        self._view(sym, pe).reshape(-1)[index] = value
+        self._backend.p(sym, value, pe, index)
 
     def g(self, sym: SymArray, pe: int, index: int = 0):
         """shmem_g: single-element get."""
-        return self._view(sym, pe).reshape(-1)[index].copy()
+        return self._backend.g(sym, pe, index)
 
     def iput(self, sym: SymArray, values, pe: int, tst: int = 1,
              sst: int = 1) -> None:
         """shmem_iput: strided put (target stride tst, source stride sst)."""
         values = np.asarray(values).reshape(-1)
-        n = (values.size + sst - 1) // sst
-        self._view(sym, pe).reshape(-1)[: n * tst : tst] = values[::sst]
+        self._backend.iput(sym, values, pe, tst, sst)
 
     def iget(self, sym: SymArray, pe: int, n: int,
              target: np.ndarray | None = None, tst: int = 1,
@@ -166,7 +344,7 @@ class ShmemPE:
         stride `sst`; when `target` is given, scatter them at target
         stride `tst` (the OpenSHMEM target-stride contract); otherwise
         return them densely."""
-        got = self._view(sym, pe).reshape(-1)[: n * sst : sst].copy()
+        got = self._backend.iget(sym, pe, n, sst)
         if target is None:
             return got
         if not target.flags["C_CONTIGUOUS"]:
@@ -180,28 +358,22 @@ class ShmemPE:
         return target
 
     def fence(self) -> None:
-        """shmem_fence: ordering of puts to each PE — in-process writes are
-        already ordered; kept for program portability."""
+        """shmem_fence: ordering of puts to each PE — both substrates
+        deliver per-origin in order (views / per-connection FIFO)."""
 
     def quiet(self) -> None:
-        """shmem_quiet: completion of all outstanding puts — immediate
-        in-process."""
+        """shmem_quiet: completion of all outstanding puts."""
+        self._backend.quiet()
 
     # -- atomics (atomic framework analog) -------------------------------
 
     def atomic_add(self, sym: SymArray, value, pe: int, index: int = 0
                    ) -> None:
-        with self._state.locks[pe]:
-            v = self._view(sym, pe).reshape(-1)
-            v[index] = v[index] + value
+        self._backend.amo(sym, "add", pe, index, value=value)
 
     def atomic_fetch_add(self, sym: SymArray, value, pe: int,
                          index: int = 0):
-        with self._state.locks[pe]:
-            v = self._view(sym, pe).reshape(-1)
-            old = v[index].copy()
-            v[index] = old + value
-        return old
+        return self._backend.amo(sym, "add", pe, index, value=value)
 
     def atomic_inc(self, sym: SymArray, pe: int, index: int = 0) -> None:
         self.atomic_add(sym, 1, pe, index)
@@ -210,29 +382,20 @@ class ShmemPE:
         return self.atomic_fetch_add(sym, 1, pe, index)
 
     def atomic_swap(self, sym: SymArray, value, pe: int, index: int = 0):
-        with self._state.locks[pe]:
-            v = self._view(sym, pe).reshape(-1)
-            old = v[index].copy()
-            v[index] = value
-        return old
+        return self._backend.amo(sym, "swap", pe, index, value=value)
 
     def atomic_compare_swap(self, sym: SymArray, cond, value, pe: int,
                             index: int = 0):
-        with self._state.locks[pe]:
-            v = self._view(sym, pe).reshape(-1)
-            old = v[index].copy()
-            if old == cond:
-                v[index] = value
-        return old
+        return self._backend.amo(
+            sym, "cas", pe, index, value=value, compare=cond
+        )
 
     def atomic_fetch(self, sym: SymArray, pe: int, index: int = 0):
-        with self._state.locks[pe]:
-            return self._view(sym, pe).reshape(-1)[index].copy()
+        return self._backend.amo(sym, "fetch", pe, index)
 
     def atomic_set(self, sym: SymArray, value, pe: int, index: int = 0
                    ) -> None:
-        with self._state.locks[pe]:
-            self._view(sym, pe).reshape(-1)[index] = value
+        self._backend.amo(sym, "set", pe, index, value=value)
 
     # -- point synchronization -------------------------------------------
 
@@ -255,26 +418,25 @@ class ShmemPE:
 
     # -- distributed locks -----------------------------------------------
 
-    def _dist_lock(self, sym: SymArray) -> threading.RLock:
-        with self._state.dist_lock_guard:
-            return self._state.dist_locks.setdefault(
-                sym.offset, threading.RLock()
-            )
-
     def set_lock(self, sym: SymArray) -> None:
         """shmem_set_lock on a symmetric lock variable."""
-        self._dist_lock(sym).acquire()
+        self._backend.set_lock(sym)
 
     def clear_lock(self, sym: SymArray) -> None:
-        self._dist_lock(sym).release()
+        self._backend.clear_lock(sym)
 
     def test_lock(self, sym: SymArray) -> bool:
         """shmem_test_lock: True if acquired."""
-        return self._dist_lock(sym).acquire(blocking=False)
+        return self._backend.test_lock(sym)
 
     # -- collectives (scoll/basic analog) --------------------------------
 
     def barrier_all(self) -> None:
+        """shmem_barrier_all: the OpenSHMEM spec requires completion of
+        all outstanding remote updates BEFORE the synchronization — an
+        implicit quiet (on the AM backend a put may still be in flight
+        when the pt2pt barrier alone completes)."""
+        self._backend.quiet()
         self._ctx.barrier()
 
     def broadcast(self, sym: SymArray, root: int = 0) -> None:
@@ -398,5 +560,12 @@ def shmem_universe(n_pes: int, heap_bytes: int = _DEFAULT_HEAP
     symmetric-heap attach (shmem_init)."""
     uni = LocalUniverse(n_pes)
     state = _ShmemUniverseState(n_pes, heap_bytes)
-    pes = [ShmemPE(ctx, state) for ctx in uni.contexts]
+    pes = [ShmemPE(ctx, _DirectBackend(ctx, state)) for ctx in uni.contexts]
     return uni, pes
+
+
+def shmem_wire_pe(ep, heap_bytes: int = _DEFAULT_HEAP) -> ShmemPE:
+    """shmem_init over a wire endpoint (TcpProc): collective — every rank
+    of the endpoint's group must call it.  The symmetric heap lives in
+    this process; remote PEs reach it through the AM window."""
+    return ShmemPE(ep, _AmBackend(ep, heap_bytes))
