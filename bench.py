@@ -19,6 +19,15 @@ of the final loss — the step chain is sequentially dependent, so fetching
 the last loss bounds the whole window.  A physics assert rejects any
 throughput implying more FLOP/s than the chip's peak, so a broken sync can
 never ship a bogus number.
+
+vs_baseline > 1 explained (round-3 item 7): ``benchmarks/hlo_diff.py``
+dumps the optimized HLO of both steps and — after stripping source-location
+metadata and argument names — they are IDENTICAL on this chip.  The two
+paths compile to the same program, so the true ratio is 1.00 and any
+deviation is measurement procedure, not the framework.  The round-2 +10%
+came from a fixed window order (framework always timed first in each
+interleave pair); windows now alternate order every round and the count is
+4, which centers the ratio at ~1.0.
 """
 
 import json
@@ -201,15 +210,18 @@ def main():
     # warmer device state (measured ~2 ms/step order bias on v5e).
     st_fw = prep(step_fw, specs)
     st_pl = prep(make_plain_step(), specs)
-    for _ in range(3):
-        window(st_fw)
-        window(st_pl)
+    for i in range(4):
+        # alternate which path is timed first: a fixed order biases the
+        # first-timed path (~10% measured on v5e; see module docstring)
+        first, second = (st_fw, st_pl) if i % 2 == 0 else (st_pl, st_fw)
+        window(first)
+        window(second)
     fw_s = check_physics(st_fw["best"])
     plain_s = check_physics(st_pl["best"])
 
     fw_tps = batch * cfg.seq / fw_s
     mfu = (flops_step / fw_s) / peak if kind_known else 0.0
-    print(json.dumps({
+    result = {
         "metric": "train_step_throughput",
         "value": round(fw_tps, 1),
         "unit": "tokens/s",
@@ -217,7 +229,59 @@ def main():
         "step_ms": round(fw_s * 1e3, 2),
         "mfu": round(mfu, 4),
         "flops_per_step": flops_step,
-    }))
+    }
+
+    # Long-context configuration (round-3 item 6): seq 4096 with the
+    # Pallas flash kernels + remat — the regime the flash backward was
+    # built for (naive attention OOMs here).  Reported as extra fields on
+    # the same line (the driver's one-JSON-line contract).
+    if on_tpu:
+        lc_cfg = tfm.Config(
+            vocab=8192, d_model=1024, n_heads=16, d_ff=4096, n_layers=4,
+            seq=4096, dtype=jnp.bfloat16, remat=True,
+        )
+        lc_batch = 2 * dp
+        lc_iters = 8
+        lc_tokens = jnp.asarray(
+            r.integers(0, lc_cfg.vocab, (lc_batch, lc_cfg.seq)))
+        lc_targets = jnp.asarray(
+            r.integers(0, lc_cfg.vocab, (lc_batch, lc_cfg.seq)))
+        lc_flops = _train_flops_per_step(lc_cfg, lc_batch)
+        step_lc, lc_specs = tfm.make_train_step(lc_cfg, mesh, dp_comm,
+                                                tp_comm)
+        lc_sharded = {
+            k: jax.device_put(
+                v, NamedSharding(mesh, lc_specs[k]))
+            for k, v in tfm.init_params(
+                lc_cfg, jax.random.PRNGKey(1)).items()
+        }
+        dspec = NamedSharding(mesh, P("dp"))
+        lc_tok = jax.device_put(lc_tokens, dspec)
+        lc_tgt = jax.device_put(lc_targets, dspec)
+        ps, loss = step_lc(lc_sharded, lc_tok, lc_tgt)  # compile
+        ps, loss = step_lc(ps, lc_tok, lc_tgt)
+        float(loss)
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            for _ in range(lc_iters):
+                ps, loss = step_lc(ps, lc_tok, lc_tgt)
+            lval = float(loss)
+            best = min(best, (time.perf_counter() - t0) / lc_iters)
+            if not np.isfinite(lval):
+                raise RuntimeError(f"long-context non-finite loss {lval}")
+        if lc_flops / best >= peak:
+            raise RuntimeError("long-context timing sync broken")
+        result.update({
+            "long_ctx_seq": lc_cfg.seq,
+            "long_ctx_tokens_per_s": round(lc_batch * lc_cfg.seq / best, 1),
+            "long_ctx_step_ms": round(best * 1e3, 2),
+            "long_ctx_mfu": (
+                round((lc_flops / best) / peak, 4) if kind_known else 0.0
+            ),
+        })
+
+    print(json.dumps(result))
 
 
 if __name__ == "__main__":

@@ -1,0 +1,73 @@
+/* ring_c.c — the canonical MPI ring acceptance program, written against
+ * the framework's C ABI shim (zompi_mpi.h).  Plays the role of the
+ * reference's examples/ring_c.c: a token circulates the ring a fixed
+ * number of laps, then every rank reports and validates with an
+ * allreduce and a broadcast.
+ *
+ * Build:  gcc ring_c.c -o ring_c -L<libdir> -lzompi_mpi -Wl,-rpath,<libdir>
+ * Run:    launcher sets ZMPI_RANK/ZMPI_SIZE/ZMPI_COORD_HOST/ZMPI_COORD_PORT
+ */
+
+#include <stdio.h>
+#include <stdlib.h>
+
+#include "zompi_mpi.h"
+
+int main(int argc, char **argv) {
+  int rank, size, next, prev, message;
+  const int laps = 3;
+
+  if (MPI_Init(&argc, &argv) != MPI_SUCCESS) {
+    fprintf(stderr, "MPI_Init failed\n");
+    return 2;
+  }
+  MPI_Comm_rank(MPI_COMM_WORLD, &rank);
+  MPI_Comm_size(MPI_COMM_WORLD, &size);
+  next = (rank + 1) % size;
+  prev = (rank + size - 1) % size;
+
+  /* pass a token around the ring; rank 0 decrements once per lap and the
+   * final zero circulates so every rank terminates (the classic ring
+   * structure) */
+  if (rank == 0) {
+    message = laps;
+    MPI_Send(&message, 1, MPI_INT, next, 201, MPI_COMM_WORLD);
+  }
+  while (1) {
+    MPI_Status st;
+    MPI_Recv(&message, 1, MPI_INT, prev, 201, MPI_COMM_WORLD, &st);
+    if (rank == 0) message--;
+    MPI_Send(&message, 1, MPI_INT, next, 201, MPI_COMM_WORLD);
+    if (message == 0) break;
+  }
+  if (rank == 0) { /* absorb the last circulating zero */
+    MPI_Status st;
+    MPI_Recv(&message, 1, MPI_INT, prev, 201, MPI_COMM_WORLD, &st);
+  }
+
+  MPI_Barrier(MPI_COMM_WORLD);
+
+  /* allreduce: sum of (rank+1) must be size*(size+1)/2 on every rank */
+  {
+    double mine = (double)(rank + 1), total = 0.0;
+    MPI_Allreduce(&mine, &total, 1, MPI_DOUBLE, MPI_SUM, MPI_COMM_WORLD);
+    if ((int)total != size * (size + 1) / 2) {
+      fprintf(stderr, "rank %d: allreduce got %f\n", rank, total);
+      MPI_Abort(MPI_COMM_WORLD, 3);
+    }
+  }
+
+  /* bcast from the last rank */
+  {
+    int word = (rank == size - 1) ? 4242 : 0;
+    MPI_Bcast(&word, 1, MPI_INT, size - 1, MPI_COMM_WORLD);
+    if (word != 4242) {
+      fprintf(stderr, "rank %d: bcast got %d\n", rank, word);
+      MPI_Abort(MPI_COMM_WORLD, 4);
+    }
+  }
+
+  printf("ring_c rank %d/%d OK\n", rank, size);
+  MPI_Finalize();
+  return 0;
+}

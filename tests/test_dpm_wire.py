@@ -222,3 +222,31 @@ class TestProcessSpawn:
 
         res = run_tcp(2, main, timeout=90.0)
         assert res[0] == [(0, 3, "cfg"), (1, 3, "cfg")]
+
+
+class TestGetParentIdentity:
+    def test_get_parent_returns_same_comm(self):
+        """MPI contract: Comm_get_parent is THE parent communicator —
+        repeated calls must not reset collective sequence tags (regression:
+        a fresh handle per call deadlocked the second collective)."""
+        from zhpe_ompi_tpu.comm import dpm
+        from zhpe_ompi_tpu.pt2pt.universe import LocalUniverse
+
+        uni = LocalUniverse(2)
+
+        def child_main(ctx):
+            p1 = dpm.get_parent(ctx)
+            p2 = dpm.get_parent(ctx)
+            assert p1 is p2
+            p1.barrier()
+            dpm.get_parent(ctx).barrier()  # second collective, new lookup
+            return True
+
+        def main(ctx):
+            ic, handle = dpm.spawn(uni, ctx, child_main, n_children=2)
+            ic.barrier()
+            ic.barrier()
+            return handle.join() if ctx.rank == 0 else None
+
+        res = uni.run(main)
+        assert res[0] == [True, True]

@@ -123,3 +123,35 @@ def test_loss_decreases(cfg):
         sharded, loss = step(sharded, tokens, targets)
         losses.append(float(loss))
     assert losses[-1] < losses[0]
+
+
+def test_remat_matches_no_remat():
+    """cfg.remat must not change the math — same loss and grads, only the
+    backward's memory/recompute schedule differs."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from zhpe_ompi_tpu.models import transformer as tfm
+
+    r = np.random.default_rng(3)
+    base = dict(vocab=64, d_model=32, n_heads=4, d_ff=64, n_layers=2,
+                seq=16, dtype=jnp.float32, flash=False)
+    cfg_a = tfm.Config(**base)
+    cfg_b = tfm.Config(**base, remat=True)
+    params = tfm.init_params(cfg_a, jax.random.PRNGKey(0))
+    tok = jnp.asarray(r.integers(0, 64, (2, 16)))
+    tgt = jnp.asarray(r.integers(0, 64, (2, 16)))
+
+    def lossgrad(cfg):
+        return jax.value_and_grad(
+            lambda p: tfm.loss_fn(p, tok, tgt, cfg)
+        )(params)
+
+    la, ga = lossgrad(cfg_a)
+    lb, gb = lossgrad(cfg_b)
+    np.testing.assert_allclose(float(la), float(lb), rtol=1e-6)
+    for k in ga:
+        np.testing.assert_allclose(
+            np.asarray(ga[k]), np.asarray(gb[k]), rtol=1e-5, atol=1e-6
+        )

@@ -285,3 +285,92 @@ class TestAmWindow:
             return kind
 
         assert uni.run(uni_main) == ["HostWindow", "HostWindow"]
+
+
+class TestAmRegressions:
+    def test_get_bad_offset_raises(self):
+        """count=None with an out-of-range offset must raise, not return
+        an empty array (negative-count bounds bypass regression)."""
+
+        def main(p):
+            buf = np.zeros(4, np.float32)
+            win = AmWindow.create(p, buf)
+            win.fence()
+            errs = []
+            if p.rank == 1:
+                for off in (10, -1):
+                    try:
+                        win.get(0, offset=off)
+                        errs.append(None)
+                    except errors.WinError as e:
+                        errs.append(str(e))
+            win.fence()
+            win.free()
+            return errs
+
+        res = run_tcp(2, main)[1]
+        assert all(e is not None for e in res)
+
+    def test_queued_exclusive_blocks_later_shared(self):
+        """FIFO lock fairness: once an EXCLUSIVE request is queued, a later
+        SHARED request must queue behind it (writer-starvation fix)."""
+
+        def main(p):
+            buf = np.zeros(1, np.float64)
+            win = AmWindow.create(p, buf)
+            win.fence()
+            order = []
+            if p.rank == 0:
+                win.lock(0, LOCK_SHARED)
+                p.send(b"held", dest=1, tag=80)
+                p.recv(source=1, tag=81)  # writer queued now
+                p.send(b"go", dest=2, tag=82)
+                p.recv(source=2, tag=83)  # reader 2 is about to queue
+                import time as _time
+
+                _time.sleep(0.2)  # let reader 2's request reach the queue
+                win.unlock(0)  # -> writer granted first, then reader 2
+                win.fence()
+                win.free()
+                return None
+            if p.rank == 1:
+                p.recv(source=0, tag=80)
+                import threading as _t
+
+                granted = _t.Event()
+
+                def writer():
+                    win.lock(0, LOCK_EXCLUSIVE)
+                    granted.set()
+                    win.put(np.float64(1), 0, 0)
+                    win.unlock(0)
+
+                th = _t.Thread(target=writer)
+                th.start()
+                import time as _time
+
+                _time.sleep(0.2)  # let the lock request queue
+                p.send(b"queued", dest=0, tag=81)
+                th.join(20)
+                win.fence()
+                win.free()
+                return granted.is_set()
+            # rank 2: a late SHARED request must NOT overtake the writer
+            p.recv(source=0, tag=82)
+            p.send(b"queuing", dest=0, tag=83)  # announce BEFORE locking
+            import time as _time
+
+            t0 = _time.monotonic()
+            win.lock(0, LOCK_SHARED)
+            waited = _time.monotonic() - t0
+            got = float(win.get(0, 0, 1)[0])
+            win.unlock(0)
+            win.fence()
+            win.free()
+            # reader 2 was granted only after the writer ran
+            return (got, waited)
+
+        res = run_tcp(3, main)
+        assert res[1] is True
+        got, _ = res[2]
+        assert got == 1.0  # saw the writer's value => did not overtake

@@ -164,8 +164,20 @@ _OPS_WITH_REDUCTION = (
     "scan", "exscan",
 )
 
-# decision thresholds (bytes); MCA-tunable, defaults in the spirit of the
-# reference's 10KB/1MB switch points (coll_tuned_decision_fixed.c:53,73)
+# Decision thresholds (bytes); MCA-tunable.  Provenance (round 3): the
+# committed sweep benchmarks/baseline_cpu8.json (8-virtual-CPU loopback
+# mesh, benchmarks/capture_baseline.py) measures the algorithmic
+# crossovers: allreduce recursive_doubling beats ring below ~256KB-1MB
+# and ring wins from ~1MB up (16MB: ring 246ms vs rd 298ms); bcast
+# binomial overtakes the latency-optimal k-nomial in the same band.
+# These agree with the reference's historical 10KB/1MB switch points
+# (coll_tuned_decision_fixed.c:53,73), so the defaults keep that order of
+# magnitude.  On the loopback mesh the XLA-native path wins at EVERY
+# size (no wire: its extra bytes are shared-memory copies), so the
+# small/large routing primarily matters on real ICI, where the p-x-bytes
+# forms (masked-psum bcast, bcast+slice scatter) pay for their traffic —
+# re-measure there when a multi-chip slice is available (the bench chip
+# this round is single-device, where every collective is degenerate).
 _DEFAULT_SMALL = 16 * 1024
 _DEFAULT_LARGE = 1 * 1024 * 1024
 
@@ -268,7 +280,13 @@ def decide(opname: str, comm, x, op=None) -> str:
         if op is not None and op.xla_collective:
             return "xla"
         return "binomial"
-    if opname in ("allgather", "alltoall", "barrier", "gather", "scatter",
+    if opname in ("scatter", "gather"):
+        # The XLA forms are single-collective but move p x the payload
+        # (scatter = bcast+slice, gather = allgather): right at latency-
+        # bound sizes, wrong shape for large tensors — route those to the
+        # log(p) ppermute trees (round-3 fix of the masked-psum weakness).
+        return "xla" if nbytes < large else "binomial"
+    if opname in ("allgather", "alltoall", "barrier",
                   "allgatherv", "alltoallv"):
         # XLA's native collectives are optimal on ICI at every size; the
         # algorithmic variants exist for forced selection and benchmarking,

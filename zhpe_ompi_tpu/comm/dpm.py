@@ -187,12 +187,20 @@ def spawn(uni: LocalUniverse, ctx: RankContext, child_main: Callable,
 
 def get_parent(child_ctx: RankContext) -> Intercomm | None:
     """MPI_Comm_get_parent: the bridge to the universe that spawned this
-    one, or None for a root universe."""
+    one, or None for a root universe.  Returns the SAME communicator
+    object on every call (the MPI contract) — a fresh handle per call
+    would reset the inter-collective sequence tags and deadlock the
+    second collective against the parent's persistent handle."""
+    cached = getattr(child_ctx, "_zmpi_parent_icomm", None)
+    if cached is not None:
+        return cached
     entry = getattr(child_ctx.universe, _PARENT_ATTR, None)
     if entry is None:
         return None
     parent_uni, cid = entry
-    return Intercomm(child_ctx, parent_uni, cid)
+    icomm = Intercomm(child_ctx, parent_uni, cid)
+    child_ctx._zmpi_parent_icomm = icomm
+    return icomm
 
 
 def open_port() -> str:

@@ -142,3 +142,33 @@ def load() -> ctypes.CDLL | None:
 
 def available() -> bool:
     return load() is not None
+
+
+# -- C ABI shim (zompi_mpi.h / libzompi_mpi.so) ---------------------------
+
+_MPI_SRC = os.path.join(_HERE, "zompi_mpi.cpp")
+_mpi_lock = threading.Lock()
+
+
+def build_mpi_shim() -> str:
+    """Build libzompi_mpi.so (the mpi.h-compatible C ABI over the host
+    plane) if stale; returns the .so path.  Raises on compile failure —
+    unlike the kernel library there is no Python fallback for a C ABI."""
+    with open(_MPI_SRC, "rb") as f:
+        h = hashlib.sha256(f.read()).hexdigest()[:16]
+    so = os.path.join(_HERE, f"libzompi_mpi_{h}.so")
+    with _mpi_lock:
+        if not os.path.exists(so):
+            tmp = so + f".tmp.{os.getpid()}"
+            subprocess.run(
+                ["g++", "-O2", "-shared", "-fPIC", "-std=c++17",
+                 "-pthread", "-o", tmp, _MPI_SRC],
+                check=True, capture_output=True, text=True, timeout=120,
+            )
+            os.replace(tmp, so)
+    return so
+
+
+def mpi_header_dir() -> str:
+    """Directory containing zompi_mpi.h (for -I when compiling C users)."""
+    return _HERE

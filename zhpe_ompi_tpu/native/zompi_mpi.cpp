@@ -1,0 +1,727 @@
+/* libzompi_mpi — the C ABI shim's engine (SURVEY.md §7's "C ABI
+ * mpi.h-compatible shim" commitment).
+ *
+ * Speaks the SAME wire protocol as the Python host plane
+ * (zhpe_ompi_tpu/pt2pt/tcp.py):
+ *   - modex: connect to the coordinator, send pack(rank, [host, port]),
+ *     receive pack(address_book); rank 0 IS the coordinator (binds the
+ *     agreed address, gathers, replies) — ompi_mpi_init.c:667-700's
+ *     business-card exchange.
+ *   - data frames: 4-byte LE length + DSS(src, tag, cid, seq, payload);
+ *     payloads are DSS ndarrays (dtype tags '<i4','<i8','<f4','<f8','|u1')
+ *     so numpy on the Python side round-trips them natively.
+ *   - hello frame on each new connection announces the peer rank.
+ *   - barrier: dissemination rounds, tag 0x7FFD cid 0x7FFD, empty-bytes
+ *     payload — bit-identical to TcpProc.barrier, so mixed C/Python jobs
+ *     synchronize together.
+ *
+ * Matching: posted-receive semantics with ANY_SOURCE/ANY_TAG wildcards and
+ * per-source FIFO (arrival order scan), the contract of
+ * pml_ob1_recvfrag.c re-stated in ~40 lines because the C shim only ever
+ * has blocking receives (no posted queue needed — just the unexpected
+ * queue and a condvar).
+ *
+ * Collectives: recursive-doubling allreduce with the non-power-of-two
+ * fold (coll_base_allreduce.c:130-225 shape) and binomial bcast on a
+ * reserved cid, element-typed kernels for the four predefined ops.
+ */
+
+#include "zompi_mpi.h"
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <deque>
+#include <map>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+#include <chrono>
+
+namespace {
+
+// ---------------------------------------------------------------- DSS
+// Subset of zhpe_ompi_tpu/utils/dss.py: varints, zigzag ints, str,
+// bytes, list, ndarray.  Type tags must match dss.py exactly.
+enum DssTag : uint8_t {
+  T_NONE = 0, T_BOOL = 1, T_INT = 2, T_FLOAT = 3, T_STR = 4,
+  T_BYTES = 5, T_LIST = 6, T_TUPLE = 7, T_DICT = 8, T_NDARRAY = 9,
+};
+
+void put_varint(std::string &out, uint64_t n) {
+  while (true) {
+    uint8_t b = n & 0x7F;
+    n >>= 7;
+    if (n) out.push_back((char)(b | 0x80));
+    else { out.push_back((char)b); return; }
+  }
+}
+
+bool get_varint(const uint8_t *buf, size_t len, size_t &pos, uint64_t &n) {
+  n = 0;
+  int shift = 0;
+  while (pos < len) {
+    uint8_t b = buf[pos++];
+    n |= (uint64_t)(b & 0x7F) << shift;
+    if (!(b & 0x80)) return true;
+    shift += 7;
+  }
+  return false;
+}
+
+void put_int(std::string &out, int64_t v) {
+  out.push_back((char)T_INT);
+  uint64_t z = v >= 0 ? ((uint64_t)v << 1) : ((uint64_t)(-v) << 1 | 1);
+  put_varint(out, z);
+}
+
+void put_str(std::string &out, const std::string &s) {
+  out.push_back((char)T_STR);
+  put_varint(out, s.size());
+  out += s;
+}
+
+void put_bytes(std::string &out, const void *p, size_t n) {
+  out.push_back((char)T_BYTES);
+  put_varint(out, n);
+  out.append((const char *)p, n);
+}
+
+void put_ndarray_1d(std::string &out, const char *dtstr, const void *data,
+                    uint64_t count, uint64_t itemsize) {
+  out.push_back((char)T_NDARRAY);
+  size_t dl = strlen(dtstr);
+  put_varint(out, dl);
+  out.append(dtstr, dl);
+  put_varint(out, 1);          // ndim
+  put_varint(out, count);      // shape[0]
+  put_varint(out, count * itemsize);
+  out.append((const char *)data, count * itemsize);
+}
+
+// Parsed DSS value (only what the shim needs).
+struct DssVal {
+  uint8_t tag = T_NONE;
+  int64_t i = 0;
+  std::string s;            // str/bytes raw
+  std::string dt;           // ndarray dtype
+  std::vector<uint64_t> shape;
+  std::string data;         // ndarray raw bytes
+  std::vector<DssVal> items;  // list/tuple
+};
+
+bool parse_one(const uint8_t *buf, size_t len, size_t &pos, DssVal &v) {
+  if (pos >= len) return false;
+  v.tag = buf[pos++];
+  uint64_t n;
+  switch (v.tag) {
+    case T_NONE: return true;
+    case T_BOOL: v.i = buf[pos++]; return true;
+    case T_INT: {
+      if (!get_varint(buf, len, pos, n)) return false;
+      v.i = (n & 1) ? -(int64_t)(n >> 1) : (int64_t)(n >> 1);
+      return true;
+    }
+    case T_FLOAT: {
+      if (pos + 8 > len) return false;
+      double d;
+      memcpy(&d, buf + pos, 8);
+      pos += 8;
+      v.i = (int64_t)d;
+      return true;
+    }
+    case T_STR:
+    case T_BYTES: {
+      if (!get_varint(buf, len, pos, n) || pos + n > len) return false;
+      v.s.assign((const char *)buf + pos, n);
+      pos += n;
+      return true;
+    }
+    case T_NDARRAY: {
+      if (!get_varint(buf, len, pos, n) || pos + n > len) return false;
+      v.dt.assign((const char *)buf + pos, n);
+      pos += n;
+      uint64_t ndim;
+      if (!get_varint(buf, len, pos, ndim)) return false;
+      for (uint64_t k = 0; k < ndim; k++) {
+        uint64_t d;
+        if (!get_varint(buf, len, pos, d)) return false;
+        v.shape.push_back(d);
+      }
+      if (!get_varint(buf, len, pos, n) || pos + n > len) return false;
+      v.data.assign((const char *)buf + pos, n);
+      pos += n;
+      return true;
+    }
+    case T_LIST:
+    case T_TUPLE: {
+      if (!get_varint(buf, len, pos, n)) return false;
+      v.items.resize(n);
+      for (uint64_t k = 0; k < n; k++)
+        if (!parse_one(buf, len, pos, v.items[k])) return false;
+      return true;
+    }
+    default:
+      return false;  // dict etc: not needed by the shim
+  }
+}
+
+bool parse_all(const std::string &frame, std::vector<DssVal> &out) {
+  const uint8_t *buf = (const uint8_t *)frame.data();
+  size_t len = frame.size(), pos = 0;
+  uint64_t count;
+  if (!get_varint(buf, len, pos, count)) return false;
+  out.resize(count);
+  for (uint64_t k = 0; k < count; k++)
+    if (!parse_one(buf, len, pos, out[k])) return false;
+  return true;
+}
+
+// ------------------------------------------------------------- sockets
+
+bool send_all(int fd, const void *p, size_t n) {
+  const char *c = (const char *)p;
+  while (n) {
+    ssize_t w = ::send(fd, c, n, 0);
+    if (w <= 0) return false;
+    c += w;
+    n -= (size_t)w;
+  }
+  return true;
+}
+
+bool recv_all(int fd, void *p, size_t n) {
+  char *c = (char *)p;
+  while (n) {
+    ssize_t r = ::recv(fd, c, n, 0);
+    if (r <= 0) return false;
+    c += r;
+    n -= (size_t)r;
+  }
+  return true;
+}
+
+bool send_frame(int fd, const std::string &payload) {
+  uint32_t len = (uint32_t)payload.size();
+  uint8_t hdr[4] = {(uint8_t)(len), (uint8_t)(len >> 8),
+                    (uint8_t)(len >> 16), (uint8_t)(len >> 24)};
+  return send_all(fd, hdr, 4) && send_all(fd, payload.data(), len);
+}
+
+bool recv_frame(int fd, std::string &out) {
+  uint8_t hdr[4];
+  if (!recv_all(fd, hdr, 4)) return false;
+  uint32_t len = hdr[0] | hdr[1] << 8 | hdr[2] << 16 | hdr[3] << 24;
+  out.resize(len);
+  return len == 0 || recv_all(fd, &out[0], len);
+}
+
+int tcp_connect(const std::string &host, int port) {
+  int fd = socket(AF_INET, SOCK_STREAM, 0);
+  sockaddr_in a{};
+  a.sin_family = AF_INET;
+  a.sin_port = htons((uint16_t)port);
+  inet_pton(AF_INET, host.c_str(), &a.sin_addr);
+  for (int tries = 0; tries < 200; tries++) {
+    if (connect(fd, (sockaddr *)&a, sizeof a) == 0) {
+      int one = 1;
+      setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof one);
+      return fd;
+    }
+    usleep(50 * 1000);
+    close(fd);
+    fd = socket(AF_INET, SOCK_STREAM, 0);
+  }
+  close(fd);
+  return -1;
+}
+
+// -------------------------------------------------------------- state
+
+struct Message {
+  int64_t src, tag, cid, seq;
+  std::string dt;     // ndarray dtype or "" for bytes payload
+  std::string data;   // raw payload bytes
+};
+
+struct Shim {
+  int rank = -1, size = 0;
+  int listen_fd = -1;
+  std::string host = "127.0.0.1";
+  int listen_port = 0;
+  std::vector<std::pair<std::string, int>> book;
+  std::map<int, int> conns;  // peer rank -> fd
+  std::mutex conn_mu;
+  std::mutex send_mu;
+  std::deque<Message> unexpected;
+  std::mutex match_mu;
+  std::condition_variable match_cv;
+  std::atomic<bool> closing{false};
+  std::vector<std::thread> threads;
+  int64_t seq = 0;
+  int64_t coll_seq = 0;
+  bool initialized = false;
+};
+
+Shim g;
+
+void drain_loop(int fd) {
+  std::string frame;
+  while (!g.closing.load()) {
+    if (!recv_frame(fd, frame)) return;
+    std::vector<DssVal> vals;
+    if (!parse_all(frame, vals) || vals.size() != 5) continue;
+    Message m;
+    m.src = vals[0].i;
+    m.tag = vals[1].i;
+    m.cid = vals[2].i;
+    m.seq = vals[3].i;
+    if (vals[4].tag == T_NDARRAY) {
+      m.dt = vals[4].dt;
+      m.data = vals[4].data;
+    } else if (vals[4].tag == T_BYTES || vals[4].tag == T_STR) {
+      m.data = vals[4].s;
+    }
+    {
+      std::lock_guard<std::mutex> lk(g.match_mu);
+      g.unexpected.push_back(std::move(m));
+    }
+    g.match_cv.notify_all();
+  }
+}
+
+void accept_loop() {
+  while (!g.closing.load()) {
+    int fd = accept(g.listen_fd, nullptr, nullptr);
+    if (fd < 0) return;
+    int one = 1;
+    setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof one);
+    std::string hello;
+    if (!recv_frame(fd, hello)) { close(fd); continue; }
+    std::vector<DssVal> vals;
+    if (!parse_all(hello, vals) || vals.empty()) { close(fd); continue; }
+    if (vals[0].tag == T_INT) {
+      std::lock_guard<std::mutex> lk(g.conn_mu);
+      if (!g.conns.count((int)vals[0].i)) g.conns[(int)vals[0].i] = fd;
+    }
+    std::thread(drain_loop, fd).detach();
+  }
+}
+
+int endpoint(int dest) {
+  {
+    std::lock_guard<std::mutex> lk(g.conn_mu);
+    auto it = g.conns.find(dest);
+    if (it != g.conns.end()) return it->second;
+  }
+  int fd = tcp_connect(g.book[dest].first, g.book[dest].second);
+  if (fd < 0) return -1;
+  std::string hello;
+  put_varint(hello, 1);
+  put_int(hello, g.rank);
+  if (!send_frame(fd, hello)) { close(fd); return -1; }
+  {
+    std::lock_guard<std::mutex> lk(g.conn_mu);
+    auto it = g.conns.find(dest);
+    if (it != g.conns.end()) { close(fd); return it->second; }
+    g.conns[dest] = fd;
+  }
+  std::thread(drain_loop, fd).detach();
+  return fd;
+}
+
+struct DtInfo { const char *tag; size_t item; };
+
+bool dtinfo(MPI_Datatype dt, DtInfo &out) {
+  switch (dt) {
+    case MPI_BYTE:   out = {"|u1", 1}; return true;
+    case MPI_INT:    out = {"<i4", 4}; return true;
+    case MPI_LONG:   out = {"<i8", 8}; return true;
+    case MPI_FLOAT:  out = {"<f4", 4}; return true;
+    case MPI_DOUBLE: out = {"<f8", 8}; return true;
+  }
+  return false;
+}
+
+int raw_send(const void *buf, int count, MPI_Datatype dt, int dest,
+             int64_t tag, int64_t cid) {
+  DtInfo di;
+  if (!dtinfo(dt, di)) return MPI_ERR_ARG;
+  if (dest == g.rank) {
+    Message m;
+    m.src = g.rank; m.tag = tag; m.cid = cid; m.seq = g.seq++;
+    m.dt = di.tag;
+    m.data.assign((const char *)buf, (size_t)count * di.item);
+    {
+      std::lock_guard<std::mutex> lk(g.match_mu);
+      g.unexpected.push_back(std::move(m));
+    }
+    g.match_cv.notify_all();
+    return MPI_SUCCESS;
+  }
+  int fd = endpoint(dest);
+  if (fd < 0) return MPI_ERR_OTHER;
+  std::string payload;
+  put_varint(payload, 5);
+  put_int(payload, g.rank);
+  put_int(payload, tag);
+  put_int(payload, cid);
+  put_int(payload, g.seq++);
+  put_ndarray_1d(payload, di.tag, buf, (uint64_t)count, di.item);
+  std::lock_guard<std::mutex> lk(g.send_mu);
+  return send_frame(fd, payload) ? MPI_SUCCESS : MPI_ERR_OTHER;
+}
+
+int raw_recv(void *buf, int count, MPI_Datatype dt, int source, int64_t tag,
+             int64_t cid, MPI_Status *status) {
+  DtInfo di;
+  if (!dtinfo(dt, di)) return MPI_ERR_ARG;
+  std::unique_lock<std::mutex> lk(g.match_mu);
+  int rc = MPI_SUCCESS;
+  auto match = [&]() -> bool {
+    for (auto it = g.unexpected.begin(); it != g.unexpected.end(); ++it) {
+      if (it->cid != cid) continue;
+      if (source != MPI_ANY_SOURCE && it->src != source) continue;
+      if (tag != MPI_ANY_TAG && it->tag != tag) continue;
+      size_t have = it->data.size();
+      size_t want = (size_t)count * di.item;
+      size_t copied = have > want ? want : have;
+      memcpy(buf, it->data.data(), copied);
+      if (have > want) rc = MPI_ERR_TRUNCATE;  // MPI truncation error
+      if (status) {
+        status->MPI_SOURCE = (int)it->src;
+        status->MPI_TAG = (int)it->tag;
+        status->MPI_ERROR = rc;
+        status->_count = (int)(copied / di.item);
+      }
+      g.unexpected.erase(it);
+      return true;
+    }
+    return false;
+  };
+  // wait until a matching message arrives (blocking recv only)
+  while (!match()) {
+    g.match_cv.wait_for(lk, std::chrono::milliseconds(100));
+    if (g.closing.load()) return MPI_ERR_OTHER;
+  }
+  return rc;
+}
+
+// reduction kernels for the predefined ops
+template <typename T>
+void reduce_t(T *acc, const T *in, int n, MPI_Op op) {
+  for (int i = 0; i < n; i++) {
+    switch (op) {
+      case MPI_SUM:  acc[i] = acc[i] + in[i]; break;
+      case MPI_PROD: acc[i] = acc[i] * in[i]; break;
+      case MPI_MAX:  acc[i] = acc[i] > in[i] ? acc[i] : in[i]; break;
+      case MPI_MIN:  acc[i] = acc[i] < in[i] ? acc[i] : in[i]; break;
+    }
+  }
+}
+
+void reduce_buf(void *acc, const void *in, int n, MPI_Datatype dt,
+                MPI_Op op) {
+  switch (dt) {
+    case MPI_INT:
+      reduce_t((int32_t *)acc, (const int32_t *)in, n, op); break;
+    case MPI_LONG:
+      reduce_t((int64_t *)acc, (const int64_t *)in, n, op); break;
+    case MPI_FLOAT:
+      reduce_t((float *)acc, (const float *)in, n, op); break;
+    case MPI_DOUBLE:
+      reduce_t((double *)acc, (const double *)in, n, op); break;
+    case MPI_BYTE:
+      reduce_t((uint8_t *)acc, (const uint8_t *)in, n, op); break;
+  }
+}
+
+}  // namespace
+
+// ------------------------------------------------------------ C ABI
+
+extern "C" {
+
+int MPI_Init(int *, char ***) {
+  if (g.initialized) return MPI_ERR_OTHER;
+  const char *r = getenv("ZMPI_RANK");
+  const char *s = getenv("ZMPI_SIZE");
+  const char *ch = getenv("ZMPI_COORD_HOST");
+  const char *cp = getenv("ZMPI_COORD_PORT");
+  if (!r || !s || !ch || !cp) {
+    fprintf(stderr, "zompi: ZMPI_RANK/SIZE/COORD_HOST/COORD_PORT unset\n");
+    return MPI_ERR_OTHER;
+  }
+  g.rank = atoi(r);
+  g.size = atoi(s);
+  std::string coord_host = ch;
+  int coord_port = atoi(cp);
+
+  // listener (btl_tcp's per-proc endpoint)
+  g.listen_fd = socket(AF_INET, SOCK_STREAM, 0);
+  int one = 1;
+  setsockopt(g.listen_fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof one);
+  sockaddr_in a{};
+  a.sin_family = AF_INET;
+  a.sin_port = 0;
+  inet_pton(AF_INET, g.host.c_str(), &a.sin_addr);
+  if (bind(g.listen_fd, (sockaddr *)&a, sizeof a) != 0) return MPI_ERR_OTHER;
+  socklen_t alen = sizeof a;
+  getsockname(g.listen_fd, (sockaddr *)&a, &alen);
+  g.listen_port = ntohs(a.sin_port);
+  listen(g.listen_fd, g.size + 4);
+  g.threads.emplace_back(accept_loop);
+
+  // modex (tcp.py _modex wire protocol)
+  if (g.rank == 0) {
+    int srv = socket(AF_INET, SOCK_STREAM, 0);
+    setsockopt(srv, SOL_SOCKET, SO_REUSEADDR, &one, sizeof one);
+    sockaddr_in ca{};
+    ca.sin_family = AF_INET;
+    ca.sin_port = htons((uint16_t)coord_port);
+    inet_pton(AF_INET, coord_host.c_str(), &ca.sin_addr);
+    if (bind(srv, (sockaddr *)&ca, sizeof ca) != 0) return MPI_ERR_OTHER;
+    listen(srv, g.size + 4);
+    g.book.assign(g.size, {"", 0});
+    g.book[0] = {g.host, g.listen_port};
+    std::vector<int> peers;
+    for (int i = 0; i < g.size - 1; i++) {
+      int c = accept(srv, nullptr, nullptr);
+      std::string f;
+      if (!recv_frame(c, f)) return MPI_ERR_OTHER;
+      std::vector<DssVal> vals;
+      if (!parse_all(f, vals) || vals.size() != 2) return MPI_ERR_OTHER;
+      int peer = (int)vals[0].i;
+      g.book[peer] = {vals[1].items[0].s, (int)vals[1].items[1].i};
+      peers.push_back(c);
+    }
+    std::string reply;
+    put_varint(reply, 1);
+    reply.push_back((char)T_LIST);
+    put_varint(reply, g.size);
+    for (auto &e : g.book) {
+      reply.push_back((char)T_LIST);
+      put_varint(reply, 2);
+      put_str(reply, e.first);
+      put_int(reply, e.second);
+    }
+    for (int c : peers) {
+      send_frame(c, reply);
+      close(c);
+    }
+    close(srv);
+  } else {
+    int c = tcp_connect(coord_host, coord_port);
+    if (c < 0) return MPI_ERR_OTHER;
+    std::string f;
+    put_varint(f, 2);
+    put_int(f, g.rank);
+    f.push_back((char)T_LIST);
+    put_varint(f, 2);
+    put_str(f, g.host);
+    put_int(f, g.listen_port);
+    if (!send_frame(c, f)) return MPI_ERR_OTHER;
+    std::string reply;
+    if (!recv_frame(c, reply)) return MPI_ERR_OTHER;
+    close(c);
+    std::vector<DssVal> vals;
+    if (!parse_all(reply, vals) || vals.size() != 1) return MPI_ERR_OTHER;
+    g.book.clear();
+    for (auto &e : vals[0].items)
+      g.book.push_back({e.items[0].s, (int)e.items[1].i});
+  }
+  g.initialized = true;
+  return MPI_SUCCESS;
+}
+
+int MPI_Initialized(int *flag) {
+  *flag = g.initialized ? 1 : 0;
+  return MPI_SUCCESS;
+}
+
+int MPI_Finalize(void) {
+  // Tear down without an implicit barrier: MPI allows but does not
+  // require Finalize to synchronize, and an implicit barrier would
+  // deadlock mixed C/Python jobs whose Python endpoints close() without
+  // one.  Programs needing quiescence call MPI_Barrier themselves (the
+  // examples do).
+  g.closing.store(true);
+  shutdown(g.listen_fd, SHUT_RDWR);
+  close(g.listen_fd);
+  {
+    std::lock_guard<std::mutex> lk(g.conn_mu);
+    for (auto &kv : g.conns) close(kv.second);
+    g.conns.clear();
+  }
+  for (auto &t : g.threads) t.join();
+  g.threads.clear();
+  g.initialized = false;
+  return MPI_SUCCESS;
+}
+
+int MPI_Comm_rank(MPI_Comm, int *rank) {
+  *rank = g.rank;
+  return MPI_SUCCESS;
+}
+
+int MPI_Comm_size(MPI_Comm, int *size) {
+  *size = g.size;
+  return MPI_SUCCESS;
+}
+
+int MPI_Send(const void *buf, int count, MPI_Datatype dt, int dest,
+             int tag, MPI_Comm) {
+  if (tag < 0) return MPI_ERR_ARG;
+  if (dest < 0 || dest >= g.size) return MPI_ERR_ARG;
+  return raw_send(buf, count, dt, dest, tag, 0);
+}
+
+int MPI_Recv(void *buf, int count, MPI_Datatype dt, int source, int tag,
+             MPI_Comm, MPI_Status *status) {
+  return raw_recv(buf, count, dt, source, tag, 0, status);
+}
+
+int MPI_Get_count(const MPI_Status *status, MPI_Datatype, int *count) {
+  *count = status->_count;
+  return MPI_SUCCESS;
+}
+
+int MPI_Barrier(MPI_Comm) {
+  // dissemination rounds, wire-identical to TcpProc.barrier (tag/cid
+  // 0x7FFD, empty-bytes payload)
+  for (int64_t k = 1; k < g.size; k <<= 1) {
+    int dest = (int)((g.rank + k) % g.size);
+    int fd = dest == g.rank ? -2 : endpoint(dest);
+    if (dest == g.rank) {
+      // size 1: nothing on the wire
+    } else {
+      if (fd < 0) return MPI_ERR_OTHER;
+      std::string payload;
+      put_varint(payload, 5);
+      put_int(payload, g.rank);
+      put_int(payload, 0x7FFD);
+      put_int(payload, 0x7FFD);
+      put_int(payload, g.seq++);
+      put_bytes(payload, "", 0);
+      {
+        std::lock_guard<std::mutex> lk(g.send_mu);
+        if (!send_frame(fd, payload)) return MPI_ERR_OTHER;
+      }
+      int src = (int)((g.rank - k % g.size + g.size) % g.size);
+      uint8_t dummy[1];
+      int rc = raw_recv(dummy, 0, MPI_BYTE, src, 0x7FFD, 0x7FFD, nullptr);
+      if (rc != MPI_SUCCESS) return rc;
+    }
+  }
+  return MPI_SUCCESS;
+}
+
+int MPI_Allreduce(const void *sendbuf, void *recvbuf, int count,
+                  MPI_Datatype dt, MPI_Op op, MPI_Comm) {
+  // recursive doubling with the non-power-of-two pre/post fold
+  // (in-order combines: lower rank's operand left)
+  DtInfo di;
+  if (!dtinfo(dt, di)) return MPI_ERR_ARG;
+  size_t nbytes = (size_t)count * di.item;
+  memcpy(recvbuf, sendbuf, nbytes);
+  if (g.size == 1) return MPI_SUCCESS;
+  int64_t cid = 0x7FFC;
+  int64_t tag = (g.coll_seq++ % 0x8000) << 16 | 0x7E03;
+  std::vector<char> other(nbytes);
+
+  int pof2 = 1;
+  while (pof2 * 2 <= g.size) pof2 *= 2;
+  int rem = g.size - pof2;
+  int newrank;
+  if (g.rank < 2 * rem) {
+    if (g.rank % 2 == 0) {
+      int rc = raw_send(recvbuf, count, dt, g.rank + 1, tag, cid);
+      if (rc) return rc;
+      newrank = -1;
+    } else {
+      int rc = raw_recv(other.data(), count, dt, g.rank - 1, tag, cid,
+                        nullptr);
+      if (rc) return rc;
+      // lower rank's operand left: acc = other ⊕ acc
+      std::vector<char> tmp(other);
+      reduce_buf(tmp.data(), recvbuf, count, dt, op);
+      memcpy(recvbuf, tmp.data(), nbytes);
+      newrank = g.rank / 2;
+    }
+  } else {
+    newrank = g.rank - rem;
+  }
+  if (newrank >= 0) {
+    for (int mask = 1; mask < pof2; mask <<= 1) {
+      int pnew = newrank ^ mask;
+      int partner = pnew < rem ? pnew * 2 + 1 : pnew + rem;
+      int rc = raw_send(recvbuf, count, dt, partner, tag, cid);
+      if (rc) return rc;
+      rc = raw_recv(other.data(), count, dt, partner, tag, cid, nullptr);
+      if (rc) return rc;
+      if (partner < g.rank) {
+        std::vector<char> tmp(other);
+        reduce_buf(tmp.data(), recvbuf, count, dt, op);
+        memcpy(recvbuf, tmp.data(), nbytes);
+      } else {
+        reduce_buf(recvbuf, other.data(), count, dt, op);
+      }
+    }
+  }
+  if (g.rank < 2 * rem) {
+    if (g.rank % 2 == 0) {
+      int rc = raw_recv(recvbuf, count, dt, g.rank + 1, tag, cid, nullptr);
+      if (rc) return rc;
+    } else {
+      int rc = raw_send(recvbuf, count, dt, g.rank - 1, tag, cid);
+      if (rc) return rc;
+    }
+  }
+  return MPI_SUCCESS;
+}
+
+int MPI_Bcast(void *buf, int count, MPI_Datatype dt, int root, MPI_Comm) {
+  // binomial tree (coll_base_bcast.c:329 shape)
+  int64_t cid = 0x7FFC;
+  int64_t tag = (g.coll_seq++ % 0x8000) << 16 | 0x7E01;
+  int vrank = (g.rank - root + g.size) % g.size;
+  if (vrank != 0) {
+    int parent = ((vrank & (vrank - 1)) + root) % g.size;
+    int rc = raw_recv(buf, count, dt, parent, tag, cid, nullptr);
+    if (rc) return rc;
+  }
+  for (int mask = 1; mask < g.size; mask <<= 1) {
+    if ((vrank & (mask - 1)) == 0 && (vrank | mask) != vrank) {
+      int child = vrank | mask;
+      if (child < g.size) {
+        int rc = raw_send(buf, count, dt, (child + root) % g.size, tag,
+                          cid);
+        if (rc) return rc;
+      }
+    }
+  }
+  return MPI_SUCCESS;
+}
+
+int MPI_Abort(MPI_Comm, int errorcode) {
+  fprintf(stderr, "MPI_Abort(%d)\n", errorcode);
+  _exit(errorcode ? errorcode : 1);
+}
+
+double MPI_Wtime(void) {
+  using clock = std::chrono::steady_clock;
+  return std::chrono::duration<double>(clock::now().time_since_epoch())
+      .count();
+}
+
+}  // extern "C"

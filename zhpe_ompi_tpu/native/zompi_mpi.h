@@ -1,0 +1,82 @@
+/* zompi_mpi.h — mpi.h-compatible C ABI over the framework's host plane.
+ *
+ * The reference exposes its C API in ompi/include/mpi.h with bindings in
+ * ompi/mpi/c (MPI_Send at ompi/mpi/c/send.c:45, MPI_Init at
+ * ompi/mpi/c/init.c).  This shim is that surface re-implemented over the
+ * framework's TCP host plane: a C program compiled against this header
+ * and linked with libzompi_mpi.so becomes a rank of the same universe the
+ * Python TcpProc endpoints form — identical modex, framing, and barrier
+ * wire protocol, so C and Python ranks interoperate in one job.
+ *
+ * Wire-up (the PMIx-env analog): MPI_Init reads
+ *   ZMPI_RANK        this process's rank
+ *   ZMPI_SIZE        job size
+ *   ZMPI_COORD_HOST  modex coordinator host (rank 0 binds it)
+ *   ZMPI_COORD_PORT  modex coordinator port
+ * which the launcher (or test harness) provides, exactly as mpirun's
+ * daemons seed OMPI_COMM_WORLD_RANK / PMIx env vars.
+ */
+
+#ifndef ZOMPI_MPI_H
+#define ZOMPI_MPI_H
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef int MPI_Comm;
+#define MPI_COMM_WORLD 0
+
+typedef int MPI_Datatype;
+#define MPI_BYTE   0
+#define MPI_INT    1
+#define MPI_LONG   2
+#define MPI_FLOAT  3
+#define MPI_DOUBLE 4
+
+typedef int MPI_Op;
+#define MPI_SUM  0
+#define MPI_PROD 1
+#define MPI_MAX  2
+#define MPI_MIN  3
+
+#define MPI_ANY_SOURCE (-1)
+#define MPI_ANY_TAG    (-1)
+
+#define MPI_SUCCESS      0
+#define MPI_ERR_OTHER    16
+#define MPI_ERR_ARG      13
+#define MPI_ERR_TRUNCATE 15
+
+typedef struct MPI_Status {
+  int MPI_SOURCE;
+  int MPI_TAG;
+  int MPI_ERROR;
+  int _count; /* received element count */
+} MPI_Status;
+
+#define MPI_STATUS_IGNORE ((MPI_Status *)0)
+
+int MPI_Init(int *argc, char ***argv);
+int MPI_Initialized(int *flag);
+int MPI_Finalize(void);
+int MPI_Comm_rank(MPI_Comm comm, int *rank);
+int MPI_Comm_size(MPI_Comm comm, int *size);
+int MPI_Send(const void *buf, int count, MPI_Datatype dt, int dest,
+             int tag, MPI_Comm comm);
+int MPI_Recv(void *buf, int count, MPI_Datatype dt, int source, int tag,
+             MPI_Comm comm, MPI_Status *status);
+int MPI_Get_count(const MPI_Status *status, MPI_Datatype dt, int *count);
+int MPI_Barrier(MPI_Comm comm);
+int MPI_Allreduce(const void *sendbuf, void *recvbuf, int count,
+                  MPI_Datatype dt, MPI_Op op, MPI_Comm comm);
+int MPI_Bcast(void *buf, int count, MPI_Datatype dt, int root,
+              MPI_Comm comm);
+int MPI_Abort(MPI_Comm comm, int errorcode);
+double MPI_Wtime(void);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* ZOMPI_MPI_H */

@@ -168,7 +168,19 @@ class AmService:
 
     # -- the service loop -------------------------------------------------
 
+    # which message element carries the reply tag, per RPC op — error
+    # replies must never guess (a guessed msg[-1] could collide with an
+    # unrelated RPC's tag, e.g. a lock key that happens to be >= 0x100)
+    _REPLY_TAG_INDEX = {
+        "get": 4, "get_acc": 5, "cas": 5, "flush": 2, "lock": 3,
+        "dlock": 3, "dtrylock": 3, "dyn_get": 4, "dyn_iget": 6,
+        "dyn_amo": 7,
+    }
+
     def _serve(self) -> None:
+        from ..mca import output as mca_output
+
+        stream = mca_output.open_stream("osc_am")
         while not self._stop.is_set():
             try:
                 msg, status = self.ep.recv(
@@ -183,11 +195,19 @@ class AmService:
                 self._dispatch(msg, status.source)
             except errors.MpiError as e:
                 # target-side failure travels back on the reply tag when
-                # the op expects a reply; fire-and-forget ops log it
-                reply_tag = msg[-1] if isinstance(msg[-1], int) else None
-                if reply_tag is not None and reply_tag >= 0x100:
-                    self._reply(status.source, reply_tag,
+                # the op is an RPC; fire-and-forget ops (put/acc/unlock/
+                # dyn_put/...) have no reply channel — log the loss
+                idx = self._REPLY_TAG_INDEX.get(msg[0])
+                if idx is not None:
+                    self._reply(status.source, msg[idx],
                                 ("err", type(e).__name__, str(e)))
+                else:
+                    mca_output.emit(
+                        stream,
+                        "one-sided %r from rank %s failed at the target: "
+                        "%s: %s", msg[0], status.source,
+                        type(e).__name__, e,
+                    )
 
     def _reply(self, origin: int, tag: int, payload: Any) -> None:
         self.ep.send(payload, origin, tag=tag, cid=AM_CID)
@@ -241,7 +261,11 @@ class AmService:
         elif op == "lock":
             _, win_id, lock_type, reply_tag = msg
             st = self._win(win_id)
-            if st.lockman.try_grant(origin, lock_type):
+            # FIFO fairness: an immediate grant only when nobody is queued
+            # — otherwise new SHARED requests would starve a waiting writer
+            if not st.lockman.waiters and st.lockman.try_grant(
+                origin, lock_type
+            ):
                 self._reply(origin, reply_tag, ("ok", None))
             else:
                 st.lockman.waiters.append((origin, lock_type, reply_tag))
@@ -327,7 +351,7 @@ class AmService:
             _, win_id, key, reply_tag = msg
             st = self._win(win_id)
             man = st.dist_locks.setdefault(key, _LockManager())
-            if man.try_grant(origin, LOCK_EXCLUSIVE):
+            if not man.waiters and man.try_grant(origin, LOCK_EXCLUSIVE):
                 self._reply(origin, reply_tag, ("ok", None))
             else:
                 man.waiters.append((origin, LOCK_EXCLUSIVE, reply_tag))
@@ -367,8 +391,12 @@ def apply_put(st: _AmWinState, offset: int, data: np.ndarray) -> None:
 def read_window(st: _AmWinState, offset: int, count: int | None
                 ) -> np.ndarray:
     flat = st.buffer
+    if offset < 0 or offset > flat.size:
+        raise errors.WinError(
+            f"get offset {offset} outside window of {flat.size}"
+        )
     count = flat.size - offset if count is None else count
-    if offset < 0 or offset + count > flat.size:
+    if count < 0 or offset + count > flat.size:
         raise errors.WinError("get overruns window")
     return flat[offset : offset + count].copy()
 
