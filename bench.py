@@ -235,13 +235,24 @@ def main():
     # Pallas flash kernels + remat — the regime the flash backward was
     # built for (naive attention OOMs here).  Reported as extra fields on
     # the same line (the driver's one-JSON-line contract).
-    if on_tpu:
-        lc_cfg = tfm.Config(
-            vocab=8192, d_model=1024, n_heads=16, d_ff=4096, n_layers=4,
-            seq=4096, dtype=jnp.bfloat16, remat=True,
-        )
-        lc_batch = 2 * dp
-        lc_iters = 8
+    # ZMPI_BENCH_SMOKE=1 exercises this path off-TPU with tiny shapes so
+    # the program structure is testable without a chip.
+    import os as _os
+
+    smoke = _os.environ.get("ZMPI_BENCH_SMOKE") == "1"
+    if on_tpu or smoke:
+        if smoke and not on_tpu:
+            lc_cfg = tfm.Config(
+                vocab=128, d_model=64, n_heads=4, d_ff=128, n_layers=2,
+                seq=256, dtype=jnp.float32, remat=True,
+            )
+            lc_batch, lc_iters = 1 * dp, 2
+        else:
+            lc_cfg = tfm.Config(
+                vocab=8192, d_model=1024, n_heads=16, d_ff=4096,
+                n_layers=4, seq=4096, dtype=jnp.bfloat16, remat=True,
+            )
+            lc_batch, lc_iters = 2 * dp, 8
         lc_tokens = jnp.asarray(
             r.integers(0, lc_cfg.vocab, (lc_batch, lc_cfg.seq)))
         lc_targets = jnp.asarray(

@@ -229,3 +229,66 @@ class TestTcpCollectives:
         for d, (m, r) in enumerate(res):
             assert m == [f"{s}->{d}" for s in range(4)]
             assert (r == 6.0) if d == 2 else (r is None)
+
+
+class TestHostAlgorithmSelection:
+    """Round 3 (Weak #8): the host plane selects by payload size — ring
+    allreduce for large commutative arrays, recursive doubling otherwise."""
+
+    def test_large_array_ring_matches_numpy(self):
+        from tests.test_tcp import run_tcp
+        from zhpe_ompi_tpu.mca import var as mca_var
+
+        n = 4
+        per = 5000  # 40 KB f64; force the ring with a small threshold
+        old = mca_var.get("host_coll_large_msg")
+        mca_var.set_var("host_coll_large_msg", 1024)
+        try:
+            def prog(p):
+                x = np.arange(per, dtype=np.float64) * (p.rank + 1)
+                out = p.allreduce(x, zops.SUM)
+                return out
+
+            res = run_tcp(n, prog)
+        finally:
+            mca_var.set_var("host_coll_large_msg", old)
+        expect = np.arange(per, dtype=np.float64) * sum(
+            r + 1 for r in range(n)
+        )
+        for r in range(n):
+            np.testing.assert_allclose(res[r], expect)
+
+    def test_ring_skipped_for_noncommutative(self):
+        """Non-commutative ops must stay on the in-order doubling path
+        regardless of size."""
+        from zhpe_ompi_tpu.mca import var as mca_var
+        from zhpe_ompi_tpu.pt2pt.universe import LocalUniverse
+
+        cat = zops.create_op(lambda a, b: a + b, commute=False)
+        uni = LocalUniverse(3)
+        old = mca_var.get("host_coll_large_msg")
+        mca_var.set_var("host_coll_large_msg", 1)
+        try:
+            res = uni.run(lambda ctx: ctx.allreduce(f"{ctx.rank}", cat))
+        finally:
+            mca_var.set_var("host_coll_large_msg", old)
+        assert res == ["012"] * 3
+
+    def test_odd_size_ring(self):
+        """Ring with a comm size that does not divide the array."""
+        from zhpe_ompi_tpu.mca import var as mca_var
+        from zhpe_ompi_tpu.pt2pt.universe import LocalUniverse
+
+        uni = LocalUniverse(3)
+        old = mca_var.get("host_coll_large_msg")
+        mca_var.set_var("host_coll_large_msg", 8)
+        try:
+            res = uni.run(
+                lambda ctx: ctx.allreduce(
+                    np.full(7, float(ctx.rank + 1)), zops.MAX
+                )
+            )
+        finally:
+            mca_var.set_var("host_coll_large_msg", old)
+        for r in res:
+            np.testing.assert_allclose(r, np.full(7, 3.0))

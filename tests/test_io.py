@@ -240,3 +240,76 @@ class TestSharded:
             f.write(b"garbage" * 100)
         with pytest.raises(errors.ArgError):
             zio.load_sharded(p)
+
+
+class TestFcollStrategies:
+    """Round 3: the fcoll sub-framework — every strategy must produce
+    identical file contents (two_phase vs dynamic vs individual), selected
+    via the MCA fcoll variable like the reference's ZMPI_MCA_fcoll."""
+
+    @pytest.mark.parametrize("strategy", ["two_phase", "dynamic",
+                                          "individual"])
+    def test_interleaved_write_all(self, tmp_path, strategy):
+        from zhpe_ompi_tpu.datatype import derived
+        from zhpe_ompi_tpu.datatype.predefined import FLOAT
+        from zhpe_ompi_tpu.io import file as iofile
+        from zhpe_ompi_tpu.mca import var as mca_var
+
+        n = 4
+        path = str(tmp_path / f"fcoll_{strategy}.bin")
+        old = mca_var.get("fcoll", "")
+        mca_var.set_var("fcoll", strategy)
+        try:
+            comm = type("C", (), {"size": n})()
+            f = iofile.File(comm, path,
+                            iofile.MODE_CREATE | iofile.MODE_RDWR)
+            # interleaved rank-strided views: rank r owns every n-th float
+            # filetype = one float resized to an n-float extent, so rank
+            # r (displaced r floats) owns every n-th element
+            ft = derived.create_resized(FLOAT, 0, 4 * n)
+            for r in range(n):
+                f.set_view(disp=r * 4, etype=FLOAT, filetype=ft, rank=r)
+            bufs = [np.full(8, float(r + 1), np.float32) for r in range(n)]
+            total = f.write_all(bufs)
+            assert total == n * 8
+            for r in range(n):
+                f.seek(0, rank=r)  # rewind for the read-back
+            out = f.read_all([8] * n)
+            f.close()
+        finally:
+            mca_var.set_var("fcoll", old)
+        for r in range(n):
+            np.testing.assert_allclose(out[r], bufs[r])
+        raw = np.fromfile(path, np.float32)
+        expect = np.tile(np.arange(1, n + 1, dtype=np.float32), 8)
+        np.testing.assert_allclose(raw, expect)
+
+    def test_dynamic_stripe_var(self, tmp_path):
+        """The dynamic strategy honors its stripe-size MCA var (tiny
+        stripes force many independent aggregation segments)."""
+        from zhpe_ompi_tpu.io import file as iofile
+        from zhpe_ompi_tpu.mca import var as mca_var
+
+        path = str(tmp_path / "stripe.bin")
+        old_f = mca_var.get("fcoll", "")
+        mca_var.set_var("fcoll", "dynamic")
+        try:
+            comm = type("C", (), {"size": 2})()
+            f = iofile.File(comm, path,
+                            iofile.MODE_CREATE | iofile.MODE_RDWR)
+            mca_var.set_var("fcoll_dynamic_stripe", 64)
+            data = [np.arange(256, dtype=np.uint8),
+                    np.arange(256, dtype=np.uint8)[::-1].copy()]
+            # rank 1's bytes follow rank 0's (different displacements)
+            from zhpe_ompi_tpu.datatype.predefined import BYTE
+            f.set_view(disp=0, etype=BYTE, rank=0)
+            f.set_view(disp=256, etype=BYTE, rank=1)
+            f.write_all(data)
+            for r in range(2):
+                f.seek(0, rank=r)
+            back = f.read_all([256, 256])
+            f.close()
+        finally:
+            mca_var.set_var("fcoll", old_f)
+        np.testing.assert_array_equal(back[0], data[0])
+        np.testing.assert_array_equal(back[1], data[1])

@@ -48,9 +48,14 @@ def run_tcp(n, fn, timeout=60.0):
     for t in threads:
         t.join(timeout)
         assert not t.is_alive(), "tcp rank hung"
-    for e in excs:
-        if e is not None:
-            raise e
+    if any(e is not None for e in excs):
+        # a stuck rank usually cascades: show every rank's state so the
+        # ORIGIN of the stall is visible, not just the first timeout
+        for r, e in enumerate(excs):
+            if e is not None:
+                print(f"[run_tcp] rank {r} raised: {type(e).__name__}: {e}",
+                      flush=True)
+        raise next(e for e in excs if e is not None)
     return results
 
 
@@ -193,3 +198,133 @@ class TestWire:
 
         out = run_tcp(2, prog)
         assert out[0] == (14, 1, 1) and out[1] == (7, 1, 1)
+
+
+class TestRendezvous:
+    """RTS/CTS above tcp_eager_limit: large payloads park at the SENDER
+    until the receiver matches (round-3 fix of eager-only weakness)."""
+
+    def test_large_message_rendezvous(self):
+        from zhpe_ompi_tpu.mca import var as mca_var
+
+        big = np.arange(1 << 18, dtype=np.float64)  # 2 MB > 1 MB limit
+
+        def prog(p):
+            if p.rank == 0:
+                p.send(big, dest=1, tag=21)
+                return True
+            got = p.recv(source=0, tag=21, timeout=20.0)
+            return bool(np.array_equal(got, big))
+
+        assert run_tcp(2, prog) == [True, True]
+
+    def test_payload_parks_at_sender_until_matched(self):
+        """The data frame must not cross the wire before the receiver
+        posts a matching recv: the sender's pending table holds it."""
+
+        def prog(p):
+            big = np.zeros(1 << 18, np.float64)
+            if p.rank == 0:
+                p.send(big, dest=1, tag=22)  # returns after RTS only
+                # data still pending (receiver hasn't matched)
+                p.recv(source=1, tag=23)  # receiver: "I have NOT matched"
+                pending_before = len(p._pending_rndv)
+                p.send(b"now", dest=1, tag=24)
+                got_back = p.recv(source=1, tag=25, timeout=20.0)
+                pending_after = len(p._pending_rndv)
+                return (pending_before, got_back, pending_after)
+            import time
+
+            time.sleep(0.3)  # let the RTS arrive unmatched
+            p.send(b"unmatched", dest=0, tag=23)
+            p.recv(source=0, tag=24)
+            got = p.recv(source=0, tag=22, timeout=20.0)  # NOW match
+            p.send(float(got.size), dest=0, tag=25)
+            return None
+
+        res = run_tcp(2, prog)
+        pending_before, got_back, pending_after = res[0]
+        assert pending_before == 1  # parked at sender while unmatched
+        assert got_back == float(1 << 18)
+        assert pending_after == 0  # released after the CTS
+
+    def test_interleaved_large_and_small(self):
+        """Eager traffic keeps flowing while a rendezvous is pending, and
+        two overlapping rendezvous sends resolve independently."""
+
+        def prog(p):
+            a = np.full(1 << 17, 1.0)  # 1 MB threshold exceeded? 1<<17*8=1MB
+            b = np.full(1 << 18, 2.0)  # 2 MB
+            if p.rank == 0:
+                p.send(b, dest=1, tag=31)
+                p.send(a, dest=1, tag=30)
+                p.send(b"small", dest=1, tag=32)
+                return True
+            small = p.recv(source=0, tag=32, timeout=20.0)
+            gb = p.recv(source=0, tag=31, timeout=20.0)
+            ga = p.recv(source=0, tag=30, timeout=20.0)
+            return (small, float(ga[0]), ga.size, float(gb[0]), gb.size)
+
+        res = run_tcp(2, prog)
+        assert res[1] == (b"small", 1.0, 1 << 17, 2.0, 1 << 18)
+
+    def test_rendezvous_through_collectives(self):
+        """A large-payload host-plane collective rides the rendezvous
+        path transparently (coll rides the PML layering)."""
+
+        def prog(p):
+            big = np.full(1 << 18, float(p.rank + 1))
+            out = p.allreduce(big, __import__(
+                "zhpe_ompi_tpu.ops", fromlist=["SUM"]).SUM)
+            return float(np.asarray(out)[0])
+
+        assert run_tcp(4, prog, timeout=90.0) == [10.0] * 4
+
+    def test_bidirectional_large_exchange(self):
+        """Two ranks streaming >eager-limit payloads at each other must
+        not deadlock: the rendezvous data push runs off the drain thread
+        (a drain blocked in sendall would stop reading and wedge both
+        kernel buffers)."""
+
+        big = np.arange(1 << 19, dtype=np.float64)  # 4 MB each way
+
+        def prog(p):
+            other = 1 - p.rank
+            got = p.sendrecv(big * (p.rank + 1), dest=other, source=other,
+                             sendtag=44, recvtag=44)
+            return float(np.asarray(got)[1])
+
+        res = run_tcp(2, prog, timeout=90.0)
+        assert res == [2.0, 1.0]
+
+    def test_container_payload_uses_rendezvous(self):
+        """Tuple-wrapped large arrays must count their bytes for the
+        eager/rendezvous switch (host collectives ship (idx, block)
+        tuples)."""
+        from zhpe_ompi_tpu.pt2pt.tcp import _payload_size
+
+        arr = np.zeros(1 << 18, np.float64)  # 2 MB
+        assert _payload_size(arr) == arr.nbytes
+        assert _payload_size((3, arr)) >= arr.nbytes
+        assert _payload_size([arr, arr]) >= 2 * arr.nbytes
+        assert _payload_size({"k": arr}) >= arr.nbytes
+
+        def prog(p):
+            if p.rank == 0:
+                p.send((7, arr), dest=1, tag=45)
+                # the tuple must have parked (RTS sent, data pending)
+                pending = len(p._pending_rndv)
+                p.send(pending, dest=1, tag=46)
+                return True
+            import time
+
+            time.sleep(0.3)  # leave the RTS unmatched for a moment
+            pending = p.recv(source=0, tag=46, timeout=20.0)
+            idx, got = p.recv(source=0, tag=45, timeout=20.0)
+            return (pending, idx, got.size)
+
+        res = run_tcp(2, prog)
+        # note: rank 0 sampled pending AFTER its own send returned but
+        # possibly before rank 1 matched — it must have been >= 1 at RTS
+        # time; by match time the transfer completes
+        assert res[1][1] == 7 and res[1][2] == 1 << 18

@@ -30,7 +30,19 @@ from __future__ import annotations
 
 from typing import Any
 
+import numpy as np
+
 from ..core import errors
+from ..mca import var as mca_var
+
+mca_var.register(
+    "host_coll_large_msg", 256 * 1024,
+    "Array payload size (bytes) above which host-plane collectives switch "
+    "to bandwidth-optimal algorithms (ring allreduce).  Provenance: the "
+    "committed pt2pt ladder (benchmarks/baseline_cpu8.json) crosses from "
+    "latency- to bandwidth-dominated between 16KB and 256KB one-way",
+    type=int,
+)
 
 # Reserved context id for host-plane collective traffic (the
 # MCA_COLL_BASE_TAG_* space; barrier already uses cid 0x7FFF).
@@ -163,14 +175,60 @@ def reduce(ctx, value: Any, op, root: int = 0) -> Any:
 # -------------------------------------------------------------- allreduce
 
 
+def _allreduce_ring(ctx, value: np.ndarray, op, tag: int) -> np.ndarray:
+    """Ring allreduce (reduce-scatter + allgather,
+    coll_base_allreduce.c:341 shape): 2(p-1) steps moving ~2·nbytes/p per
+    step — the bandwidth-optimal choice for large arrays on a wire.
+    Commutative ops only (ring combine order is ring order, not rank
+    order); the caller guards."""
+    size, rank = ctx.size, ctx.rank
+    flat = np.ascontiguousarray(value).reshape(-1)
+    bounds = np.linspace(0, flat.size, size + 1).astype(np.int64)
+    chunks = [flat[bounds[i] : bounds[i + 1]].copy() for i in range(size)]
+    right, left = (rank + 1) % size, (rank - 1) % size
+    # reduce-scatter phase: after p-1 steps, chunk (rank+1)%size is done
+    for step in range(size - 1):
+        send_idx = (rank - step) % size
+        recv_idx = (rank - step - 1) % size
+        got = ctx.sendrecv(
+            chunks[send_idx], right, source=left,
+            sendtag=tag, recvtag=tag, cid=COLL_CID,
+        )
+        chunks[recv_idx] = op(got, chunks[recv_idx])
+    # allgather phase: circulate the finished chunks
+    for step in range(size - 1):
+        send_idx = (rank + 1 - step) % size
+        recv_idx = (rank - step) % size
+        chunks[recv_idx] = ctx.sendrecv(
+            chunks[send_idx], right, source=left,
+            sendtag=tag, recvtag=tag, cid=COLL_CID,
+        )
+    return np.concatenate(chunks).reshape(value.shape).astype(
+        value.dtype, copy=False
+    )
+
+
 def allreduce(ctx, value: Any, op) -> Any:
-    """Recursive-doubling allreduce with the non-power-of-two pre/post fold
-    (coll_base_allreduce.c:130-225 shape); in-order combines keep
-    non-commutative ops correct."""
+    """Allreduce with host-plane algorithm selection (the Weak-#8 fix:
+    one hardwired algorithm per op was a conscious round-2 scope line) —
+    recursive doubling with the non-power-of-two pre/post fold
+    (coll_base_allreduce.c:130-225 shape) for latency-bound payloads,
+    ring reduce-scatter+allgather above ``host_coll_large_msg`` for
+    large commutative array payloads.  In-order combines keep
+    non-commutative ops correct on the doubling path."""
     size, rank = ctx.size, ctx.rank
     if size == 1:
         return value
     tag = _next_tag(ctx, TAG_ALLREDUCE)
+    large = int(mca_var.get("host_coll_large_msg", 256 * 1024))
+    if (
+        size > 2
+        and isinstance(value, np.ndarray)
+        and value.nbytes >= large
+        and value.size >= size
+        and getattr(op, "commute", False)
+    ):
+        return _allreduce_ring(ctx, value, op, tag)
     pof2 = 1
     while pof2 * 2 <= size:
         pof2 *= 2

@@ -1,0 +1,70 @@
+"""fbtl framework — file byte-transfer components.
+
+Analog of OMPIO's ``fbtl`` sub-framework (``ompi/mca/fbtl/{posix,...}``):
+the layer that moves bytes at explicit offsets, kept separate from ``fs``
+(metadata: open/resize/sync/delete) exactly as the reference separates
+them — fcoll strategies schedule *what* to transfer, fbtl performs the
+transfers, fs owns the file object.  One component ships (posix over
+``os.pread``/``os.pwrite``); async-capable transports (the reference's
+``fbtl/ime``/``pvfs2``) would register siblings selected by priority or
+``ZMPI_MCA_fbtl=...``.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from ..mca import component as mca_component
+
+
+class FbtlComponent(mca_component.Component):
+    framework_name = "fbtl"
+
+    def pwritev(self, fd: int, runs, data: np.ndarray) -> int:
+        """Write coalesced (start, length) runs from `data` (uint8,
+        concatenated in run order); returns bytes written."""
+        raise NotImplementedError
+
+    def preadv(self, fd: int, runs, total: int) -> np.ndarray:
+        """Read coalesced (start, length) runs into one uint8 buffer (run
+        order); short reads past EOF zero-fill (MPI count semantics)."""
+        raise NotImplementedError
+
+
+class PosixFbtl(FbtlComponent):
+    """fbtl/posix analog: thread-safe at-offset syscalls."""
+
+    name = "posix"
+    default_priority = 10
+
+    def pwritev(self, fd: int, runs, data: np.ndarray) -> int:
+        pos = 0
+        for start, length in runs:
+            os.pwrite(fd, data[pos : pos + length].tobytes(), start)
+            pos += length
+        return pos
+
+    def preadv(self, fd: int, runs, total: int) -> np.ndarray:
+        out = np.empty(total, dtype=np.uint8)
+        pos = 0
+        for start, length in runs:
+            chunk = os.pread(fd, length, start)
+            got = np.frombuffer(chunk, dtype=np.uint8)
+            out[pos : pos + got.size] = got
+            if got.size < length:
+                out[pos + got.size : pos + length] = 0
+            pos += length
+        return out
+
+
+def fbtl_framework() -> mca_component.Framework:
+    fw = mca_component.framework("fbtl", "file byte-transfer")
+    fw.register(PosixFbtl())
+    fw.open()
+    return fw
+
+
+def select_fbtl() -> FbtlComponent:
+    return fbtl_framework().select_one()

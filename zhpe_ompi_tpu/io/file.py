@@ -95,19 +95,6 @@ class _View:
         ).ravel()
 
 
-def _runs(offsets: np.ndarray) -> list[tuple[int, int]]:
-    """Coalesce sorted byte offsets into (start, length) contiguous runs."""
-    if offsets.size == 0:
-        return []
-    breaks = np.nonzero(np.diff(offsets) != 1)[0]
-    starts = np.concatenate(([0], breaks + 1))
-    ends = np.concatenate((breaks, [offsets.size - 1]))
-    return [
-        (int(offsets[s]), int(offsets[e] - offsets[s] + 1))
-        for s, e in zip(starts, ends)
-    ]
-
-
 class File(errhandler.HasErrhandler):
     """MPI_File analog; one object serves every rank of `comm`.
 
@@ -126,6 +113,11 @@ class File(errhandler.HasErrhandler):
         self.info = info_mod.coerce(info)
         self.name = f"file:{path}"
         self._fs = fs_mod.select_fs()
+        from . import fbtl as fbtl_mod
+        from . import fcoll as fcoll_mod
+
+        self._fbtl = fbtl_mod.select_fbtl()
+        self._fcoll = fcoll_mod.select_fcoll()
         self._fd = self._fs.open(path, _os_flags(mode))
         n = comm.size if comm is not None else 1
         self._views = [_View(0, BYTE, BYTE) for _ in range(n)]
@@ -180,24 +172,12 @@ class File(errhandler.HasErrhandler):
     # -- byte-level engine ------------------------------------------------
 
     def _read_offsets(self, offsets: np.ndarray) -> np.ndarray:
-        out = np.empty(offsets.size, dtype=np.uint8)
-        pos = 0
-        for start, length in _runs(offsets):
-            chunk = self._fs.pread(self._fd, length, start)
-            got = np.frombuffer(chunk, dtype=np.uint8)
-            out[pos:pos + got.size] = got
-            if got.size < length:  # short read past EOF → zeros (MPI: count)
-                out[pos + got.size:pos + length] = 0
-            pos += length
-        return out
+        """Single-rank offset read, routed through fcoll -> fbtl (the
+        OMPIO layering: strategy schedules, byte-transfer layer moves)."""
+        return self._fcoll.read(self._fbtl, self._fd, [offsets])[0]
 
     def _write_offsets(self, offsets: np.ndarray, data: np.ndarray) -> None:
-        pos = 0
-        for start, length in _runs(offsets):
-            self._fs.pwrite(
-                self._fd, data[pos:pos + length].tobytes(), start
-            )
-            pos += length
+        self._fcoll.write(self._fbtl, self._fd, [(offsets, data)])
 
     def _as_bytes(self, buf, view: _View, count: int) -> np.ndarray:
         arr = np.ascontiguousarray(buf)
@@ -299,7 +279,7 @@ class File(errhandler.HasErrhandler):
             raise errors.ArgError(
                 f"need one buffer per rank ({len(self._views)})"
             )
-        all_offsets, all_bytes, total = [], [], 0
+        per_rank, total = [], 0
         with self._lock:
             for r, buf in enumerate(bufs):
                 v = self._views[r]
@@ -307,15 +287,10 @@ class File(errhandler.HasErrhandler):
                 data = self._as_bytes(buf, v, count)
                 offs = v.byte_offsets(self._pointers[r], count)
                 self._pointers[r] += count
-                all_offsets.append(offs)
-                all_bytes.append(data)
+                per_rank.append((offs, data))
                 total += count
-        offsets = np.concatenate(all_offsets) if all_offsets else (
-            np.empty(0, np.int64))
-        data = np.concatenate(all_bytes) if all_bytes else (
-            np.empty(0, np.uint8))
-        order = np.argsort(offsets, kind="stable")
-        self._write_offsets(offsets[order], data[order])
+        # the selected fcoll strategy owns the aggregation shape
+        self._fcoll.write(self._fbtl, self._fd, per_rank)
         return total
 
     def read_all(self, counts: list[int]) -> list[np.ndarray]:
@@ -331,15 +306,9 @@ class File(errhandler.HasErrhandler):
                 v = self._views[r]
                 per_rank_offs.append(v.byte_offsets(self._pointers[r], count))
                 self._pointers[r] += count
-        offsets = np.concatenate(per_rank_offs) if per_rank_offs else (
-            np.empty(0, np.int64))
-        order = np.argsort(offsets, kind="stable")
-        gathered = np.empty(offsets.size, dtype=np.uint8)
-        gathered[order] = self._read_offsets(offsets[order])
-        out, pos = [], 0
-        for r, offs in enumerate(per_rank_offs):
-            raw = gathered[pos:pos + offs.size]
-            pos += offs.size
+        raws = self._fcoll.read(self._fbtl, self._fd, per_rank_offs)
+        out = []
+        for r, raw in enumerate(raws):
             dt = getattr(self._views[r].etype, "np_dtype", None)
             out.append(raw.view(dt) if dt is not None else raw)
         return out

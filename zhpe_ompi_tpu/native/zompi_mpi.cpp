@@ -15,6 +15,12 @@
  *     payload — bit-identical to TcpProc.barrier, so mixed C/Python jobs
  *     synchronize together.
  *
+ * Protocol note: this shim implements the EAGER path only.  The Python
+ * plane switches to RTS/CTS rendezvous above ZMPI_MCA_tcp_eager_limit
+ * (default 1 MB); mixed C/Python jobs must keep C-bound messages under
+ * that limit (the C ABI is the control-plane surface, as the reference's
+ * heterogeneous deployments keep bulk data on the fabric plane).
+ *
  * Matching: posted-receive semantics with ANY_SOURCE/ANY_TAG wildcards and
  * per-source FIFO (arrival order scan), the contract of
  * pml_ob1_recvfrag.c re-stated in ~40 lines because the C shim only ever
@@ -267,13 +273,23 @@ struct Shim {
   std::mutex match_mu;
   std::condition_variable match_cv;
   std::atomic<bool> closing{false};
-  std::vector<std::thread> threads;
+  std::vector<std::thread> threads;     // accept loop + drains (joinable)
+  std::vector<int> drain_fds;           // every fd a drain thread reads
+  std::mutex threads_mu;
   int64_t seq = 0;
   int64_t coll_seq = 0;
   bool initialized = false;
 };
 
 Shim g;
+
+void drain_loop(int fd);
+
+void start_drain(int fd) {
+  std::lock_guard<std::mutex> lk(g.threads_mu);
+  g.drain_fds.push_back(fd);
+  g.threads.emplace_back(drain_loop, fd);
+}
 
 void drain_loop(int fd) {
   std::string frame;
@@ -314,7 +330,7 @@ void accept_loop() {
       std::lock_guard<std::mutex> lk(g.conn_mu);
       if (!g.conns.count((int)vals[0].i)) g.conns[(int)vals[0].i] = fd;
     }
-    std::thread(drain_loop, fd).detach();
+    start_drain(fd);
   }
 }
 
@@ -333,10 +349,16 @@ int endpoint(int dest) {
   {
     std::lock_guard<std::mutex> lk(g.conn_mu);
     auto it = g.conns.find(dest);
-    if (it != g.conns.end()) { close(fd); return it->second; }
+    if (it != g.conns.end()) {
+      // crossed simultaneous connect: the peer may have registered OUR
+      // socket (it saw the hello) — closing it would RST the peer's
+      // first frames.  Keep both; each side sends on its own choice.
+      start_drain(fd);
+      return it->second;
+    }
     g.conns[dest] = fd;
   }
-  std::thread(drain_loop, fd).detach();
+  start_drain(fd);
   return fd;
 }
 
@@ -556,14 +578,27 @@ int MPI_Finalize(void) {
   // one.  Programs needing quiescence call MPI_Barrier themselves (the
   // examples do).
   g.closing.store(true);
+  // shutdown -> join -> close: drain threads are blocked in recv on
+  // these fds; shutdown delivers EOF on the still-valid descriptor, the
+  // join guarantees no reader is parked on the fd when it is freed, and
+  // only then is the descriptor closed (fd-reuse byte-stealing guard,
+  // same discipline as the Python plane's close)
   shutdown(g.listen_fd, SHUT_RDWR);
-  close(g.listen_fd);
   {
-    std::lock_guard<std::mutex> lk(g.conn_mu);
-    for (auto &kv : g.conns) close(kv.second);
-    g.conns.clear();
+    std::lock_guard<std::mutex> lk(g.threads_mu);
+    for (int fd : g.drain_fds) shutdown(fd, SHUT_RDWR);
   }
   for (auto &t : g.threads) t.join();
+  close(g.listen_fd);
+  {
+    std::lock_guard<std::mutex> lk(g.threads_mu);
+    for (int fd : g.drain_fds) close(fd);
+    g.drain_fds.clear();
+  }
+  {
+    std::lock_guard<std::mutex> lk(g.conn_mu);
+    g.conns.clear();
+  }
   g.threads.clear();
   g.initialized = false;
   return MPI_SUCCESS;

@@ -39,6 +39,7 @@ from ..coll.host import HostCollectives
 from ..coll.nbc import NonblockingCollectives
 from ..core import errors
 from ..mca import output as mca_output
+from ..mca import var as mca_var
 from ..runtime import spc
 from ..utils import dss
 from . import matching
@@ -47,6 +48,43 @@ from .matching import ANY_SOURCE, ANY_TAG, Envelope
 _stream = mca_output.open_stream("btl_tcp")
 
 _LEN = struct.Struct("<I")
+
+mca_var.register(
+    "tcp_eager_limit", 1 << 20,
+    "Serialized size (bytes) above which TCP sends use RTS/CTS rendezvous "
+    "instead of eager delivery (bounds receiver-side unexpected-queue "
+    "memory, the ob1 eager_limit contract on the wire plane)",
+    type=int,
+)
+
+# rendezvous control channels (outside the user cid space)
+_RNDV_CTS_CID = 0x7FFA
+_RNDV_DATA_CID = 0x7FF9
+# wire sentinel of an RTS announce (first element of a 4-tuple payload;
+# the remaining elements are sender_rank, rndv_id, nbytes)
+_RTS_MARK = "__zmpi_rndv_rts__"
+
+
+def _payload_size(obj: Any, _depth: int = 0) -> int:
+    """Recursive payload size estimate for the eager/rendezvous switch —
+    container-wrapped arrays (the host collectives ship (idx, block)
+    tuples) must count their array bytes, or large payloads dodge the
+    receiver-memory bound the rendezvous exists for."""
+    if hasattr(obj, "nbytes"):
+        return int(obj.nbytes)
+    if isinstance(obj, (bytes, bytearray)):
+        return len(obj)
+    if isinstance(obj, str):
+        return len(obj)  # bytes-per-char >= 1; a lower bound is enough
+    if _depth < 4:
+        if isinstance(obj, (list, tuple)):
+            return sum(_payload_size(o, _depth + 1) for o in obj)
+        if isinstance(obj, dict):
+            return sum(
+                _payload_size(k, _depth + 1) + _payload_size(v, _depth + 1)
+                for k, v in obj.items()
+            )
+    return 0
 
 
 def _send_frame(sock: socket.socket, payload: bytes) -> None:
@@ -92,6 +130,11 @@ class TcpProc(HostCollectives, NonblockingCollectives):
         self.size = size
         self.engine = matching.make_matching_engine()
         self._seq = itertools.count()
+        self._rndv_ids = itertools.count(1)
+        self._pending_rndv: dict[int, bytes] = {}  # rndv_id -> data frame
+        self._rndv_lock = threading.Lock()
+        self._drains: list[threading.Thread] = []
+        self._dup_conns: list[socket.socket] = []  # crossed-connect extras
         self._timeout = timeout
         self._conns: dict[int, socket.socket] = {}
         self._conn_lock = threading.Lock()
@@ -198,14 +241,21 @@ class TcpProc(HostCollectives, NonblockingCollectives):
                 key = hello
             with self._conn_lock:
                 self._conns.setdefault(key, conn)
-            threading.Thread(
-                target=self._drain_loop, args=(conn,), daemon=True
-            ).start()
+            self._start_drain(conn)
+
+    def _start_drain(self, conn: socket.socket) -> None:
+        t = threading.Thread(
+            target=self._drain_loop, args=(conn,), daemon=True
+        )
+        self._drains.append(t)
+        t.start()
 
     def _drain_loop(self, conn: socket.socket) -> None:
         """Receiver thread per connection — the progress engine's read
         side (btl_tcp drives this from libevent; threads are the Python
-        idiom)."""
+        idiom).  A failing matching callback (e.g. a rendezvous CTS
+        handler hitting a dead socket) must not kill the drain: every
+        later message on this connection would silently vanish."""
         while not self._closed.is_set():
             try:
                 frame = _recv_frame(conn)
@@ -216,9 +266,17 @@ class TcpProc(HostCollectives, NonblockingCollectives):
             [src, tag, cid, seq, payload] = dss.unpack(frame)
             env = Envelope(src, tag, cid, seq)
             spc.record("tcp_bytes_recvd", len(frame))
-            with self._incoming_cv:
-                self.engine.incoming(env, payload)
-                self._incoming_cv.notify_all()
+            try:
+                with self._incoming_cv:
+                    self.engine.incoming(env, payload)
+                    self._incoming_cv.notify_all()
+            except Exception as e:  # noqa: BLE001 - log, keep draining
+                mca_output.emit(
+                    _stream,
+                    "rank %s: matching callback failed for (src=%s tag=%s "
+                    "cid=%s): %s: %s", self.rank, src, tag, cid,
+                    type(e).__name__, e,
+                )
 
     def _endpoint(self, dest: int) -> socket.socket:
         with self._conn_lock:
@@ -234,12 +292,18 @@ class TcpProc(HostCollectives, NonblockingCollectives):
         with self._conn_lock:
             existing = self._conns.get(dest)
             if existing is not None:
-                sock.close()
+                # simultaneous connect: the peer may have ALREADY
+                # registered our socket as ITS canonical endpoint (its
+                # accept saw our hello) — closing it here would RST the
+                # peer's first frames after its sendall returned, a
+                # silent rare message loss.  Keep both crossed
+                # connections; each side sends only on its registered
+                # one, so per-source FIFO is preserved.
+                self._dup_conns.append(sock)
+                self._start_drain(sock)
                 return existing
             self._conns[dest] = sock
-        threading.Thread(
-            target=self._drain_loop, args=(sock,), daemon=True
-        ).start()
+        self._start_drain(sock)
         return sock
 
     def bridge_endpoint(self, cid: int, dest: int,
@@ -259,12 +323,13 @@ class TcpProc(HostCollectives, NonblockingCollectives):
         with self._conn_lock:
             existing = self._conns.get(key)
             if existing is not None:
-                sock.close()
+                # crossed-connection rule: never close a socket whose
+                # hello the peer may have registered (see _endpoint)
+                self._dup_conns.append(sock)
+                self._start_drain(sock)
                 return existing
             self._conns[key] = sock
-        threading.Thread(
-            target=self._drain_loop, args=(sock,), daemon=True
-        ).start()
+        self._start_drain(sock)
         return sock
 
     def bridge_send(self, obj: Any, cid: int, dest: int,
@@ -281,26 +346,112 @@ class TcpProc(HostCollectives, NonblockingCollectives):
     # -- MPI surface (RankContext-compatible) ----------------------------
 
     def send(self, obj: Any, dest: int, tag: int = 0, cid: int = 0) -> None:
-        """Eager length-framed send (the DCN plane is a control/metadata
-        path; ob1's rendezvous exists to bound eager buffering, which TCP's
-        own flow control provides here)."""
+        """Length-framed send: eager below ``tcp_eager_limit``, RTS/CTS
+        rendezvous above it (ob1's protocol split on the wire — an
+        unmatched multi-GB send must park at the SENDER, not in the
+        receiver's unexpected queue).  The rendezvous payload is
+        serialized at send time, so the MPI buffer-reuse contract holds
+        the moment this returns."""
         if not 0 <= dest < self.size:
             raise errors.RankError(f"rank {dest} out of range")
         if tag < 0:
             raise errors.TagError(f"negative tag {tag}")
         seq = next(self._seq)
-        frame = dss.pack(self.rank, tag, cid, seq, obj)
-        spc.record("tcp_bytes_sent", len(frame))
         if dest == self.rank:
+            frame = dss.pack(self.rank, tag, cid, seq, obj)
+            spc.record("tcp_bytes_sent", len(frame))
             # loopback: the DSS round-trip is the eager buffer copy
             env = Envelope(self.rank, tag, cid, seq)
             with self._incoming_cv:
                 self.engine.incoming(env, dss.unpack(frame)[4])
                 self._incoming_cv.notify_all()
             return
+        nbytes = _payload_size(obj)
+        limit = int(mca_var.get("tcp_eager_limit", 1 << 20))
+        if nbytes > limit:
+            self._send_rndv(obj, dest, tag, cid, seq, nbytes)
+            return
+        frame = dss.pack(self.rank, tag, cid, seq, obj)
+        spc.record("tcp_bytes_sent", len(frame))
         sock = self._endpoint(dest)
         with self._send_lock:  # frames must not interleave on a socket
             _send_frame(sock, frame)
+
+    def _send_rndv(self, obj: Any, dest: int, tag: int, cid: int,
+                   seq: int, nbytes: int) -> None:
+        """RTS/CTS rendezvous: serialize the payload now (buffer-reuse
+        contract), park the data frame locally, announce with a small RTS
+        carrying the envelope; the receiver's CTS — handled in the drain
+        thread — releases the data on a dedicated (rndv_id, cid) channel."""
+        rndv_id = next(self._rndv_ids)
+        data_frame = dss.pack(self.rank, rndv_id, _RNDV_DATA_CID, seq, obj)
+        with self._rndv_lock:
+            self._pending_rndv[rndv_id] = data_frame
+        spc.record("tcp_rndv_sends", 1)
+
+        def push_data():
+            # runs on its OWN thread, never the drain thread: the drain
+            # must keep reading while this sendall blocks, or two ranks
+            # streaming large payloads at each other deadlock with full
+            # kernel buffers (each one's reader stuck in its writer)
+            try:
+                with self._rndv_lock:
+                    frame = self._pending_rndv.get(rndv_id)
+                if frame is None:
+                    return
+                spc.record("tcp_bytes_sent", len(frame))
+                sock = self._endpoint(dest)
+                with self._send_lock:
+                    _send_frame(sock, frame)
+            except OSError as e:
+                mca_output.emit(
+                    _stream,
+                    "rank %s: rendezvous data push to %s failed: %s",
+                    self.rank, dest, e,
+                )
+            finally:
+                # always release the entry: close()'s quiesce loop would
+                # otherwise spin its full timeout on a dead transfer
+                with self._rndv_lock:
+                    self._pending_rndv.pop(rndv_id, None)
+
+        def on_cts(_env, _payload):
+            t = threading.Thread(target=push_data, daemon=True)
+            self._drains.append(t)  # joined by close() like the readers
+            t.start()
+
+        with self._incoming_cv:
+            self.engine.post_recv(dest, rndv_id, _RNDV_CTS_CID, on_cts)
+        rts = dss.pack(
+            self.rank, tag, cid, seq,
+            (_RTS_MARK, self.rank, rndv_id, nbytes),
+        )
+        sock = self._endpoint(dest)
+        with self._send_lock:
+            _send_frame(sock, rts)
+
+    def _resolve_rndv(self, env: Envelope, payload: Any, deliver) -> bool:
+        """If `payload` is an RTS marker, pull the real payload over
+        (post the data recv, then CTS) and call ``deliver(env, data)``
+        when it lands; returns True when a rendezvous was initiated."""
+        if not (isinstance(payload, tuple) and len(payload) == 4
+                and payload[0] == _RTS_MARK):
+            return False
+        _, sender, rndv_id, _nbytes = payload
+
+        def on_data(_env2, data):
+            deliver(env, data)
+
+        # may be called from a drain thread (engine entry points are
+        # internally locked; _incoming_cv is NOT re-acquired here because
+        # matching callbacks already run under it)
+        self.engine.post_recv(sender, rndv_id, _RNDV_DATA_CID, on_data)
+        cts = dss.pack(self.rank, rndv_id, _RNDV_CTS_CID, next(self._seq),
+                       b"")
+        sock = self._endpoint(sender)
+        with self._send_lock:
+            _send_frame(sock, cts)
+        return True
 
     def isend(self, obj: Any, dest: int, tag: int = 0, cid: int = 0):
         """Nonblocking send: the eager frame is on the wire before return,
@@ -320,8 +471,13 @@ class TcpProc(HostCollectives, NonblockingCollectives):
 
         req = Request()
 
-        def on_match(env: Envelope, payload: Any) -> None:
+        def finalize(env: Envelope, payload: Any) -> None:
             req.complete(payload, source=env.src, tag=env.tag)
+
+        def on_match(env: Envelope, payload: Any) -> None:
+            if self._resolve_rndv(env, payload, finalize):
+                return
+            finalize(env, payload)
 
         with self._incoming_cv:
             self.engine.post_recv(source, tag, cid, on_match)
@@ -341,7 +497,7 @@ class TcpProc(HostCollectives, NonblockingCollectives):
         done = threading.Event()
         abandoned = [False]
 
-        def on_match(env: Envelope, payload: Any) -> None:
+        def finalize(env: Envelope, payload: Any) -> None:
             # always invoked while _incoming_cv is held (all engine entry
             # points in this class take it), so `abandoned` is consistent
             if abandoned[0]:
@@ -351,6 +507,13 @@ class TcpProc(HostCollectives, NonblockingCollectives):
             envs.append(env)
             done.set()
 
+        def on_match(env: Envelope, payload: Any) -> None:
+            # a rendezvous RTS resolves asynchronously; `finalize` then
+            # runs when the data lands (same abandoned/re-inject contract)
+            if self._resolve_rndv(env, payload, finalize):
+                return
+            finalize(env, payload)
+
         with self._incoming_cv:
             self.engine.post_recv(source, tag, cid, on_match)
         if not done.wait(timeout):
@@ -358,8 +521,29 @@ class TcpProc(HostCollectives, NonblockingCollectives):
                 if not done.is_set():
                     abandoned[0] = True
             if not done.is_set():
+                # diagnosis: is the message parked unexpected while our
+                # posted recv failed to match it? (engine race forensics;
+                # queue snapshots only exist on the Python engine and are
+                # taken under its lock — drain threads keep appending)
+                hit = self.engine.probe(source, tag, cid)
+                unexpected, posted = [], []
+                eng_lock = getattr(self.engine, "_lock", None)
+                if eng_lock is not None and hasattr(
+                    self.engine, "_unexpected"
+                ):
+                    with eng_lock:
+                        unexpected = [
+                            (e.src, e.tag, e.cid, e.seq)
+                            for e, _ in self.engine._unexpected
+                        ]
+                        posted = [
+                            (p.src, p.tag, p.cid)
+                            for p in self.engine._posted
+                        ]
                 raise errors.InternalError(
-                    f"tcp recv timeout (src={source}, tag={tag})"
+                    f"tcp recv timeout (src={source}, tag={tag}, "
+                    f"cid={cid}); probe={hit}; stats={self.engine.stats()}"
+                    f"; unexpected={unexpected}; posted={posted}"
                 )
         if return_status:
             from .requests import Status
@@ -387,15 +571,49 @@ class TcpProc(HostCollectives, NonblockingCollectives):
             k <<= 1
 
     def close(self) -> None:
+        # Quiesce outstanding rendezvous sends first: the payload parks
+        # here until the receiver's CTS, so tearing down immediately after
+        # a buffered send() would destroy data the peer is entitled to
+        # (ompi_mpi_finalize's quiesce-before-teardown contract).  Bounded
+        # wait: a peer that never matches cannot hang our shutdown.
+        import time as _time
+
+        deadline = _time.monotonic() + self._timeout
+        while self._pending_rndv and _time.monotonic() < deadline:
+            _time.sleep(0.005)
         self._closed.set()
+        # shutdown() first, close() only after the reader threads exit:
+        # drain/accept threads are blocked in recv/accept on these
+        # sockets, and closing a socket another thread is reading frees
+        # the fd number while that thread may still be about to read it —
+        # a NEW socket reusing the fd then has its bytes STOLEN by the
+        # old drain thread (rare, load-dependent message loss observed as
+        # tcp recv timeouts under full-suite pressure).  shutdown
+        # delivers EOF on the still-valid fd; the join guarantees nobody
+        # is parked on the fd when it is finally freed.
+        try:
+            self._listener.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        with self._conn_lock:
+            conns = list(self._conns.values()) + self._dup_conns
+            self._conns.clear()
+            self._dup_conns = []
+        for sock in conns:
+            try:
+                sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+        deadline = _time.monotonic() + 5.0
+        self._accept_thread.join(max(0.0, deadline - _time.monotonic()))
+        for t in self._drains:
+            t.join(max(0.0, deadline - _time.monotonic()))
         try:
             self._listener.close()
         except OSError:
             pass
-        with self._conn_lock:
-            for sock in self._conns.values():
-                try:
-                    sock.close()
-                except OSError:
-                    pass
-            self._conns.clear()
+        for sock in conns:
+            try:
+                sock.close()
+            except OSError:
+                pass
