@@ -251,3 +251,93 @@ class TestDeviceWindow:
             world.run(body, world.device_put_sharded(jnp.asarray(x)))
         ).reshape(n)
         np.testing.assert_allclose(out, np.full(n, 101.0))
+
+
+class TestHostWindowRw:
+    """Round 3: in-process passive target gets real reader-writer
+    semantics and identity-checked PSCW (parity with the AM plane)."""
+
+    def test_shared_locks_coexist(self):
+        uni = LocalUniverse(4)
+
+        def main(ctx):
+            import threading as _t
+
+            buf = np.zeros(1, np.float64)
+            win = HostWindow.create(ctx, buf)
+            win.fence()
+            if ctx.rank == 0:
+                # wait until every reader reports holding the lock
+                for r in range(1, 4):
+                    ctx.recv(source=r, tag=90)
+                for r in range(1, 4):
+                    ctx.send(b"go", dest=r, tag=91)
+            else:
+                win.lock(0, 1)  # LOCK_SHARED
+                ctx.send(b"held", dest=0, tag=90)
+                ctx.recv(source=0, tag=91)  # all held simultaneously
+                win.unlock(0)
+            win.fence()
+            win.free()
+            return True
+
+        assert uni.run(main) == [True] * 4
+
+    def test_exclusive_blocks_shared(self):
+        uni = LocalUniverse(2)
+
+        def main(ctx):
+            buf = np.zeros(1, np.float64)
+            win = HostWindow.create(ctx, buf)
+            win.fence()
+            if ctx.rank == 0:
+                win.lock(0, 2)  # EXCLUSIVE on self
+                win.put(np.float64(5), 0, 0)
+                ctx.send(b"locked", dest=1, tag=92)
+                ctx.recv(source=1, tag=93)
+                import time
+
+                time.sleep(0.2)  # reader must still be blocked
+                win.unlock(0)
+                win.fence()
+                win.free()
+                return None
+            ctx.recv(source=0, tag=92)
+            ctx.send(b"trying", dest=0, tag=93)
+            win.lock(0, 1)  # blocks until rank 0 unlocks
+            got = float(win.get(0, 0, 1)[0])
+            win.unlock(0)
+            win.fence()
+            win.free()
+            return got
+
+        assert uni.run(main)[1] == 5.0
+
+    def test_pscw_uninvited_origin_does_not_satisfy(self):
+        """wait_sync must wait for the POSTED origins, not any N
+        completes (identity check)."""
+        uni = LocalUniverse(3)
+
+        def main(ctx):
+            buf = np.zeros(2, np.float32)
+            win = HostWindow.create(ctx, buf)
+            if ctx.rank == 0:
+                win.post(origins=[2])  # only rank 2 invited
+                win.wait_sync(timeout=15.0)
+                out = float(buf[0])
+                win.free()
+                return out
+            if ctx.rank == 1:
+                # uninvited: a PSCW from a different pairing entirely
+                win.free()
+                return None
+            import time
+
+            time.sleep(0.3)  # let rank 0 wait a moment
+            win.start([0])
+            win.put(np.float32(9), target=0, offset=0)
+            win.complete()
+            win.free()
+            return None
+
+        assert uni.run(main)[0] == 9.0

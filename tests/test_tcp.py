@@ -253,8 +253,10 @@ class TestRendezvous:
         two overlapping rendezvous sends resolve independently."""
 
         def prog(p):
-            a = np.full(1 << 17, 1.0)  # 1 MB threshold exceeded? 1<<17*8=1MB
-            b = np.full(1 << 18, 2.0)  # 2 MB
+            # strictly ABOVE the 1 MB limit (nbytes > limit is the
+            # switch), so both transfers genuinely overlap as rendezvous
+            a = np.full((1 << 17) + 8, 1.0)  # 1 MB + 64 B
+            b = np.full(1 << 18, 2.0)        # 2 MB
             if p.rank == 0:
                 p.send(b, dest=1, tag=31)
                 p.send(a, dest=1, tag=30)
@@ -266,7 +268,7 @@ class TestRendezvous:
             return (small, float(ga[0]), ga.size, float(gb[0]), gb.size)
 
         res = run_tcp(2, prog)
-        assert res[1] == (b"small", 1.0, 1 << 17, 2.0, 1 << 18)
+        assert res[1] == (b"small", 1.0, (1 << 17) + 8, 2.0, 1 << 18)
 
     def test_rendezvous_through_collectives(self):
         """A large-payload host-plane collective rides the rendezvous
@@ -281,12 +283,13 @@ class TestRendezvous:
         assert run_tcp(4, prog, timeout=90.0) == [10.0] * 4
 
     def test_bidirectional_large_exchange(self):
-        """Two ranks streaming >eager-limit payloads at each other must
-        not deadlock: the rendezvous data push runs off the drain thread
-        (a drain blocked in sendall would stop reading and wedge both
-        kernel buffers)."""
+        """Two ranks streaming payloads far larger than the kernel
+        socket buffers at each other must not deadlock: the rendezvous
+        data push runs on its own thread over its own per-transfer
+        connection, so neither the drain threads nor the control-plane
+        framing lock can wedge behind a bulk sendall."""
 
-        big = np.arange(1 << 19, dtype=np.float64)  # 4 MB each way
+        big = np.arange(1 << 23, dtype=np.float64)  # 64 MB each way
 
         def prog(p):
             other = 1 - p.rank

@@ -273,7 +273,8 @@ struct Shim {
   std::mutex match_mu;
   std::condition_variable match_cv;
   std::atomic<bool> closing{false};
-  std::vector<std::thread> threads;     // accept loop + drains (joinable)
+  std::thread accept_thread;            // joined FIRST at finalize
+  std::vector<std::thread> threads;     // drain threads (joinable)
   std::vector<int> drain_fds;           // every fd a drain thread reads
   std::mutex threads_mu;
   int64_t seq = 0;
@@ -502,7 +503,7 @@ int MPI_Init(int *, char ***) {
   getsockname(g.listen_fd, (sockaddr *)&a, &alen);
   g.listen_port = ntohs(a.sin_port);
   listen(g.listen_fd, g.size + 4);
-  g.threads.emplace_back(accept_loop);
+  g.accept_thread = std::thread(accept_loop);
 
   // modex (tcp.py _modex wire protocol)
   if (g.rank == 0) {
@@ -584,22 +585,23 @@ int MPI_Finalize(void) {
   // only then is the descriptor closed (fd-reuse byte-stealing guard,
   // same discipline as the Python plane's close)
   shutdown(g.listen_fd, SHUT_RDWR);
+  // join the accept loop FIRST: after it exits, no new drain can be
+  // started, so the drain_fds sweep below cannot miss a late-accepted
+  // connection and the threads vector can no longer be mutated under us
+  if (g.accept_thread.joinable()) g.accept_thread.join();
   {
     std::lock_guard<std::mutex> lk(g.threads_mu);
     for (int fd : g.drain_fds) shutdown(fd, SHUT_RDWR);
   }
   for (auto &t : g.threads) t.join();
   close(g.listen_fd);
-  {
-    std::lock_guard<std::mutex> lk(g.threads_mu);
-    for (int fd : g.drain_fds) close(fd);
-    g.drain_fds.clear();
-  }
+  for (int fd : g.drain_fds) close(fd);
+  g.drain_fds.clear();
+  g.threads.clear();
   {
     std::lock_guard<std::mutex> lk(g.conn_mu);
     g.conns.clear();
   }
-  g.threads.clear();
   g.initialized = false;
   return MPI_SUCCESS;
 }

@@ -34,12 +34,54 @@ LOCK_SHARED = 1
 LOCK_EXCLUSIVE = 2
 
 
+class _RwLock:
+    """Reader-writer lock for passive-target epochs: SHARED holders
+    coexist, EXCLUSIVE serializes, FIFO hand-off so writers are not
+    starved by a stream of late readers (round-3 fix of shared-behaving-
+    exclusive; matches the AM plane's lock manager semantics)."""
+
+    def __init__(self):
+        self._cond = threading.Condition()
+        self._readers = 0
+        self._writer = False
+        self._waiting_writers = 0
+
+    def acquire(self, exclusive: bool) -> None:
+        with self._cond:
+            if exclusive:
+                self._waiting_writers += 1
+                self._cond.wait_for(
+                    lambda: not self._writer and self._readers == 0
+                )
+                self._waiting_writers -= 1
+                self._writer = True
+            else:
+                # queue behind any waiting writer (no reader starvation
+                # of writers)
+                self._cond.wait_for(
+                    lambda: not self._writer
+                    and self._waiting_writers == 0
+                )
+                self._readers += 1
+
+    def release(self, exclusive: bool) -> None:
+        with self._cond:
+            if exclusive:
+                self._writer = False
+            else:
+                self._readers -= 1
+            self._cond.notify_all()
+
+
 class _WinRegistry:
     """Universe-level shared state for one window id."""
 
     def __init__(self, size: int):
         self.buffers: list[np.ndarray | None] = [None] * size
+        # atomic-op serialization (accumulate/CAS): plain mutexes
         self.locks = [threading.RLock() for _ in range(size)]
+        # passive-target epochs (MPI_Win_lock): reader-writer semantics
+        self.epoch_locks = [_RwLock() for _ in range(size)]
         # dynamic-window state (create_dynamic/attach): per-rank attached
         # regions keyed by displacement (built here, not lazily — lazy init
         # from racing rank threads would clobber attachments)
@@ -47,11 +89,12 @@ class _WinRegistry:
             dict() for _ in range(size)
         ]
         self.dynamic_next = [0] * size
-        # PSCW state: per-rank exposure epoch counter (incremented by post)
-        # and per-rank count of origins that called complete() this epoch
+        # PSCW state: per-rank exposure epoch counter (incremented by
+        # post) and the identity set of origins completed this epoch
         self.cond = threading.Condition()
         self.post_epochs = [0] * size
-        self.completes = [0] * size
+        self.completed_by: list[set[int]] = [set() for _ in range(size)]
+        self.expected_origins: list[set[int] | None] = [None] * size
 
 
 class HostWindow(errh.HasErrhandler):
@@ -103,10 +146,9 @@ class HostWindow(errh.HasErrhandler):
         self._reg = reg
         self.info = info_mod.coerce(info)
         self.name = f"win{win_id}"
-        self._held: dict[int, int] = {}
+        self._held: dict[int, list[int]] = {}  # target -> lock types held
         self._started: list[int] = []  # PSCW access-epoch targets
         self._seen_post = [0] * ctx.size  # last observed exposure epoch
-        self._exposure_origins = 0  # origins expected this exposure epoch
 
     # -- communication ---------------------------------------------------
 
@@ -203,20 +245,26 @@ class HostWindow(errh.HasErrhandler):
         self.ctx.barrier()
 
     def lock(self, target: int, lock_type: int = LOCK_EXCLUSIVE) -> None:
-        """MPI_Win_lock (passive target).  Shared locks are modeled with the
-        same RLock (conservative: shared behaves exclusive)."""
+        """MPI_Win_lock (passive target): genuine reader-writer
+        semantics — SHARED holders coexist, EXCLUSIVE serializes
+        (round-3 fix; previously shared behaved exclusive)."""
         if self.info.get_bool("no_locks"):
             raise errors.WinError(
                 "window created with no_locks=true (MPI info assertion)"
             )
-        self._reg.locks[target].acquire()
-        self._held[target] = self._held.get(target, 0) + 1
+        self._reg.epoch_locks[target].acquire(
+            lock_type == LOCK_EXCLUSIVE
+        )
+        self._held.setdefault(target, []).append(lock_type)
 
     def unlock(self, target: int) -> None:
-        if not self._held.get(target):
+        held = self._held.get(target)
+        if not held:
             raise errors.WinError(f"unlock of {target} without lock")
-        self._held[target] -= 1
-        self._reg.locks[target].release()
+        lock_type = held.pop()
+        self._reg.epoch_locks[target].release(
+            lock_type == LOCK_EXCLUSIVE
+        )
 
     def lock_all(self) -> None:
         """MPI_Win_lock_all: shared access epoch at every target; locks are
@@ -328,13 +376,20 @@ class HostWindow(errh.HasErrhandler):
 
     # PSCW generalized active target (MPI_Win_post/start/complete/wait)
     def post(self, origins: list[int] | None = None) -> None:
-        """Open an exposure epoch for `origins` (default: all other ranks)."""
-        n_origins = (self.ctx.size - 1) if origins is None else len(origins)
+        """Open an exposure epoch for `origins` (default: all other
+        ranks).  The origin IDENTITIES are recorded — wait_sync completes
+        only when exactly these origins have completed (round-3 fix:
+        counting alone let an uninvited origin satisfy the epoch)."""
+        origins = (
+            [r for r in range(self.ctx.size) if r != self.ctx.rank]
+            if origins is None else list(origins)
+        )
         reg = self._reg
+        me = self.ctx.rank
         with reg.cond:
-            reg.completes[self.ctx.rank] = 0
-            self._exposure_origins = n_origins
-            reg.post_epochs[self.ctx.rank] += 1
+            reg.completed_by[me].clear()
+            reg.expected_origins[me] = set(origins)
+            reg.post_epochs[me] += 1
             reg.cond.notify_all()
 
     def start(self, targets: list[int], timeout: float = 10.0) -> None:
@@ -353,26 +408,34 @@ class HostWindow(errh.HasErrhandler):
 
     def complete(self) -> None:
         """Close the access epoch: notify every started target that this
-        origin's RMA operations are done."""
+        origin's RMA operations are done (with the origin's identity)."""
         reg = self._reg
+        me = self.ctx.rank
         with reg.cond:
             for t in self._started:
-                reg.completes[t] += 1
+                reg.completed_by[t].add(me)
             reg.cond.notify_all()
         self._started = []
 
     def wait_sync(self, timeout: float = 10.0) -> None:
-        """Close the exposure epoch: block until every expected origin has
-        called complete()."""
+        """Close the exposure epoch: block until exactly the posted
+        origins have called complete()."""
         reg = self._reg
         me = self.ctx.rank
         with reg.cond:
+            expected = reg.expected_origins[me]
+            if expected is None:
+                raise errors.WinError("wait_sync without a post")
             if not reg.cond.wait_for(
-                lambda: reg.completes[me] >= self._exposure_origins,
+                lambda: expected <= reg.completed_by[me],
                 timeout=timeout,
             ):
-                raise errors.WinError("wait_sync: origins never completed")
-            reg.completes[me] = 0
+                missing = expected - reg.completed_by[me]
+                raise errors.WinError(
+                    f"wait_sync: origins {sorted(missing)} never completed"
+                )
+            reg.completed_by[me].clear()
+            reg.expected_origins[me] = None
 
     def free(self) -> None:
         """MPI_Win_free: collective; the registry entry is dropped so

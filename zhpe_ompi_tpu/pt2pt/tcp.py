@@ -134,6 +134,7 @@ class TcpProc(HostCollectives, NonblockingCollectives):
         self._pending_rndv: dict[int, bytes] = {}  # rndv_id -> data frame
         self._rndv_lock = threading.Lock()
         self._drains: list[threading.Thread] = []
+        self._drain_lock = threading.Lock()
         self._dup_conns: list[socket.socket] = []  # crossed-connect extras
         self._timeout = timeout
         self._conns: dict[int, socket.socket] = {}
@@ -235,6 +236,13 @@ class TcpProc(HostCollectives, NonblockingCollectives):
                 conn.close()
                 continue
             [hello] = dss.unpack(frame)
+            if isinstance(hello, (list, tuple)) and hello[0] == "d":
+                # rendezvous bulk-data connection: drain it, but never
+                # register it for sends (control and bulk stay separate)
+                with self._conn_lock:
+                    self._dup_conns.append(conn)
+                self._start_drain(conn)
+                continue
             if isinstance(hello, (list, tuple)):
                 key = ("b", hello[1], hello[2])
             else:
@@ -243,11 +251,18 @@ class TcpProc(HostCollectives, NonblockingCollectives):
                 self._conns.setdefault(key, conn)
             self._start_drain(conn)
 
+    def _track_thread(self, t: threading.Thread) -> None:
+        with self._drain_lock:
+            # prune finished threads so long-lived ranks don't accumulate
+            # one dead Thread object per connection/transfer
+            self._drains = [d for d in self._drains if d.is_alive()]
+            self._drains.append(t)
+
     def _start_drain(self, conn: socket.socket) -> None:
         t = threading.Thread(
             target=self._drain_loop, args=(conn,), daemon=True
         )
-        self._drains.append(t)
+        self._track_thread(t)
         t.start()
 
     def _drain_loop(self, conn: socket.socket) -> None:
@@ -390,19 +405,28 @@ class TcpProc(HostCollectives, NonblockingCollectives):
         spc.record("tcp_rndv_sends", 1)
 
         def push_data():
-            # runs on its OWN thread, never the drain thread: the drain
-            # must keep reading while this sendall blocks, or two ranks
-            # streaming large payloads at each other deadlock with full
-            # kernel buffers (each one's reader stuck in its writer)
+            # Runs on its OWN thread over its OWN socket: the drain must
+            # keep reading while this sendall blocks (drain stuck in a
+            # writer = bidirectional deadlock), and the bulk write must
+            # not hold the control socket's framing lock — a tiny CTS
+            # queued behind a multi-MB sendall re-creates the same
+            # deadlock one level up.  A dedicated per-transfer data
+            # connection (hello ["d"]) keeps bulk and control planes
+            # independent, the reason ob1 separates its channels.
+            data_sock = None
             try:
                 with self._rndv_lock:
                     frame = self._pending_rndv.get(rndv_id)
                 if frame is None:
                     return
                 spc.record("tcp_bytes_sent", len(frame))
-                sock = self._endpoint(dest)
-                with self._send_lock:
-                    _send_frame(sock, frame)
+                data_sock = socket.socket(
+                    socket.AF_INET, socket.SOCK_STREAM
+                )
+                data_sock.settimeout(self._timeout)
+                data_sock.connect(tuple(self.address_book[dest]))
+                _send_frame(data_sock, dss.pack(["d"]))
+                _send_frame(data_sock, frame)
             except OSError as e:
                 mca_output.emit(
                     _stream,
@@ -410,6 +434,11 @@ class TcpProc(HostCollectives, NonblockingCollectives):
                     self.rank, dest, e,
                 )
             finally:
+                if data_sock is not None:
+                    try:
+                        data_sock.close()
+                    except OSError:
+                        pass
                 # always release the entry: close()'s quiesce loop would
                 # otherwise spin its full timeout on a dead transfer
                 with self._rndv_lock:
@@ -417,7 +446,7 @@ class TcpProc(HostCollectives, NonblockingCollectives):
 
         def on_cts(_env, _payload):
             t = threading.Thread(target=push_data, daemon=True)
-            self._drains.append(t)  # joined by close() like the readers
+            self._track_thread(t)  # joined by close() like the readers
             t.start()
 
         with self._incoming_cv:
@@ -606,7 +635,9 @@ class TcpProc(HostCollectives, NonblockingCollectives):
                 pass
         deadline = _time.monotonic() + 5.0
         self._accept_thread.join(max(0.0, deadline - _time.monotonic()))
-        for t in self._drains:
+        with self._drain_lock:
+            drains = list(self._drains)
+        for t in drains:
             t.join(max(0.0, deadline - _time.monotonic()))
         try:
             self._listener.close()

@@ -27,6 +27,13 @@ def _load_everything():
     from ..coll.framework import coll_framework
 
     coll_framework()
+    from ..io.fbtl import fbtl_framework
+    from ..io.fcoll import fcoll_framework
+    from ..io.fs import fs_framework
+
+    fs_framework()
+    fbtl_framework()
+    fcoll_framework()
     from ..pt2pt import universe  # registers pt2pt vars  # noqa: F401
     from ..parallel import mesh  # registers rte vars  # noqa: F401
     from ..coll import monitoring  # registers monitoring vars  # noqa: F401
