@@ -374,3 +374,118 @@ class TestAmRegressions:
         assert res[1] is True
         got, _ = res[2]
         assert got == 1.0  # saw the writer's value => did not overtake
+
+
+class TestRequestRma:
+    """Request-based RMA (MPI_Rput/Rget family) over the wire: rget is
+    genuinely asynchronous (overlap), rput completes locally."""
+
+    def test_rget_overlap(self):
+        def main(p):
+            buf = np.full(4, float(p.rank * 100), np.float64)
+            win = AmWindow.create(p, buf)
+            win.fence()
+            if p.rank == 1:
+                req = win.rget(0, offset=0, count=4)
+                # do unrelated work while the fetch is in flight
+                local = sum(range(1000))
+                got = req.wait(timeout=20.0)
+                win.fence()
+                win.free()
+                return (local, got.tolist())
+            win.fence()
+            win.free()
+            return None
+
+        res = run_tcp(2, main)
+        assert res[1] == (499500, [0.0, 0.0, 0.0, 0.0])
+
+    def test_rput_raccumulate_fetch_and_op(self):
+        """Epoch-separated (a put and an accumulate to the same location
+        in one epoch is undefined under MPI): rput epoch, fence,
+        raccumulate epoch, fence, fetch_and_op epoch."""
+
+        def main(p):
+            buf = np.zeros(2, np.int64)
+            win = AmWindow.create(p, buf)
+            win.fence()
+            if p.rank == 0:
+                win.rput(np.int64(5), target=0, offset=0).wait()
+            win.fence()
+            win.raccumulate(np.int64(10), target=0, offset=0).wait()
+            win.fence()
+            old = int(win.fetch_and_op(1, target=0, offset=1))
+            win.fence()
+            out = buf.tolist() if p.rank == 0 else None
+            win.free()
+            return (old, out)
+
+        res = run_tcp(2, main)
+        # slot0: rput(5) then two raccumulate(10); slot1: two
+        # fetch_and_op(+1) whose old values are {0, 1} in some order
+        assert res[0][1] == [25, 2]
+        assert sorted(r[0] for r in res) == [0, 1]
+
+    def test_rget_accumulate_async(self):
+        def main(p):
+            buf = np.zeros(1, np.int64)
+            win = AmWindow.create(p, buf)
+            win.fence()
+            req = win.rget_accumulate(np.int64(p.rank + 1), target=0)
+            old = int(np.asarray(req.wait(timeout=20.0))[0])
+            win.fence()
+            total = int(buf[0]) if p.rank == 0 else None
+            win.free()
+            return (old, total)
+
+        res = run_tcp(3, main)
+        assert res[0][1] == 1 + 2 + 3
+        # the three fetched old values are the prefix sums of whatever
+        # application order the target serialized: {0, a, a+b} with
+        # {a, b, c} = {1, 2, 3}
+        olds = sorted(o for o, _ in res)
+        assert olds[0] == 0
+        assert olds[1] in (1, 2, 3)
+        assert olds[2] in (3, 4, 5) and olds[2] > olds[1]
+        assert olds[2] - olds[1] in (1, 2, 3)
+
+    def test_host_window_request_rma(self):
+        from zhpe_ompi_tpu.pt2pt.universe import LocalUniverse
+        from zhpe_ompi_tpu.osc.window import HostWindow
+
+        uni = LocalUniverse(2)
+
+        def main(ctx):
+            buf = np.zeros(2, np.float64)
+            win = HostWindow.create(ctx, buf)
+            win.fence()
+            win.rput(np.float64(ctx.rank + 1), target=0,
+                     offset=ctx.rank).wait()
+            win.fence()
+            got = win.rget(0, 0, 2).wait()
+            win.fence()  # reads complete before the atomic epoch starts
+            old = win.fetch_and_op(5.0, target=0, offset=0)
+            win.fence()
+            win.free()
+            return (got.tolist(), float(old))
+
+        res = uni.run(main)
+        assert res[0][0] == [1.0, 2.0]
+        assert sorted(r[1] for r in res) == [1.0, 6.0]
+
+    def test_rget_error_travels(self):
+        def main(p):
+            win = AmWindow.create(p, np.zeros(2, np.float32))
+            win.fence()
+            err = None
+            if p.rank == 1:
+                req = win.rget(0, offset=0, count=64)
+                try:
+                    req.wait(timeout=20.0)
+                except errors.WinError as e:
+                    err = str(e)
+            win.fence()
+            win.free()
+            return err
+
+        assert "overruns" in run_tcp(2, main)[1]

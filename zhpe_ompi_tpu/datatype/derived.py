@@ -258,6 +258,100 @@ def create_subarray(
     return DerivedDatatype(f"subarray({sizes},{subsizes},{starts})", tm, total_bytes)
 
 
+# MPI_Type_create_darray distribution constants
+DISTRIBUTE_BLOCK = 1
+DISTRIBUTE_CYCLIC = 2
+DISTRIBUTE_NONE = 3
+DISTRIBUTE_DFLT_DARG = -1
+
+
+def create_darray(
+    size: int,
+    rank: int,
+    gsizes: list[int],
+    distribs: list[int],
+    dargs: list[int],
+    psizes: list[int],
+    oldtype: Datatype,
+    order: str = "C",
+) -> DerivedDatatype:
+    """MPI_Type_create_darray (cf. ompi_datatype_create_darray.c): the
+    HPF-style decomposition of an ndims-dimensional global array over a
+    process grid — the datatype parallel IO uses to give each rank its
+    block/cyclic slice of a file.  Supports BLOCK, CYCLIC(k), and NONE
+    per dimension; the extent covers the FULL global array, so counting
+    over the type tiles whole-array strides (the subarray convention)."""
+    ndims = len(gsizes)
+    if not (len(distribs) == len(dargs) == len(psizes) == ndims):
+        raise errors.ArgError("darray argument length mismatch")
+    if int(np.prod(psizes)) != size:
+        raise errors.ArgError(
+            f"process grid {psizes} does not cover comm size {size}"
+        )
+    if order not in ("C", "F"):
+        raise errors.ArgError(f"bad order {order!r}")
+    # this rank's coordinates in the process grid: ROW-MAJOR regardless
+    # of `order` (the MPI rule — ompi_datatype_create_darray.c:201
+    # "calculate position in grid using row-major ordering"; `order`
+    # affects only the storage strides below)
+    coords = [0] * ndims
+    r = rank
+    for d in range(ndims - 1, -1, -1):
+        coords[d] = r % psizes[d]
+        r //= psizes[d]
+    # per-dimension owned global indices
+    owned: list[np.ndarray] = []
+    for d in range(ndims):
+        g, p, c = gsizes[d], psizes[d], coords[d]
+        dist, darg = distribs[d], dargs[d]
+        if dist == DISTRIBUTE_NONE:
+            if p != 1:
+                # MPI mandates psize 1 for NONE dims: p > 1 would hand
+                # every grid coordinate the full range and silently
+                # cover the array p times over
+                raise errors.ArgError(
+                    f"darray DISTRIBUTE_NONE requires psizes[{d}] == 1, "
+                    f"got {p}"
+                )
+            idx = np.arange(g, dtype=np.int64)
+        elif dist == DISTRIBUTE_BLOCK:
+            blk = darg if darg != DISTRIBUTE_DFLT_DARG else -(-g // p)
+            if blk * p < g:
+                raise errors.ArgError(
+                    f"darray BLOCK darg {blk} too small for dim {d}"
+                )
+            start = c * blk
+            idx = np.arange(start, min(start + blk, g), dtype=np.int64)
+        elif dist == DISTRIBUTE_CYCLIC:
+            blk = darg if darg != DISTRIBUTE_DFLT_DARG else 1
+            base = np.arange(g, dtype=np.int64)
+            idx = base[(base // blk) % p == c]
+        else:
+            raise errors.ArgError(f"unknown distribution {dist}")
+        owned.append(idx)
+    # byte strides per dim over the full global array
+    strides = [0] * ndims
+    acc = oldtype.extent
+    sdims = range(ndims - 1, -1, -1) if order == "C" else range(ndims)
+    for d in sdims:
+        strides[d] = acc
+        acc *= gsizes[d]
+    total_bytes = acc
+    tm: list[tuple[np.dtype, int]] = []
+
+    def rec(dim: int, base: int):
+        if dim == ndims:
+            tm.extend(_expand(oldtype, base))
+            return
+        for i in owned[dim]:
+            rec(dim + 1, base + int(i) * strides[dim])
+
+    rec(0, 0)
+    return DerivedDatatype(
+        f"darray(r{rank}/{size},{gsizes},{psizes})", tm, total_bytes
+    )
+
+
 def create_resized(oldtype: Datatype, lb: int, extent: int) -> DerivedDatatype:
     """MPI_Type_create_resized.  MPI permits non-positive extents, but the
     pack/unpack engine addresses elements at `i * extent` from a 0-based

@@ -50,6 +50,7 @@ from ..core import errhandler as errh
 from ..core import errors
 from ..core import info as info_mod
 from ..runtime import spc
+from . import rma_util
 
 LOCK_SHARED = 1
 LOCK_EXCLUSIVE = 2
@@ -425,7 +426,7 @@ def resolve_dynamic(st: _AmWinState, disp: int, nbytes: int
     )
 
 
-class AmWindow(errh.HasErrhandler):
+class AmWindow(errh.HasErrhandler, rma_util.FetchOpMixin):
     """MPI window over a wire endpoint — HostWindow-compatible surface.
     Defaults to MPI_ERRORS_RETURN (the reference's window default);
     honors the "no_locks" info assertion."""
@@ -551,6 +552,66 @@ class AmWindow(errh.HasErrhandler):
             return old
         return self._rpc(
             target, ("cas", self.win_id, offset, compare, value)
+        )
+
+    # -- request-based RMA (MPI_Rput/Rget/Raccumulate family) -------------
+
+    def _async_rpc(self, target: int, msg_head: tuple):
+        """RPC returning a Request that completes with the reply — the
+        request-based RMA substrate (true overlap: the reply recv is
+        posted, the request fires, the caller waits whenever it wants)."""
+        from ..pt2pt.requests import Request
+
+        reply_tag = next(self.svc.reply_tags)
+        inner = self.ep.irecv(source=target, tag=reply_tag, cid=AM_CID)
+        req = Request()
+
+        def progress():
+            if not inner.done:
+                return
+            out = inner._value
+            if out[0] == "err":
+                cls_ = getattr(errors, out[1], errors.MpiError)
+                raise cls_(out[2])
+            req.complete(out[1], source=target)
+
+        req._progress = progress
+        self._send(target, msg_head + (reply_tag,))
+        return req
+
+    def rput(self, data, target: int, offset: int = 0):
+        """MPI_Rput: the request completes at LOCAL completion — the AM
+        payload is serialized at send time, so the buffer is immediately
+        reusable (remote completion still requires flush/unlock, per the
+        MPI contract)."""
+        self.put(data, target, offset)
+        return rma_util.completed_request()
+
+    def raccumulate(self, data, target: int, offset: int = 0,
+                    op: zops.Op = zops.SUM):
+        """MPI_Raccumulate: local completion, like rput."""
+        self.accumulate(data, target, offset, op)
+        return rma_util.completed_request()
+
+    def rget(self, target: int, offset: int = 0, count: int | None = None):
+        """MPI_Rget: returns a Request completing with the data — the
+        genuinely asynchronous one (overlap computation with the fetch)."""
+        if target == self.ep.rank:
+            with self.st.apply_lock:
+                out = read_window(self.st, offset, count)
+            return rma_util.completed_request(out)
+        return self._async_rpc(target, ("get", self.win_id, offset, count))
+
+    def rget_accumulate(self, data, target: int, offset: int = 0,
+                        op: zops.Op = zops.SUM):
+        """MPI_Rget_accumulate: asynchronous fetch-and-op."""
+        data = np.asarray(data)
+        if target == self.ep.rank:
+            return rma_util.completed_request(
+                apply_acc(self.st, offset, op, data)
+            )
+        return self._async_rpc(
+            target, ("get_acc", self.win_id, offset, op.name, data)
         )
 
     # -- synchronization --------------------------------------------------

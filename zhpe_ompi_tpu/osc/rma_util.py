@@ -1,0 +1,33 @@
+"""Shared request-based-RMA plumbing for the OSC planes.
+
+The in-process (`HostWindow`) and wire (`AmWindow`) components expose an
+identical MPI_Rput/Rget surface; the completed-request construction and
+the Fetch_and_op convenience live here once so the planes cannot drift.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def completed_request(value=None):
+    """A born-complete Request (local-completion semantics: the payload
+    was serialized / applied before this returns)."""
+    from ..pt2pt.requests import Request
+
+    req = Request()
+    req.complete(value)
+    return req
+
+
+class FetchOpMixin:
+    """MPI_Fetch_and_op over the window's get_accumulate (the common
+    atomic-counter idiom, single element)."""
+
+    def fetch_and_op(self, value, target: int, offset: int = 0, op=None):
+        from .. import ops as zops
+
+        return self.get_accumulate(
+            np.asarray(value).reshape(1), target, offset,
+            op if op is not None else zops.SUM,
+        )[0]

@@ -29,6 +29,7 @@ from ..core import errhandler as errh
 from ..core import errors
 from ..core import info as info_mod
 from ..runtime import spc
+from . import rma_util
 
 LOCK_SHARED = 1
 LOCK_EXCLUSIVE = 2
@@ -97,7 +98,7 @@ class _WinRegistry:
         self.expected_origins: list[set[int] | None] = [None] * size
 
 
-class HostWindow(errh.HasErrhandler):
+class HostWindow(errh.HasErrhandler, rma_util.FetchOpMixin):
     """Per-rank handle to a collectively-created window.
 
     Windows default to MPI_ERRORS_RETURN (the reference's win default)
@@ -237,6 +238,33 @@ class HostWindow(errh.HasErrhandler):
             if old == compare:
                 flat[offset] = value
         return old
+
+    # -- request-based RMA (MPI_Rput/Rget/Raccumulate family) -------------
+    # In-process RMA completes immediately (direct memory); the request
+    # form exists so programs written against it are portable to the AM
+    # plane, where rget/rget_accumulate genuinely overlap.
+
+    def rput(self, data, target: int, offset: int = 0):
+        """MPI_Rput."""
+        self.put(data, target, offset)
+        return rma_util.completed_request()
+
+    def raccumulate(self, data, target: int, offset: int = 0,
+                    op: zops.Op = zops.SUM):
+        """MPI_Raccumulate."""
+        self.accumulate(data, target, offset, op)
+        return rma_util.completed_request()
+
+    def rget(self, target: int, offset: int = 0, count: int | None = None):
+        """MPI_Rget."""
+        return rma_util.completed_request(self.get(target, offset, count))
+
+    def rget_accumulate(self, data, target: int, offset: int = 0,
+                        op: zops.Op = zops.SUM):
+        """MPI_Rget_accumulate."""
+        return rma_util.completed_request(
+            self.get_accumulate(data, target, offset, op)
+        )
 
     # -- synchronization -------------------------------------------------
 
