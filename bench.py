@@ -20,14 +20,21 @@ the last loss bounds the whole window.  A physics assert rejects any
 throughput implying more FLOP/s than the chip's peak, so a broken sync can
 never ship a bogus number.
 
-vs_baseline > 1 explained (round-3 item 7): ``benchmarks/hlo_diff.py``
-dumps the optimized HLO of both steps and — after stripping source-location
-metadata and argument names — they are IDENTICAL on this chip.  The two
-paths compile to the same program, so the true ratio is 1.00 and any
-deviation is measurement procedure, not the framework.  The round-2 +10%
-came from a fixed window order (framework always timed first in each
-interleave pair); windows now alternate order every round and the count is
-4, which centers the ratio at ~1.0.
+vs_baseline > 1 explained and eliminated (round-3 item 7):
+``benchmarks/hlo_diff.py`` proves the optimized HLO of both steps is
+IDENTICAL on this chip (after stripping source-location metadata and
+argument names), so the true ratio is 1.00 and any deviation is
+measurement procedure.  ``benchmarks/order_probe.py`` then located the
+round-2 +10%: the chip runs ~10% faster for one brief window after first
+dispatch (measured 19.8 ms first window vs 21.7-22.5 ms steady state; a
+second, independently-jitted instance of the SAME framework program
+tracks the baseline, not the framework — so the delta follows build/run
+order, not the program).  The framework was always prepped and timed
+first, so best-of-windows handed it the boost window.  The fix: one
+discarded burn-in window per path, median (not best) over the remaining
+windows, and vs_baseline = median of adjacent-pair ratios — drift-robust
+and centered at 1.00.  The same transient inflated the round-2 headline
+throughput/MFU ~10%; round-3 numbers are steady-state honest.
 """
 
 import json
@@ -128,7 +135,7 @@ def main():
             ps, loss = step(ps, tok, tgt)
         float(loss)  # forced host fetch: drains the queue for real
         return {"step": step, "ps": ps, "tok": tok, "tgt": tgt,
-                "best": float("inf")}
+                "times": []}
 
     def window(st):
         step, tok, tgt = st["step"], st["tok"], st["tgt"]
@@ -139,7 +146,7 @@ def main():
         # The steps form a dependency chain (params thread through), so
         # fetching the final loss to the host bounds the whole window.
         lval = float(loss)
-        st["best"] = min(st["best"], (time.perf_counter() - t0) / iters)
+        st["times"].append((time.perf_counter() - t0) / iters)
         st["ps"] = ps
         # raise (not assert): must survive python -O — this is the guard
         # that a broken sync / NaN window can never ship a bogus number;
@@ -210,14 +217,28 @@ def main():
     # warmer device state (measured ~2 ms/step order bias on v5e).
     st_fw = prep(step_fw, specs)
     st_pl = prep(make_plain_step(), specs)
+    # burn-in: the chip's very first timed window after dispatch runs ~10%
+    # fast (order_probe.py); discard one window per path so the measured
+    # windows are steady-state
+    window(st_fw)
+    window(st_pl)
+    st_fw["times"].clear()
+    st_pl["times"].clear()
+    ratios = []
     for i in range(4):
-        # alternate which path is timed first: a fixed order biases the
-        # first-timed path (~10% measured on v5e; see module docstring)
+        # alternate which path is timed first within each adjacent pair;
+        # the pair ratio cancels any residual slow drift
         first, second = (st_fw, st_pl) if i % 2 == 0 else (st_pl, st_fw)
         window(first)
         window(second)
-    fw_s = check_physics(st_fw["best"])
-    plain_s = check_physics(st_pl["best"])
+        ratios.append(st_pl["times"][-1] / st_fw["times"][-1])
+    # physics-check the FASTEST window of each path (not just the median):
+    # a sync that breaks in a minority of windows must still trip the guard
+    check_physics(min(st_fw["times"]))
+    check_physics(min(st_pl["times"]))
+    fw_s = check_physics(float(np.median(st_fw["times"])))
+    plain_s = check_physics(float(np.median(st_pl["times"])))
+    vs_baseline = float(np.median(ratios))
 
     fw_tps = batch * cfg.seq / fw_s
     mfu = (flops_step / fw_s) / peak if kind_known else 0.0
@@ -225,7 +246,7 @@ def main():
         "metric": "train_step_throughput",
         "value": round(fw_tps, 1),
         "unit": "tokens/s",
-        "vs_baseline": round(plain_s / fw_s, 4),
+        "vs_baseline": round(vs_baseline, 4),
         "step_ms": round(fw_s * 1e3, 2),
         "mfu": round(mfu, 4),
         "flops_per_step": flops_step,
@@ -272,15 +293,18 @@ def main():
         ps, loss = step_lc(lc_sharded, lc_tok, lc_tgt)  # compile
         ps, loss = step_lc(ps, lc_tok, lc_tgt)
         float(loss)
-        best = float("inf")
+        lc_times = []
         for _ in range(3):
             t0 = time.perf_counter()
             for _ in range(lc_iters):
                 ps, loss = step_lc(ps, lc_tok, lc_tgt)
             lval = float(loss)
-            best = min(best, (time.perf_counter() - t0) / lc_iters)
+            lc_times.append((time.perf_counter() - t0) / lc_iters)
             if not np.isfinite(lval):
                 raise RuntimeError(f"long-context non-finite loss {lval}")
+        best = float(np.median(lc_times))  # steady-state by now; median
+        if lc_flops / min(lc_times) >= peak:  # guard every window
+            raise RuntimeError("long-context timing sync broken")
         if lc_flops / best >= peak:
             raise RuntimeError("long-context timing sync broken")
         result.update({

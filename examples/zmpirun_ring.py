@@ -1,0 +1,49 @@
+"""Ring-pass under the zmpirun launcher — the reference's
+``examples/ring_c.c:19-60`` run the way the reference runs it:
+``mpirun -n 4 ring`` with real OS processes.
+
+    python -m zhpe_ompi_tpu.tools.mpirun -n 4 examples/zmpirun_ring.py
+
+Each rank joins the job with ``host_init()`` (the MPI_Init/PMIx-client
+analog), passes a decrementing token around the ring, then allreduces a
+check value across the job.
+"""
+
+import sys
+
+
+def main():
+    import zhpe_ompi_tpu as zmpi
+    from zhpe_ompi_tpu import ops as zops
+
+    proc = zmpi.host_init()
+    rank, size = proc.rank, proc.size
+    nxt, prv = (rank + 1) % size, (rank - 1) % size
+
+    laps = 3
+    token = 0
+    for _ in range(laps):
+        if rank == 0:
+            proc.send(token, nxt, tag=7)
+            token = proc.recv(source=prv, tag=7)
+        else:
+            token = proc.recv(source=prv, tag=7)
+            proc.send(token + 1, nxt, tag=7)
+    if rank == 0:
+        print(f"rank 0 token {token} after {laps} laps")
+        if token != laps * (size - 1):
+            sys.exit(1)
+
+    total = proc.allreduce(rank, zops.SUM)
+    expect = size * (size - 1) // 2
+    if total != expect:
+        print(f"rank {rank}: allreduce got {total} want {expect}")
+        sys.exit(1)
+    proc.barrier()
+    if rank == 0:
+        print("PASSED")
+    zmpi.host_finalize()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,122 @@
+"""zmpirun launcher tests — the reference's launch surface
+(mpirun → prte, ``ompi/tools/mpirun/Makefile.am:11-15``) exercised the way
+``test/simple/`` exercises it: tiny programs under the launcher, plus the
+abort/teardown path (``test/simple/delayed_abort.c`` shape).
+
+These spawn REAL OS processes; every rank's endpoint comes up through the
+ZMPI_* env contract via zmpi.host_init().
+"""
+
+import io
+import os
+import sys
+import textwrap
+
+import pytest
+
+from zhpe_ompi_tpu.tools import mpirun
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _script(tmp_path, body: str) -> str:
+    p = tmp_path / "prog.py"
+    p.write_text(
+        "import sys\n"
+        f"sys.path.insert(0, {_REPO!r})\n" + textwrap.dedent(body)
+    )
+    return str(p)
+
+
+def _launch(n, argv, **kw):
+    out, err = io.StringIO(), io.StringIO()
+    rc = mpirun.launch(n, argv, stdout=out, stderr=err, timeout=60.0, **kw)
+    return rc, out.getvalue(), err.getvalue()
+
+
+def test_ring_example():
+    rc, out, err = _launch(
+        3, [os.path.join(_REPO, "examples", "zmpirun_ring.py")]
+    )
+    assert rc == 0, err
+    assert "PASSED" in out
+    # IOF prefixes: rank 0's lines carry the [0] tag
+    assert "[0] " in out
+
+
+def test_collectives_across_processes(tmp_path):
+    prog = _script(tmp_path, """
+        import zhpe_ompi_tpu as zmpi
+        from zhpe_ompi_tpu import ops as zops
+
+        proc = zmpi.host_init()
+        vals = proc.allgather(proc.rank * 10)
+        assert vals == [0, 10, 20], vals
+        got = proc.bcast("hello" if proc.rank == 1 else None, root=1)
+        assert got == "hello"
+        m = proc.allreduce(proc.rank, zops.MAX)
+        assert m == proc.size - 1
+        print(f"rank {proc.rank} OK")
+        zmpi.host_finalize()
+    """)
+    rc, out, err = _launch(3, [prog])
+    assert rc == 0, err
+    assert out.count("OK") == 3
+
+
+def test_abort_tears_down_job(tmp_path):
+    # one rank exits nonzero; the launcher must kill the others (which
+    # block forever) and surface the failing code — MPI_Abort semantics
+    prog = _script(tmp_path, """
+        import sys, time
+        import zhpe_ompi_tpu as zmpi
+
+        proc = zmpi.host_init()
+        if proc.rank == 1:
+            sys.exit(7)
+        time.sleep(600)
+    """)
+    rc, out, err = _launch(3, [prog])
+    assert rc == 7
+    assert "rank 1 exited with code 7" in err
+
+
+def test_mca_forwarding(tmp_path):
+    prog = _script(tmp_path, """
+        import zhpe_ompi_tpu as zmpi
+
+        proc = zmpi.host_init()  # imports pt2pt.tcp, registering tcp_* vars
+        val = zmpi.mca_var.get("tcp_eager_limit", None)
+        print(f"rank {proc.rank} eager={val}")
+        zmpi.host_finalize()
+    """)
+    rc, out, err = _launch(2, [prog], mca=[("tcp_eager_limit", "4096")])
+    assert rc == 0, err
+    assert out.count("eager=4096") == 2
+
+
+def test_job_timeout(tmp_path):
+    prog = _script(tmp_path, """
+        import time
+        time.sleep(600)
+    """)
+    out, err = io.StringIO(), io.StringIO()
+    rc = mpirun.launch(2, [prog], stdout=out, stderr=err, timeout=3.0)
+    assert rc == 124
+    assert "timeout" in err.getvalue()
+
+
+def test_cli_entrypoint(tmp_path):
+    # python -m zhpe_ompi_tpu.tools.mpirun parses and runs end to end
+    import subprocess
+
+    prog = _script(tmp_path, "print('cli-ok')\n")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    res = subprocess.run(
+        [sys.executable, "-m", "zhpe_ompi_tpu.tools.mpirun",
+         "-n", "2", "--no-tag-output", prog],
+        capture_output=True, text=True, timeout=120, env=env,
+    )
+    assert res.returncode == 0, res.stderr
+    assert res.stdout.count("cli-ok") == 2

@@ -55,6 +55,9 @@ from .runtime import spc
 from .runtime.init import (
     comm_self,
     finalize,
+    host_finalize,
+    host_init,
+    host_world,
     init,
     initialized,
     is_finalized,
@@ -66,6 +69,7 @@ __version__ = "0.1.0"
 
 __all__ = [
     "init", "finalize", "initialized", "is_finalized", "world", "comm_self",
+    "host_init", "host_world", "host_finalize",
     "world_mesh", "Communicator", "Group", "mesh", "datatype", "ops", "spc",
     "dpm",
     "errors", "mca_var", "mca_component", "mca_output", "coll_algorithms",

@@ -117,8 +117,8 @@ class TcpProc(HostCollectives, NonblockingCollectives):
     thread ranks — the coll-rides-the-PML layering of the reference.
 
     Construction is collective: every rank calls with the same coordinator
-    address; rank 0 must also pass ``is_coordinator=True`` (it binds the
-    rendezvous socket).  `host` is this rank's reachable address."""
+    address; rank 0 binds it as the rendezvous socket, the rest connect
+    with retry.  `host` is this rank's reachable address."""
 
     def __init__(self, rank: int, size: int,
                  coordinator: tuple[str, int] = ("127.0.0.1", 0),

@@ -111,3 +111,62 @@ def finalize() -> None:
 
 def is_finalized() -> bool:
     return _global["finalized"]
+
+
+# -- host plane (launcher-started multi-process jobs) ----------------------
+
+_host = {"proc": None}
+_host_lock = threading.Lock()
+
+
+def host_init(timeout: float = 30.0):
+    """Wire this process into a launcher-started host-plane universe.
+
+    The PMIx-client side of ``zmpirun`` (``tools/mpirun.py``): reads the
+    ``ZMPI_RANK/SIZE/COORD_HOST/COORD_PORT`` environment contract — the
+    same one the C ABI shim's ``MPI_Init`` reads (``native/zompi_mpi.cpp``)
+    — and performs the TcpProc modex, mirroring the reference's
+    ``ompi_rte_init`` → PMIx_Init connect-to-local-prted step
+    (``ompi_mpi_init.c:508``).  Idempotent; returns this process's
+    :class:`~zhpe_ompi_tpu.pt2pt.tcp.TcpProc` endpoint (rank, size,
+    send/recv, collectives).
+    """
+    import os
+
+    with _host_lock:
+        if _host["proc"] is not None:
+            return _host["proc"]
+        try:
+            rank = int(os.environ["ZMPI_RANK"])
+            size = int(os.environ["ZMPI_SIZE"])
+            chost = os.environ["ZMPI_COORD_HOST"]
+            cport = int(os.environ["ZMPI_COORD_PORT"])
+        except (KeyError, ValueError) as e:
+            raise errors.NotInitializedError(
+                f"host_init: bad ZMPI_* contract ({e}) — run under zmpirun "
+                "(python -m zhpe_ompi_tpu.tools.mpirun) or export "
+                "ZMPI_RANK/SIZE/COORD_HOST/COORD_PORT"
+            ) from None
+        from ..pt2pt.tcp import TcpProc
+
+        t0 = time.perf_counter()
+        proc = TcpProc(rank, size, coordinator=(chost, cport), timeout=timeout)
+        _host["proc"] = proc
+        spc.record("init_count", 1)
+        mca_output.verbose(
+            1, _stream, "host plane up: rank %d/%d in %.1fms", rank, size,
+            (time.perf_counter() - t0) * 1e3,
+        )
+        return proc
+
+
+def host_world():
+    """The TcpProc endpoint created by :func:`host_init` (or None)."""
+    return _host["proc"]
+
+
+def host_finalize() -> None:
+    with _host_lock:
+        proc, _host["proc"] = _host["proc"], None
+        if proc is not None:
+            proc.close()

@@ -1,0 +1,221 @@
+"""``zmpirun`` — the mpirun/PRRTE analog for the host plane.
+
+In the reference, ``mpirun`` is literally a symlink to the external ``prte``
+binary (``ompi/tools/mpirun/Makefile.am:11-15``): PRRTE launches the
+processes, forwards their stdio (IOF), hands each proc its rank and the
+PMIx contact info through the environment, propagates exit codes, and
+tears the whole job down when any rank aborts
+(``test/simple/delayed_abort.c`` is the acceptance shape for that).
+
+This CLI is that surface for the TCP/DCN plane:
+
+- **launch**: spawn ``-n`` local processes with the ``ZMPI_*`` environment
+  contract (the PMIx-put/get analog) shared with the C ABI shim
+  (``native/zompi_mpi.cpp`` reads the same four variables), so both Python
+  ranks (via :func:`host_init`) and compiled C ranks (via the shim's
+  ``MPI_Init``) join the same wire-up protocol.
+- **IOF**: children's stdout/stderr are line-forwarded with a ``[r]``
+  prefix (mpirun ``--tag-output`` semantics, on by default).
+- **abort**: if any rank exits nonzero the remaining ranks are terminated
+  after a short grace period and the job exits with the failing rank's
+  code — MPI_Abort job semantics.
+- **--mca name value** is forwarded as ``ZMPI_MCA_<name>`` env, exactly
+  the reference's ``mpirun --mca`` → ``OMPI_MCA_*`` plumbing.
+
+The rendezvous port is chosen by the launcher (bind-probe then release);
+rank 0 re-binds it as the modex coordinator — the same fixed-port scheme
+the C ABI interop tests use.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+_TERM_GRACE = 2.0  # seconds between SIGTERM and SIGKILL on abort
+
+
+def _free_port(host: str) -> int:
+    s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    s.bind((host, 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _forward(stream, rank: int, label: str, out, lock: threading.Lock,
+             tag: bool) -> None:
+    """IOF drain thread: line-forward a child stream with a rank prefix."""
+    for line in iter(stream.readline, ""):
+        with lock:
+            if tag:
+                out.write(f"[{rank}{label}] {line}")
+            else:
+                out.write(line)
+            out.flush()
+    stream.close()
+
+
+def build_env(rank: int, size: int, host: str, port: int,
+              mca: list[tuple[str, str]] | None = None) -> dict:
+    """The ZMPI_* environment contract one rank sees (PMIx envars analog)."""
+    env = dict(os.environ)
+    env.update({
+        "ZMPI_RANK": str(rank),
+        "ZMPI_SIZE": str(size),
+        "ZMPI_COORD_HOST": host,
+        "ZMPI_COORD_PORT": str(port),
+    })
+    # make the framework importable in every rank regardless of cwd — the
+    # mpirun-exports-its-library-paths behavior (OPAL_PREFIX/LD_LIBRARY_PATH)
+    pkg_root = os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))
+    ))
+    parts = env.get("PYTHONPATH", "").split(os.pathsep)
+    if pkg_root not in parts:
+        env["PYTHONPATH"] = os.pathsep.join([pkg_root] + [p for p in parts if p])
+    for name, value in mca or ():
+        env[f"ZMPI_MCA_{name}"] = value
+    return env
+
+
+def launch(n: int, argv: list[str], host: str = "127.0.0.1",
+           mca: list[tuple[str, str]] | None = None,
+           timeout: float | None = None, tag_output: bool = True,
+           stdout=None, stderr=None) -> int:
+    """Run ``argv`` as an ``n``-rank job; returns the job exit code.
+
+    Python programs (``*.py``) run under the current interpreter; anything
+    else is exec'd directly (a C program linked against the ABI shim).
+    """
+    if n < 1:
+        raise ValueError("zmpirun: -n must be >= 1")
+    stdout = stdout if stdout is not None else sys.stdout
+    stderr = stderr if stderr is not None else sys.stderr
+    port = _free_port(host)
+    cmd = list(argv)
+    if cmd[0].endswith(".py"):
+        cmd = [sys.executable] + cmd
+
+    procs: list[subprocess.Popen] = []
+    drains: list[threading.Thread] = []
+    out_lock = threading.Lock()
+    for rank in range(n):
+        p = subprocess.Popen(
+            cmd, env=build_env(rank, n, host, port, mca),
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            start_new_session=True,  # isolate from our signal group
+        )
+        procs.append(p)
+        for stream, label, sink in (
+            (p.stdout, "", stdout), (p.stderr, ":err", stderr),
+        ):
+            t = threading.Thread(
+                target=_forward,
+                args=(stream, rank, label, sink, out_lock, tag_output),
+                daemon=True,
+            )
+            t.start()
+            drains.append(t)
+
+    deadline = time.monotonic() + timeout if timeout else None
+    exit_code = 0
+    failed_rank = None
+    live = set(range(n))
+    try:
+        while live:
+            for rank in sorted(live):
+                rc = procs[rank].poll()
+                if rc is None:
+                    continue
+                live.discard(rank)
+                if rc != 0 and failed_rank is None:
+                    failed_rank, exit_code = rank, rc
+            if failed_rank is not None and live:
+                # MPI_Abort job teardown: one rank failed, kill the rest
+                with out_lock:
+                    stderr.write(
+                        f"zmpirun: rank {failed_rank} exited with code "
+                        f"{exit_code}; terminating {len(live)} remaining "
+                        "rank(s)\n"
+                    )
+                    stderr.flush()
+                _teardown(procs, live)
+                break
+            if deadline is not None and time.monotonic() > deadline:
+                with out_lock:
+                    stderr.write(
+                        f"zmpirun: job timeout after {timeout}s; killing "
+                        f"{len(live)} rank(s)\n"
+                    )
+                    stderr.flush()
+                _teardown(procs, live)
+                exit_code = 124
+                break
+            time.sleep(0.02)
+    except KeyboardInterrupt:
+        _teardown(procs, live)
+        exit_code = 130
+    for t in drains:
+        t.join(timeout=2.0)
+    return exit_code
+
+
+def _teardown(procs: list[subprocess.Popen], live: set) -> None:
+    for rank in list(live):
+        p = procs[rank]
+        if p.poll() is None:
+            try:
+                os.killpg(os.getpgid(p.pid), signal.SIGTERM)
+            except (OSError, ProcessLookupError):
+                pass
+    grace_end = time.monotonic() + _TERM_GRACE
+    for rank in list(live):
+        p = procs[rank]
+        try:
+            p.wait(timeout=max(0.0, grace_end - time.monotonic()))
+        except subprocess.TimeoutExpired:
+            try:
+                os.killpg(os.getpgid(p.pid), signal.SIGKILL)
+            except (OSError, ProcessLookupError):
+                pass
+            p.wait()
+        live.discard(rank)
+
+
+def main(args: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="zmpirun",
+        description="Launch an n-rank host-plane job (mpirun analog).",
+    )
+    ap.add_argument("-n", "--np", type=int, required=True, dest="n",
+                    help="number of ranks")
+    ap.add_argument("--host", default="127.0.0.1",
+                    help="bind/rendezvous address (default 127.0.0.1)")
+    ap.add_argument("--mca", nargs=2, action="append", default=[],
+                    metavar=("NAME", "VALUE"),
+                    help="set an MCA variable (forwarded as ZMPI_MCA_NAME)")
+    ap.add_argument("--timeout", type=float, default=None,
+                    help="kill the job after this many seconds")
+    ap.add_argument("--no-tag-output", action="store_true",
+                    help="forward child output without [rank] prefixes")
+    ap.add_argument("argv", nargs=argparse.REMAINDER,
+                    help="program and its arguments")
+    ns = ap.parse_args(args)
+    if not ns.argv:
+        ap.error("no program given")
+    return launch(
+        ns.n, ns.argv, host=ns.host, mca=[tuple(m) for m in ns.mca],
+        timeout=ns.timeout, tag_output=not ns.no_tag_output,
+    )
+
+
+if __name__ == "__main__":
+    sys.exit(main())
