@@ -1,0 +1,74 @@
+"""MFU lever sweep on the real chip: batch size x remat x flash for the
+headline config.  Steady-state discipline from bench.py (burn-in window,
+median of 3).
+
+Run from repo root: python benchmarks/mfu_sweep.py
+"""
+
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+import numpy as np
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    import zhpe_ompi_tpu as zmpi
+    from zhpe_ompi_tpu.models import transformer as tfm
+
+    import bench
+
+    devs = jax.devices()
+    mesh = Mesh(np.asarray(devs[:1]).reshape(1, 1), ("dp", "tp"))
+    dp_comm = zmpi.Communicator(mesh, "dp", name="sweep_dp")
+
+    peak, _ = bench._chip_peak(devs[0])
+
+    for batch, remat, seq in [
+        (8, False, 512), (16, False, 512), (32, False, 512),
+        (16, True, 512), (32, True, 512), (64, True, 512),
+    ]:
+        cfg = tfm.Config(
+            vocab=8192, d_model=1024, n_heads=16, d_ff=4096, n_layers=4,
+            seq=seq, dtype=jnp.bfloat16, remat=remat,
+        )
+        r = np.random.default_rng(0)
+        params = tfm.init_params(cfg, jax.random.PRNGKey(0))
+        tok = jnp.asarray(r.integers(0, cfg.vocab, (batch, cfg.seq)))
+        tgt = jnp.asarray(r.integers(0, cfg.vocab, (batch, cfg.seq)))
+        step, specs = tfm.make_train_step(cfg, mesh, dp_comm, None)
+        sharded = {k: jax.device_put(v, NamedSharding(mesh, specs[k]))
+                   for k, v in params.items()}
+        dspec = NamedSharding(mesh, P("dp"))
+        tokd, tgtd = jax.device_put(tok, dspec), jax.device_put(tgt, dspec)
+        try:
+            ps, loss = step(sharded, tokd, tgtd)
+            for _ in range(3):
+                ps, loss = step(ps, tokd, tgtd)
+            float(loss)
+            iters = max(4, int(0.5 / (0.003 * batch)))
+            times = []
+            for w in range(4):  # first window discarded
+                t0 = time.perf_counter()
+                for _ in range(iters):
+                    ps, loss = step(ps, tokd, tgtd)
+                float(loss)
+                if w > 0:
+                    times.append((time.perf_counter() - t0) / iters)
+            med = float(np.median(times))
+            fl = bench._train_flops_per_step(cfg, batch)
+            print(f"B={batch:3d} remat={int(remat)} seq={seq}: "
+                  f"{med*1e3:7.2f} ms  {batch*seq/med:9.0f} tok/s  "
+                  f"MFU {fl/med/peak*100:5.2f}%", flush=True)
+        except Exception as e:
+            print(f"B={batch:3d} remat={int(remat)} seq={seq}: FAILED "
+                  f"{type(e).__name__}: {str(e)[:120]}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -120,3 +120,26 @@ def test_cli_entrypoint(tmp_path):
     )
     assert res.returncode == 0, res.stderr
     assert res.stdout.count("cli-ok") == 2
+
+
+def test_c_program_under_launcher(tmp_path):
+    """A compiled C rank (ABI shim) launches under zmpirun: the shim's
+    MPI_Init honors ZMPI_COORD_EXTERNAL and joins the launcher-hosted
+    rendezvous as a client — C and the launcher speak one wire-up."""
+    import subprocess
+
+    from zhpe_ompi_tpu import native
+
+    shim = native.build_mpi_shim()
+    libdir = os.path.dirname(shim)
+    libname = os.path.basename(shim)[3:].rsplit(".so", 1)[0]
+    binary = tmp_path / "ring_c"
+    subprocess.run(
+        ["gcc", os.path.join(_REPO, "examples", "ring_c.c"),
+         "-o", str(binary), "-I", native.mpi_header_dir(),
+         "-L", libdir, f"-l{libname}", f"-Wl,-rpath,{libdir}"],
+        check=True, capture_output=True, text=True,
+    )
+    rc, out, err = _launch(3, [str(binary)])
+    assert rc == 0, err
+    assert "PASSED" in out or "ring" in out.lower(), out

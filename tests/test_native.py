@@ -16,7 +16,7 @@ from zhpe_ompi_tpu.pt2pt import matching
 
 def test_native_builds():
     assert native.available(), f"native build failed: {native.build_error}"
-    assert native.load().zompi_abi_version() == 2
+    assert native.load().zompi_abi_version() == 3
 
 
 @pytest.fixture
@@ -186,3 +186,63 @@ class TestNativeMatching:
                 peng.incoming(env, f"m{i}")
         assert nlog == plog
         assert neng.stats() == peng.stats()
+
+
+class TestShmAmo:
+    """Native cross-process AMOs (zompi_shm_amo): exercised on ordinary
+    process memory here (the mapping case is tests/test_shmem_mmap.py)."""
+
+    def _amo(self, arr, code, kind, vi=0, ci=0, vf=0.0, cf=0.0):
+        import ctypes
+
+        lib = native.load()
+        oi = ctypes.c_int64(0)
+        of = ctypes.c_double(0.0)
+        rc = lib.zompi_shm_amo(
+            ctypes.c_void_p(arr.ctypes.data), code, kind,
+            vi, ci, vf, cf, ctypes.byref(oi), ctypes.byref(of),
+        )
+        assert rc == 0
+        return oi.value, of.value
+
+    def test_int64_add_swap_cas(self):
+        a = np.array([10], dtype=np.int64)
+        old, _ = self._amo(a, 6, 0, vi=5)       # add
+        assert (old, a[0]) == (10, 15)
+        old, _ = self._amo(a, 6, 1, vi=100)     # swap
+        assert (old, a[0]) == (15, 100)
+        old, _ = self._amo(a, 6, 2, vi=7, ci=100)  # cas hit
+        assert (old, a[0]) == (100, 7)
+        old, _ = self._amo(a, 6, 2, vi=9, ci=100)  # cas miss
+        assert (old, a[0]) == (7, 7)
+        old, _ = self._amo(a, 6, 4)             # fetch
+        assert old == 7
+
+    def test_float32_add_cas(self):
+        a = np.array([1.5], dtype=np.float32)
+        _, old = self._amo(a, 8, 0, vf=2.25)
+        assert (old, float(a[0])) == (1.5, 3.75)
+        _, old = self._amo(a, 8, 2, vf=9.0, cf=3.75)
+        assert (old, float(a[0])) == (3.75, 9.0)
+
+    def test_narrow_widths(self):
+        for code, dt in [(0, np.int8), (2, np.int16), (4, np.int32),
+                         (7, np.uint64)]:
+            a = np.array([3], dtype=dt)
+            old, _ = self._amo(a, code, 0, vi=4)
+            assert (old, int(a[0])) == (3, 7)
+
+    def test_concurrent_fetch_add_exact(self):
+        import threading
+
+        a = np.zeros(1, dtype=np.int64)
+        ADDS, THREADS = 2000, 8
+
+        def worker():
+            for _ in range(ADDS):
+                self._amo(a, 6, 0, vi=1)
+
+        ts = [threading.Thread(target=worker) for _ in range(THREADS)]
+        [t.start() for t in ts]
+        [t.join() for t in ts]
+        assert a[0] == ADDS * THREADS

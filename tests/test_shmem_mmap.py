@@ -1,0 +1,234 @@
+"""PGAS over mapped segments (sshmem/mmap analog, ``shmem/segment.py``).
+
+Two tiers:
+- the API surface over the mapped substrate with thread ranks (fast,
+  same harness as the wire tests);
+- REAL OS processes under the zmpirun launcher — direct loads/stores and
+  native atomics against a mapping shared across address spaces, which
+  is the property the reference's sshmem/mmap exists for.
+"""
+
+import io
+import os
+import textwrap
+
+import numpy as np
+import pytest
+
+from test_tcp import run_tcp
+from zhpe_ompi_tpu.shmem.api import shmem_mapped_pe
+from zhpe_ompi_tpu.tools import mpirun
+
+N = 4
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run_mapped(n, fn, heap_bytes=1 << 16, timeout=60.0):
+    def main(p):
+        pe = shmem_mapped_pe(p, heap_bytes)
+        try:
+            return fn(pe)
+        finally:
+            pe._backend.close()
+
+    return run_tcp(n, main, timeout=timeout)
+
+
+class TestMappedThreads:
+    def test_circular_shift(self):
+        def prog(pe):
+            me, n = pe.my_pe(), pe.n_pes()
+            sym = pe.shmalloc(4, np.float64)
+            pe.local(sym)[...] = me
+            pe.barrier_all()
+            pe.put(sym, np.full(4, float(me)), (me + 1) % n)
+            pe.barrier_all()
+            got = pe.local(sym).copy()
+            pe.barrier_all()
+            pe.shfree(sym)
+            return got.tolist()
+
+        res = run_mapped(N, prog)
+        for r in range(N):
+            assert res[r] == [float((r - 1) % N)] * 4
+
+    def test_amo_fetch_add_contention(self):
+        """Every PE hammers PE 0's counter; the count must be exact
+        (native __atomic path or flock fallback)."""
+        ADDS = 200
+
+        def prog(pe):
+            sym = pe.shmalloc(1, np.int64)
+            pe.local(sym)[...] = 0
+            pe.barrier_all()
+            for _ in range(ADDS):
+                pe.atomic_add(sym, 1, 0)
+            pe.barrier_all()
+            out = int(pe.local(sym)[0])
+            pe.barrier_all()
+            pe.shfree(sym)
+            return out
+
+        res = run_mapped(N, prog)
+        assert res[0] == N * ADDS
+
+    def test_amo_cas_swap_float(self):
+        def prog(pe):
+            sym = pe.shmalloc(2, np.float32)
+            pe.local(sym)[...] = [1.5, 0.0]
+            pe.barrier_all()
+            if pe.my_pe() == 1:
+                old = pe.atomic_compare_swap(sym, 1.5, 7.25, 0, index=0)
+                assert old == np.float32(1.5), old
+                old = pe.atomic_swap(sym, 3.0, 0, index=1)
+                assert old == np.float32(0.0), old
+            pe.barrier_all()
+            out = pe.local(sym).copy() if pe.my_pe() == 0 else None
+            pe.barrier_all()
+            pe.shfree(sym)
+            return None if out is None else out.tolist()
+
+        res = run_mapped(N, prog)
+        assert res[0] == [7.25, 3.0]
+
+    def test_strided_iput_iget(self):
+        def prog(pe):
+            sym = pe.shmalloc(8, np.int32)
+            pe.local(sym)[...] = 0
+            pe.barrier_all()
+            if pe.my_pe() == 0:
+                pe.iput(sym, np.arange(4, dtype=np.int32), 1, tst=2, sst=1)
+            pe.barrier_all()
+            got = pe.iget(sym, 1, 4, sst=2)
+            pe.barrier_all()
+            pe.shfree(sym)
+            return got.tolist()
+
+        res = run_mapped(2, prog)
+        assert res[0] == [0, 1, 2, 3]
+
+    def test_lock_mutual_exclusion(self):
+        """Guarded non-atomic increments under shmem_set_lock must not
+        lose updates."""
+        ADDS = 50
+
+        def prog(pe):
+            lock = pe.shmalloc(1, np.int64)
+            ctr = pe.shmalloc(1, np.int64)
+            pe.local(ctr)[...] = 0
+            pe.barrier_all()
+            for _ in range(ADDS):
+                pe.set_lock(lock)
+                cur = int(pe.g(ctr, 0))
+                pe.p(ctr, cur + 1, 0)
+                pe.quiet()
+                pe.clear_lock(lock)
+            pe.barrier_all()
+            out = int(pe.local(ctr)[0])
+            pe.barrier_all()
+            pe.shfree(ctr)
+            pe.shfree(lock)
+            return out
+
+        res = run_mapped(N, prog)
+        assert res[0] == N * ADDS
+
+    def test_collectives_over_mapped(self):
+        def prog(pe):
+            n = pe.n_pes()
+            src = pe.shmalloc(2, np.int32)
+            dst = pe.shmalloc(2 * n, np.int32)
+            pe.local(src)[...] = [pe.my_pe(), pe.my_pe() + 10]
+            pe.barrier_all()
+            pe.fcollect(dst, src)
+            out = pe.local(dst).copy().tolist()
+            pe.barrier_all()
+            pe.shfree(dst)
+            pe.shfree(src)
+            return out
+
+        res = run_mapped(N, prog)
+        want = []
+        for r in range(N):
+            want += [r, r + 10]
+        assert all(r == want for r in res)
+
+
+def _script(tmp_path, body: str) -> str:
+    p = tmp_path / "prog.py"
+    p.write_text(
+        "import sys\n"
+        f"sys.path.insert(0, {_REPO!r})\n" + textwrap.dedent(body)
+    )
+    return str(p)
+
+
+def _launch(n, argv):
+    out, err = io.StringIO(), io.StringIO()
+    rc = mpirun.launch(n, argv, stdout=out, stderr=err, timeout=120.0)
+    return rc, out.getvalue(), err.getvalue()
+
+
+class TestMappedProcesses:
+    """The cross-process proof: separate address spaces, one mapping."""
+
+    def test_cross_process_put_amo(self, tmp_path):
+        prog = _script(tmp_path, """
+            import numpy as np
+            import zhpe_ompi_tpu as zmpi
+            from zhpe_ompi_tpu.shmem.api import shmem_mapped_pe
+
+            proc = zmpi.host_init()
+            pe = shmem_mapped_pe(proc, 1 << 16)
+            me, n = pe.my_pe(), pe.n_pes()
+
+            sym = pe.shmalloc(4, np.float64)
+            pe.local(sym)[...] = me
+            pe.barrier_all()
+            pe.put(sym, np.full(4, float(me)), (me + 1) % n)
+            pe.barrier_all()
+            assert pe.local(sym).tolist() == [float((me - 1) % n)] * 4
+
+            ctr = pe.shmalloc(1, np.int64)
+            pe.local(ctr)[...] = 0
+            pe.barrier_all()
+            for _ in range(300):
+                pe.atomic_add(ctr, 1, 0)
+            pe.barrier_all()
+            if me == 0:
+                total = int(pe.local(ctr)[0])
+                assert total == n * 300, total
+                print("CROSS-PROC-OK")
+            pe._backend.close()
+            zmpi.host_finalize()
+        """)
+        rc, out, err = _launch(4, [prog])
+        assert rc == 0, err
+        assert "CROSS-PROC-OK" in out
+
+    def test_cross_process_wait_until(self, tmp_path):
+        # PE 1 blocks in wait_until on its own memory; PE 0's put from
+        # another PROCESS must wake it — store visibility across address
+        # spaces
+        prog = _script(tmp_path, """
+            import numpy as np
+            import zhpe_ompi_tpu as zmpi
+            from zhpe_ompi_tpu.shmem.api import shmem_mapped_pe
+
+            proc = zmpi.host_init()
+            pe = shmem_mapped_pe(proc, 1 << 16)
+            flag = pe.shmalloc(1, np.int64)
+            pe.local(flag)[...] = 0
+            pe.barrier_all()
+            if pe.my_pe() == 0:
+                pe.atomic_set(flag, 42, 1)
+            elif pe.my_pe() == 1:
+                pe.wait_until(flag, "eq", 42, timeout=30.0)
+                print("WOKE")
+            pe.barrier_all()
+            pe._backend.close()
+            zmpi.host_finalize()
+        """)
+        rc, out, err = _launch(2, [prog])
+        assert rc == 0, err
+        assert "WOKE" in out

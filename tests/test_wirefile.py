@@ -1,0 +1,148 @@
+"""MPI-IO over the wire plane (``io/wirefile.py``): per-rank views,
+lockedfile shared pointer, fcoll-aggregated collective IO — with thread
+ranks for speed and real launcher processes for the cross-process
+sharedfp/lockedfile property (reference: ``ompi/mca/sharedfp/lockedfile``).
+"""
+
+import io
+import os
+import textwrap
+
+import numpy as np
+
+from test_tcp import run_tcp
+from zhpe_ompi_tpu.datatype import (
+    FLOAT,
+    INT32_T,
+    create_contiguous,
+    create_resized,
+    create_vector,
+)
+from zhpe_ompi_tpu.io.file import (
+    MODE_CREATE,
+    MODE_RDONLY,
+    MODE_RDWR,
+    MODE_WRONLY,
+)
+from zhpe_ompi_tpu.io.wirefile import WireFile
+from zhpe_ompi_tpu.tools import mpirun
+
+N = 4
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class TestWireFileThreads:
+    def test_interleaved_views_write_all(self, tmp_path):
+        """Each rank's filetype tiles the file rank-interleaved; a
+        collective write composes the full array."""
+        path = str(tmp_path / "data.bin")
+
+        def prog(p):
+            with WireFile(p, path, MODE_RDWR | MODE_CREATE) as f:
+                # rank r owns int32 slot r of every n-slot tile
+                ft = create_resized(create_vector(1, 1, 1, INT32_T), 0, 4 * N)
+                f.set_view(4 * p.rank, INT32_T, ft)
+                data = np.arange(8, dtype=np.int32) + 100 * p.rank
+                f.write_all(data)
+            return True
+
+        run_tcp(N, prog)
+        got = np.fromfile(path, dtype=np.int32)
+        want = np.empty(8 * N, np.int32)
+        for r in range(N):
+            want[r::N] = np.arange(8, dtype=np.int32) + 100 * r
+        assert got.tolist() == want.tolist()
+
+    def test_read_all_scatters(self, tmp_path):
+        path = str(tmp_path / "data.bin")
+        full = np.arange(8 * N, dtype=np.int32)
+        full.tofile(path)
+
+        def prog(p):
+            with WireFile(p, path, MODE_RDONLY) as f:
+                ft = create_resized(create_vector(1, 1, 1, INT32_T), 0, 4 * N)
+                f.set_view(4 * p.rank, INT32_T, ft)
+                got = f.read_all(8)
+            return got.tolist()
+
+        res = run_tcp(N, prog)
+        for r in range(N):
+            assert res[r] == full[r::N].tolist()
+
+    def test_shared_pointer_disjoint(self, tmp_path):
+        """Concurrent write_shared from every rank: regions must be
+        disjoint and cover the file exactly."""
+        path = str(tmp_path / "log.bin")
+        PER = 16
+
+        def prog(p):
+            with WireFile(p, path, MODE_RDWR | MODE_CREATE) as f:
+                f.set_view(0, FLOAT, create_contiguous(1, FLOAT))
+                for _ in range(PER):
+                    f.write_shared(np.full(2, float(p.rank), np.float32))
+                f.sync()
+            return True
+
+        run_tcp(N, prog)
+        got = np.fromfile(path, dtype=np.float32)
+        assert got.size == 2 * PER * N
+        # every 2-float record is rank-constant and counts are exact
+        recs = got.reshape(-1, 2)
+        assert (recs[:, 0] == recs[:, 1]).all()
+        for r in range(N):
+            assert (recs[:, 0] == r).sum() == PER
+
+    def test_explicit_offsets_and_size(self, tmp_path):
+        path = str(tmp_path / "x.bin")
+
+        def prog(p):
+            with WireFile(p, path, MODE_RDWR | MODE_CREATE) as f:
+                f.set_view(0, INT32_T)
+                f.write_at(p.rank * 4, np.full(4, p.rank, np.int32))
+                f.sync()
+                back = f.read_at(p.rank * 4, 4)
+                sz = f.get_size()
+            return back.tolist(), sz
+
+        res = run_tcp(N, prog)
+        for r in range(N):
+            assert res[r][0] == [r] * 4
+            assert res[r][1] == 4 * N * 4
+
+
+class TestWireFileProcesses:
+    def test_cross_process_shared_pointer(self, tmp_path):
+        prog_path = tmp_path / "prog.py"
+        data_path = str(tmp_path / "shared.bin")
+        prog_path.write_text(
+            "import sys\n"
+            f"sys.path.insert(0, {_REPO!r})\n" + textwrap.dedent(f"""
+            import numpy as np
+            import zhpe_ompi_tpu as zmpi
+            from zhpe_ompi_tpu.io.file import MODE_CREATE, MODE_RDWR
+            from zhpe_ompi_tpu.io.wirefile import WireFile
+            from zhpe_ompi_tpu.datatype import INT32_T
+
+            proc = zmpi.host_init()
+            with WireFile(proc, {data_path!r},
+                          MODE_RDWR | MODE_CREATE) as f:
+                f.set_view(0, INT32_T)
+                for _ in range(25):
+                    f.write_shared(np.full(1, proc.rank, np.int32))
+                f.sync()
+                total = f.tell_shared()
+                if proc.rank == 0:
+                    assert total == 25 * proc.size, total
+                    print("SHFP-OK")
+            zmpi.host_finalize()
+            """)
+        )
+        out, err = io.StringIO(), io.StringIO()
+        rc = mpirun.launch(3, [str(prog_path)], stdout=out, stderr=err,
+                           timeout=120.0)
+        assert rc == 0, err.getvalue()
+        assert "SHFP-OK" in out.getvalue()
+        got = np.fromfile(data_path, dtype=np.int32)
+        assert got.size == 75
+        for r in range(3):
+            assert (got == r).sum() == 25

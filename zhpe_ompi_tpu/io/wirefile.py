@@ -1,0 +1,310 @@
+"""MPI-IO for multi-process jobs — ompio over the wire plane.
+
+``io/file.py`` is the single-controller OMPIO analog (one File object
+sees every rank).  This module is the same surface for launcher-started
+OS processes, where each rank holds only its own state and coordination
+must be explicit — the deployment the reference's ompio actually runs in:
+
+- **individual / explicit-offset IO**: each rank's view maps etype
+  offsets to file byte offsets (``_View.byte_offsets``) and pwrites
+  through fs/posix — no coordination needed.
+- **shared file pointer**: the ``sharedfp/lockedfile`` component
+  (``ompi/mca/sharedfp/lockedfile``): the pointer lives in a sidecar
+  file next to the data file, and fetch-and-add runs under ``flock`` —
+  correct across processes with no server rank.
+- **collective IO** (``write_all``/``read_all``): every rank ships its
+  (offsets, bytes) run list to an aggregator over the endpoint, which
+  drives the SAME fcoll component (two-phase coalescing) the
+  single-controller path uses — one aggregation strategy, two planes.
+
+Collective calls are collective over the endpoint's whole group; the
+sidecar is created at open and removed at close by rank 0.
+"""
+
+from __future__ import annotations
+
+import fcntl
+import os
+
+import numpy as np
+
+from ..core import errhandler, errors
+from ..core import info as info_mod
+from ..datatype import Datatype
+from .file import (
+    BYTE,
+    MODE_APPEND,
+    MODE_CREATE,
+    MODE_DELETE_ON_CLOSE,
+    MODE_RDONLY,
+    MODE_RDWR,
+    MODE_WRONLY,
+    _os_flags,
+    _View,
+)
+from . import fbtl as fbtl_mod
+from . import fcoll as fcoll_mod
+from . import fs as fs_mod
+
+_IO_TAG = 0x7FE0
+_IO_CID = 0x7FE0
+
+
+class SharedPointerFile:
+    """sharedfp/lockedfile: the shared pointer as ASCII in a sidecar
+    file, updated under an exclusive flock."""
+
+    def __init__(self, path: str, create: bool, initial: int = 0):
+        self.path = path
+        if create:
+            fd = os.open(path, os.O_RDWR | os.O_CREAT, 0o600)
+            try:
+                fcntl.flock(fd, fcntl.LOCK_EX)
+                if os.fstat(fd).st_size == 0:
+                    os.write(fd, f"{initial:020d}".encode())
+            finally:
+                fcntl.flock(fd, fcntl.LOCK_UN)
+                os.close(fd)
+
+    def fetch_add(self, n: int) -> int:
+        fd = os.open(self.path, os.O_RDWR)
+        try:
+            fcntl.flock(fd, fcntl.LOCK_EX)
+            cur = int(os.pread(fd, 20, 0) or b"0")
+            os.pwrite(fd, f"{cur + n:020d}".encode(), 0)
+            return cur
+        finally:
+            fcntl.flock(fd, fcntl.LOCK_UN)
+            os.close(fd)
+
+    def set(self, value: int) -> None:
+        fd = os.open(self.path, os.O_RDWR)
+        try:
+            fcntl.flock(fd, fcntl.LOCK_EX)
+            os.pwrite(fd, f"{value:020d}".encode(), 0)
+        finally:
+            fcntl.flock(fd, fcntl.LOCK_UN)
+            os.close(fd)
+
+    def get(self) -> int:
+        fd = os.open(self.path, os.O_RDONLY)
+        try:
+            fcntl.flock(fd, fcntl.LOCK_EX)
+            return int(os.pread(fd, 20, 0) or b"0")
+        finally:
+            fcntl.flock(fd, fcntl.LOCK_UN)
+            os.close(fd)
+
+    def unlink(self) -> None:
+        try:
+            os.unlink(self.path)
+        except OSError:
+            pass
+
+
+class WireFile(errhandler.HasErrhandler):
+    """One rank's handle on a collectively-opened file (MPI_File for
+    launcher jobs).  `ep` is the rank's endpoint (TcpProc)."""
+
+    _default_errhandler = errhandler.ERRORS_RETURN
+
+    def __init__(self, ep, path: str, mode: int = MODE_RDONLY, info=None):
+        self.ep = ep
+        self.path = path
+        self.mode = mode
+        self.info = info_mod.coerce(info)
+        self.name = f"wirefile:{path}"
+        self._fs = fs_mod.select_fs()
+        self._fbtl = fbtl_mod.select_fbtl()
+        self._fcoll = fcoll_mod.select_fcoll()
+        # rank 0 creates; the others open the existing file (CREATE/EXCL
+        # are collective-open semantics, not per-rank O_CREAT races)
+        from .file import MODE_EXCL
+
+        if ep.rank == 0:
+            self._fd = self._fs.open(path, _os_flags(mode))
+            ep.barrier()
+        else:
+            ep.barrier()  # file exists (if CREATE) before others open
+            self._fd = self._fs.open(
+                path, _os_flags(mode & ~(MODE_CREATE | MODE_EXCL)))
+        start = self._fs.size(self._fd) if mode & MODE_APPEND else 0
+        self._view = _View(0, BYTE, BYTE)
+        self._pointer = start
+        self._shfp = SharedPointerFile(
+            path + ".zshfp", create=(ep.rank == 0), initial=start
+        )
+        ep.barrier()  # sidecar initialized before any shared-pointer op
+        self._closed = False
+
+    # -- lifecycle -------------------------------------------------------
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._fs.close(self._fd)
+        self._closed = True
+        self.ep.barrier()  # all IO complete before any teardown
+        if self.ep.rank == 0:
+            self._shfp.unlink()
+            if self.mode & MODE_DELETE_ON_CLOSE:
+                self._fs.delete(self.path)
+        self.ep.barrier()
+
+    def __enter__(self) -> "WireFile":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def _check_open(self) -> None:
+        if self._closed:
+            raise errors.ArgError("file is closed")
+
+    # -- view ------------------------------------------------------------
+
+    def set_view(self, disp: int, etype: Datatype,
+                 filetype: Datatype | None = None) -> None:
+        """This rank's (disp, etype, filetype); collective by MPI contract
+        (every rank calls, each with its own triple)."""
+        self._check_open()
+        self._view = _View(disp, etype, filetype or etype)
+        self._pointer = 0
+        self.ep.barrier()
+        if self.ep.rank == 0:
+            self._shfp.set(0)
+        self.ep.barrier()
+
+    def get_view(self) -> tuple[int, Datatype, Datatype]:
+        v = self._view
+        return v.disp, v.etype, v.filetype
+
+    # -- byte helpers ----------------------------------------------------
+
+    def _as_bytes(self, buf, count: int) -> np.ndarray:
+        arr = np.ascontiguousarray(buf)
+        data = arr.reshape(-1).view(np.uint8)
+        need = count * self._view.etype.size
+        if data.size < need:
+            raise errors.TruncateError(
+                f"buffer {data.size}B < {need}B ({count} etypes)"
+            )
+        return data[:need]
+
+    def _full_count(self, buf) -> int:
+        nbytes = np.ascontiguousarray(buf).nbytes
+        esz = self._view.etype.size
+        if esz and nbytes % esz:
+            raise errors.TypeError_(
+                f"buffer ({nbytes}B) is not a whole number of etypes"
+            )
+        return nbytes // esz if esz else 0
+
+    # -- explicit offset / individual pointer ----------------------------
+
+    def read_at(self, offset: int, count: int) -> np.ndarray:
+        self._check_open()
+        offs = self._view.byte_offsets(offset, count)
+        raw = self._fcoll.read(self._fbtl, self._fd, [offs])[0]
+        dt = getattr(self._view.etype, "np_dtype", None)
+        return raw.view(dt) if dt is not None else raw
+
+    def write_at(self, offset: int, buf, count: int | None = None) -> int:
+        self._check_open()
+        if count is None:
+            count = self._full_count(buf)
+        data = self._as_bytes(buf, count)
+        offs = self._view.byte_offsets(offset, count)
+        self._fcoll.write(self._fbtl, self._fd, [(offs, data)])
+        return count
+
+    def read(self, count: int) -> np.ndarray:
+        off, self._pointer = self._pointer, self._pointer + count
+        return self.read_at(off, count)
+
+    def write(self, buf, count: int | None = None) -> int:
+        if count is None:
+            count = self._full_count(buf)
+        off, self._pointer = self._pointer, self._pointer + count
+        return self.write_at(off, buf, count)
+
+    def seek(self, offset: int) -> None:
+        self._pointer = offset
+
+    def tell(self) -> int:
+        return self._pointer
+
+    # -- shared pointer (sharedfp/lockedfile) ----------------------------
+
+    def write_shared(self, buf, count: int | None = None) -> int:
+        if count is None:
+            count = self._full_count(buf)
+        off = self._shfp.fetch_add(count)
+        return self.write_at(off, buf, count)
+
+    def read_shared(self, count: int) -> np.ndarray:
+        off = self._shfp.fetch_add(count)
+        return self.read_at(off, count)
+
+    def seek_shared(self, offset: int) -> None:
+        """Collective: every rank calls with the same offset."""
+        self.ep.barrier()
+        if self.ep.rank == 0:
+            self._shfp.set(offset)
+        self.ep.barrier()
+
+    def tell_shared(self) -> int:
+        return self._shfp.get()
+
+    # -- collective IO: fcoll over the endpoint --------------------------
+
+    def write_all(self, buf, count: int | None = None) -> int:
+        """Collective write at each rank's individual pointer.  Runs are
+        shipped to rank 0, which drives the selected fcoll component's
+        aggregation (two-phase coalescing) in one pass."""
+        self._check_open()
+        if count is None:
+            count = self._full_count(buf)
+        data = self._as_bytes(buf, count).copy()
+        offs = self._view.byte_offsets(self._pointer, count)
+        self._pointer += count
+        gathered = self.ep.gather((offs, data), root=0)
+        if self.ep.rank == 0:
+            self._fcoll.write(self._fbtl, self._fd, gathered)
+        self.ep.barrier()  # data visible to every rank after the call
+        return count
+
+    def read_all(self, count: int) -> np.ndarray:
+        """Collective read at each rank's individual pointer: rank 0 runs
+        the aggregated fcoll pass and scatters per-rank bytes."""
+        self._check_open()
+        offs = self._view.byte_offsets(self._pointer, count)
+        self._pointer += count
+        all_offs = self.ep.gather(offs, root=0)
+        if self.ep.rank == 0:
+            raws = self._fcoll.read(self._fbtl, self._fd, all_offs)
+            raw = self.ep.scatter(raws, root=0)
+        else:
+            raw = self.ep.scatter(None, root=0)
+        dt = getattr(self._view.etype, "np_dtype", None)
+        return raw.view(dt) if dt is not None else raw
+
+    # -- size management -------------------------------------------------
+
+    def get_size(self) -> int:
+        self._check_open()
+        return self._fs.size(self._fd)
+
+    def set_size(self, size: int) -> None:
+        """Collective."""
+        self._check_open()
+        self.ep.barrier()
+        if self.ep.rank == 0:
+            self._fs.resize(self._fd, size)
+        self.ep.barrier()
+
+    def sync(self) -> None:
+        """MPI_File_sync: flush this rank then barrier (collective)."""
+        self._check_open()
+        self._fs.sync(self._fd)
+        self.ep.barrier()

@@ -88,6 +88,13 @@ def _declare(dll: ctypes.CDLL) -> None:
     dll.zompi_match_extract.restype = ctypes.c_int
     dll.zompi_match_stats.argtypes = [vp, i64p, i64p]
     dll.zompi_match_stats.restype = None
+    dll.zompi_shm_amo.argtypes = [
+        vp, ctypes.c_int, ctypes.c_int, i64, i64,
+        ctypes.c_double, ctypes.c_double, i64p, ctypes.POINTER(ctypes.c_double),
+    ]
+    dll.zompi_shm_amo.restype = ctypes.c_int
+    dll.zompi_shm_fence.argtypes = []
+    dll.zompi_shm_fence.restype = None
     dll.zompi_abi_version.argtypes = []
     dll.zompi_abi_version.restype = ctypes.c_int
 
@@ -128,7 +135,7 @@ def load() -> ctypes.CDLL | None:
                 os.replace(tmp, so)
             dll = ctypes.CDLL(so)
             _declare(dll)
-            if dll.zompi_abi_version() != 2:
+            if dll.zompi_abi_version() != 3:
                 raise RuntimeError("ABI version mismatch")
             lib = dll
         except Exception as exc:  # noqa: BLE001 - any failure → fallback

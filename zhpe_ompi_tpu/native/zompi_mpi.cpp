@@ -505,8 +505,12 @@ int MPI_Init(int *, char ***) {
   listen(g.listen_fd, g.size + 4);
   g.accept_thread = std::thread(accept_loop);
 
-  // modex (tcp.py _modex wire protocol)
-  if (g.rank == 0) {
+  // modex (tcp.py _modex wire protocol).  ZMPI_COORD_EXTERNAL=1 means a
+  // launcher (zmpirun) hosts the rendezvous and EVERY rank — including
+  // rank 0 — joins as a client.
+  const char *ext = getenv("ZMPI_COORD_EXTERNAL");
+  bool external_coord = ext && ext[0] == '1';
+  if (g.rank == 0 && !external_coord) {
     int srv = socket(AF_INET, SOCK_STREAM, 0);
     setsockopt(srv, SOL_SOCKET, SO_REUSEADDR, &one, sizeof one);
     sockaddr_in ca{};

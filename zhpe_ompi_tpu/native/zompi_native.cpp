@@ -394,6 +394,148 @@ void zompi_match_stats(void* h, int64_t* n_posted, int64_t* n_unexpected) {
   *n_unexpected = static_cast<int64_t>(m->unexpected.size());
 }
 
-int zompi_abi_version() { return 2; }
+// ---------------------------------------------------------------------------
+// Cross-process atomics on mapped symmetric segments.
+//
+// The oshmem atomic framework executes AMOs in native code against the
+// mapped segment (oshmem/mca/atomic/basic over sshmem/mmap); __atomic
+// builtins give lock-free 1/2/4/8-byte read-modify-write that is coherent
+// across OS processes sharing the mapping.  Floats go through bit-punned
+// compare-exchange loops (CAS compares BITS, so -0.0 vs 0.0 and NaN
+// payloads follow bit equality, not IEEE ==; the OpenSHMEM AMO set is
+// integer-centric and this matches practical usage).
+//
+// kind: 0=add 1=swap 2=cas 3=set 4=fetch.  The pre-op value is always
+// written to old_*.  Returns 0 ok, -1 unsupported type for native AMO.
+// ---------------------------------------------------------------------------
+
+}  // extern "C"  (templates below need C++ linkage)
+
+namespace {
+
+template <typename T>
+void amo_int(T* p, int kind, T val, T cmp, T* old) {
+  switch (kind) {
+    case 0: *old = __atomic_fetch_add(p, val, __ATOMIC_SEQ_CST); break;
+    case 1: *old = __atomic_exchange_n(p, val, __ATOMIC_SEQ_CST); break;
+    case 2: {
+      T expected = cmp;
+      __atomic_compare_exchange_n(p, &expected, val, false,
+                                  __ATOMIC_SEQ_CST, __ATOMIC_SEQ_CST);
+      *old = expected;  // on failure holds the current value = pre-op
+      break;
+    }
+    case 3: *old = __atomic_exchange_n(p, val, __ATOMIC_SEQ_CST); break;
+    case 4: *old = __atomic_load_n(p, __ATOMIC_SEQ_CST); break;
+  }
+}
+
+template <typename F, typename Bits>
+void amo_float(F* p, int kind, F val, F cmp, F* old) {
+  static_assert(sizeof(F) == sizeof(Bits), "pun width");
+  Bits* bp = reinterpret_cast<Bits*>(p);
+  auto pun = [](F f) { Bits b; std::memcpy(&b, &f, sizeof b); return b; };
+  auto unpun = [](Bits b) { F f; std::memcpy(&f, &b, sizeof f); return f; };
+  switch (kind) {
+    case 0: {  // add: CAS loop
+      Bits cur = __atomic_load_n(bp, __ATOMIC_SEQ_CST);
+      for (;;) {
+        F next = unpun(cur) + val;
+        Bits nb = pun(next);
+        if (__atomic_compare_exchange_n(bp, &cur, nb, false,
+                                        __ATOMIC_SEQ_CST, __ATOMIC_SEQ_CST))
+          break;
+      }
+      *old = unpun(cur);
+      break;
+    }
+    case 1:
+    case 3:
+      *old = unpun(__atomic_exchange_n(bp, pun(val), __ATOMIC_SEQ_CST));
+      break;
+    case 2: {
+      Bits expected = pun(cmp);
+      __atomic_compare_exchange_n(bp, &expected, pun(val), false,
+                                  __ATOMIC_SEQ_CST, __ATOMIC_SEQ_CST);
+      *old = unpun(expected);
+      break;
+    }
+    case 4: *old = unpun(__atomic_load_n(bp, __ATOMIC_SEQ_CST)); break;
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+int zompi_shm_amo(void* addr, int type_code, int kind, int64_t value_i,
+                  int64_t cmp_i, double value_f, double cmp_f,
+                  int64_t* old_i, double* old_f) {
+  switch (type_code) {
+    case 0: {  // int8
+      int8_t o;
+      amo_int<int8_t>((int8_t*)addr, kind, (int8_t)value_i, (int8_t)cmp_i, &o);
+      *old_i = o; return 0;
+    }
+    case 1: {  // uint8
+      uint8_t o;
+      amo_int<uint8_t>((uint8_t*)addr, kind, (uint8_t)value_i,
+                       (uint8_t)cmp_i, &o);
+      *old_i = (int64_t)o; return 0;
+    }
+    case 2: {  // int16
+      int16_t o;
+      amo_int<int16_t>((int16_t*)addr, kind, (int16_t)value_i,
+                       (int16_t)cmp_i, &o);
+      *old_i = o; return 0;
+    }
+    case 3: {  // uint16
+      uint16_t o;
+      amo_int<uint16_t>((uint16_t*)addr, kind, (uint16_t)value_i,
+                        (uint16_t)cmp_i, &o);
+      *old_i = (int64_t)o; return 0;
+    }
+    case 4: {  // int32
+      int32_t o;
+      amo_int<int32_t>((int32_t*)addr, kind, (int32_t)value_i,
+                       (int32_t)cmp_i, &o);
+      *old_i = o; return 0;
+    }
+    case 5: {  // uint32
+      uint32_t o;
+      amo_int<uint32_t>((uint32_t*)addr, kind, (uint32_t)value_i,
+                        (uint32_t)cmp_i, &o);
+      *old_i = (int64_t)o; return 0;
+    }
+    case 6: {  // int64
+      int64_t o;
+      amo_int<int64_t>((int64_t*)addr, kind, value_i, cmp_i, &o);
+      *old_i = o; return 0;
+    }
+    case 7: {  // uint64
+      uint64_t o;
+      amo_int<uint64_t>((uint64_t*)addr, kind, (uint64_t)value_i,
+                        (uint64_t)cmp_i, &o);
+      *old_i = (int64_t)o; return 0;
+    }
+    case 8: {  // float32
+      float o;
+      amo_float<float, uint32_t>((float*)addr, kind, (float)value_f,
+                                 (float)cmp_f, &o);
+      *old_f = o; return 0;
+    }
+    case 9: {  // float64
+      double o;
+      amo_float<double, uint64_t>((double*)addr, kind, value_f, cmp_f, &o);
+      *old_f = o; return 0;
+    }
+  }
+  return -1;
+}
+
+// Full memory fence: shmem_quiet/fence ordering point for mapped segments.
+void zompi_shm_fence() { __atomic_thread_fence(__ATOMIC_SEQ_CST); }
+
+int zompi_abi_version() { return 3; }
 
 }  // extern "C"

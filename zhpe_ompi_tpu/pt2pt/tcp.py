@@ -123,7 +123,8 @@ class TcpProc(HostCollectives, NonblockingCollectives):
     def __init__(self, rank: int, size: int,
                  coordinator: tuple[str, int] = ("127.0.0.1", 0),
                  host: str = "127.0.0.1", timeout: float = 30.0,
-                 on_coordinator_bound=None):
+                 on_coordinator_bound=None,
+                 external_coordinator: bool = False):
         if size < 1:
             raise errors.ArgError("size must be >= 1")
         self.rank = rank
@@ -162,6 +163,10 @@ class TcpProc(HostCollectives, NonblockingCollectives):
         # other ranks (prte forwarding the PMIx URI).  With a fixed,
         # pre-agreed port it is unnecessary.
         self._on_coordinator_bound = on_coordinator_bound
+        # external_coordinator: a launcher hosts the rendezvous (the
+        # PRRTE-hosts-the-PMIx-server shape) — rank 0 joins as a client
+        # instead of binding the coordinator address itself
+        self._external_coordinator = external_coordinator
         self.address_book = self._modex(coordinator, timeout)
         mca_output.verbose(
             5, _stream, "rank %d up at %s; book=%s", rank, self.address,
@@ -172,7 +177,7 @@ class TcpProc(HostCollectives, NonblockingCollectives):
 
     def _modex(self, coordinator: tuple[str, int], timeout: float
                ) -> list[tuple[str, int]]:
-        if self.rank == 0:
+        if self.rank == 0 and not self._external_coordinator:
             srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
             srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
             srv.bind(coordinator)

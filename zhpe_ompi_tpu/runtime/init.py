@@ -150,7 +150,11 @@ def host_init(timeout: float = 30.0):
         from ..pt2pt.tcp import TcpProc
 
         t0 = time.perf_counter()
-        proc = TcpProc(rank, size, coordinator=(chost, cport), timeout=timeout)
+        proc = TcpProc(
+            rank, size, coordinator=(chost, cport), timeout=timeout,
+            external_coordinator=os.environ.get(
+                "ZMPI_COORD_EXTERNAL") == "1",
+        )
         _host["proc"] = proc
         spc.record("init_count", 1)
         mca_output.verbose(

@@ -569,3 +569,16 @@ def shmem_wire_pe(ep, heap_bytes: int = _DEFAULT_HEAP) -> ShmemPE:
     of the endpoint's group must call it.  The symmetric heap lives in
     this process; remote PEs reach it through the AM window."""
     return ShmemPE(ep, _AmBackend(ep, heap_bytes))
+
+
+def shmem_mapped_pe(ep, heap_bytes: int = _DEFAULT_HEAP,
+                    seg_dir: str | None = None) -> ShmemPE:
+    """shmem_init over mapped segments (the sshmem/mmap component):
+    collective over a wire endpoint whose ranks are OS processes on ONE
+    host.  Every PE's heap is a tmpfs file all others mmap, so put/get
+    are direct loads/stores and AMOs are native lock-free atomics on the
+    mapping — no service loop in the data path.  Control (wire-up,
+    barriers) rides the endpoint."""
+    from .segment import MmapBackend
+
+    return ShmemPE(ep, MmapBackend(ep, heap_bytes, seg_dir))

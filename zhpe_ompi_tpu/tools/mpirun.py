@@ -41,13 +41,44 @@ import time
 _TERM_GRACE = 2.0  # seconds between SIGTERM and SIGKILL on abort
 
 
-def _free_port(host: str) -> int:
-    s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
-    s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-    s.bind((host, 0))
-    port = s.getsockname()[1]
-    s.close()
-    return port
+def _start_coordinator(host: str, size: int, timeout: float):
+    """Host the modex rendezvous in the LAUNCHER (PRRTE hosts the PMIx
+    server, ranks are all clients).  Binding port 0 here removes the
+    probe-then-rebind race a launcher-chosen fixed port would have: the
+    socket is listening before any rank spawns.  Every rank — including
+    rank 0, told by ZMPI_COORD_EXTERNAL=1 — connects, sends its
+    (rank, address) card, and receives the full address book."""
+    from ..pt2pt.tcp import _recv_frame, _send_frame
+    from ..utils import dss
+
+    srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    srv.bind((host, 0))
+    srv.listen(size + 4)
+    srv.settimeout(timeout)
+
+    def serve():
+        book = [None] * size
+        conns = []
+        try:
+            for _ in range(size):
+                conn, _ = srv.accept()
+                [rank, addr] = dss.unpack(_recv_frame(conn))
+                book[rank] = addr
+                conns.append(conn)
+            payload = dss.pack(book)
+            for c in conns:
+                _send_frame(c, payload)
+        except OSError:
+            pass  # job died / timed out; ranks see their own modex timeout
+        finally:
+            for c in conns:
+                c.close()
+            srv.close()
+
+    t = threading.Thread(target=serve, daemon=True)
+    t.start()
+    return srv.getsockname()[1]
 
 
 def _forward(stream, rank: int, label: str, out, lock: threading.Lock,
@@ -72,6 +103,9 @@ def build_env(rank: int, size: int, host: str, port: int,
         "ZMPI_SIZE": str(size),
         "ZMPI_COORD_HOST": host,
         "ZMPI_COORD_PORT": str(port),
+        # the launcher hosts the rendezvous: rank 0 joins as a client
+        # instead of binding the coordinator itself
+        "ZMPI_COORD_EXTERNAL": "1",
     })
     # make the framework importable in every rank regardless of cwd — the
     # mpirun-exports-its-library-paths behavior (OPAL_PREFIX/LD_LIBRARY_PATH)
@@ -99,7 +133,7 @@ def launch(n: int, argv: list[str], host: str = "127.0.0.1",
         raise ValueError("zmpirun: -n must be >= 1")
     stdout = stdout if stdout is not None else sys.stdout
     stderr = stderr if stderr is not None else sys.stderr
-    port = _free_port(host)
+    port = _start_coordinator(host, n, timeout or 120.0)
     cmd = list(argv)
     if cmd[0].endswith(".py"):
         cmd = [sys.executable] + cmd
