@@ -29,7 +29,7 @@ def run_mapped(n, fn, heap_bytes=1 << 16, timeout=60.0):
         try:
             return fn(pe)
         finally:
-            pe._backend.close()
+            pe.finalize()
 
     return run_tcp(n, main, timeout=timeout)
 
@@ -199,7 +199,7 @@ class TestMappedProcesses:
                 total = int(pe.local(ctr)[0])
                 assert total == n * 300, total
                 print("CROSS-PROC-OK")
-            pe._backend.close()
+            pe.finalize()
             zmpi.host_finalize()
         """)
         rc, out, err = _launch(4, [prog])
@@ -226,7 +226,7 @@ class TestMappedProcesses:
                 pe.wait_until(flag, "eq", 42, timeout=30.0)
                 print("WOKE")
             pe.barrier_all()
-            pe._backend.close()
+            pe.finalize()
             zmpi.host_finalize()
         """)
         rc, out, err = _launch(2, [prog])

@@ -1,0 +1,83 @@
+"""spml framework: MCA-selected SHMEM transport (oshmem/mca/spml analog).
+
+Selection is a priority decision over components whose preconditions the
+endpoint meets: direct (thread ranks) > mmap (same-host wire procs) >
+am (any wire).  ZMPI_MCA_spml include/exclude must steer it like every
+other framework.
+"""
+
+import numpy as np
+import pytest
+
+from test_tcp import run_tcp
+from zhpe_ompi_tpu.mca import var as mca_var
+from zhpe_ompi_tpu.pt2pt.universe import LocalUniverse
+from zhpe_ompi_tpu.shmem import spml
+from zhpe_ompi_tpu.shmem.api import _AmBackend, _DirectBackend
+from zhpe_ompi_tpu.shmem.segment import MmapBackend
+
+
+def test_selects_direct_for_thread_ranks():
+    uni = LocalUniverse(2)
+    comp = spml.select_spml(uni.contexts[0])
+    assert comp.name == "direct"
+
+
+def test_selects_mmap_for_samehost_wire():
+    def prog(p):
+        return spml.select_spml(p).name
+
+    assert run_tcp(2, prog) == ["mmap", "mmap"]
+
+
+def test_exclude_steers_to_am():
+    mca_var.set_var("spml", "^mmap")
+    try:
+        def prog(p):
+            return spml.select_spml(p).name
+
+        assert run_tcp(2, prog) == ["am", "am"]
+    finally:
+        mca_var.unset("spml")
+
+
+def test_pe_construction_roundtrip_each_component():
+    # direct
+    uni = LocalUniverse(2)
+
+    def direct_prog(ctx):
+        pe = spml.shmem_pe(ctx, 1 << 14)
+        assert isinstance(pe._backend, _DirectBackend)
+        sym = pe.shmalloc(2, np.int32)
+        pe.local(sym)[...] = ctx.rank
+        pe.barrier_all()
+        got = pe.get(sym, 1 - ctx.rank).tolist()
+        pe.barrier_all()
+        return got
+
+    res = uni.run(direct_prog)
+    assert res == [[1, 1], [0, 0]]
+
+    # mmap via auto-selection over wire ranks
+    def wire_prog(p):
+        pe = spml.shmem_pe(p, 1 << 14)
+        assert isinstance(pe._backend, MmapBackend)
+        sym = pe.shmalloc(1, np.int64)
+        pe.local(sym)[...] = 10 + p.rank
+        pe.barrier_all()
+        got = int(pe.g(sym, 1 - p.rank))
+        pe.barrier_all()
+        pe.finalize()
+        return got
+
+    assert run_tcp(2, wire_prog) == [11, 10]
+
+
+def test_no_candidate_raises():
+    from zhpe_ompi_tpu.core import errors
+
+    class FakeEp:
+        rank, size = 0, 1
+
+    with pytest.raises(errors.InternalError):
+        spml.select_spml(FakeEp())

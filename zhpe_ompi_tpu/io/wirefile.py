@@ -46,10 +46,6 @@ from . import fbtl as fbtl_mod
 from . import fcoll as fcoll_mod
 from . import fs as fs_mod
 
-_IO_TAG = 0x7FE0
-_IO_CID = 0x7FE0
-
-
 class SharedPointerFile:
     """sharedfp/lockedfile: the shared pointer as ASCII in a sidecar
     file, updated under an exclusive flock."""

@@ -397,7 +397,16 @@ def flash_attention(q, k, v, causal: bool = True, block_q: int = 512,
     block_k = min(block_k, S)
     if S % block_q or S % block_k:
         return attn_reference(q, k, v, causal)
-    on_tpu = jax.devices()[0].platform == "tpu"
+    # TPU-like = any device that runs Mosaic/Pallas-TPU kernels: platform
+    # "tpu" proper, or a tunneled backend whose platform string differs
+    # but whose device_kind names a TPU generation.  Round-3 regression
+    # fix: the == "tpu" form silently disabled the kernels on the
+    # tunneled bench chip (platform "axon"), reverting attention to naive.
+    dev0 = jax.devices()[0]
+    kind = getattr(dev0, "device_kind", "").lower()
+    on_tpu = dev0.platform == "tpu" or any(
+        t in kind for t in ("tpu", "v4", "v5", "v6", "trillium")
+    )
     if force:
         return _flash(q, k, v, causal, block_q, block_k,
                       interpret or not on_tpu)

@@ -160,6 +160,9 @@ class _DirectBackend:
     def quiet(self) -> None:
         """In-process writes complete immediately."""
 
+    def close(self) -> None:
+        """Nothing to tear down: arenas die with the universe."""
+
 
 class _AmBackend:
     """Wire substrate: the symmetric heap is a local arena attached to a
@@ -255,6 +258,10 @@ class _AmBackend:
         """shmem_quiet: flush outstanding AM puts (ack round-trip)."""
         self._win.flush_all()
 
+    def close(self) -> None:
+        """Collective teardown: free the dynamic window."""
+        self._win.free()
+
 
 class ShmemPE:
     """One PE's API handle — the surface of ``shmem.h``."""
@@ -270,6 +277,11 @@ class ShmemPE:
 
     def n_pes(self) -> int:
         return self._ctx.size
+
+    def finalize(self) -> None:
+        """shmem_finalize: collective backend teardown (uniform across
+        direct/mmap/am substrates)."""
+        self._backend.close()
 
     # -- symmetric memory ------------------------------------------------
 

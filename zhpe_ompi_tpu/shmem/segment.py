@@ -155,6 +155,8 @@ class MmapBackend:
 
     def amo(self, sym, kind: str, pe: int, index: int, value=None,
             compare=None):
+        if not 0 <= pe < self._ep.size:
+            raise errors.RankError(f"PE {pe} out of range")
         dt = sym.dtype
         code = _TYPE_CODES.get(dt)
         if self._native is not None and code is not None:

@@ -1,0 +1,169 @@
+"""spml — the SHMEM put/get transport framework, as a real MCA framework.
+
+The reference layers OSHMEM over selectable frameworks
+(``oshmem/mca/spml`` for the transport, ``sshmem`` for the segment
+deployment); which component wins is a priority decision at
+``shmem_init``.  This module expresses this framework's three transports
+through the same MCA machinery every other framework here uses
+(``mca/component.py``): components register, admission respects
+``ZMPI_MCA_spml`` include/exclude lists, and selection is
+highest-priority-that-supports-the-endpoint:
+
+- **direct** (prio 80): thread-universe ranks share an address space —
+  numpy-view put/get (sshmem equivalent: the segment IS the process
+  heap).
+- **mmap** (prio 60): socket ranks that are all processes on ONE host —
+  mapped tmpfs segments, native atomics (``shmem/segment.py``).
+- **am** (prio 40): any wire endpoint — active-message RMA over the osc
+  plane (``shmem/api.py::_AmBackend``); the only transport that works
+  cross-host, and the fallback whenever mmap's same-host precondition
+  fails.
+
+``shmem_pe(ep)`` is the shmem_init analog: select, build the backend,
+wrap in a :class:`~zhpe_ompi_tpu.shmem.api.ShmemPE`.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from ..core import errors
+from ..mca import component as mca_component
+
+_DEFAULT_HEAP = 1 << 20
+
+
+def _is_thread_ctx(ep) -> bool:
+    return hasattr(ep, "universe")
+
+
+def _is_wire_ep(ep) -> bool:
+    return hasattr(ep, "address_book")
+
+
+def _all_same_host(ep) -> bool:
+    """True when every rank's endpoint address is one loopback/local
+    host — the mmap component's precondition."""
+    hosts = {h for h, _ in ep.address_book}
+    return len(hosts) == 1
+
+
+class SpmlComponent(mca_component.Component):
+    framework_name = "spml"
+
+    def supports(self, ep) -> bool:
+        raise NotImplementedError
+
+    def make(self, ep, heap_bytes: int):
+        raise NotImplementedError
+
+
+class DirectSpml(SpmlComponent):
+    name = "direct"
+    default_priority = 80
+
+    def supports(self, ep) -> bool:
+        return _is_thread_ctx(ep)
+
+    def make(self, ep, heap_bytes: int):
+        from .api import _DirectBackend, _ShmemUniverseState
+
+        uni = ep.universe
+        # universe-shared state, created once by whichever PE gets here
+        # first (construction is collective; the lock makes it exactly
+        # one).  The heap size is fixed per universe, like the
+        # reference's SHMEM_SYMMETRIC_SIZE: replacing the state would
+        # orphan every live PE's symmetric addresses.
+        with _universe_lock(uni):
+            state = getattr(uni, "_shmem_state", None)
+            if state is None:
+                state = _ShmemUniverseState(ep.size, heap_bytes)
+                uni._shmem_state = state
+            elif state.arenas[0].nbytes < heap_bytes:
+                raise errors.ArgError(
+                    f"symmetric heap is fixed per universe "
+                    f"({state.arenas[0].nbytes}B); cannot grow to "
+                    f"{heap_bytes}B after first shmem_init"
+                )
+        return _DirectBackend(ep, state)
+
+
+_universe_locks: dict[int, threading.Lock] = {}
+_universe_guard = threading.Lock()
+
+
+def _universe_lock(uni) -> threading.Lock:
+    with _universe_guard:
+        return _universe_locks.setdefault(id(uni), threading.Lock())
+
+
+class MmapSpml(SpmlComponent):
+    name = "mmap"
+    default_priority = 60
+
+    def supports(self, ep) -> bool:
+        return _is_wire_ep(ep) and _all_same_host(ep)
+
+    def make(self, ep, heap_bytes: int):
+        from .segment import MmapBackend
+
+        return MmapBackend(ep, heap_bytes)
+
+
+class AmSpml(SpmlComponent):
+    name = "am"
+    default_priority = 40
+
+    def supports(self, ep) -> bool:
+        return _is_wire_ep(ep)
+
+    def make(self, ep, heap_bytes: int):
+        from .api import _AmBackend
+
+        return _AmBackend(ep, heap_bytes)
+
+
+_framework: mca_component.Framework | None = None
+_framework_guard = threading.Lock()
+
+
+def spml_framework() -> mca_component.Framework:
+    global _framework
+    with _framework_guard:
+        if _framework is None:
+            fw = mca_component.framework("spml", "SHMEM put/get transports")
+            fw.register(DirectSpml())
+            fw.register(MmapSpml())
+            fw.register(AmSpml())
+            fw.open()
+            _framework = fw
+        return _framework
+
+
+def select_spml(ep) -> SpmlComponent:
+    """Highest-priority admitted component that supports this endpoint.
+
+    CAUTION: selection must be deterministic across the group — it
+    depends only on collective facts (endpoint type, address book), so
+    every rank picks the same component without negotiation, the same
+    property the reference's modex-free spml selection relies on."""
+    fw = spml_framework()
+    candidates = [
+        c for c in fw.admitted() if isinstance(c, SpmlComponent)
+        and c.supports(ep)
+    ]
+    if not candidates:
+        raise errors.InternalError(
+            f"no spml component supports endpoint {type(ep).__name__} "
+            f"(admitted: {[c.name for c in fw.admitted()]})"
+        )
+    return max(candidates, key=lambda c: c.priority)
+
+
+def shmem_pe(ep, heap_bytes: int = _DEFAULT_HEAP):
+    """shmem_init: spml-selected PE construction (collective over the
+    endpoint's group)."""
+    from .api import ShmemPE
+
+    comp = select_spml(ep)
+    return ShmemPE(ep, comp.make(ep, heap_bytes))
