@@ -1,0 +1,91 @@
+"""Keyval attribute caching (ompi/attribute analog): copy callbacks at
+dup, delete callbacks at free/replace, predefined NULL_COPY/DUP
+policies."""
+
+import numpy as np
+import pytest
+
+import zhpe_ompi_tpu as zmpi
+from zhpe_ompi_tpu.core import attributes as attrs
+from zhpe_ompi_tpu.core import errors
+
+
+@pytest.fixture
+def world():
+    return zmpi.init()
+
+
+def test_set_get_delete(world):
+    kv = attrs.create_keyval()
+    world.set_attr(kv, {"x": 1})
+    found, val = world.get_attr(kv)
+    assert found and val == {"x": 1}
+    world.delete_attr(kv)
+    found, _ = world.get_attr(kv)
+    assert not found
+    with pytest.raises(errors.ArgError):
+        world.delete_attr(kv)
+
+
+def test_null_copy_does_not_propagate(world):
+    kv = attrs.create_keyval(copy_fn=attrs.NULL_COPY_FN)
+    world.set_attr(kv, "secret")
+    dup = world.dup()
+    assert dup.get_attr(kv) == (False, None)
+    assert world.get_attr(kv) == (True, "secret")
+
+
+def test_dup_fn_propagates_by_reference(world):
+    kv = attrs.create_keyval(copy_fn=attrs.DUP_FN)
+    payload = [1, 2]
+    world.set_attr(kv, payload)
+    dup = world.dup()
+    assert dup.get_attr(kv) == (True, payload)
+    assert dup.get_attr(kv)[1] is payload
+
+
+def test_custom_copy_and_delete_callbacks(world):
+    log = []
+
+    def copy_fn(old, keyval, extra, value):
+        log.append(("copy", value, extra))
+        return True, value * 2
+
+    def delete_fn(obj, keyval, value, extra):
+        log.append(("delete", value))
+
+    kv = attrs.create_keyval(copy_fn, delete_fn, extra_state="E")
+    comm = world.dup()
+    comm.set_attr(kv, 21)
+    dup = comm.dup()
+    assert dup.get_attr(kv) == (True, 42)
+    assert ("copy", 21, "E") in log
+    # replacing runs delete on the old value
+    comm.set_attr(kv, 5)
+    assert ("delete", 21) in log
+    # free runs delete for everything cached
+    dup.free()
+    assert ("delete", 42) in log
+
+
+def test_freed_keyval_still_deletes_at_free(world):
+    deleted = []
+    kv = attrs.create_keyval(delete_fn=lambda o, k, v, e: deleted.append(v))
+    comm = world.dup()
+    comm.set_attr(kv, "v")
+    assert attrs.free_keyval(kv) == attrs.KEYVAL_INVALID
+    comm.free()
+    assert deleted == ["v"]
+
+
+def test_unknown_keyval_raises(world):
+    with pytest.raises(errors.ArgError):
+        world.set_attr(999999, 1)
+
+
+def test_split_type_shared(world):
+    # all virtual CPU devices share one process -> one group == dup shape
+    sub = world.split_type("shared")
+    assert sub.uniform_size == world.size
+    with pytest.raises(errors.ArgError):
+        world.split_type("numa")

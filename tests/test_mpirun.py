@@ -143,3 +143,37 @@ def test_c_program_under_launcher(tmp_path):
     rc, out, err = _launch(3, [str(binary)])
     assert rc == 0, err
     assert "PASSED" in out or "ring" in out.lower(), out
+
+
+def test_name_publishing_across_ranks(tmp_path):
+    """MPI_Publish_name/Lookup_name through the launcher-hosted name
+    server (the ompi-server analog): one rank publishes, another looks
+    the service up — discovery with no out-of-band exchange."""
+    prog = _script(tmp_path, """
+        import zhpe_ompi_tpu as zmpi
+        from zhpe_ompi_tpu.comm import dpm_wire
+        from zhpe_ompi_tpu.core import errors
+
+        proc = zmpi.host_init()
+        if proc.rank == 0:
+            dpm_wire.publish_name("svc", "10.0.0.1:4242")
+            proc.barrier()
+            proc.barrier()  # rank 1 looked it up
+            dpm_wire.unpublish_name("svc")
+            proc.barrier()
+        else:
+            proc.barrier()
+            assert dpm_wire.lookup_name("svc") == "10.0.0.1:4242"
+            proc.barrier()
+            proc.barrier()  # rank 0 unpublished
+            try:
+                dpm_wire.lookup_name("svc")
+            except errors.ArgError:
+                print("NS-OK")
+            else:
+                raise SystemExit("lookup after unpublish succeeded")
+        zmpi.host_finalize()
+    """)
+    rc, out, err = _launch(2, [prog])
+    assert rc == 0, err
+    assert "NS-OK" in out

@@ -146,3 +146,48 @@ class TestWireFileProcesses:
         assert got.size == 75
         for r in range(3):
             assert (got == r).sum() == 25
+
+
+class TestVulcanAggregation:
+    """fcoll_wire_aggregators > 1: the vulcan shape — stripe sets owned
+    round-robin by several aggregator ranks (ompi/mca/fcoll/vulcan)."""
+
+    def _with_vulcan(self, fn):
+        from zhpe_ompi_tpu.mca import var as mca_var
+
+        mca_var.register("fcoll_wire_aggregators", 1, "test", type=int)
+        mca_var.register("fcoll_dynamic_stripe", 4 << 20, "test", type=int)
+        mca_var.set_var("fcoll_wire_aggregators", 2)
+        mca_var.set_var("fcoll_dynamic_stripe", 64)
+        try:
+            return fn()
+        finally:
+            mca_var.unset("fcoll_wire_aggregators")
+            mca_var.unset("fcoll_dynamic_stripe")
+
+    def test_multi_aggregator_roundtrip(self, tmp_path):
+        path = str(tmp_path / "vulcan.bin")
+
+        def run():
+            def prog(p):
+                with WireFile(p, path, MODE_RDWR | MODE_CREATE) as f:
+                    ft = create_resized(
+                        create_vector(1, 1, 1, INT32_T), 0, 4 * N)
+                    f.set_view(4 * p.rank, INT32_T, ft)
+                    data = np.arange(64, dtype=np.int32) + 1000 * p.rank
+                    f.write_all(data)
+                    f.seek(0)
+                    back = f.read_all(64)
+                return back.tolist()
+
+            return run_tcp(N, prog)
+
+        res = self._with_vulcan(run)
+        for r in range(N):
+            assert res[r] == (np.arange(64, dtype=np.int32)
+                              + 1000 * r).tolist()
+        got = np.fromfile(path, dtype=np.int32)
+        want = np.empty(64 * N, np.int32)
+        for r in range(N):
+            want[r::N] = np.arange(64, dtype=np.int32) + 1000 * r
+        assert got.tolist() == want.tolist()

@@ -32,11 +32,20 @@ import numpy as np
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..core import attributes
 from ..core import errhandler as errh
 from ..core import errors
 from ..core import info as info_mod
 from ..mca import output as mca_output
 from .group import Group
+
+
+def _axis_devices(mesh: Mesh, axis: str) -> list:
+    """One representative device per index of `axis` (index 0 of every
+    other axis)."""
+    k = mesh.axis_names.index(axis)
+    arr = np.moveaxis(mesh.devices, k, 0)
+    return [np.asarray(arr[i]).flat[0] for i in range(arr.shape[0])]
 
 _stream = mca_output.open_stream("comm")
 
@@ -52,14 +61,15 @@ def _alloc_cid() -> int:
         return cid
 
 
-class Communicator(errh.HasErrhandler):
+class Communicator(errh.HasErrhandler, attributes.AttrHost):
     """A communicator over one mesh axis, optionally partitioned into
     same-axis sub-groups (the result of ``split``).
 
-    Carries an :class:`~zhpe_ompi_tpu.core.info.Info` of hints and an
+    Carries an :class:`~zhpe_ompi_tpu.core.info.Info` of hints, an
     attachable :class:`~zhpe_ompi_tpu.core.errhandler.Errhandler`
-    (default MPI_ERRORS_ARE_FATAL, the reference's communicator default);
-    collective dispatch failures route through it."""
+    (default MPI_ERRORS_ARE_FATAL, the reference's communicator default),
+    and keyval attribute caching (``core/attributes.py`` — copy callbacks
+    run at dup, delete callbacks at free, per ompi/attribute)."""
 
     _default_errhandler = errh.ERRORS_ARE_FATAL
 
@@ -163,8 +173,30 @@ class Communicator(errh.HasErrhandler):
     # -- construction of new communicators ------------------------------
 
     def dup(self, name: str | None = None) -> "Communicator":
-        """MPI_Comm_dup: same partition, fresh CID and attribute space."""
-        return Communicator(self.mesh, self.axis, list(self.partition), name)
+        """MPI_Comm_dup: same partition, fresh CID; attributes propagate
+        through their keyvals' copy callbacks (MPI dup semantics)."""
+        new = Communicator(self.mesh, self.axis, list(self.partition), name)
+        self._copy_attrs_to(new)
+        return new
+
+    def free(self) -> None:
+        """MPI_Comm_free: runs attribute delete callbacks.  The object
+        itself is garbage-collected; collectives after free are a user
+        error the dispatch layer surfaces naturally."""
+        self._delete_all_attrs()
+
+    def split_type(self, split_type: str = "shared",
+                   keys: Sequence[int] | None = None,
+                   name: str | None = None) -> "Communicator":
+        """MPI_Comm_split_type: "shared" groups axis indices whose
+        devices share a host (process_index) — the
+        MPI_COMM_TYPE_SHARED/OMPI_COMM_TYPE_NODE semantics on a device
+        mesh.  On a single-host mesh this is one group (== dup)."""
+        if split_type != "shared":
+            raise errors.ArgError(f"unknown split_type {split_type!r}")
+        devs = _axis_devices(self.mesh, self.axis)
+        colors = [int(getattr(d, "process_index", 0)) for d in devs]
+        return self.split(colors, keys, name)
 
     def split(self, colors: Sequence[int], keys: Sequence[int] | None = None,
               name: str | None = None) -> "Communicator":

@@ -71,6 +71,54 @@ def open_port(host: str = "127.0.0.1") -> Port:
     return Port(host)
 
 
+# -- name publishing (MPI_Publish_name / Lookup_name / Unpublish_name) ----
+#
+# The reference routes these through a PMIx server that outlasts any one
+# rank (the separate ``ompi-server`` daemon).  Under zmpirun the launcher
+# hosts that registry (ZMPI_NAMESERVER env, tools/mpirun.py); outside a
+# launcher job there is no server and these raise.
+
+def _name_server_request(req: list) -> Any:
+    import os
+
+    addr = os.environ.get("ZMPI_NAMESERVER")
+    if not addr:
+        raise errors.InternalError(
+            "MPI name publishing needs a name server: run under zmpirun "
+            "(which hosts one) or unset service names and exchange port "
+            "names out of band"
+        )
+    host, port = addr.rsplit(":", 1)
+    cli = socket.create_connection((host, int(port)), timeout=10.0)
+    try:
+        _send_frame(cli, dss.pack(req))
+        [out] = dss.unpack(_recv_frame(cli))
+        return out
+    finally:
+        cli.close()
+
+
+def publish_name(service: str, port_name: str) -> None:
+    """MPI_Publish_name: service -> port name, visible to every rank of
+    the job (and to other jobs launched with the same name server)."""
+    _name_server_request(["pub", service, port_name])
+
+
+def lookup_name(service: str) -> str:
+    """MPI_Lookup_name; raises if the service is not published."""
+    out = _name_server_request(["look", service])
+    if out is None:
+        raise errors.ArgError(f"service {service!r} is not published")
+    return out
+
+
+def unpublish_name(service: str) -> None:
+    """MPI_Unpublish_name; raises (MPI_ERR_SERVICE shape) when the
+    service was never published — matching lookup_name."""
+    if not _name_server_request(["unpub", service]):
+        raise errors.ArgError(f"service {service!r} is not published")
+
+
 class TcpIntercomm(InterCollectives):
     """Intercommunicator between two TcpProc groups (possibly in
     different OS processes).  MPI addressing: send/recv name ranks of the

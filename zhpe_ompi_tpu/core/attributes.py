@@ -1,0 +1,156 @@
+"""MPI attribute caching — keyvals with copy/delete callbacks.
+
+The reference's ``ompi/attribute/attribute.c`` implements one keyval
+system shared by communicators, windows, and datatypes: a keyval is
+created with a copy callback (invoked at MPI_Comm_dup to decide whether
+and what to propagate) and a delete callback (invoked at attribute
+deletion/object free).  This is that system, re-derived:
+
+- :func:`create_keyval` → integer keyval + callbacks.  The MPI
+  predefined policies are module constants: :data:`NULL_COPY_FN`
+  (never propagate on dup) and :data:`DUP_FN` (propagate by reference).
+- :class:`AttrHost` — mixin for attribute-bearing objects (communicator
+  / window / file here).  ``set_attr/get_attr/delete_attr`` plus the
+  dup-time (:meth:`_copy_attrs_to`) and free-time
+  (:meth:`_delete_all_attrs`) hooks.
+
+Copy callbacks return ``(flag, value)``: flag False drops the attribute
+on the new object (MPI's copy_fn contract).  Delete callbacks may raise;
+the error propagates to the caller of delete/free exactly as
+MPI_ERR_OTHER would.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+from typing import Any, Callable
+
+from . import errors
+
+# copy_fn(oldobj, keyval, extra_state, value) -> (keep: bool, newvalue)
+CopyFn = Callable[[Any, int, Any, Any], tuple[bool, Any]]
+# delete_fn(obj, keyval, value, extra_state) -> None
+DeleteFn = Callable[[Any, int, Any, Any], None]
+
+
+def NULL_COPY_FN(oldobj, keyval, extra, value):
+    """MPI_NULL_COPY_FN: attribute does not propagate on dup."""
+    return False, None
+
+
+def DUP_FN(oldobj, keyval, extra, value):
+    """MPI_DUP_FN: attribute propagates by reference on dup."""
+    return True, value
+
+
+def NULL_DELETE_FN(obj, keyval, value, extra):
+    """MPI_NULL_DELETE_FN."""
+
+
+class _Keyval:
+    __slots__ = ("id", "copy_fn", "delete_fn", "extra_state", "freed")
+
+    def __init__(self, kid: int, copy_fn: CopyFn, delete_fn: DeleteFn,
+                 extra_state: Any):
+        self.id = kid
+        self.copy_fn = copy_fn
+        self.delete_fn = delete_fn
+        self.extra_state = extra_state
+        self.freed = False
+
+
+_keyvals: dict[int, _Keyval] = {}
+_next_id = itertools.count(1000)  # distinct from any predefined space
+_lock = threading.Lock()
+
+KEYVAL_INVALID = -1
+
+
+def create_keyval(copy_fn: CopyFn = NULL_COPY_FN,
+                  delete_fn: DeleteFn = NULL_DELETE_FN,
+                  extra_state: Any = None) -> int:
+    """MPI_Comm_create_keyval (also serves win/type keyvals, as the
+    reference's unified attribute machinery does)."""
+    with _lock:
+        kid = next(_next_id)
+        _keyvals[kid] = _Keyval(kid, copy_fn or NULL_COPY_FN,
+                                delete_fn or NULL_DELETE_FN, extra_state)
+        return kid
+
+
+def free_keyval(keyval: int) -> int:
+    """MPI_Comm_free_keyval: marks the keyval dead; objects still
+    holding attributes under it keep their values (MPI semantics — the
+    keyval is reference-counted in the reference; here deletion
+    callbacks still run at object free).  Returns KEYVAL_INVALID."""
+    with _lock:
+        kv = _keyvals.get(keyval)
+        if kv is None:
+            raise errors.ArgError(f"unknown keyval {keyval}")
+        kv.freed = True
+        return KEYVAL_INVALID
+
+
+def _get_keyval(keyval: int) -> _Keyval:
+    with _lock:
+        kv = _keyvals.get(keyval)
+    if kv is None:
+        raise errors.ArgError(f"unknown keyval {keyval}")
+    return kv
+
+
+class AttrHost:
+    """Mixin for attribute-bearing objects.  Storage lives in
+    ``self.attributes`` (keyval -> value)."""
+
+    attributes: dict[int, Any]
+
+    def set_attr(self, keyval: int, value: Any) -> None:
+        """MPI_Comm_set_attr: replacing an existing value runs the old
+        value's delete callback first (MPI semantics)."""
+        kv = _get_keyval(keyval)
+        if keyval in self.attributes:
+            kv.delete_fn(self, keyval, self.attributes[keyval],
+                         kv.extra_state)
+        self.attributes[keyval] = value
+
+    def get_attr(self, keyval: int) -> tuple[bool, Any]:
+        """MPI_Comm_get_attr: (found, value)."""
+        _get_keyval(keyval)
+        if keyval in self.attributes:
+            return True, self.attributes[keyval]
+        return False, None
+
+    def delete_attr(self, keyval: int) -> None:
+        """MPI_Comm_delete_attr: runs the delete callback."""
+        kv = _get_keyval(keyval)
+        if keyval not in self.attributes:
+            raise errors.ArgError(f"no attribute under keyval {keyval}")
+        value = self.attributes.pop(keyval)
+        kv.delete_fn(self, keyval, value, kv.extra_state)
+
+    # -- object lifecycle hooks ------------------------------------------
+
+    def _copy_attrs_to(self, newobj: "AttrHost") -> None:
+        """Dup-time propagation: run each attribute's copy callback
+        against the OLD object (MPI_Comm_dup's attribute pass)."""
+        for keyval, value in list(self.attributes.items()):
+            kv = _get_keyval(keyval)
+            keep, newval = kv.copy_fn(self, keyval, kv.extra_state, value)
+            if keep:
+                newobj.attributes[keyval] = newval
+
+    def _delete_all_attrs(self) -> None:
+        """Free-time pass: delete callbacks for every cached attribute
+        (ompi_attr_delete_all)."""
+        first_err = None
+        for keyval in list(self.attributes):
+            kv = _get_keyval(keyval)
+            value = self.attributes.pop(keyval)
+            try:
+                kv.delete_fn(self, keyval, value, kv.extra_state)
+            except Exception as e:  # noqa: BLE001 - collect, finish pass
+                first_err = first_err or e
+        if first_err is not None:
+            raise first_err

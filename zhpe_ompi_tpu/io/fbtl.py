@@ -60,10 +60,9 @@ class PosixFbtl(FbtlComponent):
 
 
 def fbtl_framework() -> mca_component.Framework:
-    fw = mca_component.framework("fbtl", "file byte-transfer")
-    fw.register(PosixFbtl())
-    fw.open()
-    return fw
+    return mca_component.build_framework(
+        "fbtl", "file byte-transfer", (PosixFbtl,)
+    )
 
 
 def select_fbtl() -> FbtlComponent:

@@ -168,12 +168,10 @@ class IndividualFcoll(FcollComponent):
 
 
 def fcoll_framework() -> mca_component.Framework:
-    fw = mca_component.framework("fcoll", "collective IO strategies")
-    fw.register(TwoPhaseFcoll())
-    fw.register(DynamicFcoll())
-    fw.register(IndividualFcoll())
-    fw.open()
-    return fw
+    return mca_component.build_framework(
+        "fcoll", "collective IO strategies",
+        (TwoPhaseFcoll, DynamicFcoll, IndividualFcoll),
+    )
 
 
 def select_fcoll() -> FcollComponent:

@@ -86,10 +86,9 @@ class PosixFs(FsComponent):
 
 
 def fs_framework() -> mca_component.Framework:
-    fw = mca_component.framework("fs", "filesystem operations")
-    fw.register(PosixFs())
-    fw.open()
-    return fw
+    return mca_component.build_framework(
+        "fs", "filesystem operations", (PosixFs,)
+    )
 
 
 def select_fs() -> FsComponent:

@@ -253,35 +253,91 @@ class WireFile(errhandler.HasErrhandler):
         return self._shfp.get()
 
     # -- collective IO: fcoll over the endpoint --------------------------
+    #
+    # Aggregator count = fcoll_wire_aggregators (default 1).  With 1,
+    # runs ship to rank 0, which drives the selected fcoll component —
+    # the classic two-phase shape.  With A > 1, this is the vulcan shape
+    # (ompi/mca/fcoll/vulcan): stripes of fcoll_dynamic_stripe bytes are
+    # owned round-robin by A aggregator ranks, every rank alltoalls each
+    # stripe's runs to its owner, and the owners write their disjoint
+    # stripe sets concurrently (one process each).
+
+    def _num_aggregators(self) -> int:
+        from ..mca import var as mca_var
+
+        mca_var.register(
+            "fcoll_wire_aggregators", 1,
+            "Aggregator ranks for wire-plane collective IO (1 = two-phase "
+            "single aggregator; >1 = vulcan stripe-round-robin)",
+            type=int,
+        )
+        return max(1, min(int(mca_var.get("fcoll_wire_aggregators", 1)),
+                          self.ep.size))
+
+    def _stripe_owner(self, offs: np.ndarray, naggr: int) -> np.ndarray:
+        from ..mca import var as mca_var
+
+        stripe = int(mca_var.get("fcoll_dynamic_stripe", 4 * 1024 * 1024))
+        return (offs // stripe) % naggr
 
     def write_all(self, buf, count: int | None = None) -> int:
-        """Collective write at each rank's individual pointer.  Runs are
-        shipped to rank 0, which drives the selected fcoll component's
-        aggregation (two-phase coalescing) in one pass."""
+        """Collective write at each rank's individual pointer."""
         self._check_open()
         if count is None:
             count = self._full_count(buf)
         data = self._as_bytes(buf, count).copy()
         offs = self._view.byte_offsets(self._pointer, count)
         self._pointer += count
-        gathered = self.ep.gather((offs, data), root=0)
-        if self.ep.rank == 0:
-            self._fcoll.write(self._fbtl, self._fd, gathered)
+        naggr = self._num_aggregators()
+        if naggr == 1:
+            gathered = self.ep.gather((offs, data), root=0)
+            if self.ep.rank == 0:
+                self._fcoll.write(self._fbtl, self._fd, gathered)
+        else:
+            owner = self._stripe_owner(offs, naggr)
+            outbox = [
+                (offs[owner == a], data[owner == a]) if a < naggr else None
+                for a in range(self.ep.size)
+            ]
+            inbox = self.ep.alltoall(outbox)
+            if self.ep.rank < naggr:
+                mine = [p for p in inbox if p is not None]
+                self._fcoll.write(self._fbtl, self._fd, mine)
         self.ep.barrier()  # data visible to every rank after the call
         return count
 
     def read_all(self, count: int) -> np.ndarray:
-        """Collective read at each rank's individual pointer: rank 0 runs
-        the aggregated fcoll pass and scatters per-rank bytes."""
+        """Collective read at each rank's individual pointer."""
         self._check_open()
         offs = self._view.byte_offsets(self._pointer, count)
         self._pointer += count
-        all_offs = self.ep.gather(offs, root=0)
-        if self.ep.rank == 0:
-            raws = self._fcoll.read(self._fbtl, self._fd, all_offs)
-            raw = self.ep.scatter(raws, root=0)
+        naggr = self._num_aggregators()
+        if naggr == 1:
+            all_offs = self.ep.gather(offs, root=0)
+            if self.ep.rank == 0:
+                raws = self._fcoll.read(self._fbtl, self._fd, all_offs)
+                raw = self.ep.scatter(raws, root=0)
+            else:
+                raw = self.ep.scatter(None, root=0)
         else:
-            raw = self.ep.scatter(None, root=0)
+            owner = self._stripe_owner(offs, naggr)
+            outbox = [
+                offs[owner == a] if a < naggr else None
+                for a in range(self.ep.size)
+            ]
+            inbox = self.ep.alltoall(outbox)
+            if self.ep.rank < naggr:
+                reqs = [o if o is not None else np.empty(0, np.int64)
+                        for o in inbox]
+                raws = self._fcoll.read(self._fbtl, self._fd, reqs)
+            else:
+                raws = [None] * self.ep.size
+            back = self.ep.alltoall(raws)
+            raw = np.empty(offs.size, dtype=np.uint8)
+            for a in range(naggr):
+                piece = back[a]
+                if piece is not None and piece.size:
+                    raw[owner == a] = piece
         dt = getattr(self._view.etype, "np_dtype", None)
         return raw.view(dt) if dt is not None else raw
 

@@ -192,6 +192,21 @@ registry = FrameworkRegistry()
 framework = registry.framework
 
 
+def build_framework(name: str, description: str,
+                    component_factories) -> Framework:
+    """Memoized framework construction: first call registers the
+    components (built from the zero-arg factories) and opens; later
+    calls return the populated framework without reconstructing anything.
+    The single home for the build-once pattern every
+    ``<fw>_framework()`` helper needs."""
+    fw = registry.framework(name, description)
+    if not fw.components():
+        for factory in component_factories:
+            fw.register(factory())
+        fw.open()
+    return fw
+
+
 def info() -> list[dict[str, Any]]:
     """Introspection dump used by the zmpi-info tool (ompi_info analog)."""
     out = []

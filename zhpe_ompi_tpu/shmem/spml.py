@@ -123,21 +123,11 @@ class AmSpml(SpmlComponent):
         return _AmBackend(ep, heap_bytes)
 
 
-_framework: mca_component.Framework | None = None
-_framework_guard = threading.Lock()
-
-
 def spml_framework() -> mca_component.Framework:
-    global _framework
-    with _framework_guard:
-        if _framework is None:
-            fw = mca_component.framework("spml", "SHMEM put/get transports")
-            fw.register(DirectSpml())
-            fw.register(MmapSpml())
-            fw.register(AmSpml())
-            fw.open()
-            _framework = fw
-        return _framework
+    return mca_component.build_framework(
+        "spml", "SHMEM put/get transports",
+        (DirectSpml, MmapSpml, AmSpml),
+    )
 
 
 def select_spml(ep) -> SpmlComponent:

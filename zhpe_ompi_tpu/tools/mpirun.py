@@ -81,6 +81,61 @@ def _start_coordinator(host: str, size: int, timeout: float):
     return srv.getsockname()[1]
 
 
+def _start_name_server(host: str):
+    """The ompi-server analog: a tiny publish/lookup/unpublish registry
+    that lives for the job (MPI_Publish_name needs a server that outlasts
+    any one rank — the reference ships a separate ``ompi-server`` daemon
+    for exactly this; here the launcher hosts it).  One request per
+    connection: request frame = dss.pack of ONE list value —
+    ["pub", service, port] / ["look", service] / ["unpub", service];
+    reply frame = dss.pack of ONE result value (True, the port name or
+    None, found-bool respectively)."""
+    from ..pt2pt.tcp import _recv_frame, _send_frame
+    from ..utils import dss
+
+    srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    srv.bind((host, 0))
+    srv.listen(16)
+    registry: dict[str, str] = {}
+    reg_lock = threading.Lock()
+
+    def serve():
+        while True:
+            try:
+                conn, _ = srv.accept()
+            except OSError:
+                return  # launcher exiting
+            try:
+                # a stalled/garbage client must cost at most 5s and never
+                # kill the service for the rest of the job
+                conn.settimeout(5.0)
+                frame = _recv_frame(conn)
+                if frame is None:
+                    continue
+                [req] = dss.unpack(frame)
+                op = req[0]
+                with reg_lock:
+                    if op == "pub":
+                        registry[req[1]] = req[2]
+                        out = True
+                    elif op == "look":
+                        out = registry.get(req[1])
+                    elif op == "unpub":
+                        out = registry.pop(req[1], None) is not None
+                    else:
+                        out = None
+                _send_frame(conn, dss.pack(out))
+            except Exception:  # noqa: BLE001 - malformed request; serve on
+                pass
+            finally:
+                conn.close()
+
+    t = threading.Thread(target=serve, daemon=True)
+    t.start()
+    return srv, srv.getsockname()[1]
+
+
 def _forward(stream, rank: int, label: str, out, lock: threading.Lock,
              tag: bool) -> None:
     """IOF drain thread: line-forward a child stream with a rank prefix."""
@@ -95,7 +150,8 @@ def _forward(stream, rank: int, label: str, out, lock: threading.Lock,
 
 
 def build_env(rank: int, size: int, host: str, port: int,
-              mca: list[tuple[str, str]] | None = None) -> dict:
+              mca: list[tuple[str, str]] | None = None,
+              ns_port: int | None = None) -> dict:
     """The ZMPI_* environment contract one rank sees (PMIx envars analog)."""
     env = dict(os.environ)
     env.update({
@@ -107,6 +163,8 @@ def build_env(rank: int, size: int, host: str, port: int,
         # instead of binding the coordinator itself
         "ZMPI_COORD_EXTERNAL": "1",
     })
+    if ns_port is not None:
+        env["ZMPI_NAMESERVER"] = f"{host}:{ns_port}"
     # make the framework importable in every rank regardless of cwd — the
     # mpirun-exports-its-library-paths behavior (OPAL_PREFIX/LD_LIBRARY_PATH)
     pkg_root = os.path.dirname(os.path.dirname(
@@ -134,6 +192,16 @@ def launch(n: int, argv: list[str], host: str = "127.0.0.1",
     stdout = stdout if stdout is not None else sys.stdout
     stderr = stderr if stderr is not None else sys.stderr
     port = _start_coordinator(host, n, timeout or 120.0)
+    ns_srv, ns_port = _start_name_server(host)
+    try:
+        return _launch_job(n, argv, host, port, ns_port, mca, timeout,
+                           tag_output, stdout, stderr)
+    finally:
+        ns_srv.close()  # stops the name-server accept loop
+
+
+def _launch_job(n, argv, host, port, ns_port, mca, timeout, tag_output,
+                stdout, stderr) -> int:
     cmd = list(argv)
     if cmd[0].endswith(".py"):
         cmd = [sys.executable] + cmd
@@ -143,7 +211,7 @@ def launch(n: int, argv: list[str], host: str = "127.0.0.1",
     out_lock = threading.Lock()
     for rank in range(n):
         p = subprocess.Popen(
-            cmd, env=build_env(rank, n, host, port, mca),
+            cmd, env=build_env(rank, n, host, port, mca, ns_port),
             stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
             start_new_session=True,  # isolate from our signal group
         )
