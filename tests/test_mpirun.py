@@ -177,3 +177,106 @@ def test_name_publishing_across_ranks(tmp_path):
     rc, out, err = _launch(2, [prog])
     assert rc == 0, err
     assert "NS-OK" in out
+
+
+def test_zmpicc_wrapper_compile_and_launch(tmp_path):
+    """zmpicc (the mpicc wrapper analog) compiles examples/ring_c.c with
+    no manual flags, and the binary runs under zmpirun — the reference's
+    whole C toolchain loop: wrapper compiler -> launcher."""
+    import subprocess
+
+    binary = str(tmp_path / "ring_c")
+    res = subprocess.run(
+        [sys.executable, "-m", "zhpe_ompi_tpu.tools.zmpicc",
+         os.path.join(_REPO, "examples", "ring_c.c"), "-o", binary],
+        capture_output=True, text=True, timeout=180,
+        env={**os.environ, "PYTHONPATH": _REPO},
+    )
+    assert res.returncode == 0, res.stderr
+    showme = subprocess.run(
+        [sys.executable, "-m", "zhpe_ompi_tpu.tools.zmpicc", "--showme"],
+        capture_output=True, text=True, timeout=180,
+        env={**os.environ, "PYTHONPATH": _REPO},
+    )
+    assert "-lzompi_mpi" in showme.stdout
+    rc, out, err = _launch(4, [binary])
+    assert rc == 0, err
+
+
+def test_mpmd_mixed_c_and_python(tmp_path):
+    """MPMD (-n 1 C-binary : -n 2 python): one COMM_WORLD, mixed
+    languages, one wire protocol.  The C rank (rank 0) sendrecvs with
+    Python ranks through the shim."""
+    import subprocess
+
+    from zhpe_ompi_tpu import native
+
+    shim = native.build_mpi_shim()
+    libdir = os.path.dirname(shim)
+    libname = os.path.basename(shim)[3:].rsplit(".so", 1)[0]
+    csrc = tmp_path / "head.c"
+    csrc.write_text(textwrap.dedent("""
+        #include <stdio.h>
+        #include "zompi_mpi.h"
+        int main(int argc, char **argv) {
+            int rank, size, v;
+            MPI_Init(&argc, &argv);
+            MPI_Comm_rank(MPI_COMM_WORLD, &rank);
+            MPI_Comm_size(MPI_COMM_WORLD, &size);
+            for (int r = 1; r < size; r++) {
+                v = 100 + r;
+                MPI_Send(&v, 1, MPI_INT, r, 5, MPI_COMM_WORLD);
+            }
+            int total = 0;
+            for (int r = 1; r < size; r++) {
+                MPI_Status st;
+                MPI_Recv(&v, 1, MPI_INT, r, 6, MPI_COMM_WORLD, &st);
+                total += v;
+            }
+            printf("HEAD total=%d\\n", total);
+            MPI_Finalize();
+            return total == 406 ? 0 : 1;  /* 2*101 + 2*102 */
+        }
+    """))
+    binary = str(tmp_path / "head")
+    subprocess.run(
+        ["gcc", str(csrc), "-o", binary, "-I", native.mpi_header_dir(),
+         "-L", libdir, f"-l{libname}", f"-Wl,-rpath,{libdir}"],
+        check=True, capture_output=True, text=True,
+    )
+    pyprog = _script(tmp_path, """
+        import numpy as np
+        import zhpe_ompi_tpu as zmpi
+
+        proc = zmpi.host_init()
+        got = proc.recv(source=0, tag=5)
+        v = int(np.asarray(got).reshape(-1)[0])
+        proc.send(np.asarray([2 * v], np.int32), 0, tag=6)
+    """)
+    out, err = io.StringIO(), io.StringIO()
+    rc = mpirun.launch_mpmd(
+        [(1, [binary]), (2, [pyprog])],
+        stdout=out, stderr=err, timeout=120.0,
+    )
+    assert rc == 0, err.getvalue()
+    assert "HEAD total=406" in out.getvalue()
+
+
+def test_cli_mpmd_colon_syntax(tmp_path):
+    import subprocess
+
+    a = _script(tmp_path, "print('A-rank')\n")
+    bp = tmp_path / "b.py"
+    bp.write_text(
+        f"import sys\nsys.path.insert(0, {_REPO!r})\nprint('B-rank')\n")
+    b = str(bp)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    res = subprocess.run(
+        [sys.executable, "-m", "zhpe_ompi_tpu.tools.mpirun",
+         "-n", "2", "--no-tag-output", a, ":", "-n", "1", b],
+        capture_output=True, text=True, timeout=120, env=env,
+    )
+    assert res.returncode == 0, res.stderr
+    assert res.stdout.count("A-rank") == 2
+    assert res.stdout.count("B-rank") == 1

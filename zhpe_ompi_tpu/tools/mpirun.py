@@ -187,34 +187,57 @@ def launch(n: int, argv: list[str], host: str = "127.0.0.1",
     Python programs (``*.py``) run under the current interpreter; anything
     else is exec'd directly (a C program linked against the ABI shim).
     """
-    if n < 1:
-        raise ValueError("zmpirun: -n must be >= 1")
+    return launch_mpmd([(n, argv)], host=host, mca=mca, timeout=timeout,
+                       tag_output=tag_output, stdout=stdout, stderr=stderr)
+
+
+def launch_mpmd(apps: list[tuple[int, list[str]]], host: str = "127.0.0.1",
+                mca: list[tuple[str, str]] | None = None,
+                timeout: float | None = None, tag_output: bool = True,
+                stdout=None, stderr=None) -> int:
+    """MPMD launch (mpirun's ``-n A progA : -n B progB``): one job, one
+    COMM_WORLD, consecutive rank blocks per app context.  Mixed
+    Python/C contexts share the wire protocol, so a C ring and a Python
+    analytics rank can be one job."""
+    if not apps or any(n < 1 for n, _ in apps):
+        raise ValueError("zmpirun: every app context needs -n >= 1")
+    n = sum(cnt for cnt, _ in apps)
     stdout = stdout if stdout is not None else sys.stdout
     stderr = stderr if stderr is not None else sys.stderr
     port = _start_coordinator(host, n, timeout or 120.0)
     ns_srv, ns_port = _start_name_server(host)
+    cmds: list[list[str]] = []
+    for cnt, argv in apps:
+        cmd = list(argv)
+        if cmd[0].endswith(".py"):
+            cmd = [sys.executable] + cmd
+        cmds.extend([cmd] * cnt)
     try:
-        return _launch_job(n, argv, host, port, ns_port, mca, timeout,
+        return _launch_job(n, cmds, host, port, ns_port, mca, timeout,
                            tag_output, stdout, stderr)
     finally:
         ns_srv.close()  # stops the name-server accept loop
 
 
-def _launch_job(n, argv, host, port, ns_port, mca, timeout, tag_output,
+def _launch_job(n, cmds, host, port, ns_port, mca, timeout, tag_output,
                 stdout, stderr) -> int:
-    cmd = list(argv)
-    if cmd[0].endswith(".py"):
-        cmd = [sys.executable] + cmd
-
     procs: list[subprocess.Popen] = []
     drains: list[threading.Thread] = []
     out_lock = threading.Lock()
     for rank in range(n):
-        p = subprocess.Popen(
-            cmd, env=build_env(rank, n, host, port, mca, ns_port),
-            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
-            start_new_session=True,  # isolate from our signal group
-        )
+        try:
+            p = subprocess.Popen(
+                cmds[rank],
+                env=build_env(rank, n, host, port, mca, ns_port),
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+                start_new_session=True,  # isolate from our signal group
+            )
+        except OSError:
+            # MPMD makes mid-loop spawn failure real (a later context's
+            # binary may be missing): don't orphan already-spawned ranks
+            # in the modex rendezvous
+            _teardown(procs, set(range(len(procs))))
+            raise
         procs.append(p)
         for stream, label, sink in (
             (p.stdout, "", stdout), (p.stderr, ":err", stderr),
@@ -295,10 +318,12 @@ def _teardown(procs: list[subprocess.Popen], live: set) -> None:
 def main(args: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(
         prog="zmpirun",
-        description="Launch an n-rank host-plane job (mpirun analog).",
+        description="Launch an n-rank host-plane job (mpirun analog). "
+                    "MPMD: separate app contexts with ':' — "
+                    "zmpirun -n 2 progA : -n 2 progB",
     )
     ap.add_argument("-n", "--np", type=int, required=True, dest="n",
-                    help="number of ranks")
+                    help="number of ranks (per app context)")
     ap.add_argument("--host", default="127.0.0.1",
                     help="bind/rendezvous address (default 127.0.0.1)")
     ap.add_argument("--mca", nargs=2, action="append", default=[],
@@ -310,12 +335,34 @@ def main(args: list[str] | None = None) -> int:
                     help="forward child output without [rank] prefixes")
     ap.add_argument("argv", nargs=argparse.REMAINDER,
                     help="program and its arguments")
-    ns = ap.parse_args(args)
-    if not ns.argv:
+    raw = list(sys.argv[1:] if args is None else args)
+    # MPMD: split on ':' tokens; global flags come from the FIRST context
+    contexts: list[list[str]] = [[]]
+    for tok in raw:
+        if tok == ":":
+            contexts.append([])
+        else:
+            contexts[-1].append(tok)
+    first = ap.parse_args(contexts[0])
+    if not first.argv:
         ap.error("no program given")
-    return launch(
-        ns.n, ns.argv, host=ns.host, mca=[tuple(m) for m in ns.mca],
-        timeout=ns.timeout, tag_output=not ns.no_tag_output,
+    apps = [(first.n, first.argv)]
+    for extra in contexts[1:]:
+        more = ap.parse_args(extra)
+        if not more.argv:
+            ap.error("empty app context after ':'")
+        # global flags belong to the FIRST context only; accepting them
+        # later and ignoring them would silently drop user intent
+        if (more.host != "127.0.0.1" or more.mca or
+                more.timeout is not None or more.no_tag_output):
+            ap.error(
+                "--host/--mca/--timeout/--no-tag-output are job-global: "
+                "pass them in the first app context"
+            )
+        apps.append((more.n, more.argv))
+    return launch_mpmd(
+        apps, host=first.host, mca=[tuple(m) for m in first.mca],
+        timeout=first.timeout, tag_output=not first.no_tag_output,
     )
 
 

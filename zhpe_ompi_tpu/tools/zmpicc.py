@@ -1,0 +1,63 @@
+"""``zmpicc`` — the mpicc wrapper-compiler analog.
+
+The reference's ``mpicc``/``mpifort`` (``ompi/tools/wrappers``) are thin
+drivers that inject the MPI include/lib flags around the underlying
+compiler.  This is that surface for the C ABI shim: it builds
+``libzompi_mpi.so`` if stale, then execs the real compiler with
+``-I<header dir> -L<lib dir> -lzompi_mpi_<hash> -Wl,-rpath,<lib dir>``
+appended.
+
+    python -m zhpe_ompi_tpu.tools.zmpicc ring.c -o ring
+    python -m zhpe_ompi_tpu.tools.zmpicc --showme          # print flags
+
+``--showme`` (and ``--showme:compile`` / ``--showme:link``) mirror the
+reference wrapper's introspection flags so build systems can consume the
+flags without invoking the wrapper per-file.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+
+def _flags() -> tuple[list[str], list[str]]:
+    """(compile_flags, link_flags) for the shim."""
+    from .. import native
+
+    so = native.build_mpi_shim()
+    libdir = os.path.dirname(so)
+    libname = os.path.basename(so)[3:].rsplit(".so", 1)[0]
+    compile_flags = ["-I", native.mpi_header_dir()]
+    link_flags = ["-L", libdir, f"-l{libname}", f"-Wl,-rpath,{libdir}",
+                  "-pthread"]
+    return compile_flags, link_flags
+
+
+def main(args: list[str] | None = None) -> int:
+    args = list(sys.argv[1:] if args is None else args)
+    cc = os.environ.get("ZMPI_CC", "gcc")
+    compile_flags, link_flags = _flags()
+    if args and args[0].startswith("--showme"):
+        which = args[0].partition(":")[2]
+        if which == "compile":
+            out = compile_flags
+        elif which == "link":
+            out = link_flags
+        else:
+            out = [cc] + compile_flags + link_flags
+        print(" ".join(out))
+        return 0
+    if not args:
+        print("zmpicc: no input files (try --showme)", file=sys.stderr)
+        return 1
+    cmd = [cc] + args + compile_flags
+    # link flags only when this invocation links (no -c/-S/-E)
+    if not any(a in ("-c", "-S", "-E") for a in args):
+        cmd += link_flags
+    return subprocess.call(cmd)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
