@@ -141,6 +141,79 @@ def bench_pt2pt(max_size: int = 4 << 20, iters: int = 50) -> list[dict]:
     return rows
 
 
+def bench_tcp(max_size: int = 4 << 20, iters: int = 50) -> list[dict]:
+    """REAL-socket ping-pong latency (osu_latency over btl/tcp): two
+    TcpProc endpoints over loopback, eager and rendezvous regimes both
+    crossed as the ladder passes tcp_eager_limit."""
+    import threading
+
+    from zhpe_ompi_tpu.pt2pt.tcp import TcpProc
+
+    rows = []
+    for nbytes in _sizes(max_size):
+        payload = np.zeros(max(1, nbytes // 8), dtype=np.float64)
+        results: dict[int, float | None] = {}
+
+        # rank 0 binds an ephemeral coordinator; rank 1 learns it via the
+        # on_coordinator_bound hook (prte forwarding the PMIx URI)
+        coord: list = []
+        coord_ready = threading.Event()
+
+        def run_rank0(payload=payload):
+            try:
+                proc = TcpProc(
+                    0, 2, coordinator=("127.0.0.1", 0),
+                    on_coordinator_bound=lambda addr: (
+                        coord.append(addr), coord_ready.set()),
+                )
+            except BaseException as e:
+                results[0] = e
+                coord_ready.set()  # unblock rank 1's wait
+                raise
+            try:
+                proc.send(payload, dest=1, tag=1)
+                proc.recv(source=1, tag=2)
+                t0 = time.perf_counter()
+                for _ in range(iters):
+                    proc.send(payload, dest=1, tag=1)
+                    proc.recv(source=1, tag=2)
+                results[0] = (time.perf_counter() - t0) / iters
+            except BaseException as e:
+                results[0] = e
+                raise
+            finally:
+                proc.close()
+
+        def run_rank1(payload=payload):
+            if not coord_ready.wait(30.0) or not coord:
+                return  # rank 0 failed; its error is in results[0]
+            proc = TcpProc(1, 2, coordinator=tuple(coord[0]))
+            try:
+                proc.recv(source=0, tag=1)
+                proc.send(payload, dest=0, tag=2)
+                for _ in range(iters):
+                    proc.recv(source=0, tag=1)
+                    proc.send(payload, dest=0, tag=2)
+            finally:
+                proc.close()
+
+        t0 = threading.Thread(target=run_rank0)
+        t1 = threading.Thread(target=run_rank1)
+        t0.start()
+        t1.start()
+        t0.join()
+        t1.join()
+        rtt = results.get(0)
+        if rtt is None or isinstance(rtt, BaseException):
+            raise RuntimeError(f"tcp pingpong rank 0 failed: {rtt!r}")
+        rows.append({
+            "op": "tcp_pingpong", "bytes": payload.nbytes,
+            "latency_us": rtt / 2 * 1e6,
+            "bandwidth_MBps": (payload.nbytes / (rtt / 2)) / 1e6,
+        })
+    return rows
+
+
 def _print_table(rows: list[dict]) -> None:
     if not rows:
         return
@@ -156,7 +229,7 @@ def main(argv: list[str] | None = None) -> int:
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     p.add_argument("--op", default="allreduce",
                    help="allreduce|bcast|allgather|alltoall|reduce|"
-                        "reduce_scatter|pt2pt|all")
+                        "reduce_scatter|pt2pt|tcp|all")
     p.add_argument("--algorithm", default="auto",
                    help="tuned forced algorithm name, or 'auto'")
     p.add_argument("--max-size", type=int, default=1 << 20)
@@ -166,11 +239,14 @@ def main(argv: list[str] | None = None) -> int:
 
     if args.op == "pt2pt":
         rows = bench_pt2pt(args.max_size, max(args.iters, 10))
+    elif args.op == "tcp":
+        rows = bench_tcp(args.max_size, max(args.iters, 10))
     elif args.op == "all":
         rows = []
         for op in ("allreduce", "bcast", "allgather", "alltoall"):
             rows += bench_collective(op, "auto", args.max_size, args.iters)
         rows += bench_pt2pt(args.max_size, max(args.iters, 10))
+        rows += bench_tcp(args.max_size, max(args.iters, 10))
     else:
         rows = bench_collective(
             args.op, args.algorithm, args.max_size, args.iters

@@ -149,3 +149,41 @@ class TestQuiesce:
             ck.save(0, {"x": np.zeros(2)}, blocking=True)
         uni.contexts[1].recv(source=0, tag=2)
         ck.save(0, {"x": np.zeros(2)}, blocking=True)
+
+
+class TestCheckpointCli:
+    """opal-checkpoint/opal-restart CLI analog (tools/checkpoint.py)."""
+
+    def _make(self, tmp_path):
+        import jax.numpy as jnp
+
+        from zhpe_ompi_tpu.runtime.checkpoint import Checkpointer
+
+        ck = Checkpointer(str(tmp_path), keep=10)
+        for step in (1, 2, 3):
+            ck.save(step, {"w": jnp.arange(4.0) * step}, blocking=True)
+        return ck
+
+    def test_list_inspect_prune(self, tmp_path, capsys):
+        from zhpe_ompi_tpu.tools import checkpoint as cli
+
+        self._make(tmp_path)
+        assert cli.main(["list", str(tmp_path)]) == 0
+        out = capsys.readouterr().out
+        assert "step        1" in out and "latest: 3" in out
+
+        assert cli.main(["inspect", str(tmp_path), "--step", "2"]) == 0
+        out = capsys.readouterr().out
+        assert "shape=(4,)" in out
+
+        assert cli.main(["prune", str(tmp_path), "--keep", "1"]) == 0
+        out = capsys.readouterr().out
+        assert "pruned step 1" in out and "pruned step 2" in out
+
+        assert cli.main(["list", str(tmp_path)]) == 0
+        assert "latest: 3" in capsys.readouterr().out
+
+    def test_list_empty_dir(self, tmp_path):
+        from zhpe_ompi_tpu.tools import checkpoint as cli
+
+        assert cli.main(["list", str(tmp_path)]) == 1

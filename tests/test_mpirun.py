@@ -128,16 +128,12 @@ def test_c_program_under_launcher(tmp_path):
     rendezvous as a client — C and the launcher speak one wire-up."""
     import subprocess
 
-    from zhpe_ompi_tpu import native
+    from zhpe_ompi_tpu.tools import zmpicc
 
-    shim = native.build_mpi_shim()
-    libdir = os.path.dirname(shim)
-    libname = os.path.basename(shim)[3:].rsplit(".so", 1)[0]
     binary = tmp_path / "ring_c"
     subprocess.run(
         ["gcc", os.path.join(_REPO, "examples", "ring_c.c"),
-         "-o", str(binary), "-I", native.mpi_header_dir(),
-         "-L", libdir, f"-l{libname}", f"-Wl,-rpath,{libdir}"],
+         "-o", str(binary)] + zmpicc.compile_flags() + zmpicc.link_flags(),
         check=True, capture_output=True, text=True,
     )
     rc, out, err = _launch(3, [str(binary)])
@@ -209,11 +205,8 @@ def test_mpmd_mixed_c_and_python(tmp_path):
     Python ranks through the shim."""
     import subprocess
 
-    from zhpe_ompi_tpu import native
+    from zhpe_ompi_tpu.tools import zmpicc
 
-    shim = native.build_mpi_shim()
-    libdir = os.path.dirname(shim)
-    libname = os.path.basename(shim)[3:].rsplit(".so", 1)[0]
     csrc = tmp_path / "head.c"
     csrc.write_text(textwrap.dedent("""
         #include <stdio.h>
@@ -240,8 +233,8 @@ def test_mpmd_mixed_c_and_python(tmp_path):
     """))
     binary = str(tmp_path / "head")
     subprocess.run(
-        ["gcc", str(csrc), "-o", binary, "-I", native.mpi_header_dir(),
-         "-L", libdir, f"-l{libname}", f"-Wl,-rpath,{libdir}"],
+        ["gcc", str(csrc), "-o", binary]
+        + zmpicc.compile_flags() + zmpicc.link_flags(),
         check=True, capture_output=True, text=True,
     )
     pyprog = _script(tmp_path, """

@@ -1,0 +1,109 @@
+"""``zmpi-checkpoint`` — the opal-checkpoint / opal-restart CLI analog.
+
+The reference ships command-line checkpoint tooling
+(``opal/tools/opal-checkpoint``, ``opal-restart``) on top of its crs
+framework.  This CLI is that surface over the framework's async
+checkpointer (``runtime/checkpoint.py``):
+
+    python -m zhpe_ompi_tpu.tools.checkpoint list <dir>
+    python -m zhpe_ompi_tpu.tools.checkpoint inspect <dir> [--step N]
+    python -m zhpe_ompi_tpu.tools.checkpoint prune <dir> --keep K
+
+``list`` prints available steps; ``inspect`` loads one snapshot on CPU
+and prints its tree structure (leaf shapes/dtypes); ``prune`` applies
+the retention policy offline (the opal-checkpoint -s housekeeping role).
+Restore-into-a-program stays programmatic (``Checkpointer.restore``) —
+process-image restart does not transfer to this platform; the snapshot
+IS the restartable state.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+
+def _list(directory: str) -> int:
+    from ..runtime.checkpoint import Checkpointer
+
+    ck = Checkpointer(directory)
+    steps = ck.all_steps()
+    if not steps:
+        print(f"no checkpoints in {directory}")
+        return 1
+    for s in steps:
+        d = os.path.join(directory, f"step_{s}")
+        size = 0
+        if os.path.isdir(d):
+            size = sum(
+                os.path.getsize(os.path.join(d, f)) for f in os.listdir(d)
+            )
+        print(f"step {s:8d}  {size / 1e6:8.2f} MB")
+    print(f"latest: {ck.latest_step()}")
+    return 0
+
+
+def _inspect(directory: str, step: int | None) -> int:
+    import jax
+
+    from ..runtime.checkpoint import Checkpointer
+
+    jax.config.update("jax_platforms", "cpu")
+    ck = Checkpointer(directory)
+    state = ck.restore(step)
+    step = step if step is not None else ck.latest_step()
+    print(f"step {step}:")
+    leaves, treedef = jax.tree_util.tree_flatten(state)
+    print(f"  tree: {treedef}")
+    total = 0
+    for i, leaf in enumerate(leaves):
+        shape = getattr(leaf, "shape", ())
+        dtype = getattr(leaf, "dtype", type(leaf).__name__)
+        nbytes = getattr(leaf, "nbytes", 0)
+        total += nbytes
+        print(f"  leaf[{i}]: shape={tuple(shape)} dtype={dtype}")
+    print(f"  total: {total / 1e6:.2f} MB in {len(leaves)} leaves")
+    return 0
+
+
+def _prune(directory: str, keep: int) -> int:
+    import shutil
+
+    from ..runtime.checkpoint import Checkpointer
+
+    ck = Checkpointer(directory)
+    steps = ck.all_steps()
+    drop = steps[:-keep] if keep > 0 else []
+    for s in drop:
+        shutil.rmtree(os.path.join(directory, f"step_{s}"),
+                      ignore_errors=True)
+        print(f"pruned step {s}")
+    print(f"kept {min(len(steps), keep)} of {len(steps)}")
+    return 0
+
+
+def main(args: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="zmpi-checkpoint",
+        description="Checkpoint housekeeping CLI (opal-checkpoint analog)",
+    )
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    p_list = sub.add_parser("list")
+    p_list.add_argument("dir")
+    p_ins = sub.add_parser("inspect")
+    p_ins.add_argument("dir")
+    p_ins.add_argument("--step", type=int, default=None)
+    p_pr = sub.add_parser("prune")
+    p_pr.add_argument("dir")
+    p_pr.add_argument("--keep", type=int, required=True)
+    ns = ap.parse_args(args)
+    if ns.cmd == "list":
+        return _list(ns.dir)
+    if ns.cmd == "inspect":
+        return _inspect(ns.dir, ns.step)
+    return _prune(ns.dir, ns.keep)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -22,40 +22,46 @@ import subprocess
 import sys
 
 
-def _flags() -> tuple[list[str], list[str]]:
-    """(compile_flags, link_flags) for the shim."""
+def compile_flags() -> list[str]:
+    """Header-only flags; never triggers a shim build (a per-file
+    ``zmpicc -c`` or a ``--showme:compile`` configure probe must be
+    cheap)."""
+    from .. import native
+
+    return ["-I", native.mpi_header_dir()]
+
+
+def link_flags() -> list[str]:
+    """Library flags; builds ``libzompi_mpi.so`` if stale."""
     from .. import native
 
     so = native.build_mpi_shim()
     libdir = os.path.dirname(so)
     libname = os.path.basename(so)[3:].rsplit(".so", 1)[0]
-    compile_flags = ["-I", native.mpi_header_dir()]
-    link_flags = ["-L", libdir, f"-l{libname}", f"-Wl,-rpath,{libdir}",
-                  "-pthread"]
-    return compile_flags, link_flags
+    return ["-L", libdir, f"-l{libname}", f"-Wl,-rpath,{libdir}",
+            "-pthread"]
 
 
 def main(args: list[str] | None = None) -> int:
     args = list(sys.argv[1:] if args is None else args)
     cc = os.environ.get("ZMPI_CC", "gcc")
-    compile_flags, link_flags = _flags()
     if args and args[0].startswith("--showme"):
         which = args[0].partition(":")[2]
         if which == "compile":
-            out = compile_flags
+            out = compile_flags()
         elif which == "link":
-            out = link_flags
+            out = link_flags()
         else:
-            out = [cc] + compile_flags + link_flags
+            out = [cc] + compile_flags() + link_flags()
         print(" ".join(out))
         return 0
     if not args:
         print("zmpicc: no input files (try --showme)", file=sys.stderr)
         return 1
-    cmd = [cc] + args + compile_flags
+    cmd = [cc] + args + compile_flags()
     # link flags only when this invocation links (no -c/-S/-E)
     if not any(a in ("-c", "-S", "-E") for a in args):
-        cmd += link_flags
+        cmd += link_flags()
     return subprocess.call(cmd)
 
 
