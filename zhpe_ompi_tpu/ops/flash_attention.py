@@ -412,4 +412,57 @@ def flash_attention(q, k, v, causal: bool = True, block_q: int = 512,
                       interpret or not on_tpu)
     if not (on_tpu or interpret):
         return attn_reference(q, k, v, causal)
-    return _flash(q, k, v, causal, block_q, block_k, interpret)
+    if on_tpu and not interpret and not _kernel_available():
+        # the component-availability probe failed (a TPU-like backend
+        # that cannot lower Mosaic): graceful naive fallback
+        return attn_reference(q, k, v, causal)
+    if interpret:
+        return _flash(q, k, v, causal, block_q, block_k, interpret)
+    # The probe covers one config; a dtype/shape-specific lowering
+    # failure can still surface here — the auto path's no-crash
+    # guarantee is this except, not the probe (which just avoids paying
+    # a doomed compile per call on a backend with no Mosaic at all)
+    try:
+        return _flash(q, k, v, causal, block_q, block_k, interpret)
+    except Exception as e:  # noqa: BLE001 - lowering/executable failure
+        _warn_fallback(f"{type(e).__name__} at shape {tuple(q.shape)}")
+        return attn_reference(q, k, v, causal)
+
+
+_kernel_ok: bool | None = None
+_warned: bool = False
+
+
+def _warn_fallback(reason: str) -> None:
+    """Warn once per process: silent O(S^2) fallback would hide a large
+    slowdown with zero diagnostic."""
+    global _warned
+    if not _warned:
+        import warnings
+
+        warnings.warn(
+            f"Pallas flash-attention kernel unavailable ({reason}); "
+            f"using the jnp reference attention", stacklevel=3,
+        )
+        _warned = True
+
+
+def _kernel_available() -> bool:
+    """One-shot probe: compile+run a minimal flash kernel on the real
+    backend (the mca component_init availability pattern — probe once,
+    select accordingly).  Any failure marks the kernel path unavailable
+    for the process."""
+    global _kernel_ok
+    if _kernel_ok is None:
+        import numpy as np
+
+        try:
+            q = jnp.zeros((1, 256, 1, 64), jnp.bfloat16)
+            out = _flash(q, q, q, True, 128, 128, False)
+            _kernel_ok = bool(np.isfinite(np.asarray(out)).all())
+            if not _kernel_ok:
+                _warn_fallback("probe produced non-finite output")
+        except Exception as e:  # noqa: BLE001 - any lowering/exec failure
+            _warn_fallback(type(e).__name__)
+            _kernel_ok = False
+    return _kernel_ok
