@@ -292,3 +292,65 @@ class TestHostAlgorithmSelection:
             mca_var.set_var("host_coll_large_msg", old)
         for r in res:
             np.testing.assert_allclose(r, np.full(7, 3.0))
+
+
+class TestBcastPipeline:
+    """Chain-pipelined bcast (coll_base_bcast.c:273 shape): segmented
+    stream through a root-rotated chain."""
+
+    def test_matches_binomial(self):
+        from zhpe_ompi_tpu.mca import var as mca_var
+        from zhpe_ompi_tpu.pt2pt.universe import LocalUniverse
+
+        mca_var.set_var("host_coll_segment", 256)
+        try:
+            uni = LocalUniverse(4)
+            payload = np.arange(1000, dtype=np.float32).reshape(10, 100)
+
+            def prog(ctx):
+                obj = payload if ctx.rank == 2 else None
+                got = hcoll.bcast(ctx, obj, root=2, algorithm="pipeline")
+                return np.asarray(got)
+
+            res = uni.run(prog)
+            for r in res:
+                np.testing.assert_array_equal(r, payload)
+        finally:
+            mca_var.unset("host_coll_segment")
+
+    def test_single_segment_payload(self):
+        from zhpe_ompi_tpu.pt2pt.universe import LocalUniverse
+
+        uni = LocalUniverse(3)
+        payload = np.ones(3, dtype=np.int64)
+
+        def prog(ctx):
+            got = hcoll.bcast(
+                ctx, payload if ctx.rank == 0 else None, root=0,
+                algorithm="pipeline",
+            )
+            return np.asarray(got)
+
+        for r in uni.run(prog):
+            np.testing.assert_array_equal(r, payload)
+
+    def test_over_sockets(self):
+        from test_tcp import run_tcp
+        from zhpe_ompi_tpu.mca import var as mca_var
+
+        mca_var.set_var("host_coll_segment", 1024)
+        try:
+            payload = np.random.default_rng(0).normal(
+                size=(64, 64)).astype(np.float64)
+
+            def prog(p):
+                got = hcoll.bcast(
+                    p, payload if p.rank == 1 else None, root=1,
+                    algorithm="pipeline",
+                )
+                return float(np.asarray(got).sum())
+
+            res = run_tcp(3, prog)
+            assert all(abs(r - payload.sum()) < 1e-6 for r in res)
+        finally:
+            mca_var.unset("host_coll_segment")

@@ -101,14 +101,43 @@ def _ordered(op, lo_val, hi_val):
 # -------------------------------------------------------------- broadcast
 
 
-def bcast(ctx, obj: Any = None, root: int = 0) -> Any:
-    """Binomial-tree broadcast (coll_base_bcast.c:207-259 shape).
+mca_var.register(
+    "host_coll_segment", 64 * 1024,
+    "Segment size (bytes) of pipelined host-plane collectives (the "
+    "reference's per-algorithm segsize knobs)",
+    type=int,
+)
+mca_var.register(
+    "host_bcast_algorithm", "binomial",
+    "Host-plane bcast algorithm: binomial (latency-optimal tree) or "
+    "pipeline (chain-pipelined segments, bandwidth-optimal for large "
+    "arrays).  Unlike MPI, non-root ranks don't pass a count here, so "
+    "size-based auto-selection has no size to look at — selection is "
+    "explicit, by this var or the algorithm argument",
+    enum=("binomial", "pipeline"),
+)
 
-    ``obj`` is significant at root only; every rank returns the payload.
-    """
+
+def bcast(ctx, obj: Any = None, root: int = 0,
+          algorithm: str | None = None) -> Any:
+    """Broadcast; ``obj`` is significant at root only; every rank
+    returns the payload.
+
+    binomial: coll_base_bcast.c:207-259 shape.  pipeline:
+    coll_base_bcast.c:273 shape — the payload streams through a
+    root-rotated chain in ``host_coll_segment``-byte pieces so link i
+    forwards piece k while receiving piece k+1 (requires an ndarray
+    payload at root; every rank must select the same algorithm)."""
+    alg = algorithm or mca_var.get("host_bcast_algorithm", "binomial")
+    if alg not in ("binomial", "pipeline"):
+        raise errors.ArgError(
+            f"unknown bcast algorithm {alg!r} (binomial|pipeline)"
+        )
     size, rank = ctx.size, ctx.rank
     if size == 1:
         return obj
+    if alg == "pipeline":
+        return _bcast_pipeline(ctx, obj, root)
     tag = _next_tag(ctx, TAG_BCAST)
     vrank = (rank - root) % size
     # receive from parent (clear lowest set bit of vrank)
@@ -125,6 +154,60 @@ def bcast(ctx, obj: Any = None, root: int = 0) -> Any:
                          cid=COLL_CID)
         mask <<= 1
     return obj
+
+
+def _bcast_pipeline(ctx, obj: Any, root: int) -> Any:
+    """Chain-pipelined broadcast: root-rotated chain, segment stream.
+    2(p-1)+nseg-1 message steps vs binomial's log2(p) — wins when
+    nbytes/bandwidth dominates latency (large arrays over sockets)."""
+    from ..pt2pt.requests import wait_all
+
+    size, rank = ctx.size, ctx.rank
+    vrank = (rank - root) % size
+    succ = (rank + 1) % size
+    pred = (rank - 1) % size
+    tag = _next_tag(ctx, TAG_BCAST)
+    last = vrank == size - 1
+    if vrank == 0:
+        # only the root's segment size matters: receivers take nseg from
+        # the header and reassemble whatever piece sizes arrive
+        seg = max(1, int(mca_var.get("host_coll_segment", 64 * 1024)))
+        arr = np.ascontiguousarray(obj)
+        flat = arr.reshape(-1).view(np.uint8)
+        nseg = max(1, -(-flat.size // seg))
+        ctx.send((arr.dtype.str, arr.shape, nseg), succ, tag=tag,
+                 cid=COLL_CID)
+        reqs = [
+            ctx.isend(flat[i * seg : (i + 1) * seg].copy(), succ,
+                      tag=tag, cid=COLL_CID)
+            for i in range(nseg)
+        ]
+        wait_all(reqs)
+        return obj
+    dtype_str, shape, nseg = ctx.recv(pred, tag=tag, cid=COLL_CID)
+    if not last:
+        ctx.send((dtype_str, shape, nseg), succ, tag=tag, cid=COLL_CID)
+    dt = np.dtype(dtype_str)
+    # single preallocated buffer: pieces fill slices as they arrive (a
+    # parts-list + concatenate would hold ~2x the payload at peak, on
+    # exactly the large-array workloads this algorithm targets)
+    flat = np.empty(int(np.prod(shape or (1,))) * dt.itemsize, np.uint8)
+    pos, reqs = 0, []
+    for _ in range(nseg):
+        piece = ctx.recv(pred, tag=tag, cid=COLL_CID)
+        raw = np.asarray(piece, np.uint8).reshape(-1)
+        flat[pos : pos + raw.size] = raw
+        pos += raw.size
+        if not last:
+            # forward while the next segment is still in flight — the
+            # pipeline overlap that makes the chain bandwidth-optimal
+            reqs.append(ctx.isend(piece, succ, tag=tag, cid=COLL_CID))
+    wait_all(reqs)
+    if pos != flat.size:
+        raise errors.TruncateError(
+            f"pipelined bcast: got {pos}B of {flat.size}B"
+        )
+    return flat.view(dt).reshape(shape)
 
 
 # ----------------------------------------------------------------- reduce
@@ -509,8 +592,9 @@ class HostCollectives:
     mca_coll_base_comm_select analog for host endpoints: one composed
     table, methods delegate to the module algorithms)."""
 
-    def bcast(self, obj: Any = None, root: int = 0) -> Any:
-        return bcast(self, obj, root)
+    def bcast(self, obj: Any = None, root: int = 0,
+              algorithm: str | None = None) -> Any:
+        return bcast(self, obj, root, algorithm)
 
     def reduce(self, value: Any, op, root: int = 0) -> Any:
         return reduce(self, value, op, root)
