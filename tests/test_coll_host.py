@@ -354,3 +354,100 @@ class TestBcastPipeline:
             assert all(abs(r - payload.sum()) < 1e-6 for r in res)
         finally:
             mca_var.unset("host_coll_segment")
+
+
+class TestReducePipeline:
+    """Chain-pipelined reduce (coll_base_reduce.c:409 shape)."""
+
+    def test_matches_binomial(self):
+        from zhpe_ompi_tpu.mca import var as mca_var
+        from zhpe_ompi_tpu.pt2pt.universe import LocalUniverse
+
+        mca_var.set_var("host_coll_segment", 128)
+        try:
+            uni = LocalUniverse(4)
+            r = np.random.default_rng(7)
+            data = [r.normal(size=300).astype(np.float64) for _ in range(4)]
+
+            def prog(ctx):
+                got = hcoll.reduce(ctx, data[ctx.rank], zops.SUM, root=1,
+                                   algorithm="pipeline")
+                return None if got is None else np.asarray(got)
+
+            res = uni.run(prog)
+            assert res[0] is None and res[2] is None and res[3] is None
+            np.testing.assert_allclose(res[1], sum(data), rtol=1e-12)
+        finally:
+            mca_var.unset("host_coll_segment")
+
+    def test_non_commutative_rejected(self):
+        from zhpe_ompi_tpu.core import errors
+        from zhpe_ompi_tpu.pt2pt.universe import LocalUniverse
+
+        nc = zops.create_op(lambda a, b: a, commute=False, name="left")
+        uni = LocalUniverse(2)
+
+        def prog(ctx):
+            with pytest.raises(errors.ArgError):
+                hcoll.reduce(ctx, np.ones(4), nc, algorithm="pipeline")
+            return True
+
+        assert uni.run(prog) == [True, True]
+
+    def test_over_sockets(self):
+        from test_tcp import run_tcp
+        from zhpe_ompi_tpu.mca import var as mca_var
+
+        mca_var.set_var("host_coll_segment", 512)
+        try:
+            def prog(p):
+                v = np.full(200, float(p.rank + 1), np.float32)
+                got = hcoll.reduce(p, v, zops.SUM, root=0,
+                                   algorithm="pipeline")
+                return None if got is None else float(np.asarray(got).sum())
+
+            res = run_tcp(3, prog)
+            assert res[0] == 200 * (1 + 2 + 3)
+            assert res[1] is None and res[2] is None
+        finally:
+            mca_var.unset("host_coll_segment")
+
+    def test_segment_skew_is_harmless(self):
+        """Per-rank host_coll_segment disagreement must not desync the
+        chain: the originator's header carries the geometry."""
+        from zhpe_ompi_tpu.mca import var as mca_var
+        from zhpe_ompi_tpu.pt2pt.universe import LocalUniverse
+
+        uni = LocalUniverse(3)
+        data = [np.full(100, float(r), np.float64) for r in range(3)]
+
+        def prog(ctx):
+            # each rank believes a different segment size
+            mca_var.set_var("host_coll_segment", 64 * (ctx.rank + 1))
+            got = hcoll.reduce(ctx, data[ctx.rank], zops.SUM, root=0,
+                               algorithm="pipeline")
+            return None if got is None else np.asarray(got)
+
+        try:
+            res = uni.run(prog)
+            np.testing.assert_allclose(res[0], sum(data))
+        finally:
+            mca_var.unset("host_coll_segment")
+
+    def test_shape_mismatch_raises(self):
+        from zhpe_ompi_tpu.core import errors
+        from zhpe_ompi_tpu.pt2pt.universe import LocalUniverse
+
+        uni = LocalUniverse(2)
+
+        def prog(ctx):
+            v = np.ones(4 if ctx.rank == 0 else 8)
+            try:
+                hcoll.reduce(ctx, v, zops.SUM, root=0,
+                             algorithm="pipeline")
+            except errors.TypeError_:
+                return "raised"
+            return "ok"
+
+        res = uni.run(prog)
+        assert "raised" in res

@@ -108,6 +108,13 @@ mca_var.register(
     type=int,
 )
 mca_var.register(
+    "host_reduce_algorithm", "auto",
+    "Host-plane reduce algorithm: auto (binomial tree; in-order linear "
+    "for non-commutative ops) or pipeline (chain-pipelined segments for "
+    "large commutative array reductions)",
+    enum=("auto", "pipeline"),
+)
+mca_var.register(
     "host_bcast_algorithm", "binomial",
     "Host-plane bcast algorithm: binomial (latency-optimal tree) or "
     "pipeline (chain-pipelined segments, bandwidth-optimal for large "
@@ -227,12 +234,91 @@ def _reduce_linear(ctx, value, op, root, tag):
     return acc
 
 
-def reduce(ctx, value: Any, op, root: int = 0) -> Any:
-    """Reduce to root; binomial tree for commutative ops, in-order linear
-    otherwise.  Result significant at root (others return None)."""
+def _reduce_pipeline(ctx, value, op, root: int):
+    """Chain-pipelined reduce (coll_base_reduce.c:409 pipeline shape):
+    segments flow down a root-rotated chain, each hop combining its own
+    slice before forwarding — bandwidth-optimal for large arrays.
+    Chain combine order is vrank-descending onto ascending, which only
+    equals rank order for commutative ops; callers route non-commutative
+    ops to the in-order variants."""
+    from ..pt2pt.requests import wait_all
+
     size, rank = ctx.size, ctx.rank
+    vrank = (rank - root) % size
+    # chain orientation: segments flow from the far end (vrank size-1)
+    # toward the root (vrank 0)
+    toward_root = (rank - 1) % size
+    away = (rank + 1) % size
+    tag = _next_tag(ctx, TAG_REDUCE)
+    arr = np.ascontiguousarray(value)
+    flat = arr.reshape(-1)
+    if vrank == size - 1:
+        # the stream originator decides the geometry and announces it in
+        # a header (the bcast-pipeline discipline): per-rank
+        # host_coll_segment or dtype skew must not desynchronize the
+        # chain's message counts
+        seg = max(1, int(mca_var.get("host_coll_segment", 64 * 1024)))
+        elems = max(1, -(-seg // max(arr.dtype.itemsize, 1)))
+        nseg = max(1, -(-flat.size // elems))
+        ctx.send((arr.dtype.str, arr.shape, nseg, elems), toward_root,
+                 tag=tag, cid=COLL_CID)
+        reqs = [
+            ctx.isend(flat[i * elems : (i + 1) * elems].copy(),
+                      toward_root, tag=tag, cid=COLL_CID)
+            for i in range(nseg)
+        ]
+        wait_all(reqs)
+        return None
+    dtype_str, shape, nseg, elems = ctx.recv(away, tag=tag, cid=COLL_CID)
+    if tuple(shape) != arr.shape or np.dtype(dtype_str) != arr.dtype:
+        raise errors.TypeError_(
+            f"pipelined reduce: payload mismatch — local "
+            f"{arr.shape}/{arr.dtype} vs chain {tuple(shape)}/{dtype_str} "
+            "(reduce requires congruent arrays on every rank)"
+        )
+    if vrank != 0:
+        ctx.send((dtype_str, shape, nseg, elems), toward_root, tag=tag,
+                 cid=COLL_CID)
+    out = np.empty_like(flat)
+    reqs = []
+    for i in range(nseg):
+        sl = slice(i * elems, (i + 1) * elems)
+        contrib = ctx.recv(away, tag=tag, cid=COLL_CID)
+        # combine own slice with the accumulated higher-vrank slice,
+        # keeping the lower contribution on the left
+        merged = _combine(op, flat[sl], np.asarray(contrib))
+        if vrank == 0:
+            out[sl] = merged
+        else:
+            reqs.append(ctx.isend(merged, toward_root, tag=tag,
+                                  cid=COLL_CID))
+    wait_all(reqs)
+    if vrank != 0:
+        return None
+    return out.reshape(arr.shape)
+
+
+def reduce(ctx, value: Any, op, root: int = 0,
+           algorithm: str | None = None) -> Any:
+    """Reduce to root; binomial tree for commutative ops, in-order linear
+    otherwise; ``algorithm="pipeline"`` selects the chain-pipelined
+    large-array variant (commutative ops + ndarray payloads).  Result
+    significant at root (others return None)."""
+    size, rank = ctx.size, ctx.rank
+    alg = algorithm or mca_var.get("host_reduce_algorithm", "auto")
+    if alg not in ("auto", "pipeline"):
+        raise errors.ArgError(
+            f"unknown reduce algorithm {alg!r} (auto|pipeline)"
+        )
     if size == 1:
         return value
+    if alg == "pipeline":
+        if not getattr(op, "commute", True):
+            raise errors.ArgError(
+                "pipeline reduce requires a commutative op (chain order "
+                "!= rank order); use the default in-order path"
+            )
+        return _reduce_pipeline(ctx, value, op, root)
     tag = _next_tag(ctx, TAG_REDUCE)
     if not getattr(op, "commute", True):
         return _reduce_linear(ctx, value, op, root, tag)
@@ -583,7 +669,7 @@ def reduce_scatter(ctx, values: list, op) -> Any:
     size = ctx.size
     if len(values) != size:
         raise errors.ArgError(f"reduce_scatter needs {size} blocks")
-    reduced = reduce(ctx, values, op, root=0)
+    reduced = reduce(ctx, values, op, root=0, algorithm="auto")
     return scatter(ctx, reduced, root=0)
 
 
@@ -596,8 +682,9 @@ class HostCollectives:
               algorithm: str | None = None) -> Any:
         return bcast(self, obj, root, algorithm)
 
-    def reduce(self, value: Any, op, root: int = 0) -> Any:
-        return reduce(self, value, op, root)
+    def reduce(self, value: Any, op, root: int = 0,
+               algorithm: str | None = None) -> Any:
+        return reduce(self, value, op, root, algorithm)
 
     def allreduce(self, value: Any, op) -> Any:
         return allreduce(self, value, op)

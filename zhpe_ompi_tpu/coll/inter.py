@@ -87,7 +87,7 @@ class InterCollectives:
         REMOTE group's contributions (MPI semantics).  Local intra-reduce
         to the leader, leaders swap, intra-bcast of the remote result."""
         tag = self._inter_tag()
-        mine = self._ctx.reduce(value, op, root=0)
+        mine = self._ctx.reduce(value, op, root=0, algorithm="auto")
         if self.rank == 0:
             self.send(mine, 0, tag=tag)
             theirs = self.recv(source=0, tag=tag)
@@ -123,7 +123,7 @@ class InterCollectives:
             return None
         if not 0 <= root < self.remote_size:
             raise errors.RankError(f"intercomm reduce root {root} invalid")
-        acc = self._ctx.reduce(value, op, root=0)
+        acc = self._ctx.reduce(value, op, root=0, algorithm="auto")
         if self.rank == 0:
             self.send(acc, root, tag=tag)
         return None
