@@ -412,27 +412,35 @@ class TestReducePipeline:
         finally:
             mca_var.unset("host_coll_segment")
 
-    def test_segment_skew_is_harmless(self):
+    def test_segment_skew_is_harmless(self, monkeypatch):
         """Per-rank host_coll_segment disagreement must not desync the
-        chain: the originator's header carries the geometry."""
-        from zhpe_ompi_tpu.mca import var as mca_var
+        chain: only the originator's value matters (header-announced
+        geometry).  TRUE skew via a thread-keyed var override — the MCA
+        registry is process-global, so plain set_var can't skew threads."""
+        import threading
+
         from zhpe_ompi_tpu.pt2pt.universe import LocalUniverse
 
+        per_thread: dict[int, int] = {}
+        real_get = hcoll.mca_var.get
+
+        def skewed_get(name, default=None):
+            if name == "host_coll_segment":
+                return per_thread.get(threading.get_ident(), 64)
+            return real_get(name, default)
+
+        monkeypatch.setattr(hcoll.mca_var, "get", skewed_get)
         uni = LocalUniverse(3)
         data = [np.full(100, float(r), np.float64) for r in range(3)]
 
         def prog(ctx):
-            # each rank believes a different segment size
-            mca_var.set_var("host_coll_segment", 64 * (ctx.rank + 1))
+            per_thread[threading.get_ident()] = 64 * (ctx.rank + 1)
             got = hcoll.reduce(ctx, data[ctx.rank], zops.SUM, root=0,
                                algorithm="pipeline")
             return None if got is None else np.asarray(got)
 
-        try:
-            res = uni.run(prog)
-            np.testing.assert_allclose(res[0], sum(data))
-        finally:
-            mca_var.unset("host_coll_segment")
+        res = uni.run(prog)
+        np.testing.assert_allclose(res[0], sum(data))
 
     def test_shape_mismatch_raises(self):
         from zhpe_ompi_tpu.core import errors
@@ -451,3 +459,25 @@ class TestReducePipeline:
 
         res = uni.run(prog)
         assert "raised" in res
+
+    def test_middle_rank_mismatch_poisons_chain(self):
+        """A congruence failure at an INTERMEDIATE rank must raise on it
+        AND every downstream rank (err-header propagation) instead of
+        deadlocking the root in a header recv."""
+        from zhpe_ompi_tpu.core import errors
+        from zhpe_ompi_tpu.pt2pt.universe import LocalUniverse
+
+        uni = LocalUniverse(3)
+
+        def prog(ctx):
+            n = 8 if ctx.rank != 1 else 4  # rank 1 (middle) mismatches
+            try:
+                got = hcoll.reduce(ctx, np.ones(n), zops.SUM, root=0,
+                                   algorithm="pipeline")
+            except errors.TypeError_:
+                return "raised"
+            return "ok" if got is None or got is not None else "?"
+
+        res = uni.run(prog, timeout=30.0)
+        # originator (rank 2) completes; middle and root both raise
+        assert res[1] == "raised" and res[0] == "raised"

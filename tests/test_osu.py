@@ -1,0 +1,29 @@
+"""OSU harness smoke tests: each sweep flavor produces sane rows on tiny
+ladders (the perf harness itself must not rot)."""
+
+import numpy as np
+
+from benchmarks import osu_zmpi
+
+
+def _check(rows, op):
+    assert rows, "no rows"
+    for r in rows:
+        assert r["op"] == op
+        assert r["bytes"] > 0
+        assert r["latency_us"] > 0
+        assert np.isfinite(r["bandwidth_MBps"])
+
+
+def test_pt2pt_rows():
+    _check(osu_zmpi.bench_pt2pt(max_size=64, iters=3), "pt2pt_pingpong")
+
+
+def test_tcp_rows():
+    _check(osu_zmpi.bench_tcp(max_size=64, iters=3), "tcp_pingpong")
+
+
+def test_sizes_ladder():
+    s = osu_zmpi._sizes(4096)
+    assert s[0] == 4 and s[-1] == 4096
+    assert all(b == a * 4 for a, b in zip(s, s[1:]))

@@ -260,8 +260,8 @@ def _reduce_pipeline(ctx, value, op, root: int):
         seg = max(1, int(mca_var.get("host_coll_segment", 64 * 1024)))
         elems = max(1, -(-seg // max(arr.dtype.itemsize, 1)))
         nseg = max(1, -(-flat.size // elems))
-        ctx.send((arr.dtype.str, arr.shape, nseg, elems), toward_root,
-                 tag=tag, cid=COLL_CID)
+        ctx.send(("hdr", arr.dtype.str, arr.shape, nseg, elems),
+                 toward_root, tag=tag, cid=COLL_CID)
         reqs = [
             ctx.isend(flat[i * elems : (i + 1) * elems].copy(),
                       toward_root, tag=tag, cid=COLL_CID)
@@ -269,17 +269,31 @@ def _reduce_pipeline(ctx, value, op, root: int):
         ]
         wait_all(reqs)
         return None
-    dtype_str, shape, nseg, elems = ctx.recv(away, tag=tag, cid=COLL_CID)
+    header = ctx.recv(away, tag=tag, cid=COLL_CID)
+    if header[0] == "err":
+        # upstream congruence failure: poison the rest of the chain so
+        # every downstream rank raises instead of blocking on segments
+        # that will never come
+        if vrank != 0:
+            ctx.send(header, toward_root, tag=tag, cid=COLL_CID)
+        raise errors.TypeError_(f"pipelined reduce: {header[1]}")
+    _hdr, dtype_str, shape, nseg, elems = header
     if tuple(shape) != arr.shape or np.dtype(dtype_str) != arr.dtype:
-        raise errors.TypeError_(
-            f"pipelined reduce: payload mismatch — local "
-            f"{arr.shape}/{arr.dtype} vs chain {tuple(shape)}/{dtype_str} "
-            "(reduce requires congruent arrays on every rank)"
+        reason = (
+            f"payload mismatch — local {arr.shape}/{arr.dtype} vs chain "
+            f"{tuple(shape)}/{dtype_str} (reduce requires congruent "
+            "arrays on every rank)"
         )
+        if vrank != 0:
+            ctx.send(("err", reason), toward_root, tag=tag, cid=COLL_CID)
+        # NOTE: ranks upstream of this one (toward the originator) may
+        # still block in their segment sends until timeout — an
+        # erroneous program; the err header bounds the damage downstream
+        raise errors.TypeError_(f"pipelined reduce: {reason}")
     if vrank != 0:
-        ctx.send((dtype_str, shape, nseg, elems), toward_root, tag=tag,
-                 cid=COLL_CID)
-    out = np.empty_like(flat)
+        ctx.send(header, toward_root, tag=tag, cid=COLL_CID)
+    # only the root materializes a result buffer; intermediates forward
+    out = np.empty_like(flat) if vrank == 0 else None
     reqs = []
     for i in range(nseg):
         sl = slice(i * elems, (i + 1) * elems)
