@@ -34,6 +34,11 @@ def _load_everything():
     fs_framework()
     fbtl_framework()
     fcoll_framework()
+    from ..shmem.spml import spml_framework
+
+    spml_framework()
+    from ..coll import host  # registers host_coll_* vars  # noqa: F401
+    from ..pt2pt import tcp  # registers tcp_* vars  # noqa: F401
     from ..pt2pt import universe  # registers pt2pt vars  # noqa: F401
     from ..parallel import mesh  # registers rte vars  # noqa: F401
     from ..coll import monitoring  # registers monitoring vars  # noqa: F401
