@@ -35,6 +35,18 @@ discarded burn-in window per path, median (not best) over the remaining
 windows, and vs_baseline = median of adjacent-pair ratios — drift-robust
 and centered at 1.00.  The same transient inflated the round-2 headline
 throughput/MFU ~10%; round-3 numbers are steady-state honest.
+
+Why this model shape caps below 45% MFU (round-3 item 6 analysis): the
+batch/remat sweep (benchmarks/mfu_sweep.py) plateaus at 38-39% for
+B in [16, 64], remat on or off, so the cap is shape-driven, not
+batch-starvation.  At d_model 1024 every matmul reduces over K=1024 —
+short relative to the MXU pipeline — and between matmuls sit
+HBM-bound segments XLA cannot fuse away (f32 layernorms, residual adds,
+the f32 (B,S,V) logit/lse pass = 13% of FLOPs at 8k vocab but run at
+bandwidth, not MXU, rate).  The levers that would move it (wider
+d_model, fused-norm Pallas kernels, bf16 lse) change the model
+definition, not the framework — the framework layer itself costs
+nothing (vs_baseline 1.00 vs hand-written JAX, identical HLO).
 """
 
 import json
@@ -92,12 +104,16 @@ def main():
 
     on_tpu = devs[0].platform not in ("cpu",)
     if on_tpu:
+        # batch 16 + remat: the measured MFU optimum of the round-3
+        # batch/remat sweep (benchmarks/mfu_sweep.py: B8 36.1%, B16+remat
+        # 38.9%, plateau ~38% through B64 — see the docstring's cap
+        # analysis)
         cfg = tfm.Config(
             vocab=8192, d_model=1024, n_heads=16, d_ff=4096, n_layers=4,
-            seq=512, dtype=jnp.bfloat16,
+            seq=512, dtype=jnp.bfloat16, remat=True,
         )
-        batch = 8 * dp
-        iters = 20
+        batch = 16 * dp
+        iters = 12
     else:
         cfg = tfm.Config(
             vocab=256, d_model=128, n_heads=8, d_ff=512, n_layers=2,

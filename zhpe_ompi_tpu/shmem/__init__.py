@@ -11,9 +11,13 @@ host plane:
   allocation sequence on every PE yields the same offsets, which is the
   entire symmetric-heap contract (``oshmem/mca/memheap``).
 - :mod:`.api` — the PE-facing API (put/get/p/g, AMOs, wait_until, locks,
-  broadcast/collect/reductions, barrier), one object per PE over the
-  thread-rank universe — the analog of ``oshmem/shmem/c``'s 56 files over
-  spml/scoll.
+  broadcast/collect/reductions, barrier) — the analog of
+  ``oshmem/shmem/c``'s 56 files over spml/scoll.
+- :mod:`.spml` — the transport framework as REAL MCA components with
+  priority selection: ``direct`` (thread ranks, shared address space),
+  ``mmap`` (same-host OS processes over mapped tmpfs segments with
+  native atomics, :mod:`.segment`), ``am`` (cross-host active messages).
+  :func:`shmem_pe` is the spml-selected shmem_init.
 
 On the device plane, symmetric objects are simply replicated/sharded jax
 arrays and put/get lower to the same ``ppermute``/collective machinery as
@@ -22,5 +26,11 @@ separate device transport exists (documented design decision, not an
 omission).
 """
 
-from .api import ShmemPE, shmem_universe  # noqa: F401
+from .api import (  # noqa: F401
+    ShmemPE,
+    shmem_mapped_pe,
+    shmem_universe,
+    shmem_wire_pe,
+)
 from .memheap import SymmetricHeapAllocator  # noqa: F401
+from .spml import shmem_pe  # noqa: F401
