@@ -168,3 +168,31 @@ class TestTcpNonblocking:
             return float(r.wait()[0])
 
         assert run_tcp(4, prog) == [10.0] * 4
+
+
+class TestBlockingNeighbor:
+    """MPI_Neighbor_allgather/alltoall (blocking): the nbc schedule run
+    to completion on the host plane."""
+
+    def test_neighbor_ring(self):
+        from zhpe_ompi_tpu.pt2pt.universe import LocalUniverse
+
+        uni = LocalUniverse(4)
+
+        def prog(ctx):
+            left, right = (ctx.rank - 1) % 4, (ctx.rank + 1) % 4
+            got = ctx.neighbor_allgather(
+                ctx.rank * 10, sources=[left, right],
+                destinations=[left, right],
+            )
+            a2a = ctx.neighbor_alltoall(
+                [f"to{left}", f"to{right}"], sources=[left, right],
+                destinations=[left, right],
+            )
+            return got, a2a
+
+        res = uni.run(prog)
+        for r in range(4):
+            left, right = (r - 1) % 4, (r + 1) % 4
+            assert res[r][0] == [left * 10, right * 10]
+            assert res[r][1] == [f"to{r}", f"to{r}"]

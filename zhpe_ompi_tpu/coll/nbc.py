@@ -641,3 +641,16 @@ class NonblockingCollectives:
     def ineighbor_alltoall(self, values: list, sources: list[int],
                            destinations: list[int]) -> SchedRequest:
         return ineighbor_alltoall(self, values, sources, destinations)
+
+    # blocking neighbor collectives (MPI_Neighbor_allgather/alltoall):
+    # the schedule run to completion — same layering the reference gets
+    # from nbc_ineighbor_* + wait
+    def neighbor_allgather(self, value: Any, sources: list[int],
+                           destinations: list[int]) -> list:
+        return ineighbor_allgather(self, value, sources,
+                                   destinations).wait()
+
+    def neighbor_alltoall(self, values: list, sources: list[int],
+                          destinations: list[int]) -> list:
+        return ineighbor_alltoall(self, values, sources,
+                                  destinations).wait()
