@@ -287,3 +287,20 @@ class TestDarray:
                 4, 0, [8], [derived.DISTRIBUTE_BLOCK], [-1], [3],
                 predefined.FLOAT,
             )
+
+
+def test_hindexed_block_matches_hindexed():
+    from zhpe_ompi_tpu.datatype import (
+        INT32_T,
+        create_hindexed,
+        create_hindexed_block,
+    )
+    from zhpe_ompi_tpu.datatype import convertor
+
+    a = create_hindexed_block(2, [0, 24, 48], INT32_T)
+    b = create_hindexed([2, 2, 2], [0, 24, 48], INT32_T)
+    src = np.arange(20, dtype=np.int32)
+    pa = convertor.pack(src, a, 1)
+    pb = convertor.pack(src, b, 1)
+    assert bytes(pa) == bytes(pb)
+    assert a.size == 24 and a.extent == b.extent

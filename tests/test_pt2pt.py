@@ -263,3 +263,40 @@ class TestUniverse:
             return np.asarray(ctx.recv(source=0)).tolist()
 
         assert uni.run(main)[1] == [0.0, 1.0, 2.0, 3.0]
+
+
+class TestGetCount:
+    """MPI_Get_count semantics over received payloads."""
+
+    def test_count_from_array_payload(self):
+        from zhpe_ompi_tpu.datatype import INT32_T
+        from zhpe_ompi_tpu.pt2pt.requests import get_count
+        from zhpe_ompi_tpu.pt2pt.universe import LocalUniverse
+
+        uni = LocalUniverse(2)
+
+        def prog(ctx):
+            if ctx.rank == 0:
+                ctx.send(np.arange(6, dtype=np.int32), dest=1, tag=3)
+                return None
+            val, st = ctx.recv(source=0, tag=3, return_status=True)
+            assert st.source == 0 and st.tag == 3
+            assert st.count_bytes == 24
+            assert get_count(st, INT32_T) == 6
+            return True
+
+        assert uni.run(prog)[1] is True
+
+    def test_undefined_for_object_and_partial(self):
+        from zhpe_ompi_tpu.datatype import INT32_T, create_contiguous
+        from zhpe_ompi_tpu.pt2pt.requests import (
+            Status,
+            UNDEFINED,
+            get_count,
+        )
+
+        assert get_count(Status(count_bytes=-1), INT32_T) == UNDEFINED
+        # 10 bytes is not a whole number of 8-byte elements
+        t = create_contiguous(2, INT32_T)
+        assert get_count(Status(count_bytes=10), t) == UNDEFINED
+        assert get_count(Status(count_bytes=16), t) == 2

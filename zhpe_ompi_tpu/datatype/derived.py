@@ -201,6 +201,16 @@ def create_indexed_block(
     return create_indexed([blocklength] * len(displacements), displacements, oldtype)
 
 
+def create_hindexed_block(
+    blocklength: int, byte_displacements: list[int], oldtype: Datatype
+) -> DerivedDatatype:
+    """MPI_Type_create_hindexed_block: equal-length blocks at byte
+    displacements."""
+    return create_hindexed(
+        [blocklength] * len(byte_displacements), byte_displacements, oldtype
+    )
+
+
 def create_struct(
     blocklengths: list[int],
     byte_displacements: list[int],

@@ -17,12 +17,43 @@ from ..core import errors
 
 @dataclass
 class Status:
-    """MPI_Status analog."""
+    """MPI_Status analog.  ``count_bytes`` is the received payload size
+    (array/bytes payloads; -1 when unsized), feeding :func:`get_count`."""
 
     source: int = -1
     tag: int = -1
     error: int = 0
     cancelled: bool = False
+    count_bytes: int = -1
+
+
+UNDEFINED = -1  # MPI_UNDEFINED
+
+
+def get_count(status: Status, datatype) -> int:
+    """MPI_Get_count: whole elements of `datatype` in the message;
+    UNDEFINED when the byte count is unknown or not a whole multiple
+    (mpi-standard semantics)."""
+    size = getattr(datatype, "size", 0)
+    if status.count_bytes < 0:
+        return UNDEFINED
+    if size <= 0:
+        # MPI: zero-size datatype receives 0 elements of a 0-byte
+        # message; anything else is not a whole count
+        return 0 if status.count_bytes == 0 else UNDEFINED
+    if status.count_bytes % size:
+        return UNDEFINED
+    return status.count_bytes // size
+
+
+def _payload_bytes(value) -> int:
+    """Byte size of a received payload, -1 for unsized Python objects."""
+    nbytes = getattr(value, "nbytes", None)
+    if nbytes is not None:  # ndarray AND memoryview land here
+        return int(nbytes)
+    if isinstance(value, (bytes, bytearray)):
+        return len(value)
+    return -1
 
 
 class Request:
@@ -43,6 +74,7 @@ class Request:
         self._value = value
         self.status.source = source
         self.status.tag = tag
+        self.status.count_bytes = _payload_bytes(value)
         self._done.set()
 
     # -- user side --------------------------------------------------------

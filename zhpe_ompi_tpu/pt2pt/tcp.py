@@ -580,10 +580,13 @@ class TcpProc(HostCollectives, NonblockingCollectives):
                     f"; unexpected={unexpected}; posted={posted}"
                 )
         if return_status:
-            from .requests import Status
+            from .requests import Status, _payload_bytes
 
             env = envs[0]
-            return result[0], Status(source=env.src, tag=env.tag)
+            return result[0], Status(
+                source=env.src, tag=env.tag,
+                count_bytes=_payload_bytes(result[0]),
+            )
         return result[0]
 
     def probe(self, source: int = ANY_SOURCE, tag: int = ANY_TAG,
