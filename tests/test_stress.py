@@ -1,0 +1,67 @@
+"""Concurrency soak: overlapping nonblocking collectives, RMA, and
+pt2pt traffic over real sockets — the schedule-interleaving torture the
+per-instance tag discipline exists for."""
+
+import numpy as np
+
+from test_tcp import run_tcp
+from zhpe_ompi_tpu import ops as zops
+
+N = 4
+ROUNDS = 12
+
+
+class TestOverlapSoak:
+    def test_overlapping_nonblocking_collectives(self):
+        def prog(p):
+            rng = np.random.default_rng(100 + p.rank)
+            for it in range(ROUNDS):
+                a = p.iallreduce(float(p.rank + it), zops.SUM)
+                b = p.iallgather((p.rank, it))
+                c = p.ibcast(f"r{it}" if p.rank == it % N else None,
+                             root=it % N)
+                d = p.ialltoall([(p.rank, dst, it) for dst in range(N)])
+                # complete intentionally out of issue order
+                got_d = d.wait()
+                got_b = b.wait()
+                got_a = a.wait()
+                got_c = c.wait()
+                assert got_a == sum(r + it for r in range(N))
+                assert got_b == [(r, it) for r in range(N)]
+                assert got_c == f"r{it}"
+                assert got_d == [(src, p.rank, it) for src in range(N)]
+            return True
+
+        assert run_tcp(N, prog, timeout=120.0) == [True] * N
+
+    def test_collectives_interleaved_with_pt2pt_and_rma(self):
+        from zhpe_ompi_tpu.osc.am import AmWindow
+
+        def prog(p):
+            win = AmWindow.create(p, np.zeros(N, np.float64))
+            for it in range(ROUNDS):
+                req = p.iallreduce(1, zops.SUM)
+                # pt2pt ring exchange while the collective is in flight
+                nxt, prv = (p.rank + 1) % N, (p.rank - 1) % N
+                p.send((p.rank, it), nxt, tag=0x600 + it)
+                got = p.recv(source=prv, tag=0x600 + it)
+                assert got == (prv, it)
+                # one-sided accumulate into the neighbor's window slot
+                win.lock(nxt)
+                win.accumulate(np.asarray([1.0]), nxt,
+                               offset=p.rank, op=zops.SUM)
+                win.unlock(nxt)
+                assert req.wait() == N
+            # unlock already completed every op at the target; one
+            # barrier orders all ranks' epochs before the read-back
+            p.barrier()
+            local = win.local_buffer.tolist()
+            win.free()
+            return local
+
+        res = run_tcp(N, prog, timeout=120.0)
+        for r in range(N):
+            # neighbor (r-1) accumulated ROUNDS ones into slot (r-1)
+            want = [0.0] * N
+            want[(r - 1) % N] = float(ROUNDS)
+            assert res[r] == want, (r, res[r])
