@@ -1,4 +1,5 @@
-"""Mesh construction and sharding helpers (the wire-up plane)."""
-from . import mesh
+"""Mesh construction and sharding helpers (the wire-up plane), plus the
+hierarchical ICI-inside/DCN-outside data-parallel layer (hybrid)."""
+from . import hybrid, mesh
 
-__all__ = ["mesh"]
+__all__ = ["mesh", "hybrid"]
