@@ -200,30 +200,14 @@ def loss_fn(params, tokens, targets, cfg: Config, tp_comm=None, sp_comm=None):
     return jnp.mean(lse - tl)
 
 
-def make_train_step(cfg: Config, mesh, dp_comm, tp_comm, sp_comm=None,
-                    lr: float = 1e-2):
-    """Build the jitted SPMD training step over dp x tp (x sp).
+# Parameters replicated over tp (everything else is tp-sharded).
+_TP_REPLICATED = frozenset({"embed", "lnf", "ln1", "ln2"})
 
-    Gradient synchronization semantics (verified in tests against a
-    single-device run):
-      - tp-sharded params (wqkv/wo/w1/w2): their grads are tp-local already;
-        average over dp only.
-      - replicated-over-tp params (embed/ln): with the f/g wrappers each tp
-        rank holds the full tp-summed gradient; a tp-mean makes the update
-        bitwise-identical across tp ranks.
-      - sp: every rank sees only its sequence block, so EVERY param's grad
-        is partial over sp — sp-mean them all (the global loss is a mean
-        over tokens, and dp-mean x sp-mean composes to the global mean).
-    All syncs go through the framework's allreduce.
-    """
-    from jax.sharding import NamedSharding, PartitionSpec as P
 
-    dp = mesh.shape[dp_comm.axis]
-    tp = mesh.shape[tp_comm.axis] if tp_comm is not None else 1
-    sp = mesh.shape[sp_comm.axis] if sp_comm is not None else 1
+def _param_specs(tp_ax):
+    from jax.sharding import PartitionSpec as P
 
-    tp_ax = tp_comm.axis if tp_comm is not None else None
-    param_specs = {
+    return {
         "embed": P(), "lnf": P(),
         "wqkv": P(None, None, None, tp_ax),
         "wo": P(None, tp_ax, None),
@@ -232,26 +216,56 @@ def make_train_step(cfg: Config, mesh, dp_comm, tp_comm, sp_comm=None,
         "ln1": P(), "ln2": P(),
     }
 
-    replicated = {"embed", "lnf", "ln1", "ln2"}
+
+def _sync_grads(grads, loss, dp_comm, tp_comm, sp_comm, dp, tp, sp):
+    """The gradient synchronization semantics (verified in tests against
+    a single-device run) — ONE home for both train-step builders:
+      - tp-sharded params (wqkv/wo/w1/w2): their grads are tp-local
+        already; average over dp only.
+      - replicated-over-tp params (embed/ln): with the f/g wrappers each
+        tp rank holds the full tp-summed gradient; a tp-mean makes the
+        update bitwise-identical across tp ranks.
+      - sp: every rank sees only its sequence block, so EVERY param's
+        grad is partial over sp — sp-mean them all (the global loss is a
+        mean over tokens; dp-mean x sp-mean composes to the global mean).
+    All syncs go through the framework's allreduce."""
+    synced = {}
+    for name, g in grads.items():
+        g = dp_comm.allreduce(g, zops.SUM) / dp
+        if sp_comm is not None:
+            g = sp_comm.allreduce(g, zops.SUM) / sp
+        if name in _TP_REPLICATED and tp_comm is not None:
+            g = tp_comm.allreduce(g, zops.SUM) / tp
+        synced[name] = g
+    loss = dp_comm.allreduce(loss, zops.SUM) / dp
+    if sp_comm is not None:
+        loss = sp_comm.allreduce(loss, zops.SUM) / sp
+    if tp_comm is not None:
+        loss = tp_comm.allreduce(loss, zops.SUM) / tp
+    return synced, loss
+
+
+def make_train_step(cfg: Config, mesh, dp_comm, tp_comm, sp_comm=None,
+                    lr: float = 1e-2):
+    """Build the jitted SPMD training step over dp x tp (x sp): one
+    fused shard_map program — grads, sync (see :func:`_sync_grads`),
+    and the SGD update in a single jit (the structure bench.py's
+    HLO-parity comparison against plain JAX relies on)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    dp = mesh.shape[dp_comm.axis]
+    tp = mesh.shape[tp_comm.axis] if tp_comm is not None else 1
+    sp = mesh.shape[sp_comm.axis] if sp_comm is not None else 1
+    param_specs = _param_specs(tp_comm.axis if tp_comm is not None else None)
 
     def spmd_step(params, tokens, targets):
         def local_loss(p):
             return loss_fn(p, tokens, targets, cfg, tp_comm, sp_comm)
 
         loss, grads = jax.value_and_grad(local_loss)(params)
-        synced = {}
-        for name, g in grads.items():
-            g = dp_comm.allreduce(g, zops.SUM) / dp
-            if sp_comm is not None:
-                g = sp_comm.allreduce(g, zops.SUM) / sp
-            if name in replicated and tp_comm is not None:
-                g = tp_comm.allreduce(g, zops.SUM) / tp
-            synced[name] = g
-        loss = dp_comm.allreduce(loss, zops.SUM) / dp
-        if sp_comm is not None:
-            loss = sp_comm.allreduce(loss, zops.SUM) / sp
-        if tp_comm is not None:
-            loss = tp_comm.allreduce(loss, zops.SUM) / tp
+        synced, loss = _sync_grads(
+            grads, loss, dp_comm, tp_comm, sp_comm, dp, tp, sp
+        )
         new_params = jax.tree.map(
             lambda p, g: (p - lr * g).astype(p.dtype), params, synced
         )
@@ -269,3 +283,73 @@ def make_train_step(cfg: Config, mesh, dp_comm, tp_comm, sp_comm=None,
         )
     )
     return step, param_specs
+
+
+def make_train_step_optax(cfg: Config, mesh, dp_comm, tp_comm,
+                          sp_comm=None, optimizer=None):
+    """Stateful-optimizer training step: the framework's SPMD grad
+    computation composed with any optax GradientTransformation.
+
+    The gradient pass is the same shard_map program ``make_train_step``
+    builds (framework allreduces on the dp/tp/sp axes); the optimizer
+    update runs in a second jit whose optimizer-state shardings follow
+    from the gradient/parameter shardings by XLA propagation — Adam
+    moments land sharded exactly like their parameters with no
+    hand-written state specs.
+
+    Returns ``(init_opt_state, step, param_specs)``: ``step(params,
+    opt_state, tokens, targets) -> (params, opt_state, loss)``."""
+    import optax
+
+    if optimizer is None:
+        optimizer = optax.adam(1e-3)
+
+    from jax.sharding import PartitionSpec as P
+
+    dp = mesh.shape[dp_comm.axis]
+    tp = mesh.shape[tp_comm.axis] if tp_comm is not None else 1
+    sp = mesh.shape[sp_comm.axis] if sp_comm is not None else 1
+    param_specs = _param_specs(tp_comm.axis if tp_comm is not None else None)
+
+    def spmd_grads(params, tokens, targets):
+        def local_loss(p):
+            return loss_fn(p, tokens, targets, cfg, tp_comm, sp_comm)
+
+        loss, grads = jax.value_and_grad(local_loss)(params)
+        return _sync_grads(
+            grads, loss, dp_comm, tp_comm, sp_comm, dp, tp, sp
+        )
+
+    sp_ax = sp_comm.axis if sp_comm is not None else None
+    data_spec = P(dp_comm.axis, sp_ax)
+    grad_step = jax.jit(
+        jax.shard_map(
+            spmd_grads, mesh=mesh,
+            in_specs=(param_specs, data_spec, data_spec),
+            out_specs=(param_specs, P()),
+            check_vma=False,
+        )
+    )
+
+    init_opt_state = jax.jit(optimizer.init)
+
+    def _apply(params, opt_state, grads):
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        new_params = optax.apply_updates(params, updates)
+        # preserve storage dtype (apply_updates upcasts mixed dtypes)
+        new_params = jax.tree.map(
+            lambda new, old: new.astype(old.dtype), new_params, params
+        )
+        return new_params, opt_state
+
+    # donate the old params + optimizer state: callers thread both
+    # through step() and never reuse them, so the update is in-place at
+    # the XLA level instead of holding 2x params + both moment trees
+    apply = jax.jit(_apply, donate_argnums=(0, 1))
+
+    def step(params, opt_state, tokens, targets):
+        grads, loss = grad_step(params, tokens, targets)
+        new_params, opt_state = apply(params, opt_state, grads)
+        return new_params, opt_state, loss
+
+    return init_opt_state, step, param_specs
