@@ -180,3 +180,86 @@ def test_single_slice_returns_numpy_leaves():
 
     got = hybrid.dcn_grad_sync(proc, {"w": jnp.ones(3, jnp.float32)})
     assert isinstance(got["w"], np.ndarray)
+
+
+def test_multislice_adam_matches_full_batch(tmp_path):
+    """The full composition: 2 launcher slices each run the optax train
+    step with dcn_proc set; after 2 steps their params must match a
+    single-process full-batch Adam run."""
+    prog = tmp_path / "adam_slice.py"
+    prog.write_text(textwrap.dedent(f"""
+        import os, sys
+        sys.path.insert(0, {_REPO!r})
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+        os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+        import numpy as np
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        import jax.numpy as jnp
+        import optax
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        import zhpe_ompi_tpu as zmpi
+        from zhpe_ompi_tpu.models import transformer as tfm
+
+        proc = zmpi.host_init()
+        cfg = tfm.Config(vocab=64, d_model=16, n_heads=2, d_ff=32,
+                         n_layers=2, seq=8, dtype=jnp.float32)
+        mesh = Mesh(np.asarray(jax.devices()[:1]).reshape(1, 1),
+                    ("dp", "tp"))
+        dpc = zmpi.Communicator(mesh, "dp")
+        init_state, step, specs = tfm.make_train_step_optax(
+            cfg, mesh, dpc, None, optimizer=optax.adam(1e-2),
+            dcn_proc=proc)
+        params = {{k: jax.device_put(np.asarray(v),
+                                     NamedSharding(mesh, specs[k]))
+                   for k, v in tfm.init_params(
+                       cfg, jax.random.PRNGKey(0)).items()}}
+        st = init_state(params)
+        r = np.random.default_rng(0)
+        tok = r.integers(0, cfg.vocab, (8, cfg.seq))
+        tgt = r.integers(0, cfg.vocab, (8, cfg.seq))
+        lo = proc.rank * 4
+        ds = NamedSharding(mesh, P("dp"))
+        mtok = jax.device_put(jnp.asarray(tok[lo:lo+4]), ds)
+        mtgt = jax.device_put(jnp.asarray(tgt[lo:lo+4]), ds)
+        for _ in range(2):
+            params, st, loss = step(params, st, mtok, mtgt)
+        if proc.rank == 0:
+            np.savez(os.path.join({str(tmp_path)!r}, "slice_params.npz"),
+                     **{{k: np.asarray(v) for k, v in params.items()}})
+            print("ADAM-SLICES-DONE")
+        proc.barrier()
+        zmpi.host_finalize()
+    """))
+    out, err = io.StringIO(), io.StringIO()
+    rc = mpirun.launch(2, [str(prog)], stdout=out, stderr=err,
+                       timeout=240.0)
+    assert rc == 0, err.getvalue()
+    assert "ADAM-SLICES-DONE" in out.getvalue()
+
+    # single-process full-batch reference
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from zhpe_ompi_tpu.models import transformer as tfm
+
+    cfg = tfm.Config(vocab=64, d_model=16, n_heads=2, d_ff=32,
+                     n_layers=2, seq=8, dtype=jnp.float32)
+    params = tfm.init_params(cfg, jax.random.PRNGKey(0))
+    opt = optax.adam(1e-2)
+    st = opt.init(params)
+    r = np.random.default_rng(0)
+    tok = jnp.asarray(r.integers(0, cfg.vocab, (8, cfg.seq)))
+    tgt = jnp.asarray(r.integers(0, cfg.vocab, (8, cfg.seq)))
+    for _ in range(2):
+        grads = jax.grad(lambda p: tfm.loss_fn(p, tok, tgt, cfg))(params)
+        upd, st = opt.update(grads, st, params)
+        params = optax.apply_updates(params, upd)
+
+    got = np.load(os.path.join(str(tmp_path), "slice_params.npz"))
+    for k, v in params.items():
+        np.testing.assert_allclose(got[k], np.asarray(v),
+                                   rtol=5e-5, atol=5e-6, err_msg=k)

@@ -286,7 +286,8 @@ def make_train_step(cfg: Config, mesh, dp_comm, tp_comm, sp_comm=None,
 
 
 def make_train_step_optax(cfg: Config, mesh, dp_comm, tp_comm,
-                          sp_comm=None, optimizer=None):
+                          sp_comm=None, optimizer=None, dcn_proc=None,
+                          dcn_weight: float | None = None):
     """Stateful-optimizer training step: the framework's SPMD grad
     computation composed with any optax GradientTransformation.
 
@@ -296,6 +297,15 @@ def make_train_step_optax(cfg: Config, mesh, dp_comm, tp_comm,
     from the gradient/parameter shardings by XLA propagation — Adam
     moments land sharded exactly like their parameters with no
     hand-written state specs.
+
+    ``dcn_proc``: a host-plane endpoint (TcpProc from ``host_init``)
+    makes this a MULTI-SLICE step — the in-mesh-synced gradients are
+    additionally allreduce-meaned across launcher slices
+    (:func:`zhpe_ompi_tpu.parallel.hybrid.dcn_grad_sync`) between the
+    two jits, the ICI-inside/DCN-outside composition.  The loss scalar
+    rides the same bucketed sync (no extra per-step DCN round trip).
+    ``dcn_weight``: this slice's fraction of the global batch when
+    slices carry unequal batches (default: equal, 1/size).
 
     Returns ``(init_opt_state, step, param_specs)``: ``step(params,
     opt_state, tokens, targets) -> (params, opt_state, loss)``."""
@@ -349,6 +359,18 @@ def make_train_step_optax(cfg: Config, mesh, dp_comm, tp_comm,
 
     def step(params, opt_state, tokens, targets):
         grads, loss = grad_step(params, tokens, targets)
+        if dcn_proc is not None and dcn_proc.size > 1:
+            from ..parallel import hybrid
+
+            bundle = hybrid.dcn_grad_sync(
+                dcn_proc,
+                {"grads": grads, "loss": np.asarray(loss, np.float32)},
+                weight=dcn_weight,
+            )
+            grads = bundle["grads"]
+            # keep the return contract uniform across modes: loss is
+            # always a jax scalar
+            loss = jnp.asarray(bundle["loss"])
         new_params, opt_state = apply(params, opt_state, grads)
         return new_params, opt_state, loss
 
