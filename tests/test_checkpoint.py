@@ -183,6 +183,21 @@ class TestCheckpointCli:
         assert cli.main(["list", str(tmp_path)]) == 0
         assert "latest: 3" in capsys.readouterr().out
 
+    def test_prune_keep_zero_drops_all(self, tmp_path, capsys):
+        """Round-4 advisor fix: --keep 0 prunes everything (it used to be
+        a silent no-op); negative --keep is rejected."""
+        import pytest
+
+        from zhpe_ompi_tpu.tools import checkpoint as cli
+
+        self._make(tmp_path)
+        assert cli.main(["prune", str(tmp_path), "--keep", "0"]) == 0
+        out = capsys.readouterr().out
+        for s in (1, 2, 3):
+            assert f"pruned step {s}" in out
+        with pytest.raises(SystemExit):
+            cli.main(["prune", str(tmp_path), "--keep", "-1"])
+
     def test_list_empty_dir(self, tmp_path):
         from zhpe_ompi_tpu.tools import checkpoint as cli
 

@@ -16,8 +16,15 @@ def _run_example(name: str) -> None:
     path = os.path.join(_EXAMPLES, f"{name}.py")
     spec = importlib.util.spec_from_file_location(f"examples.{name}", path)
     mod = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(mod)
-    mod.main()
+    # Register before exec: spawn-based dpm pickles module-level targets
+    # by reference, which requires the defining module in sys.modules
+    # (the child re-imports it as a namespace-package module).
+    sys.modules[spec.name] = mod
+    try:
+        spec.loader.exec_module(mod)
+        mod.main()
+    finally:
+        sys.modules.pop(spec.name, None)
 
 
 @pytest.mark.parametrize("name", [

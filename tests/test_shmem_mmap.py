@@ -91,6 +91,31 @@ class TestMappedThreads:
         res = run_mapped(N, prog)
         assert res[0] == [7.25, 3.0]
 
+    def test_amo_index_bounds_checked(self):
+        """Round-4 advisor fix: the native AMO path computes a raw address
+        from the index — out-of-range (incl. negative) must raise, never
+        touch memory outside the symmetric array."""
+        from zhpe_ompi_tpu.core import errors
+
+        def prog(pe):
+            sym = pe.shmalloc(4, np.int64)
+            pe.local(sym)[...] = 0
+            pe.barrier_all()
+            for bad in (-1, 4, 1000):
+                try:
+                    pe.atomic_add(sym, 1, 0, index=bad)
+                    caught = False
+                except errors.ArgError:
+                    caught = True
+                assert caught, f"index {bad} accepted"
+            pe.barrier_all()
+            out = int(pe.local(sym)[0])
+            pe.shfree(sym)
+            return out
+
+        res = run_mapped(2, prog)
+        assert res[0] == 0  # nothing landed
+
     def test_strided_iput_iget(self):
         def prog(pe):
             sym = pe.shmalloc(8, np.int32)

@@ -267,18 +267,29 @@ class SpawnHandle:
 
 
 def spawn(proc: TcpProc, target: Callable, n_children: int,
-          timeout: float = 30.0, info=None, method: str = "fork"
+          timeout: float = 30.0, info=None, method: str = "spawn"
           ) -> tuple[TcpIntercomm, SpawnHandle]:
     """MPI_Comm_spawn over real processes — collective over the parent
-    group.  Forks `n_children` OS processes running
+    group.  Launches `n_children` OS processes running
     ``target(child_proc, parent_intercomm)``, wires them into their own
     TcpProc universe, and returns the parent↔child intercommunicator plus
     a supervision handle.
 
-    ``method="fork"`` (default) allows closures as targets; pass
-    ``method="spawn"`` (fresh interpreters, picklable module-level target
-    required) when the parent has an initialized JAX backend — forking a
-    multithreaded JAX process can deadlock the child."""
+    ``method="spawn"`` (default) execs fresh interpreters — the same
+    contract as the launcher (``tools/mpirun.py``) — so it is safe in a
+    parent with an initialized JAX backend; the target must be a
+    picklable module-level function.  ``method="fork"`` is opt-in for
+    fork-safe callers that need closure targets: forking a multithreaded
+    JAX process is a latent deadlock, so opting in warns."""
+    if method == "fork":
+        import warnings
+
+        warnings.warn(
+            "dpm_wire.spawn(method='fork') can deadlock children when the "
+            "parent holds locks in background threads (an initialized JAX "
+            "backend always does); prefer the default method='spawn'",
+            RuntimeWarning, stacklevel=2,
+        )
     ctx = mp.get_context(method)
     if proc.rank == 0:
         port = open_port()

@@ -230,6 +230,45 @@ class WireFile(errhandler.HasErrhandler):
     def tell(self) -> int:
         return self._pointer
 
+    # -- nonblocking (MPI_File_iread/iwrite[_at]) ------------------------
+    # Same async fbtl as the in-process path (file_iwrite.c:38 over
+    # fbtl_posix_ipwritev.c): IO retires on a worker thread; the caller
+    # overlaps compute and completes through wait/test.
+
+    def _async_fbtl(self):
+        if not hasattr(self, "_ifbtl"):
+            self._ifbtl = fbtl_mod.AsyncFbtl(self._fbtl)
+        return self._ifbtl
+
+    def iread_at(self, offset: int, count: int):
+        from .file import iread_offsets
+
+        self._check_open()
+        return iread_offsets(self._async_fbtl(), self._fd,
+                             self._view.byte_offsets(offset, count),
+                             getattr(self._view.etype, "np_dtype", None))
+
+    def iwrite_at(self, offset: int, buf, count: int | None = None):
+        from .file import iwrite_offsets
+
+        self._check_open()
+        if count is None:
+            count = self._full_count(buf)
+        return iwrite_offsets(self._async_fbtl(), self._fd,
+                              self._view.byte_offsets(offset, count),
+                              self._as_bytes(buf, count),
+                              self._view.etype.size)
+
+    def iread(self, count: int):
+        off, self._pointer = self._pointer, self._pointer + count
+        return self.iread_at(off, count)
+
+    def iwrite(self, buf, count: int | None = None):
+        if count is None:
+            count = self._full_count(buf)
+        off, self._pointer = self._pointer, self._pointer + count
+        return self.iwrite_at(off, buf, count)
+
     # -- shared pointer (sharedfp/lockedfile) ----------------------------
 
     def write_shared(self, buf, count: int | None = None) -> int:
@@ -335,8 +374,17 @@ class WireFile(errhandler.HasErrhandler):
             back = self.ep.alltoall(raws)
             raw = np.empty(offs.size, dtype=np.uint8)
             for a in range(naggr):
+                routed = int((owner == a).sum())
                 piece = back[a]
-                if piece is not None and piece.size:
+                got = 0 if piece is None else int(piece.size)
+                if got != routed:
+                    # A short or missing reply must never surface the
+                    # uninitialized np.empty bytes as file data.
+                    raise errors.TruncateError(
+                        f"aggregator {a} returned {got} bytes for "
+                        f"{routed} requested"
+                    )
+                if routed:
                     raw[owner == a] = piece
         dt = getattr(self._view.etype, "np_dtype", None)
         return raw.view(dt) if dt is not None else raw

@@ -357,17 +357,37 @@ def make_train_step_optax(cfg: Config, mesh, dp_comm, tp_comm,
     # the XLA level instead of holding 2x params + both moment trees
     apply = jax.jit(_apply, donate_argnums=(0, 1))
 
+    from jax.sharding import NamedSharding
+
+    grad_shardings = {
+        k: NamedSharding(mesh, spec) for k, spec in param_specs.items()
+    }
+
     def step(params, opt_state, tokens, targets):
         grads, loss = grad_step(params, tokens, targets)
         if dcn_proc is not None and dcn_proc.size > 1:
             from ..parallel import hybrid
 
+            # DCN sync crosses the host: pack_tree gathers each gradient
+            # fully to numpy, the socket allreduce sums it across slices.
+            # This replicates full gradients through host RAM per step —
+            # acceptable for the small-slice regime this targets; a
+            # per-shard DCN reduction (each device's shard synced
+            # separately) is the scaling path when tp-sharded leaves get
+            # large.
             bundle = hybrid.dcn_grad_sync(
                 dcn_proc,
                 {"grads": grads, "loss": np.asarray(loss, np.float32)},
                 weight=dcn_weight,
             )
-            grads = bundle["grads"]
+            # Re-shard the synced host gradients explicitly before the
+            # jitted apply: feeding unsharded numpy would force XLA to
+            # re-infer layout from donated params and materialize a
+            # replicated copy on every device first.
+            grads = {
+                k: jax.device_put(v, grad_shardings[k])
+                for k, v in bundle["grads"].items()
+            }
             # keep the return contract uniform across modes: loss is
             # always a jax scalar
             loss = jnp.asarray(bundle["loss"])

@@ -142,6 +142,40 @@ class DeviceWindow:
         new_shard = jnp.where(is_target == 1, updated, self.shard)
         return DeviceWindow(self.comm, new_shard)
 
+    # -- passive target: not expressible on the device plane -------------
+    #
+    # Lock/unlock/flush require a target-independent progress agent; an
+    # XLA epoch is whole-program-scheduled, so there is no moment at which
+    # one rank can acquire a remote lock while the others compute.  The AM
+    # (wire-plane) component implements the full passive-target surface.
+    _PASSIVE_MSG = (
+        "DeviceWindow compiles whole RMA epochs (active target: "
+        "put/get/accumulate/fence); passive-target {0} is a host-plane "
+        "concept — create the window through the AM component "
+        "(zhpe_ompi_tpu.osc.am.AmWindow) for lock/unlock/flush semantics."
+    )
+
+    def lock(self, *a, **k):
+        raise errors.WinError(self._PASSIVE_MSG.format("lock"))
+
+    def lock_all(self, *a, **k):
+        raise errors.WinError(self._PASSIVE_MSG.format("lock_all"))
+
+    def unlock(self, *a, **k):
+        raise errors.WinError(self._PASSIVE_MSG.format("unlock"))
+
+    def unlock_all(self, *a, **k):
+        raise errors.WinError(self._PASSIVE_MSG.format("unlock_all"))
+
+    def flush(self, *a, **k):
+        raise errors.WinError(self._PASSIVE_MSG.format("flush"))
+
+    def flush_all(self, *a, **k):
+        raise errors.WinError(self._PASSIVE_MSG.format("flush_all"))
+
+    def flush_local(self, *a, **k):
+        raise errors.WinError(self._PASSIVE_MSG.format("flush_local"))
+
     def fence(self) -> "DeviceWindow":
         """Epoch boundary: the barrier token is folded into the window state
         (added as zero) so XLA cannot dead-code-eliminate the collective —

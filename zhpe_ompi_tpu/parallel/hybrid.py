@@ -99,7 +99,10 @@ def dcn_grad_sync(proc, grads: Any, weight: float | None = None) -> Any:
                 f"dcn_grad_sync expects float gradients, got {buf.dtype}"
             )
         if proc.size == 1:
-            summed[key] = buf
+            # An explicit weight still applies on one slice — the caller
+            # asked for a weighted sum, and w != 1 must not silently
+            # become identity just because there is nothing to reduce.
+            summed[key] = buf if weight is None else buf * w
         else:
             summed[key] = proc.allreduce(buf * w, zops.SUM)
     return unpack_tree(summed, treedef, meta)

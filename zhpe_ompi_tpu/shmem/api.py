@@ -379,13 +379,28 @@ class ShmemPE:
 
     # -- atomics (atomic framework analog) -------------------------------
 
+    def _amo(self, sym: SymArray, kind: str, pe: int, index: int,
+             value=None, compare=None):
+        """Single AMO dispatch: index bounds are validated HERE so every
+        backend (mmap raw-address, AM displacement, direct view) rejects
+        out-of-range identically — a backend computing addr/disp from an
+        unchecked index would touch a neighboring symmetric allocation."""
+        n_elems = sym.nbytes // sym.dtype.itemsize
+        if not 0 <= index < n_elems:
+            raise errors.ArgError(
+                f"AMO index {index} out of range for symmetric array of "
+                f"{n_elems} elements"
+            )
+        return self._backend.amo(sym, kind, pe, index, value=value,
+                                 compare=compare)
+
     def atomic_add(self, sym: SymArray, value, pe: int, index: int = 0
                    ) -> None:
-        self._backend.amo(sym, "add", pe, index, value=value)
+        self._amo(sym, "add", pe, index, value=value)
 
     def atomic_fetch_add(self, sym: SymArray, value, pe: int,
                          index: int = 0):
-        return self._backend.amo(sym, "add", pe, index, value=value)
+        return self._amo(sym, "add", pe, index, value=value)
 
     def atomic_inc(self, sym: SymArray, pe: int, index: int = 0) -> None:
         self.atomic_add(sym, 1, pe, index)
@@ -394,20 +409,18 @@ class ShmemPE:
         return self.atomic_fetch_add(sym, 1, pe, index)
 
     def atomic_swap(self, sym: SymArray, value, pe: int, index: int = 0):
-        return self._backend.amo(sym, "swap", pe, index, value=value)
+        return self._amo(sym, "swap", pe, index, value=value)
 
     def atomic_compare_swap(self, sym: SymArray, cond, value, pe: int,
                             index: int = 0):
-        return self._backend.amo(
-            sym, "cas", pe, index, value=value, compare=cond
-        )
+        return self._amo(sym, "cas", pe, index, value=value, compare=cond)
 
     def atomic_fetch(self, sym: SymArray, pe: int, index: int = 0):
-        return self._backend.amo(sym, "fetch", pe, index)
+        return self._amo(sym, "fetch", pe, index)
 
     def atomic_set(self, sym: SymArray, value, pe: int, index: int = 0
                    ) -> None:
-        self._backend.amo(sym, "set", pe, index, value=value)
+        self._amo(sym, "set", pe, index, value=value)
 
     # -- point synchronization -------------------------------------------
 

@@ -158,6 +158,17 @@ class MmapBackend:
         if not 0 <= pe < self._ep.size:
             raise errors.RankError(f"PE {pe} out of range")
         dt = sym.dtype
+        # Both AMO paths must agree: the native path computes a raw address,
+        # so an unchecked index would write outside the symmetric array
+        # (silent cross-process corruption), while numpy indexing in the
+        # fallback would wrap negatives / raise on overflow.  Validate once
+        # here so the semantics cannot diverge.
+        n_elems = sym.nbytes // dt.itemsize
+        if not 0 <= index < n_elems:
+            raise errors.ArgError(
+                f"AMO index {index} out of range for symmetric array of "
+                f"{n_elems} elements"
+            )
         code = _TYPE_CODES.get(dt)
         if self._native is not None and code is not None:
             import ctypes

@@ -74,7 +74,8 @@ def _prune(directory: str, keep: int) -> int:
 
     ck = Checkpointer(directory)
     steps = ck.all_steps()
-    drop = steps[:-keep] if keep > 0 else []
+    # --keep 0 means keep none: drop every step (steps[:-0] would be []).
+    drop = steps[:-keep] if keep > 0 else list(steps)
     for s in drop:
         shutil.rmtree(os.path.join(directory, f"step_{s}"),
                       ignore_errors=True)
@@ -96,8 +97,11 @@ def main(args: list[str] | None = None) -> int:
     p_ins.add_argument("--step", type=int, default=None)
     p_pr = sub.add_parser("prune")
     p_pr.add_argument("dir")
-    p_pr.add_argument("--keep", type=int, required=True)
+    p_pr.add_argument("--keep", type=int, required=True,
+                      help="checkpoints to retain (0 prunes everything)")
     ns = ap.parse_args(args)
+    if ns.cmd == "prune" and ns.keep < 0:
+        ap.error(f"--keep must be >= 0, got {ns.keep}")
     if ns.cmd == "list":
         return _list(ns.dir)
     if ns.cmd == "inspect":
