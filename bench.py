@@ -50,6 +50,9 @@ nothing (vs_baseline 1.00 vs hand-written JAX, identical HLO).
 """
 
 import json
+import os
+import subprocess
+import sys
 import time
 
 import numpy as np
@@ -86,8 +89,20 @@ def _train_flops_per_step(cfg, batch):
     return 6 * matmul_params * tokens + 12 * L * batch * s * s * d
 
 
+def _pin_platform(jax):
+    """Honor JAX_PLATFORMS at the jax-config level: the axon
+    sitecustomize force-registers the TPU plugin and overrides the
+    config default, so the env var alone is silently ignored (a CPU
+    smoke run would then hang dialing the tunnel)."""
+    p = os.environ.get("JAX_PLATFORMS")
+    if p:
+        jax.config.update("jax_platforms", p)
+
+
 def main():
     import jax
+
+    _pin_platform(jax)
     import jax.numpy as jnp
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -333,5 +348,102 @@ def main():
     print(json.dumps(result))
 
 
+# ---------------------------------------------------------------------------
+# Supervisor (round-4 item 1): BENCH_r03 died in jax.devices() with a
+# transient "TPU backend setup/compile error (Unavailable)" and lost the
+# round's perf evidence.  A probe this round HUNG >400 s (not an exception),
+# so in-process retries are not enough — the backend must be probed in a
+# killable subprocess.  Default mode: probe with bounded retries/backoff,
+# then run the measurement in a child; if every attempt dies, emit the
+# failure reason as the one JSON line so the artifact is diagnosable.
+
+_PROBE_SRC = (
+    "import json,os,jax\n"
+    "p=os.environ.get('JAX_PLATFORMS')\n"
+    "jax.config.update('jax_platforms', p) if p else None\n"
+    "d=jax.devices()\n"
+    "print(json.dumps({'n':len(d),'platform':d[0].platform,"
+    "'kind':getattr(d[0],'device_kind','?')}))\n"
+)
+
+
+def _tail(text: str, n: int = 800) -> str:
+    text = (text or "").strip()
+    return text[-n:]
+
+
+def supervise() -> int:
+    probe_timeout = float(os.environ.get("ZMPI_BENCH_PROBE_TIMEOUT", 240))
+    bench_timeout = float(os.environ.get("ZMPI_BENCH_TIMEOUT", 1800))
+    attempts = int(os.environ.get("ZMPI_BENCH_ATTEMPTS", 5))
+    backoffs = [10, 30, 60, 120]
+    failures = []
+
+    for attempt in range(attempts):
+        if attempt:
+            time.sleep(backoffs[min(attempt - 1, len(backoffs) - 1)])
+        t0 = time.perf_counter()
+        try:
+            probe = subprocess.run(
+                [sys.executable, "-c", _PROBE_SRC],
+                capture_output=True, text=True, timeout=probe_timeout,
+            )
+        except subprocess.TimeoutExpired:
+            failures.append(
+                f"attempt {attempt + 1}: backend probe hung "
+                f"{probe_timeout:.0f}s (killed)"
+            )
+            continue
+        if probe.returncode != 0:
+            failures.append(
+                f"attempt {attempt + 1}: probe rc={probe.returncode}: "
+                f"{_tail(probe.stderr, 400)}"
+            )
+            continue
+        print(f"probe ok in {time.perf_counter() - t0:.1f}s: "
+              f"{probe.stdout.strip()}", file=sys.stderr)
+
+        # backend answers — run the measurement in a killable child
+        try:
+            child = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), "--direct"],
+                capture_output=True, text=True, timeout=bench_timeout,
+            )
+        except subprocess.TimeoutExpired:
+            failures.append(
+                f"attempt {attempt + 1}: bench hung "
+                f"{bench_timeout:.0f}s (killed)"
+            )
+            continue
+        if child.returncode == 0:
+            sys.stderr.write(child.stderr)
+            sys.stdout.write(child.stdout)  # the one JSON line
+            return 0
+        failures.append(
+            f"attempt {attempt + 1}: bench rc={child.returncode}: "
+            f"{_tail(child.stderr, 400)}"
+        )
+        # a non-transient failure (assertion, bad JSON...) would repeat
+        # identically; only backend-availability errors merit more
+        # retries.  Case-insensitive: the round-3 failure string was
+        # "TPU backend setup/compile error (Unavailable)"
+        low = child.stderr.lower()
+        if "unavailable" not in low and \
+                "unable to initialize backend" not in low:
+            break
+
+    print(json.dumps({
+        "metric": "train_step_throughput",
+        "value": 0.0,
+        "unit": "tokens/s",
+        "vs_baseline": 0.0,
+        "error": "; ".join(failures)[-2000:],
+    }))
+    return 1
+
+
 if __name__ == "__main__":
-    main()
+    if "--direct" in sys.argv:
+        main()
+    else:
+        sys.exit(supervise())
