@@ -1,0 +1,218 @@
+/* subcomm_c.c — round-4 C ABI acceptance program (VERDICT item 3).
+ *
+ * Exercises the broadened mpi.h surface end to end:
+ *   1. MPI_Comm_split of COMM_WORLD into odd/even sub-communicators and
+ *      an allreduce inside each (comm_split.c:40 + allreduce.c:113 shape),
+ *   2. MPI_Comm_dup + MPI_Comm_free,
+ *   3. Isend/Irecv overlapped with local compute, completed by
+ *      MPI_Test polling then MPI_Waitall (isend.c:46 semantics),
+ *   4. MPI_Sendrecv ring shift,
+ *   5. rooted collectives: Reduce, Gather, Scatter + Allgather/Alltoall,
+ *   6. derived datatypes: MPI_Type_vector strided column send and
+ *      MPI_Type_contiguous, committed and freed,
+ *   7. logical/bitwise reduction ops (MPI_LAND, MPI_BXOR),
+ *   8. MPI_Get_processor_name / MPI_Wtick.
+ *
+ * Every stage validates its result; any mismatch exits nonzero with a
+ * message, so the harness only has to check the exit code and the final
+ * OK line.
+ */
+
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+#include "zompi_mpi.h"
+
+#define CHECK(cond, msg)                                        \
+  do {                                                          \
+    if (!(cond)) {                                              \
+      fprintf(stderr, "FAIL rank %d: %s\n", world_rank, msg);   \
+      return 1;                                                 \
+    }                                                           \
+  } while (0)
+
+int main(int argc, char **argv) {
+  int world_rank, world_size;
+  if (MPI_Init(&argc, &argv) != MPI_SUCCESS) return 2;
+  MPI_Comm_rank(MPI_COMM_WORLD, &world_rank);
+  MPI_Comm_size(MPI_COMM_WORLD, &world_size);
+
+  /* 1. split odd/even; allreduce inside the sub-communicator */
+  MPI_Comm sub;
+  int color = world_rank % 2;
+  CHECK(MPI_Comm_split(MPI_COMM_WORLD, color, world_rank, &sub) ==
+            MPI_SUCCESS, "Comm_split");
+  int sub_rank, sub_size;
+  MPI_Comm_rank(sub, &sub_rank);
+  MPI_Comm_size(sub, &sub_size);
+  int expect_size = world_size / 2 + (color == 0 ? world_size % 2 : 0);
+  CHECK(sub_size == expect_size, "sub size");
+  long my = world_rank, total = -1;
+  CHECK(MPI_Allreduce(&my, &total, 1, MPI_LONG, MPI_SUM, sub) ==
+            MPI_SUCCESS, "sub allreduce");
+  long want = 0;
+  for (int r = color; r < world_size; r += 2) want += r;
+  CHECK(total == want, "sub allreduce value");
+
+  /* barrier on the sub-communicator too */
+  CHECK(MPI_Barrier(sub) == MPI_SUCCESS, "sub barrier");
+
+  /* 2. dup + free */
+  MPI_Comm dup;
+  CHECK(MPI_Comm_dup(sub, &dup) == MPI_SUCCESS, "Comm_dup");
+  long total2 = -1;
+  CHECK(MPI_Allreduce(&my, &total2, 1, MPI_LONG, MPI_SUM, dup) ==
+            MPI_SUCCESS && total2 == want, "dup allreduce");
+  CHECK(MPI_Comm_free(&dup) == MPI_SUCCESS && dup == MPI_COMM_NULL,
+        "Comm_free");
+
+  /* 3. nonblocking ring: Irecv posted first, Isend, local compute
+   * overlaps, Test polls, Waitall completes */
+  int next = (world_rank + 1) % world_size;
+  int prev = (world_rank + world_size - 1) % world_size;
+  double out[8], in[8];
+  for (int i = 0; i < 8; i++) out[i] = world_rank * 100.0 + i;
+  MPI_Request reqs[2];
+  CHECK(MPI_Irecv(in, 8, MPI_DOUBLE, prev, 31, MPI_COMM_WORLD,
+                  &reqs[0]) == MPI_SUCCESS, "Irecv");
+  CHECK(MPI_Isend(out, 8, MPI_DOUBLE, next, 31, MPI_COMM_WORLD,
+                  &reqs[1]) == MPI_SUCCESS, "Isend");
+  /* the overlapped "compute" */
+  double acc = 0.0;
+  for (int i = 0; i < 100000; i++) acc += i * 1e-9;
+  int flag = 0;
+  CHECK(MPI_Test(&reqs[1], &flag, MPI_STATUS_IGNORE) == MPI_SUCCESS,
+        "Test");
+  MPI_Status sts[2];
+  CHECK(MPI_Waitall(2, reqs, sts) == MPI_SUCCESS, "Waitall");
+  CHECK(reqs[0] == MPI_REQUEST_NULL && reqs[1] == MPI_REQUEST_NULL,
+        "requests nulled");
+  CHECK(sts[0].MPI_SOURCE == prev && sts[0].MPI_TAG == 31, "status");
+  int got_n = -1;
+  MPI_Get_count(&sts[0], MPI_DOUBLE, &got_n);
+  CHECK(got_n == 8, "Get_count");
+  for (int i = 0; i < 8; i++)
+    CHECK(in[i] == prev * 100.0 + i, "ring payload");
+
+  /* 4. Sendrecv shift the other way */
+  long sv = world_rank * 7L, rv = -1;
+  MPI_Status st;
+  CHECK(MPI_Sendrecv(&sv, 1, MPI_LONG, prev, 32, &rv, 1, MPI_LONG, next,
+                     32, MPI_COMM_WORLD, &st) == MPI_SUCCESS, "Sendrecv");
+  CHECK(rv == next * 7L, "Sendrecv payload");
+
+  /* 5. rooted collectives on WORLD */
+  int root = world_size - 1;
+  long red = -1;
+  CHECK(MPI_Reduce(&my, &red, 1, MPI_LONG, MPI_SUM, root,
+                   MPI_COMM_WORLD) == MPI_SUCCESS, "Reduce");
+  if (world_rank == root) {
+    long all = (long)world_size * (world_size - 1) / 2;
+    CHECK(red == all, "Reduce value");
+  }
+  int *gath = malloc(sizeof(int) * world_size);
+  int mine_i = world_rank + 1000;
+  CHECK(MPI_Gather(&mine_i, 1, MPI_INT, gath, 1, MPI_INT, 0,
+                   MPI_COMM_WORLD) == MPI_SUCCESS, "Gather");
+  if (world_rank == 0)
+    for (int r = 0; r < world_size; r++)
+      CHECK(gath[r] == r + 1000, "Gather value");
+  int *scat = malloc(sizeof(int) * world_size);
+  for (int r = 0; r < world_size; r++) scat[r] = r * 3;
+  int pick = -1;
+  CHECK(MPI_Scatter(scat, 1, MPI_INT, &pick, 1, MPI_INT, 0,
+                    MPI_COMM_WORLD) == MPI_SUCCESS, "Scatter");
+  CHECK(pick == world_rank * 3, "Scatter value");
+  int *ag = malloc(sizeof(int) * world_size);
+  CHECK(MPI_Allgather(&mine_i, 1, MPI_INT, ag, 1, MPI_INT,
+                      MPI_COMM_WORLD) == MPI_SUCCESS, "Allgather");
+  for (int r = 0; r < world_size; r++)
+    CHECK(ag[r] == r + 1000, "Allgather value");
+  int *a2a_s = malloc(sizeof(int) * world_size);
+  int *a2a_r = malloc(sizeof(int) * world_size);
+  for (int r = 0; r < world_size; r++)
+    a2a_s[r] = world_rank * 100 + r;
+  CHECK(MPI_Alltoall(a2a_s, 1, MPI_INT, a2a_r, 1, MPI_INT,
+                     MPI_COMM_WORLD) == MPI_SUCCESS, "Alltoall");
+  for (int r = 0; r < world_size; r++)
+    CHECK(a2a_r[r] == r * 100 + world_rank, "Alltoall value");
+
+  /* 6. derived datatypes: vector = one column of a 4x4 row-major
+   * matrix; the receiver takes it as 4 contiguous doubles */
+  MPI_Datatype col, quad;
+  CHECK(MPI_Type_vector(4, 1, 4, MPI_DOUBLE, &col) == MPI_SUCCESS &&
+            MPI_Type_commit(&col) == MPI_SUCCESS, "Type_vector");
+  CHECK(MPI_Type_contiguous(4, MPI_DOUBLE, &quad) == MPI_SUCCESS &&
+            MPI_Type_commit(&quad) == MPI_SUCCESS, "Type_contiguous");
+  int tsize = -1;
+  CHECK(MPI_Type_size(col, &tsize) == MPI_SUCCESS && tsize == 32,
+        "Type_size");
+  if (world_rank == 0) {
+    double m[16];
+    for (int i = 0; i < 16; i++) m[i] = i;
+    /* send column 1: elements 1, 5, 9, 13 */
+    CHECK(MPI_Send(m + 1, 1, col, 1 % world_size, 41, MPI_COMM_WORLD) ==
+              MPI_SUCCESS, "vector send");
+  }
+  if (world_rank == 1 % world_size) {
+    double colv[4];
+    CHECK(MPI_Recv(colv, 1, quad, 0, 41, MPI_COMM_WORLD, &st) ==
+              MPI_SUCCESS, "vector recv");
+    int cn = -1;
+    MPI_Get_count(&st, MPI_DOUBLE, &cn);
+    CHECK(cn == 4, "vector count");
+    CHECK(colv[0] == 1 && colv[1] == 5 && colv[2] == 9 && colv[3] == 13,
+          "vector payload");
+    /* and receive INTO a strided layout: scatter the quad back out */
+    double back[16];
+    memset(back, 0, sizeof back);
+    if (world_size > 1) {
+      CHECK(MPI_Send(colv, 1, quad, 0, 42, MPI_COMM_WORLD) ==
+                MPI_SUCCESS, "quad send");
+    } else {
+      CHECK(MPI_Send(colv, 1, quad, 0, 42, MPI_COMM_WORLD) ==
+                MPI_SUCCESS, "quad send self");
+    }
+    (void)back;
+  }
+  if (world_rank == 0) {
+    double back[16];
+    memset(back, 0, sizeof back);
+    CHECK(MPI_Recv(back + 1, 1, col, 1 % world_size, 42, MPI_COMM_WORLD,
+                   &st) == MPI_SUCCESS, "strided recv");
+    CHECK(back[1] == 1 && back[5] == 5 && back[9] == 9 && back[13] == 13,
+          "strided recv payload");
+    CHECK(back[0] == 0 && back[2] == 0, "strided recv gaps untouched");
+  }
+  CHECK(MPI_Type_free(&col) == MPI_SUCCESS &&
+            col == MPI_DATATYPE_NULL, "Type_free");
+  MPI_Type_free(&quad);
+
+  /* 7. logical/bitwise ops */
+  int lv = world_rank == 0 ? 1 : 1, land = -1;
+  CHECK(MPI_Allreduce(&lv, &land, 1, MPI_INT, MPI_LAND,
+                      MPI_COMM_WORLD) == MPI_SUCCESS && land == 1,
+        "LAND");
+  unsigned xv = 1u << (world_rank % 8), bx = 0;
+  CHECK(MPI_Allreduce(&xv, &bx, 1, MPI_UNSIGNED, MPI_BXOR,
+                      MPI_COMM_WORLD) == MPI_SUCCESS, "BXOR");
+  unsigned want_bx = 0;
+  for (int r = 0; r < world_size; r++) want_bx ^= 1u << (r % 8);
+  CHECK(bx == want_bx, "BXOR value");
+
+  /* 8. identity queries */
+  char pname[MPI_MAX_PROCESSOR_NAME];
+  int plen = -1;
+  CHECK(MPI_Get_processor_name(pname, &plen) == MPI_SUCCESS && plen > 0,
+        "Get_processor_name");
+  CHECK(MPI_Wtick() > 0.0 && MPI_Wtick() < 1.0, "Wtick");
+
+  MPI_Comm_free(&sub);
+  MPI_Barrier(MPI_COMM_WORLD);
+  printf("subcomm_c rank %d/%d OK (acc=%.3f host=%s)\n", world_rank,
+         world_size, acc, pname);
+  free(gath); free(scat); free(ag); free(a2a_s); free(a2a_r);
+  MPI_Finalize();
+  return 0;
+}

@@ -1,5 +1,6 @@
 /* libzompi_mpi — the C ABI shim's engine (SURVEY.md §7's "C ABI
- * mpi.h-compatible shim" commitment).
+ * mpi.h-compatible shim" commitment; breadth per VERDICT round-3
+ * Missing #1).
  *
  * Speaks the SAME wire protocol as the Python host plane
  * (zhpe_ompi_tpu/pt2pt/tcp.py):
@@ -21,15 +22,33 @@
  * that limit (the C ABI is the control-plane surface, as the reference's
  * heterogeneous deployments keep bulk data on the fabric plane).
  *
- * Matching: posted-receive semantics with ANY_SOURCE/ANY_TAG wildcards and
- * per-source FIFO (arrival order scan), the contract of
- * pml_ob1_recvfrag.c re-stated in ~40 lines because the C shim only ever
- * has blocking receives (no posted queue needed — just the unexpected
- * queue and a condvar).
+ * Matching: a posted-receive engine (the pml_ob1_recvfrag.c:295-513
+ * contract): posted requests are matched in post order against arriving
+ * fragments, the unexpected queue holds arrivals with no posted match,
+ * and wildcards (ANY_SOURCE/ANY_TAG) resolve in arrival order.  Blocking
+ * receive is Irecv+Wait over the same engine, so ordering between
+ * blocking and nonblocking receives follows the MPI posting-order rule.
+ *
+ * Communicators: WORLD and SELF are predefined; Comm_split/dup derive
+ * new contexts whose cid triples (pt2pt / collective / barrier context)
+ * are computed deterministically from the parent's cid and a per-parent
+ * creation sequence — every member runs the identical computation, so no
+ * wire agreement round is needed (the ompi_comm_nextcid analog,
+ * ompi/communicator/comm_cid.c, collapsed to a hash because disjoint
+ * sibling groups can safely share a context id).
  *
  * Collectives: recursive-doubling allreduce with the non-power-of-two
- * fold (coll_base_allreduce.c:130-225 shape) and binomial bcast on a
- * reserved cid, element-typed kernels for the four predefined ops.
+ * fold (coll_base_allreduce.c:130-225 shape), binomial bcast
+ * (coll_base_bcast.c:329), linear rooted reduce/gather/scatter
+ * (coll/basic's linear algorithms, coll_base_gather.c:41 family), ring
+ * allgather, and pairwise alltoall (coll_base_alltoall.c:132 shape) on a
+ * reserved cid, element-typed kernels for the predefined ops including
+ * the logical/bitwise set (op_base_functions.c analog).
+ *
+ * Derived datatypes: contiguous and vector typemaps with a resumable
+ * pack/unpack into base-typed contiguous wire buffers — the convertor
+ * shape (opal_convertor_pack, opal/datatype/opal_convertor.c:218-276)
+ * reduced to the two constructors the C surface exposes.
  */
 
 #include "zompi_mpi.h"
@@ -40,6 +59,7 @@
 #include <sys/socket.h>
 #include <unistd.h>
 
+#include <algorithm>
 #include <atomic>
 #include <condition_variable>
 #include <cstdint>
@@ -47,6 +67,7 @@
 #include <cstdlib>
 #include <cstring>
 #include <deque>
+#include <list>
 #include <map>
 #include <mutex>
 #include <string>
@@ -252,12 +273,163 @@ int tcp_connect(const std::string &host, int port) {
   return -1;
 }
 
-// -------------------------------------------------------------- state
+// ------------------------------------------------------------ datatypes
+
+struct DtInfo { const char *tag; size_t item; };
+
+bool base_dtinfo(MPI_Datatype dt, DtInfo &out) {
+  switch (dt) {
+    case MPI_BYTE:           out = {"|u1", 1}; return true;
+    case MPI_INT:            out = {"<i4", 4}; return true;
+    case MPI_LONG:           out = {"<i8", 8}; return true;
+    case MPI_FLOAT:          out = {"<f4", 4}; return true;
+    case MPI_DOUBLE:         out = {"<f8", 8}; return true;
+    case MPI_CHAR:           out = {"<i1", 1}; return true;
+    case MPI_SIGNED_CHAR:    out = {"<i1", 1}; return true;
+    case MPI_SHORT:          out = {"<i2", 2}; return true;
+    case MPI_LONG_LONG:      out = {"<i8", 8}; return true;
+    case MPI_UNSIGNED_CHAR:  out = {"<u1", 1}; return true;
+    case MPI_UNSIGNED_SHORT: out = {"<u2", 2}; return true;
+    case MPI_UNSIGNED:       out = {"<u4", 4}; return true;
+    case MPI_UNSIGNED_LONG:  out = {"<u8", 8}; return true;
+  }
+  return false;
+}
+
+// Derived typemap: blocks of base elements within one extent, the
+// convertor's description (opal_datatype_optimize.c) reduced to the
+// contiguous/vector constructors.
+struct DtypeObj {
+  MPI_Datatype base = MPI_BYTE;
+  std::vector<std::pair<int64_t, int64_t>> blocks;  // (offset, n) in elems
+  int64_t extent = 0;   // in base elems
+  int64_t elems = 0;    // base elems per one item (sum of block n)
+  bool committed = false;
+};
+
+constexpr MPI_Datatype DERIVED_BASE = 0x40;
+std::map<MPI_Datatype, DtypeObj> g_dtypes;
+MPI_Datatype g_next_dtype = DERIVED_BASE;
+
+// A resolved view: base info + typemap (identity map for predefined).
+struct DtView {
+  DtInfo di;
+  const DtypeObj *derived = nullptr;  // null => predefined (contiguous)
+  int64_t elems_per_item() const { return derived ? derived->elems : 1; }
+  bool contiguous() const {
+    if (!derived) return true;
+    return derived->blocks.size() == 1 && derived->blocks[0].first == 0 &&
+           derived->extent == derived->elems;
+  }
+};
+
+// merge adjacent typemap blocks (opal_datatype_optimize.c's job)
+void coalesce_blocks(std::vector<std::pair<int64_t, int64_t>> &blocks) {
+  std::vector<std::pair<int64_t, int64_t>> merged;
+  for (auto &b : blocks) {
+    if (!merged.empty() &&
+        merged.back().first + merged.back().second == b.first)
+      merged.back().second += b.second;
+    else
+      merged.push_back(b);
+  }
+  blocks = std::move(merged);
+}
+
+// memory footprint of `count` items laid out per MPI extent rules —
+// block r of a gather-family buffer starts at r * slot_bytes
+size_t slot_bytes(const DtView &v, int count) {
+  int64_t ext = v.derived ? v.derived->extent : 1;
+  return (size_t)count * (size_t)ext * v.di.item;
+}
+
+bool resolve_dtype(MPI_Datatype dt, DtView &v) {
+  if (dt < DERIVED_BASE) return base_dtinfo(dt, v.di);
+  auto it = g_dtypes.find(dt);
+  if (it == g_dtypes.end() || !it->second.committed) return false;
+  v.derived = &it->second;
+  return base_dtinfo(it->second.base, v.di);
+}
+
+// pack `count` items described by `v` from user memory into a
+// contiguous base-element buffer (the convertor's pack direction)
+void pack_dtype(const void *user, int count, const DtView &v,
+                std::vector<char> &out) {
+  size_t item = v.di.item;
+  out.resize((size_t)count * v.elems_per_item() * item);
+  if (v.contiguous()) {
+    memcpy(out.data(), user, out.size());
+    return;
+  }
+  const char *src = (const char *)user;
+  char *dst = out.data();
+  for (int c = 0; c < count; c++) {
+    const char *base = src + (size_t)c * v.derived->extent * item;
+    for (auto &b : v.derived->blocks) {
+      memcpy(dst, base + (size_t)b.first * item, (size_t)b.second * item);
+      dst += (size_t)b.second * item;
+    }
+  }
+}
+
+// unpack up to `avail_bytes` of contiguous base elements into user
+// memory laid out per `v` (the convertor's unpack direction)
+void unpack_dtype(void *user, int count, const DtView &v,
+                  const char *wire, size_t avail_bytes) {
+  size_t item = v.di.item;
+  if (v.contiguous()) {
+    size_t want = (size_t)count * v.elems_per_item() * item;
+    memcpy(user, wire, avail_bytes < want ? avail_bytes : want);
+    return;
+  }
+  char *dst = (char *)user;
+  size_t taken = 0;
+  for (int c = 0; c < count; c++) {
+    char *base = dst + (size_t)c * v.derived->extent * item;
+    for (auto &b : v.derived->blocks) {
+      size_t n = (size_t)b.second * item;
+      if (taken >= avail_bytes) return;
+      if (taken + n > avail_bytes) n = avail_bytes - taken;
+      memcpy(base + (size_t)b.first * item, wire + taken, n);
+      taken += n;
+    }
+  }
+}
+
+// ------------------------------------------------------ matching engine
 
 struct Message {
   int64_t src, tag, cid, seq;
   std::string dt;     // ndarray dtype or "" for bytes payload
   std::string data;   // raw payload bytes
+};
+
+// A receive request registered with the engine.  Blocking receives are
+// Irecv+Wait over the same posted list, preserving MPI posting order.
+struct Req {
+  bool complete = false;
+  bool is_recv = false;
+  bool heap = false;               // user-facing (Isend/Irecv) vs stack
+  int comm = MPI_COMM_WORLD;       // for MPI_SOURCE translation
+  void *user_buf = nullptr;
+  int count = 0;
+  std::vector<char> scratch;       // landing zone for derived-type recvs
+  bool needs_unpack = false;
+  // Unpack plan captured AT POST TIME: MPI allows MPI_Type_free while a
+  // receive is pending, so completion must not consult the dtype table.
+  DtInfo plan_di{"|u1", 1};
+  DtypeObj plan;
+  MPI_Status status{};
+};
+
+struct Posted {
+  Req *req;
+  int64_t cid;
+  int src_world;   // -1 = ANY
+  int64_t tag;     // -1 = ANY
+  char *land;      // where arriving bytes go (user buf or scratch)
+  size_t want_bytes;
+  size_t item;     // base element size (status._count unit)
 };
 
 struct Shim {
@@ -270,6 +442,9 @@ struct Shim {
   std::mutex conn_mu;
   std::mutex send_mu;
   std::deque<Message> unexpected;
+  std::list<Posted> posted;
+  std::map<int, Req *> reqs;
+  int next_req = 1;
   std::mutex match_mu;
   std::condition_variable match_cv;
   std::atomic<bool> closing{false};
@@ -278,11 +453,128 @@ struct Shim {
   std::vector<int> drain_fds;           // every fd a drain thread reads
   std::mutex threads_mu;
   int64_t seq = 0;
-  int64_t coll_seq = 0;
   bool initialized = false;
 };
 
 Shim g;
+
+// fill a posted request from an arriving/unexpected message.
+// match_mu must be held.
+void deliver(const Posted &p, const Message &m) {
+  size_t have = m.data.size();
+  size_t copied = have > p.want_bytes ? p.want_bytes : have;
+  memcpy(p.land, m.data.data(), copied);
+  Req *r = p.req;
+  r->status.MPI_SOURCE = (int)m.src;  // world rank; translated at Wait
+  r->status.MPI_TAG = (int)m.tag;
+  r->status.MPI_ERROR =
+      have > p.want_bytes ? MPI_ERR_TRUNCATE : MPI_SUCCESS;
+  r->status._count = (int)(copied / p.item);
+  r->complete = true;
+}
+
+// Arrival path (drain threads + self-sends): posted list first, in post
+// order; otherwise the unexpected queue (pml_ob1_recvfrag.c:342 shape).
+void push_message(Message &&m) {
+  {
+    std::lock_guard<std::mutex> lk(g.match_mu);
+    for (auto it = g.posted.begin(); it != g.posted.end(); ++it) {
+      if (it->cid != m.cid) continue;
+      if (it->src_world != MPI_ANY_SOURCE && it->src_world != m.src)
+        continue;
+      if (it->tag != MPI_ANY_TAG && it->tag != m.tag) continue;
+      deliver(*it, m);
+      g.posted.erase(it);
+      g.match_cv.notify_all();
+      return;
+    }
+    g.unexpected.push_back(std::move(m));
+  }
+  g.match_cv.notify_all();
+}
+
+// Post a receive: unexpected queue first (arrival order), else posted.
+// Returns the request handle.
+int post_recv(Req *r, const DtView &v, int64_t cid, int src_world,
+              int64_t tag) {
+  size_t base_bytes =
+      (size_t)r->count * v.elems_per_item() * v.di.item;
+  char *land;
+  r->plan_di = v.di;
+  if (v.contiguous()) {
+    land = (char *)r->user_buf;
+  } else {
+    r->scratch.resize(base_bytes);
+    r->needs_unpack = true;
+    r->plan = *v.derived;  // survives MPI_Type_free of the handle
+    land = r->scratch.data();
+  }
+  Posted p{r, cid, src_world, tag, land, base_bytes, v.di.item};
+  std::lock_guard<std::mutex> lk(g.match_mu);
+  int handle = g.next_req++;
+  g.reqs[handle] = r;
+  for (auto it = g.unexpected.begin(); it != g.unexpected.end(); ++it) {
+    if (it->cid != cid) continue;
+    if (src_world != MPI_ANY_SOURCE && it->src != src_world) continue;
+    if (tag != MPI_ANY_TAG && it->tag != tag) continue;
+    deliver(p, *it);
+    g.unexpected.erase(it);
+    return handle;
+  }
+  g.posted.push_back(p);
+  return handle;
+}
+
+// finish a completed receive on the calling thread (derived unpack,
+// from the plan captured at post time)
+void finish_recv(Req *r) {
+  if (r->needs_unpack) {
+    DtView v;
+    v.di = r->plan_di;
+    v.derived = &r->plan;
+    size_t avail = (size_t)r->status._count * v.di.item;
+    unpack_dtype(r->user_buf, r->count, v, r->scratch.data(), avail);
+    r->needs_unpack = false;
+    r->scratch.clear();
+  }
+}
+
+// wait for handle; fills status (world-rank source), frees the slot.
+// On shutdown the request is fully deregistered (posted entry + map
+// slot) before returning, so a stack-allocated Req never outlives its
+// registration.
+int wait_handle_impl(int handle, MPI_Status *status) {
+  Req *r;
+  {
+    std::unique_lock<std::mutex> lk(g.match_mu);
+    auto it = g.reqs.find(handle);
+    if (it == g.reqs.end()) return MPI_ERR_REQUEST;
+    r = it->second;
+    while (!r->complete) {
+      g.match_cv.wait_for(lk, std::chrono::milliseconds(100));
+      if (g.closing.load()) {
+        g.posted.remove_if([r](const Posted &p) { return p.req == r; });
+        bool heap = r->heap;
+        g.reqs.erase(it);
+        if (heap) delete r;
+        return MPI_ERR_OTHER;
+      }
+    }
+    g.reqs.erase(it);
+  }
+  finish_recv(r);
+  int rc = r->status.MPI_ERROR;
+  if (status) *status = r->status;
+  if (r->heap) delete r;
+  return rc;
+}
+
+// internal (collectives): stack Req, world-rank statuses
+int wait_handle(int handle, MPI_Status *status) {
+  return wait_handle_impl(handle, status);
+}
+
+// ------------------------------------------------------------ endpoints
 
 void drain_loop(int fd);
 
@@ -309,11 +601,7 @@ void drain_loop(int fd) {
     } else if (vals[4].tag == T_BYTES || vals[4].tag == T_STR) {
       m.data = vals[4].s;
     }
-    {
-      std::lock_guard<std::mutex> lk(g.match_mu);
-      g.unexpected.push_back(std::move(m));
-    }
-    g.match_cv.notify_all();
+    push_message(std::move(m));
   }
 }
 
@@ -363,33 +651,15 @@ int endpoint(int dest) {
   return fd;
 }
 
-struct DtInfo { const char *tag; size_t item; };
-
-bool dtinfo(MPI_Datatype dt, DtInfo &out) {
-  switch (dt) {
-    case MPI_BYTE:   out = {"|u1", 1}; return true;
-    case MPI_INT:    out = {"<i4", 4}; return true;
-    case MPI_LONG:   out = {"<i8", 8}; return true;
-    case MPI_FLOAT:  out = {"<f4", 4}; return true;
-    case MPI_DOUBLE: out = {"<f8", 8}; return true;
-  }
-  return false;
-}
-
-int raw_send(const void *buf, int count, MPI_Datatype dt, int dest,
-             int64_t tag, int64_t cid) {
-  DtInfo di;
-  if (!dtinfo(dt, di)) return MPI_ERR_ARG;
+// wire-send `count` contiguous base elements (world-rank addressing)
+int wire_send(const void *buf, size_t count, const DtInfo &di, int dest,
+              int64_t tag, int64_t cid) {
   if (dest == g.rank) {
     Message m;
     m.src = g.rank; m.tag = tag; m.cid = cid; m.seq = g.seq++;
     m.dt = di.tag;
-    m.data.assign((const char *)buf, (size_t)count * di.item);
-    {
-      std::lock_guard<std::mutex> lk(g.match_mu);
-      g.unexpected.push_back(std::move(m));
-    }
-    g.match_cv.notify_all();
+    m.data.assign((const char *)buf, count * di.item);
+    push_message(std::move(m));
     return MPI_SUCCESS;
   }
   int fd = endpoint(dest);
@@ -400,73 +670,464 @@ int raw_send(const void *buf, int count, MPI_Datatype dt, int dest,
   put_int(payload, tag);
   put_int(payload, cid);
   put_int(payload, g.seq++);
-  put_ndarray_1d(payload, di.tag, buf, (uint64_t)count, di.item);
+  put_ndarray_1d(payload, di.tag, buf, count, di.item);
   std::lock_guard<std::mutex> lk(g.send_mu);
   return send_frame(fd, payload) ? MPI_SUCCESS : MPI_ERR_OTHER;
 }
 
+// blocking internal recv of contiguous base elements (world addressing);
+// used by the collective algorithms
 int raw_recv(void *buf, int count, MPI_Datatype dt, int source, int64_t tag,
              int64_t cid, MPI_Status *status) {
-  DtInfo di;
-  if (!dtinfo(dt, di)) return MPI_ERR_ARG;
-  std::unique_lock<std::mutex> lk(g.match_mu);
-  int rc = MPI_SUCCESS;
-  auto match = [&]() -> bool {
-    for (auto it = g.unexpected.begin(); it != g.unexpected.end(); ++it) {
-      if (it->cid != cid) continue;
-      if (source != MPI_ANY_SOURCE && it->src != source) continue;
-      if (tag != MPI_ANY_TAG && it->tag != tag) continue;
-      size_t have = it->data.size();
-      size_t want = (size_t)count * di.item;
-      size_t copied = have > want ? want : have;
-      memcpy(buf, it->data.data(), copied);
-      if (have > want) rc = MPI_ERR_TRUNCATE;  // MPI truncation error
-      if (status) {
-        status->MPI_SOURCE = (int)it->src;
-        status->MPI_TAG = (int)it->tag;
-        status->MPI_ERROR = rc;
-        status->_count = (int)(copied / di.item);
-      }
-      g.unexpected.erase(it);
-      return true;
-    }
-    return false;
-  };
-  // wait until a matching message arrives (blocking recv only)
-  while (!match()) {
-    g.match_cv.wait_for(lk, std::chrono::milliseconds(100));
-    if (g.closing.load()) return MPI_ERR_OTHER;
-  }
-  return rc;
+  DtView v;
+  if (!resolve_dtype(dt, v)) return MPI_ERR_TYPE;
+  Req r;
+  r.is_recv = true;
+  r.user_buf = buf;
+  r.count = count;
+  int handle = post_recv(&r, v, cid, source, tag);
+  return wait_handle(handle, status);
 }
 
-// reduction kernels for the predefined ops
+int raw_send(const void *buf, int count, MPI_Datatype dt, int dest,
+             int64_t tag, int64_t cid) {
+  DtView v;
+  if (!resolve_dtype(dt, v)) return MPI_ERR_TYPE;
+  if (v.contiguous())
+    return wire_send(buf, (size_t)count * v.elems_per_item(), v.di, dest,
+                     tag, cid);
+  std::vector<char> packed;
+  pack_dtype(buf, count, v, packed);
+  return wire_send(packed.data(), packed.size() / v.di.item, v.di, dest,
+                   tag, cid);
+}
+
+// --------------------------------------------------------- communicators
+
+struct CommObj {
+  std::vector<int> group;   // local rank -> world rank
+  int local_rank = 0;
+  int64_t cid_pt2pt, cid_coll, cid_bar;
+  int64_t coll_seq = 0;
+  uint64_t child_seq = 0;
+};
+
+std::map<int, CommObj> g_comms;
+int g_next_comm = 2;  // 0 = WORLD, 1 = SELF
+
+CommObj *lookup_comm(MPI_Comm c) {
+  auto it = g_comms.find(c);
+  return it == g_comms.end() ? nullptr : &it->second;
+}
+
+int world_of(const CommObj &c, int local) {
+  return (local >= 0 && local < (int)c.group.size()) ? c.group[local] : -1;
+}
+
+int local_of(const CommObj &c, int world) {
+  for (size_t i = 0; i < c.group.size(); i++)
+    if (c.group[i] == world) return (int)i;
+  return MPI_ANY_SOURCE;
+}
+
+uint64_t mix64(uint64_t x) {
+  x += 0x9E3779B97F4A7C15ULL;
+  x = (x ^ (x >> 30)) * 0xBF58476D1CE4E5B9ULL;
+  x = (x ^ (x >> 27)) * 0x94D049BB133111EBULL;
+  return x ^ (x >> 31);
+}
+
+// Deterministic child context ids: every member of the parent computes
+// the same triple, so no agreement round is needed.  Disjoint sibling
+// groups may share a cid — harmless, they share no endpoints.  The low
+// reserved ids (0 pt2pt, 0x7FFC coll, 0x7FFD barrier, SELF block) are
+// below 0x10000; derived cids are forced above it.
+void derive_cids(const CommObj &parent, uint64_t salt, CommObj &child) {
+  uint64_t base =
+      mix64(mix64((uint64_t)parent.cid_pt2pt) ^
+            (parent.child_seq * 0x100000001B3ULL) ^ salt);
+  base = (base & 0x3FFFFFFFFFFFULL) | 0x10000ULL;
+  child.cid_pt2pt = (int64_t)base;
+  child.cid_coll = (int64_t)base + 1;
+  child.cid_bar = (int64_t)base + 2;
+}
+
+// ----------------------------------------------------------- reductions
+
 template <typename T>
-void reduce_t(T *acc, const T *in, int n, MPI_Op op) {
-  for (int i = 0; i < n; i++) {
-    switch (op) {
-      case MPI_SUM:  acc[i] = acc[i] + in[i]; break;
-      case MPI_PROD: acc[i] = acc[i] * in[i]; break;
-      case MPI_MAX:  acc[i] = acc[i] > in[i] ? acc[i] : in[i]; break;
-      case MPI_MIN:  acc[i] = acc[i] < in[i] ? acc[i] : in[i]; break;
-    }
+int reduce_arith(T *acc, const T *in, int n, MPI_Op op) {
+  switch (op) {
+    case MPI_SUM:
+      for (int i = 0; i < n; i++) acc[i] = acc[i] + in[i];
+      return MPI_SUCCESS;
+    case MPI_PROD:
+      for (int i = 0; i < n; i++) acc[i] = acc[i] * in[i];
+      return MPI_SUCCESS;
+    case MPI_MAX:
+      for (int i = 0; i < n; i++) acc[i] = acc[i] > in[i] ? acc[i] : in[i];
+      return MPI_SUCCESS;
+    case MPI_MIN:
+      for (int i = 0; i < n; i++) acc[i] = acc[i] < in[i] ? acc[i] : in[i];
+      return MPI_SUCCESS;
+    case MPI_LAND:
+      for (int i = 0; i < n; i++) acc[i] = (T)(acc[i] && in[i]);
+      return MPI_SUCCESS;
+    case MPI_LOR:
+      for (int i = 0; i < n; i++) acc[i] = (T)(acc[i] || in[i]);
+      return MPI_SUCCESS;
+    case MPI_LXOR:
+      for (int i = 0; i < n; i++) acc[i] = (T)(!acc[i] != !in[i]);
+      return MPI_SUCCESS;
   }
+  return MPI_ERR_OP;
 }
 
-void reduce_buf(void *acc, const void *in, int n, MPI_Datatype dt,
-                MPI_Op op) {
+template <typename T>
+int reduce_int(T *acc, const T *in, int n, MPI_Op op) {
+  switch (op) {
+    case MPI_BAND:
+      for (int i = 0; i < n; i++) acc[i] = acc[i] & in[i];
+      return MPI_SUCCESS;
+    case MPI_BOR:
+      for (int i = 0; i < n; i++) acc[i] = acc[i] | in[i];
+      return MPI_SUCCESS;
+    case MPI_BXOR:
+      for (int i = 0; i < n; i++) acc[i] = acc[i] ^ in[i];
+      return MPI_SUCCESS;
+  }
+  return reduce_arith(acc, in, n, op);
+}
+
+// acc = acc ⊕ in elementwise, acc as the LEFT operand (rank order is
+// the caller's responsibility; op.h:547-605's in-order contract)
+int reduce_buf(void *acc, const void *in, int n, MPI_Datatype dt,
+               MPI_Op op) {
   switch (dt) {
     case MPI_INT:
-      reduce_t((int32_t *)acc, (const int32_t *)in, n, op); break;
+      return reduce_int((int32_t *)acc, (const int32_t *)in, n, op);
     case MPI_LONG:
-      reduce_t((int64_t *)acc, (const int64_t *)in, n, op); break;
-    case MPI_FLOAT:
-      reduce_t((float *)acc, (const float *)in, n, op); break;
-    case MPI_DOUBLE:
-      reduce_t((double *)acc, (const double *)in, n, op); break;
+    case MPI_LONG_LONG:
+      return reduce_int((int64_t *)acc, (const int64_t *)in, n, op);
+    case MPI_CHAR:
+    case MPI_SIGNED_CHAR:
+      return reduce_int((int8_t *)acc, (const int8_t *)in, n, op);
+    case MPI_SHORT:
+      return reduce_int((int16_t *)acc, (const int16_t *)in, n, op);
     case MPI_BYTE:
-      reduce_t((uint8_t *)acc, (const uint8_t *)in, n, op); break;
+    case MPI_UNSIGNED_CHAR:
+      return reduce_int((uint8_t *)acc, (const uint8_t *)in, n, op);
+    case MPI_UNSIGNED_SHORT:
+      return reduce_int((uint16_t *)acc, (const uint16_t *)in, n, op);
+    case MPI_UNSIGNED:
+      return reduce_int((uint32_t *)acc, (const uint32_t *)in, n, op);
+    case MPI_UNSIGNED_LONG:
+      return reduce_int((uint64_t *)acc, (const uint64_t *)in, n, op);
+    case MPI_FLOAT:
+      // bitwise ops on floats are invalid (MPI-4.1 §6.9.2)
+      return reduce_arith((float *)acc, (const float *)in, n, op);
+    case MPI_DOUBLE:
+      return reduce_arith((double *)acc, (const double *)in, n, op);
   }
+  return MPI_ERR_TYPE;
+}
+
+// --------------------------------------------- comm-generic collectives
+// All take local-rank addressing and translate through comm.group;
+// WORLD keeps the round-3 wire format (cid 0x7FFC/0x7FFD) so mixed
+// C/Python jobs stay bit-compatible.
+
+// barrier signal frame: empty T_BYTES payload, bit-identical to
+// TcpProc.barrier's wire format (NOT a zero-length ndarray)
+int send_barrier_signal(CommObj &c, int dest_world) {
+  if (dest_world == g.rank) {
+    Message m;
+    m.src = g.rank; m.tag = 0x7FFD; m.cid = c.cid_bar; m.seq = g.seq++;
+    push_message(std::move(m));
+    return MPI_SUCCESS;
+  }
+  int fd = endpoint(dest_world);
+  if (fd < 0) return MPI_ERR_OTHER;
+  std::string payload;
+  put_varint(payload, 5);
+  put_int(payload, g.rank);
+  put_int(payload, 0x7FFD);
+  put_int(payload, c.cid_bar);
+  put_int(payload, g.seq++);
+  put_bytes(payload, "", 0);
+  std::lock_guard<std::mutex> lk(g.send_mu);
+  return send_frame(fd, payload) ? MPI_SUCCESS : MPI_ERR_OTHER;
+}
+
+int c_barrier(CommObj &c) {
+  // dissemination rounds (tag 0x7FFD), wire-identical to TcpProc.barrier
+  int n = (int)c.group.size(), me = c.local_rank;
+  for (int64_t k = 1; k < n; k <<= 1) {
+    int dest = (int)((me + k) % n);
+    int rc = send_barrier_signal(c, world_of(c, dest));
+    if (rc) return rc;
+    int src = (int)((me - k % n + n) % n);
+    uint8_t dummy[1];
+    rc = raw_recv(dummy, 0, MPI_BYTE, world_of(c, src), 0x7FFD, c.cid_bar,
+                  nullptr);
+    if (rc) return rc;
+  }
+  return MPI_SUCCESS;
+}
+
+int c_bcast(CommObj &c, void *buf, int count, MPI_Datatype dt, int root,
+            int64_t opcode) {
+  // binomial tree (coll_base_bcast.c:329 shape)
+  int n = (int)c.group.size(), me = c.local_rank;
+  int64_t tag = (c.coll_seq++ % 0x8000) << 16 | opcode;
+  int vrank = (me - root + n) % n;
+  if (vrank != 0) {
+    int parent = ((vrank & (vrank - 1)) + root) % n;
+    int rc = raw_recv(buf, count, dt, world_of(c, parent), tag, c.cid_coll,
+                      nullptr);
+    if (rc) return rc;
+  }
+  for (int mask = 1; mask < n; mask <<= 1) {
+    if ((vrank & (mask - 1)) == 0 && (vrank | mask) != vrank) {
+      int child = vrank | mask;
+      if (child < n) {
+        int rc = raw_send(buf, count, dt, world_of(c, (child + root) % n),
+                          tag, c.cid_coll);
+        if (rc) return rc;
+      }
+    }
+  }
+  return MPI_SUCCESS;
+}
+
+int c_allreduce(CommObj &c, const void *sendbuf, void *recvbuf, int count,
+                MPI_Datatype dt, MPI_Op op) {
+  // recursive doubling with the non-power-of-two pre/post fold
+  // (in-order combines: lower rank's operand left)
+  DtView v;
+  if (!resolve_dtype(dt, v) || v.derived) return MPI_ERR_TYPE;
+  size_t nbytes = (size_t)count * v.di.item;
+  memcpy(recvbuf, sendbuf, nbytes);
+  int n = (int)c.group.size(), me = c.local_rank;
+  if (n == 1) return MPI_SUCCESS;
+  int64_t tag = (c.coll_seq++ % 0x8000) << 16 | 0x7E03;
+  std::vector<char> other(nbytes);
+
+  int pof2 = 1;
+  while (pof2 * 2 <= n) pof2 *= 2;
+  int rem = n - pof2;
+  int newrank;
+  int rc;
+  if (me < 2 * rem) {
+    if (me % 2 == 0) {
+      rc = raw_send(recvbuf, count, dt, world_of(c, me + 1), tag,
+                    c.cid_coll);
+      if (rc) return rc;
+      newrank = -1;
+    } else {
+      rc = raw_recv(other.data(), count, dt, world_of(c, me - 1), tag,
+                    c.cid_coll, nullptr);
+      if (rc) return rc;
+      // lower rank's operand left: acc = other ⊕ acc
+      std::vector<char> tmp(other);
+      rc = reduce_buf(tmp.data(), recvbuf, count, dt, op);
+      if (rc) return rc;
+      memcpy(recvbuf, tmp.data(), nbytes);
+      newrank = me / 2;
+    }
+  } else {
+    newrank = me - rem;
+  }
+  if (newrank >= 0) {
+    for (int mask = 1; mask < pof2; mask <<= 1) {
+      int pnew = newrank ^ mask;
+      int partner = pnew < rem ? pnew * 2 + 1 : pnew + rem;
+      rc = raw_send(recvbuf, count, dt, world_of(c, partner), tag,
+                    c.cid_coll);
+      if (rc) return rc;
+      rc = raw_recv(other.data(), count, dt, world_of(c, partner), tag,
+                    c.cid_coll, nullptr);
+      if (rc) return rc;
+      if (partner < me) {
+        std::vector<char> tmp(other);
+        rc = reduce_buf(tmp.data(), recvbuf, count, dt, op);
+        if (rc) return rc;
+        memcpy(recvbuf, tmp.data(), nbytes);
+      } else {
+        rc = reduce_buf(recvbuf, other.data(), count, dt, op);
+        if (rc) return rc;
+      }
+    }
+  }
+  if (me < 2 * rem) {
+    if (me % 2 == 0) {
+      rc = raw_recv(recvbuf, count, dt, world_of(c, me + 1), tag,
+                    c.cid_coll, nullptr);
+      if (rc) return rc;
+    } else {
+      rc = raw_send(recvbuf, count, dt, world_of(c, me - 1), tag,
+                    c.cid_coll);
+      if (rc) return rc;
+    }
+  }
+  return MPI_SUCCESS;
+}
+
+int c_reduce(CommObj &c, const void *sendbuf, void *recvbuf, int count,
+             MPI_Datatype dt, MPI_Op op, int root) {
+  // linear with rank-ordered combine (coll/basic shape): correct for
+  // non-commutative user expectations, O(p) small messages at root
+  DtView v;
+  if (!resolve_dtype(dt, v) || v.derived) return MPI_ERR_TYPE;
+  int n = (int)c.group.size(), me = c.local_rank;
+  int64_t tag = (c.coll_seq++ % 0x8000) << 16 | 0x7E04;
+  size_t nbytes = (size_t)count * v.di.item;
+  if (me != root)
+    return raw_send(sendbuf, count, dt, world_of(c, root), tag,
+                    c.cid_coll);
+  std::vector<char> acc(nbytes), contrib(nbytes);
+  for (int r = 0; r < n; r++) {
+    const char *part;
+    if (r == me) {
+      part = (const char *)sendbuf;
+    } else {
+      int rc = raw_recv(contrib.data(), count, dt, world_of(c, r), tag,
+                        c.cid_coll, nullptr);
+      if (rc) return rc;
+      part = contrib.data();
+    }
+    if (r == 0) {
+      memcpy(acc.data(), part, nbytes);
+    } else {
+      int rc = reduce_buf(acc.data(), part, count, dt, op);
+      if (rc) return rc;
+    }
+  }
+  memcpy(recvbuf, acc.data(), nbytes);
+  return MPI_SUCCESS;
+}
+
+int c_gather(CommObj &c, const void *sendbuf, int sendcount,
+             MPI_Datatype sendtype, void *recvbuf, int recvcount,
+             MPI_Datatype recvtype, int root) {
+  // linear (coll_base_gather.c:41's basic shape)
+  int n = (int)c.group.size(), me = c.local_rank;
+  int64_t tag = (c.coll_seq++ % 0x8000) << 16 | 0x7E05;
+  if (me != root)
+    return raw_send(sendbuf, sendcount, sendtype, world_of(c, root), tag,
+                    c.cid_coll);
+  DtView rv;
+  if (!resolve_dtype(recvtype, rv)) return MPI_ERR_TYPE;
+  size_t slot = slot_bytes(rv, recvcount);
+  for (int r = 0; r < n; r++) {
+    char *dst = (char *)recvbuf + (size_t)r * slot;
+    if (r == me) {
+      DtView sv;
+      if (!resolve_dtype(sendtype, sv)) return MPI_ERR_TYPE;
+      std::vector<char> packed;
+      pack_dtype(sendbuf, sendcount, sv, packed);
+      unpack_dtype(dst, recvcount, rv, packed.data(), packed.size());
+    } else {
+      int rc = raw_recv(dst, recvcount, recvtype, world_of(c, r), tag,
+                        c.cid_coll, nullptr);
+      if (rc) return rc;
+    }
+  }
+  return MPI_SUCCESS;
+}
+
+int c_scatter(CommObj &c, const void *sendbuf, int sendcount,
+              MPI_Datatype sendtype, void *recvbuf, int recvcount,
+              MPI_Datatype recvtype, int root) {
+  // linear (coll_base_scatter.c's basic shape)
+  int n = (int)c.group.size(), me = c.local_rank;
+  int64_t tag = (c.coll_seq++ % 0x8000) << 16 | 0x7E06;
+  if (me != root)
+    return raw_recv(recvbuf, recvcount, recvtype, world_of(c, root), tag,
+                    c.cid_coll, nullptr);
+  DtView sv;
+  if (!resolve_dtype(sendtype, sv)) return MPI_ERR_TYPE;
+  size_t slot = slot_bytes(sv, sendcount);
+  for (int r = 0; r < n; r++) {
+    const char *src = (const char *)sendbuf + (size_t)r * slot;
+    if (r == me) {
+      DtView rv;
+      if (!resolve_dtype(recvtype, rv)) return MPI_ERR_TYPE;
+      std::vector<char> packed;
+      pack_dtype(src, sendcount, sv, packed);
+      unpack_dtype(recvbuf, recvcount, rv, packed.data(), packed.size());
+    } else {
+      int rc = raw_send(src, sendcount, sendtype, world_of(c, r), tag,
+                        c.cid_coll);
+      if (rc) return rc;
+    }
+  }
+  return MPI_SUCCESS;
+}
+
+int c_allgather(CommObj &c, const void *sendbuf, int sendcount,
+                MPI_Datatype sendtype, void *recvbuf, int recvcount,
+                MPI_Datatype recvtype) {
+  // ring (coll_base_allgather.c:358 shape): n-1 rounds of pass-along
+  int n = (int)c.group.size(), me = c.local_rank;
+  int64_t tag = (c.coll_seq++ % 0x8000) << 16 | 0x7E07;
+  DtView rv;
+  if (!resolve_dtype(recvtype, rv)) return MPI_ERR_TYPE;
+  size_t slot = slot_bytes(rv, recvcount);
+  // place own contribution
+  DtView sv;
+  if (!resolve_dtype(sendtype, sv)) return MPI_ERR_TYPE;
+  std::vector<char> packed;
+  pack_dtype(sendbuf, sendcount, sv, packed);
+  unpack_dtype((char *)recvbuf + (size_t)me * slot, recvcount, rv,
+               packed.data(), packed.size());
+  int right = (me + 1) % n, left = (me - 1 + n) % n;
+  for (int round = 0; round < n - 1; round++) {
+    int send_block = (me - round + n) % n;
+    int recv_block = (me - round - 1 + n) % n;
+    // eager sends are buffered by the drain threads, so the ring cannot
+    // deadlock even though every rank sends before receiving
+    int rc = raw_send((char *)recvbuf + (size_t)send_block * slot,
+                      recvcount, recvtype, world_of(c, right), tag,
+                      c.cid_coll);
+    if (rc) return rc;
+    rc = raw_recv((char *)recvbuf + (size_t)recv_block * slot, recvcount,
+                  recvtype, world_of(c, left), tag, c.cid_coll, nullptr);
+    if (rc) return rc;
+  }
+  return MPI_SUCCESS;
+}
+
+int c_alltoall(CommObj &c, const void *sendbuf, int sendcount,
+               MPI_Datatype sendtype, void *recvbuf, int recvcount,
+               MPI_Datatype recvtype) {
+  // pairwise exchange (coll_base_alltoall.c:132 shape); distinct tag
+  // per round keeps matching unambiguous
+  int n = (int)c.group.size(), me = c.local_rank;
+  int64_t tag = (c.coll_seq++ % 0x8000) << 16 | 0x7E08;
+  DtView sv, rv;
+  if (!resolve_dtype(sendtype, sv) || !resolve_dtype(recvtype, rv))
+    return MPI_ERR_TYPE;
+  size_t sslot = slot_bytes(sv, sendcount);
+  size_t rslot = slot_bytes(rv, recvcount);
+  // self block
+  {
+    std::vector<char> packed;
+    pack_dtype((const char *)sendbuf + (size_t)me * sslot, sendcount, sv,
+               packed);
+    unpack_dtype((char *)recvbuf + (size_t)me * rslot, recvcount, rv,
+                 packed.data(), packed.size());
+  }
+  for (int k = 1; k < n; k++) {
+    int to = (me + k) % n, from = (me - k + n) % n;
+    int rc = raw_send((const char *)sendbuf + (size_t)to * sslot,
+                      sendcount, sendtype, world_of(c, to), tag,
+                      c.cid_coll);
+    if (rc) return rc;
+    rc = raw_recv((char *)recvbuf + (size_t)from * rslot, recvcount,
+                  recvtype, world_of(c, from), tag, c.cid_coll, nullptr);
+    if (rc) return rc;
+  }
+  return MPI_SUCCESS;
 }
 
 }  // namespace
@@ -567,6 +1228,27 @@ int MPI_Init(int *, char ***) {
     for (auto &e : vals[0].items)
       g.book.push_back({e.items[0].s, (int)e.items[1].i});
   }
+
+  // predefined communicators.  WORLD keeps the round-3 wire cids for
+  // Python interop; SELF's context never leaves the process.
+  g_comms.clear();
+  g_next_comm = 2;
+  CommObj world;
+  world.group.resize(g.size);
+  for (int i = 0; i < g.size; i++) world.group[i] = i;
+  world.local_rank = g.rank;
+  world.cid_pt2pt = 0;
+  world.cid_coll = 0x7FFC;
+  world.cid_bar = 0x7FFD;
+  g_comms[MPI_COMM_WORLD] = world;
+  CommObj self;
+  self.group = {g.rank};
+  self.local_rank = 0;
+  self.cid_pt2pt = 0x7F00;
+  self.cid_coll = 0x7F01;
+  self.cid_bar = 0x7F02;
+  g_comms[MPI_COMM_SELF] = self;
+
   g.initialized = true;
   return MPI_SUCCESS;
 }
@@ -606,153 +1288,455 @@ int MPI_Finalize(void) {
     std::lock_guard<std::mutex> lk(g.conn_mu);
     g.conns.clear();
   }
+  {
+    std::lock_guard<std::mutex> lk(g.match_mu);
+    g.posted.clear();
+    for (auto &kv : g.reqs)
+      if (kv.second->heap) delete kv.second;  // un-waited Isend/Irecv
+    g.reqs.clear();
+    g.unexpected.clear();
+  }
+  g_comms.clear();
+  g_dtypes.clear();
+  g_next_dtype = DERIVED_BASE;
   g.initialized = false;
   return MPI_SUCCESS;
 }
 
-int MPI_Comm_rank(MPI_Comm, int *rank) {
-  *rank = g.rank;
+int MPI_Comm_rank(MPI_Comm comm, int *rank) {
+  CommObj *c = lookup_comm(comm);
+  if (!c) return MPI_ERR_COMM;
+  *rank = c->local_rank;
   return MPI_SUCCESS;
 }
 
-int MPI_Comm_size(MPI_Comm, int *size) {
-  *size = g.size;
+int MPI_Comm_size(MPI_Comm comm, int *size) {
+  CommObj *c = lookup_comm(comm);
+  if (!c) return MPI_ERR_COMM;
+  *size = (int)c->group.size();
   return MPI_SUCCESS;
 }
+
+int MPI_Get_processor_name(char *name, int *resultlen) {
+  if (gethostname(name, MPI_MAX_PROCESSOR_NAME - 1) != 0)
+    return MPI_ERR_OTHER;
+  name[MPI_MAX_PROCESSOR_NAME - 1] = '\0';
+  *resultlen = (int)strlen(name);
+  return MPI_SUCCESS;
+}
+
+// --------------------------------------------------------- communicator
+
+int MPI_Comm_split(MPI_Comm comm, int color, int key, MPI_Comm *newcomm) {
+  CommObj *c = lookup_comm(comm);
+  if (!c) return MPI_ERR_COMM;
+  int n = (int)c->group.size();
+  // allgather (color, key) over the parent (comm_split.c:40 gathers the
+  // same tuples before sorting)
+  std::vector<int64_t> mine = {color, key};
+  std::vector<int64_t> all(2 * (size_t)n);
+  int rc = c_allgather(*c, mine.data(), 2, MPI_LONG, all.data(), 2,
+                       MPI_LONG);
+  if (rc) return rc;
+  uint64_t salt = color == MPI_UNDEFINED ? 0 : (uint64_t)(int64_t)color;
+  // members of my color, ordered by (key, parent rank)
+  std::vector<std::pair<int64_t, int>> members;  // (key, parent local)
+  for (int r = 0; r < n; r++)
+    if (all[2 * r] == color) members.push_back({all[2 * r + 1], r});
+  std::stable_sort(members.begin(), members.end());
+  // every parent member advances the creation sequence identically,
+  // color or not — the deterministic-cid contract
+  CommObj child;
+  derive_cids(*c, salt, child);
+  c->child_seq++;
+  if (color == MPI_UNDEFINED) {
+    *newcomm = MPI_COMM_NULL;
+    return MPI_SUCCESS;
+  }
+  for (size_t i = 0; i < members.size(); i++) {
+    child.group.push_back(c->group[members[i].second]);
+    if (members[i].second == c->local_rank) child.local_rank = (int)i;
+  }
+  int handle = g_next_comm++;
+  g_comms[handle] = child;
+  *newcomm = handle;
+  return MPI_SUCCESS;
+}
+
+int MPI_Comm_dup(MPI_Comm comm, MPI_Comm *newcomm) {
+  CommObj *c = lookup_comm(comm);
+  if (!c) return MPI_ERR_COMM;
+  CommObj child;
+  derive_cids(*c, 0xD0B, child);
+  c->child_seq++;
+  child.group = c->group;
+  child.local_rank = c->local_rank;
+  int handle = g_next_comm++;
+  g_comms[handle] = child;
+  *newcomm = handle;
+  return MPI_SUCCESS;
+}
+
+int MPI_Comm_free(MPI_Comm *comm) {
+  if (!comm || *comm == MPI_COMM_WORLD || *comm == MPI_COMM_SELF)
+    return MPI_ERR_COMM;
+  if (!g_comms.erase(*comm)) return MPI_ERR_COMM;
+  *comm = MPI_COMM_NULL;
+  return MPI_SUCCESS;
+}
+
+// -------------------------------------------------------- point-to-point
 
 int MPI_Send(const void *buf, int count, MPI_Datatype dt, int dest,
-             int tag, MPI_Comm) {
+             int tag, MPI_Comm comm) {
+  CommObj *c = lookup_comm(comm);
+  if (!c) return MPI_ERR_COMM;
+  if (dest == MPI_PROC_NULL) return MPI_SUCCESS;
   if (tag < 0) return MPI_ERR_ARG;
-  if (dest < 0 || dest >= g.size) return MPI_ERR_ARG;
-  return raw_send(buf, count, dt, dest, tag, 0);
+  if (dest < 0 || dest >= (int)c->group.size()) return MPI_ERR_ARG;
+  return raw_send(buf, count, dt, world_of(*c, dest), tag, c->cid_pt2pt);
+}
+
+static int translate_status(CommObj *c, MPI_Status *status) {
+  if (status && c) {
+    int local = local_of(*c, status->MPI_SOURCE);
+    if (local != MPI_ANY_SOURCE) status->MPI_SOURCE = local;
+  }
+  return status ? status->MPI_ERROR : MPI_SUCCESS;
 }
 
 int MPI_Recv(void *buf, int count, MPI_Datatype dt, int source, int tag,
-             MPI_Comm, MPI_Status *status) {
-  return raw_recv(buf, count, dt, source, tag, 0, status);
+             MPI_Comm comm, MPI_Status *status) {
+  CommObj *c = lookup_comm(comm);
+  if (!c) return MPI_ERR_COMM;
+  if (source == MPI_PROC_NULL) {
+    if (status) {
+      status->MPI_SOURCE = MPI_PROC_NULL;
+      status->MPI_TAG = MPI_ANY_TAG;
+      status->MPI_ERROR = MPI_SUCCESS;
+      status->_count = 0;
+    }
+    return MPI_SUCCESS;
+  }
+  DtView v;
+  if (!resolve_dtype(dt, v)) return MPI_ERR_TYPE;
+  int src_world = source == MPI_ANY_SOURCE
+                      ? MPI_ANY_SOURCE
+                      : world_of(*c, source);
+  if (source != MPI_ANY_SOURCE && src_world < 0) return MPI_ERR_ARG;
+  MPI_Status st{};
+  int rc = raw_recv(buf, count, dt, src_world, tag, c->cid_pt2pt, &st);
+  if (status) {
+    *status = st;
+    translate_status(c, status);
+  }
+  return rc;
 }
 
-int MPI_Get_count(const MPI_Status *status, MPI_Datatype, int *count) {
-  *count = status->_count;
+int MPI_Get_count(const MPI_Status *status, MPI_Datatype dt, int *count) {
+  DtView v;
+  if (!resolve_dtype(dt, v)) return MPI_ERR_TYPE;
+  int64_t per = v.elems_per_item();
+  if (per == 0 || status->_count % per) {
+    *count = MPI_UNDEFINED;
+    return MPI_SUCCESS;
+  }
+  *count = (int)(status->_count / per);
   return MPI_SUCCESS;
 }
 
-int MPI_Barrier(MPI_Comm) {
-  // dissemination rounds, wire-identical to TcpProc.barrier (tag/cid
-  // 0x7FFD, empty-bytes payload)
-  for (int64_t k = 1; k < g.size; k <<= 1) {
-    int dest = (int)((g.rank + k) % g.size);
-    int fd = dest == g.rank ? -2 : endpoint(dest);
-    if (dest == g.rank) {
-      // size 1: nothing on the wire
-    } else {
-      if (fd < 0) return MPI_ERR_OTHER;
-      std::string payload;
-      put_varint(payload, 5);
-      put_int(payload, g.rank);
-      put_int(payload, 0x7FFD);
-      put_int(payload, 0x7FFD);
-      put_int(payload, g.seq++);
-      put_bytes(payload, "", 0);
-      {
-        std::lock_guard<std::mutex> lk(g.send_mu);
-        if (!send_frame(fd, payload)) return MPI_ERR_OTHER;
-      }
-      int src = (int)((g.rank - k % g.size + g.size) % g.size);
-      uint8_t dummy[1];
-      int rc = raw_recv(dummy, 0, MPI_BYTE, src, 0x7FFD, 0x7FFD, nullptr);
-      if (rc != MPI_SUCCESS) return rc;
+int MPI_Isend(const void *buf, int count, MPI_Datatype dt, int dest,
+              int tag, MPI_Comm comm, MPI_Request *request) {
+  // Eager protocol: the payload is on the wire (or in the peer's
+  // unexpected queue) before return, so the request is born complete —
+  // pml_ob1's start_copy fast path (pml_ob1_sendreq.h:399-405).
+  CommObj *c = lookup_comm(comm);
+  if (!c) return MPI_ERR_COMM;
+  int rc = MPI_SUCCESS;
+  if (dest != MPI_PROC_NULL) {
+    if (tag < 0) return MPI_ERR_ARG;
+    if (dest < 0 || dest >= (int)c->group.size()) return MPI_ERR_ARG;
+    rc = raw_send(buf, count, dt, world_of(*c, dest), tag, c->cid_pt2pt);
+    if (rc) return rc;
+  }
+  Req *r = new Req;
+  r->complete = true;
+  r->heap = true;
+  r->comm = comm;
+  std::lock_guard<std::mutex> lk(g.match_mu);
+  int handle = g.next_req++;
+  g.reqs[handle] = r;
+  *request = handle;
+  return rc;
+}
+
+int MPI_Irecv(void *buf, int count, MPI_Datatype dt, int source, int tag,
+              MPI_Comm comm, MPI_Request *request) {
+  CommObj *c = lookup_comm(comm);
+  if (!c) return MPI_ERR_COMM;
+  DtView v;
+  if (!resolve_dtype(dt, v)) return MPI_ERR_TYPE;
+  if (source == MPI_PROC_NULL) {
+    Req *r = new Req;
+    r->complete = true;
+    r->heap = true;
+    r->comm = comm;
+    r->status.MPI_SOURCE = MPI_PROC_NULL;
+    r->status.MPI_TAG = MPI_ANY_TAG;
+    std::lock_guard<std::mutex> lk(g.match_mu);
+    int handle = g.next_req++;
+    g.reqs[handle] = r;
+    *request = handle;
+    return MPI_SUCCESS;
+  }
+  int src_world = source == MPI_ANY_SOURCE
+                      ? MPI_ANY_SOURCE
+                      : world_of(*c, source);
+  if (source != MPI_ANY_SOURCE && src_world < 0) return MPI_ERR_ARG;
+  Req *r = new Req;
+  r->is_recv = true;
+  r->heap = true;
+  r->comm = comm;
+  r->user_buf = buf;
+  r->count = count;
+  *request = post_recv(r, v, c->cid_pt2pt, src_world, tag);
+  return MPI_SUCCESS;
+}
+
+int MPI_Wait(MPI_Request *request, MPI_Status *status) {
+  if (!request || *request == MPI_REQUEST_NULL) {
+    if (status) {
+      status->MPI_SOURCE = MPI_ANY_SOURCE;
+      status->MPI_TAG = MPI_ANY_TAG;
+      status->MPI_ERROR = MPI_SUCCESS;
+      status->_count = 0;
+    }
+    return MPI_SUCCESS;
+  }
+  int comm_handle;
+  {
+    std::lock_guard<std::mutex> lk(g.match_mu);
+    auto it = g.reqs.find(*request);
+    if (it == g.reqs.end()) return MPI_ERR_REQUEST;
+    comm_handle = it->second->comm;
+  }
+  MPI_Status st{};
+  int rc = wait_handle_impl(*request, &st);
+  if (status) {
+    *status = st;
+    translate_status(lookup_comm(comm_handle), status);
+  }
+  *request = MPI_REQUEST_NULL;
+  return rc;
+}
+
+int MPI_Test(MPI_Request *request, int *flag, MPI_Status *status) {
+  if (!request || *request == MPI_REQUEST_NULL) {
+    *flag = 1;
+    return MPI_SUCCESS;
+  }
+  {
+    std::lock_guard<std::mutex> lk(g.match_mu);
+    auto it = g.reqs.find(*request);
+    if (it == g.reqs.end()) return MPI_ERR_REQUEST;
+    if (!it->second->complete) {
+      *flag = 0;
+      return MPI_SUCCESS;
     }
   }
-  return MPI_SUCCESS;
+  *flag = 1;
+  return MPI_Wait(request, status);
+}
+
+int MPI_Waitall(int count, MPI_Request requests[], MPI_Status statuses[]) {
+  int rc = MPI_SUCCESS;
+  for (int i = 0; i < count; i++) {
+    int r = MPI_Wait(&requests[i],
+                     statuses ? &statuses[i] : MPI_STATUS_IGNORE);
+    if (r != MPI_SUCCESS) rc = r;
+  }
+  return rc;
+}
+
+int MPI_Sendrecv(const void *sendbuf, int sendcount, MPI_Datatype sendtype,
+                 int dest, int sendtag, void *recvbuf, int recvcount,
+                 MPI_Datatype recvtype, int source, int recvtag,
+                 MPI_Comm comm, MPI_Status *status) {
+  // irecv-first so crossed Sendrecv pairs cannot deadlock
+  // (coll_base_util.h:70-98's sendrecv primitive)
+  MPI_Request rreq;
+  int rc = MPI_Irecv(recvbuf, recvcount, recvtype, source, recvtag, comm,
+                     &rreq);
+  if (rc) return rc;
+  rc = MPI_Send(sendbuf, sendcount, sendtype, dest, sendtag, comm);
+  if (rc) return rc;
+  return MPI_Wait(&rreq, status);
+}
+
+// ----------------------------------------------------------- collectives
+
+int MPI_Barrier(MPI_Comm comm) {
+  CommObj *c = lookup_comm(comm);
+  if (!c) return MPI_ERR_COMM;
+  return c_barrier(*c);
+}
+
+int MPI_Bcast(void *buf, int count, MPI_Datatype dt, int root,
+              MPI_Comm comm) {
+  CommObj *c = lookup_comm(comm);
+  if (!c) return MPI_ERR_COMM;
+  if (root < 0 || root >= (int)c->group.size()) return MPI_ERR_ARG;
+  return c_bcast(*c, buf, count, dt, root, 0x7E01);
 }
 
 int MPI_Allreduce(const void *sendbuf, void *recvbuf, int count,
-                  MPI_Datatype dt, MPI_Op op, MPI_Comm) {
-  // recursive doubling with the non-power-of-two pre/post fold
-  // (in-order combines: lower rank's operand left)
-  DtInfo di;
-  if (!dtinfo(dt, di)) return MPI_ERR_ARG;
-  size_t nbytes = (size_t)count * di.item;
-  memcpy(recvbuf, sendbuf, nbytes);
-  if (g.size == 1) return MPI_SUCCESS;
-  int64_t cid = 0x7FFC;
-  int64_t tag = (g.coll_seq++ % 0x8000) << 16 | 0x7E03;
-  std::vector<char> other(nbytes);
+                  MPI_Datatype dt, MPI_Op op, MPI_Comm comm) {
+  CommObj *c = lookup_comm(comm);
+  if (!c) return MPI_ERR_COMM;
+  return c_allreduce(*c, sendbuf, recvbuf, count, dt, op);
+}
 
-  int pof2 = 1;
-  while (pof2 * 2 <= g.size) pof2 *= 2;
-  int rem = g.size - pof2;
-  int newrank;
-  if (g.rank < 2 * rem) {
-    if (g.rank % 2 == 0) {
-      int rc = raw_send(recvbuf, count, dt, g.rank + 1, tag, cid);
-      if (rc) return rc;
-      newrank = -1;
+int MPI_Reduce(const void *sendbuf, void *recvbuf, int count,
+               MPI_Datatype dt, MPI_Op op, int root, MPI_Comm comm) {
+  CommObj *c = lookup_comm(comm);
+  if (!c) return MPI_ERR_COMM;
+  if (root < 0 || root >= (int)c->group.size()) return MPI_ERR_ARG;
+  return c_reduce(*c, sendbuf, recvbuf, count, dt, op, root);
+}
+
+int MPI_Gather(const void *sendbuf, int sendcount, MPI_Datatype sendtype,
+               void *recvbuf, int recvcount, MPI_Datatype recvtype,
+               int root, MPI_Comm comm) {
+  CommObj *c = lookup_comm(comm);
+  if (!c) return MPI_ERR_COMM;
+  if (root < 0 || root >= (int)c->group.size()) return MPI_ERR_ARG;
+  return c_gather(*c, sendbuf, sendcount, sendtype, recvbuf, recvcount,
+                  recvtype, root);
+}
+
+int MPI_Scatter(const void *sendbuf, int sendcount, MPI_Datatype sendtype,
+                void *recvbuf, int recvcount, MPI_Datatype recvtype,
+                int root, MPI_Comm comm) {
+  CommObj *c = lookup_comm(comm);
+  if (!c) return MPI_ERR_COMM;
+  if (root < 0 || root >= (int)c->group.size()) return MPI_ERR_ARG;
+  return c_scatter(*c, sendbuf, sendcount, sendtype, recvbuf, recvcount,
+                   recvtype, root);
+}
+
+int MPI_Allgather(const void *sendbuf, int sendcount,
+                  MPI_Datatype sendtype, void *recvbuf, int recvcount,
+                  MPI_Datatype recvtype, MPI_Comm comm) {
+  CommObj *c = lookup_comm(comm);
+  if (!c) return MPI_ERR_COMM;
+  return c_allgather(*c, sendbuf, sendcount, sendtype, recvbuf, recvcount,
+                     recvtype);
+}
+
+int MPI_Alltoall(const void *sendbuf, int sendcount, MPI_Datatype sendtype,
+                 void *recvbuf, int recvcount, MPI_Datatype recvtype,
+                 MPI_Comm comm) {
+  CommObj *c = lookup_comm(comm);
+  if (!c) return MPI_ERR_COMM;
+  return c_alltoall(*c, sendbuf, sendcount, sendtype, recvbuf, recvcount,
+                    recvtype);
+}
+
+// ------------------------------------------------------------- datatypes
+
+int MPI_Type_contiguous(int count, MPI_Datatype oldtype,
+                        MPI_Datatype *newtype) {
+  // type_contiguous.c analog; nesting flattens (old derived types
+  // expand into their base blocks)
+  if (count < 0) return MPI_ERR_ARG;
+  DtView v;
+  if (!resolve_dtype(oldtype, v)) return MPI_ERR_TYPE;
+  DtypeObj d;
+  d.base = v.derived ? v.derived->base : oldtype;
+  int64_t old_extent = v.derived ? v.derived->extent : 1;
+  for (int c = 0; c < count; c++) {
+    int64_t off = c * old_extent;
+    if (v.derived) {
+      for (auto &b : v.derived->blocks)
+        d.blocks.push_back({off + b.first, b.second});
     } else {
-      int rc = raw_recv(other.data(), count, dt, g.rank - 1, tag, cid,
-                        nullptr);
-      if (rc) return rc;
-      // lower rank's operand left: acc = other ⊕ acc
-      std::vector<char> tmp(other);
-      reduce_buf(tmp.data(), recvbuf, count, dt, op);
-      memcpy(recvbuf, tmp.data(), nbytes);
-      newrank = g.rank / 2;
+      d.blocks.push_back({off, 1});
     }
-  } else {
-    newrank = g.rank - rem;
   }
-  if (newrank >= 0) {
-    for (int mask = 1; mask < pof2; mask <<= 1) {
-      int pnew = newrank ^ mask;
-      int partner = pnew < rem ? pnew * 2 + 1 : pnew + rem;
-      int rc = raw_send(recvbuf, count, dt, partner, tag, cid);
-      if (rc) return rc;
-      rc = raw_recv(other.data(), count, dt, partner, tag, cid, nullptr);
-      if (rc) return rc;
-      if (partner < g.rank) {
-        std::vector<char> tmp(other);
-        reduce_buf(tmp.data(), recvbuf, count, dt, op);
-        memcpy(recvbuf, tmp.data(), nbytes);
+  coalesce_blocks(d.blocks);
+  d.extent = count * old_extent;
+  d.elems = count * v.elems_per_item();
+  MPI_Datatype handle = g_next_dtype++;
+  g_dtypes[handle] = d;
+  *newtype = handle;
+  return MPI_SUCCESS;
+}
+
+int MPI_Type_vector(int count, int blocklength, int stride,
+                    MPI_Datatype oldtype, MPI_Datatype *newtype) {
+  // type_vector.c analog; stride in units of oldtype extent
+  if (count < 0 || blocklength < 0) return MPI_ERR_ARG;
+  DtView v;
+  if (!resolve_dtype(oldtype, v)) return MPI_ERR_TYPE;
+  DtypeObj d;
+  d.base = v.derived ? v.derived->base : oldtype;
+  int64_t old_extent = v.derived ? v.derived->extent : 1;
+  int64_t max_off = 0;
+  for (int c = 0; c < count; c++) {
+    for (int b = 0; b < blocklength; b++) {
+      int64_t off = ((int64_t)c * stride + b) * old_extent;
+      if (off < 0) return MPI_ERR_ARG;  // negative stride unsupported
+      if (v.derived) {
+        for (auto &bb : v.derived->blocks)
+          d.blocks.push_back({off + bb.first, bb.second});
       } else {
-        reduce_buf(recvbuf, other.data(), count, dt, op);
+        d.blocks.push_back({off, 1});
       }
+      if (off + old_extent > max_off) max_off = off + old_extent;
     }
   }
-  if (g.rank < 2 * rem) {
-    if (g.rank % 2 == 0) {
-      int rc = raw_recv(recvbuf, count, dt, g.rank + 1, tag, cid, nullptr);
-      if (rc) return rc;
-    } else {
-      int rc = raw_send(recvbuf, count, dt, g.rank - 1, tag, cid);
-      if (rc) return rc;
-    }
-  }
+  coalesce_blocks(d.blocks);
+  d.extent = max_off;
+  d.elems = (int64_t)count * blocklength * v.elems_per_item();
+  MPI_Datatype handle = g_next_dtype++;
+  g_dtypes[handle] = d;
+  *newtype = handle;
   return MPI_SUCCESS;
 }
 
-int MPI_Bcast(void *buf, int count, MPI_Datatype dt, int root, MPI_Comm) {
-  // binomial tree (coll_base_bcast.c:329 shape)
-  int64_t cid = 0x7FFC;
-  int64_t tag = (g.coll_seq++ % 0x8000) << 16 | 0x7E01;
-  int vrank = (g.rank - root + g.size) % g.size;
-  if (vrank != 0) {
-    int parent = ((vrank & (vrank - 1)) + root) % g.size;
-    int rc = raw_recv(buf, count, dt, parent, tag, cid, nullptr);
-    if (rc) return rc;
-  }
-  for (int mask = 1; mask < g.size; mask <<= 1) {
-    if ((vrank & (mask - 1)) == 0 && (vrank | mask) != vrank) {
-      int child = vrank | mask;
-      if (child < g.size) {
-        int rc = raw_send(buf, count, dt, (child + root) % g.size, tag,
-                          cid);
-        if (rc) return rc;
-      }
-    }
-  }
+int MPI_Type_commit(MPI_Datatype *datatype) {
+  if (!datatype) return MPI_ERR_TYPE;
+  if (*datatype < DERIVED_BASE) return MPI_SUCCESS;  // predefined
+  auto it = g_dtypes.find(*datatype);
+  if (it == g_dtypes.end()) return MPI_ERR_TYPE;
+  it->second.committed = true;
   return MPI_SUCCESS;
 }
+
+int MPI_Type_free(MPI_Datatype *datatype) {
+  if (!datatype || *datatype < DERIVED_BASE) return MPI_ERR_TYPE;
+  if (!g_dtypes.erase(*datatype)) return MPI_ERR_TYPE;
+  *datatype = MPI_DATATYPE_NULL;
+  return MPI_SUCCESS;
+}
+
+int MPI_Type_size(MPI_Datatype datatype, int *size) {
+  DtView v;
+  if (datatype >= DERIVED_BASE) {
+    // committed not required for size queries
+    auto it = g_dtypes.find(datatype);
+    if (it == g_dtypes.end()) return MPI_ERR_TYPE;
+    DtInfo di;
+    if (!base_dtinfo(it->second.base, di)) return MPI_ERR_TYPE;
+    *size = (int)(it->second.elems * di.item);
+    return MPI_SUCCESS;
+  }
+  if (!resolve_dtype(datatype, v)) return MPI_ERR_TYPE;
+  *size = (int)v.di.item;
+  return MPI_SUCCESS;
+}
+
+// ---------------------------------------------------------------- misc
 
 int MPI_Abort(MPI_Comm, int errorcode) {
   fprintf(stderr, "MPI_Abort(%d)\n", errorcode);
@@ -764,5 +1748,7 @@ double MPI_Wtime(void) {
   return std::chrono::duration<double>(clock::now().time_since_epoch())
       .count();
 }
+
+double MPI_Wtick(void) { return 1e-9; }
 
 }  // extern "C"
