@@ -13,6 +13,7 @@ transfers, fs owns the file object.  One component ships (posix over
 from __future__ import annotations
 
 import os
+import threading
 
 import numpy as np
 
@@ -57,6 +58,98 @@ class PosixFbtl(FbtlComponent):
                 out[pos + got.size : pos + length] = 0
             pos += length
         return out
+
+
+class AsyncFbtl:
+    """Nonblocking transfers over any fbtl component — the analog of the
+    reference's async fbtl entry points (``fbtl_posix_ipreadv.c`` /
+    ``fbtl_posix_ipwritev.c``, which queue aio control blocks and retire
+    them from progress).  Here a small worker pool retires the at-offset
+    syscalls while the caller computes; completion flows through the
+    standard framework :class:`~zhpe_ompi_tpu.pt2pt.requests.Request`
+    machinery (wait/test/wait_all), exactly as OMPIO's request wraps the
+    aio state.
+
+    The pool is lazy and shared per-process (the reference sizes its aio
+    queue globally, ``fbtl_posix_component.c``); two workers keep one
+    read and one write in flight, enough to overlap IO with compute
+    without reordering same-file writes observed through ``sync``."""
+
+    _pool = None
+    _pool_lock = threading.Lock()
+
+    def __init__(self, base: FbtlComponent):
+        self.base = base
+
+    @classmethod
+    def _executor(cls):
+        if cls._pool is None:
+            from concurrent.futures import ThreadPoolExecutor
+
+            with cls._pool_lock:
+                if cls._pool is None:
+                    cls._pool = ThreadPoolExecutor(
+                        max_workers=2, thread_name_prefix="zmpi-fbtl"
+                    )
+        return cls._pool
+
+    def _submit(self, fn, *args):
+        req = FileRequest()
+
+        def run():
+            try:
+                req.complete(fn(*args))
+            except BaseException as e:  # noqa: BLE001 — crosses threads
+                req.fail(e)
+
+        self._executor().submit(run)
+        return req
+
+    def ipwritev(self, fd: int, runs, data: np.ndarray):
+        """Nonblocking pwritev: returns a Request whose value is bytes
+        written."""
+        return self._submit(self.base.pwritev, fd, list(runs),
+                            np.ascontiguousarray(data))
+
+    def ipreadv(self, fd: int, runs, total: int):
+        """Nonblocking preadv: returns a Request whose value is the
+        uint8 buffer."""
+        return self._submit(self.base.preadv, fd, list(runs), total)
+
+
+class FileRequest:
+    """Request for nonblocking file ops: the standard wait/test surface
+    plus error transport from the worker thread (the reference surfaces
+    aio errors at MPI_Wait time, not at the iwrite call)."""
+
+    def __init__(self):
+        from ..pt2pt import requests as req_mod
+
+        self._req = req_mod.Request()
+        self._exc: BaseException | None = None
+
+    def complete(self, value) -> None:
+        self._req.complete(value)
+
+    def fail(self, exc: BaseException) -> None:
+        self._exc = exc
+        self._req.complete(None)
+
+    @property
+    def done(self) -> bool:
+        return self._req.done
+
+    def test(self):
+        flag, value = self._req.test()
+        if flag and self._exc is not None:
+            raise self._exc
+        return flag, value
+
+    def wait(self, timeout: float | None = None):
+        value = self._req.wait(timeout)
+        if self._exc is not None:
+            raise self._exc
+        return value
 
 
 def fbtl_framework() -> mca_component.Framework:
