@@ -1,6 +1,6 @@
-"""MFU lever sweep on the real chip: batch size x remat x flash for the
-headline config.  Steady-state discipline from bench.py (burn-in window,
-median of 3).
+"""MFU lever sweep on the real chip: batch x remat x the round-4 levers
+(fused Pallas layernorm, vocab-chunked CE) for the headline config.
+Steady-state discipline from bench.py (burn-in window, median of 3).
 
 Run from repo root: python benchmarks/mfu_sweep.py
 """
@@ -29,13 +29,21 @@ def main():
 
     peak, _ = bench._chip_peak(devs[0])
 
-    for batch, remat, seq in [
-        (8, False, 512), (16, False, 512), (32, False, 512),
-        (16, True, 512), (32, True, 512), (64, True, 512),
+    # (batch, remat, seq, fused_ln, ce_chunk): the round-3 grid plus the
+    # round-4 levers individually and together at the measured optimum
+    for batch, remat, seq, fused_ln, ce_chunk in [
+        (8, False, 512, False, None), (16, False, 512, False, None),
+        (32, False, 512, False, None), (16, True, 512, False, None),
+        (32, True, 512, False, None), (64, True, 512, False, None),
+        # levers, one at a time then together, at B16/B32 + remat
+        (16, True, 512, None, None), (16, True, 512, False, 1024),
+        (16, True, 512, None, 1024), (32, True, 512, None, 1024),
+        (16, True, 512, None, 512), (16, True, 512, None, 2048),
     ]:
         cfg = tfm.Config(
             vocab=8192, d_model=1024, n_heads=16, d_ff=4096, n_layers=4,
-            seq=seq, dtype=jnp.bfloat16, remat=remat,
+            seq=seq, dtype=jnp.bfloat16, remat=remat, fused_ln=fused_ln,
+            ce_chunk=ce_chunk,
         )
         r = np.random.default_rng(0)
         params = tfm.init_params(cfg, jax.random.PRNGKey(0))
@@ -62,7 +70,9 @@ def main():
                     times.append((time.perf_counter() - t0) / iters)
             med = float(np.median(times))
             fl = bench._train_flops_per_step(cfg, batch)
-            print(f"B={batch:3d} remat={int(remat)} seq={seq}: "
+            lev = f"ln={'auto' if fused_ln is None else int(fused_ln)} " \
+                  f"ce={ce_chunk or 0}"
+            print(f"B={batch:3d} remat={int(remat)} seq={seq} {lev}: "
                   f"{med*1e3:7.2f} ms  {batch*seq/med:9.0f} tok/s  "
                   f"MFU {fl/med/peak*100:5.2f}%", flush=True)
         except Exception as e:

@@ -403,6 +403,47 @@ class TestNonblockingIO:
             np.testing.assert_array_equal(got, f.read_at(0, 8))
         np.testing.assert_array_equal(got, [0, 1, 4, 5, 6, 7, 10, 11])
 
+    def test_close_drains_inflight_requests(self, tmp_path, world):
+        """close() must complete pending async transfers before the fd
+        dies — a recycled fd number must never receive a stale write."""
+        import threading
+
+        p = str(tmp_path / "drain.bin")
+        f = zio.File(world, p, zio.MODE_CREATE | zio.MODE_RDWR)
+        gated = _GatedFbtl(f._fbtl)
+        f._fbtl = gated
+        req = f.iwrite_at(0, np.arange(50, dtype=np.uint8))
+        assert not req.done
+        # release the gate from another thread while close() drains
+        threading.Timer(0.2, gated.gate.set).start()
+        f.close()  # must block until the write retired
+        assert req.done and req.wait(timeout=5) == 50
+        got = np.fromfile(p, dtype=np.uint8)
+        np.testing.assert_array_equal(got, np.arange(50, dtype=np.uint8))
+
+    def test_nonblocking_honors_selected_fcoll(self, tmp_path, world):
+        """The async path routes through the SAME MCA-selected fcoll
+        component as the blocking path (no parallel engine)."""
+        calls = []
+
+        p = str(tmp_path / "fc.bin")
+        with zio.File(world, p, zio.MODE_CREATE | zio.MODE_RDWR) as f:
+            real = f._fcoll
+
+            class Spy:
+                def read(self, fbtl, fd, offs):
+                    calls.append("read")
+                    return real.read(fbtl, fd, offs)
+
+                def write(self, fbtl, fd, per_rank):
+                    calls.append("write")
+                    return real.write(fbtl, fd, per_rank)
+
+            f._fcoll = Spy()
+            f.iwrite_at(0, np.arange(8, dtype=np.uint8)).wait(timeout=30)
+            f.iread_at(0, 8).wait(timeout=30)
+        assert calls == ["write", "read"]
+
     def test_pointer_advances_at_call_time(self, tmp_path, world):
         """MPI nonblocking-pointer contract: iread/iwrite consume the
         individual pointer immediately, so back-to-back calls address

@@ -71,15 +71,20 @@ class AsyncFbtl:
     aio state.
 
     The pool is lazy and shared per-process (the reference sizes its aio
-    queue globally, ``fbtl_posix_component.c``); two workers keep one
-    read and one write in flight, enough to overlap IO with compute
-    without reordering same-file writes observed through ``sync``."""
+    queue globally, ``fbtl_posix_component.c``).  Ordering: in-flight
+    requests are independent and may complete in any order — MPI's
+    non-atomic file mode; concurrent writes to overlapping regions are
+    the caller's race, as in the reference.  ``drain`` completes every
+    in-flight transfer (File.close calls it so a recycled fd can never
+    receive a stale async write)."""
 
     _pool = None
     _pool_lock = threading.Lock()
 
     def __init__(self, base: FbtlComponent):
         self.base = base
+        self._inflight: set = set()
+        self._mu = threading.Lock()
 
     @classmethod
     def _executor(cls):
@@ -93,28 +98,49 @@ class AsyncFbtl:
                     )
         return cls._pool
 
-    def _submit(self, fn, *args):
+    def submit(self, fn, *args):
+        """Run any transfer callable on the pool; returns a FileRequest.
+        The file layer routes its MCA-selected fcoll through this, so
+        the nonblocking path uses the same strategy component as the
+        blocking one."""
         req = FileRequest()
+        with self._mu:
+            self._inflight.add(req)
 
         def run():
             try:
                 req.complete(fn(*args))
             except BaseException as e:  # noqa: BLE001 — crosses threads
                 req.fail(e)
+            finally:
+                with self._mu:
+                    self._inflight.discard(req)
 
         self._executor().submit(run)
         return req
 
+    def drain(self, timeout: float = 60.0) -> None:
+        """Complete every in-flight transfer (close-time quiescence —
+        the reference completes pending aio before the fd dies).  Errors
+        stay with their requests and re-raise at the owner's wait."""
+        with self._mu:
+            pending = list(self._inflight)
+        for r in pending:
+            try:
+                r.wait(timeout)
+            except BaseException:  # noqa: BLE001 — owner's wait re-raises
+                pass
+
     def ipwritev(self, fd: int, runs, data: np.ndarray):
         """Nonblocking pwritev: returns a Request whose value is bytes
         written."""
-        return self._submit(self.base.pwritev, fd, list(runs),
-                            np.ascontiguousarray(data))
+        return self.submit(self.base.pwritev, fd, list(runs),
+                           np.ascontiguousarray(data))
 
     def ipreadv(self, fd: int, runs, total: int):
         """Nonblocking preadv: returns a Request whose value is the
         uint8 buffer."""
-        return self._submit(self.base.preadv, fd, list(runs), total)
+        return self.submit(self.base.preadv, fd, list(runs), total)
 
 
 class FileRequest:

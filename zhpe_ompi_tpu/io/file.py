@@ -118,34 +118,29 @@ class _MappedRequest:
 
 # Shared nonblocking engine for File and WireFile (MPI_File_iread/iwrite
 # over the async fbtl; reference ompi/mpi/c/file_iwrite.c:38 +
-# fbtl_posix_ipreadv.c): sort the view's byte offsets into maximal runs,
-# hand the transfer to the worker pool, and undo the permutation / type
-# the result at completion.
+# fbtl_posix_ipreadv.c): the SAME MCA-selected fcoll strategy the
+# blocking path uses, submitted to the worker pool — one
+# sort/coalesce/unpermute engine for both paths.
 
-def iread_offsets(async_fbtl, fd: int, offsets: np.ndarray, np_dtype):
-    from .fcoll import runs_of
-
-    order = np.argsort(offsets, kind="stable")
-    inner = async_fbtl.ipreadv(fd, runs_of(offsets[order]), offsets.size)
+def iread_offsets(async_fbtl, fcoll, fbtl, fd: int, offsets: np.ndarray,
+                  np_dtype):
+    inner = async_fbtl.submit(
+        lambda: fcoll.read(fbtl, fd, [offsets])[0])
 
     def fn(raw):
-        out = np.empty(offsets.size, dtype=np.uint8)
-        out[order] = raw
-        return out.view(np_dtype) if np_dtype is not None else out
+        return raw.view(np_dtype) if np_dtype is not None else raw
 
     return _MappedRequest(inner, fn)
 
 
-def iwrite_offsets(async_fbtl, fd: int, offsets: np.ndarray,
-                   data: np.ndarray, etype_size: int):
-    from .fcoll import runs_of
-
-    order = np.argsort(offsets, kind="stable")
-    # data[order] materializes a fresh array, so the caller may reuse
-    # its buffer immediately (no extra defensive copy needed)
-    inner = async_fbtl.ipwritev(fd, runs_of(offsets[order]), data[order])
-    return _MappedRequest(
-        inner, lambda nbytes: nbytes // etype_size if etype_size else 0)
+def iwrite_offsets(async_fbtl, fcoll, fbtl, fd: int, offsets: np.ndarray,
+                   data: np.ndarray, count: int):
+    # defensive copy: the worker reads `data` later, after this call has
+    # returned — the caller is free to reuse its buffer immediately
+    data = data.copy()
+    inner = async_fbtl.submit(
+        lambda: fcoll.write(fbtl, fd, [(offsets, data)]))
+    return _MappedRequest(inner, lambda _nbytes: count)
 
 
 class File(errhandler.HasErrhandler):
@@ -185,6 +180,12 @@ class File(errhandler.HasErrhandler):
 
     def close(self) -> None:
         if not self._closed:
+            # quiesce in-flight nonblocking IO first: closing the fd
+            # under an async transfer would let a recycled fd number
+            # receive the stale write (the reference completes pending
+            # aio before the fd dies)
+            if hasattr(self, "_ifbtl"):
+                self._ifbtl.drain()
             self._fs.close(self._fd)
             self._closed = True
             if self.mode & MODE_DELETE_ON_CLOSE:
@@ -293,8 +294,8 @@ class File(errhandler.HasErrhandler):
         """MPI_File_iread_at: request completing with the etype array."""
         self._check_open()
         v = self._views[rank]
-        return iread_offsets(self._async_fbtl(), self._fd,
-                             v.byte_offsets(offset, count),
+        return iread_offsets(self._async_fbtl(), self._fcoll, self._fbtl,
+                             self._fd, v.byte_offsets(offset, count),
                              getattr(v.etype, "np_dtype", None))
 
     def iwrite_at(self, offset: int, buf, count: int | None = None,
@@ -304,9 +305,9 @@ class File(errhandler.HasErrhandler):
         v = self._views[rank]
         if count is None:
             count = self._full_count(buf, v)
-        return iwrite_offsets(self._async_fbtl(), self._fd,
-                              v.byte_offsets(offset, count),
-                              self._as_bytes(buf, v, count), v.etype.size)
+        return iwrite_offsets(self._async_fbtl(), self._fcoll, self._fbtl,
+                              self._fd, v.byte_offsets(offset, count),
+                              self._as_bytes(buf, v, count), count)
 
     def iread(self, count: int, rank: int = 0):
         """MPI_File_iread: nonblocking at the individual pointer (which

@@ -138,6 +138,8 @@ class WireFile(errhandler.HasErrhandler):
     def close(self) -> None:
         if self._closed:
             return
+        if hasattr(self, "_ifbtl"):
+            self._ifbtl.drain()  # no async transfer may outlive the fd
         self._fs.close(self._fd)
         self._closed = True
         self.ep.barrier()  # all IO complete before any teardown
@@ -244,7 +246,8 @@ class WireFile(errhandler.HasErrhandler):
         from .file import iread_offsets
 
         self._check_open()
-        return iread_offsets(self._async_fbtl(), self._fd,
+        return iread_offsets(self._async_fbtl(), self._fcoll, self._fbtl,
+                             self._fd,
                              self._view.byte_offsets(offset, count),
                              getattr(self._view.etype, "np_dtype", None))
 
@@ -254,10 +257,10 @@ class WireFile(errhandler.HasErrhandler):
         self._check_open()
         if count is None:
             count = self._full_count(buf)
-        return iwrite_offsets(self._async_fbtl(), self._fd,
+        return iwrite_offsets(self._async_fbtl(), self._fcoll, self._fbtl,
+                              self._fd,
                               self._view.byte_offsets(offset, count),
-                              self._as_bytes(buf, count),
-                              self._view.etype.size)
+                              self._as_bytes(buf, count), count)
 
     def iread(self, count: int):
         off, self._pointer = self._pointer, self._pointer + count

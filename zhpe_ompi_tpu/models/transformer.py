@@ -50,6 +50,13 @@ class Config:
     # policy keeps matmul outputs (checkpoint_dots) so only the cheap
     # elementwise/norm intermediates are recomputed.
     remat: bool = False
+    # round-4 MFU levers (bench.py's cap analysis named both):
+    # fused layernorm Pallas kernel: None = auto (kernel on TPU,
+    # reference jnp elsewhere), True/False forces
+    fused_ln: bool | None = None
+    # vocab-chunked cross-entropy (no (B,S,V) materialization): chunk
+    # size, or None for the unchunked reference loss
+    ce_chunk: int | None = None
 
 
 def init_params(cfg: Config, key, tp: int = 1) -> dict:
@@ -75,12 +82,15 @@ def init_params(cfg: Config, key, tp: int = 1) -> dict:
     }
 
 
-def _ln(x, g):
-    dt = x.dtype
-    x = x.astype(jnp.float32)
-    m = x.mean(-1, keepdims=True)
-    v = x.var(-1, keepdims=True)
-    return ((x - m) * lax.rsqrt(v + 1e-5) * g).astype(dt)
+def _ln(x, g, fused=None):
+    """Layernorm: the fused Pallas one-pass kernel on TPU (round-4 MFU
+    lever), reference jnp elsewhere; numerics live in one place
+    (ops/fused_norm.ln_reference)."""
+    from ..ops import fused_norm
+
+    if fused is False:
+        return fused_norm.ln_reference(x, g)
+    return fused_norm.layer_norm(x, g, force=fused is True)
 
 
 from ..ops.flash_attention import attn_reference as _attn  # noqa: E402
@@ -118,7 +128,7 @@ def forward_hidden(params: dict, tokens, cfg: Config, tp_comm=None,
 
     def block(x, layer):
         wqkv, wo, w1, w2, g1, g2 = layer
-        h = _ln(x, g1)
+        h = _ln(x, g1, cfg.fused_ln)
         if tp_comm is not None:
             h = f_identity(tp_comm, h)
         qkv = jnp.einsum("bsd,dce->bsce", h, wqkv.astype(dtype))
@@ -140,7 +150,7 @@ def forward_hidden(params: dict, tokens, cfg: Config, tp_comm=None,
         if tp_comm is not None:
             o = g_allreduce(tp_comm, o)
         x = x + o
-        h = _ln(x, g2)
+        h = _ln(x, g2, cfg.fused_ln)
         if tp_comm is not None:
             h = f_identity(tp_comm, h)
         u = jnp.einsum("bsd,df->bsf", h, w1.astype(dtype))
@@ -164,7 +174,7 @@ def forward_hidden(params: dict, tokens, cfg: Config, tp_comm=None,
         lambda carry, layer: step_fn(carry, layer), x,
         layers,
     )
-    return _ln(x, params["lnf"])
+    return _ln(x, params["lnf"], cfg.fused_ln)
 
 
 def forward(params: dict, tokens, cfg: Config, tp_comm=None, sp_comm=None):
@@ -190,14 +200,13 @@ def loss_fn(params, tokens, targets, cfg: Config, tp_comm=None, sp_comm=None):
     """
     x = forward_hidden(params, tokens, cfg, tp_comm, sp_comm)
     emb = params["embed"].astype(cfg.dtype)
-    logits = jnp.einsum(
-        "bsd,vd->bsv", x, emb, preferred_element_type=jnp.float32
-    )
-    lse = jax.nn.logsumexp(logits, axis=-1)
-    tl = jnp.einsum(
-        "bsd,bsd->bs", x, emb[targets], preferred_element_type=jnp.float32
-    )
-    return jnp.mean(lse - tl)
+    # round-4 lever: cfg.ce_chunk scans vocab chunks through the online
+    # lse so no (B, S, V) f32 array ever reaches HBM; the unchunked
+    # reference (ops/fused_ce.ce_reference) is this module's historical
+    # loss body, bit-for-bit
+    from ..ops.fused_ce import token_ce
+
+    return token_ce(x, emb, targets, cfg.ce_chunk)
 
 
 # Parameters replicated over tp (everything else is tp-sharded).
