@@ -29,7 +29,7 @@ def _run_example(name: str) -> None:
 
 @pytest.mark.parametrize("name", [
     "hello_zmpi", "ring_zmpi", "connectivity_zmpi", "oshmem_shift",
-    "spawn_connect_zmpi",
+    "spawn_connect_zmpi", "device_pgas",
 ])
 def test_example(name, capsys):
     _run_example(name)

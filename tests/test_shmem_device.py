@@ -1,0 +1,164 @@
+"""Device-plane PGAS (``shmem/device.py``) — VERDICT round-3 Missing #3:
+the symmetric heap lives in HBM as jax Arrays sharded over the 8-device
+mesh, and put/get/AMO epochs compile to DeviceWindow schedules.  The
+spml/ucx inversion, tested the way the DeviceWindow suite is."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import zhpe_ompi_tpu as zmpi
+from zhpe_ompi_tpu.core import errors
+from zhpe_ompi_tpu.shmem import spml
+from zhpe_ompi_tpu.shmem.device import DeviceHeap
+
+N = 8
+
+
+@pytest.fixture(scope="module")
+def world():
+    return zmpi.init()
+
+
+@pytest.fixture()
+def heap(world):
+    h = DeviceHeap(world, heap_bytes=1 << 14)
+    yield h
+    h.finalize()
+
+
+class TestSelection:
+    def test_spml_selects_device_for_device_comm(self, world):
+        comp = spml.select_spml(world)
+        assert comp.name == "device"
+
+    def test_shmem_pe_returns_device_heap(self, world):
+        pe = spml.shmem_pe(world, heap_bytes=1 << 12)
+        assert isinstance(pe, DeviceHeap)
+        assert pe.plane == "device"
+        pe.finalize()
+
+    def test_exclusion_falls_through(self, world, monkeypatch, fresh_vars):
+        """ZMPI_MCA_spml=^device must stop device selection — the MCA
+        exclusion contract applies to the new component too."""
+        from zhpe_ompi_tpu.mca import var as mca_var
+
+        mca_var.set_var("spml", "^device")
+        with pytest.raises(errors.InternalError):
+            # nothing else supports a device communicator
+            spml.select_spml(world)
+
+
+class TestHeap:
+    def test_symmetric_offsets_deterministic(self, heap):
+        a = heap.shmalloc(4, np.float32)
+        b = heap.shmalloc(8, np.float32)
+        assert a.offset == 0 and b.offset >= 4  # 64B-aligned first-fit
+        heap.shfree(a)
+        c = heap.shmalloc(2, np.float32)
+        assert c.offset == a.offset  # first-fit reuses the freed block
+
+    def test_data_resident_as_jax_arrays(self, heap, world):
+        a = heap.shmalloc(4, np.float32)
+        assert isinstance(heap._arenas[a.arena], jax.Array)
+        shard_shapes = {
+            s.data.shape for s in heap._arenas[a.arena].addressable_shards
+        }
+        assert len(shard_shapes) == 1  # one equal shard per device/PE
+
+
+class TestEpochs:
+    def test_put_circular_shift(self, heap, world):
+        sym = heap.shmalloc(4, np.float32)
+
+        def prog(pe, _):
+            me = pe.my_pe().astype(jnp.float32)
+            pe = pe.local_set(sym, me)
+            pe = pe.barrier()
+            pe = pe.put(sym, jnp.full(4, me),
+                        pe_of=lambda r, n: (r + 1) % n)
+            return pe, jnp.zeros((1, 1))
+
+        heap.epoch(prog, jnp.zeros((N, 1)))
+        got = heap.read(sym)
+        for r in range(N):
+            np.testing.assert_allclose(got[r], np.full(4, (r - 1) % N))
+
+    def test_get_neighbor(self, heap, world):
+        sym = heap.shmalloc(2, np.float32)
+
+        def prog(pe, _):
+            me = pe.my_pe().astype(jnp.float32)
+            pe = pe.local_set(sym, me * 10)
+            pe = pe.barrier()
+            got = pe.get(sym, pe_of=lambda r, n: (r - 1) % n)
+            return pe, got[None]
+
+        out = np.asarray(heap.epoch(prog, jnp.zeros((N, 1))))
+        for r in range(N):
+            np.testing.assert_allclose(out[r], np.full(2, ((r - 1) % N) * 10))
+
+    def test_fadd_ring(self, heap, world):
+        """fetch-add into the right neighbor: old values read before the
+        add lands, counts exact after."""
+        sym = heap.shmalloc(1, np.float32)
+
+        def prog(pe, _):
+            pe = pe.local_set(sym, 100.0)
+            pe = pe.barrier()
+            old, pe = pe.fadd(sym, pe.my_pe().astype(jnp.float32) + 1,
+                              pe_of=lambda r, n: (r + 1) % n)
+            return pe, old[None]
+
+        old = np.asarray(heap.epoch(prog, jnp.zeros((N, 1)))).reshape(N)
+        np.testing.assert_allclose(old, np.full(N, 100.0))
+        got = heap.read(sym).reshape(N)
+        # PE r received (left neighbor's rank + 1)
+        want = np.asarray([100.0 + ((r - 1) % N) + 1 for r in range(N)])
+        np.testing.assert_allclose(got, want)
+
+    def test_state_persists_across_epochs(self, heap, world):
+        """The heap is stateful across compiled epochs — write in one,
+        read in the next."""
+        sym = heap.shmalloc(2, np.int32)
+
+        def write(pe, _):
+            pe = pe.local_set(sym, pe.my_pe() * 2)
+            return pe, None
+
+        def shift(pe, _):
+            pe = pe.put(sym, pe.local(sym),
+                        pe_of=lambda r, n: (r + 1) % n)
+            return pe, None
+
+        z = jnp.zeros((N, 1))
+        heap.epoch(write, z)
+        heap.epoch(shift, z)
+        got = heap.read(sym)
+        for r in range(N):
+            np.testing.assert_array_equal(got[r], np.full(2, ((r - 1) % N) * 2))
+
+    def test_mixed_dtypes_separate_arenas(self, heap, world):
+        f = heap.shmalloc(4, np.float32)
+        i = heap.shmalloc(4, np.int32)
+        assert f.arena != i.arena
+
+        def prog(pe, _):
+            pe = pe.local_set(f, 1.5)
+            pe = pe.local_set(i, 7)
+            return pe, None
+
+        heap.epoch(prog, jnp.zeros((N, 1)))
+        np.testing.assert_allclose(heap.read(f)[0], np.full(4, 1.5))
+        np.testing.assert_array_equal(heap.read(i)[0], np.full(4, 7))
+
+    def test_bad_pe_rejected(self, heap, world):
+        sym = heap.shmalloc(1, np.float32)
+
+        def prog(pe, _):
+            return pe.put(sym, jnp.zeros(1), pe_of=[N] * N), None
+
+        with pytest.raises(errors.RankError):
+            heap.epoch(prog, jnp.zeros((N, 1)))

@@ -1,0 +1,283 @@
+"""Device-plane PGAS: the symmetric heap resident in HBM.
+
+The round-3 OSHMEM transports (direct/mmap/am) are all host-plane — the
+symmetric heap lives in process or mapped memory.  This module is the
+missing fast-fabric spml, inverted the way ``coll/tpu`` inverted
+``coll/cuda``: the reference's spml/ucx
+(``oshmem/mca/spml/ucx/spml_ucx.c:57``) reaches device memory through a
+fabric's RDMA verbs; on this platform the "fabric" is ICI and the
+idiomatic form is the compiled epoch — the same schedule-compilation
+shape ``osc/spmd_window.py`` established for MPI RMA, here carrying
+OpenSHMEM semantics:
+
+- the **symmetric heap** is a set of per-dtype arenas, each a jax Array
+  sharded one-shard-per-PE over the communicator's mesh axis (data
+  lives in HBM and never leaves it);
+- **symmetric allocation** is deterministic (every PE runs the same
+  ``shmalloc`` sequence against the same first-fit allocator —
+  ``memheap.py``'s property), so remote offsets are computed, never
+  exchanged — exactly the reference's memheap contract;
+- **put/get/AMO epochs** lower onto :class:`DeviceWindow` static
+  schedules (ppermute + dynamic-update under one jit); ``barrier`` is
+  the window fence, carried as a data dependency.
+
+Like DeviceWindow, target PEs are *static per-rank schedules*: a
+``pe_of`` argument is a list indexed by rank, or a callable
+``f(rank, n_pes) -> target`` evaluated at trace time (the classic
+OpenSHMEM neighbor patterns — shift, ring, halo — are all static).
+``-1`` means "this rank does not participate".
+
+Selected through the spml MCA framework at priority 100 ("device"):
+``spml.shmem_pe(device_comm)`` hands back a :class:`DeviceHeap` when
+the endpoint is a device communicator, the host backends otherwise —
+one selection mechanism, two planes (SURVEY.md §5's backend map).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core import errors
+from ..osc.spmd_window import DeviceWindow
+from .memheap import SymmetricHeapAllocator
+
+
+@dataclass(frozen=True)
+class DeviceSym:
+    """A symmetric allocation: (arena key, element offset, shape).  The
+    same descriptor is valid on every PE — offsets are deterministic."""
+
+    arena: str
+    offset: int  # in elements
+    shape: tuple
+    dtype: Any
+
+    @property
+    def elems(self) -> int:
+        n = 1
+        for s in self.shape:
+            n *= s
+        return n
+
+
+def _normalize_pe_of(pe_of, n: int) -> list[int]:
+    if callable(pe_of):
+        pe_of = [pe_of(r, n) for r in range(n)]
+    elif isinstance(pe_of, int):
+        pe_of = [pe_of] * n
+    pe_of = list(pe_of)
+    if len(pe_of) != n:
+        raise errors.ArgError(f"pe_of needs {n} entries, got {len(pe_of)}")
+    for t in pe_of:
+        if not -1 <= t < n:
+            raise errors.RankError(f"target PE {t} out of range")
+    return pe_of
+
+
+class DevicePE:
+    """The in-epoch handle (valid inside shard_map): wraps the comm and
+    this PE's arena shards.  Functional-update semantics like
+    DeviceWindow — operations RETURN the updated handle."""
+
+    def __init__(self, comm, arenas: dict):
+        self.comm = comm
+        self.arenas = arenas  # key -> (elems,) local shard
+
+    def my_pe(self):
+        return self.comm.rank()
+
+    def n_pes(self) -> int:
+        return self.comm.axis_size
+
+    # -- local access ----------------------------------------------------
+
+    def local(self, sym: DeviceSym):
+        """This PE's view of the allocation (a traced value)."""
+        from jax import lax
+
+        flat = self.arenas[sym.arena]
+        return lax.dynamic_slice(flat, (sym.offset,), (sym.elems,)
+                                 ).reshape(sym.shape)
+
+    def local_set(self, sym: DeviceSym, value) -> "DevicePE":
+        from jax import lax
+
+        flat = self.arenas[sym.arena]
+        val = jnp.asarray(value, flat.dtype).reshape(-1)
+        if val.size != sym.elems:
+            val = jnp.broadcast_to(val, (sym.elems,))
+        new = lax.dynamic_update_slice(flat, val, (sym.offset,))
+        return self._with(sym.arena, new)
+
+    def _with(self, key: str, new_arena) -> "DevicePE":
+        arenas = dict(self.arenas)
+        arenas[key] = new_arena
+        return DevicePE(self.comm, arenas)
+
+    def _window(self, sym: DeviceSym) -> DeviceWindow:
+        return DeviceWindow(self.comm, self.arenas[sym.arena])
+
+    # -- RMA epochs ------------------------------------------------------
+
+    def put(self, sym: DeviceSym, value, pe_of) -> "DevicePE":
+        """Every rank r puts `value` (its local traced array, sym-shaped)
+        into PE ``pe_of[r]``'s allocation."""
+        n = self.n_pes()
+        targets = _normalize_pe_of(pe_of, n)
+        val = jnp.asarray(value, self.arenas[sym.arena].dtype).reshape(-1)
+        # bounds against the ALLOCATION, not the arena: the window spans
+        # the whole arena, so without this check an oversized value would
+        # silently overwrite the next symmetric allocation
+        if val.size > sym.elems:
+            raise errors.ArgError(
+                f"put of {val.size} elems into allocation of {sym.elems}"
+            )
+        win = self._window(sym).put(val, targets, [sym.offset] * n)
+        return self._with(sym.arena, win.shard)
+
+    def get(self, sym: DeviceSym, pe_of, count: int | None = None,
+            offset: int = 0):
+        """Every rank r reads PE ``pe_of[r]``'s allocation (or a
+        count-slice at element offset)."""
+        n = self.n_pes()
+        sources = _normalize_pe_of(pe_of, n)
+        cnt = sym.elems if count is None else count
+        if not 0 <= offset <= sym.elems or offset + cnt > sym.elems:
+            raise errors.ArgError(
+                f"get of {cnt} elems at offset {offset} overruns "
+                f"allocation of {sym.elems}"
+            )
+        return self._window(sym).get(
+            sources, [sym.offset + offset] * n, cnt)
+
+    def add(self, sym: DeviceSym, value, pe_of, index: int = 0
+            ) -> "DevicePE":
+        """shmem_atomic_add as a schedule: rank r adds its `value` into
+        element ``index`` of PE ``pe_of[r]``'s allocation.  One writer
+        per target per epoch (DeviceWindow's atomicity model: the
+        schedule IS the serialization)."""
+        n = self.n_pes()
+        targets = _normalize_pe_of(pe_of, n)
+        if not 0 <= index < sym.elems:
+            raise errors.ArgError(
+                f"AMO index {index} out of range for allocation of "
+                f"{sym.elems} elements"
+            )
+        val = jnp.asarray(value, self.arenas[sym.arena].dtype).reshape(1)
+        win = self._window(sym).accumulate(
+            val, targets, [sym.offset + index] * n)
+        return self._with(sym.arena, win.shard)
+
+    def fadd(self, sym: DeviceSym, value, pe_of, index: int = 0):
+        """shmem_atomic_fetch_add: returns (old, updated pe).  The old
+        value reads before the add in the same compiled epoch — correct
+        because the schedule admits one writer per target."""
+        n = self.n_pes()
+        targets = _normalize_pe_of(pe_of, n)
+        old = self.get(sym, targets, count=1, offset=index)
+        return old, self.add(sym, value, targets, index)
+
+    def barrier(self) -> "DevicePE":
+        """shmem_barrier_all: fence every arena (data-dependency token,
+        like DeviceWindow.fence)."""
+        from ..coll import algorithms as alg
+
+        token = alg.barrier_dissemination(self.comm)
+        arenas = {
+            k: a + token.astype(a.dtype) for k, a in self.arenas.items()
+        }
+        return DevicePE(self.comm, arenas)
+
+
+class DeviceHeap:
+    """Host-side owner of the HBM symmetric heap: allocator + the
+    sharded arena state + the epoch runner."""
+
+    plane = "device"
+
+    def __init__(self, comm, heap_bytes: int = 1 << 20):
+        if getattr(comm, "is_partitioned", False):
+            # group-relative ranks vs full-axis schedules would diverge;
+            # the spml also refuses selection for partitioned comms
+            raise errors.CommError(
+                "device PGAS requires an unpartitioned communicator "
+                "(one group spanning the axis)"
+            )
+        self.comm = comm
+        self.heap_bytes = int(heap_bytes)
+        self._allocators: dict[str, SymmetricHeapAllocator] = {}
+        self._arenas: dict[str, Any] = {}  # key -> (n, elems) jax Array
+
+    # -- symmetric allocation (deterministic; memheap contract) ----------
+
+    def _arena_key(self, dtype) -> str:
+        return np.dtype(dtype).str
+
+    def shmalloc(self, shape, dtype) -> DeviceSym:
+        from jax.sharding import PartitionSpec as P
+
+        if isinstance(shape, int):
+            shape = (shape,)
+        dt = np.dtype(dtype)
+        key = self._arena_key(dt)
+        if key not in self._allocators:
+            elems = self.heap_bytes // dt.itemsize
+            self._allocators[key] = SymmetricHeapAllocator(self.heap_bytes)
+            n = self.comm.axis_size
+            self._arenas[key] = self.comm.device_put_sharded(
+                jnp.zeros((n, elems), dtype=dt), P(self.comm.axis)
+            )
+        nbytes = int(np.prod(shape)) * dt.itemsize
+        off_bytes = self._allocators[key].alloc(nbytes)
+        assert off_bytes % dt.itemsize == 0  # ALIGN=64 covers all dtypes
+        return DeviceSym(key, off_bytes // dt.itemsize, tuple(shape), dt)
+
+    def shfree(self, sym: DeviceSym) -> None:
+        self._allocators[sym.arena].free(sym.offset * sym.dtype.itemsize)
+
+    # -- epochs ----------------------------------------------------------
+
+    def epoch(self, fn: Callable, *args):
+        """Run ``fn(pe, *args) -> (pe, out)`` as ONE compiled program
+        under shard_map over the heap's mesh axis; commits the updated
+        arena state and returns ``out`` (axis-sharded, or None).  Extra
+        ``args`` arrive axis-sharded along dim 0."""
+        from jax.sharding import PartitionSpec as P
+
+        keys = sorted(self._arenas)
+        ax = self.comm.axis
+
+        def body(arena_list, *xs):
+            pe = DevicePE(self.comm,
+                          {k: a[0] for k, a in zip(keys, arena_list)})
+            pe, out = fn(pe, *xs)
+            new = [pe.arenas[k][None] for k in keys]
+            return new, (jnp.zeros((1, 1)) if out is None else out)
+
+        in_specs = ([P(ax)] * len(keys),) + tuple(P(ax) for _ in args)
+        mapped = jax.shard_map(
+            body, mesh=self.comm.mesh,
+            in_specs=in_specs,
+            out_specs=([P(ax)] * len(keys), P(ax)),
+            check_vma=False,
+        )
+        new_arenas, out = mapped([self._arenas[k] for k in keys], *args)
+        self._arenas = dict(zip(keys, new_arenas))
+        return out
+
+    def read(self, sym: DeviceSym) -> np.ndarray:
+        """Host view of every PE's copy of the allocation: (n,) + shape
+        (debug/verification path — data stays device-resident otherwise)."""
+        arena = np.asarray(self._arenas[sym.arena])
+        return arena[:, sym.offset:sym.offset + sym.elems].reshape(
+            (arena.shape[0],) + sym.shape)
+
+    def finalize(self) -> None:
+        self._arenas.clear()
+        self._allocators.clear()

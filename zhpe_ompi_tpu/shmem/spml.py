@@ -9,6 +9,9 @@ through the same MCA machinery every other framework here uses
 ``ZMPI_MCA_spml`` include/exclude lists, and selection is
 highest-priority-that-supports-the-endpoint:
 
+- **device** (prio 100): device communicators — symmetric heap in HBM,
+  put/get/AMO as compiled DeviceWindow epochs (``shmem/device.py``);
+  the spml/ucx fast-fabric inversion.
 - **direct** (prio 80): thread-universe ranks share an address space —
   numpy-view put/get (sshmem equivalent: the segment IS the process
   heap).
@@ -56,6 +59,28 @@ class SpmlComponent(mca_component.Component):
 
     def make(self, ep, heap_bytes: int):
         raise NotImplementedError
+
+
+class DeviceSpml(SpmlComponent):
+    """Round-4: the fast-fabric spml (spml/ucx inverted) — symmetric
+    heap in HBM, put/get/AMO as compiled DeviceWindow epochs over the
+    mesh.  Highest priority: when the endpoint IS a device communicator
+    the device plane is the point."""
+
+    name = "device"
+    default_priority = 100
+    wraps_pe = False  # returns the epoch-API DeviceHeap, not a ShmemPE
+
+    def supports(self, ep) -> bool:
+        # unpartitioned device communicators only: a split comm's
+        # group-relative ranks do not match full-axis epoch schedules
+        return hasattr(ep, "mesh") and hasattr(ep, "axis") and \
+            not getattr(ep, "is_partitioned", False)
+
+    def make(self, ep, heap_bytes: int):
+        from .device import DeviceHeap
+
+        return DeviceHeap(ep, heap_bytes)
 
 
 class DirectSpml(SpmlComponent):
@@ -126,7 +151,7 @@ class AmSpml(SpmlComponent):
 def spml_framework() -> mca_component.Framework:
     return mca_component.build_framework(
         "spml", "SHMEM put/get transports",
-        (DirectSpml, MmapSpml, AmSpml),
+        (DeviceSpml, DirectSpml, MmapSpml, AmSpml),
     )
 
 
@@ -152,8 +177,13 @@ def select_spml(ep) -> SpmlComponent:
 
 def shmem_pe(ep, heap_bytes: int = _DEFAULT_HEAP):
     """shmem_init: spml-selected PE construction (collective over the
-    endpoint's group)."""
+    endpoint's group).  Host transports wrap in the imperative ShmemPE;
+    the device transport returns the epoch-API DeviceHeap (schedules
+    compile — the platform's native PGAS shape)."""
     from .api import ShmemPE
 
     comp = select_spml(ep)
-    return ShmemPE(ep, comp.make(ep, heap_bytes))
+    backend = comp.make(ep, heap_bytes)
+    if not getattr(comp, "wraps_pe", True):
+        return backend
+    return ShmemPE(ep, backend)
