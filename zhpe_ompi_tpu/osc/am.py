@@ -186,7 +186,7 @@ class AmService:
             try:
                 msg, status = self.ep.recv(
                     tag=AM_REQ_TAG, cid=AM_CID, timeout=0.25,
-                    return_status=True,
+                    return_status=True, poll=True,
                 )
             except errors.InternalError:
                 continue  # poll timeout: check _stop and re-post
