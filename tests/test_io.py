@@ -458,3 +458,36 @@ class TestNonblockingIO:
             got = f.read_at(0, 20)
         assert got[:10].tolist() == [1] * 10
         assert got[10:].tolist() == [2] * 10
+
+
+class TestNonblockingCollectiveIO:
+    """MPI_File_iwrite_all/iread_all (the ompio iread_all-over-libnbc
+    analog): the aggregated pass retires on the async worker."""
+
+    def test_iwrite_all_iread_all_roundtrip(self, tmp_path, world):
+        p = str(tmp_path / "nbcoll.bin")
+        n = world.size
+        with zio.File(world, p, zio.MODE_CREATE | zio.MODE_RDWR) as f:
+            from zhpe_ompi_tpu.datatype.predefined import BYTE
+
+            # rank r owns bytes [32r, 32r+32)
+            for r in range(n):
+                f.set_view(disp=32 * r, etype=BYTE, rank=r)
+            bufs = [np.full(32, r, dtype=np.uint8) for r in range(n)]
+            wreq = f.iwrite_all(bufs)
+            acc = sum(i for i in range(10000))
+            assert wreq.wait(timeout=30) == 32 * n and acc > 0
+            for r in range(n):
+                f.seek(0, rank=r)
+            rreq = f.iread_all([32] * n)
+            got = rreq.wait(timeout=30)
+        for r in range(n):
+            np.testing.assert_array_equal(got[r], bufs[r])
+
+    def test_pointer_advances_at_call_time(self, tmp_path, world):
+        p = str(tmp_path / "nbptr.bin")
+        n = world.size
+        with zio.File(world, p, zio.MODE_CREATE | zio.MODE_RDWR) as f:
+            req = f.iwrite_all([np.arange(8, dtype=np.uint8)] * n)
+            assert all(f.tell(rank=r) == 8 for r in range(n))
+            req.wait(timeout=30)

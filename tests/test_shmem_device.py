@@ -162,3 +162,84 @@ class TestEpochs:
 
         with pytest.raises(errors.RankError):
             heap.epoch(prog, jnp.zeros((N, 1)))
+
+
+class TestDeviceScoll:
+    """The scoll analog on the device plane: collectives over heap
+    values execute as the framework's XLA-native collectives inside the
+    epoch (scoll/mpi's reuse trick on ICI)."""
+
+    def test_broadcast(self, heap, world):
+        sym = heap.shmalloc(3, np.float32)
+
+        def prog(pe, _):
+            pe = pe.local_set(sym, pe.my_pe().astype(jnp.float32))
+            pe = pe.broadcast(sym, root=5)
+            return pe, None
+
+        heap.epoch(prog, jnp.zeros((N, 1)))
+        got = heap.read(sym)
+        for r in range(N):
+            np.testing.assert_allclose(got[r], np.full(3, 5.0))
+
+    def test_fcollect(self, heap, world):
+        src = heap.shmalloc(2, np.float32)
+        dest = heap.shmalloc(2 * N, np.float32)
+
+        def prog(pe, _):
+            me = pe.my_pe().astype(jnp.float32)
+            pe = pe.local_set(src, jnp.asarray([me, me + 0.5]))
+            pe = pe.fcollect(dest, src)
+            return pe, None
+
+        heap.epoch(prog, jnp.zeros((N, 1)))
+        want = np.concatenate([[r, r + 0.5] for r in range(N)])
+        got = heap.read(dest)
+        for r in range(N):
+            np.testing.assert_allclose(got[r], want)
+
+    def test_reduce_to_all(self, heap, world):
+        from zhpe_ompi_tpu import ops as zops
+
+        src = heap.shmalloc(4, np.float32)
+        dest = heap.shmalloc(4, np.float32)
+
+        def prog(pe, _):
+            me = pe.my_pe().astype(jnp.float32)
+            pe = pe.local_set(src, jnp.full(4, me))
+            pe = pe.reduce_to_all(dest, src, zops.MAX)
+            return pe, None
+
+        heap.epoch(prog, jnp.zeros((N, 1)))
+        got = heap.read(dest)
+        for r in range(N):
+            np.testing.assert_allclose(got[r], np.full(4, N - 1.0))
+
+    def test_alltoall(self, heap, world):
+        src = heap.shmalloc(N, np.float32)
+        dest = heap.shmalloc(N, np.float32)
+
+        def prog(pe, _):
+            me = pe.my_pe().astype(jnp.float32)
+            # block j = me * 10 + j
+            pe = pe.local_set(
+                src, me * 10 + jnp.arange(N, dtype=jnp.float32))
+            pe = pe.alltoall(dest, src)
+            return pe, None
+
+        heap.epoch(prog, jnp.zeros((N, 1)))
+        got = heap.read(dest)
+        for r in range(N):
+            # PE r's block j came from PE j's block r: j*10 + r
+            np.testing.assert_allclose(
+                got[r], np.arange(N) * 10.0 + r)
+
+    def test_size_mismatches_rejected(self, heap, world):
+        src = heap.shmalloc(4, np.float32)
+        small = heap.shmalloc(4, np.float32)
+
+        def prog(pe, _):
+            return pe.fcollect(small, src), None
+
+        with pytest.raises(errors.CountError):
+            heap.epoch(prog, jnp.zeros((N, 1)))

@@ -253,3 +253,62 @@ class TestWireNonblocking:
 
         res = run_tcp(2, prog)
         assert res[0] == list(range(8)) and res[1] == list(range(8))
+
+
+class TestWireNonblockingCollective:
+    """iwrite_all/iread_all on the wire plane: every rank's collective
+    body (aggregation exchange + transfers) retires on its worker."""
+
+    def test_iwrite_all_then_iread_all(self, tmp_path):
+        path = str(tmp_path / "nbcoll.bin")
+
+        def prog(p):
+            with WireFile(p, path, MODE_RDWR | MODE_CREATE) as f:
+                ft = create_resized(create_vector(1, 1, 1, INT32_T),
+                                    0, 4 * N)
+                f.set_view(4 * p.rank, INT32_T, ft)
+                data = np.arange(8, dtype=np.int32) + 100 * p.rank
+                wreq = f.iwrite_all(data)
+                acc = sum(i for i in range(10000))  # overlapped compute
+                assert wreq.wait(timeout=30) == 8 and acc > 0
+                f.seek(0)
+                rreq = f.iread_all(8)
+                got = rreq.wait(timeout=30)
+            return got.tolist()
+
+        res = run_tcp(N, prog)
+        for r in range(N):
+            assert res[r] == (np.arange(8, dtype=np.int32)
+                              + 100 * r).tolist()
+
+    def test_iwrite_all_overlaps_blocking_collective(self, tmp_path):
+        """Regression (round-4 review): collective tags are reserved at
+        CALL time, so a blocking collective issued while the nonblocking
+        body still runs on the worker cannot steal its tag window."""
+        path = str(tmp_path / "overlap.bin")
+        pre = np.arange(4 * N, dtype=np.int32)
+        pre.tofile(path)
+
+        def prog(p):
+            with WireFile(p, path, MODE_RDWR) as f:
+                ft = create_resized(create_vector(1, 1, 1, INT32_T),
+                                    0, 4 * N)
+                f.set_view(4 * p.rank, INT32_T, ft)
+                data = np.arange(4, dtype=np.int32) + 1000 * p.rank
+                wreq = f.iwrite_all(data)
+                # a blocking collective on the SAME endpoint while the
+                # write body may still be in flight on the worker
+                f.seek(0)
+                first = f.read_all(4)
+                assert wreq.wait(timeout=30) == 4
+                f.seek(0)
+                final = f.read_all(4)
+            return first.tolist(), final.tolist()
+
+        res = run_tcp(N, prog)
+        for r in range(N):
+            want_final = (np.arange(4, dtype=np.int32) + 1000 * r).tolist()
+            assert res[r][1] == want_final
+            # the overlapped read saw either the old or the new image
+            # per element (non-atomic mode), but never corrupt tags —
+            # completing at all, with a valid final image, is the proof

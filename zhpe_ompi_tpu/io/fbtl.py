@@ -70,33 +70,36 @@ class AsyncFbtl:
     machinery (wait/test/wait_all), exactly as OMPIO's request wraps the
     aio state.
 
-    The pool is lazy and shared per-process (the reference sizes its aio
-    queue globally, ``fbtl_posix_component.c``).  Ordering: in-flight
-    requests are independent and may complete in any order — MPI's
-    non-atomic file mode; concurrent writes to overlapping regions are
-    the caller's race, as in the reference.  ``drain`` completes every
-    in-flight transfer (File.close calls it so a recycled fd can never
-    receive a stale async write)."""
-
-    _pool = None
-    _pool_lock = threading.Lock()
+    The pool is PER FILE HANDLE (one AsyncFbtl per File/WireFile), not
+    per process: nonblocking COLLECTIVE bodies block in the pool waiting
+    for their peers, so a process-global pool would deadlock whenever
+    more ranks than workers share one process (the thread-rank test
+    harness, and any threaded MPI user) — each rank's handle must be
+    able to make progress independently.  Ordering: in-flight requests
+    are independent and may complete in any order — MPI's non-atomic
+    file mode; concurrent writes to overlapping regions are the
+    caller's race, as in the reference.  ``drain`` completes every
+    in-flight transfer; ``close`` (called by File.close) additionally
+    retires the workers, so a recycled fd can never receive a stale
+    async write."""
 
     def __init__(self, base: FbtlComponent):
         self.base = base
         self._inflight: set = set()
         self._mu = threading.Lock()
+        self._pool = None
+        self._pool_lock = threading.Lock()
 
-    @classmethod
-    def _executor(cls):
-        if cls._pool is None:
+    def _executor(self):
+        if self._pool is None:
             from concurrent.futures import ThreadPoolExecutor
 
-            with cls._pool_lock:
-                if cls._pool is None:
-                    cls._pool = ThreadPoolExecutor(
+            with self._pool_lock:
+                if self._pool is None:
+                    self._pool = ThreadPoolExecutor(
                         max_workers=2, thread_name_prefix="zmpi-fbtl"
                     )
-        return cls._pool
+        return self._pool
 
     def submit(self, fn, *args):
         """Run any transfer callable on the pool; returns a FileRequest.
@@ -130,6 +133,13 @@ class AsyncFbtl:
                 r.wait(timeout)
             except BaseException:  # noqa: BLE001 — owner's wait re-raises
                 pass
+
+    def close(self) -> None:
+        """Drain and retire the worker threads."""
+        self.drain()
+        if self._pool is not None:
+            self._pool.shutdown(wait=True)
+            self._pool = None
 
     def ipwritev(self, fd: int, runs, data: np.ndarray):
         """Nonblocking pwritev: returns a Request whose value is bytes

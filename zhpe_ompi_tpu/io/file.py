@@ -185,7 +185,7 @@ class File(errhandler.HasErrhandler):
             # receive the stale write (the reference completes pending
             # aio before the fd dies)
             if hasattr(self, "_ifbtl"):
-                self._ifbtl.drain()
+                self._ifbtl.close()
             self._fs.close(self._fd)
             self._closed = True
             if self.mode & MODE_DELETE_ON_CLOSE:
@@ -382,6 +382,15 @@ class File(errhandler.HasErrhandler):
             raise errors.ArgError(
                 f"need one buffer per rank ({len(self._views)})"
             )
+        per_rank, total = self._resolve_write_all(bufs, copy=False)
+        # the selected fcoll strategy owns the aggregation shape
+        self._fcoll.write(self._fbtl, self._fd, per_rank)
+        return total
+
+    def _resolve_write_all(self, bufs: list, copy: bool):
+        """Shared write_all/iwrite_all body: per-rank counts, bytes and
+        offsets resolved and pointers advanced under one lock (copy=True
+        detaches the data for a worker that reads it later)."""
         per_rank, total = [], 0
         with self._lock:
             for r, buf in enumerate(bufs):
@@ -390,11 +399,50 @@ class File(errhandler.HasErrhandler):
                 data = self._as_bytes(buf, v, count)
                 offs = v.byte_offsets(self._pointers[r], count)
                 self._pointers[r] += count
-                per_rank.append((offs, data))
+                per_rank.append((offs, data.copy() if copy else data))
                 total += count
-        # the selected fcoll strategy owns the aggregation shape
-        self._fcoll.write(self._fbtl, self._fd, per_rank)
-        return total
+        return per_rank, total
+
+    def _resolve_read_all(self, counts: list[int]):
+        """Shared read_all/iread_all body: per-rank offsets + dtypes,
+        pointers advanced under one lock."""
+        offs_list, dts = [], []
+        with self._lock:
+            for r, count in enumerate(counts):
+                v = self._views[r]
+                offs_list.append(v.byte_offsets(self._pointers[r], count))
+                self._pointers[r] += count
+                dts.append(getattr(v.etype, "np_dtype", None))
+        return offs_list, dts
+
+    # -- nonblocking collective IO (MPI_File_iwrite_all/iread_all) -------
+    # Single-controller forms: pointers advance at call time; the whole
+    # aggregated pass retires on the async worker (the reference's
+    # ompio iread_all over libnbc, collapsed to one submission because
+    # no exchange phase exists on a single controller).
+
+    def iwrite_all(self, bufs: list):
+        self._check_open()
+        if len(bufs) != len(self._views):
+            raise errors.ArgError(
+                f"need one buffer per rank ({len(self._views)})"
+            )
+        per_rank, total = self._resolve_write_all(bufs, copy=True)
+        inner = self._async_fbtl().submit(
+            self._fcoll.write, self._fbtl, self._fd, per_rank)
+        return _MappedRequest(inner, lambda _: total)
+
+    def iread_all(self, counts: list[int]):
+        self._check_open()
+        if len(counts) != len(self._views):
+            raise errors.ArgError("need one count per rank")
+        offs_list, dts = self._resolve_read_all(counts)
+        inner = self._async_fbtl().submit(
+            self._fcoll.read, self._fbtl, self._fd, offs_list)
+        return _MappedRequest(inner, lambda raws: [
+            raw.view(dt) if dt is not None else raw
+            for raw, dt in zip(raws, dts)
+        ])
 
     def read_all(self, counts: list[int]) -> list[np.ndarray]:
         """Collective read: rank r reads counts[r] etypes at its pointer.

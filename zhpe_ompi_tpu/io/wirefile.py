@@ -46,6 +46,42 @@ from . import fbtl as fbtl_mod
 from . import fcoll as fcoll_mod
 from . import fs as fs_mod
 
+
+class _ReservedCtx:
+    """Endpoint proxy carrying a privately RESERVED collective-sequence
+    window.  Nonblocking collective IO runs its body (gather/alltoall/
+    scatter/barrier) on a worker thread, so tags must be drawn at CALL
+    time, in program order, exactly like coll/nbc.py's schedules — a
+    body drawing from the live endpoint at execution time would race
+    any other collective on the same endpoint.  The proxy owns its own
+    ``_coll_seq`` (starting at the window reserved by the caller) and
+    delegates everything else to the real endpoint."""
+
+    #: seq numbers consumed by ONE collective-IO op on every rank,
+    #: regardless of path (write: gather|alltoall; read adds the reply
+    #: round) — uniform so all ranks' live counters advance identically
+    WINDOW = 4
+
+    def __init__(self, ep, start: int):
+        object.__setattr__(self, "_ep", ep)
+        object.__setattr__(self, "_coll_seq", start)
+
+    def __getattr__(self, name):
+        return getattr(object.__getattribute__(self, "_ep"), name)
+
+    def __setattr__(self, name, value):
+        if name == "_coll_seq":
+            object.__setattr__(self, name, value)
+        else:
+            setattr(object.__getattribute__(self, "_ep"), name, value)
+
+    @classmethod
+    def reserve(cls, ep) -> "_ReservedCtx":
+        """Reserve the window on the caller thread (call time)."""
+        start = getattr(ep, "_coll_seq", 0)
+        ep._coll_seq = start + cls.WINDOW
+        return cls(ep, start)
+
 class SharedPointerFile:
     """sharedfp/lockedfile: the shared pointer as ASCII in a sidecar
     file, updated under an exclusive flock."""
@@ -139,7 +175,7 @@ class WireFile(errhandler.HasErrhandler):
         if self._closed:
             return
         if hasattr(self, "_ifbtl"):
-            self._ifbtl.drain()  # no async transfer may outlive the fd
+            self._ifbtl.close()  # no async transfer may outlive the fd
         self._fs.close(self._fd)
         self._closed = True
         self.ep.barrier()  # all IO complete before any teardown
@@ -266,6 +302,39 @@ class WireFile(errhandler.HasErrhandler):
         off, self._pointer = self._pointer, self._pointer + count
         return self.iread_at(off, count)
 
+    # -- nonblocking collective IO (MPI_File_iwrite_all/iread_all) -------
+    # The reference backs these with libnbc-scheduled collectives
+    # (ompi/mca/io/ompio's *_all_begin/_end + iread_all); here the whole
+    # collective body (aggregation exchange + fbtl transfers) retires on
+    # the async worker while the caller computes — every rank of the
+    # group must call it, exactly like the blocking form, and pointers
+    # advance at call time per the MPI nonblocking contract.
+
+    def iwrite_all(self, buf, count: int | None = None):
+        from .file import _MappedRequest
+
+        self._check_open()
+        if count is None:
+            count = self._full_count(buf)
+        data = self._as_bytes(buf, count).copy()
+        offs = self._view.byte_offsets(self._pointer, count)
+        self._pointer += count
+        ctx = _ReservedCtx.reserve(self.ep)  # tags drawn at CALL time
+        inner = self._async_fbtl().submit(
+            self._write_all_offsets, offs, data, ctx)
+        return _MappedRequest(inner, lambda _: count)
+
+    def iread_all(self, count: int):
+        from .file import _MappedRequest
+
+        self._check_open()
+        offs = self._view.byte_offsets(self._pointer, count)
+        self._pointer += count
+        ctx = _ReservedCtx.reserve(self.ep)  # tags drawn at CALL time
+        inner = self._async_fbtl().submit(self._read_all_offsets, offs,
+                                          ctx)
+        return _MappedRequest(inner, lambda raw: raw)
+
     def iwrite(self, buf, count: int | None = None):
         if count is None:
             count = self._full_count(buf)
@@ -330,9 +399,23 @@ class WireFile(errhandler.HasErrhandler):
         data = self._as_bytes(buf, count).copy()
         offs = self._view.byte_offsets(self._pointer, count)
         self._pointer += count
+        self._write_all_offsets(offs, data,
+                                ctx=_ReservedCtx.reserve(self.ep))
+        return count
+
+    def _write_all_offsets(self, offs: np.ndarray, data: np.ndarray,
+                           ctx=None) -> None:
+        """The collective write body (offsets already resolved): the
+        shared engine for write_all and iwrite_all.  ``ctx`` is the
+        tag-drawing endpoint view (a _ReservedCtx when running on a
+        worker); collectives go through the free functions so the
+        reserved sequence window is honored."""
+        from ..coll import host as hostc
+
+        ctx = self.ep if ctx is None else ctx
         naggr = self._num_aggregators()
         if naggr == 1:
-            gathered = self.ep.gather((offs, data), root=0)
+            gathered = hostc.gather(ctx, (offs, data), root=0)
             if self.ep.rank == 0:
                 self._fcoll.write(self._fbtl, self._fd, gathered)
         else:
@@ -341,40 +424,49 @@ class WireFile(errhandler.HasErrhandler):
                 (offs[owner == a], data[owner == a]) if a < naggr else None
                 for a in range(self.ep.size)
             ]
-            inbox = self.ep.alltoall(outbox)
+            inbox = hostc.alltoall(ctx, outbox)
             if self.ep.rank < naggr:
                 mine = [p for p in inbox if p is not None]
                 self._fcoll.write(self._fbtl, self._fd, mine)
         self.ep.barrier()  # data visible to every rank after the call
-        return count
 
     def read_all(self, count: int) -> np.ndarray:
         """Collective read at each rank's individual pointer."""
         self._check_open()
         offs = self._view.byte_offsets(self._pointer, count)
         self._pointer += count
+        return self._read_all_offsets(offs,
+                                      ctx=_ReservedCtx.reserve(self.ep))
+
+    def _read_all_offsets(self, offs: np.ndarray, ctx=None) -> np.ndarray:
+        """The collective read body (offsets already resolved): the
+        shared engine for read_all and iread_all; ``ctx`` as in
+        :meth:`_write_all_offsets`."""
+        from ..coll import host as hostc
+
+        ctx = self.ep if ctx is None else ctx
         naggr = self._num_aggregators()
         if naggr == 1:
-            all_offs = self.ep.gather(offs, root=0)
+            all_offs = hostc.gather(ctx, offs, root=0)
             if self.ep.rank == 0:
                 raws = self._fcoll.read(self._fbtl, self._fd, all_offs)
-                raw = self.ep.scatter(raws, root=0)
+                raw = hostc.scatter(ctx, raws, root=0)
             else:
-                raw = self.ep.scatter(None, root=0)
+                raw = hostc.scatter(ctx, None, root=0)
         else:
             owner = self._stripe_owner(offs, naggr)
             outbox = [
                 offs[owner == a] if a < naggr else None
                 for a in range(self.ep.size)
             ]
-            inbox = self.ep.alltoall(outbox)
+            inbox = hostc.alltoall(ctx, outbox)
             if self.ep.rank < naggr:
                 reqs = [o if o is not None else np.empty(0, np.int64)
                         for o in inbox]
                 raws = self._fcoll.read(self._fbtl, self._fd, reqs)
             else:
                 raws = [None] * self.ep.size
-            back = self.ep.alltoall(raws)
+            back = hostc.alltoall(ctx, raws)
             raw = np.empty(offs.size, dtype=np.uint8)
             for a in range(naggr):
                 routed = int((owner == a).sum())

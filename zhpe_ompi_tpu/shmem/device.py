@@ -183,6 +183,57 @@ class DevicePE:
         old = self.get(sym, targets, count=1, offset=index)
         return old, self.add(sym, value, targets, index)
 
+    # -- collectives (the scoll analog, on XLA collectives) --------------
+    # The reference's scoll/basic runs linear/binomial trees over pt2pt;
+    # on the device plane the idiomatic form is the framework's own
+    # XLA-native collective components operating on the heap values
+    # inside the same compiled epoch (scoll/mpi's reuse trick, executed
+    # as psum/all_gather/all_to_all on ICI).
+
+    def broadcast(self, sym: DeviceSym, root: int = 0) -> "DevicePE":
+        """shmem_broadcast: root's instance overwrites every PE's."""
+        if not 0 <= root < self.n_pes():
+            # the masked-psum bcast would silently zero every PE's copy
+            raise errors.RankError(f"root PE {root} out of range")
+        data = self.comm.bcast(self.local(sym), root=root)
+        return self.local_set(sym, data)
+
+    def fcollect(self, dest: DeviceSym, src: DeviceSym) -> "DevicePE":
+        """shmem_fcollect: concatenate every PE's src (equal sizes) into
+        every PE's dest, PE order."""
+        n = self.n_pes()
+        if dest.elems != src.elems * n:
+            raise errors.CountError(
+                f"fcollect dest must hold n_pes * src "
+                f"({dest.elems} != {n} * {src.elems})"
+            )
+        gathered = self.comm.allgather(self.local(src).reshape(-1))
+        return self.local_set(dest, gathered.reshape(-1))
+
+    def reduce_to_all(self, dest: DeviceSym, src: DeviceSym, op=None
+                      ) -> "DevicePE":
+        """shmem_<op>_to_all: elementwise reduction of every PE's src
+        into every PE's dest (framework allreduce on the heap value)."""
+        from .. import ops as zops
+
+        if dest.elems != src.elems:
+            raise errors.CountError("reduce dest/src size mismatch")
+        red = self.comm.allreduce(self.local(src),
+                                  op if op is not None else zops.SUM)
+        return self.local_set(dest, red)
+
+    def alltoall(self, dest: DeviceSym, src: DeviceSym) -> "DevicePE":
+        """shmem_alltoall: PE i's block j lands in PE j's block i."""
+        n = self.n_pes()
+        if src.elems % n or dest.elems != src.elems:
+            raise errors.CountError(
+                f"alltoall needs equal dest/src with elems divisible "
+                f"by {n}"
+            )
+        moved = self.comm.alltoall(
+            self.local(src).reshape(n, src.elems // n))
+        return self.local_set(dest, moved.reshape(-1))
+
     def barrier(self) -> "DevicePE":
         """shmem_barrier_all: fence every arena (data-dependency token,
         like DeviceWindow.fence)."""
