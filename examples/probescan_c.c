@@ -1,0 +1,185 @@
+/* probescan_c.c — second round-4 C ABI acceptance program.
+ *
+ * Covers the calls subcomm_c.c does not: MPI_Probe/Iprobe (matching
+ * introspection before the receive), MPI_Waitany/Testall, prefix scans
+ * (MPI_Scan/MPI_Exscan), the v-variant collectives
+ * (Gatherv/Scatterv/Allgatherv with ragged counts/displacements),
+ * MPI_Reduce_scatter_block, user-defined reduction operators
+ * (MPI_Op_create), MPI_Error_string and MPI_Type_get_extent.
+ */
+
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+#include "zompi_mpi.h"
+
+#define CHECK(cond, msg)                                      \
+  do {                                                        \
+    if (!(cond)) {                                            \
+      fprintf(stderr, "FAIL rank %d: %s\n", rank, msg);       \
+      return 1;                                               \
+    }                                                         \
+  } while (0)
+
+/* user op: modular sum (mod 1000) — exercises the Op_create path with
+ * something the predefined table cannot express */
+static void modsum(void *invec, void *inoutvec, int *len,
+                   MPI_Datatype *dt) {
+  long *a = (long *)invec, *b = (long *)inoutvec;
+  (void)dt;
+  for (int i = 0; i < *len; i++) b[i] = (a[i] + b[i]) % 1000;
+}
+
+int main(int argc, char **argv) {
+  int rank, size;
+  if (MPI_Init(&argc, &argv) != MPI_SUCCESS) return 2;
+  MPI_Comm_rank(MPI_COMM_WORLD, &rank);
+  MPI_Comm_size(MPI_COMM_WORLD, &size);
+
+  /* 1. Probe before receive: learn source/size without consuming */
+  int next = (rank + 1) % size, prev = (rank + size - 1) % size;
+  long payload[3] = {rank * 7L, rank * 7L + 1, rank * 7L + 2};
+  CHECK(MPI_Send(payload, 3, MPI_LONG, next, 21, MPI_COMM_WORLD) ==
+            MPI_SUCCESS, "send");
+  MPI_Status st;
+  CHECK(MPI_Probe(MPI_ANY_SOURCE, 21, MPI_COMM_WORLD, &st) ==
+            MPI_SUCCESS, "Probe");
+  CHECK(st.MPI_SOURCE == prev && st.MPI_TAG == 21, "Probe status");
+  int pn = -1;
+  MPI_Get_count(&st, MPI_LONG, &pn);
+  CHECK(pn == 3, "Probe count");
+  long got[3];
+  CHECK(MPI_Recv(got, 3, MPI_LONG, st.MPI_SOURCE, 21, MPI_COMM_WORLD,
+                 MPI_STATUS_IGNORE) == MPI_SUCCESS, "recv after probe");
+  CHECK(got[0] == prev * 7L, "probe payload");
+
+  /* Iprobe: nothing pending on tag 99 */
+  int flag = -1;
+  CHECK(MPI_Iprobe(MPI_ANY_SOURCE, 99, MPI_COMM_WORLD, &flag,
+                   MPI_STATUS_IGNORE) == MPI_SUCCESS && flag == 0,
+        "Iprobe empty");
+
+  /* 2. Waitany over two Irecvs: complete in send order */
+  MPI_Request rq[2];
+  long a = -1, b = -1;
+  CHECK(MPI_Irecv(&a, 1, MPI_LONG, prev, 31, MPI_COMM_WORLD, &rq[0]) ==
+            MPI_SUCCESS, "Irecv a");
+  CHECK(MPI_Irecv(&b, 1, MPI_LONG, prev, 32, MPI_COMM_WORLD, &rq[1]) ==
+            MPI_SUCCESS, "Irecv b");
+  long v32 = rank + 3200;
+  CHECK(MPI_Send(&v32, 1, MPI_LONG, next, 32, MPI_COMM_WORLD) ==
+            MPI_SUCCESS, "send 32");
+  int idx = -1;
+  CHECK(MPI_Waitany(2, rq, &idx, MPI_STATUS_IGNORE) == MPI_SUCCESS,
+        "Waitany");
+  /* a fast neighbor may already have delivered tag 31 too, so Waitany
+   * may legally return either index — but whichever it returns must be
+   * completed, nulled, and carry the right payload */
+  CHECK((idx == 0 || idx == 1) && rq[idx] == MPI_REQUEST_NULL,
+        "Waitany completion");
+  CHECK(idx == 1 ? b == prev + 3200 : a == prev + 3100,
+        "Waitany payload");
+  long v31 = rank + 3100;
+  CHECK(MPI_Send(&v31, 1, MPI_LONG, next, 31, MPI_COMM_WORLD) ==
+            MPI_SUCCESS, "send 31");
+  int all = 0;
+  while (!all) {  /* Testall polls; completion arrives asynchronously */
+    CHECK(MPI_Testall(2, rq, &all, MPI_STATUSES_IGNORE) == MPI_SUCCESS,
+          "Testall");
+  }
+  CHECK(a == prev + 3100 && b == prev + 3200 &&
+            rq[0] == MPI_REQUEST_NULL && rq[1] == MPI_REQUEST_NULL,
+        "Testall completion");
+
+  /* 3. Scan / Exscan */
+  long mine = rank + 1, incl = -1, excl = -1;
+  CHECK(MPI_Scan(&mine, &incl, 1, MPI_LONG, MPI_SUM, MPI_COMM_WORLD) ==
+            MPI_SUCCESS, "Scan");
+  long want_incl = (long)(rank + 1) * (rank + 2) / 2;
+  CHECK(incl == want_incl, "Scan value");
+  CHECK(MPI_Exscan(&mine, &excl, 1, MPI_LONG, MPI_SUM,
+                   MPI_COMM_WORLD) == MPI_SUCCESS, "Exscan");
+  if (rank > 0)
+    CHECK(excl == (long)rank * (rank + 1) / 2, "Exscan value");
+
+  /* 4. ragged Gatherv/Scatterv/Allgatherv: rank r contributes r+1 */
+  int *counts = malloc(sizeof(int) * size);
+  int *displs = malloc(sizeof(int) * size);
+  int total = 0;
+  for (int r = 0; r < size; r++) {
+    counts[r] = r + 1;
+    displs[r] = total;
+    total += r + 1;
+  }
+  long *ragged = malloc(sizeof(long) * (size + 1));
+  for (int i = 0; i <= rank; i++) ragged[i] = rank * 100L + i;
+  long *gat = malloc(sizeof(long) * total);
+  memset(gat, 0xFF, sizeof(long) * total);
+  CHECK(MPI_Gatherv(ragged, rank + 1, MPI_LONG, gat, counts, displs,
+                    MPI_LONG, 0, MPI_COMM_WORLD) == MPI_SUCCESS,
+        "Gatherv");
+  if (rank == 0)
+    for (int r = 0; r < size; r++)
+      for (int i = 0; i <= r; i++)
+        CHECK(gat[displs[r] + i] == r * 100L + i, "Gatherv value");
+  /* scatter the assembled image back out */
+  long *back = malloc(sizeof(long) * (size + 1));
+  CHECK(MPI_Scatterv(gat, counts, displs, MPI_LONG, back, rank + 1,
+                     MPI_LONG, 0, MPI_COMM_WORLD) == MPI_SUCCESS,
+        "Scatterv");
+  for (int i = 0; i <= rank; i++)
+    CHECK(back[i] == rank * 100L + i, "Scatterv value");
+  long *allg = malloc(sizeof(long) * total);
+  CHECK(MPI_Allgatherv(ragged, rank + 1, MPI_LONG, allg, counts, displs,
+                       MPI_LONG, MPI_COMM_WORLD) == MPI_SUCCESS,
+        "Allgatherv");
+  for (int r = 0; r < size; r++)
+    for (int i = 0; i <= r; i++)
+      CHECK(allg[displs[r] + i] == r * 100L + i, "Allgatherv value");
+
+  /* 5. Reduce_scatter_block */
+  long *vec = malloc(sizeof(long) * 2 * size);
+  for (int i = 0; i < 2 * size; i++) vec[i] = rank + i;
+  long piece[2] = {-1, -1};
+  CHECK(MPI_Reduce_scatter_block(vec, piece, 2, MPI_LONG, MPI_SUM,
+                                 MPI_COMM_WORLD) == MPI_SUCCESS,
+        "Reduce_scatter_block");
+  long ranksum = (long)size * (size - 1) / 2;
+  for (int j = 0; j < 2; j++) {
+    long want = ranksum + (long)size * (2 * rank + j);
+    CHECK(piece[j] == want, "Reduce_scatter_block value");
+  }
+
+  /* 6. user-defined op through Allreduce and Reduce */
+  MPI_Op mod;
+  CHECK(MPI_Op_create(modsum, 1, &mod) == MPI_SUCCESS, "Op_create");
+  long big = 700 + rank, m = -1;
+  CHECK(MPI_Allreduce(&big, &m, 1, MPI_LONG, mod, MPI_COMM_WORLD) ==
+            MPI_SUCCESS, "user-op allreduce");
+  long want_mod = 0;
+  for (int r = 0; r < size; r++) want_mod = (want_mod + 700 + r) % 1000;
+  CHECK(m == want_mod, "user-op value");
+  CHECK(MPI_Op_free(&mod) == MPI_SUCCESS && mod == MPI_OP_NULL,
+        "Op_free");
+
+  /* 7. diagnostics */
+  char es[MPI_MAX_PROCESSOR_NAME];
+  int el = -1;
+  CHECK(MPI_Error_string(MPI_ERR_TRUNCATE, es, &el) == MPI_SUCCESS &&
+            strstr(es, "TRUNCATE") && el > 0, "Error_string");
+  MPI_Datatype col;
+  MPI_Type_vector(3, 1, 4, MPI_DOUBLE, &col);
+  long lb = -1, ext = -1;
+  CHECK(MPI_Type_get_extent(col, &lb, &ext) == MPI_SUCCESS && lb == 0 &&
+            ext == 9 * 8, "Type_get_extent");  /* (2*4+1) doubles */
+  MPI_Type_commit(&col);
+  MPI_Type_free(&col);
+
+  MPI_Barrier(MPI_COMM_WORLD);
+  printf("probescan_c rank %d/%d OK\n", rank, size);
+  free(counts); free(displs); free(ragged); free(gat); free(back);
+  free(allg); free(vec);
+  MPI_Finalize();
+  return 0;
+}

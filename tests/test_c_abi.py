@@ -27,18 +27,24 @@ def shim():
     return so
 
 
-@pytest.fixture(scope="module")
-def ring_bin(shim, tmp_path_factory):
-    out = tmp_path_factory.mktemp("cabi") / "ring_c"
+def _compile_example(shim, tmp_path_factory, src_name: str) -> str:
+    """One shim link recipe for every acceptance binary."""
+    stem = src_name.rsplit(".", 1)[0]
+    out = tmp_path_factory.mktemp(f"cabi_{stem}") / stem
     libdir = os.path.dirname(shim)
     libname = os.path.basename(shim)[3:].rsplit(".so", 1)[0]  # lib<X>.so
     subprocess.run(
-        ["gcc", os.path.join(REPO, "examples", "ring_c.c"), "-o", str(out),
+        ["gcc", os.path.join(REPO, "examples", src_name), "-o", str(out),
          "-I", native.mpi_header_dir(), "-L", libdir, f"-l{libname}",
          f"-Wl,-rpath,{libdir}"],
         check=True, capture_output=True, text=True,
     )
     return str(out)
+
+
+@pytest.fixture(scope="module")
+def ring_bin(shim, tmp_path_factory):
+    return _compile_example(shim, tmp_path_factory, "ring_c.c")
 
 
 def _free_port():
@@ -166,16 +172,12 @@ int main(int argc, char **argv) {
 
 @pytest.fixture(scope="module")
 def subcomm_bin(shim, tmp_path_factory):
-    out = tmp_path_factory.mktemp("cabi4") / "subcomm_c"
-    libdir = os.path.dirname(shim)
-    libname = os.path.basename(shim)[3:].rsplit(".so", 1)[0]
-    subprocess.run(
-        ["gcc", os.path.join(REPO, "examples", "subcomm_c.c"), "-o",
-         str(out), "-I", native.mpi_header_dir(), "-L", libdir,
-         f"-l{libname}", f"-Wl,-rpath,{libdir}"],
-        check=True, capture_output=True, text=True,
-    )
-    return str(out)
+    return _compile_example(shim, tmp_path_factory, "subcomm_c.c")
+
+
+@pytest.fixture(scope="module")
+def probescan_bin(shim, tmp_path_factory):
+    return _compile_example(shim, tmp_path_factory, "probescan_c.c")
 
 
 class TestRound4Surface:
@@ -196,6 +198,23 @@ class TestRound4Surface:
             out, err = p.communicate(timeout=90)
             assert p.returncode == 0, f"rank {r} failed: {err}\n{out}"
             assert f"subcomm_c rank {r}/{n} OK" in out
+
+    @pytest.mark.parametrize("n", [1, 3, 4])
+    def test_probescan_example(self, probescan_bin, n):
+        """Probe/Iprobe, Waitany/Testall, Scan/Exscan, ragged
+        v-collectives, Reduce_scatter_block, user-defined ops,
+        Error_string, Type_get_extent."""
+        port = _free_port()
+        procs = [
+            subprocess.Popen([probescan_bin], env=_env(r, n, port),
+                             stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                             text=True)
+            for r in range(n)
+        ]
+        for r, p in enumerate(procs):
+            out, err = p.communicate(timeout=90)
+            assert p.returncode == 0, f"rank {r} failed: {err}\n{out}"
+            assert f"probescan_c rank {r}/{n} OK" in out
 
     def test_isend_truly_pending_until_recv(self, shim, tmp_path):
         """An Irecv posted with no matching send must stay incomplete

@@ -428,7 +428,11 @@ class WireFile(errhandler.HasErrhandler):
             if self.ep.rank < naggr:
                 mine = [p for p in inbox if p is not None]
                 self._fcoll.write(self._fbtl, self._fd, mine)
-        self.ep.barrier()  # data visible to every rank after the call
+        # completion sync: a token allgather DRAWN FROM THE RESERVED
+        # WINDOW — the endpoint's fixed-tag barrier (0x7FFD, no
+        # sequence) would cross-match between overlapping nonblocking
+        # collective bodies
+        hostc.allgather(ctx, 0)
 
     def read_all(self, count: int) -> np.ndarray:
         """Collective read at each rank's individual pointer."""

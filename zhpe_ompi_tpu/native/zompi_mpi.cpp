@@ -454,6 +454,15 @@ struct Shim {
   std::mutex threads_mu;
   int64_t seq = 0;
   bool initialized = false;
+
+  ~Shim() {
+    // error-path exit without MPI_Finalize: joinable std::threads would
+    // std::terminate in their destructors — detach them (the process is
+    // dying anyway; Finalize remains the clean path)
+    if (accept_thread.joinable()) accept_thread.detach();
+    for (auto &t : threads)
+      if (t.joinable()) t.detach();
+  }
 };
 
 Shim g;
@@ -469,7 +478,9 @@ void deliver(const Posted &p, const Message &m) {
   r->status.MPI_TAG = (int)m.tag;
   r->status.MPI_ERROR =
       have > p.want_bytes ? MPI_ERR_TRUNCATE : MPI_SUCCESS;
-  r->status._count = (int)(copied / p.item);
+  // _count carries BYTES (dtype-agnostic, so MPI_Probe can fill it
+  // without knowing the eventual receive type); Get_count converts
+  r->status._count = (int)copied;
   r->complete = true;
 }
 
@@ -532,7 +543,9 @@ void finish_recv(Req *r) {
     DtView v;
     v.di = r->plan_di;
     v.derived = &r->plan;
-    size_t avail = (size_t)r->status._count * v.di.item;
+    // _count is BYTES (the probe-compatible unit) — cap the unpack at
+    // exactly the received payload, not a multiple of it
+    size_t avail = (size_t)r->status._count;
     unpack_dtype(r->user_buf, r->count, v, r->scratch.data(), avail);
     r->needs_unpack = false;
     r->scratch.clear();
@@ -798,10 +811,34 @@ int reduce_int(T *acc, const T *in, int n, MPI_Op op) {
   return reduce_arith(acc, in, n, op);
 }
 
+// user-defined reduction operators (ompi/op/op.c:243-287's table,
+// reduced to a map); handles from 0x20 up
+struct UserOp {
+  MPI_User_function *fn;
+  bool commute;
+};
+std::map<MPI_Op, UserOp> g_user_ops;
+MPI_Op g_next_op = 0x20;
+
 // acc = acc ⊕ in elementwise, acc as the LEFT operand (rank order is
 // the caller's responsibility; op.h:547-605's in-order contract)
 int reduce_buf(void *acc, const void *in, int n, MPI_Datatype dt,
                MPI_Op op) {
+  auto uit = g_user_ops.find(op);
+  if (uit != g_user_ops.end()) {
+    // MPI user fn computes inoutvec = invec ∘ inoutvec (invec LEFT);
+    // feed invec=acc, inoutvec=copy(in), copy back — acc ∘ in lands
+    // in acc per this function's contract
+    DtInfo di;
+    if (!base_dtinfo(dt, di)) return MPI_ERR_TYPE;
+    std::vector<char> tmp((size_t)n * di.item);
+    memcpy(tmp.data(), in, tmp.size());
+    int len = n;
+    MPI_Datatype d = dt;
+    uit->second.fn(acc, tmp.data(), &len, &d);
+    memcpy(acc, tmp.data(), tmp.size());
+    return MPI_SUCCESS;
+  }
   switch (dt) {
     case MPI_INT:
       return reduce_int((int32_t *)acc, (const int32_t *)in, n, op);
@@ -1130,6 +1167,143 @@ int c_alltoall(CommObj &c, const void *sendbuf, int sendcount,
   return MPI_SUCCESS;
 }
 
+int c_scan(CommObj &c, const void *sendbuf, void *recvbuf, int count,
+           MPI_Datatype dt, MPI_Op op, bool exclusive) {
+  // linear chain (coll_base_scan.c:35 / coll_base_exscan.c:35): rank r
+  // receives the prefix of ranks < r, combines in rank order, forwards
+  DtView v;
+  if (!resolve_dtype(dt, v) || v.derived) return MPI_ERR_TYPE;
+  int n = (int)c.group.size(), me = c.local_rank;
+  int64_t tag = (c.coll_seq++ % 0x8000) << 16 | 0x7E09;
+  size_t nbytes = (size_t)count * v.di.item;
+  std::vector<char> acc(nbytes);
+  if (me == 0) {
+    if (!exclusive) memcpy(recvbuf, sendbuf, nbytes);
+    memcpy(acc.data(), sendbuf, nbytes);
+  } else {
+    int rc = raw_recv(acc.data(), count, dt, world_of(c, me - 1), tag,
+                      c.cid_coll, nullptr);
+    if (rc) return rc;
+    if (exclusive) {
+      memcpy(recvbuf, acc.data(), nbytes);  // prefix of ranks < me
+      int rc2 = reduce_buf(acc.data(), sendbuf, count, dt, op);
+      if (rc2) return rc2;
+    } else {
+      int rc2 = reduce_buf(acc.data(), sendbuf, count, dt, op);
+      if (rc2) return rc2;
+      memcpy(recvbuf, acc.data(), nbytes);
+    }
+  }
+  if (me + 1 < n) {
+    // acc holds the inclusive prefix of ranks <= me (for rank 0 in the
+    // exclusive form: just its own value) — the next rank's prefix
+    int rc = raw_send(acc.data(), count, dt, world_of(c, me + 1), tag,
+                      c.cid_coll);
+    if (rc) return rc;
+  }
+  return MPI_SUCCESS;
+}
+
+int c_gatherv(CommObj &c, const void *sendbuf, int sendcount,
+              MPI_Datatype sendtype, void *recvbuf, const int recvcounts[],
+              const int displs[], MPI_Datatype recvtype, int root) {
+  // linear with per-rank counts/displacements (displs in recvtype
+  // extent units, the MPI contract)
+  int n = (int)c.group.size(), me = c.local_rank;
+  int64_t tag = (c.coll_seq++ % 0x8000) << 16 | 0x7E0A;
+  if (me != root)
+    return raw_send(sendbuf, sendcount, sendtype, world_of(c, root), tag,
+                    c.cid_coll);
+  DtView rv;
+  if (!resolve_dtype(recvtype, rv)) return MPI_ERR_TYPE;
+  size_t unit = slot_bytes(rv, 1);
+  for (int r = 0; r < n; r++) {
+    char *dst = (char *)recvbuf + (size_t)displs[r] * unit;
+    if (r == me) {
+      DtView sv;
+      if (!resolve_dtype(sendtype, sv)) return MPI_ERR_TYPE;
+      std::vector<char> packed;
+      pack_dtype(sendbuf, sendcount, sv, packed);
+      unpack_dtype(dst, recvcounts[r], rv, packed.data(), packed.size());
+    } else {
+      int rc = raw_recv(dst, recvcounts[r], recvtype, world_of(c, r), tag,
+                        c.cid_coll, nullptr);
+      if (rc) return rc;
+    }
+  }
+  return MPI_SUCCESS;
+}
+
+int c_scatterv(CommObj &c, const void *sendbuf, const int sendcounts[],
+               const int displs[], MPI_Datatype sendtype, void *recvbuf,
+               int recvcount, MPI_Datatype recvtype, int root) {
+  int n = (int)c.group.size(), me = c.local_rank;
+  int64_t tag = (c.coll_seq++ % 0x8000) << 16 | 0x7E0B;
+  if (me != root)
+    return raw_recv(recvbuf, recvcount, recvtype, world_of(c, root), tag,
+                    c.cid_coll, nullptr);
+  DtView sv;
+  if (!resolve_dtype(sendtype, sv)) return MPI_ERR_TYPE;
+  size_t unit = slot_bytes(sv, 1);
+  for (int r = 0; r < n; r++) {
+    const char *blk = (const char *)sendbuf + (size_t)displs[r] * unit;
+    if (r == me) {
+      DtView rv;
+      if (!resolve_dtype(recvtype, rv)) return MPI_ERR_TYPE;
+      std::vector<char> packed;
+      pack_dtype(blk, sendcounts[r], sv, packed);
+      unpack_dtype(recvbuf, recvcount, rv, packed.data(), packed.size());
+    } else {
+      int rc = raw_send(blk, sendcounts[r], sendtype, world_of(c, r), tag,
+                        c.cid_coll);
+      if (rc) return rc;
+    }
+  }
+  return MPI_SUCCESS;
+}
+
+int c_allgatherv(CommObj &c, const void *sendbuf, int sendcount,
+                 MPI_Datatype sendtype, void *recvbuf,
+                 const int recvcounts[], const int displs[],
+                 MPI_Datatype recvtype) {
+  // n rooted broadcasts of each rank's block into the (identical)
+  // recv layout — simple and displacement-safe (gaps never touched)
+  int n = (int)c.group.size(), me = c.local_rank;
+  DtView rv;
+  if (!resolve_dtype(recvtype, rv)) return MPI_ERR_TYPE;
+  size_t unit = slot_bytes(rv, 1);
+  // own contribution into own block first
+  {
+    DtView sv;
+    if (!resolve_dtype(sendtype, sv)) return MPI_ERR_TYPE;
+    std::vector<char> packed;
+    pack_dtype(sendbuf, sendcount, sv, packed);
+    unpack_dtype((char *)recvbuf + (size_t)displs[me] * unit,
+                 recvcounts[me], rv, packed.data(), packed.size());
+  }
+  for (int r = 0; r < n; r++) {
+    int rc = c_bcast(c, (char *)recvbuf + (size_t)displs[r] * unit,
+                     recvcounts[r], recvtype, r, 0x7E0C);
+    if (rc) return rc;
+  }
+  return MPI_SUCCESS;
+}
+
+int c_reduce_scatter_block(CommObj &c, const void *sendbuf, void *recvbuf,
+                           int recvcount, MPI_Datatype dt, MPI_Op op) {
+  // reduce-to-0 then scatter (coll_base_reduce_scatter_block.c:55's
+  // linear shape)
+  DtView v;
+  if (!resolve_dtype(dt, v) || v.derived) return MPI_ERR_TYPE;
+  int n = (int)c.group.size(), me = c.local_rank;
+  size_t nbytes = (size_t)recvcount * n * v.di.item;
+  std::vector<char> full(me == 0 ? nbytes : 0);
+  int rc = c_reduce(c, sendbuf, full.data(), recvcount * n, dt, op, 0);
+  if (rc) return rc;
+  return c_scatter(c, full.data(), recvcount, dt, recvbuf, recvcount,
+                   dt, 0);
+}
+
 }  // namespace
 
 // ------------------------------------------------------------ C ABI
@@ -1436,12 +1610,12 @@ int MPI_Recv(void *buf, int count, MPI_Datatype dt, int source, int tag,
 int MPI_Get_count(const MPI_Status *status, MPI_Datatype dt, int *count) {
   DtView v;
   if (!resolve_dtype(dt, v)) return MPI_ERR_TYPE;
-  int64_t per = v.elems_per_item();
-  if (per == 0 || status->_count % per) {
+  int64_t per_bytes = v.elems_per_item() * (int64_t)v.di.item;
+  if (per_bytes == 0 || status->_count % per_bytes) {
     *count = MPI_UNDEFINED;
     return MPI_SUCCESS;
   }
-  *count = (int)(status->_count / per);
+  *count = (int)(status->_count / per_bytes);
   return MPI_SUCCESS;
 }
 
@@ -1733,6 +1907,227 @@ int MPI_Type_size(MPI_Datatype datatype, int *size) {
   }
   if (!resolve_dtype(datatype, v)) return MPI_ERR_TYPE;
   *size = (int)v.di.item;
+  return MPI_SUCCESS;
+}
+
+// ---------------------------------------------------- probe / any / all
+
+namespace {
+
+int probe_impl(int source, int tag, CommObj *c, int *flag,
+               MPI_Status *status, bool blocking) {
+  int src_world = source == MPI_ANY_SOURCE ? MPI_ANY_SOURCE
+                                           : world_of(*c, source);
+  if (source != MPI_ANY_SOURCE && src_world < 0) return MPI_ERR_ARG;
+  std::unique_lock<std::mutex> lk(g.match_mu);
+  while (true) {
+    for (auto &m : g.unexpected) {
+      if (m.cid != c->cid_pt2pt) continue;
+      if (src_world != MPI_ANY_SOURCE && m.src != src_world) continue;
+      if (tag != MPI_ANY_TAG && m.tag != tag) continue;
+      if (status) {
+        status->MPI_SOURCE = (int)m.src;
+        status->MPI_TAG = (int)m.tag;
+        status->MPI_ERROR = MPI_SUCCESS;
+        status->_count = (int)m.data.size();  // bytes (Get_count converts)
+      }
+      if (flag) *flag = 1;
+      return MPI_SUCCESS;
+    }
+    if (!blocking) {
+      if (flag) *flag = 0;
+      return MPI_SUCCESS;
+    }
+    g.match_cv.wait_for(lk, std::chrono::milliseconds(100));
+    if (g.closing.load()) return MPI_ERR_OTHER;
+  }
+}
+
+}  // namespace
+
+int MPI_Probe(int source, int tag, MPI_Comm comm, MPI_Status *status) {
+  CommObj *c = lookup_comm(comm);
+  if (!c) return MPI_ERR_COMM;
+  MPI_Status st{};
+  int rc = probe_impl(source, tag, c, nullptr, &st, true);
+  if (rc == MPI_SUCCESS && status) {
+    *status = st;
+    translate_status(c, status);
+  }
+  return rc;
+}
+
+int MPI_Iprobe(int source, int tag, MPI_Comm comm, int *flag,
+               MPI_Status *status) {
+  CommObj *c = lookup_comm(comm);
+  if (!c) return MPI_ERR_COMM;
+  MPI_Status st{};
+  int rc = probe_impl(source, tag, c, flag, &st, false);
+  if (rc == MPI_SUCCESS && *flag && status) {
+    *status = st;
+    translate_status(c, status);
+  }
+  return rc;
+}
+
+int MPI_Waitany(int count, MPI_Request requests[], int *index,
+                MPI_Status *status) {
+  bool any_active = false;
+  for (int i = 0; i < count; i++)
+    if (requests[i] != MPI_REQUEST_NULL) any_active = true;
+  if (!any_active) {
+    *index = MPI_UNDEFINED;
+    return MPI_SUCCESS;
+  }
+  while (true) {
+    int ready = -1;
+    {
+      std::unique_lock<std::mutex> lk(g.match_mu);
+      for (int i = 0; i < count && ready < 0; i++) {
+        if (requests[i] == MPI_REQUEST_NULL) continue;
+        auto it = g.reqs.find(requests[i]);
+        if (it == g.reqs.end()) return MPI_ERR_REQUEST;
+        if (it->second->complete) ready = i;
+      }
+      if (ready < 0) {
+        g.match_cv.wait_for(lk, std::chrono::milliseconds(100));
+        if (g.closing.load()) return MPI_ERR_OTHER;
+      }
+    }
+    if (ready >= 0) {
+      *index = ready;
+      return MPI_Wait(&requests[ready], status);
+    }
+  }
+}
+
+int MPI_Testall(int count, MPI_Request requests[], int *flag,
+                MPI_Status statuses[]) {
+  {
+    std::lock_guard<std::mutex> lk(g.match_mu);
+    for (int i = 0; i < count; i++) {
+      if (requests[i] == MPI_REQUEST_NULL) continue;
+      auto it = g.reqs.find(requests[i]);
+      if (it == g.reqs.end()) return MPI_ERR_REQUEST;
+      if (!it->second->complete) {
+        *flag = 0;
+        return MPI_SUCCESS;
+      }
+    }
+  }
+  *flag = 1;
+  return MPI_Waitall(count, requests,
+                     statuses ? statuses : MPI_STATUSES_IGNORE);
+}
+
+// ------------------------------------------------- scan/v-collectives
+
+int MPI_Scan(const void *sendbuf, void *recvbuf, int count,
+             MPI_Datatype dt, MPI_Op op, MPI_Comm comm) {
+  CommObj *c = lookup_comm(comm);
+  if (!c) return MPI_ERR_COMM;
+  return c_scan(*c, sendbuf, recvbuf, count, dt, op, false);
+}
+
+int MPI_Exscan(const void *sendbuf, void *recvbuf, int count,
+               MPI_Datatype dt, MPI_Op op, MPI_Comm comm) {
+  CommObj *c = lookup_comm(comm);
+  if (!c) return MPI_ERR_COMM;
+  return c_scan(*c, sendbuf, recvbuf, count, dt, op, true);
+}
+
+int MPI_Gatherv(const void *sendbuf, int sendcount, MPI_Datatype sendtype,
+                void *recvbuf, const int recvcounts[], const int displs[],
+                MPI_Datatype recvtype, int root, MPI_Comm comm) {
+  CommObj *c = lookup_comm(comm);
+  if (!c) return MPI_ERR_COMM;
+  if (root < 0 || root >= (int)c->group.size()) return MPI_ERR_ARG;
+  return c_gatherv(*c, sendbuf, sendcount, sendtype, recvbuf, recvcounts,
+                   displs, recvtype, root);
+}
+
+int MPI_Allgatherv(const void *sendbuf, int sendcount,
+                   MPI_Datatype sendtype, void *recvbuf,
+                   const int recvcounts[], const int displs[],
+                   MPI_Datatype recvtype, MPI_Comm comm) {
+  CommObj *c = lookup_comm(comm);
+  if (!c) return MPI_ERR_COMM;
+  return c_allgatherv(*c, sendbuf, sendcount, sendtype, recvbuf,
+                      recvcounts, displs, recvtype);
+}
+
+int MPI_Scatterv(const void *sendbuf, const int sendcounts[],
+                 const int displs[], MPI_Datatype sendtype, void *recvbuf,
+                 int recvcount, MPI_Datatype recvtype, int root,
+                 MPI_Comm comm) {
+  CommObj *c = lookup_comm(comm);
+  if (!c) return MPI_ERR_COMM;
+  if (root < 0 || root >= (int)c->group.size()) return MPI_ERR_ARG;
+  return c_scatterv(*c, sendbuf, sendcounts, displs, sendtype, recvbuf,
+                    recvcount, recvtype, root);
+}
+
+int MPI_Reduce_scatter_block(const void *sendbuf, void *recvbuf,
+                             int recvcount, MPI_Datatype dt, MPI_Op op,
+                             MPI_Comm comm) {
+  CommObj *c = lookup_comm(comm);
+  if (!c) return MPI_ERR_COMM;
+  return c_reduce_scatter_block(*c, sendbuf, recvbuf, recvcount, dt, op);
+}
+
+// ------------------------------------------------------------ user ops
+
+int MPI_Op_create(MPI_User_function *function, int commute, MPI_Op *op) {
+  if (!function || !op) return MPI_ERR_ARG;
+  MPI_Op handle = g_next_op++;
+  g_user_ops[handle] = UserOp{function, commute != 0};
+  *op = handle;
+  return MPI_SUCCESS;
+}
+
+int MPI_Op_free(MPI_Op *op) {
+  if (!op || !g_user_ops.erase(*op)) return MPI_ERR_OP;
+  *op = MPI_OP_NULL;
+  return MPI_SUCCESS;
+}
+
+// --------------------------------------------------------- diagnostics
+
+int MPI_Error_string(int errorcode, char *string, int *resultlen) {
+  const char *s;
+  switch (errorcode) {
+    case MPI_SUCCESS:      s = "MPI_SUCCESS: no error"; break;
+    case MPI_ERR_COMM:     s = "MPI_ERR_COMM: invalid communicator"; break;
+    case MPI_ERR_TYPE:     s = "MPI_ERR_TYPE: invalid datatype"; break;
+    case MPI_ERR_OP:       s = "MPI_ERR_OP: invalid reduction operation";
+                           break;
+    case MPI_ERR_REQUEST:  s = "MPI_ERR_REQUEST: invalid request"; break;
+    case MPI_ERR_ARG:      s = "MPI_ERR_ARG: invalid argument"; break;
+    case MPI_ERR_TRUNCATE: s = "MPI_ERR_TRUNCATE: message truncated";
+                           break;
+    case MPI_ERR_OTHER:    s = "MPI_ERR_OTHER: known error not in list";
+                           break;
+    default:               s = "unknown error code"; break;
+  }
+  snprintf(string, MPI_MAX_ERROR_STRING, "%s", s);
+  *resultlen = (int)strlen(string);
+  return MPI_SUCCESS;
+}
+
+int MPI_Type_get_extent(MPI_Datatype dt, long *lb, long *extent) {
+  DtView v;
+  if (!resolve_dtype(dt, v)) {
+    // allow uncommitted derived types for extent queries
+    auto it = g_dtypes.find(dt);
+    if (it == g_dtypes.end()) return MPI_ERR_TYPE;
+    DtInfo di;
+    if (!base_dtinfo(it->second.base, di)) return MPI_ERR_TYPE;
+    *lb = 0;
+    *extent = (long)(it->second.extent * (int64_t)di.item);
+    return MPI_SUCCESS;
+  }
+  *lb = 0;
+  *extent = (long)slot_bytes(v, 1);
   return MPI_SUCCESS;
 }
 

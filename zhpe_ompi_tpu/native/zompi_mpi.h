@@ -11,10 +11,12 @@
  * ranks interoperate in one job.
  *
  * Round-4 breadth (VERDICT Missing #1): nonblocking point-to-point with
- * request wait/test, communicator management (split/dup/free + SELF),
- * the rooted/gather-family collectives, derived datatypes
- * (contiguous/vector + commit), the full predefined integer dtype set,
- * and the logical/bitwise reduction ops.
+ * request wait/test/waitall/waitany/testall, probe/iprobe, communicator
+ * management (split/dup/free + SELF), the rooted/gather-family
+ * collectives plus v-variants, scan/exscan, reduce_scatter_block,
+ * derived datatypes (contiguous/vector + commit/extent), the full
+ * predefined integer dtype set, the logical/bitwise reduction ops,
+ * user-defined operators (MPI_Op_create), and MPI_Error_string.
  *
  * Wire-up (the PMIx-env analog): MPI_Init reads
  *   ZMPI_RANK        this process's rank
@@ -93,12 +95,13 @@ typedef int MPI_Request;
 #define MPI_ERR_OTHER    16
 
 #define MPI_MAX_PROCESSOR_NAME 256
+#define MPI_MAX_ERROR_STRING   256
 
 typedef struct MPI_Status {
   int MPI_SOURCE;
   int MPI_TAG;
   int MPI_ERROR;
-  int _count; /* received base-element count */
+  int _count; /* received BYTES (MPI_Get_count converts) */
 } MPI_Status;
 
 #define MPI_STATUS_IGNORE   ((MPI_Status *)0)
@@ -139,6 +142,15 @@ int MPI_Irecv(void *buf, int count, MPI_Datatype dt, int source, int tag,
 int MPI_Wait(MPI_Request *request, MPI_Status *status);
 int MPI_Test(MPI_Request *request, int *flag, MPI_Status *status);
 int MPI_Waitall(int count, MPI_Request requests[], MPI_Status statuses[]);
+int MPI_Waitany(int count, MPI_Request requests[], int *index,
+                MPI_Status *status);
+int MPI_Testall(int count, MPI_Request requests[], int *flag,
+                MPI_Status statuses[]);
+
+/* probe */
+int MPI_Probe(int source, int tag, MPI_Comm comm, MPI_Status *status);
+int MPI_Iprobe(int source, int tag, MPI_Comm comm, int *flag,
+               MPI_Status *status);
 
 /* collectives */
 int MPI_Barrier(MPI_Comm comm);
@@ -160,6 +172,34 @@ int MPI_Allgather(const void *sendbuf, int sendcount,
 int MPI_Alltoall(const void *sendbuf, int sendcount, MPI_Datatype sendtype,
                  void *recvbuf, int recvcount, MPI_Datatype recvtype,
                  MPI_Comm comm);
+int MPI_Gatherv(const void *sendbuf, int sendcount, MPI_Datatype sendtype,
+                void *recvbuf, const int recvcounts[], const int displs[],
+                MPI_Datatype recvtype, int root, MPI_Comm comm);
+int MPI_Allgatherv(const void *sendbuf, int sendcount,
+                   MPI_Datatype sendtype, void *recvbuf,
+                   const int recvcounts[], const int displs[],
+                   MPI_Datatype recvtype, MPI_Comm comm);
+int MPI_Scatterv(const void *sendbuf, const int sendcounts[],
+                 const int displs[], MPI_Datatype sendtype, void *recvbuf,
+                 int recvcount, MPI_Datatype recvtype, int root,
+                 MPI_Comm comm);
+int MPI_Scan(const void *sendbuf, void *recvbuf, int count,
+             MPI_Datatype dt, MPI_Op op, MPI_Comm comm);
+int MPI_Exscan(const void *sendbuf, void *recvbuf, int count,
+               MPI_Datatype dt, MPI_Op op, MPI_Comm comm);
+int MPI_Reduce_scatter_block(const void *sendbuf, void *recvbuf,
+                             int recvcount, MPI_Datatype dt, MPI_Op op,
+                             MPI_Comm comm);
+
+/* user-defined reduction operators */
+typedef void MPI_User_function(void *invec, void *inoutvec, int *len,
+                               MPI_Datatype *datatype);
+int MPI_Op_create(MPI_User_function *function, int commute, MPI_Op *op);
+int MPI_Op_free(MPI_Op *op);
+
+/* diagnostics */
+int MPI_Error_string(int errorcode, char *string, int *resultlen);
+int MPI_Type_get_extent(MPI_Datatype dt, long *lb, long *extent);
 
 /* derived datatypes */
 int MPI_Type_contiguous(int count, MPI_Datatype oldtype,
