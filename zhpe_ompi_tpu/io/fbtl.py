@@ -106,6 +106,9 @@ class AsyncFbtl:
         The file layer routes its MCA-selected fcoll through this, so
         the nonblocking path uses the same strategy component as the
         blocking one."""
+        from ..runtime import spc
+
+        spc.record("io_nonblocking_ops")
         req = FileRequest()
         with self._mu:
             self._inflight.add(req)

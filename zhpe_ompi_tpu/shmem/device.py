@@ -318,6 +318,9 @@ class DeviceHeap:
             out_specs=([P(ax)] * len(keys), P(ax)),
             check_vma=False,
         )
+        from ..runtime import spc
+
+        spc.record("pgas_device_epochs")
         new_arenas, out = mapped([self._arenas[k] for k in keys], *args)
         self._arenas = dict(zip(keys, new_arenas))
         return out
