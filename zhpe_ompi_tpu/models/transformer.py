@@ -296,7 +296,8 @@ def make_train_step(cfg: Config, mesh, dp_comm, tp_comm, sp_comm=None,
 
 def make_train_step_optax(cfg: Config, mesh, dp_comm, tp_comm,
                           sp_comm=None, optimizer=None, dcn_proc=None,
-                          dcn_weight: float | None = None):
+                          dcn_weight: float | None = None,
+                          dcn_sharded: bool = False):
     """Stateful-optimizer training step: the framework's SPMD grad
     computation composed with any optax GradientTransformation.
 
@@ -377,29 +378,43 @@ def make_train_step_optax(cfg: Config, mesh, dp_comm, tp_comm,
         if dcn_proc is not None and dcn_proc.size > 1:
             from ..parallel import hybrid
 
-            # DCN sync crosses the host: pack_tree gathers each gradient
-            # fully to numpy, the socket allreduce sums it across slices.
-            # This replicates full gradients through host RAM per step —
-            # acceptable for the small-slice regime this targets; a
-            # per-shard DCN reduction (each device's shard synced
-            # separately) is the scaling path when tp-sharded leaves get
-            # large.
-            bundle = hybrid.dcn_grad_sync(
-                dcn_proc,
-                {"grads": grads, "loss": np.asarray(loss, np.float32)},
-                weight=dcn_weight,
-            )
-            # Re-shard the synced host gradients explicitly before the
-            # jitted apply: feeding unsharded numpy would force XLA to
-            # re-infer layout from donated params and materialize a
-            # replicated copy on every device first.
-            grads = {
-                k: jax.device_put(v, grad_shardings[k])
-                for k, v in bundle["grads"].items()
-            }
-            # keep the return contract uniform across modes: loss is
-            # always a jax scalar
-            loss = jnp.asarray(bundle["loss"])
+            if dcn_sharded:
+                # scaling path (round 4): each distinct device shard
+                # syncs with its same-index peer across slices — host
+                # memory and DCN traffic are O(unique shard bytes),
+                # shardings preserved with no reshard (identical meshes
+                # on every slice, fingerprint-enforced).  The loss
+                # scalar rides the same call's host-leaf bucket — no
+                # extra DCN round trip.
+                bundle = hybrid.dcn_grad_sync_sharded(
+                    dcn_proc,
+                    {"grads": grads,
+                     "loss": np.asarray(loss, np.float32)},
+                    weight=dcn_weight)
+                grads = bundle["grads"]
+                loss = jnp.asarray(bundle["loss"])
+            else:
+                # small-slice default: pack_tree gathers each gradient
+                # fully to numpy and one bucketed allreduce syncs it —
+                # fewer, larger messages, at the cost of full-tensor
+                # host replication per step
+                bundle = hybrid.dcn_grad_sync(
+                    dcn_proc,
+                    {"grads": grads,
+                     "loss": np.asarray(loss, np.float32)},
+                    weight=dcn_weight,
+                )
+                # Re-shard the synced host gradients explicitly before
+                # the jitted apply: feeding unsharded numpy would force
+                # XLA to re-infer layout from donated params and
+                # materialize a replicated copy on every device first.
+                grads = {
+                    k: jax.device_put(v, grad_shardings[k])
+                    for k, v in bundle["grads"].items()
+                }
+                # keep the return contract uniform across modes: loss
+                # is always a jax scalar
+                loss = jnp.asarray(bundle["loss"])
         new_params, opt_state = apply(params, opt_state, grads)
         return new_params, opt_state, loss
 

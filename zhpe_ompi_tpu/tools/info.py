@@ -55,10 +55,13 @@ def gather(prefix: str | None = None) -> dict:
     from ..mca import var as mca_var
     from ..runtime import spc
 
+    from ..coll import tuned
+
     data = {
         "version": __version__,
         "package": "zhpe_ompi_tpu",
         "frameworks": mca_component.info(),
+        "profiles": tuned.profiles(),
         "params": [
             {
                 "name": v.name,
@@ -110,6 +113,9 @@ def main(argv: list[str] | None = None) -> int:
                 f"[{v['source']}] {v['description']}"
             )
     if show_all or args.pvars:
+        print("\n== Shipped decision profiles (coll_tuned_dynamic_rules) ==")
+        for name, path in data["profiles"].items():
+            print(f"  {name:<12} {path}")
         print("\n== Performance variables (SPC) ==")
         if not data["pvars"]:
             print("  (no counters recorded)")
