@@ -1,0 +1,122 @@
+/* fileio_c.c — MPI-IO acceptance for the C ABI (round 4).
+ *
+ * The byte-view C file surface: collective open with CREATE, disjoint
+ * per-rank write_at stripes, sync, cross-rank read_at verification,
+ * individual-pointer read/write with seek/get_position, derived-type
+ * file IO (a strided vector written as its packed image), get/set_size,
+ * and DELETE_ON_CLOSE teardown.
+ *
+ * Usage: fileio_c <path>
+ */
+
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+#include "zompi_mpi.h"
+
+#define CHECK(cond, msg)                                      \
+  do {                                                        \
+    if (!(cond)) {                                            \
+      fprintf(stderr, "FAIL rank %d: %s\n", rank, msg);       \
+      return 1;                                               \
+    }                                                         \
+  } while (0)
+
+int main(int argc, char **argv) {
+  int rank, size;
+  if (MPI_Init(&argc, &argv) != MPI_SUCCESS) return 2;
+  MPI_Comm_rank(MPI_COMM_WORLD, &rank);
+  MPI_Comm_size(MPI_COMM_WORLD, &size);
+  CHECK(argc > 1, "need a path argument");
+  const char *path = argv[1];
+
+  /* collective create + disjoint stripes */
+  MPI_File fh;
+  CHECK(MPI_File_open(MPI_COMM_WORLD, path,
+                      MPI_MODE_CREATE | MPI_MODE_RDWR, MPI_INFO_NULL,
+                      &fh) == MPI_SUCCESS, "open");
+  double stripe[4];
+  for (int i = 0; i < 4; i++) stripe[i] = rank * 10.0 + i;
+  MPI_Status st;
+  CHECK(MPI_File_write_at(fh, rank * 32, stripe, 4, MPI_DOUBLE, &st) ==
+            MPI_SUCCESS, "write_at");
+  int wn = -1;
+  MPI_Get_count(&st, MPI_DOUBLE, &wn);
+  CHECK(wn == 4, "write_at count");
+  CHECK(MPI_File_sync(fh) == MPI_SUCCESS, "sync");  /* + barrier */
+
+  /* read the RIGHT neighbor's stripe */
+  int nbr = (rank + 1) % size;
+  double peer[4];
+  CHECK(MPI_File_read_at(fh, nbr * 32, peer, 4, MPI_DOUBLE, &st) ==
+            MPI_SUCCESS, "read_at");
+  for (int i = 0; i < 4; i++)
+    CHECK(peer[i] == nbr * 10.0 + i, "neighbor stripe");
+
+  /* size queries */
+  MPI_Offset sz = -1;
+  CHECK(MPI_File_get_size(fh, &sz) == MPI_SUCCESS && sz == 32 * size,
+        "get_size");
+
+  /* individual pointer: seek to own stripe, read through the pointer */
+  CHECK(MPI_File_seek(fh, rank * 32, MPI_SEEK_SET) == MPI_SUCCESS,
+        "seek");
+  double mine2[2];
+  CHECK(MPI_File_read(fh, mine2, 2, MPI_DOUBLE, &st) == MPI_SUCCESS,
+        "read");
+  MPI_Offset pos = -1;
+  CHECK(MPI_File_get_position(fh, &pos) == MPI_SUCCESS &&
+            pos == rank * 32 + 16, "get_position");
+  CHECK(mine2[0] == rank * 10.0 && mine2[1] == rank * 10.0 + 1,
+        "pointer read");
+  /* everyone's size/pointer checks done before anyone extends the
+   * file below (a slow rank must not observe a neighbor's later
+   * write) */
+  MPI_Barrier(MPI_COMM_WORLD);
+
+  /* derived type through the file: every rank appends its column image
+   * past the stripes (packed vector = 3 doubles) */
+  MPI_Datatype col;
+  MPI_Type_vector(3, 1, 2, MPI_DOUBLE, &col);
+  MPI_Type_commit(&col);
+  double mat[6];
+  for (int i = 0; i < 6; i++) mat[i] = rank * 100.0 + i;
+  MPI_Offset base = 32 * (MPI_Offset)size + rank * 24;
+  CHECK(MPI_File_write_at(fh, base, mat, 1, col, &st) == MPI_SUCCESS,
+        "vector write_at");
+  CHECK(MPI_File_sync(fh) == MPI_SUCCESS, "sync 2");
+  double flat[3];
+  CHECK(MPI_File_read_at(fh, base, flat, 3, MPI_DOUBLE, &st) ==
+            MPI_SUCCESS, "flat read of packed vector");
+  CHECK(flat[0] == rank * 100.0 && flat[1] == rank * 100.0 + 2 &&
+            flat[2] == rank * 100.0 + 4, "packed vector image");
+  MPI_Type_free(&col);
+
+  /* truncate collectively, verify */
+  CHECK(MPI_File_set_size(fh, 32 * size) == MPI_SUCCESS, "set_size");
+  CHECK(MPI_File_get_size(fh, &sz) == MPI_SUCCESS && sz == 32 * size,
+        "size after truncate");
+
+  CHECK(MPI_File_close(&fh) == MPI_SUCCESS && fh == MPI_FILE_NULL,
+        "close");
+
+  /* DELETE_ON_CLOSE on a scratch file */
+  char scratch[1024];
+  snprintf(scratch, sizeof scratch, "%s.scratch", path);
+  MPI_File fh2;
+  CHECK(MPI_File_open(MPI_COMM_WORLD, scratch,
+                      MPI_MODE_CREATE | MPI_MODE_WRONLY |
+                      MPI_MODE_DELETE_ON_CLOSE, MPI_INFO_NULL,
+                      &fh2) == MPI_SUCCESS, "scratch open");
+  CHECK(MPI_File_close(&fh2) == MPI_SUCCESS, "scratch close");
+  MPI_File fh3;
+  CHECK(MPI_File_open(MPI_COMM_WORLD, scratch, MPI_MODE_RDONLY,
+                      MPI_INFO_NULL, &fh3) == MPI_ERR_NO_SUCH_FILE,
+        "scratch deleted on close");
+
+  MPI_Barrier(MPI_COMM_WORLD);
+  printf("fileio_c rank %d/%d OK\n", rank, size);
+  MPI_Finalize();
+  return 0;
+}

@@ -272,3 +272,32 @@ int main(int argc, char **argv) {
             out, err = p.communicate(timeout=60)
             assert p.returncode == 0, f"rank {r} failed: {err}\n{out}"
             assert "pending OK" in out
+
+
+@pytest.fixture(scope="module")
+def fileio_bin(shim, tmp_path_factory):
+    return _compile_example(shim, tmp_path_factory, "fileio_c.c")
+
+
+class TestFileIO:
+    """The MPI-IO C surface (byte views over POSIX at-offset IO):
+    collective open/close, disjoint stripes, cross-rank verification,
+    pointers, derived-type images, set_size, DELETE_ON_CLOSE."""
+
+    @pytest.mark.parametrize("n", [1, 2, 4])
+    def test_fileio_example(self, fileio_bin, n, tmp_path):
+        port = _free_port()
+        path = str(tmp_path / f"data_{n}.bin")
+        procs = [
+            subprocess.Popen([fileio_bin, path], env=_env(r, n, port),
+                             stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                             text=True)
+            for r in range(n)
+        ]
+        for r, p in enumerate(procs):
+            out, err = p.communicate(timeout=90)
+            assert p.returncode == 0, f"rank {r} failed: {err}\n{out}"
+            assert f"fileio_c rank {r}/{n} OK" in out
+        # the truncated data file remains; scratch must be gone
+        assert os.path.getsize(path) == 32 * n
+        assert not os.path.exists(path + ".scratch")

@@ -54,7 +54,9 @@
 #include "zompi_mpi.h"
 
 #include <arpa/inet.h>
+#include <fcntl.h>
 #include <netinet/in.h>
+#include <sys/stat.h>
 #include <netinet/tcp.h>
 #include <sys/socket.h>
 #include <unistd.h>
@@ -727,6 +729,19 @@ struct CommObj {
 
 std::map<int, CommObj> g_comms;
 int g_next_comm = 2;  // 0 = WORLD, 1 = SELF
+
+// MPI-IO file table (definitions with the other global state so
+// MPI_Finalize can sweep leaked fds)
+struct FileObj {
+  int fd = -1;
+  int amode = 0;
+  int comm = MPI_COMM_WORLD;
+  int64_t pointer = 0;  // individual pointer, bytes
+  std::string path;
+};
+
+std::map<int, FileObj> g_files;
+int g_next_file = 1;
 
 CommObj *lookup_comm(MPI_Comm c) {
   auto it = g_comms.find(c);
@@ -1470,6 +1485,8 @@ int MPI_Finalize(void) {
     g.reqs.clear();
     g.unexpected.clear();
   }
+  for (auto &kv : g_files) ::close(kv.second.fd);
+  g_files.clear();
   g_comms.clear();
   g_dtypes.clear();
   g_next_dtype = DERIVED_BASE;
@@ -2129,6 +2146,226 @@ int MPI_Type_get_extent(MPI_Datatype dt, long *lb, long *extent) {
   *lb = 0;
   *extent = (long)slot_bytes(v, 1);
   return MPI_SUCCESS;
+}
+
+// --------------------------------------------------------------- MPI-IO
+// Byte-view file surface over POSIX at-offset IO (the romio-level C
+// semantics with the default MPI_BYTE etype; collective open/close via
+// the communicator's barrier, matching io_ompio_file_open.c's shape).
+
+namespace {
+
+FileObj *lookup_file(MPI_File fh) {
+  auto it = g_files.find(fh);
+  return it == g_files.end() ? nullptr : &it->second;
+}
+
+// fill an MPI_Status for a file transfer of `nbytes`
+void file_status(MPI_Status *status, size_t nbytes) {
+  if (status) {
+    status->MPI_SOURCE = MPI_ANY_SOURCE;
+    status->MPI_TAG = MPI_ANY_TAG;
+    status->MPI_ERROR = MPI_SUCCESS;
+    status->_count = (int)nbytes;
+  }
+}
+
+}  // namespace
+
+int MPI_File_open(MPI_Comm comm, const char *filename, int amode,
+                  MPI_Info, MPI_File *fh) {
+  CommObj *c = lookup_comm(comm);
+  if (!c) return MPI_ERR_COMM;
+  int rw = amode & (MPI_MODE_RDONLY | MPI_MODE_WRONLY | MPI_MODE_RDWR);
+  int flags;
+  if (rw == MPI_MODE_RDONLY) flags = O_RDONLY;
+  else if (rw == MPI_MODE_WRONLY) flags = O_WRONLY;
+  else if (rw == MPI_MODE_RDWR) flags = O_RDWR;
+  else return MPI_ERR_AMODE;
+  // collective create: rank 0 creates (EXCL honored there), peers open
+  // the existing file after the barrier — no O_CREAT races
+  int fd = -1;
+  if (c->local_rank == 0) {
+    int f0 = flags;
+    if (amode & MPI_MODE_CREATE) f0 |= O_CREAT;
+    if (amode & MPI_MODE_EXCL) f0 |= O_EXCL;
+    fd = ::open(filename, f0, 0644);
+  }
+  int rc = c_barrier(*c);
+  if (rc) return rc;
+  if (c->local_rank != 0) fd = ::open(filename, flags);
+  // collective agreement: if ANY rank failed (rank 0's EEXIST under
+  // EXCL, a peer's EMFILE...), every rank fails — divergent outcomes
+  // would deadlock the next collective file op
+  int32_t ok = fd >= 0 ? 1 : 0, all_ok = 0;
+  rc = c_allreduce(*c, &ok, &all_ok, 1, MPI_INT, MPI_MIN);
+  if (rc) return rc;
+  if (!all_ok) {
+    if (fd >= 0) ::close(fd);
+    return MPI_ERR_NO_SUCH_FILE;
+  }
+  FileObj f;
+  f.fd = fd;
+  f.amode = amode;
+  f.comm = comm;
+  f.path = filename;
+  if (amode & MPI_MODE_APPEND) {
+    struct stat st{};
+    if (fstat(fd, &st) == 0) f.pointer = (int64_t)st.st_size;
+  }
+  int handle = g_next_file++;
+  g_files[handle] = f;
+  *fh = handle;
+  return MPI_SUCCESS;
+}
+
+int MPI_File_close(MPI_File *fh) {
+  FileObj *f = fh ? lookup_file(*fh) : nullptr;
+  if (!f) return MPI_ERR_FILE;
+  CommObj *c = lookup_comm(f->comm);
+  if (c) c_barrier(*c);  // all IO quiescent before any unlink
+  ::close(f->fd);
+  if ((f->amode & MPI_MODE_DELETE_ON_CLOSE) && c && c->local_rank == 0)
+    ::unlink(f->path.c_str());
+  if (c) c_barrier(*c);
+  g_files.erase(*fh);
+  *fh = MPI_FILE_NULL;
+  return MPI_SUCCESS;
+}
+
+int MPI_File_delete(const char *filename, MPI_Info) {
+  return ::unlink(filename) == 0 ? MPI_SUCCESS : MPI_ERR_NO_SUCH_FILE;
+}
+
+int MPI_File_read_at(MPI_File fh, MPI_Offset offset, void *buf, int count,
+                     MPI_Datatype dt, MPI_Status *status) {
+  FileObj *f = lookup_file(fh);
+  if (!f) return MPI_ERR_FILE;
+  DtView v;
+  if (!resolve_dtype(dt, v)) return MPI_ERR_TYPE;
+  size_t want = (size_t)count * v.elems_per_item() * v.di.item;
+  ssize_t got;
+  if (v.contiguous()) {
+    got = pread(f->fd, buf, want, (off_t)offset);  // no staging copy
+    if (got < 0) return MPI_ERR_OTHER;
+  } else {
+    std::vector<char> tmp(want);
+    got = pread(f->fd, tmp.data(), want, (off_t)offset);
+    if (got < 0) return MPI_ERR_OTHER;
+    // short read past EOF: deliver what exists (MPI count semantics)
+    unpack_dtype(buf, count, v, tmp.data(), (size_t)got);
+  }
+  file_status(status, (size_t)got);
+  return MPI_SUCCESS;
+}
+
+int MPI_File_write_at(MPI_File fh, MPI_Offset offset, const void *buf,
+                      int count, MPI_Datatype dt, MPI_Status *status) {
+  FileObj *f = lookup_file(fh);
+  if (!f) return MPI_ERR_FILE;
+  DtView v;
+  if (!resolve_dtype(dt, v)) return MPI_ERR_TYPE;
+  ssize_t put;
+  size_t nbytes;
+  if (v.contiguous()) {
+    nbytes = (size_t)count * v.elems_per_item() * v.di.item;
+    put = pwrite(f->fd, buf, nbytes, (off_t)offset);  // no staging copy
+  } else {
+    std::vector<char> packed;
+    pack_dtype(buf, count, v, packed);
+    nbytes = packed.size();
+    put = pwrite(f->fd, packed.data(), nbytes, (off_t)offset);
+  }
+  if (put < 0 || (size_t)put != nbytes) return MPI_ERR_OTHER;
+  file_status(status, (size_t)put);
+  return MPI_SUCCESS;
+}
+
+int MPI_File_read(MPI_File fh, void *buf, int count, MPI_Datatype dt,
+                  MPI_Status *status) {
+  FileObj *f = lookup_file(fh);
+  if (!f) return MPI_ERR_FILE;
+  int64_t off = f->pointer;
+  // always read through a real status: the pointer advances by bytes
+  // ACTUALLY read (short reads at EOF must not strand the pointer past
+  // the data), whether or not the caller passed MPI_STATUS_IGNORE
+  MPI_Status st{};
+  int rc = MPI_File_read_at(fh, off, buf, count, dt, &st);
+  if (rc == MPI_SUCCESS) {
+    f->pointer = off + st._count;
+    if (status) *status = st;
+  }
+  return rc;
+}
+
+int MPI_File_write(MPI_File fh, const void *buf, int count,
+                   MPI_Datatype dt, MPI_Status *status) {
+  FileObj *f = lookup_file(fh);
+  if (!f) return MPI_ERR_FILE;
+  int64_t off = f->pointer;
+  int rc = MPI_File_write_at(fh, off, buf, count, dt, status);
+  if (rc == MPI_SUCCESS) {
+    DtView v;
+    resolve_dtype(dt, v);
+    f->pointer = off + (int64_t)count * v.elems_per_item() * v.di.item;
+  }
+  return rc;
+}
+
+int MPI_File_seek(MPI_File fh, MPI_Offset offset, int whence) {
+  FileObj *f = lookup_file(fh);
+  if (!f) return MPI_ERR_FILE;
+  if (whence == MPI_SEEK_SET) {
+    f->pointer = (int64_t)offset;
+  } else if (whence == MPI_SEEK_CUR) {
+    f->pointer += (int64_t)offset;
+  } else if (whence == MPI_SEEK_END) {
+    struct stat st{};
+    if (fstat(f->fd, &st) != 0) return MPI_ERR_OTHER;
+    f->pointer = (int64_t)st.st_size + (int64_t)offset;
+  } else {
+    return MPI_ERR_ARG;
+  }
+  return f->pointer < 0 ? MPI_ERR_ARG : MPI_SUCCESS;
+}
+
+int MPI_File_get_position(MPI_File fh, MPI_Offset *offset) {
+  FileObj *f = lookup_file(fh);
+  if (!f) return MPI_ERR_FILE;
+  *offset = (MPI_Offset)f->pointer;
+  return MPI_SUCCESS;
+}
+
+int MPI_File_get_size(MPI_File fh, MPI_Offset *size) {
+  FileObj *f = lookup_file(fh);
+  if (!f) return MPI_ERR_FILE;
+  struct stat st{};
+  if (fstat(f->fd, &st) != 0) return MPI_ERR_OTHER;
+  *size = (MPI_Offset)st.st_size;
+  return MPI_SUCCESS;
+}
+
+int MPI_File_set_size(MPI_File fh, MPI_Offset size) {
+  FileObj *f = lookup_file(fh);
+  if (!f) return MPI_ERR_FILE;
+  CommObj *c = lookup_comm(f->comm);
+  if (c) {
+    int rc = c_barrier(*c);  // collective
+    if (rc) return rc;
+  }
+  int rc = MPI_SUCCESS;
+  if (!c || c->local_rank == 0)
+    if (ftruncate(f->fd, (off_t)size) != 0) rc = MPI_ERR_OTHER;
+  if (c) c_barrier(*c);
+  return rc;
+}
+
+int MPI_File_sync(MPI_File fh) {
+  FileObj *f = lookup_file(fh);
+  if (!f) return MPI_ERR_FILE;
+  fsync(f->fd);
+  CommObj *c = lookup_comm(f->comm);
+  return c ? c_barrier(*c) : MPI_SUCCESS;
 }
 
 // ---------------------------------------------------------------- misc

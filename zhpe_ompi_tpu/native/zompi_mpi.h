@@ -201,6 +201,47 @@ int MPI_Op_free(MPI_Op *op);
 int MPI_Error_string(int errorcode, char *string, int *resultlen);
 int MPI_Type_get_extent(MPI_Datatype dt, long *lb, long *extent);
 
+/* MPI-IO (byte views: no set_view in the C surface — offsets are in
+ * bytes, the default MPI_BYTE etype; the Python plane owns file views
+ * and collective/nonblocking IO).  Open/close/set_size are collective
+ * over the communicator. */
+typedef int MPI_File;
+typedef long long MPI_Offset;
+typedef int MPI_Info;
+#define MPI_FILE_NULL (-1)
+#define MPI_INFO_NULL 0
+#define MPI_MODE_CREATE          1
+#define MPI_MODE_RDONLY          2
+#define MPI_MODE_WRONLY          4
+#define MPI_MODE_RDWR            8
+#define MPI_MODE_DELETE_ON_CLOSE 16
+#define MPI_MODE_EXCL            64
+#define MPI_MODE_APPEND          128
+#define MPI_SEEK_SET 600
+#define MPI_SEEK_CUR 602
+#define MPI_SEEK_END 604
+#define MPI_ERR_FILE   27
+#define MPI_ERR_AMODE  28
+#define MPI_ERR_NO_SUCH_FILE 37
+
+int MPI_File_open(MPI_Comm comm, const char *filename, int amode,
+                  MPI_Info info, MPI_File *fh);
+int MPI_File_close(MPI_File *fh);
+int MPI_File_delete(const char *filename, MPI_Info info);
+int MPI_File_read_at(MPI_File fh, MPI_Offset offset, void *buf, int count,
+                     MPI_Datatype dt, MPI_Status *status);
+int MPI_File_write_at(MPI_File fh, MPI_Offset offset, const void *buf,
+                      int count, MPI_Datatype dt, MPI_Status *status);
+int MPI_File_read(MPI_File fh, void *buf, int count, MPI_Datatype dt,
+                  MPI_Status *status);
+int MPI_File_write(MPI_File fh, const void *buf, int count,
+                   MPI_Datatype dt, MPI_Status *status);
+int MPI_File_seek(MPI_File fh, MPI_Offset offset, int whence);
+int MPI_File_get_position(MPI_File fh, MPI_Offset *offset);
+int MPI_File_get_size(MPI_File fh, MPI_Offset *size);
+int MPI_File_set_size(MPI_File fh, MPI_Offset size);
+int MPI_File_sync(MPI_File fh);
+
 /* derived datatypes */
 int MPI_Type_contiguous(int count, MPI_Datatype oldtype,
                         MPI_Datatype *newtype);

@@ -142,9 +142,15 @@ def dcn_grad_sync_sharded(proc, grads: Any, weight: float | None = None
         fp = hashlib.sha256()
         for leaf in leaves:
             if isinstance(leaf, jax.Array):
-                idxs = sorted(
-                    str(s.index) for s in leaf.addressable_shards
-                )
+                # index sequence IN DEVICE-ID ORDER (unsorted): the
+                # reduce pairing below follows device-id order, so a
+                # permuted device->shard mapping must change the
+                # fingerprint, not just the index set
+                idxs = [
+                    str(s.index)
+                    for s in sorted(leaf.addressable_shards,
+                                    key=lambda s: s.device.id)
+                ]
                 fp.update(repr((leaf.shape, str(leaf.dtype), idxs)
                                ).encode())
             else:
@@ -200,14 +206,9 @@ def dcn_grad_sync_sharded(proc, grads: Any, weight: float | None = None
             leaf.shape, leaf.sharding, buffers
         )
     if host_leaves:
-        synced = dcn_grad_sync(
-            proc, jax.tree_util.tree_unflatten(
-                jax.tree_util.tree_structure(host_leaves), host_leaves
-            ),
-            weight=weight,
-        )
-        for i, v in zip(host_idx,
-                        jax.tree_util.tree_leaves(synced)):
+        # a list IS a pytree: one bucketed sync over the flat leaves
+        synced = dcn_grad_sync(proc, host_leaves, weight=weight)
+        for i, v in zip(host_idx, synced):
             out[i] = v
     return jax.tree_util.tree_unflatten(treedef, out)
 
