@@ -301,3 +301,92 @@ class TestFileIO:
         # the truncated data file remains; scratch must be gone
         assert os.path.getsize(path) == 32 * n
         assert not os.path.exists(path + ".scratch")
+
+
+class TestGroups:
+    def test_group_algebra(self, shim, tmp_path):
+        """MPI_Comm_group + incl/excl/union/intersection/difference/
+        translate_ranks/Comm_compare — the ompi/group rank algebra."""
+        src = tmp_path / "groups.c"
+        src.write_text(r'''
+#include <stdio.h>
+#include "zompi_mpi.h"
+int main(int argc, char **argv) {
+  int rank, size;
+  if (MPI_Init(&argc, &argv) != MPI_SUCCESS) return 2;
+  MPI_Comm_rank(MPI_COMM_WORLD, &rank);
+  MPI_Comm_size(MPI_COMM_WORLD, &size);  /* run with 4 */
+  MPI_Group world, evens, odds, first3, inter, uni, diff;
+  int gsz = -1, grk = -1;
+  if (MPI_Comm_group(MPI_COMM_WORLD, &world) != MPI_SUCCESS) return 1;
+  MPI_Group_size(world, &gsz);
+  MPI_Group_rank(world, &grk);
+  if (gsz != size || grk != rank) return 3;
+  int er[2] = {0, 2}, orr[2] = {1, 3}, f3[3] = {0, 1, 2};
+  MPI_Group_incl(world, 2, er, &evens);
+  MPI_Group_incl(world, 2, orr, &odds);
+  MPI_Group_incl(world, 3, f3, &first3);
+  MPI_Group_rank(evens, &grk);
+  if (rank == 2 && grk != 1) return 4;
+  if (rank == 1 && grk != MPI_UNDEFINED) return 5;
+  MPI_Group_intersection(evens, first3, &inter);  /* {0,2} */
+  MPI_Group_size(inter, &gsz);
+  if (gsz != 2) return 6;
+  MPI_Group_union(evens, odds, &uni);  /* {0,2,1,3} */
+  MPI_Group_size(uni, &gsz);
+  if (gsz != 4) return 7;
+  MPI_Group_difference(world, evens, &diff);  /* {1,3} */
+  MPI_Group_size(diff, &gsz);
+  if (gsz != 2) return 8;
+  /* translate: evens rank 1 (world 2) -> world group rank 2 */
+  int r1[1] = {1}, r2[1] = {-5};
+  MPI_Group_translate_ranks(evens, 1, r1, world, r2);
+  if (r2[0] != 2) return 9;
+  /* excl of everything -> MPI_GROUP_EMPTY */
+  int all4[4] = {0, 1, 2, 3};
+  MPI_Group e;
+  MPI_Group_excl(world, 4, all4, &e);
+  if (e != MPI_GROUP_EMPTY) return 10;
+  MPI_Group_size(e, &gsz);
+  if (gsz != 0) return 11;
+  /* comm compare: dup is CONGRUENT, split-self is UNEQUAL */
+  MPI_Comm dup;
+  int cmp = -1;
+  MPI_Comm_dup(MPI_COMM_WORLD, &dup);
+  MPI_Comm_compare(MPI_COMM_WORLD, dup, &cmp);
+  if (cmp != MPI_CONGRUENT) return 12;
+  MPI_Comm_compare(MPI_COMM_WORLD, MPI_COMM_WORLD, &cmp);
+  if (cmp != MPI_IDENT) return 13;
+  MPI_Comm_compare(MPI_COMM_WORLD, MPI_COMM_SELF, &cmp);
+  if (cmp != (size == 1 ? MPI_CONGRUENT : MPI_UNEQUAL)) return 14;
+  MPI_Comm_free(&dup);
+  MPI_Group_free(&world);
+  MPI_Group_free(&evens);
+  MPI_Group_free(&e);
+  if (e != MPI_GROUP_NULL) return 15;
+  MPI_Barrier(MPI_COMM_WORLD);
+  printf("groups rank %d/%d OK\n", rank, size);
+  MPI_Finalize();
+  return 0;
+}
+''')
+        binpath = tmp_path / "groups"
+        libdir = os.path.dirname(shim)
+        libname = os.path.basename(shim)[3:].rsplit(".so", 1)[0]
+        subprocess.run(
+            ["gcc", str(src), "-o", str(binpath), "-I",
+             native.mpi_header_dir(), "-L", libdir, f"-l{libname}",
+             f"-Wl,-rpath,{libdir}"],
+            check=True, capture_output=True, text=True,
+        )
+        port = _free_port()
+        procs = [
+            subprocess.Popen([str(binpath)], env=_env(r, 4, port),
+                             stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                             text=True)
+            for r in range(4)
+        ]
+        for r, p in enumerate(procs):
+            out, err = p.communicate(timeout=60)
+            assert p.returncode == 0, f"rank {r} rc={p.returncode}: {err}"
+            assert f"groups rank {r}/4 OK" in out

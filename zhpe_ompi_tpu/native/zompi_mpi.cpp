@@ -730,6 +730,25 @@ struct CommObj {
 std::map<int, CommObj> g_comms;
 int g_next_comm = 2;  // 0 = WORLD, 1 = SELF
 
+// group table: a group is a list of world ranks (the ompi/group analog
+// with int handles)
+struct GroupObj {
+  std::vector<int> ranks;  // group rank -> world rank
+};
+std::map<int, GroupObj> g_groups;
+int g_next_group = 1;
+
+GroupObj *lookup_group(int grp) {
+  auto it = g_groups.find(grp);
+  return it == g_groups.end() ? nullptr : &it->second;
+}
+
+int register_group(std::vector<int> ranks) {
+  int handle = g_next_group++;
+  g_groups[handle] = GroupObj{std::move(ranks)};
+  return handle;
+}
+
 // MPI-IO file table (definitions with the other global state so
 // MPI_Finalize can sweep leaked fds)
 struct FileObj {
@@ -1487,6 +1506,8 @@ int MPI_Finalize(void) {
   }
   for (auto &kv : g_files) ::close(kv.second.fd);
   g_files.clear();
+  g_groups.clear();
+  g_next_group = 1;
   g_comms.clear();
   g_dtypes.clear();
   g_next_dtype = DERIVED_BASE;
@@ -1573,6 +1594,183 @@ int MPI_Comm_free(MPI_Comm *comm) {
     return MPI_ERR_COMM;
   if (!g_comms.erase(*comm)) return MPI_ERR_COMM;
   *comm = MPI_COMM_NULL;
+  return MPI_SUCCESS;
+}
+
+// --------------------------------------------------------------- groups
+// ompi/group reduced to rank-list algebra; set ops preserve the
+// first-group order (the MPI-defined ordering for union/intersection/
+// difference).
+
+int MPI_Comm_group(MPI_Comm comm, MPI_Group *group) {
+  CommObj *c = lookup_comm(comm);
+  if (!c) return MPI_ERR_COMM;
+  *group = register_group(c->group);
+  return MPI_SUCCESS;
+}
+
+int MPI_Group_size(MPI_Group group, int *size) {
+  if (group == MPI_GROUP_EMPTY) {
+    *size = 0;
+    return MPI_SUCCESS;
+  }
+  GroupObj *gr = lookup_group(group);
+  if (!gr) return MPI_ERR_GROUP;
+  *size = (int)gr->ranks.size();
+  return MPI_SUCCESS;
+}
+
+int MPI_Group_rank(MPI_Group group, int *rank) {
+  if (group == MPI_GROUP_EMPTY) {
+    *rank = MPI_UNDEFINED;
+    return MPI_SUCCESS;
+  }
+  GroupObj *gr = lookup_group(group);
+  if (!gr) return MPI_ERR_GROUP;
+  *rank = MPI_UNDEFINED;
+  for (size_t i = 0; i < gr->ranks.size(); i++)
+    if (gr->ranks[i] == g.rank) *rank = (int)i;
+  return MPI_SUCCESS;
+}
+
+int MPI_Group_incl(MPI_Group group, int n, const int ranks[],
+                   MPI_Group *newgroup) {
+  GroupObj *gr = lookup_group(group);
+  if (!gr) return MPI_ERR_GROUP;
+  if (n == 0) {
+    *newgroup = MPI_GROUP_EMPTY;
+    return MPI_SUCCESS;
+  }
+  std::vector<int> out;
+  for (int i = 0; i < n; i++) {
+    if (ranks[i] < 0 || ranks[i] >= (int)gr->ranks.size())
+      return MPI_ERR_ARG;
+    out.push_back(gr->ranks[ranks[i]]);
+  }
+  *newgroup = register_group(std::move(out));
+  return MPI_SUCCESS;
+}
+
+int MPI_Group_excl(MPI_Group group, int n, const int ranks[],
+                   MPI_Group *newgroup) {
+  GroupObj *gr = lookup_group(group);
+  if (!gr) return MPI_ERR_GROUP;
+  std::vector<bool> drop(gr->ranks.size(), false);
+  for (int i = 0; i < n; i++) {
+    if (ranks[i] < 0 || ranks[i] >= (int)gr->ranks.size())
+      return MPI_ERR_ARG;
+    drop[ranks[i]] = true;
+  }
+  std::vector<int> out;
+  for (size_t i = 0; i < gr->ranks.size(); i++)
+    if (!drop[i]) out.push_back(gr->ranks[i]);
+  if (out.empty()) {
+    *newgroup = MPI_GROUP_EMPTY;
+    return MPI_SUCCESS;
+  }
+  *newgroup = register_group(std::move(out));
+  return MPI_SUCCESS;
+}
+
+namespace {
+
+const std::vector<int> *group_ranks(MPI_Group grp,
+                                    const std::vector<int> &empty) {
+  if (grp == MPI_GROUP_EMPTY) return &empty;
+  GroupObj *g2 = lookup_group(grp);
+  return g2 ? &g2->ranks : nullptr;
+}
+
+}  // namespace
+
+int MPI_Group_union(MPI_Group group1, MPI_Group group2,
+                    MPI_Group *newgroup) {
+  static const std::vector<int> empty;
+  const std::vector<int> *a = group_ranks(group1, empty);
+  const std::vector<int> *b = group_ranks(group2, empty);
+  if (!a || !b) return MPI_ERR_GROUP;
+  std::vector<int> out(*a);
+  for (int r : *b)
+    if (std::find(out.begin(), out.end(), r) == out.end())
+      out.push_back(r);
+  *newgroup = out.empty() ? MPI_GROUP_EMPTY
+                          : register_group(std::move(out));
+  return MPI_SUCCESS;
+}
+
+int MPI_Group_intersection(MPI_Group group1, MPI_Group group2,
+                           MPI_Group *newgroup) {
+  static const std::vector<int> empty;
+  const std::vector<int> *a = group_ranks(group1, empty);
+  const std::vector<int> *b = group_ranks(group2, empty);
+  if (!a || !b) return MPI_ERR_GROUP;
+  std::vector<int> out;
+  for (int r : *a)
+    if (std::find(b->begin(), b->end(), r) != b->end())
+      out.push_back(r);
+  *newgroup = out.empty() ? MPI_GROUP_EMPTY
+                          : register_group(std::move(out));
+  return MPI_SUCCESS;
+}
+
+int MPI_Group_difference(MPI_Group group1, MPI_Group group2,
+                         MPI_Group *newgroup) {
+  static const std::vector<int> empty;
+  const std::vector<int> *a = group_ranks(group1, empty);
+  const std::vector<int> *b = group_ranks(group2, empty);
+  if (!a || !b) return MPI_ERR_GROUP;
+  std::vector<int> out;
+  for (int r : *a)
+    if (std::find(b->begin(), b->end(), r) == b->end())
+      out.push_back(r);
+  *newgroup = out.empty() ? MPI_GROUP_EMPTY
+                          : register_group(std::move(out));
+  return MPI_SUCCESS;
+}
+
+int MPI_Group_translate_ranks(MPI_Group group1, int n, const int ranks1[],
+                              MPI_Group group2, int ranks2[]) {
+  static const std::vector<int> empty;
+  const std::vector<int> *a = group_ranks(group1, empty);
+  const std::vector<int> *b = group_ranks(group2, empty);
+  if (!a || !b) return MPI_ERR_GROUP;
+  for (int i = 0; i < n; i++) {
+    if (ranks1[i] < 0 || ranks1[i] >= (int)a->size())
+      return MPI_ERR_ARG;
+    int world = (*a)[ranks1[i]];
+    ranks2[i] = MPI_UNDEFINED;
+    for (size_t j = 0; j < b->size(); j++)
+      if ((*b)[j] == world) ranks2[i] = (int)j;
+  }
+  return MPI_SUCCESS;
+}
+
+int MPI_Group_free(MPI_Group *group) {
+  if (!group) return MPI_ERR_GROUP;
+  if (*group == MPI_GROUP_EMPTY) {
+    *group = MPI_GROUP_NULL;
+    return MPI_SUCCESS;
+  }
+  if (!g_groups.erase(*group)) return MPI_ERR_GROUP;
+  *group = MPI_GROUP_NULL;
+  return MPI_SUCCESS;
+}
+
+int MPI_Comm_compare(MPI_Comm comm1, MPI_Comm comm2, int *result) {
+  CommObj *a = lookup_comm(comm1), *b = lookup_comm(comm2);
+  if (!a || !b) return MPI_ERR_COMM;
+  if (comm1 == comm2) {
+    *result = MPI_IDENT;
+    return MPI_SUCCESS;
+  }
+  if (a->group == b->group) {
+    *result = MPI_CONGRUENT;  // same ranks, same order, distinct context
+    return MPI_SUCCESS;
+  }
+  std::vector<int> sa(a->group), sb(b->group);
+  std::sort(sa.begin(), sa.end());
+  std::sort(sb.begin(), sb.end());
+  *result = sa == sb ? MPI_SIMILAR : MPI_UNEQUAL;
   return MPI_SUCCESS;
 }
 
