@@ -1602,6 +1602,17 @@ int MPI_Comm_free(MPI_Comm *comm) {
 // first-group order (the MPI-defined ordering for union/intersection/
 // difference).
 
+namespace {
+
+const std::vector<int> *group_ranks(MPI_Group grp) {
+  static const std::vector<int> empty;
+  if (grp == MPI_GROUP_EMPTY) return &empty;
+  GroupObj *g2 = lookup_group(grp);
+  return g2 ? &g2->ranks : nullptr;
+}
+
+}  // namespace
+
 int MPI_Comm_group(MPI_Comm comm, MPI_Group *group) {
   CommObj *c = lookup_comm(comm);
   if (!c) return MPI_ERR_COMM;
@@ -1635,17 +1646,20 @@ int MPI_Group_rank(MPI_Group group, int *rank) {
 
 int MPI_Group_incl(MPI_Group group, int n, const int ranks[],
                    MPI_Group *newgroup) {
-  GroupObj *gr = lookup_group(group);
-  if (!gr) return MPI_ERR_GROUP;
+  const std::vector<int> *base = group_ranks(group);
+  if (!base) return MPI_ERR_GROUP;
   if (n == 0) {
     *newgroup = MPI_GROUP_EMPTY;
     return MPI_SUCCESS;
   }
+  std::vector<bool> seen(base->size(), false);
   std::vector<int> out;
   for (int i = 0; i < n; i++) {
-    if (ranks[i] < 0 || ranks[i] >= (int)gr->ranks.size())
+    if (ranks[i] < 0 || ranks[i] >= (int)base->size())
       return MPI_ERR_ARG;
-    out.push_back(gr->ranks[ranks[i]]);
+    if (seen[ranks[i]]) return MPI_ERR_ARG;  // MPI: ranks distinct
+    seen[ranks[i]] = true;
+    out.push_back((*base)[ranks[i]]);
   }
   *newgroup = register_group(std::move(out));
   return MPI_SUCCESS;
@@ -1653,17 +1667,18 @@ int MPI_Group_incl(MPI_Group group, int n, const int ranks[],
 
 int MPI_Group_excl(MPI_Group group, int n, const int ranks[],
                    MPI_Group *newgroup) {
-  GroupObj *gr = lookup_group(group);
-  if (!gr) return MPI_ERR_GROUP;
-  std::vector<bool> drop(gr->ranks.size(), false);
+  const std::vector<int> *base = group_ranks(group);
+  if (!base) return MPI_ERR_GROUP;
+  std::vector<bool> drop(base->size(), false);
   for (int i = 0; i < n; i++) {
-    if (ranks[i] < 0 || ranks[i] >= (int)gr->ranks.size())
+    if (ranks[i] < 0 || ranks[i] >= (int)base->size())
       return MPI_ERR_ARG;
+    if (drop[ranks[i]]) return MPI_ERR_ARG;  // MPI: ranks distinct
     drop[ranks[i]] = true;
   }
   std::vector<int> out;
-  for (size_t i = 0; i < gr->ranks.size(); i++)
-    if (!drop[i]) out.push_back(gr->ranks[i]);
+  for (size_t i = 0; i < base->size(); i++)
+    if (!drop[i]) out.push_back((*base)[i]);
   if (out.empty()) {
     *newgroup = MPI_GROUP_EMPTY;
     return MPI_SUCCESS;
@@ -1672,22 +1687,10 @@ int MPI_Group_excl(MPI_Group group, int n, const int ranks[],
   return MPI_SUCCESS;
 }
 
-namespace {
-
-const std::vector<int> *group_ranks(MPI_Group grp,
-                                    const std::vector<int> &empty) {
-  if (grp == MPI_GROUP_EMPTY) return &empty;
-  GroupObj *g2 = lookup_group(grp);
-  return g2 ? &g2->ranks : nullptr;
-}
-
-}  // namespace
-
 int MPI_Group_union(MPI_Group group1, MPI_Group group2,
                     MPI_Group *newgroup) {
-  static const std::vector<int> empty;
-  const std::vector<int> *a = group_ranks(group1, empty);
-  const std::vector<int> *b = group_ranks(group2, empty);
+  const std::vector<int> *a = group_ranks(group1);
+  const std::vector<int> *b = group_ranks(group2);
   if (!a || !b) return MPI_ERR_GROUP;
   std::vector<int> out(*a);
   for (int r : *b)
@@ -1700,9 +1703,8 @@ int MPI_Group_union(MPI_Group group1, MPI_Group group2,
 
 int MPI_Group_intersection(MPI_Group group1, MPI_Group group2,
                            MPI_Group *newgroup) {
-  static const std::vector<int> empty;
-  const std::vector<int> *a = group_ranks(group1, empty);
-  const std::vector<int> *b = group_ranks(group2, empty);
+  const std::vector<int> *a = group_ranks(group1);
+  const std::vector<int> *b = group_ranks(group2);
   if (!a || !b) return MPI_ERR_GROUP;
   std::vector<int> out;
   for (int r : *a)
@@ -1715,9 +1717,8 @@ int MPI_Group_intersection(MPI_Group group1, MPI_Group group2,
 
 int MPI_Group_difference(MPI_Group group1, MPI_Group group2,
                          MPI_Group *newgroup) {
-  static const std::vector<int> empty;
-  const std::vector<int> *a = group_ranks(group1, empty);
-  const std::vector<int> *b = group_ranks(group2, empty);
+  const std::vector<int> *a = group_ranks(group1);
+  const std::vector<int> *b = group_ranks(group2);
   if (!a || !b) return MPI_ERR_GROUP;
   std::vector<int> out;
   for (int r : *a)
@@ -1730,11 +1731,14 @@ int MPI_Group_difference(MPI_Group group1, MPI_Group group2,
 
 int MPI_Group_translate_ranks(MPI_Group group1, int n, const int ranks1[],
                               MPI_Group group2, int ranks2[]) {
-  static const std::vector<int> empty;
-  const std::vector<int> *a = group_ranks(group1, empty);
-  const std::vector<int> *b = group_ranks(group2, empty);
+  const std::vector<int> *a = group_ranks(group1);
+  const std::vector<int> *b = group_ranks(group2);
   if (!a || !b) return MPI_ERR_GROUP;
   for (int i = 0; i < n; i++) {
+    if (ranks1[i] == MPI_PROC_NULL) {
+      ranks2[i] = MPI_PROC_NULL;  // MPI-2.2: passes through
+      continue;
+    }
     if (ranks1[i] < 0 || ranks1[i] >= (int)a->size())
       return MPI_ERR_ARG;
     int world = (*a)[ranks1[i]];

@@ -1,0 +1,149 @@
+"""ZeRO-1 across DCN slices: optimizer-state sharding over the host
+plane.
+
+The hybrid layer (``parallel/hybrid.py``) replicates optimizer state on
+every slice; at scale that replication dominates memory.  ZeRO stage 1
+partitions the flat parameter space across the data-parallel group so
+each rank keeps optimizer state only for the 1/N partition it OWNS —
+and the gradient synchronization becomes reduce-scatter (each owner
+receives exactly its fully-reduced partition) followed by an allgather
+of the updated parameters.  A ring allreduce IS a reduce-scatter plus an
+allgather, so the wire bytes match plain DDP while the optimizer memory
+drops by the slice count.
+
+Framework-native composition: the partition runs on the SAME per-dtype
+flat buckets ``pack_tree`` builds (bucketed like the gradient sync),
+``proc.reduce_scatter`` / ``proc.allgather`` are the host-plane
+collective algorithms, and extension float params (bf16/f8) ride the
+lossless f32 transport — which doubles as f32 master weights: the
+optimizer updates in f32 and the result casts back to the storage dtype
+at unpack, exactly the mixed-precision recipe large trainers use.
+
+Reference positioning: the reference has no optimizer (it is an MPI
+library); this layer is the "distributed is first-class" composition
+SURVEY §5's backend map calls for — the dp outer loop expressed in the
+framework's own collectives.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as np
+
+from .. import ops as zops
+from ..core import errors
+from .hybrid import pack_tree, unpack_tree
+
+
+def _partition(n: int, size: int) -> list[tuple[int, int]]:
+    """Contiguous (start, stop) per rank; padded-equal chunks so the
+    reduce_scatter blocks are same-sized (the host algorithm's
+    contract)."""
+    chunk = -(-n // size)
+    return [(min(r * chunk, n), min((r + 1) * chunk, n))
+            for r in range(size)]
+
+
+class ZeroOptimizer:
+    """Stage-1 ZeRO over a host-plane endpoint (TcpProc across slices).
+
+    ``optimizer`` is any optax GradientTransformation; its state exists
+    only for this rank's partition of each flat dtype bucket.  ``step``
+    takes the full (replicated) params tree and the LOCAL gradient tree
+    and returns the updated full params tree — numpy leaves in the
+    original dtypes, ready for ``jax.device_put``.
+    """
+
+    def __init__(self, proc, optimizer, params: Any,
+                 weight: float | None = None):
+        import jax
+
+        self.proc = proc
+        self.optimizer = optimizer
+        self.weight = weight
+        buffers, self._treedef, self._meta = pack_tree(params)
+        self._keys = sorted(buffers)
+        self._sizes = {k: buffers[k].size for k in self._keys}
+        n = proc.size
+        self._parts = {
+            k: _partition(buffers[k].size, n) for k in self._keys
+        }
+        me = proc.rank
+        # optimizer state over MY partition only (f32 transport dtype =
+        # master precision)
+        my_chunks = {
+            k: np.asarray(buffers[k][slice(*self._parts[k][me])],
+                          dtype=np.float32)
+            for k in self._keys
+        }
+        self._opt_state = optimizer.init(
+            jax.tree.map(lambda x: x, my_chunks)
+        )
+
+    def state_bytes(self) -> int:
+        """Optimizer-state bytes held by THIS rank (the ZeRO saving)."""
+        import jax
+
+        return sum(
+            getattr(leaf, "nbytes", 0)
+            for leaf in jax.tree_util.tree_leaves(self._opt_state)
+        )
+
+    def _chunks_of(self, flat: np.ndarray, key: str) -> list[np.ndarray]:
+        """Rank-indexed, padded-equal blocks of one flat bucket."""
+        n = self.proc.size
+        chunk = -(-self._sizes[key] // n)
+        padded = np.zeros(chunk * n, np.float32)
+        padded[: flat.size] = flat
+        return [padded[r * chunk: (r + 1) * chunk] for r in range(n)]
+
+    def step(self, params: Any, grads: Any) -> Any:
+        """One ZeRO-1 step: reduce-scatter grads, update the owned
+        partition, allgather updated params.  Collective over the
+        proc's whole group."""
+        p_buf, p_tree, p_meta = pack_tree(params)
+        g_buf, g_tree, _ = pack_tree(grads)
+        if sorted(p_buf) != self._keys or sorted(g_buf) != self._keys:
+            raise errors.ArgError(
+                "params/grads buckets do not match the tree this "
+                "optimizer was built for"
+            )
+        n, me = self.proc.size, self.proc.rank
+        w = (1.0 / n) if self.weight is None else float(self.weight)
+        new_chunks = {}
+        my_updates = {}
+        for k in self._keys:
+            if n == 1:
+                my_g = g_buf[k].astype(np.float32) * (
+                    1.0 if self.weight is None else w)
+                my_p = p_buf[k].astype(np.float32)
+            else:
+                blocks = self._chunks_of(
+                    g_buf[k].astype(np.float32) * w, k)
+                my_g = np.asarray(
+                    self.proc.reduce_scatter(blocks, zops.SUM),
+                    np.float32,
+                )
+                chunk = -(-self._sizes[k] // n)
+                padded = np.zeros(chunk * n, np.float32)
+                padded[: self._sizes[k]] = p_buf[k].astype(np.float32)
+                my_p = padded[me * chunk: (me + 1) * chunk]
+            my_updates[k] = (my_p, my_g)
+        # one optax update over the owned-partition tree
+        my_p_tree = {k: v[0] for k, v in my_updates.items()}
+        my_g_tree = {k: v[1] for k, v in my_updates.items()}
+        updates, self._opt_state = self.optimizer.update(
+            my_g_tree, self._opt_state, my_p_tree
+        )
+        import optax
+
+        new_local = optax.apply_updates(my_p_tree, updates)
+        for k in self._keys:
+            mine = np.asarray(new_local[k], np.float32)
+            if n == 1:
+                new_chunks[k] = mine[: self._sizes[k]]
+            else:
+                gathered = self.proc.allgather(mine)
+                new_chunks[k] = np.concatenate(gathered)[: self._sizes[k]]
+        return unpack_tree(new_chunks, p_tree, p_meta)
