@@ -124,3 +124,43 @@ def test_two_slice_zero_matches_replicated_adam(tmp_path):
     for k, v in params.items():
         np.testing.assert_allclose(got[k], np.asarray(v), rtol=3e-4,
                                    atol=3e-6)
+
+
+def test_odd_bucket_partition_two_ranks():
+    """Regression (round-4 review): a bucket whose size does not divide
+    the world size must still update correctly — init and step share
+    the padded chunk geometry."""
+    import optax
+
+    from test_tcp import run_tcp
+    from zhpe_ompi_tpu.parallel.zero import ZeroOptimizer
+
+    params = {"w": np.arange(5, dtype=np.float32)}
+    g = np.full(5, 0.5, np.float32)
+
+    def prog(p):
+        z = ZeroOptimizer(p, optax.sgd(0.1), params)
+        out = z.step(params, {"w": g})
+        return np.asarray(out["w"])
+
+    res = run_tcp(2, prog)
+    want = params["w"] - 0.1 * 0.5  # mean of equal grads
+    for r in range(2):
+        np.testing.assert_allclose(res[r], want, rtol=1e-6)
+
+
+def test_mismatched_tree_rejected():
+    import optax
+    import pytest
+
+    from zhpe_ompi_tpu.core import errors
+    from zhpe_ompi_tpu.parallel.zero import ZeroOptimizer
+
+    class OneProc:
+        rank, size = 0, 1
+
+    z = ZeroOptimizer(OneProc(), optax.sgd(0.1),
+                      {"w": np.zeros(8, np.float32)})
+    with pytest.raises(errors.ArgError, match="sizes"):
+        z.step({"w": np.zeros(8, np.float32)},
+               {"w": np.zeros(4, np.float32)})

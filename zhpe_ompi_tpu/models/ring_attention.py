@@ -25,6 +25,23 @@ import jax.numpy as jnp
 from jax import lax
 
 
+def _chunk_attn(qf, kb, vb, m, l, acc, mask=None):
+    """One (Sc x Sc) online-softmax block update; qf pre-scaled f32."""
+    scores = jnp.einsum("bshd,bthd->bhst", qf, kb.astype(jnp.float32))
+    if mask is not None:
+        scores = jnp.where(mask[None, None], scores, -jnp.inf)
+    blk_max = jnp.max(scores, axis=-1)
+    new_m = jnp.maximum(m, blk_max)
+    safe_m = jnp.where(jnp.isfinite(new_m), new_m, 0.0)
+    corr = jnp.where(jnp.isfinite(m), jnp.exp(m - safe_m), 0.0)
+    p = jnp.exp(scores - safe_m[..., None])
+    p = jnp.where(jnp.isfinite(scores), p, 0.0)
+    l = l * corr + jnp.sum(p, axis=-1)
+    pv = jnp.einsum("bhst,bthd->bshd", p, vb.astype(jnp.float32))
+    acc = acc * corr.transpose(0, 2, 1)[..., None] + pv
+    return new_m, l, acc
+
+
 def ring_attention(comm, q, k, v, causal: bool = True):
     """Exact attention over a sequence sharded on `comm`'s axis.
 
@@ -47,29 +64,18 @@ def ring_attention(comm, q, k, v, causal: bool = True):
     def step(carry, i):
         m, l, acc, kb, vb = carry
         src = (rank - i) % n  # whose K/V block we hold this step
-        scores = jnp.einsum(
-            "bshd,bthd->bhst", qf, kb.astype(jnp.float32)
-        )  # (B,H,Sq,Sk)
+        mask = None
         if causal:
             k_pos = src * S + jnp.arange(S)
             mask = q_pos[:, None] >= k_pos[None, :]
-            scores = jnp.where(mask[None, None], scores, -jnp.inf)
-        blk_max = jnp.max(scores, axis=-1)  # (B,H,Sq)
-        new_m = jnp.maximum(m, blk_max)
-        # guard fully-masked rows: exp(-inf - -inf) -> use where
-        safe_m = jnp.where(jnp.isfinite(new_m), new_m, 0.0)
-        corr = jnp.where(
-            jnp.isfinite(m), jnp.exp(m - safe_m), 0.0
-        )
-        p = jnp.exp(scores - safe_m[..., None])
-        p = jnp.where(jnp.isfinite(scores), p, 0.0)
-        l = l * corr + jnp.sum(p, axis=-1)
-        pv = jnp.einsum("bhst,bthd->bshd", p, vb.astype(jnp.float32))
-        acc = acc * corr.transpose(0, 2, 1)[..., None] + pv
+        # the shared online-softmax block update (_chunk_attn): ONE home
+        # for the numerically delicate recurrence, used by both the
+        # contiguous and zigzag rings
+        m, l, acc = _chunk_attn(qf, kb, vb, m, l, acc, mask=mask)
         # rotate K/V one hop around the ring (framework ppermute)
         kb = comm.shift(kb, 1)
         vb = comm.shift(vb, 1)
-        return (new_m, l, acc, kb, vb), None
+        return (m, l, acc, kb, vb), None
 
     # lax.scan (not fori_loop): reverse-mode AD needs a scan so training
     # can differentiate through the ring
@@ -93,3 +99,130 @@ def _block_attention_single(q, k, v, causal):
     return jnp.einsum(
         "bhst,bthd->bshd", w, v.astype(jnp.float32)
     ).astype(q.dtype)
+
+
+# ---------------------------------------------------------------- zigzag
+# Load-balanced causal ring attention (round 4).  With the contiguous
+# layout above, causality makes the ring LOCKSTEP-IMBALANCED: every rank
+# computes a full (S_local x S_local) score block each step, but for
+# rank i only steps with src <= i carry unmasked work — the per-step
+# wall time is set by the busiest rank while the others burn FLOPs on
+# fully-masked blocks.  The zigzag layout gives rank i the chunk PAIR
+# (i, 2n-1-i) of 2n global chunks; then every (rank, step) pair has
+# EXACTLY the equivalent of two unmasked half-chunks (one of
+# {2 full | 1 full + 2 half-diagonals}), so computing only the live
+# sub-blocks halves the attention FLOPs uniformly — balanced AND
+# cheaper, the standard zigzag/striped context-parallel scheme expressed
+# over the framework's ring.
+
+
+def zigzag_shard(x, n: int):
+    """Global (B, S, ...) -> (n, B, S/n, ...) zigzag blocks: rank i gets
+    chunks (i, 2n-1-i) of the 2n-chunk split, concatenated."""
+    S = x.shape[1]
+    assert S % (2 * n) == 0, "sequence must split into 2n chunks"
+    c = S // (2 * n)
+    chunks = [x[:, i * c:(i + 1) * c] for i in range(2 * n)]
+    return jnp.stack(
+        [jnp.concatenate([chunks[i], chunks[2 * n - 1 - i]], axis=1)
+         for i in range(n)]
+    )
+
+
+def zigzag_unshard(blocks, n: int):
+    """(n, B, S/n, ...) zigzag blocks -> global (B, S, ...)."""
+    parts = [None] * (2 * n)
+    for i in range(n):
+        b = blocks[i]
+        c = b.shape[1] // 2
+        parts[i] = b[:, :c]
+        parts[2 * n - 1 - i] = b[:, c:]
+    return jnp.concatenate(parts, axis=1)
+
+
+def ring_attention_zigzag(comm, q, k, v):
+    """Exact CAUSAL attention over a zigzag-sharded sequence.
+
+    q, k, v: (B, S_local, H, D) where the first half is this rank's
+    EARLY chunk (global chunk ``rank``) and the second half its LATE
+    chunk (global chunk ``2n-1-rank``) — the :func:`zigzag_shard`
+    layout.  Must run inside shard_map over comm's mesh.  Each ring
+    step computes only the causally-live sub-blocks (two full-chunk
+    equivalents), so attention FLOPs are half the contiguous ring's and
+    identical on every rank.
+    """
+    n = comm.size
+    if n == 1:
+        return _block_attention_single(q, k, v, True)
+    rank = comm.rank()
+    B, S, H, D = q.shape
+    c = S // 2
+    scale = D ** -0.5
+    qf = q.astype(jnp.float32) * scale
+    qa, qb = qf[:, :c], qf[:, c:]  # early / late chunks
+
+    causal = jnp.tril(jnp.ones((c, c), bool))
+
+    def init(sq):
+        return (jnp.full((B, H, sq), -jnp.inf, jnp.float32),
+                jnp.zeros((B, H, sq), jnp.float32),
+                jnp.zeros((B, sq, H, D), jnp.float32))
+
+    ma, la, acca = init(c)
+    mb, lb, accb = init(c)
+
+    def step(carry, i):
+        ma, la, acca, mb, lb, accb, kb, vb = carry
+        src = (rank - i) % n  # whose zigzag pair we hold this step
+        kc, kd = kb[:, :c], kb[:, c:]   # src's early / late chunks
+        vc, vd = vb[:, :c], vb[:, c:]
+        # live sub-blocks (chunk ids: a=rank, b=2n-1-rank, c=src,
+        # d=2n-1-src):
+        #   rank > src: (a,c) full, (b,c) full
+        #   rank < src: (b,c) full, (b,d) full
+        #   rank == src: (a,c) diag, (b,c) full, (b,d) diag
+        # (b,c) is full in EVERY case except the diagonal-on-self of
+        # (b,d); (a,d) is never live.  Dispatch the two variable
+        # sub-blocks with a 3-way branch on the traced comparison.
+        def gt_case(ops):
+            ma, la, acca, mb, lb, accb = ops
+            ma, la, acca = _chunk_attn(qa, kc, vc, ma, la, acca)
+            mb, lb, accb = _chunk_attn(qb, kc, vc, mb, lb, accb)
+            return ma, la, acca, mb, lb, accb
+
+        def lt_case(ops):
+            ma, la, acca, mb, lb, accb = ops
+            mb, lb, accb = _chunk_attn(qb, kc, vc, mb, lb, accb)
+            mb, lb, accb = _chunk_attn(qb, kd, vd, mb, lb, accb)
+            return ma, la, acca, mb, lb, accb
+
+        def eq_case(ops):
+            ma, la, acca, mb, lb, accb = ops
+            ma, la, acca = _chunk_attn(qa, kc, vc, ma, la, acca,
+                                       mask=causal)
+            mb, lb, accb = _chunk_attn(qb, kc, vc, mb, lb, accb)
+            mb, lb, accb = _chunk_attn(qb, kd, vd, mb, lb, accb,
+                                       mask=causal)
+            return ma, la, acca, mb, lb, accb
+
+        idx = jnp.where(rank > src, 0, jnp.where(rank < src, 1, 2))
+        ma, la, acca, mb, lb, accb = lax.switch(
+            idx, (gt_case, lt_case, eq_case),
+            (ma, la, acca, mb, lb, accb),
+        )
+        kb = comm.shift(kb, 1)
+        vb = comm.shift(vb, 1)
+        return (ma, la, acca, mb, lb, accb, kb, vb), None
+
+    (ma, la, acca, mb, lb, accb, _, _), _ = lax.scan(
+        step, (ma, la, acca, mb, lb, accb, k, v), jnp.arange(n)
+    )
+
+    def finish(m, l, acc):
+        denom = jnp.maximum(l, 1e-30).transpose(0, 2, 1)[..., None]
+        return acc / denom
+
+    out = jnp.concatenate(
+        [finish(ma, la, acca), finish(mb, lb, accb)], axis=1
+    )
+    return out.astype(q.dtype)

@@ -57,6 +57,11 @@ class Config:
     # vocab-chunked cross-entropy (no (B,S,V) materialization): chunk
     # size, or None for the unchunked reference loss
     ce_chunk: int | None = None
+    # zigzag sequence parallelism: tokens arrive zigzag-sharded (rank i
+    # holds global chunks (i, 2n-1-i)) and causal ring attention skips
+    # the dead half of the ring work, balanced across ranks
+    # (models/ring_attention.py::ring_attention_zigzag)
+    zigzag_sp: bool = False
 
 
 def init_params(cfg: Config, key, tp: int = 1) -> dict:
@@ -136,7 +141,12 @@ def forward_hidden(params: dict, tokens, cfg: Config, tp_comm=None,
         k = qkv[:, :, 1].reshape(B, S, n_heads_local, hd)
         v = qkv[:, :, 2].reshape(B, S, n_heads_local, hd)
         if sp_comm is not None:
-            o = ring_attention(sp_comm, q, k, v, causal=True)
+            if cfg.zigzag_sp:
+                from .ring_attention import ring_attention_zigzag
+
+                o = ring_attention_zigzag(sp_comm, q, k, v)
+            else:
+                o = ring_attention(sp_comm, q, k, v, causal=True)
             o = o.reshape(B, S, -1)
         elif use_flash:
             from ..ops.flash_attention import flash_attention

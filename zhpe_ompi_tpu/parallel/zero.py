@@ -36,15 +36,6 @@ from ..core import errors
 from .hybrid import pack_tree, unpack_tree
 
 
-def _partition(n: int, size: int) -> list[tuple[int, int]]:
-    """Contiguous (start, stop) per rank; padded-equal chunks so the
-    reduce_scatter blocks are same-sized (the host algorithm's
-    contract)."""
-    chunk = -(-n // size)
-    return [(min(r * chunk, n), min((r + 1) * chunk, n))
-            for r in range(size)]
-
-
 class ZeroOptimizer:
     """Stage-1 ZeRO over a host-plane endpoint (TcpProc across slices).
 
@@ -57,29 +48,22 @@ class ZeroOptimizer:
 
     def __init__(self, proc, optimizer, params: Any,
                  weight: float | None = None):
-        import jax
-
         self.proc = proc
         self.optimizer = optimizer
         self.weight = weight
         buffers, self._treedef, self._meta = pack_tree(params)
         self._keys = sorted(buffers)
         self._sizes = {k: buffers[k].size for k in self._keys}
-        n = proc.size
-        self._parts = {
-            k: _partition(buffers[k].size, n) for k in self._keys
-        }
-        me = proc.rank
-        # optimizer state over MY partition only (f32 transport dtype =
-        # master precision)
+        # optimizer state over MY partition only, in the SAME padded
+        # equal-chunk geometry step() reduces into (the padded tail of
+        # the last rank carries zero state and its updates are
+        # discarded at unpad) — f32 transport dtype = master precision
         my_chunks = {
-            k: np.asarray(buffers[k][slice(*self._parts[k][me])],
-                          dtype=np.float32)
+            k: self._chunks_of(buffers[k].astype(np.float32),
+                               k)[proc.rank].copy()
             for k in self._keys
         }
-        self._opt_state = optimizer.init(
-            jax.tree.map(lambda x: x, my_chunks)
-        )
+        self._opt_state = optimizer.init(my_chunks)
 
     def state_bytes(self) -> int:
         """Optimizer-state bytes held by THIS rank (the ZeRO saving)."""
@@ -104,11 +88,13 @@ class ZeroOptimizer:
         proc's whole group."""
         p_buf, p_tree, p_meta = pack_tree(params)
         g_buf, g_tree, _ = pack_tree(grads)
-        if sorted(p_buf) != self._keys or sorted(g_buf) != self._keys:
-            raise errors.ArgError(
-                "params/grads buckets do not match the tree this "
-                "optimizer was built for"
-            )
+        for buf in (p_buf, g_buf):
+            if {k: v.size for k, v in buf.items()} != self._sizes:
+                raise errors.ArgError(
+                    "params/grads buckets do not match the tree this "
+                    "optimizer was built for (keys AND sizes must "
+                    "agree)"
+                )
         n, me = self.proc.size, self.proc.rank
         w = (1.0 / n) if self.weight is None else float(self.weight)
         new_chunks = {}
