@@ -28,9 +28,10 @@ def _script(tmp_path, body: str) -> str:
     return str(p)
 
 
-def _launch(n, argv, **kw):
+def _launch(n, argv, timeout=60.0, **kw):
     out, err = io.StringIO(), io.StringIO()
-    rc = mpirun.launch(n, argv, stdout=out, stderr=err, timeout=60.0, **kw)
+    rc = mpirun.launch(n, argv, stdout=out, stderr=err, timeout=timeout,
+                       **kw)
     return rc, out.getvalue(), err.getvalue()
 
 
@@ -273,3 +274,14 @@ def test_cli_mpmd_colon_syntax(tmp_path):
     assert res.returncode == 0, res.stderr
     assert res.stdout.count("A-rank") == 2
     assert res.stdout.count("B-rank") == 1
+
+
+def test_zero_train_example():
+    """ZeRO-1 example under the launcher: 2 slices, partitioned state,
+    decreasing loss."""
+    rc, out, err = _launch(
+        2, [os.path.join(_REPO, "examples", "zmpirun_zero_train.py")],
+        timeout=150.0,
+    )
+    assert rc == 0, err
+    assert out.count("PASSED") == 2
