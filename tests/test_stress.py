@@ -65,3 +65,80 @@ class TestOverlapSoak:
             want = [0.0] * N
             want[(r - 1) % N] = float(ROUNDS)
             assert res[r] == want, (r, res[r])
+
+
+class TestAsyncIoSoak:
+    def test_many_inflight_requests_then_drain(self, tmp_path):
+        """Dozens of overlapping nonblocking reads/writes against one
+        file, interleaved completions, then close() drains whatever is
+        still in flight — the aio-queue soak (fbtl_posix sizes its
+        queue for exactly this shape)."""
+        import numpy as np
+
+        import zhpe_ompi_tpu as zmpi
+        from zhpe_ompi_tpu import io as zio
+
+        world = zmpi.init()
+        p = str(tmp_path / "soak.bin")
+        ROUNDS, SLOTS = 6, 16
+        with zio.File(world, p, zio.MODE_CREATE | zio.MODE_RDWR) as f:
+            for rnd in range(ROUNDS):
+                wreqs = [
+                    f.iwrite_at(s * 64,
+                                np.full(64, (rnd * SLOTS + s) % 251,
+                                        np.uint8))
+                    for s in range(SLOTS)
+                ]
+                # wait in reverse order (completion order independence)
+                for s in reversed(range(SLOTS)):
+                    assert wreqs[s].wait(timeout=60) == 64
+                rreqs = [f.iread_at(s * 64, 64) for s in range(SLOTS)]
+                for s, rq in enumerate(rreqs):
+                    got = rq.wait(timeout=60)
+                    assert got[0] == (rnd * SLOTS + s) % 251, (rnd, s)
+            # leave a few in flight for close() to drain
+            tail = [f.iwrite_at(s * 64, np.full(64, 7, np.uint8))
+                    for s in range(4)]
+        # drained at close: file reflects the tail writes
+        data = np.fromfile(p, np.uint8)
+        for s in range(4):
+            assert data[s * 64] == 7
+        assert all(t.done for t in tail)
+
+    def test_wire_collective_io_interleaved_with_pt2pt(self, tmp_path):
+        """Nonblocking collective IO overlapping user pt2pt on the SAME
+        endpoint: the reserved tag windows must keep them separate."""
+        import numpy as np
+
+        from test_tcp import run_tcp
+        from zhpe_ompi_tpu.io.file import MODE_CREATE, MODE_RDWR
+        from zhpe_ompi_tpu.io.wirefile import WireFile
+        from zhpe_ompi_tpu.datatype import INT32_T, create_resized, \
+            create_vector
+
+        path = str(tmp_path / "mix.bin")
+        N = 4
+
+        def prog(p):
+            with WireFile(p, path, MODE_RDWR | MODE_CREATE) as f:
+                ft = create_resized(create_vector(1, 1, 1, INT32_T),
+                                    0, 4 * N)
+                f.set_view(4 * p.rank, INT32_T, ft)
+                for rnd in range(4):
+                    data = np.arange(4, dtype=np.int32) + 100 * p.rank \
+                        + rnd
+                    wreq = f.iwrite_all(data)
+                    # pt2pt chatter on the same endpoint while the
+                    # collective body runs on the worker
+                    p.send(("r", rnd, p.rank), dest=(p.rank + 1) % N,
+                           tag=55 + rnd)
+                    got = p.recv(source=(p.rank - 1) % N, tag=55 + rnd)
+                    assert got == ("r", rnd, (p.rank - 1) % N)
+                    assert wreq.wait(timeout=60) == 4
+                    f.seek(0)
+                    back = f.iread_all(4).wait(timeout=60)
+                    assert back.tolist() == data.tolist(), (rnd, back)
+                    f.seek(0)
+            return True
+
+        assert run_tcp(N, prog) == [True] * N
