@@ -177,12 +177,15 @@ class DeviceWindow:
         raise errors.WinError(self._PASSIVE_MSG.format("flush_local"))
 
     def fence(self) -> "DeviceWindow":
-        """Epoch boundary: the barrier token is folded into the window state
-        (added as zero) so XLA cannot dead-code-eliminate the collective —
-        the returned window's shard carries a data dependency on every
-        rank's arrival."""
+        """Epoch boundary: the barrier token and the window state pass
+        through one ``optimization_barrier``, so the returned shard
+        carries a dependency on every rank's arrival (XLA may not
+        reorder or dead-code-eliminate across the barrier) at O(1) cost
+        — no elementwise pass over the window."""
+        from jax import lax
+
         from ..coll import algorithms as alg
 
         token = alg.barrier_dissemination(self.comm)
-        fenced = self.shard + token.astype(self.shard.dtype)
+        fenced, _ = lax.optimization_barrier((self.shard, token))
         return DeviceWindow(self.comm, fenced)

@@ -159,9 +159,16 @@ class DevicePE:
     def add(self, sym: DeviceSym, value, pe_of, index: int = 0
             ) -> "DevicePE":
         """shmem_atomic_add as a schedule: rank r adds its `value` into
-        element ``index`` of PE ``pe_of[r]``'s allocation.  One writer
-        per target per epoch (DeviceWindow's atomicity model: the
-        schedule IS the serialization)."""
+        element ``index`` of PE ``pe_of[r]``'s allocation.
+
+        Unique targets lower onto DeviceWindow.accumulate (one ppermute,
+        no collective).  When several PEs target the SAME PE — the
+        canonical "everyone bumps one counter" shmem_atomic idiom
+        (``oshmem/shmem/c/shmem_fadd.c``) — the epoch switches to the
+        *combining* form: each rank scatters its contribution into a
+        one-hot length-n vector, a single psum folds all contributions,
+        and each PE deposits its own total.  Associativity of the psum
+        is the serialization, so any writer multiplicity is exact."""
         n = self.n_pes()
         targets = _normalize_pe_of(pe_of, n)
         if not 0 <= index < sym.elems:
@@ -169,19 +176,83 @@ class DevicePE:
                 f"AMO index {index} out of range for allocation of "
                 f"{sym.elems} elements"
             )
+        if self._has_collision(targets):
+            return self._add_combining(sym, value, targets, index)
         val = jnp.asarray(value, self.arenas[sym.arena].dtype).reshape(1)
         win = self._window(sym).accumulate(
             val, targets, [sym.offset + index] * n)
         return self._with(sym.arena, win.shard)
 
     def fadd(self, sym: DeviceSym, value, pe_of, index: int = 0):
-        """shmem_atomic_fetch_add: returns (old, updated pe).  The old
-        value reads before the add in the same compiled epoch — correct
-        because the schedule admits one writer per target."""
+        """shmem_atomic_fetch_add: returns (old, updated pe).  Unique
+        targets read-before-add in the same compiled epoch.  Colliding
+        targets use the combining epoch with rank-order serialization:
+        rank r's fetch is the pre-epoch value plus the exclusive prefix
+        sum of lower-ranked contributions to the same target — every
+        fetcher observes a distinct, complete intermediate value, exactly
+        the linearization a hardware fetch-add in rank order produces."""
         n = self.n_pes()
         targets = _normalize_pe_of(pe_of, n)
+        if self._has_collision(targets):
+            old = self._prefix_fetch(sym, value, targets, index)
+            return old, self.add(sym, value, targets, index)
         old = self.get(sym, targets, count=1, offset=index)
         return old, self.add(sym, value, targets, index)
+
+    @staticmethod
+    def _has_collision(targets: list[int]) -> bool:
+        live = [t for t in targets if t >= 0]
+        return len(live) != len(set(live))
+
+    def _amo_vectors(self, sym: DeviceSym, value, targets: list[int]):
+        """Per-rank (target, active, contribution) as traced values: the
+        static schedule indexed by the executing PE's axis index."""
+        dt = self.arenas[sym.arena].dtype
+        my = self.comm.rank()
+        t_arr = jnp.asarray([t if t >= 0 else 0 for t in targets])
+        act_arr = jnp.asarray([1 if t >= 0 else 0 for t in targets])
+        val = jnp.asarray(value, dt).reshape(())
+        t = t_arr[my]
+        active = act_arr[my]
+        contrib = jnp.where(active == 1, val, jnp.zeros((), dt))
+        return my, t, active, contrib
+
+    def _add_combining(self, sym: DeviceSym, value, targets: list[int],
+                       index: int) -> "DevicePE":
+        from .. import ops as zops
+
+        n = self.n_pes()
+        dt = self.arenas[sym.arena].dtype
+        my, t, _active, contrib = self._amo_vectors(sym, value, targets)
+        onehot = jnp.zeros((n,), dt).at[t].add(contrib)
+        totals = self.comm.allreduce(onehot, zops.SUM)
+        flat = self.arenas[sym.arena]
+        new = flat.at[sym.offset + index].add(totals[my])
+        return self._with(sym.arena, new)
+
+    def _prefix_fetch(self, sym: DeviceSym, value, targets: list[int],
+                      index: int):
+        """Old value rank r observes under rank-order combining: target's
+        pre-epoch element + sum of contributions from ranks < r aimed at
+        the same target.  Idle (-1) ranks fetch 0 — the same masking the
+        unique-target ppermute path applies to non-destinations."""
+        if not 0 <= index < sym.elems:
+            raise errors.ArgError(
+                f"AMO index {index} out of range for allocation of "
+                f"{sym.elems} elements"
+            )
+        n = self.n_pes()
+        my, t, active, contrib = self._amo_vectors(sym, value, targets)
+        elem = self.arenas[sym.arena][sym.offset + index]
+        both = self.comm.allgather(
+            jnp.stack([elem.astype(contrib.dtype), contrib])[None])
+        elems, vals = both.reshape(n, 2)[:, 0], both.reshape(n, 2)[:, 1]
+        t_arr = jnp.asarray([tt if tt >= 0 else 0 for tt in targets])
+        before_me = (t_arr == t) & (jnp.arange(n) < my)
+        prefix = jnp.sum(jnp.where(before_me, vals, 0))
+        old = jnp.where(active == 1, elems[t] + prefix,
+                        jnp.zeros((), contrib.dtype))
+        return old.reshape(1)
 
     # -- collectives (the scoll analog, on XLA collectives) --------------
     # The reference's scoll/basic runs linear/binomial trees over pt2pt;
@@ -235,14 +306,20 @@ class DevicePE:
         return self.local_set(dest, moved.reshape(-1))
 
     def barrier(self) -> "DevicePE":
-        """shmem_barrier_all: fence every arena (data-dependency token,
-        like DeviceWindow.fence)."""
+        """shmem_barrier_all: fence every arena on the dissemination
+        token via ``optimization_barrier`` — an O(1) control dependency
+        per arena (XLA may not reorder or DCE across it), not an
+        elementwise pass over the heap.  The returned arenas carry a
+        data dependency on every PE's arrival at zero HBM traffic."""
+        from jax import lax
+
         from ..coll import algorithms as alg
 
         token = alg.barrier_dissemination(self.comm)
-        arenas = {
-            k: a + token.astype(a.dtype) for k, a in self.arenas.items()
-        }
+        arenas = {}
+        for k, a in self.arenas.items():
+            fenced, _ = lax.optimization_barrier((a, token))
+            arenas[k] = fenced
         return DevicePE(self.comm, arenas)
 
 
