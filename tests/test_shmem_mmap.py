@@ -257,3 +257,29 @@ class TestMappedProcesses:
         rc, out, err = _launch(2, [prog])
         assert rc == 0, err
         assert "WOKE" in out
+
+
+class TestMappedNbi:
+    """put_nbi/get_nbi on the mapped substrate: stores are coherent once
+    issued, so nbi completes immediately — the surface must still be
+    uniform with the AM backend (quiet is the completion point)."""
+
+    def test_nbi_roundtrip(self):
+        def prog(pe):
+            me, n = pe.my_pe(), pe.n_pes()
+            sym = pe.shmalloc(4, np.float64)
+            pe.local(sym)[...] = -1.0
+            pe.barrier_all()
+            pe.put_nbi(sym, np.full(4, float(me)), (me + 1) % n)
+            pe.quiet()
+            pe.barrier_all()
+            buf = np.zeros(4, np.float64)
+            pe.get_nbi(sym, (me + 1) % n, buf)
+            pe.quiet()
+            pe.barrier_all()
+            pe.shfree(sym)
+            return buf.tolist()
+
+        res = run_mapped(3, prog)
+        for r in range(3):
+            assert res[r] == [float(r)] * 4

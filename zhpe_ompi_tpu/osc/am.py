@@ -828,6 +828,18 @@ class AmWindow(errh.HasErrhandler, rma_util.FetchOpMixin):
             target, ("dyn_iget", self.win_id, disp, sst, n, dt.str)
         )
 
+    def dyn_get_nbi(self, target: int, disp: int, nbytes: int):
+        """Nonblocking dynamic get (the shmem_get_nbi substrate,
+        ``oshmem/shmem/c/shmem_get_nb.c``): returns a Request completing
+        with the raw bytes — the reply recv is posted and the caller
+        overlaps compute until it waits (normally at shmem_quiet)."""
+        if target == self.ep.rank:
+            with self.st.apply_lock:
+                view, off = resolve_dynamic(self.st, disp, nbytes)
+                return rma_util.completed_request(
+                    view[off : off + nbytes].copy())
+        return self._async_rpc(target, ("dyn_get", self.win_id, disp, nbytes))
+
     def dyn_amo(self, target: int, disp: int, kind: str, dtype,
                 value=None, compare=None):
         """Typed atomic (shmem AMO): add/swap/cas/set/fetch at a byte

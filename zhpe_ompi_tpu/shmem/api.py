@@ -103,6 +103,14 @@ class _DirectBackend:
     def iget(self, sym: SymArray, pe: int, n: int, sst: int) -> np.ndarray:
         return self._view(sym, pe).reshape(-1)[: n * sst : sst].copy()
 
+    def put_nbi(self, sym: SymArray, value, pe: int) -> None:
+        """shmem_put_nbi: in-process stores complete immediately — legal,
+        since nbi only promises completion no later than quiet."""
+        self.put(sym, value, pe)
+
+    def get_nbi(self, sym: SymArray, pe: int, target: np.ndarray) -> None:
+        target.reshape(-1)[...] = self._view(sym, pe).reshape(-1)
+
     def amo(self, sym: SymArray, kind: str, pe: int, index: int,
             value=None, compare=None):
         """Atomic read-modify-write; returns the pre-op value."""
@@ -173,6 +181,8 @@ class _AmBackend:
         from ..osc.am import AmWindow
 
         self._ep = ep
+        # (request, target buffer, dtype) of get_nbi ops completing at quiet
+        self._pending_gets: list[tuple] = []
         self.arena = np.zeros(heap_bytes, dtype=np.uint8)
         self._win = AmWindow.create_dynamic(ep)
         base = self._win.attach(self.arena)
@@ -227,6 +237,23 @@ class _AmBackend:
             value=value, compare=compare,
         )
 
+    # -- implicit-handle nonblocking RMA (shmem_put_nbi/get_nbi) ----------
+
+    def put_nbi(self, sym: SymArray, value, pe: int) -> None:
+        """shmem_put_nbi: the AM put is already fire-and-forget (payload
+        serialized at send time, applied by the target's service loop);
+        remote completion is deferred to quiet — exactly the nbi
+        contract, so this IS the nonblocking form."""
+        self.put(sym, value, pe)
+
+    def get_nbi(self, sym: SymArray, pe: int, target: np.ndarray) -> None:
+        """shmem_get_nbi: post the reply recv and return immediately; the
+        caller's `target` buffer is filled at quiet (never earlier — the
+        deferred scatter makes the completion point deterministic).
+        Target validation happens at the ShmemPE dispatch level."""
+        req = self._win.dyn_get_nbi(pe, self._disp(sym), sym.nbytes)
+        self._pending_gets.append((req, target, sym.dtype))
+
     # -- distributed locks: home PE 0 arbitrates per-offset ---------------
 
     def set_lock(self, sym: SymArray) -> None:
@@ -255,8 +282,27 @@ class _AmBackend:
         self._ep.barrier()
 
     def quiet(self) -> None:
-        """shmem_quiet: flush outstanding AM puts (ack round-trip)."""
-        self._win.flush_all()
+        """shmem_quiet: complete pending nbi gets (wait the replies,
+        scatter into the callers' buffers), then flush outstanding AM
+        puts (ack round-trip).  A failing get must not abandon the rest:
+        every pending op is still driven and the put flush still runs;
+        the first error re-raises after the drain."""
+        pending, self._pending_gets = self._pending_gets, []
+        first_err = None
+        for req, target, dt in pending:
+            try:
+                raw = req.wait(30.0)
+                target.reshape(-1)[...] = raw.view(dt)
+            except Exception as e:  # noqa: BLE001 — drain must continue
+                if first_err is None:
+                    first_err = e
+        try:
+            self._win.flush_all()
+        except Exception as e:  # noqa: BLE001
+            if first_err is None:
+                first_err = e
+        if first_err is not None:
+            raise first_err
 
     def close(self) -> None:
         """Collective teardown: free the dynamic window."""
@@ -368,6 +414,41 @@ class ShmemPE:
             )
         target.reshape(-1)[: n * tst : tst] = got
         return target
+
+    def put_nbi(self, sym: SymArray, value, pe: int) -> None:
+        """shmem_put_nbi (``oshmem/shmem/c/shmem_put_nb.c``): implicit-
+        handle nonblocking put; completion no later than quiet/barrier_all.
+        The source `value` is consumed before return (serialized or
+        stored), so the caller may reuse it immediately."""
+        spc.record("shmem_puts_nbi", 1)
+        self._backend.put_nbi(sym, value, pe)
+
+    def get_nbi(self, sym: SymArray, pe: int, target: np.ndarray) -> None:
+        """shmem_get_nbi (``oshmem/shmem/c/shmem_get_nb.c``): start a
+        fetch of PE `pe`'s instance into `target`; `target` contents are
+        undefined until quiet/barrier_all.  `target` is an OUT parameter
+        and is validated HERE so every backend rejects identically (the
+        AMO-dispatch precedent): it must be a writable C-contiguous
+        ndarray of the symmetric object's dtype and element count —
+        coercion would fill a temporary the caller never sees, and a
+        dtype mismatch would fail far away inside quiet."""
+        spc.record("shmem_gets_nbi", 1)
+        if not isinstance(target, np.ndarray):
+            raise errors.ArgError(
+                "get_nbi target is an out parameter and must be a numpy "
+                f"array, not {type(target).__name__}"
+            )
+        if target.dtype != sym.dtype or target.nbytes != sym.nbytes:
+            raise errors.ArgError(
+                f"get_nbi target ({target.dtype}, {target.nbytes}B) does "
+                f"not match symmetric object ({sym.dtype}, {sym.nbytes}B)"
+            )
+        if not target.flags["C_CONTIGUOUS"] or not target.flags["WRITEABLE"]:
+            raise errors.ArgError(
+                "get_nbi target must be writable and C-contiguous (the "
+                "deferred scatter goes through a flat view)"
+            )
+        self._backend.get_nbi(sym, pe, target)
 
     def fence(self) -> None:
         """shmem_fence: ordering of puts to each PE — both substrates

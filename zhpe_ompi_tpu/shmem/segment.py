@@ -151,6 +151,15 @@ class MmapBackend:
     def iget(self, sym, pe: int, n: int, sst: int) -> np.ndarray:
         return self._view(sym, pe).reshape(-1)[: n * sst : sst].copy()
 
+    def put_nbi(self, sym, value, pe: int) -> None:
+        """shmem_put_nbi: mapped stores are coherent once issued, so the
+        nonblocking form completes immediately (legal — nbi promises
+        completion no later than quiet)."""
+        self.put(sym, value, pe)
+
+    def get_nbi(self, sym, pe: int, target: np.ndarray) -> None:
+        target.reshape(-1)[...] = self._view(sym, pe).reshape(-1)
+
     # -- AMOs ------------------------------------------------------------
 
     def amo(self, sym, kind: str, pe: int, index: int, value=None,
