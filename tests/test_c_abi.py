@@ -47,6 +47,19 @@ def ring_bin(shim, tmp_path_factory):
     return _compile_example(shim, tmp_path_factory, "ring_c.c")
 
 
+
+def _compile_c(shim, src, binpath):
+    """Single link recipe for ad-hoc C sources (the _compile_example
+    analog for tmp_path-generated programs)."""
+    libdir = os.path.dirname(shim)
+    libname = os.path.basename(shim)[3:].rsplit(".so", 1)[0]
+    subprocess.run(
+        ["gcc", str(src), "-o", str(binpath), "-I",
+         native.mpi_header_dir(), "-L", libdir, f"-l{libname}",
+         f"-Wl,-rpath,{libdir}"],
+        check=True, capture_output=True, text=True,
+    )
+
 def _free_port():
     s = socket.socket()
     s.bind(("127.0.0.1", 0))
@@ -118,14 +131,7 @@ int main(int argc, char **argv) {
 }
 ''')
         binpath = tmp_path / "interop"
-        libdir = os.path.dirname(shim)
-        libname = os.path.basename(shim)[3:].rsplit(".so", 1)[0]
-        subprocess.run(
-            ["gcc", str(src), "-o", str(binpath), "-I",
-             native.mpi_header_dir(), "-L", libdir, f"-l{libname}",
-             f"-Wl,-rpath,{libdir}"],
-            check=True, capture_output=True, text=True,
-        )
+        _compile_c(shim, src, binpath)
 
         port = _free_port()
         n = 3  # ranks 0,1 = python; rank 2 = C
@@ -253,14 +259,7 @@ int main(int argc, char **argv) {
 }
 ''')
         binpath = tmp_path / "pending"
-        libdir = os.path.dirname(shim)
-        libname = os.path.basename(shim)[3:].rsplit(".so", 1)[0]
-        subprocess.run(
-            ["gcc", str(src), "-o", str(binpath), "-I",
-             native.mpi_header_dir(), "-L", libdir, f"-l{libname}",
-             f"-Wl,-rpath,{libdir}"],
-            check=True, capture_output=True, text=True,
-        )
+        _compile_c(shim, src, binpath)
         port = _free_port()
         procs = [
             subprocess.Popen([str(binpath)], env=_env(r, 2, port),
@@ -371,14 +370,7 @@ int main(int argc, char **argv) {
 }
 ''')
         binpath = tmp_path / "groups"
-        libdir = os.path.dirname(shim)
-        libname = os.path.basename(shim)[3:].rsplit(".so", 1)[0]
-        subprocess.run(
-            ["gcc", str(src), "-o", str(binpath), "-I",
-             native.mpi_header_dir(), "-L", libdir, f"-l{libname}",
-             f"-Wl,-rpath,{libdir}"],
-            check=True, capture_output=True, text=True,
-        )
+        _compile_c(shim, src, binpath)
         port = _free_port()
         procs = [
             subprocess.Popen([str(binpath)], env=_env(r, 4, port),
@@ -390,3 +382,320 @@ int main(int argc, char **argv) {
             out, err = p.communicate(timeout=60)
             assert p.returncode == 0, f"rank {r} rc={p.returncode}: {err}"
             assert f"groups rank {r}/4 OK" in out
+
+
+class TestRendezvousLargeMessages:
+    """VERDICT round-4 Missing #2 / Next #2: any-size delivery to and
+    from C ranks.  The shim now speaks the RTS/CTS rendezvous leg
+    (pml_ob1_sendreq.c:768's guarantee): ≥4 MB payloads flow Python→C,
+    C→Python, and C→C, over dedicated bulk connections."""
+
+    NDOUBLES = 1 << 19  # 4 MiB of float64 — 4x the 1 MB eager limit
+
+    def test_python_to_c_and_back_4mb(self, shim, tmp_path):
+        src = tmp_path / "bigmsg.c"
+        src.write_text(r'''
+#include <stdio.h>
+#include <stdlib.h>
+#include "zompi_mpi.h"
+#define N (1 << 19)
+int main(int argc, char **argv) {
+  int rank, size, i, n;
+  if (MPI_Init(&argc, &argv) != MPI_SUCCESS) return 2;
+  MPI_Comm_rank(MPI_COMM_WORLD, &rank);
+  MPI_Comm_size(MPI_COMM_WORLD, &size);
+  double *buf = malloc(N * sizeof(double));
+  MPI_Status st;
+  /* 4 MB from python rank 0: arrives via RTS/CTS (the shim answers) */
+  MPI_Recv(buf, N, MPI_DOUBLE, 0, 7, MPI_COMM_WORLD, &st);
+  MPI_Get_count(&st, MPI_DOUBLE, &n);
+  if (n != N) { fprintf(stderr, "short recv %d\n", n); return 3; }
+  for (i = 0; i < N; i++) {
+    if (buf[i] != (double)(i % 1000)) { fprintf(stderr, "bad data at %d\n", i); return 4; }
+    buf[i] += 1.0;
+  }
+  /* 4 MB back: the shim's sender-side rendezvous */
+  MPI_Send(buf, N, MPI_DOUBLE, 0, 8, MPI_COMM_WORLD);
+  MPI_Barrier(MPI_COMM_WORLD);
+  printf("bigmsg rank %d/%d OK\n", rank, size);
+  free(buf);
+  MPI_Finalize();
+  return 0;
+}
+''')
+        binpath = tmp_path / "bigmsg"
+        _compile_c(shim, src, binpath)
+
+        port = _free_port()
+        n = 2  # rank 0 = python, rank 1 = C
+        results = {}
+        excs = []
+        payload = np.arange(self.NDOUBLES, dtype=np.float64) % 1000
+
+        def py_rank():
+            try:
+                proc = TcpProc(0, n, coordinator=("127.0.0.1", port))
+                try:
+                    proc.send(payload, dest=1, tag=7)
+                    results["reply"] = proc.recv(source=1, tag=8)
+                    proc.barrier()
+                finally:
+                    proc.close()
+            except BaseException as e:  # noqa: BLE001
+                excs.append(e)
+
+        t = threading.Thread(target=py_rank)
+        t.start()
+        cproc = subprocess.Popen(
+            [str(binpath)], env=_env(1, n, port),
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        )
+        out, err = cproc.communicate(timeout=120)
+        t.join(60)
+        assert not t.is_alive(), "python rank hung"
+        if excs:
+            raise excs[0]
+        assert cproc.returncode == 0, f"C rank failed: {err}\n{out}"
+        assert "bigmsg rank 1/2 OK" in out
+        got = np.asarray(results["reply"])
+        assert got.shape == (self.NDOUBLES,)
+        np.testing.assert_array_equal(got, payload + 1.0)
+
+    def test_c_to_c_4mb_exchange(self, shim, tmp_path):
+        """Both C legs at once: every rank rendezvous-sends 4 MB to its
+        right neighbor while answering its left neighbor's RTS."""
+        src = tmp_path / "bigring.c"
+        src.write_text(r'''
+#include <stdio.h>
+#include <stdlib.h>
+#include "zompi_mpi.h"
+#define N (1 << 19)
+int main(int argc, char **argv) {
+  int rank, size, i, n;
+  if (MPI_Init(&argc, &argv) != MPI_SUCCESS) return 2;
+  MPI_Comm_rank(MPI_COMM_WORLD, &rank);
+  MPI_Comm_size(MPI_COMM_WORLD, &size);
+  double *snd = malloc(N * sizeof(double));
+  double *rcv = malloc(N * sizeof(double));
+  for (i = 0; i < N; i++) snd[i] = rank * 1000.0 + (i % 97);
+  MPI_Status st;
+  MPI_Sendrecv(snd, N, MPI_DOUBLE, (rank + 1) % size, 5,
+               rcv, N, MPI_DOUBLE, (rank + size - 1) % size, 5,
+               MPI_COMM_WORLD, &st);
+  MPI_Get_count(&st, MPI_DOUBLE, &n);
+  if (n != N) { fprintf(stderr, "short recv %d\n", n); return 3; }
+  int left = (rank + size - 1) % size;
+  for (i = 0; i < N; i++)
+    if (rcv[i] != left * 1000.0 + (i % 97)) { fprintf(stderr, "bad at %d\n", i); return 4; }
+  MPI_Barrier(MPI_COMM_WORLD);
+  printf("bigring rank %d/%d OK\n", rank, size);
+  free(snd); free(rcv);
+  MPI_Finalize();
+  return 0;
+}
+''')
+        binpath = tmp_path / "bigring"
+        _compile_c(shim, src, binpath)
+        port = _free_port()
+        n = 3
+        procs = [
+            subprocess.Popen([str(binpath)], env=_env(r, n, port),
+                             stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                             text=True)
+            for r in range(n)
+        ]
+        for r, p in enumerate(procs):
+            out, err = p.communicate(timeout=120)
+            assert p.returncode == 0, f"rank {r} failed: {err}\n{out}"
+            assert f"bigring rank {r}/{n} OK" in out
+
+    def test_non_overtaking_rndv_then_eager_same_tag(self, shim, tmp_path):
+        """MPI non-overtaking across the protocol switch: a 4 MB
+        rendezvous send followed by a small eager send on the SAME
+        (src, tag) must be received in that order — the placeholder
+        holds the announced message's place in the matching stream even
+        though its bulk data arrives later on a slower connection."""
+        src = tmp_path / "order.c"
+        src.write_text(r'''
+#include <stdio.h>
+#include <stdlib.h>
+#include "zompi_mpi.h"
+#define N (1 << 19)
+int main(int argc, char **argv) {
+  int rank, size, n1, n2;
+  if (MPI_Init(&argc, &argv) != MPI_SUCCESS) return 2;
+  MPI_Comm_rank(MPI_COMM_WORLD, &rank);
+  MPI_Comm_size(MPI_COMM_WORLD, &size);
+  double *big = malloc(N * sizeof(double));
+  double small[2];
+  MPI_Status s1, s2;
+  /* post BOTH receives before any data: first must take the big one */
+  MPI_Request r1, r2;
+  MPI_Irecv(big, N, MPI_DOUBLE, 0, 5, MPI_COMM_WORLD, &r1);
+  MPI_Irecv(small, 2, MPI_DOUBLE, 0, 5, MPI_COMM_WORLD, &r2);
+  MPI_Barrier(MPI_COMM_WORLD);  /* release the python sender */
+  MPI_Wait(&r1, &s1);
+  MPI_Wait(&r2, &s2);
+  MPI_Get_count(&s1, MPI_DOUBLE, &n1);
+  MPI_Get_count(&s2, MPI_DOUBLE, &n2);
+  if (n1 != N || n2 != 2) { fprintf(stderr, "order broke: n1=%d n2=%d\n", n1, n2); return 3; }
+  if (big[7] != 7.0 || small[0] != -1.0) { fprintf(stderr, "payload swapped\n"); return 4; }
+  /* unposted path: big + small arrive with NO recv posted; recv in order */
+  MPI_Barrier(MPI_COMM_WORLD);
+  MPI_Barrier(MPI_COMM_WORLD);  /* python sent both between barriers */
+  MPI_Recv(big, N, MPI_DOUBLE, 0, 6, MPI_COMM_WORLD, &s1);
+  MPI_Recv(small, 2, MPI_DOUBLE, 0, 6, MPI_COMM_WORLD, &s2);
+  MPI_Get_count(&s1, MPI_DOUBLE, &n1);
+  MPI_Get_count(&s2, MPI_DOUBLE, &n2);
+  if (n1 != N || n2 != 2) { fprintf(stderr, "unexpected-queue order broke: n1=%d n2=%d\n", n1, n2); return 5; }
+  if (big[9] != 9.0 || small[0] != -2.0) { fprintf(stderr, "payload swapped 2\n"); return 6; }
+  printf("order rank %d/%d OK\n", rank, size);
+  free(big);
+  MPI_Finalize();
+  return 0;
+}
+''')
+        binpath = tmp_path / "order"
+        _compile_c(shim, src, binpath)
+        port = _free_port()
+        n = 2
+        excs = []
+        big = np.arange(self.NDOUBLES, dtype=np.float64)
+
+        def py_rank():
+            try:
+                proc = TcpProc(0, n, coordinator=("127.0.0.1", port))
+                try:
+                    proc.barrier()  # C posted both receives
+                    proc.send(big, dest=1, tag=5)                  # rndv
+                    proc.send(np.asarray([-1.0, -1.0]), dest=1, tag=5)  # eager
+                    proc.barrier()
+                    proc.send(big, dest=1, tag=6)                  # rndv
+                    proc.send(np.asarray([-2.0, -2.0]), dest=1, tag=6)  # eager
+                    proc.barrier()
+                finally:
+                    proc.close()
+            except BaseException as e:  # noqa: BLE001
+                excs.append(e)
+
+        t = threading.Thread(target=py_rank)
+        t.start()
+        cproc = subprocess.Popen(
+            [str(binpath)], env=_env(1, n, port),
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        )
+        out, err = cproc.communicate(timeout=120)
+        t.join(60)
+        assert not t.is_alive(), "python rank hung"
+        if excs:
+            raise excs[0]
+        assert cproc.returncode == 0, f"C rank failed: {err}\n{out}"
+        assert "order rank 1/2 OK" in out
+
+    def test_crossed_large_isends_no_deadlock(self, shim, tmp_path):
+        """The MPI-guaranteed idiom that inline rendezvous would
+        deadlock: both ranks Isend 4 MB to each other FIRST, then post
+        receives, then Waitall.  The background rendezvous thread waits
+        for the peer's claim while the main thread posts the receive
+        that produces it."""
+        src = tmp_path / "crossed.c"
+        src.write_text(r'''
+#include <stdio.h>
+#include <stdlib.h>
+#include "zompi_mpi.h"
+#define N (1 << 19)
+int main(int argc, char **argv) {
+  int rank, size, i, n;
+  if (MPI_Init(&argc, &argv) != MPI_SUCCESS) return 2;
+  MPI_Comm_rank(MPI_COMM_WORLD, &rank);
+  MPI_Comm_size(MPI_COMM_WORLD, &size);
+  int peer = 1 - rank;
+  double *snd = malloc(N * sizeof(double));
+  double *rcv = malloc(N * sizeof(double));
+  for (i = 0; i < N; i++) snd[i] = rank + i * 0.001;
+  MPI_Request reqs[2];
+  MPI_Status sts[2];
+  MPI_Isend(snd, N, MPI_DOUBLE, peer, 3, MPI_COMM_WORLD, &reqs[0]);
+  MPI_Irecv(rcv, N, MPI_DOUBLE, peer, 3, MPI_COMM_WORLD, &reqs[1]);
+  if (MPI_Waitall(2, reqs, sts) != MPI_SUCCESS) return 3;
+  MPI_Get_count(&sts[1], MPI_DOUBLE, &n);
+  if (n != N) { fprintf(stderr, "short %d\n", n); return 4; }
+  for (i = 0; i < N; i++)
+    if (rcv[i] != peer + i * 0.001) { fprintf(stderr, "bad %d\n", i); return 5; }
+  MPI_Barrier(MPI_COMM_WORLD);
+  printf("crossed rank %d/%d OK\n", rank, size);
+  free(snd); free(rcv);
+  MPI_Finalize();
+  return 0;
+}
+''')
+        binpath = tmp_path / "crossed"
+        _compile_c(shim, src, binpath)
+        port = _free_port()
+        procs = [
+            subprocess.Popen([str(binpath)], env=_env(r, 2, port),
+                             stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                             text=True)
+            for r in range(2)
+        ]
+        for r, p in enumerate(procs):
+            out, err = p.communicate(timeout=120)
+            assert p.returncode == 0, f"rank {r} failed: {err}\n{out}"
+            assert f"crossed rank {r}/2 OK" in out
+
+    def test_isend_large_then_eager_same_tag_ordered(self, shim, tmp_path):
+        """The RTS must leave on the CALLING thread: MPI_Isend(4MB) then
+        MPI_Send(small) on one (dest, tag) must match two posted
+        receives in that order even though the bulk push happens on a
+        background thread."""
+        src = tmp_path / "iorder.c"
+        src.write_text(r'''
+#include <stdio.h>
+#include <stdlib.h>
+#include "zompi_mpi.h"
+#define N (1 << 19)
+int main(int argc, char **argv) {
+  int rank, size, i, n1, n2;
+  if (MPI_Init(&argc, &argv) != MPI_SUCCESS) return 2;
+  MPI_Comm_rank(MPI_COMM_WORLD, &rank);
+  MPI_Comm_size(MPI_COMM_WORLD, &size);
+  if (rank == 0) {
+    double *big = malloc(N * sizeof(double));
+    for (i = 0; i < N; i++) big[i] = i * 0.5;
+    double small[2] = {42.0, 43.0};
+    MPI_Request sreq;
+    MPI_Isend(big, N, MPI_DOUBLE, 1, 5, MPI_COMM_WORLD, &sreq);
+    MPI_Send(small, 2, MPI_DOUBLE, 1, 5, MPI_COMM_WORLD);
+    MPI_Wait(&sreq, MPI_STATUS_IGNORE);
+    free(big);
+  } else {
+    double *big = malloc(N * sizeof(double));
+    double small[2];
+    MPI_Status s1, s2;
+    MPI_Recv(big, N, MPI_DOUBLE, 0, 5, MPI_COMM_WORLD, &s1);
+    MPI_Recv(small, 2, MPI_DOUBLE, 0, 5, MPI_COMM_WORLD, &s2);
+    MPI_Get_count(&s1, MPI_DOUBLE, &n1);
+    MPI_Get_count(&s2, MPI_DOUBLE, &n2);
+    if (n1 != N || n2 != 2) { fprintf(stderr, "overtook: n1=%d n2=%d\n", n1, n2); return 3; }
+    if (big[10] != 5.0 || small[0] != 42.0) { fprintf(stderr, "swapped\n"); return 4; }
+    free(big);
+  }
+  MPI_Barrier(MPI_COMM_WORLD);
+  printf("iorder rank %d/%d OK\n", rank, size);
+  MPI_Finalize();
+  return 0;
+}
+''')
+        binpath = tmp_path / "iorder"
+        _compile_c(shim, src, binpath)
+        port = _free_port()
+        procs = [
+            subprocess.Popen([str(binpath)], env=_env(r, 2, port),
+                             stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                             text=True)
+            for r in range(2)
+        ]
+        for r, p in enumerate(procs):
+            out, err = p.communicate(timeout=120)
+            assert p.returncode == 0, f"rank {r} failed: {err}\n{out}"
+            assert f"iorder rank {r}/2 OK" in out

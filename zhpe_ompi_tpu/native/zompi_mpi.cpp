@@ -16,11 +16,19 @@
  *     payload — bit-identical to TcpProc.barrier, so mixed C/Python jobs
  *     synchronize together.
  *
- * Protocol note: this shim implements the EAGER path only.  The Python
- * plane switches to RTS/CTS rendezvous above ZMPI_MCA_tcp_eager_limit
- * (default 1 MB); mixed C/Python jobs must keep C-bound messages under
- * that limit (the C ABI is the control-plane surface, as the reference's
- * heterogeneous deployments keep bulk data on the fabric plane).
+ * Protocol note: the shim speaks BOTH protocol legs.  Below
+ * ZMPI_MCA_tcp_eager_limit (default 1 MB) user sends are eager; above it
+ * they follow the same RTS/CTS rendezvous as the Python plane
+ * (pml_ob1_sendreq.c:768's any-size delivery guarantee): the sender
+ * parks the payload, announces with a small RTS tuple, and pushes the
+ * data frame over a dedicated bulk connection (hello ["d"]) once the
+ * receiver's CTS arrives.  The receiving engine enters a PLACEHOLDER
+ * into the matching stream at RTS position (non-overtaking) and sends
+ * CTS only when a receive CLAIMS it — the Python plane's flow-control
+ * contract (unmatched bulk parks at the SENDER).  Large MPI_Isend runs
+ * its rendezvous on a background thread (crossed-Isend deadlock
+ * freedom); collective-internal exchanges stay eager at any size, their
+ * receives being posted by the same synchronized algorithm on all ranks.
  *
  * Matching: a posted-receive engine (the pml_ob1_recvfrag.c:295-513
  * contract): posted requests are matched in post order against arriving
@@ -62,6 +70,7 @@
 #include <unistd.h>
 
 #include <algorithm>
+#include <array>
 #include <atomic>
 #include <condition_variable>
 #include <cstdint>
@@ -404,6 +413,12 @@ struct Message {
   int64_t src, tag, cid, seq;
   std::string dt;     // ndarray dtype or "" for bytes payload
   std::string data;   // raw payload bytes
+  // rendezvous placeholder: entered into the matching stream at RTS
+  // arrival (so a later eager frame can never overtake the announced
+  // message — MPI non-overtaking); the bulk data fills it in place
+  bool rndv_pending = false;
+  int64_t rndv_id = 0;
+  int64_t rndv_nbytes = 0;  // announced size, for Probe's count
 };
 
 // A receive request registered with the engine.  Blocking receives are
@@ -453,9 +468,23 @@ struct Shim {
   std::thread accept_thread;            // joined FIRST at finalize
   std::vector<std::thread> threads;     // drain threads (joinable)
   std::vector<int> drain_fds;           // every fd a drain thread reads
+  std::vector<int> bulk_fds;            // transient rendezvous-data fds
+  std::atomic<int> bulk_closing{0};     // self-closes still in flight
   std::mutex threads_mu;
-  int64_t seq = 0;
+  // atomic: drain threads stamp CTS frames concurrently with app sends
+  std::atomic<int64_t> seq{0};
   bool initialized = false;
+  // rendezvous: sender-side id counter; receiver-side map of announced
+  // transfers (src, rndv_id) -> original (tag, cid, seq) envelope, and
+  // receives already matched to a placeholder awaiting bulk data
+  // (rndv_wait is guarded by match_mu — it is part of matching state)
+  int64_t eager_limit = 1 << 20;
+  double cts_timeout = -1.0;  // <0: wait forever (blocking-send law)
+  std::atomic<int> inflight_isends{0};
+  std::atomic<int64_t> next_rndv{1};
+  std::map<std::pair<int64_t, int64_t>, std::array<int64_t, 3>> rndv_in;
+  std::mutex rndv_mu;
+  std::map<std::pair<int64_t, int64_t>, Posted> rndv_wait;
 
   ~Shim() {
     // error-path exit without MPI_Finalize: joinable std::threads would
@@ -467,7 +496,11 @@ struct Shim {
   }
 };
 
-Shim g;
+// Intentionally leaked: detached bulk/rendezvous threads may still be
+// unwinding when main() returns, and a static Shim destructor running
+// under them (mutexes included) would be UB at process exit.  Finalize
+// does the real cleanup; the one Shim's memory dies with the process.
+Shim &g = *new Shim;
 
 // fill a posted request from an arriving/unexpected message.
 // match_mu must be held.
@@ -482,13 +515,21 @@ void deliver(const Posted &p, const Message &m) {
       have > p.want_bytes ? MPI_ERR_TRUNCATE : MPI_SUCCESS;
   // _count carries BYTES (dtype-agnostic, so MPI_Probe can fill it
   // without knowing the eventual receive type); Get_count converts
-  r->status._count = (int)copied;
+  r->status._count = (long long)copied;
   r->complete = true;
 }
 
+void send_cts(int64_t sender, int64_t rndv_id);
+
 // Arrival path (drain threads + self-sends): posted list first, in post
 // order; otherwise the unexpected queue (pml_ob1_recvfrag.c:342 shape).
+// A rendezvous placeholder that matches a posted receive PARKS it in
+// rndv_wait instead of completing — the bulk data finishes it later,
+// but the match decision is made NOW, at announce position, so later
+// eager frames cannot overtake (MPI non-overtaking).  The claim is what
+// releases the sender (CTS), sent after match_mu drops.
 void push_message(Message &&m) {
+  int64_t cts_src = -1, cts_rid = -1;
   {
     std::lock_guard<std::mutex> lk(g.match_mu);
     for (auto it = g.posted.begin(); it != g.posted.end(); ++it) {
@@ -496,13 +537,21 @@ void push_message(Message &&m) {
       if (it->src_world != MPI_ANY_SOURCE && it->src_world != m.src)
         continue;
       if (it->tag != MPI_ANY_TAG && it->tag != m.tag) continue;
+      if (m.rndv_pending) {
+        g.rndv_wait[{m.src, m.rndv_id}] = *it;
+        g.posted.erase(it);
+        cts_src = m.src;
+        cts_rid = m.rndv_id;
+        break;
+      }
       deliver(*it, m);
       g.posted.erase(it);
       g.match_cv.notify_all();
       return;
     }
-    g.unexpected.push_back(std::move(m));
+    if (cts_src < 0) g.unexpected.push_back(std::move(m));
   }
+  if (cts_src >= 0) send_cts(cts_src, cts_rid);
   g.match_cv.notify_all();
 }
 
@@ -523,18 +572,36 @@ int post_recv(Req *r, const DtView &v, int64_t cid, int src_world,
     land = r->scratch.data();
   }
   Posted p{r, cid, src_world, tag, land, base_bytes, v.di.item};
-  std::lock_guard<std::mutex> lk(g.match_mu);
-  int handle = g.next_req++;
-  g.reqs[handle] = r;
-  for (auto it = g.unexpected.begin(); it != g.unexpected.end(); ++it) {
-    if (it->cid != cid) continue;
-    if (src_world != MPI_ANY_SOURCE && it->src != src_world) continue;
-    if (tag != MPI_ANY_TAG && it->tag != tag) continue;
-    deliver(p, *it);
-    g.unexpected.erase(it);
-    return handle;
+  int handle;
+  int64_t cts_src = -1, cts_rid = -1;
+  {
+    std::lock_guard<std::mutex> lk(g.match_mu);
+    handle = g.next_req++;
+    g.reqs[handle] = r;
+    bool matched = false;
+    for (auto it = g.unexpected.begin(); it != g.unexpected.end(); ++it) {
+      if (it->cid != cid) continue;
+      if (src_world != MPI_ANY_SOURCE && it->src != src_world) continue;
+      if (tag != MPI_ANY_TAG && it->tag != tag) continue;
+      if (it->rndv_pending) {
+        // the first matching message is an announced (not yet arrived)
+        // rendezvous: claim it — this is the moment the sender may
+        // release the payload (CTS after the lock drops)
+        g.rndv_wait[{it->src, it->rndv_id}] = p;
+        cts_src = it->src;
+        cts_rid = it->rndv_id;
+        g.unexpected.erase(it);
+        matched = true;
+        break;
+      }
+      deliver(p, *it);
+      g.unexpected.erase(it);
+      matched = true;
+      break;
+    }
+    if (!matched) g.posted.push_back(p);
   }
-  g.posted.push_back(p);
+  if (cts_src >= 0) send_cts(cts_src, cts_rid);
   return handle;
 }
 
@@ -554,12 +621,28 @@ void finish_recv(Req *r) {
   }
 }
 
+// remove every engine registration of `r` (posted entry, parked
+// rendezvous claim, handle slot); match_mu must be held.  Keeps a
+// stack-allocated Req from outliving its registration on error paths.
+void deregister_locked(int handle, Req *r) {
+  g.posted.remove_if([r](const Posted &p) { return p.req == r; });
+  for (auto it = g.rndv_wait.begin(); it != g.rndv_wait.end();) {
+    if (it->second.req == r) it = g.rndv_wait.erase(it);
+    else ++it;
+  }
+  g.reqs.erase(handle);
+}
+
 // wait for handle; fills status (world-rank source), frees the slot.
-// On shutdown the request is fully deregistered (posted entry + map
-// slot) before returning, so a stack-allocated Req never outlives its
-// registration.
-int wait_handle_impl(int handle, MPI_Status *status) {
+// On shutdown — or past `timeout_sec` when >= 0 — the request is fully
+// deregistered before returning, so a stack-allocated Req never
+// outlives its registration.
+int wait_handle_impl(int handle, MPI_Status *status,
+                     double timeout_sec = -1.0) {
   Req *r;
+  auto deadline = std::chrono::steady_clock::now() +
+                  std::chrono::duration<double>(
+                      timeout_sec < 0 ? 0.0 : timeout_sec);
   {
     std::unique_lock<std::mutex> lk(g.match_mu);
     auto it = g.reqs.find(handle);
@@ -567,10 +650,11 @@ int wait_handle_impl(int handle, MPI_Status *status) {
     r = it->second;
     while (!r->complete) {
       g.match_cv.wait_for(lk, std::chrono::milliseconds(100));
-      if (g.closing.load()) {
-        g.posted.remove_if([r](const Posted &p) { return p.req == r; });
+      bool expired = timeout_sec >= 0 &&
+                     std::chrono::steady_clock::now() > deadline;
+      if (g.closing.load() || (expired && !r->complete)) {
+        deregister_locked(handle, r);
         bool heap = r->heap;
-        g.reqs.erase(it);
         if (heap) delete r;
         return MPI_ERR_OTHER;
       }
@@ -595,8 +679,124 @@ void drain_loop(int fd);
 
 void start_drain(int fd) {
   std::lock_guard<std::mutex> lk(g.threads_mu);
+  if (g.closing.load()) {
+    // Finalize already swept drain_fds: a drain started now would never
+    // be shut down and would hang the join loop
+    close(fd);
+    return;
+  }
   g.drain_fds.push_back(fd);
   g.threads.emplace_back(drain_loop, fd);
+}
+
+// Transient bulk-data connections (hello ["d"]): one per rendezvous
+// transfer, EOF when the sender closes.  A joinable thread + a
+// Finalize-swept fd per multi-MB message would accumulate (pthread
+// stacks of exited joinable threads are retained until join), so these
+// drains run detached, register in bulk_fds only for the Finalize
+// shutdown sweep, and deregister + close their own fd on exit — the
+// self-close is safe because the closing thread is the only reader.
+void start_bulk_drain(int fd) {
+  {
+    std::lock_guard<std::mutex> lk(g.threads_mu);
+    g.bulk_fds.push_back(fd);
+  }
+  std::thread([fd]() {
+    drain_loop(fd);
+    // deregister (so Finalize's shutdown sweep can't touch a reused fd
+    // number) while flagging the close as in-flight — Finalize waits
+    // for BOTH lists to drain, so a straggler's close-by-number can
+    // never hit a descriptor the application opens after Finalize
+    {
+      std::lock_guard<std::mutex> lk(g.threads_mu);
+      auto &v = g.bulk_fds;
+      v.erase(std::remove(v.begin(), v.end(), fd), v.end());
+      g.bulk_closing.fetch_add(1);
+    }
+    close(fd);
+    g.bulk_closing.fetch_sub(1);
+  }).detach();
+}
+
+int endpoint(int dest);
+
+// rendezvous constants — wire-identical to pt2pt/tcp.py:62-66
+constexpr int64_t RNDV_DATA_CID = 0x7FF9;
+constexpr int64_t RNDV_CTS_CID = 0x7FFA;
+constexpr const char *RTS_MARK = "__zmpi_rndv_rts__";
+
+// CTS leaves only when a receive CLAIMS the announced message — the
+// Python plane's flow-control contract ("an unmatched multi-GB send
+// must park at the SENDER, not in the receiver's unexpected queue",
+// tcp.py send docstring; _resolve_rndv runs from on_match).  Called
+// AFTER match_mu is released by the claiming path.
+void send_cts(int64_t sender, int64_t rndv_id) {
+  if (g.closing.load()) return;
+  int fd = endpoint((int)sender);
+  if (fd < 0) return;  // peer unreachable: sender errors/hangs, job-level
+  std::string cts;
+  put_varint(cts, 5);
+  put_int(cts, g.rank);
+  put_int(cts, rndv_id);
+  put_int(cts, RNDV_CTS_CID);
+  put_int(cts, g.seq++);
+  put_bytes(cts, "", 0);
+  std::lock_guard<std::mutex> lk(g.send_mu);
+  send_frame(fd, cts);
+  // NOTE: a sender dying AFTER this CTS (bulk connect/push failure)
+  // leaves the claimed receive parked — the peer-death-without-fault-
+  // tolerance class, surfaced on the sender as an error; job-level
+  // recovery is the errhandler's business, as on the Python plane.
+}
+
+// Engine-level RTS note (the match half of TcpProc._resolve_rndv):
+// record the announce and enter a PLACEHOLDER into the matching stream
+// at this position, so the announced message keeps its place in the
+// (src, tag, cid) order.  No CTS yet — the payload stays parked at the
+// sender until a receive claims the placeholder.
+void answer_rts(const std::vector<DssVal> &vals) {
+  int64_t sender = vals[4].items[1].i;
+  int64_t rndv_id = vals[4].items[2].i;
+  {
+    std::lock_guard<std::mutex> lk(g.rndv_mu);
+    g.rndv_in[{sender, rndv_id}] = {vals[1].i, vals[2].i, vals[3].i};
+  }
+  Message ph;
+  ph.src = vals[0].i;
+  ph.tag = vals[1].i;
+  ph.cid = vals[2].i;
+  ph.seq = vals[3].i;
+  ph.rndv_pending = true;
+  ph.rndv_id = rndv_id;
+  ph.rndv_nbytes = vals[4].items[3].i;
+  push_message(std::move(ph));
+}
+
+// Bulk-data arrival: complete the receive the placeholder claimed, or
+// fill the placeholder where it sits in the unexpected queue (position
+// preserved either way).
+void land_rndv_data(Message &&m, int64_t rid) {
+  {
+    std::lock_guard<std::mutex> lk(g.match_mu);
+    auto w = g.rndv_wait.find({m.src, rid});
+    if (w != g.rndv_wait.end()) {
+      deliver(w->second, m);
+      g.rndv_wait.erase(w);
+      g.match_cv.notify_all();
+      return;
+    }
+    for (auto &u : g.unexpected) {
+      if (u.rndv_pending && u.src == m.src && u.rndv_id == rid) {
+        u.dt = std::move(m.dt);
+        u.data = std::move(m.data);
+        u.rndv_pending = false;
+        g.match_cv.notify_all();
+        return;
+      }
+    }
+  }
+  // placeholder vanished (shutdown race): deliver by normal matching
+  push_message(std::move(m));
 }
 
 void drain_loop(int fd) {
@@ -605,6 +805,11 @@ void drain_loop(int fd) {
     if (!recv_frame(fd, frame)) return;
     std::vector<DssVal> vals;
     if (!parse_all(frame, vals) || vals.size() != 5) continue;
+    if (vals[4].tag == T_TUPLE && vals[4].items.size() == 4 &&
+        vals[4].items[0].tag == T_STR && vals[4].items[0].s == RTS_MARK) {
+      answer_rts(vals);
+      continue;
+    }
     Message m;
     m.src = vals[0].i;
     m.tag = vals[1].i;
@@ -615,6 +820,24 @@ void drain_loop(int fd) {
       m.data = vals[4].data;
     } else if (vals[4].tag == T_BYTES || vals[4].tag == T_STR) {
       m.data = vals[4].s;
+    }
+    if (m.cid == RNDV_DATA_CID) {
+      // bulk data of an announced transfer: re-frame under the envelope
+      // the RTS carried, then land it on the placeholder/claimed recv
+      int64_t rid = m.tag;
+      std::array<int64_t, 3> env;
+      {
+        std::lock_guard<std::mutex> lk(g.rndv_mu);
+        auto it = g.rndv_in.find({m.src, rid});
+        if (it == g.rndv_in.end()) continue;  // unannounced: drop
+        env = it->second;
+        g.rndv_in.erase(it);
+      }
+      m.tag = env[0];
+      m.cid = env[1];
+      m.seq = env[2];
+      land_rndv_data(std::move(m), rid);
+      continue;
     }
     push_message(std::move(m));
   }
@@ -633,6 +856,11 @@ void accept_loop() {
     if (vals[0].tag == T_INT) {
       std::lock_guard<std::mutex> lk(g.conn_mu);
       if (!g.conns.count((int)vals[0].i)) g.conns[(int)vals[0].i] = fd;
+    } else if (vals[0].tag == T_LIST) {
+      // rendezvous bulk connection (hello ["d"]): transient,
+      // self-closing, never registered for sends
+      start_bulk_drain(fd);
+      continue;
     }
     start_drain(fd);
   }
@@ -666,9 +894,110 @@ int endpoint(int dest) {
   return fd;
 }
 
-// wire-send `count` contiguous base elements (world-rank addressing)
+// RTS/CTS rendezvous send (pml_ob1_sendreq.c:768's protocol, the wire
+// shape of TcpProc._send_rndv), split in two so MPI_Isend can put the
+// ANNOUNCE on the wire from the calling thread — the RTS's position on
+// the control socket is what fixes the message's matching order
+// (non-overtaking), so it must precede any later frame to the peer —
+// while the CTS wait + bulk push run wherever convenient.
+
+// Announce: post the CTS receive, then send the RTS inline.  On success
+// fills rid/handle; the heap CTS Req is owned by the handle machinery.
+int rndv_announce(size_t count, const DtInfo &di, int dest, int64_t tag,
+                  int64_t cid, int64_t &rid_out, int &handle_out) {
+  int64_t rid = g.next_rndv.fetch_add(1);
+  static char dummy;  // zero-byte CTS landing, shared is fine
+  Req *r = new Req;
+  r->is_recv = true;
+  r->heap = true;
+  r->user_buf = &dummy;
+  r->count = 0;
+  DtView v;  // byte view; CTS payload is empty
+  v.di = {"|u1", 1};
+  int handle = post_recv(r, v, RNDV_CTS_CID, dest, rid);
+  // every early return must deregister: a stale posted entry would let
+  // a late CTS write through a freed request
+  auto abort_cts = [&]() {
+    std::lock_guard<std::mutex> lk(g.match_mu);
+    deregister_locked(handle, r);
+    delete r;
+    return MPI_ERR_OTHER;
+  };
+  int fd = endpoint(dest);
+  if (fd < 0) return abort_cts();
+  std::string rts;
+  put_varint(rts, 5);
+  put_int(rts, g.rank);
+  put_int(rts, tag);
+  put_int(rts, cid);
+  put_int(rts, g.seq++);
+  rts.push_back((char)T_TUPLE);
+  put_varint(rts, 4);
+  put_str(rts, RTS_MARK);
+  put_int(rts, g.rank);
+  put_int(rts, rid);
+  put_int(rts, (int64_t)(count * di.item));
+  {
+    std::lock_guard<std::mutex> lk(g.send_mu);
+    if (!send_frame(fd, rts)) return abort_cts();
+  }
+  rid_out = rid;
+  handle_out = handle;
+  return MPI_SUCCESS;
+}
+
+// Complete: wait for the receiver's CTS (it arrives when a receive
+// MATCHES the announce, so a blocking send legally waits as long as the
+// receiver computes — infinite by default, MPI blocking-send law;
+// ZMPI_MCA_rndv_cts_timeout bounds it for jobs preferring typed errors
+// over peer-death hangs), then push the data frame over a dedicated
+// bulk connection so the control socket never carries a multi-MB write.
+int rndv_complete(const void *buf, size_t count, const DtInfo &di,
+                  int dest, int64_t rid, int handle) {
+  MPI_Status st{};
+  int rc = wait_handle_impl(handle, &st, g.cts_timeout);
+  if (rc != MPI_SUCCESS) return rc;
+  int dfd = tcp_connect(g.book[dest].first, g.book[dest].second);
+  if (dfd < 0) return MPI_ERR_OTHER;
+  std::string hello;
+  put_varint(hello, 1);
+  hello.push_back((char)T_LIST);
+  put_varint(hello, 1);
+  put_str(hello, "d");
+  bool ok = send_frame(dfd, hello);
+  if (ok) {
+    std::string payload;
+    put_varint(payload, 5);
+    put_int(payload, g.rank);
+    put_int(payload, rid);
+    put_int(payload, RNDV_DATA_CID);
+    put_int(payload, g.seq++);
+    put_ndarray_1d(payload, di.tag, buf, count, di.item);
+    ok = send_frame(dfd, payload);
+  }
+  close(dfd);
+  return ok ? MPI_SUCCESS : MPI_ERR_OTHER;
+}
+
+int wire_send_rndv(const void *buf, size_t count, const DtInfo &di,
+                   int dest, int64_t tag, int64_t cid) {
+  int64_t rid;
+  int handle;
+  int rc = rndv_announce(count, di, dest, tag, cid, rid, handle);
+  if (rc != MPI_SUCCESS) return rc;
+  return rndv_complete(buf, count, di, dest, rid, handle);
+}
+
+// wire-send `count` contiguous base elements (world-rank addressing).
+// allow_rndv selects the protocol split: USER point-to-point sends
+// rendezvous above the eager limit (flow control for unmatched sends);
+// collective-internal sends stay eager at any size — their receives are
+// posted by the same synchronized algorithm on every rank, so the
+// unexpected-queue exposure is one round's worth by construction, and
+// eager keeps the ring/pairwise exchanges deadlock-free (the same
+// reasoning as the allgather ring's buffered-eager note below).
 int wire_send(const void *buf, size_t count, const DtInfo &di, int dest,
-              int64_t tag, int64_t cid) {
+              int64_t tag, int64_t cid, bool allow_rndv = false) {
   if (dest == g.rank) {
     Message m;
     m.src = g.rank; m.tag = tag; m.cid = cid; m.seq = g.seq++;
@@ -677,6 +1006,8 @@ int wire_send(const void *buf, size_t count, const DtInfo &di, int dest,
     push_message(std::move(m));
     return MPI_SUCCESS;
   }
+  if (allow_rndv && (int64_t)(count * di.item) > g.eager_limit)
+    return wire_send_rndv(buf, count, di, dest, tag, cid);
   int fd = endpoint(dest);
   if (fd < 0) return MPI_ERR_OTHER;
   std::string payload;
@@ -705,16 +1036,16 @@ int raw_recv(void *buf, int count, MPI_Datatype dt, int source, int64_t tag,
 }
 
 int raw_send(const void *buf, int count, MPI_Datatype dt, int dest,
-             int64_t tag, int64_t cid) {
+             int64_t tag, int64_t cid, bool allow_rndv = false) {
   DtView v;
   if (!resolve_dtype(dt, v)) return MPI_ERR_TYPE;
   if (v.contiguous())
     return wire_send(buf, (size_t)count * v.elems_per_item(), v.di, dest,
-                     tag, cid);
+                     tag, cid, allow_rndv);
   std::vector<char> packed;
   pack_dtype(buf, count, v, packed);
   return wire_send(packed.data(), packed.size() / v.di.item, v.di, dest,
-                   tag, cid);
+                   tag, cid, allow_rndv);
 }
 
 // --------------------------------------------------------- communicators
@@ -1358,6 +1689,11 @@ int MPI_Init(int *, char ***) {
   g.size = atoi(s);
   std::string coord_host = ch;
   int coord_port = atoi(cp);
+  // same MCA var (and default) as the Python plane's protocol switch
+  const char *el = getenv("ZMPI_MCA_tcp_eager_limit");
+  if (el && el[0]) g.eager_limit = atoll(el);
+  const char *ct = getenv("ZMPI_MCA_rndv_cts_timeout");
+  if (ct && ct[0]) g.cts_timeout = atof(ct);
 
   // listener (btl_tcp's per-proc endpoint)
   g.listen_fd = socket(AF_INET, SOCK_STREAM, 0);
@@ -1483,15 +1819,56 @@ int MPI_Finalize(void) {
   // started, so the drain_fds sweep below cannot miss a late-accepted
   // connection and the threads vector can no longer be mutated under us
   if (g.accept_thread.joinable()) g.accept_thread.join();
+  // correct programs have Wait-ed every send request, so inflight
+  // rendezvous pushers are in their last few instructions; give them a
+  // moment rather than racing their g accesses
+  for (int i = 0; i < 500 && g.inflight_isends.load() > 0; i++)
+    std::this_thread::sleep_for(std::chrono::milliseconds(10));
   {
     std::lock_guard<std::mutex> lk(g.threads_mu);
     for (int fd : g.drain_fds) shutdown(fd, SHUT_RDWR);
+    // transient bulk drains self-close; only unblock them here
+    for (int fd : g.bulk_fds) shutdown(fd, SHUT_RDWR);
   }
-  for (auto &t : g.threads) t.join();
+  // index-snapshot join: a drain processing a late RTS can still create
+  // a connection (endpoint -> start_drain appends under threads_mu), so
+  // the vector may grow while we join — never iterate it unlocked
+  for (size_t i = 0;;) {
+    std::thread t;
+    {
+      std::lock_guard<std::mutex> lk(g.threads_mu);
+      if (i >= g.threads.size()) break;
+      t = std::move(g.threads[i]);
+      ++i;
+    }
+    if (t.joinable()) t.join();
+  }
   close(g.listen_fd);
-  for (int fd : g.drain_fds) close(fd);
-  g.drain_fds.clear();
-  g.threads.clear();
+  // late-started drains were shut down by the closing guard in
+  // start_drain; sweep whatever registered before the guard flipped
+  {
+    std::lock_guard<std::mutex> lk(g.threads_mu);
+    for (int fd : g.drain_fds) close(fd);
+    g.drain_fds.clear();
+    g.threads.clear();
+  }
+  // wait for self-closing bulk drains: both the registered list and the
+  // in-flight closes must drain before the application may reuse fd
+  // numbers.  Shutdown already unblocked every reader, so this is
+  // scheduler latency, not network time; warn if it somehow exceeds 10s.
+  bool drained = false;
+  for (int i = 0; i < 1000 && !drained; i++) {
+    {
+      std::lock_guard<std::mutex> lk(g.threads_mu);
+      drained = g.bulk_fds.empty() && g.bulk_closing.load() == 0;
+    }
+    if (!drained)
+      std::this_thread::sleep_for(std::chrono::milliseconds(10));
+  }
+  if (!drained)
+    fprintf(stderr,
+            "zompi: warning: bulk-data drains still closing at "
+            "MPI_Finalize exit\n");
   {
     std::lock_guard<std::mutex> lk(g.conn_mu);
     g.conns.clear();
@@ -1787,7 +2164,8 @@ int MPI_Send(const void *buf, int count, MPI_Datatype dt, int dest,
   if (dest == MPI_PROC_NULL) return MPI_SUCCESS;
   if (tag < 0) return MPI_ERR_ARG;
   if (dest < 0 || dest >= (int)c->group.size()) return MPI_ERR_ARG;
-  return raw_send(buf, count, dt, world_of(*c, dest), tag, c->cid_pt2pt);
+  return raw_send(buf, count, dt, world_of(*c, dest), tag, c->cid_pt2pt,
+                  /*allow_rndv=*/true);
 }
 
 static int translate_status(CommObj *c, MPI_Status *status) {
@@ -1834,22 +2212,86 @@ int MPI_Get_count(const MPI_Status *status, MPI_Datatype dt, int *count) {
     *count = MPI_UNDEFINED;
     return MPI_SUCCESS;
   }
-  *count = (int)(status->_count / per_bytes);
+  long long n = status->_count / per_bytes;
+  // element counts above INT_MAX are unrepresentable in the int API
+  *count = n > 2147483647LL ? MPI_UNDEFINED : (int)n;
   return MPI_SUCCESS;
 }
 
 int MPI_Isend(const void *buf, int count, MPI_Datatype dt, int dest,
               int tag, MPI_Comm comm, MPI_Request *request) {
-  // Eager protocol: the payload is on the wire (or in the peer's
+  // Below the eager limit the payload is on the wire (or in the peer's
   // unexpected queue) before return, so the request is born complete —
-  // pml_ob1's start_copy fast path (pml_ob1_sendreq.h:399-405).
+  // pml_ob1's start_copy fast path (pml_ob1_sendreq.h:399-405).  Above
+  // it the rendezvous runs on a background thread (CTS arrives only
+  // when the receiver matches, so completing it inline would deadlock
+  // the crossed-Isend idiom MPI guarantees): the request completes when
+  // the bulk push lands, exactly pml_ob1's progressed send request.
   CommObj *c = lookup_comm(comm);
   if (!c) return MPI_ERR_COMM;
   int rc = MPI_SUCCESS;
   if (dest != MPI_PROC_NULL) {
     if (tag < 0) return MPI_ERR_ARG;
     if (dest < 0 || dest >= (int)c->group.size()) return MPI_ERR_ARG;
-    rc = raw_send(buf, count, dt, world_of(*c, dest), tag, c->cid_pt2pt);
+    DtView v;
+    if (!resolve_dtype(dt, v)) return MPI_ERR_TYPE;
+    int64_t nbytes =
+        (int64_t)count * v.elems_per_item() * (int64_t)v.di.item;
+    if (nbytes > g.eager_limit) {
+      // resolve + (if derived) pack NOW: MPI allows MPI_Type_free after
+      // Isend; the contiguous user buffer itself must stay valid until
+      // Wait, so the thread may read it in place.
+      auto *packed = new std::vector<char>;
+      const void *src = buf;
+      size_t n = (size_t)count * v.elems_per_item();
+      if (!v.contiguous()) {
+        pack_dtype(buf, count, v, *packed);
+        src = packed->data();
+        n = packed->size() / v.di.item;
+      }
+      Req *r = new Req;
+      r->heap = true;
+      r->comm = comm;
+      int handle;
+      {
+        std::lock_guard<std::mutex> lk(g.match_mu);
+        handle = g.next_req++;
+        g.reqs[handle] = r;
+      }
+      int dest_world = world_of(*c, dest);
+      int64_t cid = c->cid_pt2pt;
+      DtInfo di = v.di;
+      // the ANNOUNCE goes out on THIS thread before Isend returns: its
+      // position on the control socket is the message's matching order,
+      // so a later send to the same (dest, tag) cannot overtake it
+      int64_t rid;
+      int cts_handle;
+      rc = rndv_announce(n, di, dest_world, tag, cid, rid, cts_handle);
+      if (rc != MPI_SUCCESS) {
+        delete packed;
+        std::lock_guard<std::mutex> lk(g.match_mu);
+        g.reqs.erase(handle);
+        delete r;
+        return rc;
+      }
+      g.inflight_isends.fetch_add(1);
+      std::thread([=]() {
+        int src_rc = rndv_complete(src, n, di, dest_world, rid, cts_handle);
+        {
+          std::lock_guard<std::mutex> lk(g.match_mu);
+          r->status.MPI_ERROR = src_rc;
+          r->status._count = (long long)(n * di.item);
+          r->complete = true;
+        }
+        g.match_cv.notify_all();
+        delete packed;
+        g.inflight_isends.fetch_sub(1);
+      }).detach();
+      *request = handle;
+      return MPI_SUCCESS;
+    }
+    rc = raw_send(buf, count, dt, world_of(*c, dest), tag, c->cid_pt2pt,
+                  /*allow_rndv=*/true);
     if (rc) return rc;
   }
   Req *r = new Req;
@@ -2148,7 +2590,10 @@ int probe_impl(int source, int tag, CommObj *c, int *flag,
         status->MPI_SOURCE = (int)m.src;
         status->MPI_TAG = (int)m.tag;
         status->MPI_ERROR = MPI_SUCCESS;
-        status->_count = (int)m.data.size();  // bytes (Get_count converts)
+        // bytes (Get_count converts); an announced-but-not-landed
+        // rendezvous reports the size its RTS declared
+        status->_count = m.rndv_pending ? (long long)m.rndv_nbytes
+                                         : (long long)m.data.size();
       }
       if (flag) *flag = 1;
       return MPI_SUCCESS;
@@ -2368,7 +2813,7 @@ void file_status(MPI_Status *status, size_t nbytes) {
     status->MPI_SOURCE = MPI_ANY_SOURCE;
     status->MPI_TAG = MPI_ANY_TAG;
     status->MPI_ERROR = MPI_SUCCESS;
-    status->_count = (int)nbytes;
+    status->_count = (long long)nbytes;
   }
 }
 

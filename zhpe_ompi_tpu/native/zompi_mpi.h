@@ -101,7 +101,8 @@ typedef struct MPI_Status {
   int MPI_SOURCE;
   int MPI_TAG;
   int MPI_ERROR;
-  int _count; /* received BYTES (MPI_Get_count converts) */
+  long long _count; /* received BYTES (MPI_Get_count converts); wide so
+                       any-size rendezvous payloads cannot wrap an int */
 } MPI_Status;
 
 #define MPI_STATUS_IGNORE   ((MPI_Status *)0)

@@ -28,11 +28,21 @@ from typing import Any, Sequence
 import numpy as np
 
 from ..core import errors
+from ..mca import var as mca_var
 from ..pt2pt.universe import LocalUniverse, RankContext
 from ..runtime import spc
 from .memheap import SymmetricHeapAllocator
 
 _DEFAULT_HEAP = 1 << 20  # 1 MiB per PE; SHMEM_SYMMETRIC_SIZE analog
+
+mca_var.register(
+    "shmem_quiet_timeout", 0.0,
+    "Seconds shmem_quiet waits for each pending nonblocking get before "
+    "raising (0 = wait forever, the spec's block-until-complete "
+    "semantics; positive values trade spec compliance for typed errors "
+    "on peer death)",
+    type=float,
+)
 
 
 class SymArray:
@@ -287,11 +297,15 @@ class _AmBackend:
         puts (ack round-trip).  A failing get must not abandon the rest:
         every pending op is still driven and the put flush still runs;
         the first error re-raises after the drain."""
+        # shmem_quiet must block until completion; 0 = wait forever (the
+        # spec's semantics), a positive value bounds the wait for jobs
+        # preferring typed errors over peer-death hangs
+        tmo = float(mca_var.get("shmem_quiet_timeout", 0.0)) or None
         pending, self._pending_gets = self._pending_gets, []
         first_err = None
         for req, target, dt in pending:
             try:
-                raw = req.wait(30.0)
+                raw = req.wait(tmo)
                 target.reshape(-1)[...] = raw.view(dt)
             except Exception as e:  # noqa: BLE001 — drain must continue
                 if first_err is None:
