@@ -19,7 +19,9 @@
  * Protocol note: the shim speaks BOTH protocol legs.  Below
  * ZMPI_MCA_tcp_eager_limit (default 1 MB) user sends are eager; above it
  * they follow the same RTS/CTS rendezvous as the Python plane
- * (pml_ob1_sendreq.c:768's any-size delivery guarantee): the sender
+ * (pml_ob1_sendreq.c:768's delivery guarantee at any size up to the
+ * shared 4-byte frame bound of ~4 GiB, enforced with MPI_ERR_COUNT):
+ * the sender
  * parks the payload, announces with a small RTS tuple, and pushes the
  * data frame over a dedicated bulk connection (hello ["d"]) once the
  * receiver's CTS arrives.  The receiving engine enters a PLACEHOLDER
@@ -250,6 +252,10 @@ bool recv_all(int fd, void *p, size_t n) {
 }
 
 bool send_frame(int fd, const std::string &payload) {
+  // the wire protocol is 4-byte length-framed (matching the Python
+  // plane's struct "<I"); a frame at or past 4 GiB cannot be framed —
+  // fail loudly instead of wrapping the length and shearing the stream
+  if (payload.size() > 0xFFFFFFFFull) return false;
   uint32_t len = (uint32_t)payload.size();
   uint8_t hdr[4] = {(uint8_t)(len), (uint8_t)(len >> 8),
                     (uint8_t)(len >> 16), (uint8_t)(len >> 24)};
@@ -1006,6 +1012,10 @@ int wire_send(const void *buf, size_t count, const DtInfo &di, int dest,
     push_message(std::move(m));
     return MPI_SUCCESS;
   }
+  // 4-byte framing bounds any single message below 4 GiB (the Python
+  // plane shares the limit — struct "<I"); reject with a typed error
+  // rather than let send_frame fail opaquely after the RTS handshake
+  if (count * di.item > 0xFFFF0000ull) return MPI_ERR_COUNT;
   if (allow_rndv && (int64_t)(count * di.item) > g.eager_limit)
     return wire_send_rndv(buf, count, di, dest, tag, cid);
   int fd = endpoint(dest);
@@ -2767,6 +2777,8 @@ int MPI_Error_string(int errorcode, char *string, int *resultlen) {
                            break;
     case MPI_ERR_REQUEST:  s = "MPI_ERR_REQUEST: invalid request"; break;
     case MPI_ERR_ARG:      s = "MPI_ERR_ARG: invalid argument"; break;
+    case MPI_ERR_COUNT:    s = "MPI_ERR_COUNT: invalid count (message "
+                               "exceeds the 4 GiB frame bound)"; break;
     case MPI_ERR_TRUNCATE: s = "MPI_ERR_TRUNCATE: message truncated";
                            break;
     case MPI_ERR_OTHER:    s = "MPI_ERR_OTHER: known error not in list";

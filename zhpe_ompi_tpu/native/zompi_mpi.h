@@ -92,6 +92,7 @@ typedef int MPI_Request;
 #define MPI_ERR_REQUEST  19
 #define MPI_ERR_ARG      13
 #define MPI_ERR_TRUNCATE 15
+#define MPI_ERR_COUNT    2
 #define MPI_ERR_OTHER    16
 
 #define MPI_MAX_PROCESSOR_NAME 256
