@@ -699,3 +699,51 @@ int main(int argc, char **argv) {
             out, err = p.communicate(timeout=120)
             assert p.returncode == 0, f"rank {r} failed: {err}\n{out}"
             assert f"iorder rank {r}/2 OK" in out
+
+
+@pytest.fixture(scope="module")
+def halo_bin(shim, tmp_path_factory):
+    return _compile_example(shim, tmp_path_factory, "halo_c.c")
+
+
+class TestTier3Surface:
+    """VERDICT round-4 Next #3: RMA windows, nonblocking collectives,
+    Cartesian topology, Pack/Unpack — the acceptance is a 2-D halo
+    exchange on a Cart grid via RMA fences with an overlapped
+    Iallreduce, across real processes."""
+
+    @pytest.mark.parametrize("n", [4, 6, 9])
+    def test_halo_example(self, halo_bin, n):
+        port = _free_port()
+        procs = [
+            subprocess.Popen([halo_bin], env=_env(r, n, port),
+                             stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                             text=True)
+            for r in range(n)
+        ]
+        for r, p in enumerate(procs):
+            out, err = p.communicate(timeout=120)
+            assert p.returncode == 0, f"rank {r} failed: {err}\n{out}"
+            assert f"halo_c rank {r}/{n} OK" in out
+
+    def test_halo_via_zmpicc_and_zmpirun(self, tmp_path):
+        """The whole C toolchain loop for the tier-3 surface: zmpicc
+        compiles examples/halo_c.c with no manual flags and zmpirun
+        launches it across 4 ranks."""
+        binary = str(tmp_path / "halo")
+        res = subprocess.run(
+            [sys.executable, "-m", "zhpe_ompi_tpu.tools.zmpicc",
+             os.path.join(REPO, "examples", "halo_c.c"), "-o", binary],
+            capture_output=True, text=True, timeout=180,
+            env={**os.environ, "PYTHONPATH": REPO},
+        )
+        assert res.returncode == 0, res.stderr
+        run = subprocess.run(
+            [sys.executable, "-m", "zhpe_ompi_tpu.tools.mpirun",
+             "-n", "4", binary],
+            capture_output=True, text=True, timeout=180,
+            env={**os.environ, "PYTHONPATH": REPO},
+        )
+        assert run.returncode == 0, run.stderr
+        for r in range(4):
+            assert f"halo_c rank {r}/4 OK" in run.stdout

@@ -79,9 +79,12 @@
 #include <cstdio>
 #include <cstdlib>
 #include <cstring>
+#include <cmath>
 #include <deque>
+#include <functional>
 #include <list>
 #include <map>
+#include <set>
 #include <mutex>
 #include <string>
 #include <thread>
@@ -731,6 +734,12 @@ constexpr int64_t RNDV_DATA_CID = 0x7FF9;
 constexpr int64_t RNDV_CTS_CID = 0x7FFA;
 constexpr const char *RTS_MARK = "__zmpi_rndv_rts__";
 
+// one-sided plane: request frames are tuples on this reserved cid,
+// applied by the drain (the AM-window shape of osc/am.py, C-side);
+// replies are plain messages on the same cid matched by reply tag
+constexpr int64_t WIN_CID = 0x7FF8;
+void handle_win_frame(int64_t src, const DssVal &t);
+
 // CTS leaves only when a receive CLAIMS the announced message — the
 // Python plane's flow-control contract ("an unmatched multi-GB send
 // must park at the SENDER, not in the receiver's unexpected queue",
@@ -814,6 +823,10 @@ void drain_loop(int fd) {
     if (vals[4].tag == T_TUPLE && vals[4].items.size() == 4 &&
         vals[4].items[0].tag == T_STR && vals[4].items[0].s == RTS_MARK) {
       answer_rts(vals);
+      continue;
+    }
+    if (vals[2].i == WIN_CID && vals[4].tag == T_TUPLE) {
+      handle_win_frame(vals[0].i, vals[4]);
       continue;
     }
     Message m;
@@ -1066,6 +1079,9 @@ struct CommObj {
   int64_t cid_pt2pt, cid_coll, cid_bar;
   int64_t coll_seq = 0;
   uint64_t child_seq = 0;
+  uint64_t win_seq = 0;               // per-comm window-id sequence
+  std::vector<int> cart_dims;         // non-empty => Cartesian topology
+  std::vector<int> cart_periods;
 };
 
 std::map<int, CommObj> g_comms;
@@ -1241,6 +1257,117 @@ int reduce_buf(void *acc, const void *in, int n, MPI_Datatype dt,
       return reduce_arith((double *)acc, (const double *)in, n, op);
   }
   return MPI_ERR_TYPE;
+}
+
+// ------------------------------------------------------------- windows
+// Active-target RMA (win_create.c:44 / osc_rdma's fence epoch, reduced
+// to the AM shape the Python plane's osc/am.py uses): the window is the
+// target's local buffer; put/accumulate are fire-and-forget tuples the
+// target's drain applies under the window lock; get/flush are RPCs.
+// Per-origin FIFO on a connection means a flush reply proves every
+// earlier op from that origin has been applied — fence is flush-all
+// plus the communicator barrier.
+
+struct WinObj {
+  char *base = nullptr;
+  int64_t size = 0;  // bytes
+  int disp_unit = 1;
+  CommObj comm;      // snapshot at creation
+  std::mutex mu;     // apply lock (drains from several origins)
+  std::set<int> dirty;  // world ranks with unflushed ops from us
+  std::mutex dirty_mu;
+};
+
+std::map<int64_t, WinObj *> g_wins;      // wire win-id -> obj
+std::map<int, int64_t> g_win_handles;    // local MPI_Win -> wire win-id
+int g_next_win_handle = 0;
+std::mutex g_wins_mu;
+
+std::atomic<int64_t> g_next_reply_tag{1};
+
+// send a 5-frame whose payload is a window tuple (tag 0: requests are
+// dispatched by cid+tuple, never matched)
+int win_send_tuple(int dest_world, const std::string &tuple_payload) {
+  if (dest_world == g.rank) return MPI_ERR_OTHER;  // caller handles self
+  int fd = endpoint(dest_world);
+  if (fd < 0) return MPI_ERR_OTHER;
+  std::string f;
+  put_varint(f, 5);
+  put_int(f, g.rank);
+  put_int(f, 0);
+  put_int(f, WIN_CID);
+  put_int(f, g.seq++);
+  f += tuple_payload;
+  std::lock_guard<std::mutex> lk(g.send_mu);
+  return send_frame(fd, f) ? MPI_SUCCESS : MPI_ERR_OTHER;
+}
+
+void win_reply(int64_t origin, int64_t reply_tag, const void *data,
+               size_t nbytes) {
+  if (origin == g.rank) return;
+  int fd = endpoint((int)origin);
+  if (fd < 0) return;
+  std::string f;
+  put_varint(f, 5);
+  put_int(f, g.rank);
+  put_int(f, reply_tag);
+  put_int(f, WIN_CID);
+  put_int(f, g.seq++);
+  put_ndarray_1d(f, "|u1", data, nbytes, 1);
+  std::lock_guard<std::mutex> lk(g.send_mu);
+  send_frame(fd, f);
+}
+
+// Drain-side dispatch of ("wput"|"wacc"|"wget"|"wflush", win_id, ...)
+void handle_win_frame(int64_t src, const DssVal &t) {
+  if (t.items.empty() || t.items[0].tag != T_STR) return;
+  const std::string &kind = t.items[0].s;
+  if (t.items.size() < 2) return;
+  WinObj *w = nullptr;
+  {
+    std::lock_guard<std::mutex> lk(g_wins_mu);
+    auto it = g_wins.find(t.items[1].i);
+    if (it == g_wins.end()) return;  // freed or never created: drop
+    w = it->second;
+  }
+  if (kind == "wput" && t.items.size() == 4) {
+    int64_t disp = t.items[2].i;
+    const std::string &data = t.items[3].data;
+    if (disp < 0 || disp + (int64_t)data.size() > w->size) return;
+    std::lock_guard<std::mutex> lk(w->mu);
+    memcpy(w->base + disp, data.data(), data.size());
+  } else if (kind == "wacc" && t.items.size() == 6) {
+    int64_t disp = t.items[2].i;
+    MPI_Op op = (MPI_Op)t.items[3].i;
+    MPI_Datatype dt = (MPI_Datatype)t.items[4].i;
+    const std::string &data = t.items[5].data;
+    DtInfo di;
+    if (!base_dtinfo(dt, di)) return;
+    int64_t n = (int64_t)(data.size() / di.item);
+    if (disp < 0 || disp + (int64_t)data.size() > w->size) return;
+    std::lock_guard<std::mutex> lk(w->mu);
+    // MPI_Accumulate: target = target op origin (the service loop is
+    // the serialization point, as in osc/am.py's apply_acc)
+    reduce_buf(w->base + disp, data.data(), (int)n, dt, op);
+  } else if (kind == "wget" && t.items.size() == 5) {
+    int64_t disp = t.items[2].i;
+    int64_t nbytes = t.items[3].i;
+    int64_t reply_tag = t.items[4].i;
+    if (disp < 0 || nbytes < 0 || disp + nbytes > w->size) {
+      win_reply(src, reply_tag, "", 0);
+      return;
+    }
+    std::vector<char> out((size_t)nbytes);
+    {
+      std::lock_guard<std::mutex> lk(w->mu);
+      memcpy(out.data(), w->base + disp, (size_t)nbytes);
+    }
+    win_reply(src, reply_tag, out.data(), out.size());
+  } else if (kind == "wflush" && t.items.size() == 3) {
+    // FIFO per connection: by the time the drain reaches this frame,
+    // every earlier op from `src` has been applied
+    win_reply(src, t.items[2].i, "", 0);
+  }
 }
 
 // --------------------------------------------- comm-generic collectives
@@ -3025,6 +3152,552 @@ int MPI_File_sync(MPI_File fh) {
   fsync(f->fd);
   CommObj *c = lookup_comm(f->comm);
   return c ? c_barrier(*c) : MPI_SUCCESS;
+}
+
+// ------------------------------------------------------- pack / unpack
+// The convertor surface (ompi/mpi/c/pack.c:45): positions advance in
+// bytes through a caller-owned packing buffer.
+
+int MPI_Pack_size(int incount, MPI_Datatype dt, MPI_Comm, int *size) {
+  DtView v;
+  if (!resolve_dtype(dt, v)) return MPI_ERR_TYPE;
+  *size = (int)((int64_t)incount * v.elems_per_item() * v.di.item);
+  return MPI_SUCCESS;
+}
+
+int MPI_Pack(const void *inbuf, int incount, MPI_Datatype dt,
+             void *outbuf, int outsize, int *position, MPI_Comm) {
+  DtView v;
+  if (!resolve_dtype(dt, v)) return MPI_ERR_TYPE;
+  if (!position || *position < 0) return MPI_ERR_ARG;
+  size_t nbytes = (size_t)incount * v.elems_per_item() * v.di.item;
+  if ((size_t)*position + nbytes > (size_t)outsize) return MPI_ERR_TRUNCATE;
+  char *dst = (char *)outbuf + *position;
+  if (v.contiguous()) {
+    memcpy(dst, inbuf, nbytes);
+  } else {
+    std::vector<char> packed;
+    pack_dtype(inbuf, incount, v, packed);
+    memcpy(dst, packed.data(), packed.size());
+  }
+  *position += (int)nbytes;
+  return MPI_SUCCESS;
+}
+
+int MPI_Unpack(const void *inbuf, int insize, int *position, void *outbuf,
+               int outcount, MPI_Datatype dt, MPI_Comm) {
+  DtView v;
+  if (!resolve_dtype(dt, v)) return MPI_ERR_TYPE;
+  if (!position || *position < 0) return MPI_ERR_ARG;
+  size_t nbytes = (size_t)outcount * v.elems_per_item() * v.di.item;
+  if ((size_t)*position + nbytes > (size_t)insize) return MPI_ERR_TRUNCATE;
+  const char *src = (const char *)inbuf + *position;
+  if (v.contiguous()) {
+    memcpy(outbuf, src, nbytes);
+  } else {
+    unpack_dtype(outbuf, outcount, v, src, nbytes);
+  }
+  *position += (int)nbytes;
+  return MPI_SUCCESS;
+}
+
+// --------------------------------------------- nonblocking collectives
+// ibcast.c:36 family: the tag sequence is RESERVED at call time (fixing
+// the op's place in the comm's collective order, MPI's same-order law),
+// then the blocking algorithm runs against a comm snapshot on a
+// background thread and retires through the request engine.
+
+namespace {
+
+int icoll_spawn(std::function<int()> body, MPI_Comm comm,
+                MPI_Request *request) {
+  Req *r = new Req;
+  r->heap = true;
+  r->comm = comm;
+  int handle;
+  {
+    std::lock_guard<std::mutex> lk(g.match_mu);
+    handle = g.next_req++;
+    g.reqs[handle] = r;
+  }
+  g.inflight_isends.fetch_add(1);
+  std::thread([body, r]() {
+    int rc = body();
+    {
+      std::lock_guard<std::mutex> lk(g.match_mu);
+      r->status.MPI_ERROR = rc;
+      r->complete = true;
+    }
+    g.match_cv.notify_all();
+    g.inflight_isends.fetch_sub(1);
+  }).detach();
+  *request = handle;
+  return MPI_SUCCESS;
+}
+
+}  // namespace
+
+int MPI_Ibcast(void *buf, int count, MPI_Datatype dt, int root,
+               MPI_Comm comm, MPI_Request *request) {
+  CommObj *c = lookup_comm(comm);
+  if (!c) return MPI_ERR_COMM;
+  if (root < 0 || root >= (int)c->group.size()) return MPI_ERR_ARG;
+  auto snap = std::make_shared<CommObj>(*c);
+  c->coll_seq++;  // reserve this op's tag slot in program order
+  return icoll_spawn(
+      [snap, buf, count, dt, root]() {
+        return c_bcast(*snap, buf, count, dt, root, 0x7E01);
+      },
+      comm, request);
+}
+
+int MPI_Iallreduce(const void *sendbuf, void *recvbuf, int count,
+                   MPI_Datatype dt, MPI_Op op, MPI_Comm comm,
+                   MPI_Request *request) {
+  CommObj *c = lookup_comm(comm);
+  if (!c) return MPI_ERR_COMM;
+  auto snap = std::make_shared<CommObj>(*c);
+  c->coll_seq++;
+  return icoll_spawn(
+      [snap, sendbuf, recvbuf, count, dt, op]() {
+        return c_allreduce(*snap, sendbuf, recvbuf, count, dt, op);
+      },
+      comm, request);
+}
+
+int MPI_Ibarrier(MPI_Comm comm, MPI_Request *request) {
+  // as a 1-int allreduce: the plain dissemination barrier's fixed tag
+  // cannot distinguish overlapping instances, the reserved-seq
+  // allreduce can (libnbc implements ibarrier the same way)
+  CommObj *c = lookup_comm(comm);
+  if (!c) return MPI_ERR_COMM;
+  auto snap = std::make_shared<CommObj>(*c);
+  c->coll_seq++;
+  auto buf = std::make_shared<std::array<int, 2>>();
+  return icoll_spawn(
+      [snap, buf]() {
+        (*buf)[0] = 1;
+        return c_allreduce(*snap, buf->data(), buf->data() + 1, 1,
+                           MPI_INT, MPI_SUM);
+      },
+      comm, request);
+}
+
+// -------------------------------------------------- Cartesian topology
+// cart_create.c:45 family — pure index arithmetic over a derived comm.
+
+int MPI_Dims_create(int nnodes, int ndims, int dims[]) {
+  // balanced factorization honoring pre-set (nonzero) entries
+  int fixed = 1, free_slots = 0;
+  for (int i = 0; i < ndims; i++) {
+    if (dims[i] > 0) fixed *= dims[i];
+    else free_slots++;
+  }
+  if (fixed <= 0 || nnodes % fixed) return MPI_ERR_ARG;
+  int rem = nnodes / fixed;
+  if (free_slots == 0) return rem == 1 ? MPI_SUCCESS : MPI_ERR_ARG;
+  // greedy: largest factor first into the earliest free slot
+  std::vector<int> fill(free_slots, 1);
+  for (int slot = 0; slot < free_slots; slot++) {
+    int want = (int)std::round(
+        std::pow((double)rem, 1.0 / (free_slots - slot)));
+    int best = 1;
+    for (int f = 1; f <= want; f++)
+      if (rem % f == 0) best = f;
+    fill[slot] = slot == free_slots - 1 ? rem : best;
+    rem /= fill[slot];
+  }
+  std::sort(fill.rbegin(), fill.rend());
+  int j = 0;
+  for (int i = 0; i < ndims; i++)
+    if (dims[i] <= 0) dims[i] = fill[j++];
+  return MPI_SUCCESS;
+}
+
+int MPI_Cart_create(MPI_Comm comm, int ndims, const int dims[],
+                    const int periods[], int /*reorder*/,
+                    MPI_Comm *newcomm) {
+  CommObj *c = lookup_comm(comm);
+  if (!c) return MPI_ERR_COMM;
+  if (ndims <= 0) return MPI_ERR_ARG;
+  int64_t total = 1;
+  for (int i = 0; i < ndims; i++) {
+    if (dims[i] <= 0) return MPI_ERR_ARG;
+    total *= dims[i];
+  }
+  if (total > (int64_t)c->group.size()) return MPI_ERR_ARG;
+  // ranks beyond the grid get MPI_COMM_NULL (cart_create.c's contract);
+  // reorder is accepted and ignored (ranks are already arbitrary here)
+  int color = c->local_rank < total ? 0 : MPI_UNDEFINED;
+  int rc = MPI_Comm_split(comm, color, c->local_rank, newcomm);
+  if (rc != MPI_SUCCESS) return rc;
+  if (*newcomm == MPI_COMM_NULL) return MPI_SUCCESS;
+  CommObj *nc = lookup_comm(*newcomm);
+  nc->cart_dims.assign(dims, dims + ndims);
+  nc->cart_periods.assign(ndims, 0);
+  if (periods)
+    for (int i = 0; i < ndims; i++) nc->cart_periods[i] = periods[i] != 0;
+  return MPI_SUCCESS;
+}
+
+int MPI_Cartdim_get(MPI_Comm comm, int *ndims) {
+  CommObj *c = lookup_comm(comm);
+  if (!c) return MPI_ERR_COMM;
+  if (c->cart_dims.empty()) return MPI_ERR_ARG;
+  *ndims = (int)c->cart_dims.size();
+  return MPI_SUCCESS;
+}
+
+int MPI_Cart_get(MPI_Comm comm, int maxdims, int dims[], int periods[],
+                 int coords[]) {
+  CommObj *c = lookup_comm(comm);
+  if (!c) return MPI_ERR_COMM;
+  int nd = (int)c->cart_dims.size();
+  if (nd == 0 || maxdims < nd) return MPI_ERR_ARG;
+  for (int i = 0; i < nd; i++) {
+    dims[i] = c->cart_dims[i];
+    periods[i] = c->cart_periods[i];
+  }
+  return MPI_Cart_coords(comm, c->local_rank, maxdims, coords);
+}
+
+int MPI_Cart_rank(MPI_Comm comm, const int coords[], int *rank) {
+  CommObj *c = lookup_comm(comm);
+  if (!c) return MPI_ERR_COMM;
+  int nd = (int)c->cart_dims.size();
+  if (nd == 0) return MPI_ERR_ARG;
+  int64_t r = 0;
+  for (int i = 0; i < nd; i++) {
+    int64_t coord = coords[i];
+    int dim = c->cart_dims[i];
+    if (coord < 0 || coord >= dim) {
+      if (!c->cart_periods[i]) return MPI_ERR_ARG;  // out of a wall
+      coord = ((coord % dim) + dim) % dim;
+    }
+    r = r * dim + coord;
+  }
+  *rank = (int)r;
+  return MPI_SUCCESS;
+}
+
+int MPI_Cart_coords(MPI_Comm comm, int rank, int maxdims, int coords[]) {
+  CommObj *c = lookup_comm(comm);
+  if (!c) return MPI_ERR_COMM;
+  int nd = (int)c->cart_dims.size();
+  if (nd == 0 || maxdims < nd) return MPI_ERR_ARG;
+  if (rank < 0 || rank >= (int)c->group.size()) return MPI_ERR_ARG;
+  for (int i = nd - 1; i >= 0; i--) {
+    coords[i] = rank % c->cart_dims[i];
+    rank /= c->cart_dims[i];
+  }
+  return MPI_SUCCESS;
+}
+
+int MPI_Cart_shift(MPI_Comm comm, int direction, int disp,
+                   int *rank_source, int *rank_dest) {
+  CommObj *c = lookup_comm(comm);
+  if (!c) return MPI_ERR_COMM;
+  int nd = (int)c->cart_dims.size();
+  if (nd == 0 || direction < 0 || direction >= nd) return MPI_ERR_ARG;
+  std::vector<int> coords(nd);
+  int rc = MPI_Cart_coords(comm, c->local_rank, nd, coords.data());
+  if (rc != MPI_SUCCESS) return rc;
+  auto neighbor = [&](int delta, int *out) {
+    std::vector<int> nb = coords;
+    nb[direction] += delta;
+    int dim = c->cart_dims[direction];
+    if (nb[direction] < 0 || nb[direction] >= dim) {
+      if (!c->cart_periods[direction]) {
+        *out = MPI_PROC_NULL;
+        return;
+      }
+      nb[direction] = ((nb[direction] % dim) + dim) % dim;
+    }
+    MPI_Cart_rank(comm, nb.data(), out);
+  };
+  neighbor(-disp, rank_source);
+  neighbor(disp, rank_dest);
+  return MPI_SUCCESS;
+}
+
+// ------------------------------------------------------ one-sided RMA
+
+int MPI_Win_create(void *base, MPI_Aint size, int disp_unit, MPI_Info,
+                   MPI_Comm comm, MPI_Win *win) {
+  CommObj *c = lookup_comm(comm);
+  if (!c) return MPI_ERR_COMM;
+  if (size < 0 || (size > 0 && !base) || disp_unit <= 0)
+    return MPI_ERR_ARG;
+  // the wire win-id is deterministic per comm (cid x per-comm counter):
+  // every member computes the same id with no agreement round — the
+  // same collapse as the deterministic-cid communicator algebra
+  int64_t wid = (int64_t)((uint64_t)c->cid_pt2pt * 256u + c->win_seq++);
+  WinObj *w = new WinObj;
+  w->base = (char *)base;
+  w->size = (int64_t)size;
+  w->disp_unit = disp_unit;
+  w->comm = *c;
+  int handle;
+  {
+    std::lock_guard<std::mutex> lk(g_wins_mu);
+    g_wins[wid] = w;
+    handle = g_next_win_handle++;
+    g_win_handles[handle] = wid;
+  }
+  // all windows registered before any rank may start an epoch
+  int rc = c_barrier(*c);
+  if (rc != MPI_SUCCESS) return rc;
+  *win = handle;
+  return MPI_SUCCESS;
+}
+
+namespace {
+
+WinObj *lookup_win(MPI_Win win, int64_t *wid_out = nullptr) {
+  std::lock_guard<std::mutex> lk(g_wins_mu);
+  auto h = g_win_handles.find(win);
+  if (h == g_win_handles.end()) return nullptr;
+  auto it = g_wins.find(h->second);
+  if (it == g_wins.end()) return nullptr;
+  if (wid_out) *wid_out = h->second;
+  return it->second;
+}
+
+// origin-side packing of (count, dtype) into contiguous base bytes
+int pack_origin(const void *addr, int count, MPI_Datatype dt,
+                std::vector<char> &out, DtInfo &di) {
+  DtView v;
+  if (!resolve_dtype(dt, v)) return MPI_ERR_TYPE;
+  di = v.di;
+  pack_dtype(addr, count, v, out);
+  return MPI_SUCCESS;
+}
+
+}  // namespace
+
+int MPI_Put(const void *origin_addr, int origin_count,
+            MPI_Datatype origin_datatype, int target_rank,
+            MPI_Aint target_disp, int target_count,
+            MPI_Datatype target_datatype, MPI_Win win) {
+  int64_t wid;
+  WinObj *w = lookup_win(win, &wid);
+  if (!w) return MPI_ERR_WIN;
+  CommObj &c = w->comm;
+  if (target_rank == MPI_PROC_NULL) return MPI_SUCCESS;
+  if (target_rank < 0 || target_rank >= (int)c.group.size())
+    return MPI_ERR_ARG;
+  DtView tv;
+  if (!resolve_dtype(target_datatype, tv)) return MPI_ERR_TYPE;
+  // the wire op writes contiguous bytes at the target; a strided
+  // target typemap would be silently flattened — reject it
+  if (!tv.contiguous()) return MPI_ERR_TYPE;
+  std::vector<char> data;
+  DtInfo di;
+  int rc = pack_origin(origin_addr, origin_count, origin_datatype, data, di);
+  if (rc != MPI_SUCCESS) return rc;
+  size_t want =
+      (size_t)target_count * tv.elems_per_item() * tv.di.item;
+  if (data.size() != want) return MPI_ERR_TRUNCATE;
+  int64_t disp = (int64_t)target_disp * w->disp_unit;
+  int tw = world_of(c, target_rank);
+  if (tw == g.rank) {
+    if (disp < 0 || disp + (int64_t)data.size() > w->size)
+      return MPI_ERR_ARG;
+    std::lock_guard<std::mutex> lk(w->mu);
+    memcpy(w->base + disp, data.data(), data.size());
+    return MPI_SUCCESS;
+  }
+  std::string t;
+  t.push_back((char)T_TUPLE);
+  put_varint(t, 4);
+  put_str(t, "wput");
+  put_int(t, wid);
+  put_int(t, disp);
+  put_ndarray_1d(t, di.tag, data.data(),
+                 data.size() / di.item, di.item);
+  rc = win_send_tuple(tw, t);
+  if (rc == MPI_SUCCESS) {
+    std::lock_guard<std::mutex> lk(w->dirty_mu);
+    w->dirty.insert(tw);
+  }
+  return rc;
+}
+
+int MPI_Accumulate(const void *origin_addr, int origin_count,
+                   MPI_Datatype origin_datatype, int target_rank,
+                   MPI_Aint target_disp, int target_count,
+                   MPI_Datatype target_datatype, MPI_Op op, MPI_Win win) {
+  int64_t wid;
+  WinObj *w = lookup_win(win, &wid);
+  if (!w) return MPI_ERR_WIN;
+  CommObj &c = w->comm;
+  if (target_rank == MPI_PROC_NULL) return MPI_SUCCESS;
+  if (target_rank < 0 || target_rank >= (int)c.group.size())
+    return MPI_ERR_ARG;
+  if (g_user_ops.count(op))
+    return MPI_ERR_OP;  // MPI: accumulate takes predefined ops only
+  DtView tv;
+  if (!resolve_dtype(target_datatype, tv)) return MPI_ERR_TYPE;
+  if (!tv.contiguous()) return MPI_ERR_TYPE;  // see MPI_Put
+  std::vector<char> data;
+  DtInfo di;
+  int rc = pack_origin(origin_addr, origin_count, origin_datatype, data,
+                       di);
+  if (rc != MPI_SUCCESS) return rc;
+  size_t want =
+      (size_t)target_count * tv.elems_per_item() * tv.di.item;
+  if (data.size() != want) return MPI_ERR_TRUNCATE;
+  int64_t disp = (int64_t)target_disp * w->disp_unit;
+  int tw = world_of(c, target_rank);
+  int n = (int)(data.size() / tv.di.item);
+  if (tw == g.rank) {
+    if (disp < 0 || disp + (int64_t)data.size() > w->size)
+      return MPI_ERR_ARG;
+    std::lock_guard<std::mutex> lk(w->mu);
+    return reduce_buf(w->base + disp, data.data(), n,
+                      tv.derived ? tv.derived->base : target_datatype, op);
+  }
+  std::string t;
+  t.push_back((char)T_TUPLE);
+  put_varint(t, 6);
+  put_str(t, "wacc");
+  put_int(t, wid);
+  put_int(t, disp);
+  put_int(t, (int64_t)op);
+  put_int(t, (int64_t)(tv.derived ? tv.derived->base : target_datatype));
+  put_ndarray_1d(t, di.tag, data.data(), data.size() / di.item, di.item);
+  rc = win_send_tuple(tw, t);
+  if (rc == MPI_SUCCESS) {
+    std::lock_guard<std::mutex> lk(w->dirty_mu);
+    w->dirty.insert(tw);
+  }
+  return rc;
+}
+
+int MPI_Get(void *origin_addr, int origin_count,
+            MPI_Datatype origin_datatype, int target_rank,
+            MPI_Aint target_disp, int target_count,
+            MPI_Datatype target_datatype, MPI_Win win) {
+  int64_t wid;
+  WinObj *w = lookup_win(win, &wid);
+  if (!w) return MPI_ERR_WIN;
+  CommObj &c = w->comm;
+  if (target_rank == MPI_PROC_NULL) return MPI_SUCCESS;
+  if (target_rank < 0 || target_rank >= (int)c.group.size())
+    return MPI_ERR_ARG;
+  DtView ov, tv;
+  if (!resolve_dtype(origin_datatype, ov) ||
+      !resolve_dtype(target_datatype, tv))
+    return MPI_ERR_TYPE;
+  if (!tv.contiguous()) return MPI_ERR_TYPE;  // see MPI_Put
+  size_t nbytes = (size_t)target_count * tv.elems_per_item() * tv.di.item;
+  if (nbytes > 0x7FFFFFFFull) return MPI_ERR_COUNT;  // int request count
+  size_t obytes = (size_t)origin_count * ov.elems_per_item() * ov.di.item;
+  if (nbytes != obytes) return MPI_ERR_TRUNCATE;
+  int64_t disp = (int64_t)target_disp * w->disp_unit;
+  int tw = world_of(c, target_rank);
+  std::vector<char> raw(nbytes);
+  if (tw == g.rank) {
+    if (disp < 0 || disp + (int64_t)nbytes > w->size) return MPI_ERR_ARG;
+    std::lock_guard<std::mutex> lk(w->mu);
+    memcpy(raw.data(), w->base + disp, nbytes);
+  } else {
+    // RPC: post the reply recv, send the request, wait (the epoch is
+    // active-target, so a blocking get inside it is the natural shape)
+    int64_t rtag = g_next_reply_tag.fetch_add(1);
+    Req r;
+    r.is_recv = true;
+    r.user_buf = raw.data();
+    r.count = (int)nbytes;
+    DtView bv;
+    bv.di = {"|u1", 1};
+    int handle = post_recv(&r, bv, WIN_CID, tw, rtag);
+    std::string t;
+    t.push_back((char)T_TUPLE);
+    put_varint(t, 5);
+    put_str(t, "wget");
+    put_int(t, wid);
+    put_int(t, disp);
+    put_int(t, (int64_t)nbytes);
+    put_int(t, rtag);
+    int rc = win_send_tuple(tw, t);
+    if (rc != MPI_SUCCESS) {
+      std::lock_guard<std::mutex> lk(g.match_mu);
+      deregister_locked(handle, &r);
+      return rc;
+    }
+    MPI_Status st{};
+    rc = wait_handle_impl(handle, &st, g.cts_timeout);
+    if (rc != MPI_SUCCESS) return rc;
+    if ((size_t)st._count != nbytes) return MPI_ERR_ARG;  // oob at target
+  }
+  if (ov.contiguous()) {
+    memcpy(origin_addr, raw.data(), nbytes);
+  } else {
+    unpack_dtype(origin_addr, origin_count, ov, raw.data(), nbytes);
+  }
+  return MPI_SUCCESS;
+}
+
+int MPI_Win_fence(int /*assert_*/, MPI_Win win) {
+  WinObj *w = lookup_win(win);
+  if (!w) return MPI_ERR_WIN;
+  // flush every dirty target (per-origin FIFO: the reply proves all our
+  // earlier ops applied), then close the exposure epoch collectively
+  std::vector<int> targets;
+  {
+    std::lock_guard<std::mutex> lk(w->dirty_mu);
+    targets.assign(w->dirty.begin(), w->dirty.end());
+    w->dirty.clear();
+  }
+  int64_t wid;
+  lookup_win(win, &wid);
+  for (int tw : targets) {
+    if (tw == g.rank) continue;
+    int64_t rtag = g_next_reply_tag.fetch_add(1);
+    Req r;
+    char dummy;
+    r.is_recv = true;
+    r.user_buf = &dummy;
+    r.count = 0;
+    DtView bv;
+    bv.di = {"|u1", 1};
+    int handle = post_recv(&r, bv, WIN_CID, tw, rtag);
+    std::string t;
+    t.push_back((char)T_TUPLE);
+    put_varint(t, 3);
+    put_str(t, "wflush");
+    put_int(t, wid);
+    put_int(t, rtag);
+    int rc = win_send_tuple(tw, t);
+    if (rc != MPI_SUCCESS) {
+      std::lock_guard<std::mutex> lk(g.match_mu);
+      deregister_locked(handle, &r);
+      return rc;
+    }
+    MPI_Status st{};
+    rc = wait_handle_impl(handle, &st, g.cts_timeout);
+    if (rc != MPI_SUCCESS) return rc;
+  }
+  return c_barrier(w->comm);
+}
+
+int MPI_Win_free(MPI_Win *win) {
+  if (!win) return MPI_ERR_ARG;
+  int64_t wid;
+  WinObj *w = lookup_win(*win, &wid);
+  if (!w) return MPI_ERR_WIN;
+  // quiesce: a conforming program has fenced, so after this barrier no
+  // peer can still address the window
+  int rc = c_barrier(w->comm);
+  {
+    std::lock_guard<std::mutex> lk(g_wins_mu);
+    g_wins.erase(wid);
+    g_win_handles.erase(*win);
+  }
+  delete w;
+  *win = MPI_WIN_NULL;
+  return rc;
 }
 
 // ---------------------------------------------------------------- misc

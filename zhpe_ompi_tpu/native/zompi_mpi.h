@@ -18,6 +18,13 @@
  * predefined integer dtype set, the logical/bitwise reduction ops,
  * user-defined operators (MPI_Op_create), and MPI_Error_string.
  *
+ * Round-5 tier 3: any-size RTS/CTS rendezvous sends, active-target RMA
+ * windows (Win_create/fence/free + Put/Get/Accumulate,
+ * win_create.c:44), nonblocking collectives retiring through the
+ * request engine (Ibarrier/Ibcast/Iallreduce, ibcast.c:36), Cartesian
+ * topology (Dims/Cart create/get/rank/coords/shift, cart_create.c:45),
+ * and MPI_Pack/Unpack/Pack_size over the convertor (pack.c:45).
+ *
  * Wire-up (the PMIx-env analog): MPI_Init reads
  *   ZMPI_RANK        this process's rank
  *   ZMPI_SIZE        job size
@@ -279,6 +286,57 @@ int MPI_Type_vector(int count, int blocklength, int stride,
 int MPI_Type_commit(MPI_Datatype *datatype);
 int MPI_Type_free(MPI_Datatype *datatype);
 int MPI_Type_size(MPI_Datatype datatype, int *size);
+
+/* pack/unpack (ompi/mpi/c/pack.c:45 surface over the convertor) */
+int MPI_Pack(const void *inbuf, int incount, MPI_Datatype datatype,
+             void *outbuf, int outsize, int *position, MPI_Comm comm);
+int MPI_Unpack(const void *inbuf, int insize, int *position, void *outbuf,
+               int outcount, MPI_Datatype datatype, MPI_Comm comm);
+int MPI_Pack_size(int incount, MPI_Datatype datatype, MPI_Comm comm,
+                  int *size);
+
+/* nonblocking collectives (ompi/mpi/c/ibcast.c:36 family): retire
+ * through the same request engine as point-to-point */
+int MPI_Ibarrier(MPI_Comm comm, MPI_Request *request);
+int MPI_Ibcast(void *buf, int count, MPI_Datatype dt, int root,
+               MPI_Comm comm, MPI_Request *request);
+int MPI_Iallreduce(const void *sendbuf, void *recvbuf, int count,
+                   MPI_Datatype dt, MPI_Op op, MPI_Comm comm,
+                   MPI_Request *request);
+
+/* Cartesian topology (ompi/mpi/c/cart_create.c:45 family) */
+int MPI_Dims_create(int nnodes, int ndims, int dims[]);
+int MPI_Cart_create(MPI_Comm comm, int ndims, const int dims[],
+                    const int periods[], int reorder, MPI_Comm *newcomm);
+int MPI_Cartdim_get(MPI_Comm comm, int *ndims);
+int MPI_Cart_get(MPI_Comm comm, int maxdims, int dims[], int periods[],
+                 int coords[]);
+int MPI_Cart_rank(MPI_Comm comm, const int coords[], int *rank);
+int MPI_Cart_coords(MPI_Comm comm, int rank, int maxdims, int coords[]);
+int MPI_Cart_shift(MPI_Comm comm, int direction, int disp,
+                   int *rank_source, int *rank_dest);
+
+/* one-sided (active target: ompi/mpi/c/win_create.c:44 surface) */
+typedef long long MPI_Aint;
+typedef int MPI_Win;
+#define MPI_WIN_NULL (-1)
+#define MPI_ERR_WIN 45
+int MPI_Win_create(void *base, MPI_Aint size, int disp_unit, MPI_Info info,
+                   MPI_Comm comm, MPI_Win *win);
+int MPI_Win_fence(int assert_, MPI_Win win);
+int MPI_Win_free(MPI_Win *win);
+int MPI_Put(const void *origin_addr, int origin_count,
+            MPI_Datatype origin_datatype, int target_rank,
+            MPI_Aint target_disp, int target_count,
+            MPI_Datatype target_datatype, MPI_Win win);
+int MPI_Get(void *origin_addr, int origin_count,
+            MPI_Datatype origin_datatype, int target_rank,
+            MPI_Aint target_disp, int target_count,
+            MPI_Datatype target_datatype, MPI_Win win);
+int MPI_Accumulate(const void *origin_addr, int origin_count,
+                   MPI_Datatype origin_datatype, int target_rank,
+                   MPI_Aint target_disp, int target_count,
+                   MPI_Datatype target_datatype, MPI_Op op, MPI_Win win);
 
 #ifdef __cplusplus
 }
