@@ -747,3 +747,95 @@ class TestTier3Surface:
         assert run.returncode == 0, run.stderr
         for r in range(4):
             assert f"halo_c rank {r}/4 OK" in run.stdout
+
+    def test_icoll_family_and_graph_topology(self, shim, tmp_path):
+        """Multiple nonblocking collectives in flight in program order
+        (their tag slots are reserved at call time), plus the graph
+        topology surface and Topo_test."""
+        src = tmp_path / "icoll.c"
+        src.write_text(r'''
+#include <stdio.h>
+#include <stdlib.h>
+#include "zompi_mpi.h"
+int main(int argc, char **argv) {
+  int rank, size, i;
+  if (MPI_Init(&argc, &argv) != MPI_SUCCESS) return 2;
+  MPI_Comm_rank(MPI_COMM_WORLD, &rank);
+  MPI_Comm_size(MPI_COMM_WORLD, &size);
+  /* four nonblocking collectives started back-to-back, waited in
+     reverse order: slot reservation keeps their wires disjoint */
+  long v = rank + 1, sum = 0, scan = 0;
+  long *ga = malloc(size * sizeof(long));
+  long *aa = malloc(size * sizeof(long));
+  MPI_Request rq[4];
+  MPI_Iallreduce(&v, &sum, 1, MPI_LONG, MPI_SUM, MPI_COMM_WORLD, &rq[0]);
+  MPI_Igather(&v, 1, MPI_LONG, ga, 1, MPI_LONG, 0, MPI_COMM_WORLD, &rq[1]);
+  MPI_Iallgather(&v, 1, MPI_LONG, aa, 1, MPI_LONG, MPI_COMM_WORLD, &rq[2]);
+  MPI_Iscan(&v, &scan, 1, MPI_LONG, MPI_SUM, MPI_COMM_WORLD, &rq[3]);
+  for (i = 3; i >= 0; i--)
+    if (MPI_Wait(&rq[i], MPI_STATUS_IGNORE) != MPI_SUCCESS) return 3;
+  long want = (long)size * (size + 1) / 2;
+  if (sum != want) { fprintf(stderr, "sum %ld != %ld\n", sum, want); return 4; }
+  if (scan != (long)(rank + 1) * (rank + 2) / 2) return 5;
+  for (i = 0; i < size; i++)
+    if (aa[i] != i + 1) return 6;
+  if (rank == 0)
+    for (i = 0; i < size; i++)
+      if (ga[i] != i + 1) return 7;
+  /* Ireduce_scatter_block reserves TWO slots; follow with a blocking
+     bcast to prove the sequence stays aligned */
+  long *contrib = malloc(size * sizeof(long));
+  for (i = 0; i < size; i++) contrib[i] = rank + i;
+  long mine = -1;
+  MPI_Request rsb;
+  MPI_Ireduce_scatter_block(contrib, &mine, 1, MPI_LONG, MPI_SUM,
+                            MPI_COMM_WORLD, &rsb);
+  long token = rank == 0 ? 77 : 0;
+  MPI_Bcast(&token, 1, MPI_LONG, 0, MPI_COMM_WORLD);
+  if (token != 77) return 8;
+  MPI_Wait(&rsb, MPI_STATUS_IGNORE);
+  /* sum over ranks of (rank + me) = size*me + size*(size-1)/2 */
+  if (mine != (long)size * rank + (long)size * (size - 1) / 2) return 9;
+  /* graph topology: ring graph, every node two neighbors */
+  int *index = malloc(size * sizeof(int));
+  int *edges = malloc(2 * size * sizeof(int));
+  for (i = 0; i < size; i++) {
+    index[i] = 2 * (i + 1);
+    edges[2 * i] = (i + size - 1) % size;
+    edges[2 * i + 1] = (i + 1) % size;
+  }
+  MPI_Comm gcomm;
+  if (MPI_Graph_create(MPI_COMM_WORLD, size, index, edges, 0, &gcomm)
+      != MPI_SUCCESS) return 10;
+  int topo;
+  MPI_Topo_test(gcomm, &topo);
+  if (topo != MPI_GRAPH) return 11;
+  int nn, nbrs[2];
+  MPI_Graph_neighbors_count(gcomm, rank, &nn);
+  if (nn != 2) return 12;
+  MPI_Graph_neighbors(gcomm, rank, 2, nbrs);
+  if (nbrs[0] != (rank + size - 1) % size || nbrs[1] != (rank + 1) % size)
+    return 13;
+  MPI_Topo_test(MPI_COMM_WORLD, &topo);
+  if (topo != MPI_UNDEFINED) return 14;
+  MPI_Barrier(MPI_COMM_WORLD);
+  printf("icoll rank %d/%d OK\n", rank, size);
+  free(ga); free(aa); free(contrib); free(index); free(edges);
+  MPI_Finalize();
+  return 0;
+}
+''')
+        binpath = tmp_path / "icoll"
+        _compile_c(shim, src, binpath)
+        port = _free_port()
+        n = 5
+        procs = [
+            subprocess.Popen([str(binpath)], env=_env(r, n, port),
+                             stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                             text=True)
+            for r in range(n)
+        ]
+        for r, p in enumerate(procs):
+            out, err = p.communicate(timeout=120)
+            assert p.returncode == 0, f"rank {r} failed: {err}\n{out}"
+            assert f"icoll rank {r}/{n} OK" in out

@@ -1082,6 +1082,8 @@ struct CommObj {
   uint64_t win_seq = 0;               // per-comm window-id sequence
   std::vector<int> cart_dims;         // non-empty => Cartesian topology
   std::vector<int> cart_periods;
+  std::vector<int> graph_index;       // non-empty => graph topology
+  std::vector<int> graph_edges;
 };
 
 std::map<int, CommObj> g_comms;
@@ -3237,13 +3239,24 @@ int icoll_spawn(std::function<int()> body, MPI_Comm comm,
 
 }  // namespace
 
+namespace {
+
+// snapshot the comm with this op's tag slot(s) RESERVED in program
+// order; `slots` = number of coll_seq increments the algorithm performs
+std::shared_ptr<CommObj> icoll_reserve(CommObj *c, int slots = 1) {
+  auto snap = std::make_shared<CommObj>(*c);
+  c->coll_seq += slots;
+  return snap;
+}
+
+}  // namespace
+
 int MPI_Ibcast(void *buf, int count, MPI_Datatype dt, int root,
                MPI_Comm comm, MPI_Request *request) {
   CommObj *c = lookup_comm(comm);
   if (!c) return MPI_ERR_COMM;
   if (root < 0 || root >= (int)c->group.size()) return MPI_ERR_ARG;
-  auto snap = std::make_shared<CommObj>(*c);
-  c->coll_seq++;  // reserve this op's tag slot in program order
+  auto snap = icoll_reserve(c);
   return icoll_spawn(
       [snap, buf, count, dt, root]() {
         return c_bcast(*snap, buf, count, dt, root, 0x7E01);
@@ -3256,11 +3269,124 @@ int MPI_Iallreduce(const void *sendbuf, void *recvbuf, int count,
                    MPI_Request *request) {
   CommObj *c = lookup_comm(comm);
   if (!c) return MPI_ERR_COMM;
-  auto snap = std::make_shared<CommObj>(*c);
-  c->coll_seq++;
+  auto snap = icoll_reserve(c);
   return icoll_spawn(
       [snap, sendbuf, recvbuf, count, dt, op]() {
         return c_allreduce(*snap, sendbuf, recvbuf, count, dt, op);
+      },
+      comm, request);
+}
+
+int MPI_Ireduce(const void *sendbuf, void *recvbuf, int count,
+                MPI_Datatype dt, MPI_Op op, int root, MPI_Comm comm,
+                MPI_Request *request) {
+  CommObj *c = lookup_comm(comm);
+  if (!c) return MPI_ERR_COMM;
+  if (root < 0 || root >= (int)c->group.size()) return MPI_ERR_ARG;
+  auto snap = icoll_reserve(c);
+  return icoll_spawn(
+      [snap, sendbuf, recvbuf, count, dt, op, root]() {
+        return c_reduce(*snap, sendbuf, recvbuf, count, dt, op, root);
+      },
+      comm, request);
+}
+
+int MPI_Igather(const void *sendbuf, int sendcount, MPI_Datatype sendtype,
+                void *recvbuf, int recvcount, MPI_Datatype recvtype,
+                int root, MPI_Comm comm, MPI_Request *request) {
+  CommObj *c = lookup_comm(comm);
+  if (!c) return MPI_ERR_COMM;
+  if (root < 0 || root >= (int)c->group.size()) return MPI_ERR_ARG;
+  auto snap = icoll_reserve(c);
+  return icoll_spawn(
+      [=]() {
+        return c_gather(*snap, sendbuf, sendcount, sendtype, recvbuf,
+                        recvcount, recvtype, root);
+      },
+      comm, request);
+}
+
+int MPI_Iscatter(const void *sendbuf, int sendcount, MPI_Datatype sendtype,
+                 void *recvbuf, int recvcount, MPI_Datatype recvtype,
+                 int root, MPI_Comm comm, MPI_Request *request) {
+  CommObj *c = lookup_comm(comm);
+  if (!c) return MPI_ERR_COMM;
+  if (root < 0 || root >= (int)c->group.size()) return MPI_ERR_ARG;
+  auto snap = icoll_reserve(c);
+  return icoll_spawn(
+      [=]() {
+        return c_scatter(*snap, sendbuf, sendcount, sendtype, recvbuf,
+                         recvcount, recvtype, root);
+      },
+      comm, request);
+}
+
+int MPI_Iallgather(const void *sendbuf, int sendcount,
+                   MPI_Datatype sendtype, void *recvbuf, int recvcount,
+                   MPI_Datatype recvtype, MPI_Comm comm,
+                   MPI_Request *request) {
+  CommObj *c = lookup_comm(comm);
+  if (!c) return MPI_ERR_COMM;
+  auto snap = icoll_reserve(c);
+  return icoll_spawn(
+      [=]() {
+        return c_allgather(*snap, sendbuf, sendcount, sendtype, recvbuf,
+                           recvcount, recvtype);
+      },
+      comm, request);
+}
+
+int MPI_Ialltoall(const void *sendbuf, int sendcount,
+                  MPI_Datatype sendtype, void *recvbuf, int recvcount,
+                  MPI_Datatype recvtype, MPI_Comm comm,
+                  MPI_Request *request) {
+  CommObj *c = lookup_comm(comm);
+  if (!c) return MPI_ERR_COMM;
+  auto snap = icoll_reserve(c);
+  return icoll_spawn(
+      [=]() {
+        return c_alltoall(*snap, sendbuf, sendcount, sendtype, recvbuf,
+                          recvcount, recvtype);
+      },
+      comm, request);
+}
+
+int MPI_Iscan(const void *sendbuf, void *recvbuf, int count,
+              MPI_Datatype dt, MPI_Op op, MPI_Comm comm,
+              MPI_Request *request) {
+  CommObj *c = lookup_comm(comm);
+  if (!c) return MPI_ERR_COMM;
+  auto snap = icoll_reserve(c);
+  return icoll_spawn(
+      [=]() {
+        return c_scan(*snap, sendbuf, recvbuf, count, dt, op, false);
+      },
+      comm, request);
+}
+
+int MPI_Iexscan(const void *sendbuf, void *recvbuf, int count,
+                MPI_Datatype dt, MPI_Op op, MPI_Comm comm,
+                MPI_Request *request) {
+  CommObj *c = lookup_comm(comm);
+  if (!c) return MPI_ERR_COMM;
+  auto snap = icoll_reserve(c);
+  return icoll_spawn(
+      [=]() {
+        return c_scan(*snap, sendbuf, recvbuf, count, dt, op, true);
+      },
+      comm, request);
+}
+
+int MPI_Ireduce_scatter_block(const void *sendbuf, void *recvbuf,
+                              int recvcount, MPI_Datatype dt, MPI_Op op,
+                              MPI_Comm comm, MPI_Request *request) {
+  CommObj *c = lookup_comm(comm);
+  if (!c) return MPI_ERR_COMM;
+  auto snap = icoll_reserve(c, 2);  // reduce + scatter under the hood
+  return icoll_spawn(
+      [=]() {
+        return c_reduce_scatter_block(*snap, sendbuf, recvbuf, recvcount,
+                                      dt, op);
       },
       comm, request);
 }
@@ -3417,6 +3543,86 @@ int MPI_Cart_shift(MPI_Comm comm, int direction, int disp,
   };
   neighbor(-disp, rank_source);
   neighbor(disp, rank_dest);
+  return MPI_SUCCESS;
+}
+
+// ------------------------------------------------------ graph topology
+// graph_create.c family: arbitrary neighbor lists in the standard
+// index/edges encoding (index[i] = cumulative edge count through node i)
+
+int MPI_Graph_create(MPI_Comm comm, int nnodes, const int index[],
+                     const int edges[], int /*reorder*/,
+                     MPI_Comm *newcomm) {
+  CommObj *c = lookup_comm(comm);
+  if (!c) return MPI_ERR_COMM;
+  if (nnodes <= 0 || nnodes > (int)c->group.size()) return MPI_ERR_ARG;
+  int nedges = index[nnodes - 1];
+  for (int i = 0; i < nnodes; i++) {
+    if (index[i] < (i ? index[i - 1] : 0)) return MPI_ERR_ARG;
+  }
+  for (int e = 0; e < nedges; e++)
+    if (edges[e] < 0 || edges[e] >= nnodes) return MPI_ERR_ARG;
+  int color = c->local_rank < nnodes ? 0 : MPI_UNDEFINED;
+  int rc = MPI_Comm_split(comm, color, c->local_rank, newcomm);
+  if (rc != MPI_SUCCESS) return rc;
+  if (*newcomm == MPI_COMM_NULL) return MPI_SUCCESS;
+  CommObj *nc = lookup_comm(*newcomm);
+  nc->graph_index.assign(index, index + nnodes);
+  nc->graph_edges.assign(edges, edges + nedges);
+  return MPI_SUCCESS;
+}
+
+int MPI_Graphdims_get(MPI_Comm comm, int *nnodes, int *nedges) {
+  CommObj *c = lookup_comm(comm);
+  if (!c) return MPI_ERR_COMM;
+  if (c->graph_index.empty()) return MPI_ERR_ARG;
+  *nnodes = (int)c->graph_index.size();
+  *nedges = c->graph_index.back();
+  return MPI_SUCCESS;
+}
+
+int MPI_Graph_get(MPI_Comm comm, int maxindex, int maxedges, int index[],
+                  int edges[]) {
+  CommObj *c = lookup_comm(comm);
+  if (!c) return MPI_ERR_COMM;
+  if (c->graph_index.empty()) return MPI_ERR_ARG;
+  if (maxindex < (int)c->graph_index.size() ||
+      maxedges < c->graph_index.back())
+    return MPI_ERR_ARG;
+  std::copy(c->graph_index.begin(), c->graph_index.end(), index);
+  std::copy(c->graph_edges.begin(), c->graph_edges.end(), edges);
+  return MPI_SUCCESS;
+}
+
+int MPI_Graph_neighbors_count(MPI_Comm comm, int rank, int *nneighbors) {
+  CommObj *c = lookup_comm(comm);
+  if (!c) return MPI_ERR_COMM;
+  int nn = (int)c->graph_index.size();
+  if (nn == 0 || rank < 0 || rank >= nn) return MPI_ERR_ARG;
+  *nneighbors = c->graph_index[rank] - (rank ? c->graph_index[rank - 1]
+                                             : 0);
+  return MPI_SUCCESS;
+}
+
+int MPI_Graph_neighbors(MPI_Comm comm, int rank, int maxneighbors,
+                        int neighbors[]) {
+  CommObj *c = lookup_comm(comm);
+  if (!c) return MPI_ERR_COMM;
+  int nn = (int)c->graph_index.size();
+  if (nn == 0 || rank < 0 || rank >= nn) return MPI_ERR_ARG;
+  int lo = rank ? c->graph_index[rank - 1] : 0;
+  int hi = c->graph_index[rank];
+  if (maxneighbors < hi - lo) return MPI_ERR_ARG;
+  for (int e = lo; e < hi; e++) neighbors[e - lo] = c->graph_edges[e];
+  return MPI_SUCCESS;
+}
+
+int MPI_Topo_test(MPI_Comm comm, int *status) {
+  CommObj *c = lookup_comm(comm);
+  if (!c) return MPI_ERR_COMM;
+  if (!c->cart_dims.empty()) *status = MPI_CART;
+  else if (!c->graph_index.empty()) *status = MPI_GRAPH;
+  else *status = MPI_UNDEFINED;
   return MPI_SUCCESS;
 }
 
@@ -3640,7 +3846,8 @@ int MPI_Get(void *origin_addr, int origin_count,
 }
 
 int MPI_Win_fence(int /*assert_*/, MPI_Win win) {
-  WinObj *w = lookup_win(win);
+  int64_t wid;
+  WinObj *w = lookup_win(win, &wid);
   if (!w) return MPI_ERR_WIN;
   // flush every dirty target (per-origin FIFO: the reply proves all our
   // earlier ops applied), then close the exposure epoch collectively
@@ -3650,8 +3857,6 @@ int MPI_Win_fence(int /*assert_*/, MPI_Win win) {
     targets.assign(w->dirty.begin(), w->dirty.end());
     w->dirty.clear();
   }
-  int64_t wid;
-  lookup_win(win, &wid);
   for (int tw : targets) {
     if (tw == g.rank) continue;
     int64_t rtag = g_next_reply_tag.fetch_add(1);
