@@ -1,0 +1,94 @@
+/* oshmem_c — the C OpenSHMEM surface acceptance (zompi_shmem.h over
+ * the window engine; reference oshmem/shmem/c):
+ * symmetric allocation, ring put, every-PE fetch-add on one counter,
+ * wait_until signaling, reductions, fcollect, and a lock-protected
+ * critical section, across N real processes.
+ *
+ *   python -m zhpe_ompi_tpu.tools.zmpicc examples/oshmem_c.c -o oshmem
+ *   python -m zhpe_ompi_tpu.tools.mpirun -n 4 ./oshmem
+ */
+#include <stdio.h>
+#include <stdlib.h>
+#include "zompi_shmem.h"
+
+int main(void) {
+  if (shmem_init() != 0) return 2;
+  int me = shmem_my_pe(), n = shmem_n_pes();
+
+  /* symmetric allocation: same offsets everywhere */
+  long *ring = shmem_malloc(4 * sizeof(long));
+  long *counter = shmem_malloc(sizeof(long));
+  long *flag = shmem_malloc(sizeof(long));
+  long *lock = shmem_malloc(sizeof(long));
+  long *tally = shmem_malloc(sizeof(long));
+  if (!ring || !counter || !flag || !lock || !tally) return 3;
+  for (int i = 0; i < 4; i++) ring[i] = -1;
+  *counter = 0; *flag = 0; *lock = 0; *tally = 0;
+  shmem_barrier_all();
+
+  /* ring put: my payload lands in my right neighbor's ring[] */
+  long payload[4];
+  for (int i = 0; i < 4; i++) payload[i] = me * 10 + i;
+  shmem_long_put(ring, payload, 4, (me + 1) % n);
+  shmem_barrier_all();
+  int left = (me + n - 1) % n;
+  for (int i = 0; i < 4; i++)
+    if (ring[i] != left * 10 + i) {
+      fprintf(stderr, "PE %d: ring[%d]=%ld\n", me, i, ring[i]);
+      return 4;
+    }
+
+  /* the canonical idiom: every PE fetch-adds PE 0's counter; fetches
+   * must be distinct linearization points and the total exact */
+  long old = shmem_long_atomic_fetch_add(counter, me + 1, 0);
+  if (old < 0 || old > (long)n * (n + 1) / 2) return 5;
+  shmem_barrier_all();
+  if (me == 0 && *counter != (long)n * (n + 1) / 2) {
+    fprintf(stderr, "counter %ld\n", *counter);
+    return 6;
+  }
+
+  /* wait_until: PE 0 signals PE n-1 */
+  if (me == 0) shmem_long_p(flag, 42, n - 1);
+  if (me == n - 1) {
+    shmem_long_wait_until(flag, SHMEM_CMP_EQ, 42);
+  }
+  shmem_barrier_all();
+
+  /* reductions + fcollect */
+  long lv = me + 1, lsum = 0, lmax = 0;
+  shmem_long_sum_reduce(&lsum, &lv, 1);
+  shmem_long_max_reduce(&lmax, &lv, 1);
+  if (lsum != (long)n * (n + 1) / 2 || lmax != n) return 7;
+  long *gathered = shmem_malloc(n * sizeof(long));
+  shmem_fcollectmem(gathered, &lv, sizeof(long));
+  for (int i = 0; i < n; i++)
+    if (gathered[i] != i + 1) return 8;
+
+  /* lock-protected read-modify-write (NOT atomic ops: the lock is the
+   * serialization) — every PE increments the tally 3 times */
+  for (int k = 0; k < 3; k++) {
+    shmem_set_lock(lock);
+    long t = shmem_long_g(tally, 0);
+    shmem_long_p(tally, t + 1, 0);
+    shmem_quiet();
+    shmem_clear_lock(lock);
+  }
+  shmem_barrier_all();
+  if (me == 0 && *tally != 3L * n) {
+    fprintf(stderr, "tally %ld != %ld\n", *tally, 3L * n);
+    return 9;
+  }
+
+  /* broadcast */
+  double src = me == 1 ? 2.718 : 0.0, dst = -1.0;
+  shmem_broadcastmem(&dst, &src, sizeof dst, 1);
+  if (dst != 2.718) return 10;
+
+  shmem_free(gathered);
+  shmem_free(ring);
+  shmem_barrier_all();
+  printf("oshmem_c PE %d/%d OK\n", me, n);
+  shmem_finalize();
+  return 0;
+}

@@ -839,3 +839,79 @@ int main(int argc, char **argv) {
             out, err = p.communicate(timeout=120)
             assert p.returncode == 0, f"rank {r} failed: {err}\n{out}"
             assert f"icoll rank {r}/{n} OK" in out
+
+
+@pytest.fixture(scope="module")
+def oshmem_bin(shim, tmp_path_factory):
+    return _compile_example(shim, tmp_path_factory, "oshmem_c.c")
+
+
+class TestOshmemCSurface:
+    """The C OpenSHMEM surface (zompi_shmem.h over the window engine —
+    the reference's oshmem/shmem/c bindings): symmetric heap, ring put,
+    all-PE fetch-add, wait_until, reductions, fcollect, locks,
+    broadcast, across real processes."""
+
+    @pytest.mark.parametrize("n", [2, 4, 5])
+    def test_oshmem_example(self, oshmem_bin, n):
+        port = _free_port()
+        procs = [
+            subprocess.Popen([oshmem_bin], env=_env(r, n, port),
+                             stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                             text=True)
+            for r in range(n)
+        ]
+        for r, p in enumerate(procs):
+            out, err = p.communicate(timeout=120)
+            assert p.returncode == 0, f"rank {r} failed: {err}\n{out}"
+            assert f"oshmem_c PE {r}/{n} OK" in out
+
+    def test_mixed_mpi_and_shmem_in_one_process(self, shim, tmp_path):
+        """A process may be an MPI rank and a PE at once (the reference
+        links ompi + oshmem into one runtime): shmem_init on top of an
+        existing MPI_Init, MPI collectives + shmem RMA interleaved."""
+        src = tmp_path / "mixed.c"
+        src.write_text(r'''
+#include <stdio.h>
+#include "zompi_mpi.h"
+#include "zompi_shmem.h"
+int main(int argc, char **argv) {
+  int rank, size;
+  if (MPI_Init(&argc, &argv) != MPI_SUCCESS) return 2;
+  MPI_Comm_rank(MPI_COMM_WORLD, &rank);
+  MPI_Comm_size(MPI_COMM_WORLD, &size);
+  if (shmem_init() != 0) return 3;  /* rides the existing MPI runtime */
+  if (shmem_my_pe() != rank || shmem_n_pes() != size) return 4;
+  long *cell = shmem_malloc(sizeof(long));
+  *cell = 0;
+  shmem_barrier_all();
+  shmem_long_atomic_add(cell, rank + 1, 0);
+  long sum = 0, me = rank;
+  MPI_Allreduce(&me, &sum, 1, MPI_LONG, MPI_SUM, MPI_COMM_WORLD);
+  shmem_barrier_all();
+  if (sum != (long)size * (size - 1) / 2) return 5;
+  if (rank == 0 && *cell != (long)size * (size + 1) / 2) return 6;
+  shmem_finalize();  /* does NOT finalize MPI (we initialized it) */
+  int fin = 0;
+  MPI_Initialized(&fin);
+  if (!fin) return 7;
+  MPI_Barrier(MPI_COMM_WORLD);
+  printf("mixed rank %d/%d OK\n", rank, size);
+  MPI_Finalize();
+  return 0;
+}
+''')
+        binpath = tmp_path / "mixed"
+        _compile_c(shim, src, binpath)
+        port = _free_port()
+        n = 3
+        procs = [
+            subprocess.Popen([str(binpath)], env=_env(r, n, port),
+                             stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                             text=True)
+            for r in range(n)
+        ]
+        for r, p in enumerate(procs):
+            out, err = p.communicate(timeout=120)
+            assert p.returncode == 0, f"rank {r} failed: {err}\n{out}"
+            assert f"mixed rank {r}/{n} OK" in out

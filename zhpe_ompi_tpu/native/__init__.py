@@ -151,25 +151,32 @@ def available() -> bool:
     return load() is not None
 
 
-# -- C ABI shim (zompi_mpi.h / libzompi_mpi.so) ---------------------------
+# -- C ABI shim (zompi_mpi.h + zompi_shmem.h / libzompi_mpi.so) -----------
 
-_MPI_SRC = os.path.join(_HERE, "zompi_mpi.cpp")
+_MPI_SRCS = [os.path.join(_HERE, "zompi_mpi.cpp"),
+             os.path.join(_HERE, "zompi_shmem.cpp")]
+_MPI_HDRS = [os.path.join(_HERE, "zompi_mpi.h"),
+             os.path.join(_HERE, "zompi_shmem.h")]
 _mpi_lock = threading.Lock()
 
 
 def build_mpi_shim() -> str:
-    """Build libzompi_mpi.so (the mpi.h-compatible C ABI over the host
-    plane) if stale; returns the .so path.  Raises on compile failure —
-    unlike the kernel library there is no Python fallback for a C ABI."""
-    with open(_MPI_SRC, "rb") as f:
-        h = hashlib.sha256(f.read()).hexdigest()[:16]
-    so = os.path.join(_HERE, f"libzompi_mpi_{h}.so")
+    """Build libzompi_mpi.so (the mpi.h + shmem.h compatible C ABI over
+    the host plane) if stale; returns the .so path.  Raises on compile
+    failure — unlike the kernel library there is no Python fallback for
+    a C ABI.  The hash covers every source AND header, so an
+    interface-only change still rebuilds."""
+    h = hashlib.sha256()
+    for path in _MPI_SRCS + _MPI_HDRS:
+        with open(path, "rb") as f:
+            h.update(f.read())
+    so = os.path.join(_HERE, f"libzompi_mpi_{h.hexdigest()[:16]}.so")
     with _mpi_lock:
         if not os.path.exists(so):
             tmp = so + f".tmp.{os.getpid()}"
             subprocess.run(
                 ["g++", "-O2", "-shared", "-fPIC", "-std=c++17",
-                 "-pthread", "-o", tmp, _MPI_SRC],
+                 "-pthread", "-o", tmp] + _MPI_SRCS,
                 check=True, capture_output=True, text=True, timeout=120,
             )
             os.replace(tmp, so)

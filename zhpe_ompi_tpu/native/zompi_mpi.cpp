@@ -1320,6 +1320,37 @@ void win_reply(int64_t origin, int64_t reply_tag, const void *data,
   send_frame(fd, f);
 }
 
+// The one AMO apply path (local fast path AND the wamo wire handler):
+// validates displacement and operand shape, applies under the window
+// lock, fills `old` with the pre-op value.  subkind: add | set | swap |
+// cas ([compare][value] operand) | fetch (no operand).
+bool apply_amo(WinObj *w, int64_t disp, const std::string &sub,
+               MPI_Datatype dt, const char *opnd, size_t opnd_len,
+               std::vector<char> &old) {
+  DtInfo di;
+  if (!base_dtinfo(dt, di)) return false;
+  if (disp < 0 || disp + (int64_t)di.item > w->size) return false;
+  size_t need = sub == "cas" ? 2 * di.item
+                : sub == "fetch" ? 0
+                                 : di.item;
+  if (opnd_len != need || (need > 0 && opnd == nullptr)) return false;
+  old.resize(di.item);
+  std::lock_guard<std::mutex> lk(w->mu);
+  char *cell = w->base + disp;
+  memcpy(old.data(), cell, di.item);
+  if (sub == "add") {
+    reduce_buf(cell, opnd, 1, dt, MPI_SUM);
+  } else if (sub == "set" || sub == "swap") {
+    memcpy(cell, opnd, di.item);
+  } else if (sub == "cas") {
+    if (memcmp(cell, opnd, di.item) == 0)
+      memcpy(cell, opnd + di.item, di.item);
+  } else if (sub != "fetch") {
+    return false;
+  }
+  return true;
+}
+
 // Drain-side dispatch of ("wput"|"wacc"|"wget"|"wflush", win_id, ...)
 void handle_win_frame(int64_t src, const DssVal &t) {
   if (t.items.empty() || t.items[0].tag != T_STR) return;
@@ -1369,6 +1400,20 @@ void handle_win_frame(int64_t src, const DssVal &t) {
     // FIFO per connection: by the time the drain reaches this frame,
     // every earlier op from `src` has been applied
     win_reply(src, t.items[2].i, "", 0);
+  } else if (kind == "wamo" && t.items.size() == 7) {
+    // fetch-AMO RPC (the shmem_atomic substrate, oshmem/shmem/c/
+    // shmem_fadd.c): ("wamo", wid, disp, subkind, dt, operand-bytes,
+    // reply_tag) -> old value; applied atomically under the window
+    // lock (the drain is the serialization point)
+    int64_t reply_tag = t.items[6].i;
+    std::vector<char> old;
+    if (!apply_amo(w, t.items[2].i, t.items[3].s,
+                   (MPI_Datatype)t.items[4].i, t.items[5].data.data(),
+                   t.items[5].data.size(), old)) {
+      win_reply(src, reply_tag, "", 0);
+      return;
+    }
+    win_reply(src, reply_tag, old.data(), old.size());
   }
 }
 
@@ -3845,12 +3890,77 @@ int MPI_Get(void *origin_addr, int origin_count,
   return MPI_SUCCESS;
 }
 
-int MPI_Win_fence(int /*assert_*/, MPI_Win win) {
+/* Fetch-AMO on a window cell (the C OSHMEM layer's substrate; not part
+ * of mpi.h).  subkind: "add" | "set" | "swap" | "cas" | "fetch"; for
+ * cas `operand` carries [compare][value].  Fills `old_out` (di.item
+ * bytes) with the pre-op value.  Atomic at the target: the drain
+ * applies under the window lock. */
+int zompi_win_amo(MPI_Win win, int target_rank, long long disp_bytes,
+                  const char *subkind, MPI_Datatype dt,
+                  const void *operand, int operand_items, void *old_out) {
   int64_t wid;
   WinObj *w = lookup_win(win, &wid);
   if (!w) return MPI_ERR_WIN;
-  // flush every dirty target (per-origin FIFO: the reply proves all our
-  // earlier ops applied), then close the exposure epoch collectively
+  CommObj &c = w->comm;
+  if (target_rank < 0 || target_rank >= (int)c.group.size())
+    return MPI_ERR_ARG;
+  DtInfo di;
+  if (!base_dtinfo(dt, di)) return MPI_ERR_TYPE;
+  if (disp_bytes < 0 || disp_bytes + (int64_t)di.item > w->size)
+    return MPI_ERR_ARG;
+  std::string sub = subkind;
+  int need_items = sub == "cas" ? 2 : sub == "fetch" ? 0 : 1;
+  if (operand_items != need_items) return MPI_ERR_ARG;
+  if (need_items > 0 && operand == nullptr) return MPI_ERR_ARG;
+  int tw = world_of(c, target_rank);
+  if (tw == g.rank) {
+    std::vector<char> old;
+    if (!apply_amo(w, disp_bytes, sub, dt, (const char *)operand,
+                   (size_t)need_items * di.item, old))
+      return MPI_ERR_ARG;
+    memcpy(old_out, old.data(), di.item);
+    return MPI_SUCCESS;
+  }
+  int64_t rtag = g_next_reply_tag.fetch_add(1);
+  Req r;
+  r.is_recv = true;
+  r.user_buf = old_out;
+  r.count = (int)di.item;
+  DtView bv;
+  bv.di = {"|u1", 1};
+  int handle = post_recv(&r, bv, WIN_CID, tw, rtag);
+  std::string t;
+  t.push_back((char)T_TUPLE);
+  put_varint(t, 7);
+  put_str(t, "wamo");
+  put_int(t, wid);
+  put_int(t, disp_bytes);
+  put_str(t, sub);
+  put_int(t, (int64_t)dt);
+  put_ndarray_1d(t, di.tag, need_items ? operand : "",
+                 (uint64_t)need_items, di.item);
+  put_int(t, rtag);
+  int rc = win_send_tuple(tw, t);
+  if (rc != MPI_SUCCESS) {
+    std::lock_guard<std::mutex> lk(g.match_mu);
+    deregister_locked(handle, &r);
+    return rc;
+  }
+  MPI_Status st{};
+  rc = wait_handle_impl(handle, &st, g.cts_timeout);
+  if (rc != MPI_SUCCESS) return rc;
+  if (st._count != (long long)di.item) return MPI_ERR_ARG;
+  return MPI_SUCCESS;
+}
+
+/* Flush this origin's outstanding puts/accumulates on the window (an
+ * ack round-trip per dirty target; per-origin FIFO proves application).
+ * Exported for the C OSHMEM layer's shmem_quiet, which completes
+ * without the fence's closing barrier. */
+int zompi_win_flush(MPI_Win win) {
+  int64_t wid;
+  WinObj *w = lookup_win(win, &wid);
+  if (!w) return MPI_ERR_WIN;
   std::vector<int> targets;
   {
     std::lock_guard<std::mutex> lk(w->dirty_mu);
@@ -3884,6 +3994,15 @@ int MPI_Win_fence(int /*assert_*/, MPI_Win win) {
     rc = wait_handle_impl(handle, &st, g.cts_timeout);
     if (rc != MPI_SUCCESS) return rc;
   }
+  return MPI_SUCCESS;
+}
+
+int MPI_Win_fence(int /*assert_*/, MPI_Win win) {
+  // flush every dirty target, then close the exposure epoch collectively
+  WinObj *w = lookup_win(win);
+  if (!w) return MPI_ERR_WIN;
+  int rc = zompi_win_flush(win);
+  if (rc != MPI_SUCCESS) return rc;
   return c_barrier(w->comm);
 }
 
