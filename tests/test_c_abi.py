@@ -915,3 +915,136 @@ int main(int argc, char **argv) {
             out, err = p.communicate(timeout=120)
             assert p.returncode == 0, f"rank {r} failed: {err}\n{out}"
             assert f"mixed rank {r}/{n} OK" in out
+
+    def test_passive_target_lock_counter(self, shim, tmp_path):
+        """Passive-target RMA (win_lock.c): every rank lock/get/put/
+        unlocks an exclusive counter on rank 0's Win_allocate'd window
+        WITHOUT rank 0 participating in the epochs — the drain is the
+        arbiter. Plus Comm_create from a reversed group."""
+        src = tmp_path / "passive.c"
+        src.write_text(r'''
+#include <stdio.h>
+#include <stdlib.h>
+#include "zompi_mpi.h"
+int main(int argc, char **argv) {
+  int rank, size, i;
+  if (MPI_Init(&argc, &argv) != MPI_SUCCESS) return 2;
+  MPI_Comm_rank(MPI_COMM_WORLD, &rank);
+  MPI_Comm_size(MPI_COMM_WORLD, &size);
+  long *base = 0;
+  MPI_Win win;
+  if (MPI_Win_allocate(sizeof(long), sizeof(long), MPI_INFO_NULL,
+                       MPI_COMM_WORLD, &base, &win) != MPI_SUCCESS)
+    return 3;
+  *base = 0;
+  MPI_Barrier(MPI_COMM_WORLD);
+  /* lock-protected read-modify-write: NOT atomics — exclusive lock is
+     the serialization; 4 increments per rank */
+  for (i = 0; i < 4; i++) {
+    long cur = -1, next;
+    MPI_Win_lock(MPI_LOCK_EXCLUSIVE, 0, 0, win);
+    MPI_Get(&cur, 1, MPI_LONG, 0, 0, 1, MPI_LONG, win);
+    next = cur + 1;
+    MPI_Put(&next, 1, MPI_LONG, 0, 0, 1, MPI_LONG, win);
+    MPI_Win_unlock(0, win);
+  }
+  MPI_Barrier(MPI_COMM_WORLD);
+  if (rank == 0 && *base != 4L * size) {
+    fprintf(stderr, "counter %ld != %ld\n", *base, 4L * size);
+    return 4;
+  }
+  /* shared locks may coexist: everyone shared-locks rank 0, reads */
+  MPI_Win_lock(MPI_LOCK_SHARED, 0, 0, win);
+  long seen = -1;
+  MPI_Get(&seen, 1, MPI_LONG, 0, 0, 1, MPI_LONG, win);
+  MPI_Win_unlock(0, win);
+  if (seen != 4L * size) return 5;
+  MPI_Win_free(&win);
+  /* Comm_create from the REVERSED group: rank order flips */
+  MPI_Group world_grp, rev_grp;
+  MPI_Comm_group(MPI_COMM_WORLD, &world_grp);
+  int *order = malloc(size * sizeof(int));
+  for (i = 0; i < size; i++) order[i] = size - 1 - i;
+  MPI_Group_incl(world_grp, size, order, &rev_grp);
+  MPI_Comm rev;
+  if (MPI_Comm_create(MPI_COMM_WORLD, rev_grp, &rev) != MPI_SUCCESS)
+    return 6;
+  int rrank;
+  MPI_Comm_rank(rev, &rrank);
+  if (rrank != size - 1 - rank) return 7;
+  long probe = rrank, rsum = 0;
+  MPI_Allreduce(&probe, &rsum, 1, MPI_LONG, MPI_SUM, rev);
+  if (rsum != (long)size * (size - 1) / 2) return 8;
+  MPI_Barrier(MPI_COMM_WORLD);
+  printf("passive rank %d/%d OK\n", rank, size);
+  free(order);
+  MPI_Finalize();
+  return 0;
+}
+''')
+        binpath = tmp_path / "passive"
+        _compile_c(shim, src, binpath)
+        port = _free_port()
+        n = 4
+        procs = [
+            subprocess.Popen([str(binpath)], env=_env(r, n, port),
+                             stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                             text=True)
+            for r in range(n)
+        ]
+        for r, p in enumerate(procs):
+            out, err = p.communicate(timeout=120)
+            assert p.returncode == 0, f"rank {r} failed: {err}\n{out}"
+            assert f"passive rank {r}/{n} OK" in out
+
+    def test_asymmetric_window_amo(self, shim, tmp_path):
+        """Windows are per-rank sized: only rank 0 exposes memory (the
+        others pass size 0); remote AMOs to rank 0 must succeed — the
+        TARGET validates displacements, not the origin's local size."""
+        src = tmp_path / "asym.c"
+        src.write_text(r'''
+#include <stdio.h>
+#include <stdlib.h>
+#include "zompi_mpi.h"
+extern int zompi_win_amo(MPI_Win, int, long long, const char *,
+                         MPI_Datatype, const void *, int, void *);
+int main(int argc, char **argv) {
+  int rank, size;
+  if (MPI_Init(&argc, &argv) != MPI_SUCCESS) return 2;
+  MPI_Comm_rank(MPI_COMM_WORLD, &rank);
+  MPI_Comm_size(MPI_COMM_WORLD, &size);
+  long cell = 0;
+  MPI_Win win;
+  /* only rank 0 exposes its cell */
+  if (MPI_Win_create(rank == 0 ? (void *)&cell : NULL,
+                     rank == 0 ? (MPI_Aint)sizeof(long) : 0,
+                     sizeof(long), MPI_INFO_NULL, MPI_COMM_WORLD, &win)
+      != MPI_SUCCESS) return 3;
+  MPI_Win_fence(0, win);
+  long one = 1, old = -1;
+  if (zompi_win_amo(win, 0, 0, "add", MPI_LONG, &one, 1, &old)
+      != MPI_SUCCESS) return 4;  /* origin size 0 must not matter */
+  if (old < 0 || old >= size) return 5;
+  MPI_Win_fence(0, win);
+  if (rank == 0 && cell != size) { fprintf(stderr, "cell %ld\n", cell); return 6; }
+  MPI_Win_free(&win);
+  MPI_Barrier(MPI_COMM_WORLD);
+  printf("asym rank %d/%d OK\n", rank, size);
+  MPI_Finalize();
+  return 0;
+}
+''')
+        binpath = tmp_path / "asym"
+        _compile_c(shim, src, binpath)
+        port = _free_port()
+        n = 4
+        procs = [
+            subprocess.Popen([str(binpath)], env=_env(r, n, port),
+                             stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                             text=True)
+            for r in range(n)
+        ]
+        for r, p in enumerate(procs):
+            out, err = p.communicate(timeout=120)
+            assert p.returncode == 0, f"rank {r} failed: {err}\n{out}"
+            assert f"asym rank {r}/{n} OK" in out

@@ -1274,10 +1274,18 @@ struct WinObj {
   char *base = nullptr;
   int64_t size = 0;  // bytes
   int disp_unit = 1;
+  bool owns_base = false;  // Win_allocate: free the buffer at Win_free
   CommObj comm;      // snapshot at creation
   std::mutex mu;     // apply lock (drains from several origins)
   std::set<int> dirty;  // world ranks with unflushed ops from us
   std::mutex dirty_mu;
+  // passive-target lock manager (osc/am.py _LockManager's shape): the
+  // target's drain arbitrates; waiters park their reply tag until a
+  // release grants them, FIFO
+  std::mutex lock_mu;
+  int lock_excl_holder = -1;        // world rank or -1
+  int lock_shared = 0;              // count of shared holders
+  std::deque<std::array<int64_t, 3>> lock_waiters;  // (origin, type, rtag)
 };
 
 std::map<int64_t, WinObj *> g_wins;      // wire win-id -> obj
@@ -1318,6 +1326,35 @@ void win_reply(int64_t origin, int64_t reply_tag, const void *data,
   put_ndarray_1d(f, "|u1", data, nbytes, 1);
   std::lock_guard<std::mutex> lk(g.send_mu);
   send_frame(fd, f);
+}
+
+// The one lock-release path (wunlock wire handler AND the self-target
+// MPI_Win_unlock): drop `unlocker`'s hold, then grant waiters FIFO — a
+// head exclusive waits for full drain and blocks everyone behind it,
+// shared waiters are granted as a run.  Returns the granted
+// (origin, type, reply_tag) rows; the caller sends the replies.
+std::vector<std::array<int64_t, 3>> release_and_grants(WinObj *w,
+                                                       int unlocker) {
+  std::vector<std::array<int64_t, 3>> grants;
+  std::lock_guard<std::mutex> lk(w->lock_mu);
+  if (w->lock_excl_holder == unlocker) w->lock_excl_holder = -1;
+  else if (w->lock_shared > 0) w->lock_shared--;
+  while (!w->lock_waiters.empty()) {
+    auto next = w->lock_waiters.front();
+    if (next[1] == 1) {  // exclusive waiter
+      if (w->lock_excl_holder < 0 && w->lock_shared == 0) {
+        w->lock_excl_holder = (int)next[0];
+        grants.push_back(next);
+        w->lock_waiters.pop_front();
+      }
+      break;  // exclusive at the head blocks everyone behind it
+    }
+    if (w->lock_excl_holder >= 0) break;
+    w->lock_shared++;
+    grants.push_back(next);
+    w->lock_waiters.pop_front();
+  }
+  return grants;
 }
 
 // The one AMO apply path (local fast path AND the wamo wire handler):
@@ -1400,6 +1437,31 @@ void handle_win_frame(int64_t src, const DssVal &t) {
     // FIFO per connection: by the time the drain reaches this frame,
     // every earlier op from `src` has been applied
     win_reply(src, t.items[2].i, "", 0);
+  } else if (kind == "wlock" && t.items.size() == 4) {
+    // passive-target lock request: grant now or park the reply until a
+    // release frees the window (the drain is the arbiter)
+    int lock_type = (int)t.items[2].i;
+    int64_t reply_tag = t.items[3].i;
+    bool grant;
+    {
+      std::lock_guard<std::mutex> lk(w->lock_mu);
+      if (lock_type == 1) {  // exclusive
+        grant = w->lock_excl_holder < 0 && w->lock_shared == 0 &&
+                w->lock_waiters.empty();
+        if (grant) w->lock_excl_holder = (int)src;
+      } else {               // shared
+        grant = w->lock_excl_holder < 0 && w->lock_waiters.empty();
+        if (grant) w->lock_shared++;
+      }
+      if (!grant) w->lock_waiters.push_back({src, lock_type, reply_tag});
+    }
+    if (grant) win_reply(src, reply_tag, "", 0);
+  } else if (kind == "wunlock" && t.items.size() == 3) {
+    // FIFO ordering means every op the holder issued before the unlock
+    // is already applied — release, grant waiters, ack the unlocker
+    auto grants = release_and_grants(w, (int)src);
+    win_reply(src, t.items[2].i, "", 0);
+    for (auto &gr : grants) win_reply(gr[0], gr[2], "", 0);
   } else if (kind == "wamo" && t.items.size() == 7) {
     // fetch-AMO RPC (the shmem_atomic substrate, oshmem/shmem/c/
     // shmem_fadd.c): ("wamo", wid, disp, subkind, dt, operand-bytes,
@@ -3591,6 +3653,26 @@ int MPI_Cart_shift(MPI_Comm comm, int direction, int disp,
   return MPI_SUCCESS;
 }
 
+int MPI_Comm_create(MPI_Comm comm, MPI_Group group, MPI_Comm *newcomm) {
+  // comm_create.c:40's semantics by reduction to split: members color
+  // together, keyed by GROUP rank so the new comm preserves the
+  // group's ordering; non-members get MPI_COMM_NULL
+  CommObj *c = lookup_comm(comm);
+  if (!c) return MPI_ERR_COMM;
+  GroupObj *gr = lookup_group(group);
+  if (!gr) return MPI_ERR_GROUP;
+  int my_world = c->group[c->local_rank];
+  int color = MPI_UNDEFINED, key = 0;
+  for (size_t i = 0; i < gr->ranks.size(); i++) {
+    if (gr->ranks[i] == my_world) {
+      color = 0;
+      key = (int)i;
+      break;
+    }
+  }
+  return MPI_Comm_split(comm, color, key, newcomm);
+}
+
 // ------------------------------------------------------ graph topology
 // graph_create.c family: arbitrary neighbor lists in the standard
 // index/edges encoding (index[i] = cumulative edge count through node i)
@@ -3906,8 +3988,10 @@ int zompi_win_amo(MPI_Win win, int target_rank, long long disp_bytes,
     return MPI_ERR_ARG;
   DtInfo di;
   if (!base_dtinfo(dt, di)) return MPI_ERR_TYPE;
-  if (disp_bytes < 0 || disp_bytes + (int64_t)di.item > w->size)
-    return MPI_ERR_ARG;
+  // NOTE: no bounds check against w->size here — windows are per-rank
+  // sized (asymmetric exposure is legal), so only the TARGET can
+  // validate the displacement (apply_amo does, on both paths)
+  if (disp_bytes < 0) return MPI_ERR_ARG;
   std::string sub = subkind;
   int need_items = sub == "cas" ? 2 : sub == "fetch" ? 0 : 1;
   if (operand_items != need_items) return MPI_ERR_ARG;
@@ -3967,7 +4051,8 @@ int zompi_win_flush(MPI_Win win) {
     targets.assign(w->dirty.begin(), w->dirty.end());
     w->dirty.clear();
   }
-  for (int tw : targets) {
+  for (size_t i = 0; i < targets.size(); i++) {
+    int tw = targets[i];
     if (tw == g.rank) continue;
     int64_t rtag = g_next_reply_tag.fetch_add(1);
     Req r;
@@ -3985,14 +4070,21 @@ int zompi_win_flush(MPI_Win win) {
     put_int(t, wid);
     put_int(t, rtag);
     int rc = win_send_tuple(tw, t);
-    if (rc != MPI_SUCCESS) {
+    if (rc == MPI_SUCCESS) {
+      MPI_Status st{};
+      rc = wait_handle_impl(handle, &st, g.cts_timeout);
+    } else {
       std::lock_guard<std::mutex> lk(g.match_mu);
       deregister_locked(handle, &r);
+    }
+    if (rc != MPI_SUCCESS) {
+      // unacknowledged targets stay dirty — a later flush/fence must
+      // not report completion for unconfirmed puts
+      std::lock_guard<std::mutex> lk(w->dirty_mu);
+      for (size_t j = i; j < targets.size(); j++)
+        w->dirty.insert(targets[j]);
       return rc;
     }
-    MPI_Status st{};
-    rc = wait_handle_impl(handle, &st, g.cts_timeout);
-    if (rc != MPI_SUCCESS) return rc;
   }
   return MPI_SUCCESS;
 }
@@ -4011,18 +4103,145 @@ int MPI_Win_free(MPI_Win *win) {
   int64_t wid;
   WinObj *w = lookup_win(*win, &wid);
   if (!w) return MPI_ERR_WIN;
-  // quiesce: a conforming program has fenced, so after this barrier no
-  // peer can still address the window
+  // quiesce: a conforming program has fenced/unlocked, so after this
+  // barrier no peer can still address the window
   int rc = c_barrier(w->comm);
   {
     std::lock_guard<std::mutex> lk(g_wins_mu);
     g_wins.erase(wid);
     g_win_handles.erase(*win);
   }
+  if (w->owns_base) free(w->base);
   delete w;
   *win = MPI_WIN_NULL;
   return rc;
 }
+
+int MPI_Win_allocate(MPI_Aint size, int disp_unit, MPI_Info info,
+                     MPI_Comm comm, void *baseptr, MPI_Win *win) {
+  if (size < 0 || !baseptr) return MPI_ERR_ARG;
+  void *base = size ? calloc(1, (size_t)size) : nullptr;
+  if (size && !base) return MPI_ERR_OTHER;
+  int rc = MPI_Win_create(base, size, disp_unit, info, comm, win);
+  if (rc != MPI_SUCCESS) {
+    free(base);
+    return rc;
+  }
+  lookup_win(*win)->owns_base = true;
+  *(void **)baseptr = base;
+  return MPI_SUCCESS;
+}
+
+// passive target (win_lock.c / the AM plane's _LockManager): the
+// target's drain arbitrates grants; a self-target acquire polls the
+// local manager (no fairness guarantee, per MPI).
+
+namespace {
+
+int win_lock_rpc(WinObj *w, int64_t wid, int tw, const std::string &kind,
+                 int lock_type) {
+  int64_t rtag = g_next_reply_tag.fetch_add(1);
+  Req r;
+  char dummy;
+  r.is_recv = true;
+  r.user_buf = &dummy;
+  r.count = 0;
+  DtView bv;
+  bv.di = {"|u1", 1};
+  int handle = post_recv(&r, bv, WIN_CID, tw, rtag);
+  std::string t;
+  t.push_back((char)T_TUPLE);
+  put_varint(t, kind == "wlock" ? 4 : 3);
+  put_str(t, kind);
+  put_int(t, wid);
+  if (kind == "wlock") put_int(t, lock_type);
+  put_int(t, rtag);
+  int rc = win_send_tuple(tw, t);
+  if (rc != MPI_SUCCESS) {
+    std::lock_guard<std::mutex> lk(g.match_mu);
+    deregister_locked(handle, &r);
+    return rc;
+  }
+  MPI_Status st{};
+  // lock grants legally wait for another origin's unlock: no timeout
+  return wait_handle_impl(handle, &st, kind == "wlock" ? -1.0
+                                                       : g.cts_timeout);
+}
+
+}  // namespace
+
+int MPI_Win_lock(int lock_type, int rank, int /*assert_*/, MPI_Win win) {
+  if (lock_type != MPI_LOCK_EXCLUSIVE && lock_type != MPI_LOCK_SHARED)
+    return MPI_ERR_ARG;
+  int64_t wid;
+  WinObj *w = lookup_win(win, &wid);
+  if (!w) return MPI_ERR_WIN;
+  CommObj &c = w->comm;
+  if (rank < 0 || rank >= (int)c.group.size()) return MPI_ERR_ARG;
+  int tw = world_of(c, rank);
+  if (tw == g.rank) {
+    for (;;) {
+      {
+        std::lock_guard<std::mutex> lk(w->lock_mu);
+        if (lock_type == MPI_LOCK_EXCLUSIVE) {
+          if (w->lock_excl_holder < 0 && w->lock_shared == 0) {
+            w->lock_excl_holder = g.rank;
+            return MPI_SUCCESS;
+          }
+        } else if (w->lock_excl_holder < 0) {
+          w->lock_shared++;
+          return MPI_SUCCESS;
+        }
+      }
+      std::this_thread::sleep_for(std::chrono::microseconds(200));
+      if (g.closing.load()) return MPI_ERR_OTHER;
+    }
+  }
+  return win_lock_rpc(w, wid, tw, "wlock", lock_type);
+}
+
+int MPI_Win_unlock(int rank, MPI_Win win) {
+  int64_t wid;
+  WinObj *w = lookup_win(win, &wid);
+  if (!w) return MPI_ERR_WIN;
+  CommObj &c = w->comm;
+  if (rank < 0 || rank >= (int)c.group.size()) return MPI_ERR_ARG;
+  // MPI: unlock completes all ops of the epoch at origin AND target
+  int rc = MPI_Win_flush(rank, win);
+  if (rc != MPI_SUCCESS) return rc;
+  int tw = world_of(c, rank);
+  if (tw == g.rank) {
+    auto grants = release_and_grants(w, g.rank);
+    for (auto &gr : grants) win_reply(gr[0], gr[2], "", 0);
+    return MPI_SUCCESS;
+  }
+  return win_lock_rpc(w, wid, tw, "wunlock", 0);
+}
+
+int MPI_Win_flush(int rank, MPI_Win win) {
+  int64_t wid;
+  WinObj *w = lookup_win(win, &wid);
+  if (!w) return MPI_ERR_WIN;
+  CommObj &c = w->comm;
+  if (rank < 0 || rank >= (int)c.group.size()) return MPI_ERR_ARG;
+  int tw = world_of(c, rank);
+  {
+    std::lock_guard<std::mutex> lk(w->dirty_mu);
+    if (!w->dirty.count(tw)) return MPI_SUCCESS;
+    w->dirty.erase(tw);
+  }
+  if (tw == g.rank) return MPI_SUCCESS;
+  int rc = win_lock_rpc(w, wid, tw, "wflush", 0);
+  if (rc != MPI_SUCCESS) {
+    // an unacknowledged target stays dirty: a later flush/fence/unlock
+    // must not report completion for puts that were never confirmed
+    std::lock_guard<std::mutex> lk(w->dirty_mu);
+    w->dirty.insert(tw);
+  }
+  return rc;
+}
+
+int MPI_Win_flush_all(MPI_Win win) { return zompi_win_flush(win); }
 
 // ---------------------------------------------------------------- misc
 

@@ -158,6 +158,7 @@ int MPI_Group_difference(MPI_Group group1, MPI_Group group2,
 int MPI_Group_translate_ranks(MPI_Group group1, int n, const int ranks1[],
                               MPI_Group group2, int ranks2[]);
 int MPI_Group_free(MPI_Group *group);
+int MPI_Comm_create(MPI_Comm comm, MPI_Group group, MPI_Comm *newcomm);
 
 /* blocking point-to-point */
 int MPI_Send(const void *buf, int count, MPI_Datatype dt, int dest,
@@ -361,9 +362,17 @@ typedef long long MPI_Aint;
 typedef int MPI_Win;
 #define MPI_WIN_NULL (-1)
 #define MPI_ERR_WIN 45
+#define MPI_LOCK_EXCLUSIVE 1
+#define MPI_LOCK_SHARED    2
 int MPI_Win_create(void *base, MPI_Aint size, int disp_unit, MPI_Info info,
                    MPI_Comm comm, MPI_Win *win);
+int MPI_Win_allocate(MPI_Aint size, int disp_unit, MPI_Info info,
+                     MPI_Comm comm, void *baseptr, MPI_Win *win);
 int MPI_Win_fence(int assert_, MPI_Win win);
+int MPI_Win_lock(int lock_type, int rank, int assert_, MPI_Win win);
+int MPI_Win_unlock(int rank, MPI_Win win);
+int MPI_Win_flush(int rank, MPI_Win win);
+int MPI_Win_flush_all(MPI_Win win);
 int MPI_Win_free(MPI_Win *win);
 int MPI_Put(const void *origin_addr, int origin_count,
             MPI_Datatype origin_datatype, int target_rank,
