@@ -1048,3 +1048,105 @@ int main(int argc, char **argv) {
             out, err = p.communicate(timeout=120)
             assert p.returncode == 0, f"rank {r} failed: {err}\n{out}"
             assert f"asym rank {r}/{n} OK" in out
+
+    @pytest.mark.parametrize("n", [2, 4])
+    def test_fetch_rma_and_neighbor_colls(self, shim, tmp_path, n):
+        """MPI_Fetch_and_op (SUM/MAX/REPLACE/NO_OP), Compare_and_swap,
+        and neighbor collectives on a periodic 1-D cart ring — n=2 is
+        the degenerate ring where the minus and plus neighbor are the
+        SAME process, exercising the complementary-slot tag pairing."""
+        src = tmp_path / "fneigh.c"
+        src.write_text(r'''
+#include <stdio.h>
+#include <stdlib.h>
+#include "zompi_mpi.h"
+int main(int argc, char **argv) {
+  int rank, size, i;
+  if (MPI_Init(&argc, &argv) != MPI_SUCCESS) return 2;
+  MPI_Comm_rank(MPI_COMM_WORLD, &rank);
+  MPI_Comm_size(MPI_COMM_WORLD, &size);
+  /* ---- fetch-RMA on rank 0's window ---- */
+  long *base = 0;
+  MPI_Win win;
+  MPI_Win_allocate(2 * sizeof(long), sizeof(long), MPI_INFO_NULL,
+                   MPI_COMM_WORLD, &base, &win);
+  base[0] = 0; base[1] = 5;
+  MPI_Barrier(MPI_COMM_WORLD);
+  long mine = rank + 1, old = -1;
+  MPI_Fetch_and_op(&mine, &old, MPI_LONG, 0, 0, MPI_SUM, win);
+  if (old < 0) return 3;
+  MPI_Fetch_and_op(&mine, &old, MPI_LONG, 0, 1, MPI_MAX, win);
+  MPI_Barrier(MPI_COMM_WORLD);
+  if (rank == 0) {
+    if (base[0] != (long)size * (size + 1) / 2) return 4;
+    if (base[1] != (size > 5 ? size : 5)) return 5;  /* max(5, max rank+1) */
+  }
+  MPI_Barrier(MPI_COMM_WORLD);
+  /* NO_OP = atomic read; REPLACE = swap */
+  long seen = -1;
+  MPI_Fetch_and_op(NULL, &seen, MPI_LONG, 0, 0, MPI_NO_OP, win);
+  if (seen != (long)size * (size + 1) / 2) return 6;
+  MPI_Barrier(MPI_COMM_WORLD);  /* all reads done before the REPLACE */
+  if (rank == 0) {
+    long nine = 9;
+    MPI_Fetch_and_op(&nine, &old, MPI_LONG, 0, 0, MPI_REPLACE, win);
+    if (old != (long)size * (size + 1) / 2 || base[0] != 9) return 7;
+    /* CAS: succeed then fail */
+    long cmp = 9, val = 11, res = -1;
+    MPI_Compare_and_swap(&val, &cmp, &res, MPI_LONG, 0, 0, win);
+    if (res != 9 || base[0] != 11) return 8;
+    MPI_Compare_and_swap(&val, &cmp, &res, MPI_LONG, 0, 0, win);
+    if (res != 11 || base[0] != 11) return 9;
+    /* MPI_Accumulate with MPI_REPLACE = atomic put (MPI-3.1 11.3) */
+    long forty = 40;
+    MPI_Accumulate(&forty, 1, MPI_LONG, 0, 0, 1, MPI_LONG, MPI_REPLACE,
+                   win);
+    MPI_Win_fence(0, win);
+    if (base[0] != 40) return 12;
+  } else {
+    MPI_Win_fence(0, win);
+  }
+  /* PROC_NULL targets are no-ops, never errors */
+  long dummy = 1, dres = -1;
+  if (MPI_Fetch_and_op(&dummy, &dres, MPI_LONG, MPI_PROC_NULL, 0,
+                       MPI_SUM, win) != MPI_SUCCESS) return 13;
+  MPI_Win_free(&win);
+  /* ---- neighbor collectives on a periodic ring ---- */
+  int dims[1] = {size}, periods[1] = {1};
+  MPI_Comm ring;
+  MPI_Cart_create(MPI_COMM_WORLD, 1, dims, periods, 0, &ring);
+  long sval = 100 + rank, ngat[2] = {-1, -1};
+  MPI_Neighbor_allgather(&sval, 1, MPI_LONG, ngat, 1, MPI_LONG, ring);
+  int left = (rank + size - 1) % size, right = (rank + 1) % size;
+  if (ngat[0] != 100 + left || ngat[1] != 100 + right) {
+    fprintf(stderr, "rank %d allgather [%ld,%ld]\n", rank, ngat[0], ngat[1]);
+    return 10;
+  }
+  long sblk[2] = {1000 + rank * 10, 1000 + rank * 10 + 1};  /* to left, to right */
+  long rblk[2] = {-1, -1};
+  MPI_Neighbor_alltoall(sblk, 1, MPI_LONG, rblk, 1, MPI_LONG, ring);
+  /* my left block gets left neighbor's TO-RIGHT block; right gets
+     right neighbor's TO-LEFT block */
+  if (rblk[0] != 1000 + left * 10 + 1 || rblk[1] != 1000 + right * 10) {
+    fprintf(stderr, "rank %d alltoall [%ld,%ld]\n", rank, rblk[0], rblk[1]);
+    return 11;
+  }
+  MPI_Barrier(MPI_COMM_WORLD);
+  printf("fneigh rank %d/%d OK\n", rank, size);
+  MPI_Finalize();
+  return 0;
+}
+''')
+        binpath = tmp_path / "fneigh"
+        _compile_c(shim, src, binpath)
+        port = _free_port()
+        procs = [
+            subprocess.Popen([str(binpath)], env=_env(r, n, port),
+                             stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                             text=True)
+            for r in range(n)
+        ]
+        for r, p in enumerate(procs):
+            out, err = p.communicate(timeout=120)
+            assert p.returncode == 0, f"rank {r} failed: {err}\n{out}"
+            assert f"fneigh rank {r}/{n} OK" in out

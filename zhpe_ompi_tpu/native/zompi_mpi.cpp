@@ -1217,6 +1217,15 @@ MPI_Op g_next_op = 0x20;
 // the caller's responsibility; op.h:547-605's in-order contract)
 int reduce_buf(void *acc, const void *in, int n, MPI_Datatype dt,
                MPI_Op op) {
+  // the RMA identity ops (MPI-3.1 §11.3): REPLACE = atomic put,
+  // NO_OP = leave the accumulator untouched
+  if (op == MPI_REPLACE) {
+    DtInfo di;
+    if (!base_dtinfo(dt, di)) return MPI_ERR_TYPE;
+    memcpy(acc, in, (size_t)n * di.item);
+    return MPI_SUCCESS;
+  }
+  if (op == MPI_NO_OP) return MPI_SUCCESS;
   auto uit = g_user_ops.find(op);
   if (uit != g_user_ops.end()) {
     // MPI user fn computes inoutvec = invec ∘ inoutvec (invec LEFT);
@@ -1360,7 +1369,9 @@ std::vector<std::array<int64_t, 3>> release_and_grants(WinObj *w,
 // The one AMO apply path (local fast path AND the wamo wire handler):
 // validates displacement and operand shape, applies under the window
 // lock, fills `old` with the pre-op value.  subkind: add | set | swap |
-// cas ([compare][value] operand) | fetch (no operand).
+// cas ([compare][value] operand) | fetch (no operand) | "aop:<N>"
+// (cell = cell OP operand for predefined op N — the MPI_Fetch_and_op
+// general form; user ops are rejected at the origin, per MPI).
 bool apply_amo(WinObj *w, int64_t disp, const std::string &sub,
                MPI_Datatype dt, const char *opnd, size_t opnd_len,
                std::vector<char> &old) {
@@ -1382,6 +1393,10 @@ bool apply_amo(WinObj *w, int64_t disp, const std::string &sub,
   } else if (sub == "cas") {
     if (memcmp(cell, opnd, di.item) == 0)
       memcpy(cell, opnd + di.item, di.item);
+  } else if (sub.rfind("aop:", 0) == 0) {
+    MPI_Op op = (MPI_Op)atoi(sub.c_str() + 4);
+    if (g_user_ops.count(op)) return false;
+    if (reduce_buf(cell, opnd, 1, dt, op) != MPI_SUCCESS) return false;
   } else if (sub != "fetch") {
     return false;
   }
@@ -3753,6 +3768,138 @@ int MPI_Topo_test(MPI_Comm comm, int *status) {
   return MPI_SUCCESS;
 }
 
+// ------------------------------------------ neighborhood collectives
+// neighbor_allgather.c / neighbor_alltoall.c over the cart/graph
+// topologies: standard neighbor order (cart: for each dim, -1 then +1;
+// graph: the node's edge list).  Tag pairing makes the exchange exact
+// even in degenerate topologies (a size-2 periodic ring where the
+// minus and plus neighbor are the SAME process): cart sends carry the
+// RECEIVER's slot (the complementary direction, slot^1); graph sends
+// carry the edge's ordinal among the parallel edges to that neighbor
+// (the symmetric-multiplicity convention).
+
+namespace {
+
+// local-rank neighbor list in standard order; MPI_PROC_NULL at walls.
+// Cart neighbors come from MPI_Cart_shift — ONE copy of the
+// wrap/encode rules, shared with user-facing shift.
+int neighbor_list(MPI_Comm comm, CommObj &c, std::vector<int> &nbrs) {
+  nbrs.clear();
+  if (!c.cart_dims.empty()) {
+    for (int d = 0; d < (int)c.cart_dims.size(); d++) {
+      int minus, plus;
+      int rc = MPI_Cart_shift(comm, d, 1, &minus, &plus);
+      if (rc != MPI_SUCCESS) return rc;
+      nbrs.push_back(minus);
+      nbrs.push_back(plus);
+    }
+    return MPI_SUCCESS;
+  }
+  if (!c.graph_index.empty()) {
+    int me = c.local_rank;
+    int lo = me ? c.graph_index[me - 1] : 0;
+    for (int e = lo; e < c.graph_index[me]; e++)
+      nbrs.push_back(c.graph_edges[e]);
+    return MPI_SUCCESS;
+  }
+  return MPI_ERR_ARG;  // no topology attached
+}
+
+// tag codes: receiver's slot for cart, parallel-edge ordinal for graph
+void neighbor_codes(CommObj &c, const std::vector<int> &nbrs,
+                    std::vector<int> &send_code,
+                    std::vector<int> &recv_code) {
+  int n = (int)nbrs.size();
+  send_code.resize(n);
+  recv_code.resize(n);
+  bool cart = !c.cart_dims.empty();
+  std::map<int, int> seen;  // neighbor -> parallel-edge ordinal
+  for (int i = 0; i < n; i++) {
+    if (cart) {
+      send_code[i] = i ^ 1;
+      recv_code[i] = i;
+    } else {
+      int ord = seen[nbrs[i]]++;
+      send_code[i] = ord;
+      recv_code[i] = ord;
+    }
+  }
+}
+
+int c_neighbor_exchange(MPI_Comm comm, CommObj &c, const void *sendbuf,
+                        int scount, MPI_Datatype stype, void *recvbuf,
+                        int rcount, MPI_Datatype rtype, bool alltoall) {
+  DtView sv, rv;
+  if (!resolve_dtype(stype, sv) || !resolve_dtype(rtype, rv))
+    return MPI_ERR_TYPE;
+  std::vector<int> nbrs;
+  int rc = neighbor_list(comm, c, nbrs);
+  if (rc != MPI_SUCCESS) return rc;
+  std::vector<int> send_code, recv_code;
+  neighbor_codes(c, nbrs, send_code, recv_code);
+  int n = (int)nbrs.size();
+  int64_t base = (c.coll_seq++ % 0x8000) << 16;
+  size_t sslot = (size_t)scount * sv.elems_per_item() * sv.di.item;
+  size_t rslot = (size_t)rcount * rv.elems_per_item() * rv.di.item;
+  // post every receive first (the PROC_NULL blocks stay untouched)
+  std::vector<Req> reqs(n);
+  std::vector<int> handles(n, -1);
+  // the stack Reqs must not outlive their registrations: every exit
+  // path past this point deregisters whatever is still pending
+  auto abort_all = [&](int err) {
+    std::lock_guard<std::mutex> lk(g.match_mu);
+    for (int i = 0; i < n; i++)
+      if (handles[i] >= 0) deregister_locked(handles[i], &reqs[i]);
+    return err;
+  };
+  for (int i = 0; i < n; i++) {
+    if (nbrs[i] == MPI_PROC_NULL) continue;
+    reqs[i].is_recv = true;
+    reqs[i].user_buf = (char *)recvbuf + (size_t)i * rslot;
+    reqs[i].count = rcount;
+    handles[i] = post_recv(&reqs[i], rv, c.cid_coll,
+                           world_of(c, nbrs[i]),
+                           base | (0x7E20 + recv_code[i]));
+  }
+  for (int i = 0; i < n; i++) {
+    if (nbrs[i] == MPI_PROC_NULL) continue;
+    const char *blk = alltoall ? (const char *)sendbuf + (size_t)i * sslot
+                               : (const char *)sendbuf;
+    rc = raw_send(blk, scount, stype, world_of(c, nbrs[i]),
+                  base | (0x7E20 + send_code[i]), c.cid_coll);
+    if (rc != MPI_SUCCESS) return abort_all(rc);
+  }
+  for (int i = 0; i < n; i++) {
+    if (handles[i] < 0) continue;
+    rc = wait_handle(handles[i], nullptr);
+    handles[i] = -1;  // consumed (success or not), never re-deregister
+    if (rc != MPI_SUCCESS) return abort_all(rc);
+  }
+  return MPI_SUCCESS;
+}
+
+}  // namespace
+
+int MPI_Neighbor_allgather(const void *sendbuf, int sendcount,
+                           MPI_Datatype sendtype, void *recvbuf,
+                           int recvcount, MPI_Datatype recvtype,
+                           MPI_Comm comm) {
+  CommObj *c = lookup_comm(comm);
+  if (!c) return MPI_ERR_COMM;
+  return c_neighbor_exchange(comm, *c, sendbuf, sendcount, sendtype,
+                             recvbuf, recvcount, recvtype, false);
+}
+
+int MPI_Neighbor_alltoall(const void *sendbuf, int sendcount,
+                          MPI_Datatype sendtype, void *recvbuf,
+                          int recvcount, MPI_Datatype recvtype,
+                          MPI_Comm comm) {
+  CommObj *c = lookup_comm(comm);
+  if (!c) return MPI_ERR_COMM;
+  return c_neighbor_exchange(comm, *c, sendbuf, sendcount, sendtype,
+                             recvbuf, recvcount, recvtype, true);
+}
+
 // ------------------------------------------------------ one-sided RMA
 
 int MPI_Win_create(void *base, MPI_Aint size, int disp_unit, MPI_Info,
@@ -4242,6 +4389,47 @@ int MPI_Win_flush(int rank, MPI_Win win) {
 }
 
 int MPI_Win_flush_all(MPI_Win win) { return zompi_win_flush(win); }
+
+int MPI_Fetch_and_op(const void *origin_addr, void *result_addr,
+                     MPI_Datatype dt, int target_rank,
+                     MPI_Aint target_disp, MPI_Op op, MPI_Win win) {
+  // fetch_and_op.c: single-element atomic fetch+op, predefined ops plus
+  // MPI_REPLACE / MPI_NO_OP — all lower onto the wamo substrate
+  WinObj *w = lookup_win(win);
+  if (!w) return MPI_ERR_WIN;
+  if (target_rank == MPI_PROC_NULL) return MPI_SUCCESS;  // RMA no-op
+  if (g_user_ops.count(op)) return MPI_ERR_OP;
+  int64_t disp = (int64_t)target_disp * w->disp_unit;
+  const char *sub;
+  char subbuf[16];
+  if (op == MPI_NO_OP) sub = "fetch";
+  else if (op == MPI_REPLACE) sub = "swap";
+  else if (op == MPI_SUM) sub = "add";
+  else {
+    snprintf(subbuf, sizeof subbuf, "aop:%d", op);
+    sub = subbuf;
+  }
+  return zompi_win_amo(win, target_rank, disp, sub, dt,
+                       op == MPI_NO_OP ? nullptr : origin_addr,
+                       op == MPI_NO_OP ? 0 : 1, result_addr);
+}
+
+int MPI_Compare_and_swap(const void *origin_addr, const void *compare_addr,
+                         void *result_addr, MPI_Datatype dt,
+                         int target_rank, MPI_Aint target_disp,
+                         MPI_Win win) {
+  WinObj *w = lookup_win(win);
+  if (!w) return MPI_ERR_WIN;
+  if (target_rank == MPI_PROC_NULL) return MPI_SUCCESS;  // RMA no-op
+  DtInfo di;
+  if (!base_dtinfo(dt, di)) return MPI_ERR_TYPE;
+  std::vector<char> opnd(2 * di.item);
+  memcpy(opnd.data(), compare_addr, di.item);
+  memcpy(opnd.data() + di.item, origin_addr, di.item);
+  int64_t disp = (int64_t)target_disp * w->disp_unit;
+  return zompi_win_amo(win, target_rank, disp, "cas", dt, opnd.data(), 2,
+                       result_addr);
+}
 
 // ---------------------------------------------------------------- misc
 

@@ -83,6 +83,8 @@ typedef int MPI_Op;
 #define MPI_BAND 7
 #define MPI_BOR  8
 #define MPI_BXOR 9
+#define MPI_REPLACE 12
+#define MPI_NO_OP   13
 
 typedef int MPI_Request;
 #define MPI_REQUEST_NULL (-1)
@@ -356,6 +358,14 @@ int MPI_Graph_neighbors_count(MPI_Comm comm, int rank, int *nneighbors);
 int MPI_Graph_neighbors(MPI_Comm comm, int rank, int maxneighbors,
                         int neighbors[]);
 int MPI_Topo_test(MPI_Comm comm, int *status);
+int MPI_Neighbor_allgather(const void *sendbuf, int sendcount,
+                           MPI_Datatype sendtype, void *recvbuf,
+                           int recvcount, MPI_Datatype recvtype,
+                           MPI_Comm comm);
+int MPI_Neighbor_alltoall(const void *sendbuf, int sendcount,
+                          MPI_Datatype sendtype, void *recvbuf,
+                          int recvcount, MPI_Datatype recvtype,
+                          MPI_Comm comm);
 
 /* one-sided (active target: ompi/mpi/c/win_create.c:44 surface) */
 typedef long long MPI_Aint;
@@ -386,6 +396,13 @@ int MPI_Accumulate(const void *origin_addr, int origin_count,
                    MPI_Datatype origin_datatype, int target_rank,
                    MPI_Aint target_disp, int target_count,
                    MPI_Datatype target_datatype, MPI_Op op, MPI_Win win);
+int MPI_Fetch_and_op(const void *origin_addr, void *result_addr,
+                     MPI_Datatype dt, int target_rank,
+                     MPI_Aint target_disp, MPI_Op op, MPI_Win win);
+int MPI_Compare_and_swap(const void *origin_addr,
+                         const void *compare_addr, void *result_addr,
+                         MPI_Datatype dt, int target_rank,
+                         MPI_Aint target_disp, MPI_Win win);
 
 #ifdef __cplusplus
 }
