@@ -1150,3 +1150,119 @@ int main(int argc, char **argv) {
             out, err = p.communicate(timeout=120)
             assert p.returncode == 0, f"rank {r} failed: {err}\n{out}"
             assert f"fneigh rank {r}/{n} OK" in out
+
+    def test_attrs_and_indexed_types(self, shim, tmp_path):
+        """Attribute caching (keyval copy/delete through dup/free) and
+        MPI_Type_indexed round-trip including a declaration-order
+        (non-ascending) typemap."""
+        src = tmp_path / "attridx.c"
+        src.write_text(r'''
+#include <stdio.h>
+#include <stdlib.h>
+#include "zompi_mpi.h"
+static int copies = 0, deletes = 0;
+static int copy_fn(MPI_Comm c, int k, void *es, void *in, void *out, int *flag) {
+  copies++;
+  *(void **)out = (char *)in + 1;  /* transformed copy */
+  *flag = 1;
+  return MPI_SUCCESS;
+}
+static int del_fn(MPI_Comm c, int k, void *val, void *es) {
+  deletes++;
+  return MPI_SUCCESS;
+}
+int main(int argc, char **argv) {
+  int rank, size, i;
+  if (MPI_Init(&argc, &argv) != MPI_SUCCESS) return 2;
+  MPI_Comm_rank(MPI_COMM_WORLD, &rank);
+  MPI_Comm_size(MPI_COMM_WORLD, &size);
+  /* ---- attributes ---- */
+  int kv;
+  MPI_Comm_create_keyval(copy_fn, del_fn, &kv, NULL);
+  MPI_Comm_set_attr(MPI_COMM_WORLD, kv, (void *)1000);
+  MPI_Comm dup;
+  MPI_Comm_dup(MPI_COMM_WORLD, &dup);
+  void *got = NULL;
+  int flag = 0;
+  MPI_Comm_get_attr(dup, kv, &got, &flag);
+  if (!flag || (long)got != 1001) return 3;  /* transformed */
+  if (copies != 1) return 4;
+  MPI_Comm_free(&dup);
+  if (deletes != 1) return 5;  /* dup's attr deleted with it */
+  MPI_Comm_delete_attr(MPI_COMM_WORLD, kv);
+  if (deletes != 2) return 6;
+  MPI_Comm_get_attr(MPI_COMM_WORLD, kv, &got, &flag);
+  if (flag) return 7;
+  /* ---- indexed datatype: pick columns 5,1,3 of an 8-vector ---- */
+  double srcv[8], dstv[8];
+  for (i = 0; i < 8; i++) { srcv[i] = i; dstv[i] = -1; }
+  int lens[3] = {1, 1, 1}, disps[3] = {5, 1, 3};
+  MPI_Datatype idx;
+  MPI_Type_indexed(3, lens, disps, MPI_DOUBLE, &idx);
+  MPI_Type_commit(&idx);
+  int tsize;
+  MPI_Type_size(idx, &tsize);
+  if (tsize != 3 * (int)sizeof(double)) return 8;
+  /* MPI-3.1 4.1.6: lb = min disp = 1 elem, extent = ub - lb = 5 elems */
+  long lb = -1, ext = -1;
+  MPI_Type_get_extent(idx, &lb, &ext);
+  if (lb != 1 * (long)sizeof(double) || ext != 5 * (long)sizeof(double))
+    return 14;
+  /* count=2 concatenation strides by the extent: item 1's typemap is
+     {5,1,3} + 5 = {10,6,8}; buffer must span lb + 2*extent = 11 */
+  double two[12], back[12];
+  for (i = 0; i < 12; i++) { two[i] = 100 + i; back[i] = -1; }
+  int pos = 0;
+  double packed2[6];
+  MPI_Pack(two, 2, idx, packed2, (int)sizeof packed2, &pos, MPI_COMM_WORLD);
+  if (packed2[0] != 105 || packed2[1] != 101 || packed2[2] != 103 ||
+      packed2[3] != 110 || packed2[4] != 106 || packed2[5] != 108)
+    return 15;
+  pos = 0;
+  MPI_Unpack(packed2, (int)sizeof packed2, &pos, back, 2, idx,
+             MPI_COMM_WORLD);
+  if (back[5] != 105 || back[1] != 101 || back[3] != 103 ||
+      back[10] != 110 || back[6] != 106 || back[8] != 108) return 16;
+  if (size >= 2) {
+    if (rank == 0) {
+      /* declaration order on the wire: 5.0, 1.0, 3.0 */
+      MPI_Send(srcv, 1, idx, 1, 4, MPI_COMM_WORLD);
+    } else if (rank == 1) {
+      double flat[3] = {-1, -1, -1};
+      MPI_Recv(flat, 3, MPI_DOUBLE, 0, 4, MPI_COMM_WORLD,
+               MPI_STATUS_IGNORE);
+      if (flat[0] != 5 || flat[1] != 1 || flat[2] != 3) return 9;
+      /* and scatter back through the same typemap (self loopback) */
+      MPI_Sendrecv(flat, 3, MPI_DOUBLE, 0, 5, dstv, 1, idx, 0, 5,
+                   MPI_COMM_SELF, MPI_STATUS_IGNORE);
+      if (dstv[5] != 5 || dstv[1] != 1 || dstv[3] != 3) return 10;
+    }
+  }
+  /* indexed_block convenience form */
+  MPI_Datatype blk;
+  int bd[2] = {6, 0};
+  MPI_Type_create_indexed_block(2, 2, bd, MPI_DOUBLE, &blk);
+  MPI_Type_size(blk, &tsize);
+  if (tsize != 4 * (int)sizeof(double)) return 11;
+  MPI_Type_free(&blk);
+  MPI_Type_free(&idx);
+  MPI_Barrier(MPI_COMM_WORLD);
+  printf("attridx rank %d/%d OK\n", rank, size);
+  MPI_Finalize();
+  return 0;
+}
+''')
+        binpath = tmp_path / "attridx"
+        _compile_c(shim, src, binpath)
+        port = _free_port()
+        n = 2
+        procs = [
+            subprocess.Popen([str(binpath)], env=_env(r, n, port),
+                             stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                             text=True)
+            for r in range(n)
+        ]
+        for r, p in enumerate(procs):
+            out, err = p.communicate(timeout=120)
+            assert p.returncode == 0, f"rank {r} failed: {err}\n{out}"
+            assert f"attridx rank {r}/{n} OK" in out

@@ -322,7 +322,8 @@ bool base_dtinfo(MPI_Datatype dt, DtInfo &out) {
 struct DtypeObj {
   MPI_Datatype base = MPI_BYTE;
   std::vector<std::pair<int64_t, int64_t>> blocks;  // (offset, n) in elems
-  int64_t extent = 0;   // in base elems
+  int64_t extent = 0;   // ub - lb, in base elems (the item stride)
+  int64_t lb = 0;       // lower bound (min displacement), in base elems
   int64_t elems = 0;    // base elems per one item (sum of block n)
   bool committed = false;
 };
@@ -2213,6 +2214,75 @@ int MPI_Comm_split(MPI_Comm comm, int color, int key, MPI_Comm *newcomm) {
   return MPI_SUCCESS;
 }
 
+// -------------------------------------------------- attribute caching
+// comm_create_keyval.c family: keyvals with copy/delete callbacks, the
+// MPI library-composition mechanism (attribute/attribute.c reduced to
+// two maps — the object system is absorbed by STL).
+
+struct KeyvalObj {
+  MPI_Comm_copy_attr_function *copy_fn;
+  MPI_Comm_delete_attr_function *delete_fn;
+  void *extra_state;
+};
+std::map<int, KeyvalObj> g_keyvals;
+int g_next_keyval = 0;
+// (comm handle, keyval) -> attribute pointer
+std::map<std::pair<int, int>, void *> g_attrs;
+
+int MPI_Comm_create_keyval(MPI_Comm_copy_attr_function *copy_fn,
+                           MPI_Comm_delete_attr_function *delete_fn,
+                           int *keyval, void *extra_state) {
+  if (!keyval) return MPI_ERR_ARG;
+  int kv = g_next_keyval++;
+  g_keyvals[kv] = {copy_fn, delete_fn, extra_state};
+  *keyval = kv;
+  return MPI_SUCCESS;
+}
+
+int MPI_Comm_free_keyval(int *keyval) {
+  if (!keyval || !g_keyvals.erase(*keyval)) return MPI_ERR_ARG;
+  *keyval = MPI_KEYVAL_INVALID;
+  return MPI_SUCCESS;
+}
+
+int MPI_Comm_set_attr(MPI_Comm comm, int keyval, void *attribute_val) {
+  if (!lookup_comm(comm)) return MPI_ERR_COMM;
+  auto kv = g_keyvals.find(keyval);
+  if (kv == g_keyvals.end()) return MPI_ERR_ARG;
+  auto key = std::make_pair(comm, keyval);
+  auto it = g_attrs.find(key);
+  if (it != g_attrs.end() && kv->second.delete_fn) {
+    int rc = kv->second.delete_fn(comm, keyval, it->second,
+                                  kv->second.extra_state);
+    if (rc != MPI_SUCCESS) return rc;
+  }
+  g_attrs[key] = attribute_val;
+  return MPI_SUCCESS;
+}
+
+int MPI_Comm_get_attr(MPI_Comm comm, int keyval, void *attribute_val,
+                      int *flag) {
+  if (!lookup_comm(comm)) return MPI_ERR_COMM;
+  auto it = g_attrs.find({comm, keyval});
+  *flag = it != g_attrs.end() ? 1 : 0;
+  if (*flag) *(void **)attribute_val = it->second;
+  return MPI_SUCCESS;
+}
+
+int MPI_Comm_delete_attr(MPI_Comm comm, int keyval) {
+  if (!lookup_comm(comm)) return MPI_ERR_COMM;
+  auto it = g_attrs.find({comm, keyval});
+  if (it == g_attrs.end()) return MPI_ERR_ARG;
+  auto kv = g_keyvals.find(keyval);
+  if (kv != g_keyvals.end() && kv->second.delete_fn) {
+    int rc = kv->second.delete_fn(comm, keyval, it->second,
+                                  kv->second.extra_state);
+    if (rc != MPI_SUCCESS) return rc;
+  }
+  g_attrs.erase(it);
+  return MPI_SUCCESS;
+}
+
 int MPI_Comm_dup(MPI_Comm comm, MPI_Comm *newcomm) {
   CommObj *c = lookup_comm(comm);
   if (!c) return MPI_ERR_COMM;
@@ -2224,13 +2294,56 @@ int MPI_Comm_dup(MPI_Comm comm, MPI_Comm *newcomm) {
   int handle = g_next_comm++;
   g_comms[handle] = child;
   *newcomm = handle;
+  // attribute propagation through copy callbacks (MPI dup semantics:
+  // the callback decides whether and what to copy)
+  for (auto &e : g_attrs) {
+    if (e.first.first != comm) continue;
+    auto kv = g_keyvals.find(e.first.second);
+    if (kv == g_keyvals.end() || !kv->second.copy_fn) continue;
+    void *out = nullptr;
+    int flag = 0;
+    int rc = kv->second.copy_fn(comm, e.first.second,
+                                kv->second.extra_state, e.second, &out,
+                                &flag);
+    if (rc != MPI_SUCCESS) {
+      // unwind: already-copied attrs get their delete callbacks, then
+      // the half-built comm dies (comm_dup.c's error contract)
+      for (auto it = g_attrs.begin(); it != g_attrs.end();) {
+        if (it->first.first == handle) {
+          auto dkv = g_keyvals.find(it->first.second);
+          if (dkv != g_keyvals.end() && dkv->second.delete_fn)
+            dkv->second.delete_fn(handle, it->first.second, it->second,
+                                  dkv->second.extra_state);
+          it = g_attrs.erase(it);
+        } else {
+          ++it;
+        }
+      }
+      g_comms.erase(handle);
+      return rc;
+    }
+    if (flag) g_attrs[{handle, e.first.second}] = out;
+  }
   return MPI_SUCCESS;
 }
 
 int MPI_Comm_free(MPI_Comm *comm) {
   if (!comm || *comm == MPI_COMM_WORLD || *comm == MPI_COMM_SELF)
     return MPI_ERR_COMM;
-  if (!g_comms.erase(*comm)) return MPI_ERR_COMM;
+  if (!g_comms.count(*comm)) return MPI_ERR_COMM;
+  // delete callbacks run BEFORE the handle dies (comm_free.c order)
+  for (auto it = g_attrs.begin(); it != g_attrs.end();) {
+    if (it->first.first == *comm) {
+      auto kv = g_keyvals.find(it->first.second);
+      if (kv != g_keyvals.end() && kv->second.delete_fn)
+        kv->second.delete_fn(*comm, it->first.second, it->second,
+                             kv->second.extra_state);
+      it = g_attrs.erase(it);
+    } else {
+      ++it;
+    }
+  }
+  g_comms.erase(*comm);
   *comm = MPI_COMM_NULL;
   return MPI_SUCCESS;
 }
@@ -2800,6 +2913,61 @@ int MPI_Type_vector(int count, int blocklength, int stride,
   return MPI_SUCCESS;
 }
 
+int MPI_Type_indexed(int count, const int blocklengths[],
+                     const int displacements[], MPI_Datatype oldtype,
+                     MPI_Datatype *newtype) {
+  // type_indexed.c analog: per-block lengths and displacements, both in
+  // units of oldtype extent
+  if (count < 0) return MPI_ERR_ARG;
+  DtView v;
+  if (!resolve_dtype(oldtype, v)) return MPI_ERR_TYPE;
+  DtypeObj d;
+  d.base = v.derived ? v.derived->base : oldtype;
+  int64_t old_extent = v.derived ? v.derived->extent : 1;
+  int64_t max_off = 0, min_off = INT64_MAX;
+  int64_t total = 0;
+  for (int c = 0; c < count; c++) {
+    if (blocklengths[c] < 0) return MPI_ERR_ARG;
+    for (int b = 0; b < blocklengths[c]; b++) {
+      int64_t off = ((int64_t)displacements[c] + b) * old_extent;
+      if (off < 0) return MPI_ERR_ARG;  // negative disp unsupported
+      if (v.derived) {
+        for (auto &bb : v.derived->blocks)
+          d.blocks.push_back({off + bb.first, bb.second});
+      } else {
+        d.blocks.push_back({off, 1});
+      }
+      if (off + old_extent > max_off) max_off = off + old_extent;
+      if (off < min_off) min_off = off;
+    }
+    total += blocklengths[c];
+  }
+  if (total == 0) min_off = 0;
+  // typemap order is DECLARATION order (pack serializes in this order,
+  // MPI-3.1 §4.1) — never sort; coalescing only merges adjacent runs
+  coalesce_blocks(d.blocks);
+  // extent = ub - lb (MPI-3.1 §4.1.6): a nonzero minimum displacement
+  // shrinks the per-item stride; block offsets stay ABSOLUTE, so item
+  // k's typemap is d_i + k*extent, exactly the standard's concatenation
+  d.lb = min_off;
+  d.extent = max_off - min_off;
+  d.elems = total * v.elems_per_item();
+  MPI_Datatype handle = g_next_dtype++;
+  g_dtypes[handle] = d;
+  *newtype = handle;
+  return MPI_SUCCESS;
+}
+
+int MPI_Type_create_indexed_block(int count, int blocklength,
+                                  const int displacements[],
+                                  MPI_Datatype oldtype,
+                                  MPI_Datatype *newtype) {
+  if (count < 0 || blocklength < 0) return MPI_ERR_ARG;
+  std::vector<int> lens((size_t)count, blocklength);
+  return MPI_Type_indexed(count, lens.data(), displacements, oldtype,
+                          newtype);
+}
+
 int MPI_Type_commit(MPI_Datatype *datatype) {
   if (!datatype) return MPI_ERR_TYPE;
   if (*datatype < DERIVED_BASE) return MPI_SUCCESS;  // predefined
@@ -3049,11 +3217,11 @@ int MPI_Type_get_extent(MPI_Datatype dt, long *lb, long *extent) {
     if (it == g_dtypes.end()) return MPI_ERR_TYPE;
     DtInfo di;
     if (!base_dtinfo(it->second.base, di)) return MPI_ERR_TYPE;
-    *lb = 0;
+    *lb = (long)(it->second.lb * (int64_t)di.item);
     *extent = (long)(it->second.extent * (int64_t)di.item);
     return MPI_SUCCESS;
   }
-  *lb = 0;
+  *lb = (long)((v.derived ? v.derived->lb : 0) * (int64_t)v.di.item);
   *extent = (long)slot_bytes(v, 1);
   return MPI_SUCCESS;
 }
@@ -3839,8 +4007,10 @@ int c_neighbor_exchange(MPI_Comm comm, CommObj &c, const void *sendbuf,
   neighbor_codes(c, nbrs, send_code, recv_code);
   int n = (int)nbrs.size();
   int64_t base = (c.coll_seq++ % 0x8000) << 16;
-  size_t sslot = (size_t)scount * sv.elems_per_item() * sv.di.item;
-  size_t rslot = (size_t)rcount * rv.elems_per_item() * rv.di.item;
+  // slot stride follows the EXTENT rule like every gather-family
+  // collective (block i starts at i * slot_bytes), not the packed size
+  size_t sslot = slot_bytes(sv, scount);
+  size_t rslot = slot_bytes(rv, rcount);
   // post every receive first (the PROC_NULL blocks stay untouched)
   std::vector<Req> reqs(n);
   std::vector<int> handles(n, -1);
