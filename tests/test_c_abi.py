@@ -1248,7 +1248,12 @@ int main(int argc, char **argv) {
   MPI_Type_free(&idx);
   MPI_Barrier(MPI_COMM_WORLD);
   printf("attridx rank %d/%d OK\n", rank, size);
+  /* the finalize-hook idiom: a WORLD attribute's delete callback must
+     fire inside MPI_Finalize (MPI-3.1 8.7.1) */
+  MPI_Comm_set_attr(MPI_COMM_WORLD, kv, (void *)7777);
+  int deletes_before = deletes;
   MPI_Finalize();
+  if (deletes != deletes_before + 1) return 17;
   return 0;
 }
 ''')
@@ -1266,3 +1271,66 @@ int main(int argc, char **argv) {
             out, err = p.communicate(timeout=120)
             assert p.returncode == 0, f"rank {r} failed: {err}\n{out}"
             assert f"attridx rank {r}/{n} OK" in out
+
+    def test_persistent_requests(self, shim, tmp_path):
+        """Persistent requests (send_init.c family): a frozen halo
+        pattern re-Started 5 times; handles survive completion, Wait
+        deactivates, Request_free destroys."""
+        src = tmp_path / "persist.c"
+        src.write_text(r'''
+#include <stdio.h>
+#include "zompi_mpi.h"
+int main(int argc, char **argv) {
+  int rank, size, it;
+  if (MPI_Init(&argc, &argv) != MPI_SUCCESS) return 2;
+  MPI_Comm_rank(MPI_COMM_WORLD, &rank);
+  MPI_Comm_size(MPI_COMM_WORLD, &size);
+  int right = (rank + 1) % size, left = (rank + size - 1) % size;
+  long sbuf, rbuf;
+  MPI_Request reqs[2];
+  /* frozen argument sets: ring shift of a mutating buffer */
+  MPI_Send_init(&sbuf, 1, MPI_LONG, right, 3, MPI_COMM_WORLD, &reqs[0]);
+  MPI_Recv_init(&rbuf, 1, MPI_LONG, left, 3, MPI_COMM_WORLD, &reqs[1]);
+  for (it = 0; it < 5; it++) {
+    sbuf = rank * 100 + it;
+    rbuf = -1;
+    MPI_Startall(2, reqs);
+    MPI_Waitall(2, reqs, MPI_STATUSES_IGNORE);
+    if (rbuf != left * 100 + it) {
+      fprintf(stderr, "rank %d iter %d: rbuf=%ld\n", rank, it, rbuf);
+      return 3;
+    }
+    /* handles must still be valid (not nulled by Wait) */
+    if (reqs[0] == MPI_REQUEST_NULL || reqs[1] == MPI_REQUEST_NULL)
+      return 4;
+  }
+  /* waiting an INACTIVE persistent request returns immediately */
+  if (MPI_Wait(&reqs[0], MPI_STATUS_IGNORE) != MPI_SUCCESS) return 5;
+  /* double-Start without completion is an error */
+  MPI_Start(&reqs[1]);
+  if (MPI_Start(&reqs[1]) == MPI_SUCCESS) return 6;
+  MPI_Send(&sbuf, 1, MPI_LONG, right, 3, MPI_COMM_WORLD); /* match it */
+  MPI_Wait(&reqs[1], MPI_STATUS_IGNORE);
+  if (MPI_Request_free(&reqs[0]) != MPI_SUCCESS) return 7;
+  if (MPI_Request_free(&reqs[1]) != MPI_SUCCESS) return 8;
+  if (reqs[0] != MPI_REQUEST_NULL) return 9;
+  MPI_Barrier(MPI_COMM_WORLD);
+  printf("persist rank %d/%d OK\n", rank, size);
+  MPI_Finalize();
+  return 0;
+}
+''')
+        binpath = tmp_path / "persist"
+        _compile_c(shim, src, binpath)
+        port = _free_port()
+        n = 3
+        procs = [
+            subprocess.Popen([str(binpath)], env=_env(r, n, port),
+                             stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                             text=True)
+            for r in range(n)
+        ]
+        for r, p in enumerate(procs):
+            out, err = p.communicate(timeout=120)
+            assert p.returncode == 0, f"rank {r} failed: {err}\n{out}"
+            assert f"persist rank {r}/{n} OK" in out

@@ -2064,7 +2064,13 @@ int MPI_Initialized(int *flag) {
   return MPI_SUCCESS;
 }
 
+void finalize_attr_sweep(void);  // defined with the attribute machinery
+
 int MPI_Finalize(void) {
+  // Attribute delete callbacks fire for EVERY comm that still carries
+  // attributes — including WORLD/SELF, the canonical library
+  // finalize-hook idiom (MPI-3.1 §8.7.1 requires these deletions)
+  finalize_attr_sweep();
   // Tear down without an implicit barrier: MPI allows but does not
   // require Finalize to synchronize, and an implicit barrier would
   // deadlock mixed C/Python jobs whose Python endpoints close() without
@@ -2223,11 +2229,54 @@ struct KeyvalObj {
   MPI_Comm_copy_attr_function *copy_fn;
   MPI_Comm_delete_attr_function *delete_fn;
   void *extra_state;
+  // MPI-3.1 6.7.2: a freed keyval's callbacks stay in effect until the
+  // last attribute referencing it is deleted
+  bool freed = false;
 };
 std::map<int, KeyvalObj> g_keyvals;
 int g_next_keyval = 0;
 // (comm handle, keyval) -> attribute pointer
 std::map<std::pair<int, int>, void *> g_attrs;
+
+bool keyval_referenced(int keyval) {
+  for (auto &e : g_attrs)
+    if (e.first.second == keyval) return true;
+  return false;
+}
+
+void reap_keyval(int keyval) {
+  auto it = g_keyvals.find(keyval);
+  if (it != g_keyvals.end() && it->second.freed &&
+      !keyval_referenced(keyval))
+    g_keyvals.erase(it);
+}
+
+// delete every attribute cached on `comm`, running the delete
+// callbacks (comm_free.c order); shared by Comm_free, the Comm_dup
+// error unwind, and the Finalize sweep
+void delete_comm_attrs(int comm) {
+  for (auto it = g_attrs.begin(); it != g_attrs.end();) {
+    if (it->first.first == comm) {
+      int kvid = it->first.second;
+      auto kv = g_keyvals.find(kvid);
+      if (kv != g_keyvals.end() && kv->second.delete_fn)
+        kv->second.delete_fn(comm, kvid, it->second,
+                             kv->second.extra_state);
+      it = g_attrs.erase(it);
+      reap_keyval(kvid);
+    } else {
+      ++it;
+    }
+  }
+}
+
+void finalize_attr_sweep(void) {
+  std::vector<int> with_attrs;
+  for (auto &e : g_attrs)
+    if (with_attrs.empty() || with_attrs.back() != e.first.first)
+      with_attrs.push_back(e.first.first);
+  for (int comm : with_attrs) delete_comm_attrs(comm);
+}
 
 int MPI_Comm_create_keyval(MPI_Comm_copy_attr_function *copy_fn,
                            MPI_Comm_delete_attr_function *delete_fn,
@@ -2240,7 +2289,12 @@ int MPI_Comm_create_keyval(MPI_Comm_copy_attr_function *copy_fn,
 }
 
 int MPI_Comm_free_keyval(int *keyval) {
-  if (!keyval || !g_keyvals.erase(*keyval)) return MPI_ERR_ARG;
+  if (!keyval) return MPI_ERR_ARG;
+  auto it = g_keyvals.find(*keyval);
+  if (it == g_keyvals.end()) return MPI_ERR_ARG;
+  // callbacks stay live while attributes still reference the keyval
+  it->second.freed = true;
+  reap_keyval(*keyval);
   *keyval = MPI_KEYVAL_INVALID;
   return MPI_SUCCESS;
 }
@@ -2248,7 +2302,7 @@ int MPI_Comm_free_keyval(int *keyval) {
 int MPI_Comm_set_attr(MPI_Comm comm, int keyval, void *attribute_val) {
   if (!lookup_comm(comm)) return MPI_ERR_COMM;
   auto kv = g_keyvals.find(keyval);
-  if (kv == g_keyvals.end()) return MPI_ERR_ARG;
+  if (kv == g_keyvals.end() || kv->second.freed) return MPI_ERR_ARG;
   auto key = std::make_pair(comm, keyval);
   auto it = g_attrs.find(key);
   if (it != g_attrs.end() && kv->second.delete_fn) {
@@ -2280,6 +2334,7 @@ int MPI_Comm_delete_attr(MPI_Comm comm, int keyval) {
     if (rc != MPI_SUCCESS) return rc;
   }
   g_attrs.erase(it);
+  reap_keyval(keyval);
   return MPI_SUCCESS;
 }
 
@@ -2308,17 +2363,7 @@ int MPI_Comm_dup(MPI_Comm comm, MPI_Comm *newcomm) {
     if (rc != MPI_SUCCESS) {
       // unwind: already-copied attrs get their delete callbacks, then
       // the half-built comm dies (comm_dup.c's error contract)
-      for (auto it = g_attrs.begin(); it != g_attrs.end();) {
-        if (it->first.first == handle) {
-          auto dkv = g_keyvals.find(it->first.second);
-          if (dkv != g_keyvals.end() && dkv->second.delete_fn)
-            dkv->second.delete_fn(handle, it->first.second, it->second,
-                                  dkv->second.extra_state);
-          it = g_attrs.erase(it);
-        } else {
-          ++it;
-        }
-      }
+      delete_comm_attrs(handle);
       g_comms.erase(handle);
       return rc;
     }
@@ -2332,17 +2377,7 @@ int MPI_Comm_free(MPI_Comm *comm) {
     return MPI_ERR_COMM;
   if (!g_comms.count(*comm)) return MPI_ERR_COMM;
   // delete callbacks run BEFORE the handle dies (comm_free.c order)
-  for (auto it = g_attrs.begin(); it != g_attrs.end();) {
-    if (it->first.first == *comm) {
-      auto kv = g_keyvals.find(it->first.second);
-      if (kv != g_keyvals.end() && kv->second.delete_fn)
-        kv->second.delete_fn(*comm, it->first.second, it->second,
-                             kv->second.extra_state);
-      it = g_attrs.erase(it);
-    } else {
-      ++it;
-    }
-  }
+  delete_comm_attrs(*comm);
   g_comms.erase(*comm);
   *comm = MPI_COMM_NULL;
   return MPI_SUCCESS;
@@ -2712,6 +2747,95 @@ int MPI_Irecv(void *buf, int count, MPI_Datatype dt, int source, int tag,
   return MPI_SUCCESS;
 }
 
+// ------------------------------------------------- persistent requests
+// send_init.c / recv_init.c: the argument set is frozen once, Start
+// re-fires it.  Persistent handles are NEGATIVE (disjoint from the
+// active-request space), stay allocated across completions (Wait
+// deactivates, never frees), and die at MPI_Request_free.
+
+struct PersistentReq {
+  bool is_recv;
+  const void *sbuf;
+  void *rbuf;
+  int count;
+  MPI_Datatype dt;
+  int peer;
+  int tag;
+  MPI_Comm comm;
+  MPI_Request active = MPI_REQUEST_NULL;  // inner handle when started
+};
+std::map<int, PersistentReq> g_persistent;
+int g_next_persistent = 2;  // public handle = -id (MPI_REQUEST_NULL=-1)
+
+int MPI_Send_init(const void *buf, int count, MPI_Datatype dt, int dest,
+                  int tag, MPI_Comm comm, MPI_Request *request) {
+  CommObj *c = lookup_comm(comm);
+  if (!c) return MPI_ERR_COMM;
+  if (dest != MPI_PROC_NULL &&
+      (dest < 0 || dest >= (int)c->group.size()))
+    return MPI_ERR_ARG;
+  int id = g_next_persistent++;
+  g_persistent[id] = {false, buf, nullptr, count, dt, dest, tag, comm};
+  *request = -id;
+  return MPI_SUCCESS;
+}
+
+int MPI_Recv_init(void *buf, int count, MPI_Datatype dt, int source,
+                  int tag, MPI_Comm comm, MPI_Request *request) {
+  CommObj *c = lookup_comm(comm);
+  if (!c) return MPI_ERR_COMM;
+  if (source != MPI_ANY_SOURCE && source != MPI_PROC_NULL &&
+      (source < 0 || source >= (int)c->group.size()))
+    return MPI_ERR_ARG;
+  int id = g_next_persistent++;
+  g_persistent[id] = {true, nullptr, buf, count, dt, source, tag, comm};
+  *request = -id;
+  return MPI_SUCCESS;
+}
+
+int MPI_Start(MPI_Request *request) {
+  if (!request || *request >= MPI_REQUEST_NULL) return MPI_ERR_REQUEST;
+  auto it = g_persistent.find(-*request);
+  if (it == g_persistent.end()) return MPI_ERR_REQUEST;
+  PersistentReq &p = it->second;
+  if (p.active != MPI_REQUEST_NULL) return MPI_ERR_REQUEST;  // running
+  return p.is_recv
+             ? MPI_Irecv(p.rbuf, p.count, p.dt, p.peer, p.tag, p.comm,
+                         &p.active)
+             : MPI_Isend(p.sbuf, p.count, p.dt, p.peer, p.tag, p.comm,
+                         &p.active);
+}
+
+int MPI_Startall(int count, MPI_Request requests[]) {
+  for (int i = 0; i < count; i++) {
+    int rc = MPI_Start(&requests[i]);
+    if (rc != MPI_SUCCESS) return rc;
+  }
+  return MPI_SUCCESS;
+}
+
+int MPI_Request_free(MPI_Request *request) {
+  if (!request || *request == MPI_REQUEST_NULL) return MPI_ERR_REQUEST;
+  if (*request < MPI_REQUEST_NULL) {
+    auto it = g_persistent.find(-*request);
+    if (it == g_persistent.end()) return MPI_ERR_REQUEST;
+    if (it->second.active != MPI_REQUEST_NULL)
+      return MPI_ERR_REQUEST;  // complete it first (the safe subset)
+    g_persistent.erase(it);
+    *request = MPI_REQUEST_NULL;
+    return MPI_SUCCESS;
+  }
+  // non-persistent: only a completed request may be freed here
+  std::lock_guard<std::mutex> lk(g.match_mu);
+  auto it = g.reqs.find(*request);
+  if (it == g.reqs.end() || !it->second->complete) return MPI_ERR_REQUEST;
+  Req *r = it->second;
+  g.reqs.erase(it);
+  if (r->heap) delete r;
+  *request = MPI_REQUEST_NULL;
+  return MPI_SUCCESS;
+}
+
 int MPI_Wait(MPI_Request *request, MPI_Status *status) {
   if (!request || *request == MPI_REQUEST_NULL) {
     if (status) {
@@ -2721,6 +2845,20 @@ int MPI_Wait(MPI_Request *request, MPI_Status *status) {
       status->_count = 0;
     }
     return MPI_SUCCESS;
+  }
+  if (*request < MPI_REQUEST_NULL) {
+    // persistent: wait the inner active op, DEACTIVATE but never free
+    auto it = g_persistent.find(-*request);
+    if (it == g_persistent.end()) return MPI_ERR_REQUEST;
+    PersistentReq &p = it->second;
+    if (p.active == MPI_REQUEST_NULL) {
+      // inactive persistent request: empty status, immediate return
+      MPI_Request null_req = MPI_REQUEST_NULL;
+      return MPI_Wait(&null_req, status);
+    }
+    int rc = MPI_Wait(&p.active, status);
+    p.active = MPI_REQUEST_NULL;
+    return rc;  // handle stays valid for the next Start
   }
   int comm_handle;
   {
@@ -2743,6 +2881,19 @@ int MPI_Test(MPI_Request *request, int *flag, MPI_Status *status) {
   if (!request || *request == MPI_REQUEST_NULL) {
     *flag = 1;
     return MPI_SUCCESS;
+  }
+  if (*request < MPI_REQUEST_NULL) {
+    auto it = g_persistent.find(-*request);
+    if (it == g_persistent.end()) return MPI_ERR_REQUEST;
+    PersistentReq &p = it->second;
+    if (p.active == MPI_REQUEST_NULL) {
+      *flag = 1;
+      return MPI_SUCCESS;
+    }
+    *flag = 0;
+    int rc = MPI_Test(&p.active, flag, status);
+    if (rc == MPI_SUCCESS && *flag) p.active = MPI_REQUEST_NULL;
+    return rc;
   }
   {
     std::lock_guard<std::mutex> lk(g.match_mu);
@@ -2890,6 +3041,7 @@ int MPI_Type_vector(int count, int blocklength, int stride,
   DtypeObj d;
   d.base = v.derived ? v.derived->base : oldtype;
   int64_t old_extent = v.derived ? v.derived->extent : 1;
+  d.lb = v.derived ? v.derived->lb : 0;  // min disp is 0; inner lb adds
   int64_t max_off = 0;
   for (int c = 0; c < count; c++) {
     for (int b = 0; b < blocklength; b++) {
@@ -2924,21 +3076,30 @@ int MPI_Type_indexed(int count, const int blocklengths[],
   DtypeObj d;
   d.base = v.derived ? v.derived->base : oldtype;
   int64_t old_extent = v.derived ? v.derived->extent : 1;
+  int64_t old_lb = v.derived ? v.derived->lb : 0;
   int64_t max_off = 0, min_off = INT64_MAX;
   int64_t total = 0;
   for (int c = 0; c < count; c++) {
     if (blocklengths[c] < 0) return MPI_ERR_ARG;
-    for (int b = 0; b < blocklengths[c]; b++) {
-      int64_t off = ((int64_t)displacements[c] + b) * old_extent;
+    if (blocklengths[c] == 0) continue;
+    if (!v.derived) {
+      // predefined oldtype: the whole block is ONE contiguous run
+      int64_t off = (int64_t)displacements[c];
       if (off < 0) return MPI_ERR_ARG;  // negative disp unsupported
-      if (v.derived) {
+      d.blocks.push_back({off, blocklengths[c]});
+      int64_t end = off + blocklengths[c];
+      if (end > max_off) max_off = end;
+      if (off < min_off) min_off = off;
+    } else {
+      for (int b = 0; b < blocklengths[c]; b++) {
+        int64_t off = ((int64_t)displacements[c] + b) * old_extent;
+        if (off < 0) return MPI_ERR_ARG;
         for (auto &bb : v.derived->blocks)
           d.blocks.push_back({off + bb.first, bb.second});
-      } else {
-        d.blocks.push_back({off, 1});
+        if (off + old_lb + old_extent > max_off)
+          max_off = off + old_lb + old_extent;
+        if (off + old_lb < min_off) min_off = off + old_lb;
       }
-      if (off + old_extent > max_off) max_off = off + old_extent;
-      if (off < min_off) min_off = off;
     }
     total += blocklengths[c];
   }
@@ -2946,9 +3107,9 @@ int MPI_Type_indexed(int count, const int blocklengths[],
   // typemap order is DECLARATION order (pack serializes in this order,
   // MPI-3.1 §4.1) — never sort; coalescing only merges adjacent runs
   coalesce_blocks(d.blocks);
-  // extent = ub - lb (MPI-3.1 §4.1.6): a nonzero minimum displacement
-  // shrinks the per-item stride; block offsets stay ABSOLUTE, so item
-  // k's typemap is d_i + k*extent, exactly the standard's concatenation
+  // extent = ub - lb (MPI-3.1 §4.1.6), the oldtype's own lb included;
+  // block offsets stay ABSOLUTE, so item k's typemap is d_i + k*extent,
+  // exactly the standard's concatenation
   d.lb = min_off;
   d.extent = max_off - min_off;
   d.elems = total * v.elems_per_item();

@@ -205,6 +205,16 @@ int MPI_Waitany(int count, MPI_Request requests[], int *index,
 int MPI_Testall(int count, MPI_Request requests[], int *flag,
                 MPI_Status statuses[]);
 
+/* persistent requests (send_init.c family); supported through
+ * Start/Startall + Wait/Test/Waitall (not Waitany/Testall) */
+int MPI_Send_init(const void *buf, int count, MPI_Datatype dt, int dest,
+                  int tag, MPI_Comm comm, MPI_Request *request);
+int MPI_Recv_init(void *buf, int count, MPI_Datatype dt, int source,
+                  int tag, MPI_Comm comm, MPI_Request *request);
+int MPI_Start(MPI_Request *request);
+int MPI_Startall(int count, MPI_Request requests[]);
+int MPI_Request_free(MPI_Request *request);
+
 /* probe */
 int MPI_Probe(int source, int tag, MPI_Comm comm, MPI_Status *status);
 int MPI_Iprobe(int source, int tag, MPI_Comm comm, int *flag,
