@@ -1,0 +1,68 @@
+/* osu_c — OSU-style ping-pong over the C ABI shim (the C-plane analog
+ * of benchmarks/osu_zmpi.py --op tcp): quantifies the shim's engine
+ * (drain threads, posted-receive matching, DSS framing) without the
+ * Python interpreter in the data path.
+ *
+ *   python -m zhpe_ompi_tpu.tools.zmpicc benchmarks/osu_c.c -o osu_c
+ *   python -m zhpe_ompi_tpu.tools.mpirun -n 2 ./osu_c
+ *
+ * Prints one line per size: bytes, one-way latency (us), bandwidth
+ * (MB/s), median of 5 reps of `iters` round trips each.
+ */
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include "zompi_mpi.h"
+
+static int cmp_double(const void *a, const void *b) {
+  double d = *(const double *)a - *(const double *)b;
+  return d < 0 ? -1 : d > 0 ? 1 : 0;
+}
+
+int main(int argc, char **argv) {
+  int rank, size;
+  if (MPI_Init(&argc, &argv) != MPI_SUCCESS) return 2;
+  MPI_Comm_rank(MPI_COMM_WORLD, &rank);
+  MPI_Comm_size(MPI_COMM_WORLD, &size);
+  if (size != 2) {
+    if (rank == 0) fprintf(stderr, "osu_c needs exactly 2 ranks\n");
+    MPI_Finalize();
+    return 1;
+  }
+  size_t sizes[] = {8, 64, 1024, 4096, 16384, 65536, 262144, 1048576,
+                    4194304};
+  char *buf = malloc(sizes[8]);
+  memset(buf, 7, sizes[8]);
+  for (int s = 0; s < 9; s++) {
+    size_t n = sizes[s];
+    int iters = n <= 4096 ? 200 : n <= 65536 ? 80 : 20;
+    double reps[5];
+    for (int rep = 0; rep < 5; rep++) {
+      MPI_Barrier(MPI_COMM_WORLD);
+      double t0 = MPI_Wtime();
+      for (int it = 0; it < iters; it++) {
+        if (rank == 0) {
+          MPI_Send(buf, (int)n, MPI_BYTE, 1, 1, MPI_COMM_WORLD);
+          MPI_Recv(buf, (int)n, MPI_BYTE, 1, 2, MPI_COMM_WORLD,
+                   MPI_STATUS_IGNORE);
+        } else {
+          MPI_Recv(buf, (int)n, MPI_BYTE, 0, 1, MPI_COMM_WORLD,
+                   MPI_STATUS_IGNORE);
+          MPI_Send(buf, (int)n, MPI_BYTE, 0, 2, MPI_COMM_WORLD);
+        }
+      }
+      reps[rep] = (MPI_Wtime() - t0) / (2.0 * iters);  /* one-way s */
+    }
+    if (rank == 0) {
+      qsort(reps, 5, sizeof(double), cmp_double);
+      double lat = reps[2];  /* median */
+      printf("{\"op\": \"c_pingpong\", \"bytes\": %zu, "
+             "\"latency_us\": %.2f, \"bandwidth_MBps\": %.1f}\n",
+             n, lat * 1e6, n / lat / 1e6);
+      fflush(stdout);
+    }
+  }
+  free(buf);
+  MPI_Finalize();
+  return 0;
+}

@@ -1334,3 +1334,73 @@ int main(int argc, char **argv) {
             out, err = p.communicate(timeout=120)
             assert p.returncode == 0, f"rank {r} failed: {err}\n{out}"
             assert f"persist rank {r}/{n} OK" in out
+
+    def test_pscw_epochs(self, shim, tmp_path):
+        """PSCW generalized active target (win_post.c family): even
+        ranks access their odd right-neighbor's window in a
+        start/complete epoch the target brackets with post/wait — no
+        global fence involved."""
+        src = tmp_path / "pscw.c"
+        src.write_text(r'''
+#include <stdio.h>
+#include <stdlib.h>
+#include "zompi_mpi.h"
+int main(int argc, char **argv) {
+  int rank, size;
+  if (MPI_Init(&argc, &argv) != MPI_SUCCESS) return 2;
+  MPI_Comm_rank(MPI_COMM_WORLD, &rank);
+  MPI_Comm_size(MPI_COMM_WORLD, &size);
+  if (size % 2) { /* pairs required */ MPI_Finalize(); return 0; }
+  long *base = 0;
+  MPI_Win win;
+  MPI_Win_allocate(4 * sizeof(long), sizeof(long), MPI_INFO_NULL,
+                   MPI_COMM_WORLD, &base, &win);
+  for (int i = 0; i < 4; i++) base[i] = -1;
+  MPI_Barrier(MPI_COMM_WORLD);
+  MPI_Group world_grp;
+  MPI_Comm_group(MPI_COMM_WORLD, &world_grp);
+  if (rank % 2 == 0) {
+    /* origin: access epoch toward the odd partner */
+    int partner = rank + 1;
+    MPI_Group tgt;
+    MPI_Group_incl(world_grp, 1, &partner, &tgt);
+    MPI_Win_start(tgt, 0, win);
+    long vals[4];
+    for (int i = 0; i < 4; i++) vals[i] = rank * 100 + i;
+    /* target addressing uses the window comm's ranks */
+    MPI_Put(vals, 4, MPI_LONG, partner, 0, 4, MPI_LONG, win);
+    MPI_Win_complete(win);
+  } else {
+    /* target: exposure epoch to the even partner */
+    int partner = rank - 1;
+    MPI_Group org;
+    MPI_Group_incl(world_grp, 1, &partner, &org);
+    MPI_Win_post(org, 0, win);
+    MPI_Win_wait(win);
+    for (int i = 0; i < 4; i++)
+      if (base[i] != (rank - 1) * 100 + i) {
+        fprintf(stderr, "rank %d: base[%d]=%ld\n", rank, i, base[i]);
+        return 3;
+      }
+  }
+  MPI_Win_free(&win);
+  MPI_Barrier(MPI_COMM_WORLD);
+  printf("pscw rank %d/%d OK\n", rank, size);
+  MPI_Finalize();
+  return 0;
+}
+''')
+        binpath = tmp_path / "pscw"
+        _compile_c(shim, src, binpath)
+        port = _free_port()
+        n = 4
+        procs = [
+            subprocess.Popen([str(binpath)], env=_env(r, n, port),
+                             stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                             text=True)
+            for r in range(n)
+        ]
+        for r, p in enumerate(procs):
+            out, err = p.communicate(timeout=120)
+            assert p.returncode == 0, f"rank {r} failed: {err}\n{out}"
+            assert f"pscw rank {r}/{n} OK" in out

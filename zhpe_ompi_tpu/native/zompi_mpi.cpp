@@ -1296,6 +1296,10 @@ struct WinObj {
   int lock_excl_holder = -1;        // world rank or -1
   int lock_shared = 0;              // count of shared holders
   std::deque<std::array<int64_t, 3>> lock_waiters;  // (origin, type, rtag)
+  // PSCW epochs: the start group (targets we access) and post group
+  // (origins exposed to), world ranks
+  std::vector<int> pscw_start;
+  std::vector<int> pscw_post;
 };
 
 std::map<int64_t, WinObj *> g_wins;      // wire win-id -> obj
@@ -2271,6 +2275,9 @@ void delete_comm_attrs(int comm) {
 }
 
 void finalize_attr_sweep(void) {
+  // MPI-3.1 8.7.1: Finalize behaves as if MPI_COMM_FREE(COMM_SELF) is
+  // executed FIRST — the finalize-hook ordering libraries rely on
+  delete_comm_attrs(MPI_COMM_SELF);
   std::vector<int> with_attrs;
   for (auto &e : g_attrs)
     if (with_attrs.empty() || with_attrs.back() != e.first.first)
@@ -2767,6 +2774,19 @@ struct PersistentReq {
 std::map<int, PersistentReq> g_persistent;
 int g_next_persistent = 2;  // public handle = -id (MPI_REQUEST_NULL=-1)
 
+// MPI allows MPI_Type_free between init and Start: pin the typemap by
+// registering a PRIVATE duplicate handle the request owns (freed with
+// the request), so the user's handle may die independently.
+static MPI_Datatype pin_dtype(MPI_Datatype dt) {
+  if (dt < DERIVED_BASE) return dt;  // predefined: nothing to pin
+  auto it = g_dtypes.find(dt);
+  if (it == g_dtypes.end()) return MPI_DATATYPE_NULL;
+  MPI_Datatype priv = g_next_dtype++;
+  g_dtypes[priv] = it->second;
+  g_dtypes[priv].committed = true;
+  return priv;
+}
+
 int MPI_Send_init(const void *buf, int count, MPI_Datatype dt, int dest,
                   int tag, MPI_Comm comm, MPI_Request *request) {
   CommObj *c = lookup_comm(comm);
@@ -2774,8 +2794,11 @@ int MPI_Send_init(const void *buf, int count, MPI_Datatype dt, int dest,
   if (dest != MPI_PROC_NULL &&
       (dest < 0 || dest >= (int)c->group.size()))
     return MPI_ERR_ARG;
+  MPI_Datatype pinned = pin_dtype(dt);
+  if (pinned == MPI_DATATYPE_NULL) return MPI_ERR_TYPE;
   int id = g_next_persistent++;
-  g_persistent[id] = {false, buf, nullptr, count, dt, dest, tag, comm};
+  g_persistent[id] = {false, buf, nullptr, count, pinned, dest, tag,
+                      comm};
   *request = -id;
   return MPI_SUCCESS;
 }
@@ -2787,8 +2810,11 @@ int MPI_Recv_init(void *buf, int count, MPI_Datatype dt, int source,
   if (source != MPI_ANY_SOURCE && source != MPI_PROC_NULL &&
       (source < 0 || source >= (int)c->group.size()))
     return MPI_ERR_ARG;
+  MPI_Datatype pinned = pin_dtype(dt);
+  if (pinned == MPI_DATATYPE_NULL) return MPI_ERR_TYPE;
   int id = g_next_persistent++;
-  g_persistent[id] = {true, nullptr, buf, count, dt, source, tag, comm};
+  g_persistent[id] = {true, nullptr, buf, count, pinned, source, tag,
+                      comm};
   *request = -id;
   return MPI_SUCCESS;
 }
@@ -2821,16 +2847,25 @@ int MPI_Request_free(MPI_Request *request) {
     if (it == g_persistent.end()) return MPI_ERR_REQUEST;
     if (it->second.active != MPI_REQUEST_NULL)
       return MPI_ERR_REQUEST;  // complete it first (the safe subset)
+    if (it->second.dt >= DERIVED_BASE)
+      g_dtypes.erase(it->second.dt);  // the request's private pin
     g_persistent.erase(it);
     *request = MPI_REQUEST_NULL;
     return MPI_SUCCESS;
   }
   // non-persistent: only a completed request may be freed here
-  std::lock_guard<std::mutex> lk(g.match_mu);
-  auto it = g.reqs.find(*request);
-  if (it == g.reqs.end() || !it->second->complete) return MPI_ERR_REQUEST;
-  Req *r = it->second;
-  g.reqs.erase(it);
+  Req *r;
+  {
+    std::lock_guard<std::mutex> lk(g.match_mu);
+    auto it = g.reqs.find(*request);
+    if (it == g.reqs.end() || !it->second->complete)
+      return MPI_ERR_REQUEST;
+    r = it->second;
+    g.reqs.erase(it);
+  }
+  // the receive must still complete into the user buffer (MPI-3.1
+  // 3.7.3): a derived-type recv parked in scratch gets its unpack
+  finish_recv(r);
   if (r->heap) delete r;
   *request = MPI_REQUEST_NULL;
   return MPI_SUCCESS;
@@ -2888,6 +2923,12 @@ int MPI_Test(MPI_Request *request, int *flag, MPI_Status *status) {
     PersistentReq &p = it->second;
     if (p.active == MPI_REQUEST_NULL) {
       *flag = 1;
+      if (status) {
+        status->MPI_SOURCE = MPI_ANY_SOURCE;
+        status->MPI_TAG = MPI_ANY_TAG;
+        status->MPI_ERROR = MPI_SUCCESS;
+        status->_count = 0;
+      }
       return MPI_SUCCESS;
     }
     *flag = 0;
@@ -4720,6 +4761,118 @@ int MPI_Win_flush(int rank, MPI_Win win) {
 }
 
 int MPI_Win_flush_all(MPI_Win win) { return zompi_win_flush(win); }
+
+// PSCW active-target epochs (win_post.c family; the AM plane's
+// identity-checked PSCW): post/complete notifications are plain empty
+// messages on WIN_CID in tag ranges disjoint from the RPC reply tags.
+
+namespace {
+
+constexpr int64_t PSCW_POST_BASE = 1LL << 40;
+constexpr int64_t PSCW_DONE_BASE = 1LL << 41;
+
+int pscw_notify(int tw, int64_t tag) {
+  if (tw == g.rank) {
+    Message m;
+    m.src = g.rank;
+    m.tag = tag;
+    m.cid = WIN_CID;
+    m.seq = g.seq++;
+    push_message(std::move(m));
+    return MPI_SUCCESS;
+  }
+  int fd = endpoint(tw);
+  if (fd < 0) return MPI_ERR_OTHER;
+  std::string f;
+  put_varint(f, 5);
+  put_int(f, g.rank);
+  put_int(f, tag);
+  put_int(f, WIN_CID);
+  put_int(f, g.seq++);
+  put_bytes(f, "", 0);
+  std::lock_guard<std::mutex> lk(g.send_mu);
+  return send_frame(fd, f) ? MPI_SUCCESS : MPI_ERR_OTHER;
+}
+
+int pscw_await(int from_world, int64_t tag) {
+  Req r;
+  char dummy;
+  r.is_recv = true;
+  r.user_buf = &dummy;
+  r.count = 0;
+  DtView bv;
+  bv.di = {"|u1", 1};
+  int handle = post_recv(&r, bv, WIN_CID, from_world, tag);
+  MPI_Status st{};
+  return wait_handle_impl(handle, &st);  // epochs legally wait
+}
+
+}  // namespace
+
+int MPI_Win_post(MPI_Group group, int /*assert_*/, MPI_Win win) {
+  int64_t wid;
+  WinObj *w = lookup_win(win, &wid);
+  if (!w) return MPI_ERR_WIN;
+  GroupObj *gr = lookup_group(group);
+  if (!gr) return MPI_ERR_GROUP;
+  if (!w->pscw_post.empty()) return MPI_ERR_ARG;  // epoch already open
+  w->pscw_post = gr->ranks;
+  for (int tw : w->pscw_post) {
+    int rc = pscw_notify(tw, PSCW_POST_BASE + wid);
+    if (rc != MPI_SUCCESS) {
+      w->pscw_post.clear();  // a wedged epoch would block forever
+      return rc;
+    }
+  }
+  return MPI_SUCCESS;  // post never blocks (win_post.c)
+}
+
+int MPI_Win_start(MPI_Group group, int /*assert_*/, MPI_Win win) {
+  int64_t wid;
+  WinObj *w = lookup_win(win, &wid);
+  if (!w) return MPI_ERR_WIN;
+  GroupObj *gr = lookup_group(group);
+  if (!gr) return MPI_ERR_GROUP;
+  if (!w->pscw_start.empty()) return MPI_ERR_ARG;
+  w->pscw_start = gr->ranks;
+  // access epoch opens when every target has exposed (start MAY block)
+  for (int tw : w->pscw_start) {
+    int rc = pscw_await(tw, PSCW_POST_BASE + wid);
+    if (rc != MPI_SUCCESS) return rc;
+  }
+  return MPI_SUCCESS;
+}
+
+int MPI_Win_complete(MPI_Win win) {
+  int64_t wid;
+  WinObj *w = lookup_win(win, &wid);
+  if (!w) return MPI_ERR_WIN;
+  if (w->pscw_start.empty()) return MPI_ERR_ARG;
+  // ops must be APPLIED at the targets before the completion signal.
+  // The epoch closes WHATEVER happens below: leaving pscw_start set
+  // would let a retry re-send DONE to targets that already got one,
+  // and a stale DONE would terminate their NEXT exposure epoch early.
+  int rc = zompi_win_flush(win);
+  for (int tw : w->pscw_start) {
+    if (rc != MPI_SUCCESS) break;  // don't signal unflushed ops
+    rc = pscw_notify(tw, PSCW_DONE_BASE + wid);
+  }
+  w->pscw_start.clear();
+  return rc;
+}
+
+int MPI_Win_wait(MPI_Win win) {
+  int64_t wid;
+  WinObj *w = lookup_win(win, &wid);
+  if (!w) return MPI_ERR_WIN;
+  if (w->pscw_post.empty()) return MPI_ERR_ARG;
+  for (int ow : w->pscw_post) {
+    int rc = pscw_await(ow, PSCW_DONE_BASE + wid);
+    if (rc != MPI_SUCCESS) return rc;
+  }
+  w->pscw_post.clear();
+  return MPI_SUCCESS;
+}
 
 int MPI_Fetch_and_op(const void *origin_addr, void *result_addr,
                      MPI_Datatype dt, int target_rank,

@@ -419,6 +419,10 @@ int MPI_Win_lock(int lock_type, int rank, int assert_, MPI_Win win);
 int MPI_Win_unlock(int rank, MPI_Win win);
 int MPI_Win_flush(int rank, MPI_Win win);
 int MPI_Win_flush_all(MPI_Win win);
+int MPI_Win_post(MPI_Group group, int assert_, MPI_Win win);
+int MPI_Win_start(MPI_Group group, int assert_, MPI_Win win);
+int MPI_Win_complete(MPI_Win win);
+int MPI_Win_wait(MPI_Win win);
 int MPI_Win_free(MPI_Win *win);
 int MPI_Put(const void *origin_addr, int origin_count,
             MPI_Datatype origin_datatype, int target_rank,
