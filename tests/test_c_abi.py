@@ -1110,6 +1110,24 @@ int main(int argc, char **argv) {
   long dummy = 1, dres = -1;
   if (MPI_Fetch_and_op(&dummy, &dres, MPI_LONG, MPI_PROC_NULL, 0,
                        MPI_SUM, win) != MPI_SUCCESS) return 13;
+  /* multi-element Get_accumulate: atomically fetch BOTH cells while
+     adding {5,5}; then a NO_OP fetch of the pair */
+  MPI_Barrier(MPI_COMM_WORLD);
+  if (rank == 0) { base[0] = 3; base[1] = 4; }
+  MPI_Barrier(MPI_COMM_WORLD);
+  if (rank == 1 || size == 1) {
+    long add2[2] = {5, 5}, got2[2] = {-1, -1};
+    if (MPI_Get_accumulate(add2, 2, MPI_LONG, got2, 2, MPI_LONG, 0, 0,
+                           2, MPI_LONG, MPI_SUM, win) != MPI_SUCCESS)
+      return 17;
+    if (got2[0] != 3 || got2[1] != 4) return 18;
+    long seen2[2] = {-1, -1};
+    if (MPI_Get_accumulate(NULL, 0, MPI_LONG, seen2, 2, MPI_LONG, 0, 0,
+                           2, MPI_LONG, MPI_NO_OP, win) != MPI_SUCCESS)
+      return 19;
+    if (seen2[0] != 8 || seen2[1] != 9) return 20;
+  }
+  MPI_Barrier(MPI_COMM_WORLD);
   MPI_Win_free(&win);
   /* ---- neighbor collectives on a periodic ring ---- */
   int dims[1] = {size}, periods[1] = {1};

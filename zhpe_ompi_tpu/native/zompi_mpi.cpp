@@ -1297,9 +1297,12 @@ struct WinObj {
   int lock_shared = 0;              // count of shared holders
   std::deque<std::array<int64_t, 3>> lock_waiters;  // (origin, type, rtag)
   // PSCW epochs: the start group (targets we access) and post group
-  // (origins exposed to), world ranks
+  // (origins exposed to), world ranks.  The open flags distinguish an
+  // EMPTY epoch (legal, MPI_GROUP_EMPTY) from no epoch at all.
   std::vector<int> pscw_start;
   std::vector<int> pscw_post;
+  bool pscw_start_open = false;
+  bool pscw_post_open = false;
 };
 
 std::map<int64_t, WinObj *> g_wins;      // wire win-id -> obj
@@ -1375,33 +1378,45 @@ std::vector<std::array<int64_t, 3>> release_and_grants(WinObj *w,
 // validates displacement and operand shape, applies under the window
 // lock, fills `old` with the pre-op value.  subkind: add | set | swap |
 // cas ([compare][value] operand) | fetch (no operand) | "aop:<N>"
-// (cell = cell OP operand for predefined op N — the MPI_Fetch_and_op
-// general form; user ops are rejected at the origin, per MPI).
+// (cell = cell OP operand for predefined op N).  Every subkind except
+// cas operates on `nelems` elements atomically — the Get_accumulate
+// general form; fetch takes nelems from the caller since it has no
+// operand.  User ops are rejected at the origin, per MPI.
 bool apply_amo(WinObj *w, int64_t disp, const std::string &sub,
                MPI_Datatype dt, const char *opnd, size_t opnd_len,
-               std::vector<char> &old) {
+               std::vector<char> &old, int64_t fetch_elems = 1) {
   DtInfo di;
   if (!base_dtinfo(dt, di)) return false;
-  if (disp < 0 || disp + (int64_t)di.item > w->size) return false;
-  size_t need = sub == "cas" ? 2 * di.item
-                : sub == "fetch" ? 0
-                                 : di.item;
-  if (opnd_len != need || (need > 0 && opnd == nullptr)) return false;
-  old.resize(di.item);
+  int64_t nelems;
+  if (sub == "cas") {
+    if (opnd_len != 2 * di.item || opnd == nullptr) return false;
+    nelems = 1;
+  } else if (sub == "fetch") {
+    if (opnd_len != 0) return false;
+    nelems = fetch_elems;
+  } else {
+    if (opnd_len == 0 || opnd == nullptr || opnd_len % di.item)
+      return false;
+    nelems = (int64_t)(opnd_len / di.item);
+  }
+  if (nelems <= 0 || disp < 0 || disp + nelems * (int64_t)di.item > w->size)
+    return false;
+  old.resize((size_t)nelems * di.item);
   std::lock_guard<std::mutex> lk(w->mu);
   char *cell = w->base + disp;
-  memcpy(old.data(), cell, di.item);
+  memcpy(old.data(), cell, old.size());
   if (sub == "add") {
-    reduce_buf(cell, opnd, 1, dt, MPI_SUM);
+    reduce_buf(cell, opnd, (int)nelems, dt, MPI_SUM);
   } else if (sub == "set" || sub == "swap") {
-    memcpy(cell, opnd, di.item);
+    memcpy(cell, opnd, old.size());
   } else if (sub == "cas") {
     if (memcmp(cell, opnd, di.item) == 0)
       memcpy(cell, opnd + di.item, di.item);
   } else if (sub.rfind("aop:", 0) == 0) {
     MPI_Op op = (MPI_Op)atoi(sub.c_str() + 4);
     if (g_user_ops.count(op)) return false;
-    if (reduce_buf(cell, opnd, 1, dt, op) != MPI_SUCCESS) return false;
+    if (reduce_buf(cell, opnd, (int)nelems, dt, op) != MPI_SUCCESS)
+      return false;
   } else if (sub != "fetch") {
     return false;
   }
@@ -1488,10 +1503,16 @@ void handle_win_frame(int64_t src, const DssVal &t) {
     // reply_tag) -> old value; applied atomically under the window
     // lock (the drain is the serialization point)
     int64_t reply_tag = t.items[6].i;
+    std::string sub = t.items[3].s;
+    int64_t fetch_n = 1;
+    if (sub.rfind("fetch:", 0) == 0) {
+      fetch_n = atoll(sub.c_str() + 6);
+      sub = "fetch";
+    }
     std::vector<char> old;
-    if (!apply_amo(w, t.items[2].i, t.items[3].s,
-                   (MPI_Datatype)t.items[4].i, t.items[5].data.data(),
-                   t.items[5].data.size(), old)) {
+    if (!apply_amo(w, t.items[2].i, sub, (MPI_Datatype)t.items[4].i,
+                   t.items[5].data.data(), t.items[5].data.size(), old,
+                   fetch_n)) {
       win_reply(src, reply_tag, "", 0);
       return;
     }
@@ -4512,36 +4533,50 @@ int zompi_win_amo(MPI_Win win, int target_rank, long long disp_bytes,
   // validate the displacement (apply_amo does, on both paths)
   if (disp_bytes < 0) return MPI_ERR_ARG;
   std::string sub = subkind;
-  int need_items = sub == "cas" ? 2 : sub == "fetch" ? 0 : 1;
-  if (operand_items != need_items) return MPI_ERR_ARG;
-  if (need_items > 0 && operand == nullptr) return MPI_ERR_ARG;
+  // operand_items is the ELEMENT count: cas carries [compare][value]
+  // (2, one result element), fetch carries none (count = items), the
+  // rest carry `items` elements and return as many
+  bool is_cas = sub == "cas";
+  bool is_fetch = sub == "fetch";
+  if (operand_items <= 0 || (is_cas && operand_items != 2))
+    return MPI_ERR_ARG;
+  int payload_items = is_fetch ? 0 : operand_items;
+  if (payload_items > 0 && operand == nullptr) return MPI_ERR_ARG;
+  int result_items = is_cas ? 1 : operand_items;
   int tw = world_of(c, target_rank);
   if (tw == g.rank) {
     std::vector<char> old;
     if (!apply_amo(w, disp_bytes, sub, dt, (const char *)operand,
-                   (size_t)need_items * di.item, old))
+                   (size_t)payload_items * di.item, old,
+                   is_fetch ? operand_items : 1))
       return MPI_ERR_ARG;
-    memcpy(old_out, old.data(), di.item);
+    memcpy(old_out, old.data(), (size_t)result_items * di.item);
     return MPI_SUCCESS;
   }
   int64_t rtag = g_next_reply_tag.fetch_add(1);
   Req r;
   r.is_recv = true;
   r.user_buf = old_out;
-  r.count = (int)di.item;
+  r.count = (int)((size_t)result_items * di.item);
   DtView bv;
   bv.di = {"|u1", 1};
   int handle = post_recv(&r, bv, WIN_CID, tw, rtag);
+  char subbuf[24];
+  const char *wire_sub = sub.c_str();
+  if (is_fetch) {
+    snprintf(subbuf, sizeof subbuf, "fetch:%d", operand_items);
+    wire_sub = subbuf;
+  }
   std::string t;
   t.push_back((char)T_TUPLE);
   put_varint(t, 7);
   put_str(t, "wamo");
   put_int(t, wid);
   put_int(t, disp_bytes);
-  put_str(t, sub);
+  put_str(t, wire_sub);
   put_int(t, (int64_t)dt);
-  put_ndarray_1d(t, di.tag, need_items ? operand : "",
-                 (uint64_t)need_items, di.item);
+  put_ndarray_1d(t, di.tag, payload_items ? operand : "",
+                 (uint64_t)payload_items, di.item);
   put_int(t, rtag);
   int rc = win_send_tuple(tw, t);
   if (rc != MPI_SUCCESS) {
@@ -4552,7 +4587,8 @@ int zompi_win_amo(MPI_Win win, int target_rank, long long disp_bytes,
   MPI_Status st{};
   rc = wait_handle_impl(handle, &st, g.cts_timeout);
   if (rc != MPI_SUCCESS) return rc;
-  if (st._count != (long long)di.item) return MPI_ERR_ARG;
+  if (st._count != (long long)((size_t)result_items * di.item))
+    return MPI_ERR_ARG;
   return MPI_SUCCESS;
 }
 
@@ -4809,18 +4845,36 @@ int pscw_await(int from_world, int64_t tag) {
 
 }  // namespace
 
+namespace {
+
+// MPI_GROUP_EMPTY is a sentinel, not a registered handle: an empty
+// epoch group is legal (MPI-3.1 11.5.2, a rank with no partners this
+// round)
+bool resolve_epoch_group(MPI_Group group, std::vector<int> &out) {
+  if (group == MPI_GROUP_EMPTY) {
+    out.clear();
+    return true;
+  }
+  GroupObj *gr = lookup_group(group);
+  if (!gr) return false;
+  out = gr->ranks;
+  return true;
+}
+
+}  // namespace
+
 int MPI_Win_post(MPI_Group group, int /*assert_*/, MPI_Win win) {
   int64_t wid;
   WinObj *w = lookup_win(win, &wid);
   if (!w) return MPI_ERR_WIN;
-  GroupObj *gr = lookup_group(group);
-  if (!gr) return MPI_ERR_GROUP;
-  if (!w->pscw_post.empty()) return MPI_ERR_ARG;  // epoch already open
-  w->pscw_post = gr->ranks;
+  if (w->pscw_post_open) return MPI_ERR_ARG;  // epoch already open
+  if (!resolve_epoch_group(group, w->pscw_post)) return MPI_ERR_GROUP;
+  w->pscw_post_open = true;
   for (int tw : w->pscw_post) {
     int rc = pscw_notify(tw, PSCW_POST_BASE + wid);
     if (rc != MPI_SUCCESS) {
       w->pscw_post.clear();  // a wedged epoch would block forever
+      w->pscw_post_open = false;
       return rc;
     }
   }
@@ -4831,14 +4885,19 @@ int MPI_Win_start(MPI_Group group, int /*assert_*/, MPI_Win win) {
   int64_t wid;
   WinObj *w = lookup_win(win, &wid);
   if (!w) return MPI_ERR_WIN;
-  GroupObj *gr = lookup_group(group);
-  if (!gr) return MPI_ERR_GROUP;
-  if (!w->pscw_start.empty()) return MPI_ERR_ARG;
-  w->pscw_start = gr->ranks;
+  if (w->pscw_start_open) return MPI_ERR_ARG;
+  if (!resolve_epoch_group(group, w->pscw_start)) return MPI_ERR_GROUP;
+  w->pscw_start_open = true;
   // access epoch opens when every target has exposed (start MAY block)
   for (int tw : w->pscw_start) {
     int rc = pscw_await(tw, PSCW_POST_BASE + wid);
-    if (rc != MPI_SUCCESS) return rc;
+    if (rc != MPI_SUCCESS) {
+      // a half-open epoch would wedge the window AND let a recovery
+      // complete() replay DONE into unconsumed POSTs
+      w->pscw_start.clear();
+      w->pscw_start_open = false;
+      return rc;
+    }
   }
   return MPI_SUCCESS;
 }
@@ -4847,7 +4906,7 @@ int MPI_Win_complete(MPI_Win win) {
   int64_t wid;
   WinObj *w = lookup_win(win, &wid);
   if (!w) return MPI_ERR_WIN;
-  if (w->pscw_start.empty()) return MPI_ERR_ARG;
+  if (!w->pscw_start_open) return MPI_ERR_ARG;
   // ops must be APPLIED at the targets before the completion signal.
   // The epoch closes WHATEVER happens below: leaving pscw_start set
   // would let a retry re-send DONE to targets that already got one,
@@ -4858,6 +4917,7 @@ int MPI_Win_complete(MPI_Win win) {
     rc = pscw_notify(tw, PSCW_DONE_BASE + wid);
   }
   w->pscw_start.clear();
+  w->pscw_start_open = false;
   return rc;
 }
 
@@ -4865,12 +4925,13 @@ int MPI_Win_wait(MPI_Win win) {
   int64_t wid;
   WinObj *w = lookup_win(win, &wid);
   if (!w) return MPI_ERR_WIN;
-  if (w->pscw_post.empty()) return MPI_ERR_ARG;
+  if (!w->pscw_post_open) return MPI_ERR_ARG;
   for (int ow : w->pscw_post) {
     int rc = pscw_await(ow, PSCW_DONE_BASE + wid);
     if (rc != MPI_SUCCESS) return rc;
   }
   w->pscw_post.clear();
+  w->pscw_post_open = false;
   return MPI_SUCCESS;
 }
 
@@ -4894,8 +4955,64 @@ int MPI_Fetch_and_op(const void *origin_addr, void *result_addr,
     sub = subbuf;
   }
   return zompi_win_amo(win, target_rank, disp, sub, dt,
-                       op == MPI_NO_OP ? nullptr : origin_addr,
-                       op == MPI_NO_OP ? 0 : 1, result_addr);
+                       op == MPI_NO_OP ? nullptr : origin_addr, 1,
+                       result_addr);
+}
+
+int MPI_Get_accumulate(const void *origin_addr, int origin_count,
+                       MPI_Datatype origin_datatype, void *result_addr,
+                       int result_count, MPI_Datatype result_datatype,
+                       int target_rank, MPI_Aint target_disp,
+                       int target_count, MPI_Datatype target_datatype,
+                       MPI_Op op, MPI_Win win) {
+  // get_accumulate.c: atomic multi-element fetch+op; the whole span is
+  // read and updated under the target's window lock (the wamo
+  // substrate's generalized form)
+  WinObj *w = lookup_win(win);
+  if (!w) return MPI_ERR_WIN;
+  if (target_rank == MPI_PROC_NULL) return MPI_SUCCESS;
+  if (g_user_ops.count(op)) return MPI_ERR_OP;
+  DtView tv, rv;
+  if (!resolve_dtype(target_datatype, tv) ||
+      !resolve_dtype(result_datatype, rv))
+    return MPI_ERR_TYPE;
+  if (!tv.contiguous()) return MPI_ERR_TYPE;  // see MPI_Put
+  MPI_Datatype base_dt = tv.derived ? tv.derived->base : target_datatype;
+  DtInfo di;
+  if (!base_dtinfo(base_dt, di)) return MPI_ERR_TYPE;
+  int64_t nelems = (int64_t)target_count * tv.elems_per_item();
+  if (nelems == 0) return MPI_SUCCESS;  // zero-count no-op, like Put
+  size_t nbytes = (size_t)nelems * di.item;
+  if (nbytes > 0x7FFFFFFFull) return MPI_ERR_COUNT;  // int request count
+  if ((size_t)result_count * rv.elems_per_item() * rv.di.item != nbytes)
+    return MPI_ERR_TRUNCATE;
+  int64_t disp = (int64_t)target_disp * w->disp_unit;
+  std::vector<char> origin;
+  const char *sub;
+  char subbuf[16];
+  if (op == MPI_NO_OP) {
+    sub = "fetch";
+  } else {
+    DtInfo odi;
+    int rc = pack_origin(origin_addr, origin_count, origin_datatype,
+                         origin, odi);
+    if (rc != MPI_SUCCESS) return rc;
+    if (origin.size() != nbytes) return MPI_ERR_TRUNCATE;
+    if (op == MPI_REPLACE) sub = "swap";
+    else if (op == MPI_SUM) sub = "add";
+    else {
+      snprintf(subbuf, sizeof subbuf, "aop:%d", op);
+      sub = subbuf;
+    }
+  }
+  std::vector<char> old(nbytes);
+  int rc = zompi_win_amo(win, target_rank, disp, sub, base_dt,
+                         op == MPI_NO_OP ? nullptr : origin.data(),
+                         (int)nelems, old.data());
+  if (rc != MPI_SUCCESS) return rc;
+  if (rv.contiguous()) memcpy(result_addr, old.data(), nbytes);
+  else unpack_dtype(result_addr, result_count, rv, old.data(), nbytes);
+  return MPI_SUCCESS;
 }
 
 int MPI_Compare_and_swap(const void *origin_addr, const void *compare_addr,

@@ -18,12 +18,24 @@
  * predefined integer dtype set, the logical/bitwise reduction ops,
  * user-defined operators (MPI_Op_create), and MPI_Error_string.
  *
- * Round-5 tier 3: any-size RTS/CTS rendezvous sends, active-target RMA
- * windows (Win_create/fence/free + Put/Get/Accumulate,
- * win_create.c:44), nonblocking collectives retiring through the
- * request engine (Ibarrier/Ibcast/Iallreduce, ibcast.c:36), Cartesian
- * topology (Dims/Cart create/get/rank/coords/shift, cart_create.c:45),
- * and MPI_Pack/Unpack/Pack_size over the convertor (pack.c:45).
+ * Round-5 tier 3: any-size RTS/CTS rendezvous sends (non-overtaking
+ * placeholders, claim-time flow control, background large Isend); RMA
+ * windows with ALL THREE synchronization modes — fence epochs,
+ * generalized active target (Win_post/start/complete/wait), passive
+ * target (Win_lock/unlock exclusive+shared with drain-side FIFO
+ * arbitration, Win_flush/flush_all) — plus Win_allocate and the
+ * fetch-RMA ops (Fetch_and_op with every predefined op + REPLACE/
+ * NO_OP, Compare_and_swap, multi-element Get_accumulate, all atomic
+ * under the target's window lock); the full nonblocking-collective
+ * family (Ibarrier/Ibcast/Iallreduce/Ireduce/Igather/Iscatter/
+ * Iallgather/Ialltoall/Iscan/Iexscan/Ireduce_scatter_block) with
+ * call-time tag-slot reservation; persistent requests
+ * (Send_init/Recv_init/Start/Startall); Cartesian AND graph topology
+ * with neighborhood collectives; attribute caching (keyvals with
+ * dup/free/finalize callback semantics); Type_indexed(+block) with
+ * MPI lb/extent rules; MPI_Pack/Unpack/Pack_size over the convertor;
+ * Comm_create from groups.  The sibling zompi_shmem.h carries the
+ * OpenSHMEM C surface over the same engine.
  *
  * Wire-up (the PMIx-env analog): MPI_Init reads
  *   ZMPI_RANK        this process's rank
@@ -439,6 +451,12 @@ int MPI_Accumulate(const void *origin_addr, int origin_count,
 int MPI_Fetch_and_op(const void *origin_addr, void *result_addr,
                      MPI_Datatype dt, int target_rank,
                      MPI_Aint target_disp, MPI_Op op, MPI_Win win);
+int MPI_Get_accumulate(const void *origin_addr, int origin_count,
+                       MPI_Datatype origin_datatype, void *result_addr,
+                       int result_count, MPI_Datatype result_datatype,
+                       int target_rank, MPI_Aint target_disp,
+                       int target_count, MPI_Datatype target_datatype,
+                       MPI_Op op, MPI_Win win);
 int MPI_Compare_and_swap(const void *origin_addr,
                          const void *compare_addr, void *result_addr,
                          MPI_Datatype dt, int target_rank,

@@ -274,8 +274,7 @@ long amo_long(const void *target, int pe, const char *kind, long v0,
   long old = 0;
   long opnd[2] = {v0, v1};
   bool is_cas = strcmp(kind, "cas") == 0;
-  bool is_fetch = strcmp(kind, "fetch") == 0;
-  int items = is_cas ? 2 : is_fetch ? 0 : 1;
+  int items = is_cas ? 2 : 1;  // fetch: items is the element count
   int rc = d < 0 ? MPI_ERR_ARG
                  : zompi_win_amo(s.win, pe, d, kind, MPI_LONG, opnd,
                                  items, &old);
