@@ -84,6 +84,7 @@
 #include <functional>
 #include <list>
 #include <map>
+#include <memory>
 #include <set>
 #include <mutex>
 #include <string>
@@ -478,8 +479,14 @@ struct Shim {
   std::thread accept_thread;            // joined FIRST at finalize
   std::vector<std::thread> threads;     // drain threads (joinable)
   std::vector<int> drain_fds;           // every fd a drain thread reads
-  std::vector<int> bulk_fds;            // transient rendezvous-data fds
+  std::vector<int> bulk_fds;            // RECV side: peers' bulk-data fds
   std::atomic<int> bulk_closing{0};     // self-closes still in flight
+  std::map<int, int> bulk_conns;        // SEND side: peer -> cached fd
+  // bulk_mu guards the MAPS only; each peer's pushes serialize on its
+  // own mutex so concurrent transfers to different peers stream in
+  // parallel (the per-transfer-socket property the cache must keep)
+  std::map<int, std::unique_ptr<std::mutex>> bulk_peer_mu;
+  std::mutex bulk_mu;
   std::mutex threads_mu;
   // atomic: drain threads stamp CTS frames concurrently with app sends
   std::atomic<int64_t> seq{0};
@@ -699,13 +706,14 @@ void start_drain(int fd) {
   g.threads.emplace_back(drain_loop, fd);
 }
 
-// Transient bulk-data connections (hello ["d"]): one per rendezvous
-// transfer, EOF when the sender closes.  A joinable thread + a
-// Finalize-swept fd per multi-MB message would accumulate (pthread
-// stacks of exited joinable threads are retained until join), so these
-// drains run detached, register in bulk_fds only for the Finalize
-// shutdown sweep, and deregister + close their own fd on exit — the
-// self-close is safe because the closing thread is the only reader.
+// Receiver side of bulk-data connections (hello ["d"]): one per
+// SENDING peer (the sender caches and reuses it across transfers), EOF
+// when that sender's Finalize closes its cache.  A joinable thread +
+// a Finalize-swept fd per connection would accumulate (pthread stacks
+// of exited joinable threads are retained until join), so these drains
+// run detached, register in bulk_fds only for the Finalize shutdown
+// sweep, and deregister + close their own fd on exit — the self-close
+// is safe because the closing thread is the only reader.
 void start_bulk_drain(int fd) {
   {
     std::lock_guard<std::mutex> lk(g.threads_mu);
@@ -972,30 +980,63 @@ int rndv_announce(size_t count, const DtInfo &di, int dest, int64_t tag,
 // ZMPI_MCA_rndv_cts_timeout bounds it for jobs preferring typed errors
 // over peer-death hangs), then push the data frame over a dedicated
 // bulk connection so the control socket never carries a multi-MB write.
-int rndv_complete(const void *buf, size_t count, const DtInfo &di,
-                  int dest, int64_t rid, int handle) {
-  MPI_Status st{};
-  int rc = wait_handle_impl(handle, &st, g.cts_timeout);
-  if (rc != MPI_SUCCESS) return rc;
+// Cached per-peer bulk connections: a TCP connect + slow-start per
+// multi-MB transfer costs more than the transfer at larger sizes, so
+// the first rendezvous to a peer opens the hello-["d"] connection and
+// later ones reuse it (frames serialize under bulk_mu; the receiver's
+// bulk drain loops over frames and self-closes on our Finalize EOF).
+int bulk_endpoint_locked(int dest) {
+  auto it = g.bulk_conns.find(dest);
+  if (it != g.bulk_conns.end()) return it->second;
   int dfd = tcp_connect(g.book[dest].first, g.book[dest].second);
-  if (dfd < 0) return MPI_ERR_OTHER;
+  if (dfd < 0) return -1;
   std::string hello;
   put_varint(hello, 1);
   hello.push_back((char)T_LIST);
   put_varint(hello, 1);
   put_str(hello, "d");
-  bool ok = send_frame(dfd, hello);
-  if (ok) {
-    std::string payload;
-    put_varint(payload, 5);
-    put_int(payload, g.rank);
-    put_int(payload, rid);
-    put_int(payload, RNDV_DATA_CID);
-    put_int(payload, g.seq++);
-    put_ndarray_1d(payload, di.tag, buf, count, di.item);
-    ok = send_frame(dfd, payload);
+  if (!send_frame(dfd, hello)) {
+    close(dfd);
+    return -1;
   }
-  close(dfd);
+  g.bulk_conns[dest] = dfd;
+  return dfd;
+}
+
+int rndv_complete(const void *buf, size_t count, const DtInfo &di,
+                  int dest, int64_t rid, int handle) {
+  MPI_Status st{};
+  int rc = wait_handle_impl(handle, &st, g.cts_timeout);
+  if (rc != MPI_SUCCESS) return rc;
+  std::string payload;
+  put_varint(payload, 5);
+  put_int(payload, g.rank);
+  put_int(payload, rid);
+  put_int(payload, RNDV_DATA_CID);
+  put_int(payload, g.seq++);
+  put_ndarray_1d(payload, di.tag, buf, count, di.item);
+  std::mutex *peer_mu;
+  {
+    std::lock_guard<std::mutex> lk(g.bulk_mu);
+    auto &slot = g.bulk_peer_mu[dest];
+    if (!slot) slot.reset(new std::mutex);
+    peer_mu = slot.get();
+  }
+  std::lock_guard<std::mutex> plk(*peer_mu);
+  int dfd;
+  {
+    std::lock_guard<std::mutex> lk(g.bulk_mu);
+    dfd = bulk_endpoint_locked(dest);
+  }
+  bool ok = dfd >= 0 && send_frame(dfd, payload);
+  if (!ok && dfd >= 0) {
+    // a broken cached connection gets one fresh retry
+    std::lock_guard<std::mutex> lk(g.bulk_mu);
+    close(dfd);
+    g.bulk_conns.erase(dest);
+    dfd = bulk_endpoint_locked(dest);
+    ok = dfd >= 0 && send_frame(dfd, payload);
+  }
   return ok ? MPI_SUCCESS : MPI_ERR_OTHER;
 }
 
@@ -2117,6 +2158,13 @@ int MPI_Finalize(void) {
   // moment rather than racing their g accesses
   for (int i = 0; i < 500 && g.inflight_isends.load() > 0; i++)
     std::this_thread::sleep_for(std::chrono::milliseconds(10));
+  {
+    // close our cached bulk-send connections: the peers' bulk drains
+    // see EOF and self-close (no local reader ever holds these fds)
+    std::lock_guard<std::mutex> lk(g.bulk_mu);
+    for (auto &e : g.bulk_conns) close(e.second);
+    g.bulk_conns.clear();
+  }
   {
     std::lock_guard<std::mutex> lk(g.threads_mu);
     for (int fd : g.drain_fds) shutdown(fd, SHUT_RDWR);
@@ -4845,30 +4893,16 @@ int pscw_await(int from_world, int64_t tag) {
 
 }  // namespace
 
-namespace {
-
-// MPI_GROUP_EMPTY is a sentinel, not a registered handle: an empty
-// epoch group is legal (MPI-3.1 11.5.2, a rank with no partners this
-// round)
-bool resolve_epoch_group(MPI_Group group, std::vector<int> &out) {
-  if (group == MPI_GROUP_EMPTY) {
-    out.clear();
-    return true;
-  }
-  GroupObj *gr = lookup_group(group);
-  if (!gr) return false;
-  out = gr->ranks;
-  return true;
-}
-
-}  // namespace
-
 int MPI_Win_post(MPI_Group group, int /*assert_*/, MPI_Win win) {
   int64_t wid;
   WinObj *w = lookup_win(win, &wid);
   if (!w) return MPI_ERR_WIN;
   if (w->pscw_post_open) return MPI_ERR_ARG;  // epoch already open
-  if (!resolve_epoch_group(group, w->pscw_post)) return MPI_ERR_GROUP;
+  // group_ranks handles the MPI_GROUP_EMPTY sentinel (an empty epoch
+  // is legal: a rank with no partners this round, MPI-3.1 11.5.2)
+  const std::vector<int> *er = group_ranks(group);
+  if (!er) return MPI_ERR_GROUP;
+  w->pscw_post = *er;
   w->pscw_post_open = true;
   for (int tw : w->pscw_post) {
     int rc = pscw_notify(tw, PSCW_POST_BASE + wid);
@@ -4886,7 +4920,9 @@ int MPI_Win_start(MPI_Group group, int /*assert_*/, MPI_Win win) {
   WinObj *w = lookup_win(win, &wid);
   if (!w) return MPI_ERR_WIN;
   if (w->pscw_start_open) return MPI_ERR_ARG;
-  if (!resolve_epoch_group(group, w->pscw_start)) return MPI_ERR_GROUP;
+  const std::vector<int> *sr = group_ranks(group);
+  if (!sr) return MPI_ERR_GROUP;
+  w->pscw_start = *sr;
   w->pscw_start_open = true;
   // access epoch opens when every target has exposed (start MAY block)
   for (int tw : w->pscw_start) {
