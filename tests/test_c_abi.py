@@ -1422,3 +1422,63 @@ int main(int argc, char **argv) {
             out, err = p.communicate(timeout=120)
             assert p.returncode == 0, f"rank {r} failed: {err}\n{out}"
             assert f"pscw rank {r}/{n} OK" in out
+
+    def test_ssend_completes_at_match(self, shim, tmp_path):
+        """MPI_Ssend (forced rendezvous): a SMALL synchronous send must
+        not complete until the receiver matches — measured against a
+        deliberately late receive; Testany polls a pending then a
+        completed request."""
+        src = tmp_path / "ssend.c"
+        src.write_text(r'''
+#include <stdio.h>
+#include <unistd.h>
+#include "zompi_mpi.h"
+int main(int argc, char **argv) {
+  int rank, size;
+  if (MPI_Init(&argc, &argv) != MPI_SUCCESS) return 2;
+  MPI_Comm_rank(MPI_COMM_WORLD, &rank);
+  MPI_Comm_size(MPI_COMM_WORLD, &size);
+  long v = 77;
+  MPI_Barrier(MPI_COMM_WORLD);
+  if (rank == 0) {
+    double t0 = MPI_Wtime();
+    MPI_Ssend(&v, 1, MPI_LONG, 1, 6, MPI_COMM_WORLD);
+    double dt = MPI_Wtime() - t0;
+    if (dt < 0.25) {  /* receiver posts after 400ms */
+      fprintf(stderr, "Ssend returned in %.3fs before the match\n", dt);
+      return 3;
+    }
+  } else if (rank == 1) {
+    usleep(400000);
+    long got = 0;
+    /* Testany on a pending request first */
+    MPI_Request rq;
+    MPI_Irecv(&got, 1, MPI_LONG, 0, 6, MPI_COMM_WORLD, &rq);
+    int idx = -2, flag = -1, spins = 0;
+    do {
+      if (MPI_Testany(1, &rq, &idx, &flag, MPI_STATUS_IGNORE)
+          != MPI_SUCCESS) return 4;
+      spins++;
+    } while (!flag && spins < 4000000);
+    if (!flag || idx != 0 || got != 77) return 5;
+  }
+  MPI_Barrier(MPI_COMM_WORLD);
+  printf("ssend rank %d/%d OK\n", rank, size);
+  MPI_Finalize();
+  return 0;
+}
+''')
+        binpath = tmp_path / "ssend"
+        _compile_c(shim, src, binpath)
+        port = _free_port()
+        n = 2
+        procs = [
+            subprocess.Popen([str(binpath)], env=_env(r, n, port),
+                             stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                             text=True)
+            for r in range(n)
+        ]
+        for r, p in enumerate(procs):
+            out, err = p.communicate(timeout=120)
+            assert p.returncode == 0, f"rank {r} failed: {err}\n{out}"
+            assert f"ssend rank {r}/{n} OK" in out

@@ -1058,8 +1058,31 @@ int wire_send_rndv(const void *buf, size_t count, const DtInfo &di,
 // eager keeps the ring/pairwise exchanges deadlock-free (the same
 // reasoning as the allgather ring's buffered-eager note below).
 int wire_send(const void *buf, size_t count, const DtInfo &di, int dest,
-              int64_t tag, int64_t cid, bool allow_rndv = false) {
+              int64_t tag, int64_t cid, bool allow_rndv = false,
+              bool force_rndv = false) {
   if (dest == g.rank) {
+    if (force_rndv) {
+      // synchronous self-send: completion must imply the receive is
+      // matched, so wait until a matching receive is POSTED before
+      // delivering (unmatched single-threaded self-Ssend deadlocks,
+      // as the spec's contract implies; a concurrent thread's recv
+      // releases it)
+      std::unique_lock<std::mutex> lk(g.match_mu);
+      for (;;) {
+        bool posted = false;
+        for (auto &pp : g.posted) {
+          if (pp.cid != cid) continue;
+          if (pp.src_world != MPI_ANY_SOURCE && pp.src_world != g.rank)
+            continue;
+          if (pp.tag != MPI_ANY_TAG && pp.tag != tag) continue;
+          posted = true;
+          break;
+        }
+        if (posted) break;
+        g.match_cv.wait_for(lk, std::chrono::milliseconds(100));
+        if (g.closing.load()) return MPI_ERR_OTHER;
+      }
+    }
     Message m;
     m.src = g.rank; m.tag = tag; m.cid = cid; m.seq = g.seq++;
     m.dt = di.tag;
@@ -1071,7 +1094,8 @@ int wire_send(const void *buf, size_t count, const DtInfo &di, int dest,
   // plane shares the limit — struct "<I"); reject with a typed error
   // rather than let send_frame fail opaquely after the RTS handshake
   if (count * di.item > 0xFFFF0000ull) return MPI_ERR_COUNT;
-  if (allow_rndv && (int64_t)(count * di.item) > g.eager_limit)
+  if (force_rndv ||
+      (allow_rndv && (int64_t)(count * di.item) > g.eager_limit))
     return wire_send_rndv(buf, count, di, dest, tag, cid);
   int fd = endpoint(dest);
   if (fd < 0) return MPI_ERR_OTHER;
@@ -1101,16 +1125,17 @@ int raw_recv(void *buf, int count, MPI_Datatype dt, int source, int64_t tag,
 }
 
 int raw_send(const void *buf, int count, MPI_Datatype dt, int dest,
-             int64_t tag, int64_t cid, bool allow_rndv = false) {
+             int64_t tag, int64_t cid, bool allow_rndv = false,
+             bool force_rndv = false) {
   DtView v;
   if (!resolve_dtype(dt, v)) return MPI_ERR_TYPE;
   if (v.contiguous())
     return wire_send(buf, (size_t)count * v.elems_per_item(), v.di, dest,
-                     tag, cid, allow_rndv);
+                     tag, cid, allow_rndv, force_rndv);
   std::vector<char> packed;
   pack_dtype(buf, count, v, packed);
   return wire_send(packed.data(), packed.size() / v.di.item, v.di, dest,
-                   tag, cid, allow_rndv);
+                   tag, cid, allow_rndv, force_rndv);
 }
 
 // --------------------------------------------------------- communicators
@@ -2653,6 +2678,26 @@ int MPI_Send(const void *buf, int count, MPI_Datatype dt, int dest,
                   /*allow_rndv=*/true);
 }
 
+int MPI_Ssend(const void *buf, int count, MPI_Datatype dt, int dest,
+              int tag, MPI_Comm comm) {
+  // ssend.c: completion implies the receive is MATCHED — exactly the
+  // rendezvous contract (CTS leaves at claim time), so a synchronous
+  // send is a forced-rendezvous send at any size
+  CommObj *c = lookup_comm(comm);
+  if (!c) return MPI_ERR_COMM;
+  if (dest == MPI_PROC_NULL) return MPI_SUCCESS;
+  if (tag < 0) return MPI_ERR_ARG;
+  if (dest < 0 || dest >= (int)c->group.size()) return MPI_ERR_ARG;
+  return raw_send(buf, count, dt, world_of(*c, dest), tag, c->cid_pt2pt,
+                  /*allow_rndv=*/true, /*force_rndv=*/true);
+}
+
+int MPI_Rsend(const void *buf, int count, MPI_Datatype dt, int dest,
+              int tag, MPI_Comm comm) {
+  // rsend.c: ready-send may legally be implemented as standard send
+  return MPI_Send(buf, count, dt, dest, tag, comm);
+}
+
 static int translate_status(CommObj *c, MPI_Status *status) {
   if (status && c) {
     int local = local_of(*c, status->MPI_SOURCE);
@@ -3332,6 +3377,57 @@ int MPI_Iprobe(int source, int tag, MPI_Comm comm, int *flag,
     translate_status(c, status);
   }
   return rc;
+}
+
+int MPI_Testany(int count, MPI_Request requests[], int *index, int *flag,
+                MPI_Status *status) {
+  // testany.c: one non-blocking scan of the set; persistent handles
+  // (< MPI_REQUEST_NULL) count as ready when inactive or when their
+  // inner active op completed
+  bool any_active = false;
+  int ready = -1;
+  {
+    std::lock_guard<std::mutex> lk(g.match_mu);
+    for (int i = 0; i < count && ready < 0; i++) {
+      MPI_Request h = requests[i];
+      if (h == MPI_REQUEST_NULL) continue;
+      any_active = true;
+      if (h < MPI_REQUEST_NULL) {
+        auto pit = g_persistent.find(-h);
+        if (pit == g_persistent.end()) return MPI_ERR_REQUEST;
+        if (pit->second.active == MPI_REQUEST_NULL) {
+          ready = i;  // inactive persistent tests as complete
+        } else {
+          auto it = g.reqs.find(pit->second.active);
+          if (it == g.reqs.end()) return MPI_ERR_REQUEST;
+          if (it->second->complete) ready = i;
+        }
+        continue;
+      }
+      auto it = g.reqs.find(h);
+      if (it == g.reqs.end()) return MPI_ERR_REQUEST;
+      if (it->second->complete) ready = i;
+    }
+  }
+  if (!any_active) {
+    *index = MPI_UNDEFINED;
+    *flag = 1;
+    if (status) {
+      status->MPI_SOURCE = MPI_ANY_SOURCE;
+      status->MPI_TAG = MPI_ANY_TAG;
+      status->MPI_ERROR = MPI_SUCCESS;
+      status->_count = 0;
+    }
+    return MPI_SUCCESS;
+  }
+  if (ready < 0) {
+    *flag = 0;
+    *index = MPI_UNDEFINED;
+    return MPI_SUCCESS;
+  }
+  *flag = 1;
+  *index = ready;
+  return MPI_Wait(&requests[ready], status);
 }
 
 int MPI_Waitany(int count, MPI_Request requests[], int *index,

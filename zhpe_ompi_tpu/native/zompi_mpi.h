@@ -198,6 +198,10 @@ int MPI_Send(const void *buf, int count, MPI_Datatype dt, int dest,
              int tag, MPI_Comm comm);
 int MPI_Recv(void *buf, int count, MPI_Datatype dt, int source, int tag,
              MPI_Comm comm, MPI_Status *status);
+int MPI_Ssend(const void *buf, int count, MPI_Datatype dt, int dest,
+              int tag, MPI_Comm comm);
+int MPI_Rsend(const void *buf, int count, MPI_Datatype dt, int dest,
+              int tag, MPI_Comm comm);
 int MPI_Sendrecv(const void *sendbuf, int sendcount, MPI_Datatype sendtype,
                  int dest, int sendtag, void *recvbuf, int recvcount,
                  MPI_Datatype recvtype, int source, int recvtag,
@@ -213,6 +217,8 @@ int MPI_Wait(MPI_Request *request, MPI_Status *status);
 int MPI_Test(MPI_Request *request, int *flag, MPI_Status *status);
 int MPI_Waitall(int count, MPI_Request requests[], MPI_Status statuses[]);
 int MPI_Waitany(int count, MPI_Request requests[], int *index,
+                MPI_Status *status);
+int MPI_Testany(int count, MPI_Request requests[], int *index, int *flag,
                 MPI_Status *status);
 int MPI_Testall(int count, MPI_Request requests[], int *flag,
                 MPI_Status statuses[]);
