@@ -1441,6 +1441,19 @@ int main(int argc, char **argv) {
   long v = 77;
   MPI_Barrier(MPI_COMM_WORLD);
   if (rank == 0) {
+    /* Bsend must NOT wait for the late receiver (buffered contract) */
+    static char bbuf[4096];
+    long bv = 55;
+    MPI_Buffer_attach(bbuf, sizeof bbuf);
+    double b0 = MPI_Wtime();
+    MPI_Bsend(&bv, 1, MPI_LONG, 1, 7, MPI_COMM_WORLD);
+    if (MPI_Wtime() - b0 > 0.2) {
+      fprintf(stderr, "Bsend blocked on the receiver\n");
+      return 6;
+    }
+    void *db; int ds;
+    MPI_Buffer_detach(&db, &ds);
+    if (db != (void *)bbuf || ds != (int)sizeof bbuf) return 7;
     double t0 = MPI_Wtime();
     MPI_Ssend(&v, 1, MPI_LONG, 1, 6, MPI_COMM_WORLD);
     double dt = MPI_Wtime() - t0;
@@ -1450,6 +1463,10 @@ int main(int argc, char **argv) {
     }
   } else if (rank == 1) {
     usleep(400000);
+    long bgot = 0;
+    MPI_Recv(&bgot, 1, MPI_LONG, 0, 7, MPI_COMM_WORLD,
+             MPI_STATUS_IGNORE);
+    if (bgot != 55) return 8;
     long got = 0;
     /* Testany on a pending request first */
     MPI_Request rq;

@@ -2698,6 +2698,58 @@ int MPI_Rsend(const void *buf, int count, MPI_Datatype dt, int dest,
   return MPI_Send(buf, count, dt, dest, tag, comm);
 }
 
+// bsend.c family: buffered sends must complete without the receiver.
+// The engine buffers internally (payloads serialize at send time and
+// eager frames never wait for a match), so Bsend is an eager-forced
+// send at any size below the frame bound; the user's attached buffer
+// is tracked for the attach/detach contract but the internal buffering
+// does the work (MPI allows the implementation to buffer elsewhere).
+static void *g_bsend_buf = nullptr;
+static int g_bsend_size = 0;
+
+int MPI_Buffer_attach(void *buffer, int size) {
+  if (g_bsend_buf) return MPI_ERR_ARG;  // one buffer at a time
+  g_bsend_buf = buffer;
+  g_bsend_size = size;
+  return MPI_SUCCESS;
+}
+
+int MPI_Buffer_detach(void *buffer_addr, int *size) {
+  // blocks until pending buffered sends complete — eager frames are on
+  // the wire before Bsend returns, so nothing is pending here
+  *(void **)buffer_addr = g_bsend_buf;
+  *size = g_bsend_size;
+  g_bsend_buf = nullptr;
+  g_bsend_size = 0;
+  return MPI_SUCCESS;
+}
+
+int MPI_Bsend(const void *buf, int count, MPI_Datatype dt, int dest,
+              int tag, MPI_Comm comm) {
+  CommObj *c = lookup_comm(comm);
+  if (!c) return MPI_ERR_COMM;
+  if (dest == MPI_PROC_NULL) return MPI_SUCCESS;
+  if (tag < 0) return MPI_ERR_ARG;
+  if (dest < 0 || dest >= (int)c->group.size()) return MPI_ERR_ARG;
+  // eager at any size: never blocks on the receiver
+  return raw_send(buf, count, dt, world_of(*c, dest), tag, c->cid_pt2pt);
+}
+
+int MPI_Ibsend(const void *buf, int count, MPI_Datatype dt, int dest,
+               int tag, MPI_Comm comm, MPI_Request *request) {
+  int rc = MPI_Bsend(buf, count, dt, dest, tag, comm);
+  if (rc != MPI_SUCCESS) return rc;
+  Req *r = new Req;
+  r->complete = true;
+  r->heap = true;
+  r->comm = comm;
+  std::lock_guard<std::mutex> lk(g.match_mu);
+  int handle = g.next_req++;
+  g.reqs[handle] = r;
+  *request = handle;
+  return MPI_SUCCESS;
+}
+
 static int translate_status(CommObj *c, MPI_Status *status) {
   if (status && c) {
     int local = local_of(*c, status->MPI_SOURCE);
@@ -4941,6 +4993,13 @@ int MPI_Win_flush(int rank, MPI_Win win) {
 }
 
 int MPI_Win_flush_all(MPI_Win win) { return zompi_win_flush(win); }
+
+int MPI_Win_get_group(MPI_Win win, MPI_Group *group) {
+  WinObj *w = lookup_win(win);
+  if (!w) return MPI_ERR_WIN;
+  *group = register_group(w->comm.group);
+  return MPI_SUCCESS;
+}
 
 // PSCW active-target epochs (win_post.c family; the AM plane's
 // identity-checked PSCW): post/complete notifications are plain empty

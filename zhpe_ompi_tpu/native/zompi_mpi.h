@@ -202,6 +202,12 @@ int MPI_Ssend(const void *buf, int count, MPI_Datatype dt, int dest,
               int tag, MPI_Comm comm);
 int MPI_Rsend(const void *buf, int count, MPI_Datatype dt, int dest,
               int tag, MPI_Comm comm);
+int MPI_Bsend(const void *buf, int count, MPI_Datatype dt, int dest,
+              int tag, MPI_Comm comm);
+int MPI_Ibsend(const void *buf, int count, MPI_Datatype dt, int dest,
+               int tag, MPI_Comm comm, MPI_Request *request);
+int MPI_Buffer_attach(void *buffer, int size);
+int MPI_Buffer_detach(void *buffer_addr, int *size);
 int MPI_Sendrecv(const void *sendbuf, int sendcount, MPI_Datatype sendtype,
                  int dest, int sendtag, void *recvbuf, int recvcount,
                  MPI_Datatype recvtype, int source, int recvtag,
@@ -437,6 +443,7 @@ int MPI_Win_lock(int lock_type, int rank, int assert_, MPI_Win win);
 int MPI_Win_unlock(int rank, MPI_Win win);
 int MPI_Win_flush(int rank, MPI_Win win);
 int MPI_Win_flush_all(MPI_Win win);
+int MPI_Win_get_group(MPI_Win win, MPI_Group *group);
 int MPI_Win_post(MPI_Group group, int assert_, MPI_Win win);
 int MPI_Win_start(MPI_Group group, int assert_, MPI_Win win);
 int MPI_Win_complete(MPI_Win win);
