@@ -2698,6 +2698,19 @@ int MPI_Rsend(const void *buf, int count, MPI_Datatype dt, int dest,
   return MPI_Send(buf, count, dt, dest, tag, comm);
 }
 
+// allocate an already-completed heap request and register it (the
+// eager-send/PROC_NULL request shape shared by Isend/Irecv/Ibsend)
+static int make_completed_req(MPI_Comm comm) {
+  Req *r = new Req;
+  r->complete = true;
+  r->heap = true;
+  r->comm = comm;
+  std::lock_guard<std::mutex> lk(g.match_mu);
+  int handle = g.next_req++;
+  g.reqs[handle] = r;
+  return handle;
+}
+
 // bsend.c family: buffered sends must complete without the receiver.
 // The engine buffers internally (payloads serialize at send time and
 // eager frames never wait for a match), so Bsend is an eager-forced
@@ -2739,14 +2752,7 @@ int MPI_Ibsend(const void *buf, int count, MPI_Datatype dt, int dest,
                int tag, MPI_Comm comm, MPI_Request *request) {
   int rc = MPI_Bsend(buf, count, dt, dest, tag, comm);
   if (rc != MPI_SUCCESS) return rc;
-  Req *r = new Req;
-  r->complete = true;
-  r->heap = true;
-  r->comm = comm;
-  std::lock_guard<std::mutex> lk(g.match_mu);
-  int handle = g.next_req++;
-  g.reqs[handle] = r;
-  *request = handle;
+  *request = make_completed_req(comm);
   return MPI_SUCCESS;
 }
 
@@ -2876,14 +2882,7 @@ int MPI_Isend(const void *buf, int count, MPI_Datatype dt, int dest,
                   /*allow_rndv=*/true);
     if (rc) return rc;
   }
-  Req *r = new Req;
-  r->complete = true;
-  r->heap = true;
-  r->comm = comm;
-  std::lock_guard<std::mutex> lk(g.match_mu);
-  int handle = g.next_req++;
-  g.reqs[handle] = r;
-  *request = handle;
+  *request = make_completed_req(comm);
   return rc;
 }
 
@@ -2894,15 +2893,13 @@ int MPI_Irecv(void *buf, int count, MPI_Datatype dt, int source, int tag,
   DtView v;
   if (!resolve_dtype(dt, v)) return MPI_ERR_TYPE;
   if (source == MPI_PROC_NULL) {
-    Req *r = new Req;
-    r->complete = true;
-    r->heap = true;
-    r->comm = comm;
-    r->status.MPI_SOURCE = MPI_PROC_NULL;
-    r->status.MPI_TAG = MPI_ANY_TAG;
-    std::lock_guard<std::mutex> lk(g.match_mu);
-    int handle = g.next_req++;
-    g.reqs[handle] = r;
+    int handle = make_completed_req(comm);
+    {
+      std::lock_guard<std::mutex> lk(g.match_mu);
+      Req *r = g.reqs[handle];
+      r->status.MPI_SOURCE = MPI_PROC_NULL;
+      r->status.MPI_TAG = MPI_ANY_TAG;
+    }
     *request = handle;
     return MPI_SUCCESS;
   }

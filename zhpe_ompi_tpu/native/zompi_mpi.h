@@ -206,6 +206,7 @@ int MPI_Bsend(const void *buf, int count, MPI_Datatype dt, int dest,
               int tag, MPI_Comm comm);
 int MPI_Ibsend(const void *buf, int count, MPI_Datatype dt, int dest,
                int tag, MPI_Comm comm, MPI_Request *request);
+#define MPI_BSEND_OVERHEAD 0 /* buffering is internal to the engine */
 int MPI_Buffer_attach(void *buffer, int size);
 int MPI_Buffer_detach(void *buffer_addr, int *size);
 int MPI_Sendrecv(const void *sendbuf, int sendcount, MPI_Datatype sendtype,
