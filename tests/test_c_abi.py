@@ -1499,3 +1499,78 @@ int main(int argc, char **argv) {
             out, err = p.communicate(timeout=120)
             assert p.returncode == 0, f"rank {r} failed: {err}\n{out}"
             assert f"ssend rank {r}/{n} OK" in out
+
+    def test_alltoallv_and_reduce_scatter(self, shim, tmp_path):
+        """Ragged MPI_Alltoallv (rank r sends r+1 items to each peer)
+        and MPI_Reduce_scatter with per-rank counts."""
+        src = tmp_path / "ragged.c"
+        src.write_text(r'''
+#include <stdio.h>
+#include <stdlib.h>
+#include "zompi_mpi.h"
+int main(int argc, char **argv) {
+  int rank, size, r, i;
+  if (MPI_Init(&argc, &argv) != MPI_SUCCESS) return 2;
+  MPI_Comm_rank(MPI_COMM_WORLD, &rank);
+  MPI_Comm_size(MPI_COMM_WORLD, &size);
+  /* alltoallv: rank r sends (rank+1) longs to each peer, value
+     rank*1000 + dest */
+  int *scnt = malloc(size * sizeof(int)), *sdis = malloc(size * sizeof(int));
+  int *rcnt = malloc(size * sizeof(int)), *rdis = malloc(size * sizeof(int));
+  int stot = 0, rtot = 0;
+  for (r = 0; r < size; r++) {
+    scnt[r] = rank + 1; sdis[r] = stot; stot += scnt[r];
+    rcnt[r] = r + 1;    rdis[r] = rtot; rtot += rcnt[r];
+  }
+  long *sb = malloc(stot * sizeof(long)), *rb = malloc(rtot * sizeof(long));
+  for (r = 0; r < size; r++)
+    for (i = 0; i < scnt[r]; i++) sb[sdis[r] + i] = rank * 1000 + r;
+  for (i = 0; i < rtot; i++) rb[i] = -1;
+  if (MPI_Alltoallv(sb, scnt, sdis, MPI_LONG, rb, rcnt, rdis, MPI_LONG,
+                    MPI_COMM_WORLD) != MPI_SUCCESS) return 3;
+  for (r = 0; r < size; r++)
+    for (i = 0; i < rcnt[r]; i++)
+      if (rb[rdis[r] + i] != r * 1000 + rank) {
+        fprintf(stderr, "rank %d: from %d item %d = %ld\n", rank, r, i,
+                rb[rdis[r] + i]);
+        return 4;
+      }
+  /* reduce_scatter: ragged slices, slice r has r+1 elements */
+  int total = size * (size + 1) / 2;
+  long *contrib = malloc(total * sizeof(long));
+  for (i = 0; i < total; i++) contrib[i] = rank + i;
+  long *mine = malloc((rank + 1) * sizeof(long));
+  int *counts = malloc(size * sizeof(int));
+  for (r = 0; r < size; r++) counts[r] = r + 1;
+  if (MPI_Reduce_scatter(contrib, mine, counts, MPI_LONG, MPI_SUM,
+                         MPI_COMM_WORLD) != MPI_SUCCESS) return 5;
+  /* sum over ranks of (rank + idx) = size*idx + size*(size-1)/2 */
+  int base = rank * (rank + 1) / 2;
+  for (i = 0; i < rank + 1; i++) {
+    long want = (long)size * (base + i) + (long)size * (size - 1) / 2;
+    if (mine[i] != want) {
+      fprintf(stderr, "rank %d: slice[%d]=%ld want %ld\n", rank, i,
+              mine[i], want);
+      return 6;
+    }
+  }
+  MPI_Barrier(MPI_COMM_WORLD);
+  printf("ragged rank %d/%d OK\n", rank, size);
+  MPI_Finalize();
+  return 0;
+}
+''')
+        binpath = tmp_path / "ragged"
+        _compile_c(shim, src, binpath)
+        port = _free_port()
+        n = 4
+        procs = [
+            subprocess.Popen([str(binpath)], env=_env(r, n, port),
+                             stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                             text=True)
+            for r in range(n)
+        ]
+        for r, p in enumerate(procs):
+            out, err = p.communicate(timeout=120)
+            assert p.returncode == 0, f"rank {r} failed: {err}\n{out}"
+            assert f"ragged rank {r}/{n} OK" in out

@@ -2022,6 +2022,87 @@ int c_reduce_scatter_block(CommObj &c, const void *sendbuf, void *recvbuf,
                    dt, 0);
 }
 
+int c_reduce_scatter(CommObj &c, const void *sendbuf, void *recvbuf,
+                     const int recvcounts[], MPI_Datatype dt, MPI_Op op) {
+  // reduce_scatter.c's ragged form: full reduce at 0, then scatterv of
+  // the per-rank slices (coll/basic's composition)
+  DtView v;
+  if (!resolve_dtype(dt, v) || v.derived) return MPI_ERR_TYPE;
+  int n = (int)c.group.size();
+  int64_t total = 0;
+  std::vector<int> displs(n);
+  for (int r = 0; r < n; r++) {
+    if (recvcounts[r] < 0) return MPI_ERR_ARG;
+    displs[r] = (int)total;
+    total += recvcounts[r];
+  }
+  if (total * (int64_t)v.di.item > 0x7FFFFFFFll) return MPI_ERR_COUNT;
+  // only the root touches the full reduction (the rsb helper's shape)
+  std::vector<char> full(
+      c.local_rank == 0 ? (size_t)total * v.di.item : 0);
+  int rc = c_reduce(c, sendbuf, full.data(), (int)total, dt, op, 0);
+  if (rc != MPI_SUCCESS) return rc;
+  return c_scatterv(c, full.data(), recvcounts, displs.data(), dt,
+                    recvbuf, recvcounts[c.local_rank], dt, 0);
+}
+
+int c_alltoallv(CommObj &c, const void *sendbuf, const int sendcounts[],
+                const int sdispls[], MPI_Datatype sendtype, void *recvbuf,
+                const int recvcounts[], const int rdispls[],
+                MPI_Datatype recvtype) {
+  // alltoallv.c: ragged pairwise exchange — one message per ordered
+  // pair under one reserved tag; receives post first, sends are eager
+  DtView sv, rv;
+  if (!resolve_dtype(sendtype, sv) || !resolve_dtype(recvtype, rv))
+    return MPI_ERR_TYPE;
+  int n = (int)c.group.size(), me = c.local_rank;
+  for (int r = 0; r < n; r++)
+    if (sendcounts[r] < 0 || recvcounts[r] < 0 || sdispls[r] < 0 ||
+        rdispls[r] < 0)
+      return MPI_ERR_ARG;
+  int64_t tag = (c.coll_seq++ % 0x8000) << 16 | 0x7E11;
+  size_t sstride = slot_bytes(sv, 1), rstride = slot_bytes(rv, 1);
+  std::vector<Req> reqs(n);
+  std::vector<int> handles(n, -1);
+  auto abort_all = [&](int err) {
+    std::lock_guard<std::mutex> lk(g.match_mu);
+    for (int i = 0; i < n; i++)
+      if (handles[i] >= 0) deregister_locked(handles[i], &reqs[i]);
+    return err;
+  };
+  for (int r = 0; r < n; r++) {
+    if (r == me || recvcounts[r] == 0) continue;
+    reqs[r].is_recv = true;
+    reqs[r].user_buf = (char *)recvbuf + (size_t)rdispls[r] * rstride;
+    reqs[r].count = recvcounts[r];
+    handles[r] = post_recv(&reqs[r], rv, c.cid_coll, world_of(c, r),
+                           tag);
+  }
+  for (int r = 0; r < n; r++) {
+    if (r == me || sendcounts[r] == 0) continue;
+    int rc = raw_send((const char *)sendbuf + (size_t)sdispls[r] * sstride,
+                      sendcounts[r], sendtype, world_of(c, r), tag,
+                      c.cid_coll);
+    if (rc != MPI_SUCCESS) return abort_all(rc);
+  }
+  // self block: straight pack/unpack through the convertor
+  if (sendcounts[me] > 0 || recvcounts[me] > 0) {
+    std::vector<char> packed;
+    pack_dtype((const char *)sendbuf + (size_t)sdispls[me] * sstride,
+               sendcounts[me], sv, packed);
+    unpack_dtype((char *)recvbuf + (size_t)rdispls[me] * rstride,
+                 recvcounts[me], rv, packed.data(), packed.size());
+  }
+  for (int r = 0; r < n; r++) {
+    if (handles[r] < 0) continue;
+    int rc = wait_handle(handles[r], nullptr);
+    handles[r] = -1;
+    if (rc != MPI_SUCCESS) return abort_all(rc);
+  }
+  return MPI_SUCCESS;
+}
+
+
 }  // namespace
 
 // ------------------------------------------------------------ C ABI
@@ -2699,12 +2780,14 @@ int MPI_Rsend(const void *buf, int count, MPI_Datatype dt, int dest,
 }
 
 // allocate an already-completed heap request and register it (the
-// eager-send/PROC_NULL request shape shared by Isend/Irecv/Ibsend)
-static int make_completed_req(MPI_Comm comm) {
+// eager-send/PROC_NULL request shape shared by Isend/Irecv/Ibsend);
+// hands the Req back so callers can stamp status without a re-lookup
+static int make_completed_req(MPI_Comm comm, Req **out = nullptr) {
   Req *r = new Req;
   r->complete = true;
   r->heap = true;
   r->comm = comm;
+  if (out) *out = r;
   std::lock_guard<std::mutex> lk(g.match_mu);
   int handle = g.next_req++;
   g.reqs[handle] = r;
@@ -2893,13 +2976,10 @@ int MPI_Irecv(void *buf, int count, MPI_Datatype dt, int source, int tag,
   DtView v;
   if (!resolve_dtype(dt, v)) return MPI_ERR_TYPE;
   if (source == MPI_PROC_NULL) {
-    int handle = make_completed_req(comm);
-    {
-      std::lock_guard<std::mutex> lk(g.match_mu);
-      Req *r = g.reqs[handle];
-      r->status.MPI_SOURCE = MPI_PROC_NULL;
-      r->status.MPI_TAG = MPI_ANY_TAG;
-    }
+    Req *r;
+    int handle = make_completed_req(comm, &r);
+    r->status.MPI_SOURCE = MPI_PROC_NULL;
+    r->status.MPI_TAG = MPI_ANY_TAG;
     *request = handle;
     return MPI_SUCCESS;
   }
@@ -3582,6 +3662,25 @@ int MPI_Reduce_scatter_block(const void *sendbuf, void *recvbuf,
   CommObj *c = lookup_comm(comm);
   if (!c) return MPI_ERR_COMM;
   return c_reduce_scatter_block(*c, sendbuf, recvbuf, recvcount, dt, op);
+}
+
+int MPI_Reduce_scatter(const void *sendbuf, void *recvbuf,
+                       const int recvcounts[], MPI_Datatype dt, MPI_Op op,
+                       MPI_Comm comm) {
+  CommObj *c = lookup_comm(comm);
+  if (!c) return MPI_ERR_COMM;
+  return c_reduce_scatter(*c, sendbuf, recvbuf, recvcounts, dt, op);
+}
+
+int MPI_Alltoallv(const void *sendbuf, const int sendcounts[],
+                  const int sdispls[], MPI_Datatype sendtype,
+                  void *recvbuf, const int recvcounts[],
+                  const int rdispls[], MPI_Datatype recvtype,
+                  MPI_Comm comm) {
+  CommObj *c = lookup_comm(comm);
+  if (!c) return MPI_ERR_COMM;
+  return c_alltoallv(*c, sendbuf, sendcounts, sdispls, sendtype, recvbuf,
+                     recvcounts, rdispls, recvtype);
 }
 
 // ------------------------------------------------------------ user ops

@@ -283,6 +283,14 @@ int MPI_Exscan(const void *sendbuf, void *recvbuf, int count,
 int MPI_Reduce_scatter_block(const void *sendbuf, void *recvbuf,
                              int recvcount, MPI_Datatype dt, MPI_Op op,
                              MPI_Comm comm);
+int MPI_Reduce_scatter(const void *sendbuf, void *recvbuf,
+                       const int recvcounts[], MPI_Datatype dt, MPI_Op op,
+                       MPI_Comm comm);
+int MPI_Alltoallv(const void *sendbuf, const int sendcounts[],
+                  const int sdispls[], MPI_Datatype sendtype,
+                  void *recvbuf, const int recvcounts[],
+                  const int rdispls[], MPI_Datatype recvtype,
+                  MPI_Comm comm);
 
 /* user-defined reduction operators */
 typedef void MPI_User_function(void *invec, void *inoutvec, int *len,
