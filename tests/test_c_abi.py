@@ -1554,6 +1554,21 @@ int main(int argc, char **argv) {
       return 6;
     }
   }
+  /* nonblocking forms of both, overlapped then waited */
+  for (i = 0; i < rtot; i++) rb[i] = -1;
+  MPI_Request nv[2];
+  if (MPI_Ialltoallv(sb, scnt, sdis, MPI_LONG, rb, rcnt, rdis, MPI_LONG,
+                     MPI_COMM_WORLD, &nv[0]) != MPI_SUCCESS) return 7;
+  long *mine2 = malloc((rank + 1) * sizeof(long));
+  if (MPI_Ireduce_scatter(contrib, mine2, counts, MPI_LONG, MPI_SUM,
+                          MPI_COMM_WORLD, &nv[1]) != MPI_SUCCESS)
+    return 8;
+  if (MPI_Waitall(2, nv, MPI_STATUSES_IGNORE) != MPI_SUCCESS) return 9;
+  for (r = 0; r < size; r++)
+    for (i = 0; i < rcnt[r]; i++)
+      if (rb[rdis[r] + i] != r * 1000 + rank) return 10;
+  for (i = 0; i < rank + 1; i++)
+    if (mine2[i] != mine[i]) return 11;
   MPI_Barrier(MPI_COMM_WORLD);
   printf("ragged rank %d/%d OK\n", rank, size);
   MPI_Finalize();

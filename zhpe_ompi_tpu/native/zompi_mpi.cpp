@@ -2007,19 +2007,14 @@ int c_allgatherv(CommObj &c, const void *sendbuf, int sendcount,
   return MPI_SUCCESS;
 }
 
+int c_reduce_scatter(CommObj &c, const void *sendbuf, void *recvbuf,
+                     const int recvcounts[], MPI_Datatype dt, MPI_Op op);
+
 int c_reduce_scatter_block(CommObj &c, const void *sendbuf, void *recvbuf,
                            int recvcount, MPI_Datatype dt, MPI_Op op) {
-  // reduce-to-0 then scatter (coll_base_reduce_scatter_block.c:55's
-  // linear shape)
-  DtView v;
-  if (!resolve_dtype(dt, v) || v.derived) return MPI_ERR_TYPE;
-  int n = (int)c.group.size(), me = c.local_rank;
-  size_t nbytes = (size_t)recvcount * n * v.di.item;
-  std::vector<char> full(me == 0 ? nbytes : 0);
-  int rc = c_reduce(c, sendbuf, full.data(), recvcount * n, dt, op, 0);
-  if (rc) return rc;
-  return c_scatter(c, full.data(), recvcount, dt, recvbuf, recvcount,
-                   dt, 0);
+  // the uniform-counts case of the ragged form (same 2 coll_seq slots)
+  std::vector<int> counts(c.group.size(), recvcount);
+  return c_reduce_scatter(c, sendbuf, recvbuf, counts.data(), dt, op);
 }
 
 int c_reduce_scatter(CommObj &c, const void *sendbuf, void *recvbuf,
@@ -4192,6 +4187,48 @@ int MPI_Ireduce_scatter_block(const void *sendbuf, void *recvbuf,
       [=]() {
         return c_reduce_scatter_block(*snap, sendbuf, recvbuf, recvcount,
                                       dt, op);
+      },
+      comm, request);
+}
+
+int MPI_Ireduce_scatter(const void *sendbuf, void *recvbuf,
+                        const int recvcounts[], MPI_Datatype dt,
+                        MPI_Op op, MPI_Comm comm, MPI_Request *request) {
+  CommObj *c = lookup_comm(comm);
+  if (!c) return MPI_ERR_COMM;
+  int n = (int)c->group.size();
+  auto counts = std::make_shared<std::vector<int>>(recvcounts,
+                                                   recvcounts + n);
+  auto snap = icoll_reserve(c, 2);  // reduce + scatterv under the hood
+  return icoll_spawn(
+      [=]() {
+        return c_reduce_scatter(*snap, sendbuf, recvbuf, counts->data(),
+                                dt, op);
+      },
+      comm, request);
+}
+
+int MPI_Ialltoallv(const void *sendbuf, const int sendcounts[],
+                   const int sdispls[], MPI_Datatype sendtype,
+                   void *recvbuf, const int recvcounts[],
+                   const int rdispls[], MPI_Datatype recvtype,
+                   MPI_Comm comm, MPI_Request *request) {
+  CommObj *c = lookup_comm(comm);
+  if (!c) return MPI_ERR_COMM;
+  int n = (int)c->group.size();
+  // MPI lets the caller reuse the count/displacement arrays the moment
+  // the call returns — snapshot them for the background thread
+  auto sc = std::make_shared<std::vector<int>>(sendcounts, sendcounts + n);
+  auto sd = std::make_shared<std::vector<int>>(sdispls, sdispls + n);
+  auto rc_ = std::make_shared<std::vector<int>>(recvcounts,
+                                                recvcounts + n);
+  auto rd = std::make_shared<std::vector<int>>(rdispls, rdispls + n);
+  auto snap = icoll_reserve(c);
+  return icoll_spawn(
+      [=]() {
+        return c_alltoallv(*snap, sendbuf, sc->data(), sd->data(),
+                           sendtype, recvbuf, rc_->data(), rd->data(),
+                           recvtype);
       },
       comm, request);
 }

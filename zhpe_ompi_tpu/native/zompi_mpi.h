@@ -402,6 +402,14 @@ int MPI_Iexscan(const void *sendbuf, void *recvbuf, int count,
 int MPI_Ireduce_scatter_block(const void *sendbuf, void *recvbuf,
                               int recvcount, MPI_Datatype dt, MPI_Op op,
                               MPI_Comm comm, MPI_Request *request);
+int MPI_Ireduce_scatter(const void *sendbuf, void *recvbuf,
+                        const int recvcounts[], MPI_Datatype dt,
+                        MPI_Op op, MPI_Comm comm, MPI_Request *request);
+int MPI_Ialltoallv(const void *sendbuf, const int sendcounts[],
+                   const int sdispls[], MPI_Datatype sendtype,
+                   void *recvbuf, const int recvcounts[],
+                   const int rdispls[], MPI_Datatype recvtype,
+                   MPI_Comm comm, MPI_Request *request);
 
 /* Cartesian topology (ompi/mpi/c/cart_create.c:45 family) */
 int MPI_Dims_create(int nnodes, int ndims, int dims[]);
