@@ -1569,6 +1569,50 @@ int main(int argc, char **argv) {
       if (rb[rdis[r] + i] != r * 1000 + rank) return 10;
   for (i = 0; i < rank + 1; i++)
     if (mine2[i] != mine[i]) return 11;
+  /* nonblocking v-gather/scatter/allgather: ragged blocks, root 0 */
+  {
+    long *mysend = malloc((rank + 1) * sizeof(long));
+    int k;
+    for (k = 0; k < rank + 1; k++) mysend[k] = rank * 100 + k;
+    long *gath = NULL; int *gc = NULL, *gd = NULL;
+    if (rank == 0) {
+      gc = malloc(size * sizeof(int)); gd = malloc(size * sizeof(int));
+      int off = 0;
+      for (r = 0; r < size; r++) { gc[r] = r + 1; gd[r] = off; off += r + 1; }
+      gath = malloc(off * sizeof(long));
+      for (k = 0; k < off; k++) gath[k] = -1;
+    }
+    MPI_Request vr;
+    if (MPI_Igatherv(mysend, rank + 1, MPI_LONG, gath, gc, gd, MPI_LONG,
+                     0, MPI_COMM_WORLD, &vr) != MPI_SUCCESS) return 12;
+    MPI_Wait(&vr, MPI_STATUS_IGNORE);
+    if (rank == 0) {
+      for (r = 0; r < size; r++)
+        for (k = 0; k < r + 1; k++)
+          if (gath[gd[r] + k] != r * 100 + k) return 13;
+      /* scatter it back, each rank gets its own ragged block */
+    }
+    long *back2 = malloc((rank + 1) * sizeof(long));
+    MPI_Request sv;
+    if (MPI_Iscatterv(gath, gc, gd, MPI_LONG, back2, rank + 1, MPI_LONG,
+                      0, MPI_COMM_WORLD, &sv) != MPI_SUCCESS) return 14;
+    MPI_Wait(&sv, MPI_STATUS_IGNORE);
+    for (k = 0; k < rank + 1; k++)
+      if (back2[k] != rank * 100 + k) return 15;
+    /* allgatherv: every rank ends with the full ragged layout */
+    int *ac = malloc(size * sizeof(int)), *ad = malloc(size * sizeof(int));
+    int off2 = 0;
+    for (r = 0; r < size; r++) { ac[r] = r + 1; ad[r] = off2; off2 += r + 1; }
+    long *all = malloc(off2 * sizeof(long));
+    for (k = 0; k < off2; k++) all[k] = -1;
+    MPI_Request av;
+    if (MPI_Iallgatherv(mysend, rank + 1, MPI_LONG, all, ac, ad, MPI_LONG,
+                        MPI_COMM_WORLD, &av) != MPI_SUCCESS) return 16;
+    MPI_Wait(&av, MPI_STATUS_IGNORE);
+    for (r = 0; r < size; r++)
+      for (k = 0; k < r + 1; k++)
+        if (all[ad[r] + k] != r * 100 + k) return 17;
+  }
   MPI_Barrier(MPI_COMM_WORLD);
   printf("ragged rank %d/%d OK\n", rank, size);
   MPI_Finalize();

@@ -4191,6 +4191,84 @@ int MPI_Ireduce_scatter_block(const void *sendbuf, void *recvbuf,
       comm, request);
 }
 
+namespace {
+
+// snapshot an int array the caller may reuse at return (MPI rule);
+// roots_only captures nothing on non-roots (they may legally pass
+// NULL).  data_or_null() is the unwrap the c_* helpers expect.
+struct IcollArray {
+  std::shared_ptr<std::vector<int>> v;
+  IcollArray(const int *p, int n, bool capture)
+      : v(std::make_shared<std::vector<int>>(
+            capture ? std::vector<int>(p, p + n) : std::vector<int>())) {}
+  const int *data_or_null() const {
+    return v->empty() ? nullptr : v->data();
+  }
+};
+
+}  // namespace
+
+int MPI_Igatherv(const void *sendbuf, int sendcount,
+                 MPI_Datatype sendtype, void *recvbuf,
+                 const int recvcounts[], const int displs[],
+                 MPI_Datatype recvtype, int root, MPI_Comm comm,
+                 MPI_Request *request) {
+  CommObj *c = lookup_comm(comm);
+  if (!c) return MPI_ERR_COMM;
+  if (root < 0 || root >= (int)c->group.size()) return MPI_ERR_ARG;
+  int n = (int)c->group.size();
+  bool im_root = c->local_rank == root;
+  IcollArray rc_(recvcounts, n, im_root), dp(displs, n, im_root);
+  auto snap = icoll_reserve(c);
+  return icoll_spawn(
+      [=]() {
+        return c_gatherv(*snap, sendbuf, sendcount, sendtype, recvbuf,
+                         rc_.data_or_null(), dp.data_or_null(), recvtype,
+                         root);
+      },
+      comm, request);
+}
+
+int MPI_Iscatterv(const void *sendbuf, const int sendcounts[],
+                  const int displs[], MPI_Datatype sendtype,
+                  void *recvbuf, int recvcount, MPI_Datatype recvtype,
+                  int root, MPI_Comm comm, MPI_Request *request) {
+  CommObj *c = lookup_comm(comm);
+  if (!c) return MPI_ERR_COMM;
+  if (root < 0 || root >= (int)c->group.size()) return MPI_ERR_ARG;
+  int n = (int)c->group.size();
+  bool im_root = c->local_rank == root;
+  IcollArray sc(sendcounts, n, im_root), dp(displs, n, im_root);
+  auto snap = icoll_reserve(c);
+  return icoll_spawn(
+      [=]() {
+        return c_scatterv(*snap, sendbuf, sc.data_or_null(),
+                          dp.data_or_null(), sendtype, recvbuf,
+                          recvcount, recvtype, root);
+      },
+      comm, request);
+}
+
+int MPI_Iallgatherv(const void *sendbuf, int sendcount,
+                    MPI_Datatype sendtype, void *recvbuf,
+                    const int recvcounts[], const int displs[],
+                    MPI_Datatype recvtype, MPI_Comm comm,
+                    MPI_Request *request) {
+  CommObj *c = lookup_comm(comm);
+  if (!c) return MPI_ERR_COMM;
+  int n = (int)c->group.size();
+  auto rc_ = std::make_shared<std::vector<int>>(recvcounts,
+                                                recvcounts + n);
+  auto dp = std::make_shared<std::vector<int>>(displs, displs + n);
+  auto snap = icoll_reserve(c, n);  // n rooted broadcasts inside
+  return icoll_spawn(
+      [=]() {
+        return c_allgatherv(*snap, sendbuf, sendcount, sendtype, recvbuf,
+                            rc_->data(), dp->data(), recvtype);
+      },
+      comm, request);
+}
+
 int MPI_Ireduce_scatter(const void *sendbuf, void *recvbuf,
                         const int recvcounts[], MPI_Datatype dt,
                         MPI_Op op, MPI_Comm comm, MPI_Request *request) {
