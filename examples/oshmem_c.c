@@ -85,6 +85,27 @@ int main(void) {
   shmem_broadcastmem(&dst, &src, sizeof dst, 1);
   if (dst != 2.718) return 10;
 
+  /* implicit-handle nonblocking RMA: nb put to the right neighbor and
+   * nb gets from every PE, all completing at one quiet */
+  long *nbv = shmem_malloc(sizeof(long));
+  *nbv = me * 7;
+  shmem_barrier_all();
+  long mark = me * 7 + 1000;
+  shmem_putmem_nbi(nbv, &mark, sizeof mark, (me + 1) % n);
+  shmem_quiet();
+  shmem_barrier_all();
+  if (*nbv != ((me + n - 1) % n) * 7 + 1000) return 11;
+  long *fetched = malloc(n * sizeof(long));
+  for (int p = 0; p < n; p++) {
+    fetched[p] = -1;
+    shmem_getmem_nbi(&fetched[p], nbv, sizeof(long), p);
+  }
+  shmem_quiet();
+  for (int p = 0; p < n; p++)
+    if (fetched[p] != ((p + n - 1) % n) * 7 + 1000) return 12;
+  free(fetched);
+  shmem_free(nbv);
+
   shmem_free(gathered);
   shmem_free(ring);
   shmem_barrier_all();

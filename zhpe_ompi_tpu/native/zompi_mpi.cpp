@@ -4257,14 +4257,13 @@ int MPI_Iallgatherv(const void *sendbuf, int sendcount,
   CommObj *c = lookup_comm(comm);
   if (!c) return MPI_ERR_COMM;
   int n = (int)c->group.size();
-  auto rc_ = std::make_shared<std::vector<int>>(recvcounts,
-                                                recvcounts + n);
-  auto dp = std::make_shared<std::vector<int>>(displs, displs + n);
+  IcollArray rc_(recvcounts, n, true), dp(displs, n, true);
   auto snap = icoll_reserve(c, n);  // n rooted broadcasts inside
   return icoll_spawn(
       [=]() {
         return c_allgatherv(*snap, sendbuf, sendcount, sendtype, recvbuf,
-                            rc_->data(), dp->data(), recvtype);
+                            rc_.data_or_null(), dp.data_or_null(),
+                            recvtype);
       },
       comm, request);
 }
@@ -4916,6 +4915,83 @@ int MPI_Get(void *origin_addr, int origin_count,
   } else {
     unpack_dtype(origin_addr, origin_count, ov, raw.data(), nbytes);
   }
+  return MPI_SUCCESS;
+}
+
+/* Nonblocking window get (the shmem_get_nbi substrate): posts the
+ * reply recv into `dest` and fires the wget RPC, returning a request
+ * handle the caller completes with zompi_win_get_wait (normally from
+ * shmem_quiet).  Not part of mpi.h. */
+int zompi_win_get_start(MPI_Win win, int target_rank,
+                        long long disp_bytes, long long nbytes,
+                        void *dest, int *handle_out) {
+  int64_t wid;
+  WinObj *w = lookup_win(win, &wid);
+  if (!w) return MPI_ERR_WIN;
+  CommObj &c = w->comm;
+  if (target_rank < 0 || target_rank >= (int)c.group.size())
+    return MPI_ERR_ARG;
+  if (nbytes <= 0 || nbytes > 0x7FFFFFFFll || disp_bytes < 0)
+    return MPI_ERR_ARG;
+  int tw = world_of(c, target_rank);
+  if (tw == g.rank) {
+    if (disp_bytes + nbytes > w->size) return MPI_ERR_ARG;
+    {
+      std::lock_guard<std::mutex> lk(w->mu);
+      memcpy(dest, w->base + disp_bytes, (size_t)nbytes);
+    }
+    Req *r;
+    *handle_out = make_completed_req(MPI_COMM_WORLD, &r);
+    r->status._count = nbytes;
+    return MPI_SUCCESS;
+  }
+  int64_t rtag = g_next_reply_tag.fetch_add(1);
+  Req *r = new Req;
+  r->is_recv = true;
+  r->heap = true;
+  r->user_buf = dest;
+  r->count = (int)nbytes;
+  DtView bv;
+  bv.di = {"|u1", 1};
+  int handle = post_recv(r, bv, WIN_CID, tw, rtag);
+  std::string t;
+  t.push_back((char)T_TUPLE);
+  put_varint(t, 5);
+  put_str(t, "wget");
+  put_int(t, wid);
+  put_int(t, disp_bytes);
+  put_int(t, nbytes);
+  put_int(t, rtag);
+  int rc = win_send_tuple(tw, t);
+  if (rc != MPI_SUCCESS) {
+    std::lock_guard<std::mutex> lk(g.match_mu);
+    deregister_locked(handle, r);
+    delete r;
+    return rc;
+  }
+  *handle_out = handle;
+  return MPI_SUCCESS;
+}
+
+std::map<int, long long> g_nbi_want;  // handle -> expected reply bytes
+std::mutex g_nbi_want_mu;
+
+int zompi_win_get_wait(int handle) {
+  long long want = -1;
+  {
+    std::lock_guard<std::mutex> lk(g_nbi_want_mu);
+    auto it = g_nbi_want.find(handle);
+    if (it != g_nbi_want.end()) {
+      want = it->second;
+      g_nbi_want.erase(it);
+    }
+  }
+  MPI_Status st{};
+  int rc = wait_handle_impl(handle, &st, g.cts_timeout);
+  if (rc != MPI_SUCCESS) return rc;
+  // the target answers out-of-range requests with an EMPTY reply
+  // (blocking MPI_Get has the same check): a short reply must surface
+  if (want >= 0 && st._count != want) return MPI_ERR_ARG;
   return MPI_SUCCESS;
 }
 

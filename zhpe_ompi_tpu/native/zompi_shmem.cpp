@@ -25,7 +25,13 @@ int zompi_win_amo(MPI_Win win, int target_rank, long long disp_bytes,
                   const char *subkind, MPI_Datatype dt,
                   const void *operand, int operand_items, void *old_out);
 int zompi_win_flush(MPI_Win win);
+int zompi_win_get_start(MPI_Win win, int target_rank,
+                        long long disp_bytes, long long nbytes,
+                        void *dest, int *handle_out);
+int zompi_win_get_wait(int handle);
 }
+
+#include <vector>
 
 namespace {
 
@@ -43,6 +49,9 @@ struct ShmemState {
   std::map<size_t, size_t> free_list;  // offset -> size
   std::map<size_t, size_t> allocated;  // offset -> aligned size
   std::mutex alloc_mu;
+  // implicit-handle nonblocking gets completing at shmem_quiet
+  std::vector<int> pending_gets;
+  std::mutex nbi_mu;
 };
 
 ShmemState s;
@@ -83,6 +92,9 @@ int shmem_init(void) {
 
 void shmem_finalize(void) {
   if (!s.up) return;
+  // the spec's implicit quiet: pending nbi gets complete and puts
+  // flush BEFORE the window dies under them
+  shmem_quiet();
   MPI_Barrier(MPI_COMM_WORLD);
   MPI_Win_free(&s.win);
   free(s.heap);
@@ -173,7 +185,23 @@ void shmem_free(void *ptr) {
 /* ---- completion ---- */
 
 void shmem_quiet(void) {
-  if (s.up) zompi_win_flush(s.win);
+  if (!s.up) return;
+  // complete pending nbi gets first, then flush outstanding puts; a
+  // failing get must not abandon the rest (drain everything, abort at
+  // the end — the OpenSHMEM APIs have no error channel)
+  std::vector<int> pend;
+  {
+    std::lock_guard<std::mutex> lk(s.nbi_mu);
+    pend.swap(s.pending_gets);
+  }
+  bool failed = false;
+  for (int h : pend)
+    if (zompi_win_get_wait(h) != MPI_SUCCESS) failed = true;
+  if (zompi_win_flush(s.win) != MPI_SUCCESS) failed = true;
+  if (failed) {
+    fprintf(stderr, "zompi_shmem: quiet failed to complete nbi ops\n");
+    abort();
+  }
 }
 
 void shmem_fence(void) {
@@ -223,6 +251,31 @@ void shmem_getmem(void *dest, const void *source, size_t nbytes, int pe) {
       fprintf(stderr, "zompi_shmem: get from PE %d failed\n", pe);
       abort();
     }
+  }
+}
+
+void shmem_putmem_nbi(void *dest, const void *source, size_t nbytes,
+                      int pe) {
+  /* puts are fire-and-forget AMs already: the blocking form IS the
+     nbi contract (completion no later than quiet) */
+  shmem_putmem(dest, source, nbytes, pe);
+}
+
+void shmem_getmem_nbi(void *dest, const void *source, size_t nbytes,
+                      int pe) {
+  long long d = disp_of(source);
+  if (d < 0) return;
+  char *dst = (char *)dest;
+  for (size_t off = 0; off < nbytes; off += CHUNK) {
+    size_t n = nbytes - off < CHUNK ? nbytes - off : CHUNK;
+    int handle = -1;
+    if (zompi_win_get_start(s.win, pe, d + (long long)off, (long long)n,
+                            dst + off, &handle) != MPI_SUCCESS) {
+      fprintf(stderr, "zompi_shmem: get_nbi from PE %d failed\n", pe);
+      abort();
+    }
+    std::lock_guard<std::mutex> lk(s.nbi_mu);
+    s.pending_gets.push_back(handle);
   }
 }
 

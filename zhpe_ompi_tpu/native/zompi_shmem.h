@@ -49,6 +49,12 @@ void shmem_barrier_all(void);
 /* contiguous RMA (shmem_put.c / shmem_get.c family) */
 void shmem_putmem(void *dest, const void *source, size_t nbytes, int pe);
 void shmem_getmem(void *dest, const void *source, size_t nbytes, int pe);
+/* implicit-handle nonblocking RMA (shmem_put_nb.c / shmem_get_nb.c):
+ * completion no later than shmem_quiet / shmem_barrier_all */
+void shmem_putmem_nbi(void *dest, const void *source, size_t nbytes,
+                      int pe);
+void shmem_getmem_nbi(void *dest, const void *source, size_t nbytes,
+                      int pe);
 void shmem_long_put(long *dest, const long *source, size_t nelems, int pe);
 void shmem_long_get(long *dest, const long *source, size_t nelems, int pe);
 void shmem_double_put(double *dest, const double *source, size_t nelems,
