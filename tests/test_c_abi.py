@@ -1633,3 +1633,97 @@ int main(int argc, char **argv) {
             out, err = p.communicate(timeout=120)
             assert p.returncode == 0, f"rank {r} failed: {err}\n{out}"
             assert f"ragged rank {r}/{n} OK" in out
+
+    def test_intercommunicators(self, shim, tmp_path):
+        """MPI_Intercomm_create between the two halves of a split world:
+        remote-group pt2pt both ways (ranks address the REMOTE group),
+        remote_size/test_inter, collectives rejected on the intercomm,
+        and Intercomm_merge reconstructing a working intracommunicator
+        with the high group second."""
+        src = tmp_path / "inter.c"
+        src.write_text(r'''
+#include <stdio.h>
+#include <stdlib.h>
+#include "zompi_mpi.h"
+int main(int argc, char **argv) {
+  int rank, size;
+  if (MPI_Init(&argc, &argv) != MPI_SUCCESS) return 2;
+  MPI_Comm_rank(MPI_COMM_WORLD, &rank);
+  MPI_Comm_size(MPI_COMM_WORLD, &size);
+  if (size < 4 || size % 2) { MPI_Finalize(); return 0; }
+  int half = size / 2, low = rank < half;
+  MPI_Comm mine;
+  MPI_Comm_split(MPI_COMM_WORLD, low, rank, &mine);
+  /* leaders: local rank 0 of each half; peer_comm = WORLD */
+  MPI_Comm inter;
+  if (MPI_Intercomm_create(mine, 0, MPI_COMM_WORLD, low ? half : 0, 99,
+                           &inter) != MPI_SUCCESS) return 3;
+  int flag = 0, rsize = -1, lrank = -1, lsize = -1;
+  MPI_Comm_test_inter(inter, &flag);
+  if (!flag) return 4;
+  MPI_Comm_remote_size(inter, &rsize);
+  if (rsize != half) return 5;
+  MPI_Comm_rank(inter, &lrank);
+  MPI_Comm_size(inter, &lsize);
+  if (lsize != half) return 6;
+  /* pt2pt across: low rank i <-> high rank i (REMOTE addressing) */
+  long v = rank * 11, got = -1;
+  MPI_Status st;
+  if (low) {
+    MPI_Send(&v, 1, MPI_LONG, lrank, 5, inter);
+    MPI_Recv(&got, 1, MPI_LONG, lrank, 6, inter, &st);
+    if (got != (lrank + half) * 11L) return 7;
+    if (st.MPI_SOURCE != lrank) return 8;  /* remote-group rank */
+  } else {
+    MPI_Recv(&got, 1, MPI_LONG, lrank, 5, inter, &st);
+    if (got != (long)lrank * 11) return 9;
+    MPI_Send(&v, 1, MPI_LONG, lrank, 6, inter);
+  }
+  /* collectives are an intra surface: loudly rejected here */
+  long s1 = 1, s2 = 0;
+  if (MPI_Allreduce(&s1, &s2, 1, MPI_LONG, MPI_SUM, inter)
+      != MPI_ERR_COMM) return 10;
+  /* merge: low group passes high=0, high group high=1 -> world order */
+  MPI_Comm flat;
+  if (MPI_Intercomm_merge(inter, low ? 0 : 1, &flat) != MPI_SUCCESS)
+    return 11;
+  int frank = -1, fsize = -1;
+  MPI_Comm_rank(flat, &frank);
+  MPI_Comm_size(flat, &fsize);
+  if (fsize != size || frank != rank) return 12;
+  long fv = rank + 1, fsum = 0;
+  if (MPI_Allreduce(&fv, &fsum, 1, MPI_LONG, MPI_SUM, flat)
+      != MPI_SUCCESS) return 13;
+  if (fsum != (long)size * (size + 1) / 2) return 14;
+  /* a SECOND merge of the same intercomm with EQUAL (erroneous) flags:
+     the leaders detect it and both sides fall back to the same
+     deterministic order (low world ranks first), on fresh cids */
+  MPI_Comm flat2;
+  if (MPI_Intercomm_merge(inter, 1, &flat2) != MPI_SUCCESS) return 15;
+  int f2rank = -1;
+  MPI_Comm_rank(flat2, &f2rank);
+  if (f2rank != rank) return 16;  /* low group first -> world order */
+  long f2sum = 0;
+  if (MPI_Allreduce(&fv, &f2sum, 1, MPI_LONG, MPI_SUM, flat2)
+      != MPI_SUCCESS) return 17;
+  if (f2sum != (long)size * (size + 1) / 2) return 18;
+  MPI_Barrier(MPI_COMM_WORLD);
+  printf("inter rank %d/%d OK\n", rank, size);
+  MPI_Finalize();
+  return 0;
+}
+''')
+        binpath = tmp_path / "inter"
+        _compile_c(shim, src, binpath)
+        port = _free_port()
+        n = 4
+        procs = [
+            subprocess.Popen([str(binpath)], env=_env(r, n, port),
+                             stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                             text=True)
+            for r in range(n)
+        ]
+        for r, p in enumerate(procs):
+            out, err = p.communicate(timeout=120)
+            assert p.returncode == 0, f"rank {r} failed: {err}\n{out}"
+            assert f"inter rank {r}/{n} OK" in out

@@ -1147,6 +1147,9 @@ struct CommObj {
   int64_t coll_seq = 0;
   uint64_t child_seq = 0;
   uint64_t win_seq = 0;               // per-comm window-id sequence
+  // intercommunicator: non-empty => pt2pt addresses THIS group (remote
+  // ranks), local_rank/group stay the local side (intercomm_create.c)
+  std::vector<int> remote;
   std::vector<int> cart_dims;         // non-empty => Cartesian topology
   std::vector<int> cart_periods;
   std::vector<int> graph_index;       // non-empty => graph topology
@@ -1200,6 +1203,24 @@ int world_of(const CommObj &c, int local) {
 int local_of(const CommObj &c, int world) {
   for (size_t i = 0; i < c.group.size(); i++)
     if (c.group[i] == world) return (int)i;
+  return MPI_ANY_SOURCE;
+}
+
+// point-to-point PEER group: on an intercommunicator ranks address the
+// REMOTE group (MPI-3.1 6.6.1); intracommunicators address themselves
+const std::vector<int> &peer_group(const CommObj &c) {
+  return c.remote.empty() ? c.group : c.remote;
+}
+
+int peer_world_of(const CommObj &c, int rank) {
+  const std::vector<int> &pg = peer_group(c);
+  return (rank >= 0 && rank < (int)pg.size()) ? pg[rank] : -1;
+}
+
+int peer_local_of(const CommObj &c, int world) {
+  const std::vector<int> &pg = peer_group(c);
+  for (size_t i = 0; i < pg.size(); i++)
+    if (pg[i] == world) return (int)i;
   return MPI_ANY_SOURCE;
 }
 
@@ -1614,6 +1635,7 @@ int send_barrier_signal(CommObj &c, int dest_world) {
 }
 
 int c_barrier(CommObj &c) {
+  if (!c.remote.empty()) return MPI_ERR_COMM;  // intercomm: pt2pt surface
   // dissemination rounds (tag 0x7FFD), wire-identical to TcpProc.barrier
   int n = (int)c.group.size(), me = c.local_rank;
   for (int64_t k = 1; k < n; k <<= 1) {
@@ -1631,6 +1653,7 @@ int c_barrier(CommObj &c) {
 
 int c_bcast(CommObj &c, void *buf, int count, MPI_Datatype dt, int root,
             int64_t opcode) {
+  if (!c.remote.empty()) return MPI_ERR_COMM;  // intercomm: pt2pt surface
   // binomial tree (coll_base_bcast.c:329 shape)
   int n = (int)c.group.size(), me = c.local_rank;
   int64_t tag = (c.coll_seq++ % 0x8000) << 16 | opcode;
@@ -1656,6 +1679,7 @@ int c_bcast(CommObj &c, void *buf, int count, MPI_Datatype dt, int root,
 
 int c_allreduce(CommObj &c, const void *sendbuf, void *recvbuf, int count,
                 MPI_Datatype dt, MPI_Op op) {
+  if (!c.remote.empty()) return MPI_ERR_COMM;  // intercomm: pt2pt surface
   // recursive doubling with the non-power-of-two pre/post fold
   // (in-order combines: lower rank's operand left)
   DtView v;
@@ -1729,6 +1753,7 @@ int c_allreduce(CommObj &c, const void *sendbuf, void *recvbuf, int count,
 
 int c_reduce(CommObj &c, const void *sendbuf, void *recvbuf, int count,
              MPI_Datatype dt, MPI_Op op, int root) {
+  if (!c.remote.empty()) return MPI_ERR_COMM;  // intercomm: pt2pt surface
   // linear with rank-ordered combine (coll/basic shape): correct for
   // non-commutative user expectations, O(p) small messages at root
   DtView v;
@@ -1764,6 +1789,7 @@ int c_reduce(CommObj &c, const void *sendbuf, void *recvbuf, int count,
 int c_gather(CommObj &c, const void *sendbuf, int sendcount,
              MPI_Datatype sendtype, void *recvbuf, int recvcount,
              MPI_Datatype recvtype, int root) {
+  if (!c.remote.empty()) return MPI_ERR_COMM;  // intercomm: pt2pt surface
   // linear (coll_base_gather.c:41's basic shape)
   int n = (int)c.group.size(), me = c.local_rank;
   int64_t tag = (c.coll_seq++ % 0x8000) << 16 | 0x7E05;
@@ -1793,6 +1819,7 @@ int c_gather(CommObj &c, const void *sendbuf, int sendcount,
 int c_scatter(CommObj &c, const void *sendbuf, int sendcount,
               MPI_Datatype sendtype, void *recvbuf, int recvcount,
               MPI_Datatype recvtype, int root) {
+  if (!c.remote.empty()) return MPI_ERR_COMM;  // intercomm: pt2pt surface
   // linear (coll_base_scatter.c's basic shape)
   int n = (int)c.group.size(), me = c.local_rank;
   int64_t tag = (c.coll_seq++ % 0x8000) << 16 | 0x7E06;
@@ -1822,6 +1849,7 @@ int c_scatter(CommObj &c, const void *sendbuf, int sendcount,
 int c_allgather(CommObj &c, const void *sendbuf, int sendcount,
                 MPI_Datatype sendtype, void *recvbuf, int recvcount,
                 MPI_Datatype recvtype) {
+  if (!c.remote.empty()) return MPI_ERR_COMM;  // intercomm: pt2pt surface
   // ring (coll_base_allgather.c:358 shape): n-1 rounds of pass-along
   int n = (int)c.group.size(), me = c.local_rank;
   int64_t tag = (c.coll_seq++ % 0x8000) << 16 | 0x7E07;
@@ -1855,6 +1883,7 @@ int c_allgather(CommObj &c, const void *sendbuf, int sendcount,
 int c_alltoall(CommObj &c, const void *sendbuf, int sendcount,
                MPI_Datatype sendtype, void *recvbuf, int recvcount,
                MPI_Datatype recvtype) {
+  if (!c.remote.empty()) return MPI_ERR_COMM;  // intercomm: pt2pt surface
   // pairwise exchange (coll_base_alltoall.c:132 shape); distinct tag
   // per round keeps matching unambiguous
   int n = (int)c.group.size(), me = c.local_rank;
@@ -1887,6 +1916,7 @@ int c_alltoall(CommObj &c, const void *sendbuf, int sendcount,
 
 int c_scan(CommObj &c, const void *sendbuf, void *recvbuf, int count,
            MPI_Datatype dt, MPI_Op op, bool exclusive) {
+  if (!c.remote.empty()) return MPI_ERR_COMM;  // intercomm: pt2pt surface
   // linear chain (coll_base_scan.c:35 / coll_base_exscan.c:35): rank r
   // receives the prefix of ranks < r, combines in rank order, forwards
   DtView v;
@@ -1925,6 +1955,7 @@ int c_scan(CommObj &c, const void *sendbuf, void *recvbuf, int count,
 int c_gatherv(CommObj &c, const void *sendbuf, int sendcount,
               MPI_Datatype sendtype, void *recvbuf, const int recvcounts[],
               const int displs[], MPI_Datatype recvtype, int root) {
+  if (!c.remote.empty()) return MPI_ERR_COMM;  // intercomm: pt2pt surface
   // linear with per-rank counts/displacements (displs in recvtype
   // extent units, the MPI contract)
   int n = (int)c.group.size(), me = c.local_rank;
@@ -1955,6 +1986,7 @@ int c_gatherv(CommObj &c, const void *sendbuf, int sendcount,
 int c_scatterv(CommObj &c, const void *sendbuf, const int sendcounts[],
                const int displs[], MPI_Datatype sendtype, void *recvbuf,
                int recvcount, MPI_Datatype recvtype, int root) {
+  if (!c.remote.empty()) return MPI_ERR_COMM;  // intercomm: pt2pt surface
   int n = (int)c.group.size(), me = c.local_rank;
   int64_t tag = (c.coll_seq++ % 0x8000) << 16 | 0x7E0B;
   if (me != root)
@@ -1984,6 +2016,7 @@ int c_allgatherv(CommObj &c, const void *sendbuf, int sendcount,
                  MPI_Datatype sendtype, void *recvbuf,
                  const int recvcounts[], const int displs[],
                  MPI_Datatype recvtype) {
+  if (!c.remote.empty()) return MPI_ERR_COMM;  // intercomm: pt2pt surface
   // n rooted broadcasts of each rank's block into the (identical)
   // recv layout — simple and displacement-safe (gaps never touched)
   int n = (int)c.group.size(), me = c.local_rank;
@@ -2012,6 +2045,7 @@ int c_reduce_scatter(CommObj &c, const void *sendbuf, void *recvbuf,
 
 int c_reduce_scatter_block(CommObj &c, const void *sendbuf, void *recvbuf,
                            int recvcount, MPI_Datatype dt, MPI_Op op) {
+  if (!c.remote.empty()) return MPI_ERR_COMM;  // intercomm: pt2pt surface
   // the uniform-counts case of the ragged form (same 2 coll_seq slots)
   std::vector<int> counts(c.group.size(), recvcount);
   return c_reduce_scatter(c, sendbuf, recvbuf, counts.data(), dt, op);
@@ -2045,6 +2079,7 @@ int c_alltoallv(CommObj &c, const void *sendbuf, const int sendcounts[],
                 const int sdispls[], MPI_Datatype sendtype, void *recvbuf,
                 const int recvcounts[], const int rdispls[],
                 MPI_Datatype recvtype) {
+  if (!c.remote.empty()) return MPI_ERR_COMM;  // intercomm: pt2pt surface
   // alltoallv.c: ragged pairwise exchange — one message per ordered
   // pair under one reserved tag; receives post first, sends are eager
   DtView sv, rv;
@@ -2749,9 +2784,9 @@ int MPI_Send(const void *buf, int count, MPI_Datatype dt, int dest,
   if (!c) return MPI_ERR_COMM;
   if (dest == MPI_PROC_NULL) return MPI_SUCCESS;
   if (tag < 0) return MPI_ERR_ARG;
-  if (dest < 0 || dest >= (int)c->group.size()) return MPI_ERR_ARG;
-  return raw_send(buf, count, dt, world_of(*c, dest), tag, c->cid_pt2pt,
-                  /*allow_rndv=*/true);
+  if (dest < 0 || dest >= (int)peer_group(*c).size()) return MPI_ERR_ARG;
+  return raw_send(buf, count, dt, peer_world_of(*c, dest), tag,
+                  c->cid_pt2pt, /*allow_rndv=*/true);
 }
 
 int MPI_Ssend(const void *buf, int count, MPI_Datatype dt, int dest,
@@ -2763,9 +2798,9 @@ int MPI_Ssend(const void *buf, int count, MPI_Datatype dt, int dest,
   if (!c) return MPI_ERR_COMM;
   if (dest == MPI_PROC_NULL) return MPI_SUCCESS;
   if (tag < 0) return MPI_ERR_ARG;
-  if (dest < 0 || dest >= (int)c->group.size()) return MPI_ERR_ARG;
-  return raw_send(buf, count, dt, world_of(*c, dest), tag, c->cid_pt2pt,
-                  /*allow_rndv=*/true, /*force_rndv=*/true);
+  if (dest < 0 || dest >= (int)peer_group(*c).size()) return MPI_ERR_ARG;
+  return raw_send(buf, count, dt, peer_world_of(*c, dest), tag,
+                  c->cid_pt2pt, /*allow_rndv=*/true, /*force_rndv=*/true);
 }
 
 int MPI_Rsend(const void *buf, int count, MPI_Datatype dt, int dest,
@@ -2821,9 +2856,10 @@ int MPI_Bsend(const void *buf, int count, MPI_Datatype dt, int dest,
   if (!c) return MPI_ERR_COMM;
   if (dest == MPI_PROC_NULL) return MPI_SUCCESS;
   if (tag < 0) return MPI_ERR_ARG;
-  if (dest < 0 || dest >= (int)c->group.size()) return MPI_ERR_ARG;
+  if (dest < 0 || dest >= (int)peer_group(*c).size()) return MPI_ERR_ARG;
   // eager at any size: never blocks on the receiver
-  return raw_send(buf, count, dt, world_of(*c, dest), tag, c->cid_pt2pt);
+  return raw_send(buf, count, dt, peer_world_of(*c, dest), tag,
+                  c->cid_pt2pt);
 }
 
 int MPI_Ibsend(const void *buf, int count, MPI_Datatype dt, int dest,
@@ -2836,7 +2872,9 @@ int MPI_Ibsend(const void *buf, int count, MPI_Datatype dt, int dest,
 
 static int translate_status(CommObj *c, MPI_Status *status) {
   if (status && c) {
-    int local = local_of(*c, status->MPI_SOURCE);
+    // sources arrive as world ranks; on an intercommunicator they are
+    // ranks of the REMOTE group
+    int local = peer_local_of(*c, status->MPI_SOURCE);
     if (local != MPI_ANY_SOURCE) status->MPI_SOURCE = local;
   }
   return status ? status->MPI_ERROR : MPI_SUCCESS;
@@ -2859,7 +2897,7 @@ int MPI_Recv(void *buf, int count, MPI_Datatype dt, int source, int tag,
   if (!resolve_dtype(dt, v)) return MPI_ERR_TYPE;
   int src_world = source == MPI_ANY_SOURCE
                       ? MPI_ANY_SOURCE
-                      : world_of(*c, source);
+                      : peer_world_of(*c, source);
   if (source != MPI_ANY_SOURCE && src_world < 0) return MPI_ERR_ARG;
   MPI_Status st{};
   int rc = raw_recv(buf, count, dt, src_world, tag, c->cid_pt2pt, &st);
@@ -2898,7 +2936,8 @@ int MPI_Isend(const void *buf, int count, MPI_Datatype dt, int dest,
   int rc = MPI_SUCCESS;
   if (dest != MPI_PROC_NULL) {
     if (tag < 0) return MPI_ERR_ARG;
-    if (dest < 0 || dest >= (int)c->group.size()) return MPI_ERR_ARG;
+    if (dest < 0 || dest >= (int)peer_group(*c).size())
+      return MPI_ERR_ARG;
     DtView v;
     if (!resolve_dtype(dt, v)) return MPI_ERR_TYPE;
     int64_t nbytes =
@@ -2924,7 +2963,7 @@ int MPI_Isend(const void *buf, int count, MPI_Datatype dt, int dest,
         handle = g.next_req++;
         g.reqs[handle] = r;
       }
-      int dest_world = world_of(*c, dest);
+      int dest_world = peer_world_of(*c, dest);
       int64_t cid = c->cid_pt2pt;
       DtInfo di = v.di;
       // the ANNOUNCE goes out on THIS thread before Isend returns: its
@@ -2956,8 +2995,8 @@ int MPI_Isend(const void *buf, int count, MPI_Datatype dt, int dest,
       *request = handle;
       return MPI_SUCCESS;
     }
-    rc = raw_send(buf, count, dt, world_of(*c, dest), tag, c->cid_pt2pt,
-                  /*allow_rndv=*/true);
+    rc = raw_send(buf, count, dt, peer_world_of(*c, dest), tag,
+                  c->cid_pt2pt, /*allow_rndv=*/true);
     if (rc) return rc;
   }
   *request = make_completed_req(comm);
@@ -2980,7 +3019,7 @@ int MPI_Irecv(void *buf, int count, MPI_Datatype dt, int source, int tag,
   }
   int src_world = source == MPI_ANY_SOURCE
                       ? MPI_ANY_SOURCE
-                      : world_of(*c, source);
+                      : peer_world_of(*c, source);
   if (source != MPI_ANY_SOURCE && src_world < 0) return MPI_ERR_ARG;
   Req *r = new Req;
   r->is_recv = true;
@@ -3030,7 +3069,7 @@ int MPI_Send_init(const void *buf, int count, MPI_Datatype dt, int dest,
   CommObj *c = lookup_comm(comm);
   if (!c) return MPI_ERR_COMM;
   if (dest != MPI_PROC_NULL &&
-      (dest < 0 || dest >= (int)c->group.size()))
+      (dest < 0 || dest >= (int)peer_group(*c).size()))
     return MPI_ERR_ARG;
   MPI_Datatype pinned = pin_dtype(dt);
   if (pinned == MPI_DATATYPE_NULL) return MPI_ERR_TYPE;
@@ -3046,7 +3085,7 @@ int MPI_Recv_init(void *buf, int count, MPI_Datatype dt, int source,
   CommObj *c = lookup_comm(comm);
   if (!c) return MPI_ERR_COMM;
   if (source != MPI_ANY_SOURCE && source != MPI_PROC_NULL &&
-      (source < 0 || source >= (int)c->group.size()))
+      (source < 0 || source >= (int)peer_group(*c).size()))
     return MPI_ERR_ARG;
   MPI_Datatype pinned = pin_dtype(dt);
   if (pinned == MPI_DATATYPE_NULL) return MPI_ERR_TYPE;
@@ -3447,7 +3486,7 @@ namespace {
 int probe_impl(int source, int tag, CommObj *c, int *flag,
                MPI_Status *status, bool blocking) {
   int src_world = source == MPI_ANY_SOURCE ? MPI_ANY_SOURCE
-                                           : world_of(*c, source);
+                                           : peer_world_of(*c, source);
   if (source != MPI_ANY_SOURCE && src_world < 0) return MPI_ERR_ARG;
   std::unique_lock<std::mutex> lk(g.match_mu);
   while (true) {
@@ -4485,6 +4524,153 @@ int MPI_Comm_create(MPI_Comm comm, MPI_Group group, MPI_Comm *newcomm) {
   return MPI_Comm_split(comm, color, key, newcomm);
 }
 
+// ----------------------------------------------------- intercommunicators
+// intercomm_create.c / intercomm_merge.c: two disjoint groups of ONE
+// universe joined for remote-group point-to-point.  The context ids are
+// computed, not negotiated: both sides hash the same (sorted union of
+// world ranks, tag) so no extra agreement round exists — the same
+// collapse as the deterministic-cid communicator algebra.
+
+namespace {
+
+void intercomm_cids(const std::vector<int> &a, const std::vector<int> &b,
+                    int tag, CommObj &out) {
+  std::vector<int> all(a);
+  all.insert(all.end(), b.begin(), b.end());
+  std::sort(all.begin(), all.end());
+  uint64_t h = 0xCBF29CE484222325ULL ^ (uint64_t)(uint32_t)tag;
+  for (int r : all) h = mix64(h ^ (uint64_t)(uint32_t)r);
+  h = (h & 0x3FFFFFFFFFFFULL) | 0x10000ULL;
+  out.cid_pt2pt = (int64_t)h;
+  out.cid_coll = (int64_t)h + 1;
+  out.cid_bar = (int64_t)h + 2;
+}
+
+}  // namespace
+
+int MPI_Intercomm_create(MPI_Comm local_comm, int local_leader,
+                         MPI_Comm peer_comm, int remote_leader, int tag,
+                         MPI_Comm *newintercomm) {
+  CommObj *lc = lookup_comm(local_comm);
+  if (!lc || !lc->remote.empty()) return MPI_ERR_COMM;
+  if (local_leader < 0 || local_leader >= (int)lc->group.size())
+    return MPI_ERR_ARG;
+  int n = (int)lc->group.size(), me = lc->local_rank;
+  // the leaders swap group lists over peer_comm, then broadcast them
+  // inside their local comms (intercomm_create.c's two-phase shape)
+  std::vector<int> remote;
+  if (me == local_leader) {
+    CommObj *pc = lookup_comm(peer_comm);
+    if (!pc) return MPI_ERR_COMM;
+    long my_n = n;
+    long their_n = 0;
+    MPI_Status st{};
+    int rc = MPI_Sendrecv(&my_n, 1, MPI_LONG, remote_leader, tag,
+                          &their_n, 1, MPI_LONG, remote_leader, tag,
+                          peer_comm, &st);
+    if (rc != MPI_SUCCESS) return rc;
+    remote.resize((size_t)their_n);
+    rc = MPI_Sendrecv(lc->group.data(), n, MPI_INT, remote_leader, tag,
+                      remote.data(), (int)their_n, MPI_INT,
+                      remote_leader, tag, peer_comm, &st);
+    if (rc != MPI_SUCCESS) return rc;
+  }
+  long rn = (long)remote.size();
+  int rc = c_bcast(*lc, &rn, 1, MPI_LONG, local_leader, 0x7E12);
+  if (rc != MPI_SUCCESS) return rc;
+  remote.resize((size_t)rn);
+  rc = c_bcast(*lc, remote.data(), (int)rn, MPI_INT, local_leader,
+               0x7E13);
+  if (rc != MPI_SUCCESS) return rc;
+  CommObj inter;
+  inter.group = lc->group;
+  inter.local_rank = me;
+  inter.remote = remote;
+  intercomm_cids(lc->group, remote, tag, inter);
+  int handle = g_next_comm++;
+  g_comms[handle] = inter;
+  *newintercomm = handle;
+  return MPI_SUCCESS;
+}
+
+int MPI_Comm_remote_size(MPI_Comm comm, int *size) {
+  CommObj *c = lookup_comm(comm);
+  if (!c) return MPI_ERR_COMM;
+  if (c->remote.empty()) return MPI_ERR_COMM;  // intracommunicator
+  *size = (int)c->remote.size();
+  return MPI_SUCCESS;
+}
+
+int MPI_Comm_test_inter(MPI_Comm comm, int *flag) {
+  CommObj *c = lookup_comm(comm);
+  if (!c) return MPI_ERR_COMM;
+  *flag = c->remote.empty() ? 0 : 1;
+  return MPI_SUCCESS;
+}
+
+int MPI_Intercomm_merge(MPI_Comm intercomm, int high, MPI_Comm *newintra) {
+  // intercomm_merge.c: concatenate the two groups into one
+  // intracommunicator; the `high` group goes SECOND.  Both sides must
+  // pass complementary flags (spec requirement); equal flags fall back
+  // to a deterministic order (smaller leading world rank first) so the
+  // two sides still agree.
+  CommObj *c = lookup_comm(intercomm);
+  if (!c || c->remote.empty()) return MPI_ERR_COMM;
+  // the two sides' flags must actually be COMPARED: deciding the order
+  // from one side's flag alone silently diverges when both sides pass
+  // the same value (the cids still agree — the union hash is
+  // order-independent — so the corruption would be silent).  Leaders
+  // swap flags over the intercomm, then broadcast inside each group
+  // through a per-side local context derived from the intercomm cid.
+  long my_flag = high ? 1 : 0, their_flag = -1;
+  if (c->local_rank == 0) {
+    MPI_Status st{};
+    int rc = MPI_Sendrecv(&my_flag, 1, MPI_LONG, 0, 0x7E14, &their_flag,
+                          1, MPI_LONG, 0, 0x7E14, intercomm, &st);
+    if (rc != MPI_SUCCESS) return rc;
+  }
+  CommObj local_side;
+  local_side.group = c->group;
+  local_side.local_rank = c->local_rank;
+  intercomm_cids(c->group, {},
+                 (int)((c->cid_pt2pt ^ c->group.front()) & 0x3FFFFFFF),
+                 local_side);
+  int rc = c_bcast(local_side, &their_flag, 1, MPI_LONG, 0, 0x7E15);
+  if (rc != MPI_SUCCESS) return rc;
+  bool im_second;
+  if (my_flag != their_flag) {
+    im_second = my_flag == 1;  // the high group goes second (the spec)
+  } else {
+    // equal flags (erroneous per MPI, but detectable here): both sides
+    // fall back to the same deterministic order — smaller leading
+    // world rank first
+    im_second = !(c->group.front() < c->remote.front());
+  }
+  std::vector<int> first = im_second ? c->remote : c->group;
+  std::vector<int> second = im_second ? c->group : c->remote;
+  CommObj merged;
+  merged.group = first;
+  merged.group.insert(merged.group.end(), second.begin(), second.end());
+  int my_world = c->group[c->local_rank];
+  for (size_t i = 0; i < merged.group.size(); i++)
+    if (merged.group[i] == my_world) merged.local_rank = (int)i;
+  // cids keyed by the parent intercomm's cid AND a per-merge sequence
+  // (both sides advance it on every collective merge call), so repeated
+  // merges of one intercomm get distinct contexts — the comm_split
+  // child_seq discipline
+  intercomm_cids(first, second,
+                 (int)((c->cid_pt2pt ^
+                        (int64_t)(c->child_seq * 0x9E3779B1ULL)) &
+                       0x3FFFFFFF) ^
+                     0x4D52,
+                 merged);
+  c->child_seq++;
+  int handle = g_next_comm++;
+  g_comms[handle] = merged;
+  *newintra = handle;
+  return MPI_SUCCESS;
+}
+
 // ------------------------------------------------------ graph topology
 // graph_create.c family: arbitrary neighbor lists in the standard
 // index/edges encoding (index[i] = cumulative edge count through node i)
@@ -4626,6 +4812,7 @@ void neighbor_codes(CommObj &c, const std::vector<int> &nbrs,
 int c_neighbor_exchange(MPI_Comm comm, CommObj &c, const void *sendbuf,
                         int scount, MPI_Datatype stype, void *recvbuf,
                         int rcount, MPI_Datatype rtype, bool alltoall) {
+  if (!c.remote.empty()) return MPI_ERR_COMM;  // intercomm: pt2pt surface
   DtView sv, rv;
   if (!resolve_dtype(stype, sv) || !resolve_dtype(rtype, rv))
     return MPI_ERR_TYPE;
@@ -4922,6 +5109,9 @@ int MPI_Get(void *origin_addr, int origin_count,
  * reply recv into `dest` and fires the wget RPC, returning a request
  * handle the caller completes with zompi_win_get_wait (normally from
  * shmem_quiet).  Not part of mpi.h. */
+std::map<int, long long> g_nbi_want;  // handle -> expected reply bytes
+std::mutex g_nbi_want_mu;
+
 int zompi_win_get_start(MPI_Win win, int target_rank,
                         long long disp_bytes, long long nbytes,
                         void *dest, int *handle_out) {
@@ -4969,12 +5159,13 @@ int zompi_win_get_start(MPI_Win win, int target_rank,
     delete r;
     return rc;
   }
+  {
+    std::lock_guard<std::mutex> lk(g_nbi_want_mu);
+    g_nbi_want[handle] = nbytes;
+  }
   *handle_out = handle;
   return MPI_SUCCESS;
 }
-
-std::map<int, long long> g_nbi_want;  // handle -> expected reply bytes
-std::mutex g_nbi_want_mu;
 
 int zompi_win_get_wait(int handle) {
   long long want = -1;

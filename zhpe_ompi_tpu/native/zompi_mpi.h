@@ -193,6 +193,16 @@ int MPI_Group_translate_ranks(MPI_Group group1, int n, const int ranks1[],
 int MPI_Group_free(MPI_Group *group);
 int MPI_Comm_create(MPI_Comm comm, MPI_Group group, MPI_Comm *newcomm);
 
+/* intercommunicators (intercomm_create.c family): remote-group
+ * point-to-point between two disjoint groups of one universe;
+ * collectives are an intracommunicator surface (merge first) */
+int MPI_Intercomm_create(MPI_Comm local_comm, int local_leader,
+                         MPI_Comm peer_comm, int remote_leader, int tag,
+                         MPI_Comm *newintercomm);
+int MPI_Intercomm_merge(MPI_Comm intercomm, int high, MPI_Comm *newintra);
+int MPI_Comm_remote_size(MPI_Comm comm, int *size);
+int MPI_Comm_test_inter(MPI_Comm comm, int *flag);
+
 /* blocking point-to-point */
 int MPI_Send(const void *buf, int count, MPI_Datatype dt, int dest,
              int tag, MPI_Comm comm);
