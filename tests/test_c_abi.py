@@ -1727,3 +1727,109 @@ int main(int argc, char **argv) {
             out, err = p.communicate(timeout=120)
             assert p.returncode == 0, f"rank {r} failed: {err}\n{out}"
             assert f"inter rank {r}/{n} OK" in out
+
+    def test_comm_spawn(self, shim, tmp_path):
+        """MPI_Comm_spawn: the parent universe launches 2 children that
+        form their OWN MPI_COMM_WORLD (ids offset into the shared book);
+        parent<->child pt2pt crosses the spawn intercomm both ways and
+        the children synchronize on their own world without touching
+        the parents' contexts."""
+        child_src = tmp_path / "child.c"
+        child_src.write_text(r'''
+#include <stdio.h>
+#include "zompi_mpi.h"
+int main(int argc, char **argv) {
+  int rank, size;
+  if (MPI_Init(&argc, &argv) != MPI_SUCCESS) return 2;
+  MPI_Comm_rank(MPI_COMM_WORLD, &rank);
+  MPI_Comm_size(MPI_COMM_WORLD, &size);
+  if (size != 2) return 3;  /* children's world is the 2 children */
+  MPI_Comm parent;
+  MPI_Comm_get_parent(&parent);
+  if (parent == MPI_COMM_NULL) return 4;
+  int prsize = -1, flag = 0;
+  MPI_Comm_test_inter(parent, &flag);
+  if (!flag) return 5;
+  MPI_Comm_remote_size(parent, &prsize);
+  /* child world collective on its own contexts */
+  long v = rank + 1, sum = 0;
+  MPI_Allreduce(&v, &sum, 1, MPI_LONG, MPI_SUM, MPI_COMM_WORLD);
+  if (sum != 3) return 6;
+  /* receive a probe from parent rank 0, reply transformed */
+  long got = -1;
+  MPI_Recv(&got, 1, MPI_LONG, 0, 40, parent, MPI_STATUS_IGNORE);
+  got = got * 10 + rank;
+  MPI_Send(&got, 1, MPI_LONG, 0, 41, parent);
+  MPI_Finalize();
+  return 0;
+}
+''')
+        child_bin = tmp_path / "spawn_child"
+        _compile_c(shim, child_src, child_bin)
+
+        parent_src = tmp_path / "parent.c"
+        parent_src.write_text(r'''
+#include <stdio.h>
+#include <stdlib.h>
+#include "zompi_mpi.h"
+int main(int argc, char **argv) {
+  int rank, size;
+  if (MPI_Init(&argc, &argv) != MPI_SUCCESS) return 2;
+  MPI_Comm_rank(MPI_COMM_WORLD, &rank);
+  MPI_Comm_size(MPI_COMM_WORLD, &size);
+  /* a failed launch must fail on EVERY rank (collective agreement),
+     leave no partial universe, and not poison later spawns */
+  MPI_Comm dead;
+  if (MPI_Comm_spawn("/nonexistent/zompi-child", NULL, 2, MPI_INFO_NULL,
+                     0, MPI_COMM_WORLD, &dead, NULL) != MPI_ERR_OTHER)
+    return 13;
+  /* a child that execs but dies before joining the modex (crash before
+     MPI_Init) must also become an agreed failure, not a hang */
+  if (MPI_Comm_spawn("/bin/true", NULL, 2, MPI_INFO_NULL, 0,
+                     MPI_COMM_WORLD, &dead, NULL) != MPI_ERR_OTHER)
+    return 14;
+  MPI_Comm kids;
+  int errs[2] = {-1, -1};
+  if (MPI_Comm_spawn(getenv("SPAWN_CHILD"), NULL, 2, MPI_INFO_NULL, 0,
+                     MPI_COMM_WORLD, &kids, errs) != MPI_SUCCESS)
+    return 3;
+  if (errs[0] != MPI_SUCCESS || errs[1] != MPI_SUCCESS) return 4;
+  int rsize = -1;
+  MPI_Comm_remote_size(kids, &rsize);
+  if (rsize != 2) return 5;
+  if (rank == 0) {
+    /* message each child over the intercomm, read the replies */
+    for (int k = 0; k < 2; k++) {
+      long v = 7 + k;
+      MPI_Send(&v, 1, MPI_LONG, k, 40, kids);
+    }
+    for (int k = 0; k < 2; k++) {
+      long got = -1;
+      MPI_Recv(&got, 1, MPI_LONG, k, 41, kids, MPI_STATUS_IGNORE);
+      if (got != (7 + k) * 10 + k) {
+        fprintf(stderr, "child %d replied %ld\n", k, got);
+        return 6;
+      }
+    }
+  }
+  MPI_Barrier(MPI_COMM_WORLD);
+  printf("spawn rank %d/%d OK\n", rank, size);
+  MPI_Finalize();
+  return 0;
+}
+''')
+        binpath = tmp_path / "spawn_parent"
+        _compile_c(shim, parent_src, binpath)
+        port = _free_port()
+        n = 2
+        procs = []
+        for r in range(n):
+            env = _env(r, n, port)
+            env["SPAWN_CHILD"] = str(child_bin)
+            procs.append(subprocess.Popen(
+                [str(binpath)], env=env, stdout=subprocess.PIPE,
+                stderr=subprocess.PIPE, text=True))
+        for r, p in enumerate(procs):
+            out, err = p.communicate(timeout=120)
+            assert p.returncode == 0, f"rank {r} failed: {err}\n{out}"
+            assert f"spawn rank {r}/{n} OK" in out

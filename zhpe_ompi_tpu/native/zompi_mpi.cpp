@@ -67,6 +67,7 @@
 #include <fcntl.h>
 #include <netinet/in.h>
 #include <sys/stat.h>
+#include <sys/wait.h>
 #include <netinet/tcp.h>
 #include <sys/socket.h>
 #include <unistd.h>
@@ -274,8 +275,14 @@ bool recv_frame(int fd, std::string &out) {
   return len == 0 || recv_all(fd, &out[0], len);
 }
 
+// children must not inherit the engine's sockets across execve: an
+// exec'd child holding duplicates of our connections converts peer
+// death into a silent hang for everyone blocked on those sockets
+void set_cloexec(int fd) { fcntl(fd, F_SETFD, FD_CLOEXEC); }
+
 int tcp_connect(const std::string &host, int port) {
   int fd = socket(AF_INET, SOCK_STREAM, 0);
+  set_cloexec(fd);
   sockaddr_in a{};
   a.sin_family = AF_INET;
   a.sin_port = htons((uint16_t)port);
@@ -463,6 +470,7 @@ struct Posted {
 struct Shim {
   int rank = -1, size = 0;
   int listen_fd = -1;
+  static constexpr size_t BOOK_CAP = 4096;  // universe bound (see init)
   std::string host = "127.0.0.1";
   int listen_port = 0;
   std::vector<std::pair<std::string, int>> book;
@@ -875,6 +883,7 @@ void accept_loop() {
   while (!g.closing.load()) {
     int fd = accept(g.listen_fd, nullptr, nullptr);
     if (fd < 0) return;
+    set_cloexec(fd);
     int one = 1;
     setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof one);
     std::string hello;
@@ -1047,6 +1056,23 @@ int wire_send_rndv(const void *buf, size_t count, const DtInfo &di,
   int rc = rndv_announce(count, di, dest, tag, cid, rid, handle);
   if (rc != MPI_SUCCESS) return rc;
   return rndv_complete(buf, count, di, dest, rid, handle);
+}
+
+// DSS reply carrying an address book (the modex coordinator's answer,
+// shared by MPI_Init's rank-0 coordinator and the spawn coordinator)
+std::string pack_address_book(
+    const std::vector<std::pair<std::string, int>> &book) {
+  std::string reply;
+  put_varint(reply, 1);
+  reply.push_back((char)T_LIST);
+  put_varint(reply, book.size());
+  for (auto &e : book) {
+    reply.push_back((char)T_LIST);
+    put_varint(reply, 2);
+    put_str(reply, e.first);
+    put_int(reply, e.second);
+  }
+  return reply;
 }
 
 // wire-send `count` contiguous base elements (world-rank addressing).
@@ -2161,6 +2187,7 @@ int MPI_Init(int *, char ***) {
 
   // listener (btl_tcp's per-proc endpoint)
   g.listen_fd = socket(AF_INET, SOCK_STREAM, 0);
+  set_cloexec(g.listen_fd);
   int one = 1;
   setsockopt(g.listen_fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof one);
   sockaddr_in a{};
@@ -2201,16 +2228,7 @@ int MPI_Init(int *, char ***) {
       g.book[peer] = {vals[1].items[0].s, (int)vals[1].items[1].i};
       peers.push_back(c);
     }
-    std::string reply;
-    put_varint(reply, 1);
-    reply.push_back((char)T_LIST);
-    put_varint(reply, g.size);
-    for (auto &e : g.book) {
-      reply.push_back((char)T_LIST);
-      put_varint(reply, 2);
-      put_str(reply, e.first);
-      put_int(reply, e.second);
-    }
+    std::string reply = pack_address_book(g.book);
     for (int c : peers) {
       send_frame(c, reply);
       close(c);
@@ -2237,17 +2255,40 @@ int MPI_Init(int *, char ***) {
       g.book.push_back({e.items[0].s, (int)e.items[1].i});
   }
 
+  // endpoint() reads g.book unlocked from several threads; reserving
+  // once caps the universe (init ranks + spawned children) at BOOK_CAP
+  // and guarantees spawn's push_back never reallocates under a reader
+  g.book.reserve(Shim::BOOK_CAP);
+
   // predefined communicators.  WORLD keeps the round-3 wire cids for
   // Python interop; SELF's context never leaves the process.
   g_comms.clear();
   g_next_comm = 2;
   CommObj world;
-  world.group.resize(g.size);
-  for (int i = 0; i < g.size; i++) world.group[i] = i;
-  world.local_rank = g.rank;
-  world.cid_pt2pt = 0;
-  world.cid_coll = 0x7FFC;
-  world.cid_bar = 0x7FFD;
+  const char *wb = getenv("ZMPI_WORLD_BASE");
+  if (wb && wb[0]) {
+    // SPAWNED process (comm_spawn.c's child side): the universe book
+    // spans parent + children, but MPI_COMM_WORLD is the CHILDREN only
+    // — a contiguous id block at `base`, with context ids the spawner
+    // chose (so parent WORLD traffic and child WORLD traffic never
+    // share a context)
+    int base = atoi(wb);
+    int wsize = atoi(getenv("ZMPI_WORLD_SIZE"));
+    int64_t scid = atoll(getenv("ZMPI_SPAWN_CID"));
+    world.group.resize(wsize);
+    for (int i = 0; i < wsize; i++) world.group[i] = base + i;
+    world.local_rank = g.rank - base;
+    world.cid_pt2pt = scid + 3;  // the spawn intercomm owns scid..+2
+    world.cid_coll = scid + 4;
+    world.cid_bar = scid + 5;
+  } else {
+    world.group.resize(g.size);
+    for (int i = 0; i < g.size; i++) world.group[i] = i;
+    world.local_rank = g.rank;
+    world.cid_pt2pt = 0;
+    world.cid_coll = 0x7FFC;
+    world.cid_bar = 0x7FFD;
+  }
   g_comms[MPI_COMM_WORLD] = world;
   CommObj self;
   self.group = {g.rank};
@@ -2267,8 +2308,10 @@ int MPI_Initialized(int *flag) {
 }
 
 void finalize_attr_sweep(void);  // defined with the attribute machinery
+void reap_spawned(void);         // defined with the spawn machinery
 
 int MPI_Finalize(void) {
+  reap_spawned();
   // Attribute delete callbacks fire for EVERY comm that still carries
   // attributes — including WORLD/SELF, the canonical library
   // finalize-hook idiom (MPI-3.1 §8.7.1 requires these deletions)
@@ -2558,6 +2601,7 @@ int MPI_Comm_dup(MPI_Comm comm, MPI_Comm *newcomm) {
   c->child_seq++;
   child.group = c->group;
   child.local_rank = c->local_rank;
+  child.remote = c->remote;  // dup of an intercomm stays an intercomm
   int handle = g_next_comm++;
   g_comms[handle] = child;
   *newcomm = handle;
@@ -4624,9 +4668,15 @@ int MPI_Intercomm_merge(MPI_Comm intercomm, int high, MPI_Comm *newintra) {
   // through a per-side local context derived from the intercomm cid.
   long my_flag = high ? 1 : 0, their_flag = -1;
   if (c->local_rank == 0) {
-    MPI_Status st{};
-    int rc = MPI_Sendrecv(&my_flag, 1, MPI_LONG, 0, 0x7E14, &their_flag,
-                          1, MPI_LONG, 0, 0x7E14, intercomm, &st);
+    // reserved context (cid_bar), NOT the user pt2pt cid: tag 0x7E14
+    // is a legal user tag and an eager user message could otherwise
+    // match this internal recv
+    int remote_leader = c->remote[0];
+    int rc = raw_send(&my_flag, 1, MPI_LONG, remote_leader, 0x7E14,
+                      c->cid_bar);
+    if (rc != MPI_SUCCESS) return rc;
+    rc = raw_recv(&their_flag, 1, MPI_LONG, remote_leader, 0x7E14,
+                  c->cid_bar, nullptr);
     if (rc != MPI_SUCCESS) return rc;
   }
   CommObj local_side;
@@ -4668,6 +4718,303 @@ int MPI_Intercomm_merge(MPI_Comm intercomm, int high, MPI_Comm *newintra) {
   int handle = g_next_comm++;
   g_comms[handle] = merged;
   *newintra = handle;
+  return MPI_SUCCESS;
+}
+
+// ------------------------------------------------------ dynamic spawn
+// comm_spawn.c re-designed over universe EXTENSION: children join the
+// SAME address book at offset ids (base..base+n), with their own
+// MPI_COMM_WORLD context handed down by the spawner — so no second
+// wire namespace exists and the spawn intercomm's remote-group pt2pt
+// rides the ordinary endpoint machinery.  The root runs the children's
+// modex coordinator inline (the standard init handshake, unchanged).
+// Constraint (documented): spawns must be serialized across the
+// universe — disjoint comms spawning concurrently would fork the book.
+
+namespace {
+
+int g_parent_comm_handle = -2;  // lazily built from the ZMPI_* env
+std::vector<pid_t> g_spawned_pids;
+
+}  // namespace
+
+// reap exited children non-blockingly (called per spawn + at Finalize)
+void reap_spawned(void) {
+  for (auto it = g_spawned_pids.begin(); it != g_spawned_pids.end();) {
+    if (waitpid(*it, nullptr, WNOHANG) > 0) it = g_spawned_pids.erase(it);
+    else ++it;
+  }
+}
+
+int MPI_Comm_spawn(const char *command, char *argv[], int maxprocs,
+                   MPI_Info /*info*/, int root, MPI_Comm comm,
+                   MPI_Comm *intercomm, int errcodes[]) {
+  CommObj *c = lookup_comm(comm);
+  if (!c || !c->remote.empty()) return MPI_ERR_COMM;
+  if (root < 0 || root >= (int)c->group.size()) return MPI_ERR_ARG;
+  int me = c->local_rank;
+  reap_spawned();
+  // hdr[0] < 0 signals a root-side failure to EVERY rank through the
+  // broadcasts below — the collective-error-agreement discipline (the
+  // Python plane's _rank0_collective): no early root return may strand
+  // the other ranks inside c_bcast.
+  long hdr[3] = {-1, 0, 0};  // maxprocs, spawn cid, base
+  std::string flat;          // "host:port\n" per child
+  if (me == root) {
+    // command/argv/maxprocs are root-significant (MPI-3.1 10.3.2)
+    if (maxprocs <= 0 || !command) goto root_done;
+    {
+      int base = (int)g.book.size();
+      // the bound is the CONSTANT, not capacity(): reserve guarantees
+      // >= BOOK_CAP, and the no-reallocation invariant must hold on
+      // every rank, not just wherever capacity happens to be larger
+      if (base + maxprocs > (int)Shim::BOOK_CAP) goto root_done;
+      int64_t scid =
+          (int64_t)((mix64((uint64_t)base * 0x9E3779B97F4A7C15ULL) &
+                     0x3FFFFFFFFFFFULL) |
+                    0x200000000000ULL);
+      // the children's modex coordinator (standard init handshake)
+      int srv = socket(AF_INET, SOCK_STREAM, 0);
+      set_cloexec(srv);
+      int one = 1;
+      setsockopt(srv, SOL_SOCKET, SO_REUSEADDR, &one, sizeof one);
+      sockaddr_in ca{};
+      ca.sin_family = AF_INET;
+      ca.sin_port = 0;
+      inet_pton(AF_INET, g.host.c_str(), &ca.sin_addr);
+      if (bind(srv, (sockaddr *)&ca, sizeof ca) != 0) {
+        close(srv);
+        goto root_done;
+      }
+      socklen_t alen = sizeof ca;
+      getsockname(srv, (sockaddr *)&ca, &alen);
+      int spawn_port = ntohs(ca.sin_port);
+      listen(srv, maxprocs + 2);
+      std::string pgroup;
+      for (size_t i = 0; i < c->group.size(); i++) {
+        if (i) pgroup += ",";
+        pgroup += std::to_string(c->group[i]);
+      }
+      // argv/envp built BEFORE fork (threads hold malloc locks); the
+      // filtered base environment is shared by every child
+      std::vector<char *> av;
+      av.push_back(const_cast<char *>(command));
+      if (argv)
+        for (int i = 0; argv[i]; i++) av.push_back(argv[i]);
+      av.push_back(nullptr);
+      extern char **environ;
+      std::vector<std::string> base_envs;
+      for (char **e = environ; *e; e++) {
+        if (strncmp(*e, "ZMPI_RANK=", 10) &&
+            strncmp(*e, "ZMPI_SIZE=", 10) &&
+            strncmp(*e, "ZMPI_COORD_", 11) &&
+            strncmp(*e, "ZMPI_WORLD_", 11) &&
+            strncmp(*e, "ZMPI_SPAWN_", 11) &&
+            strncmp(*e, "ZMPI_PARENT_", 12))
+          base_envs.push_back(*e);
+      }
+      base_envs.push_back("ZMPI_SIZE=" + std::to_string(base + maxprocs));
+      base_envs.push_back("ZMPI_COORD_HOST=" + g.host);
+      base_envs.push_back("ZMPI_COORD_PORT=" + std::to_string(spawn_port));
+      base_envs.push_back("ZMPI_WORLD_BASE=" + std::to_string(base));
+      base_envs.push_back("ZMPI_WORLD_SIZE=" + std::to_string(maxprocs));
+      base_envs.push_back("ZMPI_SPAWN_CID=" + std::to_string(scid));
+      base_envs.push_back("ZMPI_PARENT_GROUP=" + pgroup);
+      std::vector<pid_t> pids;
+      std::vector<int> errpipes;  // CLOEXEC: closes on exec success
+      bool launch_failed = false;
+      for (int i = 0; i < maxprocs && !launch_failed; i++) {
+        std::string rank_env = "ZMPI_RANK=" + std::to_string(base + i);
+        std::vector<char *> ev;
+        for (auto &x : base_envs) ev.push_back(const_cast<char *>(x.c_str()));
+        ev.push_back(const_cast<char *>(rank_env.c_str()));
+        ev.push_back(nullptr);
+        int pfd[2];
+        if (pipe(pfd) != 0) {
+          launch_failed = true;
+          break;
+        }
+        set_cloexec(pfd[0]);  // later siblings must not inherit it
+        set_cloexec(pfd[1]);
+        pid_t pid = fork();
+        if (pid == 0) {
+          close(pfd[0]);
+          execve(command, av.data(), ev.data());
+          // exec failed: the CLOEXEC pipe survived — report and die
+          // (write is async-signal-safe)
+          int err = errno;
+          ssize_t ignored = write(pfd[1], &err, sizeof err);
+          (void)ignored;
+          _exit(127);
+        }
+        close(pfd[1]);
+        if (pid < 0) {
+          close(pfd[0]);
+          launch_failed = true;
+          break;
+        }
+        pids.push_back(pid);
+        errpipes.push_back(pfd[0]);
+      }
+      // exec verdicts: EOF on the pipe = exec succeeded
+      std::vector<int> codes((size_t)maxprocs, MPI_SUCCESS);
+      for (size_t i = 0; i < errpipes.size(); i++) {
+        int err = 0;
+        if (read(errpipes[i], &err, sizeof err) > 0) {
+          codes[i] = MPI_ERR_OTHER;
+          launch_failed = true;
+        }
+        close(errpipes[i]);
+      }
+      if (launch_failed) {
+        // no partial universes: kill whatever launched, reap, fail
+        for (pid_t pid : pids) kill(pid, SIGKILL);
+        for (pid_t pid : pids) waitpid(pid, nullptr, 0);
+        close(srv);
+        if (errcodes)
+          for (int i = 0; i < maxprocs; i++) errcodes[i] = codes[(size_t)i];
+        goto root_done;
+      }
+      for (pid_t pid : pids) g_spawned_pids.push_back(pid);
+      // gather the children's cards, reply with the EXTENDED book.
+      // accept() is POLLED so a child dying after exec but before its
+      // modex connect (crash before MPI_Init) turns into an agreed
+      // failure rather than an accept() that waits forever.
+      std::vector<std::pair<std::string, int>> kids(maxprocs, {"", 0});
+      std::vector<int> conns;
+      bool modex_ok = true;
+      for (int i = 0; i < maxprocs && modex_ok; i++) {
+        int fd = -1;
+        for (;;) {
+          fd_set rf;
+          FD_ZERO(&rf);
+          FD_SET(srv, &rf);
+          timeval tv{1, 0};
+          int sel = select(srv + 1, &rf, nullptr, nullptr, &tv);
+          if (sel > 0) {
+            fd = accept(srv, nullptr, nullptr);
+            break;
+          }
+          // a second of silence: is any child already dead?
+          bool died = false;
+          for (pid_t pid : pids)
+            if (waitpid(pid, nullptr, WNOHANG) > 0) died = true;
+          if (died || sel < 0) break;
+        }
+        if (fd < 0) {
+          modex_ok = false;
+          break;
+        }
+        set_cloexec(fd);
+        std::string f;
+        std::vector<DssVal> vals;
+        if (!recv_frame(fd, f) || !parse_all(f, vals) ||
+            vals.size() != 2 || vals[1].tag != T_LIST ||
+            vals[1].items.size() != 2 || vals[1].items[0].tag != T_STR ||
+            vals[1].items[1].tag != T_INT) {
+          close(fd);
+          modex_ok = false;
+          break;
+        }
+        int kr = (int)vals[0].i - base;
+        if (kr >= 0 && kr < maxprocs)
+          kids[kr] = {vals[1].items[0].s, (int)vals[1].items[1].i};
+        conns.push_back(fd);
+      }
+      if (!modex_ok) {
+        for (int fd : conns) close(fd);
+        close(srv);
+        goto root_done;
+      }
+      auto book = g.book;
+      for (auto &k : kids) book.push_back(k);
+      std::string reply = pack_address_book(book);
+      for (int fd : conns) {
+        send_frame(fd, reply);
+        close(fd);
+      }
+      close(srv);
+      // the ROOT extends its own book here; every other participant
+      // extends from the broadcast below
+      for (auto &k : kids) g.book.push_back(k);
+      hdr[0] = maxprocs;
+      hdr[1] = scid;
+      hdr[2] = base;
+      for (auto &k : kids)
+        flat += k.first + ":" + std::to_string(k.second) + "\n";
+    }
+  }
+root_done:
+  // distribute the outcome to every participant (hdr[0] < 0 = failure)
+  int rc = c_bcast(*c, hdr, 3, MPI_LONG, root, 0x7E16);
+  if (rc != MPI_SUCCESS) return rc;
+  if (hdr[0] < 0) return MPI_ERR_OTHER;  // agreed failure, no deadlock
+  long flen = (long)flat.size();
+  rc = c_bcast(*c, &flen, 1, MPI_LONG, root, 0x7E17);
+  if (rc != MPI_SUCCESS) return rc;
+  flat.resize((size_t)flen);
+  rc = c_bcast(*c, flat.data(), (int)flen, MPI_BYTE, root, 0x7E18);
+  if (rc != MPI_SUCCESS) return rc;
+  int base = (int)hdr[2];
+  int nkids = (int)hdr[0];  // root-significant maxprocs, agreed via hdr
+  if (me != root) {
+    if ((int)g.book.size() != base) return MPI_ERR_OTHER;  // serialized-
+    // spawn contract broken (see the section comment)
+    if (base + nkids > (int)Shim::BOOK_CAP) return MPI_ERR_OTHER;
+    size_t pos = 0;
+    for (int i = 0; i < nkids; i++) {
+      size_t nl = flat.find('\n', pos);
+      std::string entry = flat.substr(pos, nl - pos);
+      pos = nl + 1;
+      size_t colon = entry.rfind(':');
+      g.book.push_back({entry.substr(0, colon),
+                        atoi(entry.c_str() + colon + 1)});
+    }
+  }
+  // the spawn intercommunicator: local = the spawn comm, remote = kids
+  CommObj inter;
+  inter.group = c->group;
+  inter.local_rank = me;
+  for (int i = 0; i < nkids; i++) inter.remote.push_back(base + i);
+  inter.cid_pt2pt = hdr[1];
+  inter.cid_coll = hdr[1] + 1;
+  inter.cid_bar = hdr[1] + 2;
+  int handle = g_next_comm++;
+  g_comms[handle] = inter;
+  *intercomm = handle;
+  if (errcodes)
+    for (int i = 0; i < nkids; i++) errcodes[i] = MPI_SUCCESS;
+  return MPI_SUCCESS;
+}
+
+int MPI_Comm_get_parent(MPI_Comm *parent) {
+  const char *wb = getenv("ZMPI_WORLD_BASE");
+  if (!wb || !wb[0]) {
+    *parent = MPI_COMM_NULL;
+    return MPI_SUCCESS;
+  }
+  if (g_parent_comm_handle >= 0) {
+    *parent = g_parent_comm_handle;
+    return MPI_SUCCESS;
+  }
+  CommObj *w = lookup_comm(MPI_COMM_WORLD);
+  if (!w) return MPI_ERR_COMM;
+  CommObj inter;
+  inter.group = w->group;
+  inter.local_rank = w->local_rank;
+  const char *pg = getenv("ZMPI_PARENT_GROUP");
+  for (const char *p = pg; p && *p;) {
+    inter.remote.push_back(atoi(p));
+    const char *comma = strchr(p, ',');
+    p = comma ? comma + 1 : nullptr;
+  }
+  int64_t scid = atoll(getenv("ZMPI_SPAWN_CID"));
+  inter.cid_pt2pt = scid;
+  inter.cid_coll = scid + 1;
+  inter.cid_bar = scid + 2;
+  g_parent_comm_handle = g_next_comm++;
+  g_comms[g_parent_comm_handle] = inter;
+  *parent = g_parent_comm_handle;
   return MPI_SUCCESS;
 }
 

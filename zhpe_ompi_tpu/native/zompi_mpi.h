@@ -101,6 +101,8 @@ typedef int MPI_Op;
 typedef int MPI_Request;
 #define MPI_REQUEST_NULL (-1)
 
+typedef int MPI_Info;
+
 #define MPI_ANY_SOURCE (-1)
 #define MPI_ANY_TAG    (-1)
 #define MPI_PROC_NULL  (-2)
@@ -202,6 +204,15 @@ int MPI_Intercomm_create(MPI_Comm local_comm, int local_leader,
 int MPI_Intercomm_merge(MPI_Comm intercomm, int high, MPI_Comm *newintra);
 int MPI_Comm_remote_size(MPI_Comm comm, int *size);
 int MPI_Comm_test_inter(MPI_Comm comm, int *flag);
+
+/* dynamic process management (comm_spawn.c): children join the
+ * universe at offset ids with their own WORLD; the spawn intercomm
+ * carries remote-group pt2pt.  Spawns must be serialized across the
+ * universe. */
+int MPI_Comm_spawn(const char *command, char *argv[], int maxprocs,
+                   MPI_Info info, int root, MPI_Comm comm,
+                   MPI_Comm *intercomm, int errcodes[]);
+int MPI_Comm_get_parent(MPI_Comm *parent);
 
 /* blocking point-to-point */
 int MPI_Send(const void *buf, int count, MPI_Datatype dt, int dest,
@@ -318,7 +329,6 @@ int MPI_Type_get_extent(MPI_Datatype dt, long *lb, long *extent);
  * over the communicator. */
 typedef int MPI_File;
 typedef long long MPI_Offset;
-typedef int MPI_Info;
 #define MPI_FILE_NULL (-1)
 #define MPI_INFO_NULL 0
 #define MPI_MODE_CREATE          1
