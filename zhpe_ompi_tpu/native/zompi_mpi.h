@@ -34,8 +34,14 @@
  * with neighborhood collectives; attribute caching (keyvals with
  * dup/free/finalize callback semantics); Type_indexed(+block) with
  * MPI lb/extent rules; MPI_Pack/Unpack/Pack_size over the convertor;
- * Comm_create from groups.  The sibling zompi_shmem.h carries the
- * OpenSHMEM C surface over the same engine.
+ * Comm_create from groups; INTERCOMMUNICATORS (create/merge/
+ * remote_size/test_inter with remote-group pt2pt) and DYNAMIC PROCESS
+ * MANAGEMENT (Comm_spawn/Comm_get_parent over universe extension);
+ * Ssend/Rsend/Bsend(+I) and buffered-send bookkeeping; Alltoallv and
+ * ragged Reduce_scatter (+ nonblocking forms and Igatherv/Iscatterv/
+ * Iallgatherv).  The sibling zompi_shmem.h carries the OpenSHMEM C
+ * surface (incl. put/get _nbi completing at quiet) over the same
+ * engine.
  *
  * Wire-up (the PMIx-env analog): MPI_Init reads
  *   ZMPI_RANK        this process's rank
