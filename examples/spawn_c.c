@@ -1,0 +1,68 @@
+/* spawn_c — dynamic process management acceptance (comm_spawn.c):
+ * every parent rank collectively spawns 2 children (this same binary,
+ * re-exec'd in child mode), the children form their own
+ * MPI_COMM_WORLD, and parent rank 0 round-trips a payload with each
+ * child over the spawn intercommunicator.
+ *
+ *   python -m zhpe_ompi_tpu.tools.zmpicc examples/spawn_c.c -o spawn
+ *   python -m zhpe_ompi_tpu.tools.mpirun -n 3 ./spawn ./spawn
+ *
+ * argv[1] is the child command (normally this binary's own path).
+ */
+#include <stdio.h>
+#include <stdlib.h>
+#include "zompi_mpi.h"
+
+static int child_main(void) {
+  int rank, size;
+  MPI_Comm_rank(MPI_COMM_WORLD, &rank);
+  MPI_Comm_size(MPI_COMM_WORLD, &size);
+  MPI_Comm parent;
+  MPI_Comm_get_parent(&parent);
+  if (parent == MPI_COMM_NULL) return 10;
+  /* the children's world is their own: contexts disjoint from the
+     parents' — prove it with a child-only allreduce */
+  long v = rank + 1, sum = 0;
+  MPI_Allreduce(&v, &sum, 1, MPI_LONG, MPI_SUM, MPI_COMM_WORLD);
+  if (sum != (long)size * (size + 1) / 2) return 11;
+  long got = -1;
+  MPI_Recv(&got, 1, MPI_LONG, 0, 40, parent, MPI_STATUS_IGNORE);
+  got = got * 10 + rank;
+  MPI_Send(&got, 1, MPI_LONG, 0, 41, parent);
+  MPI_Finalize();
+  return 0;
+}
+
+int main(int argc, char **argv) {
+  int rank, size;
+  if (MPI_Init(&argc, &argv) != MPI_SUCCESS) return 2;
+  if (getenv("ZMPI_WORLD_BASE")) return child_main();  /* spawned side */
+  MPI_Comm_rank(MPI_COMM_WORLD, &rank);
+  MPI_Comm_size(MPI_COMM_WORLD, &size);
+  const char *child = argc > 1 ? argv[1] : argv[0];
+  MPI_Comm kids;
+  int errs[2];
+  if (MPI_Comm_spawn(child, NULL, 2, MPI_INFO_NULL, 0, MPI_COMM_WORLD,
+                     &kids, errs) != MPI_SUCCESS) return 3;
+  int rsize = -1;
+  MPI_Comm_remote_size(kids, &rsize);
+  if (rsize != 2) return 4;
+  if (rank == 0) {
+    for (int k = 0; k < 2; k++) {
+      long v = 7 + k;
+      MPI_Send(&v, 1, MPI_LONG, k, 40, kids);
+    }
+    for (int k = 0; k < 2; k++) {
+      long got = -1;
+      MPI_Recv(&got, 1, MPI_LONG, k, 41, kids, MPI_STATUS_IGNORE);
+      if (got != (7 + k) * 10 + k) {
+        fprintf(stderr, "child %d replied %ld\n", k, got);
+        return 5;
+      }
+    }
+  }
+  MPI_Barrier(MPI_COMM_WORLD);
+  printf("spawn_c rank %d/%d OK (2 children served)\n", rank, size);
+  MPI_Finalize();
+  return 0;
+}

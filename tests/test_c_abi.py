@@ -846,6 +846,11 @@ def oshmem_bin(shim, tmp_path_factory):
     return _compile_example(shim, tmp_path_factory, "oshmem_c.c")
 
 
+@pytest.fixture(scope="module")
+def spawn_example_bin(shim, tmp_path_factory):
+    return _compile_example(shim, tmp_path_factory, "spawn_c.c")
+
+
 class TestOshmemCSurface:
     """The C OpenSHMEM surface (zompi_shmem.h over the window engine —
     the reference's oshmem/shmem/c bindings): symmetric heap, ring put,
@@ -1454,6 +1459,15 @@ int main(int argc, char **argv) {
     void *db; int ds;
     MPI_Buffer_detach(&db, &ds);
     if (db != (void *)bbuf || ds != (int)sizeof bbuf) return 7;
+    /* Issend: returns immediately, request pends until the match */
+    long iv = 88;
+    MPI_Request isr;
+    double i0 = MPI_Wtime();
+    MPI_Issend(&iv, 1, MPI_LONG, 1, 9, MPI_COMM_WORLD, &isr);
+    if (MPI_Wtime() - i0 > 0.2) return 9;  /* must not block */
+    int iflag = -1;
+    MPI_Test(&isr, &iflag, MPI_STATUS_IGNORE);
+    if (iflag) return 10;  /* receiver not there yet */
     double t0 = MPI_Wtime();
     MPI_Ssend(&v, 1, MPI_LONG, 1, 6, MPI_COMM_WORLD);
     double dt = MPI_Wtime() - t0;
@@ -1461,12 +1475,17 @@ int main(int argc, char **argv) {
       fprintf(stderr, "Ssend returned in %.3fs before the match\n", dt);
       return 3;
     }
+    MPI_Wait(&isr, MPI_STATUS_IGNORE);  /* its receiver matched too */
   } else if (rank == 1) {
     usleep(400000);
     long bgot = 0;
     MPI_Recv(&bgot, 1, MPI_LONG, 0, 7, MPI_COMM_WORLD,
              MPI_STATUS_IGNORE);
     if (bgot != 55) return 8;
+    long igot = -1;
+    MPI_Recv(&igot, 1, MPI_LONG, 0, 9, MPI_COMM_WORLD,
+             MPI_STATUS_IGNORE);
+    if (igot != 88) return 11;
     long got = 0;
     /* Testany on a pending request first */
     MPI_Request rq;
@@ -1833,3 +1852,19 @@ int main(int argc, char **argv) {
             out, err = p.communicate(timeout=120)
             assert p.returncode == 0, f"rank {r} failed: {err}\n{out}"
             assert f"spawn rank {r}/{n} OK" in out
+
+    @pytest.mark.parametrize("n", [1, 3])
+    def test_spawn_example(self, spawn_example_bin, n):
+        """examples/spawn_c.c: the self-re-exec'ing spawn acceptance."""
+        binpath = spawn_example_bin
+        port = _free_port()
+        procs = [
+            subprocess.Popen([binpath, binpath], env=_env(r, n, port),
+                             stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                             text=True)
+            for r in range(n)
+        ]
+        for r, p in enumerate(procs):
+            out, err = p.communicate(timeout=120)
+            assert p.returncode == 0, f"rank {r} failed: {err}\n{out}"
+            assert f"spawn_c rank {r}/{n} OK" in out

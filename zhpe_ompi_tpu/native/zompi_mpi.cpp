@@ -2833,6 +2833,8 @@ int MPI_Send(const void *buf, int count, MPI_Datatype dt, int dest,
                   c->cid_pt2pt, /*allow_rndv=*/true);
 }
 
+static int make_completed_req(MPI_Comm comm, Req **out = nullptr);
+
 int MPI_Ssend(const void *buf, int count, MPI_Datatype dt, int dest,
               int tag, MPI_Comm comm) {
   // ssend.c: completion implies the receive is MATCHED — exactly the
@@ -2853,10 +2855,78 @@ int MPI_Rsend(const void *buf, int count, MPI_Datatype dt, int dest,
   return MPI_Send(buf, count, dt, dest, tag, comm);
 }
 
+int MPI_Issend(const void *buf, int count, MPI_Datatype dt, int dest,
+               int tag, MPI_Comm comm, MPI_Request *request) {
+  // issend.c: the request completes when the receive is MATCHED — the
+  // rendezvous announce goes out on THIS thread (wire order) and the
+  // CTS wait + push retire on a background thread, exactly the large-
+  // Isend shape but forced at any size
+  CommObj *c = lookup_comm(comm);
+  if (!c) return MPI_ERR_COMM;
+  if (dest == MPI_PROC_NULL) {
+    *request = make_completed_req(comm);
+    return MPI_SUCCESS;
+  }
+  if (tag < 0) return MPI_ERR_ARG;
+  if (dest < 0 || dest >= (int)peer_group(*c).size()) return MPI_ERR_ARG;
+  DtView v;
+  if (!resolve_dtype(dt, v)) return MPI_ERR_TYPE;
+  auto *packed = new std::vector<char>;
+  const void *src = buf;
+  size_t n = (size_t)count * v.elems_per_item();
+  if (!v.contiguous()) {
+    pack_dtype(buf, count, v, *packed);
+    src = packed->data();
+    n = packed->size() / v.di.item;
+  }
+  Req *r = new Req;
+  r->heap = true;
+  r->comm = comm;
+  int handle;
+  {
+    std::lock_guard<std::mutex> lk(g.match_mu);
+    handle = g.next_req++;
+    g.reqs[handle] = r;
+  }
+  int dest_world = peer_world_of(*c, dest);
+  int64_t cid = c->cid_pt2pt;
+  DtInfo di = v.di;
+  int64_t rid;
+  int cts_handle;
+  int rc = rndv_announce(n, di, dest_world, tag, cid, rid, cts_handle);
+  if (rc != MPI_SUCCESS) {
+    delete packed;
+    std::lock_guard<std::mutex> lk(g.match_mu);
+    g.reqs.erase(handle);
+    delete r;
+    return rc;
+  }
+  g.inflight_isends.fetch_add(1);
+  std::thread([=]() {
+    int src_rc = rndv_complete(src, n, di, dest_world, rid, cts_handle);
+    {
+      std::lock_guard<std::mutex> lk(g.match_mu);
+      r->status.MPI_ERROR = src_rc;
+      r->status._count = (long long)(n * di.item);
+      r->complete = true;
+    }
+    g.match_cv.notify_all();
+    delete packed;
+    g.inflight_isends.fetch_sub(1);
+  }).detach();
+  *request = handle;
+  return MPI_SUCCESS;
+}
+
+int MPI_Irsend(const void *buf, int count, MPI_Datatype dt, int dest,
+               int tag, MPI_Comm comm, MPI_Request *request) {
+  return MPI_Isend(buf, count, dt, dest, tag, comm, request);
+}
+
 // allocate an already-completed heap request and register it (the
 // eager-send/PROC_NULL request shape shared by Isend/Irecv/Ibsend);
 // hands the Req back so callers can stamp status without a re-lookup
-static int make_completed_req(MPI_Comm comm, Req **out = nullptr) {
+static int make_completed_req(MPI_Comm comm, Req **out) {
   Req *r = new Req;
   r->complete = true;
   r->heap = true;

@@ -231,6 +231,10 @@ int MPI_Rsend(const void *buf, int count, MPI_Datatype dt, int dest,
               int tag, MPI_Comm comm);
 int MPI_Bsend(const void *buf, int count, MPI_Datatype dt, int dest,
               int tag, MPI_Comm comm);
+int MPI_Issend(const void *buf, int count, MPI_Datatype dt, int dest,
+               int tag, MPI_Comm comm, MPI_Request *request);
+int MPI_Irsend(const void *buf, int count, MPI_Datatype dt, int dest,
+               int tag, MPI_Comm comm, MPI_Request *request);
 int MPI_Ibsend(const void *buf, int count, MPI_Datatype dt, int dest,
                int tag, MPI_Comm comm, MPI_Request *request);
 #define MPI_BSEND_OVERHEAD 0 /* buffering is internal to the engine */
