@@ -1154,6 +1154,30 @@ int main(int argc, char **argv) {
     fprintf(stderr, "rank %d alltoall [%ld,%ld]\n", rank, rblk[0], rblk[1]);
     return 11;
   }
+  /* distributed graph, adjacent form: a DIRECTED ring — send right
+     only, receive from left only (asymmetric in/out lists) */
+  {
+    int src1 = left, dst1 = right;
+    MPI_Comm dg;
+    if (MPI_Dist_graph_create_adjacent(MPI_COMM_WORLD, 1, &src1,
+                                       MPI_UNWEIGHTED, 1, &dst1,
+                                       MPI_UNWEIGHTED, MPI_INFO_NULL, 0,
+                                       &dg) != MPI_SUCCESS) return 21;
+    int topo, ind, outd, wtd;
+    MPI_Topo_test(dg, &topo);
+    if (topo != MPI_DIST_GRAPH) return 22;
+    MPI_Dist_graph_neighbors_count(dg, &ind, &outd, &wtd);
+    if (ind != 1 || outd != 1 || wtd != 0) return 23;
+    int gs = -1, gd = -1;
+    MPI_Dist_graph_neighbors(dg, 1, &gs, NULL, 1, &gd, NULL);
+    if (gs != left || gd != right) return 24;
+    long dv = 500 + rank, dres = -1;
+    MPI_Neighbor_allgather(&dv, 1, MPI_LONG, &dres, 1, MPI_LONG, dg);
+    if (dres != 500 + left) {
+      fprintf(stderr, "rank %d dist ring got %ld\n", rank, dres);
+      return 25;
+    }
+  }
   MPI_Barrier(MPI_COMM_WORLD);
   printf("fneigh rank %d/%d OK\n", rank, size);
   MPI_Finalize();

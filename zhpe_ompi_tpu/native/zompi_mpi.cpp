@@ -1180,6 +1180,12 @@ struct CommObj {
   std::vector<int> cart_periods;
   std::vector<int> graph_index;       // non-empty => graph topology
   std::vector<int> graph_edges;
+  bool dist = false;                  // distributed graph (adjacent form)
+  bool dist_weighted = false;
+  std::vector<int> dist_src;          // recv neighbors, in order
+  std::vector<int> dist_dst;          // send neighbors, in order
+  std::vector<int> dist_srcw;         // weights (when dist_weighted)
+  std::vector<int> dist_dstw;
 };
 
 std::map<int, CommObj> g_comms;
@@ -2834,6 +2840,9 @@ int MPI_Send(const void *buf, int count, MPI_Datatype dt, int dest,
 }
 
 static int make_completed_req(MPI_Comm comm, Req **out = nullptr);
+static int isend_rndv(const void *buf, int count, MPI_Datatype dt,
+                      int dest, int tag, MPI_Comm comm, CommObj *c,
+                      MPI_Request *request);
 
 int MPI_Ssend(const void *buf, int count, MPI_Datatype dt, int dest,
               int tag, MPI_Comm comm) {
@@ -2858,9 +2867,7 @@ int MPI_Rsend(const void *buf, int count, MPI_Datatype dt, int dest,
 int MPI_Issend(const void *buf, int count, MPI_Datatype dt, int dest,
                int tag, MPI_Comm comm, MPI_Request *request) {
   // issend.c: the request completes when the receive is MATCHED — the
-  // rendezvous announce goes out on THIS thread (wire order) and the
-  // CTS wait + push retire on a background thread, exactly the large-
-  // Isend shape but forced at any size
+  // shared rendezvous-isend lifecycle, forced at any size
   CommObj *c = lookup_comm(comm);
   if (!c) return MPI_ERR_COMM;
   if (dest == MPI_PROC_NULL) {
@@ -2869,53 +2876,7 @@ int MPI_Issend(const void *buf, int count, MPI_Datatype dt, int dest,
   }
   if (tag < 0) return MPI_ERR_ARG;
   if (dest < 0 || dest >= (int)peer_group(*c).size()) return MPI_ERR_ARG;
-  DtView v;
-  if (!resolve_dtype(dt, v)) return MPI_ERR_TYPE;
-  auto *packed = new std::vector<char>;
-  const void *src = buf;
-  size_t n = (size_t)count * v.elems_per_item();
-  if (!v.contiguous()) {
-    pack_dtype(buf, count, v, *packed);
-    src = packed->data();
-    n = packed->size() / v.di.item;
-  }
-  Req *r = new Req;
-  r->heap = true;
-  r->comm = comm;
-  int handle;
-  {
-    std::lock_guard<std::mutex> lk(g.match_mu);
-    handle = g.next_req++;
-    g.reqs[handle] = r;
-  }
-  int dest_world = peer_world_of(*c, dest);
-  int64_t cid = c->cid_pt2pt;
-  DtInfo di = v.di;
-  int64_t rid;
-  int cts_handle;
-  int rc = rndv_announce(n, di, dest_world, tag, cid, rid, cts_handle);
-  if (rc != MPI_SUCCESS) {
-    delete packed;
-    std::lock_guard<std::mutex> lk(g.match_mu);
-    g.reqs.erase(handle);
-    delete r;
-    return rc;
-  }
-  g.inflight_isends.fetch_add(1);
-  std::thread([=]() {
-    int src_rc = rndv_complete(src, n, di, dest_world, rid, cts_handle);
-    {
-      std::lock_guard<std::mutex> lk(g.match_mu);
-      r->status.MPI_ERROR = src_rc;
-      r->status._count = (long long)(n * di.item);
-      r->complete = true;
-    }
-    g.match_cv.notify_all();
-    delete packed;
-    g.inflight_isends.fetch_sub(1);
-  }).detach();
-  *request = handle;
-  return MPI_SUCCESS;
+  return isend_rndv(buf, count, dt, dest, tag, comm, c, request);
 }
 
 int MPI_Irsend(const void *buf, int count, MPI_Datatype dt, int dest,
@@ -3036,6 +2997,61 @@ int MPI_Get_count(const MPI_Status *status, MPI_Datatype dt, int *count) {
   return MPI_SUCCESS;
 }
 
+// The rendezvous-isend lifecycle (pack-or-inplace, request
+// registration, inline ANNOUNCE for wire order, detached CTS-wait +
+// bulk push), shared by large MPI_Isend and every-size MPI_Issend.
+static int isend_rndv(const void *buf, int count, MPI_Datatype dt,
+                      int dest, int tag, MPI_Comm comm, CommObj *c,
+                      MPI_Request *request) {
+  DtView v;
+  if (!resolve_dtype(dt, v)) return MPI_ERR_TYPE;
+  auto *packed = new std::vector<char>;
+  const void *src = buf;
+  size_t n = (size_t)count * v.elems_per_item();
+  if (!v.contiguous()) {
+    pack_dtype(buf, count, v, *packed);
+    src = packed->data();
+    n = packed->size() / v.di.item;
+  }
+  Req *r = new Req;
+  r->heap = true;
+  r->comm = comm;
+  int handle;
+  {
+    std::lock_guard<std::mutex> lk(g.match_mu);
+    handle = g.next_req++;
+    g.reqs[handle] = r;
+  }
+  int dest_world = peer_world_of(*c, dest);
+  int64_t cid = c->cid_pt2pt;
+  DtInfo di = v.di;
+  int64_t rid;
+  int cts_handle;
+  int rc = rndv_announce(n, di, dest_world, tag, cid, rid, cts_handle);
+  if (rc != MPI_SUCCESS) {
+    delete packed;
+    std::lock_guard<std::mutex> lk(g.match_mu);
+    g.reqs.erase(handle);
+    delete r;
+    return rc;
+  }
+  g.inflight_isends.fetch_add(1);
+  std::thread([=]() {
+    int src_rc = rndv_complete(src, n, di, dest_world, rid, cts_handle);
+    {
+      std::lock_guard<std::mutex> lk(g.match_mu);
+      r->status.MPI_ERROR = src_rc;
+      r->status._count = (long long)(n * di.item);
+      r->complete = true;
+    }
+    g.match_cv.notify_all();
+    delete packed;
+    g.inflight_isends.fetch_sub(1);
+  }).detach();
+  *request = handle;
+  return MPI_SUCCESS;
+}
+
 int MPI_Isend(const void *buf, int count, MPI_Datatype dt, int dest,
               int tag, MPI_Comm comm, MPI_Request *request) {
   // Below the eager limit the payload is on the wire (or in the peer's
@@ -3056,59 +3072,8 @@ int MPI_Isend(const void *buf, int count, MPI_Datatype dt, int dest,
     if (!resolve_dtype(dt, v)) return MPI_ERR_TYPE;
     int64_t nbytes =
         (int64_t)count * v.elems_per_item() * (int64_t)v.di.item;
-    if (nbytes > g.eager_limit) {
-      // resolve + (if derived) pack NOW: MPI allows MPI_Type_free after
-      // Isend; the contiguous user buffer itself must stay valid until
-      // Wait, so the thread may read it in place.
-      auto *packed = new std::vector<char>;
-      const void *src = buf;
-      size_t n = (size_t)count * v.elems_per_item();
-      if (!v.contiguous()) {
-        pack_dtype(buf, count, v, *packed);
-        src = packed->data();
-        n = packed->size() / v.di.item;
-      }
-      Req *r = new Req;
-      r->heap = true;
-      r->comm = comm;
-      int handle;
-      {
-        std::lock_guard<std::mutex> lk(g.match_mu);
-        handle = g.next_req++;
-        g.reqs[handle] = r;
-      }
-      int dest_world = peer_world_of(*c, dest);
-      int64_t cid = c->cid_pt2pt;
-      DtInfo di = v.di;
-      // the ANNOUNCE goes out on THIS thread before Isend returns: its
-      // position on the control socket is the message's matching order,
-      // so a later send to the same (dest, tag) cannot overtake it
-      int64_t rid;
-      int cts_handle;
-      rc = rndv_announce(n, di, dest_world, tag, cid, rid, cts_handle);
-      if (rc != MPI_SUCCESS) {
-        delete packed;
-        std::lock_guard<std::mutex> lk(g.match_mu);
-        g.reqs.erase(handle);
-        delete r;
-        return rc;
-      }
-      g.inflight_isends.fetch_add(1);
-      std::thread([=]() {
-        int src_rc = rndv_complete(src, n, di, dest_world, rid, cts_handle);
-        {
-          std::lock_guard<std::mutex> lk(g.match_mu);
-          r->status.MPI_ERROR = src_rc;
-          r->status._count = (long long)(n * di.item);
-          r->complete = true;
-        }
-        g.match_cv.notify_all();
-        delete packed;
-        g.inflight_isends.fetch_sub(1);
-      }).detach();
-      *request = handle;
-      return MPI_SUCCESS;
-    }
+    if (nbytes > g.eager_limit)
+      return isend_rndv(buf, count, dt, dest, tag, comm, c, request);
     rc = raw_send(buf, count, dt, peer_world_of(*c, dest), tag,
                   c->cid_pt2pt, /*allow_rndv=*/true);
     if (rc) return rc;
@@ -5163,8 +5128,78 @@ int MPI_Topo_test(MPI_Comm comm, int *status) {
   CommObj *c = lookup_comm(comm);
   if (!c) return MPI_ERR_COMM;
   if (!c->cart_dims.empty()) *status = MPI_CART;
+  else if (c->dist) *status = MPI_DIST_GRAPH;
   else if (!c->graph_index.empty()) *status = MPI_GRAPH;
   else *status = MPI_UNDEFINED;
+  return MPI_SUCCESS;
+}
+
+int MPI_Dist_graph_create_adjacent(
+    MPI_Comm comm, int indegree, const int sources[],
+    const int sourceweights[], int outdegree, const int destinations[],
+    const int destweights[], MPI_Info /*info*/, int /*reorder*/,
+    MPI_Comm *newcomm) {
+  // dist_graph_create_adjacent.c: the adjacent form is fully LOCAL —
+  // every rank already knows its own in/out lists, so the derived comm
+  // needs no neighbor exchange at all (weights are accepted and
+  // ignored, as coll components may)
+  CommObj *c = lookup_comm(comm);
+  if (!c || !c->remote.empty()) return MPI_ERR_COMM;
+  if (indegree < 0 || outdegree < 0) return MPI_ERR_ARG;
+  int n = (int)c->group.size();
+  for (int i = 0; i < indegree; i++)
+    if (sources[i] < 0 || sources[i] >= n) return MPI_ERR_ARG;
+  for (int i = 0; i < outdegree; i++)
+    if (destinations[i] < 0 || destinations[i] >= n) return MPI_ERR_ARG;
+  // derive like Graph_create (split, NOT dup: topology constructors
+  // must not run attribute copy callbacks)
+  int rc = MPI_Comm_split(comm, 0, c->local_rank, newcomm);
+  if (rc != MPI_SUCCESS) return rc;
+  CommObj *nc = lookup_comm(*newcomm);
+  nc->dist = true;
+  nc->dist_src.assign(sources, sources + indegree);
+  nc->dist_dst.assign(destinations, destinations + outdegree);
+  // MPI_UNWEIGHTED is a sentinel pointer; real weight arrays are kept
+  // and reported through the query API
+  nc->dist_weighted = sourceweights != MPI_UNWEIGHTED &&
+                      destweights != MPI_UNWEIGHTED &&
+                      sourceweights != nullptr && destweights != nullptr;
+  if (nc->dist_weighted) {
+    nc->dist_srcw.assign(sourceweights, sourceweights + indegree);
+    nc->dist_dstw.assign(destweights, destweights + outdegree);
+  }
+  return MPI_SUCCESS;
+}
+
+int MPI_Dist_graph_neighbors_count(MPI_Comm comm, int *indegree,
+                                   int *outdegree, int *weighted) {
+  CommObj *c = lookup_comm(comm);
+  if (!c) return MPI_ERR_COMM;
+  if (!c->dist) return MPI_ERR_ARG;
+  *indegree = (int)c->dist_src.size();
+  *outdegree = (int)c->dist_dst.size();
+  *weighted = c->dist_weighted ? 1 : 0;
+  return MPI_SUCCESS;
+}
+
+int MPI_Dist_graph_neighbors(MPI_Comm comm, int maxindegree,
+                             int sources[], int sourceweights[],
+                             int maxoutdegree, int destinations[],
+                             int destweights[]) {
+  CommObj *c = lookup_comm(comm);
+  if (!c) return MPI_ERR_COMM;
+  if (!c->dist) return MPI_ERR_ARG;
+  if (maxindegree < (int)c->dist_src.size() ||
+      maxoutdegree < (int)c->dist_dst.size())
+    return MPI_ERR_ARG;
+  std::copy(c->dist_src.begin(), c->dist_src.end(), sources);
+  std::copy(c->dist_dst.begin(), c->dist_dst.end(), destinations);
+  if (c->dist_weighted) {
+    if (sourceweights && sourceweights != MPI_UNWEIGHTED)
+      std::copy(c->dist_srcw.begin(), c->dist_srcw.end(), sourceweights);
+    if (destweights && destweights != MPI_UNWEIGHTED)
+      std::copy(c->dist_dstw.begin(), c->dist_dstw.end(), destweights);
+  }
   return MPI_SUCCESS;
 }
 
@@ -5180,9 +5215,27 @@ int MPI_Topo_test(MPI_Comm comm, int *status) {
 
 namespace {
 
-// local-rank neighbor list in standard order; MPI_PROC_NULL at walls.
-// Cart neighbors come from MPI_Cart_shift — ONE copy of the
-// wrap/encode rules, shared with user-facing shift.
+// local-rank neighbor lists in standard order; MPI_PROC_NULL at walls.
+// For cart/graph the send and recv lists coincide; a distributed graph
+// (adjacent form) has directed lists.  Cart neighbors come from
+// MPI_Cart_shift — ONE copy of the wrap/encode rules.
+int neighbor_list(MPI_Comm comm, CommObj &c, std::vector<int> &nbrs);
+
+int neighbor_lists(MPI_Comm comm, CommObj &c, std::vector<int> &recv_from,
+                   std::vector<int> &send_to) {
+  if (c.dist) {
+    recv_from = c.dist_src;
+    send_to = c.dist_dst;
+    return MPI_SUCCESS;
+  }
+  std::vector<int> nbrs;
+  int rc = neighbor_list(comm, c, nbrs);
+  if (rc != MPI_SUCCESS) return rc;
+  recv_from = nbrs;
+  send_to = nbrs;
+  return MPI_SUCCESS;
+}
+
 int neighbor_list(MPI_Comm comm, CommObj &c, std::vector<int> &nbrs) {
   nbrs.clear();
   if (!c.cart_dims.empty()) {
@@ -5205,25 +5258,21 @@ int neighbor_list(MPI_Comm comm, CommObj &c, std::vector<int> &nbrs) {
   return MPI_ERR_ARG;  // no topology attached
 }
 
-// tag codes: receiver's slot for cart, parallel-edge ordinal for graph
-void neighbor_codes(CommObj &c, const std::vector<int> &nbrs,
+// tag codes: receiver's slot for cart, parallel-edge ordinal for
+// (dist) graphs — the i-th out-edge to a peer pairs with its i-th
+// in-edge from us, the symmetric-multiplicity convention
+void neighbor_codes(CommObj &c, const std::vector<int> &recv_from,
+                    const std::vector<int> &send_to,
                     std::vector<int> &send_code,
                     std::vector<int> &recv_code) {
-  int n = (int)nbrs.size();
-  send_code.resize(n);
-  recv_code.resize(n);
   bool cart = !c.cart_dims.empty();
-  std::map<int, int> seen;  // neighbor -> parallel-edge ordinal
-  for (int i = 0; i < n; i++) {
-    if (cart) {
-      send_code[i] = i ^ 1;
-      recv_code[i] = i;
-    } else {
-      int ord = seen[nbrs[i]]++;
-      send_code[i] = ord;
-      recv_code[i] = ord;
-    }
-  }
+  send_code.resize(send_to.size());
+  recv_code.resize(recv_from.size());
+  std::map<int, int> seen_s, seen_r;
+  for (size_t i = 0; i < send_to.size(); i++)
+    send_code[i] = cart ? ((int)i ^ 1) : seen_s[send_to[i]]++;
+  for (size_t i = 0; i < recv_from.size(); i++)
+    recv_code[i] = cart ? (int)i : seen_r[recv_from[i]]++;
 }
 
 int c_neighbor_exchange(MPI_Comm comm, CommObj &c, const void *sendbuf,
@@ -5233,46 +5282,46 @@ int c_neighbor_exchange(MPI_Comm comm, CommObj &c, const void *sendbuf,
   DtView sv, rv;
   if (!resolve_dtype(stype, sv) || !resolve_dtype(rtype, rv))
     return MPI_ERR_TYPE;
-  std::vector<int> nbrs;
-  int rc = neighbor_list(comm, c, nbrs);
+  std::vector<int> recv_from, send_to;
+  int rc = neighbor_lists(comm, c, recv_from, send_to);
   if (rc != MPI_SUCCESS) return rc;
   std::vector<int> send_code, recv_code;
-  neighbor_codes(c, nbrs, send_code, recv_code);
-  int n = (int)nbrs.size();
+  neighbor_codes(c, recv_from, send_to, send_code, recv_code);
+  int nr = (int)recv_from.size(), ns = (int)send_to.size();
   int64_t base = (c.coll_seq++ % 0x8000) << 16;
   // slot stride follows the EXTENT rule like every gather-family
   // collective (block i starts at i * slot_bytes), not the packed size
   size_t sslot = slot_bytes(sv, scount);
   size_t rslot = slot_bytes(rv, rcount);
   // post every receive first (the PROC_NULL blocks stay untouched)
-  std::vector<Req> reqs(n);
-  std::vector<int> handles(n, -1);
+  std::vector<Req> reqs(nr);
+  std::vector<int> handles(nr, -1);
   // the stack Reqs must not outlive their registrations: every exit
   // path past this point deregisters whatever is still pending
   auto abort_all = [&](int err) {
     std::lock_guard<std::mutex> lk(g.match_mu);
-    for (int i = 0; i < n; i++)
+    for (int i = 0; i < nr; i++)
       if (handles[i] >= 0) deregister_locked(handles[i], &reqs[i]);
     return err;
   };
-  for (int i = 0; i < n; i++) {
-    if (nbrs[i] == MPI_PROC_NULL) continue;
+  for (int i = 0; i < nr; i++) {
+    if (recv_from[i] == MPI_PROC_NULL) continue;
     reqs[i].is_recv = true;
     reqs[i].user_buf = (char *)recvbuf + (size_t)i * rslot;
     reqs[i].count = rcount;
     handles[i] = post_recv(&reqs[i], rv, c.cid_coll,
-                           world_of(c, nbrs[i]),
+                           world_of(c, recv_from[i]),
                            base | (0x7E20 + recv_code[i]));
   }
-  for (int i = 0; i < n; i++) {
-    if (nbrs[i] == MPI_PROC_NULL) continue;
+  for (int i = 0; i < ns; i++) {
+    if (send_to[i] == MPI_PROC_NULL) continue;
     const char *blk = alltoall ? (const char *)sendbuf + (size_t)i * sslot
                                : (const char *)sendbuf;
-    rc = raw_send(blk, scount, stype, world_of(c, nbrs[i]),
+    rc = raw_send(blk, scount, stype, world_of(c, send_to[i]),
                   base | (0x7E20 + send_code[i]), c.cid_coll);
     if (rc != MPI_SUCCESS) return abort_all(rc);
   }
-  for (int i = 0; i < n; i++) {
+  for (int i = 0; i < nr; i++) {
     if (handles[i] < 0) continue;
     rc = wait_handle(handles[i], nullptr);
     handles[i] = -1;  // consumed (success or not), never re-deregister

@@ -470,6 +470,8 @@ int MPI_Cart_shift(MPI_Comm comm, int direction, int disp,
 /* graph topology (ompi/mpi/c/graph_create.c family) */
 #define MPI_CART  1
 #define MPI_GRAPH 2
+#define MPI_DIST_GRAPH 3
+#define MPI_UNWEIGHTED ((int *)0)
 int MPI_Graph_create(MPI_Comm comm, int nnodes, const int index[],
                      const int edges[], int reorder, MPI_Comm *newcomm);
 int MPI_Graphdims_get(MPI_Comm comm, int *nnodes, int *nedges);
@@ -479,6 +481,17 @@ int MPI_Graph_neighbors_count(MPI_Comm comm, int rank, int *nneighbors);
 int MPI_Graph_neighbors(MPI_Comm comm, int rank, int maxneighbors,
                         int neighbors[]);
 int MPI_Topo_test(MPI_Comm comm, int *status);
+int MPI_Dist_graph_create_adjacent(
+    MPI_Comm comm, int indegree, const int sources[],
+    const int sourceweights[], int outdegree, const int destinations[],
+    const int destweights[], MPI_Info info, int reorder,
+    MPI_Comm *newcomm);
+int MPI_Dist_graph_neighbors_count(MPI_Comm comm, int *indegree,
+                                   int *outdegree, int *weighted);
+int MPI_Dist_graph_neighbors(MPI_Comm comm, int maxindegree,
+                             int sources[], int sourceweights[],
+                             int maxoutdegree, int destinations[],
+                             int destweights[]);
 int MPI_Neighbor_allgather(const void *sendbuf, int sendcount,
                            MPI_Datatype sendtype, void *recvbuf,
                            int recvcount, MPI_Datatype recvtype,
