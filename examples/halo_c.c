@@ -180,6 +180,23 @@ int main(int argc, char **argv) {
   }
 
   MPI_Win_free(&win);
+  /* Cart_sub: slice into row communicators (keep dim 1) — my row comm
+     spans dims[1] ranks and my rank in it is my column coordinate */
+  {
+    int remain[2] = {0, 1};
+    MPI_Comm row;
+    if (MPI_Cart_sub(grid, remain, &row) != MPI_SUCCESS) return 20;
+    int rrank = -1, rsz = -1, rnd = -1;
+    MPI_Comm_rank(row, &rrank);
+    MPI_Comm_size(row, &rsz);
+    MPI_Cartdim_get(row, &rnd);
+    if (rsz != dims[1] || rrank != coords[1] || rnd != 1) return 21;
+    long rv = coords[0] * 100 + coords[1], rs = 0;
+    MPI_Allreduce(&rv, &rs, 1, MPI_LONG, MPI_SUM, row);
+    long want = 0;
+    for (j = 0; j < dims[1]; j++) want += coords[0] * 100 + j;
+    if (rs != want) return 22;
+  }
   MPI_Barrier(MPI_COMM_WORLD);
   printf("halo_c rank %d/%d OK (grid %dx%d at [%d,%d])\n", rank, size,
          dims[0], dims[1], coords[0], coords[1]);

@@ -2840,7 +2840,7 @@ int MPI_Send(const void *buf, int count, MPI_Datatype dt, int dest,
 }
 
 static int make_completed_req(MPI_Comm comm, Req **out = nullptr);
-static int isend_rndv(const void *buf, int count, MPI_Datatype dt,
+static int isend_rndv(const void *buf, int count, const DtView &v,
                       int dest, int tag, MPI_Comm comm, CommObj *c,
                       MPI_Request *request);
 
@@ -2876,7 +2876,9 @@ int MPI_Issend(const void *buf, int count, MPI_Datatype dt, int dest,
   }
   if (tag < 0) return MPI_ERR_ARG;
   if (dest < 0 || dest >= (int)peer_group(*c).size()) return MPI_ERR_ARG;
-  return isend_rndv(buf, count, dt, dest, tag, comm, c, request);
+  DtView v;
+  if (!resolve_dtype(dt, v)) return MPI_ERR_TYPE;
+  return isend_rndv(buf, count, v, dest, tag, comm, c, request);
 }
 
 int MPI_Irsend(const void *buf, int count, MPI_Datatype dt, int dest,
@@ -3000,11 +3002,9 @@ int MPI_Get_count(const MPI_Status *status, MPI_Datatype dt, int *count) {
 // The rendezvous-isend lifecycle (pack-or-inplace, request
 // registration, inline ANNOUNCE for wire order, detached CTS-wait +
 // bulk push), shared by large MPI_Isend and every-size MPI_Issend.
-static int isend_rndv(const void *buf, int count, MPI_Datatype dt,
+static int isend_rndv(const void *buf, int count, const DtView &v,
                       int dest, int tag, MPI_Comm comm, CommObj *c,
                       MPI_Request *request) {
-  DtView v;
-  if (!resolve_dtype(dt, v)) return MPI_ERR_TYPE;
   auto *packed = new std::vector<char>;
   const void *src = buf;
   size_t n = (size_t)count * v.elems_per_item();
@@ -3073,7 +3073,7 @@ int MPI_Isend(const void *buf, int count, MPI_Datatype dt, int dest,
     int64_t nbytes =
         (int64_t)count * v.elems_per_item() * (int64_t)v.di.item;
     if (nbytes > g.eager_limit)
-      return isend_rndv(buf, count, dt, dest, tag, comm, c, request);
+      return isend_rndv(buf, count, v, dest, tag, comm, c, request);
     rc = raw_send(buf, count, dt, peer_world_of(*c, dest), tag,
                   c->cid_pt2pt, /*allow_rndv=*/true);
     if (rc) return rc;
@@ -4556,6 +4556,44 @@ int MPI_Cart_coords(MPI_Comm comm, int rank, int maxdims, int coords[]) {
   return MPI_SUCCESS;
 }
 
+int MPI_Cart_sub(MPI_Comm comm, const int remain_dims[],
+                 MPI_Comm *newcomm) {
+  // cart_sub.c: slice the grid — ranks sharing the coordinates of the
+  // DROPPED dimensions form a sub-grid over the kept ones
+  CommObj *c = lookup_comm(comm);
+  if (!c) return MPI_ERR_COMM;
+  int nd = (int)c->cart_dims.size();
+  if (nd == 0) return MPI_ERR_ARG;
+  std::vector<int> coords(nd);
+  int rc = MPI_Cart_coords(comm, c->local_rank, nd, coords.data());
+  if (rc != MPI_SUCCESS) return rc;
+  // color = the dropped-dim coordinates; key = row-major rank within
+  // the kept dims (so the sub-grid keeps cartesian order)
+  int color = 0, key = 0;
+  for (int d = 0; d < nd; d++) {
+    if (remain_dims[d]) key = key * c->cart_dims[d] + coords[d];
+    else color = color * c->cart_dims[d] + coords[d];
+  }
+  rc = MPI_Comm_split(comm, color, key, newcomm);
+  if (rc != MPI_SUCCESS) return rc;
+  CommObj *nc = lookup_comm(*newcomm);
+  nc->cart_dims.clear();
+  nc->cart_periods.clear();
+  for (int d = 0; d < nd; d++) {
+    if (remain_dims[d]) {
+      nc->cart_dims.push_back(c->cart_dims[d]);
+      nc->cart_periods.push_back(c->cart_periods[d]);
+    }
+  }
+  if (nc->cart_dims.empty()) {
+    // all dims dropped: a 1-rank "grid" of dimension 1 (cart_sub.c
+    // returns a zero-dim cart comm; a single cell keeps the API total)
+    nc->cart_dims.push_back(1);
+    nc->cart_periods.push_back(0);
+  }
+  return MPI_SUCCESS;
+}
+
 int MPI_Cart_shift(MPI_Comm comm, int direction, int disp,
                    int *rank_source, int *rank_dest) {
   CommObj *c = lookup_comm(comm);
@@ -5134,6 +5172,9 @@ int MPI_Topo_test(MPI_Comm comm, int *status) {
   return MPI_SUCCESS;
 }
 
+int zompi_unweighted_[1];
+int zompi_weights_empty_[1];
+
 int MPI_Dist_graph_create_adjacent(
     MPI_Comm comm, int indegree, const int sources[],
     const int sourceweights[], int outdegree, const int destinations[],
@@ -5159,14 +5200,20 @@ int MPI_Dist_graph_create_adjacent(
   nc->dist = true;
   nc->dist_src.assign(sources, sources + indegree);
   nc->dist_dst.assign(destinations, destinations + outdegree);
-  // MPI_UNWEIGHTED is a sentinel pointer; real weight arrays are kept
-  // and reported through the query API
-  nc->dist_weighted = sourceweights != MPI_UNWEIGHTED &&
-                      destweights != MPI_UNWEIGHTED &&
-                      sourceweights != nullptr && destweights != nullptr;
+  // MPI_UNWEIGHTED / MPI_WEIGHTS_EMPTY are distinct sentinel
+  // addresses; a topology is weighted unless BOTH args say unweighted
+  // (a zero-degree side passes WEIGHTS_EMPTY and stays
+  // weighted-compatible, per the spec's adjacent-form contract)
+  auto is_unw = [](const int *w) { return w == MPI_UNWEIGHTED; };
+  auto is_empty = [](const int *w) { return w == MPI_WEIGHTS_EMPTY; };
+  nc->dist_weighted = !is_unw(sourceweights) || !is_unw(destweights);
   if (nc->dist_weighted) {
-    nc->dist_srcw.assign(sourceweights, sourceweights + indegree);
-    nc->dist_dstw.assign(destweights, destweights + outdegree);
+    if (indegree > 0 && !is_unw(sourceweights) &&
+        !is_empty(sourceweights) && sourceweights)
+      nc->dist_srcw.assign(sourceweights, sourceweights + indegree);
+    if (outdegree > 0 && !is_unw(destweights) && !is_empty(destweights) &&
+        destweights)
+      nc->dist_dstw.assign(destweights, destweights + outdegree);
   }
   return MPI_SUCCESS;
 }
@@ -5195,9 +5242,11 @@ int MPI_Dist_graph_neighbors(MPI_Comm comm, int maxindegree,
   std::copy(c->dist_src.begin(), c->dist_src.end(), sources);
   std::copy(c->dist_dst.begin(), c->dist_dst.end(), destinations);
   if (c->dist_weighted) {
-    if (sourceweights && sourceweights != MPI_UNWEIGHTED)
+    if (sourceweights && sourceweights != MPI_UNWEIGHTED &&
+        sourceweights != MPI_WEIGHTS_EMPTY)
       std::copy(c->dist_srcw.begin(), c->dist_srcw.end(), sourceweights);
-    if (destweights && destweights != MPI_UNWEIGHTED)
+    if (destweights && destweights != MPI_UNWEIGHTED &&
+        destweights != MPI_WEIGHTS_EMPTY)
       std::copy(c->dist_dstw.begin(), c->dist_dstw.end(), destweights);
   }
   return MPI_SUCCESS;

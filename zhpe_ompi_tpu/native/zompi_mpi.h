@@ -466,12 +466,19 @@ int MPI_Cart_rank(MPI_Comm comm, const int coords[], int *rank);
 int MPI_Cart_coords(MPI_Comm comm, int rank, int maxdims, int coords[]);
 int MPI_Cart_shift(MPI_Comm comm, int direction, int disp,
                    int *rank_source, int *rank_dest);
+int MPI_Cart_sub(MPI_Comm comm, const int remain_dims[],
+                 MPI_Comm *newcomm);
 
 /* graph topology (ompi/mpi/c/graph_create.c family) */
 #define MPI_CART  1
 #define MPI_GRAPH 2
 #define MPI_DIST_GRAPH 3
-#define MPI_UNWEIGHTED ((int *)0)
+/* distinct sentinel ADDRESSES (not NULL), so "unweighted" and an
+ * erroneous null weights argument stay distinguishable */
+extern int zompi_unweighted_[1];
+extern int zompi_weights_empty_[1];
+#define MPI_UNWEIGHTED    (zompi_unweighted_)
+#define MPI_WEIGHTS_EMPTY (zompi_weights_empty_)
 int MPI_Graph_create(MPI_Comm comm, int nnodes, const int index[],
                      const int edges[], int reorder, MPI_Comm *newcomm);
 int MPI_Graphdims_get(MPI_Comm comm, int *nnodes, int *nedges);
