@@ -19,15 +19,53 @@ static int cmp_double(const void *a, const void *b) {
   return d < 0 ? -1 : d > 0 ? 1 : 0;
 }
 
+static void allreduce_ladder(int rank, int size) {
+  /* osu_allreduce shape over the shim's recursive-doubling engine */
+  size_t elems[] = {1, 16, 256, 4096, 65536, 1048576};
+  double *in = malloc(elems[5] * sizeof(double));
+  double *out = malloc(elems[5] * sizeof(double));
+  for (size_t i = 0; i < elems[5]; i++) in[i] = (double)i;
+  for (int s = 0; s < 6; s++) {
+    size_t n = elems[s];
+    int iters = n <= 4096 ? 100 : 20;
+    double reps[5];
+    for (int rep = 0; rep < 5; rep++) {
+      MPI_Barrier(MPI_COMM_WORLD);
+      double t0 = MPI_Wtime();
+      for (int it = 0; it < iters; it++)
+        MPI_Allreduce(in, out, (int)n, MPI_DOUBLE, MPI_SUM,
+                      MPI_COMM_WORLD);
+      reps[rep] = (MPI_Wtime() - t0) / iters;
+    }
+    if (rank == 0) {
+      qsort(reps, 5, sizeof(double), cmp_double);
+      printf("{\"op\": \"c_allreduce\", \"ranks\": %d, \"bytes\": %zu, "
+             "\"latency_us\": %.2f}\n",
+             size, n * sizeof(double), reps[2] * 1e6);
+      fflush(stdout);
+    }
+  }
+  free(in);
+  free(out);
+}
+
 int main(int argc, char **argv) {
   int rank, size;
   if (MPI_Init(&argc, &argv) != MPI_SUCCESS) return 2;
   MPI_Comm_rank(MPI_COMM_WORLD, &rank);
   MPI_Comm_size(MPI_COMM_WORLD, &size);
   if (size != 2) {
-    if (rank == 0) fprintf(stderr, "osu_c needs exactly 2 ranks\n");
+    if (size < 2) {
+      if (rank == 0)
+        fprintf(stderr, "osu_c needs >= 2 ranks (2 = pt2pt ladder, "
+                        ">2 = allreduce ladder)\n");
+      MPI_Finalize();
+      return 1;
+    }
+    /* >2 ranks runs the collective ladder instead */
+    allreduce_ladder(rank, size);
     MPI_Finalize();
-    return 1;
+    return 0;
   }
   size_t sizes[] = {8, 64, 1024, 4096, 16384, 65536, 262144, 1048576,
                     4194304};
