@@ -1,19 +1,65 @@
 """MFU lever sweep on the real chip: batch x remat x the round-4 levers
-(fused Pallas layernorm, vocab-chunked CE) for the headline config.
-Steady-state discipline from bench.py (burn-in window, median of 3).
+(fused Pallas layernorm, vocab-chunked CE) plus the round-5 flash-attention
+dimension, for the headline config.  Steady-state discipline from bench.py
+(burn-in window, median of 3).
 
-Run from repo root: python benchmarks/mfu_sweep.py
+The tunnel to the chip is intermittent (rounds 3-5 all saw mid-run hangs),
+so the default mode is a SUPERVISOR: each config runs in its own killable
+subprocess with a bounded timeout, results append to a persistent state
+file (``benchmarks/mfu_sweep_state.jsonl``) so a hang costs one config,
+not the window.  Re-running resumes: finished configs are skipped.
+
+    python benchmarks/mfu_sweep.py            # supervisor (resumable)
+    python benchmarks/mfu_sweep.py --one N    # run config N in-process
 """
 
+import json
+import os
+import subprocess
 import sys
 import time
 
-sys.path.insert(0, ".")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-import numpy as np
+STATE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "mfu_sweep_state.jsonl")
+
+# (batch, remat, seq, fused_ln, ce_chunk, flash): the round-3 grid plus the
+# round-4 levers individually and together, plus round-5 flash on/off
+# attribution rows (flash None = auto kernel-if-available, False = naive).
+CONFIGS = [
+    (8, False, 512, False, None, None),
+    (16, False, 512, False, None, None),
+    (32, False, 512, False, None, None),
+    (16, True, 512, False, None, None),
+    (32, True, 512, False, None, None),
+    (64, True, 512, False, None, None),
+    # levers, one at a time then together, at B16/B32 + remat
+    (16, True, 512, None, None, None),
+    (16, True, 512, False, 1024, None),
+    (16, True, 512, None, 1024, None),
+    (32, True, 512, None, 1024, None),
+    (16, True, 512, None, 512, None),
+    (16, True, 512, None, 2048, None),
+    # flash attribution at the headline config (auto row above vs naive)
+    (16, True, 512, None, 1024, False),
+    # long-context rows: seq 4096 where attention is ~36% of FLOPs —
+    # flash auto vs forced-naive isolates the kernel's contribution
+    (2, True, 4096, None, 1024, None),
+    (2, True, 4096, None, 1024, False),
+]
 
 
-def main():
+def cfg_key(c):
+    b, remat, seq, ln, ce, fl = c
+    return (f"B{b}_r{int(remat)}_s{seq}_"
+            f"ln{'a' if ln is None else int(ln)}_ce{ce or 0}_"
+            f"fl{'a' if fl is None else int(fl)}")
+
+
+def run_one(idx: int) -> None:
+    import numpy as np
+
     import jax
     import jax.numpy as jnp
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
@@ -23,61 +69,139 @@ def main():
 
     import bench
 
+    batch, remat, seq, fused_ln, ce_chunk, flash = CONFIGS[idx]
+
     devs = jax.devices()
     mesh = Mesh(np.asarray(devs[:1]).reshape(1, 1), ("dp", "tp"))
     dp_comm = zmpi.Communicator(mesh, "dp", name="sweep_dp")
-
     peak, _ = bench._chip_peak(devs[0])
 
-    # (batch, remat, seq, fused_ln, ce_chunk): the round-3 grid plus the
-    # round-4 levers individually and together at the measured optimum
-    for batch, remat, seq, fused_ln, ce_chunk in [
-        (8, False, 512, False, None), (16, False, 512, False, None),
-        (32, False, 512, False, None), (16, True, 512, False, None),
-        (32, True, 512, False, None), (64, True, 512, False, None),
-        # levers, one at a time then together, at B16/B32 + remat
-        (16, True, 512, None, None), (16, True, 512, False, 1024),
-        (16, True, 512, None, 1024), (32, True, 512, None, 1024),
-        (16, True, 512, None, 512), (16, True, 512, None, 2048),
-    ]:
-        cfg = tfm.Config(
-            vocab=8192, d_model=1024, n_heads=16, d_ff=4096, n_layers=4,
-            seq=seq, dtype=jnp.bfloat16, remat=remat, fused_ln=fused_ln,
-            ce_chunk=ce_chunk,
-        )
-        r = np.random.default_rng(0)
-        params = tfm.init_params(cfg, jax.random.PRNGKey(0))
-        tok = jnp.asarray(r.integers(0, cfg.vocab, (batch, cfg.seq)))
-        tgt = jnp.asarray(r.integers(0, cfg.vocab, (batch, cfg.seq)))
-        step, specs = tfm.make_train_step(cfg, mesh, dp_comm, None)
-        sharded = {k: jax.device_put(v, NamedSharding(mesh, specs[k]))
-                   for k, v in params.items()}
-        dspec = NamedSharding(mesh, P("dp"))
-        tokd, tgtd = jax.device_put(tok, dspec), jax.device_put(tgt, dspec)
+    cfg = tfm.Config(
+        vocab=8192, d_model=1024, n_heads=16, d_ff=4096, n_layers=4,
+        seq=seq, dtype=jnp.bfloat16, remat=remat, fused_ln=fused_ln,
+        ce_chunk=ce_chunk, flash=flash,
+    )
+    r = np.random.default_rng(0)
+    params = tfm.init_params(cfg, jax.random.PRNGKey(0))
+    tok = jnp.asarray(r.integers(0, cfg.vocab, (batch, cfg.seq)))
+    tgt = jnp.asarray(r.integers(0, cfg.vocab, (batch, cfg.seq)))
+    step, specs = tfm.make_train_step(cfg, mesh, dp_comm, None)
+    sharded = {k: jax.device_put(v, NamedSharding(mesh, specs[k]))
+               for k, v in params.items()}
+    dspec = NamedSharding(mesh, P("dp"))
+    tokd, tgtd = jax.device_put(tok, dspec), jax.device_put(tgt, dspec)
+
+    ps, loss = step(sharded, tokd, tgtd)
+    for _ in range(3):
+        ps, loss = step(ps, tokd, tgtd)
+    float(loss)
+    iters = max(4, int(0.5 / (0.003 * batch * seq / 512)))
+    times = []
+    for w in range(4):  # first window discarded
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            ps, loss = step(ps, tokd, tgtd)
+        float(loss)
+        if w > 0:
+            times.append((time.perf_counter() - t0) / iters)
+    med = float(np.median(times))
+    fl = bench._train_flops_per_step(cfg, batch)
+    lev = (f"ln={'auto' if fused_ln is None else int(fused_ln)} "
+           f"ce={ce_chunk or 0} "
+           f"flash={'auto' if flash is None else int(flash)}")
+    print(f"B={batch:3d} remat={int(remat)} seq={seq} {lev}: "
+          f"{med*1e3:7.2f} ms  {batch*seq/med:9.0f} tok/s  "
+          f"MFU {fl/med/peak*100:5.2f}%", flush=True)
+
+
+def _load_state():
+    done = {}
+    if os.path.exists(STATE):
+        with open(STATE) as f:
+            for line in f:
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if rec.get("status") == "ok":
+                    done[rec["key"]] = rec
+    return done
+
+
+def _append_state(rec):
+    with open(STATE, "a") as f:
+        f.write(json.dumps(rec) + "\n")
+
+
+def supervise() -> int:
+    cfg_timeout = float(os.environ.get("ZMPI_SWEEP_CFG_TIMEOUT", 600))
+    probe_timeout = float(os.environ.get("ZMPI_SWEEP_PROBE_TIMEOUT", 240))
+    deadline = time.time() + float(
+        os.environ.get("ZMPI_SWEEP_DEADLINE_S", 6 * 3600))
+    probe_src = "import jax; print(len(jax.devices()))"
+
+    while time.time() < deadline:
+        done = _load_state()
+        todo = [i for i, c in enumerate(CONFIGS) if cfg_key(c) not in done]
+        if not todo:
+            print("sweep complete:", flush=True)
+            for c in CONFIGS:
+                print(" ", done[cfg_key(c)]["line"], flush=True)
+            return 0
+        # probe in a killable child: a down tunnel hangs, not errors
         try:
-            ps, loss = step(sharded, tokd, tgtd)
-            for _ in range(3):
-                ps, loss = step(ps, tokd, tgtd)
-            float(loss)
-            iters = max(4, int(0.5 / (0.003 * batch)))
-            times = []
-            for w in range(4):  # first window discarded
-                t0 = time.perf_counter()
-                for _ in range(iters):
-                    ps, loss = step(ps, tokd, tgtd)
-                float(loss)
-                if w > 0:
-                    times.append((time.perf_counter() - t0) / iters)
-            med = float(np.median(times))
-            fl = bench._train_flops_per_step(cfg, batch)
-            lev = f"ln={'auto' if fused_ln is None else int(fused_ln)} " \
-                  f"ce={ce_chunk or 0}"
-            print(f"B={batch:3d} remat={int(remat)} seq={seq} {lev}: "
-                  f"{med*1e3:7.2f} ms  {batch*seq/med:9.0f} tok/s  "
-                  f"MFU {fl/med/peak*100:5.2f}%", flush=True)
-        except Exception as e:
-            print(f"B={batch:3d} remat={int(remat)} seq={seq}: FAILED "
-                  f"{type(e).__name__}: {str(e)[:120]}", flush=True)
+            p = subprocess.run([sys.executable, "-c", probe_src],
+                               capture_output=True, text=True,
+                               timeout=probe_timeout)
+            up = p.returncode == 0
+        except subprocess.TimeoutExpired:
+            up = False
+        if not up:
+            print(f"[{time.strftime('%H:%M:%S')}] tunnel down "
+                  f"({len(todo)} configs pending); sleeping 300s",
+                  flush=True)
+            time.sleep(300)
+            continue
+        idx = todo[0]
+        key = cfg_key(CONFIGS[idx])
+        print(f"[{time.strftime('%H:%M:%S')}] running config {idx} "
+              f"({key})", flush=True)
+        try:
+            child = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), "--one",
+                 str(idx)],
+                capture_output=True, text=True, timeout=cfg_timeout)
+        except subprocess.TimeoutExpired:
+            _append_state({"key": key, "status": "timeout",
+                           "ts": time.time()})
+            print(f"  config {idx} hung {cfg_timeout:.0f}s (killed)",
+                  flush=True)
+            continue
+        out = (child.stdout or "").strip().splitlines()
+        line = out[-1] if out else ""
+        if child.returncode == 0 and "MFU" in line:
+            _append_state({"key": key, "status": "ok", "line": line,
+                           "warns": [l for l in
+                                     (child.stderr or "").splitlines()
+                                     if "unavailable" in l],
+                           "ts": time.time()})
+            print(" ", line, flush=True)
+        else:
+            _append_state({"key": key, "status": "fail",
+                           "rc": child.returncode,
+                           "err": (child.stderr or "")[-400:],
+                           "ts": time.time()})
+            print(f"  config {idx} FAILED rc={child.returncode}: "
+                  f"{(child.stderr or '')[-200:]}", flush=True)
+    print("sweep deadline reached", flush=True)
+    return 1
+
+
+def main():
+    if "--one" in sys.argv:
+        run_one(int(sys.argv[sys.argv.index("--one") + 1]))
+    else:
+        sys.exit(supervise())
 
 
 if __name__ == "__main__":

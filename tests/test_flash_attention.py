@@ -258,3 +258,44 @@ class TestKernelProbe:
         q = fa.jnp.zeros((1, 128, 2, 8), fa.jnp.float32)
         fa.flash_attention(q, q, q, causal=True)
         assert calls
+
+    def test_probe_runs_concrete_under_jit_trace(self, monkeypatch):
+        """The first attention call is always inside a jit trace (the
+        train step), where omnistaging lifts even constant-input ops to
+        tracers.  The probe must escape the ambient trace: before the
+        ensure_compile_time_eval fix, np.asarray(tracer) raised
+        TracerArrayConversionError and permanently disabled the kernels
+        for every jit'd run (naive O(S^2) attention on TPU)."""
+        import jax
+
+        from zhpe_ompi_tpu.ops import flash_attention as fa
+
+        monkeypatch.setattr(fa, "_kernel_ok", None)
+        monkeypatch.setattr(fa, "_warned", False)
+
+        probe_inputs = []
+
+        def spy(q, k, v, causal, bq, bk, interpret):
+            probe_inputs.append(q)
+            return q  # identity: finite, right shape, concrete iff q is
+
+        monkeypatch.setattr(fa, "_flash", spy)
+
+        class FakeDev:
+            platform = "axon"
+            device_kind = "TPU v5 lite"
+
+        monkeypatch.setattr(fa.jax, "devices", lambda: [FakeDev()])
+
+        verdicts = []
+
+        @jax.jit
+        def traced(x):
+            verdicts.append(fa._kernel_available())
+            return x
+
+        traced(fa.jnp.zeros((2,), fa.jnp.float32))
+        assert verdicts == [True]
+        assert fa._kernel_ok is True
+        # the probe's own input must have been concrete, not a tracer
+        assert not isinstance(probe_inputs[0], jax.core.Tracer)

@@ -451,15 +451,24 @@ def _kernel_available() -> bool:
     """One-shot probe: compile+run a minimal flash kernel on the real
     backend (the mca component_init availability pattern — probe once,
     select accordingly).  Any failure marks the kernel path unavailable
-    for the process."""
+    for the process.
+
+    The probe must run OUTSIDE the ambient trace: the first attention
+    call is always under jit (the train step), where omnistaging turns
+    even constant-input ops into tracers — without the eval context the
+    probe's np.asarray raised TracerArrayConversionError on every jit'd
+    first call and permanently disabled the kernels for the process
+    (naive O(S^2) attention on every TPU run)."""
     global _kernel_ok
     if _kernel_ok is None:
         import numpy as np
 
         try:
-            q = jnp.zeros((1, 256, 1, 64), jnp.bfloat16)
-            out = _flash(q, q, q, True, 128, 128, False)
-            _kernel_ok = bool(np.isfinite(np.asarray(out)).all())
+            with jax.ensure_compile_time_eval():
+                q = jnp.zeros((1, 256, 1, 64), jnp.bfloat16)
+                out = _flash(q, q, q, True, 128, 128, False)
+                ok = bool(np.isfinite(np.asarray(out)).all())
+            _kernel_ok = ok
             if not _kernel_ok:
                 _warn_fallback("probe produced non-finite output")
         except Exception as e:  # noqa: BLE001 - any lowering/exec failure
