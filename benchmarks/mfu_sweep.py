@@ -115,7 +115,10 @@ def run_one(idx: int) -> None:
 
 
 def _load_state():
-    done = {}
+    """(done, attempts): ok records by key, and per-key attempt counts
+    (every record counts — a deterministically failing config must not
+    starve the rest of the sweep)."""
+    done, attempts = {}, {}
     if os.path.exists(STATE):
         with open(STATE) as f:
             for line in f:
@@ -123,9 +126,10 @@ def _load_state():
                     rec = json.loads(line)
                 except ValueError:
                     continue
+                attempts[rec["key"]] = attempts.get(rec["key"], 0) + 1
                 if rec.get("status") == "ok":
                     done[rec["key"]] = rec
-    return done
+    return done, attempts
 
 
 def _append_state(rec):
@@ -140,14 +144,27 @@ def supervise() -> int:
         os.environ.get("ZMPI_SWEEP_DEADLINE_S", 6 * 3600))
     probe_src = "import jax; print(len(jax.devices()))"
 
+    max_attempts = int(os.environ.get("ZMPI_SWEEP_MAX_ATTEMPTS", 3))
     while time.time() < deadline:
-        done = _load_state()
-        todo = [i for i, c in enumerate(CONFIGS) if cfg_key(c) not in done]
+        done, attempts = _load_state()
+        # fewest-attempts-first: a failing config retries (transient
+        # tunnel deaths look like failures) but yields to untried ones;
+        # exhausted configs drop out entirely
+        todo = sorted(
+            (i for i, c in enumerate(CONFIGS)
+             if cfg_key(c) not in done
+             and attempts.get(cfg_key(c), 0) < max_attempts),
+            key=lambda i: attempts.get(cfg_key(CONFIGS[i]), 0))
         if not todo:
-            print("sweep complete:", flush=True)
+            remaining = [cfg_key(c) for c in CONFIGS if cfg_key(c)
+                         not in done]
+            print(f"sweep complete ({len(done)}/{len(CONFIGS)} ok"
+                  + (f"; gave up on {remaining}" if remaining else "")
+                  + "):", flush=True)
             for c in CONFIGS:
-                print(" ", done[cfg_key(c)]["line"], flush=True)
-            return 0
+                if cfg_key(c) in done:
+                    print(" ", done[cfg_key(c)]["line"], flush=True)
+            return 0 if not remaining else 1
         # probe in a killable child: a down tunnel hangs, not errors
         try:
             p = subprocess.run([sys.executable, "-c", probe_src],

@@ -1,0 +1,204 @@
+/* util_c.c — round-5 utility-surface acceptance: versions/threads,
+ * error classes, Alloc_mem, Reduce_local, Request_get_status,
+ * Waitsome, Cancel, Get_elements, Sendrecv_replace, handle c2f/f2c.
+ * Reference shapes: ompi/mpi/c/{get_version,init_thread,
+ * add_error_class,reduce_local,request_get_status,waitsome,cancel,
+ * get_elements,sendrecv_replace,comm_c2f}.c.  Run with >= 2 ranks. */
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include "zompi_mpi.h"
+
+#define CHECK(cond)                                                    \
+  do {                                                                 \
+    if (!(cond)) {                                                     \
+      fprintf(stderr, "FAIL %s:%d: %s\n", __FILE__, __LINE__, #cond);  \
+      MPI_Abort(MPI_COMM_WORLD, 2);                                    \
+    }                                                                  \
+  } while (0)
+
+int main(int argc, char **argv) {
+  int provided = -1;
+  CHECK(MPI_Init_thread(&argc, &argv, MPI_THREAD_MULTIPLE, &provided) ==
+        MPI_SUCCESS);
+  CHECK(provided >= MPI_THREAD_SINGLE && provided <= MPI_THREAD_MULTIPLE);
+
+  int rank, size;
+  MPI_Comm_rank(MPI_COMM_WORLD, &rank);
+  MPI_Comm_size(MPI_COMM_WORLD, &size);
+  CHECK(size >= 2);
+
+  /* versions */
+  int ver, subver;
+  CHECK(MPI_Get_version(&ver, &subver) == MPI_SUCCESS && ver == 3);
+  char lib[MPI_MAX_LIBRARY_VERSION_STRING];
+  int len = 0;
+  CHECK(MPI_Get_library_version(lib, &len) == MPI_SUCCESS && len > 0);
+
+  /* thread identity */
+  int qt = -1, main_th = 0, fin = -1;
+  CHECK(MPI_Query_thread(&qt) == MPI_SUCCESS && qt == provided);
+  CHECK(MPI_Is_thread_main(&main_th) == MPI_SUCCESS && main_th == 1);
+  CHECK(MPI_Finalized(&fin) == MPI_SUCCESS && fin == 0);
+
+  /* error classes */
+  int eclass = -1, ecode = -1, out = -1;
+  CHECK(MPI_Add_error_class(&eclass) == MPI_SUCCESS &&
+        eclass > MPI_ERR_LASTCODE);
+  CHECK(MPI_Add_error_code(eclass, &ecode) == MPI_SUCCESS);
+  CHECK(MPI_Add_error_string(ecode, "app-level frobnication error") ==
+        MPI_SUCCESS);
+  CHECK(MPI_Error_class(ecode, &out) == MPI_SUCCESS && out == eclass);
+  char es[MPI_MAX_ERROR_STRING];
+  CHECK(MPI_Error_string(ecode, es, &len) == MPI_SUCCESS);
+  CHECK(strstr(es, "frobnication") != NULL);
+  CHECK(MPI_Error_class(MPI_ERR_COMM, &out) == MPI_SUCCESS &&
+        out == MPI_ERR_COMM);
+
+  /* memory */
+  void *mem = NULL;
+  CHECK(MPI_Alloc_mem(4096, MPI_INFO_NULL, &mem) == MPI_SUCCESS && mem);
+  memset(mem, 0x5A, 4096);
+  CHECK(MPI_Free_mem(mem) == MPI_SUCCESS);
+  MPI_Aint addr = 0;
+  int probe_target = 7;
+  CHECK(MPI_Get_address(&probe_target, &addr) == MPI_SUCCESS && addr != 0);
+
+  /* op introspection + local reduction */
+  int comm_flag = -1;
+  CHECK(MPI_Op_commutative(MPI_SUM, &comm_flag) == MPI_SUCCESS &&
+        comm_flag == 1);
+  double a[3] = {1, 2, 3}, b[3] = {10, 20, 30};
+  CHECK(MPI_Reduce_local(a, b, 3, MPI_DOUBLE, MPI_SUM) == MPI_SUCCESS);
+  CHECK(b[0] == 11 && b[1] == 22 && b[2] == 33);
+
+  /* handle conversion is the identity on this ABI */
+  CHECK(MPI_Comm_f2c(MPI_Comm_c2f(MPI_COMM_WORLD)) == MPI_COMM_WORLD);
+  CHECK(MPI_Type_f2c(MPI_Type_c2f(MPI_DOUBLE)) == MPI_DOUBLE);
+  CHECK(MPI_Pcontrol(0) == MPI_SUCCESS);
+
+  /* request_get_status (non-destructive) + waitsome + cancel +
+   * get_elements + sendrecv_replace: a 0<->1 exchange.  The pair
+   * synchronizes on its own subcommunicator so ranks >= 2 never see a
+   * mismatched barrier count. */
+  MPI_Comm pair;
+  CHECK(MPI_Comm_split(MPI_COMM_WORLD, rank < 2 ? 0 : 1, rank, &pair) ==
+        MPI_SUCCESS);
+  if (rank < 2) {
+    int peer = 1 - rank;
+
+    /* sendrecv_replace swaps payloads */
+    int v[4] = {rank * 100 + 1, rank * 100 + 2, rank * 100 + 3,
+                rank * 100 + 4};
+    MPI_Status st;
+    memset(&st, 0, sizeof st);
+    CHECK(MPI_Sendrecv_replace(v, 4, MPI_INT, peer, 7, peer, 7,
+                               MPI_COMM_WORLD, &st) == MPI_SUCCESS);
+    CHECK(v[0] == peer * 100 + 1 && v[3] == peer * 100 + 4);
+    CHECK(st.MPI_SOURCE == peer);
+    int elems = -1;
+    CHECK(MPI_Get_elements(&st, MPI_INT, &elems) == MPI_SUCCESS &&
+          elems == 4);
+    int cnt = -1;
+    CHECK(MPI_Get_count(&st, MPI_INT, &cnt) == MPI_SUCCESS && cnt == 4);
+
+    /* sendrecv_replace with a strided vector type: only typemap
+     * positions swap; the stride gap stays untouched */
+    MPI_Datatype vec;
+    CHECK(MPI_Type_vector(2, 2, 3, MPI_INT, &vec) == MPI_SUCCESS);
+    CHECK(MPI_Type_commit(&vec) == MPI_SUCCESS);
+    int sv5[5] = {rank * 10 + 0, rank * 10 + 1, -777, rank * 10 + 3,
+                  rank * 10 + 4};
+    memset(&st, 0, sizeof st);
+    CHECK(MPI_Sendrecv_replace(sv5, 1, vec, peer, 8, peer, 8,
+                               MPI_COMM_WORLD, &st) == MPI_SUCCESS);
+    CHECK(sv5[0] == peer * 10 + 0 && sv5[1] == peer * 10 + 1);
+    CHECK(sv5[2] == -777); /* the gap is not part of the typemap */
+    CHECK(sv5[3] == peer * 10 + 3 && sv5[4] == peer * 10 + 4);
+    CHECK(MPI_Type_free(&vec) == MPI_SUCCESS);
+
+    /* status_set_elements / set_cancelled round-trip */
+    MPI_Status fake;
+    memset(&fake, 0, sizeof fake);
+    CHECK(MPI_Status_set_elements(&fake, MPI_DOUBLE, 5) == MPI_SUCCESS);
+    MPI_Count ce = -1;
+    CHECK(MPI_Get_elements_x(&fake, MPI_DOUBLE, &ce) == MPI_SUCCESS &&
+          ce == 5);
+    int cflag = -1;
+    CHECK(MPI_Status_set_cancelled(&fake, 1) == MPI_SUCCESS);
+    CHECK(MPI_Test_cancelled(&fake, &cflag) == MPI_SUCCESS && cflag == 1);
+
+    /* status c2f/f2c round-trip */
+    MPI_Fint fst[MPI_F_STATUS_SIZE];
+    MPI_Status back;
+    CHECK(MPI_Status_c2f(&fake, fst) == MPI_SUCCESS);
+    CHECK(MPI_Status_f2c(fst, &back) == MPI_SUCCESS);
+    CHECK(back._count == fake._count && back._cancelled == 1);
+
+    /* request_get_status leaves the request live; waitsome retires */
+    int rbuf[2] = {-1, -1};
+    MPI_Request reqs[2];
+    CHECK(MPI_Irecv(&rbuf[0], 1, MPI_INT, peer, 21, MPI_COMM_WORLD,
+                    &reqs[0]) == MPI_SUCCESS);
+    CHECK(MPI_Irecv(&rbuf[1], 1, MPI_INT, peer, 22, MPI_COMM_WORLD,
+                    &reqs[1]) == MPI_SUCCESS);
+    MPI_Barrier(pair); /* both posted before any send */
+    int sv = rank + 40;
+    CHECK(MPI_Send(&sv, 1, MPI_INT, peer, 21, MPI_COMM_WORLD) ==
+          MPI_SUCCESS);
+    sv = rank + 50;
+    CHECK(MPI_Send(&sv, 1, MPI_INT, peer, 22, MPI_COMM_WORLD) ==
+          MPI_SUCCESS);
+
+    /* poll non-destructively until the first request completes */
+    int gflag = 0;
+    while (!gflag)
+      CHECK(MPI_Request_get_status(reqs[0], &gflag, &st) == MPI_SUCCESS);
+    CHECK(reqs[0] != MPI_REQUEST_NULL); /* NOT freed by get_status */
+
+    int done = 0;
+    while (done < 2) {
+      int outcount = 0, idx[2];
+      MPI_Status sts[2];
+      CHECK(MPI_Waitsome(2, reqs, &outcount, idx, sts) == MPI_SUCCESS);
+      CHECK(outcount != MPI_UNDEFINED && outcount >= 1);
+      done += outcount;
+    }
+    CHECK(rbuf[0] == peer + 40 && rbuf[1] == peer + 50);
+    CHECK(reqs[0] == MPI_REQUEST_NULL && reqs[1] == MPI_REQUEST_NULL);
+    int outcount = 0, idx[2];
+    CHECK(MPI_Waitsome(2, reqs, &outcount, idx, NULL) == MPI_SUCCESS);
+    CHECK(outcount == MPI_UNDEFINED); /* nothing active */
+
+    /* waitsome over only-inactive persistent handles: MPI_UNDEFINED
+     * (an inactive handle is not an active participant) */
+    MPI_Request preq;
+    int pb = 0;
+    CHECK(MPI_Recv_init(&pb, 1, MPI_INT, peer, 33, MPI_COMM_WORLD,
+                        &preq) == MPI_SUCCESS);
+    outcount = -5;
+    CHECK(MPI_Testsome(1, &preq, &outcount, idx, NULL) == MPI_SUCCESS);
+    CHECK(outcount == MPI_UNDEFINED);
+    CHECK(MPI_Waitsome(1, &preq, &outcount, idx, NULL) == MPI_SUCCESS);
+    CHECK(outcount == MPI_UNDEFINED);
+    CHECK(preq != MPI_REQUEST_NULL); /* handle survives for Start */
+    CHECK(MPI_Request_free(&preq) == MPI_SUCCESS);
+
+    /* cancel an unmatched receive */
+    MPI_Request creq;
+    int cb = 0;
+    CHECK(MPI_Irecv(&cb, 1, MPI_INT, peer, 999, MPI_COMM_WORLD, &creq) ==
+          MPI_SUCCESS);
+    CHECK(MPI_Cancel(&creq) == MPI_SUCCESS);
+    memset(&st, 0, sizeof st);
+    CHECK(MPI_Wait(&creq, &st) == MPI_SUCCESS);
+    CHECK(MPI_Test_cancelled(&st, &cflag) == MPI_SUCCESS && cflag == 1);
+  }
+
+  MPI_Comm_free(&pair);
+  MPI_Barrier(MPI_COMM_WORLD);
+  if (rank == 0) printf("util_c OK on %d ranks\n", size);
+  MPI_Finalize();
+  CHECK(MPI_Finalized(&fin) == MPI_SUCCESS && fin == 1);
+  return 0;
+}

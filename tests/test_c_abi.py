@@ -97,6 +97,27 @@ class TestPureC:
         for r in range(n):
             assert f"ring_c rank {r}/{n} OK" in outs[r]
 
+    @pytest.mark.parametrize("n", [2, 3])
+    def test_util_example(self, shim, tmp_path_factory, n):
+        """Round-5 utility surface: versions/threads, error classes,
+        Alloc_mem, Reduce_local, Request_get_status, Waitsome, Cancel,
+        Get_elements, Sendrecv_replace, c2f/f2c (self-checking C
+        program; every CHECK aborts on failure)."""
+        util_bin = _compile_example(shim, tmp_path_factory, "util_c.c")
+        port = _free_port()
+        procs = [
+            subprocess.Popen([util_bin], env=_env(r, n, port),
+                             stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                             text=True)
+            for r in range(n)
+        ]
+        outs = []
+        for r, p in enumerate(procs):
+            out, err = p.communicate(timeout=60)
+            assert p.returncode == 0, f"rank {r} failed: {err}\n{out}"
+            outs.append(out)
+        assert f"util_c OK on {n} ranks" in outs[0]
+
 
 class TestInterop:
     def test_c_rank_joins_python_universe(self, shim, tmp_path):

@@ -2169,10 +2169,19 @@ int c_alltoallv(CommObj &c, const void *sendbuf, const int sendcounts[],
 
 // ------------------------------------------------------------ C ABI
 
+// thread-level / finalized bookkeeping (init_thread.c, finalized.c);
+// definitions here so Init/Finalize can stamp them, used by the
+// utilities section below
+static bool g_finalized_flag = false;
+static std::thread::id g_main_tid;
+static int g_thread_level = 0;  // MPI_THREAD_SINGLE
+
 extern "C" {
 
 int MPI_Init(int *, char ***) {
   if (g.initialized) return MPI_ERR_OTHER;
+  g_main_tid = std::this_thread::get_id();
+  g_thread_level = 0;
   const char *r = getenv("ZMPI_RANK");
   const char *s = getenv("ZMPI_SIZE");
   const char *ch = getenv("ZMPI_COORD_HOST");
@@ -2415,6 +2424,7 @@ int MPI_Finalize(void) {
   g_dtypes.clear();
   g_next_dtype = DERIVED_BASE;
   g.initialized = false;
+  g_finalized_flag = true;
   return MPI_SUCCESS;
 }
 
@@ -2947,6 +2957,17 @@ int MPI_Ibsend(const void *buf, int count, MPI_Datatype dt, int dest,
   return MPI_SUCCESS;
 }
 
+// the MPI-defined "empty" status (request.h's completed-null shape):
+// no source, no tag, zero payload, not cancelled
+static void empty_status(MPI_Status *status, int source = MPI_ANY_SOURCE) {
+  if (!status) return;
+  status->MPI_SOURCE = source;
+  status->MPI_TAG = MPI_ANY_TAG;
+  status->MPI_ERROR = MPI_SUCCESS;
+  status->_count = 0;
+  status->_cancelled = 0;
+}
+
 static int translate_status(CommObj *c, MPI_Status *status) {
   if (status && c) {
     // sources arrive as world ranks; on an intercommunicator they are
@@ -2962,12 +2983,7 @@ int MPI_Recv(void *buf, int count, MPI_Datatype dt, int source, int tag,
   CommObj *c = lookup_comm(comm);
   if (!c) return MPI_ERR_COMM;
   if (source == MPI_PROC_NULL) {
-    if (status) {
-      status->MPI_SOURCE = MPI_PROC_NULL;
-      status->MPI_TAG = MPI_ANY_TAG;
-      status->MPI_ERROR = MPI_SUCCESS;
-      status->_count = 0;
-    }
+    empty_status(status, MPI_PROC_NULL);
     return MPI_SUCCESS;
   }
   DtView v;
@@ -3229,12 +3245,7 @@ int MPI_Request_free(MPI_Request *request) {
 
 int MPI_Wait(MPI_Request *request, MPI_Status *status) {
   if (!request || *request == MPI_REQUEST_NULL) {
-    if (status) {
-      status->MPI_SOURCE = MPI_ANY_SOURCE;
-      status->MPI_TAG = MPI_ANY_TAG;
-      status->MPI_ERROR = MPI_SUCCESS;
-      status->_count = 0;
-    }
+    empty_status(status);
     return MPI_SUCCESS;
   }
   if (*request < MPI_REQUEST_NULL) {
@@ -3271,6 +3282,7 @@ int MPI_Wait(MPI_Request *request, MPI_Status *status) {
 int MPI_Test(MPI_Request *request, int *flag, MPI_Status *status) {
   if (!request || *request == MPI_REQUEST_NULL) {
     *flag = 1;
+    empty_status(status);
     return MPI_SUCCESS;
   }
   if (*request < MPI_REQUEST_NULL) {
@@ -3279,12 +3291,7 @@ int MPI_Test(MPI_Request *request, int *flag, MPI_Status *status) {
     PersistentReq &p = it->second;
     if (p.active == MPI_REQUEST_NULL) {
       *flag = 1;
-      if (status) {
-        status->MPI_SOURCE = MPI_ANY_SOURCE;
-        status->MPI_TAG = MPI_ANY_TAG;
-        status->MPI_ERROR = MPI_SUCCESS;
-        status->_count = 0;
-      }
+      empty_status(status);
       return MPI_SUCCESS;
     }
     *flag = 0;
@@ -3581,6 +3588,7 @@ int probe_impl(int source, int tag, CommObj *c, int *flag,
         // rendezvous reports the size its RTS declared
         status->_count = m.rndv_pending ? (long long)m.rndv_nbytes
                                          : (long long)m.data.size();
+        status->_cancelled = 0;
       }
       if (flag) *flag = 1;
       return MPI_SUCCESS;
@@ -3654,12 +3662,7 @@ int MPI_Testany(int count, MPI_Request requests[], int *index, int *flag,
   if (!any_active) {
     *index = MPI_UNDEFINED;
     *flag = 1;
-    if (status) {
-      status->MPI_SOURCE = MPI_ANY_SOURCE;
-      status->MPI_TAG = MPI_ANY_TAG;
-      status->MPI_ERROR = MPI_SUCCESS;
-      status->_count = 0;
-    }
+    empty_status(status);
     return MPI_SUCCESS;
   }
   if (ready < 0) {
@@ -3814,7 +3817,18 @@ int MPI_Op_free(MPI_Op *op) {
 
 // --------------------------------------------------------- diagnostics
 
+// user-added error classes/codes/strings (add_error_class.c family)
+static std::map<int, std::string> g_err_strings;
+static std::map<int, int> g_err_class;  // user code -> its class
+static int g_next_err = MPI_ERR_LASTCODE + 1;
+
 int MPI_Error_string(int errorcode, char *string, int *resultlen) {
+  auto uit = g_err_strings.find(errorcode);
+  if (uit != g_err_strings.end()) {
+    snprintf(string, MPI_MAX_ERROR_STRING, "%s", uit->second.c_str());
+    *resultlen = (int)strlen(string);
+    return MPI_SUCCESS;
+  }
   const char *s;
   switch (errorcode) {
     case MPI_SUCCESS:      s = "MPI_SUCCESS: no error"; break;
@@ -3873,6 +3887,7 @@ void file_status(MPI_Status *status, size_t nbytes) {
     status->MPI_TAG = MPI_ANY_TAG;
     status->MPI_ERROR = MPI_SUCCESS;
     status->_count = (long long)nbytes;
+    status->_cancelled = 0;
   }
 }
 
@@ -6214,6 +6229,397 @@ int MPI_Compare_and_swap(const void *origin_addr, const void *compare_addr,
   int64_t disp = (int64_t)target_disp * w->disp_unit;
   return zompi_win_amo(win, target_rank, disp, "cas", dt, opnd.data(), 2,
                        result_addr);
+}
+
+// ----------------------------------------------- utilities (round 5)
+// Versions/threads, error classes, memory, local reduction, request
+// and status utilities, Fortran handle conversion.  Reference bindings:
+// get_version.c, init_thread.c, add_error_class.c, alloc_mem.c,
+// reduce_local.c, request_get_status.c, waitsome.c, cancel.c,
+// sendrecv_replace.c, comm_c2f.c et al.
+
+int MPI_Get_version(int *version, int *subversion) {
+  *version = MPI_VERSION;
+  *subversion = MPI_SUBVERSION;
+  return MPI_SUCCESS;
+}
+
+int MPI_Get_library_version(char *version, int *resultlen) {
+  snprintf(version, MPI_MAX_LIBRARY_VERSION_STRING,
+           "zhpe-ompi-tpu C shim (mpi.h-compatible host plane), "
+           "MPI %d.%d surface", MPI_VERSION, MPI_SUBVERSION);
+  *resultlen = (int)strlen(version);
+  return MPI_SUCCESS;
+}
+
+int MPI_Init_thread(int *argc, char ***argv, int required, int *provided) {
+  // the engine's internal locks serialize the matching/send paths;
+  // SERIALIZED is the honest ceiling (init_thread.c's shape: provided
+  // = min(required, ceiling))
+  int ceiling = MPI_THREAD_SERIALIZED;
+  int rc = MPI_Init(argc, argv);
+  if (rc != MPI_SUCCESS) return rc;
+  g_thread_level = required < ceiling ? required : ceiling;
+  if (g_thread_level < MPI_THREAD_SINGLE)
+    g_thread_level = MPI_THREAD_SINGLE;
+  if (provided) *provided = g_thread_level;
+  return MPI_SUCCESS;
+}
+
+int MPI_Query_thread(int *provided) {
+  *provided = g_thread_level;
+  return MPI_SUCCESS;
+}
+
+int MPI_Is_thread_main(int *flag) {
+  *flag = std::this_thread::get_id() == g_main_tid ? 1 : 0;
+  return MPI_SUCCESS;
+}
+
+int MPI_Finalized(int *flag) {
+  *flag = g_finalized_flag ? 1 : 0;
+  return MPI_SUCCESS;
+}
+
+int MPI_Error_class(int errorcode, int *errorclass) {
+  auto it = g_err_class.find(errorcode);
+  *errorclass = it != g_err_class.end() ? it->second : errorcode;
+  return MPI_SUCCESS;
+}
+
+int MPI_Add_error_class(int *errorclass) {
+  int c = g_next_err++;
+  g_err_class[c] = c;
+  *errorclass = c;
+  return MPI_SUCCESS;
+}
+
+int MPI_Add_error_code(int errorclass, int *errorcode) {
+  int c = g_next_err++;
+  g_err_class[c] = errorclass;
+  *errorcode = c;
+  return MPI_SUCCESS;
+}
+
+int MPI_Add_error_string(int errorcode, const char *string) {
+  g_err_strings[errorcode] = string ? string : "";
+  return MPI_SUCCESS;
+}
+
+int MPI_Alloc_mem(MPI_Aint size, MPI_Info, void *baseptr) {
+  if (size < 0) return MPI_ERR_ARG;
+  void *p = malloc(size ? (size_t)size : 1);
+  if (!p) return MPI_ERR_OTHER;
+  *(void **)baseptr = p;
+  return MPI_SUCCESS;
+}
+
+int MPI_Free_mem(void *base) {
+  free(base);
+  return MPI_SUCCESS;
+}
+
+int MPI_Get_address(const void *location, MPI_Aint *address) {
+  *address = (MPI_Aint)(uintptr_t)location;
+  return MPI_SUCCESS;
+}
+
+int MPI_Address(void *location, MPI_Aint *address) {
+  return MPI_Get_address(location, address);
+}
+
+int MPI_Op_commutative(MPI_Op op, int *commute) {
+  auto uit = g_user_ops.find(op);
+  if (uit != g_user_ops.end()) {
+    *commute = uit->second.commute ? 1 : 0;
+    return MPI_SUCCESS;
+  }
+  if (op < 0 || (op > MPI_BXOR && op != MPI_REPLACE && op != MPI_NO_OP))
+    return MPI_ERR_OP;
+  *commute = 1;  // every predefined op here is commutative
+  return MPI_SUCCESS;
+}
+
+int MPI_Reduce_local(const void *inbuf, void *inoutbuf, int count,
+                     MPI_Datatype dt, MPI_Op op) {
+  // reduce_local.c: inout = in (op) inout, invec the LEFT operand
+  if (count < 0) return MPI_ERR_COUNT;
+  auto uit = g_user_ops.find(op);
+  if (uit != g_user_ops.end()) {
+    // exactly the user-function contract — no copies needed
+    int len = count;
+    MPI_Datatype d = dt;
+    uit->second.fn((void *)inbuf, inoutbuf, &len, &d);
+    return MPI_SUCCESS;
+  }
+  // predefined ops are commutative, so acc-left reduce_buf matches
+  return reduce_buf(inoutbuf, inbuf, count, dt, op);
+}
+
+int MPI_Request_get_status(MPI_Request request, int *flag,
+                           MPI_Status *status) {
+  // request_get_status.c: non-destructive completion query — the
+  // request is neither freed nor deactivated
+  if (request == MPI_REQUEST_NULL) {
+    *flag = 1;
+    empty_status(status);
+    return MPI_SUCCESS;
+  }
+  int inner = request;
+  if (request < MPI_REQUEST_NULL) {
+    auto pit = g_persistent.find(-request);
+    if (pit == g_persistent.end()) return MPI_ERR_REQUEST;
+    if (pit->second.active == MPI_REQUEST_NULL) {
+      MPI_Request nullr = MPI_REQUEST_NULL;
+      return MPI_Request_get_status(nullr, flag, status);
+    }
+    inner = pit->second.active;
+  }
+  Req *r;
+  {
+    std::lock_guard<std::mutex> lk(g.match_mu);
+    auto it = g.reqs.find(inner);
+    if (it == g.reqs.end()) return MPI_ERR_REQUEST;
+    r = it->second;
+    if (!r->complete) {
+      *flag = 0;
+      return MPI_SUCCESS;
+    }
+  }
+  // The operation is being REPORTED complete, so the receive buffer
+  // must be usable now: run the derived-type unpack (idempotent; the
+  // later Wait/Test sees needs_unpack already cleared).  Outside
+  // match_mu — a multi-MB unpack must not stall the matching threads —
+  // which is safe at the declared MPI_THREAD_SERIALIZED level: only
+  // the (single) app thread completes requests, so `r` cannot be
+  // Wait-freed concurrently.
+  finish_recv(r);
+  *flag = 1;
+  if (status) {
+    *status = r->status;
+    translate_status(lookup_comm(r->comm), status);
+  }
+  return MPI_SUCCESS;
+}
+
+namespace {
+
+// one completion sweep shared by Waitsome/Testsome: harvest every
+// currently-complete ACTIVE request, Wait-ing each to run its normal
+// retire path.  Null handles and inactive persistent handles do not
+// participate (waitsome.c: outcount is MPI_UNDEFINED when no handle is
+// active).  *any_active reports whether an active handle exists; on an
+// error mid-harvest, *outcount counts only the fully-retired entries,
+// so indices/statuses[0..outcount) are always valid.  match_mu must
+// NOT be held.
+int harvest_some(int incount, MPI_Request requests[], int *outcount,
+                 int indices[], MPI_Status statuses[], bool *any_active) {
+  std::vector<int> ready;
+  *any_active = false;
+  *outcount = 0;  // defined even on an early MPI_ERR_REQUEST return
+  {
+    std::lock_guard<std::mutex> lk(g.match_mu);
+    for (int i = 0; i < incount; i++) {
+      MPI_Request h = requests[i];
+      if (h == MPI_REQUEST_NULL) continue;
+      int inner = h;
+      if (h < MPI_REQUEST_NULL) {
+        auto pit = g_persistent.find(-h);
+        if (pit == g_persistent.end()) return MPI_ERR_REQUEST;
+        if (pit->second.active == MPI_REQUEST_NULL)
+          continue;  // inactive persistent: not a participant
+        inner = pit->second.active;
+      }
+      auto it = g.reqs.find(inner);
+      if (it == g.reqs.end()) return MPI_ERR_REQUEST;
+      *any_active = true;
+      if (it->second->complete) ready.push_back(i);
+    }
+  }
+  for (size_t k = 0; k < ready.size(); k++) {
+    indices[k] = ready[k];
+    int rc = MPI_Wait(&requests[ready[k]],
+                      statuses ? &statuses[k] : MPI_STATUS_IGNORE);
+    if (rc != MPI_SUCCESS) return rc;
+    *outcount = (int)k + 1;
+  }
+  return MPI_SUCCESS;
+}
+
+}  // namespace
+
+int MPI_Waitsome(int incount, MPI_Request requests[], int *outcount,
+                 int indices[], MPI_Status statuses[]) {
+  while (true) {
+    bool any_active = false;
+    int rc = harvest_some(incount, requests, outcount, indices, statuses,
+                          &any_active);
+    if (rc != MPI_SUCCESS) return rc;
+    if (!any_active) {
+      *outcount = MPI_UNDEFINED;
+      return MPI_SUCCESS;
+    }
+    if (*outcount > 0) return MPI_SUCCESS;
+    std::unique_lock<std::mutex> lk(g.match_mu);
+    g.match_cv.wait_for(lk, std::chrono::milliseconds(100));
+    if (g.closing.load()) return MPI_ERR_OTHER;
+  }
+}
+
+int MPI_Testsome(int incount, MPI_Request requests[], int *outcount,
+                 int indices[], MPI_Status statuses[]) {
+  bool any_active = false;
+  int rc = harvest_some(incount, requests, outcount, indices, statuses,
+                        &any_active);
+  if (rc != MPI_SUCCESS) return rc;
+  if (!any_active) *outcount = MPI_UNDEFINED;
+  return MPI_SUCCESS;
+}
+
+int MPI_Cancel(MPI_Request *request) {
+  // cancel.c semantics, reduced to the deterministically-cancellable
+  // case: an UNMATCHED posted receive is withdrawn and completes with
+  // the cancelled bit; anything else (sends, matched receives) is left
+  // to complete normally — MPI_Test_cancelled then reports false,
+  // which is a legal outcome of MPI_Cancel
+  if (!request || *request == MPI_REQUEST_NULL) return MPI_ERR_REQUEST;
+  if (*request < MPI_REQUEST_NULL) return MPI_ERR_REQUEST;
+  std::lock_guard<std::mutex> lk(g.match_mu);
+  auto it = g.reqs.find(*request);
+  if (it == g.reqs.end()) return MPI_ERR_REQUEST;
+  Req *r = it->second;
+  if (!r->is_recv || r->complete) return MPI_SUCCESS;
+  for (auto pit = g.posted.begin(); pit != g.posted.end(); ++pit) {
+    if (pit->req == r) {
+      g.posted.erase(pit);
+      r->status.MPI_SOURCE = MPI_ANY_SOURCE;
+      r->status.MPI_TAG = MPI_ANY_TAG;
+      r->status.MPI_ERROR = MPI_SUCCESS;
+      r->status._count = 0;
+      r->status._cancelled = 1;
+      r->complete = true;
+      g.match_cv.notify_all();
+      return MPI_SUCCESS;
+    }
+  }
+  return MPI_SUCCESS;  // matched already (e.g. parked rendezvous)
+}
+
+int MPI_Test_cancelled(const MPI_Status *status, int *flag) {
+  *flag = status->_cancelled ? 1 : 0;
+  return MPI_SUCCESS;
+}
+
+int MPI_Status_set_cancelled(MPI_Status *status, int flag) {
+  status->_cancelled = flag ? 1 : 0;
+  return MPI_SUCCESS;
+}
+
+int MPI_Get_elements_x(const MPI_Status *status, MPI_Datatype dt,
+                       MPI_Count *count) {
+  // get_elements.c: BASE-element count, partial items included —
+  // _count carries wire bytes of packed base elements
+  DtView v;
+  if (!resolve_dtype(dt, v)) return MPI_ERR_TYPE;
+  if (v.di.item == 0) return MPI_ERR_TYPE;
+  *count = (MPI_Count)(status->_count / (long long)v.di.item);
+  return MPI_SUCCESS;
+}
+
+int MPI_Get_elements(const MPI_Status *status, MPI_Datatype dt,
+                     int *count) {
+  MPI_Count n;
+  int rc = MPI_Get_elements_x(status, dt, &n);
+  if (rc != MPI_SUCCESS) return rc;
+  *count = n > 2147483647LL ? MPI_UNDEFINED : (int)n;
+  return MPI_SUCCESS;
+}
+
+int MPI_Status_set_elements_x(MPI_Status *status, MPI_Datatype dt,
+                              MPI_Count count) {
+  DtView v;
+  if (!resolve_dtype(dt, v)) return MPI_ERR_TYPE;
+  status->_count = (long long)count * (long long)v.di.item;
+  return MPI_SUCCESS;
+}
+
+int MPI_Status_set_elements(MPI_Status *status, MPI_Datatype dt,
+                            int count) {
+  return MPI_Status_set_elements_x(status, dt, (MPI_Count)count);
+}
+
+int MPI_Sendrecv_replace(void *buf, int count, MPI_Datatype dt, int dest,
+                         int sendtag, int source, int recvtag,
+                         MPI_Comm comm, MPI_Status *status) {
+  // sendrecv_replace.c: snapshot the full extent region, post the
+  // receive into the user buffer, send from the snapshot (same
+  // typemap, so layout is preserved), then wait both
+  CommObj *c = lookup_comm(comm);
+  if (!c) return MPI_ERR_COMM;
+  DtView v;
+  if (!resolve_dtype(dt, v)) return MPI_ERR_TYPE;
+  // pack touches only typemap bytes — a raw extent-sized memcpy would
+  // overread the trailing gap of a strided type (a conforming buffer
+  // may end at the last typemap byte).  The wire carries packed base
+  // elements for any send, so the snapshot goes out as base elements
+  // directly: identical bytes to sending `buf` with `dt`.
+  std::vector<char> packed;
+  pack_dtype(buf, count, v, packed);
+  MPI_Datatype base_dt = v.derived ? v.derived->base : dt;
+  int base_elems = (int)((int64_t)count * v.elems_per_item());
+  MPI_Request rreq;
+  int rc = MPI_Irecv(buf, count, dt, source, recvtag, comm, &rreq);
+  if (rc != MPI_SUCCESS) return rc;
+  rc = MPI_Send(packed.data(), base_elems, base_dt, dest, sendtag, comm);
+  if (rc != MPI_SUCCESS) {
+    // never leave a posted receive aimed at the caller's buffer: a
+    // later matching message would land in a dead stack frame
+    MPI_Cancel(&rreq);
+    MPI_Wait(&rreq, MPI_STATUS_IGNORE);
+    return rc;
+  }
+  return MPI_Wait(&rreq, status);
+}
+
+int MPI_Pcontrol(const int, ...) { return MPI_SUCCESS; }
+
+MPI_Fint MPI_Comm_c2f(MPI_Comm comm) { return (MPI_Fint)comm; }
+MPI_Comm MPI_Comm_f2c(MPI_Fint comm) { return (MPI_Comm)comm; }
+MPI_Fint MPI_Type_c2f(MPI_Datatype dt) { return (MPI_Fint)dt; }
+MPI_Datatype MPI_Type_f2c(MPI_Fint dt) { return (MPI_Datatype)dt; }
+MPI_Fint MPI_Group_c2f(MPI_Group group) { return (MPI_Fint)group; }
+MPI_Group MPI_Group_f2c(MPI_Fint group) { return (MPI_Group)group; }
+MPI_Fint MPI_Op_c2f(MPI_Op op) { return (MPI_Fint)op; }
+MPI_Op MPI_Op_f2c(MPI_Fint op) { return (MPI_Op)op; }
+MPI_Fint MPI_Request_c2f(MPI_Request request) { return (MPI_Fint)request; }
+MPI_Request MPI_Request_f2c(MPI_Fint request) {
+  return (MPI_Request)request;
+}
+MPI_Fint MPI_Win_c2f(MPI_Win win) { return (MPI_Fint)win; }
+MPI_Win MPI_Win_f2c(MPI_Fint win) { return (MPI_Win)win; }
+MPI_Fint MPI_File_c2f(MPI_File file) { return (MPI_Fint)file; }
+MPI_File MPI_File_f2c(MPI_Fint file) { return (MPI_File)file; }
+MPI_Fint MPI_Info_c2f(MPI_Info info) { return (MPI_Fint)info; }
+MPI_Info MPI_Info_f2c(MPI_Fint info) { return (MPI_Info)info; }
+
+int MPI_Status_c2f(const MPI_Status *c_status, MPI_Fint *f_status) {
+  f_status[0] = c_status->MPI_SOURCE;
+  f_status[1] = c_status->MPI_TAG;
+  f_status[2] = c_status->MPI_ERROR;
+  f_status[3] = (MPI_Fint)(c_status->_count & 0x7FFFFFFF);
+  f_status[4] = (MPI_Fint)(c_status->_count >> 31);
+  f_status[5] = c_status->_cancelled;
+  return MPI_SUCCESS;
+}
+
+int MPI_Status_f2c(const MPI_Fint *f_status, MPI_Status *c_status) {
+  c_status->MPI_SOURCE = f_status[0];
+  c_status->MPI_TAG = f_status[1];
+  c_status->MPI_ERROR = f_status[2];
+  c_status->_count =
+      (long long)f_status[3] | ((long long)f_status[4] << 31);
+  c_status->_cancelled = f_status[5];
+  return MPI_SUCCESS;
 }
 
 // ---------------------------------------------------------------- misc

@@ -108,6 +108,9 @@ typedef int MPI_Request;
 #define MPI_REQUEST_NULL (-1)
 
 typedef int MPI_Info;
+typedef long long MPI_Aint;
+typedef int MPI_Win;
+typedef int MPI_File;
 
 #define MPI_ANY_SOURCE (-1)
 #define MPI_ANY_TAG    (-1)
@@ -133,10 +136,30 @@ typedef struct MPI_Status {
   int MPI_ERROR;
   long long _count; /* received BYTES (MPI_Get_count converts); wide so
                        any-size rendezvous payloads cannot wrap an int */
+  int _cancelled;   /* MPI_Test_cancelled / MPI_Status_set_cancelled */
+  int _reserved;
 } MPI_Status;
 
 #define MPI_STATUS_IGNORE   ((MPI_Status *)0)
 #define MPI_STATUSES_IGNORE ((MPI_Status *)0)
+
+/* versions (get_version.c / get_library_version.c) */
+#define MPI_VERSION 3
+#define MPI_SUBVERSION 1
+#define MPI_MAX_LIBRARY_VERSION_STRING 256
+int MPI_Get_version(int *version, int *subversion);
+int MPI_Get_library_version(char *version, int *resultlen);
+
+/* thread levels (init_thread.c): the engine serializes internally via
+ * its matching/send locks; SERIALIZED is the honest provided level */
+#define MPI_THREAD_SINGLE     0
+#define MPI_THREAD_FUNNELED   1
+#define MPI_THREAD_SERIALIZED 2
+#define MPI_THREAD_MULTIPLE   3
+int MPI_Init_thread(int *argc, char ***argv, int required, int *provided);
+int MPI_Query_thread(int *provided);
+int MPI_Is_thread_main(int *flag);
+int MPI_Finalized(int *flag);
 
 /* init / identity */
 int MPI_Init(int *argc, char ***argv);
@@ -329,15 +352,84 @@ typedef void MPI_User_function(void *invec, void *inoutvec, int *len,
 int MPI_Op_create(MPI_User_function *function, int commute, MPI_Op *op);
 int MPI_Op_free(MPI_Op *op);
 
-/* diagnostics */
+/* diagnostics and error classes (error_class.c / add_error_class.c) */
+#define MPI_ERR_LASTCODE 92
 int MPI_Error_string(int errorcode, char *string, int *resultlen);
+int MPI_Error_class(int errorcode, int *errorclass);
+int MPI_Add_error_class(int *errorclass);
+int MPI_Add_error_code(int errorclass, int *errorcode);
+int MPI_Add_error_string(int errorcode, const char *string);
 int MPI_Type_get_extent(MPI_Datatype dt, long *lb, long *extent);
+
+/* memory (alloc_mem.c): XLA owns device memory; host-side this is the
+ * allocator surface only */
+int MPI_Alloc_mem(MPI_Aint size, MPI_Info info, void *baseptr);
+int MPI_Free_mem(void *base);
+
+/* address arithmetic (get_address.c + the deprecated MPI-1 form) */
+int MPI_Get_address(const void *location, MPI_Aint *address);
+int MPI_Address(void *location, MPI_Aint *address);
+
+/* op introspection + local reduction (op_commutative.c / reduce_local.c) */
+int MPI_Op_commutative(MPI_Op op, int *commute);
+int MPI_Reduce_local(const void *inbuf, void *inoutbuf, int count,
+                     MPI_Datatype dt, MPI_Op op);
+
+/* request/status utilities (request_get_status.c, waitsome.c,
+ * testsome.c, cancel.c, get_elements.c, status_set_*.c) */
+typedef long long MPI_Count;
+int MPI_Request_get_status(MPI_Request request, int *flag,
+                           MPI_Status *status);
+int MPI_Waitsome(int incount, MPI_Request requests[], int *outcount,
+                 int indices[], MPI_Status statuses[]);
+int MPI_Testsome(int incount, MPI_Request requests[], int *outcount,
+                 int indices[], MPI_Status statuses[]);
+int MPI_Cancel(MPI_Request *request);
+int MPI_Test_cancelled(const MPI_Status *status, int *flag);
+int MPI_Status_set_cancelled(MPI_Status *status, int flag);
+int MPI_Get_elements(const MPI_Status *status, MPI_Datatype dt,
+                     int *count);
+int MPI_Get_elements_x(const MPI_Status *status, MPI_Datatype dt,
+                       MPI_Count *count);
+int MPI_Status_set_elements(MPI_Status *status, MPI_Datatype dt,
+                            int count);
+int MPI_Status_set_elements_x(MPI_Status *status, MPI_Datatype dt,
+                              MPI_Count count);
+int MPI_Sendrecv_replace(void *buf, int count, MPI_Datatype dt, int dest,
+                         int sendtag, int source, int recvtag,
+                         MPI_Comm comm, MPI_Status *status);
+
+/* profiling control (pcontrol.c): accepted, no-op */
+int MPI_Pcontrol(const int level, ...);
+
+/* Fortran handle conversion (comm_c2f.c family): handles are ints on
+ * both sides, so conversions are the identity — the surface exists so
+ * tooling written against mpi.h compiles */
+typedef int MPI_Fint;
+#define MPI_F_STATUS_SIZE 6
+MPI_Fint MPI_Comm_c2f(MPI_Comm comm);
+MPI_Comm MPI_Comm_f2c(MPI_Fint comm);
+MPI_Fint MPI_Type_c2f(MPI_Datatype dt);
+MPI_Datatype MPI_Type_f2c(MPI_Fint dt);
+MPI_Fint MPI_Group_c2f(MPI_Group group);
+MPI_Group MPI_Group_f2c(MPI_Fint group);
+MPI_Fint MPI_Op_c2f(MPI_Op op);
+MPI_Op MPI_Op_f2c(MPI_Fint op);
+MPI_Fint MPI_Request_c2f(MPI_Request request);
+MPI_Request MPI_Request_f2c(MPI_Fint request);
+MPI_Fint MPI_Win_c2f(MPI_Win win);
+MPI_Win MPI_Win_f2c(MPI_Fint win);
+MPI_Fint MPI_File_c2f(MPI_File file);
+MPI_File MPI_File_f2c(MPI_Fint file);
+MPI_Fint MPI_Info_c2f(MPI_Info info);
+MPI_Info MPI_Info_f2c(MPI_Fint info);
+int MPI_Status_c2f(const MPI_Status *c_status, MPI_Fint *f_status);
+int MPI_Status_f2c(const MPI_Fint *f_status, MPI_Status *c_status);
 
 /* MPI-IO (byte views: no set_view in the C surface — offsets are in
  * bytes, the default MPI_BYTE etype; the Python plane owns file views
  * and collective/nonblocking IO).  Open/close/set_size are collective
  * over the communicator. */
-typedef int MPI_File;
 typedef long long MPI_Offset;
 #define MPI_FILE_NULL (-1)
 #define MPI_INFO_NULL 0
@@ -509,8 +601,6 @@ int MPI_Neighbor_alltoall(const void *sendbuf, int sendcount,
                           MPI_Comm comm);
 
 /* one-sided (active target: ompi/mpi/c/win_create.c:44 surface) */
-typedef long long MPI_Aint;
-typedef int MPI_Win;
 #define MPI_WIN_NULL (-1)
 #define MPI_ERR_WIN 45
 #define MPI_LOCK_EXCLUSIVE 1
