@@ -1,0 +1,208 @@
+/* dtype2_c.c — round-5 datatype tier-2 acceptance: hvector, hindexed,
+ * struct, resized, subarray, darray, dup, true extent, envelope/
+ * contents, deprecated MPI-1 forms.  Every constructor is exercised
+ * over the wire (0 -> 1 exchange) so the typemaps are proven by
+ * delivery, not just by extent queries.  Reference shapes:
+ * ompi/mpi/c/{type_create_hvector,type_create_struct,
+ * type_create_resized,type_create_subarray,type_create_darray,
+ * type_dup,type_get_envelope}.c.  Run with >= 2 ranks. */
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include "zompi_mpi.h"
+
+#define CHECK(cond)                                                    \
+  do {                                                                 \
+    if (!(cond)) {                                                     \
+      fprintf(stderr, "FAIL %s:%d: %s\n", __FILE__, __LINE__, #cond);  \
+      MPI_Abort(MPI_COMM_WORLD, 2);                                    \
+    }                                                                  \
+  } while (0)
+
+struct particle {
+  double pos[3];
+  int id;
+  char tag;
+  /* trailing padding makes sizeof > packed size */
+};
+
+int main(int argc, char **argv) {
+  CHECK(MPI_Init(&argc, &argv) == MPI_SUCCESS);
+  int rank, size;
+  MPI_Comm_rank(MPI_COMM_WORLD, &rank);
+  MPI_Comm_size(MPI_COMM_WORLD, &size);
+  CHECK(size >= 2);
+
+  /* ---- struct: the heterogeneous constructor ---- */
+  MPI_Datatype ptype;
+  {
+    int bl[3] = {3, 1, 1};
+    MPI_Aint disp[3];
+    struct particle probe;
+    MPI_Aint base, a;
+    MPI_Get_address(&probe, &base);
+    MPI_Get_address(&probe.pos[0], &a);
+    disp[0] = a - base;
+    MPI_Get_address(&probe.id, &a);
+    disp[1] = a - base;
+    MPI_Get_address(&probe.tag, &a);
+    disp[2] = a - base;
+    MPI_Datatype types[3] = {MPI_DOUBLE, MPI_INT, MPI_CHAR};
+    CHECK(MPI_Type_create_struct(3, bl, disp, types, &ptype) ==
+          MPI_SUCCESS);
+    /* resize to sizeof so arrays of particles stride correctly */
+    MPI_Datatype raw = ptype;
+    CHECK(MPI_Type_create_resized(raw, 0, sizeof(struct particle),
+                                  &ptype) == MPI_SUCCESS);
+    MPI_Type_free(&raw);
+    CHECK(MPI_Type_commit(&ptype) == MPI_SUCCESS);
+    long lb = -1, ext = -1;
+    CHECK(MPI_Type_get_extent(ptype, &lb, &ext) == MPI_SUCCESS);
+    CHECK(lb == 0 && ext == (long)sizeof(struct particle));
+    int tsz = -1;
+    CHECK(MPI_Type_size(ptype, &tsz) == MPI_SUCCESS);
+    CHECK(tsz == 3 * 8 + 4 + 1); /* packed payload only */
+  }
+  if (rank == 0) {
+    struct particle ps[4];
+    memset(ps, 0, sizeof ps);
+    for (int i = 0; i < 4; i++) {
+      ps[i].pos[0] = i + 0.5;
+      ps[i].pos[1] = i + 0.25;
+      ps[i].pos[2] = i + 0.125;
+      ps[i].id = 100 + i;
+      ps[i].tag = (char)('a' + i);
+    }
+    CHECK(MPI_Send(ps, 4, ptype, 1, 1, MPI_COMM_WORLD) == MPI_SUCCESS);
+  } else if (rank == 1) {
+    struct particle ps[4];
+    memset(ps, 0x77, sizeof ps);
+    MPI_Status st;
+    CHECK(MPI_Recv(ps, 4, ptype, 0, 1, MPI_COMM_WORLD, &st) ==
+          MPI_SUCCESS);
+    int cnt = -1;
+    CHECK(MPI_Get_count(&st, ptype, &cnt) == MPI_SUCCESS && cnt == 4);
+    for (int i = 0; i < 4; i++) {
+      CHECK(ps[i].pos[0] == i + 0.5 && ps[i].pos[2] == i + 0.125);
+      CHECK(ps[i].id == 100 + i && ps[i].tag == (char)('a' + i));
+    }
+  }
+
+  /* ---- envelope/contents on the struct's resized wrapper ---- */
+  {
+    int ni = -1, na = -1, nd = -1, comb = -1;
+    CHECK(MPI_Type_get_envelope(ptype, &ni, &na, &nd, &comb) ==
+          MPI_SUCCESS);
+    CHECK(comb == MPI_COMBINER_RESIZED && ni == 0 && na == 2 && nd == 1);
+    MPI_Aint aints[2];
+    MPI_Datatype dts[1];
+    CHECK(MPI_Type_get_contents(ptype, 0, 2, 1, NULL, aints, dts) ==
+          MPI_SUCCESS);
+    CHECK(aints[0] == 0 && aints[1] == (MPI_Aint)sizeof(struct particle));
+  }
+
+  /* ---- hvector: byte-strided columns ---- */
+  {
+    MPI_Datatype col;
+    /* 3 doubles strided 32 bytes apart (a column of a 4-double row) */
+    CHECK(MPI_Type_create_hvector(3, 1, 32, MPI_DOUBLE, &col) ==
+          MPI_SUCCESS);
+    CHECK(MPI_Type_commit(&col) == MPI_SUCCESS);
+    MPI_Aint tlb = -1, text = -1;
+    CHECK(MPI_Type_get_true_extent(col, &tlb, &text) == MPI_SUCCESS);
+    CHECK(tlb == 0 && text == 2 * 32 + 8);
+    if (rank == 0) {
+      double m[12];
+      for (int i = 0; i < 12; i++) m[i] = i;
+      CHECK(MPI_Send(m, 1, col, 1, 2, MPI_COMM_WORLD) == MPI_SUCCESS);
+    } else if (rank == 1) {
+      double m[12];
+      for (int i = 0; i < 12; i++) m[i] = -1;
+      CHECK(MPI_Recv(m, 1, col, 0, 2, MPI_COMM_WORLD,
+                     MPI_STATUS_IGNORE) == MPI_SUCCESS);
+      CHECK(m[0] == 0 && m[4] == 4 && m[8] == 8);
+      CHECK(m[1] == -1 && m[5] == -1); /* gaps untouched */
+    }
+    MPI_Type_free(&col);
+  }
+
+  /* ---- subarray: interior 2x2 of a 4x4, C order ---- */
+  {
+    int sizes[2] = {4, 4}, subs[2] = {2, 2}, starts[2] = {1, 1};
+    MPI_Datatype sub;
+    CHECK(MPI_Type_create_subarray(2, sizes, subs, starts, MPI_ORDER_C,
+                                   MPI_INT, &sub) == MPI_SUCCESS);
+    CHECK(MPI_Type_commit(&sub) == MPI_SUCCESS);
+    long lb = -1, ext = -1;
+    CHECK(MPI_Type_get_extent(sub, &lb, &ext) == MPI_SUCCESS);
+    CHECK(lb == 0 && ext == 16 * 4); /* full array extent */
+    if (rank == 0) {
+      int m[16];
+      for (int i = 0; i < 16; i++) m[i] = i;
+      CHECK(MPI_Send(m, 1, sub, 1, 3, MPI_COMM_WORLD) == MPI_SUCCESS);
+    } else if (rank == 1) {
+      int m[16];
+      for (int i = 0; i < 16; i++) m[i] = -1;
+      CHECK(MPI_Recv(m, 1, sub, 0, 3, MPI_COMM_WORLD,
+                     MPI_STATUS_IGNORE) == MPI_SUCCESS);
+      CHECK(m[5] == 5 && m[6] == 6 && m[9] == 9 && m[10] == 10);
+      CHECK(m[0] == -1 && m[15] == -1);
+    }
+    MPI_Type_free(&sub);
+  }
+
+  /* ---- darray: 1-D block over 2 procs, then cyclic(1) ---- */
+  if (rank < 2) {
+    int gs[1] = {8}, dist[1] = {MPI_DISTRIBUTE_BLOCK};
+    int darg[1] = {MPI_DISTRIBUTE_DFLT_DARG}, ps[1] = {2};
+    MPI_Datatype da;
+    CHECK(MPI_Type_create_darray(2, rank, 1, gs, dist, darg, ps,
+                                 MPI_ORDER_C, MPI_INT, &da) ==
+          MPI_SUCCESS);
+    MPI_Aint tlb = -1, text = -1;
+    CHECK(MPI_Type_get_true_extent(da, &tlb, &text) == MPI_SUCCESS);
+    CHECK(tlb == (rank == 0 ? 0 : 16) && text == 16); /* 4 ints each */
+    MPI_Type_free(&da);
+
+    dist[0] = MPI_DISTRIBUTE_CYCLIC;
+    CHECK(MPI_Type_create_darray(2, rank, 1, gs, dist, darg, ps,
+                                 MPI_ORDER_C, MPI_INT, &da) ==
+          MPI_SUCCESS);
+    int tsz = -1;
+    CHECK(MPI_Type_size(da, &tsz) == MPI_SUCCESS && tsz == 16);
+    MPI_Aint tlb2 = -1;
+    CHECK(MPI_Type_get_true_extent(da, &tlb2, &text) == MPI_SUCCESS);
+    CHECK(tlb2 == (rank == 0 ? 0 : 4)); /* first owned element */
+    MPI_Type_free(&da);
+  }
+
+  /* ---- dup + deprecated forms ---- */
+  {
+    MPI_Datatype d2;
+    CHECK(MPI_Type_dup(ptype, &d2) == MPI_SUCCESS);
+    int ni, na, nd, comb;
+    CHECK(MPI_Type_get_envelope(d2, &ni, &na, &nd, &comb) ==
+          MPI_SUCCESS && comb == MPI_COMBINER_DUP);
+    int s1 = -1, s2 = -1;
+    CHECK(MPI_Type_size(ptype, &s1) == MPI_SUCCESS);
+    CHECK(MPI_Type_size(d2, &s2) == MPI_SUCCESS && s1 == s2);
+    MPI_Type_free(&d2);
+
+    MPI_Aint ub = -1;
+    CHECK(MPI_Type_ub(ptype, &ub) == MPI_SUCCESS);
+    CHECK(ub == (MPI_Aint)sizeof(struct particle));
+    MPI_Aint disp2[2] = {8, 0};
+    int bl2[2] = {1, 1};
+    MPI_Datatype types2[2] = {MPI_INT, MPI_INT}, legacy;
+    CHECK(MPI_Type_struct(2, bl2, disp2, types2, &legacy) ==
+          MPI_SUCCESS);
+    CHECK(MPI_Type_commit(&legacy) == MPI_SUCCESS);
+    MPI_Type_free(&legacy);
+  }
+  MPI_Type_free(&ptype);
+
+  MPI_Barrier(MPI_COMM_WORLD);
+  if (rank == 0) printf("dtype2_c OK on %d ranks\n", size);
+  MPI_Finalize();
+  return 0;
+}

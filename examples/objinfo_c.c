@@ -1,0 +1,162 @@
+/* objinfo_c.c — round-5 object-tier acceptance: MPI_Info dictionaries,
+ * object naming, comm/win/file info, Comm_split_type(SHARED),
+ * Comm_create_group, Comm_dup_with_info, Comm_idup.  Reference shapes:
+ * ompi/mpi/c/{info_create,info_set,comm_set_name,comm_split_type,
+ * comm_create_group,comm_idup,win_set_name,file_get_amode}.c.
+ * Run with >= 2 ranks. */
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include "zompi_mpi.h"
+
+#define CHECK(cond)                                                    \
+  do {                                                                 \
+    if (!(cond)) {                                                     \
+      fprintf(stderr, "FAIL %s:%d: %s\n", __FILE__, __LINE__, #cond);  \
+      MPI_Abort(MPI_COMM_WORLD, 2);                                    \
+    }                                                                  \
+  } while (0)
+
+int main(int argc, char **argv) {
+  CHECK(MPI_Init(&argc, &argv) == MPI_SUCCESS);
+  int rank, size;
+  MPI_Comm_rank(MPI_COMM_WORLD, &rank);
+  MPI_Comm_size(MPI_COMM_WORLD, &size);
+  CHECK(size >= 2);
+
+  /* ---- info dictionaries ---- */
+  MPI_Info info;
+  CHECK(MPI_Info_create(&info) == MPI_SUCCESS);
+  CHECK(MPI_Info_set(info, "cb_nodes", "4") == MPI_SUCCESS);
+  CHECK(MPI_Info_set(info, "striping_unit", "1048576") == MPI_SUCCESS);
+  CHECK(MPI_Info_set(info, "cb_nodes", "8") == MPI_SUCCESS); /* update */
+  int nkeys = -1, flag = -1, vlen = -1;
+  CHECK(MPI_Info_get_nkeys(info, &nkeys) == MPI_SUCCESS && nkeys == 2);
+  char key[MPI_MAX_INFO_KEY + 1], val[MPI_MAX_INFO_VAL + 1];
+  CHECK(MPI_Info_get_nthkey(info, 0, key) == MPI_SUCCESS);
+  CHECK(strcmp(key, "cb_nodes") == 0); /* declaration order kept */
+  CHECK(MPI_Info_get(info, "cb_nodes", MPI_MAX_INFO_VAL, val, &flag) ==
+        MPI_SUCCESS && flag == 1 && strcmp(val, "8") == 0);
+  CHECK(MPI_Info_get_valuelen(info, "striping_unit", &vlen, &flag) ==
+        MPI_SUCCESS && flag == 1 && vlen == 7);
+  CHECK(MPI_Info_get(info, "absent", MPI_MAX_INFO_VAL, val, &flag) ==
+        MPI_SUCCESS && flag == 0);
+  /* truncation to valuelen */
+  CHECK(MPI_Info_get(info, "striping_unit", 3, val, &flag) ==
+        MPI_SUCCESS && flag == 1 && strcmp(val, "104") == 0);
+  MPI_Info dup;
+  CHECK(MPI_Info_dup(info, &dup) == MPI_SUCCESS);
+  CHECK(MPI_Info_delete(dup, "cb_nodes") == MPI_SUCCESS);
+  CHECK(MPI_Info_delete(dup, "cb_nodes") == MPI_ERR_INFO_NOKEY);
+  CHECK(MPI_Info_get_nkeys(info, &nkeys) == MPI_SUCCESS && nkeys == 2);
+  CHECK(MPI_Info_get_nkeys(dup, &nkeys) == MPI_SUCCESS && nkeys == 1);
+
+  /* ---- naming ---- */
+  char name[MPI_MAX_OBJECT_NAME];
+  int rlen = -1;
+  CHECK(MPI_Comm_get_name(MPI_COMM_WORLD, name, &rlen) == MPI_SUCCESS);
+  CHECK(strcmp(name, "MPI_COMM_WORLD") == 0);
+  CHECK(MPI_Comm_set_name(MPI_COMM_WORLD, "universe") == MPI_SUCCESS);
+  CHECK(MPI_Comm_get_name(MPI_COMM_WORLD, name, &rlen) == MPI_SUCCESS);
+  CHECK(strcmp(name, "universe") == 0 && rlen == 8);
+  CHECK(MPI_Type_get_name(MPI_DOUBLE, name, &rlen) == MPI_SUCCESS);
+  CHECK(strcmp(name, "MPI_DOUBLE") == 0);
+  MPI_Datatype pair_t;
+  CHECK(MPI_Type_contiguous(2, MPI_DOUBLE, &pair_t) == MPI_SUCCESS);
+  CHECK(MPI_Type_set_name(pair_t, "pair") == MPI_SUCCESS);
+  CHECK(MPI_Type_get_name(pair_t, name, &rlen) == MPI_SUCCESS);
+  CHECK(strcmp(name, "pair") == 0);
+  MPI_Type_free(&pair_t);
+
+  /* ---- comm info ---- */
+  CHECK(MPI_Comm_set_info(MPI_COMM_WORLD, info) == MPI_SUCCESS);
+  MPI_Info used;
+  CHECK(MPI_Comm_get_info(MPI_COMM_WORLD, &used) == MPI_SUCCESS);
+  CHECK(MPI_Info_get(used, "cb_nodes", MPI_MAX_INFO_VAL, val, &flag) ==
+        MPI_SUCCESS && flag == 1 && strcmp(val, "8") == 0);
+  /* the snapshot is deep: mutating the source later changes nothing */
+  CHECK(MPI_Info_set(info, "cb_nodes", "64") == MPI_SUCCESS);
+  MPI_Info used2;
+  CHECK(MPI_Comm_get_info(MPI_COMM_WORLD, &used2) == MPI_SUCCESS);
+  CHECK(MPI_Info_get(used2, "cb_nodes", MPI_MAX_INFO_VAL, val, &flag) ==
+        MPI_SUCCESS && flag == 1 && strcmp(val, "8") == 0);
+  MPI_Info_free(&used);
+  MPI_Info_free(&used2);
+
+  /* ---- split_type: every rank here shares one host ---- */
+  MPI_Comm shared;
+  CHECK(MPI_Comm_split_type(MPI_COMM_WORLD, MPI_COMM_TYPE_SHARED, 0,
+                            MPI_INFO_NULL, &shared) == MPI_SUCCESS);
+  int ssz = -1;
+  CHECK(MPI_Comm_size(shared, &ssz) == MPI_SUCCESS && ssz == size);
+  int sum = -1, one = 1;
+  CHECK(MPI_Allreduce(&one, &sum, 1, MPI_INT, MPI_SUM, shared) ==
+        MPI_SUCCESS && sum == size);
+  MPI_Comm_free(&shared);
+
+  /* mixed participation: the last rank opts out with MPI_UNDEFINED —
+   * still collective, must not deadlock (MPI-3.1 6.4.2) */
+  MPI_Comm part;
+  int my_type =
+      rank == size - 1 ? MPI_UNDEFINED : MPI_COMM_TYPE_SHARED;
+  CHECK(MPI_Comm_split_type(MPI_COMM_WORLD, my_type, 0, MPI_INFO_NULL,
+                            &part) == MPI_SUCCESS);
+  if (rank == size - 1) {
+    CHECK(part == MPI_COMM_NULL);
+  } else {
+    int psz = -1;
+    CHECK(MPI_Comm_size(part, &psz) == MPI_SUCCESS && psz == size - 1);
+    MPI_Comm_free(&part);
+  }
+
+  /* ---- create_group over the even ranks (collective over the group
+   * ONLY — odd ranks never enter) ---- */
+  MPI_Group wgrp, evens;
+  CHECK(MPI_Comm_group(MPI_COMM_WORLD, &wgrp) == MPI_SUCCESS);
+  int nev = (size + 1) / 2;
+  int evranks[64];
+  for (int i = 0; i < nev; i++) evranks[i] = 2 * i;
+  CHECK(MPI_Group_incl(wgrp, nev, evranks, &evens) == MPI_SUCCESS);
+  if (rank % 2 == 0) {
+    MPI_Comm ec;
+    CHECK(MPI_Comm_create_group(MPI_COMM_WORLD, evens, 17, &ec) ==
+          MPI_SUCCESS);
+    CHECK(ec != MPI_COMM_NULL);
+    int esz = -1, erk = -1;
+    CHECK(MPI_Comm_size(ec, &esz) == MPI_SUCCESS && esz == nev);
+    CHECK(MPI_Comm_rank(ec, &erk) == MPI_SUCCESS && erk == rank / 2);
+    int esum = -1;
+    one = 1;
+    CHECK(MPI_Allreduce(&one, &esum, 1, MPI_INT, MPI_SUM, ec) ==
+          MPI_SUCCESS && esum == nev);
+    MPI_Comm_free(&ec);
+  }
+  MPI_Group_free(&evens);
+  MPI_Group_free(&wgrp);
+
+  /* ---- dup_with_info and idup ---- */
+  MPI_Comm dwi;
+  CHECK(MPI_Comm_dup_with_info(MPI_COMM_WORLD, info, &dwi) ==
+        MPI_SUCCESS);
+  CHECK(MPI_Comm_get_info(dwi, &used) == MPI_SUCCESS);
+  CHECK(MPI_Info_get(used, "cb_nodes", MPI_MAX_INFO_VAL, val, &flag) ==
+        MPI_SUCCESS && flag == 1 && strcmp(val, "64") == 0);
+  MPI_Info_free(&used);
+  MPI_Comm idup_c;
+  MPI_Request idup_r;
+  CHECK(MPI_Comm_idup(MPI_COMM_WORLD, &idup_c, &idup_r) == MPI_SUCCESS);
+  CHECK(MPI_Wait(&idup_r, MPI_STATUS_IGNORE) == MPI_SUCCESS);
+  int bsum = -1;
+  one = 1;
+  CHECK(MPI_Allreduce(&one, &bsum, 1, MPI_INT, MPI_SUM, idup_c) ==
+        MPI_SUCCESS && bsum == size);
+  MPI_Comm_free(&idup_c);
+  MPI_Comm_free(&dwi);
+  MPI_Info_free(&dup);
+  MPI_Info_free(&info);
+
+  MPI_Barrier(MPI_COMM_WORLD);
+  if (rank == 0) printf("objinfo_c OK on %d ranks\n", size);
+  MPI_Finalize();
+  return 0;
+}

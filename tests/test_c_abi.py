@@ -60,6 +60,26 @@ def _compile_c(shim, src, binpath):
         check=True, capture_output=True, text=True,
     )
 
+def _run_example(shim, tmp_path_factory, src_name, n, timeout=60):
+    """Compile an examples/ C source against the shim and run it as n
+    real processes; returns per-rank stdout (asserts every rank exits
+    0).  The one launch recipe every acceptance test shares."""
+    bin_ = _compile_example(shim, tmp_path_factory, src_name)
+    port = _free_port()
+    procs = [
+        subprocess.Popen([bin_], env=_env(r, n, port),
+                         stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                         text=True)
+        for r in range(n)
+    ]
+    outs = []
+    for r, p in enumerate(procs):
+        out, err = p.communicate(timeout=timeout)
+        assert p.returncode == 0, f"rank {r} failed: {err}\n{out}"
+        outs.append(out)
+    return outs
+
+
 def _free_port():
     s = socket.socket()
     s.bind(("127.0.0.1", 0))
@@ -103,20 +123,26 @@ class TestPureC:
         Alloc_mem, Reduce_local, Request_get_status, Waitsome, Cancel,
         Get_elements, Sendrecv_replace, c2f/f2c (self-checking C
         program; every CHECK aborts on failure)."""
-        util_bin = _compile_example(shim, tmp_path_factory, "util_c.c")
-        port = _free_port()
-        procs = [
-            subprocess.Popen([util_bin], env=_env(r, n, port),
-                             stdout=subprocess.PIPE, stderr=subprocess.PIPE,
-                             text=True)
-            for r in range(n)
-        ]
-        outs = []
-        for r, p in enumerate(procs):
-            out, err = p.communicate(timeout=60)
-            assert p.returncode == 0, f"rank {r} failed: {err}\n{out}"
-            outs.append(out)
+        outs = _run_example(shim, tmp_path_factory, "util_c.c", n)
         assert f"util_c OK on {n} ranks" in outs[0]
+
+    @pytest.mark.parametrize("n", [2, 4])
+    def test_objinfo_example(self, shim, tmp_path_factory, n):
+        """Round-5 object tier: Info dictionaries, object naming,
+        comm/win/file info snapshots, Comm_split_type(SHARED),
+        Comm_create_group over a strict subset (odd ranks never call),
+        Comm_dup_with_info, Comm_idup."""
+        outs = _run_example(shim, tmp_path_factory, "objinfo_c.c", n)
+        assert f"objinfo_c OK on {n} ranks" in outs[0]
+
+    @pytest.mark.parametrize("n", [2, 3])
+    def test_dtype2_example(self, shim, tmp_path_factory, n):
+        """Round-5 datatype tier 2: struct/resized over the wire (a C
+        struct with padding round-trips), hvector columns, subarray
+        interior block, darray block+cyclic typemaps, dup, true extent,
+        envelope/contents, deprecated MPI-1 forms."""
+        outs = _run_example(shim, tmp_path_factory, "dtype2_c.c", n)
+        assert f"dtype2_c OK on {n} ranks" in outs[0]
 
 
 class TestInterop:

@@ -334,6 +334,11 @@ struct DtypeObj {
   int64_t lb = 0;       // lower bound (min displacement), in base elems
   int64_t elems = 0;    // base elems per one item (sum of block n)
   bool committed = false;
+  // constructor envelope (type_get_envelope.c / type_get_contents.c)
+  int combiner = 0;  // MPI_COMBINER_NAMED until a constructor stamps it
+  std::vector<int> env_ints;
+  std::vector<long long> env_aints;
+  std::vector<int> env_types;
 };
 
 constexpr MPI_Datatype DERIVED_BASE = 0x40;
@@ -2423,6 +2428,8 @@ int MPI_Finalize(void) {
   g_comms.clear();
   g_dtypes.clear();
   g_next_dtype = DERIVED_BASE;
+  extern void clear_info_naming_state(void);
+  clear_info_naming_state();
   g.initialized = false;
   g_finalized_flag = true;
   return MPI_SUCCESS;
@@ -3430,6 +3437,9 @@ int MPI_Type_contiguous(int count, MPI_Datatype oldtype,
   coalesce_blocks(d.blocks);
   d.extent = count * old_extent;
   d.elems = count * v.elems_per_item();
+  d.combiner = MPI_COMBINER_CONTIGUOUS;
+  d.env_ints = {count};
+  d.env_types = {oldtype};
   MPI_Datatype handle = g_next_dtype++;
   g_dtypes[handle] = d;
   *newtype = handle;
@@ -3463,6 +3473,9 @@ int MPI_Type_vector(int count, int blocklength, int stride,
   coalesce_blocks(d.blocks);
   d.extent = max_off;
   d.elems = (int64_t)count * blocklength * v.elems_per_item();
+  d.combiner = MPI_COMBINER_VECTOR;
+  d.env_ints = {count, blocklength, stride};
+  d.env_types = {oldtype};
   MPI_Datatype handle = g_next_dtype++;
   g_dtypes[handle] = d;
   *newtype = handle;
@@ -3517,6 +3530,12 @@ int MPI_Type_indexed(int count, const int blocklengths[],
   d.lb = min_off;
   d.extent = max_off - min_off;
   d.elems = total * v.elems_per_item();
+  d.combiner = MPI_COMBINER_INDEXED;
+  d.env_ints.push_back(count);
+  for (int c2 = 0; c2 < count; c2++) d.env_ints.push_back(blocklengths[c2]);
+  for (int c2 = 0; c2 < count; c2++)
+    d.env_ints.push_back(displacements[c2]);
+  d.env_types = {oldtype};
   MPI_Datatype handle = g_next_dtype++;
   g_dtypes[handle] = d;
   *newtype = handle;
@@ -3529,8 +3548,16 @@ int MPI_Type_create_indexed_block(int count, int blocklength,
                                   MPI_Datatype *newtype) {
   if (count < 0 || blocklength < 0) return MPI_ERR_ARG;
   std::vector<int> lens((size_t)count, blocklength);
-  return MPI_Type_indexed(count, lens.data(), displacements, oldtype,
-                          newtype);
+  int rc = MPI_Type_indexed(count, lens.data(), displacements, oldtype,
+                            newtype);
+  if (rc != MPI_SUCCESS) return rc;
+  DtypeObj &d = g_dtypes[*newtype];
+  d.combiner = MPI_COMBINER_INDEXED_BLOCK;
+  d.env_ints.assign({count, blocklength});
+  for (int c2 = 0; c2 < count; c2++)
+    d.env_ints.push_back(displacements[c2]);
+  d.env_types = {oldtype};
+  return MPI_SUCCESS;
 }
 
 int MPI_Type_commit(MPI_Datatype *datatype) {
@@ -3562,6 +3589,551 @@ int MPI_Type_size(MPI_Datatype datatype, int *size) {
   }
   if (!resolve_dtype(datatype, v)) return MPI_ERR_TYPE;
   *size = (int)v.di.item;
+  return MPI_SUCCESS;
+}
+
+// ------------------------------------------- datatype tier 2 (round 5)
+// Byte-displacement constructors (type_create_hvector.c,
+// type_create_struct.c, ...) flatten to BYTE typemaps: displacements
+// need not be multiples of the base item, so the byte unit is the one
+// common denominator.  The cluster is homogeneous (same reduction the
+// convertor's external32 path documents), so no per-element identity
+// is lost on the wire.
+
+namespace {
+
+// resolve a type for CONSTRUCTION (committed not required, unlike the
+// communication-path resolve_dtype)
+bool resolve_for_build(MPI_Datatype dt, DtView &v) {
+  if (dt < DERIVED_BASE) return base_dtinfo(dt, v.di);
+  auto it = g_dtypes.find(dt);
+  if (it == g_dtypes.end()) return false;
+  v.derived = &it->second;
+  return base_dtinfo(it->second.base, v.di);
+}
+
+// one item of `v` as BYTE blocks appended at byte offset `at`
+void append_item_bytes(std::vector<std::pair<int64_t, int64_t>> &blocks,
+                       const DtView &v, int64_t at) {
+  int64_t item = (int64_t)v.di.item;
+  if (!v.derived) {
+    blocks.push_back({at, item});
+    return;
+  }
+  for (auto &b : v.derived->blocks)
+    blocks.push_back({at + b.first * item, b.second * item});
+}
+
+// extent/lb of one item in BYTES
+int64_t extent_bytes_of(const DtView &v) {
+  return (v.derived ? v.derived->extent : 1) * (int64_t)v.di.item;
+}
+int64_t lb_bytes_of(const DtView &v) {
+  return (v.derived ? v.derived->lb : 0) * (int64_t)v.di.item;
+}
+
+// finalize a byte-based DtypeObj: elems = total bytes, base = BYTE
+void seal_byte_type(DtypeObj &d) {
+  coalesce_blocks(d.blocks);
+  d.base = MPI_BYTE;
+  int64_t total = 0;
+  for (auto &b : d.blocks) total += b.second;
+  d.elems = total;
+}
+
+int register_dtype(DtypeObj d, MPI_Datatype *newtype) {
+  MPI_Datatype handle = g_next_dtype++;
+  g_dtypes[handle] = std::move(d);
+  *newtype = handle;
+  return MPI_SUCCESS;
+}
+
+}  // namespace
+
+int MPI_Type_dup(MPI_Datatype oldtype, MPI_Datatype *newtype) {
+  if (oldtype < DERIVED_BASE) {
+    DtInfo di;
+    if (!base_dtinfo(oldtype, di)) return MPI_ERR_TYPE;
+    DtypeObj d;
+    d.base = oldtype;
+    d.blocks = {{0, 1}};
+    d.extent = 1;
+    d.elems = 1;
+    d.combiner = MPI_COMBINER_DUP;
+    d.env_types = {oldtype};
+    return register_dtype(std::move(d), newtype);
+  }
+  auto it = g_dtypes.find(oldtype);
+  if (it == g_dtypes.end()) return MPI_ERR_TYPE;
+  DtypeObj d = it->second;
+  d.combiner = MPI_COMBINER_DUP;
+  d.env_ints.clear();
+  d.env_aints.clear();
+  d.env_types = {oldtype};
+  d.committed = it->second.committed;  // dup of committed is committed
+  return register_dtype(std::move(d), newtype);
+}
+
+int MPI_Type_create_resized(MPI_Datatype oldtype, MPI_Aint lb,
+                            MPI_Aint extent, MPI_Datatype *newtype) {
+  // type_create_resized.c: same typemap, caller-chosen lb/extent
+  // (bytes) — the packing stride changes, the data does not
+  DtView v;
+  if (!resolve_for_build(oldtype, v)) return MPI_ERR_TYPE;
+  DtypeObj d;
+  append_item_bytes(d.blocks, v, 0);
+  seal_byte_type(d);
+  d.lb = lb;
+  d.extent = extent;
+  d.combiner = MPI_COMBINER_RESIZED;
+  d.env_aints = {(long long)lb, (long long)extent};
+  d.env_types = {oldtype};
+  return register_dtype(std::move(d), newtype);
+}
+
+int MPI_Type_create_hvector(int count, int blocklength, MPI_Aint stride,
+                            MPI_Datatype oldtype, MPI_Datatype *newtype) {
+  // type_create_hvector.c: stride in BYTES
+  if (count < 0 || blocklength < 0) return MPI_ERR_ARG;
+  DtView v;
+  if (!resolve_for_build(oldtype, v)) return MPI_ERR_TYPE;
+  int64_t oext = extent_bytes_of(v);
+  DtypeObj d;
+  int64_t max_ub = 0, min_lb = 0;
+  for (int c = 0; c < count; c++) {
+    int64_t base_off = (int64_t)c * stride;
+    for (int b = 0; b < blocklength; b++) {
+      int64_t off = base_off + (int64_t)b * oext;
+      if (off < 0) return MPI_ERR_ARG;
+      append_item_bytes(d.blocks, v, off);
+      int64_t ilb = off + lb_bytes_of(v);
+      if (ilb < min_lb) min_lb = ilb;
+      if (ilb + oext > max_ub) max_ub = ilb + oext;
+    }
+  }
+  seal_byte_type(d);
+  d.lb = min_lb;
+  d.extent = max_ub - min_lb;
+  d.combiner = MPI_COMBINER_HVECTOR;
+  d.env_ints = {count, blocklength};
+  d.env_aints = {(long long)stride};
+  d.env_types = {oldtype};
+  return register_dtype(std::move(d), newtype);
+}
+
+static int hindexed_impl(int count, const int blocklengths[],
+                         const MPI_Aint displacements[],
+                         MPI_Datatype oldtype, MPI_Datatype *newtype,
+                         int combiner) {
+  // type_create_hindexed.c: displacements in BYTES
+  if (count < 0) return MPI_ERR_ARG;
+  DtView v;
+  if (!resolve_for_build(oldtype, v)) return MPI_ERR_TYPE;
+  int64_t oext = extent_bytes_of(v);
+  DtypeObj d;
+  int64_t max_ub = INT64_MIN, min_lb = INT64_MAX;
+  int64_t total = 0;
+  for (int c = 0; c < count; c++) {
+    if (blocklengths[c] < 0) return MPI_ERR_ARG;
+    for (int b = 0; b < blocklengths[c]; b++) {
+      int64_t off = (int64_t)displacements[c] + (int64_t)b * oext;
+      if (off < 0) return MPI_ERR_ARG;
+      append_item_bytes(d.blocks, v, off);
+      int64_t ilb = off + lb_bytes_of(v);
+      if (ilb < min_lb) min_lb = ilb;
+      if (ilb + oext > max_ub) max_ub = ilb + oext;
+    }
+    total += blocklengths[c];
+  }
+  if (total == 0) { min_lb = 0; max_ub = 0; }
+  seal_byte_type(d);
+  d.lb = min_lb;
+  d.extent = max_ub - min_lb;
+  d.combiner = combiner;
+  d.env_ints.push_back(count);
+  if (combiner == MPI_COMBINER_HINDEXED_BLOCK) {
+    d.env_ints.push_back(count ? blocklengths[0] : 0);
+  } else {
+    for (int c = 0; c < count; c++) d.env_ints.push_back(blocklengths[c]);
+  }
+  for (int c = 0; c < count; c++)
+    d.env_aints.push_back((long long)displacements[c]);
+  d.env_types = {oldtype};
+  return register_dtype(std::move(d), newtype);
+}
+
+int MPI_Type_create_hindexed(int count, const int blocklengths[],
+                             const MPI_Aint displacements[],
+                             MPI_Datatype oldtype,
+                             MPI_Datatype *newtype) {
+  return hindexed_impl(count, blocklengths, displacements, oldtype,
+                       newtype, MPI_COMBINER_HINDEXED);
+}
+
+int MPI_Type_create_hindexed_block(int count, int blocklength,
+                                   const MPI_Aint displacements[],
+                                   MPI_Datatype oldtype,
+                                   MPI_Datatype *newtype) {
+  if (count < 0 || blocklength < 0) return MPI_ERR_ARG;
+  std::vector<int> lens((size_t)count, blocklength);
+  return hindexed_impl(count, lens.data(), displacements, oldtype,
+                       newtype, MPI_COMBINER_HINDEXED_BLOCK);
+}
+
+int MPI_Type_create_struct(int count, const int blocklengths[],
+                           const MPI_Aint displacements[],
+                           const MPI_Datatype types[],
+                           MPI_Datatype *newtype) {
+  // type_create_struct.c: heterogeneous fields — the one constructor
+  // that FORCES the byte flattening
+  if (count < 0) return MPI_ERR_ARG;
+  DtypeObj d;
+  int64_t max_ub = INT64_MIN, min_lb = INT64_MAX;
+  int64_t total = 0;
+  for (int c = 0; c < count; c++) {
+    if (blocklengths[c] < 0) return MPI_ERR_ARG;
+    DtView v;
+    if (!resolve_for_build(types[c], v)) return MPI_ERR_TYPE;
+    int64_t oext = extent_bytes_of(v);
+    for (int b = 0; b < blocklengths[c]; b++) {
+      int64_t off = (int64_t)displacements[c] + (int64_t)b * oext;
+      if (off < 0) return MPI_ERR_ARG;
+      append_item_bytes(d.blocks, v, off);
+      int64_t ilb = off + lb_bytes_of(v);
+      if (ilb < min_lb) min_lb = ilb;
+      if (ilb + oext > max_ub) max_ub = ilb + oext;
+    }
+    total += blocklengths[c];
+  }
+  if (total == 0) { min_lb = 0; max_ub = 0; }
+  // typemap stays in DECLARATION order (pack serializes field order)
+  seal_byte_type(d);
+  d.lb = min_lb;
+  d.extent = max_ub - min_lb;
+  d.combiner = MPI_COMBINER_STRUCT;
+  d.env_ints.push_back(count);
+  for (int c = 0; c < count; c++) d.env_ints.push_back(blocklengths[c]);
+  for (int c = 0; c < count; c++)
+    d.env_aints.push_back((long long)displacements[c]);
+  d.env_types.assign(types, types + count);
+  return register_dtype(std::move(d), newtype);
+}
+
+namespace {
+
+// shared emitter for subarray/darray: per-dimension index RUNS over a
+// full array of `sizes`, emitted as oldtype-unit blocks.  `order`
+// fixes which dimension is unit-stride (C: last, Fortran: first).
+void emit_runs(const std::vector<std::vector<std::pair<int, int>>> &runs,
+               const std::vector<int> &sizes, int order, const DtView &v,
+               DtypeObj &d) {
+  int nd = (int)sizes.size();
+  std::vector<int64_t> stride((size_t)nd);  // in oldtype units
+  int contig;
+  if (order == MPI_ORDER_C) {
+    contig = nd - 1;
+    stride[(size_t)nd - 1] = 1;
+    for (int i = nd - 2; i >= 0; i--)
+      stride[(size_t)i] = stride[(size_t)i + 1] * sizes[(size_t)i + 1];
+  } else {
+    contig = 0;
+    stride[0] = 1;
+    for (int i = 1; i < nd; i++)
+      stride[(size_t)i] = stride[(size_t)i - 1] * sizes[(size_t)i - 1];
+  }
+  int64_t oext = extent_bytes_of(v);
+  // odometer over every non-contiguous dimension's individual indices;
+  // the contiguous dimension emits whole runs
+  std::function<void(int, int64_t)> rec = [&](int dim, int64_t off) {
+    if (dim == nd) {
+      for (auto &r : runs[(size_t)contig]) {
+        int64_t at = (off + (int64_t)r.first * stride[(size_t)contig]) *
+                     oext;
+        for (int k = 0; k < r.second; k++)
+          append_item_bytes(d.blocks, v, at + (int64_t)k * oext);
+      }
+      return;
+    }
+    if (dim == contig) {
+      rec(dim + 1, off);
+      return;
+    }
+    for (auto &r : runs[(size_t)dim])
+      for (int k = 0; k < r.second; k++)
+        rec(dim + 1, off + ((int64_t)r.first + k) * stride[(size_t)dim]);
+  };
+  rec(0, 0);
+}
+
+}  // namespace
+
+int MPI_Type_create_subarray(int ndims, const int sizes[],
+                             const int subsizes[], const int starts[],
+                             int order, MPI_Datatype oldtype,
+                             MPI_Datatype *newtype) {
+  // type_create_subarray.c: extent spans the FULL array (lb 0), the
+  // typemap covers the subarray block
+  if (ndims <= 0) return MPI_ERR_ARG;
+  if (order != MPI_ORDER_C && order != MPI_ORDER_FORTRAN)
+    return MPI_ERR_ARG;
+  DtView v;
+  if (!resolve_for_build(oldtype, v)) return MPI_ERR_TYPE;
+  std::vector<std::vector<std::pair<int, int>>> runs((size_t)ndims);
+  int64_t full = 1;
+  for (int i = 0; i < ndims; i++) {
+    if (sizes[i] <= 0 || subsizes[i] < 0 || starts[i] < 0 ||
+        starts[i] + subsizes[i] > sizes[i])
+      return MPI_ERR_ARG;
+    if (subsizes[i] > 0) runs[(size_t)i] = {{starts[i], subsizes[i]}};
+    full *= sizes[i];
+  }
+  DtypeObj d;
+  emit_runs(runs, std::vector<int>(sizes, sizes + ndims), order, v, d);
+  seal_byte_type(d);
+  d.lb = 0;
+  d.extent = full * extent_bytes_of(v);
+  d.combiner = MPI_COMBINER_SUBARRAY;
+  d.env_ints.push_back(ndims);
+  for (int i = 0; i < ndims; i++) d.env_ints.push_back(sizes[i]);
+  for (int i = 0; i < ndims; i++) d.env_ints.push_back(subsizes[i]);
+  for (int i = 0; i < ndims; i++) d.env_ints.push_back(starts[i]);
+  d.env_ints.push_back(order);
+  d.env_types = {oldtype};
+  return register_dtype(std::move(d), newtype);
+}
+
+int MPI_Type_create_darray(int size, int rank, int ndims,
+                           const int gsizes[], const int distribs[],
+                           const int dargs[], const int psizes[],
+                           int order, MPI_Datatype oldtype,
+                           MPI_Datatype *newtype) {
+  // type_create_darray.c: HPF-style distributions.  The process grid
+  // is ALWAYS row-major over psizes (MPI-3.1 §4.1.4); `order` governs
+  // only the array storage order.
+  if (ndims <= 0 || size <= 0 || rank < 0 || rank >= size)
+    return MPI_ERR_ARG;
+  if (order != MPI_ORDER_C && order != MPI_ORDER_FORTRAN)
+    return MPI_ERR_ARG;
+  int64_t grid = 1;
+  for (int i = 0; i < ndims; i++) {
+    if (psizes[i] <= 0 || gsizes[i] <= 0) return MPI_ERR_ARG;
+    if (distribs[i] == MPI_DISTRIBUTE_NONE && psizes[i] != 1)
+      return MPI_ERR_ARG;
+    grid *= psizes[i];
+  }
+  if (grid != size) return MPI_ERR_ARG;
+  DtView v;
+  if (!resolve_for_build(oldtype, v)) return MPI_ERR_TYPE;
+  // my coordinates, row-major
+  std::vector<int> coord((size_t)ndims);
+  int rem = rank;
+  for (int i = ndims - 1; i >= 0; i--) {
+    coord[(size_t)i] = rem % psizes[i];
+    rem /= psizes[i];
+  }
+  std::vector<std::vector<std::pair<int, int>>> runs((size_t)ndims);
+  int64_t full = 1;
+  for (int i = 0; i < ndims; i++) {
+    full *= gsizes[i];
+    int n = gsizes[i], p = psizes[i], c = coord[(size_t)i];
+    switch (distribs[i]) {
+      case MPI_DISTRIBUTE_NONE:
+        runs[(size_t)i] = {{0, n}};
+        break;
+      case MPI_DISTRIBUTE_BLOCK: {
+        int b = dargs[i] == MPI_DISTRIBUTE_DFLT_DARG
+                    ? (n + p - 1) / p
+                    : dargs[i];
+        if (b <= 0 || (int64_t)b * p < n) return MPI_ERR_ARG;
+        int start = c * b;
+        int len = start < n ? (start + b > n ? n - start : b) : 0;
+        if (len > 0) runs[(size_t)i] = {{start, len}};
+        break;
+      }
+      case MPI_DISTRIBUTE_CYCLIC: {
+        int b = dargs[i] == MPI_DISTRIBUTE_DFLT_DARG ? 1 : dargs[i];
+        if (b <= 0) return MPI_ERR_ARG;
+        for (int64_t start = (int64_t)c * b; start < n;
+             start += (int64_t)p * b) {
+          int len = (int)(start + b > n ? n - start : b);
+          runs[(size_t)i].push_back({(int)start, len});
+        }
+        break;
+      }
+      default:
+        return MPI_ERR_ARG;
+    }
+  }
+  DtypeObj d;
+  emit_runs(runs, std::vector<int>(gsizes, gsizes + ndims), order, v, d);
+  seal_byte_type(d);
+  d.lb = 0;
+  d.extent = full * extent_bytes_of(v);
+  d.combiner = MPI_COMBINER_DARRAY;
+  d.env_ints.push_back(size);
+  d.env_ints.push_back(rank);
+  d.env_ints.push_back(ndims);
+  for (int i = 0; i < ndims; i++) d.env_ints.push_back(gsizes[i]);
+  for (int i = 0; i < ndims; i++) d.env_ints.push_back(distribs[i]);
+  for (int i = 0; i < ndims; i++) d.env_ints.push_back(dargs[i]);
+  for (int i = 0; i < ndims; i++) d.env_ints.push_back(psizes[i]);
+  d.env_ints.push_back(order);
+  d.env_types = {oldtype};
+  return register_dtype(std::move(d), newtype);
+}
+
+namespace {
+
+// true extent: the typemap's actual byte span, resized lb/ub ignored
+// (type_get_true_extent.c)
+int true_extent_impl(MPI_Datatype dt, int64_t &tlb, int64_t &text) {
+  if (dt < DERIVED_BASE) {
+    DtInfo di;
+    if (!base_dtinfo(dt, di)) return MPI_ERR_TYPE;
+    tlb = 0;
+    text = (int64_t)di.item;
+    return MPI_SUCCESS;
+  }
+  auto it = g_dtypes.find(dt);
+  if (it == g_dtypes.end()) return MPI_ERR_TYPE;
+  DtInfo di;
+  if (!base_dtinfo(it->second.base, di)) return MPI_ERR_TYPE;
+  if (it->second.blocks.empty()) {
+    tlb = 0;
+    text = 0;
+    return MPI_SUCCESS;
+  }
+  int64_t lo = INT64_MAX, hi = INT64_MIN;
+  for (auto &b : it->second.blocks) {
+    if (b.first < lo) lo = b.first;
+    if (b.first + b.second > hi) hi = b.first + b.second;
+  }
+  tlb = lo * (int64_t)di.item;
+  text = (hi - lo) * (int64_t)di.item;
+  return MPI_SUCCESS;
+}
+
+}  // namespace
+
+int MPI_Type_get_true_extent(MPI_Datatype dt, MPI_Aint *true_lb,
+                             MPI_Aint *true_extent) {
+  int64_t tlb, text;
+  int rc = true_extent_impl(dt, tlb, text);
+  if (rc != MPI_SUCCESS) return rc;
+  *true_lb = (MPI_Aint)tlb;
+  *true_extent = (MPI_Aint)text;
+  return MPI_SUCCESS;
+}
+
+int MPI_Type_get_true_extent_x(MPI_Datatype dt, MPI_Count *true_lb,
+                               MPI_Count *true_extent) {
+  int64_t tlb, text;
+  int rc = true_extent_impl(dt, tlb, text);
+  if (rc != MPI_SUCCESS) return rc;
+  *true_lb = (MPI_Count)tlb;
+  *true_extent = (MPI_Count)text;
+  return MPI_SUCCESS;
+}
+
+int MPI_Type_get_extent_x(MPI_Datatype dt, MPI_Count *lb,
+                          MPI_Count *extent) {
+  long l, e;
+  int rc = MPI_Type_get_extent(dt, &l, &e);
+  if (rc != MPI_SUCCESS) return rc;
+  *lb = (MPI_Count)l;
+  *extent = (MPI_Count)e;
+  return MPI_SUCCESS;
+}
+
+int MPI_Type_size_x(MPI_Datatype dt, MPI_Count *size) {
+  int s;
+  int rc = MPI_Type_size(dt, &s);
+  if (rc != MPI_SUCCESS) return rc;
+  *size = (MPI_Count)s;
+  return MPI_SUCCESS;
+}
+
+int MPI_Type_get_envelope(MPI_Datatype dt, int *num_integers,
+                          int *num_addresses, int *num_datatypes,
+                          int *combiner) {
+  if (dt < DERIVED_BASE) {
+    DtInfo di;
+    if (!base_dtinfo(dt, di)) return MPI_ERR_TYPE;
+    *num_integers = *num_addresses = *num_datatypes = 0;
+    *combiner = MPI_COMBINER_NAMED;
+    return MPI_SUCCESS;
+  }
+  auto it = g_dtypes.find(dt);
+  if (it == g_dtypes.end()) return MPI_ERR_TYPE;
+  *num_integers = (int)it->second.env_ints.size();
+  *num_addresses = (int)it->second.env_aints.size();
+  *num_datatypes = (int)it->second.env_types.size();
+  *combiner = it->second.combiner;
+  return MPI_SUCCESS;
+}
+
+int MPI_Type_get_contents(MPI_Datatype dt, int max_integers,
+                          int max_addresses, int max_datatypes,
+                          int integers[], MPI_Aint addresses[],
+                          MPI_Datatype datatypes[]) {
+  if (dt < DERIVED_BASE) return MPI_ERR_TYPE;  // NAMED has no contents
+  auto it = g_dtypes.find(dt);
+  if (it == g_dtypes.end()) return MPI_ERR_TYPE;
+  DtypeObj &d = it->second;
+  if (max_integers < (int)d.env_ints.size() ||
+      max_addresses < (int)d.env_aints.size() ||
+      max_datatypes < (int)d.env_types.size())
+    return MPI_ERR_ARG;
+  for (size_t i = 0; i < d.env_ints.size(); i++)
+    integers[i] = d.env_ints[i];
+  for (size_t i = 0; i < d.env_aints.size(); i++)
+    addresses[i] = (MPI_Aint)d.env_aints[i];
+  for (size_t i = 0; i < d.env_types.size(); i++)
+    datatypes[i] = d.env_types[i];
+  return MPI_SUCCESS;
+}
+
+int MPI_Type_hvector(int count, int blocklength, MPI_Aint stride,
+                     MPI_Datatype oldtype, MPI_Datatype *newtype) {
+  return MPI_Type_create_hvector(count, blocklength, stride, oldtype,
+                                 newtype);
+}
+
+int MPI_Type_hindexed(int count, int blocklengths[],
+                      MPI_Aint displacements[], MPI_Datatype oldtype,
+                      MPI_Datatype *newtype) {
+  return MPI_Type_create_hindexed(count, blocklengths, displacements,
+                                  oldtype, newtype);
+}
+
+int MPI_Type_struct(int count, int blocklengths[],
+                    MPI_Aint displacements[], MPI_Datatype types[],
+                    MPI_Datatype *newtype) {
+  return MPI_Type_create_struct(count, blocklengths, displacements,
+                                types, newtype);
+}
+
+int MPI_Type_extent(MPI_Datatype dt, MPI_Aint *extent) {
+  long lb, e;
+  int rc = MPI_Type_get_extent(dt, &lb, &e);
+  if (rc != MPI_SUCCESS) return rc;
+  *extent = (MPI_Aint)e;
+  return MPI_SUCCESS;
+}
+
+int MPI_Type_lb(MPI_Datatype dt, MPI_Aint *lb) {
+  long l, e;
+  int rc = MPI_Type_get_extent(dt, &l, &e);
+  if (rc != MPI_SUCCESS) return rc;
+  *lb = (MPI_Aint)l;
+  return MPI_SUCCESS;
+}
+
+int MPI_Type_ub(MPI_Datatype dt, MPI_Aint *ub) {
+  long l, e;
+  int rc = MPI_Type_get_extent(dt, &l, &e);
+  if (rc != MPI_SUCCESS) return rc;
+  *ub = (MPI_Aint)(l + e);
   return MPI_SUCCESS;
 }
 
@@ -3842,6 +4414,9 @@ int MPI_Error_string(int errorcode, char *string, int *resultlen) {
                                "exceeds the 4 GiB frame bound)"; break;
     case MPI_ERR_TRUNCATE: s = "MPI_ERR_TRUNCATE: message truncated";
                            break;
+    case MPI_ERR_IN_STATUS: s = "MPI_ERR_IN_STATUS: see the status "
+                                "array for per-request error codes";
+                            break;
     case MPI_ERR_OTHER:    s = "MPI_ERR_OTHER: known error not in list";
                            break;
     default:               s = "unknown error code"; break;
@@ -6436,14 +7011,22 @@ int harvest_some(int incount, MPI_Request requests[], int *outcount,
       if (it->second->complete) ready.push_back(i);
     }
   }
+  int first_err = MPI_SUCCESS;
   for (size_t k = 0; k < ready.size(); k++) {
     indices[k] = ready[k];
-    int rc = MPI_Wait(&requests[ready[k]],
-                      statuses ? &statuses[k] : MPI_STATUS_IGNORE);
-    if (rc != MPI_SUCCESS) return rc;
-    *outcount = (int)k + 1;
+    MPI_Status tmp;
+    MPI_Status *sp = statuses ? &statuses[k] : &tmp;
+    int rc = MPI_Wait(&requests[ready[k]], sp);
+    *outcount = (int)k + 1;  // the completion is REPORTED even on error
+    if (rc != MPI_SUCCESS) {
+      // waitsome.c contract: per-request failures surface as
+      // MPI_ERR_IN_STATUS with the code in statuses[k].MPI_ERROR; the
+      // harvest continues so no completed request is silently lost
+      sp->MPI_ERROR = rc;
+      if (first_err == MPI_SUCCESS) first_err = MPI_ERR_IN_STATUS;
+    }
   }
-  return MPI_SUCCESS;
+  return first_err;
 }
 
 }  // namespace
@@ -6620,6 +7203,387 @@ int MPI_Status_f2c(const MPI_Fint *f_status, MPI_Status *c_status) {
       (long long)f_status[3] | ((long long)f_status[4] << 31);
   c_status->_cancelled = f_status[5];
   return MPI_SUCCESS;
+}
+
+// ------------------------------------ info objects + naming (round 5)
+// info_create.c family: ordered string dictionaries (order matters for
+// get_nthkey); comm/win/file carry deep COPIES (set_info snapshots,
+// get_info returns a fresh dup the caller frees — MPI-3.1 §6.4.4).
+
+struct InfoObj {
+  std::vector<std::pair<std::string, std::string>> kv;
+  const std::string *find(const char *key) const {
+    for (auto &e : kv)
+      if (e.first == key) return &e.second;
+    return nullptr;
+  }
+};
+static std::map<int, InfoObj> g_infos;
+static int g_next_info = 1;  // 0 = MPI_INFO_NULL
+
+static InfoObj *lookup_info(MPI_Info h) {
+  auto it = g_infos.find(h);
+  return it == g_infos.end() ? nullptr : &it->second;
+}
+
+// object-info snapshots (comm/win handle -> copy); files carry theirs
+// in a side map too so FileObj's layout stays untouched
+static std::map<int, InfoObj> g_comm_info, g_win_info, g_file_info;
+// object names; comm defaults seeded lazily for WORLD/SELF
+static std::map<int, std::string> g_comm_names, g_type_names, g_win_names;
+
+int MPI_Info_create(MPI_Info *info) {
+  int h = g_next_info++;
+  g_infos[h] = InfoObj{};
+  *info = h;
+  return MPI_SUCCESS;
+}
+
+int MPI_Info_free(MPI_Info *info) {
+  if (!info || !g_infos.erase(*info)) return MPI_ERR_INFO;
+  *info = MPI_INFO_NULL;
+  return MPI_SUCCESS;
+}
+
+int MPI_Info_dup(MPI_Info info, MPI_Info *newinfo) {
+  InfoObj *o = lookup_info(info);
+  if (!o) return MPI_ERR_INFO;
+  int h = g_next_info++;
+  g_infos[h] = *o;
+  *newinfo = h;
+  return MPI_SUCCESS;
+}
+
+int MPI_Info_set(MPI_Info info, const char *key, const char *value) {
+  InfoObj *o = lookup_info(info);
+  if (!o) return MPI_ERR_INFO;
+  if (!key || !*key || strlen(key) > MPI_MAX_INFO_KEY)
+    return MPI_ERR_INFO_KEY;
+  if (!value || strlen(value) > MPI_MAX_INFO_VAL)
+    return MPI_ERR_INFO_VALUE;
+  for (auto &e : o->kv)
+    if (e.first == key) {
+      e.second = value;
+      return MPI_SUCCESS;
+    }
+  o->kv.push_back({key, value});
+  return MPI_SUCCESS;
+}
+
+int MPI_Info_delete(MPI_Info info, const char *key) {
+  InfoObj *o = lookup_info(info);
+  if (!o) return MPI_ERR_INFO;
+  for (auto it = o->kv.begin(); it != o->kv.end(); ++it)
+    if (it->first == key) {
+      o->kv.erase(it);
+      return MPI_SUCCESS;
+    }
+  return MPI_ERR_INFO_NOKEY;
+}
+
+int MPI_Info_get(MPI_Info info, const char *key, int valuelen,
+                 char *value, int *flag) {
+  InfoObj *o = lookup_info(info);
+  if (!o) return MPI_ERR_INFO;
+  const std::string *v = o->find(key);
+  *flag = v ? 1 : 0;
+  if (v) {
+    size_t n = (size_t)valuelen < v->size() ? (size_t)valuelen
+                                            : v->size();
+    memcpy(value, v->data(), n);
+    value[n] = '\0';
+  }
+  return MPI_SUCCESS;
+}
+
+int MPI_Info_get_nkeys(MPI_Info info, int *nkeys) {
+  InfoObj *o = lookup_info(info);
+  if (!o) return MPI_ERR_INFO;
+  *nkeys = (int)o->kv.size();
+  return MPI_SUCCESS;
+}
+
+int MPI_Info_get_nthkey(MPI_Info info, int n, char *key) {
+  InfoObj *o = lookup_info(info);
+  if (!o) return MPI_ERR_INFO;
+  if (n < 0 || n >= (int)o->kv.size()) return MPI_ERR_ARG;
+  snprintf(key, MPI_MAX_INFO_KEY + 1, "%s", o->kv[n].first.c_str());
+  return MPI_SUCCESS;
+}
+
+int MPI_Info_get_valuelen(MPI_Info info, const char *key, int *valuelen,
+                          int *flag) {
+  InfoObj *o = lookup_info(info);
+  if (!o) return MPI_ERR_INFO;
+  const std::string *v = o->find(key);
+  *flag = v ? 1 : 0;
+  if (v) *valuelen = (int)v->size();
+  return MPI_SUCCESS;
+}
+
+namespace {
+
+// set_info snapshots (an INFO_NULL set clears); get_info returns a
+// fresh handle the caller frees
+int object_set_info(std::map<int, InfoObj> &table, int handle,
+                    MPI_Info info) {
+  if (info == MPI_INFO_NULL) {
+    table.erase(handle);
+    return MPI_SUCCESS;
+  }
+  InfoObj *o = lookup_info(info);
+  if (!o) return MPI_ERR_INFO;
+  table[handle] = *o;
+  return MPI_SUCCESS;
+}
+
+int object_get_info(std::map<int, InfoObj> &table, int handle,
+                    MPI_Info *info_used) {
+  int h = g_next_info++;
+  auto it = table.find(handle);
+  g_infos[h] = it == table.end() ? InfoObj{} : it->second;
+  *info_used = h;
+  return MPI_SUCCESS;
+}
+
+int object_set_name(std::map<int, std::string> &table, int handle,
+                    const char *name) {
+  table[handle] = name ? name : "";
+  return MPI_SUCCESS;
+}
+
+int object_get_name(const std::map<int, std::string> &table, int handle,
+                    const std::string &fallback, char *name,
+                    int *resultlen) {
+  auto it = table.find(handle);
+  const std::string &s = it == table.end() ? fallback : it->second;
+  snprintf(name, MPI_MAX_OBJECT_NAME, "%s", s.c_str());
+  *resultlen = (int)strlen(name);
+  return MPI_SUCCESS;
+}
+
+const char *predefined_type_name(MPI_Datatype dt) {
+  switch (dt) {
+    case MPI_BYTE:           return "MPI_BYTE";
+    case MPI_INT:            return "MPI_INT";
+    case MPI_LONG:           return "MPI_LONG";
+    case MPI_FLOAT:          return "MPI_FLOAT";
+    case MPI_DOUBLE:         return "MPI_DOUBLE";
+    case MPI_CHAR:           return "MPI_CHAR";
+    case MPI_SIGNED_CHAR:    return "MPI_SIGNED_CHAR";
+    case MPI_SHORT:          return "MPI_SHORT";
+    case MPI_LONG_LONG:      return "MPI_LONG_LONG";
+    case MPI_UNSIGNED_CHAR:  return "MPI_UNSIGNED_CHAR";
+    case MPI_UNSIGNED_SHORT: return "MPI_UNSIGNED_SHORT";
+    case MPI_UNSIGNED:       return "MPI_UNSIGNED";
+    case MPI_UNSIGNED_LONG:  return "MPI_UNSIGNED_LONG";
+  }
+  return "";
+}
+
+}  // namespace
+
+int MPI_Comm_set_name(MPI_Comm comm, const char *name) {
+  if (!lookup_comm(comm)) return MPI_ERR_COMM;
+  return object_set_name(g_comm_names, comm, name);
+}
+
+int MPI_Comm_get_name(MPI_Comm comm, char *name, int *resultlen) {
+  if (!lookup_comm(comm)) return MPI_ERR_COMM;
+  std::string fallback;
+  if (comm == MPI_COMM_WORLD) fallback = "MPI_COMM_WORLD";
+  else if (comm == MPI_COMM_SELF) fallback = "MPI_COMM_SELF";
+  return object_get_name(g_comm_names, comm, fallback, name, resultlen);
+}
+
+int MPI_Type_set_name(MPI_Datatype dt, const char *name) {
+  if (dt >= DERIVED_BASE && !g_dtypes.count(dt)) return MPI_ERR_TYPE;
+  DtInfo di;
+  if (dt < DERIVED_BASE && !base_dtinfo(dt, di)) return MPI_ERR_TYPE;
+  return object_set_name(g_type_names, dt, name);
+}
+
+int MPI_Type_get_name(MPI_Datatype dt, char *name, int *resultlen) {
+  if (dt >= DERIVED_BASE && !g_dtypes.count(dt)) return MPI_ERR_TYPE;
+  DtInfo di;
+  if (dt < DERIVED_BASE && !base_dtinfo(dt, di)) return MPI_ERR_TYPE;
+  return object_get_name(g_type_names, dt, predefined_type_name(dt),
+                         name, resultlen);
+}
+
+int MPI_Win_set_name(MPI_Win win, const char *name) {
+  if (!g_win_handles.count(win)) return MPI_ERR_WIN;
+  return object_set_name(g_win_names, win, name);
+}
+
+int MPI_Win_get_name(MPI_Win win, char *name, int *resultlen) {
+  if (!g_win_handles.count(win)) return MPI_ERR_WIN;
+  return object_get_name(g_win_names, win, "", name, resultlen);
+}
+
+int MPI_Comm_set_info(MPI_Comm comm, MPI_Info info) {
+  if (!lookup_comm(comm)) return MPI_ERR_COMM;
+  return object_set_info(g_comm_info, comm, info);
+}
+
+int MPI_Comm_get_info(MPI_Comm comm, MPI_Info *info_used) {
+  if (!lookup_comm(comm)) return MPI_ERR_COMM;
+  return object_get_info(g_comm_info, comm, info_used);
+}
+
+int MPI_Win_set_info(MPI_Win win, MPI_Info info) {
+  if (!g_win_handles.count(win)) return MPI_ERR_WIN;
+  return object_set_info(g_win_info, win, info);
+}
+
+int MPI_Win_get_info(MPI_Win win, MPI_Info *info_used) {
+  if (!g_win_handles.count(win)) return MPI_ERR_WIN;
+  return object_get_info(g_win_info, win, info_used);
+}
+
+int MPI_File_set_info(MPI_File fh, MPI_Info info) {
+  if (!g_files.count(fh)) return MPI_ERR_FILE;
+  return object_set_info(g_file_info, fh, info);
+}
+
+int MPI_File_get_info(MPI_File fh, MPI_Info *info_used) {
+  if (!g_files.count(fh)) return MPI_ERR_FILE;
+  return object_get_info(g_file_info, fh, info_used);
+}
+
+int MPI_File_get_amode(MPI_File fh, int *amode) {
+  auto it = g_files.find(fh);
+  if (it == g_files.end()) return MPI_ERR_FILE;
+  *amode = it->second.amode;
+  return MPI_SUCCESS;
+}
+
+int MPI_File_get_group(MPI_File fh, MPI_Group *group) {
+  auto it = g_files.find(fh);
+  if (it == g_files.end()) return MPI_ERR_FILE;
+  CommObj *c = lookup_comm(it->second.comm);
+  if (!c) return MPI_ERR_COMM;
+  *group = register_group(c->group);
+  return MPI_SUCCESS;
+}
+
+// ------------------------------------ communicator tier 2 (round 5)
+
+int MPI_Comm_split_type(MPI_Comm comm, int split_type, int key,
+                        MPI_Info, MPI_Comm *newcomm) {
+  // comm_split_type.c: SHARED groups ranks that can share memory —
+  // here, ranks whose modex business card names the same host.  The
+  // color is the lowest parent rank on my host, so same-host members
+  // agree and distinct hosts never collide.
+  CommObj *c = lookup_comm(comm);
+  if (!c) return MPI_ERR_COMM;
+  if (split_type != MPI_COMM_TYPE_SHARED && split_type != MPI_UNDEFINED)
+    return MPI_ERR_ARG;
+  // MPI-3.1 §6.4.2: UNDEFINED ranks still participate in the
+  // collective — they enter the allgather with a sentinel card (hosts
+  // never start with '\1') so no SHARED rank can match them, then
+  // split with MPI_UNDEFINED
+  int n = (int)c->group.size();
+  char mine[64] = {0};
+  if (split_type == MPI_UNDEFINED)
+    snprintf(mine, sizeof mine, "\1%d", c->local_rank);
+  else
+    snprintf(mine, sizeof mine, "%s",
+             g.book[c->group[c->local_rank]].first.c_str());
+  std::vector<char> all((size_t)n * 64);
+  int rc = c_allgather(*c, mine, 64, MPI_BYTE, all.data(), 64, MPI_BYTE);
+  if (rc != MPI_SUCCESS) return rc;
+  int color = MPI_UNDEFINED;
+  if (split_type == MPI_COMM_TYPE_SHARED)
+    for (int r = 0; r < n; r++)
+      if (strncmp(all.data() + (size_t)r * 64, mine, 64) == 0) {
+        color = r;  // lowest parent rank sharing my host
+        break;
+      }
+  return MPI_Comm_split(comm, color, key, newcomm);
+}
+
+// Per-(group,tag) creation sequence: members of repeated
+// Comm_create_group calls with the same signature advance identically
+// (mismatched sequences are erroneous usage), so the derived cids
+// agree without any wire traffic — the deterministic-cid contract.
+static std::map<std::pair<uint64_t, int>, uint64_t> g_ccg_seq;
+
+int MPI_Comm_create_group(MPI_Comm comm, MPI_Group group, int tag,
+                          MPI_Comm *newcomm) {
+  // comm_create_group.c: collective over the GROUP only — non-members
+  // do not call.  No parent-wide traffic: cids derive from (member
+  // world ranks, tag, per-signature sequence).
+  CommObj *c = lookup_comm(comm);
+  if (!c) return MPI_ERR_COMM;
+  GroupObj *gr = lookup_group(group);
+  if (group == MPI_GROUP_EMPTY || (gr && gr->ranks.empty())) {
+    *newcomm = MPI_COMM_NULL;
+    return MPI_SUCCESS;
+  }
+  if (!gr) return MPI_ERR_GROUP;
+  int my_world = c->group[c->local_rank];
+  int my_idx = -1;
+  for (size_t i = 0; i < gr->ranks.size(); i++)
+    if (gr->ranks[i] == my_world) my_idx = (int)i;
+  if (my_idx < 0) {
+    *newcomm = MPI_COMM_NULL;
+    return MPI_SUCCESS;
+  }
+  uint64_t h = 0x9E3779B97F4A7C15ULL;
+  for (int r : gr->ranks) h = mix64(h ^ (uint64_t)(uint32_t)r);
+  uint64_t seq = g_ccg_seq[{h, tag}]++;
+  CommObj child;
+  uint64_t base = mix64(h ^ mix64((uint64_t)(uint32_t)tag) ^
+                        (seq * 0x100000001B3ULL) ^ 0xCC6ULL);
+  base = (base & 0x3FFFFFFFFFFFULL) | 0x10000ULL;
+  child.cid_pt2pt = (int64_t)base;
+  child.cid_coll = (int64_t)base + 1;
+  child.cid_bar = (int64_t)base + 2;
+  child.group = gr->ranks;
+  child.local_rank = my_idx;
+  int handle = g_next_comm++;
+  g_comms[handle] = child;
+  *newcomm = handle;
+  return MPI_SUCCESS;
+}
+
+int MPI_Comm_dup_with_info(MPI_Comm comm, MPI_Info info,
+                           MPI_Comm *newcomm) {
+  int rc = MPI_Comm_dup(comm, newcomm);
+  if (rc != MPI_SUCCESS) return rc;
+  return MPI_Comm_set_info(*newcomm, info);
+}
+
+int MPI_Comm_idup(MPI_Comm comm, MPI_Comm *newcomm,
+                  MPI_Request *request) {
+  // comm_idup.c; dup is wire-free here (deterministic cids), so the
+  // request is born complete
+  int rc = MPI_Comm_dup(comm, newcomm);
+  if (rc != MPI_SUCCESS) return rc;
+  *request = make_completed_req(comm);
+  return MPI_SUCCESS;
+}
+
+int MPI_Comm_remote_group(MPI_Comm comm, MPI_Group *group) {
+  CommObj *c = lookup_comm(comm);
+  if (!c) return MPI_ERR_COMM;
+  if (c->remote.empty()) return MPI_ERR_COMM;  // intracommunicator
+  *group = register_group(c->remote);
+  return MPI_SUCCESS;
+}
+
+// Finalize sweep for this section's state (called from MPI_Finalize)
+void clear_info_naming_state(void) {
+  g_infos.clear();
+  g_next_info = 1;
+  g_comm_info.clear();
+  g_win_info.clear();
+  g_file_info.clear();
+  g_comm_names.clear();
+  g_type_names.clear();
+  g_win_names.clear();
+  g_ccg_seq.clear();
 }
 
 // ---------------------------------------------------------------- misc

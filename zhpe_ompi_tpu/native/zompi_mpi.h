@@ -126,6 +126,7 @@ typedef int MPI_File;
 #define MPI_ERR_TRUNCATE 15
 #define MPI_ERR_COUNT    2
 #define MPI_ERR_OTHER    16
+#define MPI_ERR_IN_STATUS 18
 
 #define MPI_MAX_PROCESSOR_NAME 256
 #define MPI_MAX_ERROR_STRING   256
@@ -402,6 +403,56 @@ int MPI_Sendrecv_replace(void *buf, int count, MPI_Datatype dt, int dest,
 /* profiling control (pcontrol.c): accepted, no-op */
 int MPI_Pcontrol(const int level, ...);
 
+/* info objects (info_create.c family): ordered string dictionaries */
+#define MPI_MAX_INFO_KEY   255
+#define MPI_MAX_INFO_VAL   1024
+#define MPI_ERR_INFO       34
+#define MPI_ERR_INFO_KEY   29
+#define MPI_ERR_INFO_VALUE 30
+#define MPI_ERR_INFO_NOKEY 31
+int MPI_Info_create(MPI_Info *info);
+int MPI_Info_free(MPI_Info *info);
+int MPI_Info_dup(MPI_Info info, MPI_Info *newinfo);
+int MPI_Info_set(MPI_Info info, const char *key, const char *value);
+int MPI_Info_delete(MPI_Info info, const char *key);
+int MPI_Info_get(MPI_Info info, const char *key, int valuelen,
+                 char *value, int *flag);
+int MPI_Info_get_nkeys(MPI_Info info, int *nkeys);
+int MPI_Info_get_nthkey(MPI_Info info, int n, char *key);
+int MPI_Info_get_valuelen(MPI_Info info, const char *key, int *valuelen,
+                          int *flag);
+
+/* object naming (comm_set_name.c / type_set_name.c / win_set_name.c) */
+#define MPI_MAX_OBJECT_NAME 64
+int MPI_Comm_set_name(MPI_Comm comm, const char *name);
+int MPI_Comm_get_name(MPI_Comm comm, char *name, int *resultlen);
+int MPI_Type_set_name(MPI_Datatype dt, const char *name);
+int MPI_Type_get_name(MPI_Datatype dt, char *name, int *resultlen);
+int MPI_Win_set_name(MPI_Win win, const char *name);
+int MPI_Win_get_name(MPI_Win win, char *name, int *resultlen);
+
+/* communicator tier 2 (comm_split_type.c, comm_create_group.c,
+ * comm_dup_with_info.c, comm_idup.c, comm_remote_group.c,
+ * comm_set_info.c) */
+#define MPI_COMM_TYPE_SHARED 1
+int MPI_Comm_split_type(MPI_Comm comm, int split_type, int key,
+                        MPI_Info info, MPI_Comm *newcomm);
+int MPI_Comm_create_group(MPI_Comm comm, MPI_Group group, int tag,
+                          MPI_Comm *newcomm);
+int MPI_Comm_dup_with_info(MPI_Comm comm, MPI_Info info,
+                           MPI_Comm *newcomm);
+int MPI_Comm_idup(MPI_Comm comm, MPI_Comm *newcomm,
+                  MPI_Request *request);
+int MPI_Comm_remote_group(MPI_Comm comm, MPI_Group *group);
+int MPI_Comm_set_info(MPI_Comm comm, MPI_Info info);
+int MPI_Comm_get_info(MPI_Comm comm, MPI_Info *info_used);
+int MPI_Win_set_info(MPI_Win win, MPI_Info info);
+int MPI_Win_get_info(MPI_Win win, MPI_Info *info_used);
+int MPI_File_set_info(MPI_File fh, MPI_Info info);
+int MPI_File_get_info(MPI_File fh, MPI_Info *info_used);
+int MPI_File_get_amode(MPI_File fh, int *amode);
+int MPI_File_get_group(MPI_File fh, MPI_Group *group);
+
 /* Fortran handle conversion (comm_c2f.c family): handles are ints on
  * both sides, so conversions are the identity — the surface exists so
  * tooling written against mpi.h compiles */
@@ -480,6 +531,82 @@ int MPI_Type_create_indexed_block(int count, int blocklength,
 int MPI_Type_commit(MPI_Datatype *datatype);
 int MPI_Type_free(MPI_Datatype *datatype);
 int MPI_Type_size(MPI_Datatype datatype, int *size);
+
+/* datatype tier 2 (type_create_hvector.c, type_create_struct.c,
+ * type_create_resized.c, type_create_subarray.c, type_create_darray.c,
+ * type_dup.c, type_get_envelope.c families).  Byte-displacement
+ * constructors flatten to byte typemaps (homogeneous wire). */
+int MPI_Type_dup(MPI_Datatype oldtype, MPI_Datatype *newtype);
+int MPI_Type_create_resized(MPI_Datatype oldtype, MPI_Aint lb,
+                            MPI_Aint extent, MPI_Datatype *newtype);
+int MPI_Type_create_hvector(int count, int blocklength, MPI_Aint stride,
+                            MPI_Datatype oldtype, MPI_Datatype *newtype);
+int MPI_Type_create_hindexed(int count, const int blocklengths[],
+                             const MPI_Aint displacements[],
+                             MPI_Datatype oldtype, MPI_Datatype *newtype);
+int MPI_Type_create_hindexed_block(int count, int blocklength,
+                                   const MPI_Aint displacements[],
+                                   MPI_Datatype oldtype,
+                                   MPI_Datatype *newtype);
+int MPI_Type_create_struct(int count, const int blocklengths[],
+                           const MPI_Aint displacements[],
+                           const MPI_Datatype types[],
+                           MPI_Datatype *newtype);
+#define MPI_ORDER_C       0
+#define MPI_ORDER_FORTRAN 1
+int MPI_Type_create_subarray(int ndims, const int sizes[],
+                             const int subsizes[], const int starts[],
+                             int order, MPI_Datatype oldtype,
+                             MPI_Datatype *newtype);
+#define MPI_DISTRIBUTE_BLOCK     0
+#define MPI_DISTRIBUTE_CYCLIC    1
+#define MPI_DISTRIBUTE_NONE      2
+#define MPI_DISTRIBUTE_DFLT_DARG (-1)
+int MPI_Type_create_darray(int size, int rank, int ndims,
+                           const int gsizes[], const int distribs[],
+                           const int dargs[], const int psizes[],
+                           int order, MPI_Datatype oldtype,
+                           MPI_Datatype *newtype);
+int MPI_Type_get_true_extent(MPI_Datatype dt, MPI_Aint *true_lb,
+                             MPI_Aint *true_extent);
+int MPI_Type_get_true_extent_x(MPI_Datatype dt, MPI_Count *true_lb,
+                               MPI_Count *true_extent);
+int MPI_Type_get_extent_x(MPI_Datatype dt, MPI_Count *lb,
+                          MPI_Count *extent);
+int MPI_Type_size_x(MPI_Datatype dt, MPI_Count *size);
+/* envelope/contents (type_get_envelope.c): constructor introspection */
+#define MPI_COMBINER_NAMED          0
+#define MPI_COMBINER_DUP            1
+#define MPI_COMBINER_CONTIGUOUS     2
+#define MPI_COMBINER_VECTOR         3
+#define MPI_COMBINER_HVECTOR        4
+#define MPI_COMBINER_INDEXED        5
+#define MPI_COMBINER_HINDEXED       6
+#define MPI_COMBINER_INDEXED_BLOCK  7
+#define MPI_COMBINER_HINDEXED_BLOCK 8
+#define MPI_COMBINER_STRUCT         9
+#define MPI_COMBINER_SUBARRAY       10
+#define MPI_COMBINER_DARRAY         11
+#define MPI_COMBINER_RESIZED        12
+int MPI_Type_get_envelope(MPI_Datatype dt, int *num_integers,
+                          int *num_addresses, int *num_datatypes,
+                          int *combiner);
+int MPI_Type_get_contents(MPI_Datatype dt, int max_integers,
+                          int max_addresses, int max_datatypes,
+                          int integers[], MPI_Aint addresses[],
+                          MPI_Datatype datatypes[]);
+/* deprecated MPI-1 forms (type_hvector.c, type_extent.c, ...) */
+int MPI_Type_hvector(int count, int blocklength, MPI_Aint stride,
+                     MPI_Datatype oldtype, MPI_Datatype *newtype);
+int MPI_Type_hindexed(int count, int blocklengths[],
+                      MPI_Aint displacements[], MPI_Datatype oldtype,
+                      MPI_Datatype *newtype);
+int MPI_Type_struct(int count, int blocklengths[],
+                    MPI_Aint displacements[], MPI_Datatype types[],
+                    MPI_Datatype *newtype);
+int MPI_Type_extent(MPI_Datatype dt, MPI_Aint *extent);
+int MPI_Type_lb(MPI_Datatype dt, MPI_Aint *lb);
+int MPI_Type_ub(MPI_Datatype dt, MPI_Aint *ub);
 
 /* pack/unpack (ompi/mpi/c/pack.c:45 surface over the convertor) */
 int MPI_Pack(const void *inbuf, int incount, MPI_Datatype datatype,
