@@ -1,0 +1,220 @@
+/* winadv_c.c — round-5 win tier-2 + matched-probe acceptance:
+ * lock_all/unlock_all epochs, Win_sync, Win_test (PSCW), dynamic
+ * windows (attach/detach + absolute displacements), shared-memory
+ * windows (allocate_shared + shared_query with direct load/store),
+ * win attributes, and Mprobe/Improbe/Mrecv including a rendezvous-
+ * size message claimed by Improbe.  Reference shapes:
+ * ompi/mpi/c/{win_lock_all,win_sync,win_test,win_create_dynamic,
+ * win_attach,win_allocate_shared,win_shared_query,win_create_keyval,
+ * mprobe,mrecv}.c.  Run with >= 2 ranks. */
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include "zompi_mpi.h"
+
+#define CHECK(cond)                                                    \
+  do {                                                                 \
+    if (!(cond)) {                                                     \
+      fprintf(stderr, "FAIL %s:%d: %s\n", __FILE__, __LINE__, #cond);  \
+      MPI_Abort(MPI_COMM_WORLD, 2);                                    \
+    }                                                                  \
+  } while (0)
+
+static int win_del_calls = 0;
+static int win_del_fn(MPI_Win w, int k, void *v, void *es) {
+  (void)w; (void)k; (void)v; (void)es;
+  win_del_calls++;
+  return MPI_SUCCESS;
+}
+
+int main(int argc, char **argv) {
+  CHECK(MPI_Init(&argc, &argv) == MPI_SUCCESS);
+  int rank, size;
+  MPI_Comm_rank(MPI_COMM_WORLD, &rank);
+  MPI_Comm_size(MPI_COMM_WORLD, &size);
+  CHECK(size >= 2);
+
+  /* ---- lock_all epoch: every rank adds into rank 0's counter ---- */
+  {
+    long long acc = 0;
+    MPI_Win win;
+    CHECK(MPI_Win_create(&acc, sizeof acc, sizeof acc, MPI_INFO_NULL,
+                         MPI_COMM_WORLD, &win) == MPI_SUCCESS);
+    CHECK(MPI_Win_lock_all(MPI_MODE_NOCHECK, win) == MPI_SUCCESS);
+    long long one = 1;
+    CHECK(MPI_Accumulate(&one, 1, MPI_LONG, 0, 0, 1, MPI_LONG, MPI_SUM,
+                         win) == MPI_SUCCESS);
+    CHECK(MPI_Win_flush_local(0, win) == MPI_SUCCESS);
+    CHECK(MPI_Win_unlock_all(win) == MPI_SUCCESS);
+    CHECK(MPI_Win_sync(win) == MPI_SUCCESS);
+    MPI_Barrier(MPI_COMM_WORLD);
+    if (rank == 0) CHECK(acc == size);
+    CHECK(MPI_Win_free(&win) == MPI_SUCCESS);
+  }
+
+  /* ---- Win_test: PSCW with polling completion ---- */
+  if (rank < 2) {
+    double buf[4] = {0, 0, 0, 0};
+    MPI_Win win;
+    MPI_Comm pair;
+    CHECK(MPI_Comm_split(MPI_COMM_WORLD, 0, rank, &pair) == MPI_SUCCESS);
+    CHECK(MPI_Win_create(buf, sizeof buf, sizeof(double), MPI_INFO_NULL,
+                         pair, &win) == MPI_SUCCESS);
+    MPI_Group pg, peer_grp;
+    CHECK(MPI_Comm_group(pair, &pg) == MPI_SUCCESS);
+    int peer = 1 - rank;
+    CHECK(MPI_Group_incl(pg, 1, &peer, &peer_grp) == MPI_SUCCESS);
+    CHECK(MPI_Win_post(peer_grp, 0, win) == MPI_SUCCESS);
+    CHECK(MPI_Win_start(peer_grp, 0, win) == MPI_SUCCESS);
+    double v = 10.0 + rank;
+    /* write my stamp into MY-rank slot of the peer's window */
+    CHECK(MPI_Put(&v, 1, MPI_DOUBLE, peer, (MPI_Aint)rank, 1,
+                  MPI_DOUBLE, win) == MPI_SUCCESS);
+    CHECK(MPI_Win_complete(win) == MPI_SUCCESS);
+    int done = 0;
+    while (!done) CHECK(MPI_Win_test(win, &done) == MPI_SUCCESS);
+    CHECK(buf[peer] == 10.0 + peer); /* the peer's stamp, their slot */
+    MPI_Group_free(&peer_grp);
+    MPI_Group_free(&pg);
+    CHECK(MPI_Win_free(&win) == MPI_SUCCESS);
+    MPI_Comm_free(&pair);
+  } else {
+    MPI_Comm dummy;
+    CHECK(MPI_Comm_split(MPI_COMM_WORLD, 1, rank, &dummy) ==
+          MPI_SUCCESS);
+    MPI_Comm_free(&dummy);
+  }
+
+  /* ---- dynamic window: exchange absolute displacements, then RMA
+   * into attached regions ---- */
+  {
+    MPI_Win dwin;
+    CHECK(MPI_Win_create_dynamic(MPI_INFO_NULL, MPI_COMM_WORLD, &dwin) ==
+          MPI_SUCCESS);
+    static int region[8];
+    for (int i = 0; i < 8; i++) region[i] = -1;
+    CHECK(MPI_Win_attach(dwin, region, sizeof region) == MPI_SUCCESS);
+    MPI_Aint myaddr;
+    CHECK(MPI_Get_address(region, &myaddr) == MPI_SUCCESS);
+    /* everyone learns everyone's region address */
+    MPI_Aint *addrs = malloc(sizeof(MPI_Aint) * (size_t)size);
+    CHECK(MPI_Allgather(&myaddr, 1, MPI_LONG_LONG, addrs, 1,
+                        MPI_LONG_LONG, MPI_COMM_WORLD) == MPI_SUCCESS);
+    CHECK(MPI_Win_fence(0, dwin) == MPI_SUCCESS);
+    int next = (rank + 1) % size;
+    int val = 7000 + rank;
+    /* write my stamp into slot `rank` of my right neighbor's region */
+    CHECK(MPI_Put(&val, 1, MPI_INT, next,
+                  addrs[next] + (MPI_Aint)(rank * (int)sizeof(int)), 1,
+                  MPI_INT, dwin) == MPI_SUCCESS);
+    CHECK(MPI_Win_fence(0, dwin) == MPI_SUCCESS);
+    int prev = (rank + size - 1) % size;
+    CHECK(region[prev] == 7000 + prev);
+    /* out-of-region RMA must fail loudly at the self path */
+    CHECK(MPI_Put(&val, 1, MPI_INT, rank, (MPI_Aint)1, 1, MPI_INT,
+                  dwin) == MPI_ERR_ARG);
+    CHECK(MPI_Win_detach(dwin, region) == MPI_SUCCESS);
+    free(addrs);
+    CHECK(MPI_Win_free(&dwin) == MPI_SUCCESS);
+  }
+
+  /* ---- shared-memory window: direct load/store, no MPI calls in
+   * the data path ---- */
+  {
+    MPI_Win swin;
+    double *mine = NULL;
+    CHECK(MPI_Win_allocate_shared(4 * sizeof(double), sizeof(double),
+                                  MPI_INFO_NULL, MPI_COMM_WORLD, &mine,
+                                  &swin) == MPI_SUCCESS);
+    for (int i = 0; i < 4; i++) mine[i] = rank * 100.0 + i;
+    CHECK(MPI_Win_sync(swin) == MPI_SUCCESS);
+    MPI_Barrier(MPI_COMM_WORLD);
+    /* read the right neighbor's slice through the shared mapping */
+    int next = (rank + 1) % size;
+    MPI_Aint nsz = -1;
+    int nunit = -1;
+    double *nbase = NULL;
+    CHECK(MPI_Win_shared_query(swin, next, &nsz, &nunit, &nbase) ==
+          MPI_SUCCESS);
+    CHECK(nsz == 4 * (MPI_Aint)sizeof(double) &&
+          nunit == (int)sizeof(double));
+    for (int i = 0; i < 4; i++) CHECK(nbase[i] == next * 100.0 + i);
+    MPI_Barrier(MPI_COMM_WORLD);
+    CHECK(MPI_Win_free(&swin) == MPI_SUCCESS);
+  }
+
+  /* ---- win attributes ---- */
+  {
+    int acc = 0;
+    MPI_Win win;
+    CHECK(MPI_Win_create(&acc, sizeof acc, 1, MPI_INFO_NULL,
+                         MPI_COMM_WORLD, &win) == MPI_SUCCESS);
+    int kv = MPI_KEYVAL_INVALID;
+    CHECK(MPI_Win_create_keyval(NULL, win_del_fn, &kv, NULL) ==
+          MPI_SUCCESS);
+    CHECK(MPI_Win_set_attr(win, kv, (void *)0xBEEF) == MPI_SUCCESS);
+    void *got = NULL;
+    int found = 0;
+    CHECK(MPI_Win_get_attr(win, kv, &got, &found) == MPI_SUCCESS);
+    CHECK(found == 1 && got == (void *)0xBEEF);
+    CHECK(MPI_Win_free(&win) == MPI_SUCCESS); /* runs the delete fn */
+    CHECK(win_del_calls == 1);
+    CHECK(MPI_Win_free_keyval(&kv) == MPI_SUCCESS);
+  }
+
+  /* ---- matched probe: eager and rendezvous ---- */
+  if (rank < 2) {
+    int peer = 1 - rank;
+    if (rank == 0) {
+      int small = 4242;
+      CHECK(MPI_Send(&small, 1, MPI_INT, 1, 5, MPI_COMM_WORLD) ==
+            MPI_SUCCESS);
+      /* 2 MB: above the eager limit, goes rendezvous */
+      size_t n = 2 * 1024 * 1024 / sizeof(int);
+      int *big = malloc(n * sizeof(int));
+      for (size_t i = 0; i < n; i++) big[i] = (int)(i * 3);
+      CHECK(MPI_Send(big, (int)n, MPI_INT, 1, 6, MPI_COMM_WORLD) ==
+            MPI_SUCCESS);
+      free(big);
+    } else {
+      MPI_Message msg;
+      MPI_Status st;
+      /* Mprobe the small message; a recv on the same tag must NOT see
+       * it once extracted, so probe again returns nothing */
+      CHECK(MPI_Mprobe(0, 5, MPI_COMM_WORLD, &msg, &st) == MPI_SUCCESS);
+      int cnt = -1;
+      CHECK(MPI_Get_count(&st, MPI_INT, &cnt) == MPI_SUCCESS &&
+            cnt == 1);
+      int flag = -1;
+      MPI_Status st2;
+      CHECK(MPI_Iprobe(0, 5, MPI_COMM_WORLD, &flag, &st2) ==
+            MPI_SUCCESS && flag == 0);
+      int small = -1;
+      CHECK(MPI_Mrecv(&small, 1, MPI_INT, &msg, &st) == MPI_SUCCESS);
+      CHECK(small == 4242 && msg == MPI_MESSAGE_NULL);
+      CHECK(st.MPI_SOURCE == 0 && st.MPI_TAG == 5);
+
+      /* rendezvous-size message through Improbe + Mrecv */
+      size_t n = 2 * 1024 * 1024 / sizeof(int);
+      MPI_Message big_msg = MPI_MESSAGE_NULL;
+      flag = 0;
+      while (!flag)
+        CHECK(MPI_Improbe(0, 6, MPI_COMM_WORLD, &flag, &big_msg, &st) ==
+              MPI_SUCCESS);
+      CHECK(MPI_Get_count(&st, MPI_INT, &cnt) == MPI_SUCCESS &&
+            cnt == (int)n);
+      int *big = malloc(n * sizeof(int));
+      CHECK(MPI_Mrecv(big, (int)n, MPI_INT, &big_msg, &st) ==
+            MPI_SUCCESS);
+      for (size_t i = 0; i < n; i += 4097)
+        CHECK(big[i] == (int)(i * 3));
+      CHECK(big[n - 1] == (int)((n - 1) * 3));
+      free(big);
+    }
+  }
+
+  MPI_Barrier(MPI_COMM_WORLD);
+  if (rank == 0) printf("winadv_c OK on %d ranks\n", size);
+  MPI_Finalize();
+  return 0;
+}

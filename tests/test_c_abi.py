@@ -144,6 +144,17 @@ class TestPureC:
         outs = _run_example(shim, tmp_path_factory, "dtype2_c.c", n)
         assert f"dtype2_c OK on {n} ranks" in outs[0]
 
+    @pytest.mark.parametrize("n", [2, 3])
+    def test_winadv_example(self, shim, tmp_path_factory, n):
+        """Round-5 win tier 2 + matched probe: lock_all epochs,
+        Win_test polling, dynamic windows with absolute displacements,
+        shared-memory windows with direct load/store through
+        shared_query, win attributes, Mprobe/Mrecv incl. a 2 MB
+        rendezvous message claimed by Improbe."""
+        outs = _run_example(shim, tmp_path_factory, "winadv_c.c", n,
+                            timeout=90)
+        assert f"winadv_c OK on {n} ranks" in outs[0]
+
 
 class TestInterop:
     def test_c_rank_joins_python_universe(self, shim, tmp_path):

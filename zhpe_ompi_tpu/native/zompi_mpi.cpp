@@ -65,6 +65,7 @@
 
 #include <arpa/inet.h>
 #include <fcntl.h>
+#include <sys/mman.h>
 #include <netinet/in.h>
 #include <sys/stat.h>
 #include <sys/wait.h>
@@ -442,6 +443,9 @@ struct Message {
   bool rndv_pending = false;
   int64_t rndv_id = 0;
   int64_t rndv_nbytes = 0;  // announced size, for Probe's count
+  // matched-probe extraction (mprobe.c): a nonzero handle means an
+  // Improbe owns this message — ordinary matching and probing skip it
+  int64_t mhandle = 0;
 };
 
 // A receive request registered with the engine.  Blocking receives are
@@ -471,6 +475,10 @@ struct Posted {
   size_t want_bytes;
   size_t item;     // base element size (status._count unit)
 };
+
+// Imrecv claims parked on a rendezvous-pending extracted message:
+// mhandle -> landing plan (guarded by match_mu)
+std::map<int64_t, Posted> g_mrecv_wait;
 
 struct Shim {
   int rank = -1, size = 0;
@@ -587,20 +595,24 @@ void push_message(Message &&m) {
 
 // Post a receive: unexpected queue first (arrival order), else posted.
 // Returns the request handle.
+// capture the landing plan for a receive into `r`: contiguous types
+// land in the user buffer, derived types in scratch with the typemap
+// snapshotted (survives MPI_Type_free).  Shared by post_recv and the
+// matched-probe receive so the two paths can never diverge.
+char *prepare_landing(Req *r, const DtView &v, size_t &want_bytes) {
+  want_bytes = (size_t)r->count * v.elems_per_item() * v.di.item;
+  r->plan_di = v.di;
+  if (v.contiguous()) return (char *)r->user_buf;
+  r->scratch.resize(want_bytes);
+  r->needs_unpack = true;
+  r->plan = *v.derived;
+  return r->scratch.data();
+}
+
 int post_recv(Req *r, const DtView &v, int64_t cid, int src_world,
               int64_t tag) {
-  size_t base_bytes =
-      (size_t)r->count * v.elems_per_item() * v.di.item;
-  char *land;
-  r->plan_di = v.di;
-  if (v.contiguous()) {
-    land = (char *)r->user_buf;
-  } else {
-    r->scratch.resize(base_bytes);
-    r->needs_unpack = true;
-    r->plan = *v.derived;  // survives MPI_Type_free of the handle
-    land = r->scratch.data();
-  }
+  size_t base_bytes;
+  char *land = prepare_landing(r, v, base_bytes);
   Posted p{r, cid, src_world, tag, land, base_bytes, v.di.item};
   int handle;
   int64_t cts_src = -1, cts_rid = -1;
@@ -610,6 +622,7 @@ int post_recv(Req *r, const DtView &v, int64_t cid, int src_world,
     g.reqs[handle] = r;
     bool matched = false;
     for (auto it = g.unexpected.begin(); it != g.unexpected.end(); ++it) {
+      if (it->mhandle) continue;  // owned by a matched probe
       if (it->cid != cid) continue;
       if (src_world != MPI_ANY_SOURCE && it->src != src_world) continue;
       if (tag != MPI_ANY_TAG && it->tag != tag) continue;
@@ -656,6 +669,10 @@ void finish_recv(Req *r) {
 // stack-allocated Req from outliving its registration on error paths.
 void deregister_locked(int handle, Req *r) {
   g.posted.remove_if([r](const Posted &p) { return p.req == r; });
+  for (auto it = g_mrecv_wait.begin(); it != g_mrecv_wait.end();) {
+    if (it->second.req == r) it = g_mrecv_wait.erase(it);
+    else ++it;
+  }
   for (auto it = g.rndv_wait.begin(); it != g.rndv_wait.end();) {
     if (it->second.req == r) it = g.rndv_wait.erase(it);
     else ++it;
@@ -822,11 +839,23 @@ void land_rndv_data(Message &&m, int64_t rid) {
       g.match_cv.notify_all();
       return;
     }
-    for (auto &u : g.unexpected) {
+    for (auto it = g.unexpected.begin(); it != g.unexpected.end();
+         ++it) {
+      Message &u = *it;
       if (u.rndv_pending && u.src == m.src && u.rndv_id == rid) {
         u.dt = std::move(m.dt);
         u.data = std::move(m.data);
         u.rndv_pending = false;
+        // an Imrecv may already be parked on this extracted message:
+        // deliver into its buffer now and retire the placeholder
+        if (u.mhandle) {
+          auto mw = g_mrecv_wait.find(u.mhandle);
+          if (mw != g_mrecv_wait.end()) {
+            deliver(mw->second, u);
+            g_mrecv_wait.erase(mw);
+            g.unexpected.erase(it);
+          }
+        }
         g.match_cv.notify_all();
         return;
       }
@@ -1427,7 +1456,39 @@ struct WinObj {
   std::vector<int> pscw_post;
   bool pscw_start_open = false;
   bool pscw_post_open = false;
+  // dynamic windows (win_create_dynamic.c): ops address ABSOLUTE byte
+  // displacements that must land inside a locally attached region
+  bool dynamic = false;
+  std::vector<std::pair<uint64_t, uint64_t>> attached;  // (addr, len)
+  std::mutex attach_mu;
+  // shared-memory windows (win_allocate_shared.c): one mmap'd segment
+  // per comm, every rank maps the whole thing
+  bool shm = false;
+  char *shm_map = nullptr;
+  size_t shm_len = 0;
+  std::string shm_path;
+  std::vector<int64_t> shm_sizes;   // per comm rank
+  std::vector<int> shm_units;
+  std::vector<int64_t> shm_offsets;
 };
+
+// resolve the target-side destination of an RMA op: normal windows
+// bound-check against [0, size); dynamic windows take absolute
+// displacements validated against the attached-region list
+char *win_dst(WinObj *w, int64_t disp, int64_t nbytes) {
+  if (nbytes < 0) return nullptr;
+  if (!w->dynamic) {
+    if (disp < 0 || disp + nbytes > w->size) return nullptr;
+    return w->base + disp;
+  }
+  std::lock_guard<std::mutex> lk(w->attach_mu);
+  for (auto &r : w->attached) {
+    uint64_t d = (uint64_t)disp;
+    if (d >= r.first && d + (uint64_t)nbytes <= r.first + r.second)
+      return (char *)(uintptr_t)d;
+  }
+  return nullptr;
+}
 
 std::map<int64_t, WinObj *> g_wins;      // wire win-id -> obj
 std::map<int, int64_t> g_win_handles;    // local MPI_Win -> wire win-id
@@ -1523,11 +1584,11 @@ bool apply_amo(WinObj *w, int64_t disp, const std::string &sub,
       return false;
     nelems = (int64_t)(opnd_len / di.item);
   }
-  if (nelems <= 0 || disp < 0 || disp + nelems * (int64_t)di.item > w->size)
-    return false;
+  if (nelems <= 0) return false;
+  char *cell = win_dst(w, disp, nelems * (int64_t)di.item);
+  if (!cell) return false;
   old.resize((size_t)nelems * di.item);
   std::lock_guard<std::mutex> lk(w->mu);
-  char *cell = w->base + disp;
   memcpy(old.data(), cell, old.size());
   if (sub == "add") {
     reduce_buf(cell, opnd, (int)nelems, dt, MPI_SUM);
@@ -1562,9 +1623,10 @@ void handle_win_frame(int64_t src, const DssVal &t) {
   if (kind == "wput" && t.items.size() == 4) {
     int64_t disp = t.items[2].i;
     const std::string &data = t.items[3].data;
-    if (disp < 0 || disp + (int64_t)data.size() > w->size) return;
+    char *dst = win_dst(w, disp, (int64_t)data.size());
+    if (!dst) return;
     std::lock_guard<std::mutex> lk(w->mu);
-    memcpy(w->base + disp, data.data(), data.size());
+    memcpy(dst, data.data(), data.size());
   } else if (kind == "wacc" && t.items.size() == 6) {
     int64_t disp = t.items[2].i;
     MPI_Op op = (MPI_Op)t.items[3].i;
@@ -1573,23 +1635,25 @@ void handle_win_frame(int64_t src, const DssVal &t) {
     DtInfo di;
     if (!base_dtinfo(dt, di)) return;
     int64_t n = (int64_t)(data.size() / di.item);
-    if (disp < 0 || disp + (int64_t)data.size() > w->size) return;
+    char *dst = win_dst(w, disp, (int64_t)data.size());
+    if (!dst) return;
     std::lock_guard<std::mutex> lk(w->mu);
     // MPI_Accumulate: target = target op origin (the service loop is
     // the serialization point, as in osc/am.py's apply_acc)
-    reduce_buf(w->base + disp, data.data(), (int)n, dt, op);
+    reduce_buf(dst, data.data(), (int)n, dt, op);
   } else if (kind == "wget" && t.items.size() == 5) {
     int64_t disp = t.items[2].i;
     int64_t nbytes = t.items[3].i;
     int64_t reply_tag = t.items[4].i;
-    if (disp < 0 || nbytes < 0 || disp + nbytes > w->size) {
+    char *src_p = win_dst(w, disp, nbytes);
+    if (!src_p) {
       win_reply(src, reply_tag, "", 0);
       return;
     }
     std::vector<char> out((size_t)nbytes);
     {
       std::lock_guard<std::mutex> lk(w->mu);
-      memcpy(out.data(), w->base + disp, (size_t)nbytes);
+      memcpy(out.data(), src_p, (size_t)nbytes);
     }
     win_reply(src, reply_tag, out.data(), out.size());
   } else if (kind == "wflush" && t.items.size() == 3) {
@@ -4149,6 +4213,7 @@ int probe_impl(int source, int tag, CommObj *c, int *flag,
   std::unique_lock<std::mutex> lk(g.match_mu);
   while (true) {
     for (auto &m : g.unexpected) {
+      if (m.mhandle) continue;  // owned by a matched probe
       if (m.cid != c->cid_pt2pt) continue;
       if (src_world != MPI_ANY_SOURCE && m.src != src_world) continue;
       if (tag != MPI_ANY_TAG && m.tag != tag) continue;
@@ -4199,6 +4264,164 @@ int MPI_Iprobe(int source, int tag, MPI_Comm comm, int *flag,
     translate_status(c, status);
   }
   return rc;
+}
+
+// ----------------------------------------- matched probe (round 5)
+// mprobe.c family: Improbe EXTRACTS the first matching message from
+// the unexpected queue (marks it owned; ordinary matching skips it)
+// so a later Mrecv receives exactly that message — the thread-safe
+// probe+recv idiom.  A rendezvous-pending message is claimed at
+// Improbe time (the CTS goes out immediately); its payload lands in
+// place and Mrecv/Imrecv completes from there.
+
+static int64_t g_next_msg = 1;
+// extracted-message bookkeeping: mhandle -> owning comm handle
+static std::map<int64_t, int> g_msgs;
+
+int MPI_Improbe(int source, int tag, MPI_Comm comm, int *flag,
+                MPI_Message *message, MPI_Status *status) {
+  CommObj *c = lookup_comm(comm);
+  if (!c) return MPI_ERR_COMM;
+  if (source == MPI_PROC_NULL) {
+    *flag = 1;
+    *message = MPI_MESSAGE_NO_PROC;
+    empty_status(status);
+    if (status) status->MPI_SOURCE = MPI_PROC_NULL;
+    return MPI_SUCCESS;
+  }
+  int src_world = source == MPI_ANY_SOURCE ? MPI_ANY_SOURCE
+                                           : peer_world_of(*c, source);
+  if (source != MPI_ANY_SOURCE && src_world < 0) return MPI_ERR_ARG;
+  int64_t cts_src = -1, cts_rid = -1;
+  bool found = false;
+  {
+    std::lock_guard<std::mutex> lk(g.match_mu);
+    for (auto &m : g.unexpected) {
+      if (m.mhandle) continue;
+      if (m.cid != c->cid_pt2pt) continue;
+      if (src_world != MPI_ANY_SOURCE && m.src != src_world) continue;
+      if (tag != MPI_ANY_TAG && m.tag != tag) continue;
+      m.mhandle = g_next_msg++;
+      g_msgs[m.mhandle] = comm;
+      if (m.rndv_pending) {
+        // extraction IS the claim: release the sender; the bulk data
+        // fills this message in place (CTS goes out after the lock
+        // drops — the engine's ordering invariant)
+        cts_src = m.src;
+        cts_rid = m.rndv_id;
+      }
+      *message = (MPI_Message)m.mhandle;
+      if (status) {
+        status->MPI_SOURCE = (int)m.src;
+        status->MPI_TAG = (int)m.tag;
+        status->MPI_ERROR = MPI_SUCCESS;
+        status->_count = m.rndv_pending ? (long long)m.rndv_nbytes
+                                        : (long long)m.data.size();
+        status->_cancelled = 0;
+        translate_status(c, status);
+      }
+      found = true;
+      break;
+    }
+  }
+  if (found) {
+    if (cts_src >= 0) send_cts(cts_src, cts_rid);
+    *flag = 1;
+    return MPI_SUCCESS;
+  }
+  *flag = 0;
+  *message = MPI_MESSAGE_NULL;
+  return MPI_SUCCESS;
+}
+
+int MPI_Mprobe(int source, int tag, MPI_Comm comm, MPI_Message *message,
+               MPI_Status *status) {
+  while (true) {
+    int flag = 0;
+    int rc = MPI_Improbe(source, tag, comm, &flag, message, status);
+    if (rc != MPI_SUCCESS || flag) return rc;
+    std::unique_lock<std::mutex> lk(g.match_mu);
+    g.match_cv.wait_for(lk, std::chrono::milliseconds(100));
+    if (g.closing.load()) return MPI_ERR_OTHER;
+  }
+}
+
+int MPI_Imrecv(void *buf, int count, MPI_Datatype dt,
+               MPI_Message *message, MPI_Request *request) {
+  if (!message) return MPI_ERR_ARG;
+  if (*message == MPI_MESSAGE_NO_PROC) {
+    *message = MPI_MESSAGE_NULL;
+    Req *r0;
+    *request = make_completed_req(MPI_COMM_WORLD, &r0);
+    r0->status.MPI_SOURCE = MPI_PROC_NULL;  // the Irecv PROC_NULL shape
+    r0->status.MPI_TAG = MPI_ANY_TAG;
+    return MPI_SUCCESS;
+  }
+  if (*message == MPI_MESSAGE_NULL) return MPI_ERR_ARG;
+  DtView v;
+  if (!resolve_dtype(dt, v)) return MPI_ERR_TYPE;
+  auto gm = g_msgs.find(*message);
+  if (gm == g_msgs.end()) return MPI_ERR_ARG;
+  int comm = gm->second;
+  Req *r = new Req;
+  r->heap = true;
+  r->is_recv = true;
+  r->comm = comm;
+  r->user_buf = buf;
+  r->count = count;
+  size_t want;
+  char *land = prepare_landing(r, v, want);
+  int handle;
+  {
+    std::lock_guard<std::mutex> lk(g.match_mu);
+    handle = g.next_req++;
+    g.reqs[handle] = r;
+    Posted p{r, 0, MPI_ANY_SOURCE, MPI_ANY_TAG, land, want, v.di.item};
+    for (auto it = g.unexpected.begin(); it != g.unexpected.end();
+         ++it) {
+      if (it->mhandle != (int64_t)*message) continue;
+      if (it->rndv_pending) {
+        // payload still in flight: park the landing plan; the fill
+        // path (land_rndv_data) completes it
+        g_mrecv_wait[it->mhandle] = p;
+      } else {
+        deliver(p, *it);
+        g.unexpected.erase(it);
+      }
+      g_msgs.erase(gm);
+      *message = MPI_MESSAGE_NULL;
+      *request = handle;
+      return MPI_SUCCESS;
+    }
+  }
+  // extracted message vanished: only possible via engine teardown
+  {
+    std::lock_guard<std::mutex> lk(g.match_mu);
+    g.reqs.erase(handle);
+  }
+  delete r;
+  return MPI_ERR_OTHER;
+}
+
+int MPI_Mrecv(void *buf, int count, MPI_Datatype dt,
+              MPI_Message *message, MPI_Status *status) {
+  if (message && *message == MPI_MESSAGE_NO_PROC) {
+    *message = MPI_MESSAGE_NULL;
+    empty_status(status);
+    if (status) status->MPI_SOURCE = MPI_PROC_NULL;
+    return MPI_SUCCESS;
+  }
+  MPI_Request req;
+  int rc = MPI_Imrecv(buf, count, dt, message, &req);
+  if (rc != MPI_SUCCESS) return rc;
+  return MPI_Wait(&req, status);
+}
+
+MPI_Fint MPI_Message_c2f(MPI_Message message) {
+  return (MPI_Fint)message;
+}
+MPI_Message MPI_Message_f2c(MPI_Fint message) {
+  return (MPI_Message)message;
 }
 
 int MPI_Testany(int count, MPI_Request requests[], int *index, int *flag,
@@ -6072,10 +6295,10 @@ int MPI_Put(const void *origin_addr, int origin_count,
   int64_t disp = (int64_t)target_disp * w->disp_unit;
   int tw = world_of(c, target_rank);
   if (tw == g.rank) {
-    if (disp < 0 || disp + (int64_t)data.size() > w->size)
-      return MPI_ERR_ARG;
+    char *dst = win_dst(w, disp, (int64_t)data.size());
+    if (!dst) return MPI_ERR_ARG;
     std::lock_guard<std::mutex> lk(w->mu);
-    memcpy(w->base + disp, data.data(), data.size());
+    memcpy(dst, data.data(), data.size());
     return MPI_SUCCESS;
   }
   std::string t;
@@ -6122,10 +6345,10 @@ int MPI_Accumulate(const void *origin_addr, int origin_count,
   int tw = world_of(c, target_rank);
   int n = (int)(data.size() / tv.di.item);
   if (tw == g.rank) {
-    if (disp < 0 || disp + (int64_t)data.size() > w->size)
-      return MPI_ERR_ARG;
+    char *dst = win_dst(w, disp, (int64_t)data.size());
+    if (!dst) return MPI_ERR_ARG;
     std::lock_guard<std::mutex> lk(w->mu);
-    return reduce_buf(w->base + disp, data.data(), n,
+    return reduce_buf(dst, data.data(), n,
                       tv.derived ? tv.derived->base : target_datatype, op);
   }
   std::string t;
@@ -6169,9 +6392,10 @@ int MPI_Get(void *origin_addr, int origin_count,
   int tw = world_of(c, target_rank);
   std::vector<char> raw(nbytes);
   if (tw == g.rank) {
-    if (disp < 0 || disp + (int64_t)nbytes > w->size) return MPI_ERR_ARG;
+    char *src_p = win_dst(w, (int64_t)disp, (int64_t)nbytes);
+    if (!src_p) return MPI_ERR_ARG;
     std::lock_guard<std::mutex> lk(w->mu);
-    memcpy(raw.data(), w->base + disp, nbytes);
+    memcpy(raw.data(), src_p, nbytes);
   } else {
     // RPC: post the reply recv, send the request, wait (the epoch is
     // active-target, so a blocking get inside it is the natural shape)
@@ -6432,11 +6656,16 @@ int MPI_Win_fence(int /*assert_*/, MPI_Win win) {
   return c_barrier(w->comm);
 }
 
+void delete_win_attrs(int win);  // defined with the win tier 2 section
+
 int MPI_Win_free(MPI_Win *win) {
   if (!win) return MPI_ERR_ARG;
   int64_t wid;
   WinObj *w = lookup_win(*win, &wid);
   if (!w) return MPI_ERR_WIN;
+  // attribute delete callbacks run BEFORE the handle dies (the
+  // comm_free ordering, applied to windows)
+  delete_win_attrs(*win);
   // quiesce: a conforming program has fenced/unlocked, so after this
   // barrier no peer can still address the window
   int rc = c_barrier(w->comm);
@@ -6445,7 +6674,12 @@ int MPI_Win_free(MPI_Win *win) {
     g_wins.erase(wid);
     g_win_handles.erase(*win);
   }
-  if (w->owns_base) free(w->base);
+  if (w->shm) {
+    munmap(w->shm_map, w->shm_len);
+    if (w->comm.local_rank == 0) shm_unlink(w->shm_path.c_str());
+  } else if (w->owns_base) {
+    free(w->base);
+  }
   delete w;
   *win = MPI_WIN_NULL;
   return rc;
@@ -7202,6 +7436,321 @@ int MPI_Status_f2c(const MPI_Fint *f_status, MPI_Status *c_status) {
   c_status->_count =
       (long long)f_status[3] | ((long long)f_status[4] << 31);
   c_status->_cancelled = f_status[5];
+  return MPI_SUCCESS;
+}
+
+// --------------------------------------------- win tier 2 (round 5)
+// win_lock_all.c, win_sync.c, win_test.c, win_create_dynamic.c,
+// win_allocate_shared.c, win_create_keyval.c families.
+
+int MPI_Win_lock_all(int /*assert_*/, MPI_Win win) {
+  // a shared lock on every member: shared grants coexist, so the
+  // rank-ordered loop cannot deadlock (win_lock_all.c semantics)
+  WinObj *w = lookup_win(win);
+  if (!w) return MPI_ERR_WIN;
+  int n = (int)w->comm.group.size();
+  for (int r = 0; r < n; r++) {
+    int rc = MPI_Win_lock(MPI_LOCK_SHARED, r, 0, win);
+    if (rc != MPI_SUCCESS) return rc;
+  }
+  return MPI_SUCCESS;
+}
+
+int MPI_Win_unlock_all(MPI_Win win) {
+  WinObj *w = lookup_win(win);
+  if (!w) return MPI_ERR_WIN;
+  int n = (int)w->comm.group.size();
+  int first_err = MPI_SUCCESS;
+  for (int r = 0; r < n; r++) {
+    int rc = MPI_Win_unlock(r, win);
+    if (rc != MPI_SUCCESS && first_err == MPI_SUCCESS) first_err = rc;
+  }
+  return first_err;
+}
+
+int MPI_Win_flush_local(int rank, MPI_Win win) {
+  // every op packs its origin buffer into the wire frame AT CALL TIME
+  // (pack_origin / put_ndarray_1d copies), so local completion is
+  // immediate — the reference's osc_rdma distinguishes these; here
+  // local-flush is a no-op by construction
+  WinObj *w = lookup_win(win);
+  if (!w) return MPI_ERR_WIN;
+  CommObj &c = w->comm;
+  if (rank < 0 || rank >= (int)c.group.size()) return MPI_ERR_ARG;
+  return MPI_SUCCESS;
+}
+
+int MPI_Win_flush_local_all(MPI_Win win) {
+  WinObj *w = lookup_win(win);
+  if (!w) return MPI_ERR_WIN;
+  return MPI_SUCCESS;
+}
+
+int MPI_Win_sync(MPI_Win win) {
+  // win_sync.c: memory-barrier the public/private window copies; the
+  // shim's window IS process memory, so a hardware fence suffices
+  WinObj *w = lookup_win(win);
+  if (!w) return MPI_ERR_WIN;
+  std::atomic_thread_fence(std::memory_order_seq_cst);
+  return MPI_SUCCESS;
+}
+
+int MPI_Win_test(MPI_Win win, int *flag) {
+  // win_test.c: nonblocking Win_wait — consume whatever DONE
+  // notifications have arrived; the epoch closes when all are in
+  int64_t wid;
+  WinObj *w = lookup_win(win, &wid);
+  if (!w) return MPI_ERR_WIN;
+  if (!w->pscw_post_open) return MPI_ERR_ARG;
+  {
+    std::lock_guard<std::mutex> lk(g.match_mu);
+    for (auto it = w->pscw_post.begin(); it != w->pscw_post.end();) {
+      bool got = false;
+      for (auto u = g.unexpected.begin(); u != g.unexpected.end(); ++u) {
+        if (u->mhandle) continue;
+        if (u->cid == WIN_CID && u->src == *it &&
+            u->tag == PSCW_DONE_BASE + wid) {
+          g.unexpected.erase(u);
+          got = true;
+          break;
+        }
+      }
+      if (got) it = w->pscw_post.erase(it);
+      else ++it;
+    }
+  }
+  if (w->pscw_post.empty()) {
+    w->pscw_post_open = false;
+    *flag = 1;
+  } else {
+    *flag = 0;
+  }
+  return MPI_SUCCESS;
+}
+
+int MPI_Win_create_dynamic(MPI_Info info, MPI_Comm comm, MPI_Win *win) {
+  // win_create_dynamic.c: no storage at creation; Win_attach exposes
+  // regions, target_disp addresses absolute bytes (win_dst validates)
+  int rc = MPI_Win_create(nullptr, 0, 1, info, comm, win);
+  if (rc != MPI_SUCCESS) return rc;
+  lookup_win(*win)->dynamic = true;
+  return MPI_SUCCESS;
+}
+
+int MPI_Win_attach(MPI_Win win, void *base, MPI_Aint size) {
+  WinObj *w = lookup_win(win);
+  if (!w) return MPI_ERR_WIN;
+  if (!w->dynamic) return MPI_ERR_WIN;
+  if (!base || size < 0) return MPI_ERR_ARG;
+  std::lock_guard<std::mutex> lk(w->attach_mu);
+  w->attached.push_back({(uint64_t)(uintptr_t)base, (uint64_t)size});
+  return MPI_SUCCESS;
+}
+
+int MPI_Win_detach(MPI_Win win, const void *base) {
+  WinObj *w = lookup_win(win);
+  if (!w) return MPI_ERR_WIN;
+  if (!w->dynamic) return MPI_ERR_WIN;
+  std::lock_guard<std::mutex> lk(w->attach_mu);
+  for (auto it = w->attached.begin(); it != w->attached.end(); ++it)
+    if (it->first == (uint64_t)(uintptr_t)base) {
+      w->attached.erase(it);
+      return MPI_SUCCESS;
+    }
+  return MPI_ERR_ARG;
+}
+
+int MPI_Win_allocate_shared(MPI_Aint size, int disp_unit, MPI_Info info,
+                            MPI_Comm comm, void *baseptr, MPI_Win *win) {
+  // win_allocate_shared.c: one POSIX shm segment per window, every
+  // member maps the whole thing; rank r's slice starts at the sum of
+  // earlier sizes.  Requires same-host members (Comm_split_type's
+  // SHARED comm is the intended parent).
+  CommObj *c = lookup_comm(comm);
+  if (!c) return MPI_ERR_COMM;
+  if (size < 0 || disp_unit <= 0 || !baseptr) return MPI_ERR_ARG;
+  int n = (int)c->group.size();
+  std::vector<int64_t> mine = {(int64_t)size, (int64_t)disp_unit};
+  std::vector<int64_t> all(2 * (size_t)n);
+  int rc = c_allgather(*c, mine.data(), 2, MPI_LONG, all.data(), 2,
+                       MPI_LONG);
+  if (rc != MPI_SUCCESS) return rc;
+  std::vector<int64_t> sizes((size_t)n), offsets((size_t)n);
+  std::vector<int> units((size_t)n);
+  int64_t total = 0;
+  for (int r = 0; r < n; r++) {
+    sizes[(size_t)r] = all[2 * (size_t)r];
+    units[(size_t)r] = (int)all[2 * (size_t)r + 1];
+    offsets[(size_t)r] = total;
+    total += sizes[(size_t)r];
+  }
+  // deterministic segment name: every member computes the same (the
+  // same collapse as the wire win-id)
+  char path[128];
+  snprintf(path, sizeof path, "/zompi_shm_%s_%llx_%llu",
+           getenv("ZMPI_COORD_PORT") ? getenv("ZMPI_COORD_PORT") : "0",
+           (unsigned long long)c->cid_pt2pt,
+           (unsigned long long)c->win_seq);
+  size_t map_len = total > 0 ? (size_t)total : 1;
+  int fd;
+  if (c->local_rank == 0) {
+    shm_unlink(path);  // stale segment from a crashed job
+    fd = shm_open(path, O_CREAT | O_RDWR, 0600);
+    if (fd >= 0 && ftruncate(fd, (off_t)map_len) != 0) {
+      close(fd);
+      fd = -1;
+    }
+    rc = c_barrier(*c);  // segment exists before peers open
+  } else {
+    rc = c_barrier(*c);
+    fd = rc == MPI_SUCCESS ? shm_open(path, O_RDWR, 0600) : -1;
+  }
+  char *map = (char *)MAP_FAILED;
+  if (fd >= 0) {
+    map = (char *)mmap(nullptr, map_len, PROT_READ | PROT_WRITE,
+                       MAP_SHARED, fd, 0);
+    close(fd);
+  }
+  // agree on success: a lone failing rank (ENOSPC truncate, failed
+  // open/mmap) must produce a UNIFORM error, never a half-entered
+  // Win_create barrier (collective hang)
+  int64_t ok = (rc == MPI_SUCCESS && fd >= 0 && map != MAP_FAILED)
+                   ? 1 : 0;
+  std::vector<int64_t> oks((size_t)n);
+  int rc2 = c_allgather(*c, &ok, 1, MPI_LONG, oks.data(), 1, MPI_LONG);
+  bool all_ok = rc2 == MPI_SUCCESS;
+  for (int r = 0; all_ok && r < n; r++) all_ok = oks[(size_t)r] == 1;
+  if (!all_ok) {
+    if (map != MAP_FAILED) munmap(map, map_len);
+    if (c->local_rank == 0) shm_unlink(path);
+    return MPI_ERR_OTHER;
+  }
+  char *my_base = map + offsets[(size_t)c->local_rank];
+  rc = MPI_Win_create(size ? my_base : nullptr, size, disp_unit, info,
+                      comm, win);
+  if (rc != MPI_SUCCESS) {
+    munmap(map, map_len);
+    return rc;
+  }
+  WinObj *w = lookup_win(*win);
+  w->base = my_base;  // even a zero-size slice keeps its map position
+  w->shm = true;
+  w->shm_map = map;
+  w->shm_len = map_len;
+  w->shm_path = path;
+  w->shm_sizes = std::move(sizes);
+  w->shm_units = std::move(units);
+  w->shm_offsets = std::move(offsets);
+  *(void **)baseptr = my_base;
+  return MPI_SUCCESS;
+}
+
+int MPI_Win_shared_query(MPI_Win win, int rank, MPI_Aint *size,
+                         int *disp_unit, void *baseptr) {
+  WinObj *w = lookup_win(win);
+  if (!w) return MPI_ERR_WIN;
+  if (!w->shm) return MPI_ERR_WIN;
+  int n = (int)w->comm.group.size();
+  if (rank == MPI_PROC_NULL) {
+    // the lowest rank with a non-zero slice (win_shared_query.c)
+    rank = 0;
+    for (int r = 0; r < n; r++)
+      if (w->shm_sizes[(size_t)r] > 0) {
+        rank = r;
+        break;
+      }
+  }
+  if (rank < 0 || rank >= n) return MPI_ERR_ARG;
+  *size = (MPI_Aint)w->shm_sizes[(size_t)rank];
+  *disp_unit = w->shm_units[(size_t)rank];
+  *(void **)baseptr = w->shm_map + w->shm_offsets[(size_t)rank];
+  return MPI_SUCCESS;
+}
+
+// win attribute caching: the comm keyval machinery, instantiated for
+// windows (the reference shares one attribute engine; two small maps
+// reach the same behavior here)
+struct WinKeyvalObj {
+  MPI_Win_copy_attr_function *copy_fn;
+  MPI_Win_delete_attr_function *delete_fn;
+  void *extra_state;
+  bool freed = false;
+};
+static std::map<int, WinKeyvalObj> g_win_keyvals;
+static int g_next_win_keyval = 0;
+static std::map<std::pair<int, int>, void *> g_win_attrs;
+
+void delete_win_attrs(int win) {
+  for (auto it = g_win_attrs.begin(); it != g_win_attrs.end();) {
+    if (it->first.first == win) {
+      auto kv = g_win_keyvals.find(it->first.second);
+      if (kv != g_win_keyvals.end() && kv->second.delete_fn)
+        kv->second.delete_fn(win, it->first.second, it->second,
+                             kv->second.extra_state);
+      it = g_win_attrs.erase(it);
+    } else {
+      ++it;
+    }
+  }
+}
+
+int MPI_Win_create_keyval(MPI_Win_copy_attr_function *copy_fn,
+                          MPI_Win_delete_attr_function *delete_fn,
+                          int *keyval, void *extra_state) {
+  if (!keyval) return MPI_ERR_ARG;
+  int kv = g_next_win_keyval++;
+  g_win_keyvals[kv] = {copy_fn, delete_fn, extra_state};
+  *keyval = kv;
+  return MPI_SUCCESS;
+}
+
+int MPI_Win_free_keyval(int *keyval) {
+  if (!keyval) return MPI_ERR_ARG;
+  auto it = g_win_keyvals.find(*keyval);
+  if (it == g_win_keyvals.end()) return MPI_ERR_ARG;
+  it->second.freed = true;
+  bool referenced = false;
+  for (auto &e : g_win_attrs)
+    if (e.first.second == *keyval) referenced = true;
+  if (!referenced) g_win_keyvals.erase(it);
+  *keyval = MPI_KEYVAL_INVALID;
+  return MPI_SUCCESS;
+}
+
+int MPI_Win_set_attr(MPI_Win win, int keyval, void *attribute_val) {
+  if (!g_win_handles.count(win)) return MPI_ERR_WIN;
+  auto kv = g_win_keyvals.find(keyval);
+  if (kv == g_win_keyvals.end() || kv->second.freed) return MPI_ERR_ARG;
+  auto it = g_win_attrs.find({win, keyval});
+  if (it != g_win_attrs.end() && kv->second.delete_fn)
+    kv->second.delete_fn(win, keyval, it->second, kv->second.extra_state);
+  g_win_attrs[{win, keyval}] = attribute_val;
+  return MPI_SUCCESS;
+}
+
+int MPI_Win_get_attr(MPI_Win win, int keyval, void *attribute_val,
+                     int *flag) {
+  if (!g_win_handles.count(win)) return MPI_ERR_WIN;
+  auto it = g_win_attrs.find({win, keyval});
+  *flag = it != g_win_attrs.end() ? 1 : 0;
+  if (*flag) *(void **)attribute_val = it->second;
+  return MPI_SUCCESS;
+}
+
+int MPI_Win_delete_attr(MPI_Win win, int keyval) {
+  if (!g_win_handles.count(win)) return MPI_ERR_WIN;
+  auto it = g_win_attrs.find({win, keyval});
+  if (it == g_win_attrs.end()) return MPI_ERR_ARG;
+  auto kv = g_win_keyvals.find(keyval);
+  if (kv != g_win_keyvals.end() && kv->second.delete_fn)
+    kv->second.delete_fn(win, keyval, it->second, kv->second.extra_state);
+  g_win_attrs.erase(it);
+  if (kv != g_win_keyvals.end() && kv->second.freed) {
+    bool referenced = false;
+    for (auto &e : g_win_attrs)
+      if (e.first.second == keyval) referenced = true;
+    if (!referenced) g_win_keyvals.erase(kv);
+  }
   return MPI_SUCCESS;
 }
 

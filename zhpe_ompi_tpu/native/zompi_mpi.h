@@ -111,6 +111,7 @@ typedef int MPI_Info;
 typedef long long MPI_Aint;
 typedef int MPI_Win;
 typedef int MPI_File;
+typedef int MPI_Fint;
 
 #define MPI_ANY_SOURCE (-1)
 #define MPI_ANY_TAG    (-1)
@@ -300,6 +301,23 @@ int MPI_Probe(int source, int tag, MPI_Comm comm, MPI_Status *status);
 int MPI_Iprobe(int source, int tag, MPI_Comm comm, int *flag,
                MPI_Status *status);
 
+/* matched probe (mprobe.c family): extract a specific message from
+ * the unexpected queue and receive exactly it later — the thread-safe
+ * probe+recv idiom */
+typedef int MPI_Message;
+#define MPI_MESSAGE_NULL    (-1)
+#define MPI_MESSAGE_NO_PROC (-2)
+int MPI_Mprobe(int source, int tag, MPI_Comm comm, MPI_Message *message,
+               MPI_Status *status);
+int MPI_Improbe(int source, int tag, MPI_Comm comm, int *flag,
+                MPI_Message *message, MPI_Status *status);
+int MPI_Mrecv(void *buf, int count, MPI_Datatype dt,
+              MPI_Message *message, MPI_Status *status);
+int MPI_Imrecv(void *buf, int count, MPI_Datatype dt,
+               MPI_Message *message, MPI_Request *request);
+MPI_Fint MPI_Message_c2f(MPI_Message message);
+MPI_Message MPI_Message_f2c(MPI_Fint message);
+
 /* collectives */
 int MPI_Barrier(MPI_Comm comm);
 int MPI_Bcast(void *buf, int count, MPI_Datatype dt, int root,
@@ -456,7 +474,6 @@ int MPI_File_get_group(MPI_File fh, MPI_Group *group);
 /* Fortran handle conversion (comm_c2f.c family): handles are ints on
  * both sides, so conversions are the identity — the surface exists so
  * tooling written against mpi.h compiles */
-typedef int MPI_Fint;
 #define MPI_F_STATUS_SIZE 6
 MPI_Fint MPI_Comm_c2f(MPI_Comm comm);
 MPI_Comm MPI_Comm_f2c(MPI_Fint comm);
@@ -772,6 +789,45 @@ int MPI_Compare_and_swap(const void *origin_addr,
                          const void *compare_addr, void *result_addr,
                          MPI_Datatype dt, int target_rank,
                          MPI_Aint target_disp, MPI_Win win);
+
+/* win tier 2 (win_lock_all.c, win_sync.c, win_test.c,
+ * win_create_dynamic.c, win_allocate_shared.c families) */
+#define MPI_MODE_NOCHECK   1024
+#define MPI_MODE_NOSTORE   2048
+#define MPI_MODE_NOPUT     4096
+#define MPI_MODE_NOPRECEDE 8192
+#define MPI_MODE_NOSUCCEED 16384
+int MPI_Win_lock_all(int assert_, MPI_Win win);
+int MPI_Win_unlock_all(MPI_Win win);
+int MPI_Win_flush_local(int rank, MPI_Win win);
+int MPI_Win_flush_local_all(MPI_Win win);
+int MPI_Win_sync(MPI_Win win);
+int MPI_Win_test(MPI_Win win, int *flag);
+int MPI_Win_create_dynamic(MPI_Info info, MPI_Comm comm, MPI_Win *win);
+int MPI_Win_attach(MPI_Win win, void *base, MPI_Aint size);
+int MPI_Win_detach(MPI_Win win, const void *base);
+int MPI_Win_allocate_shared(MPI_Aint size, int disp_unit, MPI_Info info,
+                            MPI_Comm comm, void *baseptr, MPI_Win *win);
+int MPI_Win_shared_query(MPI_Win win, int rank, MPI_Aint *size,
+                         int *disp_unit, void *baseptr);
+
+/* win attribute caching (win_create_keyval.c family) */
+typedef int MPI_Win_copy_attr_function(MPI_Win oldwin, int keyval,
+                                       void *extra_state,
+                                       void *attribute_val_in,
+                                       void *attribute_val_out,
+                                       int *flag);
+typedef int MPI_Win_delete_attr_function(MPI_Win win, int keyval,
+                                         void *attribute_val,
+                                         void *extra_state);
+int MPI_Win_create_keyval(MPI_Win_copy_attr_function *copy_fn,
+                          MPI_Win_delete_attr_function *delete_fn,
+                          int *keyval, void *extra_state);
+int MPI_Win_free_keyval(int *keyval);
+int MPI_Win_set_attr(MPI_Win win, int keyval, void *attribute_val);
+int MPI_Win_get_attr(MPI_Win win, int keyval, void *attribute_val,
+                     int *flag);
+int MPI_Win_delete_attr(MPI_Win win, int keyval);
 
 #ifdef __cplusplus
 }
