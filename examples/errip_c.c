@@ -1,0 +1,205 @@
+/* errip_c.c — round-5 errhandler + MPI_IN_PLACE acceptance.
+ * Errhandlers: ERRORS_RETURN flips a fatal default into returned
+ * codes; a user handler observes the (comm, code) pair; Comm_call_
+ * errhandler dispatches explicitly; win/file handler surface
+ * round-trips.  IN_PLACE: allreduce, reduce(root), allgather(v),
+ * gather, scatter, alltoall, reduce_scatter_block, scan.  Reference
+ * shapes: ompi/mpi/c/{comm_create_errhandler,comm_set_errhandler,
+ * comm_call_errhandler,errhandler_free}.c and the ch.5 IN_PLACE
+ * bindings.  Run with >= 2 ranks. */
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include "zompi_mpi.h"
+
+#define CHECK(cond)                                                    \
+  do {                                                                 \
+    if (!(cond)) {                                                     \
+      fprintf(stderr, "FAIL %s:%d: %s\n", __FILE__, __LINE__, #cond);  \
+      MPI_Abort(MPI_COMM_WORLD, 2);                                    \
+    }                                                                  \
+  } while (0)
+
+static int seen_code = -1;
+static MPI_Comm seen_comm = MPI_COMM_NULL;
+static void my_handler(MPI_Comm *comm, int *code, ...) {
+  seen_comm = *comm;
+  seen_code = *code;
+}
+
+int main(int argc, char **argv) {
+  CHECK(MPI_Init(&argc, &argv) == MPI_SUCCESS);
+  int rank, size;
+  MPI_Comm_rank(MPI_COMM_WORLD, &rank);
+  MPI_Comm_size(MPI_COMM_WORLD, &size);
+  CHECK(size >= 2);
+
+  /* default is ARE_FATAL (the MPI default) */
+  MPI_Errhandler eh = MPI_ERRHANDLER_NULL;
+  CHECK(MPI_Comm_get_errhandler(MPI_COMM_WORLD, &eh) == MPI_SUCCESS);
+  CHECK(eh == MPI_ERRORS_ARE_FATAL);
+
+  /* ERRORS_RETURN hands codes back */
+  CHECK(MPI_Comm_set_errhandler(MPI_COMM_WORLD, MPI_ERRORS_RETURN) ==
+        MPI_SUCCESS);
+  CHECK(MPI_Send(NULL, 0, MPI_INT, size + 7, 0, MPI_COMM_WORLD) ==
+        MPI_ERR_ARG);
+  CHECK(MPI_Send(NULL, 0, MPI_INT, 0, -3, MPI_COMM_WORLD) ==
+        MPI_ERR_ARG);
+
+  /* a user handler observes the dispatch */
+  MPI_Errhandler uh;
+  CHECK(MPI_Comm_create_errhandler(my_handler, &uh) == MPI_SUCCESS);
+  CHECK(MPI_Comm_set_errhandler(MPI_COMM_WORLD, uh) == MPI_SUCCESS);
+  CHECK(MPI_Comm_get_errhandler(MPI_COMM_WORLD, &eh) == MPI_SUCCESS &&
+        eh == uh);
+  CHECK(MPI_Send(NULL, 0, MPI_INT, size + 7, 0, MPI_COMM_WORLD) ==
+        MPI_ERR_ARG);
+  CHECK(seen_code == MPI_ERR_ARG && seen_comm == MPI_COMM_WORLD);
+  seen_code = -1;
+  CHECK(MPI_Comm_call_errhandler(MPI_COMM_WORLD, MPI_ERR_OP) ==
+        MPI_SUCCESS);
+  CHECK(seen_code == MPI_ERR_OP);
+  CHECK(MPI_Errhandler_free(&uh) == MPI_SUCCESS &&
+        uh == MPI_ERRHANDLER_NULL);
+  /* MPI-3.1 8.3.4: the freed handler stays in effect while WORLD
+   * still references it */
+  seen_code = -1;
+  CHECK(MPI_Send(NULL, 0, MPI_INT, size + 7, 0, MPI_COMM_WORLD) ==
+        MPI_ERR_ARG);
+  CHECK(seen_code == MPI_ERR_ARG);
+  CHECK(MPI_Comm_set_errhandler(MPI_COMM_WORLD, MPI_ERRORS_RETURN) ==
+        MPI_SUCCESS);
+  /* a freed handler id is not settable again */
+  CHECK(MPI_Comm_set_errhandler(MPI_COMM_WORLD, 0x10) == MPI_ERR_ARG);
+
+  /* deprecated MPI-1 names reach the same machinery */
+  CHECK(MPI_Errhandler_get(MPI_COMM_WORLD, &eh) == MPI_SUCCESS &&
+        eh == MPI_ERRORS_RETURN);
+
+  /* file handlers default to ERRORS_RETURN */
+  {
+    char path[256];
+    snprintf(path, sizeof path, "/tmp/zompi_errip_%s.bin",
+             getenv("ZMPI_COORD_PORT") ? getenv("ZMPI_COORD_PORT") : "0");
+    MPI_File fh;
+    CHECK(MPI_File_open(MPI_COMM_WORLD, path,
+                        MPI_MODE_CREATE | MPI_MODE_RDWR, MPI_INFO_NULL,
+                        &fh) == MPI_SUCCESS);
+    CHECK(MPI_File_get_errhandler(fh, &eh) == MPI_SUCCESS &&
+          eh == MPI_ERRORS_RETURN);
+    CHECK(MPI_File_set_errhandler(fh, MPI_ERRORS_RETURN) == MPI_SUCCESS);
+    CHECK(MPI_File_close(&fh) == MPI_SUCCESS);
+    if (rank == 0) MPI_File_delete(path, MPI_INFO_NULL);
+  }
+
+  /* ---- IN_PLACE collectives ---- */
+  int n = size;
+
+  /* allreduce */
+  long ar = rank + 1;
+  CHECK(MPI_Allreduce(MPI_IN_PLACE, &ar, 1, MPI_LONG, MPI_SUM,
+                      MPI_COMM_WORLD) == MPI_SUCCESS);
+  CHECK(ar == (long)n * (n + 1) / 2);
+
+  /* reduce at root */
+  long rv = 10 * (rank + 1);
+  if (rank == 0) {
+    CHECK(MPI_Reduce(MPI_IN_PLACE, &rv, 1, MPI_LONG, MPI_SUM, 0,
+                     MPI_COMM_WORLD) == MPI_SUCCESS);
+    CHECK(rv == 10L * n * (n + 1) / 2);
+  } else {
+    CHECK(MPI_Reduce(&rv, NULL, 1, MPI_LONG, MPI_SUM, 0,
+                     MPI_COMM_WORLD) == MPI_SUCCESS);
+  }
+
+  /* allgather */
+  int *ag = malloc(sizeof(int) * (size_t)n);
+  for (int i = 0; i < n; i++) ag[i] = -1;
+  ag[rank] = 500 + rank;
+  CHECK(MPI_Allgather(MPI_IN_PLACE, 0, MPI_DATATYPE_NULL, ag, 1,
+                      MPI_INT, MPI_COMM_WORLD) == MPI_SUCCESS);
+  for (int i = 0; i < n; i++) CHECK(ag[i] == 500 + i);
+
+  /* allgatherv with shifted displacements */
+  int *agv = malloc(sizeof(int) * (size_t)(2 * n));
+  int *cnts = malloc(sizeof(int) * (size_t)n);
+  int *disp = malloc(sizeof(int) * (size_t)n);
+  for (int i = 0; i < 2 * n; i++) agv[i] = -1;
+  for (int i = 0; i < n; i++) {
+    cnts[i] = 1;
+    disp[i] = 2 * i + 1; /* odd slots */
+  }
+  agv[2 * rank + 1] = 900 + rank;
+  CHECK(MPI_Allgatherv(MPI_IN_PLACE, 0, MPI_DATATYPE_NULL, agv, cnts,
+                       disp, MPI_INT, MPI_COMM_WORLD) == MPI_SUCCESS);
+  for (int i = 0; i < n; i++) {
+    CHECK(agv[2 * i + 1] == 900 + i);
+    CHECK(agv[2 * i] == -1); /* gaps untouched */
+  }
+
+  /* gather at root */
+  int *gb = malloc(sizeof(int) * (size_t)n);
+  if (rank == 0) {
+    for (int i = 0; i < n; i++) gb[i] = -1;
+    gb[0] = 700;
+    CHECK(MPI_Gather(MPI_IN_PLACE, 0, MPI_DATATYPE_NULL, gb, 1, MPI_INT,
+                     0, MPI_COMM_WORLD) == MPI_SUCCESS);
+    for (int i = 0; i < n; i++) CHECK(gb[i] == 700 + i);
+  } else {
+    int me = 700 + rank;
+    CHECK(MPI_Gather(&me, 1, MPI_INT, NULL, 0, MPI_DATATYPE_NULL, 0,
+                     MPI_COMM_WORLD) == MPI_SUCCESS);
+  }
+
+  /* scatter with IN_PLACE recvbuf at root */
+  if (rank == 0) {
+    int *sb = malloc(sizeof(int) * (size_t)n);
+    for (int i = 0; i < n; i++) sb[i] = 300 + i;
+    CHECK(MPI_Scatter(sb, 1, MPI_INT, MPI_IN_PLACE, 0,
+                      MPI_DATATYPE_NULL, 0, MPI_COMM_WORLD) ==
+          MPI_SUCCESS);
+    CHECK(sb[0] == 300); /* root's slice untouched, stays in sendbuf */
+    free(sb);
+  } else {
+    int got = -1;
+    CHECK(MPI_Scatter(NULL, 0, MPI_DATATYPE_NULL, &got, 1, MPI_INT, 0,
+                      MPI_COMM_WORLD) == MPI_SUCCESS);
+    CHECK(got == 300 + rank);
+  }
+
+  /* alltoall */
+  int *aa = malloc(sizeof(int) * (size_t)n);
+  for (int i = 0; i < n; i++) aa[i] = rank * 1000 + i;
+  CHECK(MPI_Alltoall(MPI_IN_PLACE, 0, MPI_DATATYPE_NULL, aa, 1, MPI_INT,
+                     MPI_COMM_WORLD) == MPI_SUCCESS);
+  for (int i = 0; i < n; i++) CHECK(aa[i] == i * 1000 + rank);
+
+  /* reduce_scatter_block */
+  long *rsb = malloc(sizeof(long) * (size_t)n);
+  for (int i = 0; i < n; i++) rsb[i] = rank + i;
+  CHECK(MPI_Reduce_scatter_block(MPI_IN_PLACE, rsb, 1, MPI_LONG,
+                                 MPI_SUM, MPI_COMM_WORLD) ==
+        MPI_SUCCESS);
+  /* block r holds sum over ranks of (rank + r) */
+  CHECK(rsb[0] == (long)n * (n - 1) / 2 + (long)n * rank);
+
+  /* scan */
+  long sc = rank + 1;
+  CHECK(MPI_Scan(MPI_IN_PLACE, &sc, 1, MPI_LONG, MPI_SUM,
+                 MPI_COMM_WORLD) == MPI_SUCCESS);
+  CHECK(sc == (long)(rank + 1) * (rank + 2) / 2);
+
+  free(ag);
+  free(agv);
+  free(cnts);
+  free(disp);
+  free(gb);
+  free(aa);
+  free(rsb);
+
+  MPI_Barrier(MPI_COMM_WORLD);
+  if (rank == 0) printf("errip_c OK on %d ranks\n", size);
+  MPI_Finalize();
+  return 0;
+}

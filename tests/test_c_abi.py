@@ -155,6 +155,41 @@ class TestPureC:
                             timeout=90)
         assert f"winadv_c OK on {n} ranks" in outs[0]
 
+    @pytest.mark.parametrize("n", [2, 4])
+    def test_errip_example(self, shim, tmp_path_factory, n):
+        """Round-5 errhandlers + MPI_IN_PLACE: ERRORS_RETURN flips the
+        fatal default, a user handler observes (comm, code),
+        Comm_call_errhandler dispatches, file handlers default to
+        ERRORS_RETURN; IN_PLACE across allreduce/reduce/allgather(v)/
+        gather/scatter/alltoall/reduce_scatter_block/scan."""
+        outs = _run_example(shim, tmp_path_factory, "errip_c.c", n)
+        assert f"errip_c OK on {n} ranks" in outs[0]
+
+    def test_are_fatal_default_aborts(self, shim, tmp_path):
+        """The MPI default handler is ERRORS_ARE_FATAL: an invalid-rank
+        send without an installed handler must kill the process with a
+        diagnostic, not return a code."""
+        src = tmp_path / "fatal.c"
+        src.write_text(
+            '#include "zompi_mpi.h"\n'
+            "#include <stdio.h>\n"
+            "int main(int argc, char **argv) {\n"
+            "  MPI_Init(&argc, &argv);\n"
+            "  int x = 0;\n"
+            "  MPI_Send(&x, 1, MPI_INT, 99, 0, MPI_COMM_WORLD);\n"
+            '  printf("unreachable\\n");\n'
+            "  MPI_Finalize();\n"
+            "  return 0;\n"
+            "}\n")
+        binp = tmp_path / "fatal"
+        _compile_c(shim, src, binp)
+        port = _free_port()
+        p = subprocess.run([str(binp)], env=_env(0, 1, port),
+                           capture_output=True, text=True, timeout=30)
+        assert p.returncode != 0
+        assert "MPI_ERRORS_ARE_FATAL" in p.stderr
+        assert "unreachable" not in p.stdout
+
 
 class TestInterop:
     def test_c_rank_joins_python_universe(self, shim, tmp_path):
@@ -1780,7 +1815,9 @@ int main(int argc, char **argv) {
     if (got != (long)lrank * 11) return 9;
     MPI_Send(&v, 1, MPI_LONG, lrank, 6, inter);
   }
-  /* collectives are an intra surface: loudly rejected here */
+  /* collectives are an intra surface: loudly rejected here (install
+   * ERRORS_RETURN first — the default handler is ARE_FATAL) */
+  MPI_Comm_set_errhandler(inter, MPI_ERRORS_RETURN);
   long s1 = 1, s2 = 0;
   if (MPI_Allreduce(&s1, &s2, 1, MPI_LONG, MPI_SUM, inter)
       != MPI_ERR_COMM) return 10;

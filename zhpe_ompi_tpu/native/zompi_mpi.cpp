@@ -2236,6 +2236,72 @@ int c_alltoallv(CommObj &c, const void *sendbuf, const int sendcounts[],
 
 }  // namespace
 
+// ----------------------------------------------- error handlers core
+// comm_create_errhandler.c family.  The comm plane dispatches through
+// the installed handler at the pt2pt/collective entry points; win and
+// file carry the full surface (create/set/get/call) with their MPI
+// defaults (windows: ARE_FATAL, files: ERRORS_RETURN).
+
+struct ErrhObj {
+  int kind;  // 0 comm, 1 win, 2 file
+  void *fn;
+  // MPI-3.1 8.3.4: a freed handler stays in effect while any object
+  // still references it; the object tables below hold the references
+  bool freed = false;
+};
+std::map<int, ErrhObj> g_errhandlers;
+int g_next_errh = 0x10;  // 0 = ARE_FATAL, 1 = ERRORS_RETURN
+std::map<int, int> g_comm_errh, g_win_errh, g_file_errh;
+
+bool errh_referenced(int h) {
+  for (auto &e : g_comm_errh)
+    if (e.second == h) return true;
+  for (auto &e : g_win_errh)
+    if (e.second == h) return true;
+  for (auto &e : g_file_errh)
+    if (e.second == h) return true;
+  return false;
+}
+
+void reap_errh(int h) {
+  if (h < 0x10) return;
+  auto it = g_errhandlers.find(h);
+  if (it != g_errhandlers.end() && it->second.freed &&
+      !errh_referenced(h))
+    g_errhandlers.erase(it);
+}
+
+// drop an object's handler reference (object free/close paths)
+void release_errh_ref(std::map<int, int> &table, int handle) {
+  auto it = table.find(handle);
+  if (it == table.end()) return;
+  int h = it->second;
+  table.erase(it);
+  reap_errh(h);
+}
+
+// a handler id is settable iff predefined or a live entry of `kind`
+bool valid_errh(int h, int kind) {
+  if (h == 0 /*ARE_FATAL*/ || h == 1 /*ERRORS_RETURN*/) return true;
+  auto it = g_errhandlers.find(h);
+  return it != g_errhandlers.end() && !it->second.freed &&
+         it->second.kind == kind;
+}
+
+int errh_of_comm(int comm) {
+  auto it = g_comm_errh.find(comm);
+  if (it != g_comm_errh.end()) return it->second;
+  // unset comms fall back to WORLD's handler (the reference inherits
+  // from the parent at creation; the WORLD fallback reaches the same
+  // observable behavior for the common set-on-WORLD idiom)
+  it = g_comm_errh.find(0 /* MPI_COMM_WORLD */);
+  return it != g_comm_errh.end() ? it->second : 0 /* ARE_FATAL */;
+}
+
+// defined after the ABI (needs MPI_Error_string); the definition sits
+// inside the extern "C" block, so the declaration matches that linkage
+extern "C" int dispatch_comm_err(int comm, int code);
+
 // ------------------------------------------------------------ C ABI
 
 // thread-level / finalized bookkeeping (init_thread.c, finalized.c);
@@ -2246,6 +2312,9 @@ static std::thread::id g_main_tid;
 static int g_thread_level = 0;  // MPI_THREAD_SINGLE
 
 extern "C" {
+
+// the MPI_IN_PLACE sentinel (never dereferenced; identity by address)
+char zompi_in_place_[1];
 
 int MPI_Init(int *, char ***) {
   if (g.initialized) return MPI_ERR_OTHER;
@@ -2494,6 +2563,11 @@ int MPI_Finalize(void) {
   g_next_dtype = DERIVED_BASE;
   extern void clear_info_naming_state(void);
   clear_info_naming_state();
+  g_errhandlers.clear();
+  g_next_errh = 0x10;
+  g_comm_errh.clear();
+  g_win_errh.clear();
+  g_file_errh.clear();
   g.initialized = false;
   g_finalized_flag = true;
   return MPI_SUCCESS;
@@ -2721,6 +2795,7 @@ int MPI_Comm_free(MPI_Comm *comm) {
   if (!g_comms.count(*comm)) return MPI_ERR_COMM;
   // delete callbacks run BEFORE the handle dies (comm_free.c order)
   delete_comm_attrs(*comm);
+  release_errh_ref(g_comm_errh, *comm);
   g_comms.erase(*comm);
   *comm = MPI_COMM_NULL;
   return MPI_SUCCESS;
@@ -2912,12 +2987,14 @@ int MPI_Comm_compare(MPI_Comm comm1, MPI_Comm comm2, int *result) {
 int MPI_Send(const void *buf, int count, MPI_Datatype dt, int dest,
              int tag, MPI_Comm comm) {
   CommObj *c = lookup_comm(comm);
-  if (!c) return MPI_ERR_COMM;
+  if (!c) return dispatch_comm_err(comm, MPI_ERR_COMM);
   if (dest == MPI_PROC_NULL) return MPI_SUCCESS;
-  if (tag < 0) return MPI_ERR_ARG;
-  if (dest < 0 || dest >= (int)peer_group(*c).size()) return MPI_ERR_ARG;
-  return raw_send(buf, count, dt, peer_world_of(*c, dest), tag,
-                  c->cid_pt2pt, /*allow_rndv=*/true);
+  if (tag < 0) return dispatch_comm_err(comm, MPI_ERR_ARG);
+  if (dest < 0 || dest >= (int)peer_group(*c).size())
+    return dispatch_comm_err(comm, MPI_ERR_ARG);
+  return dispatch_comm_err(
+      comm, raw_send(buf, count, dt, peer_world_of(*c, dest), tag,
+                     c->cid_pt2pt, /*allow_rndv=*/true));
 }
 
 static int make_completed_req(MPI_Comm comm, Req **out = nullptr);
@@ -3052,24 +3129,26 @@ static int translate_status(CommObj *c, MPI_Status *status) {
 int MPI_Recv(void *buf, int count, MPI_Datatype dt, int source, int tag,
              MPI_Comm comm, MPI_Status *status) {
   CommObj *c = lookup_comm(comm);
-  if (!c) return MPI_ERR_COMM;
+  if (!c) return dispatch_comm_err(comm, MPI_ERR_COMM);
   if (source == MPI_PROC_NULL) {
     empty_status(status, MPI_PROC_NULL);
     return MPI_SUCCESS;
   }
   DtView v;
-  if (!resolve_dtype(dt, v)) return MPI_ERR_TYPE;
+  if (!resolve_dtype(dt, v))
+    return dispatch_comm_err(comm, MPI_ERR_TYPE);
   int src_world = source == MPI_ANY_SOURCE
                       ? MPI_ANY_SOURCE
                       : peer_world_of(*c, source);
-  if (source != MPI_ANY_SOURCE && src_world < 0) return MPI_ERR_ARG;
+  if (source != MPI_ANY_SOURCE && src_world < 0)
+    return dispatch_comm_err(comm, MPI_ERR_ARG);
   MPI_Status st{};
   int rc = raw_recv(buf, count, dt, src_world, tag, c->cid_pt2pt, &st);
   if (status) {
     *status = st;
     translate_status(c, status);
   }
-  return rc;
+  return dispatch_comm_err(comm, rc);
 }
 
 int MPI_Get_count(const MPI_Status *status, MPI_Datatype dt, int *count) {
@@ -3149,21 +3228,23 @@ int MPI_Isend(const void *buf, int count, MPI_Datatype dt, int dest,
   // the crossed-Isend idiom MPI guarantees): the request completes when
   // the bulk push lands, exactly pml_ob1's progressed send request.
   CommObj *c = lookup_comm(comm);
-  if (!c) return MPI_ERR_COMM;
+  if (!c) return dispatch_comm_err(comm, MPI_ERR_COMM);
   int rc = MPI_SUCCESS;
   if (dest != MPI_PROC_NULL) {
-    if (tag < 0) return MPI_ERR_ARG;
+    if (tag < 0) return dispatch_comm_err(comm, MPI_ERR_ARG);
     if (dest < 0 || dest >= (int)peer_group(*c).size())
-      return MPI_ERR_ARG;
+      return dispatch_comm_err(comm, MPI_ERR_ARG);
     DtView v;
-    if (!resolve_dtype(dt, v)) return MPI_ERR_TYPE;
+    if (!resolve_dtype(dt, v))
+      return dispatch_comm_err(comm, MPI_ERR_TYPE);
     int64_t nbytes =
         (int64_t)count * v.elems_per_item() * (int64_t)v.di.item;
     if (nbytes > g.eager_limit)
-      return isend_rndv(buf, count, v, dest, tag, comm, c, request);
+      return dispatch_comm_err(
+          comm, isend_rndv(buf, count, v, dest, tag, comm, c, request));
     rc = raw_send(buf, count, dt, peer_world_of(*c, dest), tag,
                   c->cid_pt2pt, /*allow_rndv=*/true);
-    if (rc) return rc;
+    if (rc) return dispatch_comm_err(comm, rc);
   }
   *request = make_completed_req(comm);
   return rc;
@@ -3172,9 +3253,10 @@ int MPI_Isend(const void *buf, int count, MPI_Datatype dt, int dest,
 int MPI_Irecv(void *buf, int count, MPI_Datatype dt, int source, int tag,
               MPI_Comm comm, MPI_Request *request) {
   CommObj *c = lookup_comm(comm);
-  if (!c) return MPI_ERR_COMM;
+  if (!c) return dispatch_comm_err(comm, MPI_ERR_COMM);
   DtView v;
-  if (!resolve_dtype(dt, v)) return MPI_ERR_TYPE;
+  if (!resolve_dtype(dt, v))
+    return dispatch_comm_err(comm, MPI_ERR_TYPE);
   if (source == MPI_PROC_NULL) {
     Req *r;
     int handle = make_completed_req(comm, &r);
@@ -3186,7 +3268,8 @@ int MPI_Irecv(void *buf, int count, MPI_Datatype dt, int source, int tag,
   int src_world = source == MPI_ANY_SOURCE
                       ? MPI_ANY_SOURCE
                       : peer_world_of(*c, source);
-  if (source != MPI_ANY_SOURCE && src_world < 0) return MPI_ERR_ARG;
+  if (source != MPI_ANY_SOURCE && src_world < 0)
+    return dispatch_comm_err(comm, MPI_ERR_ARG);
   Req *r = new Req;
   r->is_recv = true;
   r->heap = true;
@@ -3412,69 +3495,166 @@ int MPI_Sendrecv(const void *sendbuf, int sendcount, MPI_Datatype sendtype,
 
 int MPI_Barrier(MPI_Comm comm) {
   CommObj *c = lookup_comm(comm);
-  if (!c) return MPI_ERR_COMM;
-  return c_barrier(*c);
+  if (!c) return dispatch_comm_err(comm, MPI_ERR_COMM);
+  return dispatch_comm_err(comm, c_barrier(*c));
 }
 
 int MPI_Bcast(void *buf, int count, MPI_Datatype dt, int root,
               MPI_Comm comm) {
   CommObj *c = lookup_comm(comm);
-  if (!c) return MPI_ERR_COMM;
-  if (root < 0 || root >= (int)c->group.size()) return MPI_ERR_ARG;
-  return c_bcast(*c, buf, count, dt, root, 0x7E01);
+  if (!c) return dispatch_comm_err(comm, MPI_ERR_COMM);
+  if (root < 0 || root >= (int)c->group.size())
+    return dispatch_comm_err(comm, MPI_ERR_ARG);
+  return dispatch_comm_err(comm, c_bcast(*c, buf, count, dt, root,
+                                         0x7E01));
+}
+
+// IN_PLACE substitution (MPI-3.1 ch.5): clone the receive-side
+// contribution into an extent-layout temp via pack/unpack — pack
+// touches only typemap bytes, so the clone never overreads a strided
+// type's trailing gap
+static int clone_region(const void *src, int count, MPI_Datatype dt,
+                        std::vector<char> &tmp) {
+  DtView v;
+  if (!resolve_dtype(dt, v)) return MPI_ERR_TYPE;
+  std::vector<char> packed;
+  pack_dtype(src, count, v, packed);
+  tmp.assign(slot_bytes(v, count), 0);
+  unpack_dtype(tmp.data(), count, v, packed.data(), packed.size());
+  return MPI_SUCCESS;
 }
 
 int MPI_Allreduce(const void *sendbuf, void *recvbuf, int count,
                   MPI_Datatype dt, MPI_Op op, MPI_Comm comm) {
   CommObj *c = lookup_comm(comm);
-  if (!c) return MPI_ERR_COMM;
-  return c_allreduce(*c, sendbuf, recvbuf, count, dt, op);
+  if (!c) return dispatch_comm_err(comm, MPI_ERR_COMM);
+  std::vector<char> tmp;
+  if (sendbuf == MPI_IN_PLACE) {
+    int rc = clone_region(recvbuf, count, dt, tmp);
+    if (rc != MPI_SUCCESS) return dispatch_comm_err(comm, rc);
+    sendbuf = tmp.data();
+  }
+  return dispatch_comm_err(
+      comm, c_allreduce(*c, sendbuf, recvbuf, count, dt, op));
 }
 
 int MPI_Reduce(const void *sendbuf, void *recvbuf, int count,
                MPI_Datatype dt, MPI_Op op, int root, MPI_Comm comm) {
   CommObj *c = lookup_comm(comm);
-  if (!c) return MPI_ERR_COMM;
-  if (root < 0 || root >= (int)c->group.size()) return MPI_ERR_ARG;
-  return c_reduce(*c, sendbuf, recvbuf, count, dt, op, root);
+  if (!c) return dispatch_comm_err(comm, MPI_ERR_COMM);
+  if (root < 0 || root >= (int)c->group.size())
+    return dispatch_comm_err(comm, MPI_ERR_ARG);
+  std::vector<char> tmp;
+  if (sendbuf == MPI_IN_PLACE) {
+    // IN_PLACE is legal at the ROOT only (reduce.c)
+    if (c->local_rank != root)
+      return dispatch_comm_err(comm, MPI_ERR_ARG);
+    int rc = clone_region(recvbuf, count, dt, tmp);
+    if (rc != MPI_SUCCESS) return dispatch_comm_err(comm, rc);
+    sendbuf = tmp.data();
+  }
+  return dispatch_comm_err(
+      comm, c_reduce(*c, sendbuf, recvbuf, count, dt, op, root));
 }
 
 int MPI_Gather(const void *sendbuf, int sendcount, MPI_Datatype sendtype,
                void *recvbuf, int recvcount, MPI_Datatype recvtype,
                int root, MPI_Comm comm) {
   CommObj *c = lookup_comm(comm);
-  if (!c) return MPI_ERR_COMM;
-  if (root < 0 || root >= (int)c->group.size()) return MPI_ERR_ARG;
-  return c_gather(*c, sendbuf, sendcount, sendtype, recvbuf, recvcount,
-                  recvtype, root);
+  if (!c) return dispatch_comm_err(comm, MPI_ERR_COMM);
+  if (root < 0 || root >= (int)c->group.size())
+    return dispatch_comm_err(comm, MPI_ERR_ARG);
+  std::vector<char> tmp;
+  if (sendbuf == MPI_IN_PLACE) {
+    // root's contribution already sits at its slot of recvbuf
+    if (c->local_rank != root)
+      return dispatch_comm_err(comm, MPI_ERR_ARG);
+    DtView rv;
+    if (!resolve_dtype(recvtype, rv))
+      return dispatch_comm_err(comm, MPI_ERR_TYPE);
+    const char *slice =
+        (const char *)recvbuf + (size_t)root * slot_bytes(rv, recvcount);
+    int rc = clone_region(slice, recvcount, recvtype, tmp);
+    if (rc != MPI_SUCCESS) return dispatch_comm_err(comm, rc);
+    sendbuf = tmp.data();
+    sendcount = recvcount;
+    sendtype = recvtype;
+  }
+  return dispatch_comm_err(
+      comm, c_gather(*c, sendbuf, sendcount, sendtype, recvbuf,
+                     recvcount, recvtype, root));
 }
 
 int MPI_Scatter(const void *sendbuf, int sendcount, MPI_Datatype sendtype,
                 void *recvbuf, int recvcount, MPI_Datatype recvtype,
                 int root, MPI_Comm comm) {
   CommObj *c = lookup_comm(comm);
-  if (!c) return MPI_ERR_COMM;
-  if (root < 0 || root >= (int)c->group.size()) return MPI_ERR_ARG;
-  return c_scatter(*c, sendbuf, sendcount, sendtype, recvbuf, recvcount,
-                   recvtype, root);
+  if (!c) return dispatch_comm_err(comm, MPI_ERR_COMM);
+  if (root < 0 || root >= (int)c->group.size())
+    return dispatch_comm_err(comm, MPI_ERR_ARG);
+  std::vector<char> scratch;
+  if (recvbuf == MPI_IN_PLACE) {
+    // scatter.c: IN_PLACE recvbuf at the root — its slice stays in
+    // sendbuf; receive into scratch and discard
+    if (c->local_rank != root)
+      return dispatch_comm_err(comm, MPI_ERR_ARG);
+    DtView sv;
+    if (!resolve_dtype(sendtype, sv))
+      return dispatch_comm_err(comm, MPI_ERR_TYPE);
+    scratch.resize(slot_bytes(sv, sendcount));
+    recvbuf = scratch.data();
+    recvcount = sendcount;
+    recvtype = sendtype;
+  }
+  return dispatch_comm_err(
+      comm, c_scatter(*c, sendbuf, sendcount, sendtype, recvbuf,
+                      recvcount, recvtype, root));
 }
 
 int MPI_Allgather(const void *sendbuf, int sendcount,
                   MPI_Datatype sendtype, void *recvbuf, int recvcount,
                   MPI_Datatype recvtype, MPI_Comm comm) {
   CommObj *c = lookup_comm(comm);
-  if (!c) return MPI_ERR_COMM;
-  return c_allgather(*c, sendbuf, sendcount, sendtype, recvbuf, recvcount,
-                     recvtype);
+  if (!c) return dispatch_comm_err(comm, MPI_ERR_COMM);
+  std::vector<char> tmp;
+  if (sendbuf == MPI_IN_PLACE) {
+    DtView rv;
+    if (!resolve_dtype(recvtype, rv))
+      return dispatch_comm_err(comm, MPI_ERR_TYPE);
+    const char *slice = (const char *)recvbuf +
+                        (size_t)c->local_rank *
+                            slot_bytes(rv, recvcount);
+    int rc = clone_region(slice, recvcount, recvtype, tmp);
+    if (rc != MPI_SUCCESS) return dispatch_comm_err(comm, rc);
+    sendbuf = tmp.data();
+    sendcount = recvcount;
+    sendtype = recvtype;
+  }
+  return dispatch_comm_err(
+      comm, c_allgather(*c, sendbuf, sendcount, sendtype, recvbuf,
+                        recvcount, recvtype));
 }
 
 int MPI_Alltoall(const void *sendbuf, int sendcount, MPI_Datatype sendtype,
                  void *recvbuf, int recvcount, MPI_Datatype recvtype,
                  MPI_Comm comm) {
   CommObj *c = lookup_comm(comm);
-  if (!c) return MPI_ERR_COMM;
-  return c_alltoall(*c, sendbuf, sendcount, sendtype, recvbuf, recvcount,
-                    recvtype);
+  if (!c) return dispatch_comm_err(comm, MPI_ERR_COMM);
+  std::vector<char> tmp;
+  if (sendbuf == MPI_IN_PLACE) {
+    int n = (int)c->group.size();
+    DtView rv;
+    if (!resolve_dtype(recvtype, rv))
+      return dispatch_comm_err(comm, MPI_ERR_TYPE);
+    int rc = clone_region(recvbuf, n * recvcount, recvtype, tmp);
+    if (rc != MPI_SUCCESS) return dispatch_comm_err(comm, rc);
+    sendbuf = tmp.data();
+    sendcount = recvcount;
+    sendtype = recvtype;
+  }
+  return dispatch_comm_err(
+      comm, c_alltoall(*c, sendbuf, sendcount, sendtype, recvbuf,
+                       recvcount, recvtype));
 }
 
 // ------------------------------------------------------------- datatypes
@@ -4522,28 +4702,56 @@ int MPI_Testall(int count, MPI_Request requests[], int *flag,
 
 // ------------------------------------------------- scan/v-collectives
 
+static int scan_wrapper(const void *sendbuf, void *recvbuf, int count,
+                        MPI_Datatype dt, MPI_Op op, MPI_Comm comm,
+                        bool exclusive) {
+  CommObj *c = lookup_comm(comm);
+  if (!c) return dispatch_comm_err(comm, MPI_ERR_COMM);
+  std::vector<char> tmp;
+  if (sendbuf == MPI_IN_PLACE) {
+    int rc = clone_region(recvbuf, count, dt, tmp);
+    if (rc != MPI_SUCCESS) return dispatch_comm_err(comm, rc);
+    sendbuf = tmp.data();
+  }
+  return dispatch_comm_err(
+      comm, c_scan(*c, sendbuf, recvbuf, count, dt, op, exclusive));
+}
+
 int MPI_Scan(const void *sendbuf, void *recvbuf, int count,
              MPI_Datatype dt, MPI_Op op, MPI_Comm comm) {
-  CommObj *c = lookup_comm(comm);
-  if (!c) return MPI_ERR_COMM;
-  return c_scan(*c, sendbuf, recvbuf, count, dt, op, false);
+  return scan_wrapper(sendbuf, recvbuf, count, dt, op, comm, false);
 }
 
 int MPI_Exscan(const void *sendbuf, void *recvbuf, int count,
                MPI_Datatype dt, MPI_Op op, MPI_Comm comm) {
-  CommObj *c = lookup_comm(comm);
-  if (!c) return MPI_ERR_COMM;
-  return c_scan(*c, sendbuf, recvbuf, count, dt, op, true);
+  return scan_wrapper(sendbuf, recvbuf, count, dt, op, comm, true);
 }
 
 int MPI_Gatherv(const void *sendbuf, int sendcount, MPI_Datatype sendtype,
                 void *recvbuf, const int recvcounts[], const int displs[],
                 MPI_Datatype recvtype, int root, MPI_Comm comm) {
   CommObj *c = lookup_comm(comm);
-  if (!c) return MPI_ERR_COMM;
-  if (root < 0 || root >= (int)c->group.size()) return MPI_ERR_ARG;
-  return c_gatherv(*c, sendbuf, sendcount, sendtype, recvbuf, recvcounts,
-                   displs, recvtype, root);
+  if (!c) return dispatch_comm_err(comm, MPI_ERR_COMM);
+  if (root < 0 || root >= (int)c->group.size())
+    return dispatch_comm_err(comm, MPI_ERR_ARG);
+  std::vector<char> tmp;
+  if (sendbuf == MPI_IN_PLACE) {
+    if (c->local_rank != root)
+      return dispatch_comm_err(comm, MPI_ERR_ARG);
+    DtView rv;
+    if (!resolve_dtype(recvtype, rv))
+      return dispatch_comm_err(comm, MPI_ERR_TYPE);
+    const char *slice = (const char *)recvbuf +
+                        (size_t)displs[root] * slot_bytes(rv, 1);
+    int rc = clone_region(slice, recvcounts[root], recvtype, tmp);
+    if (rc != MPI_SUCCESS) return dispatch_comm_err(comm, rc);
+    sendbuf = tmp.data();
+    sendcount = recvcounts[root];
+    sendtype = recvtype;
+  }
+  return dispatch_comm_err(
+      comm, c_gatherv(*c, sendbuf, sendcount, sendtype, recvbuf,
+                      recvcounts, displs, recvtype, root));
 }
 
 int MPI_Allgatherv(const void *sendbuf, int sendcount,
@@ -4551,9 +4759,24 @@ int MPI_Allgatherv(const void *sendbuf, int sendcount,
                    const int recvcounts[], const int displs[],
                    MPI_Datatype recvtype, MPI_Comm comm) {
   CommObj *c = lookup_comm(comm);
-  if (!c) return MPI_ERR_COMM;
-  return c_allgatherv(*c, sendbuf, sendcount, sendtype, recvbuf,
-                      recvcounts, displs, recvtype);
+  if (!c) return dispatch_comm_err(comm, MPI_ERR_COMM);
+  std::vector<char> tmp;
+  if (sendbuf == MPI_IN_PLACE) {
+    int me = c->local_rank;
+    DtView rv;
+    if (!resolve_dtype(recvtype, rv))
+      return dispatch_comm_err(comm, MPI_ERR_TYPE);
+    const char *slice = (const char *)recvbuf +
+                        (size_t)displs[me] * slot_bytes(rv, 1);
+    int rc = clone_region(slice, recvcounts[me], recvtype, tmp);
+    if (rc != MPI_SUCCESS) return dispatch_comm_err(comm, rc);
+    sendbuf = tmp.data();
+    sendcount = recvcounts[me];
+    sendtype = recvtype;
+  }
+  return dispatch_comm_err(
+      comm, c_allgatherv(*c, sendbuf, sendcount, sendtype, recvbuf,
+                         recvcounts, displs, recvtype));
 }
 
 int MPI_Scatterv(const void *sendbuf, const int sendcounts[],
@@ -4561,26 +4784,61 @@ int MPI_Scatterv(const void *sendbuf, const int sendcounts[],
                  int recvcount, MPI_Datatype recvtype, int root,
                  MPI_Comm comm) {
   CommObj *c = lookup_comm(comm);
-  if (!c) return MPI_ERR_COMM;
-  if (root < 0 || root >= (int)c->group.size()) return MPI_ERR_ARG;
-  return c_scatterv(*c, sendbuf, sendcounts, displs, sendtype, recvbuf,
-                    recvcount, recvtype, root);
+  if (!c) return dispatch_comm_err(comm, MPI_ERR_COMM);
+  if (root < 0 || root >= (int)c->group.size())
+    return dispatch_comm_err(comm, MPI_ERR_ARG);
+  std::vector<char> scratch;
+  if (recvbuf == MPI_IN_PLACE) {
+    if (c->local_rank != root)
+      return dispatch_comm_err(comm, MPI_ERR_ARG);
+    DtView sv;
+    if (!resolve_dtype(sendtype, sv))
+      return dispatch_comm_err(comm, MPI_ERR_TYPE);
+    scratch.resize(slot_bytes(sv, sendcounts[root]));
+    recvbuf = scratch.data();
+    recvcount = sendcounts[root];
+    recvtype = sendtype;
+  }
+  return dispatch_comm_err(
+      comm, c_scatterv(*c, sendbuf, sendcounts, displs, sendtype,
+                       recvbuf, recvcount, recvtype, root));
 }
 
 int MPI_Reduce_scatter_block(const void *sendbuf, void *recvbuf,
                              int recvcount, MPI_Datatype dt, MPI_Op op,
                              MPI_Comm comm) {
   CommObj *c = lookup_comm(comm);
-  if (!c) return MPI_ERR_COMM;
-  return c_reduce_scatter_block(*c, sendbuf, recvbuf, recvcount, dt, op);
+  if (!c) return dispatch_comm_err(comm, MPI_ERR_COMM);
+  std::vector<char> tmp;
+  if (sendbuf == MPI_IN_PLACE) {
+    // reduce_scatter_block.c: input is the FULL n*recvcount vector in
+    // recvbuf
+    int rc = clone_region(recvbuf,
+                          (int)c->group.size() * recvcount, dt, tmp);
+    if (rc != MPI_SUCCESS) return dispatch_comm_err(comm, rc);
+    sendbuf = tmp.data();
+  }
+  return dispatch_comm_err(
+      comm,
+      c_reduce_scatter_block(*c, sendbuf, recvbuf, recvcount, dt, op));
 }
 
 int MPI_Reduce_scatter(const void *sendbuf, void *recvbuf,
                        const int recvcounts[], MPI_Datatype dt, MPI_Op op,
                        MPI_Comm comm) {
   CommObj *c = lookup_comm(comm);
-  if (!c) return MPI_ERR_COMM;
-  return c_reduce_scatter(*c, sendbuf, recvbuf, recvcounts, dt, op);
+  if (!c) return dispatch_comm_err(comm, MPI_ERR_COMM);
+  std::vector<char> tmp;
+  if (sendbuf == MPI_IN_PLACE) {
+    int total = 0;
+    for (int r = 0; r < (int)c->group.size(); r++)
+      total += recvcounts[r];
+    int rc = clone_region(recvbuf, total, dt, tmp);
+    if (rc != MPI_SUCCESS) return dispatch_comm_err(comm, rc);
+    sendbuf = tmp.data();
+  }
+  return dispatch_comm_err(
+      comm, c_reduce_scatter(*c, sendbuf, recvbuf, recvcounts, dt, op));
 }
 
 int MPI_Alltoallv(const void *sendbuf, const int sendcounts[],
@@ -4589,9 +4847,29 @@ int MPI_Alltoallv(const void *sendbuf, const int sendcounts[],
                   const int rdispls[], MPI_Datatype recvtype,
                   MPI_Comm comm) {
   CommObj *c = lookup_comm(comm);
-  if (!c) return MPI_ERR_COMM;
-  return c_alltoallv(*c, sendbuf, sendcounts, sdispls, sendtype, recvbuf,
-                     recvcounts, rdispls, recvtype);
+  if (!c) return dispatch_comm_err(comm, MPI_ERR_COMM);
+  std::vector<char> tmp;
+  if (sendbuf == MPI_IN_PLACE) {
+    // alltoallv.c IN_PLACE: counts/displacements/type come from the
+    // receive side; clone the full spanned region
+    int n = (int)c->group.size();
+    DtView rv;
+    if (!resolve_dtype(recvtype, rv))
+      return dispatch_comm_err(comm, MPI_ERR_TYPE);
+    int span = 0;
+    for (int r = 0; r < n; r++)
+      if (rdispls[r] + recvcounts[r] > span)
+        span = rdispls[r] + recvcounts[r];
+    int rc = clone_region(recvbuf, span, recvtype, tmp);
+    if (rc != MPI_SUCCESS) return dispatch_comm_err(comm, rc);
+    sendbuf = tmp.data();
+    sendcounts = recvcounts;
+    sdispls = rdispls;
+    sendtype = recvtype;
+  }
+  return dispatch_comm_err(
+      comm, c_alltoallv(*c, sendbuf, sendcounts, sdispls, sendtype,
+                        recvbuf, recvcounts, rdispls, recvtype));
 }
 
 // ------------------------------------------------------------ user ops
@@ -4747,6 +5025,7 @@ int MPI_File_close(MPI_File *fh) {
   if ((f->amode & MPI_MODE_DELETE_ON_CLOSE) && c && c->local_rank == 0)
     ::unlink(f->path.c_str());
   if (c) c_barrier(*c);
+  release_errh_ref(g_file_errh, *fh);
   g_files.erase(*fh);
   *fh = MPI_FILE_NULL;
   return MPI_SUCCESS;
@@ -6666,6 +6945,7 @@ int MPI_Win_free(MPI_Win *win) {
   // attribute delete callbacks run BEFORE the handle dies (the
   // comm_free ordering, applied to windows)
   delete_win_attrs(*win);
+  release_errh_ref(g_win_errh, *win);
   // quiesce: a conforming program has fenced/unlocked, so after this
   // barrier no peer can still address the window
   int rc = c_barrier(w->comm);
@@ -8133,6 +8413,178 @@ void clear_info_naming_state(void) {
   g_type_names.clear();
   g_win_names.clear();
   g_ccg_seq.clear();
+}
+
+// ------------------------------------------- error handlers (round 5)
+
+int dispatch_comm_err(int comm, int code) {
+  if (code == MPI_SUCCESS) return code;
+  int eh = errh_of_comm(comm);
+  if (eh == MPI_ERRORS_RETURN) return code;
+  if (eh == MPI_ERRORS_ARE_FATAL) {
+    char msg[MPI_MAX_ERROR_STRING];
+    int len;
+    MPI_Error_string(code, msg, &len);
+    fprintf(stderr,
+            "zompi: MPI_ERRORS_ARE_FATAL on comm %d: %s — aborting\n",
+            comm, msg);
+    _exit(code > 0 && code < 256 ? code : 1);
+  }
+  auto it = g_errhandlers.find(eh);
+  if (it != g_errhandlers.end() && it->second.kind == 0 &&
+      it->second.fn) {
+    MPI_Comm c2 = comm;
+    ((MPI_Comm_errhandler_function *)it->second.fn)(&c2, &code);
+  }
+  return code;
+}
+
+int MPI_Comm_create_errhandler(MPI_Comm_errhandler_function *fn,
+                               MPI_Errhandler *errhandler) {
+  int h = g_next_errh++;
+  g_errhandlers[h] = {0, (void *)fn};
+  *errhandler = h;
+  return MPI_SUCCESS;
+}
+
+int MPI_Comm_set_errhandler(MPI_Comm comm, MPI_Errhandler errhandler) {
+  if (!lookup_comm(comm)) return MPI_ERR_COMM;
+  if (!valid_errh(errhandler, 0)) return MPI_ERR_ARG;
+  release_errh_ref(g_comm_errh, comm);
+  g_comm_errh[comm] = errhandler;
+  return MPI_SUCCESS;
+}
+
+int MPI_Comm_get_errhandler(MPI_Comm comm, MPI_Errhandler *errhandler) {
+  if (!lookup_comm(comm)) return MPI_ERR_COMM;
+  *errhandler = errh_of_comm(comm);
+  return MPI_SUCCESS;
+}
+
+int MPI_Comm_call_errhandler(MPI_Comm comm, int errorcode) {
+  if (!lookup_comm(comm)) return MPI_ERR_COMM;
+  dispatch_comm_err(comm, errorcode);
+  return MPI_SUCCESS;
+}
+
+int MPI_Win_create_errhandler(MPI_Win_errhandler_function *fn,
+                              MPI_Errhandler *errhandler) {
+  int h = g_next_errh++;
+  g_errhandlers[h] = {1, (void *)fn};
+  *errhandler = h;
+  return MPI_SUCCESS;
+}
+
+int MPI_Win_set_errhandler(MPI_Win win, MPI_Errhandler errhandler) {
+  if (!g_win_handles.count(win)) return MPI_ERR_WIN;
+  if (!valid_errh(errhandler, 1)) return MPI_ERR_ARG;
+  release_errh_ref(g_win_errh, win);
+  g_win_errh[win] = errhandler;
+  return MPI_SUCCESS;
+}
+
+int MPI_Win_get_errhandler(MPI_Win win, MPI_Errhandler *errhandler) {
+  if (!g_win_handles.count(win)) return MPI_ERR_WIN;
+  auto it = g_win_errh.find(win);
+  *errhandler = it != g_win_errh.end() ? it->second
+                                       : MPI_ERRORS_ARE_FATAL;
+  return MPI_SUCCESS;
+}
+
+int MPI_Win_call_errhandler(MPI_Win win, int errorcode) {
+  if (!g_win_handles.count(win)) return MPI_ERR_WIN;
+  auto it = g_win_errh.find(win);
+  int eh = it != g_win_errh.end() ? it->second : MPI_ERRORS_ARE_FATAL;
+  if (eh == MPI_ERRORS_RETURN) return MPI_SUCCESS;
+  if (eh == MPI_ERRORS_ARE_FATAL) {
+    fprintf(stderr, "zompi: MPI_ERRORS_ARE_FATAL on win %d: %d\n", win,
+            errorcode);
+    _exit(errorcode > 0 && errorcode < 256 ? errorcode : 1);
+  }
+  auto uh = g_errhandlers.find(eh);
+  if (uh != g_errhandlers.end() && uh->second.kind == 1 &&
+      uh->second.fn) {
+    MPI_Win w2 = win;
+    ((MPI_Win_errhandler_function *)uh->second.fn)(&w2, &errorcode);
+  }
+  return MPI_SUCCESS;
+}
+
+int MPI_File_create_errhandler(MPI_File_errhandler_function *fn,
+                               MPI_Errhandler *errhandler) {
+  int h = g_next_errh++;
+  g_errhandlers[h] = {2, (void *)fn};
+  *errhandler = h;
+  return MPI_SUCCESS;
+}
+
+int MPI_File_set_errhandler(MPI_File file, MPI_Errhandler errhandler) {
+  if (!g_files.count(file)) return MPI_ERR_FILE;
+  if (!valid_errh(errhandler, 2)) return MPI_ERR_ARG;
+  release_errh_ref(g_file_errh, file);
+  g_file_errh[file] = errhandler;
+  return MPI_SUCCESS;
+}
+
+int MPI_File_get_errhandler(MPI_File file, MPI_Errhandler *errhandler) {
+  if (!g_files.count(file)) return MPI_ERR_FILE;
+  auto it = g_file_errh.find(file);
+  // files default to ERRORS_RETURN (MPI-3.1 §13.7)
+  *errhandler = it != g_file_errh.end() ? it->second
+                                        : MPI_ERRORS_RETURN;
+  return MPI_SUCCESS;
+}
+
+int MPI_File_call_errhandler(MPI_File file, int errorcode) {
+  if (!g_files.count(file)) return MPI_ERR_FILE;
+  auto it = g_file_errh.find(file);
+  int eh = it != g_file_errh.end() ? it->second : MPI_ERRORS_RETURN;
+  if (eh == MPI_ERRORS_RETURN) return MPI_SUCCESS;
+  if (eh == MPI_ERRORS_ARE_FATAL) {
+    fprintf(stderr, "zompi: MPI_ERRORS_ARE_FATAL on file %d: %d\n",
+            file, errorcode);
+    _exit(errorcode > 0 && errorcode < 256 ? errorcode : 1);
+  }
+  auto uh = g_errhandlers.find(eh);
+  if (uh != g_errhandlers.end() && uh->second.kind == 2 &&
+      uh->second.fn) {
+    MPI_File f2 = file;
+    ((MPI_File_errhandler_function *)uh->second.fn)(&f2, &errorcode);
+  }
+  return MPI_SUCCESS;
+}
+
+int MPI_Errhandler_free(MPI_Errhandler *errhandler) {
+  if (!errhandler) return MPI_ERR_ARG;
+  if (*errhandler >= 0x10) {
+    auto it = g_errhandlers.find(*errhandler);
+    if (it == g_errhandlers.end()) return MPI_ERR_ARG;
+    // stays in effect until the last referencing object detaches
+    it->second.freed = true;
+    reap_errh(*errhandler);
+  }
+  *errhandler = MPI_ERRHANDLER_NULL;
+  return MPI_SUCCESS;
+}
+
+int MPI_Errhandler_create(MPI_Handler_function *fn,
+                          MPI_Errhandler *errhandler) {
+  return MPI_Comm_create_errhandler(fn, errhandler);
+}
+
+int MPI_Errhandler_set(MPI_Comm comm, MPI_Errhandler errhandler) {
+  return MPI_Comm_set_errhandler(comm, errhandler);
+}
+
+int MPI_Errhandler_get(MPI_Comm comm, MPI_Errhandler *errhandler) {
+  return MPI_Comm_get_errhandler(comm, errhandler);
+}
+
+MPI_Fint MPI_Errhandler_c2f(MPI_Errhandler errhandler) {
+  return (MPI_Fint)errhandler;
+}
+MPI_Errhandler MPI_Errhandler_f2c(MPI_Fint errhandler) {
+  return (MPI_Errhandler)errhandler;
 }
 
 // ---------------------------------------------------------------- misc

@@ -118,6 +118,11 @@ typedef int MPI_Fint;
 #define MPI_PROC_NULL  (-2)
 #define MPI_UNDEFINED  (-32766)
 
+/* in-place collectives (MPI-3.1 ch.5): a sentinel ADDRESS, never
+ * dereferenced */
+extern char zompi_in_place_[1];
+#define MPI_IN_PLACE ((void *)zompi_in_place_)
+
 #define MPI_SUCCESS      0
 #define MPI_ERR_COMM     5
 #define MPI_ERR_TYPE     3
@@ -370,6 +375,45 @@ typedef void MPI_User_function(void *invec, void *inoutvec, int *len,
                                MPI_Datatype *datatype);
 int MPI_Op_create(MPI_User_function *function, int commute, MPI_Op *op);
 int MPI_Op_free(MPI_Op *op);
+
+/* error handlers (comm_create_errhandler.c / errhandler_free.c
+ * families).  Predefined: ERRORS_ARE_FATAL aborts the job (the MPI
+ * default on communicators and windows), ERRORS_RETURN hands the code
+ * back (the default on files).  Dispatch is wired at the
+ * point-to-point and collective entry points. */
+typedef int MPI_Errhandler;
+#define MPI_ERRHANDLER_NULL  (-1)
+#define MPI_ERRORS_ARE_FATAL 0
+#define MPI_ERRORS_RETURN    1
+typedef void MPI_Comm_errhandler_function(MPI_Comm *comm, int *code,
+                                          ...);
+typedef void MPI_Win_errhandler_function(MPI_Win *win, int *code, ...);
+typedef void MPI_File_errhandler_function(MPI_File *file, int *code,
+                                          ...);
+typedef MPI_Comm_errhandler_function MPI_Handler_function; /* MPI-1 */
+int MPI_Comm_create_errhandler(MPI_Comm_errhandler_function *fn,
+                               MPI_Errhandler *errhandler);
+int MPI_Comm_set_errhandler(MPI_Comm comm, MPI_Errhandler errhandler);
+int MPI_Comm_get_errhandler(MPI_Comm comm, MPI_Errhandler *errhandler);
+int MPI_Comm_call_errhandler(MPI_Comm comm, int errorcode);
+int MPI_Win_create_errhandler(MPI_Win_errhandler_function *fn,
+                              MPI_Errhandler *errhandler);
+int MPI_Win_set_errhandler(MPI_Win win, MPI_Errhandler errhandler);
+int MPI_Win_get_errhandler(MPI_Win win, MPI_Errhandler *errhandler);
+int MPI_Win_call_errhandler(MPI_Win win, int errorcode);
+int MPI_File_create_errhandler(MPI_File_errhandler_function *fn,
+                               MPI_Errhandler *errhandler);
+int MPI_File_set_errhandler(MPI_File file, MPI_Errhandler errhandler);
+int MPI_File_get_errhandler(MPI_File file, MPI_Errhandler *errhandler);
+int MPI_File_call_errhandler(MPI_File file, int errorcode);
+int MPI_Errhandler_free(MPI_Errhandler *errhandler);
+/* deprecated MPI-1 names */
+int MPI_Errhandler_create(MPI_Handler_function *fn,
+                          MPI_Errhandler *errhandler);
+int MPI_Errhandler_set(MPI_Comm comm, MPI_Errhandler errhandler);
+int MPI_Errhandler_get(MPI_Comm comm, MPI_Errhandler *errhandler);
+MPI_Fint MPI_Errhandler_c2f(MPI_Errhandler errhandler);
+MPI_Errhandler MPI_Errhandler_f2c(MPI_Fint errhandler);
 
 /* diagnostics and error classes (error_class.c / add_error_class.c) */
 #define MPI_ERR_LASTCODE 92
