@@ -1,0 +1,202 @@
+/* nbrw_c.c — round-5 generalized-exchange acceptance: MPI_Alltoallw
+ * (+IN_PLACE, +nonblocking), neighbor v/w collectives on a periodic
+ * Cartesian ring, the Ineighbor family, and Cart_map/Graph_map.
+ * Reference shapes: ompi/mpi/c/{alltoallw,ialltoallw,
+ * neighbor_allgatherv,neighbor_alltoallv,neighbor_alltoallw,
+ * ineighbor_alltoall,cart_map,graph_map}.c.  Run with >= 2 ranks. */
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include "zompi_mpi.h"
+
+#define CHECK(cond)                                                    \
+  do {                                                                 \
+    if (!(cond)) {                                                     \
+      fprintf(stderr, "FAIL %s:%d: %s\n", __FILE__, __LINE__, #cond);  \
+      MPI_Abort(MPI_COMM_WORLD, 2);                                    \
+    }                                                                  \
+  } while (0)
+
+int main(int argc, char **argv) {
+  CHECK(MPI_Init(&argc, &argv) == MPI_SUCCESS);
+  int rank, size;
+  MPI_Comm_rank(MPI_COMM_WORLD, &rank);
+  MPI_Comm_size(MPI_COMM_WORLD, &size);
+  CHECK(size >= 2);
+  int n = size;
+
+  /* ---- Alltoallw: per-peer types (ints to even peers, doubles to
+   * odd peers), byte displacements ---- */
+  {
+    /* to peer r: one int if r even, one double if r odd */
+    char *sb = calloc((size_t)n, 8);
+    char *rb = calloc((size_t)n, 8);
+    int *scnt = malloc(sizeof(int) * (size_t)n);
+    int *rcnt = malloc(sizeof(int) * (size_t)n);
+    int *sd = malloc(sizeof(int) * (size_t)n);
+    int *rd = malloc(sizeof(int) * (size_t)n);
+    MPI_Datatype *st = malloc(sizeof(MPI_Datatype) * (size_t)n);
+    MPI_Datatype *rt = malloc(sizeof(MPI_Datatype) * (size_t)n);
+    for (int r = 0; r < n; r++) {
+      scnt[r] = rcnt[r] = 1;
+      sd[r] = rd[r] = 8 * r; /* byte displacements */
+      st[r] = r % 2 ? MPI_DOUBLE : MPI_INT;
+      /* I receive from r what r sends to me: typed by MY parity */
+      rt[r] = rank % 2 ? MPI_DOUBLE : MPI_INT;
+      if (r % 2)
+        *(double *)(sb + sd[r]) = rank * 100.0 + r;
+      else
+        *(int *)(sb + sd[r]) = rank * 1000 + r;
+    }
+    CHECK(MPI_Alltoallw(sb, scnt, sd, st, rb, rcnt, rd, rt,
+                        MPI_COMM_WORLD) == MPI_SUCCESS);
+    for (int r = 0; r < n; r++) {
+      if (rank % 2)
+        CHECK(*(double *)(rb + rd[r]) == r * 100.0 + rank);
+      else
+        CHECK(*(int *)(rb + rd[r]) == r * 1000 + rank);
+    }
+
+    /* nonblocking form, overlapped with a barrier-wait pattern */
+    memset(rb, 0, (size_t)n * 8);
+    MPI_Request wreq;
+    CHECK(MPI_Ialltoallw(sb, scnt, sd, st, rb, rcnt, rd, rt,
+                         MPI_COMM_WORLD, &wreq) == MPI_SUCCESS);
+    CHECK(MPI_Wait(&wreq, MPI_STATUS_IGNORE) == MPI_SUCCESS);
+    for (int r = 0; r < n; r++) {
+      if (rank % 2)
+        CHECK(*(double *)(rb + rd[r]) == r * 100.0 + rank);
+      else
+        CHECK(*(int *)(rb + rd[r]) == r * 1000 + rank);
+    }
+
+    /* IN_PLACE: the receive side defines everything, so the pairwise
+     * types must match — use one uniform type */
+    for (int r = 0; r < n; r++) {
+      rt[r] = MPI_LONG_LONG;
+      *(long long *)(rb + rd[r]) = rank * 11LL + r;
+    }
+    CHECK(MPI_Alltoallw(MPI_IN_PLACE, NULL, NULL, NULL, rb, rcnt, rd,
+                        rt, MPI_COMM_WORLD) == MPI_SUCCESS);
+    for (int r = 0; r < n; r++)
+      CHECK(*(long long *)(rb + rd[r]) == r * 11LL + rank);
+
+    /* nonblocking IN_PLACE too (MPI-3.1 5.12) */
+    for (int r = 0; r < n; r++)
+      *(long long *)(rb + rd[r]) = rank * 13LL + r;
+    MPI_Request ipreq;
+    CHECK(MPI_Ialltoallw(MPI_IN_PLACE, NULL, NULL, NULL, rb, rcnt, rd,
+                         rt, MPI_COMM_WORLD, &ipreq) == MPI_SUCCESS);
+    CHECK(MPI_Wait(&ipreq, MPI_STATUS_IGNORE) == MPI_SUCCESS);
+    for (int r = 0; r < n; r++)
+      CHECK(*(long long *)(rb + rd[r]) == r * 13LL + rank);
+    free(sb); free(rb); free(scnt); free(rcnt);
+    free(sd); free(rd); free(st); free(rt);
+  }
+
+  /* ---- periodic 1-D Cartesian ring: neighbor v/w ---- */
+  {
+    int dims[1] = {size}, periods[1] = {1};
+    MPI_Comm ring;
+    CHECK(MPI_Cart_create(MPI_COMM_WORLD, 1, dims, periods, 0, &ring) ==
+          MPI_SUCCESS);
+    int newrank = -1;
+    CHECK(MPI_Cart_map(MPI_COMM_WORLD, 1, dims, periods, &newrank) ==
+          MPI_SUCCESS && newrank == rank);
+    int left, right;
+    CHECK(MPI_Cart_shift(ring, 0, 1, &left, &right) == MPI_SUCCESS);
+
+    /* neighbor order for 1-D cart: [minus, plus] = [left, right] */
+
+    /* allgatherv: ragged blocks — rank r contributes r+1 ints */
+    {
+      int mine[8];
+      for (int i = 0; i <= rank && i < 8; i++) mine[i] = rank * 10 + i;
+      int rc2[2] = {left + 1, right + 1};
+      int dp[2] = {0, left + 1};
+      int *out = calloc((size_t)(left + right + 2), sizeof(int));
+      CHECK(MPI_Neighbor_allgatherv(mine, rank + 1, MPI_INT, out, rc2,
+                                    dp, MPI_INT, ring) == MPI_SUCCESS);
+      for (int i = 0; i <= left; i++) CHECK(out[i] == left * 10 + i);
+      for (int i = 0; i <= right; i++)
+        CHECK(out[left + 1 + i] == right * 10 + i);
+
+      /* nonblocking flavor */
+      memset(out, 0, (size_t)(left + right + 2) * sizeof(int));
+      MPI_Request nreq;
+      CHECK(MPI_Ineighbor_allgatherv(mine, rank + 1, MPI_INT, out, rc2,
+                                     dp, MPI_INT, ring, &nreq) ==
+            MPI_SUCCESS);
+      CHECK(MPI_Wait(&nreq, MPI_STATUS_IGNORE) == MPI_SUCCESS);
+      CHECK(out[0] == left * 10 && out[left + 1] == right * 10);
+      free(out);
+    }
+
+    /* alltoallv: distinct block to each neighbor */
+    {
+      int sb2[4] = {rank * 2, rank * 2 + 1, rank * 3, rank * 3 + 1};
+      int sc2[2] = {2, 2}, sd2[2] = {0, 2};
+      int rb2[4] = {-1, -1, -1, -1};
+      int rc2[2] = {2, 2}, rd2[2] = {0, 2};
+      CHECK(MPI_Neighbor_alltoallv(sb2, sc2, sd2, MPI_INT, rb2, rc2,
+                                   rd2, MPI_INT, ring) == MPI_SUCCESS);
+      /* block 0 = from left (their block TO their right = my side);
+       * 1-D cart codes pair minus<->plus, so left sent its block 1 */
+      CHECK(rb2[0] == left * 3 && rb2[1] == left * 3 + 1);
+      CHECK(rb2[2] == right * 2 && rb2[3] == right * 2 + 1);
+    }
+
+    /* alltoallw on the ring: slot-0 recv pairs with the minus
+     * neighbor's plus-direction send, so the pairwise types must
+     * agree — one uniform 8-byte type, distinct per-direction data */
+    {
+      char sb3[16], rb3[16];
+      memset(rb3, 0, sizeof rb3);
+      int sc3[2] = {1, 1}, rc3[2] = {1, 1};
+      MPI_Aint sd3[2] = {0, 8}, rd3[2] = {0, 8};
+      MPI_Datatype t2[2] = {MPI_LONG_LONG, MPI_LONG_LONG};
+      *(long long *)(sb3 + 0) = 4000 + rank;
+      *(long long *)(sb3 + 8) = 8000 + rank;
+      CHECK(MPI_Neighbor_alltoallw(sb3, sc3, sd3, t2, rb3, rc3, rd3,
+                                   t2, ring) == MPI_SUCCESS);
+      CHECK(*(long long *)(rb3 + 0) == 8000 + left);
+      CHECK(*(long long *)(rb3 + 8) == 4000 + right);
+
+      /* Ineighbor_alltoallw */
+      memset(rb3, 0, sizeof rb3);
+      MPI_Request wr;
+      CHECK(MPI_Ineighbor_alltoallw(sb3, sc3, sd3, t2, rb3, rc3, rd3,
+                                    t2, ring, &wr) == MPI_SUCCESS);
+      CHECK(MPI_Wait(&wr, MPI_STATUS_IGNORE) == MPI_SUCCESS);
+      CHECK(*(long long *)(rb3 + 0) == 8000 + left);
+      CHECK(*(long long *)(rb3 + 8) == 4000 + right);
+    }
+
+    /* Ineighbor_alltoall */
+    {
+      int sb4[2] = {rank + 20, rank + 40};
+      int rb4[2] = {-1, -1};
+      MPI_Request nr;
+      CHECK(MPI_Ineighbor_alltoall(sb4, 1, MPI_INT, rb4, 1, MPI_INT,
+                                   ring, &nr) == MPI_SUCCESS);
+      CHECK(MPI_Wait(&nr, MPI_STATUS_IGNORE) == MPI_SUCCESS);
+      CHECK(rb4[0] == left + 40 && rb4[1] == right + 20);
+    }
+
+    MPI_Comm_free(&ring);
+  }
+
+  /* Graph_map */
+  {
+    int index[2] = {1, 2}, edges[2] = {1, 0};
+    int nrk = -3;
+    CHECK(MPI_Graph_map(MPI_COMM_WORLD, 2, index, edges, &nrk) ==
+          MPI_SUCCESS);
+    CHECK(nrk == (rank < 2 ? rank : MPI_UNDEFINED));
+  }
+
+  MPI_Barrier(MPI_COMM_WORLD);
+  if (rank == 0) printf("nbrw_c OK on %d ranks\n", size);
+  MPI_Finalize();
+  return 0;
+}

@@ -165,6 +165,15 @@ class TestPureC:
         outs = _run_example(shim, tmp_path_factory, "errip_c.c", n)
         assert f"errip_c OK on {n} ranks" in outs[0]
 
+    @pytest.mark.parametrize("n", [2, 4])
+    def test_nbrw_example(self, shim, tmp_path_factory, n):
+        """Round-5 generalized exchanges: Alltoallw with per-peer
+        datatypes (+IN_PLACE, +Ialltoallw), neighbor allgatherv/
+        alltoallv/alltoallw on a periodic Cartesian ring, the
+        Ineighbor family, Cart_map/Graph_map."""
+        outs = _run_example(shim, tmp_path_factory, "nbrw_c.c", n)
+        assert f"nbrw_c OK on {n} ranks" in outs[0]
+
     def test_are_fatal_default_aborts(self, shim, tmp_path):
         """The MPI default handler is ERRORS_ARE_FATAL: an invalid-rank
         send without an installed handler must kill the process with a

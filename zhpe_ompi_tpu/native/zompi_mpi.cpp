@@ -2233,6 +2233,67 @@ int c_alltoallv(CommObj &c, const void *sendbuf, const int sendcounts[],
   return MPI_SUCCESS;
 }
 
+int c_alltoallw(CommObj &c, const void *sendbuf, const int sendcounts[],
+                const int sdispls[], const MPI_Datatype sendtypes[],
+                void *recvbuf, const int recvcounts[],
+                const int rdispls[], const MPI_Datatype recvtypes[]) {
+  // alltoallw.c: the fully general exchange — per-peer datatypes and
+  // BYTE displacements (the one collective whose displacements are not
+  // scaled by an extent, MPI-3.1 §5.8)
+  if (!c.remote.empty()) return MPI_ERR_COMM;
+  int n = (int)c.group.size(), me = c.local_rank;
+  std::vector<DtView> sv((size_t)n), rv((size_t)n);
+  for (int r = 0; r < n; r++) {
+    if (sendcounts[r] < 0 || recvcounts[r] < 0 || sdispls[r] < 0 ||
+        rdispls[r] < 0)
+      return MPI_ERR_ARG;
+    if (sendcounts[r] > 0 &&
+        !resolve_dtype(sendtypes[r], sv[(size_t)r]))
+      return MPI_ERR_TYPE;
+    if (recvcounts[r] > 0 &&
+        !resolve_dtype(recvtypes[r], rv[(size_t)r]))
+      return MPI_ERR_TYPE;
+  }
+  int64_t tag = (c.coll_seq++ % 0x8000) << 16 | 0x7E12;
+  std::vector<Req> reqs((size_t)n);
+  std::vector<int> handles((size_t)n, -1);
+  auto abort_all = [&](int err) {
+    std::lock_guard<std::mutex> lk(g.match_mu);
+    for (int i = 0; i < n; i++)
+      if (handles[(size_t)i] >= 0)
+        deregister_locked(handles[(size_t)i], &reqs[(size_t)i]);
+    return err;
+  };
+  for (int r = 0; r < n; r++) {
+    if (r == me || recvcounts[r] == 0) continue;
+    reqs[(size_t)r].is_recv = true;
+    reqs[(size_t)r].user_buf = (char *)recvbuf + (size_t)rdispls[r];
+    reqs[(size_t)r].count = recvcounts[r];
+    handles[(size_t)r] = post_recv(&reqs[(size_t)r], rv[(size_t)r],
+                                   c.cid_coll, world_of(c, r), tag);
+  }
+  for (int r = 0; r < n; r++) {
+    if (r == me || sendcounts[r] == 0) continue;
+    int rc = raw_send((const char *)sendbuf + (size_t)sdispls[r],
+                      sendcounts[r], sendtypes[r], world_of(c, r), tag,
+                      c.cid_coll);
+    if (rc != MPI_SUCCESS) return abort_all(rc);
+  }
+  if (sendcounts[me] > 0 && recvcounts[me] > 0) {
+    std::vector<char> packed;
+    pack_dtype((const char *)sendbuf + (size_t)sdispls[me],
+               sendcounts[me], sv[(size_t)me], packed);
+    unpack_dtype((char *)recvbuf + (size_t)rdispls[me], recvcounts[me],
+                 rv[(size_t)me], packed.data(), packed.size());
+  }
+  for (int r = 0; r < n; r++) {
+    if (handles[(size_t)r] < 0) continue;
+    int rc = wait_handle(handles[(size_t)r], nullptr);
+    handles[(size_t)r] = -1;
+    if (rc != MPI_SUCCESS) return abort_all(rc);
+  }
+  return MPI_SUCCESS;
+}
 
 }  // namespace
 
@@ -4872,6 +4933,53 @@ int MPI_Alltoallv(const void *sendbuf, const int sendcounts[],
                         recvbuf, recvcounts, rdispls, recvtype));
 }
 
+int MPI_Alltoallw(const void *sendbuf, const int sendcounts[],
+                  const int sdispls[], const MPI_Datatype sendtypes[],
+                  void *recvbuf, const int recvcounts[],
+                  const int rdispls[], const MPI_Datatype recvtypes[],
+                  MPI_Comm comm) {
+  CommObj *c = lookup_comm(comm);
+  if (!c) return dispatch_comm_err(comm, MPI_ERR_COMM);
+  int n = (int)c->group.size();
+  std::vector<char> tmp;
+  if (sendbuf == MPI_IN_PLACE) {
+    // alltoallw.c IN_PLACE: everything comes from the receive side;
+    // clone each peer's block (byte displacements, per-peer types).
+    // Validate BEFORE dereferencing — the sibling path's negativity
+    // checks live in c_alltoallw, which runs after this clone.
+    int64_t span = 0;
+    for (int r = 0; r < n; r++) {
+      if (recvcounts[r] < 0 || rdispls[r] < 0)
+        return dispatch_comm_err(comm, MPI_ERR_ARG);
+      DtView rv;
+      if (recvcounts[r] == 0) continue;
+      if (!resolve_dtype(recvtypes[r], rv))
+        return dispatch_comm_err(comm, MPI_ERR_TYPE);
+      int64_t end = rdispls[r] +
+                    (int64_t)slot_bytes(rv, recvcounts[r]);
+      if (end > span) span = end;
+    }
+    tmp.assign((size_t)span, 0);
+    for (int r = 0; r < n; r++) {
+      if (recvcounts[r] == 0) continue;
+      DtView rv;
+      resolve_dtype(recvtypes[r], rv);
+      std::vector<char> packed;
+      pack_dtype((const char *)recvbuf + rdispls[r], recvcounts[r], rv,
+                 packed);
+      unpack_dtype(tmp.data() + rdispls[r], recvcounts[r], rv,
+                   packed.data(), packed.size());
+    }
+    sendbuf = tmp.data();
+    sendcounts = recvcounts;
+    sdispls = rdispls;
+    sendtypes = recvtypes;
+  }
+  return dispatch_comm_err(
+      comm, c_alltoallw(*c, sendbuf, sendcounts, sdispls, sendtypes,
+                        recvbuf, recvcounts, rdispls, recvtypes));
+}
+
 // ------------------------------------------------------------ user ops
 
 int MPI_Op_create(MPI_User_function *function, int commute, MPI_Op *op) {
@@ -5520,6 +5628,69 @@ int MPI_Ialltoallv(const void *sendbuf, const int sendcounts[],
       comm, request);
 }
 
+int MPI_Ialltoallw(const void *sendbuf, const int sendcounts[],
+                   const int sdispls[], const MPI_Datatype sendtypes[],
+                   void *recvbuf, const int recvcounts[],
+                   const int rdispls[], const MPI_Datatype recvtypes[],
+                   MPI_Comm comm, MPI_Request *request) {
+  CommObj *c = lookup_comm(comm);
+  if (!c) return MPI_ERR_COMM;
+  int n = (int)c->group.size();
+  // MPI-3.1 5.12 extends IN_PLACE to the nonblocking collectives: the
+  // send arrays are then absent (often NULL) — clone the receive side
+  // exactly as the blocking wrapper does, with the clone owned by the
+  // lambda so it outlives the background run
+  auto tmp = std::make_shared<std::vector<char>>();
+  if (sendbuf == MPI_IN_PLACE) {
+    int64_t span = 0;
+    for (int r = 0; r < n; r++) {
+      if (recvcounts[r] < 0 || rdispls[r] < 0) return MPI_ERR_ARG;
+      DtView rv;
+      if (recvcounts[r] == 0) continue;
+      if (!resolve_dtype(recvtypes[r], rv)) return MPI_ERR_TYPE;
+      int64_t end = rdispls[r] +
+                    (int64_t)slot_bytes(rv, recvcounts[r]);
+      if (end > span) span = end;
+    }
+    tmp->assign((size_t)span, 0);
+    for (int r = 0; r < n; r++) {
+      if (recvcounts[r] == 0) continue;
+      DtView rv;
+      resolve_dtype(recvtypes[r], rv);
+      std::vector<char> packed;
+      pack_dtype((const char *)recvbuf + rdispls[r], recvcounts[r], rv,
+                 packed);
+      unpack_dtype(tmp->data() + rdispls[r], recvcounts[r], rv,
+                   packed.data(), packed.size());
+    }
+    sendbuf = tmp->data();
+    sendcounts = recvcounts;
+    sdispls = rdispls;
+    sendtypes = recvtypes;
+  }
+  auto sc = std::make_shared<std::vector<int>>(sendcounts,
+                                               sendcounts + n);
+  auto sd = std::make_shared<std::vector<int>>(sdispls, sdispls + n);
+  auto st2 = std::make_shared<std::vector<MPI_Datatype>>(sendtypes,
+                                                         sendtypes + n);
+  auto rc_ = std::make_shared<std::vector<int>>(recvcounts,
+                                                recvcounts + n);
+  auto rd = std::make_shared<std::vector<int>>(rdispls, rdispls + n);
+  auto rt2 = std::make_shared<std::vector<MPI_Datatype>>(recvtypes,
+                                                         recvtypes + n);
+  auto snap = icoll_reserve(c);
+  // tmp is captured EXPLICITLY: sendbuf aliases tmp->data() on the
+  // IN_PLACE path, and [=] alone would not keep the clone alive (the
+  // lambda body never names tmp)
+  return icoll_spawn(
+      [snap, tmp, sendbuf, recvbuf, sc, sd, st2, rc_, rd, rt2]() {
+        return c_alltoallw(*snap, sendbuf, sc->data(), sd->data(),
+                           st2->data(), recvbuf, rc_->data(),
+                           rd->data(), rt2->data());
+      },
+      comm, request);
+}
+
 int MPI_Ibarrier(MPI_Comm comm, MPI_Request *request) {
   // as a 1-int allreduce: the plain dissemination barrier's fixed tag
   // cannot distinguish overlapping instances, the reserved-seq
@@ -5566,6 +5737,36 @@ int MPI_Dims_create(int nnodes, int ndims, int dims[]) {
   int j = 0;
   for (int i = 0; i < ndims; i++)
     if (dims[i] <= 0) dims[i] = fill[j++];
+  return MPI_SUCCESS;
+}
+
+int MPI_Cart_map(MPI_Comm comm, int ndims, const int dims[],
+                 const int periods[], int *newrank) {
+  // cart_map.c: the no-reorder mapping this shim's Cart_create also
+  // uses — ranks below the grid size keep their rank, the rest get
+  // MPI_UNDEFINED
+  (void)periods;
+  CommObj *c = lookup_comm(comm);
+  if (!c) return MPI_ERR_COMM;
+  if (ndims <= 0) return MPI_ERR_ARG;
+  int64_t nnodes = 1;
+  for (int d = 0; d < ndims; d++) {
+    if (dims[d] <= 0) return MPI_ERR_ARG;
+    nnodes *= dims[d];
+  }
+  if (nnodes > (int64_t)c->group.size()) return MPI_ERR_ARG;
+  *newrank = c->local_rank < nnodes ? c->local_rank : MPI_UNDEFINED;
+  return MPI_SUCCESS;
+}
+
+int MPI_Graph_map(MPI_Comm comm, int nnodes, const int index[],
+                  const int edges[], int *newrank) {
+  (void)index;
+  (void)edges;
+  CommObj *c = lookup_comm(comm);
+  if (!c) return MPI_ERR_COMM;
+  if (nnodes <= 0 || nnodes > (int)c->group.size()) return MPI_ERR_ARG;
+  *newrank = c->local_rank < nnodes ? c->local_rank : MPI_UNDEFINED;
   return MPI_SUCCESS;
 }
 
@@ -6471,6 +6672,155 @@ int c_neighbor_exchange(MPI_Comm comm, CommObj &c, const void *sendbuf,
   return MPI_SUCCESS;
 }
 
+// generalized neighborhood exchange: per-edge buffers/counts/types.
+// The v/w variants (neighbor_allgatherv.c, neighbor_alltoallv.c,
+// neighbor_alltoallw.c) all reduce to this shape; the callers compute
+// the per-edge pointers (extent-scaled for v, byte displacements for
+// w — MPI-3.1 §7.7).
+int c_neighbor_general(MPI_Comm comm, CommObj &c,
+                       const std::vector<const char *> &sptr,
+                       const std::vector<int> &scnt,
+                       const std::vector<MPI_Datatype> &stype,
+                       const std::vector<char *> &rptr,
+                       const std::vector<int> &rcnt,
+                       const std::vector<MPI_Datatype> &rtype,
+                       const std::vector<int> &recv_from,
+                       const std::vector<int> &send_to) {
+  std::vector<int> send_code, recv_code;
+  neighbor_codes(c, recv_from, send_to, send_code, recv_code);
+  int nr = (int)recv_from.size(), ns = (int)send_to.size();
+  int64_t base = (c.coll_seq++ % 0x8000) << 16;
+  std::vector<Req> reqs((size_t)nr);
+  std::vector<int> handles((size_t)nr, -1);
+  auto abort_all = [&](int err) {
+    std::lock_guard<std::mutex> lk(g.match_mu);
+    for (int i = 0; i < nr; i++)
+      if (handles[(size_t)i] >= 0)
+        deregister_locked(handles[(size_t)i], &reqs[(size_t)i]);
+    return err;
+  };
+  for (int i = 0; i < nr; i++) {
+    if (recv_from[(size_t)i] == MPI_PROC_NULL) continue;
+    DtView rv;
+    if (!resolve_dtype(rtype[(size_t)i], rv))
+      return abort_all(MPI_ERR_TYPE);
+    reqs[(size_t)i].is_recv = true;
+    reqs[(size_t)i].user_buf = rptr[(size_t)i];
+    reqs[(size_t)i].count = rcnt[(size_t)i];
+    handles[(size_t)i] =
+        post_recv(&reqs[(size_t)i], rv, c.cid_coll,
+                  world_of(c, recv_from[(size_t)i]),
+                  base | (0x7E20 + recv_code[(size_t)i]));
+  }
+  for (int i = 0; i < ns; i++) {
+    if (send_to[(size_t)i] == MPI_PROC_NULL) continue;
+    int rc = raw_send(sptr[(size_t)i], scnt[(size_t)i],
+                      stype[(size_t)i],
+                      world_of(c, send_to[(size_t)i]),
+                      base | (0x7E20 + send_code[(size_t)i]),
+                      c.cid_coll);
+    if (rc != MPI_SUCCESS) return abort_all(rc);
+  }
+  for (int i = 0; i < nr; i++) {
+    if (handles[(size_t)i] < 0) continue;
+    int rc = wait_handle(handles[(size_t)i], nullptr);
+    handles[(size_t)i] = -1;
+    if (rc != MPI_SUCCESS) return abort_all(rc);
+  }
+  return MPI_SUCCESS;
+}
+
+int c_neighbor_allgatherv(MPI_Comm comm, CommObj &c, const void *sendbuf,
+                          int sendcount, MPI_Datatype sendtype,
+                          void *recvbuf, const int recvcounts[],
+                          const int displs[], MPI_Datatype recvtype) {
+  if (!c.remote.empty()) return MPI_ERR_COMM;
+  DtView rv;
+  if (!resolve_dtype(recvtype, rv)) return MPI_ERR_TYPE;
+  std::vector<int> recv_from, send_to;
+  int rc = neighbor_lists(comm, c, recv_from, send_to);
+  if (rc != MPI_SUCCESS) return rc;
+  size_t rstride = slot_bytes(rv, 1);
+  int nr = (int)recv_from.size(), ns = (int)send_to.size();
+  std::vector<const char *> sptr((size_t)ns, (const char *)sendbuf);
+  std::vector<int> scnt((size_t)ns, sendcount);
+  std::vector<MPI_Datatype> stypes((size_t)ns, sendtype);
+  std::vector<char *> rptr((size_t)nr);
+  std::vector<int> rcnt((size_t)nr);
+  std::vector<MPI_Datatype> rtypes((size_t)nr, recvtype);
+  for (int i = 0; i < nr; i++) {
+    rptr[(size_t)i] = (char *)recvbuf + (size_t)displs[i] * rstride;
+    rcnt[(size_t)i] = recvcounts[i];
+  }
+  return c_neighbor_general(comm, c, sptr, scnt, stypes, rptr, rcnt,
+                            rtypes, recv_from, send_to);
+}
+
+int c_neighbor_alltoallv(MPI_Comm comm, CommObj &c, const void *sendbuf,
+                         const int sendcounts[], const int sdispls[],
+                         MPI_Datatype sendtype, void *recvbuf,
+                         const int recvcounts[], const int rdispls[],
+                         MPI_Datatype recvtype) {
+  if (!c.remote.empty()) return MPI_ERR_COMM;
+  DtView sv, rv;
+  if (!resolve_dtype(sendtype, sv) || !resolve_dtype(recvtype, rv))
+    return MPI_ERR_TYPE;
+  std::vector<int> recv_from, send_to;
+  int rc = neighbor_lists(comm, c, recv_from, send_to);
+  if (rc != MPI_SUCCESS) return rc;
+  size_t sstride = slot_bytes(sv, 1), rstride = slot_bytes(rv, 1);
+  int nr = (int)recv_from.size(), ns = (int)send_to.size();
+  std::vector<const char *> sptr((size_t)ns);
+  std::vector<int> scnt((size_t)ns);
+  std::vector<MPI_Datatype> stypes((size_t)ns, sendtype);
+  std::vector<char *> rptr((size_t)nr);
+  std::vector<int> rcnt((size_t)nr);
+  std::vector<MPI_Datatype> rtypes((size_t)nr, recvtype);
+  for (int i = 0; i < ns; i++) {
+    sptr[(size_t)i] =
+        (const char *)sendbuf + (size_t)sdispls[i] * sstride;
+    scnt[(size_t)i] = sendcounts[i];
+  }
+  for (int i = 0; i < nr; i++) {
+    rptr[(size_t)i] = (char *)recvbuf + (size_t)rdispls[i] * rstride;
+    rcnt[(size_t)i] = recvcounts[i];
+  }
+  return c_neighbor_general(comm, c, sptr, scnt, stypes, rptr, rcnt,
+                            rtypes, recv_from, send_to);
+}
+
+int c_neighbor_alltoallw(MPI_Comm comm, CommObj &c, const void *sendbuf,
+                         const int sendcounts[],
+                         const MPI_Aint sdispls[],
+                         const MPI_Datatype sendtypes[], void *recvbuf,
+                         const int recvcounts[],
+                         const MPI_Aint rdispls[],
+                         const MPI_Datatype recvtypes[]) {
+  if (!c.remote.empty()) return MPI_ERR_COMM;
+  std::vector<int> recv_from, send_to;
+  int rc = neighbor_lists(comm, c, recv_from, send_to);
+  if (rc != MPI_SUCCESS) return rc;
+  int nr = (int)recv_from.size(), ns = (int)send_to.size();
+  std::vector<const char *> sptr((size_t)ns);
+  std::vector<int> scnt((size_t)ns);
+  std::vector<MPI_Datatype> stypes((size_t)ns);
+  std::vector<char *> rptr((size_t)nr);
+  std::vector<int> rcnt((size_t)nr);
+  std::vector<MPI_Datatype> rtypes((size_t)nr);
+  for (int i = 0; i < ns; i++) {
+    sptr[(size_t)i] = (const char *)sendbuf + (size_t)sdispls[i];
+    scnt[(size_t)i] = sendcounts[i];
+    stypes[(size_t)i] = sendtypes[i];
+  }
+  for (int i = 0; i < nr; i++) {
+    rptr[(size_t)i] = (char *)recvbuf + (size_t)rdispls[i];
+    rcnt[(size_t)i] = recvcounts[i];
+    rtypes[(size_t)i] = recvtypes[i];
+  }
+  return c_neighbor_general(comm, c, sptr, scnt, stypes, rptr, rcnt,
+                            rtypes, recv_from, send_to);
+}
+
 }  // namespace
 
 int MPI_Neighbor_allgather(const void *sendbuf, int sendcount,
@@ -6478,9 +6828,10 @@ int MPI_Neighbor_allgather(const void *sendbuf, int sendcount,
                            int recvcount, MPI_Datatype recvtype,
                            MPI_Comm comm) {
   CommObj *c = lookup_comm(comm);
-  if (!c) return MPI_ERR_COMM;
-  return c_neighbor_exchange(comm, *c, sendbuf, sendcount, sendtype,
-                             recvbuf, recvcount, recvtype, false);
+  if (!c) return dispatch_comm_err(comm, MPI_ERR_COMM);
+  return dispatch_comm_err(
+      comm, c_neighbor_exchange(comm, *c, sendbuf, sendcount, sendtype,
+                                recvbuf, recvcount, recvtype, false));
 }
 
 int MPI_Neighbor_alltoall(const void *sendbuf, int sendcount,
@@ -6488,9 +6839,163 @@ int MPI_Neighbor_alltoall(const void *sendbuf, int sendcount,
                           int recvcount, MPI_Datatype recvtype,
                           MPI_Comm comm) {
   CommObj *c = lookup_comm(comm);
+  if (!c) return dispatch_comm_err(comm, MPI_ERR_COMM);
+  return dispatch_comm_err(
+      comm, c_neighbor_exchange(comm, *c, sendbuf, sendcount, sendtype,
+                                recvbuf, recvcount, recvtype, true));
+}
+
+int MPI_Neighbor_allgatherv(const void *sendbuf, int sendcount,
+                            MPI_Datatype sendtype, void *recvbuf,
+                            const int recvcounts[], const int displs[],
+                            MPI_Datatype recvtype, MPI_Comm comm) {
+  CommObj *c = lookup_comm(comm);
+  if (!c) return dispatch_comm_err(comm, MPI_ERR_COMM);
+  return dispatch_comm_err(
+      comm, c_neighbor_allgatherv(comm, *c, sendbuf, sendcount,
+                                  sendtype, recvbuf, recvcounts, displs,
+                                  recvtype));
+}
+
+int MPI_Neighbor_alltoallv(const void *sendbuf, const int sendcounts[],
+                           const int sdispls[], MPI_Datatype sendtype,
+                           void *recvbuf, const int recvcounts[],
+                           const int rdispls[], MPI_Datatype recvtype,
+                           MPI_Comm comm) {
+  CommObj *c = lookup_comm(comm);
+  if (!c) return dispatch_comm_err(comm, MPI_ERR_COMM);
+  return dispatch_comm_err(
+      comm, c_neighbor_alltoallv(comm, *c, sendbuf, sendcounts, sdispls,
+                                 sendtype, recvbuf, recvcounts, rdispls,
+                                 recvtype));
+}
+
+int MPI_Neighbor_alltoallw(const void *sendbuf, const int sendcounts[],
+                           const MPI_Aint sdispls[],
+                           const MPI_Datatype sendtypes[], void *recvbuf,
+                           const int recvcounts[],
+                           const MPI_Aint rdispls[],
+                           const MPI_Datatype recvtypes[],
+                           MPI_Comm comm) {
+  CommObj *c = lookup_comm(comm);
+  if (!c) return dispatch_comm_err(comm, MPI_ERR_COMM);
+  return dispatch_comm_err(
+      comm, c_neighbor_alltoallw(comm, *c, sendbuf, sendcounts, sdispls,
+                                 sendtypes, recvbuf, recvcounts,
+                                 rdispls, recvtypes));
+}
+
+// nonblocking neighborhood collectives (ineighbor_allgather.c family):
+// the icoll engine — reserve the tag window, run the blocking form on
+// a comm snapshot, retire through the request engine.  Array arguments
+// are snapshotted by value (the standard lets the caller reuse them
+// the moment the call returns).
+
+int MPI_Ineighbor_allgather(const void *sendbuf, int sendcount,
+                            MPI_Datatype sendtype, void *recvbuf,
+                            int recvcount, MPI_Datatype recvtype,
+                            MPI_Comm comm, MPI_Request *request) {
+  CommObj *c = lookup_comm(comm);
   if (!c) return MPI_ERR_COMM;
-  return c_neighbor_exchange(comm, *c, sendbuf, sendcount, sendtype,
-                             recvbuf, recvcount, recvtype, true);
+  auto snap = icoll_reserve(c);
+  return icoll_spawn(
+      [=]() {
+        return c_neighbor_exchange(comm, *snap, sendbuf, sendcount,
+                                   sendtype, recvbuf, recvcount,
+                                   recvtype, false);
+      },
+      comm, request);
+}
+
+int MPI_Ineighbor_alltoall(const void *sendbuf, int sendcount,
+                           MPI_Datatype sendtype, void *recvbuf,
+                           int recvcount, MPI_Datatype recvtype,
+                           MPI_Comm comm, MPI_Request *request) {
+  CommObj *c = lookup_comm(comm);
+  if (!c) return MPI_ERR_COMM;
+  auto snap = icoll_reserve(c);
+  return icoll_spawn(
+      [=]() {
+        return c_neighbor_exchange(comm, *snap, sendbuf, sendcount,
+                                   sendtype, recvbuf, recvcount,
+                                   recvtype, true);
+      },
+      comm, request);
+}
+
+int MPI_Ineighbor_allgatherv(const void *sendbuf, int sendcount,
+                             MPI_Datatype sendtype, void *recvbuf,
+                             const int recvcounts[], const int displs[],
+                             MPI_Datatype recvtype, MPI_Comm comm,
+                             MPI_Request *request) {
+  CommObj *c = lookup_comm(comm);
+  if (!c) return MPI_ERR_COMM;
+  std::vector<int> rf, st_;
+  int rc = neighbor_lists(comm, *c, rf, st_);
+  if (rc != MPI_SUCCESS) return rc;
+  std::vector<int> rc_v(recvcounts, recvcounts + rf.size());
+  std::vector<int> d_v(displs, displs + rf.size());
+  auto snap = icoll_reserve(c);
+  return icoll_spawn(
+      [=]() {
+        return c_neighbor_allgatherv(comm, *snap, sendbuf, sendcount,
+                                     sendtype, recvbuf, rc_v.data(),
+                                     d_v.data(), recvtype);
+      },
+      comm, request);
+}
+
+int MPI_Ineighbor_alltoallv(const void *sendbuf, const int sendcounts[],
+                            const int sdispls[], MPI_Datatype sendtype,
+                            void *recvbuf, const int recvcounts[],
+                            const int rdispls[], MPI_Datatype recvtype,
+                            MPI_Comm comm, MPI_Request *request) {
+  CommObj *c = lookup_comm(comm);
+  if (!c) return MPI_ERR_COMM;
+  std::vector<int> rf, st_;
+  int rc = neighbor_lists(comm, *c, rf, st_);
+  if (rc != MPI_SUCCESS) return rc;
+  std::vector<int> sc_v(sendcounts, sendcounts + st_.size());
+  std::vector<int> sd_v(sdispls, sdispls + st_.size());
+  std::vector<int> rc_v(recvcounts, recvcounts + rf.size());
+  std::vector<int> rd_v(rdispls, rdispls + rf.size());
+  auto snap = icoll_reserve(c);
+  return icoll_spawn(
+      [=]() {
+        return c_neighbor_alltoallv(comm, *snap, sendbuf, sc_v.data(),
+                                    sd_v.data(), sendtype, recvbuf,
+                                    rc_v.data(), rd_v.data(), recvtype);
+      },
+      comm, request);
+}
+
+int MPI_Ineighbor_alltoallw(const void *sendbuf, const int sendcounts[],
+                            const MPI_Aint sdispls[],
+                            const MPI_Datatype sendtypes[],
+                            void *recvbuf, const int recvcounts[],
+                            const MPI_Aint rdispls[],
+                            const MPI_Datatype recvtypes[],
+                            MPI_Comm comm, MPI_Request *request) {
+  CommObj *c = lookup_comm(comm);
+  if (!c) return MPI_ERR_COMM;
+  std::vector<int> rf, st_;
+  int rc = neighbor_lists(comm, *c, rf, st_);
+  if (rc != MPI_SUCCESS) return rc;
+  std::vector<int> sc_v(sendcounts, sendcounts + st_.size());
+  std::vector<MPI_Aint> sd_v(sdispls, sdispls + st_.size());
+  std::vector<MPI_Datatype> stv(sendtypes, sendtypes + st_.size());
+  std::vector<int> rc_v(recvcounts, recvcounts + rf.size());
+  std::vector<MPI_Aint> rd_v(rdispls, rdispls + rf.size());
+  std::vector<MPI_Datatype> rtv(recvtypes, recvtypes + rf.size());
+  auto snap = icoll_reserve(c);
+  return icoll_spawn(
+      [=]() {
+        return c_neighbor_alltoallw(comm, *snap, sendbuf, sc_v.data(),
+                                    sd_v.data(), stv.data(), recvbuf,
+                                    rc_v.data(), rd_v.data(),
+                                    rtv.data());
+      },
+      comm, request);
 }
 
 // ------------------------------------------------------ one-sided RMA
