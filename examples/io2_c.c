@@ -1,0 +1,213 @@
+/* io2_c.c — round-5 MPI-IO tier-2 acceptance: file views (strided
+ * filetype tiling), collective and split collective IO, shared-pointer
+ * IO (independent + ordered), nonblocking IO, preallocate/atomicity,
+ * byte-offset/type-extent queries.  Reference shapes:
+ * ompi/mpi/c/{file_set_view,file_read_all,file_write_at_all_begin,
+ * file_write_shared,file_write_ordered,file_iread,file_preallocate,
+ * file_get_byte_offset}.c.  Run with >= 2 ranks. */
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include "zompi_mpi.h"
+
+#define CHECK(cond)                                                    \
+  do {                                                                 \
+    if (!(cond)) {                                                     \
+      fprintf(stderr, "FAIL %s:%d: %s\n", __FILE__, __LINE__, #cond);  \
+      MPI_Abort(MPI_COMM_WORLD, 2);                                    \
+    }                                                                  \
+  } while (0)
+
+int main(int argc, char **argv) {
+  CHECK(MPI_Init(&argc, &argv) == MPI_SUCCESS);
+  int rank, size;
+  MPI_Comm_rank(MPI_COMM_WORLD, &rank);
+  MPI_Comm_size(MPI_COMM_WORLD, &size);
+  CHECK(size >= 2);
+
+  char path[256];
+  snprintf(path, sizeof path, "/tmp/zompi_io2_%s.bin",
+           getenv("ZMPI_COORD_PORT") ? getenv("ZMPI_COORD_PORT") : "0");
+
+  MPI_File fh;
+  CHECK(MPI_File_open(MPI_COMM_WORLD, path,
+                      MPI_MODE_CREATE | MPI_MODE_RDWR, MPI_INFO_NULL,
+                      &fh) == MPI_SUCCESS);
+
+  /* ---- preallocate + collective write_at_all ---- */
+  CHECK(MPI_File_preallocate(fh, 4096) == MPI_SUCCESS);
+  MPI_Offset fsz = -1;
+  CHECK(MPI_File_get_size(fh, &fsz) == MPI_SUCCESS && fsz >= 4096);
+  int mine[4];
+  for (int i = 0; i < 4; i++) mine[i] = rank * 100 + i;
+  MPI_Status st;
+  CHECK(MPI_File_write_at_all(fh, (MPI_Offset)(rank * 16), mine, 4,
+                              MPI_INT, &st) == MPI_SUCCESS);
+  CHECK(st._count == 16);
+
+  /* everyone sees everyone's block after the collective */
+  int peer = (rank + 1) % size;
+  int got[4] = {-1, -1, -1, -1};
+  CHECK(MPI_File_read_at_all(fh, (MPI_Offset)(peer * 16), got, 4,
+                             MPI_INT, &st) == MPI_SUCCESS);
+  for (int i = 0; i < 4; i++) CHECK(got[i] == peer * 100 + i);
+
+  /* ---- split collective pair ---- */
+  int got2[4] = {0, 0, 0, 0};
+  CHECK(MPI_File_read_at_all_begin(fh, (MPI_Offset)(rank * 16), got2, 4,
+                                   MPI_INT) == MPI_SUCCESS);
+  CHECK(MPI_File_read_at_all_end(fh, got2, &st) == MPI_SUCCESS);
+  CHECK(st._count == 16 && got2[0] == rank * 100);
+
+  /* ---- view: each rank sees only its stride-slice of the file ----
+   * filetype = one int at offset rank, extent size ints; the file
+   * becomes a rank-interleaved array.  disp skips the 4096-byte
+   * preallocated header region. */
+  {
+    MPI_Datatype ft, rft;
+    CHECK(MPI_Type_vector(1, 1, 1, MPI_INT, &ft) == MPI_SUCCESS);
+    /* place my int at position `rank` within a size-int tile */
+    int bl[1] = {1};
+    int dp[1] = {rank};
+    MPI_Datatype base;
+    CHECK(MPI_Type_indexed(1, bl, dp, MPI_INT, &base) == MPI_SUCCESS);
+    CHECK(MPI_Type_create_resized(base, 0, size * (int)sizeof(int),
+                                  &rft) == MPI_SUCCESS);
+    CHECK(MPI_Type_commit(&rft) == MPI_SUCCESS);
+    CHECK(MPI_File_set_view(fh, 4096, MPI_INT, rft, "native",
+                            MPI_INFO_NULL) == MPI_SUCCESS);
+
+    /* byte offset of view element k = 4096 + (k*size + rank)*4 */
+    MPI_Offset bo = -1;
+    CHECK(MPI_File_get_byte_offset(fh, 2, &bo) == MPI_SUCCESS);
+    CHECK(bo == 4096 + (2 * size + rank) * (MPI_Offset)sizeof(int));
+
+    /* each rank writes 8 ints through its view (individual pointer) */
+    int vals[8];
+    for (int i = 0; i < 8; i++) vals[i] = rank * 1000 + i;
+    CHECK(MPI_File_write_all(fh, vals, 8, MPI_INT, &st) == MPI_SUCCESS);
+    CHECK(st._count == 32);
+    MPI_Offset pos = -1;
+    CHECK(MPI_File_get_position(fh, &pos) == MPI_SUCCESS && pos == 8);
+
+    /* read back through the view from the start */
+    CHECK(MPI_File_seek(fh, 0, MPI_SEEK_SET) == MPI_SUCCESS);
+    int back[8];
+    memset(back, 0, sizeof back);
+    CHECK(MPI_File_read_all(fh, back, 8, MPI_INT, &st) == MPI_SUCCESS);
+    for (int i = 0; i < 8; i++) CHECK(back[i] == rank * 1000 + i);
+
+    /* the raw file really is interleaved: reset to the default view
+     * and inspect a full tile */
+    CHECK(MPI_File_set_view(fh, 0, MPI_BYTE, MPI_BYTE, "native",
+                            MPI_INFO_NULL) == MPI_SUCCESS);
+    int tile0[64];
+    CHECK(MPI_File_read_at(fh, 4096, tile0, size, MPI_INT, &st) ==
+          MPI_SUCCESS);
+    for (int r = 0; r < size; r++) CHECK(tile0[r] == r * 1000);
+    (void)tile0;
+    MPI_Type_free(&ft);
+    MPI_Type_free(&base);
+    MPI_Type_free(&rft);
+  }
+
+  /* ---- view introspection ---- */
+  {
+    MPI_Offset disp = -1;
+    MPI_Datatype et = -5, ft2 = -5;
+    char rep[MPI_MAX_DATAREP_STRING];
+    CHECK(MPI_File_get_view(fh, &disp, &et, &ft2, rep) == MPI_SUCCESS);
+    CHECK(disp == 0 && et == MPI_BYTE && strcmp(rep, "native") == 0);
+    MPI_Offset text = -1;
+    CHECK(MPI_File_get_type_extent(fh, MPI_DOUBLE, &text) ==
+          MPI_SUCCESS && text == 8);
+    int at = -1;
+    CHECK(MPI_File_set_atomicity(fh, 1) == MPI_SUCCESS);
+    CHECK(MPI_File_get_atomicity(fh, &at) == MPI_SUCCESS && at == 1);
+  }
+
+  /* ---- shared pointer: every rank appends one stamped record; all
+   * records land, none overlap ---- */
+  {
+    CHECK(MPI_File_seek_shared(fh, 8192 / (MPI_Offset)sizeof(char),
+                               MPI_SEEK_SET) == MPI_SUCCESS);
+    long long rec[2] = {0x5A5A0000LL + rank, rank};
+    CHECK(MPI_File_write_shared(fh, rec, 2, MPI_LONG_LONG, &st) ==
+          MPI_SUCCESS);
+    MPI_Barrier(MPI_COMM_WORLD);
+    MPI_Offset sp = -1;
+    CHECK(MPI_File_get_position_shared(fh, &sp) == MPI_SUCCESS);
+    CHECK(sp == 8192 + size * 16);
+    /* validate every record appears exactly once */
+    if (rank == 0) {
+      long long *all = malloc((size_t)size * 16);
+      CHECK(MPI_File_read_at(fh, 8192, all, 2 * size, MPI_LONG_LONG,
+                             &st) == MPI_SUCCESS);
+      int *seen = calloc((size_t)size, sizeof(int));
+      for (int r = 0; r < size; r++) {
+        long long who = all[2 * r + 1];
+        CHECK(who >= 0 && who < size);
+        CHECK(all[2 * r] == 0x5A5A0000LL + who);
+        seen[who]++;
+      }
+      for (int r = 0; r < size; r++) CHECK(seen[r] == 1);
+      free(all);
+      free(seen);
+    }
+    MPI_Barrier(MPI_COMM_WORLD);
+  }
+
+  /* ---- ordered shared IO: rank order is deterministic ---- */
+  {
+    CHECK(MPI_File_seek_shared(fh, 16384, MPI_SEEK_SET) == MPI_SUCCESS);
+    int stamp[2] = {rank, rank * 7};
+    CHECK(MPI_File_write_ordered(fh, stamp, 2, MPI_INT, &st) ==
+          MPI_SUCCESS);
+    int all2[64];
+    CHECK(MPI_File_read_at_all(fh, 16384, all2, 2 * size, MPI_INT,
+                               &st) == MPI_SUCCESS);
+    for (int r = 0; r < size; r++) {
+      CHECK(all2[2 * r] == r); /* rank order, not arrival order */
+      CHECK(all2[2 * r + 1] == r * 7);
+    }
+    /* ordered split pair */
+    CHECK(MPI_File_seek_shared(fh, 20480, MPI_SEEK_SET) == MPI_SUCCESS);
+    CHECK(MPI_File_write_ordered_begin(fh, stamp, 2, MPI_INT) ==
+          MPI_SUCCESS);
+    CHECK(MPI_File_write_ordered_end(fh, stamp, &st) == MPI_SUCCESS);
+    CHECK(st._count == 8);
+  }
+
+  /* ---- nonblocking IO overlaps ---- */
+  {
+    int wbuf[4] = {rank, rank + 1, rank + 2, rank + 3};
+    MPI_Request wr;
+    CHECK(MPI_File_iwrite_at(fh, (MPI_Offset)(24576 + rank * 16), wbuf,
+                             4, MPI_INT, &wr) == MPI_SUCCESS);
+    CHECK(MPI_Wait(&wr, &st) == MPI_SUCCESS && st._count == 16);
+    int rbuf[4] = {-1, -1, -1, -1};
+    MPI_Request rr;
+    CHECK(MPI_File_iread_at(fh, (MPI_Offset)(24576 + rank * 16), rbuf,
+                            4, MPI_INT, &rr) == MPI_SUCCESS);
+    CHECK(MPI_Wait(&rr, &st) == MPI_SUCCESS && st._count == 16);
+    for (int i = 0; i < 4; i++) CHECK(rbuf[i] == rank + i);
+
+    /* shared-pointer nonblocking append */
+    CHECK(MPI_File_seek_shared(fh, 28672, MPI_SEEK_SET) == MPI_SUCCESS);
+    MPI_Request sr;
+    CHECK(MPI_File_iwrite_shared(fh, wbuf, 4, MPI_INT, &sr) ==
+          MPI_SUCCESS);
+    CHECK(MPI_Wait(&sr, &st) == MPI_SUCCESS && st._count == 16);
+    MPI_Barrier(MPI_COMM_WORLD);
+    MPI_Offset sp = -1;
+    CHECK(MPI_File_get_position_shared(fh, &sp) == MPI_SUCCESS);
+    CHECK(sp == 28672 + size * 16);
+  }
+
+  CHECK(MPI_File_close(&fh) == MPI_SUCCESS);
+  if (rank == 0) MPI_File_delete(path, MPI_INFO_NULL);
+  MPI_Barrier(MPI_COMM_WORLD);
+  if (rank == 0) printf("io2_c OK on %d ranks\n", size);
+  MPI_Finalize();
+  return 0;
+}

@@ -108,28 +108,35 @@ int main(int argc, char **argv) {
 
     /* neighbor order for 1-D cart: [minus, plus] = [left, right] */
 
-    /* allgatherv: ragged blocks — rank r contributes r+1 ints */
+    /* allgatherv: ragged blocks — rank r contributes CONTRIB(r) ints,
+     * capped so the source array bound holds at ANY comm size */
     {
+#define CONTRIB(r) ((r) % 8 + 1)
       int mine[8];
-      for (int i = 0; i <= rank && i < 8; i++) mine[i] = rank * 10 + i;
-      int rc2[2] = {left + 1, right + 1};
-      int dp[2] = {0, left + 1};
-      int *out = calloc((size_t)(left + right + 2), sizeof(int));
-      CHECK(MPI_Neighbor_allgatherv(mine, rank + 1, MPI_INT, out, rc2,
-                                    dp, MPI_INT, ring) == MPI_SUCCESS);
-      for (int i = 0; i <= left; i++) CHECK(out[i] == left * 10 + i);
-      for (int i = 0; i <= right; i++)
-        CHECK(out[left + 1 + i] == right * 10 + i);
+      for (int i = 0; i < CONTRIB(rank); i++) mine[i] = rank * 10 + i;
+      int rc2[2] = {CONTRIB(left), CONTRIB(right)};
+      int dp[2] = {0, CONTRIB(left)};
+      int *out =
+          calloc((size_t)(CONTRIB(left) + CONTRIB(right)), sizeof(int));
+      CHECK(MPI_Neighbor_allgatherv(mine, CONTRIB(rank), MPI_INT, out,
+                                    rc2, dp, MPI_INT, ring) ==
+            MPI_SUCCESS);
+      for (int i = 0; i < CONTRIB(left); i++)
+        CHECK(out[i] == left * 10 + i);
+      for (int i = 0; i < CONTRIB(right); i++)
+        CHECK(out[CONTRIB(left) + i] == right * 10 + i);
 
       /* nonblocking flavor */
-      memset(out, 0, (size_t)(left + right + 2) * sizeof(int));
+      memset(out, 0,
+             (size_t)(CONTRIB(left) + CONTRIB(right)) * sizeof(int));
       MPI_Request nreq;
-      CHECK(MPI_Ineighbor_allgatherv(mine, rank + 1, MPI_INT, out, rc2,
-                                     dp, MPI_INT, ring, &nreq) ==
+      CHECK(MPI_Ineighbor_allgatherv(mine, CONTRIB(rank), MPI_INT, out,
+                                     rc2, dp, MPI_INT, ring, &nreq) ==
             MPI_SUCCESS);
       CHECK(MPI_Wait(&nreq, MPI_STATUS_IGNORE) == MPI_SUCCESS);
-      CHECK(out[0] == left * 10 && out[left + 1] == right * 10);
+      CHECK(out[0] == left * 10 && out[CONTRIB(left)] == right * 10);
       free(out);
+#undef CONTRIB
     }
 
     /* alltoallv: distinct block to each neighbor */

@@ -174,6 +174,17 @@ class TestPureC:
         outs = _run_example(shim, tmp_path_factory, "nbrw_c.c", n)
         assert f"nbrw_c OK on {n} ranks" in outs[0]
 
+    @pytest.mark.parametrize("n", [2, 4])
+    def test_io2_example(self, shim, tmp_path_factory, n):
+        """Round-5 MPI-IO tier 2: strided file views (write through the
+        view, verify raw interleaving), collective + split collective
+        IO, shared-pointer appends (every record exactly once),
+        rank-ordered shared IO, nonblocking IO, preallocate/atomicity,
+        byte-offset query."""
+        outs = _run_example(shim, tmp_path_factory, "io2_c.c", n,
+                            timeout=90)
+        assert f"io2_c OK on {n} ranks" in outs[0]
+
     def test_are_fatal_default_aborts(self, shim, tmp_path):
         """The MPI default handler is ERRORS_ARE_FATAL: an invalid-rank
         send without an installed handler must kill the process with a

@@ -66,6 +66,7 @@
 #include <arpa/inet.h>
 #include <fcntl.h>
 #include <sys/mman.h>
+#include <sys/file.h>
 #include <netinet/in.h>
 #include <sys/stat.h>
 #include <sys/wait.h>
@@ -1250,12 +1251,35 @@ struct FileObj {
   int fd = -1;
   int amode = 0;
   int comm = MPI_COMM_WORLD;
-  int64_t pointer = 0;  // individual pointer, bytes
+  int64_t pointer = 0;  // individual pointer, ETYPES (bytes w/ default view)
   std::string path;
+  // file view (io_ompio's etype/filetype template, byte-flattened):
+  // the filetype tiles the file from `disp`; IO addresses payload
+  // bytes inside the tiles.  Default view = identity (etype BYTE,
+  // filetype BYTE) — offsets are then plain bytes.
+  int64_t view_disp = 0;
+  MPI_Datatype view_etype = 0 /* MPI_BYTE */;
+  MPI_Datatype view_ftype = 0;
+  std::vector<std::pair<int64_t, int64_t>> vblocks;  // (off,len) bytes
+  int64_t vtile = 1;      // filetype extent (bytes)
+  int64_t vpayload = 1;   // payload bytes per tile
+  int64_t etype_size = 1;
+  bool identity_view = true;
+  // shared file pointer (sharedfp/lockedfile's shape): sidecar file,
+  // flock-serialized fetch-and-add; value in ETYPES
+  std::string sfp_path;
+  bool atomic_mode = false;
+  // one outstanding split collective (read/write_all|ordered_begin)
+  bool split_active = false;
+  MPI_Status split_status{};
 };
 
 std::map<int, FileObj> g_files;
 int g_next_file = 1;
+// guards map MUTATION vs the nonblocking-IO threads' lookups; node
+// pointers stay valid across inserts (std::map), and closing a file
+// with IO in flight is erroneous per MPI, so held FileObj*s are safe
+std::mutex g_files_mu;
 
 CommObj *lookup_comm(MPI_Comm c) {
   auto it = g_comms.find(c);
@@ -4933,6 +4957,37 @@ int MPI_Alltoallv(const void *sendbuf, const int sendcounts[],
                         recvbuf, recvcounts, rdispls, recvtype));
 }
 
+// alltoallw.c IN_PLACE: everything comes from the receive side; clone
+// each peer's block (byte displacements, per-peer types) into `tmp`.
+// Validates counts/displacements BEFORE dereferencing anything.
+static int alltoallw_inplace_clone(int n, const void *recvbuf,
+                                   const int recvcounts[],
+                                   const int rdispls[],
+                                   const MPI_Datatype recvtypes[],
+                                   std::vector<char> &tmp) {
+  int64_t span = 0;
+  for (int r = 0; r < n; r++) {
+    if (recvcounts[r] < 0 || rdispls[r] < 0) return MPI_ERR_ARG;
+    DtView rv;
+    if (recvcounts[r] == 0) continue;
+    if (!resolve_dtype(recvtypes[r], rv)) return MPI_ERR_TYPE;
+    int64_t end = rdispls[r] + (int64_t)slot_bytes(rv, recvcounts[r]);
+    if (end > span) span = end;
+  }
+  tmp.assign((size_t)span, 0);
+  for (int r = 0; r < n; r++) {
+    if (recvcounts[r] == 0) continue;
+    DtView rv;
+    resolve_dtype(recvtypes[r], rv);
+    std::vector<char> packed;
+    pack_dtype((const char *)recvbuf + rdispls[r], recvcounts[r], rv,
+               packed);
+    unpack_dtype(tmp.data() + rdispls[r], recvcounts[r], rv,
+                 packed.data(), packed.size());
+  }
+  return MPI_SUCCESS;
+}
+
 int MPI_Alltoallw(const void *sendbuf, const int sendcounts[],
                   const int sdispls[], const MPI_Datatype sendtypes[],
                   void *recvbuf, const int recvcounts[],
@@ -4943,33 +4998,9 @@ int MPI_Alltoallw(const void *sendbuf, const int sendcounts[],
   int n = (int)c->group.size();
   std::vector<char> tmp;
   if (sendbuf == MPI_IN_PLACE) {
-    // alltoallw.c IN_PLACE: everything comes from the receive side;
-    // clone each peer's block (byte displacements, per-peer types).
-    // Validate BEFORE dereferencing — the sibling path's negativity
-    // checks live in c_alltoallw, which runs after this clone.
-    int64_t span = 0;
-    for (int r = 0; r < n; r++) {
-      if (recvcounts[r] < 0 || rdispls[r] < 0)
-        return dispatch_comm_err(comm, MPI_ERR_ARG);
-      DtView rv;
-      if (recvcounts[r] == 0) continue;
-      if (!resolve_dtype(recvtypes[r], rv))
-        return dispatch_comm_err(comm, MPI_ERR_TYPE);
-      int64_t end = rdispls[r] +
-                    (int64_t)slot_bytes(rv, recvcounts[r]);
-      if (end > span) span = end;
-    }
-    tmp.assign((size_t)span, 0);
-    for (int r = 0; r < n; r++) {
-      if (recvcounts[r] == 0) continue;
-      DtView rv;
-      resolve_dtype(recvtypes[r], rv);
-      std::vector<char> packed;
-      pack_dtype((const char *)recvbuf + rdispls[r], recvcounts[r], rv,
-                 packed);
-      unpack_dtype(tmp.data() + rdispls[r], recvcounts[r], rv,
-                   packed.data(), packed.size());
-    }
+    int rc = alltoallw_inplace_clone(n, recvbuf, recvcounts, rdispls,
+                                     recvtypes, tmp);
+    if (rc != MPI_SUCCESS) return dispatch_comm_err(comm, rc);
     sendbuf = tmp.data();
     sendcounts = recvcounts;
     sdispls = rdispls;
@@ -5060,6 +5091,7 @@ int MPI_Type_get_extent(MPI_Datatype dt, long *lb, long *extent) {
 namespace {
 
 FileObj *lookup_file(MPI_File fh) {
+  std::lock_guard<std::mutex> lk(g_files_mu);
   auto it = g_files.find(fh);
   return it == g_files.end() ? nullptr : &it->second;
 }
@@ -5073,6 +5105,105 @@ void file_status(MPI_Status *status, size_t nbytes) {
     status->_count = (long long)nbytes;
     status->_cancelled = 0;
   }
+}
+
+// ---- file views (io_ompio's etype/filetype template) ----
+// Map payload byte `pos` within the tiled filetype to its absolute
+// file offset runs; fn(file_off, payload_delta, len) per run.  The
+// identity view short-circuits to one run.  (std::function rather
+// than a template: this sits inside the extern "C" block.)
+void view_runs(FileObj *f, int64_t payload_off, int64_t nbytes,
+               const std::function<void(int64_t, int64_t, int64_t)> &fn) {
+  if (f->identity_view) {
+    fn(f->view_disp + payload_off, (int64_t)0, nbytes);
+    return;
+  }
+  int64_t done = 0;
+  while (done < nbytes) {
+    int64_t pos = payload_off + done;
+    int64_t tile = pos / f->vpayload;
+    int64_t rem = pos % f->vpayload;
+    int64_t acc = 0;
+    for (auto &b : f->vblocks) {
+      if (rem < acc + b.second) {
+        int64_t inblk = rem - acc;
+        int64_t len = b.second - inblk;
+        if (len > nbytes - done) len = nbytes - done;
+        fn(f->view_disp + tile * f->vtile + b.first + inblk, done, len);
+        done += len;
+        break;
+      }
+      acc += b.second;
+    }
+  }
+}
+
+// view-aware positioned IO on PAYLOAD bytes; reads stop at the first
+// short read (EOF semantics), writes demand completeness
+// returns bytes read (stopping at EOF), or -1 on a REAL IO error —
+// EBADF/EIO must surface as errors, not as success-at-EOF
+int64_t view_pread(FileObj *f, int64_t payload_off, char *buf,
+                   int64_t nbytes) {
+  int64_t total = 0;
+  bool stop = false, err = false;
+  view_runs(f, payload_off, nbytes,
+            [&](int64_t off, int64_t delta, int64_t len) {
+              if (stop) return;
+              ssize_t got = pread(f->fd, buf + delta, (size_t)len,
+                                  (off_t)off);
+              if (got < 0) {
+                err = true;
+                stop = true;
+                return;
+              }
+              total += got;
+              if (got < len) stop = true;
+            });
+  return err ? -1 : total;
+}
+
+int view_pwrite(FileObj *f, int64_t payload_off, const char *buf,
+                int64_t nbytes, int64_t *wrote) {
+  int64_t total = 0;
+  bool fail = false;
+  view_runs(f, payload_off, nbytes,
+            [&](int64_t off, int64_t delta, int64_t len) {
+              if (fail) return;
+              ssize_t put = pwrite(f->fd, buf + delta, (size_t)len,
+                                   (off_t)off);
+              if (put != (ssize_t)len) {
+                fail = true;
+                if (put > 0) total += put;
+                return;
+              }
+              total += put;
+            });
+  *wrote = total;
+  return fail ? MPI_ERR_OTHER : MPI_SUCCESS;
+}
+
+// ---- shared file pointer (sharedfp/lockedfile's shape) ----
+// flock-serialized sidecar holding the pointer in ETYPES; every rank
+// of every process sees one serialization point.
+int sfp_update(FileObj *f, int64_t delta, bool set, int64_t setval,
+               int64_t *old_out) {
+  int sfd = ::open(f->sfp_path.c_str(), O_CREAT | O_RDWR, 0644);
+  if (sfd < 0) return MPI_ERR_FILE;
+  if (flock(sfd, LOCK_EX) != 0) {
+    ::close(sfd);
+    return MPI_ERR_OTHER;
+  }
+  int64_t cur = 0;
+  ssize_t got = pread(sfd, &cur, sizeof cur, 0);
+  if (got != (ssize_t)sizeof cur) cur = 0;
+  if (old_out) *old_out = cur;
+  int64_t next = set ? setval : cur + delta;
+  int rc = MPI_SUCCESS;
+  if (pwrite(sfd, &next, sizeof next, 0) != (ssize_t)sizeof next)
+    rc = MPI_ERR_OTHER;
+  flock(sfd, LOCK_UN);
+  ::close(sfd);
+  return rc;
 }
 
 }  // namespace
@@ -5114,12 +5245,30 @@ int MPI_File_open(MPI_Comm comm, const char *filename, int amode,
   f.amode = amode;
   f.comm = comm;
   f.path = filename;
+  // shared file pointer sidecar: rank 0 resets it (the shared pointer
+  // starts at zero on open, MPI-3.1 13.6.4), peers see it post-barrier
+  f.sfp_path = std::string(filename) + ".zsfp";
+  if (c->local_rank == 0) {
+    int sfd = ::open(f.sfp_path.c_str(), O_CREAT | O_RDWR | O_TRUNC,
+                     0644);
+    if (sfd >= 0) {
+      int64_t zero = 0;
+      (void)!write(sfd, &zero, sizeof zero);
+      ::close(sfd);
+    }
+  }
+  rc = c_barrier(*c);
+  if (rc) return rc;
   if (amode & MPI_MODE_APPEND) {
     struct stat st{};
     if (fstat(fd, &st) == 0) f.pointer = (int64_t)st.st_size;
   }
-  int handle = g_next_file++;
-  g_files[handle] = f;
+  int handle;
+  {
+    std::lock_guard<std::mutex> lk(g_files_mu);
+    handle = g_next_file++;
+    g_files[handle] = f;
+  }
   *fh = handle;
   return MPI_SUCCESS;
 }
@@ -5130,11 +5279,16 @@ int MPI_File_close(MPI_File *fh) {
   CommObj *c = lookup_comm(f->comm);
   if (c) c_barrier(*c);  // all IO quiescent before any unlink
   ::close(f->fd);
-  if ((f->amode & MPI_MODE_DELETE_ON_CLOSE) && c && c->local_rank == 0)
-    ::unlink(f->path.c_str());
+  if (c && c->local_rank == 0) {
+    ::unlink(f->sfp_path.c_str());  // sidecar dies with the handle
+    if (f->amode & MPI_MODE_DELETE_ON_CLOSE) ::unlink(f->path.c_str());
+  }
   if (c) c_barrier(*c);
   release_errh_ref(g_file_errh, *fh);
-  g_files.erase(*fh);
+  {
+    std::lock_guard<std::mutex> lk(g_files_mu);
+    g_files.erase(*fh);
+  }
   *fh = MPI_FILE_NULL;
   return MPI_SUCCESS;
 }
@@ -5150,13 +5304,15 @@ int MPI_File_read_at(MPI_File fh, MPI_Offset offset, void *buf, int count,
   DtView v;
   if (!resolve_dtype(dt, v)) return MPI_ERR_TYPE;
   size_t want = (size_t)count * v.elems_per_item() * v.di.item;
-  ssize_t got;
+  // `offset` is in ETYPES of the current view (bytes for the default)
+  int64_t payload = offset * f->etype_size;
+  int64_t got;
   if (v.contiguous()) {
-    got = pread(f->fd, buf, want, (off_t)offset);  // no staging copy
+    got = view_pread(f, payload, (char *)buf, (int64_t)want);
     if (got < 0) return MPI_ERR_OTHER;
   } else {
     std::vector<char> tmp(want);
-    got = pread(f->fd, tmp.data(), want, (off_t)offset);
+    got = view_pread(f, payload, tmp.data(), (int64_t)want);
     if (got < 0) return MPI_ERR_OTHER;
     // short read past EOF: deliver what exists (MPI count semantics)
     unpack_dtype(buf, count, v, tmp.data(), (size_t)got);
@@ -5171,18 +5327,20 @@ int MPI_File_write_at(MPI_File fh, MPI_Offset offset, const void *buf,
   if (!f) return MPI_ERR_FILE;
   DtView v;
   if (!resolve_dtype(dt, v)) return MPI_ERR_TYPE;
-  ssize_t put;
-  size_t nbytes;
+  int64_t payload = offset * f->etype_size;
+  int64_t put = 0;
+  int rc;
   if (v.contiguous()) {
-    nbytes = (size_t)count * v.elems_per_item() * v.di.item;
-    put = pwrite(f->fd, buf, nbytes, (off_t)offset);  // no staging copy
+    size_t nbytes = (size_t)count * v.elems_per_item() * v.di.item;
+    rc = view_pwrite(f, payload, (const char *)buf, (int64_t)nbytes,
+                     &put);
   } else {
     std::vector<char> packed;
     pack_dtype(buf, count, v, packed);
-    nbytes = packed.size();
-    put = pwrite(f->fd, packed.data(), nbytes, (off_t)offset);
+    rc = view_pwrite(f, payload, packed.data(), (int64_t)packed.size(),
+                     &put);
   }
-  if (put < 0 || (size_t)put != nbytes) return MPI_ERR_OTHER;
+  if (rc != MPI_SUCCESS) return rc;
   file_status(status, (size_t)put);
   return MPI_SUCCESS;
 }
@@ -5198,7 +5356,8 @@ int MPI_File_read(MPI_File fh, void *buf, int count, MPI_Datatype dt,
   MPI_Status st{};
   int rc = MPI_File_read_at(fh, off, buf, count, dt, &st);
   if (rc == MPI_SUCCESS) {
-    f->pointer = off + st._count;
+    // the pointer advances in ETYPES; the status carries bytes
+    f->pointer = off + st._count / (f->etype_size ? f->etype_size : 1);
     if (status) *status = st;
   }
   return rc;
@@ -5209,11 +5368,11 @@ int MPI_File_write(MPI_File fh, const void *buf, int count,
   FileObj *f = lookup_file(fh);
   if (!f) return MPI_ERR_FILE;
   int64_t off = f->pointer;
-  int rc = MPI_File_write_at(fh, off, buf, count, dt, status);
+  MPI_Status st{};
+  int rc = MPI_File_write_at(fh, off, buf, count, dt, &st);
   if (rc == MPI_SUCCESS) {
-    DtView v;
-    resolve_dtype(dt, v);
-    f->pointer = off + (int64_t)count * v.elems_per_item() * v.di.item;
+    f->pointer = off + st._count / (f->etype_size ? f->etype_size : 1);
+    if (status) *status = st;
   }
   return rc;
 }
@@ -5228,7 +5387,10 @@ int MPI_File_seek(MPI_File fh, MPI_Offset offset, int whence) {
   } else if (whence == MPI_SEEK_END) {
     struct stat st{};
     if (fstat(f->fd, &st) != 0) return MPI_ERR_OTHER;
-    f->pointer = (int64_t)st.st_size + (int64_t)offset;
+    // the pointer is in ETYPES of the current view
+    f->pointer = (int64_t)st.st_size /
+                     (f->etype_size ? f->etype_size : 1) +
+                 (int64_t)offset;
   } else {
     return MPI_ERR_ARG;
   }
@@ -5272,6 +5434,558 @@ int MPI_File_sync(MPI_File fh) {
   fsync(f->fd);
   CommObj *c = lookup_comm(f->comm);
   return c ? c_barrier(*c) : MPI_SUCCESS;
+}
+
+// -------------------------------------------- MPI-IO tier 2 (round 5)
+// Views (file_set_view.c), collective and split collective IO
+// (file_read_all.c, file_read_all_begin.c), shared-pointer IO
+// (file_read_shared.c, file_read_ordered.c), nonblocking IO
+// (file_iread.c family), preallocate/atomicity.
+
+int MPI_File_set_view(MPI_File fh, MPI_Offset disp, MPI_Datatype etype,
+                      MPI_Datatype filetype, const char *datarep,
+                      MPI_Info) {
+  // file_set_view.c: collective; resets both pointers.  The filetype
+  // tiles the file from `disp`; only "native" representation (the
+  // cluster is homogeneous — external32 lives on the Python plane).
+  FileObj *f = lookup_file(fh);
+  if (!f) return MPI_ERR_FILE;
+  if (datarep && strcmp(datarep, "native") != 0) return MPI_ERR_ARG;
+  DtView ev, fv;
+  if (!resolve_dtype(etype, ev) || !resolve_dtype(filetype, fv))
+    return MPI_ERR_TYPE;
+  if (disp < 0) return MPI_ERR_ARG;
+  int64_t esize = ev.elems_per_item() * (int64_t)ev.di.item;
+  if (esize <= 0) return MPI_ERR_TYPE;
+  // byte-flatten one filetype item
+  std::vector<std::pair<int64_t, int64_t>> blocks;
+  int64_t item = (int64_t)fv.di.item;
+  if (!fv.derived) {
+    blocks.push_back({0, item});
+  } else {
+    for (auto &b : fv.derived->blocks)
+      blocks.push_back({b.first * item, b.second * item});
+  }
+  int64_t tile = (fv.derived ? fv.derived->extent : 1) * item;
+  int64_t payload = 0;
+  for (auto &b : blocks) payload += b.second;
+  if (payload <= 0 || payload % esize)
+    return MPI_ERR_ARG;  // filetype must hold whole etypes
+  f->view_disp = (int64_t)disp;
+  f->view_etype = etype;
+  f->view_ftype = filetype;
+  f->vblocks = std::move(blocks);
+  f->vtile = tile;
+  f->vpayload = payload;
+  f->etype_size = esize;
+  // identity = one gap-free block tiling the file: the single-run
+  // fast path already adds view_disp, and the etype size only scales
+  // offsets (callers convert before mapping), so neither disqualifies
+  f->identity_view = f->vblocks.size() == 1 &&
+                     f->vblocks[0].first == 0 &&
+                     f->vpayload == f->vtile;
+  f->pointer = 0;
+  int rc = sfp_update(f, 0, true, 0, nullptr);  // shared ptr resets too
+  if (rc != MPI_SUCCESS) return rc;
+  CommObj *c = lookup_comm(f->comm);
+  return c ? c_barrier(*c) : MPI_SUCCESS;
+}
+
+int MPI_File_get_view(MPI_File fh, MPI_Offset *disp, MPI_Datatype *etype,
+                      MPI_Datatype *filetype, char *datarep) {
+  FileObj *f = lookup_file(fh);
+  if (!f) return MPI_ERR_FILE;
+  *disp = (MPI_Offset)f->view_disp;
+  *etype = f->view_etype;
+  *filetype = f->view_ftype;
+  if (datarep) strcpy(datarep, "native");
+  return MPI_SUCCESS;
+}
+
+int MPI_File_get_byte_offset(MPI_File fh, MPI_Offset offset,
+                             MPI_Offset *byte_offset) {
+  // file_get_byte_offset.c: absolute byte of a view offset (etypes)
+  FileObj *f = lookup_file(fh);
+  if (!f) return MPI_ERR_FILE;
+  int64_t payload = offset * f->etype_size;
+  if (f->identity_view) {
+    *byte_offset = (MPI_Offset)(f->view_disp + payload);
+    return MPI_SUCCESS;
+  }
+  int64_t tile = payload / f->vpayload;
+  int64_t rem = payload % f->vpayload;
+  int64_t acc = 0, inoff = 0;
+  for (auto &b : f->vblocks) {
+    if (rem < acc + b.second) {
+      inoff = b.first + (rem - acc);
+      break;
+    }
+    acc += b.second;
+  }
+  *byte_offset = (MPI_Offset)(f->view_disp + tile * f->vtile + inoff);
+  return MPI_SUCCESS;
+}
+
+int MPI_File_get_type_extent(MPI_File fh, MPI_Datatype dt,
+                             MPI_Offset *extent) {
+  // native representation: file extent == memory extent
+  if (!lookup_file(fh)) return MPI_ERR_FILE;
+  long lb, ext;
+  int rc = MPI_Type_get_extent(dt, &lb, &ext);
+  if (rc != MPI_SUCCESS) return rc;
+  *extent = (MPI_Offset)ext;
+  return MPI_SUCCESS;
+}
+
+int MPI_File_preallocate(MPI_File fh, MPI_Offset size) {
+  // collective; grows the file to at least `size` bytes
+  FileObj *f = lookup_file(fh);
+  if (!f) return MPI_ERR_FILE;
+  if (size < 0) return MPI_ERR_ARG;
+  CommObj *c = lookup_comm(f->comm);
+  int rc = MPI_SUCCESS;
+  if (!c || c->local_rank == 0) {
+    struct stat st{};
+    if (fstat(f->fd, &st) != 0) rc = MPI_ERR_OTHER;
+    else if (st.st_size < (off_t)size &&
+             ftruncate(f->fd, (off_t)size) != 0)
+      rc = MPI_ERR_OTHER;
+  }
+  return c ? (c_barrier(*c), rc) : rc;
+}
+
+int MPI_File_set_atomicity(MPI_File fh, int flag) {
+  // every write here is one positioned syscall (kernel-atomic), so
+  // atomic mode is a recorded promise the engine already keeps
+  FileObj *f = lookup_file(fh);
+  if (!f) return MPI_ERR_FILE;
+  f->atomic_mode = flag != 0;
+  CommObj *c = lookup_comm(f->comm);
+  return c ? c_barrier(*c) : MPI_SUCCESS;
+}
+
+int MPI_File_get_atomicity(MPI_File fh, int *flag) {
+  FileObj *f = lookup_file(fh);
+  if (!f) return MPI_ERR_FILE;
+  *flag = f->atomic_mode ? 1 : 0;
+  return MPI_SUCCESS;
+}
+
+// ---- collective IO: the engine's independent IO is already safe for
+// concurrent disjoint accesses; the collective forms add the
+// synchronization the interface promises (fcoll/individual's shape) ----
+
+int MPI_File_read_at_all(MPI_File fh, MPI_Offset offset, void *buf,
+                         int count, MPI_Datatype dt,
+                         MPI_Status *status) {
+  FileObj *f = lookup_file(fh);
+  if (!f) return MPI_ERR_FILE;
+  CommObj *c = lookup_comm(f->comm);
+  if (c) c_barrier(*c);  // writers before this collective are visible
+  return MPI_File_read_at(fh, offset, buf, count, dt, status);
+}
+
+int MPI_File_write_at_all(MPI_File fh, MPI_Offset offset,
+                          const void *buf, int count, MPI_Datatype dt,
+                          MPI_Status *status) {
+  FileObj *f = lookup_file(fh);
+  if (!f) return MPI_ERR_FILE;
+  int rc = MPI_File_write_at(fh, offset, buf, count, dt, status);
+  CommObj *c = lookup_comm(f->comm);
+  if (c) c_barrier(*c);  // all blocks on disk before anyone returns
+  return rc;
+}
+
+int MPI_File_read_all(MPI_File fh, void *buf, int count, MPI_Datatype dt,
+                      MPI_Status *status) {
+  FileObj *f = lookup_file(fh);
+  if (!f) return MPI_ERR_FILE;
+  CommObj *c = lookup_comm(f->comm);
+  if (c) c_barrier(*c);
+  return MPI_File_read(fh, buf, count, dt, status);
+}
+
+int MPI_File_write_all(MPI_File fh, const void *buf, int count,
+                       MPI_Datatype dt, MPI_Status *status) {
+  FileObj *f = lookup_file(fh);
+  if (!f) return MPI_ERR_FILE;
+  int rc = MPI_File_write(fh, buf, count, dt, status);
+  CommObj *c = lookup_comm(f->comm);
+  if (c) c_barrier(*c);
+  return rc;
+}
+
+// ---- split collectives: begin performs the operation, end hands the
+// stashed status back (file_read_all_begin.c semantics allow the
+// implementation to complete eagerly; one outstanding pair per file) ----
+
+namespace {
+
+int split_begin(FileObj *f, int rc, const MPI_Status &st) {
+  if (f->split_active) return MPI_ERR_OTHER;  // one pair at a time
+  f->split_active = true;
+  f->split_status = st;
+  return rc;
+}
+
+int split_end(MPI_File fh, MPI_Status *status) {
+  FileObj *f = lookup_file(fh);
+  if (!f) return MPI_ERR_FILE;
+  if (!f->split_active) return MPI_ERR_OTHER;
+  f->split_active = false;
+  if (status) *status = f->split_status;
+  return MPI_SUCCESS;
+}
+
+}  // namespace
+
+int MPI_File_read_all_begin(MPI_File fh, void *buf, int count,
+                            MPI_Datatype dt) {
+  FileObj *f = lookup_file(fh);
+  if (!f) return MPI_ERR_FILE;
+  if (f->split_active) return MPI_ERR_OTHER;  // before any side effect
+  MPI_Status st{};
+  int rc = MPI_File_read_all(fh, buf, count, dt, &st);
+  return split_begin(f, rc, st);
+}
+
+int MPI_File_read_all_end(MPI_File fh, void *, MPI_Status *status) {
+  return split_end(fh, status);
+}
+
+int MPI_File_write_all_begin(MPI_File fh, const void *buf, int count,
+                             MPI_Datatype dt) {
+  FileObj *f = lookup_file(fh);
+  if (!f) return MPI_ERR_FILE;
+  if (f->split_active) return MPI_ERR_OTHER;
+  MPI_Status st{};
+  int rc = MPI_File_write_all(fh, buf, count, dt, &st);
+  return split_begin(f, rc, st);
+}
+
+int MPI_File_write_all_end(MPI_File fh, const void *, MPI_Status *status) {
+  return split_end(fh, status);
+}
+
+int MPI_File_read_at_all_begin(MPI_File fh, MPI_Offset offset, void *buf,
+                               int count, MPI_Datatype dt) {
+  FileObj *f = lookup_file(fh);
+  if (!f) return MPI_ERR_FILE;
+  if (f->split_active) return MPI_ERR_OTHER;
+  MPI_Status st{};
+  int rc = MPI_File_read_at_all(fh, offset, buf, count, dt, &st);
+  return split_begin(f, rc, st);
+}
+
+int MPI_File_read_at_all_end(MPI_File fh, void *, MPI_Status *status) {
+  return split_end(fh, status);
+}
+
+int MPI_File_write_at_all_begin(MPI_File fh, MPI_Offset offset,
+                                const void *buf, int count,
+                                MPI_Datatype dt) {
+  FileObj *f = lookup_file(fh);
+  if (!f) return MPI_ERR_FILE;
+  if (f->split_active) return MPI_ERR_OTHER;
+  MPI_Status st{};
+  int rc = MPI_File_write_at_all(fh, offset, buf, count, dt, &st);
+  return split_begin(f, rc, st);
+}
+
+int MPI_File_write_at_all_end(MPI_File fh, const void *,
+                              MPI_Status *status) {
+  return split_end(fh, status);
+}
+
+// ---- shared file pointer IO ----
+
+int MPI_File_read_shared(MPI_File fh, void *buf, int count,
+                         MPI_Datatype dt, MPI_Status *status) {
+  FileObj *f = lookup_file(fh);
+  if (!f) return MPI_ERR_FILE;
+  DtView v;
+  if (!resolve_dtype(dt, v)) return MPI_ERR_TYPE;
+  int64_t want = (int64_t)count * v.elems_per_item() * v.di.item;
+  int64_t etypes = want / (f->etype_size ? f->etype_size : 1);
+  int64_t old = 0;
+  int rc = sfp_update(f, etypes, false, 0, &old);
+  if (rc != MPI_SUCCESS) return rc;
+  return MPI_File_read_at(fh, (MPI_Offset)old, buf, count, dt, status);
+}
+
+int MPI_File_write_shared(MPI_File fh, const void *buf, int count,
+                          MPI_Datatype dt, MPI_Status *status) {
+  FileObj *f = lookup_file(fh);
+  if (!f) return MPI_ERR_FILE;
+  DtView v;
+  if (!resolve_dtype(dt, v)) return MPI_ERR_TYPE;
+  int64_t want = (int64_t)count * v.elems_per_item() * v.di.item;
+  int64_t etypes = want / (f->etype_size ? f->etype_size : 1);
+  int64_t old = 0;
+  int rc = sfp_update(f, etypes, false, 0, &old);
+  if (rc != MPI_SUCCESS) return rc;
+  return MPI_File_write_at(fh, (MPI_Offset)old, buf, count, dt, status);
+}
+
+int MPI_File_seek_shared(MPI_File fh, MPI_Offset offset, int whence) {
+  // collective (file_seek_shared.c); rank 0 applies, all synchronize
+  FileObj *f = lookup_file(fh);
+  if (!f) return MPI_ERR_FILE;
+  CommObj *c = lookup_comm(f->comm);
+  int rc = MPI_SUCCESS;
+  if (!c || c->local_rank == 0) {
+    int64_t base = 0;
+    if (whence == MPI_SEEK_CUR) {
+      sfp_update(f, 0, false, 0, &base);
+    } else if (whence == MPI_SEEK_END) {
+      struct stat st{};
+      if (fstat(f->fd, &st) == 0)
+        base = (int64_t)st.st_size /
+               (f->etype_size ? f->etype_size : 1);
+    } else if (whence != MPI_SEEK_SET) {
+      rc = MPI_ERR_ARG;
+    }
+    if (rc == MPI_SUCCESS)
+      rc = sfp_update(f, 0, true, base + (int64_t)offset, nullptr);
+  }
+  return c ? (c_barrier(*c), rc) : rc;
+}
+
+int MPI_File_get_position_shared(MPI_File fh, MPI_Offset *offset) {
+  FileObj *f = lookup_file(fh);
+  if (!f) return MPI_ERR_FILE;
+  int64_t cur = 0;
+  int rc = sfp_update(f, 0, false, 0, &cur);
+  if (rc != MPI_SUCCESS) return rc;
+  *offset = (MPI_Offset)cur;
+  return MPI_SUCCESS;
+}
+
+// ---- ordered (rank-sequential) shared IO: exscan computes each
+// rank's slice of the shared region, the last total advances the
+// pointer once (file_read_ordered.c semantics without serialization) ----
+
+namespace {
+
+int ordered_io(MPI_File fh, void *buf, int count, MPI_Datatype dt,
+               MPI_Status *status, bool writing) {
+  FileObj *f = lookup_file(fh);
+  if (!f) return MPI_ERR_FILE;
+  CommObj *c = lookup_comm(f->comm);
+  if (!c) return MPI_ERR_COMM;
+  DtView v;
+  if (!resolve_dtype(dt, v)) return MPI_ERR_TYPE;
+  int64_t mine = ((int64_t)count * v.elems_per_item() * v.di.item) /
+                 (f->etype_size ? f->etype_size : 1);
+  int64_t prefix = 0, total = 0;
+  int rc = c_scan(*c, &mine, &prefix, 1, MPI_LONG, MPI_SUM, true);
+  if (rc != MPI_SUCCESS) return rc;
+  rc = c_allreduce(*c, &mine, &total, 1, MPI_LONG, MPI_SUM);
+  if (rc != MPI_SUCCESS) return rc;
+  // rank 0 advances the shared pointer; its outcome rides the bcast so
+  // a sidecar failure is UNIFORM (an early return would strand the
+  // other ranks inside the bcast)
+  int64_t msg[2] = {0, MPI_SUCCESS};
+  if (c->local_rank == 0)
+    msg[1] = sfp_update(f, total, false, 0, &msg[0]);
+  rc = c_bcast(*c, msg, 2, MPI_LONG, 0, 0x7E31);
+  if (rc != MPI_SUCCESS) return rc;
+  if (msg[1] != MPI_SUCCESS) return (int)msg[1];
+  MPI_Offset at = (MPI_Offset)(msg[0] + prefix);
+  rc = writing ? MPI_File_write_at(fh, at, buf, count, dt, status)
+               : MPI_File_read_at(fh, at, buf, count, dt, status);
+  int rc2 = c_barrier(*c);  // ordered IO is collective
+  return rc != MPI_SUCCESS ? rc : rc2;
+}
+
+}  // namespace
+
+int MPI_File_read_ordered(MPI_File fh, void *buf, int count,
+                          MPI_Datatype dt, MPI_Status *status) {
+  return ordered_io(fh, buf, count, dt, status, false);
+}
+
+int MPI_File_write_ordered(MPI_File fh, const void *buf, int count,
+                           MPI_Datatype dt, MPI_Status *status) {
+  return ordered_io(fh, (void *)buf, count, dt, status, true);
+}
+
+int MPI_File_read_ordered_begin(MPI_File fh, void *buf, int count,
+                                MPI_Datatype dt) {
+  FileObj *f = lookup_file(fh);
+  if (!f) return MPI_ERR_FILE;
+  if (f->split_active) return MPI_ERR_OTHER;
+  MPI_Status st{};
+  int rc = ordered_io(fh, buf, count, dt, &st, false);
+  return split_begin(f, rc, st);
+}
+
+int MPI_File_read_ordered_end(MPI_File fh, void *, MPI_Status *status) {
+  return split_end(fh, status);
+}
+
+int MPI_File_write_ordered_begin(MPI_File fh, const void *buf, int count,
+                                 MPI_Datatype dt) {
+  FileObj *f = lookup_file(fh);
+  if (!f) return MPI_ERR_FILE;
+  if (f->split_active) return MPI_ERR_OTHER;
+  MPI_Status st{};
+  int rc = ordered_io(fh, (void *)buf, count, dt, &st, true);
+  return split_begin(f, rc, st);
+}
+
+int MPI_File_write_ordered_end(MPI_File fh, const void *,
+                               MPI_Status *status) {
+  return split_end(fh, status);
+}
+
+// ---- nonblocking IO (file_iread.c family): the blocking form runs on
+// a background thread and retires through the request engine, exactly
+// the fbtl_posix ipreadv shape ----
+
+namespace {
+
+int file_ispawn(std::function<int(MPI_Status *)> body,
+                MPI_Request *request) {
+  Req *r = new Req;
+  r->heap = true;
+  r->comm = MPI_COMM_WORLD;
+  int handle;
+  {
+    std::lock_guard<std::mutex> lk(g.match_mu);
+    handle = g.next_req++;
+    g.reqs[handle] = r;
+  }
+  std::thread t([r, body]() {
+    MPI_Status st{};
+    int rc = body(&st);
+    std::lock_guard<std::mutex> lk(g.match_mu);
+    r->status = st;
+    r->status.MPI_ERROR = rc;
+    r->complete = true;
+    g.match_cv.notify_all();
+  });
+  {
+    std::lock_guard<std::mutex> lk(g.threads_mu);
+    g.threads.push_back(std::move(t));
+  }
+  *request = handle;
+  return MPI_SUCCESS;
+}
+
+}  // namespace
+
+int MPI_File_iread_at(MPI_File fh, MPI_Offset offset, void *buf,
+                      int count, MPI_Datatype dt, MPI_Request *request) {
+  if (!lookup_file(fh)) return MPI_ERR_FILE;
+  return file_ispawn(
+      [fh, offset, buf, count, dt](MPI_Status *st) {
+        return MPI_File_read_at(fh, offset, buf, count, dt, st);
+      },
+      request);
+}
+
+int MPI_File_iwrite_at(MPI_File fh, MPI_Offset offset, const void *buf,
+                       int count, MPI_Datatype dt,
+                       MPI_Request *request) {
+  if (!lookup_file(fh)) return MPI_ERR_FILE;
+  return file_ispawn(
+      [fh, offset, buf, count, dt](MPI_Status *st) {
+        return MPI_File_write_at(fh, offset, buf, count, dt, st);
+      },
+      request);
+}
+
+int MPI_File_iread(MPI_File fh, void *buf, int count, MPI_Datatype dt,
+                   MPI_Request *request) {
+  // the pointer advances NOW (the op owns its slice; a later iread
+  // must not overlap it) — the data lands when the request completes
+  FileObj *f = lookup_file(fh);
+  if (!f) return MPI_ERR_FILE;
+  DtView v;
+  if (!resolve_dtype(dt, v)) return MPI_ERR_TYPE;
+  int64_t off = f->pointer;
+  f->pointer += ((int64_t)count * v.elems_per_item() * v.di.item) /
+                (f->etype_size ? f->etype_size : 1);
+  return MPI_File_iread_at(fh, (MPI_Offset)off, buf, count, dt,
+                           request);
+}
+
+int MPI_File_iwrite(MPI_File fh, const void *buf, int count,
+                    MPI_Datatype dt, MPI_Request *request) {
+  FileObj *f = lookup_file(fh);
+  if (!f) return MPI_ERR_FILE;
+  DtView v;
+  if (!resolve_dtype(dt, v)) return MPI_ERR_TYPE;
+  int64_t off = f->pointer;
+  f->pointer += ((int64_t)count * v.elems_per_item() * v.di.item) /
+                (f->etype_size ? f->etype_size : 1);
+  return MPI_File_iwrite_at(fh, (MPI_Offset)off, buf, count, dt,
+                            request);
+}
+
+int MPI_File_iread_shared(MPI_File fh, void *buf, int count,
+                          MPI_Datatype dt, MPI_Request *request) {
+  // claim the shared slice NOW, read it in the background
+  FileObj *f = lookup_file(fh);
+  if (!f) return MPI_ERR_FILE;
+  DtView v;
+  if (!resolve_dtype(dt, v)) return MPI_ERR_TYPE;
+  int64_t etypes = ((int64_t)count * v.elems_per_item() * v.di.item) /
+                   (f->etype_size ? f->etype_size : 1);
+  int64_t old = 0;
+  int rc = sfp_update(f, etypes, false, 0, &old);
+  if (rc != MPI_SUCCESS) return rc;
+  return MPI_File_iread_at(fh, (MPI_Offset)old, buf, count, dt,
+                           request);
+}
+
+int MPI_File_iwrite_shared(MPI_File fh, const void *buf, int count,
+                           MPI_Datatype dt, MPI_Request *request) {
+  FileObj *f = lookup_file(fh);
+  if (!f) return MPI_ERR_FILE;
+  DtView v;
+  if (!resolve_dtype(dt, v)) return MPI_ERR_TYPE;
+  int64_t etypes = ((int64_t)count * v.elems_per_item() * v.di.item) /
+                   (f->etype_size ? f->etype_size : 1);
+  int64_t old = 0;
+  int rc = sfp_update(f, etypes, false, 0, &old);
+  if (rc != MPI_SUCCESS) return rc;
+  return MPI_File_iwrite_at(fh, (MPI_Offset)old, buf, count, dt,
+                            request);
+}
+
+int MPI_File_iread_at_all(MPI_File fh, MPI_Offset offset, void *buf,
+                          int count, MPI_Datatype dt,
+                          MPI_Request *request) {
+  // "all" adds collectivity to completion, not initiation; the
+  // independent nonblocking form satisfies both here
+  return MPI_File_iread_at(fh, offset, buf, count, dt, request);
+}
+
+int MPI_File_iwrite_at_all(MPI_File fh, MPI_Offset offset,
+                           const void *buf, int count, MPI_Datatype dt,
+                           MPI_Request *request) {
+  return MPI_File_iwrite_at(fh, offset, buf, count, dt, request);
+}
+
+int MPI_File_iread_all(MPI_File fh, void *buf, int count,
+                       MPI_Datatype dt, MPI_Request *request) {
+  return MPI_File_iread(fh, buf, count, dt, request);
+}
+
+int MPI_File_iwrite_all(MPI_File fh, const void *buf, int count,
+                        MPI_Datatype dt, MPI_Request *request) {
+  return MPI_File_iwrite(fh, buf, count, dt, request);
+}
+
+int MPI_Register_datarep(const char *datarep, void *, void *, void *,
+                         void *) {
+  // register_datarep.c surface: only "native" exists on this
+  // homogeneous engine; registering it is idempotent, anything else
+  // is rejected loudly rather than silently unconverted
+  if (datarep && strcmp(datarep, "native") == 0) return MPI_SUCCESS;
+  return MPI_ERR_ARG;
 }
 
 // ------------------------------------------------------- pack / unpack
@@ -5637,32 +6351,13 @@ int MPI_Ialltoallw(const void *sendbuf, const int sendcounts[],
   if (!c) return MPI_ERR_COMM;
   int n = (int)c->group.size();
   // MPI-3.1 5.12 extends IN_PLACE to the nonblocking collectives: the
-  // send arrays are then absent (often NULL) — clone the receive side
-  // exactly as the blocking wrapper does, with the clone owned by the
-  // lambda so it outlives the background run
+  // send arrays are then absent (often NULL) — same clone as the
+  // blocking wrapper, owned by the lambda so it outlives the run
   auto tmp = std::make_shared<std::vector<char>>();
   if (sendbuf == MPI_IN_PLACE) {
-    int64_t span = 0;
-    for (int r = 0; r < n; r++) {
-      if (recvcounts[r] < 0 || rdispls[r] < 0) return MPI_ERR_ARG;
-      DtView rv;
-      if (recvcounts[r] == 0) continue;
-      if (!resolve_dtype(recvtypes[r], rv)) return MPI_ERR_TYPE;
-      int64_t end = rdispls[r] +
-                    (int64_t)slot_bytes(rv, recvcounts[r]);
-      if (end > span) span = end;
-    }
-    tmp->assign((size_t)span, 0);
-    for (int r = 0; r < n; r++) {
-      if (recvcounts[r] == 0) continue;
-      DtView rv;
-      resolve_dtype(recvtypes[r], rv);
-      std::vector<char> packed;
-      pack_dtype((const char *)recvbuf + rdispls[r], recvcounts[r], rv,
-                 packed);
-      unpack_dtype(tmp->data() + rdispls[r], recvcounts[r], rv,
-                   packed.data(), packed.size());
-    }
+    int rc = alltoallw_inplace_clone(n, recvbuf, recvcounts, rdispls,
+                                     recvtypes, *tmp);
+    if (rc != MPI_SUCCESS) return rc;
     sendbuf = tmp->data();
     sendcounts = recvcounts;
     sdispls = rdispls;

@@ -577,6 +577,91 @@ int MPI_File_read(MPI_File fh, void *buf, int count, MPI_Datatype dt,
 int MPI_File_write(MPI_File fh, const void *buf, int count,
                    MPI_Datatype dt, MPI_Status *status);
 int MPI_File_seek(MPI_File fh, MPI_Offset offset, int whence);
+
+/* MPI-IO tier 2 (round 5): views, collective + split collective IO,
+ * shared-pointer IO, nonblocking IO, preallocate/atomicity.  Offsets
+ * are in etypes of the current view; "native" representation only. */
+int MPI_File_set_view(MPI_File fh, MPI_Offset disp, MPI_Datatype etype,
+                      MPI_Datatype filetype, const char *datarep,
+                      MPI_Info info);
+int MPI_File_get_view(MPI_File fh, MPI_Offset *disp, MPI_Datatype *etype,
+                      MPI_Datatype *filetype, char *datarep);
+int MPI_File_get_byte_offset(MPI_File fh, MPI_Offset offset,
+                             MPI_Offset *byte_offset);
+int MPI_File_get_type_extent(MPI_File fh, MPI_Datatype dt,
+                             MPI_Offset *extent);
+int MPI_File_preallocate(MPI_File fh, MPI_Offset size);
+int MPI_File_set_atomicity(MPI_File fh, int flag);
+int MPI_File_get_atomicity(MPI_File fh, int *flag);
+int MPI_File_read_at_all(MPI_File fh, MPI_Offset offset, void *buf,
+                         int count, MPI_Datatype dt, MPI_Status *status);
+int MPI_File_write_at_all(MPI_File fh, MPI_Offset offset,
+                          const void *buf, int count, MPI_Datatype dt,
+                          MPI_Status *status);
+int MPI_File_read_all(MPI_File fh, void *buf, int count, MPI_Datatype dt,
+                      MPI_Status *status);
+int MPI_File_write_all(MPI_File fh, const void *buf, int count,
+                       MPI_Datatype dt, MPI_Status *status);
+int MPI_File_read_all_begin(MPI_File fh, void *buf, int count,
+                            MPI_Datatype dt);
+int MPI_File_read_all_end(MPI_File fh, void *buf, MPI_Status *status);
+int MPI_File_write_all_begin(MPI_File fh, const void *buf, int count,
+                             MPI_Datatype dt);
+int MPI_File_write_all_end(MPI_File fh, const void *buf,
+                           MPI_Status *status);
+int MPI_File_read_at_all_begin(MPI_File fh, MPI_Offset offset, void *buf,
+                               int count, MPI_Datatype dt);
+int MPI_File_read_at_all_end(MPI_File fh, void *buf, MPI_Status *status);
+int MPI_File_write_at_all_begin(MPI_File fh, MPI_Offset offset,
+                                const void *buf, int count,
+                                MPI_Datatype dt);
+int MPI_File_write_at_all_end(MPI_File fh, const void *buf,
+                              MPI_Status *status);
+int MPI_File_read_shared(MPI_File fh, void *buf, int count,
+                         MPI_Datatype dt, MPI_Status *status);
+int MPI_File_write_shared(MPI_File fh, const void *buf, int count,
+                          MPI_Datatype dt, MPI_Status *status);
+int MPI_File_seek_shared(MPI_File fh, MPI_Offset offset, int whence);
+int MPI_File_get_position_shared(MPI_File fh, MPI_Offset *offset);
+int MPI_File_read_ordered(MPI_File fh, void *buf, int count,
+                          MPI_Datatype dt, MPI_Status *status);
+int MPI_File_write_ordered(MPI_File fh, const void *buf, int count,
+                           MPI_Datatype dt, MPI_Status *status);
+int MPI_File_read_ordered_begin(MPI_File fh, void *buf, int count,
+                                MPI_Datatype dt);
+int MPI_File_read_ordered_end(MPI_File fh, void *buf,
+                              MPI_Status *status);
+int MPI_File_write_ordered_begin(MPI_File fh, const void *buf, int count,
+                                 MPI_Datatype dt);
+int MPI_File_write_ordered_end(MPI_File fh, const void *buf,
+                               MPI_Status *status);
+int MPI_File_iread_at(MPI_File fh, MPI_Offset offset, void *buf,
+                      int count, MPI_Datatype dt, MPI_Request *request);
+int MPI_File_iwrite_at(MPI_File fh, MPI_Offset offset, const void *buf,
+                       int count, MPI_Datatype dt, MPI_Request *request);
+int MPI_File_iread(MPI_File fh, void *buf, int count, MPI_Datatype dt,
+                   MPI_Request *request);
+int MPI_File_iwrite(MPI_File fh, const void *buf, int count,
+                    MPI_Datatype dt, MPI_Request *request);
+int MPI_File_iread_shared(MPI_File fh, void *buf, int count,
+                          MPI_Datatype dt, MPI_Request *request);
+int MPI_File_iwrite_shared(MPI_File fh, const void *buf, int count,
+                           MPI_Datatype dt, MPI_Request *request);
+int MPI_File_iread_at_all(MPI_File fh, MPI_Offset offset, void *buf,
+                          int count, MPI_Datatype dt,
+                          MPI_Request *request);
+int MPI_File_iwrite_at_all(MPI_File fh, MPI_Offset offset,
+                           const void *buf, int count, MPI_Datatype dt,
+                           MPI_Request *request);
+int MPI_File_iread_all(MPI_File fh, void *buf, int count,
+                       MPI_Datatype dt, MPI_Request *request);
+int MPI_File_iwrite_all(MPI_File fh, const void *buf, int count,
+                        MPI_Datatype dt, MPI_Request *request);
+#define MPI_MAX_DATAREP_STRING 128
+int MPI_Register_datarep(const char *datarep,
+                         void *read_conversion_fn,
+                         void *write_conversion_fn,
+                         void *dtype_file_extent_fn, void *extra_state);
 int MPI_File_get_position(MPI_File fh, MPI_Offset *offset);
 int MPI_File_get_size(MPI_File fh, MPI_Offset *size);
 int MPI_File_set_size(MPI_File fh, MPI_Offset size);
