@@ -1,0 +1,268 @@
+/* misc2_c.c — round-5 batch-8 acceptance: group range algebra and
+ * compare, MPI-1 attribute names, datatype attributes, persistent
+ * send modes, request-based RMA, canonical external32 packing,
+ * size-matched and f90-parameterized types, generalized requests.
+ * Reference shapes: ompi/mpi/c/{group_range_incl,group_compare,
+ * attr_put,type_create_keyval,ssend_init,rput,pack_external,
+ * type_match_size,grequest_start}.c.  Run with >= 2 ranks. */
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include "zompi_mpi.h"
+
+#define CHECK(cond)                                                    \
+  do {                                                                 \
+    if (!(cond)) {                                                     \
+      fprintf(stderr, "FAIL %s:%d: %s\n", __FILE__, __LINE__, #cond);  \
+      MPI_Abort(MPI_COMM_WORLD, 2);                                    \
+    }                                                                  \
+  } while (0)
+
+static int type_del_calls = 0;
+static int type_del_fn(MPI_Datatype d, int k, void *v, void *es) {
+  (void)d; (void)k; (void)v; (void)es;
+  type_del_calls++;
+  return MPI_SUCCESS;
+}
+
+static int gq_query(void *extra, MPI_Status *st) {
+  *(int *)extra += 1;
+  st->_count = 42;
+  return MPI_SUCCESS;
+}
+static int gq_free(void *extra) {
+  *(int *)extra += 100;
+  return MPI_SUCCESS;
+}
+static int gq_cancel(void *extra, int complete) {
+  (void)extra; (void)complete;
+  return MPI_SUCCESS;
+}
+
+int main(int argc, char **argv) {
+  CHECK(MPI_Init(&argc, &argv) == MPI_SUCCESS);
+  int rank, size;
+  MPI_Comm_rank(MPI_COMM_WORLD, &rank);
+  MPI_Comm_size(MPI_COMM_WORLD, &size);
+  CHECK(size >= 2);
+
+  /* ---- group range algebra + compare ---- */
+  {
+    MPI_Group w, evens, evens2, rest;
+    CHECK(MPI_Comm_group(MPI_COMM_WORLD, &w) == MPI_SUCCESS);
+    int r1[1][3] = {{0, size - 1, 2}};
+    CHECK(MPI_Group_range_incl(w, 1, r1, &evens) == MPI_SUCCESS);
+    int esz = -1;
+    CHECK(MPI_Group_size(evens, &esz) == MPI_SUCCESS);
+    CHECK(esz == (size + 1) / 2);
+    /* same membership built by excluding the odds */
+    int r2[1][3] = {{1, size - 1, 2}};
+    CHECK(MPI_Group_range_excl(w, 1, r2, &evens2) == MPI_SUCCESS);
+    int cmp = -1;
+    CHECK(MPI_Group_compare(evens, evens2, &cmp) == MPI_SUCCESS);
+    CHECK(cmp == MPI_IDENT);
+    /* reversed order is SIMILAR, not IDENT */
+    int r3[1][3] = {{size - 1 - (size - 1) % 2, 0, -2}};
+    CHECK(MPI_Group_range_incl(w, 1, r3, &rest) == MPI_SUCCESS);
+    CHECK(MPI_Group_compare(evens, rest, &cmp) == MPI_SUCCESS);
+    CHECK(cmp == (esz > 1 ? MPI_SIMILAR : MPI_IDENT));
+    CHECK(MPI_Group_compare(evens, w, &cmp) == MPI_SUCCESS);
+    CHECK(size == esz ? cmp == MPI_IDENT : cmp == MPI_UNEQUAL);
+    MPI_Group_free(&evens);
+    MPI_Group_free(&evens2);
+    MPI_Group_free(&rest);
+    MPI_Group_free(&w);
+  }
+
+  /* ---- MPI-1 attribute names ---- */
+  {
+    int kv = MPI_KEYVAL_INVALID;
+    CHECK(MPI_Keyval_create(NULL, NULL, &kv, NULL) == MPI_SUCCESS);
+    CHECK(MPI_Attr_put(MPI_COMM_WORLD, kv, (void *)0xCAFE) ==
+          MPI_SUCCESS);
+    void *got = NULL;
+    int found = 0;
+    CHECK(MPI_Attr_get(MPI_COMM_WORLD, kv, &got, &found) == MPI_SUCCESS);
+    CHECK(found == 1 && got == (void *)0xCAFE);
+    CHECK(MPI_Attr_delete(MPI_COMM_WORLD, kv) == MPI_SUCCESS);
+    CHECK(MPI_Attr_get(MPI_COMM_WORLD, kv, &got, &found) == MPI_SUCCESS);
+    CHECK(found == 0);
+    CHECK(MPI_Keyval_free(&kv) == MPI_SUCCESS);
+  }
+
+  /* ---- datatype attributes ---- */
+  {
+    MPI_Datatype t;
+    CHECK(MPI_Type_contiguous(3, MPI_INT, &t) == MPI_SUCCESS);
+    int kv = MPI_KEYVAL_INVALID;
+    CHECK(MPI_Type_create_keyval(NULL, type_del_fn, &kv, NULL) ==
+          MPI_SUCCESS);
+    CHECK(MPI_Type_set_attr(t, kv, (void *)0xD00D) == MPI_SUCCESS);
+    void *got = NULL;
+    int found = 0;
+    CHECK(MPI_Type_get_attr(t, kv, &got, &found) == MPI_SUCCESS);
+    CHECK(found == 1 && got == (void *)0xD00D);
+    CHECK(MPI_Type_free(&t) == MPI_SUCCESS); /* delete callback runs */
+    CHECK(type_del_calls == 1);
+    CHECK(MPI_Type_free_keyval(&kv) == MPI_SUCCESS);
+  }
+
+  /* ---- persistent send modes (0 <-> 1) ---- */
+  if (rank < 2) {
+    int peer = 1 - rank;
+    MPI_Comm pair;
+    CHECK(MPI_Comm_split(MPI_COMM_WORLD, 0, rank, &pair) == MPI_SUCCESS);
+    int sbuf = 60 + rank, rbuf = -1;
+    MPI_Request sreq, rreq;
+    CHECK(MPI_Ssend_init(&sbuf, 1, MPI_INT, 1 - rank, 3, pair, &sreq) ==
+          MPI_SUCCESS);
+    CHECK(MPI_Recv_init(&rbuf, 1, MPI_INT, 1 - rank, 3, pair, &rreq) ==
+          MPI_SUCCESS);
+    for (int round = 0; round < 3; round++) {
+      rbuf = -1;
+      sbuf = 60 + rank + round;
+      CHECK(MPI_Start(&rreq) == MPI_SUCCESS);
+      CHECK(MPI_Barrier(pair) == MPI_SUCCESS); /* recv posted first */
+      CHECK(MPI_Start(&sreq) == MPI_SUCCESS);
+      CHECK(MPI_Wait(&sreq, MPI_STATUS_IGNORE) == MPI_SUCCESS);
+      CHECK(MPI_Wait(&rreq, MPI_STATUS_IGNORE) == MPI_SUCCESS);
+      CHECK(rbuf == 60 + peer + round);
+    }
+    CHECK(MPI_Request_free(&sreq) == MPI_SUCCESS);
+    CHECK(MPI_Request_free(&rreq) == MPI_SUCCESS);
+    /* bsend/rsend persistent variants construct + fire once */
+    MPI_Request breq;
+    CHECK(MPI_Bsend_init(&sbuf, 1, MPI_INT, 1 - rank, 4, pair, &breq) ==
+          MPI_SUCCESS);
+    CHECK(MPI_Start(&breq) == MPI_SUCCESS);
+    CHECK(MPI_Wait(&breq, MPI_STATUS_IGNORE) == MPI_SUCCESS);
+    int dummy = -1;
+    CHECK(MPI_Recv(&dummy, 1, MPI_INT, 1 - rank, 4, pair,
+                   MPI_STATUS_IGNORE) == MPI_SUCCESS);
+    CHECK(MPI_Request_free(&breq) == MPI_SUCCESS);
+    MPI_Comm_free(&pair);
+  } else {
+    MPI_Comm dummy;
+    CHECK(MPI_Comm_split(MPI_COMM_WORLD, 1, rank, &dummy) ==
+          MPI_SUCCESS);
+    MPI_Comm_free(&dummy);
+  }
+
+  /* ---- request-based RMA ---- */
+  {
+    long long cell = 0;
+    MPI_Win win;
+    CHECK(MPI_Win_create(&cell, sizeof cell, sizeof cell, MPI_INFO_NULL,
+                         MPI_COMM_WORLD, &win) == MPI_SUCCESS);
+    CHECK(MPI_Win_fence(0, win) == MPI_SUCCESS);
+    long long one = 1;
+    MPI_Request rr;
+    CHECK(MPI_Raccumulate(&one, 1, MPI_LONG, 0, 0, 1, MPI_LONG, MPI_SUM,
+                          win, &rr) == MPI_SUCCESS);
+    CHECK(MPI_Wait(&rr, MPI_STATUS_IGNORE) == MPI_SUCCESS);
+    CHECK(MPI_Win_fence(0, win) == MPI_SUCCESS);
+    long long seen = -1;
+    CHECK(MPI_Rget(&seen, 1, MPI_LONG, 0, 0, 1, MPI_LONG, win, &rr) ==
+          MPI_SUCCESS);
+    CHECK(MPI_Wait(&rr, MPI_STATUS_IGNORE) == MPI_SUCCESS);
+    CHECK(seen == size);
+    CHECK(MPI_Win_fence(0, win) == MPI_SUCCESS);
+    CHECK(MPI_Win_free(&win) == MPI_SUCCESS);
+  }
+
+  /* ---- external32 canonical packing round-trip + wire check ---- */
+  {
+    int vals[3] = {0x01020304, 0x0A0B0C0D, -2};
+    MPI_Aint psize = -1;
+    CHECK(MPI_Pack_external_size("external32", 3, MPI_INT, &psize) ==
+          MPI_SUCCESS && psize == 12);
+    char buf[64];
+    MPI_Aint pos = 0;
+    CHECK(MPI_Pack_external("external32", vals, 3, MPI_INT, buf, 64,
+                            &pos) == MPI_SUCCESS && pos == 12);
+    /* canonical big-endian bytes */
+    CHECK((unsigned char)buf[0] == 0x01 && (unsigned char)buf[3] == 0x04);
+    int back[3] = {0, 0, 0};
+    MPI_Aint rpos = 0;
+    CHECK(MPI_Unpack_external("external32", buf, pos, &rpos, back, 3,
+                              MPI_INT) == MPI_SUCCESS);
+    CHECK(back[0] == vals[0] && back[2] == -2);
+    CHECK(MPI_Pack_external("bogus", vals, 3, MPI_INT, buf, 64, &pos) ==
+          MPI_ERR_ARG);
+
+    /* homogeneous byte-sealed types swap at their element unit */
+    MPI_Datatype hv;
+    CHECK(MPI_Type_create_hvector(2, 1, 8, MPI_INT, &hv) ==
+          MPI_SUCCESS);
+    CHECK(MPI_Type_commit(&hv) == MPI_SUCCESS);
+    int strided[4] = {0x11223344, -1, 0x55667788, -1};
+    pos = 0;
+    CHECK(MPI_Pack_external("external32", strided, 1, hv, buf, 64,
+                            &pos) == MPI_SUCCESS && pos == 8);
+    CHECK((unsigned char)buf[0] == 0x11 &&
+          (unsigned char)buf[3] == 0x44);
+    CHECK((unsigned char)buf[4] == 0x55);
+    int sback[4] = {9, 9, 9, 9};
+    rpos = 0;
+    CHECK(MPI_Unpack_external("external32", buf, pos, &rpos, sback, 1,
+                              hv) == MPI_SUCCESS);
+    CHECK(sback[0] == 0x11223344 && sback[2] == 0x55667788);
+    CHECK(sback[1] == 9); /* the gap is untouched */
+    MPI_Type_free(&hv);
+
+    /* a mixed-field struct has no canonical element unit */
+    {
+      int bl[2] = {1, 1};
+      MPI_Aint dp2[2] = {0, 4};
+      MPI_Datatype ts[2] = {MPI_INT, MPI_DOUBLE}, mixed;
+      CHECK(MPI_Type_create_struct(2, bl, dp2, ts, &mixed) ==
+            MPI_SUCCESS);
+      CHECK(MPI_Type_commit(&mixed) == MPI_SUCCESS);
+      char mbuf[16];
+      pos = 0;
+      CHECK(MPI_Pack_external("external32", mbuf, 1, mixed, buf, 64,
+                              &pos) == MPI_ERR_TYPE);
+      MPI_Type_free(&mixed);
+    }
+  }
+
+  /* ---- size-matched + f90 types ---- */
+  {
+    MPI_Datatype t;
+    CHECK(MPI_Type_match_size(MPI_TYPECLASS_INTEGER, 8, &t) ==
+          MPI_SUCCESS && t == MPI_LONG_LONG);
+    CHECK(MPI_Type_match_size(MPI_TYPECLASS_REAL, 4, &t) ==
+          MPI_SUCCESS && t == MPI_FLOAT);
+    CHECK(MPI_Type_create_f90_integer(9, &t) == MPI_SUCCESS &&
+          t == MPI_INT);
+    CHECK(MPI_Type_create_f90_real(15, 300, &t) == MPI_SUCCESS &&
+          t == MPI_DOUBLE);
+    MPI_Datatype cx;
+    CHECK(MPI_Type_create_f90_complex(6, 30, &cx) == MPI_SUCCESS);
+    int sz = -1;
+    CHECK(MPI_Type_size(cx, &sz) == MPI_SUCCESS && sz == 8);
+    MPI_Type_free(&cx);
+  }
+
+  /* ---- generalized requests ---- */
+  {
+    int state = 0;
+    MPI_Request gr;
+    CHECK(MPI_Grequest_start(gq_query, gq_free, gq_cancel, &state,
+                             &gr) == MPI_SUCCESS);
+    int flag = -1;
+    CHECK(MPI_Test(&gr, &flag, MPI_STATUS_IGNORE) == MPI_SUCCESS &&
+          flag == 0);
+    CHECK(MPI_Grequest_complete(gr) == MPI_SUCCESS);
+    MPI_Status st;
+    memset(&st, 0, sizeof st);
+    CHECK(MPI_Wait(&gr, &st) == MPI_SUCCESS);
+    CHECK(st._count == 42);      /* query_fn shaped the status */
+    CHECK(state == 101);         /* query (+1) then free (+100) ran */
+  }
+
+  MPI_Barrier(MPI_COMM_WORLD);
+  if (rank == 0) printf("misc2_c OK on %d ranks\n", size);
+  MPI_Finalize();
+  return 0;
+}

@@ -185,6 +185,17 @@ class TestPureC:
                             timeout=90)
         assert f"io2_c OK on {n} ranks" in outs[0]
 
+    @pytest.mark.parametrize("n", [2, 4])
+    def test_misc2_example(self, shim, tmp_path_factory, n):
+        """Round-5 batch 8: group range algebra/compare, MPI-1
+        attribute names, datatype attributes with delete callbacks,
+        persistent send modes over repeated Start rounds,
+        request-based RMA, external32 canonical packing (big-endian
+        bytes on the wire), size-matched + f90 types, generalized
+        requests with query/free callbacks."""
+        outs = _run_example(shim, tmp_path_factory, "misc2_c.c", n)
+        assert f"misc2_c OK on {n} ranks" in outs[0]
+
     def test_are_fatal_default_aborts(self, shim, tmp_path):
         """The MPI default handler is ERRORS_ARE_FATAL: an invalid-rank
         send without an installed handler must kill the process with a

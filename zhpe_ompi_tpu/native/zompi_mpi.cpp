@@ -336,6 +336,11 @@ struct DtypeObj {
   int64_t lb = 0;       // lower bound (min displacement), in base elems
   int64_t elems = 0;    // base elems per one item (sum of block n)
   bool committed = false;
+  // canonical-packing element unit for byte-sealed typemaps: the
+  // packed stream of a single-oldtype byte constructor is whole base
+  // elements of that oldtype (external32 swaps at this unit); 0 means
+  // heterogeneous (struct) — canonical packing is then unsupported
+  int swap_unit = 1;
   // constructor envelope (type_get_envelope.c / type_get_contents.c)
   int combiner = 0;  // MPI_COMBINER_NAMED until a constructor stamps it
   std::vector<int> env_ints;
@@ -3381,6 +3386,7 @@ struct PersistentReq {
   int tag;
   MPI_Comm comm;
   MPI_Request active = MPI_REQUEST_NULL;  // inner handle when started
+  int mode = 0;  // 0 standard, 1 synchronous, 2 buffered, 3 ready
 };
 std::map<int, PersistentReq> g_persistent;
 int g_next_persistent = 2;  // public handle = -id (MPI_REQUEST_NULL=-1)
@@ -3414,6 +3420,33 @@ int MPI_Send_init(const void *buf, int count, MPI_Datatype dt, int dest,
   return MPI_SUCCESS;
 }
 
+// send-mode persistent variants (ssend_init.c / bsend_init.c /
+// rsend_init.c): same frozen argument set, Start fires the matching
+// nonblocking mode
+static int send_init_mode(const void *buf, int count, MPI_Datatype dt,
+                          int dest, int tag, MPI_Comm comm,
+                          MPI_Request *request, int mode) {
+  int rc = MPI_Send_init(buf, count, dt, dest, tag, comm, request);
+  if (rc != MPI_SUCCESS) return rc;
+  g_persistent[-*request].mode = mode;
+  return MPI_SUCCESS;
+}
+
+int MPI_Ssend_init(const void *buf, int count, MPI_Datatype dt, int dest,
+                   int tag, MPI_Comm comm, MPI_Request *request) {
+  return send_init_mode(buf, count, dt, dest, tag, comm, request, 1);
+}
+
+int MPI_Bsend_init(const void *buf, int count, MPI_Datatype dt, int dest,
+                   int tag, MPI_Comm comm, MPI_Request *request) {
+  return send_init_mode(buf, count, dt, dest, tag, comm, request, 2);
+}
+
+int MPI_Rsend_init(const void *buf, int count, MPI_Datatype dt, int dest,
+                   int tag, MPI_Comm comm, MPI_Request *request) {
+  return send_init_mode(buf, count, dt, dest, tag, comm, request, 3);
+}
+
 int MPI_Recv_init(void *buf, int count, MPI_Datatype dt, int source,
                   int tag, MPI_Comm comm, MPI_Request *request) {
   CommObj *c = lookup_comm(comm);
@@ -3436,11 +3469,22 @@ int MPI_Start(MPI_Request *request) {
   if (it == g_persistent.end()) return MPI_ERR_REQUEST;
   PersistentReq &p = it->second;
   if (p.active != MPI_REQUEST_NULL) return MPI_ERR_REQUEST;  // running
-  return p.is_recv
-             ? MPI_Irecv(p.rbuf, p.count, p.dt, p.peer, p.tag, p.comm,
-                         &p.active)
-             : MPI_Isend(p.sbuf, p.count, p.dt, p.peer, p.tag, p.comm,
-                         &p.active);
+  if (p.is_recv)
+    return MPI_Irecv(p.rbuf, p.count, p.dt, p.peer, p.tag, p.comm,
+                     &p.active);
+  switch (p.mode) {
+    case 1:
+      return MPI_Issend(p.sbuf, p.count, p.dt, p.peer, p.tag, p.comm,
+                        &p.active);
+    case 2:
+      return MPI_Ibsend(p.sbuf, p.count, p.dt, p.peer, p.tag, p.comm,
+                        &p.active);
+    case 3:
+      return MPI_Irsend(p.sbuf, p.count, p.dt, p.peer, p.tag, p.comm,
+                        &p.active);
+  }
+  return MPI_Isend(p.sbuf, p.count, p.dt, p.peer, p.tag, p.comm,
+                   &p.active);
 }
 
 int MPI_Startall(int count, MPI_Request requests[]) {
@@ -3898,9 +3942,14 @@ int MPI_Type_commit(MPI_Datatype *datatype) {
   return MPI_SUCCESS;
 }
 
+void delete_type_attrs(MPI_Datatype dt);  // batch-8 section
+
 int MPI_Type_free(MPI_Datatype *datatype) {
   if (!datatype || *datatype < DERIVED_BASE) return MPI_ERR_TYPE;
-  if (!g_dtypes.erase(*datatype)) return MPI_ERR_TYPE;
+  if (!g_dtypes.count(*datatype)) return MPI_ERR_TYPE;
+  // attribute delete callbacks run before the handle dies
+  delete_type_attrs(*datatype);
+  g_dtypes.erase(*datatype);
   *datatype = MPI_DATATYPE_NULL;
   return MPI_SUCCESS;
 }
@@ -3961,10 +4010,13 @@ int64_t lb_bytes_of(const DtView &v) {
   return (v.derived ? v.derived->lb : 0) * (int64_t)v.di.item;
 }
 
-// finalize a byte-based DtypeObj: elems = total bytes, base = BYTE
-void seal_byte_type(DtypeObj &d) {
+// finalize a byte-based DtypeObj: elems = total bytes, base = BYTE.
+// `swap_unit` records the uniform element size of the packed stream
+// (0 for heterogeneous structs — external32 rejects those).
+void seal_byte_type(DtypeObj &d, int swap_unit) {
   coalesce_blocks(d.blocks);
   d.base = MPI_BYTE;
+  d.swap_unit = swap_unit;
   int64_t total = 0;
   for (auto &b : d.blocks) total += b.second;
   d.elems = total;
@@ -4011,7 +4063,7 @@ int MPI_Type_create_resized(MPI_Datatype oldtype, MPI_Aint lb,
   if (!resolve_for_build(oldtype, v)) return MPI_ERR_TYPE;
   DtypeObj d;
   append_item_bytes(d.blocks, v, 0);
-  seal_byte_type(d);
+  seal_byte_type(d, (v.derived ? v.derived->swap_unit : (int)v.di.item));
   d.lb = lb;
   d.extent = extent;
   d.combiner = MPI_COMBINER_RESIZED;
@@ -4040,7 +4092,7 @@ int MPI_Type_create_hvector(int count, int blocklength, MPI_Aint stride,
       if (ilb + oext > max_ub) max_ub = ilb + oext;
     }
   }
-  seal_byte_type(d);
+  seal_byte_type(d, (v.derived ? v.derived->swap_unit : (int)v.di.item));
   d.lb = min_lb;
   d.extent = max_ub - min_lb;
   d.combiner = MPI_COMBINER_HVECTOR;
@@ -4075,7 +4127,7 @@ static int hindexed_impl(int count, const int blocklengths[],
     total += blocklengths[c];
   }
   if (total == 0) { min_lb = 0; max_ub = 0; }
-  seal_byte_type(d);
+  seal_byte_type(d, (v.derived ? v.derived->swap_unit : (int)v.di.item));
   d.lb = min_lb;
   d.extent = max_ub - min_lb;
   d.combiner = combiner;
@@ -4135,8 +4187,18 @@ int MPI_Type_create_struct(int count, const int blocklengths[],
     total += blocklengths[c];
   }
   if (total == 0) { min_lb = 0; max_ub = 0; }
-  // typemap stays in DECLARATION order (pack serializes field order)
-  seal_byte_type(d);
+  // typemap stays in DECLARATION order (pack serializes field order);
+  // a uniform field unit survives for canonical packing, mixed -> 0
+  int su = -1;
+  for (int c = 0; c < count; c++) {
+    if (blocklengths[c] == 0) continue;
+    DtView fv;
+    resolve_for_build(types[c], fv);
+    int u = fv.derived ? fv.derived->swap_unit : (int)fv.di.item;
+    if (su < 0) su = u;
+    else if (su != u) su = 0;
+  }
+  seal_byte_type(d, su < 0 ? 1 : su);
   d.lb = min_lb;
   d.extent = max_ub - min_lb;
   d.combiner = MPI_COMBINER_STRUCT;
@@ -4218,7 +4280,7 @@ int MPI_Type_create_subarray(int ndims, const int sizes[],
   }
   DtypeObj d;
   emit_runs(runs, std::vector<int>(sizes, sizes + ndims), order, v, d);
-  seal_byte_type(d);
+  seal_byte_type(d, (v.derived ? v.derived->swap_unit : (int)v.di.item));
   d.lb = 0;
   d.extent = full * extent_bytes_of(v);
   d.combiner = MPI_COMBINER_SUBARRAY;
@@ -4295,7 +4357,7 @@ int MPI_Type_create_darray(int size, int rank, int ndims,
   }
   DtypeObj d;
   emit_runs(runs, std::vector<int>(gsizes, gsizes + ndims), order, v, d);
-  seal_byte_type(d);
+  seal_byte_type(d, (v.derived ? v.derived->swap_unit : (int)v.di.item));
   d.lb = 0;
   d.extent = full * extent_bytes_of(v);
   d.combiner = MPI_COMBINER_DARRAY;
@@ -5543,7 +5605,7 @@ int MPI_File_preallocate(MPI_File fh, MPI_Offset size) {
   if (!f) return MPI_ERR_FILE;
   if (size < 0) return MPI_ERR_ARG;
   CommObj *c = lookup_comm(f->comm);
-  int rc = MPI_SUCCESS;
+  int64_t rc = MPI_SUCCESS;
   if (!c || c->local_rank == 0) {
     struct stat st{};
     if (fstat(f->fd, &st) != 0) rc = MPI_ERR_OTHER;
@@ -5551,7 +5613,10 @@ int MPI_File_preallocate(MPI_File fh, MPI_Offset size) {
              ftruncate(f->fd, (off_t)size) != 0)
       rc = MPI_ERR_OTHER;
   }
-  return c ? (c_barrier(*c), rc) : rc;
+  if (!c) return (int)rc;
+  // rank 0's outcome is everyone's outcome (collective uniformity)
+  int brc = c_bcast(*c, &rc, 1, MPI_LONG, 0, 0x7E32);
+  return brc != MPI_SUCCESS ? brc : (int)rc;
 }
 
 int MPI_File_set_atomicity(MPI_File fh, int flag) {
@@ -5732,14 +5797,15 @@ int MPI_File_seek_shared(MPI_File fh, MPI_Offset offset, int whence) {
   FileObj *f = lookup_file(fh);
   if (!f) return MPI_ERR_FILE;
   CommObj *c = lookup_comm(f->comm);
-  int rc = MPI_SUCCESS;
+  int64_t rc = MPI_SUCCESS;
   if (!c || c->local_rank == 0) {
     int64_t base = 0;
     if (whence == MPI_SEEK_CUR) {
-      sfp_update(f, 0, false, 0, &base);
+      rc = sfp_update(f, 0, false, 0, &base);
     } else if (whence == MPI_SEEK_END) {
       struct stat st{};
-      if (fstat(f->fd, &st) == 0)
+      if (fstat(f->fd, &st) != 0) rc = MPI_ERR_OTHER;
+      else
         base = (int64_t)st.st_size /
                (f->etype_size ? f->etype_size : 1);
     } else if (whence != MPI_SEEK_SET) {
@@ -5748,7 +5814,11 @@ int MPI_File_seek_shared(MPI_File fh, MPI_Offset offset, int whence) {
     if (rc == MPI_SUCCESS)
       rc = sfp_update(f, 0, true, base + (int64_t)offset, nullptr);
   }
-  return c ? (c_barrier(*c), rc) : rc;
+  if (!c) return (int)rc;
+  // rank 0's outcome rides to everyone (an early divergence would
+  // leave peers believing the shared pointer moved)
+  int brc = c_bcast(*c, &rc, 1, MPI_LONG, 0, 0x7E33);
+  return brc != MPI_SUCCESS ? brc : (int)rc;
 }
 
 int MPI_File_get_position_shared(MPI_File fh, MPI_Offset *offset) {
@@ -9785,6 +9855,448 @@ MPI_Fint MPI_Errhandler_c2f(MPI_Errhandler errhandler) {
 }
 MPI_Errhandler MPI_Errhandler_f2c(MPI_Fint errhandler) {
   return (MPI_Errhandler)errhandler;
+}
+
+// -------------------------------------- batch-8 surface (round 5)
+// group_range_incl.c, attr_put.c (MPI-1 names), type_create_keyval.c,
+// rput.c, pack_external.c, type_match_size.c, grequest_start.c.
+
+int MPI_Group_compare(MPI_Group group1, MPI_Group group2, int *result) {
+  const std::vector<int> *a = group_ranks(group1);
+  const std::vector<int> *b = group_ranks(group2);
+  if (!a || !b) return MPI_ERR_GROUP;
+  if (*a == *b) {
+    *result = MPI_IDENT;
+    return MPI_SUCCESS;
+  }
+  std::vector<int> sa(*a), sb(*b);
+  std::sort(sa.begin(), sa.end());
+  std::sort(sb.begin(), sb.end());
+  *result = sa == sb ? MPI_SIMILAR : MPI_UNEQUAL;
+  return MPI_SUCCESS;
+}
+
+namespace {
+
+// expand (first,last,stride) triplets into group ranks
+// (group_range_incl.c's triplet semantics; negative strides walk down)
+int expand_ranges(const std::vector<int> &src, int n, int ranges[][3],
+                  std::vector<int> &out) {
+  for (int i = 0; i < n; i++) {
+    int first = ranges[i][0], last = ranges[i][1], stride = ranges[i][2];
+    if (stride == 0) return MPI_ERR_ARG;
+    if (stride > 0 ? first > last : first < last) return MPI_ERR_ARG;
+    for (int r = first; stride > 0 ? r <= last : r >= last;
+         r += stride) {
+      if (r < 0 || r >= (int)src.size()) return MPI_ERR_ARG;
+      out.push_back(r);
+    }
+  }
+  return MPI_SUCCESS;
+}
+
+}  // namespace
+
+int MPI_Group_range_incl(MPI_Group group, int n, int ranges[][3],
+                         MPI_Group *newgroup) {
+  const std::vector<int> *src = group_ranks(group);
+  if (!src) return MPI_ERR_GROUP;
+  std::vector<int> picks;
+  int rc = expand_ranges(*src, n, ranges, picks);
+  if (rc != MPI_SUCCESS) return rc;
+  std::vector<int> ranks;
+  for (int r : picks) ranks.push_back((*src)[(size_t)r]);
+  *newgroup = ranks.empty() ? MPI_GROUP_EMPTY : register_group(ranks);
+  return MPI_SUCCESS;
+}
+
+int MPI_Group_range_excl(MPI_Group group, int n, int ranges[][3],
+                         MPI_Group *newgroup) {
+  const std::vector<int> *src = group_ranks(group);
+  if (!src) return MPI_ERR_GROUP;
+  std::vector<int> picks;
+  int rc = expand_ranges(*src, n, ranges, picks);
+  if (rc != MPI_SUCCESS) return rc;
+  std::vector<bool> drop(src->size(), false);
+  for (int r : picks) drop[(size_t)r] = true;
+  std::vector<int> ranks;
+  for (size_t i = 0; i < src->size(); i++)
+    if (!drop[i]) ranks.push_back((*src)[i]);
+  *newgroup = ranks.empty() ? MPI_GROUP_EMPTY : register_group(ranks);
+  return MPI_SUCCESS;
+}
+
+// MPI-1 attribute names: straight aliases of the comm attribute engine
+int MPI_Keyval_create(MPI_Copy_function *copy_fn,
+                      MPI_Delete_function *delete_fn, int *keyval,
+                      void *extra_state) {
+  return MPI_Comm_create_keyval(copy_fn, delete_fn, keyval, extra_state);
+}
+int MPI_Keyval_free(int *keyval) { return MPI_Comm_free_keyval(keyval); }
+int MPI_Attr_put(MPI_Comm comm, int keyval, void *attribute_val) {
+  return MPI_Comm_set_attr(comm, keyval, attribute_val);
+}
+int MPI_Attr_get(MPI_Comm comm, int keyval, void *attribute_val,
+                 int *flag) {
+  return MPI_Comm_get_attr(comm, keyval, attribute_val, flag);
+}
+int MPI_Attr_delete(MPI_Comm comm, int keyval) {
+  return MPI_Comm_delete_attr(comm, keyval);
+}
+
+// datatype attribute caching: the comm keyval machinery instantiated
+// for datatypes (as with windows)
+struct TypeKeyvalObj {
+  MPI_Type_copy_attr_function *copy_fn;
+  MPI_Type_delete_attr_function *delete_fn;
+  void *extra_state;
+  bool freed = false;
+};
+static std::map<int, TypeKeyvalObj> g_type_keyvals;
+static int g_next_type_keyval = 0;
+static std::map<std::pair<int, int>, void *> g_type_attrs;
+
+void reap_type_keyval(int keyval) {
+  auto kv = g_type_keyvals.find(keyval);
+  if (kv == g_type_keyvals.end() || !kv->second.freed) return;
+  for (auto &e : g_type_attrs)
+    if (e.first.second == keyval) return;
+  g_type_keyvals.erase(kv);  // deferred free completes here
+}
+
+void delete_type_attrs(MPI_Datatype dt) {
+  for (auto it = g_type_attrs.begin(); it != g_type_attrs.end();) {
+    if (it->first.first == dt) {
+      int kvid = it->first.second;
+      auto kv = g_type_keyvals.find(kvid);
+      if (kv != g_type_keyvals.end() && kv->second.delete_fn)
+        kv->second.delete_fn(dt, kvid, it->second,
+                             kv->second.extra_state);
+      it = g_type_attrs.erase(it);
+      reap_type_keyval(kvid);
+    } else {
+      ++it;
+    }
+  }
+}
+
+int MPI_Type_create_keyval(MPI_Type_copy_attr_function *copy_fn,
+                           MPI_Type_delete_attr_function *delete_fn,
+                           int *keyval, void *extra_state) {
+  if (!keyval) return MPI_ERR_ARG;
+  int kv = g_next_type_keyval++;
+  g_type_keyvals[kv] = {copy_fn, delete_fn, extra_state};
+  *keyval = kv;
+  return MPI_SUCCESS;
+}
+
+int MPI_Type_free_keyval(int *keyval) {
+  if (!keyval) return MPI_ERR_ARG;
+  auto it = g_type_keyvals.find(*keyval);
+  if (it == g_type_keyvals.end()) return MPI_ERR_ARG;
+  it->second.freed = true;
+  bool referenced = false;
+  for (auto &e : g_type_attrs)
+    if (e.first.second == *keyval) referenced = true;
+  if (!referenced) g_type_keyvals.erase(it);
+  *keyval = MPI_KEYVAL_INVALID;
+  return MPI_SUCCESS;
+}
+
+int MPI_Type_set_attr(MPI_Datatype dt, int keyval, void *attribute_val) {
+  if (dt >= DERIVED_BASE && !g_dtypes.count(dt)) return MPI_ERR_TYPE;
+  auto kv = g_type_keyvals.find(keyval);
+  if (kv == g_type_keyvals.end() || kv->second.freed)
+    return MPI_ERR_ARG;
+  auto it = g_type_attrs.find({dt, keyval});
+  if (it != g_type_attrs.end() && kv->second.delete_fn)
+    kv->second.delete_fn(dt, keyval, it->second, kv->second.extra_state);
+  g_type_attrs[{dt, keyval}] = attribute_val;
+  return MPI_SUCCESS;
+}
+
+int MPI_Type_get_attr(MPI_Datatype dt, int keyval, void *attribute_val,
+                      int *flag) {
+  if (dt >= DERIVED_BASE && !g_dtypes.count(dt)) return MPI_ERR_TYPE;
+  auto it = g_type_attrs.find({dt, keyval});
+  *flag = it != g_type_attrs.end() ? 1 : 0;
+  if (*flag) *(void **)attribute_val = it->second;
+  return MPI_SUCCESS;
+}
+
+int MPI_Type_delete_attr(MPI_Datatype dt, int keyval) {
+  if (dt >= DERIVED_BASE && !g_dtypes.count(dt)) return MPI_ERR_TYPE;
+  auto it = g_type_attrs.find({dt, keyval});
+  if (it == g_type_attrs.end()) return MPI_ERR_ARG;
+  auto kv = g_type_keyvals.find(keyval);
+  if (kv != g_type_keyvals.end() && kv->second.delete_fn)
+    kv->second.delete_fn(dt, keyval, it->second, kv->second.extra_state);
+  g_type_attrs.erase(it);
+  reap_type_keyval(keyval);
+  return MPI_SUCCESS;
+}
+
+// size-matched types (type_match_size.c)
+int MPI_Type_match_size(int typeclass, int size, MPI_Datatype *dt) {
+  if (typeclass == MPI_TYPECLASS_INTEGER) {
+    switch (size) {
+      case 1: *dt = MPI_SIGNED_CHAR; return MPI_SUCCESS;
+      case 2: *dt = MPI_SHORT; return MPI_SUCCESS;
+      case 4: *dt = MPI_INT; return MPI_SUCCESS;
+      case 8: *dt = MPI_LONG_LONG; return MPI_SUCCESS;
+    }
+  } else if (typeclass == MPI_TYPECLASS_REAL) {
+    switch (size) {
+      case 4: *dt = MPI_FLOAT; return MPI_SUCCESS;
+      case 8: *dt = MPI_DOUBLE; return MPI_SUCCESS;
+    }
+  } else if (typeclass == MPI_TYPECLASS_COMPLEX) {
+    // complex = contiguous (re, im) pair; match_size returns a
+    // REFERENCE the caller never frees, so the handle is built once
+    // per size and cached for the process lifetime
+    static MPI_Datatype cached8 = MPI_DATATYPE_NULL;
+    static MPI_Datatype cached16 = MPI_DATATYPE_NULL;
+    MPI_Datatype *slot;
+    MPI_Datatype base;
+    if (size == 8) { slot = &cached8; base = MPI_FLOAT; }
+    else if (size == 16) { slot = &cached16; base = MPI_DOUBLE; }
+    else return MPI_ERR_ARG;
+    if (*slot == MPI_DATATYPE_NULL || !g_dtypes.count(*slot)) {
+      int rc = MPI_Type_contiguous(2, base, slot);
+      if (rc != MPI_SUCCESS) return rc;
+      rc = MPI_Type_commit(slot);
+      if (rc != MPI_SUCCESS) return rc;
+    }
+    *dt = *slot;
+    return MPI_SUCCESS;
+  }
+  return MPI_ERR_ARG;
+}
+
+// Fortran-parameterized types (type_create_f90_*.c): precision/range
+// select the narrowest hosting native type
+int MPI_Type_create_f90_integer(int range, MPI_Datatype *newtype) {
+  if (range <= 2) *newtype = MPI_SIGNED_CHAR;
+  else if (range <= 4) *newtype = MPI_SHORT;
+  else if (range <= 9) *newtype = MPI_INT;
+  else if (range <= 18) *newtype = MPI_LONG_LONG;
+  else return MPI_ERR_ARG;
+  return MPI_SUCCESS;
+}
+
+int MPI_Type_create_f90_real(int precision, int range,
+                             MPI_Datatype *newtype) {
+  if (precision <= 6 && range <= 37) *newtype = MPI_FLOAT;
+  else if (precision <= 15 && range <= 307) *newtype = MPI_DOUBLE;
+  else return MPI_ERR_ARG;
+  return MPI_SUCCESS;
+}
+
+int MPI_Type_create_f90_complex(int precision, int range,
+                                MPI_Datatype *newtype) {
+  MPI_Datatype base;
+  int rc = MPI_Type_create_f90_real(precision, range, &base);
+  if (rc != MPI_SUCCESS) return rc;
+  rc = MPI_Type_contiguous(2, base, newtype);
+  if (rc != MPI_SUCCESS) return rc;
+  DtypeObj &d = g_dtypes[*newtype];
+  d.combiner = MPI_COMBINER_F90_COMPLEX;
+  d.env_ints = {precision, range};
+  d.env_types.clear();
+  return MPI_Type_commit(newtype);
+}
+
+// canonical packing (pack_external.c): big-endian canonical base
+// elements with native sizes (64-bit longs — documented divergence)
+namespace {
+
+bool little_endian() {
+  const uint16_t probe = 1;
+  return *(const uint8_t *)&probe == 1;
+}
+
+void swap_elems(char *buf, size_t nbytes, size_t item) {
+  if (item <= 1 || !little_endian()) return;
+  for (size_t at = 0; at + item <= nbytes; at += item)
+    for (size_t i = 0; i < item / 2; i++)
+      std::swap(buf[at + i], buf[at + item - 1 - i]);
+}
+
+}  // namespace
+
+// canonical element unit of a type's PACKED stream: predefined =
+// item size; byte-sealed derived = the recorded constructor unit
+// (0 = heterogeneous struct, not canonically packable)
+static int packed_unit(const DtView &v) {
+  return v.derived ? v.derived->swap_unit : (int)v.di.item;
+}
+
+int MPI_Pack_external(const char datarep[], const void *inbuf,
+                      int incount, MPI_Datatype datatype, void *outbuf,
+                      MPI_Aint outsize, MPI_Aint *position) {
+  if (!datarep || strcmp(datarep, "external32") != 0) return MPI_ERR_ARG;
+  DtView v;
+  if (!resolve_dtype(datatype, v)) return MPI_ERR_TYPE;
+  int unit = packed_unit(v);
+  if (unit == 0) return MPI_ERR_TYPE;  // mixed-field struct
+  std::vector<char> packed;
+  pack_dtype(inbuf, incount, v, packed);
+  swap_elems(packed.data(), packed.size(), (size_t)unit);
+  if (*position + (MPI_Aint)packed.size() > outsize)
+    return MPI_ERR_TRUNCATE;
+  memcpy((char *)outbuf + *position, packed.data(), packed.size());
+  *position += (MPI_Aint)packed.size();
+  return MPI_SUCCESS;
+}
+
+int MPI_Unpack_external(const char datarep[], const void *inbuf,
+                        MPI_Aint insize, MPI_Aint *position,
+                        void *outbuf, int outcount,
+                        MPI_Datatype datatype) {
+  if (!datarep || strcmp(datarep, "external32") != 0) return MPI_ERR_ARG;
+  DtView v;
+  if (!resolve_dtype(datatype, v)) return MPI_ERR_TYPE;
+  int unit = packed_unit(v);
+  if (unit == 0) return MPI_ERR_TYPE;
+  size_t want = (size_t)outcount * v.elems_per_item() * v.di.item;
+  if (*position + (MPI_Aint)want > insize) return MPI_ERR_TRUNCATE;
+  std::vector<char> tmp((const char *)inbuf + *position,
+                        (const char *)inbuf + *position + want);
+  swap_elems(tmp.data(), tmp.size(), (size_t)unit);
+  unpack_dtype(outbuf, outcount, v, tmp.data(), tmp.size());
+  *position += (MPI_Aint)want;
+  return MPI_SUCCESS;
+}
+
+int MPI_Pack_external_size(const char datarep[], int incount,
+                           MPI_Datatype datatype, MPI_Aint *size) {
+  if (!datarep || strcmp(datarep, "external32") != 0) return MPI_ERR_ARG;
+  DtView v;
+  if (!resolve_dtype(datatype, v)) return MPI_ERR_TYPE;
+  *size = (MPI_Aint)((int64_t)incount * v.elems_per_item() *
+                     (int64_t)v.di.item);
+  return MPI_SUCCESS;
+}
+
+// generalized requests (grequest_start.c): the engine's Req with
+// user-driven completion.  query_fn fills the status at completion,
+// free_fn runs right after (this engine has no free hook in the
+// retire path; complete -> wait is the ordering that matters).
+struct GrequestState {
+  MPI_Grequest_query_function *query_fn;
+  MPI_Grequest_free_function *free_fn;
+  MPI_Grequest_cancel_function *cancel_fn;
+  void *extra_state;
+};
+// guarded by g.match_mu: Grequest_complete is DESIGNED to run on a
+// user progress thread concurrent with the main thread's engine calls
+static std::map<int, GrequestState> g_grequests;
+
+int MPI_Grequest_start(MPI_Grequest_query_function *query_fn,
+                       MPI_Grequest_free_function *free_fn,
+                       MPI_Grequest_cancel_function *cancel_fn,
+                       void *extra_state, MPI_Request *request) {
+  Req *r = new Req;
+  r->heap = true;
+  r->comm = MPI_COMM_WORLD;
+  int handle;
+  {
+    std::lock_guard<std::mutex> lk(g.match_mu);
+    handle = g.next_req++;
+    g.reqs[handle] = r;
+    g_grequests[handle] = {query_fn, free_fn, cancel_fn, extra_state};
+  }
+  *request = handle;
+  return MPI_SUCCESS;
+}
+
+int MPI_Grequest_complete(MPI_Request request) {
+  GrequestState st;
+  Req *r;
+  {
+    std::lock_guard<std::mutex> lk(g.match_mu);
+    auto git = g_grequests.find(request);
+    if (git == g_grequests.end()) return MPI_ERR_REQUEST;
+    st = git->second;
+    auto it = g.reqs.find(request);
+    if (it == g.reqs.end()) return MPI_ERR_REQUEST;
+    r = it->second;
+  }
+  MPI_Status status{};
+  status.MPI_SOURCE = MPI_ANY_SOURCE;
+  status.MPI_TAG = MPI_ANY_TAG;
+  if (st.query_fn) st.query_fn(st.extra_state, &status);
+  {
+    std::lock_guard<std::mutex> lk(g.match_mu);
+    r->status = status;
+    r->complete = true;
+    g.match_cv.notify_all();
+  }
+  if (st.free_fn) st.free_fn(st.extra_state);
+  {
+    std::lock_guard<std::mutex> lk(g.match_mu);
+    g_grequests.erase(request);
+  }
+  return MPI_SUCCESS;
+}
+
+// request-based RMA (rput.c family): every origin-side operation here
+// packs its payload at call time (local completion is immediate), so
+// the request is born complete — remote completion is the epoch's
+// flush/unlock/fence, exactly as for the non-request forms
+int MPI_Rput(const void *origin_addr, int origin_count,
+             MPI_Datatype origin_datatype, int target_rank,
+             MPI_Aint target_disp, int target_count,
+             MPI_Datatype target_datatype, MPI_Win win,
+             MPI_Request *request) {
+  int rc = MPI_Put(origin_addr, origin_count, origin_datatype,
+                   target_rank, target_disp, target_count,
+                   target_datatype, win);
+  if (rc != MPI_SUCCESS) return rc;
+  *request = make_completed_req(MPI_COMM_WORLD);
+  return MPI_SUCCESS;
+}
+
+int MPI_Rget(void *origin_addr, int origin_count,
+             MPI_Datatype origin_datatype, int target_rank,
+             MPI_Aint target_disp, int target_count,
+             MPI_Datatype target_datatype, MPI_Win win,
+             MPI_Request *request) {
+  int rc = MPI_Get(origin_addr, origin_count, origin_datatype,
+                   target_rank, target_disp, target_count,
+                   target_datatype, win);
+  if (rc != MPI_SUCCESS) return rc;
+  *request = make_completed_req(MPI_COMM_WORLD);
+  return MPI_SUCCESS;
+}
+
+int MPI_Raccumulate(const void *origin_addr, int origin_count,
+                    MPI_Datatype origin_datatype, int target_rank,
+                    MPI_Aint target_disp, int target_count,
+                    MPI_Datatype target_datatype, MPI_Op op, MPI_Win win,
+                    MPI_Request *request) {
+  int rc = MPI_Accumulate(origin_addr, origin_count, origin_datatype,
+                          target_rank, target_disp, target_count,
+                          target_datatype, op, win);
+  if (rc != MPI_SUCCESS) return rc;
+  *request = make_completed_req(MPI_COMM_WORLD);
+  return MPI_SUCCESS;
+}
+
+int MPI_Rget_accumulate(const void *origin_addr, int origin_count,
+                        MPI_Datatype origin_datatype, void *result_addr,
+                        int result_count, MPI_Datatype result_datatype,
+                        int target_rank, MPI_Aint target_disp,
+                        int target_count, MPI_Datatype target_datatype,
+                        MPI_Op op, MPI_Win win, MPI_Request *request) {
+  int rc = MPI_Get_accumulate(origin_addr, origin_count,
+                              origin_datatype, result_addr,
+                              result_count, result_datatype,
+                              target_rank, target_disp, target_count,
+                              target_datatype, op, win);
+  if (rc != MPI_SUCCESS) return rc;
+  *request = make_completed_req(MPI_COMM_WORLD);
+  return MPI_SUCCESS;
 }
 
 // ---------------------------------------------------------------- misc

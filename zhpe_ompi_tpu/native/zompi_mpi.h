@@ -228,6 +228,11 @@ int MPI_Group_difference(MPI_Group group1, MPI_Group group2,
                          MPI_Group *newgroup);
 int MPI_Group_translate_ranks(MPI_Group group1, int n, const int ranks1[],
                               MPI_Group group2, int ranks2[]);
+int MPI_Group_compare(MPI_Group group1, MPI_Group group2, int *result);
+int MPI_Group_range_incl(MPI_Group group, int n, int ranges[][3],
+                         MPI_Group *newgroup);
+int MPI_Group_range_excl(MPI_Group group, int n, int ranges[][3],
+                         MPI_Group *newgroup);
 int MPI_Group_free(MPI_Group *group);
 int MPI_Comm_create(MPI_Comm comm, MPI_Group group, MPI_Comm *newcomm);
 
@@ -295,6 +300,12 @@ int MPI_Testall(int count, MPI_Request requests[], int *flag,
  * Start/Startall + Wait/Test/Waitall (not Waitany/Testall) */
 int MPI_Send_init(const void *buf, int count, MPI_Datatype dt, int dest,
                   int tag, MPI_Comm comm, MPI_Request *request);
+int MPI_Ssend_init(const void *buf, int count, MPI_Datatype dt, int dest,
+                   int tag, MPI_Comm comm, MPI_Request *request);
+int MPI_Bsend_init(const void *buf, int count, MPI_Datatype dt, int dest,
+                   int tag, MPI_Comm comm, MPI_Request *request);
+int MPI_Rsend_init(const void *buf, int count, MPI_Datatype dt, int dest,
+                   int tag, MPI_Comm comm, MPI_Request *request);
 int MPI_Recv_init(void *buf, int count, MPI_Datatype dt, int source,
                   int tag, MPI_Comm comm, MPI_Request *request);
 int MPI_Start(MPI_Request *request);
@@ -758,6 +769,104 @@ int MPI_Type_struct(int count, int blocklengths[],
 int MPI_Type_extent(MPI_Datatype dt, MPI_Aint *extent);
 int MPI_Type_lb(MPI_Datatype dt, MPI_Aint *lb);
 int MPI_Type_ub(MPI_Datatype dt, MPI_Aint *ub);
+
+/* legacy MPI-1 attribute names (attr_put.c, keyval_create.c) */
+typedef MPI_Comm_copy_attr_function MPI_Copy_function;
+typedef MPI_Comm_delete_attr_function MPI_Delete_function;
+int MPI_Keyval_create(MPI_Copy_function *copy_fn,
+                      MPI_Delete_function *delete_fn, int *keyval,
+                      void *extra_state);
+int MPI_Keyval_free(int *keyval);
+int MPI_Attr_put(MPI_Comm comm, int keyval, void *attribute_val);
+int MPI_Attr_get(MPI_Comm comm, int keyval, void *attribute_val,
+                 int *flag);
+int MPI_Attr_delete(MPI_Comm comm, int keyval);
+
+/* datatype attribute caching (type_create_keyval.c family) */
+typedef int MPI_Type_copy_attr_function(MPI_Datatype olddt, int keyval,
+                                        void *extra_state,
+                                        void *attribute_val_in,
+                                        void *attribute_val_out,
+                                        int *flag);
+typedef int MPI_Type_delete_attr_function(MPI_Datatype dt, int keyval,
+                                          void *attribute_val,
+                                          void *extra_state);
+int MPI_Type_create_keyval(MPI_Type_copy_attr_function *copy_fn,
+                           MPI_Type_delete_attr_function *delete_fn,
+                           int *keyval, void *extra_state);
+int MPI_Type_free_keyval(int *keyval);
+int MPI_Type_set_attr(MPI_Datatype dt, int keyval, void *attribute_val);
+int MPI_Type_get_attr(MPI_Datatype dt, int keyval, void *attribute_val,
+                      int *flag);
+int MPI_Type_delete_attr(MPI_Datatype dt, int keyval);
+
+/* size-matched and Fortran-parameterized types (type_match_size.c,
+ * type_create_f90_real.c family) */
+#define MPI_TYPECLASS_INTEGER 1
+#define MPI_TYPECLASS_REAL    2
+#define MPI_TYPECLASS_COMPLEX 3
+#define MPI_COMBINER_F90_REAL    13
+#define MPI_COMBINER_F90_COMPLEX 14
+#define MPI_COMBINER_F90_INTEGER 15
+int MPI_Type_match_size(int typeclass, int size, MPI_Datatype *dt);
+int MPI_Type_create_f90_integer(int range, MPI_Datatype *newtype);
+int MPI_Type_create_f90_real(int precision, int range,
+                             MPI_Datatype *newtype);
+int MPI_Type_create_f90_complex(int precision, int range,
+                                MPI_Datatype *newtype);
+
+/* canonical "external32" packing (pack_external.c): big-endian
+ * canonical base elements; 64-bit longs (documented divergence from
+ * the 4-byte external32 long — the Python plane's external32 module
+ * owns full fidelity) */
+int MPI_Pack_external(const char datarep[], const void *inbuf,
+                      int incount, MPI_Datatype datatype, void *outbuf,
+                      MPI_Aint outsize, MPI_Aint *position);
+int MPI_Unpack_external(const char datarep[], const void *inbuf,
+                        MPI_Aint insize, MPI_Aint *position,
+                        void *outbuf, int outcount,
+                        MPI_Datatype datatype);
+int MPI_Pack_external_size(const char datarep[], int incount,
+                           MPI_Datatype datatype, MPI_Aint *size);
+
+/* generalized requests (grequest_start.c): user-completed requests in
+ * the same engine.  query_fn runs at completion, free_fn when the
+ * request retires. */
+typedef int MPI_Grequest_query_function(void *extra_state,
+                                        MPI_Status *status);
+typedef int MPI_Grequest_free_function(void *extra_state);
+typedef int MPI_Grequest_cancel_function(void *extra_state,
+                                         int complete);
+int MPI_Grequest_start(MPI_Grequest_query_function *query_fn,
+                       MPI_Grequest_free_function *free_fn,
+                       MPI_Grequest_cancel_function *cancel_fn,
+                       void *extra_state, MPI_Request *request);
+int MPI_Grequest_complete(MPI_Request request);
+
+/* request-based RMA (rput.c family): operations complete locally at
+ * call time on this engine, so the returned request is born complete;
+ * remote completion still requires the epoch's flush/unlock/fence */
+int MPI_Rput(const void *origin_addr, int origin_count,
+             MPI_Datatype origin_datatype, int target_rank,
+             MPI_Aint target_disp, int target_count,
+             MPI_Datatype target_datatype, MPI_Win win,
+             MPI_Request *request);
+int MPI_Rget(void *origin_addr, int origin_count,
+             MPI_Datatype origin_datatype, int target_rank,
+             MPI_Aint target_disp, int target_count,
+             MPI_Datatype target_datatype, MPI_Win win,
+             MPI_Request *request);
+int MPI_Raccumulate(const void *origin_addr, int origin_count,
+                    MPI_Datatype origin_datatype, int target_rank,
+                    MPI_Aint target_disp, int target_count,
+                    MPI_Datatype target_datatype, MPI_Op op, MPI_Win win,
+                    MPI_Request *request);
+int MPI_Rget_accumulate(const void *origin_addr, int origin_count,
+                        MPI_Datatype origin_datatype, void *result_addr,
+                        int result_count, MPI_Datatype result_datatype,
+                        int target_rank, MPI_Aint target_disp,
+                        int target_count, MPI_Datatype target_datatype,
+                        MPI_Op op, MPI_Win win, MPI_Request *request);
 
 /* pack/unpack (ompi/mpi/c/pack.c:45 surface over the convertor) */
 int MPI_Pack(const void *inbuf, int incount, MPI_Datatype datatype,
