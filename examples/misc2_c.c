@@ -210,6 +210,21 @@ int main(int argc, char **argv) {
     CHECK(sback[1] == 9); /* the gap is untouched */
     MPI_Type_free(&hv);
 
+    /* element-sealed derived types (contiguous of ints) swap too */
+    MPI_Datatype c3;
+    CHECK(MPI_Type_contiguous(3, MPI_INT, &c3) == MPI_SUCCESS);
+    CHECK(MPI_Type_commit(&c3) == MPI_SUCCESS);
+    pos = 0;
+    CHECK(MPI_Pack_external("external32", vals, 1, c3, buf, 64, &pos) ==
+          MPI_SUCCESS && pos == 12);
+    CHECK((unsigned char)buf[0] == 0x01 && (unsigned char)buf[3] == 0x04);
+    int cback[3] = {0, 0, 0};
+    rpos = 0;
+    CHECK(MPI_Unpack_external("external32", buf, pos, &rpos, cback, 1,
+                              c3) == MPI_SUCCESS);
+    CHECK(cback[0] == vals[0] && cback[2] == vals[2]);
+    MPI_Type_free(&c3);
+
     /* a mixed-field struct has no canonical element unit */
     {
       int bl[2] = {1, 1};

@@ -1,0 +1,197 @@
+/* ports_c.c — round-5 dynamic-process tier-2 acceptance: ports
+ * (open/accept/connect/disconnect), the launcher name service
+ * (publish/lookup/unpublish), MPI_Comm_join over a raw socket, the
+ * general MPI_Dist_graph_create, and predefined attr callbacks.
+ * Reference shapes: ompi/mpi/c/{open_port,comm_accept,comm_connect,
+ * publish_name,comm_join,dist_graph_create,attr_fn}.c.
+ * Run with >= 2 ranks under zmpirun (the name server lives there). */
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <unistd.h>
+#include <arpa/inet.h>
+#include <sys/socket.h>
+#include "zompi_mpi.h"
+
+#define CHECK(cond)                                                    \
+  do {                                                                 \
+    if (!(cond)) {                                                     \
+      fprintf(stderr, "FAIL %s:%d: %s\n", __FILE__, __LINE__, #cond);  \
+      MPI_Abort(MPI_COMM_WORLD, 2);                                    \
+    }                                                                  \
+  } while (0)
+
+int main(int argc, char **argv) {
+  CHECK(MPI_Init(&argc, &argv) == MPI_SUCCESS);
+  int rank, size;
+  MPI_Comm_rank(MPI_COMM_WORLD, &rank);
+  MPI_Comm_size(MPI_COMM_WORLD, &size);
+  CHECK(size >= 2);
+
+  /* ---- split into server half (low) and client half (high) ---- */
+  int half = size / 2;
+  int is_server = rank < half;
+  MPI_Comm side;
+  CHECK(MPI_Comm_split(MPI_COMM_WORLD, is_server, rank, &side) ==
+        MPI_SUCCESS);
+
+  /* ---- ports + name service ---- */
+  {
+    char svc[64];
+    snprintf(svc, sizeof svc, "zompi-ports-demo-%s",
+             getenv("ZMPI_COORD_PORT") ? getenv("ZMPI_COORD_PORT") : "0");
+    MPI_Comm inter = MPI_COMM_NULL;
+    if (is_server) {
+      char port[MPI_MAX_PORT_NAME] = {0};
+      if (rank == 0) {
+        CHECK(MPI_Open_port(MPI_INFO_NULL, port) == MPI_SUCCESS);
+        CHECK(strchr(port, ':') != NULL);
+        CHECK(MPI_Publish_name(svc, MPI_INFO_NULL, port) ==
+              MPI_SUCCESS);
+      }
+      MPI_Barrier(MPI_COMM_WORLD); /* clients may look up now */
+      CHECK(MPI_Comm_accept(port, MPI_INFO_NULL, 0, side, &inter) ==
+            MPI_SUCCESS);
+      if (rank == 0) {
+        CHECK(MPI_Unpublish_name(svc, MPI_INFO_NULL, port) ==
+              MPI_SUCCESS);
+        CHECK(MPI_Close_port(port) == MPI_SUCCESS);
+      }
+    } else {
+      char port[MPI_MAX_PORT_NAME] = {0};
+      MPI_Barrier(MPI_COMM_WORLD); /* wait for the publication */
+      if (rank == half)
+        CHECK(MPI_Lookup_name(svc, MPI_INFO_NULL, port) == MPI_SUCCESS);
+      CHECK(MPI_Comm_connect(port, MPI_INFO_NULL, 0, side, &inter) ==
+            MPI_SUCCESS);
+    }
+    /* intercomm sanity: sizes and a remote-group exchange */
+    int lsz = -1, rsz = -1, flag = 0;
+    CHECK(MPI_Comm_test_inter(inter, &flag) == MPI_SUCCESS && flag);
+    CHECK(MPI_Comm_size(inter, &lsz) == MPI_SUCCESS);
+    CHECK(MPI_Comm_remote_size(inter, &rsz) == MPI_SUCCESS);
+    CHECK(lsz == (is_server ? half : size - half));
+    CHECK(rsz == (is_server ? size - half : half));
+    int me_local = -1;
+    MPI_Comm_rank(inter, &me_local);
+    if (me_local == 0) {
+      int token = is_server ? 111 : 222, got = -1;
+      MPI_Status st;
+      CHECK(MPI_Sendrecv(&token, 1, MPI_INT, 0, 9, &got, 1, MPI_INT, 0,
+                         9, inter, &st) == MPI_SUCCESS);
+      CHECK(got == (is_server ? 222 : 111));
+    }
+    CHECK(MPI_Comm_disconnect(&inter) == MPI_SUCCESS &&
+          inter == MPI_COMM_NULL);
+  }
+
+  /* ---- Comm_join between ranks 0 and 1 over a raw TCP socket ---- */
+  if (rank < 2) {
+    int sock = -1;
+    if (rank == 0) {
+      int srv = socket(AF_INET, SOCK_STREAM, 0);
+      struct sockaddr_in a;
+      memset(&a, 0, sizeof a);
+      a.sin_family = AF_INET;
+      a.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+      a.sin_port = 0;
+      CHECK(bind(srv, (struct sockaddr *)&a, sizeof a) == 0);
+      CHECK(listen(srv, 1) == 0);
+      socklen_t alen = sizeof a;
+      getsockname(srv, (struct sockaddr *)&a, &alen);
+      int p = (int)ntohs(a.sin_port);
+      CHECK(MPI_Send(&p, 1, MPI_INT, 1, 77, MPI_COMM_WORLD) ==
+            MPI_SUCCESS);
+      sock = accept(srv, NULL, NULL);
+      CHECK(sock >= 0);
+      close(srv);
+    } else {
+      int p = -1;
+      CHECK(MPI_Recv(&p, 1, MPI_INT, 0, 77, MPI_COMM_WORLD,
+                     MPI_STATUS_IGNORE) == MPI_SUCCESS);
+      sock = socket(AF_INET, SOCK_STREAM, 0);
+      struct sockaddr_in a;
+      memset(&a, 0, sizeof a);
+      a.sin_family = AF_INET;
+      a.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+      a.sin_port = htons((unsigned short)p);
+      CHECK(connect(sock, (struct sockaddr *)&a, sizeof a) == 0);
+    }
+    MPI_Comm joined = MPI_COMM_NULL;
+    CHECK(MPI_Comm_join(sock, &joined) == MPI_SUCCESS);
+    close(sock);
+    int rsz = -1;
+    CHECK(MPI_Comm_remote_size(joined, &rsz) == MPI_SUCCESS &&
+          rsz == 1);
+    int token = 500 + rank, got = -1;
+    CHECK(MPI_Sendrecv(&token, 1, MPI_INT, 0, 8, &got, 1, MPI_INT, 0, 8,
+                       joined, MPI_STATUS_IGNORE) == MPI_SUCCESS);
+    CHECK(got == 500 + (1 - rank));
+    CHECK(MPI_Comm_disconnect(&joined) == MPI_SUCCESS);
+  }
+
+  /* ---- general dist_graph: rank 0 declares the whole ring ---- */
+  {
+    MPI_Comm ring = MPI_COMM_NULL;
+    int *src = NULL, *deg = NULL, *dst = NULL;
+    int n = 0;
+    if (rank == 0) {
+      /* edges r -> (r+1)%size for every r, all declared by rank 0 */
+      n = size;
+      src = malloc(sizeof(int) * (size_t)size);
+      deg = malloc(sizeof(int) * (size_t)size);
+      dst = malloc(sizeof(int) * (size_t)size);
+      for (int r = 0; r < size; r++) {
+        src[r] = r;
+        deg[r] = 1;
+        dst[r] = (r + 1) % size;
+      }
+    }
+    CHECK(MPI_Dist_graph_create(MPI_COMM_WORLD, n, src, deg, dst,
+                                MPI_UNWEIGHTED, MPI_INFO_NULL, 0,
+                                &ring) == MPI_SUCCESS);
+    int indeg = -1, outdeg = -1, wflag = -1;
+    CHECK(MPI_Dist_graph_neighbors_count(ring, &indeg, &outdeg,
+                                         &wflag) == MPI_SUCCESS);
+    CHECK(indeg == 1 && outdeg == 1 && wflag == 0);
+    int in1 = -1, out1 = -1;
+    CHECK(MPI_Dist_graph_neighbors(ring, 1, &in1, MPI_UNWEIGHTED, 1,
+                                   &out1, MPI_UNWEIGHTED) ==
+          MPI_SUCCESS);
+    CHECK(in1 == (rank + size - 1) % size && out1 == (rank + 1) % size);
+    /* the directed exchange actually routes */
+    long sbuf = 9000 + rank, rbuf = -1;
+    CHECK(MPI_Neighbor_alltoall(&sbuf, 1, MPI_LONG, &rbuf, 1, MPI_LONG,
+                                ring) == MPI_SUCCESS);
+    CHECK(rbuf == 9000 + (rank + size - 1) % size);
+    MPI_Comm_free(&ring);
+    free(src);
+    free(deg);
+    free(dst);
+  }
+
+  /* ---- predefined attr callbacks: DUP_FN propagates on dup ---- */
+  {
+    int kv = MPI_KEYVAL_INVALID;
+    CHECK(MPI_Comm_create_keyval(MPI_COMM_DUP_FN,
+                                 MPI_COMM_NULL_DELETE_FN, &kv, NULL) ==
+          MPI_SUCCESS);
+    CHECK(MPI_Comm_set_attr(MPI_COMM_WORLD, kv, (void *)0xFEED) ==
+          MPI_SUCCESS);
+    MPI_Comm dup;
+    CHECK(MPI_Comm_dup(MPI_COMM_WORLD, &dup) == MPI_SUCCESS);
+    void *got = NULL;
+    int found = 0;
+    CHECK(MPI_Comm_get_attr(dup, kv, &got, &found) == MPI_SUCCESS);
+    CHECK(found == 1 && got == (void *)0xFEED);
+    MPI_Comm_free(&dup);
+    CHECK(MPI_Comm_delete_attr(MPI_COMM_WORLD, kv) == MPI_SUCCESS);
+    CHECK(MPI_Comm_free_keyval(&kv) == MPI_SUCCESS);
+  }
+
+  MPI_Comm_free(&side);
+  MPI_Barrier(MPI_COMM_WORLD);
+  if (rank == 0) printf("ports_c OK on %d ranks\n", size);
+  MPI_Finalize();
+  return 0;
+}

@@ -196,6 +196,106 @@ class TestPureC:
         outs = _run_example(shim, tmp_path_factory, "misc2_c.c", n)
         assert f"misc2_c OK on {n} ranks" in outs[0]
 
+    @pytest.mark.parametrize("n", [2, 4])
+    def test_ports_example(self, tmp_path, n):
+        """Round-5 dynamic-process tier 2 under zmpirun (the name
+        server lives in the launcher): ports + publish/lookup/
+        unpublish, Comm_accept/connect between the job's halves,
+        Comm_join over a raw socket, general Dist_graph_create ring
+        declared entirely by rank 0, predefined DUP_FN propagation."""
+        binary = str(tmp_path / "ports")
+        res = subprocess.run(
+            [sys.executable, "-m", "zhpe_ompi_tpu.tools.zmpicc",
+             os.path.join(REPO, "examples", "ports_c.c"), "-o", binary],
+            capture_output=True, text=True, timeout=180,
+            env={**os.environ, "PYTHONPATH": REPO},
+        )
+        assert res.returncode == 0, res.stderr
+        run = subprocess.run(
+            [sys.executable, "-m", "zhpe_ompi_tpu.tools.mpirun",
+             "-n", str(n), binary],
+            capture_output=True, text=True, timeout=180,
+            env={**os.environ, "PYTHONPATH": REPO},
+        )
+        assert run.returncode == 0, run.stderr + run.stdout
+        assert f"ports_c OK on {n} ranks" in run.stdout
+
+    def test_spawn_multiple(self, shim, tmp_path):
+        """MPI_Comm_spawn_multiple: two command blocks share ONE child
+        world; each child reports its world rank and block identity
+        back to the parent over the spawn intercomm."""
+        child = tmp_path / "childm.c"
+        child.write_text(r'''
+#include <stdio.h>
+#include <stdlib.h>
+#include "zompi_mpi.h"
+int main(int argc, char **argv) {
+  MPI_Init(&argc, &argv);
+  int rank, size;
+  MPI_Comm_rank(MPI_COMM_WORLD, &rank);
+  MPI_Comm_size(MPI_COMM_WORLD, &size);
+  MPI_Comm parent;
+  MPI_Comm_get_parent(&parent);
+  if (parent == MPI_COMM_NULL) return 3;
+  /* block identity arrives as argv[1] */
+  int payload[2] = {rank * 10 + atoi(argv[1]), size};
+  MPI_Send(payload, 2, MPI_INT, 0, 1, parent);
+  MPI_Finalize();
+  return 0;
+}
+''')
+        parent = tmp_path / "parentm.c"
+        parent.write_text(r'''
+#include <stdio.h>
+#include <string.h>
+#include "zompi_mpi.h"
+int main(int argc, char **argv) {
+  MPI_Init(&argc, &argv);
+  int rank;
+  MPI_Comm_rank(MPI_COMM_WORLD, &rank);
+  char *cmds[2] = {argv[1], argv[1]};
+  char *a0[] = {(char *)"1", 0};
+  char *a1[] = {(char *)"2", 0};
+  char **argvs[2] = {a0, a1};
+  int counts[2] = {1, 2};
+  MPI_Comm inter;
+  int codes[3];
+  if (MPI_Comm_spawn_multiple(2, cmds, argvs, counts, 0, 0,
+                              MPI_COMM_WORLD, &inter, codes)
+      != MPI_SUCCESS) return 4;
+  int rsz = -1;
+  MPI_Comm_remote_size(inter, &rsz);
+  if (rsz != 3) return 5;
+  if (rank == 0) {
+    int seen_block[4] = {0, 0, 0, 0};
+    for (int k = 0; k < 3; k++) {
+      int payload[2];
+      MPI_Status st;
+      MPI_Recv(payload, 2, MPI_INT, MPI_ANY_SOURCE, 1, inter, &st);
+      if (payload[1] != 3) return 6;   /* ONE shared child world */
+      seen_block[payload[0] % 10]++;
+    }
+    if (seen_block[1] != 1 || seen_block[2] != 2) return 7;
+    printf("spawn_multiple OK\n");
+  }
+  MPI_Comm_free(&inter);
+  MPI_Finalize();
+  return 0;
+}
+''')
+        childbin = tmp_path / "childm"
+        parentbin = tmp_path / "parentm"
+        _compile_c(shim, child, childbin)
+        _compile_c(shim, parent, parentbin)
+        port = _free_port()
+        p = subprocess.Popen([str(parentbin), str(childbin)],
+                             env=_env(0, 1, port),
+                             stdout=subprocess.PIPE,
+                             stderr=subprocess.PIPE, text=True)
+        out, err = p.communicate(timeout=90)
+        assert p.returncode == 0, f"parent failed: {err}\n{out}"
+        assert "spawn_multiple OK" in out
+
     def test_are_fatal_default_aborts(self, shim, tmp_path):
         """The MPI default handler is ERRORS_ARE_FATAL: an invalid-rank
         send without an installed handler must kill the process with a

@@ -352,6 +352,15 @@ constexpr MPI_Datatype DERIVED_BASE = 0x40;
 std::map<MPI_Datatype, DtypeObj> g_dtypes;
 MPI_Datatype g_next_dtype = DERIVED_BASE;
 
+// canonical packed element unit of a type's packed stream: predefined
+// and element-sealed derived = base item size; byte-sealed derived =
+// the unit recorded at construction (0 = heterogeneous struct)
+int packed_unit_of(const DtypeObj *derived, size_t item) {
+  if (!derived) return (int)item;
+  if (derived->base != 0 /* MPI_BYTE */) return (int)item;
+  return derived->swap_unit;
+}
+
 // A resolved view: base info + typemap (identity map for predefined).
 struct DtView {
   DtInfo di;
@@ -4063,7 +4072,7 @@ int MPI_Type_create_resized(MPI_Datatype oldtype, MPI_Aint lb,
   if (!resolve_for_build(oldtype, v)) return MPI_ERR_TYPE;
   DtypeObj d;
   append_item_bytes(d.blocks, v, 0);
-  seal_byte_type(d, (v.derived ? v.derived->swap_unit : (int)v.di.item));
+  seal_byte_type(d, packed_unit_of(v.derived, v.di.item));
   d.lb = lb;
   d.extent = extent;
   d.combiner = MPI_COMBINER_RESIZED;
@@ -4092,7 +4101,7 @@ int MPI_Type_create_hvector(int count, int blocklength, MPI_Aint stride,
       if (ilb + oext > max_ub) max_ub = ilb + oext;
     }
   }
-  seal_byte_type(d, (v.derived ? v.derived->swap_unit : (int)v.di.item));
+  seal_byte_type(d, packed_unit_of(v.derived, v.di.item));
   d.lb = min_lb;
   d.extent = max_ub - min_lb;
   d.combiner = MPI_COMBINER_HVECTOR;
@@ -4127,7 +4136,7 @@ static int hindexed_impl(int count, const int blocklengths[],
     total += blocklengths[c];
   }
   if (total == 0) { min_lb = 0; max_ub = 0; }
-  seal_byte_type(d, (v.derived ? v.derived->swap_unit : (int)v.di.item));
+  seal_byte_type(d, packed_unit_of(v.derived, v.di.item));
   d.lb = min_lb;
   d.extent = max_ub - min_lb;
   d.combiner = combiner;
@@ -4194,7 +4203,7 @@ int MPI_Type_create_struct(int count, const int blocklengths[],
     if (blocklengths[c] == 0) continue;
     DtView fv;
     resolve_for_build(types[c], fv);
-    int u = fv.derived ? fv.derived->swap_unit : (int)fv.di.item;
+    int u = packed_unit_of(fv.derived, fv.di.item);
     if (su < 0) su = u;
     else if (su != u) su = 0;
   }
@@ -4280,7 +4289,7 @@ int MPI_Type_create_subarray(int ndims, const int sizes[],
   }
   DtypeObj d;
   emit_runs(runs, std::vector<int>(sizes, sizes + ndims), order, v, d);
-  seal_byte_type(d, (v.derived ? v.derived->swap_unit : (int)v.di.item));
+  seal_byte_type(d, packed_unit_of(v.derived, v.di.item));
   d.lb = 0;
   d.extent = full * extent_bytes_of(v);
   d.combiner = MPI_COMBINER_SUBARRAY;
@@ -4357,7 +4366,7 @@ int MPI_Type_create_darray(int size, int rank, int ndims,
   }
   DtypeObj d;
   emit_runs(runs, std::vector<int>(gsizes, gsizes + ndims), order, v, d);
-  seal_byte_type(d, (v.derived ? v.derived->swap_unit : (int)v.di.item));
+  seal_byte_type(d, packed_unit_of(v.derived, v.di.item));
   d.lb = 0;
   d.extent = full * extent_bytes_of(v);
   d.combiner = MPI_COMBINER_DARRAY;
@@ -6877,9 +6886,12 @@ void reap_spawned(void) {
   }
 }
 
-int MPI_Comm_spawn(const char *command, char *argv[], int maxprocs,
-                   MPI_Info /*info*/, int root, MPI_Comm comm,
-                   MPI_Comm *intercomm, int errcodes[]) {
+// one spawn engine for MPI_Comm_spawn AND MPI_Comm_spawn_multiple
+// (comm_spawn_multiple.c): all blocks share ONE child world; child i
+// runs the command of the block it falls into.
+static int spawn_impl(int count, const char *commands[], char ***argvs,
+                      const int maxprocs_arr[], int root, MPI_Comm comm,
+                      MPI_Comm *intercomm, int errcodes[]) {
   CommObj *c = lookup_comm(comm);
   if (!c || !c->remote.empty()) return MPI_ERR_COMM;
   if (root < 0 || root >= (int)c->group.size()) return MPI_ERR_ARG;
@@ -6891,9 +6903,14 @@ int MPI_Comm_spawn(const char *command, char *argv[], int maxprocs,
   // the other ranks inside c_bcast.
   long hdr[3] = {-1, 0, 0};  // maxprocs, spawn cid, base
   std::string flat;          // "host:port\n" per child
+  int maxprocs = 0;  // total across blocks (root-significant)
   if (me == root) {
-    // command/argv/maxprocs are root-significant (MPI-3.1 10.3.2)
-    if (maxprocs <= 0 || !command) goto root_done;
+    // commands/argvs/maxprocs are root-significant (MPI-3.1 10.3.2)
+    if (count <= 0) goto root_done;
+    for (int b = 0; b < count; b++) {
+      if (maxprocs_arr[b] <= 0 || !commands[b]) goto root_done;
+      maxprocs += maxprocs_arr[b];
+    }
     {
       int base = (int)g.book.size();
       // the bound is the CONSTANT, not capacity(): reserve guarantees
@@ -6927,12 +6944,23 @@ int MPI_Comm_spawn(const char *command, char *argv[], int maxprocs,
         pgroup += std::to_string(c->group[i]);
       }
       // argv/envp built BEFORE fork (threads hold malloc locks); the
-      // filtered base environment is shared by every child
-      std::vector<char *> av;
-      av.push_back(const_cast<char *>(command));
-      if (argv)
-        for (int i = 0; argv[i]; i++) av.push_back(argv[i]);
-      av.push_back(nullptr);
+      // filtered base environment is shared by every child.  One argv
+      // vector per block; child i uses its block's.
+      std::vector<std::vector<char *>> avs((size_t)count);
+      std::vector<int> block_of((size_t)maxprocs);
+      {
+        int at = 0;
+        for (int b = 0; b < count; b++) {
+          avs[(size_t)b].push_back(const_cast<char *>(commands[b]));
+          char **bargv = argvs ? argvs[b] : nullptr;
+          if (bargv)
+            for (int i = 0; bargv[i]; i++)
+              avs[(size_t)b].push_back(bargv[i]);
+          avs[(size_t)b].push_back(nullptr);
+          for (int i = 0; i < maxprocs_arr[b]; i++)
+            block_of[(size_t)at++] = b;
+        }
+      }
       extern char **environ;
       std::vector<std::string> base_envs;
       for (char **e = environ; *e; e++) {
@@ -6967,10 +6995,11 @@ int MPI_Comm_spawn(const char *command, char *argv[], int maxprocs,
         }
         set_cloexec(pfd[0]);  // later siblings must not inherit it
         set_cloexec(pfd[1]);
+        int blk = block_of[(size_t)i];
         pid_t pid = fork();
         if (pid == 0) {
           close(pfd[0]);
-          execve(command, av.data(), ev.data());
+          execve(commands[blk], avs[(size_t)blk].data(), ev.data());
           // exec failed: the CLOEXEC pipe survived — report and die
           // (write is async-signal-safe)
           int err = errno;
@@ -7116,6 +7145,33 @@ root_done:
   if (errcodes)
     for (int i = 0; i < nkids; i++) errcodes[i] = MPI_SUCCESS;
   return MPI_SUCCESS;
+}
+
+int MPI_Comm_spawn(const char *command, char *argv[], int maxprocs,
+                   MPI_Info /*info*/, int root, MPI_Comm comm,
+                   MPI_Comm *intercomm, int errcodes[]) {
+  char **argvs1[1] = {argv};
+  return spawn_impl(1, &command, argvs1, &maxprocs, root, comm,
+                    intercomm, errcodes);
+}
+
+int MPI_Comm_spawn_multiple(int count, char *commands[],
+                            char **argvs[], const int maxprocs[],
+                            const MPI_Info /*infos*/[], int root,
+                            MPI_Comm comm, MPI_Comm *intercomm,
+                            int errcodes[]) {
+  // comm_spawn_multiple.c: one child WORLD spanning every block.
+  // count/commands/argvs/maxprocs are ROOT-significant (MPI-3.1
+  // 10.3.2) — non-root ranks must not touch them
+  CommObj *c = lookup_comm(comm);
+  if (!c) return MPI_ERR_COMM;
+  std::vector<const char *> cmds;
+  if (c->local_rank == root && count > 0 && commands) {
+    cmds.resize((size_t)count);
+    for (int b = 0; b < count; b++) cmds[(size_t)b] = commands[b];
+  }
+  return spawn_impl(count, cmds.data(), argvs, maxprocs, root, comm,
+                    intercomm, errcodes);
 }
 
 int MPI_Comm_get_parent(MPI_Comm *parent) {
@@ -10128,7 +10184,7 @@ void swap_elems(char *buf, size_t nbytes, size_t item) {
 // item size; byte-sealed derived = the recorded constructor unit
 // (0 = heterogeneous struct, not canonically packable)
 static int packed_unit(const DtView &v) {
-  return v.derived ? v.derived->swap_unit : (int)v.di.item;
+  return packed_unit_of(v.derived, v.di.item);
 }
 
 int MPI_Pack_external(const char datarep[], const void *inbuf,
@@ -10296,6 +10352,360 @@ int MPI_Rget_accumulate(const void *origin_addr, int origin_count,
                               target_datatype, op, win);
   if (rc != MPI_SUCCESS) return rc;
   *request = make_completed_req(MPI_COMM_WORLD);
+  return MPI_SUCCESS;
+}
+
+// ---------------------------- ports / join / naming (round 5)
+// open_port.c / comm_accept.c / comm_connect.c / publish_name.c /
+// comm_join.c: client/server connection establishment within one
+// universe.  A port is a live listening socket named "host:tcpport";
+// accept/connect roots exchange group lists + a seed over it and both
+// sides derive the intercommunicator cids from the same hash — the
+// deterministic-cid collapse again.  Publish/lookup speak the
+// launcher's name-server protocol (tools/mpirun.py hosts it,
+// ZMPI_NAMESERVER advertises it — the ompi-server analog).
+
+static std::map<std::string, int> g_ports;  // port name -> listen fd
+
+int MPI_Open_port(MPI_Info, char *port_name) {
+  int fd = socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return MPI_ERR_OTHER;
+  set_cloexec(fd);
+  int one = 1;
+  setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof one);
+  sockaddr_in a{};
+  a.sin_family = AF_INET;
+  a.sin_port = 0;
+  inet_pton(AF_INET, g.host.c_str(), &a.sin_addr);
+  if (bind(fd, (sockaddr *)&a, sizeof a) != 0 || listen(fd, 8) != 0) {
+    close(fd);
+    return MPI_ERR_OTHER;
+  }
+  socklen_t alen = sizeof a;
+  getsockname(fd, (sockaddr *)&a, &alen);
+  snprintf(port_name, MPI_MAX_PORT_NAME, "%s:%d", g.host.c_str(),
+           (int)ntohs(a.sin_port));
+  g_ports[port_name] = fd;
+  return MPI_SUCCESS;
+}
+
+int MPI_Close_port(const char *port_name) {
+  auto it = g_ports.find(port_name ? port_name : "");
+  if (it == g_ports.end()) return MPI_ERR_ARG;
+  close(it->second);
+  g_ports.erase(it);
+  return MPI_SUCCESS;
+}
+
+namespace {
+
+// serialize my comm's world-rank group + a seed into one DSS frame
+std::string pack_group_frame(const CommObj &c, int64_t seed) {
+  std::string f;
+  put_varint(f, 2);
+  put_int(f, seed);
+  f.push_back((char)T_LIST);
+  put_varint(f, c.group.size());
+  for (int r : c.group) put_int(f, (int64_t)r);
+  return f;
+}
+
+bool parse_group_frame(const std::string &f, int64_t &seed,
+                       std::vector<int> &group) {
+  std::vector<DssVal> vals;
+  if (!parse_all(f, vals) || vals.size() != 2 ||
+      vals[1].tag != T_LIST)
+    return false;
+  seed = vals[0].i;
+  group.clear();
+  for (auto &e : vals[1].items) group.push_back((int)e.i);
+  return true;
+}
+
+// both sides build the identical intercomm from (mine, theirs, seed)
+int build_port_intercomm(CommObj *c, const std::vector<int> &remote,
+                         int64_t seed, MPI_Comm *newcomm) {
+  CommObj inter;
+  inter.group = c->group;
+  inter.local_rank = c->local_rank;
+  inter.remote = remote;
+  intercomm_cids(c->group, remote, (int)(seed & 0x7FFFFFFF), inter);
+  int handle = g_next_comm++;
+  g_comms[handle] = inter;
+  *newcomm = handle;
+  return MPI_SUCCESS;
+}
+
+// distribute (seed, remote group) from the root and build — the tail
+// both accept and connect share
+int port_epilogue(CommObj *c, int root, int64_t hdr0_seed,
+                  std::vector<int> &remote, MPI_Comm comm,
+                  MPI_Comm *newcomm) {
+  long hdr[2] = {(long)hdr0_seed, (long)remote.size()};
+  int rc = c_bcast(*c, hdr, 2, MPI_LONG, root, 0x7E19);
+  if (rc != MPI_SUCCESS) return rc;
+  if (hdr[0] < 0) return MPI_ERR_OTHER;  // root failure, agreed
+  remote.resize((size_t)hdr[1]);
+  if (hdr[1] > 0) {
+    rc = c_bcast(*c, remote.data(), (int)hdr[1], MPI_INT, root, 0x7E1A);
+    if (rc != MPI_SUCCESS) return rc;
+  }
+  (void)comm;
+  return build_port_intercomm(c, remote, hdr[0], newcomm);
+}
+
+}  // namespace
+
+int MPI_Comm_accept(const char *port_name, MPI_Info, int root,
+                    MPI_Comm comm, MPI_Comm *newcomm) {
+  CommObj *c = lookup_comm(comm);
+  if (!c || !c->remote.empty()) return MPI_ERR_COMM;
+  if (root < 0 || root >= (int)c->group.size()) return MPI_ERR_ARG;
+  int64_t seed = -1;
+  std::vector<int> remote;
+  if (c->local_rank == root) {
+    auto it = g_ports.find(port_name ? port_name : "");
+    if (it != g_ports.end()) {
+      int conn = accept(it->second, nullptr, nullptr);
+      if (conn >= 0) {
+        // the accept side mints the seed (its own counter guarantees
+        // distinct cids across repeated accepts on one port)
+        static std::atomic<int64_t> accept_seq{1};
+        int64_t my_seed =
+            (int64_t)(mix64((uint64_t)accept_seq.fetch_add(1) ^
+                            ((uint64_t)g.rank << 32)) &
+                      0x7FFFFFFF);
+        std::string f;
+        if (recv_frame(conn, f)) {
+          int64_t ignored;
+          if (parse_group_frame(f, ignored, remote) &&
+              send_frame(conn, pack_group_frame(*c, my_seed)))
+            seed = my_seed;
+        }
+        close(conn);
+      }
+    }
+  }
+  return port_epilogue(c, root, seed, remote, comm, newcomm);
+}
+
+int MPI_Comm_connect(const char *port_name, MPI_Info, int root,
+                     MPI_Comm comm, MPI_Comm *newcomm) {
+  CommObj *c = lookup_comm(comm);
+  if (!c || !c->remote.empty()) return MPI_ERR_COMM;
+  if (root < 0 || root >= (int)c->group.size()) return MPI_ERR_ARG;
+  int64_t seed = -1;
+  std::vector<int> remote;
+  if (c->local_rank == root && port_name) {
+    std::string pn = port_name;
+    size_t colon = pn.rfind(':');
+    if (colon != std::string::npos) {
+      int conn = tcp_connect(pn.substr(0, colon),
+                             atoi(pn.c_str() + colon + 1));
+      if (conn >= 0) {
+        // connector sends first, seed comes back from the acceptor
+        if (send_frame(conn, pack_group_frame(*c, 0))) {
+          std::string f;
+          int64_t their_seed;
+          if (recv_frame(conn, f) &&
+              parse_group_frame(f, their_seed, remote))
+            seed = their_seed;
+        }
+        close(conn);
+      }
+    }
+  }
+  return port_epilogue(c, root, seed, remote, comm, newcomm);
+}
+
+int MPI_Comm_disconnect(MPI_Comm *comm) {
+  // comm_disconnect.c: collective; waits for pending comm traffic.
+  // The engine completes sends at the API boundary, so the barrier IS
+  // the quiescence point; then the handle dies like Comm_free.
+  if (!comm || *comm == MPI_COMM_WORLD || *comm == MPI_COMM_SELF)
+    return MPI_ERR_COMM;  // the Comm_free guard, same mistake class
+  CommObj *c = lookup_comm(*comm);
+  if (!c) return MPI_ERR_COMM;
+  if (c->remote.empty()) c_barrier(*c);  // intracomm quiesce
+  delete_comm_attrs(*comm);
+  release_errh_ref(g_comm_errh, *comm);
+  g_comms.erase(*comm);
+  *comm = MPI_COMM_NULL;
+  return MPI_SUCCESS;
+}
+
+int MPI_Comm_join(int fd, MPI_Comm *intercomm) {
+  // comm_join.c scoped to one universe: the two processes exchange
+  // (world rank, local seed) over the caller's socket; the shared
+  // seed is the SUM so both sides compute it identically
+  static std::atomic<int64_t> join_seq{1};
+  int64_t my_seed = join_seq.fetch_add(1) + g.rank * 1000003LL;
+  std::string out;
+  put_varint(out, 2);
+  put_int(out, (int64_t)g.rank);
+  put_int(out, my_seed);
+  if (!send_frame(fd, out)) return MPI_ERR_OTHER;
+  std::string in;
+  if (!recv_frame(fd, in)) return MPI_ERR_OTHER;
+  std::vector<DssVal> vals;
+  if (!parse_all(in, vals) || vals.size() != 2) return MPI_ERR_OTHER;
+  int peer = (int)vals[0].i;
+  int64_t seed = my_seed + vals[1].i;
+  if (peer < 0 || peer >= (int)g.book.size() || peer == g.rank)
+    return MPI_ERR_ARG;
+  CommObj inter;
+  inter.group = {g.rank};
+  inter.local_rank = 0;
+  inter.remote = {peer};
+  intercomm_cids(inter.group, inter.remote,
+                 (int)(seed & 0x7FFFFFFF), inter);
+  int handle = g_next_comm++;
+  g_comms[handle] = inter;
+  *intercomm = handle;
+  return MPI_SUCCESS;
+}
+
+namespace {
+
+// one round-trip with the launcher-hosted name server; the request is
+// ONE list value, the reply ONE value (mpirun.py's protocol)
+int nameserver_rpc(const std::vector<std::string> &req, DssVal &reply) {
+  const char *ns = getenv("ZMPI_NAMESERVER");
+  if (!ns || !*ns) return MPI_ERR_OTHER;  // no ompi-server analog
+  std::string addr = ns;
+  size_t colon = addr.rfind(':');
+  if (colon == std::string::npos) return MPI_ERR_OTHER;
+  int fd = tcp_connect(addr.substr(0, colon),
+                       atoi(addr.c_str() + colon + 1));
+  if (fd < 0) return MPI_ERR_OTHER;
+  std::string f;
+  put_varint(f, 1);
+  f.push_back((char)T_LIST);
+  put_varint(f, req.size());
+  for (auto &s2 : req) put_str(f, s2);
+  std::string in;
+  bool ok = send_frame(fd, f) && recv_frame(fd, in);
+  close(fd);
+  if (!ok) return MPI_ERR_OTHER;
+  std::vector<DssVal> vals;
+  if (!parse_all(in, vals) || vals.size() != 1) return MPI_ERR_OTHER;
+  reply = vals[0];
+  return MPI_SUCCESS;
+}
+
+}  // namespace
+
+int MPI_Publish_name(const char *service_name, MPI_Info,
+                     const char *port_name) {
+  if (!service_name || !port_name) return MPI_ERR_ARG;
+  DssVal reply;
+  return nameserver_rpc({"pub", service_name, port_name}, reply);
+}
+
+int MPI_Lookup_name(const char *service_name, MPI_Info,
+                    char *port_name) {
+  if (!service_name || !port_name) return MPI_ERR_ARG;
+  DssVal reply;
+  int rc = nameserver_rpc({"look", service_name}, reply);
+  if (rc != MPI_SUCCESS) return rc;
+  if (reply.tag != T_STR) return MPI_ERR_ARG;  // unpublished service
+  snprintf(port_name, MPI_MAX_PORT_NAME, "%s", reply.s.c_str());
+  return MPI_SUCCESS;
+}
+
+int MPI_Unpublish_name(const char *service_name, MPI_Info,
+                       const char *port_name) {
+  (void)port_name;
+  if (!service_name) return MPI_ERR_ARG;
+  DssVal reply;
+  int rc = nameserver_rpc({"unpub", service_name}, reply);
+  if (rc != MPI_SUCCESS) return rc;
+  return reply.tag == T_BOOL && reply.i ? MPI_SUCCESS : MPI_ERR_ARG;
+}
+
+// general distributed graph (dist_graph_create.c): edges may describe
+// ANY node, so one allgatherv round routes every (src, dst, weight)
+// triple to everyone; each rank then filters its in/out lists in
+// contributor order
+int MPI_Dist_graph_create(MPI_Comm comm, int n, const int sources[],
+                          const int degrees[], const int destinations[],
+                          const int weights[], MPI_Info /*info*/,
+                          int /*reorder*/, MPI_Comm *newcomm) {
+  CommObj *c = lookup_comm(comm);
+  if (!c || !c->remote.empty()) return MPI_ERR_COMM;
+  if (n < 0) return MPI_ERR_ARG;
+  int csize = (int)c->group.size();
+  bool weighted = weights != MPI_UNWEIGHTED;
+  std::vector<int64_t> mine;
+  {
+    int at = 0;
+    for (int i = 0; i < n; i++) {
+      if (sources[i] < 0 || sources[i] >= csize || degrees[i] < 0)
+        return MPI_ERR_ARG;
+      for (int e = 0; e < degrees[i]; e++, at++) {
+        if (destinations[at] < 0 || destinations[at] >= csize)
+          return MPI_ERR_ARG;
+        mine.push_back(sources[i]);
+        mine.push_back(destinations[at]);
+        mine.push_back(
+            weighted && weights != MPI_WEIGHTS_EMPTY ? weights[at] : 1);
+      }
+    }
+  }
+  int my_n = (int)mine.size();
+  std::vector<int> counts((size_t)csize), displs((size_t)csize);
+  int rc = c_allgather(*c, &my_n, 1, MPI_INT, counts.data(), 1, MPI_INT);
+  if (rc != MPI_SUCCESS) return rc;
+  int total = 0;
+  for (int r = 0; r < csize; r++) {
+    displs[(size_t)r] = total;
+    total += counts[(size_t)r];
+  }
+  std::vector<int64_t> all((size_t)total);
+  rc = c_allgatherv(*c, mine.data(), my_n, MPI_LONG, all.data(),
+                    counts.data(), displs.data(), MPI_LONG);
+  if (rc != MPI_SUCCESS) return rc;
+  int me = c->local_rank;
+  std::vector<int> in_src, in_w, out_dst, out_w;
+  for (int t = 0; t + 2 < total; t += 3) {
+    int src = (int)all[(size_t)t], dst = (int)all[(size_t)t + 1];
+    int w = (int)all[(size_t)t + 2];
+    if (dst == me) {
+      in_src.push_back(src);
+      in_w.push_back(w);
+    }
+    if (src == me) {
+      out_dst.push_back(dst);
+      out_w.push_back(w);
+    }
+  }
+  rc = MPI_Comm_split(comm, 0, me, newcomm);
+  if (rc != MPI_SUCCESS) return rc;
+  CommObj *nc = lookup_comm(*newcomm);
+  nc->dist = true;
+  nc->dist_src = std::move(in_src);
+  nc->dist_dst = std::move(out_dst);
+  nc->dist_weighted = weighted;
+  if (weighted) {
+    nc->dist_srcw = std::move(in_w);
+    nc->dist_dstw = std::move(out_w);
+  }
+  return MPI_SUCCESS;
+}
+
+// predefined attribute functions (attr_fn.c): the do-nothing copy and
+// delete callbacks plus the always-copy DUP_FN
+int MPI_NULL_COPY_FN(MPI_Comm, int, void *, void *, void *, int *flag) {
+  *flag = 0;
+  return MPI_SUCCESS;
+}
+int MPI_NULL_DELETE_FN(MPI_Comm, int, void *, void *) {
+  return MPI_SUCCESS;
+}
+int MPI_DUP_FN(MPI_Comm, int, void *, void *attribute_val_in,
+               void *attribute_val_out, int *flag) {
+  *(void **)attribute_val_out = attribute_val_in;
+  *flag = 1;
   return MPI_SUCCESS;
 }
 

@@ -253,7 +253,34 @@ int MPI_Comm_test_inter(MPI_Comm comm, int *flag);
 int MPI_Comm_spawn(const char *command, char *argv[], int maxprocs,
                    MPI_Info info, int root, MPI_Comm comm,
                    MPI_Comm *intercomm, int errcodes[]);
+int MPI_Comm_spawn_multiple(int count, char *commands[], char **argvs[],
+                            const int maxprocs[], const MPI_Info infos[],
+                            int root, MPI_Comm comm, MPI_Comm *intercomm,
+                            int errcodes[]);
 int MPI_Comm_get_parent(MPI_Comm *parent);
+#define MPI_ARGV_NULL  ((char **)0)
+#define MPI_ARGVS_NULL ((char ***)0)
+#define MPI_ERRCODES_IGNORE ((int *)0)
+
+/* client/server connection establishment (open_port.c, comm_accept.c,
+ * comm_connect.c, comm_join.c families) and the name service
+ * (publish_name.c — needs the launcher's name server, the ompi-server
+ * analog advertised via ZMPI_NAMESERVER) */
+#define MPI_MAX_PORT_NAME 256
+int MPI_Open_port(MPI_Info info, char *port_name);
+int MPI_Close_port(const char *port_name);
+int MPI_Comm_accept(const char *port_name, MPI_Info info, int root,
+                    MPI_Comm comm, MPI_Comm *newcomm);
+int MPI_Comm_connect(const char *port_name, MPI_Info info, int root,
+                     MPI_Comm comm, MPI_Comm *newcomm);
+int MPI_Comm_disconnect(MPI_Comm *comm);
+int MPI_Comm_join(int fd, MPI_Comm *intercomm);
+int MPI_Publish_name(const char *service_name, MPI_Info info,
+                     const char *port_name);
+int MPI_Lookup_name(const char *service_name, MPI_Info info,
+                    char *port_name);
+int MPI_Unpublish_name(const char *service_name, MPI_Info info,
+                       const char *port_name);
 
 /* blocking point-to-point */
 int MPI_Send(const void *buf, int count, MPI_Datatype dt, int dest,
@@ -770,9 +797,21 @@ int MPI_Type_extent(MPI_Datatype dt, MPI_Aint *extent);
 int MPI_Type_lb(MPI_Datatype dt, MPI_Aint *lb);
 int MPI_Type_ub(MPI_Datatype dt, MPI_Aint *ub);
 
-/* legacy MPI-1 attribute names (attr_put.c, keyval_create.c) */
+/* legacy MPI-1 attribute names (attr_put.c, keyval_create.c) and the
+ * predefined do-nothing callbacks (attr_fn.c) */
 typedef MPI_Comm_copy_attr_function MPI_Copy_function;
 typedef MPI_Comm_delete_attr_function MPI_Delete_function;
+int MPI_NULL_COPY_FN(MPI_Comm comm, int keyval, void *extra_state,
+                     void *attribute_val_in, void *attribute_val_out,
+                     int *flag);
+int MPI_NULL_DELETE_FN(MPI_Comm comm, int keyval, void *attribute_val,
+                       void *extra_state);
+int MPI_DUP_FN(MPI_Comm comm, int keyval, void *extra_state,
+               void *attribute_val_in, void *attribute_val_out,
+               int *flag);
+#define MPI_COMM_NULL_COPY_FN   MPI_NULL_COPY_FN
+#define MPI_COMM_NULL_DELETE_FN MPI_NULL_DELETE_FN
+#define MPI_COMM_DUP_FN         MPI_DUP_FN
 int MPI_Keyval_create(MPI_Copy_function *copy_fn,
                       MPI_Delete_function *delete_fn, int *keyval,
                       void *extra_state);
@@ -972,6 +1011,10 @@ int MPI_Graph_neighbors_count(MPI_Comm comm, int rank, int *nneighbors);
 int MPI_Graph_neighbors(MPI_Comm comm, int rank, int maxneighbors,
                         int neighbors[]);
 int MPI_Topo_test(MPI_Comm comm, int *status);
+int MPI_Dist_graph_create(MPI_Comm comm, int n, const int sources[],
+                          const int degrees[], const int destinations[],
+                          const int weights[], MPI_Info info,
+                          int reorder, MPI_Comm *newcomm);
 int MPI_Dist_graph_create_adjacent(
     MPI_Comm comm, int indegree, const int sources[],
     const int sourceweights[], int outdegree, const int destinations[],
