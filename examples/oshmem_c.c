@@ -108,6 +108,67 @@ int main(void) {
 
   shmem_free(gathered);
   shmem_free(ring);
+
+  /* ---- round-5 completion tier ---- */
+  /* align: symmetric OFFSET alignment (and absolute, page-aligned
+   * heap), usable as a put target */
+  long *av = shmem_align(256, sizeof(long));
+  if (!av || ((unsigned long)av & 255)) return 13;
+  *av = -5;
+  shmem_barrier_all();
+  long stamp = 4000 + me;
+  shmem_putmem(av, &stamp, sizeof stamp, (me + 1) % n);
+  shmem_barrier_all();
+  if (*av != 4000 + (me + n - 1) % n) return 14;
+  /* realloc preserves contents and stays symmetric */
+  av = shmem_realloc(av, 4 * sizeof(long));
+  if (!av || *av != 4000 + (me + n - 1) % n) return 15;
+  shmem_free(av);
+
+  /* accessibility + ptr */
+  if (!shmem_pe_accessible(0) || shmem_pe_accessible(n + 5)) return 16;
+  long *probe = shmem_malloc(sizeof(long));
+  if (!shmem_addr_accessible(probe, (me + 1) % n)) return 17;
+  if (shmem_ptr(probe, me) != probe) return 18;
+  if (n > 1 && shmem_ptr(probe, (me + 1) % n) != NULL) return 19;
+
+  /* strided iput into the right neighbor */
+  long *grid = shmem_malloc(8 * sizeof(long));
+  for (int i = 0; i < 8; i++) grid[i] = -1;
+  long stv[2] = {me * 100, me * 100 + 1};
+  shmem_barrier_all();
+  shmem_long_iput(grid, stv, 3, 1, 2, (me + 1) % n); /* slots 0,3 */
+  shmem_barrier_all();
+  int lpe = (me + n - 1) % n;
+  if (grid[0] != lpe * 100 || grid[3] != lpe * 100 + 1) return 20;
+  if (grid[1] != -1 || grid[2] != -1) return 21;
+  long back[2] = {-9, -9};
+  shmem_long_iget(back, grid, 1, 3, 2, me); /* read 0,3 back */
+  if (back[0] != lpe * 100 || back[1] != lpe * 100 + 1) return 22;
+  shmem_free(grid);
+
+  /* alltoall + collect */
+  long *a2src = shmem_malloc(n * sizeof(long));
+  long *a2dst = shmem_malloc(n * sizeof(long));
+  for (int p = 0; p < n; p++) a2src[p] = me * 1000 + p;
+  shmem_barrier_all();
+  shmem_alltoallmem(a2dst, a2src, sizeof(long));
+  for (int p = 0; p < n; p++)
+    if (a2dst[p] != p * 1000 + me) return 23;
+  shmem_free(a2src);
+  shmem_free(a2dst);
+  shmem_sync_all();
+
+  int maj = -1, min = -1;
+  shmem_info_get_version(&maj, &min);
+  if (maj != 1 || min != 4) return 24;
+  char libname[SHMEM_MAX_NAME_LEN];
+  shmem_info_get_name(libname);
+  if (!libname[0]) return 25;
+  shmem_udcflush(); /* deprecated cache ops: link + no-op */
+  if (_my_pe() != me || _num_pes() != n) return 26;
+  shmem_free(probe);
+
   shmem_barrier_all();
   printf("oshmem_c PE %d/%d OK\n", me, n);
   shmem_finalize();

@@ -52,6 +52,8 @@ struct ShmemState {
   // implicit-handle nonblocking gets completing at shmem_quiet
   std::vector<int> pending_gets;
   std::mutex nbi_mu;
+  // shmem_align over-allocation: aligned pointer -> real block start
+  std::map<void *, void *> aligned_blocks;
 };
 
 ShmemState s;
@@ -80,8 +82,13 @@ int shmem_init(void) {
   }
   const char *hb = getenv("ZMPI_SHMEM_HEAP");
   s.heap_bytes = hb && hb[0] ? (size_t)atoll(hb) : (size_t)1 << 20;
-  s.heap = (char *)calloc(1, s.heap_bytes);
+  // page-aligned base: shmem_align aligns OFFSETS (the symmetric
+  // contract), so an aligned base makes the absolute address aligned
+  // too for every alignment up to the page size
+  size_t rounded = (s.heap_bytes + 4095) & ~(size_t)4095;
+  s.heap = (char *)aligned_alloc(4096, rounded);
   if (!s.heap) return -1;
+  memset(s.heap, 0, rounded);
   if (MPI_Win_create(s.heap, (MPI_Aint)s.heap_bytes, 1, MPI_INFO_NULL,
                      MPI_COMM_WORLD, &s.win) != MPI_SUCCESS)
     return -1;
@@ -99,6 +106,16 @@ void shmem_finalize(void) {
   MPI_Win_free(&s.win);
   free(s.heap);
   s.heap = nullptr;
+  // a re-init must not inherit this epoch's bookkeeping: a recycled
+  // heap address could alias a stale aligned_blocks key and redirect
+  // a future free to the wrong offset
+  s.free_list.clear();
+  s.allocated.clear();
+  s.aligned_blocks.clear();
+  {
+    std::lock_guard<std::mutex> lk(s.nbi_mu);
+    s.pending_gets.clear();
+  }
   s.up = false;
   if (s.owns_mpi) MPI_Finalize();
 }
@@ -149,6 +166,15 @@ void *shmem_calloc(size_t count, size_t size) {
 
 void shmem_free(void *ptr) {
   if (!s.up || !ptr) return;
+  {
+    // an aligned pointer resolves back to its over-allocated block
+    std::lock_guard<std::mutex> lk(s.alloc_mu);
+    auto ab = s.aligned_blocks.find(ptr);
+    if (ab != s.aligned_blocks.end()) {
+      ptr = ab->second;
+      s.aligned_blocks.erase(ab);
+    }
+  }
   // spec: barrier at ENTRY — pending remote accesses to the region
   // must complete before its bytes can be reused
   MPI_Barrier(MPI_COMM_WORLD);
@@ -451,6 +477,191 @@ int shmem_test_lock(long *lock) {
   int me = shmem_my_pe();
   long old = shmem_long_atomic_compare_swap(lock, 0, (long)me + 1, 0);
   return old == 0 ? 0 : 1;  /* 0 = acquired, OpenSHMEM contract */
+}
+
+}  // extern "C"
+
+/* ------------------------- round-5 completion tier ------------------
+ * shmem_align.c, shmem_realloc.c, shmem_ptr.c, shmem_pe_accessible.c,
+ * shmem_iput.c/iget.c, shmem_alltoall.c, shmem_collect.c,
+ * shmem_sync.c, shmem_global_exit.c, shmem_info.c, the deprecated
+ * cache ops, and the legacy start_pes-era names. */
+
+extern "C" {
+
+void *shmem_align(size_t alignment, size_t size) {
+  // the symmetric contract aligns the OFFSET (identical on every PE);
+  // the page-aligned heap base then makes the local address aligned
+  // for any power-of-two alignment up to the page size
+  if (!s.up || size == 0 || alignment == 0 ||
+      (alignment & (alignment - 1)))
+    return nullptr;
+  // the heap base is 4096-aligned; offsets aligned beyond that would
+  // NOT be absolutely aligned — refuse rather than silently misalign
+  if (alignment > 4096) return nullptr;
+  if (size > (size_t)-1 - alignment) return nullptr;  // size+alignment
+  if (alignment <= ALIGN) return shmem_malloc(size);
+  // over-allocate, then publish the aligned offset; free() resolves
+  // the aligned pointer back to the block through the side map
+  char *base = (char *)shmem_malloc(size + alignment);
+  if (!base) return nullptr;
+  size_t off = (size_t)(base - s.heap);
+  size_t aligned_off = (off + alignment - 1) & ~(alignment - 1);
+  char *out = s.heap + aligned_off;
+  if (out != base) {
+    std::lock_guard<std::mutex> lk(s.alloc_mu);
+    s.aligned_blocks[out] = base;
+  }
+  return out;
+}
+
+void *shmem_realloc(void *ptr, size_t size) {
+  // shmem_realloc.c: collective like malloc/free; contents move
+  if (!s.up) return nullptr;
+  if (!ptr) return shmem_malloc(size);
+  if (size == 0) {
+    shmem_free(ptr);
+    return nullptr;
+  }
+  size_t old_sz = 0;
+  {
+    std::lock_guard<std::mutex> lk(s.alloc_mu);
+    void *blk = ptr;  // an aligned pointer's block starts earlier
+    auto ab = s.aligned_blocks.find(ptr);
+    if (ab != s.aligned_blocks.end()) blk = ab->second;
+    long long d = disp_of(blk);
+    if (d >= 0) {
+      auto a = s.allocated.find((size_t)d);
+      if (a != s.allocated.end())
+        old_sz = a->second - (size_t)((char *)ptr - (char *)blk);
+    }
+  }
+  // shrink (or refit) in place: the block already covers the request,
+  // the symmetric offset stays valid on every PE, and no collective
+  // round is needed (every PE takes this same deterministic branch)
+  if (size <= old_sz) return ptr;
+  void *fresh = shmem_malloc(size);
+  if (!fresh) return nullptr;
+  memcpy(fresh, ptr, old_sz < size ? old_sz : size);
+  shmem_free(ptr);
+  return fresh;
+}
+
+void *shmem_ptr(const void *dest, int pe) {
+  // only the local PE's heap is load/store addressable on this
+  // transport (shmem_ptr.c returns NULL exactly then)
+  if (!s.up || pe != shmem_my_pe()) return nullptr;
+  const char *p = (const char *)dest;
+  if (p < s.heap || p >= s.heap + s.heap_bytes) return nullptr;
+  return (void *)p;
+}
+
+int shmem_pe_accessible(int pe) {
+  return s.up && pe >= 0 && pe < shmem_n_pes() ? 1 : 0;
+}
+
+int shmem_addr_accessible(const void *addr, int pe) {
+  if (!shmem_pe_accessible(pe)) return 0;
+  const char *p = (const char *)addr;
+  return p >= s.heap && p < s.heap + s.heap_bytes ? 1 : 0;
+}
+
+/* strided RMA: element loops over the contiguous engine (the
+ * reference's iput is the same loop at the SPML layer) */
+#define ZOMPI_IPUT(T, NAME, PUT)                                       \
+  void NAME(T *dest, const T *source, ptrdiff_t dst, ptrdiff_t sst,    \
+            size_t nelems, int pe) {                                   \
+    if (dst == 1 && sst == 1) { /* contiguous: one engine op */        \
+      PUT(dest, source, nelems, pe);                                   \
+      return;                                                          \
+    }                                                                  \
+    for (size_t i = 0; i < nelems; i++)                                \
+      PUT(dest + (ptrdiff_t)i * dst, source + (ptrdiff_t)i * sst, 1,  \
+          pe);                                                         \
+  }
+ZOMPI_IPUT(long, shmem_long_iput, shmem_long_put)
+ZOMPI_IPUT(long, shmem_long_iget, shmem_long_get)
+ZOMPI_IPUT(double, shmem_double_iput, shmem_double_put)
+ZOMPI_IPUT(double, shmem_double_iget, shmem_double_get)
+#undef ZOMPI_IPUT
+
+void shmem_alltoallmem(void *dest, const void *source, size_t nbytes) {
+  // the engine's collective counts are int (and frames bound at 4 GiB)
+  if (nbytes > (size_t)1 << 30) {
+    fprintf(stderr,
+            "zompi_shmem: alltoall block of %zu bytes exceeds the "
+            "1 GiB per-PE bound\n", nbytes);
+    shmem_global_exit(1);
+  }
+  MPI_Alltoall(source, (int)nbytes, MPI_BYTE, dest, (int)nbytes,
+               MPI_BYTE, MPI_COMM_WORLD);
+}
+
+void shmem_collectmem(void *dest, const void *source, size_t nbytes) {
+  // varying contributions, concatenated in PE order (shmem_collect.c)
+  if (nbytes > (size_t)1 << 30) {
+    fprintf(stderr,
+            "zompi_shmem: collect block of %zu bytes exceeds the "
+            "1 GiB per-PE bound\n", nbytes);
+    shmem_global_exit(1);
+  }
+  int n = shmem_n_pes();
+  std::vector<int> counts((size_t)n), displs((size_t)n);
+  int mine = (int)nbytes;
+  MPI_Allgather(&mine, 1, MPI_INT, counts.data(), 1, MPI_INT,
+                MPI_COMM_WORLD);
+  long long total = 0;
+  for (int r = 0; r < n; r++) {
+    if (total > (long long)INT32_MAX - counts[(size_t)r]) {
+      fprintf(stderr, "zompi_shmem: collect total exceeds 2 GiB\n");
+      shmem_global_exit(1);
+    }
+    displs[(size_t)r] = (int)total;
+    total += counts[(size_t)r];
+  }
+  MPI_Allgatherv(source, mine, MPI_BYTE, dest, counts.data(),
+                 displs.data(), MPI_BYTE, MPI_COMM_WORLD);
+}
+
+void shmem_sync_all(void) {
+  // sync WITHOUT the implicit quiet (shmem_sync.c): pure arrival
+  // synchronization — puts need not be remotely complete
+  MPI_Barrier(MPI_COMM_WORLD);
+}
+
+void shmem_global_exit(int status) {
+  MPI_Abort(MPI_COMM_WORLD, status);
+}
+
+void shmem_info_get_version(int *major, int *minor) {
+  *major = SHMEM_MAJOR_VERSION;
+  *minor = SHMEM_MINOR_VERSION;
+}
+
+void shmem_info_get_name(char *name) {
+  snprintf(name, SHMEM_MAX_NAME_LEN, "zhpe-ompi-tpu OpenSHMEM");
+}
+
+/* deprecated cache ops: the host is cache-coherent; kept for link
+ * compatibility with start_pes-era codes */
+void shmem_set_cache_inv(void) {}
+void shmem_clear_cache_inv(void) {}
+void shmem_set_cache_line_inv(void *) {}
+void shmem_clear_cache_line_inv(void *) {}
+void shmem_udcflush(void) {}
+void shmem_udcflush_line(void *) {}
+
+/* legacy names */
+void start_pes(int) { (void)shmem_init(); }
+int _my_pe(void) { return shmem_my_pe(); }
+int _num_pes(void) { return shmem_n_pes(); }
+
+void shmem_long_wait(long *ivar, long value) {
+  shmem_long_wait_until(ivar, SHMEM_CMP_NE, value);
+}
+
+long shmem_swap(long *target, long value, int pe) {
+  return shmem_long_atomic_swap(target, value, pe);
 }
 
 }  // extern "C"

@@ -104,6 +104,51 @@ void shmem_set_lock(long *lock);
 void shmem_clear_lock(long *lock);
 int shmem_test_lock(long *lock);
 
+/* round-5 completion tier: the rest of the reference's binding
+ * families (shmem_align.c, shmem_realloc.c, shmem_ptr.c,
+ * shmem_pe_accessible.c, shmem_iput.c/iget.c, shmem_alltoall.c,
+ * shmem_collect.c, shmem_sync.c, shmem_global_exit.c, shmem_info.c,
+ * the deprecated cache ops, and the legacy start_pes-era names). */
+void *shmem_align(size_t alignment, size_t size);
+void *shmem_realloc(void *ptr, size_t size);
+/* load/store access: only the local PE's heap is addressable here */
+void *shmem_ptr(const void *dest, int pe);
+int shmem_pe_accessible(int pe);
+int shmem_addr_accessible(const void *addr, int pe);
+/* strided RMA (element strides, shmem_iput.c semantics) */
+void shmem_long_iput(long *dest, const long *source, ptrdiff_t dst,
+                     ptrdiff_t sst, size_t nelems, int pe);
+void shmem_long_iget(long *dest, const long *source, ptrdiff_t dst,
+                     ptrdiff_t sst, size_t nelems, int pe);
+void shmem_double_iput(double *dest, const double *source, ptrdiff_t dst,
+                       ptrdiff_t sst, size_t nelems, int pe);
+void shmem_double_iget(double *dest, const double *source, ptrdiff_t dst,
+                       ptrdiff_t sst, size_t nelems, int pe);
+/* collectives over all PEs (house 1.4 style: no pSync/pWrk) */
+void shmem_alltoallmem(void *dest, const void *source, size_t nbytes);
+void shmem_collectmem(void *dest, const void *source, size_t nbytes);
+void shmem_sync_all(void);
+void shmem_global_exit(int status);
+#define SHMEM_MAX_NAME_LEN 64
+#define SHMEM_MAJOR_VERSION 1
+#define SHMEM_MINOR_VERSION 4
+void shmem_info_get_version(int *major, int *minor);
+void shmem_info_get_name(char *name);
+/* deprecated cache ops (shmem_set_cache_inv.c family): no-ops on a
+ * coherent host, kept so legacy codes link */
+void shmem_set_cache_inv(void);
+void shmem_clear_cache_inv(void);
+void shmem_set_cache_line_inv(void *dest);
+void shmem_clear_cache_line_inv(void *dest);
+void shmem_udcflush(void);
+void shmem_udcflush_line(void *dest);
+/* legacy start_pes-era names */
+void start_pes(int npes);
+int _my_pe(void);
+int _num_pes(void);
+void shmem_long_wait(long *ivar, long value);
+long shmem_swap(long *target, long value, int pe);
+
 #ifdef __cplusplus
 }
 #endif
