@@ -296,6 +296,214 @@ int main(int argc, char **argv) {
         assert p.returncode == 0, f"parent failed: {err}\n{out}"
         assert "spawn_multiple OK" in out
 
+    def test_pmpi_interposition(self, shim, tmp_path):
+        """The PMPI profiling contract (send.c:37-39's weak-symbol
+        pattern): an application-defined strong MPI_Send/MPI_Recv
+        wrapper overrides the shim's weak symbol, counts the call, and
+        reaches the real engine through PMPI_*; payloads still
+        deliver."""
+        src = tmp_path / "pmpi.c"
+        src.write_text(r'''
+#include <stdio.h>
+#include "zompi_mpi.h"
+#include "zompi_pmpi.h"
+
+static int sends = 0, recvs = 0;
+
+/* strong definitions override the shim's weak MPI_X */
+int MPI_Send(const void *buf, int count, MPI_Datatype dt, int dest,
+             int tag, MPI_Comm comm) {
+  sends++;
+  return PMPI_Send(buf, count, dt, dest, tag, comm);
+}
+int MPI_Recv(void *buf, int count, MPI_Datatype dt, int src, int tag,
+             MPI_Comm comm, MPI_Status *st) {
+  recvs++;
+  return PMPI_Recv(buf, count, dt, src, tag, comm, st);
+}
+
+int main(int argc, char **argv) {
+  MPI_Init(&argc, &argv);
+  int rank, size;
+  MPI_Comm_rank(MPI_COMM_WORLD, &rank);
+  MPI_Comm_size(MPI_COMM_WORLD, &size);
+  int v = rank * 3 + 7, got = -1;
+  int peer = 1 - rank;
+  if (rank == 0) {
+    if (MPI_Send(&v, 1, MPI_INT, peer, 1, MPI_COMM_WORLD)
+        != MPI_SUCCESS) return 2;
+    if (MPI_Recv(&got, 1, MPI_INT, peer, 2, MPI_COMM_WORLD,
+                 MPI_STATUS_IGNORE) != MPI_SUCCESS) return 3;
+  } else {
+    if (MPI_Recv(&got, 1, MPI_INT, peer, 1, MPI_COMM_WORLD,
+                 MPI_STATUS_IGNORE) != MPI_SUCCESS) return 3;
+    if (MPI_Send(&v, 1, MPI_INT, peer, 2, MPI_COMM_WORLD)
+        != MPI_SUCCESS) return 2;
+  }
+  if (got != peer * 3 + 7) return 4;
+  /* the wrappers saw the application calls (collectives use the
+   * engine internally, not the profiled entry points) */
+  if (sends != 1 || recvs != 1) return 5;
+  MPI_Barrier(MPI_COMM_WORLD);
+  if (rank == 0) printf("pmpi OK\n");
+  MPI_Finalize();
+  return 0;
+}
+''')
+        binp = tmp_path / "pmpi"
+        _compile_c(shim, src, binp)
+        port = _free_port()
+        procs = [
+            subprocess.Popen([str(binp)], env=_env(r, 2, port),
+                             stdout=subprocess.PIPE,
+                             stderr=subprocess.PIPE, text=True)
+            for r in range(2)
+        ]
+        outs = []
+        for r, p in enumerate(procs):
+            out, err = p.communicate(timeout=60)
+            assert p.returncode == 0, f"rank {r} failed: {err}\n{out}"
+            outs.append(out)
+        assert "pmpi OK" in outs[0]
+
+    def test_mpit_tool_interface(self, shim, tmp_path):
+        """The C MPI_T surface (ompi/mpi/tool's C side): enumerate
+        cvars/pvars, WRITE the eager-limit cvar and observe the
+        protocol switch move (an eager-size send becomes a rendezvous
+        send in the pvar counters), and watch the unexpected-queue
+        level rise and fall."""
+        src = tmp_path / "mpit.c"
+        src.write_text(r'''
+#include <stdio.h>
+#include <string.h>
+#include "zompi_mpi.h"
+
+int main(int argc, char **argv) {
+  int prov = -1;
+  if (MPI_T_init_thread(MPI_THREAD_SINGLE, &prov) != MPI_SUCCESS)
+    return 2;
+  MPI_Init(&argc, &argv);
+  int rank;
+  MPI_Comm_rank(MPI_COMM_WORLD, &rank);
+
+  int ncv = 0, npv = 0;
+  if (MPI_T_cvar_get_num(&ncv) != MPI_SUCCESS || ncv < 2) return 3;
+  if (MPI_T_pvar_get_num(&npv) != MPI_SUCCESS || npv < 5) return 4;
+
+  /* find the eager-limit cvar by name */
+  int eager_idx = -1;
+  for (int i = 0; i < ncv; i++) {
+    char name[64]; int nl = sizeof name;
+    MPI_Datatype dt; int verb, bind, scope;
+    if (MPI_T_cvar_get_info(i, name, &nl, &verb, &dt, 0, 0, 0, &bind,
+                            &scope) != MPI_SUCCESS) return 5;
+    if (!strcmp(name, "tcp_eager_limit")) {
+      if (dt != MPI_LONG || scope != MPI_T_SCOPE_LOCAL) return 6;
+      eager_idx = i;
+    }
+  }
+  if (eager_idx < 0) return 7;
+
+  MPI_T_cvar_handle ch; int cnt;
+  if (MPI_T_cvar_handle_alloc(eager_idx, 0, &ch, &cnt) != MPI_SUCCESS)
+    return 8;
+  long lim = -1;
+  if (MPI_T_cvar_read(ch, &lim) != MPI_SUCCESS || lim != (1L << 20))
+    return 9;
+
+  MPI_T_pvar_session ses;
+  if (MPI_T_pvar_session_create(&ses) != MPI_SUCCESS) return 10;
+  MPI_T_pvar_handle eager_h, rndv_h, unexp_h;
+  /* pvar order: eager_sends, rndv_sends, bytes_sent, unexpected, posted */
+  MPI_T_pvar_handle_alloc(ses, 0, 0, &eager_h, &cnt);
+  MPI_T_pvar_handle_alloc(ses, 1, 0, &rndv_h, &cnt);
+  MPI_T_pvar_handle_alloc(ses, 3, 0, &unexp_h, &cnt);
+
+  int peer = 1 - rank;
+  long long e0, e1, r0, r1;
+  MPI_T_pvar_read(ses, eager_h, &e0);
+  MPI_T_pvar_read(ses, rndv_h, &r0);
+  int payload[256];
+  memset(payload, rank, sizeof payload);
+  if (rank == 0) {
+    MPI_Send(payload, 256, MPI_INT, peer, 1, MPI_COMM_WORLD);
+    MPI_Recv(payload, 256, MPI_INT, peer, 2, MPI_COMM_WORLD,
+             MPI_STATUS_IGNORE);
+  } else {
+    MPI_Recv(payload, 256, MPI_INT, peer, 1, MPI_COMM_WORLD,
+             MPI_STATUS_IGNORE);
+    MPI_Send(payload, 256, MPI_INT, peer, 2, MPI_COMM_WORLD);
+  }
+  MPI_T_pvar_read(ses, eager_h, &e1);
+  MPI_T_pvar_read(ses, rndv_h, &r1);
+  if (e1 <= e0 || r1 != r0) return 11; /* 1 KiB goes eager */
+
+  /* write the cvar: now the same payload goes rendezvous */
+  long tiny = 64;
+  if (MPI_T_cvar_write(ch, &tiny) != MPI_SUCCESS) return 12;
+  MPI_T_cvar_read(ch, &lim);
+  if (lim != 64) return 13;
+  MPI_Barrier(MPI_COMM_WORLD);
+  MPI_T_pvar_read(ses, rndv_h, &r0);
+  if (rank == 0) {
+    MPI_Send(payload, 256, MPI_INT, peer, 3, MPI_COMM_WORLD);
+  } else {
+    MPI_Recv(payload, 256, MPI_INT, peer, 3, MPI_COMM_WORLD,
+             MPI_STATUS_IGNORE);
+  }
+  MPI_T_pvar_read(ses, rndv_h, &r1);
+  if (rank == 0 && r1 != r0 + 1) return 14; /* the switch moved */
+  long big = 1 << 20;
+  MPI_T_cvar_write(ch, &big);
+
+  /* unexpected-queue LEVEL: rank 1 sends early, rank 0 reads the
+   * level before and after receiving */
+  MPI_Barrier(MPI_COMM_WORLD);
+  if (rank == 1) {
+    MPI_Send(payload, 16, MPI_INT, 0, 4, MPI_COMM_WORLD);
+    MPI_Barrier(MPI_COMM_WORLD);
+    /* park until rank 0 finishes its level reads: running ahead would
+     * land the NEXT barrier's internal frame in rank 0's unexpected
+     * queue mid-assertion */
+    MPI_Recv(payload, 1, MPI_INT, 0, 5, MPI_COMM_WORLD,
+             MPI_STATUS_IGNORE);
+  } else {
+    MPI_Barrier(MPI_COMM_WORLD); /* the send landed unexpected */
+    long long lvl = -1;
+    MPI_T_pvar_read(ses, unexp_h, &lvl);
+    if (lvl < 1) return 15;
+    MPI_Recv(payload, 16, MPI_INT, 1, 4, MPI_COMM_WORLD,
+             MPI_STATUS_IGNORE);
+    MPI_T_pvar_read(ses, unexp_h, &lvl);
+    if (lvl != 0) return 16;
+    MPI_Send(payload, 1, MPI_INT, 1, 5, MPI_COMM_WORLD); /* release */
+  }
+
+  MPI_T_pvar_session_free(&ses);
+  MPI_T_cvar_handle_free(&ch);
+  if (MPI_T_finalize() != MPI_SUCCESS) return 17;
+  MPI_Barrier(MPI_COMM_WORLD);
+  if (rank == 0) printf("mpit OK\n");
+  MPI_Finalize();
+  return 0;
+}
+''')
+        binp = tmp_path / "mpit"
+        _compile_c(shim, src, binp)
+        port = _free_port()
+        procs = [
+            subprocess.Popen([str(binp)], env=_env(r, 2, port),
+                             stdout=subprocess.PIPE,
+                             stderr=subprocess.PIPE, text=True)
+            for r in range(2)
+        ]
+        outs = []
+        for r, p in enumerate(procs):
+            out, err = p.communicate(timeout=60)
+            assert p.returncode == 0, f"rank {r} failed: {err}\n{out}"
+            outs.append(out)
+        assert "mpit OK" in outs[0]
+
     def test_are_fatal_default_aborts(self, shim, tmp_path):
         """The MPI default handler is ERRORS_ARE_FATAL: an invalid-rank
         send without an installed handler must kill the process with a

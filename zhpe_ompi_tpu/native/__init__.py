@@ -156,7 +156,11 @@ def available() -> bool:
 _MPI_SRCS = [os.path.join(_HERE, "zompi_mpi.cpp"),
              os.path.join(_HERE, "zompi_shmem.cpp")]
 _MPI_HDRS = [os.path.join(_HERE, "zompi_mpi.h"),
-             os.path.join(_HERE, "zompi_shmem.h")]
+             os.path.join(_HERE, "zompi_shmem.h"),
+             # the PMPI layer: zompi_mpi.cpp #includes the .inc, and
+             # user code sees the .h — both must key the rebuild hash
+             os.path.join(_HERE, "zompi_pmpi.inc"),
+             os.path.join(_HERE, "zompi_pmpi.h")]
 _mpi_lock = threading.Lock()
 
 

@@ -531,8 +531,14 @@ struct Shim {
   // transfers (src, rndv_id) -> original (tag, cid, seq) envelope, and
   // receives already matched to a placeholder awaiting bulk data
   // (rndv_wait is guarded by match_mu — it is part of matching state)
-  int64_t eager_limit = 1 << 20;
+  // atomic: MPI_T_cvar_write mutates it at runtime while rendezvous
+  // pushers and icoll threads read it concurrently
+  std::atomic<int64_t> eager_limit{1 << 20};
   double cts_timeout = -1.0;  // <0: wait forever (blocking-send law)
+  // SPC-style engine counters, surfaced as MPI_T pvars
+  std::atomic<long long> ctr_eager_sends{0};
+  std::atomic<long long> ctr_rndv_sends{0};
+  std::atomic<long long> ctr_bytes_sent{0};
   std::atomic<int> inflight_isends{0};
   std::atomic<int64_t> next_rndv{1};
   std::map<std::pair<int64_t, int64_t>, std::array<int64_t, 3>> rndv_in;
@@ -1170,8 +1176,15 @@ int wire_send(const void *buf, size_t count, const DtInfo &di, int dest,
   // rather than let send_frame fail opaquely after the RTS handshake
   if (count * di.item > 0xFFFF0000ull) return MPI_ERR_COUNT;
   if (force_rndv ||
-      (allow_rndv && (int64_t)(count * di.item) > g.eager_limit))
-    return wire_send_rndv(buf, count, di, dest, tag, cid);
+      (allow_rndv && (int64_t)(count * di.item) > g.eager_limit)) {
+    int rc = wire_send_rndv(buf, count, di, dest, tag, cid);
+    if (rc == MPI_SUCCESS) {  // pvars count sends that reached the wire
+      g.ctr_rndv_sends.fetch_add(1, std::memory_order_relaxed);
+      g.ctr_bytes_sent.fetch_add((long long)(count * di.item),
+                                 std::memory_order_relaxed);
+    }
+    return rc;
+  }
   int fd = endpoint(dest);
   if (fd < 0) return MPI_ERR_OTHER;
   std::string payload;
@@ -1182,7 +1195,11 @@ int wire_send(const void *buf, size_t count, const DtInfo &di, int dest,
   put_int(payload, g.seq++);
   put_ndarray_1d(payload, di.tag, buf, count, di.item);
   std::lock_guard<std::mutex> lk(g.send_mu);
-  return send_frame(fd, payload) ? MPI_SUCCESS : MPI_ERR_OTHER;
+  if (!send_frame(fd, payload)) return MPI_ERR_OTHER;
+  g.ctr_eager_sends.fetch_add(1, std::memory_order_relaxed);
+  g.ctr_bytes_sent.fetch_add((long long)(count * di.item),
+                             std::memory_order_relaxed);
+  return MPI_SUCCESS;
 }
 
 // blocking internal recv of contiguous base elements (world addressing);
@@ -10709,6 +10726,228 @@ int MPI_DUP_FN(MPI_Comm, int, void *, void *attribute_val_in,
   return MPI_SUCCESS;
 }
 
+// ------------------------------------------- MPI_T tool interface
+// ompi/mpi/tool reduced to this shim's variable set: cvars are the
+// MCA-style knobs MPI_Init reads from ZMPI_MCA_* (writable at runtime
+// through exactly this interface, the reference's cvar write path);
+// pvars read the engine's live counters and queue levels.
+
+static bool g_mpit_up = false;
+
+struct CvarDesc {
+  const char *name;
+  const char *desc;
+  MPI_Datatype dt;
+  int scope;  // MPI_T_SCOPE_LOCAL = writable here
+};
+static const CvarDesc g_cvars[] = {
+    {"tcp_eager_limit",
+     "protocol switch: payloads above this many bytes go rendezvous",
+     MPI_LONG, MPI_T_SCOPE_LOCAL},
+    {"rndv_cts_timeout",
+     "seconds a rendezvous sender waits for CTS (<0 = forever)",
+     MPI_DOUBLE, MPI_T_SCOPE_LOCAL},
+};
+constexpr int N_CVARS = (int)(sizeof g_cvars / sizeof g_cvars[0]);
+
+struct PvarDesc {
+  const char *name;
+  const char *desc;
+  int var_class;
+};
+static const PvarDesc g_pvars[] = {
+    {"eager_sends", "messages sent on the eager path",
+     MPI_T_PVAR_CLASS_COUNTER},
+    {"rndv_sends", "messages sent through the rendezvous protocol",
+     MPI_T_PVAR_CLASS_COUNTER},
+    {"bytes_sent", "payload bytes handed to the wire",
+     MPI_T_PVAR_CLASS_COUNTER},
+    {"unexpected_msgs", "current unexpected-queue length",
+     MPI_T_PVAR_CLASS_LEVEL},
+    {"posted_recvs", "current posted-receive-queue length",
+     MPI_T_PVAR_CLASS_LEVEL},
+};
+constexpr int N_PVARS = (int)(sizeof g_pvars / sizeof g_pvars[0]);
+
+static std::set<int> g_pvar_sessions;
+static int g_next_pvar_session = 1;
+
+static void mpit_str(const char *src, char *dst, int *len) {
+  if (dst && len && *len > 0) {
+    snprintf(dst, (size_t)*len, "%s", src);
+    *len = (int)strlen(dst);
+  } else if (len) {
+    *len = (int)strlen(src) + 1;
+  }
+}
+
+int MPI_T_init_thread(int, int *provided) {
+  g_mpit_up = true;
+  if (provided) *provided = g_thread_level;
+  return MPI_SUCCESS;
+}
+
+int MPI_T_finalize(void) {
+  if (!g_mpit_up) return MPI_T_ERR_NOT_INITIALIZED;
+  g_mpit_up = false;
+  g_pvar_sessions.clear();
+  return MPI_SUCCESS;
+}
+
+int MPI_T_cvar_get_num(int *num_cvar) {
+  if (!g_mpit_up) return MPI_T_ERR_NOT_INITIALIZED;
+  *num_cvar = N_CVARS;
+  return MPI_SUCCESS;
+}
+
+int MPI_T_cvar_get_info(int idx, char *name, int *name_len,
+                        int *verbosity, MPI_Datatype *datatype,
+                        void *, char *desc, int *desc_len, int *bind,
+                        int *scope) {
+  if (!g_mpit_up) return MPI_T_ERR_NOT_INITIALIZED;
+  if (idx < 0 || idx >= N_CVARS) return MPI_T_ERR_INVALID_INDEX;
+  mpit_str(g_cvars[idx].name, name, name_len);
+  mpit_str(g_cvars[idx].desc, desc, desc_len);
+  if (verbosity) *verbosity = MPI_T_VERBOSITY_USER_BASIC;
+  if (datatype) *datatype = g_cvars[idx].dt;
+  if (bind) *bind = MPI_T_BIND_NO_OBJECT;
+  if (scope) *scope = g_cvars[idx].scope;
+  return MPI_SUCCESS;
+}
+
+int MPI_T_cvar_handle_alloc(int idx, void *, MPI_T_cvar_handle *handle,
+                            int *count) {
+  if (!g_mpit_up) return MPI_T_ERR_NOT_INITIALIZED;
+  if (idx < 0 || idx >= N_CVARS) return MPI_T_ERR_INVALID_INDEX;
+  *handle = idx;  // the variable set is static; the index IS the handle
+  if (count) *count = 1;
+  return MPI_SUCCESS;
+}
+
+int MPI_T_cvar_handle_free(MPI_T_cvar_handle *handle) {
+  if (handle) *handle = -1;
+  return MPI_SUCCESS;
+}
+
+int MPI_T_cvar_read(MPI_T_cvar_handle h, void *buf) {
+  if (!g_mpit_up) return MPI_T_ERR_NOT_INITIALIZED;
+  switch (h) {
+    case 0:
+      *(long *)buf = (long)g.eager_limit.load();
+      return MPI_SUCCESS;
+    case 1: *(double *)buf = g.cts_timeout; return MPI_SUCCESS;
+  }
+  return MPI_T_ERR_INVALID_HANDLE;
+}
+
+int MPI_T_cvar_write(MPI_T_cvar_handle h, const void *buf) {
+  if (!g_mpit_up) return MPI_T_ERR_NOT_INITIALIZED;
+  switch (h) {
+    case 0: {
+      long v = *(const long *)buf;
+      if (v <= 0) return MPI_T_ERR_CVAR_SET_NOT_NOW;
+      g.eager_limit = v;
+      return MPI_SUCCESS;
+    }
+    case 1:
+      g.cts_timeout = *(const double *)buf;
+      return MPI_SUCCESS;
+  }
+  return MPI_T_ERR_INVALID_HANDLE;
+}
+
+int MPI_T_pvar_get_num(int *num_pvar) {
+  if (!g_mpit_up) return MPI_T_ERR_NOT_INITIALIZED;
+  *num_pvar = N_PVARS;
+  return MPI_SUCCESS;
+}
+
+int MPI_T_pvar_get_info(int idx, char *name, int *name_len,
+                        int *verbosity, int *var_class,
+                        MPI_Datatype *datatype, void *, char *desc,
+                        int *desc_len, int *bind, int *readonly,
+                        int *continuous, int *atomic_) {
+  if (!g_mpit_up) return MPI_T_ERR_NOT_INITIALIZED;
+  if (idx < 0 || idx >= N_PVARS) return MPI_T_ERR_INVALID_INDEX;
+  mpit_str(g_pvars[idx].name, name, name_len);
+  mpit_str(g_pvars[idx].desc, desc, desc_len);
+  if (verbosity) *verbosity = MPI_T_VERBOSITY_USER_BASIC;
+  if (var_class) *var_class = g_pvars[idx].var_class;
+  if (datatype) *datatype = MPI_LONG_LONG;
+  if (bind) *bind = MPI_T_BIND_NO_OBJECT;
+  if (readonly) *readonly = 1;
+  if (continuous) *continuous = 1;  // counters never need start/stop
+  if (atomic_) *atomic_ = 0;
+  return MPI_SUCCESS;
+}
+
+int MPI_T_pvar_session_create(MPI_T_pvar_session *session) {
+  if (!g_mpit_up) return MPI_T_ERR_NOT_INITIALIZED;
+  *session = g_next_pvar_session++;
+  g_pvar_sessions.insert(*session);
+  return MPI_SUCCESS;
+}
+
+int MPI_T_pvar_session_free(MPI_T_pvar_session *session) {
+  if (!session || !g_pvar_sessions.erase(*session))
+    return MPI_T_ERR_INVALID_HANDLE;
+  *session = -1;
+  return MPI_SUCCESS;
+}
+
+int MPI_T_pvar_handle_alloc(MPI_T_pvar_session session, int idx, void *,
+                            MPI_T_pvar_handle *handle, int *count) {
+  if (!g_mpit_up) return MPI_T_ERR_NOT_INITIALIZED;
+  if (!g_pvar_sessions.count(session)) return MPI_T_ERR_INVALID_HANDLE;
+  if (idx < 0 || idx >= N_PVARS) return MPI_T_ERR_INVALID_INDEX;
+  *handle = idx;
+  if (count) *count = 1;
+  return MPI_SUCCESS;
+}
+
+int MPI_T_pvar_handle_free(MPI_T_pvar_session,
+                           MPI_T_pvar_handle *handle) {
+  if (handle) *handle = -1;
+  return MPI_SUCCESS;
+}
+
+int MPI_T_pvar_start(MPI_T_pvar_session session, MPI_T_pvar_handle) {
+  // continuous variables: start is a no-op (the reference's behavior)
+  return g_pvar_sessions.count(session) ? MPI_SUCCESS
+                                        : MPI_T_ERR_INVALID_HANDLE;
+}
+
+int MPI_T_pvar_stop(MPI_T_pvar_session session, MPI_T_pvar_handle) {
+  return g_pvar_sessions.count(session) ? MPI_SUCCESS
+                                        : MPI_T_ERR_INVALID_HANDLE;
+}
+
+int MPI_T_pvar_read(MPI_T_pvar_session session, MPI_T_pvar_handle h,
+                    void *buf) {
+  if (!g_mpit_up) return MPI_T_ERR_NOT_INITIALIZED;
+  if (!g_pvar_sessions.count(session)) return MPI_T_ERR_INVALID_HANDLE;
+  long long v;
+  switch (h) {
+    case 0: v = g.ctr_eager_sends.load(); break;
+    case 1: v = g.ctr_rndv_sends.load(); break;
+    case 2: v = g.ctr_bytes_sent.load(); break;
+    case 3: {
+      std::lock_guard<std::mutex> lk(g.match_mu);
+      v = (long long)g.unexpected.size();
+      break;
+    }
+    case 4: {
+      std::lock_guard<std::mutex> lk(g.match_mu);
+      v = (long long)g.posted.size();
+      break;
+    }
+    default:
+      return MPI_T_ERR_INVALID_HANDLE;
+  }
+  *(long long *)buf = v;
+  return MPI_SUCCESS;
+}
+
 // ---------------------------------------------------------------- misc
 
 int MPI_Abort(MPI_Comm, int errorcode) {
@@ -10725,3 +10964,6 @@ double MPI_Wtime(void) {
 double MPI_Wtick(void) { return 1e-9; }
 
 }  // extern "C"
+
+// PMPI profiling layer: weak MPI_X + PMPI_X aliases (generated)
+#include "zompi_pmpi.inc"

@@ -1165,6 +1165,55 @@ int MPI_Win_get_attr(MPI_Win win, int keyval, void *attribute_val,
                      int *flag);
 int MPI_Win_delete_attr(MPI_Win win, int keyval);
 
+/* MPI_T tool interface (ompi/mpi/tool, SURVEY §2.6 row 47's C side):
+ * control variables expose the shim's MCA-style knobs, performance
+ * variables expose live engine counters/levels.  Compact-but-real
+ * subset: ENUMTYPE/CHAR bindings and categories are absent. */
+#define MPI_T_ERR_INVALID_INDEX  64
+#define MPI_T_ERR_INVALID_HANDLE 65
+#define MPI_T_ERR_NOT_INITIALIZED 66
+#define MPI_T_ERR_CVAR_SET_NOT_NOW 67
+#define MPI_T_VERBOSITY_USER_BASIC 221
+#define MPI_T_BIND_NO_OBJECT 0
+#define MPI_T_SCOPE_LOCAL 1
+#define MPI_T_SCOPE_READONLY 0
+#define MPI_T_PVAR_CLASS_COUNTER 2
+#define MPI_T_PVAR_CLASS_LEVEL 1
+typedef int MPI_T_cvar_handle;
+typedef int MPI_T_pvar_handle;
+typedef int MPI_T_pvar_session;
+#define MPI_T_PVAR_ALL_HANDLES (-1)
+int MPI_T_init_thread(int required, int *provided);
+int MPI_T_finalize(void);
+int MPI_T_cvar_get_num(int *num_cvar);
+int MPI_T_cvar_get_info(int cvar_index, char *name, int *name_len,
+                        int *verbosity, MPI_Datatype *datatype,
+                        void *enumtype, char *desc, int *desc_len,
+                        int *bind, int *scope);
+int MPI_T_cvar_handle_alloc(int cvar_index, void *obj_handle,
+                            MPI_T_cvar_handle *handle, int *count);
+int MPI_T_cvar_handle_free(MPI_T_cvar_handle *handle);
+int MPI_T_cvar_read(MPI_T_cvar_handle handle, void *buf);
+int MPI_T_cvar_write(MPI_T_cvar_handle handle, const void *buf);
+int MPI_T_pvar_get_num(int *num_pvar);
+int MPI_T_pvar_get_info(int pvar_index, char *name, int *name_len,
+                        int *verbosity, int *var_class,
+                        MPI_Datatype *datatype, void *enumtype,
+                        char *desc, int *desc_len, int *bind,
+                        int *readonly, int *continuous, int *atomic);
+int MPI_T_pvar_session_create(MPI_T_pvar_session *session);
+int MPI_T_pvar_session_free(MPI_T_pvar_session *session);
+int MPI_T_pvar_handle_alloc(MPI_T_pvar_session session, int pvar_index,
+                            void *obj_handle, MPI_T_pvar_handle *handle,
+                            int *count);
+int MPI_T_pvar_handle_free(MPI_T_pvar_session session,
+                           MPI_T_pvar_handle *handle);
+int MPI_T_pvar_start(MPI_T_pvar_session session,
+                     MPI_T_pvar_handle handle);
+int MPI_T_pvar_stop(MPI_T_pvar_session session, MPI_T_pvar_handle handle);
+int MPI_T_pvar_read(MPI_T_pvar_session session, MPI_T_pvar_handle handle,
+                    void *buf);
+
 #ifdef __cplusplus
 }
 #endif
