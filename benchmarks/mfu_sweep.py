@@ -197,7 +197,15 @@ def supervise() -> int:
         out = (child.stdout or "").strip().splitlines()
         line = out[-1] if out else ""
         if child.returncode == 0 and "MFU" in line:
+            import re as _re
+            mfu_m = _re.search(r"MFU\s+([\d.]+)%", line)
             _append_state({"key": key, "status": "ok", "line": line,
+                           # structured fields: bench.py adopts the
+                           # best config from THESE, never by
+                           # re-parsing the key string
+                           "cfg": list(CONFIGS[idx]),
+                           "mfu": float(mfu_m.group(1)) if mfu_m
+                                  else None,
                            "warns": [l for l in
                                      (child.stderr or "").splitlines()
                                      if "unavailable" in l],

@@ -534,7 +534,9 @@ struct Shim {
   // atomic: MPI_T_cvar_write mutates it at runtime while rendezvous
   // pushers and icoll threads read it concurrently
   std::atomic<int64_t> eager_limit{1 << 20};
-  double cts_timeout = -1.0;  // <0: wait forever (blocking-send law)
+  // atomic for the same reason: the rndv_cts_timeout cvar is writable
+  // at runtime while rendezvous waiters read it from their threads
+  std::atomic<double> cts_timeout{-1.0};  // <0: wait forever
   // SPC-style engine counters, surfaced as MPI_T pvars
   std::atomic<long long> ctr_eager_sends{0};
   std::atomic<long long> ctr_rndv_sends{0};
@@ -8355,8 +8357,8 @@ int win_lock_rpc(WinObj *w, int64_t wid, int tw, const std::string &kind,
   }
   MPI_Status st{};
   // lock grants legally wait for another origin's unlock: no timeout
-  return wait_handle_impl(handle, &st, kind == "wlock" ? -1.0
-                                                       : g.cts_timeout);
+  return wait_handle_impl(
+      handle, &st, kind == "wlock" ? -1.0 : g.cts_timeout.load());
 }
 
 }  // namespace
@@ -10835,7 +10837,9 @@ int MPI_T_cvar_read(MPI_T_cvar_handle h, void *buf) {
     case 0:
       *(long *)buf = (long)g.eager_limit.load();
       return MPI_SUCCESS;
-    case 1: *(double *)buf = g.cts_timeout; return MPI_SUCCESS;
+    case 1:
+      *(double *)buf = g.cts_timeout.load();
+      return MPI_SUCCESS;
   }
   return MPI_T_ERR_INVALID_HANDLE;
 }
