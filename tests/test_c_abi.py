@@ -893,6 +893,115 @@ int main(int argc, char **argv) {
         assert got.shape == (self.NDOUBLES,)
         np.testing.assert_array_equal(got, payload + 1.0)
 
+    def test_derived_types_cross_plane(self, shim, tmp_path):
+        """Derived datatypes across the wire boundary: a C rank packs a
+        strided vector (element-sealed, wire dtype <f8) and a mixed
+        struct (byte-flattened, wire dtype |u1) to a Python rank, then
+        receives Python doubles into its strided layout — the convertor
+        contract (packed base elements on the wire) holds between the
+        two engines."""
+        src = tmp_path / "dtinterop.c"
+        src.write_text(r'''
+#include <stdio.h>
+#include <string.h>
+#include "zompi_mpi.h"
+struct rec { double x; int id; };
+int main(int argc, char **argv) {
+  if (MPI_Init(&argc, &argv) != MPI_SUCCESS) return 2;
+  int rank;
+  MPI_Comm_rank(MPI_COMM_WORLD, &rank);
+  /* strided doubles: every OTHER element of a 8-double buffer
+   * (Type_vector stays ELEMENT-sealed: wire dtype <f8; the byte
+   * constructors flatten to |u1 — the struct below covers that) */
+  MPI_Datatype hv;
+  if (MPI_Type_vector(4, 1, 2, MPI_DOUBLE, &hv) != MPI_SUCCESS)
+    return 3;
+  MPI_Type_commit(&hv);
+  double buf[8];
+  for (int i = 0; i < 8; i++) buf[i] = i * 1.5;
+  /* -> python sees the packed elements [0, 3, 6, 9] */
+  if (MPI_Send(buf, 1, hv, 0, 11, MPI_COMM_WORLD) != MPI_SUCCESS)
+    return 4;
+  /* mixed struct -> byte-flattened payload on the wire */
+  struct rec r2[2];
+  memset(r2, 0, sizeof r2);
+  r2[0].x = 2.5; r2[0].id = 7;
+  r2[1].x = -4.25; r2[1].id = 9;
+  int bl[2] = {1, 1};
+  MPI_Aint dp[2];
+  MPI_Aint base, a;
+  MPI_Get_address(&r2[0], &base);
+  MPI_Get_address(&r2[0].x, &a); dp[0] = a - base;
+  MPI_Get_address(&r2[0].id, &a); dp[1] = a - base;
+  MPI_Datatype fields[2] = {MPI_DOUBLE, MPI_INT}, st_t, rec_t;
+  MPI_Type_create_struct(2, bl, dp, fields, &st_t);
+  MPI_Type_create_resized(st_t, 0, sizeof(struct rec), &rec_t);
+  MPI_Type_commit(&rec_t);
+  if (MPI_Send(r2, 2, rec_t, 0, 12, MPI_COMM_WORLD) != MPI_SUCCESS)
+    return 5;
+  /* python doubles land in the strided layout through the unpack */
+  double landing[8];
+  for (int i = 0; i < 8; i++) landing[i] = -1.0;
+  MPI_Status st;
+  if (MPI_Recv(landing, 1, hv, 0, 13, MPI_COMM_WORLD, &st) !=
+      MPI_SUCCESS) return 6;
+  for (int i = 0; i < 4; i++) {
+    if (landing[2 * i] != 100.0 + i) return 7;   /* typemap slots */
+    if (landing[2 * i + 1] != -1.0) return 8;    /* gaps untouched */
+  }
+  MPI_Type_free(&hv);
+  MPI_Type_free(&st_t);
+  MPI_Type_free(&rec_t);
+  MPI_Barrier(MPI_COMM_WORLD);
+  printf("dtinterop OK\n");
+  MPI_Finalize();
+  return 0;
+}
+''')
+        binpath = tmp_path / "dtinterop"
+        _compile_c(shim, src, binpath)
+        port = _free_port()
+        results = {}
+        excs = []
+
+        def py_rank():
+            try:
+                proc = TcpProc(0, 2, coordinator=("127.0.0.1", port))
+                try:
+                    results["hv"] = proc.recv(source=1, tag=11)
+                    results["struct"] = proc.recv(source=1, tag=12)
+                    proc.send(np.arange(4, dtype=np.float64) + 100.0,
+                              dest=1, tag=13)
+                    proc.barrier()
+                finally:
+                    proc.close()
+            except BaseException as e:  # noqa: BLE001
+                excs.append(e)
+
+        t = threading.Thread(target=py_rank)
+        t.start()
+        cproc = subprocess.Popen(
+            [str(binpath)], env=_env(1, 2, port),
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        )
+        out, err = cproc.communicate(timeout=60)
+        t.join(30)
+        assert not t.is_alive(), "python rank hung"
+        if excs:
+            raise excs[0]
+        assert cproc.returncode == 0, f"C rank failed: {err}\n{out}"
+        # element-sealed vector: packed doubles, every other element
+        np.testing.assert_array_equal(
+            np.asarray(results["hv"]),
+            np.array([0.0, 3.0, 6.0, 9.0]))
+        # byte-flattened struct: packed (double, int) pairs as raw bytes
+        raw = np.asarray(results["struct"])
+        assert raw.dtype == np.uint8 and raw.size == 2 * 12
+        rec = np.frombuffer(raw.tobytes(), dtype=[("x", "<f8"),
+                                                  ("id", "<i4")])
+        assert rec["x"].tolist() == [2.5, -4.25]
+        assert rec["id"].tolist() == [7, 9]
+
     def test_c_to_c_4mb_exchange(self, shim, tmp_path):
         """Both C legs at once: every rank rendezvous-sends 4 MB to its
         right neighbor while answering its left neighbor's RTS."""
