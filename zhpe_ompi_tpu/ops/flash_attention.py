@@ -466,8 +466,16 @@ def _kernel_available() -> bool:
         try:
             with jax.ensure_compile_time_eval():
                 q = jnp.zeros((1, 256, 1, 64), jnp.bfloat16)
-                out = _flash(q, q, q, True, 128, 128, False)
-                ok = bool(np.isfinite(np.asarray(out)).all())
+                # forward AND backward: the bwd kernels lower
+                # separately, and a bwd-only Mosaic failure would
+                # otherwise surface as a whole-train-step compile
+                # error the per-call fallback cannot catch
+                val, grads = jax.value_and_grad(
+                    lambda a: _flash(a, a, a, True, 128, 128,
+                                     False).astype(jnp.float32).sum()
+                )(q)
+                ok = bool(np.isfinite(np.asarray(val))) and bool(
+                    np.isfinite(np.asarray(grads)).all())
             _kernel_ok = ok
             if not _kernel_ok:
                 _warn_fallback("probe produced non-finite output")
