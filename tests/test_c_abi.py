@@ -117,6 +117,18 @@ class TestPureC:
         for r in range(n):
             assert f"ring_c rank {r}/{n} OK" in outs[r]
 
+    @pytest.mark.parametrize("n", [3, 5])
+    def test_hello_and_connectivity_examples(self, shim,
+                                             tmp_path_factory, n):
+        """The reference's examples/hello_c.c and connectivity_c.c
+        acceptance shapes: identity + full NxN pairwise reachability."""
+        outs = _run_example(shim, tmp_path_factory, "hello_c.c", n)
+        for r in range(n):
+            assert f"I am {r} of {n}" in outs[r]
+        outs = _run_example(shim, tmp_path_factory, "connectivity_c.c",
+                            n)
+        assert f"Connectivity test on {n} processes PASSED." in outs[0]
+
     @pytest.mark.parametrize("n", [2, 3])
     def test_util_example(self, shim, tmp_path_factory, n):
         """Round-5 utility surface: versions/threads, error classes,
