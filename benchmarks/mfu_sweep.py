@@ -27,26 +27,35 @@ STATE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
 # (batch, remat, seq, fused_ln, ce_chunk, flash): the round-3 grid plus the
 # round-4 levers individually and together, plus round-5 flash on/off
 # attribution rows (flash None = auto kernel-if-available, False = naive).
+#
+# ORDERED BY INFORMATION VALUE: the tunnel dies without warning
+# (rounds 3-5), so a short window must yield the lever attribution the
+# VERDICT asks for, not baseline rows.  First the all-levers headline,
+# then the three one-lever-off attributions, then the long-context
+# flash pair, then batch/chunk variations, baselines last (the
+# no-lever plateau is already measured — round 3 and this morning's
+# partial window agree).
 CONFIGS = [
-    (8, False, 512, False, None, None),
-    (16, False, 512, False, None, None),
-    (32, False, 512, False, None, None),
-    (16, True, 512, False, None, None),
-    (32, True, 512, False, None, None),
-    (64, True, 512, False, None, None),
-    # levers, one at a time then together, at B16/B32 + remat
-    (16, True, 512, None, None, None),
-    (16, True, 512, False, 1024, None),
+    # 1. the candidate optimum: all three levers on
     (16, True, 512, None, 1024, None),
+    # 2-4. one-lever-off attributions at the same shape
+    (16, True, 512, False, 1024, None),   # fused-ln off
+    (16, True, 512, None, None, None),    # chunked-CE off
+    (16, True, 512, None, 1024, False),   # flash off
+    # 5-6. long context: attention ~36% of FLOPs, the flash regime
+    (2, True, 4096, None, 1024, None),
+    (2, True, 4096, None, 1024, False),
+    # 7-9. batch/chunk variations around the optimum
     (32, True, 512, None, 1024, None),
     (16, True, 512, None, 512, None),
     (16, True, 512, None, 2048, None),
-    # flash attribution at the headline config (auto row above vs naive)
-    (16, True, 512, None, 1024, False),
-    # long-context rows: seq 4096 where attention is ~36% of FLOPs —
-    # flash auto vs forced-naive isolates the kernel's contribution
-    (2, True, 4096, None, 1024, None),
-    (2, True, 4096, None, 1024, False),
+    # 10-15. the round-3 baseline grid (no levers)
+    (16, True, 512, False, None, None),
+    (32, True, 512, False, None, None),
+    (64, True, 512, False, None, None),
+    (8, False, 512, False, None, None),
+    (16, False, 512, False, None, None),
+    (32, False, 512, False, None, None),
 ]
 
 
