@@ -1,0 +1,37 @@
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include "zompi_mpi.h"
+#define N (6 * 1024 * 1024 / 8)  /* 6 MB window: reply > ring capacity */
+int main(int argc, char **argv) {
+  MPI_Init(&argc, &argv);
+  int rank;
+  MPI_Comm_rank(MPI_COMM_WORLD, &rank);
+  double *base = malloc(N * sizeof(double));
+  for (int i = 0; i < N; i++) base[i] = rank * 1000.0 + i % 997;
+  MPI_Win win;
+  MPI_Win_create(base, N * sizeof(double), sizeof(double),
+                 MPI_INFO_NULL, MPI_COMM_WORLD, &win);
+  MPI_Win_fence(0, win);
+  double *got = malloc(N * sizeof(double));
+  if (rank < 2) {
+    int peer = 1 - rank;
+    /* ranks 0/1 Get each other's ENTIRE 6 MB window at once: the
+     * replies exceed the 4 MiB ring, crossing in both directions
+     * (ranks >= 2 stay in the fence, proving their inbound frames
+     * are not frozen by the pair's spill) */
+    if (MPI_Get(got, N, MPI_DOUBLE, peer, 0, N, MPI_DOUBLE, win) !=
+        MPI_SUCCESS) return 3;
+    MPI_Win_fence(0, win);
+    for (int i = 0; i < N; i += 4099)
+      if (got[i] != peer * 1000.0 + i % 997) return 4;
+  } else {
+    MPI_Win_fence(0, win);
+  }
+  MPI_Win_free(&win);
+  MPI_Barrier(MPI_COMM_WORLD);
+  if (rank == 0) printf("crossget OK\n");
+  MPI_Finalize();
+  free(base); free(got);
+  return 0;
+}

@@ -93,6 +93,12 @@ def _env(rank, size, port):
     env.update({
         "ZMPI_RANK": str(rank), "ZMPI_SIZE": str(size),
         "ZMPI_COORD_HOST": "127.0.0.1", "ZMPI_COORD_PORT": str(port),
+        # force the shared-memory rings on (the hardware-aware default
+        # disables them on this single-core CI host): every direct
+        # multi-process test then exercises the sm transport, while
+        # the zmpirun-launched tests keep the TCP default — both
+        # transports stay covered
+        "ZMPI_MCA_sm": "1",
     })
     return env
 
@@ -307,6 +313,17 @@ int main(int argc, char **argv) {
         out, err = p.communicate(timeout=90)
         assert p.returncode == 0, f"parent failed: {err}\n{out}"
         assert "spawn_multiple OK" in out
+
+    @pytest.mark.parametrize("n", [2, 3])
+    def test_crossed_large_gets_over_sm(self, shim, tmp_path_factory,
+                                        n):
+        """Crossed 6 MB MPI_Gets whose replies exceed the 4 MiB sm ring
+        in both directions at once: the poll thread must spill its
+        replies instead of blocking (a blocked poll thread would
+        deadlock the pair AND freeze every other peer's inbound)."""
+        outs = _run_example(shim, tmp_path_factory, "crossget_c.c", n,
+                            timeout=120)
+        assert "crossget OK" in outs[0]
 
     def test_pmpi_interposition(self, shim, tmp_path):
         """The PMPI profiling contract (send.c:37-39's weak-symbol

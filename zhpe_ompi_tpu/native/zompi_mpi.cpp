@@ -502,6 +502,9 @@ struct Shim {
   std::string host = "127.0.0.1";
   int listen_port = 0;
   std::vector<std::pair<std::string, int>> book;
+  // modex capability strings, aligned with book ("" = none; "sm" =
+  // the rank maps same-host shared-memory rings)
+  std::vector<std::string> caps;
   std::map<int, int> conns;  // peer rank -> fd
   std::mutex conn_mu;
   std::mutex send_mu;
@@ -562,6 +565,380 @@ struct Shim {
 // under them (mutexes included) would be UB at process exit.  Finalize
 // does the real cleanup; the one Shim's memory dies with the process.
 Shim &g = *new Shim;
+
+// ------------------------- same-host shared-memory transport --------
+// The btl/sm role for the C plane (opal/mca/btl/sm's fast-box/FIFO,
+// re-designed as one SPSC byte-stream ring per DIRECTED same-host
+// pair).  The ENTIRE main channel of an sm-activated direction rides
+// the ring — eager data, RTS, CTS, window tuples, barrier signals —
+// so per-pair FIFO holds with no cross-transport reordering (the
+// reference needs PML sequence numbers for exactly this; one
+// transport per direction needs none).  Rendezvous BULK data keeps
+// its dedicated TCP connections: a separate channel whose arrival
+// order the placeholder design already decouples.
+//
+// Activation: both ranks advertise "sm" in their modex card, share a
+// host string, and belong to the same init cohort (the contiguous
+// WORLD block that initialized together — spawn joins stay TCP).
+// Each rank creates its outbound rings, then waits briefly for the
+// matching inbound files; a mapped inbound ring proves the shared
+// /dev/shm namespace, which gates the OUTBOUND activation.  Inbound
+// rings that appear late are still polled (pending list), so an
+// asymmetric activation can never lose frames.
+
+struct SmRingHdr {
+  std::atomic<uint64_t> magic;
+  char pad0[56];
+  std::atomic<uint64_t> head;  // bytes produced (monotonic)
+  char pad1[56];
+  std::atomic<uint64_t> tail;  // bytes consumed (monotonic)
+  char pad2[56];
+};
+constexpr uint64_t SM_MAGIC = 0x5A4F4D5049534D31ULL;  // "ZOMPISM1"
+constexpr size_t SM_RING_BYTES = (size_t)4 << 20;     // stream capacity
+
+struct SmRing {
+  SmRingHdr *hdr = nullptr;
+  char *data = nullptr;
+  std::string path;
+  bool creator = false;
+  std::mutex wmu;     // outbound: serialize concurrent senders
+  std::string rbuf;   // inbound: frame assembly across poll cycles
+  int src = -1;       // inbound: the writing peer (diagnostics)
+  // outbound overflow queue: the POLL thread must never block on a
+  // full ring (it is the consumer that frees every OTHER ring — a
+  // blocked poll thread deadlocks crossed large replies), so its
+  // writes spill here and the poll loop itself drains the spill as
+  // space appears.  Order: once non-empty, EVERY later frame to this
+  // ring appends behind it (guarded by wmu).
+  std::string pending;
+};
+
+// set inside sm_poll_loop: sends from the dispatch path must not block
+thread_local bool tl_sm_poll_thread = false;
+
+void sm_release(SmRing &r) {
+  if (r.hdr) {
+    munmap((void *)r.hdr, sizeof(SmRingHdr) + SM_RING_BYTES);
+    r.hdr = nullptr;
+  }
+  if (r.creator && !r.path.empty()) shm_unlink(r.path.c_str());
+}
+
+std::map<int, std::unique_ptr<SmRing>> g_sm_out;   // dest -> ring
+std::vector<std::unique_ptr<SmRing>> g_sm_in;
+std::vector<std::pair<int, std::string>> g_sm_pending;  // late inbound
+std::mutex g_sm_pending_mu;
+std::thread g_sm_poll;
+std::atomic<bool> g_sm_poll_up{false};
+
+void dispatch_frame(const std::string &frame);  // defined with drains
+
+bool sm_map(const std::string &path, bool create, SmRing &out) {
+  int fd;
+  size_t len = sizeof(SmRingHdr) + SM_RING_BYTES;
+  if (create) {
+    shm_unlink(path.c_str());  // stale ring from a crashed job
+    fd = shm_open(path.c_str(), O_CREAT | O_EXCL | O_RDWR, 0600);
+    if (fd < 0 || ftruncate(fd, (off_t)len) != 0) {
+      if (fd >= 0) close(fd);
+      return false;
+    }
+  } else {
+    fd = shm_open(path.c_str(), O_RDWR, 0600);
+    if (fd < 0) return false;
+    struct stat st{};
+    if (fstat(fd, &st) != 0 || st.st_size < (off_t)len) {
+      close(fd);  // peer still truncating: caller retries
+      return false;
+    }
+  }
+  char *m = (char *)mmap(nullptr, len, PROT_READ | PROT_WRITE,
+                         MAP_SHARED, fd, 0);
+  close(fd);
+  if (m == MAP_FAILED) return false;
+  out.hdr = (SmRingHdr *)m;
+  out.data = m + sizeof(SmRingHdr);
+  out.path = path;
+  out.creator = create;
+  if (create) {
+    out.hdr->head.store(0, std::memory_order_relaxed);
+    out.hdr->tail.store(0, std::memory_order_relaxed);
+    out.hdr->magic.store(SM_MAGIC, std::memory_order_release);
+  } else if (out.hdr->magic.load(std::memory_order_acquire) !=
+             SM_MAGIC) {
+    munmap(m, len);
+    out.hdr = nullptr;
+    return false;  // creator has not finished stamping
+  }
+  return true;
+}
+
+// the selection policy (shared by the modex card and sm_setup): rings
+// on multi-core hosts, TCP on single-core, ZMPI_MCA_sm forces either
+bool sm_enabled() {
+  const char *force = getenv("ZMPI_MCA_sm");
+  if (force && force[0]) return force[0] == '1';
+  return sysconf(_SC_NPROCESSORS_ONLN) > 1;
+}
+
+std::string sm_ring_path(int src, int dst) {
+  const char *port = getenv("ZMPI_COORD_PORT");
+  char buf[96];
+  snprintf(buf, sizeof buf, "/zompi_ring_%s_%d_%d",
+           port ? port : "0", src, dst);
+  return buf;
+}
+
+// stream `n` bytes into the ring, wrapping and waiting on the
+// consumer; frames larger than the ring flow through in pieces (the
+// reader frees space as it assembles)
+int sm_write_bytes(SmRing *r, const char *p, size_t n) {
+  size_t done = 0;
+  int spins = 0;
+  while (done < n) {
+    uint64_t head = r->hdr->head.load(std::memory_order_relaxed);
+    uint64_t tail = r->hdr->tail.load(std::memory_order_acquire);
+    size_t free_ = SM_RING_BYTES - (size_t)(head - tail);
+    if (free_ == 0) {
+      if (g.closing.load()) return MPI_ERR_OTHER;
+      if (++spins > 2000)
+        std::this_thread::sleep_for(std::chrono::microseconds(50));
+      continue;
+    }
+    spins = 0;
+    size_t chunk = n - done < free_ ? n - done : free_;
+    size_t off = (size_t)(head % SM_RING_BYTES);
+    size_t first = chunk < SM_RING_BYTES - off ? chunk
+                                               : SM_RING_BYTES - off;
+    memcpy(r->data + off, p + done, first);
+    memcpy(r->data, p + done + first, chunk - first);
+    r->hdr->head.store(head + chunk, std::memory_order_release);
+    done += chunk;
+  }
+  return MPI_SUCCESS;
+}
+
+// write whatever fits RIGHT NOW; returns bytes written (never waits)
+size_t sm_write_avail(SmRing *r, const char *p, size_t n) {
+  uint64_t head = r->hdr->head.load(std::memory_order_relaxed);
+  uint64_t tail = r->hdr->tail.load(std::memory_order_acquire);
+  size_t free_ = SM_RING_BYTES - (size_t)(head - tail);
+  size_t chunk = n < free_ ? n : free_;
+  if (chunk == 0) return 0;
+  size_t off = (size_t)(head % SM_RING_BYTES);
+  size_t first = chunk < SM_RING_BYTES - off ? chunk
+                                             : SM_RING_BYTES - off;
+  memcpy(r->data + off, p, first);
+  memcpy(r->data, p + first, chunk - first);
+  r->hdr->head.store(head + chunk, std::memory_order_release);
+  return chunk;
+}
+
+// wmu must be held; pushes as much spilled data as fits
+void sm_flush_pending_locked(SmRing *r) {
+  if (r->pending.empty()) return;
+  size_t put = sm_write_avail(r, r->pending.data(), r->pending.size());
+  if (put) r->pending.erase(0, put);
+}
+
+int sm_send_frame(SmRing *r, const std::string &payload) {
+  // same 4-byte little-endian length prefix as the TCP framing
+  uint32_t len = (uint32_t)payload.size();
+  char hdr[4] = {(char)(len & 0xFF), (char)((len >> 8) & 0xFF),
+                 (char)((len >> 16) & 0xFF), (char)((len >> 24) & 0xFF)};
+  std::lock_guard<std::mutex> lk(r->wmu);
+  sm_flush_pending_locked(r);
+  if (tl_sm_poll_thread) {
+    // the poll thread NEVER blocks here (deadlock analysis above):
+    // whatever does not fit spills behind any existing backlog
+    if (r->pending.empty()) {
+      size_t put = sm_write_avail(r, hdr, 4);
+      if (put == 4) {
+        size_t put2 =
+            sm_write_avail(r, payload.data(), payload.size());
+        if (put2 < payload.size())
+          r->pending.append(payload, put2, std::string::npos);
+        return MPI_SUCCESS;
+      }
+      r->pending.append(hdr + put, 4 - put);
+      r->pending += payload;
+      return MPI_SUCCESS;
+    }
+    r->pending.append(hdr, 4);
+    r->pending += payload;
+    return MPI_SUCCESS;
+  }
+  // app threads drain the spill first (order), then block as needed
+  while (!r->pending.empty()) {
+    sm_flush_pending_locked(r);
+    if (r->pending.empty()) break;
+    if (g.closing.load()) return MPI_ERR_OTHER;
+    std::this_thread::sleep_for(std::chrono::microseconds(20));
+  }
+  int rc = sm_write_bytes(r, hdr, 4);
+  if (rc != MPI_SUCCESS) return rc;
+  return sm_write_bytes(r, payload.data(), payload.size());
+}
+
+// drain whatever the producer published; dispatch completed frames
+bool sm_poll_ring(SmRing *r) {
+  uint64_t head = r->hdr->head.load(std::memory_order_acquire);
+  uint64_t tail = r->hdr->tail.load(std::memory_order_relaxed);
+  if (head == tail) return false;
+  size_t n = (size_t)(head - tail);
+  size_t off = (size_t)(tail % SM_RING_BYTES);
+  size_t first = n < SM_RING_BYTES - off ? n : SM_RING_BYTES - off;
+  r->rbuf.append(r->data + off, first);
+  r->rbuf.append(r->data, n - first);
+  r->hdr->tail.store(head, std::memory_order_release);
+  size_t pos = 0;
+  while (r->rbuf.size() - pos >= 4) {
+    const unsigned char *b = (const unsigned char *)r->rbuf.data() + pos;
+    uint32_t len = (uint32_t)b[0] | ((uint32_t)b[1] << 8) |
+                   ((uint32_t)b[2] << 16) | ((uint32_t)b[3] << 24);
+    if (r->rbuf.size() - pos - 4 < len) break;
+    dispatch_frame(r->rbuf.substr(pos + 4, len));
+    pos += 4 + (size_t)len;
+  }
+  r->rbuf.erase(0, pos);
+  return true;
+}
+
+void sm_poll_loop() {
+  tl_sm_poll_thread = true;
+  auto last_active = std::chrono::steady_clock::now();
+  auto last_pending = last_active;
+  while (!g.closing.load()) {
+    bool any = false;
+    for (auto &r : g_sm_in) any |= sm_poll_ring(r.get());
+    // drain outbound spills (frames the dispatch path could not fit)
+    for (auto &e : g_sm_out) {
+      SmRing *r = e.second.get();
+      if (r->pending.empty()) continue;
+      std::lock_guard<std::mutex> lk(r->wmu);
+      sm_flush_pending_locked(r);
+      any = true;
+    }
+    auto now = std::chrono::steady_clock::now();
+    // late inbound rings (peer activated after our init window)
+    if (now - last_pending > std::chrono::milliseconds(100)) {
+      last_pending = now;
+      std::lock_guard<std::mutex> lk(g_sm_pending_mu);
+      for (auto it = g_sm_pending.begin(); it != g_sm_pending.end();) {
+        auto r = std::make_unique<SmRing>();
+        if (sm_map(it->second, false, *r)) {
+          r->src = it->first;
+          g_sm_in.push_back(std::move(r));
+          it = g_sm_pending.erase(it);
+        } else {
+          ++it;
+        }
+      }
+    }
+    if (any) {
+      last_active = now;
+      continue;
+    }
+    // stay HOT for a generous window after traffic: a ping-pong's
+    // inter-arrival gap is a full RTT, and dozing inside it puts the
+    // sleep latency ON the critical path of every message (measured:
+    // a 200us window turned 2us rings into 208us).  Escalate only
+    // through genuinely idle phases.
+    auto idle = now - last_active;
+    if (idle < std::chrono::milliseconds(20)) {
+      // hot, but YIELD: a hard spin on a shared host steals the app
+      // thread's core and puts a scheduler quantum (~ms) on every
+      // message (measured both ways: hard spin 3.6ms, 100us dozes
+      // 208us; yield keeps the poll sub-10us hot without starving)
+      sched_yield();
+      continue;
+    }
+    if (idle < std::chrono::milliseconds(200))
+      std::this_thread::sleep_for(std::chrono::microseconds(20));
+    else
+      std::this_thread::sleep_for(std::chrono::microseconds(200));
+  }
+}
+
+// init-time cohort wiring; returns only after outbound rings exist and
+// inbound rings were awaited (missing ones go to the pending list)
+void sm_setup(int cohort_base, int cohort_size) {
+  // hardware-aware default (the component-selection policy the
+  // reference's MCA priorities exist for): the ring's polling thread
+  // pays a scheduler quantum per handoff when there is only ONE core
+  // (measured on this host: small messages 2x faster, 256 KB 5x
+  // slower), so single-core hosts keep the kernel-blocking TCP path.
+  // ZMPI_MCA_sm=1 forces the rings on, =0 forces them off; both
+  // sides decide independently and asymmetric choices degrade safely
+  // to TCP (activation requires the peer's mapped ring).
+  if (!sm_enabled()) return;
+  double wait_s = 5.0;
+  if (const char *w = getenv("ZMPI_MCA_sm_wait"))
+    if (w[0]) wait_s = atof(w);
+  std::vector<int> peers;
+  for (int j = cohort_base; j < cohort_base + cohort_size; j++) {
+    if (j == g.rank || j >= (int)g.book.size()) continue;
+    if (j >= (int)g.caps.size() ||
+        g.caps[(size_t)j].find("sm") == std::string::npos)
+      continue;
+    if (g.book[(size_t)j].first != g.host) continue;  // other host
+    auto r = std::make_unique<SmRing>();
+    if (sm_map(sm_ring_path(g.rank, j), true, *r))
+      g_sm_out[j] = std::move(r);  // activated after namespace proof
+    peers.push_back(j);
+  }
+  auto deadline = std::chrono::steady_clock::now() +
+                  std::chrono::duration<double>(wait_s);
+  for (int j : peers) {
+    bool mapped = false;
+    while (std::chrono::steady_clock::now() < deadline) {
+      auto r = std::make_unique<SmRing>();
+      if (sm_map(sm_ring_path(j, g.rank), false, *r)) {
+        r->src = j;
+        g_sm_in.push_back(std::move(r));
+        mapped = true;
+        break;
+      }
+      std::this_thread::sleep_for(std::chrono::milliseconds(2));
+    }
+    if (!mapped) {
+      // shared namespace unproven: never WRITE to this peer via sm,
+      // but keep looking for its ring so its frames are never lost
+      auto oit = g_sm_out.find(j);
+      if (oit != g_sm_out.end()) {
+        sm_release(*oit->second);  // unmap AND unlink the orphan file
+        g_sm_out.erase(oit);
+      }
+      std::lock_guard<std::mutex> lk(g_sm_pending_mu);
+      g_sm_pending.push_back({j, sm_ring_path(j, g.rank)});
+    }
+  }
+  if (!g_sm_out.empty() || !g_sm_in.empty() || !g_sm_pending.empty()) {
+    g_sm_poll = std::thread(sm_poll_loop);
+    g_sm_poll_up.store(true);
+  }
+}
+
+void sm_teardown() {
+  if (g_sm_poll_up.load()) {
+    if (g_sm_poll.joinable()) g_sm_poll.join();  // closing already set
+    g_sm_poll_up.store(false);
+  }
+  for (auto &e : g_sm_out) sm_release(*e.second);
+  g_sm_out.clear();
+  for (auto &r : g_sm_in) sm_release(*r);
+  g_sm_in.clear();
+  {
+    std::lock_guard<std::mutex> lk(g_sm_pending_mu);
+    g_sm_pending.clear();
+  }
+}
+
+SmRing *sm_ring_to(int dest) {
+  auto it = g_sm_out.find(dest);
+  return it == g_sm_out.end() ? nullptr : it->second.get();
+}
 
 // fill a posted request from an arriving/unexpected message.
 // match_mu must be held.
@@ -790,6 +1167,7 @@ void start_bulk_drain(int fd) {
 }
 
 int endpoint(int dest);
+int peer_send_frame(int dest, const std::string &payload);
 
 // rendezvous constants — wire-identical to pt2pt/tcp.py:62-66
 constexpr int64_t RNDV_DATA_CID = 0x7FF9;
@@ -809,8 +1187,6 @@ void handle_win_frame(int64_t src, const DssVal &t);
 // AFTER match_mu is released by the claiming path.
 void send_cts(int64_t sender, int64_t rndv_id) {
   if (g.closing.load()) return;
-  int fd = endpoint((int)sender);
-  if (fd < 0) return;  // peer unreachable: sender errors/hangs, job-level
   std::string cts;
   put_varint(cts, 5);
   put_int(cts, g.rank);
@@ -818,8 +1194,7 @@ void send_cts(int64_t sender, int64_t rndv_id) {
   put_int(cts, RNDV_CTS_CID);
   put_int(cts, g.seq++);
   put_bytes(cts, "", 0);
-  std::lock_guard<std::mutex> lk(g.send_mu);
-  send_frame(fd, cts);
+  peer_send_frame((int)sender, cts);
   // NOTE: a sender dying AFTER this CTS (bulk connect/push failure)
   // leaves the claimed receive parked — the peer-death-without-fault-
   // tolerance class, surfaced on the sender as an error; job-level
@@ -888,51 +1263,57 @@ void land_rndv_data(Message &&m, int64_t rid) {
   push_message(std::move(m));
 }
 
+// one inbound frame, from EITHER transport (TCP drains and the sm
+// poll loop feed the identical dispatch)
+void dispatch_frame(const std::string &frame) {
+  std::vector<DssVal> vals;
+  if (!parse_all(frame, vals) || vals.size() != 5) return;
+  if (vals[4].tag == T_TUPLE && vals[4].items.size() == 4 &&
+      vals[4].items[0].tag == T_STR && vals[4].items[0].s == RTS_MARK) {
+    answer_rts(vals);
+    return;
+  }
+  if (vals[2].i == WIN_CID && vals[4].tag == T_TUPLE) {
+    handle_win_frame(vals[0].i, vals[4]);
+    return;
+  }
+  Message m;
+  m.src = vals[0].i;
+  m.tag = vals[1].i;
+  m.cid = vals[2].i;
+  m.seq = vals[3].i;
+  if (vals[4].tag == T_NDARRAY) {
+    m.dt = vals[4].dt;
+    m.data = vals[4].data;
+  } else if (vals[4].tag == T_BYTES || vals[4].tag == T_STR) {
+    m.data = vals[4].s;
+  }
+  if (m.cid == RNDV_DATA_CID) {
+    // bulk data of an announced transfer: re-frame under the envelope
+    // the RTS carried, then land it on the placeholder/claimed recv
+    int64_t rid = m.tag;
+    std::array<int64_t, 3> env;
+    {
+      std::lock_guard<std::mutex> lk(g.rndv_mu);
+      auto it = g.rndv_in.find({m.src, rid});
+      if (it == g.rndv_in.end()) return;  // unannounced: drop
+      env = it->second;
+      g.rndv_in.erase(it);
+    }
+    m.tag = env[0];
+    m.cid = env[1];
+    m.seq = env[2];
+    land_rndv_data(std::move(m), rid);
+    return;
+  }
+  push_message(std::move(m));
+}
+
 void drain_loop(int fd) {
   std::string frame;
   while (!g.closing.load()) {
     if (!recv_frame(fd, frame)) return;
-    std::vector<DssVal> vals;
-    if (!parse_all(frame, vals) || vals.size() != 5) continue;
-    if (vals[4].tag == T_TUPLE && vals[4].items.size() == 4 &&
-        vals[4].items[0].tag == T_STR && vals[4].items[0].s == RTS_MARK) {
-      answer_rts(vals);
-      continue;
-    }
-    if (vals[2].i == WIN_CID && vals[4].tag == T_TUPLE) {
-      handle_win_frame(vals[0].i, vals[4]);
-      continue;
-    }
-    Message m;
-    m.src = vals[0].i;
-    m.tag = vals[1].i;
-    m.cid = vals[2].i;
-    m.seq = vals[3].i;
-    if (vals[4].tag == T_NDARRAY) {
-      m.dt = vals[4].dt;
-      m.data = vals[4].data;
-    } else if (vals[4].tag == T_BYTES || vals[4].tag == T_STR) {
-      m.data = vals[4].s;
-    }
-    if (m.cid == RNDV_DATA_CID) {
-      // bulk data of an announced transfer: re-frame under the envelope
-      // the RTS carried, then land it on the placeholder/claimed recv
-      int64_t rid = m.tag;
-      std::array<int64_t, 3> env;
-      {
-        std::lock_guard<std::mutex> lk(g.rndv_mu);
-        auto it = g.rndv_in.find({m.src, rid});
-        if (it == g.rndv_in.end()) continue;  // unannounced: drop
-        env = it->second;
-        g.rndv_in.erase(it);
-      }
-      m.tag = env[0];
-      m.cid = env[1];
-      m.seq = env[2];
-      land_rndv_data(std::move(m), rid);
-      continue;
-    }
-    push_message(std::move(m));
+    dispatch_frame(frame);
   }
 }
 
@@ -988,6 +1369,19 @@ int endpoint(int dest) {
   return fd;
 }
 
+// ONE main-channel frame to a peer: the sm ring when the direction is
+// activated (entire channel, preserving per-direction FIFO), else the
+// TCP endpoint under the global send lock.  Every main-channel
+// producer routes through here — mixing transports per direction
+// would break the matching order.
+int peer_send_frame(int dest, const std::string &payload) {
+  if (SmRing *r = sm_ring_to(dest)) return sm_send_frame(r, payload);
+  int fd = endpoint(dest);
+  if (fd < 0) return MPI_ERR_OTHER;
+  std::lock_guard<std::mutex> lk(g.send_mu);
+  return send_frame(fd, payload) ? MPI_SUCCESS : MPI_ERR_OTHER;
+}
+
 // RTS/CTS rendezvous send (pml_ob1_sendreq.c:768's protocol, the wire
 // shape of TcpProc._send_rndv), split in two so MPI_Isend can put the
 // ANNOUNCE on the wire from the calling thread — the RTS's position on
@@ -1017,8 +1411,6 @@ int rndv_announce(size_t count, const DtInfo &di, int dest, int64_t tag,
     delete r;
     return MPI_ERR_OTHER;
   };
-  int fd = endpoint(dest);
-  if (fd < 0) return abort_cts();
   std::string rts;
   put_varint(rts, 5);
   put_int(rts, g.rank);
@@ -1031,10 +1423,7 @@ int rndv_announce(size_t count, const DtInfo &di, int dest, int64_t tag,
   put_int(rts, g.rank);
   put_int(rts, rid);
   put_int(rts, (int64_t)(count * di.item));
-  {
-    std::lock_guard<std::mutex> lk(g.send_mu);
-    if (!send_frame(fd, rts)) return abort_cts();
-  }
+  if (peer_send_frame(dest, rts) != MPI_SUCCESS) return abort_cts();
   rid_out = rid;
   handle_out = handle;
   return MPI_SUCCESS;
@@ -1118,16 +1507,20 @@ int wire_send_rndv(const void *buf, size_t count, const DtInfo &di,
 // DSS reply carrying an address book (the modex coordinator's answer,
 // shared by MPI_Init's rank-0 coordinator and the spawn coordinator)
 std::string pack_address_book(
-    const std::vector<std::pair<std::string, int>> &book) {
+    const std::vector<std::pair<std::string, int>> &book,
+    const std::vector<std::string> *caps = nullptr) {
   std::string reply;
   put_varint(reply, 1);
   reply.push_back((char)T_LIST);
   put_varint(reply, book.size());
-  for (auto &e : book) {
+  for (size_t i = 0; i < book.size(); i++) {
+    const std::string cap =
+        caps && i < caps->size() ? (*caps)[i] : std::string();
     reply.push_back((char)T_LIST);
-    put_varint(reply, 2);
-    put_str(reply, e.first);
-    put_int(reply, e.second);
+    put_varint(reply, cap.empty() ? 2 : 3);
+    put_str(reply, book[i].first);
+    put_int(reply, book[i].second);
+    if (!cap.empty()) put_str(reply, cap);
   }
   return reply;
 }
@@ -1187,8 +1580,6 @@ int wire_send(const void *buf, size_t count, const DtInfo &di, int dest,
     }
     return rc;
   }
-  int fd = endpoint(dest);
-  if (fd < 0) return MPI_ERR_OTHER;
   std::string payload;
   put_varint(payload, 5);
   put_int(payload, g.rank);
@@ -1196,8 +1587,8 @@ int wire_send(const void *buf, size_t count, const DtInfo &di, int dest,
   put_int(payload, cid);
   put_int(payload, g.seq++);
   put_ndarray_1d(payload, di.tag, buf, count, di.item);
-  std::lock_guard<std::mutex> lk(g.send_mu);
-  if (!send_frame(fd, payload)) return MPI_ERR_OTHER;
+  if (peer_send_frame(dest, payload) != MPI_SUCCESS)
+    return MPI_ERR_OTHER;
   g.ctr_eager_sends.fetch_add(1, std::memory_order_relaxed);
   g.ctr_bytes_sent.fetch_add((long long)(count * di.item),
                              std::memory_order_relaxed);
@@ -1558,8 +1949,6 @@ std::atomic<int64_t> g_next_reply_tag{1};
 // dispatched by cid+tuple, never matched)
 int win_send_tuple(int dest_world, const std::string &tuple_payload) {
   if (dest_world == g.rank) return MPI_ERR_OTHER;  // caller handles self
-  int fd = endpoint(dest_world);
-  if (fd < 0) return MPI_ERR_OTHER;
   std::string f;
   put_varint(f, 5);
   put_int(f, g.rank);
@@ -1567,15 +1956,12 @@ int win_send_tuple(int dest_world, const std::string &tuple_payload) {
   put_int(f, WIN_CID);
   put_int(f, g.seq++);
   f += tuple_payload;
-  std::lock_guard<std::mutex> lk(g.send_mu);
-  return send_frame(fd, f) ? MPI_SUCCESS : MPI_ERR_OTHER;
+  return peer_send_frame(dest_world, f);
 }
 
 void win_reply(int64_t origin, int64_t reply_tag, const void *data,
                size_t nbytes) {
   if (origin == g.rank) return;
-  int fd = endpoint((int)origin);
-  if (fd < 0) return;
   std::string f;
   put_varint(f, 5);
   put_int(f, g.rank);
@@ -1583,8 +1969,7 @@ void win_reply(int64_t origin, int64_t reply_tag, const void *data,
   put_int(f, WIN_CID);
   put_int(f, g.seq++);
   put_ndarray_1d(f, "|u1", data, nbytes, 1);
-  std::lock_guard<std::mutex> lk(g.send_mu);
-  send_frame(fd, f);
+  peer_send_frame((int)origin, f);
 }
 
 // The one lock-release path (wunlock wire handler AND the self-target
@@ -1779,8 +2164,6 @@ int send_barrier_signal(CommObj &c, int dest_world) {
     push_message(std::move(m));
     return MPI_SUCCESS;
   }
-  int fd = endpoint(dest_world);
-  if (fd < 0) return MPI_ERR_OTHER;
   std::string payload;
   put_varint(payload, 5);
   put_int(payload, g.rank);
@@ -1788,8 +2171,7 @@ int send_barrier_signal(CommObj &c, int dest_world) {
   put_int(payload, c.cid_bar);
   put_int(payload, g.seq++);
   put_bytes(payload, "", 0);
-  std::lock_guard<std::mutex> lk(g.send_mu);
-  return send_frame(fd, payload) ? MPI_SUCCESS : MPI_ERR_OTHER;
+  return peer_send_frame(dest_world, payload);
 }
 
 int c_barrier(CommObj &c) {
@@ -2487,7 +2869,9 @@ int MPI_Init(int *, char ***) {
     if (bind(srv, (sockaddr *)&ca, sizeof ca) != 0) return MPI_ERR_OTHER;
     listen(srv, g.size + 4);
     g.book.assign(g.size, {"", 0});
+    g.caps.assign(g.size, "");
     g.book[0] = {g.host, g.listen_port};
+    if (sm_enabled()) g.caps[0] = "sm";
     std::vector<int> peers;
     for (int i = 0; i < g.size - 1; i++) {
       int c = accept(srv, nullptr, nullptr);
@@ -2496,10 +2880,14 @@ int MPI_Init(int *, char ***) {
       std::vector<DssVal> vals;
       if (!parse_all(f, vals) || vals.size() != 2) return MPI_ERR_OTHER;
       int peer = (int)vals[0].i;
+      if (vals[1].items.size() < 2) return MPI_ERR_OTHER;
       g.book[peer] = {vals[1].items[0].s, (int)vals[1].items[1].i};
+      // optional third card item: capability string (Python ranks
+      // send 2-item cards and get "" — never routed to rings)
+      if (vals[1].items.size() >= 3) g.caps[peer] = vals[1].items[2].s;
       peers.push_back(c);
     }
-    std::string reply = pack_address_book(g.book);
+    std::string reply = pack_address_book(g.book, &g.caps);
     for (int c : peers) {
       send_frame(c, reply);
       close(c);
@@ -2512,9 +2900,11 @@ int MPI_Init(int *, char ***) {
     put_varint(f, 2);
     put_int(f, g.rank);
     f.push_back((char)T_LIST);
-    put_varint(f, 2);
+    bool sm = sm_enabled();
+    put_varint(f, sm ? 3 : 2);
     put_str(f, g.host);
     put_int(f, g.listen_port);
+    if (sm) put_str(f, "sm");  // this rank maps same-host rings
     if (!send_frame(c, f)) return MPI_ERR_OTHER;
     std::string reply;
     if (!recv_frame(c, reply)) return MPI_ERR_OTHER;
@@ -2522,14 +2912,21 @@ int MPI_Init(int *, char ***) {
     std::vector<DssVal> vals;
     if (!parse_all(reply, vals) || vals.size() != 1) return MPI_ERR_OTHER;
     g.book.clear();
-    for (auto &e : vals[0].items)
+    g.caps.clear();
+    for (auto &e : vals[0].items) {
+      if (e.items.size() < 2) return MPI_ERR_OTHER;
       g.book.push_back({e.items[0].s, (int)e.items[1].i});
+      g.caps.push_back(e.items.size() >= 3 ? e.items[2].s
+                                           : std::string());
+    }
   }
 
   // endpoint() reads g.book unlocked from several threads; reserving
   // once caps the universe (init ranks + spawned children) at BOOK_CAP
   // and guarantees spawn's push_back never reallocates under a reader
   g.book.reserve(Shim::BOOK_CAP);
+  g.caps.resize(g.book.size(), "");
+  g.caps.reserve(Shim::BOOK_CAP);
 
   // predefined communicators.  WORLD keeps the round-3 wire cids for
   // Python interop; SELF's context never leaves the process.
@@ -2568,6 +2965,18 @@ int MPI_Init(int *, char ***) {
   self.cid_coll = 0x7F01;
   self.cid_bar = 0x7F02;
   g_comms[MPI_COMM_SELF] = self;
+
+  // same-host shared-memory transport for this init cohort (the
+  // contiguous WORLD block that initialized together; spawn joins
+  // stay TCP — see the sm design block)
+  {
+    int cohort_base = 0, cohort_size = g.size;
+    if (wb && wb[0]) {
+      cohort_base = atoi(wb);
+      cohort_size = atoi(getenv("ZMPI_WORLD_SIZE"));
+    }
+    sm_setup(cohort_base, cohort_size);
+  }
 
   g.initialized = true;
   return MPI_SUCCESS;
@@ -2660,6 +3069,7 @@ int MPI_Finalize(void) {
     fprintf(stderr,
             "zompi: warning: bulk-data drains still closing at "
             "MPI_Finalize exit\n");
+  sm_teardown();  // poll thread saw g.closing; unmap + unlink rings
   {
     std::lock_guard<std::mutex> lk(g.conn_mu);
     g.conns.clear();
@@ -7060,6 +7470,7 @@ static int spawn_impl(int count, const char *commands[], char ***argvs,
       // modex connect (crash before MPI_Init) turns into an agreed
       // failure rather than an accept() that waits forever.
       std::vector<std::pair<std::string, int>> kids(maxprocs, {"", 0});
+      std::vector<std::string> kidcaps((size_t)maxprocs, "");
       std::vector<int> conns;
       bool modex_ok = true;
       for (int i = 0; i < maxprocs && modex_ok; i++) {
@@ -7089,15 +7500,18 @@ static int spawn_impl(int count, const char *commands[], char ***argvs,
         std::vector<DssVal> vals;
         if (!recv_frame(fd, f) || !parse_all(f, vals) ||
             vals.size() != 2 || vals[1].tag != T_LIST ||
-            vals[1].items.size() != 2 || vals[1].items[0].tag != T_STR ||
+            vals[1].items.size() < 2 || vals[1].items[0].tag != T_STR ||
             vals[1].items[1].tag != T_INT) {
           close(fd);
           modex_ok = false;
           break;
         }
         int kr = (int)vals[0].i - base;
-        if (kr >= 0 && kr < maxprocs)
+        if (kr >= 0 && kr < maxprocs) {
           kids[kr] = {vals[1].items[0].s, (int)vals[1].items[1].i};
+          if (vals[1].items.size() >= 3)
+            kidcaps[(size_t)kr] = vals[1].items[2].s;  // sibling sm
+        }
         conns.push_back(fd);
       }
       if (!modex_ok) {
@@ -7106,8 +7520,13 @@ static int spawn_impl(int count, const char *commands[], char ***argvs,
         goto root_done;
       }
       auto book = g.book;
-      for (auto &k : kids) book.push_back(k);
-      std::string reply = pack_address_book(book);
+      auto caps = g.caps;
+      caps.resize(book.size(), "");
+      for (size_t k = 0; k < kids.size(); k++) {
+        book.push_back(kids[k]);
+        caps.push_back(kidcaps[k]);  // siblings ring each other
+      }
+      std::string reply = pack_address_book(book, &caps);
       for (int fd : conns) {
         send_frame(fd, reply);
         close(fd);
@@ -7115,7 +7534,10 @@ static int spawn_impl(int count, const char *commands[], char ***argvs,
       close(srv);
       // the ROOT extends its own book here; every other participant
       // extends from the broadcast below
-      for (auto &k : kids) g.book.push_back(k);
+      for (auto &k : kids) {
+        g.book.push_back(k);
+        g.caps.push_back("");  // cross-cohort stays TCP (see sm design)
+      }
       hdr[0] = maxprocs;
       hdr[1] = scid;
       hdr[2] = base;
@@ -7148,6 +7570,7 @@ root_done:
       size_t colon = entry.rfind(':');
       g.book.push_back({entry.substr(0, colon),
                         atoi(entry.c_str() + colon + 1)});
+      g.caps.push_back("");  // cross-cohort stays TCP
     }
   }
   // the spawn intercommunicator: local = the spawn comm, remote = kids
@@ -8462,8 +8885,6 @@ int pscw_notify(int tw, int64_t tag) {
     push_message(std::move(m));
     return MPI_SUCCESS;
   }
-  int fd = endpoint(tw);
-  if (fd < 0) return MPI_ERR_OTHER;
   std::string f;
   put_varint(f, 5);
   put_int(f, g.rank);
@@ -8471,8 +8892,7 @@ int pscw_notify(int tw, int64_t tag) {
   put_int(f, WIN_CID);
   put_int(f, g.seq++);
   put_bytes(f, "", 0);
-  std::lock_guard<std::mutex> lk(g.send_mu);
-  return send_frame(fd, f) ? MPI_SUCCESS : MPI_ERR_OTHER;
+  return peer_send_frame(tw, f);
 }
 
 int pscw_await(int from_world, int64_t tag) {

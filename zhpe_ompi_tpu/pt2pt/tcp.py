@@ -201,7 +201,10 @@ class TcpProc(errh.HasErrhandler, HostCollectives,
                 _send_frame(conn, payload)
                 conn.close()
             srv.close()
-            return [tuple(a) for a in book]
+            # the RELAYED book keeps every card verbatim (C peers read
+            # capability items); the LOCAL book normalizes to
+            # (host, port) — Python consumers address sockets only
+            return [tuple(a[:2]) for a in book]
         cli = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         cli.settimeout(timeout)
         deadline_err = None
@@ -233,7 +236,9 @@ class TcpProc(errh.HasErrhandler, HostCollectives,
         _send_frame(cli, dss.pack(self.rank, list(self.address)))
         [book] = dss.unpack(_recv_frame(cli))
         cli.close()
-        return [tuple(a) for a in book]
+        # normalize at the boundary: C ranks' cards may carry extra
+        # capability items beyond (host, port)
+        return [tuple(a[:2]) for a in book]
 
     def _accept_loop(self) -> None:
         while not self._closed.is_set():
@@ -313,8 +318,11 @@ class TcpProc(errh.HasErrhandler, HostCollectives,
             sock = self._conns.get(dest)
         if sock is not None:
             return sock
-        # lazy connection establishment (btl_tcp_endpoint shape)
-        addr = self.address_book[dest]
+        # lazy connection establishment (btl_tcp_endpoint shape).
+        # Cards may carry extra capability items beyond (host, port) —
+        # C ranks advertise their shared-memory transport there — so
+        # the connect address is always the 2-prefix.
+        addr = tuple(self.address_book[dest][:2])
         sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         sock.settimeout(self._timeout)
         sock.connect(addr)
@@ -439,7 +447,7 @@ class TcpProc(errh.HasErrhandler, HostCollectives,
                     socket.AF_INET, socket.SOCK_STREAM
                 )
                 data_sock.settimeout(self._timeout)
-                data_sock.connect(tuple(self.address_book[dest]))
+                data_sock.connect(tuple(self.address_book[dest][:2]))
                 _send_frame(data_sock, dss.pack(["d"]))
                 _send_frame(data_sock, frame)
             except OSError as e:
