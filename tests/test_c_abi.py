@@ -314,6 +314,17 @@ int main(int argc, char **argv) {
         assert p.returncode == 0, f"parent failed: {err}\n{out}"
         assert "spawn_multiple OK" in out
 
+    def test_sm_soak(self, shim, tmp_path_factory):
+        """Mixed concurrent traffic over the rings: overlapping
+        nonblocking allreduces, a random-size pt2pt ring mixing eager
+        and rendezvous payloads, and lock/accumulate RMA, 60
+        iterations x 3 ranks — the race soak for the sm transport."""
+        outs = _run_example(shim, tmp_path_factory, "smsoak_c.c", 3,
+                            timeout=240)
+        # the example takes the iteration count as argv[1]; the
+        # compiled default (100) applies under _run_example
+        assert "smsoak OK" in outs[0]
+
     @pytest.mark.parametrize("n", [2, 3])
     def test_crossed_large_gets_over_sm(self, shim, tmp_path_factory,
                                         n):
