@@ -259,6 +259,43 @@ int main(int argc, char **argv) {
     MPI_Type_free(&cx);
   }
 
+  /* ---- MINLOC/MAXLOC over pair types ---- */
+  {
+    struct { double v; int i; } din, dout;
+    din.v = (rank == 1) ? -3.5 : rank * 2.0 + 1.0; /* rank 1 wins min */
+    din.i = rank;
+    CHECK(MPI_Allreduce(&din, &dout, 1, MPI_DOUBLE_INT, MPI_MINLOC,
+                        MPI_COMM_WORLD) == MPI_SUCCESS);
+    CHECK(dout.v == -3.5 && dout.i == 1);
+    struct { int v; int i; } iin, iout;
+    iin.v = 100; /* all tie: MAXLOC takes the LOWEST index */
+    iin.i = rank;
+    CHECK(MPI_Allreduce(&iin, &iout, 1, MPI_2INT, MPI_MAXLOC,
+                        MPI_COMM_WORLD) == MPI_SUCCESS);
+    CHECK(iout.v == 100 && iout.i == 0);
+    int cf = -1;
+    CHECK(MPI_Op_commutative(MPI_MINLOC, &cf) == MPI_SUCCESS &&
+          cf == 1);
+    /* typemap size vs padded extent (type_size.c: 12 vs 16) */
+    int psz = -1;
+    long plb = -1, pext = -1;
+    CHECK(MPI_Type_size(MPI_DOUBLE_INT, &psz) == MPI_SUCCESS &&
+          psz == 12);
+    CHECK(MPI_Type_get_extent(MPI_DOUBLE_INT, &plb, &pext) ==
+          MPI_SUCCESS && pext == 16);
+    /* pair types have no canonical external32 order */
+    char pbuf[64];
+    MPI_Aint ppos = 0, pes = -1;
+    CHECK(MPI_Pack_external("external32", &din, 1, MPI_DOUBLE_INT, pbuf,
+                            64, &ppos) == MPI_ERR_TYPE);
+    CHECK(MPI_Pack_external_size("external32", 1, MPI_DOUBLE_INT,
+                                 &pes) == MPI_ERR_TYPE);
+    /* loc ops demand a pair type */
+    double plain = 1.0, pout = 0.0;
+    CHECK(MPI_Reduce_local(&plain, &pout, 1, MPI_DOUBLE, MPI_MINLOC) ==
+          MPI_ERR_TYPE);
+  }
+
   /* ---- generalized requests ---- */
   {
     int state = 0;

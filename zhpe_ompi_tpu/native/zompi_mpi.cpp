@@ -322,8 +322,19 @@ bool base_dtinfo(MPI_Datatype dt, DtInfo &out) {
     case MPI_UNSIGNED_SHORT: out = {"<u2", 2}; return true;
     case MPI_UNSIGNED:       out = {"<u4", 4}; return true;
     case MPI_UNSIGNED_LONG:  out = {"<u8", 8}; return true;
+    // MINLOC/MAXLOC pair types: opaque fixed-size records on the wire
+    // (C struct layouts, padding included — op.h's ompi_op pair reds)
+    case MPI_2INT:           out = {"|V8", 8}; return true;
+    case MPI_FLOAT_INT:      out = {"|V8", 8}; return true;
+    case MPI_DOUBLE_INT:     out = {"|V16", 16}; return true;
+    case MPI_LONG_INT:       out = {"|V16", 16}; return true;
+    case MPI_SHORT_INT:      out = {"|V8", 8}; return true;
   }
   return false;
+}
+
+bool is_pair_dtype(MPI_Datatype dt) {
+  return dt >= MPI_2INT && dt <= MPI_SHORT_INT;
 }
 
 // Derived typemap: blocks of base elements within one extent, the
@@ -1815,6 +1826,49 @@ struct UserOp {
 std::map<MPI_Op, UserOp> g_user_ops;
 MPI_Op g_next_op = 0x20;
 
+// MINLOC/MAXLOC over (value, index) pair structs: winner by value,
+// ties broken by the LOWER index (MPI-3.1 §5.9.4)
+template <typename Pair>
+void reduce_loc(Pair *acc, const Pair *in, int n, bool maxloc) {
+  for (int i = 0; i < n; i++) {
+    bool take = maxloc ? in[i].v > acc[i].v : in[i].v < acc[i].v;
+    if (in[i].v == acc[i].v) take = in[i].i < acc[i].i;
+    if (take) acc[i] = in[i];
+  }
+}
+
+struct PairFloatInt { float v; int i; };
+struct PairDoubleInt { double v; int i; };
+struct PairLongInt { long v; int i; };
+struct PairShortInt { short v; int i; };
+struct Pair2Int { int v; int i; };
+
+int reduce_loc_buf(void *acc, const void *in, int n, MPI_Datatype dt,
+                   bool maxloc) {
+  switch (dt) {
+    case MPI_2INT:
+      reduce_loc((Pair2Int *)acc, (const Pair2Int *)in, n, maxloc);
+      return MPI_SUCCESS;
+    case MPI_FLOAT_INT:
+      reduce_loc((PairFloatInt *)acc, (const PairFloatInt *)in,
+                        n, maxloc);
+      return MPI_SUCCESS;
+    case MPI_DOUBLE_INT:
+      reduce_loc((PairDoubleInt *)acc, (const PairDoubleInt *)in,
+                         n, maxloc);
+      return MPI_SUCCESS;
+    case MPI_LONG_INT:
+      reduce_loc((PairLongInt *)acc, (const PairLongInt *)in, n,
+                       maxloc);
+      return MPI_SUCCESS;
+    case MPI_SHORT_INT:
+      reduce_loc((PairShortInt *)acc, (const PairShortInt *)in,
+                        n, maxloc);
+      return MPI_SUCCESS;
+  }
+  return MPI_ERR_TYPE;  // MINLOC/MAXLOC require a pair type
+}
+
 // acc = acc ⊕ in elementwise, acc as the LEFT operand (rank order is
 // the caller's responsibility; op.h:547-605's in-order contract)
 int reduce_buf(void *acc, const void *in, int n, MPI_Datatype dt,
@@ -1828,6 +1882,8 @@ int reduce_buf(void *acc, const void *in, int n, MPI_Datatype dt,
     return MPI_SUCCESS;
   }
   if (op == MPI_NO_OP) return MPI_SUCCESS;
+  if (op == MPI_MINLOC || op == MPI_MAXLOC)
+    return reduce_loc_buf(acc, in, n, dt, op == MPI_MAXLOC);
   auto uit = g_user_ops.find(op);
   if (uit != g_user_ops.end()) {
     // MPI user fn computes inoutvec = invec ∘ inoutvec (invec LEFT);
@@ -4404,6 +4460,13 @@ int MPI_Type_size(MPI_Datatype datatype, int *size) {
     return MPI_SUCCESS;
   }
   if (!resolve_dtype(datatype, v)) return MPI_ERR_TYPE;
+  // pair types: the TYPEMAP size (value + int), not the padded extent
+  // (type_size.c: MPI_DOUBLE_INT is 12, its extent 16)
+  switch (datatype) {
+    case MPI_DOUBLE_INT: *size = 12; return MPI_SUCCESS;
+    case MPI_LONG_INT:   *size = 12; return MPI_SUCCESS;
+    case MPI_SHORT_INT:  *size = 6; return MPI_SUCCESS;
+  }
   *size = (int)v.di.item;
   return MPI_SUCCESS;
 }
@@ -8380,6 +8443,17 @@ int MPI_Accumulate(const void *origin_addr, int origin_count,
   DtView tv;
   if (!resolve_dtype(target_datatype, tv)) return MPI_ERR_TYPE;
   if (!tv.contiguous()) return MPI_ERR_TYPE;  // see MPI_Put
+  {
+    // the op/dtype pairing must fail at the ORIGIN: the remote apply
+    // is fire-and-forget, so a target-side reduce_buf error would
+    // otherwise vanish (pair types take only MINLOC/MAXLOC/REPLACE)
+    MPI_Datatype base = tv.derived ? tv.derived->base : target_datatype;
+    bool pair = is_pair_dtype(base);
+    bool loc_op = op == MPI_MINLOC || op == MPI_MAXLOC;
+    if (pair && !loc_op && op != MPI_REPLACE && op != MPI_NO_OP)
+      return MPI_ERR_OP;
+    if (!pair && loc_op) return MPI_ERR_OP;
+  }
   std::vector<char> data;
   DtInfo di;
   int rc = pack_origin(origin_addr, origin_count, origin_datatype, data,
@@ -9188,8 +9262,7 @@ int MPI_Op_commutative(MPI_Op op, int *commute) {
     *commute = uit->second.commute ? 1 : 0;
     return MPI_SUCCESS;
   }
-  if (op < 0 || (op > MPI_BXOR && op != MPI_REPLACE && op != MPI_NO_OP))
-    return MPI_ERR_OP;
+  if (op < 0 || op > MPI_NO_OP) return MPI_ERR_OP;
   *commute = 1;  // every predefined op here is commutative
   return MPI_SUCCESS;
 }
@@ -9971,6 +10044,11 @@ const char *predefined_type_name(MPI_Datatype dt) {
     case MPI_UNSIGNED_SHORT: return "MPI_UNSIGNED_SHORT";
     case MPI_UNSIGNED:       return "MPI_UNSIGNED";
     case MPI_UNSIGNED_LONG:  return "MPI_UNSIGNED_LONG";
+    case MPI_2INT:           return "MPI_2INT";
+    case MPI_FLOAT_INT:      return "MPI_FLOAT_INT";
+    case MPI_DOUBLE_INT:     return "MPI_DOUBLE_INT";
+    case MPI_LONG_INT:       return "MPI_LONG_INT";
+    case MPI_SHORT_INT:      return "MPI_SHORT_INT";
   }
   return "";
 }
@@ -10632,6 +10710,10 @@ int MPI_Pack_external(const char datarep[], const void *inbuf,
   if (!datarep || strcmp(datarep, "external32") != 0) return MPI_ERR_ARG;
   DtView v;
   if (!resolve_dtype(datatype, v)) return MPI_ERR_TYPE;
+  // pair types (directly or as a derived type's base) have no
+  // canonical byte order — reject, never half-swap the record
+  if (is_pair_dtype(v.derived ? v.derived->base : datatype))
+    return MPI_ERR_TYPE;
   int unit = packed_unit(v);
   if (unit == 0) return MPI_ERR_TYPE;  // mixed-field struct
   std::vector<char> packed;
@@ -10651,6 +10733,8 @@ int MPI_Unpack_external(const char datarep[], const void *inbuf,
   if (!datarep || strcmp(datarep, "external32") != 0) return MPI_ERR_ARG;
   DtView v;
   if (!resolve_dtype(datatype, v)) return MPI_ERR_TYPE;
+  if (is_pair_dtype(v.derived ? v.derived->base : datatype))
+    return MPI_ERR_TYPE;
   int unit = packed_unit(v);
   if (unit == 0) return MPI_ERR_TYPE;
   size_t want = (size_t)outcount * v.elems_per_item() * v.di.item;
@@ -10668,6 +10752,8 @@ int MPI_Pack_external_size(const char datarep[], int incount,
   if (!datarep || strcmp(datarep, "external32") != 0) return MPI_ERR_ARG;
   DtView v;
   if (!resolve_dtype(datatype, v)) return MPI_ERR_TYPE;
+  if (is_pair_dtype(v.derived ? v.derived->base : datatype))
+    return MPI_ERR_TYPE;  // consistent with Pack_external's rejection
   *size = (MPI_Aint)((int64_t)incount * v.elems_per_item() *
                      (int64_t)v.di.item);
   return MPI_SUCCESS;

@@ -88,6 +88,13 @@ typedef int MPI_Datatype;
 #define MPI_UINT16_T       10
 #define MPI_UINT32_T       11
 #define MPI_UINT64_T       12
+/* MINLOC/MAXLOC pair types (value, index) — C struct layouts incl.
+ * padding (double_int is 16 bytes), as in the reference's mpi.h */
+#define MPI_2INT        13
+#define MPI_FLOAT_INT   14
+#define MPI_DOUBLE_INT  15
+#define MPI_LONG_INT    16
+#define MPI_SHORT_INT   17
 
 typedef int MPI_Op;
 #define MPI_OP_NULL (-1)
@@ -101,6 +108,8 @@ typedef int MPI_Op;
 #define MPI_BAND 7
 #define MPI_BOR  8
 #define MPI_BXOR 9
+#define MPI_MINLOC 10
+#define MPI_MAXLOC 11
 #define MPI_REPLACE 12
 #define MPI_NO_OP   13
 
