@@ -72,6 +72,42 @@ int main(int argc, char **argv) {
   CHECK(MPI_Reduce_local(a, b, 3, MPI_DOUBLE, MPI_SUM) == MPI_SUCCESS);
   CHECK(b[0] == 11 && b[1] == 22 && b[2] == 33);
 
+  /* predefined WORLD attributes + Aint arithmetic + MPI_BOTTOM */
+  {
+    void *pv = NULL;
+    int pf = 0;
+    CHECK(MPI_Comm_get_attr(MPI_COMM_WORLD, MPI_TAG_UB, &pv, &pf) ==
+          MPI_SUCCESS && pf == 1 && *(int *)pv >= 32767);
+    CHECK(MPI_Comm_get_attr(MPI_COMM_WORLD, MPI_WTIME_IS_GLOBAL, &pv,
+                            &pf) == MPI_SUCCESS && pf == 1);
+    MPI_Aint a1 = 0;
+    int anchor[4];
+    CHECK(MPI_Get_address(&anchor[0], &a1) == MPI_SUCCESS);
+    MPI_Aint a2 = MPI_Aint_add(a1, 2 * (MPI_Aint)sizeof(int));
+    CHECK(MPI_Aint_diff(a2, a1) == 2 * (MPI_Aint)sizeof(int));
+    /* absolute-address send: hindexed over MPI_BOTTOM */
+    if (rank < 2) {
+      int pr = 1 - rank;
+      anchor[0] = 9100 + rank;
+      anchor[2] = 9200 + rank;
+      int bl2[2] = {1, 1};
+      MPI_Aint ad[2];
+      MPI_Get_address(&anchor[0], &ad[0]);
+      MPI_Get_address(&anchor[2], &ad[1]);
+      MPI_Datatype abs_t;
+      CHECK(MPI_Type_create_hindexed(2, bl2, ad, MPI_INT, &abs_t) ==
+            MPI_SUCCESS);
+      CHECK(MPI_Type_commit(&abs_t) == MPI_SUCCESS);
+      int got2[2] = {-1, -1};
+      MPI_Status ast;
+      CHECK(MPI_Sendrecv(MPI_BOTTOM, 1, abs_t, pr, 40, got2, 2,
+                         MPI_INT, pr, 40, MPI_COMM_WORLD, &ast) ==
+            MPI_SUCCESS);
+      CHECK(got2[0] == 9100 + pr && got2[1] == 9200 + pr);
+      MPI_Type_free(&abs_t);
+    }
+  }
+
   /* handle conversion is the identity on this ABI */
   CHECK(MPI_Comm_f2c(MPI_Comm_c2f(MPI_COMM_WORLD)) == MPI_COMM_WORLD);
   CHECK(MPI_Type_f2c(MPI_Type_c2f(MPI_DOUBLE)) == MPI_DOUBLE);

@@ -337,6 +337,32 @@ bool is_pair_dtype(MPI_Datatype dt) {
   return dt >= MPI_2INT && dt <= MPI_SHORT_INT;
 }
 
+// the TYPEMAP size of a pair record (value + int, padding excluded);
+// 0 for non-pair types
+int pair_typemap_size(MPI_Datatype dt) {
+  switch (dt) {
+    case MPI_2INT:       return 8;
+    case MPI_FLOAT_INT:  return 8;
+    case MPI_DOUBLE_INT: return 12;
+    case MPI_LONG_INT:   return 12;
+    case MPI_SHORT_INT:  return 6;
+  }
+  return 0;
+}
+
+// the op/dtype pairing must fail at the ORIGIN of every accumulate-
+// family call: the remote apply is fire-and-forget, so a target-side
+// reduce_buf error would otherwise vanish (pair types take only
+// MINLOC/MAXLOC/REPLACE/NO_OP; loc ops REQUIRE a pair type)
+int check_acc_op_pairing(MPI_Datatype base, MPI_Op op) {
+  bool pair = is_pair_dtype(base);
+  bool loc_op = op == MPI_MINLOC || op == MPI_MAXLOC;
+  if (pair && !loc_op && op != MPI_REPLACE && op != MPI_NO_OP)
+    return MPI_ERR_OP;
+  if (!pair && loc_op) return MPI_ERR_OP;
+  return MPI_SUCCESS;
+}
+
 // Derived typemap: blocks of base elements within one extent, the
 // convertor's description (opal_datatype_optimize.c) reduced to the
 // contiguous/vector constructors.
@@ -366,10 +392,12 @@ MPI_Datatype g_next_dtype = DERIVED_BASE;
 // canonical packed element unit of a type's packed stream: predefined
 // and element-sealed derived = base item size; byte-sealed derived =
 // the unit recorded at construction (0 = heterogeneous struct)
-int packed_unit_of(const DtypeObj *derived, size_t item) {
-  if (!derived) return (int)item;
-  if (derived->base != 0 /* MPI_BYTE */) return (int)item;
-  return derived->swap_unit;
+int packed_unit_of(const DtypeObj *derived, MPI_Datatype dt,
+                   size_t item) {
+  MPI_Datatype base = derived ? derived->base : dt;
+  if (is_pair_dtype(base)) return 0;  // heterogeneous record: no unit
+  if (derived && base == 0 /* MPI_BYTE */) return derived->swap_unit;
+  return (int)item;
 }
 
 // A resolved view: base info + typemap (identity map for predefined).
@@ -3316,6 +3344,28 @@ int MPI_Comm_set_attr(MPI_Comm comm, int keyval, void *attribute_val) {
 
 int MPI_Comm_get_attr(MPI_Comm comm, int keyval, void *attribute_val,
                       int *flag) {
+  // predefined WORLD attributes (reserved keyvals; the value cells
+  // live for the process, per the attribute-pointer contract)
+  static int tag_ub = 0x7FFFFFFF;       // tags are int64 on the wire
+  static int host_val = MPI_PROC_NULL;  // no distinguished host proc
+  static int io_val = MPI_ANY_SOURCE;   // every rank can do IO
+  static int wtime_global = 0;          // steady_clock is per-process
+  if (keyval >= MPI_TAG_UB && keyval <= MPI_WTIME_IS_GLOBAL) {
+    if (!lookup_comm(comm)) return MPI_ERR_COMM;
+    if (comm != MPI_COMM_WORLD) {
+      *flag = 0;  // cached on WORLD only (attribute.c's contract)
+      return MPI_SUCCESS;
+    }
+    *flag = 1;
+    switch (keyval) {
+      case MPI_TAG_UB: *(void **)attribute_val = &tag_ub; break;
+      case MPI_HOST: *(void **)attribute_val = &host_val; break;
+      case MPI_IO: *(void **)attribute_val = &io_val; break;
+      default: *(void **)attribute_val = &wtime_global; break;
+    }
+    return MPI_SUCCESS;
+  }
+
   if (!lookup_comm(comm)) return MPI_ERR_COMM;
   auto it = g_attrs.find({comm, keyval});
   *flag = it != g_attrs.end() ? 1 : 0;
@@ -4456,18 +4506,15 @@ int MPI_Type_size(MPI_Datatype datatype, int *size) {
     if (it == g_dtypes.end()) return MPI_ERR_TYPE;
     DtInfo di;
     if (!base_dtinfo(it->second.base, di)) return MPI_ERR_TYPE;
-    *size = (int)(it->second.elems * di.item);
+    int ptm = pair_typemap_size(it->second.base);
+    *size = (int)(it->second.elems * (ptm ? (size_t)ptm : di.item));
     return MPI_SUCCESS;
   }
   if (!resolve_dtype(datatype, v)) return MPI_ERR_TYPE;
   // pair types: the TYPEMAP size (value + int), not the padded extent
   // (type_size.c: MPI_DOUBLE_INT is 12, its extent 16)
-  switch (datatype) {
-    case MPI_DOUBLE_INT: *size = 12; return MPI_SUCCESS;
-    case MPI_LONG_INT:   *size = 12; return MPI_SUCCESS;
-    case MPI_SHORT_INT:  *size = 6; return MPI_SUCCESS;
-  }
-  *size = (int)v.di.item;
+  int ptm = pair_typemap_size(datatype);
+  *size = ptm ? ptm : (int)v.di.item;
   return MPI_SUCCESS;
 }
 
@@ -4564,7 +4611,7 @@ int MPI_Type_create_resized(MPI_Datatype oldtype, MPI_Aint lb,
   if (!resolve_for_build(oldtype, v)) return MPI_ERR_TYPE;
   DtypeObj d;
   append_item_bytes(d.blocks, v, 0);
-  seal_byte_type(d, packed_unit_of(v.derived, v.di.item));
+  seal_byte_type(d, packed_unit_of(v.derived, oldtype, v.di.item));
   d.lb = lb;
   d.extent = extent;
   d.combiner = MPI_COMBINER_RESIZED;
@@ -4593,7 +4640,7 @@ int MPI_Type_create_hvector(int count, int blocklength, MPI_Aint stride,
       if (ilb + oext > max_ub) max_ub = ilb + oext;
     }
   }
-  seal_byte_type(d, packed_unit_of(v.derived, v.di.item));
+  seal_byte_type(d, packed_unit_of(v.derived, oldtype, v.di.item));
   d.lb = min_lb;
   d.extent = max_ub - min_lb;
   d.combiner = MPI_COMBINER_HVECTOR;
@@ -4628,7 +4675,7 @@ static int hindexed_impl(int count, const int blocklengths[],
     total += blocklengths[c];
   }
   if (total == 0) { min_lb = 0; max_ub = 0; }
-  seal_byte_type(d, packed_unit_of(v.derived, v.di.item));
+  seal_byte_type(d, packed_unit_of(v.derived, oldtype, v.di.item));
   d.lb = min_lb;
   d.extent = max_ub - min_lb;
   d.combiner = combiner;
@@ -4695,7 +4742,7 @@ int MPI_Type_create_struct(int count, const int blocklengths[],
     if (blocklengths[c] == 0) continue;
     DtView fv;
     resolve_for_build(types[c], fv);
-    int u = packed_unit_of(fv.derived, fv.di.item);
+    int u = packed_unit_of(fv.derived, types[c], fv.di.item);
     if (su < 0) su = u;
     else if (su != u) su = 0;
   }
@@ -4781,7 +4828,7 @@ int MPI_Type_create_subarray(int ndims, const int sizes[],
   }
   DtypeObj d;
   emit_runs(runs, std::vector<int>(sizes, sizes + ndims), order, v, d);
-  seal_byte_type(d, packed_unit_of(v.derived, v.di.item));
+  seal_byte_type(d, packed_unit_of(v.derived, oldtype, v.di.item));
   d.lb = 0;
   d.extent = full * extent_bytes_of(v);
   d.combiner = MPI_COMBINER_SUBARRAY;
@@ -4858,7 +4905,7 @@ int MPI_Type_create_darray(int size, int rank, int ndims,
   }
   DtypeObj d;
   emit_runs(runs, std::vector<int>(gsizes, gsizes + ndims), order, v, d);
-  seal_byte_type(d, packed_unit_of(v.derived, v.di.item));
+  seal_byte_type(d, packed_unit_of(v.derived, oldtype, v.di.item));
   d.lb = 0;
   d.extent = full * extent_bytes_of(v);
   d.combiner = MPI_COMBINER_DARRAY;
@@ -8444,15 +8491,9 @@ int MPI_Accumulate(const void *origin_addr, int origin_count,
   if (!resolve_dtype(target_datatype, tv)) return MPI_ERR_TYPE;
   if (!tv.contiguous()) return MPI_ERR_TYPE;  // see MPI_Put
   {
-    // the op/dtype pairing must fail at the ORIGIN: the remote apply
-    // is fire-and-forget, so a target-side reduce_buf error would
-    // otherwise vanish (pair types take only MINLOC/MAXLOC/REPLACE)
-    MPI_Datatype base = tv.derived ? tv.derived->base : target_datatype;
-    bool pair = is_pair_dtype(base);
-    bool loc_op = op == MPI_MINLOC || op == MPI_MAXLOC;
-    if (pair && !loc_op && op != MPI_REPLACE && op != MPI_NO_OP)
-      return MPI_ERR_OP;
-    if (!pair && loc_op) return MPI_ERR_OP;
+    int oprc = check_acc_op_pairing(
+        tv.derived ? tv.derived->base : target_datatype, op);
+    if (oprc != MPI_SUCCESS) return oprc;
   }
   std::vector<char> data;
   DtInfo di;
@@ -9071,6 +9112,10 @@ int MPI_Fetch_and_op(const void *origin_addr, void *result_addr,
   if (!w) return MPI_ERR_WIN;
   if (target_rank == MPI_PROC_NULL) return MPI_SUCCESS;  // RMA no-op
   if (g_user_ops.count(op)) return MPI_ERR_OP;
+  {
+    int oprc = check_acc_op_pairing(dt, op);  // origin-side, like acc
+    if (oprc != MPI_SUCCESS) return oprc;
+  }
   int64_t disp = (int64_t)target_disp * w->disp_unit;
   const char *sub;
   char subbuf[16];
@@ -9103,6 +9148,12 @@ int MPI_Get_accumulate(const void *origin_addr, int origin_count,
   if (!resolve_dtype(target_datatype, tv) ||
       !resolve_dtype(result_datatype, rv))
     return MPI_ERR_TYPE;
+  {
+    // origin-side pairing check on the RESOLVED base (see Accumulate)
+    int oprc = check_acc_op_pairing(
+        tv.derived ? tv.derived->base : target_datatype, op);
+    if (oprc != MPI_SUCCESS) return oprc;
+  }
   if (!tv.contiguous()) return MPI_ERR_TYPE;  // see MPI_Put
   MPI_Datatype base_dt = tv.derived ? tv.derived->base : target_datatype;
   DtInfo di;
@@ -9254,6 +9305,16 @@ int MPI_Get_address(const void *location, MPI_Aint *address) {
 
 int MPI_Address(void *location, MPI_Aint *address) {
   return MPI_Get_address(location, address);
+}
+
+MPI_Aint MPI_Aint_add(MPI_Aint base, MPI_Aint disp) {
+  // aint_add.c: defined in terms of char* arithmetic
+  return (MPI_Aint)(uintptr_t)((char *)(uintptr_t)base + disp);
+}
+
+MPI_Aint MPI_Aint_diff(MPI_Aint addr1, MPI_Aint addr2) {
+  return (MPI_Aint)((char *)(uintptr_t)addr1 -
+                    (char *)(uintptr_t)addr2);
 }
 
 int MPI_Op_commutative(MPI_Op op, int *commute) {
@@ -10700,8 +10761,8 @@ void swap_elems(char *buf, size_t nbytes, size_t item) {
 // canonical element unit of a type's PACKED stream: predefined =
 // item size; byte-sealed derived = the recorded constructor unit
 // (0 = heterogeneous struct, not canonically packable)
-static int packed_unit(const DtView &v) {
-  return packed_unit_of(v.derived, v.di.item);
+static int packed_unit(const DtView &v, MPI_Datatype dt) {
+  return packed_unit_of(v.derived, dt, v.di.item);
 }
 
 int MPI_Pack_external(const char datarep[], const void *inbuf,
@@ -10710,12 +10771,11 @@ int MPI_Pack_external(const char datarep[], const void *inbuf,
   if (!datarep || strcmp(datarep, "external32") != 0) return MPI_ERR_ARG;
   DtView v;
   if (!resolve_dtype(datatype, v)) return MPI_ERR_TYPE;
-  // pair types (directly or as a derived type's base) have no
-  // canonical byte order — reject, never half-swap the record
-  if (is_pair_dtype(v.derived ? v.derived->base : datatype))
-    return MPI_ERR_TYPE;
-  int unit = packed_unit(v);
-  if (unit == 0) return MPI_ERR_TYPE;  // mixed-field struct
+  int unit = packed_unit(v, datatype);
+  // unit 0 = no canonical element order: mixed-field structs and pair
+  // records, directly or through ANY derived construction — reject,
+  // never half-swap
+  if (unit == 0) return MPI_ERR_TYPE;
   std::vector<char> packed;
   pack_dtype(inbuf, incount, v, packed);
   swap_elems(packed.data(), packed.size(), (size_t)unit);
@@ -10733,10 +10793,8 @@ int MPI_Unpack_external(const char datarep[], const void *inbuf,
   if (!datarep || strcmp(datarep, "external32") != 0) return MPI_ERR_ARG;
   DtView v;
   if (!resolve_dtype(datatype, v)) return MPI_ERR_TYPE;
-  if (is_pair_dtype(v.derived ? v.derived->base : datatype))
-    return MPI_ERR_TYPE;
-  int unit = packed_unit(v);
-  if (unit == 0) return MPI_ERR_TYPE;
+  int unit = packed_unit(v, datatype);
+  if (unit == 0) return MPI_ERR_TYPE;  // see Pack_external
   size_t want = (size_t)outcount * v.elems_per_item() * v.di.item;
   if (*position + (MPI_Aint)want > insize) return MPI_ERR_TRUNCATE;
   std::vector<char> tmp((const char *)inbuf + *position,
@@ -10752,7 +10810,7 @@ int MPI_Pack_external_size(const char datarep[], int incount,
   if (!datarep || strcmp(datarep, "external32") != 0) return MPI_ERR_ARG;
   DtView v;
   if (!resolve_dtype(datatype, v)) return MPI_ERR_TYPE;
-  if (is_pair_dtype(v.derived ? v.derived->base : datatype))
+  if (packed_unit(v, datatype) == 0)
     return MPI_ERR_TYPE;  // consistent with Pack_external's rejection
   *size = (MPI_Aint)((int64_t)incount * v.elems_per_item() *
                      (int64_t)v.di.item);

@@ -131,6 +131,10 @@ typedef int MPI_Fint;
  * dereferenced */
 extern char zompi_in_place_[1];
 #define MPI_IN_PLACE ((void *)zompi_in_place_)
+/* absolute-address buffers: datatypes built with absolute byte
+ * displacements (e.g. hindexed over MPI_Get_address values) send from
+ * MPI_BOTTOM */
+#define MPI_BOTTOM ((void *)0)
 
 #define MPI_SUCCESS      0
 #define MPI_ERR_COMM     5
@@ -212,6 +216,14 @@ int MPI_Comm_set_attr(MPI_Comm comm, int keyval, void *attribute_val);
 int MPI_Comm_get_attr(MPI_Comm comm, int keyval, void *attribute_val,
                       int *flag);
 int MPI_Comm_delete_attr(MPI_Comm comm, int keyval);
+/* predefined WORLD attributes (attr/attribute.c's reserved keyvals);
+ * Comm_get_attr yields a pointer to the int value */
+#define MPI_TAG_UB          0x644A1
+#define MPI_HOST            0x644A2
+#define MPI_IO              0x644A3
+#define MPI_WTIME_IS_GLOBAL 0x644A4
+MPI_Aint MPI_Aint_add(MPI_Aint base, MPI_Aint disp);
+MPI_Aint MPI_Aint_diff(MPI_Aint addr1, MPI_Aint addr2);
 #define MPI_IDENT     0
 #define MPI_CONGRUENT 1
 #define MPI_SIMILAR   2
