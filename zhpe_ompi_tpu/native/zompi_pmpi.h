@@ -39,6 +39,8 @@ int PMPI_Comm_set_attr(MPI_Comm comm, int keyval, void *attribute_val);
 int PMPI_Comm_get_attr(MPI_Comm comm, int keyval, void *attribute_val,
     int *flag);
 int PMPI_Comm_delete_attr(MPI_Comm comm, int keyval);
+MPI_Aint PMPI_Aint_add(MPI_Aint base, MPI_Aint disp);
+MPI_Aint PMPI_Aint_diff(MPI_Aint addr1, MPI_Aint addr2);
 int PMPI_Comm_group(MPI_Comm comm, MPI_Group *group);
 int PMPI_Group_size(MPI_Group group, int *size);
 int PMPI_Group_rank(MPI_Group group, int *rank);
