@@ -190,6 +190,32 @@ int main(int argc, char **argv) {
                  MPI_COMM_WORLD) == MPI_SUCCESS);
   CHECK(sc == (long)(rank + 1) * (rank + 2) / 2);
 
+  /* IN_PLACE extends to the NONBLOCKING collectives (MPI-3.1 5.12):
+   * the clone must outlive the call, not just the engine read */
+  {
+    long v2 = 5 + rank;
+    MPI_Request q;
+    CHECK(MPI_Iallreduce(MPI_IN_PLACE, &v2, 1, MPI_LONG, MPI_SUM,
+                         MPI_COMM_WORLD, &q) == MPI_SUCCESS);
+    CHECK(MPI_Wait(&q, MPI_STATUS_IGNORE) == MPI_SUCCESS);
+    CHECK(v2 == 5L * n + (long)n * (n - 1) / 2);
+
+    int *ag2 = malloc(sizeof(int) * (size_t)n);
+    for (int i = 0; i < n; i++) ag2[i] = -1;
+    ag2[rank] = 800 + rank;
+    CHECK(MPI_Iallgather(MPI_IN_PLACE, 0, MPI_DATATYPE_NULL, ag2, 1,
+                         MPI_INT, MPI_COMM_WORLD, &q) == MPI_SUCCESS);
+    CHECK(MPI_Wait(&q, MPI_STATUS_IGNORE) == MPI_SUCCESS);
+    for (int i = 0; i < n; i++) CHECK(ag2[i] == 800 + i);
+    free(ag2);
+
+    long sv2 = rank + 2;
+    CHECK(MPI_Iscan(MPI_IN_PLACE, &sv2, 1, MPI_LONG, MPI_SUM,
+                    MPI_COMM_WORLD, &q) == MPI_SUCCESS);
+    CHECK(MPI_Wait(&q, MPI_STATUS_IGNORE) == MPI_SUCCESS);
+    CHECK(sv2 == (long)(rank + 1) * (rank + 4) / 2);
+  }
+
   free(ag);
   free(agv);
   free(cnts);

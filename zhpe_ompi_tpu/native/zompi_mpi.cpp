@@ -4185,7 +4185,11 @@ int MPI_Bcast(void *buf, int count, MPI_Datatype dt, int root,
 // IN_PLACE substitution (MPI-3.1 ch.5): clone the receive-side
 // contribution into an extent-layout temp via pack/unpack — pack
 // touches only typemap bytes, so the clone never overreads a strided
-// type's trailing gap
+// type's trailing gap.
+// NOTE: the per-collective slice/span arithmetic below is MIRRORED in
+// the nonblocking wrappers (MPI_Iallreduce ... MPI_Ialltoallv, search
+// icoll_inplace) — fix BOTH copies or extract a helper when touching
+// either.
 static int clone_region(const void *src, int count, MPI_Datatype dt,
                         std::vector<char> &tmp) {
   DtView v;
@@ -6714,14 +6718,37 @@ int MPI_Ibcast(void *buf, int count, MPI_Datatype dt, int root,
       comm, request);
 }
 
+// MPI-3.1 5.12: IN_PLACE extends to every nonblocking collective.
+// The receive-side contribution is cloned NOW (the caller may touch
+// nothing until completion, but the engine must not read the sentinel
+// address) and the clone is owned by each closure — captured
+// EXPLICITLY, since [=] would not keep a shared_ptr the body never
+// names alive.
+// NOTE: the slice/span arithmetic MIRRORS the blocking wrappers
+// (MPI_Allreduce ... MPI_Alltoallv above) — fix BOTH copies or
+// extract a helper when touching either.
+static int icoll_inplace(const void *&sendbuf, const void *src,
+                         int count, MPI_Datatype dt,
+                         std::shared_ptr<std::vector<char>> &keep) {
+  if (sendbuf != MPI_IN_PLACE) return MPI_SUCCESS;
+  keep = std::make_shared<std::vector<char>>();
+  int rc = clone_region(src, count, dt, *keep);
+  if (rc != MPI_SUCCESS) return rc;
+  sendbuf = keep->data();
+  return MPI_SUCCESS;
+}
+
 int MPI_Iallreduce(const void *sendbuf, void *recvbuf, int count,
                    MPI_Datatype dt, MPI_Op op, MPI_Comm comm,
                    MPI_Request *request) {
   CommObj *c = lookup_comm(comm);
   if (!c) return MPI_ERR_COMM;
+  std::shared_ptr<std::vector<char>> keep;
+  int rc = icoll_inplace(sendbuf, recvbuf, count, dt, keep);
+  if (rc != MPI_SUCCESS) return rc;
   auto snap = icoll_reserve(c);
   return icoll_spawn(
-      [snap, sendbuf, recvbuf, count, dt, op]() {
+      [snap, keep, sendbuf, recvbuf, count, dt, op]() {
         return c_allreduce(*snap, sendbuf, recvbuf, count, dt, op);
       },
       comm, request);
@@ -6733,9 +6760,15 @@ int MPI_Ireduce(const void *sendbuf, void *recvbuf, int count,
   CommObj *c = lookup_comm(comm);
   if (!c) return MPI_ERR_COMM;
   if (root < 0 || root >= (int)c->group.size()) return MPI_ERR_ARG;
+  std::shared_ptr<std::vector<char>> keep;
+  if (sendbuf == MPI_IN_PLACE) {
+    if (c->local_rank != root) return MPI_ERR_ARG;  // root only
+    int rc = icoll_inplace(sendbuf, recvbuf, count, dt, keep);
+    if (rc != MPI_SUCCESS) return rc;
+  }
   auto snap = icoll_reserve(c);
   return icoll_spawn(
-      [snap, sendbuf, recvbuf, count, dt, op, root]() {
+      [snap, keep, sendbuf, recvbuf, count, dt, op, root]() {
         return c_reduce(*snap, sendbuf, recvbuf, count, dt, op, root);
       },
       comm, request);
@@ -6747,9 +6780,22 @@ int MPI_Igather(const void *sendbuf, int sendcount, MPI_Datatype sendtype,
   CommObj *c = lookup_comm(comm);
   if (!c) return MPI_ERR_COMM;
   if (root < 0 || root >= (int)c->group.size()) return MPI_ERR_ARG;
+  std::shared_ptr<std::vector<char>> keep;
+  if (sendbuf == MPI_IN_PLACE) {
+    if (c->local_rank != root) return MPI_ERR_ARG;
+    DtView rv;
+    if (!resolve_dtype(recvtype, rv)) return MPI_ERR_TYPE;
+    const char *slice =
+        (const char *)recvbuf + (size_t)root * slot_bytes(rv, recvcount);
+    int rc = icoll_inplace(sendbuf, slice, recvcount, recvtype, keep);
+    if (rc != MPI_SUCCESS) return rc;
+    sendcount = recvcount;
+    sendtype = recvtype;
+  }
   auto snap = icoll_reserve(c);
   return icoll_spawn(
-      [=]() {
+      [snap, keep, sendbuf, sendcount, sendtype, recvbuf, recvcount,
+       recvtype, root]() {
         return c_gather(*snap, sendbuf, sendcount, sendtype, recvbuf,
                         recvcount, recvtype, root);
       },
@@ -6762,9 +6808,21 @@ int MPI_Iscatter(const void *sendbuf, int sendcount, MPI_Datatype sendtype,
   CommObj *c = lookup_comm(comm);
   if (!c) return MPI_ERR_COMM;
   if (root < 0 || root >= (int)c->group.size()) return MPI_ERR_ARG;
+  std::shared_ptr<std::vector<char>> scratch;
+  if (recvbuf == MPI_IN_PLACE) {
+    if (c->local_rank != root) return MPI_ERR_ARG;
+    DtView sv;
+    if (!resolve_dtype(sendtype, sv)) return MPI_ERR_TYPE;
+    scratch = std::make_shared<std::vector<char>>(
+        slot_bytes(sv, sendcount));
+    recvbuf = scratch->data();
+    recvcount = sendcount;
+    recvtype = sendtype;
+  }
   auto snap = icoll_reserve(c);
   return icoll_spawn(
-      [=]() {
+      [snap, scratch, sendbuf, sendcount, sendtype, recvbuf, recvcount,
+       recvtype, root]() {
         return c_scatter(*snap, sendbuf, sendcount, sendtype, recvbuf,
                          recvcount, recvtype, root);
       },
@@ -6777,9 +6835,22 @@ int MPI_Iallgather(const void *sendbuf, int sendcount,
                    MPI_Request *request) {
   CommObj *c = lookup_comm(comm);
   if (!c) return MPI_ERR_COMM;
+  std::shared_ptr<std::vector<char>> keep;
+  if (sendbuf == MPI_IN_PLACE) {
+    DtView rv;
+    if (!resolve_dtype(recvtype, rv)) return MPI_ERR_TYPE;
+    const char *slice = (const char *)recvbuf +
+                        (size_t)c->local_rank *
+                            slot_bytes(rv, recvcount);
+    int rc = icoll_inplace(sendbuf, slice, recvcount, recvtype, keep);
+    if (rc != MPI_SUCCESS) return rc;
+    sendcount = recvcount;
+    sendtype = recvtype;
+  }
   auto snap = icoll_reserve(c);
   return icoll_spawn(
-      [=]() {
+      [snap, keep, sendbuf, sendcount, sendtype, recvbuf, recvcount,
+       recvtype]() {
         return c_allgather(*snap, sendbuf, sendcount, sendtype, recvbuf,
                            recvcount, recvtype);
       },
@@ -6792,11 +6863,38 @@ int MPI_Ialltoall(const void *sendbuf, int sendcount,
                   MPI_Request *request) {
   CommObj *c = lookup_comm(comm);
   if (!c) return MPI_ERR_COMM;
+  std::shared_ptr<std::vector<char>> keep;
+  if (sendbuf == MPI_IN_PLACE) {
+    int rc = icoll_inplace(sendbuf, recvbuf,
+                           (int)c->group.size() * recvcount, recvtype,
+                           keep);
+    if (rc != MPI_SUCCESS) return rc;
+    sendcount = recvcount;
+    sendtype = recvtype;
+  }
   auto snap = icoll_reserve(c);
   return icoll_spawn(
-      [=]() {
+      [snap, keep, sendbuf, sendcount, sendtype, recvbuf, recvcount,
+       recvtype]() {
         return c_alltoall(*snap, sendbuf, sendcount, sendtype, recvbuf,
                           recvcount, recvtype);
+      },
+      comm, request);
+}
+
+static int iscan_impl(const void *sendbuf, void *recvbuf, int count,
+                      MPI_Datatype dt, MPI_Op op, MPI_Comm comm,
+                      MPI_Request *request, bool exclusive) {
+  CommObj *c = lookup_comm(comm);
+  if (!c) return MPI_ERR_COMM;
+  std::shared_ptr<std::vector<char>> keep;
+  int rc = icoll_inplace(sendbuf, recvbuf, count, dt, keep);
+  if (rc != MPI_SUCCESS) return rc;
+  auto snap = icoll_reserve(c);
+  return icoll_spawn(
+      [snap, keep, sendbuf, recvbuf, count, dt, op, exclusive]() {
+        return c_scan(*snap, sendbuf, recvbuf, count, dt, op,
+                      exclusive);
       },
       comm, request);
 }
@@ -6804,27 +6902,15 @@ int MPI_Ialltoall(const void *sendbuf, int sendcount,
 int MPI_Iscan(const void *sendbuf, void *recvbuf, int count,
               MPI_Datatype dt, MPI_Op op, MPI_Comm comm,
               MPI_Request *request) {
-  CommObj *c = lookup_comm(comm);
-  if (!c) return MPI_ERR_COMM;
-  auto snap = icoll_reserve(c);
-  return icoll_spawn(
-      [=]() {
-        return c_scan(*snap, sendbuf, recvbuf, count, dt, op, false);
-      },
-      comm, request);
+  return iscan_impl(sendbuf, recvbuf, count, dt, op, comm, request,
+                    false);
 }
 
 int MPI_Iexscan(const void *sendbuf, void *recvbuf, int count,
                 MPI_Datatype dt, MPI_Op op, MPI_Comm comm,
                 MPI_Request *request) {
-  CommObj *c = lookup_comm(comm);
-  if (!c) return MPI_ERR_COMM;
-  auto snap = icoll_reserve(c);
-  return icoll_spawn(
-      [=]() {
-        return c_scan(*snap, sendbuf, recvbuf, count, dt, op, true);
-      },
-      comm, request);
+  return iscan_impl(sendbuf, recvbuf, count, dt, op, comm, request,
+                    true);
 }
 
 int MPI_Ireduce_scatter_block(const void *sendbuf, void *recvbuf,
@@ -6832,9 +6918,15 @@ int MPI_Ireduce_scatter_block(const void *sendbuf, void *recvbuf,
                               MPI_Comm comm, MPI_Request *request) {
   CommObj *c = lookup_comm(comm);
   if (!c) return MPI_ERR_COMM;
+  std::shared_ptr<std::vector<char>> keep;
+  if (sendbuf == MPI_IN_PLACE) {
+    int rc = icoll_inplace(sendbuf, recvbuf,
+                           (int)c->group.size() * recvcount, dt, keep);
+    if (rc != MPI_SUCCESS) return rc;
+  }
   auto snap = icoll_reserve(c, 2);  // reduce + scatter under the hood
   return icoll_spawn(
-      [=]() {
+      [snap, keep, sendbuf, recvbuf, recvcount, dt, op]() {
         return c_reduce_scatter_block(*snap, sendbuf, recvbuf, recvcount,
                                       dt, op);
       },
@@ -6869,9 +6961,23 @@ int MPI_Igatherv(const void *sendbuf, int sendcount,
   int n = (int)c->group.size();
   bool im_root = c->local_rank == root;
   IcollArray rc_(recvcounts, n, im_root), dp(displs, n, im_root);
+  std::shared_ptr<std::vector<char>> keep;
+  if (sendbuf == MPI_IN_PLACE) {
+    if (!im_root) return MPI_ERR_ARG;
+    DtView rv;
+    if (!resolve_dtype(recvtype, rv)) return MPI_ERR_TYPE;
+    const char *slice = (const char *)recvbuf +
+                        (size_t)displs[root] * slot_bytes(rv, 1);
+    int rc = icoll_inplace(sendbuf, slice, recvcounts[root], recvtype,
+                           keep);
+    if (rc != MPI_SUCCESS) return rc;
+    sendcount = recvcounts[root];
+    sendtype = recvtype;
+  }
   auto snap = icoll_reserve(c);
   return icoll_spawn(
-      [=]() {
+      [snap, keep, rc_, dp, sendbuf, sendcount, sendtype, recvbuf,
+       recvtype, root]() {
         return c_gatherv(*snap, sendbuf, sendcount, sendtype, recvbuf,
                          rc_.data_or_null(), dp.data_or_null(), recvtype,
                          root);
@@ -6889,9 +6995,21 @@ int MPI_Iscatterv(const void *sendbuf, const int sendcounts[],
   int n = (int)c->group.size();
   bool im_root = c->local_rank == root;
   IcollArray sc(sendcounts, n, im_root), dp(displs, n, im_root);
+  std::shared_ptr<std::vector<char>> scratch;
+  if (recvbuf == MPI_IN_PLACE) {
+    if (!im_root) return MPI_ERR_ARG;
+    DtView sv;
+    if (!resolve_dtype(sendtype, sv)) return MPI_ERR_TYPE;
+    scratch = std::make_shared<std::vector<char>>(
+        slot_bytes(sv, sendcounts[root]));
+    recvbuf = scratch->data();
+    recvcount = sendcounts[root];
+    recvtype = sendtype;
+  }
   auto snap = icoll_reserve(c);
   return icoll_spawn(
-      [=]() {
+      [snap, scratch, sc, dp, sendbuf, sendtype, recvbuf, recvcount,
+       recvtype, root]() {
         return c_scatterv(*snap, sendbuf, sc.data_or_null(),
                           dp.data_or_null(), sendtype, recvbuf,
                           recvcount, recvtype, root);
@@ -6908,9 +7026,23 @@ int MPI_Iallgatherv(const void *sendbuf, int sendcount,
   if (!c) return MPI_ERR_COMM;
   int n = (int)c->group.size();
   IcollArray rc_(recvcounts, n, true), dp(displs, n, true);
+  std::shared_ptr<std::vector<char>> keep;
+  if (sendbuf == MPI_IN_PLACE) {
+    int me = c->local_rank;
+    DtView rv;
+    if (!resolve_dtype(recvtype, rv)) return MPI_ERR_TYPE;
+    const char *slice = (const char *)recvbuf +
+                        (size_t)displs[me] * slot_bytes(rv, 1);
+    int rc = icoll_inplace(sendbuf, slice, recvcounts[me], recvtype,
+                           keep);
+    if (rc != MPI_SUCCESS) return rc;
+    sendcount = recvcounts[me];
+    sendtype = recvtype;
+  }
   auto snap = icoll_reserve(c, n);  // n rooted broadcasts inside
   return icoll_spawn(
-      [=]() {
+      [snap, keep, rc_, dp, sendbuf, sendcount, sendtype, recvbuf,
+       recvtype]() {
         return c_allgatherv(*snap, sendbuf, sendcount, sendtype, recvbuf,
                             rc_.data_or_null(), dp.data_or_null(),
                             recvtype);
@@ -6926,9 +7058,16 @@ int MPI_Ireduce_scatter(const void *sendbuf, void *recvbuf,
   int n = (int)c->group.size();
   auto counts = std::make_shared<std::vector<int>>(recvcounts,
                                                    recvcounts + n);
+  std::shared_ptr<std::vector<char>> keep;
+  if (sendbuf == MPI_IN_PLACE) {
+    int total = 0;
+    for (int r = 0; r < n; r++) total += recvcounts[r];
+    int rc = icoll_inplace(sendbuf, recvbuf, total, dt, keep);
+    if (rc != MPI_SUCCESS) return rc;
+  }
   auto snap = icoll_reserve(c, 2);  // reduce + scatterv under the hood
   return icoll_spawn(
-      [=]() {
+      [snap, keep, counts, sendbuf, recvbuf, dt, op]() {
         return c_reduce_scatter(*snap, sendbuf, recvbuf, counts->data(),
                                 dt, op);
       },
@@ -6943,6 +7082,21 @@ int MPI_Ialltoallv(const void *sendbuf, const int sendcounts[],
   CommObj *c = lookup_comm(comm);
   if (!c) return MPI_ERR_COMM;
   int n = (int)c->group.size();
+  std::shared_ptr<std::vector<char>> keep;
+  if (sendbuf == MPI_IN_PLACE) {
+    // the receive side defines everything (alltoallv.c IN_PLACE)
+    DtView rv;
+    if (!resolve_dtype(recvtype, rv)) return MPI_ERR_TYPE;
+    int span = 0;
+    for (int r = 0; r < n; r++)
+      if (rdispls[r] + recvcounts[r] > span)
+        span = rdispls[r] + recvcounts[r];
+    int rc = icoll_inplace(sendbuf, recvbuf, span, recvtype, keep);
+    if (rc != MPI_SUCCESS) return rc;
+    sendcounts = recvcounts;
+    sdispls = rdispls;
+    sendtype = recvtype;
+  }
   // MPI lets the caller reuse the count/displacement arrays the moment
   // the call returns — snapshot them for the background thread
   auto sc = std::make_shared<std::vector<int>>(sendcounts, sendcounts + n);
@@ -6952,7 +7106,8 @@ int MPI_Ialltoallv(const void *sendbuf, const int sendcounts[],
   auto rd = std::make_shared<std::vector<int>>(rdispls, rdispls + n);
   auto snap = icoll_reserve(c);
   return icoll_spawn(
-      [=]() {
+      [snap, keep, sc, sd, rc_, rd, sendbuf, sendtype, recvbuf,
+       recvtype]() {
         return c_alltoallv(*snap, sendbuf, sc->data(), sd->data(),
                            sendtype, recvbuf, rc_->data(), rd->data(),
                            recvtype);
