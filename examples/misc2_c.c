@@ -276,6 +276,16 @@ int main(int argc, char **argv) {
     int cf = -1;
     CHECK(MPI_Op_commutative(MPI_MINLOC, &cf) == MPI_SUCCESS &&
           cf == 1);
+    /* get_elements counts BASIC elements: 2 per pair record */
+    {
+      MPI_Status est;
+      memset(&est, 0, sizeof est);
+      CHECK(MPI_Status_set_elements(&est, MPI_DOUBLE_INT, 3) ==
+            MPI_SUCCESS);
+      int ne = -1;
+      CHECK(MPI_Get_elements(&est, MPI_DOUBLE_INT, &ne) ==
+            MPI_SUCCESS && ne == 6);
+    }
     /* typemap size vs padded extent (type_size.c: 12 vs 16) */
     int psz = -1;
     long plb = -1, pext = -1;

@@ -9668,12 +9668,15 @@ int MPI_Status_set_cancelled(MPI_Status *status, int flag) {
 
 int MPI_Get_elements_x(const MPI_Status *status, MPI_Datatype dt,
                        MPI_Count *count) {
-  // get_elements.c: BASE-element count, partial items included —
-  // _count carries wire bytes of packed base elements
+  // get_elements.c: BASIC-element count, partial items included —
+  // _count carries wire bytes of packed base elements.  A pair record
+  // holds TWO basic elements (value + index), MPI-3.1 §5.9.4.
   DtView v;
   if (!resolve_dtype(dt, v)) return MPI_ERR_TYPE;
   if (v.di.item == 0) return MPI_ERR_TYPE;
-  *count = (MPI_Count)(status->_count / (long long)v.di.item);
+  MPI_Datatype base = v.derived ? v.derived->base : dt;
+  long long units = status->_count / (long long)v.di.item;
+  *count = (MPI_Count)(is_pair_dtype(base) ? units * 2 : units);
   return MPI_SUCCESS;
 }
 
