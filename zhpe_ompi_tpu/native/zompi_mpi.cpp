@@ -9676,7 +9676,15 @@ int MPI_Get_elements_x(const MPI_Status *status, MPI_Datatype dt,
   if (v.di.item == 0) return MPI_ERR_TYPE;
   MPI_Datatype base = v.derived ? v.derived->base : dt;
   long long units = status->_count / (long long)v.di.item;
-  *count = (MPI_Count)(is_pair_dtype(base) ? units * 2 : units);
+  if (is_pair_dtype(base)) {
+    // 2 basics per record; a half-record remainder counts as 1 (the
+    // set_elements inverse stores count*item/2 bytes, so odd counts
+    // round-trip exactly)
+    long long rem = status->_count % (long long)v.di.item;
+    *count = (MPI_Count)(units * 2 + (rem > 0 ? 1 : 0));
+  } else {
+    *count = (MPI_Count)units;
+  }
   return MPI_SUCCESS;
 }
 
@@ -9691,9 +9699,16 @@ int MPI_Get_elements(const MPI_Status *status, MPI_Datatype dt,
 
 int MPI_Status_set_elements_x(MPI_Status *status, MPI_Datatype dt,
                               MPI_Count count) {
+  // status_set_elements.c contract: a subsequent Get_elements returns
+  // EXACTLY `count` — for pair types that means count BASIC elements
+  // (2 per record), so store half an item per basic
   DtView v;
   if (!resolve_dtype(dt, v)) return MPI_ERR_TYPE;
-  status->_count = (long long)count * (long long)v.di.item;
+  MPI_Datatype base = v.derived ? v.derived->base : dt;
+  if (is_pair_dtype(base))
+    status->_count = (long long)count * (long long)v.di.item / 2;
+  else
+    status->_count = (long long)count * (long long)v.di.item;
   return MPI_SUCCESS;
 }
 
