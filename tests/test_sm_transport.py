@@ -1,0 +1,152 @@
+"""Shared-memory transport lifecycle (the C plane's btl/sm role).
+
+The functional surface is covered by tests/test_c_abi.py (the whole
+direct-launch suite runs over the rings); this file checks the
+OPERATIONAL contract: ring files appear only while a job lives, are
+unlinked at MPI_Finalize, obey the ZMPI_MCA_sm switch, and mixed
+on/off pairs degrade to TCP without losing messages."""
+
+import os
+import socket
+import subprocess
+
+import pytest
+
+from zhpe_ompi_tpu import native
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def ring_bin(tmp_path_factory):
+    so = native.build_mpi_shim()
+    out = tmp_path_factory.mktemp("smlife") / "ring"
+    libdir = os.path.dirname(so)
+    libname = os.path.basename(so)[3:].rsplit(".so", 1)[0]
+    subprocess.run(
+        ["gcc", os.path.join(REPO, "examples", "ring_c.c"), "-o",
+         str(out), "-I", native.mpi_header_dir(), "-L", libdir,
+         f"-l{libname}", f"-Wl,-rpath,{libdir}"],
+        check=True, capture_output=True, text=True)
+    return str(out)
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _run(ring_bin, port, n, sm_env):
+    procs = []
+    for r in range(n):
+        env = dict(os.environ)
+        env.update({
+            "ZMPI_RANK": str(r), "ZMPI_SIZE": str(n),
+            "ZMPI_COORD_HOST": "127.0.0.1",
+            "ZMPI_COORD_PORT": str(port),
+        })
+        if sm_env.get(r) is not None:
+            env["ZMPI_MCA_sm"] = sm_env[r]
+        else:
+            env.pop("ZMPI_MCA_sm", None)
+        # direct launches name segments by COORD_PORT; a stray session
+        # tag from an outer launcher would break the glob below
+        env.pop("ZMPI_SESSION", None)
+        procs.append(subprocess.Popen(
+            [ring_bin], env=env, stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE, text=True))
+    outs = []
+    for r, p in enumerate(procs):
+        out, err = p.communicate(timeout=90)
+        assert p.returncode == 0, f"rank {r}: {err}\n{out}"
+        outs.append(out)
+    return outs
+
+
+def _ring_files(port):
+    return [f for f in os.listdir("/dev/shm")
+            if f.startswith(f"zompi_ring_{port}_")]
+
+
+def test_rings_unlinked_at_finalize(ring_bin):
+    """Forced-on job: ring files exist for the job's port DURING the
+    run would be racy to assert, but after clean MPI_Finalize every
+    ring this job created must be unlinked."""
+    port = _free_port()
+    outs = _run(ring_bin, port, 3, {r: "1" for r in range(3)})
+    for r in range(3):
+        assert f"ring_c rank {r}/3 OK" in outs[r]
+    assert _ring_files(port) == [], "ring files leaked past finalize"
+
+
+def test_forced_off_creates_no_rings(ring_bin):
+    port = _free_port()
+    outs = _run(ring_bin, port, 2, {0: "0", 1: "0"})
+    assert "ring_c rank 0/2 OK" in outs[0]
+    assert _ring_files(port) == []
+
+
+def test_abort_unlinks_own_rings(tmp_path):
+    """A rank that dies through MPI_Abort never reaches finalize; its
+    own ring files must still be unlinked (best-effort in Abort; the
+    launcher additionally sweeps the session)."""
+    so = native.build_mpi_shim()
+    src = tmp_path / "aborter.c"
+    src.write_text(
+        '#include "zompi_mpi.h"\n'
+        "int main(int argc, char **argv) {\n"
+        "  MPI_Init(&argc, &argv);\n"
+        "  int rank;\n"
+        "  MPI_Comm_rank(MPI_COMM_WORLD, &rank);\n"
+        "  MPI_Barrier(MPI_COMM_WORLD);\n"
+        "  if (rank == 1) MPI_Abort(MPI_COMM_WORLD, 7);\n"
+        "  MPI_Barrier(MPI_COMM_WORLD);  /* rank 0 hangs here */\n"
+        "  MPI_Finalize();\n"
+        "  return 0;\n"
+        "}\n")
+    binp = tmp_path / "aborter"
+    libdir = os.path.dirname(so)
+    libname = os.path.basename(so)[3:].rsplit(".so", 1)[0]
+    subprocess.run(
+        ["gcc", str(src), "-o", str(binp), "-I",
+         native.mpi_header_dir(), "-L", libdir, f"-l{libname}",
+         f"-Wl,-rpath,{libdir}"], check=True, capture_output=True)
+    port = _free_port()
+    procs = []
+    for r in range(2):
+        env = dict(os.environ)
+        env.update({"ZMPI_RANK": str(r), "ZMPI_SIZE": "2",
+                    "ZMPI_COORD_HOST": "127.0.0.1",
+                    "ZMPI_COORD_PORT": str(port), "ZMPI_MCA_sm": "1"})
+        procs.append(subprocess.Popen([str(binp)], env=env,
+                                      stdout=subprocess.PIPE,
+                                      stderr=subprocess.PIPE, text=True))
+    # rank 1 aborts; rank 0 blocks in the second barrier forever — kill
+    # it after rank 1 is gone (the launcher's abort-teardown role)
+    procs[1].communicate(timeout=60)
+    assert procs[1].returncode == 7
+    import time
+    time.sleep(0.5)
+    procs[0].kill()
+    procs[0].communicate(timeout=30)
+    # rank 1's OWN ring (1->0) must be gone via the Abort sweep; rank
+    # 0's ring (0->1) may survive the SIGKILL — that is the launcher
+    # sweep's job, so clean it here to keep the host tidy
+    leftovers = _ring_files(port)
+    assert f"zompi_ring_{port}_1_0" not in leftovers
+    for f in leftovers:
+        os.unlink(os.path.join("/dev/shm", f))
+
+
+def test_mixed_on_off_degrades_to_tcp(ring_bin):
+    """One rank forces rings on, the other off: the enabled rank's
+    outbound ring finds no partner (cap absent), activation degrades
+    to TCP on both sides, the job completes, and no files survive."""
+    port = _free_port()
+    outs = _run(ring_bin, port, 2, {0: "1", 1: "0"})
+    for r in range(2):
+        assert f"ring_c rank {r}/2 OK" in outs[r]
+    assert _ring_files(port) == []

@@ -721,11 +721,21 @@ bool sm_enabled() {
   return sysconf(_SC_NPROCESSORS_ONLN) > 1;
 }
 
+// segment-name session tag: the launcher's ZMPI_SESSION when present
+// (inherited by spawn children, whose coordinator port differs —
+// keeps the whole job tree under ONE sweepable prefix), else the
+// coordinator port (direct launches)
+const char *session_tag() {
+  const char *t = getenv("ZMPI_SESSION");
+  if (t && t[0]) return t;
+  t = getenv("ZMPI_COORD_PORT");
+  return t && t[0] ? t : "0";
+}
+
 std::string sm_ring_path(int src, int dst) {
-  const char *port = getenv("ZMPI_COORD_PORT");
   char buf[96];
-  snprintf(buf, sizeof buf, "/zompi_ring_%s_%d_%d",
-           port ? port : "0", src, dst);
+  snprintf(buf, sizeof buf, "/zompi_ring_%s_%d_%d", session_tag(), src,
+           dst);
   return buf;
 }
 
@@ -9939,8 +9949,7 @@ int MPI_Win_allocate_shared(MPI_Aint size, int disp_unit, MPI_Info info,
   // deterministic segment name: every member computes the same (the
   // same collapse as the wire win-id)
   char path[128];
-  snprintf(path, sizeof path, "/zompi_shm_%s_%llx_%llu",
-           getenv("ZMPI_COORD_PORT") ? getenv("ZMPI_COORD_PORT") : "0",
+  snprintf(path, sizeof path, "/zompi_shm_%s_%llx_%llu", session_tag(),
            (unsigned long long)c->cid_pt2pt,
            (unsigned long long)c->win_seq);
   size_t map_len = total > 0 ? (size_t)total : 1;
@@ -11693,6 +11702,11 @@ int MPI_T_pvar_read(MPI_T_pvar_session session, MPI_T_pvar_handle h,
 
 int MPI_Abort(MPI_Comm, int errorcode) {
   fprintf(stderr, "MPI_Abort(%d)\n", errorcode);
+  // best-effort: unlink this rank's ring files so an aborted job does
+  // not strand /dev/shm segments (the launcher sweeps the rest; pure
+  // syscalls, safe in this context)
+  for (auto &e : g_sm_out)
+    if (e.second->creator) shm_unlink(e.second->path.c_str());
   _exit(errorcode ? errorcode : 1);
 }
 

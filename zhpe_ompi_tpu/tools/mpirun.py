@@ -162,6 +162,11 @@ def build_env(rank: int, size: int, host: str, port: int,
         # the launcher hosts the rendezvous: rank 0 joins as a client
         # instead of binding the coordinator itself
         "ZMPI_COORD_EXTERNAL": "1",
+        # session tag for /dev/shm segment names: INHERITED by
+        # MPI_Comm_spawn children (whose coordinator port differs), so
+        # the launcher's end-of-job sweep catches every segment of the
+        # whole job tree with one prefix
+        "ZMPI_SESSION": str(port),
     })
     if ns_port is not None:
         env["ZMPI_NAMESERVER"] = f"{host}:{ns_port}"
@@ -217,6 +222,26 @@ def launch_mpmd(apps: list[tuple[int, list[str]]], host: str = "127.0.0.1",
                            tag_output, stdout, stderr)
     finally:
         ns_srv.close()  # stops the name-server accept loop
+        _sweep_session_shm(port)
+
+
+def _sweep_session_shm(port: int) -> None:
+    """The PRRTE session-directory cleanup analog: a rank that aborts
+    (or is killed) never reaches MPI_Finalize, so its /dev/shm ring and
+    shared-window segments survive it.  Every segment of the job TREE
+    embeds the launcher's session tag (ZMPI_SESSION, inherited through
+    MPI_Comm_spawn whose children rendezvous on a different port), so
+    one prefix sweep covers spawned ranks too."""
+    try:
+        for f in os.listdir("/dev/shm"):
+            if f.startswith(f"zompi_ring_{port}_") or \
+                    f.startswith(f"zompi_shm_{port}_"):
+                try:
+                    os.unlink(os.path.join("/dev/shm", f))
+                except OSError:
+                    pass
+    except OSError:
+        pass  # /dev/shm absent: nothing to sweep
 
 
 def _launch_job(n, cmds, host, port, ns_port, mca, timeout, tag_output,
