@@ -51,6 +51,27 @@ int main(int argc, char **argv) {
   CHECK(MPI_Info_get_nkeys(info, &nkeys) == MPI_SUCCESS && nkeys == 2);
   CHECK(MPI_Info_get_nkeys(dup, &nkeys) == MPI_SUCCESS && nkeys == 1);
 
+  /* ---- MPI_INFO_ENV: the read-only startup snapshot ---- */
+  {
+    int nk = -1, f2 = 0;
+    char v2[MPI_MAX_INFO_VAL + 1];
+    CHECK(MPI_Info_get_nkeys(MPI_INFO_ENV, &nk) == MPI_SUCCESS &&
+          nk >= 4);
+    CHECK(MPI_Info_get(MPI_INFO_ENV, "maxprocs", MPI_MAX_INFO_VAL, v2,
+                       &f2) == MPI_SUCCESS && f2 == 1);
+    CHECK(atoi(v2) == size);
+    CHECK(MPI_Info_get(MPI_INFO_ENV, "thread_level", MPI_MAX_INFO_VAL,
+                       v2, &f2) == MPI_SUCCESS && f2 == 1);
+    CHECK(MPI_Info_set(MPI_INFO_ENV, "x", "y") == MPI_ERR_INFO);
+    MPI_Info e2 = MPI_INFO_ENV;
+    CHECK(MPI_Info_free(&e2) == MPI_ERR_INFO); /* predefined */
+    /* dup of INFO_ENV yields an ordinary mutable copy */
+    MPI_Info cp;
+    CHECK(MPI_Info_dup(MPI_INFO_ENV, &cp) == MPI_SUCCESS);
+    CHECK(MPI_Info_set(cp, "x", "y") == MPI_SUCCESS);
+    CHECK(MPI_Info_free(&cp) == MPI_SUCCESS);
+  }
+
   /* ---- naming ---- */
   char name[MPI_MAX_OBJECT_NAME];
   int rlen = -1;

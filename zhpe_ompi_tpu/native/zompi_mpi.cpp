@@ -3072,6 +3072,9 @@ int MPI_Init(int *, char ***) {
     sm_setup(cohort_base, cohort_size);
   }
 
+  extern void build_env_info_hook(void);
+  build_env_info_hook();  // MPI_INFO_ENV startup snapshot (10.5.3)
+
   g.initialized = true;
   return MPI_SUCCESS;
 }
@@ -9407,6 +9410,8 @@ int MPI_Init_thread(int *argc, char ***argv, int required, int *provided) {
   if (g_thread_level < MPI_THREAD_SINGLE)
     g_thread_level = MPI_THREAD_SINGLE;
   if (provided) *provided = g_thread_level;
+  extern void build_env_info_hook(void);
+  build_env_info_hook();  // the snapshot's thread_level key moved
   return MPI_SUCCESS;
 }
 
@@ -10131,7 +10136,38 @@ struct InfoObj {
 static std::map<int, InfoObj> g_infos;
 static int g_next_info = 1;  // 0 = MPI_INFO_NULL
 
+// the MPI_INFO_ENV snapshot: built EAGERLY at the end of MPI_Init
+// (wdir must be the LAUNCH directory, not wherever the app chdir'd
+// before first touching the object — MPI-3.1 10.5.3), read-only after
+static InfoObj g_env_info;
+
+void build_env_info() {
+  g_env_info.kv.clear();
+  char buf[4096];
+  ssize_t n2 = readlink("/proc/self/exe", buf, sizeof buf - 1);
+  if (n2 > 0) {
+    buf[n2] = '\0';
+    g_env_info.kv.push_back({"command", buf});
+  }
+  if (getcwd(buf, sizeof buf)) g_env_info.kv.push_back({"wdir", buf});
+  if (gethostname(buf, sizeof buf) == 0)
+    g_env_info.kv.push_back({"host", buf});
+  g_env_info.kv.push_back(
+      {"maxprocs", std::to_string(g.size > 0 ? g.size : 1)});
+  const char *lvl = "MPI_THREAD_SINGLE";
+  if (g_thread_level >= MPI_THREAD_MULTIPLE)
+    lvl = "MPI_THREAD_MULTIPLE";
+  else if (g_thread_level == MPI_THREAD_SERIALIZED)
+    lvl = "MPI_THREAD_SERIALIZED";
+  else if (g_thread_level == MPI_THREAD_FUNNELED)
+    lvl = "MPI_THREAD_FUNNELED";
+  g_env_info.kv.push_back({"thread_level", lvl});
+}
+
+void build_env_info_hook(void) { build_env_info(); }
+
 static InfoObj *lookup_info(MPI_Info h) {
+  if (h == MPI_INFO_ENV) return &g_env_info;
   auto it = g_infos.find(h);
   return it == g_infos.end() ? nullptr : &it->second;
 }
@@ -10142,14 +10178,21 @@ static std::map<int, InfoObj> g_comm_info, g_win_info, g_file_info;
 // object names; comm defaults seeded lazily for WORLD/SELF
 static std::map<int, std::string> g_comm_names, g_type_names, g_win_names;
 
-int MPI_Info_create(MPI_Info *info) {
+static int next_info_handle() {
   int h = g_next_info++;
+  if (h == MPI_INFO_ENV) h = g_next_info++;  // never alias the sentinel
+  return h;
+}
+
+int MPI_Info_create(MPI_Info *info) {
+  int h = next_info_handle();
   g_infos[h] = InfoObj{};
   *info = h;
   return MPI_SUCCESS;
 }
 
 int MPI_Info_free(MPI_Info *info) {
+  if (info && *info == MPI_INFO_ENV) return MPI_ERR_INFO;  // predefined
   if (!info || !g_infos.erase(*info)) return MPI_ERR_INFO;
   *info = MPI_INFO_NULL;
   return MPI_SUCCESS;
@@ -10158,13 +10201,14 @@ int MPI_Info_free(MPI_Info *info) {
 int MPI_Info_dup(MPI_Info info, MPI_Info *newinfo) {
   InfoObj *o = lookup_info(info);
   if (!o) return MPI_ERR_INFO;
-  int h = g_next_info++;
+  int h = next_info_handle();
   g_infos[h] = *o;
   *newinfo = h;
   return MPI_SUCCESS;
 }
 
 int MPI_Info_set(MPI_Info info, const char *key, const char *value) {
+  if (info == MPI_INFO_ENV) return MPI_ERR_INFO;  // read-only
   InfoObj *o = lookup_info(info);
   if (!o) return MPI_ERR_INFO;
   if (!key || !*key || strlen(key) > MPI_MAX_INFO_KEY)
@@ -10181,6 +10225,7 @@ int MPI_Info_set(MPI_Info info, const char *key, const char *value) {
 }
 
 int MPI_Info_delete(MPI_Info info, const char *key) {
+  if (info == MPI_INFO_ENV) return MPI_ERR_INFO;  // read-only
   InfoObj *o = lookup_info(info);
   if (!o) return MPI_ERR_INFO;
   for (auto it = o->kv.begin(); it != o->kv.end(); ++it)
@@ -10249,7 +10294,7 @@ int object_set_info(std::map<int, InfoObj> &table, int handle,
 
 int object_get_info(std::map<int, InfoObj> &table, int handle,
                     MPI_Info *info_used) {
-  int h = g_next_info++;
+  int h = next_info_handle();
   auto it = table.find(handle);
   g_infos[h] = it == table.end() ? InfoObj{} : it->second;
   *info_used = h;

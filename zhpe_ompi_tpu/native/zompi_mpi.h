@@ -531,6 +531,10 @@ int MPI_Pcontrol(const int level, ...);
 
 /* info objects (info_create.c family): ordered string dictionaries */
 #define MPI_MAX_INFO_KEY   255
+/* the predefined startup-info object (MPI-3.1 10.5.3): command, wdir,
+ * host, thread_level, maxprocs — read-only snapshot of this rank's
+ * launch environment */
+#define MPI_INFO_ENV (0x7FFE)
 #define MPI_MAX_INFO_VAL   1024
 #define MPI_ERR_INFO       34
 #define MPI_ERR_INFO_KEY   29
