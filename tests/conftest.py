@@ -26,6 +26,45 @@ jax.config.update("jax_platforms", "cpu")
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: multi-second stress cases excluded from the tier-1 run "
+        "(selected out by -m 'not slow')",
+    )
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _ulfm_detector_hygiene():
+    """Suite-wide ULFM acceptance gates, checked once at session end:
+    the heartbeat failure detector must produce ZERO false positives
+    across a clean run (suspicions of ranks no fault plan killed), and
+    no detector thread may leak past its test's fixtures."""
+    yield
+    from zhpe_ompi_tpu.ft import ulfm
+
+    fps = ulfm.false_positive_count()
+    assert fps == 0, (
+        f"failure detector produced {fps} false positive(s) — a rank "
+        "was suspected dead that no fault plan ever killed"
+    )
+    leaked = ulfm.live_detectors()
+    assert not leaked, f"heartbeat detector threads leaked: {leaked}"
+
+
+@pytest.fixture(autouse=True)
+def _ulfm_expected_kill_isolation():
+    """Per-test isolation for the detector-accuracy bookkeeping: the
+    ranks a fault plan killed are forgotten after each test, so the
+    session-wide zero-false-positive gate keeps full strength (a rank
+    number one test legitimately killed must not excuse a later test's
+    false suspicion of the same number)."""
+    yield
+    from zhpe_ompi_tpu.ft import ulfm
+
+    ulfm.clear_expected_failures()
+
+
 @pytest.fixture()
 def fresh_vars():
     """Snapshot/restore the MCA var registry around a test."""

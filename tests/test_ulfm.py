@@ -1,0 +1,1067 @@
+"""ULFM failure mitigation: typed failure classes through errhandler
+dispositions, ring heartbeat detector, revoke/shrink/agree, and the
+deterministic fault-injection harness (reference: the ULFM machinery the
+OMPI 5.x fork was landing — MPIX_Comm_revoke/_shrink/_agree,
+MPIX_ERR_PROC_FAILED{,_PENDING}, MPIX_ERR_REVOKED)."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import zhpe_ompi_tpu as zmpi
+from zhpe_ompi_tpu import ops
+from zhpe_ompi_tpu.core import errhandler as errh
+from zhpe_ompi_tpu.core import errors
+from zhpe_ompi_tpu.ft import ulfm
+from zhpe_ompi_tpu.ft.inject import FaultPlan, replay_rejoin
+from zhpe_ompi_tpu.ft.vprotocol import UniverseLogger
+from zhpe_ompi_tpu.mca import var as mca_var
+from zhpe_ompi_tpu.pt2pt.matching import ANY_SOURCE
+from zhpe_ompi_tpu.pt2pt.tcp import TcpProc
+from zhpe_ompi_tpu.pt2pt.universe import LocalUniverse
+
+N = 4
+
+
+class TestErrorClasses:
+    def test_codes_and_strings(self):
+        assert errors.ERR_PROC_FAILED == 75
+        assert errors.ERR_PROC_FAILED_PENDING == 76
+        assert errors.ERR_REVOKED == 77
+        assert "PROC_FAILED" in errors.error_string(errors.ERR_PROC_FAILED)
+        assert "REVOKED" in errors.error_string(errors.ERR_REVOKED)
+
+    def test_typed_exceptions(self):
+        e = errors.ProcFailed("x", failed_ranks=[3, 1])
+        assert e.errclass == errors.ERR_PROC_FAILED
+        assert e.failed_ranks == (1, 3)
+        p = errors.ProcFailedPending("y", failed_ranks=[2])
+        assert p.errclass == errors.ERR_PROC_FAILED_PENDING
+        assert isinstance(p, errors.ProcFailed)  # ack-able failure family
+        r = errors.Revoked("z", cid=9)
+        assert r.errclass == errors.ERR_REVOKED and r.cid == 9
+
+    def test_jobabort_carries_failed_ranks(self):
+        exc = errors.ProcFailed("dead", failed_ranks=[2])
+        abort = errh.JobAbort("comm0", exc)
+        assert abort.failed_ranks == (2,)
+        assert abort.errclass == errors.ERR_PROC_FAILED
+
+
+class TestFailureState:
+    def test_mark_ack_restore(self):
+        st = ulfm.FailureState(4)
+        assert st.live() == [0, 1, 2, 3]
+        assert st.mark_failed(2, cause="killed")
+        assert not st.mark_failed(2)  # idempotent
+        assert st.is_failed(2) and st.live() == [0, 1, 3]
+        assert st.unacked() == frozenset({2})
+        st.ack()
+        assert st.acked() == frozenset({2}) and not st.unacked()
+        st.restore(2)
+        assert not st.is_failed(2) and st.live() == [0, 1, 2, 3]
+
+    def test_wait_failed(self):
+        st = ulfm.FailureState(2)
+        t = threading.Timer(0.05, lambda: st.mark_failed(1))
+        t.start()
+        try:
+            assert st.wait_failed(1, timeout=5.0)
+        finally:
+            t.join()
+        assert not st.wait_failed(0, timeout=0.05)
+
+    def test_revocation(self):
+        st = ulfm.FailureState(2)
+        st.revoke(7)
+        assert st.is_revoked(7) and not st.is_revoked(8)
+        with pytest.raises(errors.Revoked):
+            st.check_revoked(7)
+        st.check_revoked(8)  # no raise
+
+
+class TestUniverseFailureDelivery:
+    """Satellite: typed ProcFailed (not a generic queue timeout) to
+    receivers blocked on a rank that exits, including ANY_SOURCE."""
+
+    def test_named_source_death_is_typed(self):
+        uni = LocalUniverse(2, ft=True)
+
+        def prog(ctx):
+            ctx.set_errhandler(errh.ERRORS_RETURN)
+            if ctx.rank == 0:
+                with pytest.raises(errors.ProcFailed) as ei:
+                    ctx.recv(source=1, tag=7, timeout=10.0)
+                assert 1 in ei.value.failed_ranks
+                return "survived"
+            return None  # rank 1 exits without sending
+
+        assert uni.run(prog)[0] == "survived"
+
+    def test_any_source_death_is_pending(self):
+        uni = LocalUniverse(3, ft=True)
+
+        def prog(ctx):
+            ctx.set_errhandler(errh.ERRORS_RETURN)
+            if ctx.rank == 0:
+                with pytest.raises(errors.ProcFailedPending):
+                    ctx.recv(source=ANY_SOURCE, tag=7, timeout=10.0)
+                return "pending-seen"
+            return None  # everyone else exits silently
+
+        assert uni.run(prog)[0] == "pending-seen"
+
+    def test_ack_reenables_wildcard_and_message_survives(self):
+        """The ULFM pending contract: after failure_ack a wildcard
+        receive proceeds — and a message that raced the classification
+        must still be matchable (abandon/re-inject)."""
+        uni = LocalUniverse(3, ft=True)
+
+        def prog(ctx):
+            ctx.set_errhandler(errh.ERRORS_RETURN)
+            if ctx.rank == 0:
+                ctx.universe.ft_state.wait_failed(2, timeout=10.0)
+                with pytest.raises(errors.ProcFailedPending):
+                    ctx.recv(source=ANY_SOURCE, tag=7, timeout=10.0)
+                ctx.failure_ack()
+                assert ctx.failure_get_acked().ranks == (2,)
+                # rank 1 sends only after the ack round-trips
+                ctx.send(b"", 1, tag=8)
+                return ctx.recv(source=ANY_SOURCE, tag=7, timeout=10.0)
+            if ctx.rank == 1:
+                ctx.recv(source=0, tag=8, timeout=10.0)
+                ctx.send("late", 0, tag=7)
+                return None
+            return None  # rank 2 exits immediately
+
+        assert uni.run(prog)[0] == "late"
+
+    def test_dead_ranks_delivered_messages_survive(self):
+        """Death must not eat data already delivered: the dead rank's
+        last message is still receivable (final-drain contract)."""
+        uni = LocalUniverse(2, ft=True)
+
+        def prog(ctx):
+            ctx.set_errhandler(errh.ERRORS_RETURN)
+            if ctx.rank == 1:
+                ctx.send("parting-gift", 0, tag=5)
+                return None  # exits right after sending
+            ctx.universe.ft_state.wait_failed(1, timeout=10.0)
+            return ctx.recv(source=1, tag=5, timeout=10.0)
+
+        assert uni.run(prog)[0] == "parting-gift"
+
+    def test_send_to_dead_rank_is_typed(self):
+        """Sends to a known-failed rank classify typed ProcFailed like
+        the wire plane — a rendezvous-size send must not park its RTS
+        in the dead rank's mailbox and spin out the run timeout."""
+        uni = LocalUniverse(2, ft=True)
+        plan = FaultPlan(seed=0).kill_rank(1, after_ops=0)
+
+        def prog(ctx):
+            ctx.set_errhandler(errh.ERRORS_RETURN)
+            inj = plan.arm(ctx)
+            if ctx.rank == 1:
+                inj.send(b"", 0, tag=1)  # dies before the send
+            ctx.universe.ft_state.wait_failed(1, timeout=10.0)
+            big = np.zeros(100_000)  # > pt2pt_eager_limit: rendezvous
+            with pytest.raises(errors.ProcFailed):
+                ctx.send(big, 1, tag=2)
+            return "typed"
+
+        assert uni.run(prog)[0] == "typed"
+
+    def test_plain_timeout_still_a_stall(self):
+        """No failure, no message: a timed-out receive is a stall
+        (InternalError), never a ProcFailed — callers can distinguish."""
+        uni = LocalUniverse(2, ft=True)
+
+        def prog(ctx):
+            ctx.set_errhandler(errh.ERRORS_RETURN)
+            if ctx.rank == 0:
+                with pytest.raises(errors.InternalError, match="timeout"):
+                    ctx.recv(source=1, tag=9, timeout=0.2)
+            ctx.barrier()
+            return True
+
+        assert uni.run(prog) == [True, True]
+
+
+class TestUniverseReuse:
+    """A clean run's end-of-run "exit" marks are bookkeeping, not
+    process failures: the universe must be reusable for another run,
+    while killed/crashed ranks stay failed for recovery to own."""
+
+    def test_ft_universe_reusable_after_clean_run(self):
+        uni = LocalUniverse(2, ft=True)
+
+        def prog(ctx):
+            ctx.send(ctx.rank, 1 - ctx.rank, tag=1)
+            return ctx.recv(source=1 - ctx.rank, tag=1, timeout=10.0)
+
+        assert uni.run(prog) == [1, 0]
+        assert uni.ft_state.failed() == frozenset()
+        assert uni.run(prog) == [1, 0]  # second run: nobody "dead"
+
+    def test_killed_rank_stays_failed_after_run(self):
+        uni = LocalUniverse(2, ft=True)
+        plan = FaultPlan(seed=0).kill_rank(1, after_ops=0)
+
+        def prog(ctx):
+            ctx.set_errhandler(errh.ERRORS_RETURN)
+            inj = plan.arm(ctx)
+            if ctx.rank == 1:
+                inj.send(b"", 0, tag=1)  # dies before the send
+            return True
+
+        uni.run(prog)
+        assert uni.ft_state.failed() == frozenset({1})
+        assert uni.ft_state.cause_of(1) == "killed"
+
+
+class TestErrhandlerDispositions:
+    """Satellite: core/errhandler.py dispositions under injected faults."""
+
+    def _kill_and_recv(self, ctx, plan):
+        inj = plan.arm(ctx)
+        if ctx.rank == 1:
+            inj.send(b"x", 0, tag=1)  # op 1; next op dies
+            inj.recv(source=0, tag=2, timeout=10.0)
+        return ctx
+
+    def test_errors_are_fatal_aborts(self):
+        uni = LocalUniverse(2, ft=True)
+        plan = FaultPlan(seed=0).kill_rank(1, after_ops=1)
+
+        def prog(ctx):
+            self._kill_and_recv(ctx, plan)
+            if ctx.rank == 0:
+                # default disposition: the typed failure escalates
+                ctx.recv(source=1, tag=3, timeout=10.0)
+            return True
+
+        with pytest.raises(errh.JobAbort) as ei:
+            uni.run(prog)
+        assert isinstance(ei.value.cause, errors.ProcFailed)
+        assert 1 in ei.value.failed_ranks
+
+    def test_errors_return_raises_typed(self):
+        uni = LocalUniverse(2, ft=True)
+        plan = FaultPlan(seed=0).kill_rank(1, after_ops=1)
+
+        def prog(ctx):
+            ctx.set_errhandler(errh.ERRORS_RETURN)
+            self._kill_and_recv(ctx, plan)
+            if ctx.rank == 0:
+                with pytest.raises(errors.ProcFailed):
+                    ctx.recv(source=1, tag=3, timeout=10.0)
+                return "typed"
+            return None
+
+        assert uni.run(prog)[0] == "typed"
+
+    def test_user_handler_recovers_by_shrinking(self):
+        """A user errhandler that acks, shrinks, and finishes the job on
+        the survivor communicator — the ULFM recovery idiom."""
+        n = 3
+        uni = LocalUniverse(n, ft=True)
+        plan = FaultPlan(seed=0).kill_rank(2, after_ops=0)
+
+        def recover(ctx, exc):
+            assert isinstance(exc, errors.ProcFailed)
+            ctx.failure_ack()
+            sh = ctx.shrink()
+            return ("recovered",
+                    float(sh.allreduce(np.float64(ctx.rank), ops.SUM)))
+
+        def prog(ctx):
+            inj = plan.arm(ctx)
+            if ctx.rank == 2:
+                inj.send(b"", 0, tag=1)  # dies before the send
+            ctx.set_errhandler(errh.create(recover))
+            ctx.universe.ft_state.wait_failed(2, timeout=10.0)
+            return ctx.recv(source=2, tag=1, timeout=10.0)
+
+        res = uni.run(prog)
+        assert res[0] == res[1] == ("recovered", 1.0)  # 0 + 1
+
+
+class TestRingDetector:
+    def test_detector_discovers_muted_rank(self, fresh_vars):
+        """'mute' kill: heartbeats stop but nothing marks the death —
+        only the ring detector can discover it, and the suspicion must
+        propagate to every survivor via the shared state."""
+        mca_var.set_var("ft_detector_period", 0.02)
+        mca_var.set_var("ft_detector_timeout", 0.15)
+        uni = LocalUniverse(N, ft=True)
+        plan = FaultPlan(seed=5).kill_rank(2, after_ops=1, mode="mute")
+        uni.start_failure_detector()
+        try:
+            def prog(ctx):
+                ctx.set_errhandler(errh.ERRORS_RETURN)
+                inj = plan.arm(ctx)
+                if ctx.rank == 2:
+                    inj.send(b"", 3, tag=1)  # op 1; dies (mute) on op 2
+                    inj.recv(source=3, tag=2, timeout=10.0)
+                assert ctx.universe.ft_state.wait_failed(2, timeout=10.0)
+                return ctx.universe.ft_state.cause_of(2)
+
+            res = uni.run(prog)
+            assert res[0] == res[1] == res[3] == "detector"
+        finally:
+            uni.stop_failure_detector()
+        assert all(not d.is_alive() for d in uni.ft_detectors or [])
+
+    def test_clean_run_no_suspicions(self, fresh_vars):
+        """A healthy universe under an aggressive detector: zero
+        suspicions, zero failures — the false-positive gate."""
+        mca_var.set_var("ft_detector_period", 0.02)
+        mca_var.set_var("ft_detector_timeout", 0.3)
+        before = ulfm.false_positive_count()
+        uni = LocalUniverse(N, ft=True)
+        uni.start_failure_detector()
+        try:
+            def prog(ctx):
+                for lap in range(3):
+                    ctx.send(ctx.rank, (ctx.rank + 1) % N, tag=lap)
+                    ctx.recv(source=(ctx.rank - 1) % N, tag=lap,
+                             timeout=10.0)
+                return True
+
+            assert uni.run(prog) == [True] * N
+            assert uni.ft_state.failed() - {0, 1, 2, 3} == frozenset()
+            # exits are marked by the runner, but no DETECTOR suspicion
+            # may have fired for any of them
+            dets = uni.ft_detectors
+            assert all(d.suspicions == [] for d in dets)
+        finally:
+            uni.stop_failure_detector()
+        assert ulfm.false_positive_count() == before
+
+    def test_detectors_shut_down(self, fresh_vars):
+        uni = LocalUniverse(2, ft=True)
+        uni.start_failure_detector()
+        assert any(d.is_alive() for d in uni.ft_detectors)
+        uni.stop_failure_detector()
+        assert uni.ft_detectors == []
+        assert all("hb-uni" not in (t.name or "")
+                   for t in threading.enumerate())
+
+
+class TestAgree:
+    def test_agree_excludes_dead_participant(self):
+        uni = LocalUniverse(3, ft=True)
+        plan = FaultPlan(seed=0).kill_rank(2, after_ops=0)
+
+        def prog(ctx):
+            ctx.set_errhandler(errh.ERRORS_RETURN)
+            inj = plan.arm(ctx)
+            if ctx.rank == 2:
+                inj.send(b"", 0, tag=1)
+            ctx.universe.ft_state.wait_failed(2, timeout=10.0)
+            return ctx.agree(True)
+
+        assert uni.run(prog)[:2] == [True, True]
+
+    def test_agree_ands_flags(self):
+        uni = LocalUniverse(3, ft=True)
+
+        def prog(ctx):
+            return ctx.agree(ctx.rank != 1)  # one dissent
+
+        assert uni.run(prog) == [False, False, False]
+
+    def test_agree_survives_coordinator_death(self):
+        """Rank 0 (the coordinator) dies mid-protocol: survivors
+        re-elect rank 1 and the agreement still completes."""
+        uni = LocalUniverse(3, ft=True)
+        plan = FaultPlan(seed=0).kill_rank(0, after_ops=0)
+
+        def prog(ctx):
+            ctx.set_errhandler(errh.ERRORS_RETURN)
+            inj = plan.arm(ctx)
+            if ctx.rank == 0:
+                inj.send(b"", 1, tag=1)  # dies before sending
+            ctx.universe.ft_state.wait_failed(0, timeout=10.0)
+            return ctx.agree(True)
+
+        assert uni.run(prog)[1:] == [True, True]
+
+    def test_agree_survives_partial_result_delivery(self):
+        """The nastiest coordinator death: rank 0 gathers every
+        contribution, delivers the result to rank 3 ONLY, then dies.
+        Rank 3 publishes the value into the shared registry; ranks 1/2
+        (and rank 1 as the re-elected coordinator, gathering from the
+        already-departed rank 3) must converge on IT — never re-run a
+        round that could compute a different answer."""
+        uni = LocalUniverse(4, ft=True)
+        plan = FaultPlan(seed=0).kill_rank(0, after_ops=0)
+
+        def prog(ctx):
+            ctx.set_errhandler(errh.ERRORS_RETURN)
+            if ctx.rank == 0:
+                gather_tag, result_tag = ulfm._agree_tags(0)
+                acc = True
+                for r in (1, 2, 3):
+                    contrib = ctx.recv(source=r, tag=gather_tag,
+                                       cid=ulfm.FT_AGREE_CID,
+                                       timeout=10.0, poll=True)
+                    acc = acc and bool(contrib[1])
+                ctx.send((0, acc), 3, tag=result_tag,
+                         cid=ulfm.FT_AGREE_CID, poll=True)
+                plan.arm(ctx).die()  # unreachable past here
+            return ctx.agree(ctx.rank != 2)  # rank 2 dissents
+
+        res = uni.run(prog)
+        assert res[1:] == [False, False, False]
+
+
+class TestShrunkEndpoint:
+    def _shrunk(self, uni):
+        uni.ft_state.mark_failed(1, cause="killed")
+
+        def prog(ctx):
+            if ctx.rank == 1:
+                return None
+            sh = ctx.shrink()
+            got = sh.allgather(ctx.rank)
+            sh.barrier()
+            return (sh.rank, sh.size, got)
+
+        return uni.run(prog)
+
+    def test_renumbering_and_collectives(self):
+        uni = LocalUniverse(4, ft=True)
+        res = self._shrunk(uni)
+        assert res[0] == (0, 3, [0, 2, 3])
+        assert res[2] == (1, 3, [0, 2, 3])
+        assert res[3] == (2, 3, [0, 2, 3])
+
+    def test_wildcard_recv_despite_unacked_failure(self):
+        """The shrink contract: a shrunken communicator contains no
+        failed processes — a pre-shrink UNacknowledged failure must not
+        block its wildcard receives with ProcFailedPending."""
+        uni = LocalUniverse(3, ft=True)
+        uni.ft_state.mark_failed(2, cause="killed")
+
+        def prog(ctx):
+            ctx.set_errhandler(errh.ERRORS_RETURN)
+            if ctx.rank == 2:
+                return None
+            sh = ctx.shrink()  # nobody acked: the failure is pending
+            if sh.rank == 1:
+                sh.send(b"hello", 0, tag=4)
+                return "sent"
+            return sh.recv(source=ANY_SOURCE, tag=4, timeout=10.0)
+
+        res = uni.run(prog)
+        assert res[0] == b"hello" and res[1] == "sent"
+
+    def test_sendrecv_partner_death_is_typed(self):
+        """A ring-exchange partner that dies POST-shrink must surface
+        typed ProcFailed from the shrunken sendrecv, not hang the wait
+        (collectives built over sendrecv inherit failure delivery)."""
+        uni = LocalUniverse(3, ft=True)
+        uni.ft_state.mark_failed(2, cause="killed")
+
+        def prog(ctx):
+            ctx.set_errhandler(errh.ERRORS_RETURN)
+            if ctx.rank == 2:
+                return None
+            sh = ctx.shrink()
+            if sh.rank == 1:
+                return "left"  # departs without exchanging
+            with pytest.raises(errors.ProcFailed):
+                sh.sendrecv(b"x", dest=1, source=1, sendtag=1, recvtag=1)
+            return "typed"
+
+        assert uni.run(prog)[0] == "typed"
+
+    def test_non_survivor_cannot_shrink(self):
+        st = ulfm.FailureState(2)
+        st.mark_failed(0, cause="killed")
+
+        class FakeEp:
+            rank, size, ft_state = 0, 2, st
+
+        with pytest.raises(errors.ProcFailed):
+            ulfm.ShrunkEndpoint(FakeEp(), [1], generation=1)
+
+    def test_requires_ft(self):
+        uni = LocalUniverse(2)  # no ft
+        with pytest.raises(errors.UnsupportedError):
+            uni.contexts[0].shrink()
+        with pytest.raises(errors.UnsupportedError):
+            uni.contexts[0].failure_ack()
+
+
+class TestCommunicatorUlfm:
+    def test_revoke_poisons_collectives(self):
+        world = zmpi.init()
+        comm = zmpi.Communicator(world.mesh, world.axis)
+        comm.set_errhandler(errh.ERRORS_RETURN)
+        assert not comm.is_revoked()
+        comm.revoke()
+        assert comm.is_revoked()
+        with pytest.raises(errors.Revoked) as ei:
+            comm.barrier()
+        assert ei.value.cid == comm.cid
+
+    def test_revoke_is_fatal_by_default(self):
+        world = zmpi.init()
+        comm = zmpi.Communicator(world.mesh, world.axis)
+        comm.revoke()
+        with pytest.raises(errh.JobAbort) as ei:
+            comm.barrier()
+        assert ei.value.errclass == errors.ERR_REVOKED
+
+    def test_shrink_builds_survivor_partition(self):
+        world = zmpi.init()
+        comm = zmpi.Communicator(world.mesh, world.axis)
+        state = ulfm.FailureState(comm.axis_size)
+        comm.bind_failure_state(state)
+        state.mark_failed(2, cause="killed")
+        sh = comm.shrink()
+        survivors = [r for r in range(comm.axis_size) if r != 2]
+        assert list(sh.partition[0].ranks) == survivors
+        assert not sh.is_revoked()  # fresh cid, not poisoned
+        assert sh.ft_state is state
+
+    def test_shrink_after_revoke_yields_usable_comm(self):
+        world = zmpi.init()
+        comm = zmpi.Communicator(world.mesh, world.axis)
+        comm.set_errhandler(errh.ERRORS_RETURN)
+        state = ulfm.FailureState(comm.axis_size)
+        comm.bind_failure_state(state)
+        state.mark_failed(0, cause="killed")
+        comm.revoke()
+        sh = comm.shrink()
+        with pytest.raises(errors.Revoked):
+            comm.barrier()
+        assert not sh.is_revoked()
+
+    def test_agree_and_ack(self):
+        world = zmpi.init()
+        comm = zmpi.Communicator(world.mesh, world.axis)
+        state = ulfm.FailureState(comm.axis_size)
+        comm.bind_failure_state(state)
+        state.mark_failed(1, cause="killed")
+        # the dead rank's dissent is excluded; live dissent counts
+        assert comm.agree(True, contributions={0: True, 1: False})
+        assert not comm.agree(True, contributions={0: False, 1: True})
+        comm.failure_ack()
+        assert comm.failure_get_acked().ranks == (1,)
+
+    def test_explicit_failed_set_without_state(self):
+        world = zmpi.init()
+        comm = zmpi.Communicator(world.mesh, world.axis)
+        sh = comm.shrink(failed=[0])
+        assert 0 not in sh.partition[0].ranks
+        with pytest.raises(errors.ArgError):
+            comm.shrink()  # no state bound, no explicit set
+
+
+class TestFaultPlan:
+    def test_deterministic_from_seed(self):
+        a = FaultPlan(seed=42).random_kill(8, max_ops=16)
+        b = FaultPlan(seed=42).random_kill(8, max_ops=16)
+        assert a._kills == b._kills
+        c = FaultPlan(seed=43).random_kill(8, max_ops=16)
+        assert a.victims == b.victims
+        assert (a._kills != c._kills) or (a.seed != c.seed)
+
+    def test_op_counting(self):
+        uni = LocalUniverse(2, ft=True)
+        plan = FaultPlan(seed=0).kill_rank(0, after_ops=3)
+
+        def prog(ctx):
+            inj = plan.arm(ctx)
+            if ctx.rank == 0:
+                inj.send(b"a", 1, tag=1)      # op 1
+                inj.send(b"b", 1, tag=2)      # op 2
+                inj.recv(source=1, tag=3,     # op 3
+                         timeout=10.0)
+                inj.send(b"c", 1, tag=4)      # op 4 -> dies
+                return "unreachable"
+            ctx.recv(source=0, tag=1, timeout=10.0)
+            ctx.recv(source=0, tag=2, timeout=10.0)
+            ctx.send(b"z", 0, tag=3)
+            return "peer-done"
+
+        res = uni.run(prog)
+        assert res[0] is None and res[1] == "peer-done"
+        assert uni.ft_state.cause_of(0) == "killed"
+
+    def test_kill_fires_inside_collective(self):
+        """Collectives re-bind to the counted surface: a kill scheduled
+        before a collective's internal pt2pt traffic still fires, at a
+        pt2pt boundary inside the collective — the way a real crash
+        lands mid-allgather."""
+        uni = LocalUniverse(2, ft=True)
+        plan = FaultPlan(seed=0).kill_rank(1, after_ops=0)
+
+        def prog(ctx):
+            ctx.set_errhandler(errh.ERRORS_RETURN)
+            inj = plan.arm(ctx)
+            if ctx.rank == 1:
+                inj.allgather(ctx.rank)  # first internal op -> dies
+                return "unreachable"
+            try:
+                ctx.allgather(ctx.rank)
+            except errors.MpiError:
+                pass  # peer died mid-collective
+            return "survivor"
+
+        res = uni.run(prog)
+        assert res == ["survivor", None]
+        assert uni.ft_state.cause_of(1) == "killed"
+
+    def test_bad_args(self):
+        with pytest.raises(errors.ArgError):
+            FaultPlan().kill_rank(0, after_ops=-1)
+        with pytest.raises(errors.ArgError):
+            FaultPlan().kill_rank(0, 1, mode="nuke")
+
+
+class TestEndToEndRecovery:
+    """The acceptance path: FaultPlan kills 1 of 4 ranks mid-run;
+    survivors observe ProcFailed, revoke() propagates Revoked to every
+    live rank, shrink() yields a 3-rank communicator, agree() completes
+    despite the dead participant, and an allreduce over the shrunken
+    communicator returns the correct value."""
+
+    APP_CID = 5
+
+    def test_recovery_pipeline(self):
+        uni = LocalUniverse(N, ft=True)
+        plan = FaultPlan(seed=7).kill_rank(2, after_ops=2)
+
+        def prog(ctx):
+            ctx.set_errhandler(errh.ERRORS_RETURN)
+            inj = plan.arm(ctx)
+            observed = None
+            try:
+                for lap in range(2):
+                    inj.send(ctx.rank, dest=(ctx.rank + 1) % N, tag=lap,
+                             cid=self.APP_CID)
+                    inj.recv(source=(ctx.rank - 1) % N, tag=lap,
+                             cid=self.APP_CID, timeout=10.0)
+            except errors.ProcFailed as e:
+                observed = e
+            if observed is None:  # confirm the death explicitly
+                try:
+                    ctx.recv(source=2, tag=99, cid=self.APP_CID,
+                             timeout=10.0)
+                except errors.ProcFailed as e:
+                    observed = e
+            assert observed is not None and 2 in observed.failed_ranks
+            ctx.failure_ack()
+            # agreement completes despite the dead participant — and
+            # doubles as the uniform-knowledge barrier the ULFM recipe
+            # puts before revoke: nobody revokes until every survivor
+            # has observed and acknowledged the failure
+            agreed = ctx.agree(True)
+            # the lowest survivor revokes; EVERY live rank must observe
+            if ctx.rank == 0:
+                ctx.revoke(self.APP_CID)
+            saw_revoked = False
+            for _ in range(2000):
+                try:
+                    ctx.recv(source=(ctx.rank - 1) % N, tag=77,
+                             cid=self.APP_CID, timeout=0.01)
+                except errors.Revoked:
+                    saw_revoked = True
+                    break
+                except errors.MpiError:
+                    continue  # stall timeouts while the revoke spreads
+            assert saw_revoked
+            sh = ctx.shrink()
+            total = sh.allreduce(np.float64(ctx.rank), ops.SUM)
+            return (agreed, sh.rank, sh.size, float(total))
+
+        res = uni.run(prog, timeout=60.0)
+        assert res[2] is None  # the victim
+        for new_rank, old_rank in enumerate([0, 1, 3]):
+            assert res[old_rank] == (True, new_rank, 3, 4.0)  # 0+1+3
+
+    def test_send_to_revoked_cid_raises(self):
+        uni = LocalUniverse(2, ft=True)
+
+        def prog(ctx):
+            ctx.set_errhandler(errh.ERRORS_RETURN)
+            ctx.revoke(11)
+            with pytest.raises(errors.Revoked):
+                ctx.send(b"x", 1 - ctx.rank, tag=1, cid=11)
+            return True
+
+        assert uni.run(prog) == [True, True]
+
+    def test_user_handler_recovers_revoked_send(self):
+        """A user errhandler that RECOVERS from Revoked (returns a
+        value): isend must still hand back a Request — send()'s .wait()
+        rides it — carrying the handler's recovery result."""
+        uni = LocalUniverse(2, ft=True)
+        seen_cids = []
+
+        def handler(obj, exc):
+            seen_cids.append(exc.cid)
+            return "recovered"
+
+        def prog(ctx):
+            ctx.set_errhandler(errh.create(handler))
+            ctx.revoke(13)
+            req = ctx.isend(b"x", 1 - ctx.rank, tag=1, cid=13)
+            assert req.wait() == "recovered"
+            ctx.send(b"y", 1 - ctx.rank, tag=2, cid=13)  # must not crash
+            return True
+
+        assert uni.run(prog) == [True, True]
+        assert seen_cids == [13] * 4  # two ops on each of two ranks
+
+
+class TestRejoin:
+    """inject + vprotocol: a killed rank replays its pessimistic log and
+    rejoins the universe live once the log is exhausted."""
+
+    def test_replay_then_live_continuation(self):
+        uni = LocalUniverse(2, ft=True)
+        logger = UniverseLogger(uni)
+        plan = FaultPlan(seed=3).kill_rank(1, after_ops=2)
+
+        def prog(ctx):
+            ctx.set_errhandler(errh.ERRORS_RETURN)
+            w = plan.arm(logger.wrap(ctx))
+            if ctx.rank == 0:
+                w.send(7, dest=1, tag=1)
+                assert w.recv(source=1, tag=2, timeout=10.0) == 14
+                with pytest.raises(errors.ProcFailed):
+                    ctx.recv(source=1, tag=3, timeout=10.0)
+                return "survived"
+            got = w.recv(source=0, tag=1, timeout=10.0)  # op 1
+            w.send(got * 2, dest=0, tag=2)               # op 2
+            w.recv(source=0, tag=3, timeout=10.0)        # op 3 -> dies
+            return "unreachable"
+
+        res = uni.run(prog)
+        assert res == ["survived", None]
+        assert uni.ft_state.is_failed(1)
+
+        # restart rank 1: replay its log deterministically...
+        rj = replay_rejoin(logger, 1, uni.contexts[1])
+        assert not uni.ft_state.is_failed(1)  # restored on rejoin
+        assert rj.recv(source=0, tag=1) == 7   # from the log
+        rj.send(14, dest=0, tag=2)             # swallowed (delivered)
+        assert rj.fully_replayed
+        # ...then go LIVE on the universe transport
+        rj.send("back-online", dest=0, tag=9)
+        got = uni.contexts[0].recv(source=1, tag=9, timeout=10.0)
+        assert got == "back-online"
+
+    def test_return_status_shape_survives_replay(self):
+        """return_status parity across the logged, replayed, and rejoin
+        surfaces: the (value, status) shape must not change when the
+        log runs dry mid-program."""
+        uni = LocalUniverse(2, ft=True)
+        logger = UniverseLogger(uni)
+
+        def prog(ctx):
+            w = logger.wrap(ctx)
+            if ctx.rank == 0:
+                w.send(5, dest=1, tag=1)
+                return None
+            value, status = w.recv(source=ANY_SOURCE, tag=1,
+                                   timeout=10.0, return_status=True)
+            assert (value, status.source, status.tag) == (5, 0, 1)
+            return "ok"
+
+        assert uni.run(prog)[1] == "ok"
+        # the restarted rank's REPLAYED receive returns the same shape,
+        # with the logged resolved source/tag as its status
+        rj = logger.rejoin_context(1)
+        value, status = rj.recv(source=ANY_SOURCE, tag=1,
+                                return_status=True)
+        assert (value, status.source, status.tag) == (5, 0, 1)
+        assert rj.fully_replayed
+
+
+def run_tcp_ft(n, fn, timeout=60.0, proc_timeout=15.0):
+    """Launch n ft-enabled TcpProcs over a localhost coordinator."""
+    coord_ready = threading.Event()
+    coord_addr = [None]
+    results = [None] * n
+    procs = [None] * n
+    excs = [None] * n
+
+    def publish(addr):
+        coord_addr[0] = addr
+        coord_ready.set()
+
+    def main(rank):
+        proc = None
+        try:
+            if rank == 0:
+                proc = TcpProc(0, n, coordinator=("127.0.0.1", 0),
+                               timeout=proc_timeout, ft=True,
+                               on_coordinator_bound=publish)
+            else:
+                coord_ready.wait(10)
+                proc = TcpProc(rank, n, coordinator=coord_addr[0],
+                               timeout=proc_timeout, ft=True)
+            procs[rank] = proc
+            try:
+                results[rank] = fn(proc)
+            except ulfm.RankKilled:
+                results[rank] = "killed"
+        except BaseException as e:  # noqa: BLE001
+            excs[rank] = e
+            coord_ready.set()
+        finally:
+            if proc is not None and not proc._ft_dead:
+                proc.close()
+            elif proc is not None and proc._detector is not None:
+                proc._detector.stop()
+
+    threads = [threading.Thread(target=main, args=(r,)) for r in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout)
+        assert not t.is_alive(), "tcp rank hung"
+    # "dead" procs kept their sockets up for the scenario's sake (mute)
+    # or were severed; release whatever is left so nothing leaks into
+    # later tests
+    for p in procs:
+        if p is not None and p._ft_dead:
+            p.close()
+    for e in excs:
+        if e is not None:
+            raise e
+    return results
+
+
+class TestTcpUlfm:
+    """ULFM over real sockets: severed connections classify as peer
+    death, the wire detector floods suspicion, survivors recover."""
+
+    def test_severed_rank_recovery(self, fresh_vars):
+        mca_var.set_var("ft_detector_period", 0.05)
+        mca_var.set_var("ft_detector_timeout", 0.4)
+        n = 3
+        plan = FaultPlan(seed=1).kill_rank(2, after_ops=1)
+
+        def prog(p):
+            p.set_errhandler(errh.ERRORS_RETURN)
+            inj = plan.arm(p)
+            inj.send(p.rank, dest=(p.rank + 1) % n, tag=1)
+            inj.recv(source=(p.rank - 1) % n, tag=1, timeout=10.0)
+            assert p.ft_state.wait_failed(2, timeout=10.0)
+            p.failure_ack()
+            agreed = p.agree(True)
+            sh = p.shrink()
+            total = sh.allreduce(np.float64(p.rank), ops.SUM)
+            return (agreed, sh.rank, sh.size, float(total))
+
+        res = run_tcp_ft(n, prog)
+        assert res[2] == "killed"
+        assert res[0] == (True, 0, 2, 1.0)
+        assert res[1] == (True, 1, 2, 1.0)
+
+    def test_muted_rank_found_by_detector_only(self, fresh_vars):
+        """mute kill: sockets stay open, only heartbeats stop — the ring
+        detector is the sole discovery path and must flood the news."""
+        mca_var.set_var("ft_detector_period", 0.05)
+        mca_var.set_var("ft_detector_timeout", 0.4)
+        n = 3
+        plan = FaultPlan(seed=2).kill_rank(1, after_ops=1, mode="mute")
+
+        def prog(p):
+            p.set_errhandler(errh.ERRORS_RETURN)
+            inj = plan.arm(p)
+            inj.send(p.rank, dest=(p.rank + 1) % n, tag=1)
+            inj.recv(source=(p.rank - 1) % n, tag=1, timeout=10.0)
+            assert p.ft_state.wait_failed(1, timeout=10.0)
+            return p.ft_state.cause_of(1)
+
+        res = run_tcp_ft(n, prog)
+        assert res[1] == "killed"
+        # one survivor is the origin detector; the other may learn from
+        # the flood — both must know, neither may call it a stall
+        assert set(res[0::2]) <= {"detector", "notice"}
+
+    def test_agree_completes_under_fatal_disposition(self, fresh_vars):
+        """MPIX_Comm_agree must complete despite participant death even
+        under the DEFAULT disposition (ERRORS_ARE_FATAL): the protocol's
+        internal sends bypass the errhandler, so a dead coordinator
+        triggers re-election instead of JobAbort."""
+        mca_var.set_var("ft_detector_period", 0.05)
+        mca_var.set_var("ft_detector_timeout", 0.4)
+        n = 3
+        plan = FaultPlan(seed=3).kill_rank(0, after_ops=0)
+
+        def prog(p):
+            # deliberately NO set_errhandler: FATAL is the default
+            inj = plan.arm(p)
+            if p.rank == 0:
+                inj.send(b"", 1, tag=1)  # dies on op 1
+            if p.rank == 1:
+                assert p.ft_state.wait_failed(0, timeout=10.0)
+                p.send(b"go", 2, tag=2)
+            if p.rank == 2:
+                # may still believe rank 0 (the initial coordinator) is
+                # alive here: agree's first gather send then hits the
+                # corpse and must RE-ELECT, not abort the job
+                p.recv(source=1, tag=2, timeout=10.0)
+            return p.agree(True)
+
+        res = run_tcp_ft(n, prog)
+        assert res[0] == "killed"
+        assert res[1] is True and res[2] is True
+
+    def test_agree_survives_partial_result_delivery_wire(self, fresh_vars):
+        """Wire flavor of the partial-delivery death: the coordinator
+        hands the result to rank 2 only, then hangs (mute — sockets stay
+        up, so the delivered frame cannot be lost to an RST).  Rank 2's
+        completed agreement is ANNOUNCED into the survivors' registries;
+        rank 1, stuck waiting on the dead coordinator, must adopt it
+        after the detector fires instead of timing out a fresh round."""
+        mca_var.set_var("ft_detector_period", 0.05)
+        mca_var.set_var("ft_detector_timeout", 0.4)
+        n = 3
+        plan = FaultPlan(seed=4).kill_rank(0, after_ops=0, mode="mute")
+
+        def prog(p):
+            p.set_errhandler(errh.ERRORS_RETURN)
+            if p.rank == 0:
+                gather_tag, result_tag = ulfm._agree_tags(0)
+                acc = True
+                for r in (1, 2):
+                    contrib = p.recv(source=r, tag=gather_tag,
+                                     cid=ulfm.FT_AGREE_CID,
+                                     timeout=10.0, poll=True)
+                    acc = acc and bool(contrib[1])
+                p.send((0, acc), 2, tag=result_tag,
+                       cid=ulfm.FT_AGREE_CID, poll=True)
+                plan.arm(p).die()  # unreachable past here
+            return p.agree(p.rank != 2)  # rank 2 dissents
+
+        res = run_tcp_ft(n, prog)
+        assert res[0] == "killed"
+        assert res[1] is False and res[2] is False
+
+    def test_self_send_on_revoked_cid_raises(self, fresh_vars):
+        """The loopback fast path must observe revocation too."""
+
+        def prog(p):
+            p.set_errhandler(errh.ERRORS_RETURN)
+            p.revoke(21)
+            with pytest.raises(errors.Revoked):
+                p.send(b"x", p.rank, tag=1, cid=21)
+            return True
+
+        assert run_tcp_ft(1, prog) == [True]
+
+    def test_clean_staggered_close_no_false_positive(self, fresh_vars):
+        """An orderly close() announces departure: a survivor whose
+        detector outlives the departed rank's missed-beat window must
+        reconfigure its ring via the goodbye notice, never suspect."""
+        mca_var.set_var("ft_detector_period", 0.02)
+        mca_var.set_var("ft_detector_timeout", 0.15)
+        before = ulfm.false_positive_count()
+
+        def prog(p):
+            p.barrier()
+            if p.rank == 1:
+                # outlive rank 0's close by several detector windows
+                time.sleep(0.5)
+                assert p.ft_state.cause_of(0) != "detector"
+            return True
+
+        assert run_tcp_ft(2, prog) == [True, True]
+        assert ulfm.false_positive_count() == before
+
+    def test_clean_close_does_not_gate_wildcards(self, fresh_vars):
+        """Orderly departure is pre-acknowledged (cause="goodbye"): a
+        survivor's wildcard receive must not raise ProcFailedPending
+        over normal finalize skew — that gate is for CRASHES recovery
+        has not yet acknowledged."""
+        n = 3
+
+        def prog(p):
+            p.set_errhandler(errh.ERRORS_RETURN)
+            p.barrier()
+            if p.rank == 0:
+                return True  # departs; close() sends the goodbye
+            if p.rank == 2:
+                p.ft_state.wait_failed(0, timeout=10.0)
+                p.send("late", 1, tag=6)
+                return "sent"
+            p.ft_state.wait_failed(0, timeout=10.0)
+            assert p.ft_state.cause_of(0) == "goodbye"
+            return p.recv(source=ANY_SOURCE, tag=6, timeout=10.0)
+
+        res = run_tcp_ft(n, prog)
+        assert res == [True, "late", "sent"]
+
+    def test_revoke_floods_over_wire(self, fresh_vars):
+        n = 2
+
+        def prog(p):
+            p.set_errhandler(errh.ERRORS_RETURN)
+            p.barrier()
+            if p.rank == 0:
+                p.revoke(13)
+                p.barrier()
+                return True
+            # rank 1 learns of the revocation only via the flood
+            deadline = 200
+            for _ in range(deadline):
+                try:
+                    p.recv(source=0, tag=1, cid=13, timeout=0.05)
+                except errors.Revoked:
+                    p.barrier()
+                    return True
+                except errors.MpiError:
+                    continue
+            return False
+
+        assert run_tcp_ft(n, prog) == [True, True]
+
+
+@pytest.mark.slow
+class TestInjectionStress:
+    """Multi-second randomized stress (excluded from tier-1): seed-driven
+    kills across many runs, every survivor set must recover."""
+
+    def test_random_kill_sweep(self):
+        for seed in range(6):
+            plan = FaultPlan(seed=seed).random_kill(N, max_ops=6)
+            victim = next(iter(plan.victims))
+            uni = LocalUniverse(N, ft=True)
+
+            def prog(ctx, plan=plan, victim=victim):
+                ctx.set_errhandler(errh.ERRORS_RETURN)
+                inj = plan.arm(ctx)
+                try:
+                    for lap in range(4):
+                        inj.send(ctx.rank, (ctx.rank + 1) % N, tag=lap)
+                        # short stall timeout: a peer that bailed out of
+                        # the ring after observing the death upstream
+                        # never sends — both outcomes (ProcFailed and
+                        # stall) mean "leave the ring and recover"
+                        inj.recv(source=(ctx.rank - 1) % N, tag=lap,
+                                 timeout=2.0)
+                except errors.MpiError:
+                    pass
+                if ctx.rank == victim:
+                    return None
+                ctx.universe.ft_state.wait_failed(victim, timeout=10.0)
+                ctx.failure_ack()
+                sh = ctx.shrink()
+                return float(sh.allreduce(np.float64(1.0), ops.SUM))
+
+            res = uni.run(prog, timeout=60.0)
+            expect = float(N - 1)
+            assert all(r == expect for i, r in enumerate(res)
+                       if i != victim), (seed, res)

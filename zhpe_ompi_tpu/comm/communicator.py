@@ -227,6 +227,99 @@ class Communicator(errh.HasErrhandler, attributes.AttrHost):
         parts = [group] + ([Group(rest)] if rest else [])
         return Communicator(self.mesh, self.axis, parts, name)
 
+    # -- ULFM (MPIX_Comm_revoke / _shrink / _agree / _failure_ack) --------
+
+    def bind_failure_state(self, state) -> "Communicator":
+        """Attach a host-plane :class:`~zhpe_ompi_tpu.ft.ulfm
+        .FailureState` so shrink()/agree()/failure_ack() can consult the
+        live failure view (the host plane is where processes die; the
+        device mesh is static under the single controller)."""
+        self._ft_state = state
+        return self
+
+    @property
+    def ft_state(self):
+        return getattr(self, "_ft_state", None)
+
+    def revoke(self) -> None:
+        """MPIX_Comm_revoke: poison this communicator's cid — every
+        pending and future operation on it raises ``Revoked``.  Under
+        the single controller every device-plane operation dispatches
+        through this one object, so the process-global registry (comm
+        cids are monotonic, never reused) is the complete revocation
+        view; the host-plane endpoint cid space is a different
+        numbering and is revoked through its own FailureState."""
+        from ..ft import ulfm
+
+        ulfm.revoke_cid(self.cid)
+        mca_output.verbose(5, _stream, "revoked %s (cid=%d)",
+                           self.name, self.cid)
+
+    def is_revoked(self) -> bool:
+        from ..ft import ulfm
+
+        return ulfm.is_revoked(self.cid)
+
+    def _failed_ranks(self, failed) -> set[int]:
+        if failed is None:
+            if self.ft_state is None:
+                raise errors.ArgError(
+                    "no failed ranks given and no failure state bound "
+                    "(bind_failure_state)"
+                )
+            failed = self.ft_state.failed()
+        return {int(r) for r in failed}
+
+    def shrink(self, failed=None, name: str | None = None
+               ) -> "Communicator":
+        """MPIX_Comm_shrink: a fresh communicator (new, unrevoked cid)
+        whose primary group is the survivors, ordered by old rank.
+        `failed` defaults to the bound failure state's view."""
+        dead = self._failed_ranks(failed)
+        survivors = [r for r in range(self.axis_size) if r not in dead]
+        if not survivors:
+            raise errors.ProcFailed("no survivors to shrink onto",
+                                    failed_ranks=dead)
+        new = self.create_from_group(
+            Group(survivors), name or f"{self.name}_shrunk"
+        )
+        if self.ft_state is not None:
+            new.bind_failure_state(self.ft_state)
+        return new
+
+    def agree(self, flag: bool = True, contributions=None,
+              failed=None) -> bool:
+        """MPIX_Comm_agree, single-controller form: AND-reduce `flag`
+        (and optional per-rank `contributions`, a dict or sequence) over
+        the LIVE ranks — dead participants are excluded, so agreement
+        completes despite their death."""
+        if failed is None:
+            failed = (self.ft_state.failed()
+                      if self.ft_state is not None else ())
+        dead = {int(r) for r in failed}
+        acc = bool(flag)
+        if contributions is not None:
+            items = (contributions.items()
+                     if isinstance(contributions, dict)
+                     else enumerate(contributions))
+            for rank, contrib in items:
+                if int(rank) in dead:
+                    continue
+                acc = acc and bool(contrib)
+        return acc
+
+    def failure_ack(self) -> None:
+        """MPIX_Comm_failure_ack on the bound failure state."""
+        if self.ft_state is None:
+            raise errors.ArgError("no failure state bound")
+        self.ft_state.ack()
+
+    def failure_get_acked(self) -> Group:
+        """MPIX_Comm_failure_get_acked: acknowledged-failed ranks."""
+        if self.ft_state is None:
+            raise errors.ArgError("no failure state bound")
+        return Group(sorted(self.ft_state.acked()))
+
     # -- collective dispatch --------------------------------------------
 
     @property
@@ -247,6 +340,11 @@ class Communicator(errh.HasErrhandler, attributes.AttrHost):
         )
 
     def _coll_call_inner(self, opname: str, *args, **kwargs):
+        if self.is_revoked():
+            raise errors.Revoked(
+                f"{opname} on revoked communicator {self.name}",
+                cid=self.cid,
+            )
         entry = self.coll.get(opname)
         if entry is None:
             raise errors.UnsupportedError(

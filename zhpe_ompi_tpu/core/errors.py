@@ -37,6 +37,12 @@ ERR_WIN = 45
 ERR_KEYVAL = 48
 ERR_NOT_INITIALIZED = 60
 ERR_UNSUPPORTED = 52
+# ULFM fault-tolerance classes (numbering follows the reference fork's
+# MPIX_ERR_* extension slots in ompi/include/mpi.h.in)
+ERR_PROC_ABORTED = 74
+ERR_PROC_FAILED = 75
+ERR_PROC_FAILED_PENDING = 76
+ERR_REVOKED = 77
 
 _ERROR_STRINGS = {
     SUCCESS: "MPI_SUCCESS: no error",
@@ -63,6 +69,12 @@ _ERROR_STRINGS = {
     ERR_KEYVAL: "MPI_ERR_KEYVAL: invalid key value",
     ERR_NOT_INITIALIZED: "MPI_ERR_NOT_INITIALIZED: runtime not initialized",
     ERR_UNSUPPORTED: "MPI_ERR_UNSUPPORTED_OPERATION: unsupported operation",
+    ERR_PROC_ABORTED: "MPIX_ERR_PROC_ABORTED: process aborted",
+    ERR_PROC_FAILED: "MPIX_ERR_PROC_FAILED: process failed",
+    ERR_PROC_FAILED_PENDING:
+        "MPIX_ERR_PROC_FAILED_PENDING: pending failure blocks a wildcard "
+        "receive; acknowledge with failure_ack to continue",
+    ERR_REVOKED: "MPIX_ERR_REVOKED: communicator revoked",
 }
 
 
@@ -148,3 +160,36 @@ class NotInitializedError(MpiError):
 
 class UnsupportedError(MpiError):
     errclass = ERR_UNSUPPORTED
+
+
+class ProcFailed(MpiError):
+    """MPIX_ERR_PROC_FAILED: a named peer the operation depends on is dead
+    (the ULFM live-failure path — distinct from a stall/timeout).  Carries
+    the set of global ranks known failed when it was raised."""
+
+    errclass = ERR_PROC_FAILED
+
+    def __init__(self, message: str = "", failed_ranks=(),
+                 errclass: int | None = None):
+        super().__init__(message, errclass)
+        self.failed_ranks = tuple(sorted(int(r) for r in failed_ranks))
+
+
+class ProcFailedPending(ProcFailed):
+    """MPIX_ERR_PROC_FAILED_PENDING: a wildcard (ANY_SOURCE) receive
+    cannot complete because an unacknowledged failure means the awaited
+    sender may be dead.  ``failure_ack`` re-enables wildcard receives
+    (the ULFM pending contract)."""
+
+    errclass = ERR_PROC_FAILED_PENDING
+
+
+class Revoked(MpiError):
+    """MPIX_ERR_REVOKED: the communicator (cid) was revoked — every
+    pending and future operation on it must raise on all live ranks."""
+
+    errclass = ERR_REVOKED
+
+    def __init__(self, message: str = "", cid: int = -1):
+        super().__init__(message)
+        self.cid = cid

@@ -1,4 +1,4 @@
-"""Fault tolerance — the checkpoint/restart lineage (SURVEY.md §5).
+"""Fault tolerance — checkpoint/restart lineage + live-failure mitigation.
 
 The reference (Open MPI 5.0.0a1 vintage) carries three cooperating FT
 mechanisms, all re-designed here for the host plane:
@@ -13,7 +13,44 @@ mechanisms, all re-designed here for the host plane:
   is :mod:`zhpe_ompi_tpu.runtime.checkpoint`'s async array snapshots
   (message logging does not transfer to the SPMD plane, where a step is a
   deterministic pure function and "replay" is just re-running it).
+
+Plus the *live* failure path the fork was landing as ULFM:
+
+- :mod:`.ulfm` — ring heartbeat failure detector, ``PROC_FAILED``
+  classification, revoke/shrink/agree, failure ack.
+- :mod:`.inject` — deterministic fault injection (kill rank r at op k)
+  so every recovery path is testable on CPU in tier-1.
+
+Submodule attributes resolve lazily (PEP 562): :mod:`.vprotocol` and
+:mod:`.crcp` import the pt2pt layer, which itself needs :mod:`.ulfm` —
+eager imports here would close that cycle.
 """
 
-from .crcp import BookmarkCoordinator  # noqa: F401
-from .vprotocol import UniverseLogger  # noqa: F401
+_LAZY = {
+    "BookmarkCoordinator": ("crcp", "BookmarkCoordinator"),
+    "UniverseLogger": ("vprotocol", "UniverseLogger"),
+    "ProcessLogger": ("vprotocol", "ProcessLogger"),
+    "RejoinContext": ("vprotocol", "RejoinContext"),
+    "FailureState": ("ulfm", "FailureState"),
+    "RingDetector": ("ulfm", "RingDetector"),
+    "ShrunkEndpoint": ("ulfm", "ShrunkEndpoint"),
+    "RankKilled": ("ulfm", "RankKilled"),
+    "agree": ("ulfm", "agree"),
+    "FaultPlan": ("inject", "FaultPlan"),
+    "InjectedContext": ("inject", "InjectedContext"),
+    "replay_rejoin": ("inject", "replay_rejoin"),
+}
+
+__all__ = sorted(_LAZY)
+
+
+def __getattr__(name):
+    try:
+        modname, attr = _LAZY[name]
+    except KeyError:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+
+    value = getattr(importlib.import_module(f".{modname}", __name__), attr)
+    globals()[name] = value  # cache: resolve once
+    return value

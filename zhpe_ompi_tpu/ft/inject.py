@@ -1,0 +1,200 @@
+"""Deterministic fault injection — the test harness of the ULFM path.
+
+Every recovery mechanism in :mod:`.ulfm` must be exercisable on CPU in
+tier-1 without real process death, and *deterministically*: the same plan
+kills the same rank at the same operation count every run.  A
+:class:`FaultPlan` is that schedule; :meth:`FaultPlan.arm` wraps a rank's
+endpoint so its point-to-point operations are counted, and at the chosen
+count the rank "dies":
+
+- its heartbeats stop (the universe's :class:`~.ulfm.HeartbeatBoard`
+  slot is killed, or the TCP endpoint stops emitting), so the ring
+  detector discovers it;
+- its transport is severed (TCP sockets closed abruptly, no quiescence —
+  the peer sees connection reset, exactly like a real crash);
+- :class:`~.ulfm.RankKilled` unwinds the rank's program (a
+  ``BaseException``, so recovery code catching ``MpiError`` never
+  swallows its own death).
+
+Kill modes: ``"exit"`` (default) — the rank's thread/process unwinds and
+the runtime marks the death immediately (a crash); ``"mute"`` — only the
+heartbeats stop and nothing is marked, so the *detector* is the only
+discovery path (a hang/partition).
+
+Replay integration (:mod:`.vprotocol`): a killed rank that was running
+under pessimistic logging can be restarted against its log and, once the
+log is exhausted, continue live — see
+:class:`~.vprotocol.RejoinContext` and :func:`replay_rejoin`.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Any
+
+from ..coll.host import HostCollectives
+from ..coll.nbc import NonblockingCollectives
+from ..core import errors
+from . import ulfm
+
+
+class FaultPlan:
+    """A deterministic kill schedule: which rank dies after how many
+    point-to-point operations (each send/recv/sendrecv counts one).
+
+    ``seed`` drives :meth:`random_kill`'s choices, so randomized stress
+    runs replay exactly from the seed alone."""
+
+    def __init__(self, seed: int = 0):
+        self.seed = seed
+        self._rng = random.Random(seed)
+        self._kills: dict[int, tuple[int, str]] = {}
+
+    def kill_rank(self, rank: int, after_ops: int,
+                  mode: str = "exit") -> "FaultPlan":
+        """Schedule `rank` to die when it attempts operation
+        ``after_ops + 1`` (i.e. it completes exactly `after_ops` ops)."""
+        if mode not in ("exit", "mute"):
+            raise errors.ArgError(f"unknown kill mode {mode!r}")
+        if after_ops < 0:
+            raise errors.ArgError("after_ops must be >= 0")
+        self._kills[int(rank)] = (int(after_ops), mode)
+        return self
+
+    def random_kill(self, size: int, max_ops: int = 8,
+                    mode: str = "exit") -> "FaultPlan":
+        """Seed-derived kill: one victim in [0, size), one op count in
+        [1, max_ops] — deterministic given the constructor seed."""
+        rank = self._rng.randrange(size)
+        ops = self._rng.randint(1, max_ops)
+        return self.kill_rank(rank, ops, mode)
+
+    def kill_for(self, rank: int) -> tuple[int, str] | None:
+        return self._kills.get(rank)
+
+    @property
+    def victims(self) -> frozenset:
+        return frozenset(self._kills)
+
+    def arm(self, ep) -> "InjectedContext":
+        """Wrap one rank's endpoint with op counting + the kill trigger."""
+        return InjectedContext(ep, self)
+
+
+def _state_of(ep) -> "ulfm.FailureState | None":
+    state = getattr(ep, "ft_state", None)
+    if state is not None:
+        return state
+    uni = getattr(ep, "universe", None)
+    return getattr(uni, "ft_state", None) if uni is not None else None
+
+
+def _kill_transport(ep, mode: str) -> None:
+    """Make the endpoint look dead to the outside world: silence its
+    heartbeats, and for a crash ("exit") sever its transport."""
+    uni = getattr(ep, "universe", None)
+    if uni is not None and getattr(uni, "ft_board", None) is not None:
+        uni.ft_board.kill(ep.rank)
+    if hasattr(ep, "sever"):
+        if mode == "exit":
+            ep.sever()
+        else:
+            ep.mute()
+
+
+class InjectedContext:
+    """Endpoint proxy that counts operations and fires the plan's kill.
+
+    The point-to-point surface is counted directly (send/recv/sendrecv/
+    isend/irecv); collective methods are re-bound to THIS proxy, so their
+    internal pt2pt traffic runs through the counted surface and a kill
+    scheduled inside a collective fires mid-operation, at a pt2pt
+    boundary, the way a real crash lands.  Everything else (ULFM calls,
+    attributes) passes through to the wrapped endpoint untouched."""
+
+    # public methods of the collective surfaces get re-bound to the proxy
+    _COLL_NAMES = frozenset(
+        name
+        for base in (HostCollectives, NonblockingCollectives)
+        for name in vars(base)
+        if not name.startswith("_")
+    )
+
+    def __init__(self, ep, plan: FaultPlan):
+        self._ep = ep
+        self._plan = plan
+        self.ops = 0
+        kill = plan.kill_for(ep.rank)
+        self._kill_at, self._kill_mode = kill if kill else (None, "exit")
+
+    @property
+    def rank(self) -> int:
+        return self._ep.rank
+
+    @property
+    def size(self) -> int:
+        return self._ep.size
+
+    @property
+    def endpoint(self):
+        return self._ep
+
+    def _tick(self) -> None:
+        self.ops += 1
+        if self._kill_at is not None and self.ops > self._kill_at:
+            self.die()
+
+    def die(self) -> None:
+        """The kill: register the expected failure (detector-accuracy
+        bookkeeping), silence/sever the transport, unwind the program."""
+        state = _state_of(self._ep)
+        if state is not None:
+            ulfm.expect_failure(state, self._ep.rank)
+        _kill_transport(self._ep, self._kill_mode)
+        raise ulfm.RankKilled(self._ep.rank, self._kill_mode)
+
+    # -- counted pt2pt surface -------------------------------------------
+
+    def send(self, obj: Any, dest: int, tag: int = 0, cid: int = 0) -> None:
+        self._tick()
+        return self._ep.send(obj, dest, tag, cid)
+
+    def recv(self, *args, **kwargs):
+        self._tick()
+        return self._ep.recv(*args, **kwargs)
+
+    def sendrecv(self, *args, **kwargs):
+        self._tick()
+        return self._ep.sendrecv(*args, **kwargs)
+
+    def isend(self, obj: Any, dest: int, tag: int = 0, cid: int = 0):
+        self._tick()
+        return self._ep.isend(obj, dest, tag, cid)
+
+    def irecv(self, *args, **kwargs):
+        self._tick()
+        return self._ep.irecv(*args, **kwargs)
+
+    def __getattr__(self, name: str):
+        if name in self._COLL_NAMES:
+            # look the method up on the endpoint's TYPE (an override like
+            # TcpProc.barrier wins) and bind it to the proxy: its
+            # self.send/self.recv land on the counted surface above
+            fn = getattr(type(self._ep), name, None)
+            if callable(fn):
+                return fn.__get__(self)
+        return getattr(self._ep, name)
+
+
+def replay_rejoin(logger, rank: int, live_ep):
+    """Restart a killed rank: deterministic replay from its pessimistic
+    log, then live continuation on `live_ep` once the log is exhausted
+    (see :class:`~.vprotocol.RejoinContext`).  Clears the rank's failure
+    record so survivors stop classifying it dead — the
+    checkpoint-integrated restart hook."""
+    state = _state_of(live_ep)
+    if state is not None:
+        state.restore(rank)
+    from .vprotocol import RejoinContext
+
+    return RejoinContext(logger.replay_context(rank), live_ep)

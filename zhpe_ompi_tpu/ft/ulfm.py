@@ -1,0 +1,842 @@
+"""User-Level Failure Mitigation — the ULFM analog of the host plane.
+
+The reference fork (Open MPI 5.0.0a1) was landing ULFM as this commit was
+cut: a heartbeat failure detector over the out-of-band plane, process
+failure surfaced as ``MPIX_ERR_PROC_FAILED``, and the recovery triad
+``MPIX_Comm_revoke`` / ``MPIX_Comm_shrink`` / ``MPIX_Comm_agree`` plus
+``MPIX_Comm_failure_ack``/``_get_acked``.  This module re-designs that
+machinery for the host plane shared by thread ranks
+(:class:`~zhpe_ompi_tpu.pt2pt.universe.RankContext`) and socket ranks
+(:class:`~zhpe_ompi_tpu.pt2pt.tcp.TcpProc`):
+
+- :class:`FailureState` — per-job view of failed/acked ranks and revoked
+  cids (one shared instance per thread universe; one per process on the
+  wire, kept coherent by flooding).
+- :class:`RingDetector` — the ULFM ring heartbeat detector: each rank
+  *emits* heartbeats to its nearest live predecessor (its observer) and
+  *observes* its nearest live successor; a missed-beat window marks the
+  observed rank suspect and the suspicion propagates (shared state for
+  thread ranks, a failure-notice flood for socket ranks).  Period and
+  timeout are MCA variables (``ft_detector_period``/``ft_detector_timeout``).
+- :func:`agree` — fault-tolerant agreement (flag AND-reduction) that
+  completes despite participant death: the lowest live rank coordinates;
+  a dead coordinator triggers re-election and a tagged retry round.
+- :class:`ShrunkEndpoint` — the survivor communicator: live ranks
+  renumbered densely, full host-collective surface
+  (:class:`~zhpe_ompi_tpu.coll.host.HostCollectives`) over a
+  generation-isolated cid space.
+
+Detector health is observable: suspicions of ranks that were never
+actually killed count as *false positives* (see
+:func:`false_positive_count`), and every detector registers itself so
+tests can assert no heartbeat thread leaks (:func:`live_detectors`).
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from typing import Any, Callable, Iterable
+
+from ..coll.host import HostCollectives
+from ..comm.group import Group
+from ..core import errors
+from ..mca import var as mca_var
+
+mca_var.register(
+    "ft_detector_period", 0.05,
+    "Heartbeat emission period (seconds) of the ULFM ring failure "
+    "detector (the reference's opal_mca_ft_detector_period)",
+    type=float,
+)
+mca_var.register(
+    "ft_detector_timeout", 0.5,
+    "Missed-heartbeat window (seconds) after which the observed rank is "
+    "suspected dead (opal_mca_ft_detector_timeout analog)",
+    type=float,
+)
+mca_var.register(
+    "ft_agree_timeout", 30.0,
+    "Per-round deadline (seconds) of the fault-tolerant agreement "
+    "protocol before the coordinator is presumed dead and re-elected",
+    type=float,
+)
+
+# Control-plane cids, outside the user and collective cid spaces
+FT_HB_CID = 0x7FF6      # heartbeat frames (wire transport only)
+FT_NOTICE_CID = 0x7FF5  # failure-notice floods
+FT_REVOKE_CID = 0x7FF4  # revoke floods
+FT_AGREE_CID = 0x7FF3   # agreement rounds
+FT_AGREE_PUB_CID = 0x7FF2  # completed-agreement result announcements
+FT_BYE_CID = 0x7FF1     # orderly-departure goodbyes (close(), not death)
+_AGREE_TAG = 0x7D00
+
+# Shrunken communicators get a generation-isolated cid window so
+# pre-shrink traffic (including traffic FROM the dead rank) can never
+# match post-shrink operations.  The generation is a pure function of
+# the failure count: every survivor that shrinks with the same (agreed)
+# failure knowledge lands in the SAME window with no extra negotiation
+# round — the reason ULFM requires uniform knowledge before shrink.
+_SHRINK_CID_BASE = 0x100000
+_SHRINK_CID_STRIDE = 0x10000
+
+_state_uids = itertools.count(1)
+
+# -- process-global bookkeeping -----------------------------------------
+
+_global_lock = threading.Lock()
+# Device-plane Communicator cids are allocated monotonically and never
+# reused, so a process-global revocation set is safe for them; endpoint
+# cids (small, reused across tests) are revoked on their FailureState.
+_REVOKED_CIDS: set[int] = set()
+# (state.uid, rank) pairs a fault plan intends to kill: a detector
+# suspicion outside this set is a FALSE POSITIVE.  The bare-rank set is
+# the cross-process fallback: on the wire every process holds its OWN
+# FailureState, and a real observer cannot know the victim's state uid —
+# the injection harness registers the victim's global rank out-of-band
+# (test instrumentation, not protocol).  In a clean run both sets are
+# empty, so every suspicion counts — the zero-false-positive gate keeps
+# full strength exactly where it matters.
+_EXPECTED_FAILURES: set[tuple[int, int]] = set()
+_EXPECTED_RANK_KILLS: set[int] = set()
+_false_positives = 0
+_DETECTORS: list["RingDetector"] = []
+
+
+def revoke_cid(cid: int) -> None:
+    """Process-global cid poisoning (MPIX_Comm_revoke's effect for the
+    single-controller device plane)."""
+    with _global_lock:
+        _REVOKED_CIDS.add(int(cid))
+
+
+def is_revoked(cid: int) -> bool:
+    """Device-plane (Communicator) revocation check ONLY — endpoint cids
+    are a different numbering, revoked via their FailureState."""
+    # unlocked fast path: CPython set membership is atomic enough for a
+    # monotonic poison set (entries are only ever added)
+    return cid in _REVOKED_CIDS
+
+
+def reset_revocations() -> None:
+    """Test isolation: forget every global revocation."""
+    with _global_lock:
+        _REVOKED_CIDS.clear()
+
+
+def expect_failure(state: "FailureState", rank: int) -> None:
+    """Pre-register an intended kill so its detection is not counted as a
+    detector false positive (called by the fault-injection harness)."""
+    with _global_lock:
+        _EXPECTED_FAILURES.add((state.uid, rank))
+        _EXPECTED_RANK_KILLS.add(int(rank))
+
+
+def clear_expected_failures() -> None:
+    """Test isolation: forget the kills fault plans registered, so a
+    later test's detector suspicions are judged at full strength — the
+    zero-false-positive gate must not be blinded by rank numbers an
+    EARLIER test legitimately killed."""
+    with _global_lock:
+        _EXPECTED_FAILURES.clear()
+        _EXPECTED_RANK_KILLS.clear()
+
+
+def false_positive_count() -> int:
+    """Detector suspicions of ranks no fault plan ever killed — must stay
+    0 across a clean run (the detector-accuracy acceptance gate)."""
+    return _false_positives
+
+
+def live_detectors() -> list["RingDetector"]:
+    """Detector threads still running (must be [] after fixtures clean
+    up — heartbeat threads may not leak into later tests)."""
+    with _global_lock:
+        _DETECTORS[:] = [d for d in _DETECTORS if d.is_alive()]
+        return list(_DETECTORS)
+
+
+def _register_detector(det: "RingDetector") -> None:
+    with _global_lock:
+        _DETECTORS[:] = [d for d in _DETECTORS if d.is_alive()]
+        _DETECTORS.append(det)
+
+
+class RankKilled(BaseException):
+    """Raised inside a rank's program by the fault-injection harness to
+    simulate process death.  Deliberately NOT an ``MpiError``: recovery
+    code catching typed failures must never swallow its own death."""
+
+    def __init__(self, rank: int, mode: str = "exit"):
+        super().__init__(f"rank {rank} killed by fault plan (mode={mode})")
+        self.rank = rank
+        self.mode = mode  # "exit": thread unwinds; "mute": only hb stop
+
+
+class FailureState:
+    """One job's ULFM view: failed ranks, acknowledged failures, revoked
+    cids.  Shared by every thread rank of a universe; per-process on the
+    wire (kept coherent by the detector's failure-notice flood)."""
+
+    def __init__(self, size: int):
+        self.size = size
+        self.uid = next(_state_uids)
+        self._failed: set[int] = set()
+        self._acked: set[int] = set()
+        self._cause: dict[int, str] = {}
+        self._revoked: set[int] = set()
+        self._shrink_groups: dict[int, frozenset[int]] = {}
+        self._agreements: dict[int, bool] = {}
+        self._cv = threading.Condition()
+
+    # -- failures --------------------------------------------------------
+
+    def mark_failed(self, rank: int, cause: str = "transport") -> bool:
+        """Record a rank death; returns True when newly learned.  A
+        ``cause="detector"`` suspicion of a rank no fault plan killed is
+        counted as a false positive."""
+        with self._cv:
+            if rank in self._failed:
+                return False
+            self._failed.add(rank)
+            self._cause[rank] = cause
+            self._cv.notify_all()
+        if cause == "detector":
+            with _global_lock:
+                if ((self.uid, rank) not in _EXPECTED_FAILURES
+                        and rank not in _EXPECTED_RANK_KILLS):
+                    global _false_positives
+                    _false_positives += 1
+        return True
+
+    def merge_failed(self, ranks: Iterable[int], cause: str = "notice"
+                     ) -> None:
+        for r in ranks:
+            self.mark_failed(int(r), cause=cause)
+
+    def is_failed(self, rank: int) -> bool:
+        return rank in self._failed
+
+    def failed(self) -> frozenset:
+        with self._cv:
+            return frozenset(self._failed)
+
+    def cause_of(self, rank: int) -> str | None:
+        return self._cause.get(rank)
+
+    def crash_count(self) -> int:
+        """Failures that are CRASHES, excluding orderly goodbyes.  The
+        shrink generation derives from this count: a BYE flood still in
+        flight (finalize skew) must not put survivors holding identical
+        crash knowledge into different cid windows."""
+        with self._cv:
+            return sum(1 for r in self._failed
+                       if self._cause.get(r) != "goodbye")
+
+    def live(self) -> list[int]:
+        with self._cv:
+            return [r for r in range(self.size) if r not in self._failed]
+
+    def wait_failed(self, rank: int, timeout: float | None = None) -> bool:
+        """Block until `rank` is known failed (suspicion propagation)."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cv:
+            while rank not in self._failed:
+                left = None if deadline is None else deadline - time.monotonic()
+                if left is not None and left <= 0:
+                    return False
+                self._cv.wait(0.05 if left is None else min(left, 0.05))
+            return True
+
+    # -- acknowledgement (MPIX_Comm_failure_ack / _get_acked) ------------
+
+    def ack(self) -> frozenset:
+        """Acknowledge every currently-known failure; wildcard receives
+        blocked on PROC_FAILED_PENDING may proceed afterwards."""
+        with self._cv:
+            self._acked |= self._failed
+            return frozenset(self._acked)
+
+    def acked(self) -> frozenset:
+        with self._cv:
+            return frozenset(self._acked)
+
+    def unacked(self) -> frozenset:
+        with self._cv:
+            return frozenset(self._failed - self._acked)
+
+    def mark_departed(self, rank: int) -> bool:
+        """Orderly goodbye (a peer's clean close): the rank is gone, so
+        named receives on it classify typed ``ProcFailed`` — but the
+        departure is pre-acknowledged, so it never gates wildcard
+        receives the way an unacknowledged CRASH does.  ULFM pending
+        semantics exist for failures recovery has not yet seen; normal
+        finalize skew must not abort healthy survivors.  Returns True
+        when the departure is NEWLY learned (the gossip-once gate for
+        relaying BYE notices to peers the departing rank never
+        connected to)."""
+        with self._cv:
+            fresh = rank not in self._failed
+            if fresh:
+                self._failed.add(rank)
+                self._cause[rank] = "goodbye"
+            self._acked.add(rank)
+            self._cv.notify_all()
+            return fresh
+
+    def restore(self, rank: int) -> None:
+        """Forget a failure — the rejoin path: a replayed/restarted rank
+        re-enters the job (checkpoint-integrated restart)."""
+        with self._cv:
+            self._failed.discard(rank)
+            self._acked.discard(rank)
+            self._cause.pop(rank, None)
+
+    # -- shrink membership ----------------------------------------------
+
+    def register_shrink(self, generation: int, members: Iterable[int]
+                        ) -> None:
+        """Record a shrink window's survivor set, so classification can
+        tell a PRE-shrink failure (of a non-member — exempt by the ULFM
+        shrink contract) from a POST-shrink death of a member."""
+        with self._cv:
+            self._shrink_groups[int(generation)] = frozenset(
+                int(r) for r in members
+            )
+
+    def shrink_group(self, generation: int) -> frozenset[int] | None:
+        return self._shrink_groups.get(generation)
+
+    # -- agreed results --------------------------------------------------
+
+    def record_agreement(self, seq: int, result: bool) -> None:
+        """Publish a completed agreement's value: survivors that lose
+        their coordinator mid-delivery converge on THIS result instead
+        of re-running a round nobody can finish (see :func:`agree`)."""
+        with self._cv:
+            self._agreements[int(seq)] = bool(result)
+
+    def agreement(self, seq: int) -> bool | None:
+        return self._agreements.get(seq)
+
+    # -- revocation ------------------------------------------------------
+
+    def revoke(self, cid: int) -> None:
+        with self._cv:
+            self._revoked.add(int(cid))
+            self._cv.notify_all()
+
+    def is_revoked(self, cid: int) -> bool:
+        return cid in self._revoked
+
+    def check_revoked(self, cid: int) -> None:
+        if cid in self._revoked:
+            raise errors.Revoked(f"communicator cid={cid} is revoked",
+                                 cid=cid)
+
+
+class HeartbeatBoard:
+    """Shared heartbeat medium of a thread universe: one monotonic
+    timestamp slot per rank (the btl/self analog of heartbeat frames).
+    ``kill`` silences a rank — the fault-injection hook that makes a
+    dead thread stop beating."""
+
+    def __init__(self, size: int):
+        now = time.monotonic()
+        self._last = [now] * size
+        self._dead = [False] * size
+        self._lock = threading.Lock()
+
+    def beat(self, rank: int) -> None:
+        with self._lock:
+            if not self._dead[rank]:
+                self._last[rank] = time.monotonic()
+
+    def last(self, rank: int) -> float:
+        with self._lock:
+            return self._last[rank]
+
+    def kill(self, rank: int) -> None:
+        with self._lock:
+            self._dead[rank] = True
+
+    def revive(self, rank: int) -> None:
+        """Re-admit a rank (clean end-of-run, or a rejoin after replay):
+        its slot beats again with a fresh window."""
+        with self._lock:
+            self._dead[rank] = False
+            self._last[rank] = time.monotonic()
+
+    def is_dead(self, rank: int) -> bool:
+        with self._lock:
+            return self._dead[rank]
+
+
+class BoardTransport:
+    """Detector transport over a :class:`HeartbeatBoard` (thread ranks)."""
+
+    def __init__(self, board: HeartbeatBoard, rank: int):
+        self._board = board
+        self._rank = rank
+
+    def emit(self, _dest: int) -> None:
+        self._board.beat(self._rank)
+
+    def last_beat(self, rank: int) -> float:
+        return self._board.last(rank)
+
+    def grace(self, rank: int) -> None:
+        # board timestamps are global; a live rank's slot is always fresh
+        pass
+
+
+class WireTransport:
+    """Detector transport over framed heartbeats (socket ranks): the
+    endpoint feeds :meth:`on_beat` from its drain loop; emission rides a
+    caller-provided frame sender."""
+
+    def __init__(self, rank: int, size: int,
+                 emit_fn: Callable[[int], None]):
+        now = time.monotonic()
+        self._last = {r: now for r in range(size)}
+        self._lock = threading.Lock()
+        self._emit = emit_fn
+        self._rank = rank
+
+    def on_beat(self, src: int) -> None:
+        with self._lock:
+            self._last[src] = time.monotonic()
+
+    def emit(self, dest: int) -> None:
+        if dest != self._rank:
+            self._emit(dest)
+
+    def last_beat(self, rank: int) -> float:
+        with self._lock:
+            return self._last[rank]
+
+    def grace(self, rank: int) -> None:
+        # freshly-adopted observed target: restart its window so a rank
+        # that was beating toward the DEAD observer isn't insta-suspected
+        with self._lock:
+            self._last[rank] = time.monotonic()
+
+
+class RingDetector(threading.Thread):
+    """The ULFM ring failure detector as a daemon thread.
+
+    Rank r emits one heartbeat per ``ft_detector_period`` toward its
+    nearest live predecessor and observes its nearest live successor;
+    when the observed rank's last beat ages past ``ft_detector_timeout``
+    it is marked failed (suspicion) and the suspicion propagates via
+    ``flood`` (no-op for thread ranks — their state is shared)."""
+
+    def __init__(self, rank: int, size: int, state: FailureState,
+                 transport, flood: Callable[[frozenset], None] | None = None,
+                 muted: Callable[[], bool] | None = None,
+                 period: float | None = None, timeout: float | None = None,
+                 name: str | None = None):
+        super().__init__(name=name or f"ft-detector-{rank}", daemon=True)
+        self.rank = rank
+        self.size = size
+        self.state = state
+        self.transport = transport
+        self._flood = flood
+        self._muted = muted
+        self.period = float(
+            period if period is not None
+            else mca_var.get("ft_detector_period", 0.05)
+        )
+        self.timeout = float(
+            timeout if timeout is not None
+            else mca_var.get("ft_detector_timeout", 0.5)
+        )
+        self.suspicions: list[int] = []
+        self._halt = threading.Event()
+        _register_detector(self)
+
+    # -- ring neighbourhood over the live set ----------------------------
+
+    def _live_succ(self) -> int:
+        for k in range(1, self.size):
+            r = (self.rank + k) % self.size
+            if not self.state.is_failed(r):
+                return r
+        return self.rank
+
+    def _live_pred(self) -> int:
+        for k in range(1, self.size):
+            r = (self.rank - k) % self.size
+            if not self.state.is_failed(r):
+                return r
+        return self.rank
+
+    def run(self) -> None:  # pragma: no branch - loop body covered
+        observed = self._live_succ()
+        while not self._halt.wait(self.period):
+            if self._muted is not None and self._muted():
+                continue  # a killed rank stops beating but the thread
+                # stays parked until stop() so teardown is uniform
+            self.transport.emit(self._live_pred())
+            live_obs = self._live_succ()
+            if live_obs != observed:
+                # ring reconfiguration (someone else's notice arrived)
+                observed = live_obs
+                self.transport.grace(observed)
+            if observed == self.rank:
+                continue  # last one standing
+            age = time.monotonic() - self.transport.last_beat(observed)
+            if age > self.timeout:
+                self.suspicions.append(observed)
+                if self.state.mark_failed(observed, cause="detector"):
+                    if self._flood is not None:
+                        self._flood(self.state.failed())
+                observed = self._live_succ()
+                self.transport.grace(observed)
+
+    def stop(self, join_timeout: float = 5.0) -> None:
+        self._halt.set()
+        if self.is_alive() and threading.current_thread() is not self:
+            self.join(join_timeout)
+
+
+def classify_recv_failure(state: FailureState, source: int, cid: int
+                          ) -> errors.MpiError | None:
+    """The shared transport-side classification of a receive that cannot
+    complete: revoked cid → ``Revoked``; named dead source →
+    ``ProcFailed``; wildcard receive with an unacknowledged failure →
+    ``ProcFailedPending``.  None means "keep waiting" (a stall is not a
+    death).  Only the endpoint's own revocation set applies: the global
+    registry is the device-plane Communicator space, whose cids are a
+    DIFFERENT numbering from endpoint cids — consulting it here would
+    poison unrelated endpoint traffic."""
+    if state.is_revoked(cid):
+        return errors.Revoked(f"recv on revoked cid={cid}", cid=cid)
+    if source != -1:  # named source (ANY_SOURCE is -1)
+        if state.is_failed(source):
+            return errors.ProcFailed(
+                f"rank {source} failed (cause: {state.cause_of(source)})",
+                failed_ranks=state.failed(),
+            )
+        return None
+    if cid >= _SHRINK_CID_BASE:
+        # the shrunken communicator "contains no failed processes" per
+        # the ULFM shrink contract, so a PRE-shrink failure (of a
+        # non-member) is exempt, ack or no ack — but a MEMBER that died
+        # after the shrink is a real pending failure for this window's
+        # wildcard receives
+        gen = (cid - _SHRINK_CID_BASE) // _SHRINK_CID_STRIDE
+        members = state.shrink_group(gen)
+        if members is None:
+            # a window this process never registered (it is not a
+            # survivor of that shrink): nothing to classify against
+            return None
+        pending = state.unacked() & members
+        if pending:
+            return errors.ProcFailedPending(
+                f"wildcard receive on shrink window gen={gen} with "
+                f"unacknowledged member failures {sorted(pending)}; "
+                f"failure_ack() to continue",
+                failed_ranks=pending,
+            )
+        return None
+    pending = state.unacked()
+    if pending:
+        return errors.ProcFailedPending(
+            f"wildcard receive with unacknowledged failures "
+            f"{sorted(pending)}; failure_ack() to continue",
+            failed_ranks=pending,
+        )
+    return None
+
+
+# -- fault-tolerant agreement (MPIX_Comm_agree) -------------------------
+
+
+def _agree_tags(seq: int) -> tuple[int, int]:
+    """(gather, result) tag pair unique to one agreement instance.  Tags
+    carry the sequence number, NOT the retry round: any contribution for
+    agreement `seq` matches its coordinator's gather regardless of how
+    many re-elections either side has counted, so view skew between
+    participants can never strand a frame on mismatched round tags —
+    and a stale frame from an earlier agreement can never match a later
+    one's protocol."""
+    base = _AGREE_TAG + ((seq & 0xFFFFF) << 1)
+    return base, base + 1
+
+
+class _AgreeDone(Exception):
+    """Internal: the agreement completed through the published-result
+    channel while this rank was still mid-protocol."""
+
+    def __init__(self, result: bool):
+        super().__init__(result)
+        self.result = result
+
+
+def _await_frame(ep, state: FailureState, seq: int, source: int,
+                 tag: int, timeout: float):
+    """Wait for one protocol frame, adopting the published result if the
+    agreement completes through another path first (a survivor that
+    already holds the result records it in the registry / announces it
+    on the wire — see :func:`_publish`).  ONE posted receive per call,
+    never a repost loop: sliced re-receiving would abandon one engine
+    post per slice (the engines have no cancel) and the stale posts
+    re-inject recursively when a frame finally lands.  An exceptional
+    exit leaves at most this one post behind, and the instance-unique
+    tags keep it from ever stealing another agreement's frames."""
+    deadline = time.monotonic() + timeout
+    req = ep.irecv(source=source, tag=tag, cid=FT_AGREE_CID)
+    while True:
+        flag, value = req.test()  # drives progress on thread ranks
+        if flag:
+            return value
+        done = state.agreement(seq)
+        if done is not None:
+            raise _AgreeDone(done)
+        if state.is_failed(source):
+            # final pump: death must not eat a frame already delivered
+            flag, value = req.test()
+            if flag:
+                return value
+            raise errors.ProcFailed(
+                f"rank {source} failed (cause: {state.cause_of(source)})",
+                failed_ranks=state.failed(),
+            )
+        if time.monotonic() > deadline:
+            raise errors.InternalError(
+                f"agreement {seq}: no frame from rank {source} "
+                f"within {timeout}s"
+            )
+        time.sleep(0.002)
+
+
+def _publish(ep, state: FailureState, seq: int, result: bool) -> None:
+    """Make a completed agreement's value recoverable: record it in the
+    failure state's registry (shared by every thread rank of a universe)
+    and, on wire endpoints, announce it into the live peers' registries.
+    Survivors that lose the coordinator mid-delivery converge on THIS
+    value instead of re-running a round that could compute a different
+    one (the uniformity half of the MPIX_Comm_agree contract)."""
+    state.record_agreement(seq, result)
+    announce = getattr(ep, "_agree_announce", None)
+    if announce is not None:
+        announce(seq, result)
+
+
+def agree(ep, flag: bool = True, timeout: float | None = None) -> bool:
+    """Fault-tolerant AND-reduction of `flag` over the live ranks of an
+    endpoint.  The lowest live rank coordinates; contributors that die
+    mid-round are excluded; a dead coordinator triggers re-election and
+    a retry.  A coordinator that dies after delivering its result to
+    only SOME survivors cannot split the outcome: the delivered ranks
+    publish the value and everyone still mid-protocol adopts it.
+    Completes despite participant death — the MPIX_Comm_agree
+    contract."""
+    state = _require_ft(ep)
+    if timeout is None:
+        timeout = float(mca_var.get("ft_agree_timeout", 30.0))
+    # collective-order instance number: every rank's k-th agree is the
+    # same instance — the result registry and the tags key off it
+    seq = getattr(ep, "_agree_seq", 0)
+    ep._agree_seq = seq + 1
+    gather_tag, result_tag = _agree_tags(seq)
+    round_no = 0
+    while True:
+        done = state.agreement(seq)
+        if done is not None:
+            return done
+        live = [r for r in range(ep.size) if not state.is_failed(r)]
+        coord = live[0]
+        try:
+            if ep.rank == coord:
+                acc = bool(flag)
+                for r in live:
+                    if r == ep.rank:
+                        continue
+                    try:
+                        contrib = _await_frame(ep, state, seq, r,
+                                               gather_tag, timeout)
+                    except errors.ProcFailed:
+                        continue  # died mid-agreement: excluded
+                    if (isinstance(contrib, (list, tuple))
+                            and len(contrib) == 2 and contrib[0] == seq):
+                        acc = acc and bool(contrib[1])
+                # a survivor may have completed this instance through a
+                # PREVIOUS coordinator's partial delivery: that value is
+                # the agreement (uniformity), ours is discarded
+                done = state.agreement(seq)
+                if done is not None:
+                    return done
+                # publish BEFORE distributing: if we die mid-delivery,
+                # the ranks we reached hold (and spread) the result
+                _publish(ep, state, seq, acc)
+                for r in live:
+                    if r == ep.rank or state.is_failed(r):
+                        continue
+                    try:
+                        ep.send((seq, acc), r, tag=result_tag,
+                                cid=FT_AGREE_CID, poll=True)
+                    except (errors.MpiError, OSError):
+                        pass  # result undeliverable to a dying rank
+                return acc
+            # poll=True on the protocol's own sends: a dead coordinator
+            # must surface as typed ProcFailed for the re-election path
+            # below, never as the user disposition (FATAL would abort the
+            # survivor — breaking the completes-despite-death contract)
+            ep.send((seq, bool(flag)), coord, tag=gather_tag,
+                    cid=FT_AGREE_CID, poll=True)
+            res = _await_frame(ep, state, seq, coord, result_tag, timeout)
+            if not (isinstance(res, (list, tuple)) and len(res) == 2
+                    and res[0] == seq):
+                raise errors.InternalError(
+                    f"agreement {seq}: mismatched result frame {res!r}"
+                )
+            acc = bool(res[1])
+            _publish(ep, state, seq, acc)
+            return acc
+        except _AgreeDone as d:
+            # adopted from the registry/announce channel: re-publish so
+            # the value keeps spreading to ranks still mid-protocol
+            _publish(ep, state, seq, d.result)
+            return d.result
+        except errors.ProcFailed:
+            # the coordinator died: re-elect and retry (same tags — the
+            # instance, not the round, keys the matching)
+            round_no += 1
+            if round_no > ep.size:
+                raise
+
+
+# -- survivor communicator (MPIX_Comm_shrink) ---------------------------
+
+
+def _shrink_cid(gen: int, cid: int) -> int:
+    return _SHRINK_CID_BASE + gen * _SHRINK_CID_STRIDE + (cid & 0xFFFF)
+
+
+class ShrunkEndpoint(HostCollectives):
+    """The shrunken communicator of the host plane: survivors renumbered
+    densely (0..m-1), every operation translated onto the parent endpoint
+    inside a generation-isolated cid window.  Carries the full
+    host-collective surface, so ``shrunk.allreduce(...)`` just works —
+    the coll-rides-the-PML layering survives the shrink."""
+
+    def __init__(self, ep, survivors: list[int], generation: int):
+        if ep.rank not in survivors:
+            raise errors.ProcFailed(
+                f"rank {ep.rank} is not a survivor of the shrink",
+                failed_ranks=[r for r in range(ep.size)
+                              if r not in survivors],
+            )
+        self._ep = ep
+        self._map = list(survivors)          # shrunk rank -> parent rank
+        self._inv = {g: i for i, g in enumerate(self._map)}
+        self._gen = generation
+        self.rank = self._inv[ep.rank]
+        self.size = len(self._map)
+        self.group = Group(self._map)
+        state = getattr(ep, "ft_state", None)
+        if state is not None:
+            # the survivor set defines this generation's cid window:
+            # classification can then tell a pre-shrink failure (of a
+            # non-member — exempt per the shrink contract) from a
+            # post-shrink death of a member (see classify_recv_failure)
+            state.register_shrink(generation, self._map)
+
+    def _xlate_src(self, source: int) -> int:
+        if source == -1:  # ANY_SOURCE passes through
+            return source
+        return self._map[source]
+
+    def send(self, obj: Any, dest: int, tag: int = 0, cid: int = 0) -> None:
+        self._ep.send(obj, self._map[dest], tag, _shrink_cid(self._gen, cid))
+
+    def isend(self, obj: Any, dest: int, tag: int = 0, cid: int = 0):
+        return self._ep.isend(obj, self._map[dest], tag,
+                              _shrink_cid(self._gen, cid))
+
+    def recv(self, source: int = -1, tag: int = -1, cid: int = 0,
+             timeout: float | None = None, return_status: bool = False):
+        out = self._ep.recv(self._xlate_src(source), tag,
+                            _shrink_cid(self._gen, cid), timeout=timeout,
+                            return_status=return_status)
+        if return_status:
+            value, status = out
+            if status.source >= 0:
+                status.source = self._inv.get(status.source, -1)
+            return value, status
+        return out
+
+    def irecv(self, source: int = -1, tag: int = -1, cid: int = 0):
+        return self._ep.irecv(self._xlate_src(source), tag,
+                              _shrink_cid(self._gen, cid))
+
+    def sendrecv(self, obj: Any, dest: int, source: int = -1,
+                 sendtag: int = 0, recvtag: int = -1, cid: int = 0):
+        # isend-then-classified-recv, NOT irecv+wait: a bare Request
+        # wait has no failure classification, so a partner dying
+        # post-shrink would hang the exchange instead of raising typed
+        # ProcFailed (collectives built over sendrecv inherit this)
+        self.isend(obj, dest, sendtag, cid)
+        return self.recv(source, recvtag, cid)
+
+    def barrier(self) -> None:
+        n, k = self.size, 1
+        while k < n:
+            self.send(b"", (self.rank + k) % n, tag=0x7FFE, cid=0x7FFE)
+            self.recv(source=(self.rank - k) % n, tag=0x7FFE, cid=0x7FFE)
+            k <<= 1
+
+    def __repr__(self):  # pragma: no cover
+        return (f"ShrunkEndpoint(rank={self.rank}/{self.size}, "
+                f"parents={self._map}, gen={self._gen})")
+
+
+def _require_ft(ep) -> FailureState:
+    state = getattr(ep, "ft_state", None)
+    if state is None:
+        raise errors.UnsupportedError(
+            "ULFM operations need fault tolerance enabled on the "
+            "endpoint (construct with ft=True)"
+        )
+    return state
+
+
+class UlfmEndpointAPI:
+    """Mixin giving any endpoint with ``ft_state`` the ULFM user surface
+    (MPIX_Comm_failure_ack/_get_acked/_agree/_shrink/_revoke)."""
+
+    def failure_ack(self) -> None:
+        """MPIX_Comm_failure_ack: acknowledge every known failure;
+        wildcard receives stop raising PROC_FAILED_PENDING for them."""
+        _require_ft(self).ack()
+
+    def failure_get_acked(self) -> Group:
+        """MPIX_Comm_failure_get_acked: the group of acknowledged-failed
+        ranks."""
+        return Group(sorted(_require_ft(self).acked()))
+
+    def agree(self, flag: bool = True, timeout: float | None = None) -> bool:
+        """MPIX_Comm_agree: fault-tolerant flag AND-reduction."""
+        return agree(self, flag, timeout)
+
+    def shrink(self) -> ShrunkEndpoint:
+        """MPIX_Comm_shrink: a survivor endpoint with dense new ranks.
+        Collective over the survivors: every caller must hold the same
+        failure knowledge (run ``agree`` first when in doubt) — the
+        shrink generation, and with it the isolated cid window, is
+        derived from the CRASH count (orderly departures excluded, so
+        finalize skew cannot split the window; survivor-set consensus
+        under concurrent departure remains the caller's agree round)."""
+        state = _require_ft(self)
+        survivors = state.live()
+        return ShrunkEndpoint(self, survivors,
+                              generation=state.crash_count())
+
+    def revoke(self, cid: int) -> None:
+        """MPIX_Comm_revoke for an endpoint-plane cid: every pending and
+        future operation on it raises ``Revoked`` on all live ranks.
+        Transports with a wire (TCP) override to flood the notice."""
+        _require_ft(self).revoke(cid)

@@ -25,6 +25,7 @@ from typing import Any, Callable
 
 from ..core import errors
 from ..pt2pt.matching import ANY_SOURCE, ANY_TAG
+from ..pt2pt.requests import Status, _payload_bytes
 from ..pt2pt.universe import LocalUniverse, RankContext, _eager_copy
 
 
@@ -61,9 +62,12 @@ class LoggedContext:
         self._ctx.send(obj, dest, tag, cid)
 
     def recv(self, source: int = ANY_SOURCE, tag: int = ANY_TAG,
-             cid: int = 0) -> Any:
+             cid: int = 0, **kwargs) -> Any:
+        # the logger always needs the status (resolved source/tag below);
+        # whether the CALLER gets it too is their return_status
+        want_status = kwargs.pop("return_status", False)
         value, status = self._ctx.recv(
-            source, tag, cid, return_status=True
+            source, tag, cid, return_status=True, **kwargs
         )
         # log the RESOLVED source/tag — this is the nondeterminism that
         # must be pinned for ANY_SOURCE/ANY_TAG replay
@@ -71,7 +75,7 @@ class LoggedContext:
             self._log.recvs.append(
                 (status.source, status.tag, _eager_copy(value))
             )
-        return value
+        return (value, status) if want_status else value
 
     def sendrecv(self, obj: Any, dest: int, source: int = ANY_SOURCE,
                  sendtag: int = 0, recvtag: int = ANY_TAG, cid: int = 0):
@@ -119,7 +123,10 @@ class ReplayContext:
         )
 
     def recv(self, source: int = ANY_SOURCE, tag: int = ANY_TAG,
-             cid: int = 0) -> Any:
+             cid: int = 0, return_status: bool = False,
+             timeout: float | None = None, poll: bool = False) -> Any:
+        # timeout/poll are accepted for live-surface signature parity and
+        # ignored: replay is instantaneous and cannot fail mid-wait
         if self._recv_pos >= len(self._log.recvs):
             raise errors.InternalError("replay ran past the receive log")
         lsource, ltag, payload = self._log.recvs[self._recv_pos]
@@ -134,7 +141,15 @@ class ReplayContext:
                 f"{ltag}, replayed asks {tag}"
             )
         self._recv_pos += 1
-        return _eager_copy(payload)
+        value = _eager_copy(payload)
+        if return_status:
+            # the logged resolved (source, tag) IS the status — the
+            # replayed caller sees the same shape as the live surface
+            return value, Status(
+                source=lsource, tag=ltag,
+                count_bytes=_payload_bytes(value),
+            )
+        return value
 
     def sendrecv(self, obj: Any, dest: int, source: int = ANY_SOURCE,
                  sendtag: int = 0, recvtag: int = ANY_TAG, cid: int = 0):
@@ -145,9 +160,61 @@ class ReplayContext:
         """Barriers are deterministic control flow — nothing to replay."""
 
     @property
+    def sends_done(self) -> bool:
+        return self._send_pos >= len(self._log.sends)
+
+    @property
+    def recvs_done(self) -> bool:
+        return self._recv_pos >= len(self._log.recvs)
+
+    @property
     def fully_replayed(self) -> bool:
         return (self._recv_pos == len(self._log.recvs)
                 and self._send_pos == len(self._log.sends))
+
+
+class RejoinContext:
+    """Restarted-rank context that crosses the replay/live boundary: while
+    the pessimistic log still has entries, operations replay from it (the
+    :class:`ReplayContext` contract — sends swallowed, receives served in
+    logged order); once a log runs dry, the SAME call falls through to a
+    live endpoint — the restarted rank rejoins the (possibly shrunken)
+    universe mid-program.  This is the piece the reference leaves to the
+    restart runtime: logged history first, live traffic after."""
+
+    def __init__(self, replay: ReplayContext, live):
+        self._replay = replay
+        self._live = live
+        self.rank = live.rank
+        self.size = live.size
+
+    def send(self, obj: Any, dest: int, tag: int = 0, cid: int = 0) -> None:
+        if not self._replay.sends_done:
+            return self._replay.send(obj, dest, tag, cid)
+        return self._live.send(obj, dest, tag, cid)
+
+    def recv(self, source: int = ANY_SOURCE, tag: int = ANY_TAG,
+             cid: int = 0, **kwargs) -> Any:
+        if not self._replay.recvs_done:
+            # kwargs (return_status in particular) forward to replay too:
+            # the return SHAPE must not change when the log runs dry
+            return self._replay.recv(source, tag, cid, **kwargs)
+        return self._live.recv(source, tag, cid, **kwargs)
+
+    def sendrecv(self, obj: Any, dest: int, source: int = ANY_SOURCE,
+                 sendtag: int = 0, recvtag: int = ANY_TAG, cid: int = 0):
+        self.send(obj, dest, sendtag, cid)
+        return self.recv(source, recvtag, cid)
+
+    def barrier(self) -> None:
+        # during replay barriers are deterministic control flow (no-op);
+        # once live, the rejoined rank must synchronize for real
+        if self._replay.fully_replayed:
+            self._live.barrier()
+
+    @property
+    def fully_replayed(self) -> bool:
+        return self._replay.fully_replayed
 
 
 class ProcessLogger:
@@ -168,6 +235,10 @@ class ProcessLogger:
 
     def replay_context(self) -> ReplayContext:
         return ReplayContext(self._ep.rank, self._ep.size, self.log)
+
+    def rejoin_context(self, live_ep) -> "RejoinContext":
+        """Replay this rank's log, then continue live on `live_ep`."""
+        return RejoinContext(self.replay_context(), live_ep)
 
     def event_counts(self) -> tuple[int, int]:
         return len(self.log.sends), len(self.log.recvs)
@@ -195,6 +266,15 @@ class UniverseLogger:
         if not 0 <= rank < self._uni.size:
             raise errors.RankError(f"rank {rank} out of range")
         return ReplayContext(rank, self._uni.size, self._logs[rank])
+
+    def rejoin_context(self, rank: int, live_ep=None) -> "RejoinContext":
+        """Replay rank's log, then continue LIVE — by default on the
+        universe's own context for that rank (the restarted rank takes
+        its old slot back; pass `live_ep` to rejoin elsewhere, e.g. a
+        shrunken endpoint)."""
+        if live_ep is None:
+            live_ep = self._uni.contexts[rank]
+        return RejoinContext(self.replay_context(rank), live_ep)
 
     def event_counts(self, rank: int) -> tuple[int, int]:
         log = self._logs[rank]

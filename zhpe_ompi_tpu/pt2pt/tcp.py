@@ -30,15 +30,19 @@ coordinator plays for the device plane.
 from __future__ import annotations
 
 import itertools
+import random
 import socket
 import struct
 import threading
+import time
+import weakref
 from typing import Any
 
 from ..coll.host import HostCollectives
 from ..coll.nbc import NonblockingCollectives
 from ..core import errhandler as errh
 from ..core import errors
+from ..ft import ulfm
 from ..mca import output as mca_output
 from ..mca import var as mca_var
 from ..runtime import spc
@@ -92,25 +96,60 @@ def _send_frame(sock: socket.socket, payload: bytes) -> None:
     sock.sendall(_LEN.pack(len(payload)) + payload)
 
 
-def _recv_exact(sock: socket.socket, n: int) -> bytes | None:
+def _recv_exact(sock: socket.socket, n: int,
+                idle_retry: bool = False) -> bytes | None:
     buf = bytearray()
     while len(buf) < n:
-        chunk = sock.recv(n - len(buf))
+        try:
+            chunk = sock.recv(n - len(buf))
+        except socket.timeout:
+            if idle_retry and not buf:
+                # a QUIET connection is not a dead one: the drain's
+                # steady state must outlive any socket timeout.  A
+                # timeout with PARTIAL bytes read still raises — a peer
+                # wedged mid-frame would desync the length framing.
+                continue
+            raise
         if not chunk:
             return None
         buf.extend(chunk)
     return bytes(buf)
 
 
-def _recv_frame(sock: socket.socket) -> bytes | None:
-    header = _recv_exact(sock, _LEN.size)
+def _recv_frame(sock: socket.socket,
+                idle_retry: bool = False) -> bytes | None:
+    header = _recv_exact(sock, _LEN.size, idle_retry=idle_retry)
     if header is None:
         return None
     (length,) = _LEN.unpack(header)
     return _recv_exact(sock, length)
 
 
-class TcpProc(errh.HasErrhandler, HostCollectives,
+class _Backoff:
+    """Exponential connect backoff with deterministic per-caller jitter,
+    bounded by a total budget — shared by the modex rendezvous and lazy
+    endpoint establishment so a slow-starting peer is retried patiently
+    (no thundering herd) but never past the deadline."""
+
+    START, CAP = 0.01, 0.5
+
+    def __init__(self, budget: float, seed: int):
+        self.stop_at = time.monotonic() + budget
+        self.delay = self.START
+        self._jitter = random.Random(seed)
+
+    def expired(self, lookahead: float = 0.0) -> bool:
+        return time.monotonic() + lookahead >= self.stop_at
+
+    def sleep(self) -> None:
+        time.sleep(min(
+            self.delay * (0.5 + self._jitter.random()),
+            max(0.0, self.stop_at - time.monotonic()),
+        ))
+        self.delay = min(self.delay * 2, self.CAP)
+
+
+class TcpProc(errh.HasErrhandler, ulfm.UlfmEndpointAPI, HostCollectives,
               NonblockingCollectives):
     """One process's endpoint in a TCP universe of `size` ranks.
     Collectives come from :class:`~zhpe_ompi_tpu.coll.host.HostCollectives`
@@ -126,11 +165,16 @@ class TcpProc(errh.HasErrhandler, HostCollectives,
                  coordinator: tuple[str, int] = ("127.0.0.1", 0),
                  host: str = "127.0.0.1", timeout: float = 30.0,
                  on_coordinator_bound=None,
-                 external_coordinator: bool = False):
+                 external_coordinator: bool = False,
+                 ft: bool = False):
         if size < 1:
             raise errors.ArgError("size must be >= 1")
         self.rank = rank
         self.size = size
+        # ULFM state precedes the accept loop: drain threads consult it
+        self.ft_state = ulfm.FailureState(size) if ft else None
+        self._ft_dead = False
+        self._detector: ulfm.RingDetector | None = None
         self.engine = matching.make_matching_engine()
         self._seq = itertools.count()
         self._rndv_ids = itertools.count(1)
@@ -142,7 +186,9 @@ class TcpProc(errh.HasErrhandler, HostCollectives,
         self._timeout = timeout
         self._conns: dict[int, socket.socket] = {}
         self._conn_lock = threading.Lock()
-        self._send_lock = threading.Lock()  # one frame on the wire at a time
+        self._send_lock = threading.Lock()  # guards the lock registry only
+        self._sock_locks: weakref.WeakKeyDictionary = \
+            weakref.WeakKeyDictionary()  # socket -> its framing lock
         self._closed = threading.Event()
         self._incoming_cv = threading.Condition()
 
@@ -174,6 +220,169 @@ class TcpProc(errh.HasErrhandler, HostCollectives,
             5, _stream, "rank %d up at %s; book=%s", rank, self.address,
             self.address_book,
         )
+        if ft:
+            # ring heartbeat detector over framed beats: this rank emits
+            # to its nearest live predecessor, observes its nearest live
+            # successor, floods suspicion (the ULFM detector shape)
+            self._detector = ulfm.RingDetector(
+                rank, size, self.ft_state,
+                transport=ulfm.WireTransport(rank, size, self._ft_emit),
+                flood=self._ft_flood,
+                muted=lambda: self._ft_dead,
+                name=f"hb-tcp-{rank}",
+            )
+            self._detector.start()
+
+    def _framed_send(self, sock: socket.socket, frame: bytes) -> None:
+        """Frames must not interleave on ONE socket, but independent
+        sockets must not serialize behind each other — above all for the
+        heartbeat path: a data send blocked on a wedged peer holding a
+        global lock would starve this rank's own beats and get it
+        falsely suspected.  Per-socket granularity is the contract."""
+        with self._send_lock:
+            lock = self._sock_locks.get(sock)
+            if lock is None:
+                lock = self._sock_locks[sock] = threading.Lock()
+        with lock:
+            _send_frame(sock, frame)
+
+    # -- ULFM control plane ---------------------------------------------
+
+    def _ft_emit(self, dest: int) -> None:
+        """One heartbeat frame to `dest` (best-effort: a beat that cannot
+        be delivered is evidence, not an error)."""
+        if self._ft_dead or self._closed.is_set() \
+                or self.ft_state.is_failed(dest):
+            return
+        frame = dss.pack(self.rank, 0, ulfm.FT_HB_CID, 0, b"")
+        try:
+            # short connect deadline: the detector thread must never park
+            # in a connect retry, or our OWN beats stop and the observer
+            # falsely suspects us
+            sock = self._endpoint(dest, deadline=4 * self._detector.period
+                                  if self._detector else 0.5)
+            self._framed_send(sock, frame)
+        except (OSError, errors.MpiError) as e:
+            if isinstance(e, (ConnectionRefusedError, ConnectionResetError,
+                              BrokenPipeError)):
+                # connection refused/reset IS peer death, not a stall
+                self.ft_state.mark_failed(dest, cause="transport")
+
+    def _flood(self, cid: int, payload: Any, name: str) -> None:
+        """Best-effort ULFM control-plane flood to every live peer, on a
+        one-shot daemon thread: no flooding caller — the detector loop
+        (which must keep beating or its OWN observer falsely suspects
+        it), a rank mid-recovery revoking a cid, a completing agreement
+        — may stall behind serial connect deadlines to unreachable
+        peers.  An undeliverable frame is dropped: the peer's own
+        detector/recovery path covers it."""
+        threading.Thread(
+            target=self._flood_sync, args=(cid, payload),
+            daemon=True, name=f"{name}-{self.rank}",
+        ).start()
+
+    def _flood_sync(self, cid: int, payload: Any) -> None:
+        frame = dss.pack(self.rank, 0, cid, 0, payload)
+        for r in range(self.size):
+            if r == self.rank or self.ft_state.is_failed(r):
+                continue
+            try:
+                sock = self._endpoint(r, deadline=1.0)
+                self._framed_send(sock, frame)
+            except (OSError, errors.MpiError):
+                pass
+
+    def _ft_flood(self, failed: frozenset) -> None:
+        """Propagate suspicion: failure notices to every live rank."""
+        self._flood(ulfm.FT_NOTICE_CID, sorted(int(r) for r in failed),
+                    "hb-flood")
+
+    def _agree_announce(self, seq: int, result: bool) -> None:
+        """Flood a completed agreement's value into the live peers'
+        result registries (the recovery channel of :func:`ulfm.agree`):
+        a survivor the dead coordinator never reached adopts the value
+        from its registry instead of waiting out a round nobody can
+        finish — and a re-elected coordinator gathering from an
+        already-departed participant converges the same way."""
+        self._flood(ulfm.FT_AGREE_PUB_CID, [int(seq), bool(result)],
+                    "agree-pub")
+
+    def _ft_ctrl(self, cid: int, src: int, payload: Any) -> None:
+        """Control frames intercepted before the matching engine."""
+        if cid == ulfm.FT_HB_CID:
+            if self._detector is not None:
+                self._detector.transport.on_beat(src)
+        elif cid == ulfm.FT_NOTICE_CID:
+            self.ft_state.merge_failed(payload)
+        elif cid == ulfm.FT_REVOKE_CID:
+            self.ft_state.revoke(int(payload))
+        elif cid == ulfm.FT_AGREE_PUB_CID:
+            seq, result = payload
+            self.ft_state.record_agreement(int(seq), bool(result))
+        elif cid == ulfm.FT_BYE_CID:
+            # relay newly-learned departures onward (gossip-once): the
+            # departing rank goodbyes only its CONNECTED peers, so a
+            # survivor it never dialed would otherwise re-learn the rank
+            # the hard way — ring reconfiguration adopts it as observed
+            # successor, sees no beats, and scores a detector false
+            # positive for a clean exit.  mark_departed returns False
+            # for anything already known, so each rank relays a given
+            # departure at most once and the flood terminates.
+            fresh = [int(r) for r in payload
+                     if self.ft_state.mark_departed(int(r))]
+            if fresh and not self._ft_dead and not self._closed.is_set():
+                self._flood(ulfm.FT_BYE_CID, fresh, "bye-gossip")
+
+    def revoke(self, cid: int) -> None:
+        """MPIX_Comm_revoke on the wire: poison locally, flood the
+        notice so every live rank's pending and future operations on
+        this cid raise ``Revoked``.  Local state is poisoned before the
+        flood thread starts, so the revoking rank's own operations fail
+        fast and the caller's RECOVERY path never stalls behind the
+        flood's connect deadlines."""
+        state = self.ft_state
+        if state is None:
+            raise errors.UnsupportedError(
+                "revoke needs fault tolerance enabled (ft=True)"
+            )
+        state.revoke(cid)
+        self._flood(ulfm.FT_REVOKE_CID, int(cid), "revoke-flood")
+
+    def sever(self) -> None:
+        """Simulate process death (the fault-injection hook): heartbeats
+        stop and every socket is torn down abruptly — no quiescence, no
+        goodbye — so peers see connection reset exactly like a crash."""
+        self._ft_dead = True
+        if self._detector is not None:
+            self._detector.stop(join_timeout=0.0)
+        self._closed.set()
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        with self._conn_lock:
+            conns = list(self._conns.values()) + self._dup_conns
+            self._conns.clear()
+            self._dup_conns = []
+        for sock in conns:
+            try:
+                # RST on close (SO_LINGER 0): peers must observe a reset,
+                # not an orderly shutdown — this is a crash, not a close
+                sock.setsockopt(
+                    socket.SOL_SOCKET, socket.SO_LINGER,
+                    struct.pack("ii", 1, 0),
+                )
+            except OSError:
+                pass
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    def mute(self) -> None:
+        """Simulate a hang/partition: heartbeats stop, sockets stay up —
+        only the failure detector can discover this death."""
+        self._ft_dead = True
 
     # -- wire-up ---------------------------------------------------------
 
@@ -208,19 +417,24 @@ class TcpProc(errh.HasErrhandler, HostCollectives,
         cli = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         cli.settimeout(timeout)
         deadline_err = None
-        import time
-
-        for _ in range(200):  # coordinator may not be up yet
+        # backoff bounded by the modex deadline: a slow-starting
+        # coordinator is retried patiently but never past `timeout` —
+        # distinguishing "not up yet" from "never coming" by the total
+        # budget, not a fixed attempt count
+        backoff = _Backoff(timeout, self.rank ^ 0x5EED)
+        connected = False
+        while not backoff.expired():
             try:
                 cli.connect(coordinator)
+                connected = True
                 break
             except OSError as e:
                 deadline_err = e
-                time.sleep(0.05)
                 cli.close()
+                backoff.sleep()
                 cli = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
                 cli.settimeout(timeout)
-        else:
+        if not connected:
             # transport failure routes through the errhandler disposition
             # (ompi_errhandler_invoke at the transport boundary,
             # errhandler.h:94-136): FATAL raises JobAbort, RETURN hands
@@ -293,12 +507,20 @@ class TcpProc(errh.HasErrhandler, HostCollectives,
         later message on this connection would silently vanish."""
         while not self._closed.is_set():
             try:
-                frame = _recv_frame(conn)
+                frame = _recv_frame(conn, idle_retry=True)
             except OSError:
                 return
             if frame is None:
                 return
             [src, tag, cid, seq, payload] = dss.unpack(frame)
+            if self.ft_state is not None and cid in (
+                ulfm.FT_HB_CID, ulfm.FT_NOTICE_CID, ulfm.FT_REVOKE_CID,
+                ulfm.FT_AGREE_PUB_CID, ulfm.FT_BYE_CID,
+            ):
+                # ULFM control plane: heartbeats / failure notices /
+                # revoke floods never enter the matching engine
+                self._ft_ctrl(cid, src, payload)
+                continue
             env = Envelope(src, tag, cid, seq)
             spc.record("tcp_bytes_recvd", len(frame))
             try:
@@ -313,19 +535,72 @@ class TcpProc(errh.HasErrhandler, HostCollectives,
                     type(e).__name__, e,
                 )
 
-    def _endpoint(self, dest: int) -> socket.socket:
+    def _endpoint(self, dest: int,
+                  deadline: float | None = None) -> socket.socket:
         with self._conn_lock:
             sock = self._conns.get(dest)
         if sock is not None:
             return sock
-        # lazy connection establishment (btl_tcp_endpoint shape).
+        if self.ft_state is not None and self.ft_state.is_failed(dest):
+            raise errors.ProcFailed(
+                f"rank {dest} is known failed",
+                failed_ranks=self.ft_state.failed(),
+            )
+        # lazy connection establishment (btl_tcp_endpoint shape) with
+        # exponential backoff + jitter bounded by a total deadline: a
+        # peer still wiring up is retried, not misclassified as dead.
         # Cards may carry extra capability items beyond (host, port) —
         # C ranks advertise their shared-memory transport there — so
         # the connect address is always the 2-prefix.
         addr = tuple(self.address_book[dest][:2])
-        sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        budget = self._timeout if deadline is None else deadline
+        backoff = _Backoff(budget, (self.rank << 16) ^ dest)
+        sock = None
+        while True:
+            sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            sock.settimeout(max(0.05, min(self._timeout, budget)))
+            try:
+                sock.connect(addr)
+                break
+            except OSError as e:
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+                state = self.ft_state
+                if state is not None and state.is_failed(dest):
+                    raise errors.ProcFailed(
+                        f"rank {dest} failed while connecting",
+                        failed_ranks=state.failed(),
+                    ) from e
+                if state is None and isinstance(
+                    e, (ConnectionRefusedError, ConnectionResetError)
+                ):
+                    # non-ft: the peer advertised this port through the
+                    # modex, so its listener WAS bound — refused now
+                    # means it is gone, and without ft there is no
+                    # rejoin path that could re-bind it.  Fail fast
+                    # (the seed behavior) instead of burning the whole
+                    # backoff budget on a corpse.
+                    raise
+                if backoff.expired(lookahead=backoff.delay):
+                    if state is not None and isinstance(
+                        e, (ConnectionRefusedError, ConnectionResetError)
+                    ):
+                        # refused past the backoff budget: the peer's
+                        # listener is gone — that is death, not a stall
+                        state.mark_failed(dest, cause="transport")
+                        raise errors.ProcFailed(
+                            f"rank {dest} unreachable "
+                            f"(connection refused/reset): {e}",
+                            failed_ranks=state.failed(),
+                        ) from e
+                    raise
+                backoff.sleep()
+        # the connect BUDGET must not become the socket's steady-state
+        # timeout: a 0.2s heartbeat budget would bound every later send
+        # on this cached socket (and starve its peer-side drain)
         sock.settimeout(self._timeout)
-        sock.connect(addr)
         _send_frame(sock, dss.pack(self.rank))
         with self._conn_lock:
             existing = self._conns.get(dest)
@@ -378,22 +653,37 @@ class TcpProc(errh.HasErrhandler, HostCollectives,
         frame = dss.pack(self.rank, tag, cid, seq, obj)
         spc.record("tcp_bytes_sent", len(frame))
         sock = self.bridge_endpoint(cid, dest, addr)
-        with self._send_lock:
-            _send_frame(sock, frame)
+        self._framed_send(sock, frame)
 
     # -- MPI surface (RankContext-compatible) ----------------------------
 
-    def send(self, obj: Any, dest: int, tag: int = 0, cid: int = 0) -> None:
+    def send(self, obj: Any, dest: int, tag: int = 0, cid: int = 0,
+             poll: bool = False) -> None:
         """Length-framed send: eager below ``tcp_eager_limit``, RTS/CTS
         rendezvous above it (ob1's protocol split on the wire — an
         unmatched multi-GB send must park at the SENDER, not in the
         receiver's unexpected queue).  The rendezvous payload is
         serialized at send time, so the MPI buffer-reuse contract holds
-        the moment this returns."""
+        the moment this returns.
+
+        ``poll=True`` marks a framework-internal send (e.g. an agreement
+        round): typed failures raise directly, bypassing the errhandler
+        disposition, so fault-tolerant protocols can observe and recover
+        from peer death regardless of the user's disposition."""
         if not 0 <= dest < self.size:
             raise errors.RankError(f"rank {dest} out of range")
         if tag < 0:
             raise errors.TagError(f"negative tag {tag}")
+        state = self.ft_state
+        if state is not None and state.is_revoked(cid):
+            # before ANY delivery path, the loopback fast path included:
+            # a revoked cid poisons sends to self like any other
+            exc: errors.MpiError = errors.Revoked(
+                f"send on revoked cid={cid}", cid=cid
+            )
+            if poll:
+                raise exc
+            return self.call_errhandler(exc)
         seq = next(self._seq)
         if dest == self.rank:
             frame = dss.pack(self.rank, tag, cid, seq, obj)
@@ -406,14 +696,37 @@ class TcpProc(errh.HasErrhandler, HostCollectives,
             return
         nbytes = _payload_size(obj)
         limit = int(mca_var.get("tcp_eager_limit", 1 << 20))
-        if nbytes > limit:
-            self._send_rndv(obj, dest, tag, cid, seq, nbytes)
-            return
-        frame = dss.pack(self.rank, tag, cid, seq, obj)
-        spc.record("tcp_bytes_sent", len(frame))
-        sock = self._endpoint(dest)
-        with self._send_lock:  # frames must not interleave on a socket
-            _send_frame(sock, frame)
+        try:
+            if nbytes > limit:
+                self._send_rndv(obj, dest, tag, cid, seq, nbytes)
+                return
+            frame = dss.pack(self.rank, tag, cid, seq, obj)
+            spc.record("tcp_bytes_sent", len(frame))
+            sock = self._endpoint(dest)
+            self._framed_send(sock, frame)
+        except errors.ProcFailed as exc:
+            # peer death classified by the endpoint layer: route through
+            # the attached disposition (FATAL aborts, RETURN raises typed)
+            if poll:
+                raise
+            return self.call_errhandler(exc)
+        except OSError as e:
+            if state is None or not isinstance(
+                e, (ConnectionRefusedError, ConnectionResetError,
+                    BrokenPipeError)
+            ):
+                # a stalled send (timeout on a live but slow peer) is NOT
+                # death — only reset/refused/pipe is; the endpoint layer
+                # already re-raised non-death errors raw, honor that here
+                raise
+            state.mark_failed(dest, cause="transport")
+            exc = errors.ProcFailed(
+                f"send to rank {dest} failed: {e}",
+                failed_ranks=state.failed(),
+            )
+            if poll:
+                raise exc from e
+            return self.call_errhandler(exc)
 
     def _send_rndv(self, obj: Any, dest: int, tag: int, cid: int,
                    seq: int, nbytes: int) -> None:
@@ -479,8 +792,7 @@ class TcpProc(errh.HasErrhandler, HostCollectives,
             (_RTS_MARK, self.rank, rndv_id, nbytes),
         )
         sock = self._endpoint(dest)
-        with self._send_lock:
-            _send_frame(sock, rts)
+        self._framed_send(sock, rts)
 
     def _resolve_rndv(self, env: Envelope, payload: Any, deliver) -> bool:
         """If `payload` is an RTS marker, pull the real payload over
@@ -501,8 +813,7 @@ class TcpProc(errh.HasErrhandler, HostCollectives,
         cts = dss.pack(self.rank, rndv_id, _RNDV_CTS_CID, next(self._seq),
                        b"")
         sock = self._endpoint(sender)
-        with self._send_lock:
-            _send_frame(sock, cts)
+        self._framed_send(sock, cts)
         return True
 
     def isend(self, obj: Any, dest: int, tag: int = 0, cid: int = 0):
@@ -573,13 +884,41 @@ class TcpProc(errh.HasErrhandler, HostCollectives,
                 return
             finalize(env, payload)
 
+        state = self.ft_state
+        if state is not None:
+            # revocation poisons pending AND future receives
+            fail_exc = ulfm.classify_recv_failure(state, source, cid)
+            if isinstance(fail_exc, errors.Revoked):
+                if poll:
+                    raise fail_exc
+                return self.call_errhandler(fail_exc)
         with self._incoming_cv:
             self.engine.post_recv(source, tag, cid, on_match)
-        if not done.wait(timeout):
+        if state is None:
+            completed = done.wait(timeout)
+            fail_exc = None
+        else:
+            # sliced wait so peer death classifies promptly: a receive
+            # blocked on a rank that dies mid-wait must surface typed
+            # ProcFailed, not ride out the full stall timeout
+            fail_exc = None
+            wait_deadline = time.monotonic() + timeout
+            while True:
+                if done.wait(0.02):
+                    break
+                fail_exc = ulfm.classify_recv_failure(state, source, cid)
+                if fail_exc is not None or time.monotonic() > wait_deadline:
+                    break
+            completed = done.is_set()
+        if not completed:
             with self._incoming_cv:
                 if not done.is_set():
                     abandoned[0] = True
             if not done.is_set():
+                if fail_exc is not None:
+                    if poll:
+                        raise fail_exc
+                    return self.call_errhandler(fail_exc)
                 # diagnosis: is the message parked unexpected while our
                 # posted recv failed to match it? (engine race forensics;
                 # queue snapshots only exist on the Python engine and are
@@ -642,16 +981,57 @@ class TcpProc(errh.HasErrhandler, HostCollectives,
             k <<= 1
 
     def close(self) -> None:
-        # Quiesce outstanding rendezvous sends first: the payload parks
-        # here until the receiver's CTS, so tearing down immediately after
-        # a buffered send() would destroy data the peer is entitled to
-        # (ompi_mpi_finalize's quiesce-before-teardown contract).  Bounded
-        # wait: a peer that never matches cannot hang our shutdown.
-        import time as _time
-
-        deadline = _time.monotonic() + self._timeout
-        while self._pending_rndv and _time.monotonic() < deadline:
-            _time.sleep(0.005)
+        # Quiesce outstanding rendezvous sends FIRST — with the detector
+        # still beating: the payload parks here until the receiver's CTS,
+        # so tearing down immediately after a buffered send() would
+        # destroy data the peer is entitled to (ompi_mpi_finalize's
+        # quiesce-before-teardown contract), and a long quiesce with our
+        # own beats already silenced would get us falsely suspected by
+        # our observer.  Bounded wait: a peer that never matches cannot
+        # hang our shutdown.
+        deadline = time.monotonic() + self._timeout
+        while self._pending_rndv and time.monotonic() < deadline:
+            time.sleep(0.005)
+        if self.ft_state is not None and not self._ft_dead:
+            # orderly departure: tell the survivors we are LEAVING, so
+            # their detectors reconfigure the ring instead of suspecting
+            # us via missed beats (cause="goodbye", pre-acknowledged:
+            # never a detector false positive, and never a pending gate
+            # on survivors' wildcard receives — finalize skew is not a
+            # crash) — the goodbye the crash paths (sever/mute)
+            # deliberately omit.  Per-socket FIFO puts the goodbye after
+            # every frame already sent, so no delivered message is
+            # reclassified as lost.
+            goodbye = dss.pack(self.rank, 0, ulfm.FT_BYE_CID, 0,
+                               [self.rank])
+            # only ALREADY-CONNECTED peers get the goodbye directly:
+            # they are the ones holding delivered frames the notice must
+            # trail (the FIFO argument), and our observer is among them
+            # by construction — we beat toward it over a cached socket.
+            # Dialing fresh connections just to say goodbye would stall
+            # shutdown on refused-connect retries for peers already
+            # gone; recipients gossip the BYE onward (_ft_ctrl), so
+            # never-connected survivors still learn of the departure.
+            with self._conn_lock:
+                connected = list(self._conns.items())
+            for r, sock in connected:
+                if not isinstance(r, int) \
+                        or r == self.rank or self.ft_state.is_failed(r):
+                    # tuple keys are intercomm-bridge peers: a DIFFERENT
+                    # job's rank namespace, where our departing rank
+                    # number would poison their unrelated local rank
+                    continue
+                try:
+                    self._framed_send(sock, goodbye)
+                except OSError:
+                    pass  # peer already gone: nothing to notify
+        # the heartbeat thread stops only NOW: the goodbye above already
+        # reconfigured the peers' rings, and our beats had to stay alive
+        # through the quiesce so nobody suspected us mid-shutdown.  It
+        # must still stop before teardown (no emitting into dying
+        # sockets; fixtures assert no detector thread leaks).
+        if self._detector is not None:
+            self._detector.stop()
         self._closed.set()
         # shutdown() first, close() only after the reader threads exit:
         # drain/accept threads are blocked in recv/accept on these
@@ -675,12 +1055,12 @@ class TcpProc(errh.HasErrhandler, HostCollectives,
                 sock.shutdown(socket.SHUT_RDWR)
             except OSError:
                 pass
-        deadline = _time.monotonic() + 5.0
-        self._accept_thread.join(max(0.0, deadline - _time.monotonic()))
+        deadline = time.monotonic() + 5.0
+        self._accept_thread.join(max(0.0, deadline - time.monotonic()))
         with self._drain_lock:
             drains = list(self._drains)
         for t in drains:
-            t.join(max(0.0, deadline - _time.monotonic()))
+            t.join(max(0.0, deadline - time.monotonic()))
         try:
             self._listener.close()
         except OSError:

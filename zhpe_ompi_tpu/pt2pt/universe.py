@@ -30,12 +30,14 @@ import numpy as np
 
 from ..coll.host import HostCollectives
 from ..coll.nbc import NonblockingCollectives
+from ..core import errhandler as errh
 from ..core import errors
+from ..ft import ulfm
 from ..mca import var as mca_var
 from ..runtime import spc
 from . import matching
 from .matching import ANY_SOURCE, ANY_TAG, Envelope
-from .requests import Request, Status
+from .requests import Request, Status, _payload_bytes
 
 mca_var.register(
     "pt2pt_eager_limit", 64 * 1024,
@@ -122,12 +124,17 @@ class PersistentRequest:
         return flag, value
 
 
-class RankContext(HostCollectives, NonblockingCollectives):
+class RankContext(errh.HasErrhandler, ulfm.UlfmEndpointAPI,
+                  HostCollectives, NonblockingCollectives):
     """One rank's endpoint: the MPI API surface of the host plane.
     Collectives come from :class:`~zhpe_ompi_tpu.coll.host.HostCollectives`
     (blocking) and :class:`~zhpe_ompi_tpu.coll.nbc.NonblockingCollectives`
     (MPI_Ix round schedules) — written over send/recv, the way the
-    reference's coll_base and libnbc ride the PML."""
+    reference's coll_base and libnbc ride the PML.  On an ft-enabled
+    universe the ULFM surface (:class:`~zhpe_ompi_tpu.ft.ulfm
+    .UlfmEndpointAPI`) is live too, and failures classify as typed
+    ``ProcFailed``/``Revoked`` through the attached errhandler
+    disposition (communicator default: MPI_ERRORS_ARE_FATAL)."""
 
     def __init__(self, universe: "LocalUniverse", rank: int):
         self.universe = universe
@@ -139,6 +146,12 @@ class RankContext(HostCollectives, NonblockingCollectives):
         self._pending_rndv: dict[int, tuple[Any, Request]] = {}
         self._rndv_ids = itertools.count()
         self._lock = threading.Lock()
+
+    @property
+    def ft_state(self):
+        """The universe's shared ULFM failure state (None unless the
+        universe was built with ft=True)."""
+        return self.universe.ft_state
 
     # -- internals -------------------------------------------------------
 
@@ -176,12 +189,43 @@ class RankContext(HostCollectives, NonblockingCollectives):
 
     # -- sends -----------------------------------------------------------
 
-    def isend(self, obj: Any, dest: int, tag: int = 0, cid: int = 0
-              ) -> Request:
+    def isend(self, obj: Any, dest: int, tag: int = 0, cid: int = 0,
+              poll: bool = False) -> Request:
         """MPI_Isend (cf. mca_pml_ob1_send's protocol switch,
-        pml_ob1_sendreq.h:385-414)."""
+        pml_ob1_sendreq.h:385-414).  ``poll=True`` marks a
+        framework-internal send: typed failures raise directly, bypassing
+        the errhandler disposition (the same contract as ``recv``)."""
         if tag < 0:
             raise errors.TagError(f"negative tag {tag}")
+        state = self.universe.ft_state
+        if state is not None and state.is_revoked(cid):
+            # a revoked cid poisons sends on every rank (MPIX_Comm_revoke);
+            # route per disposition (FATAL aborts, RETURN raises typed)
+            exc = errors.Revoked(f"send on revoked cid={cid}", cid=cid)
+            if poll:
+                raise exc
+            # a recovering user handler returns a value, but isend's
+            # contract is a Request (send() calls .wait() on it) — ride
+            # the recovery result on a pre-completed one
+            recovered = Request()
+            recovered.complete(self.call_errhandler(exc))
+            return recovered
+        if state is not None and state.is_failed(dest):
+            # send to a known-failed rank is typed PROC_FAILED, exactly
+            # like the wire plane — without this, a rendezvous-size send
+            # would park its RTS in the dead rank's mailbox and wait()
+            # would spin until the run's deadlock timeout (the
+            # stall-vs-death ambiguity the ft path exists to remove)
+            exc = errors.ProcFailed(
+                f"rank {dest} is known failed "
+                f"(cause: {state.cause_of(dest)})",
+                failed_ranks=state.failed(),
+            )
+            if poll:
+                raise exc
+            recovered = Request()
+            recovered.complete(self.call_errhandler(exc))
+            return recovered
         # memchecker annotation point (ompi/mpi/c/send.c:53-55 analog)
         from ..utils import memchecker
 
@@ -202,9 +246,10 @@ class RankContext(HostCollectives, NonblockingCollectives):
             self._mbox(dest).put((_RTS, env, self.rank, rndv_id))
         return req
 
-    def send(self, obj: Any, dest: int, tag: int = 0, cid: int = 0) -> None:
+    def send(self, obj: Any, dest: int, tag: int = 0, cid: int = 0,
+             poll: bool = False) -> None:
         """MPI_Send: blocking (completes when the buffer is reusable)."""
-        self.isend(obj, dest, tag, cid).wait()
+        self.isend(obj, dest, tag, cid, poll=poll).wait()
 
     # -- receives --------------------------------------------------------
 
@@ -228,12 +273,105 @@ class RankContext(HostCollectives, NonblockingCollectives):
         return req
 
     def recv(self, source: int = ANY_SOURCE, tag: int = ANY_TAG,
-             cid: int = 0, return_status: bool = False):
-        """MPI_Recv."""
+             cid: int = 0, timeout: float | None = None,
+             return_status: bool = False, poll: bool = False):
+        """MPI_Recv.  On an ft-enabled universe a receive blocked on a
+        dead rank raises typed ``ProcFailed`` (named source) or
+        ``ProcFailedPending`` (ANY_SOURCE with an unacknowledged
+        failure) through the errhandler disposition, instead of hanging
+        until the run's deadlock timeout — callers can distinguish stall
+        from death.  ``poll=True`` marks a framework-internal receive:
+        classification raises directly, bypassing the disposition."""
+        if self.universe.ft_state is not None:
+            return self._ft_recv(source, tag, cid, timeout,
+                                 return_status, poll)
         req = self.irecv(source, tag, cid)
-        value = req.wait()
+        value = req.wait(timeout)
         if return_status:
             return value, req.status
+        return value
+
+    def _ft_classify(self, source: int, cid: int
+                     ) -> errors.MpiError | None:
+        """Typed failure for a receive that cannot complete, or None."""
+        return ulfm.classify_recv_failure(self.universe.ft_state,
+                                          source, cid)
+
+    def _ft_recv(self, source: int, tag: int, cid: int,
+                 timeout: float | None, return_status: bool, poll: bool):
+        """Receive with live-failure classification.  Delivery runs only
+        from this rank's own progress() (single-threaded), so the
+        abandoned/re-inject contract needs no extra locking: a message
+        matched after classification re-enters the engine for a retry
+        (e.g. after failure_ack)."""
+        import time
+
+        box: list[Any] = []
+        envs: list[Envelope] = []
+        done = threading.Event()
+        abandoned = [False]
+        # eager delivery is single-threaded (this rank's progress()),
+        # but a rendezvous CTS handoff completes on the SENDER's
+        # progress thread — the abandon decision must serialize with
+        # delivery or a payload landing in the classification window is
+        # consumed yet neither returned nor re-injected (silent loss)
+        abandon_lock = threading.Lock()
+
+        def deliver(env: Envelope, payload: Any) -> None:
+            with abandon_lock:
+                if abandoned[0]:
+                    self.engine.incoming(env, payload)
+                    return
+                box.append(payload)
+                envs.append(env)
+                done.set()
+
+        def on_match(env: Envelope, payload: Any) -> None:
+            if isinstance(payload, _RndvToken):
+                def handoff(data, env=env):
+                    deliver(env, data)
+
+                self.universe.contexts[payload.sender_rank].mailbox.put(
+                    (_CTS, payload.rndv_id, self.rank, handoff)
+                )
+            else:
+                deliver(env, payload)
+
+        exc: errors.MpiError | None = None
+        self.engine.post_recv(source, tag, cid, on_match)
+        deadline = (None if timeout is None
+                    else time.monotonic() + timeout)
+        while not done.is_set():
+            self.progress()
+            if done.is_set():
+                break
+            exc = self._ft_classify(source, cid)
+            if exc is None and deadline is not None \
+                    and time.monotonic() > deadline:
+                exc = errors.InternalError(
+                    f"recv timeout (src={source}, tag={tag}, cid={cid})"
+                )
+            if exc is not None:
+                # final drain: the dead rank's last messages may already
+                # sit in our mailbox — death must not eat delivered data
+                self.progress()
+                with abandon_lock:
+                    if done.is_set():
+                        exc = None
+                    else:
+                        abandoned[0] = True
+                break
+            done.wait(0.0005)
+        if exc is not None:
+            if poll:
+                raise exc
+            return self.call_errhandler(exc)
+        value, env = box[0], envs[0]
+        if return_status:
+            return value, Status(
+                source=env.src, tag=env.tag,
+                count_bytes=_payload_bytes(value),
+            )
         return value
 
     def probe(self, source: int = ANY_SOURCE, tag: int = ANY_TAG,
@@ -290,7 +428,13 @@ class RankContext(HostCollectives, NonblockingCollectives):
 
     def sendrecv(self, obj: Any, dest: int, source: int = ANY_SOURCE,
                  sendtag: int = 0, recvtag: int = ANY_TAG, cid: int = 0):
-        """MPI_Sendrecv."""
+        """MPI_Sendrecv.  On an ft universe the receive side runs the
+        classified path, so a partner that dies mid-exchange surfaces
+        typed ProcFailed instead of wedging the wait — collectives built
+        over sendrecv (ring allgather et al.) inherit failure delivery."""
+        if self.universe.ft_state is not None:
+            self.isend(obj, dest, sendtag, cid)
+            return self.recv(source, recvtag, cid)
         rreq = self.irecv(source, recvtag, cid)
         self.isend(obj, dest, sendtag, cid)
         return rreq.wait()
@@ -345,20 +489,61 @@ def _register_queue_pvars() -> None:
 
 
 class LocalUniverse:
-    """N thread-ranks on one host (btl/self+sm analog)."""
+    """N thread-ranks on one host (btl/self+sm analog).
 
-    def __init__(self, size: int):
+    ``ft=True`` arms the ULFM machinery: a shared
+    :class:`~zhpe_ompi_tpu.ft.ulfm.FailureState`, a heartbeat board the
+    ring detector reads, typed failure delivery from ``recv``, and
+    tolerant ``run`` semantics (a rank killed by the fault-injection
+    harness does not abort the surviving ranks' run)."""
+
+    def __init__(self, size: int, ft: bool = False):
         if size < 1:
             raise errors.ArgError("size must be >= 1")
         self.size = size
+        self.ft_state = ulfm.FailureState(size) if ft else None
+        self.ft_board = ulfm.HeartbeatBoard(size) if ft else None
+        self.ft_detectors: list[ulfm.RingDetector] = []
         self.contexts = [RankContext(self, r) for r in range(size)]
         _live_universes.add(self)
         _register_queue_pvars()
 
+    # -- failure detection (ULFM ring detector over the beat board) ------
+
+    def start_failure_detector(self, period: float | None = None,
+                               timeout: float | None = None) -> None:
+        """Start one ring-detector daemon thread per rank (requires
+        ft=True).  Callers own shutdown via stop_failure_detector —
+        test fixtures must not leak heartbeat threads."""
+        if self.ft_state is None:
+            raise errors.UnsupportedError(
+                "failure detector needs a universe built with ft=True"
+            )
+        if self.ft_detectors:
+            return
+        for r in range(self.size):
+            det = ulfm.RingDetector(
+                r, self.size, self.ft_state,
+                transport=ulfm.BoardTransport(self.ft_board, r),
+                muted=(lambda r=r: self.ft_board.is_dead(r)),
+                period=period, timeout=timeout,
+                name=f"hb-uni-{id(self) & 0xFFFF:x}-{r}",
+            )
+            det.start()
+            self.ft_detectors.append(det)
+
+    def stop_failure_detector(self) -> None:
+        for det in self.ft_detectors:
+            det.stop()
+        self.ft_detectors = []
+
     def run(self, fn: Callable[[RankContext], Any], timeout: float = 60.0
             ) -> list[Any]:
         """SPMD-launch fn(ctx) on every rank thread; returns per-rank
-        results; re-raises the first rank exception."""
+        results; re-raises the first rank exception.  Under ft=True a
+        rank's exit is recorded in the failure state (receivers blocked
+        on it classify ProcFailed), and RankKilled — injected death — is
+        an expected outcome, not a run failure."""
         results: list[Any] = [None] * self.size
         excs: list[BaseException | None] = [None] * self.size
 
@@ -367,6 +552,20 @@ class LocalUniverse:
                 results[r] = fn(self.contexts[r])
             except BaseException as e:  # noqa: BLE001 - propagated below
                 excs[r] = e
+            finally:
+                if self.ft_state is not None:
+                    if self.ft_board is not None:
+                        self.ft_board.kill(r)
+                    e = excs[r]
+                    if isinstance(e, ulfm.RankKilled):
+                        # "mute" deaths are left for the detector to
+                        # discover (the hang/partition scenario)
+                        if e.mode != "mute":
+                            self.ft_state.mark_failed(r, cause="killed")
+                    else:
+                        self.ft_state.mark_failed(
+                            r, cause="exit" if e is None else "crash"
+                        )
 
         threads = [
             threading.Thread(target=runner, args=(r,), daemon=True)
@@ -381,6 +580,24 @@ class LocalUniverse:
                     "universe.run timed out (deadlock between ranks?)"
                 )
         for e in excs:
-            if e is not None:
+            if e is not None and not (
+                self.ft_state is not None
+                and isinstance(e, ulfm.RankKilled)
+            ):
+                # an injected death is an expected outcome only when the
+                # universe is ft-armed; on a plain universe nothing
+                # records it, so swallowing it would report success on a
+                # run that never completed
                 raise e
+        if self.ft_state is not None:
+            # end-of-run "exit" marks exist so receivers blocked on an
+            # already-finished rank classify ProcFailed MID-run; once
+            # the job is over a clean exit is not a process failure —
+            # forget it, so the universe is reusable for another run.
+            # Killed/crashed ranks stay failed (recovery owns them).
+            for r in range(self.size):
+                if excs[r] is None and self.ft_state.cause_of(r) == "exit":
+                    self.ft_state.restore(r)
+                    if self.ft_board is not None:
+                        self.ft_board.revive(r)
         return results
