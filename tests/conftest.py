@@ -36,12 +36,15 @@ def pytest_configure(config):
 
 @pytest.fixture(scope="session", autouse=True)
 def _ulfm_detector_hygiene():
-    """Suite-wide ULFM acceptance gates, checked once at session end:
-    the heartbeat failure detector must produce ZERO false positives
-    across a clean run (suspicions of ranks no fault plan killed), and
-    no detector thread may leak past its test's fixtures."""
+    """Suite-wide ULFM + recovery acceptance gates, checked once at
+    session end: the heartbeat failure detector must produce ZERO false
+    positives across a clean run (suspicions of ranks no fault plan
+    killed), no detector thread may leak past its test's fixtures, no
+    RESPAWNED-rank thread may outlive the recovery test that grew the
+    job back to full size, and no checkpoint directory a rollback
+    touched may be left holding orphaned ``.tmp``/``.old`` partials."""
     yield
-    from zhpe_ompi_tpu.ft import ulfm
+    from zhpe_ompi_tpu.ft import recovery, ulfm
 
     fps = ulfm.false_positive_count()
     assert fps == 0, (
@@ -50,6 +53,15 @@ def _ulfm_detector_hygiene():
     )
     leaked = ulfm.live_detectors()
     assert not leaked, f"heartbeat detector threads leaked: {leaked}"
+    respawned = recovery.live_respawn_threads()
+    assert not respawned, (
+        f"respawned-rank threads leaked past their recovery test: "
+        f"{respawned}"
+    )
+    partials = recovery.orphaned_checkpoint_partials()
+    assert not partials, (
+        f"recovery left orphaned checkpoint partials on disk: {partials}"
+    )
 
 
 @pytest.fixture(autouse=True)

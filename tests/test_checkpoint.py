@@ -122,6 +122,153 @@ class TestSaveRestore:
         )
 
 
+class TestCrashDuringWrite:
+    """A writer killed at the precise seams of the publish protocol must
+    leave ``restore()`` returning the previous COMPLETE step, never a
+    partial (the crs/self handshake contract the recovery pipeline's
+    rollback step depends on)."""
+
+    @staticmethod
+    def _kill_replace_on(monkeypatch, match, after: int = 0):
+        """Arm os.replace to die (simulated kill) on the `after`-th call
+        whose destination matches `match` — everything before proceeds
+        normally, exactly like a process killed mid-protocol."""
+        from zhpe_ompi_tpu.runtime import checkpoint as ck_mod
+
+        real = os.replace
+        seen = {"n": 0}
+
+        def dying_replace(src, dst):
+            if match(src, dst):
+                if seen["n"] >= after:
+                    raise OSError("simulated writer kill")
+                seen["n"] += 1
+            return real(src, dst)
+
+        monkeypatch.setattr(ck_mod.os, "replace", dying_replace)
+        return lambda: monkeypatch.setattr(ck_mod.os, "replace", real)
+
+    def test_killed_between_tmp_and_rename(self, tmp_path, monkeypatch):
+        """Kill between .tmp creation and the atomic publish: the .tmp
+        holds a fully-written state, but it was never renamed — restore
+        must return the previous step and a rerun must heal the partial."""
+        ck = Checkpointer(str(tmp_path), check_quiescent=False)
+        ck.save(1, {"x": np.zeros(4)}, blocking=True)
+
+        unarm = self._kill_replace_on(
+            monkeypatch, lambda src, dst: src.endswith(".tmp"))
+        with pytest.raises(errors.InternalError, match="checkpoint write"):
+            ck.save(2, {"x": np.ones(4)}, blocking=True)
+        unarm()
+
+        assert os.path.isdir(str(tmp_path / "step_2.tmp"))  # the corpse
+        assert ck.all_steps() == [1]
+        got, step = ck.restore()
+        assert step == 1
+        np.testing.assert_array_equal(np.asarray(got["x"]), np.zeros(4))
+
+        # the step's next writer clears the partial and publishes
+        ck.save(2, {"x": np.ones(4)}, blocking=True)
+        got, step = ck.restore()
+        assert step == 2
+        np.testing.assert_array_equal(np.asarray(got["x"]), np.ones(4))
+        assert not os.path.exists(str(tmp_path / "step_2.tmp"))
+
+    def test_killed_mid_old_swap(self, tmp_path, monkeypatch):
+        """Kill between retiring step_N → step_N.old and republishing
+        the new version: the retired version IS the newest complete
+        checkpoint — restore (and a fresh Checkpointer) must heal it
+        back, not report the step missing or hand out the .tmp."""
+        ck = Checkpointer(str(tmp_path), check_quiescent=False)
+        ck.save(3, {"x": np.full(4, 7.0)}, blocking=True)
+
+        # dies on the SECOND rename of the republish (tmp → final);
+        # the first (final → .old) has already happened
+        unarm = self._kill_replace_on(
+            monkeypatch, lambda src, dst: True, after=1)
+        with pytest.raises(errors.InternalError, match="checkpoint write"):
+            ck.save(3, {"x": np.full(4, 9.0)}, blocking=True)
+        unarm()
+
+        assert os.path.isdir(str(tmp_path / "step_3.old"))
+        assert not os.path.isdir(str(tmp_path / "step_3"))
+
+        got, step = ck.restore()  # heals: .old swapped back into place
+        assert step == 3
+        np.testing.assert_array_equal(np.asarray(got["x"]), np.full(4, 7.0))
+        assert os.path.isdir(str(tmp_path / "step_3"))
+        assert not os.path.exists(str(tmp_path / "step_3.old"))
+
+    def test_killed_after_publish_before_old_cleanup(self, tmp_path,
+                                                     monkeypatch):
+        """Kill AFTER the republish landed but before the retired .old
+        was removed: the new version is complete — restore must return
+        it and drop the stale copy, never resurrect it."""
+        import shutil as _sh
+
+        from zhpe_ompi_tpu.runtime import checkpoint as ck_mod
+
+        ck = Checkpointer(str(tmp_path), check_quiescent=False)
+        ck.save(4, {"x": np.zeros(2)}, blocking=True)
+
+        real_rmtree = _sh.rmtree
+
+        def dying_rmtree(path, *a, **kw):
+            if str(path).endswith(".old"):
+                raise OSError("simulated writer kill")
+            return real_rmtree(path, *a, **kw)
+
+        monkeypatch.setattr(ck_mod.shutil, "rmtree", dying_rmtree)
+        with pytest.raises(errors.InternalError, match="checkpoint write"):
+            ck.save(4, {"x": np.ones(2)}, blocking=True)
+        monkeypatch.setattr(ck_mod.shutil, "rmtree", real_rmtree)
+
+        assert os.path.isdir(str(tmp_path / "step_4.old"))
+        got, step = ck.restore()
+        assert step == 4
+        np.testing.assert_array_equal(np.asarray(got["x"]), np.ones(2))
+        assert not os.path.exists(str(tmp_path / "step_4.old"))
+
+    def test_failed_async_save_does_not_poison_restore(self, tmp_path,
+                                                       monkeypatch):
+        """An ASYNC writer that failed (disk full, injected kill) left
+        only partials; a later rollback's restore() must return the
+        previous complete step — the writer's error stays pending for
+        the next save()/wait() to report, not the rollback's."""
+        from zhpe_ompi_tpu.runtime import checkpoint as ck_mod
+
+        ck = Checkpointer(str(tmp_path), check_quiescent=False)
+        ck.save(1, {"x": np.zeros(4)}, blocking=True)
+
+        real = os.replace
+        monkeypatch.setattr(
+            ck_mod.os, "replace",
+            lambda s, d: (_ for _ in ()).throw(OSError("simulated kill")))
+        ck.save(2, {"x": np.ones(4)})  # async: error parks in ck._error
+        got, step = ck.restore()  # joins the writer, does NOT re-raise
+        monkeypatch.setattr(ck_mod.os, "replace", real)
+        assert step == 1
+        np.testing.assert_array_equal(np.asarray(got["x"]), np.zeros(4))
+        with pytest.raises(errors.InternalError, match="checkpoint write"):
+            ck.wait()  # the failure is still reported, just not by restore
+
+    def test_fresh_checkpointer_heals_at_construction(self, tmp_path):
+        """The recovery pipeline's replacement rank opens the directory
+        anew: a fresh Checkpointer over a mid-swap corpse must see the
+        healed step immediately (all_steps, latest_step, restore)."""
+        ck = Checkpointer(str(tmp_path), check_quiescent=False)
+        ck.save(5, {"x": np.arange(3.0)}, blocking=True)
+        # hand-build the killed-mid-swap state: retired, never republished
+        os.replace(str(tmp_path / "step_5"), str(tmp_path / "step_5.old"))
+
+        ck2 = Checkpointer(str(tmp_path), check_quiescent=False)
+        assert ck2.all_steps() == [5]
+        got, step = ck2.restore()
+        assert step == 5
+        np.testing.assert_array_equal(np.asarray(got["x"]), np.arange(3.0))
+        assert not os.path.exists(str(tmp_path / "step_5.old"))
+
+
 class TestQuiesce:
     def test_quiescent_passes(self):
         quiesce_check()

@@ -46,15 +46,28 @@ class SchedRequest(Request):
     resumed with the list of their payloads once all complete.  Its return
     value becomes this request's value.  Progress is weak (driven from
     wait/test), like every request in this framework.
+
+    Revoke-aware (ULFM): on an ft-enabled endpoint, every progress tick
+    and every round boundary checks whether the collective channel has
+    been revoked — a rank parked inside a multi-round schedule (its
+    partner died and will never send) aborts with typed ``Revoked`` as
+    soon as the revocation lands, instead of discovering it only at its
+    next pt2pt op (which, parked mid-wait, would be never).  The
+    recovering rank triggers this by revoking the collective cid
+    (``ep.revoke(coll.host.COLL_CID)``), the MPIX_Comm_revoke idiom.
     """
 
-    __slots__ = ("_gen", "_round", "_endpoint_progress")
+    __slots__ = ("_gen", "_round", "_endpoint_progress", "_ft_state",
+                 "_coll_cid")
 
-    def __init__(self, gen: Generator, endpoint_progress=None):
+    def __init__(self, gen: Generator, endpoint_progress=None,
+                 ft_state=None, coll_cid: int = H.COLL_CID):
         super().__init__(progress=self._advance)
         self._gen = gen
         self._round: list[Request] = []
         self._endpoint_progress = endpoint_progress
+        self._ft_state = ft_state
+        self._coll_cid = coll_cid
         self._kick()
 
     def _kick(self) -> None:
@@ -64,14 +77,24 @@ class SchedRequest(Request):
         except StopIteration as stop:
             self.complete(stop.value)
 
+    def _check_revoked(self) -> None:
+        if self._ft_state is not None \
+                and self._ft_state.is_revoked(self._coll_cid):
+            raise errors.Revoked(
+                f"collective schedule aborted: cid={self._coll_cid} "
+                f"revoked mid-schedule", cid=self._coll_cid,
+            )
+
     def _advance(self) -> None:
         """NBC_PROGRESS: if the current round is fully complete, feed the
         results back and post the next round(s)."""
         if self.done:
             return
+        self._check_revoked()
         if self._endpoint_progress is not None:
             self._endpoint_progress()
         while not self.done and all(r.done for r in self._round):
+            self._check_revoked()  # round boundary
             values = [r._value for r in self._round]
             try:
                 self._round = list(self._gen.send(values))
@@ -80,7 +103,11 @@ class SchedRequest(Request):
 
 
 def _start(ctx, gen) -> SchedRequest:
-    return SchedRequest(gen, endpoint_progress=getattr(ctx, "progress", None))
+    return SchedRequest(
+        gen,
+        endpoint_progress=getattr(ctx, "progress", None),
+        ft_state=getattr(ctx, "ft_state", None),
+    )
 
 
 # ---------------------------------------------------------------- ibarrier

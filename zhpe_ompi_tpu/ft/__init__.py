@@ -36,9 +36,15 @@ _LAZY = {
     "ShrunkEndpoint": ("ulfm", "ShrunkEndpoint"),
     "RankKilled": ("ulfm", "RankKilled"),
     "agree": ("ulfm", "agree"),
+    "agree_failed_set": ("ulfm", "agree_failed_set"),
     "FaultPlan": ("inject", "FaultPlan"),
     "InjectedContext": ("inject", "InjectedContext"),
     "replay_rejoin": ("inject", "replay_rejoin"),
+    "RespawnHandle": ("recovery", "RespawnHandle"),
+    "respawn_rank": ("recovery", "respawn_rank"),
+    "spawn_replacement": ("recovery", "spawn_replacement"),
+    "await_rejoin": ("recovery", "await_rejoin"),
+    "rollback": ("recovery", "rollback"),
 }
 
 __all__ = sorted(_LAZY)

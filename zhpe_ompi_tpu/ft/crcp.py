@@ -83,9 +83,19 @@ class BookmarkCoordinator:
             return self._sent.copy(), self._recvd.copy()
 
     def in_flight(self) -> np.ndarray:
-        """Per-channel outstanding message counts (sent − received)."""
+        """Per-channel outstanding message counts (sent − received).
+        FT-aware: channels touching a failed rank are exempt (zeroed) —
+        a dead endpoint can never drain them, and the rollback owns
+        whatever was in flight there."""
         sent, recvd = self.bookmarks()
-        return sent - recvd
+        fl = sent - recvd
+        state = getattr(self._uni, "ft_state", None)
+        if state is not None:
+            dead = sorted(state.failed())
+            if dead:
+                fl[dead, :] = 0
+                fl[:, dead] = 0
+        return fl
 
     def quiescent(self) -> bool:
         """True when every channel is drained — the bkmrk go/no-go
@@ -136,19 +146,54 @@ class DistributedBookmarks:
 
     def exchange(self) -> tuple[np.ndarray, np.ndarray]:
         """Collective: gather every rank's rows into the full (sent,
-        received) matrices — entry [i, j] counts i→j messages."""
+        received) matrices — entry [i, j] counts i→j messages.
+
+        FT-aware: with failed peers the full-membership allgather would
+        wedge on the corpse, so on an ft endpoint the rows ALWAYS travel
+        over a consensus-shrunk survivor endpoint (every survivor calls
+        exchange collectively at checkpoint time, so the internal shrink
+        is collective too).  Always: branching on LOCAL failure
+        knowledge would let a survivor that has not yet seen an
+        in-flight notice post the full-membership allgather while its
+        peers run the consensus — divergent collective paths that
+        deadlock.  The consensus round is the price of uniformity; with
+        no failures it degenerates to a full-membership agreement and
+        the "shrunk" endpoint IS the full job.  The dead ranks' rows
+        stay zero; :meth:`in_flight` exempts their channels entirely —
+        acked-failed peers' rows are the rollback's business, not
+        quiescence's."""
         with self._lock:
             mine = (self.sent.tolist(), self.recvd.tolist())
-        rows = self._ctx.allgather(mine)
-        sent = np.array([r[0] for r in rows], dtype=np.int64)
-        recvd = np.array([r[1] for r in rows], dtype=np.int64)
+        n = self._ctx.size
+        state = getattr(self._ctx, "ft_state", None)
+        if state is None:
+            rows = self._ctx.allgather(mine)
+            sent = np.array([r[0] for r in rows], dtype=np.int64)
+            recvd = np.array([r[1] for r in rows], dtype=np.int64)
+            return sent, recvd
+        sh = self._ctx.shrink()
+        rows = sh.allgather(mine)
+        sent = np.zeros((n, n), dtype=np.int64)
+        recvd = np.zeros((n, n), dtype=np.int64)
+        for dense, row in enumerate(rows):
+            parent = sh.group.ranks[dense]
+            sent[parent] = row[0]
+            recvd[parent] = row[1]
         return sent, recvd
 
     def in_flight(self) -> np.ndarray:
         """Collective: per-channel outstanding counts (sent[i,j] −
-        recvd[j,i])."""
+        recvd[j,i]).  Channels touching a failed rank are exempt
+        (zeroed): no drain can ever clear them."""
         sent, recvd = self.exchange()
-        return sent - recvd.T
+        fl = sent - recvd.T
+        state = getattr(self._ctx, "ft_state", None)
+        if state is not None:
+            dead = sorted(state.failed())
+            if dead:
+                fl[dead, :] = 0
+                fl[:, dead] = 0
+        return fl
 
     def quiescent(self) -> bool:
         """Collective go/no-go: every channel drained on every rank."""

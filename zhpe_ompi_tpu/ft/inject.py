@@ -49,6 +49,7 @@ class FaultPlan:
         self.seed = seed
         self._rng = random.Random(seed)
         self._kills: dict[int, tuple[int, str]] = {}
+        self._respawns: set[int] = set()
 
     def kill_rank(self, rank: int, after_ops: int,
                   mode: str = "exit") -> "FaultPlan":
@@ -69,12 +70,30 @@ class FaultPlan:
         ops = self._rng.randint(1, max_ops)
         return self.kill_rank(rank, ops, mode)
 
+    def kill_then_respawn(self, rank: int, after_ops: int,
+                          mode: str = "exit") -> "FaultPlan":
+        """Schedule a kill AND mark the victim for respawn: the recovery
+        pipeline (:mod:`.recovery`) queries :attr:`respawn_victims` to
+        grow the job back to full size after shrink + rollback — the
+        kill-then-respawn plan of the checkpoint-integrated restart
+        test harness."""
+        self.kill_rank(rank, after_ops, mode)
+        self._respawns.add(int(rank))
+        return self
+
     def kill_for(self, rank: int) -> tuple[int, str] | None:
         return self._kills.get(rank)
+
+    def wants_respawn(self, rank: int) -> bool:
+        return int(rank) in self._respawns
 
     @property
     def victims(self) -> frozenset:
         return frozenset(self._kills)
+
+    @property
+    def respawn_victims(self) -> frozenset:
+        return frozenset(self._respawns)
 
     def arm(self, ep) -> "InjectedContext":
         """Wrap one rank's endpoint with op counting + the kill trigger."""

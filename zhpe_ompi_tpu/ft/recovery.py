@@ -1,0 +1,195 @@
+"""Checkpoint-integrated restart — the shrink → rollback → respawn
+recovery pipeline.
+
+PR 1's ULFM machinery (:mod:`.ulfm`) lets a job *survive* a failure:
+detect, revoke, shrink, agree.  But a shrunken job stays shrunken.  This
+module is the other half the reference's crs/crcp/vprotocol lineage
+(SURVEY.md §5) exists for: replacing the failed rank and rolling the job
+back to a consistent point, so the application finishes at FULL size.
+
+The pipeline (the MPI_Comm_spawn blocking-recovery idiom):
+
+1. **detect** — a crash surfaces as typed ``ProcFailed`` (transport
+   classification or the ring heartbeat detector).
+2. **agree on the failed set** — :func:`agree_failed_set` (re-exported
+   from :mod:`.ulfm`) unions every survivor's (rank, cause) knowledge
+   and their crash epochs, so a notice still in flight cannot leave
+   survivors holding divergent member maps.
+3. **shrink** — ``ep.shrink()`` (set consensus built in) yields the
+   dense survivor communicator in an agreed cid-generation window.
+4. **rollback** — survivors restore the last quiescent checkpoint
+   (:func:`rollback`; quiescence was proven by the crcp bookmarks /
+   :func:`~zhpe_ompi_tpu.runtime.checkpoint.quiesce_check`, both
+   ft-aware: acked-failed ranks' rows are exempt).
+5. **respawn** — grow back to full size: :func:`respawn_rank` puts a
+   replacement into the dead rank's old universe slot (thread plane), or
+   a ``TcpProc(rejoin_book=...)`` re-modexes the survivors over JOIN
+   control frames (wire plane) — fresh endpoint, fresh beat window,
+   survivors' collective/agreement counters adopted so post-recovery
+   full-size collectives tag identically.
+6. **restore** — the replacement loads its state from the snapshot
+   (``Checkpointer.restore``, shardings supported) instead of replaying
+   pessimistic logs — the checkpoint-integrated restart the ROADMAP
+   called out.
+
+Hygiene is observable exactly like the detector's: every respawned-rank
+thread registers here (:func:`live_respawn_threads` must be empty after
+fixtures clean up) and every checkpoint directory a rollback touched is
+scanned for orphaned ``.tmp``/``.old`` partials
+(:func:`orphaned_checkpoint_partials`) — the session gate in
+``tests/conftest.py`` asserts both.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Callable
+
+from ..core import errors
+from . import ulfm
+from .ulfm import agree_failed_set  # noqa: F401  (pipeline step 2)
+
+_lock = threading.Lock()
+_RESPAWN_THREADS: list[threading.Thread] = []
+_RECOVERY_DIRS: set[str] = set()
+
+
+# -- hygiene registries (consumed by the conftest session gate) ---------
+
+
+def _register_thread(t: threading.Thread) -> None:
+    with _lock:
+        _RESPAWN_THREADS[:] = [x for x in _RESPAWN_THREADS if x.is_alive()]
+        _RESPAWN_THREADS.append(t)
+
+
+def live_respawn_threads() -> list[threading.Thread]:
+    """Respawned-rank threads still running — must be [] once recovery
+    tests have joined their handles (no replacement may leak)."""
+    with _lock:
+        _RESPAWN_THREADS[:] = [x for x in _RESPAWN_THREADS if x.is_alive()]
+        return list(_RESPAWN_THREADS)
+
+
+def register_recovery_dir(path: str) -> None:
+    """Track a checkpoint directory the recovery pipeline rolled back
+    from, so the session gate can assert no ``.tmp``/``.old`` partials
+    were orphaned by the recovery tests."""
+    with _lock:
+        _RECOVERY_DIRS.add(os.path.abspath(path))
+
+
+def orphaned_checkpoint_partials() -> list[str]:
+    """Leftover ``.tmp``/``.old`` entries in every checkpoint directory a
+    rollback touched.  A healthy pipeline leaves none: ``restore`` heals
+    interrupted republishes and writers clean their own partials."""
+    out = []
+    with _lock:
+        dirs = list(_RECOVERY_DIRS)
+    for d in dirs:
+        if not os.path.isdir(d):
+            continue
+        for name in sorted(os.listdir(d)):
+            if name.endswith((".tmp", ".old")):
+                out.append(os.path.join(d, name))
+    return out
+
+
+# -- pipeline steps ------------------------------------------------------
+
+
+def rollback(checkpointer, step: int | None = None, shardings=None):
+    """Step 4/6: restore the last (or a named) quiescent checkpoint —
+    used identically by survivors rolling back and by the replacement
+    restoring its state from the snapshot instead of replaying logs.
+    Registers the directory with the hygiene gate."""
+    register_recovery_dir(checkpointer.directory)
+    return checkpointer.restore(step, shardings)
+
+
+def await_rejoin(ep, rank: int, timeout: float = 30.0) -> bool:
+    """Survivor side of step 5: block until `rank`'s failure record is
+    cleared — i.e. the replacement took the slot (thread plane) or its
+    JOIN re-modex reached this endpoint (wire plane)."""
+    state = getattr(ep, "ft_state", None)
+    if state is None:
+        state = ep  # a bare FailureState is accepted too
+    return state.wait_restored(rank, timeout)
+
+
+class RespawnHandle:
+    """A replacement rank's second life: the thread it runs on plus its
+    eventual result.  ``result()`` joins and re-raises the replacement's
+    failure — a respawn that dies again must not vanish silently."""
+
+    def __init__(self, rank: int | None, context, thread: threading.Thread):
+        self.rank = rank
+        self.context = context
+        self._thread = thread
+        self._result: Any = None
+        self._exc: BaseException | None = None
+
+    def result(self, timeout: float = 60.0):
+        self._thread.join(timeout)
+        if self._thread.is_alive():
+            raise errors.InternalError(
+                f"respawned rank {self.rank} did not finish in {timeout}s"
+            )
+        if self._exc is not None:
+            raise self._exc
+        return self._result
+
+
+def spawn_replacement(fn: Callable[[], Any], rank: int | None = None,
+                      context=None, name: str | None = None
+                      ) -> RespawnHandle:
+    """Run a replacement rank's program on a tracked daemon thread (the
+    wire-plane entry: the caller's `fn` constructs the rejoining
+    ``TcpProc(rejoin_book=...)`` itself and owns its close)."""
+    handle = RespawnHandle(rank, context, None)
+
+    def runner():
+        try:
+            handle._result = fn()
+        except BaseException as e:  # noqa: BLE001 - re-raised by result()
+            handle._exc = e
+
+    t = threading.Thread(
+        target=runner, daemon=True,
+        name=name or f"respawn-{rank if rank is not None else 'rank'}",
+    )
+    handle._thread = t
+    _register_thread(t)
+    t.start()
+    return handle
+
+
+def respawn_rank(uni, rank: int, fn: Callable[[Any], Any],
+                 name: str | None = None) -> RespawnHandle:
+    """Step 5 on the thread plane: put a FRESH context into the dead
+    rank's universe slot (``LocalUniverse.respawn_rank`` — new mailbox
+    and matching engine, survivors' collective/agreement counters
+    adopted, failure record cleared last) and launch ``fn(ctx)`` as the
+    replacement's program.  Mirrors ``LocalUniverse.run``'s bookkeeping:
+    a replacement that dies again is marked failed; a clean finish is
+    not a process failure."""
+    ctx = uni.respawn_rank(rank)
+
+    def second_life():
+        try:
+            return fn(ctx)
+        except ulfm.RankKilled as e:
+            if uni.ft_board is not None:
+                uni.ft_board.kill(rank)
+            if e.mode != "mute":
+                uni.ft_state.mark_failed(rank, cause="killed")
+            raise
+        except BaseException:
+            if uni.ft_board is not None:
+                uni.ft_board.kill(rank)
+            uni.ft_state.mark_failed(rank, cause="crash")
+            raise
+
+    return spawn_replacement(second_life, rank=rank, context=ctx,
+                             name=name or f"respawn-uni-{rank}")

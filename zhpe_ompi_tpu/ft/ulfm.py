@@ -70,6 +70,7 @@ FT_REVOKE_CID = 0x7FF4  # revoke floods
 FT_AGREE_CID = 0x7FF3   # agreement rounds
 FT_AGREE_PUB_CID = 0x7FF2  # completed-agreement result announcements
 FT_BYE_CID = 0x7FF1     # orderly-departure goodbyes (close(), not death)
+FT_JOIN_CID = 0x7FF0    # rejoin/re-modex frames (respawned-rank JOIN + ACK)
 _AGREE_TAG = 0x7D00
 
 # Shrunken communicators get a generation-isolated cid window so
@@ -187,7 +188,12 @@ class FailureState:
         self._cause: dict[int, str] = {}
         self._revoked: set[int] = set()
         self._shrink_groups: dict[int, frozenset[int]] = {}
-        self._agreements: dict[int, bool] = {}
+        self._agreements: dict[int, Any] = {}
+        # cumulative crash counter: bumps on every NEWLY-learned crash
+        # and never decrements — restore() (a rejoin) must not let a
+        # later shrink reuse an earlier generation's cid window for a
+        # DIFFERENT survivor set
+        self._crash_epoch = 0
         self._cv = threading.Condition()
 
     # -- failures --------------------------------------------------------
@@ -201,6 +207,7 @@ class FailureState:
                 return False
             self._failed.add(rank)
             self._cause[rank] = cause
+            self._crash_epoch += 1
             self._cv.notify_all()
         if cause == "detector":
             with _global_lock:
@@ -226,13 +233,36 @@ class FailureState:
         return self._cause.get(rank)
 
     def crash_count(self) -> int:
-        """Failures that are CRASHES, excluding orderly goodbyes.  The
-        shrink generation derives from this count: a BYE flood still in
-        flight (finalize skew) must not put survivors holding identical
-        crash knowledge into different cid windows."""
+        """CURRENTLY-failed crashes, excluding orderly goodbyes.  The
+        non-consensus shrink generation derives from this count: a BYE
+        flood still in flight (finalize skew) must not put survivors
+        holding identical crash knowledge into different cid windows."""
         with self._cv:
             return sum(1 for r in self._failed
                        if self._cause.get(r) != "goodbye")
+
+    def crash_epoch(self) -> int:
+        """CUMULATIVE crash counter (never decremented by restore): the
+        consensus shrink derives its generation from the agreed MAX of
+        these, so a post-rejoin crash can never land a new survivor set
+        in a cid window an earlier shrink already used."""
+        with self._cv:
+            return self._crash_epoch
+
+    def raise_epoch(self, epoch: int) -> None:
+        """Adopt an agreed (or JOIN-ack'd) crash-epoch floor — a
+        respawned rank's fresh state must count forward from the
+        survivors' epoch, not from zero."""
+        with self._cv:
+            self._crash_epoch = max(self._crash_epoch, int(epoch))
+
+    def failed_with_causes(self) -> list[tuple[int, str]]:
+        """Snapshot of (rank, cause) pairs — the contribution this rank
+        feeds into the failed-set agreement."""
+        with self._cv:
+            return sorted(
+                (r, self._cause.get(r, "unknown")) for r in self._failed
+            )
 
     def live(self) -> list[int]:
         with self._cv:
@@ -243,6 +273,18 @@ class FailureState:
         deadline = None if timeout is None else time.monotonic() + timeout
         with self._cv:
             while rank not in self._failed:
+                left = None if deadline is None else deadline - time.monotonic()
+                if left is not None and left <= 0:
+                    return False
+                self._cv.wait(0.05 if left is None else min(left, 0.05))
+            return True
+
+    def wait_restored(self, rank: int, timeout: float | None = None) -> bool:
+        """Block until `rank` is no longer failed — the survivors' wait
+        for a respawned replacement to rejoin (restore() notifies)."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cv:
+            while rank in self._failed:
                 left = None if deadline is None else deadline - time.monotonic()
                 if left is not None and left <= 0:
                     return False
@@ -292,6 +334,7 @@ class FailureState:
             self._failed.discard(rank)
             self._acked.discard(rank)
             self._cause.pop(rank, None)
+            self._cv.notify_all()  # wait_restored watchers
 
     # -- shrink membership ----------------------------------------------
 
@@ -310,14 +353,16 @@ class FailureState:
 
     # -- agreed results --------------------------------------------------
 
-    def record_agreement(self, seq: int, result: bool) -> None:
+    def record_agreement(self, seq: int, result: Any) -> None:
         """Publish a completed agreement's value: survivors that lose
         their coordinator mid-delivery converge on THIS result instead
-        of re-running a round nobody can finish (see :func:`agree`)."""
+        of re-running a round nobody can finish (see :func:`agree`).
+        Values are arbitrary (bool for the flag AND-reduction, a
+        [pairs, epoch] list for the failed-set agreement)."""
         with self._cv:
-            self._agreements[int(seq)] = bool(result)
+            self._agreements[int(seq)] = result
 
-    def agreement(self, seq: int) -> bool | None:
+    def agreement(self, seq: int) -> Any | None:
         return self._agreements.get(seq)
 
     # -- revocation ------------------------------------------------------
@@ -329,6 +374,15 @@ class FailureState:
 
     def is_revoked(self, cid: int) -> bool:
         return cid in self._revoked
+
+    def revoked_cids(self) -> frozenset:
+        """Snapshot of the endpoint-plane revoked cids — the checkpoint
+        quiescence view exempts their queue rows: a revoked channel
+        never delivers again (recv on it raises ``Revoked``), so an
+        aborted schedule's parked receives must not wedge
+        ``quiesce_check`` for the rest of the job's life."""
+        with self._cv:
+            return frozenset(self._revoked)
 
     def check_revoked(self, cid: int) -> None:
         if cid in self._revoked:
@@ -570,7 +624,7 @@ class _AgreeDone(Exception):
     """Internal: the agreement completed through the published-result
     channel while this rank was still mid-protocol."""
 
-    def __init__(self, result: bool):
+    def __init__(self, result: Any):
         super().__init__(result)
         self.result = result
 
@@ -612,7 +666,7 @@ def _await_frame(ep, state: FailureState, seq: int, source: int,
         time.sleep(0.002)
 
 
-def _publish(ep, state: FailureState, seq: int, result: bool) -> None:
+def _publish(ep, state: FailureState, seq: int, result: Any) -> None:
     """Make a completed agreement's value recoverable: record it in the
     failure state's registry (shared by every thread rank of a universe)
     and, on wire endpoints, announce it into the live peers' registries.
@@ -625,15 +679,17 @@ def _publish(ep, state: FailureState, seq: int, result: bool) -> None:
         announce(seq, result)
 
 
-def agree(ep, flag: bool = True, timeout: float | None = None) -> bool:
-    """Fault-tolerant AND-reduction of `flag` over the live ranks of an
-    endpoint.  The lowest live rank coordinates; contributors that die
-    mid-round are excluded; a dead coordinator triggers re-election and
-    a retry.  A coordinator that dies after delivering its result to
-    only SOME survivors cannot split the outcome: the delivered ranks
-    publish the value and everyone still mid-protocol adopts it.
-    Completes despite participant death — the MPIX_Comm_agree
-    contract."""
+def _agree_value(ep, value: Any, combine: Callable[[Any, Any], Any],
+                 timeout: float | None = None) -> Any:
+    """The fault-tolerant agreement protocol over an arbitrary
+    contribution type: the lowest live rank coordinates, folding every
+    live contribution through `combine`; contributors that die mid-round
+    are excluded; a dead coordinator triggers re-election and a retry.
+    A coordinator that dies after delivering its result to only SOME
+    survivors cannot split the outcome: the delivered ranks publish the
+    value and everyone still mid-protocol adopts it.  Values must be
+    DSS-packable (bools, ints, nested lists) so the same protocol runs
+    over thread and socket endpoints."""
     state = _require_ft(ep)
     if timeout is None:
         timeout = float(mca_var.get("ft_agree_timeout", 30.0))
@@ -651,7 +707,7 @@ def agree(ep, flag: bool = True, timeout: float | None = None) -> bool:
         coord = live[0]
         try:
             if ep.rank == coord:
-                acc = bool(flag)
+                acc = value
                 for r in live:
                     if r == ep.rank:
                         continue
@@ -662,7 +718,7 @@ def agree(ep, flag: bool = True, timeout: float | None = None) -> bool:
                         continue  # died mid-agreement: excluded
                     if (isinstance(contrib, (list, tuple))
                             and len(contrib) == 2 and contrib[0] == seq):
-                        acc = acc and bool(contrib[1])
+                        acc = combine(acc, contrib[1])
                 # a survivor may have completed this instance through a
                 # PREVIOUS coordinator's partial delivery: that value is
                 # the agreement (uniformity), ours is discarded
@@ -685,7 +741,7 @@ def agree(ep, flag: bool = True, timeout: float | None = None) -> bool:
             # must surface as typed ProcFailed for the re-election path
             # below, never as the user disposition (FATAL would abort the
             # survivor — breaking the completes-despite-death contract)
-            ep.send((seq, bool(flag)), coord, tag=gather_tag,
+            ep.send((seq, value), coord, tag=gather_tag,
                     cid=FT_AGREE_CID, poll=True)
             res = _await_frame(ep, state, seq, coord, result_tag, timeout)
             if not (isinstance(res, (list, tuple)) and len(res) == 2
@@ -693,7 +749,7 @@ def agree(ep, flag: bool = True, timeout: float | None = None) -> bool:
                 raise errors.InternalError(
                     f"agreement {seq}: mismatched result frame {res!r}"
                 )
-            acc = bool(res[1])
+            acc = res[1]
             _publish(ep, state, seq, acc)
             return acc
         except _AgreeDone as d:
@@ -707,6 +763,48 @@ def agree(ep, flag: bool = True, timeout: float | None = None) -> bool:
             round_no += 1
             if round_no > ep.size:
                 raise
+
+
+def _combine_and(a: Any, b: Any) -> bool:
+    return bool(a) and bool(b)
+
+
+def agree(ep, flag: bool = True, timeout: float | None = None) -> bool:
+    """Fault-tolerant AND-reduction of `flag` over the live ranks of an
+    endpoint — the MPIX_Comm_agree contract (completes despite
+    participant death; uniform result under partial delivery)."""
+    return bool(_agree_value(ep, bool(flag), _combine_and, timeout))
+
+
+def _combine_failed_sets(a: Any, b: Any) -> list:
+    """Union of two [pairs, epoch] failed-set contributions: merge the
+    (rank, cause) pairs (first cause seen wins — causes only disagree on
+    which transport noticed first) and take the max crash epoch."""
+    merged = {int(r): str(c) for r, c in a[0]}
+    for r, c in b[0]:
+        merged.setdefault(int(r), str(c))
+    return [sorted([r, c] for r, c in merged.items()),
+            max(int(a[1]), int(b[1]))]
+
+
+def agree_failed_set(ep, timeout: float | None = None
+                     ) -> tuple[dict[int, str], int]:
+    """Internal agreement on the failed SET (not just a flag): every
+    survivor contributes its locally-known (rank, cause) pairs plus its
+    cumulative crash epoch; the agreed value is the union and the max.
+    This is the uniform-knowledge step real ULFM runs inside shrink — a
+    BYE flood or failure notice still in flight cannot leave survivors
+    holding divergent member maps, because the union is what everyone
+    adopts.  Returns ``(failed, generation)``: a rank→cause dict and the
+    agreed shrink generation."""
+    state = _require_ft(ep)
+    contribution = [
+        [[int(r), str(c)] for r, c in state.failed_with_causes()],
+        state.crash_epoch(),
+    ]
+    pairs, epoch = _agree_value(ep, contribution, _combine_failed_sets,
+                                timeout)
+    return {int(r): str(c) for r, c in pairs}, int(epoch)
 
 
 # -- survivor communicator (MPIX_Comm_shrink) ---------------------------
@@ -822,18 +920,38 @@ class UlfmEndpointAPI:
         """MPIX_Comm_agree: fault-tolerant flag AND-reduction."""
         return agree(self, flag, timeout)
 
-    def shrink(self) -> ShrunkEndpoint:
+    def shrink(self, consensus: bool = True) -> ShrunkEndpoint:
         """MPIX_Comm_shrink: a survivor endpoint with dense new ranks.
-        Collective over the survivors: every caller must hold the same
-        failure knowledge (run ``agree`` first when in doubt) — the
-        shrink generation, and with it the isolated cid window, is
-        derived from the CRASH count (orderly departures excluded, so
-        finalize skew cannot split the window; survivor-set consensus
-        under concurrent departure remains the caller's agree round)."""
+        Collective over the survivors.  By default an INTERNAL agreement
+        on the failed set runs first (:func:`agree_failed_set`, the same
+        seq/announce machinery as ``agree``), exactly as real ULFM does
+        inside shrink: survivors holding divergent failure knowledge — a
+        BYE flood or failure notice still in flight concurrent with a
+        crash — converge on one member map and one agreed generation, so
+        no two survivors can land in different cid windows.  The merged
+        failures are adopted locally (detector-cause entries merge as
+        second-hand "notice" so the false-positive gate keeps its
+        meaning; goodbyes merge pre-acknowledged).
+
+        ``consensus=False`` restores the caller-holds-uniform-knowledge
+        contract (one fewer protocol round): the generation then derives
+        from the local CRASH count (orderly departures excluded, so
+        finalize skew cannot split the window)."""
         state = _require_ft(self)
-        survivors = state.live()
-        return ShrunkEndpoint(self, survivors,
-                              generation=state.crash_count())
+        if not consensus:
+            return ShrunkEndpoint(self, state.live(),
+                                  generation=state.crash_count())
+        failed, generation = agree_failed_set(self)
+        for r, cause in failed.items():
+            if cause == "goodbye":
+                state.mark_departed(r)
+            else:
+                state.mark_failed(
+                    r, cause="notice" if cause == "detector" else cause
+                )
+        state.raise_epoch(generation)
+        survivors = [r for r in range(self.size) if r not in failed]
+        return ShrunkEndpoint(self, survivors, generation=generation)
 
     def revoke(self, cid: int) -> None:
         """MPIX_Comm_revoke for an endpoint-plane cid: every pending and

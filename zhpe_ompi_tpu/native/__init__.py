@@ -88,6 +88,10 @@ def _declare(dll: ctypes.CDLL) -> None:
     dll.zompi_match_extract.restype = ctypes.c_int
     dll.zompi_match_stats.argtypes = [vp, i64p, i64p]
     dll.zompi_match_stats.restype = None
+    dll.zompi_match_stats_excluding.argtypes = [
+        vp, i64p, i64, i64p, i64, i64p, i64p,
+    ]
+    dll.zompi_match_stats_excluding.restype = None
     dll.zompi_shm_amo.argtypes = [
         vp, ctypes.c_int, ctypes.c_int, i64, i64,
         ctypes.c_double, ctypes.c_double, i64p, ctypes.POINTER(ctypes.c_double),

@@ -394,6 +394,37 @@ void zompi_match_stats(void* h, int64_t* n_posted, int64_t* n_unexpected) {
   *n_unexpected = static_cast<int64_t>(m->unexpected.size());
 }
 
+// Queue depths excluding entries attributable to the given sources or
+// communicator ids: posted receives NAMED on an excluded source
+// (abandoned after a typed process failure) or posted on an excluded
+// cid (a revoked channel never delivers again), and unexpected
+// messages FROM an excluded source or carried on an excluded cid.  The
+// checkpoint quiescence check uses this so acked-failed peers' and
+// revoked channels' rows — which no drain can ever clear — don't block
+// a recovery-time snapshot.  ANY_SOURCE (-1) posted receives are
+// unattributable by source and counted unless their cid is excluded.
+void zompi_match_stats_excluding(void* h, const int64_t* excl_srcs,
+                                 int64_t n_excl, const int64_t* excl_cids,
+                                 int64_t n_cids, int64_t* n_posted,
+                                 int64_t* n_unexpected) {
+  ZompiMatch* m = static_cast<ZompiMatch*>(h);
+  std::lock_guard<std::mutex> g(m->mu);
+  auto excluded = [&](int64_t src, int64_t cid) {
+    for (int64_t i = 0; i < n_excl; ++i)
+      if (excl_srcs[i] == src) return true;
+    for (int64_t i = 0; i < n_cids; ++i)
+      if (excl_cids[i] == cid) return true;
+    return false;
+  };
+  int64_t p = 0, u = 0;
+  for (const auto& r : m->posted)
+    if (!excluded(r.src, r.cid)) ++p;
+  for (const auto& e : m->unexpected)
+    if (!excluded(e.src, e.cid)) ++u;
+  *n_posted = p;
+  *n_unexpected = u;
+}
+
 // ---------------------------------------------------------------------------
 // Cross-process atomics on mapped symmetric segments.
 //

@@ -142,6 +142,31 @@ class MatchingEngine:
                 "unexpected": len(self._unexpected),
             }
 
+    def stats_excluding(self, srcs, cids=()) -> dict[str, int]:
+        """Queue depths NOT attributable to `srcs` or `cids`: posted
+        receives named on one of the sources (abandoned by
+        typed-failure delivery) or posted/parked on one of the cids
+        (a revoked channel never delivers again), and unexpected
+        messages sent from one of the sources or carried on one of the
+        cids.  The ft-aware quiescence view — a dead peer's or revoked
+        channel's rows can never drain, so a recovery-time checkpoint
+        must not wait on them.  ANY_SOURCE posted receives are
+        unattributable by source and counted unless their cid is
+        exempt."""
+        excl = {int(s) for s in srcs}
+        excl_cids = {int(c) for c in cids}
+        with self._lock:
+            return {
+                "posted": sum(
+                    1 for p in self._posted
+                    if p.src not in excl and p.cid not in excl_cids
+                ),
+                "unexpected": sum(
+                    1 for e, _ in self._unexpected
+                    if e.src not in excl and e.cid not in excl_cids
+                ),
+            }
+
 
 class NativeMatchingEngine:
     """Same contract as :class:`MatchingEngine`, with the queue walk in C++
@@ -254,6 +279,22 @@ class NativeMatchingEngine:
         p, u = ct.c_int64(), ct.c_int64()
         with self._lock:
             self._lib.zompi_match_stats(self._h, ct.byref(p), ct.byref(u))
+        return {"posted": p.value, "unexpected": u.value}
+
+    def stats_excluding(self, srcs, cids=()) -> dict[str, int]:
+        """Native twin of :meth:`MatchingEngine.stats_excluding` — the
+        queue walk happens in C against the same engine handle."""
+        ct = self._ctypes
+        excl = sorted(int(s) for s in srcs)
+        excl_cids = sorted(int(c) for c in cids)
+        arr = (ct.c_int64 * max(1, len(excl)))(*(excl or [0]))
+        carr = (ct.c_int64 * max(1, len(excl_cids)))(*(excl_cids or [0]))
+        p, u = ct.c_int64(), ct.c_int64()
+        with self._lock:
+            self._lib.zompi_match_stats_excluding(
+                self._h, arr, len(excl), carr, len(excl_cids),
+                ct.byref(p), ct.byref(u)
+            )
         return {"posted": p.value, "unexpected": u.value}
 
 

@@ -166,9 +166,14 @@ class TcpProc(errh.HasErrhandler, ulfm.UlfmEndpointAPI, HostCollectives,
                  host: str = "127.0.0.1", timeout: float = 30.0,
                  on_coordinator_bound=None,
                  external_coordinator: bool = False,
-                 ft: bool = False):
+                 ft: bool = False,
+                 rejoin_book: list | None = None):
         if size < 1:
             raise errors.ArgError("size must be >= 1")
+        if rejoin_book is not None and not ft:
+            raise errors.ArgError(
+                "rejoin_book (respawn into an existing job) requires ft=True"
+            )
         self.rank = rank
         self.size = size
         # ULFM state precedes the accept loop: drain threads consult it
@@ -191,6 +196,10 @@ class TcpProc(errh.HasErrhandler, ulfm.UlfmEndpointAPI, HostCollectives,
             weakref.WeakKeyDictionary()  # socket -> its framing lock
         self._closed = threading.Event()
         self._incoming_cv = threading.Condition()
+        # rejoin handshake state: survivor JOIN_ACKs carrying their
+        # collective/agreement counters + crash epoch (see _announce_join)
+        self._join_cv = threading.Condition()
+        self._join_acks: dict[int, tuple[int, int, int]] = {}
 
         # listening socket (btl_tcp's per-proc endpoint)
         self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
@@ -215,12 +224,24 @@ class TcpProc(errh.HasErrhandler, ulfm.UlfmEndpointAPI, HostCollectives,
         # PRRTE-hosts-the-PMIx-server shape) — rank 0 joins as a client
         # instead of binding the coordinator address itself
         self._external_coordinator = external_coordinator
-        self.address_book = self._modex(coordinator, timeout)
+        if rejoin_book is not None:
+            # respawned rank: no modex rendezvous exists anymore — adopt
+            # the survivors' address book with OUR fresh endpoint in the
+            # old slot; the JOIN announce below re-modexes the survivors
+            self.address_book = [tuple(a[:2]) for a in rejoin_book]
+            self.address_book[rank] = tuple(self.address)
+        else:
+            self.address_book = self._modex(coordinator, timeout)
         mca_output.verbose(
             5, _stream, "rank %d up at %s; book=%s", rank, self.address,
             self.address_book,
         )
         if ft:
+            if rejoin_book is not None:
+                # announce BEFORE the detector starts: beats toward a
+                # survivor that has not yet swapped in the fresh
+                # endpoint would ride (and warm) a stale address
+                self._announce_join(timeout)
             # ring heartbeat detector over framed beats: this rank emits
             # to its nearest live predecessor, observes its nearest live
             # successor, floods suspicion (the ULFM detector shape)
@@ -297,14 +318,18 @@ class TcpProc(errh.HasErrhandler, ulfm.UlfmEndpointAPI, HostCollectives,
         self._flood(ulfm.FT_NOTICE_CID, sorted(int(r) for r in failed),
                     "hb-flood")
 
-    def _agree_announce(self, seq: int, result: bool) -> None:
+    def _agree_announce(self, seq: int, result) -> None:
         """Flood a completed agreement's value into the live peers'
         result registries (the recovery channel of :func:`ulfm.agree`):
         a survivor the dead coordinator never reached adopts the value
         from its registry instead of waiting out a round nobody can
         finish — and a re-elected coordinator gathering from an
-        already-departed participant converges the same way."""
-        self._flood(ulfm.FT_AGREE_PUB_CID, [int(seq), bool(result)],
+        already-departed participant converges the same way.  The value
+        is carried verbatim (DSS-packable): a bool for the flag
+        AND-reduction, a [pairs, epoch] list for the failed-set
+        agreement — coercion here would hand adopters of a failed-set
+        result a bare flag they cannot unpack."""
+        self._flood(ulfm.FT_AGREE_PUB_CID, [int(seq), result],
                     "agree-pub")
 
     def _ft_ctrl(self, cid: int, src: int, payload: Any) -> None:
@@ -318,7 +343,9 @@ class TcpProc(errh.HasErrhandler, ulfm.UlfmEndpointAPI, HostCollectives,
             self.ft_state.revoke(int(payload))
         elif cid == ulfm.FT_AGREE_PUB_CID:
             seq, result = payload
-            self.ft_state.record_agreement(int(seq), bool(result))
+            # verbatim: agreement values are typed by their protocol
+            # (bool for agree(), [pairs, epoch] for agree_failed_set())
+            self.ft_state.record_agreement(int(seq), result)
         elif cid == ulfm.FT_BYE_CID:
             # relay newly-learned departures onward (gossip-once): the
             # departing rank goodbyes only its CONNECTED peers, so a
@@ -332,6 +359,91 @@ class TcpProc(errh.HasErrhandler, ulfm.UlfmEndpointAPI, HostCollectives,
                      if self.ft_state.mark_departed(int(r))]
             if fresh and not self._ft_dead and not self._closed.is_set():
                 self._flood(ulfm.FT_BYE_CID, fresh, "bye-gossip")
+
+    def _announce_join(self, timeout: float) -> None:
+        """Re-modex for a respawned rank (the JOIN half of the recovery
+        pipeline): dial every presumed-live survivor from the inherited
+        address book, announce the fresh endpoint, and adopt the
+        survivors' collective/agreement sequence counters and crash
+        epoch from their JOIN_ACKs — so the replacement's next full-size
+        collective tags identically to the survivors' and a post-rejoin
+        shrink can never reuse an earlier generation's cid window.  The
+        pipeline contract is that respawn happens at a survivor barrier
+        (post-rollback), so the ack'd counters are stable."""
+        frame = dss.pack(self.rank, 0, ulfm.FT_JOIN_CID, 0,
+                         ["join", self.rank, list(self.address)])
+        reached = 0
+        for r in range(self.size):
+            if r == self.rank:
+                continue
+            try:
+                sock = self._endpoint(r, deadline=min(2.0, timeout))
+                self._framed_send(sock, frame)
+                reached += 1
+            except (OSError, errors.MpiError):
+                continue  # a peer that is itself gone: its own recovery
+        if reached == 0:
+            raise errors.InternalError(
+                "rejoin: no survivor reachable for the JOIN re-modex"
+            )
+        deadline = time.monotonic() + timeout
+        with self._join_cv:
+            while not self._join_acks:
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    raise errors.InternalError(
+                        "rejoin: no JOIN_ACK from any survivor"
+                    )
+                self._join_cv.wait(min(left, 0.05))
+            acks = list(self._join_acks.values())
+        self._coll_seq = max(a[0] for a in acks)
+        self._agree_seq = max(a[1] for a in acks)
+        self.ft_state.raise_epoch(max(a[2] for a in acks))
+
+    def _ft_join(self, conn: socket.socket, src: int, payload: Any) -> None:
+        """JOIN/re-modex control family (runs on the drain thread, which
+        is the one place the carrying connection is in hand).  "join": a
+        respawned rank announces its fresh endpoint — swap it in as the
+        canonical connection (the pre-crash cached socket is a severed
+        corpse), update the address book, clear the failure record so
+        classification stops typing the rank dead, give the detector a
+        fresh beat window, and ack with our counters.  "ack": the
+        survivor's reply, collected by _announce_join."""
+        kind = payload[0]
+        if kind == "join":
+            jrank = int(payload[1])
+            addr = tuple(payload[2][:2])
+            with self._conn_lock:
+                stale = self._conns.get(jrank)
+                self._conns[jrank] = conn
+            if stale is not None and stale is not conn:
+                # the severed pre-crash socket: its drain already exited
+                # on the RST; EOF-then-close per the fd-reuse contract
+                try:
+                    stale.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
+                try:
+                    stale.close()
+                except OSError:
+                    pass
+            self.address_book[jrank] = addr
+            if self._detector is not None:
+                self._detector.transport.grace(jrank)
+            self.ft_state.restore(jrank)
+            ack = ["ack", self.rank, int(getattr(self, "_coll_seq", 0)),
+                   int(getattr(self, "_agree_seq", 0)),
+                   int(self.ft_state.crash_epoch())]
+            try:
+                self._framed_send(conn, dss.pack(
+                    self.rank, 0, ulfm.FT_JOIN_CID, 0, ack))
+            except OSError:
+                pass  # the joiner died again: its next respawn's business
+        elif kind == "ack":
+            with self._join_cv:
+                self._join_acks[int(payload[1])] = (
+                    int(payload[2]), int(payload[3]), int(payload[4]))
+                self._join_cv.notify_all()
 
     def revoke(self, cid: int) -> None:
         """MPIX_Comm_revoke on the wire: poison locally, flood the
@@ -513,6 +625,11 @@ class TcpProc(errh.HasErrhandler, ulfm.UlfmEndpointAPI, HostCollectives,
             if frame is None:
                 return
             [src, tag, cid, seq, payload] = dss.unpack(frame)
+            if self.ft_state is not None and cid == ulfm.FT_JOIN_CID:
+                # rejoin/re-modex: needs the carrying connection (the
+                # joiner's fresh socket becomes the canonical endpoint)
+                self._ft_join(conn, src, payload)
+                continue
             if self.ft_state is not None and cid in (
                 ulfm.FT_HB_CID, ulfm.FT_NOTICE_CID, ulfm.FT_REVOKE_CID,
                 ulfm.FT_AGREE_PUB_CID, ulfm.FT_BYE_CID,

@@ -458,12 +458,31 @@ class RankContext(errh.HasErrhandler, ulfm.UlfmEndpointAPI,
 _live_universes: weakref.WeakSet = weakref.WeakSet()
 
 
-def _queue_depth(key: str) -> int:
-    return sum(
-        c.engine.stats()[key]
-        for uni in list(_live_universes)
-        for c in uni.contexts
-    )
+def _queue_depth(key: str, exempt_acked_failed: bool = False) -> int:
+    """Aggregate queue depth across live universes.  With
+    ``exempt_acked_failed`` (the checkpoint quiescence view), rows
+    attributable to acknowledged-failed ranks are left out — the dead
+    rank's own queues (it can never drain them), posted receives NAMED
+    on it (abandoned by the typed-failure classification), and
+    unexpected messages FROM it (rolled back, not drained) — and so are
+    rows parked on REVOKED cids: a revoked channel never delivers again
+    (recv on it raises ``Revoked``), so a schedule aborted by
+    revocation must not wedge quiescence for the rest of the job's
+    life.  Otherwise a checkpoint during recovery could never be
+    declared quiescent."""
+    total = 0
+    for uni in list(_live_universes):
+        state = uni.ft_state if exempt_acked_failed else None
+        dead = state.acked() if state is not None else frozenset()
+        revoked = state.revoked_cids() if state is not None else frozenset()
+        for c in uni.contexts:
+            if c.rank in dead:
+                continue
+            if dead or revoked:
+                total += c.engine.stats_excluding(dead, revoked)[key]
+            else:
+                total += c.engine.stats()[key]
+    return total
 
 
 _pvars_registered = False
@@ -536,6 +555,42 @@ class LocalUniverse:
         for det in self.ft_detectors:
             det.stop()
         self.ft_detectors = []
+
+    # -- respawn (grow back to full size after a failure) ----------------
+
+    def respawn_rank(self, rank: int) -> RankContext:
+        """Replace a failed rank's universe slot with a FRESH context —
+        the MPI_Comm_spawn blocking-recovery idiom on the thread plane.
+        The fresh context gets a new mailbox and matching engine (no
+        stale pre-death frames can ever match its receives) and adopts a
+        survivor's collective/agreement sequence counters, so its next
+        collective on the full-size surface tags identically to the
+        survivors'.  The failure record is cleared LAST (after the slot
+        swap), so a survivor released by ``wait_restored`` can only ever
+        see the replacement context."""
+        if self.ft_state is None:
+            raise errors.UnsupportedError(
+                "respawn needs a universe built with ft=True"
+            )
+        if not 0 <= rank < self.size:
+            raise errors.RankError(f"rank {rank} out of range")
+        if not self.ft_state.is_failed(rank):
+            raise errors.ArgError(
+                f"rank {rank} is not failed; nothing to respawn"
+            )
+        fresh = RankContext(self, rank)
+        donor = next(
+            (self.contexts[r] for r in self.ft_state.live() if r != rank),
+            None,
+        )
+        if donor is not None:
+            fresh._coll_seq = getattr(donor, "_coll_seq", 0)
+            fresh._agree_seq = getattr(donor, "_agree_seq", 0)
+        self.contexts[rank] = fresh
+        if self.ft_board is not None:
+            self.ft_board.revive(rank)
+        self.ft_state.restore(rank)
+        return fresh
 
     def run(self, fn: Callable[[RankContext], Any], timeout: float = 60.0
             ) -> list[Any]:

@@ -48,11 +48,19 @@ _STEP_PREFIX = "step_"
 
 def quiesce_check() -> None:
     """Raise if host-plane pt2pt queues are non-empty (the checkable form
-    of crcp/bkmrk's 'drain in-flight messages first' protocol)."""
+    of crcp/bkmrk's 'drain in-flight messages first' protocol).
+
+    FT-aware: rows attributable to ACKED-failed ranks are exempt — a
+    dead rank's own queues, posted receives named on it (abandoned by
+    typed-failure delivery), and unexpected messages from it can never
+    drain, and the rollback owns them; without the exemption a
+    checkpoint could never be declared quiescent during recovery.  The
+    ack is the gate: an unacknowledged failure still blocks, exactly as
+    its pending wildcard receives do."""
     from ..pt2pt import universe as uni_mod
 
-    posted = uni_mod._queue_depth("posted")
-    unexpected = uni_mod._queue_depth("unexpected")
+    posted = uni_mod._queue_depth("posted", exempt_acked_failed=True)
+    unexpected = uni_mod._queue_depth("unexpected", exempt_acked_failed=True)
     if posted or unexpected:
         raise errors.InternalError(
             f"checkpoint at non-quiescent point: {posted} posted recvs, "
@@ -71,6 +79,40 @@ class Checkpointer:
         os.makedirs(directory, exist_ok=True)
         self._worker: threading.Thread | None = None
         self._error: BaseException | None = None
+        # one checkpointer is SHARED by every survivor thread of the
+        # recovery pipeline (each calls rollback() concurrently): the
+        # reentrant lock serializes save/wait/restore/heal so a pair of
+        # concurrent restores cannot double-join the worker or race the
+        # .old → final republish heal
+        self._op_lock = threading.RLock()
+        self._heal_interrupted()
+
+    def _heal_interrupted(self) -> None:
+        """Complete — backwards — any republish a crashed writer left
+        half done.  The re-checkpoint protocol retires the existing
+        version to ``step_N.old`` before publishing the new one; a
+        writer killed between those two renames leaves ``step_N.old``
+        with no ``step_N`` — the retired version IS the newest complete
+        checkpoint for that step, so put it back.  ``step_N.old`` WITH a
+        ``step_N`` means the publish landed and only the cleanup was
+        lost: drop the stale copy.  ``.tmp`` partials need no healing —
+        all_steps ignores them and the next writer of that step clears
+        them."""
+        with self._op_lock:
+            for name in os.listdir(self.directory):
+                if not (name.startswith(_STEP_PREFIX)
+                        and name.endswith(".old")):
+                    continue
+                old = os.path.join(self.directory, name)
+                final = old[:-len(".old")]
+                if os.path.isdir(final):
+                    shutil.rmtree(old, ignore_errors=True)
+                else:
+                    os.replace(old, final)
+                    mca_output.verbose(
+                        1, _stream,
+                        "healed interrupted republish: restored %s", final,
+                    )
 
     # -- save ------------------------------------------------------------
 
@@ -80,26 +122,28 @@ class Checkpointer:
         disk writes happen in the background unless `blocking`."""
         if self.check_quiescent:
             quiesce_check()
-        self.wait()  # one outstanding checkpoint at a time (orbax contract)
-        leaves, treedef = jax.tree_util.tree_flatten(state)
-        # snapshot to host before returning control (np.array COPIES even
-        # for host leaves — the caller may overwrite its buffers right away).
-        # Single-controller semantics: the controller materializes each full
-        # array; sharded RESTORE still places per-device extents directly.
-        host_leaves = [np.array(leaf) for leaf in leaves]
+        with self._op_lock:
+            self.wait()  # one outstanding checkpoint at a time (orbax)
+            leaves, treedef = jax.tree_util.tree_flatten(state)
+            # snapshot to host before returning control (np.array COPIES
+            # even for host leaves — the caller may overwrite its buffers
+            # right away).  Single-controller semantics: the controller
+            # materializes each full array; sharded RESTORE still places
+            # per-device extents directly.
+            host_leaves = [np.array(leaf) for leaf in leaves]
 
-        def write():
-            try:
-                self._write(step, host_leaves, treedef)
-            except BaseException as e:  # noqa: BLE001 - surfaced in wait()
-                self._error = e
+            def write():
+                try:
+                    self._write(step, host_leaves, treedef)
+                except BaseException as e:  # noqa: BLE001 - see wait()
+                    self._error = e
 
-        if blocking:
-            write()
-            self._raise_pending()
-        else:
-            self._worker = threading.Thread(target=write, daemon=True)
-            self._worker.start()
+            if blocking:
+                write()
+                self._raise_pending()
+            else:
+                self._worker = threading.Thread(target=write, daemon=True)
+                self._worker.start()
 
     def _write(self, step, host_leaves, treedef) -> None:
         final = os.path.join(self.directory, f"{_STEP_PREFIX}{step}")
@@ -148,10 +192,20 @@ class Checkpointer:
     def wait(self) -> None:
         """Block until the outstanding async save completes; re-raise its
         error if it failed."""
-        if self._worker is not None:
-            self._worker.join()
-            self._worker = None
-        self._raise_pending()
+        with self._op_lock:
+            self._join_worker()
+            self._raise_pending()
+
+    def _join_worker(self) -> None:
+        """Join the outstanding writer WITHOUT surfacing its error —
+        restore() must not let a failed save poison a rollback (the
+        failed write left only partials, which the heal/all_steps
+        contract already ignores); the error stays pending for the next
+        save()/wait() to report."""
+        with self._op_lock:
+            if self._worker is not None:
+                self._worker.join()
+                self._worker = None
 
     def _raise_pending(self) -> None:
         if self._error is not None:
@@ -178,30 +232,43 @@ class Checkpointer:
     def restore(self, step: int | None = None, shardings=None):
         """Load a checkpoint (default: newest).  `shardings`: optional
         pytree-of-shardings matching the state — each leaf then
-        materializes directly onto its devices."""
-        if step is None:
-            step = self.latest_step()
+        materializes directly onto its devices (the rejoined-rank
+        restore path: a replacement reads only its extents).  Heals
+        interrupted republishes first, so a writer crashed mid-swap
+        still yields the previous complete step, never a partial; a
+        FAILED async save does not poison the restore (its error stays
+        pending for the next save/wait) — the rollback gets the newest
+        COMPLETE checkpoint either way."""
+        with self._op_lock:
+            # an in-flight async writer must not race the heal; its
+            # failure is not ours to report (see _join_worker).  The
+            # lock spans the read too: a concurrent save republishing
+            # this very step must not swap directories under the reader.
+            self._join_worker()
+            self._heal_interrupted()
             if step is None:
-                raise errors.ArgError(
-                    f"no checkpoint found in {self.directory}"
-                )
-        d = os.path.join(self.directory, f"{_STEP_PREFIX}{step}")
-        if not os.path.isdir(d):
-            raise errors.ArgError(f"no checkpoint for step {step}")
-        with open(os.path.join(d, "meta.json")) as f:
-            meta = json.load(f)
-        with open(os.path.join(d, "treedef.pkl"), "rb") as f:
-            import pickle
+                step = self.latest_step()
+                if step is None:
+                    raise errors.ArgError(
+                        f"no checkpoint found in {self.directory}"
+                    )
+            d = os.path.join(self.directory, f"{_STEP_PREFIX}{step}")
+            if not os.path.isdir(d):
+                raise errors.ArgError(f"no checkpoint for step {step}")
+            with open(os.path.join(d, "meta.json")) as f:
+                meta = json.load(f)
+            with open(os.path.join(d, "treedef.pkl"), "rb") as f:
+                import pickle
 
-            treedef = pickle.load(f)
-        shard_leaves = (
-            jax.tree_util.tree_flatten(shardings)[0]
-            if shardings is not None else [None] * meta["n_leaves"]
-        )
-        leaves = [
-            sharded.load_sharded(
-                os.path.join(d, f"leaf_{i}.zmpi"), shard_leaves[i]
+                treedef = pickle.load(f)
+            shard_leaves = (
+                jax.tree_util.tree_flatten(shardings)[0]
+                if shardings is not None else [None] * meta["n_leaves"]
             )
-            for i in range(meta["n_leaves"])
-        ]
-        return jax.tree_util.tree_unflatten(treedef, leaves), step
+            leaves = [
+                sharded.load_sharded(
+                    os.path.join(d, f"leaf_{i}.zmpi"), shard_leaves[i]
+                )
+                for i in range(meta["n_leaves"])
+            ]
+            return jax.tree_util.tree_unflatten(treedef, leaves), step
