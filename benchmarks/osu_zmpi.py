@@ -12,7 +12,18 @@ Usage::
     python -m benchmarks.osu_zmpi --op allreduce --algorithm ring
     python -m benchmarks.osu_zmpi --op bcast --max-size 1048576
     python -m benchmarks.osu_zmpi --op pt2pt
+    python -m benchmarks.osu_zmpi --op pt2pt --bw --json   # osu_bw shape
+    python -m benchmarks.osu_zmpi --op tcp --bw
+    python -m benchmarks.osu_zmpi --op allreduce --plane host --algorithm ring
     python -m benchmarks.osu_zmpi --op all --json
+
+``--bw`` switches the pt2pt/tcp ops from ping-pong latency (osu_latency)
+to the multi-frame in-flight bandwidth shape (osu_bw): the sender streams
+a window of frames back-to-back, the receiver acks once per window —
+measuring the wire plane's streaming throughput, where the zero-copy
+framing matters most.  ``--plane host`` runs the collective over REAL
+loopback sockets through coll/host (the DCN leg), instead of the
+device-plane XLA collectives.
 
 On a CPU host this exercises the 8-virtual-device loopback mesh (the
 btl/self+sm analog); on TPU hardware the same sweep rides ICI.
@@ -105,9 +116,13 @@ def bench_collective(opname: str, algorithm: str = "auto",
     return rows
 
 
-def bench_pt2pt(max_size: int = 4 << 20, iters: int = 50) -> list[dict]:
-    """Host-plane ping-pong latency (osu_latency shape) over the
-    thread-rank universe — the btl/self+sm loopback analog."""
+def bench_pt2pt(max_size: int = 4 << 20, iters: int = 50,
+                bw: bool = False, window: int = 16) -> list[dict]:
+    """Host-plane pt2pt over the thread-rank universe — the btl/self+sm
+    loopback analog.  Default: ping-pong latency (osu_latency shape).
+    ``bw=True``: multi-frame in-flight bandwidth (osu_bw shape — the
+    sender streams `window` messages, the receiver acks per window)."""
+    from zhpe_ompi_tpu.pt2pt.requests import wait_all
     from zhpe_ompi_tpu.pt2pt.universe import LocalUniverse
 
     rows = []
@@ -115,7 +130,7 @@ def bench_pt2pt(max_size: int = 4 << 20, iters: int = 50) -> list[dict]:
         payload = np.zeros(max(1, nbytes // 8), dtype=np.float64)
         uni = LocalUniverse(2)
 
-        def main(ctx, payload=payload):
+        def main_latency(ctx, payload=payload):
             if ctx.rank == 0:
                 # warmup
                 ctx.send(payload, dest=1, tag=1)
@@ -132,86 +147,197 @@ def bench_pt2pt(max_size: int = 4 << 20, iters: int = 50) -> list[dict]:
                 ctx.send(payload, dest=0, tag=2)
             return None
 
-        rtt = uni.run(main)[0]
+        def main_bw(ctx, payload=payload):
+            reps = max(1, iters // 4)
+            if ctx.rank == 0:
+                wait_all([ctx.isend(payload, 1, tag=1)
+                          for _ in range(window)])
+                ctx.recv(source=1, tag=2)  # warmup window + ack
+                t0 = time.perf_counter()
+                for _ in range(reps):
+                    wait_all([ctx.isend(payload, 1, tag=1)
+                              for _ in range(window)])
+                    ctx.recv(source=1, tag=2)
+                # seconds per one-way message, amortized over the window
+                return (time.perf_counter() - t0) / (reps * window)
+            for _ in range(reps + 1):
+                reqs = [ctx.irecv(source=0, tag=1) for _ in range(window)]
+                wait_all(reqs)
+                ctx.send(b"ack", dest=0, tag=2)
+            return None
+
+        sec = uni.run(main_bw if bw else main_latency)[0]
+        one_way = sec if bw else sec / 2
         rows.append({
-            "op": "pt2pt_pingpong", "bytes": payload.nbytes,
-            "latency_us": rtt / 2 * 1e6,  # one-way, OSU convention
-            "bandwidth_MBps": (payload.nbytes / (rtt / 2)) / 1e6,
+            "op": "pt2pt_bw" if bw else "pt2pt_pingpong",
+            "bytes": payload.nbytes,
+            "latency_us": one_way * 1e6,  # one-way, OSU convention
+            "bandwidth_MBps": (payload.nbytes / one_way) / 1e6,
         })
     return rows
 
 
-def bench_tcp(max_size: int = 4 << 20, iters: int = 50) -> list[dict]:
-    """REAL-socket ping-pong latency (osu_latency over btl/tcp): two
-    TcpProc endpoints over loopback, eager and rendezvous regimes both
-    crossed as the ladder passes tcp_eager_limit."""
+def _run_tcp_ranks(n: int, fn, timeout: float = 180.0) -> list:
+    """Launch fn(proc) on n TcpProc ranks over localhost sockets; rank 0
+    binds an ephemeral coordinator the others learn through the
+    on_coordinator_bound hook (prte forwarding the PMIx URI)."""
     import threading
 
     from zhpe_ompi_tpu.pt2pt.tcp import TcpProc
 
-    rows = []
-    for nbytes in _sizes(max_size):
-        payload = np.zeros(max(1, nbytes // 8), dtype=np.float64)
-        results: dict[int, float | None] = {}
+    coord: list = []
+    coord_ready = threading.Event()
+    results: list = [None] * n
+    excs: list = [None] * n
 
-        # rank 0 binds an ephemeral coordinator; rank 1 learns it via the
-        # on_coordinator_bound hook (prte forwarding the PMIx URI)
-        coord: list = []
-        coord_ready = threading.Event()
-
-        def run_rank0(payload=payload):
-            try:
+    def main(rank):
+        try:
+            if rank == 0:
                 proc = TcpProc(
-                    0, 2, coordinator=("127.0.0.1", 0),
+                    0, n, coordinator=("127.0.0.1", 0),
                     on_coordinator_bound=lambda addr: (
                         coord.append(addr), coord_ready.set()),
                 )
-            except BaseException as e:
-                results[0] = e
-                coord_ready.set()  # unblock rank 1's wait
-                raise
+            else:
+                if not coord_ready.wait(30.0) or not coord:
+                    return  # rank 0 failed; its error is in excs[0]
+                proc = TcpProc(rank, n, coordinator=tuple(coord[0]))
             try:
+                results[rank] = fn(proc)
+            finally:
+                proc.close()
+        except BaseException as e:  # noqa: BLE001 - re-raised below
+            excs[rank] = e
+            coord_ready.set()
+
+    threads = [threading.Thread(target=main, args=(r,)) for r in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout)
+    for e in excs:
+        if e is not None:
+            raise RuntimeError(f"tcp bench rank failed: {e!r}") from e
+    return results
+
+
+def bench_tcp(max_size: int = 4 << 20, iters: int = 50,
+              bw: bool = False, window: int = 16) -> list[dict]:
+    """REAL-socket pt2pt (over btl/tcp): two TcpProc endpoints over
+    loopback, eager and rendezvous regimes both crossed as the ladder
+    passes tcp_eager_limit.  Default: ping-pong latency (osu_latency).
+    ``bw=True``: multi-frame in-flight bandwidth (osu_bw — `window`
+    frames streamed per ack, so TCP keeps its pipe full)."""
+    rows = []
+    for nbytes in _sizes(max_size):
+        payload = np.zeros(max(1, nbytes // 8), dtype=np.float64)
+
+        def pingpong(proc, payload=payload):
+            if proc.rank == 0:
                 proc.send(payload, dest=1, tag=1)
                 proc.recv(source=1, tag=2)
                 t0 = time.perf_counter()
                 for _ in range(iters):
                     proc.send(payload, dest=1, tag=1)
                     proc.recv(source=1, tag=2)
-                results[0] = (time.perf_counter() - t0) / iters
-            except BaseException as e:
-                results[0] = e
-                raise
-            finally:
-                proc.close()
-
-        def run_rank1(payload=payload):
-            if not coord_ready.wait(30.0) or not coord:
-                return  # rank 0 failed; its error is in results[0]
-            proc = TcpProc(1, 2, coordinator=tuple(coord[0]))
-            try:
+                return (time.perf_counter() - t0) / iters
+            proc.recv(source=0, tag=1)
+            proc.send(payload, dest=0, tag=2)
+            for _ in range(iters):
                 proc.recv(source=0, tag=1)
                 proc.send(payload, dest=0, tag=2)
-                for _ in range(iters):
-                    proc.recv(source=0, tag=1)
-                    proc.send(payload, dest=0, tag=2)
-            finally:
-                proc.close()
+            return None
 
-        t0 = threading.Thread(target=run_rank0)
-        t1 = threading.Thread(target=run_rank1)
-        t0.start()
-        t1.start()
-        t0.join()
-        t1.join()
-        rtt = results.get(0)
-        if rtt is None or isinstance(rtt, BaseException):
-            raise RuntimeError(f"tcp pingpong rank 0 failed: {rtt!r}")
+        def stream(proc, payload=payload):
+            reps = max(1, iters // 4)
+            if proc.rank == 0:
+                for _ in range(window):
+                    proc.send(payload, dest=1, tag=1)
+                proc.recv(source=1, tag=2)  # warmup window + ack
+                t0 = time.perf_counter()
+                for _ in range(reps):
+                    for _ in range(window):
+                        proc.send(payload, dest=1, tag=1)
+                    proc.recv(source=1, tag=2)
+                return (time.perf_counter() - t0) / (reps * window)
+            for _ in range(reps + 1):
+                for _ in range(window):
+                    proc.recv(source=0, tag=1, timeout=120.0)
+                proc.send(b"ack", dest=0, tag=2)
+            return None
+
+        sec = _run_tcp_ranks(2, stream if bw else pingpong)[0]
+        one_way = sec if bw else sec / 2
         rows.append({
-            "op": "tcp_pingpong", "bytes": payload.nbytes,
-            "latency_us": rtt / 2 * 1e6,
-            "bandwidth_MBps": (payload.nbytes / (rtt / 2)) / 1e6,
+            "op": "tcp_bw" if bw else "tcp_pingpong",
+            "bytes": payload.nbytes,
+            "latency_us": one_way * 1e6,
+            "bandwidth_MBps": (payload.nbytes / one_way) / 1e6,
         })
     return rows
+
+
+def bench_host_coll(opname: str = "allreduce", algorithm: str = "auto",
+                    max_size: int = 4 << 20, iters: int = 5,
+                    nprocs: int = 4) -> list[dict]:
+    """Host-plane collective over REAL loopback sockets: `nprocs`
+    TcpProc ranks running the coll/host algorithms (ring allreduce,
+    pipeline bcast, pairwise alltoall ... the DCN leg of multi-host
+    training).  ``algorithm`` pins the host algorithm MCA var where one
+    exists; 'ring' for allreduce means crossing host_coll_large_msg so
+    the bandwidth-optimal ring path is selected."""
+    from zhpe_ompi_tpu import ops
+    from zhpe_ompi_tpu.mca import var as mca_var
+
+    pinned = None
+    if algorithm != "auto" and opname in ("bcast", "reduce"):
+        pinned = f"host_{opname}_algorithm"
+        mca_var.set_var(pinned, algorithm)
+    elif algorithm == "ring" and opname == "allreduce":
+        # the ring path has no forced-algorithm var; it is selected by
+        # size — drop the threshold so EVERY rung actually runs ring
+        # and the row's algorithm label is honest
+        pinned = "host_coll_large_msg"
+        mca_var.set_var(pinned, 1)
+    elif algorithm != "auto":
+        raise ValueError(
+            f"host plane: no algorithm knob for {opname}/{algorithm}"
+        )
+    try:
+        rows = []
+        for nbytes in _sizes(max_size, min_bytes=1 << 10):
+            arr = np.zeros(max(nprocs, nbytes // 8), dtype=np.float64)
+
+            def prog(p, arr=arr):
+                def once():
+                    if opname == "allreduce":
+                        p.allreduce(arr, ops.SUM)
+                    elif opname == "bcast":
+                        p.bcast(arr if p.rank == 0 else None, 0)
+                    elif opname == "alltoall":
+                        blocks = np.array_split(arr, p.size)
+                        p.alltoall(list(blocks))
+                    else:
+                        raise ValueError(f"host plane: unknown {opname}")
+
+                once()  # warmup
+                p.barrier()
+                t0 = time.perf_counter()
+                for _ in range(iters):
+                    once()
+                return (time.perf_counter() - t0) / iters
+
+            per_rank = _run_tcp_ranks(nprocs, prog)
+            sec = max(per_rank)
+            rows.append({
+                "op": f"host_{opname}", "algorithm": algorithm,
+                "bytes": arr.nbytes, "latency_us": sec * 1e6,
+                "bandwidth_MBps": (arr.nbytes / sec) / 1e6,
+            })
+        return rows
+    finally:
+        if pinned:
+            mca_var.unset(pinned)
 
 
 def _print_table(rows: list[dict]) -> None:
@@ -235,18 +361,36 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--max-size", type=int, default=1 << 20)
     p.add_argument("--iters", type=int, default=10)
     p.add_argument("--json", action="store_true")
+    p.add_argument("--bw", action="store_true",
+                   help="pt2pt/tcp: multi-frame in-flight bandwidth "
+                        "(osu_bw shape) instead of ping-pong latency")
+    p.add_argument("--window", type=int, default=16,
+                   help="frames in flight per ack in --bw mode")
+    p.add_argument("--plane", default="device",
+                   choices=("device", "host"),
+                   help="collectives: device = XLA mesh (default); "
+                        "host = coll/host over real loopback sockets")
+    p.add_argument("--nprocs", type=int, default=4,
+                   help="socket ranks for --plane host")
     args = p.parse_args(argv)
 
     if args.op == "pt2pt":
-        rows = bench_pt2pt(args.max_size, max(args.iters, 10))
+        rows = bench_pt2pt(args.max_size, max(args.iters, 10),
+                           bw=args.bw, window=args.window)
     elif args.op == "tcp":
-        rows = bench_tcp(args.max_size, max(args.iters, 10))
+        rows = bench_tcp(args.max_size, max(args.iters, 10),
+                         bw=args.bw, window=args.window)
     elif args.op == "all":
         rows = []
         for op in ("allreduce", "bcast", "allgather", "alltoall"):
             rows += bench_collective(op, "auto", args.max_size, args.iters)
         rows += bench_pt2pt(args.max_size, max(args.iters, 10))
         rows += bench_tcp(args.max_size, max(args.iters, 10))
+    elif args.plane == "host":
+        rows = bench_host_coll(
+            args.op, args.algorithm, args.max_size, args.iters,
+            nprocs=args.nprocs,
+        )
     else:
         rows = bench_collective(
             args.op, args.algorithm, args.max_size, args.iters

@@ -62,6 +62,13 @@ def _ulfm_detector_hygiene():
     assert not partials, (
         f"recovery left orphaned checkpoint partials on disk: {partials}"
     )
+    from zhpe_ompi_tpu.pt2pt import tcp as tcp_mod
+
+    pushers = tcp_mod.live_push_threads()
+    assert not pushers, (
+        f"rendezvous push-pool threads leaked past their proc's "
+        f"close(): {pushers}"
+    )
 
 
 @pytest.fixture(autouse=True)

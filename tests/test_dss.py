@@ -63,3 +63,105 @@ class TestRoundtrip:
     def test_wire_is_compact(self):
         # a small int should be a handful of bytes, not a pickle blob
         assert len(dss.pack(7)) <= 4
+
+
+def _assert_same(a, b):
+    """Byte-identical structural equality (arrays compare dtype, shape,
+    AND raw bytes; containers recurse; scalars compare type exactly)."""
+    if isinstance(a, np.ndarray):
+        assert isinstance(b, np.ndarray)
+        assert a.dtype == b.dtype and a.shape == b.shape
+        assert a.tobytes() == b.tobytes()
+    elif isinstance(a, (list, tuple)):
+        assert type(a) is type(b) and len(a) == len(b)
+        for x, y in zip(a, b):
+            _assert_same(x, y)
+    elif isinstance(a, dict):
+        assert set(a) == set(b)
+        for k in a:
+            _assert_same(a[k], b[k])
+    else:
+        assert a == b and type(a) is type(b)
+
+
+class TestFramePath:
+    """The out-of-band zero-copy frame path (pack_frames/unpack_from)
+    must be byte-identical in RESULT to the legacy pack path for every
+    edge case, and the legacy byte stream must remain a valid
+    degenerate case of the same wire format."""
+
+    EDGE_CASES = [
+        np.zeros((0, 5), np.float32),            # zero-size array
+        np.arange(20)[::3],                      # non-contiguous slice
+        np.arange(6, dtype=">f8"),               # big-endian dtype
+        np.asfortranarray(np.arange(12.).reshape(3, 4)),  # F-order
+        np.float32(2.5),                         # np.generic scalar
+        np.int64(-7),
+        np.bool_(True),
+        np.float64(1.25),                        # ALSO a float subclass
+        (3, np.arange(257, dtype=np.float64)),   # the (idx, block) tuple
+        [np.ones((2, 2)), "mid", np.zeros(3, np.int8), None, -1, 2.5],
+        {"w": np.linspace(0, 1, 9), ("t",): [b"raw", np.uint16(9)]},
+        b"x" * 8192,                             # OOB-sized bytes
+        bytearray(b"y" * 8192),
+        b"tiny", "str", 0, True, None,
+    ]
+
+    @pytest.mark.parametrize("case", range(len(EDGE_CASES)))
+    def test_matches_legacy_pack(self, case):
+        obj = self.EDGE_CASES[case]
+        legacy = dss.unpack(dss.pack(obj))[0]
+        header, segs = dss.pack_frames(obj)
+        wire = header + b"".join(bytes(s) for s in segs)
+        _assert_same(legacy, dss.unpack(wire)[0])
+        # and through the view-building receive entry, over a writable
+        # buffer (what _recv_exact_into hands the drain loop)
+        _assert_same(legacy, dss.unpack_from(bytearray(wire))[0])
+
+    def test_legacy_stream_is_degenerate_case(self):
+        obj = {"a": np.arange(4), "b": [1, (2.0, b"c")]}
+        legacy_wire = dss.pack(obj)
+        _assert_same(dss.unpack(legacy_wire)[0],
+                     dss.unpack_from(bytearray(legacy_wire))[0])
+
+    def test_pack_frames_is_zero_copy(self):
+        """The OOB segment must reference the source array's memory —
+        no tobytes() copy anywhere on the pack side."""
+        import ctypes
+
+        arr = np.arange(64, dtype=np.float64)
+        _, segs = dss.pack_frames(arr)
+        assert len(segs) == 1
+        addr = ctypes.addressof(ctypes.c_char.from_buffer(segs[0]))
+        assert addr == arr.ctypes.data
+
+    def test_unpack_from_views_are_writable_and_aliased(self):
+        arr = np.arange(16, dtype=np.float32)
+        header, segs = dss.pack_frames(0, arr)
+        buf = bytearray(header + b"".join(bytes(s) for s in segs))
+        [_, out] = dss.unpack_from(buf)
+        assert out.flags.writeable
+        out[0] = 99.0  # must not raise (writable-delivery contract)
+        assert buf is not None  # the view pins the frame buffer
+
+    def test_unpack_from_readonly_degrades_to_copy(self):
+        arr = np.arange(16, dtype=np.float32)
+        header, segs = dss.pack_frames(arr)
+        wire = header + b"".join(bytes(s) for s in segs)  # immutable
+        [out] = dss.unpack_from(wire)
+        assert out.flags.writeable  # copy taken: still writable
+
+    def test_oob_threshold_keeps_small_arrays_inline(self):
+        small = np.arange(4, dtype=np.int8)
+        header, segs = dss.pack_frames(small, oob_min=1024)
+        assert segs == []
+        assert header == dss.pack(small)  # fully degenerate
+
+    def test_truncated_oob_frame_raises(self):
+        arr = np.arange(32, dtype=np.float64)
+        header, segs = dss.pack_frames(arr)
+        wire = header + b"".join(bytes(s) for s in segs)
+        with pytest.raises(errors.TruncateError):
+            dss.unpack(wire[:-8])  # tail segment cut short
+        with pytest.raises(errors.TruncateError):
+            dss.unpack(wire + b"\x00")  # trailing garbage still caught

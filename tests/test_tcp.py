@@ -125,6 +125,44 @@ class TestWire:
 
         assert run_tcp(2, prog) == [b"self", b"self"]
 
+    def test_loopback_buffer_reuse_isolation(self):
+        """The loopback shortcut skips serialization but must keep the
+        defensive copy: mutate the source after send, the receiver sees
+        the pre-mutation value (and the delivered array is writable)."""
+
+        def prog(p):
+            arr = np.arange(8, dtype=np.float64)
+            p.send(arr, dest=p.rank, tag=9)
+            arr[:] = -1.0  # sender reuses its buffer immediately
+            got = p.recv(source=p.rank, tag=9)
+            got += 0.0  # writable-delivery contract
+            # container payloads get the same treatment (the (idx, block)
+            # tuples host collectives ship)
+            blk = np.ones(4)
+            p.send((3, blk), dest=p.rank, tag=10)
+            blk[:] = 7.0
+            idx, got2 = p.recv(source=p.rank, tag=10)
+            return (got.tolist(), idx, got2.tolist())
+
+        out = run_tcp(2, prog)
+        assert out[0] == (list(range(8)), 3, [1.0] * 4)
+
+    def test_loopback_type_mapping_matches_dss(self):
+        """Fast-path loopback must deliver the SAME types the DSS round
+        trip would: bytearray lands as bytes, numpy scalars as 0-d
+        arrays, tuples stay tuples."""
+
+        def prog(p):
+            p.send(bytearray(b"ba"), dest=p.rank, tag=11)
+            p.send(np.float32(2.5), dest=p.rank, tag=12)
+            a = p.recv(source=p.rank, tag=11)
+            b = p.recv(source=p.rank, tag=12)
+            return (type(a).__name__, a, type(b).__name__,
+                    b.dtype.str, float(b))
+
+        out = run_tcp(1, prog)
+        assert out[0] == ("bytes", b"ba", "ndarray", "<f4", 2.5)
+
     def test_large_message(self):
         big = np.random.default_rng(0).normal(size=(512, 256))
 
@@ -248,6 +286,194 @@ class TestWire:
         assert out[0] == (14, 1, 1) and out[1] == (7, 1, 1)
 
 
+class TestZeroCopyWire:
+    """The out-of-band frame path over real sockets: counters prove the
+    fast path is taken, accounting covers actual on-wire bytes."""
+
+    def test_zero_copy_counters_on_eager_array_send(self):
+        from zhpe_ompi_tpu.runtime import spc
+
+        arr = np.arange(4096, dtype=np.float64)  # 32 KB, eager
+
+        def prog(p):
+            if p.rank == 0:
+                before = spc.read("tcp_zero_copy_sends")
+                avoided = spc.read("tcp_copy_bytes_avoided")
+                p.send(arr, dest=1, tag=60)
+                p.recv(source=1, tag=61)
+                return (spc.read("tcp_zero_copy_sends") - before,
+                        spc.read("tcp_copy_bytes_avoided") - avoided)
+            got = p.recv(source=0, tag=60)
+            assert np.array_equal(got, arr) and got.flags.writeable
+            p.send(b"ok", dest=0, tag=61)
+            return None
+
+        sends, avoided = run_tcp(2, prog)[0]
+        assert sends >= 1
+        assert avoided >= arr.nbytes
+
+    def test_zero_copy_counters_on_rendezvous_send(self):
+        from zhpe_ompi_tpu.runtime import spc
+
+        big = np.arange(1 << 18, dtype=np.float64)  # 2 MB > eager limit
+
+        def prog(p):
+            if p.rank == 0:
+                before = spc.read("tcp_zero_copy_sends")
+                p.send(big, dest=1, tag=62)
+                p.recv(source=1, tag=63)
+                return spc.read("tcp_zero_copy_sends") - before
+            got = p.recv(source=0, tag=62, timeout=20.0)
+            assert got.flags.writeable and float(got[-1]) == (1 << 18) - 1
+            p.send(b"ok", dest=0, tag=63)
+            return None
+
+        assert run_tcp(2, prog)[0] >= 1
+
+    def test_bytes_sent_counts_wire_bytes(self):
+        """tcp_bytes_sent must cover actual on-wire bytes: the 4-byte
+        length headers and the payload frame — not just the DSS body
+        (the seed under-counted headers and control frames)."""
+        from zhpe_ompi_tpu.runtime import spc
+        from zhpe_ompi_tpu.utils import dss
+
+        arr = np.arange(1024, dtype=np.float64)
+
+        def prog(p):
+            if p.rank == 0:
+                before = spc.read("tcp_bytes_sent")
+                p.send(arr, dest=1, tag=64)
+                sent = spc.read("tcp_bytes_sent") - before
+                p.recv(source=1, tag=65)
+                # at least the serialized frame + its length header
+                return sent >= len(dss.pack(0, 64, 0, 0, arr)) + 4
+            p.recv(source=0, tag=64)
+            p.send(b"ok", dest=0, tag=65)
+            return None
+
+        assert run_tcp(2, prog)[0] is True
+
+    def test_rndv_wire_accounting_includes_control_frames(self):
+        """A rendezvous transfer's RTS and CTS control frames (and the
+        data connection's hello) are on-wire bytes too: the sender+
+        receiver pair must record MORE than the bare data frame."""
+        from zhpe_ompi_tpu.runtime import spc
+
+        big = np.zeros(1 << 18, np.float64)  # 2 MB
+
+        def prog(p):
+            if p.rank == 0:
+                p.barrier()
+                before = spc.read("tcp_bytes_sent")
+                p.send(big, dest=1, tag=66)
+                p.recv(source=1, tag=67)  # transfer fully drained
+                p.barrier()
+                return spc.read("tcp_bytes_sent") - before
+            p.barrier()
+            p.recv(source=0, tag=66, timeout=20.0)
+            p.send(b"done", dest=0, tag=67)
+            p.barrier()
+            return None
+
+        # both ranks' counters land in the same process-global spc; the
+        # delta spans RTS + CTS + hello + data + ack — strictly more
+        # than the payload alone
+        sent = run_tcp(2, prog)[0]
+        assert sent > big.nbytes
+
+    def test_ft_and_zero_copy_coexist(self):
+        """The fast path must ride UNDER the FT control plane, not
+        around it: ft=True procs exchanging arrays still count
+        zero-copy sends, and heartbeats/goodbyes keep flowing."""
+        from zhpe_ompi_tpu.runtime import spc
+
+        def prog(p):
+            before = spc.read("tcp_zero_copy_sends")
+            got = p.sendrecv(
+                np.full(2048, float(p.rank + 1)), dest=1 - p.rank,
+                source=1 - p.rank, sendtag=68, recvtag=68,
+            )
+            assert float(np.asarray(got)[0]) == float(2 - p.rank)
+            return spc.read("tcp_zero_copy_sends") - before
+
+        deltas = run_tcp_ft_pair(prog)
+        assert all(d >= 1 for d in deltas)
+
+
+def run_tcp_ft_pair(fn, timeout=60.0):
+    """Two ft=True TcpProcs over localhost (detector armed) — the
+    minimal fast-path + FT coexistence harness."""
+    coord_ready = threading.Event()
+    coord_addr = [None]
+    results = [None, None]
+    excs = [None, None]
+
+    def publish(addr):
+        coord_addr[0] = addr
+        coord_ready.set()
+
+    def main(rank):
+        try:
+            if rank == 0:
+                proc = TcpProc(0, 2, coordinator=("127.0.0.1", 0),
+                               on_coordinator_bound=publish, ft=True)
+            else:
+                coord_ready.wait(10)
+                proc = TcpProc(1, 2, coordinator=coord_addr[0], ft=True)
+            try:
+                results[rank] = fn(proc)
+            finally:
+                proc.close()
+        except BaseException as e:  # noqa: BLE001
+            excs[rank] = e
+            coord_ready.set()
+
+    threads = [threading.Thread(target=main, args=(r,)) for r in (0, 1)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout)
+        assert not t.is_alive(), "ft tcp rank hung"
+    if any(e is not None for e in excs):
+        raise next(e for e in excs if e is not None)
+    return results
+
+
+class TestRendezvousPushPool:
+    """Satellite: the per-rendezvous push thread spawn is capped by a
+    small per-proc executor — a burst of large sends cannot spawn
+    unbounded threads, and the pool drains at close()."""
+
+    def test_burst_bounded_and_drains(self):
+        from zhpe_ompi_tpu.mca import var as mca_var
+        from zhpe_ompi_tpu.pt2pt import tcp as tcp_mod
+
+        nmsg = 12
+        cap = int(mca_var.get("tcp_rndv_push_workers", 4))
+        big = np.zeros((1 << 17) + 16, np.float64)  # just over 1 MB
+
+        def prog(p):
+            if p.rank == 0:
+                for i in range(nmsg):
+                    p.send(big + float(i), dest=1, tag=70 + i)
+                # every transfer is in flight now; the worker count must
+                # stay at the pool cap even while pushes overlap
+                peak = len(p._push_pool._threads)
+                p.recv(source=1, tag=99, timeout=60.0)
+                return peak
+            total = 0.0
+            for i in range(nmsg):
+                got = p.recv(source=0, tag=70 + i, timeout=60.0)
+                total += float(got[1])
+            p.send(total, dest=0, tag=99)
+            return total
+
+        res = run_tcp(2, prog)
+        assert res[0] <= cap
+        assert res[1] == float(sum(range(nmsg)))
+        # pool drained at close(): the conftest session gate asserts the
+        # same globally; check promptly here too
+        assert tcp_mod.live_push_threads() == []
 class TestRendezvous:
     """RTS/CTS above tcp_eager_limit: large payloads park at the SENDER
     until the receiver matches (round-3 fix of eager-only weakness)."""

@@ -875,6 +875,44 @@ class TestTcpUlfm:
         assert res[0] == (True, 0, 2, 1.0)
         assert res[1] == (True, 1, 2, 1.0)
 
+    def test_recovery_with_array_payloads_rides_fast_path(self,
+                                                          fresh_vars):
+        """The zero-copy wire plane and ULFM recovery coexist end to
+        end: kill a rank mid-ring, survivors ack → agree → shrink, then
+        allreduce an ARRAY over the shrunken endpoint — the result is
+        correct AND the out-of-band fast path carried the payloads
+        (tcp_zero_copy_sends rose), i.e. FT classification did not
+        silently fall back to the copy path."""
+        from zhpe_ompi_tpu.runtime import spc
+
+        mca_var.set_var("ft_detector_period", 0.05)
+        mca_var.set_var("ft_detector_timeout", 0.4)
+        n = 3
+        plan = FaultPlan(seed=21).kill_rank(2, after_ops=1)
+        zc0 = spc.read("tcp_zero_copy_sends")
+
+        def prog(p):
+            p.set_errhandler(errh.ERRORS_RETURN)
+            inj = plan.arm(p)
+            block = np.full(2048, float(p.rank + 1))  # 16 KB, eager OOB
+            try:
+                inj.send((p.rank, block), dest=(p.rank + 1) % n, tag=1)
+                inj.recv(source=(p.rank - 1) % n, tag=1, timeout=10.0)
+            except errors.ProcFailed:
+                pass  # discovery-at-send: as valid an entry as at-recv
+            assert p.ft_state.wait_failed(2, timeout=10.0)
+            p.failure_ack()
+            assert p.agree(True) is True
+            sh = p.shrink()
+            total = sh.allreduce(np.full(2048, float(p.rank + 1)),
+                                 ops.SUM)
+            return (sh.size, float(np.asarray(total)[0]))
+
+        res = run_tcp_ft(n, prog)
+        assert res[2] == "killed"
+        assert res[0] == (2, 3.0) and res[1] == (2, 3.0)  # 1.0 + 2.0
+        assert spc.read("tcp_zero_copy_sends") > zc0
+
     def test_muted_rank_found_by_detector_only(self, fresh_vars):
         """mute kill: sockets stay open, only heartbeats stop — the ring
         detector is the sole discovery path and must flood the news."""

@@ -184,8 +184,11 @@ def _bcast_pipeline(ctx, obj: Any, root: int) -> Any:
         nseg = max(1, -(-flat.size // seg))
         ctx.send((arr.dtype.str, arr.shape, nseg), succ, tag=tag,
                  cid=COLL_CID)
+        # segment VIEWS: the zero-copy wire path references them as
+        # out-of-band buffers; root never mutates obj mid-broadcast, and
+        # the thread plane's eager/handoff copy preserves buffer reuse
         reqs = [
-            ctx.isend(flat[i * seg : (i + 1) * seg].copy(), succ,
+            ctx.isend(flat[i * seg : (i + 1) * seg], succ,
                       tag=tag, cid=COLL_CID)
             for i in range(nseg)
         ]
@@ -262,8 +265,10 @@ def _reduce_pipeline(ctx, value, op, root: int):
         nseg = max(1, -(-flat.size // elems))
         ctx.send(("hdr", arr.dtype.str, arr.shape, nseg, elems),
                  toward_root, tag=tag, cid=COLL_CID)
+        # segment views (see _bcast_pipeline): the originator only reads
+        # flat until wait_all returns, so the per-segment copy was waste
         reqs = [
-            ctx.isend(flat[i * elems : (i + 1) * elems].copy(),
+            ctx.isend(flat[i * elems : (i + 1) * elems],
                       toward_root, tag=tag, cid=COLL_CID)
             for i in range(nseg)
         ]
@@ -367,7 +372,16 @@ def _allreduce_ring(ctx, value: np.ndarray, op, tag: int) -> np.ndarray:
     size, rank = ctx.size, ctx.rank
     flat = np.ascontiguousarray(value).reshape(-1)
     bounds = np.linspace(0, flat.size, size + 1).astype(np.int64)
-    chunks = [flat[bounds[i] : bounds[i + 1]].copy() for i in range(size)]
+    # chunk VIEWS, not copies: the wire plane ships contiguous slices as
+    # out-of-band segments (dss.pack_frames) and the combine below
+    # rebinds list entries with fresh op() results, so the full-payload
+    # copy the seed made bought nothing — EXCEPT this rank's own chunk,
+    # the only entry still aliasing the caller's buffer when sent (the
+    # thread plane parks rendezvous payloads by reference past
+    # sendrecv's return, so an aliased chunk could see a post-collective
+    # caller mutation); one 1/p-sized copy keeps that contract
+    chunks = [flat[bounds[i] : bounds[i + 1]] for i in range(size)]
+    chunks[rank] = chunks[rank].copy()
     right, left = (rank + 1) % size, (rank - 1) % size
     # reduce-scatter phase: after p-1 steps, chunk (rank+1)%size is done
     for step in range(size - 1):

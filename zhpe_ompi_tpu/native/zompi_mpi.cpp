@@ -103,6 +103,12 @@ namespace {
 enum DssTag : uint8_t {
   T_NONE = 0, T_BOOL = 1, T_INT = 2, T_FLOAT = 3, T_STR = 4,
   T_BYTES = 5, T_LIST = 6, T_TUPLE = 7, T_DICT = 8, T_NDARRAY = 9,
+  // out-of-band twins (dss.pack_frames): header carries the metadata
+  // plus an 8-byte little-endian offset-from-frame-END; the raw
+  // payload sits in the frame's trailing segment region.  The parser
+  // normalizes them to T_NDARRAY/T_BYTES so downstream dispatch is
+  // agnostic to which framing the (Python) sender chose.
+  T_NDARRAY_OOB = 10, T_BYTES_OOB = 11,
 };
 
 void put_varint(std::string &out, uint64_t n) {
@@ -194,7 +200,19 @@ bool parse_one(const uint8_t *buf, size_t len, size_t &pos, DssVal &v) {
       pos += n;
       return true;
     }
-    case T_NDARRAY: {
+    case T_BYTES_OOB: {
+      if (!get_varint(buf, len, pos, n)) return false;
+      if (pos + 8 > len) return false;
+      uint64_t ofe;
+      memcpy(&ofe, buf + pos, 8);
+      pos += 8;
+      if (ofe > len || n > ofe) return false;
+      v.s.assign((const char *)buf + (len - ofe), n);
+      v.tag = T_BYTES;
+      return true;
+    }
+    case T_NDARRAY:
+    case T_NDARRAY_OOB: {
       if (!get_varint(buf, len, pos, n) || pos + n > len) return false;
       v.dt.assign((const char *)buf + pos, n);
       pos += n;
@@ -205,7 +223,18 @@ bool parse_one(const uint8_t *buf, size_t len, size_t &pos, DssVal &v) {
         if (!get_varint(buf, len, pos, d)) return false;
         v.shape.push_back(d);
       }
-      if (!get_varint(buf, len, pos, n) || pos + n > len) return false;
+      if (!get_varint(buf, len, pos, n)) return false;
+      if (v.tag == T_NDARRAY_OOB) {
+        if (pos + 8 > len) return false;
+        uint64_t ofe;
+        memcpy(&ofe, buf + pos, 8);  // little-endian hosts only (x86/arm)
+        pos += 8;
+        if (ofe > len || n > ofe) return false;
+        v.data.assign((const char *)buf + (len - ofe), n);
+        v.tag = T_NDARRAY;
+        return true;
+      }
+      if (pos + n > len) return false;
       v.data.assign((const char *)buf + pos, n);
       pos += n;
       return true;

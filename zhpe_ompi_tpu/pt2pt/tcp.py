@@ -30,6 +30,7 @@ coordinator plays for the device plane.
 from __future__ import annotations
 
 import itertools
+import queue
 import random
 import socket
 import struct
@@ -37,6 +38,8 @@ import threading
 import time
 import weakref
 from typing import Any
+
+import numpy as np
 
 from ..coll.host import HostCollectives
 from ..coll.nbc import NonblockingCollectives
@@ -61,6 +64,26 @@ mca_var.register(
     "memory, the ob1 eager_limit contract on the wire plane)",
     type=int,
 )
+mca_var.register(
+    "tcp_zero_copy_min", 0,
+    "Array payload size (bytes) at/above which contiguous ndarray "
+    "payloads ride the out-of-band zero-copy frame path (dss.pack_frames "
+    "memoryview segments over sendmsg); 0 = every contiguous array",
+    type=int,
+)
+mca_var.register(
+    "tcp_rndv_push_workers", 4,
+    "Rendezvous data-push executor threads per proc: a burst of large "
+    "sends queues its CTS-released pushes on this bounded pool instead "
+    "of spawning one thread per transfer",
+    type=int,
+)
+
+# sendmsg gathers header+segments in one syscall; platforms without it
+# (or a socket object that declines) fall back to sequential sendall
+_HAS_SENDMSG = hasattr(socket.socket, "sendmsg")
+# stay well under IOV_MAX (typically 1024) per sendmsg call
+_IOV_BATCH = 256
 
 # rendezvous control channels (outside the user cid space)
 _RNDV_CTS_CID = 0x7FFA
@@ -92,37 +115,89 @@ def _payload_size(obj: Any, _depth: int = 0) -> int:
     return 0
 
 
-def _send_frame(sock: socket.socket, payload: bytes) -> None:
-    sock.sendall(_LEN.pack(len(payload)) + payload)
+def _byte_views(segments) -> list[memoryview]:
+    """Normalize a segment list to flat uint8 memoryviews (sendmsg wants
+    byte buffers; ndarray data views carry their own shape/format)."""
+    views = []
+    for seg in segments:
+        v = seg if isinstance(seg, memoryview) else memoryview(seg)
+        if v.format != "B" or v.ndim != 1:
+            v = v.cast("B")
+        views.append(v)
+    return views
 
 
-def _recv_exact(sock: socket.socket, n: int,
-                idle_retry: bool = False) -> bytes | None:
-    buf = bytearray()
-    while len(buf) < n:
+def _send_frame(sock: socket.socket, payload) -> int:
+    """Emit one length-framed message from `payload` — bytes, or a
+    sequence of buffer segments sent VECTORED via ``socket.sendmsg``
+    (no header+body concatenation, no frame-assembly copy; the btl
+    iovec discipline).  Returns — and counts in ``tcp_bytes_sent`` —
+    the actual on-wire byte total including the 4-byte length header."""
+    if isinstance(payload, (bytes, bytearray, memoryview)):
+        segments = (payload,)
+    else:
+        segments = payload
+    views = _byte_views(segments)
+    total = sum(v.nbytes for v in views)
+    bufs = [memoryview(_LEN.pack(total))]
+    bufs += [v for v in views if v.nbytes]
+    if _HAS_SENDMSG:
+        while bufs:
+            n = sock.sendmsg(bufs[:_IOV_BATCH])
+            # advance past what the kernel took (a short write leaves a
+            # suffix of the iovec; blocking sockets never return 0)
+            while n:
+                head = bufs[0]
+                if n >= head.nbytes:
+                    n -= head.nbytes
+                    bufs.pop(0)
+                else:
+                    bufs[0] = head[n:]
+                    n = 0
+    else:  # pragma: no cover - every target platform has sendmsg
+        for v in bufs:
+            sock.sendall(v)
+    spc.record("tcp_bytes_sent", total + _LEN.size)
+    return total + _LEN.size
+
+
+def _recv_exact_into(sock: socket.socket, n: int,
+                     idle_retry: bool = False) -> bytearray | None:
+    """Read exactly n bytes into ONE preallocated writable buffer via
+    ``recv_into`` — no accumulate-then-copy; the returned bytearray is
+    dedicated to this frame, so dss.unpack_from may alias it."""
+    buf = bytearray(n)
+    if n == 0:
+        return buf
+    view = memoryview(buf)
+    got = 0
+    while got < n:
         try:
-            chunk = sock.recv(n - len(buf))
+            k = sock.recv_into(view[got:])
         except socket.timeout:
-            if idle_retry and not buf:
+            if idle_retry and got == 0:
                 # a QUIET connection is not a dead one: the drain's
                 # steady state must outlive any socket timeout.  A
                 # timeout with PARTIAL bytes read still raises — a peer
                 # wedged mid-frame would desync the length framing.
                 continue
             raise
-        if not chunk:
+        if not k:
             return None
-        buf.extend(chunk)
-    return bytes(buf)
+        got += k
+    return buf
 
 
 def _recv_frame(sock: socket.socket,
-                idle_retry: bool = False) -> bytes | None:
-    header = _recv_exact(sock, _LEN.size, idle_retry=idle_retry)
+                idle_retry: bool = False) -> bytearray | None:
+    header = _recv_exact_into(sock, _LEN.size, idle_retry=idle_retry)
     if header is None:
         return None
     (length,) = _LEN.unpack(header)
-    return _recv_exact(sock, length)
+    body = _recv_exact_into(sock, length)
+    if body is not None:
+        spc.record("tcp_bytes_recvd", length + _LEN.size)
+    return body
 
 
 class _Backoff:
@@ -147,6 +222,133 @@ class _Backoff:
             max(0.0, self.stop_at - time.monotonic()),
         ))
         self.delay = min(self.delay * 2, self.CAP)
+
+
+class _LoopbackFallback(Exception):
+    """Payload type outside the fast-copy universe: take the full
+    serialize/deserialize cycle (which also owns the error surface for
+    unpackable types)."""
+
+
+def _loopback_copy(obj: Any, _depth: int = 0):
+    """Single defensive copy for rank-to-self delivery, with the SAME
+    type mapping the DSS round trip applies (tuple stays tuple,
+    bytearray lands as bytes, numpy scalars as 0-d arrays) — the
+    receiver must see the pre-mutation value even if the sender reuses
+    its buffer immediately, but nothing needs to be serialized to
+    cross a process boundary that isn't there."""
+    if obj is None or isinstance(obj, (bool, str, bytes)):
+        return obj  # immutable: by-reference IS value semantics
+    if isinstance(obj, float):
+        # np.float64 subclasses float and DSS delivers it as plain float
+        return obj if type(obj) is float else float(obj)
+    if isinstance(obj, int):
+        return obj if type(obj) is int else int(obj)  # IntEnum et al.
+    if isinstance(obj, bytearray):
+        return bytes(obj)
+    if isinstance(obj, np.ndarray):
+        # ascontiguousarray already materializes a fresh array for
+        # non-contiguous input — exactly one copy either way
+        return np.ascontiguousarray(obj) \
+            if not obj.flags.c_contiguous else obj.copy()
+    if isinstance(obj, np.generic):
+        return np.asarray(obj).copy()
+    if _depth >= 16:
+        raise _LoopbackFallback  # absurd nesting: let dss arbitrate
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_loopback_copy(o, _depth + 1) for o in obj)
+    if isinstance(obj, dict):
+        return {
+            _loopback_copy(k, _depth + 1): _loopback_copy(v, _depth + 1)
+            for k, v in obj.items()
+        }
+    raise _LoopbackFallback
+
+
+class _PushPool:
+    """Bounded rendezvous-push executor: CTS-released bulk pushes queue
+    here instead of spawning one thread per transfer, so a burst of
+    large sends cannot grow the thread count without bound (the
+    reference bounds its rndv pipeline by the send-request freelist).
+    Workers start lazily up to the cap and exit at close()."""
+
+    def __init__(self, name: str, max_workers: int):
+        self._q: queue.Queue = queue.Queue()
+        self._threads: list[threading.Thread] = []
+        self._lock = threading.Lock()
+        self._idle = 0
+        self._closed = False
+        self._name = name
+        self._max = max(1, max_workers)
+
+    def submit(self, fn) -> None:
+        with self._lock:
+            if self._closed:
+                # post-close CTS (late-matching peer): a one-shot thread
+                # completes the transfer — TRACKED, so the leak gate
+                # still sees it if it wedges on a dead peer
+                t = threading.Thread(
+                    target=fn, daemon=True, name=f"{self._name}-late"
+                )
+                self._threads.append(t)
+                t.start()
+                return
+            self._q.put(fn)
+            if self._idle == 0 and len(self._threads) < self._max:
+                t = threading.Thread(
+                    target=self._worker, daemon=True,
+                    name=f"{self._name}-{len(self._threads)}",
+                )
+                self._threads.append(t)
+                t.start()
+
+    def _worker(self) -> None:
+        while True:
+            with self._lock:
+                self._idle += 1
+            fn = self._q.get()  # blocking; close() wakes via sentinel
+            with self._lock:
+                self._idle -= 1
+            if fn is None:
+                return  # close() sentinel
+            try:
+                fn()
+            except Exception:  # noqa: BLE001 - push_data logs its own
+                pass
+
+    def close(self, timeout: float) -> None:
+        with self._lock:
+            first = not self._closed
+            self._closed = True
+            threads = list(self._threads)
+        if first:
+            # one sentinel per worker: each consumes exactly one and
+            # exits once the queued pushes ahead of it drain
+            for t in threads:
+                if t.is_alive():
+                    self._q.put(None)
+        deadline = time.monotonic() + timeout
+        for t in threads:
+            t.join(max(0.0, deadline - time.monotonic()))
+
+    def alive_threads(self) -> list[threading.Thread]:
+        with self._lock:
+            return [t for t in self._threads if t.is_alive()]
+
+
+# every proc's pool, weakly: the conftest leak gate asserts each pool
+# drained at close() without keeping closed procs alive
+_live_push_pools: weakref.WeakSet = weakref.WeakSet()
+
+
+def live_push_threads() -> list[str]:
+    """Names of rendezvous-push worker threads still alive across all
+    (weakly tracked) procs — the test-suite hygiene gate's view."""
+    return [
+        t.name
+        for pool in list(_live_push_pools)
+        for t in pool.alive_threads()
+    ]
 
 
 class TcpProc(errh.HasErrhandler, ulfm.UlfmEndpointAPI, HostCollectives,
@@ -183,8 +385,14 @@ class TcpProc(errh.HasErrhandler, ulfm.UlfmEndpointAPI, HostCollectives,
         self.engine = matching.make_matching_engine()
         self._seq = itertools.count()
         self._rndv_ids = itertools.count(1)
-        self._pending_rndv: dict[int, bytes] = {}  # rndv_id -> data frame
+        # rndv_id -> parked data-frame segments (header + payload copies)
+        self._pending_rndv: dict[int, list] = {}
         self._rndv_lock = threading.Lock()
+        self._push_pool = _PushPool(
+            f"rndv-push-{rank}",
+            int(mca_var.get("tcp_rndv_push_workers", 4)),
+        )
+        _live_push_pools.add(self._push_pool)
         self._drains: list[threading.Thread] = []
         self._drain_lock = threading.Lock()
         self._dup_conns: list[socket.socket] = []  # crossed-connect extras
@@ -254,12 +462,13 @@ class TcpProc(errh.HasErrhandler, ulfm.UlfmEndpointAPI, HostCollectives,
             )
             self._detector.start()
 
-    def _framed_send(self, sock: socket.socket, frame: bytes) -> None:
+    def _framed_send(self, sock: socket.socket, frame) -> None:
         """Frames must not interleave on ONE socket, but independent
         sockets must not serialize behind each other — above all for the
         heartbeat path: a data send blocked on a wedged peer holding a
         global lock would starve this rank's own beats and get it
-        falsely suspected.  Per-socket granularity is the contract."""
+        falsely suspected.  Per-socket granularity is the contract.
+        `frame` is bytes or a segment sequence (vectored framing)."""
         with self._send_lock:
             lock = self._sock_locks.get(sock)
             if lock is None:
@@ -468,6 +677,9 @@ class TcpProc(errh.HasErrhandler, ulfm.UlfmEndpointAPI, HostCollectives,
         if self._detector is not None:
             self._detector.stop(join_timeout=0.0)
         self._closed.set()
+        # a crash abandons its pushes: mark the pool closed so idle
+        # workers exit (the hygiene gate counts worker threads)
+        self._push_pool.close(0.0)
         try:
             self._listener.close()
         except OSError:
@@ -624,7 +836,10 @@ class TcpProc(errh.HasErrhandler, ulfm.UlfmEndpointAPI, HostCollectives,
                 return
             if frame is None:
                 return
-            [src, tag, cid, seq, payload] = dss.unpack(frame)
+            # unpack_from: array payloads become writable views over the
+            # frame's dedicated recv_into buffer — the zero-copy receive
+            # half (the frame bytearray stays alive via the views)
+            [src, tag, cid, seq, payload] = dss.unpack_from(frame)
             if self.ft_state is not None and cid == ulfm.FT_JOIN_CID:
                 # rejoin/re-modex: needs the carrying connection (the
                 # joiner's fresh socket becomes the canonical endpoint)
@@ -639,7 +854,6 @@ class TcpProc(errh.HasErrhandler, ulfm.UlfmEndpointAPI, HostCollectives,
                 self._ft_ctrl(cid, src, payload)
                 continue
             env = Envelope(src, tag, cid, seq)
-            spc.record("tcp_bytes_recvd", len(frame))
             try:
                 with self._incoming_cv:
                     self.engine.incoming(env, payload)
@@ -767,10 +981,16 @@ class TcpProc(errh.HasErrhandler, ulfm.UlfmEndpointAPI, HostCollectives,
         """Send to a remote-group rank across a bridge; frames carry the
         bridge cid so matching stays isolated from in-group traffic."""
         seq = next(self._seq)
-        frame = dss.pack(self.rank, tag, cid, seq, obj)
-        spc.record("tcp_bytes_sent", len(frame))
+        header, oob = dss.pack_frames(
+            self.rank, tag, cid, seq, obj,
+            oob_min=int(mca_var.get("tcp_zero_copy_min", 0)),
+        )
         sock = self.bridge_endpoint(cid, dest, addr)
-        self._framed_send(sock, frame)
+        self._framed_send(sock, [header, *oob])
+        if oob:
+            spc.record("tcp_zero_copy_sends", 1)
+            spc.record("tcp_copy_bytes_avoided",
+                       sum(v.nbytes for v in oob))
 
     # -- MPI surface (RankContext-compatible) ----------------------------
 
@@ -803,12 +1023,21 @@ class TcpProc(errh.HasErrhandler, ulfm.UlfmEndpointAPI, HostCollectives,
             return self.call_errhandler(exc)
         seq = next(self._seq)
         if dest == self.rank:
-            frame = dss.pack(self.rank, tag, cid, seq, obj)
-            spc.record("tcp_bytes_sent", len(frame))
-            # loopback: the DSS round-trip is the eager buffer copy
+            # loopback shortcut (btl/self): ONE defensive copy with the
+            # DSS type mapping instead of the full serialize/deserialize
+            # round trip — the receiver still sees the pre-mutation
+            # value if the sender reuses its buffer immediately
+            nbytes = _payload_size(obj)
+            try:
+                payload = _loopback_copy(obj)
+                spc.record("tcp_loopback_fast_deliveries", 1)
+                spc.record("tcp_copy_bytes_avoided", nbytes)
+            except _LoopbackFallback:
+                frame = dss.pack(self.rank, tag, cid, seq, obj)
+                payload = dss.unpack(frame)[4]
             env = Envelope(self.rank, tag, cid, seq)
             with self._incoming_cv:
-                self.engine.incoming(env, dss.unpack(frame)[4])
+                self.engine.incoming(env, payload)
                 self._incoming_cv.notify_all()
             return
         nbytes = _payload_size(obj)
@@ -817,10 +1046,20 @@ class TcpProc(errh.HasErrhandler, ulfm.UlfmEndpointAPI, HostCollectives,
             if nbytes > limit:
                 self._send_rndv(obj, dest, tag, cid, seq, nbytes)
                 return
-            frame = dss.pack(self.rank, tag, cid, seq, obj)
-            spc.record("tcp_bytes_sent", len(frame))
+            # eager zero-copy: array/bytes payloads leave as out-of-band
+            # memoryview segments of the CALLER's buffers, gathered by
+            # sendmsg — the blocking send completes only after the
+            # kernel has the bytes, so buffer reuse stays safe
+            header, oob = dss.pack_frames(
+                self.rank, tag, cid, seq, obj,
+                oob_min=int(mca_var.get("tcp_zero_copy_min", 0)),
+            )
             sock = self._endpoint(dest)
-            self._framed_send(sock, frame)
+            self._framed_send(sock, [header, *oob])
+            if oob:
+                spc.record("tcp_zero_copy_sends", 1)
+                spc.record("tcp_copy_bytes_avoided",
+                           sum(v.nbytes for v in oob))
         except errors.ProcFailed as exc:
             # peer death classified by the endpoint layer: route through
             # the attached disposition (FATAL aborts, RETURN raises typed)
@@ -852,34 +1091,46 @@ class TcpProc(errh.HasErrhandler, ulfm.UlfmEndpointAPI, HostCollectives,
         carrying the envelope; the receiver's CTS — handled in the drain
         thread — releases the data on a dedicated (rndv_id, cid) channel."""
         rndv_id = next(self._rndv_ids)
-        data_frame = dss.pack(self.rank, rndv_id, _RNDV_DATA_CID, seq, obj)
+        # serialize NOW (buffer-reuse contract: the caller may mutate the
+        # moment send() returns) — but as parked SEGMENTS: the header
+        # stream plus one defensive copy per raw payload block, pushed
+        # vectored later.  One copy total, vs pack's tobytes + the old
+        # header+body reassembly; the receive side stays zero-copy.
+        header, oob = dss.pack_frames(
+            self.rank, rndv_id, _RNDV_DATA_CID, seq, obj,
+            oob_min=int(mca_var.get("tcp_zero_copy_min", 0)),
+        )
+        segments = [header] + [bytes(v) for v in oob]
         with self._rndv_lock:
-            self._pending_rndv[rndv_id] = data_frame
+            self._pending_rndv[rndv_id] = segments
         spc.record("tcp_rndv_sends", 1)
+        if oob:
+            spc.record("tcp_zero_copy_sends", 1)
+            spc.record("tcp_copy_bytes_avoided",
+                       sum(v.nbytes for v in oob))
 
         def push_data():
-            # Runs on its OWN thread over its OWN socket: the drain must
-            # keep reading while this sendall blocks (drain stuck in a
-            # writer = bidirectional deadlock), and the bulk write must
-            # not hold the control socket's framing lock — a tiny CTS
-            # queued behind a multi-MB sendall re-creates the same
+            # Runs on a push-pool worker over its OWN socket: the drain
+            # must keep reading while this sendall blocks (drain stuck
+            # in a writer = bidirectional deadlock), and the bulk write
+            # must not hold the control socket's framing lock — a tiny
+            # CTS queued behind a multi-MB sendall re-creates the same
             # deadlock one level up.  A dedicated per-transfer data
             # connection (hello ["d"]) keeps bulk and control planes
             # independent, the reason ob1 separates its channels.
             data_sock = None
             try:
                 with self._rndv_lock:
-                    frame = self._pending_rndv.get(rndv_id)
-                if frame is None:
+                    frame_segs = self._pending_rndv.get(rndv_id)
+                if frame_segs is None:
                     return
-                spc.record("tcp_bytes_sent", len(frame))
                 data_sock = socket.socket(
                     socket.AF_INET, socket.SOCK_STREAM
                 )
                 data_sock.settimeout(self._timeout)
                 data_sock.connect(tuple(self.address_book[dest][:2]))
                 _send_frame(data_sock, dss.pack(["d"]))
-                _send_frame(data_sock, frame)
+                _send_frame(data_sock, frame_segs)
             except OSError as e:
                 mca_output.emit(
                     _stream,
@@ -898,9 +1149,7 @@ class TcpProc(errh.HasErrhandler, ulfm.UlfmEndpointAPI, HostCollectives,
                     self._pending_rndv.pop(rndv_id, None)
 
         def on_cts(_env, _payload):
-            t = threading.Thread(target=push_data, daemon=True)
-            self._track_thread(t)  # joined by close() like the readers
-            t.start()
+            self._push_pool.submit(push_data)
 
         with self._incoming_cv:
             self.engine.post_recv(dest, rndv_id, _RNDV_CTS_CID, on_cts)
@@ -1178,6 +1427,11 @@ class TcpProc(errh.HasErrhandler, ulfm.UlfmEndpointAPI, HostCollectives,
             drains = list(self._drains)
         for t in drains:
             t.join(max(0.0, deadline - time.monotonic()))
+        # the rendezvous-push pool drains with the proc: the quiesce loop
+        # above already waited out pending transfers, so workers are idle
+        # (or wedged on a dead peer, bounded by the join deadline) — the
+        # conftest leak gate asserts none survive
+        self._push_pool.close(max(0.0, deadline - time.monotonic()))
         try:
             self._listener.close()
         except OSError:

@@ -8,6 +8,23 @@ Semantics note for a traced runtime: counters record **host-side events** —
 under ``jit`` a collective is counted when traced (compiled), not per device
 execution.  Eager calls count per call.  This is the honest analog on a
 compile-once machine and is documented at the CLI.
+
+Wire-plane counters (recorded by ``pt2pt/tcp.py``):
+
+- ``tcp_bytes_sent`` / ``tcp_bytes_recvd`` — ACTUAL on-wire bytes: every
+  length-framed message including its 4-byte header — eager frames,
+  rendezvous RTS/CTS/data, FT heartbeats/notices, modex and hello frames.
+  (Loopback rank-to-self delivery never hits the wire and is NOT counted.)
+- ``tcp_zero_copy_sends`` — sends whose array/bytes payload left as
+  out-of-band segments (``dss.pack_frames`` + vectored ``sendmsg``, with
+  a zero-copy ``recv_into``/``unpack_from`` receive).  Eager sends copy
+  nothing; rendezvous sends park ONE defensive copy (buffer-reuse
+  contract) but skip the serialize/reassemble/receive copies.
+- ``tcp_copy_bytes_avoided`` — payload bytes that skipped the pack-side
+  serialization copy (OOB segment bytes, plus loopback payload bytes).
+- ``tcp_loopback_fast_deliveries`` — rank-to-self sends delivered by the
+  single-defensive-copy shortcut instead of a full DSS round trip.
+- ``tcp_rndv_sends`` — rendezvous (RTS/CTS) transfers initiated.
 """
 
 from __future__ import annotations

@@ -11,6 +11,20 @@ Format: one type byte, then a varint length where needed, then the
 payload; containers recurse.  Little-endian fixed-width scalars (the
 reference's heterogeneous-arch conversion lives in the datatype engine's
 external32 path, not here).
+
+Zero-copy frame path (the btl-style "send the buffer, not a copy of it"
+contract): :func:`pack_frames` splits a frame into a self-describing
+header stream plus out-of-band raw buffer segments — contiguous
+ndarray/bytes payloads are referenced as memoryviews, never
+``tobytes()``-copied.  On the wire the frame is simply the header
+followed by the segments in order, so ``header + b"".join(segments)`` is
+a valid :func:`unpack` stream: the OOB tags carry the payload's offset
+from the END of the frame, patched into the header once every segment's
+size is known.  A legacy :func:`pack` stream contains no OOB tags and is
+therefore the degenerate case of the same format — mixed old/new frames
+round-trip through one parser.  :func:`unpack_from` additionally builds
+arrays as views OVER a writable receive buffer (``recv_into`` target)
+instead of copying them out.
 """
 
 from __future__ import annotations
@@ -32,6 +46,17 @@ _T_LIST = 6
 _T_TUPLE = 7
 _T_DICT = 8
 _T_NDARRAY = 9
+# out-of-band twins: the header carries dtype/shape/nbytes plus an 8-byte
+# offset-from-frame-end; the raw payload travels as a trailing segment
+_T_NDARRAY_OOB = 10
+_T_BYTES_OOB = 11
+
+_OFE = struct.Struct("<Q")  # offset-from-end slot, patched post-pack
+
+# bytes/bytearray below this stay inline even on the frame path: their
+# unpack must copy anyway (``bytes`` is immutable), so OOB only saves the
+# pack-side copy — worth it for bulk blobs, not for tag strings
+_BYTES_OOB_MIN = 4096
 
 
 def _pack_varint(n: int, out: bytearray) -> None:
@@ -113,7 +138,30 @@ def _pack_one(obj: Any, out: bytearray) -> None:
         )
 
 
-def _unpack_one(buf: memoryview, pos: int) -> tuple[Any, int]:
+class _UnpackCtx:
+    """Per-stream unpack state: ``copy`` forces fresh writable arrays
+    (legacy semantics); ``oob`` accumulates trailing out-of-band bytes
+    consumed, so the final truncation check still balances."""
+
+    __slots__ = ("copy", "oob")
+
+    def __init__(self, copy: bool):
+        self.copy = copy
+        self.oob = 0
+
+
+def _ndarray_from(buf: memoryview, dt: np.dtype, shape: list[int],
+                  ctx: _UnpackCtx) -> np.ndarray:
+    """Array over a region of the frame buffer: a VIEW when the caller
+    allows it (writable recv buffer), else one fresh writable copy."""
+    arr = np.frombuffer(buf, dtype=dt).reshape(shape)
+    if ctx.copy or not arr.flags.writeable:
+        arr = arr.copy()
+    return arr
+
+
+def _unpack_one(buf: memoryview, pos: int,
+                ctx: _UnpackCtx) -> tuple[Any, int]:
     t = buf[pos]
     pos += 1
     if t == _T_NONE:
@@ -142,28 +190,125 @@ def _unpack_one(buf: memoryview, pos: int) -> tuple[Any, int]:
             d, pos = _unpack_varint(buf, pos)
             shape.append(d)
         nbytes, pos = _unpack_varint(buf, pos)
-        # copy: frombuffer over bytes yields a read-only array, which would
-        # diverge from the writable copies the thread universe delivers
-        arr = np.frombuffer(
-            bytes(buf[pos : pos + nbytes]), dtype=dt
-        ).reshape(shape).copy()
+        arr = _ndarray_from(buf[pos : pos + nbytes], dt, shape, ctx)
         return arr, pos + nbytes
+    if t == _T_NDARRAY_OOB:
+        n, pos = _unpack_varint(buf, pos)
+        dt = np.dtype(bytes(buf[pos : pos + n]).decode("ascii"))
+        pos += n
+        ndim, pos = _unpack_varint(buf, pos)
+        shape = []
+        for _ in range(ndim):
+            d, pos = _unpack_varint(buf, pos)
+            shape.append(d)
+        nbytes, pos = _unpack_varint(buf, pos)
+        (ofe,) = _OFE.unpack_from(buf, pos)
+        pos += _OFE.size
+        start = len(buf) - ofe
+        if start < 0 or start + nbytes > len(buf):
+            raise errors.TruncateError(
+                f"dss: out-of-band segment [{start}:{start + nbytes}] "
+                f"outside frame of {len(buf)} bytes"
+            )
+        ctx.oob += nbytes
+        return _ndarray_from(buf[start : start + nbytes], dt, shape,
+                             ctx), pos
+    if t == _T_BYTES_OOB:
+        nbytes, pos = _unpack_varint(buf, pos)
+        (ofe,) = _OFE.unpack_from(buf, pos)
+        pos += _OFE.size
+        start = len(buf) - ofe
+        if start < 0 or start + nbytes > len(buf):
+            raise errors.TruncateError(
+                f"dss: out-of-band segment [{start}:{start + nbytes}] "
+                f"outside frame of {len(buf)} bytes"
+            )
+        ctx.oob += nbytes
+        return bytes(buf[start : start + nbytes]), pos
     if t in (_T_LIST, _T_TUPLE):
         n, pos = _unpack_varint(buf, pos)
         items = []
         for _ in range(n):
-            item, pos = _unpack_one(buf, pos)
+            item, pos = _unpack_one(buf, pos, ctx)
             items.append(item)
         return (items if t == _T_LIST else tuple(items)), pos
     if t == _T_DICT:
         n, pos = _unpack_varint(buf, pos)
         d = {}
         for _ in range(n):
-            k, pos = _unpack_one(buf, pos)
-            v, pos = _unpack_one(buf, pos)
+            k, pos = _unpack_one(buf, pos, ctx)
+            v, pos = _unpack_one(buf, pos, ctx)
             d[k] = v
         return d, pos
     raise errors.TypeError_(f"dss: unknown type tag {t}")
+
+
+def _oob_view(obj: Any) -> memoryview | None:
+    """Flat byte view of a buffer-exporting object, or None when the
+    buffer protocol declines (e.g. datetime64 arrays) — callers fall
+    back to the inline copy path."""
+    try:
+        return memoryview(obj).cast("B")
+    except (ValueError, TypeError, BufferError):
+        return None
+
+
+def _pack_one_frames(obj: Any, out: bytearray, segs: list[memoryview],
+                     slots: list[int], oob_min: int) -> None:
+    """Like :func:`_pack_one`, but contiguous ndarray/bytes payloads —
+    at any container depth — emit an OOB tag and append a memoryview
+    segment instead of copying their raw bytes into the header."""
+    if obj is None or isinstance(obj, (bool, int, float, str)):
+        # scalar precedence must mirror _pack_one exactly: np.float64 IS
+        # a float subclass and must stay a _T_FLOAT, not a 0-d array
+        _pack_one(obj, out)
+        return
+    if isinstance(obj, np.ndarray):
+        nbytes = int(obj.nbytes)
+        if nbytes > 0 and nbytes >= oob_min and obj.flags.c_contiguous:
+            view = _oob_view(obj)
+            if view is not None:
+                out.append(_T_NDARRAY_OOB)
+                dt = obj.dtype.str.encode("ascii")
+                _pack_varint(len(dt), out)
+                out.extend(dt)
+                _pack_varint(obj.ndim, out)
+                for d in obj.shape:
+                    _pack_varint(d, out)
+                _pack_varint(nbytes, out)
+                slots.append(len(out))
+                out.extend(b"\x00" * _OFE.size)
+                segs.append(view)
+                return
+        _pack_one(obj, out)
+    elif isinstance(obj, (bytes, bytearray)):
+        n = len(obj)
+        if n >= max(oob_min, _BYTES_OOB_MIN):
+            out.append(_T_BYTES_OOB)
+            _pack_varint(n, out)
+            slots.append(len(out))
+            out.extend(b"\x00" * _OFE.size)
+            segs.append(memoryview(obj))
+            return
+        _pack_one(obj, out)
+    elif isinstance(obj, (list, tuple)):
+        out.append(_T_LIST if isinstance(obj, list) else _T_TUPLE)
+        _pack_varint(len(obj), out)
+        for item in obj:
+            _pack_one_frames(item, out, segs, slots, oob_min)
+    elif isinstance(obj, dict):
+        out.append(_T_DICT)
+        _pack_varint(len(obj), out)
+        for k, v in obj.items():
+            _pack_one_frames(k, out, segs, slots, oob_min)
+            _pack_one_frames(v, out, segs, slots, oob_min)
+    elif isinstance(obj, np.generic):
+        # numpy scalar: as a 0-d array so the dtype survives (and rides
+        # OOB when big enough — np.float64 payloads are the ULFM
+        # agreement currency)
+        _pack_one_frames(np.asarray(obj), out, segs, slots, oob_min)
+    else:
+        _pack_one(obj, out)
 
 
 def pack(*objs: Any) -> bytes:
@@ -175,16 +320,62 @@ def pack(*objs: Any) -> bytes:
     return bytes(out)
 
 
-def unpack(data: bytes) -> list[Any]:
-    """Unpack every value from a buffer (opal_dss.unpack)."""
-    buf = memoryview(data)
+def pack_frames(*objs: Any, oob_min: int = 0
+                ) -> tuple[bytes, list[memoryview]]:
+    """Pack values into a header stream plus out-of-band raw segments.
+
+    Returns ``(header, segments)`` where the on-wire frame is the
+    concatenation ``header + seg0 + seg1 + ...`` — a valid
+    :func:`unpack`/:func:`unpack_from` stream.  Contiguous
+    ndarray/bytes payloads of at least ``oob_min`` bytes are referenced
+    as memoryviews of the CALLER's buffers: nothing is copied here, so
+    the caller must keep those buffers unmutated until the segments are
+    consumed (a blocking ``sendall``/``sendmsg`` satisfies this by
+    construction).  Everything else — and a frame with no qualifying
+    payload — degenerates to the legacy inline encoding."""
+    out = bytearray()
+    segs: list[memoryview] = []
+    slots: list[int] = []
+    _pack_varint(len(objs), out)
+    for obj in objs:
+        _pack_one_frames(obj, out, segs, slots, oob_min)
+    # patch the offset-from-end slots now every segment size is known:
+    # segment i starts (total_tail - prefix_i) bytes before frame end
+    total = sum(s.nbytes for s in segs)
+    prefix = 0
+    for slot, seg in zip(slots, segs):
+        _OFE.pack_into(out, slot, total - prefix)
+        prefix += seg.nbytes
+    return bytes(out), segs
+
+
+def _unpack(buf: memoryview, copy: bool) -> list[Any]:
+    ctx = _UnpackCtx(copy=copy)
     n, pos = _unpack_varint(buf, 0)
     out = []
     for _ in range(n):
-        obj, pos = _unpack_one(buf, pos)
+        obj, pos = _unpack_one(buf, pos, ctx)
         out.append(obj)
-    if pos != len(buf):
+    if pos + ctx.oob != len(buf):
         raise errors.TruncateError(
-            f"dss: {len(buf) - pos} trailing bytes after unpack"
+            f"dss: {len(buf) - pos - ctx.oob} trailing bytes after unpack"
         )
     return out
+
+
+def unpack(data) -> list[Any]:
+    """Unpack every value from a buffer (opal_dss.unpack).  Arrays come
+    back as fresh writable copies regardless of the buffer's nature —
+    the legacy contract every existing caller holds."""
+    return _unpack(memoryview(data), copy=True)
+
+
+def unpack_from(data) -> list[Any]:
+    """Unpack a frame, building arrays as writable VIEWS over ``data``
+    when it is a writable buffer (the ``recv_into`` bytearray of the
+    zero-copy receive path) — no per-array copy.  The caller must
+    dedicate the buffer to this frame: the views keep it alive and
+    alias its storage.  Read-only buffers degrade to :func:`unpack`'s
+    copy semantics, so delivered arrays are ALWAYS writable."""
+    buf = memoryview(data)
+    return _unpack(buf, copy=False)
