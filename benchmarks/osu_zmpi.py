@@ -25,6 +25,18 @@ framing matters most.  ``--plane host`` runs the collective over REAL
 loopback sockets through coll/host (the DCN leg), instead of the
 device-plane XLA collectives.
 
+``--plane sm`` measures the shared-memory plane: same-host ranks with
+the mmap-ring transport selected (``pt2pt/sm.py``) — pt2pt
+latency/bandwidth and the host collectives both, failing loudly if any
+send silently fell back to TCP (``sm_fallback_tcp_sends`` must stay 0
+along the ladder).  ``--real-procs`` runs the ranks as separate OS
+processes (the cross-process case the ring exists for; the default
+thread harness shares one GIL and understates the win)::
+
+    python -m benchmarks.osu_zmpi --op tcp --plane sm --real-procs
+    python -m benchmarks.osu_zmpi --op tcp --plane sm --bw --real-procs
+    python -m benchmarks.osu_zmpi --op allreduce --plane sm --nprocs 4
+
 On a CPU host this exercises the 8-virtual-device loopback mesh (the
 btl/self+sm analog); on TPU hardware the same sweep rides ICI.
 """
@@ -177,10 +189,13 @@ def bench_pt2pt(max_size: int = 4 << 20, iters: int = 50,
     return rows
 
 
-def _run_tcp_ranks(n: int, fn, timeout: float = 180.0) -> list:
+def _run_tcp_ranks(n: int, fn, timeout: float = 180.0,
+                   sm: bool | None = None) -> list:
     """Launch fn(proc) on n TcpProc ranks over localhost sockets; rank 0
     binds an ephemeral coordinator the others learn through the
-    on_coordinator_bound hook (prte forwarding the PMIx URI)."""
+    on_coordinator_bound hook (prte forwarding the PMIx URI).  ``sm``
+    pins the shared-memory transport on/off per proc (None = MCA
+    default)."""
     import threading
 
     from zhpe_ompi_tpu.pt2pt.tcp import TcpProc
@@ -194,14 +209,15 @@ def _run_tcp_ranks(n: int, fn, timeout: float = 180.0) -> list:
         try:
             if rank == 0:
                 proc = TcpProc(
-                    0, n, coordinator=("127.0.0.1", 0),
+                    0, n, coordinator=("127.0.0.1", 0), sm=sm,
                     on_coordinator_bound=lambda addr: (
                         coord.append(addr), coord_ready.set()),
                 )
             else:
                 if not coord_ready.wait(30.0) or not coord:
                     return  # rank 0 failed; its error is in excs[0]
-                proc = TcpProc(rank, n, coordinator=tuple(coord[0]))
+                proc = TcpProc(rank, n, coordinator=tuple(coord[0]),
+                               sm=sm)
             try:
                 results[rank] = fn(proc)
             finally:
@@ -221,55 +237,81 @@ def _run_tcp_ranks(n: int, fn, timeout: float = 180.0) -> list:
     return results
 
 
-def bench_tcp(max_size: int = 4 << 20, iters: int = 50,
-              bw: bool = False, window: int = 16) -> list[dict]:
-    """REAL-socket pt2pt (over btl/tcp): two TcpProc endpoints over
-    loopback, eager and rendezvous regimes both crossed as the ladder
-    passes tcp_eager_limit.  Default: ping-pong latency (osu_latency).
-    ``bw=True``: multi-frame in-flight bandwidth (osu_bw — `window`
-    frames streamed per ack, so TCP keeps its pipe full)."""
+def _pingpong(proc, payload, iters: int):
+    """osu_latency body over one endpoint pair: rank 0 returns seconds
+    per round trip, rank 1 echoes."""
+    if proc.rank == 0:
+        proc.send(payload, dest=1, tag=1)
+        proc.recv(source=1, tag=2, timeout=120.0)
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            proc.send(payload, dest=1, tag=1)
+            proc.recv(source=1, tag=2, timeout=120.0)
+        return (time.perf_counter() - t0) / iters
+    proc.recv(source=0, tag=1, timeout=120.0)
+    proc.send(payload, dest=0, tag=2)
+    for _ in range(iters):
+        proc.recv(source=0, tag=1, timeout=120.0)
+        proc.send(payload, dest=0, tag=2)
+    return None
+
+
+def _stream(proc, payload, iters: int, window: int):
+    """osu_bw body: `window` frames in flight per ack; rank 0 returns
+    seconds per one-way message amortized over the window."""
+    reps = max(1, iters // 4)
+    if proc.rank == 0:
+        for _ in range(window):
+            proc.send(payload, dest=1, tag=1)
+        proc.recv(source=1, tag=2, timeout=120.0)  # warmup window + ack
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            for _ in range(window):
+                proc.send(payload, dest=1, tag=1)
+            proc.recv(source=1, tag=2, timeout=120.0)
+        return (time.perf_counter() - t0) / (reps * window)
+    for _ in range(reps + 1):
+        for _ in range(window):
+            proc.recv(source=0, tag=1, timeout=120.0)
+        proc.send(b"ack", dest=0, tag=2)
+    return None
+
+
+def _pt2pt_ladder(max_size: int, iters: int, bw: bool, window: int,
+                  sm: bool) -> list[dict]:
+    """One size ladder over a TcpProc pair in the thread harness —
+    shared by the tcp and sm planes; the sm run adds the
+    loud-degradation gate (no silent TCP fallback, bytes must cross
+    the rings at every rung)."""
+    from zhpe_ompi_tpu.runtime import spc
+
     rows = []
+    op = ("sm_" if sm else "tcp_") + ("bw" if bw else "pingpong")
     for nbytes in _sizes(max_size):
         payload = np.zeros(max(1, nbytes // 8), dtype=np.float64)
+        fb0 = spc.read("sm_fallback_tcp_sends")
+        sent0 = spc.read("sm_bytes_sent")
 
-        def pingpong(proc, payload=payload):
-            if proc.rank == 0:
-                proc.send(payload, dest=1, tag=1)
-                proc.recv(source=1, tag=2)
-                t0 = time.perf_counter()
-                for _ in range(iters):
-                    proc.send(payload, dest=1, tag=1)
-                    proc.recv(source=1, tag=2)
-                return (time.perf_counter() - t0) / iters
-            proc.recv(source=0, tag=1)
-            proc.send(payload, dest=0, tag=2)
-            for _ in range(iters):
-                proc.recv(source=0, tag=1)
-                proc.send(payload, dest=0, tag=2)
-            return None
+        def prog(proc, payload=payload):
+            if bw:
+                return _stream(proc, payload, iters, window)
+            return _pingpong(proc, payload, iters)
 
-        def stream(proc, payload=payload):
-            reps = max(1, iters // 4)
-            if proc.rank == 0:
-                for _ in range(window):
-                    proc.send(payload, dest=1, tag=1)
-                proc.recv(source=1, tag=2)  # warmup window + ack
-                t0 = time.perf_counter()
-                for _ in range(reps):
-                    for _ in range(window):
-                        proc.send(payload, dest=1, tag=1)
-                    proc.recv(source=1, tag=2)
-                return (time.perf_counter() - t0) / (reps * window)
-            for _ in range(reps + 1):
-                for _ in range(window):
-                    proc.recv(source=0, tag=1, timeout=120.0)
-                proc.send(b"ack", dest=0, tag=2)
-            return None
-
-        sec = _run_tcp_ranks(2, stream if bw else pingpong)[0]
+        sec = _run_tcp_ranks(2, prog, sm=sm)[0]
+        if sm:
+            if spc.read("sm_fallback_tcp_sends") != fb0:
+                raise RuntimeError(
+                    f"sm plane at {payload.nbytes}B: sends silently "
+                    "fell back to TCP"
+                )
+            if spc.read("sm_bytes_sent") == sent0:
+                raise RuntimeError(
+                    f"sm plane at {payload.nbytes}B: no bytes crossed "
+                    "the rings (selection failed?)"
+                )
         one_way = sec if bw else sec / 2
         rows.append({
-            "op": "tcp_bw" if bw else "tcp_pingpong",
+            "op": op,
             "bytes": payload.nbytes,
             "latency_us": one_way * 1e6,
             "bandwidth_MBps": (payload.nbytes / one_way) / 1e6,
@@ -277,17 +319,208 @@ def bench_tcp(max_size: int = 4 << 20, iters: int = 50,
     return rows
 
 
+def bench_tcp(max_size: int = 4 << 20, iters: int = 50,
+              bw: bool = False, window: int = 16) -> list[dict]:
+    """REAL-socket pt2pt (over btl/tcp): two TcpProc endpoints over
+    loopback, eager and rendezvous regimes both crossed as the ladder
+    passes tcp_eager_limit.  Default: ping-pong latency (osu_latency).
+    ``bw=True``: multi-frame in-flight bandwidth (osu_bw — `window`
+    frames streamed per ack, so TCP keeps its pipe full).  The
+    shared-memory transport is pinned OFF: this op measures the WIRE;
+    use :func:`bench_sm` / ``--plane sm`` for the rings."""
+    return _pt2pt_ladder(max_size, iters, bw, window, sm=False)
+
+
+def bench_sm(max_size: int = 4 << 20, iters: int = 50, bw: bool = False,
+             window: int = 16, real_procs: bool = False) -> list[dict]:
+    """Shared-memory-plane pt2pt: the same OSU shapes as
+    :func:`bench_tcp` with the mmap-ring transport selected, and a
+    LOUD-degradation gate — the ladder fails if any send silently fell
+    back to TCP (``sm_fallback_tcp_sends`` must not move).
+
+    ``real_procs=True`` runs the two ranks as separate OS processes:
+    the cross-process case the ring exists for (thread ranks share one
+    GIL and understate the win)."""
+    if real_procs:
+        return _run_proc_bench({
+            "kind": "pt2pt", "max_size": max_size, "iters": iters,
+            "bw": bw, "window": window,
+        }, nprocs=2)
+    return _pt2pt_ladder(max_size, iters, bw, window, sm=True)
+
+
+# -------------------------------------------- real-process harness
+
+def _worker_main(spec: dict) -> int:
+    """Entry point of a ``--real-procs`` rank (its own interpreter, its
+    own GIL): joins the parent-reserved coordinator port, runs the
+    requested ladder, and — on rank 0 — emits the rows plus the
+    sm-selection counters as one JSON line on stdout."""
+    from zhpe_ompi_tpu.pt2pt.tcp import TcpProc
+    from zhpe_ompi_tpu.runtime import spc
+
+    rank, n = int(spec["rank"]), int(spec["size"])
+    proc = TcpProc(rank, n, coordinator=("127.0.0.1", int(spec["port"])),
+                   timeout=120.0, sm=bool(spec.get("sm", True)))
+    rows = []
+    fb0 = spc.read("sm_fallback_tcp_sends")
+    try:
+        for nbytes in _sizes(int(spec["max_size"]),
+                             int(spec.get("min_bytes", 4))):
+            if spec["kind"] == "pt2pt":
+                payload = np.zeros(max(1, nbytes // 8), np.float64)
+                if spec["bw"]:
+                    sec = _stream(proc, payload, int(spec["iters"]),
+                                  int(spec["window"]))
+                else:
+                    sec = _pingpong(proc, payload, int(spec["iters"]))
+                plane = "sm" if spec.get("sm", True) else "tcp"
+                op = f"{plane}_bw" if spec["bw"] else f"{plane}_pingpong"
+            else:  # host collective
+                from zhpe_ompi_tpu import ops
+
+                payload = np.zeros(max(n, nbytes // 8), np.float64)
+                proc.allreduce(payload, ops.SUM)  # warmup
+                proc.barrier()
+                t0 = time.perf_counter()
+                for _ in range(int(spec["iters"])):
+                    proc.allreduce(payload, ops.SUM)
+                sec = (time.perf_counter() - t0) / int(spec["iters"])
+                op = "sm_host_allreduce"
+            if rank == 0:
+                one_way = sec if spec.get("bw") else (
+                    sec / 2 if spec["kind"] == "pt2pt" else sec)
+                rows.append({
+                    "op": op, "bytes": payload.nbytes,
+                    "latency_us": one_way * 1e6,
+                    "bandwidth_MBps": (payload.nbytes / one_way) / 1e6,
+                })
+            proc.barrier()
+        if rank == 0:
+            print(json.dumps({
+                "rows": rows,
+                "sm_fallback": spc.read("sm_fallback_tcp_sends") - fb0,
+                "sm_bytes_sent": spc.read("sm_bytes_sent"),
+            }), flush=True)
+    finally:
+        proc.close()
+    return 0
+
+
+def _run_proc_bench(spec: dict, nprocs: int) -> list[dict]:
+    """Spawn `nprocs` worker interpreters sharing a fixed coordinator
+    port, parse rank 0's JSON report, and enforce the sm-selection
+    gate across REAL process boundaries.  The ephemeral port is
+    reserved by bind-then-close, so another process can steal it
+    before rank 0 re-binds (TOCTOU) — a bind failure retries the whole
+    launch on a fresh port."""
+    last_exc: Exception | None = None
+    for _attempt in range(3):
+        try:
+            return _run_proc_bench_once(spec, nprocs)
+        except RuntimeError as e:
+            if "Address already in use" not in str(e):
+                raise
+            last_exc = e
+    raise last_exc
+
+
+def _run_proc_bench_once(spec: dict, nprocs: int) -> list[dict]:
+    import os
+    import socket
+    import subprocess
+    import sys
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    import threading
+
+    procs = []
+    try:
+        for rank in range(nprocs):
+            wspec = dict(spec, rank=rank, size=nprocs, port=port)
+            procs.append(subprocess.Popen(
+                [sys.executable, "-m", "benchmarks.osu_zmpi",
+                 "--_worker", json.dumps(wspec)],
+                env=env, cwd=repo, stdout=subprocess.PIPE,
+                stderr=subprocess.PIPE, text=True,
+            ))
+        # drain every worker CONCURRENTLY: a worker blocked writing a
+        # full stderr pipe (verbose streams, a long traceback) stops
+        # answering the benchmark and wedges the whole ladder if the
+        # parent reads the ranks one at a time
+        outs: list = [None] * nprocs
+        errs: list = [None] * nprocs
+
+        def drain(rank, p):
+            try:
+                outs[rank], errs[rank] = p.communicate(timeout=900)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                outs[rank], errs[rank] = p.communicate()
+        threads = [threading.Thread(target=drain, args=(r, p))
+                   for r, p in enumerate(procs)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        for rank, p in enumerate(procs):
+            if p.returncode != 0:
+                raise RuntimeError(
+                    f"sm bench worker rank {rank} failed:\n"
+                    f"{errs[rank]}\n{outs[rank]}"
+                )
+    finally:
+        for p in procs:  # no orphan interpreters (nor their segments)
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+    report = json.loads(outs[0].strip().splitlines()[-1])
+    if not spec.get("sm", True):
+        return report["rows"]  # tcp baseline run: no selection gate
+    if report["sm_fallback"]:
+        raise RuntimeError(
+            f"sm plane: {report['sm_fallback']} sends silently fell "
+            "back to TCP across the real-process ladder"
+        )
+    if report["sm_bytes_sent"] == 0:
+        raise RuntimeError(
+            "sm plane: no bytes crossed the rings across real "
+            "processes (selection failed?)"
+        )
+    return report["rows"]
+
+
 def bench_host_coll(opname: str = "allreduce", algorithm: str = "auto",
                     max_size: int = 4 << 20, iters: int = 5,
-                    nprocs: int = 4) -> list[dict]:
+                    nprocs: int = 4, sm: bool | None = False,
+                    real_procs: bool = False) -> list[dict]:
     """Host-plane collective over REAL loopback sockets: `nprocs`
     TcpProc ranks running the coll/host algorithms (ring allreduce,
     pipeline bcast, pairwise alltoall ... the DCN leg of multi-host
     training).  ``algorithm`` pins the host algorithm MCA var where one
     exists; 'ring' for allreduce means crossing host_coll_large_msg so
-    the bandwidth-optimal ring path is selected."""
+    the bandwidth-optimal ring path is selected.  ``sm`` pins the
+    shared-memory transport per proc (True = the collectives ride the
+    mmap rings, with the loud-degradation gate); ``real_procs`` runs
+    the allreduce ladder over separate OS processes instead."""
     from zhpe_ompi_tpu import ops
     from zhpe_ompi_tpu.mca import var as mca_var
+    from zhpe_ompi_tpu.runtime import spc
+
+    if real_procs:
+        if opname != "allreduce":
+            raise ValueError("real-process host plane: allreduce only")
+        return _run_proc_bench({
+            "kind": "coll", "max_size": max_size, "iters": iters,
+            "min_bytes": 1 << 10, "bw": False,
+        }, nprocs=nprocs)
 
     pinned = None
     if algorithm != "auto" and opname in ("bcast", "reduce"):
@@ -327,10 +560,24 @@ def bench_host_coll(opname: str = "allreduce", algorithm: str = "auto",
                     once()
                 return (time.perf_counter() - t0) / iters
 
-            per_rank = _run_tcp_ranks(nprocs, prog)
+            fb0 = spc.read("sm_fallback_tcp_sends")
+            sent0 = spc.read("sm_bytes_sent")
+            per_rank = _run_tcp_ranks(nprocs, prog, sm=sm)
+            if sm:
+                if spc.read("sm_fallback_tcp_sends") != fb0:
+                    raise RuntimeError(
+                        f"sm host plane at {arr.nbytes}B: sends "
+                        "silently fell back to TCP"
+                    )
+                if spc.read("sm_bytes_sent") == sent0:
+                    raise RuntimeError(
+                        f"sm host plane at {arr.nbytes}B: no ring "
+                        "traffic (selection failed?)"
+                    )
             sec = max(per_rank)
             rows.append({
-                "op": f"host_{opname}", "algorithm": algorithm,
+                "op": (f"sm_host_{opname}" if sm else f"host_{opname}"),
+                "algorithm": algorithm,
                 "bytes": arr.nbytes, "latency_us": sec * 1e6,
                 "bandwidth_MBps": (arr.nbytes / sec) / 1e6,
             })
@@ -367,16 +614,29 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--window", type=int, default=16,
                    help="frames in flight per ack in --bw mode")
     p.add_argument("--plane", default="device",
-                   choices=("device", "host"),
+                   choices=("device", "host", "sm"),
                    help="collectives: device = XLA mesh (default); "
-                        "host = coll/host over real loopback sockets")
+                        "host = coll/host over real loopback sockets; "
+                        "sm = same, with the shared-memory rings "
+                        "selected (pt2pt/tcp ops too) and silent TCP "
+                        "fallback failing the run")
     p.add_argument("--nprocs", type=int, default=4,
-                   help="socket ranks for --plane host")
+                   help="socket ranks for --plane host/sm collectives")
+    p.add_argument("--real-procs", action="store_true",
+                   help="--plane sm: ranks as separate OS processes "
+                        "(the cross-process case; threads share a GIL)")
+    p.add_argument("--_worker", default=None, help=argparse.SUPPRESS)
     args = p.parse_args(argv)
 
+    if args._worker is not None:
+        return _worker_main(json.loads(args._worker))
     if args.op == "pt2pt":
         rows = bench_pt2pt(args.max_size, max(args.iters, 10),
                            bw=args.bw, window=args.window)
+    elif args.op == "tcp" and args.plane == "sm":
+        rows = bench_sm(args.max_size, max(args.iters, 10),
+                        bw=args.bw, window=args.window,
+                        real_procs=args.real_procs)
     elif args.op == "tcp":
         rows = bench_tcp(args.max_size, max(args.iters, 10),
                          bw=args.bw, window=args.window)
@@ -386,10 +646,12 @@ def main(argv: list[str] | None = None) -> int:
             rows += bench_collective(op, "auto", args.max_size, args.iters)
         rows += bench_pt2pt(args.max_size, max(args.iters, 10))
         rows += bench_tcp(args.max_size, max(args.iters, 10))
-    elif args.plane == "host":
+        rows += bench_sm(args.max_size, max(args.iters, 10))
+    elif args.plane in ("host", "sm"):
         rows = bench_host_coll(
             args.op, args.algorithm, args.max_size, args.iters,
-            nprocs=args.nprocs,
+            nprocs=args.nprocs, sm=(args.plane == "sm"),
+            real_procs=args.real_procs and args.plane == "sm",
         )
     else:
         rows = bench_collective(

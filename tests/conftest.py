@@ -69,6 +69,15 @@ def _ulfm_detector_hygiene():
         f"rendezvous push-pool threads leaked past their proc's "
         f"close(): {pushers}"
     )
+    from zhpe_ompi_tpu.pt2pt import sm as sm_mod
+
+    orphans = sm_mod.orphaned_ring_files()
+    assert not orphans, (
+        f"Python-plane /dev/shm ring segments leaked past their proc's "
+        f"close() (the C-plane lifecycle contract): {orphans}"
+    )
+    polls = sm_mod.live_poll_threads()
+    assert not polls, f"sm poll threads leaked: {polls}"
 
 
 @pytest.fixture(autouse=True)

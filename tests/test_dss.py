@@ -165,3 +165,52 @@ class TestFramePath:
             dss.unpack(wire[:-8])  # tail segment cut short
         with pytest.raises(errors.TruncateError):
             dss.unpack(wire + b"\x00")  # trailing garbage still caught
+
+
+class TestPackFramesInto:
+    """The write-into-buffer pack variant (the shared-memory ring's
+    single-slot fast path): header bytes land directly in a caller
+    buffer, byte-identical to pack_frames, with overflow typed."""
+
+    CASES = [
+        (),
+        (None, True, -3, 2.5, "s", b"bytes"),
+        (np.arange(64, dtype=np.float64),),
+        (0, 1, 0, 7, (3, np.ones(8, np.float32))),
+        ({"k": [np.arange(5), b"x" * 5000]},),
+        (np.float32(1.5), np.arange(6, dtype=">i4")),
+    ]
+
+    @pytest.mark.parametrize("objs", CASES)
+    def test_byte_identical_to_pack_frames(self, objs):
+        ref_header, ref_segs = dss.pack_frames(*objs)
+        buf = bytearray(len(ref_header) + 64)
+        n, segs = dss.pack_frames_into(buf, *objs)
+        assert bytes(buf[:n]) == ref_header
+        assert [bytes(s) for s in segs] == [bytes(s) for s in ref_segs]
+        # the assembled frame is a valid unpack stream
+        frame = bytearray(bytes(buf[:n]) +
+                          b"".join(bytes(s) for s in segs))
+        out = dss.unpack_from(frame)
+        assert len(out) == len(objs)
+
+    def test_oob_min_respected(self):
+        arr = np.arange(8, dtype=np.int8)
+        buf = bytearray(256)
+        n, segs = dss.pack_frames_into(buf, arr, oob_min=1024)
+        assert segs == []
+        assert bytes(buf[:n]) == dss.pack(arr)
+
+    def test_overflow_raises_truncate(self):
+        buf = bytearray(4)
+        with pytest.raises(errors.TruncateError):
+            dss.pack_frames_into(buf, "a string far larger than four")
+
+    def test_readonly_buffer_rejected(self):
+        with pytest.raises(errors.ArgError):
+            dss.pack_frames_into(bytes(64), 1)
+
+    def test_writes_at_buffer_start_only(self):
+        buf = bytearray(b"\xff" * 128)
+        n, _segs = dss.pack_frames_into(buf, 42)
+        assert bytes(buf[n:]) == b"\xff" * (128 - n)  # tail untouched

@@ -88,9 +88,101 @@ def test_zero_copy_path_taken_across_ladder():
             p.send(got, dest=0, tag=2)
             return None
 
-        res = osu_zmpi._run_tcp_ranks(2, prog)
+        res = osu_zmpi._run_tcp_ranks(2, prog, sm=False)
         assert res[0] == payload.nbytes
         assert spc.read("tcp_zero_copy_sends") - zc0 >= 2, (
             f"zero-copy path not taken at {nbytes}B over sockets"
         )
         assert spc.read("tcp_copy_bytes_avoided") - av0 >= 2 * nbytes
+
+
+def test_sm_pt2pt_rows():
+    _check(osu_zmpi.bench_sm(max_size=64, iters=3), "sm_pingpong")
+
+
+def test_sm_host_allreduce_rows():
+    rows = osu_zmpi.bench_host_coll(
+        "allreduce", "auto", max_size=1 << 10, iters=2, nprocs=2,
+        sm=True,
+    )
+    _check(rows, "sm_allreduce".replace("sm_", "sm_host_"))
+
+
+@pytest.mark.slow
+def test_sm_ladder_no_silent_tcp_fallback():
+    """CI smoke for the shared-memory plane (satellite): a size ladder
+    over the socket harness with sm selected must put every rung's
+    bytes on the RINGS — `sm_fallback_tcp_sends` may not move and
+    `sm_bytes_sent` must rise per rung, so selection silently falling
+    back to the wire fails CI instead of hiding as a perf regression.
+    Crosses the single-slot (eager), fragmented, and
+    larger-than-the-whole-ring regimes."""
+    from zhpe_ompi_tpu.runtime import spc
+
+    sizes = [4 << 10, 64 << 10, 1 << 20, 4 << 20]
+    for nbytes in sizes:
+        payload = np.zeros(nbytes // 8, np.float64)
+        fb0 = spc.read("sm_fallback_tcp_sends")
+        sent0 = spc.read("sm_bytes_sent")
+
+        def prog(p, payload=payload):
+            if p.rank == 0:
+                p.send(payload, dest=1, tag=1)
+                return p.recv(source=1, tag=2, timeout=60.0).nbytes
+            got = p.recv(source=0, tag=1, timeout=60.0)
+            p.send(got, dest=0, tag=2)
+            return None
+
+        res = osu_zmpi._run_tcp_ranks(2, prog, sm=True)
+        assert res[0] == payload.nbytes
+        assert spc.read("sm_fallback_tcp_sends") == fb0, (
+            f"silent TCP fallback at {nbytes}B on the sm ladder"
+        )
+        assert spc.read("sm_bytes_sent") - sent0 >= 2 * nbytes, (
+            f"ring bytes did not rise at {nbytes}B"
+        )
+
+
+@pytest.mark.slow
+def test_sm_bench_gate_trips_on_forced_fallback():
+    """The ladder gate itself must work: a pair that silently degrades
+    (mismatched boot ids — rings advertised but not provably one
+    /dev/shm namespace) moves `sm_fallback_tcp_sends`, which is
+    exactly what the bench/ladder assertions refuse to accept."""
+    import threading
+
+    from zhpe_ompi_tpu.pt2pt.tcp import TcpProc
+    from zhpe_ompi_tpu.runtime import spc
+
+    fb0 = spc.read("sm_fallback_tcp_sends")
+    coord = []
+    ready = threading.Event()
+    excs = [None, None]
+
+    def main(rank):
+        try:
+            if rank == 0:
+                p = TcpProc(0, 2, coordinator=("127.0.0.1", 0), sm=True,
+                            on_coordinator_bound=lambda a: (
+                                coord.append(a), ready.set()))
+            else:
+                ready.wait(10)
+                p = TcpProc(1, 2, coordinator=tuple(coord[0]), sm=True,
+                            sm_boot_id="0badc0ffee00")
+            try:
+                p.send(np.zeros(64), dest=1 - rank, tag=1)
+                p.recv(source=1 - rank, tag=1, timeout=30.0)
+                p.barrier()
+            finally:
+                p.close()
+        except BaseException as e:  # noqa: BLE001
+            excs[rank] = e
+            ready.set()
+
+    ts = [threading.Thread(target=main, args=(r,)) for r in (0, 1)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(60.0)
+    assert excs == [None, None]
+    assert spc.read("sm_fallback_tcp_sends") > fb0

@@ -1,0 +1,462 @@
+"""Python-plane shared-memory transport (pt2pt/sm.py) — the twin of
+tests/test_sm_transport.py's C-plane contract, plus the mmap ring
+itself: segment lifecycle (files live exactly as long as their proc,
+stale rings unlinked at create), btl-style priority selection with
+loud degradation to TCP for mixed pairs, and an
+eager/fragmented/zero-size/non-contiguous roundtrip matrix over the
+ring."""
+
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from zhpe_ompi_tpu.mca import var as mca_var
+from zhpe_ompi_tpu.pt2pt import sm as sm_mod
+from zhpe_ompi_tpu.pt2pt.tcp import TcpProc
+from zhpe_ompi_tpu.runtime import spc
+
+
+def run_sm(n, fn, kwargs_by_rank=None, timeout=60.0, **common):
+    """Launch n TcpProcs in threads sharing a localhost coordinator,
+    with per-rank constructor overrides (the asymmetric-config knob the
+    mixed-pair tests need)."""
+    coord_ready = threading.Event()
+    coord_addr = [None]
+    results = [None] * n
+    excs = [None] * n
+
+    def main(rank):
+        kw = dict(common)
+        kw.update((kwargs_by_rank or {}).get(rank, {}))
+        try:
+            if rank == 0:
+                proc = TcpProc(
+                    0, n, coordinator=("127.0.0.1", 0),
+                    on_coordinator_bound=lambda a: (
+                        coord_addr.__setitem__(0, a), coord_ready.set()),
+                    **kw)
+            else:
+                coord_ready.wait(10)
+                proc = TcpProc(rank, n, coordinator=coord_addr[0], **kw)
+            try:
+                results[rank] = fn(proc)
+            finally:
+                proc.close()
+        except BaseException as e:  # noqa: BLE001
+            excs[rank] = e
+            coord_ready.set()
+
+    threads = [threading.Thread(target=main, args=(r,)) for r in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout)
+        assert not t.is_alive(), "sm rank hung"
+    if any(e is not None for e in excs):
+        raise next(e for e in excs if e is not None)
+    return results
+
+
+class TestRing:
+    """The mmap ring itself, below the transport: SPSC framing, wrap,
+    fragment pipeline, and geometry adoption."""
+
+    def _pair(self, collected, nslots=4, slot_bytes=256):
+        mca_var.set_var("sm_max_frag", slot_bytes)
+        mca_var.set_var("sm_ring_bytes", nslots * slot_bytes)
+        seg = sm_mod.SmSegment(
+            0, 2, on_frame=lambda src, frame: collected.append(
+                (src, bytes(frame))))
+        tx = sm_mod.SmSender(seg.name, src_rank=1, dest_rank=0)
+        return seg, tx
+
+    def _send_bytes(self, tx, blob, deadline=5.0):
+        import time
+
+        return tx.send_frame(blob, [], time.monotonic() + deadline,
+                             None)
+
+    def _await(self, collected, count, timeout=5.0):
+        import time
+
+        deadline = time.monotonic() + timeout
+        while len(collected) < count and time.monotonic() < deadline:
+            time.sleep(0.001)
+        assert len(collected) >= count, (
+            f"only {len(collected)}/{count} frames arrived")
+
+    def test_roundtrip_and_wraparound(self, fresh_vars):
+        collected = []
+        seg, tx = self._pair(collected)
+        try:
+            # 4-slot ring, far more frames than slots: head/tail wrap
+            frames = [bytes([i]) * (i * 37 % 200) for i in range(64)]
+            for f in frames:
+                self._send_bytes(tx, f)
+            self._await(collected, len(frames))
+            assert [f for _, f in collected] == frames
+            assert all(src == 1 for src, _ in collected)
+        finally:
+            tx.close()
+            seg.close()
+        assert not os.path.exists(seg.path)
+
+    def test_message_larger_than_whole_ring_streams(self, fresh_vars):
+        collected = []
+        seg, tx = self._pair(collected, nslots=4, slot_bytes=256)
+        try:
+            big = bytes(range(256)) * 40  # 10 KiB through a 1 KiB ring
+            wire, nfrags = self._send_bytes(tx, big, deadline=10.0)
+            assert nfrags == 40
+            assert wire == len(big) + nfrags * 16
+            self._await(collected, 1, timeout=10.0)
+            assert collected[0][1] == big
+        finally:
+            tx.close()
+            seg.close()
+
+    def test_zero_size_frame(self, fresh_vars):
+        collected = []
+        seg, tx = self._pair(collected)
+        try:
+            wire, nfrags = self._send_bytes(tx, b"")
+            assert nfrags == 1
+            self._await(collected, 1)
+            assert collected[0][1] == b""
+        finally:
+            tx.close()
+            seg.close()
+
+    def test_sender_adopts_segment_geometry(self, fresh_vars):
+        """Geometry is read from the SEGMENT header, not the mapper's
+        MCA state: a var mismatch between procs cannot desync the
+        slot walk (the cross-process contract)."""
+        collected = []
+        seg, _tx0 = self._pair(collected, nslots=8, slot_bytes=128)
+        _tx0.close()
+        # a sender created under totally different local vars
+        mca_var.set_var("sm_max_frag", 4096)
+        mca_var.set_var("sm_ring_bytes", 1 << 20)
+        tx = sm_mod.SmSender(seg.name, src_rank=1, dest_rank=0)
+        try:
+            assert tx.slot_bytes == 128 and tx.nslots == 8
+            blob = bytes(1000)
+            _wire, nfrags = self._send_bytes(tx, blob)
+            assert nfrags == 8  # 1000 bytes over 128-byte slots
+            self._await(collected, 1)
+            assert collected[0][1] == blob
+        finally:
+            tx.close()
+            seg.close()
+
+    def test_stale_ring_unlinked_at_create(self, fresh_vars):
+        """The O_EXCL-retry idiom (zompi_mpi.cpp:709): a leftover file
+        from a crashed job with the same name is unlinked and the
+        create retried, not an error and not silently reused."""
+        collected = []
+        name = "zompi_pyring_testsuite_stale_0_0"
+        path = os.path.join(sm_mod.segment_dir(), name)
+        with open(path, "wb") as f:
+            f.write(b"stale garbage from a crashed job")
+        try:
+            seg = sm_mod.SmSegment(0, 2, on_frame=lambda s, fr: None,
+                                   name=name)
+            try:
+                # recreated from scratch: mappable, right geometry
+                tx = sm_mod.SmSender(name, src_rank=1, dest_rank=0)
+                tx.close()
+            finally:
+                seg.close()
+            assert not os.path.exists(path)
+        finally:
+            if os.path.exists(path):
+                os.unlink(path)
+
+    def test_foreign_file_refused(self, fresh_vars):
+        name = "zompi_pyring_testsuite_foreign_0_0"
+        path = os.path.join(sm_mod.segment_dir(), name)
+        with open(path, "wb") as f:
+            f.write(b"\x00" * 8192)
+        try:
+            from zhpe_ompi_tpu.core import errors
+
+            with pytest.raises(errors.MpiError):
+                sm_mod.SmSender(name, src_rank=0, dest_rank=1)
+        finally:
+            os.unlink(path)
+
+
+class TestTransportMatrix:
+    """The ring under the full TcpProc surface: every payload shape the
+    DSS wire carries round-trips over sm, across the eager/fragment
+    regimes, with zero silent TCP fallback."""
+
+    PAYLOADS = [
+        b"",                                     # zero-size
+        0,
+        3.14,
+        "string payload",
+        b"x" * 100,
+        np.array([], dtype=np.float32),          # zero-size array
+        np.arange(1000, dtype=np.float64),       # eager OOB array
+        np.arange(4096, dtype=np.float64)[::2],  # NON-contiguous
+        np.float64(2.5),                         # numpy scalar
+        (7, np.ones(128, np.float32)),           # (idx, block) tuple
+        {"k": [1, np.arange(10)], "n": None},    # nested mix
+        np.arange(1 << 16, dtype=np.float64),    # 512 KiB: fragmented
+    ]
+
+    def test_roundtrip_matrix_rides_the_ring(self, fresh_vars):
+        fb0 = spc.read("sm_fallback_tcp_sends")
+        eager0 = spc.read("sm_eager_sends")
+        frag0 = spc.read("sm_frag_sends")
+
+        def prog(p):
+            other = 1 - p.rank
+            for i, m in enumerate(self.PAYLOADS):
+                p.send(m, dest=other, tag=100 + i)
+            got = [p.recv(source=other, tag=100 + i, timeout=30.0)
+                   for i in range(len(self.PAYLOADS))]
+            p.barrier()
+            return got
+
+        res = run_sm(2, prog, sm=True)
+        for got in res:
+            for sent, back in zip(self.PAYLOADS, got):
+                if isinstance(sent, np.ndarray):
+                    assert np.array_equal(np.ascontiguousarray(sent),
+                                          back)
+                    assert back.flags.writeable
+                elif isinstance(sent, tuple):
+                    assert back[0] == sent[0]
+                    assert np.array_equal(sent[1], back[1])
+                elif isinstance(sent, dict):
+                    assert back["n"] is None
+                    assert np.array_equal(sent["k"][1], back["k"][1])
+                else:
+                    assert back == sent
+        assert spc.read("sm_fallback_tcp_sends") == fb0
+        assert spc.read("sm_eager_sends") > eager0
+        assert spc.read("sm_frag_sends") > frag0  # the 512 KiB rung
+
+    def test_large_rendezvous_regime_rides_the_ring(self, fresh_vars):
+        """Above tcp_eager_limit the wire would switch to RTS/CTS; the
+        sm plane carries the same payload as a fragment pipeline with
+        ring backpressure as its receiver-memory bound — no RTS ever
+        crosses, and the bytes all ride the ring."""
+        big = np.arange(1 << 18, dtype=np.float64)  # 2 MB > eager limit
+        rndv0 = spc.read("tcp_rndv_sends")
+        sent0 = spc.read("sm_bytes_sent")
+
+        def prog(p):
+            if p.rank == 0:
+                p.send(big, dest=1, tag=7)
+                return True
+            got = p.recv(source=0, tag=7, timeout=30.0)
+            return bool(np.array_equal(got, big)) and got.flags.writeable
+
+        assert run_sm(2, prog, sm=True) == [True, True]
+        assert spc.read("tcp_rndv_sends") == rndv0
+        assert spc.read("sm_bytes_sent") - sent0 >= big.nbytes
+
+    def test_collectives_get_the_fast_path_for_free(self, fresh_vars):
+        """coll/host rides the same send seam: a 4-rank ring allreduce
+        moves its chunks over the rings, no code changes above the
+        transport (the coll-rides-the-PML layering)."""
+        from zhpe_ompi_tpu import ops
+
+        sent0 = spc.read("sm_bytes_sent")
+        fb0 = spc.read("sm_fallback_tcp_sends")
+        arr = np.full(4096, 1.0)
+
+        def prog(p):
+            out = p.allreduce(arr * (p.rank + 1), ops.SUM)
+            p.barrier()
+            return float(np.asarray(out)[0])
+
+        assert run_sm(4, prog, sm=True, timeout=90.0) == [10.0] * 4
+        assert spc.read("sm_bytes_sent") > sent0
+        assert spc.read("sm_fallback_tcp_sends") == fb0
+
+    def test_ordering_under_concurrent_tags(self, fresh_vars):
+        """Per-source FIFO across eager and fragmented messages on one
+        direction: interleaved sizes deliver in matching order."""
+
+        def prog(p):
+            other = 1 - p.rank
+            sizes = [10, 1 << 15, 4, 1 << 16, 0, 300]
+            for i, nb in enumerate(sizes):
+                p.send(np.arange(max(1, nb // 8), dtype=np.float64)
+                       if nb else b"", dest=other, tag=50 + i)
+            out = []
+            for i, nb in enumerate(sizes):
+                got = p.recv(source=other, tag=50 + i, timeout=30.0)
+                out.append(got if isinstance(got, bytes)
+                           else int(got.size))
+            p.barrier()
+            return out
+
+        res = run_sm(2, prog, sm=True)
+        expect = [1, 4096, 1, 8192, b"", 37]
+        assert res == [expect, expect]
+
+
+class TestSelection:
+    """btl-style priority selection and the mixed-pair degradation
+    contract (the Python twin of test_sm_transport.py's
+    test_mixed_on_off_degrades_to_tcp)."""
+
+    def _exchange(self, p):
+        other = 1 - p.rank
+        msgs = [p.rank, np.arange(256.0), b"z" * 8192,
+                np.zeros(1 << 15)]
+        for i, m in enumerate(msgs):
+            p.send(m, dest=other, tag=20 + i)
+        got = [p.recv(source=other, tag=20 + i, timeout=30.0)
+               for i in range(len(msgs))]
+        p.barrier()
+        # exactly-once: a second recv on any tag must find nothing
+        for i in range(len(msgs)):
+            assert p.probe(source=other, tag=20 + i) is None or \
+                not p.probe(source=other, tag=20 + i)
+        return (got[0], float(np.asarray(got[1]).sum()), len(got[2]),
+                int(np.asarray(got[3]).size))
+
+    EXPECT = [(1, np.arange(256.0).sum(), 8192, 1 << 15),
+              (0, np.arange(256.0).sum(), 8192, 1 << 15)]
+
+    def test_sm_selected_by_default_same_boot(self, fresh_vars):
+        sent0 = spc.read("sm_bytes_sent")
+        assert run_sm(2, self._exchange, sm=True) == self.EXPECT
+        assert spc.read("sm_bytes_sent") > sent0
+
+    def test_mixed_pair_degrades_without_loss(self, fresh_vars):
+        """sm=1 on one side, sm=0 on the other: no ring activates in
+        either direction, every message still arrives exactly once,
+        and the degradation is intentional (no fallback counted —
+        the peer never advertised)."""
+        fb0 = spc.read("sm_fallback_tcp_sends")
+        sent0 = spc.read("sm_bytes_sent")
+        res = run_sm(2, self._exchange,
+                     kwargs_by_rank={0: {"sm": True}, 1: {"sm": False}})
+        assert res == self.EXPECT
+        assert spc.read("sm_bytes_sent") == sent0
+        assert spc.read("sm_fallback_tcp_sends") == fb0
+
+    def test_mismatched_boot_id_degrades_loudly(self, fresh_vars):
+        """Both sides advertise rings but the boot ids differ (not
+        provably one /dev/shm namespace): the pair degrades to TCP
+        without loss AND the degradation is visible in
+        sm_fallback_tcp_sends."""
+        fb0 = spc.read("sm_fallback_tcp_sends")
+        res = run_sm(
+            2, self._exchange,
+            kwargs_by_rank={0: {"sm": True},
+                            1: {"sm": True,
+                                "sm_boot_id": "feedfacef00d"}})
+        assert res == self.EXPECT
+        assert spc.read("sm_fallback_tcp_sends") > fb0
+
+    def test_priority_ladder_tcp_can_outrank_sm(self, fresh_vars):
+        """sm_priority <= tcp_priority forces the wire path per policy
+        (btl priority selection), with the rings still created — and
+        NOT counted as silent fallback."""
+        mca_var.set_var("sm_priority", 10)
+        mca_var.set_var("tcp_priority", 20)
+        fb0 = spc.read("sm_fallback_tcp_sends")
+        sent0 = spc.read("sm_bytes_sent")
+        assert run_sm(2, self._exchange, sm=True) == self.EXPECT
+        assert spc.read("sm_bytes_sent") == sent0
+        assert spc.read("sm_fallback_tcp_sends") == fb0
+
+    def test_malformed_card_degrades_not_raises(self):
+        """Modex cards are relayed verbatim from arbitrary peers: a
+        capability item wearing our prefix but malformed must degrade
+        the pair to TCP, never raise out of endpoint selection."""
+        assert sm_mod.parse_card(["h", 1, "pyshm:abc"]) is None
+        assert sm_mod.parse_card(["h", 1, "pyshm:"]) is None
+        assert sm_mod.parse_card(["h", 1, "pyshm::name"]) is None
+        assert sm_mod.parse_card(["h", 1, "pyshm:boot:"]) is None
+        assert sm_mod.parse_card(["h", 1, "sm"]) is None  # C-plane cap
+        assert sm_mod.parse_card(["h", 1]) is None
+        assert sm_mod.parse_card(None) is None
+        assert sm_mod.parse_card(
+            ["h", 1, "sm", "pyshm:boot:name"]) == ("boot", "name")
+
+    def test_mca_sm_zero_disables_globally(self, fresh_vars):
+        mca_var.set_var("sm", 0)
+        sent0 = spc.read("sm_bytes_sent")
+        assert run_sm(2, self._exchange) == self.EXPECT
+        assert spc.read("sm_bytes_sent") == sent0
+
+
+class TestLifecycle:
+    """The operational contract of test_sm_transport.py on the Python
+    plane: segments exist only while a job lives and are unlinked at
+    close; nothing leaks."""
+
+    def test_segments_unlinked_at_close(self, fresh_vars):
+        seen = []
+
+        def prog(p):
+            if p._sm_seg is not None:
+                seen.append(p._sm_seg.path)
+                assert os.path.exists(p._sm_seg.path)
+            p.send(p.rank, dest=(p.rank + 1) % 3, tag=1)
+            p.recv(source=(p.rank - 1) % 3, tag=1, timeout=30.0)
+            p.barrier()
+            return True
+
+        assert run_sm(3, prog, sm=True) == [True] * 3
+        assert len(seen) == 3
+        for path in seen:
+            assert not os.path.exists(path), f"{path} leaked past close"
+        assert sm_mod.orphaned_ring_files() == []
+        assert sm_mod.live_poll_threads() == []
+
+    def test_failed_construction_leaks_nothing(self, fresh_vars):
+        """A proc whose modex never completes (unreachable coordinator)
+        raises out of the constructor — nobody will ever call close()
+        on it, so the constructor itself must unwind the segment and
+        poll thread (zero-orphan contract)."""
+        from zhpe_ompi_tpu.core import errhandler as errh
+        from zhpe_ompi_tpu.core import errors
+
+        before = set(sm_mod.orphaned_ring_files())
+        with pytest.raises((errors.MpiError, errh.JobAbort)):
+            TcpProc(1, 2, coordinator=("127.0.0.1", 1), timeout=0.5,
+                    sm=True)
+        assert set(sm_mod.orphaned_ring_files()) == before
+        assert sm_mod.live_poll_threads() == []
+
+    def test_forced_off_creates_no_segments(self, fresh_vars):
+        def prog(p):
+            assert p._sm_seg is None
+            p.barrier()
+            return True
+
+        before = set(sm_mod.orphaned_ring_files())
+        assert run_sm(2, prog, sm=False) == [True, True]
+        assert set(sm_mod.orphaned_ring_files()) == before
+
+
+class TestPackFramesInto:
+    """The write-into-buffer pack variant the single-slot fast path
+    uses (satellite on utils/dss.py) at its call site: small frames
+    pack their header straight into slot memory."""
+
+    def test_direct_path_taken_for_small_frames(self, fresh_vars):
+        eager0 = spc.read("sm_eager_sends")
+
+        def prog(p):
+            if p.rank == 0:
+                p.send(np.arange(64.), dest=1, tag=3)
+                return True
+            got = p.recv(source=0, tag=3, timeout=30.0)
+            return float(got.sum())
+
+        res = run_sm(2, prog, sm=True)
+        assert res[1] == float(np.arange(64.).sum())
+        assert spc.read("sm_eager_sends") > eager0

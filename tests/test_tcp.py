@@ -13,8 +13,11 @@ from zhpe_ompi_tpu.pt2pt.tcp import TcpProc
 N = 4
 
 
-def run_tcp(n, fn, timeout=60.0):
-    """Launch n TcpProcs in threads sharing a localhost coordinator."""
+def run_tcp(n, fn, timeout=60.0, sm=None):
+    """Launch n TcpProcs in threads sharing a localhost coordinator.
+    ``sm=False`` pins the pair to the wire — the tests asserting
+    tcp_* counter/rendezvous behavior must not ride the shared-memory
+    rings the selection ladder would otherwise pick."""
     coord_ready = threading.Event()
     coord_addr = [None]
     results = [None] * n
@@ -30,10 +33,10 @@ def run_tcp(n, fn, timeout=60.0):
         try:
             if rank == 0:
                 proc = TcpProc(0, n, coordinator=("127.0.0.1", 0),
-                               on_coordinator_bound=publish)
+                               on_coordinator_bound=publish, sm=sm)
             else:
                 coord_ready.wait(10)
-                proc = TcpProc(rank, n, coordinator=coord_addr[0])
+                proc = TcpProc(rank, n, coordinator=coord_addr[0], sm=sm)
             try:
                 results[rank] = fn(proc)
             finally:
@@ -308,7 +311,7 @@ class TestZeroCopyWire:
             p.send(b"ok", dest=0, tag=61)
             return None
 
-        sends, avoided = run_tcp(2, prog)[0]
+        sends, avoided = run_tcp(2, prog, sm=False)[0]
         assert sends >= 1
         assert avoided >= arr.nbytes
 
@@ -328,7 +331,7 @@ class TestZeroCopyWire:
             p.send(b"ok", dest=0, tag=63)
             return None
 
-        assert run_tcp(2, prog)[0] >= 1
+        assert run_tcp(2, prog, sm=False)[0] >= 1
 
     def test_bytes_sent_counts_wire_bytes(self):
         """tcp_bytes_sent must cover actual on-wire bytes: the 4-byte
@@ -351,7 +354,7 @@ class TestZeroCopyWire:
             p.send(b"ok", dest=0, tag=65)
             return None
 
-        assert run_tcp(2, prog)[0] is True
+        assert run_tcp(2, prog, sm=False)[0] is True
 
     def test_rndv_wire_accounting_includes_control_frames(self):
         """A rendezvous transfer's RTS and CTS control frames (and the
@@ -378,7 +381,7 @@ class TestZeroCopyWire:
         # both ranks' counters land in the same process-global spc; the
         # delta spans RTS + CTS + hello + data + ack — strictly more
         # than the payload alone
-        sent = run_tcp(2, prog)[0]
+        sent = run_tcp(2, prog, sm=False)[0]
         assert sent > big.nbytes
 
     def test_ft_and_zero_copy_coexist(self):
@@ -396,11 +399,11 @@ class TestZeroCopyWire:
             assert float(np.asarray(got)[0]) == float(2 - p.rank)
             return spc.read("tcp_zero_copy_sends") - before
 
-        deltas = run_tcp_ft_pair(prog)
+        deltas = run_tcp_ft_pair(prog, sm=False)
         assert all(d >= 1 for d in deltas)
 
 
-def run_tcp_ft_pair(fn, timeout=60.0):
+def run_tcp_ft_pair(fn, timeout=60.0, sm=None):
     """Two ft=True TcpProcs over localhost (detector armed) — the
     minimal fast-path + FT coexistence harness."""
     coord_ready = threading.Event()
@@ -416,10 +419,12 @@ def run_tcp_ft_pair(fn, timeout=60.0):
         try:
             if rank == 0:
                 proc = TcpProc(0, 2, coordinator=("127.0.0.1", 0),
-                               on_coordinator_bound=publish, ft=True)
+                               on_coordinator_bound=publish, ft=True,
+                               sm=sm)
             else:
                 coord_ready.wait(10)
-                proc = TcpProc(1, 2, coordinator=coord_addr[0], ft=True)
+                proc = TcpProc(1, 2, coordinator=coord_addr[0], ft=True,
+                               sm=sm)
             try:
                 results[rank] = fn(proc)
             finally:
@@ -468,7 +473,7 @@ class TestRendezvousPushPool:
             p.send(total, dest=0, tag=99)
             return total
 
-        res = run_tcp(2, prog)
+        res = run_tcp(2, prog, sm=False)
         assert res[0] <= cap
         assert res[1] == float(sum(range(nmsg)))
         # pool drained at close(): the conftest session gate asserts the
@@ -490,7 +495,7 @@ class TestRendezvous:
             got = p.recv(source=0, tag=21, timeout=20.0)
             return bool(np.array_equal(got, big))
 
-        assert run_tcp(2, prog) == [True, True]
+        assert run_tcp(2, prog, sm=False) == [True, True]
 
     def test_payload_parks_at_sender_until_matched(self):
         """The data frame must not cross the wire before the receiver
@@ -516,7 +521,7 @@ class TestRendezvous:
             p.send(float(got.size), dest=0, tag=25)
             return None
 
-        res = run_tcp(2, prog)
+        res = run_tcp(2, prog, sm=False)
         pending_before, got_back, pending_after = res[0]
         assert pending_before == 1  # parked at sender while unmatched
         assert got_back == float(1 << 18)
@@ -541,7 +546,7 @@ class TestRendezvous:
             ga = p.recv(source=0, tag=30, timeout=20.0)
             return (small, float(ga[0]), ga.size, float(gb[0]), gb.size)
 
-        res = run_tcp(2, prog)
+        res = run_tcp(2, prog, sm=False)
         assert res[1] == (b"small", 1.0, (1 << 17) + 8, 2.0, 1 << 18)
 
     def test_rendezvous_through_collectives(self):
@@ -554,7 +559,7 @@ class TestRendezvous:
                 "zhpe_ompi_tpu.ops", fromlist=["SUM"]).SUM)
             return float(np.asarray(out)[0])
 
-        assert run_tcp(4, prog, timeout=90.0) == [10.0] * 4
+        assert run_tcp(4, prog, timeout=90.0, sm=False) == [10.0] * 4
 
     def test_bidirectional_large_exchange(self):
         """Two ranks streaming payloads far larger than the kernel
@@ -571,7 +576,7 @@ class TestRendezvous:
                              sendtag=44, recvtag=44)
             return float(np.asarray(got)[1])
 
-        res = run_tcp(2, prog, timeout=90.0)
+        res = run_tcp(2, prog, timeout=90.0, sm=False)
         assert res == [2.0, 1.0]
 
     def test_container_payload_uses_rendezvous(self):
@@ -600,7 +605,7 @@ class TestRendezvous:
             idx, got = p.recv(source=0, tag=45, timeout=20.0)
             return (pending, idx, got.size)
 
-        res = run_tcp(2, prog)
+        res = run_tcp(2, prog, sm=False)
         # note: rank 0 sampled pending AFTER its own send returned but
         # possibly before rank 1 matched — it must have been >= 1 at RTS
         # time; by match time the transfer completes

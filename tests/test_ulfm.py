@@ -787,8 +787,10 @@ class TestRejoin:
         assert rj.fully_replayed
 
 
-def run_tcp_ft(n, fn, timeout=60.0, proc_timeout=15.0):
-    """Launch n ft-enabled TcpProcs over a localhost coordinator."""
+def run_tcp_ft(n, fn, timeout=60.0, proc_timeout=15.0, sm=None):
+    """Launch n ft-enabled TcpProcs over a localhost coordinator.
+    ``sm`` pins the shared-memory transport on/off (None = MCA
+    default; tests asserting tcp_* counters pin False)."""
     coord_ready = threading.Event()
     coord_addr = [None]
     results = [None] * n
@@ -804,12 +806,12 @@ def run_tcp_ft(n, fn, timeout=60.0, proc_timeout=15.0):
         try:
             if rank == 0:
                 proc = TcpProc(0, n, coordinator=("127.0.0.1", 0),
-                               timeout=proc_timeout, ft=True,
+                               timeout=proc_timeout, ft=True, sm=sm,
                                on_coordinator_bound=publish)
             else:
                 coord_ready.wait(10)
                 proc = TcpProc(rank, n, coordinator=coord_addr[0],
-                               timeout=proc_timeout, ft=True)
+                               timeout=proc_timeout, ft=True, sm=sm)
             procs[rank] = proc
             try:
                 results[rank] = fn(proc)
@@ -908,10 +910,61 @@ class TestTcpUlfm:
                                  ops.SUM)
             return (sh.size, float(np.asarray(total)[0]))
 
-        res = run_tcp_ft(n, prog)
+        res = run_tcp_ft(n, prog, sm=False)
         assert res[2] == "killed"
         assert res[0] == (2, 3.0) and res[1] == (2, 3.0)  # 1.0 + 2.0
         assert spc.read("tcp_zero_copy_sends") > zc0
+
+    def test_kill_during_sm_rings_torn_down_and_survivors_ride_sm(
+            self, fresh_vars):
+        """FT + shared-memory-plane coexistence (PR satellite): kill a
+        rank whose peers selected the sm rings — the detector (which
+        beats over TCP by design) still classifies the death as typed
+        ProcFailed, survivors tear down/unmap their rings into the
+        corpse, and the post-shrink allreduce STILL rides the rings
+        among the same-host survivors (sm_bytes_sent delta > 0)."""
+        from zhpe_ompi_tpu.runtime import spc
+
+        mca_var.set_var("ft_detector_period", 0.05)
+        mca_var.set_var("ft_detector_timeout", 0.4)
+        n = 3
+        plan = FaultPlan(seed=31).kill_rank(2, after_ops=1)
+        fb0 = spc.read("sm_fallback_tcp_sends")
+
+        def prog(p):
+            p.set_errhandler(errh.ERRORS_RETURN)
+            inj = plan.arm(p)
+            # pre-kill traffic rides the rings (ladder already selected)
+            try:
+                inj.send(np.arange(1024.0) * p.rank,
+                         dest=(p.rank + 1) % n, tag=1)
+                inj.recv(source=(p.rank - 1) % n, tag=1, timeout=10.0)
+            except errors.ProcFailed:
+                pass  # discovery-at-send: valid entry to recovery
+            assert p.ft_state.wait_failed(2, timeout=10.0)
+            # peer death => ring teardown (the failure listener): the
+            # sender toward the corpse is unmapped and pinned to TCP
+            deadline = time.monotonic() + 5.0
+            while p._sm_senders.get(2, "unset") is not None \
+                    and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert p._sm_senders.get(2, "unset") is None
+            p.failure_ack()
+            assert p.agree(True) is True
+            sh = p.shrink()
+            before = spc.read("sm_bytes_sent")
+            total = sh.allreduce(np.full(2048, float(p.rank + 1)),
+                                 ops.SUM)
+            delta = spc.read("sm_bytes_sent") - before
+            return (sh.size, float(np.asarray(total)[0]), delta > 0)
+
+        res = run_tcp_ft(n, prog, sm=True)
+        assert res[2] == "killed"
+        assert res[0][:2] == (2, 3.0) and res[1][:2] == (2, 3.0)
+        # the post-shrink collective crossed the rings on both survivors
+        assert res[0][2] and res[1][2]
+        # and never silently fell back to the wire
+        assert spc.read("sm_fallback_tcp_sends") == fb0
 
     def test_muted_rank_found_by_detector_only(self, fresh_vars):
         """mute kill: sockets stay open, only heartbeats stop — the ring

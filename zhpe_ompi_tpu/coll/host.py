@@ -12,6 +12,15 @@ thread universe.  (The device plane keeps its own XLA-native algorithms in
 ``coll/tpu.py``/``coll/algorithms.py``; this is the control/host plane the
 reference runs EVERYTHING on.)
 
+The same layering is what hands these algorithms the shared-memory fast
+path for free: ``TcpProc.send`` dispatches per peer (self → sm → tcp),
+so the ring allreduce's ``(idx, block)`` chunks and the pipeline
+bcast/reduce segments of same-host ranks ride the mmap rings of
+``pt2pt/sm.py`` with zero changes here — the coll-rides-the-PML property
+doing exactly the work the reference's BTL selection does (benchmarked
+by ``osu_zmpi --plane sm``, regression-gated by
+``tests/test_sm_plane.py::TestTransportMatrix``).
+
 Algorithm choices mirror coll_base (re-derived, not transliterated):
 binomial bcast/reduce (``coll_base_bcast.c``, in-order linear reduce for
 non-commutative ops), recursive-doubling allreduce with the non-power-of-2

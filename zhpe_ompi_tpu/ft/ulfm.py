@@ -195,6 +195,31 @@ class FailureState:
         # DIFFERENT survivor set
         self._crash_epoch = 0
         self._cv = threading.Condition()
+        # death observers (e.g. the sm transport unmapping its rings to
+        # a corpse): invoked OUTSIDE the cv, once per newly-learned
+        # departure/failure, from whatever thread learned it
+        self._listeners: list = []
+
+    # -- failure listeners -----------------------------------------------
+
+    def add_failure_listener(self, fn) -> None:
+        """Register ``fn(rank, cause)`` to run on every NEWLY-learned
+        peer death or departure — the transport-teardown hook (a ring
+        into a dead peer's address space must be unmapped; its consumer
+        is never coming back).  Called outside the state lock; a
+        listener that raises is logged-and-dropped, never fatal to the
+        classification path that discovered the death."""
+        with self._cv:
+            self._listeners.append(fn)
+
+    def _notify_death(self, rank: int, cause: str) -> None:
+        with self._cv:
+            listeners = list(self._listeners)
+        for fn in listeners:
+            try:
+                fn(rank, cause)
+            except Exception:  # noqa: BLE001 - observer must not break
+                pass            # the classifier that discovered the death
 
     # -- failures --------------------------------------------------------
 
@@ -215,6 +240,7 @@ class FailureState:
                         and rank not in _EXPECTED_RANK_KILLS):
                     global _false_positives
                     _false_positives += 1
+        self._notify_death(rank, cause)
         return True
 
     def merge_failed(self, ranks: Iterable[int], cause: str = "notice"
@@ -325,7 +351,9 @@ class FailureState:
                 self._cause[rank] = "goodbye"
             self._acked.add(rank)
             self._cv.notify_all()
-            return fresh
+        if fresh:
+            self._notify_death(rank, "goodbye")
+        return fresh
 
     def restore(self, rank: int) -> None:
         """Forget a failure — the rejoin path: a replayed/restarted rank

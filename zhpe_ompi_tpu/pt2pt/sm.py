@@ -1,0 +1,790 @@
+"""Shared-memory transport — the btl/sm analog for the Python host plane.
+
+The reference stacks transports under one selection meta-architecture:
+``opal/mca/btl/sm`` outruns ``btl/tcp`` for same-host peers and wins at
+endpoint selection by priority/exclusivity, with ``btl/self`` covering
+rank-to-self (SURVEY §btl).  PR 3 shipped the ``btl/self`` analog (the
+loopback shortcut in ``pt2pt/tcp.py``); this module closes the remaining
+gap: cross-process same-host Python ranks no longer pay syscall +
+kernel-buffer costs for every hop — frames move through mmap'd
+``/dev/shm`` rings exactly like the C shim's own transport
+(``native/zompi_mpi.cpp`` sm_*).
+
+Design (one segment per proc, one fixed-slot SPSC ring per peer
+direction):
+
+- **Segment**: each proc creates ONE ``/dev/shm`` segment at
+  construction holding its INBOUND rings — one ring per possible source
+  rank — and advertises ``(boot_id, segment_name)`` on its modex card.
+  A sender maps the destination's segment and produces into the ring
+  indexed by its own rank; the owner is the only consumer of every ring
+  in its segment, so each ring is strictly SPSC and a single doorbell
+  in the segment header covers all of them.
+- **Ring**: ``nslots`` fixed slots of ``sm_max_frag`` payload bytes
+  (``nslots = sm_ring_bytes // sm_max_frag``); ``head``/``tail`` are
+  monotonic slot counters on separate cache lines.  A message is one
+  DSS frame (the PR 3 ``pack_frames`` header + out-of-band segments)
+  written *directly into slot memory* — one copy total on the sender
+  (the btl/sm copy-in).  Messages larger than a slot flow as a
+  fragment pipeline: the consumer frees each slot as it assembles, so
+  a message larger than the whole ring still streams through.
+- **Receive**: the poll thread assembles each frame into a dedicated
+  writable bytearray and hands it to ``dss.unpack_from`` — delivered
+  arrays are writable views over that frame buffer (no per-array
+  copy), never over the slot itself: a slot is recycled the moment
+  ``tail`` passes it, and delivered payloads outlive that.  The final
+  fragment's ``tail`` advance happens only AFTER the frame reached the
+  matching engine, so ``head == tail`` observed by a sender means
+  every completed message was delivered (the close-quiesce contract).
+- **Doorbell**: a futex/spin hybrid.  The poll thread stays hot
+  (GIL-yielding spin) through a short window after traffic, then
+  announces sleep in the segment header and parks in a real
+  ``futex(FUTEX_WAIT)`` on that word; producers wake it only when the
+  flag is up.  Platforms without the futex syscall degrade to the
+  C shim's escalating-sleep poll.
+
+Selection and fallback live in ``pt2pt/tcp.py`` (priority ladder
+self → sm → tcp, ``sm_priority`` vs ``tcp_priority``, per-peer); the FT
+control family (heartbeats, notices, revoke/BYE/JOIN floods) stays on
+TCP by design — connection refused/reset IS the death signal the
+detector classifies, and a ring into a corpse's address space can never
+provide it.  Respawned (JOIN re-modex) ranks and dpm bridge peers stay
+on TCP too, mirroring the C plane's "spawn joins stay TCP" cohort
+contract.
+
+Lifecycle mirrors ``tests/test_sm_transport.py``'s C-plane contract:
+segments exist only while their proc lives, are unlinked at close, and
+a stale segment left by a crashed job is unlinked at create
+(``O_EXCL`` retry, the ``zompi_mpi.cpp:709`` idiom).  ``zmpirun``
+sweeps ``zompi_pyring_<session>_*`` for killed ranks the way it sweeps
+the C rings.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import itertools
+import mmap
+import os
+import platform
+import socket
+import struct
+import sys
+import tempfile
+import threading
+import time
+import weakref
+
+from ..core import errors
+from ..mca import output as mca_output
+from ..mca import var as mca_var
+from ..runtime import spc
+from ..utils import dss
+
+_stream = mca_output.open_stream("btl_sm")
+
+mca_var.register(
+    "sm", 1,
+    "Shared-memory transport for same-host Python ranks: 1 = create an "
+    "mmap ring segment and ride it to same-boot peers, 0 = always TCP "
+    "(asymmetric settings degrade the pair to TCP, the C plane's "
+    "ZMPI_MCA_sm contract)",
+    type=int,
+)
+mca_var.register(
+    "sm_priority", 90,
+    "Endpoint-selection priority of the sm transport (btl_sm_priority): "
+    "sm is chosen for a same-host peer when this exceeds tcp_priority; "
+    "set at/below it to force the wire path without disabling the rings",
+    type=int,
+)
+mca_var.register(
+    "sm_ring_bytes", 4 << 20,
+    "Per-direction ring payload capacity in bytes (the C plane's "
+    "SM_RING_BYTES twin; tmpfs pages allocate lazily, so untouched "
+    "slots cost nothing); with sm_max_frag it fixes the slot count "
+    "(nslots = sm_ring_bytes // sm_max_frag, floor 2) — the in-flight "
+    "bound backpressure enforces",
+    type=int,
+)
+mca_var.register(
+    "sm_max_frag", 128 << 10,
+    "Payload bytes per ring slot: messages above this fragment into a "
+    "slot pipeline (consumer frees slots while the producer still "
+    "copies, so messages larger than the whole ring stream through)",
+    type=int,
+)
+
+_U64 = struct.Struct("<Q")
+_U32 = struct.Struct("<I")
+# per-slot header: fragment length + total message length (a message is
+# a contiguous run of fragments; only one can be in flight per ring, so
+# continuation slots need no message id)
+_SLOT = struct.Struct("<II")
+_SLOT_HDR = 16  # _SLOT padded to 16 for payload alignment
+
+_MAGIC = 0x315F4D5359505A00  # "\0ZPYSM_1" little-endian
+_SEG_HDR = 4096              # segment header page
+_RING_HDR = 128              # head @+0, tail @+64 (cache-line separated)
+# segment-header field offsets
+_OFF_MAGIC = 0
+_OFF_NRINGS = 12
+_OFF_NSLOTS = 16
+_OFF_SLOT_BYTES = 20
+_OFF_DOORBELL = 64   # consumer sleep flag (futex word)
+_OFF_STOPPED = 128   # owner's poll loop exited (peers stop quiescing)
+
+# poll cadence: stay hot (GIL-yielding spin) through a window that
+# covers a ping-pong inter-arrival gap — the C shim measured that
+# dozing inside it puts the wake latency ON the critical path of every
+# message (200us dozes turned 2us rings into 208us; here a parked poll
+# thread costs ~0.5ms of scheduler latency per message on a small
+# host).  Past the window the thread parks on the doorbell futex, so
+# idle procs cost nothing and wakeups are event-driven; the fallback
+# without futex support sleeps in short bounded steps instead.
+_HOT_S = 0.005
+# the doze is also the bound on a lost wakeup the fence below cannot
+# fully rule out — keep it SHORT
+_DOZE_S = 0.005
+
+# Full memory barrier for the sleep/wake handshake.  The doorbell is a
+# Dekker protocol: a producer stores head then loads the sleep flag;
+# the consumer stores the flag then re-reads every head — TSO's
+# StoreLoad reordering can hide either store from the other side and
+# park the consumer through a delivered frame.  Python exposes no
+# fence, but an uncontended lock round-trip is an atomic RMW
+# (LOCK-prefixed on x86, ldaxr/stlxr on arm64) and orders both sides;
+# any residual miss is bounded by the doze timeout.
+_fence_lock = threading.Lock()
+
+
+def _fence() -> None:
+    with _fence_lock:
+        pass
+
+
+# ------------------------------------------------------------- futex --
+
+FUTEX_WAIT = 0
+FUTEX_WAKE = 1
+
+_SYS_FUTEX = {
+    "x86_64": 202, "aarch64": 98, "arm": 240, "armv7l": 240,
+    "armv6l": 240, "i686": 240, "i386": 240, "ppc64le": 221,
+    "s390x": 238, "riscv64": 98,
+}.get(platform.machine())
+
+
+class _Timespec(ctypes.Structure):
+    _fields_ = [("tv_sec", ctypes.c_long), ("tv_nsec", ctypes.c_long)]
+
+
+def _init_futex():
+    if sys.platform != "linux" or _SYS_FUTEX is None:
+        return None
+    try:
+        libc = ctypes.CDLL(None, use_errno=True)
+        libc.syscall.restype = ctypes.c_long
+        return libc
+    except (OSError, AttributeError):
+        return None
+
+
+_libc = _init_futex()
+
+
+def futex_available() -> bool:
+    return _libc is not None
+
+
+def _futex_wait(mm: mmap.mmap, off: int, expected: int,
+                timeout_s: float) -> None:
+    """Park on the shared word until woken, the value changes, or the
+    timeout lapses.  ctypes releases the GIL for the syscall, so a
+    parked poll thread costs nothing.  Without futex support this is a
+    short bounded sleep — same liveness, more latency."""
+    if _libc is None:
+        time.sleep(min(timeout_s, 0.0002))
+        return
+    word = ctypes.c_uint32.from_buffer(mm, off)
+    try:
+        ts = _Timespec(int(timeout_s), int((timeout_s % 1.0) * 1e9))
+        # non-PRIVATE futex: the word lives in a MAP_SHARED page and the
+        # waker may be another process
+        _libc.syscall(_SYS_FUTEX, ctypes.byref(word), FUTEX_WAIT,
+                      expected, ctypes.byref(ts), 0, 0)
+    finally:
+        del word  # release the exported buffer before any mm.close()
+
+
+def _futex_wake(mm: mmap.mmap, off: int, n: int = 1) -> None:
+    if _libc is None:
+        return
+    word = ctypes.c_uint32.from_buffer(mm, off)
+    try:
+        _libc.syscall(_SYS_FUTEX, ctypes.byref(word), FUTEX_WAKE, n,
+                      0, 0, 0)
+    finally:
+        del word
+
+
+# ------------------------------------------- naming, hygiene registry --
+
+_seg_counter = itertools.count()
+_registry_lock = threading.Lock()
+_created_paths: set[str] = set()
+_live_segments: weakref.WeakSet = weakref.WeakSet()
+
+
+def segment_dir() -> str:
+    """Backing directory for ring segments: ``/dev/shm`` (a real tmpfs,
+    the page-cache-free fast path) when present, tempdir otherwise —
+    mmap sharing works on any file, only the residency guarantee
+    differs."""
+    return "/dev/shm" if os.path.isdir("/dev/shm") else \
+        tempfile.gettempdir()
+
+
+def _session_tag() -> str:
+    """Launcher session when present (zmpirun exports ZMPI_SESSION so
+    one prefix sweep covers every rank it killed), else this pid."""
+    tag = os.environ.get("ZMPI_SESSION")
+    return tag if tag else f"p{os.getpid()}"
+
+
+def _segment_name(rank: int) -> str:
+    # pid + a process-unique counter: concurrently-living universes in
+    # one test process can never collide, and an EEXIST at create can
+    # only be a crashed job's leftover (pid reuse) — unlink and retry
+    return (f"zompi_pyring_{_session_tag()}_{os.getpid()}_{rank}_"
+            f"{next(_seg_counter)}")
+
+
+def orphaned_ring_files() -> list[str]:
+    """Every Python-plane ring segment this process created that still
+    exists on disk — the test-suite hygiene gate's view (the C plane's
+    lifecycle contract: rings live exactly as long as their proc)."""
+    with _registry_lock:
+        created = list(_created_paths)
+    return sorted(p for p in created if os.path.exists(p))
+
+
+def live_poll_threads() -> list[str]:
+    """Names of sm poll threads still alive across all (weakly tracked)
+    segments — the leak gate's view, mirroring tcp.live_push_threads."""
+    out = []
+    for seg in list(_live_segments):
+        t = seg._poll
+        if t is not None and t.is_alive():
+            out.append(t.name)
+    return out
+
+
+def boot_token() -> str:
+    """Same-host identity for the modex card: two procs share a ring
+    namespace iff their boot tokens match (hex-only, so a C-plane
+    coordinator scanning caps for the substring "sm" can never
+    misread it as a C ring capability)."""
+    try:
+        with open("/proc/sys/kernel/random/boot_id", "rb") as f:
+            raw = f.read()
+    except OSError:
+        raw = socket.gethostname().encode()
+    return hashlib.sha1(raw).hexdigest()[:12]
+
+
+_CARD_PREFIX = "pyshm:"
+
+
+def card_item(boot: str, name: str) -> str:
+    return f"{_CARD_PREFIX}{boot}:{name}"
+
+
+def parse_card(card) -> tuple[str, str] | None:
+    """Extract ``(boot, segment_name)`` from a modex card's capability
+    items (anything past ``[host, port]``); None when the peer
+    advertised no Python-plane segment (sm off, C rank, rejoiner)."""
+    if not isinstance(card, (list, tuple)):
+        return None
+    for item in card[2:]:
+        if isinstance(item, str) and item.startswith(_CARD_PREFIX):
+            parts = item.split(":", 2)
+            if len(parts) == 3 and parts[1] and parts[2]:
+                return parts[1], parts[2]
+            # malformed/foreign item wearing our prefix: cards are
+            # relayed verbatim from arbitrary peers — degrade, never
+            # raise out of endpoint selection into send()
+    return None
+
+
+def _geometry() -> tuple[int, int]:
+    slot_bytes = max(64, int(mca_var.get("sm_max_frag", 128 << 10)))
+    ring_bytes = max(slot_bytes, int(mca_var.get("sm_ring_bytes",
+                                                 4 << 20)))
+    nslots = max(2, ring_bytes // slot_bytes)
+    return nslots, slot_bytes
+
+
+def _ring_span(nslots: int, slot_bytes: int) -> int:
+    return _RING_HDR + nslots * (_SLOT_HDR + slot_bytes)
+
+
+class _RingState:
+    """Consumer-side per-ring bookkeeping (the owner is the only
+    consumer; ``tail`` here is authoritative, the shm copy exists for
+    the producer's free-space check)."""
+
+    __slots__ = ("src", "base", "tail", "buf", "fill")
+
+    def __init__(self, src: int, base: int):
+        self.src = src
+        self.base = base
+        self.tail = 0
+        self.buf: bytearray | None = None  # partial message assembly
+        self.fill = 0
+
+
+class SmSegment:
+    """The receiver half: owns the mmap'd segment holding this proc's
+    inbound rings and the poll thread that drains them.
+
+    ``on_frame(src_ring, frame)`` is invoked on the poll thread with a
+    dedicated writable bytearray per assembled message — the
+    ``dss.unpack_from`` aliasing contract of the TCP receive path."""
+
+    def __init__(self, rank: int, size: int, on_frame,
+                 name: str | None = None):
+        self.rank = rank
+        self.size = size
+        self._on_frame = on_frame
+        self.nslots, self.slot_bytes = _geometry()
+        span = _ring_span(self.nslots, self.slot_bytes)
+        seg_len = _SEG_HDR + size * span
+        self.name = name or _segment_name(rank)
+        self.path = os.path.join(segment_dir(), self.name)
+        flags = os.O_CREAT | os.O_EXCL | os.O_RDWR
+        try:
+            fd = os.open(self.path, flags, 0o600)
+        except FileExistsError:
+            # stale ring from a crashed job (pid reuse): unlink, retry
+            # once — the zompi_mpi.cpp:709 idiom
+            try:
+                os.unlink(self.path)
+            except OSError:
+                pass
+            fd = os.open(self.path, flags, 0o600)
+        try:
+            try:
+                os.ftruncate(fd, seg_len)
+                self._mm = mmap.mmap(fd, seg_len)
+            finally:
+                os.close(fd)
+        except OSError:
+            # half-created segment: never leave the file behind (the
+            # lifecycle gate's zero-orphan contract starts HERE)
+            try:
+                os.unlink(self.path)
+            except OSError:
+                pass
+            raise
+        with _registry_lock:
+            _created_paths.add(self.path)
+        # persistent read view: slicing an mmap OBJECT materializes an
+        # intermediate bytes copy per read; slicing a memoryview of it
+        # does not — the consumer's frag copy must be the only copy
+        self._mv = memoryview(self._mm)
+        mm = self._mm
+        _U32.pack_into(mm, _OFF_NRINGS, size)
+        _U32.pack_into(mm, _OFF_NSLOTS, self.nslots)
+        _U32.pack_into(mm, _OFF_SLOT_BYTES, self.slot_bytes)
+        # magic stamped LAST: a mapper that sees it sees the geometry
+        _U64.pack_into(mm, _OFF_MAGIC, _MAGIC)
+        self._span = span
+        self._rings = [
+            _RingState(src, _SEG_HDR + src * span)
+            for src in range(size) if src != rank
+        ]
+        self._stop = threading.Event()
+        self._closed = False
+        self._close_lock = threading.Lock()
+        self._poll = threading.Thread(
+            target=self._poll_loop, daemon=True,
+            name=f"sm-poll-{rank}-{os.getpid()}",
+        )
+        _live_segments.add(self)
+        self._poll.start()
+
+    def card(self, boot: str) -> str:
+        return card_item(boot, self.name)
+
+    # -- consumer --------------------------------------------------------
+
+    def _any_ready(self) -> bool:
+        mm = self._mm
+        for st in self._rings:
+            if _U64.unpack_from(mm, st.base)[0] != st.tail:
+                return True
+        return False
+
+    def _drain_ring(self, st: _RingState) -> bool:
+        mm = self._mm
+        head = _U64.unpack_from(mm, st.base)[0]
+        if head == st.tail:
+            return False
+        _fence()  # acquire edge: slot reads must not pass the head load
+        nslots, slot_bytes = self.nslots, self.slot_bytes
+        while st.tail < head:
+            slot = st.base + _RING_HDR + \
+                (st.tail % nslots) * (_SLOT_HDR + slot_bytes)
+            frag_len, total = _SLOT.unpack_from(mm, slot)
+            if frag_len > slot_bytes:  # pragma: no cover - corruption
+                raise errors.InternalError(
+                    f"sm ring from rank {st.src}: fragment of {frag_len}"
+                    f" bytes exceeds the {slot_bytes}-byte slot"
+                )
+            if st.buf is None:
+                st.buf = bytearray(total)
+                st.fill = 0
+            data = slot + _SLOT_HDR
+            st.buf[st.fill:st.fill + frag_len] = \
+                self._mv[data:data + frag_len]
+            st.fill += frag_len
+            spc.record("sm_bytes_recvd", frag_len + _SLOT_HDR)
+            st.tail += 1
+            if st.fill >= len(st.buf):
+                frame, st.buf = st.buf, None
+                # deliver BEFORE publishing the final fragment's tail:
+                # a sender observing head == tail may then rely on every
+                # completed message having reached the matching engine
+                # (the close-quiesce ordering the BYE goodbye needs)
+                try:
+                    self._on_frame(st.src, frame)
+                except Exception as e:  # noqa: BLE001 - keep polling
+                    mca_output.emit(
+                        _stream,
+                        "rank %s: sm frame dispatch from %s failed: "
+                        "%s: %s", self.rank, st.src,
+                        type(e).__name__, e,
+                    )
+            # the tail store is the release edge freeing the slot: the
+            # copy-out above must be globally done first (a producer
+            # reuses the slot the moment it sees the new tail)
+            _fence()
+            _U64.pack_into(mm, st.base + 64, st.tail)
+        return True
+
+    def _poll_loop(self) -> None:
+        mm = self._mm
+        hot_until = time.monotonic() + _HOT_S
+        try:
+            while not self._stop.is_set():
+                progressed = False
+                for st in self._rings:
+                    progressed |= self._drain_ring(st)
+                now = time.monotonic()
+                if progressed:
+                    hot_until = now + _HOT_S
+                    continue
+                if now < hot_until:
+                    # hot but cooperative: yield the GIL every pass so
+                    # the app threads this poll serves can actually run
+                    time.sleep(0)
+                    continue
+                # doze: announce sleep, re-check (lost-wakeup guard),
+                # park bounded — a missed doorbell costs one doze
+                _U32.pack_into(mm, _OFF_DOORBELL, 1)
+                _fence()  # flag store must precede the head re-reads
+                if self._any_ready() or self._stop.is_set():
+                    _U32.pack_into(mm, _OFF_DOORBELL, 0)
+                    hot_until = time.monotonic() + _HOT_S
+                    continue
+                _futex_wait(mm, _OFF_DOORBELL, 1, _DOZE_S)
+                _U32.pack_into(mm, _OFF_DOORBELL, 0)
+        except Exception as e:  # noqa: BLE001 - thread boundary
+            mca_output.emit(
+                _stream, "rank %s: sm poll loop died: %s: %s",
+                self.rank, type(e).__name__, e,
+            )
+        finally:
+            # peers' close-quiesce loops watch this: once the consumer
+            # is gone, waiting for the rings to drain is waiting forever
+            try:
+                _U32.pack_into(mm, _OFF_STOPPED, 1)
+            except ValueError:  # pragma: no cover - mm closed under us
+                pass
+
+    # -- lifecycle -------------------------------------------------------
+
+    def sever(self) -> None:
+        """Crash simulation: consumption stops, the file survives (a
+        real crash cleans nothing up — the launcher sweep / final
+        harness close owns the unlink)."""
+        self._stop.set()
+        try:
+            _futex_wake(self._mm, _OFF_DOORBELL)
+        except ValueError:
+            pass
+        self._poll.join(timeout=5.0)
+
+    def close(self) -> None:
+        with self._close_lock:
+            if self._closed:
+                return
+            self._closed = True
+        self._stop.set()
+        try:
+            _futex_wake(self._mm, _OFF_DOORBELL)
+        except ValueError:
+            pass
+        self._poll.join(timeout=5.0)
+        self._mv.release()
+        try:
+            self._mm.close()
+        except BufferError:  # pragma: no cover - exported view leaked
+            pass
+        try:
+            os.unlink(self.path)
+        except OSError:
+            pass
+        with _registry_lock:
+            _created_paths.discard(self.path)
+
+
+class SmSender:
+    """The producer half: maps a peer's segment and streams frames into
+    the ring indexed by this proc's rank.  Geometry comes from the
+    SEGMENT header, not local MCA state — mismatched vars between procs
+    cannot desynchronize the slot walk."""
+
+    def __init__(self, name: str, src_rank: int, dest_rank: int):
+        self.dest = dest_rank
+        self.path = os.path.join(segment_dir(), name)
+        fd = os.open(self.path, os.O_RDWR)
+        try:
+            seg_len = os.fstat(fd).st_size
+            if seg_len < _SEG_HDR:
+                raise errors.InternalError(
+                    f"sm segment {name}: truncated ({seg_len} bytes)"
+                )
+            self._mm = mmap.mmap(fd, seg_len)
+        finally:
+            os.close(fd)
+        mm = self._mm
+        if _U64.unpack_from(mm, _OFF_MAGIC)[0] != _MAGIC:
+            mm.close()
+            raise errors.InternalError(
+                f"sm segment {name}: bad magic (creator still stamping "
+                "or foreign file)"
+            )
+        nrings = _U32.unpack_from(mm, _OFF_NRINGS)[0]
+        self.nslots = _U32.unpack_from(mm, _OFF_NSLOTS)[0]
+        self.slot_bytes = _U32.unpack_from(mm, _OFF_SLOT_BYTES)[0]
+        if src_rank >= nrings:
+            mm.close()
+            raise errors.InternalError(
+                f"sm segment {name}: rank {src_rank} outside its "
+                f"{nrings}-ring universe"
+            )
+        span = _ring_span(self.nslots, self.slot_bytes)
+        expect = _SEG_HDR + nrings * span
+        if seg_len < expect:
+            mm.close()
+            raise errors.InternalError(
+                f"sm segment {name}: {seg_len} bytes < {expect} expected"
+            )
+        self._base = _SEG_HDR + src_rank * span
+        self._head = _U64.unpack_from(mm, self._base)[0]
+        self._mv = memoryview(mm)  # see SmSegment: no-copy slot windows
+        self._lock = threading.Lock()
+        self._dead = False
+
+    # -- producer --------------------------------------------------------
+
+    def _wait_slot(self, deadline: float, abort) -> None:
+        """Block until the ring has a free slot.  ``abort()`` is
+        consulted every spin so peer death / local close classifies
+        promptly instead of riding out the stall timeout."""
+        mm = self._mm
+        spins = 0
+        while True:
+            # a stopped consumer is checked BEFORE accepting a free
+            # slot: publishing into a ring nobody will ever drain again
+            # would report success for up to a whole ring of silently
+            # lost messages — the TCP path errors after at most one
+            # kernel-buffered send, and the sm path must match it
+            if _U32.unpack_from(mm, _OFF_STOPPED)[0]:
+                if spins:
+                    spc.record("sm_ring_full_spins", spins)
+                raise errors.InternalError(
+                    f"sm ring to rank {self.dest}: consumer stopped"
+                )
+            tail = _U64.unpack_from(mm, self._base + 64)[0]
+            if self._head - tail < self.nslots:
+                if spins:
+                    spc.record("sm_ring_full_spins", spins)
+                return
+            if abort is not None:
+                abort()
+            if time.monotonic() > deadline:
+                spc.record("sm_ring_full_spins", spins)
+                raise errors.InternalError(
+                    f"sm ring to rank {self.dest} full past the stall "
+                    "timeout (peer wedged?)"
+                )
+            spins += 1
+            time.sleep(0 if spins < 200 else 0.00005)
+
+    def _doorbell(self) -> None:
+        mm = self._mm
+        _fence()  # head store must precede the sleep-flag load
+        if _U32.unpack_from(mm, _OFF_DOORBELL)[0]:
+            _U32.pack_into(mm, _OFF_DOORBELL, 0)
+            _futex_wake(mm, _OFF_DOORBELL)
+
+    def _publish(self, slot: int, frag_len: int, total: int) -> None:
+        # the head store is the release edge: payload + slot header must
+        # be globally visible first.  Program order suffices on TSO; the
+        # fence (atomic RMW) makes it hold on weaker architectures — the
+        # discipline the C shim's release store encodes
+        mm = self._mm
+        _SLOT.pack_into(mm, slot, frag_len, total)
+        _fence()
+        self._head += 1
+        _U64.pack_into(mm, self._base, self._head)
+        self._doorbell()
+
+    def _slot_at(self, idx: int) -> int:
+        return self._base + _RING_HDR + \
+            (idx % self.nslots) * (_SLOT_HDR + self.slot_bytes)
+
+    def send_direct(self, objs: tuple, oob_min: int, deadline: float,
+                    abort) -> int | None:
+        """Single-slot fast path: acquire a slot and pack the DSS header
+        straight into slot memory (``dss.pack_frames_into`` — no
+        intermediate header buffer), then copy the out-of-band segments
+        behind it.  Returns on-ring bytes, or None when the frame does
+        not fit one slot (caller takes the fragment pipeline)."""
+        with self._lock:
+            if self._dead:
+                raise errors.InternalError(
+                    f"sm ring to rank {self.dest} is torn down"
+                )
+            self._wait_slot(deadline, abort)
+            slot = self._slot_at(self._head)
+            window = self._mv[slot + _SLOT_HDR:
+                              slot + _SLOT_HDR + self.slot_bytes]
+            try:
+                try:
+                    hlen, segs = dss.pack_frames_into(
+                        window, *objs, oob_min=oob_min
+                    )
+                except errors.TruncateError:
+                    return None  # header alone overflows: fragment path
+                total = hlen + sum(s.nbytes for s in segs)
+                if total > self.slot_bytes:
+                    return None
+                off = hlen
+                for seg in segs:
+                    v = seg if seg.format == "B" and seg.ndim == 1 \
+                        else seg.cast("B")
+                    window[off:off + v.nbytes] = v
+                    off += v.nbytes
+            finally:
+                window.release()
+            self._publish(slot, total, total)
+            return total + _SLOT_HDR
+
+    def send_frame(self, header, segments, deadline: float,
+                   abort) -> tuple[int, int]:
+        """Stream one frame (header + out-of-band segments) as a
+        fragment pipeline: each fragment is copied from the caller's
+        buffers straight into slot memory and published immediately, so
+        the consumer overlaps assembly with the remaining copies.
+        Returns ``(on_ring_bytes, nfrags)``."""
+        views = [memoryview(header)]
+        for seg in segments:
+            v = seg if isinstance(seg, memoryview) else memoryview(seg)
+            if v.format != "B" or v.ndim != 1:
+                v = v.cast("B")
+            views.append(v)
+        views = [v for v in views if v.nbytes]
+        total = sum(v.nbytes for v in views)
+        if total >= 1 << 32:
+            raise errors.ArgError(
+                f"sm frame of {total} bytes exceeds the u32 framing"
+            )
+        mm = self._mm
+        slot_bytes = self.slot_bytes
+        with self._lock:
+            if self._dead:
+                raise errors.InternalError(
+                    f"sm ring to rank {self.dest} is torn down"
+                )
+            vi, voff = 0, 0
+            remaining = total
+            nfrags = 0
+            # adaptive fragment size: aim for ~8 fragments so the
+            # consumer's copy-out overlaps the remaining copy-ins (the
+            # pipeline is the whole point — measured 3x on 64 KiB
+            # messages vs one serial copy-in/copy-out), but never below
+            # 16 KiB: per-fragment interpreter overhead dominates tiny
+            # slots and would erase the multi-MiB win
+            pipe = min(slot_bytes, max(16 << 10, total // 8))
+            while True:
+                self._wait_slot(deadline, abort)
+                slot = self._slot_at(self._head)
+                frag = min(pipe, remaining)
+                off = slot + _SLOT_HDR
+                left = frag
+                while left:
+                    v = views[vi]
+                    take = min(left, v.nbytes - voff)
+                    mm[off:off + take] = v[voff:voff + take]
+                    off += take
+                    voff += take
+                    left -= take
+                    if voff == v.nbytes:
+                        vi += 1
+                        voff = 0
+                self._publish(slot, frag, total)
+                nfrags += 1
+                remaining -= frag
+                if remaining == 0:
+                    break
+        return total + nfrags * _SLOT_HDR, nfrags
+
+    # -- quiesce / teardown ---------------------------------------------
+
+    def pending(self) -> int:
+        """Fragments published but not yet consumed-and-delivered (the
+        close-quiesce probe); 0 once the peer delivered everything.
+        Lock-free: the failure listener may close() this sender from
+        another thread mid-probe, and a probe of a just-closed mmap
+        must read as drained, not crash the closing proc."""
+        if self._dead:
+            return 0
+        try:
+            return self._head - _U64.unpack_from(self._mm,
+                                                 self._base + 64)[0]
+        except ValueError:  # closed under us: nothing left to wait for
+            return 0
+
+    def peer_stopped(self) -> bool:
+        if self._dead:
+            return True
+        try:
+            return bool(_U32.unpack_from(self._mm, _OFF_STOPPED)[0])
+        except ValueError:
+            return True
+
+    def close(self) -> None:
+        with self._lock:
+            if self._dead:
+                return
+            self._dead = True
+            self._mv.release()
+            try:
+                self._mm.close()
+            except BufferError:  # pragma: no cover - view leaked
+                pass

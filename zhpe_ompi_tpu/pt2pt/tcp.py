@@ -18,6 +18,13 @@ dpm, shmem bookkeeping, file coordination.  This module is that wire:
 - **matching**: incoming frames feed the same matching engine the local
   universe uses — transport and semantics stay decoupled exactly as
   BTL/PML are.
+- **selection**: per-peer transport dispatch at the send seam — the
+  decision ladder is self → sm → tcp: rank-to-self takes the loopback
+  shortcut, a same-boot peer that advertised a shared-memory segment
+  rides the mmap ring (``pt2pt/sm.py``, chosen while ``sm_priority``
+  exceeds ``tcp_priority``), everything else — remote hosts, mixed
+  ``sm=0`` pairs, respawned rejoiners, dpm bridges, and the whole FT
+  control family — rides the sockets below.
 
 ``TcpProc`` mirrors :class:`~zhpe_ompi_tpu.pt2pt.universe.RankContext``'s
 API (send/recv/probe/sendrecv/barrier), so everything built on rank
@@ -51,6 +58,7 @@ from ..mca import var as mca_var
 from ..runtime import spc
 from ..utils import dss
 from . import matching
+from . import sm as sm_mod
 from .matching import ANY_SOURCE, ANY_TAG, Envelope
 
 _stream = mca_output.open_stream("btl_tcp")
@@ -69,6 +77,14 @@ mca_var.register(
     "Array payload size (bytes) at/above which contiguous ndarray "
     "payloads ride the out-of-band zero-copy frame path (dss.pack_frames "
     "memoryview segments over sendmsg); 0 = every contiguous array",
+    type=int,
+)
+mca_var.register(
+    "tcp_priority", 20,
+    "Endpoint-selection priority of the tcp transport (btl_tcp_priority "
+    "shape): a same-host peer rides the shared-memory ring only while "
+    "sm_priority exceeds this — raise it above sm_priority to force the "
+    "wire path per-pair without tearing the rings down",
     type=int,
 )
 mca_var.register(
@@ -369,7 +385,9 @@ class TcpProc(errh.HasErrhandler, ulfm.UlfmEndpointAPI, HostCollectives,
                  on_coordinator_bound=None,
                  external_coordinator: bool = False,
                  ft: bool = False,
-                 rejoin_book: list | None = None):
+                 rejoin_book: list | None = None,
+                 sm: bool | None = None,
+                 sm_boot_id: str | None = None):
         if size < 1:
             raise errors.ArgError("size must be >= 1")
         if rejoin_book is not None and not ft:
@@ -404,63 +422,106 @@ class TcpProc(errh.HasErrhandler, ulfm.UlfmEndpointAPI, HostCollectives,
             weakref.WeakKeyDictionary()  # socket -> its framing lock
         self._closed = threading.Event()
         self._incoming_cv = threading.Condition()
+        # shared-memory plane (btl/sm analog): create OUR inbound-ring
+        # segment before the modex so the card can advertise a segment
+        # that already exists — a peer that got the book can map it with
+        # no handshake and no transport-switch reordering window.
+        # Respawned (rejoin) ranks stay TCP: the C plane's "spawn joins
+        # stay TCP" cohort contract — survivors scrub the joiner's card.
+        self._sm_seg: sm_mod.SmSegment | None = None
+        self._sm_senders: dict[int, sm_mod.SmSender | None] = {}
+        self._sm_declined: set[int] = set()  # advertised sm, not ridden
+        self._sm_lock = threading.Lock()
+        self._sm_boot = sm_boot_id or sm_mod.boot_token()
+        sm_on = bool(int(mca_var.get("sm", 1))) if sm is None else bool(sm)
+        if sm_on and size > 1 and rejoin_book is None:
+            try:
+                self._sm_seg = sm_mod.SmSegment(
+                    rank, size, on_frame=self._sm_incoming
+                )
+            except OSError as e:
+                mca_output.emit(
+                    _stream,
+                    "rank %s: sm segment unavailable (%s); host plane "
+                    "degrades to TCP", rank, e,
+                )
         # rejoin handshake state: survivor JOIN_ACKs carrying their
         # collective/agreement counters + crash epoch (see _announce_join)
         self._join_cv = threading.Condition()
         self._join_acks: dict[int, tuple[int, int, int]] = {}
 
-        # listening socket (btl_tcp's per-proc endpoint)
-        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
-        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        self._listener.bind((host, 0))
-        self._listener.listen(size + 4)
-        self.address = self._listener.getsockname()
+        try:
+            # listening socket (btl_tcp's per-proc endpoint)
+            self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            self._listener.bind((host, 0))
+            self._listener.listen(size + 4)
+            self.address = self._listener.getsockname()
 
-        self._accept_thread = threading.Thread(
-            target=self._accept_loop, daemon=True
-        )
-        self._accept_thread.start()
-
-        # modex: address-book exchange through the coordinator.
-        # `on_coordinator_bound(addr)` fires on rank 0 after the rendezvous
-        # socket is bound but BEFORE the blocking gather — the hook a
-        # launcher uses to forward an ephemeral coordinator address to the
-        # other ranks (prte forwarding the PMIx URI).  With a fixed,
-        # pre-agreed port it is unnecessary.
-        self._on_coordinator_bound = on_coordinator_bound
-        # external_coordinator: a launcher hosts the rendezvous (the
-        # PRRTE-hosts-the-PMIx-server shape) — rank 0 joins as a client
-        # instead of binding the coordinator address itself
-        self._external_coordinator = external_coordinator
-        if rejoin_book is not None:
-            # respawned rank: no modex rendezvous exists anymore — adopt
-            # the survivors' address book with OUR fresh endpoint in the
-            # old slot; the JOIN announce below re-modexes the survivors
-            self.address_book = [tuple(a[:2]) for a in rejoin_book]
-            self.address_book[rank] = tuple(self.address)
-        else:
-            self.address_book = self._modex(coordinator, timeout)
-        mca_output.verbose(
-            5, _stream, "rank %d up at %s; book=%s", rank, self.address,
-            self.address_book,
-        )
-        if ft:
-            if rejoin_book is not None:
-                # announce BEFORE the detector starts: beats toward a
-                # survivor that has not yet swapped in the fresh
-                # endpoint would ride (and warm) a stale address
-                self._announce_join(timeout)
-            # ring heartbeat detector over framed beats: this rank emits
-            # to its nearest live predecessor, observes its nearest live
-            # successor, floods suspicion (the ULFM detector shape)
-            self._detector = ulfm.RingDetector(
-                rank, size, self.ft_state,
-                transport=ulfm.WireTransport(rank, size, self._ft_emit),
-                flood=self._ft_flood,
-                muted=lambda: self._ft_dead,
-                name=f"hb-tcp-{rank}",
+            self._accept_thread = threading.Thread(
+                target=self._accept_loop, daemon=True
             )
-            self._detector.start()
+            self._accept_thread.start()
+
+            # modex: address-book exchange through the coordinator.
+            # `on_coordinator_bound(addr)` fires on rank 0 after the rendezvous
+            # socket is bound but BEFORE the blocking gather — the hook a
+            # launcher uses to forward an ephemeral coordinator address to the
+            # other ranks (prte forwarding the PMIx URI).  With a fixed,
+            # pre-agreed port it is unnecessary.
+            self._on_coordinator_bound = on_coordinator_bound
+            # external_coordinator: a launcher hosts the rendezvous (the
+            # PRRTE-hosts-the-PMIx-server shape) — rank 0 joins as a client
+            # instead of binding the coordinator address itself
+            self._external_coordinator = external_coordinator
+            if rejoin_book is not None:
+                # respawned rank: no modex rendezvous exists anymore —
+                # adopt the survivors' address book with OUR fresh
+                # endpoint in the old slot; the JOIN announce below
+                # re-modexes the survivors.  Only the (host, port)
+                # prefix is adopted: the survivors' pre-crash sm cards
+                # point at rings whose peer half died with the old
+                # incarnation, and rejoiners ride TCP anyway.
+                self._peer_cards = [list(a[:2]) for a in rejoin_book]
+                self.address_book = [tuple(a[:2]) for a in rejoin_book]
+                self.address_book[rank] = tuple(self.address)
+            else:
+                self.address_book = self._modex(coordinator, timeout)
+            mca_output.verbose(
+                5, _stream, "rank %d up at %s; book=%s", rank, self.address,
+                self.address_book,
+            )
+            if ft:
+                # peer death ⇒ ring teardown: the sm transport unmaps its
+                # ring into a corpse the moment classification learns of it
+                # (detector, transport error, notice flood, or goodbye)
+                self.ft_state.add_failure_listener(self._sm_peer_dead)
+                if rejoin_book is not None:
+                    # announce BEFORE the detector starts: beats toward a
+                    # survivor that has not yet swapped in the fresh
+                    # endpoint would ride (and warm) a stale address
+                    self._announce_join(timeout)
+                # ring heartbeat detector over framed beats: this rank emits
+                # to its nearest live predecessor, observes its nearest live
+                # successor, floods suspicion (the ULFM detector shape)
+                self._detector = ulfm.RingDetector(
+                    rank, size, self.ft_state,
+                    transport=ulfm.WireTransport(rank, size, self._ft_emit),
+                    flood=self._ft_flood,
+                    muted=lambda: self._ft_dead,
+                    name=f"hb-tcp-{rank}",
+                )
+                self._detector.start()
+        except BaseException:
+            # a proc that never finished wiring up still owns a
+            # mapped segment and a poll thread, and nobody will
+            # ever call close() on a constructor that raised —
+            # the zero-orphan/zero-leak lifecycle contract is
+            # honored HERE, whichever construction step failed
+            # (listener bind, accept start, modex, JOIN, detector)
+            if self._sm_seg is not None:
+                self._sm_seg.close()
+            raise
 
     def _framed_send(self, sock: socket.socket, frame) -> None:
         """Frames must not interleave on ONE socket, but independent
@@ -475,6 +536,168 @@ class TcpProc(errh.HasErrhandler, ulfm.UlfmEndpointAPI, HostCollectives,
                 lock = self._sock_locks[sock] = threading.Lock()
         with lock:
             _send_frame(sock, frame)
+
+    # -- shared-memory plane (btl/sm analog) ----------------------------
+
+    def _sm_tx(self, dest: int) -> sm_mod.SmSender | None:
+        """Per-peer transport selection, memoized: the sm ring when the
+        peer advertised a same-boot segment AND sm outranks tcp
+        (``sm_priority > tcp_priority``, the btl priority ladder), else
+        None (TCP).  The decision is made ONCE per peer — a direction
+        is all-ring or all-wire, so per-source FIFO needs no cross-
+        transport sequence numbers (the reason the C plane routes a
+        direction's ENTIRE main channel over one transport)."""
+        if self._sm_seg is None:
+            return None
+        with self._sm_lock:
+            if dest in self._sm_senders:
+                return self._sm_senders[dest]
+            sender = self._sm_activate(dest)
+            self._sm_senders[dest] = sender
+            return sender
+
+    def _sm_activate(self, dest: int) -> sm_mod.SmSender | None:
+        if int(mca_var.get("sm_priority", 90)) <= \
+                int(mca_var.get("tcp_priority", 20)):
+            return None  # policy, not degradation: nothing to count
+        cards = getattr(self, "_peer_cards", None)
+        if cards is None or dest >= len(cards):
+            return None
+        card = sm_mod.parse_card(cards[dest])
+        if card is None:
+            return None  # peer runs sm=0 / is a C rank: intended TCP
+        boot, name = card
+        if boot != self._sm_boot:
+            # mismatched boot id: the advertised /dev/shm namespace is
+            # not provably ours — degrade loudly (counted per send)
+            self._sm_declined.add(dest)
+            return None
+        try:
+            sender = sm_mod.SmSender(name, src_rank=self.rank,
+                                     dest_rank=dest)
+        except (OSError, errors.MpiError) as e:
+            mca_output.emit(
+                _stream,
+                "rank %s: sm segment of rank %s unmappable (%s); pair "
+                "degrades to TCP", self.rank, dest, e,
+            )
+            self._sm_declined.add(dest)
+            return None
+        mca_output.verbose(
+            5, _stream, "rank %d: sm ring to rank %d active (%s)",
+            self.rank, dest, name,
+        )
+        return sender
+
+    def _sm_send(self, smtx: sm_mod.SmSender, obj: Any, dest: int,
+                 tag: int, cid: int, seq: int, nbytes: int) -> None:
+        """One frame onto the peer's ring — the `_send_frame`-shaped
+        seam of the sm plane.  Small frames pack their DSS header
+        straight into the slot (``pack_frames_into``); larger ones take
+        the fragment pipeline.  Ring backpressure (a full ring blocks
+        HERE, with the peer's death classifying out of the spin) is the
+        sm analog of the rendezvous receiver-memory bound: at most one
+        message per direction ever occupies more than the ring."""
+        state = self.ft_state
+        closed = self._closed
+
+        def abort():
+            if closed.is_set():
+                raise errors.InternalError(
+                    f"sm send to rank {dest} on a closed proc"
+                )
+            if state is not None and state.is_failed(dest):
+                raise errors.ProcFailed(
+                    f"rank {dest} failed during an sm ring send",
+                    failed_ranks=state.failed(),
+                )
+
+        abort()
+        oob_min = int(mca_var.get("tcp_zero_copy_min", 0))
+        deadline = time.monotonic() + self._timeout
+        wire = None
+        # direct (single-slot) only for SMALL frames: a mid-size message
+        # is faster as a fragment pipeline — the peer's copy-out overlaps
+        # our remaining copy-ins — so the pack-into fast path stops well
+        # below the slot size
+        if nbytes + 512 <= min(smtx.slot_bytes, 32 << 10):
+            wire = smtx.send_direct(
+                (self.rank, tag, cid, seq, obj), oob_min, deadline,
+                abort,
+            )
+            nfrags = 1
+        if wire is None:
+            header, oob = dss.pack_frames(
+                self.rank, tag, cid, seq, obj, oob_min=oob_min,
+            )
+            wire, nfrags = smtx.send_frame(header, oob, deadline, abort)
+        spc.record("sm_bytes_sent", wire)
+        spc.record("sm_eager_sends" if nfrags == 1 else "sm_frag_sends",
+                   1)
+
+    def _sm_incoming(self, src_ring: int, frame: bytearray) -> None:
+        """Poll-thread delivery: one assembled frame in a dedicated
+        writable buffer — same contract as the socket drain loop, one
+        matching engine for both transports."""
+        try:
+            [src, tag, cid, seq, payload] = dss.unpack_from(frame)
+        except errors.MpiError as e:
+            mca_output.emit(
+                _stream,
+                "rank %s: undecodable sm frame from ring %s: %s",
+                self.rank, src_ring, e,
+            )
+            return
+        if self.ft_state is not None and cid in (
+            ulfm.FT_HB_CID, ulfm.FT_NOTICE_CID, ulfm.FT_REVOKE_CID,
+            ulfm.FT_AGREE_PUB_CID, ulfm.FT_BYE_CID,
+        ):
+            # the FT control family beats over TCP by design, with ONE
+            # exception: the orderly-departure BYE of an sm peer rides
+            # its ring so it trails every data frame already produced
+            # (the per-direction FIFO the goodbye contract needs)
+            self._ft_ctrl(cid, src, payload)
+            return
+        env = Envelope(src, tag, cid, seq)
+        with self._incoming_cv:
+            self.engine.incoming(env, payload)
+            self._incoming_cv.notify_all()
+
+    def _sm_peer_dead(self, rank: int, _cause: str) -> None:
+        """Failure-listener hook (``FailureState.add_failure_listener``):
+        a dead peer's consumer is never coming back — unmap our ring
+        into it and pin the pair to TCP permanently (a respawned
+        incarnation rides TCP per the cohort contract)."""
+        with self._sm_lock:
+            stale = self._sm_senders.get(rank)
+            self._sm_senders[rank] = None
+            self._sm_declined.discard(rank)
+        if stale is not None:
+            stale.close()
+
+    def _sm_quiesce(self, deadline: float) -> None:
+        """Bounded wait for peers to consume-and-deliver our outbound
+        ring frames: the BYE goodbye below rides TCP, so without this
+        it could overtake ring data still in flight and reclassify
+        delivered messages as lost.  A peer whose poll loop already
+        stopped can never drain — skip it."""
+        with self._sm_lock:
+            senders = [s for s in self._sm_senders.values()
+                       if s is not None]
+        for s in senders:
+            while s.pending() and not s.peer_stopped() \
+                    and time.monotonic() < deadline:
+                time.sleep(0.0005)
+
+    def _sm_teardown(self) -> None:
+        with self._sm_lock:
+            senders = [s for s in self._sm_senders.values()
+                       if s is not None]
+            self._sm_senders = {r: None for r in self._sm_senders}
+        for s in senders:
+            s.close()
+        if self._sm_seg is not None:
+            self._sm_seg.close()
 
     # -- ULFM control plane ---------------------------------------------
 
@@ -637,6 +860,15 @@ class TcpProc(errh.HasErrhandler, ulfm.UlfmEndpointAPI, HostCollectives,
                 except OSError:
                     pass
             self.address_book[jrank] = addr
+            cards = getattr(self, "_peer_cards", None)
+            if cards is not None and jrank < len(cards):
+                # scrub the dead incarnation's sm card: the respawned
+                # rank rides TCP (cohort contract) and must not count
+                # as a silent sm fallback either
+                cards[jrank] = list(addr)
+            with self._sm_lock:
+                self._sm_senders[jrank] = None
+                self._sm_declined.discard(jrank)
             if self._detector is not None:
                 self._detector.transport.grace(jrank)
             self.ft_state.restore(jrank)
@@ -680,6 +912,11 @@ class TcpProc(errh.HasErrhandler, ulfm.UlfmEndpointAPI, HostCollectives,
         # a crash abandons its pushes: mark the pool closed so idle
         # workers exit (the hygiene gate counts worker threads)
         self._push_pool.close(0.0)
+        if self._sm_seg is not None:
+            # consumption stops (the crash contract) but the segment
+            # FILE survives — a real crash cleans nothing up; the final
+            # harness close()/launcher sweep owns the unlink
+            self._sm_seg.sever()
         try:
             self._listener.close()
         except OSError:
@@ -710,6 +947,17 @@ class TcpProc(errh.HasErrhandler, ulfm.UlfmEndpointAPI, HostCollectives,
 
     # -- wire-up ---------------------------------------------------------
 
+    def _my_card(self) -> list:
+        """This rank's modex business card: ``[host, port]`` plus
+        capability items — the sm segment advertisement rides here the
+        way C ranks advertise their ring capability (extra items are
+        relayed verbatim and ignored by consumers that only dial
+        sockets)."""
+        card = list(self.address)
+        if self._sm_seg is not None:
+            card.append(self._sm_seg.card(self._sm_boot))
+        return card
+
     def _modex(self, coordinator: tuple[str, int], timeout: float
                ) -> list[tuple[str, int]]:
         if self.rank == 0 and not self._external_coordinator:
@@ -721,7 +969,7 @@ class TcpProc(errh.HasErrhandler, ulfm.UlfmEndpointAPI, HostCollectives,
             if self._on_coordinator_bound is not None:
                 self._on_coordinator_bound(self.coordinator_address)
             book: list[Any] = [None] * self.size
-            book[0] = list(self.address)
+            book[0] = self._my_card()
             peers = []
             srv.settimeout(timeout)
             for _ in range(self.size - 1):
@@ -736,7 +984,9 @@ class TcpProc(errh.HasErrhandler, ulfm.UlfmEndpointAPI, HostCollectives,
             srv.close()
             # the RELAYED book keeps every card verbatim (C peers read
             # capability items); the LOCAL book normalizes to
-            # (host, port) — Python consumers address sockets only
+            # (host, port) — the full cards are kept for the sm
+            # transport's endpoint selection
+            self._peer_cards = [list(a) for a in book]
             return [tuple(a[:2]) for a in book]
         cli = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         cli.settimeout(timeout)
@@ -771,11 +1021,13 @@ class TcpProc(errh.HasErrhandler, ulfm.UlfmEndpointAPI, HostCollectives,
             # return value becomes the API result (the error-recovery
             # contract of core/errhandler.py)
             return self.call_errhandler(exc)
-        _send_frame(cli, dss.pack(self.rank, list(self.address)))
+        _send_frame(cli, dss.pack(self.rank, self._my_card()))
         [book] = dss.unpack(_recv_frame(cli))
         cli.close()
         # normalize at the boundary: C ranks' cards may carry extra
-        # capability items beyond (host, port)
+        # capability items beyond (host, port); keep the raw cards for
+        # the sm transport's endpoint selection
+        self._peer_cards = [list(a) for a in book]
         return [tuple(a[:2]) for a in book]
 
     def _accept_loop(self) -> None:
@@ -1041,6 +1293,30 @@ class TcpProc(errh.HasErrhandler, ulfm.UlfmEndpointAPI, HostCollectives,
                 self._incoming_cv.notify_all()
             return
         nbytes = _payload_size(obj)
+        # per-peer transport dispatch (the btl selection seam): the sm
+        # ring wins for same-boot peers by priority; everything below —
+        # eager/rendezvous split, SPC accounting, FT classification —
+        # is the TCP path the pair degrades to
+        smtx = self._sm_tx(dest)
+        if smtx is not None:
+            try:
+                self._sm_send(smtx, obj, dest, tag, cid, seq, nbytes)
+                return
+            except errors.ProcFailed as exc:
+                if poll:
+                    raise
+                return self.call_errhandler(exc)
+            except errors.InternalError as exc:
+                # wedged/closed ring: a transport failure, not a crash —
+                # same disposition routing as a TCP stall would get
+                if poll:
+                    raise
+                return self.call_errhandler(exc)
+        if dest in self._sm_declined:
+            # the peer advertised an sm endpoint we could not ride
+            # (boot mismatch, unmappable segment): the degradation is
+            # visible, not silent — the OSU ladder gate asserts zero
+            spc.record("sm_fallback_tcp_sends", 1)
         limit = int(mca_var.get("tcp_eager_limit", 1 << 20))
         try:
             if nbytes > limit:
@@ -1359,6 +1635,13 @@ class TcpProc(errh.HasErrhandler, ulfm.UlfmEndpointAPI, HostCollectives,
         while self._pending_rndv and time.monotonic() < deadline:
             time.sleep(0.005)
         if self.ft_state is not None and not self._ft_dead:
+            # the goodbye rides TCP while data may still sit in peers'
+            # rings: wait (bounded) for our outbound rings to drain so
+            # the BYE cannot overtake delivered-but-unread ring frames
+            # — the per-socket-FIFO ordering argument, restored across
+            # the transport split
+            self._sm_quiesce(min(deadline, time.monotonic() + 5.0))
+        if self.ft_state is not None and not self._ft_dead:
             # orderly departure: tell the survivors we are LEAVING, so
             # their detectors reconfigure the ring instead of suspecting
             # us via missed beats (cause="goodbye", pre-acknowledged:
@@ -1370,18 +1653,36 @@ class TcpProc(errh.HasErrhandler, ulfm.UlfmEndpointAPI, HostCollectives,
             # reclassified as lost.
             goodbye = dss.pack(self.rank, 0, ulfm.FT_BYE_CID, 0,
                                [self.rank])
-            # only ALREADY-CONNECTED peers get the goodbye directly:
-            # they are the ones holding delivered frames the notice must
-            # trail (the FIFO argument), and our observer is among them
-            # by construction — we beat toward it over a cached socket.
-            # Dialing fresh connections just to say goodbye would stall
-            # shutdown on refused-connect retries for peers already
-            # gone; recipients gossip the BYE onward (_ft_ctrl), so
-            # never-connected survivors still learn of the departure.
+            # sm peers get the goodbye THROUGH their ring: it then
+            # trails every data frame this direction ever produced
+            # (exact per-direction FIFO — the same argument per-socket
+            # ordering makes below), and it reaches peers the data
+            # plane never warmed a TCP connection for
+            ring_done: set[int] = set()
+            with self._sm_lock:
+                ring_peers = [(r, s) for r, s in self._sm_senders.items()
+                              if s is not None]
+            for r, smtx in ring_peers:
+                if self.ft_state.is_failed(r):
+                    continue
+                try:
+                    smtx.send_frame(goodbye, [],
+                                    time.monotonic() + 2.0, None)
+                    ring_done.add(r)
+                except errors.MpiError:
+                    pass  # wedged/stopped ring: fall through to TCP
+            # remaining peers: only ALREADY-CONNECTED ones get the
+            # goodbye directly — they are the ones holding delivered
+            # frames the notice must trail, and our observer is among
+            # them by construction (we beat toward it over a cached
+            # socket).  Dialing fresh connections just to say goodbye
+            # would stall shutdown on refused-connect retries for peers
+            # already gone; recipients gossip the BYE onward
+            # (_ft_ctrl), so never-connected survivors still learn.
             with self._conn_lock:
                 connected = list(self._conns.items())
             for r, sock in connected:
-                if not isinstance(r, int) \
+                if not isinstance(r, int) or r in ring_done \
                         or r == self.rank or self.ft_state.is_failed(r):
                     # tuple keys are intercomm-bridge peers: a DIFFERENT
                     # job's rank namespace, where our departing rank
@@ -1432,6 +1733,10 @@ class TcpProc(errh.HasErrhandler, ulfm.UlfmEndpointAPI, HostCollectives,
         # (or wedged on a dead peer, bounded by the join deadline) — the
         # conftest leak gate asserts none survive
         self._push_pool.close(max(0.0, deadline - time.monotonic()))
+        # sm plane last: poll thread joined, peer mappings unmapped, own
+        # segment unlinked — the lifecycle contract the hygiene gate
+        # asserts (rings live exactly as long as their proc)
+        self._sm_teardown()
         try:
             self._listener.close()
         except OSError:

@@ -25,6 +25,28 @@ Wire-plane counters (recorded by ``pt2pt/tcp.py``):
 - ``tcp_loopback_fast_deliveries`` — rank-to-self sends delivered by the
   single-defensive-copy shortcut instead of a full DSS round trip.
 - ``tcp_rndv_sends`` — rendezvous (RTS/CTS) transfers initiated.
+
+Shared-memory-plane counters (recorded at the per-peer transport
+dispatch seam in ``pt2pt/tcp.py``; the rings live in ``pt2pt/sm.py``):
+
+- ``sm_bytes_sent`` / ``sm_bytes_recvd`` — ACTUAL on-ring bytes: every
+  fragment's payload plus its 16-byte slot header.  ``recvd`` counts at
+  consume time, so a frame parked in a dead peer's ring is visible as a
+  sent/recvd imbalance.
+- ``sm_eager_sends`` — messages that fit one ring slot (DSS header
+  packed straight into slot memory via ``dss.pack_frames_into``; one
+  sender-side copy total).
+- ``sm_frag_sends`` — messages that took the multi-slot fragment
+  pipeline (``sm_max_frag`` per slot; the consumer frees slots while
+  the producer still copies).
+- ``sm_ring_full_spins`` — producer spins on a full ring (backpressure:
+  the in-flight bound the ring capacity enforces); a high rate means
+  ``sm_ring_bytes`` is undersized for the traffic.
+- ``sm_fallback_tcp_sends`` — data sends to a peer that ADVERTISED a
+  shared-memory endpoint we could not ride (boot-id mismatch or an
+  unmappable segment): visible degradation, asserted zero along the
+  OSU ``--plane sm`` ladder.  Intentional TCP (``sm=0``, remote hosts,
+  C ranks, rejoiners) is not counted.
 """
 
 from __future__ import annotations

@@ -235,7 +235,8 @@ def _sweep_session_shm(port: int) -> None:
     try:
         for f in os.listdir("/dev/shm"):
             if f.startswith(f"zompi_ring_{port}_") or \
-                    f.startswith(f"zompi_shm_{port}_"):
+                    f.startswith(f"zompi_shm_{port}_") or \
+                    f.startswith(f"zompi_pyring_{port}_"):
                 try:
                     os.unlink(os.path.join("/dev/shm", f))
                 except OSError:

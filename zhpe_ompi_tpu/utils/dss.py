@@ -320,6 +320,69 @@ def pack(*objs: Any) -> bytes:
     return bytes(out)
 
 
+class _BufferSink:
+    """bytearray-shaped adapter over a caller-provided writable buffer:
+    the pack machinery appends through it, writing header bytes straight
+    into their final destination (a shared-memory ring slot) instead of
+    an intermediate bytearray.  Overflow raises ``TruncateError`` — the
+    partial write is garbage the caller must discard (an unpublished
+    ring slot satisfies this by construction)."""
+
+    __slots__ = ("mv", "pos")
+
+    def __init__(self, buf):
+        mv = buf if isinstance(buf, memoryview) else memoryview(buf)
+        if mv.readonly:
+            raise errors.ArgError("pack_frames_into needs a writable "
+                                  "buffer")
+        if mv.format != "B" or mv.ndim != 1:
+            mv = mv.cast("B")
+        self.mv = mv
+        self.pos = 0
+
+    def append(self, b: int) -> None:
+        if self.pos >= len(self.mv):
+            raise errors.TruncateError("dss: pack_frames_into overflow")
+        self.mv[self.pos] = b
+        self.pos += 1
+
+    def extend(self, data) -> None:
+        n = len(data)
+        if self.pos + n > len(self.mv):
+            raise errors.TruncateError("dss: pack_frames_into overflow")
+        self.mv[self.pos : self.pos + n] = bytes(data) \
+            if not isinstance(data, (bytes, bytearray, memoryview)) \
+            else data
+        self.pos += n
+
+    def __len__(self) -> int:
+        return self.pos
+
+
+def pack_frames_into(buf, *objs: Any, oob_min: int = 0
+                     ) -> tuple[int, list[memoryview]]:
+    """:func:`pack_frames`, but the header stream is packed directly
+    into ``buf`` (any writable buffer) — the write-into-buffer variant
+    the shared-memory ring's single-slot fast path uses to skip the
+    intermediate header bytearray entirely.  Returns
+    ``(header_nbytes, segments)``; the on-wire frame is
+    ``buf[:header_nbytes]`` followed by the segments in order.  Raises
+    ``TruncateError`` when the header alone outgrows ``buf`` (the
+    caller discards the partial write and takes the two-step path)."""
+    sink = _BufferSink(buf)
+    segs: list[memoryview] = []
+    slots: list[int] = []
+    _pack_varint(len(objs), sink)
+    for obj in objs:
+        _pack_one_frames(obj, sink, segs, slots, oob_min)
+    total = sum(s.nbytes for s in segs)
+    prefix = 0
+    for slot, seg in zip(slots, segs):
+        _OFE.pack_into(sink.mv, slot, total - prefix)
+        prefix += seg.nbytes
+    return sink.pos, segs
+
+
 def pack_frames(*objs: Any, oob_min: int = 0
                 ) -> tuple[bytes, list[memoryview]]:
     """Pack values into a header stream plus out-of-band raw segments.
