@@ -190,12 +190,14 @@ def bench_pt2pt(max_size: int = 4 << 20, iters: int = 50,
 
 
 def _run_tcp_ranks(n: int, fn, timeout: float = 180.0,
-                   sm: bool | None = None) -> list:
+                   sm: bool | None = None,
+                   kwargs_by_rank: dict | None = None) -> list:
     """Launch fn(proc) on n TcpProc ranks over localhost sockets; rank 0
     binds an ephemeral coordinator the others learn through the
     on_coordinator_bound hook (prte forwarding the PMIx URI).  ``sm``
     pins the shared-memory transport on/off per proc (None = MCA
-    default)."""
+    default); ``kwargs_by_rank`` adds per-rank constructor overrides
+    (the han ladder's emulated-host ``sm_boot_id`` pins)."""
     import threading
 
     from zhpe_ompi_tpu.pt2pt.tcp import TcpProc
@@ -206,18 +208,19 @@ def _run_tcp_ranks(n: int, fn, timeout: float = 180.0,
     excs: list = [None] * n
 
     def main(rank):
+        kw = dict((kwargs_by_rank or {}).get(rank, {}))
         try:
             if rank == 0:
                 proc = TcpProc(
                     0, n, coordinator=("127.0.0.1", 0), sm=sm,
                     on_coordinator_bound=lambda addr: (
-                        coord.append(addr), coord_ready.set()),
+                        coord.append(addr), coord_ready.set()), **kw,
                 )
             else:
                 if not coord_ready.wait(30.0) or not coord:
                     return  # rank 0 failed; its error is in excs[0]
                 proc = TcpProc(rank, n, coordinator=tuple(coord[0]),
-                               sm=sm)
+                               sm=sm, **kw)
             try:
                 results[rank] = fn(proc)
             finally:
@@ -351,17 +354,101 @@ def bench_sm(max_size: int = 4 << 20, iters: int = 50, bw: bool = False,
 
 # -------------------------------------------- real-process harness
 
+# counters every --plane han worker reports (deltas over its run); the
+# parent sums them across ranks for the silent-fallback and wire-byte
+# gates
+_HAN_COUNTERS = (
+    "han_flat_fallbacks", "coll_han_inter_bytes", "coll_han_intra_bytes",
+    "coll_han_leader_elections", "tcp_bytes_sent", "sm_bytes_sent",
+)
+
+
+def _han_worker_body(proc, spec: dict) -> tuple[list[dict], dict]:
+    """--plane han rank body: allreduce + bcast ladder on the emulated
+    mixed topology, result-checked per rung; per-rung seconds are the
+    BEST of `trials` timing windows (oversubscribed containers —
+    every rank polls, cores are shared — inflate single windows with
+    scheduler noise; the PR 4 sm-plane discipline), MAX-reduced over
+    the ranks so the reported latency is the slowest rank's (the OSU
+    convention for collectives).  Returns (rows — rank 0 only,
+    counter deltas)."""
+    from zhpe_ompi_tpu import ops
+    from zhpe_ompi_tpu.runtime import spc
+
+    n, rank = proc.size, proc.rank
+    iters = int(spec["iters"])
+    trials = max(1, int(spec.get("trials", 3)))
+    label = "flat" if spec["han_mode"] == "off" else "han"
+    rows: list[dict] = []
+    base = {c: spc.read(c) for c in _HAN_COUNTERS}
+    for nbytes in _sizes(int(spec["max_size"]),
+                         int(spec.get("min_bytes", 1 << 10))):
+        arr = np.full(max(n, nbytes // 8), float(rank + 1))
+        expect = float(n * (n + 1) // 2)
+        out = proc.allreduce(arr, ops.SUM)  # warmup + correctness
+        got = np.asarray(out).reshape(-1)
+        if got[0] != expect or got[-1] != expect:
+            raise RuntimeError(
+                f"{label} ladder: wrong allreduce at {arr.nbytes}B "
+                f"(got {got[0]}, want {expect})"
+            )
+        ar_sec = float("inf")
+        for _ in range(trials):
+            proc.barrier()
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                proc.allreduce(arr, ops.SUM)
+            ar_sec = min(ar_sec, (time.perf_counter() - t0) / iters)
+        payload = arr if rank == 0 else None
+        bc = proc.bcast(payload, 0)  # warmup + correctness
+        if np.asarray(bc).reshape(-1)[0] != 1.0:
+            raise RuntimeError(f"{label} ladder: wrong bcast payload")
+        bc_sec = float("inf")
+        for _ in range(trials):
+            proc.barrier()
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                proc.bcast(payload, 0)
+            bc_sec = min(bc_sec, (time.perf_counter() - t0) / iters)
+        for op, sec in (("allreduce", ar_sec), ("bcast", bc_sec)):
+            sec = float(np.asarray(
+                proc.allreduce(np.float64(sec), ops.MAX)))
+            if rank == 0:
+                rows.append({
+                    "op": f"{label}_host_{op}", "bytes": arr.nbytes,
+                    "latency_us": sec * 1e6,
+                    "bandwidth_MBps": (arr.nbytes / sec) / 1e6,
+                })
+        proc.barrier()
+    return rows, {c: spc.read(c) - base[c] for c in _HAN_COUNTERS}
+
+
 def _worker_main(spec: dict) -> int:
     """Entry point of a ``--real-procs`` rank (its own interpreter, its
     own GIL): joins the parent-reserved coordinator port, runs the
     requested ladder, and — on rank 0 — emits the rows plus the
-    sm-selection counters as one JSON line on stdout."""
+    sm-selection counters as one JSON line on stdout.  ``--plane han``
+    workers (kind "han") emit one line PER RANK: the parent needs every
+    rank's counter deltas (the flat ring's wire hops live on specific
+    ranks of the emulated topology)."""
     from zhpe_ompi_tpu.pt2pt.tcp import TcpProc
     from zhpe_ompi_tpu.runtime import spc
 
     rank, n = int(spec["rank"]), int(spec["size"])
     proc = TcpProc(rank, n, coordinator=("127.0.0.1", int(spec["port"])),
-                   timeout=120.0, sm=bool(spec.get("sm", True)))
+                   timeout=120.0, sm=bool(spec.get("sm", True)),
+                   sm_boot_id=spec.get("boot"))
+    if spec["kind"] == "han":
+        from zhpe_ompi_tpu.mca import var as mca_var
+
+        mca_var.set_var("coll_han_enable", spec["han_mode"])
+        try:
+            rows, deltas = _han_worker_body(proc, spec)
+        finally:
+            proc.close()
+        print(json.dumps({"rank": rank, "rows": rows,
+                          "counters": deltas}), flush=True)
+        return 0
     rows = []
     fb0 = spc.read("sm_fallback_tcp_sends")
     try:
@@ -407,17 +494,23 @@ def _worker_main(spec: dict) -> int:
     return 0
 
 
-def _run_proc_bench(spec: dict, nprocs: int) -> list[dict]:
+def _run_proc_bench(spec: dict, nprocs: int,
+                    rank_overrides: dict | None = None,
+                    collect_all: bool = False) -> list:
     """Spawn `nprocs` worker interpreters sharing a fixed coordinator
     port, parse rank 0's JSON report, and enforce the sm-selection
     gate across REAL process boundaries.  The ephemeral port is
     reserved by bind-then-close, so another process can steal it
     before rank 0 re-binds (TOCTOU) — a bind failure retries the whole
-    launch on a fresh port."""
+    launch on a fresh port.  ``rank_overrides`` merges per-rank spec
+    fields (the han ladder's emulated-host boot ids);
+    ``collect_all=True`` parses and returns EVERY rank's JSON report
+    instead of rank 0's rows."""
     last_exc: Exception | None = None
     for _attempt in range(3):
         try:
-            return _run_proc_bench_once(spec, nprocs)
+            return _run_proc_bench_once(spec, nprocs, rank_overrides,
+                                        collect_all)
         except RuntimeError as e:
             if "Address already in use" not in str(e):
                 raise
@@ -425,7 +518,9 @@ def _run_proc_bench(spec: dict, nprocs: int) -> list[dict]:
     raise last_exc
 
 
-def _run_proc_bench_once(spec: dict, nprocs: int) -> list[dict]:
+def _run_proc_bench_once(spec: dict, nprocs: int,
+                         rank_overrides: dict | None = None,
+                         collect_all: bool = False) -> list:
     import os
     import socket
     import subprocess
@@ -445,6 +540,7 @@ def _run_proc_bench_once(spec: dict, nprocs: int) -> list[dict]:
     try:
         for rank in range(nprocs):
             wspec = dict(spec, rank=rank, size=nprocs, port=port)
+            wspec.update((rank_overrides or {}).get(rank, {}))
             procs.append(subprocess.Popen(
                 [sys.executable, "-m", "benchmarks.osu_zmpi",
                  "--_worker", json.dumps(wspec)],
@@ -481,6 +577,8 @@ def _run_proc_bench_once(spec: dict, nprocs: int) -> list[dict]:
             if p.poll() is None:
                 p.kill()
                 p.wait()
+    if collect_all:
+        return [json.loads(out.strip().splitlines()[-1]) for out in outs]
     report = json.loads(outs[0].strip().splitlines()[-1])
     if not spec.get("sm", True):
         return report["rows"]  # tcp baseline run: no selection gate
@@ -495,6 +593,89 @@ def _run_proc_bench_once(spec: dict, nprocs: int) -> list[dict]:
             "processes (selection failed?)"
         )
     return report["rows"]
+
+
+def _run_han_threads(spec: dict, nprocs: int, boots: dict) -> list:
+    """Thread-harness variant of the han ladder (one process, shared
+    counters): used by the fast CI rows test; real deployments and the
+    slow gate use ``--real-procs``."""
+    from zhpe_ompi_tpu.mca import var as mca_var
+    from zhpe_ompi_tpu.runtime import spc
+
+    base = {c: spc.read(c) for c in _HAN_COUNTERS}
+    mca_var.set_var("coll_han_enable", spec["han_mode"])
+    try:
+        res = _run_tcp_ranks(
+            nprocs, lambda p: _han_worker_body(p, spec),
+            kwargs_by_rank={r: {"sm_boot_id": b} for r, b in boots.items()},
+        )
+    finally:
+        mca_var.unset("coll_han_enable")
+    rows = next(rows for rows, _deltas in res if rows)
+    return [{"rank": 0, "rows": rows,
+             "counters": {c: spc.read(c) - base[c]
+                          for c in _HAN_COUNTERS}}]
+
+
+def bench_han(max_size: int = 4 << 20, iters: int = 5, nprocs: int = 4,
+              hosts: int = 2, real_procs: bool = True) -> list[dict]:
+    """Hierarchical-collective ladder on an EMULATED mixed topology:
+    `nprocs` ranks carved into `hosts` same-boot groups (per-rank
+    ``sm_boot_id`` overrides — each emulated host's ranks share real
+    mmap rings, cross-host pairs degrade to TCP exactly like a real
+    2-host job), measuring flat (``coll_han_enable=off``) vs han
+    (``on``) allreduce + bcast at every size.  Gates — the sm plane's
+    loud-degradation discipline applied to the decision layer:
+
+    - the han run may not silently fall back to flat
+      (``han_flat_fallbacks`` summed over ranks must stay 0 on this
+      qualified 2-group topology);
+    - the leader phase must actually run (``coll_han_inter_bytes``
+      must rise);
+    - han's leader-phase payload bytes must stay STRICTLY below the
+      flat run's on-wire TCP bytes at equal total payload — the
+      fewer-wire-hops claim, byte-accounted rather than timed."""
+    group = max(1, -(-nprocs // hosts))
+    boots = {r: f"hanhost{r // group}" for r in range(nprocs)}
+    # a max_size below the ladder floor must still yield one rung, not
+    # an empty-rows crash after the workers already ran
+    spec_base = {"kind": "han", "max_size": max_size, "iters": iters,
+                 "min_bytes": max(1, min(1 << 10, max_size))}
+    out_rows: list[dict] = []
+    agg: dict[str, dict] = {}
+    for mode in ("off", "on"):
+        spec = dict(spec_base, han_mode=mode)
+        if real_procs:
+            reports = _run_proc_bench(
+                spec, nprocs,
+                rank_overrides={r: {"boot": b} for r, b in boots.items()},
+                collect_all=True,
+            )
+        else:
+            reports = _run_han_threads(spec, nprocs, boots)
+        rows = next(r["rows"] for r in reports if r["rows"])
+        agg[mode] = {
+            c: sum(r["counters"][c] for r in reports)
+            for c in _HAN_COUNTERS
+        }
+        out_rows += rows
+    if agg["on"]["han_flat_fallbacks"]:
+        raise RuntimeError(
+            f"han plane: {agg['on']['han_flat_fallbacks']} collective(s) "
+            "silently fell back to flat on a qualified topology"
+        )
+    if agg["on"]["coll_han_inter_bytes"] == 0:
+        raise RuntimeError(
+            "han plane: no leader-phase bytes moved (hierarchy never "
+            "engaged?)"
+        )
+    if agg["on"]["coll_han_inter_bytes"] >= agg["off"]["tcp_bytes_sent"]:
+        raise RuntimeError(
+            f"han plane: leader-phase bytes "
+            f"({agg['on']['coll_han_inter_bytes']}) not below the flat "
+            f"run's wire bytes ({agg['off']['tcp_bytes_sent']})"
+        )
+    return out_rows
 
 
 def bench_host_coll(opname: str = "allreduce", algorithm: str = "auto",
@@ -614,14 +795,20 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--window", type=int, default=16,
                    help="frames in flight per ack in --bw mode")
     p.add_argument("--plane", default="device",
-                   choices=("device", "host", "sm"),
+                   choices=("device", "host", "sm", "han"),
                    help="collectives: device = XLA mesh (default); "
                         "host = coll/host over real loopback sockets; "
                         "sm = same, with the shared-memory rings "
                         "selected (pt2pt/tcp ops too) and silent TCP "
+                        "fallback failing the run; han = real-process "
+                        "flat-vs-hierarchical ladder on an emulated "
+                        "--hosts-way mixed topology, silent flat "
                         "fallback failing the run")
     p.add_argument("--nprocs", type=int, default=4,
-                   help="socket ranks for --plane host/sm collectives")
+                   help="socket ranks for --plane host/sm/han "
+                        "collectives")
+    p.add_argument("--hosts", type=int, default=2,
+                   help="--plane han: emulated same-boot host groups")
     p.add_argument("--real-procs", action="store_true",
                    help="--plane sm: ranks as separate OS processes "
                         "(the cross-process case; threads share a GIL)")
@@ -633,6 +820,9 @@ def main(argv: list[str] | None = None) -> int:
     if args.op == "pt2pt":
         rows = bench_pt2pt(args.max_size, max(args.iters, 10),
                            bw=args.bw, window=args.window)
+    elif args.plane == "han":
+        rows = bench_han(args.max_size, max(args.iters, 3),
+                         nprocs=args.nprocs, hosts=args.hosts)
     elif args.op == "tcp" and args.plane == "sm":
         rows = bench_sm(args.max_size, max(args.iters, 10),
                         bw=args.bw, window=args.window,

@@ -78,6 +78,19 @@ def _ulfm_detector_hygiene():
     )
     polls = sm_mod.live_poll_threads()
     assert not polls, f"sm poll threads leaked: {polls}"
+    from zhpe_ompi_tpu.pt2pt import groups as groups_mod
+
+    windows = groups_mod.leaked_tag_windows()
+    assert not windows, (
+        f"han group-view tag windows leaked past their endpoint's "
+        f"close(): {windows}"
+    )
+    elections = groups_mod.live_election_threads()
+    assert not elections, (
+        f"han leader-election threads leaked (election is the "
+        f"synchronous min-rank rule; no thread may outlive it): "
+        f"{elections}"
+    )
 
 
 @pytest.fixture(autouse=True)

@@ -1,0 +1,90 @@
+"""bench.py supervisor plumbing — the probe deadline path (BENCH_r05:
+five 240 s probe hangs produced an error record instead of a number).
+Fast: every case uses a stub probe source, never a real backend."""
+
+import json
+import time
+
+import bench
+
+
+def _watchdog_prelude() -> str:
+    """The _PROBE_SRC up to (excluding) the jax import: the watchdog
+    must already be armed by then — that ordering IS the deadline
+    guarantee for a wedged jax.devices()."""
+    head, sep, _ = bench._PROBE_SRC.partition("import jax")
+    assert sep, "_PROBE_SRC no longer imports jax?"
+    assert "threading.Thread" in head, (
+        "the probe watchdog must start BEFORE the jax import — a hang "
+        "inside jax.devices() is exactly what it exists to kill"
+    )
+    return head
+
+
+class TestProbeDeadline:
+    def test_hung_probe_dies_on_internal_deadline(self):
+        """A probe that wedges after arming the watchdog exits by
+        itself, well inside the outer subprocess timeout."""
+        src = _watchdog_prelude() + "import time as _t\n_t.sleep(60)\n"
+        t0 = time.perf_counter()
+        kind, detail = bench._run_probe(timeout_s=30.0, deadline_s=0.5,
+                                        src=src)
+        elapsed = time.perf_counter() - t0
+        assert kind == "deadline"
+        assert "internal deadline" in detail
+        assert elapsed < 10.0, (
+            f"deadline probe took {elapsed:.1f}s — the internal "
+            "watchdog did not fire"
+        )
+
+    def test_outer_timeout_still_backstops(self):
+        """A probe that hangs with the watchdog DISABLED (deadline 0)
+        is killed by the outer subprocess timeout — the backstop the
+        internal deadline rides inside."""
+        src = _watchdog_prelude() + "import time as _t\n_t.sleep(60)\n"
+        kind, detail = bench._run_probe(timeout_s=1.0, deadline_s=0.0,
+                                        src=src)
+        assert kind == "hung"
+        assert "hung" in detail
+
+    def test_healthy_probe_reports_devices(self):
+        src = ("import json\n"
+               "print(json.dumps({'n': 1, 'platform': 'stub'}))\n")
+        kind, detail = bench._run_probe(timeout_s=30.0, deadline_s=30.0,
+                                         src=src)
+        assert kind == "ok"
+        assert json.loads(detail) == {"n": 1, "platform": "stub"}
+
+    def test_failing_probe_reports_rc_and_stderr(self):
+        src = "import sys\nsys.stderr.write('boom')\nsys.exit(7)\n"
+        kind, detail = bench._run_probe(timeout_s=30.0, deadline_s=30.0,
+                                         src=src)
+        assert kind == "error"
+        assert "rc=7" in detail and "boom" in detail
+
+    def test_error_with_deadline_word_is_not_a_hang(self):
+        """A fast FAILURE whose stderr happens to say DEADLINE_EXCEEDED
+        (a common transient accelerator status) must classify as an
+        ordinary error — the retry ladder rides errors out with
+        backoff, and only true hangs cut it short."""
+        src = ("import sys\n"
+               "sys.stderr.write('DEADLINE_EXCEEDED: tpu busy')\n"
+               "sys.exit(1)\n")
+        kind, detail = bench._run_probe(timeout_s=30.0, deadline_s=30.0,
+                                        src=src)
+        assert kind == "error"
+
+
+class TestCpuFallback:
+    def test_fallback_env_pins_cpu(self, monkeypatch):
+        """The CPU-mesh fallback child must run with JAX_PLATFORMS=cpu
+        even when the parent asked for an accelerator — the fallback
+        exists because that accelerator just failed to probe."""
+        monkeypatch.setenv("JAX_PLATFORMS", "tpu")
+        env = bench._cpu_env()
+        assert env["JAX_PLATFORMS"] == "cpu"
+
+    def test_fallback_env_preserves_everything_else(self, monkeypatch):
+        monkeypatch.setenv("ZMPI_BENCH_SMOKE", "1")
+        env = bench._cpu_env()
+        assert env["ZMPI_BENCH_SMOKE"] == "1"

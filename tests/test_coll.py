@@ -556,3 +556,97 @@ class TestShippedProfiles:
                             zops.SUM) == "segmented_ring"
         assert tuned.decide("allreduce", world, small, zops.SUM) != \
             "segmented_ring"
+
+
+class TestDynamicRulesFile:
+    """The dynamic-rules loader's contract (PR-6 satellite):
+    most-specific-line-wins ordering, malformed/unknown lines degrade
+    LOUDLY to the fixed default instead of raising, and `han` rule
+    lines validate for the hierarchical host ops only."""
+
+    def _rules(self, tmp_path, text):
+        from zhpe_ompi_tpu.coll import tuned
+
+        path = tmp_path / "test.rules"
+        path.write_text(text)
+        tuned._rules_cache.pop(str(path), None)
+        return str(path)
+
+    def test_most_specific_line_wins(self, tmp_path, fresh_vars):
+        from zhpe_ompi_tpu.coll import tuned
+        from zhpe_ompi_tpu.mca import var as mca_var
+
+        tuned._register_params()
+        path = self._rules(tmp_path, "\n".join([
+            "allreduce 0 0 linear",
+            "allreduce 4 0 ring",
+            "allreduce 4 1048576 rabenseifner",
+            "# comment line",
+        ]))
+        mca_var.set_var("coll_tuned_dynamic_rules", path)
+        try:
+            assert tuned._dynamic_rule("allreduce", 2, 10) == "linear"
+            assert tuned._dynamic_rule("allreduce", 8, 10) == "ring"
+            assert tuned._dynamic_rule("allreduce", 8, 2 << 20) == \
+                "rabenseifner"
+            assert tuned._dynamic_rule("bcast", 8, 10) is None
+        finally:
+            mca_var.registry.unset("coll_tuned_dynamic_rules")
+            tuned._rules_cache.pop(path, None)
+
+    def test_malformed_lines_degrade_loudly_not_raise(self, tmp_path,
+                                                      fresh_vars):
+        """Bad field counts, non-integer thresholds, unknown ops, and
+        unknown algorithm names are each skipped per line; the valid
+        line still applies and nothing raises out of the decision."""
+        from zhpe_ompi_tpu.coll import tuned
+
+        path = self._rules(tmp_path, "\n".join([
+            "allreduce x y ring",          # non-integer thresholds
+            "allreduce 0",                 # wrong field count
+            "bogus_op 0 0 ring",           # unknown op
+            "allreduce 0 0 bogus_alg",     # unknown algorithm
+            "alltoallv 0 0 han",           # han on a non-han op
+            "allreduce 0 0 ring",          # the one valid line
+        ]))
+        rules = tuned._load_rules(path)
+        assert rules == [("allreduce", 0, 0, "ring")]
+
+    def test_unreadable_file_degrades_not_raises(self, tmp_path):
+        from zhpe_ompi_tpu.coll import tuned
+
+        assert tuned._load_rules(str(tmp_path / "missing.rules")) == []
+
+    def test_han_line_validates_for_host_ops(self, tmp_path):
+        from zhpe_ompi_tpu.coll import tuned
+
+        text = "\n".join(
+            f"{op} 4 1024 han" for op in sorted(tuned._HAN_RULE_OPS)
+        )
+        path = self._rules(tmp_path, text)
+        rules = tuned._load_rules(path)
+        assert len(rules) == len(tuned._HAN_RULE_OPS)
+        assert all(alg == "han" for *_rest, alg in rules)
+
+    def test_device_decide_never_returns_han(self, world, tmp_path,
+                                             fresh_vars):
+        """A han rule line is a HOST-plane request: the device-plane
+        decision (XLA algorithm tables) must fall back to its fixed
+        choice, never hand the dispatcher an algorithm its table does
+        not hold."""
+        import numpy as np
+
+        from zhpe_ompi_tpu import ops as zops
+        from zhpe_ompi_tpu.coll import tuned
+        from zhpe_ompi_tpu.mca import var as mca_var
+
+        tuned._register_params()
+        path = self._rules(tmp_path, "allreduce 0 0 han\n")
+        mca_var.set_var("coll_tuned_dynamic_rules", path)
+        try:
+            choice = tuned.decide("allreduce", world,
+                                  np.zeros(8, np.float32), zops.SUM)
+            assert choice in tuned.ALLREDUCE_ALGS
+        finally:
+            mca_var.registry.unset("coll_tuned_dynamic_rules")
+            tuned._rules_cache.pop(path, None)

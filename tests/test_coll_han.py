@@ -1,0 +1,498 @@
+"""Hierarchical (coll/han) host collectives: locality-group derivation
+from the modex, the GroupView sub-endpoint (relative ranks, disjoint
+tag windows), the two-level algorithms against their flat twins, and
+the decision layer (auto topology gate, forced enable with loud flat
+fallback, dynamic-rules han lines)."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from zhpe_ompi_tpu import ops
+from zhpe_ompi_tpu.coll import han, host
+from zhpe_ompi_tpu.core import errors
+from zhpe_ompi_tpu.mca import var as mca_var
+from zhpe_ompi_tpu.pt2pt import groups as groups_mod
+from zhpe_ompi_tpu.pt2pt.tcp import TcpProc
+from zhpe_ompi_tpu.pt2pt.universe import LocalUniverse
+from zhpe_ompi_tpu.runtime import spc
+
+GROUPS_2x2 = [[0, 1], [2, 3]]
+GROUPS_3_2_1 = [[0, 1, 2], [3, 4], [5]]
+
+
+def run_wire(n, fn, kwargs_by_rank=None, timeout=60.0, **common):
+    """n TcpProcs in threads over a localhost coordinator with per-rank
+    constructor overrides (the emulated-host sm_boot_id pins)."""
+    coord_ready = threading.Event()
+    coord_addr = [None]
+    results = [None] * n
+    excs = [None] * n
+
+    def main(rank):
+        kw = dict(common)
+        kw.update((kwargs_by_rank or {}).get(rank, {}))
+        try:
+            if rank == 0:
+                proc = TcpProc(
+                    0, n, coordinator=("127.0.0.1", 0),
+                    on_coordinator_bound=lambda a: (
+                        coord_addr.__setitem__(0, a), coord_ready.set()),
+                    **kw)
+            else:
+                coord_ready.wait(10)
+                proc = TcpProc(rank, n, coordinator=coord_addr[0], **kw)
+            try:
+                results[rank] = fn(proc)
+            finally:
+                proc.close()
+        except BaseException as e:  # noqa: BLE001
+            excs[rank] = e
+            coord_ready.set()
+
+    threads = [threading.Thread(target=main, args=(r,)) for r in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout)
+        assert not t.is_alive(), "han wire rank hung"
+    if any(e is not None for e in excs):
+        raise next(e for e in excs if e is not None)
+    return results
+
+
+def boots_2x2():
+    return {0: {"sm_boot_id": "hostaaaa"}, 1: {"sm_boot_id": "hostaaaa"},
+            2: {"sm_boot_id": "hostbbbb"}, 3: {"sm_boot_id": "hostbbbb"}}
+
+
+class TestLocalityGroups:
+    """Group derivation from boot tokens: modex-card driven on the
+    wire, trivially one group on the thread plane, singletons for
+    unknowable peers."""
+
+    def test_thread_universe_is_one_group(self):
+        uni = LocalUniverse(4)
+
+        def prog(ctx):
+            return groups_mod.locality_groups(ctx)
+
+        for g in uni.run(prog):
+            assert g == [[0, 1, 2, 3]]
+
+    def test_unknown_endpoint_is_all_singletons(self):
+        class Bare:
+            rank, size = 0, 3
+
+        assert groups_mod.locality_groups(Bare()) == [[0], [1], [2]]
+
+    def test_wire_groups_follow_boot_ids(self):
+        def prog(p):
+            return groups_mod.locality_groups(p)
+
+        for g in run_wire(4, prog, boots_2x2()):
+            assert g == GROUPS_2x2
+
+    def test_interleaved_boots_group_by_token_not_adjacency(self):
+        kw = {0: {"sm_boot_id": "aaaa"}, 1: {"sm_boot_id": "bbbb"},
+              2: {"sm_boot_id": "aaaa"}, 3: {"sm_boot_id": "bbbb"}}
+
+        def prog(p):
+            return groups_mod.locality_groups(p)
+
+        for g in run_wire(4, prog, kw):
+            assert g == [[0, 2], [1, 3]]
+
+    def test_sm_off_rank_is_a_singleton(self):
+        """A rank that advertises no pyshm card (sm=0) has no provable
+        locality: every rank — including itself — groups it alone."""
+        kw = dict(boots_2x2())
+        kw[1] = {"sm": False}
+
+        def prog(p):
+            return groups_mod.locality_groups(p)
+
+        for g in run_wire(4, prog, kw):
+            assert g == [[0], [1], [2, 3]]
+
+
+class TestGroupView:
+    """The sub-endpoint itself: relative ranks, translation, disjoint
+    tag windows, status mapping."""
+
+    def test_relative_ranks_and_translation(self):
+        uni = LocalUniverse(4)
+
+        def prog(ctx):
+            view = groups_mod.GroupView(ctx, [1, 3], window=7) \
+                if ctx.rank in (1, 3) else None
+            if view is None:
+                return None
+            assert view.size == 2
+            assert view.parent_rank(view.rank) == ctx.rank
+            if ctx.rank == 1:
+                assert view.rank == 0
+                view.send(("hi", 42), 1, tag=5)
+                return view.recv(source=1, tag=6)
+            assert view.rank == 1
+            got, status = view.recv(source=0, tag=5, return_status=True)
+            assert status.source == 0  # RELATIVE source in the status
+            view.send(got, 0, tag=6)
+            return got
+
+        res = uni.run(prog)
+        assert res[1] == res[3] == ("hi", 42)
+
+    def test_nonmember_view_refused(self):
+        uni = LocalUniverse(2)
+
+        def prog(ctx):
+            if ctx.rank == 0:
+                with pytest.raises(errors.ArgError):
+                    groups_mod.GroupView(ctx, [1], window=0)
+            return True
+
+        assert uni.run(prog) == [True, True]
+
+    def test_tag_window_disjoint_from_parent_collectives(self):
+        """A han collective interleaved with parent-level flat
+        collectives: the window cid keeps the subgroup rounds from
+        cross-matching the parent's (same base tags, same seq values —
+        only the cid separates them)."""
+        uni = LocalUniverse(4)
+
+        def prog(ctx):
+            a = han.allreduce(ctx, ctx.rank + 1, ops.SUM,
+                              groups=GROUPS_2x2)
+            b = host.allreduce(ctx, ctx.rank + 1, ops.SUM)  # flat
+            c = han.allreduce(ctx, ctx.rank + 1, ops.SUM,
+                              groups=GROUPS_2x2)
+            return (a, b, c)
+
+        assert uni.run(prog) == [(10, 10, 10)] * 4
+
+    def test_window_seq_survives_view_recreation(self):
+        """Tag sequences live on the ENDPOINT per window: two han
+        collectives that each build fresh views still tag disjoint
+        instances (the anti-cross-match property)."""
+        uni = LocalUniverse(4)
+
+        def prog(ctx):
+            out = []
+            for _ in range(3):
+                han.invalidate(ctx)  # forces fresh views every round
+                out.append(han.allreduce(ctx, np.full(4, 1.0), ops.SUM,
+                                         groups=GROUPS_2x2)[0])
+            return out
+
+        assert uni.run(prog) == [[4.0, 4.0, 4.0]] * 4
+
+
+class TestHanAlgorithms:
+    """The two-level schedules against their flat twins, over the
+    thread plane with synthetic groups (the multi-host emulation the
+    wire tests repeat with real sockets)."""
+
+    @pytest.mark.parametrize("groups", [GROUPS_2x2, None],
+                             ids=["2x2", "degenerate-1group"])
+    def test_allreduce_matches_flat(self, groups):
+        uni = LocalUniverse(4)
+        arr = lambda r: np.arange(8, dtype=np.float64) + r  # noqa: E731
+
+        def prog(ctx):
+            return han.allreduce(ctx, arr(ctx.rank), ops.SUM,
+                                 groups=groups)
+
+        expect = sum(arr(r) for r in range(4))
+        for out in uni.run(prog):
+            np.testing.assert_allclose(out, expect)
+
+    def test_allreduce_uneven_groups(self):
+        uni = LocalUniverse(6)
+
+        def prog(ctx):
+            return han.allreduce(ctx, ctx.rank + 1, ops.SUM,
+                                 groups=GROUPS_3_2_1)
+
+        assert uni.run(prog) == [21] * 6
+
+    def test_allreduce_large_split_mode(self, fresh_vars):
+        """Above host_coll_large_msg the leader exchange takes the
+        explicit reduce-scatter + allgather ring."""
+        mca_var.set_var("host_coll_large_msg", 64)
+        uni = LocalUniverse(4)
+
+        def prog(ctx):
+            return han.allreduce(
+                ctx, np.full(64, float(ctx.rank + 1)), ops.SUM,
+                groups=GROUPS_2x2)
+
+        for out in uni.run(prog):
+            np.testing.assert_allclose(out, np.full(64, 10.0))
+
+    @pytest.mark.parametrize("root", [0, 1, 2, 3])
+    def test_bcast_all_roots(self, root):
+        """Leader roots and non-leader roots both (the root→leader hop
+        consumes a window tag on every member of the root's group)."""
+        uni = LocalUniverse(4)
+
+        def prog(ctx):
+            payload = {"root": root, "arr": np.arange(4)} \
+                if ctx.rank == root else None
+            out = han.bcast(ctx, payload, root=root, groups=GROUPS_2x2)
+            return (out["root"], list(out["arr"]))
+
+        assert uni.run(prog) == [(root, [0, 1, 2, 3])] * 4
+
+    @pytest.mark.parametrize("root", [0, 3, 5])
+    def test_reduce_all_roots(self, root):
+        uni = LocalUniverse(6)
+
+        def prog(ctx):
+            return han.reduce(ctx, ctx.rank + 1, ops.SUM, root=root,
+                              groups=GROUPS_3_2_1)
+
+        res = uni.run(prog)
+        for r, out in enumerate(res):
+            assert out == (21 if r == root else None)
+
+    def test_barrier_runs(self):
+        uni = LocalUniverse(6)
+
+        def prog(ctx):
+            for _ in range(3):
+                han.barrier(ctx, groups=GROUPS_3_2_1)
+            return True
+
+        assert uni.run(prog) == [True] * 6
+
+    def test_allgather_matches_flat(self):
+        uni = LocalUniverse(6)
+
+        def prog(ctx):
+            return han.allgather(ctx, (ctx.rank, str(ctx.rank)),
+                                 groups=GROUPS_3_2_1)
+
+        expect = [(r, str(r)) for r in range(6)]
+        for out in uni.run(prog):
+            assert out == expect
+
+    def test_reduce_scatter_matches_flat(self):
+        uni = LocalUniverse(4)
+
+        def prog(ctx):
+            blocks = [np.full(2, float(ctx.rank + 1 + b))
+                      for b in range(4)]
+            return han.reduce_scatter(ctx, blocks, ops.SUM,
+                                      groups=GROUPS_2x2)
+
+        res = uni.run(prog)
+        for r, out in enumerate(res):
+            np.testing.assert_allclose(out, np.full(2, 10.0 + 4 * r))
+
+    def test_phases_immune_to_pipeline_bcast_tuning(self, fresh_vars):
+        """host_bcast_algorithm=pipeline (a large-ndarray tuning) must
+        not leak into the han phases: they broadcast lists/None
+        payloads the pipeline algorithm cannot stream.  The phase
+        bcasts pin the binomial tree explicitly."""
+        mca_var.set_var("host_bcast_algorithm", "pipeline")
+        uni = LocalUniverse(4)
+
+        def prog(ctx):
+            ag = han.allgather(ctx, (ctx.rank, "x"), groups=GROUPS_2x2)
+            ar = han.allreduce(ctx, ctx.rank + 1, ops.SUM,
+                               groups=GROUPS_2x2)
+            return (ag, ar)
+
+        expect = [(r, "x") for r in range(4)]
+        for ag, ar in uni.run(prog):
+            assert ag == expect and ar == 10
+
+    def test_noncommutative_op_refused(self):
+        uni = LocalUniverse(4)
+
+        class NonCommute:
+            commute = False
+
+            def __call__(self, a, b):  # pragma: no cover
+                return a
+
+        nc = NonCommute()
+
+        def prog(ctx):
+            with pytest.raises(errors.ArgError):
+                han.allreduce(ctx, ctx.rank, nc, groups=GROUPS_2x2)
+            return True
+
+        assert uni.run(prog) == [True] * 4
+
+
+class TestDecision:
+    """coll_han_enable auto/on/off through coll/host.py's dispatch
+    seam, the loud flat fallback, and the topology qualification bar."""
+
+    def test_auto_thread_plane_stays_flat(self):
+        """One locality group (a thread universe): auto never engages —
+        no counters move, results unchanged."""
+        uni = LocalUniverse(4)
+        inter0 = spc.read("coll_han_inter_bytes")
+        fb0 = spc.read("han_flat_fallbacks")
+
+        def prog(ctx):
+            return host.allreduce(ctx, np.full(4, 1.0), ops.SUM)[0]
+
+        assert uni.run(prog) == [4.0] * 4
+        assert spc.read("coll_han_inter_bytes") == inter0
+        assert spc.read("han_flat_fallbacks") == fb0
+
+    def test_forced_on_degenerate_falls_back_loudly(self, fresh_vars):
+        """coll_han_enable=on over a one-group topology: the flat
+        algorithm runs (correct result) and the degradation is COUNTED
+        — never silent."""
+        mca_var.set_var("coll_han_enable", "on")
+        uni = LocalUniverse(4)
+        fb0 = spc.read("han_flat_fallbacks")
+
+        def prog(ctx):
+            return host.allreduce(ctx, ctx.rank + 1, ops.SUM)
+
+        assert uni.run(prog) == [10] * 4
+        assert spc.read("han_flat_fallbacks") > fb0
+
+    def test_off_never_engages(self, fresh_vars):
+        mca_var.set_var("coll_han_enable", "off")
+        inter0 = spc.read("coll_han_inter_bytes")
+
+        def prog(p):
+            return float(np.asarray(
+                p.allreduce(np.full(4, 1.0), ops.SUM))[0])
+
+        assert run_wire(4, prog, boots_2x2()) == [4.0] * 4
+        assert spc.read("coll_han_inter_bytes") == inter0
+
+    def test_auto_engages_on_qualified_wire_topology(self, fresh_vars):
+        """2 emulated hosts × 2 ranks: auto routes the host collectives
+        through han — leader bytes move, no fallback, results exact."""
+        inter0 = spc.read("coll_han_inter_bytes")
+        intra0 = spc.read("coll_han_intra_bytes")
+        fb0 = spc.read("han_flat_fallbacks")
+
+        def prog(p):
+            out = p.allreduce(np.full(256, float(p.rank + 1)), ops.SUM)
+            p.barrier()
+            ag = p.allgather(p.rank * 2)
+            return (float(np.asarray(out)[0]), ag)
+
+        for v, ag in run_wire(4, prog, boots_2x2()):
+            assert v == 10.0
+            assert ag == [0, 2, 4, 6]
+        assert spc.read("coll_han_inter_bytes") > inter0
+        assert spc.read("coll_han_intra_bytes") > intra0
+        assert spc.read("han_flat_fallbacks") == fb0
+
+    def test_auto_needs_two_multirank_groups(self, fresh_vars):
+        """3 ranks: a 2+1 topology has only ONE >=2-member group — auto
+        stays flat (no leader bytes)."""
+        kw = {0: {"sm_boot_id": "aaaa"}, 1: {"sm_boot_id": "aaaa"},
+              2: {"sm_boot_id": "bbbb"}}
+        inter0 = spc.read("coll_han_inter_bytes")
+
+        def prog(p):
+            return float(np.asarray(
+                p.allreduce(np.full(8, 1.0), ops.SUM))[0])
+
+        assert run_wire(3, prog, kw) == [3.0] * 3
+        assert spc.read("coll_han_inter_bytes") == inter0
+
+    def test_dynamic_rule_han_line_selects_hierarchy(self, fresh_vars,
+                                                     tmp_path):
+        """A `allreduce 4 4096 han` rules line: small payloads stay
+        flat, large ones take the two-level path — on the same
+        qualified topology with coll_han_enable left at auto... but
+        auto would also engage; pin the distinction via a 3-rank 2+1
+        topology auto REJECTS, so only the rule can engage han there."""
+        from zhpe_ompi_tpu.coll import tuned
+
+        rules = tmp_path / "han.rules"
+        rules.write_text("allreduce 2 4096 han\n")
+        mca_var.set_var("coll_tuned_dynamic_rules", str(rules))
+        kw = {0: {"sm_boot_id": "aaaa"}, 1: {"sm_boot_id": "aaaa"},
+              2: {"sm_boot_id": "bbbb"}}
+        inter0 = spc.read("coll_han_inter_bytes")
+        try:
+            def small(p):
+                return float(np.asarray(
+                    p.allreduce(np.full(8, 1.0), ops.SUM))[0])
+
+            assert run_wire(3, small, kw) == [3.0] * 3
+            assert spc.read("coll_han_inter_bytes") == inter0  # < 4096
+
+            def large(p):
+                return float(np.asarray(
+                    p.allreduce(np.full(1024, 1.0), ops.SUM))[0])
+
+            assert run_wire(3, large, kw) == [3.0] * 3
+            assert spc.read("coll_han_inter_bytes") > inter0
+        finally:
+            mca_var.registry.unset("coll_tuned_dynamic_rules")
+            tuned._rules_cache.pop(str(rules), None)
+
+    def test_explicit_algorithm_outranks_han(self, fresh_vars):
+        """A pinned host algorithm (bcast pipeline) bypasses the
+        topology layer — forced algorithms are the user's
+        responsibility, exactly as in coll/tuned."""
+        mca_var.set_var("coll_han_enable", "on")
+        inter0 = spc.read("coll_han_inter_bytes")
+
+        def prog(p):
+            arr = np.arange(64, dtype=np.float64)
+            out = host.bcast(p, arr if p.rank == 0 else None, 0,
+                             algorithm="pipeline")
+            return float(np.asarray(out)[5])
+
+        assert run_wire(4, prog, boots_2x2()) == [5.0] * 4
+        assert spc.read("coll_han_inter_bytes") == inter0
+
+
+class TestWireCorrectness:
+    """The full op set over real sockets on the emulated 2×2 topology
+    with han forced on — every result byte-checked."""
+
+    def test_all_ops_forced_on(self, fresh_vars):
+        mca_var.set_var("coll_han_enable", "on")
+        fb0 = spc.read("han_flat_fallbacks")
+
+        def prog(p):
+            r = p.rank
+            out = {}
+            out["ar"] = float(np.asarray(
+                p.allreduce(np.full(16, float(r + 1)), ops.SUM))[0])
+            out["bc"] = p.bcast(("payload", 9) if r == 1 else None, 1)
+            out["red"] = p.reduce(r + 1, ops.SUM, 2)
+            p.barrier()
+            out["ag"] = p.allgather(chr(ord("a") + r))
+            out["rs"] = float(np.asarray(p.reduce_scatter(
+                [np.full(2, float(r + 1 + b)) for b in range(4)],
+                ops.SUM))[0])
+            return out
+
+        res = run_wire(4, prog, boots_2x2())
+        for r, out in enumerate(res):
+            assert out["ar"] == 10.0
+            assert out["bc"] == ("payload", 9)
+            assert out["red"] == (10 if r == 2 else None)
+            assert out["ag"] == ["a", "b", "c", "d"]
+            assert out["rs"] == 10.0 + 4 * r
+        assert spc.read("han_flat_fallbacks") == fb0
+
+    def test_no_leaked_tag_windows_after_close(self, fresh_vars):
+        mca_var.set_var("coll_han_enable", "on")
+
+        def prog(p):
+            p.allreduce(np.full(8, 1.0), ops.SUM)
+            return True
+
+        assert run_wire(4, prog, boots_2x2()) == [True] * 4
+        assert groups_mod.leaked_tag_windows() == []
+        assert groups_mod.live_election_threads() == []

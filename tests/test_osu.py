@@ -186,3 +186,33 @@ def test_sm_bench_gate_trips_on_forced_fallback():
         t.join(60.0)
     assert excs == [None, None]
     assert spc.read("sm_fallback_tcp_sends") > fb0
+
+
+def test_han_rows_thread_harness():
+    """Fast smoke for the --plane han ladder (thread harness): both the
+    flat and han legs emit sane rows and the built-in gates (no silent
+    flat fallback, leader bytes below flat wire bytes) hold."""
+    rows = osu_zmpi.bench_han(max_size=1 << 11, iters=2,
+                              real_procs=False)
+    for prefix in ("flat_host_allreduce", "han_host_allreduce",
+                   "flat_host_bcast", "han_host_bcast"):
+        sub = [r for r in rows if r["op"] == prefix]
+        assert sub, f"no rows for {prefix}"
+        for r in sub:
+            assert r["bytes"] > 0 and r["latency_us"] > 0
+            assert np.isfinite(r["bandwidth_MBps"])
+
+
+@pytest.mark.slow
+def test_han_ladder_no_silent_flat_fallback_real_procs():
+    """CI smoke for the hierarchical plane (PR-6 satellite): the
+    REAL-PROCESS 2-host x 2-rank emulated mixed topology must actually
+    run the two-level schedules — bench_han raises if any collective
+    silently fell back to flat (han_flat_fallbacks != 0), if no
+    leader-phase bytes moved (coll_han_inter_bytes == 0), or if the
+    leader phase shipped MORE bytes than the flat ring put on the wire
+    at equal payload (the fewer-wire-hops claim, byte-accounted)."""
+    rows = osu_zmpi.bench_han(max_size=1 << 18, iters=3,
+                              real_procs=True)
+    assert any(r["op"] == "han_host_allreduce" for r in rows)
+    assert any(r["op"] == "flat_host_allreduce" for r in rows)

@@ -510,6 +510,16 @@ class TestRendezvous:
                 pending_before = len(p._pending_rndv)
                 p.send(b"now", dest=1, tag=24)
                 got_back = p.recv(source=1, tag=25, timeout=20.0)
+                # the push worker pops the parked entry in its finally,
+                # AFTER its kernel-buffered data send returns — on an
+                # oversubscribed box the receiver's round trip can beat
+                # the preempted worker's pop by a few ms, so the
+                # release is polled, not read instantaneously
+                import time
+
+                deadline = time.monotonic() + 5.0
+                while p._pending_rndv and time.monotonic() < deadline:
+                    time.sleep(0.005)
                 pending_after = len(p._pending_rndv)
                 return (pending_before, got_back, pending_after)
             import time

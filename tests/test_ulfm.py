@@ -787,10 +787,13 @@ class TestRejoin:
         assert rj.fully_replayed
 
 
-def run_tcp_ft(n, fn, timeout=60.0, proc_timeout=15.0, sm=None):
+def run_tcp_ft(n, fn, timeout=60.0, proc_timeout=15.0, sm=None,
+               kwargs_by_rank=None):
     """Launch n ft-enabled TcpProcs over a localhost coordinator.
     ``sm`` pins the shared-memory transport on/off (None = MCA
-    default; tests asserting tcp_* counters pin False)."""
+    default; tests asserting tcp_* counters pin False);
+    ``kwargs_by_rank`` adds per-rank constructor overrides (the han
+    tests' emulated-host sm_boot_id pins)."""
     coord_ready = threading.Event()
     coord_addr = [None]
     results = [None] * n
@@ -803,15 +806,17 @@ def run_tcp_ft(n, fn, timeout=60.0, proc_timeout=15.0, sm=None):
 
     def main(rank):
         proc = None
+        kw = dict((kwargs_by_rank or {}).get(rank, {}))
         try:
             if rank == 0:
                 proc = TcpProc(0, n, coordinator=("127.0.0.1", 0),
                                timeout=proc_timeout, ft=True, sm=sm,
-                               on_coordinator_bound=publish)
+                               on_coordinator_bound=publish, **kw)
             else:
                 coord_ready.wait(10)
                 proc = TcpProc(rank, n, coordinator=coord_addr[0],
-                               timeout=proc_timeout, ft=True, sm=sm)
+                               timeout=proc_timeout, ft=True, sm=sm,
+                               **kw)
             procs[rank] = proc
             try:
                 results[rank] = fn(proc)
@@ -850,7 +855,7 @@ class TestTcpUlfm:
 
     def test_severed_rank_recovery(self, fresh_vars):
         mca_var.set_var("ft_detector_period", 0.05)
-        mca_var.set_var("ft_detector_timeout", 0.4)
+        mca_var.set_var("ft_detector_timeout", 0.8)
         n = 3
         plan = FaultPlan(seed=1).kill_rank(2, after_ops=1)
 
@@ -1025,7 +1030,7 @@ class TestTcpUlfm:
         rank 1, stuck waiting on the dead coordinator, must adopt it
         after the detector fires instead of timing out a fresh round."""
         mca_var.set_var("ft_detector_period", 0.05)
-        mca_var.set_var("ft_detector_timeout", 0.4)
+        mca_var.set_var("ft_detector_timeout", 0.8)
         n = 3
         plan = FaultPlan(seed=4).kill_rank(0, after_ops=0, mode="mute")
 
@@ -1125,6 +1130,112 @@ class TestTcpUlfm:
             return False
 
         assert run_tcp_ft(n, prog) == [True, True]
+
+
+class TestKillDuringHan:
+    """FT + hierarchical-collective coexistence (the han tentpole's
+    acceptance path): a rank dying in EITHER phase of a two-level
+    collective surfaces the same typed ProcFailed the flat path
+    raises, a revoke of the logical collective cid poisons parked
+    phase windows as typed Revoked, and the post-shrink endpoint
+    REBUILDS its locality groups from the survivor set."""
+
+    BOOTS = {0: {"sm_boot_id": "hosta"}, 1: {"sm_boot_id": "hosta"},
+             2: {"sm_boot_id": "hostb"}, 3: {"sm_boot_id": "hostb"}}
+
+    def _kill_during_han(self, victim, after_ops, seed, expect_groups):
+        from zhpe_ompi_tpu.coll import host as coll_host
+        from zhpe_ompi_tpu.pt2pt import groups as groups_mod
+
+        mca_var.set_var("ft_detector_period", 0.05)
+        mca_var.set_var("ft_detector_timeout", 0.4)
+        mca_var.set_var("coll_han_enable", "on")
+        n = 4
+        plan = FaultPlan(seed=seed).kill_rank(victim, after_ops=after_ops)
+
+        def prog(p):
+            p.set_errhandler(errh.ERRORS_RETURN)
+            inj = plan.arm(p)
+            observed = None
+            try:
+                # the injected surface counts the han phase traffic,
+                # so the victim dies INSIDE the collective — survivors
+                # must classify out of whichever phase they are parked
+                # in, not ride out the stall timeout
+                inj.allreduce(np.full(64, float(p.rank + 1)), ops.SUM)
+            except errors.ProcFailed as e:
+                # the ULFM recipe: the first observers revoke the
+                # logical collective channel so peers parked on LIVE
+                # ranks (who abandoned the schedule) un-park typed
+                observed = e
+                p.revoke(coll_host.COLL_CID)
+            except errors.Revoked as e:
+                observed = e  # un-parked by another survivor's revoke
+            assert observed is not None, "collective completed despite " \
+                "the mid-phase kill"
+            assert p.ft_state.wait_failed(victim, timeout=10.0)
+            p.failure_ack()
+            assert p.agree(True) is True
+            sh = p.shrink()
+            # the rebuild contract: the shrunken endpoint derives its
+            # locality groups from the SURVIVOR set
+            rebuilt = groups_mod.locality_groups(sh)
+            total = sh.allreduce(np.full(8, float(p.rank + 1)), ops.SUM)
+            return (sh.size, rebuilt, float(np.asarray(total)[0]),
+                    type(observed).__name__)
+
+        res = run_tcp_ft(n, prog, kwargs_by_rank=self.BOOTS)
+        assert res[victim] == "killed"
+        survivors = [r for r in range(n) if r != victim]
+        expect_total = float(sum(r + 1 for r in survivors))
+        for r in survivors:
+            assert res[r][:3] == (3, expect_groups, expect_total), res[r]
+        # at least one survivor observed the death itself (typed
+        # ProcFailed); the rest may have been released by the revoke
+        assert "ProcFailed" in [res[r][3] for r in survivors]
+
+    def test_kill_nonleader_during_intra_phase(self, fresh_vars):
+        # rank 3 is a group-B member (not a leader): it dies on its
+        # FIRST phase op — before contributing its intra partial — so
+        # its leader classifies typed ProcFailed out of the intra
+        # reduce; survivor groups = [[0,1],[2]]
+        self._kill_during_han(3, after_ops=0, seed=41,
+                              expect_groups=[[0, 1], [2]])
+
+    def test_kill_leader_during_inter_phase(self, fresh_vars):
+        # rank 2 leads group B: it consumes its member's intra partial
+        # (op 1) and dies entering the leader exchange, stranding the
+        # other leader (rank 0) and its member's intra bcast (rank 3);
+        # survivor groups renumber to [[0,1],[2]] (old rank 3)
+        self._kill_during_han(2, after_ops=1, seed=42,
+                              expect_groups=[[0, 1], [2]])
+
+    def test_revoke_poisons_parked_han_phases(self, fresh_vars):
+        """revoke(COLL_CID) while ranks are parked inside han phase
+        windows: the cid alias classifies them out as typed Revoked —
+        the same surface the flat path presents."""
+        from zhpe_ompi_tpu.coll import host as coll_host
+
+        mca_var.set_var("coll_han_enable", "on")
+        n = 4
+
+        def prog(p):
+            p.set_errhandler(errh.ERRORS_RETURN)
+            if p.rank == 0:
+                # let the others park inside the collective, then
+                # poison the LOGICAL collective channel
+                time.sleep(0.6)
+                p.revoke(coll_host.COLL_CID)
+                return "revoked"
+            try:
+                p.allreduce(np.full(64, 1.0), ops.SUM)
+            except errors.Revoked:
+                return "typed"
+            return "completed"
+
+        res = run_tcp_ft(n, prog, kwargs_by_rank=self.BOOTS)
+        assert res[0] == "revoked"
+        assert res[1:] == ["typed"] * 3
 
 
 class TestAgreeFailedSet:
@@ -1304,7 +1415,7 @@ class TestCheckpointRestartRecovery:
 
     def test_tcp_recovery_pipeline(self, fresh_vars, tmp_path):
         mca_var.set_var("ft_detector_period", 0.05)
-        mca_var.set_var("ft_detector_timeout", 0.5)
+        mca_var.set_var("ft_detector_timeout", 1.0)
         n = self.N
         ck = Checkpointer(str(tmp_path), check_quiescent=False)
         plan = FaultPlan(seed=13).kill_then_respawn(2, after_ops=2)

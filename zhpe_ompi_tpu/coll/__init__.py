@@ -1,4 +1,17 @@
-"""Collective framework: components (tpu/tuned/basic) + algorithm library."""
+"""Collective framework: components (tpu/tuned/basic/han) + algorithm
+library.  ``han`` (the hierarchical host component) loads lazily — it
+pulls the pt2pt group machinery, which most device-plane users never
+touch."""
+import importlib
+
 from . import algorithms, framework
 
-__all__ = ["algorithms", "framework"]
+__all__ = ["algorithms", "framework", "han"]
+
+
+def __getattr__(name):
+    # PEP 562; importlib directly, not `from . import` — the fromlist
+    # path re-enters this hook before the submodule lands in sys.modules
+    if name == "han":
+        return importlib.import_module(".han", __name__)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,428 @@
+"""coll/han — hierarchical topology-aware host collectives.
+
+Re-design of ``ompi/mca/coll/han`` (Luo et al., "HAN: a Hierarchical
+AutotuNed Collective Communication Framework", IEEE Cluster 2020) for
+the Python host plane: every collective splits into an **intra phase**
+inside each locality group (same-host ranks, where the send seam rides
+the ``pt2pt/sm.py`` mmap rings) and an **inter phase** among one leader
+per group (where it rides the zero-copy wire), because a flat ring that
+interleaves shared-memory and wire hops runs at the speed of its
+slowest hop.  A 2-host × 4-rank flat ring allreduce pays 8 wire-priced
+hops; the two-level schedule pays exactly the leader exchange.
+
+Topology comes from :func:`zhpe_ompi_tpu.pt2pt.groups.locality_groups`
+(the ``(boot_id, segment)`` modex cards); each phase runs the FLAT
+algorithms of ``coll/host.py`` unchanged on a
+:class:`~zhpe_ompi_tpu.pt2pt.groups.GroupView` sub-endpoint — the
+coll-rides-the-PML layering, applied twice.  Algorithms:
+
+- ``allreduce``  — intra reduce → leader allreduce → intra bcast; above
+  ``host_coll_large_msg`` the leader exchange takes the split
+  (reduce-scatter + allgather ring) schedule explicitly, the
+  bandwidth-optimal inter-node shape.
+- ``bcast``      — root→leader hop (when the root is not its group's
+  leader) → leader bcast → intra bcast.
+- ``reduce``     — intra reduce → leader reduce to the root's leader →
+  leader→root hop.
+- ``barrier``    — intra gather → leader allgather → intra release.
+- ``allgather``  — intra gather → leader allgather (blocks travel with
+  their global rank map) → intra bcast.
+- ``reduce_scatter`` — intra blockwise reduce → leader alltoall of each
+  group's blocks → per-block combine → intra scatter.
+
+Selection (the coll_han_component decision, wired through
+``coll/host.py``'s dispatch seam and ``coll/tuned.py``'s dynamic-rules
+files): ``coll_han_enable`` = ``auto`` (on only when the topology has
+>= 2 locality groups with >= 2 members each), ``on`` (forced; a
+degenerate topology falls back to the flat algorithms LOUDLY via the
+``han_flat_fallbacks`` counter), or ``off``.  A
+``<op> <comm_size_min> <msg_bytes_min> han`` line in the
+``coll_tuned_dynamic_rules`` file requests han per op/size exactly like
+a forced enable.  Non-commutative reductions always route flat (group
+combine order is not rank order — correctness outranks tuning, as in
+``coll/tuned.py``).
+
+FT coexistence: each phase delegates to the parent endpoint's
+send/recv, so peer death classifies as the same typed ``ProcFailed``
+the flat path raises, ``revoke(COLL_CID)`` poisons the phase windows
+through the cid alias the views register, and a shrink produces a
+fresh endpoint whose first han collective derives fresh locality
+groups (the rebuild contract ``tests/test_ulfm.py`` exercises).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as np
+
+from ..core import errors
+from ..mca import output as mca_output
+from ..mca import var as mca_var
+from ..pt2pt import groups as groups_mod
+from ..pt2pt.groups import LEADER_WINDOW, GroupView, payload_bytes
+from ..runtime import spc
+from . import host
+
+_stream = mca_output.open_stream("coll_han")
+
+mca_var.register(
+    "coll_han_inter_segment", 1 << 20,
+    "Segment size (bytes) of the large-message leader exchange: the "
+    "inter phase processes the reduced payload in pieces of at most "
+    "this size, so every leader-to-leader transfer stays on the eager "
+    "zero-copy wire path (a monolithic half/chunk above "
+    "tcp_eager_limit would fall into RTS/CTS rendezvous and pay its "
+    "defensive copy + round trips).  Default matches tcp_eager_limit",
+    type=int,
+)
+
+#: collectives with a two-level schedule — canonical home is the
+#: dispatch seam (coll/host.py), re-exported here for the decision API
+HAN_OPS = host.HAN_OPS
+
+
+class _Topology:
+    """One endpoint's locality structure: ascending-member groups
+    ordered by leader (min) rank; ``leaders[i]`` leads ``groups[i]``."""
+
+    __slots__ = ("groups", "leaders", "gidx", "degenerate", "qualified")
+
+    def __init__(self, size: int, rank: int, groups: list[list[int]]):
+        flat = sorted(r for g in groups for r in g)
+        if flat != list(range(size)):
+            raise errors.ArgError(
+                f"han groups must partition ranks 0..{size - 1}, got "
+                f"{groups}"
+            )
+        self.groups = sorted((sorted(g) for g in groups),
+                             key=lambda g: g[0])
+        self.leaders = [g[0] for g in self.groups]
+        self.gidx = next(i for i, g in enumerate(self.groups)
+                         if rank in g)
+        n = len(self.groups)
+        # degenerate: one group (pure intra), all singletons (pure
+        # inter == flat with extra dispatch), or more groups than tag
+        # windows — nothing hierarchical to win
+        self.degenerate = n < 2 or n == size or n > groups_mod.MAX_GROUPS
+        # the auto-on bar: at least two groups that actually HAVE an
+        # intra phase — anything less and flat is at least as good
+        self.qualified = (not self.degenerate) and sum(
+            1 for g in self.groups if len(g) >= 2) >= 2
+
+    def group_of(self, rank: int) -> int:
+        return next(i for i, g in enumerate(self.groups) if rank in g)
+
+
+def topology(ctx, groups: list[list[int]] | None = None) -> _Topology:
+    """The endpoint's (cached) locality topology; ``groups`` overrides
+    the modex derivation (test harnesses emulating multi-host layouts
+    on the thread plane)."""
+    if groups is None:
+        cached = getattr(ctx, "_han_topology", None)
+        if cached is not None:
+            return cached
+        topo = _Topology(ctx.size, ctx.rank,
+                         groups_mod.locality_groups(ctx))
+        ctx._han_topology = topo
+        return topo
+    return _Topology(ctx.size, ctx.rank, groups)
+
+
+def invalidate(ctx) -> None:
+    """Drop the cached topology/views (a membership change: JOIN
+    re-modex scrubbing a rejoiner's card).  The next han collective
+    re-derives the groups — the same rebuild a shrink gets by being a
+    fresh endpoint."""
+    for attr in ("_han_topology", "_han_views"):
+        try:
+            delattr(ctx, attr)
+        except AttributeError:
+            pass
+
+
+def _views(ctx, topo: _Topology) -> tuple[GroupView, GroupView | None]:
+    """(intra view, leader view-or-None) for this rank, cached per
+    group structure.  Building the views IS the leader election (the
+    deterministic min-rank rule), counted in
+    ``coll_han_leader_elections``."""
+    cache = getattr(ctx, "_han_views", None)
+    if cache is None:
+        cache = {}
+        ctx._han_views = cache
+    key = tuple(tuple(g) for g in topo.groups)
+    got = cache.get(key)
+    if got is None:
+        intra = GroupView(ctx, topo.groups[topo.gidx],
+                          window=topo.gidx, plane="intra")
+        inter = None
+        if ctx.rank in topo.leaders:
+            inter = GroupView(ctx, topo.leaders, window=LEADER_WINDOW,
+                              plane="inter")
+        spc.record("coll_han_leader_elections", 1)
+        got = (intra, inter)
+        cache[key] = got
+    return got
+
+
+def _flat_fallback(ctx, opname: str, reason: str) -> None:
+    """An explicitly-requested han that cannot run hierarchically:
+    LOUD degradation — counted (the OSU ladder gates on zero) and
+    emitted, never silent."""
+    spc.record("han_flat_fallbacks", 1)
+    mca_output.emit(
+        _stream,
+        "rank %s: %s requested the hierarchical (han) path but %s; "
+        "running the flat algorithm", getattr(ctx, "rank", "?"),
+        opname, reason,
+    )
+
+
+def _rule_requests_han(opname: str, size: int, payload: Any) -> bool:
+    path = mca_var.get("coll_tuned_dynamic_rules", "")
+    if not path:
+        return False
+    # late import: tuned pulls the device-plane stack; only rules-file
+    # users pay for it.  Size matching uses the LOCAL payload size —
+    # ops whose payloads are not congruent across ranks (the host
+    # plane's bcast has none at non-roots) must use msg_bytes_min 0.
+    from . import tuned
+
+    return tuned._dynamic_rule(
+        opname, size, payload_bytes(payload)) == "han"
+
+
+def wants_han(ctx, opname: str, payload: Any = None, op=None,
+              mode: str | None = None) -> bool:
+    """The han half of the host-plane decision (called from
+    ``coll/host.py``'s dispatch seam): True when this collective should
+    take the two-level schedule."""
+    if mode is None:
+        mode = str(mca_var.get("coll_han_enable", "auto"))
+    if mode == "off" or opname not in HAN_OPS:
+        return False
+    if getattr(ctx, "_han_subview", False):
+        return False  # phase traffic re-enters the flat algorithms
+    requested = mode == "on" or _rule_requests_han(
+        opname, getattr(ctx, "size", 0), payload)
+    if not requested and mode != "auto":  # unknown mode string: off
+        return False
+    topo = topology(ctx)
+    noncommutative = op is not None and not getattr(op, "commute", True)
+    if requested:
+        if topo.degenerate:
+            _flat_fallback(ctx, opname, "the topology is degenerate "
+                           f"({len(topo.groups)} locality group(s) over "
+                           f"{ctx.size} rank(s))")
+            return False
+        if noncommutative:
+            _flat_fallback(ctx, opname, "the op is non-commutative "
+                           "(group combine order != rank order)")
+            return False
+        return True
+    return topo.qualified and not noncommutative
+
+
+def _require_commutative(op, opname: str) -> None:
+    if op is not None and not getattr(op, "commute", True):
+        raise errors.ArgError(
+            f"han {opname} requires a commutative op (group combine "
+            "order is not rank order); use the flat path"
+        )
+
+
+# ------------------------------------------------------------ allreduce
+
+
+def allreduce(ctx, value: Any, op,
+              groups: list[list[int]] | None = None) -> Any:
+    """Two-level allreduce: intra reduce → leader allreduce → intra
+    bcast.  Above ``host_coll_large_msg`` the leader exchange runs the
+    split (reduce-scatter + allgather) ring explicitly — the
+    bandwidth-optimal inter-node schedule, applied to exactly the hops
+    that cross the wire."""
+    _require_commutative(op, "allreduce")
+    topo = topology(ctx, groups)
+    intra, inter = _views(ctx, topo)
+    partial = host.reduce(intra, value, op, root=0) \
+        if intra.size > 1 else value
+    full = None
+    if inter is not None:
+        full = _leader_allreduce(inter, partial, op)
+    if intra.size > 1:
+        full = host.bcast(intra, full, root=0, algorithm="binomial")
+    return full
+
+
+def _leader_allreduce(inter, partial: Any, op) -> Any:
+    """The inter phase of allreduce.  Below ``host_coll_large_msg`` the
+    flat allreduce runs as-is (recursive doubling — 2 leaders is its
+    sweet spot).  Above it, the payload takes the SPLIT schedule —
+    reduce-scatter + allgather across the leaders — processed in
+    ``coll_han_inter_segment`` pieces so every wire transfer stays on
+    the eager zero-copy path (segments are congruent across leaders:
+    the geometry derives from the reduced payload, which the reduce
+    phase made identical everywhere)."""
+    if inter.size <= 1:
+        return partial
+    large = int(mca_var.get("host_coll_large_msg", 256 * 1024))
+    if (
+        not isinstance(partial, np.ndarray)
+        or partial.nbytes < large
+        or partial.size < inter.size
+    ):
+        return host.allreduce(inter, partial, op)
+    seg_bytes = max(1, int(mca_var.get("coll_han_inter_segment",
+                                       1 << 20)))
+    arr = np.ascontiguousarray(partial)
+    flat = arr.reshape(-1)
+    seg = max(inter.size, seg_bytes // max(arr.dtype.itemsize, 1))
+    if flat.size <= seg:
+        if inter.size > 2:
+            tag = host._next_tag(inter, host.TAG_ALLREDUCE)
+            return host._allreduce_ring(
+                inter, flat, op, tag).reshape(arr.shape)
+        return np.asarray(
+            host.allreduce(inter, flat, op)).reshape(arr.shape)
+    out = np.empty_like(flat)
+    for off in range(0, flat.size, seg):
+        piece = flat[off:off + seg]
+        if inter.size > 2:
+            tag = host._next_tag(inter, host.TAG_ALLREDUCE)
+            done = host._allreduce_ring(inter, piece, op, tag)
+        else:
+            done = host.allreduce(inter, piece, op)
+        out[off:off + seg] = np.asarray(done).reshape(-1)
+    return out.reshape(arr.shape)
+
+
+# -------------------------------------------------------------- bcast
+
+
+def bcast(ctx, obj: Any = None, root: int = 0,
+          groups: list[list[int]] | None = None) -> Any:
+    """Two-level bcast.  The leader set is FIXED (min rank per group,
+    so every rank agrees on the tag windows with no negotiation); a
+    non-leader root first hands the payload to its group's leader over
+    the intra window — every member of that group consumes the hop tag
+    so the window's sequence stays uniform."""
+    topo = topology(ctx, groups)
+    intra, inter = _views(ctx, topo)
+    root_g = topo.group_of(root)
+    root_leader = topo.groups[root_g][0]
+    if root != root_leader and topo.gidx == root_g:
+        hoptag = host._next_tag(intra, host.TAG_BCAST)
+        if ctx.rank == root:
+            intra.send(obj, 0, tag=hoptag)
+        elif ctx.rank == root_leader:
+            obj = intra.recv(source=intra.rel(root), tag=hoptag)
+    if inter is not None:
+        obj = host.bcast(inter, obj, algorithm="binomial",
+                         root=topo.leaders.index(root_leader))
+    out = host.bcast(intra, obj, root=0, algorithm="binomial") \
+        if intra.size > 1 else obj
+    # the root returns ITS payload (MPI buffer semantics), not the
+    # round-tripped copy the intra phase delivered back to it
+    return obj if ctx.rank == root and root != root_leader else out
+
+
+# -------------------------------------------------------------- reduce
+
+
+def reduce(ctx, value: Any, op, root: int = 0,
+           groups: list[list[int]] | None = None) -> Any:
+    """Two-level reduce: intra reduce → leader reduce rooted at the
+    root's group leader → leader→root hop.  Result significant at root
+    (others return None)."""
+    _require_commutative(op, "reduce")
+    topo = topology(ctx, groups)
+    intra, inter = _views(ctx, topo)
+    root_g = topo.group_of(root)
+    root_leader = topo.groups[root_g][0]
+    partial = host.reduce(intra, value, op, root=0) \
+        if intra.size > 1 else value
+    res = None
+    if inter is not None:
+        res = host.reduce(inter, partial, op,
+                          root=topo.leaders.index(root_leader))
+    if root != root_leader and topo.gidx == root_g:
+        hoptag = host._next_tag(intra, host.TAG_REDUCE)
+        if ctx.rank == root_leader:
+            intra.send(res, intra.rel(root), tag=hoptag)
+            res = None
+        elif ctx.rank == root:
+            res = intra.recv(source=0, tag=hoptag)
+    return res if ctx.rank == root else None
+
+
+# -------------------------------------------------------------- barrier
+
+
+def barrier(ctx, groups: list[list[int]] | None = None) -> None:
+    """Two-level barrier: intra gather (arrival) → leader allgather →
+    intra bcast (release) — p-1 sm hops plus the leader exchange,
+    instead of log2(p) interleaved-transport dissemination rounds."""
+    topo = topology(ctx, groups)
+    intra, inter = _views(ctx, topo)
+    if intra.size > 1:
+        host.gather(intra, b"", root=0)
+    if inter is not None and inter.size > 1:
+        host.allgather(inter, b"")
+    if intra.size > 1:
+        host.bcast(intra, b"", root=0, algorithm="binomial")
+
+
+# ------------------------------------------------------------ allgather
+
+
+def allgather(ctx, value: Any,
+              groups: list[list[int]] | None = None) -> list:
+    """Two-level allgather: intra gather → leader allgather (each block
+    travels with its group's global rank map) → intra bcast of the
+    assembled rank-indexed list."""
+    topo = topology(ctx, groups)
+    intra, inter = _views(ctx, topo)
+    mine = host.gather(intra, value, root=0) \
+        if intra.size > 1 else [value]
+    out = None
+    if inter is not None:
+        blocks = host.allgather(inter, mine)
+        out = [None] * ctx.size
+        for gi, vals in enumerate(blocks):
+            for g, v in zip(topo.groups[gi], vals):
+                out[g] = v
+    if intra.size > 1:
+        out = host.bcast(intra, out, root=0, algorithm="binomial")
+    return out
+
+
+# -------------------------------------------------------- reduce_scatter
+
+
+def reduce_scatter(ctx, values: list, op,
+                   groups: list[list[int]] | None = None) -> Any:
+    """Two-level reduce_scatter: intra blockwise reduce → leader
+    alltoall (leader j ships leader k the partials of k's group
+    members) → per-block combine → intra scatter.  Rank r returns the
+    fully-reduced block r."""
+    _require_commutative(op, "reduce_scatter")
+    if len(values) != ctx.size:
+        raise errors.ArgError(
+            f"reduce_scatter needs {ctx.size} blocks"
+        )
+    topo = topology(ctx, groups)
+    intra, inter = _views(ctx, topo)
+    partial = host.reduce(intra, list(values), op, root=0) \
+        if intra.size > 1 else list(values)
+    mine = None
+    if inter is not None:
+        send = [[partial[g] for g in topo.groups[k]]
+                for k in range(len(topo.groups))]
+        got = host.alltoall(inter, send)
+        mine = got[0]
+        for j in range(1, len(got)):
+            mine = [host._combine(op, a, b)
+                    for a, b in zip(mine, got[j])]
+    if intra.size > 1:
+        return host.scatter(intra, mine, root=0)
+    return mine[0]

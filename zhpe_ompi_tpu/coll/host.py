@@ -52,6 +52,57 @@ mca_var.register(
     "latency- to bandwidth-dominated between 16KB and 256KB one-way",
     type=int,
 )
+mca_var.register(
+    "coll_han_enable", "auto",
+    "Hierarchical (han) host collectives: auto = two-level schedules "
+    "when the modex-derived locality topology has >= 2 same-host groups "
+    "with >= 2 members each; on = forced (degenerate topologies fall "
+    "back to the flat algorithms loudly via han_flat_fallbacks); off = "
+    "always flat.  A 'han' line in coll_tuned_dynamic_rules requests "
+    "the hierarchical path per op/size like a forced enable",
+    enum=("auto", "on", "off"),
+)
+mca_var.register(
+    "coll_tuned_dynamic_rules", "",
+    "Path to a dynamic decision-rules file "
+    "(<op> <comm_size_min> <msg_bytes_min> <algorithm> per line); the "
+    "host-plane han decision honors 'han' lines, so the var registers "
+    "with the host collectives too (the tuned component re-registers "
+    "idempotently with its own surface)",
+)
+
+
+# the collectives with a hierarchical (coll/han) two-level schedule —
+# the canonical set: the dispatch seam below, coll/han.py's decision,
+# and coll/tuned.py's rules-line validation all read THIS name
+HAN_OPS = frozenset((
+    "allreduce", "bcast", "reduce", "barrier", "allgather",
+    "reduce_scatter",
+))
+
+
+def _han_route(ctx, opname: str, payload: Any = None, op=None):
+    """The coll/han dispatch seam (the comm_select interposition point
+    of the host plane): returns the han module when this collective
+    should take the hierarchical two-level schedule, None for the flat
+    algorithms below.  Kept UPSTREAM of the algorithm bodies so han's
+    own phases — GroupView sub-endpoints, marked ``_han_subview`` —
+    re-enter the flat paths unconditionally (no recursive hierarchy)."""
+    if getattr(ctx, "_han_subview", False):
+        return None
+    mode = str(mca_var.get("coll_han_enable", "auto"))
+    if mode == "off":
+        return None
+    if mode == "auto" and getattr(ctx, "size", 1) < 4 \
+            and not mca_var.get("coll_tuned_dynamic_rules", ""):
+        # cheap pre-topology out: < 4 ranks cannot hold two >=2-member
+        # groups, and no rules file means nothing can request han
+        return None
+    from . import han as han_mod
+
+    if han_mod.wants_han(ctx, opname, payload, op, mode):
+        return han_mod
+    return None
 
 # Reserved context id for host-plane collective traffic (the
 # MCA_COLL_BASE_TAG_* space; barrier already uses cid 0x7FFF).
@@ -149,6 +200,17 @@ def bcast(ctx, obj: Any = None, root: int = 0,
         raise errors.ArgError(
             f"unknown bcast algorithm {alg!r} (binomial|pipeline)"
         )
+    if algorithm is None and alg == "binomial":
+        # explicit algorithm selection — argument OR a non-default
+        # host_bcast_algorithm var — outranks the topology layer
+        # (forced algorithms are the user's responsibility, as in
+        # tuned).  The payload is significant at root ONLY, so the
+        # size-matched dynamic-rules check sees 0 bytes on every rank
+        # (a root-only size would split the decision across ranks);
+        # han bcast rules therefore use msg_bytes_min 0.
+        han = _han_route(ctx, "bcast", None)
+        if han is not None:
+            return han.bcast(ctx, obj, root)
     size, rank = ctx.size, ctx.rank
     if size == 1:
         return obj
@@ -338,6 +400,10 @@ def reduce(ctx, value: Any, op, root: int = 0,
         raise errors.ArgError(
             f"unknown reduce algorithm {alg!r} (auto|pipeline)"
         )
+    if algorithm is None and alg == "auto":
+        han = _han_route(ctx, "reduce", value, op)
+        if han is not None:
+            return han.reduce(ctx, value, op, root)
     if size == 1:
         return value
     if alg == "pipeline":
@@ -425,6 +491,9 @@ def allreduce(ctx, value: Any, op) -> Any:
     size, rank = ctx.size, ctx.rank
     if size == 1:
         return value
+    han = _han_route(ctx, "allreduce", value, op)
+    if han is not None:
+        return han.allreduce(ctx, value, op)
     tag = _next_tag(ctx, TAG_ALLREDUCE)
     large = int(mca_var.get("host_coll_large_msg", 256 * 1024))
     if (
@@ -481,10 +550,18 @@ def allgather(ctx, value: Any) -> list:
     """Ring allgather (coll_base_allgather.c ring): p-1 steps, each rank
     forwards the block it just received.  Returns the rank-indexed list."""
     size, rank = ctx.size, ctx.rank
+    if size == 1:
+        return [value]
+    # size-matched rules see 0 bytes here: allgather payloads need not
+    # be congruent across ranks (arbitrary per-rank objects), so a
+    # local size would split the han/flat decision and deadlock —
+    # allgather han rules therefore use msg_bytes_min 0 (the bcast
+    # discipline; reduce/allreduce payloads ARE congruent by contract)
+    han = _han_route(ctx, "allgather", None)
+    if han is not None:
+        return han.allgather(ctx, value)
     out: list = [None] * size
     out[rank] = value
-    if size == 1:
-        return out
     tag = _next_tag(ctx, TAG_ALLGATHER)
     right = (rank + 1) % size
     left = (rank - 1) % size
@@ -706,6 +783,9 @@ def reduce_scatter(ctx, values: list, op) -> Any:
     size = ctx.size
     if len(values) != size:
         raise errors.ArgError(f"reduce_scatter needs {size} blocks")
+    han = _han_route(ctx, "reduce_scatter", values, op)
+    if han is not None:
+        return han.reduce_scatter(ctx, values, op)
     reduced = reduce(ctx, values, op, root=0, algorithm="auto")
     return scatter(ctx, reduced, root=0)
 

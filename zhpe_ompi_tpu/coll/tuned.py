@@ -204,7 +204,19 @@ def _register_params():
     mca_var.register(
         "coll_tuned_dynamic_rules", "",
         "Path to a dynamic decision-rules file "
-        "(<op> <comm_size_min> <msg_bytes_min> <algorithm> per line)",
+        "(<op> <comm_size_min> <msg_bytes_min> <algorithm> per line; "
+        "'han' as the algorithm selects the hierarchical host path for "
+        + ", ".join(sorted(_HAN_RULE_OPS)) + ")",
+    )
+    # the hierarchical host component's enable knob lives with the host
+    # collectives (coll/host.py registers it at import); re-register
+    # here so the MPI_T/zmpi-info surface lists it with the decision
+    # layer's other vars even in device-only processes
+    mca_var.register(
+        "coll_han_enable", "auto",
+        "Hierarchical (han) host collectives: auto/on/off (see "
+        "coll/host.py)",
+        enum=("auto", "on", "off"),
     )
 
 
@@ -213,6 +225,64 @@ from ..utils.payload import payload_nbytes as _nbytes  # noqa: E402
 
 _rules_cache: dict[str, list[tuple[str, int, int, str]]] = {}
 
+# host-plane ops the hierarchical (coll/han) component provides: "han"
+# is a valid rule-line algorithm for exactly these — the rule then
+# selects the two-level schedule through coll/host.py's dispatch seam
+# (the DEVICE decision below never returns it; its tables are XLA-side).
+# One source of truth: the seam's own set.
+from .host import HAN_OPS as _HAN_RULE_OPS  # noqa: E402
+
+
+def _valid_rule_alg(op: str, algname: str) -> bool:
+    table = _ALG_TABLES.get(op)
+    if table is not None and algname in table:
+        return True
+    return algname == "han" and op in _HAN_RULE_OPS
+
+
+def _load_rules(path: str) -> list[tuple[str, int, int, str]]:
+    """Parse a dynamic-rules file, degrading LOUDLY per line: a
+    malformed or unknown-op/unknown-algorithm line is reported and
+    skipped — the decision then falls back to the fixed defaults — but
+    never raises out of the decision layer into a collective call."""
+    rules: list[tuple[str, int, int, str]] = []
+    try:
+        with open(path, "r", encoding="utf-8") as fh:
+            for lineno, line in enumerate(fh, 1):
+                parts = line.split("#")[0].split()
+                if not parts:
+                    continue
+                reason = None
+                if len(parts) != 4:
+                    reason = "expected <op> <comm_min> <bytes_min> <alg>"
+                else:
+                    try:
+                        cmin, bmin = int(parts[1]), int(parts[2])
+                    except ValueError:
+                        reason = "non-integer comm/byte threshold"
+                    else:
+                        if not _valid_rule_alg(parts[0], parts[3]):
+                            reason = (
+                                f"unknown op/algorithm "
+                                f"{parts[0]}/{parts[3]}"
+                            )
+                if reason is not None:
+                    mca_output.emit(
+                        _stream,
+                        "coll_tuned_dynamic_rules %s:%d: ignoring "
+                        "%r (%s); the fixed decision applies",
+                        path, lineno, line.strip(), reason,
+                    )
+                    continue
+                rules.append((parts[0], cmin, bmin, parts[3]))
+    except OSError as e:
+        mca_output.emit(
+            _stream,
+            "coll_tuned_dynamic_rules file %r unreadable (%s); "
+            "falling back to fixed decisions", path, e,
+        )
+    return rules
+
 
 def _dynamic_rule(opname: str, comm_size: int, nbytes: int) -> str | None:
     path = mca_var.get("coll_tuned_dynamic_rules", "")
@@ -220,22 +290,7 @@ def _dynamic_rule(opname: str, comm_size: int, nbytes: int) -> str | None:
         return None
     rules = _rules_cache.get(path)
     if rules is None:
-        rules = []
-        try:
-            with open(path, "r", encoding="utf-8") as fh:
-                for line in fh:
-                    parts = line.split("#")[0].split()
-                    if len(parts) == 4:
-                        rules.append(
-                            (parts[0], int(parts[1]), int(parts[2]), parts[3])
-                        )
-        except OSError as e:
-            mca_output.emit(
-                _stream,
-                "coll_tuned_dynamic_rules file %r unreadable (%s); "
-                "falling back to fixed decisions", path, e,
-            )
-        _rules_cache[path] = rules
+        rules = _rules_cache[path] = _load_rules(path)
     best = None
     best_key = (-1, -1)
     for op, cmin, bmin, algname in rules:

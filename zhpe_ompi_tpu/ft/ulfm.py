@@ -187,6 +187,12 @@ class FailureState:
         self._acked: set[int] = set()
         self._cause: dict[int, str] = {}
         self._revoked: set[int] = set()
+        # cid -> logical cid it carries traffic FOR: the han tag
+        # windows (pt2pt/groups.py GroupView) register themselves as
+        # aliases of the collective cid, so revoking the logical
+        # channel poisons the hierarchical phases' parked and future
+        # operations exactly like the flat path's
+        self._cid_aliases: dict[int, int] = {}
         self._shrink_groups: dict[int, frozenset[int]] = {}
         self._agreements: dict[int, Any] = {}
         # cumulative crash counter: bumps on every NEWLY-learned crash
@@ -400,20 +406,34 @@ class FailureState:
             self._revoked.add(int(cid))
             self._cv.notify_all()
 
+    def alias_cid(self, cid: int, logical: int) -> None:
+        """Declare ``cid`` a sub-channel of ``logical``: revocation of
+        the logical cid then classifies against both (the han tag
+        windows ride this; see pt2pt/groups.py)."""
+        with self._cv:
+            self._cid_aliases[int(cid)] = int(logical)
+
     def is_revoked(self, cid: int) -> bool:
-        return cid in self._revoked
+        # unlocked fast path (monotonic poison set + write-once aliases)
+        return cid in self._revoked or \
+            self._cid_aliases.get(cid) in self._revoked
 
     def revoked_cids(self) -> frozenset:
         """Snapshot of the endpoint-plane revoked cids — the checkpoint
         quiescence view exempts their queue rows: a revoked channel
         never delivers again (recv on it raises ``Revoked``), so an
         aborted schedule's parked receives must not wedge
-        ``quiesce_check`` for the rest of the job's life."""
+        ``quiesce_check`` for the rest of the job's life.  Aliased
+        sub-channels (han tag windows) whose LOGICAL cid is revoked are
+        included: their parked phase ops are just as uncancellable."""
         with self._cv:
-            return frozenset(self._revoked)
+            out = set(self._revoked)
+            out.update(c for c, logical in self._cid_aliases.items()
+                       if logical in self._revoked)
+            return frozenset(out)
 
     def check_revoked(self, cid: int) -> None:
-        if cid in self._revoked:
+        if self.is_revoked(cid):
             raise errors.Revoked(f"communicator cid={cid} is revoked",
                                  cid=cid)
 
@@ -875,6 +895,16 @@ class ShrunkEndpoint(HostCollectives):
         if source == -1:  # ANY_SOURCE passes through
             return source
         return self._map[source]
+
+    def boot_token_of(self, rank: int) -> str | None:
+        """Locality identity of a SHRUNK rank, translated to the parent
+        endpoint — the han topology layer's rebuild contract: a
+        post-shrink hierarchical collective derives its groups from the
+        survivor set, not the pre-failure membership."""
+        fn = getattr(self._ep, "boot_token_of", None)
+        if fn is None:
+            return None
+        return fn(self._map[rank])
 
     def send(self, obj: Any, dest: int, tag: int = 0, cid: int = 0) -> None:
         self._ep.send(obj, self._map[dest], tag, _shrink_cid(self._gen, cid))

@@ -142,8 +142,29 @@ _OFF_STOPPED = 128   # owner's poll loop exited (peers stop quiescing)
 # thread costs ~0.5ms of scheduler latency per message on a small
 # host).  Past the window the thread parks on the doorbell futex, so
 # idle procs cost nothing and wakeups are event-driven; the fallback
-# without futex support sleeps in short bounded steps instead.
-_HOT_S = 0.005
+# without futex support sleeps in short bounded steps instead.  The
+# window is the sm_poll_hot_us MCA var below (0 on single-CPU masks).
+
+
+def _ncpus() -> int:
+    try:
+        return len(os.sched_getaffinity(0))
+    except (AttributeError, OSError):  # pragma: no cover - non-linux
+        return os.cpu_count() or 1
+
+
+mca_var.register(
+    "sm_poll_hot_us", 5000 if _ncpus() > 1 else 0,
+    "Hot-spin window (microseconds) of the sm poll thread after its "
+    "last traffic, before it parks on the doorbell futex.  Spinning "
+    "only helps when a core is free to burn (the consumer must run "
+    "WHILE the producer produces): on a single-CPU affinity mask the "
+    "spinner steals the very core the producer needs — measured to "
+    "serialize the han collectives' localized phases behind idle "
+    "procs' spinners — so the default is 0 there and 5000 (the "
+    "measured ping-pong cover) on multi-core hosts",
+    type=int,
+)
 # the doze is also the bound on a lost wakeup the fence below cannot
 # fully rule out — keep it SHORT
 _DOZE_S = 0.005
@@ -330,6 +351,14 @@ def _ring_span(nslots: int, slot_bytes: int) -> int:
     return _RING_HDR + nslots * (_SLOT_HDR + slot_bytes)
 
 
+class ConsumerStopped(errors.InternalError):
+    """The destination ring's owner stopped consuming (sever/crash, or
+    the tail of an orderly close): the peer is GONE.  A distinct type
+    so the transport seam can classify it as peer death on ft procs —
+    the sm twin of TCP's connection-reset-IS-death rule — instead of
+    surfacing a bare transport error."""
+
+
 class _RingState:
     """Consumer-side per-ring bookkeeping (the owner is the only
     consumer; ``tail`` here is authoritative, the shm copy exists for
@@ -405,6 +434,10 @@ class SmSegment:
             _RingState(src, _SEG_HDR + src * span)
             for src in range(size) if src != rank
         ]
+        # per-segment hot window (sm_poll_hot_us): 0 on single-CPU
+        # affinity masks — see the var's rationale
+        self._hot_s = max(0, int(mca_var.get("sm_poll_hot_us", 5000))) \
+            / 1e6
         self._stop = threading.Event()
         self._closed = False
         self._close_lock = threading.Lock()
@@ -476,7 +509,7 @@ class SmSegment:
 
     def _poll_loop(self) -> None:
         mm = self._mm
-        hot_until = time.monotonic() + _HOT_S
+        hot_until = time.monotonic() + self._hot_s
         try:
             while not self._stop.is_set():
                 progressed = False
@@ -484,7 +517,7 @@ class SmSegment:
                     progressed |= self._drain_ring(st)
                 now = time.monotonic()
                 if progressed:
-                    hot_until = now + _HOT_S
+                    hot_until = now + self._hot_s
                     continue
                 if now < hot_until:
                     # hot but cooperative: yield the GIL every pass so
@@ -497,7 +530,7 @@ class SmSegment:
                 _fence()  # flag store must precede the head re-reads
                 if self._any_ready() or self._stop.is_set():
                     _U32.pack_into(mm, _OFF_DOORBELL, 0)
-                    hot_until = time.monotonic() + _HOT_S
+                    hot_until = time.monotonic() + self._hot_s
                     continue
                 _futex_wait(mm, _OFF_DOORBELL, 1, _DOZE_S)
                 _U32.pack_into(mm, _OFF_DOORBELL, 0)
@@ -616,7 +649,7 @@ class SmSender:
             if _U32.unpack_from(mm, _OFF_STOPPED)[0]:
                 if spins:
                     spc.record("sm_ring_full_spins", spins)
-                raise errors.InternalError(
+                raise ConsumerStopped(
                     f"sm ring to rank {self.dest}: consumer stopped"
                 )
             tail = _U64.unpack_from(mm, self._base + 64)[0]

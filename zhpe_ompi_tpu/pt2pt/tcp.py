@@ -109,26 +109,9 @@ _RNDV_DATA_CID = 0x7FF9
 _RTS_MARK = "__zmpi_rndv_rts__"
 
 
-def _payload_size(obj: Any, _depth: int = 0) -> int:
-    """Recursive payload size estimate for the eager/rendezvous switch —
-    container-wrapped arrays (the host collectives ship (idx, block)
-    tuples) must count their array bytes, or large payloads dodge the
-    receiver-memory bound the rendezvous exists for."""
-    if hasattr(obj, "nbytes"):
-        return int(obj.nbytes)
-    if isinstance(obj, (bytes, bytearray)):
-        return len(obj)
-    if isinstance(obj, str):
-        return len(obj)  # bytes-per-char >= 1; a lower bound is enough
-    if _depth < 4:
-        if isinstance(obj, (list, tuple)):
-            return sum(_payload_size(o, _depth + 1) for o in obj)
-        if isinstance(obj, dict):
-            return sum(
-                _payload_size(k, _depth + 1) + _payload_size(v, _depth + 1)
-                for k, v in obj.items()
-            )
-    return 0
+# eager/rendezvous switch sizing — the shared estimator (one
+# implementation for the transport switch AND the han SPC accounting)
+from ..utils.payload import payload_size_estimate as _payload_size  # noqa: E402
 
 
 def _byte_views(segments) -> list[memoryview]:
@@ -413,6 +396,8 @@ class TcpProc(errh.HasErrhandler, ulfm.UlfmEndpointAPI, HostCollectives,
         _live_push_pools.add(self._push_pool)
         self._drains: list[threading.Thread] = []
         self._drain_lock = threading.Lock()
+        self._flood_threads: list[threading.Thread] = []
+        self._flood_lock = threading.Lock()
         self._dup_conns: list[socket.socket] = []  # crossed-connect extras
         self._timeout = timeout
         self._conns: dict[int, socket.socket] = {}
@@ -719,7 +704,7 @@ class TcpProc(errh.HasErrhandler, ulfm.UlfmEndpointAPI, HostCollectives,
             if isinstance(e, (ConnectionRefusedError, ConnectionResetError,
                               BrokenPipeError)):
                 # connection refused/reset IS peer death, not a stall
-                self.ft_state.mark_failed(dest, cause="transport")
+                self._mark_transport_death(dest)
 
     def _flood(self, cid: int, payload: Any, name: str) -> None:
         """Best-effort ULFM control-plane flood to every live peer, on a
@@ -728,11 +713,26 @@ class TcpProc(errh.HasErrhandler, ulfm.UlfmEndpointAPI, HostCollectives,
         it), a rank mid-recovery revoking a cid, a completing agreement
         — may stall behind serial connect deadlines to unreachable
         peers.  An undeliverable frame is dropped: the peer's own
-        detector/recovery path covers it."""
-        threading.Thread(
+        detector/recovery path covers it.  Threads are TRACKED so an
+        orderly close() can flush them before tearing the wire down —
+        an agreement announce racing its own rank's close would strand
+        survivors in a round nobody can finish (sever(), a crash,
+        still abandons them by design)."""
+        t = threading.Thread(
             target=self._flood_sync, args=(cid, payload),
             daemon=True, name=f"{name}-{self.rank}",
-        ).start()
+        )
+        with self._flood_lock:
+            # registered BEFORE start so a concurrent close() cannot
+            # miss it; the prune must therefore keep registered-but-
+            # unstarted threads (ident is None until start()) or a
+            # sibling's prune could silently un-track this flood
+            self._flood_threads = [
+                x for x in self._flood_threads
+                if x.ident is None or x.is_alive()
+            ]
+            self._flood_threads.append(t)
+        t.start()
 
     def _flood_sync(self, cid: int, payload: Any) -> None:
         frame = dss.pack(self.rank, 0, cid, 0, payload)
@@ -749,6 +749,18 @@ class TcpProc(errh.HasErrhandler, ulfm.UlfmEndpointAPI, HostCollectives,
         """Propagate suspicion: failure notices to every live rank."""
         self._flood(ulfm.FT_NOTICE_CID, sorted(int(r) for r in failed),
                     "hb-flood")
+
+    def _mark_transport_death(self, dest: int) -> None:
+        """Classify a transport-evidenced death (connection reset /
+        refused past backoff / sm consumer stopped) AND flood the
+        notice, exactly as the detector floods its suspicions: without
+        propagation every rank discovers the corpse independently, and
+        a ring observer can false-positive its NEW observed before
+        that rank redirects its beats away from the corpse (the
+        reconfiguration grace race, observed under scheduler noise)."""
+        if self.ft_state.mark_failed(dest, cause="transport") \
+                and not self._ft_dead and not self._closed.is_set():
+            self._ft_flood(self.ft_state.failed())
 
     def _agree_announce(self, seq: int, result) -> None:
         """Flood a completed agreement's value into the live peers'
@@ -869,6 +881,12 @@ class TcpProc(errh.HasErrhandler, ulfm.UlfmEndpointAPI, HostCollectives,
             with self._sm_lock:
                 self._sm_senders[jrank] = None
                 self._sm_declined.discard(jrank)
+            # membership change: the joiner's locality card was just
+            # scrubbed, so the next hierarchical collective must
+            # re-derive the groups (the rejoiner is a singleton now)
+            from ..coll import han as han_mod
+
+            han_mod.invalidate(self)
             if self._detector is not None:
                 self._detector.transport.grace(jrank)
             self.ft_state.restore(jrank)
@@ -944,6 +962,20 @@ class TcpProc(errh.HasErrhandler, ulfm.UlfmEndpointAPI, HostCollectives,
         """Simulate a hang/partition: heartbeats stop, sockets stay up —
         only the failure detector can discover this death."""
         self._ft_dead = True
+
+    def boot_token_of(self, rank: int) -> str | None:
+        """Locality identity of ``rank`` as the modex advertised it (the
+        boot half of the ``(boot_id, segment)`` card ``pt2pt/sm.py``
+        publishes): equal tokens = provably the same host.  None =
+        unknown (sm=0 peers, C ranks, rejoiners — their pyshm card was
+        scrubbed at JOIN), which the han topology layer groups as its
+        own singleton locality.  Own rank reads its OWN relayed card,
+        so every rank derives the identical group structure."""
+        cards = getattr(self, "_peer_cards", None)
+        if cards is None or not 0 <= rank < len(cards):
+            return None
+        card = sm_mod.parse_card(cards[rank])
+        return card[0] if card is not None else None
 
     # -- wire-up ---------------------------------------------------------
 
@@ -1172,7 +1204,7 @@ class TcpProc(errh.HasErrhandler, ulfm.UlfmEndpointAPI, HostCollectives,
                     ):
                         # refused past the backoff budget: the peer's
                         # listener is gone — that is death, not a stall
-                        state.mark_failed(dest, cause="transport")
+                        self._mark_transport_death(dest)
                         raise errors.ProcFailed(
                             f"rank {dest} unreachable "
                             f"(connection refused/reset): {e}",
@@ -1306,6 +1338,23 @@ class TcpProc(errh.HasErrhandler, ulfm.UlfmEndpointAPI, HostCollectives,
                 if poll:
                     raise
                 return self.call_errhandler(exc)
+            except sm_mod.ConsumerStopped as e:
+                # the ring's owner stopped consuming: on an ft proc that
+                # IS peer death — the sm twin of connection reset (the
+                # detector/BYE may simply not have landed yet); classify
+                # instead of surfacing a bare transport error
+                if state is None:
+                    if poll:
+                        raise
+                    return self.call_errhandler(e)
+                self._mark_transport_death(dest)
+                exc = errors.ProcFailed(
+                    f"rank {dest} failed (sm ring consumer stopped): "
+                    f"{e}", failed_ranks=state.failed(),
+                )
+                if poll:
+                    raise exc from e
+                return self.call_errhandler(exc)
             except errors.InternalError as exc:
                 # wedged/closed ring: a transport failure, not a crash —
                 # same disposition routing as a TCP stall would get
@@ -1351,7 +1400,7 @@ class TcpProc(errh.HasErrhandler, ulfm.UlfmEndpointAPI, HostCollectives,
                 # death — only reset/refused/pipe is; the endpoint layer
                 # already re-raised non-death errors raw, honor that here
                 raise
-            state.mark_failed(dest, cause="transport")
+            self._mark_transport_death(dest)
             exc = errors.ProcFailed(
                 f"send to rank {dest} failed: {e}",
                 failed_ranks=state.failed(),
@@ -1614,7 +1663,14 @@ class TcpProc(errh.HasErrhandler, ulfm.UlfmEndpointAPI, HostCollectives,
         return self.recv(source, recvtag, cid)
 
     def barrier(self) -> None:
-        """Dissemination barrier over the wire."""
+        """Dissemination barrier over the wire (two-level over the
+        locality groups when the han layer is selected — the same
+        dispatch seam the host collectives run through)."""
+        from ..coll import host as coll_host
+
+        han = coll_host._han_route(self, "barrier")
+        if han is not None:
+            return han.barrier(self)
         n = self.size
         k = 1
         while k < n:
@@ -1623,7 +1679,31 @@ class TcpProc(errh.HasErrhandler, ulfm.UlfmEndpointAPI, HostCollectives,
             k <<= 1
 
     def close(self) -> None:
-        # Quiesce outstanding rendezvous sends FIRST — with the detector
+        # Control floods first: an in-flight agreement announce or
+        # revoke notice must reach the peers before the wire comes
+        # down — the flood threads are fire-and-forget for their
+        # CALLERS, but a CLOSING rank that takes its announce to the
+        # grave strands survivors waiting to adopt it (observed as a
+        # re-elected round computing a divergent agreement).  Bounded:
+        # each flood's per-peer connect deadline is 1 s, and a wedged
+        # flood must not hang shutdown.
+        flood_deadline = time.monotonic() + 5.0
+        with self._flood_lock:
+            floods = list(self._flood_threads)
+        for t in floods:
+            while True:
+                try:
+                    t.join(max(0.0, flood_deadline - time.monotonic()))
+                    break
+                except RuntimeError:
+                    # registered but not yet started (the flood's
+                    # spawner is between append and start()): joining
+                    # an unstarted thread raises — wait it into
+                    # existence, bounded by the same deadline
+                    if time.monotonic() >= flood_deadline:
+                        break
+                    time.sleep(0.001)
+        # Quiesce outstanding rendezvous sends next — with the detector
         # still beating: the payload parks here until the receiver's CTS,
         # so tearing down immediately after a buffered send() would
         # destroy data the peer is entitled to (ompi_mpi_finalize's
@@ -1737,6 +1817,11 @@ class TcpProc(errh.HasErrhandler, ulfm.UlfmEndpointAPI, HostCollectives,
         # segment unlinked — the lifecycle contract the hygiene gate
         # asserts (rings live exactly as long as their proc)
         self._sm_teardown()
+        # han tag-window registrations die with the proc (the group-view
+        # hygiene gate asserts a closed endpoint holds none)
+        from . import groups as groups_mod
+
+        groups_mod.release(self)
         try:
             self._listener.close()
         except OSError:

@@ -153,6 +153,15 @@ class RankContext(errh.HasErrhandler, ulfm.UlfmEndpointAPI,
         universe was built with ft=True)."""
         return self.universe.ft_state
 
+    def boot_token_of(self, rank: int) -> str:
+        """Locality identity for the han topology layer: thread ranks
+        share one process, so the whole universe is trivially ONE
+        locality group (the same-host case the reference's coll/han
+        reads from the RTE's proc locality)."""
+        if not 0 <= rank < self.size:
+            raise errors.RankError(f"rank {rank} out of range")
+        return f"uni-{id(self.universe):x}"
+
     # -- internals -------------------------------------------------------
 
     def _mbox(self, dest: int) -> queue.Queue:

@@ -47,6 +47,27 @@ dispatch seam in ``pt2pt/tcp.py``; the rings live in ``pt2pt/sm.py``):
   unmappable segment): visible degradation, asserted zero along the
   OSU ``--plane sm`` ladder.  Intentional TCP (``sm=0``, remote hosts,
   C ranks, rejoiners) is not counted.
+
+Hierarchical-collective counters (the coll/han analog; recorded by
+``coll/han.py`` and the ``pt2pt/groups.py`` GroupView send seam):
+
+- ``coll_han_leader_elections`` — locality-group structures built (the
+  deterministic min-rank leader election that accompanies each new
+  group layout on an endpoint: first engagement, post-shrink rebuild,
+  post-JOIN re-derivation).
+- ``coll_han_intra_bytes`` — payload bytes sent by intra-phase
+  (same-host group) traffic; rides the sm rings through the send seam.
+- ``coll_han_inter_bytes`` — payload bytes sent by inter-phase
+  (leader-to-leader) traffic — the bytes that actually cross the wire;
+  the OSU ``--plane han`` ladder asserts this rises on a multi-group
+  topology AND stays strictly below the flat ring's wire bytes at
+  equal payload.
+- ``han_flat_fallbacks`` — collectives that REQUESTED the hierarchical
+  path (``coll_han_enable=on`` or a ``han`` dynamic-rules line) but ran
+  flat (degenerate topology, non-commutative op): loud degradation,
+  asserted zero along the OSU han ladder's 2-host × 2-rank topology.
+  The ``auto`` mode's decision not to engage is not a fallback and is
+  not counted.
 """
 
 from __future__ import annotations

@@ -5,6 +5,32 @@ from __future__ import annotations
 import numpy as np
 
 
+def payload_size_estimate(obj, _depth: int = 0) -> int:
+    """Cheap recursive payload-size estimate for per-send decision
+    points — the eager/rendezvous switch (pt2pt/tcp.py), the han
+    phase-byte counters and size-matched rules (pt2pt/groups.py).
+    Jax-free and container-aware to depth 4: host collectives ship
+    ``(idx, block)`` tuples whose array bytes must count, or large
+    payloads dodge the receiver-memory bound the rendezvous exists
+    for.  Strings count len() — bytes-per-char >= 1; a lower bound is
+    enough.  One implementation on purpose: the transport switch and
+    the SPC accounting must never disagree about the same payload."""
+    if hasattr(obj, "nbytes"):
+        return int(obj.nbytes)
+    if isinstance(obj, (bytes, bytearray, str)):
+        return len(obj)
+    if _depth < 4:
+        if isinstance(obj, (list, tuple)):
+            return sum(payload_size_estimate(o, _depth + 1) for o in obj)
+        if isinstance(obj, dict):
+            return sum(
+                payload_size_estimate(k, _depth + 1)
+                + payload_size_estimate(v, _depth + 1)
+                for k, v in obj.items()
+            )
+    return 0
+
+
 def payload_nbytes(x) -> int:
     """Total bytes of a pytree of arrays (defensive: shapeless or exotic
     leaves count conservatively instead of raising — used by trace-time
