@@ -334,6 +334,141 @@ def bench_tcp(max_size: int = 4 << 20, iters: int = 50,
     return _pt2pt_ladder(max_size, iters, bw, window, sm=False)
 
 
+def _overlap_body(proc, payload, iters: int, window: int,
+                  blocking: bool):
+    """osu-style ishift overlap worker: both ranks post a window of
+    irecvs from the peer, issue a window of (i)sends toward it, run
+    calibrated compute, then waitall.  Two overlap views come back:
+
+    - ``overlap`` — sender availability: the fraction of the send
+      window's completion span during which the caller is FREE to
+      compute, ``1 - t_issue / t_send_span`` (no-compute pass).  The
+      blocking path measures 0 BY CONSTRUCTION (its sends are born
+      complete — issue IS the span), a true isend approaches 1; this
+      is the deterministic ratio the CI gate reads, and it holds on
+      any core count.
+    - ``osu_overlap`` — the OSU nonblocking-benchmark formula
+      ``(t_pure + t_compute - t_total) / t_pure`` with compute sized
+      to ``t_pure``: the fraction of comm time the hardware actually
+      hid under compute.  On a single-CPU affinity mask this is ~0
+      for everything (compute and the progress engine serialize on
+      the one core — there is nothing to hide INTO); on multi-core
+      hosts it converges toward the availability ratio.
+    """
+    peer = 1 - proc.rank
+    mat = np.ones((128, 128))
+
+    def compute(duration):
+        # BLAS matmul releases the GIL: the push-pool workers and the
+        # peer's drain threads run WHILE this rank computes wherever a
+        # core is free to take them
+        end = time.perf_counter() + duration
+        while time.perf_counter() < end:
+            mat @ mat
+
+    def one_iter(compute_s: float) -> tuple[float, float]:
+        """Returns (t_issue, t_send_span) of this iteration."""
+        rreqs = [proc.irecv(peer, tag=1) for _ in range(window)]
+        t0 = time.perf_counter()
+        if blocking:
+            sreqs = []
+            for _ in range(window):
+                proc.send(payload, dest=peer, tag=1)
+        else:
+            sreqs = [proc.isend(payload, dest=peer, tag=1)
+                     for _ in range(window)]
+        t_issue = time.perf_counter() - t0
+        if compute_s:
+            compute(compute_s)
+        for r in sreqs:
+            r.wait(120.0)
+        t_span = time.perf_counter() - t0
+        for r in rreqs:
+            r.wait(120.0)
+        return t_issue, t_span
+
+    one_iter(0.0)  # warmup: connections, pools, rings
+    proc.barrier()
+    issue = span = 0.0
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        i, s = one_iter(0.0)
+        issue += i
+        span += s
+    t_pure = (time.perf_counter() - t0) / iters
+    proc.barrier()
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        one_iter(t_pure)
+    t_total = (time.perf_counter() - t0) / iters
+    proc.barrier()
+    avail = max(0.0, min(1.0, 1.0 - issue / span)) if span > 0 else 0.0
+    osu = max(0.0, min(1.0, (2.0 * t_pure - t_total) / t_pure))
+    return t_pure, t_total, avail, osu
+
+
+def bench_overlap(max_size: int = 4 << 20, iters: int = 20,
+                  window: int = 8) -> list[dict]:
+    """Compute/communication overlap ladder (``--overlap``): the
+    osu-style ishift shape over a real-socket TcpProc pair, nonblocking
+    (deferred-contract isend) vs blocking at every size.  CI gates —
+    the loud-degradation discipline applied to the nonblocking engine:
+
+    - every nonblocking rung must actually enter the deferred engine
+      (``tcp_isend_deferred`` rises);
+    - above ``tcp_eager_limit`` the rendezvous isends must park the
+      caller's buffers, not a copy (``rndv_park_bytes_avoided`` rises
+      and ``tcp_rndv_park_copy_bytes`` stays flat — zero silent
+      fallback to the copy-at-park path)."""
+    from zhpe_ompi_tpu.mca import var as mca_var
+    from zhpe_ompi_tpu.runtime import spc
+
+    rows = []
+    limit = int(mca_var.get("tcp_eager_limit", 1 << 20))
+    for nbytes in _sizes(max_size, min_bytes=1 << 10):
+        payload = np.zeros(max(1, nbytes // 8), np.float64)
+        d0 = spc.read("tcp_isend_deferred")
+        a0 = spc.read("rndv_park_bytes_avoided")
+        c0 = spc.read("tcp_rndv_park_copy_bytes")
+        nb = _run_tcp_ranks(
+            2, lambda p, payload=payload: _overlap_body(
+                p, payload, iters, window, blocking=False), sm=False,
+        )
+        if spc.read("tcp_isend_deferred") == d0:
+            raise RuntimeError(
+                f"overlap ladder at {payload.nbytes}B: no isend entered "
+                "the deferred engine"
+            )
+        if payload.nbytes > limit:
+            if spc.read("rndv_park_bytes_avoided") == a0:
+                raise RuntimeError(
+                    f"overlap ladder at {payload.nbytes}B: rendezvous "
+                    "isends did not avoid the park copy"
+                )
+            if spc.read("tcp_rndv_park_copy_bytes") != c0:
+                raise RuntimeError(
+                    f"overlap ladder at {payload.nbytes}B: the isend "
+                    "path silently fell back to copy-at-park"
+                )
+        bl = _run_tcp_ranks(
+            2, lambda p, payload=payload: _overlap_body(
+                p, payload, iters, window, blocking=True), sm=False,
+        )
+        (tp_nb, _tt_nb, av_nb, osu_nb) = nb[0]
+        (tp_b, _tt_b, av_b, osu_b) = bl[0]
+        rows.append({
+            "op": "tcp_ishift_overlap", "bytes": payload.nbytes,
+            "latency_us": tp_nb * 1e6,
+            "bandwidth_MBps": (window * payload.nbytes / tp_nb) / 1e6,
+            "overlap": round(av_nb, 3),
+            "blocking_overlap": round(av_b, 3),
+            "osu_overlap": round(osu_nb, 3),
+            "blocking_osu_overlap": round(osu_b, 3),
+            "blocking_latency_us": tp_b * 1e6,
+        })
+    return rows
+
+
 def bench_sm(max_size: int = 4 << 20, iters: int = 50, bw: bool = False,
              window: int = 16, real_procs: bool = False) -> list[dict]:
     """Shared-memory-plane pt2pt: the same OSU shapes as
@@ -359,7 +494,9 @@ def bench_sm(max_size: int = 4 << 20, iters: int = 50, bw: bool = False,
 # gates
 _HAN_COUNTERS = (
     "han_flat_fallbacks", "coll_han_inter_bytes", "coll_han_intra_bytes",
-    "coll_han_leader_elections", "tcp_bytes_sent", "sm_bytes_sent",
+    "coll_han_leader_elections", "coll_han_pipelined",
+    "tcp_bytes_sent", "sm_bytes_sent",
+    "tcp_isend_deferred", "sm_ring_full_spins", "sm_frag_sends",
 )
 
 
@@ -378,7 +515,8 @@ def _han_worker_body(proc, spec: dict) -> tuple[list[dict], dict]:
     n, rank = proc.size, proc.rank
     iters = int(spec["iters"])
     trials = max(1, int(spec.get("trials", 3)))
-    label = "flat" if spec["han_mode"] == "off" else "han"
+    label = spec.get("label") or (
+        "flat" if spec["han_mode"] == "off" else "han")
     rows: list[dict] = []
     base = {c: spc.read(c) for c in _HAN_COUNTERS}
     for nbytes in _sizes(int(spec["max_size"]),
@@ -442,6 +580,8 @@ def _worker_main(spec: dict) -> int:
         from zhpe_ompi_tpu.mca import var as mca_var
 
         mca_var.set_var("coll_han_enable", spec["han_mode"])
+        mca_var.set_var("coll_han_pipeline",
+                        spec.get("pipeline", "auto"))
         try:
             rows, deltas = _han_worker_body(proc, spec)
         finally:
@@ -604,6 +744,7 @@ def _run_han_threads(spec: dict, nprocs: int, boots: dict) -> list:
 
     base = {c: spc.read(c) for c in _HAN_COUNTERS}
     mca_var.set_var("coll_han_enable", spec["han_mode"])
+    mca_var.set_var("coll_han_pipeline", spec.get("pipeline", "auto"))
     try:
         res = _run_tcp_ranks(
             nprocs, lambda p: _han_worker_body(p, spec),
@@ -611,6 +752,7 @@ def _run_han_threads(spec: dict, nprocs: int, boots: dict) -> list:
         )
     finally:
         mca_var.unset("coll_han_enable")
+        mca_var.unset("coll_han_pipeline")
     rows = next(rows for rows, _deltas in res if rows)
     return [{"rank": 0, "rows": rows,
              "counters": {c: spc.read(c) - base[c]
@@ -634,7 +776,11 @@ def bench_han(max_size: int = 4 << 20, iters: int = 5, nprocs: int = 4,
       must rise);
     - han's leader-phase payload bytes must stay STRICTLY below the
       flat run's on-wire TCP bytes at equal total payload — the
-      fewer-wire-hops claim, byte-accounted rather than timed."""
+      fewer-wire-hops claim, byte-accounted rather than timed;
+    - the pipeline row (``coll_han_pipeline=on``) must actually take
+      the pipelined schedule at >= 2-segment sizes
+      (``coll_han_pipelined`` rises) — segment k's intra bcast under
+      segment k+1's wire exchange, never a silent sequential run."""
     group = max(1, -(-nprocs // hosts))
     boots = {r: f"hanhost{r // group}" for r in range(nprocs)}
     # a max_size below the ladder floor must still yield one rung, not
@@ -643,8 +789,16 @@ def bench_han(max_size: int = 4 << 20, iters: int = 5, nprocs: int = 4,
                  "min_bytes": max(1, min(1 << 10, max_size))}
     out_rows: list[dict] = []
     agg: dict[str, dict] = {}
-    for mode in ("off", "on"):
-        spec = dict(spec_base, han_mode=mode)
+    # three ladders: flat, han with the sequential (PR 6) leader
+    # exchange, and han with the pipelined inter/intra overlap
+    configs = (
+        ("off", "off", "flat"),
+        ("on", "off", "han"),
+        ("on", "on", "han_pipe"),
+    )
+    for han_mode, pipeline, label in configs:
+        spec = dict(spec_base, han_mode=han_mode, pipeline=pipeline,
+                    label=label)
         if real_procs:
             reports = _run_proc_bench(
                 spec, nprocs,
@@ -654,26 +808,36 @@ def bench_han(max_size: int = 4 << 20, iters: int = 5, nprocs: int = 4,
         else:
             reports = _run_han_threads(spec, nprocs, boots)
         rows = next(r["rows"] for r in reports if r["rows"])
-        agg[mode] = {
+        agg[label] = {
             c: sum(r["counters"][c] for r in reports)
             for c in _HAN_COUNTERS
         }
         out_rows += rows
-    if agg["on"]["han_flat_fallbacks"]:
-        raise RuntimeError(
-            f"han plane: {agg['on']['han_flat_fallbacks']} collective(s) "
-            "silently fell back to flat on a qualified topology"
-        )
-    if agg["on"]["coll_han_inter_bytes"] == 0:
-        raise RuntimeError(
-            "han plane: no leader-phase bytes moved (hierarchy never "
-            "engaged?)"
-        )
-    if agg["on"]["coll_han_inter_bytes"] >= agg["off"]["tcp_bytes_sent"]:
+    for label in ("han", "han_pipe"):
+        if agg[label]["han_flat_fallbacks"]:
+            raise RuntimeError(
+                f"han plane ({label}): "
+                f"{agg[label]['han_flat_fallbacks']} collective(s) "
+                "silently fell back to flat on a qualified topology"
+            )
+        if agg[label]["coll_han_inter_bytes"] == 0:
+            raise RuntimeError(
+                f"han plane ({label}): no leader-phase bytes moved "
+                "(hierarchy never engaged?)"
+            )
+    if agg["han"]["coll_han_inter_bytes"] >= agg["flat"]["tcp_bytes_sent"]:
         raise RuntimeError(
             f"han plane: leader-phase bytes "
-            f"({agg['on']['coll_han_inter_bytes']}) not below the flat "
-            f"run's wire bytes ({agg['off']['tcp_bytes_sent']})"
+            f"({agg['han']['coll_han_inter_bytes']}) not below the flat "
+            f"run's wire bytes ({agg['flat']['tcp_bytes_sent']})"
+        )
+    from zhpe_ompi_tpu.mca import var as mca_var
+
+    seg = int(mca_var.get("coll_han_inter_segment", 1 << 20))
+    if max_size >= 2 * seg and agg["han_pipe"]["coll_han_pipelined"] == 0:
+        raise RuntimeError(
+            "han plane: the pipeline ladder crossed >= 2-segment sizes "
+            "but no allreduce took the pipelined schedule"
         )
     return out_rows
 
@@ -773,10 +937,16 @@ def _print_table(rows: list[dict]) -> None:
         return
     print(f"# {rows[0]['op']}"
           + (f" [{rows[0]['algorithm']}]" if "algorithm" in rows[0] else ""))
-    print(f"{'Size (B)':>12} {'Latency (us)':>16} {'BW (MB/s)':>14}")
+    overlap = "overlap" in rows[0]
+    print(f"{'Size (B)':>12} {'Latency (us)':>16} {'BW (MB/s)':>14}"
+          + (f" {'Overlap':>8} {'Blocking':>9}" if overlap else ""))
     for r in rows:
-        print(f"{r['bytes']:>12} {r['latency_us']:>16.2f} "
-              f"{r['bandwidth_MBps']:>14.1f}")
+        line = (f"{r['bytes']:>12} {r['latency_us']:>16.2f} "
+                f"{r['bandwidth_MBps']:>14.1f}")
+        if overlap:
+            line += (f" {r['overlap']:>8.2f}"
+                     f" {r['blocking_overlap']:>9.2f}")
+        print(line)
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -792,6 +962,11 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--bw", action="store_true",
                    help="pt2pt/tcp: multi-frame in-flight bandwidth "
                         "(osu_bw shape) instead of ping-pong latency")
+    p.add_argument("--overlap", action="store_true",
+                   help="compute/communication overlap ladder (osu-style "
+                        "ishift: compute under outstanding isends), "
+                        "nonblocking vs blocking, gated on the deferred-"
+                        "engine counters")
     p.add_argument("--window", type=int, default=16,
                    help="frames in flight per ack in --bw mode")
     p.add_argument("--plane", default="device",
@@ -817,7 +992,10 @@ def main(argv: list[str] | None = None) -> int:
 
     if args._worker is not None:
         return _worker_main(json.loads(args._worker))
-    if args.op == "pt2pt":
+    if args.overlap:
+        rows = bench_overlap(args.max_size, max(args.iters, 10),
+                             window=min(args.window, 16))
+    elif args.op == "pt2pt":
         rows = bench_pt2pt(args.max_size, max(args.iters, 10),
                            bw=args.bw, window=args.window)
     elif args.plane == "han":

@@ -69,6 +69,18 @@ def _ulfm_detector_hygiene():
         f"rendezvous push-pool threads leaked past their proc's "
         f"close(): {pushers}"
     )
+    incomplete = tcp_mod.live_incomplete_send_requests()
+    assert not incomplete, (
+        f"deferred SendRequests left incomplete past their proc's "
+        f"close()/sever() (waiters would wedge; the drain-or-abandon "
+        f"teardown contract): {incomplete}"
+    )
+    parked = tcp_mod.orphaned_rndv_descriptors()
+    assert not parked, (
+        f"parked rendezvous descriptors orphaned past their proc's "
+        f"close() (pinned caller buffers nobody will ever push): "
+        f"{parked}"
+    )
     from zhpe_ompi_tpu.pt2pt import sm as sm_mod
 
     orphans = sm_mod.orphaned_ring_files()

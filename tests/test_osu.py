@@ -189,18 +189,63 @@ def test_sm_bench_gate_trips_on_forced_fallback():
 
 
 def test_han_rows_thread_harness():
-    """Fast smoke for the --plane han ladder (thread harness): both the
-    flat and han legs emit sane rows and the built-in gates (no silent
-    flat fallback, leader bytes below flat wire bytes) hold."""
+    """Fast smoke for the --plane han ladder (thread harness): the
+    flat, han, and han-pipeline legs emit sane rows and the built-in
+    gates (no silent flat fallback, leader bytes below flat wire
+    bytes) hold."""
     rows = osu_zmpi.bench_han(max_size=1 << 11, iters=2,
                               real_procs=False)
     for prefix in ("flat_host_allreduce", "han_host_allreduce",
-                   "flat_host_bcast", "han_host_bcast"):
+                   "flat_host_bcast", "han_host_bcast",
+                   "han_pipe_host_allreduce", "han_pipe_host_bcast"):
         sub = [r for r in rows if r["op"] == prefix]
         assert sub, f"no rows for {prefix}"
         for r in sub:
             assert r["bytes"] > 0 and r["latency_us"] > 0
             assert np.isfinite(r["bandwidth_MBps"])
+
+
+def test_overlap_rows_and_counter_gates():
+    """Fast smoke for the --overlap ladder (nonblocking-engine
+    satellite): rows carry both overlap views, the deferred-engine
+    counter gates hold (bench_overlap raises on a silent fallback),
+    and the BLOCKING sender-availability ratio is ~0 by construction
+    while the isend one is positive.  The eager/rendezvous switch is
+    lowered so the rendezvous gates (descriptor parked, zero
+    copy-at-park bytes) run inside a CI-sized ladder."""
+    from zhpe_ompi_tpu.mca import var as mca_var
+
+    mca_var.set_var("tcp_eager_limit", 16 << 10)
+    try:
+        rows = osu_zmpi.bench_overlap(max_size=1 << 16, iters=4,
+                                      window=4)
+    finally:
+        mca_var.unset("tcp_eager_limit")
+    assert rows
+    for r in rows:
+        assert r["op"] == "tcp_ishift_overlap"
+        assert 0.0 <= r["overlap"] <= 1.0
+        assert r["blocking_overlap"] <= 0.05
+        assert np.isfinite(r["bandwidth_MBps"])
+    # the rungs above the (lowered) eager limit rode the deferred
+    # rendezvous: bench_overlap's internal gates asserted the park-copy
+    # counter stayed flat — reaching here IS the pass
+    assert any(r["bytes"] > (16 << 10) for r in rows)
+
+
+@pytest.mark.slow
+def test_overlap_ladder_real_sizes():
+    """CI gate at real sizes (nonblocking-engine satellite): at and
+    above 256 KiB the deferred isend path must keep the sender
+    available (> 0.5 of the send span free for compute) where the
+    blocking path measures ~0, with the rendezvous rungs parking
+    descriptors only (the counter gates inside bench_overlap)."""
+    rows = osu_zmpi.bench_overlap(max_size=4 << 20, iters=10, window=8)
+    big = [r for r in rows if r["bytes"] >= 256 << 10]
+    assert big
+    for r in big:
+        assert r["overlap"] > 0.5, r
+        assert r["blocking_overlap"] <= 0.05, r
 
 
 @pytest.mark.slow

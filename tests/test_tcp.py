@@ -620,3 +620,163 @@ class TestRendezvous:
         # possibly before rank 1 matched — it must have been >= 1 at RTS
         # time; by match time the transfer completes
         assert res[1][1] == 7 and res[1][2] == 1 << 18
+
+
+class TestIsendDeferredContract:
+    """Tentpole: true nonblocking isend — the buffer-reuse contract is
+    DEFERRED to request completion.  wait() gates reuse: a buffer
+    mutated AFTER wait() returns must deliver its PRE-mutation bytes,
+    byte-exact, across every transport (eager wire / rendezvous wire /
+    sm ring / loopback) and both planes (thread ranks and real
+    sockets)."""
+
+    @staticmethod
+    def _sender(p, arr, want, tag, delay_recv=0.0):
+        """rank 0: isend, wait, MUTATE, handshake; rank 1: (optionally
+        delayed) recv + byte-exact check against the pre-mutation
+        value."""
+        import time
+
+        if p.rank == 0:
+            req = p.isend(arr, dest=1, tag=tag)
+            req.wait(30.0)
+            arr[:] = -1.0  # reuse AFTER completion
+            p.send(b"mutated", dest=1, tag=tag + 1)
+            return True
+        if delay_recv:
+            time.sleep(delay_recv)  # rendezvous: park while unmatched
+        got = p.recv(source=0, tag=tag, timeout=30.0)
+        p.recv(source=0, tag=tag + 1, timeout=30.0)
+        return bool(np.array_equal(np.asarray(got), want))
+
+    @pytest.mark.parametrize("nbytes,delay", [
+        (8 << 10, 0.0),          # eager
+        ((1 << 20) + 64, 0.2),   # rendezvous, parked while unmatched
+    ])
+    def test_socket_wire_matrix(self, nbytes, delay):
+        from zhpe_ompi_tpu.runtime import spc
+
+        arr = np.arange(nbytes // 8, dtype=np.float64)
+        want = arr.copy()
+        d0 = spc.read("tcp_isend_deferred")
+        a0 = spc.read("rndv_park_bytes_avoided")
+        c0 = spc.read("tcp_rndv_park_copy_bytes")
+
+        res = run_tcp(2, lambda p: self._sender(p, arr, want, 50,
+                                                delay_recv=delay),
+                      sm=False)
+        assert res == [True, True]
+        assert spc.read("tcp_isend_deferred") > d0
+        if arr.nbytes > (1 << 20):
+            # the isend rendezvous parked the DESCRIPTOR, not a copy
+            assert spc.read("rndv_park_bytes_avoided") - a0 >= arr.nbytes
+            assert spc.read("tcp_rndv_park_copy_bytes") == c0
+
+    @pytest.mark.parametrize("nbytes", [4 << 10, 1 << 20])
+    def test_sm_ring_matrix(self, nbytes):
+        """Same contract over the shared-memory rings (single-slot
+        fast path and the fragment pipeline both)."""
+        arr = np.arange(nbytes // 8, dtype=np.float64)
+        want = arr.copy()
+        res = run_tcp(2, lambda p: self._sender(p, arr, want, 52))
+        assert res == [True, True]
+
+    def test_loopback(self):
+        def prog(p):
+            arr = np.arange(512, dtype=np.float64)
+            want = arr.copy()
+            req = p.isend(arr, dest=0, tag=54)
+            req.wait(10.0)
+            arr[:] = -1.0
+            got = p.recv(source=0, tag=54, timeout=10.0)
+            return bool(np.array_equal(got, want))
+
+        assert run_tcp(1, prog) == [True]
+
+    @pytest.mark.parametrize("nbytes", [4 << 10, 256 << 10])
+    def test_thread_plane_matrix(self, nbytes):
+        """Thread ranks (LocalUniverse): eager copies at isend, the
+        rendezvous handoff copies at CTS — wait() gates reuse on both."""
+        from zhpe_ompi_tpu.pt2pt.universe import LocalUniverse
+
+        uni = LocalUniverse(2)
+        arr = np.arange(nbytes // 8, dtype=np.float64)
+        want = arr.copy()
+
+        def prog(ctx):
+            if ctx.rank == 0:
+                req = ctx.isend(arr, dest=1, tag=56)
+                req.wait(30.0)
+                arr[:] = -1.0
+                ctx.send(b"mutated", dest=1, tag=57)
+                return True
+            got = ctx.recv(source=0, tag=56)
+            ctx.recv(source=0, tag=57)
+            return bool(np.array_equal(np.asarray(got), want))
+
+        assert uni.run(prog) == [True, True]
+
+    def test_isend_send_fifo_interleave(self):
+        """Per-source FIFO holds ACROSS the send paths: deferred isends
+        and direct blocking sends to one peer arrive in program order
+        (the blocking send fences on the channel)."""
+        def prog(p):
+            if p.rank == 0:
+                reqs = []
+                for i in range(12):
+                    if i % 3 == 2:
+                        p.send(i, dest=1, tag=60)
+                    else:
+                        reqs.append(p.isend(i, dest=1, tag=60))
+                for r in reqs:
+                    r.wait(20.0)
+                return True
+            return [p.recv(source=0, tag=60, timeout=20.0)
+                    for _ in range(12)]
+
+        res = run_tcp(2, prog, sm=False)
+        assert res[1] == list(range(12))
+
+    def test_wait_gates_reuse_on_parked_rendezvous(self):
+        """A rendezvous isend stays INCOMPLETE while the receiver has
+        not matched (the descriptor parks, nothing pushed), and wait()
+        returns only once the pinned buffers crossed — the deferred
+        contract, observable."""
+        def prog(p):
+            big = np.full((1 << 17) + 8, 7.0)  # just over the 1MB limit
+            if p.rank == 0:
+                req = p.isend(big, dest=1, tag=62)
+                p.recv(source=1, tag=63, timeout=20.0)  # "not matched yet"
+                parked = len(p._pending_rndv)
+                done_early = req.done
+                p.send(b"go", dest=1, tag=64)
+                req.wait(30.0)
+                return (parked, done_early)
+            import time
+
+            p.send(b"unmatched", dest=0, tag=63)
+            p.recv(source=0, tag=64, timeout=20.0)
+            time.sleep(0.05)
+            got = p.recv(source=0, tag=62, timeout=30.0)
+            return float(got[0])
+
+        res = run_tcp(2, prog, sm=False)
+        assert res[0] == (1, False)  # parked + incomplete while unmatched
+        assert res[1] == 7.0
+
+    def test_errored_request_on_revoked_cid(self):
+        """Satellite: isend to a revoked cid returns an ERRORED request
+        (typed at wait), never a synchronous raise — the waitall
+        contract."""
+        from zhpe_ompi_tpu.core import errhandler as errh
+
+        def prog(p):
+            p.set_errhandler(errh.ERRORS_RETURN)
+            p.ft_state.revoke(77)
+            req = p.isend(b"x", dest=1 - p.rank, tag=1, cid=77)
+            assert req.done and req.error is not None
+            with pytest.raises(errors.Revoked):
+                req.wait()
+            return True
+
+        assert run_tcp_ft_pair(prog) == [True, True]

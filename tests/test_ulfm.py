@@ -1576,3 +1576,86 @@ class TestInjectionStress:
             expect = float(N - 1)
             assert all(r == expect for i, r in enumerate(res)
                        if i != victim), (seed, res)
+
+
+class TestKillWithInflightIsend:
+    """Satellite of the nonblocking engine: a rank dying with deferred
+    isends in flight toward it completes them ERRORED (typed
+    ProcFailed) — waitall observes the failure at completion, no
+    request wedges, the parked rendezvous descriptor is released, and
+    the push pool drains at close()."""
+
+    def test_typed_completion_no_wedge(self, fresh_vars):
+        mca_var.set_var("ft_detector_period", 0.05)
+        mca_var.set_var("ft_detector_timeout", 0.8)
+
+        def prog(p):
+            from zhpe_ompi_tpu.pt2pt import tcp as tcp_mod
+
+            p.set_errhandler(errh.ERRORS_RETURN)
+            if p.rank == 1:
+                p.recv(source=0, tag=1, timeout=10.0)  # conn warmed
+                ulfm.expect_failure(p.ft_state, 1)
+                p.sever()
+                return "severed"
+            p.send(0, dest=1, tag=1)
+            # a rendezvous-size isend parks its descriptor (no CTS will
+            # ever come) plus an eager burst racing the sever
+            big = np.zeros((1 << 17) + 16, np.float64)  # > 1 MB limit
+            reqs = [p.isend(big, dest=1, tag=2)]
+            reqs += [p.isend(b"x" * 2048, dest=1, tag=3)
+                     for _ in range(4)]
+            outcomes = []
+            for r in reqs:
+                try:
+                    r.wait(20.0)  # no RequestError timeout = no wedge
+                    outcomes.append("ok")
+                except errors.ProcFailed:
+                    outcomes.append("failed")
+            # the parked descriptor must be released by the failure
+            # listener, not wait out close()'s quiesce
+            deadline = time.monotonic() + 10.0
+            while p._pending_rndv and time.monotonic() < deadline:
+                time.sleep(0.01)
+            return (outcomes, len(p._pending_rndv),
+                    tcp_mod.orphaned_rndv_descriptors())
+
+        res = run_tcp_ft(2, prog, sm=False)
+        outcomes, parked_after, orphans = res[0]
+        # the rendezvous isend MUST observe typed failure (its data can
+        # never have crossed); eager frames may have beaten the sever
+        assert outcomes[0] == "failed"
+        assert all(o in ("ok", "failed") for o in outcomes)
+        assert parked_after == 0
+        assert orphans == []
+
+    def test_isend_to_known_failed_rank_errored_request(self, fresh_vars):
+        """isend AFTER the failure classified: an errored Request
+        carrying typed ProcFailed (never a synchronous raise), observed
+        by a waitall loop exactly like a live-then-died peer."""
+        mca_var.set_var("ft_detector_period", 0.05)
+        mca_var.set_var("ft_detector_timeout", 0.8)
+
+        def prog(p):
+            from zhpe_ompi_tpu.pt2pt.requests import wait_all
+
+            p.set_errhandler(errh.ERRORS_RETURN)
+            if p.rank == 1:
+                p.recv(source=0, tag=1, timeout=10.0)
+                ulfm.expect_failure(p.ft_state, 1)
+                p.sever()
+                return "severed"
+            p.send(0, dest=1, tag=1)
+            deadline = time.monotonic() + 10.0
+            while not p.ft_state.is_failed(1) \
+                    and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert p.ft_state.is_failed(1)
+            req = p.isend(b"late", dest=1, tag=4)
+            assert req.done and isinstance(req.error, errors.ProcFailed)
+            with pytest.raises(errors.ProcFailed):
+                wait_all([req])
+            return True
+
+        res = run_tcp_ft(2, prog, sm=False)
+        assert res[0] is True

@@ -76,6 +76,17 @@ mca_var.register(
     "defensive copy + round trips).  Default matches tcp_eager_limit",
     type=int,
 )
+mca_var.register(
+    "coll_han_pipeline", "auto",
+    "Pipelined inter/intra overlap of the segmented leader exchange "
+    "(the reference han's 'w' variants): segment k's intra bcast is "
+    "ISSUED nonblocking — the deferred-contract isend engine drains it "
+    "onto the rings — while the leaders already run segment k+1's wire "
+    "exchange.  auto/on = pipeline whenever the large-message "
+    "segmented exchange yields >= 2 segments; off = the sequential "
+    "schedule (every segment's exchange and bcast strictly ordered)",
+    enum=("auto", "on", "off"),
+)
 
 #: collectives with a two-level schedule — canonical home is the
 #: dispatch seam (coll/host.py), re-exported here for the decision API
@@ -234,16 +245,98 @@ def _require_commutative(op, opname: str) -> None:
 # ------------------------------------------------------------ allreduce
 
 
+def _pipeline_geometry(n_groups: int, value: Any
+                       ) -> tuple[int, int] | None:
+    """Segment geometry ``(seg_elems, nseg)`` of the pipelined leader
+    exchange, derived from the ALLREDUCE INPUT — congruent on every
+    rank by the MPI contract, so leaders and members reach the
+    identical schedule with no negotiation (members never see the
+    reduced array the sequential path sizes its segments from).  None
+    when the segmented large-message path would not engage, or when it
+    yields a single segment (nothing to overlap)."""
+    large = int(mca_var.get("host_coll_large_msg", 256 * 1024))
+    if (
+        not isinstance(value, np.ndarray)
+        or value.nbytes < large
+        or value.size < n_groups
+    ):
+        return None
+    seg_bytes = max(1, int(mca_var.get("coll_han_inter_segment",
+                                       1 << 20)))
+    seg = max(n_groups, seg_bytes // max(value.dtype.itemsize, 1))
+    if value.size <= seg:
+        return None
+    return seg, -(-value.size // seg)
+
+
+def _allreduce_pipelined(intra, inter, value: Any, op,
+                         geom: tuple[int, int]) -> Any:
+    """The reference han's "w" overlap: the segmented leader exchange
+    isends segment k's intra bcast (nonblocking — the deferred-contract
+    engine drains it onto the rings) while segment k+1's wire exchange
+    already runs, so the intra plane and the wire stay busy at once
+    instead of strictly alternating.  Members consume the segments
+    SEQUENTIALLY with the blocking binomial phase — one intra-window
+    tag bump per segment as each bcast runs, matching the leader's
+    one-ibcast-per-segment issue order — so a member is forwarding
+    segment k while its leader already exchanges k+1."""
+    from ..pt2pt.requests import wait_all
+    from . import nbc
+
+    seg, nseg = geom
+    spc.record("coll_han_pipelined", 1)
+    partial = host.reduce(intra, value, op, root=0) \
+        if intra.size > 1 else value
+    pieces: list = [None] * nseg
+    if inter is not None:
+        flat = np.ascontiguousarray(partial).reshape(-1)
+        breqs = []
+        for k in range(nseg):
+            piece = flat[k * seg:(k + 1) * seg]
+            if inter.size > 2:
+                tag = host._next_tag(inter, host.TAG_ALLREDUCE)
+                done = host._allreduce_ring(inter, piece, op, tag)
+            else:
+                done = host.allreduce(inter, piece, op)
+            pieces[k] = np.asarray(done).reshape(-1)
+            if intra.size > 1:
+                # the isends under this ibcast pin `pieces[k]` until
+                # drained — freshly produced per segment, never mutated
+                breqs.append(nbc.ibcast(intra, pieces[k], root=0))
+        wait_all(breqs)
+    else:
+        # member: consume the per-segment bcasts with the BLOCKING
+        # binomial phase — event-blocked receives; a polling
+        # SchedRequest wait per segment measurably steals scheduler
+        # quanta from the producing leader on small hosts.  Wire-
+        # compatible with the leader's nonblocking issue: nbc.ibcast
+        # and the flat binomial bcast run the identical tree and tag
+        # sequence, so each side picks the form that fits its role.
+        for k in range(nseg):
+            pieces[k] = np.asarray(host.bcast(
+                intra, None, root=0, algorithm="binomial")).reshape(-1)
+    # nseg >= 2 by construction: _pipeline_geometry returns None for a
+    # single-segment payload (nothing to overlap)
+    return np.concatenate(pieces).reshape(np.asarray(value).shape)
+
+
 def allreduce(ctx, value: Any, op,
               groups: list[list[int]] | None = None) -> Any:
     """Two-level allreduce: intra reduce → leader allreduce → intra
     bcast.  Above ``host_coll_large_msg`` the leader exchange runs the
     split (reduce-scatter + allgather) ring explicitly — the
     bandwidth-optimal inter-node schedule, applied to exactly the hops
-    that cross the wire."""
+    that cross the wire — and, with ``coll_han_pipeline`` auto/on and
+    >= 2 segments, OVERLAPS each segment's intra bcast with the next
+    segment's wire exchange (the "w" pipelining)."""
     _require_commutative(op, "allreduce")
     topo = topology(ctx, groups)
     intra, inter = _views(ctx, topo)
+    if str(mca_var.get("coll_han_pipeline", "auto")) != "off" \
+            and len(topo.groups) >= 2:
+        geom = _pipeline_geometry(len(topo.groups), value)
+        if geom is not None:
+            return _allreduce_pipelined(intra, inter, value, op, geom)
     partial = host.reduce(intra, value, op, root=0) \
         if intra.size > 1 else value
     full = None

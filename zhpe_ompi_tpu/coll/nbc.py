@@ -87,19 +87,45 @@ class SchedRequest(Request):
 
     def _advance(self) -> None:
         """NBC_PROGRESS: if the current round is fully complete, feed the
-        results back and post the next round(s)."""
+        results back and post the next round(s).  Driving sub-request
+        progress matters on the deferred engine: a parked isend whose
+        peer died (or whose cid was revoked) classifies from ITS
+        progress tick, and the typed error a sub-request completed with
+        aborts the schedule at the round boundary — waitall observes
+        the failure at completion, never a wedge."""
         if self.done:
             return
         self._check_revoked()
         if self._endpoint_progress is not None:
             self._endpoint_progress()
+        for r in self._round:
+            if not r.done and r._progress is not None:
+                r._progress()
         while not self.done and all(r.done for r in self._round):
             self._check_revoked()  # round boundary
+            err = next((r.error for r in self._round
+                        if r.error is not None), None)
+            if err is not None:
+                # a sub-request completed ERRORED (typed peer death /
+                # revocation from the deferred engine): the schedule
+                # cannot make progress — abort typed, like the revoke
+                # path, and surface the error at this request's wait
+                self._gen.close()
+                self.complete_error(err)
+                return
             values = [r._value for r in self._round]
             try:
                 self._round = list(self._gen.send(values))
             except StopIteration as stop:
                 self.complete(stop.value)
+            except BaseException as e:
+                # the schedule body itself failed (e.g. a sub-send
+                # raising at issue time): that error is the request's
+                # PERMANENT outcome — without recording it, a later
+                # test()/wait() would resume the dead generator into a
+                # StopIteration(None) and report silent success
+                self.complete_error(e)
+                raise
 
 
 def _start(ctx, gen) -> SchedRequest:

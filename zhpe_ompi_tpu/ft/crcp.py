@@ -46,9 +46,12 @@ class BookmarkedContext:
     def sendrecv(self, obj: Any, dest: int, source: int = ANY_SOURCE,
                  sendtag: int = 0, recvtag: int = ANY_TAG, cid: int = 0):
         rreq = self._ctx.irecv(source, recvtag, cid)
-        self._ctx.isend(obj, dest, sendtag, cid)
+        sreq = self._ctx.isend(obj, dest, sendtag, cid)
         self._coord._count_send(self.rank, dest)
         value = rreq.wait()
+        # deferred wire engine: the send completes (and the caller's
+        # buffer is reusable) only at request completion, not at isend
+        sreq.wait()
         self._coord._count_recv(rreq.status.source, self.rank)
         return value
 

@@ -689,7 +689,10 @@ def _await_frame(ep, state: FailureState, seq: int, source: int,
     exit leaves at most this one post behind, and the instance-unique
     tags keep it from ever stealing another agreement's frames."""
     deadline = time.monotonic() + timeout
-    req = ep.irecv(source=source, tag=tag, cid=FT_AGREE_CID)
+    # poll=True: the protocol owns failure handling (re-election /
+    # exclusion below) — classification must raise typed out of test(),
+    # never route through the user's errhandler disposition
+    req = ep.irecv(source=source, tag=tag, cid=FT_AGREE_CID, poll=True)
     while True:
         flag, value = req.test()  # drives progress on thread ranks
         if flag:
@@ -925,18 +928,26 @@ class ShrunkEndpoint(HostCollectives):
             return value, status
         return out
 
-    def irecv(self, source: int = -1, tag: int = -1, cid: int = 0):
+    def irecv(self, source: int = -1, tag: int = -1, cid: int = 0,
+              poll: bool = False):
         return self._ep.irecv(self._xlate_src(source), tag,
-                              _shrink_cid(self._gen, cid))
+                              _shrink_cid(self._gen, cid), poll=poll)
 
     def sendrecv(self, obj: Any, dest: int, source: int = -1,
                  sendtag: int = 0, recvtag: int = -1, cid: int = 0):
         # isend-then-classified-recv, NOT irecv+wait: a bare Request
         # wait has no failure classification, so a partner dying
         # post-shrink would hang the exchange instead of raising typed
-        # ProcFailed (collectives built over sendrecv inherit this)
-        self.isend(obj, dest, sendtag, cid)
-        return self.recv(source, recvtag, cid)
+        # ProcFailed (collectives built over sendrecv inherit this).
+        # The send request is WAITED after the recv: on the deferred
+        # wire engine the frame may still be queued when recv returns,
+        # and sendrecv's buffer-reuse contract holds only at send
+        # completion (a recv that raises typed leaves the request to
+        # the failure machinery — reuse is moot on that path).
+        sreq = self.isend(obj, dest, sendtag, cid)
+        out = self.recv(source, recvtag, cid)
+        sreq.wait()
+        return out
 
     def barrier(self) -> None:
         n, k = self.size, 1

@@ -82,8 +82,9 @@ class LoggedContext:
         with self._lock:
             self._log.sends.append((dest, sendtag, _eager_copy(obj)))
         rreq = self._ctx.irecv(source, recvtag, cid)
-        self._ctx.isend(obj, dest, sendtag, cid)
+        sreq = self._ctx.isend(obj, dest, sendtag, cid)
         value = rreq.wait()
+        sreq.wait()  # deferred engine: reuse gates on send completion
         with self._lock:
             self._log.recvs.append(
                 (rreq.status.source, rreq.status.tag, _eager_copy(value))

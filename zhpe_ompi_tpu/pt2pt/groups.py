@@ -245,6 +245,25 @@ class GroupView:
     def _coll_seq(self, value: int) -> None:
         self._seqs[self._window] = value
 
+    # -- nonblocking-schedule support (coll/nbc over a view) -------------
+
+    @property
+    def ft_state(self):
+        """Nearest FailureState up the parent chain (None on non-ft
+        endpoints): an nbc schedule running on a view stays
+        revoke-aware — its window cid is aliased to COLL_CID, so the
+        schedule's revocation checks resolve through the same alias
+        machinery as the blocking phases'."""
+        return _ft_state(self._ep)
+
+    def progress(self) -> None:
+        """Drive the parent's progress engine (thread-plane mailbox
+        delivery); socket endpoints progress from their drain threads
+        and this is a no-op."""
+        fn = getattr(self._ep, "progress", None)
+        if fn is not None:
+            fn()
+
     # -- translation helpers ---------------------------------------------
 
     def rel(self, parent_rank: int) -> int:

@@ -57,25 +57,98 @@ def _payload_bytes(value) -> int:
 
 
 class Request:
-    __slots__ = ("_done", "_value", "status", "_lock", "_progress", "_cancel_fn")
+    __slots__ = ("_done", "_value", "status", "_lock", "_progress",
+                 "_cancel_fn", "_error", "_dispatch", "__weakref__")
 
     def __init__(self, progress: Callable[[], None] | None = None,
-                 cancel_fn: Callable[["Request"], bool] | None = None):
+                 cancel_fn: Callable[["Request"], bool] | None = None,
+                 dispatch: Callable | None = None):
         self._done = threading.Event()
         self._value: Any = None
         self.status = Status()
+        self._lock = threading.Lock()
         self._progress = progress
         self._cancel_fn = cancel_fn
+        self._error: Any = None
+        self._dispatch = dispatch
 
     # -- completion (called by transports) -------------------------------
 
     def complete(self, value: Any = None, source: int = -1, tag: int = -1
-                 ) -> None:
-        self._value = value
-        self.status.source = source
-        self.status.tag = tag
-        self.status.count_bytes = _payload_bytes(value)
-        self._done.set()
+                 ) -> bool:
+        """Complete successfully; returns False when the request already
+        completed.  First completion wins: a transport callback racing a
+        failure classifier (peer death poisoning a parked send) must not
+        flip an already-observed outcome."""
+        with self._lock:
+            if self._done.is_set():
+                return False
+            self._value = value
+            self.status.source = source
+            self.status.tag = tag
+            self.status.count_bytes = _payload_bytes(value)
+            self._done.set()
+            return True
+
+    def complete_error(self, exc) -> bool:
+        """Complete ERRORED with a typed exception: ``wait``/``test``
+        then raise it (or route it through the endpoint's errhandler
+        disposition when the request was built with ``dispatch``) —
+        the MPI contract that a failed nonblocking operation surfaces
+        its error at completion, not at the next blocking call.  First
+        completion wins, like :meth:`complete`."""
+        with self._lock:
+            if self._done.is_set():
+                return False
+            self._error = exc
+            self.status.error = 1
+            self._done.set()
+            return True
+
+    @property
+    def error(self):
+        """The typed failure this request completed with (None while
+        incomplete or on success) — the raw, un-dispatched view
+        framework loops (nbc round schedules) read at round boundaries."""
+        return self._error
+
+    def _resolve(self):
+        """Completed-request outcome: raise/dispatch the error, or
+        return the value.  The errhandler dispatch runs EXACTLY ONCE —
+        a recovering user handler's side effects must not repeat on
+        every wait()/test() poll of the same request; its return value
+        (or the exception it raised) is cached as the request's
+        permanent outcome."""
+        if self._error is None:
+            return self._value
+        if self._dispatch is None:
+            raise self._error  # poll path: raw typed raise, idempotent
+        with self._lock:
+            dispatch, self._dispatch = self._dispatch, None
+        if dispatch is None:
+            # already dispatched (a concurrent waiter won the swap):
+            # re-read the OUTCOME under the lock — the winner may have
+            # cached a recovery value (error cleared) or the dispatched
+            # exception; a racing read between its swap and its cache
+            # write sees the original typed error, which is still a
+            # sane raise (never `raise None`)
+            with self._lock:
+                if self._error is None:
+                    return self._value
+                raise self._error
+        try:
+            # FATAL aborts, RETURN raises typed, a user handler's
+            # return value becomes the result (the same disposition
+            # contract blocking send/recv apply at the call site)
+            value = dispatch(self._error)
+        except BaseException as e:
+            with self._lock:
+                self._error = e
+            raise
+        with self._lock:
+            self._value = value
+            self._error = None
+        return value
 
     # -- user side --------------------------------------------------------
 
@@ -84,18 +157,27 @@ class Request:
         return self._done.is_set()
 
     def test(self):
-        """MPI_Test: (flag, value-or-None); non-blocking, drives progress."""
+        """MPI_Test: (flag, value-or-None); non-blocking, drives progress.
+        A request that completed ERRORED raises (or dispatches) its typed
+        error here, like :meth:`wait`."""
         if not self._done.is_set() and self._progress is not None:
             self._progress()
         if self._done.is_set():
-            return True, self._value
+            return True, self._resolve()
         return False, None
 
     def wait(self, timeout: float | None = None):
-        """MPI_Wait: drive progress until complete; returns the payload."""
+        """MPI_Wait: drive progress until complete; returns the payload.
+        A request that completed ERRORED raises (or dispatches) its
+        typed error — deferred operations surface failure at completion."""
         import time
 
         deadline = None if timeout is None else time.monotonic() + timeout
+        # weak progress needs a short tick; a completion-driven request
+        # (transport callback sets the event) parks in long slices —
+        # sub-ms polling wakeups measurably steal scheduler quanta from
+        # the very threads doing the completing on oversubscribed hosts
+        step = 0.0005 if self._progress is not None else 0.05
         while not self._done.is_set():
             if self._progress is not None:
                 self._progress()
@@ -103,8 +185,8 @@ class Request:
                 break
             if deadline is not None and time.monotonic() > deadline:
                 raise errors.RequestError("wait timed out")
-            self._done.wait(0.0005)
-        return self._value
+            self._done.wait(step)
+        return self._resolve()
 
     def cancel(self) -> bool:
         """MPI_Cancel: succeeds only if the request hasn't matched yet."""
@@ -115,6 +197,52 @@ class Request:
             self._done.set()
             return True
         return False
+
+
+class SendRequest(Request):
+    """A deferred-contract nonblocking send (true ``MPI_Isend``
+    semantics): ``isend`` PINS the caller's buffers — ``pinned`` holds
+    the ``dss.pack_frames`` memoryview segments referencing them, zero
+    copies — and hands them to the transport's progress engine; the
+    request completes only once the kernel (or the peer's ring) has the
+    bytes.  The buffer-reuse contract is therefore deferred to
+    completion: mutating the buffer before ``wait()`` returns is
+    undefined, mutating it after is guaranteed invisible to the
+    receiver.  An in-flight send whose peer dies (or whose cid is
+    revoked) completes ERRORED with the same typed exception the
+    blocking path raises."""
+
+    __slots__ = ("_pinned", "_owned")
+
+    def __init__(self, pinned=None, progress: Callable | None = None,
+                 dispatch: Callable | None = None):
+        super().__init__(progress=progress, dispatch=dispatch)
+        self._pinned = pinned
+        # transport ownership flag: True while a worker is actively
+        # sending this frame — failure classifiers must then leave the
+        # outcome to the transport (a peer's orderly goodbye racing the
+        # gap between a delivered sendmsg and complete() must not error
+        # an already-delivered send); reverts to False for an RTS whose
+        # rendezvous data is still parked awaiting the CTS
+        self._owned = False
+
+    @classmethod
+    def completed(cls) -> "SendRequest":
+        """A born-complete send (loopback / ring copy-in already done)."""
+        req = cls()
+        req.complete()
+        return req
+
+    @classmethod
+    def errored(cls, exc, dispatch: Callable | None = None
+                ) -> "SendRequest":
+        """A send that cannot be posted (revoked cid, known-failed
+        destination): an errored Request instead of a synchronous raise,
+        so nbc/han waitall loops observe the typed error at completion
+        like the MPI contract says."""
+        req = cls(dispatch=dispatch)
+        req.complete_error(exc)
+        return req
 
 
 class GeneralizedRequest(Request):

@@ -351,6 +351,15 @@ def _ring_span(nslots: int, slot_bytes: int) -> int:
     return _RING_HDR + nslots * (_SLOT_HDR + slot_bytes)
 
 
+class RingFull(errors.InternalError):
+    """The destination ring had no free slot within the caller's
+    deadline.  A distinct type so the nonblocking (deferred-contract
+    isend) path can probe with an already-expired deadline and park a
+    producer continuation on the progress engine instead of blocking
+    the caller; the blocking path still reads it as the stall it is
+    (subclass of the InternalError it always raised)."""
+
+
 class ConsumerStopped(errors.InternalError):
     """The destination ring's owner stopped consuming (sever/crash, or
     the tail of an orderly close): the peer is GONE.  A distinct type
@@ -661,7 +670,7 @@ class SmSender:
                 abort()
             if time.monotonic() > deadline:
                 spc.record("sm_ring_full_spins", spins)
-                raise errors.InternalError(
+                raise RingFull(
                     f"sm ring to rank {self.dest} full past the stall "
                     "timeout (peer wedged?)"
                 )
@@ -728,13 +737,16 @@ class SmSender:
             self._publish(slot, total, total)
             return total + _SLOT_HDR
 
-    def send_frame(self, header, segments, deadline: float,
-                   abort) -> tuple[int, int]:
-        """Stream one frame (header + out-of-band segments) as a
-        fragment pipeline: each fragment is copied from the caller's
-        buffers straight into slot memory and published immediately, so
-        the consumer overlaps assembly with the remaining copies.
-        Returns ``(on_ring_bytes, nfrags)``."""
+    def _frame_views(self, header, segments
+                     ) -> tuple[list[memoryview], int, int]:
+        """Shared prelude of the frame senders: flatten header +
+        segments to non-empty byte views, validate the u32 framing
+        bound, and compute the adaptive fragment size — ~8 fragments so
+        the consumer's copy-out overlaps the remaining copy-ins (the
+        pipeline is the whole point — measured 3x on 64 KiB messages vs
+        one serial copy-in/copy-out), but never below 16 KiB:
+        per-fragment interpreter overhead dominates tiny slots and
+        would erase the multi-MiB win.  Returns (views, total, pipe)."""
         views = [memoryview(header)]
         for seg in segments:
             v = seg if isinstance(seg, memoryview) else memoryview(seg)
@@ -747,44 +759,84 @@ class SmSender:
             raise errors.ArgError(
                 f"sm frame of {total} bytes exceeds the u32 framing"
             )
-        mm = self._mm
-        slot_bytes = self.slot_bytes
+        pipe = min(self.slot_bytes, max(16 << 10, total // 8))
+        return views, total, pipe
+
+    def try_send_frame(self, header, segments) -> tuple[int, int] | None:
+        """Nonblocking :meth:`send_frame`: runs the fragment pipeline
+        ONLY when the ring's free slots already cover the whole frame —
+        the copy-in then completes without ever waiting on the consumer
+        (free slots only grow under the producer lock: the consumer
+        can only advance ``tail``).  Returns None when the frame does
+        not currently fit; the caller parks a producer continuation
+        instead of blocking (the deferred-contract isend path)."""
+        views, total, pipe = self._frame_views(header, segments)
         with self._lock:
             if self._dead:
                 raise errors.InternalError(
                     f"sm ring to rank {self.dest} is torn down"
                 )
-            vi, voff = 0, 0
-            remaining = total
-            nfrags = 0
-            # adaptive fragment size: aim for ~8 fragments so the
-            # consumer's copy-out overlaps the remaining copy-ins (the
-            # pipeline is the whole point — measured 3x on 64 KiB
-            # messages vs one serial copy-in/copy-out), but never below
-            # 16 KiB: per-fragment interpreter overhead dominates tiny
-            # slots and would erase the multi-MiB win
-            pipe = min(slot_bytes, max(16 << 10, total // 8))
-            while True:
-                self._wait_slot(deadline, abort)
-                slot = self._slot_at(self._head)
-                frag = min(pipe, remaining)
-                off = slot + _SLOT_HDR
-                left = frag
-                while left:
-                    v = views[vi]
-                    take = min(left, v.nbytes - voff)
-                    mm[off:off + take] = v[voff:voff + take]
-                    off += take
-                    voff += take
-                    left -= take
-                    if voff == v.nbytes:
-                        vi += 1
-                        voff = 0
-                self._publish(slot, frag, total)
-                nfrags += 1
-                remaining -= frag
-                if remaining == 0:
-                    break
+            if _U32.unpack_from(self._mm, _OFF_STOPPED)[0]:
+                raise ConsumerStopped(
+                    f"sm ring to rank {self.dest}: consumer stopped"
+                )
+            nfrags = max(1, -(-total // pipe))
+            tail = _U64.unpack_from(self._mm, self._base + 64)[0]
+            if self.nslots - (self._head - tail) < nfrags:
+                return None
+            return self._stream_frame(views, total, pipe)
+
+    def send_frame(self, header, segments, deadline: float,
+                   abort) -> tuple[int, int]:
+        """Stream one frame (header + out-of-band segments) as a
+        fragment pipeline: each fragment is copied from the caller's
+        buffers straight into slot memory and published immediately, so
+        the consumer overlaps assembly with the remaining copies.
+        Returns ``(on_ring_bytes, nfrags)``."""
+        views, total, pipe = self._frame_views(header, segments)
+        with self._lock:
+            if self._dead:
+                raise errors.InternalError(
+                    f"sm ring to rank {self.dest} is torn down"
+                )
+            return self._stream_frame(views, total, pipe,
+                                      deadline=deadline, abort=abort)
+
+    def _stream_frame(self, views, total: int, pipe: int,
+                      deadline: float | None = None,
+                      abort=None) -> tuple[int, int]:
+        """Fragment-pipeline copy-in, producer lock held.  A None
+        deadline means the caller already proved the free slots cover
+        the frame (try_send_frame) — the slot waits degenerate to the
+        free-slot check."""
+        mm = self._mm
+        vi, voff = 0, 0
+        remaining = total
+        nfrags = 0
+        while True:
+            self._wait_slot(
+                time.monotonic() if deadline is None else deadline,
+                abort,
+            )
+            slot = self._slot_at(self._head)
+            frag = min(pipe, remaining)
+            off = slot + _SLOT_HDR
+            left = frag
+            while left:
+                v = views[vi]
+                take = min(left, v.nbytes - voff)
+                mm[off:off + take] = v[voff:voff + take]
+                off += take
+                voff += take
+                left -= take
+                if voff == v.nbytes:
+                    vi += 1
+                    voff = 0
+            self._publish(slot, frag, total)
+            nfrags += 1
+            remaining -= frag
+            if remaining == 0:
+                break
         return total + nfrags * _SLOT_HDR, nfrags
 
     # -- quiesce / teardown ---------------------------------------------

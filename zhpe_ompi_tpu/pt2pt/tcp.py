@@ -36,6 +36,7 @@ coordinator plays for the device plane.
 
 from __future__ import annotations
 
+import collections
 import itertools
 import queue
 import random
@@ -350,6 +351,67 @@ def live_push_threads() -> list[str]:
     ]
 
 
+class _OutChannel:
+    """Per-destination deferred-send FIFO — the send side of the
+    nonblocking progress engine.  ``isend`` enqueues its work here and
+    returns; push-pool workers drain each channel strictly in order, so
+    deferred frames to one peer can never reorder among themselves (the
+    per-source FIFO the matching engine assumes), and blocking sends
+    FENCE on the channel before writing the socket inline (ordering
+    across both send paths).  ``draining`` marks the single worker that
+    owns the queue; the empty→non-empty transition submits one."""
+
+    __slots__ = ("lock", "queue", "draining")
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        # items: (work, request, finish) — `work()` performs the send;
+        # `finish` marks the item whose success completes the request
+        # (an RTS item carries its rendezvous request only for the
+        # poisoned-while-parked skip; the DATA push completes it)
+        self.queue: collections.deque = collections.deque()
+        self.draining = False
+
+    def busy(self) -> bool:
+        with self.lock:
+            return bool(self.queue) or self.draining
+
+
+# every proc, weakly: the hygiene gate walks CLOSED procs asserting no
+# incomplete deferred SendRequest and no orphaned parked-rndv
+# descriptor survived teardown (open procs legitimately hold both)
+_live_procs: weakref.WeakSet = weakref.WeakSet()
+
+
+def live_incomplete_send_requests() -> list[str]:
+    """Deferred SendRequests still incomplete on CLOSED procs — the
+    test-suite hygiene gate's view (close() drains the in-flight set
+    bounded, then completes leftovers errored; sever() abandons them
+    errored immediately — either way nothing may stay incomplete)."""
+    out = []
+    for proc in list(_live_procs):
+        if not proc._closed.is_set():
+            continue
+        for req in list(proc._inflight):
+            if not req.done:
+                out.append(f"rank{proc.rank}: incomplete deferred send")
+    return out
+
+
+def orphaned_rndv_descriptors() -> list[str]:
+    """Parked rendezvous descriptors left on CLOSED procs — the gate's
+    view of the park table (a descriptor nobody will ever push pins the
+    caller's buffers forever)."""
+    out = []
+    for proc in list(_live_procs):
+        if not proc._closed.is_set():
+            continue
+        with proc._rndv_lock:
+            ids = sorted(proc._pending_rndv)
+        out += [f"rank{proc.rank}: parked rndv id={i}" for i in ids]
+    return out
+
+
 class TcpProc(errh.HasErrhandler, ulfm.UlfmEndpointAPI, HostCollectives,
               NonblockingCollectives):
     """One process's endpoint in a TCP universe of `size` ranks.
@@ -386,9 +448,22 @@ class TcpProc(errh.HasErrhandler, ulfm.UlfmEndpointAPI, HostCollectives,
         self.engine = matching.make_matching_engine()
         self._seq = itertools.count()
         self._rndv_ids = itertools.count(1)
-        # rndv_id -> parked data-frame segments (header + payload copies)
+        # rndv_id -> parked data-frame segments.  send() parks COPIES
+        # (its buffer-reuse contract holds at return); isend parks the
+        # DESCRIPTOR — the caller's own buffers, pinned by the
+        # SendRequest until the CTS-released push completes.
         self._pending_rndv: dict[int, list] = {}
+        # rndv_id -> (dest, SendRequest-or-None): who the transfer is
+        # for (peer death poisons it) and which request its push
+        # completes (None for blocking sends)
+        self._rndv_meta: dict[int, tuple[int, Any]] = {}
         self._rndv_lock = threading.Lock()
+        # deferred-send progress engine: per-destination FIFO channels
+        # drained by the push-pool workers, plus the in-flight request
+        # registry the hygiene gate inspects after close()
+        self._out_channels: dict[int, _OutChannel] = {}
+        self._out_lock = threading.Lock()
+        self._inflight: weakref.WeakSet = weakref.WeakSet()
         self._push_pool = _PushPool(
             f"rndv-push-{rank}",
             int(mca_var.get("tcp_rndv_push_workers", 4)),
@@ -407,6 +482,7 @@ class TcpProc(errh.HasErrhandler, ulfm.UlfmEndpointAPI, HostCollectives,
             weakref.WeakKeyDictionary()  # socket -> its framing lock
         self._closed = threading.Event()
         self._incoming_cv = threading.Condition()
+        _live_procs.add(self)
         # shared-memory plane (btl/sm analog): create OUR inbound-ring
         # segment before the modex so the card can advertise a segment
         # that already exists — a peer that got the book can map it with
@@ -481,6 +557,10 @@ class TcpProc(errh.HasErrhandler, ulfm.UlfmEndpointAPI, HostCollectives,
                 # ring into a corpse the moment classification learns of it
                 # (detector, transport error, notice flood, or goodbye)
                 self.ft_state.add_failure_listener(self._sm_peer_dead)
+                # peer death ⇒ typed completion of every parked isend
+                # toward it (queued frames AND parked rndv descriptors):
+                # a waitall must observe ProcFailed, never wedge
+                self.ft_state.add_failure_listener(self._fail_inflight)
                 if rejoin_book is not None:
                     # announce BEFORE the detector starts: beats toward a
                     # survivor that has not yet swapped in the fresh
@@ -927,6 +1007,11 @@ class TcpProc(errh.HasErrhandler, ulfm.UlfmEndpointAPI, HostCollectives,
         if self._detector is not None:
             self._detector.stop(join_timeout=0.0)
         self._closed.set()
+        # a crash abandons its in-flight deferred sends and parked
+        # rendezvous descriptors: waiters unblock ERRORED (typed) and
+        # the hygiene gate sees no incomplete request / orphaned park
+        self._abandon_inflight("proc severed (simulated crash) with "
+                               "sends in flight")
         # a crash abandons its pushes: mark the pool closed so idle
         # workers exit (the hygiene gate counts worker threads)
         self._push_pool.close(0.0)
@@ -1325,6 +1410,15 @@ class TcpProc(errh.HasErrhandler, ulfm.UlfmEndpointAPI, HostCollectives,
                 self._incoming_cv.notify_all()
             return
         nbytes = _payload_size(obj)
+        # deferred frames queued toward this peer drain FIRST: blocking
+        # sends write the socket/ring inline, and per-source FIFO must
+        # hold across both send paths (isend then send may not reorder)
+        try:
+            self._send_fence(dest)
+        except errors.InternalError as exc:
+            if poll:
+                raise
+            return self.call_errhandler(exc)
         # per-peer transport dispatch (the btl selection seam): the sm
         # ring wins for same-boot peers by priority; everything below —
         # eager/rendezvous split, SPC accounting, FT classification —
@@ -1409,75 +1503,116 @@ class TcpProc(errh.HasErrhandler, ulfm.UlfmEndpointAPI, HostCollectives,
                 raise exc from e
             return self.call_errhandler(exc)
 
-    def _send_rndv(self, obj: Any, dest: int, tag: int, cid: int,
-                   seq: int, nbytes: int) -> None:
-        """RTS/CTS rendezvous: serialize the payload now (buffer-reuse
-        contract), park the data frame locally, announce with a small RTS
-        carrying the envelope; the receiver's CTS — handled in the drain
-        thread — releases the data on a dedicated (rndv_id, cid) channel."""
+    def _push_rndv(self, rndv_id: int, dest: int, req=None) -> None:
+        """CTS-released bulk push over a dedicated per-transfer data
+        connection (hello ["d"]).  Runs on a push-pool worker over its
+        OWN socket: the drain must keep reading while this send blocks
+        (drain stuck in a writer = bidirectional deadlock), and the bulk
+        write must not hold the control socket's framing lock — a tiny
+        CTS queued behind a multi-MB sendall re-creates the same
+        deadlock one level up; ob1 separates its channels for the same
+        reason.  ``req`` is the isend path's SendRequest: the push's
+        outcome completes it (the blocking path passes None — its
+        buffer-reuse contract was settled by the park copy)."""
+        data_sock = None
+        err: BaseException | None = None
+        sent = False
+        try:
+            with self._rndv_lock:
+                frame_segs = self._pending_rndv.get(rndv_id)
+                if frame_segs is not None and req is not None \
+                        and not req.done:
+                    # push in flight: owned ATOMICALLY with the frame
+                    # read, under the same lock the failure listener
+                    # holds — no window where both sides claim it
+                    req._owned = True
+            if frame_segs is None or (req is not None and req.done):
+                # poisoned/abandoned while parked (revoke, peer death,
+                # sever): the poisoner owns the request's completion
+                return
+            data_sock = socket.socket(
+                socket.AF_INET, socket.SOCK_STREAM
+            )
+            data_sock.settimeout(self._timeout)
+            data_sock.connect(tuple(self.address_book[dest][:2]))
+            _send_frame(data_sock, dss.pack(["d"]))
+            _send_frame(data_sock, frame_segs)
+            sent = True
+        except BaseException as e:  # noqa: BLE001 - typed at the req
+            # ANY escape (not just OSError) must complete the request:
+            # the finally below drops the park entries, so a request
+            # left incomplete here could never be completed by the
+            # failure listener or the close-time abandon sweep again
+            err = e
+            mca_output.emit(
+                _stream,
+                "rank %s: rendezvous data push to %s failed: %s",
+                self.rank, dest, e,
+            )
+        finally:
+            if data_sock is not None:
+                try:
+                    data_sock.close()
+                except OSError:
+                    pass
+            # always release the entry: close()'s quiesce loop would
+            # otherwise spin its full timeout on a dead transfer
+            with self._rndv_lock:
+                self._pending_rndv.pop(rndv_id, None)
+                self._rndv_meta.pop(rndv_id, None)
+            if req is not None:
+                if sent:
+                    req.complete()
+                elif err is not None:
+                    req.complete_error(self._deferred_exc(err, dest))
+
+    def _park_rndv(self, obj: Any, dest: int, seq: int,
+                   req=None) -> tuple[int, list]:
+        """Serialize and park one rendezvous transfer; returns
+        ``(rndv_id, oob_segments)``.  The blocking path (``req=None``)
+        parks one defensive ``bytes()`` copy per payload block — its
+        buffer-reuse contract holds the moment send() returns; the
+        isend path parks the DESCRIPTOR (the caller's own memoryview
+        segments, zero copies) because its contract is deferred to
+        request completion."""
         rndv_id = next(self._rndv_ids)
-        # serialize NOW (buffer-reuse contract: the caller may mutate the
-        # moment send() returns) — but as parked SEGMENTS: the header
-        # stream plus one defensive copy per raw payload block, pushed
-        # vectored later.  One copy total, vs pack's tobytes + the old
-        # header+body reassembly; the receive side stays zero-copy.
         header, oob = dss.pack_frames(
             self.rank, rndv_id, _RNDV_DATA_CID, seq, obj,
             oob_min=int(mca_var.get("tcp_zero_copy_min", 0)),
         )
-        segments = [header] + [bytes(v) for v in oob]
+        if req is None:
+            segments = [header] + [bytes(v) for v in oob]
+            spc.record("tcp_rndv_park_copy_bytes",
+                       sum(v.nbytes for v in oob))
+        else:
+            segments = [header, *oob]
+            req._pinned = segments
+            spc.record("rndv_park_bytes_avoided",
+                       sum(v.nbytes for v in oob))
         with self._rndv_lock:
             self._pending_rndv[rndv_id] = segments
+            self._rndv_meta[rndv_id] = (dest, req)
         spc.record("tcp_rndv_sends", 1)
         if oob:
             spc.record("tcp_zero_copy_sends", 1)
             spc.record("tcp_copy_bytes_avoided",
                        sum(v.nbytes for v in oob))
 
-        def push_data():
-            # Runs on a push-pool worker over its OWN socket: the drain
-            # must keep reading while this sendall blocks (drain stuck
-            # in a writer = bidirectional deadlock), and the bulk write
-            # must not hold the control socket's framing lock — a tiny
-            # CTS queued behind a multi-MB sendall re-creates the same
-            # deadlock one level up.  A dedicated per-transfer data
-            # connection (hello ["d"]) keeps bulk and control planes
-            # independent, the reason ob1 separates its channels.
-            data_sock = None
-            try:
-                with self._rndv_lock:
-                    frame_segs = self._pending_rndv.get(rndv_id)
-                if frame_segs is None:
-                    return
-                data_sock = socket.socket(
-                    socket.AF_INET, socket.SOCK_STREAM
-                )
-                data_sock.settimeout(self._timeout)
-                data_sock.connect(tuple(self.address_book[dest][:2]))
-                _send_frame(data_sock, dss.pack(["d"]))
-                _send_frame(data_sock, frame_segs)
-            except OSError as e:
-                mca_output.emit(
-                    _stream,
-                    "rank %s: rendezvous data push to %s failed: %s",
-                    self.rank, dest, e,
-                )
-            finally:
-                if data_sock is not None:
-                    try:
-                        data_sock.close()
-                    except OSError:
-                        pass
-                # always release the entry: close()'s quiesce loop would
-                # otherwise spin its full timeout on a dead transfer
-                with self._rndv_lock:
-                    self._pending_rndv.pop(rndv_id, None)
-
         def on_cts(_env, _payload):
-            self._push_pool.submit(push_data)
+            self._push_pool.submit(
+                lambda: self._push_rndv(rndv_id, dest, req))
 
         with self._incoming_cv:
             self.engine.post_recv(dest, rndv_id, _RNDV_CTS_CID, on_cts)
+        return rndv_id, oob
+
+    def _send_rndv(self, obj: Any, dest: int, tag: int, cid: int,
+                   seq: int, nbytes: int) -> None:
+        """RTS/CTS rendezvous: serialize the payload now (buffer-reuse
+        contract), park the data frame locally, announce with a small RTS
+        carrying the envelope; the receiver's CTS — handled in the drain
+        thread — releases the data on a dedicated (rndv_id, cid) channel."""
+        rndv_id, _oob = self._park_rndv(obj, dest, seq)
         rts = dss.pack(
             self.rank, tag, cid, seq,
             (_RTS_MARK, self.rank, rndv_id, nbytes),
@@ -1507,25 +1642,481 @@ class TcpProc(errh.HasErrhandler, ulfm.UlfmEndpointAPI, HostCollectives,
         self._framed_send(sock, cts)
         return True
 
-    def isend(self, obj: Any, dest: int, tag: int = 0, cid: int = 0):
-        """Nonblocking send: the eager frame is on the wire before return,
-        so the request is born complete (TCP flow control is the eager
-        buffer bound)."""
-        from .requests import Request
+    # -- deferred-contract nonblocking send engine -----------------------
 
-        self.send(obj, dest, tag, cid)
-        req = Request()
-        req.complete()
+    def _channel(self, dest: int) -> _OutChannel:
+        ch = self._out_channels.get(dest)
+        if ch is None:
+            with self._out_lock:
+                ch = self._out_channels.setdefault(dest, _OutChannel())
+        return ch
+
+    def _enqueue_deferred(self, dest: int, req, work,
+                          finish: bool = True) -> None:
+        """Queue one unit of deferred send work for ``dest`` and make
+        sure exactly one worker owns the channel's drain."""
+        ch = self._channel(dest)
+        with ch.lock:
+            ch.queue.append((work, req, finish))
+            start = not ch.draining
+            if start:
+                ch.draining = True
+        if start:
+            self._push_pool.submit(
+                lambda: self._drain_channel(ch, dest))
+
+    def _drain_channel(self, ch: _OutChannel, dest: int) -> None:
+        """Push-pool worker body: drain one destination's deferred
+        frames strictly in order; a failing item completes its request
+        ERRORED (typed) and the drain keeps going — later frames to a
+        dead peer fail fast on their own, and frames to a live peer
+        behind a transient error still deliver."""
+        while True:
+            with ch.lock:
+                if not ch.queue:
+                    ch.draining = False
+                    return
+                work, req, finish = ch.queue.popleft()
+                if req is not None:
+                    # ownership set ATOMICALLY with the pop: a failure
+                    # classifier either sees the item still queued (and
+                    # errors it) or sees it owned — never a window where
+                    # a delivered send gets poisoned (observed: a peer
+                    # recv'd the frame, finished, and its goodbye beat
+                    # the worker to the completion)
+                    req._owned = True
+            if req is not None and req.done:
+                continue  # poisoned while parked (revoke/death/abandon)
+            try:
+                work()
+            except BaseException as e:  # noqa: BLE001 - typed at the req
+                if req is not None:
+                    req.complete_error(self._deferred_exc(e, dest))
+                continue
+            if finish and req is not None:
+                req.complete()
+            elif req is not None:
+                # RTS sent, data still parked awaiting the CTS: the
+                # park/poison machinery owns the request again (a peer
+                # that departs before its CTS must error it typed)
+                req._owned = False
+
+    def _deferred_exc(self, e: BaseException, dest: int):
+        """Typed completion error for a deferred send that failed on
+        the progress engine — the same classification the blocking
+        send path applies, observed at wait() instead of at the call."""
+        state = self.ft_state
+        if isinstance(e, sm_mod.ConsumerStopped) and state is not None:
+            self._mark_transport_death(dest)
+            return errors.ProcFailed(
+                f"rank {dest} failed (sm ring consumer stopped): {e}",
+                failed_ranks=state.failed(),
+            )
+        if isinstance(e, errors.MpiError):
+            return e
+        if isinstance(e, OSError):
+            if state is not None and isinstance(
+                e, (ConnectionRefusedError, ConnectionResetError,
+                    BrokenPipeError)
+            ):
+                self._mark_transport_death(dest)
+                return errors.ProcFailed(
+                    f"deferred send to rank {dest} failed: {e}",
+                    failed_ranks=state.failed(),
+                )
+            return errors.InternalError(
+                f"deferred send to rank {dest} failed: {e}")
+        return errors.InternalError(
+            f"deferred send to rank {dest} failed: "
+            f"{type(e).__name__}: {e}")
+
+    def _send_fence(self, dest: int) -> None:
+        """Order a direct (caller-thread) send behind every deferred
+        frame already queued toward ``dest``: blocking sends write the
+        socket/ring inline, so an in-flight isend to the same peer must
+        drain first or per-source FIFO breaks across the two send
+        paths.  No channel (the common all-blocking case) costs one
+        dict probe."""
+        ch = self._out_channels.get(dest)
+        if ch is None or not ch.busy():
+            return
+        deadline = time.monotonic() + self._timeout
+        while ch.busy():
+            if time.monotonic() > deadline:
+                raise errors.InternalError(
+                    f"deferred-send queue to rank {dest} failed to "
+                    "drain within the stall timeout")
+            time.sleep(0.0002)
+
+    def _arm_isend_poison(self, req, dest: int, cid: int,
+                          rndv_id: int | None = None) -> None:
+        """Weak-progress poisoning for a parked isend: a revoke flood
+        (via the cid alias machinery) or peer death arriving while the
+        frame waits its turn completes the request typed from the
+        waiter's own progress tick.  Death also lands eagerly through
+        the _fail_inflight failure listener; this RETRYING tick is the
+        backstop (the one-shot listener may find the frame transiently
+        owned — e.g. the RTS mid-send — and skip it) and the revoke
+        path.  A poisoned rendezvous request also releases its parked
+        descriptor (``rndv_id``): a park nobody will ever push must
+        not pin the caller's buffers or stall the close quiesce."""
+        state = self.ft_state
+        if state is None:
+            return
+
+        def fail(exc) -> None:
+            if rndv_id is not None:
+                with self._rndv_lock:
+                    if req._owned:
+                        return  # CTS push started: transport owns it
+                    self._pending_rndv.pop(rndv_id, None)
+                    self._rndv_meta.pop(rndv_id, None)
+            req.complete_error(exc)
+
+        def prog():
+            if req.done or req._owned:
+                # a worker is mid-send: its outcome (delivered, or a
+                # transport error classified typed) is authoritative
+                return
+            if state.is_revoked(cid):
+                fail(errors.Revoked(
+                    f"isend on revoked cid={cid}", cid=cid))
+            elif state.is_failed(dest):
+                fail(errors.ProcFailed(
+                    f"rank {dest} failed with an isend in flight "
+                    f"(cause: {state.cause_of(dest)})",
+                    failed_ranks=state.failed()))
+
+        req._progress = prog
+
+    def _fail_inflight(self, rank: int, cause: str) -> None:
+        """Failure-listener hook (``FailureState.add_failure_listener``):
+        a peer's death completes every parked isend toward it as typed
+        ``ProcFailed`` — queued channel frames and parked rendezvous
+        descriptors both — so waitall loops observe the failure instead
+        of wedging on a corpse (the deferred twin of the blocking
+        path's discovery-at-send classification)."""
+        state = self.ft_state
+        if state is None:
+            return
+        exc = errors.ProcFailed(
+            f"rank {rank} failed with isends in flight (cause: {cause})",
+            failed_ranks=state.failed(),
+        )
+        ch = self._out_channels.get(rank)
+        if ch is not None:
+            with ch.lock:
+                # under ch.lock: an item is either still queued HERE
+                # (error it — it will be skipped at pop) or already
+                # popped-and-owned by a worker (its outcome is
+                # authoritative); never both
+                for _work, req, _finish in ch.queue:
+                    if req is not None and not req._owned:
+                        req.complete_error(exc)
+        with self._rndv_lock:
+            doomed = [(rid, meta[1])
+                      for rid, meta in self._rndv_meta.items()
+                      if meta[0] == rank
+                      and (meta[1] is None or not meta[1]._owned)]
+            for rid, _req in doomed:
+                self._pending_rndv.pop(rid, None)
+                self._rndv_meta.pop(rid, None)
+        for _rid, req in doomed:
+            if req is not None:
+                req.complete_error(exc)
+
+    def _rndv_undelivered(self) -> bool:
+        """Parked transfers still owed to the peers — the close-quiesce
+        predicate.  Blocking-send parks (no request) are always owed;
+        an isend park whose request already completed ERRORED (revoked
+        or failed while parked — no CTS is ever coming) can never
+        drain and must not stall the quiesce for the full timeout."""
+        with self._rndv_lock:
+            if not self._pending_rndv:
+                return False
+            for rid in self._pending_rndv:
+                meta = self._rndv_meta.get(rid)
+                if meta is None or meta[1] is None or not meta[1].done:
+                    return True
+            return False
+
+    def _abandon_inflight(self, why: str) -> None:
+        """Drain-or-abandon teardown of the in-flight set: complete
+        every still-parked deferred send ERRORED (waiters unblock
+        typed) and drop the parked descriptors (the hygiene gate's
+        zero-orphan contract) — sever() abandons immediately (crash
+        semantics), close() calls this only after its bounded quiesce
+        gave every frame its chance to drain."""
+        exc = errors.InternalError(why)
+        for ch in list(self._out_channels.values()):
+            with ch.lock:
+                items = list(ch.queue)
+                ch.queue.clear()
+            for _work, req, _finish in items:
+                if req is not None:
+                    req.complete_error(exc)
+        with self._rndv_lock:
+            metas = list(self._rndv_meta.values())
+            self._pending_rndv.clear()
+            self._rndv_meta.clear()
+        for _dest, req in metas:
+            if req is not None:
+                req.complete_error(exc)
+
+    def _isend_eager(self, obj: Any, dest: int, tag: int, cid: int,
+                     seq: int, dispatch):
+        """Eager deferred send: pin the caller's buffers (pack_frames
+        memoryview segments — zero copies) and queue the vectored
+        sendmsg on the progress engine; the request completes when the
+        kernel has the bytes."""
+        from .requests import SendRequest
+
+        header, oob = dss.pack_frames(
+            self.rank, tag, cid, seq, obj,
+            oob_min=int(mca_var.get("tcp_zero_copy_min", 0)),
+        )
+        segments = [header, *oob]
+        req = SendRequest(pinned=segments, dispatch=dispatch)
+        self._arm_isend_poison(req, dest, cid)
+        self._inflight.add(req)
+        spc.record("tcp_isend_deferred", 1)
+        if oob:
+            spc.record("tcp_zero_copy_sends", 1)
+            spc.record("tcp_copy_bytes_avoided",
+                       sum(v.nbytes for v in oob))
+
+        def work():
+            sock = self._endpoint(dest)
+            self._framed_send(sock, segments)
+
+        self._enqueue_deferred(dest, req, work, finish=True)
         return req
 
+    def _isend_rndv(self, obj: Any, dest: int, tag: int, cid: int,
+                    seq: int, nbytes: int, dispatch):
+        """Rendezvous deferred send: the RTS parks only the DESCRIPTOR
+        — the caller's buffers pinned by the request, no copy-at-park —
+        and the receiver's CTS releases a push of those buffers
+        directly over the data socket.  The request completes when the
+        push has the bytes in the kernel (or errored, typed, when the
+        peer dies / the cid is revoked while parked)."""
+        from .requests import SendRequest
+
+        req = SendRequest(dispatch=dispatch)
+        self._inflight.add(req)
+        spc.record("tcp_isend_deferred", 1)
+        rndv_id, _oob = self._park_rndv(obj, dest, seq, req=req)
+        self._arm_isend_poison(req, dest, cid, rndv_id=rndv_id)
+        rts = dss.pack(
+            self.rank, tag, cid, seq,
+            (_RTS_MARK, self.rank, rndv_id, nbytes),
+        )
+
+        def send_rts():
+            sock = self._endpoint(dest)
+            self._framed_send(sock, rts)
+
+        # the RTS rides the ordered channel (it IS the matchable
+        # message — per-source FIFO with every eager frame before it);
+        # its write does NOT complete the request — the data push does
+        self._enqueue_deferred(dest, req, send_rts, finish=False)
+        return req
+
+    def _isend_sm(self, smtx: sm_mod.SmSender, obj: Any, dest: int,
+                  tag: int, cid: int, seq: int, nbytes: int, dispatch):
+        """Shared-memory deferred send.  Ring backpressure already IS
+        the in-flight bound, so a small frame tries the single-slot
+        copy-in NONBLOCKING and is born complete when it lands; a full
+        ring parks a producer continuation on the progress engine
+        instead of blocking the caller (today's behavior), and larger
+        frames take the fragment pipeline there too (the worker's
+        copy-in overlaps the caller's compute — the same deferred
+        contract, one transport over)."""
+        from .requests import SendRequest
+
+        req = SendRequest(dispatch=dispatch)
+        self._arm_isend_poison(req, dest, cid)
+        ch = self._out_channels.get(dest)
+        idle = ch is None or not ch.busy()
+        oob_min = int(mca_var.get("tcp_zero_copy_min", 0))
+        if idle and nbytes + 512 <= min(smtx.slot_bytes, 32 << 10):
+            try:
+                wire = smtx.send_direct(
+                    (self.rank, tag, cid, seq, obj), oob_min,
+                    time.monotonic(), None,
+                )
+            except sm_mod.RingFull:
+                pass  # park the continuation below
+            except (errors.MpiError, OSError) as e:
+                req.complete_error(self._deferred_exc(e, dest))
+                return req
+            else:
+                if wire is not None:
+                    spc.record("sm_bytes_sent", wire)
+                    spc.record("sm_eager_sends", 1)
+                    req.complete()
+                    return req
+                # frame does not fit one slot: fragment pipeline below
+        prebuilt = None
+        if idle:
+            # larger frame, ring currently has room for ALL of it: run
+            # the fragment pipeline inline — the copy-in never waits on
+            # the consumer, so this is still nonblocking, and it skips
+            # a worker handoff whose scheduling quantum costs more than
+            # the copy on small hosts (measured on the han pipeline)
+            prebuilt = dss.pack_frames(self.rank, tag, cid, seq, obj,
+                                       oob_min=oob_min)
+            try:
+                done = smtx.try_send_frame(*prebuilt)
+            except (errors.MpiError, OSError) as e:
+                req.complete_error(self._deferred_exc(e, dest))
+                return req
+            if done is not None:
+                wire, nfrags = done
+                spc.record("sm_bytes_sent", wire)
+                spc.record("sm_eager_sends" if nfrags == 1
+                           else "sm_frag_sends", 1)
+                req.complete()
+                return req
+        self._inflight.add(req)
+        spc.record("tcp_isend_deferred", 1)
+
+        def work():
+            if prebuilt is not None:
+                # the nonblocking attempt already serialized the frame:
+                # stream the SAME header/segments once the ring drains
+                # (re-serializing on the backpressured path would pay
+                # the DSS pack twice for exactly the largest payloads)
+                self._sm_send_prebuilt(smtx, dest, *prebuilt)
+            else:
+                self._sm_send(smtx, obj, dest, tag, cid, seq, nbytes)
+
+        self._enqueue_deferred(dest, req, work, finish=True)
+        return req
+
+    def _sm_send_prebuilt(self, smtx: sm_mod.SmSender, dest: int,
+                          header, oob) -> None:
+        """Parked-continuation body for an sm isend whose frame was
+        already serialized for the nonblocking attempt: the blocking
+        fragment pipeline over the same pinned segments, with the
+        `_sm_send` abort contract (peer death / local close classify
+        out of the ring-full spin)."""
+        state = self.ft_state
+        closed = self._closed
+
+        def abort():
+            if closed.is_set():
+                raise errors.InternalError(
+                    f"sm send to rank {dest} on a closed proc"
+                )
+            if state is not None and state.is_failed(dest):
+                raise errors.ProcFailed(
+                    f"rank {dest} failed during an sm ring send",
+                    failed_ranks=state.failed(),
+                )
+
+        deadline = time.monotonic() + self._timeout
+        wire, nfrags = smtx.send_frame(header, oob, deadline, abort)
+        spc.record("sm_bytes_sent", wire)
+        spc.record("sm_eager_sends" if nfrags == 1 else "sm_frag_sends",
+                   1)
+
+    def isend(self, obj: Any, dest: int, tag: int = 0, cid: int = 0,
+              poll: bool = False):
+        """True MPI_Isend: the buffer-reuse contract is DEFERRED to
+        request completion.  The caller's buffers are pinned (no eager
+        copy, no rendezvous park copy) and handed to the per-proc
+        progress engine — per-destination FIFO channels drained by the
+        push-pool workers (eager: queued sendmsg; rendezvous: RTS parks
+        the descriptor, CTS pushes the pinned buffers over the data
+        socket; sm: slot copy-in, or a parked producer continuation
+        when the ring is full).  ``wait()``/``test()`` gate buffer
+        reuse and surface typed failures at completion: a revoked cid
+        or known-failed destination returns an ERRORED request (never a
+        synchronous raise), an in-flight send whose peer dies completes
+        as ``ProcFailed``, a revoke flood poisons parked sends through
+        the cid alias machinery.  ``poll=True`` marks a
+        framework-internal send: errors raise raw at wait, bypassing
+        the errhandler disposition."""
+        from .requests import SendRequest
+
+        if not 0 <= dest < self.size:
+            raise errors.RankError(f"rank {dest} out of range")
+        if tag < 0:
+            raise errors.TagError(f"negative tag {tag}")
+        dispatch = None if poll else self.call_errhandler
+        state = self.ft_state
+        if state is not None and state.is_revoked(cid):
+            return SendRequest.errored(
+                errors.Revoked(f"isend on revoked cid={cid}", cid=cid),
+                dispatch=dispatch,
+            )
+        if state is not None and state.is_failed(dest):
+            return SendRequest.errored(
+                errors.ProcFailed(
+                    f"rank {dest} is known failed "
+                    f"(cause: {state.cause_of(dest)})",
+                    failed_ranks=state.failed(),
+                ),
+                dispatch=dispatch,
+            )
+        seq = next(self._seq)
+        if dest == self.rank:
+            # loopback (btl/self): the single defensive copy IS
+            # completion — born complete, exactly like the blocking path
+            nbytes = _payload_size(obj)
+            try:
+                payload = _loopback_copy(obj)
+                spc.record("tcp_loopback_fast_deliveries", 1)
+                spc.record("tcp_copy_bytes_avoided", nbytes)
+            except _LoopbackFallback:
+                frame = dss.pack(self.rank, tag, cid, seq, obj)
+                payload = dss.unpack(frame)[4]
+            env = Envelope(self.rank, tag, cid, seq)
+            with self._incoming_cv:
+                self.engine.incoming(env, payload)
+                self._incoming_cv.notify_all()
+            return SendRequest.completed()
+        nbytes = _payload_size(obj)
+        smtx = self._sm_tx(dest)
+        if smtx is not None:
+            return self._isend_sm(smtx, obj, dest, tag, cid, seq,
+                                  nbytes, dispatch)
+        if dest in self._sm_declined:
+            spc.record("sm_fallback_tcp_sends", 1)
+        limit = int(mca_var.get("tcp_eager_limit", 1 << 20))
+        if nbytes > limit:
+            return self._isend_rndv(obj, dest, tag, cid, seq, nbytes,
+                                    dispatch)
+        return self._isend_eager(obj, dest, tag, cid, seq, dispatch)
+
     def irecv(self, source: int = ANY_SOURCE, tag: int = ANY_TAG,
-              cid: int = 0):
-        """Nonblocking matched receive returning a Request."""
+              cid: int = 0, poll: bool = False):
+        """Nonblocking matched receive returning a Request.  On an ft
+        proc the request is failure-aware: classification (revoked cid,
+        named dead source, ANY_SOURCE pending semantics) completes it
+        ERRORED — typed, from the waiter's progress tick, mirroring the
+        SendRequest path — instead of surfacing only at the next
+        blocking call; a message matched after classification re-enters
+        the engine for a retry (the abandoned/re-inject contract).
+        ``poll=True`` marks a framework-internal receive (the agreement
+        protocol's frame waits): typed errors raise raw at wait/test,
+        bypassing the errhandler disposition, so fault-tolerant
+        protocols observe peer death regardless of the user's
+        disposition."""
         from .requests import Request
 
-        req = Request()
+        state = self.ft_state
+        abandoned = [False]
+        req = Request(dispatch=None if poll else self.call_errhandler) \
+            if state is not None else Request()
 
         def finalize(env: Envelope, payload: Any) -> None:
+            # runs while _incoming_cv is held (all engine entry points
+            # in this class take it), so `abandoned` is consistent
+            if abandoned[0]:
+                self.engine.incoming(env, payload)
+                return
             req.complete(payload, source=env.src, tag=env.tag)
 
         def on_match(env: Envelope, payload: Any) -> None:
@@ -1535,6 +2126,20 @@ class TcpProc(errh.HasErrhandler, ulfm.UlfmEndpointAPI, HostCollectives,
 
         with self._incoming_cv:
             self.engine.post_recv(source, tag, cid, on_match)
+        if state is not None:
+            def prog():
+                if req.done:
+                    return
+                exc = ulfm.classify_recv_failure(state, source, cid)
+                if exc is None:
+                    return
+                with self._incoming_cv:
+                    if req.done:
+                        return
+                    abandoned[0] = True
+                req.complete_error(exc)
+
+            req._progress = prog
         return req
 
     def recv(self, source: int = ANY_SOURCE, tag: int = ANY_TAG,
@@ -1703,16 +2308,30 @@ class TcpProc(errh.HasErrhandler, ulfm.UlfmEndpointAPI, HostCollectives,
                     if time.monotonic() >= flood_deadline:
                         break
                     time.sleep(0.001)
-        # Quiesce outstanding rendezvous sends next — with the detector
-        # still beating: the payload parks here until the receiver's CTS,
-        # so tearing down immediately after a buffered send() would
-        # destroy data the peer is entitled to (ompi_mpi_finalize's
-        # quiesce-before-teardown contract), and a long quiesce with our
-        # own beats already silenced would get us falsely suspected by
-        # our observer.  Bounded wait: a peer that never matches cannot
-        # hang our shutdown.
+        # Quiesce the deferred-send channels and outstanding rendezvous
+        # sends next — with the detector still beating: queued isend
+        # frames and parked payloads exist only here until the workers
+        # (or the receiver's CTS) move them, so tearing down immediately
+        # after a buffered send() would destroy data the peer is
+        # entitled to (ompi_mpi_finalize's quiesce-before-teardown
+        # contract), and a long quiesce with our own beats already
+        # silenced would get us falsely suspected by our observer.
+        # Bounded wait: a peer that never matches cannot hang shutdown —
+        # leftovers are abandoned ERRORED below, the same bounded-join
+        # rule the control floods follow.
+        if self.ft_state is not None:
+            # re-sweep known-dead peers' in-flight sends before waiting
+            # on them: a one-shot failure-listener sweep may have found
+            # a frame transiently owned (RTS mid-send) and skipped it —
+            # without a waiter ticking the poison, the park would only
+            # fall to the bounded timeout below
+            for r in self.ft_state.failed():
+                self._fail_inflight(int(r), "known failed at close")
         deadline = time.monotonic() + self._timeout
-        while self._pending_rndv and time.monotonic() < deadline:
+        while time.monotonic() < deadline and any(
+                ch.busy() for ch in list(self._out_channels.values())):
+            time.sleep(0.005)
+        while self._rndv_undelivered() and time.monotonic() < deadline:
             time.sleep(0.005)
         if self.ft_state is not None and not self._ft_dead:
             # the goodbye rides TCP while data may still sit in peers'
@@ -1813,6 +2432,13 @@ class TcpProc(errh.HasErrhandler, ulfm.UlfmEndpointAPI, HostCollectives,
         # (or wedged on a dead peer, bounded by the join deadline) — the
         # conftest leak gate asserts none survive
         self._push_pool.close(max(0.0, deadline - time.monotonic()))
+        # whatever the bounded quiesce could not deliver is abandoned
+        # ERRORED now: no SendRequest may stay incomplete and no parked
+        # descriptor may survive a closed proc (the hygiene gate's
+        # zero-leak contract; an orderly close with live peers finds
+        # nothing here)
+        self._abandon_inflight(
+            "proc closed with undeliverable sends in flight")
         # sm plane last: poll thread joined, peer mappings unmapped, own
         # segment unlinked — the lifecycle contract the hygiene gate
         # asserts (rings live exactly as long as their proc)

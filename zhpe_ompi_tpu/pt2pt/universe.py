@@ -263,23 +263,81 @@ class RankContext(errh.HasErrhandler, ulfm.UlfmEndpointAPI,
     # -- receives --------------------------------------------------------
 
     def irecv(self, source: int = ANY_SOURCE, tag: int = ANY_TAG,
-              cid: int = 0) -> Request:
-        """MPI_Irecv."""
-        req = Request(progress=self.progress)
+              cid: int = 0, poll: bool = False) -> Request:
+        """MPI_Irecv.  On an ft universe the request is failure-aware:
+        classification (revoked cid, named dead source, ANY_SOURCE
+        pending semantics) completes it ERRORED — typed, from the
+        waiter's progress tick, mirroring the wire plane's SendRequest
+        path — so a waitall parked on a corpse observes ``ProcFailed``
+        at completion instead of wedging; a message matched after
+        classification re-enters the engine for a retry (the
+        abandoned/re-inject contract of ``recv``)."""
+        state = self.universe.ft_state
+        if state is None:
+            req = Request(progress=self.progress)
 
-        def on_match(env: Envelope, payload: Any) -> None:
+            def on_match(env: Envelope, payload: Any) -> None:
+                if isinstance(payload, _RndvToken):
+                    def deliver(data, env=env):
+                        req.complete(data, source=env.src, tag=env.tag)
+
+                    self.universe.contexts[payload.sender_rank].mailbox.put(
+                        (_CTS, payload.rndv_id, self.rank, deliver)
+                    )
+                else:
+                    req.complete(payload, source=env.src, tag=env.tag)
+
+            self.engine.post_recv(source, tag, cid, on_match)
+            return req
+
+        abandoned = [False]
+        # delivery may land from the SENDER's progress thread (the
+        # rendezvous CTS handoff): the abandon decision must serialize
+        # with it, the same lock discipline _ft_recv applies
+        abandon_lock = threading.Lock()
+        box: list[Request] = []
+
+        def deliver(env: Envelope, payload: Any) -> None:
+            with abandon_lock:
+                if abandoned[0]:
+                    self.engine.incoming(env, payload)
+                    return
+                box[0].complete(payload, source=env.src, tag=env.tag)
+
+        def on_match_ft(env: Envelope, payload: Any) -> None:
             if isinstance(payload, _RndvToken):
-                def deliver(data, env=env):
-                    req.complete(data, source=env.src, tag=env.tag)
+                def handoff(data, env=env):
+                    deliver(env, data)
 
                 self.universe.contexts[payload.sender_rank].mailbox.put(
-                    (_CTS, payload.rndv_id, self.rank, deliver)
+                    (_CTS, payload.rndv_id, self.rank, handoff)
                 )
             else:
-                req.complete(payload, source=env.src, tag=env.tag)
+                deliver(env, payload)
 
-        self.engine.post_recv(source, tag, cid, on_match)
-        return req
+        def prog() -> None:
+            self.progress()
+            req = box[0]
+            if req.done:
+                return
+            exc = ulfm.classify_recv_failure(state, source, cid)
+            if exc is None:
+                return
+            # final drain: the dead rank's last messages may already
+            # sit in our mailbox — death must not eat delivered data
+            self.progress()
+            with abandon_lock:
+                if req.done:
+                    return
+                abandoned[0] = True
+            req.complete_error(exc)
+
+        box.append(Request(
+            progress=prog,
+            dispatch=None if poll else self.call_errhandler,
+        ))
+        self.engine.post_recv(source, tag, cid, on_match_ft)
+        return box[0]
 
     def recv(self, source: int = ANY_SOURCE, tag: int = ANY_TAG,
              cid: int = 0, timeout: float | None = None,

@@ -26,6 +26,26 @@ Wire-plane counters (recorded by ``pt2pt/tcp.py``):
   single-defensive-copy shortcut instead of a full DSS round trip.
 - ``tcp_rndv_sends`` — rendezvous (RTS/CTS) transfers initiated.
 
+Nonblocking-engine counters (the deferred-contract isend path,
+recorded by ``pt2pt/tcp.py``):
+
+- ``tcp_isend_deferred`` — isends that entered the deferred-contract
+  progress engine (eager frames queued for the push-pool workers,
+  rendezvous descriptors parked without the copy, sm fragment
+  pipelines / full-ring producer continuations).  Born-complete isends
+  (loopback, an sm single-slot copy-in that landed immediately) are
+  not deferred and not counted.
+- ``rndv_park_bytes_avoided`` — payload bytes a rendezvous ISEND
+  parked as the caller's own pinned buffers instead of the blocking
+  path's defensive ``bytes()`` copy (the writev-style rendezvous: the
+  CTS-released push ships the caller's buffers directly).  The OSU
+  ``--overlap`` ladder gates on this rising at rendezvous sizes.
+- ``tcp_rndv_park_copy_bytes`` — payload bytes the BLOCKING send path
+  copied at park time (its buffer-reuse contract holds at return).
+  The overlap ladder asserts this stays flat across the isend rungs:
+  a silent fallback from the deferred contract to the copy path fails
+  CI, it does not hide as a perf regression.
+
 Shared-memory-plane counters (recorded at the per-peer transport
 dispatch seam in ``pt2pt/tcp.py``; the rings live in ``pt2pt/sm.py``):
 
@@ -68,6 +88,11 @@ Hierarchical-collective counters (the coll/han analog; recorded by
   asserted zero along the OSU han ladder's 2-host × 2-rank topology.
   The ``auto`` mode's decision not to engage is not a fallback and is
   not counted.
+- ``coll_han_pipelined`` — allreduces whose segmented leader exchange
+  took the PIPELINED schedule (``coll_han_pipeline`` auto/on, >= 2
+  segments): segment k's intra bcast isends drain on the deferred
+  engine while segment k+1's wire exchange runs.  The OSU ``--plane
+  han`` pipeline row gates on this rising at >= 2-segment sizes.
 """
 
 from __future__ import annotations
