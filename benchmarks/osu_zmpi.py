@@ -45,6 +45,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import time
 from typing import Callable
 
@@ -932,6 +933,123 @@ def bench_host_coll(opname: str = "allreduce", algorithm: str = "auto",
             mca_var.unset(pinned)
 
 
+def bench_launch(nprocs: int = 2, reps: int = 5) -> list[dict]:
+    """Launch-latency ladder (the runtime-plane win): what one job
+    START costs on three rungs —
+
+    - ``cold zmpirun (launcher proc)``: the full per-job price a shell
+      user pays today — a fresh launcher interpreter (python -m ...
+      import included), its rendezvous coordinator + name server, the
+      rank spawns, teardown.
+    - ``cold launch() (in-process)``: the embedded-library shape — the
+      launcher interpreter is already warm, but every job still builds
+      its own rendezvous/name-server infrastructure.
+    - ``dvm (resident zprted)``: one RPC into the running VM; the PMIx
+      store and daemon outlive the job, so the job pays ONLY its rank
+      spawns + the store modex.
+
+    Every rung launches the SAME trivial program (host_init → barrier →
+    finalize) with the same rank count; best-of-N and median of N are
+    both reported (single-CPU container: ±20% scheduler noise — the
+    best-of is the honest point estimate).  Gates, so a silently
+    misrouted rung fails instead of lying: every dvm launch must bump
+    ``dvm_jobs_launched`` and drive ``pmix_puts``/``pmix_fences`` (the
+    store-served modex really ran), and the dvm rows come from the SAME
+    daemon (resident across reps by construction)."""
+    import io
+    import subprocess
+    import sys
+    import tempfile
+
+    from zhpe_ompi_tpu.runtime import dvm as dvm_mod
+    from zhpe_ompi_tpu.runtime import spc
+    from zhpe_ompi_tpu.tools import mpirun
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    prog = tempfile.NamedTemporaryFile(
+        "w", suffix="_launch_probe.py", delete=False)
+    prog.write(
+        f"import sys\nsys.path.insert(0, {repo!r})\n"
+        "import zhpe_ompi_tpu as zmpi\n"
+        "p = zmpi.host_init()\np.barrier()\nzmpi.host_finalize()\n"
+    )
+    prog.close()
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    rows = []
+
+    def record(mode, times):
+        rows.append({
+            "op": "launch", "mode": mode, "nprocs": nprocs, "reps": reps,
+            "best_ms": min(times) * 1e3,
+            "median_ms": sorted(times)[len(times) // 2] * 1e3,
+        })
+
+    try:
+        times = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            res = subprocess.run(
+                [sys.executable, "-m", "zhpe_ompi_tpu.tools.mpirun",
+                 "-n", str(nprocs), "--no-tag-output", prog.name],
+                env=env, capture_output=True, text=True, timeout=120,
+            )
+            times.append(time.perf_counter() - t0)
+            assert res.returncode == 0, res.stderr
+        record("cold zmpirun (launcher proc)", times)
+
+        times = []
+        for _ in range(reps):
+            out, err = io.StringIO(), io.StringIO()
+            t0 = time.perf_counter()
+            rc = mpirun.launch(nprocs, [prog.name], timeout=120.0,
+                               tag_output=False, stdout=out, stderr=err)
+            times.append(time.perf_counter() - t0)
+            assert rc == 0, err.getvalue()
+        record("cold launch() (in-process)", times)
+
+        d = dvm_mod.Dvm()
+        try:
+            cli = dvm_mod.DvmClient(d.address)
+            jobs0 = spc.read("dvm_jobs_launched")
+            puts0 = spc.read("pmix_puts")
+            fences0 = spc.read("pmix_fences")
+            times = []
+            for _ in range(reps):
+                out, err = io.StringIO(), io.StringIO()
+                t0 = time.perf_counter()
+                rc = cli.launch(nprocs, [prog.name], timeout=120.0,
+                                tag_output=False, stdout=out, stderr=err)
+                times.append(time.perf_counter() - t0)
+                assert rc == 0, err.getvalue()
+            # the gates: every rep really launched into the resident VM
+            # and really modexed through the store
+            launched = spc.read("dvm_jobs_launched") - jobs0
+            assert launched == reps, (launched, reps)
+            assert spc.read("pmix_puts") - puts0 >= nprocs * reps
+            assert spc.read("pmix_fences") - fences0 >= reps
+            record("dvm (resident zprted)", times)
+            cli.close()
+        finally:
+            d.stop()
+    finally:
+        try:
+            os.unlink(prog.name)
+        except OSError:
+            pass
+    return rows
+
+
+def _print_launch_table(rows: list[dict]) -> None:
+    print(f"# launch latency ({rows[0]['nprocs']} ranks, "
+          f"best/median of {rows[0]['reps']})")
+    print(f"{'Mode':<34} {'Best (ms)':>12} {'Median (ms)':>12}")
+    for r in rows:
+        print(f"{r['mode']:<34} {r['best_ms']:>12.1f} "
+              f"{r['median_ms']:>12.1f}")
+
+
 def _print_table(rows: list[dict]) -> None:
     if not rows:
         return
@@ -987,11 +1105,24 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--real-procs", action="store_true",
                    help="--plane sm: ranks as separate OS processes "
                         "(the cross-process case; threads share a GIL)")
+    p.add_argument("--launch", action="store_true",
+                   help="launch-latency ladder: cold zmpirun (launcher "
+                        "proc / in-process) vs a resident zprted DVM, "
+                        "counter-gated (runtime plane)")
     p.add_argument("--_worker", default=None, help=argparse.SUPPRESS)
     args = p.parse_args(argv)
 
     if args._worker is not None:
         return _worker_main(json.loads(args._worker))
+    if args.launch:
+        rows = bench_launch(nprocs=min(args.nprocs, 4),
+                            reps=max(args.iters, 3))
+        if args.json:
+            for r in rows:
+                print(json.dumps(r))
+        else:
+            _print_launch_table(rows)
+        return 0
     if args.overlap:
         rows = bench_overlap(args.max_size, max(args.iters, 10),
                              window=min(args.window, 16))

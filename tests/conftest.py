@@ -103,6 +103,29 @@ def _ulfm_detector_hygiene():
         f"synchronous min-rank rule; no thread may outlive it): "
         f"{elections}"
     )
+    from zhpe_ompi_tpu.runtime import dvm as dvm_mod
+    from zhpe_ompi_tpu.runtime import pmix as pmix_mod
+
+    daemons = dvm_mod.live_dvms()
+    assert not daemons, (
+        f"in-process runtime daemons left listening past their test's "
+        f"stop(): {daemons}"
+    )
+    zprted = dvm_mod.orphaned_daemon_processes()
+    assert not zprted, (
+        f"zprted daemon processes orphaned past the suite (every test "
+        f"that spawns one owns its stop/kill): {zprted}"
+    )
+    servers = pmix_mod.live_servers()
+    assert not servers, (
+        f"PMIx servers left listening past their owner's close(): "
+        f"{servers}"
+    )
+    stale_ns = pmix_mod.stale_namespaces()
+    assert not stale_ns, (
+        f"stale PMIx namespace state left after the suite (the daemon "
+        f"destroys a job's namespace when the job ends): {stale_ns}"
+    )
 
 
 @pytest.fixture(autouse=True)

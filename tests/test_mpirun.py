@@ -285,3 +285,201 @@ def test_zero_train_example():
     )
     assert rc == 0, err
     assert out.count("PASSED") == 2
+
+
+def test_signal_hygiene_sigterm(tmp_path):
+    """zmpirun signal hygiene: SIGTERM to the launcher is forwarded to
+    the job, every child is reaped, the rendezvous port is released,
+    and the launcher exits 128+sig — a Ctrl-C must not orphan ranks
+    still holding sockets and /dev/shm rings."""
+    import signal
+    import subprocess
+    import time
+
+    pid_dir = tmp_path / "pids"
+    pid_dir.mkdir()
+    prog = _script(tmp_path, f"""
+        import os, time
+        open(os.path.join({str(pid_dir)!r}, str(os.getpid())), "w").close()
+        time.sleep(600)
+    """)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    p = subprocess.Popen(
+        [sys.executable, "-m", "zhpe_ompi_tpu.tools.mpirun",
+         "-n", "2", prog],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        text=True,
+    )
+    try:
+        deadline = time.monotonic() + 60.0
+        while len(list(pid_dir.iterdir())) < 2:
+            assert time.monotonic() < deadline, "ranks never started"
+            assert p.poll() is None, p.communicate()
+            time.sleep(0.05)
+        p.send_signal(signal.SIGTERM)
+        rc = p.wait(timeout=30.0)
+    finally:
+        if p.poll() is None:
+            p.kill()
+            p.wait()
+    assert rc == 128 + signal.SIGTERM, p.communicate()
+    # children reaped: no rank process may survive the launcher
+    deadline = time.monotonic() + 10.0
+    pids = [int(f.name) for f in pid_dir.iterdir()]
+    while time.monotonic() < deadline:
+        alive = []
+        for pid in pids:
+            try:
+                os.kill(pid, 0)
+                alive.append(pid)
+            except ProcessLookupError:
+                pass
+        if not alive:
+            break
+        time.sleep(0.1)
+    assert not alive, f"orphaned rank processes: {alive}"
+
+
+class TestDvm:
+    """Runtime-plane daemon (zprted) lifecycle matrix: a resident VM
+    hosts the PMIx store across jobs, launches sequential jobs into
+    itself, stops clean, and rides over a just-stopped predecessor's
+    port (stale-socket retry)."""
+
+    def _mod(self):
+        from zhpe_ompi_tpu.runtime import dvm as dvm_mod
+        return dvm_mod
+
+    def _prog(self, tmp_path):
+        return _script(tmp_path, """
+            import zhpe_ompi_tpu as zmpi
+
+            proc = zmpi.host_init()
+            vals = proc.allgather(proc.rank + 1)
+            assert vals == [1, 2], vals
+            print(f"rank {proc.rank} OK")
+            zmpi.host_finalize()
+        """)
+
+    def test_two_sequential_jobs_one_dvm(self, tmp_path):
+        """Start → launch two jobs into ONE resident VM → stop: the
+        store outlives each job (namespace destroyed at job end), the
+        daemon outlives both."""
+        from zhpe_ompi_tpu.runtime import pmix as pmix_mod
+        from zhpe_ompi_tpu.runtime import spc
+
+        dvm_mod = self._mod()
+        prog = self._prog(tmp_path)
+        jobs0 = spc.read("dvm_jobs_launched")
+        d = dvm_mod.Dvm()
+        try:
+            cli = dvm_mod.DvmClient(d.address)
+            assert cli.ping()
+            out, err = io.StringIO(), io.StringIO()
+            rc1 = cli.launch(2, [prog], timeout=90.0, stdout=out,
+                             stderr=err)
+            job1 = cli.last_job_id
+            rc2 = cli.launch(2, [prog], timeout=90.0, stdout=out,
+                             stderr=err)
+            assert (rc1, rc2) == (0, 0), err.getvalue()
+            assert cli.last_job_id != job1  # a NEW job, same VM
+            assert out.getvalue().count("OK") == 4
+            stat = cli.stat()
+            assert stat["dvm_jobs_launched"] - jobs0 == 2
+            # per-job namespaces were destroyed when the jobs ended
+            assert stat["pmix"] == {}
+            cli.close()
+        finally:
+            d.stop()
+        assert dvm_mod.live_dvms() == []
+        assert pmix_mod.live_servers() == []
+        assert pmix_mod.stale_namespaces() == []
+
+    def test_abort_semantics_in_dvm_job(self, tmp_path):
+        """A non-ft daemon job keeps the zmpirun MPI_Abort contract:
+        one rank exits nonzero, the daemon kills the rest and the job
+        surfaces the failing code."""
+        dvm_mod = self._mod()
+        prog = _script(tmp_path, """
+            import sys, time
+            import zhpe_ompi_tpu as zmpi
+
+            proc = zmpi.host_init()
+            if proc.rank == 1:
+                sys.exit(7)
+            time.sleep(600)
+        """)
+        d = dvm_mod.Dvm()
+        try:
+            cli = dvm_mod.DvmClient(d.address)
+            out, err = io.StringIO(), io.StringIO()
+            rc = cli.launch(3, [prog], timeout=90.0, stdout=out,
+                            stderr=err)
+            assert rc == 7
+            assert "rank 1 exited with code 7" in err.getvalue()
+            cli.close()
+        finally:
+            d.stop()
+
+    def test_stop_then_rebind_same_ports(self):
+        """Stale-socket retry: a daemon restarted onto the ports of a
+        JUST-stopped predecessor must bind over the TIME_WAIT corpses
+        (SO_REUSEADDR on both listeners)."""
+        dvm_mod = self._mod()
+        d1 = dvm_mod.Dvm()
+        port, pmix_port = d1.address[1], d1.pmix.address[1]
+        cli = dvm_mod.DvmClient(d1.address)
+        assert cli.ping()
+        assert cli.stop() is True  # stop via RPC, not object call
+        cli.close()
+        assert d1.wait(10.0)
+        d2 = dvm_mod.Dvm(port=port, pmix_port=pmix_port)
+        try:
+            cli2 = dvm_mod.DvmClient(d2.address)
+            assert cli2.ping()
+            cli2.close()
+        finally:
+            d2.stop()
+        assert dvm_mod.live_dvms() == []
+
+    def test_zprted_subprocess_and_dvm_cli(self, tmp_path):
+        """The real daemon shape: zprted as its OWN process (python -m
+        zhpe_ompi_tpu.runtime.dvm), a job launched into it through the
+        zmpirun --dvm CLI path, orderly stop, clean exit."""
+        import subprocess
+
+        dvm_mod = self._mod()
+        prog = self._prog(tmp_path)
+        env = dict(os.environ)
+        env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+        daemon = subprocess.Popen(
+            [sys.executable, "-m", "zhpe_ompi_tpu.runtime.dvm"],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True,
+        )
+        try:
+            # bounded ready-line read: a daemon that dies before
+            # printing must fail THIS test, not hang the suite
+            import select
+
+            r, _, _ = select.select([daemon.stdout], [], [], 60.0)
+            assert r, "zprted never printed its ready line"
+            ready = daemon.stdout.readline()
+            assert ready.startswith("zprted ready"), (
+                ready, daemon.stderr.read() if daemon.poll() else "")
+            addr = ready.split("dvm=")[1].split()[0]
+            out, err = io.StringIO(), io.StringIO()
+            rc = mpirun.launch_dvm(addr, 2, [prog], timeout=90.0,
+                                   stdout=out, stderr=err)
+            assert rc == 0, err.getvalue()
+            assert out.getvalue().count("OK") == 2
+            cli = dvm_mod.DvmClient(addr)
+            cli.stop()
+            cli.close()
+            assert daemon.wait(timeout=15.0) == 0
+        finally:
+            if daemon.poll() is None:
+                daemon.kill()
+                daemon.wait()
+        assert dvm_mod.orphaned_daemon_processes() == []

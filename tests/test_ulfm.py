@@ -1659,3 +1659,349 @@ class TestKillWithInflightIsend:
 
         res = run_tcp_ft(2, prog, sm=False)
         assert res[0] is True
+
+
+class TestBatchedRespawn:
+    """ROADMAP multi-failure recovery: N victims recovered in ONE
+    agree → shrink → rollback → batched-respawn pass
+    (``recovery.respawn_victims``), and a failure DURING recovery
+    re-enters the pipeline at agree instead of stranding survivors."""
+
+    def test_two_victims_one_pass(self):
+        n = 5
+        uni = LocalUniverse(n, ft=True)
+        plan = FaultPlan(seed=17).kill_ranks([1, 3], after_ops=1,
+                                             respawn=True)
+        assert plan.respawn_victims == frozenset({1, 3})
+        handles: dict = {}
+
+        def second_life(new_ctx):
+            # the batch contract: the full-size collective starts only
+            # once EVERY victim of the window has its slot restored
+            for v in (1, 3):
+                assert new_ctx.ft_state.wait_restored(v, timeout=20.0)
+            total = new_ctx.allreduce(np.float64(new_ctx.rank), ops.SUM)
+            return float(total)
+
+        def prog(ctx):
+            ctx.set_errhandler(errh.ERRORS_RETURN)
+            inj = plan.arm(ctx)
+            try:
+                inj.send(ctx.rank, dest=(ctx.rank + 1) % n, tag=1)
+                inj.recv(source=(ctx.rank - 1) % n, tag=1, timeout=10.0)
+            except errors.ProcFailed:
+                pass  # discovery-at-send is as valid an entry as at-recv
+            for v in (1, 3):
+                assert ctx.ft_state.wait_failed(v, timeout=10.0)
+
+            def respawner(victims):
+                # ONE batch: both replacements join the same window
+                assert victims == [1, 3]
+                handles.update(
+                    recovery.respawn_ranks(uni, victims, second_life))
+
+            shrunk, victims = recovery.respawn_victims(ctx, respawner)
+            assert victims == [1, 3]
+            assert shrunk.size == n - 2
+            for v in victims:
+                assert recovery.await_rejoin(ctx, v, timeout=20.0)
+            total = ctx.allreduce(np.float64(ctx.rank), ops.SUM)
+            return float(total)
+
+        res = uni.run(prog, timeout=60.0)
+        expect = float(sum(range(n)))  # 10.0: full membership again
+        assert res[1] is None and res[3] is None  # first lives killed
+        for r in (0, 2, 4):
+            assert res[r] == expect
+        assert sorted(handles) == [1, 3]
+        for v in (1, 3):
+            assert handles[v].result(timeout=30.0) == expect
+        assert uni.ft_state.failed() == frozenset()
+
+    def test_failure_during_recovery_reenters_at_agree(self):
+        n = 4
+        uni = LocalUniverse(n, ft=True)
+        # rank 2 dies first; rank 3 dies DURING the recovery pass
+        plan = FaultPlan(seed=19).kill_then_respawn(2, after_ops=1)
+        handles: dict = {}
+        late_killed = threading.Event()
+
+        def second_life(new_ctx):
+            for v in (2, 3):
+                assert new_ctx.ft_state.wait_restored(v, timeout=20.0)
+            total = new_ctx.allreduce(np.float64(new_ctx.rank), ops.SUM)
+            return float(total)
+
+        def prog(ctx):
+            ctx.set_errhandler(errh.ERRORS_RETURN)
+            inj = plan.arm(ctx)
+            try:
+                inj.send(ctx.rank, dest=(ctx.rank + 1) % n, tag=1)
+                inj.recv(source=(ctx.rank - 1) % n, tag=1, timeout=10.0)
+            except errors.ProcFailed:
+                pass
+            assert ctx.ft_state.wait_failed(2, timeout=10.0)
+            passes = [0]
+
+            def rollback_fn(shrunk):
+                passes[0] += 1
+                if ctx.rank == 3 and passes[0] == 1:
+                    # a survivor dies mid-rollback: kill -9 shape (no
+                    # goodbye; the board detector classifies it)
+                    ulfm.expect_failure(ctx.ft_state, 3)
+                    late_killed.set()
+                    raise ulfm.RankKilled(3)
+                # the survivor barrier every pass runs: with rank 3
+                # dead mid-pass-1, this surfaces typed ProcFailed and
+                # respawn_victims re-enters at agree
+                shrunk.barrier()
+
+            def respawner(victims):
+                handles.update(
+                    recovery.respawn_ranks(uni, victims, second_life))
+
+            shrunk, victims = recovery.respawn_victims(
+                ctx, respawner, rollback_fn=rollback_fn)
+            # the re-entered pass absorbed BOTH corpses into one window
+            assert victims == [2, 3]
+            assert shrunk.size == 2
+            assert passes[0] >= 2  # really re-entered at agree
+            for v in victims:
+                assert recovery.await_rejoin(ctx, v, timeout=20.0)
+            total = ctx.allreduce(np.float64(ctx.rank), ops.SUM)
+            return float(total)
+
+        res = uni.run(prog, timeout=60.0)
+        expect = float(sum(range(n)))  # 6.0
+        assert res[2] is None and res[3] is None
+        assert res[0] == expect and res[1] == expect
+        assert late_killed.is_set()
+        assert sorted(handles) == [2, 3]
+        for v in (2, 3):
+            assert handles[v].result(timeout=30.0) == expect
+        assert uni.ft_state.failed() == frozenset()
+
+
+_DVM_RECOVERY_PROG = '''
+import os, signal, sys, time
+sys.path.insert(0, {repo!r})
+import numpy as np
+import zhpe_ompi_tpu as zmpi
+from zhpe_ompi_tpu import ops
+from zhpe_ompi_tpu.core import errhandler as errh
+from zhpe_ompi_tpu.ft import recovery
+from zhpe_ompi_tpu.runtime import spc
+from zhpe_ompi_tpu.runtime.checkpoint import Checkpointer
+
+VICTIM = int(os.environ["TEST_VICTIM"])
+CKPT = os.environ["TEST_CKPT"]
+
+proc = zmpi.host_init()
+proc.set_errhandler(errh.ERRORS_RETURN)
+ck = Checkpointer(os.path.join(CKPT, f"r{{proc.rank}}"),
+                  check_quiescent=False)
+
+if os.environ.get("ZMPI_REJOIN") == "1":
+    # second life: restore from the snapshot, join the full-size op
+    state, step = recovery.rollback(ck)
+    assert step == 1 and state["x"] == float(proc.rank)
+    total = proc.allreduce(np.float64(state["x"]), ops.SUM)
+    print(f"REJOIN-OK rank={{proc.rank}} total={{float(np.asarray(total))}}",
+          flush=True)
+    zmpi.host_finalize()
+    sys.exit(0)
+
+ck.save(1, {{"x": float(proc.rank)}}, blocking=True)
+proc.barrier()  # checkpoint published before anyone dies
+t0 = time.monotonic()
+if proc.rank == VICTIM:
+    os.kill(os.getpid(), signal.SIGKILL)  # kill -9: no cleanup, no goodbye
+
+# the daemon's waitpid event must classify the corpse long before the
+# (deliberately huge) heartbeat window could
+assert proc.ft_state.wait_failed(VICTIM, timeout=10.0), "never classified"
+latency = time.monotonic() - t0
+cause = proc.ft_state.cause_of(VICTIM)
+
+def rollback_fn(shrunk):
+    state, step = recovery.rollback(ck)
+    assert step == 1 and state["x"] == float(proc.rank)
+
+shrunk, victims = recovery.respawn_victims(
+    proc, recovery.daemon_respawn, rollback_fn=rollback_fn)
+assert victims == [VICTIM], victims
+assert recovery.await_rejoin(proc, VICTIM, timeout=30.0), "no rejoin"
+total = proc.allreduce(np.float64(proc.rank), ops.SUM)
+# read AFTER recovery: the drain thread records the event counter just
+# after mark_failed wakes wait_failed — reading at wake time races it
+events = spc.read("dvm_fault_events")
+print(f"SURVIVOR-OK rank={{proc.rank}} cause={{cause}} "
+      f"latency={{latency:.3f}} events={{events}} "
+      f"total={{float(np.asarray(total))}}", flush=True)
+zmpi.host_finalize()
+'''
+
+
+class TestDvmRealProcessRecovery:
+    """The real-process acceptance path (ROADMAP "respawn over REAL
+    processes"): a daemon-hosted 4-rank job survives kill -9 via the
+    zprted authoritative fault event → shrink → rollback → daemon
+    relaunch → FT_JOIN → full-size allreduce — every rank its own OS
+    process, the replacement exec'd by the daemon."""
+
+    def test_kill9_daemon_event_shrink_rollback_respawn(self, tmp_path,
+                                                        monkeypatch):
+        import io
+        import os
+        import re
+
+        from zhpe_ompi_tpu.runtime import dvm as dvm_mod
+        from zhpe_ompi_tpu.runtime import spc
+
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        prog = tmp_path / "recover.py"
+        prog.write_text(_DVM_RECOVERY_PROG.format(repo=repo))
+        victim = 2
+        monkeypatch.setenv("TEST_VICTIM", str(victim))
+        monkeypatch.setenv("TEST_CKPT", str(tmp_path / "ckpt"))
+        before = spc.snapshot()
+        d = dvm_mod.Dvm()
+        try:
+            cli = dvm_mod.DvmClient(d.address)
+            out, err = io.StringIO(), io.StringIO()
+            rc = cli.launch(
+                4, [str(prog)], ft=True, timeout=120.0,
+                # the heartbeat window is deliberately huge: only the
+                # daemon's waitpid truth can classify the death in time
+                mca=[("ft_detector_period", "2.0"),
+                     ("ft_detector_timeout", "60.0")],
+                stdout=out, stderr=err,
+            )
+            text = out.getvalue()
+            assert rc == 0, (text, err.getvalue())
+            survivors = re.findall(
+                r"SURVIVOR-OK rank=(\d+) cause=(\w+) latency=([\d.]+) "
+                r"events=(\d+) total=([\d.]+)", text)
+            assert len(survivors) == 3, text
+            for rank, cause, latency, events, total in survivors:
+                assert int(rank) != victim
+                # OS truth, not suspicion — and faster than any
+                # heartbeat timeout could be
+                assert cause == "daemon"
+                assert float(latency) < 1.5
+                assert int(events) >= 1
+                assert float(total) == 6.0
+            rejoin = re.findall(r"REJOIN-OK rank=(\d+) total=([\d.]+)",
+                                text)
+            assert rejoin == [(str(victim), "6.0")], text
+            stat = cli.stat()
+            assert stat["dvm_fault_events"] - before.get(
+                "dvm_fault_events", 0) == 1
+            assert stat["dvm_respawns"] - before.get(
+                "dvm_respawns", 0) == 1
+            assert stat["pmix"] == {}  # namespace destroyed at job end
+            cli.stop()
+            cli.close()
+        finally:
+            d.stop()
+        assert dvm_mod.live_dvms() == []
+        assert dvm_mod.orphaned_daemon_processes() == []
+
+
+_DVM_MULTI_VICTIM_PROG = '''
+import os, signal, sys, time
+sys.path.insert(0, {repo!r})
+import numpy as np
+import zhpe_ompi_tpu as zmpi
+from zhpe_ompi_tpu import ops
+from zhpe_ompi_tpu.core import errhandler as errh
+from zhpe_ompi_tpu.ft import recovery
+
+VICTIMS = sorted(int(x) for x in os.environ["TEST_VICTIMS"].split(","))
+
+proc = zmpi.host_init()
+proc.set_errhandler(errh.ERRORS_RETURN)
+
+if os.environ.get("ZMPI_REJOIN") == "1":
+    # fellow replacements of ONE recovery window: each read the other's
+    # card at the window's bumped generation, so this full-size
+    # collective dials fresh endpoints, not the corpses'
+    total = proc.allreduce(np.float64(proc.rank), ops.SUM)
+    print(f"REJOIN-OK rank={{proc.rank}} "
+          f"total={{float(np.asarray(total))}}", flush=True)
+    zmpi.host_finalize()
+    sys.exit(0)
+
+proc.barrier()
+if proc.rank in VICTIMS:
+    os.kill(os.getpid(), signal.SIGKILL)
+for v in VICTIMS:
+    assert proc.ft_state.wait_failed(v, timeout=10.0), f"victim {{v}}?"
+shrunk, victims = recovery.respawn_victims(proc, recovery.daemon_respawn)
+assert victims == VICTIMS, (victims, VICTIMS)
+for v in VICTIMS:
+    assert recovery.await_rejoin(proc, v, timeout=30.0), f"no rejoin {{v}}"
+total = proc.allreduce(np.float64(proc.rank), ops.SUM)
+print(f"SURVIVOR-OK rank={{proc.rank}} "
+      f"total={{float(np.asarray(total))}}", flush=True)
+zmpi.host_finalize()
+'''
+
+
+class TestDvmMultiVictimRecovery:
+    """Batched real-process recovery: TWO ranks of a daemon-hosted
+    4-rank job die (kill -9), survivors recover both in ONE
+    agree → shrink → daemon-respawn pass, and the two replacements
+    resolve EACH OTHER through the recovery window's bumped PMIx
+    generation (a plain get would hand each the other corpse's card
+    and strand the rejoin — the stale-card regression)."""
+
+    def test_two_victims_one_daemon_window(self, monkeypatch):
+        import io
+        import os
+        import re
+
+        from zhpe_ompi_tpu.runtime import dvm as dvm_mod
+        from zhpe_ompi_tpu.runtime import spc
+
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        import tempfile
+
+        with tempfile.TemporaryDirectory() as td:
+            prog = os.path.join(td, "recover2.py")
+            with open(prog, "w") as f:
+                f.write(_DVM_MULTI_VICTIM_PROG.format(repo=repo))
+            monkeypatch.setenv("TEST_VICTIMS", "1,2")
+            before = spc.snapshot()
+            d = dvm_mod.Dvm()
+            try:
+                cli = dvm_mod.DvmClient(d.address)
+                out, err = io.StringIO(), io.StringIO()
+                rc = cli.launch(
+                    4, [prog], ft=True, timeout=120.0,
+                    mca=[("ft_detector_period", "2.0"),
+                         ("ft_detector_timeout", "60.0")],
+                    stdout=out, stderr=err,
+                )
+                text = out.getvalue()
+                assert rc == 0, (text, err.getvalue())
+                totals = re.findall(
+                    r"(SURVIVOR|REJOIN)-OK rank=(\d+) total=([\d.]+)",
+                    text)
+                assert len(totals) == 4, text
+                assert sorted(r for k, r, _ in totals
+                              if k == "REJOIN") == ["1", "2"]
+                assert all(t == "6.0" for _, _, t in totals), text
+                stat = cli.stat()
+                # one batch: TWO respawns, TWO fault events, and the
+                # namespace generation machinery cleaned up with the job
+                assert stat["dvm_respawns"] - before.get(
+                    "dvm_respawns", 0) == 2
+                assert stat["dvm_fault_events"] - before.get(
+                    "dvm_fault_events", 0) == 2
+                assert stat["pmix"] == {}
+                cli.stop()
+                cli.close()
+            finally:
+                d.stop()
+        assert dvm_mod.live_dvms() == []

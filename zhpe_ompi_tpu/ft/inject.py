@@ -70,6 +70,19 @@ class FaultPlan:
         ops = self._rng.randint(1, max_ops)
         return self.kill_rank(rank, ops, mode)
 
+    def kill_ranks(self, ranks, after_ops: int, mode: str = "exit",
+                   respawn: bool = False) -> "FaultPlan":
+        """Schedule N victims at once — the multi-failure plan the
+        batched recovery pipeline (:func:`~zhpe_ompi_tpu.ft.recovery.
+        respawn_victims`) recovers in ONE agree → shrink → respawn
+        pass.  ``respawn=True`` marks every victim for respawn."""
+        for r in ranks:
+            if respawn:
+                self.kill_then_respawn(int(r), after_ops, mode)
+            else:
+                self.kill_rank(int(r), after_ops, mode)
+        return self
+
     def kill_then_respawn(self, rank: int, after_ops: int,
                           mode: str = "exit") -> "FaultPlan":
         """Schedule a kill AND mark the victim for respawn: the recovery

@@ -165,6 +165,105 @@ def spawn_replacement(fn: Callable[[], Any], rank: int | None = None,
     return handle
 
 
+def daemon_respawn(ranks, dvm: str | tuple | None = None,
+                   job: str | None = None,
+                   timeout: float = 30.0) -> list[int]:
+    """Step 5 over REAL OS processes: ask the resident runtime daemon
+    (``zprted``, :mod:`zhpe_ompi_tpu.runtime.dvm`) to exec fresh
+    replacements for ``ranks``.  ONE RPC carries the whole batch — the
+    daemon bumps the job's PMIx generation once, so every replacement
+    of this recovery window publishes its fresh card under the same tag
+    and FT_JOINs the same name-served job.  Inside a daemon-hosted rank
+    the daemon address and job id come from the ``ZMPI_DVM``/``ZMPI_JOB``
+    environment the daemon exported at launch; callers outside the job
+    (a controller) pass them explicitly.  Returns the replacement pids.
+    """
+    from ..runtime.dvm import DvmClient
+
+    dvm = dvm if dvm is not None else os.environ.get("ZMPI_DVM")
+    job = job if job is not None else os.environ.get("ZMPI_JOB")
+    if dvm is None or job is None:
+        raise errors.UnsupportedError(
+            "daemon_respawn needs a resident daemon: run the job under "
+            "zmpirun --dvm (ZMPI_DVM/ZMPI_JOB exported) or pass "
+            "dvm=(host, port) and job explicitly"
+        )
+    client = DvmClient(dvm, timeout=timeout)
+    try:
+        return client.respawn(job, sorted(int(r) for r in ranks),
+                              timeout=timeout)
+    finally:
+        client.close()
+
+
+def respawn_victims(ep, respawner: Callable[[list[int]], Any],
+                    rollback_fn: Callable[[Any], Any] | None = None,
+                    timeout: float = 30.0, max_reentries: int = 4):
+    """The batched multi-failure pipeline: ONE failed-set agreement
+    (inside ``ep.shrink(consensus=True)``) covers EVERY victim, then
+    rollback, then N respawns into the same generation window — instead
+    of one victim per pass.  A failure DURING recovery (a survivor
+    dying mid-shrink or mid-rollback surfaces as typed
+    ``ProcFailed``/``ProcFailedPending`` out of the shrunken
+    collectives) re-enters the pipeline at agree: the next pass's
+    agreement absorbs the new corpse into the same recovery.
+
+    Every survivor calls this collectively.  ``respawner(victims)`` is
+    invoked on the LOWEST survivor only — pass
+    ``recovery.daemon_respawn`` for daemon-hosted real processes, or a
+    thread-plane loop over :func:`respawn_rank`.  ``rollback_fn(shrunk)``
+    (optional) runs the checkpoint rollback over the shrunken survivor
+    endpoint before the respawns.  Returns ``(shrunk, victims)``; the
+    caller still awaits the rejoins it cares about
+    (:func:`await_rejoin`) before full-size traffic.
+    """
+    state = getattr(ep, "ft_state", None)
+    if state is None:
+        raise errors.UnsupportedError(
+            "respawn_victims needs fault tolerance enabled (ft=True)"
+        )
+    last: BaseException | None = None
+    for _ in range(max_reentries):
+        try:
+            ep.failure_ack()
+            shrunk = ep.shrink()  # consensus: one agree covers the batch
+            # crashes are respawned; orderly goodbyes are not failures
+            victims = sorted(
+                r for r in range(ep.size)
+                if r not in shrunk._map
+                and state.cause_of(r) != "goodbye"
+            )
+            if rollback_fn is not None:
+                rollback_fn(shrunk)
+            # survivor barrier BEFORE regrowth: every survivor must have
+            # finished adopting the agreed failed set (and rolling back)
+            # before any replacement's record is cleared — a slow
+            # survivor's adoption landing after the restore would
+            # re-mark the fresh rank failed and strand the recovery
+            shrunk.barrier()
+            if victims and shrunk.rank == 0:
+                respawner(victims)
+            return shrunk, victims
+        except (errors.ProcFailed, errors.ProcFailedPending) as e:
+            # a survivor died mid-recovery: re-enter at agree — the
+            # next shrink's failed-set agreement absorbs the new corpse
+            last = e
+            continue
+    raise last  # noqa: B904 - the last re-entry's typed failure
+
+
+def respawn_ranks(uni, ranks, fn: Callable[[Any], Any],
+                  name: str | None = None) -> dict[int, RespawnHandle]:
+    """Thread-plane batch respawner: one :func:`respawn_rank` per
+    victim, all into the universe's existing slots — the shape
+    ``respawn_victims`` wants for its ``respawner`` argument on the
+    thread plane."""
+    return {
+        int(r): respawn_rank(uni, int(r), fn, name=name)
+        for r in sorted(int(r) for r in ranks)
+    }
+
+
 def respawn_rank(uni, rank: int, fn: Callable[[Any], Any],
                  name: str | None = None) -> RespawnHandle:
     """Step 5 on the thread plane: put a FRESH context into the dead

@@ -71,6 +71,9 @@ FT_AGREE_CID = 0x7FF3   # agreement rounds
 FT_AGREE_PUB_CID = 0x7FF2  # completed-agreement result announcements
 FT_BYE_CID = 0x7FF1     # orderly-departure goodbyes (close(), not death)
 FT_JOIN_CID = 0x7FF0    # rejoin/re-modex frames (respawned-rank JOIN + ACK)
+FT_DVM_CID = 0x7FEF     # authoritative daemon fault events (zprted waitpid
+#                         truth: the DVM watched the corpse exit; payload is
+#                         [[rank, exit_code], ...] — OS evidence, no timeout)
 _AGREE_TAG = 0x7D00
 
 # Shrunken communicators get a generation-isolated cid window so

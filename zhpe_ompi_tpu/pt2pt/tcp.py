@@ -432,13 +432,42 @@ class TcpProc(errh.HasErrhandler, ulfm.UlfmEndpointAPI, HostCollectives,
                  ft: bool = False,
                  rejoin_book: list | None = None,
                  sm: bool | None = None,
-                 sm_boot_id: str | None = None):
+                 sm_boot_id: str | None = None,
+                 pmix: "tuple[str, int] | str | None" = None,
+                 namespace: str = "default",
+                 rejoin: bool = False,
+                 rejoin_gen: int = 0,
+                 rejoin_ranks: "list[int] | None" = None):
         if size < 1:
             raise errors.ArgError("size must be >= 1")
-        if rejoin_book is not None and not ft:
+        if (rejoin_book is not None or rejoin) and not ft:
             raise errors.ArgError(
                 "rejoin_book (respawn into an existing job) requires ft=True"
             )
+        if rejoin and pmix is None:
+            raise errors.ArgError(
+                "rejoin=True re-modexes through the name-served PMIx "
+                "store: pass pmix=(host, port) (the ZMPI_PMIX contract)"
+            )
+        # PMIx-served wire-up (the runtime-plane store of runtime/pmix.py):
+        # the modex rides put/commit/fence/get verbs against a resident
+        # server instead of the per-job rendezvous coordinator, and a
+        # respawned rank (rejoin=True) fetches the name-served address
+        # book from the same store — no in-process survivor handoff.
+        if isinstance(pmix, str):
+            pmix_host, pmix_port = pmix.rsplit(":", 1)
+            pmix = (pmix_host, int(pmix_port))
+        self._pmix_addr: tuple[str, int] | None = \
+            (pmix[0], int(pmix[1])) if pmix is not None else None
+        self._pmix_ns = str(namespace)
+        # batched-recovery window metadata (ZMPI_REJOIN_GEN/_RANKS): the
+        # ranks respawned ALONGSIDE us this window, whose store cards we
+        # must read at the window's bumped generation — the corpse's
+        # generation-old card would satisfy a plain get and strand both
+        # replacements dialing each other's dead addresses
+        self._rejoin_gen = int(rejoin_gen)
+        self._rejoin_ranks = frozenset(
+            int(r) for r in (rejoin_ranks or ()))
         self.rank = rank
         self.size = size
         # ULFM state precedes the accept loop: drain threads consult it
@@ -495,7 +524,7 @@ class TcpProc(errh.HasErrhandler, ulfm.UlfmEndpointAPI, HostCollectives,
         self._sm_lock = threading.Lock()
         self._sm_boot = sm_boot_id or sm_mod.boot_token()
         sm_on = bool(int(mca_var.get("sm", 1))) if sm is None else bool(sm)
-        if sm_on and size > 1 and rejoin_book is None:
+        if sm_on and size > 1 and rejoin_book is None and not rejoin:
             try:
                 self._sm_seg = sm_mod.SmSegment(
                     rank, size, on_frame=self._sm_incoming
@@ -535,6 +564,14 @@ class TcpProc(errh.HasErrhandler, ulfm.UlfmEndpointAPI, HostCollectives,
             # PRRTE-hosts-the-PMIx-server shape) — rank 0 joins as a client
             # instead of binding the coordinator address itself
             self._external_coordinator = external_coordinator
+            if rejoin and rejoin_book is None:
+                # name-served rejoin: the survivors' cards live in the
+                # job's PMIx namespace — fetch the book from the store
+                # and publish OUR fresh endpoint (generation-tagged: the
+                # daemon bumped the namespace generation when it opened
+                # this recovery window, so the new card is provably not
+                # the corpse's)
+                rejoin_book = self._pmix_rejoin_book(timeout)
             if rejoin_book is not None:
                 # respawned rank: no modex rendezvous exists anymore —
                 # adopt the survivors' address book with OUR fresh
@@ -546,6 +583,8 @@ class TcpProc(errh.HasErrhandler, ulfm.UlfmEndpointAPI, HostCollectives,
                 self._peer_cards = [list(a[:2]) for a in rejoin_book]
                 self.address_book = [tuple(a[:2]) for a in rejoin_book]
                 self.address_book[rank] = tuple(self.address)
+            elif self._pmix_addr is not None:
+                self.address_book = self._modex_pmix(timeout)
             else:
                 self.address_book = self._modex(coordinator, timeout)
             mca_output.verbose(
@@ -715,7 +754,7 @@ class TcpProc(errh.HasErrhandler, ulfm.UlfmEndpointAPI, HostCollectives,
             return
         if self.ft_state is not None and cid in (
             ulfm.FT_HB_CID, ulfm.FT_NOTICE_CID, ulfm.FT_REVOKE_CID,
-            ulfm.FT_AGREE_PUB_CID, ulfm.FT_BYE_CID,
+            ulfm.FT_AGREE_PUB_CID, ulfm.FT_BYE_CID, ulfm.FT_DVM_CID,
         ):
             # the FT control family beats over TCP by design, with ONE
             # exception: the orderly-departure BYE of an sm peer rides
@@ -870,6 +909,20 @@ class TcpProc(errh.HasErrhandler, ulfm.UlfmEndpointAPI, HostCollectives,
             # verbatim: agreement values are typed by their protocol
             # (bool for agree(), [pairs, epoch] for agree_failed_set())
             self.ft_state.record_agreement(int(seq), result)
+        elif cid == ulfm.FT_DVM_CID:
+            # authoritative fault event from the runtime daemon (zprted
+            # waitpid-watched the corpse exit): OS truth, not suspicion —
+            # classify immediately, before any heartbeat window expires.
+            # The daemon floods every survivor itself (it holds the
+            # name-served address book), so no onward relay is needed.
+            fresh = 0
+            for entry in payload:
+                r = int(entry[0]) if isinstance(entry, (list, tuple)) \
+                    else int(entry)
+                if self.ft_state.mark_failed(r, cause="daemon"):
+                    fresh += 1
+            if fresh:
+                spc.record("dvm_fault_events", fresh)
         elif cid == ulfm.FT_BYE_CID:
             # relay newly-learned departures onward (gossip-once): the
             # departing rank goodbyes only its CONNECTED peers, so a
@@ -898,7 +951,12 @@ class TcpProc(errh.HasErrhandler, ulfm.UlfmEndpointAPI, HostCollectives,
                          ["join", self.rank, list(self.address)])
         reached = 0
         for r in range(self.size):
-            if r == self.rank:
+            if r == self.rank or r in self._rejoin_ranks:
+                # a fellow replacement of the SAME recovery window needs
+                # no JOIN from us: both sides already hold each other's
+                # FRESH generation-tagged cards from the store, neither
+                # has the other marked failed, and dialing a sibling
+                # still mid-construction would race its wiring
                 continue
             try:
                 sock = self._endpoint(r, deadline=min(2.0, timeout))
@@ -935,6 +993,13 @@ class TcpProc(errh.HasErrhandler, ulfm.UlfmEndpointAPI, HostCollectives,
         survivor's reply, collected by _announce_join."""
         kind = payload[0]
         if kind == "join":
+            if getattr(self, "address_book", None) is None:
+                # a JOIN landing while THIS endpoint is still wiring up
+                # (possible only from another mid-recovery incarnation):
+                # nothing to swap yet — our book comes generation-fresh
+                # from the store, and the joiner's lazy connects still
+                # reach us through the listener
+                return
             jrank = int(payload[1])
             addr = tuple(payload[2][:2])
             with self._conn_lock:
@@ -1074,6 +1139,64 @@ class TcpProc(errh.HasErrhandler, ulfm.UlfmEndpointAPI, HostCollectives,
         if self._sm_seg is not None:
             card.append(self._sm_seg.card(self._sm_boot))
         return card
+
+    def _modex_pmix(self, timeout: float) -> list[tuple[str, int]]:
+        """Business-card exchange through the name-served PMIx store
+        (the PRRTE-hosts-the-PMIx-server shape of runtime/pmix.py):
+        put our card under ``card:<rank>``, commit, fence the
+        namespace, then get every peer's card — get-until-published
+        blocking means no rank ever races a slower peer's publish.
+        A resident DVM hosts the store across jobs, so this path pays
+        no per-job rendezvous infrastructure at all."""
+        from ..runtime import pmix as pmix_mod
+
+        client = pmix_mod.PmixClient(self._pmix_addr, timeout=timeout)
+        try:
+            client.ensure_ns(self._pmix_ns, self.size)
+            client.put(self._pmix_ns, self.rank, f"card:{self.rank}",
+                       self._my_card())
+            client.commit(self._pmix_ns, self.rank)
+            client.fence(self._pmix_ns, self.rank, timeout)
+            book = [client.get(self._pmix_ns, f"card:{r}", timeout)
+                    for r in range(self.size)]
+        except errors.MpiError as e:
+            return self.call_errhandler(errors.InternalError(
+                f"pmix modex via {self._pmix_addr} "
+                f"ns={self._pmix_ns!r}: {e}"
+            ))
+        finally:
+            client.close()
+        self._peer_cards = [list(a) for a in book]
+        return [tuple(a[:2]) for a in book]
+
+    def _pmix_rejoin_book(self, timeout: float) -> list:
+        """The respawned rank's half of the name-served rejoin: publish
+        OUR fresh card FIRST (so co-replacements blocked on this
+        window's generation release), then read the book — survivors'
+        cards plain, but ranks respawned in the SAME recovery window
+        (``rejoin_ranks``) at ``min_generation=rejoin_gen``: a plain
+        get would be satisfied by the corpse's generation-old card and
+        both replacements would dial each other's dead addresses with
+        nothing ever healing the books (JOIN announces to a dead
+        address are skipped, not relayed).  Publish-before-read keeps
+        the batch deadlock-free.  The JOIN announce to the survivors
+        still rides the FT_JOIN wire family unchanged."""
+        from ..runtime import pmix as pmix_mod
+
+        client = pmix_mod.PmixClient(self._pmix_addr, timeout=timeout)
+        try:
+            client.put(self._pmix_ns, self.rank, f"card:{self.rank}",
+                       self._my_card())
+            client.commit(self._pmix_ns, self.rank)
+            book = []
+            for r in range(self.size):
+                min_gen = self._rejoin_gen \
+                    if r != self.rank and r in self._rejoin_ranks else 0
+                book.append(client.get(self._pmix_ns, f"card:{r}",
+                                       timeout, min_generation=min_gen))
+        finally:
+            client.close()
+        return book
 
     def _modex(self, coordinator: tuple[str, int], timeout: float
                ) -> list[tuple[str, int]]:
@@ -1216,7 +1339,7 @@ class TcpProc(errh.HasErrhandler, ulfm.UlfmEndpointAPI, HostCollectives,
                 continue
             if self.ft_state is not None and cid in (
                 ulfm.FT_HB_CID, ulfm.FT_NOTICE_CID, ulfm.FT_REVOKE_CID,
-                ulfm.FT_AGREE_PUB_CID, ulfm.FT_BYE_CID,
+                ulfm.FT_AGREE_PUB_CID, ulfm.FT_BYE_CID, ulfm.FT_DVM_CID,
             ):
                 # ULFM control plane: heartbeats / failure notices /
                 # revoke floods never enter the matching engine

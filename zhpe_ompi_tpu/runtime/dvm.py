@@ -1,0 +1,761 @@
+"""zprted — the persistent runtime daemon (PRRTE/DVM analog).
+
+In the reference, ``mpirun`` is a symlink to the external ``prte`` binary
+(``ompi/tools/mpirun/Makefile.am:11-15``): a *resident* runtime hosts the
+PMIx server, launches jobs into itself, watches its children, and owns
+fault notification — none of which lives in the MPI tree.  This module is
+that daemon IN tree, the elastic-launcher / coordinator-service layer the
+fault-tolerance planes of PRs 1–7 built toward:
+
+- **resident PMIx store** (:mod:`.pmix`): one server outlives every job;
+  ``zmpirun --dvm`` launches a job into the running VM and the ranks
+  modex through the store — no per-job rendezvous coordinator, no name
+  server, no launcher interpreter start-up (the launch-latency win the
+  OSU ``--launch`` ladder gates).
+- **authoritative fault events**: the daemon ``waitpid``-watches every
+  child (one *blocking* ``wait()`` thread per proc — no polling in the
+  hot path) and, the moment a rank of an ft job dies, floods an
+  ``FT_DVM_CID`` control frame to every survivor.  That is OS truth —
+  the corpse's exit status — feeding the same
+  :class:`~zhpe_ompi_tpu.ft.ulfm.FailureState` as the ring heartbeats,
+  marking the rank failed (``cause="daemon"``) before a single detector
+  timeout expires.
+- **relaunch RPC**: :func:`~zhpe_ompi_tpu.ft.recovery.daemon_respawn`
+  asks the daemon to exec a fresh OS process into a dead rank's slot;
+  the replacement FT_JOINs the name-served job (``TcpProc(rejoin=True)``
+  fetches the book from the store), closing the recovery pipeline over
+  real processes end to end.  One respawn RPC may carry N victims — the
+  namespace generation is bumped ONCE, so the whole batch joins the
+  same recovery window.
+
+Wire protocol (control port; length-framed DSS, request/response with
+streaming for ``launch``): requests are ``["launch", spec]``,
+``["respawn", job, ranks]``, ``["pids", job]``, ``["stat"]``,
+``["ping"]``, ``["stop"]``.  A launch streams ``["job", id]``, then
+``["io", rank, label, line]`` / ``["note", text]`` frames, and finally
+``["exit", rc]``.
+
+Job semantics mirror ``zmpirun``: non-ft jobs keep MPI_Abort teardown
+(first nonzero exit kills the rest); ft jobs keep running — death is an
+event for the survivors' recovery pipeline, not a job teardown.
+
+Hygiene is observable: every in-process daemon registers weakly
+(:func:`live_dvms` must be empty once tests stop theirs), daemon
+*processes* are found by cmdline scan (:func:`orphaned_daemon_processes`),
+and a stopping daemon destroys its jobs' namespaces and sweeps their
+``/dev/shm`` artifacts exactly as the ``zmpirun`` session sweep does.
+
+CLI (the ``zprted`` entrypoint)::
+
+    python -m zhpe_ompi_tpu.runtime.dvm [--host H] [--port P] [--pmix-port Q]
+
+prints ``zprted ready dvm=H:P pmix=H:Q`` once both listeners are up, and
+runs until SIGTERM/SIGINT or a ``stop`` RPC.
+"""
+
+from __future__ import annotations
+
+import argparse
+import itertools
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+import weakref
+from typing import Any
+
+from ..core import errors
+from ..mca import output as mca_output
+from ..mca import var as mca_var
+from . import pmix as pmix_mod
+from . import spc
+
+_stream = mca_output.open_stream("dvm")
+
+mca_var.register(
+    "dvm_job_timeout", 600.0,
+    "Default wall-clock deadline (seconds) for a daemon-hosted job "
+    "that did not pass its own timeout: a wedged rank set may not park "
+    "a zprted launch handler forever",
+    type=float,
+)
+
+_TERM_GRACE = 2.0  # seconds between SIGTERM and SIGKILL on teardown
+
+_live_dvms: weakref.WeakSet = weakref.WeakSet()
+
+
+def live_dvms() -> list[str]:
+    """In-process daemons still listening — must be [] once tests stop
+    theirs (a leaked daemon holds two ports and a PMIx store)."""
+    return [
+        f"dvm:{d.address[0]}:{d.address[1]}"
+        for d in list(_live_dvms)
+        if not d.stopped
+    ]
+
+
+def orphaned_daemon_processes() -> list[str]:
+    """zprted processes still alive on this host (cmdline scan) — the
+    session gate's view: no daemon subprocess may outlive the test that
+    spawned it."""
+    out = []
+    try:
+        pids = [p for p in os.listdir("/proc") if p.isdigit()]
+    except OSError:
+        return out  # no /proc: nothing to scan
+    for pid in pids:
+        if int(pid) == os.getpid():
+            continue
+        try:
+            with open(f"/proc/{pid}/cmdline", "rb") as f:
+                args = [a.decode("utf-8", "replace")
+                        for a in f.read().split(b"\0") if a]
+        except OSError:
+            continue  # raced an exit
+        # match ACTUAL daemon invocations only ("python -m
+        # zhpe_ompi_tpu.runtime.dvm ..." or a zprted binary) — a
+        # substring match would flag any shell/pytest line that merely
+        # MENTIONS zprted (e.g. running a test by its name)
+        if any(a == "zhpe_ompi_tpu.runtime.dvm" for a in args) or (
+                args and os.path.basename(args[0]) == "zprted"):
+            out.append(f"pid {pid}: {' '.join(args)}")
+    return out
+
+
+def _pkg_root() -> str:
+    return os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))
+    ))
+
+
+def _sweep_shm(session: str) -> None:
+    """Session-directory cleanup for one session tag (the zmpirun sweep,
+    shared prefix scheme): killed ranks never unlink their rings."""
+    try:
+        for f in os.listdir("/dev/shm"):
+            if f.startswith((f"zompi_ring_{session}_",
+                             f"zompi_shm_{session}_",
+                             f"zompi_pyring_{session}_")):
+                try:
+                    os.unlink(os.path.join("/dev/shm", f))
+                except OSError:
+                    pass
+    except OSError:
+        pass
+
+
+class _Job:
+    """One launched job: its procs (latest incarnation per rank), exit
+    bookkeeping, and the IOF client connection."""
+
+    def __init__(self, job_id: str, size: int, cmds: list[list[str]],
+                 ft: bool, mca: list, session: str, conn, conn_lock):
+        self.id = job_id
+        self.size = size
+        self.cmds = cmds
+        self.ft = ft
+        self.mca = mca
+        self.session = session
+        self.conn = conn              # IOF/exit stream target
+        self.conn_lock = conn_lock
+        self.lock = threading.Lock()
+        self.procs: dict[int, subprocess.Popen] = {}
+        self.rcs: dict[int, int] = {}
+        self.superseded: dict[int, list[subprocess.Popen]] = {}
+        self.live = 0
+        self.fail_rc: int | None = None
+        self.stopping = False
+        self.io_broken = False
+        self.done = threading.Event()
+        self.drains: list[threading.Thread] = []
+
+    def alive_ranks(self) -> list[int]:
+        with self.lock:
+            return sorted(r for r, p in self.procs.items()
+                          if p.poll() is None)
+
+
+class Dvm(pmix_mod.FramedRpcServer):
+    """The resident daemon: PMIx store + control RPC + child watching.
+    Constructible in-process (tests, benchmarks) or via the ``zprted``
+    CLI as its own OS process.  The control port rides the shared
+    framed-RPC scaffold (:class:`~zhpe_ompi_tpu.runtime.pmix.
+    FramedRpcServer`); ``launch`` is the one streaming request —
+    replies are emitted by the job machinery
+    (``[job]``/``[io]``/``[note]``/``[exit]`` frames)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 pmix_port: int = 0, session_tag: str | None = None):
+        self.host = host
+        self.store = pmix_mod.PmixStore()
+        self.pmix = pmix_mod.PmixServer(host, pmix_port, store=self.store)
+        try:
+            super().__init__(host, port, "dvm", backlog=16)
+        except OSError:
+            self.pmix.close()
+            raise
+        self.session = session_tag or f"d{self.address[1]}"
+        self._stop_evt = threading.Event()
+        self._jobs: dict[str, _Job] = {}
+        self._job_ids = itertools.count(1)
+        self._lock = threading.Lock()
+        _live_dvms.add(self)
+        mca_output.verbose(
+            1, _stream, "zprted up: dvm=%s:%d pmix=%s:%d session=%s",
+            host, self.address[1], host, self.pmix.address[1], self.session,
+        )
+
+    # -- wire ------------------------------------------------------------
+
+    @property
+    def stopped(self) -> bool:
+        return self.closed
+
+    def _handle_request(self, req: list, conn, conn_lock) -> Any:
+        if req[0] == "launch":
+            self._handle_launch(req[1], conn, conn_lock)
+            return self.STREAMED
+        return self._dispatch(req)
+
+    def _after_reply(self, req: list) -> bool:
+        if req[0] == "stop":
+            self.stop()
+            return False
+        return True
+
+    def _dispatch(self, req: list) -> Any:
+        op = req[0]
+        if op == "ping":
+            return "pong"
+        if op == "stat":
+            with self._lock:
+                jobs = {j.id: {"size": j.size, "ft": j.ft,
+                               "live": len(j.alive_ranks()),
+                               "done": j.done.is_set()}
+                        for j in self._jobs.values()}
+            counters = spc.snapshot()
+            return {
+                "jobs": jobs,
+                "pmix": self.store.stat(),
+                "dvm_jobs_launched": counters.get("dvm_jobs_launched", 0),
+                "dvm_fault_events": counters.get("dvm_fault_events", 0),
+                "dvm_respawns": counters.get("dvm_respawns", 0),
+            }
+        if op == "pids":
+            job = self._job(req[1])
+            with job.lock:
+                return {int(r): p.pid for r, p in job.procs.items()}
+        if op == "respawn":
+            return self._handle_respawn(req[1], [int(r) for r in req[2]])
+        if op == "stop":
+            return True
+        raise errors.ArgError(f"zprted: unknown request {op!r}")
+
+    def _job(self, job_id: str) -> _Job:
+        with self._lock:
+            job = self._jobs.get(job_id)
+        if job is None:
+            raise errors.ArgError(f"zprted: unknown job {job_id!r}")
+        return job
+
+    def _stream(self, job: _Job, payload: list) -> None:
+        """One frame to the job's IOF client; a departed client must
+        never wedge the daemon (output is dropped, children keep
+        draining so their pipes never block)."""
+        from ..pt2pt.tcp import _send_frame
+        from ..utils import dss
+
+        if job.io_broken:
+            return
+        try:
+            with job.conn_lock:
+                _send_frame(job.conn, dss.pack(payload))
+        except OSError:
+            job.io_broken = True
+
+    # -- launch ----------------------------------------------------------
+
+    def _rank_env(self, job: _Job, rank: int,
+                  rejoin: "tuple[int, list[int]] | None" = None) -> dict:
+        """The ZMPI_* contract of a daemon-hosted rank: PMIx-served
+        modex (no coordinator address at all), the daemon's own address
+        for the relaunch RPC, and the per-job session tag the /dev/shm
+        sweep keys on.  Stale ZMPI_* from the daemon's OWN launch
+        environment is scrubbed — a daemon started under zmpirun must
+        not leak its launcher's contract into its children."""
+        env = {k: v for k, v in os.environ.items()
+               if not k.startswith("ZMPI_")}
+        env.update({
+            "ZMPI_RANK": str(rank),
+            "ZMPI_SIZE": str(job.size),
+            "ZMPI_PMIX": f"{self.host}:{self.pmix.address[1]}/{job.id}",
+            "ZMPI_DVM": f"{self.host}:{self.address[1]}",
+            "ZMPI_JOB": job.id,
+            "ZMPI_SESSION": job.session,
+        })
+        if job.ft:
+            env["ZMPI_FT"] = "1"
+        if rejoin is not None:
+            # recovery-window metadata: the bumped namespace generation
+            # and the whole batch of co-respawned ranks, so each
+            # replacement reads its siblings' cards at the FRESH
+            # generation (the corpse's old card must not satisfy it)
+            gen, batch = rejoin
+            env["ZMPI_REJOIN"] = "1"
+            env["ZMPI_REJOIN_GEN"] = str(gen)
+            env["ZMPI_REJOIN_RANKS"] = ",".join(str(r) for r in batch)
+        pkg_root = _pkg_root()
+        parts = env.get("PYTHONPATH", "").split(os.pathsep)
+        if pkg_root not in parts:
+            env["PYTHONPATH"] = os.pathsep.join(
+                [pkg_root] + [p for p in parts if p])
+        for name, value in job.mca or ():
+            env[f"ZMPI_MCA_{name}"] = str(value)
+        return env
+
+    def _spawn_rank(self, job: _Job, rank: int,
+                    rejoin: "tuple[int, list[int]] | None" = None
+                    ) -> subprocess.Popen:
+        p = subprocess.Popen(
+            job.cmds[rank],
+            env=self._rank_env(job, rank, rejoin=rejoin),
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            start_new_session=True,  # isolate from the daemon's signals
+        )
+        for stream, label in ((p.stdout, ""), (p.stderr, ":err")):
+            t = threading.Thread(
+                target=self._drain_iof, args=(job, rank, label, stream),
+                daemon=True, name=f"dvm-iof-{job.id}-{rank}{label}",
+            )
+            t.start()
+            job.drains.append(t)
+        w = threading.Thread(
+            target=self._watch_child, args=(job, rank, p),
+            daemon=True, name=f"dvm-wait-{job.id}-{rank}",
+        )
+        w.start()
+        return p
+
+    def _drain_iof(self, job: _Job, rank: int, label: str, stream) -> None:
+        for line in iter(stream.readline, ""):
+            self._stream(job, ["io", rank, label, line])
+        stream.close()
+
+    def _handle_launch(self, spec: dict, conn, conn_lock) -> None:
+        n = int(spec["n"])
+        if n < 1:
+            raise errors.ArgError("zprted launch: n must be >= 1")
+        argv = [str(a) for a in spec["argv"]]
+        cmd = [sys.executable] + argv if argv[0].endswith(".py") else argv
+        timeout = spec.get("timeout")
+        with self._lock:
+            job_id = f"job{next(self._job_ids)}"
+            job = _Job(
+                job_id, n, [list(cmd)] * n, bool(spec.get("ft")),
+                [tuple(m) for m in (spec.get("mca") or [])],
+                f"{self.session}_{job_id}",
+                conn, conn_lock,
+            )
+            self._jobs[job_id] = job
+        # the namespace IS the jobid: ranks modex through the resident
+        # store with zero per-job rendezvous infrastructure
+        self.store.ensure_ns(job_id, n)
+        self._stream(job, ["job", job_id])
+        with job.lock:
+            for rank in range(n):
+                job.procs[rank] = self._spawn_rank(job, rank)
+                job.live += 1
+        spc.record("dvm_jobs_launched")
+        # a job with no deadline of its own still may not park this
+        # handler forever on a wedged rank set
+        timeout = timeout if timeout \
+            else float(mca_var.get("dvm_job_timeout", 600.0))
+        if not job.done.wait(timeout):
+            self._stream(job, ["note",
+                               f"zprted: job {job_id} timeout after "
+                               f"{timeout}s; killing it\n"])
+            self._teardown_job(job, rc=124)
+        # IOF flushes before the exit frame: each drain exits at its
+        # stream's EOF, which the children's deaths guarantee
+        for t in list(job.drains):
+            t.join(timeout=2.0)
+        with job.lock:
+            if job.stopping:
+                # abort/timeout teardown: the first failure (or 124) is
+                # the job's code — the zmpirun contract
+                rc = int(job.fail_rc or 0)
+            else:
+                # ran to completion: judge each rank by its LATEST
+                # incarnation — a respawned-over corpse's exit status is
+                # recovery history, not a job failure
+                bad = [c for c in job.rcs.values() if c != 0]
+                rc = (128 - bad[0] if bad[0] < 0 else int(bad[0])) \
+                    if bad else 0
+        self._stream(job, ["exit", rc])
+        self._finalize_job(job)
+
+    # -- child watching / fault events -----------------------------------
+
+    def _watch_child(self, job: _Job, rank: int,
+                     p: subprocess.Popen) -> None:
+        """One BLOCKING waitpid per child — the daemon's failure source
+        is the OS, not a timeout."""
+        rc = p.wait()
+        with job.lock:
+            # exit accounting happens EXACTLY once per proc: here, or in
+            # the respawn RPC's corpse-adoption path if it won the race
+            if getattr(p, "_dvm_accounted", False):
+                return
+            p._dvm_accounted = True
+            current = job.procs.get(rank) is p
+            if current:
+                job.rcs[rank] = rc
+            job.live -= 1
+            last = job.live == 0
+            stopping = job.stopping
+            if current and rc != 0 and not stopping \
+                    and job.fail_rc is None:
+                # signal death → 128+sig (the shell convention)
+                job.fail_rc = 128 - rc if rc < 0 else rc
+        if current and rc != 0 and not stopping:
+            norm = 128 - rc if rc < 0 else rc
+            if job.ft:
+                # authoritative fault event: the survivors learn NOW,
+                # from OS truth, not after a heartbeat window
+                self._flood_fault(job, rank, rc)
+            else:
+                # MPI_Abort semantics (the zmpirun contract): one rank
+                # failed, the job is over
+                self._stream(job, ["note",
+                                   f"zprted: rank {rank} exited with "
+                                   f"code {norm}; terminating job "
+                                   f"{job.id}\n"])
+                self._teardown_job(job, rc=norm)
+                return
+        if last and not stopping:
+            job.done.set()
+
+    def _flood_fault(self, job: _Job, rank: int, rc: int) -> None:
+        """FT_DVM_CID to every survivor of the job, addressed from the
+        name-served cards — the daemon holds the book, so the flood
+        reaches even ranks the corpse never exchanged data with."""
+        from ..pt2pt.tcp import _send_frame
+        from ..ft import ulfm
+        from ..utils import dss
+
+        spc.record("dvm_fault_events")
+        mca_output.verbose(
+            2, _stream, "job %s: rank %d died (rc=%d); flooding fault "
+            "event", job.id, rank, rc,
+        )
+        hello = dss.pack(["d", -1])
+        frame = dss.pack(-1, 0, ulfm.FT_DVM_CID, 0, [[rank, int(rc)]])
+
+        def notify(addr):
+            try:
+                sock = socket.create_connection(addr, 2.0)
+            except OSError:
+                return  # also dying: its own watcher's course
+            try:
+                _send_frame(sock, hello)
+                _send_frame(sock, frame)
+            except OSError:
+                pass
+            finally:
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+
+        # one short-lived thread per survivor: the whole point of this
+        # event is beating the heartbeat window, so a co-dying rank's
+        # connect timeout (or a not-yet-modexed card) must not serialize
+        # ahead of the survivors still waiting to hear
+        for r in job.alive_ranks():
+            if r == rank:
+                continue
+            try:
+                card = self.store.get(job.id, f"card:{r}", timeout=0.05)
+            except errors.MpiError:
+                continue  # not modexed yet: nothing to notify
+            threading.Thread(
+                target=notify, args=((card[0], int(card[1])),),
+                daemon=True, name=f"dvm-fault-{job.id}-{r}",
+            ).start()
+
+    def _handle_respawn(self, job_id: str, ranks: list[int]) -> list[int]:
+        """The relaunch RPC: exec a fresh OS process per victim.  ONE
+        generation bump covers the whole batch — N replacements of one
+        recovery window publish their fresh cards under the same tag
+        and FT_JOIN the same name-served job."""
+        job = self._job(job_id)
+        if job.done.is_set():
+            raise errors.ArgError(
+                f"zprted: job {job_id} already completed")
+        if not ranks:
+            return []
+        pids = []
+        with job.lock:
+            # validate the WHOLE batch before spawning any of it: a bad
+            # rank must not leave a half-respawned recovery window
+            for rank in ranks:
+                if not 0 <= rank < job.size:
+                    raise errors.ArgError(
+                        f"zprted respawn: rank {rank} outside job "
+                        f"{job_id} (size {job.size})")
+            for rank in ranks:
+                old = job.procs.get(rank)
+                if old is not None and old.poll() is None:
+                    # a victim the survivors AGREED dead whose OS
+                    # process still exists is wedged (deadlock,
+                    # SIGSTOP, half-dead) — the PRRTE contract kills
+                    # the declared-dead incarnation before respawning,
+                    # it never refuses the recovery
+                    try:
+                        os.killpg(os.getpgid(old.pid), signal.SIGKILL)
+                    except (OSError, ProcessLookupError):
+                        pass
+                    try:
+                        old.wait(timeout=5.0)
+                    except subprocess.TimeoutExpired:
+                        raise errors.InternalError(
+                            f"zprted respawn: wedged rank {rank} of "
+                            f"{job_id} survived SIGKILL")
+            gen = self.store.bump_generation(job_id)
+            batch = sorted(ranks)
+            for rank in ranks:
+                old = job.procs.get(rank)
+                if old is not None:
+                    if not getattr(old, "_dvm_accounted", False):
+                        # adopt the corpse's exit before its watcher
+                        # does: the once-per-proc accounting contract
+                        old._dvm_accounted = True
+                        job.rcs[rank] = old.returncode
+                        job.live -= 1
+                    job.superseded.setdefault(rank, []).append(old)
+                p = self._spawn_rank(job, rank, rejoin=(gen, batch))
+                job.procs[rank] = p
+                job.rcs.pop(rank, None)
+                job.live += 1
+                pids.append(p.pid)
+        spc.record("dvm_respawns", len(ranks))
+        return pids
+
+    # -- teardown ---------------------------------------------------------
+
+    def _teardown_job(self, job: _Job, rc: int) -> None:
+        with job.lock:
+            job.stopping = True
+            if job.fail_rc is None or rc == 124:
+                job.fail_rc = rc
+            procs = list(job.procs.values())
+        for p in procs:
+            if p.poll() is None:
+                try:
+                    os.killpg(os.getpgid(p.pid), signal.SIGTERM)
+                except (OSError, ProcessLookupError):
+                    pass
+        grace_end = time.monotonic() + _TERM_GRACE
+        for p in procs:
+            try:
+                p.wait(timeout=max(0.0, grace_end - time.monotonic()))
+            except subprocess.TimeoutExpired:
+                try:
+                    os.killpg(os.getpgid(p.pid), signal.SIGKILL)
+                except (OSError, ProcessLookupError):
+                    pass
+                p.wait()
+        job.done.set()
+
+    def _finalize_job(self, job: _Job) -> None:
+        """End-of-job hygiene: reap superseded corpses, drop the
+        namespace, sweep the job's /dev/shm artifacts (killed ranks
+        never unlink their own rings)."""
+        with job.lock:
+            leftovers = [p for ps in job.superseded.values() for p in ps]
+        for p in leftovers:
+            try:
+                p.wait(timeout=1.0)
+            except subprocess.TimeoutExpired:
+                pass
+        self.store.destroy_ns(job.id)
+        _sweep_shm(job.session)
+        with self._lock:
+            self._jobs.pop(job.id, None)
+
+    def stop(self) -> None:
+        """Orderly daemon shutdown: kill every live job, drop the store,
+        close both listeners (the shared shutdown ladder), sweep the
+        session."""
+        if self.closed:
+            return
+        with self._lock:
+            jobs = list(self._jobs.values())
+        for job in jobs:
+            self._teardown_job(job, rc=143)
+            self._finalize_job(job)
+        self.pmix.close()
+        super().close()
+        _sweep_shm(self.session)
+        self._stop_evt.set()
+
+    def close(self) -> None:
+        """The RPC-scaffold name for :meth:`stop` — a Dvm closed like a
+        bare server still tears its jobs down."""
+        self.stop()
+
+    def wait(self, timeout: float | None = None) -> bool:
+        """Block until the daemon is stopped (RPC or signal)."""
+        return self._stop_evt.wait(timeout)
+
+
+class DvmClient:
+    """Client handle to a running daemon — ``zmpirun --dvm`` and the
+    recovery pipeline's relaunch RPC both speak through this."""
+
+    def __init__(self, address: tuple[str, int] | str,
+                 timeout: float = 30.0):
+        self.address = pmix_mod.parse_addr(address)
+        self._timeout = timeout
+        self.last_job_id: str | None = None
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.settimeout(timeout)
+        try:
+            self._sock.connect(self.address)
+        except OSError as e:
+            self._sock.close()
+            raise errors.InternalError(
+                f"zprted: no daemon at {self.address}: {e}"
+            ) from e
+
+    def _call(self, req: list, wait: float | None = None) -> Any:
+        from ..pt2pt.tcp import _recv_frame, _send_frame
+        from ..utils import dss
+
+        self._sock.settimeout((wait or 0.0) + self._timeout)
+        try:
+            _send_frame(self._sock, dss.pack(req))
+            frame = _recv_frame(self._sock)
+        except OSError as e:
+            raise errors.InternalError(
+                f"zprted: daemon connection lost mid-{req[0]}: {e}"
+            ) from e
+        if frame is None:
+            raise errors.InternalError(
+                f"zprted: daemon closed the connection mid-{req[0]}")
+        [status, value] = dss.unpack(frame)[0]
+        if status != "ok":
+            raise errors.InternalError(f"zprted {req[0]}: {value}")
+        return value
+
+    def launch(self, n: int, argv: list[str],
+               mca: list | None = None, ft: bool = False,
+               timeout: float | None = None, tag_output: bool = True,
+               stdout=None, stderr=None) -> int:
+        """Launch an n-rank job into the resident VM; streams its IOF
+        and returns the job exit code (the ``zmpirun`` surface, minus
+        the per-job launcher)."""
+        from ..pt2pt.tcp import _recv_frame, _send_frame
+        from ..utils import dss
+
+        stdout = stdout if stdout is not None else sys.stdout
+        stderr = stderr if stderr is not None else sys.stderr
+        spec = {"n": int(n), "argv": [str(a) for a in argv],
+                "mca": [list(m) for m in (mca or [])], "ft": bool(ft),
+                "timeout": timeout}
+        # no client-imposed deadline without an explicit job timeout:
+        # the daemon enforces its own (tunable) dvm_job_timeout and
+        # ALWAYS sends the exit frame, and a daemon crash surfaces as
+        # EOF/reset — a hard-coded recv timeout here would desync from
+        # a raised server-side limit and abandon a healthy job's IOF
+        self._sock.settimeout(timeout + 30.0 if timeout else None)
+        try:
+            _send_frame(self._sock, dss.pack(["launch", spec]))
+            while True:
+                frame = _recv_frame(self._sock)
+                if frame is None:
+                    raise errors.InternalError(
+                        "zprted: daemon vanished mid-job")
+                [msg] = dss.unpack(frame)
+                kind = msg[0]
+                if kind == "job":
+                    self.last_job_id = msg[1]
+                elif kind == "io":
+                    _, rank, label, line = msg
+                    sink = stderr if label else stdout
+                    if tag_output:
+                        sink.write(f"[{rank}{label}] {line}")
+                    else:
+                        sink.write(line)
+                    sink.flush()
+                elif kind == "note":
+                    stderr.write(msg[1])
+                    stderr.flush()
+                elif kind == "exit":
+                    return int(msg[1])
+                elif kind == "err":
+                    raise errors.InternalError(f"zprted launch: {msg[1]}")
+        except OSError as e:
+            raise errors.InternalError(
+                f"zprted: daemon connection lost mid-job: {e}") from e
+
+    def respawn(self, job_id: str, ranks: list[int],
+                timeout: float = 30.0) -> list[int]:
+        return self._call(["respawn", str(job_id),
+                           [int(r) for r in ranks]], wait=timeout)
+
+    def pids(self, job_id: str) -> dict[int, int]:
+        return {int(r): int(p)
+                for r, p in self._call(["pids", str(job_id)]).items()}
+
+    def stat(self) -> dict:
+        return self._call(["stat"])
+
+    def ping(self) -> bool:
+        return self._call(["ping"]) == "pong"
+
+    def stop(self) -> bool:
+        return bool(self._call(["stop"]))
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+def main(args: list[str] | None = None) -> int:
+    """The ``zprted`` CLI: start a daemon, announce its ports, run until
+    signalled or stopped by RPC."""
+    ap = argparse.ArgumentParser(
+        prog="zprted",
+        description="Persistent runtime daemon (PRRTE/DVM analog): "
+                    "hosts the PMIx store, launches zmpirun --dvm jobs, "
+                    "watches children, floods fault events, respawns "
+                    "ranks.",
+    )
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=0,
+                    help="control (RPC) port; 0 = ephemeral")
+    ap.add_argument("--pmix-port", type=int, default=0,
+                    help="PMIx store port; 0 = ephemeral")
+    ns = ap.parse_args(args)
+    dvm = Dvm(ns.host, ns.port, ns.pmix_port)
+    print(f"zprted ready dvm={dvm.host}:{dvm.address[1]} "
+          f"pmix={dvm.host}:{dvm.pmix.address[1]}", flush=True)
+
+    def on_signal(signum, _frame):
+        dvm.stop()
+
+    signal.signal(signal.SIGTERM, on_signal)
+    signal.signal(signal.SIGINT, on_signal)
+    dvm.wait()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -136,25 +136,51 @@ def host_init(timeout: float = 30.0):
     with _host_lock:
         if _host["proc"] is not None:
             return _host["proc"]
+        pmix_uri = os.environ.get("ZMPI_PMIX")
         try:
             rank = int(os.environ["ZMPI_RANK"])
             size = int(os.environ["ZMPI_SIZE"])
-            chost = os.environ["ZMPI_COORD_HOST"]
-            cport = int(os.environ["ZMPI_COORD_PORT"])
+            if pmix_uri is None:
+                chost = os.environ["ZMPI_COORD_HOST"]
+                cport = int(os.environ["ZMPI_COORD_PORT"])
         except (KeyError, ValueError) as e:
             raise errors.NotInitializedError(
                 f"host_init: bad ZMPI_* contract ({e}) — run under zmpirun "
                 "(python -m zhpe_ompi_tpu.tools.mpirun) or export "
-                "ZMPI_RANK/SIZE/COORD_HOST/COORD_PORT"
+                "ZMPI_RANK/SIZE/COORD_HOST/COORD_PORT (or ZMPI_PMIX for "
+                "a daemon-hosted job)"
             ) from None
         from ..pt2pt.tcp import TcpProc
 
+        # ft=True is the daemon-hosted recovery contract (zprted floods
+        # authoritative fault events that need a FailureState to land in)
+        ft = os.environ.get("ZMPI_FT") == "1"
         t0 = time.perf_counter()
-        proc = TcpProc(
-            rank, size, coordinator=(chost, cport), timeout=timeout,
-            external_coordinator=os.environ.get(
-                "ZMPI_COORD_EXTERNAL") == "1",
-        )
+        if pmix_uri is not None:
+            # PMIx-served wire-up (zprted hosts the store): ZMPI_PMIX is
+            # "host:port/namespace"; a respawned replacement additionally
+            # carries ZMPI_REJOIN=1 and re-modexes through the store
+            if "/" not in pmix_uri or ":" not in pmix_uri.split("/")[0]:
+                raise errors.NotInitializedError(
+                    f"host_init: malformed ZMPI_PMIX {pmix_uri!r} — "
+                    "expected host:port/namespace (zprted exports this)"
+                )
+            addr, ns = pmix_uri.rsplit("/", 1)
+            rejoin_ranks = os.environ.get("ZMPI_REJOIN_RANKS", "")
+            proc = TcpProc(
+                rank, size, pmix=addr, namespace=ns, timeout=timeout,
+                ft=ft, rejoin=os.environ.get("ZMPI_REJOIN") == "1",
+                rejoin_gen=int(os.environ.get("ZMPI_REJOIN_GEN", 0)),
+                rejoin_ranks=[int(r) for r in rejoin_ranks.split(",")
+                              if r],
+            )
+        else:
+            proc = TcpProc(
+                rank, size, coordinator=(chost, cport), timeout=timeout,
+                ft=ft,
+                external_coordinator=os.environ.get(
+                    "ZMPI_COORD_EXTERNAL") == "1",
+            )
         _host["proc"] = proc
         spc.record("init_count", 1)
         mca_output.verbose(

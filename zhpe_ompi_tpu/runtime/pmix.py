@@ -1,0 +1,564 @@
+"""PMIx-analog key-value server — the name-served modex of the runtime plane.
+
+In the reference the entire wire-up rendezvous lives OUT of tree: ``mpirun``
+is a symlink to the external ``prte`` binary and OpenPMIx is an empty
+submodule (SURVEY.md critical facts; ``.gitmodules:4-11``).  Every rank is a
+PMIx *client*: it ``put``\\ s its business card under its process name,
+``commit``\\ s, enters a ``fence`` across the namespace, and ``get``\\ s its
+peers' cards — with get-until-published blocking semantics, so a late
+reader simply waits for the publisher instead of erroring (the
+``PMIx_Get`` contract the reference's modex rides).
+
+This module is that server IN tree, with the real verb semantics:
+
+- **namespace = jobid**: every job's keys live in their own namespace;
+  a resident DVM (:mod:`.dvm`) hosts ONE store across many jobs, so a
+  second job launched into the daemon re-pays none of the rendezvous
+  infrastructure.
+- **put → commit**: puts stage locally to the rank's scratch; nothing is
+  visible to peers until ``commit`` publishes the batch (the
+  PMIx_Put/PMIx_Commit split).
+- **fence**: a namespace-wide barrier (``PMIx_Fence`` with collect
+  semantics — by the time it releases, every rank's committed data is
+  published and gettable).
+- **get(ns, key)**: blocks until the key is published or the deadline
+  passes — a joiner never races the publisher.
+- **generation-tagged entries**: every published value carries the
+  namespace's generation at commit time.  A respawned rank's fresh card
+  (published in the bumped generation of its recovery window) is
+  distinguishable from the corpse's, and ``get_meta`` exposes the tag.
+
+Three surfaces share one :class:`PmixStore`:
+
+- in-process (the store object itself — thread ranks, unit tests),
+- :class:`PmixServer` — the store behind a length-framed DSS wire
+  (thread-per-connection; blocking verbs park the connection's thread),
+- :class:`PmixClient` — the rank-side verbs over one persistent socket.
+
+Hygiene is observable like every other plane's: servers register weakly
+(:func:`live_servers` must be empty once tests close them) and a closed
+server must hold zero namespace state (:func:`stale_namespaces` — the
+daemon destroys a job's namespace when the job ends).
+
+SPC counters (recorded by the STORE, i.e. in the server/daemon process):
+``pmix_puts`` / ``pmix_gets`` / ``pmix_fences`` — see
+:mod:`zhpe_ompi_tpu.runtime.spc` for the full table.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+import time
+import weakref
+from typing import Any
+
+from ..core import errors
+from ..mca import output as mca_output
+from . import spc
+
+_stream = mca_output.open_stream("pmix")
+
+# hygiene registries (consumed by the conftest session gate)
+_live_servers: weakref.WeakSet = weakref.WeakSet()
+_live_stores: weakref.WeakSet = weakref.WeakSet()
+
+
+def live_servers() -> list[str]:
+    """PMIx servers still listening — must be [] after tests/daemons
+    close theirs (a leaked listener holds a port for the whole suite)."""
+    return [
+        f"pmix-server:{srv.address[0]}:{srv.address[1]}"
+        for srv in list(_live_servers)
+        if not srv.closed
+    ]
+
+
+def stale_namespaces() -> list[str]:
+    """Namespace state still held in any tracked store at session end —
+    the daemon destroys a job's namespace when the job ends and
+    ``close()`` clears the rest, so anything still here after the suite
+    is leaked rendezvous state (an unstopped daemon, or a job whose
+    namespace was never torn down)."""
+    out = []
+    for store in list(_live_stores):
+        out += [f"pmix-ns:{ns}" for ns in store.namespaces()]
+    return out
+
+
+def parse_addr(address: "tuple[str, int] | str") -> tuple[str, int]:
+    """Normalize a ``"host:port"`` string or ``(host, port)`` pair —
+    one parser for every runtime-plane client/server address."""
+    if isinstance(address, str):
+        host, port = address.rsplit(":", 1)
+        return (host, int(port))
+    return (address[0], int(address[1]))
+
+
+class FramedRpcServer:
+    """Shared scaffold of the runtime plane's framed-RPC servers (the
+    PMIx store wire and the zprted control port): one SO_REUSEADDR
+    listener (a daemon restarted onto a just-stopped predecessor's
+    port must ride over the TIME_WAIT corpse), a pruned
+    thread-per-connection accept loop, ``["ok", value]``/``["err",
+    msg]`` reply enveloping, and the shutdown-wakes-accept close
+    ladder.  Subclasses implement :meth:`_handle_request`; it returns
+    the reply value, raises ``MpiError`` for an errored reply, or
+    returns :attr:`STREAMED` when it already emitted its own frames.
+    :meth:`_after_reply` (default True) may return False to stop
+    serving the connection after a reply (the stop RPC's shape).
+    """
+
+    #: sentinel: the handler streamed its own reply frames
+    STREAMED = object()
+
+    def __init__(self, host: str, port: int, name: str,
+                 backlog: int = 64):
+        self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        try:
+            self._srv.bind((host, port))
+        except OSError:
+            self._srv.close()
+            raise
+        self._srv.listen(backlog)
+        self.address: tuple[str, int] = self._srv.getsockname()
+        self.closed = False
+        self._rpc_name = name
+        self._conns: list[socket.socket] = []
+        self._rpc_lock = threading.Lock()
+        self._threads: list[threading.Thread] = []
+        self._acceptor = threading.Thread(
+            target=self._accept_loop, daemon=True,
+            name=f"{name}-accept-{self.address[1]}",
+        )
+        self._acceptor.start()
+
+    def _handle_request(self, req: list, conn, conn_lock) -> Any:
+        raise NotImplementedError
+
+    def _after_reply(self, req: list) -> bool:
+        return True
+
+    def _accept_loop(self) -> None:
+        while not self.closed:
+            try:
+                conn, _ = self._srv.accept()
+            except OSError:
+                return
+            with self._rpc_lock:
+                self._conns.append(conn)
+                t = threading.Thread(
+                    target=self._serve_conn, args=(conn,), daemon=True,
+                    name=f"{self._rpc_name}-conn-{self.address[1]}",
+                )
+                self._threads = [x for x in self._threads if x.is_alive()]
+                self._threads.append(t)
+            t.start()
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        from ..pt2pt.tcp import _recv_frame, _send_frame
+        from ..utils import dss
+
+        conn_lock = threading.Lock()
+        try:
+            while not self.closed:
+                frame = _recv_frame(conn)
+                if frame is None:
+                    return
+                [req] = dss.unpack(frame)
+                try:
+                    out = self._handle_request(req, conn, conn_lock)
+                    if out is self.STREAMED:
+                        continue
+                    reply = ["ok", out]
+                except errors.MpiError as e:
+                    reply = ["err", str(e)]
+                except Exception as e:  # noqa: BLE001 - a malformed
+                    # request must error the REPLY, not silently kill
+                    # this connection's handler thread
+                    reply = ["err", f"{type(e).__name__}: {e}"]
+                with conn_lock:
+                    _send_frame(conn, dss.pack(reply))
+                if not self._after_reply(req):
+                    return
+        except OSError:
+            return  # client went away mid-request: its own problem
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def close(self) -> None:
+        """The shutdown ladder: wake the acceptor (shutdown(), not a
+        bare close() — that leaves it parked on the old fd), unblock
+        every connection drain, bounded-join all of them (skipping the
+        calling thread: a stop RPC closes from its own handler)."""
+        if self.closed:
+            return
+        self.closed = True
+        try:
+            self._srv.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+        with self._rpc_lock:
+            conns = list(self._conns)
+            self._conns = []
+            threads = list(self._threads)
+        for conn in conns:
+            try:
+                conn.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                conn.close()
+            except OSError:
+                pass
+        deadline = time.monotonic() + 5.0
+        self._acceptor.join(max(0.0, deadline - time.monotonic()))
+        me = threading.current_thread()
+        for t in threads:
+            if t is me:
+                continue
+            t.join(max(0.0, deadline - time.monotonic()))
+
+
+class _Namespace:
+    """One job's keyspace: size, staged puts per rank, published KV with
+    generation tags, and the fence epoch machinery."""
+
+    __slots__ = ("size", "generation", "staged", "kv", "fence_epoch",
+                 "fence_entered")
+
+    def __init__(self, size: int):
+        self.size = size
+        self.generation = 0
+        self.staged: dict[int, dict[str, Any]] = {}
+        # key -> (generation, value)
+        self.kv: dict[str, tuple[int, Any]] = {}
+        self.fence_epoch = 0
+        self.fence_entered: set[int] = set()
+
+
+class PmixStore:
+    """The namespace-scoped KV store itself — usable in-process (the
+    daemon and unit tests hold it directly) and behind
+    :class:`PmixServer`'s wire.  All verbs are thread-safe; blocking
+    verbs (``get``, ``fence``) park on the store condition."""
+
+    def __init__(self):
+        self._ns: dict[str, _Namespace] = {}
+        self._cv = threading.Condition()
+        self.open = True
+        _live_stores.add(self)
+
+    # -- namespace lifecycle ---------------------------------------------
+
+    def ensure_ns(self, ns: str, size: int) -> None:
+        """Create ``ns`` (idempotent).  A size mismatch on an existing
+        namespace is a caller bug — two different jobs may not share a
+        name."""
+        with self._cv:
+            have = self._ns.get(ns)
+            if have is None:
+                self._ns[ns] = _Namespace(int(size))
+            elif have.size != int(size):
+                raise errors.ArgError(
+                    f"pmix: namespace {ns!r} exists with size {have.size}, "
+                    f"not {size}"
+                )
+
+    def destroy_ns(self, ns: str) -> bool:
+        """Drop a job's keyspace (the daemon calls this when the job
+        ends; PMIx_server_deregister_nspace shape).  Waiters blocked in
+        get/fence on it observe the drop and error out."""
+        with self._cv:
+            existed = self._ns.pop(ns, None) is not None
+            self._cv.notify_all()
+        return existed
+
+    def namespaces(self) -> list[str]:
+        with self._cv:
+            return sorted(self._ns)
+
+    def clear(self) -> None:
+        with self._cv:
+            self._ns.clear()
+            self._cv.notify_all()
+
+    def _require(self, ns: str) -> _Namespace:
+        space = self._ns.get(ns)
+        if space is None:
+            raise errors.ArgError(f"pmix: unknown namespace {ns!r}")
+        return space
+
+    # -- verbs ------------------------------------------------------------
+
+    def put(self, ns: str, rank: int, key: str, value: Any) -> None:
+        """Stage ``key=value`` in the rank's scratch — invisible to
+        peers until :meth:`commit` (the PMIx_Put contract)."""
+        with self._cv:
+            space = self._require(ns)
+            space.staged.setdefault(int(rank), {})[str(key)] = value
+        spc.record("pmix_puts")
+
+    def commit(self, ns: str, rank: int) -> int:
+        """Publish the rank's staged puts, tagging each entry with the
+        namespace's CURRENT generation; returns that generation."""
+        with self._cv:
+            space = self._require(ns)
+            staged = space.staged.pop(int(rank), {})
+            gen = space.generation
+            for key, value in staged.items():
+                space.kv[key] = (gen, value)
+            self._cv.notify_all()
+            return gen
+
+    def get(self, ns: str, key: str, timeout: float = 30.0,
+            min_generation: int = 0) -> Any:
+        """Blocking get-until-published: waits for ``key`` to appear (at
+        or above ``min_generation`` — a recovery window can insist on a
+        FRESH card, not the corpse's) or raises after ``timeout``."""
+        value, _gen = self.get_meta(ns, key, timeout, min_generation)
+        return value
+
+    def get_meta(self, ns: str, key: str, timeout: float = 30.0,
+                 min_generation: int = 0) -> tuple[Any, int]:
+        """:meth:`get` plus the entry's generation tag."""
+        deadline = time.monotonic() + timeout
+        with self._cv:
+            while True:
+                space = self._ns.get(ns)
+                if space is None:
+                    raise errors.ArgError(f"pmix: unknown namespace {ns!r}")
+                hit = space.kv.get(str(key))
+                if hit is not None and hit[0] >= int(min_generation):
+                    spc.record("pmix_gets")
+                    return hit[1], hit[0]
+                left = deadline - time.monotonic()
+                if left <= 0 or not self.open:
+                    raise errors.InternalError(
+                        f"pmix: get({ns!r}, {key!r}) not published within "
+                        f"{timeout}s"
+                    )
+                self._cv.wait(min(left, 0.25))
+
+    def fence(self, ns: str, rank: int, timeout: float = 30.0) -> None:
+        """Namespace-wide barrier: blocks until every rank of ``ns`` has
+        entered this fence epoch.  Committed data published before the
+        fence is gettable by everyone after it (PMIx_Fence w/ collect)."""
+        deadline = time.monotonic() + timeout
+        with self._cv:
+            space = self._require(ns)
+            epoch = space.fence_epoch
+            space.fence_entered.add(int(rank))
+            if len(space.fence_entered) >= space.size:
+                space.fence_epoch += 1
+                space.fence_entered = set()
+                self._cv.notify_all()
+                spc.record("pmix_fences")
+                return
+            while True:
+                live = self._ns.get(ns)
+                if live is not space:
+                    raise errors.InternalError(
+                        f"pmix: namespace {ns!r} destroyed mid-fence"
+                    )
+                if space.fence_epoch > epoch:
+                    spc.record("pmix_fences")
+                    return
+                left = deadline - time.monotonic()
+                if left <= 0 or not self.open:
+                    raise errors.InternalError(
+                        f"pmix: fence on {ns!r} incomplete within "
+                        f"{timeout}s ({len(space.fence_entered)}/"
+                        f"{space.size} entered)"
+                    )
+                self._cv.wait(min(left, 0.25))
+
+    def bump_generation(self, ns: str) -> int:
+        """Open a new generation window (the daemon bumps ONCE per
+        respawn batch, so N replacements of one recovery window publish
+        under the same tag)."""
+        with self._cv:
+            space = self._require(ns)
+            space.generation += 1
+            return space.generation
+
+    def generation(self, ns: str) -> int:
+        with self._cv:
+            return self._require(ns).generation
+
+    def stat(self) -> dict:
+        """Introspection snapshot (the zmpi-info / gate view)."""
+        with self._cv:
+            return {
+                ns: {
+                    "size": sp.size,
+                    "generation": sp.generation,
+                    "keys": len(sp.kv),
+                    "staged_ranks": len(sp.staged),
+                }
+                for ns, sp in self._ns.items()
+            }
+
+    def close(self) -> None:
+        """Unblock every parked get/fence (they error out) and drop the
+        namespace state — the server owns calling this at teardown."""
+        with self._cv:
+            self.open = False
+            self._ns.clear()
+            self._cv.notify_all()
+
+
+class PmixServer(FramedRpcServer):
+    """The store behind a wire: a length-framed DSS request/response
+    protocol on one listening socket, one drain thread per client
+    connection (blocking verbs park that thread, never the acceptor).
+
+    Request frame: ``dss.pack([op, *args])``; response frame:
+    ``dss.pack(["ok", value])`` or ``dss.pack(["err", message])``.
+    """
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 store: PmixStore | None = None):
+        self.store = store if store is not None else PmixStore()
+        super().__init__(host, port, "pmix")
+        _live_servers.add(self)
+
+    def _handle_request(self, req: list, conn, conn_lock) -> Any:
+        return self._dispatch(req)
+
+    def _dispatch(self, req: list) -> Any:
+        op = req[0]
+        s = self.store
+        if op == "put":
+            s.put(req[1], int(req[2]), req[3], req[4])
+            return True
+        if op == "commit":
+            return s.commit(req[1], int(req[2]))
+        if op == "get":
+            value, gen = s.get_meta(req[1], req[2], float(req[3]),
+                                    int(req[4]))
+            return [value, gen]
+        if op == "fence":
+            s.fence(req[1], int(req[2]), float(req[3]))
+            return True
+        if op == "mkns":
+            s.ensure_ns(req[1], int(req[2]))
+            return True
+        if op == "destroy":
+            return s.destroy_ns(req[1])
+        if op == "bumpgen":
+            return s.bump_generation(req[1])
+        if op == "generation":
+            return s.generation(req[1])
+        if op == "stat":
+            return s.stat()
+        if op == "ping":
+            return "pong"
+        raise errors.ArgError(f"pmix: unknown verb {op!r}")
+
+    def close(self) -> None:
+        if self.closed:
+            return
+        # unblock parked get/fence waiters FIRST (they error out), then
+        # run the shared listener/connection shutdown ladder
+        self.store.close()
+        super().close()
+
+
+class PmixClient:
+    """Rank-side verbs over ONE persistent connection (the PMIx client
+    handle).  Synchronous request/response; a lock serializes callers so
+    the framing never interleaves."""
+
+    def __init__(self, address: tuple[str, int] | str,
+                 timeout: float = 30.0):
+        self.address = parse_addr(address)
+        self._timeout = timeout
+        self._lock = threading.Lock()
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.settimeout(timeout)
+        try:
+            self._sock.connect(self.address)
+        except OSError as e:
+            self._sock.close()
+            raise errors.InternalError(
+                f"pmix: cannot reach server at {self.address}: {e}"
+            ) from e
+
+    def _call(self, req: list, wait: float | None = None) -> Any:
+        from ..pt2pt.tcp import _recv_frame, _send_frame
+        from ..utils import dss
+
+        with self._lock:
+            # a blocking verb (get/fence) parks server-side for up to its
+            # own deadline: the socket must outwait it, not cut it short
+            self._sock.settimeout((wait or 0.0) + self._timeout)
+            try:
+                _send_frame(self._sock, dss.pack(req))
+                frame = _recv_frame(self._sock)
+            except OSError as e:
+                raise errors.InternalError(
+                    f"pmix: server connection lost mid-{req[0]}: {e}"
+                ) from e
+        if frame is None:
+            raise errors.InternalError(
+                f"pmix: server closed the connection mid-{req[0]}"
+            )
+        [status, value] = dss.unpack(frame)[0]
+        if status != "ok":
+            raise errors.InternalError(f"pmix {req[0]}: {value}")
+        return value
+
+    # -- verbs ------------------------------------------------------------
+
+    def ensure_ns(self, ns: str, size: int) -> None:
+        self._call(["mkns", ns, int(size)])
+
+    def destroy_ns(self, ns: str) -> bool:
+        return bool(self._call(["destroy", ns]))
+
+    def put(self, ns: str, rank: int, key: str, value: Any) -> None:
+        self._call(["put", ns, int(rank), str(key), value])
+
+    def commit(self, ns: str, rank: int) -> int:
+        return int(self._call(["commit", ns, int(rank)]))
+
+    def get(self, ns: str, key: str, timeout: float = 30.0,
+            min_generation: int = 0) -> Any:
+        value, _gen = self.get_meta(ns, key, timeout, min_generation)
+        return value
+
+    def get_meta(self, ns: str, key: str, timeout: float = 30.0,
+                 min_generation: int = 0) -> tuple[Any, int]:
+        out = self._call(["get", ns, str(key), float(timeout),
+                          int(min_generation)], wait=timeout)
+        return out[0], int(out[1])
+
+    def fence(self, ns: str, rank: int, timeout: float = 30.0) -> None:
+        self._call(["fence", ns, int(rank), float(timeout)], wait=timeout)
+
+    def bump_generation(self, ns: str) -> int:
+        return int(self._call(["bumpgen", ns]))
+
+    def generation(self, ns: str) -> int:
+        return int(self._call(["generation", ns]))
+
+    def stat(self) -> dict:
+        return self._call(["stat"])
+
+    def ping(self) -> bool:
+        return self._call(["ping"]) == "pong"
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass

@@ -93,6 +93,31 @@ Hierarchical-collective counters (the coll/han analog; recorded by
   segments): segment k's intra bcast isends drain on the deferred
   engine while segment k+1's wire exchange runs.  The OSU ``--plane
   han`` pipeline row gates on this rising at >= 2-segment sizes.
+
+Runtime-plane counters (the PRRTE/PMIx analog — ``runtime/pmix.py``
+records the ``pmix_*`` family in the process hosting the STORE, i.e.
+the daemon; ``runtime/dvm.py`` records the daemon-side ``dvm_*`` events
+and ``pt2pt/tcp.py`` records ``dvm_fault_events`` again in each
+SURVIVOR that ingests the frame — the daemon's ``stat`` RPC surfaces
+the daemon-side values):
+
+- ``pmix_puts`` / ``pmix_gets`` / ``pmix_fences`` — PMIx verb traffic
+  against the name-served KV store: staged puts, blocking
+  get-until-published reads (one per published key read, not per
+  wait wakeup), and completed fence ENTRIES (one per rank released,
+  not per barrier).  A cold 4-rank modex is 4 puts + 4 fence entries
+  + 16 gets; the OSU ``--launch`` ladder gates on these moving only
+  on the DVM rows.
+- ``dvm_jobs_launched`` — jobs spawned into the resident VM (one per
+  ``launch`` RPC that reached the spawn loop).
+- ``dvm_fault_events`` — authoritative daemon fault events: in the
+  daemon, one per child whose ``waitpid`` returned nonzero in an ft
+  job; in a survivor, one per NEWLY-learned corpse an ``FT_DVM_CID``
+  frame delivered (cause ``"daemon"`` — OS truth, never a detector
+  false positive).
+- ``dvm_respawns`` — replacement processes exec'd by the relaunch RPC
+  (N victims respawned in one batched RPC count N, but share ONE
+  namespace-generation bump — the same recovery window).
 """
 
 from __future__ import annotations

@@ -37,8 +37,21 @@ import subprocess
 import sys
 import threading
 import time
+from typing import Any
 
 _TERM_GRACE = 2.0  # seconds between SIGTERM and SIGKILL on abort
+
+
+class _JobSignal(Exception):
+    """Raised out of the CLI's SIGINT/SIGTERM handler into the monitor
+    loop: the launcher forwards the signal to the job, reaps every
+    child, releases its rendezvous/name-server ports, and exits
+    ``128 + signum`` — a Ctrl-C must never orphan ranks still holding
+    sockets and /dev/shm rings."""
+
+    def __init__(self, signum: int):
+        super().__init__(f"signal {signum}")
+        self.signum = signum
 
 
 def _start_coordinator(host: str, size: int, timeout: float):
@@ -78,7 +91,10 @@ def _start_coordinator(host: str, size: int, timeout: float):
 
     t = threading.Thread(target=serve, daemon=True)
     t.start()
-    return srv.getsockname()[1]
+    # the socket is returned alongside the port so the launcher can
+    # RELEASE it on any exit path (signal teardown included): a port
+    # held by a dead job's rendezvous thread is a leak
+    return srv.getsockname()[1], srv
 
 
 def _start_name_server(host: str):
@@ -151,7 +167,7 @@ def _forward(stream, rank: int, label: str, out, lock: threading.Lock,
 
 def build_env(rank: int, size: int, host: str, port: int,
               mca: list[tuple[str, str]] | None = None,
-              ns_port: int | None = None) -> dict:
+              ns_port: int | None = None, ft: bool = False) -> dict:
     """The ZMPI_* environment contract one rank sees (PMIx envars analog)."""
     env = dict(os.environ)
     env.update({
@@ -170,6 +186,10 @@ def build_env(rank: int, size: int, host: str, port: int,
     })
     if ns_port is not None:
         env["ZMPI_NAMESERVER"] = f"{host}:{ns_port}"
+    if ft:
+        # fault-tolerant job: every rank's host_init builds an ft=True
+        # endpoint (detector, typed failures, recovery surface)
+        env["ZMPI_FT"] = "1"
     # make the framework importable in every rank regardless of cwd — the
     # mpirun-exports-its-library-paths behavior (OPAL_PREFIX/LD_LIBRARY_PATH)
     pkg_root = os.path.dirname(os.path.dirname(
@@ -186,20 +206,41 @@ def build_env(rank: int, size: int, host: str, port: int,
 def launch(n: int, argv: list[str], host: str = "127.0.0.1",
            mca: list[tuple[str, str]] | None = None,
            timeout: float | None = None, tag_output: bool = True,
-           stdout=None, stderr=None) -> int:
+           stdout=None, stderr=None, ft: bool = False) -> int:
     """Run ``argv`` as an ``n``-rank job; returns the job exit code.
 
     Python programs (``*.py``) run under the current interpreter; anything
     else is exec'd directly (a C program linked against the ABI shim).
     """
     return launch_mpmd([(n, argv)], host=host, mca=mca, timeout=timeout,
-                       tag_output=tag_output, stdout=stdout, stderr=stderr)
+                       tag_output=tag_output, stdout=stdout, stderr=stderr,
+                       ft=ft)
+
+
+def launch_dvm(dvm: str, n: int, argv: list[str],
+               mca: list[tuple[str, str]] | None = None,
+               timeout: float | None = None, tag_output: bool = True,
+               stdout=None, stderr=None, ft: bool = False) -> int:
+    """Launch a job INTO a resident runtime daemon (``zmpirun --dvm``):
+    the zprted VM hosts the PMIx store and the children, streams their
+    IOF back here, and outlives the job — no per-job rendezvous, no
+    name server, no launcher teardown (the prte DVM shape;
+    :mod:`zhpe_ompi_tpu.runtime.dvm`)."""
+    from ..runtime.dvm import DvmClient
+
+    client = DvmClient(dvm)
+    try:
+        return client.launch(n, argv, mca=mca, ft=ft, timeout=timeout,
+                             tag_output=tag_output, stdout=stdout,
+                             stderr=stderr)
+    finally:
+        client.close()
 
 
 def launch_mpmd(apps: list[tuple[int, list[str]]], host: str = "127.0.0.1",
                 mca: list[tuple[str, str]] | None = None,
                 timeout: float | None = None, tag_output: bool = True,
-                stdout=None, stderr=None) -> int:
+                stdout=None, stderr=None, ft: bool = False) -> int:
     """MPMD launch (mpirun's ``-n A progA : -n B progB``): one job, one
     COMM_WORLD, consecutive rank blocks per app context.  Mixed
     Python/C contexts share the wire protocol, so a C ring and a Python
@@ -209,7 +250,7 @@ def launch_mpmd(apps: list[tuple[int, list[str]]], host: str = "127.0.0.1",
     n = sum(cnt for cnt, _ in apps)
     stdout = stdout if stdout is not None else sys.stdout
     stderr = stderr if stderr is not None else sys.stderr
-    port = _start_coordinator(host, n, timeout or 120.0)
+    port, coord_srv = _start_coordinator(host, n, timeout or 120.0)
     ns_srv, ns_port = _start_name_server(host)
     cmds: list[list[str]] = []
     for cnt, argv in apps:
@@ -219,8 +260,12 @@ def launch_mpmd(apps: list[tuple[int, list[str]]], host: str = "127.0.0.1",
         cmds.extend([cmd] * cnt)
     try:
         return _launch_job(n, cmds, host, port, ns_port, mca, timeout,
-                           tag_output, stdout, stderr)
+                           tag_output, stdout, stderr, ft)
     finally:
+        # release the ports on EVERY exit path (signal teardown
+        # included): the rendezvous and name-server sockets must not
+        # outlive the job they served
+        coord_srv.close()
         ns_srv.close()  # stops the name-server accept loop
         _sweep_session_shm(port)
 
@@ -246,41 +291,48 @@ def _sweep_session_shm(port: int) -> None:
 
 
 def _launch_job(n, cmds, host, port, ns_port, mca, timeout, tag_output,
-                stdout, stderr) -> int:
+                stdout, stderr, ft: bool = False) -> int:
     procs: list[subprocess.Popen] = []
     drains: list[threading.Thread] = []
     out_lock = threading.Lock()
-    for rank in range(n):
-        try:
-            p = subprocess.Popen(
-                cmds[rank],
-                env=build_env(rank, n, host, port, mca, ns_port),
-                stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
-                start_new_session=True,  # isolate from our signal group
-            )
-        except OSError:
-            # MPMD makes mid-loop spawn failure real (a later context's
-            # binary may be missing): don't orphan already-spawned ranks
-            # in the modex rendezvous
-            _teardown(procs, set(range(len(procs))))
-            raise
-        procs.append(p)
-        for stream, label, sink in (
-            (p.stdout, "", stdout), (p.stderr, ":err", stderr),
-        ):
-            t = threading.Thread(
-                target=_forward,
-                args=(stream, rank, label, sink, out_lock, tag_output),
-                daemon=True,
-            )
-            t.start()
-            drains.append(t)
-
+    live: set = set()
     deadline = time.monotonic() + timeout if timeout else None
     exit_code = 0
     failed_rank = None
-    live = set(range(n))
+    # the spawn loop sits INSIDE the signal-handling try: a SIGTERM
+    # landing mid-spawn must tear down the ranks already started, not
+    # orphan them in the modex rendezvous (children run in their own
+    # sessions — the terminal's signal never reaches them directly)
     try:
+        for rank in range(n):
+            try:
+                p = subprocess.Popen(
+                    cmds[rank],
+                    env=build_env(rank, n, host, port, mca, ns_port, ft),
+                    stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                    text=True,
+                    start_new_session=True,  # isolate from our signals
+                )
+            except OSError:
+                # MPMD makes mid-loop spawn failure real (a later
+                # context's binary may be missing): don't orphan
+                # already-spawned ranks in the modex rendezvous
+                _teardown(procs, set(live))
+                raise
+            procs.append(p)
+            live.add(rank)
+            for stream, label, sink in (
+                (p.stdout, "", stdout), (p.stderr, ":err", stderr),
+            ):
+                t = threading.Thread(
+                    target=_forward,
+                    args=(stream, rank, label, sink, out_lock,
+                          tag_output),
+                    daemon=True,
+                )
+                t.start()
+                drains.append(t)
+
         while live:
             for rank in sorted(live):
                 rc = procs[rank].poll()
@@ -312,11 +364,38 @@ def _launch_job(n, cmds, host, port, ns_port, mca, timeout, tag_output,
                 break
             time.sleep(0.02)
     except KeyboardInterrupt:
+        # Ctrl-C without the CLI's handlers installed (library callers):
+        # same hygiene, conventional 130 = 128 + SIGINT
+        _forward_signal(procs, live, signal.SIGINT)
         _teardown(procs, live)
         exit_code = 130
+    except _JobSignal as js:
+        # the CLI's SIGINT/SIGTERM handler: forward the ACTUAL signal to
+        # the job first (ranks may catch it and finalize), then the
+        # TERM→KILL reaping ladder, then exit 128+sig
+        with out_lock:
+            stderr.write(
+                f"zmpirun: caught signal {js.signum}; forwarding to "
+                f"{len(live)} rank(s) and exiting\n"
+            )
+            stderr.flush()
+        _forward_signal(procs, live, js.signum)
+        _teardown(procs, live)
+        exit_code = 128 + js.signum
     for t in drains:
         t.join(timeout=2.0)
     return exit_code
+
+
+def _forward_signal(procs: list[subprocess.Popen], live: set,
+                    signum: int) -> None:
+    for rank in list(live):
+        p = procs[rank]
+        if p.poll() is None:
+            try:
+                os.killpg(os.getpgid(p.pid), signum)
+            except (OSError, ProcessLookupError):
+                pass
 
 
 def _teardown(procs: list[subprocess.Popen], live: set) -> None:
@@ -359,6 +438,14 @@ def main(args: list[str] | None = None) -> int:
                     help="kill the job after this many seconds")
     ap.add_argument("--no-tag-output", action="store_true",
                     help="forward child output without [rank] prefixes")
+    ap.add_argument("--dvm", default=None, metavar="HOST:PORT",
+                    help="launch into a resident zprted daemon instead "
+                         "of cold-spawning (python -m "
+                         "zhpe_ompi_tpu.runtime.dvm starts one)")
+    ap.add_argument("--ft", action="store_true",
+                    help="fault-tolerant job: ranks build ft=True "
+                         "endpoints (detector, typed failures, daemon "
+                         "fault events under --dvm)")
     ap.add_argument("argv", nargs=argparse.REMAINDER,
                     help="program and its arguments")
     raw = list(sys.argv[1:] if args is None else args)
@@ -380,16 +467,47 @@ def main(args: list[str] | None = None) -> int:
         # global flags belong to the FIRST context only; accepting them
         # later and ignoring them would silently drop user intent
         if (more.host != "127.0.0.1" or more.mca or
-                more.timeout is not None or more.no_tag_output):
+                more.timeout is not None or more.no_tag_output or
+                more.dvm or more.ft):
             ap.error(
-                "--host/--mca/--timeout/--no-tag-output are job-global: "
-                "pass them in the first app context"
+                "--host/--mca/--timeout/--no-tag-output/--dvm/--ft are "
+                "job-global: pass them in the first app context"
             )
         apps.append((more.n, more.argv))
-    return launch_mpmd(
-        apps, host=first.host, mca=[tuple(m) for m in first.mca],
-        timeout=first.timeout, tag_output=not first.no_tag_output,
-    )
+    # signal hygiene (main thread only — the CLI path): SIGINT/SIGTERM
+    # are forwarded to the job, children reaped, ports released, exit
+    # 128+sig — see _JobSignal
+    restore: dict[int, Any] = {}
+
+    def _on_signal(signum, _frame):
+        raise _JobSignal(signum)
+
+    if threading.current_thread() is threading.main_thread():
+        for s in (signal.SIGINT, signal.SIGTERM):
+            restore[s] = signal.signal(s, _on_signal)
+    try:
+        if first.dvm:
+            if len(apps) > 1:
+                ap.error("--dvm launches a single app context (MPMD "
+                         "stays on the cold path)")
+            return launch_dvm(
+                first.dvm, first.n, first.argv,
+                mca=[tuple(m) for m in first.mca],
+                timeout=first.timeout,
+                tag_output=not first.no_tag_output, ft=first.ft,
+            )
+        return launch_mpmd(
+            apps, host=first.host, mca=[tuple(m) for m in first.mca],
+            timeout=first.timeout, tag_output=not first.no_tag_output,
+            ft=first.ft,
+        )
+    except _JobSignal as js:
+        # a signal that landed outside the monitor loop (teardown
+        # already ran, or the job never started): same exit contract
+        return 128 + js.signum
+    finally:
+        for s, h in restore.items():
+            signal.signal(s, h)
 
 
 if __name__ == "__main__":
