@@ -498,6 +498,8 @@ _HAN_COUNTERS = (
     "coll_han_leader_elections", "coll_han_pipelined",
     "tcp_bytes_sent", "sm_bytes_sent",
     "tcp_isend_deferred", "sm_ring_full_spins", "sm_frag_sends",
+    "coll_han_numa_collectives", "coll_han_dleader_bytes",
+    "han_numa_fallbacks", "sm_rings_materialized",
 )
 
 
@@ -559,7 +561,14 @@ def _han_worker_body(proc, spec: dict) -> tuple[list[dict], dict]:
                     "bandwidth_MBps": (arr.nbytes / sec) / 1e6,
                 })
         proc.barrier()
-    return rows, {c: spc.read(c) - base[c] for c in _HAN_COUNTERS}
+    sm_stats = None
+    if spec.get("report_sm"):
+        # the demand-mapping footprint view of THIS rank's own segment
+        # (read before close() — the numa ladder's role-bound gate)
+        fn = getattr(proc, "sm_segment_stats", None)
+        sm_stats = fn() if fn is not None else None
+    return (rows, {c: spc.read(c) - base[c] for c in _HAN_COUNTERS},
+            sm_stats)
 
 
 def _worker_main(spec: dict) -> int:
@@ -576,19 +585,23 @@ def _worker_main(spec: dict) -> int:
     rank, n = int(spec["rank"]), int(spec["size"])
     proc = TcpProc(rank, n, coordinator=("127.0.0.1", int(spec["port"])),
                    timeout=120.0, sm=bool(spec.get("sm", True)),
-                   sm_boot_id=spec.get("boot"))
+                   sm_boot_id=spec.get("boot"),
+                   sm_numa_id=spec.get("numa"))
     if spec["kind"] == "han":
         from zhpe_ompi_tpu.mca import var as mca_var
 
         mca_var.set_var("coll_han_enable", spec["han_mode"])
         mca_var.set_var("coll_han_pipeline",
                         spec.get("pipeline", "auto"))
+        mca_var.set_var("coll_han_numa_level",
+                        spec.get("numa_mode", "auto"))
         try:
-            rows, deltas = _han_worker_body(proc, spec)
+            rows, deltas, sm_stats = _han_worker_body(proc, spec)
         finally:
             proc.close()
         print(json.dumps({"rank": rank, "rows": rows,
-                          "counters": deltas}), flush=True)
+                          "counters": deltas,
+                          "sm_stats": sm_stats}), flush=True)
         return 0
     rows = []
     fb0 = spc.read("sm_fallback_tcp_sends")
@@ -736,28 +749,40 @@ def _run_proc_bench_once(spec: dict, nprocs: int,
     return report["rows"]
 
 
-def _run_han_threads(spec: dict, nprocs: int, boots: dict) -> list:
-    """Thread-harness variant of the han ladder (one process, shared
-    counters): used by the fast CI rows test; real deployments and the
-    slow gate use ``--real-procs``."""
+def _run_han_threads(spec: dict, nprocs: int, boots: dict,
+                     numas: dict | None = None) -> list:
+    """Thread-harness variant of the han/numa ladder (one process,
+    shared counters): used by the fast CI rows tests; real deployments
+    and the slow gates use ``--real-procs``.  Returns one report per
+    rank — rank 0 carries the rows and the PROCESS-GLOBAL counter
+    deltas (threads share the spc registry), every rank carries its
+    own segment's demand-mapping stats."""
     from zhpe_ompi_tpu.mca import var as mca_var
     from zhpe_ompi_tpu.runtime import spc
 
     base = {c: spc.read(c) for c in _HAN_COUNTERS}
+    kwargs_by_rank = {r: {"sm_boot_id": b} for r, b in boots.items()}
+    for r, numa in (numas or {}).items():
+        kwargs_by_rank.setdefault(r, {})["sm_numa_id"] = numa
     mca_var.set_var("coll_han_enable", spec["han_mode"])
     mca_var.set_var("coll_han_pipeline", spec.get("pipeline", "auto"))
+    mca_var.set_var("coll_han_numa_level", spec.get("numa_mode", "auto"))
     try:
         res = _run_tcp_ranks(
             nprocs, lambda p: _han_worker_body(p, spec),
-            kwargs_by_rank={r: {"sm_boot_id": b} for r, b in boots.items()},
+            kwargs_by_rank=kwargs_by_rank,
         )
     finally:
         mca_var.unset("coll_han_enable")
         mca_var.unset("coll_han_pipeline")
-    rows = next(rows for rows, _deltas in res if rows)
-    return [{"rank": 0, "rows": rows,
-             "counters": {c: spc.read(c) - base[c]
-                          for c in _HAN_COUNTERS}}]
+        mca_var.unset("coll_han_numa_level")
+    deltas = {c: spc.read(c) - base[c] for c in _HAN_COUNTERS}
+    zeros = {c: 0 for c in _HAN_COUNTERS}
+    return [{"rank": r,
+             "rows": rows if rows else [],
+             "counters": deltas if r == 0 else zeros,
+             "sm_stats": stats}
+            for r, (rows, _d, stats) in enumerate(res)]
 
 
 def bench_han(max_size: int = 4 << 20, iters: int = 5, nprocs: int = 4,
@@ -840,6 +865,153 @@ def bench_han(max_size: int = 4 << 20, iters: int = 5, nprocs: int = 4,
             "han plane: the pipeline ladder crossed >= 2-segment sizes "
             "but no allreduce took the pipelined schedule"
         )
+    return out_rows
+
+
+def _numa_layout(nprocs: int, hosts: int, domains: int
+                 ) -> tuple[dict, dict, dict]:
+    """(boots, numas, domains-as-hosts boots) of the emulated
+    ``hosts × domains × ranks-per-domain`` topology: real boot ids per
+    host + numa tokens per domain for the three-level row, and one
+    DISTINCT boot per (host, domain) for the pre-NUMA baseline — the
+    only way the two-level world could express domain structure at
+    all (every domain leader then pays wire prices)."""
+    per_host = max(1, -(-nprocs // hosts))
+    per_dom = max(1, -(-per_host // domains))
+    boots, numas, domhost_boots = {}, {}, {}
+    for r in range(nprocs):
+        h, d = r // per_host, (r % per_host) // per_dom
+        boots[r] = f"numahost{h}"
+        numas[r] = f"d{d}"
+        domhost_boots[r] = f"numahost{h}d{d}"
+    return boots, numas, domhost_boots
+
+
+def bench_numa(max_size: int = 1 << 20, iters: int = 3, nprocs: int = 8,
+               hosts: int = 2, domains: int = 2, real_procs: bool = True,
+               trials: int | None = None) -> list[dict]:
+    """NUMA-level ladder on the emulated ``hosts × domains ×
+    ranks-per-domain`` real-process topology (per-rank ``sm_boot_id``
+    + ``sm_numa_id`` pins): three-level han (``han3``) against the
+    pre-NUMA two-level world's only way to respect domains —
+    domains-as-hosts (``han2dom``, one distinct boot per (host,
+    domain), every domain leader on the wire) — plus an ungated flat
+    reference row.  Sizes start at 256 KiB (the acceptance band).
+    Deterministic gates, byte-accounted rather than timed (latency
+    rows are best-of-N but the 1-CPU container's scheduler noise makes
+    them report-only):
+
+    - zero ``han_flat_fallbacks`` AND zero ``han_numa_fallbacks`` on
+      both hierarchical rows (no silent degradation);
+    - the three-level schedule actually engaged
+      (``coll_han_numa_collectives`` > 0) and both exchange phases
+      moved bytes (``coll_han_dleader_bytes`` > 0,
+      ``coll_han_inter_bytes`` > 0);
+    - han3's inter-host wire bytes STRICTLY below han2dom's leader
+      bytes at equal payload — the fewer-wire-bytes claim;
+    - demand-mapping footprint: every han3 rank's materialized ring
+      set stays within its ROLE bound (domain siblings + fellow
+      domain leaders for dleaders — never the whole universe) and its
+      logical footprint under the pre-carve equivalent
+      ``(size-1) × sm_ring_bytes``."""
+    from zhpe_ompi_tpu.mca import var as mca_var
+
+    boots, numas, domhost_boots = _numa_layout(nprocs, hosts, domains)
+    min_bytes = min(256 << 10, max_size)
+    spec_base = {"kind": "han", "max_size": max_size, "iters": iters,
+                 "min_bytes": min_bytes, "report_sm": True}
+    if trials:
+        spec_base["trials"] = trials
+    configs = (
+        ("flat", "off", "off", boots, numas),
+        ("han2dom", "on", "off", domhost_boots, {}),
+        ("han3", "on", "on", boots, numas),
+    )
+    out_rows: list[dict] = []
+    agg: dict[str, dict] = {}
+    stats: dict[str, list] = {}
+    for label, han_mode, numa_mode, blist, nlist in configs:
+        spec = dict(spec_base, han_mode=han_mode, numa_mode=numa_mode,
+                    pipeline="off", label=label)
+        if real_procs:
+            overrides = {r: {"boot": blist[r]} for r in range(nprocs)}
+            for r, numa in nlist.items():
+                overrides[r]["numa"] = numa
+            reports = _run_proc_bench(spec, nprocs,
+                                      rank_overrides=overrides,
+                                      collect_all=True)
+        else:
+            reports = _run_han_threads(spec, nprocs, blist, nlist)
+        out_rows += next(r["rows"] for r in reports if r["rows"])
+        agg[label] = {c: sum(r["counters"][c] for r in reports)
+                      for c in _HAN_COUNTERS}
+        stats[label] = [r.get("sm_stats") for r in reports]
+    for label in ("han2dom", "han3"):
+        if agg[label]["han_flat_fallbacks"]:
+            raise RuntimeError(
+                f"numa plane ({label}): "
+                f"{agg[label]['han_flat_fallbacks']} collective(s) "
+                "silently fell back to flat on a qualified topology"
+            )
+    if agg["han3"]["han_numa_fallbacks"]:
+        raise RuntimeError(
+            f"numa plane: {agg['han3']['han_numa_fallbacks']} "
+            "collective(s) silently fell back to two-level on a "
+            "qualified nested topology"
+        )
+    if agg["han3"]["coll_han_numa_collectives"] == 0:
+        raise RuntimeError(
+            "numa plane: the three-level schedule never engaged"
+        )
+    for counter in ("coll_han_dleader_bytes", "coll_han_inter_bytes"):
+        if agg["han3"][counter] == 0:
+            raise RuntimeError(
+                f"numa plane: no {counter} moved (a nested phase "
+                "never ran?)"
+            )
+    if agg["han3"]["coll_han_inter_bytes"] >= \
+            agg["han2dom"]["coll_han_inter_bytes"]:
+        raise RuntimeError(
+            "numa plane: three-level wire bytes "
+            f"({agg['han3']['coll_han_inter_bytes']}) not strictly "
+            "below the domains-as-hosts leader bytes "
+            f"({agg['han2dom']['coll_han_inter_bytes']})"
+        )
+    # role-bound footprint gate (the demand-mapping win, bitmap-gated)
+    per_host = max(1, -(-nprocs // hosts))
+    per_dom = max(1, -(-per_host // domains))
+    precarve = (nprocs - 1) * int(mca_var.get("sm_ring_bytes", 4 << 20))
+    for rank, st in enumerate(stats["han3"]):
+        if st is None:
+            raise RuntimeError(
+                f"numa plane: rank {rank} reported no segment stats "
+                "(sm plane off?)"
+            )
+        def dom_of(r):
+            return r // per_host, (r % per_host) // per_dom
+
+        dom = [r for r in range(nprocs) if dom_of(r) == dom_of(rank)]
+        allowed = set(dom)
+        if rank == dom[0]:  # domain leader: fellow dleaders of the host
+            host_members = [r for r in range(nprocs)
+                            if r // per_host == rank // per_host]
+            allowed |= {min(r for r in host_members
+                            if dom_of(r) == dom_of(m))
+                        for m in host_members}
+        allowed.discard(rank)
+        extra = set(st["materialized"]) - allowed
+        if extra:
+            raise RuntimeError(
+                f"numa plane: rank {rank} materialized rings outside "
+                f"its role bound: {sorted(extra)} (allowed "
+                f"{sorted(allowed)})"
+            )
+        if st["footprint_bytes"] >= precarve:
+            raise RuntimeError(
+                f"numa plane: rank {rank}'s footprint "
+                f"({st['footprint_bytes']}B) not below the pre-carve "
+                f"equivalent ({precarve}B)"
+            )
     return out_rows
 
 
@@ -1088,7 +1260,7 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--window", type=int, default=16,
                    help="frames in flight per ack in --bw mode")
     p.add_argument("--plane", default="device",
-                   choices=("device", "host", "sm", "han"),
+                   choices=("device", "host", "sm", "han", "numa"),
                    help="collectives: device = XLA mesh (default); "
                         "host = coll/host over real loopback sockets; "
                         "sm = same, with the shared-memory rings "
@@ -1096,12 +1268,18 @@ def main(argv: list[str] | None = None) -> int:
                         "fallback failing the run; han = real-process "
                         "flat-vs-hierarchical ladder on an emulated "
                         "--hosts-way mixed topology, silent flat "
-                        "fallback failing the run")
+                        "fallback failing the run; numa = three-level "
+                        "vs domains-as-hosts two-level ladder on the "
+                        "emulated --hosts x --domains topology, "
+                        "counter- and footprint-gated")
     p.add_argument("--nprocs", type=int, default=4,
-                   help="socket ranks for --plane host/sm/han "
-                        "collectives")
+                   help="socket ranks for --plane host/sm/han/numa "
+                        "collectives (numa defaults to hosts*domains*2)")
     p.add_argument("--hosts", type=int, default=2,
-                   help="--plane han: emulated same-boot host groups")
+                   help="--plane han/numa: emulated same-boot host "
+                        "groups")
+    p.add_argument("--domains", type=int, default=2,
+                   help="--plane numa: emulated NUMA domains per host")
     p.add_argument("--real-procs", action="store_true",
                    help="--plane sm: ranks as separate OS processes "
                         "(the cross-process case; threads share a GIL)")
@@ -1132,6 +1310,12 @@ def main(argv: list[str] | None = None) -> int:
     elif args.plane == "han":
         rows = bench_han(args.max_size, max(args.iters, 3),
                          nprocs=args.nprocs, hosts=args.hosts)
+    elif args.plane == "numa":
+        nprocs = args.nprocs if args.nprocs != 4 \
+            else args.hosts * args.domains * 2
+        rows = bench_numa(args.max_size, max(args.iters, 2),
+                          nprocs=nprocs, hosts=args.hosts,
+                          domains=args.domains)
     elif args.op == "tcp" and args.plane == "sm":
         rows = bench_sm(args.max_size, max(args.iters, 10),
                         bw=args.bw, window=args.window,

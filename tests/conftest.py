@@ -90,6 +90,13 @@ def _ulfm_detector_hygiene():
     )
     polls = sm_mod.live_poll_threads()
     assert not polls, f"sm poll threads leaked: {polls}"
+    audits = sm_mod.segment_audit_failures()
+    assert not audits, (
+        f"sm segment close-time audits failed (the demand-mapping "
+        f"contract: footprint matches the allocation bitmap, no ring "
+        f"materialized for a peer that never sent, zero orphaned "
+        f"directory entries): {audits}"
+    )
     from zhpe_ompi_tpu.pt2pt import groups as groups_mod
 
     windows = groups_mod.leaked_tag_windows()

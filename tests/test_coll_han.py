@@ -496,3 +496,401 @@ class TestWireCorrectness:
         assert run_wire(4, prog, boots_2x2()) == [True] * 4
         assert groups_mod.leaked_tag_windows() == []
         assert groups_mod.live_election_threads() == []
+
+
+# ---------------------------------------------------------------- NUMA level
+
+
+NEST_2x2x2 = [[[0, 1], [2, 3]], [[4, 5], [6, 7]]]
+NEST_1x2x2 = [[[0, 1], [2, 3]]]
+
+
+def numa_kwargs_1x2x2():
+    """4 wire ranks on ONE emulated host split into two domains."""
+    return {r: {"sm_boot_id": "numahost",
+                "sm_numa_id": f"d{r // 2}"} for r in range(4)}
+
+
+class TestNumaDerivation:
+    """The host→domain derivation ladder: pynuma tokens group within a
+    host, absent tokens share the default domain, malformed tokens are
+    counted and demoted to singleton domains — and the derivation
+    never raises out of a foreign card."""
+
+    def test_wire_nested_derivation(self, fresh_vars):
+        def prog(p):
+            return groups_mod.locality_groups(p, nested=True)
+
+        for g in run_wire(4, prog, numa_kwargs_1x2x2()):
+            assert g == NEST_1x2x2
+
+    def test_interleaved_domains_group_by_token(self, fresh_vars):
+        kw = {r: {"sm_boot_id": "numahost",
+                  "sm_numa_id": f"d{r % 2}"} for r in range(4)}
+
+        def prog(p):
+            return groups_mod.locality_groups(p, nested=True)
+
+        for g in run_wire(4, prog, kw):
+            assert g == [[[0, 2], [1, 3]]]
+
+    def test_singleton_domains(self, fresh_vars):
+        kw = {r: {"sm_boot_id": "numahost",
+                  "sm_numa_id": f"d{r}"} for r in range(3)}
+
+        def prog(p):
+            return groups_mod.locality_groups(p, nested=True)
+
+        for g in run_wire(3, prog, kw):
+            assert g == [[[0], [1], [2]]]
+
+    def test_absent_tokens_share_the_default_domain(self):
+        """Mixed old/new cards: ranks whose card carries no pynuma item
+        fold into the host's single default domain (old cards stay
+        parseable; the host merely loses its domain split for them)."""
+        class Ep:
+            rank, size = 0, 4
+
+            def boot_token_of(self, r):
+                return "hostX"
+
+            def numa_token_of(self, r):
+                return {0: "d0", 3: "d1"}.get(r)  # 1, 2: absent
+
+        assert groups_mod.locality_groups(Ep(), nested=True) == \
+            [[[0], [1, 2], [3]]]
+
+    def test_all_old_cards_degrade_to_single_domain(self):
+        class Ep:
+            rank, size = 0, 3
+
+            def boot_token_of(self, r):
+                return "hostX"
+
+            def numa_token_of(self, r):
+                return None
+
+        assert groups_mod.locality_groups(Ep(), nested=True) == \
+            [[[0, 1, 2]]]
+
+    def test_malformed_card_counts_and_demotes_to_singleton(self):
+        """A malformed foreign pynuma item must never raise out of
+        topology derivation: the rank is counted and becomes its own
+        singleton domain."""
+        from zhpe_ompi_tpu.pt2pt import sm as sm_mod
+
+        class Ep:
+            rank, size = 0, 3
+
+            def boot_token_of(self, r):
+                return "hostX"
+
+            def numa_token_of(self, r):
+                if r == 1:
+                    return sm_mod.NUMA_MALFORMED
+                return "d0"
+
+        c0 = spc.read("han_malformed_numa_cards")
+        assert groups_mod.locality_groups(Ep(), nested=True) == \
+            [[[0, 2], [1]]]
+        assert spc.read("han_malformed_numa_cards") == c0 + 1
+
+    def test_raising_token_fetch_never_escapes(self):
+        class Ep:
+            rank, size = 0, 2
+
+            def boot_token_of(self, r):
+                return "hostX"
+
+            def numa_token_of(self, r):
+                if r == 1:
+                    raise ValueError("corrupt foreign card")
+                return "d0"
+
+        c0 = spc.read("han_malformed_numa_cards")
+        topo = han.topology(Ep())
+        assert topo.nested == [[[0], [1]]]
+        assert spc.read("han_malformed_numa_cards") == c0 + 1
+
+    def test_parse_numa_card_shapes(self):
+        from zhpe_ompi_tpu.pt2pt import sm as sm_mod
+
+        assert sm_mod.parse_numa(["h", 1, "pynuma:3"]) == "3"
+        assert sm_mod.parse_numa(["h", 1]) is None  # old card
+        assert sm_mod.parse_numa("bogus") is None
+        assert sm_mod.parse_numa(["h", 1, "pynuma:"]) \
+            is sm_mod.NUMA_MALFORMED
+        assert sm_mod.parse_numa(["h", 1, "pynuma:a:b"]) \
+            is sm_mod.NUMA_MALFORMED
+
+    def test_rejoiner_scrub_is_a_singleton(self, fresh_vars):
+        """The _ft_join card scrub (rejoiners ride TCP) drops BOTH the
+        pyshm and pynuma items: the rejoined rank derives as its own
+        singleton host — and therefore its own singleton domain."""
+        def prog(p):
+            if p.rank == 0:
+                # simulate the scrub a JOIN performs on a survivor's
+                # book: the joiner's card collapses to (host, port)
+                p._peer_cards[1] = list(p._peer_cards[1][:2])
+                return groups_mod.locality_groups(p, nested=True)
+            return None
+
+        res = run_wire(4, prog, numa_kwargs_1x2x2())
+        # rank 1 is a singleton host (and so a singleton domain); the
+        # remaining host keeps its d0/d1 split
+        assert res[0] == [[[0], [2, 3]], [[1]]]
+
+
+class TestNestedGroupView:
+    """View-of-view: rel/parent/base translation, window disjointness
+    under alternating layouts, and seq continuity across re-created
+    nested views."""
+
+    def test_nested_translation_and_traffic(self):
+        uni = LocalUniverse(4)
+
+        def prog(ctx):
+            hview = groups_mod.GroupView(ctx, [0, 1, 2, 3], window=0)
+            if ctx.rank not in (2, 3):
+                return True
+            dview = groups_mod.GroupView(
+                hview, [2, 3], window=groups_mod.DOMAIN_WINDOW_BASE,
+                plane="intra")
+            assert dview._ep is ctx  # flattened to the base endpoint
+            assert dview.size == 2
+            # parent-relative vs base translation
+            assert dview.parent_rank(0) == 2  # hview rank
+            assert dview.base_rank(0) == 2    # ctx rank (same here)
+            assert dview.rel(3) == 1
+            assert dview.rel_base(3) == 1
+            if ctx.rank == 2:
+                dview.send(("deep", 1), 1, tag=4)
+                return True
+            got, st = dview.recv(source=0, tag=4, return_status=True)
+            assert st.source == 0  # view-relative status
+            return got
+
+        res = uni.run(prog)
+        assert res[3] == ("deep", 1)
+
+    def test_nested_nonmember_refused(self):
+        uni = LocalUniverse(4)
+
+        def prog(ctx):
+            hview = groups_mod.GroupView(ctx, [0, 1, 2, 3], window=0)
+            if ctx.rank == 0:
+                with pytest.raises(errors.ArgError):
+                    groups_mod.GroupView(
+                        hview, [1, 2],
+                        window=groups_mod.DOMAIN_WINDOW_BASE)
+            return True
+
+        assert uni.run(prog) == [True] * 4
+
+    def test_windows_disjoint_across_levels_and_layouts(self):
+        """Three-level collectives interleaved with flat and TWO-level
+        collectives on the same endpoint: the disjoint window ranges
+        keep every per-window tag sequence uniform among its members
+        (the collision would deadlock, not just corrupt)."""
+        uni = LocalUniverse(8)
+
+        def prog(ctx):
+            out = []
+            out.append(han.allreduce(ctx, ctx.rank + 1, ops.SUM,
+                                     groups=NEST_2x2x2))
+            out.append(host.allreduce(ctx, 1, ops.SUM))
+            out.append(float(np.asarray(han.allreduce(
+                ctx, np.full(4, 1.0), ops.SUM,
+                groups=[[0, 1, 2, 3], [4, 5, 6, 7]]))[0]))
+            out.append(han.allreduce(ctx, ctx.rank + 1, ops.SUM,
+                                     groups=NEST_2x2x2))
+            return out
+
+        assert uni.run(prog) == [[36, 8, 8.0, 36]] * 8
+
+    def test_nested_seq_continuity_across_recreation(self):
+        """Re-created nested views continue their windows' tag
+        sequences (seqs live on the BASE endpoint): invalidating the
+        view cache between collectives must not re-match instances."""
+        uni = LocalUniverse(8)
+
+        def prog(ctx):
+            out = []
+            for _ in range(3):
+                han.invalidate(ctx)
+                out.append(float(np.asarray(han.allreduce(
+                    ctx, np.full(4, 1.0), ops.SUM,
+                    groups=NEST_2x2x2))[0]))
+            return out
+
+        assert uni.run(prog) == [[8.0, 8.0, 8.0]] * 8
+
+
+class TestNumaAlgorithms:
+    """The three-level schedules against their flat twins on the
+    thread plane with synthetic nested groups."""
+
+    def test_allreduce_matches_flat(self):
+        uni = LocalUniverse(8)
+
+        def prog(ctx):
+            a = han.allreduce(ctx, np.full(6, float(ctx.rank + 1)),
+                              ops.SUM, groups=NEST_2x2x2)
+            return float(np.asarray(a)[0])
+
+        assert uni.run(prog) == [36.0] * 8
+
+    def test_allreduce_large_split_mode(self, fresh_vars):
+        mca_var.set_var("host_coll_large_msg", 1024)
+        mca_var.set_var("coll_han_inter_segment", 2048)
+        uni = LocalUniverse(8)
+
+        def prog(ctx):
+            arr = np.full(4096, float(ctx.rank + 1))
+            out = np.asarray(han.allreduce(ctx, arr, ops.SUM,
+                                           groups=NEST_2x2x2))
+            return (float(out[0]), float(out[-1]), out.shape)
+
+        assert uni.run(prog) == [(36.0, 36.0, (4096,))] * 8
+
+    def test_uneven_nested_groups(self):
+        nest = [[[0, 1, 2], [3]], [[4, 5], [6, 7]]]
+        uni = LocalUniverse(8)
+
+        def prog(ctx):
+            return han.allreduce(ctx, ctx.rank + 1, ops.SUM,
+                                 groups=nest)
+
+        assert uni.run(prog) == [36] * 8
+
+    @pytest.mark.parametrize("root", range(8))
+    def test_bcast_all_roots(self, root):
+        uni = LocalUniverse(8)
+
+        def prog(ctx):
+            payload = ("deep payload", root) if ctx.rank == root else None
+            return han.bcast(ctx, payload, root=root, groups=NEST_2x2x2)
+
+        assert uni.run(prog) == [("deep payload", root)] * 8
+
+    def test_barrier_runs(self):
+        uni = LocalUniverse(8)
+
+        def prog(ctx):
+            for _ in range(3):
+                han.barrier(ctx, groups=NEST_2x2x2)
+            return True
+
+        assert uni.run(prog) == [True] * 8
+
+    def test_single_host_domain_hierarchy(self):
+        """The NUMA level carries a host-degenerate topology: one host
+        whose domains split still gets a hierarchy (domain reduce →
+        dleader exchange → trivial wire phase)."""
+        uni = LocalUniverse(4)
+
+        def prog(ctx):
+            return han.allreduce(ctx, ctx.rank + 1, ops.SUM,
+                                 groups=NEST_1x2x2)
+
+        assert uni.run(prog) == [10] * 4
+
+    def test_noncommutative_op_refused(self):
+        class NonCommute:
+            commute = False
+
+            def __call__(self, a, b):  # pragma: no cover
+                return a
+
+        uni = LocalUniverse(8)
+
+        def prog(ctx):
+            with pytest.raises(errors.ArgError):
+                han.allreduce(ctx, 1.0, NonCommute(), groups=NEST_2x2x2)
+            return True
+
+        assert uni.run(prog) == [True] * 8
+
+
+class TestNumaDecision:
+    """coll_han_numa_level auto/on/off: the auto qualification bar, the
+    loud TWO-level (never flat) fallback on degenerate NUMA structure,
+    and decision engagement over the wire."""
+
+    def test_auto_bar_needs_two_multirank_domains(self, fresh_vars):
+        c0 = spc.read("coll_han_numa_collectives")
+        uni = LocalUniverse(8)
+
+        # one multi-rank domain per host: two-level is just as good
+        nest = [[[0, 1, 2, 3]], [[4, 5, 6, 7]]]
+
+        def prog(ctx):
+            return han.allreduce(ctx, ctx.rank + 1, ops.SUM,
+                                 groups=nest)
+
+        assert uni.run(prog) == [36] * 8
+        assert spc.read("coll_han_numa_collectives") == c0
+
+    def test_auto_engages_on_qualified_nested(self, fresh_vars):
+        c0 = spc.read("coll_han_numa_collectives")
+        uni = LocalUniverse(8)
+
+        def prog(ctx):
+            return han.allreduce(ctx, ctx.rank + 1, ops.SUM,
+                                 groups=NEST_2x2x2)
+
+        assert uni.run(prog) == [36] * 8
+        assert spc.read("coll_han_numa_collectives") == c0 + 8
+
+    def test_off_never_nests(self, fresh_vars):
+        mca_var.set_var("coll_han_numa_level", "off")
+        c0 = spc.read("coll_han_numa_collectives")
+        uni = LocalUniverse(8)
+
+        def prog(ctx):
+            return han.allreduce(ctx, ctx.rank + 1, ops.SUM,
+                                 groups=NEST_2x2x2)
+
+        assert uni.run(prog) == [36] * 8
+        assert spc.read("coll_han_numa_collectives") == c0
+
+    def test_forced_on_degenerate_numa_falls_back_to_two_level(
+            self, fresh_vars):
+        """The fallback-bugfix contract: a degenerate NUMA structure
+        under coll_han_numa_level=on runs the TWO-level path (host
+        level still viable) — counted per rank, never silent, and
+        NEVER all the way to flat (han_flat_fallbacks stays put)."""
+        mca_var.set_var("coll_han_numa_level", "on")
+        f0 = spc.read("han_numa_fallbacks")
+        flat0 = spc.read("han_flat_fallbacks")
+        uni = LocalUniverse(8)
+        nest = [[[0, 1, 2, 3]], [[4, 5, 6, 7]]]  # no domain split
+
+        def prog(ctx):
+            return han.allreduce(ctx, ctx.rank + 1, ops.SUM,
+                                 groups=nest)
+
+        assert uni.run(prog) == [36] * 8
+        assert spc.read("han_numa_fallbacks") == f0 + 8
+        assert spc.read("han_flat_fallbacks") == flat0
+
+    def test_wire_auto_engages_and_counts(self, fresh_vars):
+        """Full decision path over real sockets: a forced han +
+        auto numa level on the emulated 1-host × 2-domain topology
+        rides the three-level schedule with zero fallbacks."""
+        mca_var.set_var("coll_han_enable", "on")
+        c0 = spc.read("coll_han_numa_collectives")
+        d0 = spc.read("coll_han_dleader_bytes")
+        f0 = spc.read("han_flat_fallbacks")
+
+        def prog(p):
+            out = float(np.asarray(p.allreduce(
+                np.full(8, float(p.rank + 1)), ops.SUM))[0])
+            p.barrier()
+            return out
+
+        res = run_wire(4, prog, numa_kwargs_1x2x2())
+        assert res == [10.0] * 4
+        assert spc.read("coll_han_numa_collectives") > c0
+        assert spc.read("coll_han_dleader_bytes") > d0
+        assert spc.read("han_flat_fallbacks") == f0

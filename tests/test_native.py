@@ -246,3 +246,46 @@ class TestShmAmo:
         [t.start() for t in ts]
         [t.join() for t in ts]
         assert a[0] == ADDS * THREADS
+
+
+class TestNativeMatchingParityWildcards:
+    """Binned-Python vs native-C parity on a wildcard-heavy multi-cid
+    mix: delivery order, probe/extract results, stats, and
+    stats_excluding's EXACT counts must agree event for event."""
+
+    def test_parity_wildcard_mix_and_stats_excluding(self):
+        if not native.available():
+            pytest.skip("no native lib")
+        rng = np.random.default_rng(7)
+        neng, peng = (matching.NativeMatchingEngine(),
+                      matching.MatchingEngine())
+        nlog, plog = [], []
+        for i in range(400):
+            kind = int(rng.integers(0, 3))
+            src = int(rng.integers(-1, 4))
+            tag = int(rng.integers(-1, 3))
+            cid = int(rng.integers(0, 3))
+            if kind == 0:
+                neng.post_recv(src, tag, cid,
+                               lambda e, p, i=i: nlog.append(
+                                   (i, e.src, e.seq, p)))
+                peng.post_recv(src, tag, cid,
+                               lambda e, p, i=i: plog.append(
+                                   (i, e.src, e.seq, p)))
+            elif kind == 1:
+                env = matching.Envelope(max(src, 0), max(tag, 0), cid, i)
+                neng.incoming(env, f"m{i}")
+                peng.incoming(env, f"m{i}")
+            else:
+                ne = neng.extract(src, tag, cid)
+                pe = peng.extract(src, tag, cid)
+                assert (ne is None) == (pe is None)
+                if ne is not None:
+                    assert ne[0] == pe[0] and ne[1] == pe[1]
+            assert neng.probe(src, tag, cid) == peng.probe(src, tag, cid)
+        assert nlog == plog
+        assert neng.stats() == peng.stats()
+        for srcs, cids in (((0,), ()), ((1, 2), (0,)), ((), (1, 2)),
+                           ((-1,), ()), ((0, 1, 2, 3), (0, 1, 2))):
+            assert neng.stats_excluding(srcs, cids) == \
+                peng.stats_excluding(srcs, cids), (srcs, cids)

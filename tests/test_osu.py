@@ -261,3 +261,44 @@ def test_han_ladder_no_silent_flat_fallback_real_procs():
                               real_procs=True)
     assert any(r["op"] == "han_host_allreduce" for r in rows)
     assert any(r["op"] == "flat_host_allreduce" for r in rows)
+
+
+def test_numa_rows_thread_harness():
+    """Fast smoke for the --plane numa ladder (thread harness): the
+    flat, domains-as-hosts two-level, and three-level legs emit sane
+    rows at the 256 KiB acceptance band, and every built-in gate holds
+    — zero flat/numa fallbacks, the three-level schedule engaged
+    (coll_han_numa_collectives), both nested exchange phases moved
+    bytes, han3's wire bytes STRICTLY below the domains-as-hosts
+    leader bytes, and every rank's materialized ring set inside its
+    role bound (the demand-mapping footprint gate)."""
+    rows = osu_zmpi.bench_numa(max_size=256 << 10, iters=1,
+                               nprocs=8, hosts=2, domains=2,
+                               real_procs=False, trials=1)
+    for prefix in ("flat_host_allreduce", "han2dom_host_allreduce",
+                   "han3_host_allreduce", "flat_host_bcast",
+                   "han2dom_host_bcast", "han3_host_bcast"):
+        sub = [r for r in rows if r["op"] == prefix]
+        assert sub, f"no rows for {prefix}"
+        for r in sub:
+            assert r["bytes"] >= 256 << 10
+            assert r["latency_us"] > 0
+            assert np.isfinite(r["bandwidth_MBps"])
+
+
+@pytest.mark.slow
+def test_numa_ladder_real_procs():
+    """CI gate for the NUMA level over REAL processes: the emulated
+    2-host x 2-domain x 2-rank topology (per-rank sm_boot_id +
+    sm_numa_id pins) runs the three-level schedule end to end, and
+    bench_numa raises on any silent degradation — flat fallbacks,
+    numa fallbacks, an unengaged nested phase, three-level wire bytes
+    not strictly below the domains-as-hosts baseline at >= 256 KiB,
+    a ring materialized outside a rank's role bound, or a per-proc
+    footprint at/above the size x sm_ring_bytes pre-carve.  Latency
+    rows are best-of-N but report-only (1-CPU container noise)."""
+    rows = osu_zmpi.bench_numa(max_size=1 << 20, iters=2,
+                               nprocs=8, hosts=2, domains=2,
+                               real_procs=True, trials=2)
+    assert any(r["op"] == "han3_host_allreduce" for r in rows)
+    assert any(r["op"] == "han2dom_host_allreduce" for r in rows)

@@ -300,3 +300,137 @@ class TestGetCount:
         t = create_contiguous(2, INT32_T)
         assert get_count(Status(count_bytes=10), t) == UNDEFINED
         assert get_count(Status(count_bytes=16), t) == 2
+
+
+class TestMatchingBins:
+    """The (cid, src) hash-bin index under the classic matching
+    semantics: per-source FIFO, true cross-source arrival order for
+    ANY_SOURCE, post-order merge of wildcard vs specific receives,
+    exact stats — and the comparison-count SPC gate that keeps the
+    bins from silently regressing to linear scans."""
+
+    def test_any_source_matches_in_cross_source_arrival_order(self):
+        eng = matching.MatchingEngine()
+        eng.incoming(Envelope(3, 1, 0, 0), "a")
+        eng.incoming(Envelope(1, 1, 0, 0), "b")
+        eng.incoming(Envelope(3, 1, 0, 1), "c")
+        eng.incoming(Envelope(0, 1, 0, 0), "d")
+        got = []
+        for _ in range(4):
+            eng.post_recv(ANY_SOURCE, 1, 0, lambda e, p: got.append(p))
+        assert got == ["a", "b", "c", "d"]
+
+    def test_any_source_skips_mismatched_tags_per_bin(self):
+        eng = matching.MatchingEngine()
+        eng.incoming(Envelope(0, 9, 0, 0), "wrong")   # earliest arrival
+        eng.incoming(Envelope(1, 5, 0, 0), "right")
+        got = []
+        eng.post_recv(ANY_SOURCE, 5, 0, lambda e, p: got.append(p))
+        assert got == ["right"]
+        assert eng.stats()["unexpected"] == 1  # "wrong" still parked
+
+    def test_wildcard_vs_specific_posted_merge_by_post_order(self):
+        eng = matching.MatchingEngine()
+        order = []
+        eng.post_recv(ANY_SOURCE, ANY_TAG, 0,
+                      lambda e, p: order.append(("wild", p)))
+        eng.post_recv(2, ANY_TAG, 0,
+                      lambda e, p: order.append(("spec", p)))
+        eng.incoming(Envelope(2, 9, 0, 0), "x")  # wildcard posted first
+        eng.incoming(Envelope(2, 9, 0, 1), "y")
+        assert order == [("wild", "x"), ("spec", "y")]
+
+    def test_specific_before_wildcard_when_posted_first(self):
+        eng = matching.MatchingEngine()
+        order = []
+        eng.post_recv(2, ANY_TAG, 0, lambda e, p: order.append(("spec", p)))
+        eng.post_recv(ANY_SOURCE, ANY_TAG, 0,
+                      lambda e, p: order.append(("wild", p)))
+        eng.incoming(Envelope(2, 9, 0, 0), "x")
+        eng.incoming(Envelope(3, 9, 0, 0), "y")  # only the wildcard fits
+        assert order == [("spec", "x"), ("wild", "y")]
+
+    def test_per_source_fifo_with_tag_skips(self):
+        eng = matching.MatchingEngine()
+        eng.incoming(Envelope(0, 5, 0, 0), "t5-first")
+        eng.incoming(Envelope(0, 6, 0, 1), "t6")
+        eng.incoming(Envelope(0, 5, 0, 2), "t5-second")
+        got = []
+        eng.post_recv(0, 6, 0, lambda e, p: got.append(p))
+        eng.post_recv(0, 5, 0, lambda e, p: got.append(p))
+        eng.post_recv(0, 5, 0, lambda e, p: got.append(p))
+        assert got == ["t6", "t5-first", "t5-second"]
+        assert eng.stats() == {"posted": 0, "unexpected": 0}
+
+    def test_probe_and_extract_ride_the_bins(self):
+        eng = matching.MatchingEngine()
+        eng.incoming(Envelope(4, 8, 2, 0), "keep")
+        eng.incoming(Envelope(5, 8, 2, 1), "take")
+        assert eng.probe(ANY_SOURCE, 8, 2).src == 4
+        env, payload = eng.extract(5, 8, 2)
+        assert payload == "take"
+        assert eng.stats()["unexpected"] == 1
+        assert eng.extract(5, 8, 2) is None
+
+    def test_stats_excluding_exact_counts(self):
+        eng = matching.MatchingEngine()
+        eng.post_recv(ANY_SOURCE, 1, 7, lambda e, p: None)
+        eng.post_recv(4, 1, 7, lambda e, p: None)
+        eng.post_recv(4, 1, 9, lambda e, p: None)
+        eng.incoming(Envelope(4, 99, 7, 0), "u")
+        eng.incoming(Envelope(5, 99, 8, 0), "v")
+        assert eng.stats() == {"posted": 3, "unexpected": 2}
+        # ANY_SOURCE posted rows are unattributable by source: counted
+        # unless their cid is exempt
+        assert eng.stats_excluding([4]) == {"posted": 1, "unexpected": 1}
+        assert eng.stats_excluding([], cids=[7]) == \
+            {"posted": 1, "unexpected": 1}
+        assert eng.stats_excluding([5], cids=[7, 9]) == \
+            {"posted": 0, "unexpected": 0}
+
+    def test_comparison_count_gate_on_wildcard_mix(self):
+        """The satellite's SPC gate: a 64-posted/64-unexpected wildcard
+        mix must cost the BINNED comparison counts, not the linear
+        ones.  Deterministic inputs -> deterministic counts: the park
+        phase scans only the 4-entry specific bin + the 32-entry
+        wildcard bin per arrival (2304 total; a linear engine walks all
+        64 posted per arrival = 4096), and the drain phase finds each
+        parked message at its source bin's head (64 total; linear
+        ~2080)."""
+        from zhpe_ompi_tpu.runtime import spc
+
+        eng = matching.MatchingEngine()
+        for i in range(32):
+            eng.post_recv(i % 8, 1000 + i, 0, lambda e, p: None)
+        for i in range(32):
+            eng.post_recv(ANY_SOURCE, 2000 + i, 0, lambda e, p: None)
+        c0 = spc.read("match_comparisons")
+        for i in range(64):
+            eng.incoming(Envelope(i % 8, 3000 + i, 0, i), i)
+        park = spc.read("match_comparisons") - c0
+        assert 0 < park <= 2304, park  # linear would be 4096
+        c1 = spc.read("match_comparisons")
+        got = []
+        for i in range(64):
+            eng.post_recv(i % 8, 3000 + i, 0, lambda e, p: got.append(p))
+        drain = spc.read("match_comparisons") - c1
+        assert len(got) == 64
+        assert 0 < drain <= 64, drain  # linear would be ~2080
+        assert eng.stats()["unexpected"] == 0
+
+    def test_unexpected_depth_watermark(self):
+        from zhpe_ompi_tpu.runtime import spc
+
+        assert "match_unexpected_max_depth" in spc.WATERMARK
+        before = spc.read("match_unexpected_max_depth")
+        eng = matching.MatchingEngine()
+        n = max(before, 0) + 17
+        for i in range(n):
+            eng.incoming(Envelope(0, 4000 + i, 3, i), i)
+        assert spc.read("match_unexpected_max_depth") >= n
+        # a watermark, not a sum: another engine's shallow backlog
+        # cannot LOWER it
+        high = spc.read("match_unexpected_max_depth")
+        eng2 = matching.MatchingEngine()
+        eng2.incoming(Envelope(0, 1, 0, 0), "x")
+        assert spc.read("match_unexpected_max_depth") == high

@@ -460,3 +460,196 @@ class TestPackFramesInto:
         res = run_sm(2, prog, sm=True)
         assert res[1] == float(np.arange(64.).sum())
         assert spc.read("sm_eager_sends") > eager0
+
+
+class TestDemandMapping:
+    """The ring directory: rings materialize on first contact (the
+    doorbell allocate handshake), per-class geometry comes from the
+    OWNER's directory entry, footprint tracks the allocation bitmap,
+    and the close-time audit holds."""
+
+    def test_no_rings_for_silent_peers(self, fresh_vars):
+        """A proc that never receives from a peer never pays that
+        peer's ring: only the demanded ring materializes, and the
+        logical footprint stays far below the size×ring pre-carve."""
+        collected = []
+        seg = sm_mod.SmSegment(0, 16, on_frame=lambda s, f:
+                               collected.append(s))
+        try:
+            assert seg.materialized() == []
+            tx = sm_mod.SmSender(seg.name, src_rank=5, dest_rank=0)
+            try:
+                tx.send_frame(b"x" * 100, [], _deadline(), None)
+                _await_count(collected, 1)
+                assert seg.materialized() == [5]
+                ring = int(mca_var.get("sm_ring_bytes", 4 << 20))
+                assert seg.footprint_bytes() < 2 * ring
+                phys = seg.physical_bytes()
+                assert phys is not None and phys < 2 * ring
+            finally:
+                tx.close()
+        finally:
+            seg.close()
+        assert sm_mod.segment_audit_failures() == []
+
+    def test_leader_class_ring_geometry(self, fresh_vars):
+        """The LEADER peer class sizes its ring by
+        sm_leader_ring_bytes — geometry decided by the OWNER at
+        materialization, adopted by the sender from the directory."""
+        mca_var.set_var("sm_max_frag", 1024)
+        mca_var.set_var("sm_ring_bytes", 16 * 1024)
+        mca_var.set_var("sm_leader_ring_bytes", 4 * 1024)
+        seg = sm_mod.SmSegment(0, 3, on_frame=lambda s, f: None)
+        try:
+            intra = sm_mod.SmSender(seg.name, src_rank=1, dest_rank=0,
+                                    ring_class=sm_mod.CLASS_INTRA)
+            leader = sm_mod.SmSender(seg.name, src_rank=2, dest_rank=0,
+                                     ring_class=sm_mod.CLASS_LEADER)
+            try:
+                assert (intra.nslots, intra.slot_bytes) == (16, 1024)
+                assert (leader.nslots, leader.slot_bytes) == (4, 1024)
+            finally:
+                intra.close()
+                leader.close()
+        finally:
+            seg.close()
+        assert sm_mod.segment_audit_failures() == []
+
+    def test_handshake_wakes_a_dozing_consumer(self, fresh_vars):
+        """First contact while the poll thread is parked in its futex
+        doze: the allocation request rings the doorbell and the ring
+        materializes promptly."""
+        import time
+
+        collected = []
+        seg = sm_mod.SmSegment(0, 2, on_frame=lambda s, f:
+                               collected.append(bytes(f)))
+        try:
+            time.sleep(0.2)  # poll thread is long past its hot window
+            t0 = time.monotonic()
+            tx = sm_mod.SmSender(seg.name, src_rank=1, dest_rank=0)
+            took = time.monotonic() - t0
+            try:
+                assert took < 2.0, f"handshake took {took:.3f}s"
+                tx.send_frame(b"after doze", [], _deadline(), None)
+                _await_count(collected, 1)
+                assert collected[0] == b"after doze"
+            finally:
+                tx.close()
+        finally:
+            seg.close()
+
+    def test_consumer_stopped_fails_the_handshake(self, fresh_vars):
+        seg = sm_mod.SmSegment(0, 2, on_frame=lambda s, f: None)
+        seg.sever()  # poll loop exits, STOPPED flag up, file survives
+        try:
+            with pytest.raises(sm_mod.ConsumerStopped):
+                sm_mod.SmSender(seg.name, src_rank=1, dest_rank=0)
+        finally:
+            seg.close()
+        # a severed segment is a crash: the audit is skipped by design
+        assert sm_mod.segment_audit_failures() == []
+
+    def test_sender_recreation_adopts_existing_ring(self, fresh_vars):
+        """A second sender for the same source rank adopts the already
+        materialized ring (geometry AND head position), it does not
+        re-request."""
+        collected = []
+        seg = sm_mod.SmSegment(0, 2, on_frame=lambda s, f:
+                               collected.append(bytes(f)))
+        try:
+            tx = sm_mod.SmSender(seg.name, src_rank=1, dest_rank=0)
+            tx.send_frame(b"first", [], _deadline(), None)
+            tx.close()
+            tx2 = sm_mod.SmSender(seg.name, src_rank=1, dest_rank=0)
+            try:
+                tx2.send_frame(b"second", [], _deadline(), None)
+                _await_count(collected, 2)
+                assert collected == [b"first", b"second"]
+                assert seg.materialized() == [1]
+            finally:
+                tx2.close()
+        finally:
+            seg.close()
+        assert sm_mod.segment_audit_failures() == []
+
+    def test_wire_rings_follow_traffic(self, fresh_vars):
+        """Over the full transport: a 4-rank job where only the 0↔1
+        and 2↔3 pairs exchange data materializes exactly those rings
+        in each proc's segment."""
+        def prog(p):
+            # no barrier anywhere: a barrier's dissemination tree
+            # would materialize rings across pairs (correctly!) and
+            # race the probe below — the pairwise recv IS the sync
+            peer = p.rank ^ 1
+            p.send(("hello", p.rank), peer, tag=7)
+            got = p.recv(source=peer, tag=7)
+            stats = p.sm_segment_stats()
+            return got, stats["materialized"]
+
+        res = run_sm(4, prog)
+        for r, (got, mat) in enumerate(res):
+            assert got == ("hello", r ^ 1)
+            assert mat == [r ^ 1], (r, mat)
+
+    def test_numa_classed_rings_at_the_seam(self, fresh_vars):
+        """Cross-domain same-host pairs get LEADER-class rings at the
+        transport seam (sm_numa_id emulation), same-domain pairs get
+        the intra class."""
+        mca_var.set_var("sm_max_frag", 4096)
+        mca_var.set_var("sm_ring_bytes", 64 * 1024)
+        mca_var.set_var("sm_leader_ring_bytes", 16 * 1024)
+        kw = {r: {"sm_numa_id": f"d{r // 2}"} for r in range(4)}
+
+        def prog(p):
+            # talk to a same-domain sibling and a cross-domain peer
+            sib, cross = p.rank ^ 1, p.rank ^ 2
+            for peer in (sib, cross):
+                p.send(b"ping", peer, tag=3)
+            got = sorted(bytes(p.recv(source=s, tag=3))
+                         for s in (sib, cross))
+            smtx_sib = p._sm_tx(sib)
+            smtx_cross = p._sm_tx(cross)
+            out = (smtx_sib.nslots, smtx_cross.nslots)
+            p.barrier()
+            return got, out
+
+        for got, (sib_slots, cross_slots) in run_sm(4, prog, kw):
+            assert got == [b"ping", b"ping"]
+            assert sib_slots == 16    # 64K intra ring / 4K slots
+            assert cross_slots == 4   # 16K leader ring / 4K slots
+
+    def test_audit_flags_orphaned_request(self, fresh_vars):
+        """A request the owner never served (stuck REQUESTED entry at
+        clean close) is an orphaned directory entry: the audit must
+        say so.  Injected by writing the request AFTER the poll thread
+        stopped — then the recorded failure is cleared so the session
+        gate stays green."""
+        import struct as _struct
+
+        seg = sm_mod.SmSegment(0, 2, on_frame=lambda s, f: None)
+        seg._stop.set()
+        seg._poll.join(timeout=5.0)
+        off = seg._dirent(1)
+        _struct.Struct("<I").pack_into(seg._mm, off, 1)  # REQUESTED
+        seg.close()
+        fails = sm_mod.segment_audit_failures()
+        assert any("never materialized" in f for f in fails), fails
+        with sm_mod._registry_lock:
+            sm_mod._audit_failures.clear()
+
+
+def _deadline(s: float = 5.0):
+    import time
+
+    return time.monotonic() + s
+
+
+def _await_count(collected, count, timeout=5.0):
+    import time
+
+    deadline = time.monotonic() + timeout
+    while len(collected) < count and time.monotonic() < deadline:
+        time.sleep(0.001)
+    assert len(collected) >= count, (
+        f"only {len(collected)}/{count} frames arrived")

@@ -2005,3 +2005,64 @@ class TestDvmMultiVictimRecovery:
             finally:
                 d.stop()
         assert dvm_mod.live_dvms() == []
+
+
+class TestKillDuringNumaHan:
+    """FT + three-level (NUMA) collective coexistence: a rank dying in
+    the INTRA-DOMAIN phase surfaces typed, revoke(COLL_CID) poisons
+    the nested phase windows through the cid aliases (domain, dleader
+    AND wire windows), and the post-shrink endpoint rebuilds the
+    NESTED topology from the survivor set."""
+
+    # one emulated host, two NUMA domains of two ranks: the NUMA level
+    # carries the hierarchy (the host level is degenerate by design)
+    KW = {r: {"sm_boot_id": "numahost", "sm_numa_id": f"d{r // 2}"}
+          for r in range(4)}
+
+    def test_kill_in_intra_domain_phase_then_shrink_rebuilds_nested(
+            self, fresh_vars):
+        from zhpe_ompi_tpu.coll import host as coll_host
+        from zhpe_ompi_tpu.pt2pt import groups as groups_mod
+
+        mca_var.set_var("ft_detector_period", 0.05)
+        mca_var.set_var("ft_detector_timeout", 0.4)
+        mca_var.set_var("coll_han_enable", "on")
+        mca_var.set_var("coll_han_numa_level", "on")
+        n, victim = 4, 3
+        # dies on its FIRST phase op — inside the intra-domain reduce,
+        # before its domain leader (rank 2) consumed the partial
+        plan = FaultPlan(seed=77).kill_rank(victim, after_ops=0)
+
+        def prog(p):
+            p.set_errhandler(errh.ERRORS_RETURN)
+            inj = plan.arm(p)
+            observed = None
+            try:
+                inj.allreduce(np.full(64, float(p.rank + 1)), ops.SUM)
+            except errors.ProcFailed as e:
+                observed = e
+                p.revoke(coll_host.COLL_CID)
+            except errors.Revoked as e:
+                observed = e
+            assert observed is not None, \
+                "three-level collective completed despite the kill"
+            assert p.ft_state.wait_failed(victim, timeout=10.0)
+            p.failure_ack()
+            assert p.agree(True) is True
+            sh = p.shrink()
+            # the rebuild contract, one level deeper: the shrunken
+            # endpoint derives the NESTED topology from the survivors
+            nested = groups_mod.locality_groups(sh, nested=True)
+            total = sh.allreduce(np.full(8, float(p.rank + 1)), ops.SUM)
+            return (sh.size, nested, float(np.asarray(total)[0]),
+                    type(observed).__name__)
+
+        res = run_tcp_ft(n, prog, kwargs_by_rank=self.KW)
+        assert res[victim] == "killed"
+        survivors = [r for r in range(n) if r != victim]
+        expect_total = float(sum(r + 1 for r in survivors))
+        for r in survivors:
+            # d0 keeps both members, d1 shrinks to old rank 2 alone
+            assert res[r][:3] == (3, [[[0, 1], [2]]], expect_total), \
+                res[r]
+        assert "ProcFailed" in [res[r][3] for r in survivors]

@@ -77,6 +77,19 @@ mca_var.register(
     type=int,
 )
 mca_var.register(
+    "coll_han_numa_level", "auto",
+    "Third (NUMA) topology level of the hierarchical collectives: "
+    "nest an intra-DOMAIN phase under the host level — intra-domain "
+    "reduce/bcast, an intra-host domain-leader exchange over the sm "
+    "rings, and the inter-host wire exchange among host leaders.  "
+    "auto = engage when some host has >= 2 domains of >= 2 members "
+    "(the pynuma: modex tokens / sm_numa_id emulation); on = forced — "
+    "a degenerate NUMA structure falls back to the TWO-LEVEL path "
+    "loudly (han_numa_fallbacks), never silently and never all the "
+    "way to flat while the host level is viable; off = two-level only",
+    enum=("auto", "on", "off"),
+)
+mca_var.register(
     "coll_han_pipeline", "auto",
     "Pipelined inter/intra overlap of the segmented leader exchange "
     "(the reference han's 'w' variants): segment k's intra bcast is "
@@ -95,11 +108,16 @@ HAN_OPS = host.HAN_OPS
 
 class _Topology:
     """One endpoint's locality structure: ascending-member groups
-    ordered by leader (min) rank; ``leaders[i]`` leads ``groups[i]``."""
+    ordered by leader (min) rank; ``leaders[i]`` leads ``groups[i]``.
+    ``nested`` (when known) adds the NUMA level: per host, its domain
+    member-lists ordered by domain leader — the three-level schedule's
+    input."""
 
-    __slots__ = ("groups", "leaders", "gidx", "degenerate", "qualified")
+    __slots__ = ("groups", "leaders", "gidx", "degenerate", "qualified",
+                 "nested", "numa_viable", "numa_qualified")
 
-    def __init__(self, size: int, rank: int, groups: list[list[int]]):
+    def __init__(self, size: int, rank: int, groups: list[list[int]],
+                 nested: list[list[list[int]]] | None = None):
         flat = sorted(r for g in groups for r in g)
         if flat != list(range(size)):
             raise errors.ArgError(
@@ -120,23 +138,73 @@ class _Topology:
         # intra phase — anything less and flat is at least as good
         self.qualified = (not self.degenerate) and sum(
             1 for g in self.groups if len(g) >= 2) >= 2
+        self.nested = None
+        self.numa_viable = self.numa_qualified = False
+        if nested is not None:
+            norm = []
+            for hostdoms in nested:
+                norm.append(sorted((sorted(d) for d in hostdoms),
+                                   key=lambda d: d[0]))
+            norm.sort(key=lambda doms: doms[0][0])
+            if [sorted(r for d in h for r in d) for h in norm] \
+                    != self.groups:
+                raise errors.ArgError(
+                    "han nested domains must partition their host "
+                    f"groups, got {nested} over {self.groups}"
+                )
+            self.nested = norm
+            n_domains = sum(len(h) for h in norm)
+            # viable: some host actually SPLITS into domains, and the
+            # window partition can carry the layout (global domain
+            # index + per-host dleader window + the wire window)
+            self.numa_viable = (
+                any(len(h) >= 2 for h in norm)
+                and n_domains <= groups_mod.DOMAIN_WINDOWS
+                and len(norm) <= groups_mod.MAX_HOSTS_NESTED
+            )
+            # the auto bar: >= 2 domains of >= 2 members on some host —
+            # anything less and the two-level schedule is at least as
+            # good (a lone multi-rank domain IS the host group)
+            self.numa_qualified = self.numa_viable and any(
+                sum(1 for d in h if len(d) >= 2) >= 2 for h in norm)
 
     def group_of(self, rank: int) -> int:
         return next(i for i, g in enumerate(self.groups) if rank in g)
+
+    def domain_of(self, rank: int) -> tuple[int, int]:
+        """(host index, domain index within host) of ``rank``."""
+        h = self.group_of(rank)
+        return h, next(i for i, d in enumerate(self.nested[h])
+                       if rank in d)
+
+    def domain_window(self, h: int, d: int) -> int:
+        """Window id of domain ``d`` of host ``h``: the disjoint
+        domain range (DOMAIN_WINDOW_BASE +) indexed globally in
+        (host, domain) order."""
+        return groups_mod.DOMAIN_WINDOW_BASE + \
+            sum(len(self.nested[i]) for i in range(h)) + d
 
 
 def topology(ctx, groups: list[list[int]] | None = None) -> _Topology:
     """The endpoint's (cached) locality topology; ``groups`` overrides
     the modex derivation (test harnesses emulating multi-host layouts
-    on the thread plane)."""
+    on the thread plane) — depth-2 lists give host groups only,
+    depth-3 lists (host → domain → members) emulate the NUMA level.
+    Never raises out of a malformed FOREIGN card: the nested
+    derivation counts it and demotes the rank to a singleton domain."""
     if groups is None:
         cached = getattr(ctx, "_han_topology", None)
         if cached is not None:
             return cached
-        topo = _Topology(ctx.size, ctx.rank,
-                         groups_mod.locality_groups(ctx))
+        nested = groups_mod.locality_groups(ctx, nested=True)
+        hostg = [sorted(r for d in h for r in d) for h in nested]
+        topo = _Topology(ctx.size, ctx.rank, hostg, nested=nested)
         ctx._han_topology = topo
         return topo
+    if groups and groups[0] and isinstance(groups[0][0], (list, tuple)):
+        hostg = [[r for d in h for r in d] for h in groups]
+        return _Topology(ctx.size, ctx.rank, hostg,
+                         nested=[[list(d) for d in h] for h in groups])
     return _Topology(ctx.size, ctx.rank, groups)
 
 
@@ -176,6 +244,46 @@ def _views(ctx, topo: _Topology) -> tuple[GroupView, GroupView | None]:
     return got
 
 
+def _numa_views(ctx, topo: _Topology
+                ) -> tuple[GroupView, GroupView | None, GroupView | None]:
+    """(intra-domain view, per-host domain-leader view or None, wire
+    view or None) for this rank under the three-level schedule, cached
+    per nested structure.  The domain and dleader views NEST inside the
+    host view (view-of-view: members in host-view coordinates, traffic
+    flattened onto the base endpoint under the nested view's OWN
+    window), so the three-level layout exercises exactly the rel/parent
+    translation machinery the nesting contract specifies."""
+    cache = getattr(ctx, "_han_views", None)
+    if cache is None:
+        cache = {}
+        ctx._han_views = cache
+    key = ("numa",) + tuple(
+        tuple(tuple(d) for d in h) for h in topo.nested)
+    got = cache.get(key)
+    if got is None:
+        h = topo.gidx
+        doms = topo.nested[h]
+        hview = GroupView(ctx, topo.groups[h], window=h, plane="intra")
+        _h, d = topo.domain_of(ctx.rank)
+        dview = GroupView(
+            hview, [hview.rel_base(r) for r in doms[d]],
+            window=topo.domain_window(h, d), plane="intra")
+        dlview = None
+        dleaders = [dom[0] for dom in doms]
+        if ctx.rank in dleaders:
+            dlview = GroupView(
+                hview, [hview.rel_base(r) for r in dleaders],
+                window=groups_mod.HOST_LEADER_BASE + h, plane="dleader")
+        wview = None
+        if ctx.rank in topo.leaders:
+            wview = GroupView(ctx, topo.leaders, window=LEADER_WINDOW,
+                              plane="inter")
+        spc.record("coll_han_leader_elections", 1)
+        got = (dview, dlview, wview)
+        cache[key] = got
+    return got
+
+
 def _flat_fallback(ctx, opname: str, reason: str) -> None:
     """An explicitly-requested han that cannot run hierarchically:
     LOUD degradation — counted (the OSU ladder gates on zero) and
@@ -187,6 +295,50 @@ def _flat_fallback(ctx, opname: str, reason: str) -> None:
         "running the flat algorithm", getattr(ctx, "rank", "?"),
         opname, reason,
     )
+
+
+def _numa_fallback(ctx, opname: str, reason: str) -> None:
+    """A forced NUMA (three-level) schedule that cannot nest: LOUD
+    degradation to the TWO-LEVEL path — counted and emitted.  Distinct
+    from ``_flat_fallback`` by contract: while the host level is
+    viable, a degenerate NUMA structure costs only the domain phase,
+    never the whole hierarchy."""
+    spc.record("han_numa_fallbacks", 1)
+    mca_output.emit(
+        _stream,
+        "rank %s: %s requested the NUMA (three-level) schedule but %s; "
+        "running the two-level path", getattr(ctx, "rank", "?"),
+        opname, reason,
+    )
+
+
+#: collectives with a three-level (NUMA) schedule; the rest run their
+#: two-level schedule even when the NUMA level is engaged (their phase
+#: structure gains nothing from a third nesting — documented in README)
+NUMA_OPS = frozenset(("allreduce", "bcast", "barrier"))
+
+
+def _numa_mode() -> str:
+    return str(mca_var.get("coll_han_numa_level", "auto"))
+
+
+def _use_numa(ctx, topo: _Topology, opname: str) -> bool:
+    """Per-collective decision for the third (NUMA) level, consulted
+    AFTER han itself was selected.  Deterministic across ranks: it
+    reads only the shared topology and MCA state."""
+    mode = _numa_mode()
+    if mode == "off" or topo.nested is None or opname not in NUMA_OPS:
+        return False
+    if mode == "on":
+        if topo.numa_viable:
+            return True
+        _numa_fallback(
+            ctx, opname,
+            "the NUMA structure is degenerate "
+            f"({sum(len(h) for h in topo.nested)} domain(s) over "
+            f"{len(topo.groups)} host(s))")
+        return False
+    return topo.numa_qualified
 
 
 def _rule_requests_han(opname: str, size: int, payload: Any) -> bool:
@@ -220,18 +372,27 @@ def wants_han(ctx, opname: str, payload: Any = None, op=None,
         return False
     topo = topology(ctx)
     noncommutative = op is not None and not getattr(op, "commute", True)
+    # the NUMA level can carry a host-degenerate topology (e.g. one
+    # host whose domains split): the hierarchy then lives entirely in
+    # the domain phase + dleader exchange
+    numa_carries = (
+        _numa_mode() != "off" and opname in NUMA_OPS
+        and topo.numa_qualified
+    )
     if requested:
-        if topo.degenerate:
-            _flat_fallback(ctx, opname, "the topology is degenerate "
-                           f"({len(topo.groups)} locality group(s) over "
-                           f"{ctx.size} rank(s))")
-            return False
         if noncommutative:
             _flat_fallback(ctx, opname, "the op is non-commutative "
                            "(group combine order != rank order)")
             return False
+        if topo.degenerate:
+            if numa_carries:
+                return True
+            _flat_fallback(ctx, opname, "the topology is degenerate "
+                           f"({len(topo.groups)} locality group(s) over "
+                           f"{ctx.size} rank(s))")
+            return False
         return True
-    return topo.qualified and not noncommutative
+    return (topo.qualified or numa_carries) and not noncommutative
 
 
 def _require_commutative(op, opname: str) -> None:
@@ -320,6 +481,31 @@ def _allreduce_pipelined(intra, inter, value: Any, op,
     return np.concatenate(pieces).reshape(np.asarray(value).shape)
 
 
+def _allreduce_numa(ctx, topo: _Topology, value: Any, op) -> Any:
+    """Three-level allreduce: intra-DOMAIN reduce → intra-host
+    domain-leader reduce (over the sm rings, the dleader window) →
+    inter-host wire exchange among host leaders (the same segmented
+    reduce-scatter+allgather schedule as two-level) → dleader bcast →
+    domain bcast.  Exactly the hops that cross the wire carry exactly
+    one host-reduced payload — a domains-as-hosts layout pays the full
+    leader exchange among every domain leader instead."""
+    dview, dlview, wview = _numa_views(ctx, topo)
+    spc.record("coll_han_numa_collectives", 1)
+    part = host.reduce(dview, value, op, root=0) \
+        if dview.size > 1 else value
+    if dlview is not None:
+        if dlview.size > 1:
+            part = host.reduce(dlview, part, op, root=0)
+        if wview is not None:
+            part = _leader_allreduce(wview, part, op)
+        if dlview.size > 1:
+            part = host.bcast(dlview, part, root=0,
+                              algorithm="binomial")
+    if dview.size > 1:
+        part = host.bcast(dview, part, root=0, algorithm="binomial")
+    return part
+
+
 def allreduce(ctx, value: Any, op,
               groups: list[list[int]] | None = None) -> Any:
     """Two-level allreduce: intra reduce → leader allreduce → intra
@@ -328,9 +514,13 @@ def allreduce(ctx, value: Any, op,
     bandwidth-optimal inter-node schedule, applied to exactly the hops
     that cross the wire — and, with ``coll_han_pipeline`` auto/on and
     >= 2 segments, OVERLAPS each segment's intra bcast with the next
-    segment's wire exchange (the "w" pipelining)."""
+    segment's wire exchange (the "w" pipelining).  With the NUMA level
+    engaged (``coll_han_numa_level``) the schedule nests a third,
+    intra-domain phase instead."""
     _require_commutative(op, "allreduce")
     topo = topology(ctx, groups)
+    if _use_numa(ctx, topo, "allreduce"):
+        return _allreduce_numa(ctx, topo, value, op)
     intra, inter = _views(ctx, topo)
     if str(mca_var.get("coll_han_pipeline", "auto")) != "off" \
             and len(topo.groups) >= 2:
@@ -392,6 +582,50 @@ def _leader_allreduce(inter, partial: Any, op) -> Any:
 # -------------------------------------------------------------- bcast
 
 
+def _bcast_numa(ctx, topo: _Topology, obj: Any, root: int) -> Any:
+    """Three-level bcast: root → its domain leader (domain window) →
+    its host leader (dleader window) → wire bcast among host leaders →
+    dleader bcast → domain bcast.  Hop tags are consumed by every
+    member of the hop's window (the two-level sequence-uniformity rule
+    applied per level), and the hop conditions read only global
+    topology, so every rank derives the identical schedule."""
+    dview, dlview, wview = _numa_views(ctx, topo)
+    spc.record("coll_han_numa_collectives", 1)
+    orig = obj
+    h_root, d_root = topo.domain_of(root)
+    root_dom = topo.nested[h_root][d_root]
+    droot_leader = root_dom[0]
+    host_leader = topo.groups[h_root][0]
+    # hop 1: root -> its domain's leader (all members of that domain
+    # consume the tag; other domains' windows stay untouched)
+    if root != droot_leader and ctx.rank in root_dom:
+        hoptag = host._next_tag(dview, host.TAG_BCAST)
+        if ctx.rank == root:
+            dview.send(obj, 0, tag=hoptag)
+        elif ctx.rank == droot_leader:
+            obj = dview.recv(source=dview.rel_base(root), tag=hoptag)
+    # hop 2: root's domain leader -> its host's leader (all that
+    # host's domain leaders consume the dleader-window tag)
+    if droot_leader != host_leader and topo.gidx == h_root \
+            and dlview is not None:
+        hoptag = host._next_tag(dlview, host.TAG_BCAST)
+        if ctx.rank == droot_leader:
+            dlview.send(obj, 0, tag=hoptag)
+        elif ctx.rank == host_leader:
+            obj = dlview.recv(source=dlview.rel_base(droot_leader),
+                              tag=hoptag)
+    if wview is not None and wview.size > 1:
+        obj = host.bcast(wview, obj, algorithm="binomial",
+                         root=topo.leaders.index(host_leader))
+    if dlview is not None and dlview.size > 1:
+        obj = host.bcast(dlview, obj, root=0, algorithm="binomial")
+    out = host.bcast(dview, obj, root=0, algorithm="binomial") \
+        if dview.size > 1 else obj
+    # the root returns ITS payload (MPI buffer semantics), never the
+    # round-tripped copy the down phases delivered back to it
+    return orig if ctx.rank == root else out
+
+
 def bcast(ctx, obj: Any = None, root: int = 0,
           groups: list[list[int]] | None = None) -> Any:
     """Two-level bcast.  The leader set is FIXED (min rank per group,
@@ -400,6 +634,8 @@ def bcast(ctx, obj: Any = None, root: int = 0,
     the intra window — every member of that group consumes the hop tag
     so the window's sequence stays uniform."""
     topo = topology(ctx, groups)
+    if _use_numa(ctx, topo, "bcast"):
+        return _bcast_numa(ctx, topo, obj, root)
     intra, inter = _views(ctx, topo)
     root_g = topo.group_of(root)
     root_leader = topo.groups[root_g][0]
@@ -451,11 +687,33 @@ def reduce(ctx, value: Any, op, root: int = 0,
 # -------------------------------------------------------------- barrier
 
 
+def _barrier_numa(ctx, topo: _Topology) -> None:
+    """Three-level barrier: domain gather (arrival) → dleader gather →
+    wire allgather among host leaders → dleader bcast → domain bcast
+    (release).  No rank releases before every host's arrival reached
+    the wire exchange."""
+    dview, dlview, wview = _numa_views(ctx, topo)
+    spc.record("coll_han_numa_collectives", 1)
+    if dview.size > 1:
+        host.gather(dview, b"", root=0)
+    if dlview is not None:
+        if dlview.size > 1:
+            host.gather(dlview, b"", root=0)
+        if wview is not None and wview.size > 1:
+            host.allgather(wview, b"")
+        if dlview.size > 1:
+            host.bcast(dlview, b"", root=0, algorithm="binomial")
+    if dview.size > 1:
+        host.bcast(dview, b"", root=0, algorithm="binomial")
+
+
 def barrier(ctx, groups: list[list[int]] | None = None) -> None:
     """Two-level barrier: intra gather (arrival) → leader allgather →
     intra bcast (release) — p-1 sm hops plus the leader exchange,
     instead of log2(p) interleaved-transport dissemination rounds."""
     topo = topology(ctx, groups)
+    if _use_numa(ctx, topo, "barrier"):
+        return _barrier_numa(ctx, topo)
     intra, inter = _views(ctx, topo)
     if intra.size > 1:
         host.gather(intra, b"", root=0)

@@ -218,6 +218,12 @@ def _register_params():
         "coll/host.py)",
         enum=("auto", "on", "off"),
     )
+    mca_var.register(
+        "coll_han_numa_level", "auto",
+        "Third (NUMA) topology level of the hierarchical host "
+        "collectives: auto/on/off (see coll/han.py)",
+        enum=("auto", "on", "off"),
+    )
 
 
 from ..utils.payload import payload_nbytes as _nbytes  # noqa: E402

@@ -912,6 +912,15 @@ class ShrunkEndpoint(HostCollectives):
             return None
         return fn(self._map[rank])
 
+    def numa_token_of(self, rank: int):
+        """NUMA-domain identity of a SHRUNK rank, translated to the
+        parent endpoint — the nested (three-level) twin of
+        :meth:`boot_token_of`'s rebuild contract."""
+        fn = getattr(self._ep, "numa_token_of", None)
+        if fn is None:
+            return None
+        return fn(self._map[rank])
+
     def send(self, obj: Any, dest: int, tag: int = 0, cid: int = 0) -> None:
         self._ep.send(obj, self._map[dest], tag, _shrink_cid(self._gen, cid))
 

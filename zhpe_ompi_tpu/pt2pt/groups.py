@@ -56,6 +56,7 @@ from ..coll.host import COLL_CID
 from ..core import errors
 from ..runtime import spc
 from ..utils.payload import payload_size_estimate as payload_bytes
+from . import sm as sm_mod
 
 # One cid per tag window: groups 0..253 plus the leader window.  The
 # whole span sits below every control/collective cid in use (user cids
@@ -65,7 +66,31 @@ from ..utils.payload import payload_size_estimate as payload_bytes
 _HAN_CID_BASE = 0x7900
 _HAN_WINDOWS = 0x100
 LEADER_WINDOW = _HAN_WINDOWS - 1  # the inter-phase (leader) window
-MAX_GROUPS = LEADER_WINDOW       # group i owns window i
+MAX_GROUPS = LEADER_WINDOW       # group i owns window i (two-level)
+
+# three-level (NUMA) window partition.  A window id's tag sequence is
+# uniform only among ONE member set, so every window range must be
+# DISJOINT from every other range that can coexist on an endpoint:
+# host windows (two-level intra; also the three-level nesting parent)
+# keep 0..0x3F, intra-DOMAIN views own DOMAIN_WINDOW_BASE + global
+# domain index, each host's domain-leader exchange owns
+# HOST_LEADER_BASE + host index, and the inter-host leader window
+# stays LEADER_WINDOW.  A topology too large for the partition is not
+# NUMA-viable and runs two-level (which alone may still use the full
+# 0..MAX_GROUPS-1 span — no domain windows exist to collide with).
+DOMAIN_WINDOW_BASE = 0x40
+DOMAIN_WINDOWS = 0x40                        # <= 64 domains total
+HOST_LEADER_BASE = 0x80
+MAX_HOSTS_NESTED = DOMAIN_WINDOW_BASE        # <= 64 hosts when nested
+
+#: plane -> SPC byte counter of a GroupView's send seam ("dleader" is
+#: the three-level intra-host leader exchange — same-host sm traffic,
+#: accounted apart from both the domain phase and the wire phase)
+PLANE_COUNTERS = {
+    "intra": "coll_han_intra_bytes",
+    "inter": "coll_han_inter_bytes",
+    "dleader": "coll_han_dleader_bytes",
+}
 
 # endpoint -> set of registered window ids (weak: a collected endpoint
 # takes its registrations with it); the leak gate inspects what is left
@@ -90,13 +115,39 @@ def boot_token_of(ep, rank: int) -> str | None:
     return fn(rank)
 
 
-def locality_groups(ep) -> list[list[int]]:
+def numa_token_of(ep, rank: int):
+    """NUMA-domain identity of ``rank`` on ``ep``: the token string,
+    ``None`` when unknown (old cards, sm=0 peers — the host degrades
+    to one domain), or :data:`~zhpe_ompi_tpu.pt2pt.sm.NUMA_MALFORMED`.
+    Exception-safe by contract: a malformed FOREIGN card must never
+    raise out of a collective's topology derivation — it is counted
+    and demoted to the sentinel instead."""
+    fn = getattr(ep, "numa_token_of", None)
+    if fn is None:
+        return None
+    try:
+        return fn(rank)
+    except Exception:  # noqa: BLE001 - foreign-card robustness
+        return sm_mod.NUMA_MALFORMED
+
+
+def locality_groups(ep, nested: bool = False):
     """Same-host groups of ``ep``'s ranks, derived from the modex boot
     tokens: a list of ascending-rank member lists, ordered by leader
     (minimum) rank.  Ranks with no provable locality (no card, sm=0
     peers, C ranks, rejoiners) are their own singleton group — han then
     treats them as one-rank hosts, which is always correct and merely
-    forgoes an intra phase for them."""
+    forgoes an intra phase for them.
+
+    With ``nested=True`` the structure gains the NUMA level: each host
+    entry becomes a list of DOMAIN member-lists (ordered by domain
+    leader), derived from the ``pynuma:`` card tokens.  The derivation
+    ladder per rank: token present → its domain; token absent (old
+    card) → the host's single default domain; token malformed →
+    counted (``han_malformed_numa_cards``) and demoted to a singleton
+    domain.  It never raises — a host whose members advertise no
+    usable tokens is simply one domain, i.e. exactly the two-level
+    structure."""
     size = getattr(ep, "size", 1)
     by_token: dict[str, list[int]] = {}
     groups: list[list[int]] = []
@@ -112,7 +163,32 @@ def locality_groups(ep) -> list[list[int]]:
         else:
             members.append(r)
     groups.sort(key=lambda g: g[0])
-    return groups
+    if not nested:
+        return groups
+    out: list[list[list[int]]] = []
+    for g in groups:
+        if len(g) == 1:
+            out.append([list(g)])
+            continue
+        by_dom: dict[str, list[int]] = {}
+        domains: list[list[int]] = []
+        for r in g:
+            tok = numa_token_of(ep, r)
+            if tok is sm_mod.NUMA_MALFORMED:
+                spc.record("han_malformed_numa_cards", 1)
+                domains.append([r])  # singleton domain, never a raise
+                continue
+            if tok is None:
+                tok = ""  # absent: the host's shared default domain
+            members = by_dom.get(tok)
+            if members is None:
+                members = by_dom[tok] = [r]
+                domains.append(members)
+            else:
+                members.append(r)
+        domains.sort(key=lambda d: d[0])
+        out.append(domains)
+    return out
 
 
 def _ft_state(ep):
@@ -196,12 +272,24 @@ def live_election_threads() -> list[str]:
 
 
 class GroupView:
-    """Sub-endpoint over one locality group (or the leader set): the
+    """Sub-endpoint over one locality group (or a leader set): the
     flat host-plane algorithms run on it unchanged while the traffic
     stays inside a disjoint tag window of the parent endpoint.
 
-    ``plane`` is ``"intra"`` or ``"inter"`` — it selects the SPC byte
-    counter and documents which han phase the view carries."""
+    ``plane`` is ``"intra"``, ``"dleader"`` or ``"inter"`` — it selects
+    the SPC byte counter and documents which han phase the view
+    carries.
+
+    A view may be built OVER ANOTHER VIEW (the three-level NUMA
+    schedule nests its domain views inside the host view): ``members``
+    are then ranks of that parent view, and the nested view flattens
+    the chain — its traffic translates straight onto the BASE endpoint
+    with the nested view's OWN window cid (never the parent's), its
+    per-window sequence lives on the base endpoint (recreated nested
+    views continue the sequence), and its window registration keys on
+    the close-owning transport.  ``rel``/``parent_rank`` stay
+    parent-relative; ``base_rank``/``rel_base`` translate to the base
+    endpoint."""
 
     # coll/host.py's han seam checks this to re-enter the FLAT
     # algorithms for phase traffic (no recursive hierarchy)
@@ -214,25 +302,35 @@ class GroupView:
                 f"rank {ep.rank} building a view it is not a member of "
                 f"({members})"
             )
-        self._ep = ep
-        self._members = list(members)           # view rank -> parent rank
+        self._parent = ep
+        self._pmembers = list(members)      # view rank -> parent rank
+        self._pinv = {g: i for i, g in enumerate(self._pmembers)}
+        if isinstance(ep, GroupView):
+            # view-of-view: collapse to the base endpoint so nested
+            # phases pay ONE translation, not a tower — and so the
+            # window cid on the wire is this view's, not the parent's
+            base = ep._ep
+            base_members = [ep._members[m] for m in members]
+        else:
+            base = ep
+            base_members = list(members)
+        self._ep = base
+        self._members = base_members        # view rank -> base rank
         self._inv = {g: i for i, g in enumerate(self._members)}
-        self.rank = self._inv[ep.rank]
+        self.rank = self._inv[base.rank]
         self.size = len(self._members)
         self._window = int(window) % _HAN_WINDOWS
         self._cid = _HAN_CID_BASE + self._window
         self._plane = plane
-        self._bytes_counter = (
-            "coll_han_intra_bytes" if plane == "intra"
-            else "coll_han_inter_bytes"
-        )
-        self._seqs = _window_seqs(ep)
-        state = _ft_state(ep)
+        self._bytes_counter = PLANE_COUNTERS.get(
+            plane, "coll_han_intra_bytes")
+        self._seqs = _window_seqs(base)
+        state = _ft_state(base)
         if state is not None and hasattr(state, "alias_cid"):
             # revoke(COLL_CID) must poison the window's parked and
             # future operations exactly like the flat path's
             state.alias_cid(self._cid, COLL_CID)
-        _register(ep, self._window)
+        _register(base, self._window)
 
     # -- per-window collective sequence (read/written by coll/host's
     # _next_tag through the ordinary attribute protocol) ----------------
@@ -267,16 +365,33 @@ class GroupView:
     # -- translation helpers ---------------------------------------------
 
     def rel(self, parent_rank: int) -> int:
-        """View rank of a parent rank (ArgError for non-members)."""
+        """View rank of a PARENT rank (ArgError for non-members) — the
+        parent is whatever the view was built over, another view
+        included."""
         try:
-            return self._inv[parent_rank]
+            return self._pinv[parent_rank]
         except KeyError:
             raise errors.ArgError(
                 f"parent rank {parent_rank} is not a member of this view"
             ) from None
 
     def parent_rank(self, view_rank: int) -> int:
+        return self._pmembers[view_rank]
+
+    def base_rank(self, view_rank: int) -> int:
+        """Rank of a view member on the BASE endpoint (== parent_rank
+        unless this view was built over another view)."""
         return self._members[view_rank]
+
+    def rel_base(self, base_rank: int) -> int:
+        """View rank of a base-endpoint rank (ArgError for
+        non-members) — the inverse of :meth:`base_rank`."""
+        try:
+            return self._inv[base_rank]
+        except KeyError:
+            raise errors.ArgError(
+                f"base rank {base_rank} is not a member of this view"
+            ) from None
 
     def boot_token_of(self, rank: int) -> str | None:
         return boot_token_of(self._ep, self._members[rank])

@@ -6,6 +6,21 @@ communicator id), with MPI wildcards ANY_SOURCE / ANY_TAG and the standard
 ordering guarantee — messages from the same source match posted receives in
 arrival order (per-source FIFO via sequence numbers).
 
+The Python engine indexes both queues by **(cid, src) hash bins** (the
+reference keeps per-peer queues for the same reason — ob1's
+``mca_pml_ob1_comm_proc_t``): an envelope consults only its own bin
+plus the per-cid wildcard bin instead of scanning every posted receive
+in the process, and a posted receive consults only its source's
+unexpected bin (or, for ANY_SOURCE, an arrival-ordered merge across
+the cid's bins).  Ordering is preserved exactly — entries carry a
+global monotonic stamp: per-source FIFO is bin order, ANY_SOURCE
+matches in true cross-source arrival order, and wildcard-vs-specific
+posted receives merge by post order.  The scan work is visible:
+``match_comparisons`` counts entry inspections and
+``match_unexpected_max_depth`` watermarks the unexpected backlog, so a
+matching regression shows up as a counter delta, not a mystery
+slowdown.
+
 Pure host logic with no transport dependency, unit-testable in isolation
 exactly like the reference's datatype engine tests (SURVEY.md §4) — the
 transport layer feeds :meth:`MatchingEngine.incoming`, the API layer calls
@@ -14,12 +29,15 @@ transport layer feeds :meth:`MatchingEngine.incoming`, the API layer calls
 
 from __future__ import annotations
 
+import heapq
+import itertools
 import threading
 from collections import deque
 from dataclasses import dataclass, field
 from typing import Any, Callable
 
 from ..runtime import peruse
+from ..runtime import spc
 
 ANY_SOURCE = -1
 ANY_TAG = -1
@@ -51,13 +69,110 @@ class PostedRecv:
 
 
 class MatchingEngine:
-    """Per-rank matching state: posted-receive list + unexpected-message
-    queue (the two queues of pml_ob1_recvfrag.c:325,426)."""
+    """Per-rank matching state: posted-receive bins + unexpected-message
+    bins (the two queues of pml_ob1_recvfrag.c:325,426, indexed by
+    (cid, src) like ob1's per-peer comm procs).  Entries carry a global
+    monotonic stamp so merged scans reproduce the single-queue order
+    EXACTLY: per-source FIFO, cross-source arrival order for
+    ANY_SOURCE, post order for wildcard-vs-specific posted receives."""
 
     def __init__(self) -> None:
-        self._posted: deque[PostedRecv] = deque()
-        self._unexpected: deque[tuple[Envelope, Any]] = deque()
         self._lock = threading.Lock()
+        self._stamp = itertools.count()
+        # (cid, src) -> deque[(stamp, PostedRecv)]; src may be
+        # ANY_SOURCE (the per-cid wildcard bin)
+        self._posted_bins: dict[tuple[int, int], deque] = {}
+        # cid -> src -> deque[(stamp, Envelope, payload)]
+        self._unexp_bins: dict[int, dict[int, deque]] = {}
+        self._posted_n = 0
+        self._unexp_n = 0
+
+    # -- bin walks (lock held) -------------------------------------------
+
+    def _drop_unexp(self, cid: int, src: int, i: int) -> None:
+        bins = self._unexp_bins[cid]
+        q = bins[src]
+        del q[i]
+        self._unexp_n -= 1
+        if not q:
+            del bins[src]
+            if not bins:
+                del self._unexp_bins[cid]
+
+    def _take_unexpected(self, probe: PostedRecv, remove: bool):
+        """Earliest-ARRIVED unexpected message matching ``probe``:
+        ``(env, payload, comparisons)`` or ``(None, None,
+        comparisons)``.  A specific source scans one bin in arrival
+        order; ANY_SOURCE heap-merges the cid's bins by arrival stamp
+        (a tag-mismatched head only advances its own bin, so no bin's
+        internal order is disturbed)."""
+        bins = self._unexp_bins.get(probe.cid)
+        comparisons = 0
+        if not bins:
+            return None, None, 0
+        if probe.src != ANY_SOURCE:
+            q = bins.get(probe.src)
+            if not q:
+                return None, None, 0
+            for i, (_, env, payload) in enumerate(q):
+                comparisons += 1
+                if probe.matches(env):
+                    if remove:
+                        self._drop_unexp(probe.cid, probe.src, i)
+                    return env, payload, comparisons
+            return None, None, comparisons
+        heap = [(q[0][0], src, 0) for src, q in bins.items() if q]
+        heapq.heapify(heap)
+        while heap:
+            _, src, i = heapq.heappop(heap)
+            q = bins[src]
+            _, env, payload = q[i]
+            comparisons += 1
+            if probe.matches(env):
+                if remove:
+                    self._drop_unexp(probe.cid, src, i)
+                return env, payload, comparisons
+            if i + 1 < len(q):
+                heapq.heappush(heap, (q[i + 1][0], src, i + 1))
+        return None, None, comparisons
+
+    def _take_posted(self, env: Envelope):
+        """Earliest-POSTED receive matching ``env``: the specific
+        (cid, src) bin merged with the cid's ANY_SOURCE wildcard bin
+        by post stamp — ``(posted, comparisons)`` with the entry
+        removed, or ``(None, comparisons)``."""
+        b_spec = self._posted_bins.get((env.cid, env.src))
+        b_wild = self._posted_bins.get((env.cid, ANY_SOURCE))
+        comparisons = 0
+        i = j = 0
+        while True:
+            cand_s = b_spec[i] if b_spec and i < len(b_spec) else None
+            cand_w = b_wild[j] if b_wild and j < len(b_wild) else None
+            if cand_s is None and cand_w is None:
+                return None, comparisons
+            if cand_w is None or (cand_s is not None
+                                  and cand_s[0] < cand_w[0]):
+                posted = cand_s[1]
+                comparisons += 1
+                if posted.matches(env):
+                    del b_spec[i]
+                    self._posted_n -= 1
+                    if not b_spec:
+                        del self._posted_bins[(env.cid, env.src)]
+                    return posted, comparisons
+                i += 1
+            else:
+                posted = cand_w[1]
+                comparisons += 1
+                if posted.matches(env):
+                    del b_wild[j]
+                    self._posted_n -= 1
+                    if not b_wild:
+                        del self._posted_bins[(env.cid, ANY_SOURCE)]
+                    return posted, comparisons
+                j += 1
+
+    # -- public surface ---------------------------------------------------
 
     def post_recv(self, src: int, tag: int, cid: int,
                   on_match: Callable[[Envelope, Any], None]) -> None:
@@ -65,15 +180,16 @@ class MatchingEngine:
         is waiting (ordered: earliest matching unexpected wins)."""
         if peruse.active:
             peruse.fire(peruse.REQ_ACTIVATE, src=src, tag=tag, cid=cid)
+        posted = PostedRecv(src, tag, cid, on_match)
         with self._lock:
-            posted = PostedRecv(src, tag, cid, on_match)
-            for i, (env, payload) in enumerate(self._unexpected):
-                if posted.matches(env):
-                    del self._unexpected[i]
-                    break
-            else:
-                self._posted.append(posted)
-                env = None
+            env, payload, comparisons = self._take_unexpected(
+                posted, remove=True)
+            if env is None:
+                self._posted_bins.setdefault((cid, src), deque()).append(
+                    (next(self._stamp), posted))
+                self._posted_n += 1
+        if comparisons:
+            spc.record("match_comparisons", comparisons)
         # events fire outside the lock (subscribers may re-enter the engine)
         if env is None:
             if peruse.active:
@@ -93,15 +209,19 @@ class MatchingEngine:
         if peruse.active:
             peruse.fire(peruse.MSG_ARRIVED,
                         src=env.src, tag=env.tag, cid=env.cid, seq=env.seq)
+        depth = 0
         with self._lock:
-            for i, posted in enumerate(self._posted):
-                if posted.matches(env):
-                    del self._posted[i]
-                    break
-            else:
-                self._unexpected.append((env, payload))
-                posted = None
+            posted, comparisons = self._take_posted(env)
+            if posted is None:
+                self._unexp_bins.setdefault(env.cid, {}).setdefault(
+                    env.src, deque()).append(
+                        (next(self._stamp), env, payload))
+                self._unexp_n += 1
+                depth = self._unexp_n
+        if comparisons:
+            spc.record("match_comparisons", comparisons)
         if posted is None:
+            spc.record("match_unexpected_max_depth", depth)
             if peruse.active:
                 peruse.fire(peruse.MSG_INSERT_IN_UNEX_Q, src=env.src,
                             tag=env.tag, cid=env.cid, seq=env.seq)
@@ -117,10 +237,11 @@ class MatchingEngine:
         """MPI_Iprobe: peek the earliest matching unexpected envelope."""
         probe_req = PostedRecv(src, tag, cid, lambda e, p: None)
         with self._lock:
-            for env, _ in self._unexpected:
-                if probe_req.matches(env):
-                    return env
-        return None
+            env, _payload, comparisons = self._take_unexpected(
+                probe_req, remove=False)
+        if comparisons:
+            spc.record("match_comparisons", comparisons)
+        return env
 
     def extract(self, src: int, tag: int, cid: int
                 ) -> tuple[Envelope, Any] | None:
@@ -129,17 +250,19 @@ class MatchingEngine:
         through the returned handle (MPI_Mrecv semantics)."""
         probe_req = PostedRecv(src, tag, cid, lambda e, p: None)
         with self._lock:
-            for i, (env, payload) in enumerate(self._unexpected):
-                if probe_req.matches(env):
-                    del self._unexpected[i]
-                    return env, payload
-        return None
+            env, payload, comparisons = self._take_unexpected(
+                probe_req, remove=True)
+        if comparisons:
+            spc.record("match_comparisons", comparisons)
+        if env is None:
+            return None
+        return env, payload
 
     def stats(self) -> dict[str, int]:
         with self._lock:
             return {
-                "posted": len(self._posted),
-                "unexpected": len(self._unexpected),
+                "posted": self._posted_n,
+                "unexpected": self._unexp_n,
             }
 
     def stats_excluding(self, srcs, cids=()) -> dict[str, int]:
@@ -158,14 +281,36 @@ class MatchingEngine:
         with self._lock:
             return {
                 "posted": sum(
-                    1 for p in self._posted
-                    if p.src not in excl and p.cid not in excl_cids
+                    len(q)
+                    for (cid, src), q in self._posted_bins.items()
+                    if src not in excl and cid not in excl_cids
                 ),
                 "unexpected": sum(
-                    1 for e, _ in self._unexpected
-                    if e.src not in excl and e.cid not in excl_cids
+                    len(q)
+                    for cid, bins in self._unexp_bins.items()
+                    if cid not in excl_cids
+                    for src, q in bins.items()
+                    if src not in excl
                 ),
             }
+
+    def debug_rows(self) -> tuple[list, list]:
+        """Forensic snapshot for recv-timeout diagnostics:
+        ``(posted [(src, tag, cid)...], unexpected [(src, tag, cid,
+        seq)...])`` in no particular order."""
+        with self._lock:
+            posted = [
+                (p.src, p.tag, p.cid)
+                for q in self._posted_bins.values()
+                for _, p in q
+            ]
+            unexpected = [
+                (e.src, e.tag, e.cid, e.seq)
+                for bins in self._unexp_bins.values()
+                for q in bins.values()
+                for _, e, _p in q
+            ]
+        return posted, unexpected
 
 
 class NativeMatchingEngine:
@@ -230,6 +375,7 @@ class NativeMatchingEngine:
         if peruse.active:
             peruse.fire(peruse.MSG_ARRIVED,
                         src=env.src, tag=env.tag, cid=env.cid, seq=env.seq)
+        depth = 0
         with self._lock:
             key = self._next_key
             self._next_key += 1
@@ -240,6 +386,12 @@ class NativeMatchingEngine:
             if hit:
                 del self._payloads[key]
                 cb = self._callbacks.pop(rkey.value)
+            else:
+                # _payloads holds exactly the unexpected payloads: its
+                # size IS the backlog the Python engine watermarks
+                depth = len(self._payloads)
+        if not hit:
+            spc.record("match_unexpected_max_depth", depth)
         if hit:
             if peruse.active:
                 peruse.fire(peruse.REQ_REMOVE_FROM_POSTED_Q, src=env.src,

@@ -10,24 +10,41 @@ kernel-buffer costs for every hop — frames move through mmap'd
 ``/dev/shm`` rings exactly like the C shim's own transport
 (``native/zompi_mpi.cpp`` sm_*).
 
-Design (one segment per proc, one fixed-slot SPSC ring per peer
-direction):
+Design (one segment per proc, demand-mapped fixed-slot SPSC rings per
+peer direction):
 
 - **Segment**: each proc creates ONE ``/dev/shm`` segment at
-  construction holding its INBOUND rings — one ring per possible source
-  rank — and advertises ``(boot_id, segment_name)`` on its modex card.
+  construction holding its INBOUND rings and advertises
+  ``(boot_id, segment_name)`` on its modex card plus a NUMA-domain
+  token (``pynuma:``, sysfs-derived or the ``sm_numa_id`` override).
   A sender maps the destination's segment and produces into the ring
   indexed by its own rank; the owner is the only consumer of every ring
   in its segment, so each ring is strictly SPSC and a single doorbell
   in the segment header covers all of them.
-- **Ring**: ``nslots`` fixed slots of ``sm_max_frag`` payload bytes
-  (``nslots = sm_ring_bytes // sm_max_frag``); ``head``/``tail`` are
-  monotonic slot counters on separate cache lines.  A message is one
-  DSS frame (the PR 3 ``pack_frames`` header + out-of-band segments)
-  written *directly into slot memory* — one copy total on the sender
-  (the btl/sm copy-in).  Messages larger than a slot flow as a
-  fragment pipeline: the consumer frees each slot as it assembles, so
-  a message larger than the whole ring still streams through.
+- **Demand mapping**: rings are NOT pre-carved for every possible
+  source.  The segment header carries a per-source **ring directory**
+  plus an **allocation bitmap**; a sender's first contact writes an
+  allocation request (its peer class) into its directory entry and
+  rings the doorbell, and the owner's poll thread materializes the
+  ring — per-class geometry, bitmap bit, READY state — before the
+  first payload byte moves.  A proc that never talks to a peer never
+  pays that peer's ring (the file is sparse; tmpfs pages allocate on
+  first touch), so the per-proc footprint under hierarchical (han)
+  traffic is ``(domain_size + is_leader × n_groups) × ring`` instead
+  of ``size × sm_ring_bytes``.  The close-time audit (see
+  :func:`segment_audit_failures`) asserts the physical footprint
+  matches the bitmap and no directory entry was orphaned.
+- **Ring**: ``nslots`` fixed slots of ``sm_max_frag`` payload bytes;
+  ring capacity is **per peer class** — ``sm_ring_bytes`` for
+  intra-domain peers, ``sm_leader_ring_bytes`` for leader-to-leader
+  (cross-NUMA-domain) pairs whose traffic is the segmented eager
+  exchange.  ``head``/``tail`` are monotonic slot counters on separate
+  cache lines.  A message is one DSS frame (the PR 3 ``pack_frames``
+  header + out-of-band segments) written *directly into slot memory* —
+  one copy total on the sender (the btl/sm copy-in).  Messages larger
+  than a slot flow as a fragment pipeline: the consumer frees each
+  slot as it assembles, so a message larger than the whole ring still
+  streams through.
 - **Receive**: the poll thread assembles each frame into a dedicated
   writable bytearray and hands it to ``dss.unpack_from`` — delivered
   arrays are writable views over that frame buffer (no per-array
@@ -115,6 +132,24 @@ mca_var.register(
     "copies, so messages larger than the whole ring stream through)",
     type=int,
 )
+mca_var.register(
+    "sm_leader_ring_bytes", 2 << 20,
+    "Ring payload capacity for the LEADER peer class (cross-NUMA-domain "
+    "pairs on one host — the han dleader exchange): their traffic is "
+    "the segmented eager exchange (coll_han_inter_segment pieces), so "
+    "the ring can be shallower than the intra-domain class without "
+    "losing throughput (frames larger than the ring still stream); "
+    "sized separately so the demand-mapped footprint tracks the role",
+    type=int,
+)
+mca_var.register(
+    "sm_numa_id", "",
+    "NUMA-domain identity override for the modex card (the pynuma: "
+    "item): empty = derive from sysfs (/sys/devices/system/node "
+    "cpulist vs this proc's affinity mask, single-domain when "
+    "unreadable); set per rank to emulate multi-domain topologies "
+    "exactly like the han bench's per-rank sm_boot_id",
+)
 
 _U64 = struct.Struct("<Q")
 _U32 = struct.Struct("<I")
@@ -124,16 +159,45 @@ _U32 = struct.Struct("<I")
 _SLOT = struct.Struct("<II")
 _SLOT_HDR = 16  # _SLOT padded to 16 for payload alignment
 
-_MAGIC = 0x315F4D5359505A00  # "\0ZPYSM_1" little-endian
-_SEG_HDR = 4096              # segment header page
+_MAGIC = 0x325F4D5359505A00  # "\0ZPYSM_2" little-endian (v2: directory)
 _RING_HDR = 128              # head @+0, tail @+64 (cache-line separated)
 # segment-header field offsets
 _OFF_MAGIC = 0
 _OFF_NRINGS = 12
-_OFF_NSLOTS = 16
-_OFF_SLOT_BYTES = 20
+_OFF_SPAN = 16       # u64: per-source reserved ring-region span
+_OFF_HDRLEN = 24     # u64: header length == offset of ring region 0
 _OFF_DOORBELL = 64   # consumer sleep flag (futex word)
 _OFF_STOPPED = 128   # owner's poll loop exited (peers stop quiescing)
+_OFF_BITMAP = 256    # allocation bitmap: ceil(size/64) u64 words
+
+# ring directory: one 64-byte entry per source rank, after the bitmap.
+# state/klass are written by the (single) sender of that source rank,
+# nslots/slot_bytes by the owner at materialization — no shared-word
+# writers, so the handshake needs no cross-process atomics beyond the
+# store-ordering fences already used by the rings themselves.
+_DIRENT = 64
+_DE_STATE = 0        # u32: _ST_EMPTY / _ST_REQUESTED / _ST_READY
+_DE_CLASS = 4        # u32: requested peer class (sender-written)
+_DE_NSLOTS = 8       # u32: final geometry (owner-written)
+_DE_SLOT_BYTES = 12  # u32: final geometry (owner-written)
+_ST_EMPTY, _ST_REQUESTED, _ST_READY = 0, 1, 2
+
+# peer classes (ring sizing): same NUMA domain vs leader-to-leader
+CLASS_INTRA = 0
+CLASS_LEADER = 1
+
+
+def _bitmap_words(size: int) -> int:
+    return -(-size // 64)
+
+
+def _dir_off(size: int) -> int:
+    off = _OFF_BITMAP + _bitmap_words(size) * 8
+    return (off + 63) & ~63
+
+
+def _hdr_len(size: int) -> int:
+    return (_dir_off(size) + size * _DIRENT + 4095) & ~4095
 
 # poll cadence: stay hot (GIL-yielding spin) through a window that
 # covers a ping-pong inter-arrival gap — the C shim measured that
@@ -339,12 +403,106 @@ def parse_card(card) -> tuple[str, str] | None:
     return None
 
 
+_NUMA_PREFIX = "pynuma:"
+
+#: sentinel returned by :func:`parse_numa` for an item that WEARS the
+#: pynuma prefix but cannot be a domain token (foreign/corrupt card):
+#: the topology layer counts it and demotes the rank to a singleton
+#: domain instead of letting a malformed foreign card raise out of a
+#: collective's topology derivation
+NUMA_MALFORMED = "\x00malformed"
+
+
+def numa_card_item(token: str) -> str:
+    return f"{_NUMA_PREFIX}{token}"
+
+
+def parse_numa(card):
+    """NUMA-domain token from a modex card's capability items: the
+    token string, ``None`` when absent (old cards stay parseable —
+    the host degrades to a single domain), or :data:`NUMA_MALFORMED`
+    for a present-but-unusable item (cards are relayed verbatim from
+    arbitrary peers — never raise out of topology derivation)."""
+    if not isinstance(card, (list, tuple)):
+        return None
+    for item in card[2:]:
+        if isinstance(item, str) and item.startswith(_NUMA_PREFIX):
+            tok = item[len(_NUMA_PREFIX):]
+            if tok and ":" not in tok and len(tok) <= 64:
+                return tok
+            return NUMA_MALFORMED
+    return None
+
+
+def _numa_from_sysfs() -> str:
+    """This proc's NUMA domain via sysfs: the node whose cpulist holds
+    the first CPU of our affinity mask (the hwloc-locality analog).
+    Anything unreadable/degenerate collapses to domain "0" — a single
+    domain, which the topology layer treats as "no NUMA structure"."""
+    base = "/sys/devices/system/node"
+    try:
+        nodes = sorted(
+            int(d[4:]) for d in os.listdir(base)
+            if d.startswith("node") and d[4:].isdigit()
+        )
+        if len(nodes) < 2:
+            return "0"
+        cpu = min(os.sched_getaffinity(0))
+        for n in nodes:
+            with open(f"{base}/node{n}/cpulist") as f:
+                for part in f.read().strip().split(","):
+                    if not part:
+                        continue
+                    lo, _, hi = part.partition("-")
+                    if int(lo) <= cpu <= int(hi or lo):
+                        return str(n)
+    except (OSError, ValueError):
+        pass
+    return "0"
+
+
+def numa_token() -> str:
+    """Domain identity for the modex card: the ``sm_numa_id`` MCA
+    override when set (multi-domain emulation, exactly like the han
+    bench's per-rank ``sm_boot_id``), else the sysfs derivation."""
+    override = str(mca_var.get("sm_numa_id", "") or "").strip()
+    if override:
+        return override.replace(":", "_")[:64]
+    return _numa_from_sysfs()
+
+
+# close-time audit registry: every clean SmSegment.close() verifies its
+# directory/bitmap/footprint invariants and records violations here for
+# the conftest session gate (the demand-mapping contract: no ring
+# materialized for a peer that never sent, no orphaned directory entry,
+# physical pages within the bitmap-derived bound)
+_audit_failures: list[str] = []
+
+
+def segment_audit_failures() -> list[str]:
+    with _registry_lock:
+        return list(_audit_failures)
+
+
 def _geometry() -> tuple[int, int]:
     slot_bytes = max(64, int(mca_var.get("sm_max_frag", 128 << 10)))
     ring_bytes = max(slot_bytes, int(mca_var.get("sm_ring_bytes",
                                                  4 << 20)))
     nslots = max(2, ring_bytes // slot_bytes)
     return nslots, slot_bytes
+
+
+def _class_geometry(klass: int) -> tuple[int, int]:
+    """(nslots, slot_bytes) of a peer class, from the OWNER's vars at
+    segment creation: intra-domain rings size by ``sm_ring_bytes``,
+    leader-to-leader rings by ``sm_leader_ring_bytes``."""
+    if klass == CLASS_LEADER:
+        slot_bytes = max(64, int(mca_var.get("sm_max_frag", 128 << 10)))
+        ring_bytes = max(slot_bytes,
+                         int(mca_var.get("sm_leader_ring_bytes",
+                                         2 << 20)))
+        return max(2, ring_bytes // slot_bytes), slot_bytes
+    return _geometry()
 
 
 def _ring_span(nslots: int, slot_bytes: int) -> int:
@@ -371,13 +529,18 @@ class ConsumerStopped(errors.InternalError):
 class _RingState:
     """Consumer-side per-ring bookkeeping (the owner is the only
     consumer; ``tail`` here is authoritative, the shm copy exists for
-    the producer's free-space check)."""
+    the producer's free-space check).  Geometry is per ring — peer
+    classes size their rings differently under demand mapping."""
 
-    __slots__ = ("src", "base", "tail", "buf", "fill")
+    __slots__ = ("src", "base", "tail", "buf", "fill", "nslots",
+                 "slot_bytes")
 
-    def __init__(self, src: int, base: int):
+    def __init__(self, src: int, base: int, nslots: int,
+                 slot_bytes: int):
         self.src = src
         self.base = base
+        self.nslots = nslots
+        self.slot_bytes = slot_bytes
         self.tail = 0
         self.buf: bytearray | None = None  # partial message assembly
         self.fill = 0
@@ -396,9 +559,20 @@ class SmSegment:
         self.rank = rank
         self.size = size
         self._on_frame = on_frame
-        self.nslots, self.slot_bytes = _geometry()
-        span = _ring_span(self.nslots, self.slot_bytes)
-        seg_len = _SEG_HDR + size * span
+        # per-class geometry fixed at creation (the directory publishes
+        # the materialized ring's actual shape, so a cross-proc MCA
+        # mismatch still cannot desync the slot walk)
+        self._class_geom = {
+            CLASS_INTRA: _class_geometry(CLASS_INTRA),
+            CLASS_LEADER: _class_geometry(CLASS_LEADER),
+        }
+        self.nslots, self.slot_bytes = self._class_geom[CLASS_INTRA]
+        # every source's region is reserved at the WORST class span —
+        # virtual reservation only: the file is sparse, and an
+        # unmaterialized (or half-filled) ring costs no tmpfs pages
+        span = max(_ring_span(n, s) for n, s in self._class_geom.values())
+        self._hdr = _hdr_len(size)
+        seg_len = self._hdr + size * span
         self.name = name or _segment_name(rank)
         self.path = os.path.join(segment_dir(), self.name)
         flags = os.O_CREAT | os.O_EXCL | os.O_RDWR
@@ -434,21 +608,23 @@ class SmSegment:
         self._mv = memoryview(self._mm)
         mm = self._mm
         _U32.pack_into(mm, _OFF_NRINGS, size)
-        _U32.pack_into(mm, _OFF_NSLOTS, self.nslots)
-        _U32.pack_into(mm, _OFF_SLOT_BYTES, self.slot_bytes)
+        _U64.pack_into(mm, _OFF_SPAN, span)
+        _U64.pack_into(mm, _OFF_HDRLEN, self._hdr)
         # magic stamped LAST: a mapper that sees it sees the geometry
         _U64.pack_into(mm, _OFF_MAGIC, _MAGIC)
         self._span = span
-        self._rings = [
-            _RingState(src, _SEG_HDR + src * span)
-            for src in range(size) if src != rank
-        ]
+        # demand mapping: rings materialize when their sender's first
+        # contact writes an allocation request into the directory — the
+        # poll loop scans _pending until every possible source is live
+        self._rings: list[_RingState] = []
+        self._pending = [src for src in range(size) if src != rank]
         # per-segment hot window (sm_poll_hot_us): 0 on single-CPU
         # affinity masks — see the var's rationale
         self._hot_s = max(0, int(mca_var.get("sm_poll_hot_us", 5000))) \
             / 1e6
         self._stop = threading.Event()
         self._closed = False
+        self._severed = False
         self._close_lock = threading.Lock()
         self._poll = threading.Thread(
             target=self._poll_loop, daemon=True,
@@ -459,6 +635,70 @@ class SmSegment:
 
     def card(self, boot: str) -> str:
         return card_item(boot, self.name)
+
+    # -- demand-mapped ring directory ------------------------------------
+
+    def _dirent(self, src: int) -> int:
+        return _dir_off(self.size) + src * _DIRENT
+
+    def _scan_requests(self) -> bool:
+        """Materialize rings whose sender wrote an allocation request:
+        publish the class geometry, set the bitmap bit, flip the entry
+        READY, and start consuming.  Runs on the poll thread (the owner
+        is the only writer of geometry/bitmap/READY, so the handshake
+        needs no cross-process atomics)."""
+        if not self._pending:
+            return False
+        mm = self._mm
+        progressed = False
+        for src in list(self._pending):
+            off = self._dirent(src)
+            if _U32.unpack_from(mm, off + _DE_STATE)[0] != _ST_REQUESTED:
+                continue
+            _fence()  # class write precedes the REQUESTED store
+            klass = _U32.unpack_from(mm, off + _DE_CLASS)[0]
+            nslots, slot_bytes = self._class_geom.get(
+                klass, self._class_geom[CLASS_INTRA])
+            _U32.pack_into(mm, off + _DE_NSLOTS, nslots)
+            _U32.pack_into(mm, off + _DE_SLOT_BYTES, slot_bytes)
+            _fence()  # geometry must be visible before READY
+            _U32.pack_into(mm, off + _DE_STATE, _ST_READY)
+            word = _OFF_BITMAP + (src // 64) * 8
+            bits = _U64.unpack_from(mm, word)[0]
+            _U64.pack_into(mm, word, bits | (1 << (src % 64)))
+            self._rings.append(_RingState(
+                src, self._hdr + src * self._span, nslots, slot_bytes))
+            self._pending.remove(src)
+            spc.record("sm_rings_materialized", 1)
+            mca_output.verbose(
+                5, _stream,
+                "rank %d: ring from rank %d materialized "
+                "(class=%d, %d x %dB)", self.rank, src, klass, nslots,
+                slot_bytes,
+            )
+            progressed = True
+        return progressed
+
+    def materialized(self) -> list[int]:
+        """Source ranks whose inbound ring exists — the allocation
+        bitmap's view (the OSU numa ladder's role-bound gate)."""
+        return sorted(st.src for st in self._rings)
+
+    def footprint_bytes(self) -> int:
+        """Logical segment footprint: header pages plus every
+        MATERIALIZED ring's span — the bitmap-derived bound the audit
+        compares the tmpfs page count against (unmaterialized regions
+        are sparse and cost nothing)."""
+        return self._hdr + sum(_ring_span(st.nslots, st.slot_bytes)
+                               for st in self._rings)
+
+    def physical_bytes(self) -> int | None:
+        """Actual backing pages of the segment file (tmpfs allocates
+        on first touch; ``st_blocks`` is the honest footprint)."""
+        try:
+            return os.stat(self.path).st_blocks * 512
+        except OSError:
+            return None
 
     # -- consumer --------------------------------------------------------
 
@@ -475,7 +715,7 @@ class SmSegment:
         if head == st.tail:
             return False
         _fence()  # acquire edge: slot reads must not pass the head load
-        nslots, slot_bytes = self.nslots, self.slot_bytes
+        nslots, slot_bytes = st.nslots, st.slot_bytes
         while st.tail < head:
             slot = st.base + _RING_HDR + \
                 (st.tail % nslots) * (_SLOT_HDR + slot_bytes)
@@ -521,7 +761,7 @@ class SmSegment:
         hot_until = time.monotonic() + self._hot_s
         try:
             while not self._stop.is_set():
-                progressed = False
+                progressed = self._scan_requests()
                 for st in self._rings:
                     progressed |= self._drain_ring(st)
                 now = time.monotonic()
@@ -533,11 +773,14 @@ class SmSegment:
                     # the app threads this poll serves can actually run
                     time.sleep(0)
                     continue
-                # doze: announce sleep, re-check (lost-wakeup guard),
-                # park bounded — a missed doorbell costs one doze
+                # doze: announce sleep, re-check (lost-wakeup guard:
+                # heads AND allocation requests — a first-contact
+                # sender rings the same doorbell), park bounded — a
+                # missed doorbell costs one doze
                 _U32.pack_into(mm, _OFF_DOORBELL, 1)
                 _fence()  # flag store must precede the head re-reads
-                if self._any_ready() or self._stop.is_set():
+                if self._any_ready() or self._scan_requests() \
+                        or self._stop.is_set():
                     _U32.pack_into(mm, _OFF_DOORBELL, 0)
                     hot_until = time.monotonic() + self._hot_s
                     continue
@@ -561,13 +804,82 @@ class SmSegment:
     def sever(self) -> None:
         """Crash simulation: consumption stops, the file survives (a
         real crash cleans nothing up — the launcher sweep / final
-        harness close owns the unlink)."""
+        harness close owns the unlink; the close-time audit is skipped
+        for a severed segment, a crash honors no invariants)."""
+        self._severed = True
         self._stop.set()
         try:
             _futex_wake(self._mm, _OFF_DOORBELL)
         except ValueError:
             pass
         self._poll.join(timeout=5.0)
+
+    def _audit(self) -> None:
+        """Demand-mapping invariants, checked once at clean close and
+        recorded for the conftest session gate: every bitmap bit
+        matches a READY directory entry matches a consuming ring, no
+        allocation request was left unserved (orphaned directory
+        entry), and the tmpfs page count stays within the
+        bitmap-derived bound (no pages touched for peers that never
+        sent)."""
+        mm = self._mm
+        fails: list[str] = []
+        ready = {st.src for st in self._rings}
+        try:
+            for src in range(self.size):
+                if src == self.rank:
+                    continue
+                off = self._dirent(src)
+                state = _U32.unpack_from(mm, off + _DE_STATE)[0]
+                if state == _ST_REQUESTED:
+                    # a request racing the close: its sender observes
+                    # _OFF_STOPPED within one spin iteration and rolls
+                    # the entry back to EMPTY — grant that rollback a
+                    # bounded grace before calling the entry orphaned
+                    # (a crashed-mid-handshake sender stays flagged)
+                    deadline = time.monotonic() + 0.2
+                    while state == _ST_REQUESTED \
+                            and time.monotonic() < deadline:
+                        time.sleep(0.001)
+                        state = _U32.unpack_from(
+                            mm, off + _DE_STATE)[0]
+                word = _OFF_BITMAP + (src // 64) * 8
+                bit = (_U64.unpack_from(mm, word)[0] >> (src % 64)) & 1
+                if state == _ST_REQUESTED:
+                    fails.append(
+                        f"{self.name}: rank {src}'s ring request was "
+                        "never materialized (orphaned directory entry)"
+                    )
+                if bool(bit) != (state == _ST_READY):
+                    fails.append(
+                        f"{self.name}: bitmap bit for rank {src} "
+                        f"({bit}) disagrees with directory state "
+                        f"({state})"
+                    )
+                if (state == _ST_READY) != (src in ready):
+                    fails.append(
+                        f"{self.name}: directory ready="
+                        f"{state == _ST_READY} for rank {src} but "
+                        f"consumer materialized={src in ready}"
+                    )
+            phys = self.physical_bytes()
+            if phys is not None and self.path.startswith("/dev/shm"):
+                # slack: ring regions need not be page-aligned, so each
+                # materialized ring may touch up to TWO extra pages
+                # (one at each unaligned end), plus header slop
+                bound = self.footprint_bytes() + \
+                    (2 * len(ready) + 2) * 4096
+                if phys > bound:
+                    fails.append(
+                        f"{self.name}: physical footprint {phys}B "
+                        f"exceeds the bitmap-derived bound {bound}B "
+                        "(pages touched outside materialized rings)"
+                    )
+        except ValueError:  # pragma: no cover - mm closed under us
+            return
+        if fails:
+            with _registry_lock:
+                _audit_failures.extend(fails)
 
     def close(self) -> None:
         with self._close_lock:
@@ -580,6 +892,8 @@ class SmSegment:
         except ValueError:
             pass
         self._poll.join(timeout=5.0)
+        if not getattr(self, "_severed", False):
+            self._audit()
         self._mv.release()
         try:
             self._mm.close()
@@ -594,18 +908,22 @@ class SmSegment:
 
 
 class SmSender:
-    """The producer half: maps a peer's segment and streams frames into
+    """The producer half: maps a peer's segment, runs the tiny
+    allocate handshake (first contact materializes this source's ring
+    through the owner's doorbell machinery), and streams frames into
     the ring indexed by this proc's rank.  Geometry comes from the
-    SEGMENT header, not local MCA state — mismatched vars between procs
-    cannot desynchronize the slot walk."""
+    segment's RING DIRECTORY, not local MCA state — mismatched vars
+    between procs cannot desynchronize the slot walk, and the owner
+    alone decides each peer class's ring capacity."""
 
-    def __init__(self, name: str, src_rank: int, dest_rank: int):
+    def __init__(self, name: str, src_rank: int, dest_rank: int,
+                 ring_class: int = CLASS_INTRA, timeout: float = 10.0):
         self.dest = dest_rank
         self.path = os.path.join(segment_dir(), name)
         fd = os.open(self.path, os.O_RDWR)
         try:
             seg_len = os.fstat(fd).st_size
-            if seg_len < _SEG_HDR:
+            if seg_len < 4096:
                 raise errors.InternalError(
                     f"sm segment {name}: truncated ({seg_len} bytes)"
                 )
@@ -613,33 +931,88 @@ class SmSender:
         finally:
             os.close(fd)
         mm = self._mm
-        if _U64.unpack_from(mm, _OFF_MAGIC)[0] != _MAGIC:
+        try:
+            if _U64.unpack_from(mm, _OFF_MAGIC)[0] != _MAGIC:
+                raise errors.InternalError(
+                    f"sm segment {name}: bad magic (creator still "
+                    "stamping or foreign file)"
+                )
+            nrings = _U32.unpack_from(mm, _OFF_NRINGS)[0]
+            span = _U64.unpack_from(mm, _OFF_SPAN)[0]
+            hdr = _U64.unpack_from(mm, _OFF_HDRLEN)[0]
+            if src_rank >= nrings:
+                raise errors.InternalError(
+                    f"sm segment {name}: rank {src_rank} outside its "
+                    f"{nrings}-ring universe"
+                )
+            expect = hdr + nrings * span
+            if seg_len < expect:
+                raise errors.InternalError(
+                    f"sm segment {name}: {seg_len} bytes < {expect} "
+                    "expected"
+                )
+            self._base = hdr + src_rank * span
+            self._entry = _dir_off(nrings) + src_rank * _DIRENT
+            self._handshake(ring_class, timeout)
+            self.nslots = _U32.unpack_from(
+                mm, self._entry + _DE_NSLOTS)[0]
+            self.slot_bytes = _U32.unpack_from(
+                mm, self._entry + _DE_SLOT_BYTES)[0]
+            if not self.nslots or not self.slot_bytes or \
+                    _ring_span(self.nslots, self.slot_bytes) > span:
+                raise errors.InternalError(
+                    f"sm segment {name}: corrupt directory geometry "
+                    f"({self.nslots} x {self.slot_bytes}B in a "
+                    f"{span}B region)"
+                )
+        except BaseException:
             mm.close()
-            raise errors.InternalError(
-                f"sm segment {name}: bad magic (creator still stamping "
-                "or foreign file)"
-            )
-        nrings = _U32.unpack_from(mm, _OFF_NRINGS)[0]
-        self.nslots = _U32.unpack_from(mm, _OFF_NSLOTS)[0]
-        self.slot_bytes = _U32.unpack_from(mm, _OFF_SLOT_BYTES)[0]
-        if src_rank >= nrings:
-            mm.close()
-            raise errors.InternalError(
-                f"sm segment {name}: rank {src_rank} outside its "
-                f"{nrings}-ring universe"
-            )
-        span = _ring_span(self.nslots, self.slot_bytes)
-        expect = _SEG_HDR + nrings * span
-        if seg_len < expect:
-            mm.close()
-            raise errors.InternalError(
-                f"sm segment {name}: {seg_len} bytes < {expect} expected"
-            )
-        self._base = _SEG_HDR + src_rank * span
+            raise
         self._head = _U64.unpack_from(mm, self._base)[0]
         self._mv = memoryview(mm)  # see SmSegment: no-copy slot windows
         self._lock = threading.Lock()
         self._dead = False
+
+    def _handshake(self, ring_class: int, timeout: float) -> None:
+        """Demand-map this source's ring: write the peer class, flip
+        the directory entry REQUESTED, ring the doorbell, and wait for
+        the owner's poll thread to publish READY + geometry.  A ring an
+        earlier same-rank sender already materialized is adopted as-is
+        (its geometry is the contract)."""
+        mm = self._mm
+        if _U32.unpack_from(mm, self._entry + _DE_STATE)[0] == _ST_READY:
+            _fence()
+            return
+        _U32.pack_into(mm, self._entry + _DE_CLASS, int(ring_class))
+        _fence()  # class store precedes the REQUESTED store
+        _U32.pack_into(mm, self._entry + _DE_STATE, _ST_REQUESTED)
+        self._doorbell()
+        deadline = time.monotonic() + timeout
+        spins = 0
+        while _U32.unpack_from(
+                mm, self._entry + _DE_STATE)[0] != _ST_READY:
+            if _U32.unpack_from(mm, _OFF_STOPPED)[0]:
+                # roll the request back before surfacing: a STOPPED
+                # owner provably never serves it, and this sender is
+                # the sole writer of a not-READY state word — the
+                # request must not linger as an orphaned directory
+                # entry for the owner's close-time audit to trip over
+                if _U32.unpack_from(
+                        mm, self._entry + _DE_STATE)[0] != _ST_READY:
+                    _U32.pack_into(mm, self._entry + _DE_STATE,
+                                   _ST_EMPTY)
+                raise ConsumerStopped(
+                    f"sm ring to rank {self.dest}: consumer stopped "
+                    "before materializing the ring"
+                )
+            if time.monotonic() > deadline:
+                raise errors.InternalError(
+                    f"sm ring to rank {self.dest}: allocation "
+                    "handshake timed out (owner poll thread wedged?)"
+                )
+            spins += 1
+            time.sleep(0 if spins < 200 else 0.0001)
+        _fence()  # geometry reads must not pass the READY load
 
     # -- producer --------------------------------------------------------
 

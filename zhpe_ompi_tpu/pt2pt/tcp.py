@@ -433,6 +433,7 @@ class TcpProc(errh.HasErrhandler, ulfm.UlfmEndpointAPI, HostCollectives,
                  rejoin_book: list | None = None,
                  sm: bool | None = None,
                  sm_boot_id: str | None = None,
+                 sm_numa_id: str | None = None,
                  pmix: "tuple[str, int] | str | None" = None,
                  namespace: str = "default",
                  rejoin: bool = False,
@@ -523,6 +524,13 @@ class TcpProc(errh.HasErrhandler, ulfm.UlfmEndpointAPI, HostCollectives,
         self._sm_declined: set[int] = set()  # advertised sm, not ridden
         self._sm_lock = threading.Lock()
         self._sm_boot = sm_boot_id or sm_mod.boot_token()
+        # NUMA-domain token (hosts nest into domains): constructor
+        # override for per-rank emulation, else the sm_numa_id MCA var
+        # / sysfs derivation — advertised next to the pyshm card item
+        self._sm_numa = (
+            str(sm_numa_id).strip().replace(":", "_")[:64]
+            if sm_numa_id else sm_mod.numa_token()
+        )
         sm_on = bool(int(mca_var.get("sm", 1))) if sm is None else bool(sm)
         if sm_on and size > 1 and rejoin_book is None and not rejoin:
             try:
@@ -676,9 +684,19 @@ class TcpProc(errh.HasErrhandler, ulfm.UlfmEndpointAPI, HostCollectives,
             # not provably ours — degrade loudly (counted per send)
             self._sm_declined.add(dest)
             return None
+        # peer class decides the ring capacity the owner materializes:
+        # a provably different NUMA domain makes this a leader-to-leader
+        # pair (the han dleader exchange — segmented eager traffic);
+        # unknown/absent/malformed tokens stay intra (full-size ring,
+        # always correct)
+        peer_numa = sm_mod.parse_numa(cards[dest])
+        klass = sm_mod.CLASS_INTRA
+        if peer_numa not in (None, sm_mod.NUMA_MALFORMED) \
+                and peer_numa != self._sm_numa:
+            klass = sm_mod.CLASS_LEADER
         try:
             sender = sm_mod.SmSender(name, src_rank=self.rank,
-                                     dest_rank=dest)
+                                     dest_rank=dest, ring_class=klass)
         except (OSError, errors.MpiError) as e:
             mca_output.emit(
                 _stream,
@@ -1127,6 +1145,32 @@ class TcpProc(errh.HasErrhandler, ulfm.UlfmEndpointAPI, HostCollectives,
         card = sm_mod.parse_card(cards[rank])
         return card[0] if card is not None else None
 
+    def numa_token_of(self, rank: int):
+        """NUMA-domain identity of ``rank`` as the modex advertised it
+        (the ``pynuma:`` card item): a token string, None when absent
+        (old/foreign cards — the host degrades to one domain), or the
+        :data:`~zhpe_ompi_tpu.pt2pt.sm.NUMA_MALFORMED` sentinel.  Own
+        rank reads its OWN relayed card, so every rank derives the
+        identical nested structure."""
+        cards = getattr(self, "_peer_cards", None)
+        if cards is None or not 0 <= rank < len(cards):
+            return None
+        return sm_mod.parse_numa(cards[rank])
+
+    def sm_segment_stats(self) -> dict | None:
+        """Demand-mapping introspection of this proc's OWN segment (the
+        OSU numa ladder's footprint gate): materialized inbound ring
+        sources, the bitmap-derived logical footprint, and the actual
+        tmpfs page bytes.  None when the sm plane is off."""
+        seg = self._sm_seg
+        if seg is None:
+            return None
+        return {
+            "materialized": seg.materialized(),
+            "footprint_bytes": seg.footprint_bytes(),
+            "physical_bytes": seg.physical_bytes(),
+        }
+
     # -- wire-up ---------------------------------------------------------
 
     def _my_card(self) -> list:
@@ -1138,6 +1182,10 @@ class TcpProc(errh.HasErrhandler, ulfm.UlfmEndpointAPI, HostCollectives,
         card = list(self.address)
         if self._sm_seg is not None:
             card.append(self._sm_seg.card(self._sm_boot))
+            # NUMA-domain token (the host→domain nesting level): only
+            # meaningful next to a locality (pyshm) item — a rank with
+            # no provable host is a singleton either way
+            card.append(sm_mod.numa_card_item(self._sm_numa))
         return card
 
     def _modex_pmix(self, timeout: float) -> list[tuple[str, int]]:
@@ -2340,23 +2388,14 @@ class TcpProc(errh.HasErrhandler, ulfm.UlfmEndpointAPI, HostCollectives,
                     return self.call_errhandler(fail_exc)
                 # diagnosis: is the message parked unexpected while our
                 # posted recv failed to match it? (engine race forensics;
-                # queue snapshots only exist on the Python engine and are
-                # taken under its lock — drain threads keep appending)
+                # queue snapshots only exist on the Python engine, which
+                # takes them under its own lock — drain threads keep
+                # appending)
                 hit = self.engine.probe(source, tag, cid)
                 unexpected, posted = [], []
-                eng_lock = getattr(self.engine, "_lock", None)
-                if eng_lock is not None and hasattr(
-                    self.engine, "_unexpected"
-                ):
-                    with eng_lock:
-                        unexpected = [
-                            (e.src, e.tag, e.cid, e.seq)
-                            for e, _ in self.engine._unexpected
-                        ]
-                        posted = [
-                            (p.src, p.tag, p.cid)
-                            for p in self.engine._posted
-                        ]
+                rows = getattr(self.engine, "debug_rows", None)
+                if rows is not None:
+                    posted, unexpected = rows()
                 # peer death / stall surfaces here as a recv timeout;
                 # dispatch per the communicator's errhandler disposition
                 # rather than a bare raise (round-4, VERDICT weak #4)

@@ -162,6 +162,15 @@ class RankContext(errh.HasErrhandler, ulfm.UlfmEndpointAPI,
             raise errors.RankError(f"rank {rank} out of range")
         return f"uni-{id(self.universe):x}"
 
+    def numa_token_of(self, rank: int) -> str:
+        """NUMA-domain identity for the nested (three-level) topology:
+        thread ranks share one process and therefore one affinity mask
+        — the whole universe is one domain (emulated multi-domain
+        layouts on the thread plane use the han ``groups`` override)."""
+        if not 0 <= rank < self.size:
+            raise errors.RankError(f"rank {rank} out of range")
+        return "0"
+
     # -- internals -------------------------------------------------------
 
     def _mbox(self, dest: int) -> queue.Queue:

@@ -67,6 +67,25 @@ dispatch seam in ``pt2pt/tcp.py``; the rings live in ``pt2pt/sm.py``):
   unmappable segment): visible degradation, asserted zero along the
   OSU ``--plane sm`` ladder.  Intentional TCP (``sm=0``, remote hosts,
   C ranks, rejoiners) is not counted.
+- ``sm_rings_materialized`` — rings demand-mapped into existence by a
+  sender's first-contact allocation request (the segment directory
+  handshake).  Under han traffic this tracks the role-based bound
+  (``domain_size + is_leader × n_groups`` per proc), NOT the universe
+  size — the OSU ``--plane numa`` footprint gate reads the per-segment
+  allocation bitmap directly.
+
+Matching-engine counters (``pt2pt/matching.py``; the hash-binned
+queue walks):
+
+- ``match_comparisons`` — posted/unexpected entry inspections performed
+  while matching (the bin walks' actual work).  The binned engine's
+  delta on a wildcard-heavy posted/unexpected mix is gated in
+  ``tests/test_pt2pt.py`` — a regression to linear scanning shows up
+  as a counter explosion, not a mystery slowdown.
+- ``match_unexpected_max_depth`` — WATERMARK: the deepest the
+  unexpected backlog ever got (recorded at insert on both engines).
+  A consumer that stops posting — or a matching bug that strands
+  arrivals — is visible here even after the queues drain.
 
 Hierarchical-collective counters (the coll/han analog; recorded by
 ``coll/han.py`` and the ``pt2pt/groups.py`` GroupView send seam):
@@ -93,6 +112,25 @@ Hierarchical-collective counters (the coll/han analog; recorded by
   segments): segment k's intra bcast isends drain on the deferred
   engine while segment k+1's wire exchange runs.  The OSU ``--plane
   han`` pipeline row gates on this rising at >= 2-segment sizes.
+- ``coll_han_numa_collectives`` — collectives that ran the THREE-level
+  (NUMA) schedule (``coll_han_numa_level`` auto/on on a nested
+  topology): intra-domain phase → intra-host domain-leader exchange →
+  inter-host wire exchange.  The OSU ``--plane numa`` ladder gates on
+  this rising.
+- ``coll_han_dleader_bytes`` — payload bytes of the three-level
+  schedule's intra-host domain-leader exchange (same-host sm traffic,
+  accounted apart from both the domain phase and the wire phase; the
+  bytes a domains-as-hosts layout would have paid at wire prices).
+- ``han_numa_fallbacks`` — collectives that REQUESTED the three-level
+  schedule (``coll_han_numa_level=on``) but ran TWO-level because the
+  NUMA structure is degenerate: loud degradation — never silent, and
+  never all the way to flat while the host level is viable (the
+  two-level fallback contract).  ``auto`` declining to nest is not a
+  fallback and is not counted.
+- ``han_malformed_numa_cards`` — ranks whose ``pynuma:`` card item was
+  present but unusable during topology derivation: counted and demoted
+  to a singleton domain (a malformed FOREIGN card must never raise out
+  of a collective).
 
 Runtime-plane counters (the PRRTE/PMIx analog — ``runtime/pmix.py``
 records the ``pmix_*`` family in the process hosting the STORE, i.e.
@@ -128,7 +166,7 @@ from collections import defaultdict
 _counters: dict[str, int] = defaultdict(int)
 _lock = threading.Lock()
 
-WATERMARK = {"max_bytes_in_collective"}
+WATERMARK = {"max_bytes_in_collective", "match_unexpected_max_depth"}
 
 
 def record(name: str, value: int = 1) -> None:
