@@ -51,6 +51,27 @@ from typing import Callable
 
 import numpy as np
 
+from zhpe_ompi_tpu.utils import lockdep
+
+
+def _bench_env(repo: str) -> dict:
+    """Worker-process environment: lockdep-OFF is the bench default —
+    the lock-order witness belongs to the test suite (the conftest
+    turns it on there); measured paths run the raw primitives so the
+    numbers are honest.  ``--lockdep`` opts back in explicitly."""
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    # force the flag BOTH ways: --lockdep must instrument the worker
+    # ranks too (their transports construct locks at import), and the
+    # default must strip an inherited ZMPI_LOCKDEP=1
+    env["ZMPI_LOCKDEP"] = "1" if _keep_lockdep[0] else "0"
+    return env
+
+
+#: mutated once by main() when --lockdep is passed
+_keep_lockdep = [False]
+
 
 def _sizes(max_bytes: int, min_bytes: int = 4) -> list[int]:
     out = []
@@ -685,9 +706,7 @@ def _run_proc_bench_once(spec: dict, nprocs: int,
     port = s.getsockname()[1]
     s.close()
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    env = dict(os.environ)
-    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
-    env.setdefault("JAX_PLATFORMS", "cpu")
+    env = _bench_env(repo)
     import threading
 
     procs = []
@@ -1146,9 +1165,7 @@ def bench_launch(nprocs: int = 2, reps: int = 5) -> list[dict]:
         "p = zmpi.host_init()\np.barrier()\nzmpi.host_finalize()\n"
     )
     prog.close()
-    env = dict(os.environ)
-    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
-    env.setdefault("JAX_PLATFORMS", "cpu")
+    env = _bench_env(repo)
     rows = []
 
     def record(mode, times):
@@ -1287,11 +1304,26 @@ def main(argv: list[str] | None = None) -> int:
                    help="launch-latency ladder: cold zmpirun (launcher "
                         "proc / in-process) vs a resident zprted DVM, "
                         "counter-gated (runtime plane)")
+    p.add_argument("--lockdep", action="store_true",
+                   help="run WITH the lock-order witness instrumented "
+                        "(diagnosis only: numbers are not comparable "
+                        "to the default raw-lock rows)")
     p.add_argument("--_worker", default=None, help=argparse.SUPPRESS)
     args = p.parse_args(argv)
 
     if args._worker is not None:
         return _worker_main(json.loads(args._worker))
+    # lockdep-off is the bench default: measured paths run raw
+    # threading primitives.  An inherited ZMPI_LOCKDEP=1 (e.g. the test
+    # suite's) is stripped from worker envs and disabled in-process
+    # unless --lockdep explicitly opts in.
+    if args.lockdep:
+        _keep_lockdep[0] = True
+        lockdep.enable()
+    elif lockdep.enabled():
+        print("# lockdep witness inherited from the environment: "
+              "DISABLED for the bench (pass --lockdep to keep it)")
+        lockdep.disable()
     if args.launch:
         rows = bench_launch(nprocs=min(args.nprocs, 4),
                             reps=max(args.iters, 3))

@@ -9,6 +9,13 @@ host with no TPU.
 import os
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Lock-order witness ON for the whole suite (default-off for users and
+# benchmarks): transport locks constructed after this point are
+# lockdep-instrumented, the per-thread acquisition graph accumulates
+# across every test, and the session gate below asserts zero inversion
+# cycles.  Must be set before any zhpe_ompi_tpu transport module is
+# imported (lock construction reads it).
+os.environ.setdefault("ZMPI_LOCKDEP", "1")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
@@ -132,6 +139,14 @@ def _ulfm_detector_hygiene():
     assert not stale_ns, (
         f"stale PMIx namespace state left after the suite (the daemon "
         f"destroys a job's namespace when the job ends): {stale_ns}"
+    )
+    from zhpe_ompi_tpu.utils import lockdep
+
+    inversions = lockdep.cycles()
+    assert not inversions, (
+        f"lock-order witness recorded inversion cycle(s) across the "
+        f"suite (two threads took the named locks in opposite order "
+        f"somewhere — the ch.lock/_rndv_lock bug class): {inversions}"
     )
 
 

@@ -265,6 +265,51 @@ class TestUniverse:
         assert uni.run(main)[1] == [0.0, 1.0, 2.0, 3.0]
 
 
+class TestSendrecvParkRelease:
+    """A poisoned/abandoned rendezvous send's parked payload is
+    RELEASED (no universe-lifetime pin) and a late CTS for a released
+    id is a no-op, not a KeyError out of the progress loop (the ZL001
+    follow-through on the thread plane)."""
+
+    def test_release_drops_parked_entry(self):
+        uni = LocalUniverse(2)
+
+        def main(ctx):
+            if ctx.rank != 0:
+                return True
+            big = np.zeros(100_000)  # > pt2pt_eager_limit: parks
+            req = ctx.isend(big, dest=1, tag=5)
+            with ctx._lock:
+                parked = len(ctx._pending_rndv)
+            ctx._release_parked_sends(req)
+            with ctx._lock:
+                after = len(ctx._pending_rndv)
+            return (parked, after)
+
+        res = uni.run(main)
+        assert res[0] == (1, 0)
+
+    def test_late_cts_for_released_id_is_noop(self):
+        uni = LocalUniverse(2)
+
+        def main(ctx):
+            if ctx.rank != 0:
+                return True
+            big = np.zeros(100_000)
+            req = ctx.isend(big, dest=1, tag=6)
+            with ctx._lock:
+                (rndv_id,) = list(ctx._pending_rndv)
+            ctx._release_parked_sends(req)
+            # the partner's CTS lands AFTER the release: progress must
+            # swallow it (no KeyError, no delivery, no completion)
+            ctx.mailbox.put(("cts", rndv_id, 0, lambda payload: None))
+            ctx.progress()
+            return req.done
+
+        res = uni.run(main)
+        assert res[0] is False  # released, never completed by the CTS
+
+
 class TestGetCount:
     """MPI_Get_count semantics over received payloads."""
 

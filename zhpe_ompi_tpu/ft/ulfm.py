@@ -42,6 +42,7 @@ from typing import Any, Callable, Iterable
 from ..coll.host import HostCollectives
 from ..comm.group import Group
 from ..core import errors
+from ..mca import output as mca_output
 from ..mca import var as mca_var
 
 mca_var.register(
@@ -227,8 +228,15 @@ class FailureState:
         for fn in listeners:
             try:
                 fn(rank, cause)
-            except Exception:  # noqa: BLE001 - observer must not break
-                pass            # the classifier that discovered the death
+            except Exception as e:  # observer must not break the
+                # classifier that discovered the death — but the drop
+                # is LOUD: a teardown hook that silently failed leaves
+                # rings mapped into a corpse's address space (ZL004)
+                mca_output.emit(
+                    "ft",
+                    "failure listener %r raised on death of rank %s "
+                    "(%s): %s — dropped", fn, rank, cause, e,
+                )
 
     # -- failures --------------------------------------------------------
 

@@ -38,6 +38,7 @@ from typing import Any, Callable
 
 from ..runtime import peruse
 from ..runtime import spc
+from ..utils import lockdep
 
 ANY_SOURCE = -1
 ANY_TAG = -1
@@ -77,7 +78,7 @@ class MatchingEngine:
     ANY_SOURCE, post order for wildcard-vs-specific posted receives."""
 
     def __init__(self) -> None:
-        self._lock = threading.Lock()
+        self._lock = lockdep.lock("matching.MatchingEngine._lock")
         self._stamp = itertools.count()
         # (cid, src) -> deque[(stamp, PostedRecv)]; src may be
         # ANY_SOURCE (the per-cid wildcard bin)
@@ -330,7 +331,7 @@ class NativeMatchingEngine:
             raise RuntimeError(f"native library unavailable: {native.build_error}")
         self._lib = lib
         self._h = lib.zompi_match_create()
-        self._lock = threading.Lock()
+        self._lock = lockdep.lock("matching.NativeMatchingEngine._lock")
         self._next_key = 1
         self._payloads: dict[int, Any] = {}
         self._callbacks: dict[int, Callable[[Envelope, Any], None]] = {}

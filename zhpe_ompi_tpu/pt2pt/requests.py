@@ -13,6 +13,7 @@ from dataclasses import dataclass, field
 from typing import Any, Callable
 
 from ..core import errors
+from ..utils import lockdep
 
 
 @dataclass
@@ -66,7 +67,10 @@ class Request:
         self._done = threading.Event()
         self._value: Any = None
         self.status = Status()
-        self._lock = threading.Lock()
+        # witnessed: completion runs under TRANSPORT locks (the drain
+        # worker's ch.lock, the push's _rndv_lock) — the interprocedural
+        # order the static rule cannot see
+        self._lock = lockdep.lock("pt2pt.Request._lock")
         self._progress = progress
         self._cancel_fn = cancel_fn
         self._error: Any = None
@@ -318,15 +322,22 @@ def wait_all(requests, timeout: float | None = None):
 
 
 def wait_any(requests):
-    """MPI_Waitany: (index, value) of the first completed request."""
+    """MPI_Waitany: (index, value) of the first completed request.
+    Polls with a bounded exponential backoff: ``test()`` drives each
+    request's progress, so the first sweeps stay tight for fast
+    completions, but a long park must not hot-spin — sub-ms wakeups
+    steal scheduler quanta from the completing threads on
+    oversubscribed hosts (the PR 6 ``sm_poll_hot_us`` finding, ZL003)."""
     import time
 
+    delay = 0.0002
     while True:
         for i, r in enumerate(requests):
             flag, val = r.test()
             if flag:
                 return i, val
-        time.sleep(0.0002)
+        time.sleep(delay)
+        delay = min(delay * 2, 0.005)
 
 
 def test_all(requests):

@@ -98,6 +98,7 @@ from ..mca import output as mca_output
 from ..mca import var as mca_var
 from ..runtime import spc
 from ..utils import dss
+from ..utils import lockdep
 
 _stream = mca_output.open_stream("btl_sm")
 
@@ -241,6 +242,9 @@ _DOZE_S = 0.005
 # fence, but an uncontended lock round-trip is an atomic RMW
 # (LOCK-prefixed on x86, ldaxr/stlxr on arm64) and orders both sides;
 # any residual miss is bounded by the doze timeout.
+# Deliberately NOT a lockdep-witnessed lock: it is the memory fence on
+# every ring produce/consume (the hottest acquire in the plane), it
+# never nests, and nothing else may ever be taken under it.
 _fence_lock = threading.Lock()
 
 
@@ -317,7 +321,7 @@ def _futex_wake(mm: mmap.mmap, off: int, n: int = 1) -> None:
 # ------------------------------------------- naming, hygiene registry --
 
 _seg_counter = itertools.count()
-_registry_lock = threading.Lock()
+_registry_lock = lockdep.lock("sm._registry_lock")
 _created_paths: set[str] = set()
 _live_segments: weakref.WeakSet = weakref.WeakSet()
 
@@ -625,7 +629,7 @@ class SmSegment:
         self._stop = threading.Event()
         self._closed = False
         self._severed = False
-        self._close_lock = threading.Lock()
+        self._close_lock = lockdep.lock("sm.SmSegment._close_lock")
         self._poll = threading.Thread(
             target=self._poll_loop, daemon=True,
             name=f"sm-poll-{rank}-{os.getpid()}",
@@ -770,7 +774,11 @@ class SmSegment:
                     continue
                 if now < hot_until:
                     # hot but cooperative: yield the GIL every pass so
-                    # the app threads this poll serves can actually run
+                    # the app threads this poll serves can actually run.
+                    # THE sanctioned spin site: the window is bounded by
+                    # sm_poll_hot_us (0 on 1-CPU affinity masks — the
+                    # PR 6 finding), then the loop dozes on the futex
+                    # zlint: disable=ZL003 -- bounded hot-yield window, futex doze beyond it
                     time.sleep(0)
                     continue
                 # doze: announce sleep, re-check (lost-wakeup guard:
@@ -970,7 +978,7 @@ class SmSender:
             raise
         self._head = _U64.unpack_from(mm, self._base)[0]
         self._mv = memoryview(mm)  # see SmSegment: no-copy slot windows
-        self._lock = threading.Lock()
+        self._lock = lockdep.lock("sm.SmSender._lock")
         self._dead = False
 
     def _handshake(self, ring_class: int, timeout: float) -> None:

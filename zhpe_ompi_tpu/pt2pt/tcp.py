@@ -58,6 +58,7 @@ from ..mca import output as mca_output
 from ..mca import var as mca_var
 from ..runtime import spc
 from ..utils import dss
+from ..utils import lockdep
 from . import matching
 from . import sm as sm_mod
 from .matching import ANY_SOURCE, ANY_TAG, Envelope
@@ -275,7 +276,7 @@ class _PushPool:
     def __init__(self, name: str, max_workers: int):
         self._q: queue.Queue = queue.Queue()
         self._threads: list[threading.Thread] = []
-        self._lock = threading.Lock()
+        self._lock = lockdep.lock("tcp._PushPool._lock")
         self._idle = 0
         self._closed = False
         self._name = name
@@ -313,6 +314,7 @@ class _PushPool:
                 return  # close() sentinel
             try:
                 fn()
+            # zlint: disable=ZL004 -- _push_rndv catches every escape itself and completes the request errored (PR 7); this is the worker's don't-die backstop
             except Exception:  # noqa: BLE001 - push_data logs its own
                 pass
 
@@ -364,7 +366,7 @@ class _OutChannel:
     __slots__ = ("lock", "queue", "draining")
 
     def __init__(self):
-        self.lock = threading.Lock()
+        self.lock = lockdep.lock("tcp._OutChannel.lock")
         # items: (work, request, finish) — `work()` performs the send;
         # `finish` marks the item whose success completes the request
         # (an RTS item carries its rendezvous request only for the
@@ -487,12 +489,14 @@ class TcpProc(errh.HasErrhandler, ulfm.UlfmEndpointAPI, HostCollectives,
         # for (peer death poisons it) and which request its push
         # completes (None for blocking sends)
         self._rndv_meta: dict[int, tuple[int, Any]] = {}
-        self._rndv_lock = threading.Lock()
+        # witnessed under lockdep: THE seam zlint ZL002 covers
+        # statically and PR 7 paid three review rounds to order
+        self._rndv_lock = lockdep.lock("tcp.TcpProc._rndv_lock")
         # deferred-send progress engine: per-destination FIFO channels
         # drained by the push-pool workers, plus the in-flight request
         # registry the hygiene gate inspects after close()
         self._out_channels: dict[int, _OutChannel] = {}
-        self._out_lock = threading.Lock()
+        self._out_lock = lockdep.lock("tcp.TcpProc._out_lock")
         self._inflight: weakref.WeakSet = weakref.WeakSet()
         self._push_pool = _PushPool(
             f"rndv-push-{rank}",
@@ -500,14 +504,15 @@ class TcpProc(errh.HasErrhandler, ulfm.UlfmEndpointAPI, HostCollectives,
         )
         _live_push_pools.add(self._push_pool)
         self._drains: list[threading.Thread] = []
-        self._drain_lock = threading.Lock()
+        self._drain_lock = lockdep.lock("tcp.TcpProc._drain_lock")
         self._flood_threads: list[threading.Thread] = []
-        self._flood_lock = threading.Lock()
+        self._flood_lock = lockdep.lock("tcp.TcpProc._flood_lock")
         self._dup_conns: list[socket.socket] = []  # crossed-connect extras
         self._timeout = timeout
         self._conns: dict[int, socket.socket] = {}
-        self._conn_lock = threading.Lock()
-        self._send_lock = threading.Lock()  # guards the lock registry only
+        self._conn_lock = lockdep.lock("tcp.TcpProc._conn_lock")
+        # guards the per-socket lock registry only
+        self._send_lock = lockdep.lock("tcp.TcpProc._send_lock")
         self._sock_locks: weakref.WeakKeyDictionary = \
             weakref.WeakKeyDictionary()  # socket -> its framing lock
         self._closed = threading.Event()
@@ -522,7 +527,7 @@ class TcpProc(errh.HasErrhandler, ulfm.UlfmEndpointAPI, HostCollectives,
         self._sm_seg: sm_mod.SmSegment | None = None
         self._sm_senders: dict[int, sm_mod.SmSender | None] = {}
         self._sm_declined: set[int] = set()  # advertised sm, not ridden
-        self._sm_lock = threading.Lock()
+        self._sm_lock = lockdep.lock("tcp.TcpProc._sm_lock")
         self._sm_boot = sm_boot_id or sm_mod.boot_token()
         # NUMA-domain token (hosts nest into domains): constructor
         # override for per-rank emulation, else the sm_numa_id MCA var
@@ -645,7 +650,8 @@ class TcpProc(errh.HasErrhandler, ulfm.UlfmEndpointAPI, HostCollectives,
         with self._send_lock:
             lock = self._sock_locks.get(sock)
             if lock is None:
-                lock = self._sock_locks[sock] = threading.Lock()
+                lock = self._sock_locks[sock] = lockdep.lock(
+                    "tcp.TcpProc._sock_framing_lock")
         with lock:
             _send_frame(sock, frame)
 
@@ -661,12 +667,41 @@ class TcpProc(errh.HasErrhandler, ulfm.UlfmEndpointAPI, HostCollectives,
         direction's ENTIRE main channel over one transport)."""
         if self._sm_seg is None:
             return None
-        with self._sm_lock:
-            if dest in self._sm_senders:
-                return self._sm_senders[dest]
-            sender = self._sm_activate(dest)
-            self._sm_senders[dest] = sender
-            return sender
+        try:
+            with self._sm_lock:
+                if dest in self._sm_senders:
+                    return self._sm_senders[dest]
+                sender = self._sm_activate(dest)
+                self._sm_senders[dest] = sender
+                return sender
+        except sm_mod.ConsumerStopped as e:
+            # first contact raced the peer's sever/close: a STOPPED
+            # consumer is never coming back — that is peer DEATH (the
+            # sm twin of connection reset, PR 6's consumer-stopped
+            # classification), NOT an unmappable-segment degradation,
+            # so no silent-fallback count.  Classified OUTSIDE
+            # _sm_lock: the death listener (_sm_peer_dead) re-takes it
+            # to tear sm state down — classifying under the lock
+            # self-deadlocks (found by this PR's kill-race testing;
+            # the same-role nesting the lockdep class model skips).
+            with self._sm_lock:
+                self._sm_senders[dest] = None  # pinned to TCP
+            if self.ft_state is not None:
+                mca_output.verbose(
+                    5, _stream,
+                    "rank %s: first contact found rank %s's ring "
+                    "consumer stopped (%s): classifying peer death",
+                    self.rank, dest, e,
+                )
+                self._mark_transport_death(dest)
+            else:
+                mca_output.emit(
+                    _stream,
+                    "rank %s: sm segment of rank %s already stopped "
+                    "(%s); pair degrades to TCP", self.rank, dest, e,
+                )
+                self._sm_declined.add(dest)
+            return None
 
     def _sm_activate(self, dest: int) -> sm_mod.SmSender | None:
         if int(mca_var.get("sm_priority", 90)) <= \
@@ -697,6 +732,9 @@ class TcpProc(errh.HasErrhandler, ulfm.UlfmEndpointAPI, HostCollectives,
         try:
             sender = sm_mod.SmSender(name, src_rank=self.rank,
                                      dest_rank=dest, ring_class=klass)
+        except sm_mod.ConsumerStopped:
+            raise  # peer death, not degradation: _sm_tx classifies
+            # it OUTSIDE _sm_lock (the death listener re-takes it)
         except (OSError, errors.MpiError) as e:
             mca_output.emit(
                 _stream,
@@ -807,9 +845,12 @@ class TcpProc(errh.HasErrhandler, ulfm.UlfmEndpointAPI, HostCollectives,
             senders = [s for s in self._sm_senders.values()
                        if s is not None]
         for s in senders:
+            # close-path drain: the CONSUMING peer needs the CPU more
+            # than this poll does (ZL003) — 2 ms granularity merely
+            # coarsens close by a hair
             while s.pending() and not s.peer_stopped() \
                     and time.monotonic() < deadline:
-                time.sleep(0.0005)
+                time.sleep(0.002)
 
     def _sm_teardown(self) -> None:
         with self._sm_lock:
@@ -869,7 +910,15 @@ class TcpProc(errh.HasErrhandler, ulfm.UlfmEndpointAPI, HostCollectives,
                 if x.ident is None or x.is_alive()
             ]
             self._flood_threads.append(t)
-        t.start()
+        try:
+            t.start()
+        except BaseException:
+            # never-started floods must not stay tracked (close()'s
+            # RuntimeError-tolerant join would retry them to deadline)
+            with self._flood_lock:
+                if t in self._flood_threads:
+                    self._flood_threads.remove(t)
+            raise
 
     def _flood_sync(self, cid: int, payload: Any) -> None:
         frame = dss.pack(self.rank, 0, cid, 0, payload)
@@ -1352,8 +1401,14 @@ class TcpProc(errh.HasErrhandler, ulfm.UlfmEndpointAPI, HostCollectives,
     def _track_thread(self, t: threading.Thread) -> None:
         with self._drain_lock:
             # prune finished threads so long-lived ranks don't accumulate
-            # one dead Thread object per connection/transfer
-            self._drains = [d for d in self._drains if d.is_alive()]
+            # one dead Thread object per connection/transfer — but keep
+            # registered-but-unstarted siblings (ident is None until
+            # start()): pruning one would un-track a drain a concurrent
+            # close() is entitled to join (the flood-thread idiom)
+            self._drains = [
+                d for d in self._drains
+                if d.ident is None or d.is_alive()
+            ]
             self._drains.append(t)
 
     def _start_drain(self, conn: socket.socket) -> None:
@@ -1361,7 +1416,16 @@ class TcpProc(errh.HasErrhandler, ulfm.UlfmEndpointAPI, HostCollectives,
             target=self._drain_loop, args=(conn,), daemon=True
         )
         self._track_thread(t)
-        t.start()
+        try:
+            t.start()
+        except BaseException:
+            # a thread that never started must not stay tracked: it
+            # would keep ident None forever and close()'s join-retry
+            # loop would spin on it for the whole deadline
+            with self._drain_lock:
+                if t in self._drains:
+                    self._drains.remove(t)
+            raise
 
     def _drain_loop(self, conn: socket.socket) -> None:
         """Receiver thread per connection — the progress engine's read
@@ -1863,6 +1927,14 @@ class TcpProc(errh.HasErrhandler, ulfm.UlfmEndpointAPI, HostCollectives,
             except BaseException as e:  # noqa: BLE001 - typed at the req
                 if req is not None:
                     req.complete_error(self._deferred_exc(e, dest))
+                    # a failed RTS leaves its rendezvous data parked
+                    # with a TERMINAL request: nothing will ever push
+                    # or poison it again (_fail_inflight skipped it as
+                    # owned during this very send, and the waiter's
+                    # poison tick stops with the request) — release it
+                    # here or it pins the caller's buffers until
+                    # close()'s sweep
+                    self._release_rndv_for(req)
                 continue
             if finish and req is not None:
                 req.complete()
@@ -1871,6 +1943,19 @@ class TcpProc(errh.HasErrhandler, ulfm.UlfmEndpointAPI, HostCollectives,
                 # park/poison machinery owns the request again (a peer
                 # that departs before its CTS must error it typed)
                 req._owned = False
+
+    def _release_rndv_for(self, req) -> None:
+        """Drop parked rendezvous state pinned for ``req``: once the
+        request is terminal (its RTS failed on the engine), the park
+        can never be pushed — a late CTS for the id is already a
+        no-op in the CTS handler, and the conftest orphan gate would
+        otherwise only be saved by close()'s known-failed re-sweep."""
+        with self._rndv_lock:
+            dead = [rid for rid, (_, r) in self._rndv_meta.items()
+                    if r is req]
+            for rid in dead:
+                self._pending_rndv.pop(rid, None)
+                self._rndv_meta.pop(rid, None)
 
     def _deferred_exc(self, e: BaseException, dest: int):
         """Typed completion error for a deferred send that failed on
@@ -1912,12 +1997,18 @@ class TcpProc(errh.HasErrhandler, ulfm.UlfmEndpointAPI, HostCollectives,
         if ch is None or not ch.busy():
             return
         deadline = time.monotonic() + self._timeout
+        # bounded backoff, not a sub-ms spin: the push-pool worker
+        # draining this channel needs the very quanta a hot poll would
+        # steal on a 1-CPU host (the PR 6 finding, ZL003) — first waits
+        # stay tight so an almost-drained channel costs ~nothing
+        delay = 0.0002
         while ch.busy():
             if time.monotonic() > deadline:
                 raise errors.InternalError(
                     f"deferred-send queue to rank {dest} failed to "
                     "drain within the stall timeout")
-            time.sleep(0.0002)
+            time.sleep(delay)
+            delay = min(delay * 2, 0.005)
 
     def _arm_isend_poison(self, req, dest: int, cid: int,
                           rndv_id: int | None = None) -> None:
@@ -2588,7 +2679,20 @@ class TcpProc(errh.HasErrhandler, ulfm.UlfmEndpointAPI, HostCollectives,
         with self._drain_lock:
             drains = list(self._drains)
         for t in drains:
-            t.join(max(0.0, deadline - time.monotonic()))
+            while True:
+                try:
+                    t.join(max(0.0, deadline - time.monotonic()))
+                    break
+                except RuntimeError:
+                    # registered but not yet started (_start_drain's
+                    # spawner is between _track_thread and start()):
+                    # joining an unstarted thread raises and used to
+                    # ABORT teardown mid-flight — the same race PR 6
+                    # closed for flood threads, surfaced here by the
+                    # lockdep witness widening the append→start window
+                    if time.monotonic() >= deadline:
+                        break
+                    time.sleep(0.001)
         # the rendezvous-push pool drains with the proc: the quiesce loop
         # above already waited out pending transfers, so workers are idle
         # (or wedged on a dead peer, bounded by the join deadline) — the

@@ -35,6 +35,7 @@ from ..core import errors
 from ..ft import ulfm
 from ..mca import var as mca_var
 from ..runtime import spc
+from ..utils import lockdep
 from . import matching
 from .matching import ANY_SOURCE, ANY_TAG, Envelope
 from .requests import Request, Status, _payload_bytes
@@ -145,7 +146,7 @@ class RankContext(errh.HasErrhandler, ulfm.UlfmEndpointAPI,
         self._seq = itertools.count()
         self._pending_rndv: dict[int, tuple[Any, Request]] = {}
         self._rndv_ids = itertools.count()
-        self._lock = threading.Lock()
+        self._lock = lockdep.lock("pt2pt.RankContext._lock")
 
     @property
     def ft_state(self):
@@ -196,11 +197,20 @@ class RankContext(errh.HasErrhandler, ulfm.UlfmEndpointAPI,
             elif kind == _CTS:
                 rndv_id, dest_rank, req_token = rest
                 with self._lock:
-                    payload, sreq = self._pending_rndv.pop(rndv_id)
-                # copy at handoff: the send completes now, so the sender may
-                # reuse its buffer before the receiver drains the message
-                self._mbox(dest_rank).put((_DATA, req_token, _eager_copy(payload)))
-                sreq.complete()
+                    entry = self._pending_rndv.pop(rndv_id, None)
+                if entry is not None:
+                    payload, sreq = entry
+                    # copy at handoff: the send completes now, so the
+                    # sender may reuse its buffer before the receiver
+                    # drains the message
+                    self._mbox(dest_rank).put(
+                        (_DATA, req_token, _eager_copy(payload)))
+                    sreq.complete()
+                # else: the park was poisoned-and-released (sendrecv
+                # classified the partner dead/revoked) — the send
+                # already completed errored; a late CTS must neither
+                # crash this progress loop nor deliver a payload whose
+                # buffer the caller reclaimed at the typed raise
             elif kind == _DATA:
                 req_token, payload = rest
                 req_token(payload)
@@ -268,6 +278,18 @@ class RankContext(errh.HasErrhandler, ulfm.UlfmEndpointAPI,
              poll: bool = False) -> None:
         """MPI_Send: blocking (completes when the buffer is reusable)."""
         self.isend(obj, dest, tag, cid, poll=poll).wait()
+
+    def _release_parked_sends(self, req) -> None:
+        """Drop any parked rendezvous entry pinned for ``req``: a
+        poisoned/abandoned send's payload must neither stay pinned for
+        the universe lifetime nor be delivered by a LATE CTS carrying
+        the caller's post-failure mutations (the _CTS handler treats a
+        released id as a no-op)."""
+        with self._lock:
+            dead = [k for k, (_, r) in self._pending_rndv.items()
+                    if r is req]
+            for k in dead:
+                del self._pending_rndv[k]
 
     # -- receives --------------------------------------------------------
 
@@ -507,13 +529,73 @@ class RankContext(errh.HasErrhandler, ulfm.UlfmEndpointAPI,
         """MPI_Sendrecv.  On an ft universe the receive side runs the
         classified path, so a partner that dies mid-exchange surfaces
         typed ProcFailed instead of wedging the wait — collectives built
-        over sendrecv (ring allgather et al.) inherit failure delivery."""
-        if self.universe.ft_state is not None:
-            self.isend(obj, dest, sendtag, cid)
-            return self.recv(source, recvtag, cid)
+        over sendrecv (ring allgather et al.) inherit failure delivery.
+
+        The SEND side is observed too (ZL001): a rendezvous send still
+        parked when the recv returns pins the caller's object in
+        ``_pending_rndv`` — returning without waiting it breaks the
+        buffer-reuse contract (the receiver would see post-return
+        mutations), and a discarded request's outcome can never be
+        seen.  On the ft path the wait classifies: a send partner that
+        dies before matching surfaces through the errhandler
+        disposition instead of wedging (dest and source may be
+        DIFFERENT ranks in a ring shift — the recv completing proves
+        nothing about dest's liveness)."""
+        state = self.universe.ft_state
+        if state is not None:
+            sreq = self.isend(obj, dest, sendtag, cid)
+            try:
+                value = self.recv(source, recvtag, cid)
+            except BaseException as e:
+                # the exchange is dead (the classified recv raised):
+                # this caller will never observe the send's outcome —
+                # release its parked payload (no pin, no late CTS
+                # delivering post-failure mutations) and mark it
+                # terminal before re-raising
+                self._release_parked_sends(sreq)
+                sreq.complete_error(errors.ProcFailed(
+                    f"sendrecv aborted by its receive side: {e}",
+                    failed_ranks=state.failed(),
+                ))
+                raise
+            while not sreq.done:
+                self.progress()  # the rendezvous CTS handoff rides
+                if sreq.done:    # OUR mailbox — progress must tick
+                    break
+                # classify BOTH poisons, mirroring isend's issue-time
+                # checks: a dead partner never CTSes, and a revoke
+                # makes the live partner's classified recv abandon
+                # without CTSing — either way this park can never
+                # complete on its own
+                exc = None
+                if state.is_revoked(cid):
+                    exc = errors.Revoked(
+                        f"send on revoked cid={cid}", cid=cid)
+                elif state.is_failed(dest):
+                    exc = errors.ProcFailed(
+                        f"rank {dest} failed before matching "
+                        f"sendrecv's send",
+                        failed_ranks=state.failed(),
+                    )
+                if exc is not None:
+                    poisoned = sreq.complete_error(exc)
+                    # drop the parked payload either way: a corpse
+                    # never CTSes (the pin would last forever) and a
+                    # revoked-but-live partner's late CTS must not
+                    # ship post-raise buffer mutations
+                    self._release_parked_sends(sreq)
+                    if poisoned:
+                        self.call_errhandler(exc)
+                    break
+                sreq._done.wait(0.002)
+            if sreq.error is None:
+                sreq.wait()
+            return value
         rreq = self.irecv(source, recvtag, cid)
-        self.isend(obj, dest, sendtag, cid)
-        return rreq.wait()
+        sreq = self.isend(obj, dest, sendtag, cid)
+        value = rreq.wait()
+        sreq.wait()
+        return value
 
     def barrier(self) -> None:
         """Host-plane dissemination barrier over send/recv."""
@@ -523,8 +605,12 @@ class RankContext(errh.HasErrhandler, ulfm.UlfmEndpointAPI,
             dest = (self.rank + k) % n
             src = (self.rank - k) % n
             rreq = self.irecv(src, tag=0x7FFF - 1, cid=0x7FFF)
-            self.isend(b"", dest, tag=0x7FFF - 1, cid=0x7FFF)
+            # a zero-byte send is always eager (born-complete), but the
+            # request is still observed: an issue-time classification
+            # (known-failed dest on an ft universe) must not vanish
+            sreq = self.isend(b"", dest, tag=0x7FFF - 1, cid=0x7FFF)
             rreq.wait()
+            sreq.wait()
             k <<= 1
 
 

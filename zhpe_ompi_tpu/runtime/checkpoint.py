@@ -123,6 +123,7 @@ class Checkpointer:
         if self.check_quiescent:
             quiesce_check()
         with self._op_lock:
+            # zlint: disable=ZL002 -- PR 2 contract: save/wait/restore serialize under ONE RLock; the joined writer never takes it (no cycle) and callers accept checkpoint-grade latency
             self.wait()  # one outstanding checkpoint at a time (orbax)
             leaves, treedef = jax.tree_util.tree_flatten(state)
             # snapshot to host before returning control (np.array COPIES
@@ -204,6 +205,7 @@ class Checkpointer:
         save()/wait() to report."""
         with self._op_lock:
             if self._worker is not None:
+                # zlint: disable=ZL002 -- PR 2 contract: the writer thread never takes _op_lock, so this join cannot cycle; holding it is WHY concurrent restores can't double-join
                 self._worker.join()
                 self._worker = None
 

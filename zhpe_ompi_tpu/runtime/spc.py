@@ -156,6 +156,30 @@ the daemon-side values):
 - ``dvm_respawns`` — replacement processes exec'd by the relaunch RPC
   (N victims respawned in one batched RPC count N, but share ONE
   namespace-generation bump — the same recovery window).
+
+API-surface counters (recorded at the MPI/OpenSHMEM call sites; the
+ZL006 doc-parity rule keeps this table and the ``spc.record`` call
+sites in lockstep):
+
+- ``init_count`` — runtime initializations (``runtime/init.py``: both
+  the in-process ``init()`` and the ``host_init`` coordinator-contract
+  path).
+- ``pt2pt_sends`` / ``pt2pt_bytes_sent`` — thread-plane
+  (``RankContext``) isends and their payload bytes; the wire plane's
+  twin is the ``tcp_*``/``sm_*`` family.
+- ``osc_puts`` / ``osc_gets`` / ``osc_bytes_put`` — one-sided window
+  operations (both the passive ``window.py`` plane and the
+  active-message ``osc/am.py`` plane record the same names: the
+  counter tracks the OP, not the transport).
+- ``osc_am_applied`` — active-message operations applied at the
+  TARGET by the AM service dispatch (origin-side ops count in
+  ``osc_puts``/``osc_gets``).
+- ``shmem_puts`` / ``shmem_gets`` / ``shmem_puts_nbi`` / ``shmem_gets_nbi``
+  — OpenSHMEM put/get traffic, blocking and nonblocking-implicit.
+- ``pgas_device_epochs`` — device-heap epoch advances (the PGAS
+  quiet/fence boundary on the device plane).
+- ``io_nonblocking_ops`` — nonblocking file operations submitted to
+  the fbtl async pool.
 """
 
 from __future__ import annotations

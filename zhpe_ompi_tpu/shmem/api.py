@@ -534,6 +534,7 @@ class ShmemPE:
                 raise errors.InternalError(
                     f"wait_until timed out: {v[index]} {op} {value}"
                 )
+            # zlint: disable=ZL003 -- shmem_wait_until IS a memory poll by OpenSHMEM spec; timeout-bounded
             time.sleep(0)  # yield to writer threads
 
     # -- distributed locks -----------------------------------------------
