@@ -66,6 +66,10 @@ def _bench_env(repo: str) -> dict:
     # ranks too (their transports construct locks at import), and the
     # default must strip an inherited ZMPI_LOCKDEP=1
     env["ZMPI_LOCKDEP"] = "1" if _keep_lockdep[0] else "0"
+    # metrics publishing is per-row explicit (--via-metrics passes
+    # metrics=True through the worker spec); an inherited fleet-global
+    # ZMPI_METRICS must not arm publishers on rows that have no store
+    env.pop("ZMPI_METRICS", None)
     return env
 
 
@@ -604,10 +608,17 @@ def _worker_main(spec: dict) -> int:
     from zhpe_ompi_tpu.runtime import spc
 
     rank, n = int(spec["rank"]), int(spec["size"])
+    metrics_kw = {}
+    if spec.get("via_metrics"):
+        # --via-metrics: modex through the parent's resident store and
+        # run the rank-side publisher — counters leave via the final
+        # flush, not the stdout JSON
+        metrics_kw = {"pmix": spec["pmix"], "namespace": spec["ns"],
+                      "metrics": True}
     proc = TcpProc(rank, n, coordinator=("127.0.0.1", int(spec["port"])),
                    timeout=120.0, sm=bool(spec.get("sm", True)),
                    sm_boot_id=spec.get("boot"),
-                   sm_numa_id=spec.get("numa"))
+                   sm_numa_id=spec.get("numa"), **metrics_kw)
     if spec["kind"] == "han":
         from zhpe_ompi_tpu.mca import var as mca_var
 
@@ -616,6 +627,19 @@ def _worker_main(spec: dict) -> int:
                         spec.get("pipeline", "auto"))
         mca_var.set_var("coll_han_numa_level",
                         spec.get("numa_mode", "auto"))
+        if spec.get("via_metrics"):
+            # the pre-ladder baseline rides the store too, so the
+            # parent's delta window matches the in-band one exactly
+            from zhpe_ompi_tpu.runtime.pmix import PmixClient
+
+            ns = spec["ns"]
+            cl = PmixClient(spec["pmix"])
+            try:
+                cl.put(ns, rank, f"metrics_base:{ns}:{rank}",
+                       {c: spc.read(c) for c in _HAN_COUNTERS})
+                cl.commit(ns, rank)
+            finally:
+                cl.close()
         try:
             rows, deltas, sm_stats = _han_worker_body(proc, spec)
         finally:
@@ -768,6 +792,78 @@ def _run_proc_bench_once(spec: dict, nprocs: int,
     return report["rows"]
 
 
+class _ViaMetricsHarness:
+    """``--via-metrics``: the han/numa workers' per-rank counter deltas
+    are collected THROUGH the metrics plane — each worker modexes via a
+    resident in-process zprted store, publishes its pre-ladder baseline
+    plus final-flush snapshots (``TcpProc(metrics=True)``), and the
+    parent reads them back over the daemon's ``metrics`` RPC — instead
+    of the pipe-serialized dicts.  The deterministic gates then run
+    UNCHANGED on the store-collected values, and every via-metrics row
+    must move ``pmix_puts`` (rows without the flag never touch a
+    store, so the counter rises ONLY on metrics-enabled rows)."""
+
+    def __init__(self, nprocs: int):
+        from zhpe_ompi_tpu.runtime import dvm as dvm_mod
+
+        self.nprocs = nprocs
+        self.dvm = dvm_mod.Dvm()
+        self._row_puts = 0
+
+    def arm(self, spec: dict, label: str) -> dict:
+        from zhpe_ompi_tpu.runtime import spc
+
+        ns = f"bench_{label}"
+        self.dvm.store.ensure_ns(ns, self.nprocs)
+        self._row_puts = spc.read("pmix_puts")
+        return dict(spec, pmix=f"127.0.0.1:{self.dvm.pmix.address[1]}",
+                    ns=ns, via_metrics=True)
+
+    def collect(self, label: str, reports: list) -> list:
+        """Replace each report's in-band counters with the store-
+        collected deltas (final flush minus published baseline), then
+        drop the row's namespace (zero stale metrics keys)."""
+        from zhpe_ompi_tpu.runtime import spc
+        from zhpe_ompi_tpu.runtime.dvm import DvmClient
+
+        ns = f"bench_{label}"
+        if spc.read("pmix_puts") <= self._row_puts:
+            raise RuntimeError(
+                f"via-metrics ({label}): pmix_puts did not rise — the "
+                "workers never published into the store"
+            )
+        cli = DvmClient(self.dvm.address)
+        try:
+            view = cli.metrics(ns)
+        finally:
+            cli.close()
+        bases = {
+            int(key.rsplit(":", 1)[1]): dict(value)
+            for key, value in
+            self.dvm.store.lookup(ns, "metrics_base:").items()
+        }
+        out = []
+        for rep in reports:
+            rank = int(rep["rank"])
+            rec = view["ranks"].get(rank)
+            if rec is None:
+                raise RuntimeError(
+                    f"via-metrics ({label}): rank {rank} published no "
+                    "snapshot (final flush missing?)"
+                )
+            base = bases.get(rank, {})
+            counters = rec.get("counters") or {}
+            out.append(dict(rep, counters={
+                c: int(counters.get(c, 0)) - int(base.get(c, 0))
+                for c in _HAN_COUNTERS
+            }))
+        self.dvm.store.destroy_ns(ns)
+        return out
+
+    def close(self) -> None:
+        self.dvm.stop()
+
+
 def _run_han_threads(spec: dict, nprocs: int, boots: dict,
                      numas: dict | None = None) -> list:
     """Thread-harness variant of the han/numa ladder (one process,
@@ -805,7 +901,8 @@ def _run_han_threads(spec: dict, nprocs: int, boots: dict,
 
 
 def bench_han(max_size: int = 4 << 20, iters: int = 5, nprocs: int = 4,
-              hosts: int = 2, real_procs: bool = True) -> list[dict]:
+              hosts: int = 2, real_procs: bool = True,
+              via_metrics: bool = False) -> list[dict]:
     """Hierarchical-collective ladder on an EMULATED mixed topology:
     `nprocs` ranks carved into `hosts` same-boot groups (per-rank
     ``sm_boot_id`` overrides — each emulated host's ranks share real
@@ -825,15 +922,23 @@ def bench_han(max_size: int = 4 << 20, iters: int = 5, nprocs: int = 4,
     - the pipeline row (``coll_han_pipeline=on``) must actually take
       the pipelined schedule at >= 2-segment sizes
       (``coll_han_pipelined`` rises) — segment k's intra bcast under
-      segment k+1's wire exchange, never a silent sequential run."""
+      segment k+1's wire exchange, never a silent sequential run.
+
+    ``via_metrics=True`` (CLI ``--via-metrics``) collects the per-rank
+    counter deltas THROUGH the PMIx store (publisher final flush +
+    zprted ``metrics`` RPC) instead of the pipe-serialized dicts; the
+    gates above run unchanged on the store-collected values."""
     group = max(1, -(-nprocs // hosts))
     boots = {r: f"hanhost{r // group}" for r in range(nprocs)}
+    if via_metrics and not real_procs:
+        raise RuntimeError("--via-metrics needs real-process workers")
     # a max_size below the ladder floor must still yield one rung, not
     # an empty-rows crash after the workers already ran
     spec_base = {"kind": "han", "max_size": max_size, "iters": iters,
                  "min_bytes": max(1, min(1 << 10, max_size))}
     out_rows: list[dict] = []
     agg: dict[str, dict] = {}
+    harness = _ViaMetricsHarness(nprocs) if via_metrics else None
     # three ladders: flat, han with the sequential (PR 6) leader
     # exchange, and han with the pipelined inter/intra overlap
     configs = (
@@ -841,23 +946,32 @@ def bench_han(max_size: int = 4 << 20, iters: int = 5, nprocs: int = 4,
         ("on", "off", "han"),
         ("on", "on", "han_pipe"),
     )
-    for han_mode, pipeline, label in configs:
-        spec = dict(spec_base, han_mode=han_mode, pipeline=pipeline,
-                    label=label)
-        if real_procs:
-            reports = _run_proc_bench(
-                spec, nprocs,
-                rank_overrides={r: {"boot": b} for r, b in boots.items()},
-                collect_all=True,
-            )
-        else:
-            reports = _run_han_threads(spec, nprocs, boots)
-        rows = next(r["rows"] for r in reports if r["rows"])
-        agg[label] = {
-            c: sum(r["counters"][c] for r in reports)
-            for c in _HAN_COUNTERS
-        }
-        out_rows += rows
+    try:
+        for han_mode, pipeline, label in configs:
+            spec = dict(spec_base, han_mode=han_mode, pipeline=pipeline,
+                        label=label)
+            if harness is not None:
+                spec = harness.arm(spec, label)
+            if real_procs:
+                reports = _run_proc_bench(
+                    spec, nprocs,
+                    rank_overrides={r: {"boot": b}
+                                    for r, b in boots.items()},
+                    collect_all=True,
+                )
+            else:
+                reports = _run_han_threads(spec, nprocs, boots)
+            if harness is not None:
+                reports = harness.collect(label, reports)
+            rows = next(r["rows"] for r in reports if r["rows"])
+            agg[label] = {
+                c: sum(r["counters"][c] for r in reports)
+                for c in _HAN_COUNTERS
+            }
+            out_rows += rows
+    finally:
+        if harness is not None:
+            harness.close()
     for label in ("han", "han_pipe"):
         if agg[label]["han_flat_fallbacks"]:
             raise RuntimeError(
@@ -908,7 +1022,8 @@ def _numa_layout(nprocs: int, hosts: int, domains: int
 
 def bench_numa(max_size: int = 1 << 20, iters: int = 3, nprocs: int = 8,
                hosts: int = 2, domains: int = 2, real_procs: bool = True,
-               trials: int | None = None) -> list[dict]:
+               trials: int | None = None,
+               via_metrics: bool = False) -> list[dict]:
     """NUMA-level ladder on the emulated ``hosts × domains ×
     ranks-per-domain`` real-process topology (per-rank ``sm_boot_id``
     + ``sm_numa_id`` pins): three-level han (``han3``) against the
@@ -936,6 +1051,8 @@ def bench_numa(max_size: int = 1 << 20, iters: int = 3, nprocs: int = 8,
     from zhpe_ompi_tpu.mca import var as mca_var
 
     boots, numas, domhost_boots = _numa_layout(nprocs, hosts, domains)
+    if via_metrics and not real_procs:
+        raise RuntimeError("--via-metrics needs real-process workers")
     min_bytes = min(256 << 10, max_size)
     spec_base = {"kind": "han", "max_size": max_size, "iters": iters,
                  "min_bytes": min_bytes, "report_sm": True}
@@ -949,22 +1066,32 @@ def bench_numa(max_size: int = 1 << 20, iters: int = 3, nprocs: int = 8,
     out_rows: list[dict] = []
     agg: dict[str, dict] = {}
     stats: dict[str, list] = {}
-    for label, han_mode, numa_mode, blist, nlist in configs:
-        spec = dict(spec_base, han_mode=han_mode, numa_mode=numa_mode,
-                    pipeline="off", label=label)
-        if real_procs:
-            overrides = {r: {"boot": blist[r]} for r in range(nprocs)}
-            for r, numa in nlist.items():
-                overrides[r]["numa"] = numa
-            reports = _run_proc_bench(spec, nprocs,
-                                      rank_overrides=overrides,
-                                      collect_all=True)
-        else:
-            reports = _run_han_threads(spec, nprocs, blist, nlist)
-        out_rows += next(r["rows"] for r in reports if r["rows"])
-        agg[label] = {c: sum(r["counters"][c] for r in reports)
-                      for c in _HAN_COUNTERS}
-        stats[label] = [r.get("sm_stats") for r in reports]
+    harness = _ViaMetricsHarness(nprocs) if via_metrics else None
+    try:
+        for label, han_mode, numa_mode, blist, nlist in configs:
+            spec = dict(spec_base, han_mode=han_mode,
+                        numa_mode=numa_mode, pipeline="off", label=label)
+            if harness is not None:
+                spec = harness.arm(spec, label)
+            if real_procs:
+                overrides = {r: {"boot": blist[r]}
+                             for r in range(nprocs)}
+                for r, numa in nlist.items():
+                    overrides[r]["numa"] = numa
+                reports = _run_proc_bench(spec, nprocs,
+                                          rank_overrides=overrides,
+                                          collect_all=True)
+            else:
+                reports = _run_han_threads(spec, nprocs, blist, nlist)
+            if harness is not None:
+                reports = harness.collect(label, reports)
+            out_rows += next(r["rows"] for r in reports if r["rows"])
+            agg[label] = {c: sum(r["counters"][c] for r in reports)
+                          for c in _HAN_COUNTERS}
+            stats[label] = [r.get("sm_stats") for r in reports]
+    finally:
+        if harness is not None:
+            harness.close()
     for label in ("han2dom", "han3"):
         if agg[label]["han_flat_fallbacks"]:
             raise RuntimeError(
@@ -1308,6 +1435,12 @@ def main(argv: list[str] | None = None) -> int:
                    help="run WITH the lock-order witness instrumented "
                         "(diagnosis only: numbers are not comparable "
                         "to the default raw-lock rows)")
+    p.add_argument("--via-metrics", action="store_true",
+                   help="--plane han/numa: collect the workers' "
+                        "per-rank counter deltas through the PMIx "
+                        "store (metrics publisher + zprted metrics "
+                        "RPC) instead of pipe-serialized dicts; gates "
+                        "run unchanged on the store-collected values")
     p.add_argument("--_worker", default=None, help=argparse.SUPPRESS)
     args = p.parse_args(argv)
 
@@ -1341,13 +1474,15 @@ def main(argv: list[str] | None = None) -> int:
                            bw=args.bw, window=args.window)
     elif args.plane == "han":
         rows = bench_han(args.max_size, max(args.iters, 3),
-                         nprocs=args.nprocs, hosts=args.hosts)
+                         nprocs=args.nprocs, hosts=args.hosts,
+                         via_metrics=args.via_metrics)
     elif args.plane == "numa":
         nprocs = args.nprocs if args.nprocs != 4 \
             else args.hosts * args.domains * 2
         rows = bench_numa(args.max_size, max(args.iters, 2),
                           nprocs=nprocs, hosts=args.hosts,
-                          domains=args.domains)
+                          domains=args.domains,
+                          via_metrics=args.via_metrics)
     elif args.op == "tcp" and args.plane == "sm":
         rows = bench_sm(args.max_size, max(args.iters, 10),
                         bw=args.bw, window=args.window,

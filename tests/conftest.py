@@ -140,6 +140,24 @@ def _ulfm_detector_hygiene():
         f"stale PMIx namespace state left after the suite (the daemon "
         f"destroys a job's namespace when the job ends): {stale_ns}"
     )
+    from zhpe_ompi_tpu.runtime import spc as spc_mod
+
+    publishers = spc_mod.live_publisher_threads()
+    assert not publishers, (
+        f"metrics-publisher threads leaked past their proc's close() "
+        f"(the final-flush-then-stop contract): {publishers}"
+    )
+    stale_keys = pmix_mod.stale_metric_keys()
+    assert not stale_keys, (
+        f"stale metrics:*/flightrec:* keys left in a live store after "
+        f"the suite (namespace destroy drops a job's whole keyspace — "
+        f"these outlived theirs): {stale_keys}"
+    )
+    scrapers = dvm_mod.live_metrics_listeners()
+    assert not scrapers, (
+        f"metrics HTTP listeners left bound past their daemon's "
+        f"stop(): {scrapers}"
+    )
     from zhpe_ompi_tpu.utils import lockdep
 
     inversions = lockdep.cycles()

@@ -1,0 +1,501 @@
+"""The fleet-visible metrics plane: flight recorder, rank-side SPC
+publisher, zprted metrics RPC + Prometheus scrape endpoint, and the
+real-process end-to-end acceptance (reference surface: MPI_T reading
+SPCs from live jobs, ompi/mpi/tool + ompi_spc.c — PAPER.md §5)."""
+
+import re
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from zhpe_ompi_tpu.core import errors
+from zhpe_ompi_tpu.ft import ulfm
+from zhpe_ompi_tpu.pt2pt.tcp import TcpProc
+from zhpe_ompi_tpu.runtime import dvm as dvm_mod
+from zhpe_ompi_tpu.runtime import flightrec, peruse, spc
+from zhpe_ompi_tpu.runtime import pmix as pmix_mod
+
+
+# ============================ flight recorder ==============================
+
+
+class TestFlightRecorder:
+    def test_ring_window_order_and_overflow_accounting(self):
+        rec = flightrec.FlightRecorder(capacity=8)
+        d0 = spc.read("flightrec_events_dropped")
+        for i in range(11):
+            rec.record(flightrec.SEND, i=i)
+        win = rec.window()
+        # last 8 in record order, seq-stamped
+        assert [e["i"] for e in win] == list(range(3, 11))
+        assert [e["seq"] for e in win] == list(range(3, 11))
+        assert all(e["type"] == flightrec.SEND for e in win)
+        # 3 displaced events were lost to the postmortem window — loudly
+        assert spc.read("flightrec_events_dropped") - d0 == 3
+        assert rec.total() == 11
+        assert len(rec.window(2)) == 2
+        rec.clear()
+        assert rec.window() == [] and rec.total() == 0
+
+    def test_unarmed_recorder_costs_nothing(self):
+        """No publisher ⇒ the module gate is False and the seams skip
+        the record call entirely (the peruse cost discipline applied
+        to the whole recorder)."""
+        assert not flightrec.active
+        flightrec.clear()
+        flightrec.record(flightrec.SEND, dest=1)  # gated: no-op
+        assert flightrec.window() == []
+
+    def test_ft_classification_is_tail_entry(self):
+        flightrec.arm()
+        try:
+            flightrec.clear()
+            state = ulfm.FailureState(4)
+            seen = []
+            state.add_failure_listener(
+                lambda r, c: seen.append(flightrec.window()))
+            state.mark_failed(2, cause="daemon")
+            # the listener (the publisher's hook in production) observed
+            # the window WITH the classification event already at its tail
+            assert seen and seen[0][-1]["type"] == flightrec.FT_CLASS
+            assert seen[0][-1]["rank"] == 2
+            assert seen[0][-1]["cause"] == "daemon"
+        finally:
+            flightrec.disarm()
+
+    def test_revoke_event_recorded(self):
+        flightrec.arm()
+        try:
+            flightrec.clear()
+            state = ulfm.FailureState(2)
+            state.revoke(0x77)
+            events = [e for e in flightrec.window()
+                      if e["type"] == flightrec.REVOKE]
+            assert events and events[-1]["cid"] == 0x77
+        finally:
+            flightrec.disarm()
+
+    def test_match_events_ride_peruse_refcounted(self):
+        from zhpe_ompi_tpu.pt2pt import matching
+
+        assert not peruse.active and not flightrec.active
+        flightrec.arm()
+        flightrec.arm()  # second publisher
+        try:
+            flightrec.clear()
+            eng = matching.MatchingEngine()
+            eng.incoming(matching.Envelope(0, 5, 0, 0), "payload")
+            eng.post_recv(0, 5, 0, lambda e, p: None)
+            matches = [e for e in flightrec.window()
+                       if e["type"] == flightrec.MATCH]
+            assert matches and matches[-1]["src"] == 0
+            assert matches[-1]["tag"] == 5
+            assert matches[-1]["unexpected"] is True
+        finally:
+            flightrec.disarm()
+            assert peruse.active  # one publisher still holds the hook
+            assert flightrec.active
+            flightrec.disarm()
+        # the last disarm restores the inactive-costs-nothing contract
+        assert not peruse.active and not flightrec.active
+
+    def test_wire_send_recv_events(self):
+        from tests.test_tcp import run_tcp
+
+        flightrec.arm()
+        try:
+            flightrec.clear()
+
+            def prog(p):
+                p.send(np.arange(4.0), dest=1 - p.rank, tag=9)
+                return p.recv(source=1 - p.rank, tag=9).sum()
+
+            assert run_tcp(2, prog, sm=False) == [6.0, 6.0]
+            kinds = {e["type"] for e in flightrec.window()}
+            assert flightrec.SEND in kinds and flightrec.RECV in kinds
+        finally:
+            flightrec.disarm()
+
+
+# ====================== publisher + store + daemon =========================
+
+
+def _run_metrics_job(dvm, n=2, ns="jobmet", traffic=True, rank_fn=None):
+    """n thread-plane TcpProcs modexed through the daemon's store with
+    the publisher armed; returns after every rank closed (final flush
+    published)."""
+    pmix_addr = ("127.0.0.1", dvm.pmix.address[1])
+    excs = [None] * n
+
+    def main(rank):
+        try:
+            proc = TcpProc(rank, n, pmix=pmix_addr, namespace=ns,
+                           metrics=True, sm=False)
+            try:
+                if traffic:
+                    proc.send(np.arange(64.0), dest=(rank + 1) % n, tag=3)
+                    proc.recv(source=(rank - 1) % n, tag=3)
+                if rank_fn is not None:
+                    rank_fn(proc)
+                # every rank's work lands before ANY rank's close-time
+                # final flush snapshots the shared registry
+                proc.barrier()
+            finally:
+                proc.close()
+        except BaseException as e:  # noqa: BLE001
+            excs[rank] = e
+
+    threads = [threading.Thread(target=main, args=(r,)) for r in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(60)
+        assert not t.is_alive(), "metrics job rank hung"
+    if any(excs):
+        raise next(e for e in excs if e is not None)
+
+
+class TestPublisher:
+    def test_interval_floor_is_hard(self, fresh_vars):
+        from zhpe_ompi_tpu.mca import var as mca_var
+
+        mca_var.set_var("spc_publish_interval_ms", 50)
+        d = dvm_mod.Dvm()
+        try:
+            pub = spc.MetricsPublisher(
+                ("127.0.0.1", d.pmix.address[1]), "default", 0)
+            # never sub-interval polling: 50ms clamps to the 250ms floor
+            assert pub.interval >= spc.PUBLISH_FLOOR_S
+            pub.stop()  # never started: releases the client socket
+        finally:
+            d.stop()
+        assert spc.live_publisher_threads() == []
+
+    def test_publish_final_flush_and_hygiene(self):
+        d = dvm_mod.Dvm()
+        pubs0 = spc.read("spc_publishes")
+        try:
+            _run_metrics_job(d, n=2, ns="jobflush")
+            # final flush at close: both ranks' snapshots in the store
+            entries = d.store.lookup("jobflush", "metrics:")
+            assert set(entries) == {"metrics:jobflush:0",
+                                    "metrics:jobflush:1"}
+            for payload in entries.values():
+                assert payload["final"] is True
+                assert payload["interval_ms"] >= 250
+                # the documented table is zero-filled: every documented
+                # counter is fleet-visible even if it never fired
+                missing = spc.documented_counters() \
+                    - set(payload["counters"])
+                assert not missing, missing
+                assert payload["counters"]["tcp_bytes_sent"] > 0
+                # state pvars ride the snapshot
+                assert "tcp_posted_recvs" in payload["pvars"]
+            assert spc.read("spc_publishes") - pubs0 >= 2
+            assert spc.live_publisher_threads() == []
+            # namespace destroy drops the job's whole keyspace — the
+            # zero-stale-metrics-keys contract
+            d.store.destroy_ns("jobflush")
+            assert pmix_mod.stale_metric_keys() == []
+        finally:
+            d.stop()
+
+    def test_sever_kills_publisher_without_final_flush(self):
+        """The crash contract: a severed (simulated-crash) proc's
+        publisher dies with it but ships NO final snapshot — a clean
+        final flush from a corpse would lie to the fleet."""
+        d = dvm_mod.Dvm()
+        try:
+            proc = TcpProc(0, 1, pmix=("127.0.0.1", d.pmix.address[1]),
+                           namespace="jobsev", metrics=True, sm=False)
+            deadline = time.monotonic() + 10.0
+            while not d.store.lookup("jobsev", "metrics:") \
+                    and time.monotonic() < deadline:
+                time.sleep(0.02)
+            proc.sever()
+            assert spc.live_publisher_threads() == []
+            payload = d.store.lookup("jobsev",
+                                     "metrics:")["metrics:jobsev:0"]
+            assert payload["final"] is False
+            d.store.destroy_ns("jobsev")
+        finally:
+            d.stop()
+
+    def test_explicit_metrics_without_store_is_an_error(self):
+        with pytest.raises(errors.ArgError):
+            TcpProc(0, 1, metrics=True)
+
+    def test_env_metrics_without_store_degrades_loudly(self, monkeypatch):
+        monkeypatch.setenv("ZMPI_METRICS", "1")
+        proc = TcpProc(0, 1, sm=False)  # coordinator modex, no store
+        try:
+            assert proc._metrics_pub is None
+        finally:
+            proc.close()
+
+
+class TestDvmMetricsRpc:
+    def test_per_rank_job_and_aggregate_views(self):
+        d = dvm_mod.Dvm()
+        try:
+            _run_metrics_job(d, n=2, ns="jobrpc")
+            cli = dvm_mod.DvmClient(d.address)
+            try:
+                view = cli.metrics("jobrpc")
+                assert view["job"] == "jobrpc"
+                assert set(view["ranks"]) == {0, 1}
+                for rec in view["ranks"].values():
+                    assert rec["staleness_s"] >= 0.0
+                # counters sum across ranks (shared-process registry:
+                # aggregate == 2x each rank's global view)
+                agg = view["aggregate"]
+                assert agg["tcp_bytes_sent"] == sum(
+                    r["counters"]["tcp_bytes_sent"]
+                    for r in view["ranks"].values())
+                one = cli.metrics("jobrpc", 1)
+                assert one["counters"] == view["ranks"][1]["counters"]
+                with pytest.raises(errors.MpiError):
+                    cli.metrics("jobrpc", 7)
+                with pytest.raises(errors.MpiError):
+                    cli.metrics("no_such_job")
+            finally:
+                cli.close()
+            d.store.destroy_ns("jobrpc")
+        finally:
+            d.stop()
+
+
+_PROM_LINE = re.compile(
+    r'^(zmpi_[a-z0-9_]+)\{job="([^"]+)",rank="(\d+)"\} '
+    r'(-?\d+(?:\.\d+)?)$')
+
+
+def _http_get(addr, path="/metrics"):
+    s = socket.create_connection(addr, 5.0)
+    try:
+        s.sendall(f"GET {path} HTTP/1.0\r\n\r\n".encode())
+        data = b""
+        while True:
+            chunk = s.recv(65536)
+            if not chunk:
+                break
+            data += chunk
+    finally:
+        s.close()
+    head, _, body = data.partition(b"\r\n\r\n")
+    return head.decode(), body.decode()
+
+
+class TestMetricsHttp:
+    def test_scrape_endpoint_prometheus_exposition(self):
+        d = dvm_mod.Dvm(metrics_port=0)
+        try:
+            assert d.metrics_http is not None
+            _run_metrics_job(d, n=2, ns="jobhttp")
+            head, body = _http_get(d.metrics_http.address)
+            assert "200 OK" in head
+            samples = {}
+            seen_families: list[str] = []
+            for line in body.splitlines():
+                if line.startswith("#"):
+                    assert line.startswith("# TYPE zmpi_")
+                    continue
+                m = _PROM_LINE.match(line)
+                assert m, f"unparseable exposition line: {line!r}"
+                samples[(m.group(1), m.group(2), m.group(3))] = m.group(4)
+                if not seen_families or seen_families[-1] != m.group(1):
+                    seen_families.append(m.group(1))
+            # one CONTIGUOUS block per metric family (the exposition
+            # format's rule — strict scrapers reject interleaving)
+            assert len(seen_families) == len(set(seen_families))
+            # every documented counter scrapes, per rank
+            for rank in ("0", "1"):
+                for c in spc.documented_counters():
+                    assert (f"zmpi_spc_{c}", "jobhttp", rank) in samples
+                assert (f"zmpi_metrics_age_seconds", "jobhttp",
+                        rank) in samples
+            head404, _ = _http_get(d.metrics_http.address, "/nope")
+            assert "404" in head404
+            d.store.destroy_ns("jobhttp")
+        finally:
+            d.stop()
+        assert dvm_mod.live_metrics_listeners() == []
+
+    def test_off_by_default(self):
+        d = dvm_mod.Dvm()
+        try:
+            assert d.metrics_http is None
+        finally:
+            d.stop()
+
+
+# ===================== end-to-end acceptance (slow) ========================
+
+
+_E2E_PROG = '''
+import os, signal, sys, time
+sys.path.insert(0, {repo!r})
+import numpy as np
+import zhpe_ompi_tpu as zmpi
+from zhpe_ompi_tpu.runtime.pmix import PmixClient
+
+VICTIM = int(os.environ["TEST_VICTIM"])
+
+proc = zmpi.host_init()
+rank, job = proc.rank, os.environ["ZMPI_JOB"]
+proc.barrier()
+# survivor-to-survivor traffic so every ring has send/recv/match events
+peer = {{0: 1, 1: 0, 2: 3, 3: 2}}[rank]
+proc.send(np.arange(32.0) * rank, dest=peer, tag=5)
+got = proc.recv(source=peer, tag=5)
+proc.barrier()
+if rank == VICTIM:
+    os.kill(os.getpid(), signal.SIGKILL)
+assert proc.ft_state.wait_failed(VICTIM, timeout=15.0), "no classification"
+# park until the parent has read our published windows out of the store
+pmix_host, rest = os.environ["ZMPI_PMIX"].rsplit(":", 1)
+pmix_port = int(rest.split("/")[0])
+cl = PmixClient((pmix_host, pmix_port))
+try:
+    cl.get(job, "release", timeout=60.0)
+finally:
+    cl.close()
+print(f"SURVIVOR-OK rank={{rank}} sum={{float(got.sum())}}", flush=True)
+zmpi.host_finalize()
+'''
+
+
+@pytest.mark.slow
+class TestMetricsPlaneEndToEnd:
+    """The acceptance path: a DVM-launched real-process 4-rank ft job
+    publishes metrics; the zprted metrics RPC and GET /metrics serve
+    every documented SPC counter per rank; kill -9 one rank and the
+    survivors' flight-recorder windows land in the store with the FT
+    classification as the tail entry; deterministic teardown gates."""
+
+    def test_kill9_survivor_windows_and_scrape(self, tmp_path,
+                                               monkeypatch):
+        import io
+        import os
+
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        prog = tmp_path / "metrics_e2e.py"
+        prog.write_text(_E2E_PROG.format(repo=repo))
+        victim = 2
+        monkeypatch.setenv("TEST_VICTIM", str(victim))
+        d = dvm_mod.Dvm(metrics_port=0)
+        try:
+            cli = dvm_mod.DvmClient(d.address)
+            # pmix_puts rises ONLY on metrics-enabled rows: a plain job
+            # touches the store exactly once per rank (its modex card)
+            base_puts = spc.read("pmix_puts")
+            plain = tmp_path / "plain.py"
+            plain.write_text(
+                "import sys; sys.path.insert(0, %r)\n"
+                "import zhpe_ompi_tpu as zmpi\n"
+                "p = zmpi.host_init(); p.barrier(); zmpi.host_finalize()\n"
+                % repo)
+            plain_cli = dvm_mod.DvmClient(d.address)
+            try:
+                rc = plain_cli.launch(2, [str(plain)], timeout=60.0)
+            finally:
+                plain_cli.close()
+            assert rc == 0
+            plain_puts = spc.read("pmix_puts") - base_puts
+            assert plain_puts == 2  # one card put per rank, nothing else
+
+            out, err = io.StringIO(), io.StringIO()
+            result = {}
+
+            def run_job():
+                result["rc"] = cli.launch(
+                    4, [str(prog)], ft=True, metrics=True, timeout=120.0,
+                    mca=[("ft_detector_period", "2.0"),
+                         ("ft_detector_timeout", "60.0"),
+                         ("spc_publish_interval_ms", "50")],
+                    stdout=out, stderr=err,
+                )
+
+            t = threading.Thread(target=run_job, daemon=True)
+            base_puts = spc.read("pmix_puts")
+            t.start()
+            # wait for the job id, then for the survivors' windows
+            deadline = time.monotonic() + 60.0
+            while cli.last_job_id is None \
+                    and time.monotonic() < deadline:
+                time.sleep(0.1)
+            job = cli.last_job_id
+            assert job, err.getvalue()
+            survivors = sorted({0, 1, 2, 3} - {victim})
+            view = None
+            poll = dvm_mod.DvmClient(d.address)
+            try:
+                while time.monotonic() < deadline:
+                    try:
+                        view = poll.metrics(job)
+                    except errors.MpiError:
+                        view = None
+                    if view is not None and all(
+                            "flightrec" in view["ranks"].get(r, {})
+                            for r in survivors):
+                        break
+                    time.sleep(0.25)
+            finally:
+                poll.close()
+            assert view is not None, (out.getvalue(), err.getvalue())
+            doc = spc.documented_counters()
+            for r in survivors:
+                rec = view["ranks"][r]
+                # every documented counter, per rank, zero-filled
+                assert not doc - set(rec["counters"])
+                # spc_publishes rises; the floor held (50 → 250ms)
+                assert rec["counters"]["spc_publishes"] >= 1
+                assert rec["interval_ms"] >= 250
+                # the postmortem: the last-N window's TAIL is the typed
+                # classification of the victim, OS truth from the daemon
+                window = rec["flightrec"]
+                assert window, f"rank {r}: empty flight recorder"
+                tail = window[-1]
+                assert tail["type"] == "ft_class"
+                assert tail["rank"] == victim
+                assert tail["cause"] == "daemon"
+                kinds = {e["type"] for e in window}
+                assert "send" in kinds and "recv" in kinds
+            # the scrape endpoint serves the same plane (lines parse)
+            head, body = _http_get(d.metrics_http.address)
+            assert "200 OK" in head
+            for r in survivors:
+                for c in sorted(doc):
+                    pat = f'zmpi_spc_{c}{{job="{job}",rank="{r}"}} '
+                    assert any(line.startswith(pat)
+                               for line in body.splitlines()), (c, r)
+            # release the survivors; the job runs out
+            d.store.put(job, 99, "release", True)
+            d.store.commit(job, 99)
+            t.join(90)
+            assert not t.is_alive(), "job never exited"
+            # ft job, victim killed by signal 9: rc = 128 + 9
+            assert result["rc"] == 137, (out.getvalue(), err.getvalue())
+            assert len(re.findall(r"SURVIVOR-OK rank=(\d+)",
+                                  out.getvalue())) == 3
+            # metrics-enabled row moved the store far beyond modex
+            assert spc.read("pmix_puts") - base_puts > 4
+            # job end destroys the namespace: zero stale metrics keys.
+            # The exit frame streams BEFORE the daemon's finalize runs,
+            # so give the async destroy its moment
+            finalize_deadline = time.monotonic() + 5.0
+            while pmix_mod.stale_metric_keys() \
+                    and time.monotonic() < finalize_deadline:
+                time.sleep(0.05)
+            assert pmix_mod.stale_metric_keys() == []
+            cli.stop()
+            cli.close()
+        finally:
+            d.stop()
+        # zero leaked sockets/threads/listeners at teardown
+        assert dvm_mod.live_metrics_listeners() == []
+        assert dvm_mod.live_dvms() == []
+        assert spc.live_publisher_threads() == []

@@ -97,6 +97,135 @@ class TestPvars:
         with pytest.raises(errors.ArgError):
             mpit.PvarSession().handle_alloc("nope")
 
+    def test_open_handle_survives_spc_reset(self, world):
+        """Regression: the handle's baseline outlived ``spc.reset()``
+        and every read came back NEGATIVE — the reset epoch (or the
+        monotonicity guard) must rebase instead."""
+        spc.record("mpit_epoch_counter", 50)
+        h = mpit.PvarSession().handle_alloc("spc_mpit_epoch_counter")
+        h.start()
+        spc.record("mpit_epoch_counter", 5)
+        assert h.read() == 5
+        spc.reset()
+        assert h.read() == 0  # never negative
+        spc.record("mpit_epoch_counter", 3)
+        assert h.read() == 3  # counts since the reset
+
+    def test_deterministic_discovery(self, world):
+        """pvar discovery enumerates the DOCUMENTED counter table, so
+        pvar_get_num is stable from init — traffic that fires new
+        documented counters must not grow the universe."""
+        from zhpe_ompi_tpu.pt2pt.universe import LocalUniverse
+
+        # the universe's state-pvar registration is part of init, not
+        # of traffic — build it before the discovery snapshot
+        uni = LocalUniverse(2)
+        names0 = set(mpit.pvar_names())
+        # documented counters surface BEFORE anything fired them
+        for c in ("tcp_bytes_sent", "sm_bytes_sent", "spc_publishes",
+                  "coll_han_inter_bytes", "flightrec_events_dropped"):
+            assert f"spc_{c}" in names0, c
+        n0 = mpit.pvar_get_num()
+        uni.contexts[0].send(np.ones(8), dest=1, tag=1)
+        uni.contexts[1].progress()
+        uni.contexts[1].recv(source=0, tag=1)
+        assert mpit.pvar_get_num() == n0
+        assert set(mpit.pvar_names()) == names0
+
+    def test_concurrent_sessions_do_not_trample(self, world):
+        """Eight threads, one counter, one session each: every handle
+        started before any increment must read the full total —
+        baselines are per-handle, never shared."""
+        import threading
+
+        spc.record("mpit_conc_counter", 100)
+        n = 8
+        barrier = threading.Barrier(n)
+        reads = [None] * n
+
+        def worker(i):
+            s = mpit.PvarSession()
+            h = s.handle_alloc("spc_mpit_conc_counter")
+            barrier.wait()
+            h.start()
+            barrier.wait()  # every handle started before any record
+            spc.record("mpit_conc_counter", 1)
+            barrier.wait()  # every record landed before any read
+            reads[i] = h.read()
+            s.free()
+
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(n)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(20)
+            assert not t.is_alive()
+        assert reads == [n] * n
+
+
+class TestRemoteSession:
+    def test_remote_reads_match_rank_snapshot(self):
+        """PvarSession(remote=...) against a live DVM job: handle
+        reads come from the rank's published store snapshots and match
+        the rank's own spc.snapshot() within one publish interval (the
+        final flush makes the closed rank's snapshot exact)."""
+        from tests.test_metrics_plane import _run_metrics_job
+        from zhpe_ompi_tpu.runtime import dvm as dvm_mod
+
+        d = dvm_mod.Dvm()
+        try:
+            probe0 = spc.read("mpit_remote_probe")
+            _run_metrics_job(
+                d, n=2, ns="jobremote",
+                rank_fn=lambda p: spc.record("mpit_remote_probe",
+                                             10 + p.rank))
+            s = mpit.PvarSession(
+                remote=(d.address, "jobremote", 1))
+            # a counter the publish path itself cannot move: the
+            # final-flush snapshot is EXACT for it (tcp_bytes_sent is
+            # not — publishing the snapshot is itself wire traffic)
+            assert s._remote.counter("mpit_remote_probe") \
+                == spc.read("mpit_remote_probe") == probe0 + 21
+            # wire counters stay within the monotonic window: the
+            # snapshot can only trail the live registry
+            assert 0 < s._remote.counter("tcp_bytes_sent") \
+                <= spc.read("tcp_bytes_sent")
+            h = s.handle_alloc("spc_mpit_remote_probe")
+            h.start()
+            assert h.read() == 0  # baseline isolation holds remotely
+            # remote discovery is deterministic too: the documented
+            # table enumerates without any traffic knowledge
+            defs = s._remote.defs()
+            assert "spc_sm_bytes_sent" in defs
+            s.free()
+            d.store.destroy_ns("jobremote")
+        finally:
+            d.stop()
+
+    def test_remote_session_before_first_publish_reads_zero(self):
+        """A session bound before the rank's first publish reads the
+        zero-filled documented universe — handle_alloc AND reads work
+        (a dead daemon still raises; absence of data is not absence of
+        the daemon)."""
+        from zhpe_ompi_tpu.runtime import dvm as dvm_mod
+
+        d = dvm_mod.Dvm()
+        try:
+            d.store.ensure_ns("jobearly", 2)
+            s = mpit.PvarSession(remote=(d.address, "jobearly", 0))
+            h = s.handle_alloc("spc_tcp_bytes_sent")
+            h.start()
+            assert h.read() == 0
+            assert s._remote.counter("tcp_bytes_sent") == 0
+            s.free()
+            d.store.destroy_ns("jobearly")
+        finally:
+            d.stop()
+        # the daemon is gone now: reads must FAIL, not read zero
+        with pytest.raises(errors.MpiError):
+            mpit.PvarSession(remote=(d.address, "jobearly", 0))
+
 
 class TestCategories:
     def test_categories(self, world):
@@ -106,6 +235,43 @@ class TestCategories:
         assert "coll" in info["cvars"]
         with pytest.raises(errors.ArgError):
             mpit.category_info("definitely_not_a_category")
+
+    def test_framework_prefix_families(self, world):
+        """Regression: first-`_`-segment bucketing scattered one
+        subsystem across meaningless buckets (coll_han_* under `coll`,
+        btl_tcp_* split from tcp_*).  Categories now derive from the
+        registered framework prefix table."""
+        import zhpe_ompi_tpu.coll.han  # noqa: F401 - registers coll_han
+        import zhpe_ompi_tpu.pt2pt.tcp  # noqa: F401 - registers tcp
+
+        cats = mpit.category_names()
+        assert "han" in cats
+        han = mpit.category_info("han")
+        assert "coll_han_enable" in han["cvars"]
+        assert "coll_han_pipeline" in han["cvars"]
+        # the wire family holds BOTH tcp_* and btl_tcp_* vars
+        tcp = mpit.category_info("tcp")
+        assert "tcp_eager_limit" in tcp["cvars"]
+        assert "btl_tcp_verbose" in tcp["cvars"]
+        # coll keeps what is actually coll's (not han's, not tuned's)
+        coll = mpit.category_info("coll")
+        assert "coll_han_enable" not in coll["cvars"]
+
+    def test_spc_pvars_bucket_per_family(self, world):
+        cats = mpit.category_names()
+        assert "spc.tcp" in cats and "spc.han" in cats
+        tcp_p = mpit.category_info("spc.tcp")["pvars"]
+        assert "spc_tcp_bytes_sent" in tcp_p
+        assert "spc_rndv_park_bytes_avoided" in tcp_p
+        han_p = mpit.category_info("spc.han")["pvars"]
+        assert "spc_coll_han_inter_bytes" in han_p
+        assert "spc_han_flat_fallbacks" in han_p
+        # the metrics plane's own counters form spc.metrics
+        met_p = mpit.category_info("spc.metrics")["pvars"]
+        assert "spc_spc_publishes" in met_p
+        assert "spc_flightrec_events_dropped" in met_p
+        # the umbrella still covers everything
+        assert set(tcp_p) <= set(mpit.category_info("spc")["pvars"])
 
 
 class TestHooks:

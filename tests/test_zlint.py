@@ -204,6 +204,62 @@ def decide(opname, size, text):
     return int(text)
 """
 
+# ZL009 anchors on spc.py exactly like ZL006; templated doc entries
+# (``coll_<op>_calls``) belong to IT, not to the exact-name parity
+SPC_DOC_TPL = '''
+"""Counters.
+
+- ``coll_<op>_calls`` — templated per-operation family.
+"""
+'''
+
+TRIP_ZL009_TABLE = """
+from runtime import spc
+
+PLANE = {"fast": "mystery_dynamic_counter"}
+
+class Seam:
+    def __init__(self, plane):
+        self._ctr = PLANE.get(plane, "documented_counter")
+
+    def op(self, n):
+        spc.record(self._ctr, n)
+"""
+
+CLEAN_ZL009_TABLE = """
+from runtime import spc
+
+PLANE = {"fast": "documented_counter"}
+
+class Seam:
+    def __init__(self, plane):
+        self._ctr = PLANE.get(plane, "documented_counter")
+
+    def op(self, n):
+        spc.record(self._ctr, n)
+"""
+
+TRIP_ZL009_FSTRING = """
+from runtime import spc
+
+def op(kind):
+    spc.record(f"zz_{kind}_calls", 1)
+"""
+
+CLEAN_ZL009_FSTRING = """
+from runtime import spc
+
+def op(kind):
+    spc.record(f"coll_{kind}_calls", 1)
+"""
+
+TRIP_ZL009_UNRESOLVABLE = """
+from runtime import spc
+
+def op(make_name):
+    spc.record(make_name(), 1)
+"""
+
 CLEAN_ZL008 = """
 def decide(opname, size, text):
     if opname not in ("allreduce", "bcast"):
@@ -230,6 +286,12 @@ class TestRuleMatrix:
         ("ZL007", TRIP_ZL007_UNREG, CLEAN_ZL007, {"var.py": VAR_PY}),
         ("ZL007", TRIP_ZL007_DRIFT, CLEAN_ZL007, {"var.py": VAR_PY}),
         ("ZL008", TRIP_ZL008, CLEAN_ZL008, None),
+        ("ZL009", TRIP_ZL009_TABLE, CLEAN_ZL009_TABLE,
+         {"spc.py": SPC_DOC}),
+        ("ZL009", TRIP_ZL009_FSTRING, CLEAN_ZL009_FSTRING,
+         {"spc.py": SPC_DOC_TPL}),
+        ("ZL009", TRIP_ZL009_UNRESOLVABLE, CLEAN_ZL009_TABLE,
+         {"spc.py": SPC_DOC}),
     ])
     def test_trip_and_clean(self, tmp_path, rule, trip, clean, extra):
         tripped = lint_src(tmp_path / "trip", trip, extra=extra)
@@ -259,9 +321,27 @@ class TestRuleMatrix:
         res = lint_src(tmp_path, TRIP_ZL007_UNREG)
         assert "ZL007" not in rules_of(res)
 
+    def test_zl009_inert_without_anchor(self, tmp_path):
+        res = lint_src(tmp_path, TRIP_ZL009_TABLE)
+        assert "ZL009" not in rules_of(res)
+
+    def test_zl009_names_the_leaked_counter(self, tmp_path):
+        res = lint_src(tmp_path, TRIP_ZL009_TABLE,
+                       extra={"spc.py": SPC_DOC})
+        details = {f.detail for f in res.findings if f.rule == "ZL009"}
+        assert "undocumented:mystery_dynamic_counter" in details
+        # the documented arm of the same table is NOT flagged
+        assert not any("documented_counter" in d for d in details)
+
+    def test_zl009_unresolvable_dynamic_name(self, tmp_path):
+        res = lint_src(tmp_path, TRIP_ZL009_UNRESOLVABLE,
+                       extra={"spc.py": SPC_DOC})
+        details = {f.detail for f in res.findings if f.rule == "ZL009"}
+        assert "unresolvable" in details
+
     def test_rule_table_documents_history(self):
         table = rule_table()
-        assert len(table) == 8
+        assert len(table) == 9
         assert all(guards for _, _, guards in table), (
             "every rule must cite the historical bug it encodes"
         )

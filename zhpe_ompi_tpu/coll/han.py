@@ -52,6 +52,7 @@ groups (the rebuild contract ``tests/test_ulfm.py`` exercises).
 
 from __future__ import annotations
 
+import functools
 from typing import Any
 
 import numpy as np
@@ -61,10 +62,34 @@ from ..mca import output as mca_output
 from ..mca import var as mca_var
 from ..pt2pt import groups as groups_mod
 from ..pt2pt.groups import LEADER_WINDOW, GroupView, payload_bytes
+from ..runtime import flightrec
 from ..runtime import spc
 from . import host
 
 _stream = mca_output.open_stream("coll_han")
+
+# category derivation (tools/mpit.py): the hierarchical-collective
+# plane's vars (coll_han_*) and counters (coll_han_*, han_*) are ONE
+# family
+mca_var.register_family("coll_han", "han")
+mca_var.register_family("han", "han")
+
+
+def _recorded(opname: str):
+    """Flight-recorder enter/exit around a hierarchical collective —
+    exit records only on SUCCESS, so a postmortem window shows the
+    schedule a failing rank died inside (an aborted collective's
+    missing exit is the signal, not a gap)."""
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            flightrec.record(flightrec.COLL_ENTER, op=opname)
+            out = fn(*args, **kwargs)
+            flightrec.record(flightrec.COLL_EXIT, op=opname)
+            return out
+        return wrapper
+    return deco
+
 
 mca_var.register(
     "coll_han_inter_segment", 1 << 20,
@@ -491,6 +516,8 @@ def _allreduce_numa(ctx, topo: _Topology, value: Any, op) -> Any:
     leader exchange among every domain leader instead."""
     dview, dlview, wview = _numa_views(ctx, topo)
     spc.record("coll_han_numa_collectives", 1)
+    flightrec.record(flightrec.COLL_ENTER, op="allreduce",
+                     phase="domain", sched="han3")
     part = host.reduce(dview, value, op, root=0) \
         if dview.size > 1 else value
     if dlview is not None:
@@ -503,9 +530,12 @@ def _allreduce_numa(ctx, topo: _Topology, value: Any, op) -> Any:
                               algorithm="binomial")
     if dview.size > 1:
         part = host.bcast(dview, part, root=0, algorithm="binomial")
+    flightrec.record(flightrec.COLL_EXIT, op="allreduce",
+                     phase="domain", sched="han3")
     return part
 
 
+@_recorded("allreduce")
 def allreduce(ctx, value: Any, op,
               groups: list[list[int]] | None = None) -> Any:
     """Two-level allreduce: intra reduce → leader allreduce → intra
@@ -548,6 +578,15 @@ def _leader_allreduce(inter, partial: Any, op) -> Any:
     phase made identical everywhere)."""
     if inter.size <= 1:
         return partial
+    flightrec.record(flightrec.COLL_ENTER, op="allreduce",
+                     phase="inter")
+    out = _leader_allreduce_body(inter, partial, op)
+    flightrec.record(flightrec.COLL_EXIT, op="allreduce",
+                     phase="inter")
+    return out
+
+
+def _leader_allreduce_body(inter, partial: Any, op) -> Any:
     large = int(mca_var.get("host_coll_large_msg", 256 * 1024))
     if (
         not isinstance(partial, np.ndarray)
@@ -626,6 +665,7 @@ def _bcast_numa(ctx, topo: _Topology, obj: Any, root: int) -> Any:
     return orig if ctx.rank == root else out
 
 
+@_recorded("bcast")
 def bcast(ctx, obj: Any = None, root: int = 0,
           groups: list[list[int]] | None = None) -> Any:
     """Two-level bcast.  The leader set is FIXED (min rank per group,
@@ -658,6 +698,7 @@ def bcast(ctx, obj: Any = None, root: int = 0,
 # -------------------------------------------------------------- reduce
 
 
+@_recorded("reduce")
 def reduce(ctx, value: Any, op, root: int = 0,
            groups: list[list[int]] | None = None) -> Any:
     """Two-level reduce: intra reduce → leader reduce rooted at the
@@ -707,6 +748,7 @@ def _barrier_numa(ctx, topo: _Topology) -> None:
         host.bcast(dview, b"", root=0, algorithm="binomial")
 
 
+@_recorded("barrier")
 def barrier(ctx, groups: list[list[int]] | None = None) -> None:
     """Two-level barrier: intra gather (arrival) → leader allgather →
     intra bcast (release) — p-1 sm hops plus the leader exchange,
@@ -726,6 +768,7 @@ def barrier(ctx, groups: list[list[int]] | None = None) -> None:
 # ------------------------------------------------------------ allgather
 
 
+@_recorded("allgather")
 def allgather(ctx, value: Any,
               groups: list[list[int]] | None = None) -> list:
     """Two-level allgather: intra gather → leader allgather (each block
@@ -750,6 +793,7 @@ def allgather(ctx, value: Any,
 # -------------------------------------------------------- reduce_scatter
 
 
+@_recorded("reduce_scatter")
 def reduce_scatter(ctx, values: list, op,
                    groups: list[list[int]] | None = None) -> Any:
     """Two-level reduce_scatter: intra blockwise reduce → leader

@@ -183,6 +183,9 @@ _DEFAULT_LARGE = 1 * 1024 * 1024
 
 
 def _register_params():
+    # category derivation (tools/mpit.py): coll_tuned_* is its own
+    # component family, not a scatter across the coll bucket
+    mca_var.register_family("coll_tuned", "tuned")
     for opname, table in _ALG_TABLES.items():
         mca_var.register(
             f"coll_tuned_{opname}_algorithm",

@@ -47,6 +47,7 @@ import threading
 from typing import Any, Callable
 
 from ..core import errors
+from ..runtime import flightrec
 from . import ulfm
 from .ulfm import agree_failed_set  # noqa: F401  (pipeline step 2)
 
@@ -188,10 +189,11 @@ def daemon_respawn(ranks, dvm: str | tuple | None = None,
             "zmpirun --dvm (ZMPI_DVM/ZMPI_JOB exported) or pass "
             "dvm=(host, port) and job explicitly"
         )
+    batch = sorted(int(r) for r in ranks)
+    flightrec.record(flightrec.RESPAWN, ranks=batch, via="daemon")
     client = DvmClient(dvm, timeout=timeout)
     try:
-        return client.respawn(job, sorted(int(r) for r in ranks),
-                              timeout=timeout)
+        return client.respawn(job, batch, timeout=timeout)
     finally:
         client.close()
 
@@ -273,6 +275,7 @@ def respawn_rank(uni, rank: int, fn: Callable[[Any], Any],
     replacement's program.  Mirrors ``LocalUniverse.run``'s bookkeeping:
     a replacement that dies again is marked failed; a clean finish is
     not a process failure."""
+    flightrec.record(flightrec.RESPAWN, ranks=[int(rank)], via="thread")
     ctx = uni.respawn_rank(rank)
 
     def second_life():

@@ -44,6 +44,7 @@ from ..comm.group import Group
 from ..core import errors
 from ..mca import output as mca_output
 from ..mca import var as mca_var
+from ..runtime import flightrec
 
 mca_var.register(
     "ft_detector_period", 0.05,
@@ -114,6 +115,7 @@ def revoke_cid(cid: int) -> None:
     single-controller device plane)."""
     with _global_lock:
         _REVOKED_CIDS.add(int(cid))
+    flightrec.record(flightrec.REVOKE, cid=int(cid), plane="device")
 
 
 def is_revoked(cid: int) -> bool:
@@ -257,6 +259,10 @@ class FailureState:
                         and rank not in _EXPECTED_RANK_KILLS):
                     global _false_positives
                     _false_positives += 1
+        # the flight-recorder classification event lands BEFORE the
+        # listeners run: a metrics publisher's on_classification hook
+        # ships the window with this event as its tail entry
+        flightrec.record(flightrec.FT_CLASS, rank=int(rank), cause=cause)
         self._notify_death(rank, cause)
         return True
 
@@ -369,6 +375,8 @@ class FailureState:
             self._acked.add(rank)
             self._cv.notify_all()
         if fresh:
+            flightrec.record(flightrec.FT_CLASS, rank=int(rank),
+                             cause="goodbye")
             self._notify_death(rank, "goodbye")
         return fresh
 
@@ -416,6 +424,7 @@ class FailureState:
         with self._cv:
             self._revoked.add(int(cid))
             self._cv.notify_all()
+        flightrec.record(flightrec.REVOKE, cid=int(cid))
 
     def alias_cid(self, cid: int, logical: int) -> None:
         """Declare ``cid`` a sub-channel of ``logical``: revocation of

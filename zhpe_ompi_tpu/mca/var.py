@@ -263,3 +263,50 @@ get = registry.get
 lookup = registry.lookup
 set_var = registry.set
 unset = registry.unset
+
+
+# -- framework prefix table (category derivation) ---------------------------
+#
+# Variables are named <framework>_<component>_<param>, but a bare
+# first-`_`-segment split cannot tell `coll_han_enable` (framework
+# coll, component han) from `collective_thing`: the MPI_T category
+# derivation (tools/mpit.py) scattered one subsystem's vars and
+# counters across meaningless buckets.  Subsystems therefore REGISTER
+# their name prefixes here, next to their var registrations — the
+# category of a name is its longest registered prefix's family, with
+# the first segment as the unregistered fallback (the degenerate case
+# the old behavior was).
+
+_family_lock = threading.Lock()
+_families: dict[str, str] = {}
+
+
+def register_family(prefix: str, family: str | None = None) -> None:
+    """Map every name under ``prefix`` (exact, or ``prefix_*``) to
+    ``family`` (default: the prefix itself).  Idempotent; last
+    registration wins (subsystems re-register on re-import)."""
+    with _family_lock:
+        _families[str(prefix)] = str(family if family is not None
+                                     else prefix)
+
+
+def family_of(name: str) -> str:
+    """Family of a var/counter name: the LONGEST registered prefix
+    matching at a ``_`` boundary; unregistered names fall back to
+    their first ``_`` segment.  Read-only scan under the lock — no
+    per-call table copy (category sweeps call this once per name)."""
+    name = str(name)
+    best: tuple[int, str] | None = None
+    with _family_lock:
+        for prefix, family in _families.items():
+            if name == prefix or name.startswith(prefix + "_"):
+                if best is None or len(prefix) > best[0]:
+                    best = (len(prefix), family)
+    if best is not None:
+        return best[1]
+    return name.split("_", 1)[0]
+
+
+def family_table() -> dict[str, str]:
+    with _family_lock:
+        return dict(_families)

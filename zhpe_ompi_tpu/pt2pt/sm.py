@@ -102,6 +102,11 @@ from ..utils import lockdep
 
 _stream = mca_output.open_stream("btl_sm")
 
+# category derivation (tools/mpit.py): the shared-memory plane's vars
+# and counters — sm_*, btl_sm_* — are ONE family
+mca_var.register_family("sm")
+mca_var.register_family("btl_sm", "sm")
+
 mca_var.register(
     "sm", 1,
     "Shared-memory transport for same-host Python ranks: 1 = create an "

@@ -38,6 +38,7 @@ from __future__ import annotations
 
 import collections
 import itertools
+import os
 import queue
 import random
 import socket
@@ -56,6 +57,7 @@ from ..core import errors
 from ..ft import ulfm
 from ..mca import output as mca_output
 from ..mca import var as mca_var
+from ..runtime import flightrec
 from ..runtime import spc
 from ..utils import dss
 from ..utils import lockdep
@@ -96,6 +98,12 @@ mca_var.register(
     "of spawning one thread per transfer",
     type=int,
 )
+
+# category derivation (tools/mpit.py): the wire plane's vars and
+# counters — tcp_*, btl_tcp_*, rndv_* — are ONE family
+mca_var.register_family("tcp")
+mca_var.register_family("btl_tcp", "tcp")
+mca_var.register_family("rndv", "tcp")
 
 # sendmsg gathers header+segments in one syscall; platforms without it
 # (or a socket object that declines) fall back to sequential sendall
@@ -414,6 +422,43 @@ def orphaned_rndv_descriptors() -> list[str]:
     return out
 
 
+def _wire_queue_depth(key: str) -> int:
+    """Matching-queue depth across every OPEN wire proc in this
+    process — the state-pvar twin of universe.py's thread-plane
+    readers, so the metrics publisher's snapshot carries live queue
+    depths for socket ranks too."""
+    total = 0
+    for proc in list(_live_procs):
+        if proc._closed.is_set():
+            continue
+        total += proc.engine.stats()[key]
+    return total
+
+
+_wire_pvars_registered = False
+
+
+def _register_wire_pvars() -> None:
+    global _wire_pvars_registered
+    if _wire_pvars_registered:
+        return
+    from ..tools import mpit
+
+    mpit.register_pvar(
+        "tcp_posted_recvs", lambda: _wire_queue_depth("posted"),
+        klass=mpit.PVAR_STATE,
+        description="posted receives across this process's open wire "
+                    "procs",
+    )
+    mpit.register_pvar(
+        "tcp_unexpected_msgs", lambda: _wire_queue_depth("unexpected"),
+        klass=mpit.PVAR_STATE,
+        description="unexpected-queue depth across this process's open "
+                    "wire procs",
+    )
+    _wire_pvars_registered = True
+
+
 class TcpProc(errh.HasErrhandler, ulfm.UlfmEndpointAPI, HostCollectives,
               NonblockingCollectives):
     """One process's endpoint in a TCP universe of `size` ranks.
@@ -440,9 +485,35 @@ class TcpProc(errh.HasErrhandler, ulfm.UlfmEndpointAPI, HostCollectives,
                  namespace: str = "default",
                  rejoin: bool = False,
                  rejoin_gen: int = 0,
-                 rejoin_ranks: "list[int] | None" = None):
+                 rejoin_ranks: "list[int] | None" = None,
+                 metrics: bool | None = None):
         if size < 1:
             raise errors.ArgError("size must be >= 1")
+        # metrics plane: explicit opt-in (ctor arg) or the ZMPI_METRICS
+        # environment contract a DVM job launched with metrics=True
+        # exports.  Publishing needs a store — an explicit metrics=True
+        # without one is a caller contract error, an env-driven request
+        # degrades loudly (the env may be fleet-global).
+        if metrics is None:
+            metrics = os.environ.get("ZMPI_METRICS", "") not in ("", "0")
+            env_metrics = True
+        else:
+            metrics = bool(metrics)
+            env_metrics = False
+        if metrics and pmix is None:
+            if not env_metrics:
+                raise errors.ArgError(
+                    "metrics=True publishes through the PMIx store: "
+                    "pass pmix=(host, port) (the ZMPI_PMIX contract)"
+                )
+            mca_output.emit(
+                _stream,
+                "rank %s: ZMPI_METRICS set but no PMIx store to "
+                "publish into; metrics plane disabled", rank,
+            )
+            metrics = False
+        self._metrics_on = metrics
+        self._metrics_pub: spc.MetricsPublisher | None = None
         if (rejoin_book is not None or rejoin) and not ft:
             raise errors.ArgError(
                 "rejoin_book (respawn into an existing job) requires ft=True"
@@ -604,6 +675,15 @@ class TcpProc(errh.HasErrhandler, ulfm.UlfmEndpointAPI, HostCollectives,
                 5, _stream, "rank %d up at %s; book=%s", rank, self.address,
                 self.address_book,
             )
+            _register_wire_pvars()
+            if self._metrics_on:
+                # rank-side metrics publisher: periodic generation-
+                # tagged snapshots into the job's namespace, final
+                # flush at close() — started after the modex so the
+                # namespace provably exists
+                self._metrics_pub = spc.MetricsPublisher(
+                    self._pmix_addr, self._pmix_ns, rank)
+                self._metrics_pub.start()
             if ft:
                 # peer death ⇒ ring teardown: the sm transport unmaps its
                 # ring into a corpse the moment classification learns of it
@@ -613,6 +693,13 @@ class TcpProc(errh.HasErrhandler, ulfm.UlfmEndpointAPI, HostCollectives,
                 # toward it (queued frames AND parked rndv descriptors):
                 # a waitall must observe ProcFailed, never wedge
                 self.ft_state.add_failure_listener(self._fail_inflight)
+                if self._metrics_pub is not None:
+                    # typed classification ⇒ this survivor's flight-
+                    # recorder window ships to the store (the FT_CLASS
+                    # event is already the ring's tail: FailureState
+                    # records before it notifies listeners)
+                    self.ft_state.add_failure_listener(
+                        self._metrics_pub.on_classification)
                 if rejoin_book is not None:
                     # announce BEFORE the detector starts: beats toward a
                     # survivor that has not yet swapped in the fresh
@@ -636,6 +723,9 @@ class TcpProc(errh.HasErrhandler, ulfm.UlfmEndpointAPI, HostCollectives,
             # the zero-orphan/zero-leak lifecycle contract is
             # honored HERE, whichever construction step failed
             # (listener bind, accept start, modex, JOIN, detector)
+            if self._metrics_pub is not None:
+                self._metrics_pub.stop()
+                self._metrics_pub = None
             if self._sm_seg is not None:
                 self._sm_seg.close()
             raise
@@ -1136,6 +1226,12 @@ class TcpProc(errh.HasErrhandler, ulfm.UlfmEndpointAPI, HostCollectives,
         stop and every socket is torn down abruptly — no quiescence, no
         goodbye — so peers see connection reset exactly like a crash."""
         self._ft_dead = True
+        if self._metrics_pub is not None:
+            # a crash publishes nothing more — no final flush (a clean
+            # final snapshot from a corpse would lie to the fleet); the
+            # thread still dies with the proc (the publisher leak gate)
+            self._metrics_pub.abort()
+            self._metrics_pub = None
         if self._detector is not None:
             self._detector.stop(join_timeout=0.0)
         self._closed.set()
@@ -1615,6 +1711,11 @@ class TcpProc(errh.HasErrhandler, ulfm.UlfmEndpointAPI, HostCollectives,
             raise errors.RankError(f"rank {dest} out of range")
         if tag < 0:
             raise errors.TagError(f"negative tag {tag}")
+        if flightrec.active and not poll:
+            # the postmortem ring: user-facing traffic only (poll=True
+            # protocol sends would drown the window in heartbeat noise)
+            flightrec.record(flightrec.SEND, rank=self.rank, dest=dest,
+                             tag=tag, cid=cid)
         state = self.ft_state
         if state is not None and state.is_revoked(cid):
             # before ANY delivery path, the loopback fast path included:
@@ -2306,6 +2407,9 @@ class TcpProc(errh.HasErrhandler, ulfm.UlfmEndpointAPI, HostCollectives,
             raise errors.RankError(f"rank {dest} out of range")
         if tag < 0:
             raise errors.TagError(f"negative tag {tag}")
+        if flightrec.active and not poll:
+            flightrec.record(flightrec.SEND, rank=self.rank, dest=dest,
+                             tag=tag, cid=cid, nb=True)
         dispatch = None if poll else self.call_errhandler
         state = self.ft_state
         if state is not None and state.is_revoked(cid):
@@ -2420,6 +2524,9 @@ class TcpProc(errh.HasErrhandler, ulfm.UlfmEndpointAPI, HostCollectives,
         raises ``InternalError`` directly so service loops keep their
         poll semantics regardless of the user's disposition."""
         timeout = self._timeout if timeout is None else timeout
+        if flightrec.active and not poll:
+            flightrec.record(flightrec.RECV, rank=self.rank, src=source,
+                             tag=tag, cid=cid)
         result: list[Any] = []
         envs: list[Envelope] = []
         done = threading.Event()
@@ -2537,7 +2644,15 @@ class TcpProc(errh.HasErrhandler, ulfm.UlfmEndpointAPI, HostCollectives,
             k <<= 1
 
     def close(self) -> None:
-        # Control floods first: an in-flight agreement announce or
+        # Metrics final flush first, while the store and our state are
+        # both fully alive: the stop() below publishes one last
+        # snapshot (final=True) so a job shorter than one publish
+        # interval is still fleet-visible, then joins the publisher —
+        # the zero-leaked-publisher-threads gate.
+        if self._metrics_pub is not None:
+            self._metrics_pub.stop()
+            self._metrics_pub = None
+        # Control floods next: an in-flight agreement announce or
         # revoke notice must reach the peers before the wire comes
         # down — the flood threads are fire-and-forget for their
         # CALLERS, but a CLOSING rank that takes its announce to the

@@ -31,9 +31,19 @@ fault-tolerance planes of PRs 1–7 built toward:
 Wire protocol (control port; length-framed DSS, request/response with
 streaming for ``launch``): requests are ``["launch", spec]``,
 ``["respawn", job, ranks]``, ``["pids", job]``, ``["stat"]``,
-``["ping"]``, ``["stop"]``.  A launch streams ``["job", id]``, then
-``["io", rank, label, line]`` / ``["note", text]`` frames, and finally
-``["exit", rc]``.
+``["metrics", job[, rank]]``, ``["ping"]``, ``["stop"]``.  A launch
+streams ``["job", id]``, then ``["io", rank, label, line]`` /
+``["note", text]`` frames, and finally ``["exit", rc]``.
+
+The daemon is also the metrics plane's aggregation point: ranks
+launched with ``metrics=True`` (``ZMPI_METRICS=1``) publish
+generation-tagged ``metrics:<job>:<rank>`` snapshots into the resident
+store, the ``metrics`` RPC serves per-rank / per-job / job-aggregated
+views with staleness stamps, and — off by default, ``--metrics-port``
+to enable — an HTTP ``GET /metrics`` listener emits the whole store's
+counter plane as Prometheus text exposition
+(``zmpi_spc_<name>{job="...",rank="..."} value``), so the han/sm/wire/
+FT counters the benches gate on are scrapeable from a live fleet.
 
 Job semantics mirror ``zmpirun``: non-ft jobs keep MPI_Abort teardown
 (first nonzero exit kills the rest); ft jobs keep running — death is an
@@ -58,6 +68,7 @@ from __future__ import annotations
 import argparse
 import itertools
 import os
+import re
 import signal
 import socket
 import subprocess
@@ -126,6 +137,117 @@ def orphaned_daemon_processes() -> list[str]:
     return out
 
 
+_live_metrics_http: weakref.WeakSet = weakref.WeakSet()
+
+
+def live_metrics_listeners() -> list[str]:
+    """Metrics HTTP listeners still bound — must be [] once every
+    daemon's stop() ran (the scrape endpoint dies with its daemon)."""
+    return [
+        f"metrics-http:{h.address[0]}:{h.address[1]}"
+        for h in list(_live_metrics_http)
+        if not h.closed
+    ]
+
+
+class MetricsHttpListener:
+    """Minimal HTTP/1.0 server for ``GET /metrics``: one accept loop,
+    one short-lived thread per request, Prometheus text exposition
+    rendered by the owning daemon.  Deliberately tiny — no keep-alive,
+    no routing beyond /metrics, request read bounded — because its
+    whole contract is "a scraper can poll this port"."""
+
+    def __init__(self, dvm: "Dvm", host: str, port: int):
+        self._dvm = dvm
+        self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        try:
+            self._srv.bind((host, port))
+        except OSError:
+            self._srv.close()
+            raise
+        self._srv.listen(8)
+        self.address: tuple[str, int] = self._srv.getsockname()
+        self.closed = False
+        self._threads: list[threading.Thread] = []
+        self._lock = threading.Lock()
+        self._acceptor = threading.Thread(
+            target=self._accept_loop, daemon=True,
+            name=f"dvm-metrics-http-{self.address[1]}",
+        )
+        self._acceptor.start()
+        _live_metrics_http.add(self)
+
+    def _accept_loop(self) -> None:
+        while not self.closed:
+            try:
+                conn, _ = self._srv.accept()
+            except OSError:
+                return
+            with self._lock:
+                self._threads = [t for t in self._threads if t.is_alive()]
+                t = threading.Thread(
+                    target=self._serve, args=(conn,), daemon=True,
+                    name=f"dvm-metrics-req-{self.address[1]}",
+                )
+                self._threads.append(t)
+            t.start()
+
+    def _serve(self, conn: socket.socket) -> None:
+        try:
+            conn.settimeout(5.0)
+            data = b""
+            while b"\r\n\r\n" not in data and len(data) < 8192:
+                chunk = conn.recv(1024)
+                if not chunk:
+                    return
+                data += chunk
+            line = data.split(b"\r\n", 1)[0].decode("ascii", "replace")
+            parts = line.split()
+            path = parts[1] if len(parts) >= 2 else ""
+            if parts and parts[0] == "GET" \
+                    and path.split("?", 1)[0] == "/metrics":
+                body = self._dvm.prometheus().encode("utf-8")
+                head = (
+                    "HTTP/1.0 200 OK\r\n"
+                    "Content-Type: text/plain; version=0.0.4; "
+                    "charset=utf-8\r\n"
+                    f"Content-Length: {len(body)}\r\n\r\n"
+                )
+            else:
+                body = b"not found\n"
+                head = ("HTTP/1.0 404 Not Found\r\n"
+                        "Content-Type: text/plain\r\n"
+                        f"Content-Length: {len(body)}\r\n\r\n")
+            conn.sendall(head.encode("ascii") + body)
+        except OSError:
+            return  # scraper went away mid-request: its own problem
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def close(self) -> None:
+        if self.closed:
+            return
+        self.closed = True
+        try:
+            self._srv.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+        deadline = time.monotonic() + 5.0
+        self._acceptor.join(max(0.0, deadline - time.monotonic()))
+        with self._lock:
+            threads = list(self._threads)
+        for t in threads:
+            t.join(max(0.0, deadline - time.monotonic()))
+
+
 def _pkg_root() -> str:
     return os.path.dirname(os.path.dirname(
         os.path.dirname(os.path.abspath(__file__))
@@ -153,12 +275,14 @@ class _Job:
     bookkeeping, and the IOF client connection."""
 
     def __init__(self, job_id: str, size: int, cmds: list[list[str]],
-                 ft: bool, mca: list, session: str, conn, conn_lock):
+                 ft: bool, mca: list, session: str, conn, conn_lock,
+                 metrics: bool = False):
         self.id = job_id
         self.size = size
         self.cmds = cmds
         self.ft = ft
         self.mca = mca
+        self.metrics = metrics
         self.session = session
         self.conn = conn              # IOF/exit stream target
         self.conn_lock = conn_lock
@@ -189,15 +313,27 @@ class Dvm(pmix_mod.FramedRpcServer):
     (``[job]``/``[io]``/``[note]``/``[exit]`` frames)."""
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
-                 pmix_port: int = 0, session_tag: str | None = None):
+                 pmix_port: int = 0, session_tag: str | None = None,
+                 metrics_port: int | None = None):
         self.host = host
         self.store = pmix_mod.PmixStore()
         self.pmix = pmix_mod.PmixServer(host, pmix_port, store=self.store)
+        self.metrics_http: MetricsHttpListener | None = None
         try:
             super().__init__(host, port, "dvm", backlog=16)
         except OSError:
             self.pmix.close()
             raise
+        if metrics_port is not None:
+            # scrape endpoint OFF by default: binding a port is an
+            # explicit operator decision (--metrics-port)
+            try:
+                self.metrics_http = MetricsHttpListener(
+                    self, host, int(metrics_port))
+            except OSError:
+                self.pmix.close()
+                super().close()
+                raise
         self.session = session_tag or f"d{self.address[1]}"
         self._stop_evt = threading.Event()
         self._jobs: dict[str, _Job] = {}
@@ -249,6 +385,10 @@ class Dvm(pmix_mod.FramedRpcServer):
             job = self._job(req[1])
             with job.lock:
                 return {int(r): p.pid for r, p in job.procs.items()}
+        if op == "metrics":
+            return self._metrics_view(
+                str(req[1]), None if len(req) < 3 or req[2] is None
+                else int(req[2]))
         if op == "respawn":
             return self._handle_respawn(req[1], [int(r) for r in req[2]])
         if op == "stop":
@@ -261,6 +401,109 @@ class Dvm(pmix_mod.FramedRpcServer):
         if job is None:
             raise errors.ArgError(f"zprted: unknown job {job_id!r}")
         return job
+
+    # -- metrics aggregation ----------------------------------------------
+
+    def _metrics_ranks(self, ns: str) -> dict[int, dict]:
+        """Per-rank published metrics of one namespace, staleness-
+        stamped (``staleness_s``: daemon wall clock minus the
+        snapshot's publish stamp), with each rank's flight-recorder
+        window attached when one was published."""
+        now = time.time()
+        ranks: dict[int, dict] = {}
+        for key, payload in self.store.lookup(ns, "metrics:").items():
+            try:
+                rank = int(key.rsplit(":", 1)[1])
+                rec = dict(payload)
+            except (ValueError, TypeError):
+                continue  # foreign key shape: not a publisher's
+            rec["staleness_s"] = max(0.0, now - float(rec.get("t", now)))
+            ranks[rank] = rec
+        for key, win in self.store.lookup(ns, "flightrec:").items():
+            try:
+                rank = int(key.rsplit(":", 1)[1])
+            except ValueError:
+                continue
+            ranks.setdefault(rank, {})["flightrec"] = win
+        return ranks
+
+    def _metrics_view(self, ns: str, rank: int | None = None):
+        """The ``metrics`` RPC: one rank's record, or the whole job —
+        every rank's record plus the job-aggregated counter view
+        (counters summed, watermarks maxed)."""
+        ranks = self._metrics_ranks(ns)
+        if not ranks:
+            raise errors.ArgError(
+                f"zprted metrics: no metrics published for job {ns!r} "
+                "(launch with metrics=True / ZMPI_METRICS=1)")
+        if rank is not None:
+            if rank not in ranks:
+                raise errors.ArgError(
+                    f"zprted metrics: rank {rank} of job {ns!r} has "
+                    "published nothing")
+            return ranks[rank]
+        aggregate: dict[str, int] = {}
+        watermarks: set[str] = set()
+        for rec in ranks.values():
+            watermarks.update(rec.get("watermark") or ())
+            for name, value in (rec.get("counters") or {}).items():
+                if name in watermarks:
+                    aggregate[name] = max(aggregate.get(name, 0), value)
+                else:
+                    aggregate[name] = aggregate.get(name, 0) + value
+        return {"job": ns, "ranks": ranks, "aggregate": aggregate}
+
+    @staticmethod
+    def _prom_name(name: str) -> str:
+        """Metric-name charset is [a-zA-Z0-9_:]; anything else (a
+        templated family like ``comm_<name>_coll_calls`` instantiated
+        with a dashed communicator name) collapses to ``_`` — one bad
+        counter name must not invalidate the whole scrape body."""
+        return re.sub(r"[^a-zA-Z0-9_:]", "_", name)
+
+    @staticmethod
+    def _prom_label(value: str) -> str:
+        """Label-value escaping per the text exposition format
+        (backslash, double-quote, newline)."""
+        return (str(value).replace("\\", "\\\\").replace('"', '\\"')
+                .replace("\n", "\\n"))
+
+    def prometheus(self) -> str:
+        """Text exposition of every namespace's published snapshots:
+        ``zmpi_spc_<counter>{job="...",rank="..."} value`` plus a
+        staleness gauge per rank — the ``GET /metrics`` body.  Samples
+        are grouped by METRIC family (one contiguous block after each
+        TYPE line, the exposition format's rule), not by rank — strict
+        OpenMetrics-mode scrapers reject interleaved families."""
+        # metric -> (kind, [sample lines]); insertion builds the rows,
+        # emission walks families sorted
+        families: dict[str, tuple[str, list[str]]] = {}
+
+        def sample(metric: str, kind: str, labels: str, value) -> None:
+            fam = families.setdefault(metric, (kind, []))
+            fam[1].append(f"{metric}{labels} {value}")
+
+        for ns in self.store.namespaces():
+            ranks = self._metrics_ranks(ns)
+            for rank in sorted(ranks):
+                rec = ranks[rank]
+                counters = rec.get("counters") or {}
+                watermarks = set(rec.get("watermark") or ())
+                labels = (f'{{job="{self._prom_label(ns)}",'
+                          f'rank="{rank}"}}')
+                for name in sorted(counters):
+                    sample(f"zmpi_spc_{self._prom_name(name)}",
+                           "gauge" if name in watermarks else "counter",
+                           labels, counters[name])
+                if "staleness_s" in rec:
+                    sample("zmpi_metrics_age_seconds", "gauge", labels,
+                           f"{rec['staleness_s']:.3f}")
+        lines: list[str] = []
+        for metric in sorted(families):
+            kind, rows = families[metric]
+            lines.append(f"# TYPE {metric} {kind}")
+            lines.extend(rows)
+        return "\n".join(lines) + ("\n" if lines else "")
 
     def _stream(self, job: _Job, payload: list) -> None:
         """One frame to the job's IOF client; a departed client must
@@ -299,6 +542,10 @@ class Dvm(pmix_mod.FramedRpcServer):
         })
         if job.ft:
             env["ZMPI_FT"] = "1"
+        if job.metrics:
+            # the opt-in metrics plane: every rank of this job runs the
+            # spc publisher against the resident store
+            env["ZMPI_METRICS"] = "1"
         if rejoin is not None:
             # recovery-window metadata: the bumped namespace generation
             # and the whole batch of co-respawned ranks, so each
@@ -359,6 +606,7 @@ class Dvm(pmix_mod.FramedRpcServer):
                 [tuple(m) for m in (spec.get("mca") or [])],
                 f"{self.session}_{job_id}",
                 conn, conn_lock,
+                metrics=bool(spec.get("metrics")),
             )
             self._jobs[job_id] = job
         # the namespace IS the jobid: ranks modex through the resident
@@ -599,6 +847,8 @@ class Dvm(pmix_mod.FramedRpcServer):
         for job in jobs:
             self._teardown_job(job, rc=143)
             self._finalize_job(job)
+        if self.metrics_http is not None:
+            self.metrics_http.close()
         self.pmix.close()
         super().close()
         _sweep_shm(self.session)
@@ -656,7 +906,7 @@ class DvmClient:
     def launch(self, n: int, argv: list[str],
                mca: list | None = None, ft: bool = False,
                timeout: float | None = None, tag_output: bool = True,
-               stdout=None, stderr=None) -> int:
+               stdout=None, stderr=None, metrics: bool = False) -> int:
         """Launch an n-rank job into the resident VM; streams its IOF
         and returns the job exit code (the ``zmpirun`` surface, minus
         the per-job launcher)."""
@@ -667,7 +917,7 @@ class DvmClient:
         stderr = stderr if stderr is not None else sys.stderr
         spec = {"n": int(n), "argv": [str(a) for a in argv],
                 "mca": [list(m) for m in (mca or [])], "ft": bool(ft),
-                "timeout": timeout}
+                "timeout": timeout, "metrics": bool(metrics)}
         # no client-imposed deadline without an explicit job timeout:
         # the daemon enforces its own (tunable) dvm_job_timeout and
         # ALWAYS sends the exit frame, and a daemon crash surfaces as
@@ -716,6 +966,15 @@ class DvmClient:
     def stat(self) -> dict:
         return self._call(["stat"])
 
+    def metrics(self, job_id: str, rank: int | None = None,
+                timeout: float = 10.0) -> dict:
+        """Fleet-visible metrics: one rank's published snapshot, or the
+        whole job's per-rank + aggregated view (staleness-stamped)."""
+        req: list = ["metrics", str(job_id)]
+        if rank is not None:
+            req.append(int(rank))
+        return self._call(req, wait=timeout)
+
     def ping(self) -> bool:
         return self._call(["ping"]) == "pong"
 
@@ -744,10 +1003,19 @@ def main(args: list[str] | None = None) -> int:
                     help="control (RPC) port; 0 = ephemeral")
     ap.add_argument("--pmix-port", type=int, default=0,
                     help="PMIx store port; 0 = ephemeral")
+    ap.add_argument("--metrics-port", type=int, default=None,
+                    help="bind the HTTP GET /metrics scrape endpoint "
+                         "(Prometheus text exposition) on this port; "
+                         "0 = ephemeral; off by default")
     ns = ap.parse_args(args)
-    dvm = Dvm(ns.host, ns.port, ns.pmix_port)
+    dvm = Dvm(ns.host, ns.port, ns.pmix_port,
+              metrics_port=ns.metrics_port)
+    extra = ""
+    if dvm.metrics_http is not None:
+        extra = (f" metrics={dvm.host}:"
+                 f"{dvm.metrics_http.address[1]}")
     print(f"zprted ready dvm={dvm.host}:{dvm.address[1]} "
-          f"pmix={dvm.host}:{dvm.pmix.address[1]}", flush=True)
+          f"pmix={dvm.host}:{dvm.pmix.address[1]}{extra}", flush=True)
 
     def on_signal(signum, _frame):
         dvm.stop()

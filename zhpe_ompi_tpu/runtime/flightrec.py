@@ -1,0 +1,183 @@
+"""Flight recorder — a fixed-size ring of typed runtime events.
+
+The PERUSE-adjacent half of the observability plane: where SPC counters
+say *how much* happened, the flight recorder says *what, in order* — a
+lock-cheap per-process ring of small typed events recorded at the
+existing seams (send/recv post, matching, collective phase enter/exit,
+FT classification, revoke, respawn).  When a typed failure
+classification lands, the metrics publisher (``runtime/spc.py``)
+publishes the survivor's last-N window to the PMIx store under
+``flightrec:<job>:<rank>`` — a postmortem of a real-process kill shows
+what every survivor was doing at classification time, with the
+classification event itself as the tail entry.
+
+Cost discipline mirrors :mod:`.peruse`: the whole recorder is ARMED
+only while a metrics publisher (or a test) holds the refcount —
+``arm()``/``disarm()`` flip the module gate AND the PERUSE match-event
+subscription together, so a process with no publisher pays exactly one
+false module-attribute check per seam and the matching hot path pays
+nothing at all.  While armed, a seam pays one slot write under a plain
+lock (no allocation beyond the event dict, no I/O, no waiting).
+
+The ring OVERWRITES: an event that displaces an unread slot counts in
+the ``flightrec_events_dropped`` SPC counter (events lost to the
+postmortem window — a window smaller than the traffic between
+snapshots is visible, not silent).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any
+
+from ..mca import var as mca_var
+from . import peruse, spc
+
+mca_var.register(
+    "flightrec_capacity", 256,
+    "Slots in the per-process flight-recorder ring (the last-N window "
+    "published to the store on a typed failure classification); the "
+    "ring overwrites, counting displaced events in "
+    "flightrec_events_dropped",
+    type=int,
+)
+
+# event types (the seams that record them)
+SEND = "send"              # pt2pt/tcp.py send/isend dispatch
+RECV = "recv"              # pt2pt/tcp.py recv post
+MATCH = "match"            # matching engines, via the PERUSE events
+COLL_ENTER = "coll_enter"  # coll/han.py schedule + phase entry
+COLL_EXIT = "coll_exit"    # coll/han.py schedule + phase completion
+FT_CLASS = "ft_class"      # ft/ulfm.py FailureState classification
+REVOKE = "revoke"          # ft/ulfm.py cid revocation
+RESPAWN = "respawn"        # ft/recovery.py respawn pipelines
+
+ALL_EVENTS = (SEND, RECV, MATCH, COLL_ENTER, COLL_EXIT, FT_CLASS,
+              REVOKE, RESPAWN)
+
+#: hot-path gate (the peruse cost discipline): seams check this bare
+#: module attribute before paying the record() call.  False until a
+#: metrics publisher arms the recorder — a ring nobody will ever
+#: publish is not worth one event dict per message
+active = False
+
+
+class FlightRecorder:
+    """The ring itself: ``capacity`` fixed slots, a monotonically
+    increasing sequence, overwrite-with-accounting.  The module-level
+    recorder is per-process (thread ranks share it, exactly like the
+    SPC registry); tests construct private instances."""
+
+    def __init__(self, capacity: int | None = None):
+        cap = int(mca_var.get("flightrec_capacity", 256)) \
+            if capacity is None else int(capacity)
+        self._cap = max(8, cap)
+        self._slots: list[dict | None] = [None] * self._cap
+        self._n = 0  # total events ever recorded (next seq)
+        self._lock = threading.Lock()
+
+    @property
+    def capacity(self) -> int:
+        return self._cap
+
+    def record(self, etype: str, **fields: Any) -> None:
+        """One typed event: seq + wall-clock stamp + the caller's small
+        DSS-packable fields.  Lock-cheap: slot write and index bump."""
+        evt = {"t": time.time(), "type": etype}
+        evt.update(fields)
+        with self._lock:
+            i = self._n % self._cap
+            dropped = self._slots[i] is not None
+            evt["seq"] = self._n
+            self._slots[i] = evt
+            self._n += 1
+        if dropped:
+            spc.record("flightrec_events_dropped")
+
+    def window(self, n: int | None = None) -> list[dict]:
+        """The last ``n`` (default: whole ring) events in record order —
+        the postmortem view the publisher ships to the store."""
+        with self._lock:
+            total = self._n
+            have = min(total, self._cap)
+            want = have if n is None else min(int(n), have)
+            out = []
+            for seq in range(total - want, total):
+                evt = self._slots[seq % self._cap]
+                if evt is not None:
+                    out.append(dict(evt))
+        return out
+
+    def total(self) -> int:
+        """Events ever recorded (seq of the next event)."""
+        with self._lock:
+            return self._n
+
+    def clear(self) -> None:
+        with self._lock:
+            self._slots = [None] * self._cap
+            self._n = 0
+
+
+_recorder = FlightRecorder()
+
+
+def record(etype: str, **fields: Any) -> None:
+    """Record into the process-global ring (no-op while ``active`` is
+    False — the seams' one-boolean gate)."""
+    if active:
+        _recorder.record(etype, **fields)
+
+
+def window(n: int | None = None) -> list[dict]:
+    return _recorder.window(n)
+
+
+def total() -> int:
+    return _recorder.total()
+
+
+def clear() -> None:
+    _recorder.clear()
+
+
+# -- arming (the module gate + PERUSE match events) -------------------------
+#
+# Refcounted: each metrics publisher arms on start and disarms on
+# stop, so both `active` and `peruse.active` return to False once the
+# last publisher is gone (the "inactive costs nothing" contract of
+# runtime/peruse.py, applied to the whole recorder).
+
+_arm_lock = threading.Lock()
+_arm_count = 0
+
+
+def _on_match(event: str, **info: Any) -> None:
+    record(MATCH, src=int(info.get("src", -1)),
+           tag=int(info.get("tag", -1)),
+           unexpected=event == peruse.REQ_MATCH_UNEX)
+
+
+def arm() -> None:
+    """Arm the recorder (refcounted): the seams' module gate flips on
+    and the PERUSE match events are subscribed."""
+    global _arm_count, active
+    with _arm_lock:
+        _arm_count += 1
+        if _arm_count == 1:
+            active = True
+            peruse.subscribe(peruse.MSG_MATCH_POSTED_REQ, _on_match)
+            peruse.subscribe(peruse.REQ_MATCH_UNEX, _on_match)
+
+
+def disarm() -> None:
+    global _arm_count, active
+    with _arm_lock:
+        if _arm_count == 0:
+            return
+        _arm_count -= 1
+        if _arm_count == 0:
+            active = False
+            peruse.unsubscribe(peruse.MSG_MATCH_POSTED_REQ, _on_match)
+            peruse.unsubscribe(peruse.REQ_MATCH_UNEX, _on_match)

@@ -74,6 +74,22 @@ def live_servers() -> list[str]:
     ]
 
 
+def stale_metric_keys() -> list[str]:
+    """Published ``metrics:*`` / ``flightrec:*`` / ``metrics_base:*``
+    keys still held in any tracked store at session end — namespace
+    destroy drops a job's whole keyspace, so anything here is a
+    metrics-plane leak (a publisher outliving its job, or a bench
+    namespace nobody tore down)."""
+    out = []
+    for store in list(_live_stores):
+        for ns in store.namespaces():
+            for key in store.lookup(ns):
+                if key.startswith(("metrics:", "flightrec:",
+                                   "metrics_base:")):
+                    out.append(f"pmix-key:{ns}:{key}")
+    return out
+
+
 def stale_namespaces() -> list[str]:
     """Namespace state still held in any tracked store at session end —
     the daemon destroys a job's namespace when the job ends and
@@ -380,6 +396,23 @@ class PmixStore:
                         f"{space.size} entered)"
                     )
                 self._cv.wait(min(left, 0.25))
+
+    def lookup(self, ns: str, prefix: str | None = None
+               ) -> dict[str, Any]:
+        """Non-blocking introspection over a namespace's PUBLISHED keys
+        (optionally prefix-filtered) — the daemon's metrics aggregation
+        and the hygiene gates read through this.  Unlike :meth:`get`
+        it never waits and never counts in ``pmix_gets`` (it is a
+        store-side view, not rank verb traffic); an unknown namespace
+        is an empty dict, not an error."""
+        with self._cv:
+            space = self._ns.get(ns)
+            if space is None:
+                return {}
+            return {
+                key: value for key, (_gen, value) in space.kv.items()
+                if prefix is None or key.startswith(prefix)
+            }
 
     def bump_generation(self, ns: str) -> int:
         """Open a new generation window (the daemon bumps ONCE per

@@ -180,17 +180,69 @@ sites in lockstep):
   quiet/fence boundary on the device plane).
 - ``io_nonblocking_ops`` — nonblocking file operations submitted to
   the fbtl async pool.
+
+Observability-plane counters (the fleet-visible metrics plane —
+recorded by this module's :class:`MetricsPublisher` and by
+``runtime/flightrec.py``):
+
+- ``spc_publishes`` — metrics snapshots published into the PMIx store
+  by the rank-side publisher (the periodic interval ticks plus the
+  guaranteed final flush at finalize/close — a short-lived job is
+  never invisible).  The interval is ``spc_publish_interval_ms``
+  (default 1000), clamped to a 250 ms floor: the publisher must never
+  become sub-interval polling on a 1-CPU host.
+- ``flightrec_events_dropped`` — flight-recorder ring overwrites:
+  typed events displaced from the fixed-size postmortem window before
+  any snapshot shipped them (``flightrec_capacity`` slots).  A window
+  smaller than the traffic between publishes is visible here, not
+  silent.
+
+Templated counter families (dynamic names routed through literal
+templates at the call site; the zlint ZL009 publisher-seam rule
+matches recorded names against these — an f-string counter whose
+template is absent here is an undocumented metric the moment the
+publisher ships a snapshot):
+
+- ``coll_<op>_calls`` / ``coll_<op>_bytes`` — per-operation collective
+  monitoring interposition (``coll/monitoring.py``, default off).
+- ``comm_<name>_coll_calls`` — per-communicator collective calls
+  (the same interposition, keyed by communicator name).
 """
 
 from __future__ import annotations
 
 import threading
+import time
+import weakref
 from collections import defaultdict
+
+from ..mca import output as mca_output
+from ..mca import var as mca_var
+
+_stream = mca_output.open_stream("spc")
+
+mca_var.register(
+    "spc_publish_interval_ms", 1000,
+    "Milliseconds between metrics-plane snapshot publishes into the "
+    "PMIx store (rank-side publisher, armed by ZMPI_METRICS); clamped "
+    "to a 250 ms floor — the publisher must never become sub-interval "
+    "polling (the single-CPU container contract)",
+    type=int,
+)
+
+# the metrics-plane counters form their own pvar family (spc.metrics)
+mca_var.register_family("spc_publishes", "metrics")
+mca_var.register_family("flightrec", "metrics")
 
 _counters: dict[str, int] = defaultdict(int)
 _lock = threading.Lock()
+_reset_epoch = 0
 
 WATERMARK = {"max_bytes_in_collective", "match_unexpected_max_depth"}
+
+#: publisher interval floor (seconds): below this a fleet of publishers
+#: degenerates into sub-interval polling on shared cores
+PUBLISH_FLOOR_S = 0.25
 
 
 def record(name: str, value: int = 1) -> None:
@@ -212,5 +264,239 @@ def snapshot() -> dict[str, int]:
 
 
 def reset() -> None:
+    """Clear every counter and advance the reset epoch — an open MPI_T
+    counter handle observes the epoch change and rebases instead of
+    reading a negative delta (its baseline outlives the reset)."""
+    global _reset_epoch
     with _lock:
         _counters.clear()
+        _reset_epoch += 1
+
+
+def reset_epoch() -> int:
+    """Monotonic count of :func:`reset` calls (the pvar-handle rebase
+    signal)."""
+    with _lock:
+        return _reset_epoch
+
+
+_documented: frozenset[str] | None = None
+
+
+def documented_counters() -> frozenset[str]:
+    """Exact counter names from this module's doc table, parsed with
+    the same parser zlint's ZL006 doc-parity rule uses — the
+    DETERMINISTIC pvar universe: MPI_T discovery enumerates this table
+    (plus whatever dynamic names actually fired), so ``pvar_get_num``
+    is stable from init instead of growing with traffic, and the
+    metrics publisher zero-fills these names so every documented
+    counter is fleet-visible per rank even before it first fires."""
+    global _documented
+    if _documented is None:
+        from ..tools.zlint.rules import parse_counter_doc
+
+        names, _templates = parse_counter_doc(__doc__ or "")
+        _documented = frozenset(names)
+    return _documented
+
+
+# ========================= rank-side publisher =============================
+
+# hygiene registry (consumed by the conftest session gate): publisher
+# threads must die with the proc that started them
+_live_publishers: weakref.WeakSet = weakref.WeakSet()
+
+
+def live_publisher_threads() -> list[str]:
+    """Metrics-publisher threads still alive — must be [] once every
+    proc's close() ran (the final-flush-then-stop contract)."""
+    return [
+        f"spc-publisher:{p.name}"
+        for p in list(_live_publishers)
+        if p.is_alive()
+    ]
+
+
+class MetricsPublisher(threading.Thread):
+    """The rank-side half of the metrics plane: a daemon thread that
+    publishes generation-tagged ``metrics:<job>:<rank>`` snapshots
+    (full SPC table zero-filled from the documented universe, plus
+    watermark labels and live state pvars) into the PMIx store every
+    ``spc_publish_interval_ms`` (>= 250 ms), with one snapshot at
+    start and a guaranteed final flush at :meth:`stop` — a job shorter
+    than one interval is still visible.  On a typed failure
+    classification the owning proc's failure listener calls
+    :meth:`on_classification`, which ships the flight recorder's
+    last-N window under ``flightrec:<job>:<rank>`` (the classification
+    event is the tail entry by construction: the FailureState records
+    it before notifying listeners).
+
+    The store traffic rides one :class:`~zhpe_ompi_tpu.runtime.pmix.
+    PmixClient` (its own socket; the client lock serializes the
+    interval thread against a classification-path flightrec publish).
+    Waits are event-based (``Event.wait(interval)``) — never polling.
+    """
+
+    def __init__(self, pmix_addr, namespace: str, rank: int):
+        super().__init__(
+            daemon=True, name=f"spc-pub-{namespace}-{rank}",
+        )
+        from . import pmix as pmix_mod
+
+        self.namespace = str(namespace)
+        self.rank = int(rank)
+        var_ms = int(mca_var.get("spc_publish_interval_ms", 1000))
+        # the 250 ms floor is a hard contract, not a default
+        self.interval = max(PUBLISH_FLOOR_S, var_ms / 1000.0)
+        self._client = pmix_mod.PmixClient(pmix_addr, timeout=10.0)
+        self._halt = threading.Event()
+        self._dead = False
+        self._launched = False
+        self._seq = 0
+        self._seq_lock = threading.Lock()
+        # the flight recorder is armed for this publisher's whole life
+        # (ctor to stop), so the postmortem window covers everything
+        # the owning proc did — not just what happened after the
+        # publisher thread got scheduled
+        from . import flightrec
+
+        flightrec.arm()
+        self._armed = True
+        _live_publishers.add(self)
+
+    # -- payloads ---------------------------------------------------------
+
+    def _snapshot_payload(self, final: bool) -> dict:
+        counters = {name: 0 for name in documented_counters()}
+        counters.update(snapshot())
+        pvars: dict[str, float] = {}
+        try:
+            from ..tools import mpit
+
+            # only the registered live-subsystem pvars: rebuilding the
+            # whole counter universe per tick would be pure allocation
+            # on a 250 ms-floor periodic path
+            for name, d in mpit.registered_pvars().items():
+                if d.klass != mpit.PVAR_STATE:
+                    continue
+                try:
+                    v = d.reader()
+                except Exception as e:
+                    mca_output.verbose(
+                        3, _stream, "metrics publisher: pvar %s reader "
+                        "raised (%s); row skipped", name, e,
+                    )
+                    continue  # a reader over torn-down state
+                if isinstance(v, (int, float)):
+                    pvars[name] = v
+        except Exception as e:  # discovery failure degrades to counters-only
+            mca_output.verbose(
+                2, _stream, "metrics publisher %s: pvar sweep failed "
+                "(%s); snapshot carries counters only", self.name, e,
+            )
+        with self._seq_lock:
+            seq = self._seq
+            self._seq += 1
+        return {
+            "seq": seq,
+            "t": time.time(),
+            "interval_ms": int(self.interval * 1000),
+            "final": bool(final),
+            "counters": counters,
+            "watermark": sorted(n for n in counters if n in WATERMARK),
+            "pvars": pvars,
+        }
+
+    def _put(self, key: str, payload) -> None:
+        self._client.put(self.namespace, self.rank, key, payload)
+        self._client.commit(self.namespace, self.rank)
+
+    def publish(self, final: bool = False) -> bool:
+        """One snapshot into the store; False once the store refuses
+        (namespace destroyed / daemon gone — the publisher is outliving
+        its job and stops)."""
+        if self._dead:
+            return False
+        from ..core import errors
+        from ..runtime import spc  # self, for the ZL006 parity sweep
+
+        # counted BEFORE the snapshot is built, so every shipped
+        # snapshot carries its own publish (the very first one already
+        # reads spc_publishes == 1 — the acceptance gate's "rises")
+        spc.record("spc_publishes")
+        payload = self._snapshot_payload(final)
+        try:
+            self._put(f"metrics:{self.namespace}:{self.rank}", payload)
+        except errors.MpiError as e:
+            self._dead = True
+            mca_output.verbose(
+                2, _stream, "metrics publisher %s: store refused "
+                "publish (%s); stopping", self.name, e,
+            )
+            return False
+        return True
+
+    def on_classification(self, failed_rank: int, cause: str) -> None:
+        """Failure-listener hook: ship the flight-recorder window under
+        ``flightrec:<job>:<rank>``.  The FT_CLASS event for
+        ``failed_rank`` is already in the ring (FailureState records
+        before it notifies), so it is the window's tail entry."""
+        if self._dead:
+            return
+        from ..core import errors
+        from . import flightrec
+
+        try:
+            self._put(f"flightrec:{self.namespace}:{self.rank}",
+                      flightrec.window())
+        except errors.MpiError as e:
+            mca_output.verbose(
+                2, _stream, "metrics publisher %s: flightrec publish "
+                "failed (%s)", self.name, e,
+            )
+
+    # -- lifecycle --------------------------------------------------------
+
+    def run(self) -> None:
+        try:
+            if not self.publish():  # the start-of-life snapshot
+                return
+            while not self._halt.wait(self.interval):
+                if not self.publish():
+                    return
+            self.publish(final=True)  # the guaranteed final flush
+        finally:
+            self._client.close()
+
+    def start(self) -> None:
+        # _launched flips only AFTER start() returns: a start() that
+        # raises (thread exhaustion, interpreter shutdown) must leave
+        # stop() on the never-started path — joining an unstarted
+        # thread raises and would mask the ctor's original error (the
+        # start()-raises shape PR 10 hardened in _track_thread)
+        super().start()
+        self._launched = True
+
+    def stop(self, timeout: float = 5.0) -> None:
+        """Signal the final flush and join (bounded) — the owning
+        proc's close() path.  A publisher that was never started (a
+        constructor that failed later) still owns its client socket
+        and its flight-recorder arm refcount."""
+        self._halt.set()
+        if self._armed:
+            from . import flightrec
+
+            flightrec.disarm()
+            self._armed = False
+        if not self._launched:
+            self._client.close()
+            return
+        self.join(timeout)
+
+    def abort(self, timeout: float = 5.0) -> None:
+        """The crash path (``sever()``): stop WITHOUT the final flush —
+        a clean final snapshot from a simulated corpse would lie to
+        the fleet — but the thread still dies with the proc."""
+        self._dead = True
+        self.stop(timeout)
+

@@ -220,19 +220,22 @@ def launch(n: int, argv: list[str], host: str = "127.0.0.1",
 def launch_dvm(dvm: str, n: int, argv: list[str],
                mca: list[tuple[str, str]] | None = None,
                timeout: float | None = None, tag_output: bool = True,
-               stdout=None, stderr=None, ft: bool = False) -> int:
+               stdout=None, stderr=None, ft: bool = False,
+               metrics: bool = False) -> int:
     """Launch a job INTO a resident runtime daemon (``zmpirun --dvm``):
     the zprted VM hosts the PMIx store and the children, streams their
     IOF back here, and outlives the job — no per-job rendezvous, no
     name server, no launcher teardown (the prte DVM shape;
-    :mod:`zhpe_ompi_tpu.runtime.dvm`)."""
+    :mod:`zhpe_ompi_tpu.runtime.dvm`).  ``metrics=True`` exports
+    ``ZMPI_METRICS=1`` to every rank: each publishes SPC snapshots into
+    the resident store (the fleet-visible metrics plane)."""
     from ..runtime.dvm import DvmClient
 
     client = DvmClient(dvm)
     try:
         return client.launch(n, argv, mca=mca, ft=ft, timeout=timeout,
                              tag_output=tag_output, stdout=stdout,
-                             stderr=stderr)
+                             stderr=stderr, metrics=metrics)
     finally:
         client.close()
 
@@ -446,6 +449,11 @@ def main(args: list[str] | None = None) -> int:
                     help="fault-tolerant job: ranks build ft=True "
                          "endpoints (detector, typed failures, daemon "
                          "fault events under --dvm)")
+    ap.add_argument("--metrics", action="store_true",
+                    help="metrics plane (--dvm only): every rank "
+                         "publishes its SPC counters into the resident "
+                         "store (ZMPI_METRICS=1), scrapeable via the "
+                         "daemon's metrics RPC / --metrics-port")
     ap.add_argument("argv", nargs=argparse.REMAINDER,
                     help="program and its arguments")
     raw = list(sys.argv[1:] if args is None else args)
@@ -468,10 +476,11 @@ def main(args: list[str] | None = None) -> int:
         # later and ignoring them would silently drop user intent
         if (more.host != "127.0.0.1" or more.mca or
                 more.timeout is not None or more.no_tag_output or
-                more.dvm or more.ft):
+                more.dvm or more.ft or more.metrics):
             ap.error(
-                "--host/--mca/--timeout/--no-tag-output/--dvm/--ft are "
-                "job-global: pass them in the first app context"
+                "--host/--mca/--timeout/--no-tag-output/--dvm/--ft/"
+                "--metrics are job-global: pass them in the first app "
+                "context"
             )
         apps.append((more.n, more.argv))
     # signal hygiene (main thread only — the CLI path): SIGINT/SIGTERM
@@ -495,7 +504,11 @@ def main(args: list[str] | None = None) -> int:
                 mca=[tuple(m) for m in first.mca],
                 timeout=first.timeout,
                 tag_output=not first.no_tag_output, ft=first.ft,
+                metrics=first.metrics,
             )
+        if first.metrics:
+            ap.error("--metrics needs the resident store: run with "
+                     "--dvm")
         return launch_mpmd(
             apps, host=first.host, mca=[tuple(m) for m in first.mca],
             timeout=first.timeout, tag_output=not first.no_tag_output,

@@ -1,4 +1,4 @@
-"""zlint rules ZL001–ZL008.
+"""zlint rules ZL001–ZL009.
 
 Every rule encodes an invariant a REAL bug in this repo's history
 violated; the docstrings cite the incident so the rule's teeth are
@@ -22,6 +22,47 @@ from .engine import (
 )
 
 _UNFOLDABLE = const_fold.UNFOLDABLE
+
+
+# -- the SPC doc-table parser (shared surface) -------------------------------
+#
+# One parser serves three consumers: ZL006's exact-name parity audit,
+# ZL009's publisher-seam audit of templated/dynamic names, and the
+# RUNTIME's deterministic MPI_T discovery + metrics-publisher zero-fill
+# (``runtime/spc.py::documented_counters``) — so "documented" means the
+# same thing to the linter and to the live tool plane.
+
+#: ``- ``name`` [/ ``name``...]`` doc-table entry; names may carry
+#: ``<placeholder>`` segments (templated families)
+_DOC_ENTRY_RE = re.compile(
+    r"^- (``[a-zA-Z0-9_<>]+``(?: */ *``[a-zA-Z0-9_<>]+``)*)")
+_DOC_TICKED_RE = re.compile(r"``([a-zA-Z0-9_<>]+)``")
+
+
+def parse_counter_doc(doc: str) -> tuple[set[str], set[str]]:
+    """Split a counter doc table into (exact names, templated
+    families).  A templated family carries ``<...>`` placeholders
+    (``coll_<op>_calls``) — the documented shape of a dynamic name
+    routed through a literal template at its call site."""
+    names: set[str] = set()
+    templates: set[str] = set()
+    for line in doc.splitlines():
+        m = _DOC_ENTRY_RE.match(line.strip())
+        if not m:
+            continue
+        for ticked in _DOC_TICKED_RE.findall(m.group(1)):
+            (templates if "<" in ticked else names).add(ticked)
+    return names, templates
+
+
+_TEMPLATE_HOLE_RE = re.compile(r"<[^<>]*>")
+
+
+def template_shape(template: str) -> str:
+    """Normalize a templated name (``coll_<op>_calls`` or an f-string's
+    ``coll_<*>_calls``) so documented and recorded shapes compare
+    exactly: every placeholder collapses to one hole marker."""
+    return _TEMPLATE_HOLE_RE.sub("\x00", template)
 
 
 class Rule:
@@ -447,9 +488,6 @@ class SpcDocParity(Rule):
     title = "spc-doc-parity"
     guards = "counter-gated CI: undocumented/unrecorded counters lie"
 
-    _DOC_ENTRY = re.compile(r"^- (``[a-zA-Z0-9_]+``(?: */ *``[a-zA-Z0-9_]+``)*)")
-    _TICKED = re.compile(r"``([a-zA-Z0-9_]+)``")
-
     def __init__(self):
         self.recorded: dict[str, tuple[Module, ast.AST]] = {}
         #: string literals in modules that route DYNAMIC counter names
@@ -488,14 +526,12 @@ class SpcDocParity(Rule):
         return []
 
     def documented(self) -> set[str]:
+        """Exact names only — templated families are ZL009's concern
+        (they cannot satisfy nor demand an exact-name parity row)."""
         if self.spc_mod is None:
             return set()
         doc = ast.get_docstring(self.spc_mod.tree) or ""
-        names: set[str] = set()
-        for line in doc.splitlines():
-            m = self._DOC_ENTRY.match(line.strip())
-            if m:
-                names.update(self._TICKED.findall(m.group(1)))
+        names, _templates = parse_counter_doc(doc)
         return names
 
     def finalize(self, mods: list[Module]) -> list[Finding]:
@@ -521,6 +557,184 @@ class SpcDocParity(Rule):
         self.recorded.clear()
         self.maybe_recorded.clear()
         self.spc_mod = None
+        return out
+
+
+# ----------------------------------------------------------------------
+class SpcPublisherSeam(Rule):
+    """ZL009 — DYNAMIC counter names must still resolve into the
+    documented table: the publisher seam ships ``spc.snapshot()``
+    verbatim, so a counter recorded under a computed name that no doc
+    entry covers becomes an undocumented metric on every dashboard the
+    moment the metrics plane publishes a snapshot.
+
+    ZL006 deliberately exempts dynamic first-args (a module routing
+    names through a literal table gets blanket literal-table credit) —
+    this rule closes that loophole by RESOLVING the dynamic shapes:
+
+    - ``spc.record(self._counter, n)`` → the assignments feeding
+      ``_counter`` in the module (one hop through module-level literal
+      containers, dict VALUES only) must all be documented exact names;
+    - ``spc.record(f"coll_{{op}}_calls", 1)`` → the f-string's template
+      must match a documented TEMPLATED family (``coll_<op>_calls``);
+    - a first-arg that resolves to NO literal at all is flagged as
+      unresolvable — route it through a literal table.
+
+    Active only when the scan set includes ``runtime/spc.py``
+    (the doc table anchor, like ZL006/ZL007).  Baseline kept empty.
+    """
+
+    id = "ZL009"
+    title = "spc-publisher-seam"
+    guards = ("PR 11: a dynamically-named counter publishes as an "
+              "undocumented metric")
+
+    def __init__(self):
+        self.spc_mod: Module | None = None
+        self.sites: list[tuple[Module, ast.Call, ast.AST]] = []
+
+    def visit(self, mod: Module) -> list[Finding]:
+        if mod.path_key.endswith("runtime/spc.py") \
+                or mod.path_key == "spc.py":
+            self.spc_mod = mod
+        for node in ast.walk(mod.tree):
+            if not (isinstance(node, ast.Call)
+                    and call_name(node) == "record"
+                    and call_receiver(node) == "spc" and node.args):
+                continue
+            arg0 = node.args[0]
+            if isinstance(arg0, ast.Constant):
+                continue  # exact literal: ZL006's beat
+            if isinstance(arg0, ast.IfExp) and all(
+                    isinstance(a, ast.Constant) for a in
+                    (arg0.body, arg0.orelse)):
+                continue  # literal-armed IfExp: ZL006 covers both arms
+            self.sites.append((mod, node, arg0))
+        return []
+
+    # -- dynamic-name resolution -----------------------------------------
+
+    @staticmethod
+    def _container_strings(node: ast.AST) -> list[str]:
+        """String literals a container literal contributes as counter
+        names: dict VALUES (keys are selectors, not names), every
+        element otherwise."""
+        values: list[ast.AST]
+        if isinstance(node, ast.Dict):
+            values = list(node.values)
+        elif isinstance(node, (ast.List, ast.Tuple, ast.Set)):
+            values = list(node.elts)
+        else:
+            values = [node]
+        out = []
+        for v in values:
+            for sub in ast.walk(v):
+                if isinstance(sub, ast.Constant) \
+                        and isinstance(sub.value, str):
+                    out.append(sub.value)
+        return out
+
+    @classmethod
+    def _rhs_strings(cls, mod: Module, rhs: ast.AST) -> list[str]:
+        """Literals an assignment RHS can produce: its own string
+        constants, plus — one hop — the values of any module-level
+        literal container it references by name
+        (``PLANE_COUNTERS.get(plane, "default")`` resolves to the
+        table's values and the default)."""
+        out: list[str] = []
+        for sub in ast.walk(rhs):
+            if isinstance(sub, ast.Constant) and isinstance(sub.value, str):
+                out.append(sub.value)
+            elif isinstance(sub, ast.Name):
+                for stmt in mod.tree.body:
+                    if isinstance(stmt, ast.Assign) and any(
+                            isinstance(t, ast.Name) and t.id == sub.id
+                            for t in stmt.targets):
+                        out.extend(cls._container_strings(stmt.value))
+        return out
+
+    @classmethod
+    def _resolve(cls, mod: Module, arg0: ast.AST
+                 ) -> "tuple[list[str], list[str]] | None":
+        """(exact candidates, template candidates) for a dynamic
+        first-arg, or None when nothing resolves to a literal."""
+        if isinstance(arg0, ast.JoinedStr):
+            shape = "".join(
+                v.value if isinstance(v, ast.Constant) else "<*>"
+                for v in arg0.values
+            )
+            return [], [shape]
+        if isinstance(arg0, ast.IfExp):
+            a = cls._resolve(mod, arg0.body)
+            b = cls._resolve(mod, arg0.orelse)
+            if a is None or b is None:
+                return None
+            return a[0] + b[0], a[1] + b[1]
+        if isinstance(arg0, ast.Constant) and isinstance(arg0.value, str):
+            return [arg0.value], []
+        target: str | None = None
+        if isinstance(arg0, ast.Name):
+            target = arg0.id
+        elif isinstance(arg0, ast.Attribute):
+            target = arg0.attr
+        if target is None:
+            # a computed first-arg used in place (`TABLE.get(k, "x")`,
+            # a subscript): its own literals + one-hop named tables
+            names = cls._rhs_strings(mod, arg0)
+            return (names, []) if names else None
+        names: list[str] = []
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+                continue
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            if not any(
+                (isinstance(t, ast.Name) and t.id == target)
+                or (isinstance(t, ast.Attribute) and t.attr == target)
+                for t in targets
+            ):
+                continue
+            if node.value is not None:
+                names.extend(cls._rhs_strings(mod, node.value))
+        return (names, []) if names else None
+
+    def finalize(self, mods: list[Module]) -> list[Finding]:
+        out: list[Finding] = []
+        sites, self.sites = self.sites, []
+        spc_mod, self.spc_mod = self.spc_mod, None
+        if spc_mod is None:
+            return out  # anchor-gated: no doc table in the scan set
+        doc = ast.get_docstring(spc_mod.tree) or ""
+        names, templates = parse_counter_doc(doc)
+        doc_shapes = {template_shape(t) for t in templates}
+        for mod, node, arg0 in sites:
+            resolved = self._resolve(mod, arg0)
+            if resolved is None:
+                out.append(mod.finding(
+                    self.id, node, "unresolvable",
+                    "dynamic spc.record counter name resolves to no "
+                    "literal — route it through a literal table so the "
+                    "published metric stays documentable",
+                ))
+                continue
+            exact, shaped = resolved
+            for cand in sorted(set(exact)):
+                if cand not in names:
+                    out.append(mod.finding(
+                        self.id, node, f"undocumented:{cand}",
+                        f"dynamic counter name `{cand}` is absent from "
+                        "runtime/spc.py's doc table — it publishes as "
+                        "an undocumented metric",
+                    ))
+            for cand in sorted(set(shaped)):
+                if template_shape(cand) not in doc_shapes:
+                    out.append(mod.finding(
+                        self.id, node, f"untemplated:{cand}",
+                        f"f-string counter family `{cand}` has no "
+                        "templated entry in runtime/spc.py's doc table "
+                        "(``coll_<op>_calls`` shape) — it publishes as "
+                        "an undocumented metric family",
+                    ))
         return out
 
 
@@ -709,6 +923,7 @@ def all_rules() -> list[Rule]:
     return [
         DiscardedRequest(), LockOrder(), PollingWait(), SwallowedError(),
         ThreadHygiene(), SpcDocParity(), McaParity(), LoudDegradation(),
+        SpcPublisherSeam(),
     ]
 
 
