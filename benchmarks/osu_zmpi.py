@@ -70,6 +70,11 @@ def _bench_env(repo: str) -> dict:
     # metrics=True through the worker spec); an inherited fleet-global
     # ZMPI_METRICS must not arm publishers on rows that have no store
     env.pop("ZMPI_METRICS", None)
+    # same for tracing: an inherited ZMPI_TRACE=1 would arm the span
+    # recorder in metrics-enabled workers and grow every frame by the
+    # wire context, contaminating the deterministic wire-byte gates
+    # (the --lockdep bug class, inverted); --trace rows arm in-process
+    env.pop("ZMPI_TRACE", None)
     return env
 
 
@@ -358,6 +363,158 @@ def bench_tcp(max_size: int = 4 << 20, iters: int = 50,
     shared-memory transport is pinned OFF: this op measures the WIRE;
     use :func:`bench_sm` / ``--plane sm`` for the rings."""
     return _pt2pt_ladder(max_size, iters, bw, window, sm=False)
+
+
+def _wire_quiesced(skew: int = 0, deadline_s: float = 5.0) -> None:
+    """Wait until the process-global wire counters are quiescent:
+    both ladder ranks live in THIS process (the thread harness), so
+    at quiescence every frame THIS RUN counted received has its sent
+    twin counted too — the peer's ``spc.record`` for a boundary frame
+    can lag the frame's delivery by a scheduler quantum, and a
+    snapshot taken in that window is off by one frame
+    nondeterministically.  ``skew`` is the sent−recvd imbalance the
+    process carried BEFORE this run (earlier suites tearing endpoints
+    down mid-flight leave the lifetime counters permanently skewed);
+    quiescence is the imbalance returning to that baseline, never
+    absolute equality of the cumulative totals."""
+    from zhpe_ompi_tpu.runtime import spc
+
+    deadline = time.monotonic() + deadline_s
+    stable = 0
+    last = (-1, -1)
+    while time.monotonic() < deadline:
+        now = (spc.read("tcp_bytes_sent"), spc.read("tcp_bytes_recvd"))
+        if now[0] - now[1] == skew and now == last:
+            stable += 1
+            if stable >= 2:
+                return
+        else:
+            stable = 0
+        last = now
+        time.sleep(0.002)
+    raise RuntimeError(
+        f"trace A/B: wire counters never quiesced "
+        f"(sent/recvd {last}, baseline skew {skew})"
+    )
+
+
+def _trace_probe_body(proc, payload, iters: int, out: dict,
+                      skew: int = 0):
+    """Ladder body for the ``--trace`` A/B: one unmeasured exchange
+    quiesces the wiring (modex/hello bytes — their encoding varies
+    with the run's ephemeral ports — all land before the snapshot),
+    then the measured ping-pong runs between two counter snapshots
+    taken on rank 0 at wire quiescence, so the [pre, post] window
+    holds EXACTLY the measured body's frames — byte-deterministic
+    across runs."""
+    from zhpe_ompi_tpu.runtime import spc
+
+    _pingpong(proc, b"", 1)
+    if proc.rank == 0:
+        _wire_quiesced(skew)
+        out["pre"] = {
+            k: spc.read(k)
+            for k in ("tcp_bytes_sent", "tcp_bytes_recvd",
+                      "trace_spans_recorded",
+                      "trace_wire_context_bytes")
+        }
+        out["ready"] = True
+        proc.send(b"go", dest=1, tag=3)
+    else:
+        proc.recv(source=0, tag=3, timeout=30.0)
+    sec = _pingpong(proc, payload, iters)
+    if proc.rank == 0:
+        _wire_quiesced(skew)
+        out["post"] = {k: spc.read(k) for k in out["pre"]}
+        out["sec"] = sec
+    return None
+
+
+def bench_trace(max_size: int = 1 << 20, iters: int = 20) -> list[dict]:
+    """The tracing plane's A/B ladder (``--trace``): every rung runs
+    the tcp ping-pong three times — disarmed twice, armed once — and
+    gates the zero-overhead-when-off contract in CI terms:
+
+    - the two DISARMED runs' measured-body wire-byte deltas are
+      byte-identical (no hidden per-run tracing cost), and their
+      ``trace_spans_recorded`` / ``trace_wire_context_bytes`` deltas
+      are ZERO;
+    - the ARMED run's ``trace_spans_recorded`` rises at every rung and
+      its wire bytes exceed the disarmed baseline by exactly the
+      context bytes it accounted.
+
+    Latency columns are report-only (the 1-CPU container's ±20%)."""
+    from zhpe_ompi_tpu.runtime import spc, ztrace
+
+    rows = []
+    for nbytes in _sizes(max_size):
+        payload = np.zeros(max(1, nbytes // 8), dtype=np.float64)
+        deltas = {}
+        for mode in ("off-a", "off-b", "armed"):
+            out: dict = {}
+            # the process is wire-idle here (no pair running yet): the
+            # lifetime counters' current imbalance is the quiescence
+            # baseline for this mode's run
+            skew = spc.read("tcp_bytes_sent") - spc.read(
+                "tcp_bytes_recvd")
+            if mode == "armed":
+                ztrace.arm()
+            try:
+                _run_tcp_ranks(
+                    2, lambda proc, payload=payload, out=out,
+                    skew=skew:
+                    _trace_probe_body(proc, payload, iters, out, skew),
+                    sm=False,
+                )
+            finally:
+                if mode == "armed":
+                    ztrace.disarm()
+            deltas[mode] = {
+                k: out["post"][k] - out["pre"][k] for k in out["pre"]
+            }
+            deltas[mode]["sec"] = out["sec"]
+        off_a, off_b, armed = (deltas["off-a"], deltas["off-b"],
+                               deltas["armed"])
+        for off in (off_a, off_b):
+            if off["trace_spans_recorded"] or \
+                    off["trace_wire_context_bytes"]:
+                raise RuntimeError(
+                    f"trace A/B at {payload.nbytes}B: DISARMED run "
+                    f"recorded spans/context bytes ({off}) — the "
+                    "zero-overhead-when-off contract is broken"
+                )
+        if off_a["tcp_bytes_sent"] != off_b["tcp_bytes_sent"] or \
+                off_a["tcp_bytes_recvd"] != off_b["tcp_bytes_recvd"]:
+            raise RuntimeError(
+                f"trace A/B at {payload.nbytes}B: two disarmed runs "
+                f"disagree on wire bytes ({off_a} vs {off_b}) — the "
+                "measured body is not byte-deterministic"
+            )
+        if armed["trace_spans_recorded"] <= 0:
+            raise RuntimeError(
+                f"trace A/B at {payload.nbytes}B: armed run recorded "
+                "no spans"
+            )
+        extra = armed["tcp_bytes_sent"] - off_a["tcp_bytes_sent"]
+        if extra != armed["trace_wire_context_bytes"]:
+            raise RuntimeError(
+                f"trace A/B at {payload.nbytes}B: armed wire-byte "
+                f"growth {extra} != accounted context bytes "
+                f"{armed['trace_wire_context_bytes']}"
+            )
+        for mode, d in (("trace_off", off_a), ("trace_on", armed)):
+            one_way = d["sec"] / 2
+            rows.append({
+                "op": f"tcp_pingpong_{mode}",
+                "bytes": payload.nbytes,
+                "latency_us": one_way * 1e6,
+                "bandwidth_MBps": (payload.nbytes / one_way) / 1e6
+                if one_way else 0.0,
+                "wire_bytes": d["tcp_bytes_sent"],
+                "spans": d["trace_spans_recorded"],
+                "ctx_bytes": d["trace_wire_context_bytes"],
+            })
+    return rows
 
 
 def _overlap_body(proc, payload, iters: int, window: int,
@@ -1435,6 +1592,13 @@ def main(argv: list[str] | None = None) -> int:
                    help="run WITH the lock-order witness instrumented "
                         "(diagnosis only: numbers are not comparable "
                         "to the default raw-lock rows)")
+    p.add_argument("--trace", action="store_true",
+                   help="tracing-plane A/B ladder: armed vs disarmed "
+                        "tcp ping-pong, gated — disarmed runs are "
+                        "byte-identical on the wire with zero spans "
+                        "(zero-overhead-when-off), armed runs record "
+                        "spans at every rung and grow the wire by "
+                        "exactly the accounted context bytes")
     p.add_argument("--via-metrics", action="store_true",
                    help="--plane han/numa: collect the workers' "
                         "per-rank counter deltas through the PMIx "
@@ -1466,7 +1630,9 @@ def main(argv: list[str] | None = None) -> int:
         else:
             _print_launch_table(rows)
         return 0
-    if args.overlap:
+    if args.trace:
+        rows = bench_trace(args.max_size, max(args.iters, 10))
+    elif args.overlap:
         rows = bench_overlap(args.max_size, max(args.iters, 10),
                              window=min(args.window, 16))
     elif args.op == "pt2pt":

@@ -149,9 +149,19 @@ def _ulfm_detector_hygiene():
     )
     stale_keys = pmix_mod.stale_metric_keys()
     assert not stale_keys, (
-        f"stale metrics:*/flightrec:* keys left in a live store after "
-        f"the suite (namespace destroy drops a job's whole keyspace — "
-        f"these outlived theirs): {stale_keys}"
+        f"stale metrics:*/flightrec:*/trace:* keys left in a live "
+        f"store after the suite (namespace destroy drops a job's "
+        f"whole keyspace — these outlived theirs): {stale_keys}"
+    )
+    from zhpe_ompi_tpu.runtime import ztrace as ztrace_mod
+
+    armed = ztrace_mod.armed_count()
+    assert armed == 0 and not ztrace_mod.active, (
+        f"ztrace left ARMED at session end (refcount {armed}) — a "
+        f"test or publisher armed the tracing plane and never "
+        f"disarmed it; every later send would pay span recording "
+        f"and wire-context bytes (the zero-overhead-when-off "
+        f"contract)"
     )
     scrapers = dvm_mod.live_metrics_listeners()
     assert not scrapers, (

@@ -456,7 +456,11 @@ class TestMetricsPlaneEndToEnd:
                 assert rec["interval_ms"] >= 250
                 # the postmortem: the last-N window's TAIL is the typed
                 # classification of the victim, OS truth from the daemon
-                window = rec["flightrec"]
+                # the publication carries the ring's clock anchor so
+                # the monotonic event stamps are mappable off-process
+                assert rec["flightrec"]["anchor_mono_ns"] > 0
+                assert rec["flightrec"]["anchor_wall"] > 0
+                window = rec["flightrec"]["events"]
                 assert window, f"rank {r}: empty flight recorder"
                 tail = window[-1]
                 assert tail["type"] == "ft_class"

@@ -117,6 +117,45 @@ class TestRing:
             tx.close()
             seg.close()
 
+    def test_full_ring_spins_attribute_to_the_sending_thread(
+            self, fresh_vars):
+        """The thread-local full-spin accumulator (the ztrace sm span's
+        per-call `bp` source) rises on the thread that actually spun on
+        a full ring and stays flat on every other thread — the global
+        sm_ring_full_spins counter cannot make that distinction."""
+        import time
+
+        release = threading.Event()
+        collected = []
+
+        def on_frame(src, frame):
+            release.wait(10.0)  # park the consumer: tail never advances
+            collected.append((src, bytes(frame)))
+
+        mca_var.set_var("sm_max_frag", 256)
+        mca_var.set_var("sm_ring_bytes", 4 * 256)
+        seg = sm_mod.SmSegment(0, 2, on_frame=on_frame)
+        tx = sm_mod.SmSender(seg.name, src_rank=1, dest_rank=0)
+        try:
+            base = sm_mod.thread_full_spins()
+            for _ in range(4):  # fill the ring behind the parked consumer
+                tx.send_frame(b"x" * 64, [], time.monotonic() + 5.0,
+                              None)
+            with pytest.raises(sm_mod.RingFull):
+                tx.send_frame(b"y" * 64, [], time.monotonic() + 0.3,
+                              None)
+            assert sm_mod.thread_full_spins() > base
+            sibling = []
+            t = threading.Thread(
+                target=lambda: sibling.append(sm_mod.thread_full_spins()))
+            t.start()
+            t.join(5.0)
+            assert sibling == [0]  # another thread's view: no spins
+        finally:
+            release.set()
+            tx.close()
+            seg.close()
+
     def test_zero_size_frame(self, fresh_vars):
         collected = []
         seg, tx = self._pair(collected)

@@ -270,6 +270,50 @@ def decide(opname, size, text):
         return "auto"
 """
 
+# ZL010 anchors on flightrec.py / ztrace.py carrying the type tables
+FLIGHTREC_PY = '''
+SEND = "send"
+RECV = "recv"
+ALL_EVENTS = (SEND, RECV)
+'''
+
+ZTRACE_PY = '''
+SEND = "send"
+DELIVER = "deliver"
+STRAY = "stray"  # declared but NOT listed in ALL_KINDS
+ALL_KINDS = (SEND, DELIVER)
+'''
+
+TRIP_ZL010_LITERAL = """
+from runtime import flightrec
+
+def seam():
+    flightrec.record("sennd", dest=1)
+"""
+
+TRIP_ZL010_UNDECLARED = """
+from runtime import ztrace
+
+def seam(rank):
+    ztrace.instant(ztrace.STRAY, rank)
+"""
+
+TRIP_ZL010_UNRESOLVABLE = """
+from runtime import ztrace
+
+def seam(rank, kind):
+    ztrace.record_span(kind, rank, 0, 0)
+"""
+
+CLEAN_ZL010 = """
+from runtime import flightrec, ztrace
+
+def seam(rank, unexpected):
+    flightrec.record(flightrec.SEND, dest=1)
+    flightrec.record("recv", src=0)
+    ztrace.instant(ztrace.DELIVER if unexpected else ztrace.SEND, rank)
+"""
+
 
 class TestRuleMatrix:
     """Each rule: the tripping snippet fires exactly that rule, the
@@ -292,6 +336,12 @@ class TestRuleMatrix:
          {"spc.py": SPC_DOC_TPL}),
         ("ZL009", TRIP_ZL009_UNRESOLVABLE, CLEAN_ZL009_TABLE,
          {"spc.py": SPC_DOC}),
+        ("ZL010", TRIP_ZL010_LITERAL, CLEAN_ZL010,
+         {"flightrec.py": FLIGHTREC_PY, "ztrace.py": ZTRACE_PY}),
+        ("ZL010", TRIP_ZL010_UNDECLARED, CLEAN_ZL010,
+         {"flightrec.py": FLIGHTREC_PY, "ztrace.py": ZTRACE_PY}),
+        ("ZL010", TRIP_ZL010_UNRESOLVABLE, CLEAN_ZL010,
+         {"flightrec.py": FLIGHTREC_PY, "ztrace.py": ZTRACE_PY}),
     ])
     def test_trip_and_clean(self, tmp_path, rule, trip, clean, extra):
         tripped = lint_src(tmp_path / "trip", trip, extra=extra)
@@ -339,9 +389,32 @@ class TestRuleMatrix:
         details = {f.detail for f in res.findings if f.rule == "ZL009"}
         assert "unresolvable" in details
 
+    def test_zl010_inert_without_anchor(self, tmp_path):
+        # no flightrec.py/ztrace.py in the scan set = no type table
+        res = lint_src(tmp_path, TRIP_ZL010_LITERAL)
+        assert "ZL010" not in rules_of(res)
+
+    def test_zl010_names_the_bad_kind(self, tmp_path):
+        res = lint_src(
+            tmp_path, TRIP_ZL010_LITERAL,
+            extra={"flightrec.py": FLIGHTREC_PY,
+                   "ztrace.py": ZTRACE_PY})
+        details = {f.detail for f in res.findings if f.rule == "ZL010"}
+        assert "unknown:flightrec:sennd" in details
+
+    def test_zl010_declared_but_unlisted_kind_flagged(self, tmp_path):
+        # STRAY exists as a constant but ALL_KINDS does not list it:
+        # consumers enumerate the table, so the kind is undocumented
+        res = lint_src(
+            tmp_path, TRIP_ZL010_UNDECLARED,
+            extra={"flightrec.py": FLIGHTREC_PY,
+                   "ztrace.py": ZTRACE_PY})
+        details = {f.detail for f in res.findings if f.rule == "ZL010"}
+        assert "undeclared:ztrace:STRAY" in details
+
     def test_rule_table_documents_history(self):
         table = rule_table()
-        assert len(table) == 9
+        assert len(table) == 10
         assert all(guards for _, _, guards in table), (
             "every rule must cite the historical bug it encodes"
         )

@@ -1,0 +1,989 @@
+"""Tracing plane: the ztrace span recorder, wire-propagated trace
+context across every transport (loopback/sm/eager/rndv × thread and
+socket planes), clock-corrected merged timelines, the critical-path
+report, the blocking mpisync protocol on both planes, the peruse
+copy-on-write hot path, and the traced-recovery postmortem."""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from zhpe_ompi_tpu.core import errhandler as errh
+from zhpe_ompi_tpu.core import errors
+from zhpe_ompi_tpu.ft.inject import FaultPlan
+from zhpe_ompi_tpu.pt2pt.tcp import TcpProc
+from zhpe_ompi_tpu.pt2pt.universe import LocalUniverse
+from zhpe_ompi_tpu.runtime import flightrec, peruse, spc, ztrace
+from zhpe_ompi_tpu.tools import mpisync
+from zhpe_ompi_tpu.tools import ztrace as ztrace_cli
+from zhpe_ompi_tpu import ops
+
+from tests.test_tcp import run_tcp
+
+
+@pytest.fixture()
+def armed():
+    """Arm the module recorder for one test, ring cleared, always
+    disarmed after (the conftest zero-armed-tracers gate)."""
+    ztrace.clear()
+    ztrace.arm()
+    try:
+        yield
+    finally:
+        ztrace.disarm()
+        ztrace.clear()
+
+
+def _spans(kind=None):
+    w = ztrace.window()
+    if kind is None:
+        return w
+    return [s for s in w if s["kind"] == kind]
+
+
+def _send_map():
+    return {s["sid"]: s for s in _spans("send")}
+
+
+# ============================ recorder unit ================================
+
+
+class TestSpanRecorder:
+    def test_ring_overwrite_accounting_and_payload(self):
+        rec = ztrace.SpanRecorder(capacity=16)
+        d0 = spc.read("trace_spans_dropped")
+        r0 = spc.read("trace_spans_recorded")
+        for i in range(21):
+            rec.record(ztrace.SEND, 0, i, i + 1, tag=i)
+        assert spc.read("trace_spans_recorded") - r0 == 21
+        assert spc.read("trace_spans_dropped") - d0 == 5
+        win = rec.window()
+        assert len(win) == 16
+        assert [s["tag"] for s in win] == list(range(5, 21))
+        payload = rec.payload(3)
+        assert payload["rank"] == 3
+        assert payload["anchor_mono_ns"] > 0
+        assert payload["anchor_wall"] > 0
+        assert len(payload["spans"]) == 16
+        # anchors captured back-to-back: wall_of maps monotonic onto
+        # the wall clock within a sane bound
+        assert abs(rec.wall_of(time.monotonic_ns())
+                   - time.time()) < 1.0
+
+    def test_sids_unique_across_thread_ranks(self):
+        rec = ztrace.SpanRecorder(capacity=64)
+        sids = {rec.new_sid(r) for r in range(8) for _ in range(8)}
+        assert len(sids) == 64
+
+    def test_disarmed_module_recorder_is_inert(self):
+        assert not ztrace.active
+        ztrace.clear()
+        r0 = spc.read("trace_spans_recorded")
+        assert ztrace.record_span(ztrace.SEND, 0, 0, 1) is None
+        assert ztrace.instant(ztrace.SEND, 0) is None
+        h = ztrace.begin(ztrace.SEND, 0)
+        assert h.sid is None and h.end() is None
+        assert ztrace.window() == []
+        assert spc.read("trace_spans_recorded") == r0
+
+    def test_arm_refcount(self):
+        assert ztrace.armed_count() == 0
+        ztrace.arm()
+        ztrace.arm()
+        try:
+            assert ztrace.active and ztrace.armed_count() == 2
+            ztrace.disarm()
+            assert ztrace.active
+        finally:
+            ztrace.disarm()
+        assert not ztrace.active and ztrace.armed_count() == 0
+
+    def test_match_subscription_survives_prior_plain_armer(self):
+        # the match subscription refcounts SEPARATELY from the arm
+        # count: a publisher asking for match events while a bench/test
+        # already holds a plain arm still gets its PERUSE subscription
+        assert ztrace.armed_count() == 0
+        ztrace.clear()
+        ztrace.arm()  # plain armer first (no match events)
+        ztrace.arm(match_events=True)  # the publisher
+        try:
+            assert peruse.active
+            peruse.fire(peruse.MSG_MATCH_POSTED_REQ, src=1, tag=2, cid=3)
+            matches = [s for s in ztrace.window()
+                       if s["kind"] == ztrace.MATCH]
+            assert len(matches) == 1 and matches[0]["src"] == 1
+            # the plain armer leaving first must not strip the
+            # publisher's subscription
+            ztrace.disarm()
+            peruse.fire(peruse.REQ_MATCH_UNEX, src=4, tag=5, cid=6)
+            assert len([s for s in ztrace.window()
+                        if s["kind"] == ztrace.MATCH]) == 2
+        finally:
+            ztrace.disarm(match_events=True)
+            ztrace.clear()
+        assert not peruse.active  # subscription released with its arm
+        assert ztrace.armed_count() == 0 and not ztrace.active
+
+    def test_phase_span_records_on_success_only(self, armed):
+        with ztrace.phase_span("intra", 1, op="allreduce"):
+            pass
+        assert [s["name"] for s in _spans("phase")] == ["intra"]
+        ztrace.clear()
+        with pytest.raises(RuntimeError):
+            with ztrace.phase_span("inter", 1):
+                raise RuntimeError("died inside")
+        assert _spans("phase") == []  # missing span IS the signal
+
+    def test_wire_context_shape_and_foreign_degradation(self, armed):
+        ctx = ztrace.wire_context(7, 42)
+        assert ztrace.parse_wire_context(ctx) == ctx
+        assert ctx[1] == 7 and ctx[2] == 42
+        for bad in (None, 3, (1, 2), ("a", 2, 3), [1, 2, 3]):
+            assert ztrace.parse_wire_context(bad) is None
+
+
+# ====================== peruse copy-on-write (satellite) ===================
+
+
+class TestPeruseCopyOnWrite:
+    def test_fire_does_not_take_the_registry_lock(self):
+        """A subscriber unsubscribing ITSELF from inside fire() — a
+        re-entrant registry mutation — must not deadlock: fire() reads
+        the immutable table without the lock."""
+        seen = []
+
+        def once(**kw):
+            seen.append(kw["event"])
+            peruse.unsubscribe(peruse.MSG_ARRIVED, once)
+
+        peruse.subscribe(peruse.MSG_ARRIVED, once)
+        try:
+            done = []
+
+            def firer():
+                peruse.fire(peruse.MSG_ARRIVED, src=0, tag=1, cid=0,
+                            seq=0)
+                done.append(True)
+
+            t = threading.Thread(target=firer, daemon=True)
+            t.start()
+            t.join(5.0)
+            assert done, "fire() deadlocked on a re-entrant unsubscribe"
+            assert seen == [peruse.MSG_ARRIVED]
+            assert not peruse.active
+            # the self-removal held: a second fire reaches nobody
+            peruse.fire(peruse.MSG_ARRIVED, src=0, tag=1, cid=0, seq=0)
+            assert len(seen) == 1
+        finally:
+            peruse.unsubscribe(peruse.MSG_ARRIVED, once)
+
+    def test_subscribe_swaps_whole_table(self):
+        a_calls, b_calls = [], []
+        fa = peruse.subscribe(peruse.MSG_ARRIVED,
+                              lambda **kw: a_calls.append(1))
+        fb = peruse.subscribe(peruse.MSG_ARRIVED,
+                              lambda **kw: b_calls.append(1))
+        try:
+            peruse.fire(peruse.MSG_ARRIVED, src=0, tag=0, cid=0, seq=0)
+            assert a_calls == [1] and b_calls == [1]
+            peruse.unsubscribe(peruse.MSG_ARRIVED, fa)
+            peruse.fire(peruse.MSG_ARRIVED, src=0, tag=0, cid=0, seq=0)
+            assert a_calls == [1] and b_calls == [1, 1]
+            assert peruse.active
+        finally:
+            peruse.unsubscribe(peruse.MSG_ARRIVED, fa)
+            peruse.unsubscribe(peruse.MSG_ARRIVED, fb)
+        assert not peruse.active
+
+
+# ===================== flightrec clock domain (satellite) ==================
+
+
+class TestFlightrecClockDomain:
+    def test_events_stamp_monotonic_ns_with_wall_anchor(self):
+        rec = flightrec.FlightRecorder(capacity=8)
+        wall, mono = rec.anchors()
+        assert abs(wall - time.time()) < 5.0
+        before = time.monotonic_ns()
+        rec.record(flightrec.SEND, dest=1)
+        evt = rec.window()[-1]
+        assert "t" not in evt  # the NTP-steppable stamp is gone
+        assert before <= evt["t_ns"] <= time.monotonic_ns()
+        assert evt["t_ns"] >= mono
+
+    def test_clear_re_anchors(self):
+        rec = flightrec.FlightRecorder(capacity=8)
+        _, mono0 = rec.anchors()
+        time.sleep(0.002)
+        rec.clear()
+        _, mono1 = rec.anchors()
+        assert mono1 > mono0
+
+    def test_module_anchors_exposed(self):
+        wall, mono = flightrec.anchors()
+        assert wall > 0 and mono > 0
+
+
+# ================= wire propagation matrix (socket plane) ==================
+
+
+class TestSocketPlanePropagation:
+    """The propagation matrix over real sockets: every transport's
+    deliver span parents on the sender's send span through the frame
+    header context."""
+
+    def _exchange(self, transport):
+        def prog(p):
+            if transport == "self":
+                p.send(b"me", dest=p.rank, tag=1)
+                return p.recv(source=p.rank, tag=1)
+            if p.rank == 0:
+                if transport == "tcp":
+                    p.send(np.arange(16.0), dest=1, tag=2)
+                elif transport == "rndv":
+                    p.send(np.zeros(300_000), dest=1, tag=3)  # 2.4 MB
+                elif transport == "sm":
+                    p.send(np.arange(32.0), dest=1, tag=4)
+                p.recv(source=1, tag=9, timeout=30.0)
+            else:
+                tag = {"tcp": 2, "rndv": 3, "sm": 4}[transport]
+                p.recv(source=0, tag=tag, timeout=30.0)
+                p.send(b"ack", dest=0, tag=9)
+            return True
+
+        run_tcp(2, prog, sm=(transport == "sm"))
+
+    @pytest.mark.parametrize("transport", ["self", "tcp", "rndv", "sm"])
+    def test_deliver_parents_on_send(self, armed, transport):
+        self._exchange(transport)
+        sends = _send_map()
+        delivers = [s for s in _spans("deliver")
+                    if s.get("transport") == transport
+                    or (transport == "rndv"
+                        and s.get("transport") == "tcp")]
+        assert delivers, ztrace.window()
+        matched = [d for d in delivers if d.get("parent") in sends]
+        assert matched, delivers
+        for d in matched:
+            src = sends[d["parent"]]
+            # same trace id propagated; causal order holds in the
+            # shared clock domain
+            assert d["trace"] == src["trace"]
+            assert d["t0"] >= src["t0"]
+
+    def test_rndv_legs_recorded(self, armed):
+        self._exchange("rndv")
+        sends = _send_map()
+        rndv_sends = {sid: s for sid, s in sends.items()
+                      if s.get("transport") == "rndv"}
+        assert rndv_sends
+        for kind in ("rts", "push", "cts"):
+            legs = [s for s in _spans(kind)
+                    if s.get("parent") in rndv_sends
+                    or s.get("parent") in sends]
+            assert legs, (kind, ztrace.window())
+        # the push leg carries a real duration
+        push = [s for s in _spans("push")
+                if s["parent"] in rndv_sends]
+        assert push and all(s["t1"] >= s["t0"] for s in push)
+
+    def test_recv_spans_cover_post_to_completion(self, armed):
+        self._exchange("tcp")
+        recvs = _spans("recv")
+        assert recvs
+        assert all(s["t1"] >= s["t0"] for s in recvs)
+
+    def test_disarmed_run_pays_nothing(self):
+        assert not ztrace.active
+        r0 = spc.read("trace_spans_recorded")
+        b0 = spc.read("trace_wire_context_bytes")
+        self._exchange("tcp")
+        self._exchange("rndv")
+        assert spc.read("trace_spans_recorded") == r0
+        assert spc.read("trace_wire_context_bytes") == b0
+        assert ztrace.window() == []
+
+    def test_armed_run_counts_wire_context_bytes(self, armed):
+        b0 = spc.read("trace_wire_context_bytes")
+        self._exchange("tcp")
+        assert spc.read("trace_wire_context_bytes") > b0
+
+    def test_frame_objs_zero_bytes_when_off(self):
+        """The frame-header seam itself: no context, no sixth value,
+        no counter movement — the zero-overhead-when-off contract at
+        its narrowest point."""
+        def prog(p):
+            if p.rank == 0:
+                b0 = spc.read("trace_wire_context_bytes")
+                vals = p._frame_objs(1, 2, 3, b"x", None)
+                assert len(vals) == 5
+                assert spc.read("trace_wire_context_bytes") == b0
+                ctx = (1, 2, 3)
+                vals = p._frame_objs(1, 2, 3, b"x", ctx)
+                assert len(vals) == 6 and vals[5] == ctx
+                assert spc.read("trace_wire_context_bytes") > b0
+            return True
+
+        run_tcp(2, prog, sm=False)
+
+
+# ===================== propagation on the thread plane =====================
+
+
+class TestThreadPlanePropagation:
+    def test_eager_and_rndv_parent_links(self, armed):
+        uni = LocalUniverse(2)
+
+        def main(ctx):
+            if ctx.rank == 0:
+                ctx.send(b"small", dest=1, tag=1)
+                ctx.send(np.zeros(100_000), dest=1, tag=2)  # rndv
+            else:
+                ctx.recv(source=0, tag=1, timeout=10.0)
+                ctx.recv(source=0, tag=2, timeout=10.0)
+            return True
+
+        uni.run(main)
+        sends = _send_map()
+        delivers = [s for s in _spans("deliver")
+                    if s.get("transport") == "thread"]
+        assert len(delivers) >= 2
+        for d in delivers:
+            assert d["parent"] in sends
+            assert d["t0"] >= sends[d["parent"]]["t0"]
+        # the rendezvous announce leg on the receiver
+        ctss = [s for s in _spans("cts") if s["parent"] in sends]
+        assert ctss
+        # transports labeled per protocol on the sender side
+        tps = {sends[d["parent"]]["transport"] for d in delivers}
+        assert tps == {"thread", "thread-rndv"}
+
+    def test_loopback_self_send(self, armed):
+        uni = LocalUniverse(2)
+
+        def main(ctx):
+            if ctx.rank == 0:
+                ctx.send(b"self", dest=0, tag=5)
+                return ctx.recv(source=0, tag=5, timeout=10.0)
+            return None
+
+        assert uni.run(main)[0] == b"self"
+        sends = _send_map()
+        delivers = [s for s in _spans("deliver")
+                    if s["parent"] in sends]
+        assert delivers
+
+    def test_disarmed_thread_plane_records_nothing(self):
+        assert not ztrace.active
+        uni = LocalUniverse(2)
+
+        def main(ctx):
+            ctx.send(ctx.rank, dest=1 - ctx.rank, tag=1)
+            return ctx.recv(source=1 - ctx.rank, tag=1, timeout=10.0)
+
+        assert uni.run(main) == [1, 0]
+        assert ztrace.window() == []
+
+
+# ========================= mpisync (satellite) =============================
+
+
+class TestMpisyncBlockingProtocol:
+    def test_thread_plane_surface_unchanged(self):
+        offsets = mpisync.sync_clocks(LocalUniverse(3), rounds=8)
+        assert offsets[0] == 0.0
+        assert all(abs(o) < 0.05 for o in offsets)
+
+    def test_collective_endpoint_form_on_thread_ranks(self):
+        uni = LocalUniverse(3)
+        skew = [0.0, 0.2, -0.4]
+        res = uni.run(lambda ctx: mpisync.sync_clocks(
+            ctx, rounds=8,
+            clock=lambda r, ctx=ctx: time.monotonic() + skew[ctx.rank],
+        ))
+        assert res[1] is None and res[2] is None
+        for r in (1, 2):
+            assert abs(res[0][r] - skew[r]) < 0.05, res[0]
+
+    def test_tcp_endpoints_with_synthetic_skew(self):
+        """The real-process path (the `clock` hook exists for exactly
+        this): each socket rank measures with its own skewed clock;
+        rank 0's estimates recover the injected skew."""
+        skew = [0.0, 0.35, -0.15]
+
+        def prog(p):
+            return mpisync.sync_clocks(
+                p, rounds=8,
+                clock=lambda _r, p=p: time.monotonic() + skew[p.rank],
+            )
+
+        res = run_tcp(3, prog, sm=False)
+        assert res[1] is None and res[2] is None
+        for r in (1, 2):
+            assert abs(res[0][r] - skew[r]) < 0.05, res[0]
+
+    def test_no_polling_server(self):
+        """The restructure's point: the peer side is exactly `rounds`
+        blocking recv/send pairs — no probe loop, no sleep(0) spinner
+        left in the module."""
+        import inspect
+
+        src = inspect.getsource(mpisync._sync_body)
+        assert ".probe(" not in src
+        assert "sleep" not in src
+
+
+# ================== merged timelines + critical path =======================
+
+
+def _payload(rank, anchor_wall, anchor_mono_ns, spans):
+    return {"rank": rank, "trace_id": 1, "anchor_wall": anchor_wall,
+            "anchor_mono_ns": anchor_mono_ns, "spans": spans}
+
+
+def _span(sid, kind, rank, t0, t1, **fields):
+    s = {"sid": sid, "kind": kind, "rank": rank, "t0": t0, "t1": t1,
+         "trace": 1}
+    s.update(fields)
+    return s
+
+
+class TestMergedTimeline:
+    def test_offsets_correct_skewed_clocks(self):
+        # rank 1's trace clock runs ~0.9 s BEHIND rank 0's: raw wall
+        # anchors put its deliver span almost a second before the send
+        # that caused it — the NTP-skew shape mpisync exists to fix
+        send = _span(11, "send", 0, 1_000_000_000, 1_000_000_000,
+                     dest=1, tag=1, cid=0)
+        deliver = _span(21, "deliver", 1, 600_000_000, 600_000_000,
+                        parent=11, src=0, tag=1, cid=0)
+        p0 = _payload(0, 100.0, 0, [send])   # send at T0 = 101.0
+        p1 = _payload(1, 99.5, 0, [deliver])  # deliver READS 100.1
+        uncorrected = ztrace_cli.corrected_spans([p0, p1])
+        assert ztrace_cli.happens_before_violations(uncorrected)
+        # mpisync's estimate: rank 1's clock is 0.9005 s behind (the
+        # true message flight being 0.5 ms)
+        offsets = [0.0, -0.9005]
+        corrected = ztrace_cli.corrected_spans([p0, p1], offsets)
+        assert not ztrace_cli.happens_before_violations(corrected)
+        d = next(s for s in corrected if s["kind"] == "deliver")
+        s = next(s for s in corrected if s["kind"] == "send")
+        assert d["ts"] > s["ts"]
+
+    def test_real_thread_plane_merge_is_causal(self):
+        ztrace.clear()
+        ztrace.arm()
+        try:
+            uni = LocalUniverse(2)
+
+            def main(ctx):
+                if ctx.rank == 0:
+                    ctx.send(np.arange(8.0), dest=1, tag=1)
+                else:
+                    ctx.recv(source=0, tag=1, timeout=10.0)
+                return True
+
+            uni.run(main)
+            payload = ztrace.payload(0)
+        finally:
+            ztrace.disarm()
+            ztrace.clear()
+        spans = ztrace_cli.corrected_spans([payload])
+        assert spans
+        assert not ztrace_cli.happens_before_violations(spans)
+
+    def test_chrome_trace_shape(self):
+        send = _span(11, "send", 0, 0, 1000, dest=1, tag=1, cid=0)
+        deliver = _span(21, "deliver", 1, 5_000_000, 5_000_000,
+                        parent=11, src=0, tag=1, cid=0)
+        doc = ztrace_cli.chrome_trace(
+            [_payload(0, 10.0, 0, [send]),
+             _payload(1, 10.0, 0, [deliver])])
+        evs = doc["traceEvents"]
+        metas = [e for e in evs if e["ph"] == "M"]
+        assert {m["tid"] for m in metas} == {0, 1}
+        xs = [e for e in evs if e["ph"] == "X"]
+        assert {e["tid"] for e in xs} == {0, 1}
+        assert all(e["ts"] >= 0 for e in xs)
+        flows = [e for e in evs if e["ph"] in ("s", "f")]
+        assert len(flows) == 2  # one cross-rank edge = one s/f pair
+        assert flows[0]["id"] == flows[1]["id"]
+        import json
+
+        json.dumps(doc)  # serializable end to end
+
+    def test_critical_path_late_sender_vs_late_receiver(self):
+        def mk(recv_t0, label_sid):
+            send = _span(label_sid, "send", 0, 2_000_000,
+                         2_000_000, dest=1, tag=1, cid=0)
+            deliver = _span(label_sid + 10, "deliver", 1, 3_000_000,
+                            3_000_000, parent=label_sid, src=0, tag=1,
+                            cid=0)
+            recv = _span(label_sid + 20, "recv", 1, recv_t0,
+                         4_000_000, src=0, tag=1, cid=0)
+            coll0 = _span(label_sid + 30, "coll", 0, 0, 5_000_000,
+                          op="allreduce")
+            coll1 = _span(label_sid + 31, "coll", 1, 1_000_000,
+                          5_000_000, op="allreduce")
+            return ([_payload(0, 50.0, 0, [send, coll0]),
+                     _payload(1, 50.0, 0, [deliver, recv, coll1])])
+
+        # receiver posted LONG before the message arrived: late sender
+        report = ztrace_cli.critical_path_report(mk(0, 100))
+        assert "late-sender" in report
+        assert "straggler rank 1" in report
+        # message parked before the post: late receiver
+        report = ztrace_cli.critical_path_report(mk(3_900_000, 200))
+        assert "late-receiver" in report
+
+    def test_critical_path_names_longest_recovery_leg(self):
+        ft = _span(1, "ft_class", 0, 1_000_000, 1_000_000,
+                   failed=2, cause="daemon")
+        agree = _span(2, "agree", 0, 2_000_000, 4_000_000)
+        shrink = _span(3, "shrink", 0, 4_000_000, 5_000_000, gen=1)
+        respawn = _span(4, "respawn", 0, 5_000_000, 95_000_000,
+                        via="daemon")
+        report = ztrace_cli.critical_path_report(
+            [_payload(0, 9.0, 0, [ft, agree, shrink, respawn])])
+        assert "rank 2 (daemon)" in report
+        lines = [ln for ln in report.splitlines() if "longest leg" in ln]
+        assert len(lines) == 1 and "respawn" in lines[0]
+
+    def test_ring_backpressure_classification(self):
+        send = _span(11, "send", 0, 2_000_000, 9_000_000, dest=1,
+                     tag=1, cid=0, transport="sm", bp=True)
+        deliver = _span(21, "deliver", 1, 9_500_000, 9_500_000,
+                        parent=11, src=0, tag=1, cid=0)
+        recv = _span(31, "recv", 1, 0, 9_900_000, src=0, tag=1, cid=0)
+        coll = _span(41, "coll", 0, 0, 10_000_000, op="bcast")
+        coll1 = _span(42, "coll", 1, 0, 10_000_000, op="bcast")
+        report = ztrace_cli.critical_path_report(
+            [_payload(0, 5.0, 0, [send, coll]),
+             _payload(1, 5.0, 0, [deliver, recv, coll1])])
+        assert "ring-backpressure" in report
+
+
+# ================ kill during a traced collective (thread plane) ===========
+
+
+class TestKillDuringTracedCollective:
+    APP_CID = 5
+    N = 4
+
+    def test_recovery_spans_complete(self):
+        """A rank dies inside a traced collective: survivors classify,
+        ack, agree, shrink, and re-run the collective — the span
+        buffer holds the COMPLETE recovery: ft_class → agree → shrink,
+        and the aborted collective's coll span is missing while the
+        post-recovery one is present."""
+        uni = LocalUniverse(self.N, ft=True)
+        plan = FaultPlan(seed=3).kill_rank(2, after_ops=2)
+        ztrace.clear()
+        ztrace.arm()
+        try:
+            def prog(ctx):
+                ctx.set_errhandler(errh.ERRORS_RETURN)
+                inj = plan.arm(ctx)
+                observed = None
+                try:
+                    for lap in range(2):
+                        inj.send(ctx.rank, dest=(ctx.rank + 1) % self.N,
+                                 tag=lap, cid=self.APP_CID)
+                        inj.recv(source=(ctx.rank - 1) % self.N,
+                                 tag=lap, cid=self.APP_CID,
+                                 timeout=10.0)
+                except errors.ProcFailed as e:
+                    observed = e
+                if observed is None:
+                    try:
+                        ctx.recv(source=2, tag=99, cid=self.APP_CID,
+                                 timeout=10.0)
+                    except errors.ProcFailed as e:
+                        observed = e
+                assert observed is not None
+                ctx.failure_ack()
+                assert ctx.agree(True) is True
+                sh = ctx.shrink()
+                total = sh.allreduce(np.float64(ctx.rank), ops.SUM)
+                return float(total)
+
+            res = uni.run(prog)
+            survivor_sum = float(sum(r for r in range(self.N)
+                                     if r != 2))
+            assert all(r == survivor_sum for i, r in enumerate(res)
+                       if i != 2)
+            kinds = {s["kind"] for s in ztrace.window()}
+            assert {"ft_class", "agree", "shrink"} <= kinds, kinds
+            fts = _spans("ft_class")
+            assert any(s.get("failed") == 2 for s in fts)
+            shrinks = _spans("shrink")
+            assert all(s["t1"] >= s["t0"] for s in shrinks)
+            assert any(s.get("survivors") == self.N - 1
+                       for s in shrinks)
+            # causal report runs end to end on the real buffer
+            report = ztrace_cli.critical_path_report(
+                [ztrace.payload(0)])
+            assert "ft recoveries" in report
+            assert "rank 2" in report
+        finally:
+            ztrace.disarm()
+            ztrace.clear()
+
+
+# ==================== publisher + store integration ========================
+
+
+class TestPublisherTraceIntegration:
+    def test_trace_key_published_and_disarmed_at_close(self):
+        from zhpe_ompi_tpu.runtime import dvm as dvm_mod
+        from zhpe_ompi_tpu.runtime import pmix as pmix_mod
+
+        d = dvm_mod.Dvm()
+        try:
+            pmix_addr = ("127.0.0.1", d.pmix.address[1])
+            excs = [None, None]
+
+            def main(rank):
+                try:
+                    proc = TcpProc(rank, 2, pmix=pmix_addr,
+                                   namespace="jobtrace", metrics=True,
+                                   trace=True, sm=False)
+                    try:
+                        proc.send(np.arange(8.0), dest=1 - rank, tag=3)
+                        proc.recv(source=1 - rank, tag=3, timeout=30.0)
+                        proc.barrier()
+                    finally:
+                        proc.close()
+                except BaseException as e:  # noqa: BLE001
+                    excs[rank] = e
+
+            ts = [threading.Thread(target=main, args=(r,))
+                  for r in range(2)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join(60)
+            assert not any(excs), excs
+            entries = d.store.lookup("jobtrace", "trace:")
+            assert set(entries) == {"trace:jobtrace:0",
+                                    "trace:jobtrace:1"}
+            for payload in entries.values():
+                assert payload["anchor_mono_ns"] > 0
+                kinds = {s["kind"] for s in payload["spans"]}
+                assert "send" in kinds
+            # both publishers gone: the tracing plane is disarmed
+            assert ztrace.armed_count() == 0 and not ztrace.active
+            assert spc.live_publisher_threads() == []
+            d.store.destroy_ns("jobtrace")
+            assert pmix_mod.stale_metric_keys() == []
+        finally:
+            d.stop()
+            ztrace.clear()
+
+    def test_explicit_trace_without_metrics_is_an_error(self):
+        with pytest.raises(errors.ArgError):
+            TcpProc(0, 1, trace=True)
+
+    def test_env_trace_without_metrics_degrades_loudly(self, monkeypatch):
+        monkeypatch.setenv("ZMPI_TRACE", "1")
+        proc = TcpProc(0, 1, sm=False)
+        try:
+            assert proc._trace_on is False
+            assert not ztrace.active
+        finally:
+            proc.close()
+
+    def test_publish_clock_sync_lands_in_store(self):
+        from zhpe_ompi_tpu.runtime import dvm as dvm_mod
+
+        d = dvm_mod.Dvm()
+        try:
+            pmix_addr = ("127.0.0.1", d.pmix.address[1])
+            outs = [None, None]
+            excs = [None, None]
+
+            def main(rank):
+                try:
+                    proc = TcpProc(rank, 2, pmix=pmix_addr,
+                                   namespace="jobsync", sm=False)
+                    try:
+                        outs[rank] = ztrace_cli.publish_clock_sync(
+                            proc, rounds=4)
+                        proc.barrier()
+                    finally:
+                        proc.close()
+                except BaseException as e:  # noqa: BLE001
+                    excs[rank] = e
+
+            ts = [threading.Thread(target=main, args=(r,))
+                  for r in range(2)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join(60)
+            assert not any(excs), excs
+            assert outs[1] is None and len(outs[0]) == 2
+            sync = d.store.lookup("jobsync", "tracesync:")
+            assert list(sync) == ["tracesync:jobsync"]
+            assert [float(v) for v in sync["tracesync:jobsync"]] \
+                == [float(v) for v in outs[0]]
+            d.store.destroy_ns("jobsync")
+        finally:
+            d.stop()
+
+
+# ===================== zero-overhead A/B (osu --trace) =====================
+
+
+@pytest.mark.slow
+class TestTraceABLadder:
+    def test_bench_trace_gates_hold(self):
+        """The CI row: disarmed runs byte-identical with zero spans,
+        armed runs record at every rung and grow the wire by exactly
+        the accounted context bytes — bench_trace RAISES on any gate
+        miss."""
+        from benchmarks.osu_zmpi import bench_trace
+
+        rows = bench_trace(max_size=65536, iters=10)
+        on = [r for r in rows if r["op"].endswith("trace_on")]
+        off = [r for r in rows if r["op"].endswith("trace_off")]
+        assert len(on) == len(off) and on
+        assert all(r["spans"] > 0 and r["ctx_bytes"] > 0 for r in on)
+        assert all(r["spans"] == 0 and r["ctx_bytes"] == 0
+                   for r in off)
+        assert ztrace.armed_count() == 0
+
+
+# ================== the acceptance path: traced recovery ===================
+
+
+_TRACED_RECOVERY_PROG = '''
+import os, signal, sys, time
+sys.path.insert(0, {repo!r})
+import numpy as np
+import zhpe_ompi_tpu as zmpi
+from zhpe_ompi_tpu import ops
+from zhpe_ompi_tpu.core import errhandler as errh
+from zhpe_ompi_tpu.ft import recovery
+from zhpe_ompi_tpu.runtime.pmix import PmixClient
+from zhpe_ompi_tpu.tools import ztrace as ztrace_cli
+
+VICTIM = int(os.environ["TEST_VICTIM"])
+
+proc = zmpi.host_init()
+proc.set_errhandler(errh.ERRORS_RETURN)
+rank, job = proc.rank, os.environ["ZMPI_JOB"]
+pmix_host, rest = os.environ["ZMPI_PMIX"].rsplit(":", 1)
+pmix_port = int(rest.split("/")[0])
+
+if os.environ.get("ZMPI_REJOIN") == "1":
+    total = proc.allreduce(np.float64(proc.rank), ops.SUM)
+    print(f"REJOIN-OK rank={{proc.rank}} "
+          f"total={{float(np.asarray(total))}}", flush=True)
+    zmpi.host_finalize()
+    sys.exit(0)
+
+# rank 0 measures and publishes the mpisync offsets over the live wire
+# (the clock hook feeds each process's wall-anchored trace clock)
+ztrace_cli.publish_clock_sync(proc, rounds=8)
+proc.barrier()
+# traced traffic: every ring holds send/deliver spans
+peer = {{0: 1, 1: 0, 2: 3, 3: 2}}[rank]
+proc.send(np.arange(32.0) * rank, dest=peer, tag=5)
+proc.recv(source=peer, tag=5)
+proc.barrier()
+if rank == VICTIM:
+    # the FINAL send: its span must reach the store before death — the
+    # parent sets "goahead" once the victim's published trace buffer
+    # holds it
+    proc.send(np.arange(8.0), dest=peer, tag=6)
+    cl = PmixClient((pmix_host, pmix_port))
+    try:
+        cl.get(job, "goahead", timeout=60.0)
+    finally:
+        cl.close()
+    os.kill(os.getpid(), signal.SIGKILL)
+if rank == {{0: 1, 1: 0, 2: 3, 3: 2}}[VICTIM]:
+    proc.recv(source=VICTIM, tag=6)
+assert proc.ft_state.wait_failed(VICTIM, timeout=30.0), "no classification"
+shrunk, victims = recovery.respawn_victims(proc, recovery.daemon_respawn)
+assert victims == [VICTIM], victims
+assert recovery.await_rejoin(proc, VICTIM, timeout=30.0), "no rejoin"
+total = proc.allreduce(np.float64(proc.rank), ops.SUM)
+# park until the parent has collected the survivors' trace buffers
+cl = PmixClient((pmix_host, pmix_port))
+try:
+    cl.get(job, "release", timeout=60.0)
+finally:
+    cl.close()
+print(f"SURVIVOR-OK rank={{rank}} total={{float(np.asarray(total))}}",
+      flush=True)
+zmpi.host_finalize()
+'''
+
+
+@pytest.mark.slow
+class TestTracedRecoveryEndToEnd:
+    """The acceptance path: a DVM-launched real-process 4-rank ft job
+    runs TRACED; one rank is kill -9'd mid-job; tools/ztrace collects
+    the per-rank buffers (the victim's last periodic publish included),
+    corrects them with the job's own published mpisync offsets, and
+    emits one merged Chrome trace where the victim's final send span
+    and the survivors' classification→agree→shrink→respawn spans sit
+    on a single causal timeline — with the critical-path report naming
+    the recovery's longest leg."""
+
+    def test_kill9_traced_merged_timeline_and_report(self, tmp_path,
+                                                     monkeypatch):
+        import io
+        import json
+        import os
+        import re
+
+        from zhpe_ompi_tpu.runtime import dvm as dvm_mod
+        from zhpe_ompi_tpu.runtime import pmix as pmix_mod
+
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        prog = tmp_path / "traced_recover.py"
+        prog.write_text(_TRACED_RECOVERY_PROG.format(repo=repo))
+        victim = 2
+        victim_peer = 3
+        monkeypatch.setenv("TEST_VICTIM", str(victim))
+        d = dvm_mod.Dvm()
+        try:
+            cli = dvm_mod.DvmClient(d.address)
+            out, err = io.StringIO(), io.StringIO()
+            result = {}
+
+            def run_job():
+                result["rc"] = cli.launch(
+                    4, [str(prog)], ft=True, trace=True, timeout=180.0,
+                    mca=[("ft_detector_period", "2.0"),
+                         ("ft_detector_timeout", "60.0"),
+                         ("spc_publish_interval_ms", "50")],
+                    stdout=out, stderr=err,
+                )
+
+            t = threading.Thread(target=run_job, daemon=True)
+            t.start()
+            deadline = time.monotonic() + 90.0
+            while cli.last_job_id is None \
+                    and time.monotonic() < deadline:
+                time.sleep(0.1)
+            job = cli.last_job_id
+            assert job, err.getvalue()
+
+            # wait for the victim's periodic publish to ship its FINAL
+            # send span (tag 6), then let it die
+            victim_payload = None
+            while time.monotonic() < deadline:
+                entries = d.store.lookup(job, "trace:")
+                p = entries.get(f"trace:{job}:{victim}")
+                if p and any(s["kind"] == "send" and s.get("tag") == 6
+                             for s in p["spans"]):
+                    victim_payload = p
+                    break
+                time.sleep(0.1)
+            assert victim_payload is not None, (out.getvalue(),
+                                                err.getvalue())
+            d.store.put(job, 99, "goahead", True)
+            d.store.commit(job, 99)
+
+            # wait for the survivors' buffers to hold the complete
+            # recovery (shrink spans land only once recovery ran)
+            survivors = sorted({0, 1, 2, 3} - {victim})
+            payloads = None
+            while time.monotonic() < deadline:
+                entries = d.store.lookup(job, "trace:")
+                have = {}
+                for r in survivors:
+                    p = entries.get(f"trace:{job}:{r}")
+                    if p and any(s["kind"] == "shrink"
+                                 for s in p["spans"]):
+                        have[r] = p
+                if len(have) == len(survivors):
+                    payloads = [have[r] for r in survivors]
+                    break
+                time.sleep(0.1)
+            assert payloads is not None, (out.getvalue(),
+                                          err.getvalue())
+            # the victim's buffer is its LAST pre-death publish (a
+            # respawned incarnation republishes under the same key —
+            # the cached payload is the corpse's, by pid)
+            payloads.append(victim_payload)
+            _collected, offsets = ztrace_cli.collect(
+                ("127.0.0.1", d.pmix.address[1]), job)
+            assert offsets is not None and len(offsets) == 4
+
+            # ---- the merged timeline ----
+            spans = ztrace_cli.corrected_spans(payloads, offsets)
+            ranks_on_timeline = {s["tid"] for s in spans}
+            assert set(survivors) | {victim} <= ranks_on_timeline
+            # clock-corrected causality holds across ranks (generous
+            # tolerance: the offsets are loopback-RTT estimates)
+            bad = ztrace_cli.happens_before_violations(
+                spans, tolerance=5e-3)
+            assert not bad, bad[:3]
+            # the victim's final send and its peer's deliver both sit
+            # on the one timeline, in causal order
+            final_send = next(
+                s for s in spans
+                if s["tid"] == victim and s["kind"] == "send"
+                and s.get("tag") == 6)
+            deliver = next(
+                (s for s in spans
+                 if s["tid"] == victim_peer and s["kind"] == "deliver"
+                 and s.get("parent") == final_send["sid"]), None)
+            assert deliver is not None
+            assert deliver["ts"] >= final_send["ts"] - 5e-3
+            # every survivor's complete recovery on the same timeline
+            for r in survivors:
+                kinds = {s["kind"] for s in spans if s["tid"] == r}
+                assert {"ft_class", "agree", "shrink"} <= kinds, (
+                    r, kinds)
+            assert any(s["kind"] == "respawn" for s in spans)
+
+            # ---- chrome trace + report ----
+            doc = ztrace_cli.chrome_trace(payloads, offsets, job=job)
+            trace_file = tmp_path / "trace.json"
+            trace_file.write_text(json.dumps(doc))
+            evs = doc["traceEvents"]
+            assert any(e["ph"] == "f" for e in evs)  # causal arrows
+            report = ztrace_cli.critical_path_report(payloads, offsets)
+            assert f"rank {victim} (daemon)" in report
+            longest = [ln for ln in report.splitlines()
+                       if "longest leg" in ln]
+            assert longest, report  # the recovery's longest leg NAMED
+            assert any(k in longest[0]
+                       for k in ("agree", "shrink", "respawn"))
+
+            # release the survivors; the job runs out
+            d.store.put(job, 99, "release", True)
+            d.store.commit(job, 99)
+            t.join(120)
+            assert not t.is_alive(), "job never exited"
+            # the victim was respawned over: its LATEST incarnation
+            # exited clean, so the job rc is 0 (a respawned-over
+            # corpse is recovery history, the PR 8 rc contract)
+            assert result["rc"] == 0, (out.getvalue(),
+                                       err.getvalue())
+            text = out.getvalue()
+            assert len(re.findall(r"SURVIVOR-OK rank=(\d+)", text)) == 3
+            assert re.findall(r"REJOIN-OK rank=(\d+) total=([\d.]+)",
+                              text) == [(str(victim), "6.0")]
+            finalize_deadline = time.monotonic() + 5.0
+            while pmix_mod.stale_metric_keys() \
+                    and time.monotonic() < finalize_deadline:
+                time.sleep(0.05)
+            assert pmix_mod.stale_metric_keys() == []
+            cli.stop()
+            cli.close()
+        finally:
+            d.stop()
+        assert dvm_mod.live_dvms() == []
+        assert spc.live_publisher_threads() == []
+        assert ztrace.armed_count() == 0

@@ -64,6 +64,7 @@ from ..pt2pt import groups as groups_mod
 from ..pt2pt.groups import LEADER_WINDOW, GroupView, payload_bytes
 from ..runtime import flightrec
 from ..runtime import spc
+from ..runtime import ztrace
 from . import host
 
 _stream = mca_output.open_stream("coll_han")
@@ -79,13 +80,20 @@ def _recorded(opname: str):
     """Flight-recorder enter/exit around a hierarchical collective —
     exit records only on SUCCESS, so a postmortem window shows the
     schedule a failing rank died inside (an aborted collective's
-    missing exit is the signal, not a gap)."""
+    missing exit is the signal, not a gap).  While the tracing plane
+    is armed the same pairing records one COLL span per schedule (the
+    same success-only discipline: an aborted collective's missing
+    span is the signal)."""
     def deco(fn):
         @functools.wraps(fn)
-        def wrapper(*args, **kwargs):
+        def wrapper(ctx, *args, **kwargs):
             flightrec.record(flightrec.COLL_ENTER, op=opname)
-            out = fn(*args, **kwargs)
+            sp = ztrace.begin(ztrace.COLL, getattr(ctx, "rank", -1),
+                              op=opname) if ztrace.active else None
+            out = fn(ctx, *args, **kwargs)
             flightrec.record(flightrec.COLL_EXIT, op=opname)
+            if sp is not None:
+                sp.end()
             return out
         return wrapper
     return deco
@@ -516,20 +524,30 @@ def _allreduce_numa(ctx, topo: _Topology, value: Any, op) -> Any:
     leader exchange among every domain leader instead."""
     dview, dlview, wview = _numa_views(ctx, topo)
     spc.record("coll_han_numa_collectives", 1)
+    rank = getattr(ctx, "rank", -1)
     flightrec.record(flightrec.COLL_ENTER, op="allreduce",
                      phase="domain", sched="han3")
-    part = host.reduce(dview, value, op, root=0) \
-        if dview.size > 1 else value
+    with ztrace.phase_span("intra-domain", rank, op="allreduce",
+                           sched="han3"):
+        part = host.reduce(dview, value, op, root=0) \
+            if dview.size > 1 else value
     if dlview is not None:
         if dlview.size > 1:
-            part = host.reduce(dlview, part, op, root=0)
+            with ztrace.phase_span("dleader", rank, op="allreduce",
+                                   sched="han3"):
+                part = host.reduce(dlview, part, op, root=0)
         if wview is not None:
             part = _leader_allreduce(wview, part, op)
         if dlview.size > 1:
-            part = host.bcast(dlview, part, root=0,
-                              algorithm="binomial")
+            with ztrace.phase_span("dleader", rank, op="allreduce",
+                                   sched="han3"):
+                part = host.bcast(dlview, part, root=0,
+                                  algorithm="binomial")
     if dview.size > 1:
-        part = host.bcast(dview, part, root=0, algorithm="binomial")
+        with ztrace.phase_span("intra-domain", rank, op="allreduce",
+                               sched="han3"):
+            part = host.bcast(dview, part, root=0,
+                              algorithm="binomial")
     flightrec.record(flightrec.COLL_EXIT, op="allreduce",
                      phase="domain", sched="han3")
     return part
@@ -557,13 +575,17 @@ def allreduce(ctx, value: Any, op,
         geom = _pipeline_geometry(len(topo.groups), value)
         if geom is not None:
             return _allreduce_pipelined(intra, inter, value, op, geom)
-    partial = host.reduce(intra, value, op, root=0) \
-        if intra.size > 1 else value
+    rank = getattr(ctx, "rank", -1)
+    with ztrace.phase_span("intra", rank, op="allreduce"):
+        partial = host.reduce(intra, value, op, root=0) \
+            if intra.size > 1 else value
     full = None
     if inter is not None:
         full = _leader_allreduce(inter, partial, op)
     if intra.size > 1:
-        full = host.bcast(intra, full, root=0, algorithm="binomial")
+        with ztrace.phase_span("intra", rank, op="allreduce"):
+            full = host.bcast(intra, full, root=0,
+                              algorithm="binomial")
     return full
 
 
@@ -580,7 +602,9 @@ def _leader_allreduce(inter, partial: Any, op) -> Any:
         return partial
     flightrec.record(flightrec.COLL_ENTER, op="allreduce",
                      phase="inter")
-    out = _leader_allreduce_body(inter, partial, op)
+    with ztrace.phase_span("inter-host", getattr(inter, "rank", -1),
+                           op="allreduce"):
+        out = _leader_allreduce_body(inter, partial, op)
     flightrec.record(flightrec.COLL_EXIT, op="allreduce",
                      phase="inter")
     return out

@@ -32,6 +32,7 @@ from typing import Any, Generator
 
 from ..core import errors
 from ..pt2pt.requests import Request
+from ..runtime import ztrace
 from . import host as H
 
 # Nonblocking barrier's base kind tag (blocking barrier has its own
@@ -58,24 +59,37 @@ class SchedRequest(Request):
     """
 
     __slots__ = ("_gen", "_round", "_endpoint_progress", "_ft_state",
-                 "_coll_cid")
+                 "_coll_cid", "_tspan")
 
     def __init__(self, gen: Generator, endpoint_progress=None,
-                 ft_state=None, coll_cid: int = H.COLL_CID):
+                 ft_state=None, coll_cid: int = H.COLL_CID,
+                 trace_rank: int = -1, trace_op: "str | None" = None):
         super().__init__(progress=self._advance)
         self._gen = gen
         self._round: list[Request] = []
         self._endpoint_progress = endpoint_progress
         self._ft_state = ft_state
         self._coll_cid = coll_cid
+        # tracing plane: one COLL span per schedule, issue → clean
+        # completion (an aborted schedule records no span — the
+        # missing span is the postmortem signal, like han's)
+        self._tspan = ztrace.begin(
+            ztrace.COLL, trace_rank, op=trace_op or "nbc", sched="nbc",
+        ) if ztrace.active else None
         self._kick()
+
+    def _finish(self, value) -> None:
+        self.complete(value)
+        if self._tspan is not None:
+            self._tspan.end()
+            self._tspan = None
 
     def _kick(self) -> None:
         """Start the schedule: run until the first yield (round 0 posted)."""
         try:
             self._round = list(next(self._gen))
         except StopIteration as stop:
-            self.complete(stop.value)
+            self._finish(stop.value)
 
     def _check_revoked(self) -> None:
         if self._ft_state is not None \
@@ -117,7 +131,7 @@ class SchedRequest(Request):
             try:
                 self._round = list(self._gen.send(values))
             except StopIteration as stop:
-                self.complete(stop.value)
+                self._finish(stop.value)
             except BaseException as e:
                 # the schedule body itself failed (e.g. a sub-send
                 # raising at issue time): that error is the request's
@@ -128,11 +142,20 @@ class SchedRequest(Request):
                 raise
 
 
-def _start(ctx, gen) -> SchedRequest:
+def _start(ctx, gen, op: "str | None" = None) -> SchedRequest:
+    if op is None and ztrace.active:
+        # the public i<op> wrapper one frame up names the schedule —
+        # resolved only while tracing is armed (disarmed calls pay
+        # nothing for a label nobody records)
+        import sys
+
+        op = sys._getframe(1).f_code.co_name
     return SchedRequest(
         gen,
         endpoint_progress=getattr(ctx, "progress", None),
         ft_state=getattr(ctx, "ft_state", None),
+        trace_rank=getattr(ctx, "rank", -1),
+        trace_op=op,
     )
 
 

@@ -92,7 +92,7 @@ class Intercomm(InterCollectives):
             raise errors.RankError(f"remote rank {dest} out of range")
         env = Envelope(self._ctx.rank, tag, self.cid, next(self._seq))
         self._remote.contexts[dest].mailbox.put(
-            (_EAGER, env, _eager_copy(obj))
+            (_EAGER, env, _eager_copy(obj), None)
         )
 
     def recv(self, source: int = ANY_SOURCE, tag: int = ANY_TAG) -> Any:

@@ -48,6 +48,7 @@ from typing import Any, Callable
 
 from ..core import errors
 from ..runtime import flightrec
+from ..runtime import ztrace
 from . import ulfm
 from .ulfm import agree_failed_set  # noqa: F401  (pipeline step 2)
 
@@ -191,9 +192,17 @@ def daemon_respawn(ranks, dvm: str | tuple | None = None,
         )
     batch = sorted(int(r) for r in ranks)
     flightrec.record(flightrec.RESPAWN, ranks=batch, via="daemon")
+    sp = ztrace.begin(ztrace.RESPAWN, -1, via="daemon",
+                      ranks=batch) if ztrace.active else None
     client = DvmClient(dvm, timeout=timeout)
     try:
-        return client.respawn(job, batch, timeout=timeout)
+        pids = client.respawn(job, batch, timeout=timeout)
+        if sp is not None:
+            # the recovery timeline's respawn leg: RPC round trip
+            # included — usually the longest leg the critical-path
+            # report names
+            sp.end(n=len(batch))
+        return pids
     finally:
         client.close()
 
@@ -276,7 +285,11 @@ def respawn_rank(uni, rank: int, fn: Callable[[Any], Any],
     a replacement that dies again is marked failed; a clean finish is
     not a process failure."""
     flightrec.record(flightrec.RESPAWN, ranks=[int(rank)], via="thread")
+    sp = ztrace.begin(ztrace.RESPAWN, -1, via="thread",
+                      ranks=[int(rank)]) if ztrace.active else None
     ctx = uni.respawn_rank(rank)
+    if sp is not None:
+        sp.end()
 
     def second_life():
         try:

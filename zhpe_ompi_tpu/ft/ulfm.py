@@ -45,6 +45,7 @@ from ..core import errors
 from ..mca import output as mca_output
 from ..mca import var as mca_var
 from ..runtime import flightrec
+from ..runtime import ztrace
 
 mca_var.register(
     "ft_detector_period", 0.05,
@@ -263,6 +264,11 @@ class FailureState:
         # listeners run: a metrics publisher's on_classification hook
         # ships the window with this event as its tail entry
         flightrec.record(flightrec.FT_CLASS, rank=int(rank), cause=cause)
+        if ztrace.active:
+            # the recovery story's ROOT span: agree/shrink/respawn
+            # legs follow it on the merged timeline
+            ztrace.instant(ztrace.FT_CLASS, -1, failed=int(rank),
+                           cause=cause)
         self._notify_death(rank, cause)
         return True
 
@@ -761,6 +767,19 @@ def _agree_value(ep, value: Any, combine: Callable[[Any, Any], Any],
     value and everyone still mid-protocol adopts it.  Values must be
     DSS-packable (bools, ints, nested lists) so the same protocol runs
     over thread and socket endpoints."""
+    sp = ztrace.begin(ztrace.AGREE, getattr(ep, "rank", -1)) \
+        if ztrace.active else None
+    out = _agree_value_body(ep, value, combine, timeout)
+    if sp is not None:
+        # the recovery timeline's agreement leg, completion only (an
+        # abandoned agreement records nothing — the signal)
+        sp.end(seq=getattr(ep, "_agree_seq", 0) - 1)
+    return out
+
+
+def _agree_value_body(ep, value: Any,
+                      combine: Callable[[Any, Any], Any],
+                      timeout: float | None = None) -> Any:
     state = _require_ft(ep)
     if timeout is None:
         timeout = float(mca_var.get("ft_agree_timeout", 30.0))
@@ -1036,9 +1055,14 @@ class UlfmEndpointAPI:
         from the local CRASH count (orderly departures excluded, so
         finalize skew cannot split the window)."""
         state = _require_ft(self)
+        sp = ztrace.begin(ztrace.SHRINK, getattr(self, "rank", -1),
+                          consensus=consensus) if ztrace.active else None
         if not consensus:
-            return ShrunkEndpoint(self, state.live(),
-                                  generation=state.crash_count())
+            shrunk = ShrunkEndpoint(self, state.live(),
+                                    generation=state.crash_count())
+            if sp is not None:
+                sp.end(gen=shrunk._gen, survivors=shrunk.size)
+            return shrunk
         failed, generation = agree_failed_set(self)
         for r, cause in failed.items():
             if cause == "goodbye":
@@ -1049,7 +1073,10 @@ class UlfmEndpointAPI:
                 )
         state.raise_epoch(generation)
         survivors = [r for r in range(self.size) if r not in failed]
-        return ShrunkEndpoint(self, survivors, generation=generation)
+        shrunk = ShrunkEndpoint(self, survivors, generation=generation)
+        if sp is not None:
+            sp.end(gen=generation, survivors=len(survivors))
+        return shrunk
 
     def revoke(self, cid: int) -> None:
         """MPIX_Comm_revoke for an endpoint-plane cid: every pending and

@@ -102,6 +102,24 @@ from ..utils import lockdep
 
 _stream = mca_output.open_stream("btl_sm")
 
+# Per-THREAD full-ring spin accumulator, alongside the process-global
+# sm_ring_full_spins counter: the ztrace sm send span classifies
+# ring-backpressure from the delta across ITS OWN call, and the global
+# counter would cross-contaminate concurrent senders (thread ranks
+# share one SPC table).
+_thread_spins = threading.local()
+
+
+def _note_full_spins(n: int) -> None:
+    if n:
+        _thread_spins.n = getattr(_thread_spins, "n", 0) + n
+
+
+def thread_full_spins() -> int:
+    """This thread's monotone full-ring spin total — sample before and
+    after a send to attribute backpressure to that call alone."""
+    return getattr(_thread_spins, "n", 0)
+
 # category derivation (tools/mpit.py): the shared-memory plane's vars
 # and counters — sm_*, btl_sm_* — are ONE family
 mca_var.register_family("sm")
@@ -1044,6 +1062,7 @@ class SmSender:
             if _U32.unpack_from(mm, _OFF_STOPPED)[0]:
                 if spins:
                     spc.record("sm_ring_full_spins", spins)
+                    _note_full_spins(spins)
                 raise ConsumerStopped(
                     f"sm ring to rank {self.dest}: consumer stopped"
                 )
@@ -1051,11 +1070,13 @@ class SmSender:
             if self._head - tail < self.nslots:
                 if spins:
                     spc.record("sm_ring_full_spins", spins)
+                    _note_full_spins(spins)
                 return
             if abort is not None:
                 abort()
             if time.monotonic() > deadline:
                 spc.record("sm_ring_full_spins", spins)
+                _note_full_spins(spins)
                 raise RingFull(
                     f"sm ring to rank {self.dest} full past the stall "
                     "timeout (peer wedged?)"

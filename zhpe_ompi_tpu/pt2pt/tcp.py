@@ -59,6 +59,7 @@ from ..mca import output as mca_output
 from ..mca import var as mca_var
 from ..runtime import flightrec
 from ..runtime import spc
+from ..runtime import ztrace
 from ..utils import dss
 from ..utils import lockdep
 from . import matching
@@ -486,7 +487,8 @@ class TcpProc(errh.HasErrhandler, ulfm.UlfmEndpointAPI, HostCollectives,
                  rejoin: bool = False,
                  rejoin_gen: int = 0,
                  rejoin_ranks: "list[int] | None" = None,
-                 metrics: bool | None = None):
+                 metrics: bool | None = None,
+                 trace: bool | None = None):
         if size < 1:
             raise errors.ArgError("size must be >= 1")
         # metrics plane: explicit opt-in (ctor arg) or the ZMPI_METRICS
@@ -513,6 +515,31 @@ class TcpProc(errh.HasErrhandler, ulfm.UlfmEndpointAPI, HostCollectives,
             )
             metrics = False
         self._metrics_on = metrics
+        # tracing plane: rides the metrics publisher (the trace buffer
+        # publishes as trace:<job>:<rank> next to the snapshots), so
+        # trace needs metrics needs a store.  Explicit trace=True
+        # without the metrics plane is a caller contract error; the
+        # env-driven ZMPI_TRACE request degrades loudly.
+        if trace is None:
+            trace = os.environ.get("ZMPI_TRACE", "") not in ("", "0")
+            env_trace = True
+        else:
+            trace = bool(trace)
+            env_trace = False
+        if trace and not metrics:
+            if not env_trace:
+                raise errors.ArgError(
+                    "trace=True publishes span buffers through the "
+                    "metrics publisher: pass metrics=True and "
+                    "pmix=(host, port) (the ZMPI_TRACE contract)"
+                )
+            mca_output.emit(
+                _stream,
+                "rank %s: ZMPI_TRACE set but the metrics plane is off; "
+                "tracing plane disabled", rank,
+            )
+            trace = False
+        self._trace_on = trace
         self._metrics_pub: spc.MetricsPublisher | None = None
         if (rejoin_book is not None or rejoin) and not ft:
             raise errors.ArgError(
@@ -560,6 +587,11 @@ class TcpProc(errh.HasErrhandler, ulfm.UlfmEndpointAPI, HostCollectives,
         # for (peer death poisons it) and which request its push
         # completes (None for blocking sends)
         self._rndv_meta: dict[int, tuple[int, Any]] = {}
+        # rndv_id -> parent send-span sid, populated only while the
+        # tracing plane is armed (the CTS-released push leg records a
+        # PUSH span parented on the originating send span); entries
+        # drop with their transfer
+        self._rndv_trace: dict[int, int] = {}
         # witnessed under lockdep: THE seam zlint ZL002 covers
         # statically and PR 7 paid three review rounds to order
         self._rndv_lock = lockdep.lock("tcp.TcpProc._rndv_lock")
@@ -682,7 +714,8 @@ class TcpProc(errh.HasErrhandler, ulfm.UlfmEndpointAPI, HostCollectives,
                 # flush at close() — started after the modex so the
                 # namespace provably exists
                 self._metrics_pub = spc.MetricsPublisher(
-                    self._pmix_addr, self._pmix_ns, rank)
+                    self._pmix_addr, self._pmix_ns, rank,
+                    trace=self._trace_on)
                 self._metrics_pub.start()
             if ft:
                 # peer death ⇒ ring teardown: the sm transport unmaps its
@@ -729,6 +762,43 @@ class TcpProc(errh.HasErrhandler, ulfm.UlfmEndpointAPI, HostCollectives,
             if self._sm_seg is not None:
                 self._sm_seg.close()
             raise
+
+    def _frame_objs(self, tag: int, cid: int, seq: int, obj: Any,
+                    tctx: "tuple[int, int, int] | None"
+                    ) -> tuple:
+        """The DSS frame-header values of one data frame.  While the
+        tracing plane is armed (``tctx`` non-None) the compact
+        ``(trace_id, parent_sid, seq)`` context rides as an OPTIONAL
+        sixth value — receivers parent their deliver span on it; with
+        tracing off the frame is the unchanged five-value shape, zero
+        bytes of trace overhead on the wire (the A/B contract the OSU
+        ``--trace`` row gates)."""
+        if tctx is None:
+            return (self.rank, tag, cid, seq, obj)
+        # the header growth is the context's own encoding (pack() adds
+        # one count varint byte for the single extra value)
+        spc.record("trace_wire_context_bytes", len(dss.pack(tctx)) - 1)
+        return (self.rank, tag, cid, seq, obj, tctx)
+
+    def _trace_ingest(self, vals: list, transport: str) -> None:
+        """Receiver half of the wire-propagated trace context: a
+        six-value frame parents a DELIVER span (or, for a rendezvous
+        RTS announce, the receiver-side CTS leg) on the sender's send
+        span.  Malformed foreign contexts degrade silently — a drain
+        loop must never raise over an optional tool field."""
+        if len(vals) <= 5 or not ztrace.active:
+            return
+        ctx = ztrace.parse_wire_context(vals[5])
+        if ctx is None:
+            return
+        src, tag, cid, _seq, payload = vals[:5]
+        is_rts = (isinstance(payload, tuple) and len(payload) == 4
+                  and payload[0] == _RTS_MARK)
+        ztrace.instant(
+            ztrace.CTS if is_rts else ztrace.DELIVER, self.rank,
+            parent=ctx[1], trace=ctx[0], src=int(src), tag=int(tag),
+            cid=int(cid), seq=int(ctx[2]), transport=transport,
+        )
 
     def _framed_send(self, sock: socket.socket, frame) -> None:
         """Frames must not interleave on ONE socket, but independent
@@ -840,7 +910,9 @@ class TcpProc(errh.HasErrhandler, ulfm.UlfmEndpointAPI, HostCollectives,
         return sender
 
     def _sm_send(self, smtx: sm_mod.SmSender, obj: Any, dest: int,
-                 tag: int, cid: int, seq: int, nbytes: int) -> None:
+                 tag: int, cid: int, seq: int, nbytes: int,
+                 tctx: "tuple[int, int, int] | None" = None,
+                 objs: tuple | None = None) -> None:
         """One frame onto the peer's ring — the `_send_frame`-shaped
         seam of the sm plane.  Small frames pack their DSS header
         straight into the slot (``pack_frames_into``); larger ones take
@@ -870,16 +942,13 @@ class TcpProc(errh.HasErrhandler, ulfm.UlfmEndpointAPI, HostCollectives,
         # is faster as a fragment pipeline — the peer's copy-out overlaps
         # our remaining copy-ins — so the pack-into fast path stops well
         # below the slot size
+        if objs is None:
+            objs = self._frame_objs(tag, cid, seq, obj, tctx)
         if nbytes + 512 <= min(smtx.slot_bytes, 32 << 10):
-            wire = smtx.send_direct(
-                (self.rank, tag, cid, seq, obj), oob_min, deadline,
-                abort,
-            )
+            wire = smtx.send_direct(objs, oob_min, deadline, abort)
             nfrags = 1
         if wire is None:
-            header, oob = dss.pack_frames(
-                self.rank, tag, cid, seq, obj, oob_min=oob_min,
-            )
+            header, oob = dss.pack_frames(*objs, oob_min=oob_min)
             wire, nfrags = smtx.send_frame(header, oob, deadline, abort)
         spc.record("sm_bytes_sent", wire)
         spc.record("sm_eager_sends" if nfrags == 1 else "sm_frag_sends",
@@ -890,8 +959,9 @@ class TcpProc(errh.HasErrhandler, ulfm.UlfmEndpointAPI, HostCollectives,
         writable buffer — same contract as the socket drain loop, one
         matching engine for both transports."""
         try:
-            [src, tag, cid, seq, payload] = dss.unpack_from(frame)
-        except errors.MpiError as e:
+            vals = dss.unpack_from(frame)
+            src, tag, cid, seq, payload = vals[:5]
+        except (errors.MpiError, ValueError) as e:
             mca_output.emit(
                 _stream,
                 "rank %s: undecodable sm frame from ring %s: %s",
@@ -908,6 +978,7 @@ class TcpProc(errh.HasErrhandler, ulfm.UlfmEndpointAPI, HostCollectives,
             # (the per-direction FIFO the goodbye contract needs)
             self._ft_ctrl(cid, src, payload)
             return
+        self._trace_ingest(vals, "sm")
         env = Envelope(src, tag, cid, seq)
         with self._incoming_cv:
             self.engine.incoming(env, payload)
@@ -1539,7 +1610,8 @@ class TcpProc(errh.HasErrhandler, ulfm.UlfmEndpointAPI, HostCollectives,
             # unpack_from: array payloads become writable views over the
             # frame's dedicated recv_into buffer — the zero-copy receive
             # half (the frame bytearray stays alive via the views)
-            [src, tag, cid, seq, payload] = dss.unpack_from(frame)
+            vals = dss.unpack_from(frame)
+            src, tag, cid, seq, payload = vals[:5]
             if self.ft_state is not None and cid == ulfm.FT_JOIN_CID:
                 # rejoin/re-modex: needs the carrying connection (the
                 # joiner's fresh socket becomes the canonical endpoint)
@@ -1553,6 +1625,7 @@ class TcpProc(errh.HasErrhandler, ulfm.UlfmEndpointAPI, HostCollectives,
                 # revoke floods never enter the matching engine
                 self._ft_ctrl(cid, src, payload)
                 continue
+            self._trace_ingest(vals, "tcp")
             env = Envelope(src, tag, cid, seq)
             try:
                 with self._incoming_cv:
@@ -1727,6 +1800,17 @@ class TcpProc(errh.HasErrhandler, ulfm.UlfmEndpointAPI, HostCollectives,
                 raise exc
             return self.call_errhandler(exc)
         seq = next(self._seq)
+        # tracing plane (armed only): the send span opens here and its
+        # wire context rides the frame header on every transport below;
+        # an error path that never ends the span leaves it unrecorded —
+        # the missing span IS the postmortem signal
+        tspan = tctx = None
+        if ztrace.active and not poll:
+            tspan = ztrace.begin(ztrace.SEND, self.rank, dest=dest,
+                                 tag=tag, cid=cid, seq=seq)
+            tctx = ztrace.wire_context(tspan.sid, seq)
+            if tctx is None:
+                tspan = None  # a disarm raced begin(): send untraced
         if dest == self.rank:
             # loopback shortcut (btl/self): ONE defensive copy with the
             # DSS type mapping instead of the full serialize/deserialize
@@ -1744,6 +1828,13 @@ class TcpProc(errh.HasErrhandler, ulfm.UlfmEndpointAPI, HostCollectives,
             with self._incoming_cv:
                 self.engine.incoming(env, payload)
                 self._incoming_cv.notify_all()
+            if tspan is not None:
+                # no wire: the deliver span parents directly
+                ztrace.instant(ztrace.DELIVER, self.rank,
+                               parent=tspan.sid, trace=tctx[0],
+                               src=self.rank, tag=tag, cid=cid, seq=seq,
+                               transport="self")
+                tspan.end(transport="self")
             return
         nbytes = _payload_size(obj)
         # deferred frames queued toward this peer drain FIRST: blocking
@@ -1762,7 +1853,18 @@ class TcpProc(errh.HasErrhandler, ulfm.UlfmEndpointAPI, HostCollectives,
         smtx = self._sm_tx(dest)
         if smtx is not None:
             try:
-                self._sm_send(smtx, obj, dest, tag, cid, seq, nbytes)
+                spins0 = sm_mod.thread_full_spins() \
+                    if tspan is not None else 0
+                self._sm_send(smtx, obj, dest, tag, cid, seq, nbytes,
+                              tctx=tctx)
+                if tspan is not None:
+                    # bp: the span's duration includes ring-full
+                    # backpressure — the critical-path report's
+                    # ring-backpressure classification keys on this.
+                    # THREAD-local spins: the global counter would
+                    # blame another sender's full ring on this span
+                    tspan.end(transport="sm",
+                              bp=sm_mod.thread_full_spins() > spins0)
                 return
             except errors.ProcFailed as exc:
                 if poll:
@@ -1799,14 +1901,19 @@ class TcpProc(errh.HasErrhandler, ulfm.UlfmEndpointAPI, HostCollectives,
         limit = int(mca_var.get("tcp_eager_limit", 1 << 20))
         try:
             if nbytes > limit:
-                self._send_rndv(obj, dest, tag, cid, seq, nbytes)
+                self._send_rndv(obj, dest, tag, cid, seq, nbytes,
+                                tctx=tctx,
+                                parent=tspan.sid if tspan is not None
+                                else None)
+                if tspan is not None:
+                    tspan.end(transport="rndv")
                 return
             # eager zero-copy: array/bytes payloads leave as out-of-band
             # memoryview segments of the CALLER's buffers, gathered by
             # sendmsg — the blocking send completes only after the
             # kernel has the bytes, so buffer reuse stays safe
             header, oob = dss.pack_frames(
-                self.rank, tag, cid, seq, obj,
+                *self._frame_objs(tag, cid, seq, obj, tctx),
                 oob_min=int(mca_var.get("tcp_zero_copy_min", 0)),
             )
             sock = self._endpoint(dest)
@@ -1815,6 +1922,8 @@ class TcpProc(errh.HasErrhandler, ulfm.UlfmEndpointAPI, HostCollectives,
                 spc.record("tcp_zero_copy_sends", 1)
                 spc.record("tcp_copy_bytes_avoided",
                            sum(v.nbytes for v in oob))
+            if tspan is not None:
+                tspan.end(transport="tcp")
         except errors.ProcFailed as exc:
             # peer death classified by the endpoint layer: route through
             # the attached disposition (FATAL aborts, RETURN raises typed)
@@ -1853,6 +1962,12 @@ class TcpProc(errh.HasErrhandler, ulfm.UlfmEndpointAPI, HostCollectives,
         data_sock = None
         err: BaseException | None = None
         sent = False
+        tparent = None
+        t0_ns = 0
+        if ztrace.active:
+            with self._rndv_lock:
+                tparent = self._rndv_trace.get(rndv_id)
+            t0_ns = time.monotonic_ns()
         try:
             with self._rndv_lock:
                 frame_segs = self._pending_rndv.get(rndv_id)
@@ -1896,6 +2011,13 @@ class TcpProc(errh.HasErrhandler, ulfm.UlfmEndpointAPI, HostCollectives,
             with self._rndv_lock:
                 self._pending_rndv.pop(rndv_id, None)
                 self._rndv_meta.pop(rndv_id, None)
+                self._rndv_trace.pop(rndv_id, None)
+            if sent and tparent is not None and ztrace.active:
+                # the CTS-released bulk leg, duration included —
+                # parented on the originating send span
+                ztrace.record_span(ztrace.PUSH, self.rank, t0_ns,
+                                   time.monotonic_ns(), parent=tparent,
+                                   dest=dest)
             if req is not None:
                 if sent:
                     req.complete()
@@ -1903,19 +2025,24 @@ class TcpProc(errh.HasErrhandler, ulfm.UlfmEndpointAPI, HostCollectives,
                     req.complete_error(self._deferred_exc(err, dest))
 
     def _park_rndv(self, obj: Any, dest: int, seq: int,
-                   req=None) -> tuple[int, list]:
+                   req=None, tctx=None, parent=None) -> tuple[int, list]:
         """Serialize and park one rendezvous transfer; returns
         ``(rndv_id, oob_segments)``.  The blocking path (``req=None``)
         parks one defensive ``bytes()`` copy per payload block — its
         buffer-reuse contract holds the moment send() returns; the
         isend path parks the DESCRIPTOR (the caller's own memoryview
         segments, zero copies) because its contract is deferred to
-        request completion."""
+        request completion.  While tracing is armed the DATA frame
+        carries the send span's wire context (the receiver's deliver
+        span parents on it) and ``parent`` seeds the push leg's span."""
         rndv_id = next(self._rndv_ids)
         header, oob = dss.pack_frames(
-            self.rank, rndv_id, _RNDV_DATA_CID, seq, obj,
+            *self._frame_objs(rndv_id, _RNDV_DATA_CID, seq, obj, tctx),
             oob_min=int(mca_var.get("tcp_zero_copy_min", 0)),
         )
+        if parent is not None:
+            with self._rndv_lock:
+                self._rndv_trace[rndv_id] = int(parent)
         if req is None:
             segments = [header] + [bytes(v) for v in oob]
             spc.record("tcp_rndv_park_copy_bytes",
@@ -1943,18 +2070,26 @@ class TcpProc(errh.HasErrhandler, ulfm.UlfmEndpointAPI, HostCollectives,
         return rndv_id, oob
 
     def _send_rndv(self, obj: Any, dest: int, tag: int, cid: int,
-                   seq: int, nbytes: int) -> None:
+                   seq: int, nbytes: int, tctx=None,
+                   parent=None) -> None:
         """RTS/CTS rendezvous: serialize the payload now (buffer-reuse
         contract), park the data frame locally, announce with a small RTS
         carrying the envelope; the receiver's CTS — handled in the drain
         thread — releases the data on a dedicated (rndv_id, cid) channel."""
-        rndv_id, _oob = self._park_rndv(obj, dest, seq)
+        rndv_id, _oob = self._park_rndv(obj, dest, seq, tctx=tctx,
+                                        parent=parent)
         rts = dss.pack(
-            self.rank, tag, cid, seq,
-            (_RTS_MARK, self.rank, rndv_id, nbytes),
+            *self._frame_objs(
+                tag, cid, seq, (_RTS_MARK, self.rank, rndv_id, nbytes),
+                tctx),
         )
         sock = self._endpoint(dest)
         self._framed_send(sock, rts)
+        if parent is not None and ztrace.active:
+            # the announce leg, parented on the send span
+            ztrace.instant(ztrace.RTS, self.rank, parent=parent,
+                           dest=dest, tag=tag, cid=cid, seq=seq,
+                           nbytes=nbytes)
 
     def _resolve_rndv(self, env: Envelope, payload: Any, deliver) -> bool:
         """If `payload` is an RTS marker, pull the real payload over
@@ -2057,6 +2192,7 @@ class TcpProc(errh.HasErrhandler, ulfm.UlfmEndpointAPI, HostCollectives,
             for rid in dead:
                 self._pending_rndv.pop(rid, None)
                 self._rndv_meta.pop(rid, None)
+                self._rndv_trace.pop(rid, None)
 
     def _deferred_exc(self, e: BaseException, dest: int):
         """Typed completion error for a deferred send that failed on
@@ -2134,6 +2270,7 @@ class TcpProc(errh.HasErrhandler, ulfm.UlfmEndpointAPI, HostCollectives,
                         return  # CTS push started: transport owns it
                     self._pending_rndv.pop(rndv_id, None)
                     self._rndv_meta.pop(rndv_id, None)
+                    self._rndv_trace.pop(rndv_id, None)
             req.complete_error(exc)
 
         def prog():
@@ -2184,6 +2321,7 @@ class TcpProc(errh.HasErrhandler, ulfm.UlfmEndpointAPI, HostCollectives,
             for rid, _req in doomed:
                 self._pending_rndv.pop(rid, None)
                 self._rndv_meta.pop(rid, None)
+                self._rndv_trace.pop(rid, None)
         for _rid, req in doomed:
             if req is not None:
                 req.complete_error(exc)
@@ -2222,12 +2360,13 @@ class TcpProc(errh.HasErrhandler, ulfm.UlfmEndpointAPI, HostCollectives,
             metas = list(self._rndv_meta.values())
             self._pending_rndv.clear()
             self._rndv_meta.clear()
+            self._rndv_trace.clear()
         for _dest, req in metas:
             if req is not None:
                 req.complete_error(exc)
 
     def _isend_eager(self, obj: Any, dest: int, tag: int, cid: int,
-                     seq: int, dispatch):
+                     seq: int, dispatch, tctx=None):
         """Eager deferred send: pin the caller's buffers (pack_frames
         memoryview segments — zero copies) and queue the vectored
         sendmsg on the progress engine; the request completes when the
@@ -2235,7 +2374,7 @@ class TcpProc(errh.HasErrhandler, ulfm.UlfmEndpointAPI, HostCollectives,
         from .requests import SendRequest
 
         header, oob = dss.pack_frames(
-            self.rank, tag, cid, seq, obj,
+            *self._frame_objs(tag, cid, seq, obj, tctx),
             oob_min=int(mca_var.get("tcp_zero_copy_min", 0)),
         )
         segments = [header, *oob]
@@ -2256,7 +2395,8 @@ class TcpProc(errh.HasErrhandler, ulfm.UlfmEndpointAPI, HostCollectives,
         return req
 
     def _isend_rndv(self, obj: Any, dest: int, tag: int, cid: int,
-                    seq: int, nbytes: int, dispatch):
+                    seq: int, nbytes: int, dispatch, tctx=None,
+                    parent=None):
         """Rendezvous deferred send: the RTS parks only the DESCRIPTOR
         — the caller's buffers pinned by the request, no copy-at-park —
         and the receiver's CTS releases a push of those buffers
@@ -2268,12 +2408,18 @@ class TcpProc(errh.HasErrhandler, ulfm.UlfmEndpointAPI, HostCollectives,
         req = SendRequest(dispatch=dispatch)
         self._inflight.add(req)
         spc.record("tcp_isend_deferred", 1)
-        rndv_id, _oob = self._park_rndv(obj, dest, seq, req=req)
+        rndv_id, _oob = self._park_rndv(obj, dest, seq, req=req,
+                                        tctx=tctx, parent=parent)
         self._arm_isend_poison(req, dest, cid, rndv_id=rndv_id)
         rts = dss.pack(
-            self.rank, tag, cid, seq,
-            (_RTS_MARK, self.rank, rndv_id, nbytes),
+            *self._frame_objs(
+                tag, cid, seq, (_RTS_MARK, self.rank, rndv_id, nbytes),
+                tctx),
         )
+        if parent is not None and ztrace.active:
+            ztrace.instant(ztrace.RTS, self.rank, parent=parent,
+                           dest=dest, tag=tag, cid=cid, seq=seq,
+                           nbytes=nbytes)
 
         def send_rts():
             sock = self._endpoint(dest)
@@ -2286,7 +2432,8 @@ class TcpProc(errh.HasErrhandler, ulfm.UlfmEndpointAPI, HostCollectives,
         return req
 
     def _isend_sm(self, smtx: sm_mod.SmSender, obj: Any, dest: int,
-                  tag: int, cid: int, seq: int, nbytes: int, dispatch):
+                  tag: int, cid: int, seq: int, nbytes: int, dispatch,
+                  tctx=None):
         """Shared-memory deferred send.  Ring backpressure already IS
         the in-flight bound, so a small frame tries the single-slot
         copy-in NONBLOCKING and is born complete when it lands; a full
@@ -2302,10 +2449,11 @@ class TcpProc(errh.HasErrhandler, ulfm.UlfmEndpointAPI, HostCollectives,
         ch = self._out_channels.get(dest)
         idle = ch is None or not ch.busy()
         oob_min = int(mca_var.get("tcp_zero_copy_min", 0))
+        frame_objs = self._frame_objs(tag, cid, seq, obj, tctx)
         if idle and nbytes + 512 <= min(smtx.slot_bytes, 32 << 10):
             try:
                 wire = smtx.send_direct(
-                    (self.rank, tag, cid, seq, obj), oob_min,
+                    frame_objs, oob_min,
                     time.monotonic(), None,
                 )
             except sm_mod.RingFull:
@@ -2327,8 +2475,7 @@ class TcpProc(errh.HasErrhandler, ulfm.UlfmEndpointAPI, HostCollectives,
             # the consumer, so this is still nonblocking, and it skips
             # a worker handoff whose scheduling quantum costs more than
             # the copy on small hosts (measured on the han pipeline)
-            prebuilt = dss.pack_frames(self.rank, tag, cid, seq, obj,
-                                       oob_min=oob_min)
+            prebuilt = dss.pack_frames(*frame_objs, oob_min=oob_min)
             try:
                 done = smtx.try_send_frame(*prebuilt)
             except (errors.MpiError, OSError) as e:
@@ -2352,7 +2499,10 @@ class TcpProc(errh.HasErrhandler, ulfm.UlfmEndpointAPI, HostCollectives,
                 # the DSS pack twice for exactly the largest payloads)
                 self._sm_send_prebuilt(smtx, dest, *prebuilt)
             else:
-                self._sm_send(smtx, obj, dest, tag, cid, seq, nbytes)
+                # frame_objs already accounted its wire-context bytes:
+                # hand the built header values through, not tctx
+                self._sm_send(smtx, obj, dest, tag, cid, seq, nbytes,
+                              objs=frame_objs)
 
         self._enqueue_deferred(dest, req, work, finish=True)
         return req
@@ -2427,6 +2577,16 @@ class TcpProc(errh.HasErrhandler, ulfm.UlfmEndpointAPI, HostCollectives,
                 dispatch=dispatch,
             )
         seq = next(self._seq)
+        # tracing plane (armed only): the deferred send span is an
+        # instant at dispatch — the rendezvous push/deliver legs carry
+        # the durations — and its context rides every frame below
+        tspan = tctx = None
+        if ztrace.active and not poll:
+            tspan = ztrace.begin(ztrace.SEND, self.rank, dest=dest,
+                                 tag=tag, cid=cid, seq=seq, nb=True)
+            tctx = ztrace.wire_context(tspan.sid, seq)
+            if tctx is None:
+                tspan = None  # a disarm raced begin(): send untraced
         if dest == self.rank:
             # loopback (btl/self): the single defensive copy IS
             # completion — born complete, exactly like the blocking path
@@ -2442,19 +2602,37 @@ class TcpProc(errh.HasErrhandler, ulfm.UlfmEndpointAPI, HostCollectives,
             with self._incoming_cv:
                 self.engine.incoming(env, payload)
                 self._incoming_cv.notify_all()
+            if tspan is not None:
+                ztrace.instant(ztrace.DELIVER, self.rank,
+                               parent=tspan.sid, trace=tctx[0],
+                               src=self.rank, tag=tag, cid=cid, seq=seq,
+                               transport="self")
+                tspan.end(transport="self")
             return SendRequest.completed()
         nbytes = _payload_size(obj)
         smtx = self._sm_tx(dest)
         if smtx is not None:
-            return self._isend_sm(smtx, obj, dest, tag, cid, seq,
-                                  nbytes, dispatch)
+            req = self._isend_sm(smtx, obj, dest, tag, cid, seq,
+                                 nbytes, dispatch, tctx=tctx)
+            if tspan is not None:
+                tspan.end(transport="sm")
+            return req
         if dest in self._sm_declined:
             spc.record("sm_fallback_tcp_sends", 1)
         limit = int(mca_var.get("tcp_eager_limit", 1 << 20))
         if nbytes > limit:
-            return self._isend_rndv(obj, dest, tag, cid, seq, nbytes,
-                                    dispatch)
-        return self._isend_eager(obj, dest, tag, cid, seq, dispatch)
+            req = self._isend_rndv(obj, dest, tag, cid, seq, nbytes,
+                                   dispatch, tctx=tctx,
+                                   parent=tspan.sid if tspan is not None
+                                   else None)
+            if tspan is not None:
+                tspan.end(transport="rndv")
+            return req
+        req = self._isend_eager(obj, dest, tag, cid, seq, dispatch,
+                                tctx=tctx)
+        if tspan is not None:
+            tspan.end(transport="tcp")
+        return req
 
     def irecv(self, source: int = ANY_SOURCE, tag: int = ANY_TAG,
               cid: int = 0, poll: bool = False):
@@ -2527,6 +2705,13 @@ class TcpProc(errh.HasErrhandler, ulfm.UlfmEndpointAPI, HostCollectives,
         if flightrec.active and not poll:
             flightrec.record(flightrec.RECV, rank=self.rank, src=source,
                              tag=tag, cid=cid)
+        # tracing plane: the recv span covers post → completion (its
+        # start vs the deliver span's stamp is the late-sender /
+        # late-receiver signal); an error/timeout path never ends it
+        trecv = None
+        if ztrace.active and not poll:
+            trecv = ztrace.begin(ztrace.RECV, self.rank, src=source,
+                                 tag=tag, cid=cid)
         result: list[Any] = []
         envs: list[Envelope] = []
         done = threading.Event()
@@ -2608,6 +2793,8 @@ class TcpProc(errh.HasErrhandler, ulfm.UlfmEndpointAPI, HostCollectives,
                 # handler's return value becomes the API result
                 # (core/errhandler.py's error-recovery contract)
                 return self.call_errhandler(exc)
+        if trecv is not None:
+            trecv.end(src=envs[0].src, tag=envs[0].tag)
         if return_status:
             from .requests import Status, _payload_bytes
 

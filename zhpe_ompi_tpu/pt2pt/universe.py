@@ -35,6 +35,7 @@ from ..core import errors
 from ..ft import ulfm
 from ..mca import var as mca_var
 from ..runtime import spc
+from ..runtime import ztrace
 from ..utils import lockdep
 from . import matching
 from .matching import ANY_SOURCE, ANY_TAG, Envelope
@@ -144,7 +145,8 @@ class RankContext(errh.HasErrhandler, ulfm.UlfmEndpointAPI,
         self.engine = matching.make_matching_engine()
         self.mailbox: queue.Queue = queue.Queue()
         self._seq = itertools.count()
-        self._pending_rndv: dict[int, tuple[Any, Request]] = {}
+        # rndv_id -> (payload, send Request, Envelope, trace ctx|None)
+        self._pending_rndv: dict[int, tuple] = {}
         self._rndv_ids = itertools.count()
         self._lock = lockdep.lock("pt2pt.RankContext._lock")
 
@@ -179,6 +181,19 @@ class RankContext(errh.HasErrhandler, ulfm.UlfmEndpointAPI,
             raise errors.RankError(f"rank {dest} out of range")
         return self.universe.contexts[dest].mailbox
 
+    def _trace_deliver(self, kind: str, env: Envelope, tctx,
+                       **fields) -> None:
+        """Receiver half of the thread-plane trace propagation: the
+        mailbox tuple carried the sender's span context (no wire — the
+        context rides in-memory), so the deliver/cts span parents on
+        the sender's send span exactly like the socket plane's."""
+        if tctx is None or not ztrace.active:
+            return
+        # zlint: disable=ZL010 -- kind arrives via this helper's parameter; both call sites pass the documented ztrace.DELIVER/CTS constants
+        ztrace.instant(kind, self.rank, parent=tctx[1], trace=tctx[0],
+                       src=env.src, tag=env.tag, cid=env.cid,
+                       seq=int(tctx[2]), transport="thread", **fields)
+
     def progress(self) -> None:
         """Drain the mailbox (opal_progress analog, weak progress)."""
         while True:
@@ -187,24 +202,27 @@ class RankContext(errh.HasErrhandler, ulfm.UlfmEndpointAPI,
             except queue.Empty:
                 return
             if kind == _EAGER:
-                env, payload = rest
+                env, payload, tctx = rest
+                self._trace_deliver(ztrace.DELIVER, env, tctx)
                 self.engine.incoming(env, payload)
             elif kind == _RTS:
                 # rendezvous announce: enters matching with a token the
                 # receive-side callback turns into a CTS (irecv.on_match)
-                env, sender_rank, rndv_id = rest
+                env, sender_rank, rndv_id, tctx = rest
+                self._trace_deliver(ztrace.CTS, env, tctx)
                 self.engine.incoming(env, _RndvToken(sender_rank, rndv_id))
             elif kind == _CTS:
                 rndv_id, dest_rank, req_token = rest
                 with self._lock:
                     entry = self._pending_rndv.pop(rndv_id, None)
                 if entry is not None:
-                    payload, sreq = entry
+                    payload, sreq, env, tctx = entry
                     # copy at handoff: the send completes now, so the
                     # sender may reuse its buffer before the receiver
                     # drains the message
                     self._mbox(dest_rank).put(
-                        (_DATA, req_token, _eager_copy(payload)))
+                        (_DATA, req_token, _eager_copy(payload), env,
+                         tctx))
                     sreq.complete()
                 # else: the park was poisoned-and-released (sendrecv
                 # classified the partner dead/revoked) — the send
@@ -212,7 +230,14 @@ class RankContext(errh.HasErrhandler, ulfm.UlfmEndpointAPI,
                 # crash this progress loop nor deliver a payload whose
                 # buffer the caller reclaimed at the typed raise
             elif kind == _DATA:
-                req_token, payload = rest
+                req_token, payload, env, tctx = rest
+                # leg="data": the rendezvous message already paired at
+                # its CTS leg — unlike the tcp plane, this deliver
+                # carries the USER envelope (no protocol cid), so the
+                # pairing pass needs the marker to not consume a
+                # second recv for the same message
+                self._trace_deliver(ztrace.DELIVER, env, tctx,
+                                    leg="data")
                 req_token(payload)
 
     # -- sends -----------------------------------------------------------
@@ -262,16 +287,28 @@ class RankContext(errh.HasErrhandler, ulfm.UlfmEndpointAPI,
         nbytes = _payload_nbytes(obj)
         spc.record("pt2pt_sends", 1)
         spc.record("pt2pt_bytes_sent", nbytes)
+        # tracing plane (armed only): the send span's context rides the
+        # mailbox tuple — the thread plane's "wire" — so the receiver's
+        # deliver span parents on it exactly like the socket plane's
+        tctx = None
+        if ztrace.active and not poll:
+            tspan = ztrace.begin(ztrace.SEND, self.rank, dest=dest,
+                                 tag=tag, cid=cid, seq=env.seq)
+            tctx = ztrace.wire_context(tspan.sid, env.seq)
         eager_limit = int(mca_var.get("pt2pt_eager_limit", 64 * 1024))
         req = Request(progress=self.progress)
         if nbytes <= eager_limit:
-            self._mbox(dest).put((_EAGER, env, _eager_copy(obj)))
+            self._mbox(dest).put((_EAGER, env, _eager_copy(obj), tctx))
             req.complete()
+            if tctx is not None:
+                tspan.end(transport="thread")
         else:
             rndv_id = next(self._rndv_ids)
             with self._lock:
-                self._pending_rndv[rndv_id] = (obj, req)
-            self._mbox(dest).put((_RTS, env, self.rank, rndv_id))
+                self._pending_rndv[rndv_id] = (obj, req, env, tctx)
+            self._mbox(dest).put((_RTS, env, self.rank, rndv_id, tctx))
+            if tctx is not None:
+                tspan.end(transport="thread-rndv")
         return req
 
     def send(self, obj: Any, dest: int, tag: int = 0, cid: int = 0,
@@ -286,8 +323,8 @@ class RankContext(errh.HasErrhandler, ulfm.UlfmEndpointAPI,
         the caller's post-failure mutations (the _CTS handler treats a
         released id as a no-op)."""
         with self._lock:
-            dead = [k for k, (_, r) in self._pending_rndv.items()
-                    if r is req]
+            dead = [k for k, entry in self._pending_rndv.items()
+                    if entry[1] is req]
             for k in dead:
                 del self._pending_rndv[k]
 
@@ -383,8 +420,16 @@ class RankContext(errh.HasErrhandler, ulfm.UlfmEndpointAPI,
         if self.universe.ft_state is not None:
             return self._ft_recv(source, tag, cid, timeout,
                                  return_status, poll)
+        trecv = None
+        if ztrace.active and not poll:
+            trecv = ztrace.begin(ztrace.RECV, self.rank, src=source,
+                                 tag=tag, cid=cid)
         req = self.irecv(source, tag, cid)
         value = req.wait(timeout)
+        if trecv is not None:
+            # the matched envelope, not the posted wildcard: a span
+            # recording src=-1 forever would lie to the merged timeline
+            trecv.end(src=req.status.source, tag=req.status.tag)
         if return_status:
             return value, req.status
         return value
@@ -436,6 +481,10 @@ class RankContext(errh.HasErrhandler, ulfm.UlfmEndpointAPI,
                 deliver(env, payload)
 
         exc: errors.MpiError | None = None
+        trecv = None
+        if ztrace.active and not poll:
+            trecv = ztrace.begin(ztrace.RECV, self.rank, src=source,
+                                 tag=tag, cid=cid)
         self.engine.post_recv(source, tag, cid, on_match)
         deadline = (None if timeout is None
                     else time.monotonic() + timeout)
@@ -465,6 +514,8 @@ class RankContext(errh.HasErrhandler, ulfm.UlfmEndpointAPI,
                 raise exc
             return self.call_errhandler(exc)
         value, env = box[0], envs[0]
+        if trecv is not None:
+            trecv.end(src=env.src, tag=env.tag)
         if return_status:
             return value, Status(
                 source=env.src, tag=env.tag,

@@ -276,13 +276,14 @@ class _Job:
 
     def __init__(self, job_id: str, size: int, cmds: list[list[str]],
                  ft: bool, mca: list, session: str, conn, conn_lock,
-                 metrics: bool = False):
+                 metrics: bool = False, trace: bool = False):
         self.id = job_id
         self.size = size
         self.cmds = cmds
         self.ft = ft
         self.mca = mca
         self.metrics = metrics
+        self.trace = trace
         self.session = session
         self.conn = conn              # IOF/exit stream target
         self.conn_lock = conn_lock
@@ -546,6 +547,10 @@ class Dvm(pmix_mod.FramedRpcServer):
             # the opt-in metrics plane: every rank of this job runs the
             # spc publisher against the resident store
             env["ZMPI_METRICS"] = "1"
+        if job.trace:
+            # the tracing plane rides the metrics publisher: every
+            # rank arms its span recorder and ships trace:<job>:<rank>
+            env["ZMPI_TRACE"] = "1"
         if rejoin is not None:
             # recovery-window metadata: the bumped namespace generation
             # and the whole batch of co-respawned ranks, so each
@@ -607,7 +612,12 @@ class Dvm(pmix_mod.FramedRpcServer):
                 f"{self.session}_{job_id}",
                 conn, conn_lock,
                 metrics=bool(spec.get("metrics")),
+                # trace implies metrics (the publisher ships the span
+                # buffers): a trace-only launch gets both planes
+                trace=bool(spec.get("trace")),
             )
+            if job.trace:
+                job.metrics = True
             self._jobs[job_id] = job
         # the namespace IS the jobid: ranks modex through the resident
         # store with zero per-job rendezvous infrastructure
@@ -906,7 +916,8 @@ class DvmClient:
     def launch(self, n: int, argv: list[str],
                mca: list | None = None, ft: bool = False,
                timeout: float | None = None, tag_output: bool = True,
-               stdout=None, stderr=None, metrics: bool = False) -> int:
+               stdout=None, stderr=None, metrics: bool = False,
+               trace: bool = False) -> int:
         """Launch an n-rank job into the resident VM; streams its IOF
         and returns the job exit code (the ``zmpirun`` surface, minus
         the per-job launcher)."""
@@ -917,7 +928,8 @@ class DvmClient:
         stderr = stderr if stderr is not None else sys.stderr
         spec = {"n": int(n), "argv": [str(a) for a in argv],
                 "mca": [list(m) for m in (mca or [])], "ft": bool(ft),
-                "timeout": timeout, "metrics": bool(metrics)}
+                "timeout": timeout, "metrics": bool(metrics),
+                "trace": bool(trace)}
         # no client-imposed deadline without an explicit job timeout:
         # the daemon enforces its own (tunable) dvm_job_timeout and
         # ALWAYS sends the exit frame, and a daemon crash surfaces as

@@ -76,15 +76,30 @@ class FlightRecorder:
         self._slots: list[dict | None] = [None] * self._cap
         self._n = 0  # total events ever recorded (next seq)
         self._lock = threading.Lock()
+        # merge-safe clock domain (shared with runtime/ztrace.py):
+        # events stamp monotonic ns — a wall clock stepping under NTP
+        # mid-window would corrupt cross-rank ordering — and the ring
+        # carries ONE wall anchor captured back-to-back with its
+        # monotonic twin, so consumers map stamps onto the wall clock
+        # through a fixed offset
+        self.anchor_wall = time.time()
+        self.anchor_mono_ns = time.monotonic_ns()
 
     @property
     def capacity(self) -> int:
         return self._cap
 
+    def anchors(self) -> tuple[float, int]:
+        """(anchor_wall, anchor_mono_ns): the ring's clock anchor —
+        ``anchor_wall + (t_ns - anchor_mono_ns)/1e9`` is an event's
+        wall time."""
+        return self.anchor_wall, self.anchor_mono_ns
+
     def record(self, etype: str, **fields: Any) -> None:
-        """One typed event: seq + wall-clock stamp + the caller's small
-        DSS-packable fields.  Lock-cheap: slot write and index bump."""
-        evt = {"t": time.time(), "type": etype}
+        """One typed event: seq + monotonic-ns stamp + the caller's
+        small DSS-packable fields.  Lock-cheap: slot write and index
+        bump."""
+        evt = {"t_ns": time.monotonic_ns(), "type": etype}
         evt.update(fields)
         with self._lock:
             i = self._n % self._cap
@@ -118,6 +133,10 @@ class FlightRecorder:
         with self._lock:
             self._slots = [None] * self._cap
             self._n = 0
+            # a fresh window gets a fresh anchor: the old pair mapped
+            # stamps nobody can see anymore
+            self.anchor_wall = time.time()
+            self.anchor_mono_ns = time.monotonic_ns()
 
 
 _recorder = FlightRecorder()
@@ -132,6 +151,11 @@ def record(etype: str, **fields: Any) -> None:
 
 def window(n: int | None = None) -> list[dict]:
     return _recorder.window(n)
+
+
+def anchors() -> tuple[float, int]:
+    """(anchor_wall, anchor_mono_ns) of the process-global ring."""
+    return _recorder.anchors()
 
 
 def total() -> int:

@@ -7,13 +7,18 @@ matching engine fires them inline.
 
 Cost discipline: the hot path pays ONE module-attribute boolean check when
 no subscriber exists (the reference compiles PERUSE out entirely; a traced
-runtime can't, so the gate is the cheapest possible).
+runtime can't, so the gate is the cheapest possible).  The ARMED hot path
+is lock-free too: the subscriber table is copy-on-write — ``fire()``
+reads one immutable dict of tuples and never takes the registry lock,
+so N sender threads firing per-message events (armed tracing fires on
+every match) are never serialized behind a subscribe/unsubscribe, and
+a subscriber that re-enters subscribe()/unsubscribe() from inside its
+own callback cannot deadlock.
 """
 
 from __future__ import annotations
 
 import threading
-from collections import defaultdict
 from typing import Any, Callable
 
 # Event names mirror the PERUSE_COMM_* enum (peruse.h).
@@ -33,7 +38,11 @@ ALL_EVENTS = (
     MSG_REMOVE_FROM_UNEX_Q, MSG_MATCH_POSTED_REQ,
 )
 
-_subscribers: dict[str, list[Callable[..., None]]] = defaultdict(list)
+# Copy-on-write subscriber table: an IMMUTABLE dict of tuples, swapped
+# wholesale under _lock by subscribe/unsubscribe.  fire() reads it with
+# one attribute load — no lock, no copy — so armed per-message events
+# never serialize sender threads (the match hot path's contract).
+_subscribers: dict[str, tuple[Callable[..., None], ...]] = {}
 _lock = threading.Lock()
 
 # Hot-path gate: matching engines check this bare module attribute.
@@ -42,28 +51,37 @@ active = False
 
 def subscribe(event: str, fn: Callable[..., None]) -> Callable[..., None]:
     """PERUSE_Event_comm_register analog; returns `fn` as the handle."""
+    global active, _subscribers
     if event not in ALL_EVENTS:
         raise ValueError(f"unknown PERUSE event {event!r}")
-    global active
     with _lock:
-        _subscribers[event].append(fn)
-        active = True
+        table = dict(_subscribers)
+        table[event] = table.get(event, ()) + (fn,)
+        _subscribers = table  # one atomic rebind: firing threads see
+        active = True         # either the old or the new table, whole
     return fn
 
 
 def unsubscribe(event: str, fn: Callable[..., None]) -> None:
-    global active
+    global active, _subscribers
     with _lock:
-        try:
-            _subscribers[event].remove(fn)
-        except ValueError:
-            pass
-        active = any(v for v in _subscribers.values())
+        table = dict(_subscribers)
+        subs = table.get(event, ())
+        if fn in subs:
+            i = subs.index(fn)
+            remaining = subs[:i] + subs[i + 1:]
+            if remaining:
+                table[event] = remaining
+            else:
+                table.pop(event, None)
+        _subscribers = table
+        active = any(table.values())
 
 
 def fire(event: str, **info: Any) -> None:
-    """Called by the matching engine under its `active` gate."""
-    with _lock:
-        subs = list(_subscribers.get(event, ()))
-    for fn in subs:
+    """Called by the matching engine under its `active` gate.  Reads
+    the copy-on-write table with ONE attribute load — never the lock:
+    the armed hot path fires per message and must not serialize sender
+    threads behind a registry mutation."""
+    for fn in _subscribers.get(event, ()):
         fn(event=event, **info)

@@ -76,16 +76,18 @@ def live_servers() -> list[str]:
 
 def stale_metric_keys() -> list[str]:
     """Published ``metrics:*`` / ``flightrec:*`` / ``metrics_base:*``
-    keys still held in any tracked store at session end — namespace
-    destroy drops a job's whole keyspace, so anything here is a
-    metrics-plane leak (a publisher outliving its job, or a bench
-    namespace nobody tore down)."""
+    / ``trace:*`` / ``tracesync:*`` keys still held in any tracked
+    store at session end — namespace destroy drops a job's whole
+    keyspace, so anything here is an observability-plane leak (a
+    publisher outliving its job, or a bench namespace nobody tore
+    down)."""
     out = []
     for store in list(_live_stores):
         for ns in store.namespaces():
             for key in store.lookup(ns):
                 if key.startswith(("metrics:", "flightrec:",
-                                   "metrics_base:")):
+                                   "metrics_base:", "trace:",
+                                   "tracesync:")):
                     out.append(f"pmix-key:{ns}:{key}")
     return out
 
@@ -491,6 +493,8 @@ class PmixServer(FramedRpcServer):
             return s.bump_generation(req[1])
         if op == "generation":
             return s.generation(req[1])
+        if op == "lookup":
+            return s.lookup(req[1], req[2] if len(req) > 2 else None)
         if op == "stat":
             return s.stat()
         if op == "ping":
@@ -577,6 +581,13 @@ class PmixClient:
 
     def fence(self, ns: str, rank: int, timeout: float = 30.0) -> None:
         self._call(["fence", ns, int(rank), float(timeout)], wait=timeout)
+
+    def lookup(self, ns: str, prefix: str | None = None) -> dict:
+        """Non-blocking prefix view over a namespace's published keys
+        (:meth:`PmixStore.lookup` over the wire) — the ``tools/ztrace``
+        collector reads ``trace:*`` buffers through this without
+        blocking on ranks that never published."""
+        return self._call(["lookup", ns, prefix])
 
     def bump_generation(self, ns: str) -> int:
         return int(self._call(["bumpgen", ns]))

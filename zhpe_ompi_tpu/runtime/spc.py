@@ -197,6 +197,27 @@ recorded by this module's :class:`MetricsPublisher` and by
   smaller than the traffic between publishes is visible here, not
   silent.
 
+Tracing-plane counters (the causal half — ``runtime/ztrace.py``
+records the span ring, ``pt2pt/tcp.py``/``pt2pt/universe.py`` put the
+wire context on the frames; the zlint ZL010 rule keeps the span kinds
+at the recording seams inside ztrace's documented table):
+
+- ``trace_spans_recorded`` — spans recorded into the per-process
+  ztrace ring while the tracing plane is armed (send/deliver/recv,
+  rendezvous RTS/CTS/push legs, han phase enter/exit at every level,
+  FT classification→agree→shrink→respawn).  The OSU ``--trace`` A/B
+  row gates on this rising at every ladder point of the armed run —
+  and staying ZERO on the disarmed run.
+- ``trace_spans_dropped`` — span-ring overwrites: spans displaced
+  from the fixed-size buffer (``ztrace_capacity`` slots) before a
+  publish shipped them; a buffer smaller than the traffic between
+  publishes is visible here, not silent.
+- ``trace_wire_context_bytes`` — bytes of ``(trace_id, parent_sid,
+  seq)`` context appended to DSS frame headers while armed.  The
+  zero-overhead-when-off contract is the inverse gate: a DISARMED
+  run's wire byte counters must be byte-identical to an untraced
+  baseline, and this counter must stay zero.
+
 Templated counter families (dynamic names routed through literal
 templates at the call site; the zlint ZL009 publisher-seam rule
 matches recorded names against these — an f-string counter whose
@@ -337,7 +358,8 @@ class MetricsPublisher(threading.Thread):
     Waits are event-based (``Event.wait(interval)``) — never polling.
     """
 
-    def __init__(self, pmix_addr, namespace: str, rank: int):
+    def __init__(self, pmix_addr, namespace: str, rank: int,
+                 trace: bool = False):
         super().__init__(
             daemon=True, name=f"spc-pub-{namespace}-{rank}",
         )
@@ -361,6 +383,17 @@ class MetricsPublisher(threading.Thread):
         from . import flightrec
 
         flightrec.arm()
+        # tracing plane (opt-in on top of metrics): arm the span
+        # recorder the same way and ship the trace buffer as
+        # trace:<job>:<rank> with every snapshot — a victim killed -9
+        # mid-job leaves its LAST periodic buffer in the store (the
+        # postmortem the merged timeline is built from); the final
+        # flush at stop() ships the rest
+        self._trace = bool(trace)
+        if self._trace:
+            from . import ztrace
+
+            ztrace.arm(match_events=True)
         self._armed = True
         _live_publishers.add(self)
 
@@ -427,6 +460,11 @@ class MetricsPublisher(threading.Thread):
         payload = self._snapshot_payload(final)
         try:
             self._put(f"metrics:{self.namespace}:{self.rank}", payload)
+            if self._trace:
+                from . import ztrace
+
+                self._put(f"trace:{self.namespace}:{self.rank}",
+                          ztrace.payload(self.rank))
         except errors.MpiError as e:
             self._dead = True
             mca_output.verbose(
@@ -446,9 +484,14 @@ class MetricsPublisher(threading.Thread):
         from ..core import errors
         from . import flightrec
 
+        wall, mono = flightrec.anchors()
         try:
+            # events stamp monotonic ns (merge-safe under NTP steps);
+            # the ring's wall anchor ships WITH the window so store
+            # consumers can map the stamps onto the wall clock
             self._put(f"flightrec:{self.namespace}:{self.rank}",
-                      flightrec.window())
+                      {"anchor_wall": wall, "anchor_mono_ns": mono,
+                       "events": flightrec.window()})
         except errors.MpiError as e:
             mca_output.verbose(
                 2, _stream, "metrics publisher %s: flightrec publish "
@@ -487,6 +530,10 @@ class MetricsPublisher(threading.Thread):
             from . import flightrec
 
             flightrec.disarm()
+            if self._trace:
+                from . import ztrace
+
+                ztrace.disarm(match_events=True)
             self._armed = False
         if not self._launched:
             self._client.close()

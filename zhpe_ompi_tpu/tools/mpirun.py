@@ -221,7 +221,7 @@ def launch_dvm(dvm: str, n: int, argv: list[str],
                mca: list[tuple[str, str]] | None = None,
                timeout: float | None = None, tag_output: bool = True,
                stdout=None, stderr=None, ft: bool = False,
-               metrics: bool = False) -> int:
+               metrics: bool = False, trace: bool = False) -> int:
     """Launch a job INTO a resident runtime daemon (``zmpirun --dvm``):
     the zprted VM hosts the PMIx store and the children, streams their
     IOF back here, and outlives the job — no per-job rendezvous, no
@@ -235,7 +235,8 @@ def launch_dvm(dvm: str, n: int, argv: list[str],
     try:
         return client.launch(n, argv, mca=mca, ft=ft, timeout=timeout,
                              tag_output=tag_output, stdout=stdout,
-                             stderr=stderr, metrics=metrics)
+                             stderr=stderr, metrics=metrics,
+                             trace=trace)
     finally:
         client.close()
 
@@ -454,6 +455,12 @@ def main(args: list[str] | None = None) -> int:
                          "publishes its SPC counters into the resident "
                          "store (ZMPI_METRICS=1), scrapeable via the "
                          "daemon's metrics RPC / --metrics-port")
+    ap.add_argument("--trace", action="store_true",
+                    help="tracing plane (--dvm only, implies "
+                         "--metrics): every rank records causal spans "
+                         "(ZMPI_TRACE=1) and publishes trace:<job>:"
+                         "<rank> buffers for tools/ztrace's merged "
+                         "timeline")
     ap.add_argument("argv", nargs=argparse.REMAINDER,
                     help="program and its arguments")
     raw = list(sys.argv[1:] if args is None else args)
@@ -476,11 +483,11 @@ def main(args: list[str] | None = None) -> int:
         # later and ignoring them would silently drop user intent
         if (more.host != "127.0.0.1" or more.mca or
                 more.timeout is not None or more.no_tag_output or
-                more.dvm or more.ft or more.metrics):
+                more.dvm or more.ft or more.metrics or more.trace):
             ap.error(
                 "--host/--mca/--timeout/--no-tag-output/--dvm/--ft/"
-                "--metrics are job-global: pass them in the first app "
-                "context"
+                "--metrics/--trace are job-global: pass them in the "
+                "first app context"
             )
         apps.append((more.n, more.argv))
     # signal hygiene (main thread only — the CLI path): SIGINT/SIGTERM
@@ -504,11 +511,12 @@ def main(args: list[str] | None = None) -> int:
                 mca=[tuple(m) for m in first.mca],
                 timeout=first.timeout,
                 tag_output=not first.no_tag_output, ft=first.ft,
-                metrics=first.metrics,
+                metrics=first.metrics or first.trace,
+                trace=first.trace,
             )
-        if first.metrics:
-            ap.error("--metrics needs the resident store: run with "
-                     "--dvm")
+        if first.metrics or first.trace:
+            ap.error("--metrics/--trace need the resident store: run "
+                     "with --dvm")
         return launch_mpmd(
             apps, host=first.host, mca=[tuple(m) for m in first.mca],
             timeout=first.timeout, tag_output=not first.no_tag_output,

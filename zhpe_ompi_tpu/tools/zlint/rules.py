@@ -1,4 +1,4 @@
-"""zlint rules ZL001–ZL009.
+"""zlint rules ZL001–ZL010.
 
 Every rule encodes an invariant a REAL bug in this repo's history
 violated; the docstrings cite the incident so the rule's teeth are
@@ -918,12 +918,137 @@ class LoudDegradation(Rule):
         return out
 
 
+# ----------------------------------------------------------------------
+class TraceKindParity(Rule):
+    """ZL010 — flight-recorder events and ztrace spans are TYPED by
+    contract: every ``flightrec.record(KIND, ...)`` /
+    ``ztrace.record_span/instant/begin(KIND, ...)`` call site's kind
+    must resolve into the documented type table of its plane (the
+    module-level constants enumerated by ``flightrec.ALL_EVENTS`` /
+    ``ztrace.ALL_KINDS``) — the ZL009 publisher-seam discipline
+    applied to the event planes.
+
+    Grounding: the metrics publisher ships both buffers into the
+    store verbatim and ``tools/ztrace`` classifies the merged timeline
+    BY KIND — a seam recording a misspelled or undeclared kind
+    publishes events every consumer (the critical-path report, the
+    flightrec postmortem view, the test gates asserting tail-entry
+    types) silently drops.  A literal outside the table, an attribute
+    that names no declared constant, or a first argument that resolves
+    to no literal at all is the bug shape.
+
+    Active only when the scan set includes the plane's anchor module
+    (``runtime/flightrec.py`` / ``runtime/ztrace.py``), like
+    ZL006/ZL007/ZL009.
+    """
+
+    id = "ZL010"
+    title = "trace-kind-parity"
+    guards = ("PR 12: a misspelled span kind publishes as a type no "
+              "timeline consumer matches")
+
+    #: receiver -> (anchor path suffix, ALL-table name, flagged calls)
+    PLANES = {
+        "flightrec": ("runtime/flightrec.py", "ALL_EVENTS",
+                      ("record",)),
+        "ztrace": ("runtime/ztrace.py", "ALL_KINDS",
+                   ("record_span", "instant", "begin")),
+    }
+
+    def __init__(self):
+        # plane -> (const name -> value, documented kind values)
+        self.tables: dict[str, tuple[dict[str, str], set[str]]] = {}
+        self.sites: list[tuple[str, Module, ast.Call, ast.AST]] = []
+
+    def _harvest(self, mod: Module, all_name: str
+                 ) -> tuple[dict[str, str], set[str]]:
+        consts: dict[str, str] = {}
+        listed: set[str] = set()
+        for stmt in mod.tree.body:
+            if not isinstance(stmt, ast.Assign) \
+                    or len(stmt.targets) != 1 \
+                    or not isinstance(stmt.targets[0], ast.Name):
+                continue
+            tname = stmt.targets[0].id
+            if isinstance(stmt.value, ast.Constant) \
+                    and isinstance(stmt.value.value, str):
+                consts[tname] = stmt.value.value
+            elif tname == all_name \
+                    and isinstance(stmt.value, ast.Tuple):
+                for el in stmt.value.elts:
+                    if isinstance(el, ast.Name):
+                        listed.add(el.id)
+        kinds = {consts[n] for n in listed if n in consts}
+        return consts, kinds
+
+    def visit(self, mod: Module) -> list[Finding]:
+        for plane, (suffix, all_name, calls) in self.PLANES.items():
+            if mod.path_key.endswith(suffix) \
+                    or mod.path_key == suffix.rsplit("/", 1)[-1]:
+                self.tables[plane] = self._harvest(mod, all_name)
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call) or not node.args:
+                continue
+            recv = call_receiver(node)
+            plane = self.PLANES.get(recv) if recv else None
+            if plane is None or call_name(node) not in plane[2]:
+                continue
+            self.sites.append((recv, mod, node, node.args[0]))
+        return []
+
+    def finalize(self, mods: list[Module]) -> list[Finding]:
+        out: list[Finding] = []
+        sites, self.sites = self.sites, []
+        tables, self.tables = self.tables, {}
+        for plane, mod, node, arg0 in sites:
+            if plane not in tables:
+                continue  # anchor-gated per plane
+            consts, kinds = tables[plane]
+            if isinstance(arg0, ast.IfExp):
+                # ``KIND_A if cond else KIND_B``: both arms resolve
+                # independently (the ZL006 IfExp discipline)
+                sites.append((plane, mod, node, arg0.body))
+                sites.append((plane, mod, node, arg0.orelse))
+                continue
+            if isinstance(arg0, ast.Constant) \
+                    and isinstance(arg0.value, str):
+                if arg0.value not in kinds:
+                    out.append(mod.finding(
+                        self.id, node, f"unknown:{plane}:{arg0.value}",
+                        f"`{plane}` kind literal {arg0.value!r} is "
+                        f"outside the documented "
+                        f"{self.PLANES[plane][1]} table — no timeline "
+                        "consumer will ever match it",
+                    ))
+                continue
+            if isinstance(arg0, ast.Attribute) \
+                    and isinstance(arg0.value, ast.Name) \
+                    and arg0.value.id == plane:
+                value = consts.get(arg0.attr)
+                if value is None or value not in kinds:
+                    out.append(mod.finding(
+                        self.id, node,
+                        f"undeclared:{plane}:{arg0.attr}",
+                        f"`{plane}.{arg0.attr}` names no constant in "
+                        f"the documented {self.PLANES[plane][1]} "
+                        "table",
+                    ))
+                continue
+            out.append(mod.finding(
+                self.id, node, f"unresolvable:{plane}",
+                f"`{plane}` event/span kind resolves to no literal — "
+                "record through a documented module constant so the "
+                "published type stays classifiable",
+            ))
+        return out
+
+
 def all_rules() -> list[Rule]:
     """Fresh rule instances (cross-file rules carry per-run state)."""
     return [
         DiscardedRequest(), LockOrder(), PollingWait(), SwallowedError(),
         ThreadHygiene(), SpcDocParity(), McaParity(), LoudDegradation(),
-        SpcPublisherSeam(),
+        SpcPublisherSeam(), TraceKindParity(),
     ]
 
 
