@@ -1506,6 +1506,61 @@ def bench_launch(nprocs: int = 2, reps: int = 5) -> list[dict]:
             cli.close()
         finally:
             d.stop()
+
+        # per-tree-depth rungs: the same store-served launch through a
+        # DVM tree, 2 ranks per daemon (the smallest shape where a
+        # leaf cache can hit — one rank per daemon fetches each key
+        # once and caches for nobody).  All three rungs run 2*NDAEMONS
+        # ranks so their counters are comparable; the depth-0 rung
+        # re-measures at that size for the gate baseline.
+        from zhpe_ompi_tpu.runtime import dvmtree
+
+        ndaemons = 3
+        tprocs = 2 * ndaemons
+        gets_per_depth: dict[int, int] = {}
+        for depth, fanout in ((0, None), (1, 2), (2, 1)):
+            tree = dvmtree.spawn_tree(1 if depth == 0 else ndaemons,
+                                      fanout=fanout, in_process=True)
+            try:
+                cli = dvm_mod.DvmClient(tree.root_address)
+                hits0 = spc.read("dvm_store_cache_hits")
+                gets0 = spc.read("pmix_gets")
+                times = []
+                for _ in range(reps):
+                    out, err = io.StringIO(), io.StringIO()
+                    t0 = time.perf_counter()
+                    rc = cli.launch(tprocs, [prog.name], timeout=120.0,
+                                    tag_output=False, stdout=out,
+                                    stderr=err)
+                    times.append(time.perf_counter() - t0)
+                    assert rc == 0, err.getvalue()
+                hits = spc.read("dvm_store_cache_hits") - hits0
+                gets = spc.read("pmix_gets") - gets0
+                gets_per_depth[depth] = gets
+                if depth == 0:
+                    assert hits == 0, hits  # no tree, no leaf cache
+                else:
+                    # the routing gates: leaf-served gets appear at
+                    # every depth >= 1 (2 ranks/daemon -> the second
+                    # rank's fetches hit its daemon's cache) while the
+                    # ROOT store's get traffic drops below the
+                    # depth-0 every-rank-dials-the-root shape
+                    assert hits >= tprocs * reps, (depth, hits)
+                    assert gets < gets_per_depth[0], \
+                        (depth, gets, gets_per_depth[0])
+                rows.append({
+                    "op": "launch",
+                    "mode": (f"dvm tree depth={depth} "
+                             f"({1 if depth == 0 else ndaemons} "
+                             f"daemons, {tprocs} ranks)"),
+                    "nprocs": tprocs, "reps": reps,
+                    "best_ms": min(times) * 1e3,
+                    "median_ms": sorted(times)[len(times) // 2] * 1e3,
+                    "cache_hits": hits, "root_gets": gets,
+                })
+                cli.close()
+            finally:
+                tree.stop()
     finally:
         try:
             os.unlink(prog.name)
@@ -1517,9 +1572,138 @@ def bench_launch(nprocs: int = 2, reps: int = 5) -> list[dict]:
 def _print_launch_table(rows: list[dict]) -> None:
     print(f"# launch latency ({rows[0]['nprocs']} ranks, "
           f"best/median of {rows[0]['reps']})")
-    print(f"{'Mode':<34} {'Best (ms)':>12} {'Median (ms)':>12}")
+    print(f"{'Mode':<44} {'Best (ms)':>12} {'Median (ms)':>12}"
+          f" {'hits':>7} {'gets':>7}")
     for r in rows:
-        print(f"{r['mode']:<34} {r['best_ms']:>12.1f} "
+        extra = ""
+        if "cache_hits" in r:
+            extra = f" {r['cache_hits']:>7d} {r['root_gets']:>7d}"
+        print(f"{r['mode']:<44} {r['best_ms']:>12.1f} "
+              f"{r['median_ms']:>12.1f}{extra}")
+
+
+def bench_resize(reps: int = 3) -> list[dict]:
+    """Elastic grow/shrink round-trip ladder against a resident
+    daemon: one ft job launched 2-live-of-4, then ``reps`` grow(4) /
+    shrink(2) round trips while the job's allreduce loop runs.  The
+    RTT is the RESIZE RPC's — grow returns once every new rank's spawn
+    is confirmed, shrink once every retiree exited (orderly BYE).
+
+    REPORT-ONLY timing on the 1-CPU container (spawn latency is
+    dominated by interpreter start and scheduler contention; see
+    BENCH notes) — the gates are structural: every round trip bumps
+    ``dvm_resizes`` twice and the events carry exactly the grown /
+    retired membership."""
+    import io
+    import tempfile
+    import threading
+
+    from zhpe_ompi_tpu.runtime import dvm as dvm_mod
+    from zhpe_ompi_tpu.runtime import spc
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    prog = tempfile.NamedTemporaryFile(
+        "w", suffix="_resize_probe.py", delete=False)
+    prog.write(
+        f"import sys\nsys.path.insert(0, {repo!r})\n"
+        "import os, time\n"
+        "import numpy as np\n"
+        "import zhpe_ompi_tpu as zmpi\n"
+        "from zhpe_ompi_tpu import ops\n"
+        "from zhpe_ompi_tpu.ft import recovery\n"
+        "ep = zmpi.host_init()\n"
+        "ses = recovery.ElasticSession(ep)\n"
+        "stop_after = int(os.environ['BENCH_RESIZE_EVENTS'])\n"
+        "seen = 0\n"
+        "deadline = time.monotonic() + 300.0\n"
+        "while True:\n"
+        "    stop = 1.0 if (seen >= stop_after\n"
+        "                   or time.monotonic() > deadline) else 0.0\n"
+        "    out = ses.live.allreduce(np.array([1.0, stop]), ops.SUM)\n"
+        "    assert np.isclose(out[0], ses.live.size), out\n"
+        "    if out[1] > 0:\n"
+        "        break\n"
+        "    act = ses.step()\n"
+        "    if act in ('retire', 'halt'):\n"
+        "        break\n"
+        "    if act == 'resized':\n"
+        "        seen += 1\n"
+        "ses.close()\n"
+        "zmpi.host_finalize()\n"
+    )
+    prog.close()
+    os.environ["BENCH_RESIZE_EVENTS"] = str(2 * reps)
+    rows = []
+    d = dvm_mod.Dvm()
+    try:
+        cli = dvm_mod.DvmClient(d.address)
+        out, err = io.StringIO(), io.StringIO()
+        done = {}
+
+        def run():
+            done["rc"] = cli.launch(
+                2, [prog.name], ft=True, max_size=4, timeout=600.0,
+                mca=[("ft_detector_period", "2.0"),
+                     ("ft_detector_timeout", "60.0")],
+                stdout=out, stderr=err)
+
+        t = threading.Thread(target=run, daemon=True)
+        t.start()
+        ctl = dvm_mod.DvmClient(d.address)
+        deadline = time.monotonic() + 60.0
+        while not ctl.stat()["jobs"]:
+            assert time.monotonic() < deadline, err.getvalue()
+            time.sleep(0.05)
+        job_id = next(iter(ctl.stat()["jobs"]))
+        r0 = spc.read("dvm_resizes")
+        grow_times, shrink_times = [], []
+        for _ in range(reps):
+            for new_n, await_live, times in ((4, 2, grow_times),
+                                             (2, 4, shrink_times)):
+                deadline = time.monotonic() + 120.0
+                while ctl.stat()["jobs"][job_id]["live"] != await_live:
+                    assert time.monotonic() < deadline, \
+                        (ctl.stat(), out.getvalue(), err.getvalue())
+                    time.sleep(0.05)
+                t0 = time.perf_counter()
+                evt = ctl.resize(job_id, new_n, timeout=120.0)
+                times.append(time.perf_counter() - t0)
+                # structural gate: exactly the expected membership
+                # moved
+                moved = evt["grown"] if new_n == 4 else evt["retired"]
+                assert moved == [2, 3], evt
+        assert spc.read("dvm_resizes") - r0 == 2 * reps
+        t.join(timeout=120.0)
+        assert not t.is_alive() and done.get("rc") == 0, \
+            (done, out.getvalue(), err.getvalue())
+        ctl.close()
+        cli.close()
+        for mode, times in (("grow 2->4 (spawn-confirmed RTT)",
+                             grow_times),
+                            ("shrink 4->2 (retire-confirmed RTT)",
+                             shrink_times)):
+            rows.append({
+                "op": "resize", "mode": mode, "nprocs": 4,
+                "reps": reps,
+                "best_ms": min(times) * 1e3,
+                "median_ms": sorted(times)[len(times) // 2] * 1e3,
+            })
+    finally:
+        d.stop()
+        os.environ.pop("BENCH_RESIZE_EVENTS", None)
+        try:
+            os.unlink(prog.name)
+        except OSError:
+            pass
+    return rows
+
+
+def _print_resize_table(rows: list[dict]) -> None:
+    print(f"# elastic resize RTT (2-live-of-4 ft job, best/median of "
+          f"{rows[0]['reps']}; report-only on 1 CPU)")
+    print(f"{'Round trip':<40} {'Best (ms)':>12} {'Median (ms)':>12}")
+    for r in rows:
+        print(f"{r['mode']:<40} {r['best_ms']:>12.1f} "
               f"{r['median_ms']:>12.1f}")
 
 
@@ -1587,7 +1771,15 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--launch", action="store_true",
                    help="launch-latency ladder: cold zmpirun (launcher "
                         "proc / in-process) vs a resident zprted DVM, "
-                        "counter-gated (runtime plane)")
+                        "plus per-tree-depth rungs (0/1/2; leaf-cache "
+                        "hits must rise at depth >= 1 while the root "
+                        "store's gets drop), counter-gated (runtime "
+                        "plane)")
+    p.add_argument("--resize", action="store_true",
+                   help="elastic resize ladder: grow/shrink round-trip "
+                        "latency against a resident daemon (report-"
+                        "only timing on the 1-CPU box; membership and "
+                        "dvm_resizes counter gates)")
     p.add_argument("--lockdep", action="store_true",
                    help="run WITH the lock-order witness instrumented "
                         "(diagnosis only: numbers are not comparable "
@@ -1629,6 +1821,14 @@ def main(argv: list[str] | None = None) -> int:
                 print(json.dumps(r))
         else:
             _print_launch_table(rows)
+        return 0
+    if args.resize:
+        rows = bench_resize(reps=max(min(args.iters, 5), 3))
+        if args.json:
+            for r in rows:
+                print(json.dumps(r))
+        else:
+            _print_resize_table(rows)
         return 0
     if args.trace:
         rows = bench_trace(args.max_size, max(args.iters, 10))

@@ -128,7 +128,16 @@ def _ulfm_detector_hygiene():
     zprted = dvm_mod.orphaned_daemon_processes()
     assert not zprted, (
         f"zprted daemon processes orphaned past the suite (every test "
-        f"that spawns one owns its stop/kill): {zprted}"
+        f"that spawns one owns its stop/kill; --parent children scan "
+        f"the same cmdline shape): {zprted}"
+    )
+    from zhpe_ompi_tpu.runtime import dvmtree as dvmtree_mod
+
+    stale_cache = dvmtree_mod.stale_cache_state()
+    assert not stale_cache, (
+        f"routed-store cache state left at session end (a child "
+        f"daemon's leaf cache dies with its daemon's stop(); an open "
+        f"routed store past the suite is a leaked tree): {stale_cache}"
     )
     servers = pmix_mod.live_servers()
     assert not servers, (

@@ -1,0 +1,849 @@
+"""Multi-host DVM tree tests — the routed half of the PRRTE analog
+(``runtime/dvmtree.py`` + the tree plumbing grown into ``runtime/dvm.py``).
+
+Three altitudes:
+
+- **unit** (pure threads): tree planning, the routed store's
+  cache/forward contract against a bare PMIx server.
+- **thread-fast integration**: in-process daemon trees (``spawn_tree
+  (in_process=True)``) hosting REAL rank subprocesses — launch routing,
+  concurrent-launch admission, link-loss fault classification, elastic
+  resize under an allreduce loop.  Daemons share this process's SPC
+  space, so counter deltas aggregate across the tree.
+- **slow real-process forms**: ``zprted --parent`` OS daemons — the
+  kill-a-daemon drill (SIGKILL a leaf; its ranks die on the lifeline,
+  survivors classify cause="daemon-tree", shrink, allreduce) and
+  resize-under-traffic over a tree.
+"""
+
+import io
+import os
+import signal
+import textwrap
+import threading
+import time
+
+import pytest
+
+from zhpe_ompi_tpu.core import errors
+from zhpe_ompi_tpu.runtime import dvm as dvm_mod
+from zhpe_ompi_tpu.runtime import dvmtree
+from zhpe_ompi_tpu.runtime import pmix as pmix_mod
+from zhpe_ompi_tpu.runtime import spc
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _script(tmp_path, body: str, name: str = "prog.py") -> str:
+    p = tmp_path / name
+    p.write_text(
+        "import sys\n"
+        f"sys.path.insert(0, {_REPO!r})\n" + textwrap.dedent(body)
+    )
+    return str(p)
+
+
+# --------------------------------------------------------------- planning
+
+
+class TestTreePlan:
+    def test_fanout2_binomialish(self):
+        # daemon i's parent is (i-1)//2: 0 <- 1,2; 1 <- 3,4; 2 <- 5,6
+        assert dvmtree.plan_tree(7, fanout=2) == \
+            [None, 0, 0, 1, 1, 2, 2]
+
+    def test_fanout1_chain(self):
+        assert dvmtree.plan_tree(4, fanout=1) == [None, 0, 1, 2]
+
+    def test_flat_star(self):
+        assert dvmtree.plan_tree(5, fanout=0) == [None, 0, 0, 0, 0]
+
+    def test_default_rides_mca_var(self):
+        from zhpe_ompi_tpu.mca import var as mca_var
+
+        mca_var.set_var("dvm_tree_fanout", 3)
+        try:
+            assert dvmtree.plan_tree(5) == [None, 0, 0, 0, 1]
+        finally:
+            mca_var.unset("dvm_tree_fanout")
+
+    def test_block_placement_even(self):
+        got = dvmtree.block_placement(list(range(6)), ["a", "b", "c"])
+        assert got == {0: "a", 1: "a", 2: "b", 3: "b", 4: "c", 5: "c"}
+
+    def test_block_placement_uneven(self):
+        got = dvmtree.block_placement(list(range(4)), ["a", "b", "c"])
+        # contiguous near-even blocks, earlier daemons fill first
+        assert [got[r] for r in range(4)] == ["a", "a", "b", "c"]
+
+    def test_block_placement_no_daemons_raises(self):
+        with pytest.raises(errors.MpiError):
+            dvmtree.block_placement([0, 1], [])
+
+
+# ----------------------------------------------------------- routed store
+
+
+class TestRoutedStore:
+    """RoutedStore against a bare PmixServer: writes forward up, reads
+    cache at the leaf, generation bumps invalidate."""
+
+    def _pair(self):
+        srv = pmix_mod.PmixServer()
+        routed = dvmtree.RoutedStore(srv.address, timeout=10.0)
+        return srv, routed
+
+    def test_forward_writes_and_cache_reads(self):
+        srv, routed = self._pair()
+        try:
+            f0 = spc.read("dvm_tree_forwards")
+            h0 = spc.read("dvm_store_cache_hits")
+            routed.ensure_ns("job", 1)
+            routed.put("job", 0, "card:0", ["h", 1])
+            routed.commit("job", 0)
+            # first get: a miss that forwards up and caches
+            assert routed.get("job", "card:0", timeout=5.0) == ["h", 1]
+            hits_after_miss = spc.read("dvm_store_cache_hits") - h0
+            # second get: leaf-served
+            assert routed.get("job", "card:0", timeout=5.0) == ["h", 1]
+            assert spc.read("dvm_store_cache_hits") - h0 == \
+                hits_after_miss + 1
+            assert spc.read("dvm_tree_forwards") > f0
+            # the authoritative store saw the write
+            assert srv.store.get("job", "card:0", timeout=1.0) == ["h", 1]
+            assert routed.cached_keys() == ["job:card:0"]
+        finally:
+            routed.close()
+            srv.close()
+        assert dvmtree.stale_cache_state() == []
+
+    def test_generation_bump_invalidates(self):
+        srv, routed = self._pair()
+        try:
+            routed.ensure_ns("job", 1)
+            routed.put("job", 0, "k", "old")
+            routed.commit("job", 0)
+            assert routed.get("job", "k", timeout=5.0) == "old"
+            # the respawn-window shape: bump, then republish under the
+            # fresh tag — the leaf cache must not serve the corpse's
+            gen = srv.store.bump_generation("job")
+            routed.invalidate_ns("job")  # the down-frame's effect
+            srv.store.put("job", 0, "k", "new")
+            srv.store.commit("job", 0)
+            assert routed.get("job", "k", timeout=5.0,
+                              min_generation=gen) == "new"
+        finally:
+            routed.close()
+            srv.close()
+
+    def test_min_generation_never_served_from_stale_cache(self):
+        srv, routed = self._pair()
+        try:
+            routed.ensure_ns("job", 1)
+            routed.put("job", 0, "k", "g0")
+            routed.commit("job", 0)
+            assert routed.get("job", "k", timeout=5.0) == "g0"  # cached
+            srv.store.bump_generation("job")
+            srv.store.put("job", 0, "k", "g1")
+            srv.store.commit("job", 0)
+            # WITHOUT the invalidation down-frame having arrived yet, a
+            # min_generation get must still bypass the gen-0 cache entry
+            value, gen = routed.get_meta("job", "k", timeout=5.0,
+                                         min_generation=1)
+            assert (value, gen) == ("g1", 1)
+        finally:
+            routed.close()
+            srv.close()
+
+    def test_lookup_never_cached(self):
+        srv, routed = self._pair()
+        try:
+            routed.ensure_ns("job", 1)
+            routed.put("job", -1, "resize:0", {"seq": 0})
+            routed.commit("job", -1)
+            assert list(routed.lookup("job", "resize:")) == ["resize:0"]
+            srv.store.put("job", -1, "resize:1", {"seq": 1})
+            srv.store.commit("job", -1)
+            # the mutable keyspace: a second lookup sees the new key
+            # immediately (no leaf cache in the way)
+            assert sorted(routed.lookup("job", "resize:")) == \
+                ["resize:0", "resize:1"]
+            assert routed.cached_keys() == []
+        finally:
+            routed.close()
+            srv.close()
+
+    def test_single_flight_coalesces_first_readers(self):
+        srv, routed = self._pair()
+        try:
+            routed.ensure_ns("job", 4)
+            g0 = spc.read("pmix_gets")
+            results = []
+
+            def reader():
+                results.append(routed.get("job", "late", timeout=10.0))
+
+            threads = [threading.Thread(target=reader)
+                       for _ in range(4)]
+            for t in threads:
+                t.start()
+            time.sleep(0.2)  # all four park on one in-flight fetch
+            srv.store.put("job", 0, "late", 42)
+            srv.store.commit("job", 0)
+            for t in threads:
+                t.join(timeout=10.0)
+            assert results == [42, 42, 42, 42]
+            # ONE upward fetch served the root store; the waiters hit
+            # the leaf cache once it landed
+            assert spc.read("pmix_gets") - g0 == 1
+        finally:
+            routed.close()
+            srv.close()
+
+    def test_close_drops_cache_and_clears_gate(self):
+        srv, routed = self._pair()
+        routed.ensure_ns("job", 1)
+        routed.put("job", 0, "k", 1)
+        routed.commit("job", 0)
+        routed.get("job", "k", timeout=5.0)
+        routed.close()
+        srv.close()
+        assert routed.cached_keys() == []
+        assert dvmtree.stale_cache_state() == []
+        with pytest.raises(errors.MpiError):
+            routed.get("job", "k", timeout=0.5)
+
+
+# ------------------------------------------------- in-process tree launch
+
+
+class TestTreeLaunch:
+    def _prog(self, tmp_path, n):
+        return _script(tmp_path, f"""
+            import zhpe_ompi_tpu as zmpi
+
+            proc = zmpi.host_init()
+            vals = proc.allgather(proc.rank + 1)
+            assert vals == list(range(1, {n} + 1)), vals
+            print(f"rank {{proc.rank}} OK")
+            zmpi.host_finalize()
+        """)
+
+    def test_six_ranks_over_three_daemons(self, tmp_path):
+        """A launch at the root places rank blocks across the tree;
+        child-hosted ranks modex through THEIR daemon's routed store
+        (cache hits + forwards move, the job computes correctly)."""
+        tree = dvmtree.spawn_tree(3, fanout=2, in_process=True)
+        try:
+            assert [n["dvm"].tree_depth for n in tree.nodes] == [0, 1, 1]
+            h0 = spc.read("dvm_store_cache_hits")
+            f0 = spc.read("dvm_tree_forwards")
+            cli = dvm_mod.DvmClient(tree.root_address)
+            out, err = io.StringIO(), io.StringIO()
+            rc = cli.launch(6, [self._prog(tmp_path, 6)], timeout=120.0,
+                            stdout=out, stderr=err)
+            assert rc == 0, (out.getvalue(), err.getvalue())
+            assert out.getvalue().count("OK") == 6
+            assert spc.read("dvm_store_cache_hits") > h0
+            assert spc.read("dvm_tree_forwards") > f0
+            # root placement knows all three daemons
+            info = cli.treeinfo()
+            assert info["root"] and len(info["daemons"]) == 3
+            cli.close()
+        finally:
+            tree.stop()
+        assert dvm_mod.live_dvms() == []
+        assert dvmtree.stale_cache_state() == []
+
+    def test_depth2_chain(self, tmp_path):
+        """fanout=1 builds a root<-mid<-leaf chain: the leaf's store
+        verbs are routed through the mid daemon's parent link, and a
+        job spread over all three still computes."""
+        tree = dvmtree.spawn_tree(3, fanout=1, in_process=True)
+        try:
+            assert [n["dvm"].tree_depth for n in tree.nodes] == [0, 1, 2]
+            cli = dvm_mod.DvmClient(tree.root_address)
+            out = io.StringIO()
+            rc = cli.launch(3, [self._prog(tmp_path, 3)], timeout=120.0,
+                            stdout=out, stderr=io.StringIO())
+            assert rc == 0, out.getvalue()
+            assert out.getvalue().count("OK") == 3
+            cli.close()
+        finally:
+            tree.stop()
+
+    def test_launch_must_target_root(self, tmp_path):
+        tree = dvmtree.spawn_tree(2, in_process=True)
+        try:
+            child = dvm_mod.DvmClient(tree.addresses()[1])
+            with pytest.raises(errors.MpiError,
+                               match="must target the ROOT"):
+                child.launch(1, [self._prog(tmp_path, 1)], timeout=30.0,
+                             stdout=io.StringIO(),
+                             stderr=io.StringIO())
+            child.close()
+        finally:
+            tree.stop()
+
+    def test_relayed_rpcs_reach_root_from_child(self):
+        """stat/treeinfo against a CHILD daemon: treeinfo answers
+        locally (depth 1, not root), stat relays to the root's
+        authoritative view."""
+        tree = dvmtree.spawn_tree(2, in_process=True)
+        try:
+            child = dvm_mod.DvmClient(tree.addresses()[1])
+            info = child.treeinfo()
+            assert info["depth"] == 1 and not info["root"]
+            stat = child.stat()  # relayed: the root's job table
+            assert stat["jobs"] == {}
+            assert len(stat["daemons"]) == 2
+            child.close()
+        finally:
+            tree.stop()
+
+    def test_detached_daemon_leaves_placement(self, tmp_path):
+        """An orderly child stop() relays up as daemon-detached: the
+        root unlearns the subtree (at ANY depth — the leaf of a chain
+        relays through the mid daemon), so the next launch never
+        places ranks on a stopped daemon and wedges."""
+        tree = dvmtree.spawn_tree(3, fanout=1, in_process=True)
+        try:
+            cli = dvm_mod.DvmClient(tree.root_address)
+            assert len(cli.treeinfo()["daemons"]) == 3
+            tree.nodes[2]["dvm"].stop()  # the depth-2 leaf, orderly
+            deadline = time.monotonic() + 10.0
+            while len(cli.treeinfo()["daemons"]) != 2:
+                assert time.monotonic() < deadline, cli.treeinfo()
+                time.sleep(0.05)
+            out = io.StringIO()
+            rc = cli.launch(4, [self._prog(tmp_path, 4)], timeout=120.0,
+                            stdout=out, stderr=io.StringIO())
+            assert rc == 0, out.getvalue()
+            assert out.getvalue().count("OK") == 4
+            cli.close()
+        finally:
+            tree.stop()
+
+    def test_elastic_rejects_non_python(self):
+        d = dvm_mod.Dvm()
+        try:
+            cli = dvm_mod.DvmClient(d.address)
+            with pytest.raises(errors.MpiError, match="Python-only"):
+                cli.launch(1, ["/bin/true"], ft=True, max_size=2,
+                           timeout=30.0, stdout=io.StringIO(),
+                           stderr=io.StringIO())
+            cli.close()
+        finally:
+            d.stop()
+
+    def test_concurrent_launches_one_daemon(self, tmp_path):
+        """The admission-serialization regression (the launch RPC once
+        assumed ONE caller): two simultaneous launches into one daemon
+        must not interleave job setup — distinct job ids, both jobs
+        complete, both outputs whole."""
+        progs = [
+            _script(tmp_path, f"""
+                import zhpe_ompi_tpu as zmpi
+
+                proc = zmpi.host_init()
+                vals = proc.allgather(proc.rank)
+                assert vals == [0, 1], vals
+                print(f"J{i} rank {{proc.rank}} OK")
+                zmpi.host_finalize()
+            """, name=f"prog{i}.py")
+            for i in range(2)
+        ]
+        d = dvm_mod.Dvm()
+        try:
+            results: dict[int, tuple] = {}
+            barrier = threading.Barrier(2)
+
+            def one(i):
+                cli = dvm_mod.DvmClient(d.address)
+                out, err = io.StringIO(), io.StringIO()
+                barrier.wait(timeout=10.0)
+                rc = cli.launch(2, [progs[i]], timeout=120.0,
+                                stdout=out, stderr=err)
+                results[i] = (rc, cli.last_job_id, out.getvalue(),
+                              err.getvalue())
+                cli.close()
+
+            threads = [threading.Thread(target=one, args=(i,))
+                       for i in range(2)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=150.0)
+            assert sorted(results) == [0, 1], results
+            rcs = [results[i][0] for i in range(2)]
+            ids = [results[i][1] for i in range(2)]
+            assert rcs == [0, 0], results
+            assert len(set(ids)) == 2, ids
+            for i in range(2):
+                assert results[i][2].count(f"J{i} rank") == 2, results[i]
+        finally:
+            d.stop()
+        assert pmix_mod.stale_namespaces() == []
+
+
+# --------------------------------------------------- fault routing (fast)
+
+
+_FAULT_PROG = """
+import os
+import time
+
+import numpy as np
+
+import zhpe_ompi_tpu as zmpi
+from zhpe_ompi_tpu import ops
+from zhpe_ompi_tpu.runtime import pmix as pmix_mod
+
+victims = set(int(r) for r in sys.argv[1].split(","))
+proc = zmpi.host_init()
+proc.barrier()
+print(f"READY rank={proc.rank}", flush=True)
+if proc.rank in victims:
+    # a victim rank idles until its daemon's death takes it (the
+    # lifeline) or the test tears the tree down
+    time.sleep(120.0)
+    raise SystemExit(0)
+deadline = time.monotonic() + 30.0
+while time.monotonic() < deadline:
+    if all(proc.ft_state.is_failed(v) for v in victims):
+        break
+    time.sleep(0.01)
+else:
+    print(f"TIMEOUT rank={proc.rank} failed="
+          f"{sorted(proc.ft_state.failed())}", flush=True)
+    raise SystemExit(1)
+ts = time.time()
+causes = sorted(set(proc.ft_state.cause_of(v) for v in victims))
+# the store must still serve through THIS host's surviving daemon
+addr, ns = os.environ["ZMPI_PMIX"].rsplit("/", 1)
+cli = pmix_mod.PmixClient(addr, timeout=10.0)
+card = cli.get(ns, "card:0", timeout=10.0)
+cli.close()
+assert card, card
+proc.failure_ack()
+sh = proc.shrink()
+total = float(np.asarray(sh.allreduce(np.float64(proc.rank), ops.SUM)))
+print(f"SURVIVOR-OK rank={proc.rank} ts={ts:.3f} "
+      f"causes={','.join(causes)} total={total}", flush=True)
+zmpi.host_finalize()
+"""
+
+
+def _parse_survivors(text):
+    import re
+
+    return re.findall(
+        r"SURVIVOR-OK rank=(\d+) ts=([\d.]+) causes=([\w,-]+) "
+        r"total=([\d.-]+)", text)
+
+
+class TestDaemonFaultThreadFast:
+    def test_child_link_loss_classifies_subtree(self, tmp_path):
+        """Severing a child's parent link WITHOUT a detach is a daemon
+        death to the root: every rank the subtree hosted is marked
+        failed (cause="daemon-tree"), the classification floods the
+        surviving tree, survivors shrink and compute."""
+        prog = _script(tmp_path, _FAULT_PROG)
+        tree = dvmtree.spawn_tree(2, in_process=True)
+        try:
+            cli = dvm_mod.DvmClient(tree.root_address)
+            out, err = io.StringIO(), io.StringIO()
+            done = {}
+
+            def run():
+                done["rc"] = cli.launch(
+                    4, [prog, "2,3"], ft=True, timeout=120.0,
+                    mca=[("ft_detector_period", "2.0"),
+                         ("ft_detector_timeout", "60.0")],
+                    stdout=out, stderr=err)
+
+            t = threading.Thread(target=run)
+            t.start()
+            deadline = time.monotonic() + 60.0
+            while out.getvalue().count("READY") < 4:
+                assert time.monotonic() < deadline, \
+                    (out.getvalue(), err.getvalue())
+                time.sleep(0.05)
+            t_cut = time.time()
+            # sever the link (no detach): the root must classify ranks
+            # 2 and 3 — the child daemon's block — as daemon-tree dead
+            tree.nodes[1]["dvm"]._parent_link.close()
+            t.join(timeout=90.0)
+            assert not t.is_alive(), "job never completed"
+            text = out.getvalue()
+            survivors = _parse_survivors(text)
+            assert len(survivors) == 2, (text, err.getvalue())
+            for rank, ts, causes, total in survivors:
+                assert int(rank) in (0, 1)
+                assert causes == "daemon-tree"
+                assert float(ts) - t_cut < 2.0
+                assert float(total) == 1.0  # 0 + 1
+            # victims never exited 0: the job carries 128+SIGKILL
+            assert done["rc"] == 137, done
+            cli.close()
+        finally:
+            tree.stop()
+        assert dvm_mod.live_dvms() == []
+        assert dvmtree.stale_cache_state() == []
+
+
+# ------------------------------------------------- elastic resize (fast)
+
+
+_ELASTIC_PROG = """
+import os
+import time
+
+import numpy as np
+
+import zhpe_ompi_tpu as zmpi
+from zhpe_ompi_tpu import ops
+from zhpe_ompi_tpu.ft import recovery
+
+ep = zmpi.host_init()
+ses = recovery.ElasticSession(ep)
+deadline = time.monotonic() + float(os.environ.get("TEST_ELASTIC_S",
+                                                   "30"))
+stop_after = int(os.environ.get("TEST_ELASTIC_STOP_AFTER", "999"))
+resizes = 0
+while True:
+    n = ses.live.size
+    want_stop = 1.0 if (time.monotonic() > deadline
+                        or resizes >= stop_after) else 0.0
+    out = ses.live.allreduce(np.array([1.0, want_stop]), ops.SUM)
+    assert np.isclose(out[0], n), (out, n)
+    if out[1] > 0:
+        break  # collective stop: every live rank saw the same sum
+    act = ses.step()
+    if act in ("retire", "halt"):
+        print(f"RETIRE rank={ep.rank}", flush=True)
+        break
+    if act == "resized":
+        resizes += 1
+        print(f"RESIZED rank={ep.rank} live={ses.live.size}",
+              flush=True)
+ses.close()
+zmpi.host_finalize()
+"""
+
+
+class TestElasticResize:
+    def _run_elastic(self, tmp_path, daemon_addr, n, max_size,
+                     resizes, run_s=30.0):
+        """Launch the elastic worker, apply ``resizes`` (a list of new
+        sizes) from a second client, return (rc, stdout, stderr)."""
+        prog = _script(tmp_path, _ELASTIC_PROG)
+        cli = dvm_mod.DvmClient(daemon_addr)
+        out, err = io.StringIO(), io.StringIO()
+        done = {}
+
+        def run():
+            done["rc"] = cli.launch(
+                n, [prog], ft=True, max_size=max_size, timeout=180.0,
+                mca=[("ft_detector_period", "2.0"),
+                     ("ft_detector_timeout", "60.0")],
+                stdout=out, stderr=err)
+
+        t = threading.Thread(target=run)
+        t.start()
+        try:
+            ctl = dvm_mod.DvmClient(daemon_addr)
+            deadline = time.monotonic() + 60.0
+            while not ctl.stat()["jobs"]:
+                assert time.monotonic() < deadline, err.getvalue()
+                time.sleep(0.1)
+            job_id = next(iter(ctl.stat()["jobs"]))
+            events = []
+            live = n
+            for new_n in resizes:
+                # wait until the PREVIOUS membership is fully live
+                deadline = time.monotonic() + 60.0
+                while ctl.stat()["jobs"][job_id]["live"] != live:
+                    assert time.monotonic() < deadline, \
+                        (ctl.stat(), out.getvalue(), err.getvalue())
+                    time.sleep(0.1)
+                time.sleep(1.0)  # a few allreduce iterations in between
+                events.append(ctl.resize(job_id, new_n, timeout=90.0))
+                live = new_n
+            ctl.close()
+        finally:
+            t.join(timeout=200.0)
+        assert not t.is_alive(), "elastic job never completed"
+        return done["rc"], out.getvalue(), err.getvalue(), events
+
+    def test_grow_then_shrink_under_allreduce(self, tmp_path,
+                                              monkeypatch):
+        """The resize-under-traffic shape, thread-fast: 2 -> 4 -> 2
+        while an allreduce loop runs; every generation's collectives
+        stay correct (the worker asserts sum == live size)."""
+        monkeypatch.setenv("TEST_ELASTIC_S", "60")
+        monkeypatch.setenv("TEST_ELASTIC_STOP_AFTER", "2")
+        r0 = spc.read("dvm_resizes")
+        d = dvm_mod.Dvm()
+        try:
+            rc, out, err, events = self._run_elastic(
+                tmp_path, d.address, n=2, max_size=4, resizes=[4, 2])
+            assert rc == 0, (out, err)
+            assert events[0]["grown"] == [2, 3]
+            assert events[1]["retired"] == [2, 3]
+            # ONE generation bump per grow window; shrink does not bump
+            assert events[0]["generation"] == 1
+            assert events[1]["generation"] == 1
+            assert events[0]["seq"] == 0 and events[1]["seq"] == 1
+            # every surviving rank applied both events; retired ranks
+            # said an orderly goodbye
+            assert out.count("RESIZED rank=0 live=4") == 1, out
+            assert out.count("RESIZED rank=0 live=2") == 1, out
+            assert out.count("RETIRE") == 2, out
+            assert spc.read("dvm_resizes") - r0 == 2
+        finally:
+            d.stop()
+        assert pmix_mod.stale_namespaces() == []
+
+    def test_resize_validation(self, tmp_path):
+        d = dvm_mod.Dvm()
+        try:
+            cli = dvm_mod.DvmClient(d.address)
+            with pytest.raises(errors.MpiError, match="unknown job"):
+                cli.resize("job999", 2)
+            # a non-ft launch may not be elastic at all
+            with pytest.raises(errors.MpiError, match="ft=True"):
+                cli.launch(1, ["x.py"], max_size=2, timeout=30.0,
+                           stdout=io.StringIO(), stderr=io.StringIO())
+            with pytest.raises(errors.MpiError, match="below n"):
+                cli.launch(3, ["x.py"], ft=True, max_size=2,
+                           timeout=30.0, stdout=io.StringIO(),
+                           stderr=io.StringIO())
+            cli.close()
+        finally:
+            d.stop()
+
+
+# ----------------------------------------------------- C ranks over --dvm
+
+
+_HAVE_GCC = __import__("shutil").which("g++") is not None
+
+
+@pytest.mark.skipif(not _HAVE_GCC, reason="no C++ toolchain")
+class TestCRankPmix:
+    """native/zompi_mpi.cpp speaks the store verbs: C ranks modex
+    through ZMPI_PMIX (no coordinator), so C and mixed C/Python jobs
+    ride --dvm — including over a tree, where a child-hosted C rank's
+    gets land in its daemon's leaf cache."""
+
+    @pytest.fixture(scope="class")
+    def ring_c(self, tmp_path_factory):
+        import subprocess
+        import sys
+
+        binp = str(tmp_path_factory.mktemp("cbin") / "ring_c")
+        subprocess.run(
+            [sys.executable, "-m", "zhpe_ompi_tpu.tools.zmpicc",
+             os.path.join(_REPO, "examples", "ring_c.c"), "-o", binp],
+            check=True, capture_output=True, text=True, timeout=600,
+        )
+        return binp
+
+    def test_c_ring_in_dvm(self, ring_c):
+        d = dvm_mod.Dvm()
+        try:
+            cli = dvm_mod.DvmClient(d.address)
+            out, err = io.StringIO(), io.StringIO()
+            rc = cli.launch(3, [ring_c], timeout=120.0, stdout=out,
+                            stderr=err)
+            assert rc == 0, (out.getvalue(), err.getvalue())
+            assert out.getvalue().count("OK") == 3
+            cli.close()
+        finally:
+            d.stop()
+
+    def test_c_ring_over_tree_hits_leaf_cache(self, ring_c):
+        tree = dvmtree.spawn_tree(3, fanout=2, in_process=True)
+        try:
+            h0 = spc.read("dvm_store_cache_hits")
+            cli = dvm_mod.DvmClient(tree.root_address)
+            out, err = io.StringIO(), io.StringIO()
+            rc = cli.launch(6, [ring_c], timeout=120.0, stdout=out,
+                            stderr=err)
+            assert rc == 0, (out.getvalue(), err.getvalue())
+            assert out.getvalue().count("OK") == 6
+            assert spc.read("dvm_store_cache_hits") > h0
+            cli.close()
+        finally:
+            tree.stop()
+
+    def test_mixed_mpmd_c_and_python(self, ring_c, tmp_path):
+        """One WORLD, two app contexts (C + Python), one store-served
+        wire-up: the Python block allgathers among itself while the C
+        block rings among the full WORLD?  No — no cross-context
+        traffic here: each context computes within its own ranks, both
+        exit 0 (the launch/modex interop is what's under test)."""
+        import subprocess
+        import sys
+
+        hello = str(tmp_path / "hello_c")
+        subprocess.run(
+            [sys.executable, "-m", "zhpe_ompi_tpu.tools.zmpicc",
+             os.path.join(_REPO, "examples", "hello_c.c"), "-o", hello],
+            check=True, capture_output=True, text=True, timeout=600,
+        )
+        prog = _script(tmp_path, """
+            import zhpe_ompi_tpu as zmpi
+
+            proc = zmpi.host_init()
+            assert proc.size == 4
+            print(f"py rank {proc.rank} OK")
+            zmpi.host_finalize()
+        """)
+        d = dvm_mod.Dvm()
+        try:
+            cli = dvm_mod.DvmClient(d.address)
+            out, err = io.StringIO(), io.StringIO()
+            rc = cli.launch(0, apps=[(2, [hello]), (2, [prog])],
+                            timeout=120.0, stdout=out, stderr=err)
+            assert rc == 0, (out.getvalue(), err.getvalue())
+            text = out.getvalue()
+            assert text.count("Hello, world") == 2
+            assert text.count("py rank") == 2
+            cli.close()
+        finally:
+            d.stop()
+
+
+# ------------------------------------------------- real-process drills
+
+
+@pytest.mark.slow
+class TestKillADaemonDrill:
+    """The acceptance drill over REAL processes: a 3-daemon tree hosts
+    a 6-rank ft job (2 ranks per daemon); SIGKILL of a leaf daemon
+    must (a) kill its two ranks through the lifeline, (b) classify
+    exactly those ranks (cause="daemon-tree") on every survivor in
+    < 2 s, (c) leave the surviving tree serving store traffic, and
+    (d) let survivors shrink and allreduce correctly."""
+
+    def test_sigkill_leaf_daemon(self, tmp_path):
+        prog = _script(tmp_path, _FAULT_PROG)
+        tree = dvmtree.spawn_tree(3, fanout=2, in_process=False)
+        try:
+            # block placement of 6 ranks over [root, d1, d2]: the leaf
+            # daemon d2 hosts ranks 4 and 5
+            cli = dvm_mod.DvmClient(tree.root_address)
+            out, err = io.StringIO(), io.StringIO()
+            done = {}
+
+            def run():
+                done["rc"] = cli.launch(
+                    6, [prog, "4,5"], ft=True, timeout=180.0,
+                    mca=[("ft_detector_period", "2.0"),
+                         ("ft_detector_timeout", "60.0")],
+                    stdout=out, stderr=err)
+
+            t = threading.Thread(target=run)
+            t.start()
+            deadline = time.monotonic() + 90.0
+            while out.getvalue().count("READY") < 6:
+                assert time.monotonic() < deadline, \
+                    (out.getvalue(), err.getvalue())
+                time.sleep(0.05)
+            ctl = dvm_mod.DvmClient(tree.root_address)
+            job_id = next(iter(ctl.stat()["jobs"]))
+            victim_pids = {r: p for r, p in ctl.pids(job_id).items()
+                           if r in (4, 5)}
+            assert len(victim_pids) == 2
+            t_kill = time.time()
+            tree.kill_node(2, signal.SIGKILL)
+            t.join(timeout=120.0)
+            assert not t.is_alive(), "job never completed"
+            text = out.getvalue()
+            survivors = _parse_survivors(text)
+            assert len(survivors) == 4, (text, err.getvalue())
+            for rank, ts, causes, total in survivors:
+                assert int(rank) in (0, 1, 2, 3)
+                assert causes == "daemon-tree"
+                # < 2 s from SIGKILL to classification on EVERY survivor
+                assert float(ts) - t_kill < 2.0, (rank, ts, t_kill)
+                assert float(total) == 6.0  # 0+1+2+3
+            assert done["rc"] == 137, done
+            # the lifeline took the dead daemon's ranks with it
+            lifeline_deadline = time.monotonic() + 5.0
+            while time.monotonic() < lifeline_deadline:
+                if not any(os.path.exists(f"/proc/{p}")
+                           for p in victim_pids.values()):
+                    break
+                time.sleep(0.1)
+            orphans = [p for p in victim_pids.values()
+                       if os.path.exists(f"/proc/{p}")]
+            assert not orphans, f"victim ranks outlived their daemon: " \
+                                f"{orphans}"
+            ctl.close()
+            cli.close()
+        finally:
+            tree.stop()
+        assert dvm_mod.orphaned_daemon_processes() == []
+
+
+@pytest.mark.slow
+class TestResizeUnderTrafficReal:
+    def test_grow_shrink_over_tree(self, tmp_path, monkeypatch):
+        """Resize-under-traffic over REAL zprted processes: a 2-daemon
+        tree hosts an elastic job that grows 4 -> 6 (new ranks placed
+        round-robin across the tree, FT_JOINing the live window) and
+        shrinks 6 -> 3, with the allreduce loop asserting correctness
+        at every membership."""
+        monkeypatch.setenv("TEST_ELASTIC_S", "90")
+        monkeypatch.setenv("TEST_ELASTIC_STOP_AFTER", "2")
+        prog = _script(tmp_path, _ELASTIC_PROG)
+        tree = dvmtree.spawn_tree(2, in_process=False)
+        try:
+            cli = dvm_mod.DvmClient(tree.root_address)
+            out, err = io.StringIO(), io.StringIO()
+            done = {}
+
+            def run():
+                done["rc"] = cli.launch(
+                    4, [prog], ft=True, max_size=6, timeout=240.0,
+                    mca=[("ft_detector_period", "2.0"),
+                         ("ft_detector_timeout", "60.0")],
+                    stdout=out, stderr=err)
+
+            t = threading.Thread(target=run)
+            t.start()
+            try:
+                ctl = dvm_mod.DvmClient(tree.root_address)
+                deadline = time.monotonic() + 90.0
+                while not ctl.stat()["jobs"]:
+                    assert time.monotonic() < deadline, err.getvalue()
+                    time.sleep(0.1)
+                job_id = next(iter(ctl.stat()["jobs"]))
+                for new_n, await_live in ((6, 4), (3, 6)):
+                    deadline = time.monotonic() + 90.0
+                    while ctl.stat()["jobs"][job_id]["live"] != \
+                            await_live:
+                        assert time.monotonic() < deadline, \
+                            (ctl.stat(), out.getvalue(),
+                             err.getvalue())
+                        time.sleep(0.1)
+                    time.sleep(1.5)
+                    ctl.resize(job_id, new_n, timeout=120.0)
+                ctl.close()
+            finally:
+                t.join(timeout=300.0)
+            assert not t.is_alive(), "elastic job never completed"
+            assert done["rc"] == 0, (out.getvalue(), err.getvalue())
+            text = out.getvalue()
+            # survivors applied both events, the three retirees left
+            # orderly
+            assert text.count("RESIZED rank=0 live=6") == 1, text
+            assert text.count("RESIZED rank=0 live=3") == 1, text
+            assert text.count("RETIRE") == 3, text
+            cli.close()
+        finally:
+            tree.stop()
+        assert dvm_mod.orphaned_daemon_processes() == []

@@ -183,8 +183,11 @@ def build_mpi_shim() -> str:
         if not os.path.exists(so):
             tmp = so + f".tmp.{os.getpid()}"
             subprocess.run(
+                # -lrt: shm_open/shm_unlink live in librt on pre-2.34
+                # glibc — linking it here keeps zmpicc users free of
+                # the transitive dependency (newer glibc ignores it)
                 ["g++", "-O2", "-shared", "-fPIC", "-std=c++17",
-                 "-pthread", "-o", tmp] + _MPI_SRCS,
+                 "-pthread", "-o", tmp] + _MPI_SRCS + ["-lrt"],
                 check=True, capture_output=True, text=True, timeout=120,
             )
             os.replace(tmp, so)

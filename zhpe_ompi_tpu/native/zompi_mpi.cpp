@@ -150,6 +150,15 @@ void put_bytes(std::string &out, const void *p, size_t n) {
   out.append((const char *)p, n);
 }
 
+void put_float(std::string &out, double v) {
+  // dss.py float: T_FLOAT + struct "<d" (little-endian hosts only,
+  // same assumption the OOB offset codec already makes)
+  out.push_back((char)T_FLOAT);
+  char b[8];
+  memcpy(b, &v, 8);
+  out.append(b, 8);
+}
+
 void put_ndarray_1d(std::string &out, const char *dtstr, const void *data,
                     uint64_t count, uint64_t itemsize) {
   out.push_back((char)T_NDARRAY);
@@ -2925,6 +2934,177 @@ int errh_of_comm(int comm) {
 // inside the extern "C" block, so the declaration matches that linkage
 extern "C" int dispatch_comm_err(int comm, int code);
 
+// ----------------------------------------------- PMIx store client
+// A zmpirun --dvm job modexes through the resident daemon's PMIx
+// store (runtime/pmix.py) instead of a per-job coordinator: 4-byte
+// length-framed dss.pack([op, *args]) requests, ["ok", value] /
+// ["err", message] replies.  C ranks speak the same verbs the Python
+// plane's _modex_pmix uses (mkns/put/commit/fence/get), so mixed
+// C/Python jobs share one store-served wire-up — and on a DVM tree
+// the address in ZMPI_PMIX is THIS host's daemon, whose routed store
+// forwards writes up and serves gets from its leaf cache.
+
+void pmix_req(std::string &f, const char *op, size_t argc) {
+  put_varint(f, 1);            // dss.pack of ONE value: the request
+  f.push_back((char)T_LIST);
+  put_varint(f, argc + 1);     // [op, *args]
+  put_str(f, op);
+}
+
+bool pmix_call(int fd, const std::string &req, DssVal &out,
+               std::string &err) {
+  if (!send_frame(fd, req)) {
+    err = "request send failed";
+    return false;
+  }
+  std::string reply;
+  if (!recv_frame(fd, reply)) {
+    err = "store connection lost";
+    return false;
+  }
+  std::vector<DssVal> vals;
+  if (!parse_all(reply, vals) || vals.size() != 1 ||
+      vals[0].tag != T_LIST || vals[0].items.size() != 2 ||
+      vals[0].items[0].tag != T_STR) {
+    err = "malformed reply";
+    return false;
+  }
+  if (vals[0].items[0].s != "ok") {
+    err = vals[0].items[1].s;
+    return false;
+  }
+  out = vals[0].items[1];
+  return true;
+}
+
+// the ZMPI_LIFELINE contract (runtime/dvm.py): one connection parked
+// on the host daemon's control port for this process's whole life —
+// the daemon never replies, EOF means the daemon died, and a rank
+// must not outlive the daemon that owns its store, fault routing, and
+// exit accounting (the PRRTE local-procs-die-with-their-prted
+// contract).  Exit 143 mirrors the SIGTERM teardown the daemon itself
+// would have applied.  No farewell on stderr: that IS the dead
+// daemon's IOF pipe.
+void arm_lifeline(const char *address) {
+  std::string addr = address;
+  size_t colon = addr.rfind(':');
+  if (colon == std::string::npos) return;
+  int fd = tcp_connect(addr.substr(0, colon),
+                       atoi(addr.c_str() + colon + 1));
+  if (fd < 0) _exit(143);  // daemon already gone: a teardown race
+  std::string f;
+  put_varint(f, 1);
+  f.push_back((char)T_LIST);
+  put_varint(f, 1);
+  put_str(f, "lifeline");
+  if (!send_frame(fd, f)) _exit(143);
+  std::thread([fd] {
+    std::string frame;
+    while (recv_frame(fd, frame)) {
+    }
+    _exit(143);
+  }).detach();
+}
+
+// The store-served modex (tcp.py _modex_pmix, C side): publish this
+// rank's card, fence the namespace, read every peer's card into the
+// book.  uri = "host:port/ns" (the ZMPI_PMIX contract).
+bool pmix_modex(const char *uri_c) {
+  std::string uri = uri_c;
+  size_t slash = uri.rfind('/');
+  size_t colon = slash == std::string::npos
+                     ? std::string::npos
+                     : uri.rfind(':', slash);
+  if (slash == std::string::npos || colon == std::string::npos) {
+    fprintf(stderr, "zompi: malformed ZMPI_PMIX '%s' "
+                    "(want host:port/ns)\n", uri_c);
+    return false;
+  }
+  std::string host = uri.substr(0, colon);
+  int port = atoi(uri.substr(colon + 1, slash - colon - 1).c_str());
+  std::string ns = uri.substr(slash + 1);
+  const double timeout = 30.0;  // the Python plane's host_init default
+  int fd = tcp_connect(host, port);
+  if (fd < 0) {
+    fprintf(stderr, "zompi: no PMIx store at %s:%d\n",
+            host.c_str(), port);
+    return false;
+  }
+  DssVal out;
+  std::string err, f;
+  bool ok = true;
+  // mkns is idempotent — the daemon created the job's namespace at
+  // launch; this call just asserts the size contract
+  pmix_req(f, "mkns", 2);
+  put_str(f, ns);
+  put_int(f, g.size);
+  ok = pmix_call(fd, f, out, err);
+  if (ok) {
+    // card:<rank> = [host, port(, "sm")] — same capability shape the
+    // coordinator modex sends (sm: this rank maps same-host rings)
+    f.clear();
+    pmix_req(f, "put", 4);
+    put_str(f, ns);
+    put_int(f, g.rank);
+    put_str(f, "card:" + std::to_string(g.rank));
+    bool sm = sm_enabled();
+    f.push_back((char)T_LIST);
+    put_varint(f, sm ? 3 : 2);
+    put_str(f, g.host);
+    put_int(f, g.listen_port);
+    if (sm) put_str(f, "sm");
+    ok = pmix_call(fd, f, out, err);
+  }
+  if (ok) {
+    f.clear();
+    pmix_req(f, "commit", 2);
+    put_str(f, ns);
+    put_int(f, g.rank);
+    ok = pmix_call(fd, f, out, err);
+  }
+  if (ok) {
+    // the modex barrier: parks until every rank of the namespace
+    // committed (the store's fence verb)
+    f.clear();
+    pmix_req(f, "fence", 3);
+    put_str(f, ns);
+    put_int(f, g.rank);
+    put_float(f, timeout);
+    ok = pmix_call(fd, f, out, err);
+  }
+  if (ok) {
+    g.book.assign(g.size, {"", 0});
+    g.caps.assign(g.size, "");
+    for (int r = 0; r < g.size && ok; r++) {
+      f.clear();
+      pmix_req(f, "get", 4);
+      put_str(f, ns);
+      put_str(f, "card:" + std::to_string(r));
+      put_float(f, timeout);
+      put_int(f, 0);  // min_generation: launch cards are gen 0
+      ok = pmix_call(fd, f, out, err);
+      // reply value = [card, generation]; card = [host, port, caps...]
+      if (ok && (out.tag != T_LIST || out.items.size() < 2 ||
+                 out.items[0].tag != T_LIST ||
+                 out.items[0].items.size() < 2)) {
+        err = "malformed card for rank " + std::to_string(r);
+        ok = false;
+      }
+      if (ok) {
+        DssVal &card = out.items[0];
+        g.book[r] = {card.items[0].s, (int)card.items[1].i};
+        if (card.items.size() >= 3 && card.items[2].tag == T_STR)
+          g.caps[r] = card.items[2].s;
+      }
+    }
+  }
+  close(fd);
+  if (!ok)
+    fprintf(stderr, "zompi: pmix modex via %s:%d/%s failed: %s\n",
+            host.c_str(), port, ns.c_str(), err.c_str());
+  return ok;
+}
+
 // ------------------------------------------------------------ C ABI
 
 // thread-level / finalized bookkeeping (init_thread.c, finalized.c);
@@ -2947,14 +3127,19 @@ int MPI_Init(int *, char ***) {
   const char *s = getenv("ZMPI_SIZE");
   const char *ch = getenv("ZMPI_COORD_HOST");
   const char *cp = getenv("ZMPI_COORD_PORT");
-  if (!r || !s || !ch || !cp) {
-    fprintf(stderr, "zompi: ZMPI_RANK/SIZE/COORD_HOST/COORD_PORT unset\n");
+  // a zmpirun --dvm job carries no coordinator at all: the resident
+  // daemon's PMIx store serves the modex (ZMPI_PMIX = host:port/ns)
+  const char *px = getenv("ZMPI_PMIX");
+  bool dvm_store = px && px[0];
+  if (!r || !s || (!dvm_store && (!ch || !cp))) {
+    fprintf(stderr, "zompi: ZMPI_RANK/SIZE plus ZMPI_COORD_HOST/PORT "
+                    "(or ZMPI_PMIX) unset\n");
     return MPI_ERR_OTHER;
   }
   g.rank = atoi(r);
   g.size = atoi(s);
-  std::string coord_host = ch;
-  int coord_port = atoi(cp);
+  std::string coord_host = ch ? ch : "";
+  int coord_port = cp ? atoi(cp) : 0;
   // same MCA var (and default) as the Python plane's protocol switch
   const char *el = getenv("ZMPI_MCA_tcp_eager_limit");
   if (el && el[0]) g.eager_limit = atoll(el);
@@ -2982,7 +3167,15 @@ int MPI_Init(int *, char ***) {
   // rank 0 — joins as a client.
   const char *ext = getenv("ZMPI_COORD_EXTERNAL");
   bool external_coord = ext && ext[0] == '1';
-  if (g.rank == 0 && !external_coord) {
+  if (dvm_store) {
+    // store-served modex (the --dvm shape): every rank is a store
+    // client; the daemon hosting this rank holds (or leaf-caches) the
+    // whole job's cards.  The lifeline then ties this process's life
+    // to its daemon's.
+    if (!pmix_modex(px)) return MPI_ERR_OTHER;
+    const char *ll = getenv("ZMPI_LIFELINE");
+    if (ll && ll[0]) arm_lifeline(ll);
+  } else if (g.rank == 0 && !external_coord) {
     int srv = socket(AF_INET, SOCK_STREAM, 0);
     setsockopt(srv, SOL_SOCKET, SO_REUSEADDR, &one, sizeof one);
     sockaddr_in ca{};

@@ -488,9 +488,33 @@ class TcpProc(errh.HasErrhandler, ulfm.UlfmEndpointAPI, HostCollectives,
                  rejoin_gen: int = 0,
                  rejoin_ranks: "list[int] | None" = None,
                  metrics: bool | None = None,
-                 trace: bool | None = None):
+                 trace: bool | None = None,
+                 live_ranks: "list[int] | None" = None):
         if size < 1:
             raise errors.ArgError("size must be >= 1")
+        # elastic membership (the DVM resize contract): the universe is
+        # `size` slots but only `live_ranks` started — the rest wire up
+        # as pre-acknowledged departures (the orderly-BYE state), so
+        # collectives ride a shrunken endpoint over the live set and a
+        # later grow FT_JOINs an absent slot exactly like a recovery
+        # window's replacement
+        self._live_ranks: frozenset[int] | None = None
+        if live_ranks is not None:
+            live = frozenset(int(r) for r in live_ranks)
+            if live != frozenset(range(size)):
+                if rank not in live:
+                    raise errors.ArgError(
+                        f"live_ranks must include this rank ({rank})")
+                if not live <= frozenset(range(size)):
+                    raise errors.ArgError(
+                        "live_ranks outside the universe size")
+                if pmix is None or not ft:
+                    raise errors.ArgError(
+                        "elastic membership (live_ranks a proper "
+                        "subset) needs the store-served wire-up and "
+                        "fault tolerance: pass pmix=(host, port) and "
+                        "ft=True (the ZMPI_ELASTIC_LIVE contract)")
+                self._live_ranks = live
         # metrics plane: explicit opt-in (ctor arg) or the ZMPI_METRICS
         # environment contract a DVM job launched with metrics=True
         # exports.  Publishing needs a store — an explicit metrics=True
@@ -703,6 +727,14 @@ class TcpProc(errh.HasErrhandler, ulfm.UlfmEndpointAPI, HostCollectives,
                 self.address_book = self._modex_pmix(timeout)
             else:
                 self.address_book = self._modex(coordinator, timeout)
+            if self._live_ranks is not None:
+                # absent slots are pre-acknowledged departures from the
+                # first moment: named traffic to them classifies typed,
+                # the detector ring skips them, shrink excludes them —
+                # and a grow's FT_JOIN restores them like any rejoiner
+                for r in range(size):
+                    if r != rank and r not in self._live_ranks:
+                        self.ft_state.mark_departed(r)
             mca_output.verbose(
                 5, _stream, "rank %d up at %s; book=%s", rank, self.address,
                 self.address_book,
@@ -1139,15 +1171,22 @@ class TcpProc(errh.HasErrhandler, ulfm.UlfmEndpointAPI, HostCollectives,
             self.ft_state.record_agreement(int(seq), result)
         elif cid == ulfm.FT_DVM_CID:
             # authoritative fault event from the runtime daemon (zprted
-            # waitpid-watched the corpse exit): OS truth, not suspicion —
-            # classify immediately, before any heartbeat window expires.
-            # The daemon floods every survivor itself (it holds the
-            # name-served address book), so no onward relay is needed.
+            # waitpid-watched the corpse exit, or a parent daemon saw a
+            # whole subtree's link drop): OS truth, not suspicion —
+            # classify immediately, before any heartbeat window
+            # expires.  The daemon tree floods every survivor itself
+            # (each daemon notifies the ranks IT hosts), so no onward
+            # relay is needed.  A third entry value names the cause
+            # ("daemon-tree" = the rank died WITH its host daemon).
             fresh = 0
             for entry in payload:
-                r = int(entry[0]) if isinstance(entry, (list, tuple)) \
-                    else int(entry)
-                if self.ft_state.mark_failed(r, cause="daemon"):
+                if isinstance(entry, (list, tuple)):
+                    r = int(entry[0])
+                    cause = str(entry[2]) if len(entry) > 2 \
+                        else "daemon"
+                else:
+                    r, cause = int(entry), "daemon"
+                if self.ft_state.mark_failed(r, cause=cause):
                     fresh += 1
             if fresh:
                 spc.record("dvm_fault_events", fresh)
@@ -1185,6 +1224,10 @@ class TcpProc(errh.HasErrhandler, ulfm.UlfmEndpointAPI, HostCollectives,
                 # FRESH generation-tagged cards from the store, neither
                 # has the other marked failed, and dialing a sibling
                 # still mid-construction would race its wiring
+                continue
+            if self.ft_state.is_failed(r):
+                # a known-dead or elastic-absent slot: nothing to
+                # announce to (its placeholder address dials nowhere)
                 continue
             try:
                 sock = self._endpoint(r, deadline=min(2.0, timeout))
@@ -1416,13 +1459,22 @@ class TcpProc(errh.HasErrhandler, ulfm.UlfmEndpointAPI, HostCollectives,
 
         client = pmix_mod.PmixClient(self._pmix_addr, timeout=timeout)
         try:
-            client.ensure_ns(self._pmix_ns, self.size)
+            # elastic jobs fence over the STARTED set only (the
+            # namespace size is the initial live count — absent slots
+            # would park the barrier forever); their cards are
+            # placeholders until a grow's FT_JOIN announces the truth
+            live = self._live_ranks
+            client.ensure_ns(self._pmix_ns,
+                             self.size if live is None else len(live))
             client.put(self._pmix_ns, self.rank, f"card:{self.rank}",
                        self._my_card())
             client.commit(self._pmix_ns, self.rank)
             client.fence(self._pmix_ns, self.rank, timeout)
-            book = [client.get(self._pmix_ns, f"card:{r}", timeout)
-                    for r in range(self.size)]
+            book = [
+                client.get(self._pmix_ns, f"card:{r}", timeout)
+                if live is None or r in live else ["0.0.0.0", 0]
+                for r in range(self.size)
+            ]
         except errors.MpiError as e:
             return self.call_errhandler(errors.InternalError(
                 f"pmix modex via {self._pmix_addr} "
@@ -1454,6 +1506,12 @@ class TcpProc(errh.HasErrhandler, ulfm.UlfmEndpointAPI, HostCollectives,
             client.commit(self._pmix_ns, self.rank)
             book = []
             for r in range(self.size):
+                if self._live_ranks is not None \
+                        and r not in self._live_ranks:
+                    # an absent elastic slot: no card to wait for (a
+                    # retired slot's STALE card must not be dialed)
+                    book.append(["0.0.0.0", 0])
+                    continue
                 min_gen = self._rejoin_gen \
                     if r != self.rank and r in self._rejoin_ranks else 0
                 book.append(client.get(self._pmix_ns, f"card:{r}",

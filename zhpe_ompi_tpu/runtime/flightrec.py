@@ -52,9 +52,13 @@ COLL_EXIT = "coll_exit"    # coll/han.py schedule + phase completion
 FT_CLASS = "ft_class"      # ft/ulfm.py FailureState classification
 REVOKE = "revoke"          # ft/ulfm.py cid revocation
 RESPAWN = "respawn"        # ft/recovery.py respawn pipelines
+RESIZE = "resize"          # runtime/dvm.py resize RPC + elastic-session
+                           # membership changes (ft/recovery.py)
+DAEMON_FAULT = "daemon_fault"  # runtime/dvm.py fault routing (a rank's
+                           # waitpid death or a lost daemon subtree)
 
 ALL_EVENTS = (SEND, RECV, MATCH, COLL_ENTER, COLL_EXIT, FT_CLASS,
-              REVOKE, RESPAWN)
+              REVOKE, RESPAWN, RESIZE, DAEMON_FAULT)
 
 #: hot-path gate (the peruse cost discipline): seams check this bare
 #: module attribute before paying the record() call.  False until a

@@ -167,13 +167,22 @@ def host_init(timeout: float = 30.0):
                 )
             addr, ns = pmix_uri.rsplit("/", 1)
             rejoin_ranks = os.environ.get("ZMPI_REJOIN_RANKS", "")
+            elastic_live = os.environ.get("ZMPI_ELASTIC_LIVE", "")
             proc = TcpProc(
                 rank, size, pmix=addr, namespace=ns, timeout=timeout,
                 ft=ft, rejoin=os.environ.get("ZMPI_REJOIN") == "1",
                 rejoin_gen=int(os.environ.get("ZMPI_REJOIN_GEN", 0)),
                 rejoin_ranks=[int(r) for r in rejoin_ranks.split(",")
                               if r],
+                # elastic jobs: only the live slots started (the rest
+                # wire up as pre-acknowledged departures a later grow
+                # restores) — the DVM resize contract
+                live_ranks=[int(r) for r in elastic_live.split(",")
+                            if r] or None,
             )
+            lifeline = os.environ.get("ZMPI_LIFELINE")
+            if lifeline:
+                _arm_lifeline(lifeline)
         else:
             proc = TcpProc(
                 rank, size, coordinator=(chost, cport), timeout=timeout,
@@ -188,6 +197,55 @@ def host_init(timeout: float = 30.0):
             (time.perf_counter() - t0) * 1e3,
         )
         return proc
+
+
+def _arm_lifeline(address: str) -> None:
+    """Park one connection on the host daemon's control port for this
+    process's whole life (the ``ZMPI_LIFELINE`` contract): the daemon
+    never replies, and the connection dying means the daemon died —
+    a rank must not outlive the daemon that owns its store, its fault
+    routing, and its exit accounting (the PRRTE local-procs-die-with-
+    their-prted contract, made explicit).  Exit code 143 mirrors the
+    SIGTERM teardown the daemon itself would have applied."""
+    import os
+    import socket
+    import sys
+
+    from ..pt2pt.tcp import _recv_frame, _send_frame
+    from ..utils import dss
+
+    host, port = address.rsplit(":", 1)
+    try:
+        sock = socket.create_connection((host, int(port)), 10.0)
+        _send_frame(sock, dss.pack(["lifeline"]))
+        sock.settimeout(None)
+    except OSError:
+        # the daemon is already gone: the modex above only succeeded
+        # against a live store, so this is a teardown race — exit the
+        # way the severed lifeline would have made us
+        os._exit(143)
+
+    def watch():
+        try:
+            while True:
+                if _recv_frame(sock) is None:
+                    break
+        except OSError:
+            pass
+        try:
+            sys.stderr.write(
+                "zmpi: host daemon lifeline severed — exiting\n")
+            sys.stderr.flush()
+        except OSError:
+            # stderr IS the daemon's IOF pipe: a dead daemon broke it
+            # too, and the farewell must never outrank the exit
+            pass
+        os._exit(143)
+
+    t = threading.Thread(target=watch, daemon=True,
+                         name="zmpi-lifeline")
+    t.start()
+    _host["lifeline"] = (sock, t)
 
 
 def host_world():

@@ -273,6 +273,14 @@ class PmixStore:
         self._ns: dict[str, _Namespace] = {}
         self._cv = threading.Condition()
         self.open = True
+        # coherence hooks for a store that fronts a DAEMON TREE
+        # (runtime/dvmtree.py): the root daemon sets these so every
+        # generation bump / namespace destroy — whichever surface it
+        # arrived through (wire verb, respawn RPC, resize) — rides the
+        # tree links down as cache invalidations.  Called OUTSIDE the
+        # store lock, after the mutation is visible.
+        self.on_generation: "Any | None" = None
+        self.on_destroy: "Any | None" = None
         _live_stores.add(self)
 
     # -- namespace lifecycle ---------------------------------------------
@@ -298,6 +306,8 @@ class PmixStore:
         with self._cv:
             existed = self._ns.pop(ns, None) is not None
             self._cv.notify_all()
+        if existed and self.on_destroy is not None:
+            self.on_destroy(ns)
         return existed
 
     def namespaces(self) -> list[str]:
@@ -423,7 +433,10 @@ class PmixStore:
         with self._cv:
             space = self._require(ns)
             space.generation += 1
-            return space.generation
+            gen = space.generation
+        if self.on_generation is not None:
+            self.on_generation(ns, gen)
+        return gen
 
     def generation(self, ns: str) -> int:
         with self._cv:

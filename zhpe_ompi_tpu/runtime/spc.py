@@ -156,6 +156,19 @@ the daemon-side values):
 - ``dvm_respawns`` — replacement processes exec'd by the relaunch RPC
   (N victims respawned in one batched RPC count N, but share ONE
   namespace-generation bump — the same recovery window).
+- ``dvm_tree_forwards`` — store verbs a CHILD daemon pushed up its
+  parent link (``runtime/dvmtree.py``): every write
+  (put/commit/fence/mkns/…), every ``lookup`` (mutable keys are never
+  cached), and every ``get`` cache miss.  Recorded in the child
+  daemon's process.
+- ``dvm_store_cache_hits`` — blocking ``get``\\ s a child daemon served
+  from its leaf-local cache instead of forwarding (single-flight
+  waiters of an in-flight fetch count here once it lands).  The OSU
+  ``--launch`` ladder's depth >= 1 gate: hits rise while the root
+  store's ``pmix_gets`` stays near-flat.
+- ``dvm_resizes`` — elastic resize RPCs the root daemon applied (one
+  per grow or shrink event published, however many ranks it spawned
+  or retired).
 
 API-surface counters (recorded at the MPI/OpenSHMEM call sites; the
 ZL006 doc-parity rule keeps this table and the ``spc.record`` call

@@ -79,9 +79,12 @@ FT_CLASS = "ft_class"  # ft/ulfm.py failure classification (instant)
 AGREE = "agree"        # fault-tolerant agreement protocol run
 SHRINK = "shrink"      # survivor-endpoint construction (consensus)
 RESPAWN = "respawn"    # ft/recovery.py respawn legs
+RESIZE = "resize"      # elastic-resize legs: the daemon's RPC span
+                       # (generation + delta) and each rank's
+                       # membership-rebuild span (ft/recovery.py)
 
 ALL_KINDS = (SEND, RECV, DELIVER, MATCH, RTS, CTS, PUSH, PHASE, COLL,
-             FT_CLASS, AGREE, SHRINK, RESPAWN)
+             FT_CLASS, AGREE, SHRINK, RESPAWN, RESIZE)
 
 #: hot-path gate (the peruse discipline): seams check this bare module
 #: attribute before paying anything — False means no span dicts, no

@@ -217,18 +217,25 @@ def launch(n: int, argv: list[str], host: str = "127.0.0.1",
                        ft=ft)
 
 
-def launch_dvm(dvm: str, n: int, argv: list[str],
+def launch_dvm(dvm: str, n: int, argv: list[str] | None = None,
                mca: list[tuple[str, str]] | None = None,
                timeout: float | None = None, tag_output: bool = True,
                stdout=None, stderr=None, ft: bool = False,
-               metrics: bool = False, trace: bool = False) -> int:
+               metrics: bool = False, trace: bool = False,
+               max_size: int | None = None,
+               apps: list[tuple[int, list[str]]] | None = None) -> int:
     """Launch a job INTO a resident runtime daemon (``zmpirun --dvm``):
     the zprted VM hosts the PMIx store and the children, streams their
     IOF back here, and outlives the job — no per-job rendezvous, no
     name server, no launcher teardown (the prte DVM shape;
-    :mod:`zhpe_ompi_tpu.runtime.dvm`).  ``metrics=True`` exports
-    ``ZMPI_METRICS=1`` to every rank: each publishes SPC snapshots into
-    the resident store (the fleet-visible metrics plane)."""
+    :mod:`zhpe_ompi_tpu.runtime.dvm`).  On a DVM *tree* the target may
+    be any daemon, but launches go to the root (``zmpirun --dvm`` users
+    pass the root's address); ranks are block-placed across the tree's
+    hosts.  ``metrics=True`` exports ``ZMPI_METRICS=1`` to every rank:
+    each publishes SPC snapshots into the resident store (the
+    fleet-visible metrics plane).  ``max_size`` (> n) launches the job
+    ELASTIC (see :meth:`DvmClient.launch`); ``apps`` is the MPMD form —
+    mixed C/Python contexts share the store-served wire-up."""
     from ..runtime.dvm import DvmClient
 
     client = DvmClient(dvm)
@@ -236,7 +243,22 @@ def launch_dvm(dvm: str, n: int, argv: list[str],
         return client.launch(n, argv, mca=mca, ft=ft, timeout=timeout,
                              tag_output=tag_output, stdout=stdout,
                              stderr=stderr, metrics=metrics,
-                             trace=trace)
+                             trace=trace, max_size=max_size, apps=apps)
+    finally:
+        client.close()
+
+
+def resize_dvm(dvm: str, job_id: str, n: int,
+               timeout: float = 60.0) -> dict:
+    """Elastic resize of a running ft job in the resident VM
+    (``zmpirun --dvm H:P --resize JOB -n N``): grow spawns fresh ranks
+    that FT_JOIN the live job, shrink retires the highest live ranks
+    through the orderly-BYE path.  Returns the applied event."""
+    from ..runtime.dvm import DvmClient
+
+    client = DvmClient(dvm)
+    try:
+        return client.resize(job_id, n, timeout=timeout)
     finally:
         client.close()
 
@@ -445,7 +467,17 @@ def main(args: list[str] | None = None) -> int:
     ap.add_argument("--dvm", default=None, metavar="HOST:PORT",
                     help="launch into a resident zprted daemon instead "
                          "of cold-spawning (python -m "
-                         "zhpe_ompi_tpu.runtime.dvm starts one)")
+                         "zhpe_ompi_tpu.runtime.dvm starts one; on a "
+                         "daemon TREE pass the root's address)")
+    ap.add_argument("--max-size", type=int, default=None,
+                    help="elastic job (--dvm + --ft only): the "
+                         "endpoint universe is this many slots, -n of "
+                         "them start live, and the daemon's resize RPC "
+                         "grows/shrinks membership while the job runs")
+    ap.add_argument("--resize", default=None, metavar="JOB",
+                    help="resize a RUNNING elastic job in the resident "
+                         "VM to -n live ranks (--dvm only; no program "
+                         "argument) and print the applied event")
     ap.add_argument("--ft", action="store_true",
                     help="fault-tolerant job: ranks build ft=True "
                          "endpoints (detector, typed failures, daemon "
@@ -472,6 +504,19 @@ def main(args: list[str] | None = None) -> int:
         else:
             contexts[-1].append(tok)
     first = ap.parse_args(contexts[0])
+    if first.resize is not None:
+        if not first.dvm:
+            ap.error("--resize needs --dvm (the job lives in the "
+                     "resident VM)")
+        if first.argv or len(contexts) > 1:
+            ap.error("--resize takes no program: -n is the new live "
+                     "size")
+        event = resize_dvm(first.dvm, first.resize, first.n,
+                           timeout=first.timeout or 60.0)
+        print(f"resized {event['job']} to {event['size']} "
+              f"(grown={event['grown']} retired={event['retired']} "
+              f"generation={event['generation']})")
+        return 0
     if not first.argv:
         ap.error("no program given")
     apps = [(first.n, first.argv)]
@@ -483,13 +528,17 @@ def main(args: list[str] | None = None) -> int:
         # later and ignoring them would silently drop user intent
         if (more.host != "127.0.0.1" or more.mca or
                 more.timeout is not None or more.no_tag_output or
-                more.dvm or more.ft or more.metrics or more.trace):
+                more.dvm or more.ft or more.metrics or more.trace or
+                more.max_size is not None or more.resize is not None):
             ap.error(
                 "--host/--mca/--timeout/--no-tag-output/--dvm/--ft/"
-                "--metrics/--trace are job-global: pass them in the "
-                "first app context"
+                "--metrics/--trace/--max-size/--resize are "
+                "job-global: pass them in the first app context"
             )
         apps.append((more.n, more.argv))
+    if first.max_size is not None and not first.dvm:
+        ap.error("--max-size (elastic) needs the resident VM: run "
+                 "with --dvm")
     # signal hygiene (main thread only — the CLI path): SIGINT/SIGTERM
     # are forwarded to the job, children reaped, ports released, exit
     # 128+sig — see _JobSignal
@@ -503,16 +552,15 @@ def main(args: list[str] | None = None) -> int:
             restore[s] = signal.signal(s, _on_signal)
     try:
         if first.dvm:
-            if len(apps) > 1:
-                ap.error("--dvm launches a single app context (MPMD "
-                         "stays on the cold path)")
             return launch_dvm(
-                first.dvm, first.n, first.argv,
+                first.dvm, first.n,
+                first.argv if len(apps) == 1 else None,
                 mca=[tuple(m) for m in first.mca],
                 timeout=first.timeout,
                 tag_output=not first.no_tag_output, ft=first.ft,
                 metrics=first.metrics or first.trace,
-                trace=first.trace,
+                trace=first.trace, max_size=first.max_size,
+                apps=None if len(apps) == 1 else apps,
             )
         if first.metrics or first.trace:
             ap.error("--metrics/--trace need the resident store: run "
