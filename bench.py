@@ -149,6 +149,7 @@ def main():
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
     import zhpe_ompi_tpu as zmpi
+    from zhpe_ompi_tpu import compat
     from zhpe_ompi_tpu.models import transformer as tfm
 
     devs = jax.devices()
@@ -289,7 +290,7 @@ def main():
             return new_p, loss
 
         return jax.jit(
-            jax.shard_map(
+            compat.shard_map(
                 spmd_step, mesh=mesh,
                 in_specs=(param_specs, P("dp"), P("dp")),
                 out_specs=(param_specs, P()),
@@ -418,17 +419,17 @@ def main():
 # The probe carries its own HARD internal deadline (a watchdog thread that
 # os._exit(3)s), so a wedged jax.devices() dies from the inside even if the
 # outer kill is delayed; the subprocess timeout stays as the backstop.
-_PROBE_DEADLINE_RC = 3
+# The idiom lives in zhpe_ompi_tpu/utils/deadline.py — the device
+# liveness probe (parallel/mesh.py) arms the SAME machinery, so bench
+# and the device plane are one implementation.
+from zhpe_ompi_tpu.utils import deadline as _deadline
+
+_PROBE_DEADLINE_RC = _deadline.PROBE_DEADLINE_RC
+# the probe BODY only: run_probe prepends watchdog_preamble(), which is
+# where the armed-before-the-jax-import ordering guarantee now lives
+# (and imports os/sys/threading/time for the body)
 _PROBE_SRC = (
-    "import json,os,sys,threading,time\n"
-    "dl=float(os.environ.get('ZMPI_BENCH_PROBE_DEADLINE') or 0)\n"
-    "if dl>0:\n"
-    "    def _expire():\n"
-    "        time.sleep(dl)\n"
-    "        sys.stderr.write('probe internal deadline (%.0fs)\\n'%dl)\n"
-    "        sys.stderr.flush()\n"
-    "        os._exit(" + str(_PROBE_DEADLINE_RC) + ")\n"
-    "    threading.Thread(target=_expire,daemon=True).start()\n"
+    "import json\n"
     "import jax\n"
     "p=os.environ.get('JAX_PLATFORMS')\n"
     "jax.config.update('jax_platforms', p) if p else None\n"
@@ -446,29 +447,17 @@ def _tail(text: str, n: int = 800) -> str:
 def _run_probe(timeout_s: float, deadline_s: float,
                src: str = _PROBE_SRC) -> tuple[str, str]:
     """One backend probe in a killable child with an internal watchdog
-    deadline.  Returns (kind, detail): kind is "ok" (detail = device
+    deadline — utils/deadline.run_probe with the bench's detail
+    phrasing.  Returns (kind, detail): kind is "ok" (detail = device
     JSON), "hung" (outer kill), "deadline" (internal watchdog), or
     "error" (nonzero exit) — a STRUCTURED outcome, so the retry ladder
     never has to sniff free-form stderr (a gRPC DEADLINE_EXCEEDED in an
     ordinary error must not be mistaken for a wedged probe).  Never
     raises: every outcome feeds the retry/fallback ladder."""
-    env = dict(os.environ, ZMPI_BENCH_PROBE_DEADLINE=str(deadline_s))
-    try:
-        probe = subprocess.run(
-            [sys.executable, "-c", src],
-            capture_output=True, text=True, timeout=timeout_s, env=env,
-        )
-    except subprocess.TimeoutExpired:
-        return "hung", f"backend probe hung {timeout_s:.0f}s (killed)"
-    if probe.returncode == _PROBE_DEADLINE_RC:
-        return "deadline", (
-            f"backend probe hit its internal deadline ({deadline_s:.0f}s)"
-        )
-    if probe.returncode != 0:
-        return "error", (
-            f"probe rc={probe.returncode}: {_tail(probe.stderr, 400)}"
-        )
-    return "ok", probe.stdout.strip()
+    kind, detail = _deadline.run_probe(src, timeout_s, deadline_s)
+    if kind in ("hung", "deadline"):
+        return kind, "backend " + detail
+    return kind, detail
 
 
 def _cpu_env() -> dict:

@@ -43,6 +43,7 @@ def main():
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
     import zhpe_ompi_tpu as zmpi
+    from zhpe_ompi_tpu import compat
     from zhpe_ompi_tpu.models import transformer as tfm
 
     devs = jax.devices()
@@ -102,7 +103,7 @@ def main():
         )
         return new_p, loss
 
-    step_pl = jax.jit(jax.shard_map(
+    step_pl = jax.jit(compat.shard_map(
         spmd_step, mesh=mesh,
         in_specs=(specs, P("dp"), P("dp")),
         out_specs=(specs, P()), check_vma=False,

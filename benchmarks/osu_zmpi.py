@@ -159,6 +159,53 @@ def bench_collective(opname: str, algorithm: str = "auto",
     return rows
 
 
+def bench_device_probe(rounds: int = 3) -> list[dict]:
+    """``--plane device`` probe row: the device liveness probe
+    (parallel/mesh.probe_device_plane — the killable-child tiny psum
+    the fault loop arms) run ``rounds`` times against the healthy
+    plane, COUNTER-GATED:
+
+    - ``device_probe_rounds`` rose by exactly the rounds launched;
+    - ``device_probe_misses`` and ``device_faults`` stayed ZERO — with
+      no wedge injected, any classification is a false positive and
+      fails the run loudly (the device plane's zero-false-positive
+      contract, the twin of the detector gate).
+
+    Latency is REPORT-ONLY (a subprocess jax import dominates and the
+    1-CPU container adds ±20% noise); the gates are the deliverable."""
+    from zhpe_ompi_tpu.parallel import mesh as mesh_mod
+    from zhpe_ompi_tpu.runtime import spc
+
+    before = spc.snapshot()
+    lats = []
+    for i in range(max(1, rounds)):
+        t0 = time.perf_counter()
+        kind, detail = mesh_mod.probe_device_plane()
+        lats.append(time.perf_counter() - t0)
+        if kind != "ok":
+            raise SystemExit(
+                f"--plane device probe round {i}: healthy plane "
+                f"answered {kind!r} ({detail}) — a false-positive "
+                "classification path, failing the run")
+    after = spc.snapshot()
+    got_rounds = after.get("device_probe_rounds", 0) \
+        - before.get("device_probe_rounds", 0)
+    misses = after.get("device_probe_misses", 0) \
+        - before.get("device_probe_misses", 0)
+    faults = after.get("device_faults", 0) \
+        - before.get("device_faults", 0)
+    if got_rounds < max(1, rounds) or misses or faults:
+        raise SystemExit(
+            f"--plane device probe gates failed: rounds={got_rounds} "
+            f"(want >= {max(1, rounds)}), misses={misses} (want 0), "
+            f"device_faults={faults} (want 0)")
+    return [{
+        "op": "device_probe", "rounds": got_rounds,
+        "misses": misses, "device_faults": faults,
+        "probe_latency_ms": float(np.median(lats)) * 1e3,  # report-only
+    }]
+
+
 def bench_pt2pt(max_size: int = 4 << 20, iters: int = 50,
                 bw: bool = False, window: int = 16) -> list[dict]:
     """Host-plane pt2pt over the thread-rank universe — the btl/self+sm
@@ -1716,6 +1763,14 @@ def _print_table(rows: list[dict]) -> None:
     print(f"{'Size (B)':>12} {'Latency (us)':>16} {'BW (MB/s)':>14}"
           + (f" {'Overlap':>8} {'Blocking':>9}" if overlap else ""))
     for r in rows:
+        if r.get("op") == "device_probe":
+            # the trailing probe row (gates already enforced): its
+            # latency is report-only and has no bytes axis
+            print(f"# device_probe rounds={r['rounds']} "
+                  f"misses={r['misses']} "
+                  f"device_faults={r['device_faults']} "
+                  f"latency={r['probe_latency_ms']:.0f}ms (report-only)")
+            continue
         line = (f"{r['bytes']:>12} {r['latency_us']:>16.2f} "
                 f"{r['bandwidth_MBps']:>14.1f}")
         if overlap:
@@ -1873,6 +1928,10 @@ def main(argv: list[str] | None = None) -> int:
         rows = bench_collective(
             args.op, args.algorithm, args.max_size, args.iters
         )
+        # the device plane carries the fault loop: every default-plane
+        # ladder ends with the probe row (rounds > 0, zero
+        # classifications — see bench_device_probe's gates)
+        rows += bench_device_probe(rounds=max(1, min(args.iters, 3)))
 
     if args.json:
         for r in rows:

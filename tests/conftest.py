@@ -177,6 +177,19 @@ def _ulfm_detector_hygiene():
         f"metrics HTTP listeners left bound past their daemon's "
         f"stop(): {scrapers}"
     )
+    from zhpe_ompi_tpu.utils import deadline as deadline_mod
+
+    watchdogs = deadline_mod.live_watchdog_threads()
+    assert not watchdogs, (
+        f"deadline watchdog threads leaked past their guard's exit "
+        f"(every probe guard disarms on region return): {watchdogs}"
+    )
+    probes = deadline_mod.orphaned_probe_processes()
+    assert not probes, (
+        f"probe subprocesses orphaned past their run_probe call (ok/"
+        f"deadline/error children are reaped, hung ones killed): "
+        f"{probes}"
+    )
     from zhpe_ompi_tpu.utils import lockdep
 
     inversions = lockdep.cycles()

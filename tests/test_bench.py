@@ -9,16 +9,21 @@ import bench
 
 
 def _watchdog_prelude() -> str:
-    """The _PROBE_SRC up to (excluding) the jax import: the watchdog
-    must already be armed by then — that ordering IS the deadline
-    guarantee for a wedged jax.devices()."""
-    head, sep, _ = bench._PROBE_SRC.partition("import jax")
+    """The watchdog must be armed before the jax import — that
+    ordering IS the deadline guarantee for a wedged jax.devices().
+    It now lives in utils/deadline: run_probe prepends
+    watchdog_preamble() to every child, so the ASSEMBLED bench probe
+    is checked here (one probe idiom, one place the guarantee holds)."""
+    from zhpe_ompi_tpu.utils import deadline
+
+    assembled = deadline.watchdog_preamble() + bench._PROBE_SRC
+    head, sep, _ = assembled.partition("import jax")
     assert sep, "_PROBE_SRC no longer imports jax?"
     assert "threading.Thread" in head, (
         "the probe watchdog must start BEFORE the jax import — a hang "
         "inside jax.devices() is exactly what it exists to kill"
     )
-    return head
+    return ""  # run_probe arms the preamble itself; callers pass bodies
 
 
 class TestProbeDeadline:

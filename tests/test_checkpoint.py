@@ -349,3 +349,97 @@ class TestCheckpointCli:
         from zhpe_ompi_tpu.tools import checkpoint as cli
 
         assert cli.main(["list", str(tmp_path)]) == 1
+
+
+class TestRestoreOntoSurvivorMesh:
+    """restore(shardings=...) onto a SMALLER mesh — the re-shard-on-
+    restore leg of the device-plane recovery pipeline: a checkpoint
+    written by the full-size job must materialize directly onto the
+    survivor mesh a shrink left behind (parallel/mesh.survivor_mesh),
+    including when the rollback finds a crashed writer's interrupted
+    republish (the .old-heal path)."""
+
+    def _full_state(self, world, rows=48):
+        # rows divisible by the full size AND the survivor sizes the
+        # tests shrink to (jax NamedSharding partitions evenly)
+        sharding = NamedSharding(world.mesh, P("world"))
+        return {
+            "w": jax.device_put(
+                jnp.arange(rows * 4,
+                           dtype=jnp.float32).reshape(rows, 4),
+                sharding),
+            "step_count": jnp.asarray(3, jnp.int32),
+        }
+
+    def _survivor_sharding(self, world, failed):
+        from zhpe_ompi_tpu.parallel import mesh as mesh_mod
+
+        surv = mesh_mod.survivor_mesh(world.mesh, failed=failed)
+        return surv, NamedSharding(surv, P("world"))
+
+    def test_reshard_on_restore_after_shrink(self, tmp_path, world):
+        ck = Checkpointer(str(tmp_path), check_quiescent=False)
+        state = self._full_state(world)
+        ck.save(5, state, blocking=True)
+        surv, sharding = self._survivor_sharding(world, failed=[2, 5])
+        got, step = ck.restore(shardings={"w": sharding,
+                                          "step_count": None})
+        assert step == 5
+        # bytes identical, placement STRICTLY on the survivor devices
+        np.testing.assert_array_equal(np.asarray(got["w"]),
+                                      np.asarray(state["w"]))
+        used = {d for d in got["w"].sharding.device_set}
+        dropped = {np.asarray(world.mesh.devices).flat[i]
+                   for i in (2, 5)}
+        assert used and not (used & dropped), (used, dropped)
+        assert int(got["step_count"]) == 3
+
+    def test_old_heal_interacts_with_shrink_rollback(self, tmp_path,
+                                                     world):
+        """A writer crashed mid-republish (step_N.old retired, no
+        step_N published) just before the fault: the shrink-triggered
+        rollback must heal BACKWARDS and still re-shard the healed
+        step onto the survivor mesh."""
+        import shutil
+
+        ck = Checkpointer(str(tmp_path), check_quiescent=False)
+        state = self._full_state(world, rows=56)  # 8- and 7-divisible
+        ck.save(7, state, blocking=True)
+        # simulate the crash window: retired-but-never-republished
+        d = str(tmp_path / "step_7")
+        os.replace(d, d + ".old")
+        assert ck.all_steps() == []  # nothing published...
+        surv, sharding = self._survivor_sharding(world, failed=[0])
+        got, step = ck.restore(shardings={"w": sharding,
+                                          "step_count": None})
+        assert step == 7  # ...but the heal resurrected the retired copy
+        np.testing.assert_array_equal(np.asarray(got["w"]),
+                                      np.asarray(state["w"]))
+        assert not os.path.exists(d + ".old")  # healed, not leftover
+        # the OTHER heal direction: .old WITH a published final is
+        # stale — the survivor-shardings restore drops it and loads
+        # the published version
+        ck.save(8, state, blocking=True)
+        shutil.copytree(str(tmp_path / "step_8"),
+                        str(tmp_path / "step_8.old"))
+        got, step = ck.restore(shardings={"w": sharding,
+                                          "step_count": None})
+        assert step == 8
+        assert not os.path.exists(str(tmp_path / "step_8.old"))
+
+    def test_multi_failure_survivor_split_still_loads(self, tmp_path,
+                                                      world):
+        """40 rows over a 5-device survivor mesh (8 minus 3 failed):
+        a different extent geometry than the full-size save — each
+        device reads only its slice and the reassembled array is
+        bit-identical."""
+        ck = Checkpointer(str(tmp_path), check_quiescent=False)
+        state = self._full_state(world, rows=40)
+        ck.save(1, state, blocking=True)
+        surv, sharding = self._survivor_sharding(world,
+                                                 failed=[1, 4, 6])
+        assert surv.devices.size == 5
+        got, _ = ck.restore(shardings={"w": sharding,
+                                       "step_count": None})
+        np.testing.assert_array_equal(np.asarray(got["w"]),
+                                      np.asarray(state["w"]))

@@ -396,6 +396,52 @@ class TestDvm:
         assert pmix_mod.live_servers() == []
         assert pmix_mod.stale_namespaces() == []
 
+    def test_starved_iof_drain_never_loses_the_final_line(
+            self, tmp_path, monkeypatch):
+        """The finalize-skew regression (intermittent in
+        TestDvmMultiVictimRecovery since PR 11): job exit accounting
+        fires on the last waitpid, but a rank's final stdout line is
+        still in its pipe until the IOF drain THREAD pumps it — a
+        drain starved by scheduler load past a short per-thread join
+        bound lost the line to a client that stopped reading at the
+        exit frame.  Starvation is simulated deterministically (the
+        last rank's stdout drain sleeps 3 s before pumping — beyond
+        the old 2 s bound, inside the shared _IOF_DRAIN_GRACE): the
+        exit frame must WAIT, and every line must reach the client."""
+        import time as time_mod
+
+        dvm_mod = self._mod()
+        prog = _script(tmp_path, """
+            import os
+
+            print(f"LAST-LINE rank={os.environ['ZMPI_RANK']}",
+                  flush=True)
+        """)
+        orig = dvm_mod.Dvm._drain_iof
+
+        def starved(self, job, rank, label, stream):
+            if rank == 1 and label == "":
+                time_mod.sleep(3.0)  # the starved scheduler slot
+            orig(self, job, rank, label, stream)
+
+        monkeypatch.setattr(dvm_mod.Dvm, "_drain_iof", starved)
+        d = dvm_mod.Dvm()
+        try:
+            cli = dvm_mod.DvmClient(d.address)
+            out, err = io.StringIO(), io.StringIO()
+            rc = cli.launch(2, [prog], timeout=60.0, stdout=out,
+                            stderr=err)
+            assert rc == 0, (out.getvalue(), err.getvalue())
+            text = out.getvalue()
+            for r in (0, 1):
+                assert f"LAST-LINE rank={r}" in text, (
+                    f"rank {r}'s final line raced the exit frame: "
+                    f"{text!r}")
+            cli.close()
+        finally:
+            d.stop()
+        assert dvm_mod.live_dvms() == []
+
     def test_abort_semantics_in_dvm_job(self, tmp_path):
         """A non-ft daemon job keeps the zmpirun MPI_Abort contract:
         one rank exits nonzero, the daemon kills the rest and the job

@@ -302,3 +302,37 @@ def test_numa_ladder_real_procs():
                                real_procs=True, trials=2)
     assert any(r["op"] == "han3_host_allreduce" for r in rows)
     assert any(r["op"] == "han2dom_host_allreduce" for r in rows)
+
+
+def test_device_probe_row_gates():
+    """--plane device probe row (device-plane FT satellite): rounds
+    counted, zero misses, zero classifications on a healthy plane —
+    and the row shape the table/json printers expect."""
+    rows = osu_zmpi.bench_device_probe(rounds=1)
+    assert len(rows) == 1
+    r = rows[0]
+    assert r["op"] == "device_probe"
+    assert r["rounds"] >= 1
+    assert r["misses"] == 0
+    assert r["device_faults"] == 0
+    assert r["probe_latency_ms"] > 0
+
+
+def test_device_probe_gate_trips_on_wedged_plane(monkeypatch):
+    """The gate is real: a wedged plane (injected via the probe-child
+    wedge hook) fails the run loudly instead of shipping a row."""
+    from zhpe_ompi_tpu.coll import tpu as coll_tpu
+
+    monkeypatch.setenv(coll_tpu.WEDGE_ENV, coll_tpu.WEDGE_ALL)
+    from zhpe_ompi_tpu.mca import var as mca_var
+
+    saved = (mca_var.get("device_probe_timeout", 20.0),
+             mca_var.get("device_probe_deadline", 12.0))
+    mca_var.set_var("device_probe_timeout", 20.0)
+    mca_var.set_var("device_probe_deadline", 6.0)
+    try:
+        with pytest.raises(SystemExit):
+            osu_zmpi.bench_device_probe(rounds=1)
+    finally:
+        mca_var.set_var("device_probe_timeout", saved[0])
+        mca_var.set_var("device_probe_deadline", saved[1])

@@ -25,6 +25,56 @@ from . import algorithms as alg
 from .framework import CollComponent, CollModule
 
 
+# -- device liveness probe (the killable-child half) ------------------------
+#
+# The tiny deadline-bounded psum the device-plane fault loop runs
+# (parallel/mesh.py arms it through utils/deadline.run_probe, which
+# prepends the internal-watchdog preamble): a wedged TPU participant
+# surfaces as an indefinite XLA hang, so the probe must live where it
+# can be killed — a subprocess — and die from the inside at its
+# deadline even if the outer kill is delayed.  ``ZMPI_DEVICE_WEDGE=1``
+# is the fault-injection hook (ft/inject.py's wedge_device exports it):
+# the child wedges INSIDE the collective region, exactly where a real
+# wedge holds the thread, so the whole classification ladder is
+# drillable in CI without real hardware loss.
+
+#: structured wedge-injection hook read by the probe child (and by the
+#: armed guard's owning process — ft/inject.py documents the contract).
+#: Value WEDGE_ALL wedges every probe child of the process (the
+#: real-process drill); a rank number wedges only probes launched FOR
+#: that rank (shared-process thread drills: the prober exports
+#: PROBE_RANK_ENV, so a healthy survivor's probe never inherits the
+#: victim's wedge).  The all-sentinel is deliberately NON-NUMERIC — a
+#: rank-number value must never double as the process-wide switch
+#: (wedging rank 1 must not wedge rank 0's probes)
+WEDGE_ENV = "ZMPI_DEVICE_WEDGE"
+WEDGE_ALL = "all"
+PROBE_RANK_ENV = "ZMPI_PROBE_RANK"
+
+PROBE_SRC = (
+    "import json\n"
+    "import jax\n"
+    "import jax.numpy as jnp\n"
+    "p=os.environ.get('JAX_PLATFORMS')\n"
+    "jax.config.update('jax_platforms', p) if p else None\n"
+    "d=jax.devices()\n"
+    f"_w=os.environ.get({WEDGE_ENV!r})\n"
+    f"if _w is not None and _w in ({WEDGE_ALL!r}, os.environ.get("
+    f"{PROBE_RANK_ENV!r}, '')):\n"
+    "    time.sleep(3600)  # the injected wedge: hang mid-collective\n"
+    "x=jnp.arange(float(len(d)))\n"
+    "try:\n"
+    "    s=jax.pmap(lambda v: jax.lax.psum(v,'i'),axis_name='i')(x)\n"
+    "    total=float(jax.device_get(s)[0])\n"
+    "except Exception:\n"
+    "    # single-device/odd topology: a per-device round trip still\n"
+    "    # proves the plane answers (the reduced claim, reported as is)\n"
+    "    total=float(jax.device_get(jax.device_put(x[0],d[-1])))\n"
+    "print(json.dumps({'n':len(d),'platform':d[0].platform,"
+    "'psum':total}))\n"
+)
+
+
 def _groups(comm):
     return comm.index_groups
 

@@ -1,0 +1,31 @@
+"""jax version-compatibility shims.
+
+The tree targets current jax (``jax.shard_map``, the ``check_vma=``
+spelling); the supported floor is the 0.4.x line, where the same
+machine lives at ``jax.experimental.shard_map.shard_map`` with
+``check_rep=``.  Without this shim the ENTIRE device plane — every
+``Communicator.run``, ``make_train_step``, pgas epoch — dies at import
+of the first SPMD program on an older container, which is exactly the
+environment the CPU-loopback test rig runs in.  One shim keeps every
+call site on the new spelling and translates down when needed.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = True,
+              **kwargs):
+    """``jax.shard_map`` where available, else the 0.4.x experimental
+    entry point with ``check_vma`` translated to its old ``check_rep``
+    name (same semantics: replication/varying-manual-axes checking)."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=check_vma,
+                             **kwargs)
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, check_rep=check_vma,
+                      **kwargs)

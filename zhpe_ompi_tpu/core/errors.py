@@ -43,6 +43,10 @@ ERR_PROC_ABORTED = 74
 ERR_PROC_FAILED = 75
 ERR_PROC_FAILED_PENDING = 76
 ERR_REVOKED = 77
+# device-plane fault class (no reference slot: the reference watches
+# processes only — a wedged accelerator participant is this repro's
+# extension, carved from the same MPIX_ERR_* block)
+ERR_DEVICE_FAULT = 78
 
 _ERROR_STRINGS = {
     SUCCESS: "MPI_SUCCESS: no error",
@@ -75,6 +79,9 @@ _ERROR_STRINGS = {
         "MPIX_ERR_PROC_FAILED_PENDING: pending failure blocks a wildcard "
         "receive; acknowledge with failure_ack to continue",
     ERR_REVOKED: "MPIX_ERR_REVOKED: communicator revoked",
+    ERR_DEVICE_FAULT:
+        "ZMPIX_ERR_DEVICE_FAULT: a device-plane participant missed its "
+        "liveness deadline (wedged collective, lost accelerator)",
 }
 
 
@@ -182,6 +189,22 @@ class ProcFailedPending(ProcFailed):
     (the ULFM pending contract)."""
 
     errclass = ERR_PROC_FAILED_PENDING
+
+
+class DeviceFault(ProcFailed):
+    """ZMPIX_ERR_DEVICE_FAULT: a device-plane participant missed its
+    liveness deadline — the device-plane twin of :class:`ProcFailed`
+    (a subclass, so every host-plane recovery path that catches typed
+    process failure recovers device faults too).  Carries the probe's
+    structured outcome (``kind`` in "hung"/"deadline"/"error") so a
+    postmortem can tell an outer kill from an internal watchdog expiry."""
+
+    errclass = ERR_DEVICE_FAULT
+
+    def __init__(self, message: str = "", failed_ranks=(),
+                 kind: str = "deadline"):
+        super().__init__(message, failed_ranks)
+        self.kind = str(kind)
 
 
 class Revoked(MpiError):

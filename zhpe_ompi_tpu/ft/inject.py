@@ -29,7 +29,9 @@ log is exhausted, continue live — see
 
 from __future__ import annotations
 
+import os
 import random
+import threading
 from typing import Any
 
 from ..coll.host import HostCollectives
@@ -40,7 +42,10 @@ from . import ulfm
 
 class FaultPlan:
     """A deterministic kill schedule: which rank dies after how many
-    point-to-point operations (each send/recv/sendrecv counts one).
+    point-to-point operations (each send/recv/sendrecv counts one),
+    and which rank's DEVICE plane wedges after how many train steps
+    (:meth:`wedge_device` — the device-plane twin; both schedules
+    compose in one plan, the mixed host+device fault storm).
 
     ``seed`` drives :meth:`random_kill`'s choices, so randomized stress
     runs replay exactly from the seed alone."""
@@ -49,6 +54,7 @@ class FaultPlan:
         self.seed = seed
         self._rng = random.Random(seed)
         self._kills: dict[int, tuple[int, str]] = {}
+        self._wedges: dict[int, int] = {}
         self._respawns: set[int] = set()
 
     def kill_rank(self, rank: int, after_ops: int,
@@ -94,8 +100,25 @@ class FaultPlan:
         self._respawns.add(int(rank))
         return self
 
+    def wedge_device(self, rank: int, after_steps: int) -> "FaultPlan":
+        """Schedule `rank`'s DEVICE plane to wedge when it begins step
+        ``after_steps + 1`` (it completes exactly `after_steps` steps).
+        Nothing exits and nothing stops heartbeating — the process is
+        healthy, only its device collective hangs (the XLA-wedge
+        failure mode) — so the device liveness probe is the ONLY
+        discovery path, exactly the scenario the probe exists for.
+        Composes with :meth:`kill_rank`/:meth:`kill_ranks` in one plan
+        (mixed host+device fault storms)."""
+        if after_steps < 0:
+            raise errors.ArgError("after_steps must be >= 0")
+        self._wedges[int(rank)] = int(after_steps)
+        return self
+
     def kill_for(self, rank: int) -> tuple[int, str] | None:
         return self._kills.get(rank)
+
+    def wedge_for(self, rank: int) -> int | None:
+        return self._wedges.get(int(rank))
 
     def wants_respawn(self, rank: int) -> bool:
         return int(rank) in self._respawns
@@ -105,12 +128,101 @@ class FaultPlan:
         return frozenset(self._kills)
 
     @property
+    def device_victims(self) -> frozenset:
+        return frozenset(self._wedges)
+
+    @property
     def respawn_victims(self) -> frozenset:
         return frozenset(self._respawns)
 
     def arm(self, ep) -> "InjectedContext":
         """Wrap one rank's endpoint with op counting + the kill trigger."""
         return InjectedContext(ep, self)
+
+    def arm_device(self, rank: int, state=None,
+                   hold: bool = False) -> "WedgedDevice":
+        """Arm one rank's device-plane wedge: the returned
+        :class:`WedgedDevice` is ticked once per guarded train step and
+        fires at the scheduled count (a no-op forever if this rank has
+        no wedge in the plan).  ``hold=True`` makes the fired wedge
+        ignore :meth:`WedgedDevice.release` — the TRUE-wedge drill: the
+        victim process stays parked until the recovery pipeline's
+        respawn SIGKILLs the declared-dead incarnation."""
+        return WedgedDevice(int(rank), self.wedge_for(rank), state,
+                            hold=hold)
+
+
+class WedgedDevice:
+    """One rank's armed device wedge — the injectable stand-in for a
+    TPU participant freezing mid-``psum``.
+
+    ``tick()`` once per guarded device-collective region; at the
+    scheduled step the wedge FIRES: it registers the expected failure
+    (detector-accuracy bookkeeping), exports the probe-child wedge
+    hook (``coll/tpu.WEDGE_ENV`` — the rank's own liveness probes now
+    hang exactly like its collective would), and parks the calling
+    thread.  The park resolves one of two ways:
+
+    - :meth:`release` (the ``DeviceLivenessProbe`` on_fault hook in
+      thread-plane drills): the parked "collective" unwinds by raising
+      typed :class:`~zhpe_ompi_tpu.core.errors.DeviceFault` — CI can
+      drive the whole classify→shrink→remesh ladder in one process;
+    - never (real-process drills): the rank stays wedged — healthy
+      heartbeats, hung device — until the recovery pipeline's respawn
+      SIGKILLs the declared-dead incarnation (the PRRTE contract).
+    """
+
+    def __init__(self, rank: int, after_steps: int | None, state=None,
+                 hold: bool = False):
+        self.rank = int(rank)
+        self._at = after_steps
+        self._state = state
+        self.hold = bool(hold)
+        self.steps = 0
+        self.fired = False
+        self._release = threading.Event()
+        self._fault: errors.DeviceFault | None = None
+
+    def tick(self) -> None:
+        """One guarded step begins.  Fires the wedge at its count."""
+        self.steps += 1
+        if self._at is not None and self.steps > self._at \
+                and not self.fired:
+            self.fire()
+
+    def fire(self) -> None:
+        """The wedge: park this thread as the hung collective would.
+        The probe-child hook is scoped to THIS rank's probes (a healthy
+        survivor sharing the process must not inherit the wedge — its
+        own probe answering ok is exactly what keeps it from
+        self-classifying); a real-process drill's probes all carry this
+        rank's number anyway."""
+        self.fired = True
+        if self._state is not None:
+            ulfm.expect_failure(self._state, self.rank)
+        from ..coll import tpu as coll_tpu
+
+        os.environ[coll_tpu.WEDGE_ENV] = str(self.rank)
+        self._release.wait()
+        raise self._fault or errors.DeviceFault(
+            f"rank {self.rank}: wedged device collective classified",
+            failed_ranks=[self.rank],
+        )
+
+    def release(self, fault: errors.DeviceFault | None = None) -> None:
+        """Unwind the parked wedge (classification happened): the
+        ``DeviceLivenessProbe`` on_fault hook for in-process drills.
+        Also clears the probe-child wedge hook so post-recovery probes
+        in this process answer again.  A ``hold=True`` wedge ignores
+        this — a real wedge has no unwind; only the respawn's SIGKILL
+        ends it."""
+        if self.hold:
+            return
+        from ..coll import tpu as coll_tpu
+
+        self._fault = fault
+        os.environ.pop(coll_tpu.WEDGE_ENV, None)
+        self._release.set()
 
 
 def _state_of(ep) -> "ulfm.FailureState | None":

@@ -277,6 +277,25 @@ class FailureState:
         for r in ranks:
             self.mark_failed(int(r), cause=cause)
 
+    # causes that are SYMPTOMS (what a peer observed), not root cause:
+    # a typed classification arriving later may refine them
+    CIRCUMSTANTIAL_CAUSES = frozenset({"transport", "notice",
+                                       "detector"})
+
+    def refine_cause(self, rank: int, cause: str) -> bool:
+        """Adopt a ROOT-CAUSE classification for an already-known
+        failure: typed evidence (a device fault's own probe, a daemon's
+        waitpid truth) outranks the circumstantial cause a downstream
+        symptom produced first — a wedged device's host transport dies
+        as a side effect, and whichever evidence wins the race must not
+        hide what actually happened.  Returns True when refined."""
+        with self._cv:
+            if rank in self._failed and \
+                    self._cause.get(rank) in self.CIRCUMSTANTIAL_CAUSES:
+                self._cause[rank] = str(cause)
+                return True
+        return False
+
     def is_failed(self, rank: int) -> bool:
         return rank in self._failed
 
@@ -868,11 +887,20 @@ def agree(ep, flag: bool = True, timeout: float | None = None) -> bool:
 
 def _combine_failed_sets(a: Any, b: Any) -> list:
     """Union of two [pairs, epoch] failed-set contributions: merge the
-    (rank, cause) pairs (first cause seen wins — causes only disagree on
-    which transport noticed first) and take the max crash epoch."""
+    (rank, cause) pairs and take the max crash epoch.  A ROOT cause
+    (device, daemon) outranks the circumstantial ones (transport
+    reset, second-hand notice, detector suspicion) — survivors holding
+    only the symptom must converge on what actually happened; beyond
+    that, first cause seen wins (causes then only disagree on which
+    transport noticed first)."""
     merged = {int(r): str(c) for r, c in a[0]}
     for r, c in b[0]:
-        merged.setdefault(int(r), str(c))
+        r, c = int(r), str(c)
+        have = merged.get(r)
+        if have is None or (
+                have in FailureState.CIRCUMSTANTIAL_CAUSES
+                and c not in FailureState.CIRCUMSTANTIAL_CAUSES):
+            merged[r] = c
     return [sorted([r, c] for r, c in merged.items()),
             max(int(a[1]), int(b[1]))]
 
@@ -1004,6 +1032,14 @@ class ShrunkEndpoint(HostCollectives):
             self.recv(source=(self.rank - k) % n, tag=0x7FFE, cid=0x7FFE)
             k <<= 1
 
+    def revoke(self, cid: int) -> None:
+        """MPIX_Comm_revoke on THIS window: the cid translates into the
+        generation-isolated space before delegating to the parent
+        endpoint's revoke (which floods on wire transports) — a
+        survivor unblocking peers parked in this window's collectives
+        mid-recovery, without poisoning the parent's own channels."""
+        self._ep.revoke(_shrink_cid(self._gen, cid))
+
     def __repr__(self):  # pragma: no cover
         return (f"ShrunkEndpoint(rank={self.rank}/{self.size}, "
                 f"parents={self._map}, gen={self._gen})")
@@ -1068,9 +1104,12 @@ class UlfmEndpointAPI:
             if cause == "goodbye":
                 state.mark_departed(r)
             else:
-                state.mark_failed(
-                    r, cause="notice" if cause == "detector" else cause
-                )
+                cause = "notice" if cause == "detector" else cause
+                if not state.mark_failed(r, cause=cause) and \
+                        cause not in state.CIRCUMSTANTIAL_CAUSES:
+                    # the agreed set carries a ROOT cause a local
+                    # symptom beat to the punch: adopt it
+                    state.refine_cause(r, cause)
         state.raise_epoch(generation)
         survivors = [r for r in range(self.size) if r not in failed]
         shrunk = ShrunkEndpoint(self, survivors, generation=generation)

@@ -11,13 +11,19 @@ btl/ofi endpoint set; host-loopback CPU devices are the btl/self+sm analog
 
 from __future__ import annotations
 
+import contextlib
+import os
+
 import numpy as np
 
 import jax
 from jax.sharding import Mesh
 
+from ..core import errors
 from ..mca import output as mca_output
 from ..mca import var as mca_var
+from ..runtime import flightrec, spc, ztrace
+from ..utils import deadline as deadline_mod
 
 _stream = mca_output.open_stream("rte")
 
@@ -27,6 +33,38 @@ mca_var.register(
     "Call jax.distributed.initialize() at init (multi-host/multi-process "
     "deployments; the PMIx-client analog)",
     type=bool,
+)
+
+# -- device liveness probe (opt-in device_probe_* family) -------------------
+
+mca_var.register(
+    "device_probe_enable", False,
+    "Arm the device liveness probe around guarded device collectives: "
+    "a region that outlives device_probe_deadline triggers a killable-"
+    "child probe (tiny psum over the mesh, coll/tpu.PROBE_SRC); a "
+    "missed probe classifies a typed cause=\"device\" fault into the "
+    "job's FailureState.  Off by default — probes cost a subprocess",
+    type=bool,
+)
+mca_var.register(
+    "device_probe_timeout", 20.0,
+    "Outer kill (seconds) of one device liveness probe child — the "
+    "backstop around its internal watchdog deadline",
+    type=float,
+)
+mca_var.register(
+    "device_probe_deadline", 12.0,
+    "Internal watchdog deadline (seconds) of the probe child (it "
+    "os._exits from the inside at expiry — the structured \"deadline\" "
+    "outcome), AND the guarded-region deadline that triggers a probe",
+    type=float,
+)
+mca_var.register(
+    "device_probe_grace", 2,
+    "Probe rounds that may come back \"ok\" while the guarded region "
+    "still blocks before the guard stops re-probing (a slow-but-alive "
+    "local plane is a peer's fault to classify, never this rank's own)",
+    type=int,
 )
 
 
@@ -56,6 +94,32 @@ def world_mesh(axis_name: str = "world", devices=None) -> Mesh:
     return Mesh(devs, axis_names=(axis_name,))
 
 
+def survivor_mesh(mesh: Mesh, failed, axis: str | None = None) -> Mesh:
+    """The remesh step of the device-plane recovery pipeline: the same
+    mesh minus the failed indices along ``axis`` (default: the first
+    axis — the data-parallel outer loop).  The survivor mesh is what
+    ``zero``/``grad``/``hybrid`` re-shard onto between shrink and
+    respawn; a respawned job calls :func:`world_mesh`/:func:`make_mesh`
+    again for the full-size resume."""
+    axis = axis or mesh.axis_names[0]
+    if axis not in mesh.axis_names:
+        raise errors.ArgError(
+            f"survivor_mesh: axis {axis!r} not in {mesh.axis_names}")
+    k = mesh.axis_names.index(axis)
+    drop = {int(r) for r in failed}
+    arr = np.moveaxis(np.asarray(mesh.devices), k, 0)
+    keep = [i for i in range(arr.shape[0]) if i not in drop]
+    if not keep:
+        raise errors.ArgError(
+            f"survivor_mesh: every index of axis {axis!r} failed")
+    sp = ztrace.begin(ztrace.REMESH, -1, axis=axis,
+                      dropped=sorted(drop)) if ztrace.active else None
+    out = Mesh(np.moveaxis(arr[keep], 0, k), axis_names=mesh.axis_names)
+    if sp is not None:
+        sp.end(survivors=len(keep))
+    return out
+
+
 def make_mesh(axis_sizes: dict[str, int], devices=None) -> Mesh:
     """N-D mesh, e.g. {'dp': 2, 'tp': 4}: the topo-framework analog
     (cartesian topologies, ``ompi/mca/topo``) expressed the TPU way.
@@ -72,3 +136,172 @@ def make_mesh(axis_sizes: dict[str, int], devices=None) -> Mesh:
             devices = world_devices()
     arr = np.asarray(devices).reshape(shape)
     return Mesh(arr, axis_names=names)
+
+
+# -- device liveness probe (the fault loop's device half) -------------------
+
+
+def probe_device_plane(timeout: float | None = None,
+                       deadline: float | None = None,
+                       env: dict | None = None,
+                       rank: int | None = None) -> tuple[str, str]:
+    """One killable-child device liveness probe: the tiny deadline-
+    bounded psum (``coll/tpu.PROBE_SRC``) through the shared
+    ``utils/deadline`` idiom — exactly the machinery ``bench.py`` uses
+    for its backend probe, so a wedged ``jax.devices()`` OR a wedged
+    collective dies from the inside at the child's internal watchdog.
+
+    Returns the structured ``(kind, detail)``: "ok" (detail = device
+    JSON), "hung", "deadline", "error".  Counts ``device_probe_rounds``
+    (and ``device_probe_misses`` on hung/deadline) and records the
+    DEVICE_PROBE ztrace span, so an OSU ``--plane device`` row and a
+    postmortem timeline both see every round."""
+    from ..coll import tpu as coll_tpu
+
+    timeout = float(mca_var.get("device_probe_timeout", 20.0)) \
+        if timeout is None else float(timeout)
+    deadline = float(mca_var.get("device_probe_deadline", 12.0)) \
+        if deadline is None else float(deadline)
+    if rank is not None:
+        # scope the wedge-injection hook: the child wedges only when
+        # the hook names THIS rank (or "1" = the whole process) — a
+        # healthy rank sharing the process must get a healthy answer
+        env = dict(os.environ if env is None else env)
+        env[coll_tpu.PROBE_RANK_ENV] = str(int(rank))
+    spc.record("device_probe_rounds")
+    sp = ztrace.begin(ztrace.DEVICE_PROBE, -1) if ztrace.active else None
+    kind, detail = deadline_mod.run_probe(
+        coll_tpu.PROBE_SRC, timeout, deadline, env=env)
+    if kind in ("hung", "deadline"):
+        spc.record("device_probe_misses")
+    if sp is not None:
+        sp.end(kind=kind)
+    return kind, detail
+
+
+class DeviceLivenessProbe:
+    """The armed guard: a deadline around a device-collective region,
+    feeding missed probes into the SAME :class:`~zhpe_ompi_tpu.ft.ulfm.
+    FailureState` the host-plane detectors feed — the device half of
+    the fault loop.
+
+    Usage (the models/ftloop shape)::
+
+        probe = DeviceLivenessProbe(state=proc.ft_state, rank=proc.rank,
+                                    on_fault=proc.flood_device_fault)
+        ...
+        with probe.guard():
+            loss = step(params, batch)   # may wedge mid-psum
+
+    A region that outlives ``device_probe_deadline`` triggers one
+    killable-child probe from the watchdog thread (the region itself
+    cannot be killed — the XLA dispatch holds the caller's thread):
+
+    - probe MISSED ("hung"/"deadline"): the local device plane is
+      wedged — classify a typed ``cause="device"`` fault for THIS rank
+      into the FailureState (flooding notices exactly like transport
+      deaths do, via ``on_fault``), count ``device_faults``, record the
+      DEVICE_FAULT flightrec event.
+    - probe OK: the local plane answers — the region is slow, or a
+      REMOTE participant wedged (that rank's own guard classifies it;
+      its notice unwinds us).  Re-arm, up to ``device_probe_grace``
+      ok-rounds, then stop probing and leave the wait to the host
+      plane.
+
+    ``probe_fn`` is injectable (tests drill the ladder without paying
+    a subprocess per case); the default is :func:`probe_device_plane`.
+    ``guard()`` is a no-op unless ``device_probe_enable`` is on or the
+    probe was constructed with ``enable=True`` — opt-in, per contract.
+    """
+
+    def __init__(self, state=None, rank: int = -1, on_fault=None,
+                 probe_fn=None, enable: bool | None = None,
+                 timeout: float | None = None,
+                 deadline: float | None = None,
+                 grace: int | None = None):
+        self.state = state
+        self.rank = int(rank)
+        self.on_fault = on_fault
+        self.probe_fn = probe_fn  # None = probe_device_plane, rank-scoped
+        self.enabled = bool(mca_var.get("device_probe_enable", False)) \
+            if enable is None else bool(enable)
+        self.timeout = timeout
+        self.deadline = float(mca_var.get("device_probe_deadline", 12.0)) \
+            if deadline is None else float(deadline)
+        self.grace = int(mca_var.get("device_probe_grace", 2)) \
+            if grace is None else int(grace)
+        self.fault: errors.DeviceFault | None = None
+
+    # -- classification ----------------------------------------------------
+
+    def classify(self, kind: str, detail: str) -> errors.DeviceFault:
+        """A missed probe becomes a typed device fault: counted,
+        flight-recorded, marked into the FailureState (cause="device" —
+        never a detector suspicion, so the zero-false-positive gate
+        keeps its meaning), and handed to ``on_fault`` (the wire
+        plane's notice flood / the test's wedge release)."""
+        fault = errors.DeviceFault(
+            f"device plane missed its liveness deadline ({kind}: "
+            f"{detail})",
+            failed_ranks=[self.rank] if self.rank >= 0 else (),
+            kind=kind,
+        )
+        spc.record("device_faults")
+        flightrec.record(flightrec.DEVICE_FAULT, rank=self.rank,
+                         kind=kind)
+        if ztrace.active:
+            ztrace.instant(ztrace.FT_CLASS, self.rank,
+                           failed=self.rank, cause="device")
+        if self.state is not None and self.rank >= 0:
+            self.state.mark_failed(self.rank, cause="device")
+        self.fault = fault
+        if self.on_fault is not None:
+            self.on_fault(fault)
+        return fault
+
+    def probe_once(self) -> tuple[str, str]:
+        if self.probe_fn is not None:
+            return self.probe_fn(timeout=self.timeout,
+                                 deadline=self.deadline)
+        return probe_device_plane(
+            timeout=self.timeout, deadline=self.deadline,
+            rank=self.rank if self.rank >= 0 else None)
+
+    # -- the armed guard ---------------------------------------------------
+
+    def _expired(self, watchdog) -> None:
+        """Watchdog-thread body: the guarded region outlived its
+        deadline.  Probe; classify a miss; tolerate up to ``grace``
+        ok-rounds before going quiet (re-arming forever would turn a
+        long legitimate region into a polling loop)."""
+        for _ in range(max(1, self.grace)):
+            kind, detail = self.probe_once()
+            if watchdog._disarmed.is_set():
+                return  # the region finished while we probed: no fault
+            if kind in ("hung", "deadline"):
+                self.classify(kind, detail)
+                return
+            # ok/error: the plane answered (an error is a health
+            # problem, not a wedge — loud in the probe counters, not a
+            # classification); wait out one more deadline
+            if watchdog._disarmed.wait(self.deadline):
+                return
+        mca_output.verbose(
+            1, _stream,
+            "device probe guard: region still blocked after %d ok "
+            "rounds; leaving the wait to the host plane", self.grace,
+        )
+
+    def guard(self, deadline: float | None = None):
+        """Context manager arming the deadline around one device-
+        collective region (one train step).  No-op when disabled."""
+        if not self.enabled:
+            return contextlib.nullcontext()
+        wd_box: list = []
+        wd = deadline_mod.Watchdog(
+            float(deadline if deadline is not None else self.deadline),
+            on_expire=lambda: self._expired(wd_box[0]),
+            name=f"device-probe-guard-{self.rank}",
+        )
+        wd_box.append(wd)
+        return wd

@@ -33,6 +33,7 @@ import numpy as np
 
 from .. import ops as zops
 from ..core import errors
+from ..runtime import ztrace
 from .hybrid import pack_tree, unpack_tree
 
 
@@ -81,6 +82,69 @@ class ZeroOptimizer:
         padded = np.zeros(chunk * n, np.float32)
         padded[: flat.size] = flat
         return [padded[r * chunk: (r + 1) * chunk] for r in range(n)]
+
+    # -- re-sharding (the recovery pipeline's remesh step) ---------------
+
+    def _bucket_of(self, path) -> str | None:
+        """The flat-bucket key a state leaf belongs to, read off its
+        tree path (optax preserves the ``{key: chunk}`` dict structure
+        it was initialized with) — None for non-bucket leaves (step
+        counts and other replicated scalars)."""
+        for entry in reversed(path):
+            key = getattr(entry, "key", None)
+            if key in self._sizes:
+                return key
+        return None
+
+    def full_state(self) -> Any:
+        """The partitioned optimizer state gathered to FULL (unpadded
+        flat f32 buckets) on every rank — the checkpointable form: a
+        shrink-triggered rollback restores THIS, and :meth:`reshard`
+        re-partitions it onto whatever endpoint survives.  Collective
+        over the proc's whole group (one allgather per state leaf)."""
+        import jax
+
+        n = self.proc.size
+
+        def gather(path, leaf):
+            k = self._bucket_of(path)
+            if k is None:
+                return np.asarray(leaf)
+            if n == 1:
+                return np.asarray(leaf, np.float32)[: self._sizes[k]]
+            parts = self.proc.allgather(np.asarray(leaf, np.float32))
+            return np.concatenate(parts)[: self._sizes[k]]
+
+        return jax.tree_util.tree_map_with_path(gather, self._opt_state)
+
+    def reshard(self, proc, full_state: Any) -> None:
+        """Re-partition onto a NEW endpoint — the survivor communicator
+        of a shrink, or the full-size endpoint after respawn: adopt
+        ``proc``'s size/rank as this optimizer's partition geometry and
+        take this rank's chunk of every bucket leaf of ``full_state``
+        (from :meth:`full_state` before the failure, or a checkpoint
+        restore).  The padded-equal-chunk geometry is recomputed for
+        the new size, so the SAME full state re-shards onto 3 survivors
+        mid-recovery and back onto 4 ranks after the respawn."""
+        import jax
+
+        sp = ztrace.begin(ztrace.REMESH, getattr(proc, "rank", -1),
+                          what="zero-opt") if ztrace.active else None
+        self.proc = proc
+
+        def scatter(path, leaf):
+            k = self._bucket_of(path)
+            if k is None:
+                return np.asarray(leaf)
+            full = np.zeros(self._sizes[k], np.float32)
+            flat = np.asarray(leaf, np.float32).reshape(-1)
+            full[: min(flat.size, full.size)] = flat[: full.size]
+            return self._chunks_of(full, k)[proc.rank].copy()
+
+        self._opt_state = jax.tree_util.tree_map_with_path(
+            scatter, full_state)
+        if sp is not None:
+            sp.end(size=proc.size)
 
     def step(self, params: Any, grads: Any) -> Any:
         """One ZeRO-1 step: reduce-scatter grads, update the owned

@@ -1125,9 +1125,42 @@ class TcpProc(errh.HasErrhandler, ulfm.UlfmEndpointAPI, HostCollectives,
                 pass
 
     def _ft_flood(self, failed: frozenset) -> None:
-        """Propagate suspicion: failure notices to every live rank."""
-        self._flood(ulfm.FT_NOTICE_CID, sorted(int(r) for r in failed),
-                    "hb-flood")
+        """Propagate suspicion: failure notices to every live rank.
+        Entries are ``[rank, cause]`` pairs so a typed classification
+        (a device fault) survives the wire; causes that are only LOCAL
+        evidence (a detector suspicion, a transport reset) travel as
+        second-hand "notice" — the receiver did not observe them, and
+        the zero-false-positive gate must keep its meaning.  Receivers
+        also accept bare ranks (the pre-pair wire shape)."""
+        causes = dict(self.ft_state.failed_with_causes())
+        pairs = []
+        for r in sorted(int(r) for r in failed):
+            cause = causes.get(r, "notice")
+            if cause not in ("device", "goodbye"):
+                cause = "notice"
+            pairs.append([r, cause])
+        self._flood(ulfm.FT_NOTICE_CID, pairs, "hb-flood")
+
+    def flood_device_fault(self, fault=None) -> None:
+        """Device-plane classification → the same notice flood a
+        transport death rides (the ``DeviceLivenessProbe`` on_fault
+        hook).  The fault's own ranks are flooded as explicit
+        ``device`` pairs — the flood must carry the root cause even if
+        a concurrent symptom (this rank's own sm teardown classifying
+        as transport death on a peer) wins the mark_failed race
+        somewhere (receivers refine circumstantial causes)."""
+        if self._ft_dead or self._closed.is_set():
+            return
+        causes = dict(self.ft_state.failed_with_causes())
+        for r in getattr(fault, "failed_ranks", None) or ():
+            causes[int(r)] = "device"
+        pairs = []
+        for r in sorted(causes):
+            cause = causes[r]
+            if cause not in ("device", "goodbye"):
+                cause = "notice"
+            pairs.append([int(r), cause])
+        self._flood(ulfm.FT_NOTICE_CID, pairs, "device-fault")
 
     def _mark_transport_death(self, dest: int) -> None:
         """Classify a transport-evidenced death (connection reset /
@@ -1161,7 +1194,24 @@ class TcpProc(errh.HasErrhandler, ulfm.UlfmEndpointAPI, HostCollectives,
             if self._detector is not None:
                 self._detector.transport.on_beat(src)
         elif cid == ulfm.FT_NOTICE_CID:
-            self.ft_state.merge_failed(payload)
+            # entries are [rank, cause] pairs (typed causes — "device"
+            # — survive the wire; see _ft_flood) or bare ranks (the
+            # pre-pair shape: second-hand "notice")
+            for entry in payload:
+                if isinstance(entry, (list, tuple)):
+                    r, cause = int(entry[0]), str(entry[1])
+                    if cause == "goodbye":
+                        self.ft_state.mark_departed(r)
+                    elif not self.ft_state.mark_failed(r, cause=cause) \
+                            and cause == "device":
+                        # the typed classification lost the race to a
+                        # downstream symptom (the wedged rank's sm
+                        # teardown classifies as transport death on
+                        # peers mid-send): adopt the root cause
+                        self.ft_state.refine_cause(r, cause)
+                else:
+                    self.ft_state.mark_failed(int(entry),
+                                              cause="notice")
         elif cid == ulfm.FT_REVOKE_CID:
             self.ft_state.revoke(int(payload))
         elif cid == ulfm.FT_AGREE_PUB_CID:

@@ -263,8 +263,14 @@ class Checkpointer:
                 import pickle
 
                 treedef = pickle.load(f)
+            # None is a valid per-leaf sharding ("load to host") and
+            # must keep its slot: the default flatten DROPS None
+            # leaves, which would pair the remaining shardings with
+            # the wrong arrays (found by the survivor-mesh restore
+            # tests: a {"w": sharding, "step_count": None} tree)
             shard_leaves = (
-                jax.tree_util.tree_flatten(shardings)[0]
+                jax.tree_util.tree_flatten(
+                    shardings, is_leaf=lambda x: x is None)[0]
                 if shardings is not None else [None] * meta["n_leaves"]
             )
             leaves = [

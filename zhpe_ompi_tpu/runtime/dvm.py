@@ -99,6 +99,17 @@ mca_var.register(
 
 _TERM_GRACE = 2.0  # seconds between SIGTERM and SIGKILL on teardown
 
+# IOF-drain deadline at job exit: once every child is dead its pipes
+# are at EOF, so a drain finishes after finitely many reads — but a
+# drain thread STARVED by scheduler load past a short per-thread join
+# loses the rank's final lines to a client that stopped reading at the
+# exit frame (the TestDvmMultiVictimRecovery finalize-skew flake: the
+# last SURVIVOR-OK line raced the exit frame under full-suite load).
+# One generous SHARED deadline covers starvation; only a leaked
+# grandchild holding a dead child's pipe open can exhaust it, and that
+# pathology is reported loudly instead of surfacing as truncation.
+_IOF_DRAIN_GRACE = 30.0
+
 _live_dvms: weakref.WeakSet = weakref.WeakSet()
 
 
@@ -1332,9 +1343,25 @@ class Dvm(pmix_mod.FramedRpcServer):
                                f"{timeout}s; killing it\n"])
             self._teardown_job(job, rc=124)
         # IOF flushes before the exit frame: each drain exits at its
-        # stream's EOF, which the children's deaths guarantee
+        # stream's EOF, which the children's deaths guarantee.  The
+        # joins share ONE generous deadline (_IOF_DRAIN_GRACE) instead
+        # of a short per-thread bound: a drain starved by scheduler
+        # load must not lose a rank's final lines to a client that
+        # stops reading at the exit frame (the finalize-skew flake);
+        # a drain STILL live past the deadline means a leaked
+        # grandchild holds a dead child's pipe — reported loudly,
+        # never as silent truncation.
+        drain_deadline = time.monotonic() + _IOF_DRAIN_GRACE
         for t in list(job.drains):
-            t.join(timeout=2.0)
+            t.join(timeout=max(0.0, drain_deadline - time.monotonic()))
+        straggler = [t.name for t in job.drains if t.is_alive()]
+        if straggler:
+            self._stream(job, [
+                "note",
+                f"zprted: IOF drain(s) {straggler} still live "
+                f"{_IOF_DRAIN_GRACE:.0f}s after job {job.id} ended "
+                "(a child's pipe is held open — leaked grandchild?); "
+                "trailing output may be truncated\n"])
         with job.lock:
             if job.stopping:
                 # abort/timeout teardown: the first failure (or 124) is
@@ -1387,11 +1414,14 @@ class Dvm(pmix_mod.FramedRpcServer):
                 # the exit: the tree link is FIFO, so once the tails
                 # are on the wire the root streams them before it can
                 # account the death and emit the job's exit frame (a
-                # dead child's pipes are at EOF — the join is bounded
-                # hygiene, not a wait on a live stream)
+                # dead child's pipes are at EOF — the join waits out
+                # scheduler starvation under the same shared grace as
+                # the root's exit-frame joins, never a live stream)
+                drain_deadline = time.monotonic() + _IOF_DRAIN_GRACE
                 for t in list(job.drains):
                     if getattr(t, "_dvm_proc", None) is p:
-                        t.join(timeout=2.0)
+                        t.join(timeout=max(
+                            0.0, drain_deadline - time.monotonic()))
                 try:
                     self._parent_link.send_up(
                         "exited", [job.id, rank, int(rc)])

@@ -56,9 +56,12 @@ RESIZE = "resize"          # runtime/dvm.py resize RPC + elastic-session
                            # membership changes (ft/recovery.py)
 DAEMON_FAULT = "daemon_fault"  # runtime/dvm.py fault routing (a rank's
                            # waitpid death or a lost daemon subtree)
+DEVICE_FAULT = "device_fault"  # parallel/mesh.py device liveness probe:
+                           # a missed deadline classified cause="device"
+                           # (probe kind + victim rank ride the event)
 
 ALL_EVENTS = (SEND, RECV, MATCH, COLL_ENTER, COLL_EXIT, FT_CLASS,
-              REVOKE, RESPAWN, RESIZE, DAEMON_FAULT)
+              REVOKE, RESPAWN, RESIZE, DAEMON_FAULT, DEVICE_FAULT)
 
 #: hot-path gate (the peruse cost discipline): seams check this bare
 #: module attribute before paying the record() call.  False until a

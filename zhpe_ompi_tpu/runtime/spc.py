@@ -194,6 +194,22 @@ sites in lockstep):
 - ``io_nonblocking_ops`` — nonblocking file operations submitted to
   the fbtl async pool.
 
+Device-plane liveness counters (the device half of the fault loop —
+``parallel/mesh.py`` records them; armed only by the opt-in
+``device_probe_*`` MCA family):
+
+- ``device_probe_rounds`` — killable-child liveness probes launched
+  (each a tiny deadline-bounded psum over the mesh, the
+  utils/deadline idiom).  The OSU ``--plane device`` probe row gates
+  on this rising while classifications stay zero.
+- ``device_probe_misses`` — probes that came back "hung"/"deadline"
+  (the device plane did not answer inside its window; one more miss
+  than ``device_probe_grace`` tolerates classifies).
+- ``device_faults`` — typed ``cause="device"`` classifications fed
+  into the FailureState (the DEVICE_FAULT flightrec event lands with
+  each; must stay zero across any run with no injected wedge — the
+  device plane's zero-false-positive gate).
+
 Observability-plane counters (the fleet-visible metrics plane —
 recorded by this module's :class:`MetricsPublisher` and by
 ``runtime/flightrec.py``):

@@ -82,9 +82,16 @@ RESPAWN = "respawn"    # ft/recovery.py respawn legs
 RESIZE = "resize"      # elastic-resize legs: the daemon's RPC span
                        # (generation + delta) and each rank's
                        # membership-rebuild span (ft/recovery.py)
+DEVICE_PROBE = "device_probe"  # device liveness probe round
+                       # (parallel/mesh.py): begin at spawn, end with
+                       # the structured kind; a "hung"/"deadline" end
+                       # is the recovery timeline's device-fault root
+REMESH = "remesh"      # survivor-mesh rebuild + re-shard legs
+                       # (parallel/mesh.py survivor_mesh, zero.reshard)
 
 ALL_KINDS = (SEND, RECV, DELIVER, MATCH, RTS, CTS, PUSH, PHASE, COLL,
-             FT_CLASS, AGREE, SHRINK, RESPAWN, RESIZE)
+             FT_CLASS, AGREE, SHRINK, RESPAWN, RESIZE, DEVICE_PROBE,
+             REMESH)
 
 #: hot-path gate (the peruse discipline): seams check this bare module
 #: attribute before paying anything — False means no span dicts, no
