@@ -717,6 +717,192 @@ def bench_sm(max_size: int = 4 << 20, iters: int = 50, bw: bool = False,
     return _pt2pt_ladder(max_size, iters, bw, window, sm=True)
 
 
+# -------------------------------------------- one-sided (osc) plane
+
+# counters every --plane osc rank reports (per-rung deltas); the gates
+# read them: direct bytes strictly rising, AM applies and wire bytes
+# FLAT on the same-host rungs, zero silent fallbacks
+_OSC_COUNTERS = (
+    "osc_direct_bytes", "osc_direct_puts", "osc_direct_gets",
+    "osc_direct_atomics", "osc_am_fallbacks", "osc_am_applied",
+    "tcp_bytes_sent",
+)
+
+
+def _osc_worker_body(proc, spec: dict):
+    """--plane osc rank body (thread-mode AND --real-procs): put/get
+    ladder plus a fetch-atomic row on an ALLOCATED window (the
+    region-backed path).  Every rung records counter deltas and a
+    result checksum — the forced-AM reference run (osc_direct=0) must
+    produce byte-identical checksums, which is the correctness gate
+    that makes the latency rows honest.  Returns (rows [rank 0 only],
+    per-rung deltas, checksums)."""
+    from zhpe_ompi_tpu.mca import var as mca_var
+    from zhpe_ompi_tpu.osc.direct import allocate_window
+    from zhpe_ompi_tpu.runtime import spc
+
+    mca_var.set_var("osc_direct", 1 if spec.get("direct", True) else 0)
+    label = "direct" if spec.get("direct", True) else "am"
+    n, rank = proc.size, proc.rank
+    iters = int(spec["iters"])
+    max_size = int(spec["max_size"])
+    target = (rank + 1) % n
+    source = (rank - 1) % n
+    win = allocate_window(proc, max_size, np.float64)
+    win.fence()
+    rows: list[dict] = []
+    deltas: list[dict] = []
+    sums: list = []
+    for nbytes in _sizes(max_size, 64):
+        count = nbytes // 8
+        data = (np.arange(count, dtype=np.float64) + rank) * 0.5
+        base = {c: spc.read(c) for c in _OSC_COUNTERS}
+        win.put(data, target, 0)  # warmup
+        win.fence()
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            win.put(data, target, 0)
+        put_sec = (time.perf_counter() - t0) / iters
+        win.fence()
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            got = win.get(target, 0, count)
+        get_sec = (time.perf_counter() - t0) / iters
+        win.fence()
+        # my window holds `source`'s last put; `got` is `target`'s
+        csum = (float(np.asarray(win.base[:count]).sum()),
+                float(got.sum()))
+        deltas.append({c: spc.read(c) - base[c] for c in _OSC_COUNTERS})
+        sums.append(csum)
+        if rank == 0:
+            for op, sec in ((f"osc_{label}_put", put_sec),
+                            (f"osc_{label}_get", get_sec)):
+                rows.append({
+                    "op": op, "bytes": nbytes,
+                    "latency_us": sec * 1e6,
+                    "bandwidth_MBps": (nbytes / sec) / 1e6,
+                })
+        proc.barrier()
+    # fetch-atomic row: 8-byte fetch-and-op rate through the lock word
+    awin = allocate_window(proc, 16, np.int64)
+    awin.fence()
+    base = {c: spc.read(c) for c in _OSC_COUNTERS}
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        awin.fetch_and_op(1, target=target, offset=0)
+    amo_sec = (time.perf_counter() - t0) / iters
+    awin.fence()
+    mine = int(awin.base[0])
+    if mine != iters:  # exactly one origin per target
+        raise RuntimeError(
+            f"osc {label} ladder: fetch-atomic count {mine} != {iters}"
+        )
+    deltas.append({c: spc.read(c) - base[c] for c in _OSC_COUNTERS})
+    sums.append((float(mine), 0.0))
+    if rank == 0:
+        rows.append({
+            "op": f"osc_{label}_fetch_op", "bytes": 8,
+            "latency_us": amo_sec * 1e6,
+            "bandwidth_MBps": (8 / amo_sec) / 1e6,
+        })
+    proc.barrier()
+    awin.free()
+    win.free()
+    return rows, deltas, sums
+
+
+def _gate_osc_run(label: str, all_deltas: list[list[dict]],
+                  exact: bool) -> None:
+    """Deterministic gates over every rank's per-rung counter deltas.
+    ``exact`` = per-process counter tables (--real-procs); thread-mode
+    ranks share one table, so the flat gates stay exact but the rising
+    gate is qualitative."""
+    for rank, deltas in enumerate(all_deltas):
+        prev = -1
+        for i, d in enumerate(deltas):
+            where = f"rank {rank} rung {i}"
+            if label == "direct":
+                if d["osc_am_fallbacks"]:
+                    raise RuntimeError(
+                        f"osc ladder {where}: {d['osc_am_fallbacks']} "
+                        "ops silently fell back to the AM path"
+                    )
+                if d["osc_am_applied"]:
+                    raise RuntimeError(
+                        f"osc ladder {where}: osc_am_applied moved "
+                        f"({d['osc_am_applied']}) on a same-host rung"
+                    )
+                if d["tcp_bytes_sent"]:
+                    raise RuntimeError(
+                        f"osc ladder {where}: {d['tcp_bytes_sent']} "
+                        "wire bytes moved (one-sided ops must not "
+                        "touch the wire between same-host ranks)"
+                    )
+                if d["osc_direct_bytes"] <= 0:
+                    raise RuntimeError(
+                        f"osc ladder {where}: no direct bytes moved"
+                    )
+                is_amo_row = i == len(deltas) - 1
+                if exact and not is_amo_row \
+                        and d["osc_direct_bytes"] <= prev:
+                    raise RuntimeError(
+                        f"osc ladder {where}: direct bytes not "
+                        f"strictly rising ({d['osc_direct_bytes']} "
+                        f"after {prev})"
+                    )
+                if not is_amo_row:
+                    prev = d["osc_direct_bytes"]
+            else:  # forced-AM reference: the direct path must be OFF
+                if d["osc_direct_bytes"]:
+                    raise RuntimeError(
+                        f"osc ladder (forced-AM) {where}: direct "
+                        "bytes moved with osc_direct=0"
+                    )
+
+
+def bench_osc(max_size: int = 1 << 20, iters: int = 10,
+              real_procs: bool = False) -> list[dict]:
+    """--plane osc: the direct-map one-sided ladder — put/get latency
+    per size plus a fetch-atomic row, run TWICE (direct, then the
+    forced-AM reference) with byte-identical-result and counter gates;
+    latency is report-only on the 1-CPU container, the counters are
+    the deterministic claim."""
+    from zhpe_ompi_tpu.mca import var as mca_var
+
+    saved_direct = int(mca_var.get("osc_direct", 1))
+    runs: dict[str, tuple] = {}
+    for direct in (True, False):
+        label = "direct" if direct else "am"
+        spec = {"kind": "osc", "max_size": max_size, "iters": iters,
+                "direct": direct}
+        if real_procs:
+            reports = _run_proc_bench(dict(spec), nprocs=2,
+                                      collect_all=True)
+            rows = reports[0]["rows"]
+            all_deltas = [r["deltas"] for r in reports]
+            all_sums = [r["sums"] for r in reports]
+        else:
+            try:
+                res = _run_tcp_ranks(
+                    2, lambda p, s=spec: _osc_worker_body(p, s),
+                    sm=True)
+            finally:
+                mca_var.set_var("osc_direct", saved_direct)
+            rows = res[0][0]
+            all_deltas = [r[1] for r in res]
+            all_sums = [r[2] for r in res]
+        _gate_osc_run(label, all_deltas, exact=real_procs)
+        runs[label] = (rows, all_sums)
+    # byte-identical gate: same checksums per rank per rung both ways
+    if runs["direct"][1] != runs["am"][1]:
+        raise RuntimeError(
+            "osc ladder: forced-AM reference results differ from the "
+            f"direct run (direct {runs['direct'][1]} vs AM "
+            f"{runs['am'][1]})"
+        )
+    return runs["direct"][0] + runs["am"][0]
+
+
 # -------------------------------------------- real-process harness
 
 # counters every --plane han worker reports (deltas over its run); the
@@ -851,6 +1037,15 @@ def _worker_main(spec: dict) -> int:
         print(json.dumps({"rank": rank, "rows": rows,
                           "counters": deltas,
                           "sm_stats": sm_stats}), flush=True)
+        return 0
+    if spec["kind"] == "osc":
+        try:
+            rows, odeltas, sums = _osc_worker_body(proc, spec)
+        finally:
+            proc.close()
+        print(json.dumps({"rank": rank, "rows": rows,
+                          "deltas": odeltas, "sums": sums}),
+              flush=True)
         return 0
     rows = []
     fb0 = spc.read("sm_fallback_tcp_sends")
@@ -1800,7 +1995,8 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--window", type=int, default=16,
                    help="frames in flight per ack in --bw mode")
     p.add_argument("--plane", default="device",
-                   choices=("device", "host", "sm", "han", "numa"),
+                   choices=("device", "host", "sm", "han", "numa",
+                            "osc"),
                    help="collectives: device = XLA mesh (default); "
                         "host = coll/host over real loopback sockets; "
                         "sm = same, with the shared-memory rings "
@@ -1811,7 +2007,11 @@ def main(argv: list[str] | None = None) -> int:
                         "fallback failing the run; numa = three-level "
                         "vs domains-as-hosts two-level ladder on the "
                         "emulated --hosts x --domains topology, "
-                        "counter- and footprint-gated")
+                        "counter- and footprint-gated; osc = the "
+                        "direct-map one-sided ladder (put/get/fetch-"
+                        "atomic on sm-region windows vs the forced-AM "
+                        "reference, byte-identical + counter-gated; "
+                        "--real-procs for per-process counter tables)")
     p.add_argument("--nprocs", type=int, default=4,
                    help="socket ranks for --plane host/sm/han/numa "
                         "collectives (numa defaults to hosts*domains*2)")
@@ -1897,6 +2097,9 @@ def main(argv: list[str] | None = None) -> int:
         rows = bench_han(args.max_size, max(args.iters, 3),
                          nprocs=args.nprocs, hosts=args.hosts,
                          via_metrics=args.via_metrics)
+    elif args.plane == "osc":
+        rows = bench_osc(args.max_size, max(args.iters, 5),
+                         real_procs=args.real_procs)
     elif args.plane == "numa":
         nprocs = args.nprocs if args.nprocs != 4 \
             else args.hosts * args.domains * 2

@@ -1,0 +1,796 @@
+"""Direct-map one-sided plane (osc/direct.py): sm-region-backed
+windows — the direct-vs-AM byte-identical matrix, lock-word
+fetch-atomics, futex passive-target locks over threads AND real
+processes, mixed-topology counter splits, the shmem symmetric-heap
+seam, and the region lock-word protocol itself."""
+
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from test_sm_plane import run_sm
+from test_tcp import run_tcp
+from zhpe_ompi_tpu import ops as zops
+from zhpe_ompi_tpu.core import errors
+from zhpe_ompi_tpu.mca import var as mca_var
+from zhpe_ompi_tpu.osc.am import LOCK_EXCLUSIVE, LOCK_SHARED
+from zhpe_ompi_tpu.osc.direct import DirectWindow, allocate_window
+from zhpe_ompi_tpu.pt2pt import sm as sm_mod
+from zhpe_ompi_tpu.runtime import spc
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _matrix_prog(p):
+    """The op matrix both planes must answer identically: contiguous,
+    strided-source, zero-size, overlapping put-get, offset gets, every
+    fetch-atomic op."""
+    win = allocate_window(p, 64 * 8, np.float64)
+    win.fence()
+    t = 1 - p.rank
+    win.put(np.arange(8.0) + p.rank, t, 0)                  # contiguous
+    win.put(np.arange(32.0)[::4] * (p.rank + 1), t, 8)      # strided src
+    win.put(np.zeros(0), t, 16)                             # zero-size
+    win.fence()
+    win.lock(t, LOCK_EXCLUSIVE)
+    a = win.get(t, 4, 8)
+    win.put(a * 2, t, 6)  # overlapping span [6,14) over read [4,12)
+    win.unlock(t)
+    win.fence()
+    olds = [
+        float(win.get_accumulate(np.float64(2.0), t, 20,
+                                 op=zops.SUM)[0]),
+        float(win.get_accumulate(np.float64(3.0), t, 20,
+                                 op=zops.MAX)[0]),
+        float(win.fetch_and_op(1.5, target=t, offset=21)),
+        float(win.compare_and_swap(7.0, compare=0.0, target=t,
+                                   offset=22)),
+        float(np.asarray(win.rget_accumulate(
+            np.float64(1.0), t, 23).wait(timeout=20.0))[0]),
+        float(win.rget(t, 0, 4).wait(timeout=20.0)[0]),
+    ]
+    win.accumulate(np.full(4, float(p.rank + 1)), t, 24, op=zops.SUM)
+    win.accumulate(np.full(4, 2.0), t, 24, op=zops.PROD)
+    win.fence()
+    got = win.get(t, 0, 32).tolist()
+    win.fence()
+    mine = np.asarray(win.base[:32]).tolist()
+    win.free()
+    return got, mine, olds
+
+
+class TestDirectVsAmByteIdentical:
+    """The same program, direct vs forced-AM (osc_direct=0), must
+    produce byte-identical window contents, gets, and atomic
+    pre-values."""
+
+    def test_matrix_identical_across_planes(self, fresh_vars):
+        d0 = spc.read("osc_direct_bytes")
+        am0 = spc.read("osc_am_applied")
+        fb0 = spc.read("osc_am_fallbacks")
+        direct = run_sm(2, _matrix_prog, sm=True)
+        d1 = spc.read("osc_direct_bytes")
+        # the direct run moved direct bytes, applied nothing at the AM
+        # service, and fell back on nothing (same-host, both mapped)
+        assert d1 > d0
+        assert spc.read("osc_am_applied") == am0
+        assert spc.read("osc_am_fallbacks") == fb0
+        mca_var.set_var("osc_direct", 0)
+        forced = run_sm(2, _matrix_prog, sm=True)
+        assert spc.read("osc_direct_bytes") == d1  # AM run: zero direct
+        assert forced == direct
+
+    def test_create_with_user_buffer_stays_am(self, fresh_vars):
+        """MPI_Win_create over a USER buffer cannot be region-backed
+        (the user's memory is not mappable) — it rides AM unchanged
+        and counts no fallbacks (not a direct-capable window)."""
+        fb0 = spc.read("osc_am_fallbacks")
+
+        def prog(p):
+            buf = np.zeros(8, np.float64)
+            win = DirectWindow.create(p, buf)
+            win.fence()
+            win.put(np.float64(p.rank + 1), 0, offset=p.rank)
+            win.fence()
+            out = buf[:2].tolist() if p.rank == 0 else None
+            win.free()
+            return out
+
+        assert run_sm(2, prog, sm=True)[0] == [1.0, 2.0]
+        assert spc.read("osc_am_fallbacks") == fb0
+
+
+class TestFetchAtomics:
+    """Lock-word atomics: concurrent updates from every rank must not
+    lose increments, and the pre-values must be distinct (the
+    atomicity proof), all with ZERO AM service involvement."""
+
+    def test_concurrent_accumulates_direct(self, fresh_vars):
+        iters = 25
+        am0 = spc.read("osc_am_applied")
+        at0 = spc.read("osc_direct_atomics")
+
+        def prog(p):
+            win = allocate_window(p, 8, np.int64)
+            win.fence()
+            for _ in range(iters):
+                win.accumulate(np.int64(1), target=0, offset=0)
+            win.fence()
+            out = int(win.base[0]) if p.rank == 0 else None
+            win.free()
+            return out
+
+        assert run_sm(4, prog, sm=True, timeout=90.0)[0] == 4 * iters
+        assert spc.read("osc_am_applied") == am0
+        assert spc.read("osc_direct_atomics") - at0 >= 4 * iters
+
+    def test_get_accumulate_prevalues_distinct(self, fresh_vars):
+        def prog(p):
+            win = allocate_window(p, 8, np.int64)
+            win.fence()
+            old = win.get_accumulate(np.int64(1), target=0, offset=0)
+            win.fence()
+            win.free()
+            return int(old[0])
+
+        assert sorted(run_sm(4, prog, sm=True, timeout=90.0)) == \
+            [0, 1, 2, 3]
+
+    def test_compare_and_swap_single_winner(self, fresh_vars):
+        def prog(p):
+            win = allocate_window(p, 8, np.int64)
+            win.fence()
+            old = win.compare_and_swap(p.rank + 1, compare=0, target=0)
+            win.fence()
+            win.free()
+            return int(old)
+
+        assert run_sm(4, prog, sm=True, timeout=90.0).count(0) == 1
+
+
+class TestPassiveLocks:
+    """Passive-target epochs on the region header: exclusive
+    serializes read-modify-write, shared coexist, writers are not
+    starved, and AM origins bridge into the same header words."""
+
+    def test_exclusive_lock_counter_threads(self, fresh_vars):
+        iters = 10
+
+        def prog(p):
+            win = allocate_window(p, 8, np.float64)
+            win.fence()
+            for _ in range(iters):
+                win.lock(0, LOCK_EXCLUSIVE)
+                v = win.get(0, 0, 1)[0]
+                win.put(np.float64(v + 1), 0, 0)
+                win.unlock(0)
+            win.fence()
+            out = float(win.base[0]) if p.rank == 0 else None
+            win.free()
+            return out
+
+        assert run_sm(4, prog, sm=True, timeout=90.0)[0] == 4.0 * iters
+
+    def test_shared_locks_coexist(self, fresh_vars):
+        def prog(p):
+            win = allocate_window(p, 8, np.float64)
+            win.fence()
+            readers = list(range(1, p.size))
+            if p.rank == 0:
+                for r in readers:
+                    p.recv(source=r, tag=60, timeout=30.0)
+                for r in readers:
+                    p.send(b"go", dest=r, tag=61)
+            else:
+                win.lock(0, LOCK_SHARED)
+                p.send(b"held", dest=0, tag=60)
+                p.recv(source=0, tag=61, timeout=30.0)
+                win.unlock(0)
+            win.fence()
+            win.free()
+            return True
+
+        assert run_sm(3, prog, sm=True) == [True] * 3
+
+    def test_queued_writer_blocks_later_shared(self, fresh_vars):
+        """Writer priority on the header: once an exclusive waiter is
+        recorded (the WAITW slot), a later shared request defers until
+        the writer ran."""
+
+        def prog(p):
+            win = allocate_window(p, 8, np.float64)
+            win.fence()
+            if p.rank == 0:
+                win.lock(0, LOCK_SHARED)
+                p.send(b"held", dest=1, tag=80)
+                p.recv(source=1, tag=81, timeout=30.0)  # writer queued
+                p.send(b"go", dest=2, tag=82)
+                p.recv(source=2, tag=83, timeout=30.0)
+                time.sleep(0.2)  # let reader 2's attempt hit the header
+                win.unlock(0)
+                win.fence()
+                win.free()
+                return None
+            if p.rank == 1:
+                p.recv(source=0, tag=80, timeout=30.0)
+                granted = threading.Event()
+
+                def writer():
+                    win.lock(0, LOCK_EXCLUSIVE)
+                    granted.set()
+                    win.put(np.float64(1), 0, 0)
+                    win.unlock(0)
+
+                th = threading.Thread(target=writer)
+                th.start()
+                time.sleep(0.2)  # the WAITW slot is recorded
+                p.send(b"queued", dest=0, tag=81)
+                th.join(20)
+                win.fence()
+                win.free()
+                return granted.is_set()
+            p.recv(source=0, tag=82, timeout=30.0)
+            p.send(b"queuing", dest=0, tag=83)
+            win.lock(0, LOCK_SHARED)
+            got = float(win.get(0, 0, 1)[0])
+            win.unlock(0)
+            win.fence()
+            win.free()
+            return got
+
+        res = run_sm(3, prog, sm=True)
+        assert res[1] is True
+        assert res[2] == 1.0  # saw the writer's value: did not overtake
+
+    def test_am_origin_locks_bridge_into_the_header(self, fresh_vars):
+        """MIXED lock contention on one region-backed target: a
+        cross-boot (AM) origin's lock excludes direct origins — the
+        service grants against the same header words, and direct
+        unlocks poke queued AM waiters via lock_scan."""
+        iters = 8
+        kw = {3: {"sm_boot_id": "feedfacef00d"}}  # rank 3 is "remote"
+
+        def prog(p):
+            win = allocate_window(p, 8, np.float64)
+            win.fence()
+            for _ in range(iters):
+                win.lock(0, LOCK_EXCLUSIVE)
+                v = win.get(0, 0, 1)[0]
+                win.put(np.float64(v + 1), 0, 0)
+                win.unlock(0)
+            win.fence()
+            out = float(win.base[0]) if p.rank == 0 else None
+            # rank 3's ops all rode AM (loud: fallbacks counted)
+            direct = win._direct(0) is not None
+            win.free()
+            return out, direct
+
+        fb0 = spc.read("osc_am_fallbacks")
+        res = run_sm(4, prog, kw, timeout=120.0)
+        assert res[0][0] == 4.0 * iters
+        assert res[3][1] is False and res[0][1] is True
+        assert spc.read("osc_am_fallbacks") > fb0
+
+    def test_am_waiter_granted_after_direct_holder_dies(self,
+                                                        fresh_vars):
+        """A queued AM-origin lock waiter must not ride out its RPC
+        timeout when the DIRECT holder blocking it dies: the owner's
+        classification-time recovery re-scans the service's waiter
+        queue (no unlock/lock_scan message ever arrives from a
+        corpse)."""
+        from test_ulfm import run_tcp_ft
+        from zhpe_ompi_tpu.ft import ulfm
+
+        mca_var.set_var("ft_detector_period", 0.05)
+        mca_var.set_var("ft_detector_timeout", 0.8)
+        kw = {2: {"sm_boot_id": "feedfacef00d"}}  # rank 2 = AM origin
+
+        def prog(p):
+            from zhpe_ompi_tpu.core import errhandler as errh
+
+            p.set_errhandler(errh.ERRORS_RETURN)
+            win = allocate_window(p, 8, np.float64)
+            win.fence()
+            if p.rank == 1:
+                ulfm.expect_failure(p.ft_state, 1)
+                win.lock(0, LOCK_EXCLUSIVE)  # direct header hold
+                assert win._direct(0) is not None
+                p.send(b"holding", dest=2, tag=95)
+                p.recv(source=2, tag=96, timeout=30.0)  # AM req queued
+                p.sever()  # die holding: nobody ever unlocks
+                return "gone"
+            if p.rank == 2:
+                assert win._direct(0) is None  # cross-boot: AM origin
+                p.recv(source=1, tag=95, timeout=30.0)
+                ulfm.expect_failure(p.ft_state, 1)
+                queued = threading.Event()
+
+                def announce():
+                    time.sleep(0.5)  # the lock AM is queued by then
+                    queued.set()
+                    p.send(b"queued", dest=1, tag=96)
+
+                th = threading.Thread(target=announce)
+                th.start()
+                t0 = time.monotonic()
+                win.lock(0, LOCK_EXCLUSIVE)  # blocks at rank 0's svc
+                waited = time.monotonic() - t0
+                win.put(np.float64(42.0), 0, 0)
+                win.unlock(0)
+                th.join(5)
+                p.send(b"done", dest=0, tag=97)
+                return waited
+            # rank 0: the window owner — just stay alive and verify
+            ulfm.expect_failure(p.ft_state, 1)
+            p.recv(source=2, tag=97, timeout=30.0)
+            return float(win.base[0])
+
+        res = run_tcp_ft(3, prog, sm=True, kwargs_by_rank=kw,
+                         timeout=90.0)
+        assert res[1] == "gone"
+        # granted by the recovery-time rescan, far below the 30 s RPC
+        # deadline the bug rode out
+        assert res[2] < 20.0, res
+        assert res[0] == 42.0
+
+    def test_exclusive_lock_counter_real_processes(self, fresh_vars):
+        """The cross-PROCESS case the lock word exists for: real OS
+        ranks hammer one exclusive counter through the header."""
+        worker = (
+            "import sys, numpy as np\n"
+            "from zhpe_ompi_tpu.pt2pt.tcp import TcpProc\n"
+            "from zhpe_ompi_tpu.osc.direct import allocate_window\n"
+            "from zhpe_ompi_tpu.osc.am import LOCK_EXCLUSIVE,"
+            " LOCK_SHARED\n"
+            "rank, n, port, iters = map(int, sys.argv[1:5])\n"
+            "p = TcpProc(rank, n, coordinator=('127.0.0.1', port),\n"
+            "            timeout=60.0, sm=True)\n"
+            "try:\n"
+            "    win = allocate_window(p, 8, np.int64)\n"
+            "    win.fence()\n"
+            "    assert win._direct(0) is not None\n"
+            "    for _ in range(iters):\n"
+            "        win.lock(0, LOCK_EXCLUSIVE)\n"
+            "        v = win.get(0, 0, 1)[0]\n"
+            "        win.put(np.int64(v + 1), 0, 0)\n"
+            "        win.unlock(0)\n"
+            "    win.fence()\n"
+            "    win.lock(0, LOCK_SHARED)  # shared grant cross-process\n"
+            "    shared_view = int(win.get(0, 0, 1)[0])\n"
+            "    win.unlock(0)\n"
+            "    assert shared_view == n * iters, shared_view\n"
+            "    if rank == 0:\n"
+            "        print('TOTAL', int(win.base[0]), flush=True)\n"
+            "    win.free()\n"
+            "finally:\n"
+            "    p.close()\n"
+        )
+        n, iters = 2, 12
+        last = None
+        for _attempt in range(3):
+            import socket as _socket
+
+            s = _socket.socket()
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+            s.close()
+            procs = [subprocess.Popen(
+                [sys.executable, "-c", worker, str(r), str(n),
+                 str(port), str(iters)],
+                cwd=REPO, stdout=subprocess.PIPE,
+                stderr=subprocess.PIPE, text=True,
+            ) for r in range(n)]
+            outs = []
+            try:
+                for pr in procs:
+                    out, err = pr.communicate(timeout=120)
+                    outs.append((pr.returncode, out, err))
+            finally:
+                for pr in procs:
+                    if pr.poll() is None:
+                        pr.kill()
+                        pr.wait()
+            if all(rc == 0 for rc, _, _ in outs):
+                assert f"TOTAL {n * iters}" in outs[0][1], outs
+                return
+            last = outs
+        raise AssertionError(f"real-process lock workers failed: {last}")
+
+
+class TestMixedTopologyWindows:
+    """Some peers direct, some AM — same answers, counters split."""
+
+    def test_counter_split_and_answers(self, fresh_vars):
+        kw = {0: {"sm_boot_id": "aaaaaaaaaaaa"},
+              1: {"sm_boot_id": "aaaaaaaaaaaa"},
+              2: {"sm_boot_id": "bbbbbbbbbbbb"},
+              3: {"sm_boot_id": "bbbbbbbbbbbb"}}
+        d0 = spc.read("osc_direct_puts")
+        fb0 = spc.read("osc_am_fallbacks")
+        am0 = spc.read("osc_am_applied")
+
+        def prog(p):
+            win = allocate_window(p, p.size * 8, np.float64)
+            win.fence()
+            for t in range(p.size):
+                win.put(np.float64(p.rank + 1), target=t, offset=p.rank)
+            win.fence()
+            out = np.asarray(win.base[:p.size]).tolist()
+            win.free()
+            return out
+
+        res = run_sm(4, prog, kw, timeout=90.0)
+        for out in res:
+            assert out == [1.0, 2.0, 3.0, 4.0]
+        # 4 ranks x 4 targets: 2 direct (same-boot incl. self) + 2 AM
+        assert spc.read("osc_direct_puts") - d0 == 8
+        assert spc.read("osc_am_fallbacks") - fb0 == 8
+        assert spc.read("osc_am_applied") - am0 == 8
+
+
+class TestShmemDirectSeam:
+    """The symmetric heap rides the same seam: put/get/iput/iget/
+    *_nbi/AMO over a region-backed arena take the direct path — and
+    the forced-AM reference answers identically."""
+
+    @staticmethod
+    def _prog(p):
+        from zhpe_ompi_tpu.shmem.api import shmem_wire_pe
+
+        pe = shmem_wire_pe(p, heap_bytes=1 << 16)
+        sym = pe.shmalloc(16, np.float64)
+        pe.local(sym)[...] = float(p.rank + 1)
+        pe.barrier_all()
+        other = 1 - p.rank
+        got = pe.get(sym, other).tolist()
+        pe.put(sym, np.arange(16.0) * (p.rank + 1), other)
+        pe.iput(sym, np.full(4, 99.0), other, tst=2)
+        pe.barrier_all()
+        strided = pe.iget(sym, other, 4, sst=2).tolist()
+        old = float(pe.atomic_fetch_add(sym, 0.5, pe=other, index=15))
+        cas = float(pe.atomic_compare_swap(
+            sym, 99.0, -1.0, pe=other, index=0))
+        tgt = np.empty(16, np.float64)
+        pe.get_nbi(sym, other, tgt)
+        pe.put_nbi(sym, np.full(16, 5.0), other)
+        pe.quiet()
+        pe.barrier_all()
+        mine = pe.local(sym).tolist()
+        out = (got, strided, old, cas, tgt.tolist(), mine)
+        pe.barrier_all()
+        pe.finalize()
+        return out
+
+    def test_direct_vs_am_identical_and_counted(self, fresh_vars):
+        d0 = spc.read("osc_direct_bytes")
+        direct = run_sm(2, self._prog, sm=True)
+        d1 = spc.read("osc_direct_bytes")
+        assert d1 > d0
+        mca_var.set_var("osc_direct", 0)
+        forced = run_sm(2, self._prog, sm=True)
+        assert spc.read("osc_direct_bytes") == d1
+        assert forced == direct
+
+
+class TestRevokePoisonsDirectPath:
+    """A revoke landing AFTER a target was mapped must poison the
+    DIRECT path too: every subsequent op re-routes to the AM path and
+    raises typed Revoked — post-revoke mapped load/store silently
+    mutating a poisoned window would break ULFM."""
+
+    def test_put_after_revoke_raises(self, fresh_vars):
+        from test_ulfm import run_tcp_ft
+        from zhpe_ompi_tpu.osc import am as am_mod
+
+        def prog(p):
+            from zhpe_ompi_tpu.core import errhandler as errh
+
+            p.set_errhandler(errh.ERRORS_RETURN)
+            win = allocate_window(p, 64, np.float64)
+            win.fence()
+            t = 1 - p.rank
+            win.put(np.float64(1.0), t, 0)  # mapped + direct: works
+            win.fence()
+            assert win._direct(t) is not None
+            if p.rank == 0:
+                p.revoke(am_mod.AM_CID)
+            deadline = time.monotonic() + 10
+            while not p.ft_state.is_revoked(am_mod.AM_CID) \
+                    and time.monotonic() < deadline:
+                time.sleep(0.01)
+            try:
+                win.put(np.float64(2.0), t, 0)
+                return "silent"
+            except errors.Revoked:
+                return "revoked"
+
+        assert run_tcp_ft(2, prog, sm=True) == ["revoked", "revoked"]
+
+
+class TestRpcTypedFailure:
+    """Satellite bugfix: osc/am.py's RPC path classifies known-failed
+    targets as typed ProcFailed at ISSUE time and keeps the blocked
+    wait failure-aware — never a bare 30 s timeout."""
+
+    def test_known_failed_target_raises_at_issue(self, fresh_vars):
+        from test_ulfm import run_tcp_ft
+        from zhpe_ompi_tpu.ft import ulfm
+
+        def prog(p):
+            win = allocate_window(p, 64, np.float64)
+            win.fence()
+            if p.rank == 0:
+                ulfm.expect_failure(p.ft_state, 1)
+                p.ft_state.mark_failed(1, cause="transport")
+                t0 = time.monotonic()
+                with pytest.raises(errors.ProcFailed):
+                    win.get(1, 0, 4)
+                took = time.monotonic() - t0
+                assert took < 5.0, f"bare-timeout path took {took:.1f}s"
+                return "typed"
+            time.sleep(2.5)  # stay alive while rank 0 asserts
+            return "peer"
+
+        res = run_tcp_ft(2, prog, sm=False)
+        assert res[0] == "typed"
+
+    def test_wait_classifies_mid_rpc(self, fresh_vars):
+        from test_ulfm import run_tcp_ft
+        from zhpe_ompi_tpu.ft import ulfm
+
+        def prog(p):
+            from zhpe_ompi_tpu.core import errhandler as errh
+
+            p.set_errhandler(errh.ERRORS_RETURN)
+            win = allocate_window(p, 64, np.float64)
+            win.fence()
+            if p.rank == 0:
+                ulfm.expect_failure(p.ft_state, 1)
+                # peer's service is already down when this arrives
+                p.recv(source=1, tag=7, timeout=30.0)
+
+                def classify():
+                    time.sleep(0.8)
+                    p.ft_state.mark_failed(1, cause="transport")
+
+                th = threading.Thread(target=classify)
+                th.start()
+                t0 = time.monotonic()
+                with pytest.raises(errors.ProcFailed):
+                    win.get(1, 0, 4)  # blocked: the target never answers
+                took = time.monotonic() - t0
+                th.join(5)
+                assert took < 10.0, f"wait was deadline-only: {took:.1f}s"
+                return "typed"
+            # wedge the TARGET side of the RPC: the service loop stops
+            # consuming (the sockets stay up — no transport-death
+            # signal), so only the failure-aware wait unblocks the
+            # origin
+            win.svc.shutdown()
+            p.send(b"wedged", dest=0, tag=7)
+            time.sleep(4.0)  # stay alive while rank 0 asserts
+            return "wedged"
+
+        res = run_tcp_ft(2, prog, sm=False)
+        assert res[0] == "typed"
+
+
+class TestRegionProtocol:
+    """The region lock word below the window API: cross-mapping
+    atomicity, crash recovery, waiting-writer cleanup, and the flock
+    fallback when the native kernel library is absent."""
+
+    def _pair(self):
+        seg = sm_mod.SmSegment(0, 4, on_frame=lambda s, f: None)
+        region = seg.alloc_rma_region(4096)
+        return seg, region
+
+    def test_atomicity_across_mappings(self):
+        seg, r = self._pair()
+        try:
+            m2 = sm_mod.RmaMapping(r.path, my_rank=1)
+
+            def worker(m):
+                for _ in range(400):
+                    with m.atomic():
+                        m.view(np.int64)[0] += 1
+
+            ts = [threading.Thread(target=worker, args=(m,))
+                  for m in (r, m2)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join(30)
+            assert int(r.view(np.int64)[0]) == 800
+            m2.close()
+        finally:
+            seg.close()
+
+    def test_flock_fallback_is_still_atomic(self):
+        seg, r = self._pair()
+        try:
+            m2 = sm_mod.RmaMapping(r.path, my_rank=1)
+            r._use_native = m2._use_native = False  # force flock path
+
+            def worker(m):
+                for _ in range(200):
+                    with m.atomic():
+                        m.view(np.int64)[0] += 1
+
+            ts = [threading.Thread(target=worker, args=(m,))
+                  for m in (r, m2)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join(30)
+            assert int(r.view(np.int64)[0]) == 400
+            m2.close()
+        finally:
+            seg.close()
+
+    def test_flock_fallback_honors_abort(self):
+        """The degraded (no-native-library) mutex must keep the same
+        abort/stall contract as the lock word: a wedged holder cannot
+        hang a survivor past its classification hook."""
+        seg, r = self._pair()
+        try:
+            m2 = sm_mod.RmaMapping(r.path, my_rank=1)
+            r._use_native = m2._use_native = False
+            entered = threading.Event()
+            release = threading.Event()
+
+            def holder():
+                with m2.atomic():
+                    entered.set()
+                    release.wait(10)
+
+            th = threading.Thread(target=holder)
+            th.start()
+            assert entered.wait(5)
+            calls = []
+
+            def abort():
+                calls.append(1)
+                if len(calls) > 3:
+                    raise errors.ProcFailed("holder classified dead")
+
+            with pytest.raises(errors.ProcFailed):
+                with r.atomic(abort=abort, timeout=30.0):
+                    pass
+            release.set()
+            th.join(5)
+            m2.close()
+        finally:
+            seg.close()
+
+    def test_recover_dead_releases_holder_and_mutex(self):
+        seg, r = self._pair()
+        try:
+            m3 = sm_mod.RmaMapping(r.path, my_rank=3)
+            m3.lock(3, exclusive=True)
+            # simulate dying INSIDE the lock word's critical section too
+            if r._use_native:
+                assert r._amo32(sm_mod._RH_MUTEX, sm_mod._AMO_CAS,
+                                value=4, compare=0) == 0
+            assert r.recover_dead(3) is True
+            r.lock(0, exclusive=True, timeout=5.0)
+            r.unlock(0)
+            assert r.recover_dead(3) is False  # idempotent
+            m3.close()
+        finally:
+            seg.close()
+
+    def test_shared_count_recovered(self):
+        seg, r = self._pair()
+        try:
+            m1 = sm_mod.RmaMapping(r.path, my_rank=1)
+            m1.lock(1, exclusive=False)
+            m3 = sm_mod.RmaMapping(r.path, my_rank=3)
+            m3.lock(3, exclusive=False)
+            r.recover_dead(3)
+            # one reader remains: exclusive still blocked
+            got = []
+            th = threading.Thread(
+                target=lambda: (r.lock(0, True, timeout=10.0),
+                                got.append(1), r.unlock(0)))
+            th.start()
+            time.sleep(0.2)
+            assert not got
+            m1.unlock(1)
+            th.join(10)
+            assert got == [1]
+            m1.close()
+            m3.close()
+        finally:
+            seg.close()
+
+    def test_abandoned_writer_wait_cleans_its_slot(self):
+        seg, r = self._pair()
+        try:
+            m1 = sm_mod.RmaMapping(r.path, my_rank=1)
+            m1.lock(1, exclusive=False)
+            with pytest.raises(errors.InternalError):
+                r.lock(0, exclusive=True, timeout=0.3)
+            # the ghost WAITW slot must not starve later readers
+            m2 = sm_mod.RmaMapping(r.path, my_rank=2)
+            m2.lock(2, exclusive=False, timeout=2.0)
+            m2.unlock(2)
+            m1.unlock(1)
+            m1.close()
+            m2.close()
+        finally:
+            seg.close()
+
+
+class TestPerPeerFiles:
+    """Satellite: layout v3 — physically separate per-peer files bound
+    the VIRTUAL reservation; the audit and zero-orphan gates cover
+    ring and region files alike."""
+
+    def test_control_file_is_header_only(self, fresh_vars):
+        seg = sm_mod.SmSegment(0, 512, on_frame=lambda s, f: None)
+        try:
+            # v2 reserved size x worst-class span (gigabytes at this
+            # universe size); v3's control file is the O(size) header
+            assert os.path.getsize(seg.path) == seg._hdr
+            ring = int(mca_var.get("sm_ring_bytes", 4 << 20))
+            assert os.path.getsize(seg.path) < ring
+        finally:
+            seg.close()
+
+    def test_ring_files_materialize_and_unlink(self, fresh_vars):
+        seg = sm_mod.SmSegment(0, 4, on_frame=lambda s, f: None)
+        rpath = seg._ring_path(2)
+        try:
+            assert not os.path.exists(rpath)
+            tx = sm_mod.SmSender(seg.name, src_rank=2, dest_rank=0)
+            try:
+                assert os.path.exists(rpath)
+                assert os.path.getsize(rpath) == sm_mod._ring_span(
+                    tx.nslots, tx.slot_bytes)
+            finally:
+                tx.close()
+        finally:
+            seg.close()
+        assert not os.path.exists(rpath)
+        assert sm_mod.segment_audit_failures() == []
+
+    def test_sever_leaves_files_close_sweeps(self, fresh_vars):
+        seg = sm_mod.SmSegment(0, 2, on_frame=lambda s, f: None)
+        region = seg.alloc_rma_region(1024)
+        tx = sm_mod.SmSender(seg.name, src_rank=1, dest_rank=0)
+        tx.close()
+        rpath = seg._ring_path(1)
+        seg.sever()
+        # a crash honors no invariants: everything stays on disk
+        assert os.path.exists(seg.path)
+        assert os.path.exists(rpath)
+        assert os.path.exists(region.path)
+        seg.close()  # the harness close owns the sweep
+        assert not os.path.exists(seg.path)
+        assert not os.path.exists(rpath)
+        assert not os.path.exists(region.path)
+        assert sm_mod.orphaned_ring_files() == []
+
+    def test_window_free_unlinks_its_region(self, fresh_vars):
+        paths = []
+
+        def prog(p):
+            win = allocate_window(p, 256, np.float64)
+            win.fence()
+            if win._region is not None:
+                paths.append(win._region.path)
+                assert os.path.exists(win._region.path)
+            win.free()
+            p.barrier()
+            return True
+
+        assert run_sm(2, prog, sm=True) == [True, True]
+        assert len(paths) == 2
+        for path in paths:
+            assert not os.path.exists(path), path

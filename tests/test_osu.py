@@ -336,3 +336,30 @@ def test_device_probe_gate_trips_on_wedged_plane(monkeypatch):
     finally:
         mca_var.set_var("device_probe_timeout", saved[0])
         mca_var.set_var("device_probe_deadline", saved[1])
+
+
+def test_osc_rows_thread_harness():
+    """Fast CI row for the --plane osc ladder: a tiny direct + forced-AM
+    double run with all its gates live (direct bytes rising, AM applies
+    and wire bytes flat, zero fallbacks, byte-identical results)."""
+    rows = osu_zmpi.bench_osc(max_size=1024, iters=3)
+    ops_seen = {r["op"] for r in rows}
+    assert {"osc_direct_put", "osc_direct_get", "osc_direct_fetch_op",
+            "osc_am_put", "osc_am_get",
+            "osc_am_fetch_op"} <= ops_seen
+    for r in rows:
+        assert r["bytes"] > 0
+        assert r["latency_us"] > 0
+        assert np.isfinite(r["bandwidth_MBps"])
+
+
+@pytest.mark.slow
+def test_osc_ladder_real_procs():
+    """The honest cross-process osc ladder: per-process counter tables
+    make every gate exact — osc_direct_bytes strictly rising per rank,
+    osc_am_applied and tcp_bytes_sent flat on every same-host rung,
+    zero silent fallbacks, forced-AM reference byte-identical."""
+    rows = osu_zmpi.bench_osc(max_size=1 << 17, iters=5,
+                              real_procs=True)
+    direct_puts = [r for r in rows if r["op"] == "osc_direct_put"]
+    assert len(direct_puts) >= 4

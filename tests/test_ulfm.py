@@ -2066,3 +2066,67 @@ class TestKillDuringNumaHan:
             assert res[r][:3] == (3, [[[0, 1], [2]]], expect_total), \
                 res[r]
         assert "ProcFailed" in [res[r][3] for r in survivors]
+
+
+class TestKillWhileHoldingPassiveLock:
+    """Direct-map one-sided plane drill: a rank dies HOLDING a
+    region-backed window's passive-target EXCLUSIVE lock.  Typed
+    classification must run the window's FailureState listener — the
+    corpse's writer word is recovered — and the survivors' window
+    operations (including fresh locks on the very same target) proceed
+    after the shrink, with zero leaked mappings/files at the session
+    gate."""
+
+    def test_lock_word_recovered_at_classification(self, fresh_vars):
+        from zhpe_ompi_tpu.osc.am import LOCK_EXCLUSIVE
+        from zhpe_ompi_tpu.osc.direct import allocate_window
+        from zhpe_ompi_tpu.pt2pt import sm as sm_mod
+
+        mca_var.set_var("ft_detector_period", 0.05)
+        mca_var.set_var("ft_detector_timeout", 0.8)
+        n = 3
+
+        def prog(p):
+            p.set_errhandler(errh.ERRORS_RETURN)
+            win = allocate_window(p, 8 * 8, np.float64)
+            win.fence()
+            if p.rank == 2:
+                ulfm.expect_failure(p.ft_state, 2)
+                win.lock(0, LOCK_EXCLUSIVE)
+                # taken through the region HEADER, not the AM manager
+                assert win._direct(0) is not None
+                for r in (0, 1):
+                    p.send(b"locked", dest=r, tag=90)
+                p.sever()  # crash: the unlock never comes
+                return "gone"
+            p.recv(source=2, tag=90, timeout=30.0)
+            ulfm.expect_failure(p.ft_state, 2)
+            p.ft_state.wait_failed(2, timeout=20.0)
+            # classification ran the listener: the ghost's writer word
+            # is recovered — this lock must be granted, not wait out
+            # a stall timeout on a corpse's exclusive hold
+            t0 = time.monotonic()
+            win.lock(0, LOCK_EXCLUSIVE)
+            lock_wait = time.monotonic() - t0
+            v = win.get(0, 0, 1)[0]
+            win.put(np.float64(v + 1), 0, 0)
+            win.unlock(0)
+            p.failure_ack()
+            sh = p.shrink()
+            total = float(sh.allreduce(np.float64(1.0), ops.SUM))
+            # survivors' window ops proceed after the shrink
+            win.lock(0, LOCK_EXCLUSIVE)
+            win.unlock(0)
+            counter = float(win.base[0]) if p.rank == 0 else None
+            return (total, counter, lock_wait)
+
+        res = run_tcp_ft(n, prog, sm=True, timeout=90.0)
+        assert res[2] == "gone"
+        for r in (0, 1):
+            total, _, lock_wait = res[r]
+            assert total == 2.0
+            assert lock_wait < 15.0
+        # both survivors' increments landed under the recovered lock
+        assert res[0][1] == 2.0
+        # the severed rank's files were swept by the harness close
+        assert sm_mod.orphaned_ring_files() == []

@@ -215,6 +215,16 @@ class FailureState:
 
     # -- failure listeners -----------------------------------------------
 
+    def remove_failure_listener(self, fn) -> None:
+        """Unregister a failure listener (a freed window must not keep
+        recovering lock words for the rest of the endpoint's life);
+        unknown listeners are ignored — remove races close paths."""
+        with self._cv:
+            try:
+                self._listeners.remove(fn)
+            except ValueError:
+                pass
+
     def add_failure_listener(self, fn) -> None:
         """Register ``fn(rank, cause)`` to run on every NEWLY-learned
         peer death or departure — the transport-teardown hook (a ring

@@ -1,5 +1,9 @@
-"""One-sided communication: host-plane windows + SPMD device windows."""
+"""One-sided communication: host-plane windows (direct-map + AM) and
+SPMD device windows."""
+from .direct import DirectWindow, allocate_window, create_dynamic_window
 from .spmd_window import DeviceWindow
 from .window import LOCK_EXCLUSIVE, LOCK_SHARED, HostWindow
 
-__all__ = ["HostWindow", "DeviceWindow", "LOCK_SHARED", "LOCK_EXCLUSIVE"]
+__all__ = ["HostWindow", "DeviceWindow", "DirectWindow",
+           "allocate_window", "create_dynamic_window",
+           "LOCK_SHARED", "LOCK_EXCLUSIVE"]

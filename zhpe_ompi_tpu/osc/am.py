@@ -40,6 +40,7 @@ from __future__ import annotations
 
 import itertools
 import threading
+import time
 from collections import deque
 from typing import Any
 
@@ -59,6 +60,13 @@ LOCK_EXCLUSIVE = 2
 # tag space; cf. MCA_COLL_BASE_TAG numbering).
 AM_CID = 0x7FFB
 AM_REQ_TAG = 1  # all requests; replies use per-call tags >= 0x100
+
+
+def _win_atomic(st: "_AmWinState"):
+    """The window's atomicity domain: the region LOCK WORD when the
+    window is direct-map backed (cross-process — direct origins take
+    the same word), the process-local apply lock otherwise."""
+    return st.apply_lock if st.region is None else st.region.atomic()
 
 
 class _LockManager:
@@ -119,6 +127,13 @@ class _AmWinState:
     def __init__(self, size: int, buffer: np.ndarray):
         self.buffer = buffer  # flat view target ops write through
         self.apply_lock = threading.Lock()  # serializes local vs AM applies
+        # direct-map plane (osc/direct.py): the region whose lock word
+        # is the window's cross-process atomicity domain, or None for a
+        # plain (process-private) window.  When set, the service's
+        # atomics and lock grants run against the region header so
+        # direct origins and AM origins serialize on the same words.
+        self.region = None
+        self.region_waiters: deque[tuple[int, int, int]] = deque()
         self.lockman = _LockManager()
         # dynamic windows
         self.dynamic: dict[int, np.ndarray] = {}
@@ -190,6 +205,16 @@ class AmService:
                 )
             except errors.InternalError:
                 continue  # poll timeout: check _stop and re-post
+            except (errors.ProcFailed, errors.Revoked):
+                # a PEER died (the service's wildcard recv classifies
+                # under ULFM's ANY_SOURCE pending semantics until the
+                # app acks) — peer death is not SERVICE death: the loop
+                # must keep serving the survivors' RMA.  The classify
+                # raises immediately, so pace the retry (the recv's
+                # 0.25 s cadence) instead of spinning on it.
+                if self._stop.wait(0.05):
+                    return
+                continue
             except Exception:
                 return  # endpoint torn down
             try:
@@ -212,6 +237,56 @@ class AmService:
 
     def _reply(self, origin: int, tag: int, payload: Any) -> None:
         self.ep.send(payload, origin, tag=tag, cid=AM_CID)
+
+    # -- direct-map (region-backed) lock bridge ---------------------------
+    # AM origins locking a direct-map window must exclude DIRECT origins
+    # manipulating the region header — the service grants against the
+    # same shared words, queues what it cannot grant (never blocking the
+    # loop), and counts queued waiters in the region's amq word so a
+    # direct unlock knows to poke us with a "lock_scan".
+
+    def _region_lock_request(self, st: _AmWinState, origin: int,
+                             lock_type: int, reply_tag: int) -> None:
+        excl = lock_type == LOCK_EXCLUSIVE
+        granted = False
+        with st.cond:  # waiter-queue guard
+            with st.region.atomic():
+                if not st.region_waiters and st.region.try_lock(
+                        origin, excl):
+                    granted = True
+                else:
+                    if excl:
+                        st.region.mark_waiting(origin)
+                    st.region.amq_adjust(+1)
+                    st.region_waiters.append(
+                        (origin, lock_type, reply_tag))
+        if granted:
+            self._reply(origin, reply_tag, ("ok", None))
+
+    def _scan_region_waiters(self, st: _AmWinState) -> None:
+        grants = []
+        state = getattr(self.ep, "ft_state", None)
+        with st.cond:
+            while st.region_waiters:
+                origin, lock_type, tag = st.region_waiters[0]
+                excl = lock_type == LOCK_EXCLUSIVE
+                with st.region.atomic():
+                    if state is not None and state.is_failed(origin):
+                        # a dead waiter must not absorb a grant (its
+                        # WAITW slot was cleared at classification)
+                        st.region.amq_adjust(-1)
+                        st.region_waiters.popleft()
+                        continue
+                    if st.region.try_lock(origin, excl):
+                        st.region.amq_adjust(-1)
+                        st.region_waiters.popleft()
+                        grants.append((origin, tag))
+                        if excl:
+                            break  # writer got it; nothing can follow
+                    else:
+                        break
+        for origin, tag in grants:
+            self._reply(origin, tag, ("ok", None))
 
     def _win(self, win_id: int) -> _AmWinState:
         st = self.windows.get(win_id)
@@ -244,7 +319,7 @@ class AmService:
         elif op == "cas":
             _, win_id, offset, compare, value, reply_tag = msg
             st = self._win(win_id)
-            with st.apply_lock:
+            with _win_atomic(st):
                 flat = st.buffer
                 if not 0 <= offset < flat.size:
                     raise errors.WinError(
@@ -262,9 +337,14 @@ class AmService:
         elif op == "lock":
             _, win_id, lock_type, reply_tag = msg
             st = self._win(win_id)
+            if st.region is not None:
+                # direct-map window: grant against the region header so
+                # AM origins and direct origins exclude each other
+                self._region_lock_request(st, origin, lock_type,
+                                          reply_tag)
             # FIFO fairness: an immediate grant only when nobody is queued
             # — otherwise new SHARED requests would starve a waiting writer
-            if not st.lockman.waiters and st.lockman.try_grant(
+            elif not st.lockman.waiters and st.lockman.try_grant(
                 origin, lock_type
             ):
                 self._reply(origin, reply_tag, ("ok", None))
@@ -273,8 +353,21 @@ class AmService:
         elif op == "unlock":
             _, win_id, lock_type = msg
             st = self._win(win_id)
-            for w_origin, w_tag in st.lockman.release(origin, lock_type):
-                self._reply(w_origin, w_tag, ("ok", None))
+            if st.region is not None:
+                st.region.unlock(origin)
+                self._scan_region_waiters(st)
+            else:
+                for w_origin, w_tag in st.lockman.release(origin,
+                                                          lock_type):
+                    self._reply(w_origin, w_tag, ("ok", None))
+        elif op == "lock_scan":
+            # a DIRECT origin's unlock saw queued AM waiters (the
+            # region's amq word): re-try grants — the header words
+            # changed without any message this loop could observe
+            _, win_id = msg
+            st = self._win(win_id)
+            if st.region is not None:
+                self._scan_region_waiters(st)
         elif op == "post":
             # target announced an exposure epoch to us (we are an origin)
             _, win_id = msg
@@ -328,7 +421,7 @@ class AmService:
             _, win_id, disp, kind, value, compare, dtstr, reply_tag = msg
             st = self._win(win_id)
             dt = np.dtype(dtstr)
-            with st.apply_lock:
+            with _win_atomic(st):
                 view, off = resolve_dynamic(st, disp, dt.itemsize)
                 typed = view[off : off + dt.itemsize].view(dt)
                 old = typed[0].copy()
@@ -404,7 +497,7 @@ def read_window(st: _AmWinState, offset: int, count: int | None
 
 def apply_acc(st: _AmWinState, offset: int, op: zops.Op, data: np.ndarray
               ) -> np.ndarray:
-    with st.apply_lock:
+    with _win_atomic(st):
         flat = st.buffer
         n = data.size
         if offset < 0 or offset + n > flat.size:
@@ -472,12 +565,48 @@ class AmWindow(errh.HasErrhandler, rma_util.FetchOpMixin):
     def _send(self, target: int, msg: tuple) -> None:
         self.ep.send(msg, target, tag=AM_REQ_TAG, cid=AM_CID)
 
+    def _classify_target(self, target: int):
+        """Typed issue-time classification (the PR 7 isend contract):
+        an RPC toward a KNOWN-failed target or over a revoked channel
+        raises ``ProcFailed``/``Revoked`` instead of burning the RPC
+        timeout into a bare-timeout error.  Returns the FailureState
+        (None on non-ft endpoints) for the wait loop's re-checks."""
+        state = getattr(self.ep, "ft_state", None)
+        if state is None:
+            return None
+        state.check_revoked(AM_CID)
+        if state.is_failed(target):
+            raise errors.ProcFailed(
+                f"one-sided target rank {target} is known failed "
+                f"(cause: {state.cause_of(target)})",
+                failed_ranks=state.failed(),
+            )
+        return state
+
     def _rpc(self, target: int, msg_head: tuple, timeout: float = 30.0):
-        """Request expecting a reply: post the reply recv, send, wait."""
+        """Request expecting a reply: post the reply recv, send, wait.
+        The wait is FAILURE-AWARE, not deadline-only: a target that
+        enters the FailureState (or a revoke landing) mid-wait raises
+        typed within one slice instead of a bare 30 s timeout."""
+        state = self._classify_target(target)
         reply_tag = next(self.svc.reply_tags)
         rreq = self.ep.irecv(source=target, tag=reply_tag, cid=AM_CID)
         self._send(target, msg_head + (reply_tag,))
-        out = rreq.wait(timeout)
+        deadline = time.monotonic() + timeout
+        while True:
+            try:
+                out = rreq.wait(min(0.5, max(0.05, deadline
+                                             - time.monotonic())))
+                break
+            except errors.RequestError:
+                # slice lapsed: classify before the next park — the
+                # request itself also completes ERRORED on a NEW
+                # classification (the failure-aware irecv), this
+                # covers targets that were failed/revoked already
+                if state is not None:
+                    self._classify_target(target)
+                if time.monotonic() >= deadline:
+                    raise
         if out[0] == "err":
             cls_ = getattr(errors, out[1], errors.MpiError)
             raise cls_(out[2])
@@ -539,7 +668,7 @@ class AmWindow(errh.HasErrhandler, rma_util.FetchOpMixin):
     def compare_and_swap(self, value, compare, target: int, offset: int = 0):
         """MPI_Compare_and_swap (single element)."""
         if target == self.ep.rank:
-            with self.st.apply_lock:
+            with _win_atomic(self.st):
                 flat = self.st.buffer
                 if not 0 <= offset < flat.size:
                     raise errors.WinError(
@@ -562,6 +691,7 @@ class AmWindow(errh.HasErrhandler, rma_util.FetchOpMixin):
         posted, the request fires, the caller waits whenever it wants)."""
         from ..pt2pt.requests import Request
 
+        self._classify_target(target)  # typed at issue, like _rpc
         reply_tag = next(self.svc.reply_tags)
         inner = self.ep.irecv(source=target, tag=reply_tag, cid=AM_CID)
         req = Request()

@@ -10,30 +10,47 @@ kernel-buffer costs for every hop — frames move through mmap'd
 ``/dev/shm`` rings exactly like the C shim's own transport
 (``native/zompi_mpi.cpp`` sm_*).
 
-Design (one segment per proc, demand-mapped fixed-slot SPSC rings per
-peer direction):
+Design (one CONTROL segment per proc, demand-mapped fixed-slot SPSC
+rings per peer direction, each materialized ring its own file):
 
-- **Segment**: each proc creates ONE ``/dev/shm`` segment at
-  construction holding its INBOUND rings and advertises
-  ``(boot_id, segment_name)`` on its modex card plus a NUMA-domain
-  token (``pynuma:``, sysfs-derived or the ``sm_numa_id`` override).
-  A sender maps the destination's segment and produces into the ring
-  indexed by its own rank; the owner is the only consumer of every ring
-  in its segment, so each ring is strictly SPSC and a single doorbell
-  in the segment header covers all of them.
-- **Demand mapping**: rings are NOT pre-carved for every possible
-  source.  The segment header carries a per-source **ring directory**
-  plus an **allocation bitmap**; a sender's first contact writes an
-  allocation request (its peer class) into its directory entry and
-  rings the doorbell, and the owner's poll thread materializes the
-  ring — per-class geometry, bitmap bit, READY state — before the
-  first payload byte moves.  A proc that never talks to a peer never
-  pays that peer's ring (the file is sparse; tmpfs pages allocate on
-  first touch), so the per-proc footprint under hierarchical (han)
-  traffic is ``(domain_size + is_leader × n_groups) × ring`` instead
-  of ``size × sm_ring_bytes``.  The close-time audit (see
-  :func:`segment_audit_failures`) asserts the physical footprint
-  matches the bitmap and no directory entry was orphaned.
+- **Segment**: each proc creates ONE ``/dev/shm`` control segment at
+  construction holding the doorbell, the allocation bitmap and the
+  per-source ring directory, and advertises ``(boot_id,
+  segment_name)`` on its modex card plus a NUMA-domain token
+  (``pynuma:``, sysfs-derived or the ``sm_numa_id`` override).  A
+  sender maps the destination's control segment for the handshake and
+  produces into the ring indexed by its own rank; the owner is the
+  only consumer of every ring in its namespace, so each ring is
+  strictly SPSC and a single doorbell in the control header covers
+  all of them.
+- **Demand mapping, one file per materialized ring** (layout v3):
+  rings are NOT pre-carved for every possible source.  A sender's
+  first contact writes an allocation request (its peer class) into
+  its directory entry and rings the doorbell, and the owner's poll
+  thread materializes the ring — a PHYSICALLY SEPARATE file
+  (``<segment>.r<src>``) sized exactly to the peer class's geometry,
+  bitmap bit, READY state — before the first payload byte moves.  A
+  proc that never talks to a peer never pays that peer's ring, and —
+  unlike the v2 single sparse maximal file — never even RESERVES its
+  address space: the virtual reservation is the control header plus
+  the materialized rings, so a very large universe costs
+  ``O(size)`` directory bytes, not ``size × max_ring_span`` of
+  mapping.  The close-time audit (see
+  :func:`segment_audit_failures`) asserts the per-file physical
+  footprint matches the bitmap and no directory entry was orphaned.
+- **RMA regions** (the one-sided data plane): window/symmetric-heap
+  backing buffers allocate as further per-purpose files
+  (``<segment>.w<idx>``) via :meth:`SmSegment.alloc_rma_region`.  A
+  region is a page of header — a **lock word** serializing
+  fetch-atomics cross-process (native ``__atomic`` CAS when the
+  kernel library is available, ``flock`` critical sections
+  otherwise), shared/exclusive passive-target lock counts with a
+  per-rank holder table, and a futex generation word blocked lock
+  waiters park on — followed by the window's data bytes.  Same-host
+  origins ``mmap`` the file and execute put/get as direct
+  load/store; ``osc/direct.py`` is the consumer.  A died
+  lock-holder's words are recovered at classification via
+  :meth:`RmaMapping.recover_dead`.
 - **Ring**: ``nslots`` fixed slots of ``sm_max_frag`` payload bytes;
   ring capacity is **per peer class** — ``sm_ring_bytes`` for
   intra-domain peers, ``sm_leader_ring_bytes`` for leader-to-leader
@@ -79,7 +96,9 @@ the C rings.
 
 from __future__ import annotations
 
+import contextlib
 import ctypes
+import fcntl
 import hashlib
 import itertools
 import mmap
@@ -92,6 +111,8 @@ import tempfile
 import threading
 import time
 import weakref
+
+import numpy as np
 
 from ..core import errors
 from ..mca import output as mca_output
@@ -183,13 +204,13 @@ _U32 = struct.Struct("<I")
 _SLOT = struct.Struct("<II")
 _SLOT_HDR = 16  # _SLOT padded to 16 for payload alignment
 
-_MAGIC = 0x325F4D5359505A00  # "\0ZPYSM_2" little-endian (v2: directory)
+_MAGIC = 0x335F4D5359505A00  # "\0ZPYSM_3" little-endian (v3: ring files)
 _RING_HDR = 128              # head @+0, tail @+64 (cache-line separated)
-# segment-header field offsets
+# control-segment header field offsets
 _OFF_MAGIC = 0
 _OFF_NRINGS = 12
-_OFF_SPAN = 16       # u64: per-source reserved ring-region span
-_OFF_HDRLEN = 24     # u64: header length == offset of ring region 0
+_OFF_SPAN = 16       # u64: worst-class ring span (informational in v3)
+_OFF_HDRLEN = 24     # u64: control header length (== file length in v3)
 _OFF_DOORBELL = 64   # consumer sleep flag (futex word)
 _OFF_STOPPED = 128   # owner's poll loop exited (peers stop quiescing)
 _OFF_BITMAP = 256    # allocation bitmap: ceil(size/64) u64 words
@@ -371,6 +392,37 @@ def _segment_name(rank: int) -> str:
     # only be a crashed job's leftover (pid reuse) — unlink and retry
     return (f"zompi_pyring_{_session_tag()}_{os.getpid()}_{rank}_"
             f"{next(_seg_counter)}")
+
+
+def _create_shared_file(path: str, nbytes: int) -> mmap.mmap:
+    """Create-and-map a shared backing file (ring or RMA region) with
+    the stale-unlink O_EXCL retry idiom, registered with the hygiene
+    registry; a half-created file is never left behind."""
+    flags = os.O_CREAT | os.O_EXCL | os.O_RDWR
+    try:
+        fd = os.open(path, flags, 0o600)
+    except FileExistsError:
+        # stale file from a crashed job (pid reuse): unlink, retry once
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+        fd = os.open(path, flags, 0o600)
+    try:
+        try:
+            os.ftruncate(fd, nbytes)
+            mm = mmap.mmap(fd, nbytes)
+        finally:
+            os.close(fd)
+    except OSError:
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+        raise
+    with _registry_lock:
+        _created_paths.add(path)
+    return mm
 
 
 def orphaned_ring_files() -> list[str]:
@@ -557,20 +609,31 @@ class _RingState:
     """Consumer-side per-ring bookkeeping (the owner is the only
     consumer; ``tail`` here is authoritative, the shm copy exists for
     the producer's free-space check).  Geometry is per ring — peer
-    classes size their rings differently under demand mapping."""
+    classes size their rings differently under demand mapping — and
+    each ring owns ITS OWN file mapping (layout v3: one file per
+    materialized ring, head/tail at the file's start)."""
 
-    __slots__ = ("src", "base", "tail", "buf", "fill", "nslots",
-                 "slot_bytes")
+    __slots__ = ("src", "path", "mm", "mv", "tail", "buf", "fill",
+                 "nslots", "slot_bytes")
 
-    def __init__(self, src: int, base: int, nslots: int,
-                 slot_bytes: int):
+    def __init__(self, src: int, path: str, mm: mmap.mmap,
+                 nslots: int, slot_bytes: int):
         self.src = src
-        self.base = base
+        self.path = path
+        self.mm = mm
+        self.mv = memoryview(mm)
         self.nslots = nslots
         self.slot_bytes = slot_bytes
         self.tail = 0
         self.buf: bytearray | None = None  # partial message assembly
         self.fill = 0
+
+    def close(self) -> None:
+        self.mv.release()
+        try:
+            self.mm.close()
+        except BufferError:  # pragma: no cover - exported view leaked
+            pass
 
 
 class SmSegment:
@@ -594,41 +657,17 @@ class SmSegment:
             CLASS_LEADER: _class_geometry(CLASS_LEADER),
         }
         self.nslots, self.slot_bytes = self._class_geom[CLASS_INTRA]
-        # every source's region is reserved at the WORST class span —
-        # virtual reservation only: the file is sparse, and an
-        # unmaterialized (or half-filled) ring costs no tmpfs pages
+        # layout v3: the control file is the HEADER ALONE — rings live
+        # in their own files, so the virtual reservation is bounded by
+        # the directory (O(size) bytes), not size × worst-class span
         span = max(_ring_span(n, s) for n, s in self._class_geom.values())
         self._hdr = _hdr_len(size)
-        seg_len = self._hdr + size * span
+        seg_len = self._hdr
         self.name = name or _segment_name(rank)
         self.path = os.path.join(segment_dir(), self.name)
-        flags = os.O_CREAT | os.O_EXCL | os.O_RDWR
-        try:
-            fd = os.open(self.path, flags, 0o600)
-        except FileExistsError:
-            # stale ring from a crashed job (pid reuse): unlink, retry
-            # once — the zompi_mpi.cpp:709 idiom
-            try:
-                os.unlink(self.path)
-            except OSError:
-                pass
-            fd = os.open(self.path, flags, 0o600)
-        try:
-            try:
-                os.ftruncate(fd, seg_len)
-                self._mm = mmap.mmap(fd, seg_len)
-            finally:
-                os.close(fd)
-        except OSError:
-            # half-created segment: never leave the file behind (the
-            # lifecycle gate's zero-orphan contract starts HERE)
-            try:
-                os.unlink(self.path)
-            except OSError:
-                pass
-            raise
-        with _registry_lock:
-            _created_paths.add(self.path)
+        # stale-unlink O_EXCL retry + hygiene registration + never a
+        # half-created file left behind (the zompi_mpi.cpp:709 idiom)
+        self._mm = _create_shared_file(self.path, seg_len)
         # persistent read view: slicing an mmap OBJECT materializes an
         # intermediate bytes copy per read; slicing a memoryview of it
         # does not — the consumer's frag copy must be the only copy
@@ -645,6 +684,10 @@ class SmSegment:
         # poll loop scans _pending until every possible source is live
         self._rings: list[_RingState] = []
         self._pending = [src for src in range(size) if src != rank]
+        # RMA regions (the one-sided plane's backing files): allocated
+        # by alloc_rma_region, freed by their window or at close
+        self._regions: list["RmaRegion"] = []
+        self._region_counter = itertools.count()
         # per-segment hot window (sm_poll_hot_us): 0 on single-CPU
         # affinity masks — see the var's rationale
         self._hot_s = max(0, int(mca_var.get("sm_poll_hot_us", 5000))) \
@@ -668,12 +711,20 @@ class SmSegment:
     def _dirent(self, src: int) -> int:
         return _dir_off(self.size) + src * _DIRENT
 
+    def _ring_path(self, src: int) -> str:
+        """The per-peer ring file of source rank `src` (layout v3):
+        derived from the control segment's name, so a sender that read
+        READY can open it without any name exchange and the launcher's
+        ``zompi_pyring_<session>_`` prefix sweep covers it."""
+        return f"{self.path}.r{src}"
+
     def _scan_requests(self) -> bool:
         """Materialize rings whose sender wrote an allocation request:
-        publish the class geometry, set the bitmap bit, flip the entry
-        READY, and start consuming.  Runs on the poll thread (the owner
-        is the only writer of geometry/bitmap/READY, so the handshake
-        needs no cross-process atomics)."""
+        create the per-peer ring FILE sized exactly to the class
+        geometry, publish the geometry, set the bitmap bit, flip the
+        entry READY, and start consuming.  Runs on the poll thread
+        (the owner is the only writer of geometry/bitmap/READY, so the
+        handshake needs no cross-process atomics)."""
         if not self._pending:
             return False
         mm = self._mm
@@ -686,15 +737,18 @@ class SmSegment:
             klass = _U32.unpack_from(mm, off + _DE_CLASS)[0]
             nslots, slot_bytes = self._class_geom.get(
                 klass, self._class_geom[CLASS_INTRA])
+            rpath = self._ring_path(src)
+            rmm = _create_shared_file(rpath, _ring_span(nslots,
+                                                        slot_bytes))
             _U32.pack_into(mm, off + _DE_NSLOTS, nslots)
             _U32.pack_into(mm, off + _DE_SLOT_BYTES, slot_bytes)
-            _fence()  # geometry must be visible before READY
+            _fence()  # ring file + geometry must be visible before READY
             _U32.pack_into(mm, off + _DE_STATE, _ST_READY)
             word = _OFF_BITMAP + (src // 64) * 8
             bits = _U64.unpack_from(mm, word)[0]
             _U64.pack_into(mm, word, bits | (1 << (src % 64)))
-            self._rings.append(_RingState(
-                src, self._hdr + src * self._span, nslots, slot_bytes))
+            self._rings.append(_RingState(src, rpath, rmm, nslots,
+                                          slot_bytes))
             self._pending.remove(src)
             spc.record("sm_rings_materialized", 1)
             mca_output.verbose(
@@ -720,31 +774,54 @@ class SmSegment:
                                for st in self._rings)
 
     def physical_bytes(self) -> int | None:
-        """Actual backing pages of the segment file (tmpfs allocates
-        on first touch; ``st_blocks`` is the honest footprint)."""
+        """Actual backing pages of the control file plus every
+        materialized ring file (tmpfs allocates on first touch;
+        ``st_blocks`` is the honest footprint)."""
         try:
-            return os.stat(self.path).st_blocks * 512
+            total = os.stat(self.path).st_blocks * 512
+            for st in self._rings:
+                total += os.stat(st.path).st_blocks * 512
+            return total
         except OSError:
             return None
+
+    # -- RMA regions (the one-sided plane's backing store) ---------------
+
+    def alloc_rma_region(self, nbytes: int) -> "RmaRegion":
+        """Allocate a window/symmetric-heap backing region in this
+        segment's namespace: its own file (``<segment>.w<idx>``) with
+        the lock-word header, registered for the zero-orphan gate and
+        unlinked at close unless a window freed it first."""
+        region = RmaRegion(self, next(self._region_counter), nbytes)
+        with _registry_lock:
+            self._regions.append(region)
+        return region
+
+    def release_rma_region(self, region: "RmaRegion") -> None:
+        """Window-free-time release: unmap and unlink the region file
+        (the collective ``win.free`` already quiesced every origin)."""
+        with _registry_lock:
+            if region in self._regions:
+                self._regions.remove(region)
+        region.close(unlink=True)
 
     # -- consumer --------------------------------------------------------
 
     def _any_ready(self) -> bool:
-        mm = self._mm
         for st in self._rings:
-            if _U64.unpack_from(mm, st.base)[0] != st.tail:
+            if _U64.unpack_from(st.mm, 0)[0] != st.tail:
                 return True
         return False
 
     def _drain_ring(self, st: _RingState) -> bool:
-        mm = self._mm
-        head = _U64.unpack_from(mm, st.base)[0]
+        mm = st.mm
+        head = _U64.unpack_from(mm, 0)[0]
         if head == st.tail:
             return False
         _fence()  # acquire edge: slot reads must not pass the head load
         nslots, slot_bytes = st.nslots, st.slot_bytes
         while st.tail < head:
-            slot = st.base + _RING_HDR + \
+            slot = _RING_HDR + \
                 (st.tail % nslots) * (_SLOT_HDR + slot_bytes)
             frag_len, total = _SLOT.unpack_from(mm, slot)
             if frag_len > slot_bytes:  # pragma: no cover - corruption
@@ -757,7 +834,7 @@ class SmSegment:
                 st.fill = 0
             data = slot + _SLOT_HDR
             st.buf[st.fill:st.fill + frag_len] = \
-                self._mv[data:data + frag_len]
+                st.mv[data:data + frag_len]
             st.fill += frag_len
             spc.record("sm_bytes_recvd", frag_len + _SLOT_HDR)
             st.tail += 1
@@ -780,7 +857,7 @@ class SmSegment:
             # copy-out above must be globally done first (a producer
             # reuses the slot the moment it sees the new tail)
             _fence()
-            _U64.pack_into(mm, st.base + 64, st.tail)
+            _U64.pack_into(mm, 64, st.tail)
         return True
 
     def _poll_loop(self) -> None:
@@ -893,11 +970,16 @@ class SmSegment:
                         f"{state == _ST_READY} for rank {src} but "
                         f"consumer materialized={src in ready}"
                     )
+                if state == _ST_READY and \
+                        not os.path.exists(self._ring_path(src)):
+                    fails.append(
+                        f"{self.name}: READY directory entry for rank "
+                        f"{src} but its ring file is gone"
+                    )
             phys = self.physical_bytes()
             if phys is not None and self.path.startswith("/dev/shm"):
-                # slack: ring regions need not be page-aligned, so each
-                # materialized ring may touch up to TWO extra pages
-                # (one at each unaligned end), plus header slop
+                # slack: each file rounds to page granularity at its
+                # tail, plus header slop in the control file
                 bound = self.footprint_bytes() + \
                     (2 * len(ready) + 2) * 4096
                 if phys > bound:
@@ -925,6 +1007,21 @@ class SmSegment:
         self._poll.join(timeout=5.0)
         if not getattr(self, "_severed", False):
             self._audit()
+        # RMA regions a window never freed (abnormal teardown) are
+        # unlinked here — the harness close owns the final sweep
+        with _registry_lock:
+            regions = list(self._regions)
+            self._regions = []
+        for region in regions:
+            region.close(unlink=True)
+        for st in self._rings:
+            st.close()
+            try:
+                os.unlink(st.path)
+            except OSError:
+                pass
+            with _registry_lock:
+                _created_paths.discard(st.path)
         self._mv.release()
         try:
             self._mm.close()
@@ -939,13 +1036,13 @@ class SmSegment:
 
 
 class SmSender:
-    """The producer half: maps a peer's segment, runs the tiny
+    """The producer half: maps a peer's CONTROL segment, runs the tiny
     allocate handshake (first contact materializes this source's ring
-    through the owner's doorbell machinery), and streams frames into
-    the ring indexed by this proc's rank.  Geometry comes from the
-    segment's RING DIRECTORY, not local MCA state — mismatched vars
-    between procs cannot desynchronize the slot walk, and the owner
-    alone decides each peer class's ring capacity."""
+    file through the owner's doorbell machinery), maps the per-peer
+    ring file, and streams frames into it.  Geometry comes from the
+    control segment's RING DIRECTORY, not local MCA state — mismatched
+    vars between procs cannot desynchronize the slot walk, and the
+    owner alone decides each peer class's ring capacity."""
 
     def __init__(self, name: str, src_rank: int, dest_rank: int,
                  ring_class: int = CLASS_INTRA, timeout: float = 10.0):
@@ -958,49 +1055,62 @@ class SmSender:
                 raise errors.InternalError(
                     f"sm segment {name}: truncated ({seg_len} bytes)"
                 )
-            self._mm = mmap.mmap(fd, seg_len)
+            self._cmm = mmap.mmap(fd, seg_len)
         finally:
             os.close(fd)
-        mm = self._mm
+        cmm = self._cmm
+        self._mm: mmap.mmap | None = None
         try:
-            if _U64.unpack_from(mm, _OFF_MAGIC)[0] != _MAGIC:
+            if _U64.unpack_from(cmm, _OFF_MAGIC)[0] != _MAGIC:
                 raise errors.InternalError(
                     f"sm segment {name}: bad magic (creator still "
-                    "stamping or foreign file)"
+                    "stamping, v2 layout, or foreign file)"
                 )
-            nrings = _U32.unpack_from(mm, _OFF_NRINGS)[0]
-            span = _U64.unpack_from(mm, _OFF_SPAN)[0]
-            hdr = _U64.unpack_from(mm, _OFF_HDRLEN)[0]
+            nrings = _U32.unpack_from(cmm, _OFF_NRINGS)[0]
+            hdr = _U64.unpack_from(cmm, _OFF_HDRLEN)[0]
             if src_rank >= nrings:
                 raise errors.InternalError(
                     f"sm segment {name}: rank {src_rank} outside its "
                     f"{nrings}-ring universe"
                 )
-            expect = hdr + nrings * span
-            if seg_len < expect:
+            if seg_len < hdr:
                 raise errors.InternalError(
-                    f"sm segment {name}: {seg_len} bytes < {expect} "
+                    f"sm segment {name}: {seg_len} bytes < {hdr} "
                     "expected"
                 )
-            self._base = hdr + src_rank * span
             self._entry = _dir_off(nrings) + src_rank * _DIRENT
             self._handshake(ring_class, timeout)
             self.nslots = _U32.unpack_from(
-                mm, self._entry + _DE_NSLOTS)[0]
+                cmm, self._entry + _DE_NSLOTS)[0]
             self.slot_bytes = _U32.unpack_from(
-                mm, self._entry + _DE_SLOT_BYTES)[0]
-            if not self.nslots or not self.slot_bytes or \
-                    _ring_span(self.nslots, self.slot_bytes) > span:
+                cmm, self._entry + _DE_SLOT_BYTES)[0]
+            if not self.nslots or not self.slot_bytes:
                 raise errors.InternalError(
                     f"sm segment {name}: corrupt directory geometry "
-                    f"({self.nslots} x {self.slot_bytes}B in a "
-                    f"{span}B region)"
+                    f"({self.nslots} x {self.slot_bytes}B)"
                 )
+            # READY implies the owner created-and-sized the ring file
+            # BEFORE publishing (the fence ordering in _scan_requests)
+            ring_path = f"{self.path}.r{src_rank}"
+            span = _ring_span(self.nslots, self.slot_bytes)
+            rfd = os.open(ring_path, os.O_RDWR)
+            try:
+                if os.fstat(rfd).st_size < span:
+                    raise errors.InternalError(
+                        f"sm ring file {ring_path}: smaller than its "
+                        f"directory geometry ({span}B)"
+                    )
+                self._mm = mmap.mmap(rfd, span)
+            finally:
+                os.close(rfd)
         except BaseException:
-            mm.close()
+            if self._mm is not None:
+                self._mm.close()
+            cmm.close()
             raise
-        self._head = _U64.unpack_from(mm, self._base)[0]
-        self._mv = memoryview(mm)  # see SmSegment: no-copy slot windows
+        self._base = 0
+        self._head = _U64.unpack_from(self._mm, self._base)[0]
+        self._mv = memoryview(self._mm)  # no-copy slot windows
         self._lock = lockdep.lock("sm.SmSender._lock")
         self._dead = False
 
@@ -1010,7 +1120,7 @@ class SmSender:
         the owner's poll thread to publish READY + geometry.  A ring an
         earlier same-rank sender already materialized is adopted as-is
         (its geometry is the contract)."""
-        mm = self._mm
+        mm = self._cmm
         if _U32.unpack_from(mm, self._entry + _DE_STATE)[0] == _ST_READY:
             _fence()
             return
@@ -1059,7 +1169,7 @@ class SmSender:
             # would report success for up to a whole ring of silently
             # lost messages — the TCP path errors after at most one
             # kernel-buffered send, and the sm path must match it
-            if _U32.unpack_from(mm, _OFF_STOPPED)[0]:
+            if _U32.unpack_from(self._cmm, _OFF_STOPPED)[0]:
                 if spins:
                     spc.record("sm_ring_full_spins", spins)
                     _note_full_spins(spins)
@@ -1085,7 +1195,7 @@ class SmSender:
             time.sleep(0 if spins < 200 else 0.00005)
 
     def _doorbell(self) -> None:
-        mm = self._mm
+        mm = self._cmm
         _fence()  # head store must precede the sleep-flag load
         if _U32.unpack_from(mm, _OFF_DOORBELL)[0]:
             _U32.pack_into(mm, _OFF_DOORBELL, 0)
@@ -1183,7 +1293,7 @@ class SmSender:
                 raise errors.InternalError(
                     f"sm ring to rank {self.dest} is torn down"
                 )
-            if _U32.unpack_from(self._mm, _OFF_STOPPED)[0]:
+            if _U32.unpack_from(self._cmm, _OFF_STOPPED)[0]:
                 raise ConsumerStopped(
                     f"sm ring to rank {self.dest}: consumer stopped"
                 )
@@ -1266,7 +1376,7 @@ class SmSender:
         if self._dead:
             return True
         try:
-            return bool(_U32.unpack_from(self._mm, _OFF_STOPPED)[0])
+            return bool(_U32.unpack_from(self._cmm, _OFF_STOPPED)[0])
         except ValueError:
             return True
 
@@ -1276,7 +1386,465 @@ class SmSender:
                 return
             self._dead = True
             self._mv.release()
+            for m in (self._mm, self._cmm):
+                try:
+                    m.close()
+                except BufferError:  # pragma: no cover - view leaked
+                    pass
+
+
+# ------------------------------------------------- RMA regions --------
+# The one-sided data plane's backing store: a window (or symmetric
+# heap) allocated inside the owner's sm namespace as its own file,
+# mmap-ed by same-host origins for direct load/store put/get.  The
+# page-sized region header carries the lock word serializing
+# fetch-atomics cross-process, the shared/exclusive passive-target
+# lock state with a per-rank holder table, and the futex generation
+# word blocked lock waiters park on (the sm doorbell idiom applied to
+# locks).  ``osc/direct.py`` is the consumer.
+
+_RMA_MAGIC = 0x31414D5259505A00  # "\0ZPYRMA1" little-endian
+_RH_OWNER = 8       # u32: owner rank
+_RH_NPROCS = 12     # u32: universe size (bounds the holder table)
+_RH_DATA_LEN = 16   # u64: window data bytes
+_RH_DATA_OFF = 24   # u64: data offset (== header length)
+_RH_GEN = 32        # u32: lock-handoff generation (waiters' futex word)
+_RH_MUTEX = 36      # u32: region lock word (0 free, holder rank+1)
+_RH_READERS = 40    # u32: shared passive-lock holder count
+_RH_WRITER = 44     # u32: exclusive passive-lock holder rank+1 (0 none)
+_RH_AMQ = 48        # u32: AM-origin lock waiters queued at the owner
+_RH_TABLE = 64      # u32[nprocs]: per-rank passive-lock state
+
+# per-rank holder-table states: the waiting-writer state makes writer
+# priority crash-recoverable (a dead waiter's slot is cleared at
+# classification like a dead holder's) and lets shared acquirers defer
+# without a separate — unrecoverable — waiting-writers counter
+_LK_NONE, _LK_SHARED, _LK_EXCL, _LK_WAITW = 0, 1, 2, 3
+
+_MUTEX_WAIT = 1 << 31  # waiters-present bit of the region lock word
+
+# zompi_shm_amo operand codes (native/zompi_native.cpp enums)
+_AMO_ADD, _AMO_SWAP, _AMO_CAS, _AMO_SET, _AMO_FETCH = range(5)
+_U32_CODE = 5  # TYPE_CODES["uint32"]
+
+
+def _rma_hdr_len(nprocs: int) -> int:
+    return (_RH_TABLE + 4 * nprocs + 4095) & ~4095
+
+
+_native_amo_lib = [None, False]  # [lib-or-None, probed]
+
+
+def _native_amo():
+    """The native ``__atomic`` kernel library, or None (then the region
+    lock word degrades to flock-serialized critical sections on the
+    region fd — kernel-blocking, crash-released, never a poll)."""
+    if not _native_amo_lib[1]:
+        from .. import native
+
+        _native_amo_lib[0] = native.load()
+        _native_amo_lib[1] = True
+    return _native_amo_lib[0]
+
+
+class RegionOwnerGone(errors.InternalError):
+    """The region's backing mapping is gone (owner closed/died while a
+    lock or atomic was in flight): a distinct type so the window plane
+    can classify it against the FailureState instead of surfacing a
+    bare transport error."""
+
+
+class RmaMapping:
+    """One process's mapping of an RMA region file: the shared
+    lock-word/passive-lock protocol plus a writable view of the data
+    bytes.  The OWNER's side is :class:`RmaRegion` (creates, unlinks);
+    origins construct this directly over the advertised file name.
+
+    Atomicity domains: ``atomic()`` is the region lock word — an
+    uncontended native CAS (or an flock critical section without the
+    kernel library) + futex-parked contention — and EVERY mutator of
+    the passive-lock words runs under it, so direct origins, the
+    owner's local ops, and the owner's AM service all serialize on the
+    same word.  Blocked passive-target lock waiters park on the
+    GENERATION futex word and are woken by every unlock (shared count
+    / writer word handoff — the doorbell idiom)."""
+
+    def __init__(self, path: str, my_rank: int, _create=None):
+        self.path = path
+        self._my = my_rank
+        self._closed = False
+        if _create is not None:
+            nprocs, nbytes, owner = _create
+            hdr = _rma_hdr_len(nprocs)
+            self._mm = _create_shared_file(path, hdr + nbytes)
+            mm = self._mm
+            _U32.pack_into(mm, _RH_OWNER, owner)
+            _U32.pack_into(mm, _RH_NPROCS, nprocs)
+            _U64.pack_into(mm, _RH_DATA_LEN, nbytes)
+            _U64.pack_into(mm, _RH_DATA_OFF, hdr)
+            _fence()  # header fields visible before the magic stamp
+            _U64.pack_into(mm, 0, _RMA_MAGIC)
+            self._fd = os.open(path, os.O_RDWR)
+        else:
+            self._fd = os.open(path, os.O_RDWR)
+            try:
+                size = os.fstat(self._fd).st_size
+                if size < 4096:
+                    raise errors.InternalError(
+                        f"rma region {path}: truncated ({size} bytes)"
+                    )
+                self._mm = mmap.mmap(self._fd, size)
+                if _U64.unpack_from(self._mm, 0)[0] != _RMA_MAGIC:
+                    self._mm.close()
+                    raise errors.InternalError(
+                        f"rma region {path}: bad magic (creator still "
+                        "stamping or foreign file)"
+                    )
+            except BaseException:
+                os.close(self._fd)
+                raise
+        mm = self._mm
+        self.owner_rank = _U32.unpack_from(mm, _RH_OWNER)[0]
+        self.nprocs = _U32.unpack_from(mm, _RH_NPROCS)[0]
+        self.data_len = _U64.unpack_from(mm, _RH_DATA_LEN)[0]
+        self.data_off = _U64.unpack_from(mm, _RH_DATA_OFF)[0]
+        if self.data_off + self.data_len > len(mm) or \
+                _rma_hdr_len(self.nprocs) != self.data_off:
             try:
                 self._mm.close()
-            except BufferError:  # pragma: no cover - view leaked
+            finally:
+                os.close(self._fd)
+            raise errors.InternalError(
+                f"rma region {path}: corrupt geometry "
+                f"({self.data_off}+{self.data_len} in {len(mm)}B)"
+            )
+        self._arr = np.frombuffer(mm, dtype=np.uint8)
+        #: writable uint8 view of the window data bytes (direct
+        #: load/store lands here); .ctypes.data of `_arr` is the base
+        #: address the native AMOs operate on
+        self.data = self._arr[self.data_off:self.data_off
+                              + self.data_len]
+        self._lock = lockdep.lock("sm.RmaMapping._lock")
+        self._use_native = _native_amo() is not None
+
+    # -- the region lock word (fetch-atomics serialization) -----------
+
+    def _word(self, off: int) -> int:
+        return _U32.unpack_from(self._mm, off)[0]
+
+    def _amo32(self, off: int, kind: int, value: int = 0,
+               compare: int = 0) -> int:
+        lib = _native_amo()
+        addr = self._arr.ctypes.data + off
+        oi = ctypes.c_int64(0)
+        of = ctypes.c_double(0.0)
+        rc = lib.zompi_shm_amo(ctypes.c_void_p(addr), _U32_CODE, kind,
+                               int(value), int(compare), 0.0, 0.0,
+                               ctypes.byref(oi), ctypes.byref(of))
+        if rc != 0:  # pragma: no cover - table covers uint32
+            raise errors.InternalError("native AMO refused uint32")
+        return oi.value & 0xFFFFFFFF
+
+    def _mutex_acquire(self, deadline: float, abort) -> None:
+        me = self._my + 1
+        while True:
+            old = self._amo32(_RH_MUTEX, _AMO_CAS, value=me, compare=0)
+            if old == 0:
+                return
+            if not (old & _MUTEX_WAIT):
+                # announce a waiter so the release knows to wake; a
+                # lost race just re-reads on the next pass
+                self._amo32(_RH_MUTEX, _AMO_CAS,
+                            value=old | _MUTEX_WAIT, compare=old)
+            if abort is not None:
+                abort()
+            if time.monotonic() > deadline:
+                raise errors.InternalError(
+                    f"rma region {self.path}: lock word held past the "
+                    "stall timeout (holder wedged?)"
+                )
+            try:
+                _futex_wait(self._mm, _RH_MUTEX, old | _MUTEX_WAIT,
+                            0.05)
+            except ValueError:  # mapping closed under us (peer death
+                raise RegionOwnerGone(  # listener): classify, not crash
+                    f"rma region {self.path} unmapped mid-wait"
+                )
+
+    def _mutex_release(self) -> None:
+        old = self._amo32(_RH_MUTEX, _AMO_SWAP, value=0)
+        if old & _MUTEX_WAIT:
+            _futex_wake(self._mm, _RH_MUTEX, 64)
+
+    def _flock_acquire(self, deadline: float, abort) -> None:
+        """Non-blocking-retry flock so the fallback honors the SAME
+        abort/stall contract as the native lock word (a plain LOCK_EX
+        blocks uninterruptibly — a wedged holder would hang the caller
+        past any classification).  5 ms retry steps: the hold times are
+        sub-microsecond RMWs, so contention resolves in one step."""
+        while True:
+            try:
+                fcntl.flock(self._fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+                return
+            except OSError:
+                if abort is not None:
+                    abort()
+                if time.monotonic() > deadline:
+                    raise errors.InternalError(
+                        f"rma region {self.path}: flock held past the "
+                        "stall timeout (holder wedged?)"
+                    )
+                time.sleep(0.005)
+
+    @contextlib.contextmanager
+    def atomic(self, abort=None, timeout: float = 30.0):
+        """The region's atomicity domain: per-instance thread lock +
+        the cross-process lock word (native CAS + futex park; flock
+        retry steps when the kernel library is unavailable — both
+        honoring the abort/stall-timeout contract)."""
+        with self._lock:
+            if self._closed:
+                raise RegionOwnerGone(
+                    f"rma region {self.path} is unmapped"
+                )
+            if self._use_native:
+                self._mutex_acquire(time.monotonic() + timeout, abort)
+                try:
+                    yield
+                finally:
+                    self._mutex_release()
+            else:
+                self._flock_acquire(time.monotonic() + timeout, abort)
+                try:
+                    yield
+                finally:
+                    fcntl.flock(self._fd, fcntl.LOCK_UN)
+
+    # -- passive-target (MPI_Win_lock) protocol -----------------------
+
+    def _slot(self, rank: int) -> int:
+        if not 0 <= rank < self.nprocs:
+            raise errors.RankError(
+                f"rank {rank} outside the {self.nprocs}-rank region"
+            )
+        return _RH_TABLE + 4 * rank
+
+    def _writer_waiting(self) -> bool:
+        mm = self._mm
+        for r in range(self.nprocs):
+            if _U32.unpack_from(mm, _RH_TABLE + 4 * r)[0] == _LK_WAITW:
+                return True
+        return False
+
+    def try_lock(self, rank: int, exclusive: bool) -> bool:
+        """One grant attempt; caller MUST hold :meth:`atomic`.  Shared
+        requests defer to a waiting writer (no reader starvation of
+        writers — the window plane's FIFO-fairness contract)."""
+        mm = self._mm
+        slot = self._slot(rank)
+        readers = _U32.unpack_from(mm, _RH_READERS)[0]
+        writer = _U32.unpack_from(mm, _RH_WRITER)[0]
+        if exclusive:
+            if readers == 0 and writer == 0:
+                _U32.pack_into(mm, _RH_WRITER, rank + 1)
+                _U32.pack_into(mm, slot, _LK_EXCL)
+                return True
+            return False
+        if writer == 0 and not self._writer_waiting():
+            _U32.pack_into(mm, _RH_READERS, readers + 1)
+            _U32.pack_into(mm, slot, _LK_SHARED)
+            return True
+        return False
+
+    def mark_waiting(self, rank: int) -> None:
+        """Record `rank` as a waiting writer (shared acquirers defer to
+        it — writer priority); caller holds :meth:`atomic`."""
+        slot = self._slot(rank)
+        if _U32.unpack_from(self._mm, slot)[0] == _LK_NONE:
+            _U32.pack_into(self._mm, slot, _LK_WAITW)
+
+    def _bump_gen_locked(self) -> None:
+        mm = self._mm
+        gen = _U32.unpack_from(mm, _RH_GEN)[0]
+        _U32.pack_into(mm, _RH_GEN, (gen + 1) & 0xFFFFFFFF)
+
+    def lock(self, rank: int, exclusive: bool, abort=None,
+             timeout: float = 60.0) -> None:
+        """Acquire the passive-target lock for `rank`, parking on the
+        generation futex word between attempts (event-driven: every
+        unlock bumps the generation and wakes).  ``abort()`` is
+        consulted each wake so peer/owner death classifies instead of
+        riding out the stall timeout."""
+        deadline = time.monotonic() + timeout
+        waiting = False
+        try:
+            while True:
+                with self.atomic(abort=abort):
+                    gen = self._word(_RH_GEN)
+                    if self.try_lock(rank, exclusive):
+                        waiting = False
+                        return
+                    if exclusive:
+                        _U32.pack_into(self._mm, self._slot(rank),
+                                       _LK_WAITW)
+                        waiting = True
+                if abort is not None:
+                    abort()
+                if time.monotonic() > deadline:
+                    raise errors.InternalError(
+                        f"rma region {self.path}: passive-target lock "
+                        "wait timed out"
+                    )
+                try:
+                    _futex_wait(self._mm, _RH_GEN, gen, 0.1)
+                except ValueError:
+                    raise RegionOwnerGone(
+                        f"rma region {self.path} unmapped mid-wait"
+                    )
+        finally:
+            if waiting:
+                # gave up (timeout/abort): clear the waiting-writer
+                # slot or shared acquirers defer to a ghost forever
+                # (a region unmapped mid-cleanup has nothing to clear
+                # and must not mask the original exception)
+                try:
+                    with self.atomic():
+                        slot = self._slot(rank)
+                        if _U32.unpack_from(self._mm,
+                                            slot)[0] == _LK_WAITW:
+                            _U32.pack_into(self._mm, slot, _LK_NONE)
+                            self._bump_gen_locked()
+                    _futex_wake(self._mm, _RH_GEN, 64)
+                except (RegionOwnerGone, ValueError):
+                    pass
+
+    def unlock(self, rank: int) -> int:
+        """Release `rank`'s passive-target lock; returns the count of
+        AM-origin lock waiters queued at the owner's service (caller
+        pokes the owner when nonzero — a direct unlock sends no
+        message the service could otherwise observe)."""
+        with self.atomic():
+            mm = self._mm
+            slot = self._slot(rank)
+            state = _U32.unpack_from(mm, slot)[0]
+            if state == _LK_SHARED:
+                readers = _U32.unpack_from(mm, _RH_READERS)[0]
+                _U32.pack_into(mm, _RH_READERS, max(0, readers - 1))
+            elif state == _LK_EXCL:
+                _U32.pack_into(mm, _RH_WRITER, 0)
+            else:
+                raise errors.WinError(
+                    f"unlock: rank {rank} holds no lock on this region"
+                )
+            _U32.pack_into(mm, slot, _LK_NONE)
+            self._bump_gen_locked()
+            amq = _U32.unpack_from(mm, _RH_AMQ)[0]
+        _futex_wake(self._mm, _RH_GEN, 64)
+        return amq
+
+    def amq_adjust(self, delta: int) -> None:
+        """Adjust the AM-waiter count; caller holds :meth:`atomic` (the
+        owner's service queues/grants AM-origin lock requests)."""
+        v = _U32.unpack_from(self._mm, _RH_AMQ)[0]
+        _U32.pack_into(self._mm, _RH_AMQ, max(0, v + delta))
+
+    def holder_state(self, rank: int) -> int:
+        return _U32.unpack_from(self._mm, self._slot(rank))[0]
+
+    def recover_dead(self, rank: int) -> bool:
+        """Classification-time recovery of a died rank's lock state:
+        force-release the region lock word if the corpse holds it,
+        clear its passive-lock contribution (shared count / writer
+        word / waiting-writer slot), and wake blocked waiters.
+        Idempotent — every survivor may call it.  Returns True when
+        anything was recovered."""
+        recovered = False
+        if self._closed:
+            return False
+        try:
+            if self._use_native:
+                while True:
+                    old = self._word(_RH_MUTEX)
+                    if (old & ~_MUTEX_WAIT) != rank + 1:
+                        break
+                    if self._amo32(_RH_MUTEX, _AMO_CAS, value=0,
+                                   compare=old) == old:
+                        recovered = True
+                        _futex_wake(self._mm, _RH_MUTEX, 64)
+                        break
+        except (ValueError, AttributeError):
+            return recovered  # closed under us: nothing left to repair
+        # (flock fallback: the kernel released the corpse's flock with
+        # its last fd — only the passive-lock words need repair)
+        try:
+            with self.atomic():
+                mm = self._mm
+                slot = self._slot(rank)
+                state = _U32.unpack_from(mm, slot)[0]
+                if state == _LK_SHARED:
+                    readers = _U32.unpack_from(mm, _RH_READERS)[0]
+                    _U32.pack_into(mm, _RH_READERS, max(0, readers - 1))
+                elif state == _LK_EXCL:
+                    if _U32.unpack_from(mm, _RH_WRITER)[0] == rank + 1:
+                        _U32.pack_into(mm, _RH_WRITER, 0)
+                if state != _LK_NONE:
+                    _U32.pack_into(mm, slot, _LK_NONE)
+                    self._bump_gen_locked()
+                    recovered = True
+        except (RegionOwnerGone, ValueError):
+            return recovered
+        if recovered:
+            try:
+                _futex_wake(self._mm, _RH_GEN, 64)
+            except ValueError:
                 pass
+        return recovered
+
+    # -- data access ---------------------------------------------------
+
+    def view(self, dtype) -> np.ndarray:
+        """Writable flat view of the data bytes as `dtype` (the
+        window's element type — matches the AM plane's target-side
+        ``st.buffer`` semantics)."""
+        return self.data.view(dtype)
+
+    def data_addr(self) -> int:
+        """Base address of the data bytes (native lock-free AMOs)."""
+        return self._arr.ctypes.data + self.data_off
+
+    def close(self, unlink: bool = False) -> None:
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+        self._arr = None
+        self.data = None
+        try:
+            self._mm.close()
+        except BufferError:  # user still holds a window view: the OS
+            pass             # reclaims the mapping at process exit
+        try:
+            os.close(self._fd)
+        except OSError:  # pragma: no cover
+            pass
+        if unlink:
+            try:
+                os.unlink(self.path)
+            except OSError:
+                pass
+            with _registry_lock:
+                _created_paths.discard(self.path)
+
+
+class RmaRegion(RmaMapping):
+    """The owner's side of an RMA region: creates the backing file in
+    the segment's namespace (``<segment>.w<idx>``), registered with
+    the hygiene registry, unlinked at close (sever leaves it — the
+    crash contract; the final harness close owns the sweep)."""
+
+    def __init__(self, seg: "SmSegment", idx: int, nbytes: int):
+        self.name = f"{seg.name}.w{idx}"
+        super().__init__(
+            os.path.join(segment_dir(), self.name), seg.rank,
+            _create=(seg.size, int(nbytes), seg.rank),
+        )

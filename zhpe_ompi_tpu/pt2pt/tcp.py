@@ -1480,6 +1480,34 @@ class TcpProc(errh.HasErrhandler, ulfm.UlfmEndpointAPI, HostCollectives,
             "physical_bytes": seg.physical_bytes(),
         }
 
+    # -- one-sided plane seam (osc/direct.py) ----------------------------
+
+    def sm_rma_region(self, nbytes: int):
+        """Allocate an RMA region (window/symmetric-heap backing) in
+        this proc's sm segment namespace; None when the sm plane is
+        off — the window then rides the AM path everywhere."""
+        if self._sm_seg is None:
+            return None
+        return self._sm_seg.alloc_rma_region(nbytes)
+
+    def sm_release_region(self, region) -> None:
+        if self._sm_seg is not None:
+            self._sm_seg.release_rma_region(region)
+        else:  # segment already torn down: best-effort unlink
+            region.close(unlink=True)
+
+    def sm_direct_to(self, dest: int) -> bool:
+        """The one-sided plane's per-peer seam decision: True when the
+        PR 4 transport ladder selected the sm ring for `dest` (same
+        boot, sm priority, not declined/failed) — the EXACT decision
+        the two-sided send seam memoized, so a direction is direct for
+        RMA iff its data channel rides the rings.  Rank-to-self is
+        direct whenever the sm plane is on (the owner maps its own
+        region trivially)."""
+        if dest == self.rank:
+            return self._sm_seg is not None
+        return self._sm_tx(dest) is not None
+
     # -- wire-up ---------------------------------------------------------
 
     def _my_card(self) -> list:

@@ -187,6 +187,28 @@ sites in lockstep):
 - ``osc_am_applied`` — active-message operations applied at the
   TARGET by the AM service dispatch (origin-side ops count in
   ``osc_puts``/``osc_gets``).
+
+Direct-map one-sided counters (the sm-segment-backed RMA plane —
+``osc/direct.py``; the OSU ``--plane osc`` ladder gates on direct
+bytes strictly rising while ``osc_am_applied`` and wire
+``tcp_bytes_sent`` stay flat on same-host rungs):
+
+- ``osc_direct_puts`` / ``osc_direct_gets`` — window/symmetric-heap
+  puts and gets executed as direct load/store against a mapped RMA
+  region (no message, no pack, no matching engine, no target-side
+  dispatch).
+- ``osc_direct_atomics`` — fetch-atomics (accumulate/get_accumulate/
+  compare_and_swap/fetch_and_op and the shmem AMO family) applied
+  under the region header's cross-process LOCK WORD.
+- ``osc_direct_bytes`` — payload bytes moved by the direct path (puts
+  + gets + atomics); the ladder's strictly-rising gate.
+- ``osc_am_fallbacks`` — operations a DIRECT-CAPABLE window routed to
+  the active-message path: cross-host targets, revoked channels,
+  known-failed peers, unmappable regions.  Loud, never silent —
+  asserted ZERO along the same-host OSU osc ladder; on mixed
+  topologies it splits exactly against ``osc_direct_*``.  Windows
+  with no region anywhere (plane off, sm off) are plain AM windows
+  and are not counted.
 - ``shmem_puts`` / ``shmem_gets`` / ``shmem_puts_nbi`` / ``shmem_gets_nbi``
   — OpenSHMEM put/get traffic, blocking and nonblocking-implicit.
 - ``pgas_device_epochs`` — device-heap epoch advances (the PGAS

@@ -31,6 +31,7 @@ from ..core import errors
 from ..mca import var as mca_var
 from ..pt2pt.universe import LocalUniverse, RankContext
 from ..runtime import spc
+from . import memheap as memheap_mod
 from .memheap import SymmetricHeapAllocator
 
 _DEFAULT_HEAP = 1 << 20  # 1 MiB per PE; SHMEM_SYMMETRIC_SIZE analog
@@ -161,10 +162,11 @@ class _DirectBackend:
 
     # -- symmetric allocation ---------------------------------------------
 
-    def alloc_collective(self, pe_api: "ShmemPE", nbytes: int) -> int:
+    def alloc_collective(self, pe_api: "ShmemPE", nbytes: int,
+                         align: int = memheap_mod.ALIGN) -> int:
         def action():
             with self._state.alloc_lock:
-                return self._state.allocator.alloc(nbytes)
+                return self._state.allocator.alloc(nbytes, align)
 
         return pe_api._rank0_collective(action)
 
@@ -183,19 +185,24 @@ class _DirectBackend:
 
 
 class _AmBackend:
-    """Wire substrate: the symmetric heap is a local arena attached to a
-    dynamic AM window; remote access is active messages (spml over the
-    network, re-designed on the osc/rdma-analog plane)."""
+    """Wire substrate: the symmetric heap is a local arena attached to
+    a dynamic window; remote access is active messages — EXCEPT to
+    same-host peers, where the arena is an sm-segment RMA region and
+    the whole put/get/``*_nbi``/AMO family rides ``osc/direct.py``'s
+    mapped load/store path (the spml seam of the direct-map plane;
+    ``osc_direct=0`` forces AM everywhere)."""
 
     def __init__(self, ep, heap_bytes: int):
-        from ..osc.am import AmWindow
+        from ..osc.direct import create_dynamic_window
 
         self._ep = ep
         # (request, target buffer, dtype) of get_nbi ops completing at quiet
         self._pending_gets: list[tuple] = []
-        self.arena = np.zeros(heap_bytes, dtype=np.uint8)
-        self._win = AmWindow.create_dynamic(ep)
-        base = self._win.attach(self.arena)
+        self._win = create_dynamic_window(ep)
+        # region-backed when the sm plane is on: the returned arena IS
+        # the mapped region's data bytes, so a same-host peer's direct
+        # stores and this PE's local loads share one coherent mapping
+        base, self.arena = self._win.attach_symmetric(heap_bytes)
         if base != 0:
             raise errors.InternalError(
                 "symmetric arena must be the first attachment"
@@ -277,12 +284,13 @@ class _AmBackend:
 
     # -- symmetric allocation ---------------------------------------------
 
-    def alloc_collective(self, pe_api: "ShmemPE", nbytes: int) -> int:
+    def alloc_collective(self, pe_api: "ShmemPE", nbytes: int,
+                         align: int = memheap_mod.ALIGN) -> int:
         """Every PE advances its own allocator — identical deterministic
         call sequences keep offsets symmetric; the bracketing barriers are
         the shmem_malloc synchronization."""
         self._ep.barrier()
-        off = self._allocator.alloc(nbytes)
+        off = self._allocator.alloc(nbytes, align)
         self._ep.barrier()
         return off
 
@@ -365,13 +373,18 @@ class ShmemPE:
             raise cls(outcome[2])
         return outcome[1]
 
-    def shmalloc(self, shape, dtype=np.float64) -> SymArray:
-        """Collective symmetric allocation (shmem_malloc: synchronizes all
-        PEs; identical offsets fall out of lockstep allocators)."""
+    def shmalloc(self, shape, dtype=np.float64,
+                 align: int | None = None) -> SymArray:
+        """Collective symmetric allocation (shmem_malloc: synchronizes
+        all PEs; identical offsets fall out of lockstep allocators).
+        ``align`` is the shmem_align contract — raise the 64-byte floor
+        (e.g. page alignment); the request sequence stays identical on
+        every PE, so offsets stay symmetric."""
         shape = (shape,) if isinstance(shape, int) else tuple(shape)
         dt = np.dtype(dtype)
         nbytes = int(np.prod(shape or (1,))) * dt.itemsize
-        off = self._backend.alloc_collective(self, nbytes)
+        off = self._backend.alloc_collective(
+            self, nbytes, align if align else memheap_mod.ALIGN)
         return SymArray(off, shape, dt, nbytes, self._backend)
 
     def shfree(self, sym: SymArray) -> None:

@@ -348,7 +348,12 @@ class DeviceHeap:
     def _arena_key(self, dtype) -> str:
         return np.dtype(dtype).str
 
-    def shmalloc(self, shape, dtype) -> DeviceSym:
+    def shmalloc(self, shape, dtype, align: int | None = None
+                 ) -> DeviceSym:
+        """Deterministic symmetric allocation; ``align`` is the
+        shmem_align contract shared with the host backends (one
+        allocator surface across all four spml transports — the same
+        request sequence yields the same offsets on every plane)."""
         from jax.sharding import PartitionSpec as P
 
         if isinstance(shape, int):
@@ -363,7 +368,8 @@ class DeviceHeap:
                 jnp.zeros((n, elems), dtype=dt), P(self.comm.axis)
             )
         nbytes = int(np.prod(shape)) * dt.itemsize
-        off_bytes = self._allocators[key].alloc(nbytes)
+        off_bytes = self._allocators[key].alloc(
+            nbytes, align if align else 64)
         assert off_bytes % dt.itemsize == 0  # ALIGN=64 covers all dtypes
         return DeviceSym(key, off_bytes // dt.itemsize, tuple(shape), dt)
 

@@ -271,9 +271,10 @@ class MmapBackend:
 
     # -- symmetric allocation: lockstep allocators + barriers ------------
 
-    def alloc_collective(self, pe_api, nbytes: int) -> int:
+    def alloc_collective(self, pe_api, nbytes: int,
+                         align: int = 64) -> int:
         self._ep.barrier()
-        off = self._allocator.alloc(nbytes)
+        off = self._allocator.alloc(nbytes, align)
         self._ep.barrier()
         return off
 

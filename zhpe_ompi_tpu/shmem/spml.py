@@ -17,10 +17,14 @@ highest-priority-that-supports-the-endpoint:
   heap).
 - **mmap** (prio 60): socket ranks that are all processes on ONE host —
   mapped tmpfs segments, native atomics (``shmem/segment.py``).
-- **am** (prio 40): any wire endpoint — active-message RMA over the osc
-  plane (``shmem/api.py::_AmBackend``); the only transport that works
-  cross-host, and the fallback whenever mmap's same-host precondition
-  fails.
+- **am** (prio 40): any wire endpoint — the symmetric heap attaches to
+  a dynamic window of the DIRECT-MAP osc plane
+  (``shmem/api.py::_AmBackend`` over ``osc/direct.py``): same-host
+  peers get mapped load/store put/get and lock-word AMOs per the
+  transport-ladder seam decision, everything else rides active
+  messages.  The only transport that works cross-host AND on MIXED
+  topologies (where mmap's all-same-host precondition fails, the
+  same-host subset still gets the direct path).
 
 ``shmem_pe(ep)`` is the shmem_init analog: select, build the backend,
 wrap in a :class:`~zhpe_ompi_tpu.shmem.api.ShmemPE`.
