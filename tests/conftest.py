@@ -131,6 +131,12 @@ def _ulfm_detector_hygiene():
         f"that spawns one owns its stop/kill; --parent children scan "
         f"the same cmdline shape): {zprted}"
     )
+    tickets = dvm_mod.queued_admission_tickets()
+    assert not tickets, (
+        f"admission tickets left queued past the suite (a launch "
+        f"handler died without cancel/release — the queue head is "
+        f"wedged): {tickets}"
+    )
     from zhpe_ompi_tpu.runtime import dvmtree as dvmtree_mod
 
     stale_cache = dvmtree_mod.stale_cache_state()
@@ -138,6 +144,21 @@ def _ulfm_detector_hygiene():
         f"routed-store cache state left at session end (a child "
         f"daemon's leaf cache dies with its daemon's stop(); an open "
         f"routed store past the suite is a leaked tree): {stale_cache}"
+    )
+    placement_audits = dvmtree_mod.placement_audit_failures()
+    assert not placement_audits, (
+        f"placement audits failed during the suite without being "
+        f"cleared by the test that injected them (two live jobs were "
+        f"about to share sessions/namespaces/exclusive subtrees): "
+        f"{placement_audits}"
+    )
+    from zhpe_ompi_tpu.parallel import mesh as mesh_mod
+
+    probers = mesh_mod.live_prober_threads()
+    assert not probers, (
+        f"background device-prober threads left running past their "
+        f"owner's stop() (the always-on prober dies with its loop): "
+        f"{probers}"
     )
     servers = pmix_mod.live_servers()
     assert not servers, (

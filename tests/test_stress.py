@@ -3,6 +3,7 @@ pt2pt traffic over real sockets — the schedule-interleaving torture the
 per-instance tag discipline exists for."""
 
 import numpy as np
+import pytest
 
 from test_tcp import run_tcp
 from zhpe_ompi_tpu import ops as zops
@@ -142,3 +143,27 @@ class TestAsyncIoSoak:
             return True
 
         assert run_tcp(N, prog) == [True] * N
+
+
+class TestZsoakSmoke:
+    @pytest.mark.slow
+    def test_three_cycle_storm_clean(self, tmp_path):
+        """The fault-storm soak harness end to end, small: 3 seeded
+        cycles of overlapping multi-tenant launch/kill/resize/recover
+        on a real daemon tree must finish with ZERO invariant
+        violations (rc 1 and a replay hint otherwise)."""
+        import os
+        import subprocess
+        import sys
+
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        env = dict(os.environ)
+        env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+        env["JAX_PLATFORMS"] = "cpu"
+        res = subprocess.run(
+            [sys.executable, "-m", "zhpe_ompi_tpu.tools.zsoak",
+             "--cycles", "3", "--seed", "3",
+             "--workdir", str(tmp_path)],
+            env=env, capture_output=True, text=True, timeout=300)
+        assert res.returncode == 0, (res.stdout, res.stderr)
+        assert "violations=0" in res.stdout, res.stdout

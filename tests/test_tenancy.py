@@ -1,0 +1,801 @@
+"""Multi-tenant DVM tests — admission queueing, placement isolation,
+the always-on device prober, and the tenant-isolation drill.
+
+Four altitudes:
+
+- **unit (pure threads)**: the admission queue's policy order, cap
+  blocking, dead-client reap, and close-under-waiter semantics;
+  :func:`~zhpe_ompi_tpu.runtime.dvmtree.place_job`'s pack/spread/
+  exclusive ladder and the per-job placement audit's typed violations.
+- **thread-fast daemon integration**: real in-process daemons running
+  cheap non-wire-up rank scripts — FIFO/priority admission order
+  observed end to end, ``[queued, pos]`` frames on the client, the
+  dead-queued-client reap regression over a raw socket, exclusive
+  fallback loud + counted, audit failing a colliding launch.
+- **prober unit**: a fake liveness probe wedged OUTSIDE any guarded
+  region classifies in bounded time; an active region silences the
+  background thread entirely.
+- **slow real-process drill**: two tenants on a daemon tree, a rank of
+  job A killed -9 mid-collective — job B's checked allreduces never
+  see a fault event, both rcs are exactly the fault plan's.
+"""
+
+import io
+import os
+import socket
+import textwrap
+import threading
+import time
+
+import pytest
+
+from zhpe_ompi_tpu.core import errors
+from zhpe_ompi_tpu.mca import var as mca_var
+from zhpe_ompi_tpu.parallel import mesh as mesh_mod
+from zhpe_ompi_tpu.runtime import dvm as dvm_mod
+from zhpe_ompi_tpu.runtime import dvmtree
+from zhpe_ompi_tpu.runtime import spc
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _script(tmp_path, body: str, name: str = "prog.py") -> str:
+    p = tmp_path / name
+    p.write_text(
+        "import sys\n"
+        f"sys.path.insert(0, {_REPO!r})\n" + textwrap.dedent(body)
+    )
+    return str(p)
+
+
+# no zhpe wire-up: admission/placement are daemon-side machinery, so
+# the matrix rides bare scripts (fast) — the slow drill uses real ranks
+_PARK_BODY = """
+import os, time
+deadline = time.monotonic() + 60.0
+while not os.path.exists(sys.argv[1]):
+    assert time.monotonic() < deadline, "parker never released"
+    time.sleep(0.02)
+"""
+
+_APPEND_BODY = """
+with open(sys.argv[1], "a") as f:
+    f.write(sys.argv[2] + chr(10))
+"""
+
+
+def _wait(pred, timeout=30.0, msg="condition never held"):
+    deadline = time.monotonic() + timeout
+    while not pred():
+        assert time.monotonic() < deadline, msg
+        time.sleep(0.02)
+
+
+def _bg_launch(addr, n, argv, **kw):
+    cli = dvm_mod.DvmClient(addr)
+    out, err, res = io.StringIO(), io.StringIO(), {}
+    kw.setdefault("timeout", 60.0)
+
+    def run():
+        try:
+            res["rc"] = cli.launch(n, argv, stdout=out, stderr=err,
+                                   **kw)
+        except errors.MpiError as e:
+            res["error"] = str(e)
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    return {"cli": cli, "thread": t, "out": out, "err": err,
+            "res": res}
+
+
+def _finish(h, timeout=60.0):
+    h["thread"].join(timeout=timeout)
+    assert not h["thread"].is_alive(), (h["out"].getvalue(),
+                                        h["err"].getvalue())
+    h["cli"].close()
+    return h["res"]
+
+
+# ---------------------------------------------------- admission queue (unit)
+
+
+class TestAdmissionQueueUnit:
+    def test_no_cap_admits_immediately(self, fresh_vars):
+        q = dvm_mod._AdmissionQueue()
+        t1, t2 = q.enqueue(), q.enqueue()
+        assert q.admit(t1) is not None
+        assert q.admit(t2) is not None  # cap 0: both run concurrently
+        assert not t1.was_queued and not t2.was_queued
+        assert q.stat_view()["running"] == 2
+        q.release(t1)
+        q.release(t2)
+        assert q.stat_view()["running"] == 0
+
+    def test_cap_blocks_fifo_order(self, fresh_vars):
+        fresh_vars.set("dvm_max_concurrent_jobs", 1)
+        q = dvm_mod._AdmissionQueue()
+        t1 = q.enqueue()
+        assert q.admit(t1) is not None
+        t2, t3 = q.enqueue(), q.enqueue()
+        admitted = []
+        positions = {2: [], 3: []}
+
+        def waiter(ticket, tag):
+            q.admit(ticket,
+                    on_position=lambda p: positions[tag].append(p))
+            admitted.append(tag)
+
+        th2 = threading.Thread(target=waiter, args=(t2, 2), daemon=True)
+        th2.start()
+        _wait(lambda: positions[2] == [1])
+        th3 = threading.Thread(target=waiter, args=(t3, 3), daemon=True)
+        th3.start()
+        _wait(lambda: positions[3] == [2])
+        assert q.stat_view() == {"policy": "fifo", "cap": 1,
+                                 "running": 1, "waiting": 2}
+        assert admitted == []  # both parked while the slot is held
+        q.release(t1)
+        _wait(lambda: admitted == [2])
+        q.release(t2)
+        _wait(lambda: admitted == [2, 3])
+        q.release(t3)
+        assert q.queued() == []
+
+    def test_priority_reorders_live_queue(self, fresh_vars):
+        fresh_vars.set("dvm_max_concurrent_jobs", 1)
+        fresh_vars.set("dvm_admission_policy", "priority")
+        q = dvm_mod._AdmissionQueue()
+        t1 = q.enqueue(priority=0)
+        assert q.admit(t1) is not None
+        low, high = q.enqueue(priority=1), None
+        admitted = []
+        low_pos = []
+
+        def wait_low():
+            q.admit(low, on_position=low_pos.append)
+            admitted.append("low")
+
+        threading.Thread(target=wait_low, daemon=True).start()
+        _wait(lambda: low_pos[-1:] == [1])
+        high = q.enqueue(priority=9)
+
+        def wait_high():
+            q.admit(high)
+            admitted.append("high")
+
+        threading.Thread(target=wait_high, daemon=True).start()
+        # the later, higher-priority ticket jumps the live queue — the
+        # parked low ticket hears its demotion as a position frame
+        _wait(lambda: low_pos[-1:] == [2])
+        q.release(t1)
+        _wait(lambda: admitted == ["high"])
+        q.release(high)
+        _wait(lambda: admitted == ["high", "low"])
+        q.release(low)
+
+    def test_dead_client_ticket_cancelled(self, fresh_vars):
+        fresh_vars.set("dvm_max_concurrent_jobs", 1)
+        q = dvm_mod._AdmissionQueue()
+        t1 = q.enqueue()
+        assert q.admit(t1) is not None
+        t2 = q.enqueue()
+        assert q.admit(t2, alive=lambda: False) is None
+        assert q.queued() == []  # reaped, not wedging the head
+        q.release(t1)
+        q.release(t2)  # idempotent on a cancelled ticket
+
+    def test_close_raises_under_waiter(self, fresh_vars):
+        fresh_vars.set("dvm_max_concurrent_jobs", 1)
+        q = dvm_mod._AdmissionQueue()
+        t1 = q.enqueue()
+        assert q.admit(t1) is not None
+        t2 = q.enqueue()
+        res = {}
+
+        def waiter():
+            try:
+                q.admit(t2)
+            except errors.MpiError as e:
+                res["error"] = str(e)
+
+        th = threading.Thread(target=waiter, daemon=True)
+        th.start()
+        _wait(lambda: q.stat_view()["waiting"] == 1)
+        q.close()
+        th.join(timeout=10.0)
+        assert "stopping" in res["error"]
+        assert q.queued() == []
+        q.release(t1)
+
+
+# ------------------------------------------------ placement ladder (unit)
+
+
+class TestPlacementUnit:
+    DAEMONS = ["h:1", "h:2", "h:3", "h:4"]
+
+    def test_pack_is_block_placement(self):
+        placed, fell_back = dvmtree.place_job(
+            [0, 1], self.DAEMONS, {}, "pack")
+        assert placed == {0: "h:1", 1: "h:3"}
+        assert not fell_back
+
+    def test_spread_claims_least_loaded_minimal_prefix(self):
+        busy = {"h:1": 2, "h:2": 1}
+        placed, fell_back = dvmtree.place_job(
+            [0, 1], self.DAEMONS, busy, "spread")
+        # by_load: h:3, h:4 (idle, attach order), then h:2, h:1 — the
+        # 2-rank job claims exactly the two idle daemons, never
+        # reaching back into the busy tail
+        assert placed == {0: "h:3", 1: "h:4"}
+        assert not fell_back
+
+    def test_spread_tenants_disjoint_while_capacity(self):
+        a, _ = dvmtree.place_job([0, 1], self.DAEMONS, {}, "spread")
+        busy = {d: 1 for d in a.values()}
+        b, _ = dvmtree.place_job([0, 1], self.DAEMONS, busy, "spread")
+        assert not (set(a.values()) & set(b.values())), (a, b)
+
+    def test_spread_oversubscribed_covers_whole_tree(self):
+        placed, _ = dvmtree.place_job(
+            list(range(8)), self.DAEMONS, {}, "spread")
+        assert set(placed.values()) == set(self.DAEMONS)
+
+    def test_exclusive_claims_minimal_free_prefix(self):
+        busy = {"h:1": 1}
+        placed, fell_back = dvmtree.place_job(
+            [0], self.DAEMONS, busy, "exclusive")
+        assert placed == {0: "h:2"}  # one rank claims ONE free daemon
+        assert not fell_back
+
+    def test_exclusive_fallback_when_no_free_daemon(self):
+        busy = {d: 1 for d in self.DAEMONS}
+        placed, fell_back = dvmtree.place_job(
+            [0, 1], self.DAEMONS, busy, "exclusive")
+        assert fell_back
+        assert set(placed.values()) <= set(self.DAEMONS)
+
+    def test_unknown_policy_typed(self):
+        with pytest.raises(errors.ArgError, match="unknown policy"):
+            dvmtree.place_job([0], self.DAEMONS, {}, "anywhere")
+
+    def test_empty_tree_typed(self):
+        with pytest.raises(errors.InternalError, match="no daemons"):
+            dvmtree.place_job([0], [], {}, "pack")
+
+
+class TestPlacementAudit:
+    def _jobs(self):
+        a = {"id": "job1", "session": "d1_job1", "daemons": ["h:1"],
+             "exclusive": False}
+        b = {"id": "job2", "session": "d1_job2", "daemons": ["h:2"],
+             "exclusive": False}
+        return a, b
+
+    def test_disjoint_tenants_pass(self):
+        a, b = self._jobs()
+        dvmtree.audit_placement(a, [b])  # no raise, nothing recorded
+        assert dvmtree.placement_audit_failures() == []
+
+    def test_namespace_collision_typed_counted(self):
+        a, b = self._jobs()
+        b["id"] = a["id"]
+        before = spc.read("dvm_placement_audit_failures")
+        try:
+            with pytest.raises(errors.PlacementViolation,
+                               match="cid windows") as ei:
+                dvmtree.audit_placement(a, [b])
+            assert ei.value.prop == "namespace"
+            assert dvmtree.placement_audit_failures()
+            assert spc.read("dvm_placement_audit_failures") \
+                == before + 1
+        finally:
+            dvmtree.clear_placement_audit_failures()
+
+    def test_session_prefix_collision_typed(self):
+        a, b = self._jobs()
+        b["session"] = a["session"] + "_sub"  # sweep-prefix overlap
+        try:
+            with pytest.raises(errors.PlacementViolation,
+                               match="sm segments") as ei:
+                dvmtree.audit_placement(a, [b])
+            assert ei.value.prop == "session"
+        finally:
+            dvmtree.clear_placement_audit_failures()
+
+    def test_exclusive_subtree_overlap_typed(self):
+        a, b = self._jobs()
+        a["exclusive"] = True
+        b["daemons"] = ["h:1", "h:2"]
+        try:
+            with pytest.raises(errors.PlacementViolation,
+                               match="exclusive subtree") as ei:
+                dvmtree.audit_placement(a, [b])
+            assert ei.value.prop == "subtree"
+            assert set(ei.value.jobs) == {"job1", "job2"}
+        finally:
+            dvmtree.clear_placement_audit_failures()
+
+
+# ----------------------------------------- /dev/shm sweep isolation (unit)
+
+
+class TestSweepIsolation:
+    """The cross-tenant sweep property (and why it needed no fix): the
+    sweep keys on ``<prefix>_{session}_`` WITH the trailing
+    underscore, so ``job1`` can never reach ``job10``'s files — only a
+    prefix-with-underscore session relation could, and the placement
+    audit rejects exactly that shape."""
+
+    def test_sibling_job_sessions_never_collide(self):
+        assert not dvmtree._sessions_collide("d1_job1", "d1_job10")
+        assert not dvmtree._sessions_collide("d1_job2", "d1_job21")
+
+    def test_colliding_shapes(self):
+        assert dvmtree._sessions_collide("d1_job1", "d1_job1")
+        assert dvmtree._sessions_collide("d1_job1", "d1_job1_x")
+        assert dvmtree._sessions_collide("d1_job1_x", "d1_job1")
+
+    @pytest.mark.skipif(not os.path.isdir("/dev/shm"),
+                        reason="no /dev/shm")
+    def test_sweep_respects_sibling_tenant_files(self):
+        mine = "/dev/shm/zompi_ring_ztenancy_job1_0_0"
+        sibling = "/dev/shm/zompi_ring_ztenancy_job10_0_0"
+        for p in (mine, sibling):
+            with open(p, "w"):
+                pass
+        try:
+            dvm_mod._sweep_shm("ztenancy_job1")
+            assert not os.path.exists(mine)
+            assert os.path.exists(sibling), \
+                "job1's sweep reached job10's segment"
+        finally:
+            for p in (mine, sibling):
+                try:
+                    os.unlink(p)
+                except OSError:
+                    pass
+
+
+# -------------------------------------- daemon integration (thread-fast)
+
+
+class TestAdmissionDaemon:
+    def _park(self, tmp_path, addr, flag):
+        prog = _script(tmp_path, _PARK_BODY, name="park.py")
+        h = _bg_launch(addr, 1, [prog, flag])
+        _wait(lambda: h["cli"].last_job_id is not None
+              or not h["thread"].is_alive(),
+              msg="parker job never started")
+        return h
+
+    def test_fifo_order_and_queued_frames(self, tmp_path, fresh_vars):
+        fresh_vars.set("dvm_max_concurrent_jobs", 1)
+        append = _script(tmp_path, _APPEND_BODY, name="append.py")
+        log = str(tmp_path / "order.log")
+        flag = str(tmp_path / "flag")
+        q0 = spc.read("dvm_jobs_queued")
+        d = dvm_mod.Dvm()
+        try:
+            parker = self._park(tmp_path, d.address, flag)
+            h2 = _bg_launch(d.address, 1, [append, log, "J2"])
+            _wait(lambda: h2["cli"].last_queue_position == 1)
+            h3 = _bg_launch(d.address, 1, [append, log, "J3"])
+            _wait(lambda: h3["cli"].last_queue_position == 2)
+            stat = dvm_mod.DvmClient(d.address)
+            view = stat.stat()["admission"]
+            stat.close()
+            assert view == {"policy": "fifo", "cap": 1, "running": 1,
+                            "waiting": 2}
+            assert "queued at position 1" in h2["err"].getvalue()
+            assert "queued at position 2" in h3["err"].getvalue()
+            with open(flag, "w"):
+                pass
+            assert _finish(parker)["rc"] == 0
+            assert _finish(h2)["rc"] == 0
+            assert _finish(h3)["rc"] == 0
+            with open(log) as f:
+                assert f.read().split() == ["J2", "J3"]
+            assert spc.read("dvm_jobs_queued") - q0 == 2
+            assert spc.read("dvm_queue_wait_ms") >= 0  # watermark set
+        finally:
+            d.stop()
+        assert dvm_mod.queued_admission_tickets() == []
+
+    def test_priority_preempts_fifo(self, tmp_path, fresh_vars):
+        fresh_vars.set("dvm_max_concurrent_jobs", 1)
+        fresh_vars.set("dvm_admission_policy", "priority")
+        append = _script(tmp_path, _APPEND_BODY, name="append.py")
+        log = str(tmp_path / "order.log")
+        flag = str(tmp_path / "flag")
+        d = dvm_mod.Dvm()
+        try:
+            parker = self._park(tmp_path, d.address, flag)
+            h_low = _bg_launch(d.address, 1, [append, log, "LOW"],
+                               priority=1)
+            _wait(lambda: h_low["cli"].last_queue_position == 1)
+            h_high = _bg_launch(d.address, 1, [append, log, "HIGH"],
+                                priority=9)
+            # the high-priority launch takes the head; the parked low
+            # launch hears its demotion as a fresh [queued, 2] frame
+            _wait(lambda: h_low["cli"].last_queue_position == 2)
+            assert h_high["cli"].last_queue_position == 1
+            with open(flag, "w"):
+                pass
+            assert _finish(parker)["rc"] == 0
+            assert _finish(h_high)["rc"] == 0
+            assert _finish(h_low)["rc"] == 0
+            with open(log) as f:
+                assert f.read().split() == ["HIGH", "LOW"]
+        finally:
+            d.stop()
+        assert dvm_mod.queued_admission_tickets() == []
+
+    def test_queued_launch_holds_no_setup_lock(self, tmp_path,
+                                               fresh_vars):
+        """Respawn/resize take setup() directly — they ride their
+        job's admission.  A QUEUED launch must therefore hold no lock
+        at all, or a parked launch would wedge a running job's
+        recovery."""
+        fresh_vars.set("dvm_max_concurrent_jobs", 1)
+        append = _script(tmp_path, _APPEND_BODY, name="append.py")
+        flag = str(tmp_path / "flag")
+        d = dvm_mod.Dvm()
+        try:
+            parker = self._park(tmp_path, d.address, flag)
+            h2 = _bg_launch(d.address, 1,
+                            [append, str(tmp_path / "l"), "X"])
+            _wait(lambda: h2["cli"].last_queue_position == 1)
+            lock = d._admission.setup()
+            assert lock.acquire(timeout=2.0), \
+                "a queued launch holds the setup lock"
+            lock.release()
+            with open(flag, "w"):
+                pass
+            assert _finish(parker)["rc"] == 0
+            assert _finish(h2)["rc"] == 0
+        finally:
+            d.stop()
+
+    def test_dead_queued_client_reaped(self, tmp_path, fresh_vars):
+        """The satellite regression: connect, queue behind a running
+        job, DIE.  The dead ticket must be reaped — the next launch
+        admits instead of wedging behind a ghost at the queue head."""
+        from zhpe_ompi_tpu.pt2pt.tcp import _recv_frame, _send_frame
+        from zhpe_ompi_tpu.utils import dss
+
+        fresh_vars.set("dvm_max_concurrent_jobs", 1)
+        append = _script(tmp_path, _APPEND_BODY, name="append.py")
+        flag = str(tmp_path / "flag")
+        launched0 = spc.read("dvm_jobs_launched")
+        d = dvm_mod.Dvm()
+        try:
+            parker = self._park(tmp_path, d.address, flag)
+            # a raw launch client: parks in the queue, then dies
+            s = socket.create_connection(d.address, 10.0)
+            prog = _script(tmp_path, _APPEND_BODY, name="a2.py")
+            _send_frame(s, dss.pack(["launch", {
+                "n": 1, "argv": [prog, str(tmp_path / "ghost"), "G"],
+                "mca": [], "ft": False, "timeout": 30.0}]))
+            deadline = time.monotonic() + 30.0
+            while True:
+                frame = _recv_frame(s)
+                assert frame is not None and \
+                    time.monotonic() < deadline
+                [msg] = dss.unpack(frame)
+                if msg[0] == "queued":
+                    break
+            s.close()  # the client is gone; its ticket must not wedge
+            _wait(lambda: dvm_mod.queued_admission_tickets() == [],
+                  msg="dead client's ticket never reaped")
+            h3 = _bg_launch(d.address, 1,
+                            [append, str(tmp_path / "l3"), "J3"])
+            with open(flag, "w"):
+                pass
+            assert _finish(parker)["rc"] == 0
+            assert _finish(h3)["rc"] == 0
+            # the ghost's job never launched — only parker + J3 did
+            assert spc.read("dvm_jobs_launched") - launched0 == 2
+            assert not os.path.exists(str(tmp_path / "ghost"))
+        finally:
+            d.stop()
+        assert dvm_mod.queued_admission_tickets() == []
+
+
+class TestPlacementDaemon:
+    def test_spread_tenants_disjoint_subtrees(self, tmp_path):
+        park = _script(tmp_path, _PARK_BODY, name="park.py")
+        flag = str(tmp_path / "flag")
+        tree = dvmtree.spawn_tree(4, in_process=True)
+        try:
+            addr = tree.root_address
+            h1 = _bg_launch(addr, 2, [park, flag], placement="spread")
+            _wait(lambda: h1["cli"].last_job_id is not None)
+            h2 = _bg_launch(addr, 2, [park, flag], placement="spread")
+            _wait(lambda: h2["cli"].last_job_id is not None)
+            cli = dvm_mod.DvmClient(addr)
+            jobs = cli.stat()["jobs"]
+            cli.close()
+            d1 = {d for _, d in jobs[h1["cli"].last_job_id]["placement"]}
+            d2 = {d for _, d in jobs[h2["cli"].last_job_id]["placement"]}
+            assert d1 and d2 and not (d1 & d2), (d1, d2)
+            with open(flag, "w"):
+                pass
+            assert _finish(h1)["rc"] == 0
+            assert _finish(h2)["rc"] == 0
+        finally:
+            tree.stop()
+        assert dvmtree.placement_audit_failures() == []
+
+    def test_exclusive_fallback_loud_and_counted(self, tmp_path):
+        """One daemon, one live pack tenant: an exclusive launch finds
+        no free daemon — it must fall back to spread LOUDLY (a note
+        frame + dvm_placement_fallbacks), never silently, and never as
+        an audit failure (capacity, not collision)."""
+        park = _script(tmp_path, _PARK_BODY, name="park.py")
+        flag = str(tmp_path / "flag")
+        fb0 = spc.read("dvm_placement_fallbacks")
+        d = dvm_mod.Dvm()
+        try:
+            h1 = _bg_launch(d.address, 1, [park, flag])
+            _wait(lambda: h1["cli"].last_job_id is not None)
+            h2 = _bg_launch(d.address, 1, [park, flag],
+                            placement="exclusive")
+            _wait(lambda: h2["cli"].last_job_id is not None)
+            assert "falling back to spread" in h2["err"].getvalue()
+            assert spc.read("dvm_placement_fallbacks") - fb0 == 1
+            with open(flag, "w"):
+                pass
+            assert _finish(h1)["rc"] == 0
+            assert _finish(h2)["rc"] == 0
+        finally:
+            d.stop()
+        assert dvmtree.placement_audit_failures() == []
+
+    def test_exclusive_tenant_protected_by_audit(self, tmp_path):
+        """An exclusive tenant HOLDS its subtree: a later launch whose
+        fallback would land on it must fail loudly with the typed
+        audit violation, not silently co-locate."""
+        park = _script(tmp_path, _PARK_BODY, name="park.py")
+        flag = str(tmp_path / "flag")
+        d = dvm_mod.Dvm()
+        try:
+            h1 = _bg_launch(d.address, 1, [park, flag],
+                            placement="exclusive")
+            _wait(lambda: h1["cli"].last_job_id is not None)
+            with pytest.raises(errors.MpiError,
+                               match="exclusive subtree"):
+                cli = dvm_mod.DvmClient(d.address)
+                try:
+                    cli.launch(1, [park, flag], placement="exclusive",
+                               timeout=30.0, stdout=io.StringIO(),
+                               stderr=io.StringIO())
+                finally:
+                    cli.close()
+            assert dvmtree.placement_audit_failures()
+            with open(flag, "w"):
+                pass
+            assert _finish(h1)["rc"] == 0
+        finally:
+            dvmtree.clear_placement_audit_failures()  # intentional trip
+            d.stop()
+        assert dvm_mod.queued_admission_tickets() == []
+
+
+# --------------------------------------------- device prober (thread-fast)
+
+
+class _FakeProbe:
+    """DeviceLivenessProbe stand-in: probe_once() reports the wedge
+    flag, classify() records and latches ``fault`` (the real probe's
+    recovery-owns-the-plane contract)."""
+
+    def __init__(self):
+        self.rank = 0
+        self.fault = None
+        self.probes = 0
+        self.wedged = False
+        self.classified = []
+
+    def probe_once(self):
+        self.probes += 1
+        return ("hung", "fake-wedge") if self.wedged else ("ok", "")
+
+    def classify(self, kind, detail):
+        self.classified.append((kind, detail))
+        self.fault = errors.DeviceFault(
+            detail, failed_ranks=(self.rank,), kind=kind)
+
+
+class TestDeviceProber:
+    def test_interval_zero_is_off(self):
+        probe = _FakeProbe()
+        prober = mesh_mod.DeviceProber(probe, interval_ms=0)
+        prober.start()
+        assert not prober.running
+        assert mesh_mod.live_prober_threads() == []
+
+    def test_out_of_region_wedge_classifies_bounded(self):
+        probe = _FakeProbe()
+        prober = mesh_mod.DeviceProber(probe, interval_ms=10)
+        p0 = spc.read("device_probes")
+        f0 = spc.read("device_probe_faults")
+        prober.start()
+        try:
+            assert prober.running
+            _wait(lambda: probe.probes >= 2, timeout=5.0,
+                  msg="background prober never probed")
+            probe.wedged = True
+            _wait(lambda: probe.classified, timeout=5.0,
+                  msg="out-of-region wedge never classified")
+            assert probe.classified[0][0] == "hung"
+            time.sleep(0.1)
+            # the latched fault gates re-classification: recovery owns
+            # the plane until it clears
+            assert len(probe.classified) == 1
+            assert spc.read("device_probes") - p0 >= 2
+            assert spc.read("device_probe_faults") - f0 == 1
+        finally:
+            prober.stop()
+        assert mesh_mod.live_prober_threads() == []
+
+    def test_region_silences_background_probing(self):
+        probe = _FakeProbe()
+        prober = mesh_mod.DeviceProber(probe, interval_ms=10)
+        prober.start()
+        try:
+            with prober.region():
+                time.sleep(0.05)  # let any in-flight probe drain
+                before = probe.probes
+                time.sleep(0.15)
+                assert probe.probes == before, \
+                    "prober probed inside a guarded region"
+            _wait(lambda: probe.probes > before, timeout=5.0,
+                  msg="prober never resumed after the region")
+        finally:
+            prober.stop()
+        assert mesh_mod.live_prober_threads() == []
+
+    def test_region_wraps_inner_guard(self):
+        probe = _FakeProbe()
+        prober = mesh_mod.DeviceProber(probe, interval_ms=0)
+        entered = []
+
+        class _Guard:
+            def __enter__(self):
+                entered.append("in")
+
+            def __exit__(self, *a):
+                entered.append("out")
+
+        with prober.region(_Guard()):
+            assert entered == ["in"]
+            assert prober._busy == 1
+        assert entered == ["in", "out"]
+        assert prober._busy == 0
+
+
+# ------------------------------------------ two-tenant drill (slow, real)
+
+
+_TENANT_A_BODY = """
+import os, time
+import numpy as np
+import zhpe_ompi_tpu as zmpi
+from zhpe_ompi_tpu import ops
+
+victim = int(sys.argv[1])
+proc = zmpi.host_init()
+proc.barrier()
+print(f"READY rank={proc.rank}", flush=True)
+if proc.rank == victim:
+    time.sleep(300.0)
+    raise SystemExit(0)
+deadline = time.monotonic() + 60.0
+while time.monotonic() < deadline:
+    if proc.ft_state.is_failed(victim):
+        break
+    time.sleep(0.01)
+else:
+    raise SystemExit(1)
+cause = proc.ft_state.cause_of(victim)
+proc.failure_ack()
+sh = proc.shrink()
+total = float(np.asarray(sh.allreduce(np.float64(1.0), ops.SUM)))
+print(f"SURVIVOR-OK rank={proc.rank} cause={cause} total={total}",
+      flush=True)
+zmpi.host_finalize()
+"""
+
+_TENANT_B_BODY = """
+import os, time
+import numpy as np
+import zhpe_ompi_tpu as zmpi
+from zhpe_ompi_tpu import ops
+
+flag = sys.argv[1]
+proc = zmpi.host_init()
+proc.barrier()
+print(f"READY rank={proc.rank}", flush=True)
+iters = 0
+deadline = time.monotonic() + 90.0
+while True:
+    assert time.monotonic() < deadline, "never released"
+    # the stop decision rides the allreduce (rank 0 polls the flag,
+    # contributes +1): every rank leaves in the SAME iteration, so no
+    # rank is abandoned mid-collective by a peer that saw the flag
+    stop = proc.rank == 0 and os.path.exists(flag)
+    total = float(np.asarray(proc.allreduce(
+        np.float64(2.0 if stop else 1.0), ops.SUM)))
+    assert total in (float(proc.size), float(proc.size) + 1.0), \\
+        (total, proc.size)
+    iters += 1
+    if total > float(proc.size):
+        break
+    time.sleep(0.02)
+assert not proc.ft_state.failed(), proc.ft_state.failed()
+from zhpe_ompi_tpu.runtime import spc
+assert spc.read("dvm_fault_events") == 0, "tenant saw a foreign fault"
+print(f"CLEAN-OK rank={proc.rank} iters={iters}", flush=True)
+zmpi.host_finalize()
+"""
+
+
+@pytest.mark.slow
+class TestTwoTenantDrill:
+    def test_fault_in_job_a_invisible_to_job_b(self, tmp_path):
+        """Kill -9 a rank of tenant A mid-collective-loop: tenant B —
+        ft too, checked allreduces the whole window, disjoint
+        exclusive subtree — must see ZERO fault events and ZERO
+        detector suspicions; both rcs are exactly the fault plan's."""
+        import signal as sig
+
+        prog_a = _script(tmp_path, _TENANT_A_BODY, name="a.py")
+        prog_b = _script(tmp_path, _TENANT_B_BODY, name="b.py")
+        flag = str(tmp_path / "flag")
+        victim = 1
+        mca = [("ft_detector_period", "2.0"),
+               ("ft_detector_timeout", "60.0")]
+        tree = dvmtree.spawn_tree(3, in_process=True)
+        try:
+            addr = tree.root_address
+            h_b = _bg_launch(addr, 2, [prog_b, flag], ft=True, mca=mca,
+                             placement="spread", timeout=150.0)
+            _wait(lambda: h_b["out"].getvalue().count("READY") == 2,
+                  timeout=60.0)
+            h_a = _bg_launch(addr, 2, [prog_a, str(victim)], ft=True,
+                             mca=mca, placement="exclusive",
+                             timeout=150.0)
+            _wait(lambda: h_a["out"].getvalue().count("READY") == 2,
+                  timeout=60.0)
+            cli = dvm_mod.DvmClient(addr)
+            jobs = cli.stat()["jobs"]
+            da = {d for _, d in
+                  jobs[h_a["cli"].last_job_id]["placement"]}
+            db = {d for _, d in
+                  jobs[h_b["cli"].last_job_id]["placement"]}
+            assert da and db and not (da & db), (da, db)
+            pid = cli.pids(h_a["cli"].last_job_id)[victim]
+            os.kill(pid, sig.SIGKILL)
+            cli.close()
+            _wait(lambda: "SURVIVOR-OK" in h_a["out"].getvalue(),
+                  timeout=90.0)
+            with open(flag, "w"):
+                pass
+            res_a = _finish(h_a, timeout=120.0)
+            res_b = _finish(h_b, timeout=120.0)
+            # A's rc carries the victim's 128+SIGKILL; B is spotless
+            assert res_a["rc"] == 137, (res_a, h_a["out"].getvalue())
+            assert res_b["rc"] == 0, (res_b, h_b["out"].getvalue())
+            text_a = h_a["out"].getvalue()
+            assert "SURVIVOR-OK rank=0 cause=daemon total=1.0" \
+                in text_a, text_a
+            text_b = h_b["out"].getvalue() + h_b["err"].getvalue()
+            assert "CLEAN-OK rank=0" in text_b, text_b
+            for needle in ("SURVIVOR", "fault"):
+                assert needle not in text_b, (needle, text_b)
+        finally:
+            tree.stop()
+        assert dvmtree.placement_audit_failures() == []
+        assert dvm_mod.queued_admission_tickets() == []

@@ -207,6 +207,22 @@ class DeviceFault(ProcFailed):
         self.kind = str(kind)
 
 
+class PlacementViolation(InternalError):
+    """A multi-tenant placement audit failed: two live jobs on one DVM
+    tree were caught sharing state that the tenancy contract requires
+    disjoint — sm-segment session prefixes, PMIx namespaces, or (for
+    exclusive placements) daemon subtrees.  Typed so the daemon can
+    count it (``dvm_placement_audit_failures``) and fail the offending
+    launch loudly rather than let two tenants corrupt each other.
+    Carries the two job ids and which property collided."""
+
+    def __init__(self, message: str = "", jobs=(),
+                 prop: str = "unknown"):
+        super().__init__(message)
+        self.jobs = tuple(str(j) for j in jobs)
+        self.prop = str(prop)
+
+
 class Revoked(MpiError):
     """MPIX_ERR_REVOKED: the communicator (cid) was revoked — every
     pending and future operation on it must raise on all live ranks."""

@@ -475,3 +475,63 @@ def respawn_rank(uni, rank: int, fn: Callable[[Any], Any],
 
     return spawn_replacement(second_life, rank=rank, context=ctx,
                              name=name or f"respawn-uni-{rank}")
+
+
+# -- MTTR postmortem (the soak harness's per-fault leg extraction) ----------
+
+
+def mttr_legs(window: list[dict], anchors: tuple[float, int],
+              job: str | None = None) -> list[dict]:
+    """Per-fault recovery legs out of a flight-recorder window.
+
+    ``window`` is :func:`~zhpe_ompi_tpu.runtime.flightrec.window`
+    output (monotonic-ns stamped typed events) and ``anchors`` the
+    matching :func:`~zhpe_ompi_tpu.runtime.flightrec.anchors` pair, so
+    each leg maps onto the wall clock the soak harness's own stamps
+    live on.  For every fault classification event (``daemon_fault`` /
+    ``device_fault``, optionally filtered to one ``job``) the walk
+    collects the FIRST of each recovery-leg event that follows it for
+    the same job — ``respawn`` (the relaunch RPC batch) and ``resize``
+    split by kind into ``shrink``/``grow`` — as milliseconds since the
+    classification.  Report-only by design: the legs a 1-CPU container
+    measures are ordering truth, not latency truth."""
+    anchor_wall, anchor_mono_ns = anchors
+
+    def wall(t_ns: int) -> float:
+        return anchor_wall + (int(t_ns) - anchor_mono_ns) / 1e9
+
+    faults: list[dict] = []
+    for i, evt in enumerate(window):
+        if evt.get("type") not in (flightrec.DAEMON_FAULT,
+                                   flightrec.DEVICE_FAULT):
+            continue
+        if job is not None and evt.get("job") not in (None, job):
+            continue
+        t0 = int(evt["t_ns"])
+        rec = {
+            "job": evt.get("job"),
+            "cause": evt.get("cause", evt.get("kind", "?")),
+            "deaths": evt.get("deaths", evt.get("rank")),
+            "t_fault": wall(t0),
+            "legs_ms": {},
+        }
+        for later in window[i + 1:]:
+            if job is not None and later.get("job") not in (None, job) \
+                    or evt.get("job") is not None \
+                    and later.get("job") is not None \
+                    and later["job"] != evt["job"]:
+                continue
+            etype = later.get("type")
+            leg = None
+            if etype == flightrec.RESPAWN:
+                leg = "respawn"
+            elif etype == flightrec.RESIZE:
+                leg = "shrink" if later.get("kind") == "shrink" \
+                    else "grow"
+            elif etype in (flightrec.DAEMON_FAULT,
+                           flightrec.DEVICE_FAULT):
+                break  # next fault: its own record owns what follows
+            if leg is not None and leg not in rec["legs_ms"]:
+                rec["legs_ms"][leg] = (int(later["t_ns"]) - t0) / 1e6
+        faults.append(rec)
+    return faults

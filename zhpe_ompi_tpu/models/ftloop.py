@@ -58,7 +58,8 @@ class FtTrainLoop:
 
     def __init__(self, proc, *, step_fn: Callable, state: Any,
                  checkpointer, ckpt_every: int = 1, probe=None,
-                 wedge=None, respawner: Callable | None = None,
+                 prober=None, wedge=None,
+                 respawner: Callable | None = None,
                  remesh_fn: Callable | None = None,
                  shardings_fn: Callable | None = None,
                  rejoin_timeout: float = 30.0):
@@ -71,6 +72,12 @@ class FtTrainLoop:
         self.ckpt = checkpointer
         self.ckpt_every = max(1, int(ckpt_every))
         self.probe = probe
+        # the always-on half (parallel/mesh.DeviceProber): armed for
+        # run()'s whole extent, quiet inside guarded regions and the
+        # recovery leg (region() brackets both), probing the gaps —
+        # data loading, checkpoint writes — where a wedge would
+        # otherwise wait for the next collective to classify
+        self.prober = prober
         self.wedge = wedge
         self.respawner = respawner
         self.remesh_fn = remesh_fn
@@ -113,9 +120,13 @@ class FtTrainLoop:
     # -- the loop ----------------------------------------------------------
 
     def _guard(self):
-        if self.probe is not None:
-            return self.probe.guard()
-        return contextlib.nullcontext()
+        inner = self.probe.guard() if self.probe is not None \
+            else contextlib.nullcontext()
+        if self.prober is not None:
+            # the guarded region silences the background prober (its
+            # watchdog owns this window); the guard still arms inside
+            return self.prober.region(inner)
+        return inner
 
     def _checkpoint(self) -> None:
         # blocking: the step boundary IS the quiescent point, and a
@@ -143,39 +154,55 @@ class FtTrainLoop:
         if self.step_i == 0 and self.ckpt.latest_step() is None:
             self._checkpoint()  # step-0 snapshot: a fault before the
             # first interval still has a rollback point
-        while self.step_i < steps:
-            try:
-                with self._guard():
-                    if self.wedge is not None:
-                        self.wedge.tick()
-                    self.state, loss = self.step_fn(
-                        self.live, self.state, self.step_i)
-                self.step_i += 1
-                self.losses.append(float(loss))
-                if self.step_i % self.ckpt_every == 0 \
-                        or self.step_i == steps:
-                    self._checkpoint()
-            except errors.DeviceFault as e:
-                if self.proc.rank in e.failed_ranks:
-                    raise  # THIS rank is the corpse: no survivor act
-                self._recover()
-            except (errors.ProcFailed, errors.ProcFailedPending,
-                    errors.Revoked):
-                # Revoked: a FELLOW survivor observed the fault first
-                # and revoked the live window to unblock this rank's
-                # parked collective — same recovery, different messenger
-                self._recover()
-        # training done: one barrier before the caller finalizes, so a
-        # fast rank's goodbye can never poison a peer still receiving
-        # the last step's contributions (finalize skew — the same race
-        # the DVM exit-frame fix closes one layer down)
-        barrier = getattr(self.live, "barrier", None)
-        if callable(barrier):
-            barrier()
+        if self.prober is not None:
+            self.prober.start()
+        try:
+            while self.step_i < steps:
+                try:
+                    with self._guard():
+                        if self.wedge is not None:
+                            self.wedge.tick()
+                        self.state, loss = self.step_fn(
+                            self.live, self.state, self.step_i)
+                    self.step_i += 1
+                    self.losses.append(float(loss))
+                    if self.step_i % self.ckpt_every == 0 \
+                            or self.step_i == steps:
+                        self._checkpoint()
+                except errors.DeviceFault as e:
+                    if self.proc.rank in e.failed_ranks:
+                        raise  # THIS rank is the corpse: no survivor
+                        # act
+                    self._recover()
+                except (errors.ProcFailed, errors.ProcFailedPending,
+                        errors.Revoked):
+                    # Revoked: a FELLOW survivor observed the fault
+                    # first and revoked the live window to unblock this
+                    # rank's parked collective — same recovery,
+                    # different messenger
+                    self._recover()
+            # training done: one barrier before the caller finalizes,
+            # so a fast rank's goodbye can never poison a peer still
+            # receiving the last step's contributions (finalize skew —
+            # the same race the DVM exit-frame fix closes one layer
+            # down)
+            barrier = getattr(self.live, "barrier", None)
+            if callable(barrier):
+                barrier()
+        finally:
+            if self.prober is not None:
+                self.prober.stop()
         return self.state, self.losses
 
     def _recover(self) -> None:
-        """The pipeline, end to end, collectively over the survivors."""
+        """The pipeline, end to end, collectively over the survivors.
+        Runs inside a prober region: the background prober must not
+        classify fresh faults against a plane mid-remesh."""
+        with (self.prober.region() if self.prober is not None
+              else contextlib.nullcontext()):
+            self._recover_inner()
+
+    def _recover_inner(self) -> None:
         if self.respawner is None:
             raise errors.UnsupportedError(
                 "FtTrainLoop: a typed fault arrived with no respawner "

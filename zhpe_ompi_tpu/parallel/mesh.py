@@ -13,6 +13,8 @@ from __future__ import annotations
 
 import contextlib
 import os
+import threading
+import weakref
 
 import numpy as np
 
@@ -305,3 +307,132 @@ class DeviceLivenessProbe:
         )
         wd_box.append(wd)
         return wd
+
+
+# -- the always-on background prober (the fleet-health half) ----------------
+
+mca_var.register(
+    "dvm_device_probe_interval_ms", 0,
+    "Interval (milliseconds) of the ALWAYS-ON background device "
+    "prober (DeviceProber): between guarded regions it runs the same "
+    "killable-child liveness probe the guard runs, so a wedge that "
+    "lands OUTSIDE a guarded region still classifies (cause=\"device\","
+    " the typed DeviceFault path) within one interval plus one probe "
+    "timeout instead of at the next collective; 0 (the default) = off",
+    type=int,
+)
+
+_live_probers: weakref.WeakSet = weakref.WeakSet()
+
+
+def live_prober_threads() -> list[str]:
+    """Background prober threads still RUNNING — must be [] once every
+    owner stopped its prober (the conftest session gate; a stopped
+    prober's thread finishing one last probe call is not a leak, the
+    deadline-watchdog contract)."""
+    out = []
+    for p in list(_live_probers):
+        t = p._thread
+        if t is not None and t.is_alive() and not p._stop.is_set():
+            out.append(t.name)
+    return out
+
+
+class DeviceProber:
+    """Detector-style background device prober — the always-on half of
+    the device fault loop.  The :class:`DeviceLivenessProbe` guard only
+    watches INSIDE guarded regions (a train step); a device plane that
+    wedges between steps — data loading, checkpointing, an idle serving
+    process — classifies only at the NEXT collective.  This thread
+    probes on ``dvm_device_probe_interval_ms`` whenever no guarded
+    region is active (:meth:`region` brackets them), feeding the same
+    typed ``DeviceFault``/FailureState path via the probe's
+    ``classify``, so an out-of-region wedge classifies in bounded time
+    (one interval + one probe timeout).
+
+    Counters: every background round records ``device_probes``; a miss
+    records ``device_probe_faults`` (on top of the probe family's own
+    ``device_probe_rounds``/``device_probe_misses``).  Hygiene:
+    :func:`live_prober_threads` must be [] once owners stop — the
+    models/ftloop seam starts the prober at ``run()`` entry and stops
+    it on the way out."""
+
+    def __init__(self, probe: DeviceLivenessProbe,
+                 interval_ms: int | None = None):
+        self.probe = probe
+        ms = int(mca_var.get("dvm_device_probe_interval_ms", 0)) \
+            if interval_ms is None else int(interval_ms)
+        self.interval_s = ms / 1000.0
+        self._stop = threading.Event()
+        self._busy = 0
+        self._busy_lock = threading.Lock()
+        self._thread: threading.Thread | None = None
+        _live_probers.add(self)
+
+    @property
+    def running(self) -> bool:
+        return self._thread is not None and self._thread.is_alive() \
+            and not self._stop.is_set()
+
+    def start(self) -> "DeviceProber":
+        """Arm the background thread; a no-op when the interval is 0
+        (the opt-in gate) or the prober already runs."""
+        if self.interval_s <= 0 or self.running:
+            return self
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, daemon=True,
+            name=f"device-prober-{self.probe.rank}",
+        )
+        self._thread.start()
+        return self
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            with self._busy_lock:
+                busy = self._busy > 0
+            if busy or self.probe.fault is not None:
+                # a guarded region owns this window (its watchdog
+                # classifies), or a fault already classified and the
+                # recovery path owns the plane until it clears
+                continue
+            kind, detail = self.probe.probe_once()
+            spc.record("device_probes")
+            if self._stop.is_set():
+                return  # outcome after stop is dropped (watchdog rule)
+            with self._busy_lock:
+                busy = self._busy > 0
+            if busy:
+                continue  # a region started mid-probe: its guard owns it
+            if kind in ("hung", "deadline"):
+                spc.record("device_probe_faults")
+                self.probe.classify(kind, detail)
+
+    @contextlib.contextmanager
+    def region(self, inner=None):
+        """Bracket a guarded region (optionally entering ``inner`` —
+        the probe's guard — inside the bracket): the background thread
+        goes quiet while any region is active, so the two halves never
+        double-probe one wedge."""
+        with self._busy_lock:
+            self._busy += 1
+        try:
+            if inner is not None:
+                with inner:
+                    yield
+            else:
+                yield
+        finally:
+            with self._busy_lock:
+                self._busy -= 1
+
+    def stop(self, join_timeout: float = 1.0) -> None:
+        """Stop probing.  The join is a short tidy-up (a thread still
+        inside a probe subprocess is bounded by the probe's outer kill
+        and its outcome is dropped) — the leak gate counts only
+        running probers."""
+        self._stop.set()
+        t = self._thread
+        if t is not None and t.is_alive() \
+                and t is not threading.current_thread():
+            t.join(join_timeout)

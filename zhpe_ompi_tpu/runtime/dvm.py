@@ -97,6 +97,24 @@ mca_var.register(
     type=float,
 )
 
+mca_var.register(
+    "dvm_admission_policy", "fifo",
+    "Launch-admission ordering on a daemon: 'fifo' admits in arrival "
+    "order, 'priority' by descending launch priority= (ties by "
+    "arrival) — re-evaluated each time a slot frees, so a late "
+    "high-priority arrival preempts the QUEUE order (never a running "
+    "job)",
+)
+
+mca_var.register(
+    "dvm_max_concurrent_jobs", 0,
+    "Concurrently RUNNING jobs a daemon admits; excess launches BLOCK "
+    "as tickets in the admission queue (the client streams [queued, "
+    "position] frames while it waits) until a running job completes; "
+    "<= 0 is unbounded (the single-tenant default)",
+    type=int,
+)
+
 _TERM_GRACE = 2.0  # seconds between SIGTERM and SIGKILL on teardown
 
 # IOF-drain deadline at job exit: once every child is dead its pipes
@@ -294,6 +312,168 @@ def _tree_query(addr: tuple[str, int]) -> dict:
         cli.close()
 
 
+_live_admission: weakref.WeakSet = weakref.WeakSet()
+
+
+def queued_admission_tickets() -> list[str]:
+    """Tickets still parked in any daemon's admission queue — must be
+    [] at session end (the conftest gate): a leaked ticket means a
+    launch handler died without cancel/release and the queue head is
+    wedged forever."""
+    out: list[str] = []
+    for q in list(_live_admission):
+        out += q.queued()
+    return out
+
+
+class _AdmissionTicket:
+    """One launch's place in the admission queue: enqueue order,
+    priority, and admission state."""
+
+    def __init__(self, seq: int, priority: int):
+        self.seq = seq
+        self.priority = int(priority)
+        self.t0 = time.monotonic()
+        self.admitted = False
+        self.was_queued = False
+
+
+class _AdmissionQueue:
+    """Explicit launch admission — the bare serializing lock's convoy
+    made a POLICY.  An ordered ticket queue (fifo by arrival, or
+    priority-then-arrival, per ``dvm_admission_policy``) bounded by
+    ``dvm_max_concurrent_jobs``: excess launches BLOCK as tickets here
+    (their clients stream ``[queued, position]`` frames) instead of
+    convoying blindly on a mutex.  :meth:`setup` is the short job-setup
+    critical section (id / namespace / placement / spawn loop — one
+    job at a time, exactly the old lock's scope); an ADMITTED ticket
+    additionally holds a concurrency slot until :meth:`release` at job
+    end.  The respawn/resize RPCs take ``setup()`` directly — they
+    ride their job's admission (that job is already running) so they
+    can never queue behind a blocked launch, and a queued launch holds
+    NO lock at all, so it cannot interleave a resizing job's
+    membership."""
+
+    def __init__(self):
+        self._cv = threading.Condition()
+        self._setup = threading.Lock()
+        self._waiting: list[_AdmissionTicket] = []
+        self._running = 0
+        self._seq = itertools.count()
+        self._closed = False
+        _live_admission.add(self)
+
+    def setup(self) -> threading.Lock:
+        """The job-setup serialization lock (a context manager)."""
+        return self._setup
+
+    def enqueue(self, priority: int = 0) -> _AdmissionTicket:
+        with self._cv:
+            t = _AdmissionTicket(next(self._seq), priority)
+            self._waiting.append(t)
+            return t
+
+    def _order(self) -> list[_AdmissionTicket]:
+        # policy read PER evaluation: flipping the MCA var reorders the
+        # live queue, it never needs a daemon restart
+        if str(mca_var.get("dvm_admission_policy", "fifo")) \
+                == "priority":
+            return sorted(self._waiting,
+                          key=lambda t: (-t.priority, t.seq))
+        return sorted(self._waiting, key=lambda t: t.seq)
+
+    def _admissible(self, ticket: _AdmissionTicket) -> bool:
+        cap = int(mca_var.get("dvm_max_concurrent_jobs", 0))
+        order = self._order()
+        return bool(order) and order[0] is ticket \
+            and (cap <= 0 or self._running < cap)
+
+    def _position(self, ticket: _AdmissionTicket) -> int:
+        for i, t in enumerate(self._order()):
+            if t is ticket:
+                return i + 1
+        return 0
+
+    def admit(self, ticket: _AdmissionTicket, alive=None,
+              on_position=None) -> float | None:
+        """Block until ``ticket`` is admitted.  Returns the seconds it
+        waited, or None when ``alive()`` reported the client dead (the
+        ticket is cancelled — a dead client's queued job is reaped,
+        never left to wedge the queue head).  ``on_position(pos)``
+        fires outside the queue lock whenever the queued position
+        changes.  Raises InternalError when the queue closes under a
+        waiter (daemon stop)."""
+        notified = None
+        while True:
+            with self._cv:
+                if self._closed:
+                    self._discard(ticket)
+                    raise errors.InternalError(
+                        "zprted: daemon stopping — launch not admitted")
+                if self._admissible(ticket):
+                    self._waiting.remove(ticket)
+                    ticket.admitted = True
+                    self._running += 1
+                    self._cv.notify_all()
+                    return time.monotonic() - ticket.t0
+                ticket.was_queued = True
+                pos = self._position(ticket)
+            # callbacks OUTSIDE the lock: a blocking client socket must
+            # never wedge every other launch's admission
+            if alive is not None and not alive():
+                self.cancel(ticket)
+                return None
+            if on_position is not None and pos != notified:
+                notified = pos
+                on_position(pos)
+            with self._cv:
+                if not self._closed and not self._admissible(ticket) \
+                        and self._position(ticket) == notified:
+                    self._cv.wait(0.25)
+
+    def cancel(self, ticket: _AdmissionTicket) -> None:
+        with self._cv:
+            self._discard(ticket)
+            self._cv.notify_all()
+
+    def _discard(self, ticket: _AdmissionTicket) -> None:
+        if ticket in self._waiting:
+            self._waiting.remove(ticket)
+
+    def release(self, ticket: _AdmissionTicket) -> None:
+        """Job over (or launch failed): free the concurrency slot and
+        wake the queue.  Idempotent, and reaps a never-admitted ticket
+        too — the one release in the launch handler's ``finally``
+        covers every exit path."""
+        with self._cv:
+            if ticket.admitted:
+                ticket.admitted = False
+                self._running -= 1
+            else:
+                self._discard(ticket)
+            self._cv.notify_all()
+
+    def close(self) -> None:
+        with self._cv:
+            self._closed = True
+            self._cv.notify_all()
+
+    def stat_view(self) -> dict:
+        with self._cv:
+            return {
+                "policy": str(mca_var.get("dvm_admission_policy",
+                                          "fifo")),
+                "cap": int(mca_var.get("dvm_max_concurrent_jobs", 0)),
+                "running": self._running,
+                "waiting": len(self._waiting),
+            }
+
+    def queued(self) -> list[str]:
+        with self._cv:
+            return [f"admission-ticket:seq={t.seq}:prio={t.priority}"
+                    for t in self._waiting]
+
+
 class _Job:
     """One launched job: its procs (latest incarnation per rank), exit
     bookkeeping, and the IOF client connection.  On a TREE the root
@@ -331,6 +511,8 @@ class _Job:
         self.watchers: list[threading.Thread] = []
         # tree bookkeeping (root side)
         self.placement: dict[int, str] = {}
+        # tenancy: this job got (and keeps) an exclusive daemon subtree
+        self.exclusive = False
         self.remote_alive: set[int] = set()
         self.remote_pids: dict[int, int] = {}
         # elastic bookkeeping: the CURRENT live membership target
@@ -356,6 +538,8 @@ class _Job:
             return {"size": self.size, "ft": self.ft,
                     "live": self.live, "elastic": self.elastic,
                     "target": sorted(self.target),
+                    "placement": [[int(r), d] for r, d in
+                                  sorted(self.placement.items())],
                     "done": self.done.is_set()}
 
     def retired(self, rank: int) -> bool:
@@ -423,12 +607,15 @@ class Dvm(pmix_mod.FramedRpcServer):
         self._jobs: dict[str, _Job] = {}
         self._job_ids = itertools.count(1)
         self._lock = threading.Lock()
-        # launch-RPC admission is SERIALIZED: two concurrent launches
-        # (or a launch racing a resize) may not interleave job setup —
-        # id allocation, namespace creation, placement, and the spawn
-        # loop happen one job at a time (the wait for the job's exit
-        # does NOT hold this lock; jobs still RUN concurrently)
-        self._admission = threading.Lock()
+        # launch-RPC admission is an explicit QUEUE: job setup — id
+        # allocation, namespace creation, placement, and the spawn
+        # loop — still happens one job at a time (setup()), but
+        # admission ORDER is policy (dvm_admission_policy) and
+        # admission COUNT is bounded (dvm_max_concurrent_jobs), with
+        # excess launches parked as tickets streaming [queued, pos]
+        # frames (the wait for a job's exit never holds anything;
+        # admitted jobs still RUN concurrently)
+        self._admission = _AdmissionQueue()
         # ordered daemon membership for placement: this daemon first,
         # children (and their subtrees) in attach order (root only)
         self._placement_ids: list[str] = [self.id]
@@ -520,6 +707,7 @@ class Dvm(pmix_mod.FramedRpcServer):
                 "jobs": jobs,
                 "pmix": self.store.stat(),
                 "daemons": daemons,
+                "admission": self._admission.stat_view(),
                 "dvm_jobs_launched": counters.get("dvm_jobs_launched", 0),
                 "dvm_fault_events": counters.get("dvm_fault_events", 0),
                 "dvm_respawns": counters.get("dvm_respawns", 0),
@@ -840,12 +1028,13 @@ class Dvm(pmix_mod.FramedRpcServer):
                     job.fail_rc = 137  # 128 + SIGKILL: the subtree died
             if not victims:
                 continue
-            flightrec.record(flightrec.DAEMON_FAULT, job=job.id,
-                             deaths=victims, cause="daemon-tree")
             if job.ft and not stopping:
+                # _fault records the DAEMON_FAULT flightrec event
                 self._fault(job, [(r, -9) for r in victims],
                             cause="daemon-tree")
             elif not stopping:
+                flightrec.record(flightrec.DAEMON_FAULT, job=job.id,
+                                 deaths=victims, cause="daemon-tree")
                 self._stream(job, [
                     "note",
                     f"zprted: daemon subtree {sorted(ids)} died taking "
@@ -1288,51 +1477,124 @@ class Dvm(pmix_mod.FramedRpcServer):
         if elastic:
             cmds = cmds + [cmds[0]] * (max_size - n)
         timeout = spec.get("timeout")
-        # admission is SERIALIZED (the one-caller assumption fixed):
-        # id allocation, namespace creation, placement, and the spawn
-        # loop of one launch finish before the next begins; the
-        # job-exit wait below runs OUTSIDE the lock, so jobs still run
-        # concurrently
-        with self._admission:
-            with self._lock:
-                job_id = f"job{next(self._job_ids)}"
-                job = _Job(
-                    job_id, max_size, cmds, bool(spec.get("ft")),
-                    [tuple(m) for m in (spec.get("mca") or [])],
-                    f"{self.session}_{job_id}",
-                    conn, conn_lock,
-                    metrics=bool(spec.get("metrics")),
-                    # trace implies metrics (the publisher ships the
-                    # span buffers): a trace-only launch gets both
-                    trace=bool(spec.get("trace")),
-                )
-                if job.trace:
-                    job.metrics = True
-                job.elastic = elastic
-                job.target = set(range(n))
-                self._jobs[job_id] = job
-            # the namespace IS the jobid: ranks modex through the
-            # resident store with zero per-job rendezvous
-            # infrastructure.  Its size is the INITIAL live count (the
-            # modex fence barriers the starters; grown ranks rejoin
-            # without fencing).
-            try:
-                self.store.ensure_ns(job_id, n)
-                with self._tree_lock:
-                    daemons = list(self._placement_ids)
-                job.placement = dvmtree.block_placement(
-                    sorted(job.target), daemons)
-                self._stream(job, ["job", job_id])
-                self._spawn_ranks(job, sorted(job.target), rejoin=None)
-            except errors.MpiError:
-                # half-spawned job (a daemon died between placement
-                # and its spawn frame): the already-started ranks,
-                # the namespace, and the _jobs entry must not leak
-                # for the daemon's lifetime
-                self._teardown_job(job, rc=1)
-                self._finalize_job(job)
-                raise
-            spc.record("dvm_jobs_launched")
+        priority = int(spec.get("priority") or 0)
+        policy = str(spec.get("placement")
+                     or mca_var.get("dvm_placement", "pack"))
+        # admission is a QUEUE, not a convoy: the ticket blocks here —
+        # streaming [queued, pos] frames so the client knows where it
+        # stands — until the policy order and the concurrency cap both
+        # admit it; a dead client's ticket is reaped (conn_alive), and
+        # only then does setup() serialize the actual job setup
+        ticket = self._admission.enqueue(priority)
+        try:
+            wait_s = self._admission.admit(
+                ticket,
+                alive=lambda: pmix_mod.conn_alive(conn),
+                on_position=lambda pos: self._queued_frame(
+                    conn, conn_lock, pos))
+            if wait_s is None:
+                mca_output.verbose(
+                    1, _stream, "launch: queued client died — ticket "
+                    "reaped, launch dropped")
+                return
+            if ticket.was_queued:
+                spc.record("dvm_jobs_queued")
+                spc.record("dvm_queue_wait_ms", int(wait_s * 1000))
+            with self._admission.setup():
+                with self._lock:
+                    job_id = f"job{next(self._job_ids)}"
+                    job = _Job(
+                        job_id, max_size, cmds, bool(spec.get("ft")),
+                        [tuple(m) for m in (spec.get("mca") or [])],
+                        f"{self.session}_{job_id}",
+                        conn, conn_lock,
+                        metrics=bool(spec.get("metrics")),
+                        # trace implies metrics (the publisher ships
+                        # the span buffers): a trace-only launch gets
+                        # both
+                        trace=bool(spec.get("trace")),
+                    )
+                    if job.trace:
+                        job.metrics = True
+                    job.elastic = elastic
+                    job.target = set(range(n))
+                    self._jobs[job_id] = job
+                # the namespace IS the jobid: ranks modex through the
+                # resident store with zero per-job rendezvous
+                # infrastructure.  Its size is the INITIAL live count
+                # (the modex fence barriers the starters; grown ranks
+                # rejoin without fencing).
+                try:
+                    self.store.ensure_ns(job_id, n)
+                    with self._tree_lock:
+                        daemons = list(self._placement_ids)
+                    with self._lock:
+                        live = [j for j in self._jobs.values()
+                                if j is not job
+                                and not j.done.is_set()]
+                        busy: dict[str, int] = {}
+                        for j in live:
+                            for d in set(j.placement.values()):
+                                busy[d] = busy.get(d, 0) + 1
+                    placement, fell_back = dvmtree.place_job(
+                        sorted(job.target), daemons, busy, policy)
+                    if fell_back:
+                        spc.record("dvm_placement_fallbacks")
+                        self._stream(job, [
+                            "note",
+                            "zprted: exclusive placement unavailable "
+                            "(no free daemon) — falling back to "
+                            "spread\n"])
+                    job.placement = placement
+                    job.exclusive = policy == "exclusive" \
+                        and not fell_back
+                    # the per-job audit: prove this tenant's runtime
+                    # state disjoint from every live co-tenant's
+                    # before a single rank spawns
+                    dvmtree.audit_placement(
+                        {"id": job.id, "session": job.session,
+                         "daemons": sorted(set(placement.values())),
+                         "exclusive": job.exclusive},
+                        [{"id": j.id, "session": j.session,
+                          "daemons": sorted(set(
+                              j.placement.values())),
+                          "exclusive": j.exclusive}
+                         for j in live])
+                    self._stream(job, ["job", job_id])
+                    self._spawn_ranks(job, sorted(job.target),
+                                      rejoin=None)
+                except errors.MpiError:
+                    # half-spawned job (a daemon died between
+                    # placement and its spawn frame) or a failed
+                    # audit: the already-started ranks, the namespace,
+                    # and the _jobs entry must not leak for the
+                    # daemon's lifetime
+                    self._teardown_job(job, rc=1)
+                    self._finalize_job(job)
+                    raise
+                spc.record("dvm_jobs_launched")
+            self._run_admitted(job, job_id, timeout)
+        finally:
+            # the one release covers every exit path: a finished job
+            # frees its concurrency slot, a failed/errored launch its
+            # ticket — either way the queue wakes
+            self._admission.release(ticket)
+
+    def _queued_frame(self, conn, conn_lock, pos: int) -> None:
+        """One ``[queued, position]`` frame to a still-waiting launch
+        client (no _Job exists yet, so this bypasses _stream).  Old
+        clients ignore unknown stream kinds — the frame is additive."""
+        from ..pt2pt.tcp import _send_frame
+        from ..utils import dss
+
+        try:
+            with conn_lock:
+                _send_frame(conn, dss.pack(["queued", int(pos)]))
+        except OSError:
+            pass  # admit()'s alive() poll reaps the dead client
+
+    def _run_admitted(self, job: _Job, job_id: str,
+                      timeout) -> None:
         # a job with no deadline of its own still may not park this
         # handler forever on a wedged rank set
         timeout = timeout if timeout \
@@ -1560,9 +1822,11 @@ class Dvm(pmix_mod.FramedRpcServer):
             return []
         batch = sorted(set(int(r) for r in ranks))
         # respawn IS job setup: it reads placement/target and ships
-        # membership env (ZMPI_ELASTIC_*) — riding the admission lock
-        # keeps it from observing a resize's half-applied state
-        with self._admission:
+        # membership env (ZMPI_ELASTIC_*) — riding its job's admission
+        # (the setup lock directly, never the launch queue) keeps it
+        # from observing a resize's half-applied state, and a QUEUED
+        # launch can never interleave it (tickets hold no lock)
+        with self._admission.setup():
             return self._respawn_admitted(job, job_id, batch)
 
     def _respawn_admitted(self, job: _Job, job_id: str,
@@ -1624,6 +1888,11 @@ class Dvm(pmix_mod.FramedRpcServer):
         local_pids = self._spawn_ranks(job, batch, rejoin=(gen, batch))
         self._await_remote_pids(job, remote, "respawn")
         spc.record("dvm_respawns", len(batch))
+        # root-side respawn event: the soak harness's MTTR postmortem
+        # reads the daemon's own flight recorder, not a rank's
+        flightrec.record(flightrec.RESPAWN, job=job_id,
+                         ranks=[int(r) for r in batch],
+                         generation=int(gen))
         with job.lock:
             return [local_pids.get(r, job.remote_pids.get(r))
                     for r in batch]
@@ -1682,7 +1951,7 @@ class Dvm(pmix_mod.FramedRpcServer):
             raise errors.ArgError(
                 f"zprted resize: size {new_n} outside 1..{job.size} "
                 "(the launch max_size)")
-        with self._admission:
+        with self._admission.setup():
             with job.lock:
                 target = set(job.target)
             delta = new_n - len(target)
@@ -1709,11 +1978,18 @@ class Dvm(pmix_mod.FramedRpcServer):
                     job.target |= set(grown)
                     # fresh placement over the CURRENT daemon list —
                     # a re-grown slot must not inherit a placement
-                    # entry pointing at a daemon that since detached
+                    # entry pointing at a daemon that since detached —
+                    # restricted to the job's CLAIMED subtree while
+                    # any of it survives: a grown slot of an
+                    # exclusive/spread tenant must not land on a
+                    # co-tenant's daemons
                     prev_placement = {r: job.placement.get(r)
                                       for r in grown}
+                    claimed = set(job.placement.values())
+                    pool = [d for d in daemons if d in claimed] \
+                        or daemons
                     for i, r in enumerate(grown):
-                        job.placement[r] = daemons[i % len(daemons)]
+                        job.placement[r] = pool[i % len(pool)]
                 try:
                     local_pids = self._spawn_ranks(job, grown,
                                                    rejoin=(gen, grown))
@@ -1866,6 +2142,10 @@ class Dvm(pmix_mod.FramedRpcServer):
         if self.closed:
             return
         self._stopping_tree = True
+        # queued launches first: every waiter raises (the client gets
+        # an err frame) instead of parking on a queue nobody will
+        # ever advance again
+        self._admission.close()
         with self._lock:
             jobs = list(self._jobs.values())
         # local jobs die BEFORE the goodbye: their exits ride the
@@ -1912,6 +2192,9 @@ class DvmClient:
         self.address = pmix_mod.parse_addr(address)
         self._timeout = timeout
         self.last_job_id: str | None = None
+        #: last [queued, pos] frame seen by launch() — None until the
+        #: daemon actually parks the launch in its admission queue
+        self.last_queue_position: int | None = None
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.settimeout(timeout)
         try:
@@ -1947,7 +2230,8 @@ class DvmClient:
                timeout: float | None = None, tag_output: bool = True,
                stdout=None, stderr=None, metrics: bool = False,
                trace: bool = False, max_size: int | None = None,
-               apps: list | None = None) -> int:
+               apps: list | None = None, priority: int = 0,
+               placement: str | None = None) -> int:
         """Launch an n-rank job into the resident VM; streams its IOF
         and returns the job exit code (the ``zmpirun`` surface, minus
         the per-job launcher).  ``max_size`` (> n) makes the job
@@ -1956,7 +2240,14 @@ class DvmClient:
         membership while the job runs.  ``apps`` replaces ``argv`` for
         MPMD into the VM: ``[(n1, argv1), (n2, argv2), ...]`` launches
         consecutive rank blocks per context (mixed C/Python jobs share
-        the store-served wire-up); ``n`` is ignored when given."""
+        the store-served wire-up); ``n`` is ignored when given.
+        ``priority`` orders this launch in the daemon's admission
+        queue under dvm_admission_policy=priority (higher first);
+        ``placement`` overrides the daemon's dvm_placement policy for
+        this job (pack/spread/exclusive).  While the launch waits in
+        the admission queue the daemon streams ``[queued, pos]``
+        frames — mirrored into :attr:`last_queue_position` and noted
+        on ``stderr``."""
         from ..pt2pt.tcp import _recv_frame, _send_frame
         from ..utils import dss
 
@@ -1973,7 +2264,11 @@ class DvmClient:
                 "mca": [list(m) for m in (mca or [])], "ft": bool(ft),
                 "timeout": timeout, "metrics": bool(metrics),
                 "trace": bool(trace),
-                "max_size": None if max_size is None else int(max_size)}
+                "max_size": None if max_size is None else int(max_size),
+                "priority": int(priority),
+                "placement": None if placement is None
+                else str(placement)}
+        self.last_queue_position = None
         # no client-imposed deadline without an explicit job timeout:
         # the daemon enforces its own (tunable) dvm_job_timeout and
         # ALWAYS sends the exit frame, and a daemon crash surfaces as
@@ -2001,6 +2296,12 @@ class DvmClient:
                     sink.flush()
                 elif kind == "note":
                     stderr.write(msg[1])
+                    stderr.flush()
+                elif kind == "queued":
+                    self.last_queue_position = int(msg[1])
+                    stderr.write(
+                        f"zprted: launch queued at position "
+                        f"{self.last_queue_position}\n")
                     stderr.flush()
                 elif kind == "exit":
                     return int(msg[1])

@@ -131,6 +131,140 @@ def block_placement(ranks: list[int], daemons: list[str]
     }
 
 
+# -- multi-tenant placement -------------------------------------------------
+
+#: the placement ladder: every policy degrades to the one before it
+#: when the tree is too small, never the other way around
+PLACEMENT_POLICIES = ("pack", "spread", "exclusive")
+
+mca_var.register(
+    "dvm_placement", "pack",
+    "Multi-tenant placement policy for daemon-tree jobs: 'pack' "
+    "block-places over all daemons in attach order (the single-tenant "
+    "default), 'spread' block-places over the daemons ordered "
+    "least-loaded first (co-tenants naturally claim different "
+    "subtrees while capacity allows), 'exclusive' claims only "
+    "daemons no live job uses and fails over to spread — loudly, "
+    "counted in dvm_placement_fallbacks — when none are free; a "
+    "launch spec's placement= overrides per job",
+)
+
+
+def place_job(ranks: list[int], daemons: list[str],
+              busy: dict[str, int], policy: str
+              ) -> tuple[dict[int, str], bool]:
+    """Placement for one new job under the multi-tenant ladder.
+
+    ``busy`` maps daemon id -> count of LIVE jobs already placed on it
+    (the root computes it from its job table).  Returns ``(placement,
+    fell_back)`` — ``fell_back`` is True only for an exclusive request
+    that found no free daemon and degraded to spread (the caller
+    reports it loudly and counts ``dvm_placement_fallbacks``).
+
+    - ``pack``: :func:`block_placement` over attach order — dense,
+      single-tenant shape, co-tenants overlap.
+    - ``spread``: block placement over the first ``len(ranks)``
+      daemons sorted least-loaded first (ties broken by attach
+      order).  The minimal claim is the point: a k-rank job touches
+      only the k least-loaded daemons, so two spread tenants land on
+      disjoint subtrees whenever there are enough daemons — claiming
+      the whole load order (an earlier draft) put rank k-1 back onto
+      a busy daemon and broke exactly that.
+    - ``exclusive``: place ONLY on daemons with zero live jobs,
+      claiming the minimal prefix (len(ranks) at most) so successive
+      exclusive tenants can coexist; no free daemon at all means
+      fallback to spread.
+    """
+    policy = str(policy or "pack")
+    if policy not in PLACEMENT_POLICIES:
+        raise errors.ArgError(
+            f"dvm placement: unknown policy {policy!r} "
+            f"(one of {'/'.join(PLACEMENT_POLICIES)})")
+    if not daemons:
+        raise errors.InternalError("dvm tree: no daemons to place on")
+    if policy == "pack":
+        return block_placement(ranks, daemons), False
+    order = {d: i for i, d in enumerate(daemons)}
+    by_load = sorted(daemons,
+                     key=lambda d: (busy.get(d, 0), order[d]))
+    if policy == "spread":
+        return block_placement(
+            ranks, by_load[:max(1, len(ranks))]), False
+    free = [d for d in by_load if busy.get(d, 0) == 0]
+    if not free:
+        return block_placement(ranks, by_load), True
+    return block_placement(ranks, free[:max(1, len(ranks))]), False
+
+
+_audit_failures: list[str] = []
+_audit_lock = threading.Lock()
+
+
+def placement_audit_failures() -> list[str]:
+    """Recorded placement-audit violations — must be [] at session end
+    (the conftest gate): an audit failure means two live jobs were
+    about to share sm-segment prefixes, namespaces, or an exclusive
+    subtree, and the offending launch was failed loudly."""
+    with _audit_lock:
+        return list(_audit_failures)
+
+
+def clear_placement_audit_failures() -> None:
+    with _audit_lock:
+        _audit_failures.clear()
+
+
+def _sessions_collide(a: str, b: str) -> bool:
+    # the /dev/shm sweep keys on "<prefix>_{session}_": equality OR a
+    # prefix-with-underscore relation would let one job's sweep (or
+    # segment namespace) reach the other's files
+    return a == b or b.startswith(a + "_") or a.startswith(b + "_")
+
+
+def audit_placement(new_job: dict, live_jobs: list[dict]) -> None:
+    """Per-job placement audit at admission: prove the new job's
+    runtime state is disjoint from every LIVE co-tenant's.
+
+    Each job dict carries ``id`` (the PMIx namespace — cid windows are
+    coordinated per namespace, so distinct ids imply disjoint cid
+    state), ``session`` (the sm-segment / sweep prefix tag) and
+    ``daemons`` (the placed daemon set) plus ``exclusive`` (the job
+    demanded — and got — an exclusive subtree).  A violation is typed
+    (:class:`~zhpe_ompi_tpu.core.errors.PlacementViolation`), recorded
+    for the session gate, counted (``dvm_placement_audit_failures``),
+    and raised so the launch fails loudly instead of admitting a
+    tenant that could corrupt a neighbour."""
+    for other in live_jobs:
+        if other["id"] == new_job["id"]:
+            viol = errors.PlacementViolation(
+                f"placement audit: job id/namespace {new_job['id']!r} "
+                "already live (cid windows would collide)",
+                jobs=(new_job["id"], other["id"]), prop="namespace")
+        elif _sessions_collide(str(new_job["session"]),
+                               str(other["session"])):
+            viol = errors.PlacementViolation(
+                f"placement audit: session tag {new_job['session']!r} "
+                f"collides with live job {other['id']!r}'s "
+                f"{other['session']!r} (sm segments / shm sweep would "
+                "cross tenants)",
+                jobs=(new_job["id"], other["id"]), prop="session")
+        elif (new_job.get("exclusive") or other.get("exclusive")) \
+                and set(new_job["daemons"]) & set(other["daemons"]):
+            shared = sorted(set(new_job["daemons"])
+                            & set(other["daemons"]))
+            viol = errors.PlacementViolation(
+                f"placement audit: exclusive subtree violated — jobs "
+                f"{new_job['id']!r}/{other['id']!r} share daemons "
+                f"{shared}",
+                jobs=(new_job["id"], other["id"]), prop="subtree")
+        else:
+            continue
+        with _audit_lock:
+            _audit_failures.append(str(viol))
+        spc.record("dvm_placement_audit_failures")
+        raise viol
+
+
 class RoutedStore:
     """Store-verb surface of a CHILD daemon: same method signatures as
     :class:`~zhpe_ompi_tpu.runtime.pmix.PmixStore` (so a

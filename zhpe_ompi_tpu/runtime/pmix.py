@@ -113,6 +113,24 @@ def parse_addr(address: "tuple[str, int] | str") -> tuple[str, int]:
     return (address[0], int(address[1]))
 
 
+def conn_alive(conn) -> bool:
+    """Non-blocking liveness check on a served connection: a peer that
+    closed (EOF readable) or reset is DEAD; a peer with nothing to say
+    is alive.  The admission queue polls this so a queued launch whose
+    client died is reaped instead of wedging the queue head — the
+    check never consumes protocol bytes (``MSG_PEEK``) and never
+    blocks (``select`` with a zero timeout)."""
+    import select
+
+    try:
+        readable, _, _ = select.select([conn], [], [], 0)
+        if not readable:
+            return True
+        return conn.recv(1, socket.MSG_PEEK) != b""
+    except OSError:
+        return False
+
+
 class FramedRpcServer:
     """Shared scaffold of the runtime plane's framed-RPC servers (the
     PMIx store wire and the zprted control port): one SO_REUSEADDR

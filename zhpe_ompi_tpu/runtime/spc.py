@@ -169,6 +169,21 @@ the daemon-side values):
 - ``dvm_resizes`` — elastic resize RPCs the root daemon applied (one
   per grow or shrink event published, however many ranks it spawned
   or retired).
+- ``dvm_jobs_queued`` — launches the admission queue actually BLOCKED
+  (the client saw at least one ``[queued, pos]`` frame) before
+  admitting; an uncontended launch admits without counting.
+- ``dvm_queue_wait_ms`` — WATERMARK: the longest a launch waited in
+  the admission queue (milliseconds, enqueue to admission) — the
+  multi-tenant head-of-line latency the soak harness reports.
+- ``dvm_placement_fallbacks`` — exclusive-placement requests that
+  found no free daemon and degraded (loudly, with a client note) to
+  spread; the capacity-exceeded signal, deliberately distinct from
+  audit failures.
+- ``dvm_placement_audit_failures`` — per-job placement audits that
+  caught two live jobs sharing sessions/namespaces/exclusive
+  subtrees; each raised a typed PlacementViolation and failed the
+  launch.  Must stay zero in any healthy run (the conftest session
+  gate asserts the registry empty).
 
 API-surface counters (recorded at the MPI/OpenSHMEM call sites; the
 ZL006 doc-parity rule keeps this table and the ``spc.record`` call
@@ -231,6 +246,13 @@ Device-plane liveness counters (the device half of the fault loop —
   into the FailureState (the DEVICE_FAULT flightrec event lands with
   each; must stay zero across any run with no injected wedge — the
   device plane's zero-false-positive gate).
+- ``device_probes`` — background rounds the always-on DeviceProber
+  ran between guarded regions (on ``dvm_device_probe_interval_ms``;
+  each also counts in ``device_probe_rounds`` via the shared probe).
+- ``device_probe_faults`` — background-prober rounds that missed and
+  classified a typed device fault (the out-of-region wedge the
+  per-step guard could never see); each also counts in
+  ``device_faults`` via the shared classify path.
 
 Observability-plane counters (the fleet-visible metrics plane —
 recorded by this module's :class:`MetricsPublisher` and by
@@ -310,7 +332,8 @@ _counters: dict[str, int] = defaultdict(int)
 _lock = threading.Lock()
 _reset_epoch = 0
 
-WATERMARK = {"max_bytes_in_collective", "match_unexpected_max_depth"}
+WATERMARK = {"max_bytes_in_collective", "match_unexpected_max_depth",
+             "dvm_queue_wait_ms"}
 
 #: publisher interval floor (seconds): below this a fleet of publishers
 #: degenerates into sub-interval polling on shared cores

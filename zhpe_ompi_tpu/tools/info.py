@@ -43,6 +43,8 @@ def _load_everything():
     from ..parallel import mesh  # registers rte vars  # noqa: F401
     from ..coll import monitoring  # registers monitoring vars  # noqa: F401
     from ..utils import memchecker  # registers memchecker vars  # noqa: F401
+    from ..runtime import dvm  # registers dvm_* daemon vars  # noqa: F401
+    from ..runtime import dvmtree  # registers tree/placement vars  # noqa: F401
     from .. import native
 
     native.load()  # registration happens inside load(), not at import

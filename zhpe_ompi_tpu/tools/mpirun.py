@@ -223,7 +223,9 @@ def launch_dvm(dvm: str, n: int, argv: list[str] | None = None,
                stdout=None, stderr=None, ft: bool = False,
                metrics: bool = False, trace: bool = False,
                max_size: int | None = None,
-               apps: list[tuple[int, list[str]]] | None = None) -> int:
+               apps: list[tuple[int, list[str]]] | None = None,
+               priority: int = 0,
+               placement: str | None = None) -> int:
     """Launch a job INTO a resident runtime daemon (``zmpirun --dvm``):
     the zprted VM hosts the PMIx store and the children, streams their
     IOF back here, and outlives the job — no per-job rendezvous, no
@@ -235,7 +237,11 @@ def launch_dvm(dvm: str, n: int, argv: list[str] | None = None,
     each publishes SPC snapshots into the resident store (the
     fleet-visible metrics plane).  ``max_size`` (> n) launches the job
     ELASTIC (see :meth:`DvmClient.launch`); ``apps`` is the MPMD form —
-    mixed C/Python contexts share the store-served wire-up."""
+    mixed C/Python contexts share the store-served wire-up.
+    ``priority`` orders this launch in the daemon's admission queue
+    (``dvm_admission_policy=priority``); ``placement`` picks its
+    subtree policy (pack/spread/exclusive, default the daemon's
+    ``dvm_placement``)."""
     from ..runtime.dvm import DvmClient
 
     client = DvmClient(dvm)
@@ -243,7 +249,8 @@ def launch_dvm(dvm: str, n: int, argv: list[str] | None = None,
         return client.launch(n, argv, mca=mca, ft=ft, timeout=timeout,
                              tag_output=tag_output, stdout=stdout,
                              stderr=stderr, metrics=metrics,
-                             trace=trace, max_size=max_size, apps=apps)
+                             trace=trace, max_size=max_size, apps=apps,
+                             priority=priority, placement=placement)
     finally:
         client.close()
 
@@ -474,6 +481,20 @@ def main(args: list[str] | None = None) -> int:
                          "endpoint universe is this many slots, -n of "
                          "them start live, and the daemon's resize RPC "
                          "grows/shrinks membership while the job runs")
+    ap.add_argument("--priority", type=int, default=0,
+                    help="admission priority (--dvm only): higher "
+                         "admits first when the daemon runs "
+                         "dvm_admission_policy=priority; ties admit "
+                         "in arrival order")
+    ap.add_argument("--placement", default=None,
+                    choices=("pack", "spread", "exclusive"),
+                    help="subtree placement policy (--dvm only): "
+                         "pack = block-fill the attach order, spread "
+                         "= least-loaded daemons first, exclusive = "
+                         "claim daemons hosting no other live job "
+                         "(falls back to spread, loudly, when none "
+                         "are free); default the daemon's "
+                         "dvm_placement")
     ap.add_argument("--resize", default=None, metavar="JOB",
                     help="resize a RUNNING elastic job in the resident "
                          "VM to -n live ranks (--dvm only; no program "
@@ -529,16 +550,21 @@ def main(args: list[str] | None = None) -> int:
         if (more.host != "127.0.0.1" or more.mca or
                 more.timeout is not None or more.no_tag_output or
                 more.dvm or more.ft or more.metrics or more.trace or
-                more.max_size is not None or more.resize is not None):
+                more.max_size is not None or more.resize is not None or
+                more.priority or more.placement is not None):
             ap.error(
                 "--host/--mca/--timeout/--no-tag-output/--dvm/--ft/"
-                "--metrics/--trace/--max-size/--resize are "
-                "job-global: pass them in the first app context"
+                "--metrics/--trace/--max-size/--resize/--priority/"
+                "--placement are job-global: pass them in the first "
+                "app context"
             )
         apps.append((more.n, more.argv))
     if first.max_size is not None and not first.dvm:
         ap.error("--max-size (elastic) needs the resident VM: run "
                  "with --dvm")
+    if (first.priority or first.placement is not None) and not first.dvm:
+        ap.error("--priority/--placement order and place launches in "
+                 "the resident VM: run with --dvm")
     # signal hygiene (main thread only — the CLI path): SIGINT/SIGTERM
     # are forwarded to the job, children reaped, ports released, exit
     # 128+sig — see _JobSignal
@@ -561,6 +587,7 @@ def main(args: list[str] | None = None) -> int:
                 metrics=first.metrics or first.trace,
                 trace=first.trace, max_size=first.max_size,
                 apps=None if len(apps) == 1 else apps,
+                priority=first.priority, placement=first.placement,
             )
         if first.metrics or first.trace:
             ap.error("--metrics/--trace need the resident store: run "
