@@ -211,6 +211,27 @@ def _ulfm_detector_hygiene():
         f"deadline/error children are reaped, hung ones killed): "
         f"{probes}"
     )
+    from zhpe_ompi_tpu.io import ckptio as ckptio_mod
+
+    shard_tmps = ckptio_mod.orphaned_shard_temps()
+    assert not shard_tmps, (
+        f"collective checkpoint plane left orphaned shard temp files "
+        f"(every aggregator write is tmp+fsync+rename; a .tmp past the "
+        f"suite is a crashed writer nobody healed): {shard_tmps}"
+    )
+    ckpt_writers = ckptio_mod.live_writer_threads()
+    assert not ckpt_writers, (
+        f"checkpoint writer/aggregator threads leaked past their "
+        f"checkpointer's wait() (the drain-before-done contract): "
+        f"{ckpt_writers}"
+    )
+    torn_steps = ckptio_mod.incomplete_manifests()
+    assert not torn_steps, (
+        f"incomplete checkpoint manifests left at session end (a step "
+        f"directory with no complete manifest is a torn checkpoint — "
+        f"restore ignores it, but tests must heal() what they tear): "
+        f"{torn_steps}"
+    )
     from zhpe_ompi_tpu.utils import lockdep
 
     inversions = lockdep.cycles()

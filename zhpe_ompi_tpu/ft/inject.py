@@ -31,7 +31,9 @@ from __future__ import annotations
 
 import os
 import random
+import signal
 import threading
+import time
 from typing import Any
 
 from ..coll.host import HostCollectives
@@ -56,6 +58,7 @@ class FaultPlan:
         self._kills: dict[int, tuple[int, str]] = {}
         self._wedges: dict[int, int] = {}
         self._respawns: set[int] = set()
+        self._ckpt_faults: dict[int, list[dict]] = {}
 
     def kill_rank(self, rank: int, after_ops: int,
                   mode: str = "exit") -> "FaultPlan":
@@ -135,9 +138,78 @@ class FaultPlan:
     def respawn_victims(self) -> frozenset:
         return frozenset(self._respawns)
 
+    # -- checkpoint-seam faults (io/ckptio.py fault points) ---------------
+
+    _CKPT_SEAMS = ("gather", "aggregate", "write", "manifest")
+
+    def ckpt_fault(self, rank: int, seam: str, after: int = 0,
+                   action: str = "exit", hold_s: float = 0.0,
+                   times: int = 1) -> "FaultPlan":
+        """Schedule a fault at a checkpoint seam of ``rank``: the seam
+        fires on its occurrence ``after + 1`` (``after`` occurrences
+        complete cleanly).  Seams — ``"gather"`` (a non-aggregator's
+        shard send), ``"aggregate"`` (an aggregator collecting one of
+        its group's shards: the mid-two-phase-exchange kill point),
+        ``"write"`` (one deadline-bounded fbtl stream attempt: the
+        mid-stream kill / wedge point), ``"manifest"`` (rank 0 about to
+        publish).  Actions — ``"exit"`` (thread-plane crash:
+        :class:`~.ulfm.RankKilled` unwinds the writer), ``"kill9"``
+        (real-process crash: SIGKILL self at the seam), ``"wedge"``
+        (sleep ``hold_s`` inside the attempt, pushing it past the
+        ``ckpt_write_deadline_s`` watchdog — fires ``times`` times then
+        goes inert, so the retry ladder's later attempts succeed)."""
+        if seam not in self._CKPT_SEAMS:
+            raise errors.ArgError(f"unknown ckpt seam {seam!r}")
+        if action not in ("exit", "kill9", "wedge"):
+            raise errors.ArgError(f"unknown ckpt fault action {action!r}")
+        if after < 0:
+            raise errors.ArgError("after must be >= 0")
+        self._ckpt_faults.setdefault(int(rank), []).append({
+            "seam": seam, "after": int(after), "action": action,
+            "hold_s": float(hold_s), "times": int(times),
+        })
+        return self
+
+    def ckpt_kill_aggregator(self, rank: int, after_shards: int = 0,
+                             action: str = "exit") -> "FaultPlan":
+        """Kill ``rank`` (an aggregator) mid two-phase exchange, after
+        it has collected ``after_shards`` of its group's shards."""
+        return self.ckpt_fault(rank, "aggregate", after_shards, action)
+
+    def ckpt_kill_writer(self, rank: int, after_writes: int = 0,
+                         action: str = "exit") -> "FaultPlan":
+        """Kill ``rank`` mid-stream, after ``after_writes`` completed
+        fbtl write attempts."""
+        return self.ckpt_fault(rank, "write", after_writes, action)
+
+    def ckpt_wedge_write(self, rank: int, hold_s: float,
+                         after: int = 0, times: int = 1) -> "FaultPlan":
+        """Wedge ``rank``'s fbtl stream write past its deadline for
+        ``times`` attempts (then inert — the retry ladder recovers)."""
+        return self.ckpt_fault(rank, "write", after, "wedge",
+                               hold_s=hold_s, times=times)
+
+    def ckpt_faults_for(self, rank: int) -> list[dict]:
+        return [dict(f) for f in self._ckpt_faults.get(int(rank), [])]
+
+    @property
+    def ckpt_victims(self) -> frozenset:
+        return frozenset(r for r, fs in self._ckpt_faults.items()
+                         if any(f["action"] != "wedge" for f in fs))
+
     def arm(self, ep) -> "InjectedContext":
         """Wrap one rank's endpoint with op counting + the kill trigger."""
         return InjectedContext(ep, self)
+
+    def arm_ckpt(self, rank: int, ep=None,
+                 state=None) -> "CkptSeamContext":
+        """Arm one rank's checkpoint-seam faults: the returned context
+        manager installs itself as an :func:`~zhpe_ompi_tpu.io.ckptio.
+        install_fault_hook` hook for its scope (a no-op forever if this
+        rank has no ckpt faults in the plan).  ``ep``/``state`` give the
+        ``"exit"`` action its detector bookkeeping + transport kill, the
+        :meth:`InjectedContext.die` semantics at a checkpoint seam."""
+        return CkptSeamContext(self, int(rank), ep=ep, state=state)
 
     def arm_device(self, rank: int, state=None,
                    hold: bool = False) -> "WedgedDevice":
@@ -223,6 +295,92 @@ class WedgedDevice:
         self._fault = fault
         os.environ.pop(coll_tpu.WEDGE_ENV, None)
         self._release.set()
+
+
+class CkptSeamContext:
+    """One rank's armed checkpoint-seam faults — the injectable
+    stand-in for a writer crashing (or wedging) inside the collective
+    checkpoint plane.
+
+    Installed as an ``io/ckptio.py`` fault hook for its ``with`` scope;
+    every :func:`~zhpe_ompi_tpu.io.ckptio.fault_point` call for this
+    rank counts against the plan's seam schedules.  Firing semantics
+    per action:
+
+    - ``"exit"``: the thread-plane crash — expected-failure
+      bookkeeping, transport severed, :class:`~.ulfm.RankKilled`
+      unwinds whichever thread hit the seam (the async writer's death
+      surfaces at the owner's next ``save``/``wait``);
+    - ``"kill9"``: the real-process crash — ``SIGKILL`` self, nothing
+      unwinds, survivors classify the corpse (the drill that proves a
+      torn stream never becomes a complete manifest);
+    - ``"wedge"``: sleep inside the write attempt until the
+      ``ckpt_write_deadline_s`` watchdog expires it — then inert, so
+      the retry ladder's next attempt lands (the bounded-wedge drill).
+    """
+
+    def __init__(self, plan: FaultPlan, rank: int, ep=None, state=None):
+        self.rank = int(rank)
+        self._ep = ep
+        self._state = state if state is not None else (
+            _state_of(ep) if ep is not None else None)
+        self._faults = [dict(f, count=0, fired=0)
+                        for f in plan.ckpt_faults_for(rank)]
+        self._remove = None
+        self._lock = threading.Lock()
+
+    def __enter__(self) -> "CkptSeamContext":
+        from ..io import ckptio
+
+        self._remove = ckptio.install_fault_hook(self)
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        if self._remove is not None:
+            self._remove()
+            self._remove = None
+        return False
+
+    def __call__(self, seam: str, rank: int, **info: Any) -> None:
+        if rank != self.rank:
+            return
+        fire = None
+        with self._lock:
+            for f in self._faults:
+                if f["seam"] != seam:
+                    continue
+                f["count"] += 1
+                if f["count"] <= f["after"] or f["fired"] >= f["times"]:
+                    continue
+                f["fired"] += 1
+                fire = f
+                break
+        if fire is not None:
+            self._fire(fire, seam)
+
+    def _fire(self, f: dict, seam: str) -> None:
+        if f["action"] == "wedge":
+            time.sleep(f["hold_s"])
+            return
+        if f["action"] == "kill9":
+            os.kill(os.getpid(), signal.SIGKILL)
+        if self._state is not None:
+            ulfm.expect_failure(self._state, self.rank)
+        if self._ep is not None:
+            _kill_transport(self._ep, "exit")
+        raise ulfm.RankKilled(self.rank, f"ckpt-{seam}")
+
+
+def corrupt_ckpt_shard(directory: str, step: int | None = None,
+                       leaf: int = 0, rank: int = 0) -> str:
+    """The corrupt-a-shard-on-disk fault point: flip one manifest-
+    recorded shard's bytes (delegates to :func:`~zhpe_ompi_tpu.io.
+    ckptio.corrupt_shard`).  Restore must reject the step by digest
+    (``ckpt_integrity_rejects``) and degrade to the previous complete
+    one — never a silent acceptance, never a raise mid-recovery."""
+    from ..io import ckptio
+
+    return ckptio.corrupt_shard(directory, step, leaf, rank)
 
 
 def _state_of(ep) -> "ulfm.FailureState | None":

@@ -48,6 +48,7 @@ from typing import Any, Callable
 
 from ..core import errors
 from ..runtime import flightrec
+from ..runtime import spc
 from ..runtime import ztrace
 from . import ulfm
 from .ulfm import agree_failed_set  # noqa: F401  (pipeline step 2)
@@ -105,9 +106,30 @@ def rollback(checkpointer, step: int | None = None, shardings=None):
     """Step 4/6: restore the last (or a named) quiescent checkpoint —
     used identically by survivors rolling back and by the replacement
     restoring its state from the snapshot instead of replaying logs.
-    Registers the directory with the hygiene gate."""
+    Registers the directory with the hygiene gate.
+
+    This is the ROLLBACK LEG of the recovery pipeline, named on every
+    postmortem: the ``ckpt_restore`` flightrec event (restored step +
+    restore bytes + integrity rejects ride it, so :func:`mttr_legs`
+    reports the leg and a bandwidth) and a ``rollback`` ztrace span
+    (the critical-path entry ``tools/ztrace`` merges into the
+    per-fault timeline)."""
     register_recovery_dir(checkpointer.directory)
-    return checkpointer.restore(step, shardings)
+    sp = ztrace.begin(ztrace.ROLLBACK, -1, dir=checkpointer.directory) \
+        if ztrace.active else None
+    before = spc.snapshot()
+    out = checkpointer.restore(step, shardings)
+    restored = out[1] if isinstance(out, tuple) else step
+    after = spc.snapshot()
+    rbytes = after.get("ckpt_restore_bytes", 0) \
+        - before.get("ckpt_restore_bytes", 0)
+    rejects = after.get("ckpt_integrity_rejects", 0) \
+        - before.get("ckpt_integrity_rejects", 0)
+    flightrec.record(flightrec.CKPT_RESTORE, step=restored,
+                     bytes=rbytes, integrity_rejects=rejects)
+    if sp is not None:
+        sp.end(step=restored, bytes=rbytes)
+    return out
 
 
 def await_rejoin(ep, rank: int, timeout: float = 30.0) -> bool:
@@ -491,8 +513,10 @@ def mttr_legs(window: list[dict], anchors: tuple[float, int],
     live on.  For every fault classification event (``daemon_fault`` /
     ``device_fault``, optionally filtered to one ``job``) the walk
     collects the FIRST of each recovery-leg event that follows it for
-    the same job — ``respawn`` (the relaunch RPC batch) and ``resize``
-    split by kind into ``shrink``/``grow`` — as milliseconds since the
+    the same job — ``respawn`` (the relaunch RPC batch), ``resize``
+    split by kind into ``shrink``/``grow``, and ``rollback`` (the
+    ``ckpt_restore`` checkpoint-restore leg, with its restore bytes so
+    the report can derive a bandwidth) — as milliseconds since the
     classification.  Report-only by design: the legs a 1-CPU container
     measures are ordering truth, not latency truth."""
     anchor_wall, anchor_mono_ns = anchors
@@ -528,6 +552,11 @@ def mttr_legs(window: list[dict], anchors: tuple[float, int],
             elif etype == flightrec.RESIZE:
                 leg = "shrink" if later.get("kind") == "shrink" \
                     else "grow"
+            elif etype == flightrec.CKPT_RESTORE:
+                leg = "rollback"
+                if "rollback_bytes" not in rec:
+                    rec["rollback_bytes"] = int(later.get("bytes", 0))
+                    rec["rollback_step"] = later.get("step")
             elif etype in (flightrec.DAEMON_FAULT,
                            flightrec.DEVICE_FAULT):
                 break  # next fault: its own record owns what follows

@@ -37,9 +37,12 @@ from .file import (
     File,
     delete,
 )
+from .ckptio import CheckpointWriteError, CollectiveCheckpointer
 from .sharded import load_sharded, save_sharded
 
 __all__ = [
+    "CollectiveCheckpointer",
+    "CheckpointWriteError",
     "File",
     "delete",
     "MODE_RDONLY",

@@ -49,6 +49,7 @@ from typing import Any, Callable
 from ..core import errors
 from ..ft import recovery
 from ..mca import output as mca_output
+from ..runtime import spc
 
 _stream = mca_output.open_stream("ftloop")
 
@@ -129,9 +130,19 @@ class FtTrainLoop:
         return inner
 
     def _checkpoint(self) -> None:
-        # blocking: the step boundary IS the quiescent point, and a
-        # background writer racing a fault's rollback helps nobody
-        self.ckpt.save(self.step_i, self.state, blocking=True)
+        # a collective checkpointer (io/ckptio.py, async_capable)
+        # snapshots NOW and streams in the background, re-bound to the
+        # current live window first so its gather cids revoke with the
+        # mesh; steps keep committing while the previous checkpoint
+        # drains (counted as ckpt_async_overlapped in run()).  The
+        # serial Checkpointer stays blocking: a background pickle
+        # racing a fault's rollback helps nobody
+        bind = getattr(self.ckpt, "bind", None)
+        if callable(bind):
+            bind(self.live)
+        self.ckpt.save(
+            self.step_i, self.state,
+            blocking=not getattr(self.ckpt, "async_capable", False))
 
     def restore(self, shardings=None) -> int:
         """Adopt the newest checkpoint (replacement ranks call this
@@ -166,6 +177,10 @@ class FtTrainLoop:
                             self.live, self.state, self.step_i)
                     self.step_i += 1
                     self.losses.append(float(loss))
+                    if getattr(self.ckpt, "in_flight", False):
+                        # the overlap gate: this step committed while
+                        # the previous checkpoint's stream still drains
+                        spc.record("ckpt_async_overlapped")
                     if self.step_i % self.ckpt_every == 0 \
                             or self.step_i == steps:
                         self._checkpoint()
@@ -181,6 +196,11 @@ class FtTrainLoop:
                     # rank's parked collective — same recovery,
                     # different messenger
                     self._recover()
+            # drain the last checkpoint's stream (and surface a
+            # writer's pending failure) before declaring the run done
+            wait = getattr(self.ckpt, "wait", None)
+            if callable(wait):
+                wait()
             # training done: one barrier before the caller finalizes,
             # so a fast rank's goodbye can never poison a peer still
             # receiving the last step's contributions (finalize skew —

@@ -40,6 +40,7 @@ import jax
 from ..core import errors
 from ..io import sharded
 from ..mca import output as mca_output
+from . import flightrec
 
 _stream = mca_output.open_stream("checkpoint")
 
@@ -125,6 +126,8 @@ class Checkpointer:
         with self._op_lock:
             # zlint: disable=ZL002 -- PR 2 contract: save/wait/restore serialize under ONE RLock; the joined writer never takes it (no cycle) and callers accept checkpoint-grade latency
             self.wait()  # one outstanding checkpoint at a time (orbax)
+            flightrec.record(flightrec.CKPT_BEGIN, step=int(step),
+                             plane="serial")
             leaves, treedef = jax.tree_util.tree_flatten(state)
             # snapshot to host before returning control (np.array COPIES
             # even for host leaves — the caller may overwrite its buffers
@@ -177,6 +180,8 @@ class Checkpointer:
             shutil.rmtree(old, ignore_errors=True)
         else:
             os.replace(tmp, final)  # atomic publish
+        flightrec.record(flightrec.CKPT_COMMIT, step=int(step),
+                         plane="serial")
         mca_output.verbose(1, _stream, "checkpoint step %d written", step)
         self._retain()
 

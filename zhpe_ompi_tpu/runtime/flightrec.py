@@ -59,9 +59,17 @@ DAEMON_FAULT = "daemon_fault"  # runtime/dvm.py fault routing (a rank's
 DEVICE_FAULT = "device_fault"  # parallel/mesh.py device liveness probe:
                            # a missed deadline classified cause="device"
                            # (probe kind + victim rank ride the event)
+CKPT_BEGIN = "ckpt_begin"  # io/ckptio.py collective checkpoint write
+                           # accepted (snapshot captured, stream begins)
+CKPT_COMMIT = "ckpt_commit"  # io/ckptio.py manifest published atomically
+                           # (steps between begin/commit = async overlap)
+CKPT_RESTORE = "ckpt_restore"  # ft/recovery.py rollback leg: restore
+                           # from the newest COMPLETE step (bytes +
+                           # step + integrity rejects ride the event)
 
 ALL_EVENTS = (SEND, RECV, MATCH, COLL_ENTER, COLL_EXIT, FT_CLASS,
-              REVOKE, RESPAWN, RESIZE, DAEMON_FAULT, DEVICE_FAULT)
+              REVOKE, RESPAWN, RESIZE, DAEMON_FAULT, DEVICE_FAULT,
+              CKPT_BEGIN, CKPT_COMMIT, CKPT_RESTORE)
 
 #: hot-path gate (the peruse cost discipline): seams check this bare
 #: module attribute before paying the record() call.  False until a

@@ -254,6 +254,47 @@ Device-plane liveness counters (the device half of the fault loop —
   per-step guard could never see); each also counts in
   ``device_faults`` via the shared classify path.
 
+Checkpoint-I/O-plane counters (the OMPIO-analog collective
+checkpoint/restore plane — ``io/ckptio.py`` records them at the
+two-phase writer, the digest-verified restore, and the deadline-bounded
+fbtl stream; ``models/ftloop.py`` records the overlap gate):
+
+- ``ckpt_shards_written`` — shards an aggregator streamed through the
+  fbtl backend into a checkpoint step directory (one per leaf-shard a
+  rank contributed that the delta pass did not skip).
+- ``ckpt_bytes_written`` — payload bytes of those shards (the
+  checkpoint write bandwidth numerator).
+- ``ckpt_gather_bytes`` — bytes non-aggregator ranks sent to their
+  HOST's aggregator in the two-phase exchange's shuffle phase (rides
+  the han locality groups over sm — the wire-delta gate asserts this
+  scales as one send per rank, never the flat all-pairs O(n²)).
+- ``ckpt_delta_skips`` — shards an incremental checkpoint SKIPPED
+  because the manifest digest matched the previous step's (the delta
+  pass re-links the prior shard instead of re-writing it).
+- ``ckpt_async_overlapped`` — training steps that COMMITTED while a
+  previous step's checkpoint was still draining on the async writer
+  (steps between ``ckpt_begin`` and ``ckpt_commit`` flightrec events;
+  the snapshot-then-stream overlap gate — zero means the plane
+  degenerated to blocking).
+- ``ckpt_integrity_rejects`` — shards whose manifest digest FAILED
+  verification at restore (torn/partial/corrupt on disk): each is
+  counted, the step is disqualified, and restore degrades LOUDLY to
+  the newest complete earlier step — never a silent unpickle, never a
+  raise mid-recovery.
+- ``ckpt_degraded_restores`` — restores that could not use the newest
+  manifest (integrity reject or incomplete manifest) and fell back to
+  an earlier complete step.
+- ``ckpt_write_retries`` — fbtl writes that missed their
+  ``ckpt_write_deadline_s`` watchdog window or raised, and were
+  retried with backoff (``ckpt_write_retries`` attempts max before a
+  typed failure).
+- ``ckpt_write_deadline_failures`` — writes that exhausted the retry
+  budget and surfaced as a typed ``CheckpointWriteError`` (the wedge
+  became a FAULT, never a hang).
+- ``ckpt_restore_bytes`` — payload bytes read back by a
+  digest-verified restore (the restore-bandwidth numerator the MTTR
+  rollback leg divides by its span duration).
+
 Observability-plane counters (the fleet-visible metrics plane —
 recorded by this module's :class:`MetricsPublisher` and by
 ``runtime/flightrec.py``):

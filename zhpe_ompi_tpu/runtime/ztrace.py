@@ -88,10 +88,16 @@ DEVICE_PROBE = "device_probe"  # device liveness probe round
                        # is the recovery timeline's device-fault root
 REMESH = "remesh"      # survivor-mesh rebuild + re-shard legs
                        # (parallel/mesh.py survivor_mesh, zero.reshard)
+CKPT = "ckpt"          # io/ckptio.py collective checkpoint write: the
+                       # two-phase exchange + fbtl stream as one span
+ROLLBACK = "rollback"  # ft/recovery.py checkpoint-restore leg of a
+                       # recovery (digest-verified manifest load +
+                       # survivor-mesh re-slice) — named on the
+                       # critical path by tools/ztrace postmortems
 
 ALL_KINDS = (SEND, RECV, DELIVER, MATCH, RTS, CTS, PUSH, PHASE, COLL,
              FT_CLASS, AGREE, SHRINK, RESPAWN, RESIZE, DEVICE_PROBE,
-             REMESH)
+             REMESH, CKPT, ROLLBACK)
 
 #: hot-path gate (the peruse discipline): seams check this bare module
 #: attribute before paying anything — False means no span dicts, no

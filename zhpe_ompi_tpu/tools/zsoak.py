@@ -42,8 +42,19 @@ postmortem: detect/respawn/resize legs out of the shared flight
 recorder's window (:func:`~zhpe_ompi_tpu.ft.recovery.mttr_legs`)
 merged with the harness's own injection stamps, plus the daemon's
 stat-RPC counter aggregates and any fleet-visible metrics snapshots
-the fault jobs published.  The MTTR table is REPORT-ONLY by design: a
-1-CPU container measures ordering truth, not latency truth.
+the fault jobs published.  Fault jobs also launch with ``trace=True``,
+so their ranks' ztrace buffers ride the metrics publisher into the
+root store (surviving the kill -9 victim) and the postmortem prints a
+ztrace-MERGED per-fault timeline — the recovery legs (agree / shrink /
+respawn / checkpoint-restore rollback) as clock-corrected spans with
+the critical-path leg named.  The MTTR table is REPORT-ONLY by design:
+a 1-CPU container measures ordering truth, not latency truth.
+
+One more per-cycle invariant: the root PMIx store's state is
+serialized (namespace → sorted published keys) before the storms
+start, and every cycle must return the store to that byte-identical
+baseline — a leaked job namespace, trace buffer, or metrics key is
+residue, and residue is a violation.
 
 Usage::
 
@@ -57,6 +68,7 @@ from __future__ import annotations
 import argparse
 import glob
 import io
+import json
 import os
 import random
 import signal
@@ -378,7 +390,8 @@ class _TenantJob:
 
     def __init__(self, harness: "_Harness", name: str, n: int,
                  argv: list[str], expect: set[int], *, ft: bool = False,
-                 metrics: bool = False, placement: str | None = None,
+                 metrics: bool = False, trace: bool = False,
+                 placement: str | None = None,
                  priority: int = 0, max_size: int | None = None,
                  timeout: float = 150.0):
         self.name = name
@@ -393,7 +406,7 @@ class _TenantJob:
             try:
                 self.result["rc"] = self.cli.launch(
                     n, argv, ft=ft, mca=mca, metrics=metrics,
-                    placement=placement, priority=priority,
+                    trace=trace, placement=placement, priority=priority,
                     max_size=max_size, timeout=timeout,
                     stdout=self.out, stderr=self.err)
             except errors.MpiError as e:
@@ -438,8 +451,12 @@ class _Harness:
         self.violations: list[str] = []
         self.injections: list[dict] = []   # {job, kind, t_wall, cycle}
         self.metrics_snaps: list[dict] = []
+        self.trace_snaps: list[dict] = []  # {job, name, payloads}
         self.fault_jobs = 0
         self.counters0 = spc.snapshot()
+        # the pre-storm store baseline every cycle must return to,
+        # byte-identical (namespace → sorted published keys)
+        self.store_baseline = self.store_snapshot()
 
     # -- small utilities --------------------------------------------------
 
@@ -501,6 +518,80 @@ class _Harness:
         self.injections.append({"job": job_id, "kind": kind,
                                 "cycle": cycle, "t_wall": time.time()})
 
+    def store_snapshot(self) -> str:
+        """The root PMIx store's state, serialized deterministically:
+        namespace → sorted published key names, canonical JSON.  Two
+        equal store states produce byte-identical snapshots, so cycle
+        residue is a string comparison."""
+        store = self.tree.root.store
+        snap = {}
+        for ns in store.namespaces():
+            try:
+                snap[ns] = sorted(store.lookup(ns))
+            except errors.MpiError:
+                snap[ns] = ["<lookup-failed>"]
+        return json.dumps(snap, sort_keys=True)
+
+    def check_store_residue(self, cycle: int) -> None:
+        """End-of-cycle invariant: the store must return to the
+        pre-storm baseline, byte-identical.  A short grace window
+        absorbs namespace-teardown lag; whatever remains after it is
+        residue — a leaked job namespace, trace buffer, or metrics
+        key — and residue is a violation."""
+        deadline = time.monotonic() + 5.0
+        while True:
+            snap = self.store_snapshot()
+            if snap == self.store_baseline:
+                return
+            if time.monotonic() > deadline:
+                break
+            time.sleep(0.1)
+        base = json.loads(self.store_baseline)
+        now = json.loads(snap)
+        added = {
+            ns: sorted(set(keys) - set(base.get(ns, [])))
+            for ns, keys in now.items()
+            if set(keys) - set(base.get(ns, []))
+        }
+        removed = {
+            ns: sorted(set(keys) - set(now.get(ns, [])))
+            for ns, keys in base.items()
+            if set(keys) - set(now.get(ns, []))
+        }
+        self.violate(
+            f"cycle {cycle}: PMIx store residue — end-of-cycle "
+            f"snapshot is not byte-identical to the pre-storm "
+            f"baseline (added={added}, removed={removed})")
+
+    def grab_traces(self, job: _TenantJob, expect: int = 1) -> None:
+        """Best-effort ztrace payload grab from the IN-PROCESS root
+        store while the fault job's namespace is still alive: the
+        ``trace:<job>:<rank>`` buffers ride the metrics publisher, so
+        a kill -9 victim's last window survives it.  Waits briefly for
+        a window that contains the fault classification (the publisher
+        cadence lags the recovery)."""
+        if job.job_id is None:
+            return
+        store = self.tree.root.store
+        deadline = time.monotonic() + 8.0
+        payloads: list[dict] = []
+        while time.monotonic() < deadline:
+            try:
+                found = store.lookup(job.job_id, "trace:")
+            except errors.MpiError:
+                return
+            payloads = [v for _, v in sorted(found.items())
+                        if isinstance(v, dict)]
+            if len(payloads) >= expect and any(
+                    s.get("kind") == "ft_class"
+                    for p in payloads for s in p.get("spans", ())):
+                break
+            time.sleep(0.2)
+        if payloads:
+            self.trace_snaps.append(
+                {"job": job.job_id, "name": job.name,
+                 "payloads": payloads})
+
     def grab_metrics(self, job: _TenantJob) -> None:
         """Best-effort fleet-visible snapshot while the fault job is
         still live (its namespace — and the published flightrec
@@ -559,6 +650,7 @@ class _Harness:
         if leftovers:
             self.violate(f"cycle {plan['cycle']}: admission tickets "
                          f"leaked mid-run: {leftovers}")
+        self.check_store_residue(plan["cycle"])
 
     def cycle_storm(self, plan: dict) -> None:
         i, scenario, victim = plan["cycle"], plan["scenario"], \
@@ -573,14 +665,15 @@ class _Harness:
                 job = _TenantJob(
                     self, f"c{i}-rank_kill", 3,
                     [self.progs["park"], tok_a, str(victim)], {137},
-                    ft=True, metrics=True, placement="spread")
+                    ft=True, metrics=True, trace=True,
+                    placement="spread")
                 self.drive_rank_kill(i, job, victim)
             elif scenario == "recover":
                 ckpt = os.path.join(self.workdir, f"ckpt_{i}")
                 job = _TenantJob(
                     self, f"c{i}-recover", 3,
                     [self.progs["recover"], tok_a, str(victim), ckpt],
-                    {0}, ft=True, metrics=True)
+                    {0}, ft=True, metrics=True, trace=True)
                 self.drive_recover(i, job)
             else:  # elastic
                 job = _TenantJob(
@@ -621,6 +714,7 @@ class _Harness:
             self.violate(f"cycle {i}: kill -9 {pid} failed: {e}")
             return
         if job.wait_output("SURVIVOR-OK", n - 1):
+            self.grab_traces(job, expect=n - 1)
             self.grab_metrics(job)
             self.fault_jobs += 1
 
@@ -633,6 +727,7 @@ class _Harness:
             time.sleep(0.05)
         self.inject(job.job_id, "suicide", i)
         if job.wait_output("SURVIVOR-OK", 2, timeout=120.0):
+            self.grab_traces(job, expect=2)
             self.grab_metrics(job)
             self.fault_jobs += 1
 
@@ -708,7 +803,8 @@ class _Harness:
                     self, f"c{i}-daemon_kill", 4,
                     [self.progs["park"], f"c{i}a",
                      ",".join(str(v) for v in victims)], {137},
-                    ft=True, metrics=True, placement="exclusive")
+                    ft=True, metrics=True, trace=True,
+                    placement="exclusive")
                 if not job.wait_output("READY", 4):
                     self.violate(
                         f"cycle {i}: daemon_kill job never READY: "
@@ -728,6 +824,7 @@ class _Harness:
                 except errors.MpiError as e:
                     self.violate(f"cycle {i}: daemon kill failed: {e}")
                 if job.wait_output("SURVIVOR-OK", 4 - len(victims)):
+                    self.grab_traces(job, expect=4 - len(victims))
                     self.grab_metrics(job)
                     self.fault_jobs += 1
             self.check_rc(i, job)
@@ -817,8 +914,8 @@ class _Harness:
               f"event(s); report-only — ordering truth, not latency "
               f"truth):")
         print(f"  {'job':8s} {'cause':12s} {'deaths':10s} "
-              f"{'detect_ms':>10s} {'respawn_ms':>11s} "
-              f"{'shrink_ms':>10s} {'grow_ms':>9s}")
+              f"{'detect_ms':>10s} {'rollback_ms':>12s} "
+              f"{'respawn_ms':>11s} {'shrink_ms':>10s} {'grow_ms':>9s}")
         injected = {inj["job"]: inj for inj in self.injections
                     if inj["job"] is not None}
         for rec in legs:
@@ -832,8 +929,32 @@ class _Harness:
 
             print(f"  {str(rec['job']):8s} {str(rec['cause']):12s} "
                   f"{str(rec['deaths']):10s} {detect:>10s} "
-                  f"{leg('respawn'):>11s} {leg('shrink'):>10s} "
-                  f"{leg('grow'):>9s}")
+                  f"{leg('rollback'):>12s} {leg('respawn'):>11s} "
+                  f"{leg('shrink'):>10s} {leg('grow'):>9s}")
+        if self.trace_snaps:
+            from . import ztrace as ztrace_tool
+
+            print(f"\nzsoak: ztrace-merged per-fault timelines "
+                  f"({len(self.trace_snaps)} fault job(s); "
+                  f"clock-corrected spans, critical-path leg named):")
+            for snap in self.trace_snaps[-4:]:
+                spans = ztrace_tool.corrected_spans(snap["payloads"],
+                                                    None)
+                recoveries = ztrace_tool._recovery_legs(spans)
+                if not recoveries:
+                    print(f"  {snap['name']}: no recovery spans in "
+                          f"the published windows")
+                    continue
+                for rec in recoveries:
+                    print(f"  {snap['name']}: victim {rec['victim']} "
+                          f"({rec['cause']}), "
+                          f"{len(rec['legs'])} leg span(s)")
+                    for s in sorted(rec["legs"],
+                                    key=lambda s: s["ts"]):
+                        mark = "  <-- critical path" \
+                            if s is rec["longest"] else ""
+                        print(f"    {s['kind']:8s} rank {s['tid']} "
+                              f"{s['dur'] * 1e3:9.2f} ms{mark}")
         if self.metrics_snaps:
             print(f"\nzsoak: fleet-visible metrics snapshots "
                   f"({len(self.metrics_snaps)} fault job(s)):")

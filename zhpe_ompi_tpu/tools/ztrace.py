@@ -319,8 +319,9 @@ def _coll_instances(spans: list[dict]) -> list[dict]:
 
 def _recovery_legs(spans: list[dict]) -> list[dict]:
     """Per FT classification (crash causes only): the recovery spans
-    that follow it — agreement, shrink, respawn — with the longest
-    leg named.  Goodbyes are orderly departures, not recoveries."""
+    that follow it — agreement, shrink, respawn, and the rollback
+    (checkpoint-restore) leg — with the longest leg named.  Goodbyes
+    are orderly departures, not recoveries."""
     events = []
     for ft in spans:
         if ft["kind"] != "ft_class" or ft.get("cause") == "goodbye":
@@ -346,7 +347,7 @@ def _recovery_legs(spans: list[dict]) -> list[dict]:
             else float("inf")
         legs = [
             s for s in spans
-            if s["kind"] in ("agree", "shrink", "respawn")
+            if s["kind"] in ("agree", "shrink", "respawn", "rollback")
             and ft["ts"] - _EPS_S <= s["ts"] < upper - _EPS_S
         ]
         out.append({
