@@ -1949,6 +1949,285 @@ def _print_resize_table(rows: list[dict]) -> None:
               f"{r['median_ms']:>12.1f}")
 
 
+def bench_scale(ns: tuple = (8, 32, 128), reps: int = 3,
+                launch_ranks: int = 8) -> list[dict]:
+    """Scale-out fabric ladder (the 512-rank-universe win): wire-up and
+    per-death flood cost vs universe size on the thread plane, plus the
+    launch RTT vs tree depth on a resident DVM.
+
+    Latency columns are report-only (single-CPU container); the GATES
+    are the deterministic counters —
+
+    - ``tcp_lazy_connects`` per wire-up stays ≪ n² (the eager all-pairs
+      shape the lazy connect ladder replaced), and per-rank live
+      sockets/channels fit ``2·log2(n)+4`` with the same constants at
+      every n;
+    - flood frames per death (``ft_overlay_hops``) stay under
+      ``2·log2(n)+2`` per surviving rank — the log-degree overlay, not
+      an all-pairs fallback — and kill → universe-wide classification
+      beats 2 s via the transport reset;
+    - the ROOT store's get traffic is FLAT vs tree depth: a deeper tree
+      serves the same job from leaf caches
+      (``dvm_store_cache_hits``) without multiplying root gets, and
+      remote ranks spawn via tree frames
+      (``dvm_tree_routed_launches``)."""
+    import io
+    import math
+    import tempfile
+    import threading
+
+    from zhpe_ompi_tpu import ops
+    from zhpe_ompi_tpu.core import errhandler as errh
+    from zhpe_ompi_tpu.core import errors
+    from zhpe_ompi_tpu.ft import ulfm
+    from zhpe_ompi_tpu.pt2pt.tcp import TcpProc
+    from zhpe_ompi_tpu.runtime import dvm as dvm_mod
+    from zhpe_ompi_tpu.runtime import dvmtree
+    from zhpe_ompi_tpu.runtime import spc
+
+    rows: list[dict] = []
+
+    def universe(n, fn, ft=False):
+        coord_ready = threading.Event()
+        coord_addr = [None]
+        results = [None] * n
+        procs = [None] * n
+        excs = [None] * n
+        sync = threading.Barrier(n)
+
+        def publish(addr):
+            coord_addr[0] = addr
+            coord_ready.set()
+
+        def main(rank):
+            p = None
+            try:
+                if rank == 0:
+                    p = TcpProc(0, n, coordinator=("127.0.0.1", 0),
+                                on_coordinator_bound=publish, sm=False,
+                                ft=ft)
+                else:
+                    coord_ready.wait(30)
+                    p = TcpProc(rank, n, coordinator=coord_addr[0],
+                                sm=False, ft=ft)
+                procs[rank] = p
+                results[rank] = fn(p, sync)
+            except BaseException as e:  # noqa: BLE001
+                excs[rank] = e
+                coord_ready.set()
+                try:
+                    sync.abort()
+                except Exception:  # noqa: BLE001 - already broken
+                    pass
+            finally:
+                if p is not None and not p._ft_dead:
+                    p.close()
+
+        threads = [threading.Thread(target=main, args=(r,))
+                   for r in range(n)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(180)
+            assert not t.is_alive(), "scale bench rank hung"
+        for p in procs:
+            if p is not None and p._ft_dead:
+                p.close()
+        for e in excs:
+            if e is not None:
+                raise e
+        return results
+
+    # -- rung 1: wire-up ladder (lazy connects + per-rank resources) --
+    for n in ns:
+        lazy0 = spc.read("tcp_lazy_connects")
+        t0 = time.perf_counter()
+
+        def wire_prog(p, sync):
+            p.barrier()
+            p.allreduce(np.float64(p.rank), ops.SUM)
+            sync.wait(60)
+            stats = p.resource_stats()
+            sync.wait(60)
+            return stats
+
+        stats = universe(n, wire_prog)
+        wire_s = time.perf_counter() - t0
+        lazy = spc.read("tcp_lazy_connects") - lazy0
+        max_socks = max(s["sockets"] for s in stats)
+        max_chans = max(s["channels"] for s in stats)
+        bound = 2 * math.log2(n) + 4
+        assert max_socks <= bound and max_chans <= bound, \
+            (n, max_socks, max_chans)
+        if n >= 32:
+            assert lazy < n * n // 4, (n, lazy)
+        rows.append({
+            "op": "scale-wireup", "n": n, "wireup_ms": wire_s * 1e3,
+            "lazy_connects": lazy, "max_sockets": max_socks,
+            "max_channels": max_chans,
+        })
+
+    # -- rung 2: flood frames + classification latency per death -----
+    from zhpe_ompi_tpu.mca import var as mca_var
+
+    saved = {v.name: (v._value, v._source)
+             for v in mca_var.registry.all_vars()}
+    mca_var.set_var("ft_detector_period", 2.0)
+    mca_var.set_var("ft_detector_timeout", 60.0)
+    try:
+        for n in ns:
+            victim = n - 1
+            hops0 = [None]
+            t_sever = [None]
+            hops_delta = [None]
+            survivors = threading.Barrier(n - 1)
+
+            def flood_prog(p, sync, n=n, victim=victim, hops0=hops0,
+                           t_sever=t_sever, hops_delta=hops_delta,
+                           survivors=survivors):
+                p.set_errhandler(errh.ERRORS_RETURN)
+                if p.rank == 0:
+                    p.send(b"warm", dest=victim, tag=1)
+                    p.recv(source=victim, tag=2, timeout=30.0)
+                elif p.rank == victim:
+                    p.recv(source=0, tag=1, timeout=30.0)
+                    p.send(b"ack", dest=0, tag=2)
+                sync.wait(90)
+                if p.rank == victim:
+                    ulfm.expect_failure(p.ft_state, victim)
+                    hops0[0] = spc.read("ft_overlay_hops")
+                    t_sever[0] = time.monotonic()
+                    p.sever()
+                    return None
+                if p.rank == 0:
+                    time.sleep(0.05)
+                    try:
+                        p.send(b"poke", dest=victim, tag=3)
+                    except errors.ProcFailed:
+                        pass
+                assert p.ft_state.wait_failed(victim, timeout=10.0)
+                elapsed = time.monotonic() - t_sever[0]
+                p.failure_ack()
+                survivors.wait(60)
+                if p.rank == 0:
+                    time.sleep(0.2)
+                    hops_delta[0] = \
+                        spc.read("ft_overlay_hops") - hops0[0]
+                survivors.wait(60)
+                return elapsed
+
+            res = universe(n, flood_prog, ft=True)
+            per_rank = hops_delta[0] / (n - 1)
+            classify_s = max(r for r in res if r is not None)
+            assert per_rank <= 2 * math.log2(n) + 2, (n, per_rank)
+            assert classify_s < 2.0, (n, classify_s)
+            rows.append({
+                "op": "scale-flood", "n": n,
+                "flood_frames": hops_delta[0],
+                "frames_per_rank": per_rank,
+                "classify_ms": classify_s * 1e3,
+            })
+            ulfm.clear_expected_failures()
+    finally:
+        for v in mca_var.registry.all_vars():
+            if v.name in saved:
+                v._value, v._source = saved[v.name]
+
+    # -- rung 3: launch RTT vs tree depth (root gets must stay flat) --
+    if not launch_ranks:  # the thread-plane-only fast gate shape
+        return rows
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    prog = tempfile.NamedTemporaryFile(
+        "w", suffix="_scale_probe.py", delete=False)
+    prog.write(
+        f"import sys\nsys.path.insert(0, {repo!r})\n"
+        "import zhpe_ompi_tpu as zmpi\n"
+        "p = zmpi.host_init()\np.barrier()\nzmpi.host_finalize()\n"
+    )
+    prog.close()
+    try:
+        gets_by_depth: dict[int, int] = {}
+        for depth, ndaemons, fanout in ((0, 1, None), (1, 3, 2),
+                                        (3, 4, 1)):
+            tree = dvmtree.spawn_tree(ndaemons, fanout=fanout,
+                                      in_process=True)
+            try:
+                cli = dvm_mod.DvmClient(tree.root_address)
+                gets0 = spc.read("pmix_gets")
+                hits0 = spc.read("dvm_store_cache_hits")
+                routed0 = spc.read("dvm_tree_routed_launches")
+                times = []
+                for _ in range(reps):
+                    out, err = io.StringIO(), io.StringIO()
+                    t0 = time.perf_counter()
+                    rc = cli.launch(launch_ranks, [prog.name],
+                                    timeout=180.0, tag_output=False,
+                                    stdout=out, stderr=err)
+                    times.append(time.perf_counter() - t0)
+                    assert rc == 0, err.getvalue()
+                gets = spc.read("pmix_gets") - gets0
+                hits = spc.read("dvm_store_cache_hits") - hits0
+                routed = spc.read("dvm_tree_routed_launches") - routed0
+                gets_by_depth[depth] = gets
+                if depth > 0:
+                    # the flat-vs-depth gates: leaf caches serve the
+                    # deeper tree's modex without multiplying root
+                    # gets, and remote ranks spawn via tree frames
+                    assert hits > 0, (depth, hits)
+                    assert routed > 0, (depth, routed)
+                    assert gets < gets_by_depth[0], \
+                        (depth, gets, gets_by_depth[0])
+                if depth == 3:
+                    assert gets <= gets_by_depth[1] * 3 // 2, \
+                        (gets, gets_by_depth[1])
+                rows.append({
+                    "op": "scale-launch", "depth": depth,
+                    "ndaemons": ndaemons, "nprocs": launch_ranks,
+                    "reps": reps, "best_ms": min(times) * 1e3,
+                    "median_ms": sorted(times)[len(times) // 2] * 1e3,
+                    "root_gets": gets, "cache_hits": hits,
+                    "routed_launches": routed,
+                })
+                cli.close()
+            finally:
+                tree.stop()
+    finally:
+        try:
+            os.unlink(prog.name)
+        except OSError:
+            pass
+    return rows
+
+
+def _print_scale_table(rows: list[dict]) -> None:
+    print("# scale-out fabric ladder (latency report-only; "
+          "counter gates enforced)")
+    wire = [r for r in rows if r["op"] == "scale-wireup"]
+    if wire:
+        print(f"{'n':>6} {'Wire-up (ms)':>14} {'lazy dials':>11} "
+              f"{'max socks':>10} {'max chans':>10}")
+        for r in wire:
+            print(f"{r['n']:>6} {r['wireup_ms']:>14.1f} "
+                  f"{r['lazy_connects']:>11d} {r['max_sockets']:>10d} "
+                  f"{r['max_channels']:>10d}")
+    flood = [r for r in rows if r["op"] == "scale-flood"]
+    if flood:
+        print(f"{'n':>6} {'Classify (ms)':>14} {'flood frames':>13} "
+              f"{'per rank':>9}")
+        for r in flood:
+            print(f"{r['n']:>6} {r['classify_ms']:>14.1f} "
+                  f"{r['flood_frames']:>13d} "
+                  f"{r['frames_per_rank']:>9.1f}")
+    launch = [r for r in rows if r["op"] == "scale-launch"]
+    if launch:
+        print(f"{'depth':>6} {'Best (ms)':>12} {'Median (ms)':>12} "
+              f"{'root gets':>10} {'hits':>7} {'routed':>7}")
+        for r in launch:
+            print(f"{r['depth']:>6} {r['best_ms']:>12.1f} "
+                  f"{r['median_ms']:>12.1f} {r['root_gets']:>10d} "
+                  f"{r['cache_hits']:>7d} {r['routed_launches']:>7d}")
+
+
 def _print_table(rows: list[dict]) -> None:
     if not rows:
         return
@@ -2030,6 +2309,13 @@ def main(argv: list[str] | None = None) -> int:
                         "hits must rise at depth >= 1 while the root "
                         "store's gets drop), counter-gated (runtime "
                         "plane)")
+    p.add_argument("--scale", action="store_true",
+                   help="scale-out fabric ladder: wire-up + per-death "
+                        "flood cost vs universe size (thread plane, "
+                        "n in {8,32,128}) and launch RTT vs tree "
+                        "depth — latency report-only, counter-gated "
+                        "(lazy dials ≪ n², flood frames per death "
+                        "O(log n), root store gets flat vs depth)")
     p.add_argument("--resize", action="store_true",
                    help="elastic resize ladder: grow/shrink round-trip "
                         "latency against a resident daemon (report-"
@@ -2076,6 +2362,14 @@ def main(argv: list[str] | None = None) -> int:
                 print(json.dumps(r))
         else:
             _print_launch_table(rows)
+        return 0
+    if args.scale:
+        rows = bench_scale(reps=max(min(args.iters, 5), 3))
+        if args.json:
+            for r in rows:
+                print(json.dumps(r))
+        else:
+            _print_scale_table(rows)
         return 0
     if args.resize:
         rows = bench_resize(reps=max(min(args.iters, 5), 3))

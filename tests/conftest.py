@@ -88,6 +88,20 @@ def _ulfm_detector_hygiene():
         f"close() (pinned caller buffers nobody will ever push): "
         f"{parked}"
     )
+    from zhpe_ompi_tpu.pt2pt import engine_mux as engine_mod
+
+    engines = engine_mod.live_engines()
+    assert not engines, (
+        f"channel-engine reader threads leaked past their owner's "
+        f"close() (every TcpProc/FramedRpcServer closes its engine in "
+        f"its teardown ladder): {engines}"
+    )
+    chans = engine_mod.leaked_channels()
+    assert not chans, (
+        f"framed channels still registered on an engine at session end "
+        f"(their owner unregistered neither on close nor on detach): "
+        f"{chans}"
+    )
     from zhpe_ompi_tpu.pt2pt import sm as sm_mod
 
     orphans = sm_mod.orphaned_ring_files()

@@ -495,8 +495,12 @@ class TestRevokePoisonsDirectPath:
             win.fence()
             t = 1 - p.rank
             win.put(np.float64(1.0), t, 0)  # mapped + direct: works
-            win.fence()
+            # asserted BEFORE the fence: rank 0 revokes right after its
+            # fence returns, and _direct() checks revocation ahead of
+            # the memo — a slower rank asserting post-fence races the
+            # revoke's arrival and sees None
             assert win._direct(t) is not None
+            win.fence()
             if p.rank == 0:
                 p.revoke(am_mod.AM_CID)
             deadline = time.monotonic() + 10

@@ -233,6 +233,37 @@ def test_overlap_rows_and_counter_gates():
     assert any(r["bytes"] > (16 << 10) for r in rows)
 
 
+def test_scale_rows_thread_plane(fresh_vars):
+    """Fast smoke for the --scale ladder (scale-out-fabric tentpole),
+    thread-plane rungs only: wire-up and per-death flood rows at small
+    n with every built-in counter gate enforced inside bench_scale —
+    per-rank sockets/channels under 2·log2(n)+4, flood frames per
+    death under 2·log2(n)+2, classification under 2 s."""
+    rows = osu_zmpi.bench_scale(ns=(8, 16), reps=1, launch_ranks=0)
+    wire = [r for r in rows if r["op"] == "scale-wireup"]
+    flood = [r for r in rows if r["op"] == "scale-flood"]
+    assert [r["n"] for r in wire] == [8, 16]
+    assert [r["n"] for r in flood] == [8, 16]
+    for r in wire:
+        assert r["wireup_ms"] > 0 and r["lazy_connects"] > 0
+    for r in flood:
+        assert r["classify_ms"] > 0 and r["flood_frames"] > 0
+
+
+@pytest.mark.slow
+def test_scale_ladder_with_launch_depth_rungs():
+    """CI gate for the full --scale ladder: the default n ladder plus
+    the launch-RTT-vs-depth rungs — root store gets must stay flat as
+    the tree deepens (leaf caches absorb the modex) and remote ranks
+    must spawn via tree frames; bench_scale raises on any violation."""
+    rows = osu_zmpi.bench_scale()
+    launch = [r for r in rows if r["op"] == "scale-launch"]
+    assert [r["depth"] for r in launch] == [0, 1, 3]
+    deep = launch[-1]
+    assert deep["cache_hits"] > 0 and deep["routed_launches"] > 0
+    assert deep["root_gets"] < launch[0]["root_gets"]
+
+
 @pytest.mark.slow
 def test_overlap_ladder_real_sizes():
     """CI gate at real sizes (nonblocking-engine satellite): at and

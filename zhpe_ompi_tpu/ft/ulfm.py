@@ -441,25 +441,35 @@ class FailureState:
 
     # -- agreed results --------------------------------------------------
 
-    def record_agreement(self, seq: int, result: Any) -> None:
+    def record_agreement(self, seq: int, result: Any) -> bool:
         """Publish a completed agreement's value: survivors that lose
         their coordinator mid-delivery converge on THIS result instead
         of re-running a round nobody can finish (see :func:`agree`).
         Values are arbitrary (bool for the flag AND-reduction, a
-        [pairs, epoch] list for the failed-set agreement)."""
+        [pairs, epoch] list for the failed-set agreement).  Returns
+        True when the value is NEWLY adopted — the overlay flood's
+        gossip-once relay predicate (a known value is never relayed
+        again, so the flood terminates)."""
         with self._cv:
+            fresh = int(seq) not in self._agreements
             self._agreements[int(seq)] = result
+        return fresh
 
     def agreement(self, seq: int) -> Any | None:
         return self._agreements.get(seq)
 
     # -- revocation ------------------------------------------------------
 
-    def revoke(self, cid: int) -> None:
+    def revoke(self, cid: int) -> bool:
+        """Poison ``cid``.  Returns True when the revocation is NEWLY
+        learned (the overlay flood's gossip-once relay predicate)."""
         with self._cv:
+            fresh = int(cid) not in self._revoked
             self._revoked.add(int(cid))
             self._cv.notify_all()
-        flightrec.record(flightrec.REVOKE, cid=int(cid))
+        if fresh:
+            flightrec.record(flightrec.REVOKE, cid=int(cid))
+        return fresh
 
     def alias_cid(self, cid: int, logical: int) -> None:
         """Declare ``cid`` a sub-channel of ``logical``: revocation of

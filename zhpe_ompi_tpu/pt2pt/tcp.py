@@ -62,7 +62,9 @@ from ..runtime import spc
 from ..runtime import ztrace
 from ..utils import dss
 from ..utils import lockdep
+from . import engine_mux
 from . import matching
+from . import overlay
 from . import sm as sm_mod
 from .matching import ANY_SOURCE, ANY_TAG, Envelope
 
@@ -118,6 +120,9 @@ _RNDV_DATA_CID = 0x7FF9
 # wire sentinel of an RTS announce (first element of a 4-tuple payload;
 # the remaining elements are sender_rank, rndv_id, nbytes)
 _RTS_MARK = "__zmpi_rndv_rts__"
+# fair-share rendezvous drain: a channel yields its push-pool worker
+# after this many items whenever another channel is queued behind it
+_PUSH_RR_QUANTUM = 8
 
 
 # eager/rendezvous switch sizing — the shared estimator (one
@@ -341,6 +346,12 @@ class _PushPool:
         deadline = time.monotonic() + timeout
         for t in threads:
             t.join(max(0.0, deadline - time.monotonic()))
+
+    def backlog(self) -> int:
+        """Queued-but-unclaimed work items — the fair-share rotation
+        reads this: a channel drain yields its worker only when some
+        OTHER channel is actually waiting for one."""
+        return self._q.qsize()
 
     def alive_threads(self) -> list[threading.Thread]:
         with self._lock:
@@ -630,8 +641,11 @@ class TcpProc(errh.HasErrhandler, ulfm.UlfmEndpointAPI, HostCollectives,
             int(mca_var.get("tcp_rndv_push_workers", 4)),
         )
         _live_push_pools.add(self._push_pool)
-        self._drains: list[threading.Thread] = []
-        self._drain_lock = lockdep.lock("tcp.TcpProc._drain_lock")
+        # ONE multiplexed channel engine per proc replaces the accept
+        # thread and every per-connection drain thread (the scale-out
+        # fabric's thread/fd bound: readers are O(1) in connection
+        # count); created with the listener below
+        self._chan_engine: engine_mux.ChannelEngine | None = None
         self._flood_threads: list[threading.Thread] = []
         self._flood_lock = lockdep.lock("tcp.TcpProc._flood_lock")
         self._dup_conns: list[socket.socket] = []  # crossed-connect extras
@@ -688,10 +702,10 @@ class TcpProc(errh.HasErrhandler, ulfm.UlfmEndpointAPI, HostCollectives,
             self._listener.listen(size + 4)
             self.address = self._listener.getsockname()
 
-            self._accept_thread = threading.Thread(
-                target=self._accept_loop, daemon=True
-            )
-            self._accept_thread.start()
+            self._chan_engine = engine_mux.ChannelEngine(f"tcp-r{rank}")
+            self._chan_engine.add_listener(self._listener,
+                                           self._on_accept)
+            self._chan_engine.start()
 
             # modex: address-book exchange through the coordinator.
             # `on_coordinator_bound(addr)` fires on rank 0 after the rendezvous
@@ -791,6 +805,8 @@ class TcpProc(errh.HasErrhandler, ulfm.UlfmEndpointAPI, HostCollectives,
             if self._metrics_pub is not None:
                 self._metrics_pub.stop()
                 self._metrics_pub = None
+            if self._chan_engine is not None:
+                self._chan_engine.close(1.0)
             if self._sm_seg is not None:
                 self._sm_seg.close()
             raise
@@ -1113,14 +1129,33 @@ class TcpProc(errh.HasErrhandler, ulfm.UlfmEndpointAPI, HostCollectives,
                     self._flood_threads.remove(t)
             raise
 
+    def _overlay_targets(self) -> list[int]:
+        """This rank's log-degree flood fan-out: skip-ring overlay
+        neighbors over the CURRENT live view (:mod:`.overlay`).
+        Failed/departed ranks drop out of the member list, so the
+        overlay is rebuilt from survivors at shrink by construction —
+        no membership protocol, every rank derives the same graph.
+        Live peers the old all-pairs flood would have dialed are
+        counted in ``tcp_deferred_dials`` (the scaling gate's
+        no-silent-fallback evidence)."""
+        live = [r for r in range(self.size)
+                if r == self.rank or not self.ft_state.is_failed(r)]
+        nbrs = overlay.neighbors(self.rank, live)
+        skipped = (len(live) - 1) - len(nbrs)
+        if skipped > 0:
+            spc.record("tcp_deferred_dials", skipped)
+        return nbrs
+
     def _flood_sync(self, cid: int, payload: Any) -> None:
+        # overlay fan-out, not all-pairs: receivers relay FRESH facts
+        # to THEIR neighbors (_ft_ctrl's gossip-once), so coverage is
+        # total while per-event frames stay O(n·log n) universe-wide
         frame = dss.pack(self.rank, 0, cid, 0, payload)
-        for r in range(self.size):
-            if r == self.rank or self.ft_state.is_failed(r):
-                continue
+        for r in self._overlay_targets():
             try:
                 sock = self._endpoint(r, deadline=1.0)
                 self._framed_send(sock, frame)
+                spc.record("ft_overlay_hops")
             except (OSError, errors.MpiError):
                 pass
 
@@ -1197,28 +1232,48 @@ class TcpProc(errh.HasErrhandler, ulfm.UlfmEndpointAPI, HostCollectives,
             # entries are [rank, cause] pairs (typed causes — "device"
             # — survive the wire; see _ft_flood) or bare ranks (the
             # pre-pair shape: second-hand "notice")
+            fresh = []
             for entry in payload:
                 if isinstance(entry, (list, tuple)):
                     r, cause = int(entry[0]), str(entry[1])
                     if cause == "goodbye":
-                        self.ft_state.mark_departed(r)
-                    elif not self.ft_state.mark_failed(r, cause=cause) \
-                            and cause == "device":
+                        if self.ft_state.mark_departed(r):
+                            fresh.append([r, cause])
+                    elif self.ft_state.mark_failed(r, cause=cause):
+                        fresh.append([r, cause])
+                    elif cause == "device":
                         # the typed classification lost the race to a
                         # downstream symptom (the wedged rank's sm
                         # teardown classifies as transport death on
                         # peers mid-send): adopt the root cause
                         self.ft_state.refine_cause(r, cause)
                 else:
-                    self.ft_state.mark_failed(int(entry),
-                                              cause="notice")
+                    r = int(entry)
+                    if self.ft_state.mark_failed(r, cause="notice"):
+                        fresh.append([r, "notice"])
+            if fresh and not self._ft_dead and not self._closed.is_set():
+                # gossip-once relay onto OUR overlay neighbors: the
+                # origin only dialed ITS log-degree fan-out, so a
+                # non-neighbor survivor learns through relays; mark_*
+                # returning False for known facts bounds each rank to
+                # one relay per fact and terminates the flood
+                self._flood(ulfm.FT_NOTICE_CID, fresh, "notice-gossip")
         elif cid == ulfm.FT_REVOKE_CID:
-            self.ft_state.revoke(int(payload))
+            if self.ft_state.revoke(int(payload)) \
+                    and not self._ft_dead and not self._closed.is_set():
+                # newly-learned revocation: relay (overlay gossip)
+                self._flood(ulfm.FT_REVOKE_CID, int(payload),
+                            "revoke-gossip")
         elif cid == ulfm.FT_AGREE_PUB_CID:
             seq, result = payload
             # verbatim: agreement values are typed by their protocol
             # (bool for agree(), [pairs, epoch] for agree_failed_set())
-            self.ft_state.record_agreement(int(seq), result)
+            if self.ft_state.record_agreement(int(seq), result) \
+                    and not self._ft_dead and not self._closed.is_set():
+                # newly-adopted announce: relay so survivors outside
+                # the coordinator's overlay fan-out converge too
+                self._flood(ulfm.FT_AGREE_PUB_CID,
+                            [int(seq), result], "agree-gossip")
         elif cid == ulfm.FT_DVM_CID:
             # authoritative fault event from the runtime daemon (zprted
             # waitpid-watched the corpse exit, or a parent daemon saw a
@@ -1412,6 +1467,11 @@ class TcpProc(errh.HasErrhandler, ulfm.UlfmEndpointAPI, HostCollectives,
             # FILE survives — a real crash cleans nothing up; the final
             # harness close()/launcher sweep owns the unlink
             self._sm_seg.sever()
+        # the channel engine dies with the proc (a crash reads nothing
+        # more); stopping it before the RST closes below means no
+        # reader is parked on an fd about to be freed
+        if self._chan_engine is not None:
+            self._chan_engine.close(1.0)
         try:
             self._listener.close()
         except OSError:
@@ -1465,6 +1525,27 @@ class TcpProc(errh.HasErrhandler, ulfm.UlfmEndpointAPI, HostCollectives,
         if cards is None or not 0 <= rank < len(cards):
             return None
         return sm_mod.parse_numa(cards[rank])
+
+    def resource_stats(self) -> dict:
+        """Per-rank live transport resources — the scale-out
+        scaling-curve gates read this at n ∈ {8, 32, 128}: every count
+        must fit the ``a·log2(n)+b`` bound.  ``sockets`` counts cached
+        peer connections (canonical + crossed dups), ``channels`` the
+        engine's registered readers (sockets plus inbound-accepted
+        conns), ``threads`` the transport-owned reader/push/flood
+        threads (ONE engine reader regardless of connection count —
+        the thread-per-connection replacement)."""
+        with self._conn_lock:
+            socks = len(self._conns) + len(self._dup_conns)
+        eng = self._chan_engine
+        chans = eng.channel_count() if eng is not None else 0
+        threads = 1 if eng is not None and not eng.closed else 0
+        threads += len(self._push_pool.alive_threads())
+        with self._flood_lock:
+            threads += sum(
+                1 for t in self._flood_threads if t.is_alive())
+        return {"sockets": socks, "channels": chans,
+                "threads": threads}
 
     def sm_segment_stats(self) -> dict | None:
         """Demand-mapping introspection of this proc's OWN segment (the
@@ -1670,110 +1751,75 @@ class TcpProc(errh.HasErrhandler, ulfm.UlfmEndpointAPI, HostCollectives,
         self._peer_cards = [list(a) for a in book]
         return [tuple(a[:2]) for a in book]
 
-    def _accept_loop(self) -> None:
-        while not self._closed.is_set():
-            try:
-                conn, _ = self._listener.accept()
-            except OSError:
-                return
-            # first frame on a new connection announces the peer: a bare
-            # rank for in-group peers, or ["b", bridge_cid, rank] for a
-            # rank of a REMOTE group connecting across an intercomm
-            # bridge (dpm) — namespaced so remote rank numbers cannot
-            # collide with local ones in the connection cache
-            frame = _recv_frame(conn)
-            if frame is None:
-                conn.close()
-                continue
-            [hello] = dss.unpack(frame)
-            if isinstance(hello, (list, tuple)) and hello[0] == "d":
-                # rendezvous bulk-data connection: drain it, but never
-                # register it for sends (control and bulk stay separate)
-                with self._conn_lock:
-                    self._dup_conns.append(conn)
-                self._start_drain(conn)
-                continue
+    def _on_accept(self, conn: socket.socket) -> None:
+        """Inbound connection off the channel engine's listener: the
+        first frame announces the peer — a bare rank for in-group
+        peers, ["b", bridge_cid, rank] for a rank of a REMOTE group
+        connecting across an intercomm bridge (dpm, namespaced so
+        remote rank numbers cannot collide with local ones in the
+        connection cache), or ["d"] for a rendezvous bulk-data
+        connection — so the channel starts in a HELLO state and
+        retargets itself onto the steady-state frame handler."""
+        self._chan_engine.add_channel(
+            conn, f"hello:{conn.fileno()}", self._on_hello_frame)
+
+    def _on_hello_frame(self, chan, frame) -> None:
+        conn = chan.sock
+        [hello] = dss.unpack(frame)
+        if isinstance(hello, (list, tuple)) and hello[0] == "d":
+            # rendezvous bulk-data connection: drain it, but never
+            # register it for sends (control and bulk stay separate)
+            with self._conn_lock:
+                self._dup_conns.append(conn)
+            chan.name = f"data:{conn.fileno()}"
+        else:
             if isinstance(hello, (list, tuple)):
                 key = ("b", hello[1], hello[2])
             else:
                 key = hello
             with self._conn_lock:
                 self._conns.setdefault(key, conn)
-            self._start_drain(conn)
+            chan.name = f"peer:{key}"
+        chan.on_frame = self._on_wire_frame
 
-    def _track_thread(self, t: threading.Thread) -> None:
-        with self._drain_lock:
-            # prune finished threads so long-lived ranks don't accumulate
-            # one dead Thread object per connection/transfer — but keep
-            # registered-but-unstarted siblings (ident is None until
-            # start()): pruning one would un-track a drain a concurrent
-            # close() is entitled to join (the flood-thread idiom)
-            self._drains = [
-                d for d in self._drains
-                if d.ident is None or d.is_alive()
-            ]
-            self._drains.append(t)
-
-    def _start_drain(self, conn: socket.socket) -> None:
-        t = threading.Thread(
-            target=self._drain_loop, args=(conn,), daemon=True
-        )
-        self._track_thread(t)
+    def _on_wire_frame(self, chan, frame) -> None:
+        """One framed message off the channel engine — the per-frame
+        body of the old per-connection drain loop (same dispatch,
+        same log-and-keep-draining posture: a failing matching
+        callback must not kill the channel, every later message on
+        this connection would silently vanish)."""
+        conn = chan.sock
+        # unpack_from: array payloads become writable views over the
+        # frame's dedicated recv_into buffer — the zero-copy receive
+        # half (the frame bytearray stays alive via the views)
+        vals = dss.unpack_from(frame)
+        src, tag, cid, seq, payload = vals[:5]
+        if self.ft_state is not None and cid == ulfm.FT_JOIN_CID:
+            # rejoin/re-modex: needs the carrying connection (the
+            # joiner's fresh socket becomes the canonical endpoint)
+            self._ft_join(conn, src, payload)
+            return
+        if self.ft_state is not None and cid in (
+            ulfm.FT_HB_CID, ulfm.FT_NOTICE_CID, ulfm.FT_REVOKE_CID,
+            ulfm.FT_AGREE_PUB_CID, ulfm.FT_BYE_CID, ulfm.FT_DVM_CID,
+        ):
+            # ULFM control plane: heartbeats / failure notices /
+            # revoke floods never enter the matching engine
+            self._ft_ctrl(cid, src, payload)
+            return
+        self._trace_ingest(vals, "tcp")
+        env = Envelope(src, tag, cid, seq)
         try:
-            t.start()
-        except BaseException:
-            # a thread that never started must not stay tracked: it
-            # would keep ident None forever and close()'s join-retry
-            # loop would spin on it for the whole deadline
-            with self._drain_lock:
-                if t in self._drains:
-                    self._drains.remove(t)
-            raise
-
-    def _drain_loop(self, conn: socket.socket) -> None:
-        """Receiver thread per connection — the progress engine's read
-        side (btl_tcp drives this from libevent; threads are the Python
-        idiom).  A failing matching callback (e.g. a rendezvous CTS
-        handler hitting a dead socket) must not kill the drain: every
-        later message on this connection would silently vanish."""
-        while not self._closed.is_set():
-            try:
-                frame = _recv_frame(conn, idle_retry=True)
-            except OSError:
-                return
-            if frame is None:
-                return
-            # unpack_from: array payloads become writable views over the
-            # frame's dedicated recv_into buffer — the zero-copy receive
-            # half (the frame bytearray stays alive via the views)
-            vals = dss.unpack_from(frame)
-            src, tag, cid, seq, payload = vals[:5]
-            if self.ft_state is not None and cid == ulfm.FT_JOIN_CID:
-                # rejoin/re-modex: needs the carrying connection (the
-                # joiner's fresh socket becomes the canonical endpoint)
-                self._ft_join(conn, src, payload)
-                continue
-            if self.ft_state is not None and cid in (
-                ulfm.FT_HB_CID, ulfm.FT_NOTICE_CID, ulfm.FT_REVOKE_CID,
-                ulfm.FT_AGREE_PUB_CID, ulfm.FT_BYE_CID, ulfm.FT_DVM_CID,
-            ):
-                # ULFM control plane: heartbeats / failure notices /
-                # revoke floods never enter the matching engine
-                self._ft_ctrl(cid, src, payload)
-                continue
-            self._trace_ingest(vals, "tcp")
-            env = Envelope(src, tag, cid, seq)
-            try:
-                with self._incoming_cv:
-                    self.engine.incoming(env, payload)
-                    self._incoming_cv.notify_all()
-            except Exception as e:  # noqa: BLE001 - log, keep draining
-                mca_output.emit(
-                    _stream,
-                    "rank %s: matching callback failed for (src=%s tag=%s "
-                    "cid=%s): %s: %s", self.rank, src, tag, cid,
-                    type(e).__name__, e,
-                )
+            with self._incoming_cv:
+                self.engine.incoming(env, payload)
+                self._incoming_cv.notify_all()
+        except Exception as e:  # noqa: BLE001 - log, keep draining
+            mca_output.emit(
+                _stream,
+                "rank %s: matching callback failed for (src=%s tag=%s "
+                "cid=%s): %s: %s", self.rank, src, tag, cid,
+                type(e).__name__, e,
+            )
 
     def _endpoint(self, dest: int,
                   deadline: float | None = None) -> socket.socket:
@@ -1842,6 +1888,10 @@ class TcpProc(errh.HasErrhandler, ulfm.UlfmEndpointAPI, HostCollectives,
         # on this cached socket (and starve its peer-side drain)
         sock.settimeout(self._timeout)
         _send_frame(sock, dss.pack(self.rank))
+        # every fresh outbound dial is a LAZY connect (modex handed out
+        # cards, not sockets): the scaling gate reads this counter to
+        # prove wire-up never silently reverts to eager all-pairs
+        spc.record("tcp_lazy_connects")
         with self._conn_lock:
             existing = self._conns.get(dest)
             if existing is not None:
@@ -1853,10 +1903,12 @@ class TcpProc(errh.HasErrhandler, ulfm.UlfmEndpointAPI, HostCollectives,
                 # connections; each side sends only on its registered
                 # one, so per-source FIFO is preserved.
                 self._dup_conns.append(sock)
-                self._start_drain(sock)
+                self._chan_engine.add_channel(
+                    sock, f"peer:{dest}-x", self._on_wire_frame)
                 return existing
             self._conns[dest] = sock
-        self._start_drain(sock)
+        self._chan_engine.add_channel(
+            sock, f"peer:{dest}", self._on_wire_frame)
         return sock
 
     def bridge_endpoint(self, cid: int, dest: int,
@@ -1873,16 +1925,19 @@ class TcpProc(errh.HasErrhandler, ulfm.UlfmEndpointAPI, HostCollectives,
         sock.settimeout(self._timeout)
         sock.connect(tuple(addr))
         _send_frame(sock, dss.pack(["b", cid, self.rank]))
+        spc.record("tcp_lazy_connects")
         with self._conn_lock:
             existing = self._conns.get(key)
             if existing is not None:
                 # crossed-connection rule: never close a socket whose
                 # hello the peer may have registered (see _endpoint)
                 self._dup_conns.append(sock)
-                self._start_drain(sock)
+                self._chan_engine.add_channel(
+                    sock, f"bridge:{cid}:{dest}-x", self._on_wire_frame)
                 return existing
             self._conns[key] = sock
-        self._start_drain(sock)
+        self._chan_engine.add_channel(
+            sock, f"bridge:{cid}:{dest}", self._on_wire_frame)
         return sock
 
     def bridge_send(self, obj: Any, cid: int, dest: int,
@@ -2277,21 +2332,42 @@ class TcpProc(errh.HasErrhandler, ulfm.UlfmEndpointAPI, HostCollectives,
         frames strictly in order; a failing item completes its request
         ERRORED (typed) and the drain keeps going — later frames to a
         dead peer fail fast on their own, and frames to a live peer
-        behind a transient error still deliver."""
+        behind a transient error still deliver.
+
+        Fair-share: the drain owns its worker for at most
+        ``_PUSH_RR_QUANTUM`` items while other channels queue on the
+        pool — then it re-submits itself to the BACK of the pool queue
+        (round-robin across destinations), so one peer's bulk
+        rendezvous stream cannot starve another tenant's.  ``draining``
+        stays True across the rotation: the single-owner invariant (and
+        the per-destination FIFO it guards) holds."""
+        done = 0
         while True:
+            rotate = False
             with ch.lock:
                 if not ch.queue:
                     ch.draining = False
                     return
-                work, req, finish = ch.queue.popleft()
-                if req is not None:
-                    # ownership set ATOMICALLY with the pop: a failure
-                    # classifier either sees the item still queued (and
-                    # errors it) or sees it owned — never a window where
-                    # a delivered send gets poisoned (observed: a peer
-                    # recv'd the frame, finished, and its goodbye beat
-                    # the worker to the completion)
-                    req._owned = True
+                if done >= _PUSH_RR_QUANTUM \
+                        and self._push_pool.backlog() > 0:
+                    rotate = True
+                else:
+                    work, req, finish = ch.queue.popleft()
+                    if req is not None:
+                        # ownership set ATOMICALLY with the pop: a
+                        # failure classifier either sees the item still
+                        # queued (and errors it) or sees it owned —
+                        # never a window where a delivered send gets
+                        # poisoned (observed: a peer recv'd the frame,
+                        # finished, and its goodbye beat the worker to
+                        # the completion)
+                        req._owned = True
+            if rotate:
+                spc.record("tcp_push_rr_rotations")
+                self._push_pool.submit(
+                    lambda: self._drain_channel(ch, dest))
+                return
+            done += 1
             if req is not None and req.done:
                 continue  # poisoned while parked (revoke/death/abandon)
             try:
@@ -3113,24 +3189,12 @@ class TcpProc(errh.HasErrhandler, ulfm.UlfmEndpointAPI, HostCollectives,
             except OSError:
                 pass
         deadline = time.monotonic() + 5.0
-        self._accept_thread.join(max(0.0, deadline - time.monotonic()))
-        with self._drain_lock:
-            drains = list(self._drains)
-        for t in drains:
-            while True:
-                try:
-                    t.join(max(0.0, deadline - time.monotonic()))
-                    break
-                except RuntimeError:
-                    # registered but not yet started (_start_drain's
-                    # spawner is between _track_thread and start()):
-                    # joining an unstarted thread raises and used to
-                    # ABORT teardown mid-flight — the same race PR 6
-                    # closed for flood threads, surfaced here by the
-                    # lockdep witness widening the append→start window
-                    if time.monotonic() >= deadline:
-                        break
-                    time.sleep(0.001)
+        # the channel engine's close() joins the ONE reader thread that
+        # replaced the accept thread + per-connection drains: after it
+        # returns, nobody is parked on any of the fds freed below (the
+        # fd-reuse byte-stealing hazard the old drain ladder documented)
+        if self._chan_engine is not None:
+            self._chan_engine.close(max(0.0, deadline - time.monotonic()))
         # the rendezvous-push pool drains with the proc: the quiesce loop
         # above already waited out pending transfers, so workers are idle
         # (or wedged on a dead peer, bounded by the join deadline) — the

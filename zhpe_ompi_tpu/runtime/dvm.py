@@ -554,9 +554,17 @@ class Dvm(pmix_mod.FramedRpcServer):
     Constructible in-process (tests, benchmarks) or via the ``zprted``
     CLI as its own OS process.  The control port rides the shared
     framed-RPC scaffold (:class:`~zhpe_ompi_tpu.runtime.pmix.
-    FramedRpcServer`); ``launch`` is the one streaming request —
-    replies are emitted by the job machinery
-    (``[job]``/``[io]``/``[note]``/``[exit]`` frames)."""
+    FramedRpcServer`): fast control verbs dispatch inline on the
+    channel engine; the connection-owning shapes (``launch`` streams
+    ``[job]``/``[io]``/``[note]``/``[exit]`` frames, ``attach`` serves
+    a child daemon's tree link for its life, ``lifeline`` parks until
+    daemon death) plus the slow membership RPCs (``respawn``/
+    ``resize`` hold spawn-confirmation windows) detach to dedicated
+    threads — bounded by tree fan-out and op kind, never by universe
+    size."""
+
+    _STREAMED_OPS = frozenset(
+        {"launch", "attach", "lifeline", "respawn", "resize"})
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  pmix_port: int = 0, session_tag: str | None = None,
@@ -646,6 +654,15 @@ class Dvm(pmix_mod.FramedRpcServer):
     def stopped(self) -> bool:
         return self.closed
 
+    def _wants_stream(self, op) -> bool:
+        # a CHILD daemon relays job-level RPCs to the root over a
+        # blocking upstream call — that wait belongs on a detached
+        # thread, never on the engine every other client rides
+        if self._parent_link is not None and op in (
+                "stat", "pids", "metrics"):
+            return True
+        return super()._wants_stream(op)
+
     def _handle_request(self, req: list, conn, conn_lock) -> Any:
         if req[0] == "launch":
             self._handle_launch(req[1], conn, conn_lock)
@@ -715,6 +732,16 @@ class Dvm(pmix_mod.FramedRpcServer):
                 "dvm_tree_forwards": counters.get("dvm_tree_forwards", 0),
                 "dvm_store_cache_hits":
                     counters.get("dvm_store_cache_hits", 0),
+                # scale-out-fabric gates: a REAL-process tree's scaling
+                # tests can only see the root daemon's counters through
+                # this RPC (each zprted has its own spc registry)
+                "pmix_gets": counters.get("pmix_gets", 0),
+                "dvm_tree_routed_launches":
+                    counters.get("dvm_tree_routed_launches", 0),
+                "store_leaf_cache_hits":
+                    counters.get("store_leaf_cache_hits", 0),
+                "store_leaf_cache_misses":
+                    counters.get("store_leaf_cache_misses", 0),
             }
         if op == "pids":
             job = self._job(req[1])
@@ -921,10 +948,15 @@ class Dvm(pmix_mod.FramedRpcServer):
             self._spawn_remote(payload)
         elif kind == "gen":
             if isinstance(self.store, dvmtree.RoutedStore):
-                self.store.invalidate_ns(str(payload[0]))
+                # the frame CARRIES the new generation: it raises the
+                # leaf bucket's floor, so a fetch in flight across this
+                # invalidation can never re-warm the cache with the
+                # pre-bump incarnation's value
+                gen = int(payload[1]) if len(payload) > 1 else None
+                self.store.invalidate_ns(str(payload[0]), gen=gen)
         elif kind == "nsdown":
             if isinstance(self.store, dvmtree.RoutedStore):
-                self.store.invalidate_ns(str(payload[0]))
+                self.store.forget_ns(str(payload[0]))
         elif kind == "fault":
             job = self._jobs.get(str(payload[0]))
             if job is not None:
@@ -945,7 +977,7 @@ class Dvm(pmix_mod.FramedRpcServer):
             if job is not None:
                 _sweep_shm(job.session)
             if isinstance(self.store, dvmtree.RoutedStore):
-                self.store.invalidate_ns(job_id)
+                self.store.forget_ns(job_id)
         else:
             mca_output.emit(
                 _stream, "tree: unknown downward frame %r — dropped",
@@ -1310,6 +1342,9 @@ class Dvm(pmix_mod.FramedRpcServer):
                         job.remote_alive.add(r)
                         job.live += 1
             try:
+                # counted per remote spawn frame: the scaling gates
+                # assert launch fan-out rides the tree, not root-direct
+                spc.record("dvm_tree_routed_launches")
                 self._send_tree(daemon_id, "spawn", {
                     "job": job.id, "size": job.size,
                     "cmds": {r: job.cmds[r] for r in rs},
@@ -2130,7 +2165,7 @@ class Dvm(pmix_mod.FramedRpcServer):
             self.store.destroy_ns(job.id)
             self._broadcast_down("jobdone", [job.id])
         elif isinstance(self.store, dvmtree.RoutedStore):
-            self.store.invalidate_ns(job.id)
+            self.store.forget_ns(job.id)
         _sweep_shm(job.session)
         with self._lock:
             self._jobs.pop(job.id, None)

@@ -289,8 +289,17 @@ class RoutedStore:
         self.parent = pmix_mod.parse_addr(parent_pmix)
         self._timeout = timeout
         self.open = True
-        # ns -> key -> (generation, value, cached_at)
-        self._cache: dict[str, dict[str, tuple[int, Any, float]]] = {}
+        # ns -> key -> (generation, value, cached_at, fill_floor)
+        self._cache: dict[
+            str, dict[str, tuple[int, Any, float, int]]] = {}
+        # ns -> highest namespace generation this daemon has LEARNED
+        # (gen-carrying invalidations + observed fill tags).  Entries
+        # filled under an older floor are never served again: a
+        # respawn's republished card can overwrite a key at the root,
+        # and a warm leaf entry fetched before the bump would otherwise
+        # keep serving the corpse incarnation's value to default-
+        # min_generation getters (the PR 8 race, through the tree path)
+        self._ns_gen: dict[str, int] = {}
         self._fetching: set[tuple[str, str]] = set()
         self._cv = threading.Condition()
         self._tls = threading.local()
@@ -337,17 +346,21 @@ class RoutedStore:
         deadline = time.monotonic() + timeout
         with self._cv:
             while True:
+                floor = self._ns_gen.get(ns, 0)
                 hit = self._cache.get(ns, {}).get(key)
                 if hit is not None and hit[0] >= int(min_generation) \
+                        and hit[3] >= floor \
                         and (ttl <= 0
                              or time.monotonic() - hit[2] <= ttl):
                     spc.record("dvm_store_cache_hits")
+                    spc.record("store_leaf_cache_hits")
                     return hit[1], hit[0]
                 if not self.open:
                     raise errors.InternalError(
                         "routed store closed (daemon stopping)")
                 if (ns, key) not in self._fetching:
                     self._fetching.add((ns, key))
+                    fill_floor = floor
                     break
                 left = deadline - time.monotonic()
                 if left <= 0:
@@ -359,6 +372,7 @@ class RoutedStore:
             # the forward happens OUTSIDE the cache lock: a parked
             # get-until-published upstream must never wedge local hits
             spc.record("dvm_tree_forwards")
+            spc.record("store_leaf_cache_misses")
             value, gen = self._up().get_meta(ns, key, timeout,
                                              min_generation)
         except BaseException:
@@ -372,8 +386,16 @@ class RoutedStore:
         # counters the launch ladder gates on must be deterministic)
         with self._cv:
             if self.open:
+                # a fill tag NEWER than the known floor teaches us the
+                # namespace moved on; a floor that advanced DURING the
+                # fetch (a bump invalidation raced the forward) marks
+                # this value as possibly the pre-bump incarnation's —
+                # cache it under the old floor so it is never served
+                if int(gen) > self._ns_gen.get(ns, 0):
+                    self._ns_gen[ns] = int(gen)
                 self._cache.setdefault(ns, {})[key] = (
-                    int(gen), value, time.monotonic())
+                    int(gen), value, time.monotonic(),
+                    max(fill_floor, int(gen)))
             self._fetching.discard((ns, key))
             self._cv.notify_all()
         return value, int(gen)
@@ -393,14 +415,16 @@ class RoutedStore:
         self._forward("ensure_ns", ns, int(size))
 
     def destroy_ns(self, ns: str) -> bool:
-        self.invalidate_ns(ns)
+        self.forget_ns(ns)
         return bool(self._forward("destroy_ns", ns))
 
     def bump_generation(self, ns: str) -> int:
         # a bump through THIS daemon invalidates its own cache eagerly;
         # the root's broadcast covers every other daemon
         self.invalidate_ns(ns)
-        return int(self._forward("bump_generation", ns))
+        gen = int(self._forward("bump_generation", ns))
+        self.invalidate_ns(ns, gen=gen)  # raise the bucket floor too
+        return gen
 
     def generation(self, ns: str) -> int:
         return int(self._forward("generation", ns))
@@ -418,11 +442,28 @@ class RoutedStore:
 
     # -- coherence / lifecycle --------------------------------------------
 
-    def invalidate_ns(self, ns: str) -> None:
+    def invalidate_ns(self, ns: str, gen: "int | None" = None) -> None:
         """Drop every cached entry of ``ns`` — the generation-bump (or
-        namespace-destroy) invalidation riding the parent link."""
+        namespace-destroy) invalidation riding the parent link.  A
+        gen-carrying invalidation also raises the bucket's generation
+        FLOOR, so an in-flight fetch that started before the bump can
+        never park its (possibly pre-bump) value back into the warm
+        cache as servable."""
         with self._cv:
             self._cache.pop(str(ns), None)
+            if gen is not None:
+                self._ns_gen[str(ns)] = max(
+                    self._ns_gen.get(str(ns), 0), int(gen))
+            self._cv.notify_all()
+
+    def forget_ns(self, ns: str) -> None:
+        """Namespace DESTROYED: drop its cache bucket AND its
+        generation floor — a later namespace reusing the name starts
+        over at generation 0, and a stale floor would wrongly embargo
+        every entry it publishes."""
+        with self._cv:
+            self._cache.pop(str(ns), None)
+            self._ns_gen.pop(str(ns), None)
             self._cv.notify_all()
 
     def cached_keys(self) -> list[str]:

@@ -31,8 +31,9 @@ This module is that server IN tree, with the real verb semantics:
 Three surfaces share one :class:`PmixStore`:
 
 - in-process (the store object itself — thread ranks, unit tests),
-- :class:`PmixServer` — the store behind a length-framed DSS wire
-  (thread-per-connection; blocking verbs park the connection's thread),
+- :class:`PmixServer` — the store behind a length-framed DSS wire (one
+  multiplexed channel engine serves every connection; blocking verbs
+  park as waiter RECORDS a single completer thread answers),
 - :class:`PmixClient` — the rank-side verbs over one persistent socket.
 
 Hygiene is observable like every other plane's: servers register weakly
@@ -131,25 +132,85 @@ def conn_alive(conn) -> bool:
         return False
 
 
+class _PrefixedConn:
+    """A served socket with a few already-buffered bytes in front: the
+    channel engine may have read a partial NEXT frame before a streamed
+    op detached the connection, and those bytes must reach the detached
+    thread's blocking ``_recv_frame`` loop first.  ``recv_into``/
+    ``recv`` consume the prefix (peeks don't), everything else —
+    sends, fileno, close — delegates to the real socket, so the wrapper
+    can stand in for the connection everywhere a handler passes it
+    on."""
+
+    def __init__(self, sock: socket.socket, prefix: bytes):
+        self._sock = sock
+        self._prefix = bytes(prefix)
+
+    def recv_into(self, buf, nbytes: int = 0) -> int:
+        if self._prefix:
+            view = memoryview(buf)
+            n = min(len(self._prefix), nbytes or view.nbytes)
+            view[:n] = self._prefix[:n]
+            self._prefix = self._prefix[n:]
+            return n
+        return self._sock.recv_into(buf, nbytes) if nbytes \
+            else self._sock.recv_into(buf)
+
+    def recv(self, bufsize: int, flags: int = 0) -> bytes:
+        if self._prefix:
+            if flags & socket.MSG_PEEK:
+                return self._prefix[:bufsize]
+            out, self._prefix = (self._prefix[:bufsize],
+                                 self._prefix[bufsize:])
+            return out
+        return self._sock.recv(bufsize, flags)
+
+    def __getattr__(self, name: str):
+        return getattr(self._sock, name)
+
+
 class FramedRpcServer:
     """Shared scaffold of the runtime plane's framed-RPC servers (the
     PMIx store wire and the zprted control port): one SO_REUSEADDR
     listener (a daemon restarted onto a just-stopped predecessor's
-    port must ride over the TIME_WAIT corpse), a pruned
-    thread-per-connection accept loop, ``["ok", value]``/``["err",
-    msg]`` reply enveloping, and the shutdown-wakes-accept close
-    ladder.  Subclasses implement :meth:`_handle_request`; it returns
-    the reply value, raises ``MpiError`` for an errored reply, or
-    returns :attr:`STREAMED` when it already emitted its own frames.
+    port must ride over the TIME_WAIT corpse), ``["ok", value]``/
+    ``["err", msg]`` reply enveloping, and the shutdown close ladder.
+
+    Connections are NOT served thread-per-connection: every framed
+    channel of one server multiplexes onto its single
+    :class:`~zhpe_ompi_tpu.pt2pt.engine_mux.ChannelEngine` reader, and
+    fast verbs dispatch inline on the engine thread (they are O(1)
+    store/daemon state transitions).  Two escape hatches keep the
+    blocking
+    shapes working without parking the engine:
+
+    - **streamed ops** (:attr:`_STREAMED_OPS`, or
+      :meth:`_wants_stream`) own their connection for its whole life —
+      the zprted ``launch``/``attach``/``lifeline`` shape.  The channel
+      detaches from the engine (partial-frame bytes ride along via
+      :class:`_PrefixedConn`) and a dedicated thread runs the classic
+      blocking serve loop; thread count is bounded by op KIND and tree
+      fan-out, not client count.
+    - **deferred ops** (:meth:`_defer_request`) take ownership of the
+      REPLY and return True — the PMIx ``get``/``fence`` shape, where a
+      completer thread answers when the store state lands.
+
+    Subclasses implement :meth:`_handle_request`; it returns the reply
+    value, raises ``MpiError`` for an errored reply, or returns
+    :attr:`STREAMED` when it already emitted its own frames.
     :meth:`_after_reply` (default True) may return False to stop
     serving the connection after a reply (the stop RPC's shape).
     """
 
     #: sentinel: the handler streamed its own reply frames
     STREAMED = object()
+    #: ops whose handler owns the connection for its whole life
+    _STREAMED_OPS: frozenset = frozenset()
 
     def __init__(self, host: str, port: int, name: str,
                  backlog: int = 64):
+        from ..pt2pt import engine_mux
+
         self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         try:
@@ -162,13 +223,13 @@ class FramedRpcServer:
         self.closed = False
         self._rpc_name = name
         self._conns: list[socket.socket] = []
+        self._conn_locks: dict[socket.socket, threading.Lock] = {}
         self._rpc_lock = threading.Lock()
         self._threads: list[threading.Thread] = []
-        self._acceptor = threading.Thread(
-            target=self._accept_loop, daemon=True,
-            name=f"{name}-accept-{self.address[1]}",
-        )
-        self._acceptor.start()
+        self._engine = engine_mux.ChannelEngine(
+            f"{name}-{self.address[1]}")
+        self._engine.add_listener(self._srv, self._rpc_accept)
+        self._engine.start()
 
     def _handle_request(self, req: list, conn, conn_lock) -> Any:
         raise NotImplementedError
@@ -176,61 +237,128 @@ class FramedRpcServer:
     def _after_reply(self, req: list) -> bool:
         return True
 
-    def _accept_loop(self) -> None:
-        while not self.closed:
-            try:
-                conn, _ = self._srv.accept()
-            except OSError:
-                return
+    def _wants_stream(self, op) -> bool:
+        """Should this op detach the connection to a dedicated
+        blocking-serve thread?  Default: membership in
+        :attr:`_STREAMED_OPS`."""
+        return op in self._STREAMED_OPS
+
+    def _defer_request(self, req: list, conn, conn_lock) -> bool:
+        """Take ownership of the reply for a blocking verb (a completer
+        answers later) — return True to do so.  Default: nothing
+        defers."""
+        return False
+
+    # -- engine-side serving ----------------------------------------------
+
+    def _rpc_accept(self, conn: socket.socket) -> None:
+        with self._rpc_lock:
+            self._conns.append(conn)
+            self._conn_locks[conn] = threading.Lock()
+        self._engine.add_channel(
+            conn, f"rpc:{conn.fileno()}", self._on_req_frame,
+            on_close=self._on_chan_close)
+
+    def _on_chan_close(self, chan) -> None:
+        self._drop_conn(chan.sock)
+
+    def _drop_conn(self, conn) -> None:
+        with self._rpc_lock:
+            if conn in self._conns:
+                self._conns.remove(conn)
+            self._conn_locks.pop(conn, None)
+        try:
+            conn.close()
+        except OSError:
+            pass
+
+    def _on_req_frame(self, chan, frame) -> None:
+        from ..pt2pt.tcp import _send_frame
+        from ..utils import dss
+
+        conn = chan.sock
+        [req] = dss.unpack(frame)
+        op = req[0] if isinstance(req, (list, tuple)) and req else None
+        with self._rpc_lock:
+            conn_lock = self._conn_locks.get(conn)
+        if conn_lock is None:
+            conn_lock = threading.Lock()
+        if self._wants_stream(op):
+            # the handler owns this connection now: hand any
+            # partially-buffered next frame over with it
+            leftover = self._engine.detach(conn)
+            t = threading.Thread(
+                target=self._serve_detached,
+                args=(conn, conn_lock, req, leftover), daemon=True,
+                name=f"{self._rpc_name}-conn-{self.address[1]}",
+            )
             with self._rpc_lock:
-                self._conns.append(conn)
-                t = threading.Thread(
-                    target=self._serve_conn, args=(conn,), daemon=True,
-                    name=f"{self._rpc_name}-conn-{self.address[1]}",
-                )
-                self._threads = [x for x in self._threads if x.is_alive()]
+                self._threads = [x for x in self._threads
+                                 if x.is_alive()]
                 self._threads.append(t)
             t.start()
+            return
+        if self._defer_request(req, conn, conn_lock):
+            return  # a completer owns the reply
+        reply = self._run_handler(req, conn, conn_lock)
+        if reply is None:
+            return  # STREAMED: the handler emitted its own frames
+        alive = True
+        try:
+            with conn_lock:
+                _send_frame(conn, dss.pack(reply))
+        except OSError:
+            alive = False  # client went away mid-reply: its problem
+        if not alive or not self._after_reply(req):
+            self._engine.discard(conn)
+            self._drop_conn(conn)
 
-    def _serve_conn(self, conn: socket.socket) -> None:
+    def _run_handler(self, req: list, conn, conn_lock
+                     ) -> "list | None":
+        try:
+            out = self._handle_request(req, conn, conn_lock)
+            if out is self.STREAMED:
+                return None
+            return ["ok", out]
+        except errors.MpiError as e:
+            return ["err", str(e)]
+        except Exception as e:  # noqa: BLE001 - a malformed request
+            # must error the REPLY, not silently kill the serving loop
+            return ["err", f"{type(e).__name__}: {e}"]
+
+    def _serve_detached(self, conn, conn_lock, first_req,
+                        leftover: bytes) -> None:
+        """The classic blocking serve loop, for connections a streamed
+        op took over (first request pre-consumed by the engine)."""
         from ..pt2pt.tcp import _recv_frame, _send_frame
         from ..utils import dss
 
-        conn_lock = threading.Lock()
+        rconn = _PrefixedConn(conn, leftover) if leftover else conn
+        req = first_req
         try:
             while not self.closed:
-                frame = _recv_frame(conn)
+                reply = self._run_handler(req, rconn, conn_lock)
+                if reply is not None:
+                    with conn_lock:
+                        _send_frame(conn, dss.pack(reply))
+                if reply is not None and not self._after_reply(req):
+                    return
+                frame = _recv_frame(rconn)
                 if frame is None:
                     return
                 [req] = dss.unpack(frame)
-                try:
-                    out = self._handle_request(req, conn, conn_lock)
-                    if out is self.STREAMED:
-                        continue
-                    reply = ["ok", out]
-                except errors.MpiError as e:
-                    reply = ["err", str(e)]
-                except Exception as e:  # noqa: BLE001 - a malformed
-                    # request must error the REPLY, not silently kill
-                    # this connection's handler thread
-                    reply = ["err", f"{type(e).__name__}: {e}"]
-                with conn_lock:
-                    _send_frame(conn, dss.pack(reply))
-                if not self._after_reply(req):
-                    return
         except OSError:
             return  # client went away mid-request: its own problem
         finally:
-            try:
-                conn.close()
-            except OSError:
-                pass
+            self._drop_conn(conn)
 
     def close(self) -> None:
-        """The shutdown ladder: wake the acceptor (shutdown(), not a
-        bare close() — that leaves it parked on the old fd), unblock
-        every connection drain, bounded-join all of them (skipping the
-        calling thread: a stop RPC closes from its own handler)."""
+        """The shutdown ladder: close the listener, shutdown() every
+        connection (EOF wakes the engine's channels AND any detached
+        blocking serve loop), join the engine reader BEFORE freeing the
+        fds (the fd-reuse byte-stealing hazard), then bounded-join the
+        detached threads (skipping the calling thread: a stop RPC
+        closes from its own handler)."""
         if self.closed:
             return
         self.closed = True
@@ -245,18 +373,20 @@ class FramedRpcServer:
         with self._rpc_lock:
             conns = list(self._conns)
             self._conns = []
+            self._conn_locks.clear()
             threads = list(self._threads)
         for conn in conns:
             try:
                 conn.shutdown(socket.SHUT_RDWR)
             except OSError:
                 pass
+        deadline = time.monotonic() + 5.0
+        self._engine.close(max(0.0, deadline - time.monotonic()))
+        for conn in conns:
             try:
                 conn.close()
             except OSError:
                 pass
-        deadline = time.monotonic() + 5.0
-        self._acceptor.join(max(0.0, deadline - time.monotonic()))
         me = threading.current_thread()
         for t in threads:
             if t is me:
@@ -286,6 +416,13 @@ class PmixStore:
     daemon and unit tests hold it directly) and behind
     :class:`PmixServer`'s wire.  All verbs are thread-safe; blocking
     verbs (``get``, ``fence``) park on the store condition."""
+
+    #: this store exposes the non-blocking probe surface
+    #: (try_get_meta/fence_enter/fence_done) a PmixServer's deferred
+    #: wire verbs ride — a RoutedStore does NOT (its get forwards
+    #: upstream over a blocking connection), so its server detaches
+    #: blocking verbs to threads instead (bounded by LOCAL rank count)
+    supports_deferred_verbs = True
 
     def __init__(self):
         self._ns: dict[str, _Namespace] = {}
@@ -394,6 +531,64 @@ class PmixStore:
                     )
                 self._cv.wait(min(left, 0.25))
 
+    def try_get_meta(self, ns: str, key: str, min_generation: int = 0
+                     ) -> "tuple[Any, int] | None":
+        """Non-blocking probe behind the deferred wire ``get``: the
+        ``(value, generation)`` hit, or None while unpublished.  Raises
+        exactly what a blocking :meth:`get_meta` poll would — unknown
+        namespace is an error, not a wait."""
+        with self._cv:
+            space = self._ns.get(ns)
+            if space is None:
+                raise errors.ArgError(f"pmix: unknown namespace {ns!r}")
+            hit = space.kv.get(str(key))
+            if hit is not None and hit[0] >= int(min_generation):
+                spc.record("pmix_gets")
+                return hit[1], hit[0]
+            return None
+
+    def fence_enter(self, ns: str, rank: int) -> "tuple | None":
+        """Deferred-fence entry: register ``rank`` in the namespace's
+        current fence epoch NOW (the rank counts from the moment its
+        request arrived, exactly as the blocking verb's entry did).
+        Returns None when this entry COMPLETES the fence, else an
+        opaque token for :meth:`fence_done`."""
+        with self._cv:
+            space = self._require(ns)
+            epoch = space.fence_epoch
+            space.fence_entered.add(int(rank))
+            if len(space.fence_entered) >= space.size:
+                space.fence_epoch += 1
+                space.fence_entered = set()
+                self._cv.notify_all()
+                spc.record("pmix_fences")
+                return None
+            return (space, epoch)
+
+    def fence_done(self, ns: str, token: tuple) -> bool:
+        """Poll a deferred fence: True once the entered epoch advanced.
+        Raises when the namespace was destroyed mid-fence (same message
+        the blocking verb raises)."""
+        space, epoch = token
+        with self._cv:
+            if self._ns.get(ns) is not space:
+                raise errors.InternalError(
+                    f"pmix: namespace {ns!r} destroyed mid-fence"
+                )
+            if space.fence_epoch > epoch:
+                spc.record("pmix_fences")
+                return True
+            return False
+
+    def fence_status(self, ns: str) -> tuple[int, int]:
+        """``(entered, size)`` of the namespace's current fence epoch —
+        the deferred verb's timeout diagnostics."""
+        with self._cv:
+            space = self._ns.get(ns)
+            if space is None:
+                return (0, 0)
+            return (len(space.fence_entered), space.size)
+
     def fence(self, ns: str, rank: int, timeout: float = 30.0) -> None:
         """Namespace-wide barrier: blocks until every rank of ``ns`` has
         entered this fence epoch.  Committed data published before the
@@ -484,21 +679,156 @@ class PmixStore:
 
 class PmixServer(FramedRpcServer):
     """The store behind a wire: a length-framed DSS request/response
-    protocol on one listening socket, one drain thread per client
-    connection (blocking verbs park that thread, never the acceptor).
+    protocol on one listening socket, every connection multiplexed on
+    the server's one channel engine.  Fast verbs dispatch inline on
+    the engine thread; the blocking verbs (``get``-until-published,
+    ``fence``) are DEFERRED — the request parks as a waiter record and
+    ONE completer thread answers when the store condition fires, so a
+    thousand parked ranks cost a thousand list entries, not a thousand
+    threads.  A server fronting a :class:`~zhpe_ompi_tpu.runtime.
+    dvmtree.RoutedStore` (no probe surface — its get blocks on an
+    upstream connection) detaches blocking verbs to per-connection
+    threads instead, bounded by the daemon's LOCAL rank count.
 
     Request frame: ``dss.pack([op, *args])``; response frame:
     ``dss.pack(["ok", value])`` or ``dss.pack(["err", message])``.
     """
 
+    #: the blocking store verbs a completer answers asynchronously
+    _DEFERRED_OPS = frozenset({"get", "fence"})
+
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  store: PmixStore | None = None):
         self.store = store if store is not None else PmixStore()
+        self._deferrable = bool(
+            getattr(self.store, "supports_deferred_verbs", False))
+        self._waiters: list[dict] = []
+        self._completer: threading.Thread | None = None
         super().__init__(host, port, "pmix")
+        if self._deferrable:
+            self._completer = threading.Thread(
+                target=self._complete_loop, daemon=True,
+                name=f"pmix-completer-{self.address[1]}",
+            )
+            self._completer.start()
         _live_servers.add(self)
 
     def _handle_request(self, req: list, conn, conn_lock) -> Any:
         return self._dispatch(req)
+
+    def _wants_stream(self, op) -> bool:
+        # a RoutedStore's get/fence block on upstream forwards: those
+        # connections go thread-backed (the pre-engine shape, bounded
+        # by this daemon's local ranks, never by universe size)
+        return (not self._deferrable and op in self._DEFERRED_OPS) \
+            or super()._wants_stream(op)
+
+    # -- deferred get/fence (the scale seam) ------------------------------
+
+    def _defer_request(self, req: list, conn, conn_lock) -> bool:
+        if not self._deferrable or req[0] not in self._DEFERRED_OPS:
+            return False
+        now = time.monotonic()
+        if req[0] == "get":
+            timeout = float(req[3])
+            waiter = {"op": "get", "ns": req[1], "key": str(req[2]),
+                      "min_gen": int(req[4]), "timeout": timeout,
+                      "deadline": now + timeout,
+                      "conn": conn, "lock": conn_lock}
+        else:  # fence: ENTER now — the rank counts from request arrival
+            timeout = float(req[3])
+            try:
+                token = self.store.fence_enter(req[1], int(req[2]))
+            except errors.MpiError as e:
+                self._deferred_reply(conn, conn_lock, ["err", str(e)])
+                return True
+            if token is None:  # this entry completed the fence
+                self._deferred_reply(conn, conn_lock, ["ok", True])
+                return True
+            waiter = {"op": "fence", "ns": req[1], "token": token,
+                      "timeout": timeout, "deadline": now + timeout,
+                      "conn": conn, "lock": conn_lock}
+        # probe once inline: the already-published get (the common
+        # case) answers without waiting a completer tick
+        reply = self._poll_waiter(waiter)
+        if reply is not None:
+            self._deferred_reply(conn, conn_lock, reply)
+            return True
+        with self.store._cv:
+            self._waiters.append(waiter)
+        return True
+
+    def _poll_waiter(self, w: dict) -> "list | None":
+        """One non-blocking look at a parked verb: the reply envelope
+        once it can answer (success, store error, or deadline), else
+        None — error MESSAGES match the blocking verbs byte-for-byte
+        (clients diagnose by text)."""
+        try:
+            if w["op"] == "get":
+                hit = self.store.try_get_meta(w["ns"], w["key"],
+                                              w["min_gen"])
+                if hit is not None:
+                    return ["ok", [hit[0], hit[1]]]
+            else:
+                if self.store.fence_done(w["ns"], w["token"]):
+                    return ["ok", True]
+        except errors.MpiError as e:
+            return ["err", str(e)]
+        except Exception as e:  # noqa: BLE001 - a poisoned waiter must
+            # error ITS reply, not kill the completer every verb rides
+            return ["err", f"{type(e).__name__}: {e}"]
+        if time.monotonic() >= w["deadline"] or not self.store.open:
+            if w["op"] == "get":
+                return ["err",
+                        f"pmix: get({w['ns']!r}, {w['key']!r}) not "
+                        f"published within {w['timeout']}s"]
+            entered, size = self.store.fence_status(w["ns"])
+            return ["err",
+                    f"pmix: fence on {w['ns']!r} incomplete within "
+                    f"{w['timeout']}s ({entered}/{size} entered)"]
+        return None
+
+    def _deferred_reply(self, conn, conn_lock, reply: list) -> None:
+        from ..pt2pt.tcp import _send_frame
+        from ..utils import dss
+
+        try:
+            with conn_lock:
+                _send_frame(conn, dss.pack(reply))
+        except OSError:
+            pass  # client went away mid-wait: its own problem
+
+    def _complete_loop(self) -> None:
+        """ONE thread answers every parked get/fence: it sleeps on the
+        store condition (publishes/fences/destroys notify it) and polls
+        each waiter OUTSIDE the condition — the store verbs take it
+        internally."""
+        cv = self.store._cv
+        while not self.closed:
+            with cv:
+                cv.wait(0.05)
+                waiters = list(self._waiters)
+            done = []
+            for w in waiters:
+                reply = self._poll_waiter(w)
+                if reply is not None:
+                    done.append((w, reply))
+            if not done:
+                continue
+            with cv:
+                for w, _reply in done:
+                    if w in self._waiters:
+                        self._waiters.remove(w)
+            for w, reply in done:
+                self._deferred_reply(w["conn"], w["lock"], reply)
+        # shutdown: every still-parked waiter errors out (the store is
+        # closed, so _poll_waiter answers the timeout/closed envelope)
+        with cv:
+            waiters, self._waiters = list(self._waiters), []
+        for w in waiters:
+            reply = self._poll_waiter(w)
+            if reply is not None:
+                self._deferred_reply(w["conn"], w["lock"], reply)
 
     def _dispatch(self, req: list) -> Any:
         op = req[0]
@@ -536,9 +866,11 @@ class PmixServer(FramedRpcServer):
         if self.closed:
             return
         # unblock parked get/fence waiters FIRST (they error out), then
-        # run the shared listener/connection shutdown ladder
+        # run the shared listener/connection/engine shutdown ladder
         self.store.close()
         super().close()
+        if self._completer is not None:
+            self._completer.join(5.0)
 
 
 class PmixClient:

@@ -185,6 +185,43 @@ the daemon-side values):
   launch.  Must stay zero in any healthy run (the conftest session
   gate asserts the registry empty).
 
+Scale-out-fabric counters (the log-degree overlay + lazy connect
+ladder + tree-routed launch plane; the scaling-curve suite and the OSU
+``--scale`` ladder gate on these fitting ``a·log2(n)+b`` while the
+all-pairs shapes would grow O(n)):
+
+- ``tcp_lazy_connects`` — outbound wire sockets actually DIALED (the
+  lazy connect ladder: a modex card costs no socket until first
+  traffic).  Universe-wide this must stay ≪ n² — the zero-silent-
+  fallback gate: eager all-pairs wire-up returning would explode this
+  counter, not a latency row.
+- ``tcp_deferred_dials`` — live peers a control flood SKIPPED because
+  they are not overlay neighbors (counted per flood evaluation): the
+  dials the log-degree overlay saved.  Rises with (n − degree) per
+  event; zero means the overlay degenerated to all-pairs (n ≤ 5 is
+  the designed degenerate case).
+- ``ft_overlay_hops`` — FT control frames (notice/revoke/agree/BYE
+  floods and their gossip-once relays) sent over overlay links,
+  recorded at each sender.  Per death the universe-wide total is
+  O(n·log n) frames (each member relays fresh facts to ≤ 2·ceil(log2
+  n) neighbors) and each RANK's share is O(log n) — the per-death
+  flood-frame scaling gate.
+- ``tcp_push_rr_rotations`` — rendezvous push-pool drains that hit the
+  fair-share quantum with other destination channels waiting and
+  ROTATED to the back of the pool queue (one count per rotation): one
+  peer's bulk stream visibly yielding to a co-tenant's.
+- ``store_leaf_cache_hits`` / ``store_leaf_cache_misses`` — the leaf
+  cache's hit/miss split on the generation-floored read path
+  (``runtime/dvmtree.py``): hits serve locally (and additionally count
+  in ``dvm_store_cache_hits``), misses forward up.  The depth-scaling
+  gate reads the RATIO staying flat as n grows — and the floor
+  guarantees a post-respawn get can never count a corpse-incarnation
+  entry as a hit.
+- ``dvm_tree_routed_launches`` — spawn frames the root sent DOWN the
+  daemon tree (one per remote daemon per launch/respawn/grow batch):
+  launch fan-out riding tree links instead of root-direct
+  connections.
+
 API-surface counters (recorded at the MPI/OpenSHMEM call sites; the
 ZL006 doc-parity rule keeps this table and the ``spc.record`` call
 sites in lockstep):

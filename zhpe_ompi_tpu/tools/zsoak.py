@@ -626,7 +626,8 @@ class _Harness:
             if shape == "storm":
                 plan["scenario"] = self.rng.choice(
                     ["rank_kill", "recover", "elastic", "rank_kill"])
-                plan["victim"] = self.rng.randrange(1, 3)
+                plan["victim"] = self.rng.randrange(
+                    1, max(2, int(self.args.ranks)))
             elif shape == "queue":
                 plan["policy"] = self.rng.choice(["fifo", "priority"])
                 plan["priorities"] = [0, 5, 3] \
@@ -661,20 +662,21 @@ class _Harness:
             self, f"c{i}-sentinel", 2,
             [self.progs["sentinel"], tok_s, flag, "3"], {0})
         try:
+            nr = int(self.args.ranks)  # --ranks: overlay soak scale
             if scenario == "rank_kill":
                 job = _TenantJob(
-                    self, f"c{i}-rank_kill", 3,
+                    self, f"c{i}-rank_kill", nr,
                     [self.progs["park"], tok_a, str(victim)], {137},
                     ft=True, metrics=True, trace=True,
                     placement="spread")
-                self.drive_rank_kill(i, job, victim)
+                self.drive_rank_kill(i, job, victim, n=nr)
             elif scenario == "recover":
                 ckpt = os.path.join(self.workdir, f"ckpt_{i}")
                 job = _TenantJob(
-                    self, f"c{i}-recover", 3,
+                    self, f"c{i}-recover", nr,
                     [self.progs["recover"], tok_a, str(victim), ckpt],
                     {0}, ft=True, metrics=True, trace=True)
-                self.drive_recover(i, job)
+                self.drive_recover(i, job, n=nr)
             else:  # elastic
                 job = _TenantJob(
                     self, f"c{i}-elastic", 2,
@@ -718,7 +720,8 @@ class _Harness:
             self.grab_metrics(job)
             self.fault_jobs += 1
 
-    def drive_recover(self, i: int, job: _TenantJob) -> None:
+    def drive_recover(self, i: int, job: _TenantJob,
+                      n: int = 3) -> None:
         # the victim kills itself right after READY: just witness the
         # pipeline far enough to snapshot the fleet-visible window
         deadline = time.monotonic() + 30.0
@@ -726,8 +729,8 @@ class _Harness:
                 and time.monotonic() < deadline:
             time.sleep(0.05)
         self.inject(job.job_id, "suicide", i)
-        if job.wait_output("SURVIVOR-OK", 2, timeout=120.0):
-            self.grab_traces(job, expect=2)
+        if job.wait_output("SURVIVOR-OK", n - 1, timeout=120.0):
+            self.grab_traces(job, expect=n - 1)
             self.grab_metrics(job)
             self.fault_jobs += 1
 
@@ -979,12 +982,18 @@ def main(args: list[str] | None = None) -> int:
     ap.add_argument("--daemons", type=int, default=4,
                     help="tree size: 1 in-process root + N-1 zprted "
                          "subprocess children (default 4)")
+    ap.add_argument("--ranks", type=int, default=3,
+                    help="fault-storm job size: ranks per rank_kill/"
+                         "recover job (default 3) — raise it to soak "
+                         "the log-degree FT overlay at scale (e.g. "
+                         "--ranks 128 floods a 128-member universe "
+                         "per storm)")
     ap.add_argument("--workdir", default=None,
                     help="scratch dir for worker programs/checkpoints "
                          "(default: a fresh temp dir)")
     ns = ap.parse_args(args)
-    if ns.cycles < 1 or ns.daemons < 2:
-        ap.error("--cycles >= 1 and --daemons >= 2")
+    if ns.cycles < 1 or ns.daemons < 2 or ns.ranks < 3:
+        ap.error("--cycles >= 1, --daemons >= 2 and --ranks >= 3")
     if ns.workdir is None:
         import tempfile
 
