@@ -254,6 +254,20 @@ def _ulfm_detector_hygiene():
         f"suite (two threads took the named locks in opposite order "
         f"somewhere — the ch.lock/_rndv_lock bug class): {inversions}"
     )
+    from zhpe_ompi_tpu.tools import ztune as ztune_mod
+
+    sweepers = ztune_mod.orphaned_sweep_processes()
+    assert not sweepers, (
+        f"ztune sweep worker processes orphaned past the suite (every "
+        f"--real-procs sweep kills its rank interpreters on every "
+        f"exit path): {sweepers}"
+    )
+    tables = pmix_mod.stale_tuned_tables()
+    assert not tables, (
+        f"stale tuned-table namespace state left in a live store after "
+        f"the suite (a test that publishes a ztune table destroys the "
+        f"ztune namespace or closes the store): {tables}"
+    )
 
 
 @pytest.fixture(autouse=True)

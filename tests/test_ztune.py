@@ -1,0 +1,460 @@
+"""ztune plane tests (PR 19): the topology-sectioned tuned decision
+tables — parsing, most-specific-wins precedence, the (mtime, size)
+cache-invalidation fix, store serving with loud store-loss degradation,
+the distiller's counter-gated regression gate, the fast thread-harness
+mini-sweep end-to-end, the ``--check`` verb, and sm geometry adoption.
+The slow twin re-runs the E2E over real rank interpreters and asserts
+the strict counter-gated win on the 2-host x 2-domain topology."""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from zhpe_ompi_tpu.coll import tuned, ztable
+from zhpe_ompi_tpu.mca import var as mca_var
+from zhpe_ompi_tpu.runtime import pmix as pmix_mod
+from zhpe_ompi_tpu.runtime import spc
+from zhpe_ompi_tpu.tools import ztune
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURE = os.path.join(REPO, "benchmarks", "ztune_cpu8.table")
+
+
+@pytest.fixture
+def clean_tables(monkeypatch):
+    """No inherited table state in, none out: env, vars, caches."""
+    monkeypatch.delenv("ZMPI_PMIX", raising=False)
+    tuned.invalidate_rules_cache()
+    yield
+    mca_var.registry.unset("coll_tuned_dynamic_rules")
+    mca_var.registry.unset("coll_tuned_topology")
+    tuned.invalidate_rules_cache()
+
+
+def _write_rules(tmp_path, text, name="t.table"):
+    path = tmp_path / name
+    path.write_text(text)
+    return str(path)
+
+
+class TestTableParsing:
+    """The sectioned grammar: headers, rules, geometry, and the ZL008
+    loud-degradation contract (malformed lines are reported and skipped
+    line-by-line; nothing a corrupt table holds may raise)."""
+
+    def test_sections_rules_geometry(self):
+        probs = []
+        secs = ztable.parse_table(
+            "allreduce 0 0 ring\n"          # headerless -> wildcard
+            "[topology 2 2 2]\n"
+            "allreduce 0 16384 han\n"
+            "geometry sm_ring_bytes 1048576\n"
+            "[topology 2 * *]\n"
+            "reduce 0 0 binomial\n",
+            origin="<t>", problems=probs)
+        assert not probs
+        assert [k for k, _r, _g in secs] == [
+            (2, 2, 2), (2, None, None), (None, None, None)]
+        by_key = {k: (r, g) for k, r, g in secs}
+        assert by_key[(2, 2, 2)][0] == [("allreduce", 0, 16384, "han")]
+        assert by_key[(2, 2, 2)][1] == {"sm_ring_bytes": 1048576}
+        assert by_key[(None, None, None)][0] == [
+            ("allreduce", 0, 0, "ring")]
+
+    def test_malformed_lines_degrade_loudly_per_line(self):
+        probs = []
+        secs = ztable.parse_table(
+            "allreduce 0 0 ring\n"
+            "allreduce zero 0 ring\n"        # bad int
+            "allreduce 0 0\n"                # short
+            "allreduce 0 0 not_an_algo\n"    # unknown alg
+            "geometry sm_ring_bytes many\n"  # bad geometry bytes
+            "geometry bogus_var 4096\n"      # unknown geometry var
+            "bcast 0 0 binomial\n",          # good line AFTER bad ones
+            origin="<t>", problems=probs)
+        assert len(probs) == 5
+        assert all(len(p) == 3 for p in probs)  # (lineno, line, reason)
+        (_k, rules, _g), = secs
+        assert rules == [("allreduce", 0, 0, "ring"),
+                         ("bcast", 0, 0, "binomial")]
+
+    def test_unparseable_header_quarantines_its_lines(self):
+        """Rules under a bad [topology ...] header must never be
+        misfiled into the previous section — reported, never served."""
+        probs = []
+        secs = ztable.parse_table(
+            "[topology 2 2 2]\n"
+            "allreduce 0 0 ring\n"
+            "[topology 2 two 2]\n"          # unparseable header
+            "allreduce 0 0 rabenseifner\n"  # quarantined
+            "[topology 4 4 1]\n"
+            "reduce 0 0 binomial\n",        # later good section serves
+            origin="<t>", problems=probs)
+        assert len(probs) == 2  # the header and its orphaned rule
+        served = [r for _k, rules, _g in secs for r in rules]
+        assert ("allreduce", 0, 0, "rabenseifner") not in served
+        assert ztable._section_rule(
+            secs, "reduce", 4, 100, (4, 4, 1)) == "binomial"
+
+    def test_corrupt_table_never_raises(self):
+        ztable.parse_table("[[[[\x00 ???\n" * 50, origin="<t>")
+        ztable.parse_table(None, origin="<t>")
+
+
+class TestTopologyPrecedence:
+    """Satellite: most-specific-wins across wildcard levels, and the
+    job-key plumbing through the ``coll_tuned_topology`` var."""
+
+    TABLE = (
+        "[topology 2 2 2]\nallreduce 0 0 han\n"
+        "[topology 2 * *]\nallreduce 0 0 rabenseifner\n"
+        "[topology * * *]\nallreduce 0 0 ring\n"
+    )
+
+    def test_most_specific_section_wins(self):
+        secs = ztable.parse_table(self.TABLE, origin="<t>")
+        pick = lambda key: ztable._section_rule(
+            secs, "allreduce", 4, 1024, key)
+        assert pick((2, 2, 2)) == "han"          # fully pinned
+        assert pick((2, 3, 1)) == "rabenseifner"  # host-pinned
+        assert pick((5, 1, 1)) == "ring"          # wildcard only
+        assert pick(None) == "ring"  # unknown topology: wildcard only
+
+    def test_job_topology_key_var(self, clean_tables):
+        assert ztable.job_topology_key() is None
+        mca_var.set_var("coll_tuned_topology", "2:2:2")
+        assert ztable.job_topology_key() == (2, 2, 2)
+        for bad in ("2:2", "a:b:c", "0:2:2", "2:2:2:2"):
+            mca_var.set_var("coll_tuned_topology", bad)
+            assert ztable.job_topology_key() is None  # loud, not raise
+
+    def test_resolve_respects_job_key(self, clean_tables, tmp_path):
+        path = _write_rules(tmp_path, self.TABLE)
+        mca_var.set_var("coll_tuned_dynamic_rules", path)
+        mca_var.set_var("coll_tuned_topology", "2:2:2")
+        assert tuned._dynamic_rule("allreduce", 4, 1024) == "han"
+        mca_var.set_var("coll_tuned_topology", "9:9:9")
+        assert tuned._dynamic_rule("allreduce", 4, 1024) == "ring"
+
+    def test_builtin_band_terminator_falls_through(self, clean_tables,
+                                                   tmp_path):
+        """An explicit ``builtin`` rule terminates a neighboring
+        winner's band: the table answers "builtin", which decide()'s
+        ``dyn in table`` membership check turns into the fixed
+        decision — the distiller's gate-rejected cells can never be
+        leaked over by a smaller size's winner."""
+        path = _write_rules(tmp_path,
+                            "allreduce 0 1024 ring\n"
+                            "allreduce 0 16384 builtin\n")
+        mca_var.set_var("coll_tuned_dynamic_rules", path)
+        assert tuned._dynamic_rule("allreduce", 4, 2048) == "ring"
+        dyn = tuned._dynamic_rule("allreduce", 4, 32768)
+        assert dyn == "builtin"
+        assert dyn not in tuned._ALG_TABLES["allreduce"]
+
+    def test_legacy_headerless_profile_unchanged(self, clean_tables):
+        """Every PR 6 flat rules file parses as one wildcard section."""
+        path = tuned.profiles()["v5e8_ici"]
+        secs = ztable.parse_table(
+            open(path, encoding="utf-8").read(), origin=path)
+        assert [k for k, _r, _g in secs] == [(None, None, None)]
+
+
+class TestRulesCacheInvalidation:
+    """Satellite bugfix: the PR 6 cache was keyed on path alone, so a
+    rules file rewritten IN PLACE (exactly what a ztune re-sweep does)
+    was served stale forever.  The (mtime_ns, size) stamp reloads it."""
+
+    def test_in_place_rewrite_is_reloaded(self, clean_tables, tmp_path):
+        path = _write_rules(tmp_path, "allreduce 0 0 ring\n")
+        mca_var.set_var("coll_tuned_dynamic_rules", path)
+        assert tuned._dynamic_rule("allreduce", 4, 64) == "ring"
+        with open(path, "w", encoding="utf-8") as fh:  # rewrite in place
+            fh.write("allreduce 0 0 rabenseifner\n")
+        st = os.stat(path)  # force a distinct stamp even on coarse
+        os.utime(path, ns=(st.st_atime_ns, st.st_mtime_ns + 1))  # clocks
+        assert tuned._dynamic_rule("allreduce", 4, 64) == "rabenseifner"
+
+    def test_same_stamp_hits_cache(self, clean_tables, tmp_path):
+        path = _write_rules(tmp_path, "allreduce 0 0 ring\n")
+        mca_var.set_var("coll_tuned_dynamic_rules", path)
+        assert tuned._dynamic_rule("allreduce", 4, 64) == "ring"
+        assert path in ztable._file_cache
+        sections = ztable._file_cache[path][1]
+        assert ztable.load_file(path) is sections  # identity: cache hit
+
+    def test_invalidate_hook_clears_both_caches(self, clean_tables,
+                                                tmp_path):
+        path = _write_rules(tmp_path, "allreduce 0 0 ring\n")
+        mca_var.set_var("coll_tuned_dynamic_rules", path)
+        tuned._dynamic_rule("allreduce", 4, 64)
+        assert ztable._file_cache
+        tuned.invalidate_rules_cache()
+        assert not ztable._file_cache and not ztable._store_cache
+
+    def test_unreadable_file_degrades_loudly(self, clean_tables,
+                                             tmp_path):
+        mca_var.set_var("coll_tuned_dynamic_rules",
+                        str(tmp_path / "never_written.table"))
+        assert tuned._dynamic_rule("allreduce", 4, 64) is None
+
+
+class TestStoreServing:
+    """The store rung of the ladder: fetch-once-per-process, counters
+    moving, and a job losing its store falling back WITHOUT raising."""
+
+    def test_store_fetch_serves_and_counts(self, clean_tables,
+                                           monkeypatch):
+        srv = pmix_mod.PmixServer()
+        try:
+            pmix_mod.publish_tuned_table(
+                srv.store, "[topology 2 2 2]\nallreduce 0 0 han\n")
+            assert pmix_mod.stale_tuned_tables()  # visible pre-destroy
+            host, port = srv.address
+            monkeypatch.setenv("ZMPI_PMIX", f"{host}:{port}/jobns")
+            tuned.invalidate_rules_cache()
+            fetches = spc.read("tuned_table_store_fetches")
+            hits = spc.read("tuned_table_hits")
+            assert ztable.resolve_rule(
+                "allreduce", 4, 1024, (2, 2, 2)) == "han"
+            assert spc.read("tuned_table_store_fetches") == fetches + 1
+            assert spc.read("tuned_table_hits") == hits + 1
+            # second resolve: served from cache, no second fetch
+            assert ztable.resolve_rule(
+                "allreduce", 4, 2048, (2, 2, 2)) == "han"
+            assert spc.read("tuned_table_store_fetches") == fetches + 1
+        finally:
+            srv.store.destroy_ns(pmix_mod.ZTUNE_NS)
+            assert not pmix_mod.stale_tuned_tables()
+            srv.close()
+            tuned.invalidate_rules_cache()
+
+    def test_store_loss_falls_back_without_raising(self, clean_tables,
+                                                   monkeypatch,
+                                                   tmp_path):
+        """A job whose daemon died mid-run: ZMPI_PMIX points at a dead
+        port.  The ladder degrades to the file rung (then builtin) and
+        the dead store is probed exactly once (negative-cached)."""
+        srv = pmix_mod.PmixServer()
+        host, port = srv.address
+        srv.close()  # the store is GONE
+        monkeypatch.setenv("ZMPI_PMIX", f"{host}:{port}/jobns")
+        path = _write_rules(tmp_path, "allreduce 0 0 ring\n")
+        mca_var.set_var("coll_tuned_dynamic_rules", path)
+        tuned.invalidate_rules_cache()
+        assert tuned._dynamic_rule("allreduce", 4, 64) == "ring"
+        key = f"{host}:{port}/jobns"
+        assert ztable._store_cache.get(key, "miss") is None  # negative
+        assert tuned._dynamic_rule("allreduce", 4, 64) == "ring"
+
+    def test_prefetch_never_raises(self, clean_tables, monkeypatch):
+        monkeypatch.setenv("ZMPI_PMIX", "127.0.0.1:1/deadns")
+        tuned.invalidate_rules_cache()
+        ztable.prefetch()  # dead store: loud, cached, no raise
+
+
+class TestDistill:
+    """The distiller's counter gate: winners picked on deterministic
+    wire bytes, a cell whose proposed winner moves more bytes than the
+    stock auto decision REJECTED (counter + loud report), and rejected
+    cells terminated with explicit ``builtin`` bands."""
+
+    @staticmethod
+    def _cell(nbytes, winner=None, auto_wire=100, cand_wires=None):
+        cands = cand_wires or {"ring": 80, "recursive_doubling": 120}
+        modes = {"auto": {"wire": auto_wire, "lat_us": 1.0,
+                          "counters": {}}}
+        for alg, wire in cands.items():
+            modes[f"rule:{alg}"] = {"wire": wire, "lat_us": 1.0,
+                                    "counters": {}}
+        cell = {"topo": "flat", "key": (4, 4, 1), "op": "allreduce",
+                "comm_size": 4, "nbytes": nbytes, "modes": modes}
+        if winner is not None:
+            cell["winner"] = winner
+        return cell
+
+    def test_min_wire_winner_and_merge(self):
+        d = ztune.distill([self._cell(1024), self._cell(4096)])
+        assert d[(4, 4, 1)]["rules"] == [("allreduce", 0, 1024, "ring")]
+
+    def test_planted_regression_is_rejected(self):
+        """The acceptance gate: plant a winner worse than auto — the
+        table must NOT carry it, ``tuned_regression_rejects`` must."""
+        base = spc.read("tuned_regression_rejects")
+        d = ztune.distill([
+            self._cell(1024),                                 # fine
+            self._cell(4096, winner="recursive_doubling"),    # planted
+        ])
+        assert spc.read("tuned_regression_rejects") == base + 1
+        assert d[(4, 4, 1)]["rules"] == [
+            ("allreduce", 0, 1024, "ring"),
+            ("allreduce", 0, 4096, "builtin"),  # band terminator
+        ]
+        served = ztable.parse_table(ztune.format_table(d), origin="<t>")
+        assert ztable._section_rule(
+            served, "allreduce", 4, 8192, (4, 4, 1)) == "builtin"
+
+    def test_all_rejected_table_is_empty_of_winners(self):
+        base = spc.read("tuned_regression_rejects")
+        d = ztune.distill([self._cell(
+            1024, cand_wires={"ring": 500, "recursive_doubling": 600})])
+        assert spc.read("tuned_regression_rejects") == base + 1
+        assert d[(4, 4, 1)]["rules"] == []  # leading builtin: implicit
+
+    def test_geometry_sized_from_working_set(self):
+        cells = [self._cell(1024), self._cell(65536)]
+        geo = ztune.geometry_for(cells, (4, 4, 1))
+        assert geo["sm_ring_bytes"] == 262144        # 4x64K pow2
+        assert geo["sm_leader_ring_bytes"] == 262144  # clamped floor
+        assert ztune.geometry_for(cells, (9, 9, 9)) == {}
+
+
+class TestSweepE2E:
+    """Tentpole end-to-end, thread-harness speed: a mini-sweep on the
+    flat topology emits a table, a "second job" on the same store picks
+    it up at init and decides with the swept winner — zero re-sweep."""
+
+    def test_mini_sweep_publish_second_job_adopts(self, clean_tables,
+                                                  monkeypatch):
+        cells = ztune.sweep(topos=("flat",), ops=("allreduce",),
+                            min_bytes=1024, max_bytes=1024,
+                            iters=1, trials=1)
+        assert len(cells) == 1
+        d = ztune.distill(cells)
+        (op, _cmin, _bmin, winner), = d[(4, 4, 1)]["rules"]
+        assert op == "allreduce" and winner == "ring"  # 6n < 8n wire
+        # the win is counter-gated: strictly less wire than the flat
+        # hand-set-constants default AND than the auto decision
+        m = cells[0]["modes"]
+        assert m["rule:ring"]["wire"] < m["flat"]["wire"]
+        assert m["rule:ring"]["wire"] < m["auto"]["wire"]
+
+        text = ztune.format_table(
+            d, {(4, 4, 1): ztune.geometry_for(cells, (4, 4, 1))})
+        srv = pmix_mod.PmixServer()
+        try:
+            ztune.publish(f"{srv.address[0]}:{srv.address[1]}", text)
+            # -- the "second job": same DVM store, fresh caches --
+            monkeypatch.setenv(
+                "ZMPI_PMIX",
+                f"{srv.address[0]}:{srv.address[1]}/jobns")
+            tuned.invalidate_rules_cache()
+            swept_base = spc.read("ztune_cells_swept")
+            fetches = spc.read("tuned_table_store_fetches")
+            ztable.prefetch()  # what host_init does under ZMPI_PMIX
+            assert spc.read("tuned_table_store_fetches") == fetches + 1
+            mca_var.set_var("coll_tuned_topology", "4:4:1")
+            assert tuned._dynamic_rule("allreduce", 4, 4096) == "ring"
+            assert ztable.table_geometry(
+                "sm_ring_bytes", (4, 4, 1)) == 262144
+            # zero re-sweeping: serving never runs a single cell
+            assert spc.read("ztune_cells_swept") == swept_base
+        finally:
+            srv.store.destroy_ns(pmix_mod.ZTUNE_NS)
+            srv.close()
+            tuned.invalidate_rules_cache()
+
+    def test_no_orphaned_sweep_processes(self):
+        assert ztune.orphaned_sweep_processes() == []
+
+
+@pytest.mark.slow
+class TestSweepRealProcs:
+    """The real-process twin (the acceptance topology): one interpreter
+    per rank over the live coordinator wire-up, 2 hosts x 2 domains."""
+
+    def test_han2_counter_gated_win(self):
+        topo = ztune.TOPOLOGIES["han2"]
+        import tempfile
+
+        fd, rules = tempfile.mkstemp(suffix=".rules")
+        os.close(fd)
+        try:
+            flat, _ = ztune._measure_procs(
+                topo, "allreduce", 4096, "flat", None, rules,
+                iters=2, trials=2)
+            han, _ = ztune._measure_procs(
+                topo, "allreduce", 4096, "rule:han", "han", rules,
+                iters=2, trials=2)
+        finally:
+            os.unlink(rules)
+        # the hierarchical schedule moves STRICTLY fewer wire bytes
+        # than the flat hand-set default on the 2x2 topology — the
+        # deterministic, counter-gated win the sweep distills
+        assert ztune._wire(han) < ztune._wire(flat)
+        assert han["coll_han_inter_bytes"] > 0  # really took han
+        assert flat["coll_han_inter_bytes"] == 0
+        assert ztune.orphaned_sweep_processes() == []
+
+
+class TestCheckVerb:
+    """Satellite: ``ztune --check`` as the CI validation seam — exit 0
+    on the checked-in fixture, exit 1 on any malformed line."""
+
+    def test_fixture_table_is_checked_in_and_clean(self):
+        assert os.path.exists(FIXTURE)
+        assert ztune.check_table(FIXTURE) == 0
+
+    def test_fixture_serves_real_rules(self, clean_tables):
+        secs = ztable.parse_table(
+            open(FIXTURE, encoding="utf-8").read(), origin=FIXTURE)
+        assert len(secs) >= 3  # flat, han2, han3 sections
+        assert ztable._section_rule(
+            secs, "allreduce", 4, 2048, (4, 4, 1)) == "ring"
+
+    def test_malformed_table_exits_nonzero(self, tmp_path, capsys):
+        bad = _write_rules(tmp_path, "allreduce 0 0 ring\nbogus line\n")
+        assert ztune.check_table(bad) == 1
+        assert "bogus" in capsys.readouterr().out
+
+    def test_missing_table_exits_nonzero(self, tmp_path):
+        assert ztune.check_table(str(tmp_path / "nope.table")) == 1
+
+    def test_check_cli_exit_code(self):
+        """The tier-1 CI wiring: the CLI process exits 0 on the
+        fixture (one subprocess — the import cost is the test)."""
+        env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+        res = subprocess.run(
+            [sys.executable, "-m", "zhpe_ompi_tpu.tools.ztune",
+             "--check", FIXTURE],
+            env=env, cwd=REPO, capture_output=True, text=True,
+            timeout=300)
+        assert res.returncode == 0, res.stdout + res.stderr
+
+
+class TestGeometryAdoption:
+    """The PR 4 leftover: swept per-class ring sizes adopted by the sm
+    segment owners — but an operator's explicit var always outranks."""
+
+    def test_swept_size_adopted_when_var_defaulted(self, clean_tables,
+                                                   tmp_path):
+        from zhpe_ompi_tpu.pt2pt import sm
+
+        path = _write_rules(tmp_path,
+                            "geometry sm_ring_bytes 524288\n")
+        mca_var.set_var("coll_tuned_dynamic_rules", path)
+        assert sm._tuned_ring_bytes("sm_ring_bytes", 4 << 20) == 524288
+
+    def test_operator_setting_outranks_swept(self, clean_tables,
+                                             tmp_path):
+        from zhpe_ompi_tpu.pt2pt import sm
+
+        path = _write_rules(tmp_path,
+                            "geometry sm_ring_bytes 524288\n")
+        mca_var.set_var("coll_tuned_dynamic_rules", path)
+        mca_var.set_var("sm_ring_bytes", 8 << 20)
+        try:
+            assert sm._tuned_ring_bytes(
+                "sm_ring_bytes", 8 << 20) == 8 << 20
+        finally:
+            mca_var.registry.unset("sm_ring_bytes")
+
+    def test_no_table_keeps_default(self, clean_tables):
+        from zhpe_ompi_tpu.pt2pt import sm
+
+        assert sm._tuned_ring_bytes("sm_ring_bytes",
+                                    4 << 20) == 4 << 20

@@ -374,18 +374,51 @@ def _use_numa(ctx, topo: _Topology, opname: str) -> bool:
     return topo.numa_qualified
 
 
-def _rule_requests_han(opname: str, size: int, payload: Any) -> bool:
-    path = mca_var.get("coll_tuned_dynamic_rules", "")
-    if not path:
-        return False
-    # late import: tuned pulls the device-plane stack; only rules-file
-    # users pay for it.  Size matching uses the LOCAL payload size —
-    # ops whose payloads are not congruent across ranks (the host
-    # plane's bcast has none at non-roots) must use msg_bytes_min 0.
-    from . import tuned
+def topology_key(ctx=None):
+    """The job's ``(n_hosts, n_domains, ranks_per_domain)`` decision-
+    table key: the ``coll_tuned_topology`` var when set, else derived
+    from the endpoint's cached locality topology (``ranks_per_domain``
+    is the LARGEST domain — tables for ragged layouts should pin the
+    coarser fields and wildcard it).  Never raises (ZL008): no context
+    or an underivable topology matches wildcard sections only."""
+    from . import ztable
 
-    return tuned._dynamic_rule(
-        opname, size, payload_bytes(payload)) == "han"
+    key = ztable.job_topology_key()
+    if key is not None or ctx is None:
+        return key
+    try:
+        topo = topology(ctx)
+    except errors.MpiError as e:
+        mca_output.verbose(
+            2, _stream,
+            "topology-key derivation failed (%s); tuned tables match "
+            "wildcard sections only", e,
+        )
+        return None
+    n_hosts = len(topo.groups)
+    if topo.nested:
+        n_domains = sum(len(h) for h in topo.nested)
+        biggest = max(
+            (len(d) for h in topo.nested for d in h), default=1)
+    else:
+        n_domains = n_hosts
+        biggest = max((len(g) for g in topo.groups), default=1)
+    return (n_hosts, n_domains, biggest)
+
+
+def _rule_requests_han(opname: str, size: int, payload: Any,
+                       ctx=None) -> bool:
+    # the table ladder (coll/ztable.py): store-served ztune table, then
+    # the rules file — topology-keyed when a context can derive a key.
+    # Size matching uses the LOCAL payload size — ops whose payloads
+    # are not congruent across ranks (the host plane's bcast has none
+    # at non-roots) must use msg_bytes_min 0.
+    from . import ztable
+
+    if not ztable.active():
+        return False
+    return ztable.resolve_rule(
+        opname, size, payload_bytes(payload), topology_key(ctx)) == "han"
 
 
 def wants_han(ctx, opname: str, payload: Any = None, op=None,
@@ -400,7 +433,7 @@ def wants_han(ctx, opname: str, payload: Any = None, op=None,
     if getattr(ctx, "_han_subview", False):
         return False  # phase traffic re-enters the flat algorithms
     requested = mode == "on" or _rule_requests_han(
-        opname, getattr(ctx, "size", 0), payload)
+        opname, getattr(ctx, "size", 0), payload, ctx)
     if not requested and mode != "auto":  # unknown mode string: off
         return False
     topo = topology(ctx)

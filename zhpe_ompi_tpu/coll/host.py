@@ -42,7 +42,11 @@ from typing import Any
 import numpy as np
 
 from ..core import errors
+from ..mca import output as mca_output
 from ..mca import var as mca_var
+from ..utils.payload import payload_size_estimate as payload_bytes
+
+_stream = mca_output.open_stream("coll_host")
 
 mca_var.register(
     "host_coll_large_msg", 256 * 1024,
@@ -102,6 +106,42 @@ def _han_route(ctx, opname: str, payload: Any = None, op=None):
 
     if han_mod.wants_han(ctx, opname, payload, op, mode):
         return han_mod
+    return None
+
+
+# Flat host-plane algorithms a tuned decision table may name per op —
+# the ztune candidate surface (besides "han", which routes through
+# _han_route/wants_han above).  Every name here maps onto an existing
+# eligibility-guarded body below; a rule naming one for an INELIGIBLE
+# call (non-commutative op, scalar payload) degrades loudly to the
+# builtin decision, never to a wrong answer.
+HOST_RULE_ALGS = {
+    "allreduce": ("recursive_doubling", "ring"),
+    "reduce": ("binomial", "pipeline"),
+}
+
+
+def _rule_alg(ctx, opname: str, payload: Any = None) -> "str | None":
+    """The host plane's tuned-table consult (the coll/ztable.py ladder:
+    store-served ztune table, then the rules file), topology-keyed from
+    this endpoint's locality probe.  Returns a flat algorithm name from
+    ``HOST_RULE_ALGS`` or None — builtin thresholds and the auto han
+    decision apply.  "han" rules return None HERE: the _han_route seam
+    owns them (via han's ``_rule_requests_han``)."""
+    if getattr(ctx, "_han_subview", False):
+        return None  # phase traffic re-enters the builtin decisions
+    from . import ztable
+
+    if not ztable.active():
+        return None
+    from . import han as han_mod
+
+    algname = ztable.resolve_rule(
+        opname, getattr(ctx, "size", 0), payload_bytes(payload),
+        han_mod.topology_key(ctx),
+    )
+    if algname is not None and algname in HOST_RULE_ALGS.get(opname, ()):
+        return algname
     return None
 
 # Reserved context id for host-plane collective traffic (the
@@ -401,9 +441,25 @@ def reduce(ctx, value: Any, op, root: int = 0,
             f"unknown reduce algorithm {alg!r} (auto|pipeline)"
         )
     if algorithm is None and alg == "auto":
-        han = _han_route(ctx, "reduce", value, op)
-        if han is not None:
-            return han.reduce(ctx, value, op, root)
+        # tuned-table consult first (see allreduce): an explicit rule
+        # outranks the auto han decision; explicit user/var algorithm
+        # selection above outranks BOTH
+        ruled = _rule_alg(ctx, "reduce", value)
+        if ruled == "pipeline":
+            if getattr(op, "commute", True):
+                alg = "pipeline"
+            else:
+                mca_output.verbose(
+                    2, _stream,
+                    "tuned rule names pipeline reduce but the op is "
+                    "non-commutative (chain order != rank order); "
+                    "builtin decision applies",
+                )
+                ruled = None
+        if ruled is None:
+            han = _han_route(ctx, "reduce", value, op)
+            if han is not None:
+                return han.reduce(ctx, value, op, root)
     if size == 1:
         return value
     if alg == "pipeline":
@@ -491,17 +547,32 @@ def allreduce(ctx, value: Any, op) -> Any:
     size, rank = ctx.size, ctx.rank
     if size == 1:
         return value
-    han = _han_route(ctx, "allreduce", value, op)
-    if han is not None:
-        return han.allreduce(ctx, value, op)
+    # tuned-table consult first: an explicit per-cell rule outranks the
+    # auto han decision AND the builtin size thresholds (the reference's
+    # dynamic-rules precedence); "han" rules still route below
+    ruled = _rule_alg(ctx, "allreduce", value)
+    if ruled is None:
+        han = _han_route(ctx, "allreduce", value, op)
+        if han is not None:
+            return han.allreduce(ctx, value, op)
     tag = _next_tag(ctx, TAG_ALLREDUCE)
     large = int(mca_var.get("host_coll_large_msg", 256 * 1024))
-    if (
+    ring_eligible = (
         size > 2
         and isinstance(value, np.ndarray)
-        and value.nbytes >= large
         and value.size >= size
         and getattr(op, "commute", False)
+    )
+    if ruled == "ring" and not ring_eligible:
+        mca_output.verbose(
+            2, _stream,
+            "tuned rule names ring allreduce but the call is ineligible "
+            "(need > 2 ranks, commutative op, ndarray with >= %d "
+            "elements); builtin decision applies", size,
+        )
+        ruled = None
+    if ruled == "ring" or (
+        ruled is None and ring_eligible and value.nbytes >= large
     ):
         return _allreduce_ring(ctx, value, op, tag)
     pof2 = 1

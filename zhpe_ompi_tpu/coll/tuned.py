@@ -17,7 +17,12 @@ all TPU-native:
 Dynamic-rules files (``coll_tuned_dynamic_file.c``) are supported in a
 simplified form: ``coll_tuned_dynamic_rules`` names a file of
 ``<op> <comm_size_min> <msg_bytes_min> <algorithm>`` lines; the most specific
-matching line wins.
+matching line wins.  Since PR 19 the loader is :mod:`.ztable`, which adds
+``[topology n_hosts n_domains ranks_per_domain]`` sections (headerless
+files keep their PR 6 meaning) and a second table source ahead of the
+file var: a ztune-swept table served from the DVM's PMIx store when
+``ZMPI_PMIX`` is set.  The decide ladder is therefore store table ->
+file table -> builtin fixed decisions.
 """
 
 from __future__ import annotations
@@ -209,7 +214,17 @@ def _register_params():
         "Path to a dynamic decision-rules file "
         "(<op> <comm_size_min> <msg_bytes_min> <algorithm> per line; "
         "'han' as the algorithm selects the hierarchical host path for "
-        + ", ".join(sorted(_HAN_RULE_OPS)) + ")",
+        + ", ".join(sorted(_HAN_RULE_OPS)) + "; optional "
+        "[topology n_hosts n_domains ranks_per_domain] sections scope "
+        "rules to a topology shape — see coll/ztable.py)",
+    )
+    # the topology key selecting [topology ...] sections; registered by
+    # coll/ztable.py at import (same default) — re-register here so the
+    # MPI_T/zmpi-info surface lists it with the decision layer's vars
+    mca_var.register(
+        "coll_tuned_topology", "",
+        "Topology key 'n_hosts:n_domains:ranks_per_domain' for tuned "
+        "decision-table section matching (see coll/ztable.py)",
     )
     # the hierarchical host component's enable knob lives with the host
     # collectives (coll/host.py registers it at import); re-register
@@ -232,81 +247,81 @@ def _register_params():
 from ..utils.payload import payload_nbytes as _nbytes  # noqa: E402
 
 
-_rules_cache: dict[str, list[tuple[str, int, int, str]]] = {}
-
 # host-plane ops the hierarchical (coll/han) component provides: "han"
 # is a valid rule-line algorithm for exactly these — the rule then
 # selects the two-level schedule through coll/host.py's dispatch seam
 # (the DEVICE decision below never returns it; its tables are XLA-side).
 # One source of truth: the seam's own set.
 from .host import HAN_OPS as _HAN_RULE_OPS  # noqa: E402
+from . import ztable  # noqa: E402
+
+# The table cache, shared with (and owned by) coll/ztable.py: keyed
+# path -> ((mtime_ns, size), sections), so a rules file rewritten in
+# place — exactly what ztune re-emitting a table does — reloads on the
+# next decide (the PR 19 fix of the PR 6 path-only cache).  The alias
+# keeps the historical invalidation idiom working:
+# ``tuned._rules_cache.pop(path, None)``.
+_rules_cache = ztable._file_cache
+
+
+def invalidate_rules_cache() -> None:
+    """Drop every cached decision-table source — file stamps AND the
+    once-per-process store-served table — so the next decide() re-reads
+    them.  The hook ztune (or any operator retuning a live process)
+    calls after republishing a table."""
+    ztable.invalidate_cache()
 
 
 def _valid_rule_alg(op: str, algname: str) -> bool:
+    if algname == "builtin":
+        # explicit band terminator: "keep the builtin decision here" —
+        # ztune's distiller emits it so a rejected cell is never covered
+        # by a neighboring winner's band (decide()'s ``dyn in table``
+        # check makes it fall through naturally)
+        return True
     table = _ALG_TABLES.get(op)
     if table is not None and algname in table:
         return True
     return algname == "han" and op in _HAN_RULE_OPS
 
 
+# install the (op, alg)-pair validator on the table plane: ztable owns
+# shape parsing; WHICH algorithm names exist is this module's knowledge
+ztable.set_alg_validator(_valid_rule_alg)
+
+
 def _load_rules(path: str) -> list[tuple[str, int, int, str]]:
-    """Parse a dynamic-rules file, degrading LOUDLY per line: a
-    malformed or unknown-op/unknown-algorithm line is reported and
-    skipped — the decision then falls back to the fixed defaults — but
-    never raises out of the decision layer into a collective call."""
-    rules: list[tuple[str, int, int, str]] = []
+    """Parse a dynamic-rules file into the historical FLAT rule list,
+    degrading LOUDLY per line (malformed / unknown-op / unknown-
+    algorithm lines are reported and skipped — never raising out of the
+    decision layer into a collective call).  Sectioned tables flatten
+    across sections; topology-aware resolution goes through
+    :func:`ztable.resolve_rule` instead."""
     try:
         with open(path, "r", encoding="utf-8") as fh:
-            for lineno, line in enumerate(fh, 1):
-                parts = line.split("#")[0].split()
-                if not parts:
-                    continue
-                reason = None
-                if len(parts) != 4:
-                    reason = "expected <op> <comm_min> <bytes_min> <alg>"
-                else:
-                    try:
-                        cmin, bmin = int(parts[1]), int(parts[2])
-                    except ValueError:
-                        reason = "non-integer comm/byte threshold"
-                    else:
-                        if not _valid_rule_alg(parts[0], parts[3]):
-                            reason = (
-                                f"unknown op/algorithm "
-                                f"{parts[0]}/{parts[3]}"
-                            )
-                if reason is not None:
-                    mca_output.emit(
-                        _stream,
-                        "coll_tuned_dynamic_rules %s:%d: ignoring "
-                        "%r (%s); the fixed decision applies",
-                        path, lineno, line.strip(), reason,
-                    )
-                    continue
-                rules.append((parts[0], cmin, bmin, parts[3]))
+            text = fh.read()
     except OSError as e:
         mca_output.emit(
             _stream,
             "coll_tuned_dynamic_rules file %r unreadable (%s); "
             "falling back to fixed decisions", path, e,
         )
-    return rules
+        return []
+    return [
+        rule
+        for _key, rules, _geom in ztable.parse_table(text, origin=path)
+        for rule in rules
+    ]
 
 
 def _dynamic_rule(opname: str, comm_size: int, nbytes: int) -> str | None:
-    path = mca_var.get("coll_tuned_dynamic_rules", "")
-    if not path:
-        return None
-    rules = _rules_cache.get(path)
-    if rules is None:
-        rules = _rules_cache[path] = _load_rules(path)
-    best = None
-    best_key = (-1, -1)
-    for op, cmin, bmin, algname in rules:
-        if op == opname and comm_size >= cmin and nbytes >= bmin:
-            if (cmin, bmin) > best_key:
-                best, best_key = algname, (cmin, bmin)
-    return best
+    """Resolve through the PR 19 table ladder: the store-served ztune
+    table (when ``ZMPI_PMIX`` is set) first, then the file named by
+    ``coll_tuned_dynamic_rules``, else None (fixed decisions apply).
+    Topology sections match against the ``coll_tuned_topology`` key."""
+    return ztable.resolve_rule(
+        opname, comm_size, nbytes, ztable.job_topology_key(),
+    )
 
 
 def profiles() -> dict[str, str]:

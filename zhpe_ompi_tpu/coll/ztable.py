@@ -1,0 +1,380 @@
+"""coll/ztable — topology-keyed tuned decision tables (the ztune plane).
+
+The reference's coll/tuned ships decision tables distilled from benchmark
+sweeps (``coll_tuned_dynamic_file.c`` reads them; the OSU ladders produce
+them).  This module is the serving side of our analog: ``tools/ztune``
+sweeps the OSU ladders per topology shape and distills the winners into a
+*sectioned* dynamic-rules table; this module parses, caches, and resolves
+those tables for the decision seams in ``coll/tuned.py`` (device plane),
+``coll/host.py``/``coll/han.py`` (host plane), and ``pt2pt/sm.py``
+(segment geometry adoption).
+
+Table format — a superset of the PR 6 dynamic-rules file::
+
+    # comments and blank lines ignored
+    [topology 2 2 2]            # n_hosts n_domains ranks_per_domain
+    allreduce 0 16384 han       # <op> <comm_min> <bytes_min> <alg>
+    geometry sm_ring_bytes 1048576
+    [topology * * *]            # wildcard section: matches every job
+    allreduce 4 16384 ring
+
+Lines before any ``[topology ...]`` header belong to an implicit
+all-wildcard section, which is exactly the legacy headerless format — every
+PR 6 rules file and shipped profile parses unchanged.
+
+Resolution is **most-specific-wins** across sections: sections are ordered
+by pinned-field count (then pinned-ness of ``n_hosts`` over ``n_domains``
+over ``ranks_per_domain``), the first matching section holding a rule that
+fires for ``(op, comm_size, nbytes)`` wins, and within a section the most
+specific ``(comm_min, bytes_min)`` rule wins (the PR 6 rule).  A job with
+no known topology key matches only all-wildcard sections.
+
+Two table sources form a ladder, consulted in order:
+
+1. the **store-served** table: published by ztune into the DVM's PMIx
+   store under ``runtime/pmix.py``'s well-known ztune key, fetched once
+   per process (negative-cached) when ``ZMPI_PMIX`` is set;
+2. the **file** table named by the ``coll_tuned_dynamic_rules`` MCA var.
+
+Builtin fixed decisions apply when neither ladder rung answers — and on
+ANY malformed input: per the ZL008 contract this module degrades loudly
+(every bad line is reported on the ``coll_ztable`` stream) but never lets
+a corrupt table raise into a collective call or a segment mmap.
+"""
+
+from __future__ import annotations
+
+import os
+
+from ..mca import output as mca_output
+from ..mca import var as mca_var
+
+_stream = mca_output.open_stream("coll_ztable")
+
+#: sm segment-geometry vars a table may size (the PR 4 leftover): adopted
+#: by ``pt2pt/sm.py``'s directory-entry geometry path only while the var
+#: still holds its registered default (an operator's explicit setting,
+#: from env/file/API, always outranks the swept value).
+GEOMETRY_VARS = ("sm_ring_bytes", "sm_leader_ring_bytes")
+
+mca_var.register(
+    "coll_tuned_topology", "",
+    "Topology key 'n_hosts:n_domains:ranks_per_domain' selecting the "
+    "matching [topology ...] sections of a tuned decision table; '' "
+    "derives the key from the han topology probe where a context is "
+    "available and matches only wildcard sections otherwise",
+)
+
+# A parsed table is a list of sections, each
+#   (key, rules, geometry)
+# with key a 3-tuple of int-or-None (None = wildcard field), rules a list
+# of (op, comm_min, bytes_min, alg) and geometry a dict var-name -> bytes.
+_WILDCARD = (None, None, None)
+
+# Installed by coll/tuned.py at its import: validates (op, alg) pairs
+# against the real algorithm tables (including "han" for the host-plane
+# ops).  Absent (a process that never imported tuned), rule lines pass
+# shape validation only — every decision seam still re-checks membership
+# before dispatch, so an unvalidated token can select nothing.
+_alg_validator = None
+
+
+def set_alg_validator(fn) -> None:
+    global _alg_validator
+    _alg_validator = fn
+
+
+def _complain(origin, lineno, line, reason, problems) -> None:
+    if problems is not None:
+        problems.append((lineno, line.strip(), reason))
+    mca_output.emit(
+        _stream,
+        "tuned table %s:%d: ignoring %r (%s); the fixed decision applies",
+        origin, lineno, line.strip(), reason,
+    )
+
+
+def _parse_header(parts):
+    """``["topology", H, D, R]`` with int-or-* fields -> key or None."""
+    if len(parts) != 4 or parts[0] != "topology":
+        return None
+    fields = []
+    for tok in parts[1:]:
+        if tok == "*":
+            fields.append(None)
+            continue
+        try:
+            val = int(tok)
+        except ValueError:
+            return None
+        if val < 1:
+            return None
+        fields.append(val)
+    return tuple(fields)
+
+
+def _specificity(key):
+    pinned = sum(1 for f in key if f is not None)
+    return (-pinned, tuple(0 if f is not None else 1 for f in key))
+
+
+def parse_table(text, origin="<table>", problems=None):
+    """Parse a sectioned tuned table, degrading LOUDLY per line: every
+    malformed header/rule/geometry line is reported (and collected into
+    ``problems`` when given, the ``--check`` seam) and skipped, and rule
+    lines under an unparseable header are quarantined — reported and
+    never served — rather than misfiled into the previous topology."""
+    by_key = {}
+    order = []
+    current = _WILDCARD
+    quarantined = False
+    for lineno, line in enumerate((text or "").splitlines(), 1):
+        stripped = line.split("#")[0].strip()
+        if not stripped:
+            continue
+        if stripped.startswith("["):
+            if not stripped.endswith("]"):
+                _complain(origin, lineno, line,
+                          "unterminated [topology ...] header", problems)
+                quarantined = True
+                continue
+            key = _parse_header(stripped[1:-1].split())
+            if key is None:
+                _complain(
+                    origin, lineno, line,
+                    "expected [topology <n_hosts|*> <n_domains|*> "
+                    "<ranks_per_domain|*>]", problems)
+                quarantined = True
+                continue
+            current = key
+            quarantined = False
+            continue
+        if quarantined:
+            _complain(origin, lineno, line,
+                      "line under an unparseable [topology ...] header",
+                      problems)
+            continue
+        parts = stripped.split()
+        if parts[0] == "geometry":
+            reason = None
+            nbytes = 0
+            if len(parts) != 3:
+                reason = "expected geometry <var> <bytes>"
+            elif parts[1] not in GEOMETRY_VARS:
+                reason = (f"unknown geometry var {parts[1]!r} (one of "
+                          + ", ".join(GEOMETRY_VARS) + ")")
+            else:
+                try:
+                    nbytes = int(parts[2])
+                except ValueError:
+                    reason = "non-integer geometry bytes"
+                else:
+                    if nbytes < 1:
+                        reason = "geometry bytes must be positive"
+            if reason is not None:
+                _complain(origin, lineno, line, reason, problems)
+                continue
+            if current not in by_key:
+                by_key[current] = ([], {})
+                order.append(current)
+            by_key[current][1][parts[1]] = nbytes
+            continue
+        reason = None
+        cmin = bmin = 0
+        if len(parts) != 4:
+            reason = "expected <op> <comm_min> <bytes_min> <alg>"
+        else:
+            try:
+                cmin, bmin = int(parts[1]), int(parts[2])
+            except ValueError:
+                reason = "non-integer comm/byte threshold"
+            else:
+                if _alg_validator is not None and not _alg_validator(
+                        parts[0], parts[3]):
+                    reason = f"unknown op/algorithm {parts[0]}/{parts[3]}"
+        if reason is not None:
+            _complain(origin, lineno, line, reason, problems)
+            continue
+        if current not in by_key:
+            by_key[current] = ([], {})
+            order.append(current)
+        by_key[current][0].append((parts[0], cmin, bmin, parts[3]))
+    sections = [(key, by_key[key][0], by_key[key][1]) for key in order]
+    sections.sort(key=lambda s: _specificity(s[0]))
+    return sections
+
+
+def _matches(section_key, job_key) -> bool:
+    for want, have in zip(section_key, job_key or _WILDCARD):
+        if want is not None and want != have:
+            return False
+    return True
+
+
+def _section_rule(sections, opname, comm_size, nbytes, job_key):
+    for key, rules, _geom in sections:
+        if not _matches(key, job_key):
+            continue
+        best = None
+        best_at = (-1, -1)
+        for op, cmin, bmin, algname in rules:
+            if (op == opname and comm_size >= cmin and nbytes >= bmin
+                    and (cmin, bmin) > best_at):
+                best, best_at = algname, (cmin, bmin)
+        if best is not None:
+            return best
+    return None
+
+
+# -- table sources: store ladder rung, then file ladder rung ------------
+
+# path -> ((mtime_ns, size), sections).  The (mtime_ns, size) stamp is
+# the PR 19 satellite fix: the PR 6 cache was keyed on path alone, so a
+# rules file rewritten in place (exactly what ztune re-emitting a table
+# does) was never reloaded.
+_file_cache: dict = {}
+
+# ZMPI_PMIX value -> sections or None (negative cache: a dead/absent
+# store is probed once per process, then the file/builtin ladder applies
+# without ever raising — the store-loss degradation contract).
+_store_cache: dict = {}
+
+
+def invalidate_cache() -> None:
+    """Drop all cached table state (file stamps and the store fetch)."""
+    _file_cache.clear()
+    _store_cache.clear()
+
+
+def load_file(path):
+    """Parse ``path`` into sections through the (mtime_ns, size)-stamped
+    cache; unreadable files degrade loudly to an empty table."""
+    try:
+        st = os.stat(path)
+    except OSError as e:
+        mca_output.emit(
+            _stream,
+            "tuned table file %r unreadable (%s); falling back to fixed "
+            "decisions", path, e,
+        )
+        _file_cache.pop(path, None)
+        return []
+    stamp = (st.st_mtime_ns, st.st_size)
+    hit = _file_cache.get(path)
+    if hit is not None and hit[0] == stamp:
+        return hit[1]
+    try:
+        with open(path, "r", encoding="utf-8") as fh:
+            text = fh.read()
+    except OSError as e:
+        mca_output.emit(
+            _stream,
+            "tuned table file %r unreadable (%s); falling back to fixed "
+            "decisions", path, e,
+        )
+        _file_cache.pop(path, None)
+        return []
+    sections = parse_table(text, origin=path)
+    _file_cache[path] = (stamp, sections)
+    return sections
+
+
+def _store_sections():
+    env = os.environ.get("ZMPI_PMIX", "")
+    if not env:
+        return None
+    if env in _store_cache:
+        return _store_cache[env]
+    from ..runtime import pmix as pmix_mod
+
+    addr = env.split("/", 1)[0]
+    text = pmix_mod.fetch_tuned_table(addr)
+    sections = parse_table(text, origin=f"pmix:{addr}") if text else None
+    _store_cache[env] = sections
+    return sections
+
+
+def prefetch() -> None:
+    """Warm the store-served table cache (called from ``host_init`` when
+    ``ZMPI_PMIX`` is set, so the first collective pays no fetch)."""
+    _store_sections()
+
+
+def active() -> bool:
+    """Cheap gate for the hot seams: is any table source configured?"""
+    if os.environ.get("ZMPI_PMIX", ""):
+        return True
+    return bool(mca_var.get("coll_tuned_dynamic_rules", ""))
+
+
+def job_topology_key():
+    """The job's ``(n_hosts, n_domains, ranks_per_domain)`` key from the
+    ``coll_tuned_topology`` var, or None (match wildcard sections only).
+    Malformed values degrade loudly to None, never raise (ZL008)."""
+    raw = str(mca_var.get("coll_tuned_topology", "")).strip()
+    if not raw:
+        return None
+    parts = raw.split(":")
+    fields = []
+    if len(parts) == 3:
+        for tok in parts:
+            try:
+                val = int(tok)
+            except ValueError:
+                fields = None
+                break
+            fields.append(val)
+    else:
+        fields = None
+    if not fields or any(f < 1 for f in fields):
+        mca_output.emit(
+            _stream,
+            "coll_tuned_topology %r malformed (want "
+            "'n_hosts:n_domains:ranks_per_domain', positive ints); "
+            "matching wildcard sections only", raw,
+        )
+        return None
+    return tuple(fields)
+
+
+def resolve_rule(opname, comm_size, nbytes, job_key=None):
+    """Resolve ``(op, comm_size, nbytes)`` through the table ladder:
+    store-served table first, then the ``coll_tuned_dynamic_rules`` file,
+    else None (the caller's builtin fixed decision applies)."""
+    sections = _store_sections()
+    if sections:
+        algname = _section_rule(sections, opname, comm_size, nbytes,
+                                job_key)
+        if algname is not None:
+            from ..runtime import spc
+
+            spc.record("tuned_table_hits")
+            return algname
+    path = mca_var.get("coll_tuned_dynamic_rules", "")
+    if path:
+        algname = _section_rule(load_file(str(path)), opname, comm_size,
+                                nbytes, job_key)
+        if algname is not None:
+            from ..runtime import spc
+
+            spc.record("tuned_table_hits")
+            return algname
+    return None
+
+
+def table_geometry(varname, job_key=None):
+    """Resolve a swept segment-geometry var through the same ladder;
+    None when no matching section sizes it."""
+    if varname not in GEOMETRY_VARS:
+        return None
+    sections = _store_sections()
+    if sections:
+        for key, _rules, geom in sections:
+            if _matches(key, job_key) and varname in geom:
+                return geom[varname]
+    path = mca_var.get("coll_tuned_dynamic_rules", "")
+    if path:
+        for key, _rules, geom in load_file(str(path)):
+            if _matches(key, job_key) and varname in geom:
+                return geom[varname]
+    return None

@@ -563,10 +563,37 @@ def segment_audit_failures() -> list[str]:
         return list(_audit_failures)
 
 
+def _tuned_ring_bytes(varname: str, current: int) -> int:
+    """Per-class ring sizing from a ztune-swept decision table (the
+    PR 4 leftover, served through coll/ztable.py's ladder): adopted
+    ONLY while ``varname`` still holds its registered default — an
+    operator's explicit setting (env/file/API) always outranks the
+    swept value.  Never raises into segment creation; no table, a
+    table without a geometry line, or an unimportable table plane all
+    keep the var's own value."""
+    try:
+        held = mca_var.lookup(varname)
+        if held is not None and held.source != mca_var.VarSource.DEFAULT:
+            return current
+        from ..coll import ztable
+
+        swept = ztable.table_geometry(varname, ztable.job_topology_key())
+    except Exception as e:  # pragma: no cover - defensive seam
+        mca_output.verbose(
+            2, _stream,
+            "tuned geometry consult for %s failed (%s); the var's own "
+            "value applies", varname, e,
+        )
+        return current
+    if swept is None:
+        return current
+    return int(swept)
+
+
 def _geometry() -> tuple[int, int]:
     slot_bytes = max(64, int(mca_var.get("sm_max_frag", 128 << 10)))
-    ring_bytes = max(slot_bytes, int(mca_var.get("sm_ring_bytes",
-                                                 4 << 20)))
+    ring_bytes = max(slot_bytes, _tuned_ring_bytes(
+        "sm_ring_bytes", int(mca_var.get("sm_ring_bytes", 4 << 20))))
     nslots = max(2, ring_bytes // slot_bytes)
     return nslots, slot_bytes
 
@@ -574,12 +601,14 @@ def _geometry() -> tuple[int, int]:
 def _class_geometry(klass: int) -> tuple[int, int]:
     """(nslots, slot_bytes) of a peer class, from the OWNER's vars at
     segment creation: intra-domain rings size by ``sm_ring_bytes``,
-    leader-to-leader rings by ``sm_leader_ring_bytes``."""
+    leader-to-leader rings by ``sm_leader_ring_bytes`` — each
+    adoptable from a ztune-swept table while the var is defaulted
+    (:func:`_tuned_ring_bytes`)."""
     if klass == CLASS_LEADER:
         slot_bytes = max(64, int(mca_var.get("sm_max_frag", 128 << 10)))
-        ring_bytes = max(slot_bytes,
-                         int(mca_var.get("sm_leader_ring_bytes",
-                                         2 << 20)))
+        ring_bytes = max(slot_bytes, _tuned_ring_bytes(
+            "sm_leader_ring_bytes",
+            int(mca_var.get("sm_leader_ring_bytes", 2 << 20))))
         return max(2, ring_bytes // slot_bytes), slot_bytes
     return _geometry()
 

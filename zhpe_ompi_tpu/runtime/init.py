@@ -183,6 +183,15 @@ def host_init(timeout: float = 30.0):
             lifeline = os.environ.get("ZMPI_LIFELINE")
             if lifeline:
                 _arm_lifeline(lifeline)
+            # warm the ztune decision-table cache from the daemon's
+            # store (coll/ztable.py; negative-cached, never raises):
+            # every job launched after a sweep published its table
+            # resolves the tuned decisions for ITS topology at init,
+            # with zero re-sweeping — and the first collective pays
+            # no fetch
+            from ..coll import ztable
+
+            ztable.prefetch()
         else:
             proc = TcpProc(
                 rank, size, coordinator=(chost, cport), timeout=timeout,

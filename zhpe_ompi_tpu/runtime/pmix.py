@@ -105,6 +105,73 @@ def stale_namespaces() -> list[str]:
     return out
 
 
+# -- the ztune table plane (tools/ztune <-> coll/ztable) ----------------
+#
+# ztune distills a swept decision table and publishes it HERE, under a
+# well-known namespace/key, so every subsequent job launched on the same
+# DVM resolves the tuned table for ITS topology at init with zero
+# re-sweeping (coll/ztable.py fetches through ``fetch_tuned_table``).
+
+ZTUNE_NS = "ztune"
+ZTUNE_KEY = "tuned_table"
+#: the publishing "rank" — the table has one writer (the sweep harness),
+#: so the namespace is size 1 and rank 0 owns the put/commit.
+ZTUNE_RANK = 0
+
+
+def publish_tuned_table(store, text: str) -> None:
+    """Publish a ztune-distilled decision table under the well-known
+    ztune key.  ``store`` is anything with the shared verb surface —
+    a :class:`PmixStore` (in-process) or :class:`PmixClient` (a sweep
+    harness publishing into a live zprted's store over the wire)."""
+    store.ensure_ns(ZTUNE_NS, 1)
+    store.put(ZTUNE_NS, ZTUNE_RANK, ZTUNE_KEY, str(text))
+    store.commit(ZTUNE_NS, ZTUNE_RANK)
+
+
+def fetch_tuned_table(address: "tuple[str, int] | str",
+                      timeout: float = 5.0) -> "str | None":
+    """Fetch the published tuned table from the store at ``address``,
+    or None.  NEVER raises: a DVM with no published table, an
+    unreachable/closed store, or a mid-job store loss all degrade to
+    None — the caller's file/builtin ladder applies (the loud-
+    degradation contract; reported at verbose level, not an error)."""
+    client = None
+    try:
+        client = PmixClient(address, timeout=timeout)
+        published = client.lookup(ZTUNE_NS, ZTUNE_KEY)
+    except (errors.MpiError, OSError, ValueError) as e:
+        mca_output.verbose(
+            1, _stream,
+            "ztune table fetch from %r failed (%s); file/builtin "
+            "decisions apply", address, e,
+        )
+        return None
+    finally:
+        if client is not None:
+            client.close()
+    text = published.get(ZTUNE_KEY)
+    if isinstance(text, str) and text:
+        spc.record("tuned_table_store_fetches")
+        return text
+    return None
+
+
+def stale_tuned_tables() -> list[str]:
+    """ztune table state still published in a tracked store at session
+    end — a DVM's ``stop()`` (via ``store.close()``) or an explicit
+    ``destroy_ns(ZTUNE_NS)`` drops it; anything here is a sweep that
+    published into a store nobody tore down."""
+    out = []
+    for store in list(_live_stores):
+        for ns in store.namespaces():
+            if ns == ZTUNE_NS:
+                out.append(
+                    f"pmix-ztune:{ns}:{sorted(store.lookup(ns))}"
+                )
+    return out
+
+
 def parse_addr(address: "tuple[str, int] | str") -> tuple[str, int]:
     """Normalize a ``"host:port"`` string or ``(host, port)`` pair —
     one parser for every runtime-plane client/server address."""

@@ -369,6 +369,27 @@ at the recording seams inside ztrace's documented table):
   run's wire byte counters must be byte-identical to an untraced
   baseline, and this counter must stay zero.
 
+Self-tuning-plane counters (the ztune sweep/serve loop —
+``tools/ztune.py`` records the sweep side, ``coll/ztable.py`` and
+``runtime/pmix.py`` the serving side):
+
+- ``tuned_table_hits`` — decision-table resolutions that answered a
+  collective's (op, comm size, bytes) cell from a ztune table (store-
+  served or file), instead of the builtin fixed decision.  Recorded
+  at trace/decide time, once per resolved decision.
+- ``tuned_table_store_fetches`` — published tables actually fetched
+  from a DVM's PMIx store (once per process; the negative result is
+  cached too).  A second job on a swept DVM moves this by exactly its
+  process count, with zero re-sweeping.
+- ``tuned_regression_rejects`` — distilled cells the ztune regression
+  gate REFUSED to emit because the candidate's counter-gated wire
+  bytes exceeded the default's for that (op, comm_size, nbytes) cell;
+  a planted worse-than-default winner must move this, never the
+  table.
+- ``ztune_cells_swept`` — (op, size, candidate, topology) benchmark
+  cells the sweep harness measured; the sweep's own progress/coverage
+  denominator.
+
 Templated counter families (dynamic names routed through literal
 templates at the call site; the zlint ZL009 publisher-seam rule
 matches recorded names against these — an f-string counter whose

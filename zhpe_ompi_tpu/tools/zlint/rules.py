@@ -871,6 +871,10 @@ class LoudDegradation(Rule):
         "wants_han", "_use_numa", "_numa_mode", "_rule_requests_han",
         "parse_card", "parse_numa", "numa_token", "topology",
         "locality_groups",
+        # the ztune table plane (PR 19): every seam between a tuned
+        # table and a live decision degrades loudly, never by raising
+        "parse_table", "resolve_rule", "table_geometry",
+        "job_topology_key", "topology_key",
     }
 
     def visit(self, mod: Module) -> list[Finding]:
