@@ -1038,6 +1038,17 @@ def _worker_main(spec: dict) -> int:
                           "counters": deltas,
                           "sm_stats": sm_stats}), flush=True)
         return 0
+    if spec["kind"] == "alltoall":
+        from zhpe_ompi_tpu.mca import var as mca_var
+
+        mca_var.set_var("coll_han_enable", spec["han_mode"])
+        try:
+            rows, deltas = _alltoall_worker_body(proc, spec)
+        finally:
+            proc.close()
+        print(json.dumps({"rank": rank, "rows": rows,
+                          "counters": deltas}), flush=True)
+        return 0
     if spec["kind"] == "osc":
         try:
             rows, odeltas, sums = _osc_worker_body(proc, spec)
@@ -1396,6 +1407,186 @@ def bench_han(max_size: int = 4 << 20, iters: int = 5, nprocs: int = 4,
         raise RuntimeError(
             "han plane: the pipeline ladder crossed >= 2-segment sizes "
             "but no allreduce took the pipelined schedule"
+        )
+    return out_rows
+
+
+# counters every --plane alltoall worker reports (deltas over its
+# run); the parent sums them for the fallback and wire-byte gates
+_ALLTOALL_COUNTERS = _HAN_COUNTERS + (
+    "coll_han_alltoall_collectives", "coll_han_alltoall_inter_bytes",
+    "coll_han_alltoall_leader_msgs",
+)
+
+
+def _alltoall_worker_body(proc, spec: dict) -> tuple[list[dict], dict]:
+    """--plane alltoall rank body: alltoall + alltoallv ladder on the
+    emulated topology, result-checked per rung, per-rung seconds the
+    BEST of ``trials`` windows MAX-reduced over the ranks (the han
+    ladder's timing discipline).  Returns (rows — rank 0 only,
+    counter deltas)."""
+    from zhpe_ompi_tpu import ops
+    from zhpe_ompi_tpu.runtime import spc
+
+    n, rank = proc.size, proc.rank
+    iters = int(spec["iters"])
+    trials = max(1, int(spec.get("trials", 3)))
+    label = spec.get("label") or (
+        "flat" if spec["han_mode"] == "off" else "han")
+    rows: list[dict] = []
+    base = {c: spc.read(c) for c in _ALLTOALL_COUNTERS}
+    for nbytes in _sizes(int(spec["max_size"]),
+                         int(spec.get("min_bytes", 1 << 10))):
+        w = max(1, nbytes // (8 * n))  # per-destination block words
+        blocks = [np.full(w, float(rank * 10 + d)) for d in range(n)]
+        out = proc.alltoall(blocks)  # warmup + correctness
+        for s in range(n):
+            got = np.asarray(out[s])
+            if got[0] != float(s * 10 + rank) or got.size != w:
+                raise RuntimeError(
+                    f"{label} ladder: wrong alltoall block from rank "
+                    f"{s} at {w * 8}B/dest (got {got[0]})"
+                )
+        a2a_sec = float("inf")
+        for _ in range(trials):
+            proc.barrier()
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                proc.alltoall(blocks)
+            a2a_sec = min(a2a_sec, (time.perf_counter() - t0) / iters)
+        # alltoallv: rank r ships (d+1)*w words to rank d — the
+        # variable blocks ride the same aggregated leader exchange
+        counts = [(d + 1) * w for d in range(n)]
+        sendbuf = np.concatenate(
+            [np.full((d + 1) * w, float(rank * 10 + d))
+             for d in range(n)])
+        outv = proc.alltoallv(sendbuf, counts)
+        for s in range(n):
+            got = np.asarray(outv[s])
+            if got.size != (rank + 1) * w or got[0] != float(s * 10 + rank):
+                raise RuntimeError(
+                    f"{label} ladder: wrong alltoallv block from rank "
+                    f"{s} (size {got.size}, head {got[0]})"
+                )
+        v_sec = float("inf")
+        for _ in range(trials):
+            proc.barrier()
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                proc.alltoallv(sendbuf, counts)
+            v_sec = min(v_sec, (time.perf_counter() - t0) / iters)
+        for op, sec, total in (
+                ("alltoall", a2a_sec, n * w * 8),
+                ("alltoallv", v_sec, sum(counts) * 8)):
+            sec = float(np.asarray(
+                proc.allreduce(np.float64(sec), ops.MAX)))
+            if rank == 0:
+                rows.append({
+                    "op": f"{label}_host_{op}", "bytes": total,
+                    "latency_us": sec * 1e6,
+                    "bandwidth_MBps": (total / sec) / 1e6,
+                })
+        proc.barrier()
+    return rows, {c: spc.read(c) - base[c] for c in _ALLTOALL_COUNTERS}
+
+
+def _run_alltoall_threads(spec: dict, nprocs: int, boots: dict,
+                          numas: dict | None = None) -> list:
+    """Thread-harness variant of the alltoall ladder (one process,
+    shared counters) — the fast CI rows; the slow gates use
+    ``--real-procs``."""
+    from zhpe_ompi_tpu.mca import var as mca_var
+    from zhpe_ompi_tpu.runtime import spc
+
+    base = {c: spc.read(c) for c in _ALLTOALL_COUNTERS}
+    kwargs_by_rank = {r: {"sm_boot_id": b} for r, b in boots.items()}
+    for r, numa in (numas or {}).items():
+        kwargs_by_rank.setdefault(r, {})["sm_numa_id"] = numa
+    mca_var.set_var("coll_han_enable", spec["han_mode"])
+    try:
+        res = _run_tcp_ranks(
+            nprocs, lambda p: _alltoall_worker_body(p, spec),
+            kwargs_by_rank=kwargs_by_rank,
+        )
+    finally:
+        mca_var.unset("coll_han_enable")
+    deltas = {c: spc.read(c) - base[c] for c in _ALLTOALL_COUNTERS}
+    zeros = {c: 0 for c in _ALLTOALL_COUNTERS}
+    return [{"rank": r, "rows": rows if rows else [],
+             "counters": deltas if r == 0 else zeros}
+            for r, (rows, _d) in enumerate(res)]
+
+
+def bench_alltoall(max_size: int = 4 << 20, iters: int = 5,
+                   nprocs: int = 4, hosts: int = 2,
+                   real_procs: bool = True) -> list[dict]:
+    """The alltoall-family ladder (PR 20) on the emulated 2-host ×
+    2-domain topology: flat pairwise (``coll_han_enable=off``) vs the
+    hierarchical three-phase block schedule (``on``) for alltoall AND
+    alltoallv at every size.  Gates — the serving plane's
+    expert-dispatch acceptance, byte-accounted rather than timed:
+
+    - the han run may not silently fall back to flat
+      (``han_flat_fallbacks`` summed over ranks stays 0);
+    - the aggregated leader exchange must actually run
+      (``coll_han_alltoall_collectives`` and
+      ``coll_han_alltoall_inter_bytes`` must rise);
+    - the han run's ON-WIRE TCP bytes must stay STRICTLY below the
+      flat run's at equal total payload — one aggregated message per
+      leader pair against a message per cross-host RANK pair;
+    - the aggregated payload itself (``coll_han_alltoall_inter_bytes``)
+      must also sit below the flat run's wire bytes."""
+    group = max(1, -(-nprocs // hosts))
+    boots = {r: f"hanhost{r // group}" for r in range(nprocs)}
+    numas = {r: f"d{r % group}" for r in range(nprocs)}
+    spec_base = {"kind": "alltoall", "max_size": max_size,
+                 "iters": iters,
+                 "min_bytes": max(1, min(1 << 10, max_size))}
+    out_rows: list[dict] = []
+    agg: dict[str, dict] = {}
+    for han_mode, label in (("off", "flat"), ("on", "han")):
+        spec = dict(spec_base, han_mode=han_mode, label=label)
+        if real_procs:
+            reports = _run_proc_bench(
+                spec, nprocs,
+                rank_overrides={r: {"boot": boots[r],
+                                    "numa": numas[r]}
+                                for r in range(nprocs)},
+                collect_all=True,
+            )
+        else:
+            reports = _run_alltoall_threads(spec, nprocs, boots, numas)
+        rows = next(r["rows"] for r in reports if r["rows"])
+        agg[label] = {c: sum(r["counters"][c] for r in reports)
+                      for c in _ALLTOALL_COUNTERS}
+        out_rows += rows
+    if agg["han"]["han_flat_fallbacks"]:
+        raise RuntimeError(
+            f"alltoall plane: {agg['han']['han_flat_fallbacks']} "
+            "collective(s) silently fell back to flat on a qualified "
+            "topology"
+        )
+    if agg["han"]["coll_han_alltoall_collectives"] == 0 \
+            or agg["han"]["coll_han_alltoall_inter_bytes"] == 0:
+        raise RuntimeError(
+            "alltoall plane: the aggregated leader exchange never "
+            "engaged (collectives="
+            f"{agg['han']['coll_han_alltoall_collectives']}, "
+            f"inter_bytes={agg['han']['coll_han_alltoall_inter_bytes']})"
+        )
+    if agg["han"]["tcp_bytes_sent"] >= agg["flat"]["tcp_bytes_sent"]:
+        raise RuntimeError(
+            f"alltoall plane: han wire bytes "
+            f"({agg['han']['tcp_bytes_sent']}) not strictly below the "
+            f"flat run's ({agg['flat']['tcp_bytes_sent']})"
+        )
+    if agg["han"]["coll_han_alltoall_inter_bytes"] >= \
+            agg["flat"]["tcp_bytes_sent"]:
+        raise RuntimeError(
+            f"alltoall plane: aggregated payload bytes "
+            f"({agg['han']['coll_han_alltoall_inter_bytes']}) not "
+            f"below the flat run's wire bytes "
+            f"({agg['flat']['tcp_bytes_sent']})"
         )
     return out_rows
 
@@ -2275,7 +2466,7 @@ def main(argv: list[str] | None = None) -> int:
                    help="frames in flight per ack in --bw mode")
     p.add_argument("--plane", default="device",
                    choices=("device", "host", "sm", "han", "numa",
-                            "osc"),
+                            "osc", "alltoall"),
                    help="collectives: device = XLA mesh (default); "
                         "host = coll/host over real loopback sockets; "
                         "sm = same, with the shared-memory rings "
@@ -2290,7 +2481,10 @@ def main(argv: list[str] | None = None) -> int:
                         "direct-map one-sided ladder (put/get/fetch-"
                         "atomic on sm-region windows vs the forced-AM "
                         "reference, byte-identical + counter-gated; "
-                        "--real-procs for per-process counter tables)")
+                        "--real-procs for per-process counter tables); "
+                        "alltoall = flat-vs-hierarchical alltoall/"
+                        "alltoallv ladder on the emulated topology, "
+                        "han wire bytes gated strictly below flat")
     p.add_argument("--nprocs", type=int, default=4,
                    help="socket ranks for --plane host/sm/han/numa "
                         "collectives (numa defaults to hosts*domains*2)")
@@ -2394,6 +2588,10 @@ def main(argv: list[str] | None = None) -> int:
     elif args.plane == "osc":
         rows = bench_osc(args.max_size, max(args.iters, 5),
                          real_procs=args.real_procs)
+    elif args.plane == "alltoall":
+        rows = bench_alltoall(args.max_size, max(args.iters, 3),
+                              nprocs=args.nprocs, hosts=args.hosts,
+                              real_procs=args.real_procs)
     elif args.plane == "numa":
         nprocs = args.nprocs if args.nprocs != 4 \
             else args.hosts * args.domains * 2

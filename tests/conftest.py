@@ -268,6 +268,21 @@ def _ulfm_detector_hygiene():
         f"the suite (a test that publishes a ztune table destroys the "
         f"ztune namespace or closes the store): {tables}"
     )
+    from zhpe_ompi_tpu.models import inferloop as inferloop_mod
+
+    servers = inferloop_mod.live_worker_threads()
+    assert not servers, (
+        f"inference serving threads leaked past their loop's stop() "
+        f"(rank 0's stop broadcasts the shutdown; every rank's worker "
+        f"exits through the same step boundary): {servers}"
+    )
+    parked = inferloop_mod.parked_tickets()
+    assert not parked, (
+        f"request-queue tickets left parked at session end (a serving "
+        f"plane drains by serving, failing, or evicting every "
+        f"submitted request — a parked ticket is a caller wedged in "
+        f"result() forever): {parked}"
+    )
 
 
 @pytest.fixture(autouse=True)

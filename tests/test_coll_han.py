@@ -328,6 +328,142 @@ class TestHanAlgorithms:
         assert uni.run(prog) == [True] * 4
 
 
+class TestHanAlltoall:
+    """The alltoall family's three-phase block schedule (PR 20): intra
+    gather → one aggregated wire message per leader pair → intra
+    scatter, the pairwise/Bruck leader-exchange switch, and the
+    reduce_scatter leader phase riding the SAME aggregated exchange
+    (the counter non-regression pin)."""
+
+    @staticmethod
+    def _blocks(r, n, w=4):
+        return [np.full(w, float(r * 10 + d)) for d in range(n)]
+
+    @pytest.mark.parametrize(
+        "n,groups", [(4, GROUPS_2x2), (6, GROUPS_3_2_1), (4, None)],
+        ids=["2x2", "3-2-1", "degenerate-1group"])
+    def test_alltoall_matches_flat(self, n, groups):
+        c0 = spc.read("coll_han_alltoall_collectives")
+
+        def prog(ctx):
+            return han.alltoall(ctx, self._blocks(ctx.rank, n),
+                                groups=groups)
+
+        res = LocalUniverse(n).run(prog)
+        for r, out in enumerate(res):
+            assert len(out) == n
+            for s in range(n):
+                np.testing.assert_allclose(
+                    out[s], np.full(4, float(s * 10 + r)))
+        assert spc.read("coll_han_alltoall_collectives") - c0 == n
+
+    def test_alltoallv_variable_blocks(self):
+        """Variable per-destination counts through the same block
+        schedule: rank r sends d+1 copies of r*10+d to rank d."""
+        n = 4
+
+        def prog(ctx):
+            counts = [d + 1 for d in range(n)]
+            sendbuf = [float(ctx.rank * 10 + d)
+                       for d in range(n) for _ in range(d + 1)]
+            return han.alltoallv(ctx, sendbuf, counts,
+                                 groups=GROUPS_2x2)
+
+        res = LocalUniverse(n).run(prog)
+        for r, out in enumerate(res):
+            for s in range(n):
+                assert out[s] == [float(s * 10 + r)] * (r + 1)
+
+    def test_leader_exchange_decision(self, fresh_vars, monkeypatch):
+        """The ZL008-registered decision function: pairwise below the
+        bar, Bruck at it, loud fallback (never a raise) on garbage."""
+
+        class Inter:
+            def __init__(self, size):
+                self.size = size
+
+        assert han._leader_exchange_alg(Inter(7)) == "pairwise"
+        assert han._leader_exchange_alg(Inter(8)) == "bruck"
+        mca_var.set_var("coll_han_alltoall_bruck_min", 2)
+        assert han._leader_exchange_alg(Inter(2)) == "bruck"
+        mca_var.set_var("coll_han_alltoall_bruck_min", 0)
+        assert han._leader_exchange_alg(Inter(64)) == "pairwise"
+        # a malformed value that bypassed the typed registry (e.g. a
+        # foreign store) degrades loudly to the default bar of 8
+        real_get = han.mca_var.get
+        monkeypatch.setattr(
+            han.mca_var, "get",
+            lambda name, *a, **k: "garbage"
+            if name == "coll_han_alltoall_bruck_min"
+            else real_get(name, *a, **k))
+        assert han._leader_exchange_alg(Inter(8)) == "bruck"
+        assert han._leader_exchange_alg(Inter(7)) == "pairwise"
+
+    def test_bruck_leader_exchange_correct_and_fewer_msgs(
+            self, fresh_vars):
+        """Four singleton groups = four leaders on the wire phase:
+        Bruck at bar 2 ships ceil(log2 4) = 2 messages per leader
+        against pairwise's 3 — and the payload bytes stay correct."""
+        n = 4
+        singles = [[r] for r in range(n)]
+
+        def run(n_):
+            def prog(ctx):
+                return han.alltoall(ctx, self._blocks(ctx.rank, n_),
+                                    groups=singles)
+
+            return LocalUniverse(n_).run(prog)
+
+        mca_var.set_var("coll_han_alltoall_bruck_min", 2)
+        m0 = spc.read("coll_han_alltoall_leader_msgs")
+        res = run(n)
+        bruck_msgs = spc.read("coll_han_alltoall_leader_msgs") - m0
+        mca_var.set_var("coll_han_alltoall_bruck_min", 0)
+        m0 = spc.read("coll_han_alltoall_leader_msgs")
+        res_pw = run(n)
+        pairwise_msgs = spc.read("coll_han_alltoall_leader_msgs") - m0
+        for res_ in (res, res_pw):
+            for r, out in enumerate(res_):
+                for s in range(n):
+                    np.testing.assert_allclose(
+                        out[s], np.full(4, float(s * 10 + r)))
+        assert bruck_msgs == n * 2      # ceil(log2 4) per leader
+        assert pairwise_msgs == n * 3   # p-1 per leader
+
+    def test_reduce_scatter_rides_aggregated_exchange(self):
+        """Satellite 1's non-regression pin: the reduce_scatter leader
+        phase goes through ``_leader_alltoall`` — the alltoall family's
+        wire counters move by EXACTLY the aggregated schedule's
+        accounting (one message per leader pair, the partials' payload
+        and nothing more), and the result still matches the flat twin."""
+        n, w = 4, 2
+        b0 = spc.read("coll_han_alltoall_inter_bytes")
+        m0 = spc.read("coll_han_alltoall_leader_msgs")
+
+        def prog(ctx):
+            blocks = [np.full(w, float(ctx.rank + 1 + b))
+                      for b in range(n)]
+            return han.reduce_scatter(ctx, blocks, ops.SUM,
+                                      groups=GROUPS_2x2)
+
+        res = LocalUniverse(n).run(prog)
+        for r, out in enumerate(res):
+            np.testing.assert_allclose(out, np.full(w, 10.0 + 4 * r))
+        # 2 leaders, pairwise: ONE wire message each, carrying the
+        # OTHER group's two partial blocks (w float64 each)
+        assert spc.read("coll_han_alltoall_leader_msgs") - m0 == 2
+        inter = spc.read("coll_han_alltoall_inter_bytes") - b0
+        assert inter == 2 * (2 * w * 8)
+
+    def test_alltoall_shape_validated(self):
+        def prog(ctx):
+            with pytest.raises(errors.ArgError, match="blocks"):
+                han.alltoall(ctx, [1, 2], groups=GROUPS_2x2)
+            return True
+
+        assert LocalUniverse(4).run(prog) == [True] * 4
+
+
 class TestDecision:
     """coll_han_enable auto/on/off through coll/host.py's dispatch
     seam, the loud flat fallback, and the topology qualification bar."""
@@ -485,6 +621,41 @@ class TestWireCorrectness:
             assert out["ag"] == ["a", "b", "c", "d"]
             assert out["rs"] == 10.0 + 4 * r
         assert spc.read("han_flat_fallbacks") == fb0
+
+    def test_alltoall_wire_bytes_below_flat(self, fresh_vars):
+        """The PR-20 acceptance gate on the emulated 2-host topology:
+        the han alltoall's aggregated leader exchange puts strictly
+        fewer bytes on the wire than the flat pairwise path (two
+        leader messages per round against eight cross-host rank-pair
+        messages), with ZERO loud flat fallbacks, and the family's
+        inter-bytes counter accounts the aggregated payload."""
+        laps, w = 4, 64
+
+        def run_once():
+            def prog(p):
+                blocks = [np.full(w, float(p.rank * 10 + d))
+                          for d in range(4)]
+                p.barrier()
+                b0 = spc.read("tcp_bytes_sent")
+                for _ in range(laps):
+                    out = p.alltoall(blocks)
+                p.barrier()
+                for s in range(4):
+                    np.testing.assert_allclose(
+                        out[s], np.full(w, float(s * 10 + p.rank)))
+                return spc.read("tcp_bytes_sent") - b0
+
+            return max(run_wire(4, prog, boots_2x2()))
+
+        mca_var.set_var("coll_han_enable", "off")
+        flat_bytes = run_once()
+        mca_var.set_var("coll_han_enable", "on")
+        fb0 = spc.read("han_flat_fallbacks")
+        ib0 = spc.read("coll_han_alltoall_inter_bytes")
+        han_bytes = run_once()
+        assert spc.read("han_flat_fallbacks") == fb0
+        assert spc.read("coll_han_alltoall_inter_bytes") > ib0
+        assert 0 < han_bytes < flat_bytes, (han_bytes, flat_bytes)
 
     def test_no_leaked_tag_windows_after_close(self, fresh_vars):
         mca_var.set_var("coll_han_enable", "on")

@@ -798,3 +798,178 @@ class TestPerPeerFiles:
         assert len(paths) == 2
         for path in paths:
             assert not os.path.exists(path), path
+
+
+# ----------------------------------------------- stage handoff (PR 20)
+
+
+def _handoff_prog(p, epochs=3, width=8):
+    """Producer (rank 0) streams `epochs` KV-shaped payloads to the
+    consumer (rank 1) through one persistent StageHandoff."""
+    from zhpe_ompi_tpu.osc.direct import StageHandoff
+
+    win = allocate_window(p, width * 8, np.float64)
+    win.fence()
+    hoff = StageHandoff(win, producer=0, consumer=1)
+    got = []
+    for e in range(epochs):
+        if p.rank == 1:
+            hoff.post()
+            hoff.wait()
+            got.append(np.asarray(hoff.recv(0, width)).tolist())
+        else:
+            hoff.start()
+            hoff.put(np.full(width, float(100 + e)), 0)
+            hoff.complete()
+    p.barrier()
+    direct = hoff.direct
+    win.free()
+    return direct, hoff.epochs, got
+
+
+class TestStageHandoff:
+    """The RMA stage-handoff acceptance gate (PR 20): same-host
+    pipeline epochs ride the region doorbell — the doorbell counters
+    move while the AM apply counter stays FLAT — and the forced-AM
+    twin answers the identical payload stream."""
+
+    def test_doorbell_epochs_direct_am_flat(self, fresh_vars):
+        posts0 = spc.read("osc_doorbell_posts")
+        comps0 = spc.read("osc_doorbell_completes")
+        am0 = spc.read("osc_am_applied")
+        fb0 = spc.read("osc_am_fallbacks")
+        res = run_sm(2, _handoff_prog, sm=True)
+        assert res[0] == (True, 3, [])  # producer: direct, 3 epochs
+        direct, epochs, got = res[1]
+        assert direct and epochs == 3
+        assert got == [[float(100 + e)] * 8 for e in range(3)]
+        # the gate: doorbells rang, the AM service applied NOTHING,
+        # nothing fell back
+        assert spc.read("osc_doorbell_posts") - posts0 == 3
+        assert spc.read("osc_doorbell_completes") - comps0 == 3
+        assert spc.read("osc_am_applied") == am0
+        assert spc.read("osc_am_fallbacks") == fb0
+
+    def test_forced_am_same_payloads_plain(self, fresh_vars):
+        """osc_direct=0: no regions anywhere, so the handshake pins
+        BOTH sides to AM PSCW as a plain AM window — zero doorbell
+        rings, identical payloads, and NOT counted as a fallback
+        (only direct-capable windows routing to AM are loud)."""
+        mca_var.set_var("osc_direct", 0)
+        posts0 = spc.read("osc_doorbell_posts")
+        fb0 = spc.read("osc_am_fallbacks")
+        res = run_sm(2, _handoff_prog, sm=True)
+        assert res[0][0] is False and res[1][0] is False
+        assert res[1][2] == [[float(100 + e)] * 8 for e in range(3)]
+        assert spc.read("osc_doorbell_posts") == posts0
+        assert spc.read("osc_am_fallbacks") == fb0
+
+    def test_unmappable_peer_pins_both_to_am_loud(self, fresh_vars):
+        """One side of a direct-capable window cannot map the peer:
+        the handshake pins BOTH sides to AM PSCW (no split-brain
+        schedule) and the reroute is LOUD on each side."""
+        from zhpe_ompi_tpu.osc.direct import StageHandoff
+
+        def prog(p, epochs=3, width=8):
+            win = allocate_window(p, width * 8, np.float64)
+            win.fence()
+            if p.rank == 0:
+                win._direct = lambda target: None  # producer can't map
+            hoff = StageHandoff(win, producer=0, consumer=1)
+            got = []
+            for e in range(epochs):
+                if p.rank == 1:
+                    hoff.post()
+                    hoff.wait()
+                    got.append(np.asarray(hoff.recv(0, width)).tolist())
+                else:
+                    hoff.start()
+                    hoff.put(np.full(width, float(100 + e)), 0)
+                    hoff.complete()
+            p.barrier()
+            direct = hoff.direct
+            win.free()
+            return direct, got
+
+        posts0 = spc.read("osc_doorbell_posts")
+        fb0 = spc.read("osc_am_fallbacks")
+        res = run_sm(2, prog, sm=True)
+        # the consumer COULD map (its own region) — the handshake still
+        # pins it to AM so neither side parks on a doorbell the other
+        # never rings
+        assert res[0][0] is False and res[1][0] is False
+        assert res[1][1] == [[float(100 + e)] * 8 for e in range(3)]
+        assert spc.read("osc_doorbell_posts") == posts0
+        assert spc.read("osc_am_fallbacks") >= fb0 + 2
+
+    def test_handoff_role_verbs_enforced(self, fresh_vars):
+        from zhpe_ompi_tpu.osc.direct import StageHandoff
+
+        def prog(p):
+            win = allocate_window(p, 64, np.float64)
+            win.fence()
+            hoff = StageHandoff(win, producer=0, consumer=1)
+            wrong = hoff.post if p.rank == 0 else hoff.start
+            with pytest.raises(errors.WinError):
+                wrong()
+            with pytest.raises(errors.WinError):
+                StageHandoff(win, producer=1, consumer=1)
+            p.barrier()
+            win.free()
+            return True
+
+        assert run_sm(2, prog, sm=True) == [True, True]
+
+    def test_pipeline_schedule_chains_stages(self, fresh_vars):
+        """3-stage chain: each epoch's activation flows 0 -> 1 -> 2
+        through per-pair doorbells; middle rank holds BOTH ends."""
+        from zhpe_ompi_tpu.osc.direct import pipeline_schedule
+
+        def prog(p):
+            win = allocate_window(p, 64, np.float64)
+            win.fence()
+            sched = pipeline_schedule(win)
+            out = []
+            for e in range(2):
+                val = float(10 * (e + 1))
+                if "up" in sched:
+                    sched["up"].post()
+                    sched["up"].wait()
+                    val = float(np.asarray(sched["up"].recv(0, 1))[0]) + 1
+                    out.append(val)
+                if "down" in sched:
+                    sched["down"].start()
+                    sched["down"].put(np.float64(val), 0)
+                    sched["down"].complete()
+            p.barrier()
+            keys = sorted(sched)
+            win.free()
+            return keys, out
+
+        res = run_sm(3, prog, sm=True)
+        assert res[0] == (["down"], [])
+        assert res[1] == (["down", "up"], [11.0, 21.0])
+        assert res[2] == (["up"], [12.0, 22.0])
+
+    def test_window_bcast_direct_payload(self, fresh_vars):
+        """Weight broadcast on the RMA plane: every rank pulls the
+        root's region; the payload rides direct gets (osc_direct_bytes
+        moves, osc_am_applied flat on the same-host mesh)."""
+        from zhpe_ompi_tpu.osc.direct import window_bcast
+
+        am0 = spc.read("osc_am_applied")
+        d0 = spc.read("osc_direct_bytes")
+
+        def prog(p):
+            win = allocate_window(p, 16 * 8, np.float64)
+            win.fence()
+            w = np.arange(16.0) if p.rank == 0 else None
+            got = window_bcast(win, w, root=0)
+            p.barrier()
+            win.free()
+            return np.asarray(got).tolist()
+
+        res = run_sm(2, prog, sm=True)
+        assert res[0] == res[1] == np.arange(16.0).tolist()
+        assert spc.read("osc_direct_bytes") > d0
+        assert spc.read("osc_am_applied") == am0

@@ -205,6 +205,36 @@ def test_han_rows_thread_harness():
             assert np.isfinite(r["bandwidth_MBps"])
 
 
+def test_alltoall_rows_thread_harness():
+    """Fast smoke for the --plane alltoall ladder (thread harness):
+    flat and han legs emit sane alltoall AND alltoallv rows and the
+    built-in gates hold — zero silent flat fallbacks, the aggregated
+    leader exchange engaged, and the han run's wire bytes strictly
+    below the flat run's."""
+    rows = osu_zmpi.bench_alltoall(max_size=1 << 11, iters=2,
+                                   real_procs=False)
+    for prefix in ("flat_host_alltoall", "han_host_alltoall",
+                   "flat_host_alltoallv", "han_host_alltoallv"):
+        sub = [r for r in rows if r["op"] == prefix]
+        assert sub, f"no rows for {prefix}"
+        for r in sub:
+            assert r["bytes"] > 0 and r["latency_us"] > 0
+            assert np.isfinite(r["bandwidth_MBps"])
+
+
+@pytest.mark.slow
+def test_alltoall_ladder_real_procs():
+    """CI smoke for the serving plane's expert-dispatch gate (PR 20):
+    the REAL-PROCESS 2-host x 2-domain emulated topology must run the
+    three-phase block schedule — bench_alltoall raises on any silent
+    flat fallback, a leader exchange that never engaged, or han wire
+    bytes not strictly below the flat run's."""
+    rows = osu_zmpi.bench_alltoall(max_size=1 << 16, iters=3,
+                                   real_procs=True)
+    assert any(r["op"] == "han_host_alltoall" for r in rows)
+    assert any(r["op"] == "flat_host_alltoallv" for r in rows)
+
+
 def test_overlap_rows_and_counter_gates():
     """Fast smoke for the --overlap ladder (nonblocking-engine
     satellite): rows carry both overlap views, the deferred-engine

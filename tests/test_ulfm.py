@@ -1238,6 +1238,117 @@ class TestKillDuringHan:
         assert res[1:] == ["typed"] * 3
 
 
+class TestKillDuringHanAlltoall:
+    """PR 20's FT gate on the alltoall family: a rank dying in EITHER
+    phase of the three-phase block schedule (intra gather, aggregated
+    leader wire exchange) surfaces typed to the survivors, the revoke/
+    ack/agree/shrink recipe converges, and the SURVIVOR alltoall is
+    byte-correct — over real sockets AND the thread plane."""
+
+    BOOTS = TestKillDuringHan.BOOTS
+
+    @staticmethod
+    def _survivor_alltoall(p, sh):
+        out = sh.alltoall([np.full(4, float(p.rank * 10 + d))
+                           for d in range(sh.size)])
+        return [float(np.asarray(b)[0]) for b in out]
+
+    def _check_survivors(self, res, victim, n=4):
+        survivors = [r for r in range(n) if r != victim]
+        for j, r in enumerate(survivors):
+            size, got, kind = res[r]
+            assert size == n - 1
+            assert got == [float(survivors[s] * 10 + j)
+                           for s in range(n - 1)], (r, got)
+        assert "ProcFailed" in [res[r][2] for r in survivors]
+
+    def _kill_during_alltoall_wire(self, victim, after_ops, seed):
+        from zhpe_ompi_tpu.coll import host as coll_host
+
+        mca_var.set_var("ft_detector_period", 0.05)
+        mca_var.set_var("ft_detector_timeout", 0.4)
+        mca_var.set_var("coll_han_enable", "on")
+        n = 4
+        plan = FaultPlan(seed=seed).kill_rank(victim, after_ops=after_ops)
+
+        def prog(p):
+            p.set_errhandler(errh.ERRORS_RETURN)
+            inj = plan.arm(p)
+            observed = None
+            try:
+                inj.alltoall([np.full(16, float(p.rank * 10 + d))
+                              for d in range(n)])
+            except errors.ProcFailed as e:
+                observed = e
+                p.revoke(coll_host.COLL_CID)
+            except errors.Revoked as e:
+                observed = e
+            assert observed is not None, \
+                "alltoall completed despite the mid-phase kill"
+            assert p.ft_state.wait_failed(victim, timeout=10.0)
+            p.failure_ack()
+            assert p.agree(True) is True
+            sh = p.shrink()
+            return (sh.size, self._survivor_alltoall(p, sh),
+                    type(observed).__name__)
+
+        res = run_tcp_ft(n, prog, kwargs_by_rank=self.BOOTS)
+        assert res[victim] == "killed"
+        self._check_survivors(res, victim)
+
+    def test_wire_kill_nonleader_during_intra_phase(self, fresh_vars):
+        # rank 3 dies on its FIRST phase op — before handing its send
+        # list to its leader — so leader 2 classifies typed out of the
+        # intra gather
+        self._kill_during_alltoall_wire(3, after_ops=0, seed=61)
+
+    def test_wire_kill_leader_during_inter_phase(self, fresh_vars):
+        # rank 2 consumes its member's intra list (op 1) and dies
+        # entering the aggregated leader exchange, stranding leader 0
+        # mid-wire and member 3 in the intra scatter
+        self._kill_during_alltoall_wire(2, after_ops=1, seed=62)
+
+    def _kill_during_alltoall_threads(self, victim, after_ops, seed):
+        from zhpe_ompi_tpu.coll import han
+        from zhpe_ompi_tpu.coll import host as coll_host
+
+        n = 4
+        groups = [[0, 1], [2, 3]]
+        plan = FaultPlan(seed=seed).kill_rank(victim, after_ops=after_ops)
+        uni = LocalUniverse(n, ft=True)
+
+        def prog(p):
+            p.set_errhandler(errh.ERRORS_RETURN)
+            inj = plan.arm(p)
+            observed = None
+            try:
+                han.alltoall(inj, [np.full(16, float(p.rank * 10 + d))
+                                   for d in range(n)], groups=groups)
+            except errors.ProcFailed as e:
+                observed = e
+                p.revoke(coll_host.COLL_CID)
+            except errors.Revoked as e:
+                observed = e
+            assert observed is not None, \
+                "alltoall completed despite the mid-phase kill"
+            assert p.ft_state.wait_failed(victim, timeout=10.0)
+            p.failure_ack()
+            assert p.agree(True) is True
+            sh = p.shrink()
+            return (sh.size, self._survivor_alltoall(p, sh),
+                    type(observed).__name__)
+
+        res = uni.run(prog, timeout=60.0)
+        assert res[victim] is None  # the kill unwound the thread
+        self._check_survivors(res, victim)
+
+    def test_thread_kill_nonleader_during_intra_phase(self):
+        self._kill_during_alltoall_threads(3, after_ops=0, seed=63)
+
+    def test_thread_kill_leader_during_inter_phase(self):
+        self._kill_during_alltoall_threads(2, after_ops=1, seed=64)
+
+
 class TestAgreeFailedSet:
     """Internal agreement on the failed SET (not just a flag) — the
     uniform-knowledge step the consensus shrink builds on."""

@@ -28,7 +28,16 @@ coll-rides-the-PML layering, applied twice.  Algorithms:
 - ``allgather``  — intra gather → leader allgather (blocks travel with
   their global rank map) → intra bcast.
 - ``reduce_scatter`` — intra blockwise reduce → leader alltoall of each
-  group's blocks → per-block combine → intra scatter.
+  group's blocks → per-block combine → intra scatter (the leader phase
+  rides the aggregated han exchange below).
+- ``alltoall``/``alltoallv`` — intra gather of each member's full
+  rank-indexed send list → ONE aggregated leader exchange per host pair
+  (pairwise below ``coll_han_alltoall_bruck_min`` leaders, Bruck
+  store-and-forward at or above it) → intra scatter of the reassembled
+  receive lists.  Every cross-host block crosses the wire exactly once
+  in O(hosts²) or O(hosts·log hosts) messages instead of the flat
+  path's O(ranks²) — the MoE expert-dispatch pattern
+  (``models/moe.py``).
 
 Selection (the coll_han_component decision, wired through
 ``coll/host.py``'s dispatch seam and ``coll/tuned.py``'s dynamic-rules
@@ -870,7 +879,7 @@ def reduce_scatter(ctx, values: list, op,
     if inter is not None:
         send = [[partial[g] for g in topo.groups[k]]
                 for k in range(len(topo.groups))]
-        got = host.alltoall(inter, send)
+        got = _leader_alltoall(inter, send)
         mine = got[0]
         for j in range(1, len(got)):
             mine = [host._combine(op, a, b)
@@ -878,3 +887,133 @@ def reduce_scatter(ctx, values: list, op,
     if intra.size > 1:
         return host.scatter(intra, mine, root=0)
     return mine[0]
+
+
+# --------------------------------------------------------------- alltoall
+
+
+mca_var.register(
+    "coll_han_alltoall_bruck_min", 8,
+    "Leader count at which the han alltoall family's wire exchange "
+    "switches from pairwise (one aggregated message per leader pair, "
+    "p-1 rounds) to Bruck store-and-forward (ceil(log2 p) rounds, "
+    "each forwarding up to half the aggregated blocks); 0 pins "
+    "pairwise at every leader count",
+    type=int,
+)
+
+
+def _leader_exchange_alg(inter) -> str:
+    """Wire-exchange decision of the han alltoall family's leader
+    phase: "pairwise" below ``coll_han_alltoall_bruck_min`` leaders,
+    "bruck" at or above the bar.  Degrades loudly, never raises
+    (ZL008): a malformed bar falls back to the registered default."""
+    try:
+        bar = int(mca_var.get("coll_han_alltoall_bruck_min", 8))
+    except (TypeError, ValueError):
+        mca_output.verbose(
+            2, _stream,
+            "coll_han_alltoall_bruck_min is not an integer; the "
+            "default bar (8) applies",
+        )
+        bar = 8
+    return "bruck" if bar > 0 and getattr(inter, "size", 0) >= bar \
+        else "pairwise"
+
+
+def _leader_alltoall(inter, send: list) -> list:
+    """The aggregated leader exchange shared by alltoall/alltoallv and
+    reduce_scatter's leader phase: each wire message carries a whole
+    per-host block aggregate instead of the flat path's one message
+    per cross-host RANK pair.  ``coll_han_alltoall_inter_bytes``
+    accounts the payload this leader hands to the wire (its own block
+    excluded); ``coll_han_alltoall_leader_msgs`` the wire messages it
+    issues."""
+    n, rank = inter.size, inter.rank
+    spc.record(
+        "coll_han_alltoall_inter_bytes",
+        sum(payload_bytes(send[j]) for j in range(n) if j != rank),
+    )
+    if _leader_exchange_alg(inter) == "bruck":
+        spc.record("coll_han_alltoall_leader_msgs",
+                   max(0, (n - 1).bit_length()))
+        tag = host._next_tag(inter, host.TAG_ALLTOALL)
+        return host._alltoall_bruck(inter, list(send), tag)
+    spc.record("coll_han_alltoall_leader_msgs", max(0, n - 1))
+    return host.alltoall(inter, send)
+
+
+def _alltoall_blocks(ctx, topo: _Topology, blocks: list) -> list:
+    """The shared three-phase block schedule: intra gather of each
+    member's full rank-indexed send list to its leader → leader j
+    ships leader k the [src-in-j × dst-in-k] block matrix through
+    ``_leader_alltoall`` → intra scatter of each member's reassembled
+    rank-indexed receive list.  Intra traffic grows (every list rides
+    the sm rings twice) to buy the wire aggregation — the han trade."""
+    intra, inter = _views(ctx, topo)
+    spc.record("coll_han_alltoall_collectives", 1)
+    rank = getattr(ctx, "rank", -1)
+    with ztrace.phase_span("intra", rank, op="alltoall"):
+        lists = host.gather(intra, blocks, root=0) \
+            if intra.size > 1 else [blocks]
+    recv_lists = None
+    if inter is not None:
+        members = topo.groups[topo.gidx]
+        send = [[[lists[si][d] for d in topo.groups[k]]
+                 for si in range(len(members))]
+                for k in range(len(topo.groups))]
+        flightrec.record(flightrec.COLL_ENTER, op="alltoall",
+                         phase="inter")
+        with ztrace.phase_span("inter-host", getattr(inter, "rank", -1),
+                               op="alltoall"):
+            got = _leader_alltoall(inter, send)
+        flightrec.record(flightrec.COLL_EXIT, op="alltoall",
+                         phase="inter")
+        # got[j][si][di]: the block global rank topo.groups[j][si] sent
+        # to the di-th member of MY group — reassemble one rank-indexed
+        # receive list per member
+        recv_lists = []
+        for di in range(len(members)):
+            out: list = [None] * ctx.size
+            for j, srcs in enumerate(topo.groups):
+                for si, src in enumerate(srcs):
+                    out[src] = got[j][si][di]
+            recv_lists.append(out)
+    elif len(topo.groups) == 1 and getattr(intra, "rank", -1) == 0:
+        # forced single-group topology: no wire phase — the leader
+        # holds every member's list already
+        members = topo.groups[0]
+        recv_lists = []
+        for di in range(len(members)):
+            out = [None] * ctx.size
+            for si, src in enumerate(members):
+                out[src] = lists[si][members[di]]
+            recv_lists.append(out)
+    if intra.size > 1:
+        with ztrace.phase_span("intra", rank, op="alltoall"):
+            return host.scatter(intra, recv_lists, root=0)
+    return recv_lists[0]
+
+
+@_recorded("alltoall")
+def alltoall(ctx, values: list,
+             groups: list[list[int]] | None = None) -> list:
+    """Two-level alltoall: see ``_alltoall_blocks``.  ``values`` is the
+    rank-indexed send list; returns the rank-indexed receive list (the
+    flat contract of ``coll/host.py``)."""
+    if len(values) != ctx.size:
+        raise errors.ArgError(f"alltoall needs {ctx.size} blocks")
+    topo = topology(ctx, groups)
+    return _alltoall_blocks(ctx, topo, list(values))
+
+
+@_recorded("alltoallv")
+def alltoallv(ctx, sendbuf, counts: list, displs: list | None = None,
+              groups: list[list[int]] | None = None) -> list:
+    """Two-level alltoallv: the flat (counts, displs) slicing of
+    ``coll/host.py`` feeds the shared block schedule — variable-size
+    blocks ride the aggregated leader exchange unchanged (host-plane
+    objects carry their own size)."""
+    blocks = host._blocks_from(sendbuf, counts, displs, ctx.size)
+    topo = topology(ctx, groups)
+    return _alltoall_blocks(ctx, topo, blocks)

@@ -81,7 +81,7 @@ mca_var.register(
 # and coll/tuned.py's rules-line validation all read THIS name
 HAN_OPS = frozenset((
     "allreduce", "bcast", "reduce", "barrier", "allgather",
-    "reduce_scatter",
+    "reduce_scatter", "alltoall", "alltoallv",
 ))
 
 
@@ -118,6 +118,8 @@ def _han_route(ctx, opname: str, payload: Any = None, op=None):
 HOST_RULE_ALGS = {
     "allreduce": ("recursive_doubling", "ring"),
     "reduce": ("binomial", "pipeline"),
+    "alltoall": ("pairwise", "bruck"),
+    "alltoallv": ("pairwise",),
 }
 
 
@@ -687,14 +689,53 @@ def scatter(ctx, values: list | None = None, root: int = 0) -> Any:
 # --------------------------------------------------------------- alltoall
 
 
+def _alltoall_bruck(ctx, blocks: list, tag: int) -> list:
+    """Bruck alltoall (coll_base_alltoall.c:191 shape): local rotation,
+    then ceil(log2(p)) store-and-forward rounds — round k ships every
+    slot whose index has bit k set to rank+k — then an inverse rotation.
+    O(log p) messages per rank against pairwise's O(p), each carrying up
+    to half the slots: the latency-bound regime's trade, and the leader
+    exchange coll/han's alltoall family uses above a leader-count bar."""
+    size, rank = ctx.size, ctx.rank
+    tmp = [blocks[(rank + i) % size] for i in range(size)]
+    k = 1
+    while k < size:
+        idxs = [i for i in range(size) if i & k]
+        got = ctx.sendrecv(
+            [tmp[i] for i in idxs], (rank + k) % size,
+            source=(rank - k) % size, sendtag=tag, recvtag=tag,
+            cid=COLL_CID,
+        )
+        for i, blk in zip(idxs, got):
+            tmp[i] = blk
+        k <<= 1
+    return [tmp[(rank - src) % size] for src in range(size)]
+
+
 def alltoall(ctx, values: list) -> list:
     """Pairwise-exchange alltoall (coll_base_alltoall.c:383-444 shape):
     p-1 rounds, round i exchanges with rank±i.  ``values`` is the
-    rank-indexed send list; returns the rank-indexed receive list."""
+    rank-indexed send list; returns the rank-indexed receive list.
+    A tuned rule may pin "bruck" (log-round store-and-forward) or "han"
+    (hierarchical two-level schedule) instead."""
     size, rank = ctx.size, ctx.rank
     if len(values) != size:
         raise errors.ArgError(f"alltoall needs {size} blocks")
+    # Payloads are per-rank send lists — never congruent across ranks —
+    # so the size-matched dynamic-rules consult sees 0 bytes everywhere
+    # (the bcast discipline): alltoall rules use msg_bytes_min 0.  An
+    # explicit flat rule outranks the auto han decision (the reference's
+    # dynamic-rules precedence, same as allreduce/reduce above).
+    ruled = _rule_alg(ctx, "alltoall", None)
+    if ruled is None:
+        han = _han_route(ctx, "alltoall", None)
+        if han is not None:
+            return han.alltoall(ctx, values)
+    if size == 1:
+        return [values[0]]
     tag = _next_tag(ctx, TAG_ALLTOALL)
+    if ruled == "bruck":
+        return _alltoall_bruck(ctx, list(values), tag)
     out: list = [None] * size
     out[rank] = values[rank]
     for i in range(1, size):
@@ -800,6 +841,14 @@ def alltoallv(ctx, sendbuf, counts: list, displs: list | None = None
     received blocks."""
     size, rank = ctx.size, ctx.rank
     blocks = _blocks_from(sendbuf, counts, displs, size)
+    # same non-congruent-payload discipline as alltoall above:
+    # alltoallv rules match with msg_bytes_min 0, and an explicit flat
+    # rule outranks the auto han decision
+    ruled = _rule_alg(ctx, "alltoallv", None)
+    if ruled is None:
+        han = _han_route(ctx, "alltoallv", None)
+        if han is not None:
+            return han.alltoallv(ctx, sendbuf, counts, displs)
     tag = _next_tag(ctx, TAG_ALLTOALLV)
     out: list = [None] * size
     out[rank] = blocks[rank]

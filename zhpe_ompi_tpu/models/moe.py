@@ -82,6 +82,61 @@ def moe_ffn(comm, params, x, capacity_factor: float = 1.25):
     return (y * gate[:, None].astype(y.dtype)), keep
 
 
+def moe_host_ffn(ep, params, x, capacity_factor: float = 1.25):
+    """:func:`moe_ffn` on the HOST plane: the same top-1 routing and
+    static-capacity math, but both ep transposes ride the host
+    endpoint's ``alltoall`` — which the coll layer routes through the
+    hierarchical han schedule when the topology qualifies (intra
+    gather → one aggregated wire message per host pair → intra
+    scatter), the serving plane's expert-dispatch path.  ``ep`` is any
+    host endpoint carrying ``HostCollectives`` (a RankContext, a
+    TcpProc, a shrunken live window); one expert per rank.  Returns
+    ``(y, keep)`` exactly like :func:`moe_ffn`."""
+    import numpy as np
+
+    n = ep.size
+    T, D = x.shape
+    cap = max(1, int(capacity_factor * T / n))
+
+    logits = x.astype(jnp.float32) @ params["router"]
+    probs = jax.nn.softmax(logits, axis=-1)
+    expert = jnp.argmax(probs, axis=-1)
+    gate = jnp.take_along_axis(probs, expert[:, None], axis=1)[:, 0]
+
+    onehot = jax.nn.one_hot(expert, n, dtype=jnp.int32)
+    pos_in_expert = (jnp.cumsum(onehot, axis=0) - 1) * onehot
+    pos = jnp.sum(pos_in_expert, axis=-1)
+    keep = pos < cap
+
+    buf = jnp.zeros((n, cap, D), x.dtype)
+    tok_idx = jnp.where(keep, expert * cap + pos, n * cap)
+    buf = buf.reshape(n * cap, D).at[tok_idx].set(
+        jnp.where(keep[:, None], x, 0), mode="drop"
+    ).reshape(n, cap, D)
+
+    # ep transpose #1 on the host plane: one rank-indexed block per
+    # destination expert (np blocks — host collectives move host
+    # payloads; the han path aggregates them per host on the wire)
+    dispatched = ep.alltoall([np.asarray(buf[e]) for e in range(n)])
+
+    w_in = params["w_in"][0]
+    w_out = params["w_out"][0]
+    stacked = jnp.stack([jnp.asarray(b) for b in dispatched])  # (n,cap,D)
+    h = jax.nn.gelu(stacked.astype(jnp.float32) @ w_in)
+    out = (h @ w_out).astype(x.dtype)
+
+    # ep transpose #2: results ride back to their source ranks
+    returned = ep.alltoall([np.asarray(out[s]) for s in range(n)])
+    flat = jnp.stack([jnp.asarray(b) for b in returned]).reshape(n * cap, D)
+
+    y = jnp.where(
+        keep[:, None],
+        jnp.take(flat, jnp.clip(tok_idx, 0, n * cap - 1), axis=0),
+        0.0,
+    )
+    return (y * gate[:, None].astype(y.dtype)), keep
+
+
 def moe_reference_dense(
     params, x_all, n_experts: int, capacity: int, block_tokens: int | None = None
 ):

@@ -745,6 +745,192 @@ class DirectWindow(AmWindow):
             self.st.region = None
 
 
+# ------------------------------------------------ stage handoff -------
+# The PSCW region-doorbell follow-on: active-target epochs whose
+# post/complete signals ride the region header's doorbell words
+# (pt2pt/sm.py `_RH_POSTS`/`_RH_COMPLETES`, futex-parked) instead of AM
+# messages.  The serving plane's pipeline stages hand KV/activation
+# blocks down the chain through these, and weight broadcast rides the
+# same direct path — the tiny epoch signal is the ONLY non-payload
+# traffic, and it never touches the wire or the matching engine.
+
+TAG_HANDOFF = 0x7D0B
+
+
+class StageHandoff:
+    """Persistent pre-mapped handoff schedule for ONE pipeline-stage
+    pair (producer → consumer) over a :class:`DirectWindow`.
+
+    Construction is the persistent half, done ONCE: the producer
+    pre-maps the consumer's region (the same memoized seam decision
+    every direct op rides), both sides exchange their verdicts in one
+    handshake message, and the pair pins a mode for life — unanimous
+    DIRECT (doorbell epochs), or AM PSCW on both sides (loud:
+    ``osc_am_fallbacks``; a split-brain schedule where one side waits
+    on a doorbell the other never rings cannot arise).  Every epoch
+    after that is pure doorbell::
+
+        consumer: hoff.post()      # expose; rings the post word
+        producer: hoff.start()     # futex-parks on the post word
+                  hoff.put(kv, off)  # direct store into the region
+                  hoff.complete()  # rings the complete word
+        consumer: hoff.wait()      # futex-parks on the complete word
+
+    Doorbell generations are snapshotted at construction
+    (:meth:`~zhpe_ompi_tpu.pt2pt.sm.RmaMapping.doorbell_gens`), so a
+    schedule rebuilt over a reused region never consumes a stale ring.
+    Peer death classifies typed out of both parks (the window's
+    ``_abort_for`` hook), never a bare timeout."""
+
+    def __init__(self, win: DirectWindow, producer: int, consumer: int,
+                 timeout: float = 10.0):
+        if producer == consumer:
+            raise errors.WinError("stage handoff needs two ranks")
+        me = win.ep.rank
+        if me not in (producer, consumer):
+            raise errors.WinError(
+                f"rank {me} is not part of stage pair "
+                f"({producer} -> {consumer})")
+        self.win = win
+        self.producer, self.consumer = int(producer), int(consumer)
+        self.peer = self.consumer if me == self.producer \
+            else self.producer
+        if me == self.consumer:
+            mapping = win._region  # the exposed region is OUR OWN
+        else:
+            dm = win._direct(self.consumer)
+            mapping = dm.mapping if dm is not None else None
+        mine = mapping is not None
+        # Snapshot doorbell generations BEFORE the handshake: the peer
+        # cannot ring until its own handshake completes, and that needs
+        # our message — so a pre-handshake snapshot can never absorb the
+        # consumer's first post() (a post-handshake one can, and the
+        # producer would then park for a generation that never comes).
+        gens = mapping.doorbell_gens() if mine else (0, 0)
+        theirs = win.ep.sendrecv(
+            mine, self.peer, source=self.peer, sendtag=TAG_HANDOFF,
+            recvtag=TAG_HANDOFF)
+        self.direct = bool(mine and theirs)
+        self._mapping = mapping if self.direct else None
+        if not self.direct:
+            # one side could not map: BOTH pin to the AM PSCW path —
+            # loud on any direct-capable window, never silent
+            win._am_fallback()
+            mca_output.verbose(
+                1, _stream, "stage pair (%d -> %d): doorbell "
+                "unavailable (local=%s peer=%s); AM PSCW epochs",
+                self.producer, self.consumer, mine, theirs,
+            )
+            self._posts_seen = self._completes_seen = 0
+        else:
+            self._posts_seen, self._completes_seen = gens
+        self.timeout = float(timeout)
+        self.epochs = 0
+
+    # -- consumer side ---------------------------------------------------
+
+    def post(self) -> None:
+        """Expose the next epoch to the producer."""
+        if self.win.ep.rank != self.consumer:
+            raise errors.WinError("post() is the consumer's verb")
+        if not self.direct:
+            return self.win.post([self.producer])
+        self._mapping.post_epoch()
+        spc.record("osc_doorbell_posts", 1)
+
+    def wait(self) -> None:
+        """Park until the producer completed the epoch."""
+        if self.win.ep.rank != self.consumer:
+            raise errors.WinError("wait() is the consumer's verb")
+        if not self.direct:
+            return self.win.wait_sync(self.timeout)
+        self._completes_seen = self._mapping.await_complete(
+            self._completes_seen, self.timeout,
+            abort=self.win._abort_for(self.producer))
+        self.epochs += 1
+
+    def recv(self, offset: int = 0, count: int | None = None
+             ) -> np.ndarray:
+        """Consumer-side read of the landed epoch payload (a local
+        load — the producer already stored it into OUR region)."""
+        return self.win.get(self.consumer, offset, count)
+
+    # -- producer side ---------------------------------------------------
+
+    def start(self) -> None:
+        """Park until the consumer exposed the epoch."""
+        if self.win.ep.rank != self.producer:
+            raise errors.WinError("start() is the producer's verb")
+        if not self.direct:
+            return self.win.start([self.consumer],
+                                  timeout=self.timeout)
+        self._posts_seen = self._mapping.await_post(
+            self._posts_seen, self.timeout,
+            abort=self.win._abort_for(self.consumer))
+
+    def put(self, data, offset: int = 0) -> None:
+        """Stage payload into the consumer's region (direct store on
+        the doorbell path; the window's loud AM fallback otherwise)."""
+        if self.win.ep.rank != self.producer:
+            raise errors.WinError("put() is the producer's verb")
+        self.win.put(data, self.consumer, offset)
+
+    def complete(self) -> None:
+        """Ring the completion doorbell — direct stores are visible at
+        issue, so the bump IS the epoch's completion signal."""
+        if self.win.ep.rank != self.producer:
+            raise errors.WinError("complete() is the producer's verb")
+        if not self.direct:
+            return self.win.complete()
+        self._mapping.complete_epoch()
+        spc.record("osc_doorbell_completes", 1)
+        self.epochs += 1
+
+
+def pipeline_schedule(win: DirectWindow, stages: list[int] | None = None,
+                      timeout: float = 10.0) -> dict[str, StageHandoff]:
+    """The whole pipeline's persistent schedule in one call: for a
+    stage chain (default: every rank in order) each rank builds its
+    upstream and downstream :class:`StageHandoff` pairs — ``{"up":
+    handoff-from-previous-stage, "down": handoff-to-next-stage}``
+    (absent at the chain's ends).  Handshakes pair by construction
+    order: every rank builds its UP pair before its DOWN pair."""
+    stages = list(range(win.ep.size)) if stages is None else list(stages)
+    me = win.ep.rank
+    if me not in stages:
+        return {}
+    i = stages.index(me)
+    out: dict[str, StageHandoff] = {}
+    if i > 0:
+        out["up"] = StageHandoff(win, stages[i - 1], me,
+                                 timeout=timeout)
+    if i + 1 < len(stages):
+        out["down"] = StageHandoff(win, me, stages[i + 1],
+                                   timeout=timeout)
+    return out
+
+
+def window_bcast(win: DirectWindow, data=None, root: int = 0,
+                 count: int | None = None) -> np.ndarray:
+    """Weight broadcast riding the RMA direct path: the root stores
+    the payload into its OWN window region, and every rank pulls it
+    with a window ``get`` — a direct mapped load for every same-host
+    rank (``osc_direct_bytes`` carries the payload; a cross-host rank
+    degrades loudly to an AM get).  One tiny collective bcast carries
+    the element count — the control plane; the payload plane is pure
+    RMA.  The serving loop's remesh leg re-broadcasts weights onto a
+    survivor or post-resize mesh through this."""
+    if win.ep.rank == root:
+        arr = np.ascontiguousarray(data)
+        flat = arr.reshape(-1)
+        win.put(flat, root)  # the owner's own region: a local store
+        n = win.ep.bcast(int(flat.size), root=root)
+    else:
+        n = win.ep.bcast(None, root=root)
+    win.ep.barrier()  # the store happened-before every pull
+    return win.get(root, 0, n if count is None else count)
+
+
 def allocate_window(ctx, nbytes: int, dtype=np.uint8, info=None):
     """MPI_Win_allocate with component selection (the
     osc_rdma_component priority scheme): direct memory for

@@ -1442,6 +1442,10 @@ _RH_MUTEX = 36      # u32: region lock word (0 free, holder rank+1)
 _RH_READERS = 40    # u32: shared passive-lock holder count
 _RH_WRITER = 44     # u32: exclusive passive-lock holder rank+1 (0 none)
 _RH_AMQ = 48        # u32: AM-origin lock waiters queued at the owner
+_RH_POSTS = 52      # u32: PSCW exposure-epoch doorbell (post count;
+                    #      parked origins' futex word)
+_RH_COMPLETES = 56  # u32: PSCW completion doorbell (complete count;
+                    #      the parked target's futex word)
 _RH_TABLE = 64      # u32[nprocs]: per-rank passive-lock state
 
 # per-rank holder-table states: the waiting-writer state makes writer
@@ -1828,6 +1832,74 @@ class RmaMapping:
             except ValueError:
                 pass
         return recovered
+
+    # -- the PSCW region doorbell --------------------------------------
+    # Post/complete as the epoch signal, carried by two header words
+    # instead of AM messages: the exposing side bumps its region's
+    # post word (waking origins parked on its futex), origins direct-
+    # store the epoch payload and bump the complete word (waking the
+    # parked target).  The sm doorbell idiom applied to active-target
+    # synchronization — no message, no matching engine, no target-side
+    # dispatch.  Counts wrap at 2^32; waits compare modulo.
+
+    def _ring(self, off: int) -> int:
+        with self.atomic():
+            gen = (self._word(off) + 1) & 0xFFFFFFFF
+            _U32.pack_into(self._mm, off, gen)
+        _futex_wake(self._mm, off, 64)
+        return gen
+
+    def _await_ring(self, off: int, seen: int, timeout: float,
+                    abort, what: str) -> int:
+        deadline = time.monotonic() + timeout
+        while True:
+            cur = self._word(off)
+            if (cur - seen) & 0xFFFFFFFF:
+                return cur
+            if abort is not None:
+                abort()
+            if time.monotonic() > deadline:
+                raise errors.InternalError(
+                    f"rma region {self.path}: {what} doorbell never "
+                    f"rang within {timeout}s"
+                )
+            try:
+                _futex_wait(self._mm, off, cur, 0.1)
+            except ValueError:
+                raise RegionOwnerGone(
+                    f"rma region {self.path} unmapped mid-{what}-wait"
+                )
+
+    def post_epoch(self) -> int:
+        """Ring the exposure doorbell (MPI_Win_post's signal leg);
+        returns the new post generation."""
+        return self._ring(_RH_POSTS)
+
+    def await_post(self, seen: int, timeout: float = 10.0,
+                   abort=None) -> int:
+        """Park until the post doorbell advances past ``seen``
+        (MPI_Win_start's wait leg); returns the observed generation —
+        the caller's next ``seen``."""
+        return self._await_ring(_RH_POSTS, seen, timeout, abort, "post")
+
+    def complete_epoch(self) -> int:
+        """Ring the completion doorbell (MPI_Win_complete's signal
+        leg — direct stores are visible at issue, so the bump IS the
+        whole completion)."""
+        return self._ring(_RH_COMPLETES)
+
+    def await_complete(self, seen: int, timeout: float = 10.0,
+                       abort=None) -> int:
+        """Park until the completion doorbell advances past ``seen``
+        (MPI_Win_wait's wait leg)."""
+        return self._await_ring(_RH_COMPLETES, seen, timeout, abort,
+                                "complete")
+
+    def doorbell_gens(self) -> tuple[int, int]:
+        """Current (post, complete) generations — the persistent
+        schedule snapshots these at construction so its first epoch
+        never consumes a stale ring."""
+        return self._word(_RH_POSTS), self._word(_RH_COMPLETES)
 
     # -- data access ---------------------------------------------------
 

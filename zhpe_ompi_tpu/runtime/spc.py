@@ -131,6 +131,20 @@ Hierarchical-collective counters (the coll/han analog; recorded by
   present but unusable during topology derivation: counted and demoted
   to a singleton domain (a malformed FOREIGN card must never raise out
   of a collective).
+- ``coll_han_alltoall_collectives`` — alltoall-family collectives
+  (alltoall, alltoallv, and reduce_scatter's leader phase) that ran
+  the hierarchical three-phase block schedule: intra gather → leader
+  wire exchange of aggregated per-host block matrices → intra
+  scatter.
+- ``coll_han_alltoall_inter_bytes`` — payload bytes the alltoall
+  family's LEADER phase handed to the wire (each leader's own block
+  excluded): O(hosts²) aggregated messages against the flat path's
+  O(ranks²) — the OSU ``--plane alltoall`` ladder asserts this stays
+  strictly below flat pairwise's ``tcp_bytes_sent`` at equal payload.
+- ``coll_han_alltoall_leader_msgs`` — wire messages the leader
+  exchange issued per leader: ``p-1`` on the pairwise schedule,
+  ``ceil(log2 p)`` once ``coll_han_alltoall_bruck_min`` leaders flip
+  it to Bruck store-and-forward.
 
 Runtime-plane counters (the PRRTE/PMIx analog — ``runtime/pmix.py``
 records the ``pmix_*`` family in the process hosting the STORE, i.e.
@@ -260,7 +274,16 @@ bytes strictly rising while ``osc_am_applied`` and wire
   asserted ZERO along the same-host OSU osc ladder; on mixed
   topologies it splits exactly against ``osc_direct_*``.  Windows
   with no region anywhere (plane off, sm off) are plain AM windows
-  and are not counted.
+  and are not counted.  A stage-handoff pair that handshook into AM
+  PSCW mode counts here too (once, at construction).
+- ``osc_doorbell_posts`` — exposure epochs a persistent stage-handoff
+  schedule opened by ringing the region header's POST doorbell word
+  (futex-waking the parked producer) instead of sending an AM post
+  message.
+- ``osc_doorbell_completes`` — handoff epochs completed by ringing
+  the COMPLETE doorbell word (direct stores are visible at issue, so
+  the bump IS the completion signal); the same-host pipeline-handoff
+  gate asserts these move while ``osc_am_applied`` stays flat.
 - ``shmem_puts`` / ``shmem_gets`` / ``shmem_puts_nbi`` / ``shmem_gets_nbi``
   — OpenSHMEM put/get traffic, blocking and nonblocking-implicit.
 - ``pgas_device_epochs`` — device-heap epoch advances (the PGAS
@@ -331,6 +354,30 @@ fbtl stream; ``models/ftloop.py`` records the overlap gate):
 - ``ckpt_restore_bytes`` — payload bytes read back by a
   digest-verified restore (the restore-bandwidth numerator the MTTR
   rollback leg divides by its span duration).
+
+Serving-plane counters (the continuous-batching inference loop —
+``models/inferloop.py`` records them; rank 0 of a serving job is the
+request plane's control point, so its published snapshot carries the
+load signal the operator-side LoadController scrapes):
+
+- ``infer_requests_submitted`` — requests submitted into a serving
+  queue (monotone; the backlog gauge the elastic policy keys on is
+  ``infer_requests_submitted`` − ``infer_requests_served`` — the
+  counter-difference idiom, derivable from any published snapshot).
+- ``infer_requests_served`` — requests resolved by a completed serve
+  step (rank 0 resolves the whole admitted batch at the step
+  boundary).
+- ``infer_queue_depth_max`` — WATERMARK: the deepest the request
+  backlog ever got, observed at each admission boundary; a burst the
+  resize policy absorbed is still visible here after the queue
+  drains.
+- ``infer_requeues`` — in-flight requests a typed fault sent BACK to
+  the queue head (served or requeued, never silently dropped — the
+  mid-serve kill drill's conservation gate).
+- ``infer_resizes`` — elastic membership changes the serving loop
+  applied at a step boundary (the worker-side count of the closed
+  observability→runtime loop; the daemon's ``dvm_resizes`` is the
+  operator-side twin).
 
 Observability-plane counters (the fleet-visible metrics plane —
 recorded by this module's :class:`MetricsPublisher` and by
@@ -432,7 +479,7 @@ _lock = threading.Lock()
 _reset_epoch = 0
 
 WATERMARK = {"max_bytes_in_collective", "match_unexpected_max_depth",
-             "dvm_queue_wait_ms"}
+             "dvm_queue_wait_ms", "infer_queue_depth_max"}
 
 #: publisher interval floor (seconds): below this a fleet of publishers
 #: degenerates into sub-interval polling on shared cores

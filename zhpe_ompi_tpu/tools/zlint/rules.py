@@ -875,6 +875,10 @@ class LoudDegradation(Rule):
         # table and a live decision degrades loudly, never by raising
         "parse_table", "resolve_rule", "table_geometry",
         "job_topology_key", "topology_key",
+        # the serving plane (PR 20): the han alltoall family's leader
+        # wire-exchange choice; the elastic resize policy's `decide`
+        # rides the existing name above
+        "_leader_exchange_alg",
     }
 
     def visit(self, mod: Module) -> list[Finding]:

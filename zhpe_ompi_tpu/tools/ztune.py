@@ -69,7 +69,17 @@ TOPOLOGIES = {
 CANDIDATES = {
     "allreduce": ("recursive_doubling", "ring", "han"),
     "reduce": ("binomial", "pipeline", "han"),
+    "alltoall": ("pairwise", "bruck", "han"),
+    "alltoallv": ("pairwise", "han"),
 }
+
+#: ops whose serve-time rules consult sees 0 payload bytes (per-rank
+#: send lists are never congruent across ranks — the bcast discipline
+#: in coll/host.py), so a rule with msg_bytes_min > 0 would be DEAD at
+#: serve time: the distiller pins these ops' rules to bmin 0, electing
+#: the winner from the smallest swept size (larger sizes ride the
+#: report rows only — a size-split choice is not expressible).
+SIZE_BLIND_OPS = frozenset(("alltoall", "alltoallv"))
 
 #: counter deltas measured per cell: the first two are the gating wire
 #: metric (sum = payload bytes that crossed a transport), the rest ride
@@ -159,14 +169,31 @@ def _cell_body(proc, op: str, nbytes: int, iters: int, trials: int):
     n, rank = proc.size, proc.rank
     arr = np.full(max(n, nbytes // 8), float(rank + 1), dtype=np.float64)
     expect = float(n * (n + 1) // 2)
+    # alltoall family: nbytes total per rank, split into n per-
+    # destination blocks stamped with the sender (correctness below)
+    blocks = [np.full(max(1, nbytes // (8 * n)), float(rank + 1),
+                      dtype=np.float64) for _ in range(n)]
 
     def run_once():
         if op == "allreduce":
             return proc.allreduce(arr, zops.SUM)
+        if op == "alltoall":
+            return proc.alltoall(list(blocks))
+        if op == "alltoallv":
+            return proc.alltoallv(np.concatenate(blocks),
+                                  [b.size for b in blocks])
         return proc.reduce(arr, zops.SUM, 0)
 
     out = run_once()  # warmup + correctness (a tuned table must never
-    if op == "allreduce" or rank == 0:  # trade wrong answers for bytes)
+    if op in SIZE_BLIND_OPS:  # trade wrong answers for bytes)
+        for src, blk in enumerate(out):
+            got = np.asarray(blk).reshape(-1)
+            if got[0] != float(src + 1) or got[-1] != float(src + 1):
+                raise RuntimeError(
+                    f"ztune cell {op}/{nbytes}B: wrong block from rank "
+                    f"{src} (got {got[0]}, want {float(src + 1)})"
+                )
+    elif op == "allreduce" or rank == 0:
         got = np.asarray(out).reshape(-1)
         if got[0] != expect or got[-1] != expect:
             raise RuntimeError(
@@ -363,7 +390,8 @@ def _worker_main(spec: dict) -> int:
 # -- sweep + distill ----------------------------------------------------
 
 
-def sweep(topos=("flat", "han2", "han3"), ops=("allreduce", "reduce"),
+def sweep(topos=("flat", "han2", "han3"),
+          ops=("allreduce", "reduce", "alltoall", "alltoallv"),
           min_bytes: int = _DEF_MIN_BYTES,
           max_bytes: int = _DEF_MAX_BYTES, iters: int = 4,
           trials: int = 2, real_procs: bool = False,
@@ -500,9 +528,16 @@ def distill(cells: list[dict]) -> dict:
                 "lat_us": wdata.get("lat_us"),
             })
         rules = entry["rules"]
+        op_rules = [r for r in rules if r[0] == cell["op"]]
+        if cell["op"] in SIZE_BLIND_OPS:
+            # serve-time consult sees 0 bytes: one bmin-0 rule per op,
+            # elected by the smallest swept size (sweep order)
+            if op_rules or alg == "builtin":
+                continue
+            rules.append((cell["op"], 0, 0, alg))
+            continue
         # merge: only emit when the choice changes along the size axis;
         # a leading "builtin" is implicit (no rule = builtin)
-        op_rules = [r for r in rules if r[0] == cell["op"]]
         if op_rules and op_rules[-1][3] == alg:
             continue
         if not op_rules and alg == "builtin":
@@ -617,7 +652,7 @@ def main(argv=None) -> int:
     ap.add_argument("--publish", metavar="HOST:PORT",
                     help="publish the table into this PMIx store")
     ap.add_argument("--topos", default="flat,han2,han3")
-    ap.add_argument("--ops", default="allreduce,reduce")
+    ap.add_argument("--ops", default="allreduce,reduce,alltoall,alltoallv")
     ap.add_argument("--min-bytes", type=int, default=_DEF_MIN_BYTES)
     ap.add_argument("--max-bytes", type=int, default=_DEF_MAX_BYTES)
     ap.add_argument("--iters", type=int, default=4)
